@@ -1,0 +1,220 @@
+"""Round-trip + schema tests for the TPUJob API (reference tier-1 analog:
+v1alpha2 types/defaults/validation unit tests)."""
+
+import copy
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.defaults import canonical_replica_type, set_defaults
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUSliceSpec,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_spec
+
+
+def make_template(image="busybox", name=constants.DEFAULT_CONTAINER_NAME):
+    return {"spec": {"containers": [{"name": name, "image": image}]}}
+
+
+def make_job(replica_specs=None, **meta):
+    job = TPUJob.from_dict(
+        {
+            "apiVersion": constants.API_VERSION,
+            "kind": constants.KIND,
+            "metadata": {"name": meta.get("name", "job1"), "namespace": "default", "uid": "uid-1"},
+            "spec": {"replicaSpecs": replica_specs or {}},
+        }
+    )
+    return job
+
+
+def worker_spec(n=1, tpu=None):
+    d = {"replicas": n, "template": make_template()}
+    if tpu:
+        d["tpu"] = tpu
+    return d
+
+
+class TestRoundTrip:
+    def test_to_from_dict_identity(self):
+        d = {
+            "apiVersion": constants.API_VERSION,
+            "kind": "TPUJob",
+            "metadata": {"name": "j", "namespace": "ns", "uid": "u", "labels": {"a": "b"}},
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 4,
+                        "template": make_template(),
+                        "restartPolicy": "ExitCode",
+                        "tpu": {"acceleratorType": "v5e-16", "topology": "4x4"},
+                    },
+                    "PS": {"replicas": 2, "template": make_template()},
+                },
+                "cleanPodPolicy": "All",
+                "ttlSecondsAfterFinished": 60,
+                "scheduling": {"gang": True, "schedulerName": "gang-sched"},
+            },
+            "status": {
+                "conditions": [
+                    {
+                        "type": "Created",
+                        "status": "True",
+                        "reason": "TPUJobCreated",
+                        "message": "ok",
+                        "lastUpdateTime": "t0",
+                        "lastTransitionTime": "t0",
+                    }
+                ],
+                "replicaStatuses": {"Worker": {"active": 4, "succeeded": 0, "failed": 0}},
+                "startTime": "t1",
+            },
+        }
+        job = TPUJob.from_dict(copy.deepcopy(d))
+        out = job.to_dict()
+        assert out["spec"]["replicaSpecs"]["Worker"]["tpu"]["acceleratorType"] == "v5e-16"
+        assert out["spec"]["cleanPodPolicy"] == "All"
+        assert out["status"]["replicaStatuses"]["Worker"]["active"] == 4
+        # Full second round-trip is stable.
+        assert TPUJob.from_dict(out).to_dict() == out
+
+    def test_deepcopy_isolated(self):
+        job = make_job({"Worker": worker_spec()})
+        other = job.deepcopy()
+        other.spec.replica_specs["Worker"].replicas = 99
+        assert job.spec.replica_specs["Worker"].replicas == 1
+
+
+class TestDefaults:
+    def test_basic_defaults(self):
+        job = make_job({"worker": {"template": make_template()}})
+        set_defaults(job)
+        spec = job.spec
+        # Key case normalized (defaults.go setTypeNamesToCamelCase analog).
+        assert ReplicaType.WORKER in spec.replica_specs
+        w = spec.replica_specs[ReplicaType.WORKER]
+        assert w.replicas == 1
+        assert w.restart_policy == RestartPolicy.NEVER
+        assert spec.clean_pod_policy == CleanPodPolicy.RUNNING
+        # Named port injected on the default container.
+        ports = w.template["spec"]["containers"][0]["ports"]
+        assert {"name": constants.DEFAULT_PORT_NAME, "containerPort": constants.DEFAULT_PORT} in ports
+
+    def test_port_not_duplicated(self):
+        tmpl = make_template()
+        tmpl["spec"]["containers"][0]["ports"] = [
+            {"name": constants.DEFAULT_PORT_NAME, "containerPort": 5555}
+        ]
+        job = make_job({"Worker": {"template": tmpl}})
+        set_defaults(job)
+        ports = job.spec.replica_specs["Worker"].template["spec"]["containers"][0]["ports"]
+        assert len(ports) == 1 and ports[0]["containerPort"] == 5555
+
+    def test_tpu_replicas_derived_from_slice(self):
+        job = make_job(
+            {"Worker": {"template": make_template(), "tpu": {"acceleratorType": "v5e-16"}}}
+        )
+        set_defaults(job)
+        w = job.spec.replica_specs["Worker"]
+        assert w.replicas == 4  # v5e-16 = 4 hosts x 4 chips
+        assert w.tpu.topology == "4x4"
+        assert job.spec.scheduling.gang is True  # multi-host slice => gang on
+
+    def test_single_host_slice_no_gang(self):
+        job = make_job(
+            {"Worker": {"template": make_template(), "tpu": {"acceleratorType": "v5e-4"}}}
+        )
+        set_defaults(job)
+        assert job.spec.replica_specs["Worker"].replicas == 1
+        assert job.spec.scheduling.gang is False
+
+    def test_multislice_replicas(self):
+        job = make_job(
+            {
+                "Worker": {
+                    "template": make_template(),
+                    "tpu": {"acceleratorType": "v5e-16", "numSlices": 2},
+                }
+            }
+        )
+        set_defaults(job)
+        assert job.spec.replica_specs["Worker"].replicas == 8
+
+    def test_canonical_type(self):
+        assert canonical_replica_type("ps") == "PS"
+        assert canonical_replica_type("WORKER") == "Worker"
+        assert canonical_replica_type("chief") == "Chief"
+        assert canonical_replica_type("unknownRole") == "unknownRole"
+
+
+class TestValidation:
+    def _valid_spec(self):
+        job = make_job({"Worker": worker_spec(2), "PS": worker_spec(1)})
+        set_defaults(job)
+        return job.spec
+
+    def test_valid_passes(self):
+        validate_spec(self._valid_spec())
+
+    def test_empty_replicas_rejected(self):
+        job = make_job({})
+        with pytest.raises(ValidationError, match="must not be empty"):
+            validate_spec(job.spec)
+
+    def test_unknown_type_rejected(self):
+        job = make_job({"Gopher": worker_spec()})
+        with pytest.raises(ValidationError, match="unknown replica type"):
+            validate_spec(job.spec)
+
+    def test_no_containers_rejected(self):
+        job = make_job({"Worker": {"replicas": 1, "template": {"spec": {"containers": []}}}})
+        with pytest.raises(ValidationError, match="containers is empty"):
+            validate_spec(job.spec)
+
+    def test_empty_image_rejected(self):
+        job = make_job({"Worker": {"replicas": 1, "template": make_template(image="")}})
+        with pytest.raises(ValidationError, match="image is empty"):
+            validate_spec(job.spec)
+
+    def test_missing_default_container_rejected(self):
+        job = make_job({"Worker": {"replicas": 1, "template": make_template(name="main")}})
+        with pytest.raises(ValidationError, match="no container named"):
+            validate_spec(job.spec)
+
+    def test_bad_accelerator_rejected(self):
+        job = make_job(
+            {"Worker": {"template": make_template(), "tpu": {"acceleratorType": "v9z-4"}}}
+        )
+        with pytest.raises(ValidationError, match="unknown accelerator"):
+            validate_spec(job.spec)
+
+    def test_replicas_slice_mismatch_rejected(self):
+        job = make_job(
+            {
+                "Worker": {
+                    "replicas": 3,
+                    "template": make_template(),
+                    "tpu": {"acceleratorType": "v5e-16"},
+                }
+            }
+        )
+        with pytest.raises(ValidationError, match="inconsistent"):
+            validate_spec(job.spec)
+
+    def test_two_chiefs_rejected(self):
+        job = make_job({"Chief": worker_spec(2), "Worker": worker_spec(1)})
+        with pytest.raises(ValidationError, match="at most 1 chief"):
+            validate_spec(job.spec)
+
+    def test_bad_restart_policy_rejected(self):
+        job = make_job(
+            {"Worker": {"replicas": 1, "template": make_template(), "restartPolicy": "Sometimes"}}
+        )
+        with pytest.raises(ValidationError, match="restartPolicy"):
+            validate_spec(job.spec)

@@ -1,0 +1,134 @@
+"""Golden tests for the topology/env injection layer (the TF_CONFIG analog).
+Parity: controller_pod_test.go:87 TF_CONFIG content tests + golden-file
+strategy from SURVEY.md §7 stage 3."""
+
+import json
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller import cluster_spec
+from tf_operator_tpu.controller import status as status_engine
+from tf_operator_tpu.api.types import JobCondition, JobConditionType, TPUJobStatus
+from tf_operator_tpu.utils import testutil
+
+
+class TestTFConfig:
+    def test_cluster_spec_golden(self):
+        job = testutil.new_tpujob(name="dist", worker=4, ps=2, chief=True)
+        spec = cluster_spec.gen_cluster_spec(job)
+        assert spec == {
+            "chief": ["dist-chief-0:2222"],
+            "ps": ["dist-ps-0:2222", "dist-ps-1:2222"],
+            "worker": [
+                "dist-worker-0:2222",
+                "dist-worker-1:2222",
+                "dist-worker-2:2222",
+                "dist-worker-3:2222",
+            ],
+        }
+
+    def test_tf_config_json(self):
+        job = testutil.new_tpujob(name="dist", worker=2, ps=1)
+        cfg = json.loads(cluster_spec.gen_tf_config(job, "PS", 0))
+        assert cfg["task"] == {"type": "ps", "index": 0}
+        assert cfg["environment"] == "cloud"
+
+    def test_evaluator_excluded(self):
+        job = testutil.new_tpujob(worker=1, evaluator=True)
+        assert "evaluator" not in cluster_spec.gen_cluster_spec(job)
+
+    def test_custom_port_respected(self):
+        job = testutil.new_tpujob(worker=1)
+        tmpl = job.spec.replica_specs["Worker"].template
+        tmpl["spec"]["containers"][0]["ports"] = [
+            {"name": constants.DEFAULT_PORT_NAME, "containerPort": 7777}
+        ]
+        assert cluster_spec.get_port(job, "Worker") == 7777
+        assert cluster_spec.gen_cluster_spec(job)["worker"] == ["test-job-worker-0:7777"]
+
+    def test_injection_only_default_container(self):
+        job = testutil.new_tpujob(worker=1)
+        tmpl = job.spec.replica_specs["Worker"].template
+        tmpl["spec"]["containers"].append({"name": "sidecar", "image": "side"})
+        out = cluster_spec.set_cluster_spec(tmpl, job, "Worker", 0)
+        tf_env = [
+            e for c in out["spec"]["containers"] if c["name"] == "sidecar"
+            for e in c.get("env", [])
+        ]
+        assert tf_env == []
+
+    def test_user_env_not_clobbered(self):
+        job = testutil.new_tpujob(worker=1)
+        tmpl = job.spec.replica_specs["Worker"].template
+        tmpl["spec"]["containers"][0]["env"] = [
+            {"name": constants.ENV_TF_CONFIG, "value": "user-set"}
+        ]
+        out = cluster_spec.set_cluster_spec(tmpl, job, "Worker", 0)
+        env = {e["name"]: e["value"] for e in out["spec"]["containers"][0]["env"]}
+        assert env[constants.ENV_TF_CONFIG] == "user-set"
+
+
+class TestTPUEnv:
+    def test_multislice_env(self):
+        job = testutil.new_tpujob(name="ms", tpu_accelerator="v5e-16", num_slices=2)
+        # 8 pods total: indices 0-3 slice 0, 4-7 slice 1.
+        env = cluster_spec.gen_tpu_env(job, "Worker", 5)
+        assert env[constants.ENV_TPU_WORKER_ID] == "1"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env[constants.ENV_TPU_WORKER_HOSTNAMES] == (
+            "ms-worker-4,ms-worker-5,ms-worker-6,ms-worker-7"
+        )
+        assert env[constants.ENV_COORDINATOR_ADDRESS] == "ms-worker-4:2222"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-worker-0:2222"
+
+    def test_non_tpu_replica_no_env(self):
+        job = testutil.new_tpujob(worker=2)
+        assert cluster_spec.gen_tpu_env(job, "Worker", 0) == {}
+
+    def test_hostnames_stable_ordering(self):
+        job = testutil.new_tpujob(name="st", tpu_accelerator="v5e-16")
+        env0 = cluster_spec.gen_tpu_env(job, "Worker", 0)
+        env3 = cluster_spec.gen_tpu_env(job, "Worker", 3)
+        assert (
+            env0[constants.ENV_TPU_WORKER_HOSTNAMES]
+            == env3[constants.ENV_TPU_WORKER_HOSTNAMES]
+        )
+
+
+class TestStatusEngine:
+    def _cond(self, ctype):
+        return status_engine.new_condition(ctype, "r", "m")
+
+    def test_running_restarting_exclusive(self):
+        st = TPUJobStatus()
+        status_engine.set_condition(st, self._cond(JobConditionType.RUNNING))
+        status_engine.set_condition(st, self._cond(JobConditionType.RESTARTING))
+        types = [c.type for c in st.conditions if c.status == "True"]
+        assert JobConditionType.RESTARTING in types
+        assert JobConditionType.RUNNING not in types
+        status_engine.set_condition(st, self._cond(JobConditionType.RUNNING))
+        types = [c.type for c in st.conditions if c.status == "True"]
+        assert JobConditionType.RUNNING in types
+        assert JobConditionType.RESTARTING not in types
+
+    def test_terminal_flips_running_false(self):
+        st = TPUJobStatus()
+        status_engine.set_condition(st, self._cond(JobConditionType.RUNNING))
+        status_engine.set_condition(st, self._cond(JobConditionType.SUCCEEDED))
+        running = [c for c in st.conditions if c.type == JobConditionType.RUNNING]
+        assert running[0].status == "False"
+        assert status_engine.is_succeeded(st)
+
+    def test_failed_sticky(self):
+        st = TPUJobStatus()
+        status_engine.set_condition(st, self._cond(JobConditionType.FAILED))
+        status_engine.set_condition(st, self._cond(JobConditionType.RUNNING))
+        assert status_engine.is_failed(st)
+        assert not status_engine.is_running(st)
+
+    def test_created_then_running_coexist(self):
+        st = TPUJobStatus()
+        status_engine.set_condition(st, self._cond(JobConditionType.CREATED))
+        status_engine.set_condition(st, self._cond(JobConditionType.RUNNING))
+        types = [c.type for c in st.conditions if c.status == "True"]
+        assert JobConditionType.CREATED in types and JobConditionType.RUNNING in types

@@ -1,0 +1,158 @@
+"""In-memory cluster semantics: uid/RV, optimistic concurrency, watch,
+label-selector lists, merge patch, events."""
+
+import threading
+
+import pytest
+
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    merge_patch,
+)
+from tf_operator_tpu.runtime.events import EventRecorder
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+
+def pod(name, ns="default", labels=None):
+    return objects.new_pod(name, ns, labels=labels)
+
+
+class TestCrud:
+    def test_create_assigns_identity(self):
+        c = InMemoryCluster()
+        created = c.create(objects.PODS, pod("p1"))
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"]
+        assert created["metadata"]["creationTimestamp"]
+
+    def test_create_duplicate_rejected(self):
+        c = InMemoryCluster()
+        c.create(objects.PODS, pod("p1"))
+        with pytest.raises(AlreadyExists):
+            c.create(objects.PODS, pod("p1"))
+
+    def test_get_not_found(self):
+        c = InMemoryCluster()
+        with pytest.raises(NotFound):
+            c.get(objects.PODS, "default", "nope")
+
+    def test_update_stale_rv_conflicts(self):
+        c = InMemoryCluster()
+        v1 = c.create(objects.PODS, pod("p1"))
+        v2 = c.get(objects.PODS, "default", "p1")
+        v2["status"]["phase"] = "Running"
+        c.update(objects.PODS, v2)
+        v1["status"]["phase"] = "Failed"
+        with pytest.raises(Conflict):
+            c.update(objects.PODS, v1)
+
+    def test_update_status_only_touches_status(self):
+        c = InMemoryCluster()
+        created = c.create(objects.PODS, pod("p1", labels={"a": "b"}))
+        created["metadata"]["labels"] = {"hacked": "yes"}
+        created["status"]["phase"] = "Running"
+        c.update_status(objects.PODS, created)
+        stored = c.get(objects.PODS, "default", "p1")
+        assert stored["metadata"]["labels"] == {"a": "b"}
+        assert stored["status"]["phase"] == "Running"
+
+    def test_uid_changes_on_recreate(self):
+        c = InMemoryCluster()
+        u1 = c.create(objects.PODS, pod("p1"))["metadata"]["uid"]
+        c.delete(objects.PODS, "default", "p1")
+        u2 = c.create(objects.PODS, pod("p1"))["metadata"]["uid"]
+        assert u1 != u2
+
+    def test_label_selector_list(self):
+        c = InMemoryCluster()
+        c.create(objects.PODS, pod("a", labels={"job": "x", "i": "0"}))
+        c.create(objects.PODS, pod("b", labels={"job": "x", "i": "1"}))
+        c.create(objects.PODS, pod("c", labels={"job": "y"}))
+        got = c.list(objects.PODS, "default", {"job": "x"})
+        assert [objects.name_of(p) for p in got] == ["a", "b"]
+
+    def test_namespace_isolation(self):
+        c = InMemoryCluster()
+        c.create(objects.PODS, pod("a", ns="ns1"))
+        c.create(objects.PODS, pod("a", ns="ns2"))
+        assert len(c.list(objects.PODS)) == 2
+        assert len(c.list(objects.PODS, "ns1")) == 1
+
+    def test_deep_copies_returned(self):
+        c = InMemoryCluster()
+        c.create(objects.PODS, pod("p1"))
+        got = c.get(objects.PODS, "default", "p1")
+        got["status"]["phase"] = "Mutated"
+        assert c.get(objects.PODS, "default", "p1")["status"]["phase"] == "Pending"
+
+
+class TestPatch:
+    def test_merge_patch_semantics(self):
+        base = {"a": {"b": 1, "c": 2}, "d": [1, 2], "e": "x"}
+        out = merge_patch(base, {"a": {"b": 9}, "d": [3], "e": None})
+        assert out == {"a": {"b": 9, "c": 2}, "d": [3]}
+
+    def test_patch_through_cluster(self):
+        c = InMemoryCluster()
+        c.create(objects.PODS, pod("p1", labels={"keep": "1"}))
+        c.patch_merge(objects.PODS, "default", "p1", {"metadata": {"labels": {"new": "2"}}})
+        stored = c.get(objects.PODS, "default", "p1")
+        assert stored["metadata"]["labels"] == {"keep": "1", "new": "2"}
+
+
+class TestWatch:
+    def test_watch_stream(self):
+        c = InMemoryCluster()
+        w = c.watch(objects.PODS)
+        c.create(objects.PODS, pod("p1"))
+        e = w.next(timeout=1)
+        assert e.type == ADDED and objects.name_of(e.object) == "p1"
+        got = c.get(objects.PODS, "default", "p1")
+        got["status"]["phase"] = "Running"
+        c.update(objects.PODS, got)
+        assert w.next(timeout=1).type == MODIFIED
+        c.delete(objects.PODS, "default", "p1")
+        assert w.next(timeout=1).type == DELETED
+
+    def test_watch_namespace_filter(self):
+        c = InMemoryCluster()
+        w = c.watch(objects.PODS, "ns1")
+        c.create(objects.PODS, pod("a", ns="ns2"))
+        c.create(objects.PODS, pod("b", ns="ns1"))
+        e = w.next(timeout=1)
+        assert objects.name_of(e.object) == "b"
+
+    def test_watch_from_thread(self):
+        c = InMemoryCluster()
+        w = c.watch(objects.PODS)
+        seen = []
+
+        def consume():
+            e = w.next(timeout=2)
+            if e:
+                seen.append(e)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        c.create(objects.PODS, pod("p1"))
+        t.join()
+        assert len(seen) == 1
+
+
+class TestEvents:
+    def test_recorder_writes_events(self):
+        c = InMemoryCluster()
+        rec = EventRecorder(c)
+        job = {"kind": "TPUJob", "metadata": {"name": "j", "namespace": "default", "uid": "u"}}
+        rec.normal(job, "SuccessfulCreatePod", "Created pod: x")
+        evs = c.list(objects.EVENTS)
+        assert len(evs) == 1
+        assert evs[0]["reason"] == "SuccessfulCreatePod"
+        assert evs[0]["involvedObject"]["name"] == "j"
+        assert evs[0]["type"] == "Normal"

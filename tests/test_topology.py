@@ -1,0 +1,66 @@
+"""TPU slice topology math tests."""
+
+import pytest
+
+from tf_operator_tpu.topology import slices
+
+
+class TestResolve:
+    @pytest.mark.parametrize(
+        "accel,hosts,chips_per_host,topology",
+        [
+            ("v5e-1", 1, 1, "1x1"),
+            ("v5e-4", 1, 4, "2x2"),
+            ("v5e-8", 1, 8, "2x4"),
+            ("v5e-16", 4, 4, "4x4"),
+            ("v5e-64", 16, 4, "8x8"),
+            ("v5e-256", 64, 4, "16x16"),
+            ("v6e-16", 4, 4, "4x4"),
+            ("v4-8", 2, 4, "2x2x2"),
+            ("v5p-8", 2, 4, "2x2x2"),
+        ],
+    )
+    def test_shapes(self, accel, hosts, chips_per_host, topology):
+        topo = slices.resolve(accel)
+        assert topo.num_hosts == hosts
+        assert topo.chips_per_host == chips_per_host
+        assert topo.topology == topology
+        assert topo.num_chips == hosts * chips_per_host or topo.num_hosts == 1
+
+    def test_explicit_topology(self):
+        topo = slices.resolve("v5e-16", "2x8")
+        assert topo.topology == "2x8"
+        assert topo.num_hosts == 4
+
+    def test_topology_chip_mismatch(self):
+        with pytest.raises(slices.TopologyError, match="topology"):
+            slices.resolve("v5e-16", "4x8")
+
+    def test_unknown_generation(self):
+        with pytest.raises(slices.TopologyError, match="unknown accelerator"):
+            slices.resolve("h100-8")
+
+    def test_too_many_chips(self):
+        with pytest.raises(slices.TopologyError, match="exceeds"):
+            slices.resolve("v5e-512")
+
+    def test_multi_host_flag(self):
+        assert not slices.resolve("v5e-8").multi_host
+        assert slices.resolve("v5e-16").multi_host
+
+    def test_case_insensitive(self):
+        assert slices.resolve("V5E-16").accelerator_type == "v5e-16"
+
+    def test_gke_accelerator_names(self):
+        assert slices.resolve("v5e-16").gke_accelerator == "tpu-v5-lite-podslice"
+        assert slices.resolve("v4-8").gke_accelerator == "tpu-v4-podslice"
+
+
+class TestParse:
+    def test_parse_accelerator(self):
+        assert slices.parse_accelerator_type("v5e-16") == ("v5e", 16)
+
+    def test_parse_topology(self):
+        assert slices.parse_topology("2x2x4") == (2, 2, 4)
+        with pytest.raises(slices.TopologyError):
+            slices.parse_topology("2xx4")

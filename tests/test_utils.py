@@ -1,0 +1,73 @@
+"""Exit-code policy, naming, and logger utilities (tier-1 parity:
+pkg/util/train/train_util_test.go, util_test.go)."""
+
+import pytest
+
+from tf_operator_tpu.api.helpers import (
+    gen_labels,
+    labels_to_selector,
+    replica_labels,
+    selector_matches,
+)
+from tf_operator_tpu.utils import exit_codes, logger, names
+
+
+class TestExitCodes:
+    @pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139])
+    def test_permanent(self, code):
+        assert exit_codes.is_permanent(code)
+        assert not exit_codes.is_retryable(code)
+
+    @pytest.mark.parametrize("code", [130, 137, 138, 143])
+    def test_retryable(self, code):
+        assert exit_codes.is_retryable(code)
+
+    def test_success(self):
+        assert exit_codes.is_success(0)
+        assert not exit_codes.is_retryable(0)
+        assert not exit_codes.is_permanent(0)
+
+    def test_unknown_signal_retryable(self):
+        assert exit_codes.is_retryable(131)  # SIGQUIT
+
+    def test_sigusr1_reserved(self):
+        assert exit_codes.SIGUSR1_EXIT == 138
+        assert exit_codes.is_retryable(138)
+
+
+class TestNames:
+    def test_gen_name(self):
+        assert names.gen_name("mnist", "Worker", 3) == "mnist-worker-3"
+
+    def test_gen_name_sanitizes(self):
+        assert names.gen_name("My_Job", "PS", 0) == "my-job-ps-0"
+
+    def test_rand_string_charset(self):
+        s = names.rand_string(64)
+        assert len(s) == 64
+        assert all(c.islower() or c.isdigit() for c in s)
+
+
+class TestLabels:
+    def test_replica_labels(self):
+        labels = replica_labels("j1", "Worker", 2)
+        assert labels["tpu-replica-type"] == "worker"
+        assert labels["tpu-replica-index"] == "2"
+        assert labels["tpu-job-name"] == "j1"
+
+    def test_selector(self):
+        sel = gen_labels("j1")
+        assert selector_matches(sel, replica_labels("j1", "PS", 0))
+        assert not selector_matches(sel, replica_labels("j2", "PS", 0))
+        assert "tpu-job-name=j1" in labels_to_selector(sel)
+
+
+class TestLogger:
+    def test_fields_bound(self, capsys):
+        logger.configure(json_format=True)
+        log = logger.for_replica("ns", "job", "Worker")
+        log.info("hello")
+        err = capsys.readouterr().err
+        assert '"job": "ns.job"' in err
+        assert '"replica_type": "Worker"' in err
+        logger.configure(json_format=False)

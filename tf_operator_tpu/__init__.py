@@ -1,0 +1,26 @@
+"""tf_operator_tpu — a TPU-native training-job orchestration framework.
+
+A ground-up rebuild of the capability surface of Kubeflow's tf-operator
+(reference: /root/reference, see SURVEY.md) designed TPU-first:
+
+- A declarative ``TPUJob`` resource: per-role replica sets
+  (Chief/Worker/PS/Evaluator) where a replica set may bind a whole **TPU
+  pod-slice** (accelerator type + topology, e.g. ``v5e-16``) instead of a
+  per-container GPU limit.
+- A reconciling controller (informer cache + expectations + claiming) that
+  turns the resource into gang-scheduled per-host pods and rendezvous
+  services, injects the cluster-topology contract (``TF_CONFIG`` plus
+  ``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID`` / coordinator env), and rolls
+  pod states up into condition-based job status — with restart/exit-code
+  policy applied at *slice* granularity (one bad host restarts the slice).
+- A JAX/Flax training stack (``models/``, ``parallel/``, ``ops/``) that
+  consumes the injected topology: SPMD over ``jax.sharding.Mesh`` with
+  dp/tp/sp axes, ring attention for long context, bf16 MXU-friendly kernels.
+
+Subpackages map to the reference's layer map (SURVEY.md §1) — see each
+module's docstring for the file:line parity citations.
+"""
+
+from tf_operator_tpu.version import VERSION
+
+__version__ = VERSION

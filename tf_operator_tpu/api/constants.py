@@ -1,0 +1,52 @@
+"""API-group constants for the TPUJob resource.
+
+Analog of the reference's pkg/apis/tensorflow/v1alpha2/constants.go:17-30 and
+the group/kind registration in v1alpha2/types.go:28-66, re-keyed for a
+TPU-native operator.
+"""
+
+from __future__ import annotations
+
+# API group / version / kind (the CRD coordinates).
+GROUP_NAME = "tpuflow.org"
+VERSION = "v1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+SINGULAR = "tpujob"
+CRD_NAME = f"{PLURAL}.{GROUP_NAME}"
+API_VERSION = f"{GROUP_NAME}/{VERSION}"
+
+# The container in each replica pod template that receives the cluster
+# topology contract.  Kept as "tensorflow" for drop-in parity with the
+# reference (v1alpha2/constants.go: DefaultContainerName), so existing TFJob
+# pod templates keep working.
+DEFAULT_CONTAINER_NAME = "tensorflow"
+
+# Named port on the default container used for the gRPC rendezvous mesh
+# (v1alpha2/constants.go: DefaultPortName/DefaultPort).
+DEFAULT_PORT_NAME = "tfjob-port"
+DEFAULT_PORT = 2222
+
+# Labels stamped on every pod/service the controller creates.  Parity with
+# jobcontroller.GenLabels (jobcontroller.go:132-140) + the pod-level
+# tf-replica-type / tf-replica-index labels (controller_pod.go:109-128).
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "tpu-job-name"
+LABEL_REPLICA_TYPE = "tpu-replica-type"
+LABEL_REPLICA_INDEX = "tpu-replica-index"
+LABEL_JOB_ROLE = "job-role"
+
+# Env var names of the injected topology contract (the TF_CONFIG analog;
+# reference: controller_tensorflow.go:66-96 emits only TF_CONFIG).
+ENV_TF_CONFIG = "TF_CONFIG"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_COORDINATOR_ADDRESS = "TPU_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "TPU_NUM_PROCESSES"
+
+# Namespace the operator itself runs in (KUBEFLOW_NAMESPACE analog,
+# v1alpha2/constants.go:18-19).
+ENV_OPERATOR_NAMESPACE = "TPUFLOW_NAMESPACE"
+DEFAULT_OPERATOR_NAMESPACE = "default"

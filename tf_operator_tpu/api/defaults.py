@@ -1,0 +1,90 @@
+"""Defaulting for TPUJob specs.
+
+Parity: pkg/apis/tensorflow/v1alpha2/defaults.go:35-106 —
+CleanPodPolicy→Running, replicas→1, RestartPolicy→Never, inject the named
+rendezvous port on the default container, normalize replica-type key case —
+plus the TPU-specific rules: a replica set bound to a slice gets
+replicas = num_hosts × num_slices (one pod per TPU host), and gang
+scheduling resolves to "on" whenever any multi-host slice is present.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.topology import slices
+
+_CANONICAL_TYPES = {t.lower(): t for t in ReplicaType.ALL}
+
+
+def canonical_replica_type(name: str) -> str:
+    """'ps' → 'PS', 'worker' → 'Worker' (defaults.go setTypeNamesToCamelCase)."""
+    return _CANONICAL_TYPES.get(name.lower(), name)
+
+
+def _default_port(replica: ReplicaSpec) -> None:
+    """Ensure the default container exposes the named rendezvous port
+    (defaults.go setDefaultPort)."""
+    containers = replica.template.get("spec", {}).get("containers", [])
+    for c in containers:
+        if c.get("name") != constants.DEFAULT_CONTAINER_NAME:
+            continue
+        ports = c.setdefault("ports", [])
+        if not any(p.get("name") == constants.DEFAULT_PORT_NAME for p in ports):
+            ports.append(
+                {
+                    "name": constants.DEFAULT_PORT_NAME,
+                    "containerPort": constants.DEFAULT_PORT,
+                }
+            )
+
+
+def _default_replicas(replica: ReplicaSpec) -> None:
+    if replica.tpu and replica.tpu.accelerator_type:
+        topo = slices.resolve(replica.tpu.accelerator_type, replica.tpu.topology)
+        want = topo.num_hosts * max(1, replica.tpu.num_slices)
+        # A slice binding fully determines the pod count; an explicit replicas
+        # that disagrees is corrected here and flagged by validation.
+        if replica.replicas is None:
+            replica.replicas = want
+        # Record the inferred topology so downstream layers don't re-derive.
+        if replica.tpu.topology is None:
+            replica.tpu.topology = topo.topology
+    elif replica.replicas is None:
+        replica.replicas = 1
+
+
+def set_defaults_spec(spec: TPUJobSpec) -> TPUJobSpec:
+    # Normalize replica-type key case first so later logic sees canonical keys.
+    spec.replica_specs = {
+        canonical_replica_type(t): r for t, r in spec.replica_specs.items()
+    }
+    if spec.clean_pod_policy is None:
+        spec.clean_pod_policy = CleanPodPolicy.RUNNING
+
+    any_multi_host = False
+    for replica in spec.replica_specs.values():
+        if replica.restart_policy is None:
+            replica.restart_policy = RestartPolicy.NEVER
+        _default_replicas(replica)
+        _default_port(replica)
+        if replica.tpu and replica.tpu.accelerator_type:
+            topo = slices.resolve(replica.tpu.accelerator_type, replica.tpu.topology)
+            any_multi_host = any_multi_host or topo.multi_host
+
+    if spec.scheduling.gang is None:
+        spec.scheduling.gang = any_multi_host
+    return spec
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Apply defaults in place (scheme.Default analog) and return the job."""
+    set_defaults_spec(job.spec)
+    return job

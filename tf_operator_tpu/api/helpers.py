@@ -1,0 +1,65 @@
+"""Small API-object helpers.
+
+Parity: pkg/apis/tensorflow/helper/helpers.go:36-47 (AsOwner) and the
+label-selector builders. The accelerator-config-injection half of that file
+(helpers.go:50-104, nvidia.com/gpu volumes) is superseded by the first-class
+TPU slice spec — see topology/slices.py and controller/cluster_spec.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import TPUJob
+
+
+def as_owner(job: TPUJob) -> dict[str, Any]:
+    """Controller OwnerReference for resources created on behalf of a job."""
+    return {
+        "apiVersion": job.api_version,
+        "kind": job.kind,
+        "name": job.metadata.name,
+        "uid": job.metadata.uid,
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def gen_labels(job_name: str) -> dict[str, str]:
+    """Base labels for everything owned by a job (jobcontroller.go:132-140)."""
+    return {
+        constants.LABEL_GROUP_NAME: constants.GROUP_NAME,
+        constants.LABEL_JOB_NAME: job_name,
+    }
+
+
+def replica_labels(job_name: str, replica_type: str, index: int) -> dict[str, str]:
+    labels = gen_labels(job_name)
+    labels[constants.LABEL_REPLICA_TYPE] = replica_type.lower()
+    labels[constants.LABEL_REPLICA_INDEX] = str(index)
+    return labels
+
+
+def labels_to_selector(labels: dict[str, str]) -> str:
+    """K8s label-selector string, sorted for determinism."""
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def selector_matches(selector: dict[str, str], labels: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def is_controlled_by(obj_meta: dict[str, Any], job: TPUJob) -> bool:
+    """True when obj's controller ownerReference points at this job (by UID)."""
+    for ref in obj_meta.get("ownerReferences", []):
+        if ref.get("controller") and ref.get("uid") == job.metadata.uid:
+            return True
+    return False
+
+
+def get_controller_of(obj_meta: dict[str, Any]) -> dict[str, Any] | None:
+    for ref in obj_meta.get("ownerReferences", []):
+        if ref.get("controller"):
+            return ref
+    return None

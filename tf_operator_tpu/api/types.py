@@ -1,0 +1,400 @@
+"""TPUJob resource schema.
+
+The user-facing API: one modern schema modeled on the reference's v1alpha2
+generation (pkg/apis/tensorflow/v1alpha2/types.go:28-230) — replica *map*
+rather than list, condition-based status rather than phases — extended with a
+first-class TPU pod-slice spec per replica set.
+
+Objects round-trip to/from plain dicts (the "unstructured" form) because the
+runtime store, the REST dashboard, and the YAML examples all speak dicts; the
+typed layer exists for defaults/validation/controller logic, exactly the role
+the generated Go structs play in the reference.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+from tf_operator_tpu.api import constants
+
+
+# ---------------------------------------------------------------------------
+# Enums (string-valued, as in the reference API group)
+# ---------------------------------------------------------------------------
+
+class ReplicaType:
+    """Parity: v1alpha2/types.go:117-132 (PS/Worker/Chief/Evaluator)."""
+
+    CHIEF = "Chief"
+    WORKER = "Worker"
+    PS = "PS"
+    EVALUATOR = "Evaluator"
+
+    ALL = (CHIEF, WORKER, PS, EVALUATOR)
+
+
+class RestartPolicy:
+    """Parity: v1alpha2/types.go:99-112, incl. the ExitCode policy."""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    EXIT_CODE = "ExitCode"
+
+    ALL = (ALWAYS, ON_FAILURE, NEVER, EXIT_CODE)
+
+
+class CleanPodPolicy:
+    """Parity: v1alpha2/types.go:86-93."""
+
+    NONE = "None"
+    RUNNING = "Running"
+    ALL = "All"
+
+    CHOICES = (NONE, RUNNING, ALL)
+
+
+class JobConditionType:
+    """Parity: v1alpha2/types.go:190-216."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+    ALL = (CREATED, RUNNING, RESTARTING, SUCCEEDED, FAILED)
+
+
+# ---------------------------------------------------------------------------
+# Spec
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TPUSliceSpec:
+    """First-class TPU pod-slice binding for a replica set.
+
+    This replaces the reference's nvidia.com/gpu resource-limit path
+    (helper/helpers.go:50-104): instead of "this container wants 2 GPUs",
+    a replica set declares "this replica set *is* a v5e-16 slice" and the
+    controller derives host count, gang semantics, node placement, and the
+    runtime mesh env from it.
+    """
+
+    accelerator_type: str = ""  # e.g. "v5e-16"
+    topology: str | None = None  # e.g. "4x4"; inferred when omitted
+    # Run this many independent slices (each gets its own gang); analog of
+    # multislice training over DCN.
+    num_slices: int = 1
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"acceleratorType": self.accelerator_type}
+        if self.topology:
+            d["topology"] = self.topology
+        if self.num_slices != 1:
+            d["numSlices"] = self.num_slices
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUSliceSpec":
+        return cls(
+            accelerator_type=d.get("acceleratorType", ""),
+            topology=d.get("topology"),
+            num_slices=int(d.get("numSlices", 1)),
+        )
+
+
+@dataclass
+class ReplicaSpec:
+    """One role's replica set. Parity: v1alpha2/types.go:68-84.
+
+    ``template`` is a core/v1 PodTemplateSpec kept unstructured (dict), as
+    the reference keeps the full v1.PodTemplateSpec.
+    """
+
+    replicas: int | None = None
+    template: dict[str, Any] = field(default_factory=dict)
+    restart_policy: str | None = None
+    tpu: TPUSliceSpec | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {}
+        if self.replicas is not None:
+            d["replicas"] = self.replicas
+        if self.template:
+            d["template"] = copy.deepcopy(self.template)
+        if self.restart_policy is not None:
+            d["restartPolicy"] = self.restart_policy
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ReplicaSpec":
+        return cls(
+            replicas=d.get("replicas"),
+            template=copy.deepcopy(d.get("template", {})),
+            restart_policy=d.get("restartPolicy"),
+            tpu=TPUSliceSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+        )
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (the reference exposes only an operator-level
+    --enable-gang-scheduling flag + kube-arbitrator schedulerName on pods;
+    jobcontroller.go:196-249). Promoted to the job spec here because on TPU
+    gang semantics are per-slice correctness, not an optional optimization."""
+
+    gang: bool | None = None  # None → auto: true iff any multi-host slice
+    scheduler_name: str | None = None
+    # Priority class propagated to pods, useful for preemption experiments.
+    priority_class: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {}
+        if self.gang is not None:
+            d["gang"] = self.gang
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        if self.priority_class:
+            d["priorityClass"] = self.priority_class
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SchedulingPolicy":
+        return cls(
+            gang=d.get("gang"),
+            scheduler_name=d.get("schedulerName"),
+            priority_class=d.get("priorityClass"),
+        )
+
+
+@dataclass
+class TPUJobSpec:
+    """Parity: v1alpha2/types.go:40-66 (TFJobSpec)."""
+
+    replica_specs: dict[str, ReplicaSpec] = field(default_factory=dict)
+    clean_pod_policy: str | None = None
+    ttl_seconds_after_finished: int | None = None
+    scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+    # Backoff limit for whole-job restarts under Restarting (slice-granular
+    # restarts count); None = unlimited, as the reference behaves.
+    max_restarts: int | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "replicaSpecs": {t: r.to_dict() for t, r in self.replica_specs.items()},
+        }
+        if self.clean_pod_policy is not None:
+            d["cleanPodPolicy"] = self.clean_pod_policy
+        if self.ttl_seconds_after_finished is not None:
+            d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
+        sched = self.scheduling.to_dict()
+        if sched:
+            d["scheduling"] = sched
+        if self.max_restarts is not None:
+            d["maxRestarts"] = self.max_restarts
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUJobSpec":
+        return cls(
+            replica_specs={
+                t: ReplicaSpec.from_dict(r)
+                for t, r in d.get("replicaSpecs", {}).items()
+            },
+            clean_pod_policy=d.get("cleanPodPolicy"),
+            ttl_seconds_after_finished=d.get("ttlSecondsAfterFinished"),
+            scheduling=SchedulingPolicy.from_dict(d.get("scheduling", {})),
+            max_restarts=d.get("maxRestarts"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+@dataclass
+class JobCondition:
+    """Parity: v1alpha2/types.go:172-216 (TFJobCondition)."""
+
+    type: str = ""
+    status: str = "True"  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_update_time: str = ""
+    last_transition_time: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "type": self.type,
+            "status": self.status,
+            "reason": self.reason,
+            "message": self.message,
+            "lastUpdateTime": self.last_update_time,
+            "lastTransitionTime": self.last_transition_time,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "JobCondition":
+        return cls(
+            type=d.get("type", ""),
+            status=d.get("status", "True"),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            last_update_time=d.get("lastUpdateTime", ""),
+            last_transition_time=d.get("lastTransitionTime", ""),
+        )
+
+
+@dataclass
+class ReplicaStatus:
+    """Parity: v1alpha2/types.go:159-169 (TFReplicaStatus)."""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"active": self.active, "succeeded": self.succeeded, "failed": self.failed}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ReplicaStatus":
+        return cls(
+            active=int(d.get("active", 0)),
+            succeeded=int(d.get("succeeded", 0)),
+            failed=int(d.get("failed", 0)),
+        )
+
+
+@dataclass
+class TPUJobStatus:
+    """Parity: v1alpha2/types.go:134-169 (TFJobStatus)."""
+
+    conditions: list[JobCondition] = field(default_factory=list)
+    replica_statuses: dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: str | None = None
+    completion_time: str | None = None
+    last_reconcile_time: str | None = None
+    restart_count: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "conditions": [c.to_dict() for c in self.conditions],
+            "replicaStatuses": {t: s.to_dict() for t, s in self.replica_statuses.items()},
+        }
+        if self.start_time:
+            d["startTime"] = self.start_time
+        if self.completion_time:
+            d["completionTime"] = self.completion_time
+        if self.last_reconcile_time:
+            d["lastReconcileTime"] = self.last_reconcile_time
+        if self.restart_count:
+            d["restartCount"] = self.restart_count
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUJobStatus":
+        return cls(
+            conditions=[JobCondition.from_dict(c) for c in d.get("conditions", [])],
+            replica_statuses={
+                t: ReplicaStatus.from_dict(s)
+                for t, s in d.get("replicaStatuses", {}).items()
+            },
+            start_time=d.get("startTime"),
+            completion_time=d.get("completionTime"),
+            last_reconcile_time=d.get("lastReconcileTime"),
+            restart_count=int(d.get("restartCount", 0)),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Top-level object
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ObjectMeta:
+    """The metadata subset the framework relies on (mirrors metav1.ObjectMeta)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+    creation_timestamp: str = ""
+    deletion_timestamp: str | None = None
+    owner_references: list[dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            d["uid"] = self.uid
+        if self.resource_version:
+            d["resourceVersion"] = self.resource_version
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.creation_timestamp:
+            d["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        if self.owner_references:
+            d["ownerReferences"] = copy.deepcopy(self.owner_references)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ObjectMeta":
+        return cls(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=str(d.get("resourceVersion", "")),
+            labels=dict(d.get("labels", {})),
+            annotations=dict(d.get("annotations", {})),
+            creation_timestamp=d.get("creationTimestamp", ""),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            owner_references=copy.deepcopy(d.get("ownerReferences", [])),
+        )
+
+
+@dataclass
+class TPUJob:
+    """The TPUJob custom resource. Parity: v1alpha2/types.go:28-38 (TFJob)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+
+    api_version: str = constants.API_VERSION
+    kind: str = constants.KIND
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUJob":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata", {})),
+            spec=TPUJobSpec.from_dict(d.get("spec", {})),
+            status=TPUJobStatus.from_dict(d.get("status", {})),
+            api_version=d.get("apiVersion", constants.API_VERSION),
+            kind=d.get("kind", constants.KIND),
+        )
+
+    def deepcopy(self) -> "TPUJob":
+        return TPUJob.from_dict(self.to_dict())

@@ -1,0 +1,85 @@
+"""Validation for TPUJob specs.
+
+Parity: pkg/apis/tensorflow/validation/validation.go:29-55
+(ValidateAlphaTwoTFJobSpec): every replica set has containers, images are
+non-empty, at least one container is named after the default container; plus
+the TPU-native rules (valid accelerator/topology, replicas consistent with
+slice host count, at most one Chief).  Validation runs at decode time, as the
+reference does in its unstructured informer (informer.go:87-110), so a
+malformed CR is rejected with an event instead of wedging the controller.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaType,
+    RestartPolicy,
+    TPUJobSpec,
+)
+from tf_operator_tpu.topology import slices
+
+
+class ValidationError(ValueError):
+    """A TPUJob spec that must be rejected at admission/decode time."""
+
+
+def validate_spec(spec: TPUJobSpec) -> None:
+    if not spec.replica_specs:
+        raise ValidationError("replicaSpecs must not be empty")
+
+    if spec.clean_pod_policy is not None and spec.clean_pod_policy not in CleanPodPolicy.CHOICES:
+        raise ValidationError(
+            f"cleanPodPolicy {spec.clean_pod_policy!r} not in {CleanPodPolicy.CHOICES}"
+        )
+    if spec.ttl_seconds_after_finished is not None and spec.ttl_seconds_after_finished < 0:
+        raise ValidationError("ttlSecondsAfterFinished must be >= 0")
+
+    for rtype, replica in spec.replica_specs.items():
+        where = f"replicaSpecs[{rtype}]"
+        if rtype not in ReplicaType.ALL:
+            raise ValidationError(
+                f"{where}: unknown replica type; expected one of {ReplicaType.ALL}"
+            )
+        if replica.restart_policy is not None and replica.restart_policy not in RestartPolicy.ALL:
+            raise ValidationError(
+                f"{where}: restartPolicy {replica.restart_policy!r} not in {RestartPolicy.ALL}"
+            )
+        if replica.replicas is not None and replica.replicas < 0:
+            raise ValidationError(f"{where}: replicas must be >= 0")
+
+        containers = replica.template.get("spec", {}).get("containers", [])
+        if not containers:
+            raise ValidationError(f"{where}: template.spec.containers is empty")
+        default_found = False
+        for i, c in enumerate(containers):
+            if not c.get("image"):
+                raise ValidationError(f"{where}: containers[{i}].image is empty")
+            if c.get("name") == constants.DEFAULT_CONTAINER_NAME:
+                default_found = True
+        if not default_found:
+            raise ValidationError(
+                f"{where}: no container named "
+                f"{constants.DEFAULT_CONTAINER_NAME!r} (the topology contract "
+                f"is injected into that container only)"
+            )
+
+        if replica.tpu and replica.tpu.accelerator_type:
+            if replica.tpu.num_slices < 1:
+                raise ValidationError(f"{where}: tpu.numSlices must be >= 1")
+            try:
+                topo = slices.resolve(replica.tpu.accelerator_type, replica.tpu.topology)
+            except slices.TopologyError as e:
+                raise ValidationError(f"{where}: {e}") from e
+            want = topo.num_hosts * replica.tpu.num_slices
+            if replica.replicas is not None and replica.replicas != want:
+                raise ValidationError(
+                    f"{where}: replicas={replica.replicas} inconsistent with "
+                    f"{replica.tpu.accelerator_type} × {replica.tpu.num_slices} "
+                    f"slice(s) = {want} host pod(s)"
+                )
+
+    chief = spec.replica_specs.get(ReplicaType.CHIEF)
+    if chief is not None and (chief.replicas or 0) > 1:
+        raise ValidationError("replicaSpecs[Chief]: at most 1 chief replica is allowed")

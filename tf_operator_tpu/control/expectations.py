@@ -1,0 +1,86 @@
+"""ControllerExpectations: the create/observe race breaker.
+
+Parity: the k8s.io/kubernetes ControllerExpectations the reference leans on
+(documented at jobcontroller.go:90-104, wired at tfcontroller.go:143). The
+controller's informer cache lags its own writes; without expectations a
+second sync between "created pod" and "saw pod in cache" would create
+duplicates. Before acting, a sync checks `satisfied(key)`; after issuing
+creates/deletes it bumps the expected counts; informer events decrement them.
+Entries expire after 5 minutes so a lost event can't wedge a job forever —
+critical here because gang-creating a 4-host slice quadruples the window
+(SURVEY.md §7 "create/observe races").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+EXPECTATION_TIMEOUT = 5 * 60.0
+
+
+class _Expectation:
+    __slots__ = ("adds", "dels", "timestamp")
+
+    def __init__(self, adds: int = 0, dels: int = 0) -> None:
+        self.adds = adds
+        self.dels = dels
+        self.timestamp = time.monotonic()
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.monotonic() - self.timestamp > EXPECTATION_TIMEOUT
+
+
+class ControllerExpectations:
+    """Keys are controller-chosen strings; the TPU controller uses
+    "{ns}/{name}/{replica-type}/pods" and ".../services"."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._store: dict[str, _Expectation] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(adds=count)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        with self._lock:
+            self._store[key] = _Expectation(dels=count)
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                exp = self._store[key] = _Expectation()
+            exp.adds += adds
+            exp.dels += dels
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, add_delta=-1)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, del_delta=-1)
+
+    def _lower(self, key: str, add_delta: int = 0, del_delta: int = 0) -> None:
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is not None:
+                exp.adds += add_delta
+                exp.dels += del_delta
+
+    def satisfied(self, key: str) -> bool:
+        """True when it's safe to act on the world view for this key."""
+        with self._lock:
+            exp = self._store.get(key)
+            if exp is None:
+                return True
+            if exp.fulfilled() or exp.expired():
+                return True
+            return False
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._store.pop(key, None)

@@ -1,0 +1,116 @@
+"""Pod create/delete with owner-ref stamping + event emission.
+
+Parity: pkg/control/pod_control.go (RealPodControl, forked from k8s core to
+control naming) and upstream controller.FakePodControl used by the tier-2
+tests. Creation validates the controller ownerReference, stamps labels, and
+records Normal/Warning events; deletion refuses pods already terminating.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from tf_operator_tpu.runtime import events as ev
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+
+
+class PodControlInterface:
+    def create_pod(
+        self,
+        namespace: str,
+        pod: dict[str, Any],
+        controller_object: dict[str, Any],
+        controller_ref: dict[str, Any],
+    ) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def delete_pod(
+        self, namespace: str, name: str, controller_object: dict[str, Any]
+    ) -> None:
+        raise NotImplementedError
+
+
+def validate_controller_ref(ref: dict[str, Any]) -> None:
+    if not ref.get("uid"):
+        raise ValueError("controllerRef has no UID")
+    if not ref.get("apiVersion") or not ref.get("kind"):
+        raise ValueError("controllerRef needs apiVersion and kind")
+    if not ref.get("controller"):
+        raise ValueError("controllerRef must have controller=true")
+
+
+class RealPodControl(PodControlInterface):
+    def __init__(self, client: ClusterClient, recorder: ev.EventRecorder) -> None:
+        self._client = client
+        self._recorder = recorder
+
+    def create_pod(self, namespace, pod, controller_object, controller_ref):
+        validate_controller_ref(controller_ref)
+        pod = copy.deepcopy(pod)
+        meta = objects.meta(pod)
+        meta["namespace"] = namespace
+        refs = meta.setdefault("ownerReferences", [])
+        if not any(r.get("uid") == controller_ref["uid"] for r in refs):
+            refs.append(copy.deepcopy(controller_ref))
+        try:
+            created = self._client.create(objects.PODS, pod)
+        except ApiError as e:
+            self._recorder.warning(
+                controller_object, ev.FAILED_CREATE_POD, f"Error creating: {e}"
+            )
+            raise
+        self._recorder.normal(
+            controller_object,
+            ev.SUCCESSFUL_CREATE_POD,
+            f"Created pod: {objects.name_of(created)}",
+        )
+        return created
+
+    def delete_pod(self, namespace, name, controller_object):
+        try:
+            pod = self._client.get(objects.PODS, namespace, name)
+            if objects.is_deleted(pod):
+                raise ApiError(f"pod {namespace}/{name} is already terminating")
+            self._client.delete(objects.PODS, namespace, name)
+        except ApiError as e:
+            self._recorder.warning(
+                controller_object, ev.FAILED_DELETE_POD, f"Error deleting {name}: {e}"
+            )
+            raise
+        self._recorder.normal(
+            controller_object, ev.SUCCESSFUL_DELETE_POD, f"Deleted pod: {name}"
+        )
+
+
+class FakePodControl(PodControlInterface):
+    """Records intents for assertions; optional create limit + injected errors."""
+
+    def __init__(self) -> None:
+        self.templates: list[dict[str, Any]] = []
+        self.controller_refs: list[dict[str, Any]] = []
+        self.delete_pod_names: list[str] = []
+        self.create_limit = 0  # 0 = unlimited
+        self.create_error: Exception | None = None
+        self.delete_error: Exception | None = None
+
+    def create_pod(self, namespace, pod, controller_object, controller_ref):
+        validate_controller_ref(controller_ref)
+        if self.create_limit and len(self.templates) >= self.create_limit:
+            raise ApiError("fake create limit exceeded")
+        if self.create_error is not None:
+            raise self.create_error
+        self.templates.append(copy.deepcopy(pod))
+        self.controller_refs.append(copy.deepcopy(controller_ref))
+        return pod
+
+    def delete_pod(self, namespace, name, controller_object):
+        if self.delete_error is not None:
+            raise self.delete_error
+        self.delete_pod_names.append(name)
+
+    def clear(self) -> None:
+        self.templates.clear()
+        self.controller_refs.clear()
+        self.delete_pod_names.clear()

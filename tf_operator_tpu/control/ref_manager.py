@@ -1,0 +1,108 @@
+"""ControllerRef claim/adopt/orphan manager.
+
+Parity: pkg/control/service_ref_manager.go:32-160 and the upstream
+PodControllerRefManager the reference uses via GetPodsForJob
+(jobcontroller.go:145-193). Reconciles list results against ownership:
+
+- matches selector + no controller → ADOPT (patch in our ownerReference),
+  unless the job is being deleted (CanAdopt recheck);
+- owned by us + no longer matches selector → ORPHAN (patch the ref out);
+- owned by someone else → ignore.
+
+Claiming makes the controller self-healing against manual label edits and
+lets it pick up pre-existing resources after an operator restart.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from tf_operator_tpu.api.helpers import selector_matches
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient, NotFound
+
+
+class RefManager:
+    def __init__(
+        self,
+        client: ClusterClient,
+        controller_obj: dict[str, Any],
+        controller_ref: dict[str, Any],
+        selector: dict[str, str],
+        can_adopt: Callable[[], bool] | None = None,
+    ) -> None:
+        self._client = client
+        self._obj = controller_obj
+        self._ref = controller_ref
+        self._selector = selector
+        self._can_adopt = can_adopt or (
+            lambda: not objects.is_deleted(controller_obj)
+        )
+
+    def _claim_one(self, kind: str, obj: dict[str, Any]) -> dict[str, Any] | None:
+        controller = None
+        for ref in objects.meta(obj).get("ownerReferences", []):
+            if ref.get("controller"):
+                controller = ref
+                break
+        matches = selector_matches(self._selector, objects.labels_of(obj))
+
+        if controller is not None:
+            if controller.get("uid") != self._ref.get("uid"):
+                return None  # owned by someone else
+            if matches:
+                return obj
+            # Ours but no longer matching: orphan it.
+            self._orphan(kind, obj)
+            return None
+
+        if not matches or objects.is_deleted(obj):
+            return None
+        if not self._can_adopt():
+            return None
+        return self._adopt(kind, obj)
+
+    def _adopt(self, kind: str, obj: dict[str, Any]) -> dict[str, Any] | None:
+        refs = list(objects.meta(obj).get("ownerReferences", []))
+        refs.append(dict(self._ref))
+        try:
+            return self._client.patch_merge(
+                kind,
+                objects.namespace_of(obj),
+                objects.name_of(obj),
+                {"metadata": {"ownerReferences": refs}},
+            )
+        except NotFound:
+            return None
+        except ApiError:
+            return None
+
+    def _orphan(self, kind: str, obj: dict[str, Any]) -> None:
+        refs = [
+            r
+            for r in objects.meta(obj).get("ownerReferences", [])
+            if r.get("uid") != self._ref.get("uid")
+        ]
+        try:
+            self._client.patch_merge(
+                kind,
+                objects.namespace_of(obj),
+                objects.name_of(obj),
+                {"metadata": {"ownerReferences": refs}},
+            )
+        except ApiError:
+            pass
+
+    def claim(self, kind: str, candidates: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        claimed = []
+        for obj in candidates:
+            got = self._claim_one(kind, obj)
+            if got is not None:
+                claimed.append(got)
+        return claimed
+
+    def claim_pods(self, pods: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        return self.claim(objects.PODS, pods)
+
+    def claim_services(self, services: list[dict[str, Any]]) -> list[dict[str, Any]]:
+        return self.claim(objects.SERVICES, services)

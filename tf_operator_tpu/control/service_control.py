@@ -1,0 +1,113 @@
+"""Service create/delete control, mirroring pod_control.
+
+Parity: pkg/control/service_control.go:41-207 (RealServiceControl +
+FakeServiceControl with CreateLimit).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+from tf_operator_tpu.runtime import events as ev
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.control.pod_control import validate_controller_ref
+
+
+class ServiceControlInterface:
+    def create_service(
+        self,
+        namespace: str,
+        service: dict[str, Any],
+        controller_object: dict[str, Any],
+        controller_ref: dict[str, Any],
+    ) -> dict[str, Any]:
+        raise NotImplementedError
+
+    def delete_service(
+        self, namespace: str, name: str, controller_object: dict[str, Any]
+    ) -> None:
+        raise NotImplementedError
+
+    def patch_service(
+        self, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]:
+        raise NotImplementedError
+
+
+class RealServiceControl(ServiceControlInterface):
+    def __init__(self, client: ClusterClient, recorder: ev.EventRecorder) -> None:
+        self._client = client
+        self._recorder = recorder
+
+    def create_service(self, namespace, service, controller_object, controller_ref):
+        validate_controller_ref(controller_ref)
+        service = copy.deepcopy(service)
+        meta = objects.meta(service)
+        meta["namespace"] = namespace
+        refs = meta.setdefault("ownerReferences", [])
+        if not any(r.get("uid") == controller_ref["uid"] for r in refs):
+            refs.append(copy.deepcopy(controller_ref))
+        try:
+            created = self._client.create(objects.SERVICES, service)
+        except ApiError as e:
+            self._recorder.warning(
+                controller_object, ev.FAILED_CREATE_SERVICE, f"Error creating: {e}"
+            )
+            raise
+        self._recorder.normal(
+            controller_object,
+            ev.SUCCESSFUL_CREATE_SERVICE,
+            f"Created service: {objects.name_of(created)}",
+        )
+        return created
+
+    def delete_service(self, namespace, name, controller_object):
+        try:
+            self._client.delete(objects.SERVICES, namespace, name)
+        except ApiError as e:
+            self._recorder.warning(
+                controller_object,
+                ev.FAILED_DELETE_SERVICE,
+                f"Error deleting {name}: {e}",
+            )
+            raise
+        self._recorder.normal(
+            controller_object, ev.SUCCESSFUL_DELETE_SERVICE, f"Deleted service: {name}"
+        )
+
+    def patch_service(self, namespace, name, patch):
+        return self._client.patch_merge(objects.SERVICES, namespace, name, patch)
+
+
+class FakeServiceControl(ServiceControlInterface):
+    """Parity: service_control.go:136-207."""
+
+    def __init__(self) -> None:
+        self.templates: list[dict[str, Any]] = []
+        self.delete_service_names: list[str] = []
+        self.patches: list[dict[str, Any]] = []
+        self.create_limit = 0
+        self.create_error: Exception | None = None
+
+    def create_service(self, namespace, service, controller_object, controller_ref):
+        validate_controller_ref(controller_ref)
+        if self.create_limit and len(self.templates) >= self.create_limit:
+            raise ApiError("fake create limit exceeded")
+        if self.create_error is not None:
+            raise self.create_error
+        self.templates.append(copy.deepcopy(service))
+        return service
+
+    def delete_service(self, namespace, name, controller_object):
+        self.delete_service_names.append(name)
+
+    def patch_service(self, namespace, name, patch):
+        self.patches.append(copy.deepcopy(patch))
+        return patch
+
+    def clear(self) -> None:
+        self.templates.clear()
+        self.delete_service_names.clear()
+        self.patches.clear()

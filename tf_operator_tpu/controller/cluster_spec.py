@@ -1,0 +1,159 @@
+"""Cluster-topology contract generation — the TF_CONFIG analog, TPU-first.
+
+Parity: pkg/controller.v2/tfcontroller/controller_tensorflow.go:33-124
+(genTFConfigJSONStr/genClusterSpec) + GetPortFromTFJob (controller_util.go:
+28-41). Two contracts are injected into the default container of every
+replica pod:
+
+1. ``TF_CONFIG`` — the classic map for tf.distribute strategies:
+   ``{"cluster": {role: ["host:port", ...]}, "task": {"type","index"},
+   "environment": "cloud"}``. Evaluators are excluded from the cluster map
+   exactly as in the reference (controller_tensorflow.go:103-107).
+   On TPU replica sets this is what points MultiWorkerMirroredStrategy at the
+   ICI mesh (one worker per slice host).
+
+2. The TPU mesh env — what JAX's ``jax.distributed.initialize`` and libtpu
+   consume directly: ``TPU_WORKER_HOSTNAMES`` (stable, index-ordered),
+   ``TPU_WORKER_ID``, ``TPU_COORDINATOR_ADDRESS`` (worker 0 of the slice),
+   accelerator type + topology, and per-slice MEGASCALE vars when a replica
+   set spans multiple slices (DCN multislice).
+
+Host ordering is derived from indexed pod/service names
+({job}-{type}-{index}), so it is stable across pod restarts — the rendezvous
+correctness property SURVEY.md §7 calls out.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import ReplicaType, TPUJob
+from tf_operator_tpu.topology import slices
+from tf_operator_tpu.utils import names
+
+
+def get_port(job: TPUJob, replica_type: str) -> int:
+    """Rendezvous port for a replica type: the named port on the default
+    container, else the global default."""
+    spec = job.spec.replica_specs.get(replica_type)
+    if spec is not None:
+        for c in spec.template.get("spec", {}).get("containers", []):
+            if c.get("name") != constants.DEFAULT_CONTAINER_NAME:
+                continue
+            for p in c.get("ports", []):
+                if p.get("name") == constants.DEFAULT_PORT_NAME:
+                    return int(p.get("containerPort", constants.DEFAULT_PORT))
+    return constants.DEFAULT_PORT
+
+
+def replica_hostname(job: TPUJob, replica_type: str, index: int) -> str:
+    """DNS name of a replica's headless service (== pod name)."""
+    return names.gen_name(job.metadata.name, replica_type, index)
+
+
+def gen_cluster_spec(job: TPUJob) -> dict[str, list[str]]:
+    """role → ["host:port", ...] for every replica type except Evaluator."""
+    cluster: dict[str, list[str]] = {}
+    for rtype, spec in sorted(job.spec.replica_specs.items()):
+        if rtype == ReplicaType.EVALUATOR:
+            continue
+        port = get_port(job, rtype)
+        cluster[rtype.lower()] = [
+            f"{replica_hostname(job, rtype, i)}:{port}"
+            for i in range(spec.replicas or 0)
+        ]
+    return cluster
+
+
+def gen_tf_config(job: TPUJob, replica_type: str, index: int) -> str:
+    """The TF_CONFIG JSON for one replica."""
+    config = {
+        "cluster": gen_cluster_spec(job),
+        "task": {"type": replica_type.lower(), "index": index},
+        "environment": "cloud",
+    }
+    return json.dumps(config, sort_keys=True)
+
+
+def gen_tpu_env(job: TPUJob, replica_type: str, index: int) -> dict[str, str]:
+    """TPU mesh env for one replica of a slice-bound replica set.
+
+    For ``num_slices`` > 1 the replica set's pods are partitioned into
+    contiguous index ranges, one range per slice; each slice has its own
+    in-slice worker ids and coordinator (worker 0 of that slice), and the
+    MEGASCALE vars wire slice 0's coordinator as the DCN rendezvous point.
+    """
+    spec = job.spec.replica_specs.get(replica_type)
+    if spec is None or spec.tpu is None or not spec.tpu.accelerator_type:
+        return {}
+    topo = slices.resolve(spec.tpu.accelerator_type, spec.tpu.topology)
+    num_slices = max(1, spec.tpu.num_slices)
+    port = get_port(job, replica_type)
+
+    slice_id, worker_id = divmod(index, topo.num_hosts)
+    base = slice_id * topo.num_hosts
+    hosts = [
+        replica_hostname(job, replica_type, base + i) for i in range(topo.num_hosts)
+    ]
+    env = {
+        constants.ENV_TPU_WORKER_HOSTNAMES: ",".join(hosts),
+        constants.ENV_TPU_WORKER_ID: str(worker_id),
+        constants.ENV_TPU_ACCELERATOR_TYPE: topo.accelerator_type,
+        constants.ENV_TPU_TOPOLOGY: topo.topology,
+        constants.ENV_COORDINATOR_ADDRESS: f"{hosts[0]}:{port}",
+        constants.ENV_NUM_PROCESSES: str(topo.num_hosts),
+    }
+    if num_slices > 1:
+        slice0_coord = replica_hostname(job, replica_type, 0)
+        env.update(
+            {
+                "MEGASCALE_NUM_SLICES": str(num_slices),
+                "MEGASCALE_SLICE_ID": str(slice_id),
+                "MEGASCALE_COORDINATOR_ADDRESS": f"{slice0_coord}:{port}",
+            }
+        )
+    return env
+
+
+def set_cluster_spec(
+    pod_template: dict[str, Any], job: TPUJob, replica_type: str, index: int
+) -> dict[str, Any]:
+    """Return a copy of the pod template with the topology contract injected
+    into the default container only (parity: replicas.go:202-234 injects
+    TF_CONFIG into the "tensorflow" container only)."""
+    tmpl = copy.deepcopy(pod_template)
+    injected = {constants.ENV_TF_CONFIG: gen_tf_config(job, replica_type, index)}
+    injected.update(gen_tpu_env(job, replica_type, index))
+
+    for c in tmpl.get("spec", {}).get("containers", []):
+        if c.get("name") != constants.DEFAULT_CONTAINER_NAME:
+            continue
+        env = c.setdefault("env", [])
+        present = {e.get("name") for e in env}
+        for k, v in injected.items():
+            if k not in present:
+                env.append({"name": k, "value": v})
+    return tmpl
+
+
+def node_placement(job: TPUJob, replica_type: str) -> dict[str, Any]:
+    """GKE node-selector terms pinning slice pods to the right TPU node pool.
+
+    The TPU-native replacement for the reference's accelerator volume/env
+    config injection (helper/helpers.go:50-104): placement is derived from
+    the slice spec, not from an operator-side config file.
+    """
+    spec = job.spec.replica_specs.get(replica_type)
+    if spec is None or spec.tpu is None or not spec.tpu.accelerator_type:
+        return {}
+    topo = slices.resolve(spec.tpu.accelerator_type, spec.tpu.topology)
+    return {
+        "nodeSelector": {
+            "cloud.google.com/gke-tpu-accelerator": topo.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": topo.topology,
+        },
+        "tpuResources": {"google.com/tpu": topo.chips_per_host},
+    }

@@ -1,0 +1,265 @@
+"""Per-replica-type pod reconciliation.
+
+Parity: pkg/controller.v2/tfcontroller/controller_pod.go — index-bucketed pod
+slices, expectation-guarded creation, TF_CONFIG injection at create time,
+RestartPolicy→pod-restartPolicy mapping (ExitCode→Never), and the ExitCode
+retry path (delete failed-but-retryable pods so they are recreated).
+
+TPU-native extension: **slice-granular restarts**. For a replica set bound to
+a multi-host TPU slice, ICI state is not recoverable piecemeal — when one
+host pod needs a restart, every pod of that slice group is deleted and
+recreated together (SURVEY.md §7 "failure semantics"). Restarts are counted
+on the job status and capped by spec.maxRestarts.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.helpers import replica_labels
+from tf_operator_tpu.api.types import ReplicaSpec, RestartPolicy, TPUJob
+from tf_operator_tpu.controller import cluster_spec
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.topology import slices as topo_slices
+from tf_operator_tpu.utils import exit_codes, names
+
+
+def get_pod_slices(
+    pods: list[dict[str, Any]], replicas: int
+) -> tuple[list[list[dict[str, Any]]], list[dict[str, Any]]]:
+    """Bucket pods by their replica-index label (controller_pod.go:109-128).
+
+    Returns (buckets[0..replicas-1], out_of_range) — out-of-range pods are
+    scale-down leftovers the caller deletes.
+    """
+    buckets: list[list[dict[str, Any]]] = [[] for _ in range(replicas)]
+    out_of_range: list[dict[str, Any]] = []
+    for pod in pods:
+        idx_str = objects.labels_of(pod).get(constants.LABEL_REPLICA_INDEX)
+        try:
+            idx = int(idx_str)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            continue
+        if 0 <= idx < replicas:
+            buckets[idx].append(pod)
+        else:
+            out_of_range.append(pod)
+    return buckets, out_of_range
+
+
+def map_restart_policy(replica_policy: str | None, is_multi_host_slice: bool) -> str:
+    """Replica RestartPolicy → pod spec.restartPolicy.
+
+    ExitCode maps to Never (the controller drives retries by deleting pods,
+    controller_pod.go:216). Multi-host slice pods are always Never: an
+    in-place container restart of one host cannot rejoin the ICI rendezvous,
+    so the controller must own the restart at slice granularity.
+    """
+    if is_multi_host_slice:
+        return "Never"
+    if replica_policy == RestartPolicy.EXIT_CODE:
+        return "Never"
+    return replica_policy or "Never"
+
+
+class PodReconciler:
+    """Mixin over JobController providing reconcile_pods. Host controller
+    supplies: pod_control, expectations, recorder, job_key/expectation_key."""
+
+    def build_pod(
+        self, job: TPUJob, rtype: str, spec: ReplicaSpec, index: int
+    ) -> dict[str, Any]:
+        """Materialize the pod for (job, type, index): labels, owner ref,
+        topology env, restart policy, TPU node placement."""
+        template = cluster_spec.set_cluster_spec(spec.template, job, rtype, index)
+        tmpl_spec = template.setdefault("spec", {})
+
+        is_slice = bool(spec.tpu and spec.tpu.accelerator_type)
+        multi_host = False
+        if is_slice:
+            topo = topo_slices.resolve(spec.tpu.accelerator_type, spec.tpu.topology)
+            multi_host = topo.multi_host
+            placement = cluster_spec.node_placement(job, rtype)
+            node_selector = tmpl_spec.setdefault("nodeSelector", {})
+            for k, v in placement.get("nodeSelector", {}).items():
+                node_selector.setdefault(k, v)
+            for c in tmpl_spec.get("containers", []):
+                if c.get("name") == constants.DEFAULT_CONTAINER_NAME:
+                    limits = c.setdefault("resources", {}).setdefault("limits", {})
+                    limits.setdefault(
+                        "google.com/tpu", placement["tpuResources"]["google.com/tpu"]
+                    )
+
+        tmpl_spec["restartPolicy"] = map_restart_policy(spec.restart_policy, multi_host)
+        if job.spec.scheduling.scheduler_name:
+            tmpl_spec.setdefault("schedulerName", job.spec.scheduling.scheduler_name)
+        if job.spec.scheduling.priority_class:
+            tmpl_spec.setdefault("priorityClassName", job.spec.scheduling.priority_class)
+
+        labels = replica_labels(job.metadata.name, rtype, index)
+        meta = template.setdefault("metadata", {})
+        meta.setdefault("labels", {}).update(labels)
+
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": names.gen_name(job.metadata.name, rtype, index),
+                "namespace": job.metadata.namespace,
+                "labels": meta["labels"],
+                "annotations": dict(meta.get("annotations", {})),
+            },
+            "spec": tmpl_spec,
+            "status": {"phase": objects.PENDING},
+        }
+        return pod
+
+    def reconcile_pods(
+        self,
+        job: TPUJob,
+        rtype: str,
+        spec: ReplicaSpec,
+        pods: list[dict[str, Any]],
+    ) -> dict[str, Any]:
+        """Drive this replica type's pods toward spec.
+
+        Returns a summary: {"created": n, "deleted": n, "restarts": n,
+        "permanent_failure": bool} the caller folds into status.
+        """
+        job_key = self.job_key(job.metadata.namespace, job.metadata.name)
+        exp_key = self.expectation_key(job_key, rtype, "pods")
+        replicas = spec.replicas or 0
+        rtype_pods = [
+            p
+            for p in pods
+            if objects.labels_of(p).get(constants.LABEL_REPLICA_TYPE) == rtype.lower()
+        ]
+        buckets, out_of_range = get_pod_slices(rtype_pods, replicas)
+        summary = {"created": 0, "deleted": 0, "restarts": 0, "permanent_failure": False}
+
+        # Scale-down leftovers.
+        for pod in out_of_range:
+            if self._delete_pod_expected(job, exp_key, objects.name_of(pod)):
+                summary["deleted"] += 1
+
+        # Slice grouping for restart granularity.
+        group_size = 1
+        if spec.tpu and spec.tpu.accelerator_type:
+            topo = topo_slices.resolve(spec.tpu.accelerator_type, spec.tpu.topology)
+            group_size = topo.num_hosts
+
+        to_create: list[int] = []
+        restart_indices: set[int] = set()
+        permanent_indices: set[int] = set()
+
+        for index, bucket in enumerate(buckets):
+            if not bucket:
+                to_create.append(index)
+                continue
+            # Duplicates: keep the oldest, delete the rest (defensive; the
+            # expectations machinery normally prevents this).
+            if len(bucket) > 1:
+                bucket.sort(key=lambda p: objects.meta(p).get("creationTimestamp", ""))
+                for dup in bucket[1:]:
+                    if self._delete_pod_expected(job, exp_key, objects.name_of(dup)):
+                        summary["deleted"] += 1
+            pod = bucket[0]
+            if objects.pod_phase(pod) != objects.FAILED:
+                continue
+            policy = spec.restart_policy
+            if policy == RestartPolicy.EXIT_CODE:
+                code = objects.terminated_exit_code(
+                    pod, constants.DEFAULT_CONTAINER_NAME
+                )
+                if code is not None and exit_codes.is_retryable(code):
+                    restart_indices.add(index)
+                else:
+                    permanent_indices.add(index)
+            elif policy in (RestartPolicy.ON_FAILURE, RestartPolicy.ALWAYS):
+                restart_indices.add(index)
+            else:  # Never
+                permanent_indices.add(index)
+
+        # Slice-granular expansion: one bad host restarts its whole slice
+        # group; a permanent failure on any host poisons the whole group.
+        if group_size > 1:
+            expanded: set[int] = set()
+            for idx in restart_indices:
+                g = idx // group_size
+                if any(
+                    (g * group_size + j) in permanent_indices for j in range(group_size)
+                ):
+                    continue  # group is permanently failed; do not thrash
+                expanded.update(g * group_size + j for j in range(group_size))
+            # Never restart a pod that is itself permanently failed.
+            restart_indices = expanded - permanent_indices
+            # Only delete group members that still have pods (missing ones
+            # will be recreated by the create path).
+            restart_indices = {
+                i for i in restart_indices if i < replicas and buckets[i]
+            }
+
+        if permanent_indices:
+            summary["permanent_failure"] = True
+
+        # Budget check: each restart *event* (per group or per pod) counts 1.
+        if restart_indices:
+            groups = {i // group_size for i in restart_indices}
+            budget_left = True
+            if job.spec.max_restarts is not None:
+                budget_left = (
+                    job.status.restart_count + len(groups) <= job.spec.max_restarts
+                )
+            if budget_left:
+                for idx in sorted(restart_indices):
+                    pod = buckets[idx][0]
+                    if self._delete_pod_expected(job, exp_key, objects.name_of(pod)):
+                        summary["deleted"] += 1
+                summary["restarts"] = len(groups)
+            else:
+                summary["permanent_failure"] = True
+
+        # Create missing pods (expectation first, then create — the order the
+        # reference is careful about, controller_pod.go:131-191).
+        if to_create:
+            self.expectations.raise_expectations(exp_key, len(to_create), 0)
+            for n, index in enumerate(to_create):
+                try:
+                    pod = self.build_pod(job, rtype, spec, index)
+                    self.pod_control.create_pod(
+                        job.metadata.namespace,
+                        pod,
+                        job.to_dict(),
+                        self._controller_ref(job),
+                    )
+                    summary["created"] += 1
+                except Exception:
+                    # Roll back expectations for this create AND every
+                    # not-yet-attempted one, else the job wedges until the
+                    # expectation TTL (the aborted creates will never produce
+                    # informer events to decrement them).
+                    for _ in range(len(to_create) - n):
+                        self.expectations.creation_observed(exp_key)
+                    raise
+        return summary
+
+    def _delete_pod_expected(self, job: TPUJob, exp_key: str, name: str) -> bool:
+        """Delete with a deletion expectation that is rolled back on failure.
+
+        A pod already gone (deleted externally between list and delete) counts
+        as success for reconciliation purposes, but its expectation must be
+        released here because its DELETED event fired before we raised it.
+        """
+        from tf_operator_tpu.runtime.client import NotFound
+
+        self.expectations.raise_expectations(exp_key, 0, 1)
+        try:
+            self.pod_control.delete_pod(job.metadata.namespace, name, job.to_dict())
+            return True
+        except NotFound:
+            self.expectations.deletion_observed(exp_key)
+            return False
+        except Exception:
+            self.expectations.deletion_observed(exp_key)
+            raise

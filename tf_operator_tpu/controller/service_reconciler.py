@@ -1,0 +1,134 @@
+"""Per-replica-type headless-service reconciliation.
+
+Parity: pkg/controller.v2/tfcontroller/controller_service.go:37-154 — one
+headless service per (replica type, index), selecting exactly that replica's
+pod, exposing the named rendezvous port. Headless services give each replica
+a stable DNS identity ({job}-{type}-{index}), which is what makes
+TPU_WORKER_HOSTNAMES stable across pod restarts.
+
+Unlike the reference (whose update/delete service handlers are TODO stubs,
+controller_service.go:224-232), scale-down and duplicate handling are
+implemented here.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.helpers import replica_labels
+from tf_operator_tpu.api.types import ReplicaSpec, TPUJob
+from tf_operator_tpu.controller import cluster_spec
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.utils import names
+
+
+def get_service_slices(
+    services: list[dict[str, Any]], replicas: int
+) -> tuple[list[list[dict[str, Any]]], list[dict[str, Any]]]:
+    buckets: list[list[dict[str, Any]]] = [[] for _ in range(replicas)]
+    out_of_range: list[dict[str, Any]] = []
+    for svc in services:
+        idx_str = objects.labels_of(svc).get(constants.LABEL_REPLICA_INDEX)
+        try:
+            idx = int(idx_str)  # type: ignore[arg-type]
+        except (TypeError, ValueError):
+            continue
+        if 0 <= idx < replicas:
+            buckets[idx].append(svc)
+        else:
+            out_of_range.append(svc)
+    return buckets, out_of_range
+
+
+class ServiceReconciler:
+    """Mixin over JobController providing reconcile_services."""
+
+    def build_service(
+        self, job: TPUJob, rtype: str, spec: ReplicaSpec, index: int
+    ) -> dict[str, Any]:
+        labels = replica_labels(job.metadata.name, rtype, index)
+        port = cluster_spec.get_port(job, rtype)
+        return objects.new_service(
+            name=names.gen_name(job.metadata.name, rtype, index),
+            namespace=job.metadata.namespace,
+            labels=labels,
+            selector=labels,
+            ports=[
+                {
+                    "name": constants.DEFAULT_PORT_NAME,
+                    "port": port,
+                    "targetPort": port,
+                }
+            ],
+            headless=True,
+        )
+
+    def reconcile_services(
+        self,
+        job: TPUJob,
+        rtype: str,
+        spec: ReplicaSpec,
+        services: list[dict[str, Any]],
+    ) -> dict[str, Any]:
+        job_key = self.job_key(job.metadata.namespace, job.metadata.name)
+        exp_key = self.expectation_key(job_key, rtype, "services")
+        replicas = spec.replicas or 0
+        rtype_services = [
+            s
+            for s in services
+            if objects.labels_of(s).get(constants.LABEL_REPLICA_TYPE) == rtype.lower()
+        ]
+        buckets, out_of_range = get_service_slices(rtype_services, replicas)
+        summary = {"created": 0, "deleted": 0}
+
+        for svc in out_of_range:
+            if self._delete_service_expected(job, exp_key, objects.name_of(svc)):
+                summary["deleted"] += 1
+
+        to_create = []
+        for index, bucket in enumerate(buckets):
+            if not bucket:
+                to_create.append(index)
+                continue
+            if len(bucket) > 1:
+                bucket.sort(key=lambda s: objects.meta(s).get("creationTimestamp", ""))
+                for dup in bucket[1:]:
+                    if self._delete_service_expected(job, exp_key, objects.name_of(dup)):
+                        summary["deleted"] += 1
+
+        if to_create:
+            self.expectations.raise_expectations(exp_key, len(to_create), 0)
+            for n, index in enumerate(to_create):
+                try:
+                    svc = self.build_service(job, rtype, spec, index)
+                    self.service_control.create_service(
+                        job.metadata.namespace,
+                        svc,
+                        job.to_dict(),
+                        self._controller_ref(job),
+                    )
+                    summary["created"] += 1
+                except Exception:
+                    # Release this and all unattempted creates (see
+                    # pod_reconciler: aborted creates never produce events).
+                    for _ in range(len(to_create) - n):
+                        self.expectations.creation_observed(exp_key)
+                    raise
+        return summary
+
+    def _delete_service_expected(self, job: TPUJob, exp_key: str, name: str) -> bool:
+        from tf_operator_tpu.runtime.client import NotFound
+
+        self.expectations.raise_expectations(exp_key, 0, 1)
+        try:
+            self.service_control.delete_service(
+                job.metadata.namespace, name, job.to_dict()
+            )
+            return True
+        except NotFound:
+            self.expectations.deletion_observed(exp_key)
+            return False
+        except Exception:
+            self.expectations.deletion_observed(exp_key)
+            raise

@@ -1,0 +1,131 @@
+"""Job condition state machine + replica-status counters.
+
+Parity: pkg/controller.v2/tfcontroller/controller_status.go:42-241. The
+invariants preserved:
+
+- Conditions are exclusive where it matters: Running and Restarting never
+  both True; a terminal condition (Succeeded/Failed) flips Running to False.
+- Failed is sticky: once a job has Failed=True it never un-fails.
+- Success = chief succeeded when a chief exists, else all workers succeeded.
+- failed>0 → Restarting when the replica's restart policy allows a retry,
+  else Failed.
+- StartTime set once when the job first has all replicas running;
+  CompletionTime set with the terminal condition.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.api.types import (
+    JobCondition,
+    JobConditionType,
+    ReplicaStatus,
+    TPUJob,
+    TPUJobStatus,
+)
+from tf_operator_tpu.runtime import objects
+
+# Canonical reasons (controller_status.go uses tfJobCreatedReason etc.)
+REASON_CREATED = "TPUJobCreated"
+REASON_RUNNING = "TPUJobRunning"
+REASON_RESTARTING = "TPUJobRestarting"
+REASON_SUCCEEDED = "TPUJobSucceeded"
+REASON_FAILED = "TPUJobFailed"
+
+TRUE = "True"
+FALSE = "False"
+
+
+def new_condition(ctype: str, reason: str, message: str) -> JobCondition:
+    now = objects.now_iso()
+    return JobCondition(
+        type=ctype,
+        status=TRUE,
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+
+def get_condition(status: TPUJobStatus, ctype: str) -> JobCondition | None:
+    for c in status.conditions:
+        if c.type == ctype and c.status == TRUE:
+            return c
+    return None
+
+
+def has_condition(status: TPUJobStatus, ctype: str) -> bool:
+    return get_condition(status, ctype) is not None
+
+
+def is_succeeded(status: TPUJobStatus) -> bool:
+    return has_condition(status, JobConditionType.SUCCEEDED)
+
+
+def is_failed(status: TPUJobStatus) -> bool:
+    return has_condition(status, JobConditionType.FAILED)
+
+
+def is_running(status: TPUJobStatus) -> bool:
+    return has_condition(status, JobConditionType.RUNNING)
+
+
+def is_finished(status: TPUJobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def _filter_out(status: TPUJobStatus, drop_type: str) -> None:
+    status.conditions = [c for c in status.conditions if c.type != drop_type]
+
+
+def set_condition(status: TPUJobStatus, cond: JobCondition) -> None:
+    """Insert/update a condition, enforcing exclusivity rules."""
+    # Failed is sticky: nothing dethrones it except another Failed update.
+    if is_failed(status) and cond.type != JobConditionType.FAILED:
+        return
+
+    if cond.type == JobConditionType.RUNNING:
+        _filter_out(status, JobConditionType.RESTARTING)
+    elif cond.type == JobConditionType.RESTARTING:
+        _filter_out(status, JobConditionType.RUNNING)
+    elif cond.type in (JobConditionType.SUCCEEDED, JobConditionType.FAILED):
+        for c in status.conditions:
+            if c.type in (JobConditionType.RUNNING, JobConditionType.RESTARTING) and c.status == TRUE:
+                c.status = FALSE
+                c.last_transition_time = objects.now_iso()
+
+    for c in status.conditions:
+        if c.type == cond.type:
+            transitioned = c.status != cond.status
+            c.status = cond.status
+            c.reason = cond.reason
+            c.message = cond.message
+            c.last_update_time = cond.last_update_time
+            if transitioned:
+                c.last_transition_time = cond.last_transition_time
+            return
+    status.conditions.append(cond)
+
+
+def update_job_conditions(
+    job: TPUJob, ctype: str, reason: str, message: str
+) -> None:
+    set_condition(job.status, new_condition(ctype, reason, message))
+
+
+def initialize_replica_statuses(job: TPUJob, replica_type: str) -> None:
+    job.status.replica_statuses.setdefault(replica_type, ReplicaStatus())
+
+
+def update_replica_statuses(job: TPUJob, replica_type: str, pod: dict) -> None:
+    """Count one pod into the per-type Active/Succeeded/Failed counters
+    (controller_status.go:144-153)."""
+    initialize_replica_statuses(job, replica_type)
+    rs = job.status.replica_statuses[replica_type]
+    phase = objects.pod_phase(pod)
+    if phase == objects.RUNNING:
+        rs.active += 1
+    elif phase == objects.SUCCEEDED:
+        rs.succeeded += 1
+    elif phase == objects.FAILED:
+        rs.failed += 1

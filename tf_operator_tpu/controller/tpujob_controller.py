@@ -1,0 +1,479 @@
+"""The TPUJob operator: reconciles TPUJob resources into gang-scheduled pods
+and rendezvous services, and rolls pod state up into condition-based status.
+
+Parity map (pkg/controller.v2/tfcontroller/):
+- tfcontroller.go:104-350  → __init__/run/_worker/sync_job
+- tfcontroller.go:363-430  → reconcile_job (claim, terminal path, per-type
+  reconcile, single status update)
+- controller_tfjob.go      → add_job (decode-validate + Created condition),
+  delete_pods_and_services (CleanPodPolicy), cleanup_job (TTL)
+- controller_status.go     → update_job_status roll-up (chief-else-workers)
+- informer.go              → decode-time validation with warning events
+
+Status updates go through the status "subresource" with conflict retry —
+the hardening SURVEY.md §7 calls for over the reference's bare Update.
+"""
+
+from __future__ import annotations
+
+import calendar
+import threading
+import time
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import (
+    JobConditionType,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_spec
+from tf_operator_tpu.api.types import CleanPodPolicy
+from tf_operator_tpu.control.pod_control import PodControlInterface, RealPodControl
+from tf_operator_tpu.control.service_control import (
+    RealServiceControl,
+    ServiceControlInterface,
+)
+from tf_operator_tpu.controller import status as status_engine
+from tf_operator_tpu.controller.informer import EventHandlers, Informer
+from tf_operator_tpu.controller.jobcontroller import JobController, JobControllerConfig
+from tf_operator_tpu.controller.pod_reconciler import PodReconciler
+from tf_operator_tpu.controller.service_reconciler import ServiceReconciler
+from tf_operator_tpu.runtime import events as ev
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ClusterClient, Conflict, NotFound
+from tf_operator_tpu.utils import exit_codes, logger
+
+
+class TPUJobController(JobController, PodReconciler, ServiceReconciler):
+    def __init__(
+        self,
+        client: ClusterClient,
+        config: JobControllerConfig | None = None,
+        pod_control: PodControlInterface | None = None,
+        service_control: ServiceControlInterface | None = None,
+        recorder: ev.EventRecorder | None = None,
+    ) -> None:
+        recorder = recorder or ev.EventRecorder(client)
+        super().__init__(
+            client,
+            pod_control or RealPodControl(client, recorder),
+            service_control or RealServiceControl(client, recorder),
+            recorder,
+            config,
+        )
+        self.job_informer = Informer(
+            client, objects.TPUJOBS, self.config.namespace, self.config.informer_resync
+        )
+        self.job_informer.add_event_handlers(
+            EventHandlers(
+                on_add=self.add_job, on_update=self.update_job, on_delete=self.delete_job
+            )
+        )
+        self.pod_informer.add_event_handlers(
+            EventHandlers(
+                on_add=self.add_pod, on_update=self.update_pod, on_delete=self.delete_pod
+            )
+        )
+        self.service_informer.add_event_handlers(
+            EventHandlers(on_add=self.add_service, on_delete=self.delete_service)
+        )
+        # Test seams (tfcontroller.go:84-90 exposes syncHandler etc. for the
+        # tier-2 harness).
+        self.sync_handler = self.sync_job
+        self.update_status_handler = self._write_status
+        self.delete_job_handler = self._delete_job_resource
+        self._workers: list[threading.Thread] = []
+
+    # ------------------------------------------------------------------ decode
+
+    def decode_job(self, obj: dict[str, Any]) -> TPUJob | None:
+        """Convert + default + validate an unstructured TPUJob; reject bad
+        specs with a warning event (informer.go:87-110 behavior)."""
+        try:
+            job = TPUJob.from_dict(obj)
+            set_defaults(job)
+            validate_spec(job.spec)
+            return job
+        except Exception as e:
+            # Decode barrier: ANY failure (validation or malformed structure)
+            # must reject the CR with an event rather than wedge the
+            # controller (issue #561 behavior, informer.go:87-110).
+            self.recorder.warning(obj, ev.FAILED_VALIDATION, str(e))
+            logger.for_key(objects.key_of(obj)).warning("rejected TPUJob: %s", e)
+            return None
+
+    # -------------------------------------------------------------- handlers
+
+    def add_job(self, obj: dict[str, Any]) -> None:
+        job = self.decode_job(obj)
+        if job is None:
+            return
+        if not job.status.conditions:
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.CREATED,
+                status_engine.REASON_CREATED,
+                f"TPUJob {job.metadata.name} is created.",
+            )
+            try:
+                self._write_status(job)
+            except (Conflict, NotFound):
+                pass
+        self.enqueue(job.key)
+
+    def update_job(self, old: dict[str, Any], new: dict[str, Any]) -> None:
+        self.enqueue(f"{objects.namespace_of(new)}/{objects.name_of(new)}")
+
+    def delete_job(self, obj: dict[str, Any]) -> None:
+        key = f"{objects.namespace_of(obj)}/{objects.name_of(obj)}"
+        for rtype in ReplicaType.ALL:
+            self.expectations.delete_expectations(
+                self.expectation_key(key, rtype, "pods")
+            )
+            self.expectations.delete_expectations(
+                self.expectation_key(key, rtype, "services")
+            )
+        # Owned pods/services are garbage-collected via ownerReferences by the
+        # cluster backend (memcluster executor / K8s GC); nothing to enqueue.
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, stop: threading.Event) -> None:
+        """Start informers + worker threads; blocks until stop is set."""
+        self.job_informer.start(stop)
+        self.pod_informer.start(stop)
+        self.service_informer.start(stop)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+            self.job_informer.has_synced()
+            and self.pod_informer.has_synced()
+            and self.service_informer.has_synced()
+        ):
+            time.sleep(0.01)
+        for i in range(self.config.threadiness):
+            t = threading.Thread(target=self._worker, name=f"worker-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        stop.wait()
+        self.queue.shut_down()
+        for t in self._workers:
+            t.join(timeout=2)
+
+    def _worker(self) -> None:
+        while True:
+            key = self.queue.get()
+            if key is None:
+                return
+            try:
+                requeue = self.sync_handler(key)
+                self.queue.forget(key)
+                if requeue:
+                    self.enqueue_after(key, self.config.reconcile_period)
+            except Exception:
+                logger.for_key(str(key)).exception("sync failed; requeueing")
+                self.queue.add_rate_limited(key)
+            finally:
+                self.queue.done(key)
+
+    # ------------------------------------------------------------------ sync
+
+    def get_job(self, namespace: str, name: str) -> TPUJob | None:
+        obj = self.job_informer.get(namespace, name)
+        if obj is None:
+            try:
+                obj = self.client.get(objects.TPUJOBS, namespace, name)
+            except NotFound:
+                return None
+        return self.decode_job(obj)
+
+    def satisfied_expectations(self, job: TPUJob) -> bool:
+        key = self.job_key(job.metadata.namespace, job.metadata.name)
+        for rtype in job.spec.replica_specs:
+            if not self.expectations.satisfied(
+                self.expectation_key(key, rtype, "pods")
+            ):
+                return False
+            if not self.expectations.satisfied(
+                self.expectation_key(key, rtype, "services")
+            ):
+                return False
+        return True
+
+    def sync_job(self, key: str) -> bool:
+        """One reconcile pass for a job key. Returns True to request a
+        periodic requeue (running jobs re-sync every reconcile_period)."""
+        t0 = time.monotonic()
+        namespace, _, name = key.partition("/")
+        job = self.get_job(namespace, name)
+        if job is None:
+            self.delete_job({"metadata": {"namespace": namespace, "name": name}})
+            return False
+        if not self.satisfied_expectations(job):
+            return True
+        requeue = self.reconcile_job(job)
+        logger.for_key(key).debug(
+            "sync done in %.3fs", time.monotonic() - t0
+        )
+        return requeue
+
+    def reconcile_job(self, job: TPUJob) -> bool:
+        ref = self._controller_ref(job)
+        pods = self.get_pods_for_job(job, ref)
+        services = self.get_services_for_job(job, ref)
+
+        if status_engine.is_finished(job.status):
+            self.delete_pods_and_services(job, pods, services)
+            self.delete_pdb(job)
+            return self.cleanup_job(job)
+
+        if self.config.enable_gang_scheduling and job.spec.scheduling.gang:
+            total = sum(r.replicas or 0 for r in job.spec.replica_specs.values())
+            self.sync_pdb(job, total)
+
+        restarts = 0
+        permanent_failure = False
+        for rtype, spec in sorted(job.spec.replica_specs.items()):
+            summary = self.reconcile_pods(job, rtype, spec, pods)
+            restarts += summary["restarts"]
+            permanent_failure = permanent_failure or summary["permanent_failure"]
+            self.reconcile_services(job, rtype, spec, services)
+
+        job.status.restart_count += restarts
+        self.update_job_status(job, pods, restarts, permanent_failure)
+        try:
+            self.update_status_handler(job)
+        except Conflict:
+            # Stale read: drop this pass; the enqueue from the watch event (or
+            # the periodic resync) will retry against the fresh object.
+            self.enqueue(job.key)
+        except NotFound:
+            return False
+        return True
+
+    # ------------------------------------------------------------- terminal
+
+    def delete_pods_and_services(
+        self, job: TPUJob, pods: list[dict], services: list[dict]
+    ) -> None:
+        """CleanPodPolicy enforcement (controller_tfjob.go:75-100): None →
+        keep everything; Running → delete only still-active pods; All →
+        delete all pods. Services are removed whenever the policy is not
+        None (they hold DNS names, and on TPU leaked pods hold whole slices).
+        """
+        policy = job.spec.clean_pod_policy or CleanPodPolicy.RUNNING
+        if policy == CleanPodPolicy.NONE:
+            return
+        for pod in pods:
+            phase = objects.pod_phase(pod)
+            if policy == CleanPodPolicy.RUNNING and phase not in (
+                objects.RUNNING,
+                objects.PENDING,
+            ):
+                continue
+            try:
+                self.pod_control.delete_pod(
+                    job.metadata.namespace, objects.name_of(pod), job.to_dict()
+                )
+            except NotFound:
+                pass
+        for svc in services:
+            try:
+                self.service_control.delete_service(
+                    job.metadata.namespace, objects.name_of(svc), job.to_dict()
+                )
+            except NotFound:
+                pass
+
+    def cleanup_job(self, job: TPUJob) -> bool:
+        """TTLSecondsAfterFinished (controller_tfjob.go:102-125): requeue
+        until expiry, then delete the TPUJob itself. Returns requeue flag."""
+        ttl = job.spec.ttl_seconds_after_finished
+        if ttl is None:
+            return False
+        finished_at = job.status.completion_time or job.status.last_reconcile_time
+        if not finished_at:
+            return False
+        expiry = _parse_iso(finished_at) + ttl
+        now = time.time()
+        if now < expiry:
+            self.enqueue_after(job.key, expiry - now)
+            return False
+        self.delete_job_handler(job)
+        return False
+
+    def _delete_job_resource(self, job: TPUJob) -> None:
+        try:
+            self.client.delete(objects.TPUJOBS, job.metadata.namespace, job.metadata.name)
+        except NotFound:
+            pass
+
+    # --------------------------------------------------------------- status
+
+    def update_job_status(
+        self,
+        job: TPUJob,
+        pods: list[dict[str, Any]],
+        restarts_this_sync: int,
+        permanent_failure: bool,
+    ) -> None:
+        """Recompute replica counters + conditions from observed pods
+        (controller_status.go:42-119 semantics, slice-aware)."""
+        job.status.replica_statuses = {}
+        for rtype in job.spec.replica_specs:
+            status_engine.initialize_replica_statuses(job, rtype)
+        for pod in pods:
+            rtype_label = objects.labels_of(pod).get(constants.LABEL_REPLICA_TYPE)
+            for rtype in job.spec.replica_specs:
+                if rtype.lower() == rtype_label:
+                    status_engine.update_replica_statuses(job, rtype, pod)
+        job.status.last_reconcile_time = objects.now_iso()
+
+        name = job.metadata.name
+        rs = job.status.replica_statuses
+
+        # All expected replicas running → Running condition + StartTime.
+        def _replicas(rtype: str) -> int:
+            return job.spec.replica_specs[rtype].replicas or 0
+
+        all_running = all(
+            rs[rtype].active >= _replicas(rtype) for rtype in job.spec.replica_specs
+        ) and any(_replicas(rtype) > 0 for rtype in job.spec.replica_specs)
+        if all_running:
+            if job.status.start_time is None:
+                job.status.start_time = objects.now_iso()
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.RUNNING,
+                status_engine.REASON_RUNNING,
+                f"TPUJob {name} is running.",
+            )
+
+        # Success: chief succeeded when a chief exists, else all workers done
+        # (controller_status.go:54-74).
+        succeeded = False
+        if ReplicaType.CHIEF in job.spec.replica_specs:
+            succeeded = rs[ReplicaType.CHIEF].succeeded >= 1
+        elif ReplicaType.WORKER in job.spec.replica_specs:
+            w = _replicas(ReplicaType.WORKER)
+            succeeded = w > 0 and rs[ReplicaType.WORKER].succeeded >= w
+        if succeeded:
+            newly_terminal = not self._terminal_in_store(job, JobConditionType.SUCCEEDED)
+            if job.status.completion_time is None:
+                job.status.completion_time = objects.now_iso()
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.SUCCEEDED,
+                status_engine.REASON_SUCCEEDED,
+                f"TPUJob {name} successfully completed.",
+            )
+            if newly_terminal:
+                self.recorder.normal(
+                    job.to_dict(), status_engine.REASON_SUCCEEDED, "Job completed"
+                )
+            return
+
+        total_failed = sum(s.failed for s in rs.values())
+        if restarts_this_sync > 0 and not permanent_failure:
+            # Failed pods observed this sync were deleted for a (slice)
+            # restart — the snapshot's failed counts are about to clear.
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.RESTARTING,
+                status_engine.REASON_RESTARTING,
+                f"TPUJob {name} is restarting ({restarts_this_sync} slice restart(s) "
+                f"this sync, {job.status.restart_count} total).",
+            )
+            return
+        if permanent_failure or (total_failed > 0 and not self._any_restartable(job)):
+            newly_terminal = not self._terminal_in_store(job, JobConditionType.FAILED)
+            if job.status.completion_time is None:
+                job.status.completion_time = objects.now_iso()
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.FAILED,
+                status_engine.REASON_FAILED,
+                f"TPUJob {name} has failed ({total_failed} failed replica pod(s)).",
+            )
+            if newly_terminal:
+                self.recorder.warning(
+                    job.to_dict(), status_engine.REASON_FAILED, "Job failed"
+                )
+        elif total_failed > 0:
+            status_engine.update_job_conditions(
+                job,
+                JobConditionType.RESTARTING,
+                status_engine.REASON_RESTARTING,
+                f"TPUJob {name} is restarting ({job.status.restart_count} restart(s) total).",
+            )
+
+    def _terminal_in_store(self, job: TPUJob, ctype: str) -> bool:
+        """Whether the authoritative (store) copy already carries the terminal
+        condition — guards terminal events against stale informer reads so
+        the transition is recorded exactly once."""
+        try:
+            fresh = self.client.get(
+                objects.TPUJOBS, job.metadata.namespace, job.metadata.name
+            )
+        except NotFound:
+            return False
+        return any(
+            c.get("type") == ctype and c.get("status") == "True"
+            for c in fresh.get("status", {}).get("conditions", [])
+        )
+
+    def _any_restartable(self, job: TPUJob) -> bool:
+        """Whether the failed pods belong to a replica set whose policy can
+        restart them. For ExitCode, a failed-and-still-Failed pod means the
+        code was permanent (retryable ones were deleted this sync)."""
+        for rtype, spec in job.spec.replica_specs.items():
+            st = job.status.replica_statuses.get(rtype)
+            if st is None or st.failed == 0:
+                continue
+            if spec.restart_policy in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE):
+                return True
+            if spec.restart_policy == RestartPolicy.EXIT_CODE:
+                # Failed pods under ExitCode still present are permanent.
+                continue
+        return False
+
+    def _write_status(self, job: TPUJob) -> None:
+        """Status-subresource update with conflict retry (the hardening over
+        controller_status.go:122-125's bare Update).
+
+        On conflict the fresh object is consulted, not just its RV: if the
+        store already reached a terminal state this (stale) computation must
+        not overwrite it — blindly bumping the RV would turn optimistic
+        concurrency into last-writer-wins and lose the terminal condition.
+        """
+        for attempt in range(3):
+            try:
+                self.client.update_status(objects.TPUJOBS, job.to_dict())
+                return
+            except Conflict:
+                if attempt == 2:
+                    raise
+                fresh = self.client.get(
+                    objects.TPUJOBS, job.metadata.namespace, job.metadata.name
+                )
+                fresh_status = fresh.get("status", {})
+                fresh_terminal = any(
+                    c.get("type") in (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+                    and c.get("status") == "True"
+                    for c in fresh_status.get("conditions", [])
+                )
+                mine_terminal = status_engine.is_finished(job.status)
+                if fresh_terminal and not mine_terminal:
+                    return  # keep the store's terminal status
+                job.metadata.resource_version = str(
+                    objects.meta(fresh).get("resourceVersion", "")
+                )
+
+
+def _parse_iso(ts: str) -> float:
+    # calendar.timegm, not time.mktime: the timestamp is UTC and mktime's
+    # DST guessing would shift TTL expiry by an hour in DST timezones.
+    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))

@@ -1,0 +1,163 @@
+"""Rate-limited work queue with per-key serialization.
+
+Parity: the client-go workqueue the reference builds its hot loop on
+(pkg/controller/controller.go:77-95,122-126): items are deduplicated, a key
+being processed is never handed to a second worker (re-queued on `done` if it
+went dirty meanwhile), failed items come back with per-item exponential
+backoff (5ms → 1000s) under an overall token bucket (10 qps, burst 100).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Hashable
+
+
+class ItemExponentialBackoff:
+    """Per-item exponential failure backoff (5ms base, 1000s cap)."""
+
+    def __init__(self, base: float = 0.005, cap: float = 1000.0) -> None:
+        self.base = base
+        self.cap = cap
+        self._failures: dict[Hashable, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: Hashable) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        return min(self.base * (2**n), self.cap)
+
+    def forget(self, item: Hashable) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def num_requeues(self, item: Hashable) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class TokenBucket:
+    """Overall qps limiter (10 qps / burst 100 by default)."""
+
+    def __init__(self, qps: float = 10.0, burst: int = 100) -> None:
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def delay(self) -> float:
+        """Seconds until a token is available; consumes one (possibly future) token."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+
+class RateLimitingQueue:
+    """Deduplicating, delayable, rate-limited queue of hashable keys."""
+
+    def __init__(
+        self,
+        backoff: ItemExponentialBackoff | None = None,
+        bucket: TokenBucket | None = None,
+    ) -> None:
+        self._backoff = backoff or ItemExponentialBackoff()
+        self._bucket = bucket or TokenBucket()
+        self._cond = threading.Condition()
+        self._queue: list[Hashable] = []  # ready FIFO
+        self._dirty: set[Hashable] = set()
+        self._processing: set[Hashable] = set()
+        self._delayed: list[tuple[float, int, Hashable]] = []  # heap by ready-time
+        self._seq = 0
+        self._shutdown = False
+
+    # -- core add/get/done ---------------------------------------------------
+
+    def add(self, item: Hashable) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float) -> None:
+        if delay <= 0:
+            self.add(item)
+            return
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable) -> None:
+        self.add_after(item, self._backoff.when(item) + self._bucket.delay())
+
+    def _drain_delayed(self) -> float | None:
+        """Move due delayed items to ready; return seconds to next due item."""
+        now = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+        if self._delayed:
+            return max(0.0, self._delayed[0][0] - now)
+        return None
+
+    def get(self, timeout: float | None = None) -> Hashable | None:
+        """Blocking pop; None on timeout or shutdown."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                next_due = self._drain_delayed()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                wait = next_due
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: Hashable) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- rate-limiter passthrough -------------------------------------------
+
+    def forget(self, item: Hashable) -> None:
+        self._backoff.forget(item)
+
+    def num_requeues(self, item: Hashable) -> int:
+        return self._backoff.num_requeues(item)
+
+    def shut_down(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

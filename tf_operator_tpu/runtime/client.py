@@ -1,0 +1,130 @@
+"""Cluster-client interface + error model.
+
+The controller stack is written against this interface; two implementations
+exist: the in-process cluster (runtime/memcluster.py — tests + local E2E,
+playing the role the fake clientsets play in the reference's tier-2 tests)
+and the real Kubernetes REST client (runtime/kubeclient.py). Errors mirror
+apimachinery's StatusError reasons the reference branches on
+(pkg/util/k8sutil error predicates).
+"""
+
+from __future__ import annotations
+
+import abc
+import queue
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+
+class ApiError(Exception):
+    code = 500
+
+
+class NotFound(ApiError):
+    code = 404
+
+
+class AlreadyExists(ApiError):
+    code = 409
+
+
+class Conflict(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+    code = 409
+
+
+class Invalid(ApiError):
+    code = 422
+
+
+# Watch event types (K8s watch protocol).
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+@dataclass
+class WatchEvent:
+    type: str
+    object: dict[str, Any]
+
+
+class Watch:
+    """A cancellable stream of WatchEvents."""
+
+    def __init__(self) -> None:
+        self._q: "queue.Queue[WatchEvent | None]" = queue.Queue()
+        self._stopped = False
+
+    def push(self, event: WatchEvent) -> None:
+        if not self._stopped:
+            self._q.put(event)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._q.put(None)
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            yield item
+
+    def next(self, timeout: float | None = None) -> WatchEvent | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class ClusterClient(abc.ABC):
+    """CRUD + watch over namespaced collections of unstructured objects."""
+
+    @abc.abstractmethod
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]: ...
+
+    @abc.abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]: ...
+
+    @abc.abstractmethod
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict[str, Any]]: ...
+
+    @abc.abstractmethod
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        """Full replace; raises Conflict when obj.metadata.resourceVersion is stale."""
+
+    @abc.abstractmethod
+    def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        """Status-subresource update: replaces only .status (+bumps RV)."""
+
+    @abc.abstractmethod
+    def patch_merge(
+        self, kind: str, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]:
+        """Strategic-merge-ish patch: dicts merge recursively, other values replace."""
+
+    @abc.abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def watch(self, kind: str, namespace: str | None = None) -> Watch: ...
+
+
+def merge_patch(base: dict[str, Any], patch: dict[str, Any]) -> dict[str, Any]:
+    """JSON-merge-patch (RFC 7386): None deletes, dicts recurse, rest replaces."""
+    out = dict(base)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = merge_patch(out[k], v)
+        else:
+            out[k] = v
+    return out

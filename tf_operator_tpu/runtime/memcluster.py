@@ -1,0 +1,182 @@
+"""In-process cluster: a faithful miniature API server.
+
+Serves three roles the reference splits across machinery:
+- the fake clientset + seeded informer indexers of tier-2 controller tests
+  (tfcontroller_test.go:63-64, testutil/pod.go:57-92),
+- the backing store for local end-to-end runs where pods are real OS
+  processes (runtime/executor.py — the "kubelet"),
+- a reference implementation of the semantics the real kubeclient relies on
+  (uid assignment, monotonically increasing resourceVersions, optimistic
+  concurrency, label-selector lists, watch streams).
+
+Deliberately K8s-faithful details: UID changes on recreate (the reference
+UID-checks its job cache, controller.go:271-290), updates conflict on stale
+resourceVersion (the status-update race SURVEY.md §7 calls out), and watch
+events deliver deep copies so controllers can't mutate the store in place.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import uuid
+from typing import Any
+
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    ClusterClient,
+    Conflict,
+    NotFound,
+    Watch,
+    WatchEvent,
+    merge_patch,
+)
+
+
+def _matches(selector: dict[str, str] | None, obj: dict[str, Any]) -> bool:
+    if not selector:
+        return True
+    labels = objects.labels_of(obj)
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class InMemoryCluster(ClusterClient):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._rv = 0
+        # kind -> namespace -> name -> object
+        self._store: dict[str, dict[str, dict[str, dict[str, Any]]]] = {}
+        # (kind, namespace|None) watchers
+        self._watchers: list[tuple[str, str | None, Watch]] = []
+
+    # -- internals -----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _coll(self, kind: str, namespace: str) -> dict[str, dict[str, Any]]:
+        return self._store.setdefault(kind, {}).setdefault(namespace, {})
+
+    def _broadcast(self, kind: str, etype: str, obj: dict[str, Any]) -> None:
+        ns = objects.namespace_of(obj)
+        for wkind, wns, watch in list(self._watchers):
+            if wkind == kind and (wns is None or wns == ns):
+                watch.push(WatchEvent(etype, copy.deepcopy(obj)))
+
+    # -- ClusterClient -------------------------------------------------------
+
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            m = objects.meta(obj)
+            ns, name = m.get("namespace", "default"), m.get("name")
+            if not name:
+                raise ValueError("metadata.name is required")
+            m.setdefault("namespace", ns)
+            coll = self._coll(kind, ns)
+            if name in coll:
+                raise AlreadyExists(f"{kind} {ns}/{name} already exists")
+            # Honor a pre-set uid (fake-clientset behavior, relied on by test
+            # fixtures that pre-wire ownerReferences); generate one otherwise.
+            if not m.get("uid"):
+                m["uid"] = str(uuid.uuid4())
+            m["resourceVersion"] = self._next_rv()
+            m.setdefault("creationTimestamp", objects.now_iso())
+            coll[name] = obj
+            self._broadcast(kind, ADDED, obj)
+            return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        with self._lock:
+            try:
+                return copy.deepcopy(self._store[kind][namespace][name])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name} not found") from None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict[str, Any]]:
+        with self._lock:
+            out: list[dict[str, Any]] = []
+            for ns, coll in self._store.get(kind, {}).items():
+                if namespace is not None and ns != namespace:
+                    continue
+                for obj in coll.values():
+                    if _matches(label_selector, obj):
+                        out.append(copy.deepcopy(obj))
+            out.sort(key=objects.key_of)
+            return out
+
+    def _update(self, kind: str, obj: dict[str, Any], status_only: bool) -> dict[str, Any]:
+        with self._lock:
+            ns, name = objects.namespace_of(obj), objects.name_of(obj)
+            coll = self._coll(kind, ns)
+            if name not in coll:
+                raise NotFound(f"{kind} {ns}/{name} not found")
+            current = coll[name]
+            sent_rv = str(objects.meta(obj).get("resourceVersion", ""))
+            cur_rv = str(objects.meta(current).get("resourceVersion", ""))
+            if sent_rv and sent_rv != cur_rv:
+                raise Conflict(
+                    f"{kind} {ns}/{name}: resourceVersion {sent_rv} is stale (now {cur_rv})"
+                )
+            if status_only:
+                updated = copy.deepcopy(current)
+                updated["status"] = copy.deepcopy(obj.get("status", {}))
+            else:
+                updated = copy.deepcopy(obj)
+                # uid/creationTimestamp are immutable.
+                objects.meta(updated)["uid"] = objects.meta(current)["uid"]
+                objects.meta(updated)["creationTimestamp"] = objects.meta(current).get(
+                    "creationTimestamp", ""
+                )
+            objects.meta(updated)["resourceVersion"] = self._next_rv()
+            coll[name] = updated
+            self._broadcast(kind, MODIFIED, updated)
+            return copy.deepcopy(updated)
+
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        return self._update(kind, obj, status_only=False)
+
+    def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        return self._update(kind, obj, status_only=True)
+
+    def patch_merge(
+        self, kind: str, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]:
+        with self._lock:
+            coll = self._coll(kind, namespace)
+            if name not in coll:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            merged = merge_patch(coll[name], copy.deepcopy(patch))
+            objects.meta(merged)["resourceVersion"] = self._next_rv()
+            coll[name] = merged
+            self._broadcast(kind, MODIFIED, merged)
+            return copy.deepcopy(merged)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            coll = self._coll(kind, namespace)
+            obj = coll.pop(name, None)
+            if obj is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            self._broadcast(kind, DELETED, obj)
+
+    def watch(self, kind: str, namespace: str | None = None) -> Watch:
+        with self._lock:
+            w = Watch()
+            self._watchers.append((kind, namespace, w))
+            return w
+
+    def stop_watch(self, watch: Watch) -> None:
+        with self._lock:
+            self._watchers = [(k, n, w) for (k, n, w) in self._watchers if w is not watch]
+            watch.stop()

@@ -1,0 +1,186 @@
+"""Helpers over unstructured (dict-form) core/v1-style objects.
+
+The runtime stores every resource — pods, services, TPUJobs, disruption
+budgets, events, leases — as plain dicts shaped like their Kubernetes
+counterparts, so the same controller code drives both the in-memory cluster
+(tests, local E2E) and a real apiserver (runtime/kubeclient.py). This module
+is the accessor layer the controllers use instead of typed structs.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any
+
+# Resource "kinds" as store collection names (lowercase plural, like REST paths).
+PODS = "pods"
+SERVICES = "services"
+TPUJOBS = "tpujobs"
+PDBS = "poddisruptionbudgets"
+EVENTS = "events"
+LEASES = "leases"
+NAMESPACES = "namespaces"
+
+# Pod phases (core/v1).
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+UNKNOWN = "Unknown"
+
+
+def now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def meta(obj: dict[str, Any]) -> dict[str, Any]:
+    return obj.setdefault("metadata", {})
+
+
+def name_of(obj: dict[str, Any]) -> str:
+    return meta(obj).get("name", "")
+
+
+def namespace_of(obj: dict[str, Any]) -> str:
+    return meta(obj).get("namespace", "default")
+
+
+def uid_of(obj: dict[str, Any]) -> str:
+    return meta(obj).get("uid", "")
+
+
+def labels_of(obj: dict[str, Any]) -> dict[str, str]:
+    return meta(obj).get("labels", {}) or {}
+
+
+def key_of(obj: dict[str, Any]) -> str:
+    return f"{namespace_of(obj)}/{name_of(obj)}"
+
+
+def is_deleted(obj: dict[str, Any]) -> bool:
+    return bool(meta(obj).get("deletionTimestamp"))
+
+
+def new_pod(
+    name: str,
+    namespace: str = "default",
+    labels: dict[str, str] | None = None,
+    containers: list[dict[str, Any]] | None = None,
+    owner_references: list[dict[str, Any]] | None = None,
+    **spec_extra: Any,
+) -> dict[str, Any]:
+    pod: dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"containers": copy.deepcopy(containers or [])},
+        "status": {"phase": PENDING},
+    }
+    if labels:
+        pod["metadata"]["labels"] = dict(labels)
+    if owner_references:
+        pod["metadata"]["ownerReferences"] = copy.deepcopy(owner_references)
+    pod["spec"].update(spec_extra)
+    return pod
+
+
+def new_service(
+    name: str,
+    namespace: str = "default",
+    labels: dict[str, str] | None = None,
+    selector: dict[str, str] | None = None,
+    ports: list[dict[str, Any]] | None = None,
+    owner_references: list[dict[str, Any]] | None = None,
+    headless: bool = True,
+) -> dict[str, Any]:
+    svc: dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "selector": dict(selector or {}),
+            "ports": copy.deepcopy(ports or []),
+        },
+    }
+    if headless:
+        # Headless service: DNS resolves straight to the pod IP — the
+        # rendezvous fabric (reference: replicas.go:151-162).
+        svc["spec"]["clusterIP"] = "None"
+    if labels:
+        svc["metadata"]["labels"] = dict(labels)
+    if owner_references:
+        svc["metadata"]["ownerReferences"] = copy.deepcopy(owner_references)
+    return svc
+
+
+def new_pdb(
+    name: str,
+    namespace: str,
+    min_available: int,
+    selector_labels: dict[str, str],
+    owner_references: list[dict[str, Any]] | None = None,
+) -> dict[str, Any]:
+    """Gang-scheduling PodDisruptionBudget (jobcontroller.go:196-232 analog)."""
+    pdb: dict[str, Any] = {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "minAvailable": min_available,
+            "selector": {"matchLabels": dict(selector_labels)},
+        },
+    }
+    if owner_references:
+        pdb["metadata"]["ownerReferences"] = copy.deepcopy(owner_references)
+    return pdb
+
+
+def pod_phase(pod: dict[str, Any]) -> str:
+    return pod.get("status", {}).get("phase", PENDING)
+
+
+def set_pod_phase(pod: dict[str, Any], phase: str) -> None:
+    pod.setdefault("status", {})["phase"] = phase
+
+
+def container_statuses(pod: dict[str, Any]) -> list[dict[str, Any]]:
+    return pod.get("status", {}).get("containerStatuses", [])
+
+
+def terminated_exit_code(pod: dict[str, Any], container_name: str) -> int | None:
+    """Exit code of a terminated container, or None if not terminated.
+
+    Mirrors how the reference reads pod.Status.ContainerStatuses[i].State
+    .Terminated.ExitCode for the default container (controller_pod.go:93-99).
+    """
+    for cs in container_statuses(pod):
+        if cs.get("name") == container_name:
+            term = cs.get("state", {}).get("terminated")
+            if term is not None:
+                return int(term.get("exitCode", 0))
+    return None
+
+
+def set_container_terminated(
+    pod: dict[str, Any], container_name: str, exit_code: int, reason: str = ""
+) -> None:
+    statuses = pod.setdefault("status", {}).setdefault("containerStatuses", [])
+    for cs in statuses:
+        if cs.get("name") == container_name:
+            cs["state"] = {"terminated": {"exitCode": exit_code, "reason": reason}}
+            return
+    statuses.append(
+        {
+            "name": container_name,
+            "state": {"terminated": {"exitCode": exit_code, "reason": reason}},
+            "restartCount": 0,
+        }
+    )
+
+
+def get_container(pod_or_template: dict[str, Any], name: str) -> dict[str, Any] | None:
+    for c in pod_or_template.get("spec", {}).get("containers", []):
+        if c.get("name") == name:
+            return c
+    return None

@@ -1,0 +1,183 @@
+"""TPU pod-slice topology math.
+
+This is the module that makes the accelerator a first-class scheduling object.
+The reference handles accelerators as opaque container resource limits plus
+config-file volume injection (helper.ConfigureAcceleratorsForTFJobSpec,
+pkg/apis/tensorflow/helper/helpers.go:50-104); a TPU slice instead has
+structure the controller must understand: a slice of N chips spans M hosts
+connected by ICI, every host must run exactly one worker pod, and all hosts
+must be gang-scheduled or the slice is stranded.
+
+Naming follows Cloud TPU conventions: an *accelerator type* like ``v5e-16``
+is (generation, total chip count); a *topology* like ``4x4`` is the physical
+chip arrangement.  ``num_hosts`` is what the controller actually schedules.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TPUGeneration:
+    """Per-generation constants."""
+
+    name: str
+    # Chips addressable by one host VM (one worker process per host).
+    chips_per_host: int
+    # Cores exposed per chip (v4/v5p megacore presents 1 device per chip).
+    devices_per_chip: int
+    # Largest single slice offered.
+    max_chips: int
+    # K8s node selector value (GKE convention: cloud.google.com/gke-tpu-accelerator).
+    gke_accelerator: str
+    # Dimensionality of the ICI torus for default topology inference.
+    torus_dims: int = 2
+
+
+GENERATIONS: dict[str, TPUGeneration] = {
+    "v4": TPUGeneration("v4", 4, 1, 4096, "tpu-v4-podslice", torus_dims=3),
+    "v5e": TPUGeneration("v5e", 4, 1, 256, "tpu-v5-lite-podslice", torus_dims=2),
+    "v5p": TPUGeneration("v5p", 4, 1, 8960, "tpu-v5p-slice", torus_dims=3),
+    "v6e": TPUGeneration("v6e", 4, 1, 256, "tpu-v6e-slice", torus_dims=2),
+}
+
+# Topologies that fit on a single host (no ICI-spanning pods needed); a
+# single-host slice may be scheduled without gang semantics.
+_SINGLE_HOST_MAX_CHIPS = {"v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+
+class TopologyError(ValueError):
+    """Raised for accelerator types / topologies the fleet does not offer."""
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """A resolved TPU pod-slice shape.
+
+    The controller consumes ``num_hosts`` (pod count) and the env-injection
+    layer consumes ``topology``/``accelerator_type`` (runtime mesh wiring).
+    """
+
+    accelerator_type: str  # e.g. "v5e-16"
+    generation: str  # "v5e"
+    num_chips: int  # 16
+    topology: str  # "4x4"
+    num_hosts: int  # 4
+    chips_per_host: int  # 4
+    dims: tuple[int, ...] = field(default_factory=tuple)
+
+    @property
+    def num_devices(self) -> int:
+        gen = GENERATIONS[self.generation]
+        return self.num_chips * gen.devices_per_chip
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def gke_accelerator(self) -> str:
+        return GENERATIONS[self.generation].gke_accelerator
+
+
+def parse_accelerator_type(accelerator_type: str) -> tuple[str, int]:
+    """Split ``"v5e-16"`` into ``("v5e", 16)``."""
+    parts = accelerator_type.strip().lower().split("-")
+    if len(parts) != 2 or parts[0] not in GENERATIONS:
+        raise TopologyError(
+            f"unknown accelerator type {accelerator_type!r}; expected "
+            f"<generation>-<chips> with generation in {sorted(GENERATIONS)}"
+        )
+    try:
+        chips = int(parts[1])
+    except ValueError as e:
+        raise TopologyError(f"bad chip count in {accelerator_type!r}") from e
+    if chips <= 0:
+        raise TopologyError(f"chip count must be positive in {accelerator_type!r}")
+    gen = GENERATIONS[parts[0]]
+    if chips > gen.max_chips:
+        raise TopologyError(
+            f"{accelerator_type!r}: {chips} chips exceeds the {gen.name} "
+            f"maximum of {gen.max_chips}"
+        )
+    return parts[0], chips
+
+
+def _default_dims(chips: int, ndims: int) -> tuple[int, ...]:
+    """Most-square factorization of ``chips`` into ``ndims`` power-of-two-ish dims."""
+    if ndims == 2:
+        a = 1
+        for cand in range(int(math.isqrt(chips)), 0, -1):
+            if chips % cand == 0:
+                a = cand
+                break
+        return (a, chips // a)
+    # 3D: peel off the most-cubic factor triple.
+    best = (1, 1, chips)
+    best_score = chips
+    for x in range(1, int(round(chips ** (1 / 3))) + 2):
+        if chips % x:
+            continue
+        rest = chips // x
+        for y in range(x, int(math.isqrt(rest)) + 1):
+            if rest % y:
+                continue
+            z = rest // y
+            score = z - x
+            if score < best_score:
+                best, best_score = (x, y, z), score
+    return best
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    """Parse ``"4x4"`` / ``"2x2x4"`` into dims."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError as e:
+        raise TopologyError(f"bad topology string {topology!r}") from e
+    if not dims or any(d <= 0 for d in dims):
+        raise TopologyError(f"bad topology string {topology!r}")
+    return dims
+
+
+def resolve(accelerator_type: str, topology: str | None = None) -> SliceTopology:
+    """Resolve an accelerator type (+ optional explicit topology) to a slice shape.
+
+    >>> resolve("v5e-16").num_hosts
+    4
+    """
+    gen_name, chips = parse_accelerator_type(accelerator_type)
+    gen = GENERATIONS[gen_name]
+    if topology:
+        dims = parse_topology(topology)
+        if math.prod(dims) != chips:
+            raise TopologyError(
+                f"topology {topology!r} has {math.prod(dims)} chips but "
+                f"accelerator {accelerator_type!r} declares {chips}"
+            )
+    else:
+        dims = _default_dims(chips, gen.torus_dims)
+
+    if chips <= _SINGLE_HOST_MAX_CHIPS[gen_name]:
+        num_hosts = 1
+        chips_per_host = chips
+    else:
+        if chips % gen.chips_per_host:
+            raise TopologyError(
+                f"{accelerator_type!r}: multi-host slices must be a multiple "
+                f"of {gen.chips_per_host} chips/host"
+            )
+        num_hosts = chips // gen.chips_per_host
+        chips_per_host = gen.chips_per_host
+
+    return SliceTopology(
+        accelerator_type=f"{gen_name}-{chips}",
+        generation=gen_name,
+        num_chips=chips,
+        topology="x".join(str(d) for d in dims),
+        num_hosts=num_hosts,
+        chips_per_host=chips_per_host,
+        dims=dims,
+    )

@@ -1,0 +1,43 @@
+"""Container exit-code → retryability policy.
+
+Parity: pkg/util/train/train_util.go:18-53. The contract:
+
+- 0: success.
+- 1-127 ("permanent"): app-level errors — misconfigured job, import error,
+  permission denied (1, 2, 126, 127, 128, and SIGSEGV's 139 enumerated in the
+  reference). Retrying cannot help; the replica is failed for good.
+- 130 (SIGINT), 137 (SIGKILL), 143 (SIGTERM): external interruption — node
+  drain, preemption, OOM-killer at node scope. Retryable.
+- 138 (128+SIGUSR1): reserved as *user-defined retryable* — training code can
+  kill itself with SIGUSR1 to request a restart (e.g. on a TPU health-check
+  failure) without the operator second-guessing it.
+- >128 otherwise: died by signal; treated as retryable infrastructure noise.
+
+TPU addendum: on a multi-host slice a retryable exit of ONE host restarts the
+WHOLE slice (ICI state is not recoverable piecemeal) — that logic lives in the
+pod reconciler; this module only classifies codes.
+"""
+
+from __future__ import annotations
+
+SUCCESS = 0
+SIGUSR1_EXIT = 138  # 128 + SIGUSR1: user-requested retry
+
+_RETRYABLE = frozenset({130, 137, 138, 143})
+
+
+def is_success(exit_code: int) -> bool:
+    return exit_code == SUCCESS
+
+
+def is_retryable(exit_code: int) -> bool:
+    """True when a restart may help (signal-based interruptions + SIGUSR1)."""
+    if exit_code in _RETRYABLE:
+        return True
+    # Other >128 codes are deaths-by-signal we didn't enumerate; the reference
+    # treats unknown signals as retryable infrastructure failures.
+    return exit_code > 128 and exit_code not in (139,)  # 139 = SIGSEGV: app bug
+
+
+def is_permanent(exit_code: int) -> bool:
+    return exit_code != SUCCESS and not is_retryable(exit_code)

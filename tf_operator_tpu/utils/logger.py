@@ -1,0 +1,87 @@
+"""Structured logging keyed by job / replica / pod.
+
+Parity: pkg/logger/logger.go:26-80 — logrus Entry factories that stamp
+job/replica identity onto every line. Here: stdlib logging with a JSON or
+key=value formatter and LoggerAdapter-based field binding.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Mapping, MutableMapping
+
+_ROOT = "tpuflow"
+
+
+class _StructuredFormatter(logging.Formatter):
+    def __init__(self, as_json: bool) -> None:
+        super().__init__()
+        self.as_json = as_json
+
+    def format(self, record: logging.LogRecord) -> str:
+        fields: dict[str, Any] = {
+            "time": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+        }
+        fields.update(getattr(record, "structured_fields", {}))
+        if record.exc_info:
+            fields["exc"] = self.formatException(record.exc_info)
+        if self.as_json:
+            return json.dumps(fields, default=str)
+        extras = " ".join(
+            f"{k}={v}" for k, v in fields.items() if k not in ("time", "level", "msg")
+        )
+        return f'{fields["time"]} {fields["level"]:7s} {fields["msg"]}' + (
+            f"  {extras}" if extras else ""
+        )
+
+
+class _FieldsAdapter(logging.LoggerAdapter):
+    def process(
+        self, msg: str, kwargs: MutableMapping[str, Any]
+    ) -> tuple[str, MutableMapping[str, Any]]:
+        extra = kwargs.setdefault("extra", {})
+        merged = dict(self.extra or {})
+        merged.update(extra.get("structured_fields", {}))
+        extra["structured_fields"] = merged
+        return msg, kwargs
+
+
+def configure(json_format: bool = False, level: int = logging.INFO) -> None:
+    """One-time root configuration (--json-log-format flag analog)."""
+    root = logging.getLogger(_ROOT)
+    root.setLevel(level)
+    root.handlers.clear()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_StructuredFormatter(json_format))
+    root.addHandler(handler)
+    root.propagate = False
+
+
+def base() -> logging.Logger:
+    logger = logging.getLogger(_ROOT)
+    if not logger.handlers:
+        configure()
+    return logger
+
+
+def with_fields(**fields: Any) -> logging.LoggerAdapter:
+    return _FieldsAdapter(base(), fields)
+
+
+def for_job(namespace: str, name: str) -> logging.LoggerAdapter:
+    """LoggerForJob analog (logger.go:26-38)."""
+    return with_fields(job=f"{namespace}.{name}")
+
+
+def for_replica(namespace: str, name: str, rtype: str) -> logging.LoggerAdapter:
+    """LoggerForReplica analog."""
+    return with_fields(job=f"{namespace}.{name}", replica_type=rtype)
+
+
+def for_key(key: str) -> logging.LoggerAdapter:
+    """LoggerForKey analog (workqueue keys "ns/name")."""
+    return with_fields(job=key)

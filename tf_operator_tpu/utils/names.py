@@ -1,0 +1,32 @@
+"""Deterministic resource naming + DNS-safe random suffixes.
+
+Parity: pkg/controller.v2/jobcontroller/jobcontroller_util.go:24-27
+(GenGeneralName = "{job}-{type}-{index}") and pkg/util/util.go:59-75
+(RandString). Stable indexed names are load-bearing: TPU_WORKER_HOSTNAMES
+ordering across restarts derives from them (SURVEY.md §7 "rendezvous
+correctness").
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import string
+
+_DNS1035 = string.ascii_lowercase + string.digits
+_LABEL_SAFE = re.compile(r"[^a-z0-9\-.]")
+
+
+def rand_string(n: int) -> str:
+    """DNS-label-safe random suffix (util.go:59-75 analog)."""
+    return "".join(random.choice(_DNS1035) for _ in range(n))
+
+
+def sanitize_dns(name: str) -> str:
+    """Lowercase and strip characters not allowed in DNS labels."""
+    return _LABEL_SAFE.sub("-", name.lower()).strip("-")
+
+
+def gen_name(job_name: str, replica_type: str, index: int) -> str:
+    """Pod/Service name for (job, type, index): "{job}-{type}-{index}"."""
+    return f"{sanitize_dns(job_name)}-{replica_type.lower()}-{index}"

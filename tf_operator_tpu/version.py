@@ -1,0 +1,18 @@
+"""Version info for the tpu-job-operator framework.
+
+TPU-native analog of the reference's pkg/version/version.go:21-43.
+"""
+
+from __future__ import annotations
+
+import platform
+
+VERSION = "0.1.0"
+GIT_SHA = "dev"
+
+
+def version_string() -> str:
+    return (
+        f"tpu-job-operator {VERSION} (git {GIT_SHA}) "
+        f"python {platform.python_version()} on {platform.system().lower()}"
+    )
