@@ -1,0 +1,94 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on the local TPU.
+
+The BASELINE.md headline metric. The reference (tf-operator) publishes no
+performance numbers (BASELINE.json "published": {}), so vs_baseline is
+reported against BASELINE_IMAGES_PER_SEC below — a conservative
+MultiWorkerMirroredStrategy-era per-chip expectation for ResNet-50 on
+v5e-class hardware — giving the driver a stable denominator across rounds.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# TF2-era MultiWorkerMirroredStrategy ResNet-50 throughput per 16-chip v5e
+# slice normalized per chip (~800 img/s/chip is the competitive
+# public-era figure for bf16 ResNet-50 training on this hardware class).
+BASELINE_IMAGES_PER_SEC = 800.0
+
+BATCH = 256
+WARMUP_STEPS = 3
+MEASURE_STEPS = 10
+IMAGE_SIZE = 224
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tf_operator_tpu.models.resnet import resnet50
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate, shard_batch
+    from tf_operator_tpu.train.steps import (
+        TrainState,
+        make_classifier_train_step,
+        sgd_momentum,
+    )
+
+    devices = jax.devices()
+    mesh = create_mesh({"dp": len(devices)}, devices)
+
+    model = resnet50(dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "image": rng.normal(size=(BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)).astype(
+            np.float32
+        ),
+        "label": rng.integers(0, 1000, size=(BATCH,)).astype(np.int32),
+    }
+
+    x0 = jnp.zeros((8, IMAGE_SIZE, IMAGE_SIZE, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    tx = sgd_momentum(0.1)
+    state = TrainState.create(
+        variables["params"], tx, batch_stats=variables["batch_stats"]
+    )
+    state = replicate(mesh, state)
+    step = make_classifier_train_step(model, tx, mesh, has_batch_stats=True)
+
+    batch = shard_batch(mesh, host_batch)
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = BATCH * MEASURE_STEPS / dt
+    per_chip_baseline = BASELINE_IMAGES_PER_SEC * len(devices)
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet50_train_images_per_sec_bf16_b{BATCH}_{len(devices)}chip",
+                "value": round(images_per_sec, 1),
+                "unit": "images/sec",
+                "vs_baseline": round(images_per_sec / per_chip_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

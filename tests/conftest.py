@@ -1,18 +1,15 @@
 """Test configuration.
 
 Sharding/parallelism tests run on a virtual 8-device CPU mesh (multi-chip TPU
-hardware is not available in CI); these env vars must be set before the first
-jax import anywhere in the test process.
+hardware is not available in CI); force_cpu_mesh must run before the first
+backend query anywhere in the test process.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tf_operator_tpu.parallel.testing import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)

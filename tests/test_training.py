@@ -1,0 +1,163 @@
+"""Training-stack tests on the CPU mesh: models learn, steps jit cleanly
+under dp / dp+tp+sp shardings, the distributed-env contract parses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.models.mnist import MnistCNN
+from tf_operator_tpu.models.resnet import resnet18, resnet50
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    param_sharding_rules,
+)
+from tf_operator_tpu.parallel.mesh import create_mesh
+from tf_operator_tpu.parallel.sharding import replicate, shard_batch, shard_params_by_rules
+from tf_operator_tpu.train import data as data_lib
+from tf_operator_tpu.train import distributed
+from tf_operator_tpu.train.steps import (
+    TrainState,
+    adamw,
+    make_classifier_train_step,
+    make_lm_train_step,
+    sgd_momentum,
+)
+
+
+class TestMnistTraining:
+    def test_loss_decreases_dp(self):
+        mesh = create_mesh({"dp": 8})
+        model = MnistCNN(dtype=jnp.float32)
+        it = data_lib.synthetic_mnist(64)
+        batch0 = next(it)
+        variables = model.init(jax.random.PRNGKey(0), batch0["image"], train=True)
+        tx = sgd_momentum(0.05)
+        state = TrainState.create(variables["params"], tx)
+        state = replicate(mesh, state)
+        step = make_classifier_train_step(model, tx, mesh, has_batch_stats=False)
+        losses = []
+        for _ in range(30):
+            batch = shard_batch(mesh, next(it))
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+class TestResNet:
+    def test_resnet50_forward_shape(self):
+        model = resnet50(dtype=jnp.float32)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 1000)
+        n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
+        # ResNet-50 has ~25.6M params.
+        assert 25_000_000 < n_params < 26_000_000, n_params
+
+    def test_resnet18_train_step_dp(self):
+        mesh = create_mesh({"dp": 8})
+        model = resnet18(num_classes=10, dtype=jnp.float32)
+        x = jnp.ones((8, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        tx = sgd_momentum(0.01)
+        state = TrainState.create(
+            variables["params"], tx, batch_stats=variables["batch_stats"]
+        )
+        state = replicate(mesh, state)
+        step = make_classifier_train_step(model, tx, mesh, has_batch_stats=True)
+        batch = shard_batch(
+            mesh,
+            {
+                "image": np.random.default_rng(0).normal(size=(8, 32, 32, 3)).astype(np.float32),
+                "label": np.zeros((8,), np.int32),
+            },
+        )
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert int(state.step) == 1
+
+
+class TestTransformer:
+    def _mesh_cfg(self, mesh):
+        return TransformerConfig(
+            vocab_size=256,
+            d_model=64,
+            n_heads=4,
+            n_layers=2,
+            d_ff=128,
+            max_seq_len=64,
+            dtype=jnp.float32,
+            mesh=mesh,
+        )
+
+    def test_lm_step_dp_tp_sp(self):
+        mesh = create_mesh({"dp": 2, "sp": 2, "tp": 2})
+        cfg = self._mesh_cfg(mesh)
+        model = Transformer(cfg)
+        it = data_lib.synthetic_tokens(4, 32, vocab_size=cfg.vocab_size)
+        batch0 = next(it)
+        params = model.init(jax.random.PRNGKey(0), batch0["tokens"])["params"]
+        params = shard_params_by_rules(mesh, params, param_sharding_rules())
+        tx = adamw(1e-3)
+        state = TrainState.create(params, tx)
+        step = make_lm_train_step(model, tx, mesh)
+        losses = []
+        for _ in range(5):
+            batch = next(it)
+            batch = {
+                "tokens": jnp.asarray(batch["tokens"]),
+                "targets": jnp.asarray(batch["targets"]),
+            }
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]  # adam on random tokens still memorizes a bit
+
+    def test_ring_matches_dense_model(self):
+        """Same params, sp=4 ring attention vs single-device dense attention."""
+        mesh = create_mesh({"sp": 4}, devices=jax.devices()[:4])
+        cfg_ring = self._mesh_cfg(mesh)
+        cfg_dense = TransformerConfig(
+            vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=64, dtype=jnp.float32, mesh=None,
+        )
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, size=(2, 32)), jnp.int32
+        )
+        params = Transformer(cfg_dense).init(jax.random.PRNGKey(0), tokens)["params"]
+        out_dense = Transformer(cfg_dense).apply({"params": params}, tokens)
+        out_ring = Transformer(cfg_ring).apply({"params": params}, tokens)
+        assert float(jnp.abs(out_dense - out_ring).max()) < 1e-4
+
+
+class TestDistributedEnv:
+    def test_from_tpu_env(self):
+        env = {
+            "TPU_COORDINATOR_ADDRESS": "job-worker-0:2222",
+            "TPU_WORKER_ID": "2",
+            "TPU_NUM_PROCESSES": "4",
+            "TPU_WORKER_HOSTNAMES": "a,b,c,d",
+            "TPU_ACCELERATOR_TYPE": "v5e-16",
+            "TPU_TOPOLOGY": "4x4",
+        }
+        topo = distributed.from_env(env)
+        assert topo.is_distributed
+        assert topo.process_id == 2
+        assert topo.num_processes == 4
+        assert topo.worker_hostnames == ["a", "b", "c", "d"]
+
+    def test_fallback_to_tf_config(self):
+        env = {
+            "TF_CONFIG": '{"cluster": {"worker": ["w0:2222", "w1:2222"]}, "task": {"type": "worker", "index": 1}}'
+        }
+        topo = distributed.from_env(env)
+        assert topo.process_id == 1
+        assert topo.num_processes == 2
+        assert topo.coordinator_address == "w0:2222"
+
+    def test_single_process(self):
+        topo = distributed.from_env({})
+        assert not topo.is_distributed
+        assert distributed.initialize(topo) is topo  # no-op, no crash

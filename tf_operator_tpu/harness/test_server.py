@@ -1,0 +1,91 @@
+"""Controllable fake workload — the E2E test double for a training process.
+
+Parity: test/test-server/test_app.py:25-41 in the reference — a tiny HTTP
+app run *as* the replica container so cluster E2E can exercise lifecycle
+semantics (restart policies, chief-vs-worker termination, GC)
+deterministically without any ML framework in the loop:
+
+- GET /tfconfig          → echoes the injected TF_CONFIG (JSON)
+- GET /topology          → echoes the injected TPU mesh env (the TPU analog
+                           SURVEY.md §4 calls for)
+- GET /exit?exitCode=n   → replies, then kills this replica with exit code n
+- GET /healthz           → liveness
+- GET /                  → identity summary
+
+Run: python -m tf_operator_tpu.harness.test_server  (port from PORT env,
+default 2222).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from tf_operator_tpu.api import constants
+
+TPU_ENV_KEYS = (
+    constants.ENV_TPU_WORKER_HOSTNAMES,
+    constants.ENV_TPU_WORKER_ID,
+    constants.ENV_TPU_ACCELERATOR_TYPE,
+    constants.ENV_TPU_TOPOLOGY,
+    constants.ENV_COORDINATOR_ADDRESS,
+    constants.ENV_NUM_PROCESSES,
+    "MEGASCALE_NUM_SLICES",
+    "MEGASCALE_SLICE_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _reply(self, payload: dict, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        url = urlparse(self.path)
+        if url.path == "/tfconfig":
+            raw = os.environ.get(constants.ENV_TF_CONFIG, "")
+            try:
+                self._reply(json.loads(raw) if raw else {})
+            except ValueError:
+                self._reply({"raw": raw})
+        elif url.path == "/topology":
+            self._reply({k: os.environ[k] for k in TPU_ENV_KEYS if k in os.environ})
+        elif url.path == "/exit":
+            try:
+                code = int(parse_qs(url.query).get("exitCode", ["0"])[0])
+            except ValueError:
+                self._reply({"error": "exitCode must be an integer"}, code=400)
+                return
+            self._reply({"exiting": code})
+            # Reply first, then die — the harness needs the ACK.
+            threading.Timer(0.05, lambda: os._exit(code)).start()
+        elif url.path == "/healthz":
+            self._reply({"ok": True})
+        else:
+            self._reply(
+                {
+                    "server": "tpu-operator-test-server",
+                    "task_index": os.environ.get(constants.ENV_TPU_WORKER_ID),
+                }
+            )
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet
+        pass
+
+
+def main() -> None:
+    port = int(os.environ.get("PORT", constants.DEFAULT_PORT))
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

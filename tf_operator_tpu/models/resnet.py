@@ -1,0 +1,102 @@
+"""ResNet-50 (v1.5) in Flax — the framework's flagship benchmark model.
+
+This is the BASELINE.md headline workload ("MultiWorkerMirroredStrategy
+ResNet-50 — v5e-16 slice"), rebuilt TPU-first: bf16 activations with f32
+batch-norm statistics and f32 parameters, NHWC layout (XLA's preferred conv
+layout on TPU), and shapes that tile cleanly onto the 128x128 MXU. Data
+parallelism comes from jit + batch sharding (see train/steps.py), not from a
+parameter-server process topology: under a sharded batch, XLA computes
+batch-norm moments globally (the collectives ride ICI), which is exactly the
+cross-replica sync MultiWorkerMirroredStrategy provides in the reference's
+world (examples/v1alpha2/dist-mnist/dist_mnist.py:15-60 being its analog
+sample).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3(stride) -> 1x1 with projection shortcut (v1.5 places the
+    stride on the 3x3, matching the torchvision/MLPerf definition)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides))(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), strides=(self.strides, self.strides)
+            )(residual)
+            residual = self.norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.he_normal(),
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,  # compute dtype; stats/params stay f32
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)])(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(
+                    filters=self.width * 2**i, strides=strides, conv=conv, norm=norm
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier head in f32 for a stable softmax.
+        x = nn.Dense(
+            self.num_classes,
+            dtype=jnp.float32,
+            kernel_init=nn.initializers.zeros_init(),
+        )(x.astype(jnp.float32))
+        return x
+
+
+def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+
+
+def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
+    """Smaller variant for tests/CI (still bottleneck blocks for simplicity)."""
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, dtype=dtype)

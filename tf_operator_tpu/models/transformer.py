@@ -1,0 +1,137 @@
+"""Decoder-only transformer with tensor- and sequence-parallel execution.
+
+The long-context / model-parallel workload of the framework (the reference
+has no sharded execution at all — SURVEY.md §2.9; this is the TPU-native
+capability the rebuild adds on top of parity). Sharding design:
+
+- params: attention QKV/out and MLP in/out kernels split over ``tp``
+  (head dim / hidden dim respectively) via the PartitionSpec rules in
+  ``param_sharding_rules`` — applied by train/steps.py with
+  ``shard_params_by_rules``; XLA inserts the all-reduces.
+- activations: [batch, seq, model] sharded (dp, sp, tp-on-hidden) — the
+  ``sp`` axis is handled exactly by ring attention (parallel/ring_attention).
+- bf16 compute, f32 params/softmax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # Mesh wiring (static): when mesh is set and has an 'sp' axis of size >1,
+    # attention runs as ring attention over that axis.
+    mesh: Any = None
+    seq_axis: str = "sp"
+    batch_axis: str = "dp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def use_ring(self) -> bool:
+        return self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        qkv = nn.DenseGeneral(
+            (3, cfg.n_heads, cfg.head_dim),
+            axis=-1,
+            dtype=cfg.dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.use_ring:
+            batch_spec = (cfg.batch_axis,) if cfg.mesh.shape.get(cfg.batch_axis, 1) > 1 else (None,)
+            out = ring_attention(
+                q, k, v, cfg.mesh,
+                seq_axis=cfg.seq_axis,
+                batch_spec=batch_spec,
+                causal=True,
+            )
+        else:
+            out = reference_attention(q, k, v, causal=True)
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="in_proj")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, name="attn")(nn.RMSNorm(dtype=self.cfg.dtype)(x))
+        x = x + MLP(self.cfg, name="mlp")(nn.RMSNorm(dtype=self.cfg.dtype)(x))
+        return x
+
+
+class Transformer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos")(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+        for i in range(cfg.n_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.RMSNorm(dtype=cfg.dtype)(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
+            x.astype(jnp.float32)
+        )
+        return logits
+
+
+def param_sharding_rules(tp_axis: str = "tp") -> dict[str, tuple]:
+    """PartitionSpec rules (path-substring → spec) for tensor parallelism:
+    QKV + MLP-in split the output feature dim, out-projections split the
+    input feature dim — the Megatron pairing that needs only one all-reduce
+    per block per direction."""
+    return {
+        "qkv/kernel": (None, None, tp_axis, None),  # [d_model,3,heads,head_dim]
+        "attn/out/kernel": (tp_axis, None, None),  # [heads,head_dim,d_model]
+        "mlp/in_proj/kernel": (None, tp_axis),  # [d_model,d_ff]
+        "mlp/out_proj/kernel": (tp_axis, None),  # [d_ff,d_model]
+        "embed/embedding": (tp_axis, None),  # vocab split
+        "lm_head/kernel": (None, tp_axis),  # vocab split
+    }

@@ -1,0 +1,95 @@
+"""Device-mesh construction for SPMD training.
+
+The TPU-native replacement for the reference's PS/Worker process topology:
+instead of a cluster-spec of gRPC servers, parallelism is a
+``jax.sharding.Mesh`` over the slice's devices with named axes, and XLA
+inserts the collectives (the "pick a mesh, annotate shardings" recipe).
+
+Axis conventions used across the framework:
+
+- ``dp``  — data parallelism (batch split; gradients all-reduced over ICI)
+- ``fsdp``— data parallelism with sharded parameters/optimizer state
+          (the TPU analog of the reference era's "PS sharding": parameter
+          state lives sharded across data-parallel workers)
+- ``tp``  — tensor parallelism (feature/head split inside a layer)
+- ``sp``  — sequence/context parallelism (ring attention over this axis)
+- ``pp``  — pipeline parallelism (layer stages)
+- ``ep``  — expert parallelism (MoE expert split)
+
+Reference parity note: the reference itself has no sharded execution
+(SURVEY.md §2.9) — the cluster topology it wires up (PS/Worker over
+TF_CONFIG) is superseded by these mesh axes on TPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def create_mesh(
+    axes: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh with the given axis sizes over the given devices.
+
+    Axis sizes of 1 are kept (so downstream PartitionSpecs can always name
+    the axis); a single ``-1`` axis absorbs the remaining devices.
+
+    >>> mesh = create_mesh({"dp": -1, "tp": 2})
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes or {"dp": n})
+
+    wildcard = [k for k, v in axes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(v for v in axes.values() if v != -1)
+    if wildcard:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        axes[wildcard[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"mesh axes {axes} need {fixed} devices, have {n}")
+
+    names = tuple(sorted(axes, key=lambda a: AXIS_ORDER.index(a) if a in AXIS_ORDER else 99))
+    shape = tuple(axes[a] for a in names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def slice_mesh(accelerator_type: str, topology: str | None = None,
+               devices: Sequence[jax.Device] | None = None,
+               data_axis: str = "dp") -> Mesh:
+    """Data-parallel mesh over exactly one TPU slice.
+
+    Validates that the visible device count matches the slice's device count
+    (catching "ran a v5e-16 job on a v5e-8 reservation" misconfigurations at
+    mesh-build time), then returns a 1-axis data mesh. For model-parallel
+    layouts over the slice, pass the validated device list to create_mesh
+    with the axis split you want.
+    """
+    from tf_operator_tpu.topology import slices
+
+    topo = slices.resolve(accelerator_type, topology)
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) != topo.num_devices:
+        raise ValueError(
+            f"slice {topo.accelerator_type} has {topo.num_devices} devices "
+            f"but {len(devices)} are visible"
+        )
+    return create_mesh({data_axis: len(devices)}, devices)
+
+
+def host_local_batch_size(global_batch: int, mesh: Mesh, axis: str = "dp") -> int:
+    size = mesh.shape.get(axis, 1)
+    if global_batch % size:
+        raise ValueError(f"global batch {global_batch} not divisible by {axis}={size}")
+    return global_batch // size

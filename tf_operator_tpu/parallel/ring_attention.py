@@ -1,0 +1,133 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context support is first-class in this framework (SURVEY.md notes the
+reference predates sequence parallelism entirely): the sequence axis is
+sharded over the mesh's ``sp`` axis, each device holds one Q/K/V block, and
+K/V blocks rotate around the ring via ``lax.ppermute`` over ICI while a
+streaming (flash-style) softmax accumulates exact results — O(T/sp) memory
+per device, communication overlapped with the next block's compute by XLA.
+
+Shapes follow [batch, seq, heads, head_dim]. Works under shard_map on any
+mesh axis; differentiable (autodiff through the scan+ppermute); used by
+models/transformer.py when ``sp > 1``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30  # finite "masked" value: keeps the streaming max NaN-free
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One q-block x kv-block attention contribution.
+
+    Returns (scores_max, exp_scores, pv): pieces for streaming softmax.
+    q: [B,Tq,H,D]  k,v: [B,Tk,H,D]  mask: [Tq,Tk] bool (True = keep) or None.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, _NEG_INF)
+    return s
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Per-device body (runs under shard_map). Local seq block attends to
+    every kv block as it rotates around the ring."""
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+
+    o = jnp.zeros((b, tq, h, d), jnp.float32)
+    m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, tq), jnp.float32)
+
+    q_pos = my_idx * tq + jnp.arange(tq)
+
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # Which global block this device currently holds: blocks rotate
+        # forward, so at step i we hold block (my_idx - i) mod ring.
+        kv_idx = (my_idx - i) % axis_size
+        if causal:
+            k_pos = kv_idx * tk + jnp.arange(tk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        s = _block_attn(q, k_cur, v_cur, scale, mask)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(_NEG_INF - _NEG_INF) would be 1; clamp fully-masked rows via l.
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None, :, :], p, 0.0)
+        l = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+        o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m_new, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o, m, l, k, v), jnp.arange(axis_size)
+    )
+    l = jnp.maximum(l, 1e-30)  # fully-masked rows (shouldn't occur causally)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_spec: Any = ("dp",),
+    head_spec: Any = (None,),
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention with the sequence dim sharded over ``seq_axis``.
+
+    q/k/v: [batch, seq, heads, head_dim] global arrays (sharded or to-be-
+    sharded per the specs). Returns the attention output with the same
+    sharding as q.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(*batch_spec, seq_axis, *head_spec, None)
+    body = functools.partial(
+        _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Single-device exact attention — the correctness oracle for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,75 @@
+"""Sharding-rule helpers: map logical array dimensions to mesh axes.
+
+The pattern (from the public scaling-book recipe): annotate inputs/params
+with NamedShardings, let XLA's SPMD partitioner insert the collectives,
+constrain intermediates only where XLA needs the hint.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def named(mesh: Mesh, *spec: Any) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    """Shard dim 0 (batch) over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "dp") -> Any:
+    """Device-put a host batch with dim-0 sharding over the data axis."""
+    sharding = batch_sharded(mesh, axis)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def shard_params_by_rules(
+    mesh: Mesh, params: Any, rules: dict[str, tuple], default: tuple = ()
+) -> Any:
+    """Apply PartitionSpec rules keyed by parameter-path substring.
+
+    ``rules`` maps a substring of the flattened param path (e.g. "Dense_0/kernel")
+    to a PartitionSpec tuple; first match wins, unmatched params get ``default``
+    (replicated). Returns the device-put params.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(path) -> str:
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+    def spec_for(path) -> P:
+        p = path_str(path)
+        for sub, spec in rules.items():
+            if sub in p:
+                return P(*spec)
+        return P(*default)
+
+    placed = {
+        path_str(path): jax.device_put(leaf, NamedSharding(mesh, spec_for(path)))
+        for path, leaf in flat
+    }
+    # Rebuild the tree in place.
+    def rebuild(path, leaf):
+        return placed[path_str(path)]
+
+    return jax.tree_util.tree_map_with_path(rebuild, params)
+
+
+def constrain(x: Any, mesh: Mesh, *spec: Any) -> Any:
+    """with_sharding_constraint shorthand for intermediates inside jit."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

@@ -1,0 +1,22 @@
+"""Helpers for running the parallel stack on a virtual CPU mesh.
+
+Import `force_cpu_mesh()` BEFORE any other jax usage in a script to get an
+8-device CPU platform regardless of what platform plugin the environment
+pins (needed because some TPU plugin environments re-export JAX_PLATFORMS).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

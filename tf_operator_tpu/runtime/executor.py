@@ -1,0 +1,280 @@
+"""Local process executor: a single-node "kubelet" for the in-memory cluster.
+
+Pods become real OS processes. This is what turns the framework's local mode
+into a true end-to-end system (the reference needs a GKE cluster for its
+tier-4 tests; here the same lifecycle semantics — env injection, restart
+policies, exit-code classification, GC — are exercised against genuine
+subprocesses and real HTTP on localhost).
+
+Semantics implemented:
+- pod ADDED   → allocate a rendezvous port, launch the default container's
+  command as a subprocess with the pod's env (+PORT), phase → Running
+- process exit → phase Succeeded (0) / Failed (≠0) with containerStatuses
+  .state.terminated.exitCode, honoring pod restartPolicy Always/OnFailure
+  by relaunching in place (restartCount++), Never by going terminal
+- pod DELETED → SIGTERM, escalate to SIGKILL
+
+Service "DNS": sibling pod references inside injected env values
+("{pod-name}:{port}") are rewritten to 127.0.0.1:{assigned-port}, the
+localhost analog of the headless-service DNS fabric (replicas.go:151-162).
+The port map is exposed via ``resolve()`` so harnesses can reach a replica
+the way test_runner.py reaches one through the apiserver proxy.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ADDED, DELETED, ClusterClient, NotFound
+from tf_operator_tpu.utils import logger
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class _Running:
+    process: subprocess.Popen
+    port: int
+    restart_count: int = 0
+    deleted: bool = False
+
+
+class LocalProcessExecutor:
+    def __init__(self, client: ClusterClient, namespace: str | None = None) -> None:
+        self._client = client
+        self._namespace = namespace
+        self._procs: dict[str, _Running] = {}  # pod key -> process
+        self._ports: dict[str, int] = {}  # pod name -> port
+        self._lock = threading.RLock()
+        self._log = logger.with_fields(component="local-executor")
+        self._stop: threading.Event | None = None
+
+    # -- public --------------------------------------------------------------
+
+    def start(self, stop: threading.Event) -> None:
+        self._stop = stop
+        threading.Thread(target=self._run, name="local-executor", daemon=True).start()
+
+    def resolve(self, pod_name: str) -> tuple[str, int] | None:
+        """The harness's service-proxy analog: pod name → (host, port)."""
+        with self._lock:
+            port = self._ports.get(pod_name)
+        return ("127.0.0.1", port) if port is not None else None
+
+    # -- loop ----------------------------------------------------------------
+
+    def _run(self) -> None:
+        watch = self._client.watch(objects.PODS, self._namespace)
+        for pod in self._client.list(objects.PODS, self._namespace):
+            self._on_added(pod)
+        while self._stop is not None and not self._stop.is_set():
+            event = watch.next(timeout=0.2)
+            if event is None:
+                continue
+            if event.type == ADDED:
+                self._on_added(event.object)
+            elif event.type == DELETED:
+                self._on_deleted(event.object)
+        watch.stop()
+        with self._lock:
+            procs = list(self._procs.values())
+        for running in procs:
+            self._kill(running)
+
+    # -- pod lifecycle -------------------------------------------------------
+
+    def _port_for(self, pod_name: str) -> int:
+        with self._lock:
+            if pod_name not in self._ports:
+                self._ports[pod_name] = _free_port()
+            return self._ports[pod_name]
+
+    def _ensure_job_ports(self, pod: dict[str, Any]) -> None:
+        """Allocate ports for every EXPECTED replica of the owning job before
+        launch, derived from the job spec (not from currently-listed pods),
+        so cross-references in env rewrite consistently even when this pod
+        launches before the controller created its siblings."""
+        job_name = objects.labels_of(pod).get(constants.LABEL_JOB_NAME)
+        if not job_name:
+            return
+        try:
+            job = self._client.get(
+                objects.TPUJOBS, objects.namespace_of(pod), job_name
+            )
+            from tf_operator_tpu.utils import names as names_util
+
+            for rtype, spec in job.get("spec", {}).get("replicaSpecs", {}).items():
+                replicas = int(spec.get("replicas", 1) or 1)
+                for i in range(replicas):
+                    self._port_for(names_util.gen_name(job_name, rtype, i))
+            return
+        except NotFound:
+            pass
+        # Fallback: whatever siblings exist right now.
+        siblings = self._client.list(
+            objects.PODS,
+            objects.namespace_of(pod),
+            {constants.LABEL_JOB_NAME: job_name},
+        )
+        for sib in siblings:
+            self._port_for(objects.name_of(sib))
+
+    def _rewrite(self, value: str, default_port: int) -> str:
+        """Rewrite "{pod-name}:{port}" and bare pod-name references of known
+        pods to their localhost address."""
+        with self._lock:
+            ports = dict(self._ports)
+        for name, port in ports.items():
+            value = value.replace(f"{name}:{default_port}", f"127.0.0.1:{port}")
+        return value
+
+    def _on_added(self, pod: dict[str, Any]) -> None:
+        key = objects.key_of(pod)
+        with self._lock:
+            if key in self._procs:
+                return
+        self._ensure_job_ports(pod)
+        self._launch(pod, restart_count=0)
+
+    def _launch(self, pod: dict[str, Any], restart_count: int) -> None:
+        key = objects.key_of(pod)
+        name = objects.name_of(pod)
+        container = objects.get_container(pod, constants.DEFAULT_CONTAINER_NAME)
+        if container is None:
+            self._fail_pod(pod, 127, "no default container")
+            return
+        command = list(container.get("command", [])) + list(container.get("args", []))
+        if not command:
+            self._fail_pod(pod, 127, "no command (local executor runs commands, not images)")
+            return
+
+        port = self._port_for(name)
+        default_port = constants.DEFAULT_PORT
+        for p in container.get("ports", []):
+            if p.get("name") == constants.DEFAULT_PORT_NAME:
+                default_port = int(p.get("containerPort", default_port))
+
+        env = dict(os.environ)
+        env["PORT"] = str(port)
+        for item in container.get("env", []):
+            if "value" in item:
+                env[item["name"]] = self._rewrite(str(item["value"]), default_port)
+
+        try:
+            proc = subprocess.Popen(
+                command,
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        except OSError as e:
+            self._fail_pod(pod, 127, f"spawn failed: {e}")
+            return
+
+        running = _Running(process=proc, port=port, restart_count=restart_count)
+        with self._lock:
+            self._procs[key] = running
+        # Close the relaunch/delete race: if the pod vanished while we were
+        # spawning, kill the fresh process instead of leaking an orphan.
+        try:
+            self._client.get(objects.PODS, objects.namespace_of(pod), objects.name_of(pod))
+        except NotFound:
+            running.deleted = True
+            self._kill(running)
+            with self._lock:
+                self._procs.pop(key, None)
+            return
+        self._set_phase(pod, objects.RUNNING, restart_count=restart_count)
+        threading.Thread(
+            target=self._wait, args=(pod, running), daemon=True
+        ).start()
+
+    def _wait(self, pod: dict[str, Any], running: _Running) -> None:
+        code = running.process.wait()
+        key = objects.key_of(pod)
+        if running.deleted:
+            with self._lock:
+                self._procs.pop(key, None)
+            return
+        policy = pod.get("spec", {}).get("restartPolicy", "Never")
+        should_restart = policy == "Always" or (policy == "OnFailure" and code != 0)
+        with self._lock:
+            self._procs.pop(key, None)
+        if should_restart and self._stop is not None and not self._stop.is_set():
+            try:  # pod may be gone by now
+                self._client.get(objects.PODS, objects.namespace_of(pod), objects.name_of(pod))
+            except NotFound:
+                return
+            self._launch(pod, restart_count=running.restart_count + 1)
+            return
+        phase = objects.SUCCEEDED if code == 0 else objects.FAILED
+        self._set_phase(pod, phase, exit_code=code, restart_count=running.restart_count)
+
+    def _on_deleted(self, pod: dict[str, Any]) -> None:
+        # NOTE: the name→port mapping is deliberately kept. A controller-
+        # recreated pod (ExitCode/slice restart) must come back on the SAME
+        # port because sibling pods' env was rewritten to it at their launch —
+        # the stable-port mapping is the localhost analog of stable service
+        # DNS names (replicas.go:151-162).
+        key = objects.key_of(pod)
+        with self._lock:
+            running = self._procs.get(key)
+            if running:
+                running.deleted = True
+        if running:
+            self._kill(running)
+
+    def _kill(self, running: _Running) -> None:
+        proc = running.process
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            except ProcessLookupError:
+                pass
+
+    # -- status writes -------------------------------------------------------
+
+    def _set_phase(
+        self,
+        pod: dict[str, Any],
+        phase: str,
+        exit_code: int | None = None,
+        restart_count: int = 0,
+    ) -> None:
+        ns, name = objects.namespace_of(pod), objects.name_of(pod)
+        try:
+            fresh = self._client.get(objects.PODS, ns, name)
+        except NotFound:
+            return
+        objects.set_pod_phase(fresh, phase)
+        if exit_code is not None:
+            objects.set_container_terminated(
+                fresh, constants.DEFAULT_CONTAINER_NAME, exit_code
+            )
+        statuses = fresh.setdefault("status", {}).setdefault("containerStatuses", [])
+        for cs in statuses:
+            cs["restartCount"] = restart_count
+        try:
+            self._client.update_status(objects.PODS, fresh)
+        except Exception:
+            self._log.exception("pod status update failed for %s", name)
+
+    def _fail_pod(self, pod: dict[str, Any], code: int, reason: str) -> None:
+        self._log.warning("pod %s failed to launch: %s", objects.name_of(pod), reason)
+        self._set_phase(pod, objects.FAILED, exit_code=code)

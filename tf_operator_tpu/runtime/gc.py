@@ -1,0 +1,61 @@
+"""Owner-reference garbage collector.
+
+On a real cluster the K8s GC deletes pods/services whose owning TPUJob is
+gone (the reference relies on exactly that for TFJob deletion, verified by
+its e2e wait-for-GC step, test/e2e/main.go:244-252). The in-memory cluster
+has no built-in GC, so this component supplies the same semantics: when a
+TPUJob is deleted, every object holding a controller ownerReference to its
+UID is deleted too.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import DELETED, ClusterClient, NotFound
+from tf_operator_tpu.utils import logger
+
+OWNED_KINDS = (objects.PODS, objects.SERVICES, objects.PDBS)
+
+
+class OwnerGarbageCollector:
+    def __init__(self, client: ClusterClient, namespace: str | None = None) -> None:
+        self._client = client
+        self._namespace = namespace
+        self._log = logger.with_fields(component="owner-gc")
+
+    def start(self, stop: threading.Event) -> None:
+        threading.Thread(target=self._run, args=(stop,), daemon=True).start()
+
+    def _run(self, stop: threading.Event) -> None:
+        watch = self._client.watch(objects.TPUJOBS, self._namespace)
+        while not stop.is_set():
+            event = watch.next(timeout=0.2)
+            if event is None:
+                continue
+            if event.type == DELETED:
+                self.collect(event.object)
+        watch.stop()
+
+    def collect(self, owner: dict) -> int:
+        uid = objects.uid_of(owner)
+        if not uid:
+            return 0
+        deleted = 0
+        for kind in OWNED_KINDS:
+            for obj in self._client.list(kind, self._namespace):
+                refs = objects.meta(obj).get("ownerReferences", [])
+                if any(r.get("uid") == uid and r.get("controller") for r in refs):
+                    try:
+                        self._client.delete(
+                            kind, objects.namespace_of(obj), objects.name_of(obj)
+                        )
+                        deleted += 1
+                    except NotFound:
+                        pass
+        if deleted:
+            self._log.info(
+                "collected %d objects owned by %s", deleted, objects.name_of(owner)
+            )
+        return deleted

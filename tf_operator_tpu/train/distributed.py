@@ -1,0 +1,78 @@
+"""Consume the operator-injected topology contract inside a training process.
+
+The analog of the reference sample's TF_CONFIG parsing + tf.train.Server
+bring-up (examples/tf_sample/tf_smoke.py:86-113), TPU-first: the operator
+injects TPU_COORDINATOR_ADDRESS / TPU_WORKER_ID / TPU_NUM_PROCESSES (see
+controller/cluster_spec.py) and this module turns them into a
+``jax.distributed.initialize`` call, after which ``jax.devices()`` spans the
+whole slice and jitted SPMD code runs multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from tf_operator_tpu.api import constants
+
+
+@dataclass(frozen=True)
+class ProcessTopology:
+    coordinator_address: str | None
+    process_id: int
+    num_processes: int
+    accelerator_type: str | None
+    topology: str | None
+    worker_hostnames: list[str]
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1 and self.coordinator_address is not None
+
+
+def from_env(env: dict[str, str] | None = None) -> ProcessTopology:
+    """Parse the injected contract; fall back to TF_CONFIG task info so plain
+    TF-style pods (no TPU slice) also resolve their identity."""
+    env = dict(os.environ if env is None else env)
+    coord = env.get(constants.ENV_COORDINATOR_ADDRESS)
+    worker_id = env.get(constants.ENV_TPU_WORKER_ID)
+    num = env.get(constants.ENV_NUM_PROCESSES)
+
+    if worker_id is None and constants.ENV_TF_CONFIG in env:
+        try:
+            tf_config = json.loads(env[constants.ENV_TF_CONFIG])
+            worker_id = str(tf_config.get("task", {}).get("index", 0))
+            cluster = tf_config.get("cluster", {})
+            workers = cluster.get("worker", [])
+            num = num or str(len(workers) or 1)
+            if coord is None and workers:
+                coord = workers[0]
+        except (ValueError, KeyError):
+            pass
+
+    hostnames = [
+        h for h in env.get(constants.ENV_TPU_WORKER_HOSTNAMES, "").split(",") if h
+    ]
+    return ProcessTopology(
+        coordinator_address=coord,
+        process_id=int(worker_id or 0),
+        num_processes=int(num or 1),
+        accelerator_type=env.get(constants.ENV_TPU_ACCELERATOR_TYPE),
+        topology=env.get(constants.ENV_TPU_TOPOLOGY),
+        worker_hostnames=hostnames,
+    )
+
+
+def initialize(topology: ProcessTopology | None = None) -> ProcessTopology:
+    """jax.distributed.initialize from the injected env (no-op single-process)."""
+    topo = topology or from_env()
+    if topo.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=topo.coordinator_address,
+            num_processes=topo.num_processes,
+            process_id=topo.process_id,
+        )
+    return topo
