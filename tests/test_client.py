@@ -1,0 +1,138 @@
+"""Tests for the programmatic TPUJob client (py/tf_job_client.py analog):
+CRUD, pod/service introspection by controller labels, and the wait_*
+lifecycle helpers driven by a background controller over the in-memory
+cluster."""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.client import TimeoutError_, TPUJobClient
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import NotFound
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.utils import testutil
+
+
+@pytest.fixture()
+def cluster():
+    return InMemoryCluster()
+
+
+@pytest.fixture()
+def client(cluster):
+    return TPUJobClient(cluster)
+
+
+@pytest.fixture()
+def running_controller(cluster):
+    tc = TPUJobController(
+        cluster,
+        JobControllerConfig(reconcile_period=0.1, informer_resync=0.2, threadiness=2),
+    )
+    stop = threading.Event()
+    t = threading.Thread(target=tc.run, args=(stop,), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    yield tc
+    stop.set()
+    t.join(timeout=2)
+
+
+def mark_pods(cluster, namespace, name, phase, exit_code=None):
+    """Simulate the kubelet: flip every job pod to `phase`."""
+    sel = {constants.LABEL_JOB_NAME: name}
+    for pod in cluster.list(objects.PODS, namespace, label_selector=sel):
+        objects.set_pod_phase(pod, phase)
+        if exit_code is not None:
+            objects.set_container_terminated(
+                pod, constants.DEFAULT_CONTAINER_NAME, exit_code
+            )
+        cluster.update(objects.PODS, pod)
+
+
+def test_crud_roundtrip(client):
+    job = testutil.new_tpujob(name="crud", worker=1)
+    created = client.create(job.to_dict())
+    assert created["metadata"]["uid"]
+    got = client.get("default", "crud")
+    assert got["metadata"]["name"] == "crud"
+    assert [j["metadata"]["name"] for j in client.list()] == ["crud"]
+    client.delete("default", "crud")
+    with pytest.raises(NotFound):
+        client.get("default", "crud")
+
+
+def test_create_accepts_typed_job(client):
+    created = client.create(testutil.new_tpujob(name="typed", worker=2))
+    assert created["spec"]["replicaSpecs"]["Worker"]["replicas"] == 2
+
+
+def test_wait_for_running_and_job(cluster, client, running_controller):
+    client.create(testutil.new_tpujob(name="wjob", worker=2))
+
+    # Pods appear; kubelet-sim marks them running → Running condition.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(client.get_pods("default", "wjob")) == 2:
+            break
+        time.sleep(0.05)
+    mark_pods(cluster, "default", "wjob", objects.RUNNING)
+    got = client.wait_for_running("default", "wjob", timeout=10)
+    assert TPUJobClient.log_status(got).count("Running=True") == 1
+
+    mark_pods(cluster, "default", "wjob", objects.SUCCEEDED, exit_code=0)
+    got = client.wait_for_job("default", "wjob", timeout=10)
+    types = [
+        c["type"] for c in got["status"]["conditions"] if c["status"] == "True"
+    ]
+    assert JobConditionType.SUCCEEDED in types
+
+
+def test_wait_for_condition_timeout(client):
+    client.create(testutil.new_tpujob(name="stuck", worker=1))
+    with pytest.raises(TimeoutError_):
+        client.wait_for_condition(
+            "default", "stuck", (JobConditionType.RUNNING,), timeout=0.3
+        )
+
+
+def test_wait_for_delete(cluster, client):
+    client.create(testutil.new_tpujob(name="gone", worker=1))
+
+    def deleter():
+        time.sleep(0.2)
+        cluster.delete(objects.TPUJOBS, "default", "gone")
+
+    threading.Thread(target=deleter, daemon=True).start()
+    client.wait_for_delete("default", "gone", timeout=5)
+
+
+def test_get_pods_services_by_label(cluster, client):
+    job = testutil.new_tpujob(name="sel", worker=3)
+    client.create(job)
+    testutil.seed_pods(cluster, job, "Worker", 3)
+    testutil.seed_services(cluster, job, "Worker", 3)
+    # An unrelated pod must not be picked up.
+    cluster.create(objects.PODS, objects.new_pod("stranger"))
+    assert len(client.get_pods("default", "sel")) == 3
+    assert len(client.get_services("default", "sel")) == 3
+
+
+def test_wait_for_replica_counts(cluster, client, running_controller):
+    client.create(testutil.new_tpujob(name="rc", worker=2, ps=1))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(client.get_pods("default", "rc")) == 3:
+            break
+        time.sleep(0.05)
+    mark_pods(cluster, "default", "rc", objects.RUNNING)
+    got = client.wait_for_replica_counts(
+        "default", "rc", {"Worker": {"active": 2}, "PS": {"active": 1}}, timeout=10
+    )
+    assert got["status"]["replicaStatuses"]["Worker"]["active"] == 2

@@ -1,0 +1,5 @@
+"""Programmatic TPUJob client (py/tf_job_client.py analog)."""
+
+from tf_operator_tpu.client.tpujob_client import TimeoutError_, TPUJobClient
+
+__all__ = ["TPUJobClient", "TimeoutError_"]

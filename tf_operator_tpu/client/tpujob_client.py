@@ -1,0 +1,231 @@
+"""TPUJob client — programmatic job submission and lifecycle waiting.
+
+Parity: py/tf_job_client.py in the reference (create_tf_job:22,
+delete_tf_job:59, log_status:96, wait_for_condition:175, wait_for_job:242),
+re-designed around this framework's ClusterClient abstraction so the same
+client drives the in-memory cluster (tests, local E2E) and a real apiserver.
+
+Unlike the reference's poll-only client (30 s fixed polling over the CRD),
+this one watches when the backing client supports it and falls back to
+polling, so submit→Running latency measurements (BASELINE.md) aren't
+quantized by the poll interval.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+from tf_operator_tpu.api import constants, helpers
+from tf_operator_tpu.api.types import JobConditionType, TPUJob
+from tf_operator_tpu.controller import status as status_engine
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ClusterClient, NotFound
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="tpujob-client")
+
+
+class TimeoutError_(Exception):
+    """Waiting for a job state timed out (util.py:426 analog)."""
+
+
+class TPUJobClient:
+    def __init__(self, client: ClusterClient) -> None:
+        self._client = client
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def create(self, spec: dict[str, Any] | TPUJob) -> dict[str, Any]:
+        """Submit a TPUJob (tf_job_client.py:22 analog)."""
+        obj = spec.to_dict() if isinstance(spec, TPUJob) else spec
+        created = self._client.create(objects.TPUJOBS, obj)
+        LOG.info("created TPUJob %s", objects.key_of(created))
+        return created
+
+    def get(self, namespace: str, name: str) -> dict[str, Any]:
+        return self._client.get(objects.TPUJOBS, namespace, name)
+
+    def list(self, namespace: str | None = None) -> list[dict[str, Any]]:
+        return self._client.list(objects.TPUJOBS, namespace)
+
+    def delete(self, namespace: str, name: str) -> None:
+        """Delete a TPUJob (tf_job_client.py:59 analog)."""
+        LOG.info("deleting TPUJob %s/%s", namespace, name)
+        self._client.delete(objects.TPUJOBS, namespace, name)
+
+    # -- introspection ------------------------------------------------------
+
+    def get_pods(self, namespace: str, name: str) -> list[dict[str, Any]]:
+        """Pods belonging to a job, by the controller's labels
+        (dashboard api_handler.go:162-164 uses the same selector)."""
+        return self._client.list(
+            objects.PODS, namespace, label_selector=helpers.gen_labels(name)
+        )
+
+    def get_services(self, namespace: str, name: str) -> list[dict[str, Any]]:
+        return self._client.list(
+            objects.SERVICES, namespace, label_selector=helpers.gen_labels(name)
+        )
+
+    def get_events(self, namespace: str, name: str) -> list[dict[str, Any]]:
+        """Events whose involvedObject is this job or its pods/services —
+        the audit stream the reference's E2E harness consumes
+        (test_runner.py:217-281)."""
+        out = []
+        for e in self._client.list(objects.EVENTS, namespace):
+            inv = e.get("involvedObject", {})
+            if inv.get("name", "").startswith(name) or inv.get("name") == name:
+                out.append(e)
+        return out
+
+    @staticmethod
+    def log_status(job_obj: dict[str, Any]) -> str:
+        """One-line status summary (tf_job_client.py:96 analog)."""
+        job = TPUJob.from_dict(job_obj)
+        conds = [
+            f"{c.type}={c.status}" for c in job.status.conditions if c.status == "True"
+        ]
+        counters = {
+            t: (s.active, s.succeeded, s.failed)
+            for t, s in job.status.replica_statuses.items()
+        }
+        line = f"{job.key}: conditions=[{', '.join(conds)}] replicas={counters}"
+        LOG.info(line)
+        return line
+
+    # -- waiting ------------------------------------------------------------
+
+    def _wait(
+        self,
+        namespace: str,
+        name: str,
+        predicate: Callable[[dict[str, Any] | None], bool],
+        timeout: float,
+        poll_interval: float,
+        what: str,
+    ) -> dict[str, Any] | None:
+        """Wait until predicate(job_or_None) holds; watch-driven with a
+        polling floor so a missed event can't hang the caller."""
+        deadline = time.monotonic() + timeout
+        watch = None
+        try:
+            try:
+                watch = self._client.watch(objects.TPUJOBS, namespace)
+            except Exception:  # client without watch support → poll only
+                watch = None
+            while True:
+                try:
+                    current: dict[str, Any] | None = self.get(namespace, name)
+                except NotFound:
+                    current = None
+                if predicate(current):
+                    return current
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError_(
+                        f"timed out after {timeout:.0f}s waiting for {what} "
+                        f"on TPUJob {namespace}/{name}"
+                    )
+                if watch is not None:
+                    watch.next(timeout=min(poll_interval, remaining))
+                else:
+                    time.sleep(min(poll_interval, remaining))
+        finally:
+            if watch is not None:
+                try:
+                    self._client.stop_watch(watch)  # type: ignore[attr-defined]
+                except Exception:
+                    pass
+
+    def wait_for_condition(
+        self,
+        namespace: str,
+        name: str,
+        expected: Sequence[str],
+        timeout: float = 300.0,
+        poll_interval: float = 0.25,
+    ) -> dict[str, Any]:
+        """Block until any of the expected condition types is True
+        (tf_job_client.py:175 analog)."""
+
+        def pred(obj: dict[str, Any] | None) -> bool:
+            if obj is None:
+                return False
+            st = TPUJob.from_dict(obj).status
+            return any(status_engine.has_condition(st, c) for c in expected)
+
+        got = self._wait(
+            namespace, name, pred, timeout, poll_interval,
+            what=f"condition in {list(expected)}",
+        )
+        assert got is not None
+        return got
+
+    def wait_for_job(
+        self,
+        namespace: str,
+        name: str,
+        timeout: float = 300.0,
+        poll_interval: float = 0.25,
+    ) -> dict[str, Any]:
+        """Block until the job reaches Succeeded or Failed
+        (tf_job_client.py:242 analog)."""
+        return self.wait_for_condition(
+            namespace,
+            name,
+            (JobConditionType.SUCCEEDED, JobConditionType.FAILED),
+            timeout=timeout,
+            poll_interval=poll_interval,
+        )
+
+    def wait_for_running(
+        self, namespace: str, name: str, timeout: float = 300.0
+    ) -> dict[str, Any]:
+        return self.wait_for_condition(
+            namespace, name, (JobConditionType.RUNNING,), timeout=timeout
+        )
+
+    def wait_for_delete(
+        self,
+        namespace: str,
+        name: str,
+        timeout: float = 300.0,
+        poll_interval: float = 0.25,
+    ) -> None:
+        """Block until the job object is gone (tf_job_client wait_for_delete
+        semantics; used by GC tests, test/e2e/main.go:244-252)."""
+        self._wait(
+            namespace, name, lambda obj: obj is None, timeout, poll_interval,
+            what="deletion",
+        )
+
+    def wait_for_replica_counts(
+        self,
+        namespace: str,
+        name: str,
+        expected: dict[str, dict[str, int]],
+        timeout: float = 300.0,
+        poll_interval: float = 0.25,
+    ) -> dict[str, Any]:
+        """Wait until replicaStatuses match, e.g. {"Worker": {"active": 4}}."""
+
+        def pred(obj: dict[str, Any] | None) -> bool:
+            if obj is None:
+                return False
+            st = TPUJob.from_dict(obj).status
+            for rtype, want in expected.items():
+                rs = st.replica_statuses.get(rtype)
+                if rs is None:
+                    return False
+                got = rs.to_dict()
+                if any(got.get(k, 0) != v for k, v in want.items()):
+                    return False
+            return True
+
+        got = self._wait(
+            namespace, name, pred, timeout, poll_interval,
+            what=f"replica counts {expected}",
+        )
+        assert got is not None
+        return got

@@ -42,6 +42,7 @@ class TransformerConfig:
     mesh: Any = None
     seq_axis: str = "sp"
     batch_axis: str = "dp"
+    tp_axis: str = "tp"
 
     @property
     def head_dim(self) -> int:
@@ -68,10 +69,19 @@ class Attention(nn.Module):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cfg.use_ring:
             batch_spec = (cfg.batch_axis,) if cfg.mesh.shape.get(cfg.batch_axis, 1) > 1 else (None,)
+            # Heads are tp-sharded by the qkv kernel rule; declaring that to
+            # shard_map (the ring body is head-independent) avoids an
+            # all-gather of Q/K/V heads at the boundary on every layer.
+            head_spec = (
+                (cfg.tp_axis,)
+                if cfg.mesh.shape.get(cfg.tp_axis, 1) > 1
+                else (None,)
+            )
             out = ring_attention(
                 q, k, v, cfg.mesh,
                 seq_axis=cfg.seq_axis,
                 batch_spec=batch_spec,
+                head_spec=head_spec,
                 causal=True,
             )
         else:

@@ -38,36 +38,38 @@ def replicate(mesh: Mesh, tree: Any) -> Any:
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
 
 
-def shard_params_by_rules(
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def sharding_tree_by_rules(
     mesh: Mesh, params: Any, rules: dict[str, tuple], default: tuple = ()
 ) -> Any:
-    """Apply PartitionSpec rules keyed by parameter-path substring.
+    """NamedSharding pytree matching ``params``, from path-substring rules.
 
-    ``rules`` maps a substring of the flattened param path (e.g. "Dense_0/kernel")
-    to a PartitionSpec tuple; first match wins, unmatched params get ``default``
-    (replicated). Returns the device-put params.
+    ``rules`` maps a substring of the flattened param path (e.g.
+    "Dense_0/kernel") to a PartitionSpec tuple; first match wins, unmatched
+    params get ``default`` (replicated).
     """
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-
-    def path_str(path) -> str:
-        return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
     def spec_for(path) -> P:
-        p = path_str(path)
+        p = _path_str(path)
         for sub, spec in rules.items():
             if sub in p:
                 return P(*spec)
         return P(*default)
 
-    placed = {
-        path_str(path): jax.device_put(leaf, NamedSharding(mesh, spec_for(path)))
-        for path, leaf in flat
-    }
-    # Rebuild the tree in place.
-    def rebuild(path, leaf):
-        return placed[path_str(path)]
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_for(path)), params
+    )
 
-    return jax.tree_util.tree_map_with_path(rebuild, params)
+
+def shard_params_by_rules(
+    mesh: Mesh, params: Any, rules: dict[str, tuple], default: tuple = ()
+) -> Any:
+    """Device-put params per the path-substring PartitionSpec rules."""
+    shardings = sharding_tree_by_rules(mesh, params, rules, default)
+    return jax.tree.map(jax.device_put, params, shardings)
 
 
 def constrain(x: Any, mesh: Mesh, *spec: Any) -> Any:

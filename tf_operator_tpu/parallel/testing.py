@@ -19,4 +19,7 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    try:  # no-op if the backend is already initialized
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

@@ -47,6 +47,7 @@ def _free_port() -> int:
 class _Running:
     process: subprocess.Popen
     port: int
+    uid: str = ""
     restart_count: int = 0
     deleted: bool = False
 
@@ -132,8 +133,10 @@ class LocalProcessExecutor:
             self._port_for(objects.name_of(sib))
 
     def _rewrite(self, value: str, default_port: int) -> str:
-        """Rewrite "{pod-name}:{port}" and bare pod-name references of known
-        pods to their localhost address."""
+        """Rewrite "{pod-name}:{port}" references of known pods to their
+        localhost address. Bare pod names (no port) are left untouched —
+        every injected contract (TF_CONFIG, TPU_WORKER_HOSTNAMES,
+        coordinator address) carries explicit ports."""
         with self._lock:
             ports = dict(self._ports)
         for name, port in ports.items():
@@ -142,9 +145,25 @@ class LocalProcessExecutor:
 
     def _on_added(self, pod: dict[str, Any]) -> None:
         key = objects.key_of(pod)
+        uid = objects.uid_of(pod)
         with self._lock:
-            if key in self._procs:
-                return
+            existing = self._procs.get(key)
+            if existing is not None:
+                if existing.uid == uid:
+                    return
+                # Same name, new UID: the controller deleted and recreated
+                # this pod (ExitCode/slice restart) before the old process
+                # finished dying. Retire the old incarnation and launch the
+                # new one — keying by UID is what prevents the recreated pod
+                # from being wedged Pending forever.
+                existing.deleted = True
+            else:
+                existing = None
+        if existing is not None:
+            self._kill(existing)
+            with self._lock:
+                if self._procs.get(key) is existing:
+                    self._procs.pop(key)
         self._ensure_job_ports(pod)
         self._launch(pod, restart_count=0)
 
@@ -167,6 +186,15 @@ class LocalProcessExecutor:
                 default_port = int(p.get("containerPort", default_port))
 
         env = dict(os.environ)
+        # Children must resolve the framework package regardless of the
+        # parent's cwd (pytest may run from anywhere; stderr is DEVNULL'd so
+        # an import failure would be invisible).
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
         env["PORT"] = str(port)
         for item in container.get("env", []):
             if "value" in item:
@@ -183,20 +211,35 @@ class LocalProcessExecutor:
             self._fail_pod(pod, 127, f"spawn failed: {e}")
             return
 
-        running = _Running(process=proc, port=port, restart_count=restart_count)
+        running = _Running(
+            process=proc,
+            port=port,
+            uid=objects.uid_of(pod),
+            restart_count=restart_count,
+        )
         with self._lock:
             self._procs[key] = running
-        # Close the relaunch/delete race: if the pod vanished while we were
-        # spawning, kill the fresh process instead of leaking an orphan.
+        # Close the relaunch/delete race: if the pod vanished (or was
+        # replaced by a new incarnation) while we were spawning, kill the
+        # fresh process instead of leaking an orphan.
+        gone = False
         try:
-            self._client.get(objects.PODS, objects.namespace_of(pod), objects.name_of(pod))
+            current = self._client.get(
+                objects.PODS, objects.namespace_of(pod), objects.name_of(pod)
+            )
+            gone = objects.uid_of(current) != running.uid
         except NotFound:
+            gone = True
+        if gone:
             running.deleted = True
             self._kill(running)
             with self._lock:
-                self._procs.pop(key, None)
+                if self._procs.get(key) is running:
+                    self._procs.pop(key)
             return
-        self._set_phase(pod, objects.RUNNING, restart_count=restart_count)
+        self._set_phase(
+            pod, objects.RUNNING, restart_count=restart_count, expect_uid=running.uid
+        )
         threading.Thread(
             target=self._wait, args=(pod, running), daemon=True
         ).start()
@@ -204,23 +247,32 @@ class LocalProcessExecutor:
     def _wait(self, pod: dict[str, Any], running: _Running) -> None:
         code = running.process.wait()
         key = objects.key_of(pod)
+        with self._lock:
+            if self._procs.get(key) is running:
+                self._procs.pop(key)
         if running.deleted:
-            with self._lock:
-                self._procs.pop(key, None)
             return
         policy = pod.get("spec", {}).get("restartPolicy", "Never")
         should_restart = policy == "Always" or (policy == "OnFailure" and code != 0)
-        with self._lock:
-            self._procs.pop(key, None)
         if should_restart and self._stop is not None and not self._stop.is_set():
-            try:  # pod may be gone by now
-                self._client.get(objects.PODS, objects.namespace_of(pod), objects.name_of(pod))
+            try:  # pod may be gone or recreated (new UID) by now
+                fresh = self._client.get(
+                    objects.PODS, objects.namespace_of(pod), objects.name_of(pod)
+                )
             except NotFound:
+                return
+            if objects.uid_of(fresh) != running.uid:
                 return
             self._launch(pod, restart_count=running.restart_count + 1)
             return
         phase = objects.SUCCEEDED if code == 0 else objects.FAILED
-        self._set_phase(pod, phase, exit_code=code, restart_count=running.restart_count)
+        self._set_phase(
+            pod,
+            phase,
+            exit_code=code,
+            restart_count=running.restart_count,
+            expect_uid=running.uid,
+        )
 
     def _on_deleted(self, pod: dict[str, Any]) -> None:
         # NOTE: the name→port mapping is deliberately kept. A controller-
@@ -229,8 +281,13 @@ class LocalProcessExecutor:
         # the stable-port mapping is the localhost analog of stable service
         # DNS names (replicas.go:151-162).
         key = objects.key_of(pod)
+        uid = objects.uid_of(pod)
         with self._lock:
             running = self._procs.get(key)
+            # Only retire the incarnation this DELETED event refers to; a
+            # recreated same-name pod (different UID) keeps running.
+            if running and uid and running.uid != uid:
+                running = None
             if running:
                 running.deleted = True
         if running:
@@ -256,11 +313,15 @@ class LocalProcessExecutor:
         phase: str,
         exit_code: int | None = None,
         restart_count: int = 0,
+        expect_uid: str | None = None,
     ) -> None:
         ns, name = objects.namespace_of(pod), objects.name_of(pod)
         try:
             fresh = self._client.get(objects.PODS, ns, name)
         except NotFound:
+            return
+        # Never write a dead incarnation's exit status onto a recreated pod.
+        if expect_uid and objects.uid_of(fresh) != expect_uid:
             return
         objects.set_pod_phase(fresh, phase)
         if exit_code is not None:

@@ -111,7 +111,12 @@ def make_lm_train_step(
     donate: bool = True,
 ):
     """Train step for the transformer: batch over dp, sequence over sp (ring
-    attention inside the model), params sharded per `param_shardings` (tp)."""
+    attention inside the model). Params are placed by the caller
+    (shard_params_by_rules); optionally pass ``param_shardings`` (a
+    NamedSharding pytree matching params, e.g. from sharding_tree_by_rules)
+    to pin the tp placement inside the step — updated params are constrained
+    to it so drift toward replication is impossible even if the optimizer
+    update would otherwise change placement."""
 
     def loss_fn(params, batch):
         logits = model.apply({"params": params}, batch["tokens"])
@@ -121,6 +126,10 @@ def make_lm_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if param_shardings is not None:
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, param_shardings
+            )
         return (
             state.replace(step=state.step + 1, params=new_params, opt_state=new_opt),
             {"loss": loss},
