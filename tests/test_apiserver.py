@@ -1,0 +1,132 @@
+"""Tests for the HTTP API server + REST client pair: CRUD/status/patch
+round-trips, error mapping, label-selector lists, and streamed watches —
+the process boundary every reference call stack crosses (SURVEY.md §3)."""
+
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.apiserver import ApiServer, parse_label_selector
+from tf_operator_tpu.runtime.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+)
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+
+@pytest.fixture()
+def server():
+    backend = InMemoryCluster()
+    srv = ApiServer(backend, port=0)
+    srv.start()
+    yield srv, backend
+    srv.stop()
+
+
+@pytest.fixture()
+def rest(server):
+    srv, _ = server
+    return RestClusterClient(f"http://127.0.0.1:{srv.port}")
+
+
+def test_parse_label_selector():
+    assert parse_label_selector("a=1,b=x") == {"a": "1", "b": "x"}
+    assert parse_label_selector("") == {}
+    with pytest.raises(ValueError):
+        parse_label_selector("oops")
+
+
+def test_create_get_list_delete(rest):
+    pod = objects.new_pod("p1", labels={"app": "x"})
+    created = rest.create(objects.PODS, pod)
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+
+    got = rest.get(objects.PODS, "default", "p1")
+    assert got["metadata"]["name"] == "p1"
+
+    rest.create(objects.PODS, objects.new_pod("p2", labels={"app": "y"}))
+    assert len(rest.list(objects.PODS)) == 2
+    assert len(rest.list(objects.PODS, label_selector={"app": "x"})) == 1
+    assert len(rest.list(objects.PODS, "other")) == 0
+
+    rest.delete(objects.PODS, "default", "p1")
+    with pytest.raises(NotFound):
+        rest.get(objects.PODS, "default", "p1")
+
+
+def test_error_mapping(rest):
+    pod = objects.new_pod("dup")
+    rest.create(objects.PODS, pod)
+    with pytest.raises(AlreadyExists):
+        rest.create(objects.PODS, objects.new_pod("dup"))
+    with pytest.raises(NotFound):
+        rest.delete(objects.PODS, "default", "nope")
+
+
+def test_update_conflict_via_rest(rest):
+    created = rest.create(objects.PODS, objects.new_pod("cas"))
+    stale = dict(created)
+    fresh = rest.get(objects.PODS, "default", "cas")
+    fresh["status"]["phase"] = objects.RUNNING
+    rest.update(objects.PODS, fresh)
+    # Stale resourceVersion must conflict through the wire too.
+    stale["status"] = {"phase": objects.FAILED}
+    with pytest.raises(Conflict):
+        rest.update(objects.PODS, stale)
+
+
+def test_update_status_subresource(rest):
+    created = rest.create(
+        objects.PODS, objects.new_pod("st", containers=[{"name": "c", "image": "i"}])
+    )
+    created["status"]["phase"] = objects.RUNNING
+    created["spec"]["containers"] = []  # must NOT be applied by status update
+    updated = rest.update_status(objects.PODS, created)
+    assert updated["status"]["phase"] == objects.RUNNING
+    assert updated["spec"]["containers"]  # spec untouched
+
+
+def test_patch_merge(rest):
+    rest.create(objects.PODS, objects.new_pod("pm", labels={"a": "1"}))
+    patched = rest.patch_merge(
+        objects.PODS, "default", "pm", {"metadata": {"labels": {"b": "2"}}}
+    )
+    assert patched["metadata"]["labels"] == {"a": "1", "b": "2"}
+
+
+def test_watch_stream(rest):
+    watch = rest.watch(objects.PODS)
+    time.sleep(0.3)  # let the stream connect
+    rest.create(objects.PODS, objects.new_pod("w1"))
+    ev = watch.next(timeout=5)
+    assert ev is not None and ev.type == ADDED
+    assert ev.object["metadata"]["name"] == "w1"
+
+    got = rest.get(objects.PODS, "default", "w1")
+    got["status"]["phase"] = objects.RUNNING
+    rest.update(objects.PODS, got)
+    ev = watch.next(timeout=5)
+    assert ev is not None and ev.type == MODIFIED
+
+    rest.delete(objects.PODS, "default", "w1")
+    ev = watch.next(timeout=5)
+    assert ev is not None and ev.type == DELETED
+    rest.stop_watch(watch)
+
+
+def test_watch_namespace_filter(rest):
+    watch = rest.watch(objects.PODS, "nsa")
+    time.sleep(0.3)
+    rest.create(objects.PODS, objects.new_pod("x", namespace="nsb"))
+    rest.create(objects.PODS, objects.new_pod("y", namespace="nsa"))
+    ev = watch.next(timeout=5)
+    assert ev is not None and ev.object["metadata"]["name"] == "y"
+    rest.stop_watch(watch)

@@ -1,0 +1,102 @@
+"""Leader-election tests: single-winner guarantee under racing candidates,
+failover on expiry, and clean release (reference server.go:140-152 analog,
+CAS-on-resourceVersion instead of an Endpoints lock)."""
+
+import threading
+import time
+
+from tf_operator_tpu.runtime.leader_election import (
+    LeaderElectionConfig,
+    LeaderElector,
+)
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+
+def make_elector(client, ident, cfg, log):
+    def on_start(leading_stop):
+        log.append(("start", ident))
+        leading_stop.wait()
+
+    def on_stop():
+        log.append(("stop", ident))
+
+    return LeaderElector(client, ident, on_start, on_stop, cfg)
+
+
+def test_single_winner():
+    client = InMemoryCluster()
+    cfg = LeaderElectionConfig(lease_duration=2.0, renew_deadline=0.1, retry_period=0.1)
+    log = []
+    stops = [threading.Event() for _ in range(3)]
+    electors = [make_elector(client, f"cand-{i}", cfg, log) for i in range(3)]
+    threads = [
+        threading.Thread(target=e.run, args=(s,), daemon=True)
+        for e, s in zip(electors, stops)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    leaders = [e for e in electors if e.is_leader.is_set()]
+    assert len(leaders) == 1
+    for s in stops:
+        s.set()
+    for t in threads:
+        t.join(timeout=2)
+
+
+def test_failover_on_expiry():
+    client = InMemoryCluster()
+    cfg = LeaderElectionConfig(lease_duration=0.5, renew_deadline=0.1, retry_period=0.1)
+    log = []
+
+    stop_a = threading.Event()
+    a = make_elector(client, "a", cfg, log)
+    ta = threading.Thread(target=a.run, args=(stop_a,), daemon=True)
+    ta.start()
+    assert a.is_leader.wait(timeout=3)
+
+    # Kill A without release (simulated crash: stop its loop but don't call
+    # release) — B must take over after the lease expires.
+    stop_a.set()
+    ta.join(timeout=2)
+    # Undo the graceful release the loop performed: restore a live-looking
+    # lease owned by the dead candidate to simulate a crash.
+    lease = client.get("leases", cfg.namespace, cfg.lease_name)
+    lease["spec"]["holderIdentity"] = "a"
+    lease["spec"]["renewTime"] = time.time()
+    client.update("leases", lease)
+
+    stop_b = threading.Event()
+    b = make_elector(client, "b", cfg, log)
+    tb = threading.Thread(target=b.run, args=(stop_b,), daemon=True)
+    tb.start()
+    # Not immediately: the (fake) live lease blocks B…
+    time.sleep(0.2)
+    assert not b.is_leader.is_set()
+    # …until it expires.
+    assert b.is_leader.wait(timeout=3)
+    stop_b.set()
+    tb.join(timeout=2)
+
+
+def test_release_hands_off_quickly():
+    client = InMemoryCluster()
+    cfg = LeaderElectionConfig(lease_duration=30.0, renew_deadline=0.1, retry_period=0.1)
+    log = []
+    stop_a = threading.Event()
+    a = make_elector(client, "a", cfg, log)
+    ta = threading.Thread(target=a.run, args=(stop_a,), daemon=True)
+    ta.start()
+    assert a.is_leader.wait(timeout=3)
+    stop_a.set()  # graceful: release() zeroes renewTime
+    ta.join(timeout=2)
+
+    stop_b = threading.Event()
+    b = make_elector(client, "b", cfg, log)
+    tb = threading.Thread(target=b.run, args=(stop_b,), daemon=True)
+    tb.start()
+    # Despite the 30s lease, release lets B in immediately.
+    assert b.is_leader.wait(timeout=3)
+    stop_b.set()
+    tb.join(timeout=2)
+    assert ("start", "a") in log and ("start", "b") in log

@@ -1,0 +1,1 @@
+"""Process entry points: operator main + genjob load generator (§2.5)."""

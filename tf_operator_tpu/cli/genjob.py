@@ -1,0 +1,90 @@
+"""Load generator: create N synthetic TPUJobs for scale / gang-scheduling
+experiments.
+
+Parity: hack/genjob/genjob.go:30-92 (creates N TFJobs, optionally GPU,
+custom schedulerName). TPU-native twist: `--accelerator` attaches a TPU
+slice spec instead of a GPU resource limit, so the generated fleet
+exercises slice-granular gang scheduling.
+
+  python -m tf_operator_tpu.cli.genjob --master http://127.0.0.1:8080 -n 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import uuid
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.client import TPUJobClient
+from tf_operator_tpu.utils import logger
+
+
+def synthetic_job(
+    name: str,
+    namespace: str,
+    workers: int,
+    accelerator: str | None,
+    scheduler: str | None,
+    command: list[str] | None = None,
+) -> dict:
+    worker_spec: dict = {
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "name": constants.DEFAULT_CONTAINER_NAME,
+                        "image": "tpu-operator/test-server",
+                        "command": command
+                        or [sys.executable, "-m", "tf_operator_tpu.harness.test_server"],
+                    }
+                ]
+            }
+        },
+    }
+    if accelerator:
+        worker_spec["tpu"] = {"acceleratorType": accelerator}
+    else:
+        worker_spec["replicas"] = workers
+    spec: dict = {"replicaSpecs": {"Worker": worker_spec}}
+    if scheduler:
+        spec["scheduling"] = {"schedulerName": scheduler, "gang": True}
+    return {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": spec,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-genjob", description=__doc__)
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("-n", "--num", type=int, default=10, help="jobs to create")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--workers", type=int, default=2, help="workers per job")
+    p.add_argument("--accelerator", default=None,
+                   help="TPU slice per job, e.g. v5e-16 (overrides --workers)")
+    p.add_argument("--scheduler", default=None, help="schedulerName for gang pods")
+    p.add_argument("--prefix", default=None, help="job name prefix")
+    args = p.parse_args(argv)
+
+    logger.configure()
+    log = logger.with_fields(component="genjob")
+    from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+    cli = TPUJobClient(RestClusterClient(args.master))
+    prefix = args.prefix or f"genjob-{uuid.uuid4().hex[:5]}"
+    for i in range(args.num):
+        job = synthetic_job(
+            f"{prefix}-{i}", args.namespace, args.workers, args.accelerator,
+            args.scheduler,
+        )
+        cli.create(job)
+    log.info("created %d TPUJobs with prefix %s", args.num, prefix)
+    print(prefix)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""The operator process entry point.
+
+Parity: cmd/tf-operator.v2/{main.go,app/server.go,app/options/options.go} —
+flags, signal handling, leader election, controller startup. Re-designed as
+a self-hosting runtime: `--serve` exposes the backing store over HTTP
+(runtime/apiserver.py) so remote clients/dashboard/harness connect to this
+process the way the reference's clients connect to the K8s apiserver, and
+`--local-executor` turns pods into real OS processes (the single-node mode).
+
+  # all-in-one local runtime with REST API on :8080 and real processes:
+  python -m tf_operator_tpu.cli.operator --serve 8080 --local-executor
+
+  # controller-only against a remote runtime:
+  python -m tf_operator_tpu.cli.operator --master http://host:8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+import threading
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime.leader_election import LeaderElectionConfig, LeaderElector
+from tf_operator_tpu.utils import logger, signals
+from tf_operator_tpu.version import version_string
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="TPU-native training-job operator (tf-operator rebuilt TPU-first)",
+    )
+    # Parity: options.go:22-51 (threadiness, gang, json-log, namespace).
+    p.add_argument("--namespace", default=None,
+                   help="restrict reconciliation to one namespace (default: all)")
+    p.add_argument("--threadiness", type=int, default=2,
+                   help="concurrent sync workers")
+    p.add_argument("--reconcile-period", type=float, default=15.0,
+                   help="periodic resync seconds (reference: 15s)")
+    p.add_argument("--informer-resync", type=float, default=30.0,
+                   help="informer relist seconds (reference: 30s)")
+    p.add_argument("--enable-gang-scheduling", dest="gang", action="store_true",
+                   default=True)
+    p.add_argument("--disable-gang-scheduling", dest="gang", action="store_false")
+    p.add_argument("--json-log", action="store_true", help="structured JSON logs")
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    # Runtime wiring (replaces --kubeconfig: the backing store is either
+    # in-process or a remote runtime's REST API).
+    p.add_argument("--master", default=None,
+                   help="URL of a remote runtime API server; default: in-process store")
+    p.add_argument("--serve", type=int, default=None, metavar="PORT",
+                   help="expose the in-process store over HTTP on PORT")
+    p.add_argument("--serve-host", default="127.0.0.1")
+    p.add_argument("--local-executor", action="store_true",
+                   help="run pods as local OS processes (single-node mode)")
+    # Leader election (server.go:140-152).
+    p.add_argument("--leader-elect", action="store_true", default=False)
+    p.add_argument("--lease-namespace",
+                   default=os.environ.get(constants.ENV_OPERATOR_NAMESPACE,
+                                          constants.DEFAULT_OPERATOR_NAMESPACE))
+    p.add_argument("--lease-duration", type=float, default=15.0)
+    p.add_argument("--renew-deadline", type=float, default=5.0)
+    p.add_argument("--retry-period", type=float, default=3.0)
+    p.add_argument("--dashboard", action="store_true",
+                   help="mount the dashboard UI/API on the --serve server")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(version_string())
+        return 0
+    logger.configure(json_format=args.json_log)
+    log = logger.with_fields(component="operator-main")
+    log.info("%s", version_string())
+
+    stop = signals.setup_signal_handler()
+
+    # --- backing store ------------------------------------------------------
+    if args.master:
+        from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+        client = RestClusterClient(args.master)
+        log.info("using remote runtime at %s", args.master)
+    else:
+        from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+        client = InMemoryCluster()
+
+    api_server = None
+    if args.serve is not None:
+        if args.master:
+            log.error("--serve requires the in-process store (drop --master)")
+            return 2
+        from tf_operator_tpu.runtime.apiserver import ApiServer
+
+        api_server = ApiServer(client, host=args.serve_host, port=args.serve)
+        if args.dashboard:
+            from tf_operator_tpu.dashboard.backend import mount_dashboard
+
+            mount_dashboard(api_server, client)
+        api_server.start()
+
+    # --- controller stack ---------------------------------------------------
+    cfg = JobControllerConfig(
+        reconcile_period=args.reconcile_period,
+        informer_resync=args.informer_resync,
+        enable_gang_scheduling=args.gang,
+        namespace=args.namespace,
+        threadiness=args.threadiness,
+    )
+
+    extras: list[object] = []
+
+    def run_controller(leading_stop: threading.Event) -> None:
+        controller = TPUJobController(client, cfg)
+        if args.local_executor:
+            from tf_operator_tpu.runtime.executor import LocalProcessExecutor
+            from tf_operator_tpu.runtime.gc import OwnerGarbageCollector
+
+            executor = LocalProcessExecutor(client, args.namespace)
+            collector = OwnerGarbageCollector(client, args.namespace)
+            executor.start(leading_stop)
+            collector.start(leading_stop)
+            extras.append(executor)
+        controller.run(leading_stop)
+
+    if args.leader_elect:
+        identity = f"{socket.gethostname()}-{os.getpid()}"
+        elector = LeaderElector(
+            client,
+            identity,
+            on_started_leading=run_controller,
+            config=LeaderElectionConfig(
+                namespace=args.lease_namespace,
+                lease_duration=args.lease_duration,
+                renew_deadline=args.renew_deadline,
+                retry_period=args.retry_period,
+            ),
+        )
+        elector.run(stop)  # blocks until signal
+    else:
+        t = threading.Thread(target=run_controller, args=(stop,), daemon=True)
+        t.start()
+        stop.wait()
+
+    log.info("shutting down")
+    if api_server is not None:
+        api_server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

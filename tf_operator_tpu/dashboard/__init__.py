@@ -1,0 +1,5 @@
+"""REST dashboard: backend API + static SPA frontend (reference §2.6)."""
+
+from tf_operator_tpu.dashboard.backend import DashboardBackend, mount_dashboard
+
+__all__ = ["DashboardBackend", "mount_dashboard"]

@@ -1,0 +1,187 @@
+"""Dashboard REST backend.
+
+Parity: dashboard/backend/handler/api_handler.go:75-199 + main.go:23-27 —
+list/get/create/delete TPUJobs, job detail with its pods (same label
+selector the reference uses, api_handler.go:162-164), pod logs, namespace
+list, namespace auto-create on deploy; plus the static frontend. Mounts
+onto runtime/apiserver.py's extra-handler hook instead of running its own
+listener, so one port serves both the raw resource API and the dashboard.
+
+Routes (all under /tpujobs/api, mirroring the reference's URL space):
+  GET    /tpujobs/api/tpujob                     all jobs
+  GET    /tpujobs/api/tpujob/{ns}                jobs in namespace
+  GET    /tpujobs/api/tpujob/{ns}/{name}         job detail (+pods,+events)
+  POST   /tpujobs/api/tpujob                     deploy (creates ns if absent)
+  DELETE /tpujobs/api/tpujob/{ns}/{name}         delete
+  GET    /tpujobs/api/pod/{ns}/{name}/logs       container logs
+  GET    /tpujobs/api/namespace                  namespaces
+  GET    /                                       frontend (static files)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+from urllib.parse import unquote, urlparse
+
+from tf_operator_tpu.api import constants, helpers
+from tf_operator_tpu.runtime import objects, podlogs
+from tf_operator_tpu.runtime.client import AlreadyExists, ApiError, ClusterClient
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="dashboard")
+
+FRONTEND_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "frontend")
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript",
+    ".css": "text/css",
+    ".svg": "image/svg+xml",
+    ".ico": "image/x-icon",
+}
+
+
+class DashboardBackend:
+    def __init__(self, client: ClusterClient, frontend_dir: str = FRONTEND_DIR):
+        self._client = client
+        self._frontend = frontend_dir
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _send_json(req: Any, payload: Any, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _job_detail(self, ns: str, name: str) -> dict[str, Any]:
+        job = self._client.get(objects.TPUJOBS, ns, name)
+        selector = helpers.gen_labels(name)
+        pods = self._client.list(objects.PODS, ns, label_selector=selector)
+        services = self._client.list(objects.SERVICES, ns, label_selector=selector)
+        events = [
+            e
+            for e in self._client.list(objects.EVENTS, ns)
+            if e.get("involvedObject", {}).get("name", "").startswith(name)
+        ]
+        return {"tpujob": job, "pods": pods, "services": services, "events": events}
+
+    def _ensure_namespace(self, ns: str) -> None:
+        """api_handler.go:189-199: create the namespace when deploying into
+        one that doesn't exist yet."""
+        try:
+            self._client.create(
+                objects.NAMESPACES,
+                {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ns, "namespace": ns}},
+            )
+        except AlreadyExists:
+            pass
+
+    # -- request handling ----------------------------------------------------
+
+    def __call__(self, req: Any) -> bool:
+        """apiserver extra-handler: returns True when the request was ours."""
+        url = urlparse(req.path)
+        parts = [unquote(p) for p in url.path.strip("/").split("/") if p]
+        try:
+            if parts[:2] == ["tpujobs", "api"]:
+                return self._handle_api(req, parts[2:])
+            if req.command == "GET":
+                return self._handle_static(req, parts)
+        except ApiError as e:
+            self._send_json(
+                req, {"error": type(e).__name__, "message": str(e)}, getattr(e, "code", 500)
+            )
+            return True
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(req, {"error": "BadRequest", "message": str(e)}, 400)
+            return True
+        return False
+
+    def _handle_api(self, req: Any, parts: list[str]) -> bool:
+        method = req.command
+        if not parts:
+            return False
+        head, rest = parts[0], parts[1:]
+
+        if head == "tpujob":
+            if method == "GET":
+                if len(rest) == 0:
+                    self._send_json(req, {"items": self._client.list(objects.TPUJOBS)})
+                elif len(rest) == 1:
+                    self._send_json(
+                        req, {"items": self._client.list(objects.TPUJOBS, rest[0])}
+                    )
+                elif len(rest) == 2:
+                    self._send_json(req, self._job_detail(rest[0], rest[1]))
+                else:
+                    return False
+                return True
+            if method == "POST" and len(rest) == 0:
+                length = int(req.headers.get("Content-Length", 0))
+                body = json.loads(req.rfile.read(length)) if length else {}
+                ns = body.get("metadata", {}).get("namespace", "default")
+                self._ensure_namespace(ns)
+                created = self._client.create(objects.TPUJOBS, body)
+                self._send_json(req, created, 201)
+                return True
+            if method == "DELETE" and len(rest) == 2:
+                self._client.delete(objects.TPUJOBS, rest[0], rest[1])
+                self._send_json(req, {"status": "Success"})
+                return True
+            return False
+
+        if head == "pod" and method == "GET" and len(rest) == 3 and rest[2] == "logs":
+            text = podlogs.read_log(rest[0], rest[1])
+            if text is None:
+                self._send_json(
+                    req, {"error": "NotFound", "message": "no logs spooled"}, 404
+                )
+            else:
+                self._send_json(req, {"logs": text})
+            return True
+
+        if head == "namespace" and method == "GET":
+            names = sorted(
+                {objects.name_of(n) for n in self._client.list(objects.NAMESPACES)}
+                | {
+                    objects.namespace_of(j)
+                    for j in self._client.list(objects.TPUJOBS)
+                }
+                | {"default"}
+            )
+            self._send_json(req, {"items": names})
+            return True
+
+        return False
+
+    def _handle_static(self, req: Any, parts: list[str]) -> bool:
+        rel = "/".join(parts) or "index.html"
+        path = os.path.normpath(os.path.join(self._frontend, rel))
+        if not path.startswith(os.path.abspath(self._frontend)):
+            return False
+        if not os.path.isfile(path):
+            # SPA fallback: unknown non-API paths render the app shell.
+            path = os.path.join(self._frontend, "index.html")
+            if not os.path.isfile(path):
+                return False
+        ext = os.path.splitext(path)[1]
+        with open(path, "rb") as f:
+            body = f.read()
+        req.send_response(200)
+        req.send_header("Content-Type", _CONTENT_TYPES.get(ext, "application/octet-stream"))
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+        return True
+
+
+def mount_dashboard(api_server: Any, client: ClusterClient) -> DashboardBackend:
+    backend = DashboardBackend(client)
+    api_server.add_handler(backend)
+    LOG.info("dashboard mounted at / and /tpujobs/api")
+    return backend

@@ -1,0 +1,277 @@
+/* TPU Job Operator dashboard SPA.
+ *
+ * Hash-routed views over /tpujobs/api (the reference's services.js REST
+ * surface): #/ job list, #/job/{ns}/{name} detail with pods + events +
+ * log viewer, #/create deploy form. Polls the list/detail every 3 s.
+ */
+"use strict";
+
+const app = document.getElementById("app");
+const nsSelect = document.getElementById("ns-select");
+let pollTimer = null;
+
+// ---------- api ----------
+async function api(path, opts) {
+  const resp = await fetch("/tpujobs/api" + path, opts);
+  const body = await resp.json().catch(() => ({}));
+  if (!resp.ok) throw new Error(body.message || resp.statusText);
+  return body;
+}
+
+// ---------- helpers ----------
+function h(tag, attrs, ...children) {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "onclick") el.addEventListener("click", v);
+    else if (k === "class") el.className = v;
+    else el.setAttribute(k, v);
+  }
+  for (const c of children.flat()) {
+    if (c == null) continue;
+    el.append(c.nodeType ? c : document.createTextNode(String(c)));
+  }
+  return el;
+}
+
+function activeConditions(job) {
+  return (job.status?.conditions || []).filter((c) => c.status === "True");
+}
+
+function phaseBadge(job) {
+  const conds = activeConditions(job).map((c) => c.type);
+  const order = ["Failed", "Succeeded", "Restarting", "Running", "Created"];
+  const top = order.find((t) => conds.includes(t)) || "Created";
+  return h("span", { class: "badge " + top }, top);
+}
+
+function replicaSummary(job) {
+  const rs = job.status?.replicaStatuses || {};
+  return Object.entries(rs)
+    .map(([t, s]) => `${t} ${s.active || 0}/${s.succeeded || 0}/${s.failed || 0}`)
+    .join(" · ");
+}
+
+function setPoll(fn) {
+  if (pollTimer) clearInterval(pollTimer);
+  pollTimer = setInterval(fn, 3000);
+}
+
+// ---------- views ----------
+async function jobListView() {
+  const ns = nsSelect.value;
+  const data = await api(ns && ns !== "*" ? `/tpujob/${ns}` : "/tpujob");
+  const rows = (data.items || []).map((job) => {
+    const m = job.metadata;
+    return h(
+      "tr",
+      {
+        class: "clickable",
+        onclick: () => (location.hash = `#/job/${m.namespace}/${m.name}`),
+      },
+      h("td", {}, m.namespace),
+      h("td", {}, m.name),
+      h("td", {}, phaseBadge(job)),
+      h("td", {}, replicaSummary(job) || "—"),
+      h("td", { class: "muted" }, m.creationTimestamp || "")
+    );
+  });
+  app.replaceChildren(
+    h("div", { class: "toolbar" }, h("h2", {}, "TPUJobs"), ""),
+    h(
+      "table",
+      {},
+      h(
+        "thead",
+        {},
+        h("tr", {}, ...["Namespace", "Name", "State", "Active/Done/Failed", "Created"].map((t) => h("th", {}, t)))
+      ),
+      h("tbody", {}, rows.length ? rows : h("tr", {}, h("td", { class: "muted", colspan: 5 }, "No jobs")))
+    )
+  );
+}
+
+async function jobDetailView(ns, name) {
+  const d = await api(`/tpujob/${ns}/${name}`);
+  const job = d.tpujob;
+  const conds = (job.status?.conditions || []).map((c) =>
+    h(
+      "tr",
+      {},
+      h("td", {}, c.type),
+      h("td", {}, c.status),
+      h("td", {}, c.reason || ""),
+      h("td", { class: "muted" }, c.message || ""),
+      h("td", { class: "muted" }, c.lastTransitionTime || "")
+    )
+  );
+  const pods = (d.pods || []).map((p) =>
+    h(
+      "tr",
+      {},
+      h("td", {}, p.metadata.name),
+      h("td", {}, h("span", { class: "badge " + (p.status?.phase || "") }, p.status?.phase || "?")),
+      h("td", {}, (p.status?.containerStatuses || []).map((cs) => `restarts:${cs.restartCount ?? 0}`).join(" ")),
+      h(
+        "td",
+        {},
+        h("button", { class: "ghost", onclick: () => showLogs(ns, p.metadata.name) }, "logs")
+      )
+    )
+  );
+  const events = (d.events || []).slice(-20).map((e) =>
+    h(
+      "tr",
+      {},
+      h("td", {}, e.type || ""),
+      h("td", {}, e.reason || ""),
+      h("td", { class: "muted" }, e.message || ""),
+      h("td", { class: "muted" }, e.involvedObject?.name || "")
+    )
+  );
+  app.replaceChildren(
+    h(
+      "div",
+      { class: "toolbar" },
+      h("h2", {}, `${ns}/${name} `, phaseBadge(job)),
+      h(
+        "button",
+        {
+          class: "danger",
+          onclick: async () => {
+            if (confirm(`Delete TPUJob ${ns}/${name}?`)) {
+              await api(`/tpujob/${ns}/${name}`, { method: "DELETE" });
+              location.hash = "#/";
+            }
+          },
+        },
+        "Delete"
+      )
+    ),
+    h(
+      "div",
+      { class: "row" },
+      h(
+        "div",
+        { class: "card" },
+        h("h2", {}, "Conditions"),
+        h("table", {}, h("tbody", {}, conds.length ? conds : h("tr", {}, h("td", { class: "muted" }, "none"))))
+      ),
+      h(
+        "div",
+        { class: "card" },
+        h("h2", {}, "Spec"),
+        h("pre", {}, JSON.stringify(job.spec, null, 2))
+      )
+    ),
+    h("div", { class: "card" }, h("h2", {}, "Pods"), h("table", {}, h("tbody", {}, pods.length ? pods : h("tr", {}, h("td", { class: "muted" }, "none"))))),
+    h("div", { class: "card" }, h("h2", {}, "Events"), h("table", {}, h("tbody", {}, events.length ? events : h("tr", {}, h("td", { class: "muted" }, "none"))))),
+    h("div", { id: "log-panel" })
+  );
+}
+
+async function showLogs(ns, podName) {
+  const panel = document.getElementById("log-panel");
+  try {
+    const d = await api(`/pod/${ns}/${podName}/logs`);
+    panel.replaceChildren(
+      h("div", { class: "card" }, h("h2", {}, `Logs — ${podName}`), h("pre", { class: "logs" }, d.logs || "(empty)"))
+    );
+  } catch (e) {
+    panel.replaceChildren(h("div", { class: "card" }, h("p", { class: "muted" }, `No logs: ${e.message}`)));
+  }
+}
+
+function createView() {
+  const form = h(
+    "form",
+    {},
+    h("label", {}, "Name"),
+    h("input", { name: "name", required: "", placeholder: "my-train-job" }),
+    h("label", {}, "Namespace"),
+    h("input", { name: "namespace", value: "default" }),
+    h("label", {}, "Worker replicas"),
+    h("input", { name: "workers", type: "number", value: "2", min: "1" }),
+    h("label", {}, "PS replicas (0 for none)"),
+    h("input", { name: "ps", type: "number", value: "0", min: "0" }),
+    h("label", {}, "TPU accelerator (optional, e.g. v5e-16 — overrides worker count)"),
+    h("input", { name: "accelerator", placeholder: "" }),
+    h("label", {}, "Image"),
+    h("input", { name: "image", value: "tpu-operator/test-server" }),
+    h("label", {}, "Command (JSON array, optional)"),
+    h("textarea", { name: "command", placeholder: '["python", "train.py"]' }),
+    h("div", { style: "margin-top:1rem" }, h("button", { type: "submit" }, "Deploy"))
+  );
+  form.addEventListener("submit", async (ev) => {
+    ev.preventDefault();
+    const f = new FormData(form);
+    const container = { name: "tensorflow", image: f.get("image") };
+    const cmd = (f.get("command") || "").trim();
+    if (cmd) container.command = JSON.parse(cmd);
+    const worker = { template: { spec: { containers: [container] } } };
+    if (f.get("accelerator")) worker.tpu = { acceleratorType: f.get("accelerator") };
+    else worker.replicas = parseInt(f.get("workers"), 10);
+    const replicaSpecs = { Worker: worker };
+    const ps = parseInt(f.get("ps"), 10);
+    if (ps > 0)
+      replicaSpecs.PS = {
+        replicas: ps,
+        template: { spec: { containers: [{ ...container }] } },
+      };
+    const job = {
+      apiVersion: "tpuflow.org/v1",
+      kind: "TPUJob",
+      metadata: { name: f.get("name"), namespace: f.get("namespace") || "default" },
+      spec: { replicaSpecs },
+    };
+    try {
+      await api("/tpujob", {
+        method: "POST",
+        headers: { "Content-Type": "application/json" },
+        body: JSON.stringify(job),
+      });
+      location.hash = `#/job/${job.metadata.namespace}/${job.metadata.name}`;
+    } catch (e) {
+      alert("Deploy failed: " + e.message);
+    }
+  });
+  app.replaceChildren(h("div", { class: "card" }, h("h2", {}, "Create TPUJob"), form));
+}
+
+// ---------- router ----------
+async function refreshNamespaces() {
+  try {
+    const d = await api("/namespace");
+    const current = nsSelect.value || "*";
+    nsSelect.replaceChildren(
+      h("option", { value: "*" }, "all namespaces"),
+      ...(d.items || []).map((n) => h("option", { value: n }, n))
+    );
+    nsSelect.value = current;
+  } catch (e) {
+    /* server restarting */
+  }
+}
+
+async function route() {
+  const parts = location.hash.replace(/^#\/?/, "").split("/").filter(Boolean);
+  try {
+    if (parts[0] === "create") {
+      if (pollTimer) clearInterval(pollTimer);
+      createView();
+    } else if (parts[0] === "job" && parts.length === 3) {
+      await jobDetailView(parts[1], parts[2]);
+      setPoll(() => jobDetailView(parts[1], parts[2]).catch(() => {}));
+    } else {
+      await jobListView();
+      setPoll(() => jobListView().catch(() => {}));
+    }
+  } catch (e) {
+    app.replaceChildren(h("div", { class: "card" }, h("p", { class: "muted" }, "Error: " + e.message)));
+  }
+}
+
+window.addEventListener("hashchange", route);
+nsSelect.addEventListener("change", route);
+refreshNamespaces();
+setInterval(refreshNamespaces, 10000);
+route();

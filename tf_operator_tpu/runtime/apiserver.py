@@ -1,0 +1,250 @@
+"""HTTP API server: exposes a ClusterClient over REST.
+
+The process boundary of the framework (the role the K8s apiserver plays in
+every call stack of SURVEY.md §3): the operator CLI runs this in front of
+its backing store so remote clients — the dashboard frontend, the Python
+TPUJobClient via runtime/restclient.py, genjob, the E2E harness — speak one
+wire protocol. Shapes follow K8s REST conventions:
+
+  GET    /api/{kind}                         list (all namespaces)
+  GET    /api/{kind}?namespace=ns&labelSelector=k%3Dv,...   filtered list
+  GET    /api/{kind}?watch=1[&namespace=ns]  watch (streamed JSON lines)
+  POST   /api/{kind}                         create
+  GET    /api/{kind}/{ns}/{name}             get
+  PUT    /api/{kind}/{ns}/{name}             update (resourceVersion CAS)
+  PUT    /api/{kind}/{ns}/{name}/status      status-subresource update
+  PATCH  /api/{kind}/{ns}/{name}             JSON merge patch
+  DELETE /api/{kind}/{ns}/{name}             delete
+
+Errors map to the ApiError hierarchy: 404 NotFound, 409 AlreadyExists/
+Conflict, 422 Invalid — the same codes a real apiserver returns, so
+restclient raises the identical exceptions either way.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, unquote, urlparse
+
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="apiserver")
+
+
+def parse_label_selector(raw: str) -> dict[str, str]:
+    """Parse "k=v,k2=v2" (the equality subset the framework uses)."""
+    out: dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad label selector term: {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "ApiServer"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_json(self, payload: Any, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_obj(self, e: Exception) -> None:
+        code = getattr(e, "code", 500)
+        self._send_json({"error": type(e).__name__, "message": str(e)}, code=code)
+
+    def _read_body(self) -> dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0))
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _route(self) -> tuple[str | None, list[str], dict[str, list[str]]]:
+        url = urlparse(self.path)
+        parts = [unquote(p) for p in url.path.strip("/").split("/") if p]
+        query = parse_qs(url.query)
+        if not parts or parts[0] != "api":
+            return None, [], query
+        return "api", parts[1:], query
+
+    def _q(self, query: dict[str, list[str]], key: str) -> str | None:
+        vals = query.get(key)
+        return vals[0] if vals else None
+
+    # -- methods ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        root, parts, query = self._route()
+        if root is None:
+            handled = self.server.handle_extra(self)
+            if not handled:
+                self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        try:
+            if len(parts) == 1:
+                kind = parts[0]
+                if self._q(query, "watch"):
+                    self._serve_watch(kind, self._q(query, "namespace"))
+                    return
+                selector = None
+                raw_sel = self._q(query, "labelSelector")
+                if raw_sel:
+                    selector = parse_label_selector(raw_sel)
+                items = self.server.backend.list(
+                    kind, self._q(query, "namespace"), selector
+                )
+                self._send_json({"items": items})
+            elif len(parts) == 3:
+                self._send_json(self.server.backend.get(parts[0], parts[1], parts[2]))
+            else:
+                self._send_json({"error": "NotFound", "message": self.path}, 404)
+        except ApiError as e:
+            self._send_error_obj(e)
+        except ValueError as e:
+            self._send_json({"error": "BadRequest", "message": str(e)}, 400)
+
+    def do_POST(self) -> None:  # noqa: N802
+        root, parts, _ = self._route()
+        if root is None:
+            if not self.server.handle_extra(self):
+                self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        if len(parts) != 1:
+            self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        try:
+            self._send_json(self.server.backend.create(parts[0], self._read_body()), 201)
+        except ApiError as e:
+            self._send_error_obj(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json({"error": "BadRequest", "message": str(e)}, 400)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        root, parts, _ = self._route()
+        try:
+            if root is not None and len(parts) == 3:
+                self._send_json(self.server.backend.update(parts[0], self._read_body()))
+            elif root is not None and len(parts) == 4 and parts[3] == "status":
+                self._send_json(
+                    self.server.backend.update_status(parts[0], self._read_body())
+                )
+            else:
+                self._send_json({"error": "NotFound", "message": self.path}, 404)
+        except ApiError as e:
+            self._send_error_obj(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json({"error": "BadRequest", "message": str(e)}, 400)
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        root, parts, _ = self._route()
+        if root is None or len(parts) != 3:
+            self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        try:
+            self._send_json(
+                self.server.backend.patch_merge(
+                    parts[0], parts[1], parts[2], self._read_body()
+                )
+            )
+        except ApiError as e:
+            self._send_error_obj(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json({"error": "BadRequest", "message": str(e)}, 400)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        root, parts, _ = self._route()
+        if root is None:
+            if not self.server.handle_extra(self):
+                self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        if len(parts) != 3:
+            self._send_json({"error": "NotFound", "message": self.path}, 404)
+            return
+        try:
+            self.server.backend.delete(parts[0], parts[1], parts[2])
+            self._send_json({"status": "Success"})
+        except ApiError as e:
+            self._send_error_obj(e)
+
+    # -- watch streaming ----------------------------------------------------
+
+    def _serve_watch(self, kind: str, namespace: str | None) -> None:
+        """Stream watch events as newline-delimited JSON (chunked)."""
+        watch = self.server.backend.watch(kind, namespace)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            while not self.server.stopping.is_set():
+                event = watch.next(timeout=1.0)
+                if event is None:
+                    write_chunk(b"\n")  # heartbeat keeps dead clients detectable
+                    continue
+                line = json.dumps({"type": event.type, "object": event.object})
+                write_chunk(line.encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                self.server.backend.stop_watch(watch)  # type: ignore[attr-defined]
+            except Exception:
+                pass
+
+    def log_message(self, fmt: str, *args) -> None:  # route through our logger
+        LOG.debug(fmt, *args)
+
+
+class ApiServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, backend: ClusterClient, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.backend = backend
+        self.stopping = threading.Event()
+        # Additional handlers (the dashboard mounts itself here).
+        self._extra_handlers: list[Any] = []
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def add_handler(self, handler: Any) -> None:
+        """handler(request) -> bool; first one returning True wins. Used by
+        the dashboard to mount /tpujobs/api/* and the static frontend."""
+        self._extra_handlers.append(handler)
+
+    def handle_extra(self, request: BaseHTTPRequestHandler) -> bool:
+        for h in self._extra_handlers:
+            if h(request):
+                return True
+        return False
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, name="apiserver", daemon=True)
+        t.start()
+        LOG.info("serving on %s:%d", *self.server_address)
+        return t
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self.shutdown()

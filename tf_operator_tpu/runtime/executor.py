@@ -32,7 +32,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from tf_operator_tpu.api import constants
-from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime import objects, podlogs
 from tf_operator_tpu.runtime.client import ADDED, DELETED, ClusterClient, NotFound
 from tf_operator_tpu.utils import logger
 
@@ -200,16 +200,31 @@ class LocalProcessExecutor:
             if "value" in item:
                 env[item["name"]] = self._rewrite(str(item["value"]), default_port)
 
+        # Container output goes to the log spool (runtime/podlogs.py) so the
+        # dashboard's log endpoint and post-mortem debugging can see it.
+        log_file = None
+        try:
+            log_file = open(
+                podlogs.log_path(
+                    objects.namespace_of(pod), name, objects.uid_of(pod)
+                ),
+                "ab",
+            )
+        except OSError:
+            pass
         try:
             proc = subprocess.Popen(
                 command,
                 env=env,
-                stdout=subprocess.DEVNULL,
-                stderr=subprocess.DEVNULL,
+                stdout=log_file or subprocess.DEVNULL,
+                stderr=subprocess.STDOUT if log_file else subprocess.DEVNULL,
             )
         except OSError as e:
             self._fail_pod(pod, 127, f"spawn failed: {e}")
             return
+        finally:
+            if log_file is not None:
+                log_file.close()  # the child holds its own fd
 
         running = _Running(
             process=proc,

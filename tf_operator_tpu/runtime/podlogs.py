@@ -1,0 +1,45 @@
+"""Pod log spool: where the local executor writes container output and
+where the dashboard reads it back.
+
+On a real cluster pod logs live with the kubelet and are served through the
+apiserver (the reference dashboard calls CoreV1 GetLogs,
+dashboard/backend/handler/api_handler.go:240). The local runtime's analog is
+a spool directory: one file per pod incarnation, newest wins.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def log_dir() -> str:
+    d = os.environ.get("TPU_OPERATOR_LOG_DIR") or os.path.join(
+        tempfile.gettempdir(), "tpu-operator-logs"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def log_path(namespace: str, name: str, uid: str) -> str:
+    safe_uid = (uid or "nouid")[:8]
+    return os.path.join(log_dir(), f"{namespace}_{name}_{safe_uid}.log")
+
+
+def read_log(namespace: str, name: str, max_bytes: int = 1 << 20) -> str | None:
+    """Newest incarnation's log tail, or None if nothing was spooled."""
+    prefix = f"{namespace}_{name}_"
+    d = log_dir()
+    candidates = [
+        os.path.join(d, f)
+        for f in os.listdir(d)
+        if f.startswith(prefix) and f.endswith(".log")
+    ]
+    if not candidates:
+        return None
+    newest = max(candidates, key=os.path.getmtime)
+    with open(newest, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        f.seek(max(0, size - max_bytes))
+        return f.read().decode(errors="replace")

@@ -1,0 +1,159 @@
+"""REST ClusterClient: talks to a runtime/apiserver.py over HTTP.
+
+The remote half of the process boundary: TPUJobClient, genjob, the E2E
+harness, and out-of-process controllers construct a RestClusterClient with
+the operator's URL and get the exact ClusterClient semantics the in-memory
+store provides — same error types (NotFound/AlreadyExists/Conflict/Invalid
+reconstructed from status codes + error names), same watch stream (chunked
+JSON lines pumped into a Watch by a reader thread).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+from urllib import error as urlerror
+from urllib import parse as urlparse_mod
+from urllib import request as urlrequest
+
+from tf_operator_tpu.runtime.client import (
+    AlreadyExists,
+    ApiError,
+    ClusterClient,
+    Conflict,
+    Invalid,
+    NotFound,
+    Watch,
+    WatchEvent,
+)
+
+_ERRORS = {
+    "NotFound": NotFound,
+    "AlreadyExists": AlreadyExists,
+    "Conflict": Conflict,
+    "Invalid": Invalid,
+}
+
+
+def _raise_for(err: urlerror.HTTPError) -> None:
+    try:
+        payload = json.loads(err.read())
+        cls = _ERRORS.get(payload.get("error", ""), ApiError)
+        raise cls(payload.get("message", str(err)))
+    except (ValueError, KeyError):
+        raise ApiError(str(err)) from err
+
+
+class RestClusterClient(ClusterClient):
+    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout
+        self._watches: dict[Watch, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _call(
+        self, method: str, path: str, body: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(
+            self._base + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"} if data else {},
+        )
+        try:
+            with urlrequest.urlopen(req, timeout=self._timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urlerror.HTTPError as e:
+            _raise_for(e)
+            raise  # unreachable; keeps type-checkers happy
+
+    # -- ClusterClient ------------------------------------------------------
+
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        return self._call("POST", f"/api/{kind}", obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        return self._call("GET", f"/api/{kind}/{namespace}/{name}")
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict[str, Any]]:
+        params: dict[str, str] = {}
+        if namespace is not None:
+            params["namespace"] = namespace
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items())
+            )
+        qs = ("?" + urlparse_mod.urlencode(params)) if params else ""
+        return self._call("GET", f"/api/{kind}{qs}")["items"]
+
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        meta = obj.get("metadata", {})
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        return self._call("PUT", f"/api/{kind}/{ns}/{name}", obj)
+
+    def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        meta = obj.get("metadata", {})
+        ns, name = meta.get("namespace", "default"), meta.get("name", "")
+        return self._call("PUT", f"/api/{kind}/{ns}/{name}/status", obj)
+
+    def patch_merge(
+        self, kind: str, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]:
+        return self._call("PATCH", f"/api/{kind}/{namespace}/{name}", patch)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._call("DELETE", f"/api/{kind}/{namespace}/{name}")
+
+    def watch(self, kind: str, namespace: str | None = None) -> Watch:
+        params: dict[str, str] = {"watch": "1"}
+        if namespace is not None:
+            params["namespace"] = namespace
+        url = f"{self._base}/api/{kind}?{urlparse_mod.urlencode(params)}"
+        watch = Watch()
+        stopped = threading.Event()
+        with self._lock:
+            self._watches[watch] = stopped
+
+        def reader() -> None:
+            try:
+                # No timeout: the server heartbeats; closing the response in
+                # stop_watch unblocks the read.
+                resp = urlrequest.urlopen(url)
+                watch._resp = resp  # for stop_watch to close
+                for raw in resp:
+                    if stopped.is_set():
+                        break
+                    line = raw.strip()
+                    if not line:
+                        continue  # heartbeat
+                    payload = json.loads(line)
+                    watch.push(WatchEvent(payload["type"], payload["object"]))
+            except Exception:
+                pass  # connection closed (stop_watch or server shutdown)
+            finally:
+                watch.stop()
+
+        threading.Thread(target=reader, daemon=True).start()
+        return watch
+
+    def stop_watch(self, watch: Watch) -> None:
+        with self._lock:
+            stopped = self._watches.pop(watch, None)
+        if stopped is not None:
+            stopped.set()
+        resp = getattr(watch, "_resp", None)
+        if resp is not None:
+            try:
+                resp.close()
+            except Exception:
+                pass
+        watch.stop()
