@@ -1,0 +1,113 @@
+"""Tests for the E2E harness: junit XML round-trip and the test-runner
+driver executed against a real operator subprocess — including fault
+injection through the published replica address (the terminateReplica
+analog)."""
+
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.harness import junit
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# junit (py/test_util.py parity)
+# ---------------------------------------------------------------------------
+
+def test_junit_xml_roundtrip(tmp_path):
+    ok = junit.TestCase(name="good")
+    junit.wrap_test(lambda: None, ok)
+    bad = junit.TestCase(name="bad")
+    with pytest.raises(RuntimeError):
+        junit.wrap_test(lambda: (_ for _ in ()).throw(RuntimeError("boom")), bad)
+    assert ok.passed and not bad.passed
+    assert "boom" in bad.failure
+
+    xml = junit.create_xml([ok, bad])
+    assert junit.get_num_failures(xml) == 1
+
+    out = tmp_path / "junit.xml"
+    junit.write_junit_xml([ok, bad], str(out))
+    assert junit.get_num_failures(out.read_text()) == 1
+
+
+# ---------------------------------------------------------------------------
+# test_runner against a real operator process
+# ---------------------------------------------------------------------------
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def operator():
+    port = free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tf_operator_tpu.cli.operator",
+            "--serve", str(port), "--local-executor",
+            "--reconcile-period", "0.3", "--informer-resync", "1.0",
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
+            break
+        except (urllib.error.URLError, ConnectionError):
+            if proc.poll() is not None:
+                raise RuntimeError("operator died at startup")
+            time.sleep(0.2)
+    yield base
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_runner_clean_completion(operator, tmp_path):
+    from tf_operator_tpu.harness import test_runner
+
+    out = tmp_path / "junit.xml"
+    rc = test_runner.main([
+        "--master", operator,
+        "--name", "tr-clean",
+        "--workers", "2",
+        "--trials", "2",
+        "--timeout", "60",
+        "--junit-path", str(out),
+    ])
+    assert rc == 0
+    xml = out.read_text()
+    assert junit.get_num_failures(xml) == 0
+    assert 'tests="2"' in xml
+
+
+def test_runner_worker_failure_marks_job_failed(operator):
+    from tf_operator_tpu.harness import test_runner
+
+    rc = test_runner.main([
+        "--master", operator,
+        "--name", "tr-fail",
+        "--workers", "2",
+        "--shutdown-policy", "worker",
+        "--exit-code", "1",
+        "--timeout", "60",
+    ])
+    assert rc == 0  # the trial EXPECTS Failed and passes when it sees it

@@ -1,0 +1,1 @@
+"""E2E harness: fake-workload server, test driver, junit reporting (§2.7)."""

@@ -1,0 +1,78 @@
+"""JUnit XML test reporting.
+
+Parity: py/test_util.py:15-187 (TestCase/TestSuite, create_xml,
+create_junit_xml_file, get_num_failures, wrap_test) — the artifact format CI
+systems consume from E2E runs.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class TestCase:
+    name: str = ""
+    class_name: str = "e2e"
+    time: float = 0.0
+    failure: str | None = None
+
+    @property
+    def passed(self) -> bool:
+        return self.failure is None
+
+
+@dataclass
+class TestSuite:
+    name: str = "tpujob-e2e"
+    cases: list[TestCase] = field(default_factory=list)
+
+
+def wrap_test(test_func: Callable[[], None], test_case: TestCase) -> None:
+    """Run test_func, recording wall time and any exception into test_case,
+    re-raising after recording (test_util.py:73-96 semantics)."""
+    start = time.monotonic()
+    try:
+        test_func()
+    except Exception:
+        test_case.failure = traceback.format_exc()
+        raise
+    finally:
+        test_case.time = time.monotonic() - start
+
+
+def create_xml(cases: list[TestCase], suite_name: str = "tpujob-e2e") -> str:
+    failures = sum(1 for c in cases if not c.passed)
+    root = ET.Element(
+        "testsuite",
+        name=suite_name,
+        tests=str(len(cases)),
+        failures=str(failures),
+        time=f"{sum(c.time for c in cases):.3f}",
+    )
+    for c in cases:
+        el = ET.SubElement(
+            root,
+            "testcase",
+            classname=c.class_name,
+            name=c.name,
+            time=f"{c.time:.3f}",
+        )
+        if c.failure is not None:
+            f = ET.SubElement(el, "failure", message="test failed")
+            f.text = c.failure
+    return ET.tostring(root, encoding="unicode")
+
+
+def write_junit_xml(cases: list[TestCase], output_path: str,
+                    suite_name: str = "tpujob-e2e") -> None:
+    with open(output_path, "w") as f:
+        f.write(create_xml(cases, suite_name))
+
+
+def get_num_failures(xml_string: str) -> int:
+    return int(ET.fromstring(xml_string).attrib.get("failures", "0"))

@@ -1,0 +1,305 @@
+"""E2E test driver: deploy a TPUJob, drive its lifecycle (including fault
+injection against a live replica), assert the outcome, emit JUnit XML.
+
+Parity: py/test_runner.py — the reference's CI driver (run_test:373-585):
+deploy via ksonnet, wait for Running, `terminateReplica` through the
+apiserver service proxy (:285-318), event-based pod/service accounting
+(:217-281), repeat trials, delete + wait-for-GC, junit output. This version
+drives any runtime exposing the framework's REST API; fault injection
+reaches the fake-workload server (harness/test_server.py) at the address
+the executor publishes in pod status (the service-proxy analog).
+
+  python -m tf_operator_tpu.harness.test_runner \
+      --master http://127.0.0.1:8080 --shutdown-policy worker \
+      --trials 2 --junit-path /tmp/junit.xml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.client import TPUJobClient
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ClusterClient
+from tf_operator_tpu.utils import logger
+
+from tf_operator_tpu.harness import junit
+
+LOG = logger.with_fields(component="test-runner")
+
+
+class TestFailure(AssertionError):
+    pass
+
+
+def _http_get_json(url: str, timeout: float = 10.0, retry_for: float = 10.0) -> dict:
+    """GET with retry on connection refusal: a pod can be Running before its
+    server has bound the port (same race the reference absorbs with its
+    retrying service-proxy polls)."""
+    deadline = time.monotonic() + retry_for
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return json.loads(resp.read())
+        except (ConnectionError, urllib.error.URLError) as e:
+            if time.monotonic() >= deadline:
+                raise TestFailure(f"GET {url} failed after retries: {e}") from e
+            time.sleep(0.2)
+
+
+# ---------------------------------------------------------------------------
+# Replica fault injection (terminateReplica analog)
+# ---------------------------------------------------------------------------
+
+def replica_address(
+    client: ClusterClient, namespace: str, job_name: str, rtype: str, index: int
+) -> tuple[str, int]:
+    """Address of one replica, from executor-published pod status."""
+    pods = client.list(
+        objects.PODS,
+        namespace,
+        label_selector={
+            constants.LABEL_JOB_NAME: job_name,
+            constants.LABEL_REPLICA_TYPE: rtype.lower(),
+            constants.LABEL_REPLICA_INDEX: str(index),
+        },
+    )
+    if not pods:
+        raise TestFailure(f"no pod for {job_name} {rtype}:{index}")
+    status = pods[0].get("status", {})
+    ip, port = status.get("podIP"), status.get("hostPort")
+    if not ip or not port:
+        raise TestFailure(
+            f"pod {objects.name_of(pods[0])} has no published address "
+            f"(phase={status.get('phase')})"
+        )
+    return ip, int(port)
+
+
+def terminate_replica(
+    client: ClusterClient,
+    namespace: str,
+    job_name: str,
+    rtype: str,
+    index: int = 0,
+    exit_code: int = 0,
+    timeout: float = 10.0,
+) -> None:
+    """GET /exit?exitCode=n on a replica's test server
+    (test_runner.py:285-318 analog)."""
+    ip, port = replica_address(client, namespace, job_name, rtype, index)
+    url = f"http://{ip}:{port}/exit?exitCode={exit_code}"
+    LOG.info("terminating %s %s:%d with exit code %d", job_name, rtype, index, exit_code)
+    payload = _http_get_json(url, timeout=timeout)
+    if payload.get("exiting") != exit_code:
+        raise TestFailure(f"unexpected /exit reply: {payload}")
+
+
+def get_tfconfig(
+    client: ClusterClient, namespace: str, job_name: str, rtype: str, index: int = 0
+) -> dict:
+    """GET /tfconfig from a replica — verifies the injected contract E2E."""
+    ip, port = replica_address(client, namespace, job_name, rtype, index)
+    return _http_get_json(f"http://{ip}:{port}/tfconfig")
+
+
+# ---------------------------------------------------------------------------
+# Event accounting (parse_events analog)
+# ---------------------------------------------------------------------------
+
+def count_creation_events(
+    client: ClusterClient, namespace: str, job_name: str
+) -> tuple[set[str], set[str]]:
+    """(created pod names, created service names) from the event stream
+    (test_runner.py:217-281 semantics: events are the audit trail). Creation
+    events attach to the owning job with the created object's name in the
+    message ("Created pod: {name}" — pod_control.py)."""
+    from tf_operator_tpu.runtime import events as ev
+
+    pods: set[str] = set()
+    services: set[str] = set()
+    for e in client.list(objects.EVENTS, namespace):
+        if e.get("involvedObject", {}).get("name") != job_name:
+            continue
+        message = e.get("message", "")
+        created = message.rsplit(": ", 1)[-1] if ": " in message else ""
+        if e.get("reason") == ev.SUCCESSFUL_CREATE_POD and created:
+            pods.add(created)
+        elif e.get("reason") == ev.SUCCESSFUL_CREATE_SERVICE and created:
+            services.add(created)
+    return pods, services
+
+
+# ---------------------------------------------------------------------------
+# The driver
+# ---------------------------------------------------------------------------
+
+def default_job_spec(name: str, namespace: str, workers: int, ps: int,
+                     restart_policy: str | None) -> dict:
+    container = {
+        "name": constants.DEFAULT_CONTAINER_NAME,
+        "image": "tpu-operator/test-server",
+        "command": [sys.executable, "-m", "tf_operator_tpu.harness.test_server"],
+    }
+    worker: dict = {"replicas": workers, "template": {"spec": {"containers": [container]}}}
+    if restart_policy:
+        worker["restartPolicy"] = restart_policy
+    replica_specs = {"Worker": worker}
+    if ps:
+        replica_specs["PS"] = {
+            "replicas": ps,
+            "template": {"spec": {"containers": [dict(container)]}},
+        }
+    return {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"replicaSpecs": replica_specs},
+    }
+
+
+def run_trial(
+    client: ClusterClient,
+    job_obj: dict,
+    shutdown_policy: str,
+    exit_code: int,
+    timeout: float,
+) -> None:
+    """One deploy→assert→delete cycle (the body of run_test:373-585)."""
+    cli = TPUJobClient(client)
+    meta = job_obj["metadata"]
+    ns, name = meta.get("namespace", "default"), meta["name"]
+
+    cli.create(job_obj)
+    try:
+        cli.wait_for_running(ns, name, timeout=timeout)
+        LOG.info("%s/%s running", ns, name)
+
+        # The workers are live HTTP servers: check the injected contract.
+        replica_types = list(job_obj["spec"]["replicaSpecs"])
+        tfconfig = get_tfconfig(client, ns, name, replica_types[0], 0)
+        if "cluster" not in tfconfig or "task" not in tfconfig:
+            raise TestFailure(f"bad TF_CONFIG echoed by replica: {tfconfig}")
+
+        if shutdown_policy != "none":
+            rtype = {"chief": "Chief", "worker": "Worker", "ps": "PS"}[shutdown_policy]
+            terminate_replica(client, ns, name, rtype, 0, exit_code)
+        else:
+            # No injected shutdown: ask every replica to exit 0 so the job
+            # completes (the test server otherwise serves forever).
+            for rtype, spec in job_obj["spec"]["replicaSpecs"].items():
+                for idx in range(int(spec.get("replicas", 1))):
+                    terminate_replica(client, ns, name, rtype, idx, 0)
+
+        result = cli.wait_for_job(ns, name, timeout=timeout)
+        conds = {
+            c["type"]
+            for c in result["status"]["conditions"]
+            if c["status"] == "True"
+        }
+        expect_failed = shutdown_policy != "none" and exit_code not in (0,)
+        if expect_failed and JobConditionType.FAILED not in conds:
+            raise TestFailure(f"expected Failed, got {conds}")
+        if not expect_failed:
+            # Non-injected or exit-0 shutdown must succeed... unless other
+            # replicas keep serving: chief exit-0 completes the job (chief
+            # rule), worker exit-0 with remaining workers keeps Running —
+            # handled by callers choosing sensible specs.
+            if JobConditionType.SUCCEEDED not in conds:
+                raise TestFailure(f"expected Succeeded, got {conds}")
+
+        # Event accounting: every expected pod/service has a creation event.
+        pods, services = count_creation_events(client, ns, name)
+        expected = sum(
+            int(s.get("replicas", 1)) for s in job_obj["spec"]["replicaSpecs"].values()
+        )
+        if len(pods) < expected:
+            raise TestFailure(
+                f"expected ≥{expected} pod creation events, saw {len(pods)}"
+            )
+        if len(services) < expected:
+            raise TestFailure(
+                f"expected ≥{expected} service creation events, saw {len(services)}"
+            )
+    finally:
+        try:
+            cli.delete(ns, name)
+            cli.wait_for_delete(ns, name, timeout=timeout)
+        except Exception:
+            LOG.exception("cleanup failed for %s/%s", ns, name)
+
+    # GC: no owned pods may survive deletion (test/e2e/main.go:244-252).
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not client.list(
+            objects.PODS, ns, label_selector={constants.LABEL_JOB_NAME: name}
+        ):
+            return
+        time.sleep(0.2)
+    raise TestFailure(f"pods of {ns}/{name} not garbage-collected")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-test-runner", description=__doc__)
+    p.add_argument("--master", default="http://127.0.0.1:8080")
+    p.add_argument("--spec", default=None, help="TPUJob JSON file (default: builtin)")
+    p.add_argument("--name", default="e2e-test-job")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--ps", type=int, default=0)
+    p.add_argument("--restart-policy", default=None,
+                   choices=[None, "Never", "OnFailure", "Always", "ExitCode"])
+    p.add_argument("--shutdown-policy", default="none",
+                   choices=["none", "chief", "worker", "ps"],
+                   help="which replica to /exit (none = clean completion)")
+    p.add_argument("--exit-code", type=int, default=0)
+    p.add_argument("--trials", type=int, default=1,
+                   help="repeat count (reference runs 2 trials)")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--junit-path", default=None)
+    args = p.parse_args(argv)
+
+    logger.configure()
+    from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+    client = RestClusterClient(args.master)
+    if args.spec:
+        with open(args.spec) as f:
+            job_obj = json.load(f)
+    else:
+        job_obj = default_job_spec(
+            args.name, args.namespace, args.workers, args.ps, args.restart_policy
+        )
+
+    cases: list[junit.TestCase] = []
+    failed = 0
+    for trial in range(args.trials):
+        case = junit.TestCase(name=f"{args.name}-trial-{trial}")
+        try:
+            junit.wrap_test(
+                lambda: run_trial(
+                    client, json.loads(json.dumps(job_obj)),
+                    args.shutdown_policy, args.exit_code, args.timeout,
+                ),
+                case,
+            )
+            LOG.info("trial %d passed (%.1fs)", trial, case.time)
+        except Exception as e:
+            failed += 1
+            LOG.error("trial %d FAILED: %s", trial, e)
+        cases.append(case)
+
+    if args.junit_path:
+        junit.write_junit_xml(cases, args.junit_path)
+        LOG.info("junit written to %s", args.junit_path)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,18 @@ def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch: Any, axis: str = "dp") -> Any:
-    """Device-put a host batch with dim-0 sharding over the data axis."""
+    """Place a host batch with dim-0 sharding over the data axis.
+
+    Single-process: a plain device_put. Multi-process (operator-launched
+    multi-host jobs): each process contributes its LOCAL batch shard and the
+    result is the global array — the per-host-input-pipeline contract of
+    multi-host data parallelism (global batch = concat of process batches).
+    """
     sharding = batch_sharded(mesh, axis)
+    if jax.process_count() > 1:
+        return jax.tree.map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x), batch
+        )
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

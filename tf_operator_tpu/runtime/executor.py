@@ -253,7 +253,11 @@ class LocalProcessExecutor:
                     self._procs.pop(key)
             return
         self._set_phase(
-            pod, objects.RUNNING, restart_count=restart_count, expect_uid=running.uid
+            pod,
+            objects.RUNNING,
+            restart_count=restart_count,
+            expect_uid=running.uid,
+            port=port,
         )
         threading.Thread(
             target=self._wait, args=(pod, running), daemon=True
@@ -329,6 +333,7 @@ class LocalProcessExecutor:
         exit_code: int | None = None,
         restart_count: int = 0,
         expect_uid: str | None = None,
+        port: int | None = None,
     ) -> None:
         ns, name = objects.namespace_of(pod), objects.name_of(pod)
         try:
@@ -339,6 +344,12 @@ class LocalProcessExecutor:
         if expect_uid and objects.uid_of(fresh) != expect_uid:
             return
         objects.set_pod_phase(fresh, phase)
+        if port is not None:
+            # Publish reachability in status — the analog of podIP + the
+            # apiserver service proxy the reference harness uses to reach a
+            # replica (test_runner.py:296-303).
+            fresh["status"]["podIP"] = "127.0.0.1"
+            fresh["status"]["hostPort"] = port
         if exit_code is not None:
             objects.set_container_terminated(
                 fresh, constants.DEFAULT_CONTAINER_NAME, exit_code
