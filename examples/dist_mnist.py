@@ -22,7 +22,8 @@ import time
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=60)
-    p.add_argument("--batch", type=int, default=256, help="global batch size")
+    p.add_argument("--batch", type=int, default=256,
+                   help="per-process batch size (global = this x processes)")
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--target-loss", type=float, default=0.25,
                    help="exit non-zero unless final loss is below this")
@@ -73,9 +74,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"dist_mnist: step {i+1} loss={loss:.4f} acc={acc:.3f}", flush=True)
     loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    global_batch = args.batch * topo.num_processes
     print(
         f"dist_mnist: {args.steps} steps in {dt:.1f}s "
-        f"({args.steps * args.batch / dt:.0f} img/s), final loss {loss:.4f}",
+        f"({args.steps * global_batch / dt:.0f} img/s global batch "
+        f"{global_batch}), final loss {loss:.4f}",
         flush=True,
     )
     if loss > args.target_loss:

@@ -3,13 +3,66 @@
 Sharding/parallelism tests run on a virtual 8-device CPU mesh (multi-chip TPU
 hardware is not available in CI); force_cpu_mesh must run before the first
 backend query anywhere in the test process.
+
+Also hosts the shared `operator` fixture: a real operator process (HTTP API
+server + controller + local process executor) used by the E2E test modules.
 """
 
 import os
+import socket
+import subprocess
 import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tf_operator_tpu.parallel.testing import force_cpu_mesh  # noqa: E402
 
 force_cpu_mesh(8)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def operator(tmp_path_factory):
+    """A live operator process; yields its HTTP API base URL."""
+    port = free_port()
+    log_path = tmp_path_factory.mktemp("operator") / "operator.log"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tf_operator_tpu.cli.operator",
+            "--serve", str(port), "--local-executor",
+            "--reconcile-period", "0.3", "--informer-resync", "1.0",
+        ],
+        # Log to a file, not a PIPE: an undrained pipe fills its ~64KB
+        # buffer and blocks the operator mid-reconcile (looks like a hang).
+        env=env, stdout=open(log_path, "wb"), stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
+            break
+        except (urllib.error.URLError, ConnectionError):
+            if proc.poll() is not None:
+                raise RuntimeError("operator died at startup")
+            time.sleep(0.2)
+    yield base
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()

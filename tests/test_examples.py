@@ -5,14 +5,8 @@ analog of the reference's real-TF smoke job (examples/tf_sample/tf_smoke.py
 run as a TFJob)."""
 
 import os
-import socket
-import subprocess
 import sys
 import time
-import urllib.error
-import urllib.request
-
-import pytest
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.client import TPUJobClient
@@ -22,42 +16,6 @@ from tf_operator_tpu.runtime.restclient import RestClusterClient
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO_ROOT, "examples")
 
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-@pytest.fixture(scope="module")
-def operator():
-    port = free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "tf_operator_tpu.cli.operator",
-            "--serve", str(port), "--local-executor",
-            "--reconcile-period", "0.3", "--informer-resync", "1.0",
-        ],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-    )
-    base = f"http://127.0.0.1:{port}"
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        try:
-            urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
-            break
-        except (urllib.error.URLError, ConnectionError):
-            if proc.poll() is not None:
-                raise RuntimeError("operator died at startup")
-            time.sleep(0.2)
-    yield base
-    proc.terminate()
-    try:
-        proc.wait(timeout=5)
-    except subprocess.TimeoutExpired:
-        proc.kill()
 
 
 def example_job(name: str, script: str, workers: int, extra_args: list[str] | None = None):

@@ -4,13 +4,8 @@ injection through the published replica address (the terminateReplica
 analog)."""
 
 import os
-import socket
 import subprocess
-import sys
-import tempfile
 import time
-import urllib.error
-import urllib.request
 
 import pytest
 
@@ -43,42 +38,6 @@ def test_junit_xml_roundtrip(tmp_path):
 # ---------------------------------------------------------------------------
 # test_runner against a real operator process
 # ---------------------------------------------------------------------------
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-@pytest.fixture(scope="module")
-def operator():
-    port = free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "tf_operator_tpu.cli.operator",
-            "--serve", str(port), "--local-executor",
-            "--reconcile-period", "0.3", "--informer-resync", "1.0",
-        ],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-    )
-    base = f"http://127.0.0.1:{port}"
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        try:
-            urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
-            break
-        except (urllib.error.URLError, ConnectionError):
-            if proc.poll() is not None:
-                raise RuntimeError("operator died at startup")
-            time.sleep(0.2)
-    yield base
-    proc.terminate()
-    try:
-        proc.wait(timeout=5)
-    except subprocess.TimeoutExpired:
-        proc.kill()
 
 
 def test_runner_clean_completion(operator, tmp_path):

@@ -1,0 +1,119 @@
+"""Pallas flash attention vs the XLA reference oracle.
+
+Runs the kernels in interpret mode (CI is CPU); the same code compiles via
+Mosaic on TPU. Mirrors the reference's pure-oracle test style (SURVEY.md §4
+tier 1) for the compute path the reference never owned.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tf_operator_tpu.ops import attention, pick_block
+from tf_operator_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_supported,
+    select_block,
+)
+from tf_operator_tpu.parallel.ring_attention import reference_attention
+
+
+def _rand_qkv(rng, b=2, t=128, h=2, d=16, dtype=jnp.float32):
+    q = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    k = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    v = jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_reference(causal):
+    q, k, v = _rand_qkv(np.random.default_rng(0))
+    out = flash_attention(q, k, v, causal=causal, block=32, interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_grads_match_reference(causal):
+    q, k, v = _rand_qkv(np.random.default_rng(1), b=1, t=64, h=2, d=8)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block=16, interpret=True)
+        return (o * o).sum()
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=causal)
+        return (o * o).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            gf, gr, atol=2e-4, rtol=2e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_cross_attention_rectangular():
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(2, 32, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block=32, interpret=True)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_close_to_f32_reference():
+    q, k, v = _rand_qkv(np.random.default_rng(3), dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block=64, interpret=True)
+    ref = reference_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref, atol=2e-2, rtol=2e-2
+    )
+
+
+def test_pick_block():
+    assert pick_block(1024) == 256
+    assert pick_block(128) == 128
+    assert pick_block(48) == 16
+    assert pick_block(7) is None
+
+
+def test_select_block_compiled_constraints():
+    # Mosaic: block must be %128 or equal-to-dim on BOTH sides.
+    assert select_block(1024, 1024, compiled=True) == 256
+    assert select_block(48, 48, compiled=True) == 48  # equal-to-dim
+    assert select_block(48, 96, compiled=True) is None  # no common legal block
+    assert select_block(48, 80, compiled=True) is None
+    assert select_block(128, 512, compiled=True) == 128
+    # equal-to-dim fallback is VMEM-capped: [block, block] f32 scores
+    assert select_block(1968, 1968, compiled=True) is None
+    assert not flash_supported(1968, 1968, 128, 2, causal=True, compiled=True)
+
+
+def test_flash_supported_gates_dispatch():
+    assert flash_supported(1024, 1024, 128, 2, causal=True, compiled=True)
+    # causal needs square
+    assert not flash_supported(512, 1024, 128, 2, causal=True, compiled=True)
+    # beyond the VMEM full-sequence budget
+    assert not flash_supported(
+        1 << 20, 1 << 20, 128, 4, causal=False, compiled=True
+    )
+    # untileable on the compiled path must be rejected (fallback to XLA)
+    assert not flash_supported(48, 96, 16, 4, causal=False, compiled=True)
+
+
+def test_attention_dispatch_falls_back_off_tpu():
+    q, k, v = _rand_qkv(np.random.default_rng(4), t=33)  # untileable
+    ref = reference_attention(q, k, v, causal=True)
+    out = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_flash_rejects_untileable():
+    q, k, v = _rand_qkv(np.random.default_rng(5), t=33)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block=32, interpret=True)

@@ -190,6 +190,24 @@ def run_trial(
         if shutdown_policy != "none":
             rtype = {"chief": "Chief", "worker": "Worker", "ps": "PS"}[shutdown_policy]
             terminate_replica(client, ns, name, rtype, 0, exit_code)
+            if exit_code == 0:
+                # Exit-0 shutdown must end in Succeeded, but success needs
+                # every worker (or the chief) to finish — the remaining
+                # replicas would serve forever. Drain them too; ignore
+                # replicas already torn down (e.g. chief-rule completion).
+                for other_type, spec in job_obj["spec"]["replicaSpecs"].items():
+                    for idx in range(int(spec.get("replicas", 1))):
+                        if (other_type, idx) == (rtype, 0):
+                            continue
+                        try:
+                            terminate_replica(
+                                client, ns, name, other_type, idx, 0
+                            )
+                        except Exception as exc:  # noqa: BLE001
+                            LOG.info(
+                                "drain of %s-%d skipped: %s",
+                                other_type, idx, exc,
+                            )
         else:
             # No injected shutdown: ask every replica to exit 0 so the job
             # completes (the test server otherwise serves forever).

@@ -22,10 +22,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from tf_operator_tpu.parallel.ring_attention import (
-    reference_attention,
-    ring_attention,
-)
+from tf_operator_tpu.ops import attention as device_attention
+from tf_operator_tpu.parallel.ring_attention import ring_attention
 
 
 @dataclass(frozen=True)
@@ -85,7 +83,34 @@ class Attention(nn.Module):
                 causal=True,
             )
         else:
-            out = reference_attention(q, k, v, causal=True)
+            # ops.attention dispatches: pallas flash kernel on TPU with
+            # tileable shapes, XLA reference path otherwise. The pallas
+            # custom-call has no SPMD partitioning rule, so under a mesh
+            # with dp/tp > 1 it must sit inside shard_map (batch over dp,
+            # heads over tp — both embarrassingly parallel for attention);
+            # GSPMD partitions only the surrounding ops.
+            mesh = cfg.mesh
+            dp = mesh.shape.get(cfg.batch_axis, 1) if mesh is not None else 1
+            tp = mesh.shape.get(cfg.tp_axis, 1) if mesh is not None else 1
+            # shard_map (unlike GSPMD) hard-requires divisibility; shapes
+            # that don't divide keep the old GSPMD-partitionable XLA path.
+            bspec = cfg.batch_axis if dp > 1 and b % dp == 0 else None
+            hspec = cfg.tp_axis if tp > 1 and cfg.n_heads % tp == 0 else None
+            if bspec or hspec:
+                spec = jax.sharding.PartitionSpec(bspec, None, hspec, None)
+                out = jax.shard_map(
+                    lambda q, k, v: device_attention(q, k, v, causal=True),
+                    mesh=mesh,
+                    in_specs=(spec, spec, spec),
+                    out_specs=spec,
+                    check_vma=False,
+                )(q, k, v)
+            elif dp > 1 or tp > 1:
+                # Indivisible under an active mesh: never hand GSPMD the
+                # pallas custom-call (it has no partitioning rule).
+                out = device_attention(q, k, v, causal=True, use_flash=False)
+            else:
+                out = device_attention(q, k, v, causal=True)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)

@@ -1,0 +1,43 @@
+"""TPU kernels for the hot ops (pallas), with XLA fallbacks.
+
+The dispatch rule lives here: ``attention()`` picks the pallas flash kernel
+when running on TPU with tileable shapes, otherwise the XLA reference path
+(which XLA still fuses well on CPU/small shapes). Models call this one entry
+point so the kernel choice is a deployment detail, not a model concern.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from tf_operator_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_supported,
+    pick_block,
+    select_block,
+)
+
+
+def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+              use_flash: bool | None = None) -> jax.Array:
+    """Single-device attention: flash kernel on TPU, XLA elsewhere."""
+    from tf_operator_tpu.parallel.ring_attention import reference_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    if use_flash is None:
+        use_flash = on_tpu
+    if use_flash and flash_supported(
+        q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize,
+        causal=causal, compiled=on_tpu,
+    ):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return reference_attention(q, k, v, causal=causal, scale=scale)
+
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "flash_supported",
+    "pick_block",
+    "select_block",
+]

@@ -1,0 +1,330 @@
+"""Pallas TPU flash attention (forward + custom-VJP backward).
+
+The single-device hot op of the training stack. The reference delegates all
+compute to the TensorFlow runtime inside user containers (SURVEY.md: zero
+native/kernel code in-repo); here the framework owns its compute path, so
+the attention inner loop is a hand-written TPU kernel:
+
+- blocked streaming softmax: one Q block per grid program; K/V live in VMEM
+  for the program (pipelined HBM->VMEM by pallas across grid steps) and are
+  consumed block-by-block, so scores never materialize [T, T] — VMEM is
+  O(block^2) for scores plus O(T*head_dim) for the resident K/V (budget
+  enforced by flash_supported; sequences beyond it belong to ring
+  attention's sharded path).
+- MXU-friendly: all contractions via jnp.dot with
+  preferred_element_type=float32; bf16 inputs supported.
+- causal skip: grid program for Q block i only loops K blocks j <= i
+  (dynamic fori_loop bound), halving FLOPs for causal LM training.
+- backward = two kernels (dq; dk/dv) recomputing probabilities from the
+  saved logsumexp — the standard flash recomputation trade (HBM bandwidth
+  is the bottleneck, FLOPs are cheap on the MXU).
+
+Kernels run in [batch, heads, seq, head_dim] layout so Mosaic's tiling
+constraint (block's trailing dims must be sublane/lane aligned) falls on
+(seq_block, head_dim); the public API takes the framework convention
+[batch, seq, heads, head_dim] (parallel/ring_attention.py) and transposes at
+the boundary (XLA folds the transpose into neighboring ops). Composes with
+ring attention: ring shards the sequence across chips (ICI), this kernel is
+the per-chip block compute.
+
+Falls back transparently (ops/__init__.attention) to the XLA reference
+implementation when shapes don't tile or when not on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+_BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
+
+
+# Per-(b,h) program the kernels hold two full-sequence tensors in VMEM
+# (fwd/dq: K+V; dkv: Q+dO). Cap their combined footprint well under the
+# ~16 MB VMEM so blocks/accumulators/double-buffering fit too.
+_VMEM_SEQ_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def select_block(tq: int, tk: int, *, compiled: bool = False,
+                 max_block: int = 256) -> int | None:
+    """Largest block that tiles BOTH sequence lengths, or None.
+
+    This is the single source of truth for flash dispatchability: the same
+    block is used on the Q side and the K side, so it must divide both
+    lengths, and under the Mosaic lowering (compiled=True) a trailing-two
+    BlockSpec dim must be a multiple of 128 or equal to the whole dimension
+    on *that* side; interpret mode (CPU CI) has no such limit.
+    """
+    for b in _BLOCK_CANDIDATES:
+        if b > max_block or tq % b or tk % b:
+            continue
+        if compiled and not (
+            (b % 128 == 0 or b == tq) and (b % 128 == 0 or b == tk)
+        ):
+            continue
+        return b
+    if (
+        compiled
+        and tq == tk
+        and tq % 16 == 0  # bf16 sublane alignment
+        # the kernels materialize an f32 [block, block] score tile in VMEM;
+        # cap the single-block fallback so it stays ~1 MiB, not ~16 MiB
+        and tq <= 512
+    ):
+        return tq  # single block: equal-to-dim is always a legal BlockSpec
+    return None
+
+
+def pick_block(seq_len: int, *, compiled: bool = False,
+               max_block: int = 256) -> int | None:
+    """Largest block tiling one sequence length (see select_block)."""
+    return select_block(seq_len, seq_len, compiled=compiled,
+                        max_block=max_block)
+
+
+def flash_supported(tq: int, tk: int, head_dim: int, itemsize: int,
+                    *, causal: bool, compiled: bool) -> bool:
+    """True when flash_attention() will accept these shapes."""
+    if causal and tq != tk:
+        return False
+    if 2 * max(tq, tk) * head_dim * itemsize > _VMEM_SEQ_BUDGET_BYTES:
+        return False
+    return select_block(tq, tk, compiled=compiled) is not None
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk, causal, scale, nk):
+    i = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    bq, d = q.shape
+
+    q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        l = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((bq, 1), jnp.float32)
+    hi = lax.min(i + 1, nk) if causal else nk
+    acc, m, l = lax.fori_loop(0, hi, body, (acc, m, l))
+
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :, :] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, blk, causal, scale, nk):
+    i = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, :]
+    delta = delta_ref[0, 0, :, :]
+    bq, d = q.shape
+    q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 0)
+
+    def body(j, dq):
+        k_blk = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    hi = lax.min(i + 1, nk) if causal else nk
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, blk, causal, scale, ni):
+    j = pl.program_id(2)
+    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+    bk, d = k_blk.shape
+    k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (blk, bk), 1)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * blk, blk), :]
+        delta = delta_ref[0, 0, pl.ds(i * blk, blk), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)
+        if causal:
+            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (blk, bk), 0)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    lo = j if causal else 0
+    dk, dv = lax.fori_loop(
+        lo, ni, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
+    )
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+# BlockSpecs over [B, H, T, D] (data) and [B, H, T, 1] (rows: lse/delta).
+def _blk_spec(blk, d):
+    return pl.BlockSpec((1, 1, blk, d), lambda b, h, i: (b, h, i, 0))
+
+
+def _full_spec(t, d):
+    return pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0))
+
+
+def _flash_fwd(q, k, v, causal, scale, blk, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    nk = tk // blk
+    grid = (b, h, tq // blk)
+    kernel = functools.partial(
+        _fwd_kernel, blk=blk, causal=causal, scale=scale, nk=nk
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[_blk_spec(blk, d), _full_spec(tk, d), _full_spec(tk, d)],
+        out_specs=[_blk_spec(blk, d), _blk_spec(blk, 1)],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    ni, nk = tq // blk, tk // blk
+    delta = jnp.einsum(
+        "bhtd,bhtd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
+    )[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, blk=blk, causal=causal, scale=scale, nk=nk),
+        grid=(b, h, ni),
+        in_specs=[
+            _blk_spec(blk, d),
+            _full_spec(tk, d),
+            _full_spec(tk, d),
+            _blk_spec(blk, d),
+            _blk_spec(blk, 1),
+            _blk_spec(blk, 1),
+        ],
+        out_specs=_blk_spec(blk, d),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, blk=blk, causal=causal, scale=scale, ni=ni),
+        grid=(b, h, nk),
+        in_specs=[
+            _full_spec(tq, d),
+            _blk_spec(blk, d),
+            _blk_spec(blk, d),
+            _full_spec(tq, d),
+            _full_spec(tq, 1),
+            _full_spec(tq, 1),
+        ],
+        out_specs=[_blk_spec(blk, d), _blk_spec(blk, d)],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, blk, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, scale, blk, interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, blk, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, blk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, blk, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk,
+                                 interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blocked flash attention. q/k/v: [batch, seq, heads, head_dim].
+
+    Requires seq divisible by ``block`` (auto-picked when None; on TPU the
+    block must also satisfy Mosaic tiling — see pick_block). Raises
+    ValueError when no legal block exists — callers should use
+    ops.attention() which falls back to the XLA path.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tq, tk = q.shape[1], k.shape[1]
+    if block is None:
+        block = select_block(tq, tk, compiled=not interpret)
+    if block is None or tq % block or tk % block:
+        raise ValueError(f"seq lengths ({tq},{tk}) don't tile (block={block})")
+    if causal and tq != tk:
+        raise ValueError("causal flash requires tq == tk")
+    if 2 * max(tq, tk) * q.shape[-1] * q.dtype.itemsize > _VMEM_SEQ_BUDGET_BYTES:
+        raise ValueError(
+            f"sequence ({max(tq, tk)} x {q.shape[-1]}) exceeds the kernel's "
+            "full-sequence VMEM budget; use ring attention to shard the "
+            "sequence, or the XLA fallback (ops.attention)"
+        )
+    # [B,T,H,D] -> [B,H,T,D] for the kernels; XLA folds the transposes.
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o = _flash(qt, kt, vt, causal, float(scale), int(block), bool(interpret))
+    return o.transpose(0, 2, 1, 3)
