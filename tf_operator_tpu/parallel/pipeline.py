@@ -1,0 +1,112 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+The ``pp`` mesh axis holds one pipeline stage per device group; activations
+hop stage-to-stage over ICI via ``lax.ppermute`` while ``lax.scan`` drives
+the microbatch schedule — compiler-friendly (static trip count, no Python
+control flow under jit) and differentiable end-to-end (autodiff through
+scan + ppermute + psum gives the reverse pipeline schedule for free).
+
+The reference has no model parallelism of any kind (SURVEY.md §2.9); this
+is part of the TPU-native capability layer the rebuild adds. Design follows
+the public scaling-book recipe: put the loop *inside* shard_map so XLA sees
+per-device code with explicit collectives.
+
+Schedule: with S stages and M microbatches the scan runs M+S-1 ticks; at
+tick t stage 0 ingests microbatch t (t < M) while stage s computes the
+activation that left stage 0 at tick t-s. Valid last-stage outputs appear
+at ticks S-1 .. S+M-2 and are broadcast to all stages with a masked psum
+(cheap at these sizes; callers that shard the batch too can slice instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(param_list: list[Any]) -> Any:
+    """Stack per-stage param pytrees into one pytree with leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "pp",
+    batch_axis: str | None = None,
+) -> jax.Array:
+    """Run microbatches through S pipelined stages sharded over ``axis``.
+
+    stage_fn: (one stage's params, activation) -> activation (same shape).
+    stage_params: pytree whose leaves have leading dim S (stage); sharded
+      over ``axis`` so each device group holds exactly its stage's weights.
+    microbatches: [M, microbatch, ...] input activations.
+    batch_axis: optionally also shard the microbatch dim (dim 1) over a
+      data axis — each dp group runs an independent pipeline replica on its
+      batch shard (pp x dp composition; stage-param grads are summed over
+      dp by shard_map's reverse transfer).
+    Returns [M, microbatch, ...] outputs of the final stage (replicated
+    over ``axis``, batch-sharded over ``batch_axis`` if given).
+    """
+    n_stages = mesh.shape[axis]
+    num_micro = microbatches.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leading dim {leaf.shape[0]} != {axis} axis "
+                f"size {n_stages}; to run multiple layers per stage, fold "
+                "them into stage_fn (a silent mismatch would drop stages)"
+            )
+
+    def local(params, x):
+        # params leaves arrive as [1, ...] (this device's stage); unstack.
+        p = jax.tree.map(lambda a: a[0], params)
+        stage = lax.axis_index(axis)
+
+        def tick(state, t):
+            prev = lax.ppermute(state, axis, perm)  # stage s-1's last output
+            fresh = x[jnp.clip(t, 0, num_micro - 1)]
+            inp = jnp.where(stage == 0, fresh, prev)
+            out = stage_fn(p, inp)
+            return out, out
+
+        _, outs = lax.scan(
+            tick, jnp.zeros_like(x[0]), jnp.arange(num_micro + n_stages - 1)
+        )
+        # Ticks S-1 .. S+M-2 of the LAST stage are the pipeline's outputs.
+        valid = lax.dynamic_slice_in_dim(outs, n_stages - 1, num_micro, 0)
+        valid = jnp.where(stage == n_stages - 1, valid, 0)
+        return lax.psum(valid, axis)
+
+    data_spec = P(None, batch_axis) if batch_axis else P()
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), data_spec),
+        out_specs=data_spec,
+        check_vma=False,
+    )(stage_params, microbatches)
+
+
+def microbatch(x: jax.Array, num_micro: int) -> jax.Array:
+    """[batch, ...] -> [num_micro, batch/num_micro, ...]."""
+    if x.shape[0] % num_micro:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by {num_micro} microbatches"
+        )
+    return x.reshape(num_micro, x.shape[0] // num_micro, *x.shape[1:])
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """[num_micro, mb, ...] -> [num_micro*mb, ...]."""
+    return x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
