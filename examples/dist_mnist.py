@@ -27,7 +27,18 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--target-loss", type=float, default=0.25,
                    help="exit non-zero unless final loss is below this")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save/resume train state here (orbax)")
+    p.add_argument("--checkpoint-interval", type=int, default=1,
+                   help="save every N steps")
+    p.add_argument("--fail-at-step", type=int, default=None,
+                   help="simulate preemption: first incarnation exits 138 "
+                        "(user-retryable) at this step after checkpointing")
     args = p.parse_args(argv)
+    if args.fail_at_step is not None and not args.checkpoint_dir:
+        # Without a checkpoint every incarnation restarts at step 0, hits
+        # the failure step again, and the retryable exit crash-loops the job.
+        p.error("--fail-at-step requires --checkpoint-dir")
 
     from tf_operator_tpu.train import distributed
 
@@ -62,22 +73,59 @@ def main(argv: list[str] | None = None) -> int:
     state = replicate(mesh, state)
     step = make_classifier_train_step(model, tx, mesh, has_batch_stats=False)
 
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            args.checkpoint_dir, max_to_keep=2,
+            save_interval_steps=args.checkpoint_interval,
+        )
+        state, start_step = ckpt.restore_or_init(state)
+        # Re-run at least the final step so the loss acceptance check below
+        # always executes — a fully-resumed run must not skip straight to
+        # success (the previous incarnation may have failed the target).
+        start_step = max(0, min(start_step, args.steps - 1))
+        if start_step:
+            print(f"dist_mnist: resumed from step {start_step}", flush=True)
+
     data = synthetic_mnist(args.batch, seed=topo.process_id)
     t0 = time.perf_counter()
     loss = float("inf")
-    for i in range(args.steps):
+    metrics = None
+    for i in range(start_step, args.steps):
         batch = shard_batch(mesh, next(data))
         state, metrics = step(state, batch)
-        if (i + 1) % 20 == 0 or i == 0:
+        if ckpt is not None:
+            ckpt.save(i, state)
+        if (
+            args.fail_at_step is not None
+            and i == args.fail_at_step
+            and start_step == 0
+        ):
+            # Simulated preemption: checkpoint is durable, then die with
+            # the user-retryable exit code (SIGUSR1 convention, 138) so the
+            # ExitCode restart policy relaunches this replica.
+            if ckpt is not None:
+                ckpt.wait()
+            print(f"dist_mnist: simulating preemption at step {i}", flush=True)
+            import os as _os
+
+            _os._exit(138)
+        if (i + 1) % 20 == 0 or i == start_step:
             loss = float(metrics["loss"])
             acc = float(metrics["accuracy"])
             print(f"dist_mnist: step {i+1} loss={loss:.4f} acc={acc:.3f}", flush=True)
+    if ckpt is not None:
+        ckpt.close()
     loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
+    steps_run = args.steps - start_step
     global_batch = args.batch * topo.num_processes
     print(
-        f"dist_mnist: {args.steps} steps in {dt:.1f}s "
-        f"({args.steps * global_batch / dt:.0f} img/s global batch "
+        f"dist_mnist: {steps_run} steps in {dt:.1f}s "
+        f"({steps_run * global_batch / dt:.0f} img/s global batch "
         f"{global_batch}), final loss {loss:.4f}",
         flush=True,
     )
