@@ -1,0 +1,151 @@
+"""Metrics registry, tracer, and the live /metrics + /debug/traces endpoints.
+
+The reference has neither metrics nor tracing (SURVEY.md §5) — these are
+capability additions; the E2E asserts a real operator process serves both
+and that reconcile activity shows up in the scrape."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.runtime.metrics import Registry
+from tf_operator_tpu.runtime.tracing import Tracer
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_counter_and_gauge_render():
+    reg = Registry()
+    c = reg.counter("requests_total", "Requests", ("method",))
+    c.inc(method="GET")
+    c.inc(2, method="POST")
+    g = reg.gauge("depth", "Queue depth")
+    g.set(7)
+    g.inc()
+    g.dec(3)
+    text = reg.render()
+    assert '# TYPE requests_total counter' in text
+    assert 'requests_total{method="GET"} 1' in text
+    assert 'requests_total{method="POST"} 2' in text
+    assert "depth 5" in text
+    assert c.value(method="GET") == 1
+
+
+def test_counter_rejects_negative_and_wrong_labels():
+    reg = Registry()
+    c = reg.counter("x_total", labelnames=("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="v")
+    with pytest.raises(ValueError):
+        c.inc(b="v")
+
+
+def test_histogram_buckets_cumulative():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 5.0, 100.0):
+        h.observe(v)
+    text = reg.render()
+    assert 'lat_seconds_bucket{le="0.1"} 2' in text  # 0.05, 0.1 (le inclusive)
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="10"} 4' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "lat_seconds_count 5" in text
+    assert "lat_seconds_sum 105.65" in text
+
+
+def test_registry_dedupes_families():
+    reg = Registry()
+    a = reg.counter("same_total")
+    b = reg.counter("same_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("same_total")
+
+
+def test_registry_rejects_shape_mismatch():
+    reg = Registry()
+    reg.counter("c_total", labelnames=("a",))
+    with pytest.raises(ValueError, match="labels"):
+        reg.counter("c_total")
+    reg.histogram("h_seconds", buckets=(1.0, 2.0))
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h_seconds", buckets=(5.0,))
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_records_spans_and_exports_chrome_json():
+    tr = Tracer(capacity=4)
+    with tr.span("outer", job="ns/j"):
+        with tr.span("inner"):
+            pass
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["inner", "outer"]  # close order
+    assert spans[1].duration_us >= spans[0].duration_us
+    assert spans[1].attrs == {"job": "ns/j"}
+
+    doc = json.loads(tr.export_chrome_trace())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"inner", "outer"} <= names
+    complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert all("ts" in e and "dur" in e for e in complete)
+
+
+def test_tracer_ring_bounded_and_disable():
+    tr = Tracer(capacity=2)
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    assert [s.name for s in tr.spans()] == ["s3", "s4"]
+    tr.enabled = False
+    with tr.span("hidden"):
+        pass
+    assert len(tr.spans()) == 2
+
+
+# ---------------------------------------------------------------------------
+# live endpoints on a real operator process
+# ---------------------------------------------------------------------------
+
+
+def test_operator_serves_metrics_and_traces(operator):
+    text = urllib.request.urlopen(operator + "/metrics", timeout=5).read().decode()
+    assert "# TYPE tpu_operator_syncs_total counter" in text
+
+    doc = json.loads(
+        urllib.request.urlopen(operator + "/debug/traces", timeout=5).read()
+    )
+    assert any(e.get("name") == "process_name" for e in doc["traceEvents"])
+
+
+def test_metrics_not_shadowed_by_dashboard_spa_fallback():
+    """With the dashboard mounted, /metrics must still serve Prometheus text
+    (the SPA fallback swallows unmatched GETs, so mount order matters)."""
+    from tf_operator_tpu.dashboard.backend import mount_dashboard
+    from tf_operator_tpu.runtime.apiserver import ApiServer
+    from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+    from tf_operator_tpu.runtime.metrics import REGISTRY
+    from tf_operator_tpu.runtime.observability import mount_observability
+
+    REGISTRY.counter("spa_fallback_probe_total", "test probe")
+    server = ApiServer(InMemoryCluster())
+    mount_observability(server)
+    mount_dashboard(server, InMemoryCluster())
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        resp = urllib.request.urlopen(base + "/metrics", timeout=5)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        assert b"# TYPE" in resp.read()
+        # and the dashboard still serves its app shell
+        html = urllib.request.urlopen(base + "/", timeout=5).read()
+        assert b"<" in html
+    finally:
+        server.stop()

@@ -101,6 +101,12 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.runtime.apiserver import ApiServer
 
         api_server = ApiServer(client, host=args.serve_host, port=args.serve)
+        # Observability mounts BEFORE the dashboard: handlers run in
+        # registration order and the dashboard's SPA fallback swallows any
+        # unmatched GET, which would shadow /metrics with index.html.
+        from tf_operator_tpu.runtime.observability import mount_observability
+
+        mount_observability(api_server)
         if args.dashboard:
             from tf_operator_tpu.dashboard.backend import mount_dashboard
 

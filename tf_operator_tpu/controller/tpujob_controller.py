@@ -45,7 +45,22 @@ from tf_operator_tpu.controller.service_reconciler import ServiceReconciler
 from tf_operator_tpu.runtime import events as ev
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ClusterClient, Conflict, NotFound
+from tf_operator_tpu.runtime.metrics import REGISTRY
+from tf_operator_tpu.runtime.tracing import TRACER
 from tf_operator_tpu.utils import exit_codes, logger
+
+# Observability (absent from the reference — SURVEY.md §5): reconcile
+# latency/outcome plus queue pressure, scraped via /metrics.
+SYNC_SECONDS = REGISTRY.histogram(
+    "tpu_operator_sync_duration_seconds",
+    "Wall time of one reconcile pass", ("result",),
+)
+SYNCS_TOTAL = REGISTRY.counter(
+    "tpu_operator_syncs_total", "Reconcile passes by outcome", ("result",),
+)
+QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_operator_workqueue_depth", "Keys waiting in the workqueue",
+)
 
 
 class TPUJobController(JobController, PodReconciler, ServiceReconciler):
@@ -168,15 +183,23 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             key = self.queue.get()
             if key is None:
                 return
+            QUEUE_DEPTH.set(len(self.queue))
+            t0 = time.monotonic()
+            result = "ok"
             try:
-                requeue = self.sync_handler(key)
+                with TRACER.span("sync_job", key=str(key)):
+                    requeue = self.sync_handler(key)
                 self.queue.forget(key)
                 if requeue:
                     self.enqueue_after(key, self.config.reconcile_period)
             except Exception:
+                result = "error"
                 logger.for_key(str(key)).exception("sync failed; requeueing")
                 self.queue.add_rate_limited(key)
             finally:
+                dt = time.monotonic() - t0
+                SYNC_SECONDS.observe(dt, result=result)
+                SYNCS_TOTAL.inc(result=result)
                 self.queue.done(key)
 
     # ------------------------------------------------------------------ sync

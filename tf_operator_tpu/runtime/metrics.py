@@ -1,0 +1,221 @@
+"""Prometheus-style metrics registry (text exposition format).
+
+The reference has **no metrics at all** (SURVEY.md §5: "Metrics: none");
+status conditions and K8s Events are its only observables. This framework
+keeps those surfaces and adds a real scrape endpoint: counters/gauges/
+histograms with labels, rendered in the Prometheus text format at /metrics
+on the operator's API server. Dependency-free (the environment does not
+ship prometheus_client; the text format is trivial to emit).
+
+Thread-safe; all mutation is under one lock per metric family.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Iterable
+
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0,
+)
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...],
+                extra: str = "") -> str:
+    pairs = [f'{n}="{_escape(v)}"' for n, v in zip(names, values)]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    return repr(float(v)) if not float(v).is_integer() else str(int(v))
+
+
+class _Family:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_text: str,
+                 labelnames: Iterable[str] = ()) -> None:
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], object] = {}
+
+    def _key(self, labels: dict[str, str]) -> tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.labelnames)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    def render(self) -> list[str]:
+        with self._lock:
+            snap = sorted(self._series.items())
+        return [
+            f"{self.name}{_fmt_labels(self.labelnames, key)} {_fmt_value(v)}"
+            for key, v in snap
+        ]
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return float(self._series.get(key, 0.0))
+
+    render = Counter.render
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_text: str,
+                 labelnames: Iterable[str] = (),
+                 buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help_text, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = {"counts": [0] * (len(self.buckets) + 1),
+                          "sum": 0.0, "n": 0}
+                self._series[key] = series
+            # First bucket whose upper bound (le) admits the value; values
+            # beyond the last bound land in the +Inf overflow slot.
+            series["counts"][bisect_left(self.buckets, value)] += 1
+            series["sum"] += value
+            series["n"] += 1
+
+    def render(self) -> list[str]:
+        out = []
+        with self._lock:
+            snap = sorted(
+                (k, {"counts": list(s["counts"]), "sum": s["sum"], "n": s["n"]})
+                for k, s in self._series.items()
+            )
+        for key, s in snap:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += s["counts"][i]
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(self.labelnames, key, f'le=\"{_fmt_value(b)}\"')}"
+                    f" {cum}"
+                )
+            cum += s["counts"][-1]
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(self.labelnames, key, 'le=\"+Inf\"')} {cum}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.labelnames, key)} "
+                f"{repr(float(s['sum']))}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.labelnames, key)} {s['n']}"
+            )
+        return out
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _register(self, fam: _Family) -> _Family:
+        with self._lock:
+            existing = self._families.get(fam.name)
+            if existing is not None:
+                # Re-registration must be an exact match — a silent return
+                # of a differently-shaped family would defer the error to
+                # emission time, far from the offending registration.
+                if type(existing) is not type(fam):
+                    raise ValueError(f"{fam.name} already registered as "
+                                     f"{existing.kind}")
+                if existing.labelnames != fam.labelnames:
+                    raise ValueError(
+                        f"{fam.name} already registered with labels "
+                        f"{existing.labelnames}, got {fam.labelnames}"
+                    )
+                if (
+                    isinstance(existing, Histogram)
+                    and existing.buckets != fam.buckets  # type: ignore[attr-defined]
+                ):
+                    raise ValueError(
+                        f"{fam.name} already registered with buckets "
+                        f"{existing.buckets}"
+                    )
+                return existing
+            self._families[fam.name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str = "",
+                labelnames: Iterable[str] = ()) -> Counter:
+        return self._register(Counter(name, help_text, labelnames))  # type: ignore[return-value]
+
+    def gauge(self, name: str, help_text: str = "",
+              labelnames: Iterable[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_text, labelnames))  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_text: str = "",
+                  labelnames: Iterable[str] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_text, labelnames, buckets))  # type: ignore[return-value]
+
+    def render(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

@@ -1,0 +1,50 @@
+"""/metrics (Prometheus text) and /debug/traces (Chrome trace) endpoints.
+
+Mounts on the operator's ApiServer via its extra-handler hook (the same
+mechanism the dashboard uses). The reference exposes neither metrics nor
+traces (SURVEY.md §5); here every operator process is scrapeable and
+traceable out of the box.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tf_operator_tpu.runtime.metrics import REGISTRY, Registry
+from tf_operator_tpu.runtime.tracing import TRACER, Tracer
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="observability")
+
+
+class ObservabilityHandler:
+    def __init__(self, registry: Registry = REGISTRY, tracer: Tracer = TRACER):
+        self._registry = registry
+        self._tracer = tracer
+
+    def __call__(self, req: Any) -> bool:
+        path = req.path.split("?", 1)[0]
+        if req.command != "GET":
+            return False
+        if path == "/metrics":
+            body = self._registry.render().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/debug/traces":
+            body = self._tracer.export_chrome_trace().encode()
+            ctype = "application/json"
+        else:
+            return False
+        req.send_response(200)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+        return True
+
+
+def mount_observability(api_server: Any, registry: Registry = REGISTRY,
+                        tracer: Tracer = TRACER) -> ObservabilityHandler:
+    handler = ObservabilityHandler(registry, tracer)
+    api_server.add_handler(handler)
+    LOG.info("observability mounted at /metrics and /debug/traces")
+    return handler

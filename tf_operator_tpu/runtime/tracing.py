@@ -1,0 +1,100 @@
+"""Tracing: timed spans with Chrome-trace (catapult) export.
+
+SURVEY.md §5 calls tracing out as absent from the reference (its only
+latency observable is a per-sync wall-time log line); the rebuild adds it
+for real. Spans are cheap (one monotonic clock pair + a deque append), keep
+a bounded in-memory ring, and export in the `chrome://tracing` /
+ui.perfetto.dev JSON format via /debug/traces on the operator API server.
+
+Usage:
+    from tf_operator_tpu.runtime.tracing import TRACER
+    with TRACER.span("sync_job", job="ns/name"):
+        ...
+
+Spans record wall-clock microseconds (Chrome's "ts") from the tracer's
+epoch, thread id as "tid", and keyword attributes as "args".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@dataclass
+class Span:
+    name: str
+    start_us: float
+    duration_us: float
+    thread: int
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+
+class Tracer:
+    def __init__(self, capacity: int = 8192, process_name: str = "tpu-operator"):
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._epoch = time.monotonic()
+        self.process_name = process_name
+        self.enabled = True
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            t1 = time.monotonic()
+            s = Span(
+                name=name,
+                start_us=(t0 - self._epoch) * 1e6,
+                duration_us=(t1 - t0) * 1e6,
+                thread=threading.get_ident() % 2**31,
+                attrs=attrs,
+            )
+            with self._lock:
+                self._spans.append(s)
+
+    def spans(self, name: str | None = None) -> list[Span]:
+        with self._lock:
+            snap = list(self._spans)
+        return [s for s in snap if name is None or s.name == name]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def export_chrome_trace(self) -> str:
+        """Catapult JSON: load at chrome://tracing or ui.perfetto.dev."""
+        events: list[dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "args": {"name": self.process_name},
+            }
+        ]
+        for s in self.spans():
+            events.append(
+                {
+                    "name": s.name,
+                    "ph": "X",  # complete event
+                    "pid": 1,
+                    "tid": s.thread,
+                    "ts": round(s.start_us, 3),
+                    "dur": round(s.duration_us, 3),
+                    "args": {k: str(v) for k, v in s.attrs.items()},
+                }
+            )
+        return json.dumps({"traceEvents": events})
+
+
+TRACER = Tracer()
