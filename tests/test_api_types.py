@@ -9,11 +9,9 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.defaults import canonical_replica_type, set_defaults
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
-    ReplicaSpec,
     ReplicaType,
     RestartPolicy,
     TPUJob,
-    TPUSliceSpec,
 )
 from tf_operator_tpu.api.validation import ValidationError, validate_spec
 

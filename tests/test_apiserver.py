@@ -2,7 +2,6 @@
 round-trips, error mapping, label-selector lists, and streamed watches —
 the process boundary every reference call stack crosses (SURVEY.md §3)."""
 
-import threading
 import time
 
 import pytest

@@ -1,0 +1,211 @@
+"""Release builder, prow artifacts, checks, deploy, and the workflow DAG.
+
+Parity targets: py/release.py + build_and_push_image.py (content-tagged
+artifacts), py/prow.py (started/finished contract), py/py_checks.py (lint
+gate), py/deploy.py (operator up/down), and the Argo E2E DAG
+(workflows.libsonnet topology semantics)."""
+
+import json
+import os
+import tarfile
+import time
+
+import pytest
+
+from tf_operator_tpu.harness import prow
+from tf_operator_tpu.harness.checks import run_checks
+from tf_operator_tpu.harness.workflow import Step, Workflow
+from tf_operator_tpu.release.build import build_release
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---------------------------------------------------------------------------
+# prow artifacts
+# ---------------------------------------------------------------------------
+
+
+def test_prow_started_finished(tmp_path):
+    d = str(tmp_path)
+    started = prow.create_started(d, repo="org/tpu-operator", pull="123",
+                                  repo_root=REPO_ROOT, now=1000)
+    assert started["timestamp"] == 1000
+    assert started["repos"] == {"org/tpu-operator": "123"}
+    assert len(started["repo-version"]) == 40  # a real git sha
+
+    finished = prow.create_finished(d, False, {"e2e": "failed"}, now=2000)
+    assert finished["result"] == "FAILURE" and not finished["passed"]
+
+    on_disk = json.load(open(tmp_path / "finished.json"))
+    assert on_disk["metadata"] == {"e2e": "failed"}
+    assert json.load(open(tmp_path / "started.json"))["timestamp"] == 1000
+
+
+# ---------------------------------------------------------------------------
+# release build
+# ---------------------------------------------------------------------------
+
+
+def test_release_build_manifest_and_tarball(tmp_path):
+    out = str(tmp_path / "dist")
+    manifest = build_release(REPO_ROOT, out)
+    assert manifest["git_sha"] != "unknown"
+    assert manifest["name"].startswith("tpu-operator-0.")
+    tar_path = os.path.join(out, manifest["artifact"])
+    with tarfile.open(tar_path) as tar:
+        names = tar.getnames()
+    root = manifest["name"]
+    assert f"{root}/tf_operator_tpu/version.py" in names
+    assert f"{root}/bench.py" in names
+    assert all(n.startswith(root + "/") for n in names)
+
+    # content digest is deterministic across rebuilds
+    manifest2 = build_release(REPO_ROOT, str(tmp_path / "dist2"))
+    assert manifest2["content_digest"] == manifest["content_digest"]
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+
+def test_checks_flag_syntax_and_unused_imports(tmp_path):
+    (tmp_path / "bad.py").write_text("def broken(:\n")
+    (tmp_path / "unused.py").write_text("import os\nimport sys\nprint(sys.path)\n")
+    (tmp_path / "clean.py").write_text("import os\nprint(os.getcwd())\n")
+    problems = run_checks(("bad.py", "unused.py", "clean.py"), str(tmp_path))
+    msgs = {p.message for p in problems}
+    assert any("syntax error" in m for m in msgs)
+    assert "unused import: os" in msgs
+    assert not any(p.path.endswith("clean.py") for p in problems)
+
+
+def test_repo_passes_its_own_checks():
+    assert run_checks(root=REPO_ROOT) == []
+
+
+# ---------------------------------------------------------------------------
+# workflow DAG
+# ---------------------------------------------------------------------------
+
+
+def _mark(ctx_log, name, fail=False, sleep=0.0):
+    def action(ctx):
+        if sleep:
+            time.sleep(sleep)
+        ctx_log.append(name)
+        if fail:
+            raise RuntimeError(f"{name} exploded")
+    return action
+
+
+def test_workflow_runs_dag_in_dependency_order(tmp_path):
+    log = []
+    wf = Workflow("t", [
+        Step("a", _mark(log, "a")),
+        Step("b", _mark(log, "b"), deps=("a",)),
+        Step("c", _mark(log, "c"), deps=("a",)),
+        Step("d", _mark(log, "d"), deps=("b", "c")),
+    ])
+    assert wf.run(str(tmp_path)) is True
+    assert log[0] == "a" and log[-1] == "d" and set(log) == {"a", "b", "c", "d"}
+    assert json.load(open(tmp_path / "finished.json"))["passed"] is True
+
+
+def test_workflow_failure_skips_dependents_but_runs_always_steps(tmp_path):
+    log = []
+    wf = Workflow("t", [
+        Step("ok", _mark(log, "ok")),
+        Step("boom", _mark(log, "boom", fail=True), deps=("ok",)),
+        Step("after", _mark(log, "after"), deps=("boom",)),
+        Step("teardown", _mark(log, "teardown"), deps=("boom",), always=True),
+    ])
+    assert wf.run(str(tmp_path)) is False
+    assert "after" not in log  # skipped
+    assert "teardown" in log  # exit-handler semantics
+    finished = json.load(open(tmp_path / "finished.json"))
+    assert finished["metadata"] == {
+        "ok": "passed", "boom": "failed", "after": "skipped",
+        "teardown": "passed",
+    }
+    junit_xml = (tmp_path / "junit_t.xml").read_text()
+    assert "boom exploded" in junit_xml
+
+
+def test_workflow_subprocess_step_logs_and_exit_codes(tmp_path):
+    import sys
+
+    wf = Workflow("t", [
+        Step("shout", [sys.executable, "-c", "print('hello from step')"]),
+        Step("die", [sys.executable, "-c", "raise SystemExit(3)"]),
+    ])
+    assert wf.run(str(tmp_path)) is False
+    assert "hello from step" in (tmp_path / "logs" / "shout.log").read_text()
+    assert wf.results["die"].status == "failed"
+    assert "exit code 3" in wf.results["die"].message
+
+
+def test_workflow_parallel_branches_overlap(tmp_path):
+    log = []
+    t0 = time.monotonic()
+    wf = Workflow("t", [
+        Step("s1", _mark(log, "s1", sleep=0.5)),
+        Step("s2", _mark(log, "s2", sleep=0.5)),
+        Step("s3", _mark(log, "s3", sleep=0.5)),
+    ])
+    assert wf.run(str(tmp_path)) is True
+    assert time.monotonic() - t0 < 1.2  # ran concurrently, not 1.5s serially
+
+
+def test_workflow_rejects_bad_dags():
+    with pytest.raises(ValueError, match="unknown dep"):
+        Workflow("t", [Step("a", [], deps=("nope",))])
+    with pytest.raises(ValueError, match="cycle"):
+        Workflow("t", [
+            Step("a", [], deps=("b",)),
+            Step("b", [], deps=("a",)),
+        ])
+    with pytest.raises(ValueError, match="duplicate"):
+        Workflow("t", [Step("a", []), Step("a", [])])
+
+
+# ---------------------------------------------------------------------------
+# the full default E2E workflow against a real operator (integration)
+# ---------------------------------------------------------------------------
+
+
+def test_default_e2e_workflow_end_to_end(tmp_path):
+    from tf_operator_tpu.harness.workflow import default_e2e_workflow
+
+    wf = default_e2e_workflow(
+        unit_tests=("tests/test_utils.py",), e2e_workers=2, e2e_trials=1
+    )
+    ok = wf.run(str(tmp_path))
+    statuses = {n: r.status for n, r in wf.results.items()}
+    assert ok, (statuses, _tail_logs(tmp_path))
+    assert statuses == {
+        "build": "passed", "unit": "passed", "deploy": "passed",
+        "e2e": "passed", "teardown": "passed",
+    }
+    assert (tmp_path / "dist" / "manifest.json").exists()
+    assert (tmp_path / "junit_e2e_suite.xml").exists()
+    assert json.load(open(tmp_path / "finished.json"))["passed"] is True
+
+
+def _tail_logs(tmp_path):
+    out = {}
+    logs = tmp_path / "logs"
+    if logs.is_dir():
+        for f in logs.iterdir():
+            out[f.name] = f.read_text()[-2000:]
+    return out
+
+
+def test_workflow_callable_step_timeout(tmp_path):
+    def hang(ctx):
+        time.sleep(30)
+
+    wf = Workflow("t", [Step("hang", hang, timeout=0.5)])
+    t0 = time.monotonic()
+    assert wf.run(str(tmp_path)) is False
+    assert time.monotonic() - t0 < 5
+    assert "timeout" in wf.results["hang"].message.lower()

@@ -7,7 +7,7 @@ import json
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.controller import cluster_spec
 from tf_operator_tpu.controller import status as status_engine
-from tf_operator_tpu.api.types import JobCondition, JobConditionType, TPUJobStatus
+from tf_operator_tpu.api.types import JobConditionType, TPUJobStatus
 from tf_operator_tpu.utils import testutil
 
 

@@ -9,13 +9,10 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import (
     CleanPodPolicy,
     JobConditionType,
-    ReplicaType,
     RestartPolicy,
 )
 from tf_operator_tpu.control.pod_control import FakePodControl
 from tf_operator_tpu.control.service_control import FakeServiceControl
-from tf_operator_tpu.controller import status as status_engine
-from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
 from tf_operator_tpu.controller.tpujob_controller import TPUJobController
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.events import FakeRecorder

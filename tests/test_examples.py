@@ -6,7 +6,6 @@ run as a TFJob)."""
 
 import os
 import sys
-import time
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.client import TPUJobClient

@@ -4,8 +4,6 @@ injection through the published replica address (the terminateReplica
 analog)."""
 
 import os
-import subprocess
-import time
 
 import pytest
 

@@ -4,7 +4,6 @@ under dp / dp+tp+sp shardings, the distributed-env contract parses."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.resnet import resnet18, resnet50

@@ -16,7 +16,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Sequence
 
-from tf_operator_tpu.api import constants, helpers
+from tf_operator_tpu.api import helpers
 from tf_operator_tpu.api.types import JobConditionType, TPUJob
 from tf_operator_tpu.controller import status as status_engine
 from tf_operator_tpu.runtime import objects

@@ -11,8 +11,7 @@ JobController to be reused by other Kubeflow operators.
 
 from __future__ import annotations
 
-import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 from tf_operator_tpu.api import constants

@@ -25,12 +25,11 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.defaults import set_defaults
 from tf_operator_tpu.api.types import (
     JobConditionType,
-    ReplicaSpec,
     ReplicaType,
     RestartPolicy,
     TPUJob,
 )
-from tf_operator_tpu.api.validation import ValidationError, validate_spec
+from tf_operator_tpu.api.validation import validate_spec
 from tf_operator_tpu.api.types import CleanPodPolicy
 from tf_operator_tpu.control.pod_control import PodControlInterface, RealPodControl
 from tf_operator_tpu.control.service_control import (
@@ -47,7 +46,7 @@ from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ClusterClient, Conflict, NotFound
 from tf_operator_tpu.runtime.metrics import REGISTRY
 from tf_operator_tpu.runtime.tracing import TRACER
-from tf_operator_tpu.utils import exit_codes, logger
+from tf_operator_tpu.utils import logger
 
 # Observability (absent from the reference — SURVEY.md §5): reconcile
 # latency/outcome plus queue pressure, scraped via /metrics.

@@ -25,7 +25,7 @@ import os
 from typing import Any
 from urllib.parse import unquote, urlparse
 
-from tf_operator_tpu.api import constants, helpers
+from tf_operator_tpu.api import helpers
 from tf_operator_tpu.runtime import objects, podlogs
 from tf_operator_tpu.runtime.client import AlreadyExists, ApiError, ClusterClient
 from tf_operator_tpu.utils import logger

@@ -1,0 +1,133 @@
+"""Static checks runner — the py_checks.py analog (lint + syntax gate).
+
+Parity: py/py_checks.py:18 (pylint over the tree + unittest discovery as a
+CI gate). The environment ships no linter, so the checks are self-contained:
+per-file syntax compilation and an AST unused-import lint. Unit tests are a
+separate workflow step (pytest), matching the reference's split.
+
+    python -m tf_operator_tpu.harness.checks [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from dataclasses import dataclass
+
+DEFAULT_PATHS = ("tf_operator_tpu", "tests", "examples", "bench.py")
+
+
+@dataclass
+class Problem:
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.message}"
+
+
+def _py_files(paths: tuple[str, ...], root: str) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(
+                os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+            )
+    return sorted(out)
+
+
+def check_syntax(path: str) -> list[Problem]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        compile(src, path, "exec")
+    except SyntaxError as exc:
+        return [Problem(path, exc.lineno or 0, f"syntax error: {exc.msg}")]
+    return []
+
+
+def check_unused_imports(path: str) -> list[Problem]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # reported by check_syntax
+    imported: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                imported.setdefault(name, node.lineno)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                imported.setdefault(a.asname or a.name, node.lineno)
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+    # names listed in __all__ count as used (re-export idiom) — only the
+    # __all__ assignment, not arbitrary string literals, or any dict key
+    # that happens to spell an import name would mask real unused imports
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        if not any(
+            isinstance(t, ast.Name) and t.id == "__all__" for t in targets
+        ):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                used.add(sub.value)
+    return [
+        Problem(path, lineno, f"unused import: {name}")
+        for name, lineno in sorted(imported.items(), key=lambda kv: kv[1])
+        if name not in used
+    ]
+
+
+def run_checks(paths: tuple[str, ...] = DEFAULT_PATHS,
+               root: str | None = None) -> list[Problem]:
+    root = root or os.getcwd()
+    problems: list[Problem] = []
+    for path in _py_files(paths, root):
+        problems.extend(check_syntax(path))
+        problems.extend(check_unused_imports(path))
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
+    p.add_argument("--root", default=os.getcwd())
+    args = p.parse_args(argv)
+    problems = run_checks(tuple(args.paths), args.root)
+    for prob in problems:
+        print(prob, file=sys.stderr)
+    print(f"checks: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

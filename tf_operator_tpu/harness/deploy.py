@@ -1,0 +1,157 @@
+"""Deploy tooling: bring up / tear down an operator stack for CI and tests.
+
+Parity: py/deploy.py (GKE cluster setup + ksonnet deploy of the operator,
+`deploy.py:98,180,254`). The TPU-native framework's "cluster" is the
+operator process itself (in-memory runtime + HTTP API + local executor), so
+deploy == launch an operator subprocess, wait for its API to answer, and
+hand back the master URL; teardown == terminate it. Used as a context
+manager by the test fixtures and the E2E workflow, or standalone:
+
+    python -m tf_operator_tpu.harness.deploy up --port 8080 --pid-file op.pid
+    python -m tf_operator_tpu.harness.deploy down --pid-file op.pid
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class OperatorDeployment:
+    """A live operator subprocess (API server + controller + executor)."""
+
+    def __init__(
+        self,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        local_executor: bool = True,
+        dashboard: bool = False,
+        reconcile_period: float = 0.3,
+        informer_resync: float = 1.0,
+        log_path: str | None = None,
+        env: dict[str, str] | None = None,
+        startup_timeout: float = 20.0,
+    ) -> None:
+        self.host = host
+        self.port = port or _free_port()
+        self.log_path = log_path
+        self._startup_timeout = startup_timeout
+        self._proc: subprocess.Popen | None = None
+        self._argv = [
+            sys.executable, "-m", "tf_operator_tpu.cli.operator",
+            "--serve", str(self.port), "--serve-host", host,
+            "--reconcile-period", str(reconcile_period),
+            "--informer-resync", str(informer_resync),
+        ]
+        if local_executor:
+            self._argv.append("--local-executor")
+        if dashboard:
+            self._argv.append("--dashboard")
+        self._env = dict(os.environ)
+        self._env["PYTHONPATH"] = (
+            REPO_ROOT + os.pathsep + self._env.get("PYTHONPATH", "")
+        )
+        if env:
+            self._env.update(env)
+
+    @property
+    def master(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def pid(self) -> int | None:
+        return self._proc.pid if self._proc else None
+
+    def start(self) -> "OperatorDeployment":
+        # Log to a file (undrained pipes block the operator mid-reconcile).
+        out: Any = subprocess.DEVNULL
+        if self.log_path:
+            out = open(self.log_path, "wb")
+        self._proc = subprocess.Popen(
+            self._argv, env=self._env, stdout=out, stderr=subprocess.STDOUT
+        )
+        deadline = time.monotonic() + self._startup_timeout
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(self.master + "/api/tpujobs", timeout=1)
+                return self
+            except (urllib.error.URLError, ConnectionError):
+                if self._proc.poll() is not None:
+                    raise RuntimeError(
+                        f"operator died at startup (rc={self._proc.returncode}"
+                        f"{', log ' + self.log_path if self.log_path else ''})"
+                    )
+                time.sleep(0.2)
+        self.stop()
+        raise TimeoutError(f"operator API not ready on {self.master}")
+
+    def stop(self, grace: float = 5.0) -> None:
+        if self._proc is None:
+            return
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=grace)
+        self._proc = None
+
+    def __enter__(self) -> "OperatorDeployment":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    up = sub.add_parser("up")
+    up.add_argument("--port", type=int, default=0)
+    up.add_argument("--pid-file", required=True)
+    up.add_argument("--log-file", default=None)
+    up.add_argument("--dashboard", action="store_true")
+    down = sub.add_parser("down")
+    down.add_argument("--pid-file", required=True)
+    args = p.parse_args(argv)
+
+    if args.cmd == "up":
+        dep = OperatorDeployment(
+            port=args.port, dashboard=args.dashboard, log_path=args.log_file
+        )
+        dep.start()
+        with open(args.pid_file, "w") as f:
+            f.write(f"{dep.pid}\n{dep.master}\n")
+        print(dep.master)
+        # Detach: the subprocess outlives this CLI.
+        dep._proc = None  # noqa: SLF001 — intentional detach
+        return 0
+    pid = int(open(args.pid_file).read().splitlines()[0])
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        pass
+    os.unlink(args.pid_file)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,57 @@
+"""CI artifact metadata: started.json / finished.json.
+
+Parity: py/prow.py:81-119 (create_started / create_finished) — the contract
+Prow-style CI dashboards read from the artifact directory to render run
+status. Kept format-compatible: epoch timestamps, pull/repo metadata in
+started.json, success/result plus metadata in finished.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any
+
+
+def git_sha(repo_root: str | None = None) -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except OSError:
+        pass
+    return "unknown"
+
+
+def create_started(artifacts_dir: str, *, repo: str = "", pull: str = "",
+                   repo_root: str | None = None,
+                   now: float | None = None) -> dict[str, Any]:
+    started = {
+        "timestamp": int(now if now is not None else time.time()),
+        "repos": {repo: pull} if repo else {},
+        "repo-version": git_sha(repo_root),
+    }
+    os.makedirs(artifacts_dir, exist_ok=True)
+    with open(os.path.join(artifacts_dir, "started.json"), "w") as f:
+        json.dump(started, f, indent=2, sort_keys=True)
+    return started
+
+
+def create_finished(artifacts_dir: str, success: bool,
+                    metadata: dict[str, Any] | None = None,
+                    *, now: float | None = None) -> dict[str, Any]:
+    finished = {
+        "timestamp": int(now if now is not None else time.time()),
+        "result": "SUCCESS" if success else "FAILURE",
+        "passed": bool(success),
+        "metadata": metadata or {},
+    }
+    os.makedirs(artifacts_dir, exist_ok=True)
+    with open(os.path.join(artifacts_dir, "finished.json"), "w") as f:
+        json.dump(finished, f, indent=2, sort_keys=True)
+    return finished

@@ -38,10 +38,12 @@ class TestFailure(AssertionError):
     pass
 
 
-def _http_get_json(url: str, timeout: float = 10.0, retry_for: float = 10.0) -> dict:
+def _http_get_json(url: str, timeout: float = 10.0, retry_for: float = 45.0) -> dict:
     """GET with retry on connection refusal: a pod can be Running before its
     server has bound the port (same race the reference absorbs with its
-    retrying service-proxy polls)."""
+    retrying service-proxy polls). The budget is generous — under CI the
+    replica interpreter starts while parallel workflow steps compete for
+    CPU, and a too-small window flakes."""
     deadline = time.monotonic() + retry_for
     while True:
         try:

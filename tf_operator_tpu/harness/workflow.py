@@ -1,0 +1,310 @@
+"""CI workflow DAG runner — the Argo-workflow analog, runnable anywhere.
+
+Parity: test/workflows/components/workflows.libsonnet:190-250 (the reference
+E2E DAG: checkout → build + py-test in parallel → setup cluster → run test
+suites in parallel → teardown, with per-step artifacts/logs and a junit
+summary consumed by Prow). The reference needs an Argo controller on a GKE
+cluster to execute that DAG; here the DAG executes locally with threads —
+same topology semantics (steps run as soon as their deps pass; a failure
+skips all transitive dependents; independent branches run concurrently),
+writing the same artifact contract (started.json/finished.json, per-step
+logs, junit XML).
+
+    wf = Workflow("e2e", [Step("build", [sys.executable, "-m", ...]),
+                          Step("test", ..., deps=("build",))])
+    ok = wf.run(artifacts_dir)
+
+Steps are either subprocess commands (list[str]) or Python callables taking
+a context dict ({"artifacts_dir", "env", "outputs"}); callables can publish
+outputs (e.g. the deployed master URL) for downstream steps to read.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tf_operator_tpu.harness import junit, prow
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="workflow")
+
+PASSED, FAILED, SKIPPED = "passed", "failed", "skipped"
+
+
+@dataclass
+class Step:
+    name: str
+    action: list[str] | Callable[[dict[str, Any]], None]
+    deps: tuple[str, ...] = ()
+    timeout: float = 600.0
+    env: dict[str, str] = field(default_factory=dict)
+    # Exit-handler semantics (Argo onExit analog): run once all deps have
+    # COMPLETED regardless of their status — for teardown steps that must
+    # release resources even when the steps before them failed.
+    always: bool = False
+
+
+@dataclass
+class StepResult:
+    name: str
+    status: str
+    duration: float = 0.0
+    message: str = ""
+
+
+class Workflow:
+    def __init__(self, name: str, steps: list[Step]) -> None:
+        self.name = name
+        self.steps = {s.name: s for s in steps}
+        if len(self.steps) != len(steps):
+            raise ValueError("duplicate step names")
+        for s in steps:
+            for d in s.deps:
+                if d not in self.steps:
+                    raise ValueError(f"step {s.name}: unknown dep {d}")
+        self._check_acyclic()
+
+    def _check_acyclic(self) -> None:
+        seen: dict[str, int] = {}  # 1=visiting, 2=done
+
+        def visit(n: str, chain: tuple[str, ...]) -> None:
+            state = seen.get(n)
+            if state == 2:
+                return
+            if state == 1:
+                raise ValueError(f"dependency cycle: {' -> '.join(chain + (n,))}")
+            seen[n] = 1
+            for d in self.steps[n].deps:
+                visit(d, chain + (n,))
+            seen[n] = 2
+
+        for n in self.steps:
+            visit(n, ())
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, artifacts_dir: str,
+            env: dict[str, str] | None = None) -> bool:
+        """Execute the DAG; returns True when every step passed."""
+        os.makedirs(os.path.join(artifacts_dir, "logs"), exist_ok=True)
+        prow.create_started(artifacts_dir)
+        ctx: dict[str, Any] = {
+            "artifacts_dir": artifacts_dir,
+            "env": dict(env or {}),
+            "outputs": {},  # step name -> published value
+        }
+
+        results: dict[str, StepResult] = {}
+        running: set[str] = set()
+        cond = threading.Condition()
+
+        def runnable(name: str) -> bool:
+            step = self.steps[name]
+            if step.always:
+                return all(d in results for d in step.deps)
+            return all(
+                d in results and results[d].status == PASSED
+                for d in step.deps
+            )
+
+        def blocked_forever(name: str) -> bool:
+            if self.steps[name].always:
+                return False
+            return any(
+                d in results and results[d].status != PASSED
+                for d in self.steps[name].deps
+            )
+
+        def execute(step: Step) -> None:
+            t0 = time.monotonic()
+            res = StepResult(step.name, PASSED)
+            log_path = os.path.join(artifacts_dir, "logs", f"{step.name}.log")
+            try:
+                if callable(step.action):
+                    # Enforce the timeout on callables too (a hung deploy
+                    # must fail the step, not wedge the whole workflow).
+                    # Python threads can't be killed: on timeout the step
+                    # thread leaks until process exit, but the DAG proceeds.
+                    err: list[BaseException] = []
+
+                    def _call() -> None:
+                        try:
+                            step.action(ctx)
+                        except BaseException as e:  # noqa: BLE001
+                            err.append(e)
+
+                    t = threading.Thread(
+                        target=_call, name=f"wf-{step.name}-call", daemon=True
+                    )
+                    t.start()
+                    t.join(step.timeout)
+                    if t.is_alive():
+                        raise TimeoutError(
+                            f"step exceeded timeout ({step.timeout}s)"
+                        )
+                    if err:
+                        raise err[0]
+                else:
+                    step_env = dict(os.environ)
+                    step_env.update(ctx["env"])
+                    step_env.update(step.env)
+                    with open(log_path, "wb") as log_f:
+                        proc = subprocess.run(
+                            step.action, env=step_env, stdout=log_f,
+                            stderr=subprocess.STDOUT, timeout=step.timeout,
+                        )
+                    if proc.returncode != 0:
+                        res.status = FAILED
+                        res.message = (
+                            f"exit code {proc.returncode}; log: {log_path}"
+                        )
+            except Exception as exc:  # noqa: BLE001 — step isolation
+                res.status = FAILED
+                res.message = f"{type(exc).__name__}: {exc}"
+                with open(log_path, "ab") as log_f:
+                    log_f.write(traceback.format_exc().encode())
+            res.duration = time.monotonic() - t0
+            LOG.info("step %s: %s (%.1fs) %s", step.name, res.status,
+                     res.duration, res.message)
+            with cond:
+                results[step.name] = res
+                running.discard(step.name)
+                cond.notify_all()
+
+        with cond:
+            while len(results) < len(self.steps):
+                progressed = False
+                for name, step in self.steps.items():
+                    if name in results or name in running:
+                        continue
+                    if blocked_forever(name):
+                        results[name] = StepResult(
+                            name, SKIPPED, message="dependency failed"
+                        )
+                        progressed = True
+                    elif runnable(name):
+                        running.add(name)
+                        threading.Thread(
+                            target=execute, args=(step,),
+                            name=f"wf-{name}", daemon=True,
+                        ).start()
+                        progressed = True
+                if len(results) == len(self.steps):
+                    break
+                if not progressed and not running:
+                    raise RuntimeError("workflow wedged (scheduler bug)")
+                if not progressed:
+                    cond.wait()
+
+        ordered = [results[n] for n in self.steps]
+        success = all(r.status == PASSED for r in ordered)
+        cases = [
+            junit.TestCase(
+                name=r.name, class_name=self.name, time=r.duration,
+                failure=None if r.status == PASSED else f"{r.status}: {r.message}",
+            )
+            for r in ordered
+        ]
+        junit.write_junit_xml(
+            cases, os.path.join(artifacts_dir, f"junit_{self.name}.xml")
+        )
+        prow.create_finished(
+            artifacts_dir, success,
+            {r.name: r.status for r in ordered},
+        )
+        self.results = results
+        return success
+
+
+# ---------------------------------------------------------------------------
+# The default CI workflow — the reference E2E DAG rebuilt for this framework
+# (workflows.libsonnet topology: build + unit in parallel → deploy operator →
+# e2e suite → teardown-always).
+# ---------------------------------------------------------------------------
+
+
+def default_e2e_workflow(
+    *,
+    unit_tests: tuple[str, ...] = ("tests/test_api_types.py", "tests/test_utils.py"),
+    e2e_workers: int = 2,
+    e2e_trials: int = 1,
+) -> Workflow:
+    import sys
+
+    from tf_operator_tpu.harness.deploy import REPO_ROOT, OperatorDeployment
+
+    def build(ctx: dict[str, Any]) -> None:
+        from tf_operator_tpu.release.build import build_release
+
+        manifest = build_release(
+            REPO_ROOT, os.path.join(ctx["artifacts_dir"], "dist")
+        )
+        ctx["outputs"]["release"] = manifest
+
+    def deploy(ctx: dict[str, Any]) -> None:
+        dep = OperatorDeployment(
+            log_path=os.path.join(ctx["artifacts_dir"], "logs", "operator.log")
+        )
+        dep.start()
+        ctx["outputs"]["master"] = dep.master
+        ctx["outputs"]["deployment"] = dep
+
+    def e2e(ctx: dict[str, Any]) -> None:
+        from tf_operator_tpu.harness import test_runner
+
+        rc = test_runner.main([
+            "--master", ctx["outputs"]["master"],
+            "--name", "wf-e2e",
+            "--workers", str(e2e_workers),
+            "--trials", str(e2e_trials),
+            "--timeout", "120",
+            "--junit-path",
+            os.path.join(ctx["artifacts_dir"], "junit_e2e_suite.xml"),
+        ])
+        if rc != 0:
+            raise RuntimeError(f"e2e suite failed (rc={rc})")
+
+    def teardown(ctx: dict[str, Any]) -> None:
+        dep = ctx["outputs"].get("deployment")
+        if dep is not None:
+            dep.stop()
+
+    env = {"PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    return Workflow(
+        "tpu-operator-e2e",
+        [
+            Step("build", build),
+            Step("unit", [
+                sys.executable, "-m", "pytest", "-q", *unit_tests,
+            ], env=env, timeout=900.0),
+            Step("deploy", deploy, deps=("build",)),
+            Step("e2e", e2e, deps=("deploy",), timeout=600.0),
+            Step("teardown", teardown, deps=("deploy", "e2e"), always=True),
+        ],
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--artifacts", default="artifacts")
+    p.add_argument("--unit-tests", nargs="*", default=None)
+    args = p.parse_args(argv)
+    kwargs: dict[str, Any] = {}
+    if args.unit_tests is not None:
+        kwargs["unit_tests"] = tuple(args.unit_tests)
+    wf = default_e2e_workflow(**kwargs)
+    ok = wf.run(args.artifacts)
+    print(f"workflow {wf.name}: {'SUCCESS' if ok else 'FAILURE'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,7 @@ capability the rebuild adds on top of parity). Sharding design:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import flax.linen as nn

@@ -1,0 +1,115 @@
+"""Release builder: versioned, git-tagged framework artifacts.
+
+Parity: py/release.py + py/build_and_push_image.py (build the operator
+binaries + dashboard into one image, tag by git hash, write a manifest the
+deploy tooling consumes). The TPU-native framework is pure Python + JAX, so
+the artifact is a tarball of the package tree (sources + dashboard frontend
++ examples) with a manifest.json carrying version/git-sha/content digest —
+the same contract (content-addressed, reproducibly tagged) without a Docker
+daemon in the loop.
+
+CLI:  python -m tf_operator_tpu.release.build --out dist/
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import os
+import tarfile
+import time
+from typing import Any
+
+from tf_operator_tpu import version as version_mod
+from tf_operator_tpu.harness.prow import git_sha
+
+# _build holds machine-compiled .so files (content varies by host/arch and
+# by whether a compile has run) — shipping them would break both the
+# reproducible content digest and portability; targets rebuild on demand.
+EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", "dist", "_build"}
+INCLUDE_TOP = ("tf_operator_tpu", "examples", "bench.py", "README.md")
+
+
+def _walk_files(repo_root: str) -> list[str]:
+    files: list[str] = []
+    for top in INCLUDE_TOP:
+        path = os.path.join(repo_root, top)
+        if os.path.isfile(path):
+            files.append(top)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith((".pyc", ".pyo")):
+                    continue
+                full = os.path.join(dirpath, fn)
+                files.append(os.path.relpath(full, repo_root))
+    return sorted(files)
+
+
+def content_digest(repo_root: str, files: list[str]) -> str:
+    """Deterministic digest over relative paths + file bytes."""
+    h = hashlib.sha256()
+    for rel in files:
+        h.update(rel.encode())
+        h.update(b"\0")
+        with open(os.path.join(repo_root, rel), "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 16), b""):
+                h.update(chunk)
+        h.update(b"\0")
+    return h.hexdigest()
+
+
+def build_release(repo_root: str, out_dir: str,
+                  *, version: str | None = None) -> dict[str, Any]:
+    """Write {name}.tar.gz + manifest.json into out_dir; returns manifest."""
+    version = version or version_mod.VERSION
+    sha = git_sha(repo_root)
+    files = _walk_files(repo_root)
+    digest = content_digest(repo_root, files)
+    tag = f"{version}-g{sha[:12]}" if sha != "unknown" else version
+    name = f"tpu-operator-{tag}"
+
+    os.makedirs(out_dir, exist_ok=True)
+    tar_path = os.path.join(out_dir, f"{name}.tar.gz")
+    # Deterministic tar: fixed mtime/uid/gid, sorted members.
+    with tarfile.open(tar_path, "w:gz") as tar:
+        for rel in files:
+            full = os.path.join(repo_root, rel)
+            info = tar.gettarinfo(full, arcname=f"{name}/{rel}")
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            info.mtime = 0
+            with open(full, "rb") as f:
+                tar.addfile(info, io.BytesIO(f.read()))
+
+    manifest = {
+        "name": name,
+        "version": version,
+        "git_sha": sha,
+        "content_digest": digest,
+        "artifact": os.path.basename(tar_path),
+        "files": len(files),
+        "built_at": int(time.time()),
+    }
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    return manifest
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--repo-root", default=os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    p.add_argument("--out", default="dist")
+    p.add_argument("--version", default=None)
+    args = p.parse_args(argv)
+    manifest = build_release(args.repo_root, args.out, version=args.version)
+    print(json.dumps(manifest, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

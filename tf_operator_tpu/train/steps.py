@@ -9,7 +9,6 @@ function of (state, batch): no Python control flow under jit, static shapes.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable
 
 import jax

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 import logging
 import sys
-from typing import Any, Mapping, MutableMapping
+from typing import Any, MutableMapping
 
 _ROOT = "tpuflow"
 
