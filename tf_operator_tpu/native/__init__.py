@@ -1,0 +1,85 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The compiled artifacts are content-addressed under ``_build/`` next to the
+sources; a missing toolchain or failed compile degrades gracefully — every
+native component has a pure-Python fallback chosen by its Python wrapper
+(see native/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _source_digest(src_path: str) -> str:
+    with open(src_path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def load_library(source: str, *, cxxflags: tuple[str, ...] = ()) -> ctypes.CDLL:
+    """Compile (if needed) and dlopen a one-file C++ library.
+
+    ``source`` is a filename relative to this package. The .so is keyed by a
+    digest of the source, so edits rebuild automatically and stale binaries
+    are never loaded.
+    """
+    src_path = os.path.join(_DIR, source)
+    key = f"{source}:{_source_digest(src_path)}"
+    with _LOCK:
+        if key in _CACHE:
+            lib = _CACHE[key]
+            if lib is None:
+                raise NativeBuildError(f"previous build of {source} failed")
+            return lib
+        so_path = os.path.join(
+            _BUILD_DIR, f"{os.path.splitext(source)[0]}-{key.split(':')[1]}.so"
+        )
+        if not os.path.exists(so_path):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            # Unique tmp per process: concurrent builders (test workers,
+            # executor replicas) must not interleave writes into one file;
+            # os.replace publishes whole .so files atomically, last wins.
+            fd, tmp = tempfile.mkstemp(
+                dir=_BUILD_DIR, suffix=".so.tmp"
+            )
+            os.close(fd)
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                *cxxflags, src_path, "-o", tmp,
+            ]
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=120
+                )
+            except (OSError, subprocess.TimeoutExpired) as e:
+                _CACHE[key] = None
+                raise NativeBuildError(f"g++ unavailable: {e}") from e
+            if proc.returncode != 0:
+                _CACHE[key] = None
+                raise NativeBuildError(
+                    f"compile failed for {source}:\n{proc.stderr[-4000:]}"
+                )
+            os.replace(tmp, so_path)
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError as e:
+            # Corrupt or wrong-arch binary: report as a build problem so
+            # engine="auto" callers fall back instead of crashing.
+            _CACHE[key] = None
+            raise NativeBuildError(f"dlopen failed for {so_path}: {e}") from e
+        _CACHE[key] = lib
+        return lib

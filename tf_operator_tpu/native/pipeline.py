@@ -1,0 +1,218 @@
+"""Record pipeline: ctypes binding for the C++ loader + Python fallback.
+
+``RecordPipeline`` streams batches of fixed-size records from a binary file
+with per-epoch shuffling and multi-threaded prefetch. The native engine
+(record_pipeline.cc) does the IO and shuffling off the GIL; the pure-Python
+engine implements identical semantics (same splitmix64 shuffle, same batch
+order) for environments without a toolchain — engines are interchangeable
+and the tests assert batch-for-batch equivalence.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import queue as queue_mod
+from typing import Iterator
+
+import numpy as np
+
+from tf_operator_tpu.native import NativeBuildError, load_library
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="native-pipeline")
+
+_MASK = (1 << 64) - 1
+
+
+def _splitmix64_stream(seed: int) -> Iterator[int]:
+    s = (seed ^ 0x9E3779B97F4A7C15) & _MASK
+    while True:
+        s = (s + 0x9E3779B97F4A7C15) & _MASK
+        z = s
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+        yield (z ^ (z >> 31)) & _MASK
+
+
+def epoch_order(num_records: int, seed: int, epoch: int,
+                shuffle: bool) -> np.ndarray:
+    """The record order for one epoch — shared by both engines (and the
+    oracle the tests check the native engine against)."""
+    order = np.arange(num_records, dtype=np.uint64)
+    if shuffle and num_records > 1:
+        rng = _splitmix64_stream(seed * 1000003 + epoch)
+        for i in range(num_records - 1, 0, -1):
+            j = next(rng) % (i + 1)
+            order[i], order[j] = order[j], order[i]
+    return order
+
+
+class _NativeEngine:
+    def __init__(self, path: str, record_bytes: int, batch: int,
+                 prefetch: int, threads: int, seed: int,
+                 shuffle: bool, loop: bool) -> None:
+        lib = load_library("record_pipeline.cc")
+        lib.dp_open.restype = ctypes.c_void_p
+        lib.dp_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.dp_next.restype = ctypes.c_int64
+        lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.dp_close.argtypes = [ctypes.c_void_p]
+        lib.dp_num_records.restype = ctypes.c_uint64
+        lib.dp_num_records.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._record_bytes = record_bytes
+        self._batch = batch
+        self._handle = lib.dp_open(
+            path.encode(), record_bytes, batch, prefetch, threads, seed,
+            int(shuffle), int(loop),
+        )
+        if not self._handle:
+            raise NativeBuildError(f"dp_open failed for {path}")
+        self.num_records = int(lib.dp_num_records(self._handle))
+        self._buf = ctypes.create_string_buffer(record_bytes * batch)
+
+    def next(self) -> np.ndarray | None:
+        n = self._lib.dp_next(self._handle, self._buf, len(self._buf))
+        if n == 0:
+            return None
+        if n < 0:
+            raise IOError("native record pipeline read error")
+        raw = np.frombuffer(self._buf.raw[: n * self._record_bytes], np.uint8)
+        return raw.reshape(n, self._record_bytes).copy()
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dp_close(self._handle)
+            self._handle = None
+
+
+class _PythonEngine:
+    """Same semantics, implemented with reader threads + a bounded queue."""
+
+    def __init__(self, path: str, record_bytes: int, batch: int,
+                 prefetch: int, threads: int, seed: int,
+                 shuffle: bool, loop: bool) -> None:
+        size = os.path.getsize(path)
+        if size == 0 or size % record_bytes:
+            raise ValueError(f"{path}: size {size} not a multiple of record")
+        self.num_records = size // record_bytes
+        self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, prefetch))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce,
+            args=(path, record_bytes, batch, seed, shuffle, loop),
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _produce(self, path, record_bytes, batch, seed, shuffle, loop):
+        try:
+            epoch = 0
+            with open(path, "rb") as f:
+                while not self._stop.is_set():
+                    order = epoch_order(self.num_records, seed, epoch, shuffle)
+                    for lo in range(0, self.num_records, batch):
+                        recs = order[lo: lo + batch]
+                        out = np.empty((len(recs), record_bytes), np.uint8)
+                        for i, r in enumerate(recs):
+                            f.seek(int(r) * record_bytes)
+                            out[i] = np.frombuffer(
+                                f.read(record_bytes), np.uint8
+                            )
+                        if not self._put(out):
+                            return
+                    if not loop:
+                        self._put(None)
+                        return
+                    epoch += 1
+        except Exception as exc:  # noqa: BLE001 — surfaced to the consumer
+            # Mirror the native engine's error contract (dp_next -> -1):
+            # a producer fault must raise in next(), never hang it.
+            self._put(exc)
+
+    def _put(self, item) -> bool:
+        """Bounded put that honors stop; False when stopping."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def next(self) -> np.ndarray | None:
+        item = self._q.get()
+        if isinstance(item, Exception):
+            raise IOError("record pipeline producer failed") from item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so the producer unblocks
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue_mod.Empty:
+            pass
+
+
+class RecordPipeline:
+    """Batched, shuffled, prefetching reader over fixed-size records.
+
+    engine: "native" (C++), "python", or "auto" (native with fallback).
+    Iterating yields [n, record_bytes] uint8 arrays (the final batch of an
+    epoch may be short); callers reinterpret via .view(dtype).reshape(...).
+    """
+
+    def __init__(self, path: str, record_bytes: int, batch: int, *,
+                 prefetch: int = 4, threads: int = 2, seed: int = 0,
+                 shuffle: bool = True, loop: bool = False,
+                 engine: str = "auto") -> None:
+        args = (path, record_bytes, batch, prefetch, threads, seed, shuffle,
+                loop)
+        if engine == "native":
+            self._engine = _NativeEngine(*args)
+        elif engine == "python":
+            self._engine = _PythonEngine(*args)
+        elif engine == "auto":
+            try:
+                self._engine = _NativeEngine(*args)
+            except NativeBuildError as e:
+                LOG.warning("native pipeline unavailable (%s); python engine", e)
+                self._engine = _PythonEngine(*args)
+        else:
+            raise ValueError(f"unknown engine {engine!r}")
+        self.engine_name = type(self._engine).__name__.strip("_")
+
+    @property
+    def num_records(self) -> int:
+        return self._engine.num_records
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            out = self._engine.next()
+            if out is None:
+                return
+            yield out
+
+    def close(self) -> None:
+        self._engine.close()
+
+    def __enter__(self) -> "RecordPipeline":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def write_records(path: str, array: np.ndarray) -> None:
+    """Write an [n, ...] array as n fixed-size records (row-major bytes)."""
+    arr = np.ascontiguousarray(array)
+    with open(path, "wb") as f:
+        f.write(arr.tobytes())

@@ -1,0 +1,232 @@
+// Threaded prefetching record loader — the framework's native data plane.
+//
+// Role: the host-side input pipeline that keeps a TPU fed (HBM is idle while
+// the host blocks on IO; the reference delegates this entirely to
+// tf.data inside the user's container — SURVEY.md notes the repo itself has
+// zero native code, so this is a capability the rebuild adds with real
+// C++ rather than a Python thread pool throttled by the GIL).
+//
+// Semantics:
+//  - a file of fixed-size records (n = file_size / record_bytes)
+//  - epochs iterate every record exactly once; optional per-epoch
+//    Fisher-Yates shuffle from a splitmix64/xorshift PRNG seeded by
+//    (seed, epoch) => deterministic given the seed
+//  - worker threads pread() record runs into batch slots; a bounded ring
+//    of filled slots decouples producers from the consumer
+//  - dp_next() hands back one batch (blocking), in batch order
+//  - loop=0: one epoch then EOF (0 return); loop=1: epochs forever
+//
+// C ABI (ctypes-friendly); thread-safe for one consumer.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Prng {
+  uint64_t s;
+  explicit Prng(uint64_t seed) : s(seed ^ 0x9e3779b97f4a7c15ULL) {}
+  uint64_t next() {
+    // splitmix64
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  // unbiased bounded draw (Lemire)
+  uint64_t bounded(uint64_t n) { return n ? next() % n : 0; }
+};
+
+struct Batch {
+  std::vector<char> data;
+  uint64_t records = 0;
+  uint64_t seq = 0;
+};
+
+struct Pipeline {
+  int fd = -1;
+  uint64_t record_bytes = 0;
+  uint64_t batch = 0;
+  uint64_t num_records = 0;
+  bool shuffle = false;
+  bool loop = false;
+  uint64_t seed = 0;
+
+  // work assignment
+  std::vector<uint64_t> order;   // record indices for the current epoch
+  uint64_t epoch = 0;
+  uint64_t next_batch_to_claim = 0;   // producer cursor (batch index in epoch)
+  uint64_t batches_per_epoch = 0;
+
+  // slot ring (filled batches, delivered in seq order)
+  std::vector<Batch> ring;
+  uint64_t capacity = 0;
+  uint64_t next_seq_to_produce = 0;   // global batch sequence
+  uint64_t next_seq_to_consume = 0;
+  std::vector<bool> filled;
+
+  std::mutex mu;
+  std::condition_variable cv_produce;
+  std::condition_variable cv_consume;
+  std::atomic<bool> stop{false};
+  bool io_error = false;
+  std::vector<std::thread> workers;
+
+  void reshuffle_locked() {
+    order.resize(num_records);
+    for (uint64_t i = 0; i < num_records; i++) order[i] = i;
+    if (shuffle) {
+      Prng rng(seed * 1000003ULL + epoch);
+      for (uint64_t i = num_records - 1; i > 0; i--) {
+        uint64_t j = rng.bounded(i + 1);
+        std::swap(order[i], order[j]);
+      }
+    }
+  }
+
+  // Claim the next batch of this epoch (or roll the epoch / signal done).
+  // Returns false when there is no more work forever.
+  bool claim(uint64_t* seq_out, std::vector<uint64_t>* records_out) {
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      if (stop.load()) return false;
+      if (next_batch_to_claim < batches_per_epoch) {
+        uint64_t b = next_batch_to_claim++;
+        uint64_t lo = b * batch;
+        uint64_t hi = std::min(num_records, lo + batch);
+        records_out->assign(order.begin() + lo, order.begin() + hi);
+        *seq_out = next_seq_to_produce++;
+        return true;
+      }
+      if (!loop) {
+        return false;
+      }
+      epoch++;
+      reshuffle_locked();
+      next_batch_to_claim = 0;
+    }
+  }
+
+  void worker() {
+    std::vector<uint64_t> recs;
+    uint64_t seq;
+    while (claim(&seq, &recs)) {
+      Batch b;
+      b.seq = seq;
+      b.records = recs.size();
+      b.data.resize(recs.size() * record_bytes);
+      bool ok = true;
+      for (size_t i = 0; i < recs.size(); i++) {
+        ssize_t got = pread(fd, b.data.data() + i * record_bytes,
+                            record_bytes, (off_t)(recs[i] * record_bytes));
+        if (got != (ssize_t)record_bytes) { ok = false; break; }
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      // in-order delivery: wait until seq fits in the ring window
+      cv_produce.wait(lk, [&] {
+        return stop.load() || seq < next_seq_to_consume + capacity;
+      });
+      if (stop.load()) return;
+      if (!ok) { io_error = true; cv_consume.notify_all(); return; }
+      ring[seq % capacity] = std::move(b);
+      filled[seq % capacity] = true;
+      cv_consume.notify_all();
+    }
+    // No more work (non-loop EOF or stop): the consumer detects EOF from
+    // next_seq_to_consume >= batches_per_epoch, no flag needed.
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dp_open(const char* path, uint64_t record_bytes, uint64_t batch,
+              uint64_t prefetch, uint64_t threads, uint64_t seed,
+              int shuffle, int loop) {
+  if (record_bytes == 0 || batch == 0) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size <= 0 ||
+      (uint64_t)st.st_size % record_bytes != 0) {
+    close(fd);
+    return nullptr;
+  }
+  auto* p = new Pipeline();
+  p->fd = fd;
+  p->record_bytes = record_bytes;
+  p->batch = batch;
+  p->num_records = (uint64_t)st.st_size / record_bytes;
+  p->shuffle = shuffle != 0;
+  p->loop = loop != 0;
+  p->seed = seed;
+  p->batches_per_epoch = (p->num_records + batch - 1) / batch;
+  p->capacity = prefetch ? prefetch : 4;
+  p->ring.resize(p->capacity);
+  p->filled.assign(p->capacity, false);
+  p->reshuffle_locked();
+  uint64_t n_threads = threads ? threads : 2;
+  for (uint64_t i = 0; i < n_threads; i++)
+    p->workers.emplace_back(&Pipeline::worker, p);
+  return p;
+}
+
+// Blocks for the next batch. Returns number of records copied into out
+// (record_bytes each), 0 on EOF, -1 on error/undersized buffer.
+int64_t dp_next(void* handle, char* out, uint64_t out_bytes) {
+  auto* p = static_cast<Pipeline*>(handle);
+  if (!p) return -1;
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (!p->loop && p->next_seq_to_consume >= p->batches_per_epoch)
+    return 0;  // clean EOF: every batch of the single epoch was consumed
+  p->cv_consume.wait(lk, [&] {
+    return p->stop.load() || p->io_error ||
+           p->filled[p->next_seq_to_consume % p->capacity];
+  });
+  if (p->stop.load() || p->io_error) return -1;
+  uint64_t slot = p->next_seq_to_consume % p->capacity;
+  Batch& b = p->ring[slot];
+  uint64_t bytes = b.records * p->record_bytes;
+  if (bytes > out_bytes) return -1;
+  std::memcpy(out, b.data.data(), bytes);
+  int64_t n = (int64_t)b.records;
+  b.data.clear();
+  b.data.shrink_to_fit();
+  p->filled[slot] = false;
+  p->next_seq_to_consume++;
+  p->cv_produce.notify_all();
+  return n;
+}
+
+uint64_t dp_num_records(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  return p ? p->num_records : 0;
+}
+
+uint64_t dp_batches_per_epoch(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  return p ? p->batches_per_epoch : 0;
+}
+
+void dp_close(void* handle) {
+  auto* p = static_cast<Pipeline*>(handle);
+  if (!p) return;
+  p->stop.store(true);
+  p->cv_produce.notify_all();
+  p->cv_consume.notify_all();
+  for (auto& t : p->workers) t.join();
+  close(p->fd);
+  delete p;
+}
+
+}  // extern "C"

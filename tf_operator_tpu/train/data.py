@@ -52,3 +52,74 @@ def synthetic_tokens(
             "tokens": base[:, :-1].astype(np.int32),
             "targets": base[:, 1:].astype(np.int32),
         }
+
+
+def record_dataset(
+    path: str,
+    example_shape: tuple[int, ...],
+    dtype: np.dtype,
+    batch_size: int,
+    *,
+    label_dtype: np.dtype | None = np.dtype(np.int32),
+    seed: int = 0,
+    shuffle: bool = True,
+    loop: bool = True,
+    prefetch: int = 4,
+    threads: int = 2,
+    engine: str = "auto",
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stream {image, label} batches from a binary record file.
+
+    The file layout is one fixed-size record per example: the feature bytes
+    (example_shape x dtype) immediately followed by the label
+    (label_dtype; omit by passing label_dtype=None). IO, shuffling and
+    prefetch run in the native C++ pipeline when available
+    (native/record_pipeline.cc) — off the GIL, so the accelerator never
+    waits on Python — with a semantics-identical Python fallback.
+    """
+    from tf_operator_tpu.native.pipeline import RecordPipeline
+
+    dtype = np.dtype(dtype)
+    if label_dtype is not None:
+        label_dtype = np.dtype(label_dtype)
+    feat_bytes = int(np.prod(example_shape)) * dtype.itemsize
+    rec_bytes = feat_bytes + (
+        label_dtype.itemsize if label_dtype is not None else 0
+    )
+    pipe = RecordPipeline(
+        path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
+        seed=seed, shuffle=shuffle, loop=loop, engine=engine,
+    )
+    try:
+        for raw in pipe:
+            feats = (
+                raw[:, :feat_bytes]
+                .copy()
+                .view(dtype)
+                .reshape(len(raw), *example_shape)
+            )
+            out = {"image": feats}
+            if label_dtype is not None:
+                out["label"] = (
+                    raw[:, feat_bytes:].copy().view(label_dtype).reshape(len(raw))
+                )
+            yield out
+    finally:
+        pipe.close()
+
+
+def write_example_records(
+    path: str, features: np.ndarray, labels: np.ndarray | None = None
+) -> int:
+    """Write features (+ labels) as the fixed-size records record_dataset
+    reads. Returns the record size in bytes."""
+    from tf_operator_tpu.native.pipeline import write_records
+
+    n = len(features)
+    feats = np.ascontiguousarray(features).reshape(n, -1)
+    rows = feats.view(np.uint8).reshape(n, -1)
+    if labels is not None:
+        lab = np.ascontiguousarray(labels).reshape(n, -1)
+        rows = np.concatenate([rows, lab.view(np.uint8).reshape(n, -1)], axis=1)
+    write_records(path, rows)
+    return rows.shape[1]
