@@ -160,3 +160,97 @@ class TestDistributedEnv:
         topo = distributed.from_env({})
         assert not topo.is_distributed
         assert distributed.initialize(topo) is topo  # no-op, no crash
+
+
+def test_fuse_steps_matches_sequential():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate, shard_batch
+    from tf_operator_tpu.train.steps import (
+        TrainState,
+        fuse_steps,
+        make_classifier_train_step,
+        sgd_momentum,
+    )
+
+    mesh = create_mesh({"dp": 8})
+    model = MnistCNN()
+    x = jnp.zeros((8, 28, 28, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = sgd_momentum(0.05)
+    rng = np.random.default_rng(0)
+    batch = shard_batch(mesh, {
+        "image": rng.normal(size=(16, 28, 28, 1)).astype(np.float32),
+        "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+    })
+
+    step = make_classifier_train_step(model, tx, mesh, has_batch_stats=False,
+                                      donate=False)
+    s_seq = replicate(mesh, TrainState.create(variables["params"], tx))
+    for _ in range(3):
+        s_seq, m_seq = step(s_seq, batch)
+
+    s_fused = replicate(mesh, TrainState.create(variables["params"], tx))
+    s_fused, m_fused = fuse_steps(step, 3, donate=False)(s_fused, batch)
+
+    assert int(s_fused.step) == int(s_seq.step) == 3
+    np.testing.assert_allclose(
+        float(m_fused["loss"]), float(m_seq["loss"]), rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        s_fused.params, s_seq.params,
+    )
+
+
+def test_fuse_steps_scan_batches_consumes_each_slice():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate
+    from tf_operator_tpu.train.steps import (
+        TrainState,
+        fuse_steps,
+        make_classifier_train_step,
+        sgd_momentum,
+    )
+
+    mesh = create_mesh({"dp": 8})
+    model = MnistCNN()
+    x = jnp.zeros((8, 28, 28, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    tx = sgd_momentum(0.05)
+    rng = np.random.default_rng(1)
+    batches = [
+        {
+            "image": rng.normal(size=(16, 28, 28, 1)).astype(np.float32),
+            "label": rng.integers(0, 10, size=(16,)).astype(np.int32),
+        }
+        for _ in range(3)
+    ]
+    stacked = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+    step = make_classifier_train_step(model, tx, mesh, has_batch_stats=False,
+                                      donate=False)
+    s_seq = replicate(mesh, TrainState.create(variables["params"], tx))
+    for b in batches:
+        s_seq, m_seq = step(s_seq, jax.tree.map(jnp.asarray, b))
+
+    s_f = replicate(mesh, TrainState.create(variables["params"], tx))
+    fused = fuse_steps(step, 3, scan_batches=True, donate=False)
+    s_f, m_f = fused(s_f, jax.tree.map(jnp.asarray, stacked))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        s_f.params, s_seq.params,
+    )
+
+    with pytest.raises(ValueError, match="leading dim"):
+        fused(s_f, jax.tree.map(jnp.asarray, batches[0]))
