@@ -1,31 +1,33 @@
-"""Pallas TPU flash attention (forward + custom-VJP backward).
+"""Pallas TPU flash attention (forward + custom-VJP backward), streaming.
 
 The single-device hot op of the training stack. The reference delegates all
 compute to the TensorFlow runtime inside user containers (SURVEY.md: zero
 native/kernel code in-repo); here the framework owns its compute path, so
 the attention inner loop is a hand-written TPU kernel:
 
-- blocked streaming softmax: one Q block per grid program; K/V live in VMEM
-  for the program (pipelined HBM->VMEM by pallas across grid steps) and are
-  consumed block-by-block, so scores never materialize [T, T] — VMEM is
-  O(block^2) for scores plus O(T*head_dim) for the resident K/V (budget
-  enforced by flash_supported; sequences beyond it belong to ring
-  attention's sharded path).
+- fully blocked: the grid walks (batch, head, q_block, kv_block); Q, K, V,
+  dO only ever enter VMEM one [block, head_dim] tile at a time (pallas
+  pipelines the HBM->VMEM streams across grid steps), and the softmax
+  statistics / output accumulators live in VMEM scratch that persists
+  across the innermost (sequential) kv dimension. Nothing is resident at
+  O(T) — sequence length is bounded by HBM, not VMEM.
 - MXU-friendly: all contractions via jnp.dot with
   preferred_element_type=float32; bf16 inputs supported.
-- causal skip: grid program for Q block i only loops K blocks j <= i
-  (dynamic fori_loop bound), halving FLOPs for causal LM training.
-- backward = two kernels (dq; dk/dv) recomputing probabilities from the
-  saved logsumexp — the standard flash recomputation trade (HBM bandwidth
-  is the bottleneck, FLOPs are cheap on the MXU).
+- causal skip: masked grid cells are predicated off with pl.when AND their
+  BlockSpec index maps clamp to the diagonal, so an unchanged block index
+  lets pallas skip the HBM copy too — above-diagonal cells cost neither
+  FLOPs nor bandwidth (~2x for LM training).
+- backward = two streaming kernels (dq; dk/dv) recomputing probabilities
+  from the saved logsumexp — the standard flash recomputation trade (HBM
+  bandwidth is the bottleneck, FLOPs are cheap on the MXU).
 
 Kernels run in [batch, heads, seq, head_dim] layout so Mosaic's tiling
 constraint (block's trailing dims must be sublane/lane aligned) falls on
 (seq_block, head_dim); the public API takes the framework convention
-[batch, seq, heads, head_dim] (parallel/ring_attention.py) and transposes at
-the boundary (XLA folds the transpose into neighboring ops). Composes with
-ring attention: ring shards the sequence across chips (ICI), this kernel is
-the per-chip block compute.
+[batch, seq, heads, head_dim] (parallel/ring_attention.py) and transposes
+at the boundary (XLA folds the transpose into neighboring ops). Composes
+with ring attention: ring shards the sequence across chips (ICI), this
+kernel is the per-chip block compute.
 
 Falls back transparently (ops/__init__.attention) to the XLA reference
 implementation when shapes don't tile or when not on TPU.
@@ -39,15 +41,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
 
-
-# Per-(b,h) program the kernels hold two full-sequence tensors in VMEM
-# (fwd/dq: K+V; dkv: Q+dO). Cap their combined footprint well under the
-# ~16 MB VMEM so blocks/accumulators/double-buffering fit too.
-_VMEM_SEQ_BUDGET_BYTES = 8 * 1024 * 1024
+# Sanity bound on grid size / compile time, NOT a VMEM limit (per-program
+# VMEM is O(block * head_dim) regardless of sequence length).
+MAX_SEQ_LEN = 1 << 20
 
 
 def select_block(tq: int, tk: int, *, compiled: bool = False,
@@ -90,135 +91,192 @@ def pick_block(seq_len: int, *, compiled: bool = False,
 def flash_supported(tq: int, tk: int, head_dim: int, itemsize: int,
                     *, causal: bool, compiled: bool) -> bool:
     """True when flash_attention() will accept these shapes."""
+    del head_dim, itemsize  # streaming kernels: VMEM use is O(block), not O(T)
     if causal and tq != tk:
         return False
-    if 2 * max(tq, tk) * head_dim * itemsize > _VMEM_SEQ_BUDGET_BYTES:
+    if max(tq, tk) > MAX_SEQ_LEN:
         return False
     return select_block(tq, tk, compiled=compiled) is not None
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk, causal, scale, nk):
-    i = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    bq, d = q.shape
+# ---------------------------------------------------------------------------
+# kernels — grid (batch, head, q_block, kv_block); kv is the sequential
+# ("arbitrary") dim, so VMEM scratch carries accumulators across it.
+# ---------------------------------------------------------------------------
 
-    q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 0)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
+                *, blk, causal, scale, nk):
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, _NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    @pl.when(jnp.logical_or(not causal, j <= i))
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 1)
+            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m[:]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         if causal:
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        l = l * alpha + p.sum(axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-        return acc, m_new, l
+        l[:] = l[:] * alpha + p.sum(axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        m[:] = m_new
 
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((bq, 1), jnp.float32)
-    hi = lax.min(i + 1, nk) if causal else nk
-    acc, m, l = lax.fori_loop(0, hi, body, (acc, m, l))
-
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :, :] = m + jnp.log(l)
+    last = i if causal else nk - 1
+    @pl.when(j == last)
+    def _finalize():
+        safe_l = jnp.maximum(l[:], 1e-30)
+        o_ref[0, 0, :, :] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, :] = m[:] + jnp.log(safe_l)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, blk, causal, scale, nk):
-    i = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    do = do_ref[0, 0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, :]
-    delta = delta_ref[0, 0, :, :]
-    bq, d = q.shape
-    q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 0)
+               dq_acc, *, blk, causal, scale, nk):
+    i, j = pl.program_id(2), pl.program_id(3)
 
-    def body(j, dq):
-        k_blk = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(jnp.logical_or(not causal, j <= i))
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (bq, blk), 1)
+            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+        dq_acc[:] = dq_acc[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32
+        )
 
-    hi = lax.min(i + 1, nk) if causal else nk
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    last = i if causal else nk - 1
+    @pl.when(j == last)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, blk, causal, scale, ni):
-    j = pl.program_id(2)
-    k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
-    v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
-    bk, d = k_blk.shape
-    k_pos = j * blk + lax.broadcasted_iota(jnp.int32, (blk, bk), 1)
+                dk_ref, dv_ref, dk_acc, dv_acc, *, blk, causal, scale, ni):
+    j, i = pl.program_id(2), pl.program_id(3)  # note: q blocks innermost
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * blk, blk), :]
-        delta = delta_ref[0, 0, pl.ds(i * blk, blk), :]
+    @pl.when(i == (j if causal else 0))
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(jnp.logical_or(not causal, i >= j))
+    def _compute():
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, (blk, bk), 0)
+            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc[:] = dv_acc[:] + jnp.dot(
+            p.T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc[:] = dk_acc[:] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
 
-    lo = j if causal else 0
-    dk, dv = lax.fori_loop(
-        lo, ni, body,
-        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)),
-    )
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[:].astype(dv_ref.dtype)
 
 
 # BlockSpecs over [B, H, T, D] (data) and [B, H, T, 1] (rows: lse/delta).
-def _blk_spec(blk, d):
-    return pl.BlockSpec((1, 1, blk, d), lambda b, h, i: (b, h, i, 0))
+# Grid is (b, h, x, y); which of x/y indexes the tensor differs per spec.
+def _spec_x(blk, d):
+    return pl.BlockSpec((1, 1, blk, d), lambda b, h, x, y: (b, h, x, 0))
 
 
-def _full_spec(t, d):
-    return pl.BlockSpec((1, 1, t, d), lambda b, h, i: (b, h, 0, 0))
+def _spec_y(blk, d, *, clamp_to_x: bool = False):
+    """Block follows grid dim y; with clamp_to_x, above-diagonal cells
+    (y > x, predicated off under causal masking) re-request block x —
+    an unchanged block index means pallas skips the HBM->VMEM copy, so
+    masked cells cost neither FLOPs nor bandwidth."""
+    if clamp_to_x:
+        return pl.BlockSpec(
+            (1, 1, blk, d), lambda b, h, x, y: (b, h, jnp.minimum(x, y), 0)
+        )
+    return pl.BlockSpec((1, 1, blk, d), lambda b, h, x, y: (b, h, y, 0))
+
+
+def _spec_y_floor_x(blk, d):
+    """Block follows grid dim y, clamped UP to x: for the dkv grid
+    (x=kv_block, y=q_block) causal cells with y < x are masked — fetch
+    block x instead of streaming unused q/do/lse blocks."""
+    return pl.BlockSpec(
+        (1, 1, blk, d), lambda b, h, x, y: (b, h, jnp.maximum(x, y), 0)
+    )
+
+
+# Shared grid contract: (batch, head) and the x block dim parallel; the
+# innermost streamed dim sequential so scratch accumulators carry across it.
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+)
 
 
 def _flash_fwd(q, k, v, causal, scale, blk, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    nk = tk // blk
-    grid = (b, h, tq // blk)
+    ni, nk = tq // blk, tk // blk
     kernel = functools.partial(
         _fwd_kernel, blk=blk, causal=causal, scale=scale, nk=nk
     )
     o, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[_blk_spec(blk, d), _full_spec(tk, d), _full_spec(tk, d)],
-        out_specs=[_blk_spec(blk, d), _blk_spec(blk, 1)],
+        grid=(b, h, ni, nk),
+        in_specs=[
+            _spec_x(blk, d),
+            _spec_y(blk, d, clamp_to_x=causal),
+            _spec_y(blk, d, clamp_to_x=causal),
+        ],
+        out_specs=[_spec_x(blk, d), _spec_x(blk, 1)],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, 1), jnp.float32),
+            pltpu.VMEM((blk, 1), jnp.float32),
+        ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v)
     return o, lse
@@ -233,37 +291,47 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk, interpret):
     )[..., None]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, blk=blk, causal=causal, scale=scale, nk=nk),
-        grid=(b, h, ni),
+        functools.partial(_dq_kernel, blk=blk, causal=causal, scale=scale,
+                          nk=nk),
+        grid=(b, h, ni, nk),
         in_specs=[
-            _blk_spec(blk, d),
-            _full_spec(tk, d),
-            _full_spec(tk, d),
-            _blk_spec(blk, d),
-            _blk_spec(blk, 1),
-            _blk_spec(blk, 1),
+            _spec_x(blk, d),                          # q by q-block
+            _spec_y(blk, d, clamp_to_x=causal),       # k by kv-block
+            _spec_y(blk, d, clamp_to_x=causal),       # v by kv-block
+            _spec_x(blk, d),                          # do by q-block
+            _spec_x(blk, 1),                          # lse by q-block
+            _spec_x(blk, 1),                          # delta by q-block
         ],
-        out_specs=_blk_spec(blk, d),
+        out_specs=_spec_x(blk, d),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dkv grid: (b, h, kv_block, q_block) — q blocks stream innermost.
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, blk=blk, causal=causal, scale=scale, ni=ni),
-        grid=(b, h, nk),
+        functools.partial(_dkv_kernel, blk=blk, causal=causal, scale=scale,
+                          ni=ni),
+        grid=(b, h, nk, ni),
         in_specs=[
-            _full_spec(tq, d),
-            _blk_spec(blk, d),
-            _blk_spec(blk, d),
-            _full_spec(tq, d),
-            _full_spec(tq, 1),
-            _full_spec(tq, 1),
+            (_spec_y_floor_x(blk, d) if causal else _spec_y(blk, d)),  # q
+            _spec_x(blk, d),                          # k by kv-block (dim 2)
+            _spec_x(blk, d),                          # v by kv-block
+            (_spec_y_floor_x(blk, d) if causal else _spec_y(blk, d)),  # do
+            (_spec_y_floor_x(blk, 1) if causal else _spec_y(blk, 1)),  # lse
+            (_spec_y_floor_x(blk, 1) if causal else _spec_y(blk, 1)),  # delta
         ],
-        out_specs=[_blk_spec(blk, d), _blk_spec(blk, d)],
+        out_specs=[_spec_x(blk, d), _spec_x(blk, d)],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((blk, d), jnp.float32),
+        ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -318,12 +386,8 @@ def flash_attention(
         raise ValueError(f"seq lengths ({tq},{tk}) don't tile (block={block})")
     if causal and tq != tk:
         raise ValueError("causal flash requires tq == tk")
-    if 2 * max(tq, tk) * q.shape[-1] * q.dtype.itemsize > _VMEM_SEQ_BUDGET_BYTES:
-        raise ValueError(
-            f"sequence ({max(tq, tk)} x {q.shape[-1]}) exceeds the kernel's "
-            "full-sequence VMEM budget; use ring attention to shard the "
-            "sequence, or the XLA fallback (ops.attention)"
-        )
+    if max(tq, tk) > MAX_SEQ_LEN:
+        raise ValueError(f"seq > MAX_SEQ_LEN ({MAX_SEQ_LEN})")
     # [B,T,H,D] -> [B,H,T,D] for the kernels; XLA folds the transposes.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     o = _flash(qt, kt, vt, causal, float(scale), int(block), bool(interpret))
