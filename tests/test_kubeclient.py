@@ -1,0 +1,485 @@
+"""Kubernetes adapter: contract suite + config resolution + controller E2E.
+
+The contract suite runs identically against two ClusterClient backends —
+the in-memory cluster and KubeClusterClient talking HTTP to the K8s
+wire-protocol stub — proving the adapter preserves the semantics the
+controller stack depends on (uid/RV assignment, optimistic concurrency,
+status subresource isolation, selector lists, watch streams). The reference
+gets the same guarantee from client-go fakes (tfcontroller_test.go:63-64);
+here the fake sits across a real HTTP boundary.
+"""
+
+import os
+import time
+
+import pytest
+
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    NotFound,
+)
+from tf_operator_tpu.runtime.kubeclient import (
+    KubeClusterClient,
+    KubeConfig,
+    KubeConfigError,
+    in_cluster_config,
+    load_kubeconfig,
+    resolve_config,
+)
+from tf_operator_tpu.runtime.kubestub import KubeApiStub, parse_k8s_path
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+
+# ---------------------------------------------------------------------------
+# Shared backends
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["mem", "kube"])
+def backend(request):
+    """Yields (client, teardown-handled) for each backend under contract."""
+    if request.param == "mem":
+        yield InMemoryCluster()
+        return
+    stub = KubeApiStub()
+    stub.start()
+    client = KubeClusterClient(KubeConfig(server=stub.url))
+    yield client
+    stub.stop()
+
+
+def pod(name, ns="default", labels=None):
+    return objects.new_pod(name, ns, labels=labels)
+
+
+# ---------------------------------------------------------------------------
+# The contract suite
+# ---------------------------------------------------------------------------
+
+class TestContract:
+    def test_create_assigns_identity(self, backend):
+        created = backend.create(objects.PODS, pod("p1"))
+        assert created["metadata"]["uid"]
+        assert created["metadata"]["resourceVersion"]
+        assert created["metadata"]["creationTimestamp"]
+
+    def test_create_duplicate_raises_already_exists(self, backend):
+        backend.create(objects.PODS, pod("p1"))
+        with pytest.raises(AlreadyExists):
+            backend.create(objects.PODS, pod("p1"))
+
+    def test_get_roundtrip_and_not_found(self, backend):
+        backend.create(objects.PODS, pod("p1", labels={"role": "w"}))
+        got = backend.get(objects.PODS, "default", "p1")
+        assert got["metadata"]["labels"] == {"role": "w"}
+        with pytest.raises(NotFound):
+            backend.get(objects.PODS, "default", "absent")
+
+    def test_list_by_namespace_and_selector(self, backend):
+        backend.create(objects.PODS, pod("a", "ns1", labels={"app": "x"}))
+        backend.create(objects.PODS, pod("b", "ns1", labels={"app": "y"}))
+        backend.create(objects.PODS, pod("c", "ns2", labels={"app": "x"}))
+        assert len(backend.list(objects.PODS)) == 3
+        assert [objects.name_of(o) for o in backend.list(objects.PODS, "ns1")] == [
+            "a",
+            "b",
+        ]
+        sel = backend.list(objects.PODS, "ns1", {"app": "x"})
+        assert [objects.name_of(o) for o in sel] == ["a"]
+
+    def test_update_conflicts_on_stale_rv(self, backend):
+        backend.create(objects.PODS, pod("p1"))
+        v1 = backend.get(objects.PODS, "default", "p1")
+        v2 = backend.get(objects.PODS, "default", "p1")
+        v2["status"]["phase"] = "Running"
+        backend.update(objects.PODS, v2)
+        v1["status"]["phase"] = "Failed"
+        with pytest.raises(Conflict):
+            backend.update(objects.PODS, v1)
+
+    def test_update_status_touches_only_status(self, backend):
+        backend.create(objects.PODS, pod("p1", labels={"keep": "me"}))
+        obj = backend.get(objects.PODS, "default", "p1")
+        obj["metadata"]["labels"] = {"hacked": "yes"}
+        obj["status"] = {"phase": "Running"}
+        backend.update_status(objects.PODS, obj)
+        after = backend.get(objects.PODS, "default", "p1")
+        assert after["metadata"]["labels"] == {"keep": "me"}
+        assert after["status"]["phase"] == "Running"
+
+    def test_update_bumps_resource_version(self, backend):
+        backend.create(objects.PODS, pod("p1"))
+        before = backend.get(objects.PODS, "default", "p1")
+        before["status"]["phase"] = "Running"
+        after = backend.update(objects.PODS, before)
+        assert int(after["metadata"]["resourceVersion"]) > int(
+            before["metadata"]["resourceVersion"]
+        )
+
+    def test_patch_merge(self, backend):
+        backend.create(objects.PODS, pod("p1", labels={"a": "1"}))
+        patched = backend.patch_merge(
+            objects.PODS,
+            "default",
+            "p1",
+            {"metadata": {"labels": {"b": "2"}}},
+        )
+        assert patched["metadata"]["labels"] == {"a": "1", "b": "2"}
+
+    def test_delete_then_not_found(self, backend):
+        backend.create(objects.PODS, pod("p1"))
+        backend.delete(objects.PODS, "default", "p1")
+        with pytest.raises(NotFound):
+            backend.get(objects.PODS, "default", "p1")
+        with pytest.raises(NotFound):
+            backend.delete(objects.PODS, "default", "p1")
+
+    def test_crd_kind_roundtrip(self, backend):
+        job = {
+            "apiVersion": "tpuflow.org/v1",
+            "kind": "TPUJob",
+            "metadata": {"name": "j1", "namespace": "default"},
+            "spec": {"replicaSpecs": {}},
+        }
+        backend.create(objects.TPUJOBS, job)
+        got = backend.get(objects.TPUJOBS, "default", "j1")
+        assert got["spec"] == {"replicaSpecs": {}}
+        got["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
+        backend.update_status(objects.TPUJOBS, got)
+        after = backend.get(objects.TPUJOBS, "default", "j1")
+        assert after["status"]["conditions"][0]["type"] == "Created"
+
+    def test_watch_delivers_add_modify_delete(self, backend):
+        watch = backend.watch(objects.PODS, "default")
+        # kube watch threads need a beat to connect before events flow.
+        time.sleep(0.3)
+        backend.create(objects.PODS, pod("w1"))
+        obj = backend.get(objects.PODS, "default", "w1")
+        obj["status"]["phase"] = "Running"
+        backend.update(objects.PODS, obj)
+        backend.delete(objects.PODS, "default", "w1")
+
+        seen = []
+        deadline = time.monotonic() + 5
+        while len(seen) < 3 and time.monotonic() < deadline:
+            ev = watch.next(timeout=0.5)
+            if ev is not None:
+                seen.append(ev)
+        assert [e.type for e in seen] == [ADDED, MODIFIED, DELETED]
+        assert all(objects.name_of(e.object) == "w1" for e in seen)
+        backend.stop_watch(watch)
+
+    def test_watch_namespace_scoping(self, backend):
+        watch = backend.watch(objects.PODS, "ns1")
+        time.sleep(0.3)
+        backend.create(objects.PODS, pod("other", "ns2"))
+        backend.create(objects.PODS, pod("mine", "ns1"))
+        ev = watch.next(timeout=5)
+        assert ev is not None and objects.name_of(ev.object) == "mine"
+        backend.stop_watch(watch)
+
+
+# ---------------------------------------------------------------------------
+# Kube-specific behavior
+# ---------------------------------------------------------------------------
+
+class TestKubeSpecific:
+    def test_watch_reconnects_after_stream_drop(self):
+        stub = KubeApiStub()
+        stub.start()
+        client = KubeClusterClient(KubeConfig(server=stub.url))
+        try:
+            watch = client.watch(objects.PODS, "default")
+            time.sleep(0.3)
+            client.create(objects.PODS, pod("before"))
+            assert watch.next(timeout=5) is not None
+            # Sever the live stream; the client must reconnect and keep
+            # delivering events (resourceVersion resume path).
+            resp = getattr(watch, "_resp", None)
+            assert resp is not None
+            resp.close()
+            time.sleep(1.5)  # reconnect backoff
+            client.create(objects.PODS, pod("after"))
+            deadline = time.monotonic() + 5
+            got = None
+            while time.monotonic() < deadline:
+                ev = watch.next(timeout=0.5)
+                if ev is not None and objects.name_of(ev.object) == "after":
+                    got = ev
+                    break
+            assert got is not None, "watch did not resume after stream drop"
+            client.stop_watch(watch)
+        finally:
+            stub.stop()
+
+    def test_path_mapping(self):
+        cfg = KubeConfig(server="https://example:6443")
+        c = KubeClusterClient(cfg)
+        assert c._collection(objects.PODS, "ns1") == "/api/v1/namespaces/ns1/pods"
+        assert (
+            c._collection(objects.PDBS, "ns1")
+            == "/apis/policy/v1/namespaces/ns1/poddisruptionbudgets"
+        )
+        assert (
+            c._collection(objects.LEASES, "kube-system")
+            == "/apis/coordination.k8s.io/v1/namespaces/kube-system/leases"
+        )
+        assert (
+            c._collection(objects.TPUJOBS, "default")
+            == "/apis/tpuflow.org/v1/namespaces/default/tpujobs"
+        )
+        assert c._collection(objects.TPUJOBS, None) == "/apis/tpuflow.org/v1/tpujobs"
+        assert c._collection(objects.NAMESPACES, None) == "/api/v1/namespaces"
+
+    def test_stub_path_parser(self):
+        r = parse_k8s_path("/api/v1/namespaces/ns1/pods/p1/status")
+        assert (r.kind, r.namespace, r.name, r.subresource) == (
+            "pods",
+            "ns1",
+            "p1",
+            "status",
+        )
+        r = parse_k8s_path("/apis/tpuflow.org/v1/tpujobs")
+        assert (r.kind, r.namespace, r.name) == ("tpujobs", None, None)
+        r = parse_k8s_path("/api/v1/namespaces")
+        assert (r.kind, r.namespace, r.name) == ("namespaces", None, None)
+        assert parse_k8s_path("/healthz") is None
+
+
+# ---------------------------------------------------------------------------
+# Config resolution
+# ---------------------------------------------------------------------------
+
+KUBECONFIG_YAML = """\
+apiVersion: v1
+kind: Config
+current-context: dev
+contexts:
+- name: dev
+  context: {{cluster: devcluster, user: devuser}}
+- name: prod
+  context: {{cluster: prodcluster, user: produser}}
+clusters:
+- name: devcluster
+  cluster:
+    server: https://dev.example:6443
+    insecure-skip-tls-verify: true
+- name: prodcluster
+  cluster:
+    server: https://prod.example:6443
+    certificate-authority-data: {ca_b64}
+users:
+- name: devuser
+  user: {{token: devtoken}}
+- name: produser
+  user:
+    client-certificate-data: {cert_b64}
+    client-key-data: {key_b64}
+"""
+
+
+class TestConfig:
+    def _write(self, tmp_path):
+        import base64
+
+        pem = base64.b64encode(b"-----BEGIN CERTIFICATE-----\nfake\n").decode()
+        text = KUBECONFIG_YAML.format(ca_b64=pem, cert_b64=pem, key_b64=pem)
+        path = tmp_path / "kubeconfig"
+        path.write_text(text)
+        return str(path)
+
+    def test_load_current_context(self, tmp_path):
+        cfg = load_kubeconfig(self._write(tmp_path))
+        assert cfg.server == "https://dev.example:6443"
+        assert cfg.bearer_token() == "devtoken"
+        assert cfg.insecure_skip_tls_verify
+
+    def test_load_named_context_with_cert_data(self, tmp_path):
+        cfg = load_kubeconfig(self._write(tmp_path), context="prod")
+        assert cfg.server == "https://prod.example:6443"
+        assert cfg.ca_data and b"CERTIFICATE" in cfg.ca_data
+        assert cfg.client_cert_data and cfg.client_key_data
+
+    def test_kubeconfig_env_fallback(self, tmp_path, monkeypatch):
+        path = self._write(tmp_path)
+        monkeypatch.setenv("KUBECONFIG", path)
+        cfg = load_kubeconfig()
+        assert cfg.server == "https://dev.example:6443"
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(KubeConfigError):
+            load_kubeconfig(str(tmp_path / "nope"))
+
+    def test_token_file(self, tmp_path):
+        tf = tmp_path / "token"
+        tf.write_text("filetoken\n")
+        cfg = KubeConfig(server="https://x", token_file=str(tf))
+        assert cfg.bearer_token() == "filetoken"
+
+    def test_in_cluster_config(self, tmp_path, monkeypatch):
+        (tmp_path / "token").write_text("sa-token")
+        (tmp_path / "ca.crt").write_text("ca-pem")
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+        cfg = in_cluster_config(sa_dir=str(tmp_path))
+        assert cfg.server == "https://10.0.0.1:443"
+        assert cfg.bearer_token() == "sa-token"
+        assert cfg.ca_file == str(tmp_path / "ca.crt")
+
+    def test_in_cluster_missing_ca_raises(self, tmp_path, monkeypatch):
+        (tmp_path / "token").write_text("sa-token")  # token but no ca.crt
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        with pytest.raises(KubeConfigError, match="CA bundle"):
+            in_cluster_config(sa_dir=str(tmp_path))
+
+    def test_relative_paths_resolve_against_kubeconfig_dir(self, tmp_path):
+        (tmp_path / "ca.crt").write_text("pem")
+        (tmp_path / "kc").write_text(
+            "current-context: c\n"
+            "contexts: [{name: c, context: {cluster: cl, user: u}}]\n"
+            "clusters: [{name: cl, cluster: {server: 'https://x:6443', "
+            "certificate-authority: ca.crt}}]\n"
+            "users: [{name: u, user: {tokenFile: token}}]\n"
+        )
+        cfg = load_kubeconfig(str(tmp_path / "kc"))
+        assert cfg.ca_file == str(tmp_path / "ca.crt")
+        assert cfg.token_file == str(tmp_path / "token")
+
+    def test_in_cluster_outside_cluster_raises(self, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(KubeConfigError):
+            in_cluster_config(sa_dir="/definitely/not/mounted")
+
+    def test_resolve_falls_back_to_kubeconfig(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        cfg = resolve_config(self._write(tmp_path))
+        assert cfg.server == "https://dev.example:6443"
+
+
+# ---------------------------------------------------------------------------
+# Deploy manifests + CLI wiring
+# ---------------------------------------------------------------------------
+
+class TestDeployManifests:
+    def test_crd_schema_matches_api_types(self):
+        import yaml
+
+        from tf_operator_tpu.api import constants
+        from tf_operator_tpu.api.types import CleanPodPolicy, ReplicaType, RestartPolicy
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "deploy", "crd.yaml")) as f:
+            crd = yaml.safe_load(f)
+        spec = crd["spec"]
+        assert crd["metadata"]["name"] == constants.CRD_NAME
+        assert spec["group"] == constants.GROUP_NAME
+        assert spec["names"]["plural"] == constants.PLURAL
+        version = spec["versions"][0]
+        assert version["name"] == constants.VERSION
+        assert version["subresources"] == {"status": {}}
+        schema = version["schema"]["openAPIV3Schema"]["properties"]["spec"]
+        assert (
+            tuple(schema["properties"]["cleanPodPolicy"]["enum"])
+            == CleanPodPolicy.CHOICES
+        )
+        replica_props = schema["properties"]["replicaSpecs"]["properties"]
+        assert set(replica_props) == set(ReplicaType.ALL)
+        worker = replica_props["Worker"]
+        assert (
+            tuple(worker["properties"]["restartPolicy"]["enum"]) == RestartPolicy.ALL
+        )
+        assert worker["required"] == ["template"]
+
+    def test_operator_manifest_parses(self):
+        import yaml
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(root, "deploy", "operator.yaml")) as f:
+            docs = list(yaml.safe_load_all(f))
+        kinds = [d["kind"] for d in docs]
+        assert kinds == [
+            "ServiceAccount",
+            "ClusterRole",
+            "ClusterRoleBinding",
+            "Deployment",
+        ]
+        role = docs[1]
+        groups = {g for rule in role["rules"] for g in rule["apiGroups"]}
+        assert "tpuflow.org" in groups and "coordination.k8s.io" in groups
+
+
+class TestOperatorCli:
+    def test_backend_kube_flags_parse(self):
+        from tf_operator_tpu.cli.operator import build_parser
+
+        args = build_parser().parse_args(
+            ["--backend", "kube", "--kubeconfig", "/tmp/kc", "--kube-context", "dev"]
+        )
+        assert args.backend == "kube"
+        assert args.kubeconfig == "/tmp/kc"
+        assert args.kube_context == "dev"
+
+    def test_backend_kube_bad_config_exits_2(self, tmp_path, monkeypatch):
+        from tf_operator_tpu.cli import operator as operator_cli
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        rc = operator_cli.main(
+            ["--backend", "kube", "--kubeconfig", str(tmp_path / "missing")]
+        )
+        assert rc == 2
+
+    def test_backend_kube_master_conflict_exits_2(self, tmp_path):
+        from tf_operator_tpu.cli import operator as operator_cli
+
+        rc = operator_cli.main(
+            ["--backend", "kube", "--master", "http://x", "--kubeconfig", "/nope"]
+        )
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# The controller running over the kube adapter (full reconcile loop across
+# a real HTTP boundary speaking the K8s wire protocol).
+# ---------------------------------------------------------------------------
+
+class TestControllerOverKube:
+    def test_sync_creates_pods_and_status_through_kube_api(self):
+        from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+        from tf_operator_tpu.utils import testutil
+
+        stub = KubeApiStub()
+        stub.start()
+        client = KubeClusterClient(KubeConfig(server=stub.url))
+        try:
+            job = testutil.new_tpujob(name="kubejob", worker=2)
+            client.create(objects.TPUJOBS, job.to_dict())
+            tc = TPUJobController(client)
+            tc.job_informer.sync_now()
+            tc.pod_informer.sync_now()
+            tc.service_informer.sync_now()
+            tc.sync_job("default/kubejob")
+
+            pods = client.list(objects.PODS, "default")
+            assert len(pods) == 2
+            services = client.list(objects.SERVICES, "default")
+            assert len(services) == 2
+            # Mark pods running through the kube API, resync, and verify the
+            # Running condition lands via the status subresource.
+            for p in pods:
+                p["status"]["phase"] = objects.RUNNING
+                client.update_status(objects.PODS, p)
+            # Resync all informers so the creation expectations from sync 1
+            # (pods AND services) are observed before the next sync.
+            tc.pod_informer.sync_now()
+            tc.service_informer.sync_now()
+            tc.job_informer.sync_now()
+            tc.sync_job("default/kubejob")
+            stored = client.get(objects.TPUJOBS, "default", "kubejob")
+            types = [c["type"] for c in stored["status"]["conditions"]]
+            assert "Running" in types
+        finally:
+            stub.stop()

@@ -49,8 +49,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--disable-gang-scheduling", dest="gang", action="store_false")
     p.add_argument("--json-log", action="store_true", help="structured JSON logs")
     p.add_argument("--version", action="store_true", help="print version and exit")
-    # Runtime wiring (replaces --kubeconfig: the backing store is either
-    # in-process or a remote runtime's REST API).
+    # Runtime wiring: the backing store is the in-process store (default),
+    # a remote runtime's REST API (--master), or a real Kubernetes apiserver
+    # (--backend kube, the reference's native habitat).
+    p.add_argument("--backend", choices=("mem", "kube"), default="mem",
+                   help="'mem': in-process store (or --master); "
+                        "'kube': real Kubernetes via kubeconfig/in-cluster")
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path for --backend kube "
+                        "(default: in-cluster, then $KUBECONFIG, then ~/.kube/config)")
+    p.add_argument("--kube-context", default=None,
+                   help="kubeconfig context to use (default: current-context)")
     p.add_argument("--master", default=None,
                    help="URL of a remote runtime API server; default: in-process store")
     p.add_argument("--serve", type=int, default=None, metavar="PORT",
@@ -83,7 +92,32 @@ def main(argv: list[str] | None = None) -> int:
     stop = signals.setup_signal_handler()
 
     # --- backing store ------------------------------------------------------
-    if args.master:
+    if args.backend == "kube":
+        if args.master:
+            log.error("--backend kube and --master are mutually exclusive")
+            return 2
+        if args.serve is not None:
+            log.error("--serve requires the in-process store (drop --backend kube)")
+            return 2
+        if args.local_executor:
+            # Real kubelets run the pods on a real cluster; a local executor
+            # would double-execute every replica.
+            log.error("--local-executor is incompatible with --backend kube")
+            return 2
+        from tf_operator_tpu.runtime.kubeclient import (
+            KubeClusterClient,
+            KubeConfigError,
+            resolve_config,
+        )
+
+        try:
+            kube_cfg = resolve_config(args.kubeconfig, args.kube_context)
+        except KubeConfigError as e:
+            log.error("kube config resolution failed: %s", e)
+            return 2
+        client = KubeClusterClient(kube_cfg)
+        log.info("using Kubernetes apiserver at %s", kube_cfg.server)
+    elif args.master:
         from tf_operator_tpu.runtime.restclient import RestClusterClient
 
         client = RestClusterClient(args.master)

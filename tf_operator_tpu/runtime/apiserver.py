@@ -30,6 +30,7 @@ from typing import Any
 from urllib.parse import parse_qs, unquote, urlparse
 
 from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.runtime.httputil import JsonHandlerMixin
 from tf_operator_tpu.utils import logger
 
 LOG = logger.with_fields(component="apiserver")
@@ -49,29 +50,19 @@ def parse_label_selector(raw: str) -> dict[str, str]:
     return out
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server: "ApiServer"
 
-    # -- plumbing -----------------------------------------------------------
+    # -- plumbing (shared JSON helpers live in JsonHandlerMixin) ------------
 
-    def _send_json(self, payload: Any, code: int = 200) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+    _send_json = JsonHandlerMixin.send_json
+    _read_body = JsonHandlerMixin.read_json_body
+    _q = staticmethod(JsonHandlerMixin.first_query_value)
 
     def _send_error_obj(self, e: Exception) -> None:
         code = getattr(e, "code", 500)
         self._send_json({"error": type(e).__name__, "message": str(e)}, code=code)
-
-    def _read_body(self) -> dict[str, Any]:
-        length = int(self.headers.get("Content-Length", 0))
-        if length == 0:
-            return {}
-        return json.loads(self.rfile.read(length))
 
     def _route(self) -> tuple[str | None, list[str], dict[str, list[str]]]:
         url = urlparse(self.path)
@@ -80,10 +71,6 @@ class _Handler(BaseHTTPRequestHandler):
         if not parts or parts[0] != "api":
             return None, [], query
         return "api", parts[1:], query
-
-    def _q(self, query: dict[str, list[str]], key: str) -> str | None:
-        vals = query.get(key)
-        return vals[0] if vals else None
 
     # -- methods ------------------------------------------------------------
 
@@ -189,10 +176,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
-
-        def write_chunk(data: bytes) -> None:
-            self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
-            self.wfile.flush()
+        write_chunk = self.write_chunk
 
         try:
             while not self.server.stopping.is_set():

@@ -1,0 +1,40 @@
+"""Shared HTTP handler plumbing for the framework's JSON servers.
+
+Both in-process servers — the framework-native REST apiserver
+(runtime/apiserver.py) and the K8s wire-protocol stub (runtime/kubestub.py) —
+speak JSON over BaseHTTPRequestHandler; this mixin holds the response/body/
+query helpers so fixes to e.g. Content-Length handling land in both.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+class JsonHandlerMixin:
+    """Helpers for BaseHTTPRequestHandler subclasses serving JSON APIs."""
+
+    def send_json(self, payload: Any, code: int = 200) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)  # type: ignore[attr-defined]
+        self.send_header("Content-Type", "application/json")  # type: ignore[attr-defined]
+        self.send_header("Content-Length", str(len(body)))  # type: ignore[attr-defined]
+        self.end_headers()  # type: ignore[attr-defined]
+        self.wfile.write(body)  # type: ignore[attr-defined]
+
+    def read_json_body(self) -> dict[str, Any]:
+        length = int(self.headers.get("Content-Length", 0))  # type: ignore[attr-defined]
+        if length == 0:
+            return {}
+        return json.loads(self.rfile.read(length))  # type: ignore[attr-defined]
+
+    @staticmethod
+    def first_query_value(query: dict[str, list[str]], key: str) -> str | None:
+        vals = query.get(key)
+        return vals[0] if vals else None
+
+    def write_chunk(self, data: bytes) -> None:
+        """One chunk of a Transfer-Encoding: chunked response."""
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")  # type: ignore[attr-defined]
+        self.wfile.flush()  # type: ignore[attr-defined]

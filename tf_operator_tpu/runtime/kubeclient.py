@@ -1,0 +1,536 @@
+"""Real-Kubernetes ClusterClient: the adapter that makes the operator a K8s operator.
+
+The controller stack is written against ClusterClient (runtime/client.py);
+this implementation speaks the Kubernetes REST API the way the reference's
+client-go stack does:
+
+- kubeconfig / in-cluster config resolution (reference:
+  pkg/util/k8sutil/k8sutil.go:52-76 — GetClusterConfig falls back from
+  in-cluster to $HOME/.kube/config),
+- group/version path mapping for core v1 resources, policy/v1 PDBs,
+  coordination.k8s.io/v1 Leases, and the TPUJob CRD
+  (apis/tpuflow.org/v1/namespaces/{ns}/tpujobs),
+- the status subresource (PUT .../status) the controller's conflict-retried
+  status writes need (SURVEY.md §7 "status-subresource + patch + retry"),
+- label-selector lists and watch streams with resourceVersion resume
+  (reconnect from the last seen RV; relist on 410 Gone),
+- apimachinery Status errors mapped onto the ApiError hierarchy the
+  controllers branch on (NotFound/AlreadyExists/Conflict/Invalid), like the
+  reference's error predicates in pkg/util/k8sutil.
+
+Auth supported: bearer token (inline / file / service-account), client
+certificates (inline base64 data or files), CA bundle or
+insecure-skip-tls-verify. Exec credential plugins are intentionally out of
+scope (would shell out to cloud CLIs).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import ssl
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+from urllib import error as urlerror
+from urllib import parse as urlparse_mod
+from urllib import request as urlrequest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import (
+    AlreadyExists,
+    ApiError,
+    ClusterClient,
+    Conflict,
+    Invalid,
+    NotFound,
+    Watch,
+    WatchEvent,
+)
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="kubeclient")
+
+# Service-account mount used for in-cluster config (what client-go's
+# rest.InClusterConfig reads).
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+# ---------------------------------------------------------------------------
+# API path mapping
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _Resource:
+    prefix: str  # e.g. "/api/v1" or "/apis/policy/v1"
+    plural: str
+    namespaced: bool = True
+    api_version: str = "v1"  # value to stamp into body apiVersion
+    kind: str = ""  # body kind to stamp when missing
+
+
+# Framework collection name (runtime/objects.py) -> K8s REST coordinates.
+_RESOURCES: dict[str, _Resource] = {
+    objects.PODS: _Resource("/api/v1", "pods", True, "v1", "Pod"),
+    objects.SERVICES: _Resource("/api/v1", "services", True, "v1", "Service"),
+    objects.EVENTS: _Resource("/api/v1", "events", True, "v1", "Event"),
+    objects.NAMESPACES: _Resource("/api/v1", "namespaces", False, "v1", "Namespace"),
+    objects.PDBS: _Resource(
+        "/apis/policy/v1", "poddisruptionbudgets", True, "policy/v1",
+        "PodDisruptionBudget",
+    ),
+    objects.LEASES: _Resource(
+        "/apis/coordination.k8s.io/v1", "leases", True, "coordination.k8s.io/v1",
+        "Lease",
+    ),
+    objects.TPUJOBS: _Resource(
+        f"/apis/{constants.GROUP_NAME}/{constants.VERSION}", constants.PLURAL, True,
+        constants.API_VERSION, constants.KIND,
+    ),
+}
+
+
+def _resource_for(kind: str) -> _Resource:
+    try:
+        return _RESOURCES[kind]
+    except KeyError:
+        # Unknown collections are assumed to be CRDs in our group, so new
+        # resource kinds keep working without touching this table.
+        return _Resource(
+            f"/apis/{constants.GROUP_NAME}/{constants.VERSION}", kind, True,
+            constants.API_VERSION, "",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Config resolution
+# ---------------------------------------------------------------------------
+
+class KubeConfigError(Exception):
+    pass
+
+
+@dataclass
+class KubeConfig:
+    """Resolved connection parameters for one cluster+user pair."""
+
+    server: str
+    token: str | None = None
+    token_file: str | None = None
+    ca_file: str | None = None
+    ca_data: bytes | None = None  # PEM
+    client_cert_file: str | None = None
+    client_key_file: str | None = None
+    client_cert_data: bytes | None = None  # PEM
+    client_key_data: bytes | None = None  # PEM
+    insecure_skip_tls_verify: bool = False
+    _tmpfiles: list[str] = field(default_factory=list, repr=False)
+
+    def bearer_token(self) -> str | None:
+        if self.token:
+            return self.token
+        if self.token_file:
+            with open(self.token_file) as f:
+                return f.read().strip()
+        return None
+
+    def ssl_context(self) -> ssl.SSLContext | None:
+        if not self.server.startswith("https"):
+            return None
+        ctx = ssl.create_default_context()
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        elif self.ca_data is not None:
+            ctx.load_verify_locations(cadata=self.ca_data.decode())
+        elif self.ca_file:
+            ctx.load_verify_locations(cafile=self.ca_file)
+        cert, key = self.client_cert_file, self.client_key_file
+        try:
+            if self.client_cert_data is not None:
+                cert = self._materialize(self.client_cert_data, "crt")
+            if self.client_key_data is not None:
+                key = self._materialize(self.client_key_data, "key")
+            if cert and key:
+                ctx.load_cert_chain(certfile=cert, keyfile=key)
+        finally:
+            # load_cert_chain reads the files synchronously; the key material
+            # must not outlive the call on disk.
+            for path in self._tmpfiles:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._tmpfiles.clear()
+        return ctx
+
+    def _materialize(self, pem: bytes, suffix: str) -> str:
+        # load_cert_chain only accepts file paths; inline kubeconfig data has
+        # to hit disk briefly (0600; unlinked by ssl_context right after the
+        # chain is loaded).
+        fd, path = tempfile.mkstemp(suffix=f".{suffix}", prefix="kubecfg-")
+        try:
+            os.write(fd, pem)
+        finally:
+            os.close(fd)
+        os.chmod(path, 0o600)
+        self._tmpfiles.append(path)
+        return path
+
+
+def _b64(data: str) -> bytes:
+    return base64.b64decode(data)
+
+
+def load_kubeconfig(path: str | None = None, context: str | None = None) -> KubeConfig:
+    """Parse a kubeconfig file into a KubeConfig.
+
+    Resolution order for ``path``: explicit arg → $KUBECONFIG →
+    ~/.kube/config, matching client-go's loading rules (and the reference's
+    KUBECONFIG override, cmd/tf-operator.v2/app/server.go:76-80).
+    """
+    import yaml
+
+    path = path or os.environ.get("KUBECONFIG") or os.path.expanduser("~/.kube/config")
+    if not os.path.exists(path):
+        raise KubeConfigError(f"kubeconfig not found at {path}")
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+
+    ctx_name = context or doc.get("current-context")
+    if not ctx_name:
+        raise KubeConfigError(f"{path}: no current-context and none given")
+
+    def _named(section: str, name: str) -> dict[str, Any]:
+        for entry in doc.get(section, []) or []:
+            if entry.get("name") == name:
+                return entry.get(section.rstrip("s"), {}) or {}
+        raise KubeConfigError(f"{path}: {section} entry {name!r} not found")
+
+    ctx = _named("contexts", ctx_name)
+    cluster = _named("clusters", ctx.get("cluster", ""))
+    user = _named("users", ctx.get("user", "")) if ctx.get("user") else {}
+
+    server = cluster.get("server")
+    if not server:
+        raise KubeConfigError(f"{path}: cluster {ctx.get('cluster')!r} has no server")
+
+    def _rel(p: str | None) -> str | None:
+        # Relative file references in a kubeconfig resolve against the
+        # kubeconfig's own directory, as client-go does (kind/minikube configs
+        # commonly use relative CA paths).
+        if p and not os.path.isabs(p):
+            return os.path.join(os.path.dirname(os.path.abspath(path)), p)
+        return p
+
+    cfg = KubeConfig(
+        server=server,
+        token=user.get("token"),
+        token_file=_rel(user.get("tokenFile")),
+        ca_file=_rel(cluster.get("certificate-authority")),
+        insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify", False)),
+        client_cert_file=_rel(user.get("client-certificate")),
+        client_key_file=_rel(user.get("client-key")),
+    )
+    if cluster.get("certificate-authority-data"):
+        cfg.ca_data = _b64(cluster["certificate-authority-data"])
+    if user.get("client-certificate-data"):
+        cfg.client_cert_data = _b64(user["client-certificate-data"])
+    if user.get("client-key-data"):
+        cfg.client_key_data = _b64(user["client-key-data"])
+    if user.get("exec") or user.get("auth-provider"):
+        raise KubeConfigError(
+            f"{path}: user {ctx.get('user')!r} uses an exec/auth-provider plugin; "
+            "use a token or client certificate (exec plugins are not supported)"
+        )
+    return cfg
+
+
+def in_cluster_config(sa_dir: str = SERVICEACCOUNT_DIR) -> KubeConfig:
+    """In-cluster config from the service-account mount + KUBERNETES_SERVICE_*
+    env (client-go rest.InClusterConfig; reference k8sutil.go:52-60)."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if not host:
+        raise KubeConfigError("KUBERNETES_SERVICE_HOST not set; not in a cluster")
+    token_file = os.path.join(sa_dir, "token")
+    if not os.path.exists(token_file):
+        raise KubeConfigError(f"service-account token not found at {token_file}")
+    ca_file = os.path.join(sa_dir, "ca.crt")
+    if not os.path.exists(ca_file):
+        # Fail loudly rather than silently disabling TLS verification — a
+        # missing CA with a live bearer token is exactly the setup where a
+        # MITM could steal the token (client-go errors here too).
+        raise KubeConfigError(f"in-cluster CA bundle not found at {ca_file}")
+    return KubeConfig(
+        server=f"https://{host}:{port}",
+        token_file=token_file,
+        ca_file=ca_file,
+    )
+
+
+def resolve_config(
+    kubeconfig: str | None = None, context: str | None = None
+) -> KubeConfig:
+    """In-cluster first, then kubeconfig — the reference's fallback order
+    (k8sutil.go GetClusterConfig)."""
+    if kubeconfig is None:
+        try:
+            return in_cluster_config()
+        except KubeConfigError:
+            pass
+    return load_kubeconfig(kubeconfig, context)
+
+
+# ---------------------------------------------------------------------------
+# Error mapping
+# ---------------------------------------------------------------------------
+
+_REASONS = {
+    "NotFound": NotFound,
+    "AlreadyExists": AlreadyExists,
+    "Conflict": Conflict,
+    "Invalid": Invalid,
+}
+_CODES = {404: NotFound, 409: Conflict, 422: Invalid}
+
+
+def _raise_status(err: urlerror.HTTPError) -> None:
+    """Translate an apimachinery Status body into our error hierarchy."""
+    reason, message = "", str(err)
+    try:
+        status = json.loads(err.read() or b"{}")
+        reason = status.get("reason", "")
+        message = status.get("message", message)
+    except (ValueError, AttributeError):
+        pass
+    cls = _REASONS.get(reason) or _CODES.get(err.code, ApiError)
+    exc = cls(message)
+    exc.code = err.code
+    raise exc from None
+
+
+# ---------------------------------------------------------------------------
+# The client
+# ---------------------------------------------------------------------------
+
+class KubeClusterClient(ClusterClient):
+    """ClusterClient over a real (or wire-compatible) Kubernetes apiserver."""
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+        self._cfg = config
+        self._base = config.server.rstrip("/")
+        self._timeout = timeout
+        self._ssl = config.ssl_context()
+        self._watch_stops: dict[Watch, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _headers(self, content_type: str | None = None) -> dict[str, str]:
+        h: dict[str, str] = {"Accept": "application/json"}
+        token = self._cfg.bearer_token()
+        if token:
+            h["Authorization"] = f"Bearer {token}"
+        if content_type:
+            h["Content-Type"] = content_type
+        return h
+
+    def _open(self, req: urlrequest.Request, timeout: float | None):
+        return urlrequest.urlopen(req, timeout=timeout, context=self._ssl)
+
+    def _call(
+        self,
+        method: str,
+        path: str,
+        body: dict[str, Any] | None = None,
+        content_type: str = "application/json",
+    ) -> dict[str, Any]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urlrequest.Request(
+            self._base + path,
+            data=data,
+            method=method,
+            headers=self._headers(content_type if data is not None else None),
+        )
+        try:
+            with self._open(req, self._timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urlerror.HTTPError as e:
+            _raise_status(e)
+            raise  # unreachable
+
+    def _collection(self, kind: str, namespace: str | None) -> str:
+        r = _resource_for(kind)
+        if not r.namespaced or namespace is None:
+            return f"{r.prefix}/{r.plural}"
+        return f"{r.prefix}/namespaces/{urlparse_mod.quote(namespace)}/{r.plural}"
+
+    def _item(self, kind: str, namespace: str, name: str) -> str:
+        return f"{self._collection(kind, namespace)}/{urlparse_mod.quote(name)}"
+
+    @staticmethod
+    def _stamp_gvk(kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        r = _resource_for(kind)
+        out = dict(obj)
+        out.setdefault("apiVersion", r.api_version)
+        if r.kind:
+            out.setdefault("kind", r.kind)
+        return out
+
+    # -- ClusterClient ------------------------------------------------------
+
+    def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        ns = objects.namespace_of(obj)
+        objects.meta(obj).setdefault("namespace", ns)
+        return self._call(
+            "POST", self._collection(kind, ns), self._stamp_gvk(kind, obj)
+        )
+
+    def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        return self._call("GET", self._item(kind, namespace, name))
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict[str, Any]]:
+        return self._list_raw(kind, namespace, label_selector)["items"] or []
+
+    def _list_raw(
+        self,
+        kind: str,
+        namespace: str | None,
+        label_selector: dict[str, str] | None = None,
+    ) -> dict[str, Any]:
+        params: dict[str, str] = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(
+                f"{k}={v}" for k, v in sorted(label_selector.items())
+            )
+        qs = ("?" + urlparse_mod.urlencode(params)) if params else ""
+        out = self._call("GET", self._collection(kind, namespace) + qs)
+        out.setdefault("items", [])
+        return out
+
+    def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        ns, name = objects.namespace_of(obj), objects.name_of(obj)
+        return self._call(
+            "PUT", self._item(kind, ns, name), self._stamp_gvk(kind, obj)
+        )
+
+    def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        ns, name = objects.namespace_of(obj), objects.name_of(obj)
+        return self._call(
+            "PUT", self._item(kind, ns, name) + "/status", self._stamp_gvk(kind, obj)
+        )
+
+    def patch_merge(
+        self, kind: str, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]:
+        return self._call(
+            "PATCH",
+            self._item(kind, namespace, name),
+            patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._call("DELETE", self._item(kind, namespace, name))
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(self, kind: str, namespace: str | None = None) -> Watch:
+        """Streamed watch with resourceVersion resume.
+
+        Semantics match the in-memory cluster (and the informer's needs):
+        events start flowing from "now". Internally: LIST to pin the
+        collection RV, then WATCH from it; on disconnect reconnect from the
+        last delivered RV; on 410 Gone relist for a fresh RV (the informer's
+        periodic resync repairs anything missed during the gap).
+        """
+        watch = Watch()
+        stopped = threading.Event()
+        with self._lock:
+            self._watch_stops[watch] = stopped
+        t = threading.Thread(
+            target=self._watch_loop,
+            args=(kind, namespace, watch, stopped),
+            name=f"kubewatch-{kind}",
+            daemon=True,
+        )
+        t.start()
+        return watch
+
+    def _watch_loop(
+        self, kind: str, namespace: str | None, watch: Watch, stopped: threading.Event
+    ) -> None:
+        rv: str | None = None
+        while not stopped.is_set():
+            try:
+                if rv is None:
+                    rv = str(
+                        self._list_raw(kind, namespace)
+                        .get("metadata", {})
+                        .get("resourceVersion", "")
+                    )
+                params = {"watch": "true", "allowWatchBookmarks": "true"}
+                if rv:
+                    params["resourceVersion"] = rv
+                url = (
+                    self._base
+                    + self._collection(kind, namespace)
+                    + "?"
+                    + urlparse_mod.urlencode(params)
+                )
+                req = urlrequest.Request(url, headers=self._headers())
+                resp = self._open(req, None)  # no timeout: long-lived stream
+                watch._resp = resp  # stop_watch closes it to unblock the read
+                for raw in resp:
+                    if stopped.is_set():
+                        break
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    payload = json.loads(line)
+                    etype, obj = payload.get("type"), payload.get("object", {})
+                    if etype == "BOOKMARK":
+                        rv = objects.meta(obj).get("resourceVersion", rv)
+                        continue
+                    if etype == "ERROR":
+                        if obj.get("code") == 410:  # Gone: RV too old, relist
+                            rv = None
+                            break
+                        raise ApiError(obj.get("message", "watch error"))
+                    new_rv = objects.meta(obj).get("resourceVersion")
+                    if new_rv:
+                        rv = str(new_rv)
+                    watch.push(WatchEvent(etype, obj))
+            except urlerror.HTTPError as e:
+                if e.code == 410:
+                    rv = None
+                elif not stopped.is_set():
+                    LOG.warning("watch %s failed: %s; reconnecting", kind, e)
+                    stopped.wait(1.0)
+            except Exception as e:
+                if not stopped.is_set():
+                    LOG.debug("watch %s stream ended (%s); reconnecting", kind, e)
+                    stopped.wait(1.0)
+        watch.stop()
+
+    def stop_watch(self, watch: Watch) -> None:
+        with self._lock:
+            stopped = self._watch_stops.pop(watch, None)
+        if stopped is not None:
+            stopped.set()
+        resp = getattr(watch, "_resp", None)
+        if resp is not None:
+            try:
+                resp.close()
+            except Exception:
+                pass
+        watch.stop()

@@ -1,0 +1,296 @@
+"""Wire-compatible Kubernetes apiserver stub.
+
+The contract-test double for KubeClusterClient (runtime/kubeclient.py): an
+HTTP server that speaks the Kubernetes REST conventions — group/version
+paths (/api/v1, /apis/{group}/{version}), namespaced collections, the status
+subresource, merge-patch, apimachinery Status error bodies, and ndjson watch
+streams — backed by the InMemoryCluster semantics (uid/resourceVersion
+assignment, optimistic concurrency).
+
+This plays the role the reference's recorded fake clientsets play in its
+tier-2 tests (tfcontroller_test.go:63-64), but at the *wire* level: the same
+contract suite runs against {InMemoryCluster directly, KubeClusterClient →
+this stub}, proving the adapter preserves ClusterClient semantics end to
+end. Pointing KubeClusterClient at a real apiserver changes only the URL and
+auth.
+
+Optional ``validators`` emulate CRD OpenAPI admission (the reference's
+examples/crd/crd-v1alpha2.yaml:24-47): a validator raising
+client.Invalid makes create/update return 422 with reason=Invalid.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from tf_operator_tpu.runtime.apiserver import parse_label_selector
+from tf_operator_tpu.runtime.httputil import JsonHandlerMixin
+from tf_operator_tpu.runtime.client import (
+    AlreadyExists,
+    ApiError,
+    Conflict,
+    Invalid,
+    NotFound,
+)
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="kubestub")
+
+_REASON_FOR = {
+    NotFound: "NotFound",
+    AlreadyExists: "AlreadyExists",
+    Conflict: "Conflict",
+    Invalid: "Invalid",
+}
+
+Validator = Callable[[dict[str, Any]], None]
+
+
+def status_body(code: int, reason: str, message: str) -> dict[str, Any]:
+    """apimachinery metav1.Status failure object."""
+    return {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "metadata": {},
+        "status": "Failure",
+        "message": message,
+        "reason": reason,
+        "code": code,
+    }
+
+
+class _Route:
+    """Parsed K8s REST path: kind (collection), namespace, name, subresource."""
+
+    def __init__(
+        self,
+        kind: str,
+        namespace: str | None,
+        name: str | None,
+        subresource: str | None,
+    ) -> None:
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def parse_k8s_path(path: str) -> _Route | None:
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":
+        if len(parts) < 3 or parts[1] != "v1":
+            return None
+        rest = parts[2:]
+    elif parts[0] == "apis":
+        if len(parts) < 4:
+            return None
+        rest = parts[3:]  # drop apis/{group}/{version}
+    else:
+        return None
+
+    # namespaces/{ns}/{plural}[/{name}[/{sub}]]  — namespaced resource
+    if rest[0] == "namespaces" and len(rest) >= 3:
+        ns, plural = rest[1], rest[2]
+        name = rest[3] if len(rest) >= 4 else None
+        sub = rest[4] if len(rest) >= 5 else None
+        return _Route(plural, ns, name, sub)
+    # {plural}[/{name}[/{sub}]] — cluster-scoped (namespaces itself) or
+    # all-namespaces list/watch
+    plural = rest[0]
+    name = rest[1] if len(rest) >= 2 else None
+    sub = rest[2] if len(rest) >= 3 else None
+    return _Route(plural, None, name, sub)
+
+
+class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "KubeApiStub"
+
+    # -- plumbing (shared JSON helpers live in JsonHandlerMixin) ------------
+
+    _send_json = JsonHandlerMixin.send_json
+    _read_body = JsonHandlerMixin.read_json_body
+    _q = staticmethod(JsonHandlerMixin.first_query_value)
+
+    def _send_api_error(self, e: ApiError) -> None:
+        reason = _REASON_FOR.get(type(e), "InternalError")
+        code = getattr(e, "code", 500)
+        self._send_json(status_body(code, reason, str(e)), code)
+
+    def _route(self) -> tuple[_Route | None, dict[str, list[str]]]:
+        from urllib.parse import parse_qs, unquote, urlparse
+
+        url = urlparse(self.path)
+        route = parse_k8s_path(unquote(url.path))
+        return route, parse_qs(url.query)
+
+    def _validate(self, kind: str, obj: dict[str, Any]) -> None:
+        validator = self.server.validators.get(kind)
+        if validator is not None:
+            validator(obj)
+
+    # -- methods ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        route, query = self._route()
+        if route is None:
+            self._send_json(status_body(404, "NotFound", self.path), 404)
+            return
+        try:
+            if route.name is None:
+                if self._q(query, "watch") in ("true", "1"):
+                    self._serve_watch(route)
+                    return
+                raw_sel = self._q(query, "labelSelector")
+                selector = parse_label_selector(raw_sel) if raw_sel else None
+                items = self.server.cluster.list(route.kind, route.namespace, selector)
+                self._send_json(
+                    {
+                        "kind": "List",
+                        "apiVersion": "v1",
+                        "metadata": {"resourceVersion": self.server.cluster.current_rv},
+                        "items": items,
+                    }
+                )
+            else:
+                ns = route.namespace or "default"
+                if route.kind == "namespaces" and route.namespace is None:
+                    # cluster-scoped: stored under a fixed pseudo-namespace
+                    ns = "_cluster"
+                self._send_json(self.server.cluster.get(route.kind, ns, route.name))
+        except ApiError as e:
+            self._send_api_error(e)
+
+    def do_POST(self) -> None:  # noqa: N802
+        route, _ = self._route()
+        if route is None or route.name is not None:
+            self._send_json(status_body(404, "NotFound", self.path), 404)
+            return
+        try:
+            obj = self._read_body()
+            self._validate(route.kind, obj)
+            if route.namespace is not None:
+                obj.setdefault("metadata", {})["namespace"] = route.namespace
+            elif route.kind == "namespaces":
+                obj.setdefault("metadata", {})["namespace"] = "_cluster"
+            self._send_json(self.server.cluster.create(route.kind, obj), 201)
+        except ApiError as e:
+            self._send_api_error(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(status_body(400, "BadRequest", str(e)), 400)
+
+    def do_PUT(self) -> None:  # noqa: N802
+        route, _ = self._route()
+        if route is None or route.name is None:
+            self._send_json(status_body(404, "NotFound", self.path), 404)
+            return
+        try:
+            obj = self._read_body()
+            if route.subresource == "status":
+                self._send_json(self.server.cluster.update_status(route.kind, obj))
+            elif route.subresource is None:
+                self._validate(route.kind, obj)
+                self._send_json(self.server.cluster.update(route.kind, obj))
+            else:
+                self._send_json(status_body(404, "NotFound", self.path), 404)
+        except ApiError as e:
+            self._send_api_error(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(status_body(400, "BadRequest", str(e)), 400)
+
+    def do_PATCH(self) -> None:  # noqa: N802
+        route, _ = self._route()
+        if route is None or route.name is None or route.subresource is not None:
+            self._send_json(status_body(404, "NotFound", self.path), 404)
+            return
+        try:
+            ns = route.namespace or "default"
+            self._send_json(
+                self.server.cluster.patch_merge(
+                    route.kind, ns, route.name, self._read_body()
+                )
+            )
+        except ApiError as e:
+            self._send_api_error(e)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(status_body(400, "BadRequest", str(e)), 400)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        route, _ = self._route()
+        if route is None or route.name is None:
+            self._send_json(status_body(404, "NotFound", self.path), 404)
+            return
+        try:
+            ns = route.namespace or (
+                "_cluster" if route.kind == "namespaces" else "default"
+            )
+            self.server.cluster.delete(route.kind, ns, route.name)
+            self._send_json(status_body(200, "", "deleted") | {"status": "Success"})
+        except ApiError as e:
+            self._send_api_error(e)
+
+    # -- watch --------------------------------------------------------------
+
+    def _serve_watch(self, route: _Route) -> None:
+        """ndjson watch stream (chunked). The stub streams from "now"; the
+        resourceVersion param is accepted but not replayed — history replay
+        is what the informer's periodic resync compensates for."""
+        watch = self.server.cluster.watch(route.kind, route.namespace)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        write_chunk = self.write_chunk
+
+        try:
+            while not self.server.stopping.is_set():
+                event = watch.next(timeout=0.5)
+                if event is None:
+                    write_chunk(b"\n")  # heartbeat
+                    continue
+                write_chunk(
+                    json.dumps({"type": event.type, "object": event.object}).encode()
+                    + b"\n"
+                )
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.server.cluster.stop_watch(watch)
+
+    def log_message(self, fmt: str, *args) -> None:
+        LOG.debug(fmt, *args)
+
+
+class KubeApiStub(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(
+        self,
+        cluster: InMemoryCluster | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        validators: dict[str, Validator] | None = None,
+    ) -> None:
+        super().__init__((host, port), _Handler)
+        self.cluster = cluster or InMemoryCluster()
+        self.validators = validators or {}
+        self.stopping = threading.Event()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server_address[0]}:{self.server_address[1]}"
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, name="kubestub", daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self.shutdown()

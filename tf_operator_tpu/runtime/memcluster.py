@@ -59,6 +59,13 @@ class InMemoryCluster(ClusterClient):
         self._rv += 1
         return str(self._rv)
 
+    @property
+    def current_rv(self) -> str:
+        """Latest resourceVersion — the list-level RV a real apiserver returns
+        (used by the K8s wire stub to pin watch starts)."""
+        with self._lock:
+            return str(self._rv)
+
     def _coll(self, kind: str, namespace: str) -> dict[str, dict[str, Any]]:
         return self._store.setdefault(kind, {}).setdefault(namespace, {})
 
