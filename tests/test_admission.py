@@ -1,0 +1,307 @@
+"""Server-side spec validation: invalid TPUJobs are rejected at the API
+boundary with 422, before anything is stored.
+
+The in-process analog of the reference's CRD OpenAPI validation
+(examples/crd/crd-v1alpha2.yaml:24-47): the same admission function runs in
+the framework apiserver (REST), the K8s wire stub (emulating CRD admission),
+and the dashboard deploy route. The controller decode barrier stays as
+defense-in-depth (tested in test_controller_sync.py).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.admission import validate_tpujob_object
+from tf_operator_tpu.api.validation import ValidationError
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.apiserver import ApiServer
+from tf_operator_tpu.runtime.client import Invalid, NotFound
+from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.runtime.restclient import RestClusterClient
+from tf_operator_tpu.utils import testutil
+
+
+def tpujob_dict(name="job", **overrides):
+    obj = testutil.new_tpujob(name=name, worker=2).to_dict()
+    obj.update(overrides)
+    return obj
+
+
+def template(name="tensorflow", image="img:1"):
+    return {"spec": {"containers": [{"name": name, "image": image}]}}
+
+
+# Invalid-body fixtures: (case-id, mutate(obj) -> obj, message fragment).
+# One per ValidationError in tests/test_api_types.py::TestValidation, plus
+# the structural rules only admission enforces.
+INVALID_BODIES = [
+    ("not-an-object-spec", lambda o: {**o, "spec": "nope"}, "spec is required"),
+    ("no-name", lambda o: {**o, "metadata": {}}, "metadata.name"),
+    (
+        "bad-dns-name",
+        lambda o: {**o, "metadata": {"name": "Has_Caps", "namespace": "default"}},
+        "DNS-1123",
+    ),
+    (
+        "empty-replica-specs",
+        lambda o: {**o, "spec": {"replicaSpecs": {}}},
+        "replicaSpecs",
+    ),
+    (
+        "unknown-replica-type",
+        lambda o: {**o, "spec": {"replicaSpecs": {"Gopher": {"template": template()}}}},
+        "unknown replica type",
+    ),
+    (
+        "no-containers",
+        lambda o: {
+            **o,
+            "spec": {
+                "replicaSpecs": {"Worker": {"template": {"spec": {"containers": []}}}}
+            },
+        },
+        "containers is empty",
+    ),
+    (
+        "empty-image",
+        lambda o: {
+            **o,
+            "spec": {"replicaSpecs": {"Worker": {"template": template(image="")}}},
+        },
+        "image is empty",
+    ),
+    (
+        "missing-default-container",
+        lambda o: {
+            **o,
+            "spec": {"replicaSpecs": {"Worker": {"template": template(name="main")}}},
+        },
+        "no container named",
+    ),
+    (
+        "bad-accelerator",
+        lambda o: {
+            **o,
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "template": template(),
+                        "tpu": {"acceleratorType": "v9z-4"},
+                    }
+                }
+            },
+        },
+        "unknown accelerator",
+    ),
+    (
+        "replicas-slice-mismatch",
+        lambda o: {
+            **o,
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 3,
+                        "template": template(),
+                        "tpu": {"acceleratorType": "v5e-16"},
+                    }
+                }
+            },
+        },
+        "inconsistent",
+    ),
+    (
+        "two-chiefs",
+        lambda o: {
+            **o,
+            "spec": {
+                "replicaSpecs": {
+                    "Chief": {"replicas": 2, "template": template()},
+                    "Worker": {"replicas": 1, "template": template()},
+                }
+            },
+        },
+        "at most 1 chief",
+    ),
+    (
+        "bad-restart-policy",
+        lambda o: {
+            **o,
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "replicas": 1,
+                        "template": template(),
+                        "restartPolicy": "Sometimes",
+                    }
+                }
+            },
+        },
+        "restartPolicy",
+    ),
+]
+
+
+class TestAdmissionFunction:
+    def test_valid_object_passes(self):
+        validate_tpujob_object(tpujob_dict())
+
+    @pytest.mark.parametrize(
+        "case,mutate,fragment", INVALID_BODIES, ids=[c[0] for c in INVALID_BODIES]
+    )
+    def test_invalid_rejected(self, case, mutate, fragment):
+        with pytest.raises(ValidationError, match=fragment):
+            validate_tpujob_object(mutate(tpujob_dict()))
+
+    def test_defaults_applied_before_validation(self):
+        # replicas omitted entirely -> defaulted to 1 -> valid (the decode
+        # barrier and admission must accept the same set of objects).
+        obj = tpujob_dict()
+        del obj["spec"]["replicaSpecs"]["Worker"]["replicas"]
+        validate_tpujob_object(obj)
+
+    def test_does_not_mutate_input(self):
+        obj = tpujob_dict()
+        del obj["spec"]["replicaSpecs"]["Worker"]["replicas"]
+        validate_tpujob_object(obj)
+        assert "replicas" not in obj["spec"]["replicaSpecs"]["Worker"]
+
+
+@pytest.fixture(scope="module")
+def rest_server():
+    server = ApiServer(InMemoryCluster())
+    server.start()
+    client = RestClusterClient(f"http://127.0.0.1:{server.port}")
+    yield server, client
+    server.stop()
+
+
+class TestApiServerAdmission:
+    @pytest.mark.parametrize(
+        "case,mutate,fragment", INVALID_BODIES, ids=[c[0] for c in INVALID_BODIES]
+    )
+    def test_post_invalid_returns_422(self, rest_server, case, mutate, fragment):
+        server, client = rest_server
+        with pytest.raises(Invalid):
+            client.create(objects.TPUJOBS, mutate(tpujob_dict(name="inv")))
+        # Nothing reached the store.
+        with pytest.raises(NotFound):
+            client.get(objects.TPUJOBS, "default", "inv")
+
+    def test_raw_422_status_code_on_wire(self, rest_server):
+        server, _ = rest_server
+        bad = json.dumps({"metadata": {"name": "x"}, "spec": "nope"}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/tpujobs",
+            data=bad,
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req)
+        assert exc_info.value.code == 422
+
+    def test_valid_create_then_invalid_update_rejected(self, rest_server):
+        _, client = rest_server
+        created = client.create(objects.TPUJOBS, tpujob_dict(name="upd"))
+        created["spec"]["replicaSpecs"] = {}
+        with pytest.raises(Invalid):
+            client.update(objects.TPUJOBS, created)
+        # Stored object unchanged.
+        stored = client.get(objects.TPUJOBS, "default", "upd")
+        assert stored["spec"]["replicaSpecs"]
+
+    def test_patch_to_invalid_rejected(self, rest_server):
+        _, client = rest_server
+        client.create(objects.TPUJOBS, tpujob_dict(name="pat"))
+        with pytest.raises(Invalid):
+            client.patch_merge(
+                objects.TPUJOBS, "default", "pat", {"spec": {"replicaSpecs": None}}
+            )
+
+    def test_patch_missing_object_returns_404_not_422(self, rest_server):
+        _, client = rest_server
+        with pytest.raises(NotFound):
+            client.patch_merge(
+                objects.TPUJOBS, "default", "gone", {"metadata": {"labels": {"a": "b"}}}
+            )
+
+    def test_status_update_not_validated(self, rest_server):
+        # Controller status writes must never be blocked by spec validation.
+        _, client = rest_server
+        created = client.create(objects.TPUJOBS, tpujob_dict(name="status-ok"))
+        created["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
+        client.update_status(objects.TPUJOBS, created)
+
+    def test_non_validated_kinds_unaffected(self, rest_server):
+        _, client = rest_server
+        client.create(objects.PODS, objects.new_pod("free-form"))
+
+
+class TestKubeStubAdmission:
+    def test_kube_create_invalid_returns_422(self):
+        stub = KubeApiStub()
+        stub.start()
+        client = KubeClusterClient(KubeConfig(server=stub.url))
+        try:
+            with pytest.raises(Invalid):
+                client.create(
+                    objects.TPUJOBS,
+                    {
+                        "metadata": {"name": "bad", "namespace": "default"},
+                        "spec": {"replicaSpecs": {}},
+                    },
+                )
+        finally:
+            stub.stop()
+
+    def test_kube_patch_to_invalid_rejected(self):
+        stub = KubeApiStub()
+        stub.start()
+        client = KubeClusterClient(KubeConfig(server=stub.url))
+        try:
+            client.create(objects.TPUJOBS, tpujob_dict(name="pat"))
+            with pytest.raises(Invalid):
+                client.patch_merge(
+                    objects.TPUJOBS, "default", "pat", {"spec": {"replicaSpecs": None}}
+                )
+            with pytest.raises(NotFound):
+                client.patch_merge(
+                    objects.TPUJOBS, "default", "gone", {"metadata": {}}
+                )
+        finally:
+            stub.stop()
+
+
+class TestDashboardAdmission:
+    def test_dashboard_deploy_invalid_surfaces_message(self):
+        from tf_operator_tpu.dashboard.backend import mount_dashboard
+
+        cluster = InMemoryCluster()
+        server = ApiServer(cluster)
+        mount_dashboard(server, cluster)
+        server.start()
+        try:
+            bad = tpujob_dict(name="dash-bad")
+            bad["spec"]["replicaSpecs"]["Worker"]["template"] = template(name="main")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/tpujobs/api/tpujob",
+                data=json.dumps(bad).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 422
+            payload = json.loads(exc_info.value.read())
+            assert payload["error"] == "Invalid"
+            assert "no container named" in payload["message"]
+            # Not stored.
+            with pytest.raises(NotFound):
+                cluster.get(objects.TPUJOBS, "default", "dash-bad")
+        finally:
+            server.stop()

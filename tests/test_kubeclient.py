@@ -139,15 +139,14 @@ class TestContract:
             backend.delete(objects.PODS, "default", "p1")
 
     def test_crd_kind_roundtrip(self, backend):
-        job = {
-            "apiVersion": "tpuflow.org/v1",
-            "kind": "TPUJob",
-            "metadata": {"name": "j1", "namespace": "default"},
-            "spec": {"replicaSpecs": {}},
-        }
+        # A schema-valid job: the kube stub enforces TPUJob admission by
+        # default, as a real cluster with deploy/crd.yaml applied would.
+        from tf_operator_tpu.utils import testutil
+
+        job = testutil.new_tpujob(name="j1", worker=1).to_dict()
         backend.create(objects.TPUJOBS, job)
         got = backend.get(objects.TPUJOBS, "default", "j1")
-        assert got["spec"] == {"replicaSpecs": {}}
+        assert got["spec"]["replicaSpecs"]["Worker"]["replicas"] == 1
         got["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
         backend.update_status(objects.TPUJOBS, got)
         after = backend.get(objects.TPUJOBS, "default", "j1")

@@ -1,0 +1,67 @@
+"""Admission validation for unstructured TPUJob objects.
+
+The server-side half of the validation story — the analog of the reference's
+CRD OpenAPI validation (examples/crd/crd-v1alpha2.yaml:24-47), which rejects
+bad specs at the API boundary *before* they are stored. The controller's
+decode barrier (tpujob_controller.decode_job, the informer.go:87-110
+behavior) stays as defense-in-depth for objects that reach the store by
+other means.
+
+Three enforcement points share this function:
+- runtime/apiserver.py rejects invalid create/update/patch with 422,
+- runtime/kubestub.py emulates CRD admission the same way,
+- dashboard/backend.py validates deploys so the UI surfaces the message.
+On a real cluster, deploy/crd.yaml's structural schema covers the same
+rules apiserver-side.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Any
+
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.api.validation import ValidationError, validate_spec
+
+# RFC 1123 DNS label — pod/service names are derived from the job name, so
+# the job name must itself be a valid label (reference: genName truncates to
+# 40 chars for the same reason, replicas.go:574-585).
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_NAME_LEN = 63
+
+
+def validate_tpujob_object(obj: dict[str, Any]) -> None:
+    """Validate an unstructured TPUJob for admission; raises ValidationError.
+
+    Structural checks first (the CRD-schema layer), then full spec
+    validation on a defaulted copy — defaulting before validating mirrors
+    the order the controller's decode barrier uses, so both layers accept
+    exactly the same set of objects. The stored object is what the client
+    sent; defaults are applied at decode time, not persisted.
+    """
+    if not isinstance(obj, dict):
+        raise ValidationError("body must be a JSON object")
+    meta = obj.get("metadata")
+    if not isinstance(meta, dict) or not meta.get("name"):
+        raise ValidationError("metadata.name is required")
+    name = str(meta["name"])
+    if len(name) > MAX_NAME_LEN or not _DNS1123.match(name):
+        raise ValidationError(
+            f"metadata.name {name!r} must be a DNS-1123 label (max {MAX_NAME_LEN} chars)"
+        )
+    spec = obj.get("spec")
+    if not isinstance(spec, dict):
+        raise ValidationError("spec is required and must be an object")
+    if not isinstance(spec.get("replicaSpecs"), dict) or not spec["replicaSpecs"]:
+        raise ValidationError("spec.replicaSpecs must be a non-empty object")
+
+    try:
+        job = TPUJob.from_dict(copy.deepcopy(obj))
+        set_defaults(job)
+    except ValidationError:
+        raise
+    except Exception as e:  # malformed nested structure (wrong types, etc.)
+        raise ValidationError(f"malformed TPUJob: {e}") from e
+    validate_spec(job.spec)

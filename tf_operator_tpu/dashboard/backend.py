@@ -25,9 +25,10 @@ import os
 from typing import Any
 from urllib.parse import unquote, urlparse
 
-from tf_operator_tpu.api import helpers
+from tf_operator_tpu.api import admission, helpers
+from tf_operator_tpu.api.validation import ValidationError
 from tf_operator_tpu.runtime import objects, podlogs
-from tf_operator_tpu.runtime.client import AlreadyExists, ApiError, ClusterClient
+from tf_operator_tpu.runtime.client import AlreadyExists, ApiError, ClusterClient, Invalid
 from tf_operator_tpu.utils import logger
 
 LOG = logger.with_fields(component="dashboard")
@@ -124,6 +125,14 @@ class DashboardBackend:
             if method == "POST" and len(rest) == 0:
                 length = int(req.headers.get("Content-Length", 0))
                 body = json.loads(req.rfile.read(length)) if length else {}
+                # Admission at the deploy boundary: the UI gets the 422 +
+                # message instead of a silently-stored, controller-rejected
+                # job (the dashboard talks straight to the store, so the
+                # apiserver's validators don't cover this path).
+                try:
+                    admission.validate_tpujob_object(body)
+                except ValidationError as e:
+                    raise Invalid(str(e)) from e
                 ns = body.get("metadata", {}).get("namespace", "default")
                 self._ensure_namespace(ns)
                 created = self._client.create(objects.TPUJOBS, body)

@@ -26,14 +26,38 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any
+from typing import Any, Callable
 from urllib.parse import parse_qs, unquote, urlparse
 
-from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.runtime.client import (
+    ApiError,
+    ClusterClient,
+    Invalid,
+    merge_patch,
+)
 from tf_operator_tpu.runtime.httputil import JsonHandlerMixin
 from tf_operator_tpu.utils import logger
 
 LOG = logger.with_fields(component="apiserver")
+
+Validator = Callable[[dict[str, Any]], None]
+
+
+def default_validators() -> dict[str, Validator]:
+    """Per-kind admission validators — the server-side schema enforcement the
+    reference gets from CRD OpenAPI validation (crd-v1alpha2.yaml:24-47).
+    Raise client.Invalid so the wire response is 422."""
+    from tf_operator_tpu.api.admission import validate_tpujob_object
+    from tf_operator_tpu.api.validation import ValidationError
+    from tf_operator_tpu.runtime import objects
+
+    def _validate_tpujob(obj: dict[str, Any]) -> None:
+        try:
+            validate_tpujob_object(obj)
+        except ValidationError as e:
+            raise Invalid(str(e)) from e
+
+    return {objects.TPUJOBS: _validate_tpujob}
 
 
 def parse_label_selector(raw: str) -> dict[str, str]:
@@ -114,7 +138,9 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "NotFound", "message": self.path}, 404)
             return
         try:
-            self._send_json(self.server.backend.create(parts[0], self._read_body()), 201)
+            body = self._read_body()
+            self.server.validate(parts[0], body)
+            self._send_json(self.server.backend.create(parts[0], body), 201)
         except ApiError as e:
             self._send_error_obj(e)
         except (ValueError, json.JSONDecodeError) as e:
@@ -124,7 +150,9 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         root, parts, _ = self._route()
         try:
             if root is not None and len(parts) == 3:
-                self._send_json(self.server.backend.update(parts[0], self._read_body()))
+                body = self._read_body()
+                self.server.validate(parts[0], body)
+                self._send_json(self.server.backend.update(parts[0], body))
             elif root is not None and len(parts) == 4 and parts[3] == "status":
                 self._send_json(
                     self.server.backend.update_status(parts[0], self._read_body())
@@ -142,11 +170,16 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "NotFound", "message": self.path}, 404)
             return
         try:
-            self._send_json(
-                self.server.backend.patch_merge(
-                    parts[0], parts[1], parts[2], self._read_body()
-                )
-            )
+            kind, ns, name = parts[0], parts[1], parts[2]
+            patch = self._read_body()
+            if self.server.validators.get(kind) is not None:
+                # Validate the post-merge result, as CRD admission does for
+                # patches. Read-merge-validate; NotFound propagates so a
+                # missing object stays a 404, and the backend's RV CAS still
+                # guards the actual write.
+                current = self.server.backend.get(kind, ns, name)
+                self.server.validate(kind, merge_patch(current, patch))
+            self._send_json(self.server.backend.patch_merge(kind, ns, name, patch))
         except ApiError as e:
             self._send_error_obj(e)
         except (ValueError, json.JSONDecodeError) as e:
@@ -201,12 +234,26 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
 class ApiServer(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, backend: ClusterClient, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        backend: ClusterClient,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        validators: dict[str, Validator] | None = None,
+    ):
         super().__init__((host, port), _Handler)
         self.backend = backend
         self.stopping = threading.Event()
+        # Admission validation at the API boundary (422 Invalid before the
+        # store is touched). Pass {} to disable.
+        self.validators = default_validators() if validators is None else validators
         # Additional handlers (the dashboard mounts itself here).
         self._extra_handlers: list[Any] = []
+
+    def validate(self, kind: str, obj: dict[str, Any]) -> None:
+        validator = self.validators.get(kind)
+        if validator is not None:
+            validator(obj)
 
     @property
     def port(self) -> int:

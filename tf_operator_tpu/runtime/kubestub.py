@@ -34,6 +34,7 @@ from tf_operator_tpu.runtime.client import (
     Conflict,
     Invalid,
     NotFound,
+    merge_patch,
 )
 from tf_operator_tpu.runtime.memcluster import InMemoryCluster
 from tf_operator_tpu.utils import logger
@@ -211,10 +212,14 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             return
         try:
             ns = route.namespace or "default"
+            patch = self._read_body()
+            if self.server.validators.get(route.kind) is not None:
+                # Post-merge admission, as the apiserver handler and real CRD
+                # validation do; NotFound propagates as 404.
+                current = self.server.cluster.get(route.kind, ns, route.name)
+                self._validate(route.kind, merge_patch(current, patch))
             self._send_json(
-                self.server.cluster.patch_merge(
-                    route.kind, ns, route.name, self._read_body()
-                )
+                self.server.cluster.patch_merge(route.kind, ns, route.name, patch)
             )
         except ApiError as e:
             self._send_api_error(e)
@@ -279,7 +284,14 @@ class KubeApiStub(ThreadingHTTPServer):
     ) -> None:
         super().__init__((host, port), _Handler)
         self.cluster = cluster or InMemoryCluster()
-        self.validators = validators or {}
+        # Default: the TPUJob admission validator, emulating the structural
+        # schema a real cluster enforces once deploy/crd.yaml is applied.
+        # Pass {} to run schema-less.
+        if validators is None:
+            from tf_operator_tpu.runtime.apiserver import default_validators
+
+            validators = default_validators()
+        self.validators = validators
         self.stopping = threading.Event()
 
     @property
