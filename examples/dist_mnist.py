@@ -91,6 +91,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"dist_mnist: resumed from step {start_step}", flush=True)
 
     data = synthetic_mnist(args.batch, seed=topo.process_id)
+    # Resume must continue the batch stream at the step offset, not replay
+    # batches 0..N — the pattern a real data pipeline needs (a replayed
+    # stream would double-train early batches after every preemption).
+    for _ in range(start_step):
+        next(data)
     t0 = time.perf_counter()
     loss = float("inf")
     metrics = None
@@ -119,6 +124,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"dist_mnist: step {i+1} loss={loss:.4f} acc={acc:.3f}", flush=True)
     if ckpt is not None:
         ckpt.close()
+    if metrics is None:  # steps <= start_step: no step ran this incarnation
+        print("dist_mnist: no steps to run", flush=True)
+        return 0
     loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
     steps_run = args.steps - start_step

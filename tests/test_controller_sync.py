@@ -424,3 +424,75 @@ class TestValidationRejection:
         assert tc.sync_job("default/bad") is False
         recorder: FakeRecorder = tc.recorder
         assert any(r[2] == "FailedValidation" for r in recorder.events)
+
+
+class TestTerminalOnceWithoutStoreGets:
+    """VERDICT r1 #9: the terminal-once event guard must come from the
+    informer view + controller memory, not a per-sync client GET (the
+    reference derives it from cache, controller_status.go:42-119)."""
+
+    class _CountingClient:
+        def __init__(self, inner):
+            self._inner = inner
+            self.get_calls = 0
+
+        def get(self, kind, namespace, name):
+            self.get_calls += 1
+            return self._inner.get(kind, namespace, name)
+
+        def __getattr__(self, attr):
+            return getattr(self._inner, attr)
+
+    def _run_to_succeeded(self, tc, client, job, syncs=3):
+        testutil.seed_pods(client, job, "Worker", 2, objects.SUCCEEDED)
+        for _ in range(syncs):
+            sync_once(tc, client, job)
+
+    def test_no_client_get_in_steady_state_sync(self):
+        inner = InMemoryCluster()
+        counting = self._CountingClient(inner)
+        tc, client = make_controller(client=counting)
+        job = testutil.new_tpujob(worker=2)
+        submit(client, job)
+        testutil.seed_pods(client, job, "Worker", 2, objects.SUCCEEDED)
+        # First sync may legitimately GET once: add_job's Created write makes
+        # the decoded RV stale, and _write_status's Conflict retry re-reads.
+        sync_once(tc, client, job)
+        counting.get_calls = 0
+        for _ in range(3):
+            sync_once(tc, client, job)
+        assert counting.get_calls == 0, (
+            f"{counting.get_calls} client GET(s) in the steady-state sync path"
+        )
+
+    def test_terminal_event_recorded_exactly_once_across_syncs(self):
+        tc, client = make_controller()
+        job = testutil.new_tpujob(worker=2)
+        submit(client, job)
+        self._run_to_succeeded(tc, client, job, syncs=4)
+        recorder: FakeRecorder = tc.recorder
+        succeeded_events = [r for r in recorder.events if r[2] == "TPUJobSucceeded"]
+        assert len(succeeded_events) == 1, recorder.events
+
+    def test_terminal_event_fires_even_with_stale_informer(self):
+        # The in-memory record must cover the informer-lag window: two syncs
+        # WITHOUT re-listing the job between them still yield one event.
+        tc, client = make_controller()
+        job = testutil.new_tpujob(worker=2)
+        submit(client, job)
+        testutil.seed_pods(client, job, "Worker", 2, objects.SUCCEEDED)
+        sync_once(tc, client, job)
+        tc.pod_informer.sync_now()
+        tc.sync_job(job.key)  # job informer NOT resynced: stale view
+        recorder: FakeRecorder = tc.recorder
+        succeeded_events = [r for r in recorder.events if r[2] == "TPUJobSucceeded"]
+        assert len(succeeded_events) == 1, recorder.events
+
+    def test_record_cleared_on_job_delete(self):
+        tc, client = make_controller()
+        job = testutil.new_tpujob(worker=2)
+        submit(client, job)
+        self._run_to_succeeded(tc, client, job)
+        assert tc._terminal_recorded
+        tc.delete_job(client.get(objects.TPUJOBS, "default", job.metadata.name))
+        assert not tc._terminal_recorded

@@ -385,8 +385,13 @@ class TestDeployManifests:
             tuple(schema["properties"]["cleanPodPolicy"]["enum"])
             == CleanPodPolicy.CHOICES
         )
-        replica_props = schema["properties"]["replicaSpecs"]["properties"]
+        replica_specs = schema["properties"]["replicaSpecs"]
+        replica_props = replica_specs["properties"]
         assert set(replica_props) == set(ReplicaType.ALL)
+        # Unknown role keys are rejected by CEL (additionalProperties is
+        # forbidden beside properties in v1 structural schemas).
+        cel = replica_specs["x-kubernetes-validations"][0]["rule"]
+        assert all(rtype in cel for rtype in ReplicaType.ALL)
         worker = replica_props["Worker"]
         assert (
             tuple(worker["properties"]["restartPolicy"]["enum"]) == RestartPolicy.ALL

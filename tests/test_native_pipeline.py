@@ -113,3 +113,32 @@ def test_python_engine_surfaces_producer_errors(tmp_path):
             if p._engine.next() is None:
                 break
     p.close()
+
+
+def test_python_engine_close_unblocks_concurrent_reader(record_file):
+    """A reader blocked in next() while close() runs must terminate, even
+    when a size-1 prefetch queue refills between close's drain and its
+    sentinel put (the producer deposits one final in-flight batch)."""
+    import threading
+
+    path, _ = record_file
+    p = RecordPipeline(
+        path, REC_BYTES, 4, engine="python", seed=1, shuffle=False,
+        loop=True, prefetch=1,
+    )
+    it = iter(p)
+    next(it)  # pipeline running, producer refilling the size-1 queue
+    results = []
+
+    def reader():
+        try:
+            while next(it, None) is not None:
+                results.append(1)
+        except Exception:
+            pass
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    p.close()
+    t.join(timeout=5)
+    assert not t.is_alive(), "reader hung after close()"

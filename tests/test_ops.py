@@ -141,3 +141,38 @@ def test_flash_rejects_untileable():
     q, k, v = _rand_qkv(np.random.default_rng(5), t=33)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, block=32, interpret=True)
+
+
+class TestExplicitBlockValidation:
+    """Caller-supplied block on the compiled path must pass the same Mosaic
+    legality rules select_block enforces, failing fast with a descriptive
+    error instead of an opaque lowering failure."""
+
+    def _q(self, seq):
+        import jax.numpy as jnp
+
+        return jnp.zeros((1, seq, 2, 64), jnp.bfloat16)
+
+    def test_non_128_block_rejected(self):
+        q = self._q(256)
+        with pytest.raises(ValueError, match="Mosaic-legal"):
+            flash_attention(q, q, q, block=32, interpret=False)
+
+    def test_equal_to_dim_block_over_vmem_cap_rejected(self):
+        # block == tq == tk and %16-aligned, but > 512: the f32
+        # [block, block] score tile would blow the VMEM budget select_block
+        # caps (1024 % 128 != 0 is false here — use 1040: %16 ok, not %128).
+        q = self._q(1040)
+        with pytest.raises(ValueError, match="Mosaic-legal"):
+            flash_attention(q, q, q, block=1040, interpret=False)
+
+    def test_equal_to_dim_misaligned_block_rejected(self):
+        # block == tq == tk but not %16-aligned (sublane constraint).
+        q = self._q(200)
+        with pytest.raises(ValueError, match="Mosaic-legal"):
+            flash_attention(q, q, q, block=200, interpret=False)
+
+    def test_interpret_mode_accepts_any_tiling_block(self):
+        q = self._q(64)
+        out = flash_attention(q, q, q, block=32, interpret=True)
+        assert out.shape == q.shape

@@ -101,6 +101,10 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         self.update_status_handler = self._write_status
         self.delete_job_handler = self._delete_job_resource
         self._workers: list[threading.Thread] = []
+        # job key -> terminal condition type already recorded (evented) by
+        # THIS controller — the in-memory half of the terminal-once guard
+        # (see _terminal_already_recorded); cleared when the job is deleted.
+        self._terminal_recorded: dict[str, str] = {}
 
     # ------------------------------------------------------------------ decode
 
@@ -144,6 +148,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
 
     def delete_job(self, obj: dict[str, Any]) -> None:
         key = f"{objects.namespace_of(obj)}/{objects.name_of(obj)}"
+        self._terminal_recorded.pop(key, None)
         for rtype in ReplicaType.ALL:
             self.expectations.delete_expectations(
                 self.expectation_key(key, rtype, "pods")
@@ -383,7 +388,9 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             w = _replicas(ReplicaType.WORKER)
             succeeded = w > 0 and rs[ReplicaType.WORKER].succeeded >= w
         if succeeded:
-            newly_terminal = not self._terminal_in_store(job, JobConditionType.SUCCEEDED)
+            newly_terminal = not self._terminal_already_recorded(
+                job, JobConditionType.SUCCEEDED
+            )
             if job.status.completion_time is None:
                 job.status.completion_time = objects.now_iso()
             status_engine.update_job_conditions(
@@ -411,7 +418,9 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             )
             return
         if permanent_failure or (total_failed > 0 and not self._any_restartable(job)):
-            newly_terminal = not self._terminal_in_store(job, JobConditionType.FAILED)
+            newly_terminal = not self._terminal_already_recorded(
+                job, JobConditionType.FAILED
+            )
             if job.status.completion_time is None:
                 job.status.completion_time = objects.now_iso()
             status_engine.update_job_conditions(
@@ -432,20 +441,27 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
                 f"TPUJob {name} is restarting ({job.status.restart_count} restart(s) total).",
             )
 
-    def _terminal_in_store(self, job: TPUJob, ctype: str) -> bool:
-        """Whether the authoritative (store) copy already carries the terminal
-        condition — guards terminal events against stale informer reads so
-        the transition is recorded exactly once."""
-        try:
-            fresh = self.client.get(
-                objects.TPUJOBS, job.metadata.namespace, job.metadata.name
-            )
-        except NotFound:
-            return False
-        return any(
-            c.get("type") == ctype and c.get("status") == "True"
-            for c in fresh.get("status", {}).get("conditions", [])
+    def _terminal_already_recorded(self, job: TPUJob, ctype: str) -> bool:
+        """Terminal-once guard without a per-sync API round-trip.
+
+        The reference derives this from cache (controller_status.go:42-119);
+        a fresh GET per sync would be avoidable apiserver load at O(100)
+        jobs × 15 s resync. Two cache layers cover the two staleness cases:
+        - the job's own conditions (decoded from the informer cache) cover
+          writes this controller OR a predecessor made, once observed;
+        - _terminal_recorded covers the informer-lag window right after THIS
+          controller wrote the condition (the event must not double-fire
+          while the watch delta is still in flight).
+        Marks the condition as recorded when it reports False, so each
+        (job, condition) transitions exactly once per controller incarnation.
+        """
+        if self._terminal_recorded.get(job.key) == ctype:
+            return True
+        seen = any(
+            c.type == ctype and c.status == "True" for c in job.status.conditions
         )
+        self._terminal_recorded[job.key] = ctype
+        return seen
 
     def _any_restartable(self, job: TPUJob) -> bool:
         """Whether the failed pods belong to a replica set whose policy can

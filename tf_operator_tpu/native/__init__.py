@@ -62,18 +62,27 @@ def load_library(source: str, *, cxxflags: tuple[str, ...] = ()) -> ctypes.CDLL:
                 *cxxflags, src_path, "-o", tmp,
             ]
             try:
-                proc = subprocess.run(
-                    cmd, capture_output=True, text=True, timeout=120
-                )
-            except (OSError, subprocess.TimeoutExpired) as e:
-                _CACHE[key] = None
-                raise NativeBuildError(f"g++ unavailable: {e}") from e
-            if proc.returncode != 0:
-                _CACHE[key] = None
-                raise NativeBuildError(
-                    f"compile failed for {source}:\n{proc.stderr[-4000:]}"
-                )
-            os.replace(tmp, so_path)
+                try:
+                    proc = subprocess.run(
+                        cmd, capture_output=True, text=True, timeout=120
+                    )
+                except (OSError, subprocess.TimeoutExpired) as e:
+                    _CACHE[key] = None
+                    raise NativeBuildError(f"g++ unavailable: {e}") from e
+                if proc.returncode != 0:
+                    _CACHE[key] = None
+                    raise NativeBuildError(
+                        f"compile failed for {source}:\n{proc.stderr[-4000:]}"
+                    )
+                os.replace(tmp, so_path)
+            finally:
+                # Failed/timed-out builds must not litter _build/ with
+                # .so.tmp files (success os.replace()s the tmp away).
+                if os.path.exists(tmp):
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
         try:
             lib = ctypes.CDLL(so_path)
         except OSError as e:

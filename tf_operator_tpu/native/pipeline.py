@@ -154,12 +154,24 @@ class _PythonEngine:
 
     def close(self) -> None:
         self._stop.set()
-        # drain so the producer unblocks
-        try:
-            while True:
-                self._q.get_nowait()
-        except queue_mod.Empty:
-            pass
+        # Sentinel for a reader concurrently blocked in next()'s get(): the
+        # producer exits via _put returning False without putting anything,
+        # so without this a reader thread would hang forever. Drain-then-put
+        # must loop: a producer blocked in _put can deposit one more real
+        # item right after a drain pass (refilling a size-1 queue), in which
+        # case the first put_nowait raises Full and must be retried — the
+        # producer stops refilling once it observes _stop, so this converges.
+        while True:
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue_mod.Empty:
+                pass
+            try:
+                self._q.put_nowait(None)
+                return
+            except queue_mod.Full:
+                continue
 
 
 class RecordPipeline:

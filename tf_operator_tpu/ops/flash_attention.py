@@ -382,6 +382,19 @@ def flash_attention(
     tq, tk = q.shape[1], k.shape[1]
     if block is None:
         block = select_block(tq, tk, compiled=not interpret)
+    elif not interpret and block % 128 != 0:
+        # A caller-supplied block must satisfy the same compiled-path
+        # legality select_block enforces, or the failure surfaces later as
+        # an opaque Mosaic lowering error: non-%128 blocks are only legal as
+        # the equal-to-dim single block, with the same sublane-alignment and
+        # VMEM-score-tile caps as select_block's fallback (lines 72-79).
+        if not (block == tq == tk and tq % 16 == 0 and tq <= 512):
+            raise ValueError(
+                f"block={block} is not Mosaic-legal for seq lengths "
+                f"({tq},{tk}): a compiled-path block must be a multiple of "
+                f"128, or equal to both sequence lengths with seq % 16 == 0 "
+                f"and seq <= 512"
+            )
     if block is None or tq % block or tk % block:
         raise ValueError(f"seq lengths ({tq},{tk}) don't tile (block={block})")
     if causal and tq != tk:

@@ -151,8 +151,9 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         try:
             if root is not None and len(parts) == 3:
                 body = self._read_body()
-                self.server.validate(parts[0], body)
-                self._send_json(self.server.backend.update(parts[0], body))
+                with self.server.mutation_lock(parts[0]):
+                    self.server.validate(parts[0], body)
+                    self._send_json(self.server.backend.update(parts[0], body))
             elif root is not None and len(parts) == 4 and parts[3] == "status":
                 self._send_json(
                     self.server.backend.update_status(parts[0], self._read_body())
@@ -172,14 +173,19 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         try:
             kind, ns, name = parts[0], parts[1], parts[2]
             patch = self._read_body()
-            if self.server.validators.get(kind) is not None:
-                # Validate the post-merge result, as CRD admission does for
-                # patches. Read-merge-validate; NotFound propagates so a
-                # missing object stays a 404, and the backend's RV CAS still
-                # guards the actual write.
-                current = self.server.backend.get(kind, ns, name)
-                self.server.validate(kind, merge_patch(current, patch))
-            self._send_json(self.server.backend.patch_merge(kind, ns, name, patch))
+            with self.server.mutation_lock(kind):
+                if self.server.validators.get(kind) is not None:
+                    # Validate the post-merge result, as CRD admission does
+                    # for patches. Read-merge-validate-write runs under the
+                    # per-kind mutation lock: two concurrent, individually-
+                    # valid patches must not interleave into an invalid
+                    # stored object. NotFound propagates (missing object
+                    # stays a 404).
+                    current = self.server.backend.get(kind, ns, name)
+                    self.server.validate(kind, merge_patch(current, patch))
+                self._send_json(
+                    self.server.backend.patch_merge(kind, ns, name, patch)
+                )
         except ApiError as e:
             self._send_error_obj(e)
         except (ValueError, json.JSONDecodeError) as e:
@@ -247,6 +253,10 @@ class ApiServer(ThreadingHTTPServer):
         # Admission validation at the API boundary (422 Invalid before the
         # store is touched). Pass {} to disable.
         self.validators = default_validators() if validators is None else validators
+        # Serializes spec mutations of validated kinds so PATCH's
+        # read-merge-validate-write is atomic w.r.t. concurrent PUT/PATCH
+        # (ThreadingHTTPServer handles requests concurrently).
+        self._mutation_lock = threading.Lock()
         # Additional handlers (the dashboard mounts itself here).
         self._extra_handlers: list[Any] = []
 
@@ -254,6 +264,15 @@ class ApiServer(ThreadingHTTPServer):
         validator = self.validators.get(kind)
         if validator is not None:
             validator(obj)
+
+    def mutation_lock(self, kind: str):
+        """The write-serialization lock for validated kinds; a no-op context
+        for kinds with no validator (their writes need no merge admission)."""
+        if self.validators.get(kind) is not None:
+            return self._mutation_lock
+        import contextlib
+
+        return contextlib.nullcontext()
 
     @property
     def port(self) -> int:

@@ -196,8 +196,9 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             if route.subresource == "status":
                 self._send_json(self.server.cluster.update_status(route.kind, obj))
             elif route.subresource is None:
-                self._validate(route.kind, obj)
-                self._send_json(self.server.cluster.update(route.kind, obj))
+                with self.server.mutation_lock(route.kind):
+                    self._validate(route.kind, obj)
+                    self._send_json(self.server.cluster.update(route.kind, obj))
             else:
                 self._send_json(status_body(404, "NotFound", self.path), 404)
         except ApiError as e:
@@ -213,14 +214,17 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         try:
             ns = route.namespace or "default"
             patch = self._read_body()
-            if self.server.validators.get(route.kind) is not None:
-                # Post-merge admission, as the apiserver handler and real CRD
-                # validation do; NotFound propagates as 404.
-                current = self.server.cluster.get(route.kind, ns, route.name)
-                self._validate(route.kind, merge_patch(current, patch))
-            self._send_json(
-                self.server.cluster.patch_merge(route.kind, ns, route.name, patch)
-            )
+            with self.server.mutation_lock(route.kind):
+                if self.server.validators.get(route.kind) is not None:
+                    # Post-merge admission under the mutation lock, as the
+                    # apiserver handler does (concurrent individually-valid
+                    # patches must not merge into an invalid stored object);
+                    # NotFound propagates as 404.
+                    current = self.server.cluster.get(route.kind, ns, route.name)
+                    self._validate(route.kind, merge_patch(current, patch))
+                self._send_json(
+                    self.server.cluster.patch_merge(route.kind, ns, route.name, patch)
+                )
         except ApiError as e:
             self._send_api_error(e)
         except (ValueError, json.JSONDecodeError) as e:
@@ -292,7 +296,16 @@ class KubeApiStub(ThreadingHTTPServer):
 
             validators = default_validators()
         self.validators = validators
+        self._mutation_lock = threading.Lock()
         self.stopping = threading.Event()
+
+    def mutation_lock(self, kind: str):
+        """Serializes PUT/PATCH of validated kinds (see ApiServer.mutation_lock)."""
+        if self.validators.get(kind) is not None:
+            return self._mutation_lock
+        import contextlib
+
+        return contextlib.nullcontext()
 
     @property
     def url(self) -> str:
