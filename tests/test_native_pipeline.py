@@ -105,11 +105,18 @@ def test_python_engine_surfaces_producer_errors(tmp_path):
     # fail; the consumer must raise, not hang (native-engine parity).
     path = str(tmp_path / "shrink.bin")
     write_records(path, np.zeros((10, REC_BYTES), np.uint8))
-    p = RecordPipeline(path, REC_BYTES, 4, engine="python", shuffle=False)
+    # loop=True + prefetch=1: the producer can pre-read at most two batches
+    # before blocking on the queue, so after the truncation below some read
+    # of the endless epoch stream MUST fail — no timing window (a non-loop
+    # pipeline can prefetch its whole epoch before the truncation lands).
+    p = RecordPipeline(
+        path, REC_BYTES, 4, engine="python", shuffle=False, loop=True,
+        prefetch=1,
+    )
     with open(path, "wb") as f:
         f.write(b"x" * REC_BYTES)  # truncate under the reader
     with pytest.raises(IOError):
-        for _ in range(10):
+        for _ in range(20):
             if p._engine.next() is None:
                 break
     p.close()

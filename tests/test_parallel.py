@@ -1,5 +1,6 @@
 """Parallel layer tests on the virtual 8-device CPU mesh: mesh construction,
-sharding rules, ring attention exactness (fwd + grad)."""
+sharding rules, fsdp (sharded params/optimizer state, dp equivalence,
+collective insertion), ring attention exactness (fwd + grad)."""
 
 import jax
 import jax.numpy as jnp
@@ -13,8 +14,10 @@ from tf_operator_tpu.parallel.ring_attention import (
 )
 from tf_operator_tpu.parallel.sharding import (
     batch_sharded,
+    fsdp_sharding_tree,
     shard_batch,
     shard_params_by_rules,
+    shard_params_fsdp,
 )
 
 
@@ -60,6 +63,94 @@ class TestSharding:
         )
         assert out["mlp"]["in_proj"]["kernel"].sharding.spec == P(None, "tp")
         assert out["norm"]["scale"].sharding.spec == P()
+
+
+class TestFsdp:
+    """Parameter+optimizer-state sharding over the data axis — the TPU
+    analog of the reference's PS state distribution (SURVEY.md §2.9)."""
+
+    def _setup(self, donate=False):
+        from tf_operator_tpu.models.mnist import MnistCNN
+        from tf_operator_tpu.train.steps import (
+            TrainState,
+            adamw,
+            make_classifier_train_step,
+        )
+
+        model = MnistCNN(dtype=jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 28, 28, 1))
+        y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+        params = model.init(jax.random.PRNGKey(2), x, train=True)["params"]
+        tx = adamw(1e-3)
+        mesh = create_mesh({"fsdp": 8})
+        tree = fsdp_sharding_tree(mesh, params, min_size=64)
+        state = TrainState.create(shard_params_fsdp(mesh, params, min_size=64), tx)
+        step = make_classifier_train_step(
+            model, tx, mesh, has_batch_stats=False, data_axis="fsdp",
+            param_shardings=tree, donate=donate,
+        )
+        batch = shard_batch(mesh, {"image": x, "label": y}, axis="fsdp")
+        return model, tx, params, x, y, mesh, tree, state, step, batch
+
+    def test_sharding_tree_rules(self):
+        mesh = create_mesh({"fsdp": 8})
+        params = {
+            "dense": {"kernel": jnp.ones((16, 256)), "bias": jnp.ones((256,))},
+            "odd": jnp.ones((129, 3)),   # no dim divisible by 8
+            "tiny": jnp.ones((8, 8)),    # under min_size
+        }
+        tree = fsdp_sharding_tree(mesh, params, min_size=128)
+        # largest divisible dim sharded
+        assert tree["dense"]["kernel"].spec == P(None, "fsdp")
+        assert tree["dense"]["bias"].spec == P("fsdp")
+        # indivisible and small arrays stay replicated
+        assert tree["odd"].spec == P()
+        assert tree["tiny"].spec == P()
+
+    def test_state_physically_sharded(self):
+        *_, state, step, batch = self._setup()
+        k = state.params["Dense_0"]["kernel"]
+        assert k.addressable_shards[0].data.shape[0] == k.shape[0] // 8
+        # adamw moments inherit the sharded placement (the PS-state analog)
+        mu = state.opt_state[0].mu
+        assert mu["Dense_0"]["kernel"].sharding.spec == P("fsdp", None)
+
+    def test_collectives_inserted(self):
+        """The fsdp step must gather shards for compute and reduce grads.
+
+        On TPU the gradient collective is a reduce-scatter; the CPU test
+        backend lowers it as all-reduce + slice, so accept either.
+        """
+        *_, state, step, batch = self._setup()
+        txt = step.lower(state, batch).compile().as_text()
+        assert "all-gather" in txt
+        assert "reduce-scatter" in txt or "all-reduce" in txt
+
+    def test_numerical_equivalence_vs_dp(self):
+        from tf_operator_tpu.parallel.sharding import replicate
+        from tf_operator_tpu.train.steps import (
+            TrainState,
+            make_classifier_train_step,
+        )
+
+        model, tx, params, x, y, *_ = self._setup()
+        _, _, _, _, _, _, _, fs_state, fs_step, fs_batch = self._setup()
+        dp_mesh = create_mesh({"dp": 8})
+        dp_state = replicate(dp_mesh, TrainState.create(params, tx))
+        dp_step = make_classifier_train_step(
+            model, tx, dp_mesh, has_batch_stats=False, donate=False
+        )
+        dp_batch = shard_batch(dp_mesh, {"image": x, "label": y})
+        for _ in range(3):
+            dp_state, dp_metrics = dp_step(dp_state, dp_batch)
+            fs_state, fs_metrics = fs_step(fs_state, fs_batch)
+        assert float(dp_metrics["loss"]) == pytest.approx(
+            float(fs_metrics["loss"]), abs=1e-5
+        )
+        diffs = jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()), dp_state.params, fs_state.params
+        )
+        assert max(jax.tree.leaves(diffs)) < 1e-4
 
 
 class TestRingAttention:

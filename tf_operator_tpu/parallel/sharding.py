@@ -82,6 +82,49 @@ def shard_params_by_rules(
     return jax.tree.map(jax.device_put, params, shardings)
 
 
+def fsdp_sharding_tree(
+    mesh: Mesh, params: Any, axis: str = "fsdp", min_size: int = 2**11
+) -> Any:
+    """Fully-sharded-data-parallel placement for a param/optimizer pytree.
+
+    Each array's largest dimension divisible by the ``axis`` size is sharded
+    over that axis; arrays smaller than ``min_size`` elements (biases, norm
+    scales) stay replicated — the per-chip slice would be smaller than the
+    collective's cost. This is the TPU analog of the reference era's
+    parameter-server state distribution (SURVEY.md §2.9: PS replicas each
+    own a shard of the variables, reference pkg/apis/tensorflow/v1alpha2/
+    types.go:117-123): parameter and optimizer state live sharded across the
+    data-parallel workers, and XLA inserts the all-gather (forward/backward)
+    and reduce-scatter (gradient) collectives a PS round-trip performed.
+    """
+    size = mesh.shape[axis]
+
+    def spec_for(leaf) -> P:
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape or leaf.size < min_size:
+            return P()
+        for d in sorted(range(len(shape)), key=lambda i: shape[i], reverse=True):
+            if shape[d] % size == 0:
+                spec: list[Any] = [None] * len(shape)
+                spec[d] = axis
+                return P(*spec)
+        return P()
+
+    return jax.tree.map(lambda l: NamedSharding(mesh, spec_for(l)), params)
+
+
+def shard_params_fsdp(
+    mesh: Mesh, params: Any, axis: str = "fsdp", min_size: int = 2**11
+) -> Any:
+    """Device-put params with fsdp placement (see fsdp_sharding_tree).
+
+    Call BEFORE ``tx.init`` so optimizer moments inherit the sharded
+    placement — that is what makes optimizer state fully sharded too.
+    """
+    shardings = fsdp_sharding_tree(mesh, params, axis, min_size)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
 def constrain(x: Any, mesh: Mesh, *spec: Any) -> Any:
     """with_sharding_constraint shorthand for intermediates inside jit."""
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

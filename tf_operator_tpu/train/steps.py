@@ -49,11 +49,17 @@ def make_classifier_train_step(
     mesh: Mesh,
     *,
     has_batch_stats: bool = True,
-    data_axis: str = "dp",
+    data_axis: Any = "dp",
     donate: bool = True,
+    param_shardings: Any = None,
 ) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
     """Train step for image classifiers (ResNet/MNIST): batch sharded over
-    the data axis, params replicated, BN stats computed globally by XLA."""
+    the data axis (a mesh axis name or tuple of names, e.g. ("dp", "fsdp")),
+    params replicated — or, with ``param_shardings`` (e.g. from
+    fsdp_sharding_tree), fully sharded: the caller device_puts params per the
+    tree before TrainState.create so optimizer moments inherit the placement,
+    the step pins updated params to it, and XLA inserts the fsdp
+    all-gather/reduce-scatter collectives."""
 
     def loss_fn(params, batch_stats, batch):
         variables = {"params": params}
@@ -73,8 +79,16 @@ def make_classifier_train_step(
         (loss, (new_stats, logits)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, batch)
+        if param_shardings is not None:
+            # Pin grads to the param placement so the gradient collective is
+            # a reduce-scatter (grad shards) rather than a full all-reduce.
+            grads = jax.lax.with_sharding_constraint(grads, param_shardings)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if param_shardings is not None:
+            new_params = jax.lax.with_sharding_constraint(
+                new_params, param_shardings
+            )
         metrics = {"loss": loss, "accuracy": accuracy(logits, batch["label"])}
         return (
             state.replace(
@@ -90,6 +104,14 @@ def make_classifier_train_step(
         "image": NamedSharding(mesh, P(data_axis)),
         "label": NamedSharding(mesh, P(data_axis)),
     }
+    if param_shardings is not None:
+        # Sharded-state path: placement is inferred from the (already
+        # fsdp-placed) state argument; metrics stay replicated by default.
+        return jax.jit(
+            step,
+            in_shardings=(None, batch_sharding),
+            donate_argnums=(0,) if donate else (),
+        )
     replicated = NamedSharding(mesh, P())
     return jax.jit(
         step,
