@@ -57,6 +57,22 @@ def test_histogram_buckets_cumulative():
     assert "lat_seconds_sum 105.65" in text
 
 
+def test_histogram_quantile():
+    reg = Registry()
+    h = reg.histogram("q_seconds", labelnames=("op",), buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.99) == 0.0  # no observations
+    for _ in range(99):
+        h.observe(0.05, op="fast")
+    h.observe(5.0, op="slow")
+    # 99th of 100 observations is still in the first bucket
+    assert h.quantile(0.5) == 0.1
+    assert h.quantile(0.99) == 0.1
+    assert h.quantile(1.0) == 10.0  # the slow one, merged across series
+    assert h.quantile(1.0, op="fast") == 0.1  # single-series view
+    h.observe(100.0, op="slow")
+    assert h.quantile(1.0) == float("inf")  # overflow bucket
+
+
 def test_registry_dedupes_families():
     reg = Registry()
     a = reg.counter("same_total")

@@ -130,6 +130,34 @@ class Histogram(_Family):
             series["sum"] += value
             series["n"] += 1
 
+    def quantile(self, q: float, **labels: str) -> float:
+        """Upper bucket bound holding the q-th observation (conservative).
+
+        With labels: that series only; without: all series merged. Returns
+        0.0 with no observations, +inf when the quantile lands in the
+        overflow bucket.
+        """
+        with self._lock:
+            if labels:
+                series = [self._series.get(self._key(labels))]
+                series = [s for s in series if s]
+            else:
+                series = list(self._series.values())
+            counts = [0] * (len(self.buckets) + 1)
+            for s in series:
+                for i, c in enumerate(s["counts"]):
+                    counts[i] += c
+        total = sum(counts)
+        if not total:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
     def render(self) -> list[str]:
         out = []
         with self._lock:
