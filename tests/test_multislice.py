@@ -1,0 +1,178 @@
+"""Multislice (MEGASCALE) E2E: a num_slices=2 job through the local stack.
+
+Verifies the DCN-multislice contract end to end (SURVEY.md §2.9 "keep DNS
+rendezvous for inter-slice DCN"): every replica of a 2-slice v5e-16 job
+echoes its injected topology env via GET /topology, and the env partitions
+the replica set per slice — in-slice worker ids and coordinator, shared
+MEGASCALE coordinator on slice 0. Plus the training-side analog: a dcn mesh
+axis over the virtual CPU mesh whose gradient all-reduce spans slices.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.executor import LocalProcessExecutor
+from tf_operator_tpu.runtime.gc import OwnerGarbageCollector
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.topology import slices as topo_slices
+
+from test_e2e_local import SERVER_CMD, http_get, job_condition, wait_for
+
+ACCELERATOR = "v5e-16"  # 4 hosts per slice
+NUM_SLICES = 2
+
+
+@pytest.fixture()
+def stack():
+    client = InMemoryCluster()
+    tc = TPUJobController(
+        client,
+        JobControllerConfig(reconcile_period=0.2, informer_resync=0.5, threadiness=2),
+    )
+    executor = LocalProcessExecutor(client)
+    collector = OwnerGarbageCollector(client)
+    stop = threading.Event()
+    threading.Thread(target=tc.run, args=(stop,), daemon=True).start()
+    executor.start(stop)
+    collector.start(stop)
+    time.sleep(0.3)
+    yield client, executor
+    stop.set()
+    time.sleep(0.3)
+
+
+def submit_multislice_job(client, name="ms"):
+    return client.create(
+        objects.TPUJOBS,
+        {
+            "apiVersion": constants.API_VERSION,
+            "kind": constants.KIND,
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "replicaSpecs": {
+                    "Worker": {
+                        "tpu": {
+                            "acceleratorType": ACCELERATOR,
+                            "numSlices": NUM_SLICES,
+                        },
+                        "template": {
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": constants.DEFAULT_CONTAINER_NAME,
+                                        "image": "local",
+                                        "command": SERVER_CMD,
+                                    }
+                                ]
+                            }
+                        },
+                    }
+                }
+            },
+        },
+    )
+
+
+@pytest.mark.slow
+def test_two_slice_job_partitions_topology_env(stack):
+    client, executor = stack
+    topo = topo_slices.resolve(ACCELERATOR)
+    hosts_per_slice = topo.num_hosts
+    total = hosts_per_slice * NUM_SLICES
+
+    submit_multislice_job(client)
+    wait_for(job_condition(client, "ms", "Running"), timeout=30,
+             desc="ms job Running")
+    pods = wait_for(
+        lambda: (lambda ps: ps if len(ps) == total else None)(
+            client.list(objects.PODS, "default")
+        ),
+        desc=f"{total} replica pods",
+    )
+    assert len(pods) == total
+
+    port = constants.DEFAULT_PORT
+    seen_megascale_coords = set()
+    for i in range(total):
+        topo_env = http_get(executor, f"ms-worker-{i}", "/topology")
+        slice_id, worker_id = divmod(i, hosts_per_slice)
+        base = slice_id * hosts_per_slice
+        slice_hosts = [f"ms-worker-{base + j}" for j in range(hosts_per_slice)]
+
+        # In-slice partition: this slice's hosts only, in index order.
+        assert topo_env[constants.ENV_TPU_WORKER_HOSTNAMES] == ",".join(slice_hosts)
+        assert topo_env[constants.ENV_TPU_WORKER_ID] == str(worker_id)
+        assert topo_env[constants.ENV_NUM_PROCESSES] == str(hosts_per_slice)
+        # Per-slice coordinator = worker 0 *of that slice*. The local
+        # executor rewrites "{pod}:{port}" contracts to the replica's real
+        # reachable address, so resolve the expectation the same way.
+        ip0, port0 = executor.resolve(slice_hosts[0])
+        assert topo_env[constants.ENV_COORDINATOR_ADDRESS] == f"{ip0}:{port0}"
+        assert topo_env[constants.ENV_TPU_ACCELERATOR_TYPE] == ACCELERATOR
+
+        # Cross-slice MEGASCALE wiring: slice count, own slice id, and one
+        # shared DCN coordinator (slice 0's worker 0) for every replica.
+        assert topo_env["MEGASCALE_NUM_SLICES"] == str(NUM_SLICES)
+        assert topo_env["MEGASCALE_SLICE_ID"] == str(slice_id)
+        seen_megascale_coords.add(topo_env["MEGASCALE_COORDINATOR_ADDRESS"])
+    dcn_ip, dcn_port = executor.resolve("ms-worker-0")
+    assert seen_megascale_coords == {f"{dcn_ip}:{dcn_port}"}
+
+    # Tear down: terminate every replica cleanly; the job must reach
+    # Succeeded only when all slices have finished.
+    for i in range(total):
+        http_get(executor, f"ms-worker-{i}", "/exit?exitCode=0")
+    wait_for(job_condition(client, "ms", "Succeeded"), timeout=30,
+             desc="ms job Succeeded")
+
+
+def test_dcn_mesh_trains_across_slices():
+    """Training-side multislice analog on the virtual CPU mesh: a dcn x dp
+    mesh (2 slices x 4 chips), batch sharded over both data axes; the
+    gradient reduction must span the dcn axis (cross-slice traffic)."""
+    import jax
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import multislice_mesh
+    from tf_operator_tpu.parallel.sharding import replicate, shard_batch
+    from tf_operator_tpu.train.steps import (
+        TrainState,
+        make_classifier_train_step,
+        sgd_momentum,
+    )
+
+    mesh = multislice_mesh(2, {"dp": 4})
+    assert tuple(mesh.axis_names)[0] == "dcn"  # outermost: ICI inside slices
+    assert mesh.shape == {"dcn": 2, "dp": 4}
+
+    model = MnistCNN(dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+    params = model.init(jax.random.PRNGKey(2), x, train=True)["params"]
+    tx = sgd_momentum(0.01)
+    state = replicate(mesh, TrainState.create(params, tx))
+    step = make_classifier_train_step(
+        model, tx, mesh, has_batch_stats=False, data_axis=("dcn", "dp"),
+        donate=False,
+    )
+    batch = shard_batch(mesh, {"image": x, "label": y}, axis=("dcn", "dp"))
+
+    # The gradient all-reduce must include the dcn dimension: with 8 devices
+    # in 2 slices the reduction group covers all devices, not one slice
+    # ([1,8]<=[8] is the iota form of one group of all 8).
+    txt = step.lower(state, batch).compile().as_text()
+    assert "all-reduce" in txt
+    assert (
+        "replica_groups=[1,8]<=[8]" in txt
+        or "replica_groups={{0,1,2,3,4,5,6,7}}" in txt
+    )
+
+    state, metrics = step(state, batch)
+    assert jnp.isfinite(metrics["loss"])

@@ -70,6 +70,9 @@ def test_hundred_concurrent_jobs_all_succeed():
         ),
     )
     stop = threading.Event()
+    # Window the process-global sync histogram to THIS test's observations
+    # (earlier tests in the same pytest process share the registry).
+    sync_baseline = tc_mod.SYNC_SECONDS.snapshot()
     threading.Thread(target=controller.run, args=(stop,), daemon=True).start()
     kubelet = FakeKubelet(client, stop)
     kubelet.start()
@@ -121,7 +124,7 @@ def test_hundred_concurrent_jobs_all_succeed():
         # p99 sync latency bounded: generous bound (shared CI machine), the
         # point is no pathological syncs (reference budget: a 15s resync
         # loop must not back up — jobcontroller.go:49-55).
-        p99 = tc_mod.SYNC_SECONDS.quantile(0.99)
+        p99 = tc_mod.SYNC_SECONDS.quantile(0.99, since=sync_baseline)
         assert p99 <= 2.5, f"p99 sync latency {p99}s"
 
         pods = client.list(objects.PODS, "default")

@@ -7,6 +7,9 @@ inserts the collectives (the "pick a mesh, annotate shardings" recipe).
 
 Axis conventions used across the framework:
 
+- ``dcn`` — cross-slice data parallelism (multislice: gradients reduced
+          over the data-center network between slices; always the
+          outermost axis so in-slice collectives ride ICI)
 - ``dp``  — data parallelism (batch split; gradients all-reduced over ICI)
 - ``fsdp``— data parallelism with sharded parameters/optimizer state
           (the TPU analog of the reference era's "PS sharding": parameter
@@ -30,7 +33,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 def create_mesh(
@@ -86,6 +89,33 @@ def slice_mesh(accelerator_type: str, topology: str | None = None,
             f"but {len(devices)} are visible"
         )
     return create_mesh({data_axis: len(devices)}, devices)
+
+
+def multislice_mesh(
+    num_slices: int,
+    axes: dict[str, int] | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Mesh for a MEGASCALE multislice job: ``dcn`` (cross-slice, outermost)
+    x the per-slice axes.
+
+    The per-slice ``axes`` (default all-dp) describe ONE slice; the device
+    count must be num_slices x their product. On real multislice hardware
+    jax.devices() orders devices slice-major (slice id is part of the device
+    coords), so the outermost-dcn reshape puts each slice's devices in one
+    dcn row and every non-dcn collective stays on ICI; gradient all-reduce
+    over dcn is the only DCN traffic — the operator's MEGASCALE env
+    (controller/cluster_spec.py gen_tpu_env) is what wires the slices'
+    runtimes together underneath.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % num_slices:
+        raise ValueError(f"{len(devices)} devices not divisible into {num_slices} slices")
+    per_slice = len(devices) // num_slices
+    axes = dict(axes or {"dp": per_slice})
+    if math.prod(axes.values()) != per_slice:
+        raise ValueError(f"per-slice axes {axes} need {per_slice} devices/slice")
+    return create_mesh({"dcn": num_slices, **axes}, devices)
 
 
 def host_local_batch_size(global_batch: int, mesh: Mesh, axis: str = "dp") -> int:

@@ -188,13 +188,17 @@ class LocalProcessExecutor:
         env = dict(os.environ)
         # Children must resolve the framework package regardless of the
         # parent's cwd (pytest may run from anywhere; stderr is DEVNULL'd so
-        # an import failure would be invisible).
+        # an import failure would be invisible). The parent's own PYTHONPATH
+        # is deliberately NOT inherited: these processes stand in for
+        # containers, which see only their image + injected env (reference
+        # replicas.go:202-234), and harness-environment site hooks (e.g. a
+        # TPU-plugin sitecustomize on the operator's path) must not boot a
+        # TPU runtime inside every fake workload — with the slice env
+        # injected below, that hangs the child before it can serve.
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
-        env["PYTHONPATH"] = repo_root + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
-        )
+        env["PYTHONPATH"] = repo_root
         env["PORT"] = str(port)
         for item in container.get("env", []):
             if "value" in item:

@@ -130,13 +130,10 @@ class Histogram(_Family):
             series["sum"] += value
             series["n"] += 1
 
-    def quantile(self, q: float, **labels: str) -> float:
-        """Upper bucket bound holding the q-th observation (conservative).
-
-        With labels: that series only; without: all series merged. Returns
-        0.0 with no observations, +inf when the quantile lands in the
-        overflow bucket.
-        """
+    def snapshot(self, **labels: str) -> list[int]:
+        """Merged per-bucket counts now — pass to quantile(since=...) to
+        measure only observations made after this point (the registry is
+        process-global, so long-lived tests must window their reads)."""
         with self._lock:
             if labels:
                 series = [self._series.get(self._key(labels))]
@@ -147,6 +144,20 @@ class Histogram(_Family):
             for s in series:
                 for i, c in enumerate(s["counts"]):
                     counts[i] += c
+        return counts
+
+    def quantile(self, q: float, since: list[int] | None = None,
+                 **labels: str) -> float:
+        """Upper bucket bound holding the q-th observation (conservative).
+
+        With labels: that series only; without: all series merged. ``since``
+        (a snapshot() result) subtracts earlier observations. Returns 0.0
+        with no observations, +inf when the quantile lands in the overflow
+        bucket.
+        """
+        counts = self.snapshot(**labels)
+        if since is not None:
+            counts = [max(0, c - s) for c, s in zip(counts, since)]
         total = sum(counts)
         if not total:
             return 0.0
