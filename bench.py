@@ -145,7 +145,9 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
     from tf_operator_tpu.train.steps import TrainState, adamw, fuse_steps, make_lm_train_step
     from tf_operator_tpu.parallel.mesh import create_mesh
 
-    mesh = create_mesh({"dp": 1})
+    # Single-chip metric: pin the mesh to one device (create_mesh over all
+    # visible devices would raise on a multi-chip host).
+    mesh = create_mesh({"dp": 1}, jax.devices()[:1])
     cfg = TransformerConfig(dtype=jnp.bfloat16, mesh=mesh, **LM_SIZE)
     model = Transformer(cfg)
     B, S = LM_BATCH, LM_SEQ

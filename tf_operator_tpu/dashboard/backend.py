@@ -154,6 +154,14 @@ class DashboardBackend:
                 self._send_json(req, {"logs": text})
             return True
 
+        if head == "accelerators" and method == "GET":
+            # The slice-picker catalog: offerable accelerator shapes with
+            # default topology + host counts (topology/slices.catalog).
+            from tf_operator_tpu.topology import slices as topo_slices
+
+            self._send_json(req, {"items": topo_slices.catalog()})
+            return True
+
         if head == "namespace" and method == "GET":
             names = sorted(
                 {objects.name_of(n) for n in self._client.list(objects.NAMESPACES)}

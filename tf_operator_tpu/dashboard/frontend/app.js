@@ -181,48 +181,218 @@ async function showLogs(ns, podName) {
   }
 }
 
-function createView() {
+// ---------- structured create form (parity: CreateJob.jsx /
+// CreateReplicaSpec.jsx / EnvVarCreator.jsx / VolumeCreator.jsx — TPU-native
+// twist: the accelerator picker is backed by the server's slice catalog) ----
+
+const REPLICA_TYPES = ["Worker", "Chief", "PS", "Evaluator"];
+const RESTART_POLICIES = ["Never", "OnFailure", "Always", "ExitCode"];
+let acceleratorCatalog = []; // fetched once per create view
+
+function kvRows(title, fields) {
+  // Dynamic add/remove rows of small inputs (env vars, volumes).
+  const body = h("div", { class: "kv-rows" });
+  const addRow = (values = {}) => {
+    const inputs = fields.map((f) =>
+      h("input", {
+        class: "kv",
+        "data-field": f.name,
+        placeholder: f.placeholder,
+        value: values[f.name] || "",
+      })
+    );
+    const row = h(
+      "div",
+      { class: "kv-row" },
+      ...inputs,
+      h("button", { type: "button", class: "ghost", onclick: () => row.remove() }, "×")
+    );
+    body.append(row);
+  };
+  const header = h(
+    "div",
+    { class: "kv-header" },
+    h("span", {}, title),
+    h("button", { type: "button", class: "ghost", onclick: () => addRow() }, "+ add")
+  );
+  const read = () =>
+    [...body.querySelectorAll(".kv-row")]
+      .map((row) => {
+        const out = {};
+        for (const inp of row.querySelectorAll("input.kv")) out[inp.dataset.field] = inp.value.trim();
+        return out;
+      })
+      .filter((r) => Object.values(r).some((v) => v));
+  return { el: h("div", { class: "kv-group" }, header, body), read, addRow };
+}
+
+function replicaSpecCard(onRemove) {
+  const typeSel = h("select", { "data-k": "type" }, ...REPLICA_TYPES.map((t) => h("option", { value: t }, t)));
+  const replicas = h("input", { "data-k": "replicas", type: "number", value: "2", min: "1" });
+  const image = h("input", { "data-k": "image", value: "tpu-operator/test-server" });
+  const command = h("textarea", { "data-k": "command", placeholder: '["python", "train.py"] (JSON array, optional)' });
+  const restart = h("select", { "data-k": "restart" }, ...RESTART_POLICIES.map((p) => h("option", { value: p }, p)));
+
+  // TPU slice picker: accelerator dropdown from the server catalog; the
+  // topology/hosts readout updates live, numSlices enables DCN multislice.
+  const accSel = h(
+    "select",
+    { "data-k": "accelerator" },
+    h("option", { value: "" }, "none (CPU / plain replicas)"),
+    ...acceleratorCatalog.map((a) =>
+      h(
+        "option",
+        { value: a.acceleratorType, "data-topology": a.topology, "data-hosts": a.numHosts },
+        `${a.acceleratorType} — ${a.topology}, ${a.numHosts} host${a.numHosts > 1 ? "s" : ""}`
+      )
+    )
+  );
+  const numSlices = h("input", { "data-k": "numSlices", type: "number", value: "1", min: "1" });
+  const sliceInfo = h("span", { class: "muted" }, "");
+  const syncSlice = () => {
+    const opt = accSel.selectedOptions[0];
+    const on = Boolean(accSel.value);
+    replicas.disabled = on; // a slice binding determines the pod count
+    numSlices.disabled = !on;
+    sliceInfo.textContent = on
+      ? `${opt.dataset.topology} topology · ${opt.dataset.hosts} pod(s)/slice × ${numSlices.value || 1} slice(s)`
+      : "";
+  };
+  accSel.addEventListener("change", syncSlice);
+  numSlices.addEventListener("input", syncSlice);
+  syncSlice(); // initial state: numSlices disabled until a slice is chosen
+
+  const envRows = kvRows("Environment variables", [
+    { name: "name", placeholder: "NAME" },
+    { name: "value", placeholder: "value" },
+  ]);
+  const volRows = kvRows("Volumes (hostPath)", [
+    { name: "name", placeholder: "volume name" },
+    { name: "hostPath", placeholder: "/host/path" },
+    { name: "mountPath", placeholder: "/mount/path" },
+  ]);
+
+  const card = h(
+    "div",
+    { class: "card replica-spec" },
+    h(
+      "div",
+      { class: "toolbar" },
+      h("h2", {}, "Replica set"),
+      h("button", { type: "button", class: "ghost", onclick: () => onRemove(card) }, "remove")
+    ),
+    h("label", {}, "Role"), typeSel,
+    h("label", {}, "Replicas (ignored when a TPU slice is bound)"), replicas,
+    h("label", {}, "TPU slice"), accSel,
+    h("label", {}, "Slices (numSlices > 1 = DCN multislice)"), numSlices, sliceInfo,
+    h("label", {}, "Restart policy"), restart,
+    h("label", {}, "Image"), image,
+    h("label", {}, "Command"), command,
+    envRows.el,
+    volRows.el
+  );
+
+  card.readSpec = () => {
+    const container = { name: "tensorflow", image: image.value.trim() };
+    const cmd = command.value.trim();
+    if (cmd) container.command = JSON.parse(cmd);
+    const env = envRows.read().map((r) => ({ name: r.name, value: r.value }));
+    if (env.length) container.env = env;
+    const vols = volRows.read();
+    if (vols.length) {
+      container.volumeMounts = vols.map((v) => ({ name: v.name, mountPath: v.mountPath }));
+    }
+    const template = { spec: { containers: [container] } };
+    if (vols.length) {
+      template.spec.volumes = vols.map((v) => ({ name: v.name, hostPath: { path: v.hostPath } }));
+    }
+    const spec = { template, restartPolicy: restart.value };
+    if (accSel.value) {
+      const opt = accSel.selectedOptions[0];
+      spec.tpu = { acceleratorType: accSel.value, topology: opt.dataset.topology };
+      const n = parseInt(numSlices.value, 10) || 1;
+      if (n > 1) spec.tpu.numSlices = n;
+    } else {
+      spec.replicas = parseInt(replicas.value, 10) || 1;
+    }
+    return [typeSel.value, spec];
+  };
+  return card;
+}
+
+async function createView() {
+  try {
+    acceleratorCatalog = (await api("/accelerators")).items || [];
+  } catch (e) {
+    acceleratorCatalog = [];
+  }
+  const errBox = h("div", { id: "create-error", class: "error hidden" });
+  const specsHost = h("div", { id: "replica-specs" });
+  const removeCard = (card) => {
+    if (specsHost.children.length > 1) card.remove();
+  };
+  specsHost.append(replicaSpecCard(removeCard));
+
+  const name = h("input", { name: "name", required: "", placeholder: "my-train-job" });
+  const namespace = h("input", { name: "namespace", value: "default" });
+  const cleanPolicy = h(
+    "select",
+    {},
+    ...["Running", "All", "None"].map((p) => h("option", { value: p }, p))
+  );
+  const ttl = h("input", { type: "number", placeholder: "seconds (optional)", min: "0" });
+  const gang = h("input", { type: "checkbox" });
+  const scheduler = h("input", { placeholder: "scheduler name (optional)" });
+
   const form = h(
     "form",
     {},
-    h("label", {}, "Name"),
-    h("input", { name: "name", required: "", placeholder: "my-train-job" }),
-    h("label", {}, "Namespace"),
-    h("input", { name: "namespace", value: "default" }),
-    h("label", {}, "Worker replicas"),
-    h("input", { name: "workers", type: "number", value: "2", min: "1" }),
-    h("label", {}, "PS replicas (0 for none)"),
-    h("input", { name: "ps", type: "number", value: "0", min: "0" }),
-    h("label", {}, "TPU accelerator (optional, e.g. v5e-16 — overrides worker count)"),
-    h("input", { name: "accelerator", placeholder: "" }),
-    h("label", {}, "Image"),
-    h("input", { name: "image", value: "tpu-operator/test-server" }),
-    h("label", {}, "Command (JSON array, optional)"),
-    h("textarea", { name: "command", placeholder: '["python", "train.py"]' }),
+    h("label", {}, "Name"), name,
+    h("label", {}, "Namespace"), namespace,
+    specsHost,
+    h(
+      "button",
+      { type: "button", class: "ghost", onclick: () => specsHost.append(replicaSpecCard(removeCard)) },
+      "+ add replica set"
+    ),
+    h("div", { class: "card" },
+      h("h2", {}, "Job policies"),
+      h("label", {}, "Clean pod policy"), cleanPolicy,
+      h("label", {}, "TTL after finished"), ttl,
+      h("label", {}, h("span", {}, "Gang scheduling "), gang),
+      h("label", {}, "Scheduler"), scheduler
+    ),
+    errBox,
     h("div", { style: "margin-top:1rem" }, h("button", { type: "submit" }, "Deploy"))
   );
+
   form.addEventListener("submit", async (ev) => {
     ev.preventDefault();
-    const f = new FormData(form);
-    const container = { name: "tensorflow", image: f.get("image") };
-    const cmd = (f.get("command") || "").trim();
-    if (cmd) container.command = JSON.parse(cmd);
-    const worker = { template: { spec: { containers: [container] } } };
-    if (f.get("accelerator")) worker.tpu = { acceleratorType: f.get("accelerator") };
-    else worker.replicas = parseInt(f.get("workers"), 10);
-    const replicaSpecs = { Worker: worker };
-    const ps = parseInt(f.get("ps"), 10);
-    if (ps > 0)
-      replicaSpecs.PS = {
-        replicas: ps,
-        template: { spec: { containers: [{ ...container }] } },
+    errBox.classList.add("hidden");
+    let job;
+    try {
+      const replicaSpecs = {};
+      for (const card of specsHost.querySelectorAll(".replica-spec")) {
+        const [type, spec] = card.readSpec();
+        if (replicaSpecs[type]) throw new Error(`duplicate replica role ${type}`);
+        replicaSpecs[type] = spec;
+      }
+      job = {
+        apiVersion: "tpuflow.org/v1",
+        kind: "TPUJob",
+        metadata: { name: name.value.trim(), namespace: namespace.value.trim() || "default" },
+        spec: { replicaSpecs, cleanPodPolicy: cleanPolicy.value },
       };
-    const job = {
-      apiVersion: "tpuflow.org/v1",
-      kind: "TPUJob",
-      metadata: { name: f.get("name"), namespace: f.get("namespace") || "default" },
-      spec: { replicaSpecs },
-    };
+      if (ttl.value) job.spec.ttlSecondsAfterFinished = parseInt(ttl.value, 10);
+      if (gang.checked || scheduler.value.trim()) {
+        job.spec.scheduling = { gang: gang.checked };
+        if (scheduler.value.trim()) job.spec.scheduling.schedulerName = scheduler.value.trim();
+      }
+    } catch (e) {
+      errBox.textContent = "Invalid form: " + e.message;
+      errBox.classList.remove("hidden");
+      return;
+    }
     try {
       await api("/tpujob", {
         method: "POST",
@@ -231,7 +401,9 @@ function createView() {
       });
       location.hash = `#/job/${job.metadata.namespace}/${job.metadata.name}`;
     } catch (e) {
-      alert("Deploy failed: " + e.message);
+      // Server-side validation (422 Invalid) surfaces here verbatim.
+      errBox.textContent = "Deploy rejected: " + e.message;
+      errBox.classList.remove("hidden");
     }
   });
   app.replaceChildren(h("div", { class: "card" }, h("h2", {}, "Create TPUJob"), form));
@@ -257,7 +429,7 @@ async function route() {
   try {
     if (parts[0] === "create") {
       if (pollTimer) clearInterval(pollTimer);
-      createView();
+      await createView();
     } else if (parts[0] === "job" && parts.length === 3) {
       await jobDetailView(parts[1], parts[2]);
       setPoll(() => jobDetailView(parts[1], parts[2]).catch(() => {}));

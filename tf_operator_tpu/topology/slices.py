@@ -181,3 +181,35 @@ def resolve(accelerator_type: str, topology: str | None = None) -> SliceTopology
         chips_per_host=chips_per_host,
         dims=dims,
     )
+
+
+def catalog(max_chips: int = 256) -> list[dict]:
+    """Enumerate the offerable slice shapes (for pickers/UIs).
+
+    One entry per (generation, power-of-two chip count up to max_chips and
+    the generation's own limit) with its default topology and host count —
+    the data behind the dashboard's accelerator dropdown (the TPU-native
+    version of the reference's GPU form fields, CreateJob.jsx).
+    """
+    out: list[dict] = []
+    for gen_name in sorted(GENERATIONS):
+        gen = GENERATIONS[gen_name]
+        chips = 1
+        while chips <= min(max_chips, gen.max_chips):
+            if chips >= gen.chips_per_host or chips in (1, 2, 4):
+                try:
+                    topo = resolve(f"{gen_name}-{chips}")
+                except TopologyError:
+                    chips *= 2
+                    continue
+                out.append(
+                    {
+                        "acceleratorType": topo.accelerator_type,
+                        "topology": topo.topology,
+                        "numChips": topo.num_chips,
+                        "numHosts": topo.num_hosts,
+                        "multiHost": topo.multi_host,
+                    }
+                )
+            chips *= 2
+    return out
