@@ -157,6 +157,56 @@ class TestHappyPath:
             desc="owned resources GC",
         )
 
+    def test_ps_worker_cluster_spec_all_replicas(self, stack):
+        """BASELINE configs[2] rung: a 2 PS + 4 Worker job where EVERY
+        replica's injected TF_CONFIG carries the full cluster map and its
+        own (type, index) identity — the between-graph PS/Worker contract
+        (reference controller_tensorflow.go:66-96)."""
+        client, executor = stack
+        container = {
+            "name": constants.DEFAULT_CONTAINER_NAME,
+            "image": "local",
+            "command": SERVER_CMD,
+        }
+        # Both replica sets must be in the spec BEFORE create: the
+        # controller reconciles on the ADDED event, and pods keep their
+        # baked-in TF_CONFIG (no rebuild on spec change).
+        client.create(
+            objects.TPUJOBS,
+            {
+                "apiVersion": constants.API_VERSION,
+                "kind": constants.KIND,
+                "metadata": {"name": "psjob", "namespace": "default"},
+                "spec": {
+                    "replicaSpecs": {
+                        "Worker": {
+                            "replicas": 4,
+                            "template": {"spec": {"containers": [dict(container)]}},
+                        },
+                        "PS": {
+                            "replicas": 2,
+                            "template": {"spec": {"containers": [dict(container)]}},
+                        },
+                    }
+                },
+            },
+        )
+        # Running requires every replica type fully active — all 6 pods.
+        wait_for(job_condition(client, "psjob", "Running"), desc="psjob Running")
+        for rtype, count in (("worker", 4), ("ps", 2)):
+            for i in range(count):
+                cfg = http_get(executor, f"psjob-{rtype}-{i}", "/tfconfig")
+                assert cfg["task"] == {"type": rtype, "index": i}
+                assert len(cfg["cluster"]["worker"]) == 4
+                assert len(cfg["cluster"]["ps"]) == 2
+                assert cfg["environment"] == "cloud"
+        # workers terminate cleanly; PS roles are long-running by design and
+        # the job must succeed on worker completion (no chief present).
+        for i in range(4):
+            http_get(executor, f"psjob-worker-{i}", "/exit?exitCode=0")
+        wait_for(job_condition(client, "psjob", "Succeeded"),
+                 desc="psjob Succeeded")
+
     def test_worker0_identity_and_topology_echo(self, stack):
         client, executor = stack
         submit_job(client, "ident", workers=2)
