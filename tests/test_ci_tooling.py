@@ -63,6 +63,35 @@ def test_release_build_manifest_and_tarball(tmp_path):
     assert manifest2["content_digest"] == manifest["content_digest"]
 
 
+def test_release_image_context_is_runnable(tmp_path):
+    """--image-context stages Dockerfile + flattened sources such that the
+    image ENTRYPOINT module resolves from the staged context (parity:
+    build/images/tf_operator/Dockerfile builds operators+dashboard into one
+    image)."""
+    import subprocess
+    import sys
+
+    from tf_operator_tpu.release.build import build_image_context
+
+    out = str(tmp_path / "dist")
+    manifest = build_release(REPO_ROOT, out)
+    image_dir = build_image_context(REPO_ROOT, out, manifest)
+
+    dockerfile = open(os.path.join(image_dir, "Dockerfile")).read()
+    assert 'ENTRYPOINT ["python", "-m", "tf_operator_tpu.cli.operator"]' in dockerfile
+    ctx = os.path.join(image_dir, "context")
+    # COPY paths in the Dockerfile must exist in the staged context.
+    for rel in ("tf_operator_tpu", "examples", "bench.py", "README.md"):
+        assert os.path.exists(os.path.join(ctx, rel)), rel
+    # The entrypoint actually runs from the context alone (no repo on path).
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.cli.operator", "--version"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "PYTHONPATH": ctx}, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0 and "tpu-job-operator" in proc.stdout
+
+
 # ---------------------------------------------------------------------------
 # checks
 # ---------------------------------------------------------------------------

@@ -18,6 +18,7 @@ import hashlib
 import io
 import json
 import os
+import shutil
 import tarfile
 import time
 from typing import Any
@@ -99,14 +100,56 @@ def build_release(repo_root: str, out_dir: str,
     return manifest
 
 
+def build_image_context(repo_root: str, out_dir: str,
+                        manifest: dict[str, Any]) -> str:
+    """Assemble a docker build dir: Dockerfile + context/ from the tarball.
+
+    Parity: py/build_and_push_image.py stages sources next to the
+    Dockerfile before `docker build`. The image tag to use is
+    "tpu-operator:{git_sha}" (manifest["git_sha"]); building/pushing is
+    left to the CI host's docker daemon.
+    """
+    image_dir = os.path.join(out_dir, "image")
+    # Fresh staging dir every build: a re-run must not fail on the previous
+    # context nor let files deleted from the repo survive into the image.
+    shutil.rmtree(image_dir, ignore_errors=True)
+    ctx = os.path.join(image_dir, "context")
+    os.makedirs(ctx)
+    tar_path = os.path.join(out_dir, manifest["artifact"])
+    with tarfile.open(tar_path, "r:gz") as tar:
+        tar.extractall(ctx, filter="data")
+    # The tarball nests everything under {name}/ — flatten one level so the
+    # Dockerfile's COPY context/... paths are stable across versions.
+    nested = os.path.join(ctx, manifest["name"])
+    for entry in os.listdir(nested):
+        os.replace(os.path.join(nested, entry), os.path.join(ctx, entry))
+    os.rmdir(nested)
+    shutil.copyfile(
+        os.path.join(repo_root, "build", "Dockerfile"),
+        os.path.join(image_dir, "Dockerfile"),
+    )
+    return image_dir
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--repo-root", default=os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
     p.add_argument("--out", default="dist")
     p.add_argument("--version", default=None)
+    p.add_argument("--image-context", action="store_true",
+                   help="also stage a docker build dir (Dockerfile + context)")
     args = p.parse_args(argv)
     manifest = build_release(args.repo_root, args.out, version=args.version)
+    if args.image_context:
+        manifest["image_dir"] = build_image_context(
+            args.repo_root, args.out, manifest
+        )
+        manifest["image_tag"] = f"tpu-operator:{manifest['git_sha'][:12]}"
+        # Re-write manifest.json so the on-disk manifest (what deploy
+        # tooling consumes) carries the image fields, not just stdout.
+        with open(os.path.join(args.out, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
     print(json.dumps(manifest, indent=2, sort_keys=True))
     return 0
 
