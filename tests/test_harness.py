@@ -16,6 +16,78 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # junit (py/test_util.py parity)
 # ---------------------------------------------------------------------------
 
+def test_kubectl_deploy_command_sequence():
+    """kube-up/down parity (reference py/deploy.py:180): CRD before the
+    operator on apply, reverse on delete, namespace ensured, image pinned —
+    recorded via an injected runner, no cluster needed."""
+    from tf_operator_tpu.harness.deploy import kubectl_deploy
+
+    calls = []
+
+    class _OK:
+        returncode = 0
+
+    runner = lambda cmd, **kw: (calls.append((cmd, kw)), _OK())[1]  # noqa: E731
+
+    ran = kubectl_deploy(
+        "apply", kubeconfig="/tmp/kc", namespace="ns1",
+        image="tpu-operator:abc123", runner=runner,
+    )
+    flat = [" ".join(c) for c in ran]
+    # order: namespace (stdin) -> CRD (cluster-scoped, no -n) -> operator
+    # (templated over stdin) -> image pin
+    assert flat[0] == "kubectl --kubeconfig /tmp/kc apply -f -"
+    assert b"kind: Namespace" in calls[0][1]["input"]
+    assert flat[1].endswith("apply -f " + os.path.join(REPO_ROOT, "deploy", "crd.yaml"))
+    assert flat[2] == "kubectl --kubeconfig /tmp/kc apply -f -"
+    operator_doc = calls[2][1]["input"].decode()
+    assert "kind: Deployment" in operator_doc
+    # every pinned namespace re-targeted to the requested one
+    assert "namespace: default" not in operator_doc
+    assert operator_doc.count("namespace: ns1") >= 3
+    assert flat[3].endswith(
+        "set image deployment/tpu-operator tpu-operator=tpu-operator:abc123"
+    )
+
+    calls.clear()
+    ran = kubectl_deploy("delete", namespace="ns1", runner=runner)
+    flat = [" ".join(c) for c in ran]
+    # reverse order: operator (stdin) before CRD; both tolerant of absence
+    assert flat[0].startswith("kubectl delete -f -")
+    assert b"kind: Deployment" in calls[0][1]["input"]
+    assert "crd.yaml" in flat[1]
+    assert all("--ignore-not-found" in f for f in flat)
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        kubectl_deploy("upsert", runner=runner)
+
+    class _Fail:
+        returncode = 1
+
+    with _pytest.raises(RuntimeError):
+        kubectl_deploy("apply", runner=lambda cmd, **kw: _Fail())
+
+
+def test_deploy_manifests_parse():
+    """The manifests kube-up applies must be valid YAML docs with the
+    objects the deploy sequence assumes (CRD, Deployment named
+    tpu-operator)."""
+    import yaml
+
+    deploy_dir = os.path.join(REPO_ROOT, "deploy")
+    crd_docs = list(yaml.safe_load_all(open(os.path.join(deploy_dir, "crd.yaml"))))
+    op_docs = list(yaml.safe_load_all(open(os.path.join(deploy_dir, "operator.yaml"))))
+    kinds = [d["kind"] for d in crd_docs + op_docs if d]
+    assert "CustomResourceDefinition" in kinds
+    assert "Deployment" in kinds
+    dep = next(d for d in op_docs if d and d["kind"] == "Deployment")
+    assert dep["metadata"]["name"] == "tpu-operator"
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["name"] == "tpu-operator"
+
+
 def test_junit_xml_roundtrip(tmp_path):
     ok = junit.TestCase(name="good")
     junit.wrap_test(lambda: None, ok)

@@ -121,6 +121,74 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def kubectl_deploy(
+    action: str,
+    *,
+    kubeconfig: str | None = None,
+    context: str | None = None,
+    namespace: str = "tpu-operator-system",
+    image: str | None = None,
+    runner=subprocess.run,
+) -> list[list[str]]:
+    """Apply/delete the CRD + operator manifests on a real cluster.
+
+    Parity: py/deploy.py:180 (ksonnet apply of the operator onto GKE) —
+    here plain `kubectl apply -f` of deploy/crd.yaml + deploy/operator.yaml,
+    with the Deployment's image pinned to the release tag (manifest.json
+    "image_tag"). Returns the kubectl argvs it ran; ``runner`` is
+    injectable so tests can record instead of execute.
+    """
+    if action not in ("apply", "delete"):
+        raise ValueError(f"action must be apply|delete, not {action!r}")
+    base = ["kubectl"]
+    if kubeconfig:
+        base += ["--kubeconfig", kubeconfig]
+    if context:
+        base += ["--context", context]
+    deploy_dir = os.path.join(REPO_ROOT, "deploy")
+    crd = os.path.join(deploy_dir, "crd.yaml")
+    ran: list[list[str]] = []
+
+    def run(cmd: list[str], **kw: Any) -> None:
+        ran.append(cmd)
+        result = runner(cmd, **kw)
+        rc = getattr(result, "returncode", 0)
+        if rc not in (0, None):
+            raise RuntimeError(f"{' '.join(cmd)} failed with rc={rc}")
+
+    # operator.yaml pins its objects' namespaces in-document (the
+    # ClusterRoleBinding subject needs one regardless), so a custom
+    # namespace means templating the doc and shipping it over stdin —
+    # never `-f file -n ns`, which kubectl rejects on the mismatch.
+    operator_doc = _render_operator_manifest(namespace).encode()
+    ignore = ["--ignore-not-found"] if action == "delete" else []
+
+    if action == "apply":
+        # Namespace first (idempotent), CRD before the operator watches it.
+        run(base + ["apply", "-f", "-"], input=_namespace_yaml(namespace).encode())
+        run(base + ["apply", "-f", crd])
+        run(base + ["apply", "-f", "-"], input=operator_doc)
+        if image:
+            run(base + ["-n", namespace, "set", "image",
+                        "deployment/tpu-operator", f"tpu-operator={image}"])
+    else:
+        # Reverse order: stop the operator before removing its CRD.
+        run(base + ["delete", "-f", "-"] + ignore, input=operator_doc)
+        run(base + ["delete", "-f", crd] + ignore)
+    return ran
+
+
+def _namespace_yaml(namespace: str) -> str:
+    return f"apiVersion: v1\nkind: Namespace\nmetadata:\n  name: {namespace}\n"
+
+
+def _render_operator_manifest(namespace: str) -> str:
+    """deploy/operator.yaml with every pinned namespace re-targeted."""
+    with open(os.path.join(REPO_ROOT, "deploy", "operator.yaml")) as f:
+        doc = f.read()
+    return doc.replace("namespace: default", f"namespace: {namespace}")
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -131,7 +199,29 @@ def main(argv: list[str] | None = None) -> int:
     up.add_argument("--dashboard", action="store_true")
     down = sub.add_parser("down")
     down.add_argument("--pid-file", required=True)
+    for name in ("kube-up", "kube-down"):
+        k = sub.add_parser(name, help="apply/delete CRD + operator on a cluster")
+        k.add_argument("--kubeconfig", default=None)
+        k.add_argument("--kube-context", default=None)
+        k.add_argument("--namespace", default="tpu-operator-system")
+        k.add_argument("--image", default=None,
+                       help="operator image tag (manifest.json image_tag)")
+        k.add_argument("--echo", action="store_true",
+                       help="print kubectl commands instead of running them")
     args = p.parse_args(argv)
+
+    if args.cmd in ("kube-up", "kube-down"):
+        runner: Any = subprocess.run
+        if args.echo:
+            class _Echo:
+                returncode = 0
+            runner = lambda cmd, **kw: (print(" ".join(cmd)), _Echo())[1]  # noqa: E731
+        kubectl_deploy(
+            "apply" if args.cmd == "kube-up" else "delete",
+            kubeconfig=args.kubeconfig, context=args.kube_context,
+            namespace=args.namespace, image=args.image, runner=runner,
+        )
+        return 0
 
     if args.cmd == "up":
         dep = OperatorDeployment(

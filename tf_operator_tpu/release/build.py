@@ -145,7 +145,9 @@ def main(argv: list[str] | None = None) -> int:
         manifest["image_dir"] = build_image_context(
             args.repo_root, args.out, manifest
         )
-        manifest["image_tag"] = f"tpu-operator:{manifest['git_sha'][:12]}"
+        # Full sha: must match the documented `docker build -t` recipe
+        # exactly, or the deploy-time image pin points at a never-built tag.
+        manifest["image_tag"] = f"tpu-operator:{manifest['git_sha']}"
         # Re-write manifest.json so the on-disk manifest (what deploy
         # tooling consumes) carries the image fields, not just stdout.
         with open(os.path.join(args.out, "manifest.json"), "w") as f:
