@@ -35,7 +35,7 @@ def test_kubectl_deploy_command_sequence():
     )
     flat = [" ".join(c) for c in ran]
     # order: namespace (stdin) -> CRD (cluster-scoped, no -n) -> operator
-    # (templated over stdin) -> image pin
+    # (namespace + image templated, over stdin)
     assert flat[0] == "kubectl --kubeconfig /tmp/kc apply -f -"
     assert b"kind: Namespace" in calls[0][1]["input"]
     assert flat[1].endswith("apply -f " + os.path.join(REPO_ROOT, "deploy", "crd.yaml"))
@@ -45,9 +45,10 @@ def test_kubectl_deploy_command_sequence():
     # every pinned namespace re-targeted to the requested one
     assert "namespace: default" not in operator_doc
     assert operator_doc.count("namespace: ns1") >= 3
-    assert flat[3].endswith(
-        "set image deployment/tpu-operator tpu-operator=tpu-operator:abc123"
-    )
+    # image templated in-document; no placeholder, no separate set-image
+    assert "image: tpu-operator:abc123" in operator_doc
+    assert "tpu-operator:latest" not in operator_doc
+    assert len(ran) == 3
 
     calls.clear()
     ran = kubectl_deploy("delete", namespace="ns1", runner=runner)

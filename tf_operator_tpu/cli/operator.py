@@ -63,8 +63,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--master", default=None,
                    help="URL of a remote runtime API server; default: in-process store")
     p.add_argument("--serve", type=int, default=None, metavar="PORT",
-                   help="expose the in-process store over HTTP on PORT")
+                   help="serve the HTTP API on PORT: the in-process store "
+                        "(default backend), or an aggregating proxy + "
+                        "dashboard + /metrics over --backend kube")
     p.add_argument("--serve-host", default="127.0.0.1")
+    p.add_argument("--serve-token-file", default=None, metavar="PATH",
+                   help="bearer token required on every mutating HTTP "
+                        "request (reads stay open); strongly recommended "
+                        "with --backend kube + --serve")
     p.add_argument("--local-executor", action="store_true",
                    help="run pods as local OS processes (single-node mode)")
     # Leader election (server.go:140-152).
@@ -95,9 +101,6 @@ def main(argv: list[str] | None = None) -> int:
     if args.backend == "kube":
         if args.master:
             log.error("--backend kube and --master are mutually exclusive")
-            return 2
-        if args.serve is not None:
-            log.error("--serve requires the in-process store (drop --backend kube)")
             return 2
         if args.local_executor:
             # Real kubelets run the pods on a real cluster; a local executor
@@ -134,7 +137,27 @@ def main(argv: list[str] | None = None) -> int:
             return 2
         from tf_operator_tpu.runtime.apiserver import ApiServer
 
-        api_server = ApiServer(client, host=args.serve_host, port=args.serve)
+        write_token = None
+        if args.serve_token_file:
+            with open(args.serve_token_file) as f:
+                write_token = f.read().strip()
+            if not write_token:
+                log.error("--serve-token-file %s is empty", args.serve_token_file)
+                return 2
+        elif args.backend == "kube":
+            log.warning(
+                "serving an UNAUTHENTICATED write API over the kube backend:"
+                " anyone reaching %s:%s can create jobs the operator runs"
+                " with its own privileges — set --serve-token-file (or a"
+                " NetworkPolicy)", args.serve_host, args.serve,
+            )
+        # Over the in-memory store this IS the cluster API; over the kube
+        # backend it is an aggregating proxy (REST + dashboard + /metrics
+        # riding KubeClusterClient) — the in-cluster observability surface.
+        api_server = ApiServer(
+            client, host=args.serve_host, port=args.serve,
+            write_token=write_token,
+        )
         # Observability mounts BEFORE the dashboard: handlers run in
         # registration order and the dashboard's SPA fallback swallows any
         # unmatched GET, which would shadow /metrics with index.html.

@@ -12,6 +12,13 @@ let pollTimer = null;
 
 // ---------- api ----------
 async function api(path, opts) {
+  // Optional write auth (operator --serve-token-file): stash the token with
+  // localStorage.setItem("tpuOperatorToken", "<token>") in the console.
+  const token = localStorage.getItem("tpuOperatorToken");
+  if (token) {
+    opts = opts || {};
+    opts.headers = { ...(opts.headers || {}), Authorization: "Bearer " + token };
+  }
   const resp = await fetch("/tpujobs/api" + path, opts);
   const body = await resp.json().catch(() => ({}));
   if (!resp.ok) throw new Error(body.message || resp.statusText);

@@ -158,9 +158,11 @@ def kubectl_deploy(
 
     # operator.yaml pins its objects' namespaces in-document (the
     # ClusterRoleBinding subject needs one regardless), so a custom
-    # namespace means templating the doc and shipping it over stdin —
-    # never `-f file -n ns`, which kubectl rejects on the mismatch.
-    operator_doc = _render_operator_manifest(namespace).encode()
+    # namespace — and the image tag — are templated into the doc and
+    # shipped over stdin: never `-f file -n ns` (kubectl rejects the
+    # namespace mismatch), and never apply-then-`set image` (the apply
+    # would transiently roll the Deployment back to the placeholder tag).
+    operator_doc = _render_operator_manifest(namespace, image).encode()
     ignore = ["--ignore-not-found"] if action == "delete" else []
 
     if action == "apply":
@@ -168,9 +170,6 @@ def kubectl_deploy(
         run(base + ["apply", "-f", "-"], input=_namespace_yaml(namespace).encode())
         run(base + ["apply", "-f", crd])
         run(base + ["apply", "-f", "-"], input=operator_doc)
-        if image:
-            run(base + ["-n", namespace, "set", "image",
-                        "deployment/tpu-operator", f"tpu-operator={image}"])
     else:
         # Reverse order: stop the operator before removing its CRD.
         run(base + ["delete", "-f", "-"] + ignore, input=operator_doc)
@@ -182,11 +181,16 @@ def _namespace_yaml(namespace: str) -> str:
     return f"apiVersion: v1\nkind: Namespace\nmetadata:\n  name: {namespace}\n"
 
 
-def _render_operator_manifest(namespace: str) -> str:
-    """deploy/operator.yaml with every pinned namespace re-targeted."""
+def _render_operator_manifest(namespace: str, image: str | None = None) -> str:
+    """deploy/operator.yaml with pinned namespaces re-targeted and the
+    placeholder image replaced by the release tag (manifest.json
+    image_tag) when given."""
     with open(os.path.join(REPO_ROOT, "deploy", "operator.yaml")) as f:
         doc = f.read()
-    return doc.replace("namespace: default", f"namespace: {namespace}")
+    doc = doc.replace("namespace: default", f"namespace: {namespace}")
+    if image:
+        doc = doc.replace("image: tpu-operator:latest", f"image: {image}")
+    return doc
 
 
 def main(argv: list[str] | None = None) -> int:

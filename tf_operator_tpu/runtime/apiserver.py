@@ -88,6 +88,26 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         code = getattr(e, "code", 500)
         self._send_json({"error": type(e).__name__, "message": str(e)}, code=code)
 
+    def _write_authorized(self) -> bool:
+        """Bearer-token gate on every mutating method (API and dashboard
+        routes alike). Reads stay open — the exposure that matters is an
+        unauthenticated caller creating jobs that the operator materializes
+        into pods with its own privileges."""
+        token = self.server.write_token
+        if not token:
+            return True
+        import hmac
+
+        got = self.headers.get("Authorization", "")
+        if hmac.compare_digest(got, f"Bearer {token}"):
+            return True
+        self._send_json(
+            {"error": "Unauthorized",
+             "message": "mutating requests require the bearer token"},
+            401,
+        )
+        return False
+
     def _route(self) -> tuple[str | None, list[str], dict[str, list[str]]]:
         url = urlparse(self.path)
         parts = [unquote(p) for p in url.path.strip("/").split("/") if p]
@@ -129,6 +149,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "BadRequest", "message": str(e)}, 400)
 
     def do_POST(self) -> None:  # noqa: N802
+        if not self._write_authorized():
+            return
         root, parts, _ = self._route()
         if root is None:
             if not self.server.handle_extra(self):
@@ -147,6 +169,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "BadRequest", "message": str(e)}, 400)
 
     def do_PUT(self) -> None:  # noqa: N802
+        if not self._write_authorized():
+            return
         root, parts, _ = self._route()
         try:
             if root is not None and len(parts) == 3:
@@ -166,6 +190,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "BadRequest", "message": str(e)}, 400)
 
     def do_PATCH(self) -> None:  # noqa: N802
+        if not self._write_authorized():
+            return
         root, parts, _ = self._route()
         if root is None or len(parts) != 3:
             self._send_json({"error": "NotFound", "message": self.path}, 404)
@@ -192,6 +218,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json({"error": "BadRequest", "message": str(e)}, 400)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if not self._write_authorized():
+            return
         root, parts, _ = self._route()
         if root is None:
             if not self.server.handle_extra(self):
@@ -246,9 +274,13 @@ class ApiServer(ThreadingHTTPServer):
         host: str = "127.0.0.1",
         port: int = 0,
         validators: dict[str, Validator] | None = None,
+        write_token: str | None = None,
     ):
         super().__init__((host, port), _Handler)
         self.backend = backend
+        # When set, every mutating request (any route) must carry
+        # "Authorization: Bearer <token>"; reads stay open.
+        self.write_token = write_token
         self.stopping = threading.Event()
         # Admission validation at the API boundary (422 Invalid before the
         # store is touched). Pass {} to disable.
