@@ -73,6 +73,16 @@ def test_write_token_gates_mutations_but_not_reads():
         assert req("PUT", "/api/tpujobs/default/authjob", job) == 401
         assert req("DELETE", "/api/tpujobs/default/authjob",
                    token="s3cret") == 200
+        # RestClusterClient threads the token on every call (and reads it
+        # from TPU_OPERATOR_API_TOKEN when not passed), so --master
+        # consumers keep working against a token-gated server.
+        authed = RestClusterClient(base, token="s3cret")
+        created = authed.create(objects.TPUJOBS, tpujob_dict(name="restauth"))
+        assert created["metadata"]["name"] == "restauth"
+        with pytest.raises(Exception):
+            RestClusterClient(base).create(
+                objects.TPUJOBS, tpujob_dict(name="restnoauth")
+            )
     finally:
         server.stop()
 

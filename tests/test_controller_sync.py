@@ -247,6 +247,29 @@ class TestExitCodePolicy:
         ]
         assert JobConditionType.RESTARTING in types
 
+    def test_oomkilled_is_permanent_despite_exit_137(self):
+        """Container-scope OOM must not be retried even though 137 is a
+        retryable code (reference training.go:207-220)."""
+        tc, client = make_controller()
+        job = testutil.new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
+        submit(client, job)
+        [pod] = testutil.seed_pods(
+            client, job, "Worker", 1, objects.FAILED, exit_code=137
+        )
+        objects.set_container_terminated(
+            pod, constants.DEFAULT_CONTAINER_NAME, 137, reason="OOMKilled"
+        )
+        client.update_status(objects.PODS, pod)
+        testutil.seed_pods(client, job, "Worker", 1, objects.RUNNING, start_index=1)
+        sync_once(tc, client, job)
+        fake: FakePodControl = tc.pod_control
+        assert fake.delete_pod_names == []  # no restart attempt
+        stored = client.get(objects.TPUJOBS, "default", job.metadata.name)
+        types = [
+            c["type"] for c in stored["status"]["conditions"] if c["status"] == "True"
+        ]
+        assert JobConditionType.FAILED in types
+
     def test_permanent_exit_fails_job(self):
         tc, client = make_controller()
         job = testutil.new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)

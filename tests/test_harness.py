@@ -34,13 +34,15 @@ def test_kubectl_deploy_command_sequence():
         image="tpu-operator:abc123", runner=runner,
     )
     flat = [" ".join(c) for c in ran]
-    # order: namespace (stdin) -> CRD (cluster-scoped, no -n) -> operator
-    # (namespace + image templated, over stdin)
+    # order: namespace (stdin) -> token-secret probe (exists: rc 0, so no
+    # create) -> CRD (cluster-scoped, no -n) -> operator (namespace + image
+    # templated, over stdin)
     assert flat[0] == "kubectl --kubeconfig /tmp/kc apply -f -"
     assert b"kind: Namespace" in calls[0][1]["input"]
-    assert flat[1].endswith("apply -f " + os.path.join(REPO_ROOT, "deploy", "crd.yaml"))
-    assert flat[2] == "kubectl --kubeconfig /tmp/kc apply -f -"
-    operator_doc = calls[2][1]["input"].decode()
+    assert flat[1].endswith("get secret tpu-operator-api-token")
+    assert flat[2].endswith("apply -f " + os.path.join(REPO_ROOT, "deploy", "crd.yaml"))
+    assert flat[3] == "kubectl --kubeconfig /tmp/kc apply -f -"
+    operator_doc = calls[3][1]["input"].decode()
     assert "kind: Deployment" in operator_doc
     # every pinned namespace re-targeted to the requested one
     assert "namespace: default" not in operator_doc
@@ -48,7 +50,30 @@ def test_kubectl_deploy_command_sequence():
     # image templated in-document; no placeholder, no separate set-image
     assert "image: tpu-operator:abc123" in operator_doc
     assert "tpu-operator:latest" not in operator_doc
-    assert len(ran) == 3
+    assert len(ran) == 4
+
+    # Missing token secret (probe rc 1): a random one is created BEFORE the
+    # operator deploys, and never rotated when it already exists.
+    probe_calls = []
+
+    class _NoSecret:
+        returncode = 1
+
+    def probing_runner(cmd, **kw):
+        probe_calls.append(cmd)
+        if "get" in cmd and "secret" in cmd:
+            return _NoSecret()
+        return _OK()
+
+    ran = kubectl_deploy("apply", namespace="ns1", runner=probing_runner)
+    flat = [" ".join(c) for c in ran]
+    create_idx = next(i for i, f in enumerate(flat) if "create secret generic" in f)
+    operator_idx = len(flat) - 1
+    assert create_idx < operator_idx
+    assert "--from-literal=token=" in flat[create_idx]
+    # random, non-trivial token material
+    token = flat[create_idx].split("token=")[-1]
+    assert len(token) >= 32 and token != "token"
 
     calls.clear()
     ran = kubectl_deploy("delete", namespace="ns1", runner=runner)

@@ -172,7 +172,16 @@ class PodReconciler:
                 code = objects.terminated_exit_code(
                     pod, constants.DEFAULT_CONTAINER_NAME
                 )
-                if code is not None and exit_codes.is_retryable(code):
+                reason = objects.terminated_reason(
+                    pod, constants.DEFAULT_CONTAINER_NAME
+                )
+                # Container-scope OOM is permanent even though its exit code
+                # (137) reads as a retryable signal: the workload's memory
+                # demand will not change on retry (reference
+                # training.go:207-220, OOMKilled-is-permanent).
+                if reason == "OOMKilled":
+                    permanent_indices.add(index)
+                elif code is not None and exit_codes.is_retryable(code):
                     restart_indices.add(index)
                 else:
                     permanent_indices.add(index)

@@ -156,6 +156,12 @@ def kubectl_deploy(
         if rc not in (0, None):
             raise RuntimeError(f"{' '.join(cmd)} failed with rc={rc}")
 
+    def probe(cmd: list[str]) -> bool:
+        """Run without raising; True when the command succeeded."""
+        ran.append(cmd)
+        result = runner(cmd, capture_output=True)
+        return getattr(result, "returncode", 0) in (0, None)
+
     # operator.yaml pins its objects' namespaces in-document (the
     # ClusterRoleBinding subject needs one regardless), so a custom
     # namespace — and the image tag — are templated into the doc and
@@ -168,6 +174,18 @@ def kubectl_deploy(
     if action == "apply":
         # Namespace first (idempotent), CRD before the operator watches it.
         run(base + ["apply", "-f", "-"], input=_namespace_yaml(namespace).encode())
+        # API write-auth token: generated randomly per cluster on first
+        # deploy, NEVER rotated on re-apply (the operator reads it at
+        # startup; silent rotation would strand running clients).
+        if not probe(base + ["-n", namespace, "get", "secret",
+                             "tpu-operator-api-token"]):
+            import secrets as _secrets
+
+            run(
+                base + ["-n", namespace, "create", "secret", "generic",
+                        "tpu-operator-api-token",
+                        f"--from-literal=token={_secrets.token_hex(24)}"],
+            )
         run(base + ["apply", "-f", crd])
         run(base + ["apply", "-f", "-"], input=operator_doc)
     else:

@@ -99,7 +99,9 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         import hmac
 
         got = self.headers.get("Authorization", "")
-        if hmac.compare_digest(got, f"Bearer {token}"):
+        # bytes compare: str compare_digest raises TypeError on non-ASCII
+        # input, which would turn a bad header into a 500 instead of a 401.
+        if hmac.compare_digest(got.encode(), f"Bearer {token}".encode()):
             return True
         self._send_json(
             {"error": "Unauthorized",

@@ -148,18 +148,32 @@ def container_statuses(pod: dict[str, Any]) -> list[dict[str, Any]]:
     return pod.get("status", {}).get("containerStatuses", [])
 
 
+def _terminated_state(
+    pod: dict[str, Any], container_name: str
+) -> dict[str, Any] | None:
+    for cs in container_statuses(pod):
+        if cs.get("name") == container_name:
+            return cs.get("state", {}).get("terminated")
+    return None
+
+
 def terminated_exit_code(pod: dict[str, Any], container_name: str) -> int | None:
     """Exit code of a terminated container, or None if not terminated.
 
     Mirrors how the reference reads pod.Status.ContainerStatuses[i].State
     .Terminated.ExitCode for the default container (controller_pod.go:93-99).
     """
-    for cs in container_statuses(pod):
-        if cs.get("name") == container_name:
-            term = cs.get("state", {}).get("terminated")
-            if term is not None:
-                return int(term.get("exitCode", 0))
-    return None
+    term = _terminated_state(pod, container_name)
+    return int(term.get("exitCode", 0)) if term is not None else None
+
+
+def terminated_reason(pod: dict[str, Any], container_name: str) -> str | None:
+    """Kubelet's termination reason ("OOMKilled", "Error", ...) for a
+    terminated container, or None."""
+    term = _terminated_state(pod, container_name)
+    if term is None:
+        return None
+    return str(term.get("reason", "")) or None
 
 
 def set_container_terminated(

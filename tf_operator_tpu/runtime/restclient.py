@@ -11,6 +11,7 @@ JSON lines pumped into a Watch by a reader thread).
 from __future__ import annotations
 
 import json
+import os
 import threading
 from typing import Any
 from urllib import error as urlerror
@@ -46,9 +47,16 @@ def _raise_for(err: urlerror.HTTPError) -> None:
 
 
 class RestClusterClient(ClusterClient):
-    def __init__(self, base_url: str, timeout: float = 10.0) -> None:
+    def __init__(
+        self, base_url: str, timeout: float = 10.0, token: str | None = None
+    ) -> None:
         self._base = base_url.rstrip("/")
         self._timeout = timeout
+        # Bearer token for servers running with write auth
+        # (--serve-token-file); defaults from the environment so every
+        # --master consumer (client, genjob, harness) picks it up without
+        # plumbing a flag through each CLI.
+        self._token = token or os.environ.get("TPU_OPERATOR_API_TOKEN")
         self._watches: dict[Watch, threading.Event] = {}
         self._lock = threading.Lock()
 
@@ -58,11 +66,14 @@ class RestClusterClient(ClusterClient):
         self, method: str, path: str, body: dict[str, Any] | None = None
     ) -> dict[str, Any]:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"} if data else {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
         req = urlrequest.Request(
             self._base + path,
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"} if data else {},
+            headers=headers,
         )
         try:
             with urlrequest.urlopen(req, timeout=self._timeout) as resp:
