@@ -53,27 +53,45 @@ def test_kubectl_deploy_command_sequence():
     assert len(ran) == 4
 
     # Missing token secret (probe rc 1): a random one is created BEFORE the
-    # operator deploys, and never rotated when it already exists.
-    probe_calls = []
-
+    # operator deploys, with the token over stdin (argv would leak it to ps
+    # and error logs), and never rotated when it already exists.
     class _NoSecret:
         returncode = 1
 
+    secret_calls = []
+
     def probing_runner(cmd, **kw):
-        probe_calls.append(cmd)
         if "get" in cmd and "secret" in cmd:
             return _NoSecret()
+        if "create" in cmd and "secret" in cmd:
+            secret_calls.append((cmd, kw))
         return _OK()
 
     ran = kubectl_deploy("apply", namespace="ns1", runner=probing_runner)
     flat = [" ".join(c) for c in ran]
     create_idx = next(i for i, f in enumerate(flat) if "create secret generic" in f)
-    operator_idx = len(flat) - 1
-    assert create_idx < operator_idx
-    assert "--from-literal=token=" in flat[create_idx]
-    # random, non-trivial token material
-    token = flat[create_idx].split("token=")[-1]
-    assert len(token) >= 32 and token != "token"
+    assert create_idx < len(flat) - 1  # before the operator apply
+    assert "--from-file=token=/dev/stdin" in flat[create_idx]
+    assert "token=" not in flat[create_idx].replace("token=/dev/stdin", "")
+    [(cmd, kw)] = secret_calls
+    assert len(kw["input"]) >= 32  # random, non-trivial token material
+
+    # Create race (probe said missing, create hit AlreadyExists): tolerated
+    # as long as a re-probe finds the secret.
+    state = {"gets": 0}
+
+    class _Fail1:
+        returncode = 1
+
+    def racing_runner(cmd, **kw):
+        if "get" in cmd and "secret" in cmd:
+            state["gets"] += 1
+            return _Fail1() if state["gets"] == 1 else _OK()
+        if "create" in cmd and "secret" in cmd:
+            return _Fail1()  # AlreadyExists from the race winner
+        return _OK()
+
+    kubectl_deploy("apply", namespace="ns1", runner=racing_runner)  # no raise
 
     calls.clear()
     ran = kubectl_deploy("delete", namespace="ns1", runner=runner)

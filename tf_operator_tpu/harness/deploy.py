@@ -176,16 +176,23 @@ def kubectl_deploy(
         run(base + ["apply", "-f", "-"], input=_namespace_yaml(namespace).encode())
         # API write-auth token: generated randomly per cluster on first
         # deploy, NEVER rotated on re-apply (the operator reads it at
-        # startup; silent rotation would strand running clients).
+        # startup; silent rotation would strand running clients). The token
+        # travels over stdin — argv would leak it to `ps` and error logs.
         if not probe(base + ["-n", namespace, "get", "secret",
                              "tpu-operator-api-token"]):
             import secrets as _secrets
 
-            run(
-                base + ["-n", namespace, "create", "secret", "generic",
-                        "tpu-operator-api-token",
-                        f"--from-literal=token={_secrets.token_hex(24)}"],
-            )
+            create_cmd = base + ["-n", namespace, "create", "secret",
+                                 "generic", "tpu-operator-api-token",
+                                 "--from-file=token=/dev/stdin"]
+            try:
+                run(create_cmd, input=_secrets.token_hex(24).encode())
+            except RuntimeError:
+                # Lost a create race (or the earlier probe false-negatived
+                # on a transient error): fine as long as the secret exists.
+                if not probe(base + ["-n", namespace, "get", "secret",
+                                     "tpu-operator-api-token"]):
+                    raise
         run(base + ["apply", "-f", crd])
         run(base + ["apply", "-f", "-"], input=operator_doc)
     else:

@@ -41,6 +41,12 @@ class TransformerConfig:
     seq_axis: str = "sp"
     batch_axis: str = "dp"
     tp_axis: str = "tp"
+    # Rematerialize each block on the backward pass (jax.checkpoint): layer
+    # activations are recomputed instead of stored, trading ~1/3 more FLOPs
+    # for O(n_layers) less HBM — what makes long-context training fit on a
+    # chip (the flash kernel already never materializes O(S^2) scores; remat
+    # removes the O(n_layers * S * d_model) residual-stream term).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -148,8 +154,9 @@ class Transformer(nn.Module):
             jnp.arange(tokens.shape[1])[None, :]
         )
         x = x + pos
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"block_{i}")(x)
+            x = block_cls(cfg, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
             x.astype(jnp.float32)
