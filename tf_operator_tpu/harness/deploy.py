@@ -178,8 +178,9 @@ def kubectl_deploy(
         # deploy, NEVER rotated on re-apply (the operator reads it at
         # startup; silent rotation would strand running clients). The token
         # travels over stdin — argv would leak it to `ps` and error logs.
-        if not probe(base + ["-n", namespace, "get", "secret",
-                             "tpu-operator-api-token"]):
+        get_secret = base + ["-n", namespace, "get", "secret",
+                             "tpu-operator-api-token"]
+        if not probe(get_secret):
             import secrets as _secrets
 
             create_cmd = base + ["-n", namespace, "create", "secret",
@@ -190,8 +191,7 @@ def kubectl_deploy(
             except RuntimeError:
                 # Lost a create race (or the earlier probe false-negatived
                 # on a transient error): fine as long as the secret exists.
-                if not probe(base + ["-n", namespace, "get", "secret",
-                                     "tpu-operator-api-token"]):
+                if not probe(get_secret):
                     raise
         run(base + ["apply", "-f", crd])
         run(base + ["apply", "-f", "-"], input=operator_doc)
