@@ -76,3 +76,42 @@ def test_restore_missing_raises(tmp_path):
     with CheckpointManager(str(tmp_path / "empty")) as mgr:
         with pytest.raises(FileNotFoundError):
             mgr.restore(None, _state(mesh))
+
+
+def test_fsdp_state_roundtrip_preserves_shard_placement(tmp_path):
+    """Save/restore of an FSDP-sharded TrainState (params AND adamw moments
+    on P('fsdp')) must restore onto the same sharded placement — a resumed
+    job re-gathering full params per chip would silently undo the memory
+    sharding FSDP exists for."""
+    from jax.sharding import PartitionSpec as P
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.sharding import shard_params_fsdp
+    from tf_operator_tpu.train.steps import adamw
+
+    mesh = create_mesh({"fsdp": 8})
+    model = MnistCNN(dtype=jnp.float32)
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, train=True)["params"]
+    tx = adamw(1e-3)
+
+    def fsdp_state():
+        return TrainState.create(shard_params_fsdp(mesh, params, min_size=64), tx)
+
+    state = fsdp_state()
+    with CheckpointManager(str(tmp_path / "ckpt")) as mgr:
+        mgr.save(3, state)
+        mgr.wait()
+        restored = mgr.restore(None, fsdp_state())
+
+    # values identical...
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored.params, state.params,
+    )
+    # ...and placement still sharded, for params and optimizer moments alike
+    k = restored.params["Dense_0"]["kernel"]
+    assert k.sharding.spec == P("fsdp", None)
+    assert k.addressable_shards[0].data.shape[0] == k.shape[0] // 8
+    mu = restored.opt_state[0].mu["Dense_0"]["kernel"]
+    assert mu.sharding.spec == P("fsdp", None)

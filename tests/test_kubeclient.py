@@ -551,7 +551,6 @@ def test_full_job_lifecycle_over_kube_backend():
     subresource writes, and CleanPodPolicy GC must run, all through K8s
     REST conventions."""
     import threading
-    import time
 
     from test_scale import FakeKubelet
 
