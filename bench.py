@@ -213,10 +213,15 @@ def bench_resnet(peak_tflops: float | None) -> None:
 
     model = resnet50(dtype=jnp.bfloat16)
 
-    # --- input pipeline: synthetic uint8 records through the native loader.
-    rec_bytes = IMAGE_SIZE * IMAGE_SIZE * 3 + 1  # image + label byte
-    num_records = 2048
-    path = "/tmp/bench_records.bin"
+    # --- input pipeline: synthetic uint8 records through the native loader
+    # + native crop/flip augmentation (records are stored at RECORD_SIZE^2
+    # and random-cropped to IMAGE_SIZE, ImageNet-style), all on the clock.
+    from tf_operator_tpu.native.augment import augment_batch
+
+    record_size = IMAGE_SIZE + 32 if IMAGE_SIZE >= 64 else IMAGE_SIZE
+    rec_bytes = record_size * record_size * 3 + 1  # image + label byte
+    num_records = 1024
+    path = f"/tmp/bench_records_{record_size}.bin"
     if not os.path.exists(path) or os.path.getsize(path) != num_records * rec_bytes:
         rng = np.random.default_rng(0)
         write_records(
@@ -225,9 +230,11 @@ def bench_resnet(peak_tflops: float | None) -> None:
     pipe = RecordPipeline(
         path, rec_bytes, BATCH, prefetch=8, threads=4, seed=0, loop=True
     )
+    sample_counter = [0]
 
     def next_stacked() -> dict[str, np.ndarray]:
-        """FUSED_STEPS batches stacked for scan_batches: uint8 images."""
+        """FUSED_STEPS batches stacked for scan_batches: uint8 images,
+        cropped+flipped by the native augment stage."""
         imgs = np.empty(
             (FUSED_STEPS, BATCH, IMAGE_SIZE, IMAGE_SIZE, 3), np.uint8
         )
@@ -237,7 +244,12 @@ def bench_resnet(peak_tflops: float | None) -> None:
             raw = next(it)
             while raw.shape[0] < BATCH:  # final short batch of an epoch
                 raw = np.concatenate([raw, next(it)])[:BATCH]
-            imgs[s] = raw[:, :-1].reshape(BATCH, IMAGE_SIZE, IMAGE_SIZE, 3)
+            full = raw[:, :-1].reshape(BATCH, record_size, record_size, 3)
+            imgs[s] = augment_batch(
+                full, (IMAGE_SIZE, IMAGE_SIZE), seed=1,
+                index0=sample_counter[0], threads=8,
+            )
+            sample_counter[0] += BATCH
             labels[s] = raw[:, -1].astype(np.int32) % 1000
         return {"image": imgs, "label": labels}
 
@@ -309,7 +321,7 @@ def bench_resnet(peak_tflops: float | None) -> None:
         "images/sec",
         images_per_sec / per_chip_baseline,
         mfu=mfu,
-        input_pipeline="native+double-buffered",
+        input_pipeline="native-records+augment+double-buffered",
     )
 
 

@@ -1,0 +1,109 @@
+"""Batch image augmentation: random/center crop + horizontal flip (uint8).
+
+Python binding for augment.cc with a pure-NumPy fallback of IDENTICAL
+semantics — per-image decisions derive from the shared splitmix64 stream
+(seed * 1000003 + global_index), so the two engines are bit-interchangeable
+and tests assert exact equivalence. Together with RecordPipeline this is
+the host half of the input path: records -> shuffle -> crop/flip -> uint8
+batch -> device (normalization happens on device; bytes stay uint8 on the
+host and over the transfer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from tf_operator_tpu.native import NativeBuildError, load_library
+from tf_operator_tpu.native.pipeline import _splitmix64_stream
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="native-augment")
+
+_lib = None
+_lib_failed = False
+
+
+def _native_lib():
+    global _lib, _lib_failed
+    if _lib is None and not _lib_failed:
+        try:
+            lib = load_library("augment.cc")
+            lib.aug_batch.restype = ctypes.c_int
+            lib.aug_batch.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ]
+            _lib = lib
+        except NativeBuildError as e:
+            LOG.warning("native augment unavailable (%s); numpy engine", e)
+            _lib_failed = True
+    return _lib
+
+
+# Domain separator (must match augment.cc): keeps augment decision streams
+# disjoint from the record-pipeline shuffle streams, which key the same
+# splitmix64 keyspace as seed*1000003+epoch.
+_AUGMENT_DOMAIN = 0x6175676D656E7400  # "augment\0"
+_MASK64 = (1 << 64) - 1
+
+
+def _decisions(seed: int, index: int, max_y: int, max_x: int,
+               train: bool) -> tuple[int, int, bool]:
+    if not train:
+        return max_y // 2, max_x // 2, False
+    rng = _splitmix64_stream(((seed * 1000003 + index) & _MASK64) ^ _AUGMENT_DOMAIN)
+    y = next(rng) % (max_y + 1) if max_y else 0
+    x = next(rng) % (max_x + 1) if max_x else 0
+    return y, x, bool(next(rng) & 1)
+
+
+def augment_batch(
+    images: np.ndarray,
+    out_hw: tuple[int, int],
+    *,
+    seed: int = 0,
+    index0: int = 0,
+    train: bool = True,
+    threads: int = 4,
+    engine: str = "auto",
+) -> np.ndarray:
+    """Crop (random when train, centered when not) + random hflip.
+
+    images: [n, H, W, C] uint8 (C-contiguous). index0 is the global index of
+    images[0] in the sample stream — it keys the per-image RNG so results
+    are reproducible across batch boundaries and engines.
+    """
+    if images.dtype != np.uint8 or images.ndim != 4:
+        raise ValueError(f"expected [n,H,W,C] uint8, got {images.dtype} {images.shape}")
+    n, in_h, in_w, ch = images.shape
+    out_h, out_w = out_hw
+    if out_h > in_h or out_w > in_w:
+        raise ValueError(f"crop {out_hw} larger than input {(in_h, in_w)}")
+    images = np.ascontiguousarray(images)
+    out = np.empty((n, out_h, out_w, ch), np.uint8)
+
+    if engine not in ("auto", "native", "python"):
+        raise ValueError(f"unknown engine {engine!r}")
+    lib = _native_lib() if engine in ("auto", "native") else None
+    if engine == "native" and lib is None:
+        raise NativeBuildError("native augment engine unavailable")
+    if lib is not None:
+        rc = lib.aug_batch(
+            images.ctypes.data_as(ctypes.c_char_p),
+            out.ctypes.data_as(ctypes.c_char_p),
+            n, in_h, in_w, ch, out_h, out_w, seed, index0,
+            int(train), threads,
+        )
+        if rc != 0:
+            raise ValueError(f"aug_batch failed with rc={rc}")
+        return out
+
+    for i in range(n):
+        y, x, flip = _decisions(seed, index0 + i, in_h - out_h, in_w - out_w, train)
+        crop = images[i, y:y + out_h, x:x + out_w]
+        out[i] = crop[:, ::-1] if flip else crop
+    return out
