@@ -210,3 +210,26 @@ def test_augment_rejects_bad_inputs():
         augment_batch(np.zeros((2, 8, 8, 3), np.float32), (4, 4))
     with pytest.raises(ValueError):
         augment_batch(np.zeros((2, 8, 8, 3), np.uint8), (16, 4))
+
+
+def test_record_dataset_with_crop(tmp_path):
+    """record_dataset(crop_hw=...) runs the augment stage inline: uint8
+    records stored at 12x12 come out center-cropped to 8x8 in eval mode."""
+    rng = np.random.default_rng(3)
+    feats = rng.integers(0, 256, (6, 12, 12, 3), dtype=np.uint8)
+    labels = np.arange(6, dtype=np.int32)
+    path = str(tmp_path / "crop.bin")
+    write_example_records(path, feats, labels)
+
+    it = record_dataset(
+        path, (12, 12, 3), np.uint8, 3, seed=1, shuffle=False, loop=False,
+        crop_hw=(8, 8), augment_train=False,
+    )
+    got = {int(l): img for b in it for img, l in zip(b["image"], b["label"])}
+    assert got[0].shape == (8, 8, 3)
+    np.testing.assert_array_equal(got[0], feats[0, 2:10, 2:10])
+
+    with pytest.raises(ValueError):
+        next(record_dataset(
+            path, (12, 12, 3), np.float32, 3, crop_hw=(8, 8)
+        ))

@@ -71,7 +71,8 @@ def augment_batch(
     threads: int = 4,
     engine: str = "auto",
 ) -> np.ndarray:
-    """Crop (random when train, centered when not) + random hflip.
+    """Crop + flip: random crop with random hflip when ``train``; a
+    deterministic center crop with NO flip otherwise.
 
     images: [n, H, W, C] uint8 (C-contiguous). index0 is the global index of
     images[0] in the sample stream — it keys the per-image RNG so results

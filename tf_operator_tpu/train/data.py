@@ -67,6 +67,8 @@ def record_dataset(
     prefetch: int = 4,
     threads: int = 2,
     engine: str = "auto",
+    crop_hw: tuple[int, int] | None = None,
+    augment_train: bool = True,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Stream {image, label} batches from a binary record file.
 
@@ -76,6 +78,11 @@ def record_dataset(
     prefetch run in the native C++ pipeline when available
     (native/record_pipeline.cc) — off the GIL, so the accelerator never
     waits on Python — with a semantics-identical Python fallback.
+
+    crop_hw: for uint8 [H, W, C] examples, crop each image to this size via
+    the native augment stage (random crop + hflip while augment_train, else
+    center crop) — ImageNet-style host preprocessing on the same off-GIL
+    path.
     """
     from tf_operator_tpu.native.pipeline import RecordPipeline
 
@@ -86,10 +93,18 @@ def record_dataset(
     rec_bytes = feat_bytes + (
         label_dtype.itemsize if label_dtype is not None else 0
     )
+    if crop_hw is not None:
+        if dtype != np.uint8 or len(example_shape) != 3:
+            raise ValueError(
+                f"crop_hw needs uint8 [H,W,C] examples, got {dtype} {example_shape}"
+            )
+        from tf_operator_tpu.native.augment import augment_batch
+
     pipe = RecordPipeline(
         path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
         seed=seed, shuffle=shuffle, loop=loop, engine=engine,
     )
+    sample_index = 0
     try:
         for raw in pipe:
             feats = (
@@ -98,6 +113,12 @@ def record_dataset(
                 .view(dtype)
                 .reshape(len(raw), *example_shape)
             )
+            if crop_hw is not None:
+                feats = augment_batch(
+                    feats, crop_hw, seed=seed, index0=sample_index,
+                    train=augment_train, threads=threads,
+                )
+                sample_index += len(feats)
             out = {"image": feats}
             if label_dtype is not None:
                 out["label"] = (
