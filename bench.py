@@ -101,10 +101,11 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
     import jax
     import jax.numpy as jnp
 
-    from tf_operator_tpu.ops import attention
+    from tf_operator_tpu.ops import attention, attention_kernel
 
     H, D = 16, 64
     for seq, batch in ATTN_CONFIGS:
+        kernel = attention_kernel(seq, seq, D, 2, causal=True)
         q, k, v = (
             jax.random.normal(
                 jax.random.PRNGKey(i), (batch, seq, H, D), jnp.bfloat16
@@ -133,6 +134,7 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
             "TFLOP/s",
             tflops / peak_tflops if peak_tflops else 0.0,
             seconds_per_step=dt,
+            kernel=kernel,
         )
 
 

@@ -229,7 +229,6 @@ def test_record_dataset_with_crop(tmp_path):
     assert got[0].shape == (8, 8, 3)
     np.testing.assert_array_equal(got[0], feats[0, 2:10, 2:10])
 
+    # misconfiguration raises at the call site, not on first next()
     with pytest.raises(ValueError):
-        next(record_dataset(
-            path, (12, 12, 3), np.float32, 3, crop_hw=(8, 8)
-        ))
+        record_dataset(path, (12, 12, 3), np.float32, 3, crop_hw=(8, 8))

@@ -13,9 +13,24 @@ import jax
 from tf_operator_tpu.ops.flash_attention import (
     flash_attention,
     flash_supported,
+    on_tpu_backend,
     pick_block,
     select_block,
 )
+
+
+def attention_kernel(tq: int, tk: int, head_dim: int, itemsize: int,
+                     *, causal: bool = True) -> str:
+    """Which kernel attention() will run for these shapes on THIS backend:
+    "pallas-flash" or "xla". The single source of truth for the dispatch —
+    attention() consults it, and benchmarks label their output with it (so
+    the label can never drift from what actually executed)."""
+    on_tpu = on_tpu_backend()
+    if on_tpu and flash_supported(
+        tq, tk, head_dim, itemsize, causal=causal, compiled=on_tpu
+    ):
+        return "pallas-flash"
+    return "xla"
 
 
 def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
@@ -23,21 +38,29 @@ def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
     """Single-device attention: flash kernel on TPU, XLA elsewhere."""
     from tf_operator_tpu.parallel.ring_attention import reference_attention
 
-    on_tpu = jax.default_backend() == "tpu"
     if use_flash is None:
-        use_flash = on_tpu
-    if use_flash and flash_supported(
+        choice = attention_kernel(
+            q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize,
+            causal=causal,
+        )
+    elif use_flash and flash_supported(
         q.shape[1], k.shape[1], q.shape[-1], q.dtype.itemsize,
-        causal=causal, compiled=on_tpu,
+        causal=causal, compiled=on_tpu_backend(),
     ):
+        choice = "pallas-flash"
+    else:
+        choice = "xla"
+    if choice == "pallas-flash":
         return flash_attention(q, k, v, causal=causal, scale=scale)
     return reference_attention(q, k, v, causal=causal, scale=scale)
 
 
 __all__ = [
     "attention",
+    "attention_kernel",
     "flash_attention",
     "flash_supported",
+    "on_tpu_backend",
     "pick_block",
     "select_block",
 ]

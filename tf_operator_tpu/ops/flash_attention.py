@@ -51,6 +51,25 @@ _BLOCK_CANDIDATES = (256, 128, 64, 32, 16, 8)
 MAX_SEQ_LEN = 1 << 20
 
 
+def on_tpu_backend() -> bool:
+    """True when the default backend drives TPU hardware — including
+    tunnel/plugin platforms whose backend NAME is not "tpu" (e.g. a
+    forwarding plugin): fall back to sniffing the device kind. This is the
+    single TPU-detection used for BOTH kernel dispatch (ops.attention) and
+    interpret-mode selection below — if they ever diverged, a plugin
+    platform would run the Pallas kernel in the interpreter, orders of
+    magnitude slower than the XLA path it replaced."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        d = jax.devices()[0]
+        kind = (getattr(d, "device_kind", "") or "").lower()
+        plat = (getattr(d, "platform", "") or "").lower()
+        return "tpu" in kind or "tpu" in plat
+    except Exception:
+        return False
+
+
 def select_block(tq: int, tk: int, *, compiled: bool = False,
                  max_block: int = 256) -> int | None:
     """Largest block that tiles BOTH sequence lengths, or None.
@@ -378,7 +397,7 @@ def flash_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not on_tpu_backend()
     tq, tk = q.shape[1], k.shape[1]
     if block is None:
         block = select_block(tq, tk, compiled=not interpret)

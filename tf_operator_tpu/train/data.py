@@ -80,13 +80,30 @@ def record_dataset(
     waits on Python — with a semantics-identical Python fallback.
 
     crop_hw: for uint8 [H, W, C] examples, crop each image to this size via
-    the native augment stage (random crop + hflip while augment_train, else
-    center crop) — ImageNet-style host preprocessing on the same off-GIL
-    path.
+    the augment stage (random crop + hflip while augment_train, else center
+    crop) — ImageNet-style host preprocessing; ``engine`` selects the
+    native/python implementation for the augment stage and the record
+    pipeline alike.
     """
+    dtype = np.dtype(dtype)
+    if crop_hw is not None and (dtype != np.uint8 or len(example_shape) != 3):
+        # Validate at the call site, not on first next(): the misconfigured
+        # call is where the fix belongs.
+        raise ValueError(
+            f"crop_hw needs uint8 [H,W,C] examples, got {dtype} {example_shape}"
+        )
+    return _record_batches(
+        path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
+        loop, prefetch, threads, engine, crop_hw, augment_train,
+    )
+
+
+def _record_batches(
+    path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
+    loop, prefetch, threads, engine, crop_hw, augment_train,
+) -> Iterator[dict[str, np.ndarray]]:
     from tf_operator_tpu.native.pipeline import RecordPipeline
 
-    dtype = np.dtype(dtype)
     if label_dtype is not None:
         label_dtype = np.dtype(label_dtype)
     feat_bytes = int(np.prod(example_shape)) * dtype.itemsize
@@ -94,10 +111,6 @@ def record_dataset(
         label_dtype.itemsize if label_dtype is not None else 0
     )
     if crop_hw is not None:
-        if dtype != np.uint8 or len(example_shape) != 3:
-            raise ValueError(
-                f"crop_hw needs uint8 [H,W,C] examples, got {dtype} {example_shape}"
-            )
         from tf_operator_tpu.native.augment import augment_batch
 
     pipe = RecordPipeline(
@@ -116,7 +129,7 @@ def record_dataset(
             if crop_hw is not None:
                 feats = augment_batch(
                     feats, crop_hw, seed=seed, index0=sample_index,
-                    train=augment_train, threads=threads,
+                    train=augment_train, threads=threads, engine=engine,
                 )
                 sample_index += len(feats)
             out = {"image": feats}
