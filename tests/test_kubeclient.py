@@ -535,8 +535,9 @@ def test_apiserver_proxies_over_kube_backend():
         # Deterministic metric registration: the controller module
         # registers the tpu_operator_* families at import time, which a
         # standalone run of this test would otherwise never trigger.
-        import tf_operator_tpu.controller.tpujob_controller  # noqa: F401
+        from tf_operator_tpu.controller import tpujob_controller as tc_mod
 
+        assert tc_mod.SYNC_SECONDS is not None  # families registered
         with urllib.request.urlopen(f"{base}/metrics", timeout=5) as resp:
             assert b"tpu_operator" in resp.read()
     finally:

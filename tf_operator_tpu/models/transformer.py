@@ -41,6 +41,10 @@ class TransformerConfig:
     seq_axis: str = "sp"
     batch_axis: str = "dp"
     tp_axis: str = "tp"
+    # Bound per-device attention-score memory under ring attention: fold kv
+    # in chunks of this many keys (None = whole block at once). Exact either
+    # way; set for long contexts where a [Tq, Tk] f32 tile won't fit.
+    ring_kv_chunk: int | None = None
     # Rematerialize each block on the backward pass (jax.checkpoint): layer
     # activations are recomputed instead of stored, trading ~1/3 more FLOPs
     # for O(n_layers) less HBM — what makes long-context training fit on a
@@ -87,6 +91,7 @@ class Attention(nn.Module):
                 batch_spec=batch_spec,
                 head_spec=head_spec,
                 causal=True,
+                kv_chunk=cfg.ring_kv_chunk,
             )
         else:
             # ops.attention dispatches: pallas flash kernel on TPU with

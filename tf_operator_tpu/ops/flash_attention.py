@@ -25,9 +25,10 @@ Kernels run in [batch, heads, seq, head_dim] layout so Mosaic's tiling
 constraint (block's trailing dims must be sublane/lane aligned) falls on
 (seq_block, head_dim); the public API takes the framework convention
 [batch, seq, heads, head_dim] (parallel/ring_attention.py) and transposes
-at the boundary (XLA folds the transpose into neighboring ops). Composes
-with ring attention: ring shards the sequence across chips (ICI), this
-kernel is the per-chip block compute.
+at the boundary (XLA folds the transpose into neighboring ops). For
+sequences sharded across chips, ring attention bounds its own per-chip
+memory with chunked streaming softmax (ring_attention(kv_chunk=...)); this
+kernel is the single-device path ops.attention dispatches to.
 
 Falls back transparently (ops/__init__.attention) to the XLA reference
 implementation when shapes don't tile or when not on TPU.

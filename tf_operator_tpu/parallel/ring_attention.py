@@ -39,13 +39,42 @@ def _block_attn(q, k, v, scale, mask):
     return s
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+def _accumulate_block(q, k_blk, v_blk, q_pos, k_pos, o, m, l, scale, causal):
+    """Fold one kv block into the streaming-softmax accumulators."""
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+    else:
+        mask = None
+    s = _block_attn(q, k_blk, v_blk, scale, mask)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp(_NEG_INF - _NEG_INF) would be 1; clamp fully-masked rows via l.
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None, :, :], p, 0.0)
+    l = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+    o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o, m_new, l
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float,
+                          kv_chunk: int | None = None):
     """Per-device body (runs under shard_map). Local seq block attends to
-    every kv block as it rotates around the ring."""
+    every kv block as it rotates around the ring.
+
+    kv_chunk bounds the score tile: each held kv block is folded in chunks
+    of that many keys through an inner scan, so per-device live memory is
+    O(Tq * kv_chunk) instead of O(Tq * Tk) — the long-context regime where
+    even one device's block pair would not fit. Exact either way (the
+    streaming softmax is associative over chunks).
+    """
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
+    if kv_chunk is not None and (kv_chunk <= 0 or tk % kv_chunk):
+        raise ValueError(f"kv_chunk {kv_chunk} must divide the kv block {tk}")
 
     o = jnp.zeros((b, tq, h, d), jnp.float32)
     m = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
@@ -60,24 +89,28 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
         # Which global block this device currently holds: blocks rotate
         # forward, so at step i we hold block (my_idx - i) mod ring.
         kv_idx = (my_idx - i) % axis_size
-        if causal:
-            k_pos = kv_idx * tk + jnp.arange(tk)
-            mask = q_pos[:, None] >= k_pos[None, :]
+        k0 = kv_idx * tk
+        if kv_chunk is None:
+            o, m, l = _accumulate_block(
+                q, k_cur, v_cur, q_pos, k0 + jnp.arange(tk), o, m, l,
+                scale, causal,
+            )
         else:
-            mask = None
-        s = _block_attn(q, k_cur, v_cur, scale, mask)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # exp(_NEG_INF - _NEG_INF) would be 1; clamp fully-masked rows via l.
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[..., None])
-        if causal:
-            p = jnp.where(mask[None, None, :, :], p, 0.0)
-        l = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
-        o = o * alpha.transpose(0, 2, 1)[..., None] + pv
+            def chunk_step(inner, j):
+                o, m, l = inner
+                k_blk = lax.dynamic_slice_in_dim(k_cur, j * kv_chunk, kv_chunk, 1)
+                v_blk = lax.dynamic_slice_in_dim(v_cur, j * kv_chunk, kv_chunk, 1)
+                k_pos = k0 + j * kv_chunk + jnp.arange(kv_chunk)
+                return _accumulate_block(
+                    q, k_blk, v_blk, q_pos, k_pos, o, m, l, scale, causal
+                ), None
+
+            (o, m, l), _ = lax.scan(
+                chunk_step, (o, m, l), jnp.arange(tk // kv_chunk)
+            )
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (o, m_new, l, k_next, v_next), None
+        return (o, m, l, k_next, v_next), None
 
     (o, m, l, _, _), _ = lax.scan(
         step, (o, m, l, k, v), jnp.arange(axis_size)
@@ -98,18 +131,21 @@ def ring_attention(
     head_spec: Any = (None,),
     causal: bool = True,
     scale: float | None = None,
+    kv_chunk: int | None = None,
 ) -> jax.Array:
     """Exact attention with the sequence dim sharded over ``seq_axis``.
 
     q/k/v: [batch, seq, heads, head_dim] global arrays (sharded or to-be-
     sharded per the specs). Returns the attention output with the same
-    sharding as q.
+    sharding as q. ``kv_chunk`` (must divide the per-device block) bounds
+    per-device score memory to O(Tq * kv_chunk) for long-context blocks.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(*batch_spec, seq_axis, *head_spec, None)
     body = functools.partial(
-        _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale
+        _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale,
+        kv_chunk=kv_chunk,
     )
     return jax.shard_map(
         body,
