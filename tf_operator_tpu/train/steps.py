@@ -9,6 +9,7 @@ function of (state, batch): no Python control flow under jit, static shapes.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable
 
 import jax
@@ -171,6 +172,109 @@ def make_lm_train_step(
         in_shardings=(None, batch_sharding),
         donate_argnums=(0,) if donate else (),
     )
+
+
+def make_classifier_eval_step(
+    model: Any,
+    mesh: Mesh,
+    *,
+    has_batch_stats: bool = True,
+    data_axis: Any = "dp",
+):
+    """Jitted eval step (what an Evaluator replica runs against checkpoints
+    the trainer writes): batch sharded over the data axis, params
+    replicated, BatchNorm in inference mode (running stats). The batch
+    carries a 0/1 ``mask`` (padding rows are 0) and the step returns MASKED
+    sums (correct, loss_sum, count), so ``evaluate`` below can pad every
+    batch to one fixed shape — exact metrics, one XLA compilation."""
+
+    def step(state: TrainState, batch):
+        variables = {"params": state.params}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["image"], train=False)
+        labels = batch["label"]
+        mask = batch["mask"].astype(jnp.float32)
+        per_example = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        )
+        return {
+            "correct": ((logits.argmax(-1) == labels) * mask).sum(),
+            "loss_sum": (per_example * mask).sum(),
+            "count": mask.sum(),
+        }
+
+    sharded = NamedSharding(mesh, P(data_axis))
+    batch_sharding = {"image": sharded, "label": sharded, "mask": sharded}
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sharding),
+        out_shardings=replicated,
+    )
+
+
+def evaluate(
+    eval_step,
+    state: TrainState,
+    batches,
+    mesh: Mesh,
+    *,
+    data_axis: Any = "dp",
+    pad_to: int | None = None,
+) -> dict[str, float]:
+    """Drive an eval step over host batches of ANY sizes (tail batches
+    included): each batch is padded to one fixed size (``pad_to``; default
+    = first batch rounded up to the data-axis size) with a 0 mask on the
+    padding, so every call hits the same compiled executable and the
+    aggregate is exact. Accumulation stays on device; the host syncs once
+    at the end."""
+    import numpy as np
+
+    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
+    shard_count = math.prod(mesh.shape.get(a, 1) for a in axes)
+    sharding = NamedSharding(mesh, P(data_axis))
+
+    correct = loss_sum = count = None
+    for batch in batches:
+        img = np.asarray(batch["image"])
+        lab = np.asarray(batch["label"])
+        n = img.shape[0]
+        if pad_to is None:
+            pad_to = -(-n // shard_count) * shard_count
+        if n > pad_to:
+            raise ValueError(
+                f"batch of {n} exceeds pad_to={pad_to}; the first batch "
+                "sets the compiled shape — pass pad_to= explicitly when "
+                "later batches can be larger"
+            )
+        pad = pad_to - n
+        if pad:
+            img = np.concatenate([img, np.zeros((pad, *img.shape[1:]), img.dtype)])
+            lab = np.concatenate([lab, np.zeros((pad,), lab.dtype)])
+        mask = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
+        )
+        dev = {
+            "image": jax.device_put(img, sharding),
+            "label": jax.device_put(lab, sharding),
+            "mask": jax.device_put(mask, sharding),
+        }
+        m = eval_step(state, dev)  # async: dispatch overlaps host prep
+        if correct is None:
+            correct, loss_sum, count = m["correct"], m["loss_sum"], m["count"]
+        else:
+            correct = correct + m["correct"]
+            loss_sum = loss_sum + m["loss_sum"]
+            count = count + m["count"]
+    if correct is None or float(count) == 0:
+        raise ValueError("evaluate() got no batches")
+    total = float(count)  # single host sync
+    return {
+        "accuracy": float(correct) / total,
+        "loss": float(loss_sum) / total,
+        "count": int(total),
+    }
 
 
 def fuse_steps(step_fn, num_steps: int, *, scan_batches: bool = False,
