@@ -103,7 +103,7 @@ def test_dist_mnist_two_process_training(operator):
         )
     )
     try:
-        got = cli.wait_for_job("default", "mnist2", timeout=180)
+        got = cli.wait_for_job("default", "mnist2", timeout=300)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "mnist2")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"
@@ -134,7 +134,9 @@ def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
         )
     )
     try:
-        got = cli.wait_for_job("default", "mnistresume", timeout=240)
+        # Generous budget: two incarnations each pay a fresh jit compile,
+        # and CI hosts can be single-core with other suites contending.
+        got = cli.wait_for_job("default", "mnistresume", timeout=420)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "mnistresume")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"

@@ -194,52 +194,74 @@ def make_classifier_eval_step(
             variables["batch_stats"] = state.batch_stats
         logits = model.apply(variables, batch["image"], train=False)
         labels = batch["label"]
-        mask = batch["mask"].astype(jnp.float32)
+        mask = batch["mask"]
         per_example = optax.softmax_cross_entropy_with_integer_labels(
             logits, labels
         )
+        # Integer counts: f32 accumulation would silently lose exactness
+        # past 2^24 examples; int32 is exact to 2^31.
         return {
-            "correct": ((logits.argmax(-1) == labels) * mask).sum(),
-            "loss_sum": (per_example * mask).sum(),
-            "count": mask.sum(),
+            "correct": ((logits.argmax(-1) == labels) & (mask > 0))
+            .astype(jnp.int32).sum(),
+            "loss_sum": (per_example * mask.astype(jnp.float32)).sum(),
+            "count": (mask > 0).astype(jnp.int32).sum(),
         }
 
+    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
     sharded = NamedSharding(mesh, P(data_axis))
     batch_sharding = {"image": sharded, "label": sharded, "mask": sharded}
     replicated = NamedSharding(mesh, P())
-    return jax.jit(
+    fn = jax.jit(
         step,
         in_shardings=(replicated, batch_sharding),
         out_shardings=replicated,
     )
+    return _EvalStep(
+        fn, sharded, math.prod(mesh.shape.get(a, 1) for a in axes)
+    )
+
+
+class _EvalStep:
+    """A jitted eval step plus the sharding facts evaluate() needs — bound
+    at build time so the caller can't pass a mismatched mesh/axis later."""
+
+    def __init__(self, fn, sharding: NamedSharding, shard_count: int) -> None:
+        self._fn = fn
+        self.sharding = sharding
+        self.shard_count = shard_count
+
+    def __call__(self, state: TrainState, batch):
+        return self._fn(state, batch)
+
+    def compilation_count(self) -> int:
+        """Best-effort (private JAX API): -1 when unavailable."""
+        probe = getattr(self._fn, "_cache_size", None)
+        return int(probe()) if callable(probe) else -1
 
 
 def evaluate(
-    eval_step,
+    eval_step: "_EvalStep",
     state: TrainState,
     batches,
-    mesh: Mesh,
     *,
-    data_axis: Any = "dp",
     pad_to: int | None = None,
 ) -> dict[str, float]:
     """Drive an eval step over host batches of ANY sizes (tail batches
     included): each batch is padded to one fixed size (``pad_to``; default
-    = first batch rounded up to the data-axis size) with a 0 mask on the
-    padding, so every call hits the same compiled executable and the
-    aggregate is exact. Accumulation stays on device; the host syncs once
-    at the end."""
+    = first non-empty batch rounded up to the data-axis size) with a 0 mask
+    on the padding, so every call hits the same compiled executable and
+    counts/accuracy are exact (loss accumulates in f32). Accumulation stays
+    on device; the host syncs once at the end."""
     import numpy as np
 
-    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
-    shard_count = math.prod(mesh.shape.get(a, 1) for a in axes)
-    sharding = NamedSharding(mesh, P(data_axis))
-
+    sharding, shard_count = eval_step.sharding, eval_step.shard_count
     correct = loss_sum = count = None
     for batch in batches:
         img = np.asarray(batch["image"])
         lab = np.asarray(batch["label"])
         n = img.shape[0]
+        if n == 0:
+            continue  # an empty shard must not define (or fail) the shape
         if pad_to is None:
             pad_to = -(-n // shard_count) * shard_count
         if n > pad_to:
@@ -267,13 +289,13 @@ def evaluate(
             correct = correct + m["correct"]
             loss_sum = loss_sum + m["loss_sum"]
             count = count + m["count"]
-    if correct is None or float(count) == 0:
-        raise ValueError("evaluate() got no batches")
-    total = float(count)  # single host sync
+    if correct is None or int(count) == 0:
+        raise ValueError("evaluate() got no non-empty batches")
+    total = int(count)  # single host sync
     return {
-        "accuracy": float(correct) / total,
+        "accuracy": int(correct) / total,
         "loss": float(loss_sum) / total,
-        "count": int(total),
+        "count": total,
     }
 
 
