@@ -1,0 +1,105 @@
+"""Operator HA failover E2E: two real operator processes, one cluster.
+
+The reference's leader election (cmd/tf-operator.v2/app/server.go:140-152,
+Endpoints lock) exists so a standby takes over reconciliation when the
+leader dies. Here: two operator subprocesses run --backend kube
+--leader-elect against ONE stubbed K8s apiserver (Lease CAS in the store).
+Only the leader reconciles; killing it hard (SIGKILL — no release) makes
+the standby acquire the expired lease and reconcile jobs submitted after
+the failover.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.cli.genjob import synthetic_job
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _operator(kubeconfig: str, log_path) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    with open(log_path, "wb") as log:
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cli.operator",
+                "--backend", "kube", "--kubeconfig", kubeconfig,
+                "--leader-elect", "--lease-duration", "2.0",
+                "--renew-deadline", "1.2", "--retry-period", "0.4",
+                "--reconcile-period", "0.3", "--informer-resync", "1.0",
+            ],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )  # child holds its own fd; ours closes with the with-block
+
+
+def _wait_job_created_pods(stub, name, timeout=20.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pods = [
+            p for p in stub.cluster.list(objects.PODS, "default")
+            if p["metadata"]["name"].startswith(name + "-")
+        ]
+        if pods:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+@pytest.mark.slow
+def test_standby_takes_over_after_leader_sigkill(tmp_path):
+    stub = KubeApiStub()
+    stub.start()
+    kc = tmp_path / "kubeconfig.yaml"
+    kc.write_text(
+        "apiVersion: v1\nkind: Config\ncurrent-context: stub\n"
+        "clusters: [{name: stub, cluster: {server: \"" + stub.url + "\"}}]\n"
+        "contexts: [{name: stub, context: {cluster: stub, user: u}}]\n"
+        "users: [{name: u, user: {}}]\n"
+    )
+    ops = [
+        _operator(str(kc), tmp_path / "a.log"),
+        _operator(str(kc), tmp_path / "b.log"),
+    ]
+    try:
+        # Exactly one reconciles: submit a job, it gets pods.
+        stub.cluster.create(
+            objects.TPUJOBS, synthetic_job("before", "default", 1, None, None)
+        )
+        assert _wait_job_created_pods(stub, "before"), "no leader reconciled"
+        [lease] = stub.cluster.list(objects.LEASES, None)
+        holder = lease["spec"]["holderIdentity"]
+        # Identity is "{hostname}-{pid}" (cli/operator.py): kill whichever
+        # process actually holds the lease — no timing assumptions.
+        leader_pid = int(holder.rsplit("-", 1)[1])
+        leader = next(p for p in ops if p.pid == leader_pid)
+        leader.kill()  # SIGKILL: no release, the lease must EXPIRE
+        leader.wait(timeout=10)
+
+        # Standby acquires and reconciles new work.
+        stub.cluster.create(
+            objects.TPUJOBS, synthetic_job("after", "default", 1, None, None)
+        )
+        assert _wait_job_created_pods(stub, "after", timeout=30), (
+            "standby never took over; logs under " + str(tmp_path)
+        )
+        [lease] = stub.cluster.list(objects.LEASES, None)
+        assert lease["spec"]["holderIdentity"] != holder
+    finally:
+        for p in ops:
+            try:
+                p.send_signal(signal.SIGTERM)
+                p.wait(timeout=5)
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        stub.stop()
