@@ -55,6 +55,37 @@ class TestResNet:
         # ResNet-50 has ~25.6M params.
         assert 25_000_000 < n_params < 26_000_000, n_params
 
+    def test_s2d_stem_is_exact_rewrite_of_conv7(self):
+        """The s2d stem computes the SAME function as the 7x7/s2 stem when
+        its 4x4x12 kernel is the embedding of the 7x7x3 one."""
+        from tf_operator_tpu.models.resnet import space_to_depth, stem_kernel_to_s2d
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        k7 = rng.normal(size=(7, 7, 3, 8)).astype(np.float32) * 0.1
+
+        direct = jax.lax.conv_general_dilated(
+            x, jnp.asarray(k7), window_strides=(2, 2),
+            padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        rewritten = jax.lax.conv_general_dilated(
+            space_to_depth(x, 2), jnp.asarray(stem_kernel_to_s2d(k7)),
+            window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert direct.shape == rewritten.shape == (2, 16, 16, 8)
+        np.testing.assert_allclose(direct, rewritten, rtol=1e-5, atol=1e-5)
+
+    def test_s2d_resnet_trains_and_matches_shapes(self):
+        model = resnet50(num_classes=10, dtype=jnp.float32, stem="s2d")
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
+        k = variables["params"]["stem_s2d"]["kernel"]
+        assert k.shape == (4, 4, 12, 64)
+
     def test_resnet18_train_step_dp(self):
         mesh = create_mesh({"dp": 8})
         model = resnet18(num_classes=10, dtype=jnp.float32)
@@ -246,6 +277,63 @@ def test_eval_step_exact_over_uneven_batches():
 
     with pytest.raises(ValueError):
         evaluate(eval_step, state, [])
+
+
+def test_chunked_xent_matches_naive():
+    """chunked_lm_xent == naive full-logits loss, value AND gradients."""
+    from tf_operator_tpu.train.steps import chunked_lm_xent, cross_entropy
+
+    rng = np.random.default_rng(0)
+    b, s, d, v = 2, 64, 16, 97
+    hidden = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    kernel = jnp.asarray(rng.normal(size=(d, v)) * 0.3, jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(v,)) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    def naive(hidden, kernel, bias):
+        return cross_entropy(hidden @ kernel + bias, labels)
+
+    def chunked(hidden, kernel, bias):
+        return chunked_lm_xent(hidden, kernel, bias, labels, chunk=16)
+
+    ln, gn = jax.value_and_grad(naive, argnums=(0, 1, 2))(hidden, kernel, bias)
+    lc, gc = jax.value_and_grad(chunked, argnums=(0, 1, 2))(hidden, kernel, bias)
+    np.testing.assert_allclose(ln, lc, rtol=1e-6)
+    for a, c in zip(gn, gc):
+        np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-6)
+
+    with np.testing.assert_raises(ValueError):
+        chunked_lm_xent(hidden, kernel, bias, labels, chunk=48)
+
+
+def test_lm_step_with_chunked_xent_matches_naive_step():
+    """A full LM train step with xent_chunk produces the same loss and the
+    same updated params as the materialized-logits step."""
+    mesh = create_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, mesh=mesh,
+    )
+    model = Transformer(cfg)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    rng = np.random.default_rng(1)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, 64, (2, 32)), jnp.int32),
+    }
+    tx = adamw(1e-3)
+    outs = []
+    for chunk in (None, 8):
+        state = TrainState.create(params, tx)
+        step = make_lm_train_step(
+            model, tx, mesh, seq_axis=None, donate=False, xent_chunk=chunk
+        )
+        state, metrics = step(state, batch)
+        outs.append((float(metrics["loss"]), state.params))
+    assert abs(outs[0][0] - outs[1][0]) < 1e-5, (outs[0][0], outs[1][0])
+    for a, b in zip(jax.tree.leaves(outs[0][1]), jax.tree.leaves(outs[1][1])):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6)
 
 
 def test_fuse_steps_matches_sequential():

@@ -19,8 +19,52 @@ from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+import numpy as np
 
 ModuleDef = Any
+
+
+def space_to_depth(x, block: int = 2):
+    """NHWC space-to-depth: [B,H,W,C] -> [B,H/b,W/b,b*b*C].
+
+    Channel order of the output is (dr, dc, c) flattened — the order
+    ``stem_kernel_to_s2d`` assumes when embedding a 7x7 stem kernel.
+    """
+    b, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"spatial dims {(h, w)} not divisible by {block}")
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c
+    )
+
+
+def stem_kernel_to_s2d(k7: np.ndarray) -> np.ndarray:
+    """Embed a 7x7xCxF stride-2 stem kernel into the equivalent 4x4x(4C)xF
+    kernel over space-to-depth(2) input (stride 1, padding (2,1)).
+
+    The 7x7 stride-2 receptive field of output pixel i spans input pixels
+    [2i-3, 2i+3], i.e. 2x2 blocks i-2..i+1 — four blocks, stride one block.
+    Input-pixel offset kr maps to block row (kr-3)//2 + 2 and within-block
+    row (kr-3) % 2; taps landing in the zero-padding region read zeros on
+    both paths, so the conv outputs are bit-identical in exact arithmetic.
+    This is the MLPerf-era stem rewrite: the direct 7x7 conv puts C=3 input
+    channels on the MXU's 128-lane reduction axis (2% utilization); the
+    s2d form reduces over 4x4x12=192 taps instead of 7x7x3=147 with full
+    lanes. Training uses the 4x4x12 kernel directly (a strict superset of
+    the original function class); this embedding exists so tests can prove
+    the rewrite is exact.
+    """
+    kh, kw, c, f = k7.shape
+    if (kh, kw) != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {k7.shape}")
+    out = np.zeros((4, 4, 4 * c, f), k7.dtype)
+    for kr in range(7):
+        br, dr = (kr - 3) // 2 + 2, (kr - 3) % 2
+        for kc in range(7):
+            bc, dc = (kc - 3) // 2 + 2, (kc - 3) % 2
+            out[br, bc, (dr * 2 + dc) * c : (dr * 2 + dc + 1) * c] = k7[kr, kc]
+    return out
 
 
 class BottleneckBlock(nn.Module):
@@ -56,6 +100,10 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
+    # "conv7": the standard 7x7/s2 stem. "s2d": same function computed as a
+    # 4x4/s1 conv over space-to-depth(2) input — C=3 never touches the MXU
+    # reduction lanes (see stem_kernel_to_s2d for the exactness argument).
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -72,8 +120,19 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             dtype=self.dtype,  # compute dtype; stats/params stay f32
         )
+        if self.stem not in ("conv7", "s2d"):
+            raise ValueError(f"unknown stem {self.stem!r}: use 'conv7' or 's2d'")
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)])(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = conv(
+                self.width, (4, 4), strides=(1, 1),
+                padding=[(2, 1), (2, 1)], name="stem_s2d",
+            )(x)
+        else:
+            x = conv(
+                self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)]
+            )(x)
         x = norm()(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
@@ -93,8 +152,12 @@ class ResNet(nn.Module):
         return x
 
 
-def resnet50(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:
-    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype)
+def resnet50(
+    num_classes: int = 1000, dtype: Any = jnp.bfloat16, stem: str = "conv7"
+) -> ResNet:
+    return ResNet(
+        stage_sizes=(3, 4, 6, 3), num_classes=num_classes, dtype=dtype, stem=stem
+    )
 
 
 def resnet18(num_classes: int = 1000, dtype: Any = jnp.bfloat16) -> ResNet:

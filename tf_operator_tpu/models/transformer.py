@@ -152,7 +152,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
         pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos")(
@@ -163,10 +163,15 @@ class Transformer(nn.Module):
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
-        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(
-            x.astype(jnp.float32)
-        )
-        return logits
+        head = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")
+        if return_hidden:
+            # Callers computing a fused/chunked loss read lm_head params
+            # directly (train/steps.py chunked_lm_xent); touching the module
+            # here keeps init creating them on this path too.
+            if self.is_initializing():
+                head(x[:, :1].astype(jnp.float32))
+            return x
+        return head(x.astype(jnp.float32))
 
 
 def param_sharding_rules(tp_axis: str = "tp") -> dict[str, tuple]:

@@ -40,6 +40,61 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def chunked_lm_xent(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    labels: jax.Array,
+    *,
+    chunk: int = 512,
+    dot_dtype: Any = None,
+) -> jax.Array:
+    """Exact mean softmax cross-entropy WITHOUT materializing [B,S,V] logits.
+
+    The LM head's f32 logits are the memory peak of long-context training:
+    at B=2, S=8k, V=32k they are 2.1 GB (and their cotangent doubles it) —
+    pure HBM traffic, since the loss only needs logsumexp and one gathered
+    logit per position. This computes the loss chunk-by-chunk over the
+    sequence inside a rematerialized lax.scan: peak logits memory drops to
+    O(B*chunk*V) and the backward pass recomputes each chunk's logits
+    (one extra [B*chunk,D]x[D,V] matmul — FLOPs the MXU has to spare when
+    the bottleneck is HBM). Numerics match the naive loss to f32 tolerance
+    (tests/test_training.py::test_chunked_xent_matches_naive, incl. grads).
+
+    ``dot_dtype=jnp.bfloat16`` runs the head matmul at the MXU's bf16 rate
+    with f32 accumulation (preferred_element_type) — logsumexp/gather stay
+    f32. A dense f32 head matmul runs at a fraction of bf16 peak, so on a
+    32k vocab this is the difference between the head being free and the
+    head dominating the step.
+    """
+    b, s, d = hidden.shape
+    if s % chunk:
+        raise ValueError(f"seq {s} not divisible by xent chunk {chunk}")
+    n = s // chunk
+    h = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, B, chunk, D]
+    lab = labels.reshape(b, n, chunk).swapaxes(0, 1)
+
+    def body(acc, xs):
+        hc, lc = xs
+        if dot_dtype is not None:
+            logits = jnp.dot(
+                hc.astype(dot_dtype), kernel.astype(dot_dtype),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            logits = hc.astype(jnp.float32) @ kernel.astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        return acc + (lse - picked).sum(), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32), (h, lab)
+    )
+    return total / (b * s)
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return (logits.argmax(-1) == labels).mean()
 
@@ -131,6 +186,8 @@ def make_lm_train_step(
     data_axis: str = "dp",
     seq_axis: str | None = "sp",
     donate: bool = True,
+    xent_chunk: int | None = None,
+    xent_dot_dtype: Any = None,
 ):
     """Train step for the transformer: batch over dp, sequence over sp (ring
     attention inside the model). Params are placed by the caller
@@ -138,9 +195,26 @@ def make_lm_train_step(
     NamedSharding pytree matching params, e.g. from sharding_tree_by_rules)
     to pin the tp placement inside the step — updated params are constrained
     to it so drift toward replication is impossible even if the optimizer
-    update would otherwise change placement."""
+    update would otherwise change placement.
+
+    ``xent_chunk`` switches the loss to chunked_lm_xent (exact, but never
+    materializes the [B,S,V] logits — the long-context memory peak);
+    requires seq divisible by the chunk and no sp sharding of the sequence
+    (the chunked scan slices the full sequence)."""
+
+    if xent_chunk is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
+        raise ValueError("xent_chunk is incompatible with sp-sharded sequence")
 
     def loss_fn(params, batch):
+        if xent_chunk is not None:
+            hidden = model.apply(
+                {"params": params}, batch["tokens"], return_hidden=True
+            )
+            head = params["lm_head"]
+            return chunked_lm_xent(
+                hidden, head["kernel"], head.get("bias"),
+                batch["targets"], chunk=xent_chunk, dot_dtype=xent_dot_dtype,
+            )
         logits = model.apply({"params": params}, batch["tokens"])
         return cross_entropy(logits, batch["targets"])
 
