@@ -40,6 +40,22 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def _head_logits(h, kernel, bias, dot_dtype):
+    """One chunk's f32 logits; dot_dtype (e.g. bf16) runs the matmul at that
+    dtype's MXU rate with f32 accumulation. Shared by both chunked losses so
+    their exactness-critical numerics cannot drift apart."""
+    if dot_dtype is not None:
+        logits = jnp.dot(
+            h.astype(dot_dtype), kernel.astype(dot_dtype),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = h.astype(jnp.float32) @ kernel.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
+
+
 def chunked_lm_xent(
     hidden: jax.Array,
     kernel: jax.Array,
@@ -76,15 +92,7 @@ def chunked_lm_xent(
 
     def body(acc, xs):
         hc, lc = xs
-        if dot_dtype is not None:
-            logits = jnp.dot(
-                hc.astype(dot_dtype), kernel.astype(dot_dtype),
-                preferred_element_type=jnp.float32,
-            )
-        else:
-            logits = hc.astype(jnp.float32) @ kernel.astype(jnp.float32)
-        if bias is not None:
-            logits = logits + bias.astype(jnp.float32)
+        logits = _head_logits(hc, kernel, bias, dot_dtype)
         lse = jax.nn.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
         return acc + (lse - picked).sum(), None
@@ -92,6 +100,99 @@ def chunked_lm_xent(
     total, _ = jax.lax.scan(
         jax.checkpoint(body), jnp.zeros((), jnp.float32), (h, lab)
     )
+    return total / (b * s)
+
+
+def sharded_lm_xent(
+    mesh: Mesh,
+    hidden: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    labels: jax.Array,
+    *,
+    chunk: int = 512,
+    data_axis: str = "dp",
+    seq_axis: str = "sp",
+    tp_axis: str = "tp",
+    dot_dtype: Any = None,
+) -> jax.Array:
+    """chunked_lm_xent under SPMD sharding: batch over dp, sequence over sp,
+    vocab over tp (the lm_head kernel's tp split in param_sharding_rules).
+
+    The distributed form of the chunked loss — each device computes partial
+    sums over its local (batch x sequence) tokens and its local vocab shard
+    inside a shard_map; the vocab direction uses the Megatron-style
+    vocab-parallel reduction (global max via pmax, then log of a psum'd
+    sumexp, and the label logit recovered by masking each shard's local
+    vocab range and psum'ing). Exact — same value and gradients as the
+    naive full-logits loss (tests/test_training.py::test_sharded_xent_*).
+
+    ``chunk`` must divide the PER-DEVICE sequence length (seq / sp).
+    Axes absent from the mesh are treated as unsharded.
+    """
+    b, s, _ = hidden.shape
+    names = mesh.axis_names
+    dp = data_axis if data_axis in names else None
+    sp = seq_axis if seq_axis in names else None
+    tp = tp_axis if tp_axis in names else None
+    token_axes = tuple(a for a in (dp, sp) if a)
+
+    def local(h, k, bia, lab):
+        lb, ls, d = h.shape
+        if ls % chunk:
+            raise ValueError(
+                f"per-device seq {ls} not divisible by xent chunk {chunk}"
+            )
+        n = ls // chunk
+        hc = h.reshape(lb, n, chunk, d).swapaxes(0, 1)
+        lc = lab.reshape(lb, n, chunk).swapaxes(0, 1)
+        v_local = k.shape[1]
+        v_start = jax.lax.axis_index(tp) * v_local if tp else 0
+
+        def body(acc, xs):
+            hx, lx = xs
+            logits = _head_logits(hx, k, bia, dot_dtype)
+            # Vocab-parallel logsumexp: max must be global before exp. The
+            # shift is purely for stability (lse is invariant to it), so a
+            # stop_gradient is exact — and it must wrap pmax's INPUT, since
+            # pmax has no differentiation rule (a zero-tangent operand keeps
+            # AD from ever visiting it).
+            lmax = jax.lax.stop_gradient(logits.max(axis=-1))
+            gmax = jax.lax.pmax(lmax, tp) if tp else lmax
+            sumexp = jnp.exp(logits - gmax[..., None]).sum(axis=-1)
+            if tp:
+                sumexp = jax.lax.psum(sumexp, tp)
+            lse = jnp.log(sumexp) + gmax
+            # The label's logit lives on exactly one vocab shard.
+            idx = lx - v_start
+            in_range = (idx >= 0) & (idx < v_local)
+            safe = jnp.clip(idx, 0, v_local - 1)
+            val = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+            picked = jnp.where(in_range, val, 0.0)
+            if tp:
+                picked = jax.lax.psum(picked, tp)
+            return acc + (lse - picked).sum(), None
+
+        total, _ = jax.lax.scan(
+            jax.checkpoint(body), jnp.zeros((), jnp.float32), (hc, lc)
+        )
+        return jax.lax.psum(total, token_axes) if token_axes else total
+
+    if bias is None:
+        fn, in_specs = (
+            lambda h, k, lab: local(h, k, None, lab),
+            (P(dp, sp, None), P(None, tp), P(dp, sp)),
+        )
+        args = (hidden, kernel, labels)
+    else:
+        fn, in_specs = (
+            local,
+            (P(dp, sp, None), P(None, tp), P(tp), P(dp, sp)),
+        )
+        args = (hidden, kernel, bias, labels)
+    total = jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
+    )(*args)
     return total / (b * s)
 
 
@@ -197,13 +298,20 @@ def make_lm_train_step(
     to it so drift toward replication is impossible even if the optimizer
     update would otherwise change placement.
 
-    ``xent_chunk`` switches the loss to chunked_lm_xent (exact, but never
-    materializes the [B,S,V] logits — the long-context memory peak);
-    requires seq divisible by the chunk and no sp sharding of the sequence
-    (the chunked scan slices the full sequence)."""
+    ``xent_chunk`` switches the loss to the chunked cross-entropy (exact,
+    but never materializes the [B,S,V] logits — the long-context memory
+    peak): chunked_lm_xent on an unsharded mesh, sharded_lm_xent (vocab-
+    parallel, sequence-parallel) when the mesh shards sp or tp. The chunk
+    must divide the per-device sequence length."""
 
-    if xent_chunk is not None and seq_axis and mesh.shape.get(seq_axis, 1) > 1:
-        raise ValueError("xent_chunk is incompatible with sp-sharded sequence")
+    # seq_axis=None means the caller opted out of sequence sharding: only
+    # a tp-split head then forces the sharded (vocab-parallel) loss, and
+    # the sequence stays unsharded inside it (sharded_lm_xent treats a
+    # missing axis name as unsharded).
+    sharded_loss = xent_chunk is not None and any(
+        mesh.shape.get(a, 1) > 1
+        for a in ((seq_axis, "tp") if seq_axis else ("tp",))
+    )
 
     def loss_fn(params, batch):
         if xent_chunk is not None:
@@ -211,6 +319,14 @@ def make_lm_train_step(
                 {"params": params}, batch["tokens"], return_hidden=True
             )
             head = params["lm_head"]
+            if sharded_loss:
+                return sharded_lm_xent(
+                    mesh, hidden, head["kernel"], head.get("bias"),
+                    batch["targets"], chunk=xent_chunk,
+                    data_axis=data_axis,
+                    seq_axis=seq_axis if seq_axis else "__unsharded__",
+                    dot_dtype=xent_dot_dtype,
+                )
             return chunked_lm_xent(
                 hidden, head["kernel"], head.get("bias"),
                 batch["targets"], chunk=xent_chunk, dot_dtype=xent_dot_dtype,
