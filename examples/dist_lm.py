@@ -1,0 +1,195 @@
+"""Distributed long-context LM training — the framework's transformer
+workload as an operator-launched job.
+
+The companion to dist_mnist.py for the model-parallel side of the stack
+(the reference has no sharded-execution sample at all — SURVEY.md §2.9;
+its closest analog is the between-graph dist_mnist). One jitted train step
+over a dp x sp x tp mesh spanning every process:
+
+- sp > 1 turns on ring attention (parallel/ring_attention.py) — the
+  sequence is sharded across processes and ppermute streams KV blocks
+  around the ring, so context length scales with the mesh, not the chip.
+- tp > 1 shards attention heads / MLP hidden / vocab (Megatron pairing,
+  models/transformer.py param_sharding_rules).
+- The loss is the chunked cross-entropy (train/steps.py): logits never
+  materialize at [B,S,V]; under sp/tp it is the vocab-parallel
+  sharded_lm_xent.
+- Checkpoint/resume + simulated preemption mirror dist_mnist.py so the
+  ExitCode restart policy can be exercised on the LM path too.
+
+Data is a synthetic next-token task (tokens advance by +1 mod vocab) the
+model must actually learn — the acceptance check fails the replica when
+final loss misses the target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--batch", type=int, default=8, help="GLOBAL batch size")
+    p.add_argument("--seq", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel axis size (ring attention)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel axis size")
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--target-loss", type=float, default=1.0)
+    p.add_argument("--xent-chunk", type=int, default=None,
+                   help="chunked cross-entropy chunk (default: per-device "
+                        "seq / 2)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize blocks (long-context memory)")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-interval", type=int, default=1)
+    p.add_argument("--fail-at-step", type=int, default=None,
+                   help="simulate preemption: exit 138 once at this step")
+    args = p.parse_args(argv)
+    if args.fail_at_step is not None and not args.checkpoint_dir:
+        p.error("--fail-at-step requires --checkpoint-dir")
+
+    from tf_operator_tpu.train import distributed
+
+    topo = distributed.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.train.steps import TrainState, adamw, make_lm_train_step
+
+    devices = jax.devices()
+    n = len(devices)
+    if n % (args.sp * args.tp):
+        raise SystemExit(f"{n} devices not divisible by sp*tp="
+                         f"{args.sp * args.tp}")
+    axes = {"dp": n // (args.sp * args.tp), "sp": args.sp, "tp": args.tp}
+    print(
+        f"dist_lm: process {topo.process_id}/{topo.num_processes}, "
+        f"mesh {axes}", flush=True,
+    )
+    mesh = create_mesh(axes, devices)
+    if args.batch % max(axes["dp"], 1) or args.seq % max(axes["sp"], 1):
+        raise SystemExit(
+            "batch must be a multiple of dp and seq a multiple of sp"
+        )
+    local_seq = args.seq // axes["sp"]
+    if args.xent_chunk is not None:
+        if args.xent_chunk <= 0 or local_seq % args.xent_chunk:
+            raise SystemExit(
+                f"--xent-chunk must divide the per-device seq {local_seq}"
+            )
+        chunk = args.xent_chunk
+    else:
+        chunk = local_seq // 2 if local_seq % 2 == 0 else local_seq
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_layers=args.layers, d_ff=args.d_model * 2,
+        max_seq_len=args.seq, dtype=jnp.float32, mesh=mesh,
+        remat=args.remat,
+    )
+    model = Transformer(cfg)
+    tokens0 = jnp.zeros((args.batch, args.seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+    params = shard_params_by_rules(mesh, params, param_sharding_rules())
+    tx = adamw(args.lr)
+    state = TrainState.create(params, tx)
+    step = make_lm_train_step(
+        model, tx, mesh, donate=False, xent_chunk=chunk
+    )
+
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from tf_operator_tpu.train.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(
+            args.checkpoint_dir, max_to_keep=2,
+            save_interval_steps=args.checkpoint_interval,
+        )
+        state, start_step = ckpt.restore_or_init(state)
+        start_step = max(0, min(start_step, args.steps - 1))
+        if start_step:
+            print(f"dist_lm: resumed from step {start_step}", flush=True)
+
+    # Every process generates the SAME global batch (seeded by step, so
+    # resume continues the stream) and contributes its addressable shards.
+    tok_spec = P("dp" if axes["dp"] > 1 else None,
+                 "sp" if axes["sp"] > 1 else None)
+    sharding = NamedSharding(mesh, tok_spec)
+
+    def batch_at(step_idx: int) -> dict[str, jax.Array]:
+        rng = np.random.default_rng((7, step_idx))
+        start = rng.integers(0, args.vocab, (args.batch, 1))
+        toks = (start + np.arange(args.seq)) % args.vocab  # +1 chain
+        toks = toks.astype(np.int32)
+        targets = np.roll(toks, -1, axis=1)
+
+        def place(x):
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx]
+            )
+
+        return {"tokens": place(toks), "targets": place(targets)}
+
+    t0 = time.perf_counter()
+    metrics = None
+    for i in range(start_step, args.steps):
+        state, metrics = step(state, batch_at(i))
+        if ckpt is not None:
+            ckpt.save(i, state)
+        if (
+            args.fail_at_step is not None
+            and i == args.fail_at_step
+            and start_step == 0
+        ):
+            if ckpt is not None:
+                ckpt.wait()
+            print(f"dist_lm: simulating preemption at step {i}", flush=True)
+            import os as _os
+
+            _os._exit(138)
+        if (i + 1) % 20 == 0 or i == start_step:
+            print(f"dist_lm: step {i+1} loss={float(metrics['loss']):.4f}",
+                  flush=True)
+    if ckpt is not None:
+        ckpt.close()
+    if metrics is None:
+        print("dist_lm: no steps to run", flush=True)
+        return 0
+    loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    steps_run = args.steps - start_step
+    tps = steps_run * args.batch * args.seq / dt
+    print(
+        f"dist_lm: {steps_run} steps in {dt:.1f}s ({tps:.0f} tokens/s, "
+        f"mesh {axes}, ring={cfg.use_ring}, xent_chunk={chunk}), "
+        f"final loss {loss:.4f}", flush=True,
+    )
+    if loss > args.target_loss:
+        print(f"dist_lm: FAILED (loss {loss:.4f} > {args.target_loss})",
+              flush=True)
+        return 1
+    print("dist_lm: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
