@@ -117,6 +117,7 @@ def main(argv: list[str] | None = None) -> int:
 
     ckpt = None
     start_step = 0
+    resumed = False
     if args.checkpoint_dir:
         from tf_operator_tpu.train.checkpoint import CheckpointManager
 
@@ -125,8 +126,12 @@ def main(argv: list[str] | None = None) -> int:
             save_interval_steps=args.checkpoint_interval,
         )
         state, start_step = ckpt.restore_or_init(state)
+        # resumed (not the clamped start_step) gates the preemption sim:
+        # with --steps 1 the clamp forces start_step back to 0, and a
+        # start_step==0 guard would re-fire exit 138 forever.
+        resumed = start_step > 0
         start_step = max(0, min(start_step, args.steps - 1))
-        if start_step:
+        if resumed:
             print(f"dist_lm: resumed from step {start_step}", flush=True)
 
     # Every process generates the SAME global batch (seeded by step, so
@@ -158,7 +163,7 @@ def main(argv: list[str] | None = None) -> int:
         if (
             args.fail_at_step is not None
             and i == args.fail_at_step
-            and start_step == 0
+            and not resumed
         ):
             if ckpt is not None:
                 ckpt.wait()

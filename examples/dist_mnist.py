@@ -75,6 +75,7 @@ def main(argv: list[str] | None = None) -> int:
 
     ckpt = None
     start_step = 0
+    resumed = False
     if args.checkpoint_dir:
         from tf_operator_tpu.train.checkpoint import CheckpointManager
 
@@ -83,11 +84,15 @@ def main(argv: list[str] | None = None) -> int:
             save_interval_steps=args.checkpoint_interval,
         )
         state, start_step = ckpt.restore_or_init(state)
+        # resumed (not the clamped start_step) gates the preemption sim:
+        # with --steps 1 the clamp forces start_step back to 0, and a
+        # start_step==0 guard would re-fire exit 138 forever.
+        resumed = start_step > 0
         # Re-run at least the final step so the loss acceptance check below
         # always executes — a fully-resumed run must not skip straight to
         # success (the previous incarnation may have failed the target).
         start_step = max(0, min(start_step, args.steps - 1))
-        if start_step:
+        if resumed:
             print(f"dist_mnist: resumed from step {start_step}", flush=True)
 
     data = synthetic_mnist(args.batch, seed=topo.process_id)
@@ -107,7 +112,7 @@ def main(argv: list[str] | None = None) -> int:
         if (
             args.fail_at_step is not None
             and i == args.fail_at_step
-            and start_step == 0
+            and not resumed
         ):
             # Simulated preemption: checkpoint is durable, then die with
             # the user-retryable exit code (SIGUSR1 convention, 138) so the

@@ -15,6 +15,7 @@ capability the rebuild adds on top of parity). Sharding design:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Any
 
@@ -51,6 +52,11 @@ class TransformerConfig:
     # chip (the flash kernel already never materializes O(S^2) scores; remat
     # removes the O(n_layers * S * d_model) residual-stream term).
     remat: bool = False
+    # Autoregressive decoding mode: each Attention keeps a KV cache of
+    # max_seq_len in a flax "cache" collection, calls take ONE token per
+    # step, and the position comes from the cache index. Single-device
+    # (mesh is ignored); see ``generate`` for the jitted sampling loop.
+    decode: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -75,7 +81,9 @@ class Attention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if cfg.use_ring:
+        if cfg.decode:
+            out = self._decode_attend(q, k, v)
+        elif cfg.use_ring:
             batch_spec = (cfg.batch_axis,) if cfg.mesh.shape.get(cfg.batch_axis, 1) > 1 else (None,)
             # Heads are tp-sharded by the qkv kernel rule; declaring that to
             # shard_map (the ring body is head-independent) avoids an
@@ -126,6 +134,60 @@ class Attention(nn.Module):
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)
 
+    def _decode_attend(self, q, k, v):
+        """One-token attention against the layer's KV cache.
+
+        The cache is a fixed [B, max_seq_len, H, Dh] buffer of past keys
+        and values (static shapes — the decode loop is jittable/scannable);
+        positions beyond the cache index are masked. HARD precondition:
+        at most max_seq_len total tokens may be decoded — past that,
+        dynamic_update_slice clamps the write index and silently overwrites
+        the last slot (``generate`` enforces the budget up front; callers
+        driving apply() directly must too). Numerics follow
+        reference_attention (f32 scores/softmax, d^-0.5 scale) so decode
+        logits match the training forward exactly
+        (tests/test_training.py::test_decode_matches_full_forward).
+        """
+        cfg = self.cfg
+        b, t, h, dh = q.shape
+        if t != 1:
+            raise ValueError(f"decode takes one token per call, got {t}")
+        cached_k = self.variable(
+            "cache", "cached_key",
+            jnp.zeros, (b, cfg.max_seq_len, h, dh), cfg.dtype,
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            jnp.zeros, (b, cfg.max_seq_len, h, dh), cfg.dtype,
+        )
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            # init() executes this forward once to build the variables; the
+            # cache must come out untouched (index 0, zero buffers), and
+            # one-token self-attention is just v.
+            return v
+        idx = index.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+        )
+        index.value = idx + 1
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, cached_k.value,
+            preferred_element_type=jnp.float32,
+        ) * (dh ** -0.5)
+        valid = jnp.arange(cfg.max_seq_len) <= idx
+        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, cached_v.value.astype(jnp.float32)
+        )
+        return out.astype(cfg.dtype)
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -155,11 +217,24 @@ class Transformer(nn.Module):
     def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed")(tokens)
+        if cfg.decode:
+            # One position counter for the model; every layer's
+            # cache_index advances in lockstep with it (each __call__
+            # touches all layers exactly once) — the same per-layer-counter
+            # convention as flax's canonical decode cache.
+            pidx = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = pidx.value[None, None]
+            if not self.is_initializing():
+                pidx.value = pidx.value + 1
+        else:
+            positions = jnp.arange(tokens.shape[1])[None, :]
         pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos")(
-            jnp.arange(tokens.shape[1])[None, :]
+            positions
         )
         x = x + pos
-        block_cls = nn.remat(Block) if cfg.remat else Block
+        block_cls = nn.remat(Block) if (cfg.remat and not cfg.decode) else Block
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
@@ -172,6 +247,84 @@ class Transformer(nn.Module):
                 head(x[:, :1].astype(jnp.float32))
             return x
         return head(x.astype(jnp.float32))
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Jitted autoregressive generation with a KV cache.
+
+    The whole loop — prompt prefill then ``num_steps`` of sample-and-feed —
+    is two lax.scans inside one jit: static shapes, one compilation, no
+    host round-trips per token (the TPU-native decode shape; a Python
+    token loop would be dispatch-bound). ``temperature=0`` is greedy;
+    otherwise categorical sampling with ``rng``. Returns [B, num_steps]
+    generated tokens. Single-device: the training mesh/ring config is
+    dropped for decoding.
+
+    The inference-path capability the reference delegates to user
+    containers entirely (its operator never runs models); here it
+    completes the LM family alongside the training step.
+    """
+    if prompt.shape[1] + num_steps > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + steps {num_steps} exceeds "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    fn = _generate_fn(cfg, num_steps, float(temperature))
+    return fn(params, prompt, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float):
+    """Build (and cache) the jitted decode loop for one (config, steps,
+    temperature) triple. params/prompt/rng are jit ARGUMENTS, so repeated
+    generate() calls — including with updated params — reuse the same
+    executable instead of re-tracing a fresh closure each time."""
+    from dataclasses import replace
+
+    dcfg = replace(cfg, decode=True, mesh=None, remat=False)
+    model = Transformer(dcfg)
+
+    def token_step(params, cache, tok):
+        logits, updates = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"],
+        )
+        return updates["cache"], logits[:, 0]
+
+    def run(params, prompt, rng):
+        cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+        cache, logits = jax.lax.scan(
+            lambda c, t: token_step(params, c, t), cache,
+            prompt.swapaxes(0, 1),
+        )
+        last_logits = logits[-1]
+
+        def sample(carry, step_rng):
+            cache, logits = carry
+            if temperature > 0:
+                tok = jax.random.categorical(step_rng, logits / temperature)
+            else:
+                tok = logits.argmax(-1)
+            cache, logits = token_step(params, cache, tok.astype(prompt.dtype))
+            return (cache, logits), tok
+
+        (_, _), toks = jax.lax.scan(
+            sample, (cache, last_logits), jax.random.split(rng, num_steps)
+        )
+        return toks.swapaxes(0, 1)
+
+    return jax.jit(run)
 
 
 def param_sharding_rules(tp_axis: str = "tp") -> dict[str, tuple]:
