@@ -55,6 +55,7 @@ ATTN_CONFIGS = ((8192, 4), (65536, 1))  # (seq, batch)
 LM_SIZE = dict(vocab_size=32768, d_model=1024, n_heads=16, n_layers=8,
                d_ff=4096, max_seq_len=8192)
 LM_BATCH, LM_SEQ, LM_FUSED = 2, 8192, 4
+DECODE_BATCH, DECODE_PROMPT, DECODE_STEPS = 8, 128, 128
 
 if os.environ.get("BENCH_SMOKE"):  # structure check on CPU (CI): tiny shapes
     BATCH, FUSED_STEPS, IMAGE_SIZE = 8, 2, 32
@@ -62,6 +63,7 @@ if os.environ.get("BENCH_SMOKE"):  # structure check on CPU (CI): tiny shapes
     LM_SIZE = dict(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
                    d_ff=128, max_seq_len=256)
     LM_BATCH, LM_SEQ, LM_FUSED = 2, 256, 2
+    DECODE_BATCH, DECODE_PROMPT, DECODE_STEPS = 2, 8, 8
 
 # Peak dense bf16 TFLOP/s by device kind (public Cloud TPU specs).
 PEAK_BF16_TFLOPS = {
@@ -73,13 +75,31 @@ PEAK_BF16_TFLOPS = {
     "trillium": 918.0,
 }
 
+# Peak HBM bandwidth GB/s (public specs) — the decode roofline.
+PEAK_HBM_GBPS = {
+    "v4": 1228.0,
+    "v5e": 819.0,
+    "v5 lite": 819.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+    "trillium": 1640.0,
+}
 
-def chip_peak_tflops(device) -> float | None:
+
+def _peak_from_table(device, table: dict[str, float]) -> float | None:
     kind = (getattr(device, "device_kind", "") or "").lower()
-    for key, peak in PEAK_BF16_TFLOPS.items():
+    for key, peak in table.items():
         if key in kind:
             return peak
     return None
+
+
+def chip_peak_tflops(device) -> float | None:
+    return _peak_from_table(device, PEAK_BF16_TFLOPS)
+
+
+def chip_peak_hbm_gbps(device) -> float | None:
+    return _peak_from_table(device, PEAK_HBM_GBPS)
 
 
 def emit(metric: str, value: float, unit: str, vs_baseline: float,
@@ -200,6 +220,61 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
         mfu,
         mfu=mfu,
         params_millions=n_params / 1e6,
+    )
+
+
+def bench_decode(peak_hbm_gbps: float | None) -> None:
+    """Autoregressive KV-cache decoding, bf16 params, greedy.
+
+    Single-token decode is HBM-read-bound: every step re-reads all weights
+    plus the KV cache, so the honest yardstick is achieved bandwidth
+    ((params + kv cache) x steps / time) against the chip's HBM peak —
+    vs_baseline reports that fraction. The cache is sized to the actual
+    token budget (not the training max_seq_len), as a serving path would.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+    )
+
+    B, prompt_len, steps = DECODE_BATCH, DECODE_PROMPT, DECODE_STEPS
+    total_steps = prompt_len + steps  # prefill is also one token per scan
+    cfg_kw = dict(LM_SIZE, max_seq_len=total_steps)
+    cfg = TransformerConfig(dtype=jnp.bfloat16, **cfg_kw)
+    model = Transformer(cfg)
+    prompt = jnp.zeros((B, prompt_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    # Store params in bf16: decode reads every weight per token, and f32
+    # storage would double the traffic just to cast it down for the MXU.
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    # Each step's attention reads the full (static-shape) K and V buffers.
+    kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
+
+    out = generate(cfg, params, prompt, num_steps=steps)  # compile
+    jax.block_until_ready(out)
+    reps = 2
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = generate(cfg, params, prompt, num_steps=steps)
+    int(out[0, -1])  # readback = completion
+    dt = (time.perf_counter() - t0) / reps
+
+    # Prefill iterations run the same one-token step as decode, so the
+    # steady-state rate is B tokens per (dt / total_steps).
+    tokens_per_sec = B * total_steps / dt
+    achieved_gbps = (params_bytes + kv_bytes) * total_steps / dt / 1e9
+    emit(
+        f"lm_decode_tokens_per_sec_bf16_b{B}_1chip",
+        tokens_per_sec,
+        "tokens/sec",
+        achieved_gbps / peak_hbm_gbps if peak_hbm_gbps else 0.0,
+        hbm_gbps=achieved_gbps,
+        params_millions=params_bytes / 2 / 1e6,
     )
 
 
@@ -351,9 +426,14 @@ def main() -> None:
     if os.environ.get("BENCH_ONLY") != "resnet":
         # Secondary metrics must never take down the flagship line: report
         # a failure to stderr and keep going.
-        for section in (bench_flash_attention, bench_transformer_lm):
+        peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
+        for section, arg in (
+            (bench_flash_attention, peak),
+            (bench_transformer_lm, peak),
+            (bench_decode, peak_hbm),
+        ):
             try:
-                section(peak)
+                section(arg)
             except Exception as exc:  # noqa: BLE001
                 print(f"bench: {section.__name__} failed: {exc!r}",
                       file=sys.stderr, flush=True)
