@@ -57,6 +57,14 @@ class TransformerConfig:
     # step, and the position comes from the cache index. Single-device
     # (mesh is ignored); see ``generate`` for the jitted sampling loop.
     decode: bool = False
+    # Mixture-of-Experts: every Nth block (1-indexed from the first) swaps
+    # its dense MLP for a Switch-routed expert MLP (models/moe.py) sharded
+    # over ``ep_axis``. Train with make_lm_train_step(aux_loss_weight=...)
+    # so the load-balancing loss is collected.
+    moe_every_n: int | None = None
+    moe_experts: int = 8
+    moe_capacity_factor: float = 1.25
+    ep_axis: str = "ep"
 
     @property
     def head_dim(self) -> int:
@@ -202,11 +210,23 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
-        x = x + Attention(self.cfg, name="attn")(nn.RMSNorm(dtype=self.cfg.dtype)(x))
-        x = x + MLP(self.cfg, name="mlp")(nn.RMSNorm(dtype=self.cfg.dtype)(x))
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(nn.RMSNorm(dtype=cfg.dtype)(x))
+        if self.use_moe:
+            from tf_operator_tpu.models.moe import MoeConfig, MoeMlp
+
+            mcfg = MoeConfig(
+                n_experts=cfg.moe_experts, d_model=cfg.d_model, d_ff=cfg.d_ff,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                ep_axis=cfg.ep_axis, data_axis=cfg.batch_axis, mesh=cfg.mesh,
+            )
+            x = x + MoeMlp(mcfg, name="moe")(nn.RMSNorm(dtype=cfg.dtype)(x))
+        else:
+            x = x + MLP(cfg, name="mlp")(nn.RMSNorm(dtype=cfg.dtype)(x))
         return x
 
 
@@ -236,7 +256,8 @@ class Transformer(nn.Module):
         x = x + pos
         block_cls = nn.remat(Block) if (cfg.remat and not cfg.decode) else Block
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"block_{i}")(x)
+            use_moe = bool(cfg.moe_every_n) and (i + 1) % cfg.moe_every_n == 0
+            x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
         head = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")
         if return_hidden:

@@ -112,7 +112,7 @@ def sharded_lm_xent(
     *,
     chunk: int = 512,
     data_axis: str = "dp",
-    seq_axis: str = "sp",
+    seq_axis: str | None = "sp",
     tp_axis: str = "tp",
     dot_dtype: Any = None,
 ) -> jax.Array:
@@ -128,7 +128,7 @@ def sharded_lm_xent(
     naive full-logits loss (tests/test_training.py::test_sharded_xent_*).
 
     ``chunk`` must divide the PER-DEVICE sequence length (seq / sp).
-    Axes absent from the mesh are treated as unsharded.
+    Axes absent from the mesh (or passed as None) are treated as unsharded.
     """
     b, s, _ = hidden.shape
     names = mesh.axis_names
@@ -289,6 +289,7 @@ def make_lm_train_step(
     donate: bool = True,
     xent_chunk: int | None = None,
     xent_dot_dtype: Any = None,
+    aux_loss_weight: float = 0.0,
 ):
     """Train step for the transformer: batch over dp, sequence over sp (ring
     attention inside the model). Params are placed by the caller
@@ -302,7 +303,11 @@ def make_lm_train_step(
     but never materializes the [B,S,V] logits — the long-context memory
     peak): chunked_lm_xent on an unsharded mesh, sharded_lm_xent (vocab-
     parallel, sequence-parallel) when the mesh shards sp or tp. The chunk
-    must divide the per-device sequence length."""
+    must divide the per-device sequence length.
+
+    ``aux_loss_weight`` > 0 collects sown auxiliary losses (the MoE
+    load-balancing loss) via mutable=["losses"] and adds them weighted;
+    metrics then carry "aux_loss"."""
 
     # seq_axis=None means the caller opted out of sequence sharding: only
     # a tp-split head then forces the sharded (vocab-parallel) loss, and
@@ -313,38 +318,56 @@ def make_lm_train_step(
         for a in ((seq_axis, "tp") if seq_axis else ("tp",))
     )
 
+    def apply_model(params, tokens, **kw):
+        if aux_loss_weight:
+            from tf_operator_tpu.models.moe import aux_loss_from
+
+            out, col = model.apply(
+                {"params": params}, tokens, mutable=["losses"], **kw
+            )
+            return out, aux_loss_from(col)
+        return model.apply({"params": params}, tokens, **kw), jnp.zeros(())
+
     def loss_fn(params, batch):
         if xent_chunk is not None:
-            hidden = model.apply(
-                {"params": params}, batch["tokens"], return_hidden=True
+            hidden, aux = apply_model(
+                params, batch["tokens"], return_hidden=True
             )
             head = params["lm_head"]
             if sharded_loss:
-                return sharded_lm_xent(
+                xent = sharded_lm_xent(
                     mesh, hidden, head["kernel"], head.get("bias"),
                     batch["targets"], chunk=xent_chunk,
-                    data_axis=data_axis,
-                    seq_axis=seq_axis if seq_axis else "__unsharded__",
+                    data_axis=data_axis, seq_axis=seq_axis,
                     dot_dtype=xent_dot_dtype,
                 )
-            return chunked_lm_xent(
-                hidden, head["kernel"], head.get("bias"),
-                batch["targets"], chunk=xent_chunk, dot_dtype=xent_dot_dtype,
-            )
-        logits = model.apply({"params": params}, batch["tokens"])
-        return cross_entropy(logits, batch["targets"])
+            else:
+                xent = chunked_lm_xent(
+                    hidden, head["kernel"], head.get("bias"),
+                    batch["targets"], chunk=xent_chunk,
+                    dot_dtype=xent_dot_dtype,
+                )
+        else:
+            logits, aux = apply_model(params, batch["tokens"])
+            xent = cross_entropy(logits, batch["targets"])
+        return xent + aux_loss_weight * aux, aux
 
     def step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch
+        )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         if param_shardings is not None:
             new_params = jax.lax.with_sharding_constraint(
                 new_params, param_shardings
             )
+        metrics = {"loss": loss}
+        if aux_loss_weight:
+            metrics["aux_loss"] = aux
         return (
             state.replace(step=state.step + 1, params=new_params, opt_state=new_opt),
-            {"loss": loss},
+            metrics,
         )
 
     seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
