@@ -412,8 +412,37 @@ def bench_resnet(peak_tflops: float | None) -> None:
     )
 
 
+def _arm_watchdog() -> None:
+    """Hard deadline for the whole bench (BENCH_WATCHDOG_S, default 45 min).
+
+    Backend init through a remote-chip tunnel can hang INDEFINITELY when
+    the tunnel is down (observed: jax.devices() blocking >10 min with no
+    exception) — without a watchdog the driver's bench step would never
+    return. os._exit because the hang sits inside native code that
+    ignores normal interpreter shutdown.
+    """
+    import threading
+
+    budget = float(os.environ.get("BENCH_WATCHDOG_S", "2700"))
+    if budget <= 0:  # 0 = watchdog off
+        return
+
+    def fire():
+        print(
+            f"bench: watchdog expired after {budget:.0f}s "
+            "(TPU backend hang?) — aborting",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(budget, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    _arm_watchdog()
     if os.environ.get("BENCH_SMOKE"):
         # Structure check must not touch the TPU plugin (the environment's
         # sitecustomize pins jax_platforms=axon even when the tunnel is

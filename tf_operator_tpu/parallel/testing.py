@@ -3,6 +3,14 @@
 Import `force_cpu_mesh()` BEFORE any other jax usage in a script to get an
 8-device CPU platform regardless of what platform plugin the environment
 pins (needed because some TPU plugin environments re-export JAX_PLATFORMS).
+
+CPU-backend caveat for collective-heavy train loops: the in-process
+communicator can DEADLOCK (rendezvous termination timeout, process abort)
+when many async dispatches of a cross-module-collective executable overlap
+— observed with fsdp all-gather/reduce-scatter programs after ~100
+unserialized steps. Read a metric back (``float(metrics["loss"])``) each
+iteration in CPU-mesh loops; on real TPU the per-device stream serializes
+executions and the issue cannot occur.
 """
 
 from __future__ import annotations

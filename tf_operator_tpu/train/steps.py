@@ -129,13 +129,20 @@ def sharded_lm_xent(
 
     ``chunk`` must divide the PER-DEVICE sequence length (seq / sp).
     Axes absent from the mesh (or passed as None) are treated as unsharded.
+    ``data_axis`` may be a tuple (e.g. ("dp", "fsdp")) when the batch is
+    sharded over several axes.
     """
     b, s, _ = hidden.shape
     names = mesh.axis_names
-    dp = data_axis if data_axis in names else None
+    dp_axes = tuple(
+        a for a in (
+            data_axis if isinstance(data_axis, (tuple, list)) else (data_axis,)
+        ) if a in names
+    )
+    dp = dp_axes if dp_axes else None
     sp = seq_axis if seq_axis in names else None
     tp = tp_axis if tp_axis in names else None
-    token_axes = tuple(a for a in (dp, sp) if a)
+    token_axes = dp_axes + ((sp,) if sp else ())
 
     def local(h, k, bia, lab):
         lb, ls, d = h.shape
@@ -284,7 +291,7 @@ def make_lm_train_step(
     mesh: Mesh,
     *,
     param_shardings: Any = None,
-    data_axis: str = "dp",
+    data_axis: Any = "dp",
     seq_axis: str | None = "sp",
     donate: bool = True,
     xent_chunk: int | None = None,
@@ -371,7 +378,16 @@ def make_lm_train_step(
         )
 
     seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
-    tok_spec = P(data_axis, seq) if mesh.shape.get(data_axis, 1) > 1 else P(None, seq)
+    # Axes absent from the mesh are treated as unsharded (same contract as
+    # sharded_lm_xent) — a NamedSharding would reject unknown axis names.
+    present = tuple(
+        a
+        for a in (data_axis if isinstance(data_axis, (tuple, list)) else (data_axis,))
+        if a in mesh.axis_names
+    )
+    data_size = math.prod(mesh.shape[a] for a in present)
+    batch_axes = present if len(present) != 1 else present[0]
+    tok_spec = P(batch_axes, seq) if data_size > 1 else P(None, seq)
     batch_sharding = {
         "tokens": NamedSharding(mesh, tok_spec),
         "targets": NamedSharding(mesh, tok_spec),
