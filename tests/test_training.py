@@ -336,6 +336,81 @@ def test_lm_step_with_chunked_xent_matches_naive_step():
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6)
 
 
+def test_warmup_cosine_schedule_in_train_step():
+    """The schedule composes with adamw inside jit: LR warms up then
+    decays, checkpoint-free (step lives in optimizer state)."""
+    from tf_operator_tpu.train.steps import warmup_cosine
+
+    sched = warmup_cosine(1e-2, total_steps=100, warmup_steps=10)
+    assert float(sched(0)) == 0.0
+    assert abs(float(sched(10)) - 1e-2) < 1e-8
+    assert float(sched(100)) < float(sched(50)) < float(sched(10))
+    assert abs(float(sched(100)) - 1e-3) < 1e-8  # end fraction 0.1
+
+    mesh = create_mesh({"dp": 1}, jax.devices("cpu")[:1])
+    cfg = TransformerConfig(
+        vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    model = Transformer(cfg)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    tx = adamw(warmup_cosine(5e-3, total_steps=20, warmup_steps=2))
+    state = TrainState.create(params, tx)
+    step = make_lm_train_step(model, tx, mesh, seq_axis=None, donate=False)
+    before = jax.tree.leaves(state.params)[0]
+    state, _ = step(state, {"tokens": toks, "targets": toks})
+    # Step 0 has LR 0 (warmup start): params must be unchanged.
+    np.testing.assert_array_equal(
+        np.asarray(before), np.asarray(jax.tree.leaves(state.params)[0])
+    )
+    state, _ = step(state, {"tokens": toks, "targets": toks})
+    assert not np.array_equal(
+        np.asarray(before), np.asarray(jax.tree.leaves(state.params)[0])
+    )
+
+
+def test_lm_eval_exact_over_uneven_batches():
+    """evaluate_lm pads uneven host batches to one shape, compiles once,
+    and produces EXACTLY the naive full-logits mean token loss."""
+    import optax
+
+    from tf_operator_tpu.train.steps import evaluate_lm, make_lm_eval_step
+
+    mesh = create_mesh({"dp": 4}, jax.devices()[:4])
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=32, dtype=jnp.float32, mesh=None,
+    )
+    model = Transformer(cfg)
+    rng = np.random.default_rng(0)
+    all_toks = jnp.asarray(rng.integers(0, 64, (11, 24)), jnp.int32)
+    all_targs = jnp.asarray(rng.integers(0, 64, (11, 24)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), all_toks[:1])["params"]
+    state = TrainState.create(params, adamw(1e-3))
+
+    # Naive reference: full logits, token-mean over ALL 11 rows.
+    logits = model.apply({"params": params}, all_toks)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits, all_targs
+    )
+    want = float(per_tok.mean())
+
+    eval_step = make_lm_eval_step(model, mesh, xent_chunk=8)
+    # Uneven batch sizes: 4 + 4 + 3 (tail padded), plus an empty one.
+    batches = [
+        {"tokens": all_toks[:4], "targets": all_targs[:4]},
+        {"tokens": all_toks[4:8], "targets": all_targs[4:8]},
+        {"tokens": all_toks[8:8], "targets": all_targs[8:8]},
+        {"tokens": all_toks[8:], "targets": all_targs[8:]},
+    ]
+    out = evaluate_lm(eval_step, state, batches)
+    assert out["tokens"] == 11 * 24
+    np.testing.assert_allclose(out["loss"], want, rtol=1e-5)
+    np.testing.assert_allclose(out["perplexity"], np.exp(want), rtol=1e-4)
+    assert eval_step.compilation_count() in (-1, 1)
+
+
 def test_sharded_xent_matches_naive():
     """Vocab-parallel + sequence-parallel chunked xent over a dp x sp x tp
     mesh == naive full-logits loss, value AND gradients."""

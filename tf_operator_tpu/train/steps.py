@@ -203,6 +203,45 @@ def sharded_lm_xent(
     return total / (b * s)
 
 
+def chunked_lm_xent_sums(
+    hidden: jax.Array,
+    kernel: jax.Array,
+    bias: jax.Array | None,
+    labels: jax.Array,
+    mask: jax.Array,
+    *,
+    chunk: int = 512,
+    dot_dtype: Any = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Masked (loss_sum, token_count) via the chunked scan — the eval-side
+    form of chunked_lm_xent: padding rows carry mask 0, counts are exact
+    int32, and the [B,S,V] logits never materialize."""
+    b, s, d = hidden.shape
+    if s % chunk:
+        raise ValueError(f"seq {s} not divisible by xent chunk {chunk}")
+    n = s // chunk
+    h = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)
+    lab = labels.reshape(b, n, chunk).swapaxes(0, 1)
+    msk = mask.reshape(b, n, chunk).swapaxes(0, 1)
+
+    def body(carry, xs):
+        loss_sum, count = carry
+        hc, lc, mc = xs
+        logits = _head_logits(hc, kernel, bias, dot_dtype)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        loss_sum = loss_sum + ((lse - picked) * mc.astype(jnp.float32)).sum()
+        count = count + (mc > 0).astype(jnp.int32).sum()
+        return (loss_sum, count), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h, lab, msk),
+    )
+    return loss_sum, count
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return (logits.argmax(-1) == labels).mean()
 
@@ -468,27 +507,20 @@ class _EvalStep:
         return int(probe()) if callable(probe) else -1
 
 
-def evaluate(
-    eval_step: "_EvalStep",
-    state: TrainState,
-    batches,
-    *,
-    pad_to: int | None = None,
-) -> dict[str, float]:
-    """Drive an eval step over host batches of ANY sizes (tail batches
-    included): each batch is padded to one fixed size (``pad_to``; default
-    = first non-empty batch rounded up to the data-axis size) with a 0 mask
-    on the padding, so every call hits the same compiled executable and
-    counts/accuracy are exact (loss accumulates in f32). Accumulation stays
-    on device; the host syncs once at the end."""
+def _iter_padded(batches, shard_count: int, pad_to: int | None,
+                 fields: tuple[str, ...], mask_ndim: int):
+    """Shared eval-driver padding: yield (arrays-with-mask, pad_to) for each
+    non-empty host batch, every batch zero-padded to ONE fixed row count
+    (``pad_to``; default = first non-empty batch rounded up to the data-axis
+    size) so a single compiled executable serves the whole stream. The mask
+    (ones over real rows, zeros over padding; shape = leading ``mask_ndim``
+    dims, honoring a caller-provided per-element "mask" field) makes padded
+    rows contribute nothing."""
     import numpy as np
 
-    sharding, shard_count = eval_step.sharding, eval_step.shard_count
-    correct = loss_sum = count = None
     for batch in batches:
-        img = np.asarray(batch["image"])
-        lab = np.asarray(batch["label"])
-        n = img.shape[0]
+        arrs = {f: np.asarray(batch[f]) for f in fields}
+        n = arrs[fields[0]].shape[0]
         if n == 0:
             continue  # an empty shard must not define (or fail) the shape
         if pad_to is None:
@@ -499,18 +531,40 @@ def evaluate(
                 "sets the compiled shape — pass pad_to= explicitly when "
                 "later batches can be larger"
             )
+        mshape = arrs[fields[0]].shape[:mask_ndim]
+        arrs["mask"] = (
+            np.asarray(batch["mask"], np.float32)
+            if "mask" in batch
+            else np.ones(mshape, np.float32)
+        )
         pad = pad_to - n
         if pad:
-            img = np.concatenate([img, np.zeros((pad, *img.shape[1:]), img.dtype)])
-            lab = np.concatenate([lab, np.zeros((pad,), lab.dtype)])
-        mask = np.concatenate(
-            [np.ones(n, np.float32), np.zeros(pad, np.float32)]
-        )
-        dev = {
-            "image": jax.device_put(img, sharding),
-            "label": jax.device_put(lab, sharding),
-            "mask": jax.device_put(mask, sharding),
-        }
+            arrs = {
+                k: np.concatenate(
+                    [v, np.zeros((pad, *v.shape[1:]), v.dtype)]
+                )
+                for k, v in arrs.items()
+            }
+        yield arrs, pad_to
+
+
+def evaluate(
+    eval_step: "_EvalStep",
+    state: TrainState,
+    batches,
+    *,
+    pad_to: int | None = None,
+) -> dict[str, float]:
+    """Drive an eval step over host batches of ANY sizes (tail batches
+    included) — padding via _iter_padded, so every call hits the same
+    compiled executable and counts/accuracy are exact (loss accumulates in
+    f32). Accumulation stays on device; the host syncs once at the end."""
+    sharding, shard_count = eval_step.sharding, eval_step.shard_count
+    correct = loss_sum = count = None
+    for arrs, pad_to in _iter_padded(
+        batches, shard_count, pad_to, ("image", "label"), mask_ndim=1
+    ):
+        dev = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
         m = eval_step(state, dev)  # async: dispatch overlaps host prep
         if correct is None:
             correct, loss_sum, count = m["correct"], m["loss_sum"], m["count"]
@@ -526,6 +580,84 @@ def evaluate(
         "loss": float(loss_sum) / total,
         "count": total,
     }
+
+
+def make_lm_eval_step(
+    model: Any,
+    mesh: Mesh,
+    *,
+    data_axis: Any = "dp",
+    xent_chunk: int = 512,
+):
+    """Jitted LM eval step (the Evaluator-role flow for the transformer):
+    batch {tokens, targets, mask} sharded over the data axis, returns
+    MASKED sums (loss_sum f32, count int32) so ``evaluate_lm`` can pad
+    every batch to one fixed shape — exact perplexity, one compilation,
+    and the [B,S,V] logits never materialize (chunked scan)."""
+
+    def step(state: TrainState, batch):
+        hidden = model.apply(
+            {"params": state.params}, batch["tokens"], return_hidden=True
+        )
+        head = state.params["lm_head"]
+        seq = batch["tokens"].shape[1]
+        # Largest divisor of the (static) sequence length <= xent_chunk, so
+        # any sequence length works without caller-side chunk math.
+        chunk = next(
+            c for c in range(min(xent_chunk, seq), 0, -1) if seq % c == 0
+        )
+        loss_sum, count = chunked_lm_xent_sums(
+            hidden, head["kernel"], head.get("bias"),
+            batch["targets"], batch["mask"], chunk=chunk,
+        )
+        return {"loss_sum": loss_sum, "count": count}
+
+    # Absent-axis-unsharded contract (as make_lm_train_step): NamedSharding
+    # rejects axis names the mesh doesn't have.
+    axes = tuple(
+        a
+        for a in ((data_axis,) if isinstance(data_axis, str) else tuple(data_axis))
+        if a in mesh.axis_names
+    )
+    spec_axes = axes if len(axes) != 1 else axes[0]
+    sharded = NamedSharding(mesh, P(spec_axes) if axes else P())
+    batch_sharding = {"tokens": sharded, "targets": sharded, "mask": sharded}
+    replicated = NamedSharding(mesh, P())
+    fn = jax.jit(
+        step, in_shardings=(None, batch_sharding), out_shardings=replicated
+    )
+    return _EvalStep(
+        fn, sharded, math.prod(mesh.shape[a] for a in axes) if axes else 1
+    )
+
+
+def evaluate_lm(
+    eval_step: "_EvalStep",
+    state: TrainState,
+    batches,
+    *,
+    pad_to: int | None = None,
+) -> dict[str, float]:
+    """Drive an LM eval step over host batches of any row counts — padding
+    via _iter_padded; returns mean token loss, perplexity, and the exact
+    token count. The f32 loss accumulates on device (one sync at the end);
+    the TOKEN count accumulates host-side as a Python int from the masks —
+    a device int32 would silently wrap past 2^31 tokens, routine corpus
+    scale for perplexity eval."""
+    sharding, shard_count = eval_step.sharding, eval_step.shard_count
+    loss_sum = None
+    tokens = 0
+    for arrs, pad_to in _iter_padded(
+        batches, shard_count, pad_to, ("tokens", "targets"), mask_ndim=2
+    ):
+        tokens += int((arrs["mask"] > 0).sum())
+        dev = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
+        m = eval_step(state, dev)  # async: dispatch overlaps host prep
+        loss_sum = m["loss_sum"] if loss_sum is None else loss_sum + m["loss_sum"]
+    if loss_sum is None or tokens == 0:
+        raise ValueError("evaluate_lm() got no non-empty batches")
+    mean = float(loss_sum) / tokens
+    return {"loss": mean, "perplexity": math.exp(mean), "tokens": tokens}
 
 
 def fuse_steps(step_fn, num_steps: int, *, scan_batches: bool = False,
@@ -569,5 +701,29 @@ def sgd_momentum(lr: float = 0.1, momentum: float = 0.9, nesterov: bool = True):
     return optax.sgd(lr, momentum=momentum, nesterov=nesterov)
 
 
-def adamw(lr: float = 3e-4, weight_decay: float = 0.01):
+def adamw(lr: Any = 3e-4, weight_decay: float = 0.01):
+    """AdamW; ``lr`` may be a float or an optax schedule (warmup_cosine)."""
     return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def warmup_cosine(
+    peak_lr: float,
+    total_steps: int,
+    *,
+    warmup_steps: int | None = None,
+    end_lr_fraction: float = 0.1,
+):
+    """Linear warmup -> cosine decay, the standard large-batch TPU recipe
+    (jit-compatible: a pure function of the step counter, so the schedule
+    lives INSIDE the compiled update — no host-side LR bookkeeping, and it
+    survives checkpoint/resume for free because optax keeps the step in the
+    optimizer state)."""
+    if warmup_steps is None:
+        warmup_steps = max(1, total_steps // 20)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=total_steps,
+        end_value=peak_lr * end_lr_fraction,
+    )
