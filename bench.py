@@ -450,23 +450,39 @@ def main() -> None:
         from tf_operator_tpu.parallel.testing import force_cpu_mesh
 
         force_cpu_mesh(1)
+    import contextlib
+
     import jax
+
     peak = chip_peak_tflops(jax.devices()[0])
-    if os.environ.get("BENCH_ONLY") != "resnet":
-        # Secondary metrics must never take down the flagship line: report
-        # a failure to stderr and keep going.
-        peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
-        for section, arg in (
-            (bench_flash_attention, peak),
-            (bench_transformer_lm, peak),
-            (bench_decode, peak_hbm),
-        ):
-            try:
-                section(arg)
-            except Exception as exc:  # noqa: BLE001
-                print(f"bench: {section.__name__} failed: {exc!r}",
-                      file=sys.stderr, flush=True)
-    bench_resnet(peak)
+    # BENCH_PROFILE=<dir>: capture a jax/XLA profiler trace of every
+    # section (open with xprof/tensorboard) — the tool for attributing a
+    # roofline gap between compute, HBM, and host/transfer time.
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    ctx = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        if os.environ.get("BENCH_ONLY") != "resnet":
+            # Secondary metrics must never take down the flagship line:
+            # report a failure to stderr and keep going.
+            peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
+            for section, arg in (
+                (bench_flash_attention, peak),
+                (bench_transformer_lm, peak),
+                (bench_decode, peak_hbm),
+            ):
+                try:
+                    section(arg)
+                except Exception as exc:  # noqa: BLE001
+                    print(f"bench: {section.__name__} failed: {exc!r}",
+                          file=sys.stderr, flush=True)
+        bench_resnet(peak)
+    if profile_dir:
+        print(f"bench: profile written to {profile_dir}",
+              file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

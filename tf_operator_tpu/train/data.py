@@ -142,6 +142,59 @@ def _record_batches(
         pipe.close()
 
 
+def token_dataset(
+    path: str,
+    seq_len: int,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    shuffle: bool = True,
+    loop: bool = True,
+    prefetch: int = 4,
+    threads: int = 2,
+    engine: str = "auto",
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stream {tokens, targets} LM batches from a binary token-record file.
+
+    Layout: one fixed-size record per training sequence — (seq_len + 1)
+    int32 token ids; tokens = rec[:-1], targets = rec[1:] (next-token
+    objective). IO, shuffling and prefetch ride the same native C++
+    pipeline as the image path (native/record_pipeline.cc), so the LM
+    input side is also off the GIL. Multi-host: give each process its own
+    shard file (write_token_records on a per-host slice) — the same
+    per-host-input contract as shard_batch's multi-process path.
+    """
+    rec_bytes = (seq_len + 1) * 4
+
+    def gen() -> Iterator[dict[str, np.ndarray]]:
+        # Pipeline construction stays INSIDE the generator: a generator
+        # that is never started never runs its finally, so eager
+        # construction would leak prefetch threads + the fd.
+        from tf_operator_tpu.native.pipeline import RecordPipeline
+
+        pipe = RecordPipeline(
+            path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
+            seed=seed, shuffle=shuffle, loop=loop, engine=engine,
+        )
+        try:
+            for raw in pipe:
+                seqs = raw.copy().view(np.int32).reshape(len(raw), seq_len + 1)
+                yield {"tokens": seqs[:, :-1], "targets": seqs[:, 1:]}
+        finally:
+            pipe.close()
+
+    return gen()
+
+
+def write_token_records(path: str, seqs: np.ndarray) -> int:
+    """Write [N, seq_len+1] int32 token sequences as the records
+    token_dataset reads. Returns the record size in bytes."""
+    seqs = np.ascontiguousarray(seqs, dtype=np.int32)
+    if seqs.ndim != 2:
+        raise ValueError(f"expected [N, seq_len+1] tokens, got {seqs.shape}")
+    return write_example_records(path, seqs)
+
+
 def write_example_records(
     path: str, features: np.ndarray, labels: np.ndarray | None = None
 ) -> int:

@@ -20,6 +20,21 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 
+def _data_axis_sharding(mesh: Mesh, data_axis: Any) -> tuple[NamedSharding, int]:
+    """(batch NamedSharding, shard count) for a str-or-tuple data axis,
+    with axes absent from the mesh treated as unsharded — the shared
+    absent-axis contract of the train/eval step builders (NamedSharding
+    rejects unknown axis names)."""
+    axes = tuple(
+        a
+        for a in ((data_axis,) if isinstance(data_axis, str) else tuple(data_axis))
+        if a in mesh.axis_names
+    )
+    spec_axes = axes if len(axes) != 1 else axes[0]
+    sharding = NamedSharding(mesh, P(spec_axes) if axes else P())
+    return sharding, math.prod(mesh.shape[a] for a in axes) if axes else 1
+
+
 class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
@@ -418,15 +433,10 @@ def make_lm_train_step(
 
     seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
     # Axes absent from the mesh are treated as unsharded (same contract as
-    # sharded_lm_xent) — a NamedSharding would reject unknown axis names.
-    present = tuple(
-        a
-        for a in (data_axis if isinstance(data_axis, (tuple, list)) else (data_axis,))
-        if a in mesh.axis_names
-    )
-    data_size = math.prod(mesh.shape[a] for a in present)
-    batch_axes = present if len(present) != 1 else present[0]
-    tok_spec = P(batch_axes, seq) if data_size > 1 else P(None, seq)
+    # sharded_lm_xent) — _data_axis_sharding owns the filtering.
+    row_sharding, data_size = _data_axis_sharding(mesh, data_axis)
+    batch_axes = row_sharding.spec[0] if data_size > 1 else None
+    tok_spec = P(batch_axes, seq)
     batch_sharding = {
         "tokens": NamedSharding(mesh, tok_spec),
         "targets": NamedSharding(mesh, tok_spec),
@@ -475,8 +485,7 @@ def make_classifier_eval_step(
             "count": (mask > 0).astype(jnp.int32).sum(),
         }
 
-    axes = (data_axis,) if isinstance(data_axis, str) else tuple(data_axis)
-    sharded = NamedSharding(mesh, P(data_axis))
+    sharded, shard_count = _data_axis_sharding(mesh, data_axis)
     batch_sharding = {"image": sharded, "label": sharded, "mask": sharded}
     replicated = NamedSharding(mesh, P())
     fn = jax.jit(
@@ -484,9 +493,7 @@ def make_classifier_eval_step(
         in_shardings=(replicated, batch_sharding),
         out_shardings=replicated,
     )
-    return _EvalStep(
-        fn, sharded, math.prod(mesh.shape.get(a, 1) for a in axes)
-    )
+    return _EvalStep(fn, sharded, shard_count)
 
 
 class _EvalStep:
@@ -601,34 +608,30 @@ def make_lm_eval_step(
         )
         head = state.params["lm_head"]
         seq = batch["tokens"].shape[1]
-        # Largest divisor of the (static) sequence length <= xent_chunk, so
-        # any sequence length works without caller-side chunk math.
+        # Largest divisor of the (static) sequence length <= xent_chunk;
+        # when no useful divisor exists (prime/odd lengths would degenerate
+        # to chunk=1 — an S-iteration scan of [B,1,V] matmuls), one full
+        # chunk is better: correct either way, and eval batches are small.
         chunk = next(
             c for c in range(min(xent_chunk, seq), 0, -1) if seq % c == 0
         )
-        loss_sum, count = chunked_lm_xent_sums(
+        if chunk < min(32, seq):
+            chunk = seq
+        # The device count is unused here — evaluate_lm counts tokens
+        # host-side (a device int32 would wrap past 2^31 tokens).
+        loss_sum, _ = chunked_lm_xent_sums(
             hidden, head["kernel"], head.get("bias"),
             batch["targets"], batch["mask"], chunk=chunk,
         )
-        return {"loss_sum": loss_sum, "count": count}
+        return {"loss_sum": loss_sum}
 
-    # Absent-axis-unsharded contract (as make_lm_train_step): NamedSharding
-    # rejects axis names the mesh doesn't have.
-    axes = tuple(
-        a
-        for a in ((data_axis,) if isinstance(data_axis, str) else tuple(data_axis))
-        if a in mesh.axis_names
-    )
-    spec_axes = axes if len(axes) != 1 else axes[0]
-    sharded = NamedSharding(mesh, P(spec_axes) if axes else P())
+    sharded, shard_count = _data_axis_sharding(mesh, data_axis)
     batch_sharding = {"tokens": sharded, "targets": sharded, "mask": sharded}
     replicated = NamedSharding(mesh, P())
     fn = jax.jit(
         step, in_shardings=(None, batch_sharding), out_shardings=replicated
     )
-    return _EvalStep(
-        fn, sharded, math.prod(mesh.shape[a] for a in axes) if axes else 1
-    )
+    return _EvalStep(fn, sharded, shard_count)
 
 
 def evaluate_lm(
