@@ -19,6 +19,74 @@ import sys
 import time
 
 
+def run_evaluator(args) -> int:
+    """Follow the trainer's checkpoints: evaluate every new step on
+    held-out data, exit 0 once the final step is evaluated."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.data import synthetic_mnist
+    from tf_operator_tpu.train.steps import (
+        TrainState,
+        evaluate,
+        make_classifier_eval_step,
+        sgd_momentum,
+    )
+
+    if not args.checkpoint_dir:
+        print("dist_mnist eval: --checkpoint-dir is required", flush=True)
+        return 2
+    devices = jax.devices()
+    mesh = create_mesh({"dp": len(devices)}, devices)
+    model = MnistCNN()
+    x0 = jnp.zeros((8, 28, 28, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x0, train=True)
+    template = TrainState.create(variables["params"], sgd_momentum(args.lr))
+    eval_step = make_classifier_eval_step(model, mesh, has_batch_stats=False)
+    heldout_stream = synthetic_mnist(args.batch, seed=10_000)
+    heldout = [next(heldout_stream) for _ in range(4)]
+
+    ckpt = CheckpointManager(args.checkpoint_dir, max_to_keep=2)
+    last = -1
+    deadline = time.monotonic() + args.eval_timeout
+    while True:
+        try:
+            ckpt.reload()  # see the TRAINER's writes (orbax caches steps)
+            latest = ckpt.latest_step()
+        except Exception:
+            latest = None
+        step_done = -1 if latest is None else int(latest)
+        if step_done > last:
+            try:
+                # Restore ONLY when a new step exists — a full restore per
+                # 300ms poll would be continuous redundant disk IO.
+                state = ckpt.restore(step_done, template)
+            except Exception:  # racing the trainer's save/GC: retry
+                time.sleep(0.3)
+                continue
+            m = evaluate(eval_step, state, iter(heldout))
+            print(
+                f"dist_mnist eval: step {step_done} "
+                f"accuracy={m['accuracy']:.3f} loss={m['loss']:.4f}",
+                flush=True,
+            )
+            last = step_done
+            deadline = time.monotonic() + args.eval_timeout
+            if step_done >= args.steps - 1:
+                print("dist_mnist eval: DONE", flush=True)
+                return 0
+        if time.monotonic() > deadline:
+            print(
+                f"dist_mnist eval: no new checkpoint in {args.eval_timeout}s",
+                flush=True,
+            )
+            return 1
+        time.sleep(0.3)
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--steps", type=int, default=60)
@@ -34,6 +102,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--fail-at-step", type=int, default=None,
                    help="simulate preemption: first incarnation exits 138 "
                         "(user-retryable) at this step after checkpointing")
+    p.add_argument("--eval-timeout", type=float, default=120.0,
+                   help="evaluator role: exit 1 after this long without a "
+                        "new checkpoint")
     args = p.parse_args(argv)
     if args.fail_at_step is not None and not args.checkpoint_dir:
         # Without a checkpoint every incarnation restarts at step 0, hits
@@ -43,6 +114,12 @@ def main(argv: list[str] | None = None) -> int:
     from tf_operator_tpu.train import distributed
 
     topo = distributed.initialize()
+    if topo.role == "evaluator":
+        # Evaluator replica: excluded from the training rendezvous by the
+        # operator (cluster_spec evaluator exclusion); follows the
+        # trainer's checkpoints and evaluates each one on held-out data —
+        # the reference's chief/evaluator split, workload-side.
+        return run_evaluator(args)
 
     import jax
     import jax.numpy as jnp
@@ -108,7 +185,9 @@ def main(argv: list[str] | None = None) -> int:
         batch = shard_batch(mesh, next(data))
         state, metrics = step(state, batch)
         if ckpt is not None:
-            ckpt.save(i, state)
+            # Force the FINAL step past save_interval_steps: a follower
+            # evaluator's completion condition is a checkpoint at steps-1.
+            ckpt.save(i, state, force=(i == args.steps - 1))
         if (
             args.fail_at_step is not None
             and i == args.fail_at_step

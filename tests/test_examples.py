@@ -119,6 +119,52 @@ def test_dist_mnist_two_process_training(operator):
             pass
 
 
+def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
+    """Worker + Evaluator job: the worker trains and checkpoints; the
+    evaluator replica (excluded from the rendezvous, role from TF_CONFIG)
+    follows the checkpoints, evaluates each on held-out data, and exits 0
+    after evaluating the final step — the reference's chief/evaluator
+    split running end-to-end through the operator."""
+    import time as _time
+
+    ckpt_dir = str(tmp_path / "eval-ckpt")
+    job = example_job(
+        "mnisteval", "dist_mnist.py", workers=1,
+        extra_args=[
+            "--steps", "15", "--batch", "64", "--target-loss", "5.0",
+            "--checkpoint-dir", ckpt_dir,
+        ],
+    )
+    worker = job["spec"]["replicaSpecs"]["Worker"]
+    job["spec"]["replicaSpecs"]["Evaluator"] = {
+        "replicas": 1,
+        "template": worker["template"],
+    }
+    # Keep pods after success so the evaluator can finish + its logs stay.
+    job["spec"]["cleanPodPolicy"] = "None"
+    cli = TPUJobClient(RestClusterClient(operator))
+    cli.create(job)
+    try:
+        got = cli.wait_for_job("default", "mnisteval", timeout=420)
+        conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
+        assert "Succeeded" in conds, conds
+        deadline = _time.monotonic() + 240
+        logs = ""
+        while _time.monotonic() < deadline:
+            logs = job_logs(cli, "mnisteval")
+            if "dist_mnist eval: DONE" in logs:
+                break
+            _time.sleep(1.0)
+        assert "dist_mnist eval: DONE" in logs, logs
+        assert "dist_mnist eval: step 14 " in logs, logs
+        assert "dist_mnist: OK" in logs, logs
+    finally:
+        try:
+            cli.delete("default", "mnisteval")
+        except Exception:
+            pass
+
+
 def test_dist_lm_two_process_ring_attention(operator):
     """2-process long-context LM: the sequence is sharded ACROSS PROCESSES
     (sp=2, one CPU device each), so every attention layer streams KV blocks

@@ -236,6 +236,27 @@ class TestDistributedEnv:
         assert not topo.is_distributed
         assert distributed.initialize(topo) is topo  # no-op, no crash
 
+    def test_evaluator_role_is_standalone(self):
+        """An evaluator pod must NEVER join the worker rendezvous, no
+        matter how many workers the cluster map lists (the operator
+        excludes evaluators from the cluster — reference parity)."""
+        env = {
+            "TF_CONFIG": '{"cluster": {"worker": ["w0:2222", "w1:2222"]},'
+                         ' "task": {"type": "evaluator", "index": 0}}'
+        }
+        topo = distributed.from_env(env)
+        assert topo.role == "evaluator"
+        assert not topo.is_distributed
+        assert topo.num_processes == 1
+        assert topo.coordinator_address is None
+        # And role survives alongside the TPU env contract.
+        worker = distributed.from_env(
+            {"TPU_WORKER_ID": "1", "TPU_NUM_PROCESSES": "2",
+             "TF_CONFIG": '{"task": {"type": "chief", "index": 0}}'}
+        )
+        assert worker.role == "chief"
+        assert worker.process_id == 1  # TPU env wins for identity
+
 
 def test_eval_step_exact_over_uneven_batches():
     """The Evaluator-side step: inference mode, exact aggregate metrics

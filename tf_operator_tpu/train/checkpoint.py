@@ -54,6 +54,12 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def reload(self) -> None:
+        """Re-read the directory: orbax caches the step list, so a FOLLOWER
+        process (e.g. an evaluator polling a trainer's checkpoints) must
+        reload before latest_step/restore sees externally-written steps."""
+        self._mgr.reload()
+
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Queue an async save of the state pytree at ``step``."""
         import orbax.checkpoint as ocp

@@ -164,24 +164,16 @@ def token_dataset(
     shard file (write_token_records on a per-host slice) — the same
     per-host-input contract as shard_batch's multi-process path.
     """
-    rec_bytes = (seq_len + 1) * 4
+    base = record_dataset(
+        path, (seq_len + 1,), np.int32, batch_size, label_dtype=None,
+        seed=seed, shuffle=shuffle, loop=loop, prefetch=prefetch,
+        threads=threads, engine=engine,
+    )
 
     def gen() -> Iterator[dict[str, np.ndarray]]:
-        # Pipeline construction stays INSIDE the generator: a generator
-        # that is never started never runs its finally, so eager
-        # construction would leak prefetch threads + the fd.
-        from tf_operator_tpu.native.pipeline import RecordPipeline
-
-        pipe = RecordPipeline(
-            path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
-            seed=seed, shuffle=shuffle, loop=loop, engine=engine,
-        )
-        try:
-            for raw in pipe:
-                seqs = raw.copy().view(np.int32).reshape(len(raw), seq_len + 1)
-                yield {"tokens": seqs[:, :-1], "targets": seqs[:, 1:]}
-        finally:
-            pipe.close()
+        for batch in base:  # record_dataset owns the pipeline lifecycle
+            seqs = batch["image"]
+            yield {"tokens": seqs[:, :-1], "targets": seqs[:, 1:]}
 
     return gen()
 

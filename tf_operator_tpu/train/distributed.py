@@ -25,6 +25,10 @@ class ProcessTopology:
     accelerator_type: str | None
     topology: str | None
     worker_hostnames: list[str]
+    # The replica role from TF_CONFIG task.type ("worker", "chief", "ps",
+    # "evaluator", ...). Role-aware workloads branch on this — the
+    # reference's chief/evaluator semantics (SURVEY §2.9).
+    role: str = "worker"
 
     @property
     def is_distributed(self) -> bool:
@@ -33,21 +37,41 @@ class ProcessTopology:
 
 def from_env(env: dict[str, str] | None = None) -> ProcessTopology:
     """Parse the injected contract; fall back to TF_CONFIG task info so plain
-    TF-style pods (no TPU slice) also resolve their identity."""
+    TF-style pods (no TPU slice) also resolve their identity.
+
+    Evaluators never join the TRAINING rendezvous: the operator excludes
+    them from the cluster map (controller/cluster_spec.py:58-62, the
+    reference's evaluator exclusion), so TF_CONFIG-derived identity is
+    neutralized for them (standalone: num_processes=1, no coordinator) —
+    without this, a multi-worker job's evaluator would wrongly claim
+    worker 0's rendezvous slot. TPU slice env still wins: a multi-host
+    evaluator slice has its OWN rendezvous and must initialize it."""
     env = dict(os.environ if env is None else env)
     coord = env.get(constants.ENV_COORDINATOR_ADDRESS)
     worker_id = env.get(constants.ENV_TPU_WORKER_ID)
     num = env.get(constants.ENV_NUM_PROCESSES)
+    role = "worker"
 
-    if worker_id is None and constants.ENV_TF_CONFIG in env:
+    if constants.ENV_TF_CONFIG in env:
         try:
             tf_config = json.loads(env[constants.ENV_TF_CONFIG])
-            worker_id = str(tf_config.get("task", {}).get("index", 0))
-            cluster = tf_config.get("cluster", {})
-            workers = cluster.get("worker", [])
-            num = num or str(len(workers) or 1)
-            if coord is None and workers:
-                coord = workers[0]
+            task = tf_config.get("task", {})
+            role = str(task.get("type", role)) or role
+            if worker_id is None:
+                if role == "evaluator":
+                    # Only neutralize TF_CONFIG-DERIVED identity: an
+                    # evaluator must not claim a worker's rendezvous slot
+                    # from the cluster map. TPU slice env (above) still
+                    # wins — a multi-host evaluator slice has its own
+                    # rendezvous and must initialize it.
+                    coord, worker_id, num = None, "0", "1"
+                else:
+                    worker_id = str(task.get("index", 0))
+                    cluster = tf_config.get("cluster", {})
+                    workers = cluster.get("worker", [])
+                    num = num or str(len(workers) or 1)
+                    if coord is None and workers:
+                        coord = workers[0]
         except (ValueError, KeyError):
             pass
 
@@ -61,6 +85,7 @@ def from_env(env: dict[str, str] | None = None) -> ProcessTopology:
         accelerator_type=env.get(constants.ENV_TPU_ACCELERATOR_TYPE),
         topology=env.get(constants.ENV_TPU_TOPOLOGY),
         worker_hostnames=hostnames,
+        role=role,
     )
 
 

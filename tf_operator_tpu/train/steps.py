@@ -615,7 +615,10 @@ def make_lm_eval_step(
         chunk = next(
             c for c in range(min(xent_chunk, seq), 0, -1) if seq % c == 0
         )
-        if chunk < min(32, seq):
+        # Rescue only DEGENERATE divisors (prime/odd lengths): never
+        # override an explicitly small xent_chunk — that's the caller's
+        # memory bound.
+        if chunk < min(32, xent_chunk):
             chunk = seq
         # The device count is unused here — evaluate_lm counts tokens
         # host-side (a device int32 would wrap past 2^31 tokens).
