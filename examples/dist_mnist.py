@@ -59,15 +59,19 @@ def run_evaluator(args) -> int:
         except Exception:
             latest = None
         step_done = -1 if latest is None else int(latest)
+        restored = None
         if step_done > last:
             try:
                 # Restore ONLY when a new step exists — a full restore per
                 # 300ms poll would be continuous redundant disk IO.
-                state = ckpt.restore(step_done, template)
-            except Exception:  # racing the trainer's save/GC: retry
-                time.sleep(0.3)
-                continue
-            m = evaluate(eval_step, state, iter(heldout))
+                restored = ckpt.restore(step_done, template)
+            except Exception:
+                # Racing the trainer's save/GC: retry, but FALL THROUGH to
+                # the deadline check — a persistently corrupt checkpoint
+                # must end in exit 1, not an infinite poll loop.
+                restored = None
+        if restored is not None:
+            m = evaluate(eval_step, restored, iter(heldout))
             print(
                 f"dist_mnist eval: step {step_done} "
                 f"accuracy={m['accuracy']:.3f} loss={m['loss']:.4f}",

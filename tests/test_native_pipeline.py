@@ -122,6 +122,52 @@ def test_python_engine_surfaces_producer_errors(tmp_path):
     p.close()
 
 
+@pytest.mark.parametrize("engine", ["native", "python"])
+def test_shards_are_disjoint_and_equal_sized(record_file, engine):
+    """Multi-host sharding: with shuffle on, the shards of one epoch are
+    disjoint and ALL exactly floor(n/num_shards) records (lockstep hosts;
+    the <num_shards remainder is dropped and re-dealt next epoch)."""
+    path, data = record_file
+    num_shards = 3
+    per_shard = [
+        _run(path, engine, shard_id=s, num_shards=num_shards)
+        for s in range(num_shards)
+    ]
+    ids = [set(rows[:, 0].tolist()) for rows in per_shard]
+    per = RECORDS // num_shards
+    assert all(len(rows) == per for rows in per_shard), [
+        len(r) for r in per_shard
+    ]
+    assert len(set().union(*ids)) == per * num_shards  # disjoint
+    # Native and python engines deal identical shards.
+    other = "python" if engine == "native" else "native"
+    np.testing.assert_array_equal(
+        per_shard[1], _run(path, other, shard_id=1, num_shards=num_shards)
+    )
+
+    # Looping re-deals: epoch 2's shard-0 differs from epoch 1's (shuffle).
+    with RecordPipeline(
+        path, REC_BYTES, 4, engine=engine, seed=7, shuffle=True, loop=True,
+        shard_id=0, num_shards=num_shards,
+    ) as p:
+        it = iter(p)
+        n_epoch = len(per_shard[0])
+        epoch1, epoch2 = [], []
+        while len(epoch1) < n_epoch:
+            epoch1.extend(next(it)[:, 0].tolist())
+        while len(epoch2) < n_epoch:
+            epoch2.extend(next(it)[:, 0].tolist())
+    assert sorted(epoch1) != sorted(epoch2) or epoch1 != epoch2
+
+
+def test_shard_validation(record_file):
+    path, _ = record_file
+    with pytest.raises(ValueError):
+        RecordPipeline(path, REC_BYTES, 4, shard_id=3, num_shards=3)
+    with pytest.raises(ValueError):
+        RecordPipeline(path, REC_BYTES, 4, shard_id=0, num_shards=0)
+
+
 def test_token_dataset_roundtrip_and_next_token_alignment(tmp_path):
     """token_dataset streams LM records through the pipeline: every yielded
     (tokens, targets) pair is the stored sequence split at the next-token

@@ -37,28 +37,39 @@ def _splitmix64_stream(seed: int) -> Iterator[int]:
 
 
 def epoch_order(num_records: int, seed: int, epoch: int,
-                shuffle: bool) -> np.ndarray:
+                shuffle: bool, shard_id: int = 0,
+                num_shards: int = 1) -> np.ndarray:
     """The record order for one epoch — shared by both engines (and the
-    oracle the tests check the native engine against)."""
+    oracle the tests check the native engine against). With sharding,
+    every shard computes the SAME global order and takes its strided
+    slice TRUNCATED to the common floor(n / num_shards) length: shards
+    are disjoint and all exactly the same size (lockstep hosts see the
+    same batch count and sizes — the multi-process shard_batch contract);
+    the <num_shards remainder records of an epoch are dropped and
+    re-dealt by the next epoch's shuffle, so nothing is systematically
+    lost."""
     order = np.arange(num_records, dtype=np.uint64)
     if shuffle and num_records > 1:
         rng = _splitmix64_stream(seed * 1000003 + epoch)
         for i in range(num_records - 1, 0, -1):
             j = next(rng) % (i + 1)
             order[i], order[j] = order[j], order[i]
+    if num_shards > 1:
+        order = order[shard_id::num_shards][: num_records // num_shards]
     return order
 
 
 class _NativeEngine:
     def __init__(self, path: str, record_bytes: int, batch: int,
                  prefetch: int, threads: int, seed: int,
-                 shuffle: bool, loop: bool) -> None:
+                 shuffle: bool, loop: bool, shard_id: int,
+                 num_shards: int) -> None:
         lib = load_library("record_pipeline.cc")
         lib.dp_open.restype = ctypes.c_void_p
         lib.dp_open.argtypes = [
             ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
         ]
         lib.dp_next.restype = ctypes.c_int64
         lib.dp_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
@@ -70,7 +81,7 @@ class _NativeEngine:
         self._batch = batch
         self._handle = lib.dp_open(
             path.encode(), record_bytes, batch, prefetch, threads, seed,
-            int(shuffle), int(loop),
+            int(shuffle), int(loop), shard_id, num_shards,
         )
         if not self._handle:
             raise NativeBuildError(f"dp_open failed for {path}")
@@ -97,27 +108,36 @@ class _PythonEngine:
 
     def __init__(self, path: str, record_bytes: int, batch: int,
                  prefetch: int, threads: int, seed: int,
-                 shuffle: bool, loop: bool) -> None:
+                 shuffle: bool, loop: bool, shard_id: int,
+                 num_shards: int) -> None:
         size = os.path.getsize(path)
         if size == 0 or size % record_bytes:
             raise ValueError(f"{path}: size {size} not a multiple of record")
         self.num_records = size // record_bytes
+        if shard_id >= self.num_records:
+            raise ValueError(
+                f"shard {shard_id}/{num_shards} is empty: only "
+                f"{self.num_records} records"
+            )
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce,
-            args=(path, record_bytes, batch, seed, shuffle, loop),
+            args=(path, record_bytes, batch, seed, shuffle, loop,
+                  shard_id, num_shards),
             daemon=True,
         )
         self._thread.start()
 
-    def _produce(self, path, record_bytes, batch, seed, shuffle, loop):
+    def _produce(self, path, record_bytes, batch, seed, shuffle, loop,
+                 shard_id, num_shards):
         try:
             epoch = 0
             with open(path, "rb") as f:
                 while not self._stop.is_set():
-                    order = epoch_order(self.num_records, seed, epoch, shuffle)
-                    for lo in range(0, self.num_records, batch):
+                    order = epoch_order(self.num_records, seed, epoch,
+                                        shuffle, shard_id, num_shards)
+                    for lo in range(0, len(order), batch):
                         recs = order[lo: lo + batch]
                         out = np.empty((len(recs), record_bytes), np.uint8)
                         for i, r in enumerate(recs):
@@ -180,14 +200,30 @@ class RecordPipeline:
     engine: "native" (C++), "python", or "auto" (native with fallback).
     Iterating yields [n, record_bytes] uint8 arrays (the final batch of an
     epoch may be short); callers reinterpret via .view(dtype).reshape(...).
+
+    shard_id/num_shards: multi-host input — every shard computes the same
+    per-epoch order and consumes its strided slice, so shards are disjoint
+    and jointly exhaustive within each epoch (the per-host-input contract
+    of shard_batch's multi-process path).
     """
 
     def __init__(self, path: str, record_bytes: int, batch: int, *,
                  prefetch: int = 4, threads: int = 2, seed: int = 0,
                  shuffle: bool = True, loop: bool = False,
-                 engine: str = "auto") -> None:
+                 engine: str = "auto", shard_id: int = 0,
+                 num_shards: int = 1) -> None:
+        if num_shards < 1 or not 0 <= shard_id < num_shards:
+            raise ValueError(f"bad shard {shard_id}/{num_shards}")
+        # Data-configuration errors surface HERE, not as a fake
+        # native-build failure from dp_open returning null.
+        total = os.path.getsize(path) // record_bytes if os.path.exists(path) else 0
+        if total and total // num_shards == 0:
+            raise ValueError(
+                f"shard {shard_id}/{num_shards} is empty: only {total} "
+                f"records (equal-size shards get n // num_shards each)"
+            )
         args = (path, record_bytes, batch, prefetch, threads, seed, shuffle,
-                loop)
+                loop, shard_id, num_shards)
         if engine == "native":
             self._engine = _NativeEngine(*args)
         elif engine == "python":

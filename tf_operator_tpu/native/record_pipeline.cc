@@ -11,6 +11,11 @@
 //  - epochs iterate every record exactly once; optional per-epoch
 //    Fisher-Yates shuffle from a splitmix64/xorshift PRNG seeded by
 //    (seed, epoch) => deterministic given the seed
+//  - multi-host sharding: all shards compute the SAME epoch order, then
+//    shard k consumes positions k, k+num_shards, ..., truncated to the
+//    common floor(n / num_shards) length — shards are disjoint and all
+//    exactly equal-sized (lockstep hosts), the <num_shards remainder is
+//    dropped for the epoch, and the shuffle re-deals between epochs
 //  - worker threads pread() record runs into batch slots; a bounded ring
 //    of filled slots decouples producers from the consumer
 //  - dp_next() hands back one batch (blocking), in batch order
@@ -60,6 +65,8 @@ struct Pipeline {
   bool shuffle = false;
   bool loop = false;
   uint64_t seed = 0;
+  uint64_t shard_id = 0;
+  uint64_t num_shards = 1;
 
   // work assignment
   std::vector<uint64_t> order;   // record indices for the current epoch
@@ -91,6 +98,14 @@ struct Pipeline {
         std::swap(order[i], order[j]);
       }
     }
+    if (num_shards > 1) {
+      std::vector<uint64_t> mine;
+      uint64_t keep = num_records / num_shards;  // equal-size shards
+      for (uint64_t i = shard_id; i < order.size() && mine.size() < keep;
+           i += num_shards)
+        mine.push_back(order[i]);
+      order = std::move(mine);
+    }
   }
 
   // Claim the next batch of this epoch (or roll the epoch / signal done).
@@ -102,7 +117,7 @@ struct Pipeline {
       if (next_batch_to_claim < batches_per_epoch) {
         uint64_t b = next_batch_to_claim++;
         uint64_t lo = b * batch;
-        uint64_t hi = std::min(num_records, lo + batch);
+        uint64_t hi = std::min((uint64_t)order.size(), lo + batch);
         records_out->assign(order.begin() + lo, order.begin() + hi);
         *seq_out = next_seq_to_produce++;
         return true;
@@ -152,8 +167,10 @@ extern "C" {
 
 void* dp_open(const char* path, uint64_t record_bytes, uint64_t batch,
               uint64_t prefetch, uint64_t threads, uint64_t seed,
-              int shuffle, int loop) {
+              int shuffle, int loop, uint64_t shard_id,
+              uint64_t num_shards) {
   if (record_bytes == 0 || batch == 0) return nullptr;
+  if (num_shards == 0 || shard_id >= num_shards) return nullptr;
   int fd = open(path, O_RDONLY);
   if (fd < 0) return nullptr;
   struct stat st;
@@ -170,7 +187,17 @@ void* dp_open(const char* path, uint64_t record_bytes, uint64_t batch,
   p->shuffle = shuffle != 0;
   p->loop = loop != 0;
   p->seed = seed;
-  p->batches_per_epoch = (p->num_records + batch - 1) / batch;
+  p->shard_id = shard_id;
+  p->num_shards = num_shards;
+  // Equal-size shards: every shard gets exactly floor(n / num_shards)
+  // records per epoch (lockstep multi-host contract).
+  uint64_t mine = p->num_records / num_shards;
+  if (mine == 0) {  // empty shard: more shards than records
+    close(fd);
+    delete p;
+    return nullptr;
+  }
+  p->batches_per_epoch = (mine + batch - 1) / batch;
   p->capacity = prefetch ? prefetch : 4;
   p->ring.resize(p->capacity);
   p->filled.assign(p->capacity, false);

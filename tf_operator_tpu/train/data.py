@@ -69,6 +69,8 @@ def record_dataset(
     engine: str = "auto",
     crop_hw: tuple[int, int] | None = None,
     augment_train: bool = True,
+    shard_id: int = 0,
+    num_shards: int = 1,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Stream {image, label} batches from a binary record file.
 
@@ -84,6 +86,9 @@ def record_dataset(
     crop) — ImageNet-style host preprocessing; ``engine`` selects the
     native/python implementation for the augment stage and the record
     pipeline alike.
+
+    shard_id/num_shards: multi-host input sharding (one disjoint slice of
+    every epoch per host — see RecordPipeline).
     """
     dtype = np.dtype(dtype)
     if crop_hw is not None and (dtype != np.uint8 or len(example_shape) != 3):
@@ -95,12 +100,14 @@ def record_dataset(
     return _record_batches(
         path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
         loop, prefetch, threads, engine, crop_hw, augment_train,
+        shard_id, num_shards,
     )
 
 
 def _record_batches(
     path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
     loop, prefetch, threads, engine, crop_hw, augment_train,
+    shard_id, num_shards,
 ) -> Iterator[dict[str, np.ndarray]]:
     from tf_operator_tpu.native.pipeline import RecordPipeline
 
@@ -116,6 +123,7 @@ def _record_batches(
     pipe = RecordPipeline(
         path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
         seed=seed, shuffle=shuffle, loop=loop, engine=engine,
+        shard_id=shard_id, num_shards=num_shards,
     )
     sample_index = 0
     try:
@@ -153,6 +161,8 @@ def token_dataset(
     prefetch: int = 4,
     threads: int = 2,
     engine: str = "auto",
+    shard_id: int = 0,
+    num_shards: int = 1,
 ) -> Iterator[dict[str, np.ndarray]]:
     """Stream {tokens, targets} LM batches from a binary token-record file.
 
@@ -160,14 +170,15 @@ def token_dataset(
     int32 token ids; tokens = rec[:-1], targets = rec[1:] (next-token
     objective). IO, shuffling and prefetch ride the same native C++
     pipeline as the image path (native/record_pipeline.cc), so the LM
-    input side is also off the GIL. Multi-host: give each process its own
-    shard file (write_token_records on a per-host slice) — the same
-    per-host-input contract as shard_batch's multi-process path.
+    input side is also off the GIL. Multi-host: pass each process its
+    topology slot (shard_id=process_id, num_shards=num_processes) and
+    every epoch is dealt disjointly across hosts from ONE shared file.
     """
     base = record_dataset(
         path, (seq_len + 1,), np.int32, batch_size, label_dtype=None,
         seed=seed, shuffle=shuffle, loop=loop, prefetch=prefetch,
-        threads=threads, engine=engine,
+        threads=threads, engine=engine, shard_id=shard_id,
+        num_shards=num_shards,
     )
 
     def gen() -> Iterator[dict[str, np.ndarray]]:

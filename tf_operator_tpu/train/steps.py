@@ -608,18 +608,24 @@ def make_lm_eval_step(
         )
         head = state.params["lm_head"]
         seq = batch["tokens"].shape[1]
-        # Largest divisor of the (static) sequence length <= xent_chunk;
-        # when no useful divisor exists (prime/odd lengths would degenerate
-        # to chunk=1 — an S-iteration scan of [B,1,V] matmuls), one full
-        # chunk is better: correct either way, and eval batches are small.
+        # Largest divisor of the (static) sequence length <= xent_chunk, so
+        # any sequence length works without caller-side chunk math.
+        # xent_chunk is a MEMORY BOUND and is never exceeded; a prime/odd
+        # length whose best divisor is tiny still evaluates correctly,
+        # just slowly — warn (at trace time) so the caller can pick a
+        # friendlier length.
         chunk = next(
             c for c in range(min(xent_chunk, seq), 0, -1) if seq % c == 0
         )
-        # Rescue only DEGENERATE divisors (prime/odd lengths): never
-        # override an explicitly small xent_chunk — that's the caller's
-        # memory bound.
-        if chunk < min(32, xent_chunk):
-            chunk = seq
+        if chunk < min(8, xent_chunk, seq):
+            from tf_operator_tpu.utils import logger
+
+            logger.with_fields(component="lm-eval").warning(
+                "seq %d has no divisor <= xent_chunk %d above %d; eval "
+                "will scan %d tiny chunks — consider a seq length with a "
+                "divisor near the chunk size",
+                seq, xent_chunk, chunk, seq // chunk,
+            )
         # The device count is unused here — evaluate_lm counts tokens
         # host-side (a device int32 would wrap past 2^31 tokens).
         loss_sum, _ = chunked_lm_xent_sums(
