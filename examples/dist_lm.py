@@ -48,6 +48,12 @@ def main(argv: list[str] | None = None) -> int:
                         "seq / 2)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks (long-context memory)")
+    p.add_argument("--data", default=None,
+                   help="token-record file (write_token_records layout): "
+                        "each process streams its disjoint shard of every "
+                        "epoch through the native pipeline "
+                        "(shard_id=process_id). Requires sp=tp=1 (pure "
+                        "data parallelism); default: synthetic +1 chains")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-interval", type=int, default=1)
     p.add_argument("--fail-at-step", type=int, default=None,
@@ -154,10 +160,55 @@ def main(argv: list[str] | None = None) -> int:
 
         return {"tokens": place(toks), "targets": place(targets)}
 
+    data_iter = None
+    if args.data:
+        # Real input path: this process streams ITS shard of every epoch
+        # through the native pipeline; shard_batch assembles the global
+        # batch from per-process rows (pure-dp only: with sp/tp the batch
+        # layout is not process-row-major).
+        if axes["sp"] > 1 or axes["tp"] > 1:
+            raise SystemExit("--data requires sp=1 and tp=1")
+        from tf_operator_tpu.parallel.sharding import shard_batch
+        from tf_operator_tpu.train.data import token_dataset
+
+        if args.batch % max(1, topo.num_processes):
+            raise SystemExit(
+                "global batch must be a multiple of num_processes"
+            )
+        local_rows = args.batch // max(1, topo.num_processes)
+        data_iter = token_dataset(
+            args.data, args.seq, local_rows, seed=11, loop=True,
+            shard_id=topo.process_id, num_shards=max(1, topo.num_processes),
+        )
+
+        def row_stream():
+            # Re-batch to EXACTLY local_rows per step, carrying epoch-tail
+            # leftovers into the next step (truncating them would skip
+            # records for a whole epoch) — and giving resume a stream
+            # where one next() == one training step, so fast-forwarding
+            # start_step steps lands precisely where training stopped.
+            buf = None
+            for b in data_iter:
+                buf = b if buf is None else {
+                    k: np.concatenate([buf[k], b[k]]) for k in b
+                }
+                while buf["tokens"].shape[0] >= local_rows:
+                    yield {k: v[:local_rows] for k, v in buf.items()}
+                    buf = {k: v[local_rows:] for k, v in buf.items()}
+
+        rows = row_stream()
+        for _ in range(start_step):  # resume continues, never replays
+            next(rows)
+
+        def next_data(_step_idx):
+            return shard_batch(mesh, next(rows))
+    else:
+        next_data = batch_at
+
     t0 = time.perf_counter()
     metrics = None
     for i in range(start_step, args.steps):
-        state, metrics = step(state, batch_at(i))
+        state, metrics = step(state, next_data(i))
         if ckpt is not None:
             ckpt.save(i, state)
         if (

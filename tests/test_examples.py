@@ -119,6 +119,38 @@ def test_dist_mnist_two_process_training(operator):
             pass
 
 
+def test_dist_lm_trains_from_sharded_token_file(tmp_path):
+    """dist_lm --data: the LM learns from a token-record corpus streamed
+    through the native pipeline (per-process epoch shard) instead of
+    synthetic batches — single process, no operator needed."""
+    import subprocess
+
+    import numpy as np
+
+    from tf_operator_tpu.train.data import write_token_records
+
+    rng = np.random.default_rng(0)
+    start = rng.integers(0, 64, (256, 1))
+    seqs = ((start + np.arange(65)) % 64).astype(np.int32)
+    path = str(tmp_path / "corpus.bin")
+    write_token_records(path, seqs)
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "dist_lm.py"),
+         "--steps", "80", "--batch", "8", "--seq", "64", "--vocab", "64",
+         "--data", path, "--target-loss", "1.0"],
+        env=env, capture_output=True, text=True, timeout=360,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "dist_lm: OK" in r.stdout
+
+
 def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
     """Worker + Evaluator job: the worker trains and checkpoints; the
     evaluator replica (excluded from the rendezvous, role from TF_CONFIG)

@@ -114,11 +114,8 @@ class _PythonEngine:
         if size == 0 or size % record_bytes:
             raise ValueError(f"{path}: size {size} not a multiple of record")
         self.num_records = size // record_bytes
-        if shard_id >= self.num_records:
-            raise ValueError(
-                f"shard {shard_id}/{num_shards} is empty: only "
-                f"{self.num_records} records"
-            )
+        # Empty-shard validation lives in RecordPipeline.__init__ (shared
+        # by both engines).
         self._q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, prefetch))
         self._stop = threading.Event()
         self._thread = threading.Thread(
