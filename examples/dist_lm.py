@@ -196,7 +196,18 @@ def main(argv: list[str] | None = None) -> int:
                     yield {k: v[:local_rows] for k, v in buf.items()}
                     buf = {k: v[local_rows:] for k, v in buf.items()}
 
+        import itertools
+
         rows = row_stream()
+        first = next(rows)
+        # Fail loudly on a corpus/vocab mismatch: jax gathers CLAMP
+        # out-of-range ids, which would silently train on garbage.
+        hi = int(first["tokens"].max())
+        if hi >= args.vocab:
+            raise SystemExit(
+                f"--data token id {hi} >= --vocab {args.vocab}"
+            )
+        rows = itertools.chain([first], rows)
         for _ in range(start_step):  # resume continues, never replays
             next(rows)
 

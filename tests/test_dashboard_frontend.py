@@ -102,6 +102,23 @@ def test_app_js_routes_exist_server_side(dashboard):
             assert body.lstrip()[:1] == b"{", f"route {route} fell through to SPA"
 
 
+def test_detail_view_renders_replica_statuses():
+    """The detail view's replica-set table reads the status fields the
+    controller actually writes (field drift between status engine and SPA
+    fails here)."""
+    src = open(os.path.join(FRONTEND, "app.js")).read()
+    # Detail-specific markers (the list view's replicaSummary also reads
+    # these fields, so scope to the jobDetailView additions).
+    assert '"Replica sets"' in src
+    detail = src[src.index("async function jobDetailView"):
+                 src.index("async function showLogs")]
+    assert "replicaStatuses" in detail
+    for field in ("active", "succeeded", "failed"):
+        assert f"s.{field}" in detail, field
+    assert "job.status?.restartCount" in detail  # job-level restart readout
+    assert '"Role", "Active", "Succeeded", "Failed"' in detail
+
+
 def test_accelerator_catalog_backs_slice_picker(dashboard):
     code, body = fetch(dashboard, "/tpujobs/api/accelerators")
     assert code == 200

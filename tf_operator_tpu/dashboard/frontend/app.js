@@ -135,11 +135,25 @@ async function jobDetailView(ns, name) {
       h("td", { class: "muted" }, e.involvedObject?.name || "")
     )
   );
+  const replicas = Object.entries(job.status?.replicaStatuses || {}).map(
+    ([type, s]) =>
+      h(
+        "tr",
+        {},
+        h("td", {}, type),
+        h("td", {}, s.active || 0),
+        h("td", {}, s.succeeded || 0),
+        h("td", {}, s.failed || 0)
+      )
+  );
+  const restarts = job.status?.restartCount
+    ? h("span", { class: "muted" }, ` restarts: ${job.status.restartCount}`)
+    : null;
   app.replaceChildren(
     h(
       "div",
       { class: "toolbar" },
-      h("h2", {}, `${ns}/${name} `, phaseBadge(job)),
+      h("h2", {}, `${ns}/${name} `, phaseBadge(job), restarts),
       h(
         "button",
         {
@@ -161,7 +175,14 @@ async function jobDetailView(ns, name) {
         "div",
         { class: "card" },
         h("h2", {}, "Conditions"),
-        h("table", {}, h("tbody", {}, conds.length ? conds : h("tr", {}, h("td", { class: "muted" }, "none"))))
+        h("table", {}, h("tbody", {}, conds.length ? conds : h("tr", {}, h("td", { class: "muted" }, "none")))),
+        h("h2", {}, "Replica sets"),
+        h(
+          "table",
+          {},
+          h("thead", {}, h("tr", {}, ...["Role", "Active", "Succeeded", "Failed"].map((t) => h("th", {}, t)))),
+          h("tbody", {}, replicas.length ? replicas : h("tr", {}, h("td", { class: "muted", colspan: 4 }, "none")))
+        )
       ),
       h(
         "div",
