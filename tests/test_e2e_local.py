@@ -150,7 +150,7 @@ class TestHappyPath:
                 return True
 
         wait_for(lambda: succeeded() or job_gone(), desc="Succeeded-or-reaped")
-        wait_for(job_gone, timeout=20, desc="TTL deletion")
+        wait_for(job_gone, timeout=60, desc="TTL deletion")
         wait_for(
             lambda: not client.list(objects.PODS)
             and not client.list(objects.SERVICES),
@@ -232,12 +232,12 @@ class TestFaultInjection:
             return job.get("status", {}).get("restartCount", 0) >= 1
 
         wait_for(restart_counted, desc="restartCount")
-        wait_for(job_condition(client, "flaky", "Running"), timeout=20, desc="Running again")
+        wait_for(job_condition(client, "flaky", "Running"), timeout=60, desc="Running again")
 
         # Now finish cleanly.
         http_get(executor, "flaky-worker-0", "/exit?exitCode=0")
         http_get(executor, "flaky-worker-1", "/exit?exitCode=0")
-        wait_for(job_condition(client, "flaky", "Succeeded"), timeout=20, desc="Succeeded")
+        wait_for(job_condition(client, "flaky", "Succeeded"), timeout=60, desc="Succeeded")
 
     def test_permanent_exit_fails_job(self, stack):
         client, executor = stack

@@ -80,7 +80,7 @@ def test_tpu_smoke_two_process_rendezvous(operator):
     cli = TPUJobClient(RestClusterClient(operator))
     cli.create(example_job("smoke2", "tpu_smoke.py", workers=2))
     try:
-        got = cli.wait_for_job("default", "smoke2", timeout=120)
+        got = cli.wait_for_job("default", "smoke2", timeout=240)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "smoke2")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"
@@ -107,7 +107,7 @@ def test_dist_mnist_two_process_training(operator):
         )
     )
     try:
-        got = cli.wait_for_job("default", "mnist2", timeout=300)
+        got = cli.wait_for_job("default", "mnist2", timeout=480)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "mnist2")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"
@@ -145,7 +145,7 @@ def test_dist_lm_trains_from_sharded_token_file(tmp_path):
         [sys.executable, os.path.join(EXAMPLES, "dist_lm.py"),
          "--steps", "80", "--batch", "8", "--seq", "64", "--vocab", "64",
          "--data", path, "--target-loss", "1.0"],
-        env=env, capture_output=True, text=True, timeout=360,
+        env=env, capture_output=True, text=True, timeout=480,
     )
     assert r.returncode == 0, r.stdout + r.stderr
     assert "dist_lm: OK" in r.stdout
@@ -177,7 +177,7 @@ def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
     cli = TPUJobClient(RestClusterClient(operator))
     cli.create(job)
     try:
-        got = cli.wait_for_job("default", "mnisteval", timeout=420)
+        got = cli.wait_for_job("default", "mnisteval", timeout=600)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         assert "Succeeded" in conds, conds
         deadline = _time.monotonic() + 240
@@ -219,7 +219,7 @@ def test_dist_lm_two_process_ring_attention(operator):
         )
     )
     try:
-        got = cli.wait_for_job("default", "lm2", timeout=420)
+        got = cli.wait_for_job("default", "lm2", timeout=600)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "lm2")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"

@@ -40,7 +40,7 @@ def _operator(kubeconfig: str, log_path) -> subprocess.Popen:
         )  # child holds its own fd; ours closes with the with-block
 
 
-def _wait_job_created_pods(stub, name, timeout=20.0) -> bool:
+def _wait_job_created_pods(stub, name, timeout=90.0) -> bool:
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         pods = [
@@ -81,13 +81,13 @@ def test_standby_takes_over_after_leader_sigkill(tmp_path):
         leader_pid = int(holder.rsplit("-", 1)[1])
         leader = next(p for p in ops if p.pid == leader_pid)
         leader.kill()  # SIGKILL: no release, the lease must EXPIRE
-        leader.wait(timeout=10)
+        leader.wait(timeout=30)
 
         # Standby acquires and reconciles new work.
         stub.cluster.create(
             objects.TPUJOBS, synthetic_job("after", "default", 1, None, None)
         )
-        assert _wait_job_created_pods(stub, "after", timeout=30), (
+        assert _wait_job_created_pods(stub, "after", timeout=90), (
             "standby never took over; logs under " + str(tmp_path)
         )
         [lease] = stub.cluster.list(objects.LEASES, None)

@@ -88,7 +88,7 @@ def test_two_slice_job_partitions_topology_env(stack):
     total = hosts_per_slice * NUM_SLICES
 
     submit_multislice_job(client)
-    wait_for(job_condition(client, "ms", "Running"), timeout=30,
+    wait_for(job_condition(client, "ms", "Running"), timeout=90,
              desc="ms job Running")
     pods = wait_for(
         lambda: (lambda ps: ps if len(ps) == total else None)(
@@ -129,7 +129,7 @@ def test_two_slice_job_partitions_topology_env(stack):
     # Succeeded only when all slices have finished.
     for i in range(total):
         http_get(executor, f"ms-worker-{i}", "/exit?exitCode=0")
-    wait_for(job_condition(client, "ms", "Succeeded"), timeout=30,
+    wait_for(job_condition(client, "ms", "Succeeded"), timeout=90,
              desc="ms job Succeeded")
 
 
