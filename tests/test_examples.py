@@ -254,7 +254,7 @@ def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
         # Generous budget: two incarnations each pay a fresh jit compile,
         # CI hosts can be single-core with other suites contending, and
         # this module's earlier LM job may still be tearing down.
-        got = cli.wait_for_job("default", "mnistresume", timeout=600)
+        got = cli.wait_for_job("default", "mnistresume", timeout=900)
         conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
         logs = job_logs(cli, "mnistresume")
         assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"

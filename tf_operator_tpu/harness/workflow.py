@@ -263,7 +263,9 @@ def default_e2e_workflow(
             "--name", "wf-e2e",
             "--workers", str(e2e_workers),
             "--trials", str(e2e_trials),
-            "--timeout", "120",
+            # Per-phase job wait: generous for contended single-core CI
+            # hosts (process spawn + reconcile latency scales with load).
+            "--timeout", "240",
             "--junit-path",
             os.path.join(ctx["artifacts_dir"], "junit_e2e_suite.xml"),
         ])
@@ -284,7 +286,7 @@ def default_e2e_workflow(
                 sys.executable, "-m", "pytest", "-q", *unit_tests,
             ], env=env, timeout=900.0),
             Step("deploy", deploy, deps=("build",)),
-            Step("e2e", e2e, deps=("deploy",), timeout=600.0),
+            Step("e2e", e2e, deps=("deploy",), timeout=900.0),
             Step("teardown", teardown, deps=("deploy", "e2e"), always=True),
         ],
     )
