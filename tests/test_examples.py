@@ -266,7 +266,12 @@ def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
         assert "dist_mnist: OK" in logs, logs
         # Restarting is an exclusive condition that Running replaces
         # (reference parity), so the durable restart evidence is the
-        # job-status restart counter.
+        # job-status restart counter. Known timing edge (observed once,
+        # with sparser checkpoint intervals shifting the preemption
+        # earlier): if the 138 exit outraces the controller's first
+        # Running observation of the pod, the restart is performed but
+        # the counter can read 0 — keep per-step checkpointing here so
+        # the first incarnation stays observable before it dies.
         assert got["status"].get("restartCount", 0) >= 1, got["status"]
     finally:
         try:
