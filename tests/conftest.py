@@ -51,15 +51,29 @@ def operator(tmp_path_factory):
         env=env, stdout=open(log_path, "wb"), stderr=subprocess.STDOUT,
     )
     base = f"http://127.0.0.1:{port}"
-    deadline = time.monotonic() + 15
+    # Generous: interpreter + jax-adjacent imports can take >15s on a
+    # loaded single-core host, and a silent expiry here surfaces later as
+    # an opaque Connection refused in the first test.
+    deadline = time.monotonic() + 90
+    up = False
     while time.monotonic() < deadline:
         try:
             urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
+            up = True
             break
         except (urllib.error.URLError, ConnectionError):
             if proc.poll() is not None:
-                raise RuntimeError("operator died at startup")
+                raise RuntimeError(
+                    f"operator died at startup; log:\n"
+                    f"{open(log_path).read()[-2000:]}"
+                )
             time.sleep(0.2)
+    if not up:
+        proc.terminate()
+        raise RuntimeError(
+            f"operator not serving within 90s; log:\n"
+            f"{open(log_path).read()[-2000:]}"
+        )
     yield base
     proc.terminate()
     try:
