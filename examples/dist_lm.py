@@ -149,9 +149,9 @@ def main(argv: list[str] | None = None) -> int:
     def batch_at(step_idx: int) -> dict[str, jax.Array]:
         rng = np.random.default_rng((7, step_idx))
         start = rng.integers(0, args.vocab, (args.batch, 1))
-        toks = (start + np.arange(args.seq)) % args.vocab  # +1 chain
-        toks = toks.astype(np.int32)
-        targets = np.roll(toks, -1, axis=1)
+        chain = (start + np.arange(args.seq + 1)) % args.vocab  # +1 chain
+        chain = chain.astype(np.int32)
+        toks, targets = chain[:, :-1], chain[:, 1:]
 
         def place(x):
             return jax.make_array_from_callback(

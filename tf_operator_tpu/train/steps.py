@@ -14,6 +14,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding
@@ -347,6 +348,7 @@ def make_lm_train_step(
     param_shardings: Any = None,
     data_axis: Any = "dp",
     seq_axis: str | None = "sp",
+    tp_axis: str = "tp",
     donate: bool = True,
     xent_chunk: int | None = None,
     xent_dot_dtype: Any = None,
@@ -376,7 +378,7 @@ def make_lm_train_step(
     # missing axis name as unsharded).
     sharded_loss = xent_chunk is not None and any(
         mesh.shape.get(a, 1) > 1
-        for a in ((seq_axis, "tp") if seq_axis else ("tp",))
+        for a in ((seq_axis, tp_axis) if seq_axis else (tp_axis,))
     )
 
     def apply_model(params, tokens, **kw):
@@ -400,7 +402,7 @@ def make_lm_train_step(
                     mesh, hidden, head["kernel"], head.get("bias"),
                     batch["targets"], chunk=xent_chunk,
                     data_axis=data_axis, seq_axis=seq_axis,
-                    dot_dtype=xent_dot_dtype,
+                    tp_axis=tp_axis, dot_dtype=xent_dot_dtype,
                 )
             else:
                 xent = chunked_lm_xent(
@@ -523,8 +525,6 @@ def _iter_padded(batches, shard_count: int, pad_to: int | None,
     (ones over real rows, zeros over padding; shape = leading ``mask_ndim``
     dims, honoring a caller-provided per-element "mask" field) makes padded
     rows contribute nothing."""
-    import numpy as np
-
     for batch in batches:
         arrs = {f: np.asarray(batch[f]) for f in fields}
         n = arrs[fields[0]].shape[0]
@@ -651,18 +651,26 @@ def evaluate_lm(
     pad_to: int | None = None,
 ) -> dict[str, float]:
     """Drive an LM eval step over host batches of any row counts — padding
-    via _iter_padded; returns mean token loss, perplexity, and the exact
-    token count. The f32 loss accumulates on device (one sync at the end);
-    the TOKEN count accumulates host-side as a Python int from the masks —
-    a device int32 would silently wrap past 2^31 tokens, routine corpus
-    scale for perplexity eval."""
+    via _iter_padded; returns mean token loss, perplexity, and the total
+    token weight (a float: the mask-value sum — exactly the token count
+    for 0/1 masks). The f32 loss accumulates on device (one sync at the end);
+    the TOKEN weight accumulates host-side in float64 as the SUM of mask
+    values (matching the device numerator's mask weighting, so fractional
+    masks stay consistent; exact for 0/1 masks) — a device int32 would
+    silently wrap past 2^31 tokens, routine corpus scale for perplexity
+    eval."""
     sharding, shard_count = eval_step.sharding, eval_step.shard_count
     loss_sum = None
     tokens = 0
     for arrs, pad_to in _iter_padded(
         batches, shard_count, pad_to, ("tokens", "targets"), mask_ndim=2
     ):
-        tokens += int((arrs["mask"] > 0).sum())
+        # Sum mask VALUES (not count of nonzeros) so a fractional
+        # per-token mask weights the denominator the same way the device
+        # loss_sum weights the numerator. For 0/1 masks this is identical
+        # to counting; float64 host accumulation holds exact integer
+        # counts far past 2^31.
+        tokens += float(arrs["mask"].sum(dtype=np.float64))
         dev = {k: jax.device_put(v, sharding) for k, v in arrs.items()}
         m = eval_step(state, dev)  # async: dispatch overlaps host prep
         loss_sum = m["loss_sum"] if loss_sum is None else loss_sum + m["loss_sum"]
