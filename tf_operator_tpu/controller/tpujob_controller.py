@@ -16,7 +16,6 @@ the hardening SURVEY.md §7 calls for over the reference's bare Update.
 
 from __future__ import annotations
 
-import calendar
 import threading
 import time
 from typing import Any
@@ -47,6 +46,7 @@ from tf_operator_tpu.runtime.client import ClusterClient, Conflict, NotFound
 from tf_operator_tpu.runtime.metrics import REGISTRY
 from tf_operator_tpu.runtime.tracing import TRACER
 from tf_operator_tpu.utils import logger
+from tf_operator_tpu.utils.times import parse_rfc3339
 
 # Observability (absent from the reference — SURVEY.md §5): reconcile
 # latency/outcome plus queue pressure, scraped via /metrics.
@@ -324,7 +324,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         finished_at = job.status.completion_time or job.status.last_reconcile_time
         if not finished_at:
             return False
-        expiry = _parse_iso(finished_at) + ttl
+        finished_epoch = parse_rfc3339(finished_at)
+        if finished_epoch is None:
+            # Unparseable completion time: no basis for a TTL clock; leave
+            # the job alone rather than failing the sync forever.
+            return False
+        expiry = finished_epoch + ttl
         now = time.time()
         if now < expiry:
             self.enqueue_after(job.key, expiry - now)
@@ -511,7 +516,3 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
                 )
 
 
-def _parse_iso(ts: str) -> float:
-    # calendar.timegm, not time.mktime: the timestamp is UTC and mktime's
-    # DST guessing would shift TTL expiry by an hour in DST timezones.
-    return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))

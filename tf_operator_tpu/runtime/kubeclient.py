@@ -20,8 +20,11 @@ client-go stack does:
 
 Auth supported: bearer token (inline / file / service-account), client
 certificates (inline base64 data or files), CA bundle or
-insecure-skip-tls-verify. Exec credential plugins are intentionally out of
-scope (would shell out to cloud CLIs).
+insecure-skip-tls-verify, and exec credential plugins
+(client.authentication.k8s.io ExecCredential — the mechanism a stock GKE
+kubeconfig uses via gke-gcloud-auth-plugin; client-go's exec auth provider
+is the model). Plugin tokens are cached until expirationTimestamp and
+re-minted on expiry or a 401.
 """
 
 from __future__ import annotations
@@ -30,8 +33,10 @@ import base64
 import json
 import os
 import ssl
+import subprocess
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 from urllib import error as urlerror
@@ -51,6 +56,7 @@ from tf_operator_tpu.runtime.client import (
     WatchEvent,
 )
 from tf_operator_tpu.utils import logger
+from tf_operator_tpu.utils.times import parse_rfc3339
 
 LOG = logger.with_fields(component="kubeclient")
 
@@ -113,6 +119,28 @@ class KubeConfigError(Exception):
     pass
 
 
+# Re-mint this long before expirationTimestamp so a token never dies on the
+# wire mid-request (client-go uses a similar expiry delta).
+_EXEC_EXPIRY_MARGIN_S = 120.0
+
+
+@dataclass
+class ExecConfig:
+    """users[].user.exec block: how to mint credentials via a plugin
+    (client.authentication.k8s.io; gke-gcloud-auth-plugin is the canonical
+    instance — reference auth stack: client-go exec.Authenticator)."""
+
+    command: str
+    args: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)  # additive to os.environ
+    api_version: str = "client.authentication.k8s.io/v1beta1"
+    provide_cluster_info: bool = False
+    install_hint: str = ""
+    # Cluster block forwarded via KUBERNETES_EXEC_INFO when
+    # provide_cluster_info is set (server + CA the plugin may need).
+    cluster_info: dict[str, Any] | None = None
+
+
 @dataclass
 class KubeConfig:
     """Resolved connection parameters for one cluster+user pair."""
@@ -127,7 +155,14 @@ class KubeConfig:
     client_cert_data: bytes | None = None  # PEM
     client_key_data: bytes | None = None  # PEM
     insecure_skip_tls_verify: bool = False
+    exec_config: ExecConfig | None = None
+    exec_timeout: float = 60.0  # plugin subprocess budget
     _tmpfiles: list[str] = field(default_factory=list, repr=False)
+    _exec_token: str | None = field(default=None, repr=False)
+    _exec_expiry: float | None = field(default=None, repr=False)
+    _exec_lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def bearer_token(self) -> str | None:
         if self.token:
@@ -135,7 +170,99 @@ class KubeConfig:
         if self.token_file:
             with open(self.token_file) as f:
                 return f.read().strip()
+        if self.exec_config is not None:
+            return self._exec_bearer_token()
         return None
+
+    # -- exec credential plugin ---------------------------------------------
+
+    def invalidate_exec_token(self) -> None:
+        """Drop the cached plugin token (called on a 401 so the next request
+        re-mints; client-go's exec authenticator refreshes the same way)."""
+        with self._exec_lock:
+            self._exec_token = None
+            self._exec_expiry = None
+
+    def _exec_bearer_token(self) -> str:
+        with self._exec_lock:
+            if self._exec_token is not None and (
+                self._exec_expiry is None
+                or self._exec_expiry - time.time() > _EXEC_EXPIRY_MARGIN_S
+            ):
+                return self._exec_token
+            cred = self._run_exec_plugin()
+            status = cred.get("status") or {}
+            token = status.get("token")
+            if not token:
+                if status.get("clientCertificateData"):
+                    raise KubeConfigError(
+                        "exec plugin returned TLS client-certificate "
+                        "credentials; only token credentials are supported"
+                    )
+                raise KubeConfigError(
+                    "exec plugin returned no status.token "
+                    f"(command: {self.exec_config.command})"
+                )
+            expiry = None
+            if status.get("expirationTimestamp"):
+                expiry = parse_rfc3339(status["expirationTimestamp"])
+            self._exec_token = token
+            self._exec_expiry = expiry
+            return token
+
+    def _run_exec_plugin(self) -> dict[str, Any]:
+        ec = self.exec_config
+        assert ec is not None
+        env = dict(os.environ)
+        env.update(ec.env)
+        # KUBERNETES_EXEC_INFO: the ExecCredential request object. Always
+        # sent (plugins key their protocol version off it); the cluster
+        # block rides along only under provideClusterInfo, as client-go does.
+        spec: dict[str, Any] = {"interactive": False}
+        if ec.provide_cluster_info and ec.cluster_info is not None:
+            spec["cluster"] = ec.cluster_info
+        env["KUBERNETES_EXEC_INFO"] = json.dumps(
+            {
+                "apiVersion": ec.api_version,
+                "kind": "ExecCredential",
+                "spec": spec,
+            }
+        )
+        try:
+            proc = subprocess.run(
+                [ec.command, *ec.args],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=self.exec_timeout,
+            )
+        except FileNotFoundError:
+            hint = f"\n{ec.install_hint}" if ec.install_hint else ""
+            raise KubeConfigError(
+                f"exec credential plugin {ec.command!r} not found on PATH{hint}"
+            ) from None
+        except subprocess.TimeoutExpired:
+            raise KubeConfigError(
+                f"exec credential plugin {ec.command!r} timed out after "
+                f"{self.exec_timeout:.0f}s"
+            ) from None
+        if proc.returncode != 0:
+            raise KubeConfigError(
+                f"exec credential plugin {ec.command!r} failed "
+                f"(rc={proc.returncode}): {proc.stderr.strip()[:500]}"
+            )
+        try:
+            cred = json.loads(proc.stdout)
+        except ValueError as e:
+            raise KubeConfigError(
+                f"exec credential plugin {ec.command!r} wrote invalid JSON: {e}"
+            ) from None
+        if cred.get("kind") != "ExecCredential":
+            raise KubeConfigError(
+                f"exec credential plugin {ec.command!r} returned kind "
+                f"{cred.get('kind')!r}, want ExecCredential"
+            )
+        return cred
 
     def ssl_context(self) -> ssl.SSLContext | None:
         if not self.server.startswith("https"):
@@ -241,10 +368,41 @@ def load_kubeconfig(path: str | None = None, context: str | None = None) -> Kube
         cfg.client_cert_data = _b64(user["client-certificate-data"])
     if user.get("client-key-data"):
         cfg.client_key_data = _b64(user["client-key-data"])
-    if user.get("exec") or user.get("auth-provider"):
+    if user.get("auth-provider"):
         raise KubeConfigError(
-            f"{path}: user {ctx.get('user')!r} uses an exec/auth-provider plugin; "
-            "use a token or client certificate (exec plugins are not supported)"
+            f"{path}: user {ctx.get('user')!r} uses the legacy auth-provider "
+            "mechanism (removed from client-go in v1.26); migrate to an exec "
+            "credential plugin (GKE: gke-gcloud-auth-plugin)"
+        )
+    if user.get("exec"):
+        ex = user["exec"] or {}
+        if not ex.get("command"):
+            raise KubeConfigError(
+                f"{path}: user {ctx.get('user')!r} exec block has no command"
+            )
+        cluster_info: dict[str, Any] = {"server": server}
+        if cluster.get("certificate-authority-data"):
+            cluster_info["certificate-authority-data"] = cluster[
+                "certificate-authority-data"
+            ]
+        elif cfg.ca_file:
+            cluster_info["certificate-authority"] = cfg.ca_file
+        if cluster.get("insecure-skip-tls-verify"):
+            cluster_info["insecure-skip-tls-verify"] = True
+        cfg.exec_config = ExecConfig(
+            command=ex["command"],
+            args=list(ex.get("args") or []),
+            env={
+                e["name"]: e.get("value", "")
+                for e in (ex.get("env") or [])
+                if e.get("name")
+            },
+            api_version=ex.get(
+                "apiVersion", "client.authentication.k8s.io/v1beta1"
+            ),
+            provide_cluster_info=bool(ex.get("provideClusterInfo", False)),
+            install_hint=ex.get("installHint", ""),
+            cluster_info=cluster_info,
         )
     return cfg
 
@@ -320,10 +478,25 @@ def _raise_status(err: urlerror.HTTPError) -> None:
 class KubeClusterClient(ClusterClient):
     """ClusterClient over a real (or wire-compatible) Kubernetes apiserver."""
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+    def __init__(
+        self,
+        config: KubeConfig,
+        timeout: float = 30.0,
+        list_page_size: int = 500,
+        watch_timeout_seconds: float = 300.0,
+    ) -> None:
+        """``list_page_size``: LIST pagination chunk (limit+continue loop; 0
+        disables and fetches whole collections in one response).
+        ``watch_timeout_seconds``: server-side watch budget (the apiserver
+        ends the stream after it, forcing a reconnect); the client also arms
+        a read deadline slightly past it so a silently-dead TCP connection
+        can never wedge the watch thread — the client-go reflector behavior
+        the reference inherits."""
         self._cfg = config
         self._base = config.server.rstrip("/")
         self._timeout = timeout
+        self._list_page_size = list_page_size
+        self._watch_timeout_seconds = watch_timeout_seconds
         self._ssl = config.ssl_context()
         self._watch_stops: dict[Watch, threading.Event] = {}
         self._lock = threading.Lock()
@@ -350,18 +523,31 @@ class KubeClusterClient(ClusterClient):
         content_type: str = "application/json",
     ) -> dict[str, Any]:
         data = json.dumps(body).encode() if body is not None else None
-        req = urlrequest.Request(
-            self._base + path,
-            data=data,
-            method=method,
-            headers=self._headers(content_type if data is not None else None),
-        )
-        try:
-            with self._open(req, self._timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urlerror.HTTPError as e:
-            _raise_status(e)
-            raise  # unreachable
+        retried_auth = False
+        while True:
+            req = urlrequest.Request(
+                self._base + path,
+                data=data,
+                method=method,
+                headers=self._headers(content_type if data is not None else None),
+            )
+            try:
+                with self._open(req, self._timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urlerror.HTTPError as e:
+                if (
+                    e.code == 401
+                    and not retried_auth
+                    and self._cfg.exec_config is not None
+                ):
+                    # Expired/revoked plugin token: re-mint once and retry
+                    # (client-go's exec authenticator refresh-on-401).
+                    LOG.info("401 from apiserver; re-minting exec credential")
+                    self._cfg.invalidate_exec_token()
+                    retried_auth = True
+                    continue
+                _raise_status(e)
+                raise  # unreachable
 
     def _collection(self, kind: str, namespace: str | None) -> str:
         r = _resource_for(kind)
@@ -407,15 +593,49 @@ class KubeClusterClient(ClusterClient):
         namespace: str | None,
         label_selector: dict[str, str] | None = None,
     ) -> dict[str, Any]:
-        params: dict[str, str] = {}
+        """Paginated LIST: limit+continue loop (client-go reflector style) so
+        a 10k-pod collection never lands in one response body. The returned
+        metadata is the FINAL page's — its resourceVersion is the collection
+        RV as of the first page's snapshot, which is what watch resume needs."""
+        base_params: dict[str, str] = {}
         if label_selector:
-            params["labelSelector"] = ",".join(
+            base_params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in sorted(label_selector.items())
             )
-        qs = ("?" + urlparse_mod.urlencode(params)) if params else ""
-        out = self._call("GET", self._collection(kind, namespace) + qs)
-        out.setdefault("items", [])
-        return out
+        if self._list_page_size:
+            base_params["limit"] = str(self._list_page_size)
+        items: list[dict[str, Any]] = []
+        cont: str | None = None
+        while True:
+            params = dict(base_params)
+            if cont:
+                params["continue"] = cont
+            qs = ("?" + urlparse_mod.urlencode(params)) if params else ""
+            try:
+                out = self._call("GET", self._collection(kind, namespace) + qs)
+            except ApiError as e:
+                if cont and getattr(e, "code", None) == 410:
+                    # Continue token expired mid-pagination (etcd compacted
+                    # the list snapshot). client-go's reflector falls back to
+                    # one unpaginated full list; restarting the limit loop
+                    # from page 1 could expire again forever on a slow walk.
+                    LOG.warning(
+                        "list %s continue token expired; falling back to "
+                        "unpaginated list", kind,
+                    )
+                    fallback = {
+                        k: v for k, v in base_params.items() if k != "limit"
+                    }
+                    qs = ("?" + urlparse_mod.urlencode(fallback)) if fallback else ""
+                    out = self._call("GET", self._collection(kind, namespace) + qs)
+                    out.setdefault("items", [])
+                    return out
+                raise
+            items.extend(out.get("items") or [])
+            cont = (out.get("metadata") or {}).get("continue")
+            if not cont:
+                out["items"] = items
+                return out
 
     def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
         ns, name = objects.namespace_of(obj), objects.name_of(obj)
@@ -479,6 +699,13 @@ class KubeClusterClient(ClusterClient):
                         .get("resourceVersion", "")
                     )
                 params = {"watch": "true", "allowWatchBookmarks": "true"}
+                if self._watch_timeout_seconds:
+                    # Server-side budget: the apiserver ends the stream after
+                    # this, so each watch request is finite and reconnects
+                    # re-authenticate (exec tokens rotate naturally).
+                    params["timeoutSeconds"] = str(
+                        max(1, int(self._watch_timeout_seconds))
+                    )
                 if rv:
                     params["resourceVersion"] = rv
                 url = (
@@ -488,7 +715,17 @@ class KubeClusterClient(ClusterClient):
                     + urlparse_mod.urlencode(params)
                 )
                 req = urlrequest.Request(url, headers=self._headers())
-                resp = self._open(req, None)  # no timeout: long-lived stream
+                # Read deadline slightly past the server budget: a
+                # silently-dead TCP connection (no FIN, no data) raises
+                # timeout instead of wedging this thread forever. Heartbeat
+                # chunks from the server reset the socket timer, so an idle
+                # but LIVE stream is unaffected.
+                read_deadline = (
+                    self._watch_timeout_seconds + 30.0
+                    if self._watch_timeout_seconds
+                    else None
+                )
+                resp = self._open(req, read_deadline)
                 watch._resp = resp  # stop_watch closes it to unblock the read
                 for raw in resp:
                     if stopped.is_set():
@@ -513,6 +750,14 @@ class KubeClusterClient(ClusterClient):
             except urlerror.HTTPError as e:
                 if e.code == 410:
                     rv = None
+                elif e.code == 401 and self._cfg.exec_config is not None:
+                    # Revoked/rotated plugin token: without this the watch
+                    # would retry the same stale cached token forever while
+                    # _call re-mints (the informer silently serving stale
+                    # state the whole time).
+                    LOG.info("watch %s got 401; re-minting exec credential", kind)
+                    self._cfg.invalidate_exec_token()
+                    stopped.wait(0.2)
                 elif not stopped.is_set():
                     LOG.warning("watch %s failed: %s; reconnecting", kind, e)
                     stopped.wait(1.0)

@@ -21,8 +21,12 @@ client.Invalid makes create/update return 422 with reason=Invalid.
 
 from __future__ import annotations
 
+import base64
 import json
+import socket
+import struct
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
@@ -136,9 +140,26 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         if validator is not None:
             validator(obj)
 
+    def _authorized(self) -> bool:
+        """When the stub requires a bearer token, reject requests without it
+        (401 Unauthorized, apimachinery-style) — the seam the exec-credential
+        contract tests authenticate through."""
+        required = self.server.required_token
+        if required is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        if got == f"Bearer {required}":
+            return True
+        self._send_json(
+            status_body(401, "Unauthorized", "Unauthorized"), 401
+        )
+        return False
+
     # -- methods ------------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
         route, query = self._route()
         if route is None:
             self._send_json(status_body(404, "NotFound", self.path), 404)
@@ -146,16 +167,49 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         try:
             if route.name is None:
                 if self._q(query, "watch") in ("true", "1"):
-                    self._serve_watch(route)
+                    self._serve_watch(route, query)
                     return
                 raw_sel = self._q(query, "labelSelector")
                 selector = parse_label_selector(raw_sel) if raw_sel else None
                 items = self.server.cluster.list(route.kind, route.namespace, selector)
+                meta: dict = {"resourceVersion": self.server.cluster.current_rv}
+                limit = self._q(query, "limit")
+                if limit:
+                    # Chunked list (limit+continue), apiserver-style: the
+                    # continue token encodes the next offset. The stub serves
+                    # each page from a fresh list (a real apiserver snapshots
+                    # at the first page's RV; close enough for contract
+                    # tests, which hold the collection still across pages).
+                    n = int(limit)
+                    offset = 0
+                    cont = self._q(query, "continue")
+                    if cont:
+                        if self.server.expire_continue_tokens:
+                            # etcd compacted the list snapshot: the token is
+                            # no longer honorable (apiserver 410 Expired).
+                            self._send_json(
+                                status_body(
+                                    410, "Expired",
+                                    "The provided continue parameter is too "
+                                    "old to display a consistent list view.",
+                                ),
+                                410,
+                            )
+                            return
+                        offset = json.loads(base64.b64decode(cont))["offset"]
+                    page = items[offset : offset + n]
+                    if offset + n < len(items):
+                        meta["continue"] = base64.b64encode(
+                            json.dumps({"offset": offset + n}).encode()
+                        ).decode()
+                        meta["remainingItemCount"] = len(items) - offset - n
+                    self.server.list_pages_served += 1
+                    items = page
                 self._send_json(
                     {
                         "kind": "List",
                         "apiVersion": "v1",
-                        "metadata": {"resourceVersion": self.server.cluster.current_rv},
+                        "metadata": meta,
                         "items": items,
                     }
                 )
@@ -169,6 +223,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_api_error(e)
 
     def do_POST(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
         route, _ = self._route()
         if route is None or route.name is not None:
             self._send_json(status_body(404, "NotFound", self.path), 404)
@@ -187,6 +243,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json(status_body(400, "BadRequest", str(e)), 400)
 
     def do_PUT(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
         route, _ = self._route()
         if route is None or route.name is None:
             self._send_json(status_body(404, "NotFound", self.path), 404)
@@ -207,6 +265,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json(status_body(400, "BadRequest", str(e)), 400)
 
     def do_PATCH(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
         route, _ = self._route()
         if route is None or route.name is None or route.subresource is not None:
             self._send_json(status_body(404, "NotFound", self.path), 404)
@@ -231,6 +291,8 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             self._send_json(status_body(400, "BadRequest", str(e)), 400)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if not self._authorized():
+            return
         route, _ = self._route()
         if route is None or route.name is None:
             self._send_json(status_body(404, "NotFound", self.path), 404)
@@ -246,19 +308,58 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
 
     # -- watch --------------------------------------------------------------
 
-    def _serve_watch(self, route: _Route) -> None:
+    def _serve_watch(self, route: _Route, query: dict[str, list[str]]) -> None:
         """ndjson watch stream (chunked). The stub streams from "now"; the
         resourceVersion param is accepted but not replayed — history replay
-        is what the informer's periodic resync compensates for."""
-        watch = self.server.cluster.watch(route.kind, route.namespace)
+        is what the informer's periodic resync compensates for.
+
+        Apiserver behaviors emulated for the reconnect contract tests:
+        ``timeoutSeconds`` ends the stream after the budget (clean EOF), and
+        a resume from a resourceVersion below ``expire_watch_rv_below`` gets
+        the 410-Gone ERROR event a compacted etcd would produce, forcing the
+        client to relist."""
+        rv_param = self._q(query, "resourceVersion")
+        expire_below = self.server.expire_watch_rv_below
+        gone = (
+            rv_param
+            and expire_below is not None
+            and int(rv_param) < expire_below
+        )
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         write_chunk = self.write_chunk
+        if gone:
+            write_chunk(
+                json.dumps(
+                    {
+                        "type": "ERROR",
+                        "object": {
+                            "kind": "Status",
+                            "code": 410,
+                            "reason": "Expired",
+                            "message": f"too old resource version: {rv_param}",
+                        },
+                    }
+                ).encode()
+                + b"\n"
+            )
+            write_chunk(b"")  # terminating chunk: clean stream end
+            return
 
+        deadline = None
+        timeout_s = self._q(query, "timeoutSeconds")
+        if timeout_s:
+            deadline = time.monotonic() + float(timeout_s)
+        watch = self.server.cluster.watch(route.kind, route.namespace)
+        with self.server.watch_conns_lock:
+            self.server.watch_conns.append(self.connection)
         try:
             while not self.server.stopping.is_set():
+                if deadline is not None and time.monotonic() >= deadline:
+                    write_chunk(b"")  # server-side budget: clean EOF
+                    return
                 event = watch.next(timeout=0.5)
                 if event is None:
                     write_chunk(b"\n")  # heartbeat
@@ -267,9 +368,14 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
                     json.dumps({"type": event.type, "object": event.object}).encode()
                     + b"\n"
                 )
-        except (BrokenPipeError, ConnectionResetError):
+        except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
+            with self.server.watch_conns_lock:
+                try:
+                    self.server.watch_conns.remove(self.connection)
+                except ValueError:
+                    pass
             self.server.cluster.stop_watch(watch)
 
     def log_message(self, fmt: str, *args) -> None:
@@ -298,6 +404,38 @@ class KubeApiStub(ThreadingHTTPServer):
         self.validators = validators
         self._mutation_lock = threading.Lock()
         self.stopping = threading.Event()
+        # Contract-test knobs (client-go robustness suite):
+        # watch resume below this RV gets a 410 ERROR event (etcd compaction).
+        self.expire_watch_rv_below: int | None = None
+        # Live watch connections, so kill_watches() can sever them abruptly
+        # (dead-TCP / mid-stream-drop simulation).
+        self.watch_conns: list = []
+        self.watch_conns_lock = threading.Lock()
+        # Observability for pagination tests.
+        self.list_pages_served = 0
+        # When set, every request must carry "Authorization: Bearer <this>"
+        # or it gets a 401 (exec-credential contract tests rotate it).
+        self.required_token: str | None = None
+        # When set, any list continue token gets 410 Expired (compaction).
+        self.expire_continue_tokens = False
+
+    def kill_watches(self) -> int:
+        """Abruptly sever every active watch connection (RST-style), as a
+        network partition or LB idle-timeout would. Returns count killed."""
+        with self.watch_conns_lock:
+            conns, self.watch_conns = self.watch_conns, []
+        for conn in conns:
+            try:
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+                )
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        return len(conns)
 
     def mutation_lock(self, kind: str):
         """Serializes PUT/PATCH of validated kinds (see ApiServer.mutation_lock)."""

@@ -1,0 +1,39 @@
+"""Wire-format timestamp parsing shared across the framework.
+
+One parser for every RFC3339 timestamp that crosses a process boundary:
+K8s metav1.Time fields (always "%Y-%m-%dT%H:%M:%SZ" on the wire) and
+ExecCredential expirationTimestamp (may carry fractional seconds or a
+numeric UTC offset). Centralized so timestamp-handling fixes land once
+(TTL expiry in the controller and token expiry in kubeclient both ride
+this).
+"""
+
+from __future__ import annotations
+
+import calendar
+import datetime
+import time
+
+
+def parse_rfc3339(ts: str) -> float | None:
+    """RFC3339 timestamp → epoch seconds; None when unparseable.
+
+    UTC-safe: parsing goes through timezone-aware datetimes (or
+    calendar.timegm in the fallback), never time.mktime — mktime's DST
+    guessing would shift results by an hour in DST timezones.
+    """
+    base = ts.strip()
+    if base.endswith(("Z", "z")):
+        base = base[:-1] + "+00:00"
+    try:
+        dt = datetime.datetime.fromisoformat(base)
+    except ValueError:
+        # Very old or odd producers (e.g. no offset at all): take the
+        # leading seconds-resolution prefix as UTC.
+        try:
+            return calendar.timegm(time.strptime(ts[:19], "%Y-%m-%dT%H:%M:%S"))
+        except ValueError:
+            return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
