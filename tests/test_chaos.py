@@ -1,0 +1,287 @@
+"""Chaos soak: randomized pod kills across a fleet of concurrent jobs.
+
+The reference carries a vestigial ``--chaos-level`` flag it never
+implemented (cmd/tf-operator/app/options/options.go:41); this is that idea
+done for real, at the O(100)-job design target's shape (~20 jobs, minutes
+of randomized faults). A real TPUJobController runs against the in-memory
+cluster; a fake kubelet advances pods; a chaos injector keeps killing
+random running pods with retryable exit codes (plus two targeted permanent
+faults). Afterwards the system must be CLEAN:
+
+- every job terminal, with the expected terminal type,
+- restart counters exactly equal to the injected fault count per job,
+- zero wedged expectations, a drained workqueue,
+- no leaked PDBs, no pods/services owned by vanished jobs.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+NUM_JOBS = 20
+CHAOS_SECONDS = 120.0
+# Inject only into pods that have been Running at least this long, so the
+# controller's informer has observed the Running phase before the kill —
+# otherwise the restart happens but the counter can read low (the timing
+# edge documented on the preemption test, commit 15593c7).
+MIN_RUNNING_AGE = 0.8
+
+
+def chaos_job(i: int) -> dict:
+    """Jobs 0..14: plain 2-worker; 15..19: v4-8 slice jobs (2-host gang)."""
+    worker: dict = {
+        "restartPolicy": "ExitCode",
+        "maxRestarts": 200,
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "name": constants.DEFAULT_CONTAINER_NAME,
+                        "image": "chaos/none",
+                        "command": ["unused"],
+                    }
+                ]
+            }
+        },
+    }
+    if i >= 15:
+        worker["tpu"] = {"acceleratorType": "v4-8"}  # 2 hosts, gang PDB
+    else:
+        worker["replicas"] = 2
+    return {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": f"chaos-{i}", "namespace": "default"},
+        "spec": {"replicaSpecs": {"Worker": worker}},
+    }
+
+
+class ChaosKubelet(threading.Thread):
+    """Pending → Running immediately; Running → Succeeded only once
+    ``finish`` is set (pods stay alive during the chaos window so there is
+    always something to kill)."""
+
+    def __init__(self, client, stop, finish):
+        super().__init__(daemon=True)
+        self.client = client
+        self.stop_event = stop
+        self.finish = finish
+        self.running_since: dict[str, float] = {}  # uid -> first-seen Running
+
+    def run(self):
+        while not self.stop_event.is_set():
+            now = time.monotonic()
+            for pod in list(self.client.list(objects.PODS, "default")):
+                uid = objects.uid_of(pod)
+                try:
+                    phase = objects.pod_phase(pod)
+                    if phase == objects.PENDING:
+                        objects.set_pod_phase(pod, objects.RUNNING)
+                        self.client.update_status(objects.PODS, pod)
+                        self.running_since.setdefault(uid, now)
+                    elif phase == objects.RUNNING:
+                        self.running_since.setdefault(uid, now)
+                        if self.finish.is_set():
+                            objects.set_pod_phase(pod, objects.SUCCEEDED)
+                            objects.set_container_terminated(
+                                pod, constants.DEFAULT_CONTAINER_NAME, 0
+                            )
+                            self.client.update_status(objects.PODS, pod)
+                except Exception:
+                    continue  # conflict: next pass re-reads, kubelet-style
+            time.sleep(0.05)
+
+
+class ChaosInjector(threading.Thread):
+    """Kills one running pod of a random job per tick (exit 137, retryable).
+
+    One in-flight fault per job: the next injection into a job waits until
+    the previously killed pod incarnation is gone, so each successful
+    injection is exactly one restart event — making the final counters
+    exactly assertable. Two designated jobs additionally get one PERMANENT
+    fault (exit 1) late in the window."""
+
+    def __init__(self, client, kubelet: ChaosKubelet, stop, seed=7):
+        super().__init__(daemon=True)
+        self.client = client
+        self.kubelet = kubelet
+        self.stop_event = stop
+        self.rng = random.Random(seed)
+        self.injected: dict[str, int] = {}  # job -> retryable faults landed
+        self.in_flight: dict[str, str] = {}  # job -> killed pod uid
+        self.permanent_targets = {"chaos-3", "chaos-17"}
+        self.permanent_done: set[str] = set()
+        self.started_at = time.monotonic()
+
+    def _fault(self, pod, code: int) -> bool:
+        try:
+            objects.set_pod_phase(pod, objects.FAILED)
+            objects.set_container_terminated(
+                pod, constants.DEFAULT_CONTAINER_NAME, code
+            )
+            self.client.update_status(objects.PODS, pod)
+            return True
+        except Exception:
+            return False  # conflict: injection did not land; don't count
+
+    def run(self):
+        while not self.stop_event.is_set():
+            time.sleep(self.rng.uniform(0.1, 0.4))
+            pods = list(self.client.list(objects.PODS, "default"))
+            by_job: dict[str, list] = {}
+            uids = set()
+            for p in pods:
+                uids.add(objects.uid_of(p))
+                job = objects.labels_of(p).get(constants.LABEL_JOB_NAME)
+                if job:
+                    by_job.setdefault(job, []).append(p)
+            # Clear in-flight markers whose pod incarnation is gone.
+            for job, uid in list(self.in_flight.items()):
+                if uid not in uids:
+                    del self.in_flight[job]
+            candidates = [
+                j for j in by_job
+                if j not in self.in_flight and j not in self.permanent_done
+            ]
+            if not candidates:
+                continue
+            job = self.rng.choice(candidates)
+            now = time.monotonic()
+            running = [
+                p for p in by_job[job]
+                if objects.pod_phase(p) == objects.RUNNING
+                and now - self.kubelet.running_since.get(
+                    objects.uid_of(p), now
+                ) >= MIN_RUNNING_AGE
+            ]
+            if not running:
+                continue
+            pod = self.rng.choice(running)
+            # Permanent fault for the designated jobs, once, late in the
+            # window (after they have absorbed some retryable chaos).
+            elapsed = time.monotonic() - self.started_at
+            if (
+                job in self.permanent_targets
+                and elapsed > CHAOS_SECONDS * 0.6
+            ):
+                if self._fault(pod, 1):  # exit 1: permanent under ExitCode
+                    self.permanent_done.add(job)
+                continue
+            if self._fault(pod, 137):  # SIGKILL: retryable
+                self.injected[job] = self.injected.get(job, 0) + 1
+                self.in_flight[job] = objects.uid_of(pod)
+
+
+def terminal_type(job) -> str | None:
+    for cond in job.get("status", {}).get("conditions", []):
+        if cond["type"] in ("Succeeded", "Failed") and cond["status"] == "True":
+            return cond["type"]
+    return None
+
+
+@pytest.mark.slow
+def test_chaos_soak_converges_clean():
+    client = InMemoryCluster()
+    controller = TPUJobController(
+        client,
+        JobControllerConfig(
+            reconcile_period=0.3, informer_resync=1.0, threadiness=4
+        ),
+    )
+    stop = threading.Event()
+    finish = threading.Event()
+    threading.Thread(target=controller.run, args=(stop,), daemon=True).start()
+    kubelet = ChaosKubelet(client, stop, finish)
+    kubelet.start()
+    stop_injecting = threading.Event()
+    injector = ChaosInjector(client, kubelet, stop_injecting)
+    try:
+        for i in range(NUM_JOBS):
+            client.create(objects.TPUJOBS, chaos_job(i))
+        time.sleep(2.0)  # fleet comes up
+        injector.start()
+        time.sleep(CHAOS_SECONDS)
+        stop_injecting.set()  # injector only; the system runs on
+        injector.join(timeout=5)
+        time.sleep(1.0)
+        finish.set()  # kubelet now completes surviving/recreated pods
+
+        deadline = time.monotonic() + 180
+        jobs = []
+        while time.monotonic() < deadline:
+            jobs = client.list(objects.TPUJOBS, "default")
+            if all(terminal_type(j) is not None for j in jobs):
+                break
+            time.sleep(0.5)
+        states = {objects.name_of(j): terminal_type(j) for j in jobs}
+        stuck = [n for n, s in states.items() if s is None]
+        assert not stuck, f"jobs never terminal after chaos: {stuck}"
+
+        # Terminal types: permanent-faulted jobs Failed, everything else
+        # recovered to Succeeded.
+        for name, state in states.items():
+            if name in injector.permanent_done:
+                assert state == "Failed", f"{name}: {state}"
+            else:
+                assert state == "Succeeded", f"{name}: {state}"
+
+        # Restart counters exactly match the injected retryable faults.
+        mismatches = {}
+        total_faults = 0
+        for j in jobs:
+            name = objects.name_of(j)
+            want = injector.injected.get(name, 0)
+            got = int(j.get("status", {}).get("restartCount", 0))
+            total_faults += want
+            if got != want:
+                mismatches[name] = (want, got)
+        assert not mismatches, f"restartCount != injected: {mismatches}"
+        assert total_faults >= NUM_JOBS, (
+            f"chaos window too quiet ({total_faults} faults) — not a soak"
+        )
+
+        # Workqueue drains (resync re-enqueues; poll for an empty moment).
+        drained = False
+        drain_deadline = time.monotonic() + 15
+        while time.monotonic() < drain_deadline:
+            if len(controller.queue) == 0:
+                drained = True
+                break
+            time.sleep(0.05)
+        assert drained, f"workqueue never drained ({len(controller.queue)})"
+
+        # No wedged expectations.
+        exp = controller.expectations
+        wedged = [k for k in list(exp._store) if not exp.satisfied(k)]
+        assert not wedged, f"wedged expectations: {wedged}"
+
+        # No leaked gang PDBs once every job is terminal.
+        pdbs = client.list(objects.PDBS, "default")
+        assert not pdbs, f"leaked PDBs: {[objects.name_of(p) for p in pdbs]}"
+
+        # Every surviving pod/service belongs to an existing job.
+        live_jobs = {objects.name_of(j) for j in jobs}
+        for kind in (objects.PODS, objects.SERVICES):
+            for obj in client.list(kind, "default"):
+                owner = objects.labels_of(obj).get(constants.LABEL_JOB_NAME)
+                assert owner in live_jobs, (
+                    f"orphaned {kind} {objects.name_of(obj)} (job {owner})"
+                )
+
+        print(
+            f"\nchaos: {NUM_JOBS} jobs, {CHAOS_SECONDS:.0f}s window, "
+            f"{total_faults} retryable faults + "
+            f"{len(injector.permanent_done)} permanent, all terminal, "
+            f"counters exact, no leaks"
+        )
+    finally:
+        stop.set()
+        time.sleep(0.5)

@@ -93,6 +93,146 @@ def test_release_image_context_is_runnable(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# OCI image build + registry push (py/build_and_push_image.py parity)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_context(tmp_path):
+    ctx = tmp_path / "ctx"
+    (ctx / "pkg").mkdir(parents=True)
+    (ctx / "pkg" / "__init__.py").write_text("VERSION = '1'\n")
+    (ctx / "entry.py").write_text("print('hi')\n")
+    return str(ctx)
+
+
+def test_oci_image_is_deterministic_and_wellformed(tmp_path):
+    import gzip as gzip_mod
+    import hashlib
+    import io
+    import json as json_mod
+    import tarfile as tarfile_mod
+
+    from tf_operator_tpu.release import oci
+
+    ctx = _tiny_context(tmp_path)
+    img1 = oci.build_image(ctx, labels={"l": "v"})
+    img2 = oci.build_image(ctx, labels={"l": "v"})
+    assert img1.manifest_digest == img2.manifest_digest  # reproducible
+    assert img1.layer_digest == (
+        "sha256:" + hashlib.sha256(img1.layer).hexdigest()
+    )
+    raw = gzip_mod.decompress(img1.layer)
+    assert img1.diff_id == "sha256:" + hashlib.sha256(raw).hexdigest()
+    names = tarfile_mod.open(fileobj=io.BytesIO(raw)).getnames()
+    assert "opt/tpu-operator/pkg/__init__.py" in names
+    manifest = json_mod.loads(img1.manifest)
+    assert manifest["config"]["digest"] == img1.config_digest
+    assert manifest["layers"][0]["size"] == len(img1.layer)
+    config = json_mod.loads(img1.config)
+    assert config["rootfs"]["diff_ids"] == [img1.diff_id]
+    assert config["config"]["Entrypoint"][:3] == [
+        "python", "-m", "tf_operator_tpu.cli.operator"
+    ]
+
+
+def test_push_to_registry_stub_and_pull_roundtrip(tmp_path):
+    from tf_operator_tpu.release import oci
+    from tf_operator_tpu.release.registry_stub import RegistryStub
+
+    stub = RegistryStub()
+    stub.start()
+    try:
+        img = oci.build_image(_tiny_context(tmp_path))
+        pushed = oci.push_image(
+            img, stub.url, "tpu-operator", ["v1-g123", "abc123", "latest"]
+        )
+        assert pushed["digest"] == img.manifest_digest
+        host = stub.url.split("://", 1)[1]
+        assert pushed["ref"] == f"{host}/tpu-operator@{img.manifest_digest}"
+        # Pull back by tag AND by digest; bytes must round-trip exactly so
+        # the digest pin stays valid.
+        client = oci.RegistryClient(stub.url)
+        for ref in ("latest", img.manifest_digest):
+            body, digest = client.get_manifest("tpu-operator", ref)
+            assert body == img.manifest and digest == img.manifest_digest
+        assert client.has_blob("tpu-operator", img.layer_digest)
+        assert client.has_blob("tpu-operator", img.config_digest)
+        # Second push: blobs dedup (HEAD hit), manifests re-tag idempotently.
+        oci.push_image(img, stub.url, "tpu-operator", ["latest"])
+        import urllib.request
+
+        tags = json.load(
+            urllib.request.urlopen(stub.url + "/v2/tpu-operator/tags/list")
+        )
+        assert set(tags["tags"]) == {"v1-g123", "abc123", "latest"}
+    finally:
+        stub.stop()
+
+
+def test_registry_rejects_bad_digest_and_orphan_manifest(tmp_path):
+    from tf_operator_tpu.release import oci
+    from tf_operator_tpu.release.registry_stub import RegistryStub
+
+    stub = RegistryStub()
+    stub.start()
+    try:
+        img = oci.build_image(_tiny_context(tmp_path))
+        client = oci.RegistryClient(stub.url)
+        # Upload with a lying digest: registry must verify and refuse.
+        with pytest.raises(oci.RegistryError, match="upload"):
+            client.upload_blob(
+                "r", "sha256:" + "0" * 64, b"not-that-content"
+            )
+        # Manifest referencing never-pushed blobs: refused (the blobs-
+        # before-manifest ordering real registries enforce).
+        with pytest.raises(oci.RegistryError, match="manifest PUT"):
+            client.put_manifest("r", "latest", img)
+    finally:
+        stub.stop()
+
+
+def test_release_cli_pushes_and_deploy_consumes_ref(tmp_path):
+    """End-to-end release: build → push to a local registry → the manifest
+    carries a digest-pinned ref that kube-up templating stamps into
+    deploy/operator.yaml (py/release.py:123,249 + deploy consumption)."""
+    from tf_operator_tpu.harness.deploy import _render_operator_manifest
+    from tf_operator_tpu.release.build import main as release_main
+    from tf_operator_tpu.release.registry_stub import RegistryStub
+
+    stub = RegistryStub()
+    stub.start()
+    try:
+        out = str(tmp_path / "dist")
+        rc = release_main([
+            "--out", out, "--registry", stub.url, "--oci-layout",
+        ])
+        assert rc == 0
+        manifest = json.load(open(os.path.join(out, "manifest.json")))
+        push = manifest["push"]
+        assert push["digest"].startswith("sha256:")
+        assert manifest["git_sha"] in push["tags"]
+        assert "latest" in push["tags"]
+        # OCI layout on disk next to the tarball.
+        layout = manifest["oci_layout"]
+        assert json.load(open(os.path.join(layout, "oci-layout")))[
+            "imageLayoutVersion"
+        ] == "1.0.0"
+        index = json.load(open(os.path.join(layout, "index.json")))
+        assert {
+            m["annotations"]["org.opencontainers.image.ref.name"]
+            for m in index["manifests"]
+        } == set(push["tags"])
+        blob_dir = os.path.join(layout, "blobs", "sha256")
+        assert len(os.listdir(blob_dir)) == 3  # layer + config + manifest
+        # Deploy templating pins the pushed, immutable ref.
+        doc = _render_operator_manifest("prod", image=push["ref"])
+        assert f"image: {push['ref']}" in doc
+        assert "image: tpu-operator:latest" not in doc
+    finally:
+        stub.stop()
+
+
+# ---------------------------------------------------------------------------
 # checks
 # ---------------------------------------------------------------------------
 

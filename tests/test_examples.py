@@ -4,6 +4,7 @@ JAX jobs whose processes rendezvous through the injected topology contract
 analog of the reference's real-TF smoke job (examples/tf_sample/tf_smoke.py
 run as a TFJob)."""
 
+import pytest
 import os
 import sys
 
@@ -11,6 +12,9 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.client import TPUJobClient
 from tf_operator_tpu.runtime import podlogs
 from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+# Real multi-process training E2Es: minutes each on a loaded host.
+pytestmark = pytest.mark.slow
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = os.path.join(REPO_ROOT, "examples")

@@ -114,6 +114,96 @@ def test_kubectl_deploy_command_sequence():
         kubectl_deploy("apply", runner=lambda cmd, **kw: _Fail())
 
 
+def test_gke_provisioner_command_sequences():
+    """cluster-up emits the exact gcloud sequence for a TPU cluster:
+    CPU pool for the operator, one TPU node pool per slice with the right
+    machine type / node count / topology, then get-credentials
+    (py/deploy.py:98,254 parity, TPU-flavored)."""
+    from tf_operator_tpu.harness.deploy import GKEProvisioner, gke_machine_type
+
+    assert gke_machine_type("v5e", 4) == "ct5lp-hightpu-4t"
+    assert gke_machine_type("v5e", 8) == "ct5lp-hightpu-8t"
+    assert gke_machine_type("v5p", 4) == "ct5p-hightpu-4t"
+
+    prov = GKEProvisioner(
+        "ci-cluster", "my-proj", "us-east1-d",
+        accelerator_type="v5e-16", num_slices=2, spot=True,
+    )
+    cmds = prov.up_commands()
+    flat = [" ".join(c) for c in cmds]
+    # create cluster, 2 TPU pools, get-credentials — in that order.
+    assert len(flat) == 4
+    assert "clusters create ci-cluster" in flat[0]
+    assert "--project my-proj" in flat[0] and "--zone us-east1-d" in flat[0]
+    for i in (1, 2):
+        assert f"node-pools create tpu-slice-{i-1}" in flat[i]
+        assert "--machine-type ct5lp-hightpu-4t" in flat[i]
+        assert "--num-nodes 4" in flat[i]  # v5e-16 = 4 hosts x 4 chips
+        assert "--tpu-topology 4x4" in flat[i]
+        assert "--spot" in flat[i]
+    assert "clusters get-credentials ci-cluster" in flat[3]
+
+    down = [" ".join(c) for c in prov.down_commands()]
+    assert down == [
+        "gcloud container clusters delete ci-cluster --project my-proj "
+        "--zone us-east1-d --quiet"
+    ]
+
+    # Single-host slice: no --tpu-topology flag.
+    single = GKEProvisioner(
+        "c2", "p", "z", accelerator_type="v5e-4"
+    ).up_commands()
+    pool = " ".join(single[1])
+    assert "--tpu-topology" not in pool and "--num-nodes 1" in pool
+
+    # Execution path drives the injectable runner; failures surface.
+    ran = []
+
+    class _Ok:
+        returncode = 0
+
+    prov2 = GKEProvisioner(
+        "c3", "p", "z", runner=lambda cmd, **kw: (ran.append(cmd), _Ok())[1]
+    )
+    prov2.up()
+    assert [c[:3] for c in ran][0] == ["gcloud", "container", "clusters"]
+
+    class _Fail:
+        returncode = 1
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError):
+        GKEProvisioner("c4", "p", "z", runner=lambda cmd, **kw: _Fail()).up()
+
+
+def test_gke_provisioner_cli_dry_run(capsys):
+    """`deploy cluster-up --dry-run` prints the exact command sequence and
+    runs nothing (the harness's no-cloud CI mode)."""
+    from tf_operator_tpu.harness.deploy import main as deploy_main
+
+    rc = deploy_main([
+        "cluster-up", "--project", "p1", "--zone", "europe-west4-b",
+        "--accelerator-type", "v5e-16", "--dry-run",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out[0].startswith("gcloud container clusters create tpu-operator-e2e")
+    assert any("node-pools create tpu-slice-0" in line for line in out)
+    assert out[-1].startswith("gcloud container clusters get-credentials")
+
+    rc = deploy_main([
+        "cluster-down", "--project", "p1", "--zone", "europe-west4-b",
+        "--dry-run",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert out == [
+        "gcloud container clusters delete tpu-operator-e2e --project p1 "
+        "--zone europe-west4-b --quiet"
+    ]
+
+
 def test_deploy_manifests_parse():
     """The manifests kube-up applies must be valid YAML docs with the
     objects the deploy sequence assumes (CRD, Deployment named

@@ -4,6 +4,7 @@ under dp / dp+tp+sp shardings, the distributed-env contract parses."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tf_operator_tpu.models.mnist import MnistCNN
 from tf_operator_tpu.models.resnet import resnet18, resnet50
@@ -23,6 +24,10 @@ from tf_operator_tpu.train.steps import (
     make_lm_train_step,
     sgd_momentum,
 )
+
+# Real training loops with CPU-mesh jit compiles: minutes each on a
+# loaded host.
+pytestmark = pytest.mark.slow
 
 
 class TestMnistTraining:

@@ -27,6 +27,14 @@ DEFAULT_CONTAINER_NAME = "tensorflow"
 DEFAULT_PORT_NAME = "tfjob-port"
 DEFAULT_PORT = 2222
 
+# Cross-slice (DCN) rendezvous port for multislice jobs: the MEGASCALE
+# coordinator must NOT share the in-slice coordinator's port — on slice 0's
+# worker 0 BOTH services live in one pod, and real multislice separates them
+# the same way (jax coordinator :8471 vs MEGASCALE coordinator :8080). By
+# convention the DCN port is the job port + this offset; the local executor
+# maps it per pod like the main port.
+DCN_PORT_OFFSET = 1
+
 # Labels stamped on every pod/service the controller creates.  Parity with
 # jobcontroller.GenLabels (jobcontroller.go:132-140) + the pod-level
 # tf-replica-type / tf-replica-index labels (controller_pod.go:109-128).

@@ -108,11 +108,18 @@ def gen_tpu_env(job: TPUJob, replica_type: str, index: int) -> dict[str, str]:
     }
     if num_slices > 1:
         slice0_coord = replica_hostname(job, replica_type, 0)
+        # The DCN rendezvous gets its own port: on slice 0's worker 0 the
+        # in-slice coordinator (jax.distributed) and the cross-slice
+        # coordinator both live in one pod, and they cannot share a bind —
+        # the same separation real multislice makes (MEGASCALE coordinator
+        # :8080 vs jax coordinator :8471).
         env.update(
             {
                 "MEGASCALE_NUM_SLICES": str(num_slices),
                 "MEGASCALE_SLICE_ID": str(slice_id),
-                "MEGASCALE_COORDINATOR_ADDRESS": f"{slice0_coord}:{port}",
+                "MEGASCALE_COORDINATOR_ADDRESS": (
+                    f"{slice0_coord}:{port + constants.DCN_PORT_OFFSET}"
+                ),
             }
         )
     return env

@@ -105,6 +105,9 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         # THIS controller — the in-memory half of the terminal-once guard
         # (see _terminal_already_recorded); cleared when the job is deleted.
         self._terminal_recorded: dict[str, str] = {}
+        # job key -> highest restart_count this process has written; guards
+        # the counter against informer-staleness regression (see sync path).
+        self._restart_floor: dict[str, int] = {}
 
     # ------------------------------------------------------------------ decode
 
@@ -149,6 +152,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
     def delete_job(self, obj: dict[str, Any]) -> None:
         key = f"{objects.namespace_of(obj)}/{objects.name_of(obj)}"
         self._terminal_recorded.pop(key, None)
+        self._restart_floor.pop(key, None)
         for rtype in ReplicaType.ALL:
             self.expectations.delete_expectations(
                 self.expectation_key(key, rtype, "pods")
@@ -261,6 +265,18 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             total = sum(r.replicas or 0 for r in job.spec.replica_specs.values())
             self.sync_pdb(job, total)
 
+        # Monotonic rebase BEFORE reconciling: this controller is the sole
+        # writer of restart_count, but the informer cache can be one status
+        # write stale — a sync computed from that stale base would silently
+        # LOSE the previous sync's increment when the conflict retry
+        # re-stamps the fresh RV (counter regression observed under chaos:
+        # injected 6, counted 5), and the maxRestarts budget check inside
+        # reconcile_pods would over-allow by the same margin. The floor
+        # carries the freshest value this process has ever written.
+        floor = self._restart_floor.get(job.key, 0)
+        if job.status.restart_count < floor:
+            job.status.restart_count = floor
+
         restarts = 0
         permanent_failure = False
         for rtype, spec in sorted(job.spec.replica_specs.items()):
@@ -270,6 +286,8 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             self.reconcile_services(job, rtype, spec, services)
 
         job.status.restart_count += restarts
+        if restarts:
+            self._restart_floor[job.key] = job.status.restart_count
         self.update_job_status(job, pods, restarts, permanent_failure)
         try:
             self.update_status_handler(job)

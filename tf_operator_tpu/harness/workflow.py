@@ -231,7 +231,11 @@ class Workflow:
 
 def default_e2e_workflow(
     *,
-    unit_tests: tuple[str, ...] = ("tests/test_api_types.py", "tests/test_utils.py"),
+    # Default: the documented fast tier (README "Fast vs full tier") — every
+    # suite except the slow-marked training/scale E2Es. Callers (and the
+    # nested workflow run inside test_ci_tooling) override with a narrower
+    # selection via --unit-tests.
+    unit_tests: tuple[str, ...] = ("tests", "-m", "not slow"),
     e2e_workers: int = 2,
     e2e_trials: int = 1,
 ) -> Workflow:

@@ -131,6 +131,56 @@ def build_image_context(repo_root: str, out_dir: str,
     return image_dir
 
 
+def release_image(
+    repo_root: str,
+    out_dir: str,
+    manifest: dict[str, Any],
+    *,
+    registry: str | None = None,
+    repository: str = "tpu-operator",
+    oci_layout: bool = False,
+    token: str | None = None,
+) -> dict[str, Any]:
+    """Build the OCI image from the staged context and publish it.
+
+    Tags: the release tag ({version}-g{sha12}), the full git sha, and
+    "latest" — the reference's tagging scheme (release.py:123,249 tags by
+    git hash; latest rides along for dev clusters). The returned block's
+    digest-pinned ``ref`` is what deploy/operator.yaml templating should
+    consume in production (immutable), via `deploy kube-up --image`.
+    """
+    from tf_operator_tpu.release import oci
+
+    image_dir = manifest.get("image_dir") or build_image_context(
+        repo_root, out_dir, manifest
+    )
+    image = oci.build_image(
+        os.path.join(image_dir, "context"),
+        labels={
+            "org.opencontainers.image.revision": manifest["git_sha"],
+            "org.opencontainers.image.version": manifest["version"],
+            "io.tpuflow.content-digest": manifest["content_digest"],
+        },
+    )
+    tags = [manifest["name"].removeprefix("tpu-operator-")]
+    if manifest["git_sha"] != "unknown":
+        tags.append(manifest["git_sha"])
+    tags.append("latest")
+    out: dict[str, Any] = {
+        "image_digest": image.manifest_digest,
+        "image_tags": tags,
+    }
+    if oci_layout:
+        layout = os.path.join(out_dir, "oci-layout")
+        oci.write_oci_layout(image, layout, tags)
+        out["oci_layout"] = layout
+    if registry:
+        out["push"] = oci.push_image(
+            image, registry, repository, tags, token=token
+        )
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--repo-root", default=os.path.dirname(os.path.dirname(
@@ -139,15 +189,38 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--version", default=None)
     p.add_argument("--image-context", action="store_true",
                    help="also stage a docker build dir (Dockerfile + context)")
+    p.add_argument("--registry", default=None, metavar="URL",
+                   help="push the OCI image here (Registry API v2, e.g. "
+                        "http://127.0.0.1:5000); implies --image-context")
+    p.add_argument("--repository", default="tpu-operator",
+                   help="registry repository for --registry")
+    p.add_argument("--registry-token", default=None,
+                   help="bearer token for --registry")
+    p.add_argument("--oci-layout", action="store_true",
+                   help="write a filesystem OCI image layout into OUT/"
+                        "oci-layout (no registry needed); implies "
+                        "--image-context")
     args = p.parse_args(argv)
     manifest = build_release(args.repo_root, args.out, version=args.version)
-    if args.image_context:
+    wants_image = bool(args.image_context or args.registry or args.oci_layout)
+    if wants_image:
         manifest["image_dir"] = build_image_context(
             args.repo_root, args.out, manifest
         )
         # Full sha: must match the documented `docker build -t` recipe
         # exactly, or the deploy-time image pin points at a never-built tag.
         manifest["image_tag"] = f"tpu-operator:{manifest['git_sha']}"
+    if args.registry or args.oci_layout:
+        manifest.update(
+            release_image(
+                args.repo_root, args.out, manifest,
+                registry=args.registry,
+                repository=args.repository,
+                oci_layout=args.oci_layout,
+                token=args.registry_token,
+            )
+        )
+    if wants_image:
         # Re-write manifest.json so the on-disk manifest (what deploy
         # tooling consumes) carries the image fields, not just stdout.
         with open(os.path.join(args.out, "manifest.json"), "w") as f:

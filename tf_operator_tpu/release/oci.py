@@ -1,0 +1,339 @@
+"""OCI image build + push, pure Python — the release pipeline's image leg.
+
+Parity: py/build_and_push_image.py + py/release.py:123,249 (build the
+operator image, tag it with the git hash, push to a registry the deploy
+manifests consume). The reference shells out to `docker build` and `gcloud
+docker -- push`; here the image is assembled directly — a deterministic
+single-layer OCI image from the staged build context — and pushed over the
+Registry HTTP API v2 (or written to a filesystem OCI layout), so releases
+need no Docker daemon and are reproducible byte-for-byte from the release
+tarball's content digest.
+
+The image mirrors build/Dockerfile's runtime contract (WORKDIR/ENV/
+ENTRYPOINT/CMD/EXPOSE) minus the apt layer: the context tree lands under
+/opt/tpu-operator and the operator CLI is the entrypoint.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import os
+import tarfile
+from dataclasses import dataclass, field
+from typing import Any
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+MANIFEST_MEDIA_TYPE = "application/vnd.oci.image.manifest.v1+json"
+CONFIG_MEDIA_TYPE = "application/vnd.oci.image.config.v1+json"
+LAYER_MEDIA_TYPE = "application/vnd.oci.image.layer.v1.tar+gzip"
+
+# Runtime contract copied from build/Dockerfile (kept in lockstep by
+# tests/test_harness.py's release tests).
+DEFAULT_PREFIX = "/opt/tpu-operator"
+DEFAULT_ENTRYPOINT = ["python", "-m", "tf_operator_tpu.cli.operator"]
+DEFAULT_CMD = [
+    "--serve", "8080", "--serve-host", "0.0.0.0", "--backend", "kube",
+    "--dashboard", "--leader-elect",
+]
+
+
+def digest_of(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+@dataclass
+class OciImage:
+    """A fully-assembled single-layer image: blobs + their digests."""
+
+    layer: bytes  # gzipped tar
+    layer_digest: str
+    diff_id: str  # digest of the UNCOMPRESSED tar (rootfs.diff_ids entry)
+    config: bytes
+    config_digest: str
+    manifest: bytes
+    manifest_digest: str
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def blobs(self) -> dict[str, bytes]:
+        return {
+            self.layer_digest: self.layer,
+            self.config_digest: self.config,
+            self.manifest_digest: self.manifest,
+        }
+
+
+def _deterministic_layer(context_dir: str, prefix: str) -> tuple[bytes, str]:
+    """(gzipped layer bytes, diff_id). Deterministic: sorted members, zeroed
+    times/owners, gzip mtime 0 — same context tree → same digests."""
+    raw = io.BytesIO()
+    with tarfile.open(fileobj=raw, mode="w", format=tarfile.PAX_FORMAT) as tar:
+        # Parent directories of the prefix, root-owned.
+        parts = [p for p in prefix.strip("/").split("/") if p]
+        for i in range(1, len(parts) + 1):
+            info = tarfile.TarInfo("/".join(parts[:i]))
+            info.type = tarfile.DIRTYPE
+            info.mode = 0o755
+            tar.addfile(info)
+        entries: list[tuple[str, str]] = []
+        for dirpath, dirnames, filenames in os.walk(context_dir):
+            dirnames.sort()
+            for d in dirnames:
+                full = os.path.join(dirpath, d)
+                entries.append((full, os.path.relpath(full, context_dir)))
+            for f in sorted(filenames):
+                full = os.path.join(dirpath, f)
+                entries.append((full, os.path.relpath(full, context_dir)))
+        for full, rel in sorted(entries, key=lambda e: e[1]):
+            arcname = f"{prefix.strip('/')}/{rel}"
+            info = tar.gettarinfo(full, arcname=arcname)
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            info.mtime = 0
+            if info.isreg():
+                with open(full, "rb") as fh:
+                    tar.addfile(info, fh)
+            else:
+                tar.addfile(info)
+    tar_bytes = raw.getvalue()
+    diff_id = digest_of(tar_bytes)
+    zbuf = io.BytesIO()
+    with gzip.GzipFile(fileobj=zbuf, mode="wb", mtime=0) as gz:
+        gz.write(tar_bytes)
+    return zbuf.getvalue(), diff_id
+
+
+def build_image(
+    context_dir: str,
+    *,
+    prefix: str = DEFAULT_PREFIX,
+    entrypoint: list[str] | None = None,
+    cmd: list[str] | None = None,
+    env: list[str] | None = None,
+    labels: dict[str, str] | None = None,
+) -> OciImage:
+    """Assemble the OCI image for a staged build context directory."""
+    layer, diff_id = _deterministic_layer(context_dir, prefix)
+    layer_digest = digest_of(layer)
+    config_doc: dict[str, Any] = {
+        "architecture": "amd64",
+        "os": "linux",
+        # Epoch creation time, like the zeroed tar mtimes: reproducibility
+        # beats wall-clock provenance (the git sha carries provenance).
+        "created": "1970-01-01T00:00:00Z",
+        "config": {
+            "Entrypoint": entrypoint or list(DEFAULT_ENTRYPOINT),
+            "Cmd": cmd or list(DEFAULT_CMD),
+            "Env": env or [f"PYTHONPATH={prefix}"],
+            "WorkingDir": prefix,
+            "ExposedPorts": {"8080/tcp": {}},
+            "Labels": labels or {},
+        },
+        "rootfs": {"type": "layers", "diff_ids": [diff_id]},
+        "history": [
+            {
+                "created": "1970-01-01T00:00:00Z",
+                "created_by": "tf_operator_tpu.release.oci build_image",
+            }
+        ],
+    }
+    config = json.dumps(config_doc, sort_keys=True).encode()
+    config_digest = digest_of(config)
+    manifest_doc = {
+        "schemaVersion": 2,
+        "mediaType": MANIFEST_MEDIA_TYPE,
+        "config": {
+            "mediaType": CONFIG_MEDIA_TYPE,
+            "digest": config_digest,
+            "size": len(config),
+        },
+        "layers": [
+            {
+                "mediaType": LAYER_MEDIA_TYPE,
+                "digest": layer_digest,
+                "size": len(layer),
+            }
+        ],
+        "annotations": labels or {},
+    }
+    manifest = json.dumps(manifest_doc, sort_keys=True).encode()
+    return OciImage(
+        layer=layer,
+        layer_digest=layer_digest,
+        diff_id=diff_id,
+        config=config,
+        config_digest=config_digest,
+        manifest=manifest,
+        manifest_digest=digest_of(manifest),
+        annotations=dict(labels or {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Filesystem OCI layout (image-spec image-layout: usable by skopeo/crane/
+# podman without any registry)
+# ---------------------------------------------------------------------------
+
+def write_oci_layout(image: OciImage, out_dir: str, tags: list[str]) -> str:
+    blobs = os.path.join(out_dir, "blobs", "sha256")
+    os.makedirs(blobs, exist_ok=True)
+    for dig, data in image.blobs.items():
+        with open(os.path.join(blobs, dig.split(":", 1)[1]), "wb") as f:
+            f.write(data)
+    with open(os.path.join(out_dir, "oci-layout"), "w") as f:
+        json.dump({"imageLayoutVersion": "1.0.0"}, f)
+    index = {
+        "schemaVersion": 2,
+        "manifests": [
+            {
+                "mediaType": MANIFEST_MEDIA_TYPE,
+                "digest": image.manifest_digest,
+                "size": len(image.manifest),
+                "annotations": {"org.opencontainers.image.ref.name": tag},
+            }
+            for tag in tags
+        ],
+    }
+    with open(os.path.join(out_dir, "index.json"), "w") as f:
+        json.dump(index, f, indent=2, sort_keys=True)
+    return out_dir
+
+
+# ---------------------------------------------------------------------------
+# Registry HTTP API v2 push
+# ---------------------------------------------------------------------------
+
+class RegistryError(Exception):
+    pass
+
+
+class RegistryClient:
+    """Minimal Registry V2 client: blob existence check, monolithic upload,
+    manifest put/get. ``base`` e.g. "http://127.0.0.1:5000" or
+    "https://gcr.io"; ``token`` an optional bearer token."""
+
+    def __init__(self, base: str, token: str | None = None, timeout: float = 60.0):
+        self.base = base.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def _headers(self, extra: dict[str, str] | None = None) -> dict[str, str]:
+        h = dict(extra or {})
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        data: bytes | None = None,
+        headers: dict[str, str] | None = None,
+    ):
+        req = urlrequest.Request(
+            url, data=data, method=method, headers=self._headers(headers)
+        )
+        return urlrequest.urlopen(req, timeout=self.timeout)
+
+    def ping(self) -> None:
+        try:
+            self._request("GET", f"{self.base}/v2/").close()
+        except urlerror.URLError as e:
+            raise RegistryError(f"registry {self.base} unreachable: {e}") from e
+
+    def has_blob(self, repo: str, digest: str) -> bool:
+        try:
+            self._request(
+                "HEAD", f"{self.base}/v2/{repo}/blobs/{digest}"
+            ).close()
+            return True
+        except urlerror.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise RegistryError(f"blob HEAD {digest}: HTTP {e.code}") from e
+
+    def upload_blob(self, repo: str, digest: str, data: bytes) -> None:
+        if self.has_blob(repo, digest):
+            return  # cross-build layer dedup, the registry's whole point
+        try:
+            with self._request(
+                "POST", f"{self.base}/v2/{repo}/blobs/uploads/"
+            ) as resp:
+                location = resp.headers.get("Location")
+            if not location:
+                raise RegistryError("upload POST returned no Location")
+            if location.startswith("/"):
+                location = self.base + location
+            sep = "&" if "?" in location else "?"
+            self._request(
+                "PUT",
+                f"{location}{sep}digest={digest}",
+                data=data,
+                headers={"Content-Type": "application/octet-stream"},
+            ).close()
+        except urlerror.HTTPError as e:
+            raise RegistryError(f"blob upload {digest}: HTTP {e.code}") from e
+
+    def put_manifest(self, repo: str, reference: str, image: OciImage) -> str:
+        try:
+            with self._request(
+                "PUT",
+                f"{self.base}/v2/{repo}/manifests/{reference}",
+                data=image.manifest,
+                headers={"Content-Type": MANIFEST_MEDIA_TYPE},
+            ) as resp:
+                return resp.headers.get(
+                    "Docker-Content-Digest", image.manifest_digest
+                )
+        except urlerror.HTTPError as e:
+            raise RegistryError(
+                f"manifest PUT {reference}: HTTP {e.code}"
+            ) from e
+
+    def get_manifest(self, repo: str, reference: str) -> tuple[bytes, str]:
+        try:
+            with self._request(
+                "GET",
+                f"{self.base}/v2/{repo}/manifests/{reference}",
+                headers={"Accept": MANIFEST_MEDIA_TYPE},
+            ) as resp:
+                body = resp.read()
+                return body, resp.headers.get(
+                    "Docker-Content-Digest", digest_of(body)
+                )
+        except urlerror.HTTPError as e:
+            raise RegistryError(
+                f"manifest GET {reference}: HTTP {e.code}"
+            ) from e
+
+
+def push_image(
+    image: OciImage,
+    registry: str,
+    repo: str,
+    tags: list[str],
+    *,
+    token: str | None = None,
+) -> dict[str, Any]:
+    """Push blobs + manifest (once per tag). Returns the deploy-consumable
+    reference block: a digest-pinned ref (immutable — what production
+    manifests should pin) plus the mutable tag refs."""
+    client = RegistryClient(registry, token)
+    client.ping()
+    client.upload_blob(repo, image.layer_digest, image.layer)
+    client.upload_blob(repo, image.config_digest, image.config)
+    for tag in tags:
+        client.put_manifest(repo, tag, image)
+    host = registry.split("://", 1)[-1]
+    return {
+        "registry": registry,
+        "repository": repo,
+        "digest": image.manifest_digest,
+        "ref": f"{host}/{repo}@{image.manifest_digest}",
+        "tag_refs": [f"{host}/{repo}:{t}" for t in tags],
+        "tags": list(tags),
+    }

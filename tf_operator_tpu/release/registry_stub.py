@@ -1,0 +1,231 @@
+"""In-memory Docker/OCI registry speaking the Registry HTTP API v2.
+
+The contract-test double for the release pipeline's push leg (the role a
+local `registry:2` container plays in CI elsewhere) — and a usable local
+registry for air-gapped dev loops. Covers the subset a pusher/puller needs:
+
+  GET  /v2/                               liveness
+  HEAD/GET /v2/{repo}/blobs/{digest}      blob existence / fetch
+  POST /v2/{repo}/blobs/uploads/          start upload (returns Location)
+  PUT  {location}?digest=...              monolithic upload, digest-verified
+  PUT  /v2/{repo}/manifests/{ref}         tag or digest push
+  GET  /v2/{repo}/manifests/{ref}         by tag or digest
+  GET  /v2/{repo}/tags/list
+
+Parity: the reference's release pipeline pushes through a real gcr.io
+(py/build_and_push_image.py:15-25); the rebuild proves the same wire
+contract against this stub in tests/test_harness.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_BLOB_RE = re.compile(r"^/v2/(?P<repo>.+)/blobs/(?P<digest>sha256:[0-9a-f]{64})$")
+_UPLOAD_START_RE = re.compile(r"^/v2/(?P<repo>.+)/blobs/uploads/$")
+_UPLOAD_RE = re.compile(r"^/v2/(?P<repo>.+)/blobs/uploads/(?P<uid>[0-9a-f-]+)$")
+_MANIFEST_RE = re.compile(r"^/v2/(?P<repo>.+)/manifests/(?P<ref>[^/]+)$")
+_TAGS_RE = re.compile(r"^/v2/(?P<repo>.+)/tags/list$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: "RegistryStub"
+
+    def _reply(self, code: int, body: bytes = b"", headers: dict | None = None):
+        self.send_response(code)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _error(self, code: int, errcode: str, message: str):
+        body = json.dumps(
+            {"errors": [{"code": errcode, "message": message}]}
+        ).encode()
+        self._reply(code, body, {"Content-Type": "application/json"})
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+    # -- GET/HEAD -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if path == "/v2/" or path == "/v2":
+            self._reply(200, b"{}", {"Content-Type": "application/json"})
+            return
+        if m := _BLOB_RE.match(path):
+            with self.server.lock:
+                blob = self.server.blobs.get(m["digest"])
+            if blob is None:
+                self._error(404, "BLOB_UNKNOWN", m["digest"])
+                return
+            self._reply(
+                200, blob,
+                {"Content-Type": "application/octet-stream",
+                 "Docker-Content-Digest": m["digest"]},
+            )
+            return
+        if m := _MANIFEST_RE.match(path):
+            key = (m["repo"], m["ref"])
+            with self.server.lock:
+                digest = self.server.tags.get(key) or (
+                    m["ref"] if m["ref"].startswith("sha256:") else None
+                )
+                manifest = self.server.manifests.get((m["repo"], digest))
+            if manifest is None:
+                self._error(404, "MANIFEST_UNKNOWN", m["ref"])
+                return
+            self._reply(
+                200, manifest["bytes"],
+                {"Content-Type": manifest["media_type"],
+                 "Docker-Content-Digest": digest},
+            )
+            return
+        if m := _TAGS_RE.match(path):
+            with self.server.lock:
+                tags = sorted(
+                    t for (repo, t) in self.server.tags if repo == m["repo"]
+                )
+            self._reply(
+                200,
+                json.dumps({"name": m["repo"], "tags": tags}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            return
+        self._error(404, "UNSUPPORTED", path)
+
+    do_HEAD = do_GET  # noqa: N815 — HEAD shares routing, _reply omits body
+
+    # -- uploads ------------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0]
+        if m := _UPLOAD_START_RE.match(path):
+            uid = str(uuid.uuid4())
+            with self.server.lock:
+                self.server.uploads[uid] = b""
+            self._reply(
+                202, b"",
+                {"Location": f"/v2/{m['repo']}/blobs/uploads/{uid}",
+                 "Docker-Upload-UUID": uid},
+            )
+            return
+        self._error(404, "UNSUPPORTED", path)
+
+    def do_PATCH(self):  # noqa: N802 — chunked upload leg
+        path, _, _query = self.path.partition("?")
+        if m := _UPLOAD_RE.match(path):
+            data = self._body()
+            with self.server.lock:
+                if m["uid"] not in self.server.uploads:
+                    self._error(404, "BLOB_UPLOAD_UNKNOWN", m["uid"])
+                    return
+                self.server.uploads[m["uid"]] += data
+                total = len(self.server.uploads[m["uid"]])
+            self._reply(
+                202, b"",
+                {"Location": f"/v2/{m['repo']}/blobs/uploads/{m['uid']}",
+                 "Range": f"0-{total - 1}"},
+            )
+            return
+        self._error(404, "UNSUPPORTED", path)
+
+    def do_PUT(self):  # noqa: N802
+        path, _, query = self.path.partition("?")
+        if m := _UPLOAD_RE.match(path):
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv
+            )
+            digest = params.get("digest", "")
+            data = self._body()
+            with self.server.lock:
+                data = self.server.uploads.pop(m["uid"], b"") + data
+            actual = "sha256:" + hashlib.sha256(data).hexdigest()
+            if digest != actual:
+                self._error(
+                    400, "DIGEST_INVALID", f"want {digest}, got {actual}"
+                )
+                return
+            with self.server.lock:
+                self.server.blobs[digest] = data
+            self._reply(
+                201, b"",
+                {"Location": f"/v2/{m['repo']}/blobs/{digest}",
+                 "Docker-Content-Digest": digest},
+            )
+            return
+        if m := _MANIFEST_RE.match(path):
+            body = self._body()
+            digest = "sha256:" + hashlib.sha256(body).hexdigest()
+            if m["ref"].startswith("sha256:") and m["ref"] != digest:
+                self._error(400, "DIGEST_INVALID", m["ref"])
+                return
+            # Reject manifests whose referenced blobs were never pushed —
+            # the ordering contract (blobs before manifest) real registries
+            # enforce.
+            try:
+                doc = json.loads(body)
+                refs = [doc["config"]["digest"]] + [
+                    layer["digest"] for layer in doc["layers"]
+                ]
+            except (ValueError, KeyError, TypeError):
+                self._error(400, "MANIFEST_INVALID", "unparseable manifest")
+                return
+            with self.server.lock:
+                missing = [d for d in refs if d not in self.server.blobs]
+            if missing:
+                self._error(
+                    400, "MANIFEST_BLOB_UNKNOWN", ", ".join(missing)
+                )
+                return
+            media = self.headers.get(
+                "Content-Type", "application/vnd.oci.image.manifest.v1+json"
+            )
+            with self.server.lock:
+                self.server.manifests[(m["repo"], digest)] = {
+                    "bytes": body, "media_type": media,
+                }
+                if not m["ref"].startswith("sha256:"):
+                    self.server.tags[(m["repo"], m["ref"])] = digest
+            self._reply(201, b"", {"Docker-Content-Digest": digest})
+            return
+        self._error(404, "UNSUPPORTED", path)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+class RegistryStub(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__((host, port), _Handler)
+        self.blobs: dict[str, bytes] = {}
+        self.manifests: dict[tuple[str, str], dict] = {}
+        self.tags: dict[tuple[str, str], str] = {}
+        self.uploads: dict[str, bytes] = {}
+        self.lock = threading.Lock()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.server_address[0]}:{self.server_address[1]}"
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self.serve_forever, name="registry-stub", daemon=True
+        )
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self.shutdown()

@@ -58,6 +58,10 @@ class LocalProcessExecutor:
         self._namespace = namespace
         self._procs: dict[str, _Running] = {}  # pod key -> process
         self._ports: dict[str, int] = {}  # pod name -> port
+        # Second per-pod port for the cross-slice (DCN) rendezvous of
+        # multislice jobs — in-container "{pod}:{port+DCN_PORT_OFFSET}"
+        # contracts rewrite to this (see cluster_spec.gen_tpu_env).
+        self._dcn_ports: dict[str, int] = {}
         self._lock = threading.RLock()
         self._log = logger.with_fields(component="local-executor")
         self._stop: threading.Event | None = None
@@ -102,6 +106,12 @@ class LocalProcessExecutor:
                 self._ports[pod_name] = _free_port()
             return self._ports[pod_name]
 
+    def _dcn_port_for(self, pod_name: str) -> int:
+        with self._lock:
+            if pod_name not in self._dcn_ports:
+                self._dcn_ports[pod_name] = _free_port()
+            return self._dcn_ports[pod_name]
+
     def _ensure_job_ports(self, pod: dict[str, Any]) -> None:
         """Allocate ports for every EXPECTED replica of the owning job before
         launch, derived from the job spec (not from currently-listed pods),
@@ -118,8 +128,14 @@ class LocalProcessExecutor:
 
             for rtype, spec in job.get("spec", {}).get("replicaSpecs", {}).items():
                 replicas = int(spec.get("replicas", 1) or 1)
+                multislice = int((spec.get("tpu") or {}).get("numSlices", 1) or 1) > 1
                 for i in range(replicas):
                     self._port_for(names_util.gen_name(job_name, rtype, i))
+                    if multislice:
+                        # Multislice contracts reference a second (DCN) port
+                        # per pod; allocate it up front so MEGASCALE
+                        # addresses rewrite consistently across siblings.
+                        self._dcn_port_for(names_util.gen_name(job_name, rtype, i))
             return
         except NotFound:
             pass
@@ -136,9 +152,16 @@ class LocalProcessExecutor:
         """Rewrite "{pod-name}:{port}" references of known pods to their
         localhost address. Bare pod names (no port) are left untouched —
         every injected contract (TF_CONFIG, TPU_WORKER_HOSTNAMES,
-        coordinator address) carries explicit ports."""
+        coordinator address) carries explicit ports. The DCN port
+        (default_port + DCN_PORT_OFFSET, multislice contracts) rewrites
+        first — its literal is longer, so the main-port replace cannot
+        corrupt it."""
         with self._lock:
             ports = dict(self._ports)
+            dcn_ports = dict(self._dcn_ports)
+        dcn_port = default_port + constants.DCN_PORT_OFFSET
+        for name, port in dcn_ports.items():
+            value = value.replace(f"{name}:{dcn_port}", f"127.0.0.1:{port}")
         for name, port in ports.items():
             value = value.replace(f"{name}:{default_port}", f"127.0.0.1:{port}")
         return value

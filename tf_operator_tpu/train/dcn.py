@@ -1,0 +1,214 @@
+"""Cross-slice (DCN) collective channel for multislice training.
+
+The transport analog of the MEGASCALE coordinator the operator wires up for
+``num_slices > 1`` jobs (controller/cluster_spec.py gen_tpu_env): each
+slice is its own jax.distributed process group running ICI collectives
+internally; gradients/params are synchronized ACROSS slices over the data
+center network. On real TPU multislice, libtpu's MEGASCALE transport does
+this under one global jit; this module is the framework-level fallback and
+the CPU-testable contract proof — slice leaders (in-slice process 0) meet
+at MEGASCALE_COORDINATOR_ADDRESS and run allreduce over TCP, then
+broadcast the result to their in-slice peers through the existing
+jax.distributed group (multihost_utils.broadcast_one_to_all, which rides
+the ICI mesh on hardware).
+
+SURVEY.md §2.9: "keep DNS rendezvous for inter-slice DCN" — the address IS
+a pod DNS name + port, so the same code runs under the local executor
+(rewritten to 127.0.0.1) and on a real cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import time
+from typing import Any
+
+import numpy as np
+
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="dcn")
+
+_HDR = struct.Struct("!I")  # 4-byte big-endian frame length
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    buf = b""
+    while len(buf) < _HDR.size:
+        chunk = sock.recv(_HDR.size - len(buf))
+        if not chunk:
+            raise ConnectionError("DCN peer closed mid-header")
+        buf += chunk
+    (n,) = _HDR.unpack(buf)
+    parts: list[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(1 << 20, n - got))
+        if not chunk:
+            raise ConnectionError("DCN peer closed mid-frame")
+        parts.append(chunk)
+        got += len(chunk)
+    return pickle.loads(b"".join(parts))
+
+
+class CrossSliceChannel:
+    """Slice-leader rendezvous at the MEGASCALE coordinator address.
+
+    Only in-slice process 0 of each slice participates in the TCP leg;
+    every process constructs the channel (non-leaders get a no-op handle
+    whose :meth:`allreduce` raises — callers pair it with an in-slice
+    broadcast, see :func:`cross_slice_mean`).
+    """
+
+    def __init__(
+        self,
+        slice_id: int,
+        num_slices: int,
+        coordinator_address: str,
+        *,
+        is_slice_leader: bool,
+        timeout: float = 120.0,
+    ) -> None:
+        self.slice_id = slice_id
+        self.num_slices = num_slices
+        self.is_slice_leader = is_slice_leader
+        self._timeout = timeout
+        self._listener: socket.socket | None = None
+        self._peers: dict[int, socket.socket] = {}  # slice_id -> conn (on slice 0)
+        self._sock: socket.socket | None = None  # on slices > 0
+        if not is_slice_leader or num_slices < 2:
+            return
+        host, port_s = coordinator_address.rsplit(":", 1)
+        port = int(port_s)
+        if slice_id == 0:
+            self._bind_and_accept(host, port)
+        else:
+            self._connect(host, port)
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _bind_and_accept(self, host: str, port: int) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # The contract address names THIS pod; bind all interfaces so DNS
+        # resolution differences (pod IP vs localhost rewrite) don't matter.
+        srv.bind(("", port))
+        srv.listen(self.num_slices)
+        srv.settimeout(self._timeout)
+        self._listener = srv
+        deadline = time.monotonic() + self._timeout
+        while len(self._peers) < self.num_slices - 1:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"DCN rendezvous: {len(self._peers) + 1}/{self.num_slices}"
+                    " slices present at timeout"
+                )
+            conn, _ = srv.accept()
+            # accept() does not inherit the listener's timeout: without this
+            # a peer that connects then stalls would block recv() forever.
+            conn.settimeout(self._timeout)
+            hello = _recv_msg(conn)
+            self._peers[int(hello["slice_id"])] = conn
+        LOG.info("DCN rendezvous complete: %d slices", self.num_slices)
+
+    def _connect(self, host: str, port: int) -> None:
+        deadline = time.monotonic() + self._timeout
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                sock.settimeout(self._timeout)
+                _send_msg(sock, {"slice_id": self.slice_id})
+                self._sock = sock
+                return
+            except OSError as e:  # coordinator not up yet
+                last = e
+                time.sleep(0.2)
+        raise TimeoutError(
+            f"DCN connect to {host}:{port} failed within budget: {last}"
+        )
+
+    # -- collectives --------------------------------------------------------
+
+    def allreduce(self, arrays: list[np.ndarray], op: str = "mean") -> list[np.ndarray]:
+        """Leader-side allreduce: slice 0 gathers, reduces, fans back out."""
+        if not self.is_slice_leader:
+            raise RuntimeError("allreduce is leader-only; use cross_slice_mean")
+        if self.num_slices < 2:
+            return arrays
+        if self.slice_id == 0:
+            acc = [np.asarray(a, dtype=np.float32).copy() for a in arrays]
+            for sid in sorted(self._peers):
+                theirs = _recv_msg(self._peers[sid])
+                for mine, other in zip(acc, theirs):
+                    mine += other
+            if op == "mean":
+                for a in acc:
+                    a /= self.num_slices
+            for sid in sorted(self._peers):
+                _send_msg(self._peers[sid], acc)
+            return acc
+        assert self._sock is not None
+        _send_msg(self._sock, [np.asarray(a, dtype=np.float32) for a in arrays])
+        return _recv_msg(self._sock)
+
+    def close(self) -> None:
+        for sock in (*self._peers.values(), self._sock, self._listener):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        self._peers.clear()
+        self._sock = self._listener = None
+
+
+def channel_from_env(
+    env: dict[str, str] | None = None, *, in_slice_process_id: int, timeout: float = 120.0
+) -> CrossSliceChannel | None:
+    """Build the channel from the operator-injected MEGASCALE env (None for
+    single-slice jobs — no DCN leg to run)."""
+    env = dict(os.environ if env is None else env)
+    num_slices = int(env.get("MEGASCALE_NUM_SLICES", "1"))
+    if num_slices < 2:
+        return None
+    return CrossSliceChannel(
+        int(env.get("MEGASCALE_SLICE_ID", "0")),
+        num_slices,
+        env["MEGASCALE_COORDINATOR_ADDRESS"],
+        is_slice_leader=in_slice_process_id == 0,
+        timeout=timeout,
+    )
+
+
+def cross_slice_mean(channel: CrossSliceChannel | None, tree: Any) -> Any:
+    """Mean a pytree of arrays across slices: DCN allreduce between slice
+    leaders, then in-slice broadcast from the leader over the existing
+    jax.distributed group. No-op for single-slice jobs (channel None).
+
+    This is the framework's param/grad sync for CPU-tested multislice and
+    the documented fallback where MEGASCALE-in-jit is unavailable."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    if channel is None:
+        return tree
+    leaves, treedef = jax.tree.flatten(tree)
+    if channel.is_slice_leader:
+        reduced = channel.allreduce([np.asarray(leaf) for leaf in leaves])
+    else:
+        reduced = [np.zeros_like(np.asarray(leaf)) for leaf in leaves]
+    # In-slice broadcast rides the slice's own process group (ICI on
+    # hardware): process 0 is the DCN participant, everyone else receives.
+    reduced = multihost_utils.broadcast_one_to_all(
+        tuple(reduced), is_source=channel.is_slice_leader
+    )
+    return jax.tree.unflatten(treedef, list(reduced))
