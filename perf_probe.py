@@ -1,0 +1,235 @@
+"""Perf decomposition probes — the bench-day triage tool.
+
+`bench.py` produces the headline numbers; this script attributes a gap.
+Each probe prints one JSON line; run all or pick with PROBE=name. Probes:
+
+- ``h2d``: host→device bandwidth for a bench-shaped uint8 batch (the
+  tunnel-transport roofline; images/sec ≤ bw / 150528 B).
+- ``input``: host input pipeline standalone — loader-only and
+  loader+augment images/sec (if this is below the achieved device rate,
+  the chip is starved and nothing on-device will help).
+- ``fwd_split``: ResNet fwd-only vs fwd+bwd step time (a bwd/fwd ratio
+  far from ~2 points at gradient-path problems, e.g. dtype upcasts).
+- ``stem``: ResNet img/s with conv7 vs s2d stem, synthetic device-resident
+  input (isolates the MXU effect of the stem rewrite from input noise).
+- ``synthetic``: ResNet img/s on device-resident synthetic data (the
+  compute ceiling; the gap to bench.py's native-input number is the
+  input+transfer cost).
+
+Usage on hardware:   python perf_probe.py
+Structure check:     BENCH_SMOKE=1 PROBE=input python perf_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bench  # noqa: E402 — reuse shapes/constants so probes match the bench
+
+
+def emit(probe: str, **kw) -> None:
+    print(json.dumps({"probe": probe, **{
+        k: round(v, 3) if isinstance(v, float) else v for k, v in kw.items()
+    }}), flush=True)
+
+
+def probe_h2d() -> None:
+    import jax
+
+    batch_bytes = bench.BATCH * bench.IMAGE_SIZE * bench.IMAGE_SIZE * 3
+    x = np.random.default_rng(0).integers(
+        0, 256, (bench.BATCH, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), np.uint8
+    )
+    jax.block_until_ready(jax.device_put(x))  # warm path
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(jax.device_put(x))
+    dt = (time.perf_counter() - t0) / reps
+    gbps = batch_bytes / dt / 1e9
+    emit(
+        "h2d", gbps=gbps, ms_per_batch=dt * 1e3,
+        images_per_sec_ceiling=bench.BATCH / dt,
+    )
+
+
+def probe_input() -> None:
+    from tf_operator_tpu.native.augment import augment_batch
+    from tf_operator_tpu.native.pipeline import RecordPipeline, write_records
+
+    record_size = (
+        bench.IMAGE_SIZE + 32 if bench.IMAGE_SIZE >= 64 else bench.IMAGE_SIZE
+    )
+    rec_bytes = record_size * record_size * 3 + 1
+    num_records = 1024
+    path = f"/tmp/bench_records_{record_size}.bin"
+    if not os.path.exists(path) or os.path.getsize(path) != num_records * rec_bytes:
+        rng = np.random.default_rng(0)
+        write_records(
+            path,
+            rng.integers(0, 256, (num_records, rec_bytes), dtype=np.uint8),
+        )
+
+    def run(with_augment: bool, n: int = 20) -> float:
+        pipe = RecordPipeline(
+            path, rec_bytes, bench.BATCH, prefetch=8, threads=4, seed=0,
+            loop=True,
+        )
+        it = iter(pipe)
+        next(it)  # warm
+        count = 0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            raw = next(it)
+            while raw.shape[0] < bench.BATCH:
+                raw = np.concatenate([raw, next(it)])[: bench.BATCH]
+            if with_augment:
+                full = raw[:, :-1].reshape(
+                    bench.BATCH, record_size, record_size, 3
+                )
+                augment_batch(
+                    full, (bench.IMAGE_SIZE, bench.IMAGE_SIZE), seed=1,
+                    index0=count, threads=8,
+                )
+            count += bench.BATCH
+        dt = time.perf_counter() - t0
+        pipe.close()
+        return n * bench.BATCH / dt
+
+    emit(
+        "input",
+        loader_images_per_sec=run(False),
+        loader_augment_images_per_sec=run(True),
+        cpus=os.cpu_count(),
+        loadavg_1m=os.getloadavg()[0],
+    )
+
+
+def _resnet_setup(stem: str | None = None):
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.resnet import resnet50
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate
+    from tf_operator_tpu.train.steps import (
+        TrainState, make_classifier_train_step, sgd_momentum,
+    )
+
+    mesh = create_mesh({"dp": len(jax.devices())}, jax.devices())
+    stem = stem or os.environ.get("BENCH_STEM", "conv7")
+    model = resnet50(dtype=jnp.bfloat16, stem=stem)
+    x = jnp.zeros(
+        (bench.BATCH, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), jnp.bfloat16
+    )
+    y = jnp.zeros((bench.BATCH,), jnp.int32)
+    variables = model.init(
+        __import__("jax").random.PRNGKey(0), x, train=True
+    )
+    tx = sgd_momentum(0.1)
+    state = replicate(
+        mesh,
+        TrainState.create(
+            variables["params"], tx,
+            batch_stats=variables.get("batch_stats"),
+        ),
+    )
+    step = make_classifier_train_step(
+        model, tx, mesh, has_batch_stats=True, donate=False
+    )
+    return mesh, model, state, step, {"image": x, "label": y}
+
+
+def probe_fwd_split() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    mesh, model, state, step, batch = _resnet_setup()
+
+    @jax.jit
+    def fwd_only(state, batch):
+        out, _ = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"],
+        )
+        return jnp.mean(out)
+
+    def timeit(fn, *args, reps=5):
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    t_fwd = timeit(fwd_only, state, batch)
+    t_full = timeit(lambda s, b: step(s, b)[0], state, batch)
+    emit(
+        "fwd_split", fwd_ms=t_fwd * 1e3, full_step_ms=t_full * 1e3,
+        bwd_over_fwd=(t_full - t_fwd) / t_fwd if t_fwd else 0.0,
+    )
+
+
+def _synthetic_rate(stem: str) -> float:
+    from tf_operator_tpu.train.steps import fuse_steps
+
+    mesh, model, state, step, batch = _resnet_setup(stem)
+    fused = fuse_steps(step, bench.FUSED_STEPS, donate=False)
+    state2, metrics = fused(state, batch)
+    float(metrics["loss"])  # compile + complete
+    t0 = time.perf_counter()
+    for _ in range(bench.MEASURE_CALLS):
+        state2, metrics = fused(state2, batch)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return bench.MEASURE_CALLS * bench.FUSED_STEPS * bench.BATCH / dt
+
+
+def probe_synthetic() -> None:
+    emit("synthetic", images_per_sec=_synthetic_rate(
+        os.environ.get("BENCH_STEM", "conv7")
+    ))
+
+
+def probe_stem() -> None:
+    conv7 = _synthetic_rate("conv7")
+    s2d = _synthetic_rate("s2d")
+    emit(
+        "stem", conv7_images_per_sec=conv7, s2d_images_per_sec=s2d,
+        s2d_speedup=s2d / conv7 if conv7 else 0.0,
+    )
+
+
+PROBES = {
+    "h2d": probe_h2d,
+    "input": probe_input,
+    "fwd_split": probe_fwd_split,
+    "synthetic": probe_synthetic,
+    "stem": probe_stem,
+}
+
+
+def main() -> None:
+    if os.environ.get("BENCH_SMOKE"):
+        from tf_operator_tpu.parallel.testing import force_cpu_mesh
+
+        force_cpu_mesh(1)
+    only = os.environ.get("PROBE")
+    for name, fn in PROBES.items():
+        if only and name != only:
+            continue
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — each probe independent
+            print(f"probe {name} failed: {exc!r}", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

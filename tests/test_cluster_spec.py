@@ -79,7 +79,10 @@ class TestTPUEnv:
             "ms-worker-4,ms-worker-5,ms-worker-6,ms-worker-7"
         )
         assert env[constants.ENV_COORDINATOR_ADDRESS] == "ms-worker-4:2222"
-        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-worker-0:2222"
+        # The DCN rendezvous has its OWN port (job port + DCN_PORT_OFFSET):
+        # on slice 0's worker 0 the in-slice jax coordinator and the
+        # cross-slice coordinator share a pod and cannot share a bind.
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-worker-0:2223"
 
     def test_non_tpu_replica_no_env(self):
         job = testutil.new_tpujob(worker=2)

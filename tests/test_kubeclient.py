@@ -963,3 +963,55 @@ def test_full_job_lifecycle_over_kube_backend():
         stop.set()
         time.sleep(0.3)
         stub.stop()
+
+
+# ---------------------------------------------------------------------------
+# Optional real-cluster smoke (skipped unless pointed at a cluster)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("TPUFLOW_E2E_KUBECONFIG"),
+    reason="set TPUFLOW_E2E_KUBECONFIG to a kubeconfig to run the "
+    "real-apiserver smoke (no cluster in CI)",
+)
+def test_real_apiserver_smoke():
+    """The contract cases a stub cannot fully vouch for — auth handshake,
+    TLS, pagination against real etcd, RV semantics across compaction —
+    exercised against an actual apiserver (kind/minikube/GKE) when one is
+    provided. Creates and deletes a namespaced ConfigMap-scale object (a
+    Pod) and round-trips list/watch."""
+    cfg = load_kubeconfig(os.environ["TPUFLOW_E2E_KUBECONFIG"])
+    client = KubeClusterClient(cfg, list_page_size=2)
+    name = f"tpuflow-smoke-{os.getpid()}"
+    p = pod(name)
+    p["spec"] = {
+        "containers": [{"name": "pause", "image": "registry.k8s.io/pause:3.9"}]
+    }
+    created = client.create(objects.PODS, p)
+    try:
+        assert objects.uid_of(created)
+        # Paginated list path against real etcd.
+        listed = client.list(objects.PODS, "default")
+        assert any(objects.name_of(o) == name for o in listed)
+        w = client.watch(objects.PODS, "default")
+        try:
+            # The watch pins its resourceVersion asynchronously; keep
+            # patching (each patch is a fresh event) until one is delivered
+            # instead of racing a fixed sleep against a remote apiserver.
+            deadline = time.monotonic() + 30
+            saw = False
+            n = 0
+            while time.monotonic() < deadline and not saw:
+                client.patch_merge(
+                    objects.PODS, "default", name,
+                    {"metadata": {"labels": {"tpuflow-smoke": str(n)}}},
+                )
+                n += 1
+                ev = w.next(timeout=1.0)
+                saw = ev is not None and objects.name_of(ev.object) == name
+            assert saw, "watch never delivered any patch event"
+        finally:
+            client.stop_watch(w)
+    finally:
+        client.delete(objects.PODS, "default", name)
