@@ -61,7 +61,7 @@ def probe_h2d() -> None:
 
 
 def probe_input() -> None:
-    from tf_operator_tpu.native.augment import augment_batch
+    from tf_operator_tpu.native.augment import augment_records
     from tf_operator_tpu.native.pipeline import RecordPipeline, write_records
 
     record_size = (
@@ -91,11 +91,9 @@ def probe_input() -> None:
             while raw.shape[0] < bench.BATCH:
                 raw = np.concatenate([raw, next(it)])[: bench.BATCH]
             if with_augment:
-                full = raw[:, :-1].reshape(
-                    bench.BATCH, record_size, record_size, 3
-                )
-                augment_batch(
-                    full, (bench.IMAGE_SIZE, bench.IMAGE_SIZE), seed=1,
+                augment_records(
+                    raw, (record_size, record_size, 3),
+                    (bench.IMAGE_SIZE, bench.IMAGE_SIZE), seed=1,
                     index0=count, threads=8,
                 )
             count += bench.BATCH
@@ -103,10 +101,39 @@ def probe_input() -> None:
         pipe.close()
         return n * bench.BATCH / dt
 
+    # The zero-copy path bench.py actually uses: mmap + gather-augment.
+    from tf_operator_tpu.native.augment import augment_gather
+    from tf_operator_tpu.native.pipeline import MMapRecordPipeline
+
+    def run_mmap(n: int = 40) -> float:
+        pipe = MMapRecordPipeline(
+            path, rec_bytes, bench.BATCH, seed=0, loop=True
+        )
+        out = np.empty(
+            (bench.BATCH, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), np.uint8
+        )
+        count = 0
+        pipe.next_indices()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            idx = pipe.next_indices()
+            while len(idx) < bench.BATCH:
+                idx = np.concatenate([idx, pipe.next_indices()])[: bench.BATCH]
+            augment_gather(
+                pipe.data, idx, rec_bytes,
+                (record_size, record_size, 3),
+                (bench.IMAGE_SIZE, bench.IMAGE_SIZE), seed=1,
+                index0=count, threads=8, out=out,
+            )
+            pipe.labels(idx)
+            count += bench.BATCH
+        return n * bench.BATCH / (time.perf_counter() - t0)
+
     emit(
         "input",
         loader_images_per_sec=run(False),
         loader_augment_images_per_sec=run(True),
+        mmap_gather_images_per_sec=run_mmap(),
         cpus=os.cpu_count(),
         loadavg_1m=os.getloadavg()[0],
     )

@@ -45,6 +45,8 @@ def operator(tmp_path_factory):
             sys.executable, "-m", "tf_operator_tpu.cli.operator",
             "--serve", str(port), "--local-executor",
             "--reconcile-period", "0.3", "--informer-resync", "1.0",
+            # No leaked operators when the pytest process is SIGKILLed.
+            "--exit-with-parent",
         ],
         # Log to a file, not a PIPE: an undrained pipe fills its ~64KB
         # buffer and blocks the operator mid-reconcile (looks like a hang).

@@ -245,3 +245,94 @@ class TestFaultInjection:
         wait_for(job_condition(client, "doomed", "Running"), desc="Running")
         http_get(executor, "doomed-worker-0", "/exit?exitCode=1")
         wait_for(job_condition(client, "doomed", "Failed"), desc="Failed")
+
+
+class TestNoLeakedProcesses:
+    @pytest.mark.skipif(
+        sys.platform != "linux",
+        reason="PDEATHSIG is Linux-only (the feature degrades to a no-op "
+        "elsewhere by design); also relies on procps ps output",
+    )
+    def test_sigkilled_operator_leaves_no_children(self, tmp_path):
+        """A SIGKILLed operator (pytest-timeout reaper, OOM kill) must not
+        leak its pod processes: PDEATHSIG tears the tree down (observed in
+        the wild as leaked operators churning 90% of a CI core)."""
+        import os
+        import signal as signal_mod
+        import socket
+        import subprocess
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "tf_operator_tpu.cli.operator",
+                "--serve", str(port), "--local-executor",
+                "--reconcile-period", "0.3", "--exit-with-parent",
+            ],
+            env=env,
+            stdout=open(tmp_path / "op.log", "wb"), stderr=subprocess.STDOUT,
+        )
+        base = f"http://127.0.0.1:{port}"
+        deadline = time.monotonic() + 90
+        up = False
+        while time.monotonic() < deadline and not up:
+            try:
+                urllib.request.urlopen(base + "/api/tpujobs", timeout=1)
+                up = True
+            except Exception:
+                assert proc.poll() is None, open(tmp_path / "op.log").read()
+                time.sleep(0.2)
+        assert up, "operator never came up"
+
+        try:
+            # A job whose pod is a real long-running process.
+            from tf_operator_tpu.client import TPUJobClient
+            from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+            cli = TPUJobClient(RestClusterClient(base))
+            cli.create({
+                "apiVersion": constants.API_VERSION,
+                "kind": constants.KIND,
+                "metadata": {"name": "leakcheck", "namespace": "default"},
+                "spec": {"replicaSpecs": {"Worker": {"replicas": 1, "template": {
+                    "spec": {"containers": [{
+                        "name": constants.DEFAULT_CONTAINER_NAME,
+                        "image": "local", "command": SERVER_CMD,
+                    }]}}}}},
+            })
+            pod_pid = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and pod_pid is None:
+                out = subprocess.run(
+                    ["ps", "-eo", "pid,ppid,args"],
+                    capture_output=True, text=True,
+                ).stdout
+                for line in out.splitlines():
+                    cols = line.split(None, 2)
+                    if (len(cols) == 3 and cols[1] == str(proc.pid)
+                            and "test_server" in cols[2]):
+                        pod_pid = int(cols[0])
+                time.sleep(0.3)
+            assert pod_pid is not None, "pod process never appeared"
+
+            # SIGKILL the operator: no cleanup code can run; kernel-side
+            # PDEATHSIG must still reap the pod process.
+            proc.send_signal(signal_mod.SIGKILL)
+            proc.wait(timeout=10)
+            deadline = time.monotonic() + 15
+            gone = False
+            while time.monotonic() < deadline and not gone:
+                try:
+                    os.kill(pod_pid, 0)
+                    time.sleep(0.2)
+                except ProcessLookupError:
+                    gone = True
+            assert gone, f"pod process {pod_pid} leaked after operator SIGKILL"
+        finally:
+            if proc.poll() is None:
+                proc.kill()

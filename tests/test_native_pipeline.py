@@ -308,3 +308,122 @@ def test_record_dataset_with_crop(tmp_path):
     # misconfiguration raises at the call site, not on first next()
     with pytest.raises(ValueError):
         record_dataset(path, (12, 12, 3), np.float32, 3, crop_hw=(8, 8))
+
+
+class TestAugmentRecords:
+    def test_records_path_matches_batch_path(self):
+        """augment_records (strided, zero-copy glue) must be bit-identical
+        to augment_batch over the sliced-and-reshaped image batch, for both
+        engines."""
+        import numpy as np
+
+        from tf_operator_tpu.native.augment import augment_batch, augment_records
+
+        rng = np.random.default_rng(3)
+        n, rs, os_ = 16, 40, 32
+        rec_bytes = rs * rs * 3 + 1
+        records = rng.integers(0, 256, (n, rec_bytes), np.uint8)
+        images = records[:, :-1].reshape(n, rs, rs, 3)
+
+        for engine in ("native", "python"):
+            try:
+                via_batch = augment_batch(
+                    images, (os_, os_), seed=9, index0=7, engine=engine
+                )
+                via_records = augment_records(
+                    records, (rs, rs, 3), (os_, os_), seed=9, index0=7,
+                    engine=engine,
+                )
+            except Exception as e:  # native engine may be unavailable
+                if engine == "native":
+                    import pytest as _pytest
+
+                    _pytest.skip(f"native engine unavailable: {e}")
+                raise
+            assert (via_batch == via_records).all(), engine
+
+    def test_out_param_writes_in_place(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from tf_operator_tpu.native.augment import augment_records
+
+        rng = np.random.default_rng(4)
+        n, rs, os_ = 4, 24, 16
+        records = rng.integers(0, 256, (n, rs * rs * 3 + 1), np.uint8)
+        stacked = np.zeros((2, n, os_, os_, 3), np.uint8)
+        got = augment_records(
+            records, (rs, rs, 3), (os_, os_), seed=1, out=stacked[1]
+        )
+        assert got.base is stacked or got is stacked[1] or (
+            got.__array_interface__["data"][0]
+            == stacked[1].__array_interface__["data"][0]
+        )
+        assert stacked[1].any() and not stacked[0].any()
+
+        with _pytest.raises(ValueError, match="out must be"):
+            augment_records(
+                records, (rs, rs, 3), (os_, os_),
+                out=np.zeros((n, os_, os_, 3), np.int32),
+            )
+
+
+class TestMMapRecordPipeline:
+    def test_same_sample_stream_as_record_pipeline(self, tmp_path):
+        """Swapping pipelines must not change the sample stream: the mmap
+        pipeline's index batches, gathered, equal RecordPipeline's record
+        batches for the same (seed, shuffle, shard) config."""
+        import numpy as np
+
+        from tf_operator_tpu.native.pipeline import (
+            MMapRecordPipeline,
+            RecordPipeline,
+            write_records,
+        )
+
+        rng = np.random.default_rng(5)
+        rec_bytes, n = 17, 23
+        path = str(tmp_path / "recs.bin")
+        write_records(path, rng.integers(0, 256, (n, rec_bytes), np.uint8))
+        table = np.fromfile(path, np.uint8).reshape(n, rec_bytes)
+
+        for shard_id, num_shards in ((0, 1), (1, 2)):
+            mp = MMapRecordPipeline(
+                path, rec_bytes, batch=4, seed=3, shuffle=True,
+                shard_id=shard_id, num_shards=num_shards,
+            )
+            rp = RecordPipeline(
+                path, rec_bytes, batch=4, seed=3, shuffle=True,
+                shard_id=shard_id, num_shards=num_shards,
+            )
+            it = iter(rp)
+            while True:
+                idx = mp.next_indices()
+                if idx is None:
+                    assert next(it, None) is None
+                    break
+                got = next(it)
+                assert (table[idx] == got).all()
+            rp.close()
+
+    def test_labels_and_loop(self, tmp_path):
+        import numpy as np
+
+        from tf_operator_tpu.native.pipeline import (
+            MMapRecordPipeline,
+            write_records,
+        )
+
+        rec_bytes, n = 8, 6
+        recs = np.zeros((n, rec_bytes), np.uint8)
+        recs[:, -1] = np.arange(n)
+        path = str(tmp_path / "l.bin")
+        write_records(path, recs)
+        mp = MMapRecordPipeline(
+            path, rec_bytes, batch=4, shuffle=False, loop=True
+        )
+        idx = mp.next_indices()
+        assert (mp.labels(idx) == idx.astype(np.int32)).all()
+        # loop=True rolls epochs forever.
+        for _ in range(5):
+            assert mp.next_indices() is not None

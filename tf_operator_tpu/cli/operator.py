@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal as signal_mod
 import socket
 import sys
 import threading
@@ -83,7 +84,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-period", type=float, default=3.0)
     p.add_argument("--dashboard", action="store_true",
                    help="mount the dashboard UI/API on the --serve server")
+    p.add_argument("--exit-with-parent", action="store_true",
+                   help="die when the parent process dies (Linux PDEATHSIG; "
+                        "harness mode — a SIGKILLed test run must not leak "
+                        "operator processes that churn CPU forever)")
     return p
+
+
+def _arm_parent_death_signal(log) -> None:
+    """Linux prctl(PR_SET_PDEATHSIG, SIGTERM): the kernel delivers SIGTERM
+    when the parent dies — covering the parent-SIGKILL case where no atexit
+    or signal handler on the parent side can run. Best-effort elsewhere."""
+    try:
+        import ctypes
+
+        PR_SET_PDEATHSIG = 1
+        libc = ctypes.CDLL(None, use_errno=True)
+        if libc.prctl(PR_SET_PDEATHSIG, signal_mod.SIGTERM, 0, 0, 0) != 0:
+            raise OSError(ctypes.get_errno(), "prctl failed")
+        # Race: the parent may already be gone (re-parented to init) by the
+        # time the prctl lands — detect and exit now rather than never.
+        if os.getppid() == 1:
+            log.info("parent already exited; honoring --exit-with-parent")
+            raise SystemExit(0)
+    except (OSError, AttributeError) as e:
+        log.warning("--exit-with-parent unavailable on this platform: %s", e)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -96,6 +121,8 @@ def main(argv: list[str] | None = None) -> int:
     log.info("%s", version_string())
 
     stop = signals.setup_signal_handler()
+    if args.exit_with_parent:
+        _arm_parent_death_signal(log)
 
     # --- backing store ------------------------------------------------------
     if args.backend == "kube":

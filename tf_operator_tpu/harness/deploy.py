@@ -53,6 +53,7 @@ class OperatorDeployment:
         log_path: str | None = None,
         env: dict[str, str] | None = None,
         startup_timeout: float = 20.0,
+        exit_with_parent: bool = True,
     ) -> None:
         self.host = host
         self.port = port or _free_port()
@@ -65,6 +66,12 @@ class OperatorDeployment:
             "--reconcile-period", str(reconcile_period),
             "--informer-resync", str(informer_resync),
         ]
+        if exit_with_parent:
+            # A SIGKILLed harness (pytest timeout, CI reaper) must not leak
+            # an operator that churns CPU forever on its orphaned state.
+            # (The detached `deploy up` mode opts out — it must outlive
+            # the CLI that spawned it.)
+            self._argv.append("--exit-with-parent")
         if local_executor:
             self._argv.append("--local-executor")
         if dashboard:
@@ -425,7 +432,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "up":
         dep = OperatorDeployment(
-            port=args.port, dashboard=args.dashboard, log_path=args.log_file
+            port=args.port, dashboard=args.dashboard, log_path=args.log_file,
+            exit_with_parent=False,  # detached: must outlive this CLI
         )
         dep.start()
         with open(args.pid_file, "w") as f:

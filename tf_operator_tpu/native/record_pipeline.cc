@@ -135,10 +135,23 @@ struct Pipeline {
     std::vector<uint64_t> recs;
     uint64_t seq;
     while (claim(&seq, &recs)) {
-      Batch b;
+      // Wait for the ring slot BEFORE reading, then pread straight into the
+      // slot's preallocated buffer. The previous shape (read into a fresh
+      // vector, move into the ring, shrink_to_fit on consume) paid a 62 MB
+      // malloc + zero-page faulting + free on EVERY batch at bench shapes —
+      // the dominant cost of the single-core loader. Slot exclusivity: seq
+      // values are unique and the window admits at most one in-flight seq
+      // per slot (window size == capacity).
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_produce.wait(lk, [&] {
+          return stop.load() || seq < next_seq_to_consume + capacity;
+        });
+        if (stop.load()) return;
+      }
+      Batch& b = ring[seq % capacity];
       b.seq = seq;
       b.records = recs.size();
-      b.data.resize(recs.size() * record_bytes);
       bool ok = true;
       for (size_t i = 0; i < recs.size(); i++) {
         ssize_t got = pread(fd, b.data.data() + i * record_bytes,
@@ -146,13 +159,8 @@ struct Pipeline {
         if (got != (ssize_t)record_bytes) { ok = false; break; }
       }
       std::unique_lock<std::mutex> lk(mu);
-      // in-order delivery: wait until seq fits in the ring window
-      cv_produce.wait(lk, [&] {
-        return stop.load() || seq < next_seq_to_consume + capacity;
-      });
       if (stop.load()) return;
       if (!ok) { io_error = true; cv_consume.notify_all(); return; }
-      ring[seq % capacity] = std::move(b);
       filled[seq % capacity] = true;
       cv_consume.notify_all();
     }
@@ -200,6 +208,7 @@ void* dp_open(const char* path, uint64_t record_bytes, uint64_t batch,
   p->batches_per_epoch = (mine + batch - 1) / batch;
   p->capacity = prefetch ? prefetch : 4;
   p->ring.resize(p->capacity);
+  for (auto& slot : p->ring) slot.data.resize(batch * record_bytes);
   p->filled.assign(p->capacity, false);
   p->reshuffle_locked();
   uint64_t n_threads = threads ? threads : 2;
@@ -227,8 +236,6 @@ int64_t dp_next(void* handle, char* out, uint64_t out_bytes) {
   if (bytes > out_bytes) return -1;
   std::memcpy(out, b.data.data(), bytes);
   int64_t n = (int64_t)b.records;
-  b.data.clear();
-  b.data.shrink_to_fit();
   p->filled[slot] = false;
   p->next_seq_to_consume++;
   p->cv_produce.notify_all();

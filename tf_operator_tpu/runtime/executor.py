@@ -37,6 +37,29 @@ from tf_operator_tpu.runtime.client import ADDED, DELETED, ClusterClient, NotFou
 from tf_operator_tpu.utils import logger
 
 
+# Resolved at import time: preexec_fn runs in the fork-child of a
+# multithreaded process, where an `import` or dlopen can deadlock on locks
+# some other thread held at fork() — the child may only make the
+# already-bound C call.
+try:
+    import ctypes as _ctypes
+
+    _LIBC_PRCTL = _ctypes.CDLL(None, use_errno=True).prctl
+except Exception:  # noqa: BLE001 — platform without CDLL(None)/prctl
+    _LIBC_PRCTL = None
+
+
+def _arm_pdeathsig() -> None:
+    """Child-side prctl(PR_SET_PDEATHSIG, SIGTERM): pods die with the
+    executor even when it is SIGKILLed (no chance to run cleanup).
+    Best-effort: no-op where prctl is unavailable."""
+    if _LIBC_PRCTL is not None:
+        try:
+            _LIBC_PRCTL(1, signal.SIGTERM, 0, 0, 0)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -245,6 +268,10 @@ class LocalProcessExecutor:
                 env=env,
                 stdout=log_file or subprocess.DEVNULL,
                 stderr=subprocess.STDOUT if log_file else subprocess.DEVNULL,
+                # A SIGKILLed operator must not leak its pod processes (a
+                # real kubelet's containers die with their node agent too);
+                # best-effort — Linux-only, no-op elsewhere.
+                preexec_fn=_arm_pdeathsig,
             )
         except OSError as e:
             self._fail_pod(pod, 127, f"spawn failed: {e}")
