@@ -336,3 +336,60 @@ class TestNoLeakedProcesses:
         finally:
             if proc.poll() is None:
                 proc.kill()
+
+    @pytest.mark.skipif(
+        sys.platform != "linux", reason="ppid semantics exercised on Linux CI"
+    )
+    def test_orphaned_operator_exits(self, tmp_path):
+        """--exit-with-parent must fire on parent PROCESS death — and must
+        NOT fire when merely the spawning THREAD exits (the PDEATHSIG
+        pitfall that killed the CI workflow's operator: the deploy step's
+        worker thread finished and took the operator with it)."""
+        import os
+        import subprocess
+        import textwrap
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        # An intermediate parent that spawns the operator FROM A THREAD,
+        # waits past the thread's exit (operator must survive), prints the
+        # operator pid, then exits (operator must die).
+        script = textwrap.dedent(
+            """
+            import subprocess, sys, threading, time
+            holder = {}
+            def spawn():
+                holder["p"] = subprocess.Popen([
+                    sys.executable, "-m", "tf_operator_tpu.cli.operator",
+                    "--exit-with-parent",
+                ])
+            t = threading.Thread(target=spawn)
+            t.start(); t.join()          # the spawning thread is now gone
+            time.sleep(3.0)              # operator must still be alive
+            rc = holder["p"].poll()
+            print(f"pid={holder['p'].pid} rc={rc}", flush=True)
+            """
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        fields = dict(kv.split("=") for kv in out.stdout.split())
+        assert fields["rc"] == "None", (
+            f"operator died while its parent was alive (rc={fields['rc']}) "
+            "— the spawning-thread-exit pitfall is back"
+        )
+        pid = int(fields["pid"])
+        # The intermediate parent has now exited; the orphaned operator
+        # must notice (ppid -> 1) and exit within the poll interval.
+        deadline = time.monotonic() + 15
+        gone = False
+        while time.monotonic() < deadline and not gone:
+            try:
+                os.kill(pid, 0)
+                time.sleep(0.3)
+            except ProcessLookupError:
+                gone = True
+        assert gone, f"orphaned operator {pid} still running"

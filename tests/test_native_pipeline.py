@@ -49,7 +49,7 @@ def test_native_matches_python_and_oracle(record_file):
     c = _run(path, "python")
     assert np.array_equal(a, b), "native engine nondeterministic"
     assert np.array_equal(a, c), "engines disagree"
-    order = epoch_order(RECORDS, 7, 0, True)
+    order = epoch_order(RECORDS, 7, 0, True, engine="python")
     assert np.array_equal(a, data[np.asarray(order, np.int64)])
 
 
@@ -427,3 +427,49 @@ class TestMMapRecordPipeline:
         # loop=True rolls epochs forever.
         for _ in range(5):
             assert mp.next_indices() is not None
+
+
+class TestEpochOrderNative:
+    def test_native_matches_python_oracle(self):
+        """dp_epoch_order must be bit-identical to the Python Fisher-Yates
+        across seeds/epochs/shards (it is the same splitmix64 stream).
+        Skips when the native library is unavailable — otherwise auto
+        falls back to Python and the comparison is vacuous."""
+        import numpy as np
+
+        from tf_operator_tpu.native.pipeline import (
+            _native_epoch_order,
+            epoch_order,
+        )
+
+        if _native_epoch_order(8, 0, 0, True, 0, 1) is None:
+            import pytest as _pytest
+
+            _pytest.skip("native engine unavailable")
+        for n, seed, epoch, shuffle, shard in [
+            (1, 0, 0, True, (0, 1)),
+            (97, 3, 0, True, (0, 1)),
+            (97, 3, 5, True, (1, 3)),
+            (256, 11, 2, False, (2, 4)),
+            (1000, 42, 1, True, (0, 2)),
+        ]:
+            py = epoch_order(n, seed, epoch, shuffle, *shard, engine="python")
+            auto = epoch_order(n, seed, epoch, shuffle, *shard)
+            assert np.array_equal(py, auto), (n, seed, epoch, shuffle, shard)
+
+    def test_large_order_is_fast(self):
+        """The native path must handle million-record epochs in well under
+        a second (the Python loop takes tens of seconds there)."""
+        import time
+
+        from tf_operator_tpu.native.pipeline import _native_epoch_order
+
+        if _native_epoch_order(8, 0, 0, True, 0, 1) is None:
+            import pytest as _pytest
+
+            _pytest.skip("native engine unavailable")
+        t0 = time.perf_counter()
+        out = _native_epoch_order(1_000_000, 7, 0, True, 0, 1)
+        dt = time.perf_counter() - t0
+        assert out is not None and len(out) == 1_000_000
+        assert dt < 2.0, f"native epoch order took {dt:.2f}s"

@@ -92,23 +92,41 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _arm_parent_death_signal(log) -> None:
-    """Linux prctl(PR_SET_PDEATHSIG, SIGTERM): the kernel delivers SIGTERM
-    when the parent dies — covering the parent-SIGKILL case where no atexit
-    or signal handler on the parent side can run. Best-effort elsewhere."""
-    try:
-        import ctypes
+    """Exit when the parent PROCESS dies, by polling getppid() for the
+    re-parenting to init. Deliberately NOT prctl(PR_SET_PDEATHSIG): that is
+    keyed to the parent *thread* that forked us, so a harness that spawns
+    the operator from a short-lived worker thread (the CI workflow's deploy
+    step) would kill the operator the moment the thread exits — observed as
+    ECONNRESET in the very next workflow step. Polling is process-level and
+    immune; a few seconds of latency is irrelevant for leak prevention
+    (leaked operators previously churned CPU for hours)."""
+    if os.name != "posix":
+        # No orphan re-parenting semantics to observe (getppid keeps
+        # returning the dead parent's pid on Windows): the flag cannot
+        # work, say so instead of silently no-opping.
+        log.warning("--exit-with-parent unavailable on this platform")
+        return
+    original_ppid = os.getppid()
+    if original_ppid == 1:
+        log.info("parent already exited; honoring --exit-with-parent")
+        raise SystemExit(0)
 
-        PR_SET_PDEATHSIG = 1
-        libc = ctypes.CDLL(None, use_errno=True)
-        if libc.prctl(PR_SET_PDEATHSIG, signal_mod.SIGTERM, 0, 0, 0) != 0:
-            raise OSError(ctypes.get_errno(), "prctl failed")
-        # Race: the parent may already be gone (re-parented to init) by the
-        # time the prctl lands — detect and exit now rather than never.
-        if os.getppid() == 1:
-            log.info("parent already exited; honoring --exit-with-parent")
-            raise SystemExit(0)
-    except (OSError, AttributeError) as e:
-        log.warning("--exit-with-parent unavailable on this platform: %s", e)
+    poll = threading.Event()
+
+    def watch() -> None:
+        while not poll.wait(2.0):
+            # Any CHANGE of ppid means the original parent died — the
+            # orphan may be re-parented to init (1) or to a subreaper
+            # (systemd user manager, tini), so comparing against the
+            # original pid is the robust check, not == 1.
+            if os.getppid() != original_ppid:
+                # Mirror a SIGTERM exit; os._exit because the interpreter
+                # may be blocked in non-interruptible native calls.
+                os._exit(128 + int(signal_mod.SIGTERM))
+
+    threading.Thread(
+        target=watch, name="parent-watch", daemon=True
+    ).start()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -38,16 +38,26 @@ def _splitmix64_stream(seed: int) -> Iterator[int]:
 
 def epoch_order(num_records: int, seed: int, epoch: int,
                 shuffle: bool, shard_id: int = 0,
-                num_shards: int = 1) -> np.ndarray:
-    """The record order for one epoch — shared by both engines (and the
-    oracle the tests check the native engine against). With sharding,
-    every shard computes the SAME global order and takes its strided
-    slice TRUNCATED to the common floor(n / num_shards) length: shards
-    are disjoint and all exactly the same size (lockstep hosts see the
-    same batch count and sizes — the multi-process shard_batch contract);
-    the <num_shards remainder records of an epoch are dropped and
-    re-dealt by the next epoch's shuffle, so nothing is systematically
-    lost."""
+                num_shards: int = 1, engine: str = "auto") -> np.ndarray:
+    """The record order for one epoch — shared by both engines. With
+    sharding, every shard computes the SAME global order and takes its
+    strided slice TRUNCATED to the common floor(n / num_shards) length:
+    shards are disjoint and all exactly the same size (lockstep hosts see
+    the same batch count and sizes — the multi-process shard_batch
+    contract); the <num_shards remainder records of an epoch are dropped
+    and re-dealt by the next epoch's shuffle, so nothing is systematically
+    lost.
+
+    engine="auto" runs the shuffle in C (dp_epoch_order; the interpreter's
+    Fisher-Yates loop is ~1000x slower at million-record scale), falling
+    back to Python. engine="python" is the bit-identical oracle the native
+    tests compare against."""
+    if engine == "auto":
+        native = _native_epoch_order(
+            num_records, seed, epoch, shuffle, shard_id, num_shards
+        )
+        if native is not None:
+            return native
     order = np.arange(num_records, dtype=np.uint64)
     if shuffle and num_records > 1:
         rng = _splitmix64_stream(seed * 1000003 + epoch)
@@ -57,6 +67,32 @@ def epoch_order(num_records: int, seed: int, epoch: int,
     if num_shards > 1:
         order = order[shard_id::num_shards][: num_records // num_shards]
     return order
+
+
+def _native_epoch_order(num_records: int, seed: int, epoch: int,
+                        shuffle: bool, shard_id: int,
+                        num_shards: int) -> np.ndarray | None:
+    try:
+        lib = load_library("record_pipeline.cc")
+    except NativeBuildError:
+        return None
+    if not hasattr(lib, "dp_epoch_order"):
+        return None
+    lib.dp_epoch_order.restype = ctypes.c_int64
+    lib.dp_epoch_order.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+    ]
+    keep = num_records // num_shards if num_shards > 1 else num_records
+    out = np.empty(keep, dtype=np.uint64)
+    n = lib.dp_epoch_order(
+        num_records, seed, epoch, int(shuffle), shard_id, num_shards,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), keep,
+    )
+    if n < 0 or n != keep:
+        return None
+    return out
 
 
 class _NativeEngine:

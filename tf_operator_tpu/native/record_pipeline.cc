@@ -23,6 +23,7 @@
 //
 // C ABI (ctypes-friendly); thread-safe for one consumer.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -57,6 +58,33 @@ struct Batch {
   uint64_t seq = 0;
 };
 
+// One definition of the epoch order (identity + optional Fisher-Yates +
+// equal-size strided shard slice), shared by the in-engine reshuffle and
+// the standalone dp_epoch_order export so the two can never drift.
+std::vector<uint64_t> compute_epoch_order(uint64_t num_records, uint64_t seed,
+                                          uint64_t epoch, bool shuffle,
+                                          uint64_t shard_id,
+                                          uint64_t num_shards) {
+  std::vector<uint64_t> order(num_records);
+  for (uint64_t i = 0; i < num_records; i++) order[i] = i;
+  if (shuffle && num_records > 1) {
+    Prng rng(seed * 1000003ULL + epoch);
+    for (uint64_t i = num_records - 1; i > 0; i--) {
+      uint64_t j = rng.bounded(i + 1);
+      std::swap(order[i], order[j]);
+    }
+  }
+  if (num_shards > 1) {
+    std::vector<uint64_t> mine;
+    uint64_t keep = num_records / num_shards;  // equal-size shards
+    for (uint64_t i = shard_id; i < order.size() && mine.size() < keep;
+         i += num_shards)
+      mine.push_back(order[i]);
+    order = std::move(mine);
+  }
+  return order;
+}
+
 struct Pipeline {
   int fd = -1;
   uint64_t record_bytes = 0;
@@ -89,23 +117,8 @@ struct Pipeline {
   std::vector<std::thread> workers;
 
   void reshuffle_locked() {
-    order.resize(num_records);
-    for (uint64_t i = 0; i < num_records; i++) order[i] = i;
-    if (shuffle) {
-      Prng rng(seed * 1000003ULL + epoch);
-      for (uint64_t i = num_records - 1; i > 0; i--) {
-        uint64_t j = rng.bounded(i + 1);
-        std::swap(order[i], order[j]);
-      }
-    }
-    if (num_shards > 1) {
-      std::vector<uint64_t> mine;
-      uint64_t keep = num_records / num_shards;  // equal-size shards
-      for (uint64_t i = shard_id; i < order.size() && mine.size() < keep;
-           i += num_shards)
-        mine.push_back(order[i]);
-      order = std::move(mine);
-    }
+    order = compute_epoch_order(num_records, seed, epoch, shuffle,
+                                shard_id, num_shards);
   }
 
   // Claim the next batch of this epoch (or roll the epoch / signal done).
@@ -240,6 +253,22 @@ int64_t dp_next(void* handle, char* out, uint64_t out_bytes) {
   p->next_seq_to_consume++;
   p->cv_produce.notify_all();
   return n;
+}
+
+// Epoch order as a standalone export: the Python-side MMapRecordPipeline
+// (and any gather-style consumer) needs the same order the in-engine
+// shuffle produces, and the interpreter's Fisher-Yates loop is ~1000x
+// slower at million-record scale. Writes min(out_len, shard length)
+// indices; returns the shard length, or -1 on bad args.
+int64_t dp_epoch_order(uint64_t num_records, uint64_t seed, uint64_t epoch,
+                       int shuffle, uint64_t shard_id, uint64_t num_shards,
+                       uint64_t* out, uint64_t out_len) {
+  if (!out || num_shards == 0 || shard_id >= num_shards) return -1;
+  std::vector<uint64_t> order = compute_epoch_order(
+      num_records, seed, epoch, shuffle != 0, shard_id, num_shards);
+  std::memcpy(out, order.data(),
+              std::min(out_len, (uint64_t)order.size()) * sizeof(uint64_t));
+  return (int64_t)order.size();
 }
 
 uint64_t dp_num_records(void* handle) {
