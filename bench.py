@@ -282,7 +282,7 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
     )
 
 
-def bench_submit_latency(_unused: float | None = None) -> None:
+def bench_submit_latency() -> None:
     """TPUJob submit → all-replicas-Running latency through a REAL
     controller (BASELINE.md's first target metric: "measure & minimize";
     no reference number exists). An instant fake kubelet isolates the
@@ -543,6 +543,16 @@ def main() -> None:
         from tf_operator_tpu.parallel.testing import force_cpu_mesh
 
         force_cpu_mesh(1)
+    # The operator-pipeline metric needs no accelerator (and no jax import
+    # at all): run it BEFORE backend init, so even a round whose TPU tunnel
+    # is down (jax.devices() hanging until the watchdog fires — rounds 2
+    # and 3 both hit multi-hour outages) still lands one measured metric.
+    if os.environ.get("BENCH_ONLY") != "resnet":
+        try:
+            bench_submit_latency()
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: bench_submit_latency failed: {exc!r}",
+                  file=sys.stderr, flush=True)
     import contextlib
 
     import jax
@@ -563,7 +573,6 @@ def main() -> None:
             # report a failure to stderr and keep going.
             peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
             for section, arg in (
-                (bench_submit_latency, None),
                 (bench_flash_attention, peak),
                 (bench_transformer_lm, peak),
                 (bench_decode, peak_hbm),
