@@ -118,7 +118,7 @@ def _record_batches(
         label_dtype.itemsize if label_dtype is not None else 0
     )
     if crop_hw is not None:
-        from tf_operator_tpu.native.augment import augment_batch
+        from tf_operator_tpu.native.augment import augment_records
 
     pipe = RecordPipeline(
         path, rec_bytes, batch_size, prefetch=prefetch, threads=threads,
@@ -128,18 +128,24 @@ def _record_batches(
     sample_index = 0
     try:
         for raw in pipe:
-            feats = (
-                raw[:, :feat_bytes]
-                .copy()
-                .view(dtype)
-                .reshape(len(raw), *example_shape)
-            )
             if crop_hw is not None:
-                feats = augment_batch(
-                    feats, crop_hw, seed=seed, index0=sample_index,
-                    train=augment_train, threads=threads, engine=engine,
+                # Strided path: the crop reads image bytes straight out of
+                # the raw record rows — no whole-batch slice-and-copy
+                # between the loader and the augmenter (record_dataset
+                # guarantees uint8 [H,W,C] when crop_hw is set).
+                feats = augment_records(
+                    raw, example_shape, crop_hw, seed=seed,
+                    index0=sample_index, train=augment_train,
+                    threads=threads, engine=engine,
                 )
                 sample_index += len(feats)
+            else:
+                feats = (
+                    raw[:, :feat_bytes]
+                    .copy()
+                    .view(dtype)
+                    .reshape(len(raw), *example_shape)
+                )
             out = {"image": feats}
             if label_dtype is not None:
                 out["label"] = (
