@@ -122,8 +122,11 @@ def test_two_slice_job_partitions_topology_env(stack):
         assert topo_env["MEGASCALE_NUM_SLICES"] == str(NUM_SLICES)
         assert topo_env["MEGASCALE_SLICE_ID"] == str(slice_id)
         seen_megascale_coords.add(topo_env["MEGASCALE_COORDINATOR_ADDRESS"])
-    dcn_ip, dcn_port = executor.resolve("ms-worker-0")
+    # The DCN rendezvous rides its own per-pod port (distinct from the
+    # in-slice coordinator port — they share a pod on slice 0's worker 0).
+    dcn_ip, dcn_port = executor.resolve_dcn("ms-worker-0")
     assert seen_megascale_coords == {f"{dcn_ip}:{dcn_port}"}
+    assert (dcn_ip, dcn_port) != executor.resolve("ms-worker-0")
 
     # Tear down: terminate every replica cleanly; the job must reach
     # Succeeded only when all slices have finished.

@@ -101,6 +101,13 @@ class LocalProcessExecutor:
             port = self._ports.get(pod_name)
         return ("127.0.0.1", port) if port is not None else None
 
+    def resolve_dcn(self, pod_name: str) -> tuple[str, int] | None:
+        """The pod's cross-slice (DCN) rendezvous address — what a
+        multislice contract's "{pod}:{port+DCN_PORT_OFFSET}" rewrites to."""
+        with self._lock:
+            port = self._dcn_ports.get(pod_name)
+        return ("127.0.0.1", port) if port is not None else None
+
     # -- loop ----------------------------------------------------------------
 
     def _run(self) -> None:
