@@ -473,3 +473,32 @@ class TestEpochOrderNative:
         dt = time.perf_counter() - t0
         assert out is not None and len(out) == 1_000_000
         assert dt < 2.0, f"native epoch order took {dt:.2f}s"
+
+
+def test_record_dataset_mmap_engine_matches_default(tmp_path):
+    """engine="mmap" must yield the bit-identical sample stream to the
+    default pipeline — same epoch order, same crops/flips, same labels —
+    for both cropped and uncropped datasets."""
+    import numpy as np
+
+    from tf_operator_tpu.train.data import record_dataset, write_example_records
+
+    rng = np.random.default_rng(8)
+    imgs = rng.integers(0, 256, (22, 12, 12, 3), np.uint8)
+    labels = rng.integers(0, 10, (22,)).astype(np.int32)
+    path = str(tmp_path / "m.bin")
+    write_example_records(path, imgs, labels)
+
+    for crop in (None, (8, 8)):
+        a = list(record_dataset(
+            path, (12, 12, 3), np.uint8, 5, seed=4, loop=False,
+            crop_hw=crop,
+        ))
+        b = list(record_dataset(
+            path, (12, 12, 3), np.uint8, 5, seed=4, loop=False,
+            crop_hw=crop, engine="mmap",
+        ))
+        assert len(a) == len(b) > 0, crop
+        for x, y in zip(a, b):
+            assert (x["image"] == y["image"]).all(), crop
+            assert (x["label"] == y["label"]).all(), crop

@@ -139,7 +139,14 @@ class LocalProcessExecutor:
     def _dcn_port_for(self, pod_name: str) -> int:
         with self._lock:
             if pod_name not in self._dcn_ports:
-                self._dcn_ports[pod_name] = _free_port()
+                # The kernel can hand back the just-released main port;
+                # the two services share a pod (in-slice coordinator +
+                # DCN rendezvous on slice leaders) and must not collide.
+                main = self._ports.get(pod_name)
+                port = _free_port()
+                while port == main:
+                    port = _free_port()
+                self._dcn_ports[pod_name] = port
             return self._dcn_ports[pod_name]
 
     def _ensure_job_ports(self, pod: dict[str, Any]) -> None:

@@ -85,7 +85,11 @@ def record_dataset(
     the augment stage (random crop + hflip while augment_train, else center
     crop) — ImageNet-style host preprocessing; ``engine`` selects the
     native/python implementation for the augment stage and the record
-    pipeline alike.
+    pipeline alike. ``engine="mmap"`` selects the zero-copy tier for
+    page-cache-resident files: the file is mmap'd and images are gathered
+    (and cropped) straight out of the mapping — ~5x the pread pipeline on
+    a single-core host at ImageNet shapes (docs/perf.md) — with the
+    IDENTICAL sample stream (same epoch order, same augment decisions).
 
     shard_id/num_shards: multi-host input sharding (one disjoint slice of
     every epoch per host — see RecordPipeline).
@@ -97,11 +101,64 @@ def record_dataset(
         raise ValueError(
             f"crop_hw needs uint8 [H,W,C] examples, got {dtype} {example_shape}"
         )
+    if engine == "mmap":
+        return _mmap_batches(
+            path, example_shape, dtype, batch_size, label_dtype, seed,
+            shuffle, loop, crop_hw, augment_train, threads,
+            shard_id, num_shards,
+        )
     return _record_batches(
         path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
         loop, prefetch, threads, engine, crop_hw, augment_train,
         shard_id, num_shards,
     )
+
+
+def _mmap_batches(
+    path, example_shape, dtype, batch_size, label_dtype, seed, shuffle,
+    loop, crop_hw, augment_train, threads, shard_id, num_shards,
+) -> Iterator[dict[str, np.ndarray]]:
+    from tf_operator_tpu.native.augment import augment_gather
+    from tf_operator_tpu.native.pipeline import MMapRecordPipeline
+
+    feat_bytes = int(np.prod(example_shape)) * dtype.itemsize
+    rec_bytes = feat_bytes + (
+        np.dtype(label_dtype).itemsize if label_dtype is not None else 0
+    )
+    pipe = MMapRecordPipeline(
+        path, rec_bytes, batch_size, seed=seed, shuffle=shuffle, loop=loop,
+        shard_id=shard_id, num_shards=num_shards,
+    )
+    table = np.asarray(pipe.data).reshape(pipe.num_records, rec_bytes)
+    sample_index = 0
+    try:
+        while True:
+            idx = pipe.next_indices()
+            if idx is None:
+                return
+            if crop_hw is not None:
+                feats = augment_gather(
+                    pipe.data, idx, rec_bytes, example_shape, crop_hw,
+                    seed=seed, index0=sample_index, train=augment_train,
+                    threads=threads,
+                )
+                sample_index += len(idx)
+            else:
+                feats = (
+                    table[idx, :feat_bytes]
+                    .view(dtype)
+                    .reshape(len(idx), *example_shape)
+                )
+            out = {"image": feats}
+            if label_dtype is not None:
+                out["label"] = (
+                    table[idx, feat_bytes:]
+                    .view(np.dtype(label_dtype))
+                    .reshape(len(idx))
+                )
+            yield out
+    finally:
+        pipe.close()
 
 
 def _record_batches(
