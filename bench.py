@@ -282,6 +282,24 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
     )
 
 
+def ensure_bench_records() -> tuple[str, int, int]:
+    """(path, record_size, rec_bytes) of the synthetic uint8 image-record
+    file at the current bench shapes, creating it if absent. Shared with
+    perf_probe.py so both always measure the same file."""
+    from tf_operator_tpu.native.pipeline import write_records
+
+    record_size = IMAGE_SIZE + 32 if IMAGE_SIZE >= 64 else IMAGE_SIZE
+    rec_bytes = record_size * record_size * 3 + 1  # image + label byte
+    num_records = 1024
+    path = f"/tmp/bench_records_{record_size}.bin"
+    if not os.path.exists(path) or os.path.getsize(path) != num_records * rec_bytes:
+        rng = np.random.default_rng(0)
+        write_records(
+            path, rng.integers(0, 256, (num_records, rec_bytes), dtype=np.uint8)
+        )
+    return path, record_size, rec_bytes
+
+
 def bench_submit_latency() -> None:
     """TPUJob submit → all-replicas-Running latency through a REAL
     controller (BASELINE.md's first target metric: "measure & minimize";
@@ -375,7 +393,7 @@ def bench_resnet(peak_tflops: float | None) -> None:
     import jax.numpy as jnp
 
     from tf_operator_tpu.models.resnet import resnet50
-    from tf_operator_tpu.native.pipeline import MMapRecordPipeline, write_records
+    from tf_operator_tpu.native.pipeline import MMapRecordPipeline
     from tf_operator_tpu.parallel.mesh import create_mesh
     from tf_operator_tpu.parallel.sharding import replicate
     from tf_operator_tpu.train.steps import (
@@ -401,15 +419,7 @@ def bench_resnet(peak_tflops: float | None) -> None:
     # copy-chained pread path this replaces).
     from tf_operator_tpu.native.augment import augment_gather
 
-    record_size = IMAGE_SIZE + 32 if IMAGE_SIZE >= 64 else IMAGE_SIZE
-    rec_bytes = record_size * record_size * 3 + 1  # image + label byte
-    num_records = 1024
-    path = f"/tmp/bench_records_{record_size}.bin"
-    if not os.path.exists(path) or os.path.getsize(path) != num_records * rec_bytes:
-        rng = np.random.default_rng(0)
-        write_records(
-            path, rng.integers(0, 256, (num_records, rec_bytes), dtype=np.uint8)
-        )
+    path, record_size, rec_bytes = ensure_bench_records()
     pipe = MMapRecordPipeline(path, rec_bytes, BATCH, seed=0, loop=True)
     sample_counter = [0]
 

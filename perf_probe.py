@@ -62,20 +62,9 @@ def probe_h2d() -> None:
 
 def probe_input() -> None:
     from tf_operator_tpu.native.augment import augment_records
-    from tf_operator_tpu.native.pipeline import RecordPipeline, write_records
+    from tf_operator_tpu.native.pipeline import RecordPipeline
 
-    record_size = (
-        bench.IMAGE_SIZE + 32 if bench.IMAGE_SIZE >= 64 else bench.IMAGE_SIZE
-    )
-    rec_bytes = record_size * record_size * 3 + 1
-    num_records = 1024
-    path = f"/tmp/bench_records_{record_size}.bin"
-    if not os.path.exists(path) or os.path.getsize(path) != num_records * rec_bytes:
-        rng = np.random.default_rng(0)
-        write_records(
-            path,
-            rng.integers(0, 256, (num_records, rec_bytes), dtype=np.uint8),
-        )
+    path, record_size, rec_bytes = bench.ensure_bench_records()
 
     def run(with_augment: bool, n: int = 20) -> float:
         pipe = RecordPipeline(
