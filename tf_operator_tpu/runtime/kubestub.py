@@ -331,6 +331,7 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         self.end_headers()
         write_chunk = self.write_chunk
         if gone:
+            self.server.watch_410s_served += 1
             write_chunk(
                 json.dumps(
                     {
@@ -355,6 +356,10 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         watch = self.server.cluster.watch(route.kind, route.namespace)
         with self.server.watch_conns_lock:
             self.server.watch_conns.append(self.connection)
+        bookmarks = (
+            self._q(query, "allowWatchBookmarks") in ("true", "1")
+            and self.server.send_bookmarks
+        )
         try:
             while not self.server.stopping.is_set():
                 if deadline is not None and time.monotonic() >= deadline:
@@ -362,7 +367,31 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
                     return
                 event = watch.next(timeout=0.5)
                 if event is None:
-                    write_chunk(b"\n")  # heartbeat
+                    if bookmarks:
+                        # Periodic RV checkpoint (apiserver bookmark): lets
+                        # an idle client resume from a fresh RV instead of
+                        # one compacted away during a long quiet stretch.
+                        # The object kind is the SINGULAR resource kind, as
+                        # a real apiserver sends it (Pod, not pods).
+                        from tf_operator_tpu.runtime.kubeclient import (
+                            _resource_for,
+                        )
+
+                        write_chunk(
+                            json.dumps({
+                                "type": "BOOKMARK",
+                                "object": {
+                                    "kind": _resource_for(route.kind).kind
+                                    or route.kind,
+                                    "metadata": {
+                                        "resourceVersion":
+                                            self.server.cluster.current_rv
+                                    },
+                                },
+                            }).encode() + b"\n"
+                        )
+                    else:
+                        write_chunk(b"\n")  # heartbeat
                     continue
                 write_chunk(
                     json.dumps({"type": event.type, "object": event.object}).encode()
@@ -418,6 +447,12 @@ class KubeApiStub(ThreadingHTTPServer):
         self.required_token: str | None = None
         # When set, any list continue token gets 410 Expired (compaction).
         self.expire_continue_tokens = False
+        # Emit BOOKMARK events on idle ticks for clients that request
+        # allowWatchBookmarks (the kubeclient always does).
+        self.send_bookmarks = False
+        # 410 ERROR events served to watch resumes (bookmark tests assert
+        # this stays 0: a bookmark-advanced RV never needs the relist).
+        self.watch_410s_served = 0
 
     def kill_watches(self) -> int:
         """Abruptly sever every active watch connection (RST-style), as a
