@@ -233,6 +233,9 @@ class TestCreatedPodShape:
 
 class TestExitCodePolicy:
     def test_retryable_exit_deletes_pod(self):
+        from tf_operator_tpu.controller.tpujob_controller import RESTARTS_TOTAL
+
+        restarts_before = RESTARTS_TOTAL.value()
         tc, client = make_controller()
         job = testutil.new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
         submit(client, job)
@@ -246,6 +249,9 @@ class TestExitCodePolicy:
             c["type"] for c in stored["status"]["conditions"] if c["status"] == "True"
         ]
         assert JobConditionType.RESTARTING in types
+        # The restart event is observable at /metrics (process-global
+        # registry: assert the delta, not the absolute value).
+        assert RESTARTS_TOTAL.value() == restarts_before + 1
 
     def test_oomkilled_is_permanent_despite_exit_137(self):
         """Container-scope OOM must not be retried even though 137 is a

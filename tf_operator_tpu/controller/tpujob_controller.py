@@ -60,6 +60,11 @@ SYNCS_TOTAL = REGISTRY.counter(
 QUEUE_DEPTH = REGISTRY.gauge(
     "tpu_operator_workqueue_depth", "Keys waiting in the workqueue",
 )
+RESTARTS_TOTAL = REGISTRY.counter(
+    "tpu_operator_slice_restarts_total",
+    "Slice/pod restart events (any restart policy; one per restarted "
+    "group per sync)",
+)
 
 
 class TPUJobController(JobController, PodReconciler, ServiceReconciler):
@@ -288,6 +293,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         job.status.restart_count += restarts
         if restarts:
             self._restart_floor[job.key] = job.status.restart_count
+            RESTARTS_TOTAL.inc(restarts)
         self.update_job_status(job, pods, restarts, permanent_failure)
         try:
             self.update_status_handler(job)

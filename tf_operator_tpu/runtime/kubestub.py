@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
 from tf_operator_tpu.runtime.apiserver import parse_label_selector
+from tf_operator_tpu.runtime.kubeclient import _resource_for
 from tf_operator_tpu.runtime.httputil import JsonHandlerMixin
 from tf_operator_tpu.runtime.client import (
     AlreadyExists,
@@ -349,6 +350,7 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
             write_chunk(b"")  # terminating chunk: clean stream end
             return
 
+        object_kind = _resource_for(route.kind).kind or route.kind
         deadline = None
         timeout_s = self._q(query, "timeoutSeconds")
         if timeout_s:
@@ -373,16 +375,11 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
                         # one compacted away during a long quiet stretch.
                         # The object kind is the SINGULAR resource kind, as
                         # a real apiserver sends it (Pod, not pods).
-                        from tf_operator_tpu.runtime.kubeclient import (
-                            _resource_for,
-                        )
-
                         write_chunk(
                             json.dumps({
                                 "type": "BOOKMARK",
                                 "object": {
-                                    "kind": _resource_for(route.kind).kind
-                                    or route.kind,
+                                    "kind": object_kind,
                                     "metadata": {
                                         "resourceVersion":
                                             self.server.cluster.current_rv
