@@ -137,9 +137,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
     @pl.when(jnp.logical_or(not causal, j <= i))
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        # Matmul inputs stay in their storage dtype (bf16 on the training
+        # path) with f32 ACCUMULATION via preferred_element_type — an
+        # explicit f32 upcast before the dot would run the MXU at its f32
+        # rate, a fraction of bf16 throughput. Softmax statistics stay f32.
+        q = q_ref[0, 0, :, :]
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -153,7 +157,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         l[:] = l[:] * alpha + p.sum(axis=-1, keepdims=True)
         acc[:] = acc[:] * alpha + jnp.dot(
-            p, v_blk, preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
         )
         m[:] = m_new
 
@@ -175,12 +179,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(jnp.logical_or(not causal, j <= i))
     def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        q = q_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
-        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
@@ -188,7 +192,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         dq_acc[:] = dq_acc[:] + jnp.dot(
             ds, k_blk, preferred_element_type=jnp.float32
         )
@@ -210,10 +214,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(jnp.logical_or(not causal, i >= j))
     def _compute():
-        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
-        q = q_ref[0, 0, :, :].astype(jnp.float32)
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
+        q = q_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
         lse = lse_ref[0, 0, :, :]
         delta = delta_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
@@ -223,10 +227,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dv_acc[:] = dv_acc[:] + jnp.dot(
-            p.T, do, preferred_element_type=jnp.float32
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_acc[:] = dk_acc[:] + jnp.dot(
             ds.T, q, preferred_element_type=jnp.float32
         )
