@@ -515,20 +515,22 @@ def bench_resnet(peak_tflops: float | None) -> None:
     )
 
 
-def _arm_watchdog() -> None:
-    """Hard deadline for the whole bench (BENCH_WATCHDOG_S, default 45 min).
+def _arm_watchdog(budget: float | None = None) -> float:
+    """Hard deadline for the whole bench (BENCH_WATCHDOG_S, default 55 min).
 
     Backend init through a remote-chip tunnel can hang INDEFINITELY when
     the tunnel is down (observed: jax.devices() blocking >10 min with no
     exception) — without a watchdog the driver's bench step would never
     return. os._exit because the hang sits inside native code that
-    ignores normal interpreter shutdown.
+    ignores normal interpreter shutdown. Returns the resolved budget
+    (<=0 = off) so callers derive their deadline from the same number.
     """
     import threading
 
-    budget = float(os.environ.get("BENCH_WATCHDOG_S", "2700"))
+    if budget is None:
+        budget = float(os.environ.get("BENCH_WATCHDOG_S", "3300"))
     if budget <= 0:  # 0 = watchdog off
-        return
+        return budget
 
     def fire():
         print(
@@ -541,11 +543,99 @@ def _arm_watchdog() -> None:
     t = threading.Timer(budget, fire)
     t.daemon = True
     t.start()
+    return budget
+
+
+# section -> (bench fn, peak-table lookup, soft time budget seconds).
+# Order = run priority: the flagship ResNet metric gets the chip first,
+# the LM section (largest compile) last, so a tunnel that dies mid-bench
+# costs the least-important lines.
+_SECTIONS: dict = {
+    "resnet": (bench_resnet, chip_peak_tflops, 1500.0),
+    "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
+    "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
+    "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
+}
+
+
+def _run_jax_section(name: str) -> None:
+    """Run one hardware section in-process (the --section entry point)."""
+    import jax
+
+    if name not in _SECTIONS:
+        raise SystemExit(f"unknown section {name!r}")
+    fn, peak_of, _ = _SECTIONS[name]
+    fn(peak_of(jax.devices()[0]))
+
+
+def _run_sections_isolated(deadline: float) -> None:
+    """Spawn each hardware section as its own subprocess with a timeout.
+
+    A dead/dying TPU tunnel hangs a section inside native code where no
+    Python-level recovery is possible (observed twice: a section compile
+    blocking 13+ min until the whole-bench watchdog killed everything,
+    losing the sections behind it). Process isolation bounds the damage to
+    one section's budget; the flagship ResNet line is re-emitted verbatim
+    as the final line (parsers keyed on the last line or on metric name
+    both see it; mid-run it is already on stdout in case the parent is
+    killed before the end)."""
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    child_env = dict(os.environ, BENCH_WATCHDOG_S="0")
+    flagship_lines: list[str] = []
+    emitted_after_flagship = False
+    for name, (_, _, soft_budget) in _SECTIONS.items():
+        if os.environ.get("BENCH_ONLY") == "resnet" and name != "resnet":
+            continue
+        remaining = deadline - time.monotonic()
+        budget = min(soft_budget, remaining - 45.0)
+        if budget < 60.0:
+            print(f"bench: skipping section {name} "
+                  f"({remaining:.0f}s left before watchdog)",
+                  file=sys.stderr, flush=True)
+            continue
+        proc = subprocess.Popen(
+            [sys.executable, me, "--section", name],
+            stdout=subprocess.PIPE, env=child_env,
+        )
+        timed_out = False
+        try:
+            out, _ = proc.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            proc.kill()
+            out, _ = proc.communicate()
+            print(f"bench: section {name} timed out after {budget:.0f}s "
+                  "(tunnel hang?) — killed, continuing",
+                  file=sys.stderr, flush=True)
+        if proc.returncode != 0 and not timed_out:
+            print(f"bench: section {name} exited rc={proc.returncode}",
+                  file=sys.stderr, flush=True)
+        for raw in (out or b"").decode(errors="replace").splitlines():
+            if not raw.startswith("{"):
+                continue
+            print(raw, flush=True)
+            if name == "resnet":
+                flagship_lines.append(raw)
+            else:
+                emitted_after_flagship = True
+    if flagship_lines and emitted_after_flagship:
+        print(flagship_lines[-1], flush=True)
 
 
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    _arm_watchdog()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        _arm_watchdog()
+        if os.environ.get("BENCH_SMOKE"):
+            from tf_operator_tpu.parallel.testing import force_cpu_mesh
+
+            force_cpu_mesh(1)
+        _run_jax_section(sys.argv[2])
+        return
+    budget = _arm_watchdog()
+    deadline = time.monotonic() + (budget if budget > 0 else 86400.0)
     if os.environ.get("BENCH_SMOKE"):
         # Structure check must not touch the TPU plugin (the environment's
         # sitecustomize pins jax_platforms=axon even when the tunnel is
@@ -563,39 +653,53 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(f"bench: bench_submit_latency failed: {exc!r}",
                   file=sys.stderr, flush=True)
-    import contextlib
+    if os.environ.get("BENCH_SMOKE") and not os.environ.get(
+        "BENCH_SMOKE_ISOLATED"
+    ):
+        # Smoke: everything in-process on CPU (no tunnel, no hang risk).
+        # BENCH_SMOKE_ISOLATED=1 instead sends the smoke shapes through
+        # the production subprocess runner below (CI coverage for it).
+        import jax
 
-    import jax
-
-    peak = chip_peak_tflops(jax.devices()[0])
-    # BENCH_PROFILE=<dir>: capture a jax/XLA profiler trace of every
-    # section (open with xprof/tensorboard) — the tool for attributing a
-    # roofline gap between compute, HBM, and host/transfer time.
-    profile_dir = os.environ.get("BENCH_PROFILE")
-    ctx = (
-        jax.profiler.trace(profile_dir)
-        if profile_dir
-        else contextlib.nullcontext()
-    )
-    with ctx:
-        if os.environ.get("BENCH_ONLY") != "resnet":
-            # Secondary metrics must never take down the flagship line:
-            # report a failure to stderr and keep going.
-            peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
-            for section, arg in (
-                (bench_flash_attention, peak),
-                (bench_transformer_lm, peak),
-                (bench_decode, peak_hbm),
-            ):
-                try:
-                    section(arg)
-                except Exception as exc:  # noqa: BLE001
-                    print(f"bench: {section.__name__} failed: {exc!r}",
-                          file=sys.stderr, flush=True)
+        peak = chip_peak_tflops(jax.devices()[0])
+        peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
+        for section, arg in (
+            (bench_flash_attention, peak),
+            (bench_transformer_lm, peak),
+            (bench_decode, peak_hbm),
+        ):
+            try:
+                section(arg)
+            except Exception as exc:  # noqa: BLE001
+                print(f"bench: {section.__name__} failed: {exc!r}",
+                      file=sys.stderr, flush=True)
         bench_resnet(peak)
+        return
+    # BENCH_PROFILE=<dir>: sections run in-process under one profiler
+    # trace (open with xprof/tensorboard) — the tool for attributing a
+    # roofline gap between compute, HBM, and host/transfer time. Profiling
+    # trades away the per-section process isolation.
+    profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
+        import jax
+
+        dev = jax.devices()[0]
+        with jax.profiler.trace(profile_dir):
+            if os.environ.get("BENCH_ONLY") != "resnet":
+                # Secondary metrics must never take down the flagship line.
+                for fn, peak_of, _ in (_SECTIONS["flash_attention"],
+                                       _SECTIONS["lm"],
+                                       _SECTIONS["decode"]):
+                    try:
+                        fn(peak_of(dev))
+                    except Exception as exc:  # noqa: BLE001
+                        print(f"bench: {fn.__name__} failed: {exc!r}",
+                              file=sys.stderr, flush=True)
+            bench_resnet(chip_peak_tflops(dev))
         print(f"bench: profile written to {profile_dir}",
               file=sys.stderr, flush=True)
+        return
+    _run_sections_isolated(deadline)
 
 
 if __name__ == "__main__":

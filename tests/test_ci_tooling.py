@@ -378,3 +378,36 @@ def test_workflow_callable_step_timeout(tmp_path):
     assert wf.run(str(tmp_path)) is False
     assert time.monotonic() - t0 < 5
     assert "timeout" in wf.results["hang"].message.lower()
+
+
+# ---------------------------------------------------------------------------
+# bench structure (smoke shapes through the production subprocess runner)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_bench_smoke_isolated_sections():
+    """bench.py's per-section subprocess isolation emits every metric line
+    and re-emits the flagship ResNet line last (the driver parses the last
+    JSON line; a tunnel death mid-bench must cost at most one section)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py")],
+        env=dict(os.environ, BENCH_SMOKE="1", BENCH_SMOKE_ISOLATED="1"),
+        capture_output=True, timeout=900, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.startswith("{")]
+    metrics = [l["metric"] for l in lines]
+    assert metrics[-1].startswith("resnet50_train_images_per_sec"), metrics
+    for want in ("tpujob_submit_to_all_running_median_ms",
+                 "flash_attention_fwd_bwd_tflops",
+                 "transformer_lm_tokens_per_sec",
+                 "lm_decode_gen_tokens_per_sec",
+                 "resnet50_train_images_per_sec"):
+        assert any(m.startswith(want) for m in metrics), (want, metrics)
+    for line in lines:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
