@@ -15,6 +15,13 @@ Each probe prints one JSON line; run all or pick with PROBE=name. Probes:
 - ``synthetic``: ResNet img/s on device-resident synthetic data (the
   compute ceiling; the gap to bench.py's native-input number is the
   input+transfer cost).
+- ``roofline``: the environment's MEASURED ceilings — jitted dispatch
+  round trip, raw bf16 matmul TFLOP/s (single and scan-chained), and
+  on-device copy bandwidth. Spec peaks assume local PCIe-attached
+  chips; through a tunnel the real ceilings can sit far below spec
+  (round 3 measured 111 TFLOP/s compute and 111 GB/s HBM on a chip
+  whose spec says 197/819), so every MFU denominator should be checked
+  against this probe, not the table.
 
 Usage on hardware:   python perf_probe.py
 Structure check:     BENCH_SMOKE=1 PROBE=input python perf_probe.py
@@ -40,6 +47,27 @@ def emit(probe: str, **kw) -> None:
     }}), flush=True)
 
 
+def timeit(fn, *args, reps: int = 5, per_rep_sync: bool = False) -> float:
+    """Seconds per call: warm (compile) once, then time `reps` calls.
+
+    per_rep_sync=True blocks after every call (latency measurements);
+    otherwise calls are enqueued back-to-back and one final block
+    measures throughput.
+    """
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    if per_rep_sync:
+        for _ in range(reps):
+            jax.block_until_ready(fn(*args))
+    else:
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
 def probe_h2d() -> None:
     import jax
 
@@ -47,12 +75,7 @@ def probe_h2d() -> None:
     x = np.random.default_rng(0).integers(
         0, 256, (bench.BATCH, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), np.uint8
     )
-    jax.block_until_ready(jax.device_put(x))  # warm path
-    reps = 10
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        jax.block_until_ready(jax.device_put(x))
-    dt = (time.perf_counter() - t0) / reps
+    dt = timeit(jax.device_put, x, reps=10, per_rep_sync=True)
     gbps = batch_bytes / dt / 1e9
     emit(
         "h2d", gbps=gbps, ms_per_batch=dt * 1e3,
@@ -223,7 +246,67 @@ def probe_stem() -> None:
     )
 
 
+def probe_roofline() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+
+    # Dispatch round trip: a tiny jitted op, fully synchronized per rep.
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    dispatch_ms = timeit(f, x, reps=20, per_rep_sync=True) * 1e3
+
+    # Raw bf16 matmul across sizes: per-size single executables expose
+    # size-dependent pathologies (round 3 observed 2048-cubed running 200x
+    # slower than 8192-cubed through the tunnel); the scan chain amortizes
+    # any per-executable overhead, so it is the compute ceiling.
+    sizes = (512,) if smoke else (2048, 4096, 8192)
+    single = {}
+    for n in sizes:
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+        mm = jax.jit(lambda a, b: (a @ b).astype(jnp.float32).sum())
+        dt = timeit(mm, a, b, reps=10)
+        single[f"matmul_{n}_tflops"] = 2 * n**3 / dt / 1e12
+
+    n = 512 if smoke else 4096
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    depth = 20
+
+    def chain(a, b):
+        def body(c, _):
+            return (c @ b) / jnp.asarray(n, jnp.bfloat16), ()
+
+        c, _ = jax.lax.scan(body, a, None, length=depth)
+        return c.astype(jnp.float32).sum()
+
+    dt = timeit(jax.jit(chain), a, b, reps=3)
+    chain_tflops = depth * 2 * n**3 / dt / 1e12
+
+    # On-device copy bandwidth (read + write), ~1 GB buffer. The scale
+    # factor must be bf16-representable and != 1.0 (1.000001 rounds to
+    # exactly 1.0 in bf16, which XLA would simplify to an elidable
+    # identity): 1.0078125 = 1 + 2^-7 is exact in bf16.
+    m = jnp.zeros((8, 1024, 1024) if smoke else (512, 1024, 1024), jnp.bfloat16)
+    cp = jax.jit(lambda x: x * jnp.asarray(1.0078125, jnp.bfloat16))
+    dt = timeit(cp, m, reps=5)
+    copy_gbps = 2 * m.size * 2 / dt / 1e9
+
+    emit(
+        "roofline",
+        dispatch_roundtrip_ms=dispatch_ms,
+        matmul_chain_tflops=chain_tflops,
+        copy_gbps=copy_gbps,
+        chain_n=n,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+        **single,
+    )
+
+
 PROBES = {
+    "roofline": probe_roofline,
     "h2d": probe_h2d,
     "input": probe_input,
     "fwd_split": probe_fwd_split,
