@@ -25,7 +25,10 @@ Methodology:
   input pipeline would be). Images travel uint8 and are normalized on
   device — 4x less transfer than f32.
 - MFU for ResNet uses XLA's own per-step FLOP count (compiled
-  cost_analysis) — not a hand model — divided by wall time and chip peak.
+  cost_analysis) when the backend provides one, falling back to the
+  standard analytic model (~4.09 GFLOP/img fwd, 3x for training) on
+  plugin backends whose cost analysis is empty — the emitted
+  `flops_source` field says which fired.
   Attention MFU uses the analytic model FLOPs (6*B*H*S^2*D for causal
   fwd+bwd) since that is the algorithmic work regardless of recompute.
 
@@ -111,6 +114,37 @@ def emit(metric: str, value: float, unit: str, vs_baseline: float,
     print(json.dumps(line), flush=True)
 
 
+def timed_reps(call, reps: int, warmup: int = 2) -> list[float]:
+    """Per-rep wall times, each rep synced by the caller's own readback.
+
+    `call` must force completion internally (host readback). Round-3
+    hardware data showed strong intra-process throughput RAMP through the
+    tunnel (the same matmul 100x slower in a process's first second than a
+    minute later), so single-warmup aggregate timing can under-report
+    steady-state by an order of magnitude. Multiple warmups + per-rep
+    times let the artifact carry both the best (steady-state capability)
+    and the mean (what a fresh process observes)."""
+    _warm(call, warmup)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        call()
+        times.append(time.perf_counter() - t0)
+    return times
+
+
+def _warm(call, warmup: int, slow_s: float = 30.0) -> None:
+    """Run up to `warmup` untimed calls, stopping early once one exceeds
+    `slow_s`: on a degraded tunnel each call can run minutes, and
+    unconditional extra warmups would eat the per-section budget the
+    subprocess runner enforces."""
+    for _ in range(warmup):
+        t0 = time.perf_counter()
+        call()
+        if time.perf_counter() - t0 > slow_s:
+            return
+
+
 def bench_flash_attention(peak_tflops: float | None) -> None:
     """Causal flash attention fwd+bwd at 8k and 64k context, bf16.
 
@@ -137,14 +171,13 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
             return attention(q, k, v, causal=True).astype(jnp.float32).sum()
 
         grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-        out = grad_fn(q, k, v)
-        jax.block_until_ready(out)
-        reps = 3
-        t0 = time.perf_counter()
-        for _ in range(reps):
+
+        def call():
             out = grad_fn(q, k, v)
-        float(out[0])  # readback = completion
-        dt = (time.perf_counter() - t0) / reps
+            float(out[0])  # readback = completion
+
+        times = timed_reps(call, reps=3, warmup=2)
+        dt = min(times)  # steady-state; mean exposes the warm-up ramp
 
         model_flops = 3 * (4 * batch * H * seq * seq * D) / 2
         tflops = model_flops / dt / 1e12
@@ -154,6 +187,7 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
             "TFLOP/s",
             tflops / peak_tflops if peak_tflops else 0.0,
             seconds_per_step=dt,
+            mean_seconds_per_step=sum(times) / len(times),
             kernel=kernel,
         )
 
@@ -193,14 +227,15 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
         "tokens": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
         "targets": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
     }
-    state, metrics = multi(state, batch)
-    float(metrics["loss"])
-    reps = 2
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        state, metrics = multi(state, batch)
-    float(metrics["loss"])
-    dt = (time.perf_counter() - t0) / (reps * LM_FUSED)
+    holder = [state]
+
+    def call():
+        new_state, metrics = multi(holder[0], batch)
+        holder[0] = new_state
+        float(metrics["loss"])
+
+    times = timed_reps(call, reps=2, warmup=2)
+    dt = min(times) / LM_FUSED  # steady-state per step
 
     tokens_per_sec = B * S / dt
     # Model FLOPs per token: 6*N params (fwd+bwd) + causal attention term
@@ -219,6 +254,7 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
         "tokens/sec",
         mfu,
         mfu=mfu,
+        mean_seconds_per_step=sum(times) / len(times) / LM_FUSED,
         params_millions=n_params / 1e6,
     )
 
@@ -255,14 +291,12 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
     # Each step's attention reads the full (static-shape) K and V buffers.
     kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
 
-    out = generate(cfg, params, prompt, num_steps=steps)  # compile
-    jax.block_until_ready(out)
-    reps = 2
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    def call():
         out = generate(cfg, params, prompt, num_steps=steps)
-    int(out[0, -1])  # readback = completion
-    dt = (time.perf_counter() - t0) / reps
+        int(out[0, -1])  # readback = completion
+
+    times = timed_reps(call, reps=2, warmup=2)
+    dt = min(times)
 
     # Headline counts GENERATED tokens only (prefill iterations excluded
     # from the numerator, though their wall time stays in dt — the
@@ -277,6 +311,7 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
         "tokens/sec",
         achieved_gbps / peak_hbm_gbps if peak_hbm_gbps else 0.0,
         hbm_gbps=achieved_gbps,
+        mean_seconds_per_call=sum(times) / len(times),
         steady_state_tokens_per_sec=B * total_steps / dt,
         params_millions=params_bytes / 2 / 1e6,
     )
@@ -471,11 +506,23 @@ def bench_resnet(peak_tflops: float | None) -> None:
             "label": jax.device_put(stacked["label"], sh),
         }
 
-    # Warmup (compile) + prefetch first buffer.
+    # Warmup 1 (compile) + prefetch first buffer.
     host = next_stacked()
     dev = put(host)
     state, metrics = multi_step(state, dev)
     float(metrics["loss"])
+    # Warmup 2(+3), timed: exposes the intra-process throughput ramp
+    # observed through the tunnel (round 3: same executable 10-100x slower
+    # in a process's first minute); _warm stops early on a degraded tunnel.
+    t0 = time.perf_counter()
+    state, metrics = multi_step(state, dev)
+    float(metrics["loss"])
+    warm_dt = time.perf_counter() - t0
+    if warm_dt < 30.0:
+        state, metrics = multi_step(state, dev)
+        float(metrics["loss"])
+
+    n_dev = len(devices)
 
     try:
         compiled = multi_step.lower(state, dev).compile()
@@ -484,6 +531,21 @@ def bench_resnet(peak_tflops: float | None) -> None:
         xla_flops_per_call = float(ca.get("flops", 0.0))
     except Exception:
         xla_flops_per_call = 0.0
+    # Per-DEVICE FLOPs per fused call, either source: cost_analysis
+    # describes the partitioned (per-device) module, and the analytic
+    # model's global-batch count is divided by the device count, so the
+    # two sources agree in scale and mfu below divides by one chip's peak.
+    flops_source = "xla_cost_analysis"
+    flops_per_dev_call = xla_flops_per_call
+    if not flops_per_dev_call:
+        # Some plugin backends return an empty cost analysis (round 3
+        # emitted mfu=0.0 on hardware for exactly this reason). Fall back
+        # to the standard hand model: ResNet-50 fwd ~4.09 GFLOP per 224^2
+        # image (MACs x2), training ~3x fwd.
+        flops_source = "analytic"
+        flops_per_dev_call = 3 * 4.09e9 * BATCH * FUSED_STEPS * (
+            (IMAGE_SIZE / 224.0) ** 2
+        ) / n_dev
 
     # Measured loop: host pipeline + transfer + compute, double-buffered.
     dev = put(next_stacked())
@@ -500,17 +562,19 @@ def bench_resnet(peak_tflops: float | None) -> None:
     images = BATCH * FUSED_STEPS * MEASURE_CALLS
     images_per_sec = images / dt
     mfu = (
-        xla_flops_per_call * MEASURE_CALLS / dt / (peak_tflops * 1e12 * len(devices))
-        if peak_tflops and xla_flops_per_call
+        flops_per_dev_call * MEASURE_CALLS / dt / (peak_tflops * 1e12)
+        if peak_tflops
         else 0.0
     )
-    per_chip_baseline = BASELINE_IMAGES_PER_SEC * len(devices)
+    per_chip_baseline = BASELINE_IMAGES_PER_SEC * n_dev
     emit(
         f"resnet50_train_images_per_sec_bf16_b{BATCH}_{len(devices)}chip",
         images_per_sec,
         "images/sec",
         images_per_sec / per_chip_baseline,
         mfu=mfu,
+        flops_source=flops_source,
+        warmup_call_seconds=warm_dt,
         input_pipeline="mmap-gather-augment+double-buffered",
     )
 
