@@ -55,6 +55,7 @@ FUSED_STEPS = 20  # steps per jitted call (scan)
 MEASURE_CALLS = 2
 IMAGE_SIZE = 224
 ATTN_CONFIGS = ((8192, 4), (65536, 1))  # (seq, batch)
+ATTN_HEADS, ATTN_HEAD_DIM = 16, 64
 LM_SIZE = dict(vocab_size=32768, d_model=1024, n_heads=16, n_layers=8,
                d_ff=4096, max_seq_len=8192)
 LM_BATCH, LM_SEQ, LM_FUSED = 2, 8192, 4
@@ -145,19 +146,24 @@ def _warm(call, warmup: int, slow_s: float = 30.0) -> None:
             return
 
 
-def bench_flash_attention(peak_tflops: float | None) -> None:
-    """Causal flash attention fwd+bwd at 8k and 64k context, bf16.
+def flash_model_flops(batch: int, seq: int) -> float:
+    """Causal fwd+bwd model FLOPs: fwd = 4*B*H*S^2*D / 2 (causal), bwd
+    counted as 2x fwd (the recompute inside the streaming kernel is extra
+    hardware work, NOT model work, so achieved model-TFLOP/s understates
+    device FLOP/s). Shared with perf_probe's flashramp probe so the two
+    tools' TFLOP/s stay comparable."""
+    return 3 * (4 * batch * ATTN_HEADS * seq * seq * ATTN_HEAD_DIM) / 2
 
-    Model FLOPs: fwd = 4*B*H*S^2*D / 2 (causal), bwd counted as 2x fwd
-    (the recompute inside the streaming kernel is extra hardware work, NOT
-    model work, so achieved model-TFLOP/s understates device FLOP/s).
-    """
+
+def bench_flash_attention(peak_tflops: float | None) -> None:
+    """Causal flash attention fwd+bwd at 8k and 64k context, bf16 (FLOP
+    accounting: flash_model_flops)."""
     import jax
     import jax.numpy as jnp
 
     from tf_operator_tpu.ops import attention, attention_kernel
 
-    H, D = 16, 64
+    H, D = ATTN_HEADS, ATTN_HEAD_DIM
     for seq, batch in ATTN_CONFIGS:
         kernel = attention_kernel(seq, seq, D, 2, causal=True)
         q, k, v = (
@@ -179,8 +185,7 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
         times = timed_reps(call, reps=3, warmup=2)
         dt = min(times)  # steady-state; mean exposes the warm-up ramp
 
-        model_flops = 3 * (4 * batch * H * seq * seq * D) / 2
-        tflops = model_flops / dt / 1e12
+        tflops = flash_model_flops(batch, seq) / dt / 1e12
         emit(
             f"flash_attention_fwd_bwd_tflops_bf16_seq{seq}_1chip",
             tflops,

@@ -246,6 +246,87 @@ def probe_stem() -> None:
     )
 
 
+def probe_flashramp() -> None:
+    """Per-rep times for the 8k flash-attention config that measured a
+    pathological 17.8 s/step on round-3 hardware (while 64k ran 10x
+    faster with 16x the work). If later reps are fast, the earlier number
+    was the intra-process throughput ramp; if uniformly slow, the 8k
+    shape genuinely mis-tiles and the kernel needs work."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops import attention, attention_kernel
+
+    H, D = bench.ATTN_HEADS, bench.ATTN_HEAD_DIM
+    seq, batch = (256, 1) if os.environ.get("BENCH_SMOKE") else (8192, 4)
+    q, k, v = (
+        jax.random.normal(jax.random.PRNGKey(i), (batch, seq, H, D),
+                          jnp.bfloat16)
+        for i in range(3)
+    )
+
+    def loss(q, k, v):
+        return attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    rep_s = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        out = grad_fn(q, k, v)
+        float(out[0])
+        rep_s.append(time.perf_counter() - t0)
+    emit(
+        "flashramp", seq=seq, batch=batch,
+        rep_seconds=[round(s, 4) for s in rep_s],
+        best_tflops=bench.flash_model_flops(batch, seq) / min(rep_s[1:]) / 1e12,
+        kernel=attention_kernel(seq, seq, D, 2, causal=True),
+    )
+
+
+def run_window() -> None:
+    """Hardware-window triage: run the probes that answer round 3's open
+    questions, highest-value first, each in its own subprocess with a
+    budget (a dying tunnel hangs inside native code; isolation bounds the
+    damage to one probe). Usage: `python perf_probe.py window [budget_s]`.
+
+    Order: roofline (is the chip in a fast or slow state right now?) →
+    synthetic ResNet (device-resident compute rate — splits bench.py's
+    59.9 img/s between compute and input/transfer) → flashramp (8k
+    pathology: ramp or real) → stem (conv7 vs s2d decision) → h2d.
+    """
+    import subprocess
+
+    me = os.path.abspath(__file__)
+    total = float(sys.argv[2]) if len(sys.argv) > 2 else 3000.0
+    deadline = time.monotonic() + total
+    plan = [  # (probe, budget_s)
+        ("roofline", 300.0),
+        ("synthetic", 900.0),
+        ("flashramp", 600.0),
+        ("stem", 900.0),
+        ("h2d", 180.0),
+    ]
+    for name, budget in plan:
+        left = deadline - time.monotonic()
+        if left < 60.0:
+            print(f"window: out of budget before {name}", file=sys.stderr,
+                  flush=True)
+            break
+        budget = min(budget, left)
+        env = dict(os.environ, PROBE=name)
+        try:
+            proc = subprocess.run([sys.executable, me], env=env,
+                                  timeout=budget)
+            if proc.returncode != 0:
+                # A child dying instantly (jax init through a dead tunnel)
+                # must be distinguishable from one that ran silently.
+                print(f"window: probe {name} exited rc={proc.returncode}",
+                      file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"window: probe {name} timed out after {budget:.0f}s",
+                  file=sys.stderr, flush=True)
+
+
 def probe_roofline() -> None:
     import jax
     import jax.numpy as jnp
@@ -307,6 +388,7 @@ def probe_roofline() -> None:
 
 PROBES = {
     "roofline": probe_roofline,
+    "flashramp": probe_flashramp,
     "h2d": probe_h2d,
     "input": probe_input,
     "fwd_split": probe_fwd_split,
@@ -316,6 +398,9 @@ PROBES = {
 
 
 def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "window":
+        run_window()
+        return
     if os.environ.get("BENCH_SMOKE"):
         from tf_operator_tpu.parallel.testing import force_cpu_mesh
 
