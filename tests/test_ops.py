@@ -88,6 +88,50 @@ def test_flash_rectangular_grads_match_reference(tq, tk):
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_decoupled_q_block_matches_reference(causal):
+    # block_q > block: the causal block-skip arithmetic (_last_kv/_first_q)
+    # and the asymmetric BlockSpecs only engage when the two differ.
+    q, k, v = _rand_qkv(np.random.default_rng(4))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block=16, block_q=64,
+                            interpret=True)
+        return (o * o).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    out = flash_attention(q, k, v, causal=causal, block=16, block_q=64,
+                          interpret=True)
+    np.testing.assert_allclose(
+        out, reference_attention(q, k, v, causal=causal),
+        atol=2e-5, rtol=2e-5,
+    )
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            gf, gr, atol=2e-4, rtol=2e-4, err_msg=f"d{name}"
+        )
+
+
+def test_flash_auto_block_pair_is_decoupled():
+    # Auto-selection at LM bench shapes grows the q block to MAX_Q_BLOCK
+    # while the kv block stays at the Mosaic-legal 256.
+    from tf_operator_tpu.ops.flash_attention import (
+        MAX_Q_BLOCK,
+        select_block_pair,
+    )
+
+    assert select_block_pair(8192, 8192, compiled=True) == (MAX_Q_BLOCK, 256)
+    assert select_block_pair(65536, 65536, compiled=True) == (MAX_Q_BLOCK, 256)
+    # Q block only grows in multiples that divide tq.
+    assert select_block_pair(256, 256, compiled=True) == (256, 256)
+    assert select_block_pair(48, 48, compiled=True) == (48, 48)
+    assert select_block_pair(48, 96, compiled=True) is None
+
+
 def test_flash_bf16_close_to_f32_reference():
     q, k, v = _rand_qkv(np.random.default_rng(3), dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, block=64, interpret=True)

@@ -73,13 +73,14 @@ def on_tpu_backend() -> bool:
 
 def select_block(tq: int, tk: int, *, compiled: bool = False,
                  max_block: int = 256) -> int | None:
-    """Largest block that tiles BOTH sequence lengths, or None.
+    """Largest KV block that tiles BOTH sequence lengths, or None.
 
-    This is the single source of truth for flash dispatchability: the same
-    block is used on the Q side and the K side, so it must divide both
-    lengths, and under the Mosaic lowering (compiled=True) a trailing-two
-    BlockSpec dim must be a multiple of 128 or equal to the whole dimension
-    on *that* side; interpret mode (CPU CI) has no such limit.
+    This is the single source of truth for flash dispatchability: the KV
+    block must divide both lengths (the Q block is then grown
+    independently — see select_block_pair), and under the Mosaic lowering
+    (compiled=True) a trailing-two BlockSpec dim must be a multiple of 128
+    or equal to the whole dimension on *that* side; interpret mode (CPU
+    CI) has no such limit.
     """
     for b in _BLOCK_CANDIDATES:
         if b > max_block or tq % b or tk % b:
@@ -99,6 +100,32 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
     ):
         return tq  # single block: equal-to-dim is always a legal BlockSpec
     return None
+
+
+# Q-block growth cap: the score tile is [bq, bk] f32 in VMEM (512x256 =
+# 512 KiB, well inside a core's ~16 MiB) and the Q-side accumulators are
+# [bq, head_dim] f32. Growing bq amortizes the K/V HBM streaming — per
+# grid cell the kernel moves O(bk*d) K/V bytes for O(bq*bk*d) FLOPs, so
+# arithmetic intensity scales linearly in bq; at bq=bk=256, d=64 the
+# fwd+bwd cells sit near the measured HBM roofline (round-3 perf notes).
+MAX_Q_BLOCK = 512
+
+
+def select_block_pair(
+    tq: int, tk: int, *, compiled: bool = False,
+    max_q_block: int = MAX_Q_BLOCK,
+) -> tuple[int, int] | None:
+    """(block_q, block_kv) or None: the KV block from select_block, with
+    the Q block grown to the largest power-of-two multiple <= max_q_block
+    that still divides tq (Mosaic sublane alignment is implied: multiples
+    of a legal block stay legal on the sublane dim)."""
+    bk = select_block(tq, tk, compiled=compiled)
+    if bk is None:
+        return None
+    bq = bk
+    while bq * 2 <= max_q_block and tq % (bq * 2) == 0:
+        bq *= 2
+    return bq, bk
 
 
 def pick_block(seq_len: int, *, compiled: bool = False,
@@ -122,11 +149,40 @@ def flash_supported(tq: int, tk: int, head_dim: int, itemsize: int,
 # ---------------------------------------------------------------------------
 # kernels — grid (batch, head, q_block, kv_block); kv is the sequential
 # ("arbitrary") dim, so VMEM scratch carries accumulators across it.
+#
+# Blocks are rectangular: bq rows of Q per cell, bk columns of K/V. Under
+# causal masking, q-block i (rows [i*bq, (i+1)*bq)) interacts with
+# kv-block j (cols [j*bk, (j+1)*bk)) iff j*bk <= (i+1)*bq - 1, i.e.
+# j <= _last_kv(i) := ((i+1)*bq - 1) // bk; symmetrically the first
+# active q-block for kv-block j is _first_q(j) := (j*bk) // bq.
 # ---------------------------------------------------------------------------
 
 
+def _last_kv(i, bq, bk):
+    return ((i + 1) * bq - 1) // bk
+
+
+def _first_q(j, bq, bk):
+    return (j * bk) // bq
+
+
+def _causal_clamps(causal, bq, bk):
+    """(kv_clamp, q_clamp) index-map clamps for the causal block skip, or
+    (None, None): kv_clamp keeps above-diagonal kv cells on the last
+    active kv block of their q row-block (fwd/dq grids, x=q); q_clamp
+    keeps below-diagonal q cells on the first active q block of their kv
+    column-block (dkv grid, x=kv). Shared so the fwd and bwd pallas_calls
+    cannot drift."""
+    if not causal:
+        return None, None
+    return (
+        lambda x, y: jnp.minimum(y, _last_kv(x, bq, bk)),
+        lambda x, y: jnp.maximum(y, _first_q(x, bq, bk)),
+    )
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
-                *, blk, causal, scale, nk):
+                *, bq, bk, causal, scale, nk):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
@@ -135,7 +191,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         m[:] = jnp.full_like(m, _NEG_INF)
         l[:] = jnp.zeros_like(l)
 
-    @pl.when(jnp.logical_or(not causal, j <= i))
+    @pl.when(jnp.logical_or(not causal, j <= _last_kv(i, bq, bk)))
     def _compute():
         # Matmul inputs stay in their storage dtype (bf16 on the training
         # path) with f32 ACCUMULATION via preferred_element_type — an
@@ -146,8 +202,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         v_blk = v_ref[0, 0, :, :]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = i * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_prev = m[:]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
@@ -161,7 +217,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         )
         m[:] = m_new
 
-    last = i if causal else nk - 1
+    last = _last_kv(i, bq, bk) if causal else nk - 1
     @pl.when(j == last)
     def _finalize():
         safe_l = jnp.maximum(l[:], 1e-30)
@@ -170,14 +226,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, blk, causal, scale, nk):
+               dq_acc, *, bq, bk, causal, scale, nk):
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    @pl.when(jnp.logical_or(not causal, j <= i))
+    @pl.when(jnp.logical_or(not causal, j <= _last_kv(i, bq, bk)))
     def _compute():
         q = q_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
@@ -188,8 +244,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = i * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
@@ -197,22 +253,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k_blk, preferred_element_type=jnp.float32
         )
 
-    last = i if causal else nk - 1
+    last = _last_kv(i, bq, bk) if causal else nk - 1
     @pl.when(j == last)
     def _finalize():
         dq_ref[0, 0, :, :] = dq_acc[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, blk, causal, scale, ni):
+                dk_ref, dv_ref, dk_acc, dv_acc,
+                *, bq, bk, causal, scale, ni):
     j, i = pl.program_id(2), pl.program_id(3)  # note: q blocks innermost
 
-    @pl.when(i == (j if causal else 0))
+    @pl.when(i == (_first_q(j, bq, bk) if causal else 0))
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(jnp.logical_or(not causal, i >= j))
+    @pl.when(jnp.logical_or(not causal, i >= _first_q(j, bq, bk)))
     def _compute():
         k_blk = k_ref[0, 0, :, :]
         v_blk = v_ref[0, 0, :, :]
@@ -223,8 +280,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)
         if causal:
-            q_pos = i * blk + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * blk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            q_pos = i * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, s.shape, 1)
             p = jnp.where(q_pos >= k_pos, p, 0.0)
         dv_acc[:] = dv_acc[:] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -247,25 +304,17 @@ def _spec_x(blk, d):
     return pl.BlockSpec((1, 1, blk, d), lambda b, h, x, y: (b, h, x, 0))
 
 
-def _spec_y(blk, d, *, clamp_to_x: bool = False):
-    """Block follows grid dim y; with clamp_to_x, above-diagonal cells
-    (y > x, predicated off under causal masking) re-request block x —
-    an unchanged block index means pallas skips the HBM->VMEM copy, so
-    masked cells cost neither FLOPs nor bandwidth."""
-    if clamp_to_x:
+def _spec_y(blk, d, *, clamp=None):
+    """Block follows grid dim y; with `clamp` (a function of grid dim x
+    giving the last/first active y), cells predicated off under causal
+    masking re-request an already-active block — an unchanged block index
+    means pallas skips the HBM->VMEM copy, so masked cells cost neither
+    FLOPs nor bandwidth."""
+    if clamp is not None:
         return pl.BlockSpec(
-            (1, 1, blk, d), lambda b, h, x, y: (b, h, jnp.minimum(x, y), 0)
+            (1, 1, blk, d), lambda b, h, x, y: (b, h, clamp(x, y), 0)
         )
     return pl.BlockSpec((1, 1, blk, d), lambda b, h, x, y: (b, h, y, 0))
-
-
-def _spec_y_floor_x(blk, d):
-    """Block follows grid dim y, clamped UP to x: for the dkv grid
-    (x=kv_block, y=q_block) causal cells with y < x are masked — fetch
-    block x instead of streaming unused q/do/lse blocks."""
-    return pl.BlockSpec(
-        (1, 1, blk, d), lambda b, h, x, y: (b, h, jnp.maximum(x, y), 0)
-    )
 
 
 # Shared grid contract: (batch, head) and the x block dim parallel; the
@@ -275,30 +324,31 @@ _COMPILER_PARAMS = pltpu.CompilerParams(
 )
 
 
-def _flash_fwd(q, k, v, causal, scale, blk, interpret):
+def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    ni, nk = tq // blk, tk // blk
+    ni, nk = tq // bq, tk // bk
     kernel = functools.partial(
-        _fwd_kernel, blk=blk, causal=causal, scale=scale, nk=nk
+        _fwd_kernel, bq=bq, bk=bk, causal=causal, scale=scale, nk=nk
     )
+    kv_clamp, _ = _causal_clamps(causal, bq, bk)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, ni, nk),
         in_specs=[
-            _spec_x(blk, d),
-            _spec_y(blk, d, clamp_to_x=causal),
-            _spec_y(blk, d, clamp_to_x=causal),
+            _spec_x(bq, d),
+            _spec_y(bk, d, clamp=kv_clamp),
+            _spec_y(bk, d, clamp=kv_clamp),
         ],
-        out_specs=[_spec_x(blk, d), _spec_x(blk, 1)],
+        out_specs=[_spec_x(bq, d), _spec_x(bq, 1)],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk, d), jnp.float32),
-            pltpu.VMEM((blk, 1), jnp.float32),
-            pltpu.VMEM((blk, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -306,54 +356,55 @@ def _flash_fwd(q, k, v, causal, scale, blk, interpret):
     return o, lse
 
 
-def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk, interpret):
+def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    ni, nk = tq // blk, tk // blk
+    ni, nk = tq // bq, tk // bk
     delta = jnp.einsum(
         "bhtd,bhtd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
     )[..., None]
 
+    kv_clamp, q_clamp = _causal_clamps(causal, bq, bk)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, blk=blk, causal=causal, scale=scale,
-                          nk=nk),
+        functools.partial(_dq_kernel, bq=bq, bk=bk, causal=causal,
+                          scale=scale, nk=nk),
         grid=(b, h, ni, nk),
         in_specs=[
-            _spec_x(blk, d),                          # q by q-block
-            _spec_y(blk, d, clamp_to_x=causal),       # k by kv-block
-            _spec_y(blk, d, clamp_to_x=causal),       # v by kv-block
-            _spec_x(blk, d),                          # do by q-block
-            _spec_x(blk, 1),                          # lse by q-block
-            _spec_x(blk, 1),                          # delta by q-block
+            _spec_x(bq, d),                          # q by q-block
+            _spec_y(bk, d, clamp=kv_clamp),          # k by kv-block
+            _spec_y(bk, d, clamp=kv_clamp),          # v by kv-block
+            _spec_x(bq, d),                          # do by q-block
+            _spec_x(bq, 1),                          # lse by q-block
+            _spec_x(bq, 1),                          # delta by q-block
         ],
-        out_specs=_spec_x(blk, d),
+        out_specs=_spec_x(bq, d),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((blk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     # dkv grid: (b, h, kv_block, q_block) — q blocks stream innermost.
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, blk=blk, causal=causal, scale=scale,
-                          ni=ni),
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, causal=causal,
+                          scale=scale, ni=ni),
         grid=(b, h, nk, ni),
         in_specs=[
-            (_spec_y_floor_x(blk, d) if causal else _spec_y(blk, d)),  # q
-            _spec_x(blk, d),                          # k by kv-block (dim 2)
-            _spec_x(blk, d),                          # v by kv-block
-            (_spec_y_floor_x(blk, d) if causal else _spec_y(blk, d)),  # do
-            (_spec_y_floor_x(blk, 1) if causal else _spec_y(blk, 1)),  # lse
-            (_spec_y_floor_x(blk, 1) if causal else _spec_y(blk, 1)),  # delta
+            _spec_y(bq, d, clamp=q_clamp),           # q
+            _spec_x(bk, d),                          # k by kv-block (dim 2)
+            _spec_x(bk, d),                          # v by kv-block
+            _spec_y(bq, d, clamp=q_clamp),           # do
+            _spec_y(bq, 1, clamp=q_clamp),           # lse
+            _spec_y(bq, 1, clamp=q_clamp),           # delta
         ],
-        out_specs=[_spec_x(blk, d), _spec_x(blk, d)],
+        out_specs=[_spec_x(bk, d), _spec_x(bk, d)],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((blk, d), jnp.float32),
-            pltpu.VMEM((blk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -361,20 +412,20 @@ def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk, interpret):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, blk, interpret):
-    o, _ = _flash_fwd(q, k, v, causal, scale, blk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, bq, bk, interpret):
+    o, _ = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, blk, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, scale, blk, interpret)
+def _flash_vjp_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, blk, interpret, res, do):
+def _flash_vjp_bwd(causal, scale, bq, bk, interpret, res, do):
     q, k, v, o, lse = res
-    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, blk,
+    dq, dk, dv = _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, bq, bk,
                                  interpret)
     return dq, dk, dv
 
@@ -390,14 +441,16 @@ def flash_attention(
     causal: bool = True,
     scale: float | None = None,
     block: int | None = None,
+    block_q: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blocked flash attention. q/k/v: [batch, seq, heads, head_dim].
 
-    Requires seq divisible by ``block`` (auto-picked when None; on TPU the
-    block must also satisfy Mosaic tiling — see pick_block). Raises
-    ValueError when no legal block exists — callers should use
-    ops.attention() which falls back to the XLA path.
+    Requires kv seq divisible by ``block`` and q seq by ``block_q`` (both
+    auto-picked when None; on TPU the blocks must also satisfy Mosaic
+    tiling — see select_block_pair). Raises ValueError when no legal block
+    exists — callers should use ops.attention() which falls back to the
+    XLA path.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -405,13 +458,16 @@ def flash_attention(
         interpret = not on_tpu_backend()
     tq, tk = q.shape[1], k.shape[1]
     if block is None:
-        block = select_block(tq, tk, compiled=not interpret)
+        pair = select_block_pair(tq, tk, compiled=not interpret)
+        block = pair[1] if pair else None
+        if block_q is None and pair:
+            block_q = pair[0]
     elif not interpret and block % 128 != 0:
         # A caller-supplied block must satisfy the same compiled-path
         # legality select_block enforces, or the failure surfaces later as
         # an opaque Mosaic lowering error: non-%128 blocks are only legal as
         # the equal-to-dim single block, with the same sublane-alignment and
-        # VMEM-score-tile caps as select_block's fallback (lines 72-79).
+        # VMEM-score-tile caps as select_block's fallback.
         if not (block == tq == tk and tq % 16 == 0 and tq <= 512):
             raise ValueError(
                 f"block={block} is not Mosaic-legal for seq lengths "
@@ -419,13 +475,25 @@ def flash_attention(
                 f"128, or equal to both sequence lengths with seq % 16 == 0 "
                 f"and seq <= 512"
             )
-    if block is None or tq % block or tk % block:
-        raise ValueError(f"seq lengths ({tq},{tk}) don't tile (block={block})")
+    if block_q is None:
+        block_q = block
+    if block is None or tq % block_q or tk % block:
+        raise ValueError(
+            f"seq lengths ({tq},{tk}) don't tile "
+            f"(block_q={block_q}, block={block})"
+        )
+    if not interpret and block_q != block and block_q % block != 0:
+        # The causal block-skip arithmetic (_last_kv/_first_q) and the
+        # Mosaic sublane legality both assume bq is a multiple of bk when
+        # they differ.
+        raise ValueError(f"block_q={block_q} must be a multiple of "
+                         f"block={block}")
     if causal and tq != tk:
         raise ValueError("causal flash requires tq == tk")
     if max(tq, tk) > MAX_SEQ_LEN:
         raise ValueError(f"seq > MAX_SEQ_LEN ({MAX_SEQ_LEN})")
     # [B,T,H,D] -> [B,H,T,D] for the kernels; XLA folds the transposes.
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, float(scale), int(block), bool(interpret))
+    o = _flash(qt, kt, vt, causal, float(scale), int(block_q), int(block),
+               bool(interpret))
     return o.transpose(0, 2, 1, 3)
