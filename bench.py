@@ -146,6 +146,28 @@ def _warm(call, warmup: int, slow_s: float = 30.0) -> None:
             return
 
 
+def attn_inputs(batch: int, seq: int):
+    """bf16 q, k, v at the bench attention geometry (ATTN_HEADS x
+    ATTN_HEAD_DIM, PRNG keys 0..2). Shared with perf_probe's flashramp /
+    flashblocks probes so every tool measures the identical tensors."""
+    import jax
+    import jax.numpy as jnp
+
+    return tuple(
+        jax.random.normal(
+            jax.random.PRNGKey(i), (batch, seq, ATTN_HEADS, ATTN_HEAD_DIM),
+            jnp.bfloat16,
+        )
+        for i in range(3)
+    )
+
+
+def smoke_attn_config() -> tuple[int, int]:
+    """(seq, batch) for the probe-scale attention runs: the round-3
+    pathological hardware shape, or tiny under BENCH_SMOKE."""
+    return (256, 1) if os.environ.get("BENCH_SMOKE") else (8192, 4)
+
+
 def flash_model_flops(batch: int, seq: int) -> float:
     """Causal fwd+bwd model FLOPs: fwd = 4*B*H*S^2*D / 2 (causal), bwd
     counted as 2x fwd (the recompute inside the streaming kernel is extra
@@ -163,15 +185,9 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
 
     from tf_operator_tpu.ops import attention, attention_kernel
 
-    H, D = ATTN_HEADS, ATTN_HEAD_DIM
     for seq, batch in ATTN_CONFIGS:
-        kernel = attention_kernel(seq, seq, D, 2, causal=True)
-        q, k, v = (
-            jax.random.normal(
-                jax.random.PRNGKey(i), (batch, seq, H, D), jnp.bfloat16
-            )
-            for i in range(3)
-        )
+        kernel = attention_kernel(seq, seq, ATTN_HEAD_DIM, 2, causal=True)
+        q, k, v = attn_inputs(batch, seq)
 
         def loss(q, k, v):
             return attention(q, k, v, causal=True).astype(jnp.float32).sum()

@@ -257,13 +257,8 @@ def probe_flashramp() -> None:
 
     from tf_operator_tpu.ops import attention, attention_kernel
 
-    H, D = bench.ATTN_HEADS, bench.ATTN_HEAD_DIM
-    seq, batch = (256, 1) if os.environ.get("BENCH_SMOKE") else (8192, 4)
-    q, k, v = (
-        jax.random.normal(jax.random.PRNGKey(i), (batch, seq, H, D),
-                          jnp.bfloat16)
-        for i in range(3)
-    )
+    seq, batch = bench.smoke_attn_config()
+    q, k, v = bench.attn_inputs(batch, seq)
 
     def loss(q, k, v):
         return attention(q, k, v, causal=True).astype(jnp.float32).sum()
@@ -279,8 +274,43 @@ def probe_flashramp() -> None:
         "flashramp", seq=seq, batch=batch,
         rep_seconds=[round(s, 4) for s in rep_s],
         best_tflops=bench.flash_model_flops(batch, seq) / min(rep_s[1:]) / 1e12,
-        kernel=attention_kernel(seq, seq, D, 2, causal=True),
+        kernel=attention_kernel(seq, seq, bench.ATTN_HEAD_DIM, 2, causal=True),
     )
+
+
+def probe_flashblocks() -> None:
+    """A/B the decoupled flash-attention Q block on hardware: 8k causal
+    fwd+bwd at block_q 256 (round-3 shipped behavior), 512 (the new
+    auto-pick), and 1024. Decides whether MAX_Q_BLOCK should move."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.flash_attention import flash_attention
+
+    seq, batch = bench.smoke_attn_config()
+    interpret = bool(os.environ.get("BENCH_SMOKE"))
+    q, k, v = bench.attn_inputs(batch, seq)
+    results = {}
+    for bq in (64, 128) if interpret else (256, 512, 1024):
+        if seq % bq:
+            continue
+
+        def loss(q, k, v, bq=bq):
+            o = flash_attention(q, k, v, causal=True, block=64 if interpret
+                                else 256, block_q=bq, interpret=interpret)
+            return o.astype(jnp.float32).sum()
+
+        grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+
+        def call():
+            out = grad_fn(q, k, v)
+            float(out[0])
+
+        dt = min(bench.timed_reps(call, reps=3, warmup=2))
+        results[f"bq{bq}_tflops"] = (
+            bench.flash_model_flops(batch, seq) / dt / 1e12
+        )
+    emit("flashblocks", seq=seq, batch=batch, **results)
 
 
 def run_window() -> None:
@@ -303,6 +333,7 @@ def run_window() -> None:
         ("roofline", 300.0),
         ("synthetic", 900.0),
         ("flashramp", 600.0),
+        ("flashblocks", 600.0),
         ("stem", 900.0),
         ("h2d", 180.0),
     ]
@@ -389,6 +420,7 @@ def probe_roofline() -> None:
 PROBES = {
     "roofline": probe_roofline,
     "flashramp": probe_flashramp,
+    "flashblocks": probe_flashblocks,
     "h2d": probe_h2d,
     "input": probe_input,
     "fwd_split": probe_fwd_split,

@@ -525,3 +525,83 @@ class TestTerminalOnceWithoutStoreGets:
         assert tc._terminal_recorded
         tc.delete_job(client.get(objects.TPUJOBS, "default", job.metadata.name))
         assert not tc._terminal_recorded
+
+
+class TestInformerResyncOrdering:
+    """The reflector race behind the chaos-soak restartCount over-count: a
+    resync relist applied while the watch still buffers PRE-list events
+    resurrects deleted objects into the cache (client-go avoids it by
+    restarting the watch at the list RV; this informer drains first)."""
+
+    def test_resync_drains_stale_watch_events_no_ghost(self):
+        from tf_operator_tpu.controller.informer import Informer
+
+        client = InMemoryCluster()
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {"name": "ghost-a", "namespace": "default"},
+            "spec": {},
+            "status": {"phase": "Running"},
+        }
+        client.create(objects.PODS, pod)
+        inf = Informer(client, objects.PODS, "default")
+        inf.sync_now()
+        assert inf.get("default", "ghost-a") is not None
+
+        # Events buffer unprocessed (the informer loop is "busy"): the pod
+        # fails, then is deleted (the controller's restart teardown).
+        watch = client.watch(objects.PODS, "default")
+        live = client.get(objects.PODS, "default", "ghost-a")
+        objects.set_pod_phase(live, objects.FAILED)
+        client.update_status(objects.PODS, live)
+        client.delete(objects.PODS, "default", "ghost-a")
+
+        # The fixed resync path: drain THEN relist.
+        inf._drain(watch)
+        inf.sync_now()
+        assert inf.get("default", "ghost-a") is None
+        # Nothing stale remains in the buffer to replay over the fresh
+        # list — the ghost-resurrection window is gone.
+        assert watch.next(timeout=0) is None
+
+    def test_restart_not_recounted_for_already_deleted_pod(self):
+        """Counter idempotence: a failed pod replayed by a stale cache
+        (already deleted server-side) must not re-increment restartCount."""
+        job = testutil.new_tpujob(
+            name="ghostcount",
+            worker=1,
+            restart_policy=RestartPolicy.EXIT_CODE,
+        )
+        tc, client = make_controller(real_controls=True)
+        submit(client, job)
+        sync_once(tc, client, job)  # creates the worker pod
+
+        pods = client.list(objects.PODS, "default")
+        assert len(pods) == 1
+        # Fail with a retryable code, sync: one restart counted.
+        failed = pods[0]
+        objects.set_pod_phase(failed, objects.FAILED)
+        objects.set_container_terminated(
+            failed, constants.DEFAULT_CONTAINER_NAME, 137
+        )
+        client.update_status(objects.PODS, failed)
+        sync_once(tc, client, job)
+        got = client.get(objects.TPUJOBS, "default", "ghostcount")
+        assert got["status"].get("restartCount", 0) == 1
+
+        # Replay the SAME failed pod into the informer cache (ghost) after
+        # its real deletion; the sync must not count it again.
+        with tc.pod_informer._lock:
+            tc.pod_informer._cache[
+                f"default/{objects.name_of(failed)}"
+            ] = failed
+        tc.expectations.delete_expectations(
+            tc.expectation_key(tc.job_key("default", "ghostcount"),
+                               "Worker", "pods")
+        )
+        tc.job_informer.sync_now()
+        tc.service_informer.sync_now()
+        tc.sync_job("default/ghostcount")
+        got = client.get(objects.TPUJOBS, "default", "ghostcount")
+        assert got["status"].get("restartCount", 0) == 1

@@ -51,6 +51,14 @@ class Informer:
         self._synced = threading.Event()
         self._thread: threading.Thread | None = None
         self._log = logger.with_fields(informer=kind)
+        # UIDs of objects whose deletion was observed (watch or relist
+        # diff): late watch events for these uids are stale replays —
+        # with an async-delivery backend (kubeclient's HTTP reader
+        # thread) a pre-list event can arrive AFTER the relist and
+        # resurrect a deleted object into the cache ("ghost"). UIDs are
+        # never reused, so suppression is exact; bounded FIFO.
+        self._dead_uids: dict[str, None] = {}
+        self._dead_uids_cap = 1024
 
     # -- registration / cache reads -----------------------------------------
 
@@ -84,13 +92,44 @@ class Informer:
 
     # -- delta processing ----------------------------------------------------
 
+    def _mark_dead(self, obj: dict[str, Any]) -> None:
+        uid = objects.uid_of(obj)
+        if not uid:
+            return
+        self._dead_uids[uid] = None
+        while len(self._dead_uids) > self._dead_uids_cap:
+            self._dead_uids.pop(next(iter(self._dead_uids)))
+
     def _apply(self, etype: str, obj: dict[str, Any]) -> None:
         key = objects.key_of(obj)
+        uid = objects.uid_of(obj)
         with self._lock:
             old = self._cache.get(key)
             if etype == DELETED:
-                self._cache.pop(key, None)
+                replayed = bool(uid) and uid in self._dead_uids
+                # A DELETED naming a DIFFERENT live incarnation (same key,
+                # new uid — the relist already replaced it) must not pop
+                # the live object; its on_delete still fires (below) if
+                # this is the first observation of that deletion.
+                stale_incarnation = (
+                    old is not None
+                    and uid
+                    and objects.uid_of(old)
+                    and objects.uid_of(old) != uid
+                )
+                self._mark_dead(obj)
+                if not stale_incarnation:
+                    self._cache.pop(key, None)
+                if replayed:
+                    # Handlers (expectation decrements) already ran for
+                    # this deletion — e.g. the relist diff synthesized it
+                    # and the buffered watch DELETED arrives later.
+                    return
             else:
+                if uid and uid in self._dead_uids:
+                    # Stale replay of an object whose deletion was already
+                    # observed — applying it would resurrect a ghost.
+                    return
                 self._cache[key] = obj
         for h in self._handlers:
             try:
@@ -136,10 +175,31 @@ class Informer:
         )
         self._thread.start()
 
+    def _drain(self, watch: Any) -> None:
+        """Apply every already-buffered watch event.
+
+        MUST run before a relist: `sync_now` rebuilds the cache from a
+        fresh LIST, and applying a pre-list buffered event afterwards
+        would replay stale state over it — observed as a "ghost" failed
+        pod resurrected into the cache after its DELETED had been
+        synthesized by the list diff, which a concurrent worker sync then
+        double-counted as a second restart (chaos soak, restartCount 20
+        vs 19 injected). client-go avoids the same race by restarting the
+        watch from the list's resourceVersion; draining first gives the
+        same pre-list/post-list ordering without RV coupling (events that
+        arrive DURING the list are post-snapshot for our backends, which
+        list under a store lock / at a single RV).
+        """
+        while True:
+            event = watch.next(timeout=0)
+            if event is None:
+                return
+            self._apply(event.type, event.object)
+
     def _run(self, stop: threading.Event) -> None:
         watch = self._client.watch(self.kind, self.namespace)
+        self._drain(watch)  # events buffered between watch-start and list
         self.sync_now()
-        last_resync = 0.0
         import time as _time
 
         last_resync = _time.monotonic()
@@ -149,6 +209,7 @@ class Informer:
                 self._apply(event.type, event.object)
             if _time.monotonic() - last_resync >= self.resync_period:
                 try:
+                    self._drain(watch)
                     self.sync_now()
                 except Exception:
                     self._log.exception("resync failed")

@@ -136,7 +136,14 @@ class PodReconciler:
             if objects.labels_of(p).get(constants.LABEL_REPLICA_TYPE) == rtype.lower()
         ]
         buckets, out_of_range = get_pod_slices(rtype_pods, replicas)
-        summary = {"created": 0, "deleted": 0, "restarts": 0, "permanent_failure": False}
+        # "restarts" increments the restartCount counter (idempotent: only
+        # landed trigger deletes); "restarting" reports that failed pods
+        # were handled by a restart this sync — the status engine keys
+        # Restarting-vs-Failed on it, and it must stay True even when the
+        # trigger pod was already gone (stale-cache replay), else the
+        # snapshot's failed count would read as permanent.
+        summary = {"created": 0, "deleted": 0, "restarts": 0,
+                   "restarting": False, "permanent_failure": False}
 
         # Scale-down leftovers.
         for pod in out_of_range:
@@ -190,6 +197,11 @@ class PodReconciler:
             else:  # Never
                 permanent_indices.add(index)
 
+        # The pods that TRIGGERED a restart (failed + retryable), before
+        # slice expansion adds healthy collateral members: a restart event
+        # is counted below only when a trigger's delete actually lands.
+        trigger_indices = set(restart_indices)
+
         # Slice-granular expansion: one bad host restarts its whole slice
         # group; a permanent failure on any host poisons the whole group.
         if group_size > 1:
@@ -221,13 +233,33 @@ class PodReconciler:
                     job.status.restart_count + len(groups) <= job.spec.max_restarts
                 )
             if budget_left:
+                summary["restarting"] = True
+                # Count one restart per group in which at least one delete
+                # actually removed a live object: a stale cache can replay
+                # an already-handled failed pod (informer ghost race —
+                # suppressed at the source by uid tracking, but the
+                # counter must stay exact against any stale-cache path);
+                # a fully-ghost group's deletes all return NotFound and
+                # must not re-increment restartCount.
+                landed_groups: set[int] = set()
                 for idx in sorted(restart_indices):
                     pod = buckets[idx][0]
                     if self._delete_pod_expected(job, exp_key, objects.name_of(pod)):
                         summary["deleted"] += 1
-                summary["restarts"] = len(groups)
+                        landed_groups.add(idx // group_size)
+                summary["restarts"] = len(landed_groups)
             else:
-                summary["permanent_failure"] = True
+                # Budget exhausted. Before declaring a terminal failure,
+                # confirm a trigger pod still exists server-side WITH the
+                # observed uid: a stale-cache replay of an already-handled
+                # failure must not permanently fail a healthy job.
+                for idx in sorted(trigger_indices):
+                    if idx // group_size not in groups or not buckets[idx]:
+                        continue
+                    cached = buckets[idx][0]
+                    if self._pod_live(job, cached):
+                        summary["permanent_failure"] = True
+                        break
 
         # Create missing pods (expectation first, then create — the order the
         # reference is careful about, controller_pod.go:131-191).
@@ -253,12 +285,31 @@ class PodReconciler:
                     raise
         return summary
 
+    def _pod_live(self, job: TPUJob, cached: dict) -> bool:
+        """Whether the CACHED pod incarnation still exists server-side
+        (same name AND uid). Used only on rare paths (budget exhaustion)
+        where acting on a stale observation would be terminal."""
+        from tf_operator_tpu.runtime.client import NotFound
+
+        try:
+            live = self.client.get(
+                objects.PODS, job.metadata.namespace, objects.name_of(cached)
+            )
+        except NotFound:
+            return False
+        cached_uid = objects.uid_of(cached)
+        return not cached_uid or objects.uid_of(live) == cached_uid
+
     def _delete_pod_expected(self, job: TPUJob, exp_key: str, name: str) -> bool:
         """Delete with a deletion expectation that is rolled back on failure.
 
-        A pod already gone (deleted externally between list and delete) counts
-        as success for reconciliation purposes, but its expectation must be
-        released here because its DELETED event fired before we raised it.
+        Returns True only when the delete REMOVED a live object. A pod
+        already gone (NotFound — deleted externally, or a stale-cache
+        replay of an already-handled pod) returns False: reconciliation
+        treats that as done, and the restart counter depends on the
+        distinction to stay exact (landed_groups above). The NotFound
+        path must also release the expectation raised here, because the
+        pod's DELETED event fired before we raised it.
         """
         from tf_operator_tpu.runtime.client import NotFound
 

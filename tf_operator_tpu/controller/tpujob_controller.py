@@ -283,10 +283,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             job.status.restart_count = floor
 
         restarts = 0
+        restarting = False
         permanent_failure = False
         for rtype, spec in sorted(job.spec.replica_specs.items()):
             summary = self.reconcile_pods(job, rtype, spec, pods)
             restarts += summary["restarts"]
+            restarting = restarting or summary["restarting"]
             permanent_failure = permanent_failure or summary["permanent_failure"]
             self.reconcile_services(job, rtype, spec, services)
 
@@ -294,7 +296,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         if restarts:
             self._restart_floor[job.key] = job.status.restart_count
             RESTARTS_TOTAL.inc(restarts)
-        self.update_job_status(job, pods, restarts, permanent_failure)
+        self.update_job_status(job, pods, restarting, permanent_failure)
         try:
             self.update_status_handler(job)
         except Conflict:
@@ -373,7 +375,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         self,
         job: TPUJob,
         pods: list[dict[str, Any]],
-        restarts_this_sync: int,
+        restarting: bool,
         permanent_failure: bool,
     ) -> None:
         """Recompute replica counters + conditions from observed pods
@@ -435,15 +437,17 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             return
 
         total_failed = sum(s.failed for s in rs.values())
-        if restarts_this_sync > 0 and not permanent_failure:
+        if restarting and not permanent_failure:
             # Failed pods observed this sync were deleted for a (slice)
-            # restart — the snapshot's failed counts are about to clear.
+            # restart (or their deletion had already landed and the cache
+            # is one step stale) — the snapshot's failed counts are about
+            # to clear.
             status_engine.update_job_conditions(
                 job,
                 JobConditionType.RESTARTING,
                 status_engine.REASON_RESTARTING,
-                f"TPUJob {name} is restarting ({restarts_this_sync} slice restart(s) "
-                f"this sync, {job.status.restart_count} total).",
+                f"TPUJob {name} is restarting "
+                f"({job.status.restart_count} restart(s) total).",
             )
             return
         if permanent_failure or (total_failed > 0 and not self._any_restartable(job)):
