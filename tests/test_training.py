@@ -648,6 +648,41 @@ class TestDecode:
                 rtol=1e-4, atol=1e-4,
             )
 
+    def test_batched_prefill_matches_full_forward(self):
+        """A multi-token prefill call (the whole prompt in ONE decode-mode
+        forward, block-causal attention over the cache) produces the same
+        logits as the training forward, and leaves the cache positioned so
+        subsequent single-token steps match teacher forcing."""
+        from dataclasses import replace
+
+        cfg = self._cfg()
+        model = Transformer(cfg)
+        rng = np.random.default_rng(1)
+        tokens = jnp.asarray(rng.integers(0, 32, (2, 12)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        full = model.apply({"params": params}, tokens)
+
+        dmodel = Transformer(replace(cfg, decode=True))
+        cache = dmodel.init(jax.random.PRNGKey(0), tokens[:, :1])["cache"]
+        prefill, updates = dmodel.apply(
+            {"params": params, "cache": cache}, tokens[:, :8],
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(prefill), np.asarray(full[:, :8]), rtol=1e-4, atol=1e-4
+        )
+        cache = updates["cache"]
+        for t in range(8, tokens.shape[1]):
+            logits, updates = dmodel.apply(
+                {"params": params, "cache": cache}, tokens[:, t : t + 1],
+                mutable=["cache"],
+            )
+            cache = updates["cache"]
+            np.testing.assert_allclose(
+                np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                rtol=1e-4, atol=1e-4,
+            )
+
     def test_generate_learns_plus_one(self):
         """Greedy generation from a model trained on the +1-mod-vocab task
         continues the chain."""

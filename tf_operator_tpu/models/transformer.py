@@ -53,9 +53,11 @@ class TransformerConfig:
     # removes the O(n_layers * S * d_model) residual-stream term).
     remat: bool = False
     # Autoregressive decoding mode: each Attention keeps a KV cache of
-    # max_seq_len in a flax "cache" collection, calls take ONE token per
-    # step, and the position comes from the cache index. Single-device
-    # (mesh is ignored); see ``generate`` for the jitted sampling loop.
+    # max_seq_len in a flax "cache" collection. A call may carry t >= 1
+    # tokens (multi-token calls are block-causal prompt PREFILL; sampling
+    # feeds one token per step); positions come from the cache index.
+    # Single-device (mesh is ignored); see ``generate`` for the jitted
+    # sampling loop.
     decode: bool = False
     # Mixture-of-Experts: every Nth block (1-indexed from the first) swaps
     # its dense MLP for a Switch-routed expert MLP (models/moe.py) sharded
@@ -143,23 +145,23 @@ class Attention(nn.Module):
         )(out)
 
     def _decode_attend(self, q, k, v):
-        """One-token attention against the layer's KV cache.
+        """Block attention against the layer's KV cache (t >= 1 tokens).
 
         The cache is a fixed [B, max_seq_len, H, Dh] buffer of past keys
-        and values (static shapes — the decode loop is jittable/scannable);
-        positions beyond the cache index are masked. HARD precondition:
-        at most max_seq_len total tokens may be decoded — past that,
-        dynamic_update_slice clamps the write index and silently overwrites
-        the last slot (``generate`` enforces the budget up front; callers
-        driving apply() directly must too). Numerics follow
-        reference_attention (f32 scores/softmax, d^-0.5 scale) so decode
-        logits match the training forward exactly
+        and values (static shapes — the decode loop is jittable/scannable).
+        A multi-token call (prompt PREFILL) writes all t keys/values at the
+        cache index and attends causally within the block: query row i sees
+        cached positions <= idx + i. Single-token calls are the sampling
+        steady state. HARD precondition: at most max_seq_len total tokens
+        may be decoded — past that, dynamic_update_slice clamps the write
+        index and silently overwrites the last slot (``generate`` enforces
+        the budget up front; callers driving apply() directly must too).
+        Numerics follow reference_attention (f32 scores/softmax, d^-0.5
+        scale) so decode logits match the training forward exactly
         (tests/test_training.py::test_decode_matches_full_forward).
         """
         cfg = self.cfg
         b, t, h, dh = q.shape
-        if t != 1:
-            raise ValueError(f"decode takes one token per call, got {t}")
         cached_k = self.variable(
             "cache", "cached_key",
             jnp.zeros, (b, cfg.max_seq_len, h, dh), cfg.dtype,
@@ -174,7 +176,9 @@ class Attention(nn.Module):
         if self.is_initializing():
             # init() executes this forward once to build the variables; the
             # cache must come out untouched (index 0, zero buffers), and
-            # one-token self-attention is just v.
+            # block-causal self-attention with an empty cache reduces to a
+            # value passthrough only at t == 1 — init shapes are all that
+            # matter here.
             return v
         idx = index.value
         cached_k.value = jax.lax.dynamic_update_slice(
@@ -183,13 +187,17 @@ class Attention(nn.Module):
         cached_v.value = jax.lax.dynamic_update_slice(
             cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
         )
-        index.value = idx + 1
+        index.value = idx + t
         s = jnp.einsum(
             "bqhd,bkhd->bhqk", q, cached_k.value,
             preferred_element_type=jnp.float32,
         ) * (dh ** -0.5)
-        valid = jnp.arange(cfg.max_seq_len) <= idx
-        s = jnp.where(valid[None, None, None, :], s, -1e30)
+        # Query row i (absolute position idx + i) sees keys <= idx + i.
+        valid = (
+            jnp.arange(cfg.max_seq_len)[None, :]
+            <= (idx + jnp.arange(t))[:, None]
+        )
+        s = jnp.where(valid[None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", p, cached_v.value.astype(jnp.float32)
@@ -245,9 +253,9 @@ class Transformer(nn.Module):
             pidx = self.variable(
                 "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
             )
-            positions = pidx.value[None, None]
+            positions = (pidx.value + jnp.arange(tokens.shape[1]))[None, :]
             if not self.is_initializing():
-                pidx.value = pidx.value + 1
+                pidx.value = pidx.value + tokens.shape[1]
         else:
             positions = jnp.arange(tokens.shape[1])[None, :]
         pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos")(
@@ -281,10 +289,11 @@ def generate(
 ) -> jax.Array:
     """Jitted autoregressive generation with a KV cache.
 
-    The whole loop — prompt prefill then ``num_steps`` of sample-and-feed —
-    is two lax.scans inside one jit: static shapes, one compilation, no
-    host round-trips per token (the TPU-native decode shape; a Python
-    token loop would be dispatch-bound). ``temperature=0`` is greedy;
+    The whole loop — one batched prompt-prefill forward, then
+    ``num_steps`` of sample-and-feed via lax.scan — runs inside one jit:
+    static shapes, one compilation, no host round-trips per token (the
+    TPU-native decode shape; a Python token loop would be
+    dispatch-bound). ``temperature=0`` is greedy;
     otherwise categorical sampling with ``rng``. Returns [B, num_steps]
     generated tokens. Single-device: the training mesh/ring config is
     dropped for decoding.
@@ -325,11 +334,22 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float):
 
     def run(params, prompt, rng):
         cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
-        cache, logits = jax.lax.scan(
-            lambda c, t: token_step(params, c, t), cache,
-            prompt.swapaxes(0, 1),
+        # Prompt PREFILL in ONE forward pass (block-causal attention over
+        # the cache): a token-by-token prefill scan would pay the full
+        # per-step weight read prompt_len times — at bench shapes that was
+        # half the decode wall time for work a single batched pass does.
+        # return_hidden skips the f32 [B, P, vocab] logits over the whole
+        # prompt; only the LAST position feeds sampling, so the head runs
+        # on that one row.
+        hidden, updates = model.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"],
+            return_hidden=True,
         )
-        last_logits = logits[-1]
+        cache = updates["cache"]
+        head = params["lm_head"]
+        last_logits = (
+            hidden[:, -1].astype(jnp.float32) @ head["kernel"] + head["bias"]
+        )
 
         def sample(carry, step_rng):
             cache, logits = carry
