@@ -446,6 +446,68 @@ def bench_submit_latency() -> None:
     )
 
 
+def measure_chain_matmul_tflops(n: int, depth: int, reps: int = 3) -> float:
+    """bf16 TFLOP/s of a depth-deep n^3 matmul scan chain (the compute
+    ceiling: chaining amortizes per-executable overhead). Shared by the
+    bench calibration section and perf_probe's roofline probe."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+
+    def chain(a, b):
+        def body(c, _):
+            return (c @ b) / jnp.asarray(n, jnp.bfloat16), ()
+
+        c, _ = jax.lax.scan(body, a, None, length=depth)
+        return c.astype(jnp.float32).sum()
+
+    ch = jax.jit(chain)
+    dt = min(timed_reps(lambda: float(ch(a, b)), reps=reps, warmup=2))
+    return depth * 2 * n**3 / dt / 1e12
+
+
+def measure_copy_gbps(gib: bool = True, reps: int = 5) -> float:
+    """On-device copy bandwidth GB/s, read+write, ~1 GB buffer (or small
+    under BENCH_SMOKE). The scale factor 1.0078125 = 1 + 2^-7 is exact in
+    bf16 and != 1.0, so XLA cannot elide the kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    m = jnp.zeros((8, 1024, 1024) if smoke else (512, 1024, 1024),
+                  jnp.bfloat16)
+    cp = jax.jit(lambda x: x * jnp.asarray(1.0078125, jnp.bfloat16))
+    dt = min(timed_reps(
+        lambda: jax.block_until_ready(cp(m)), reps=reps, warmup=2
+    ))
+    return 2 * m.size * 2 / dt / 1e9
+
+
+def bench_calibration(peak_tflops: float | None) -> None:
+    """Measured environment ceilings, stamped into every bench artifact.
+
+    Spec peaks assume local chips; through a tunnel the real ceilings sit
+    far below them (round 3: 111 of 197 TFLOP/s compute, 111 of 819 GB/s
+    copy), so each run's vs_baseline/mfu fractions need the same-run
+    measured ceiling alongside to be interpretable. ~30 s."""
+    import jax
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    n, depth = (512, 4) if smoke else (4096, 20)
+    chain_tflops = measure_chain_matmul_tflops(n, depth)
+    copy_gbps = measure_copy_gbps()
+    emit(
+        "chip_calibration_matmul_chain_tflops_bf16",
+        chain_tflops,
+        "TFLOP/s",
+        chain_tflops / peak_tflops if peak_tflops else 0.0,
+        copy_gbps=copy_gbps,
+        device_kind=getattr(jax.devices()[0], "device_kind", "?"),
+    )
+
+
 def bench_resnet(peak_tflops: float | None) -> None:
     import jax
     import jax.numpy as jnp
@@ -635,10 +697,12 @@ def _arm_watchdog(budget: float | None = None) -> float:
 
 # section -> (bench fn, peak-table lookup, soft time budget seconds).
 # Order = run priority: the flagship ResNet metric gets the chip first,
-# the LM section (largest compile) last, so a tunnel that dies mid-bench
-# costs the least-important lines.
+# then the cheap calibration stamp (measured ceilings contextualize every
+# other line), the LM section (largest compile) last — a tunnel that dies
+# mid-bench costs the least-important lines.
 _SECTIONS: dict = {
     "resnet": (bench_resnet, chip_peak_tflops, 1500.0),
+    "calibration": (bench_calibration, chip_peak_tflops, 240.0),
     "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
@@ -751,6 +815,7 @@ def main() -> None:
         peak = chip_peak_tflops(jax.devices()[0])
         peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
         for section, arg in (
+            (bench_calibration, peak),
             (bench_flash_attention, peak),
             (bench_transformer_lm, peak),
             (bench_decode, peak_hbm),
@@ -774,7 +839,8 @@ def main() -> None:
         with jax.profiler.trace(profile_dir):
             if os.environ.get("BENCH_ONLY") != "resnet":
                 # Secondary metrics must never take down the flagship line.
-                for fn, peak_of, _ in (_SECTIONS["flash_attention"],
+                for fn, peak_of, _ in (_SECTIONS["calibration"],
+                                       _SECTIONS["flash_attention"],
                                        _SECTIONS["lm"],
                                        _SECTIONS["decode"]):
                     try:

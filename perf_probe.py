@@ -373,38 +373,21 @@ def probe_roofline() -> None:
     # size-dependent pathologies (round 3 observed 2048-cubed running 200x
     # slower than 8192-cubed through the tunnel); the scan chain amortizes
     # any per-executable overhead, so it is the compute ceiling.
+    # Best-of-reps with multi-warmup, same as the chain/copy helpers — a
+    # methodology mismatch here would confound the single-vs-chain gap
+    # (per-executable overhead) with timing semantics.
     sizes = (512,) if smoke else (2048, 4096, 8192)
     single = {}
     for n in sizes:
         a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
         b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
         mm = jax.jit(lambda a, b: (a @ b).astype(jnp.float32).sum())
-        dt = timeit(mm, a, b, reps=10)
+        dt = min(bench.timed_reps(lambda: float(mm(a, b)), reps=5, warmup=2))
         single[f"matmul_{n}_tflops"] = 2 * n**3 / dt / 1e12
 
     n = 512 if smoke else 4096
-    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
-    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
-    depth = 20
-
-    def chain(a, b):
-        def body(c, _):
-            return (c @ b) / jnp.asarray(n, jnp.bfloat16), ()
-
-        c, _ = jax.lax.scan(body, a, None, length=depth)
-        return c.astype(jnp.float32).sum()
-
-    dt = timeit(jax.jit(chain), a, b, reps=3)
-    chain_tflops = depth * 2 * n**3 / dt / 1e12
-
-    # On-device copy bandwidth (read + write), ~1 GB buffer. The scale
-    # factor must be bf16-representable and != 1.0 (1.000001 rounds to
-    # exactly 1.0 in bf16, which XLA would simplify to an elidable
-    # identity): 1.0078125 = 1 + 2^-7 is exact in bf16.
-    m = jnp.zeros((8, 1024, 1024) if smoke else (512, 1024, 1024), jnp.bfloat16)
-    cp = jax.jit(lambda x: x * jnp.asarray(1.0078125, jnp.bfloat16))
-    dt = timeit(cp, m, reps=5)
-    copy_gbps = 2 * m.size * 2 / dt / 1e9
+    chain_tflops = bench.measure_chain_matmul_tflops(n, 4 if smoke else 20)
+    copy_gbps = bench.measure_copy_gbps()
 
     emit(
         "roofline",
