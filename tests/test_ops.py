@@ -143,6 +143,23 @@ def test_flash_bf16_close_to_f32_reference():
     )
 
 
+def test_attention_kernel_env_override(monkeypatch):
+    from tf_operator_tpu.ops import attention_kernel
+
+    monkeypatch.delenv("TPU_OPERATOR_ATTN", raising=False)
+    # Default on CPU: xla.
+    assert attention_kernel(128, 128, 16, 4) == "xla"
+    # Forcing flash off-TPU stays on xla (the kernel would only run in
+    # the orders-of-magnitude-slower Pallas interpreter here).
+    monkeypatch.setenv("TPU_OPERATOR_ATTN", "flash")
+    assert attention_kernel(128, 128, 16, 4) == "xla"
+    monkeypatch.setenv("TPU_OPERATOR_ATTN", "xla")
+    assert attention_kernel(128, 128, 16, 4) == "xla"
+    monkeypatch.setenv("TPU_OPERATOR_ATTN", "pallas")  # typo → loud error
+    with pytest.raises(ValueError):
+        attention_kernel(128, 128, 16, 4)
+
+
 def test_pick_block():
     assert pick_block(1024) == 256
     assert pick_block(128) == 128

@@ -4,9 +4,15 @@ The dispatch rule lives here: ``attention()`` picks the pallas flash kernel
 when running on TPU with tileable shapes, otherwise the XLA reference path
 (which XLA still fuses well on CPU/small shapes). Models call this one entry
 point so the kernel choice is a deployment detail, not a model concern.
+
+``TPU_OPERATOR_ATTN=xla`` forces the XLA path (``flash`` forces the kernel
+where legal) — the bench-day A/B knob: it reaches every model's attention
+through this dispatch without code edits.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 
@@ -24,10 +30,30 @@ def attention_kernel(tq: int, tk: int, head_dim: int, itemsize: int,
     """Which kernel attention() will run for these shapes on THIS backend:
     "pallas-flash" or "xla". The single source of truth for the dispatch —
     attention() consults it, and benchmarks label their output with it (so
-    the label can never drift from what actually executed)."""
+    the label can never drift from what actually executed).
+    TPU_OPERATOR_ATTN overrides ("xla" always honored; "flash" honored
+    when the shapes tile)."""
+    forced = os.environ.get("TPU_OPERATOR_ATTN", "").strip().lower()
+    if forced and forced not in ("xla", "flash"):
+        # A typo must not silently measure the kernel an A/B run meant to
+        # exclude.
+        raise ValueError(
+            f"TPU_OPERATOR_ATTN={forced!r}: expected 'xla' or 'flash'"
+        )
+    if forced == "xla":
+        return "xla"
     on_tpu = on_tpu_backend()
+    if forced == "flash":
+        # Only meaningful on TPU: off-TPU the kernel would run in the
+        # Pallas INTERPRETER, orders of magnitude slower than the XLA
+        # path it displaces (see on_tpu_backend).
+        if on_tpu and flash_supported(
+            tq, tk, head_dim, itemsize, causal=causal, compiled=True
+        ):
+            return "pallas-flash"
+        return "xla"
     if on_tpu and flash_supported(
-        tq, tk, head_dim, itemsize, causal=causal, compiled=on_tpu
+        tq, tk, head_dim, itemsize, causal=causal, compiled=True
     ):
         return "pallas-flash"
     return "xla"
