@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from tf_operator_tpu.ops import attention as device_attention
-from tf_operator_tpu.parallel.ring_attention import ring_attention
+from tf_operator_tpu.parallel.ring_attention import (
+    _use_flash_blocks,
+    ring_attention,
+    ring_flash_attention,
+)
 
 
 @dataclass(frozen=True)
@@ -45,7 +49,15 @@ class TransformerConfig:
     # Bound per-device attention-score memory under ring attention: fold kv
     # in chunks of this many keys (None = whole block at once). Exact either
     # way; set for long contexts where a [Tq, Tk] f32 tile won't fit.
+    # (Applies to the "stream" impl; the "flash" impl's kernels are blocked
+    # in VMEM already.)
     ring_kv_chunk: int | None = None
+    # Ring attention implementation: "stream" (autodiff through the ring
+    # scan, supports ring_kv_chunk), "flash" (custom-VJP second-ring
+    # backward with Pallas block kernels on TPU — no forward tape), or
+    # "auto" (flash on TPU with tileable per-device blocks and no
+    # ring_kv_chunk request, else stream).
+    ring_impl: str = "auto"
     # Rematerialize each block on the backward pass (jax.checkpoint): layer
     # activations are recomputed instead of stored, trading ~1/3 more FLOPs
     # for O(n_layers) less HBM — what makes long-context training fit on a
@@ -103,14 +115,43 @@ class Attention(nn.Module):
                 if cfg.mesh.shape.get(cfg.tp_axis, 1) > 1
                 else (None,)
             )
-            out = ring_attention(
-                q, k, v, cfg.mesh,
-                seq_axis=cfg.seq_axis,
-                batch_spec=batch_spec,
-                head_spec=head_spec,
-                causal=True,
-                kv_chunk=cfg.ring_kv_chunk,
+            if cfg.ring_impl not in ("auto", "stream", "flash"):
+                # A typo must not silently run the other implementation.
+                raise ValueError(
+                    f"ring_impl={cfg.ring_impl!r}: expected 'auto', "
+                    f"'stream', or 'flash'"
+                )
+            if cfg.ring_impl == "flash" and cfg.ring_kv_chunk is not None:
+                # The flash impl's XLA fallback materializes the full
+                # per-device score tile; silently dropping the memory
+                # bound would OOM exactly the long contexts it exists for.
+                raise ValueError(
+                    "ring_impl='flash' ignores ring_kv_chunk; use "
+                    "ring_impl='stream' (or 'auto') with ring_kv_chunk"
+                )
+            sp = cfg.mesh.shape[cfg.seq_axis]
+            use_flash_ring = cfg.ring_impl == "flash" or (
+                cfg.ring_impl == "auto"
+                and cfg.ring_kv_chunk is None
+                and _use_flash_blocks(t // sp, t // sp)
             )
+            if use_flash_ring:
+                out = ring_flash_attention(
+                    q, k, v, cfg.mesh,
+                    seq_axis=cfg.seq_axis,
+                    batch_spec=batch_spec,
+                    head_spec=head_spec,
+                    causal=True,
+                )
+            else:
+                out = ring_attention(
+                    q, k, v, cfg.mesh,
+                    seq_axis=cfg.seq_axis,
+                    batch_spec=batch_spec,
+                    head_spec=head_spec,
+                    causal=True,
+                    kv_chunk=cfg.ring_kv_chunk,
+                )
         else:
             # ops.attention dispatches: pallas flash kernel on TPU with
             # tileable shapes, XLA reference path otherwise. The pallas

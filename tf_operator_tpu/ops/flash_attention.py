@@ -357,12 +357,26 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 
 def _flash_bwd_impl(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
-    ni, nk = tq // bq, tk // bk
     delta = jnp.einsum(
         "bhtd,bhtd->bht", do.astype(jnp.float32), o.astype(jnp.float32)
     )[..., None]
+    return _flash_bwd_from_stats(q, k, v, do, lse, delta, causal, scale,
+                                 bq, bk, interpret)
+
+
+def _flash_bwd_from_stats(q, k, v, do, lse, delta, causal, scale, bq, bk,
+                          interpret):
+    """(dq, dk, dv) from softmax stats: lse/delta [B,H,T,1].
+
+    The stats may be GLOBAL (ring attention's merged logsumexp and
+    delta = sum(do*o_global)) — p = exp(s - lse) then yields each block's
+    exact share of the global softmax, which is what makes the per-block
+    ring backward communication-free beyond the rotation. Single home of
+    the dq/dkv pallas_call configuration for both the single-device VJP
+    and the ring backward."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    ni, nk = tq // bq, tk // bk
 
     kv_clamp, q_clamp = _causal_clamps(causal, bq, bk)
     dq = pl.pallas_call(

@@ -156,6 +156,282 @@ def ring_attention(
     )(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# Custom-VJP ring attention ("ring flash"): the long-context training path.
+#
+# The streaming implementation above differentiates by taping every ring
+# step (autodiff through scan): O(ring) saved score tiles. This variant
+# instead saves only (q, k, v, out, lse) per device and runs a SECOND ring
+# in the backward — the standard ring-attention gradient — with rotating
+# dk/dv accumulators that travel with their k/v blocks and arrive home
+# after a full rotation. Exactness hinges on one identity: with the
+# GLOBAL logsumexp, each block's softmax share is p = exp(s_blk - lse),
+# so per-block forward results merge by logaddexp and per-block backward
+# needs no inter-block communication beyond the rotation itself.
+#
+# Per-block compute dispatches to the Pallas flash kernels on TPU
+# (ops/flash_attention.py — fwd returns (o, lse); dq/dkv recompute from
+# the global lse), with an XLA fallback elsewhere; under causal masking a
+# ring step is one of exactly three modes: the diagonal block (aligned
+# causal), a past block (full attention), or a future block (skipped —
+# no FLOPs, no softmax statistics).
+# ---------------------------------------------------------------------------
+
+
+def _xla_block_fwd(q, k, v, scale, causal):
+    """(o_f32, lse) for one q-block x kv-block pair, XLA path.
+
+    lse: [B, H, Tq] global-softmax statistics for THIS block alone.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.maximum(p.sum(axis=-1), 1e-30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p / l[..., None],
+                   v.astype(jnp.float32))
+    return o, m + jnp.log(l)
+
+
+def _xla_block_bwd(q, k, v, do, lse, delta, scale, causal):
+    """(dq, dk, dv) for one block pair given GLOBAL lse and delta."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse[..., None])
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        p = jnp.where(mask[None, None], p, 0.0)
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    return dq, dk, dv
+
+
+def _use_flash_blocks(tq: int, tk: int) -> bool:
+    from tf_operator_tpu.ops.flash_attention import (
+        on_tpu_backend,
+        select_block,
+    )
+
+    return on_tpu_backend() and select_block(tq, tk, compiled=True) is not None
+
+
+def _kernel_block_fwd(q, k, v, scale, causal):
+    """Pallas flash fwd for one block pair: (o_f32, lse [B,H,Tq])."""
+    from tf_operator_tpu.ops.flash_attention import (
+        _flash_fwd,
+        on_tpu_backend,
+        select_block_pair,
+    )
+
+    interpret = not on_tpu_backend()  # CPU tests drive the kernel path
+    bq, bk = select_block_pair(q.shape[1], k.shape[1],
+                               compiled=not interpret)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    o, lse = _flash_fwd(qt, kt, vt, causal, scale, bq, bk, interpret)
+    return o.transpose(0, 2, 1, 3).astype(jnp.float32), lse[..., 0]
+
+
+def _kernel_block_bwd(q, k, v, do, lse, delta, scale, causal):
+    """Pallas flash bwd for one block pair from GLOBAL lse/delta (the
+    shared stats-accepting core in ops/flash_attention.py)."""
+    from tf_operator_tpu.ops.flash_attention import (
+        _flash_bwd_from_stats,
+        on_tpu_backend,
+        select_block_pair,
+    )
+
+    interpret = not on_tpu_backend()
+    bq, bk = select_block_pair(q.shape[1], k.shape[1],
+                               compiled=not interpret)
+    qt, kt, vt, dot = (x.transpose(0, 2, 1, 3) for x in (q, k, v, do))
+    dq, dk, dv = _flash_bwd_from_stats(
+        qt, kt, vt, dot, lse[..., None], delta[..., None],
+        causal, scale, bq, bk, interpret,
+    )
+    return (
+        dq.transpose(0, 2, 1, 3).astype(jnp.float32),
+        dk.transpose(0, 2, 1, 3).astype(jnp.float32),
+        dv.transpose(0, 2, 1, 3).astype(jnp.float32),
+    )
+
+
+def _merge_block(o, lse, o_blk, lse_blk):
+    """Fold one block's (o, lse) into the global accumulators."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    w_old = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]
+    w_blk = jnp.exp(lse_blk - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w_old + o_blk * w_blk, lse_new
+
+
+def _make_ring_flash_local(axis_name: str, causal: bool, scale: float,
+                           use_kernel: bool):
+    """Build the per-device custom-VJP body (runs under shard_map)."""
+    block_fwd = _kernel_block_fwd if use_kernel else _xla_block_fwd
+    block_bwd = _kernel_block_bwd if use_kernel else _xla_block_bwd
+
+    @jax.custom_vjp
+    def local(q, k, v):
+        out, _ = _fwd(q, k, v)
+        return out
+
+    def _fwd(q, k, v):
+        axis_size = lax.psum(1, axis_name)
+        my_idx = lax.axis_index(axis_name)
+        b, tq, h, d = q.shape
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        o0 = jnp.zeros((b, tq, h, d), jnp.float32)
+        lse0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
+
+        def step(carry, i):
+            o, lse, k_cur, v_cur = carry
+            kv_idx = (my_idx - i) % axis_size
+            if causal:
+                def diag(_):
+                    return block_fwd(q, k_cur, v_cur, scale, True)
+
+                def past(_):
+                    return block_fwd(q, k_cur, v_cur, scale, False)
+
+                def future(_):
+                    return (jnp.zeros_like(o0),
+                            jnp.full_like(lse0, _NEG_INF))
+
+                mode = jnp.where(
+                    kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2)
+                )
+                o_blk, lse_blk = lax.switch(mode, (diag, past, future), None)
+            else:
+                o_blk, lse_blk = block_fwd(q, k_cur, v_cur, scale, False)
+            o, lse = _merge_block(o, lse, o_blk, lse_blk)
+            return (
+                o, lse,
+                lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm),
+            ), None
+
+        (o, lse, _, _), _ = lax.scan(
+            step, (o0, lse0, k, v), jnp.arange(axis_size)
+        )
+        return o.astype(q.dtype), lse
+
+    def fwd(q, k, v):
+        out, lse = _fwd(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def bwd(res, do):
+        q, k, v, out, lse = res
+        axis_size = lax.psum(1, axis_name)
+        my_idx = lax.axis_index(axis_name)
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        delta = jnp.einsum(
+            "bqhd,bqhd->bhq", do.astype(jnp.float32),
+            out.astype(jnp.float32),
+        )
+
+        zeros_kv = jnp.zeros(k.shape, jnp.float32)
+
+        def step(carry, i):
+            dq, k_cur, v_cur, dk_cur, dv_cur = carry
+            kv_idx = (my_idx - i) % axis_size
+            if causal:
+                def diag(_):
+                    return block_bwd(q, k_cur, v_cur, do, lse, delta,
+                                     scale, True)
+
+                def past(_):
+                    return block_bwd(q, k_cur, v_cur, do, lse, delta,
+                                     scale, False)
+
+                def future(_):
+                    return jnp.zeros_like(dq), zeros_kv, zeros_kv
+
+                mode = jnp.where(
+                    kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2)
+                )
+                dq_b, dk_b, dv_b = lax.switch(mode, (diag, past, future), None)
+            else:
+                dq_b, dk_b, dv_b = block_bwd(q, k_cur, v_cur, do, lse,
+                                             delta, scale, False)
+            dq = dq + dq_b
+            # The dk/dv accumulators travel WITH their k/v blocks: after a
+            # full rotation they arrive back at the block's home device.
+            return (
+                dq,
+                lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm),
+                lax.ppermute(dk_cur + dk_b, axis_name, perm),
+                lax.ppermute(dv_cur + dv_b, axis_name, perm),
+            ), None
+
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        (dq, _, _, dk, dv), _ = lax.scan(
+            step, (dq0, k, v, zeros_kv, zeros_kv), jnp.arange(axis_size)
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    local.defvjp(fwd, bwd)
+    return local
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "sp",
+    batch_spec: Any = ("dp",),
+    head_spec: Any = (None,),
+    causal: bool = True,
+    scale: float | None = None,
+    use_kernel: bool | None = None,
+) -> jax.Array:
+    """Custom-VJP ring attention (see module section comment).
+
+    Same contract as ring_attention; the backward runs a second ring
+    instead of taping the forward scan (O(1) saved tensors per device vs
+    O(ring steps)), and per-block compute uses the Pallas flash kernels
+    when on TPU with tileable per-device blocks (``use_kernel`` forces
+    the choice for tests).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if causal and q.shape[1] != k.shape[1]:
+        # The diag/past/future block classification and the per-block masks
+        # assume aligned equal blocks; ring_attention's global-position
+        # masking handles the rectangular causal case.
+        raise ValueError(
+            f"causal ring_flash_attention requires equal q/kv seq lengths "
+            f"(got {q.shape[1]}, {k.shape[1]}); use ring_attention"
+        )
+    sp = mesh.shape.get(seq_axis, 1)
+    tq = q.shape[1] // sp
+    tk = k.shape[1] // sp
+    if use_kernel is None:
+        use_kernel = _use_flash_blocks(tq, tk)
+    spec = P(*batch_spec, seq_axis, *head_spec, None)
+    body = _make_ring_flash_local(seq_axis, causal, float(scale),
+                                  bool(use_kernel))
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def reference_attention(q, k, v, causal: bool = True, scale: float | None = None):
     """Single-device exact attention — the correctness oracle for tests."""
     if scale is None:
