@@ -8,8 +8,15 @@ streaming (flash-style) softmax accumulates exact results — O(T/sp) memory
 per device, communication overlapped with the next block's compute by XLA.
 
 Shapes follow [batch, seq, heads, head_dim]. Works under shard_map on any
-mesh axis; differentiable (autodiff through the scan+ppermute); used by
-models/transformer.py when ``sp > 1``.
+mesh axis; used by models/transformer.py when ``sp > 1``. Two
+implementations share the contract:
+
+- ``ring_attention`` — streaming softmax, differentiable by autodiff
+  through the scan+ppermute (tapes every ring step); supports
+  ``kv_chunk`` to bound the per-step score tile.
+- ``ring_flash_attention`` — custom VJP: the backward runs a second ring
+  (no forward tape), and per-block compute uses the Pallas flash kernels
+  on TPU. The training default on TPU (TransformerConfig.ring_impl).
 """
 
 from __future__ import annotations
