@@ -695,6 +695,17 @@ def _arm_watchdog(budget: float | None = None) -> float:
     return budget
 
 
+def _section_selected(name: str) -> bool:
+    """BENCH_ONLY: comma-separated section allowlist (empty = all).
+
+    'BENCH_ONLY=resnet' remains the driver's flagship-only fallback;
+    'BENCH_ONLY=lm,calibration' runs an A/B subset."""
+    only = os.environ.get("BENCH_ONLY", "").strip()
+    if not only:
+        return True
+    return name in {s.strip() for s in only.split(",")}
+
+
 # section -> (bench fn, peak-table lookup, soft time budget seconds).
 # Order = run priority: the flagship ResNet metric gets the chip first,
 # then the cheap calibration stamp (measured ceilings contextualize every
@@ -737,7 +748,7 @@ def _run_sections_isolated(deadline: float) -> None:
     flagship_lines: list[str] = []
     emitted_after_flagship = False
     for name, (_, _, soft_budget) in _SECTIONS.items():
-        if os.environ.get("BENCH_ONLY") == "resnet" and name != "resnet":
+        if not _section_selected(name):
             continue
         remaining = deadline - time.monotonic()
         budget = min(soft_budget, remaining - 45.0)
@@ -798,7 +809,7 @@ def main() -> None:
     # at all): run it BEFORE backend init, so even a round whose TPU tunnel
     # is down (jax.devices() hanging until the watchdog fires — rounds 2
     # and 3 both hit multi-hour outages) still lands one measured metric.
-    if os.environ.get("BENCH_ONLY") != "resnet":
+    if _section_selected("submit"):
         try:
             bench_submit_latency()
         except Exception as exc:  # noqa: BLE001
@@ -812,20 +823,21 @@ def main() -> None:
         # the production subprocess runner below (CI coverage for it).
         import jax
 
-        peak = chip_peak_tflops(jax.devices()[0])
-        peak_hbm = chip_peak_hbm_gbps(jax.devices()[0])
-        for section, arg in (
-            (bench_calibration, peak),
-            (bench_flash_attention, peak),
-            (bench_transformer_lm, peak),
-            (bench_decode, peak_hbm),
-        ):
+        dev0 = jax.devices()[0]
+        # Secondary sections (never take down the flagship) then resnet,
+        # whose failure must stay loud. Derived from _SECTIONS so the
+        # smoke/profile/isolated modes cannot drift.
+        for sec_name in [n for n in _SECTIONS if n != "resnet"]:
+            if not _section_selected(sec_name):
+                continue
+            fn, peak_of, _ = _SECTIONS[sec_name]
             try:
-                section(arg)
+                fn(peak_of(dev0))
             except Exception as exc:  # noqa: BLE001
-                print(f"bench: {section.__name__} failed: {exc!r}",
+                print(f"bench: {fn.__name__} failed: {exc!r}",
                       file=sys.stderr, flush=True)
-        bench_resnet(peak)
+        if _section_selected("resnet"):
+            bench_resnet(chip_peak_tflops(dev0))
         return
     # BENCH_PROFILE=<dir>: sections run in-process under one profiler
     # trace (open with xprof/tensorboard) — the tool for attributing a
@@ -837,18 +849,18 @@ def main() -> None:
 
         dev = jax.devices()[0]
         with jax.profiler.trace(profile_dir):
-            if os.environ.get("BENCH_ONLY") != "resnet":
+            for sec in [n for n in _SECTIONS if n != "resnet"]:
+                if not _section_selected(sec):
+                    continue
+                fn, peak_of, _ = _SECTIONS[sec]
                 # Secondary metrics must never take down the flagship line.
-                for fn, peak_of, _ in (_SECTIONS["calibration"],
-                                       _SECTIONS["flash_attention"],
-                                       _SECTIONS["lm"],
-                                       _SECTIONS["decode"]):
-                    try:
-                        fn(peak_of(dev))
-                    except Exception as exc:  # noqa: BLE001
-                        print(f"bench: {fn.__name__} failed: {exc!r}",
-                              file=sys.stderr, flush=True)
-            bench_resnet(chip_peak_tflops(dev))
+                try:
+                    fn(peak_of(dev))
+                except Exception as exc:  # noqa: BLE001
+                    print(f"bench: {fn.__name__} failed: {exc!r}",
+                          file=sys.stderr, flush=True)
+            if _section_selected("resnet"):
+                bench_resnet(chip_peak_tflops(dev))
         print(f"bench: profile written to {profile_dir}",
               file=sys.stderr, flush=True)
         return

@@ -322,7 +322,12 @@ def run_window() -> None:
     Order: roofline (is the chip in a fast or slow state right now?) →
     synthetic ResNet (device-resident compute rate — splits bench.py's
     59.9 img/s between compute and input/transfer) → flashramp (8k
-    pathology: ramp or real) → stem (conv7 vs s2d decision) → h2d.
+    pathology: ramp or real) → flashblocks (Q-block A/B) → stem (conv7 vs
+    s2d decision) → h2d, then TWO bench LM legs (flash vs forced-xla
+    attention, up to ~1100 s each) answering whether the flash kernel
+    helps or hurts the LM step. Budget for all of it: ~5500 s on a
+    healthy chip; the default 3000 s covers the probes and at least one
+    LM leg.
     """
     import subprocess
 
@@ -337,25 +342,45 @@ def run_window() -> None:
         ("stem", 900.0),
         ("h2d", 180.0),
     ]
+    def run_child(label: str, argv: list, env: dict, budget: float) -> None:
+        try:
+            proc = subprocess.run(argv, env=env, timeout=budget)
+            if proc.returncode != 0:
+                # A child dying instantly (jax init through a dead tunnel)
+                # must be distinguishable from one that ran silently.
+                print(f"window: {label} exited rc={proc.returncode}",
+                      file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"window: {label} timed out after {budget:.0f}s",
+                  file=sys.stderr, flush=True)
+
     for name, budget in plan:
         left = deadline - time.monotonic()
         if left < 60.0:
             print(f"window: out of budget before {name}", file=sys.stderr,
                   flush=True)
             break
-        budget = min(budget, left)
-        env = dict(os.environ, PROBE=name)
-        try:
-            proc = subprocess.run([sys.executable, me], env=env,
-                                  timeout=budget)
-            if proc.returncode != 0:
-                # A child dying instantly (jax init through a dead tunnel)
-                # must be distinguishable from one that ran silently.
-                print(f"window: probe {name} exited rc={proc.returncode}",
-                      file=sys.stderr, flush=True)
-        except subprocess.TimeoutExpired:
-            print(f"window: probe {name} timed out after {budget:.0f}s",
-                  file=sys.stderr, flush=True)
+        run_child(f"probe {name}", [sys.executable, me],
+                  dict(os.environ, PROBE=name), min(budget, left))
+
+    # LM kernel A/B: the bench LM section twice — flash dispatch (default)
+    # vs TPU_OPERATOR_ATTN=xla forcing the XLA attention path. If round
+    # 3's 8k-attention pathology is real (not the warm-up ramp), the xla
+    # leg runs faster.
+    bench_py = os.path.join(os.path.dirname(me), "bench.py")
+    # Pin the knob on BOTH legs: an ambient TPU_OPERATOR_ATTN=xla export
+    # would otherwise turn the flash leg into a second xla leg.
+    for label, extra in (("lm-ab-flash", {"TPU_OPERATOR_ATTN": ""}),
+                         ("lm-ab-xla", {"TPU_OPERATOR_ATTN": "xla"})):
+        left = deadline - time.monotonic()
+        if left < 60.0:
+            print(f"window: out of budget before {label}", file=sys.stderr,
+                  flush=True)
+            break
+        print(f"window: {label}", file=sys.stderr, flush=True)
+        run_child(label, [sys.executable, bench_py, "--section", "lm"],
+                  dict(os.environ, BENCH_WATCHDOG_S="0", **extra),
+                  min(1100.0, left))
 
 
 def probe_roofline() -> None:
