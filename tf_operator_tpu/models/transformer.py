@@ -68,8 +68,9 @@ class TransformerConfig:
     # max_seq_len in a flax "cache" collection. A call may carry t >= 1
     # tokens (multi-token calls are block-causal prompt PREFILL; sampling
     # feeds one token per step); positions come from the cache index.
-    # Single-device (mesh is ignored); see ``generate`` for the jitted
-    # sampling loop.
+    # The mesh field is unread on this path — tensor-parallel decode
+    # happens via GSPMD propagation from tp-sharded params. See
+    # ``generate`` for the jitted sampling loop.
     decode: bool = False
     # Mixture-of-Experts: every Nth block (1-indexed from the first) swaps
     # its dense MLP for a Switch-routed expert MLP (models/moe.py) sharded
@@ -336,8 +337,9 @@ def generate(
     TPU-native decode shape; a Python token loop would be
     dispatch-bound). ``temperature=0`` is greedy;
     otherwise categorical sampling with ``rng``. Returns [B, num_steps]
-    generated tokens. Single-device: the training mesh/ring config is
-    dropped for decoding.
+    generated tokens. The ring/remat training config is dropped for
+    decoding; TENSOR-PARALLEL decode works by passing tp-sharded params
+    (GSPMD propagates the shardings — see _generate_fn).
 
     The inference-path capability the reference delegates to user
     containers entirely (its operator never runs models); here it
@@ -360,7 +362,14 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float):
     """Build (and cache) the jitted decode loop for one (config, steps,
     temperature) triple. params/prompt/rng are jit ARGUMENTS, so repeated
     generate() calls — including with updated params — reuse the same
-    executable instead of re-tracing a fresh closure each time."""
+    executable instead of re-tracing a fresh closure each time.
+
+    Tensor-parallel decoding needs no mesh plumbing here: the decode path
+    is plain GSPMD-partitionable einsums and never reads cfg.mesh, so
+    calling with tp-sharded params (the training shardings from
+    param_sharding_rules) is sufficient — the KV cache shards over heads
+    by propagation, dp shards the batch
+    (tests/test_training.py::test_tensor_parallel_decode_...)."""
     from dataclasses import replace
 
     dcfg = replace(cfg, decode=True, mesh=None, remat=False)
