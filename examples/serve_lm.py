@@ -1,0 +1,208 @@
+"""LM inference serving — the decode path as an operator-launched job.
+
+Completes the LM family at the examples level: dist_lm.py trains,
+serve_lm.py serves. One process loads params (from an orbax checkpoint
+directory written by dist_lm.py, or quick-trains the synthetic +1-chain
+task at startup so the example is self-contained), optionally shards them
+for tensor-parallel decode (the shardings alone carry the parallelism —
+models/transformer.py generate), and answers greedy completions over a
+stdlib HTTP server:
+
+    GET  /healthz             -> 200 once params are ready
+    POST /generate            {"tokens": [[...]], "num_steps": N}
+                              -> {"tokens": [[...]]} (generated only)
+
+Generation runs the jitted KV-cache decode loop (batched single-pass
+prompt prefill + one-token sampling scan — one compile per
+(batch, prompt_len, num_steps) shape). ``--requests`` bounds the serve
+loop so the process terminates like a job (the operator's Succeeded
+condition); without it the server runs until SIGTERM.
+
+The reference has no inference sample at all (its operator never runs
+models); this is the TPU-native framework owning that path end to end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def quick_train(cfg, steps: int, lr: float):
+    """Train the +1-mod-vocab chain task just enough to serve verifiable
+    completions (same task dist_lm.py uses for acceptance)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.transformer import Transformer
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.train.steps import TrainState, adamw, make_lm_train_step
+
+    mesh = create_mesh({"dp": 1}, jax.devices()[:1])
+    model = Transformer(cfg)
+    rng = np.random.default_rng(0)
+    start = rng.integers(0, cfg.vocab_size, (8, 1))
+    # Chain of seq+1 then slice: rolling the tokens would mislabel the
+    # last position whenever seq % vocab != 0 (dist_lm.py does the same).
+    seq = min(32, cfg.max_seq_len)
+    chain = (start + np.arange(seq + 1)) % cfg.vocab_size
+    batch = {
+        "tokens": jnp.asarray(chain[:, :-1], jnp.int32),
+        "targets": jnp.asarray(chain[:, 1:], jnp.int32),
+    }
+    toks = batch["tokens"]
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    tx = adamw(lr)
+    state = TrainState.create(params, tx)
+    step = make_lm_train_step(model, tx, mesh, seq_axis=None, donate=False)
+    loss = float("nan")
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+    print(f"serve_lm: quick-trained {steps} steps, loss {loss:.3f}",
+          flush=True)
+    return state.params
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    # Model-shape flags default to dist_lm.py's defaults so the
+    # train-then-serve flow works without repeating flags; when loading a
+    # checkpoint from a non-default trainer run, these MUST mirror the
+    # trainer's --vocab/--d-model/--layers/--seq (the restore template is
+    # built from them).
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--max-seq-len", type=int, default=128)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint from dist_lm.py — shape flags "
+                        "must mirror the trainer's (default: quick-train "
+                        "the +1-chain task at startup)")
+    p.add_argument("--train-steps", type=int, default=150)
+    p.add_argument("--lr", type=float, default=5e-3)
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel decode over this many devices")
+    p.add_argument("--requests", type=int, default=None,
+                   help="exit 0 after serving this many /generate calls "
+                        "(job mode); default: run until SIGTERM")
+    args = p.parse_args(argv)
+    if args.requests is not None and args.requests < 1:
+        p.error("--requests must be >= 1 (omit it to serve until SIGTERM)")
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_layers=args.layers, d_ff=args.d_model * 2,
+        max_seq_len=args.max_seq_len, dtype=jnp.float32,
+    )
+    if args.checkpoint_dir:
+        from tf_operator_tpu.models.transformer import Transformer
+        from tf_operator_tpu.train.checkpoint import CheckpointManager
+        from tf_operator_tpu.train.steps import TrainState, adamw
+
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        step = ckpt.latest_step()
+        if step is None:
+            print(f"serve_lm: no checkpoint in {args.checkpoint_dir}",
+                  file=sys.stderr, flush=True)
+            return 1
+        # The trainer saved a full TrainState; restore into a matching
+        # template and keep the params.
+        toks0 = jnp.zeros((1, 1), jnp.int32)
+        template = TrainState.create(
+            Transformer(cfg).init(jax.random.PRNGKey(0), toks0)["params"],
+            adamw(args.lr),
+        )
+        params = ckpt.restore(step, template).params
+        print(f"serve_lm: restored checkpoint step {step}", flush=True)
+    else:
+        params = quick_train(cfg, args.train_steps, args.lr)
+
+    if args.tp > 1:
+        from tf_operator_tpu.parallel.mesh import create_mesh
+        from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+
+        mesh = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+        params = shard_params_by_rules(mesh, params, param_sharding_rules())
+        print(f"serve_lm: params tp-sharded over {args.tp} devices",
+              flush=True)
+
+    served = 0
+    done = threading.Event()
+    lock = threading.Lock()  # generate() calls serialized per chip
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._json(200, {"ok": True, "served": served})
+            else:
+                self._json(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            nonlocal served
+            if self.path != "/generate":
+                self._json(404, {"error": "unknown path"})
+                return
+            try:
+                req = json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                prompt = jnp.asarray(req["tokens"], jnp.int32)
+                num_steps = int(req.get("num_steps", 8))
+                if prompt.ndim != 2:
+                    raise ValueError("tokens must be [batch, len]")
+                with lock:
+                    out = generate(cfg, params, prompt, num_steps=num_steps)
+                self._json(200, {"tokens": out.tolist()})
+            except Exception as exc:  # noqa: BLE001 — client-visible error
+                self._json(400, {"error": repr(exc)})
+                return
+            # Budget accounting under the lock: concurrent handler threads
+            # would otherwise lose increments and never trip the budget.
+            with lock:
+                served += 1
+                if args.requests is not None and served >= args.requests:
+                    done.set()
+
+    server = ThreadingHTTPServer((args.host, args.port), Handler)
+    print(f"serve_lm: listening on {server.server_address[0]}:"
+          f"{server.server_address[1]}", flush=True)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    done.wait()
+    server.shutdown()
+    print(f"serve_lm: done ({served} request(s) served)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

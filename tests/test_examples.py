@@ -282,3 +282,70 @@ def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
             cli.delete("default", "mnistresume")
         except Exception:
             pass
+
+
+def test_serve_lm_inference_job(operator):
+    """An INFERENCE job: serve_lm.py quick-trains the +1-chain task, serves
+    greedy completions over HTTP (batched-prefill KV-cache decode), and
+    terminates Succeeded after its request budget — the operator running
+    the framework's serving path the way the reference ran training
+    containers."""
+    import json
+    import socket
+    import time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    cli = TPUJobClient(RestClusterClient(operator))
+    cli.create(
+        example_job(
+            "servelm", "serve_lm.py", workers=1,
+            extra_args=["--requests", "1", "--train-steps", "150",
+                        "--port", str(port),
+                        # small shapes: quick-train fast on a CPU host
+                        "--vocab", "32", "--d-model", "32",
+                        "--max-seq-len", "64"],
+        )
+    )
+    try:
+        deadline = time.monotonic() + 300
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as r:
+                    up = r.status == 200
+                    break
+            except OSError:
+                time.sleep(2.0)
+        assert up, f"server never came up\nlogs:\n{job_logs(cli, 'servelm')}"
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(
+                {"tokens": [[5, 6, 7, 8]], "num_steps": 5}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        # The trained +1-mod-vocab chain continues the prompt.
+        assert out["tokens"] == [[9, 10, 11, 12, 13]], out
+
+        got = cli.wait_for_job("default", "servelm", timeout=120)
+        conds = {
+            c["type"] for c in got["status"]["conditions"]
+            if c["status"] == "True"
+        }
+        logs = job_logs(cli, "servelm")
+        assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"
+        assert "serve_lm: done (1 request(s) served)" in logs
+    finally:
+        try:
+            cli.delete("default", "servelm")
+        except Exception:
+            pass
