@@ -249,3 +249,95 @@ def test_moe_block_trains():
     assert np.isfinite(gnorm) and gnorm > 0
     # expert weights must receive gradient (the all-to-all path is live)
     assert float(jnp.abs(g["moe"]["w_in"]).sum()) > 0
+
+
+class TestTopKRouting:
+    def test_top2_with_two_experts_equals_dense_mixture(self):
+        """E=2, k=2, ample capacity: every token reaches both experts and
+        the normalized top-2 gates ARE the full softmax — the routed layer
+        must equal the dense softmax-weighted mixture of both expert MLPs
+        computed by hand. The strongest oracle the routing math has."""
+        rng = np.random.default_rng(7)
+        x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+        cfg = _moe_cfg(n_experts=2, router_top_k=2, capacity_factor=2.0)
+        model = MoeMlp(cfg)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        out, _ = model.apply({"params": params}, x, mutable=["losses"])
+
+        probs = jax.nn.softmax(
+            jnp.einsum("btd,de->bte", x, params["router"]), axis=-1
+        )
+        dense = jnp.zeros_like(x)
+        for e in range(2):
+            h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, params["w_in"][e]))
+            y_e = jnp.einsum("btf,fd->btd", h, params["w_out"][e])
+            dense = dense + probs[..., e : e + 1] * y_e
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dense), atol=1e-5, rtol=1e-4
+        )
+
+    def test_top2_trains_and_differs_from_top1(self):
+        rng = np.random.default_rng(8)
+        x = jnp.asarray(rng.normal(size=(2, 8, 16)), jnp.float32)
+        p1 = MoeMlp(_moe_cfg()).init(jax.random.PRNGKey(0), x)["params"]
+        out1, _ = MoeMlp(_moe_cfg()).apply(
+            {"params": p1}, x, mutable=["losses"]
+        )
+        out2, col = MoeMlp(_moe_cfg(router_top_k=2)).apply(
+            {"params": p1}, x, mutable=["losses"]
+        )
+        assert float(jnp.abs(out1 - out2).max()) > 1e-4  # k changes output
+        assert np.isfinite(float(aux_loss_from(col)))
+        # Gradients flow through both choices' dispatch paths.
+        g = jax.grad(
+            lambda p: MoeMlp(_moe_cfg(router_top_k=2)).apply(
+                {"params": p}, x, mutable=["losses"]
+            )[0].sum()
+        )(p1)
+        assert float(jnp.abs(g["router"]).max()) > 0
+        assert float(jnp.abs(g["w_in"]).max()) > 0
+
+    def test_top2_capacity_ordering_exact(self):
+        """Choice-priority capacity, exact oracle. Zero router -> uniform
+        probs; the deterministic top_k tie-break sends EVERY token's first
+        choice to expert 0 and second to expert 1. With capacity 4 and 8
+        tokens: expert 0 keeps tokens 0-3 (first choices, in order) and
+        drops 4-7; expert 1's queue starts empty (no first choices), keeps
+        second choices of tokens 0-3, drops 4-7. So tokens 0-3 get BOTH
+        experts at gate 0.5 each and tokens 4-7 get nothing."""
+        rng = np.random.default_rng(9)
+        x = jnp.asarray(rng.normal(size=(1, 8, 16)), jnp.float32)
+        cfg2 = _moe_cfg(n_experts=2, router_top_k=2, capacity_factor=0.5)
+        # capacity = ceil(0.5 * 2 * 8 / 2) = 4.
+        model = MoeMlp(cfg2)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        params = dict(params, router=jnp.zeros_like(params["router"]))
+        out2, _ = model.apply({"params": params}, x, mutable=["losses"])
+        dense = []
+        for e in range(2):
+            h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, params["w_in"][e]))
+            dense.append(jnp.einsum("btf,fd->btd", h, params["w_out"][e]))
+        expect = np.zeros_like(np.asarray(out2))
+        expect[0, :4] = 0.5 * np.asarray(dense[0] + dense[1])[0, :4]
+        np.testing.assert_allclose(
+            np.asarray(out2), expect, atol=1e-5, rtol=1e-4
+        )
+
+    def test_top_k_validated(self):
+        x = jnp.ones((1, 4, 16), jnp.float32)
+        with pytest.raises(ValueError, match="router_top_k"):
+            MoeMlp(_moe_cfg(router_top_k=9)).init(jax.random.PRNGKey(0), x)
+
+    def test_top2_sharded_matches_unsharded(self):
+        rng = np.random.default_rng(10)
+        x = jnp.asarray(rng.normal(size=(4, 8, 16)), jnp.float32)
+        plain = MoeMlp(_moe_cfg(router_top_k=2))
+        params = plain.init(jax.random.PRNGKey(0), x)["params"]
+        ref, _ = plain.apply({"params": params}, x, mutable=["losses"])
+        mesh = create_mesh({"dp": 2, "ep": 4})
+        sharded = MoeMlp(_moe_cfg(mesh=mesh, router_top_k=2))
+        sp = shard_params_by_rules(mesh, params, moe_param_sharding_rules())
+        out, _ = jax.jit(
+            lambda p, x: sharded.apply({"params": p}, x, mutable=["losses"])
+        )(sp, x)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)

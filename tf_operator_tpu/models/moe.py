@@ -9,11 +9,15 @@ and back — the TPU-native equivalent of a parameter-server fan-out, and a
 capability the reference has no analog of (SURVEY.md §2.9: no sharded
 execution of any kind).
 
-Top-1 (Switch) routing with capacity dropping: tokens beyond an expert's
-capacity pass through on the residual path (output 0 from the MoE layer).
-The load-balancing auxiliary loss (Switch Transformer form, n_experts *
-sum(fraction_tokens * fraction_probs)) is sown into the ``losses``
-collection; train steps read it via apply(..., mutable=["losses"]).
+Routing is top-k (``router_top_k``): k=1 is Switch (gate = raw top prob),
+k>=2 is GShard-style (gates normalized over the selected experts, with
+choice-priority capacity — every token's first choice queues before any
+token's second choice, so second choices drop first). Tokens beyond an
+expert's capacity pass through on the residual path (output 0 from the
+MoE layer for that choice). The load-balancing auxiliary loss (Switch
+Transformer form over first choices, n_experts * sum(fraction_tokens *
+fraction_probs)) is sown into the ``losses`` collection; train steps read
+it via apply(..., mutable=["losses"]).
 """
 
 from __future__ import annotations
@@ -33,6 +37,15 @@ class MoeConfig:
     d_model: int = 256
     d_ff: int = 512
     capacity_factor: float = 1.25
+    # Experts per token: 1 = Switch, 2 = GShard top-2 (see module doc).
+    router_top_k: int = 1
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k={self.router_top_k} must be in "
+                f"[1, n_experts={self.n_experts}]"
+            )
     # Tokens are routed within fixed-size groups so dispatch/combine memory
     # is linear in total tokens (group_size * capacity per group), not
     # quadratic; None = auto (<=512 tokens per group, aligned to the
@@ -45,7 +58,7 @@ class MoeConfig:
 
 
 class MoeMlp(nn.Module):
-    """Top-1 routed expert MLP. Input/output: [batch, seq, d_model]."""
+    """Top-k routed expert MLP. Input/output: [batch, seq, d_model]."""
 
     cfg: MoeConfig
 
@@ -55,8 +68,13 @@ class MoeMlp(nn.Module):
         b, t, d = x.shape
         group = _group_size(cfg, t)
         n_groups = b * t // group
+        # Capacity scales with k: top-2 dispatches ~2x the assignments.
         capacity = max(
-            1, int(math.ceil(cfg.capacity_factor * group / cfg.n_experts))
+            1,
+            int(math.ceil(
+                cfg.capacity_factor * cfg.router_top_k * group
+                / cfg.n_experts
+            )),
         )
 
         w_router = self.param(
@@ -75,28 +93,54 @@ class MoeMlp(nn.Module):
         # [G, S, D]: groups are contiguous token runs within one example
         # (group <= seq len), so the G dim is batch-major and stays aligned
         # with dp batch sharding — no resharding before dispatch.
+        k = cfg.router_top_k  # validated by MoeConfig.__post_init__
+
         tokens = x.reshape(n_groups, group, d)
         # Router in f32: tiny FLOPs, and softmax/argmax stability matters.
         logits = jnp.einsum(
             "gsd,de->gse", tokens.astype(jnp.float32), w_router
         )
         probs = jax.nn.softmax(logits, axis=-1)  # [G, S, E]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [G, S]
-        gate = jnp.max(probs, axis=-1)  # [G, S]
+        top_vals, top_idx = jax.lax.top_k(probs, k)  # [G, S, k]
+        if k == 1:
+            gates = top_vals  # Switch: gate = raw top prob
+        else:
+            # GShard: gates renormalized over the selected experts.
+            gates = top_vals / jnp.maximum(
+                top_vals.sum(-1, keepdims=True), 1e-9
+            )
 
-        one_hot = jax.nn.one_hot(expert_idx, cfg.n_experts, dtype=jnp.float32)
-        # Position of each token within its expert's per-group queue.
-        position = jnp.cumsum(one_hot, axis=1) * one_hot - one_hot  # [G,S,E]
-        keep = (position < capacity).astype(jnp.float32) * one_hot
-        pos_one_hot = jax.nn.one_hot(
-            jnp.sum(position * one_hot, axis=-1).astype(jnp.int32),
-            capacity, dtype=jnp.float32,
-        )  # [G, S, C]
-        dispatch = keep[..., None] * pos_one_hot[:, :, None, :]  # [G,S,E,C]
-        combine = dispatch * gate[..., None, None]
+        # Choice-priority capacity: queue positions for choice j start
+        # after ALL tokens' earlier-choice assignments to that expert, so
+        # when an expert overflows, second choices drop first.
+        dispatch = jnp.zeros(
+            (n_groups, group, cfg.n_experts, capacity), jnp.float32
+        )
+        combine = jnp.zeros_like(dispatch)
+        prior_count = jnp.zeros((n_groups, 1, cfg.n_experts), jnp.float32)
+        first_choice_oh = None
+        for j in range(k):
+            oh = jax.nn.one_hot(
+                top_idx[..., j], cfg.n_experts, dtype=jnp.float32
+            )  # [G, S, E]
+            if j == 0:
+                first_choice_oh = oh
+            position = (
+                jnp.cumsum(oh, axis=1) * oh - oh + prior_count * oh
+            )  # [G, S, E]
+            keep = (position < capacity).astype(jnp.float32) * oh
+            pos_one_hot = jax.nn.one_hot(
+                jnp.sum(position * oh, axis=-1).astype(jnp.int32),
+                capacity, dtype=jnp.float32,
+            )  # [G, S, C]
+            d_j = keep[..., None] * pos_one_hot[:, :, None, :]  # [G,S,E,C]
+            dispatch = dispatch + d_j
+            combine = combine + d_j * gates[..., j, None, None]
+            prior_count = prior_count + oh.sum(axis=1, keepdims=True)
 
-        # Load-balancing aux loss (computed before capacity dropping).
-        frac_tokens = jnp.mean(one_hot, axis=(0, 1))
+        # Load-balancing aux loss over FIRST choices (computed before
+        # capacity dropping; the Switch form, unchanged for k > 1).
+        frac_tokens = jnp.mean(first_choice_oh, axis=(0, 1))
         frac_probs = jnp.mean(probs, axis=(0, 1))
         aux = cfg.n_experts * jnp.sum(frac_tokens * frac_probs)
         self.sow("losses", "moe_aux", aux)

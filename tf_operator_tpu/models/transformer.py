@@ -79,6 +79,7 @@ class TransformerConfig:
     moe_every_n: int | None = None
     moe_experts: int = 8
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch, 2 = GShard top-2
     ep_axis: str = "ep"
 
     @property
@@ -271,7 +272,8 @@ class Block(nn.Module):
 
             mcfg = MoeConfig(
                 n_experts=cfg.moe_experts, d_model=cfg.d_model, d_ff=cfg.d_ff,
-                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                capacity_factor=cfg.moe_capacity_factor,
+                router_top_k=cfg.moe_top_k, dtype=cfg.dtype,
                 ep_axis=cfg.ep_axis, data_axis=cfg.batch_axis, mesh=cfg.mesh,
             )
             x = x + MoeMlp(mcfg, name="moe")(nn.RMSNorm(dtype=cfg.dtype)(x))
