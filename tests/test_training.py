@@ -904,3 +904,112 @@ def test_fuse_steps_scan_batches_consumes_each_slice():
 
     with pytest.raises(ValueError, match="leading dim"):
         fused(s_f, jax.tree.map(jnp.asarray, batches[0]))
+
+
+class TestAdafactor:
+    def test_adafactor_state_is_factored_and_trains(self):
+        """Adafactor's second-moment state for a [d_in, d_out] kernel is
+        O(d_in + d_out), not O(d_in * d_out) — the reason it exists — and
+        the LM still learns under it."""
+        from tf_operator_tpu.train.steps import adafactor
+
+        mesh = create_mesh({"dp": 1}, jax.devices()[:1])
+        # Dims >= 128: optax.adafactor only factors axes at least
+        # min_dim_size_to_factor (128) long — real LM shapes qualify.
+        cfg = TransformerConfig(
+            vocab_size=256, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+            max_seq_len=32, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        rng = np.random.default_rng(11)
+        start = rng.integers(0, 256, (8, 1))
+        chain = (start + np.arange(17)) % 256
+        batch = {
+            "tokens": jnp.asarray(chain[:, :-1], jnp.int32),
+            "targets": jnp.asarray(chain[:, 1:], jnp.int32),
+        }
+        params = model.init(jax.random.PRNGKey(0), batch["tokens"])["params"]
+        tx = adafactor(2e-2)
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        opt_state = tx.init(params)
+        n_opt = sum(
+            x.size for x in jax.tree.leaves(opt_state)
+            if hasattr(x, "size")
+        )
+        # AdamW would carry 2x n_params; factored moments are far smaller.
+        assert n_opt < n_params, (n_opt, n_params)
+
+        state = TrainState.create(params, tx)
+        step = make_lm_train_step(model, tx, mesh, seq_axis=None,
+                                  donate=False)
+        losses = []
+        for _ in range(120):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+class TestNucleusSampling:
+    def test_tiny_top_p_equals_greedy(self):
+        """top_p small enough that the nucleus is exactly the argmax token
+        must reproduce greedy decoding deterministically."""
+        from tf_operator_tpu.models.transformer import generate
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(12).integers(0, 32, (2, 6)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        greedy = generate(cfg, params, prompt, num_steps=6)
+        nucleus = generate(
+            cfg, params, prompt, num_steps=6, temperature=1.0,
+            top_p=1e-9, rng=jax.random.PRNGKey(3),
+        )
+        np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+    def test_top_p_one_samples_full_distribution(self):
+        from tf_operator_tpu.models.transformer import generate
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        out = generate(
+            cfg, params, prompt, num_steps=8, temperature=1.0, top_p=1.0,
+            rng=jax.random.PRNGKey(4),
+        )
+        assert out.shape == (1, 8)
+        assert int(out.min()) >= 0 and int(out.max()) < 32
+
+    def test_top_p_validated(self):
+        from tf_operator_tpu.models.transformer import generate
+
+        cfg = TransformerConfig(
+            vocab_size=32, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=32, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        with pytest.raises(ValueError, match="top_p"):
+            generate(cfg, params, prompt, num_steps=2, temperature=1.0,
+                     top_p=1.5, rng=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="top_p"):
+            generate(cfg, params, prompt, num_steps=2, top_p=0.9)
+
+    def test_nucleus_filter_masks_tail(self):
+        from tf_operator_tpu.models.transformer import _nucleus_filter
+
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        # top_p=0.7: nucleus = {0.5, 0.3} (0.5 < 0.7, crossing token 0.3
+        # included); tail masked.
+        out = np.asarray(_nucleus_filter(logits, 0.7))
+        assert out[0, 0] > -1e29 and out[0, 1] > -1e29
+        assert out[0, 2] <= -1e29 and out[0, 3] <= -1e29

@@ -322,6 +322,26 @@ class Transformer(nn.Module):
         return head(x.astype(jnp.float32))
 
 
+def _nucleus_filter(logits: jax.Array, top_p: float) -> jax.Array:
+    """Mask logits outside the nucleus: keep the smallest set of tokens
+    whose probability mass reaches ``top_p`` (always including the top
+    token), set the rest to -inf so categorical renormalizes over the
+    nucleus. The mask is by sorted RANK, not probability value, so exact
+    ties at the cutoff cannot leak tail tokens into the nucleus. Static
+    shapes (sort + cumsum + inverse permutation), jit/scan-friendly."""
+    sort_idx = jnp.flip(jnp.argsort(logits, axis=-1), axis=-1)
+    sorted_probs = jax.nn.softmax(
+        jnp.take_along_axis(logits, sort_idx, axis=-1), axis=-1
+    )
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep a token iff the mass BEFORE it is still short of top_p: the
+    # crossing token stays, everything after drops.
+    keep_sorted = (cum - sorted_probs) < top_p
+    inv = jnp.argsort(sort_idx, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, -1e30)
+
+
 def generate(
     cfg: TransformerConfig,
     params: Any,
@@ -329,6 +349,7 @@ def generate(
     num_steps: int,
     *,
     temperature: float = 0.0,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
 ) -> jax.Array:
     """Jitted autoregressive generation with a KV cache.
@@ -337,8 +358,10 @@ def generate(
     ``num_steps`` of sample-and-feed via lax.scan — runs inside one jit:
     static shapes, one compilation, no host round-trips per token (the
     TPU-native decode shape; a Python token loop would be
-    dispatch-bound). ``temperature=0`` is greedy;
-    otherwise categorical sampling with ``rng``. Returns [B, num_steps]
+    dispatch-bound). ``temperature=0`` is greedy; otherwise categorical
+    sampling with ``rng``, optionally nucleus-filtered: ``top_p`` keeps
+    the smallest set of tokens whose (tempered) probability mass reaches
+    top_p and renormalizes over it. Returns [B, num_steps]
     generated tokens. The ring/remat training config is dropped for
     decoding; TENSOR-PARALLEL decode works by passing tp-sharded params
     (GSPMD propagates the shardings — see _generate_fn).
@@ -354,15 +377,21 @@ def generate(
         )
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    if top_p is not None and temperature <= 0:
+        raise ValueError("top_p requires temperature > 0 (greedy ignores it)")
     rng = jax.random.PRNGKey(0) if rng is None else rng
-    fn = _generate_fn(cfg, num_steps, float(temperature))
+    fn = _generate_fn(cfg, num_steps, float(temperature),
+                      None if top_p is None else float(top_p))
     return fn(params, prompt, rng)
 
 
 @functools.lru_cache(maxsize=32)
-def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float):
+def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float,
+                 top_p: float | None = None):
     """Build (and cache) the jitted decode loop for one (config, steps,
-    temperature) triple. params/prompt/rng are jit ARGUMENTS, so repeated
+    temperature, top_p) tuple. params/prompt/rng are jit ARGUMENTS, so repeated
     generate() calls — including with updated params — reuse the same
     executable instead of re-tracing a fresh closure each time.
 
@@ -406,7 +435,10 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float):
         def sample(carry, step_rng):
             cache, logits = carry
             if temperature > 0:
-                tok = jax.random.categorical(step_rng, logits / temperature)
+                scaled = logits / temperature
+                if top_p is not None:
+                    scaled = _nucleus_filter(scaled, top_p)
+                tok = jax.random.categorical(step_rng, scaled)
             else:
                 tok = logits.argmax(-1)
             cache, logits = token_step(params, cache, tok.astype(prompt.dtype))

@@ -726,6 +726,16 @@ def adamw(lr: Any = 3e-4, weight_decay: float = 0.01):
     return optax.adamw(lr, weight_decay=weight_decay)
 
 
+def adafactor(lr: Any = 1e-3):
+    """Adafactor (factored second moments): optimizer state for a [d_in,
+    d_out] kernel is O(d_in + d_out) instead of AdamW's 2x O(d_in *
+    d_out) — the memory-efficient choice for large LMs, where AdamW
+    moments alone can exceed the params. Composes with FSDP sharding
+    (the factored vectors shard like their params' leading dims); ``lr``
+    may be a float or an optax schedule."""
+    return optax.adafactor(lr)
+
+
 def warmup_cosine(
     peak_lr: float,
     total_steps: int,
