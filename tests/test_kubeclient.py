@@ -1076,3 +1076,69 @@ def test_real_apiserver_smoke():
             client.stop_watch(w)
     finally:
         client.delete(objects.PODS, "default", name)
+
+
+class TestRestClientMetrics:
+    def test_request_latency_and_watch_restarts_observed(self):
+        """client-go restclient-metrics parity: API calls land in the
+        request-latency histogram (by method/code) and a severed watch
+        stream bumps the restart counter with its cause."""
+        from tf_operator_tpu.runtime.client import NotFound
+        from tf_operator_tpu.runtime.kubeclient import (
+            REQUEST_SECONDS,
+            WATCH_RESTARTS,
+        )
+
+        def post_ok_count() -> int:
+            # Success codes are EXACT (200/201, matching client-go's
+            # restclient metrics); accept either for create.
+            return sum(REQUEST_SECONDS.snapshot(method="POST", code="200")) \
+                + sum(REQUEST_SECONDS.snapshot(method="POST", code="201"))
+
+        stub = KubeApiStub()
+        stub.start()
+        try:
+            client = KubeClusterClient(
+                KubeConfig(server=stub.url), watch_timeout_seconds=30.0
+            )
+            before = post_ok_count()
+            client.create(objects.PODS, pod("metric-pod"))
+            client.get(objects.PODS, "default", "metric-pod")
+            assert post_ok_count() > before, "POST not observed"
+            # A failing request records its exact code.
+            nf_before = REQUEST_SECONDS.snapshot(method="GET", code="404")
+            with pytest.raises(NotFound):
+                client.get(objects.PODS, "default", "no-such")
+            assert sum(
+                REQUEST_SECONDS.snapshot(method="GET", code="404")
+            ) > sum(nf_before)
+
+            # Severed stream -> eof restart counted for this kind.
+            eof_before = WATCH_RESTARTS.value(
+                kind=objects.PODS, reason="eof"
+            )
+            w = client.watch(objects.PODS, "default")
+            # Keep creating until an event arrives: the watch thread's
+            # initial LIST races the first create (same pattern as the
+            # bookmark test above).
+            e = None
+            deadline = time.monotonic() + 10.0
+            i = 0
+            while time.monotonic() < deadline and e is None:
+                client.create(objects.PODS, pod(f"metric-pod-{i}"))
+                i += 1
+                e = w.next(timeout=0.5)
+            assert e is not None, "watch never delivered"
+            assert stub.kill_watches() >= 1
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and (
+                WATCH_RESTARTS.value(kind=objects.PODS, reason="eof")
+                <= eof_before
+            ):
+                time.sleep(0.1)
+            assert WATCH_RESTARTS.value(
+                kind=objects.PODS, reason="eof"
+            ) > eof_before
+            client.stop_watch(w)
+        finally:
+            stub.stop()

@@ -55,10 +55,31 @@ from tf_operator_tpu.runtime.client import (
     Watch,
     WatchEvent,
 )
+from tf_operator_tpu.runtime.metrics import REGISTRY
 from tf_operator_tpu.utils import logger
 from tf_operator_tpu.utils.times import parse_rfc3339
 
 LOG = logger.with_fields(component="kubeclient")
+
+# Rest-client observability (the client-go restclient metrics the
+# reference gets for free): request latency by method and exact HTTP
+# status code (code="error" for transport failures that never got a
+# status — connect refused, timeouts, bad JSON), and watch stream
+# restarts by reason — a reconnect storm or 410 churn is an operations
+# signal, not just a log line.
+REQUEST_SECONDS = REGISTRY.histogram(
+    "tpu_operator_kube_request_seconds",
+    "Kubernetes API request latency by method and status code "
+    "(code=error: transport failure with no HTTP status)",
+    labelnames=("method", "code"),
+)
+WATCH_RESTARTS = REGISTRY.counter(
+    "tpu_operator_kube_watch_restarts_total",
+    "Watch stream restarts by cause (expired=server budget elapsed "
+    "cleanly, gone=410 relist, auth=401 re-mint, error=server watch "
+    "error/HTTP failure, eof=stream died mid-read)",
+    labelnames=("kind", "reason"),
+)
 
 # Service-account mount used for in-cluster config (what client-go's
 # rest.InClusterConfig reads).
@@ -531,10 +552,19 @@ class KubeClusterClient(ClusterClient):
                 method=method,
                 headers=self._headers(content_type if data is not None else None),
             )
+            t0 = time.monotonic()
             try:
                 with self._open(req, self._timeout) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    code = str(resp.status)
+                    out = json.loads(resp.read() or b"{}")
+                REQUEST_SECONDS.observe(
+                    time.monotonic() - t0, method=method, code=code
+                )
+                return out
             except urlerror.HTTPError as e:
+                REQUEST_SECONDS.observe(
+                    time.monotonic() - t0, method=method, code=str(e.code)
+                )
                 if (
                     e.code == 401
                     and not retried_auth
@@ -548,6 +578,15 @@ class KubeClusterClient(ClusterClient):
                     continue
                 _raise_status(e)
                 raise  # unreachable
+            except Exception:
+                # Transport failures without an HTTP status (connect
+                # refused, socket timeout, corrupt JSON): the slowest and
+                # most alert-worthy requests — they must land in the
+                # histogram, not vanish from it.
+                REQUEST_SECONDS.observe(
+                    time.monotonic() - t0, method=method, code="error"
+                )
+                raise
 
     def _collection(self, kind: str, namespace: str | None) -> str:
         r = _resource_for(kind)
@@ -740,6 +779,7 @@ class KubeClusterClient(ClusterClient):
                         continue
                     if etype == "ERROR":
                         if obj.get("code") == 410:  # Gone: RV too old, relist
+                            WATCH_RESTARTS.inc(kind=kind, reason="gone")
                             rv = None
                             break
                         raise ApiError(obj.get("message", "watch error"))
@@ -747,8 +787,15 @@ class KubeClusterClient(ClusterClient):
                     if new_rv:
                         rv = str(new_rv)
                     watch.push(WatchEvent(etype, obj))
+                else:
+                    # The server ended the stream cleanly (timeoutSeconds
+                    # budget): the healthy reconnect cadence, counted so
+                    # operators can tell it apart from a wedged watch.
+                    if not stopped.is_set():
+                        WATCH_RESTARTS.inc(kind=kind, reason="expired")
             except urlerror.HTTPError as e:
                 if e.code == 410:
+                    WATCH_RESTARTS.inc(kind=kind, reason="gone")
                     rv = None
                 elif e.code == 401 and self._cfg.exec_config is not None:
                     # Revoked/rotated plugin token: without this the watch
@@ -756,14 +803,22 @@ class KubeClusterClient(ClusterClient):
                     # _call re-mints (the informer silently serving stale
                     # state the whole time).
                     LOG.info("watch %s got 401; re-minting exec credential", kind)
+                    WATCH_RESTARTS.inc(kind=kind, reason="auth")
                     self._cfg.invalidate_exec_token()
                     stopped.wait(0.2)
                 elif not stopped.is_set():
                     LOG.warning("watch %s failed: %s; reconnecting", kind, e)
+                    WATCH_RESTARTS.inc(kind=kind, reason="error")
                     stopped.wait(1.0)
             except Exception as e:
                 if not stopped.is_set():
                     LOG.debug("watch %s stream ended (%s); reconnecting", kind, e)
+                    # Server-sent watch ERRORs (ApiError, raised above) are
+                    # genuine errors; everything else here is a died stream.
+                    WATCH_RESTARTS.inc(
+                        kind=kind,
+                        reason="error" if isinstance(e, ApiError) else "eof",
+                    )
                     stopped.wait(1.0)
         watch.stop()
 
