@@ -48,6 +48,11 @@ def main(argv: list[str] | None = None) -> int:
                         "seq / 2)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks (long-context memory)")
+    p.add_argument("--ring-impl", default="auto",
+                   choices=("auto", "stream", "flash"),
+                   help="ring attention implementation: stream (autodiff, "
+                        "supports kv chunking) or flash (custom-VJP "
+                        "second-ring backward, Pallas blocks on TPU)")
     p.add_argument("--data", default=None,
                    help="token-record file (write_token_records layout): "
                         "each process streams its disjoint shard of every "
@@ -61,6 +66,10 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.fail_at_step is not None and not args.checkpoint_dir:
         p.error("--fail-at-step requires --checkpoint-dir")
+    if args.ring_impl != "auto" and args.sp <= 1:
+        # Ring attention only engages when the sequence is sharded; a
+        # forced impl with sp=1 would silently train on plain attention.
+        p.error("--ring-impl requires --sp > 1 (ring attention is off)")
 
     from tf_operator_tpu.train import distributed
 
@@ -109,7 +118,7 @@ def main(argv: list[str] | None = None) -> int:
         vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
         n_layers=args.layers, d_ff=args.d_model * 2,
         max_seq_len=args.seq, dtype=jnp.float32, mesh=mesh,
-        remat=args.remat,
+        remat=args.remat, ring_impl=args.ring_impl,
     )
     model = Transformer(cfg)
     tokens0 = jnp.zeros((args.batch, args.seq), jnp.int32)

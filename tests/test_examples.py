@@ -214,6 +214,10 @@ def test_dist_lm_two_process_ring_attention(operator):
             extra_args=[
                 "--steps", "60", "--batch", "4", "--seq", "64",
                 "--sp", "2", "--target-loss", "1.0",
+                # The custom-VJP ring (second-ring backward): its
+                # cross-process ppermute gradients only get exercised here;
+                # the stream impl's are covered by the parallel unit suite.
+                "--ring-impl", "flash",
             ],
             # One device per process: the sp=2 axis then spans the two
             # processes, making the ring collectives genuinely cross-process
