@@ -353,6 +353,7 @@ def make_lm_train_step(
     xent_chunk: int | None = None,
     xent_dot_dtype: Any = None,
     aux_loss_weight: float = 0.0,
+    grad_accum: int = 1,
 ):
     """Train step for the transformer: batch over dp, sequence over sp (ring
     attention inside the model). Params are placed by the caller
@@ -370,7 +371,15 @@ def make_lm_train_step(
 
     ``aux_loss_weight`` > 0 collects sown auxiliary losses (the MoE
     load-balancing loss) via mutable=["losses"] and adds them weighted;
-    metrics then carry "aux_loss"."""
+    metrics then carry "aux_loss".
+
+    ``grad_accum`` > 1 splits the batch's leading dim into that many
+    microbatches and averages their gradients inside ONE jitted step (a
+    lax.scan; one optimizer update) — the peak-activation memory of a
+    microbatch buys the global batch the optimizer sees. Exact for the
+    per-token-mean LM loss when microbatches are equal-sized (the batch
+    dim must divide by grad_accum); the reported loss is the mean over
+    microbatches."""
 
     # seq_axis=None means the caller opted out of sequence sharding: only
     # a tp-split head then forces the sharded (vocab-parallel) loss, and
@@ -415,10 +424,51 @@ def make_lm_train_step(
             xent = cross_entropy(logits, batch["targets"])
         return xent + aux_loss_weight * aux, aux
 
-    def step(state: TrainState, batch):
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum={grad_accum} must be >= 1")
+    # Computed here (also used below for the batch shardings) so the
+    # microbatch split can validate against the PER-SHARD batch: a
+    # microbatch that cannot tile the data axis would silently reshard at
+    # partial utilization, defeating the feature's memory/throughput trade.
+    row_sharding, data_size = _data_axis_sharding(mesh, data_axis)
+
+    def grads_of(params, batch):
+        if grad_accum == 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+
+        from tf_operator_tpu.parallel.pipeline import microbatch
+
+        def accum_step(carry, micro):
+            loss_sum, aux_sum, grad_sum = carry
+            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                params, micro
+            )
+            return (
+                loss_sum + loss,
+                aux_sum + aux,
+                jax.tree.map(jnp.add, grad_sum, g),
+            ), None
+
+        b = batch["tokens"].shape[0]
+        if b % grad_accum or (b // grad_accum) % data_size:
+            raise ValueError(
+                f"batch dim {b} not divisible into grad_accum="
+                f"{grad_accum} microbatches that tile the data axis "
+                f"(size {data_size})"
+            )
+        micros = jax.tree.map(lambda x: microbatch(x, grad_accum), batch)
+        zero_grads = jax.tree.map(jnp.zeros_like, params)
+        (loss_sum, aux_sum, grad_sum), _ = jax.lax.scan(
+            accum_step, (jnp.zeros(()), jnp.zeros(()), zero_grads), micros
         )
+        inv = 1.0 / grad_accum
+        return (
+            (loss_sum * inv, aux_sum * inv),
+            jax.tree.map(lambda g: g * inv, grad_sum),
+        )
+
+    def step(state: TrainState, batch):
+        (loss, aux), grads = grads_of(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         if param_shardings is not None:
@@ -434,9 +484,9 @@ def make_lm_train_step(
         )
 
     seq = seq_axis if (seq_axis and mesh.shape.get(seq_axis, 1) > 1) else None
-    # Axes absent from the mesh are treated as unsharded (same contract as
-    # sharded_lm_xent) — _data_axis_sharding owns the filtering.
-    row_sharding, data_size = _data_axis_sharding(mesh, data_axis)
+    # row_sharding/data_size computed above (shared with the microbatch
+    # validation); axes absent from the mesh are treated as unsharded
+    # (same contract as sharded_lm_xent) — _data_axis_sharding filters.
     batch_axes = row_sharding.spec[0] if data_size > 1 else None
     tok_spec = P(batch_axes, seq)
     batch_sharding = {
