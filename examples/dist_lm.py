@@ -53,6 +53,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="ring attention implementation: stream (autodiff, "
                         "supports kv chunking) or flash (custom-VJP "
                         "second-ring backward, Pallas blocks on TPU)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="microbatches per optimizer step (gradients "
+                        "averaged inside one jitted step; the global "
+                        "batch must divide by this AND the microbatch "
+                        "must still tile the dp axis)")
     p.add_argument("--data", default=None,
                    help="token-record file (write_token_records layout): "
                         "each process streams its disjoint shard of every "
@@ -104,6 +109,13 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(
             "batch must be a multiple of dp and seq a multiple of sp"
         )
+    if args.grad_accum < 1 or args.batch % args.grad_accum or (
+        (args.batch // args.grad_accum) % max(axes["dp"], 1)
+    ):
+        raise SystemExit(
+            "--grad-accum must divide the batch, with each microbatch "
+            "still a multiple of dp"
+        )
     local_seq = args.seq // axes["sp"]
     if args.xent_chunk is not None:
         if args.xent_chunk <= 0 or local_seq % args.xent_chunk:
@@ -127,7 +139,8 @@ def main(argv: list[str] | None = None) -> int:
     tx = adamw(args.lr)
     state = TrainState.create(params, tx)
     step = make_lm_train_step(
-        model, tx, mesh, donate=False, xent_chunk=chunk
+        model, tx, mesh, donate=False, xent_chunk=chunk,
+        grad_accum=args.grad_accum,
     )
 
     ckpt = None
