@@ -26,8 +26,12 @@ from tf_operator_tpu.controller.tpujob_controller import TPUJobController
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.memcluster import InMemoryCluster
 
-NUM_JOBS = 20
-CHAOS_SECONDS = 120.0
+import os
+
+NUM_JOBS = int(os.environ.get("CHAOS_JOBS", "20"))
+# CHAOS_SECONDS env: longer soaks for stability runs (e.g. 600 for a
+# 10-minute window); default matches the CI slow tier's budget.
+CHAOS_SECONDS = float(os.environ.get("CHAOS_SECONDS", "120"))
 # Inject only into pods that have been Running at least this long, so the
 # controller's informer has observed the Running phase before the kill —
 # otherwise the restart happens but the counter can read low (the timing
