@@ -9,12 +9,17 @@ models/transformer.py generate), and answers greedy completions over a
 stdlib HTTP server:
 
     GET  /healthz             -> 200 once params are ready
-    POST /generate            {"tokens": [[...]], "num_steps": N}
+    POST /generate            {"tokens": [[...]], "num_steps": N,
+                               "temperature": T?, "top_p": P?, "seed": S?}
                               -> {"tokens": [[...]]} (generated only)
 
-Generation runs the jitted KV-cache decode loop (batched single-pass
-prompt prefill + one-token sampling scan — one compile per
-(batch, prompt_len, num_steps) shape). ``--requests`` bounds the serve
+temperature=0/omitted is greedy; temperature>0 samples (nucleus-filtered
+when top_p is set — top_p without temperature is a 400, mirroring
+generate()'s own validation). Generation runs the jitted KV-cache decode
+loop (batched single-pass prompt prefill + one-token sampling scan — one
+compile per (batch, prompt_len, num_steps, temperature, top_p)
+combination, so clients sweeping many distinct temperatures pay a
+recompile each). ``--requests`` bounds the serve
 loop so the process terminates like a job (the operator's Succeeded
 condition); without it the server runs until SIGTERM.
 
@@ -176,10 +181,25 @@ def main(argv: list[str] | None = None) -> int:
                 )
                 prompt = jnp.asarray(req["tokens"], jnp.int32)
                 num_steps = int(req.get("num_steps", 8))
+                temperature = float(req.get("temperature", 0.0))
+                top_p = req.get("top_p")
                 if prompt.ndim != 2:
                     raise ValueError("tokens must be [batch, len]")
+                kw = {}
+                if temperature > 0:
+                    kw = dict(
+                        temperature=temperature,
+                        rng=jax.random.PRNGKey(int(req.get("seed", 0))),
+                    )
+                if top_p is not None:
+                    # Forwarded unconditionally: top_p without temperature
+                    # is rejected by generate() itself (a client-visible
+                    # 400), never silently dropped.
+                    kw["top_p"] = float(top_p)
                 with lock:
-                    out = generate(cfg, params, prompt, num_steps=num_steps)
+                    out = generate(
+                        cfg, params, prompt, num_steps=num_steps, **kw
+                    )
                 self._json(200, {"tokens": out.tolist()})
             except Exception as exc:  # noqa: BLE001 — client-visible error
                 self._json(400, {"error": repr(exc)})
