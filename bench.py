@@ -168,6 +168,32 @@ def smoke_attn_config() -> tuple[int, int]:
     return (256, 1) if os.environ.get("BENCH_SMOKE") else (8192, 4)
 
 
+def attn_fwd_bwd_times(batch: int, seq: int, *, reps: int = 3,
+                       warmup: int = 2) -> list[float]:
+    """Per-rep wall times of the causal attention fwd+bwd at the bench
+    geometry (via ops.attention dispatch — whatever kernel that picks).
+    THE single measurement block for every attention timing tool
+    (bench_flash_attention, perf_probe flashramp/flashsweep), so
+    timing/readback changes cannot drift between them."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops import attention
+
+    q, k, v = attn_inputs(batch, seq)
+
+    def loss(q, k, v):
+        return attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+
+    def call():
+        out = grad_fn(q, k, v)
+        float(out[0])  # readback = completion
+
+    return timed_reps(call, reps=reps, warmup=warmup)
+
+
 def flash_model_flops(batch: int, seq: int) -> float:
     """Causal fwd+bwd model FLOPs: fwd = 4*B*H*S^2*D / 2 (causal), bwd
     counted as 2x fwd (the recompute inside the streaming kernel is extra
@@ -179,26 +205,12 @@ def flash_model_flops(batch: int, seq: int) -> float:
 
 def bench_flash_attention(peak_tflops: float | None) -> None:
     """Causal flash attention fwd+bwd at 8k and 64k context, bf16 (FLOP
-    accounting: flash_model_flops)."""
-    import jax
-    import jax.numpy as jnp
-
-    from tf_operator_tpu.ops import attention, attention_kernel
+    accounting: flash_model_flops; timing: attn_fwd_bwd_times)."""
+    from tf_operator_tpu.ops import attention_kernel
 
     for seq, batch in ATTN_CONFIGS:
         kernel = attention_kernel(seq, seq, ATTN_HEAD_DIM, 2, causal=True)
-        q, k, v = attn_inputs(batch, seq)
-
-        def loss(q, k, v):
-            return attention(q, k, v, causal=True).astype(jnp.float32).sum()
-
-        grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-
-        def call():
-            out = grad_fn(q, k, v)
-            float(out[0])  # readback = completion
-
-        times = timed_reps(call, reps=3, warmup=2)
+        times = attn_fwd_bwd_times(batch, seq)
         dt = min(times)  # steady-state; mean exposes the warm-up ramp
 
         tflops = flash_model_flops(batch, seq) / dt / 1e12

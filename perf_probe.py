@@ -252,24 +252,11 @@ def probe_flashramp() -> None:
     faster with 16x the work). If later reps are fast, the earlier number
     was the intra-process throughput ramp; if uniformly slow, the 8k
     shape genuinely mis-tiles and the kernel needs work."""
-    import jax
-    import jax.numpy as jnp
-
-    from tf_operator_tpu.ops import attention, attention_kernel
+    from tf_operator_tpu.ops import attention_kernel
 
     seq, batch = bench.smoke_attn_config()
-    q, k, v = bench.attn_inputs(batch, seq)
-
-    def loss(q, k, v):
-        return attention(q, k, v, causal=True).astype(jnp.float32).sum()
-
-    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-    rep_s = []
-    for _ in range(8):
-        t0 = time.perf_counter()
-        out = grad_fn(q, k, v)
-        float(out[0])
-        rep_s.append(time.perf_counter() - t0)
+    # warmup=0: the RAMP is the signal here — every rep timed from cold.
+    rep_s = bench.attn_fwd_bwd_times(batch, seq, reps=8, warmup=0)
     emit(
         "flashramp", seq=seq, batch=batch,
         rep_seconds=[round(s, 4) for s in rep_s],
@@ -313,6 +300,24 @@ def probe_flashblocks() -> None:
     emit("flashblocks", seq=seq, batch=batch, **results)
 
 
+def probe_flashsweep() -> None:
+    """Best-rep attention TFLOP/s over a (seq, batch) grid: round 3's
+    hardware sample showed 8k/b4 running 10x slower than 64k/b1 with 16x
+    less work — this sweep separates a batch-dimension pathology from a
+    sequence-length one (and from the warm-up ramp, since every cell gets
+    multi-warmup best-rep timing)."""
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    grid = (((256, 1), (256, 2)) if smoke
+            else ((8192, 1), (8192, 4), (16384, 1), (16384, 2), (32768, 1)))
+    results = {}
+    for seq, batch in grid:
+        dt = min(bench.attn_fwd_bwd_times(batch, seq))
+        results[f"s{seq}_b{batch}_tflops"] = (
+            bench.flash_model_flops(batch, seq) / dt / 1e12
+        )
+    emit("flashsweep", **results)
+
+
 def run_window() -> None:
     """Hardware-window triage: run the probes that answer round 3's open
     questions, highest-value first, each in its own subprocess with a
@@ -322,12 +327,13 @@ def run_window() -> None:
     Order: roofline (is the chip in a fast or slow state right now?) →
     synthetic ResNet (device-resident compute rate — splits bench.py's
     59.9 img/s between compute and input/transfer) → flashramp (8k
-    pathology: ramp or real) → flashblocks (Q-block A/B) → stem (conv7 vs
-    s2d decision) → h2d, then TWO bench LM legs (flash vs forced-xla
-    attention, up to ~1100 s each) answering whether the flash kernel
-    helps or hurts the LM step. Budget for all of it: ~5500 s on a
-    healthy chip; the default 3000 s covers the probes and at least one
-    LM leg.
+    pathology: ramp or real) → flashblocks (Q-block A/B) → flashsweep
+    (batch-vs-seq pathology grid) → stem (conv7 vs s2d decision) → h2d,
+    then TWO bench LM legs (flash vs forced-xla attention, up to ~1100 s
+    each) answering whether the flash kernel helps or hurts the LM step.
+    Probe budget caps sum to ~4400 s; budget ~6600 s to guarantee both LM
+    legs on a degraded chip (on a healthy one everything fits well inside
+    the 3000 s default — each probe finishes far under its cap).
     """
     import subprocess
 
@@ -339,6 +345,7 @@ def run_window() -> None:
         ("synthetic", 900.0),
         ("flashramp", 600.0),
         ("flashblocks", 600.0),
+        ("flashsweep", 900.0),
         ("stem", 900.0),
         ("h2d", 180.0),
     ]
@@ -429,6 +436,7 @@ PROBES = {
     "roofline": probe_roofline,
     "flashramp": probe_flashramp,
     "flashblocks": probe_flashblocks,
+    "flashsweep": probe_flashsweep,
     "h2d": probe_h2d,
     "input": probe_input,
     "fwd_split": probe_fwd_split,
