@@ -742,6 +742,64 @@ def _run_jax_section(name: str) -> None:
     fn(peak_of(jax.devices()[0]))
 
 
+def _preflight_budget(default_s: float) -> float:
+    raw = os.environ.get("BENCH_PREFLIGHT_S", "")
+    if not raw:
+        return default_s
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"bench: ignoring malformed BENCH_PREFLIGHT_S={raw!r}",
+              file=sys.stderr, flush=True)
+        return default_s
+
+
+def _backend_preflight_start(default_s: float = 180.0):
+    """Launch the backend-reachability probe child (or None when moot).
+
+    A dead tunnel hangs jax.devices() inside native code INDEFINITELY
+    (observed for hours in rounds 2-3); without this gate, every section
+    child would burn its full budget on the same hang — ~50 min of wall
+    clock for a bench that was never going to produce a hardware line.
+    Started BEFORE the CPU-side submit-latency section so the probe's
+    backend init overlaps it; BENCH_PREFLIGHT_S=0 disables. Smoke runs
+    force the CPU backend in-process (the bare-import child would touch
+    the real plugin), and a run whose BENCH_ONLY selects no hardware
+    section has nothing to protect."""
+    import subprocess
+
+    if (
+        _preflight_budget(default_s) <= 0
+        or os.environ.get("BENCH_SMOKE")
+        or not any(_section_selected(n) for n in _SECTIONS)
+    ):
+        return None
+    return subprocess.Popen(
+        [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _backend_preflight_join(proc, default_s: float = 180.0) -> bool:
+    import subprocess
+
+    if proc is None:
+        return True
+    budget = _preflight_budget(default_s)
+    try:
+        ok = proc.wait(timeout=budget) == 0
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        ok = False
+    if not ok:
+        print(
+            f"bench: backend preflight failed within {budget:.0f}s "
+            "(TPU tunnel down?) — skipping hardware sections",
+            file=sys.stderr, flush=True,
+        )
+    return ok
+
+
 def _run_sections_isolated(deadline: float) -> None:
     """Spawn each hardware section as its own subprocess with a timeout.
 
@@ -821,12 +879,18 @@ def main() -> None:
     # at all): run it BEFORE backend init, so even a round whose TPU tunnel
     # is down (jax.devices() hanging until the watchdog fires — rounds 2
     # and 3 both hit multi-hour outages) still lands one measured metric.
+    preflight = _backend_preflight_start()  # overlaps the CPU section
     if _section_selected("submit"):
         try:
             bench_submit_latency()
         except Exception as exc:  # noqa: BLE001
             print(f"bench: bench_submit_latency failed: {exc!r}",
                   file=sys.stderr, flush=True)
+    # Join the preflight BEFORE any branch that would touch the backend
+    # in-process (profile mode would hang exactly like a section child);
+    # smoke runs have preflight=None and pass trivially.
+    if not _backend_preflight_join(preflight):
+        sys.exit(3)  # CPU-side metrics already emitted above
     if os.environ.get("BENCH_SMOKE") and not os.environ.get(
         "BENCH_SMOKE_ISOLATED"
     ):
