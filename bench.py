@@ -45,6 +45,17 @@ import time
 
 import numpy as np
 
+# Persistent XLA compilation cache, set via env BEFORE any jax import so
+# every child process (section subprocesses, perf_probe children, the
+# driver's own bench run) inherits it. Three rounds of hardware data show
+# the tunnel window can be ~35 min while a full bench spends many minutes
+# compiling; with the cache, a later run inside the same container (e.g.
+# the driver's round-end bench after an in-window builder run) skips every
+# compile. setdefault: an explicit JAX_COMPILATION_CACHE_DIR wins.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/xla_cache_tpu_operator")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 # TF2-era MultiWorkerMirroredStrategy ResNet-50 throughput per v5e-class
 # chip (~800 img/s/chip is the competitive public-era figure for bf16
 # ResNet-50 training on this hardware class).
@@ -225,8 +236,18 @@ def bench_flash_attention(peak_tflops: float | None) -> None:
         )
 
 
-def bench_transformer_lm(peak_tflops: float | None) -> None:
-    """Decoder-only LM train step, bf16, 8k context, flash attention."""
+def lm_train_measure(
+    *, d_model: int, n_layers: int, d_ff: int, batch: int, seq: int,
+    vocab_size: int, n_heads: int | None = None, remat: bool = False,
+    fused: int | None = None, reps: int = 2, warmup: int = 2,
+    peak_tflops: float | None = None,
+) -> dict:
+    """Build + measure one decoder-only LM train config; returns a dict of
+    {tokens_per_sec, mfu, seconds_per_step, mean_seconds_per_step,
+    params_millions}. THE single LM-training measurement block, shared by
+    the bench LM section and perf_probe's lmsweep so the MFU-vs-size curve
+    and the headline line can never drift apart in timing/flops accounting.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -237,9 +258,13 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
     # Single-chip metric: pin the mesh to one device (create_mesh over all
     # visible devices would raise on a multi-chip host).
     mesh = create_mesh({"dp": 1}, jax.devices()[:1])
-    cfg = TransformerConfig(dtype=jnp.bfloat16, mesh=mesh, **LM_SIZE)
+    cfg = TransformerConfig(
+        dtype=jnp.bfloat16, mesh=mesh, vocab_size=vocab_size,
+        d_model=d_model, n_heads=n_heads or max(1, d_model // 64),
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=seq, remat=remat,
+    )
     model = Transformer(cfg)
-    B, S = LM_BATCH, LM_SEQ
+    B, S = batch, seq
     tokens = jnp.zeros((B, S), jnp.int32)
     # return_hidden at init: the unjitted init would otherwise eagerly
     # materialize the [B,S,V] f32 logits the chunked loss exists to avoid.
@@ -251,44 +276,60 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
     # accumulation (exactness: tests/test_training.py chunked-xent tests).
     step = make_lm_train_step(
         model, tx, mesh, seq_axis=None, donate=False,
-        xent_chunk=min(1024, LM_SEQ), xent_dot_dtype=jnp.bfloat16,
+        xent_chunk=min(1024, S), xent_dot_dtype=jnp.bfloat16,
     )
-    multi = fuse_steps(step, LM_FUSED)
+    n_fused = fused or LM_FUSED
+    multi = fuse_steps(step, n_fused)
     rng = np.random.default_rng(0)
-    vocab = cfg.vocab_size
-    batch = {
-        "tokens": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
-        "targets": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
+    batch_data = {
+        "tokens": jnp.asarray(rng.integers(0, vocab_size, (B, S)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, vocab_size, (B, S)), jnp.int32),
     }
     holder = [state]
 
     def call():
-        new_state, metrics = multi(holder[0], batch)
+        new_state, metrics = multi(holder[0], batch_data)
         holder[0] = new_state
         float(metrics["loss"])
 
-    times = timed_reps(call, reps=2, warmup=2)
-    dt = min(times) / LM_FUSED  # steady-state per step
+    times = timed_reps(call, reps=reps, warmup=warmup)
+    dt = min(times) / n_fused  # steady-state per step
 
     tokens_per_sec = B * S / dt
     # Model FLOPs per token: 6*N params (fwd+bwd) + causal attention term
     # (per layer fwd QK+AV = 4*S*d_model, x3 fwd+bwd, /2 causal = 6*S*d).
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    attn_flops = 6 * cfg.n_layers * cfg.d_model * S  # per token
-    flops_per_token = 6 * n_params + attn_flops
+    flops_per_token = 6 * n_params + 6 * n_layers * d_model * S
     mfu = (
         tokens_per_sec * flops_per_token / (peak_tflops * 1e12)
         if peak_tflops
         else 0.0
     )
-    emit(
-        f"transformer_lm_tokens_per_sec_bf16_seq{S}_1chip",
-        tokens_per_sec,
-        "tokens/sec",
-        mfu,
+    return dict(
+        tokens_per_sec=tokens_per_sec,
         mfu=mfu,
-        mean_seconds_per_step=sum(times) / len(times) / LM_FUSED,
+        seconds_per_step=dt,
+        mean_seconds_per_step=sum(times) / len(times) / n_fused,
         params_millions=n_params / 1e6,
+    )
+
+
+def bench_transformer_lm(peak_tflops: float | None) -> None:
+    """Decoder-only LM train step, bf16, 8k context, flash attention."""
+    m = lm_train_measure(
+        d_model=LM_SIZE["d_model"], n_layers=LM_SIZE["n_layers"],
+        d_ff=LM_SIZE["d_ff"], n_heads=LM_SIZE["n_heads"],
+        batch=LM_BATCH, seq=LM_SEQ,
+        vocab_size=LM_SIZE["vocab_size"], peak_tflops=peak_tflops,
+    )
+    emit(
+        f"transformer_lm_tokens_per_sec_bf16_seq{LM_SEQ}_1chip",
+        m["tokens_per_sec"],
+        "tokens/sec",
+        m["mfu"],
+        mfu=m["mfu"],
+        mean_seconds_per_step=m["mean_seconds_per_step"],
+        params_millions=m["params_millions"],
     )
 
 

@@ -318,6 +318,91 @@ def probe_flashsweep() -> None:
     emit("flashsweep", **results)
 
 
+def probe_lmsweep() -> None:
+    """MFU-vs-model-size curve (VERDICT r3 item 4): the 3.4%-MFU LM line
+    came from a 176M-param model that may simply be too small to be
+    compute-bound at batch 2; this sweep measures tokens/sec + MFU at
+    ~176M / ~440M / ~840M params (same 8k seq) so the headline can move
+    to the largest model if — and only if — the curve says the gap is a
+    small-model artifact. Each size runs independently; an OOM at the
+    largest size is reported, not fatal."""
+    import jax
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    seq = 256 if smoke else bench.LM_SEQ
+    vocab = 256 if smoke else bench.LM_SIZE["vocab_size"]
+    # (label, d_model, n_layers, d_ff, batch, remat)
+    sizes = (
+        (("tiny", 64, 2, 128, 2, False),) if smoke else (
+            ("176M", 1024, 8, 4096, 2, False),
+            ("440M", 1536, 12, 6144, 2, True),
+            ("840M", 2048, 14, 8192, 1, True),
+        )
+    )
+    peak = bench.chip_peak_tflops(jax.devices()[0])
+    for label, d_model, n_layers, d_ff, B, remat in sizes:
+        try:
+            m = bench.lm_train_measure(
+                d_model=d_model, n_layers=n_layers, d_ff=d_ff,
+                batch=B, seq=seq, vocab_size=vocab, remat=remat,
+                peak_tflops=peak,
+            )
+            emit(
+                "lmsweep", size=label, batch=B, seq=seq, remat=remat,
+                mfu_spec=m.pop("mfu"), **m,
+            )
+        except Exception as exc:  # noqa: BLE001 — per-size isolation
+            emit("lmsweep", size=label, error=repr(exc)[:200])
+
+
+def probe_decodesweep() -> None:
+    """Steady-state decode throughput with ramp-aware timing (VERDICT r3
+    item 5): round 3's 470-tok/s headline halved itself on warm-up ramp
+    (steady_state said 940). More warmups + best-rep, at two batch sizes,
+    reporting achieved HBM GB/s so the number lands directly against the
+    measured (not spec) copy roofline."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer, TransformerConfig, generate,
+    )
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    B_list = (2,) if smoke else (8, 32)
+    prompt_len = 8 if smoke else bench.DECODE_PROMPT
+    steps = 8 if smoke else bench.DECODE_STEPS
+    for B in B_list:
+        total = prompt_len + steps
+        cfg = TransformerConfig(
+            dtype=jnp.bfloat16,
+            **dict(bench.LM_SIZE, max_seq_len=total) if not smoke else dict(
+                vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                max_seq_len=total),
+        )
+        model = Transformer(cfg)
+        prompt = jnp.zeros((B, prompt_len), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        params_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+        kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
+
+        def call():
+            out = generate(cfg, params, prompt, num_steps=steps)
+            int(out[0, -1])
+
+        times = bench.timed_reps(call, reps=3, warmup=3)
+        dt = min(times)
+        emit(
+            "decodesweep", batch=B,
+            gen_tokens_per_sec=B * steps / dt,
+            hbm_gbps=((params_bytes + kv_bytes) * steps + params_bytes)
+            / dt / 1e9,
+            mean_tokens_per_sec=B * steps / (sum(times) / len(times)),
+        )
+
+
 def run_window() -> None:
     """Hardware-window triage: run the probes that answer round 3's open
     questions, highest-value first, each in its own subprocess with a
@@ -442,6 +527,8 @@ PROBES = {
     "fwd_split": probe_fwd_split,
     "synthetic": probe_synthetic,
     "stem": probe_stem,
+    "lmsweep": probe_lmsweep,
+    "decodesweep": probe_decodesweep,
 }
 
 

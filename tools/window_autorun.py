@@ -1,0 +1,174 @@
+"""TPU-window auto-runner: poll the tunnel, pounce on an UP window.
+
+The chip behind the axon tunnel is reachable only in short, unpredictable
+windows (rounds 2-4 each saw 6-12 h outages around a ~35-min window).
+This daemon replaces the passive watcher: it polls `jax.devices()` under
+a timeout, and the moment the backend answers it runs the round-4
+measurement plan — highest-value stage first, each stage its own
+subprocess with a budget, tunnel re-checked between stages — so a window
+is fully exploited even if it opens while nobody is watching.
+
+Stages (see VERDICT round 3 "Next round: do this"):
+  1. roofline probe        — chip state right now (fast/slow?).
+  2. synthetic probe       — device-resident ResNet rate: THE split that
+                             attributes round 3's 59.9 img/s collapse.
+  3. flashramp/flashblocks — 8k attention: ramp artifact or real, and
+                             the Q-block A/B for the decoupled kernel.
+  4. bench.py (full)       — the complete artifact, ResNet first; also
+                             populates the persistent XLA compile cache
+                             so the driver's round-end bench is cheap.
+  5. flashsweep/stem/h2d   — secondary attribution probes.
+  6. LM flash-vs-xla A/B   — bench lm section, both kernel legs.
+  7. lmsweep probe         — MFU-vs-model-size curve (VERDICT item 4).
+  8. decode probe          — steady-state decode vs measured copy roof.
+
+Everything lands under docs/window_r04/<UTC stamp>/<stage>.jsonl plus a
+combined log; stderr per stage under the same dir. Usage:
+    nohup python tools/window_autorun.py >> /tmp/autorun.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_ROOT = os.path.join(REPO, "docs", "window_r04")
+POLL_S = 150.0
+PROBE_TIMEOUT_S = 45.0
+
+# (label, env overrides ({"PROBE": name} = perf_probe stage, {"BENCH":
+# section} = bench --section stage, None = full bench), budget seconds).
+STAGES = [
+    ("roofline", {"PROBE": "roofline"}, 300.0),
+    ("synthetic", {"PROBE": "synthetic"}, 900.0),
+    ("flashramp", {"PROBE": "flashramp"}, 600.0),
+    ("flashblocks", {"PROBE": "flashblocks"}, 600.0),
+    ("bench_full", None, 3600.0),
+    ("flashsweep", {"PROBE": "flashsweep"}, 900.0),
+    ("stem", {"PROBE": "stem"}, 900.0),
+    ("h2d", {"PROBE": "h2d"}, 180.0),
+    ("lm_ab_flash", {"BENCH": "lm", "TPU_OPERATOR_ATTN": ""}, 1100.0),
+    ("lm_ab_xla", {"BENCH": "lm", "TPU_OPERATOR_ATTN": "xla"}, 1100.0),
+    ("lmsweep", {"PROBE": "lmsweep"}, 1500.0),
+    ("decodesweep", {"PROBE": "decodesweep"}, 900.0),
+]
+
+
+def log(msg: str) -> None:
+    print(f"{datetime.datetime.utcnow():%H:%M:%S} {msg}", flush=True)
+
+
+def tunnel_up() -> bool:
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True, timeout=PROBE_TIMEOUT_S, text=True,
+        )
+        return out.stdout.strip().endswith("1")
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception:
+        return False
+
+
+def stage_argv(label: str, env_over: dict | None) -> tuple[list, dict]:
+    env = dict(os.environ)
+    env["BENCH_WATCHDOG_S"] = "0"  # our own budget is the watchdog
+    if env_over and "PROBE" in env_over:
+        env.update(env_over)
+        return [sys.executable, os.path.join(REPO, "perf_probe.py")], env
+    if env_over and "BENCH" in env_over:
+        section = env_over.pop("BENCH")
+        env.update(env_over)
+        return (
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--section", section],
+            env,
+        )
+    # full bench: keep its own watchdog + per-section isolation
+    env.pop("BENCH_WATCHDOG_S")
+    return [sys.executable, os.path.join(REPO, "bench.py")], env
+
+
+def _useful_lines(path: str, label: str) -> int:
+    """Count result lines that represent real (hardware) data: JSON lines
+    with no "error" key — and for the full bench, not the CPU-only
+    submit-latency line, which lands even when the tunnel is down (that is
+    exactly the BENCH_r03 rc=3 shape that must NOT mark the stage done)."""
+    import json as _json
+
+    n = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                if not line.startswith("{"):
+                    continue
+                try:
+                    obj = _json.loads(line)
+                except ValueError:
+                    continue
+                if "error" in obj:
+                    continue
+                if obj.get("metric", "").startswith("tpujob_submit"):
+                    continue
+                n += 1
+    except OSError:
+        pass
+    return n
+
+
+def run_window(done: set) -> None:
+    if all(label in done for label, _, _ in STAGES):
+        return
+    stamp = datetime.datetime.utcnow().strftime("%Y%m%dT%H%M%S")
+    out_dir = os.path.join(OUT_ROOT, stamp)
+    os.makedirs(out_dir, exist_ok=True)
+    log(f"UP — window sequence starting, artifacts in {out_dir}")
+    for label, env_over, budget in STAGES:
+        if label in done:
+            continue
+        if not tunnel_up():
+            log(f"tunnel dropped before {label}; pausing sequence")
+            return
+        argv, env = stage_argv(label, dict(env_over) if env_over else None)
+        t0 = time.monotonic()
+        out_path = os.path.join(out_dir, f"{label}.jsonl")
+        err_path = os.path.join(out_dir, f"{label}.err")
+        try:
+            with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+                proc = subprocess.run(
+                    argv, env=env, stdout=out_f, stderr=err_f, timeout=budget
+                )
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            rc = "timeout"
+        dt = time.monotonic() - t0
+        got_lines = _useful_lines(out_path, label)
+        log(f"stage {label}: rc={rc} {dt:.0f}s {got_lines} useful lines")
+        # A stage that produced real data counts as done even on timeout;
+        # anything else (zero useful lines) is retried in the next window.
+        if got_lines:
+            done.add(label)
+    log("window sequence complete")
+
+
+def main() -> None:
+    os.makedirs(OUT_ROOT, exist_ok=True)
+    done: set = set()
+    log(f"autorun start (poll {POLL_S:.0f}s, stages={len(STAGES)})")
+    while True:
+        if tunnel_up():
+            log("UP" + (" (all stages done)" if all(
+                label in done for label, _, _ in STAGES) else ""))
+            run_window(done)
+        else:
+            log("DOWN")
+        time.sleep(POLL_S)
+
+
+if __name__ == "__main__":
+    main()
