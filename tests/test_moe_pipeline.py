@@ -15,6 +15,7 @@ from tf_operator_tpu.models.moe import (
     MoeMlp,
     aux_loss_from,
     moe_param_sharding_rules,
+    top_k_dispatch,
 )
 from tf_operator_tpu.parallel.mesh import create_mesh
 from tf_operator_tpu.parallel.pipeline import (
@@ -322,6 +323,58 @@ class TestTopKRouting:
         np.testing.assert_allclose(
             np.asarray(out2), expect, atol=1e-5, rtol=1e-4
         )
+
+    def test_dispatch_capacity_fully_utilized(self):
+        """Pin the intended capacity semantics (ADVICE r3): under heavy
+        imbalance no expert slot may go unused while an assignment is
+        dropped — each expert dispatches exactly min(assignments,
+        capacity) tokens, each (expert, slot) holds at most one token, and
+        choice priority holds (a kept later choice never displaces an
+        earlier one)."""
+        rng = np.random.default_rng(3)
+        n_experts, capacity, k = 4, 3, 3
+        for trial in range(20):
+            top_idx_np = np.stack(
+                [rng.choice(n_experts, size=(1, 10), replace=True)
+                 for _ in range(k)], axis=-1,
+            )
+            # Distinct experts per token (top_k never repeats an expert).
+            for g in range(1):
+                for s in range(10):
+                    while len(set(top_idx_np[g, s])) < k:
+                        top_idx_np[g, s] = rng.choice(
+                            n_experts, size=k, replace=False
+                        )
+            top_idx = jnp.asarray(top_idx_np, jnp.int32)
+            gates = jnp.full((1, 10, k), 1.0 / k, jnp.float32)
+            dispatch, combine, _ = top_k_dispatch(
+                top_idx, gates, n_experts, capacity
+            )
+            d = np.asarray(dispatch)  # [1, 10, E, C]
+            # Each (expert, slot) holds at most one token.
+            assert d.sum(axis=1).max() <= 1.0 + 1e-6
+            # Full utilization: dispatched == min(assigned, capacity).
+            assigned = np.zeros(n_experts)
+            for e in range(n_experts):
+                assigned[e] = (top_idx_np == e).sum()
+            dispatched = d.sum(axis=(0, 1, 3))
+            np.testing.assert_allclose(
+                dispatched, np.minimum(assigned, capacity), atol=1e-6
+            )
+            # Choice priority: every kept FIRST choice would also be kept
+            # if first choices were dispatched alone.
+            d1, _, _ = top_k_dispatch(
+                top_idx[..., :1], gates[..., :1], n_experts, capacity
+            )
+            kept_all = d.sum(axis=3)  # [1, 10, E]
+            kept_first_alone = np.asarray(d1).sum(axis=3)
+            first_oh = np.eye(n_experts)[top_idx_np[..., 0]]
+            np.testing.assert_allclose(
+                kept_first_alone, first_oh * kept_first_alone
+            )
+            # Wherever a first choice was kept alone, it stays kept in
+            # the full dispatch.
+            assert np.all(kept_all >= kept_first_alone - 1e-6)
 
     def test_top_k_validated(self):
         x = jnp.ones((1, 4, 16), jnp.float32)

@@ -56,7 +56,11 @@ class Informer:
         # with an async-delivery backend (kubeclient's HTTP reader
         # thread) a pre-list event can arrive AFTER the relist and
         # resurrect a deleted object into the cache ("ghost"). UIDs are
-        # never reused, so suppression is exact; bounded FIFO.
+        # never reused, so suppression is exact while a uid stays in the
+        # FIFO; the bound makes it BEST-EFFORT in namespaces churning
+        # more deletions than the cap between a stale buffered event and
+        # its late replay, so the cap scales with the live-cache size
+        # (see _mark_dead) with 1024 as the floor.
         self._dead_uids: dict[str, None] = {}
         self._dead_uids_cap = 1024
 
@@ -97,7 +101,11 @@ class Informer:
         if not uid:
             return
         self._dead_uids[uid] = None
-        while len(self._dead_uids) > self._dead_uids_cap:
+        # Scale the suppression window with the namespace's live size: a
+        # cache of N objects can churn ~N deletions in one relist cycle,
+        # so a fixed cap would silently lose exactness at scale.
+        cap = max(self._dead_uids_cap, 4 * len(self._cache))
+        while len(self._dead_uids) > cap:
             self._dead_uids.pop(next(iter(self._dead_uids)))
 
     def _apply(self, etype: str, obj: dict[str, Any]) -> None:

@@ -57,6 +57,50 @@ class MoeConfig:
     mesh: Any = None  # when set, constrain expert tensors over ep/dp axes
 
 
+def top_k_dispatch(
+    top_idx: jax.Array, gates: jax.Array, n_experts: int, capacity: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Choice-priority capacity dispatch (GShard top-k routing analog;
+    reference has no MoE — this is TPU-stack capability beyond parity).
+
+    top_idx/gates: [G, S, k] expert ids and renormalized gate weights per
+    choice. Returns (dispatch [G,S,E,C], combine [G,S,E,C],
+    first_choice_oh [G,S,E]).
+
+    Queue positions for choice j start after all tokens' KEPT
+    earlier-choice assignments to that expert, so when an expert
+    overflows, later choices drop first and no slot is ever reserved for
+    an assignment that was itself dropped — every expert dispatches
+    exactly min(total assignments, capacity) tokens and each (expert,
+    slot) holds at most one token (pinned by
+    tests/test_moe_pipeline.py::test_dispatch_capacity_fully_utilized).
+    """
+    n_groups, group, k = top_idx.shape
+    dispatch = jnp.zeros((n_groups, group, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    prior_count = jnp.zeros((n_groups, 1, n_experts), jnp.float32)
+    first_choice_oh = None
+    for j in range(k):
+        oh = jax.nn.one_hot(
+            top_idx[..., j], n_experts, dtype=jnp.float32
+        )  # [G, S, E]
+        if j == 0:
+            first_choice_oh = oh
+        position = (
+            jnp.cumsum(oh, axis=1) * oh - oh + prior_count * oh
+        )  # [G, S, E]
+        keep = (position < capacity).astype(jnp.float32) * oh
+        pos_one_hot = jax.nn.one_hot(
+            jnp.sum(position * oh, axis=-1).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )  # [G, S, C]
+        d_j = keep[..., None] * pos_one_hot[:, :, None, :]  # [G,S,E,C]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gates[..., j, None, None]
+        prior_count = prior_count + keep.sum(axis=1, keepdims=True)
+    return dispatch, combine, first_choice_oh
+
+
 class MoeMlp(nn.Module):
     """Top-k routed expert MLP. Input/output: [batch, seq, d_model]."""
 
@@ -110,33 +154,9 @@ class MoeMlp(nn.Module):
                 top_vals.sum(-1, keepdims=True), 1e-9
             )
 
-        # Choice-priority capacity: queue positions for choice j start
-        # after ALL tokens' earlier-choice assignments to that expert, so
-        # when an expert overflows, second choices drop first.
-        dispatch = jnp.zeros(
-            (n_groups, group, cfg.n_experts, capacity), jnp.float32
+        dispatch, combine, first_choice_oh = top_k_dispatch(
+            top_idx, gates, cfg.n_experts, capacity
         )
-        combine = jnp.zeros_like(dispatch)
-        prior_count = jnp.zeros((n_groups, 1, cfg.n_experts), jnp.float32)
-        first_choice_oh = None
-        for j in range(k):
-            oh = jax.nn.one_hot(
-                top_idx[..., j], cfg.n_experts, dtype=jnp.float32
-            )  # [G, S, E]
-            if j == 0:
-                first_choice_oh = oh
-            position = (
-                jnp.cumsum(oh, axis=1) * oh - oh + prior_count * oh
-            )  # [G, S, E]
-            keep = (position < capacity).astype(jnp.float32) * oh
-            pos_one_hot = jax.nn.one_hot(
-                jnp.sum(position * oh, axis=-1).astype(jnp.int32),
-                capacity, dtype=jnp.float32,
-            )  # [G, S, C]
-            d_j = keep[..., None] * pos_one_hot[:, :, None, :]  # [G,S,E,C]
-            dispatch = dispatch + d_j
-            combine = combine + d_j * gates[..., j, None, None]
-            prior_count = prior_count + oh.sum(axis=1, keepdims=True)
 
         # Load-balancing aux loss over FIRST choices (computed before
         # capacity dropping; the Switch form, unchanged for k > 1).
