@@ -353,11 +353,46 @@ def test_default_e2e_workflow_end_to_end(tmp_path):
     assert ok, (statuses, _tail_logs(tmp_path))
     assert statuses == {
         "build": "passed", "unit": "passed", "deploy": "passed",
-        "e2e": "passed", "teardown": "passed",
+        "e2e": "passed", "realcluster": "passed", "teardown": "passed",
     }
     assert (tmp_path / "dist" / "manifest.json").exists()
     assert (tmp_path / "junit_e2e_suite.xml").exists()
     assert json.load(open(tmp_path / "finished.json"))["passed"] is True
+
+
+def test_realcluster_stage_skips_cleanly_without_cluster(tmp_path, monkeypatch):
+    """The optional real-apiserver stage (reference parity: prow CI runs
+    on a live cluster) must be skipped-not-broken when no cluster is
+    configured: it PASSES and records an explicit skip reason, so the day
+    TPUFLOW_E2E_KUBECONFIG exists nothing new needs writing."""
+    from tf_operator_tpu.harness.workflow import default_e2e_workflow
+
+    monkeypatch.delenv("TPUFLOW_E2E_KUBECONFIG", raising=False)
+    wf = default_e2e_workflow()
+    step = wf.steps["realcluster"]
+    ctx = {"artifacts_dir": str(tmp_path), "env": {}, "outputs": {}}
+    step.action(ctx)  # must not raise
+    assert "skipped" in ctx["outputs"]["realcluster"]
+    assert "TPUFLOW_E2E_KUBECONFIG" in ctx["outputs"]["realcluster"]
+
+
+def test_realcluster_stage_fails_loudly_on_unreachable_cluster(
+    tmp_path, monkeypatch
+):
+    """A CLAIMED cluster that doesn't work must FAIL the stage (not
+    silently skip): point the kubeconfig at a nonexistent file and the
+    underlying smoke errors out."""
+    from tf_operator_tpu.harness.workflow import default_e2e_workflow
+
+    monkeypatch.setenv(
+        "TPUFLOW_E2E_KUBECONFIG", str(tmp_path / "no-such-kubeconfig")
+    )
+    (tmp_path / "logs").mkdir()
+    wf = default_e2e_workflow()
+    step = wf.steps["realcluster"]
+    ctx = {"artifacts_dir": str(tmp_path), "env": {}, "outputs": {}}
+    with pytest.raises(RuntimeError, match="real-apiserver smoke failed"):
+        step.action(ctx)
 
 
 def _tail_logs(tmp_path):

@@ -99,6 +99,7 @@ def test_tpu_smoke_two_process_rendezvous(operator):
             pass
 
 
+@pytest.mark.e2e_smoke
 def test_dist_mnist_two_process_training(operator):
     """2-process synchronous data-parallel MNIST trains to the loss target
     through the framework's full path: operator → env → jax.distributed →
@@ -201,6 +202,7 @@ def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
             pass
 
 
+@pytest.mark.e2e_smoke
 def test_dist_lm_two_process_ring_attention(operator):
     """2-process long-context LM: the sequence is sharded ACROSS PROCESSES
     (sp=2, one CPU device each), so every attention layer streams KV blocks
@@ -240,6 +242,7 @@ def test_dist_lm_two_process_ring_attention(operator):
             pass
 
 
+@pytest.mark.e2e_smoke
 def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
     """Kill-and-resume: the replica checkpoints, dies with the user-retryable
     exit code (138), the ExitCode restart policy recreates it, and training
