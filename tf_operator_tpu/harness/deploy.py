@@ -156,6 +156,7 @@ def kubectl_deploy(
     context: str | None = None,
     namespace: str = "tpu-operator-system",
     image: str | None = None,
+    bundle: str | None = None,
     runner=subprocess.run,
 ) -> list[list[str]]:
     """Apply/delete the CRD + operator manifests on a real cluster.
@@ -163,8 +164,10 @@ def kubectl_deploy(
     Parity: py/deploy.py:180 (ksonnet apply of the operator onto GKE) —
     here plain `kubectl apply -f` of deploy/crd.yaml + deploy/operator.yaml,
     with the Deployment's image pinned to the release tag (manifest.json
-    "image_tag"). Returns the kubectl argvs it ran; ``runner`` is
-    injectable so tests can record instead of execute.
+    "image_tag"), or of a versioned release bundle's rendered templates
+    when ``bundle`` (a release/bundle.py tarball) is given. Returns the
+    kubectl argvs it ran; ``runner`` is injectable so tests can record
+    instead of execute.
     """
     if action not in ("apply", "delete"):
         raise ValueError(f"action must be apply|delete, not {action!r}")
@@ -175,6 +178,23 @@ def kubectl_deploy(
         base += ["--context", context]
     deploy_dir = os.path.join(REPO_ROOT, "deploy")
     crd = os.path.join(deploy_dir, "crd.yaml")
+    crd_doc: bytes | None = None
+    operator_doc: bytes
+    if bundle:
+        # Versioned bundle (release/bundle.py, helm-chart analog): both
+        # manifests come from the bundle's templates with values
+        # substituted — the repo's deploy/ dir is not consulted, so a
+        # pinned release deploys the same bits on any checkout.
+        from tf_operator_tpu.release.bundle import load_bundle, render
+
+        overrides: dict[str, Any] = {"namespace": namespace}
+        if image:
+            overrides["image"] = image
+        docs = render(load_bundle(bundle), overrides)
+        crd_doc = docs["crd.yaml"].encode()
+        operator_doc = docs["operator.yaml"].encode()
+    else:
+        operator_doc = _render_operator_manifest(namespace, image).encode()
     ran: list[list[str]] = []
 
     def run(cmd: list[str], **kw: Any) -> None:
@@ -196,8 +216,13 @@ def kubectl_deploy(
     # shipped over stdin: never `-f file -n ns` (kubectl rejects the
     # namespace mismatch), and never apply-then-`set image` (the apply
     # would transiently roll the Deployment back to the placeholder tag).
-    operator_doc = _render_operator_manifest(namespace, image).encode()
     ignore = ["--ignore-not-found"] if action == "delete" else []
+
+    def run_crd(verb: list[str]) -> None:
+        if crd_doc is not None:
+            run(base + verb + ["-f", "-"], input=crd_doc)
+        else:
+            run(base + verb + ["-f", crd])
 
     if action == "apply":
         # Namespace first (idempotent), CRD before the operator watches it.
@@ -221,12 +246,12 @@ def kubectl_deploy(
                 # on a transient error): fine as long as the secret exists.
                 if not probe(get_secret):
                     raise
-        run(base + ["apply", "-f", crd])
+        run_crd(["apply"])
         run(base + ["apply", "-f", "-"], input=operator_doc)
     else:
         # Reverse order: stop the operator before removing its CRD.
         run(base + ["delete", "-f", "-"] + ignore, input=operator_doc)
-        run(base + ["delete", "-f", crd] + ignore)
+        run_crd(["delete"] + ignore)
     return ran
 
 
@@ -383,6 +408,10 @@ def main(argv: list[str] | None = None) -> int:
         k.add_argument("--namespace", default="tpu-operator-system")
         k.add_argument("--image", default=None,
                        help="operator image tag (manifest.json image_tag)")
+        k.add_argument("--bundle", default=None, metavar="TAR_GZ",
+                       help="deploy from a versioned release bundle "
+                            "(manifest.json \"bundle\") instead of the "
+                            "repo's deploy/ manifests")
         k.add_argument("--echo", action="store_true",
                        help="print kubectl commands instead of running them")
     for name in ("cluster-up", "cluster-down"):
@@ -426,7 +455,8 @@ def main(argv: list[str] | None = None) -> int:
         kubectl_deploy(
             "apply" if args.cmd == "kube-up" else "delete",
             kubeconfig=args.kubeconfig, context=args.kube_context,
-            namespace=args.namespace, image=args.image, runner=runner,
+            namespace=args.namespace, image=args.image,
+            bundle=args.bundle, runner=runner,
         )
         return 0
 

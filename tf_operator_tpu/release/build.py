@@ -220,11 +220,26 @@ def main(argv: list[str] | None = None) -> int:
                 token=args.registry_token,
             )
         )
-    if wants_image:
-        # Re-write manifest.json so the on-disk manifest (what deploy
-        # tooling consumes) carries the image fields, not just stdout.
-        with open(os.path.join(args.out, "manifest.json"), "w") as f:
-            json.dump(manifest, f, indent=2, sort_keys=True)
+    # Versioned deploy bundle (helm-chart analog, py/release.py:54-70):
+    # emitted unconditionally next to the image artifacts, with the
+    # release's most-pinned image ref baked in as the default value
+    # (digest-pinned push ref > local image tag > floating latest).
+    from tf_operator_tpu.release.bundle import build_bundle
+
+    image_ref = (
+        (manifest.get("push") or {}).get("ref")
+        or manifest.get("image_tag")
+    )
+    manifest.update(build_bundle(
+        args.repo_root, args.out,
+        name_tag=manifest["name"].removeprefix("tpu-operator-"),
+        version=manifest["version"], git_sha=manifest["git_sha"],
+        image=image_ref,
+    ))
+    # Re-write manifest.json so the on-disk manifest (what deploy tooling
+    # consumes) carries the image + bundle fields, not just stdout.
+    with open(os.path.join(args.out, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
     print(json.dumps(manifest, indent=2, sort_keys=True))
     return 0
 

@@ -1,0 +1,168 @@
+"""Versioned deploy bundle — the helm-chart analog.
+
+Parity: /root/reference/py/release.py:54-70 (the reference releases a
+versioned helm chart next to the image, with chart/values versions
+rewritten per release). This framework's equivalent is a deterministic
+tarball:
+
+    tpu-operator-bundle-{tag}/
+      bundle.json        # name/version/git_sha/created metadata
+      values.json        # default values (namespace, image, replicas,
+                         #   resources, leader election)
+      templates/crd.yaml
+      templates/operator.yaml   # {{key}} placeholders for every value
+
+``render()`` substitutes values (defaults overlaid with caller
+overrides) into the templates, strictly: an unknown override key and an
+unsubstituted placeholder are both errors, so a template/values drift
+cannot ship silently. `deploy.py kube-up --bundle` consumes the tarball
+directly; the round-trip is pinned by
+tests/test_ci_tooling.py::test_bundle_roundtrip_build_render_deploy.
+
+The templates are derived mechanically from deploy/crd.yaml +
+deploy/operator.yaml at build time (single source of truth — the bundle
+can never drift from what `kubectl apply -f deploy/` installs).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import tarfile
+from typing import Any
+
+# Literal -> placeholder rewrites applied to deploy/operator.yaml. Each
+# pattern must hit at least once or the build fails (guards against the
+# source manifest drifting away from the parameterization).
+_TEMPLATE_REWRITES: tuple[tuple[str, str], ...] = (
+    (r"namespace: default\b", "namespace: {{namespace}}"),
+    (r"image: tpu-operator:latest\s*(#[^\n]*)?", "image: {{image}}"),
+    (r"replicas: 1\b", "replicas: {{replicas}}"),
+    (r"requests: \{cpu: 100m, memory: 256Mi\}",
+     "requests: {cpu: {{cpu_request}}, memory: {{memory_request}}}"),
+    (r"limits: \{cpu: \"1\", memory: 1Gi\}",
+     "limits: {cpu: {{cpu_limit}}, memory: {{memory_limit}}}"),
+)
+
+DEFAULT_VALUES: dict[str, Any] = {
+    "namespace": "tpu-operator-system",
+    "image": "tpu-operator:latest",
+    "replicas": 1,
+    "cpu_request": "100m",
+    "memory_request": "256Mi",
+    "cpu_limit": '"1"',
+    "memory_limit": "1Gi",
+}
+
+_PLACEHOLDER = re.compile(r"\{\{(\w+)\}\}")
+
+
+def _operator_template(repo_root: str) -> str:
+    with open(os.path.join(repo_root, "deploy", "operator.yaml")) as f:
+        doc = f.read()
+    for pattern, repl in _TEMPLATE_REWRITES:
+        doc, n = re.subn(pattern, repl, doc)
+        if n == 0:
+            raise RuntimeError(
+                f"deploy/operator.yaml no longer matches bundle "
+                f"parameterization {pattern!r} — update _TEMPLATE_REWRITES"
+            )
+    return doc
+
+
+def build_bundle(
+    repo_root: str, out_dir: str, *, name_tag: str, version: str,
+    git_sha: str, image: str | None = None,
+) -> dict[str, Any]:
+    """Write tpu-operator-bundle-{name_tag}.tar.gz into out_dir.
+
+    ``image``: the release's digest-pinned ref (or tag) baked in as the
+    default image value, so `render(bundle)` with no overrides deploys
+    exactly the bits this release built.
+    """
+    bundle_name = f"tpu-operator-bundle-{name_tag}"
+    values = dict(DEFAULT_VALUES)
+    if image:
+        values["image"] = image
+    meta = {
+        "name": bundle_name,
+        "version": version,
+        "git_sha": git_sha,
+        "values_schema": sorted(values),
+    }
+    with open(os.path.join(repo_root, "deploy", "crd.yaml")) as f:
+        crd = f.read()
+    members = {
+        f"{bundle_name}/bundle.json": json.dumps(
+            meta, indent=2, sort_keys=True),
+        f"{bundle_name}/values.json": json.dumps(
+            values, indent=2, sort_keys=True),
+        f"{bundle_name}/templates/crd.yaml": crd,
+        f"{bundle_name}/templates/operator.yaml": _operator_template(
+            repo_root),
+    }
+    os.makedirs(out_dir, exist_ok=True)
+    tar_path = os.path.join(out_dir, f"{bundle_name}.tar.gz")
+    # Deterministic: fixed mtime/uid/gid, sorted members (same contract as
+    # build_release's source tarball).
+    with tarfile.open(tar_path, "w:gz") as tar:
+        for arcname in sorted(members):
+            data = members[arcname].encode()
+            info = tarfile.TarInfo(arcname)
+            info.size = len(data)
+            info.mode = 0o644
+            tar.addfile(info, io.BytesIO(data))
+    return {
+        "bundle": os.path.basename(tar_path),
+        "bundle_name": bundle_name,
+        "bundle_values": values,
+    }
+
+
+def load_bundle(tar_path: str) -> dict[str, Any]:
+    """Read a bundle tarball -> {meta, values, templates: {filename: doc}}."""
+    out: dict[str, Any] = {"templates": {}}
+    with tarfile.open(tar_path, "r:gz") as tar:
+        for member in tar.getmembers():
+            rel = member.name.split("/", 1)[1] if "/" in member.name else member.name
+            data = tar.extractfile(member).read().decode()
+            if rel == "bundle.json":
+                out["meta"] = json.loads(data)
+            elif rel == "values.json":
+                out["values"] = json.loads(data)
+            elif rel.startswith("templates/"):
+                out["templates"][rel.removeprefix("templates/")] = data
+    for key in ("meta", "values"):
+        if key not in out:
+            raise ValueError(f"bundle {tar_path}: missing {key}.json")
+    if not out["templates"]:
+        raise ValueError(f"bundle {tar_path}: no templates/")
+    return out
+
+
+def render(
+    bundle: dict[str, Any], overrides: dict[str, Any] | None = None,
+) -> dict[str, str]:
+    """Substitute values (defaults overlaid with overrides) into every
+    template; returns {filename: rendered doc}. Strict both ways."""
+    values = dict(bundle["values"])
+    for key, val in (overrides or {}).items():
+        if key not in values:
+            raise ValueError(
+                f"unknown value {key!r}; bundle accepts {sorted(values)}"
+            )
+        values[key] = val
+    rendered: dict[str, str] = {}
+    for fname, doc in bundle["templates"].items():
+        def sub(match: re.Match) -> str:
+            key = match.group(1)
+            if key not in values:
+                raise ValueError(
+                    f"{fname}: template references undeclared value {key!r}"
+                )
+            return str(values[key])
+
+        rendered[fname] = _PLACEHOLDER.sub(sub, doc)
+    return rendered
