@@ -368,6 +368,10 @@ def probe_decodesweep() -> None:
         Transformer, TransformerConfig, generate,
     )
 
+    from dataclasses import replace
+
+    from tf_operator_tpu.models.transformer import quantize_decode_params
+
     smoke = bool(os.environ.get("BENCH_SMOKE"))
     B_list = (2,) if smoke else (8, 32)
     prompt_len = 8 if smoke else bench.DECODE_PROMPT
@@ -382,25 +386,43 @@ def probe_decodesweep() -> None:
         )
         model = Transformer(cfg)
         prompt = jnp.zeros((B, prompt_len), jnp.int32)
-        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
-        params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-        params_bytes = sum(
-            x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
-        kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
-
-        def call():
-            out = generate(cfg, params, prompt, num_steps=steps)
-            int(out[0, -1])
-
-        times = bench.timed_reps(call, reps=3, warmup=3)
-        dt = min(times)
-        emit(
-            "decodesweep", batch=B,
-            gen_tokens_per_sec=B * steps / dt,
-            hbm_gbps=((params_bytes + kv_bytes) * steps + params_bytes)
-            / dt / 1e9,
-            mean_tokens_per_sec=B * steps / (sum(times) / len(times)),
+        params0 = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        params_bf16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16), params0)
+        # int8 leg: projection weights stored int8, dequantized in VMEM by
+        # the Pallas kernel — the real decode-HBM optimization (the naive
+        # XLA int8 path was rejected; docs/perf.md).
+        variants = (
+            ("bf16", cfg, params_bf16),
+            ("int8", replace(cfg, int8_decode=True),
+             quantize_decode_params(params_bf16)),
         )
+        for label, vcfg, params in variants:
+            params_bytes = sum(
+                x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+            kv_bytes = (
+                2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
+            )
+
+            def call(vcfg=vcfg, params=params):
+                out = generate(vcfg, params, prompt, num_steps=steps)
+                int(out[0, -1])
+
+            try:
+                times = bench.timed_reps(call, reps=3, warmup=3)
+            except Exception as exc:  # noqa: BLE001 — per-variant isolation
+                emit("decodesweep", batch=B, weights=label,
+                     error=repr(exc)[:200])
+                continue
+            dt = min(times)
+            emit(
+                "decodesweep", batch=B, weights=label,
+                gen_tokens_per_sec=B * steps / dt,
+                hbm_gbps=((params_bytes + kv_bytes) * steps + params_bytes)
+                / dt / 1e9,
+                mean_tokens_per_sec=B * steps / (sum(times) / len(times)),
+                params_mb=params_bytes / 1e6,
+            )
 
 
 def run_window() -> None:

@@ -72,6 +72,12 @@ class TransformerConfig:
     # happens via GSPMD propagation from tp-sharded params. See
     # ``generate`` for the jitted sampling loop.
     decode: bool = False
+    # Weight-only int8 decode: projection weights live in HBM as int8 +
+    # per-channel scales and are dequantized IN VMEM by the Pallas kernel
+    # (ops/int8_dense.py) — halving the per-token weight read that bounds
+    # decode throughput. Params must come from ``quantize_decode_params``.
+    # Only meaningful with decode=True; activations/KV cache stay bf16.
+    int8_decode: bool = False
     # Mixture-of-Experts: every Nth block (1-indexed from the first) swaps
     # its dense MLP for a Switch-routed expert MLP (models/moe.py) sharded
     # over ``ep_axis``. Train with make_lm_train_step(aux_loss_weight=...)
@@ -91,6 +97,38 @@ class TransformerConfig:
         return self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1
 
 
+class Int8Dense(nn.Module):
+    """Weight-only int8 projection for the decode path: kernel_q (int8) +
+    per-output-channel scale, applied by ops/int8_dense.int8_apply (Pallas
+    dequant-in-VMEM on TPU). Params are produced by
+    ``quantize_decode_params`` from a trained tree; init creates
+    zero-filled placeholders only so cache-init works."""
+
+    features: int
+    out_shape: tuple = ()
+    out_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from tf_operator_tpu.ops.int8_dense import int8_apply
+
+        k, n = x.shape[-1], self.features
+        q = self.param(
+            "kernel_q", lambda _, s: jnp.zeros(s, jnp.int8), (k, n)
+        )
+        scale = self.param(
+            "scale", lambda _, s: jnp.ones(s, jnp.float32), (n,)
+        )
+        bias = self.param(
+            "bias", lambda _, s: jnp.zeros(s, jnp.float32), (n,)
+        )
+        y = int8_apply(x, q, scale, out_dtype=jnp.float32) + bias
+        y = y.astype(self.out_dtype)
+        if self.out_shape:
+            y = y.reshape(*y.shape[:-1], *self.out_shape)
+        return y
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -98,12 +136,19 @@ class Attention(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         b, t, _ = x.shape
-        qkv = nn.DenseGeneral(
-            (3, cfg.n_heads, cfg.head_dim),
-            axis=-1,
-            dtype=cfg.dtype,
-            name="qkv",
-        )(x)
+        if cfg.decode and cfg.int8_decode:
+            qkv = Int8Dense(
+                3 * cfg.n_heads * cfg.head_dim,
+                out_shape=(3, cfg.n_heads, cfg.head_dim),
+                out_dtype=cfg.dtype, name="qkv",
+            )(x)
+        else:
+            qkv = nn.DenseGeneral(
+                (3, cfg.n_heads, cfg.head_dim),
+                axis=-1,
+                dtype=cfg.dtype,
+                name="qkv",
+            )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cfg.decode:
             out = self._decode_attend(q, k, v)
@@ -183,6 +228,11 @@ class Attention(nn.Module):
                 out = device_attention(q, k, v, causal=True, use_flash=False)
             else:
                 out = device_attention(q, k, v, causal=True)
+        if cfg.decode and cfg.int8_decode:
+            flat = out.reshape(*out.shape[:-2], -1)
+            return Int8Dense(
+                cfg.d_model, out_dtype=cfg.dtype, name="out"
+            )(flat)
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out"
         )(out)
@@ -254,6 +304,12 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        if cfg.decode and cfg.int8_decode:
+            h = Int8Dense(cfg.d_ff, out_dtype=cfg.dtype, name="in_proj")(x)
+            h = nn.gelu(h)
+            return Int8Dense(
+                cfg.d_model, out_dtype=cfg.dtype, name="out_proj"
+            )(h)
         h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="in_proj")(x)
         h = nn.gelu(h)
         return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="out_proj")(h)
@@ -311,7 +367,12 @@ class Transformer(nn.Module):
             use_moe = bool(cfg.moe_every_n) and (i + 1) % cfg.moe_every_n == 0
             x = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.RMSNorm(dtype=cfg.dtype)(x)
-        head = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")
+        if cfg.decode and cfg.int8_decode:
+            head: Any = Int8Dense(
+                cfg.vocab_size, out_dtype=jnp.float32, name="lm_head"
+            )
+        else:
+            head = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")
         if return_hidden:
             # Callers computing a fused/chunked loss read lm_head params
             # directly (train/steps.py chunked_lm_xent); touching the module
@@ -428,9 +489,18 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float,
         )
         cache = updates["cache"]
         head = params["lm_head"]
-        last_logits = (
-            hidden[:, -1].astype(jnp.float32) @ head["kernel"] + head["bias"]
-        )
+        if "kernel_q" in head:  # int8_decode tree (quantize_decode_params)
+            from tf_operator_tpu.ops.int8_dense import int8_apply
+
+            last_logits = int8_apply(
+                hidden[:, -1], head["kernel_q"], head["scale"],
+                out_dtype=jnp.float32,
+            ) + head["bias"]
+        else:
+            last_logits = (
+                hidden[:, -1].astype(jnp.float32) @ head["kernel"]
+                + head["bias"]
+            )
 
         def sample(carry, step_rng):
             cache, logits = carry
@@ -450,6 +520,53 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float,
         return toks.swapaxes(0, 1)
 
     return jax.jit(run)
+
+
+def quantize_decode_params(params: Any) -> Any:
+    """Trained params tree -> the int8 tree ``int8_decode=True`` expects.
+
+    Every projection kernel (attention qkv/out, MLP in/out, lm_head)
+    becomes {kernel_q int8 [k, n], scale f32 [n], bias f32 [n]} via
+    symmetric per-output-channel quantization (ops/int8_dense.py);
+    embeddings, position table, and norms pass through untouched (they are
+    gathers / O(d) reads, not per-token full scans). MoE expert weights
+    pass through too (int8 MoE decode is not implemented). The decode
+    numerics contract is pinned by
+    tests/test_training.py::TestInt8Decode."""
+    from tf_operator_tpu.ops.int8_dense import quantize_int8
+
+    def quant(name: str, sub: dict) -> dict:
+        kern = sub["kernel"]
+        if name == "qkv":  # [d, 3, heads, head_dim] -> [d, 3*h*hd]
+            k2 = kern.reshape(kern.shape[0], -1)
+        elif name == "out":  # [heads, head_dim, d] -> [h*hd, d]
+            k2 = kern.reshape(-1, kern.shape[-1])
+        else:  # already [k, n]
+            k2 = kern
+        q, scale = quantize_int8(k2)
+        return {
+            "kernel_q": q, "scale": scale,
+            "bias": sub["bias"].reshape(-1).astype(jnp.float32),
+        }
+
+    targets = {"qkv", "out", "in_proj", "out_proj", "lm_head"}
+
+    def walk(tree: Any) -> Any:
+        out = {}
+        for name, sub in tree.items():
+            if (
+                name in targets
+                and isinstance(sub, dict)
+                and "kernel" in sub
+            ):
+                out[name] = quant(name, sub)
+            elif isinstance(sub, dict):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(params)
 
 
 def param_sharding_rules(tp_axis: str = "tp") -> dict[str, tuple]:

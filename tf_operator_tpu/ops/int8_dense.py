@@ -1,0 +1,133 @@
+"""Pallas int8 weight-only matmul: dequantize IN VMEM, halve decode HBM.
+
+Single-token decode is HBM-read-bound: every step re-reads every weight,
+so tokens/sec scales with bytes-per-weight. Naive int8 storage does NOT
+help — XLA hoists the int8->float convert out of the decode scan, so the
+loop carry holds full-precision weights and streams them every step (the
+round-3 negative result, docs/perf.md "Explored and rejected"). The fix
+is a kernel that reads the int8 weights from HBM itself and dequantizes
+in VMEM, where XLA cannot hoist: pallas pipelines [k, block_n] int8 tiles
+in, upcasts in-register, runs the MXU dot in bf16 with f32 accumulation,
+and scales the [m, block_n] output by the per-output-channel scale —
+halving decode weight traffic vs bf16 (4x vs f32).
+
+Quantization is symmetric per-output-channel (absmax / 127), the
+standard weight-only scheme: activations stay bf16, so the only numerics
+change is weight rounding (~0.4% RMS per channel).
+
+The reference contains no kernels at all (SURVEY.md §2.9); this op backs
+``TransformerConfig.int8_decode`` (models/transformer.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tf_operator_tpu.ops.flash_attention import on_tpu_backend
+
+_LANE = 128  # TPU lane width: last block dim must align to it
+
+
+def quantize_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a 2-D [k, n]
+    weight: returns (w_q int8 [k, n], scale f32 [n]) with
+    dequant(w_q, scale) = w_q * scale ~= w."""
+    if w.ndim != 2:
+        raise ValueError(f"quantize_int8 takes [k, n], got {w.shape}")
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
+    # One [m, block_n] output tile: full-k dot of bf16 activations against
+    # the int8 tile upcast HERE (in VMEM — the whole point), then the
+    # per-channel scale on the small output tile (cheaper than scaling
+    # the [k, block_n] weights, algebraically identical).
+    acc = jnp.dot(
+        x_ref[...], w_ref[...].astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = acc * s_ref[...]  # s_ref is [1, block_n]; broadcasts
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_n", "interpret", "out_dtype")
+)
+def int8_matmul(
+    x: jax.Array, w_q: jax.Array, scale: jax.Array, *,
+    block_n: int = 512, interpret: bool = False, out_dtype=jnp.float32,
+) -> jax.Array:
+    """x [m, k] (bf16/f32) @ dequant(w_q [k, n] int8, scale [n]) -> [m, n].
+
+    Grid over n tiles; each program holds x fully (decode m is small) and
+    one [k, block_n] int8 tile. f32 accumulation; ``out_dtype`` casts the
+    result (bf16 for hidden layers, f32 for the logits head).
+    """
+    m, k = x.shape
+    k2, n = w_q.shape
+    if k != k2 or scale.shape != (n,):
+        raise ValueError(f"shape mismatch: {x.shape} @ {w_q.shape}, "
+                         f"scale {scale.shape}")
+    bn = min(block_n, n)
+    if n % bn:
+        raise ValueError(f"n={n} not divisible by block_n={bn}")
+    out = pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda i: (0, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            # Scale rides as [1, n]: Mosaic tiles trailing dims, so a 2-D
+            # lane-aligned block beats a bare [n] vector.
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), w_q, scale.reshape(1, n))
+    return out.astype(out_dtype)
+
+
+def int8_matmul_xla(
+    x: jax.Array, w_q: jax.Array, scale: jax.Array, *, out_dtype=jnp.float32
+) -> jax.Array:
+    """XLA reference path (also the non-TPU fallback): numerically the
+    kernel's exact formula. Inside a decode scan XLA hoists the upcast
+    (full-precision weights in the carry — no traffic saving); correct,
+    just not the optimization."""
+    acc = jnp.dot(
+        x.astype(jnp.bfloat16), w_q.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return (acc * scale[None, :]).astype(out_dtype)
+
+
+def int8_apply(
+    x: jax.Array, w_q: jax.Array, scale: jax.Array, *, out_dtype=jnp.float32
+) -> jax.Array:
+    """Dispatch: Pallas kernel on TPU when n tiles to the lane width,
+    XLA formula otherwise. x may be [..., k]; output [..., n]."""
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, x.shape[-1])
+    k, n = w_q.shape
+    if on_tpu_backend() and n % _LANE == 0 and k % _LANE == 0:
+        # Mosaic's bf16 min tile is (16, 128): pad the (tiny) decode batch
+        # up to the sublane minimum and slice back — activation rows are
+        # KBs where the weights are MBs, so the pad is free.
+        m = x2.shape[0]
+        pad = (-m) % 16
+        if pad:
+            x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+        bn = 512 if n % 512 == 0 else _LANE
+        out = int8_matmul(x2, w_q, scale, block_n=bn, out_dtype=out_dtype)
+        if pad:
+            out = out[:m]
+    else:
+        out = int8_matmul_xla(x2, w_q, scale, out_dtype=out_dtype)
+    return out.reshape(*lead, n)
