@@ -151,6 +151,47 @@ def test_create_rejection_surfaces_message(dashboard):
     assert msg.get("message"), msg
 
 
+def test_clone_flow_wiring(dashboard):
+    """Clone/resubmit (round-4 dashboard polish): the detail view links to
+    #/clone/{ns}/{name}, the router fetches the source job and opens the
+    create form prefilled, and every spec field the form writes is also
+    read back on prefill (write/read drift fails here)."""
+    src = open(os.path.join(FRONTEND, "app.js")).read()
+    # Detail view offers the clone deep link.
+    detail = src[src.index("async function jobDetailView"):
+                 src.index("async function showLogs")]
+    assert "#/clone/" in detail
+    # Router handles it by fetching the job and prefilling the form.
+    router = src[src.index("async function route"):]
+    assert '"clone"' in router
+    assert "createView(d.tpujob)" in router
+    # Prefill reads every field the submit path writes.
+    create = src[src.index("async function createView"):
+                 src.index("// ---------- router")]
+    for field in ("cleanPodPolicy", "ttlSecondsAfterFinished",
+                  "scheduling", "replicaSpecs"):
+        assert f"prefill?.spec?.{field}" in create or (
+            f"prefill.spec.{field}" in create
+        ) or f"spec?.{field}" in create, field
+    card = src[src.index("function replicaSpecCard"):
+               src.index("async function createView")]
+    for marker in ("init.replicas", "c0.image", "c0.command", "c0.env",
+                   "init.restartPolicy", "init.tpu", "volumeMounts"):
+        assert marker in card, marker
+
+
+def test_detail_view_renders_volumes():
+    """The volumes card (reference-parity detail field): one row per
+    (role, volume) with hostPath source and container mount paths."""
+    src = open(os.path.join(FRONTEND, "app.js")).read()
+    detail = src[src.index("async function jobDetailView"):
+                 src.index("async function showLogs")]
+    assert '"Volumes"' in detail
+    assert "volumeMounts" in detail
+    assert "hostPath" in detail
+    assert '"Role", "Volume", "Source", "Mounts"' in detail
+
+
 def test_app_js_delimiters_balanced():
     """Cheap parse sanity: braces/brackets/parens balance outside strings,
     comments, and regex-free template literals."""

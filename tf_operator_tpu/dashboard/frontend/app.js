@@ -2,7 +2,9 @@
  *
  * Hash-routed views over /tpujobs/api (the reference's services.js REST
  * surface): #/ job list, #/job/{ns}/{name} detail with pods + events +
- * log viewer, #/create deploy form. Polls the list/detail every 3 s.
+ * volumes + log viewer, #/create deploy form, #/clone/{ns}/{name}
+ * deep-linkable clone/resubmit (create form prefilled from the existing
+ * job's spec). Polls the list/detail every 3 s.
  */
 "use strict";
 
@@ -149,23 +151,61 @@ async function jobDetailView(ns, name) {
   const restarts = job.status?.restartCount
     ? h("span", { class: "muted" }, ` restarts: ${job.status.restartCount}`)
     : null;
+  // Volumes across replica roles (parity: the reference detail view lists
+  // volume mounts): one row per (role, volume) with its container mounts.
+  const volRows = Object.entries(job.spec?.replicaSpecs || {}).flatMap(
+    ([role, rs]) => {
+      const tspec = rs.template?.spec || {};
+      const mountsByVol = {};
+      for (const c of tspec.containers || []) {
+        for (const vm of c.volumeMounts || []) {
+          (mountsByVol[vm.name] = mountsByVol[vm.name] || []).push(
+            `${c.name}:${vm.mountPath}`
+          );
+        }
+      }
+      return (tspec.volumes || []).map((v) =>
+        h(
+          "tr",
+          {},
+          h("td", {}, role),
+          h("td", {}, v.name),
+          h("td", { class: "muted" }, v.hostPath?.path || JSON.stringify({ ...v, name: undefined })),
+          h("td", { class: "muted" }, (mountsByVol[v.name] || []).join(" "))
+        )
+      );
+    }
+  );
   app.replaceChildren(
     h(
       "div",
       { class: "toolbar" },
       h("h2", {}, `${ns}/${name} `, phaseBadge(job), restarts),
       h(
-        "button",
-        {
-          class: "danger",
-          onclick: async () => {
-            if (confirm(`Delete TPUJob ${ns}/${name}?`)) {
-              await api(`/tpujob/${ns}/${name}`, { method: "DELETE" });
-              location.hash = "#/";
-            }
+        "span",
+        {},
+        h(
+          "button",
+          {
+            class: "ghost",
+            onclick: () => (location.hash = `#/clone/${ns}/${name}`),
           },
-        },
-        "Delete"
+          "Clone"
+        ),
+        " ",
+        h(
+          "button",
+          {
+            class: "danger",
+            onclick: async () => {
+              if (confirm(`Delete TPUJob ${ns}/${name}?`)) {
+                await api(`/tpujob/${ns}/${name}`, { method: "DELETE" });
+                location.hash = "#/";
+              }
+            },
+          },
+          "Delete"
+        )
       )
     ),
     h(
@@ -192,6 +232,17 @@ async function jobDetailView(ns, name) {
       )
     ),
     h("div", { class: "card" }, h("h2", {}, "Pods"), h("table", {}, h("tbody", {}, pods.length ? pods : h("tr", {}, h("td", { class: "muted" }, "none"))))),
+    h(
+      "div",
+      { class: "card" },
+      h("h2", {}, "Volumes"),
+      h(
+        "table",
+        {},
+        h("thead", {}, h("tr", {}, ...["Role", "Volume", "Source", "Mounts"].map((t) => h("th", {}, t)))),
+        h("tbody", {}, volRows.length ? volRows : h("tr", {}, h("td", { class: "muted", colspan: 4 }, "none")))
+      )
+    ),
     h("div", { class: "card" }, h("h2", {}, "Events"), h("table", {}, h("tbody", {}, events.length ? events : h("tr", {}, h("td", { class: "muted" }, "none"))))),
     h("div", { id: "log-panel" })
   );
@@ -254,12 +305,19 @@ function kvRows(title, fields) {
   return { el: h("div", { class: "kv-group" }, header, body), read, addRow };
 }
 
-function replicaSpecCard(onRemove) {
+function replicaSpecCard(onRemove, initType, initSpec) {
+  // initType/initSpec: prefill from an existing job's replicaSpecs entry
+  // (the clone/resubmit path); omitted = blank defaults.
+  const init = initSpec || {};
+  const c0 = init.template?.spec?.containers?.[0] || {};
   const typeSel = h("select", { "data-k": "type" }, ...REPLICA_TYPES.map((t) => h("option", { value: t }, t)));
-  const replicas = h("input", { "data-k": "replicas", type: "number", value: "2", min: "1" });
-  const image = h("input", { "data-k": "image", value: "tpu-operator/test-server" });
+  if (initType) typeSel.value = initType;
+  const replicas = h("input", { "data-k": "replicas", type: "number", value: String(init.replicas || 2), min: "1" });
+  const image = h("input", { "data-k": "image", value: c0.image || "tpu-operator/test-server" });
   const command = h("textarea", { "data-k": "command", placeholder: '["python", "train.py"] (JSON array, optional)' });
+  if (c0.command) command.value = JSON.stringify(c0.command);
   const restart = h("select", { "data-k": "restart" }, ...RESTART_POLICIES.map((p) => h("option", { value: p }, p)));
+  if (init.restartPolicy) restart.value = init.restartPolicy;
 
   // TPU slice picker: accelerator dropdown from the server catalog; the
   // topology/hosts readout updates live, numSlices enables DCN multislice.
@@ -286,6 +344,10 @@ function replicaSpecCard(onRemove) {
       ? `${opt.dataset.topology} topology · ${opt.dataset.hosts} pod(s)/slice × ${numSlices.value || 1} slice(s)`
       : "";
   };
+  if (init.tpu?.acceleratorType) {
+    accSel.value = init.tpu.acceleratorType;
+    if (init.tpu.numSlices) numSlices.value = String(init.tpu.numSlices);
+  }
   accSel.addEventListener("change", syncSlice);
   numSlices.addEventListener("input", syncSlice);
   syncSlice(); // initial state: numSlices disabled until a slice is chosen
@@ -299,6 +361,16 @@ function replicaSpecCard(onRemove) {
     { name: "hostPath", placeholder: "/host/path" },
     { name: "mountPath", placeholder: "/mount/path" },
   ]);
+  for (const e of c0.env || []) envRows.addRow({ name: e.name, value: e.value });
+  const mountByName = {};
+  for (const vm of c0.volumeMounts || []) mountByName[vm.name] = vm.mountPath;
+  for (const v of init.template?.spec?.volumes || []) {
+    volRows.addRow({
+      name: v.name,
+      hostPath: v.hostPath?.path || "",
+      mountPath: mountByName[v.name] || "",
+    });
+  }
 
   const card = h(
     "div",
@@ -348,7 +420,10 @@ function replicaSpecCard(onRemove) {
   return card;
 }
 
-async function createView() {
+async function createView(prefill) {
+  // prefill: an existing TPUJob object (clone/resubmit) — the form opens
+  // populated with its spec, name suffixed "-copy" (parity: the reference
+  // UI has no clone; kubectl users re-apply edited manifests).
   try {
     acceleratorCatalog = (await api("/accelerators")).items || [];
   } catch (e) {
@@ -359,18 +434,34 @@ async function createView() {
   const removeCard = (card) => {
     if (specsHost.children.length > 1) card.remove();
   };
-  specsHost.append(replicaSpecCard(removeCard));
+  const preSpecs = Object.entries(prefill?.spec?.replicaSpecs || {});
+  if (preSpecs.length) {
+    for (const [type, spec] of preSpecs) {
+      specsHost.append(replicaSpecCard(removeCard, type, spec));
+    }
+  } else {
+    specsHost.append(replicaSpecCard(removeCard));
+  }
 
-  const name = h("input", { name: "name", required: "", placeholder: "my-train-job" });
-  const namespace = h("input", { name: "namespace", value: "default" });
+  const name = h("input", {
+    name: "name", required: "", placeholder: "my-train-job",
+    value: prefill ? `${prefill.metadata.name}-copy` : "",
+  });
+  const namespace = h("input", {
+    name: "namespace", value: prefill?.metadata?.namespace || "default",
+  });
   const cleanPolicy = h(
     "select",
     {},
     ...["Running", "All", "None"].map((p) => h("option", { value: p }, p))
   );
+  if (prefill?.spec?.cleanPodPolicy) cleanPolicy.value = prefill.spec.cleanPodPolicy;
   const ttl = h("input", { type: "number", placeholder: "seconds (optional)", min: "0" });
+  if (prefill?.spec?.ttlSecondsAfterFinished != null) ttl.value = String(prefill.spec.ttlSecondsAfterFinished);
   const gang = h("input", { type: "checkbox" });
+  if (prefill?.spec?.scheduling?.gang) gang.checked = true;
   const scheduler = h("input", { placeholder: "scheduler name (optional)" });
+  if (prefill?.spec?.scheduling?.schedulerName) scheduler.value = prefill.spec.scheduling.schedulerName;
 
   const form = h(
     "form",
@@ -434,7 +525,14 @@ async function createView() {
       errBox.classList.remove("hidden");
     }
   });
-  app.replaceChildren(h("div", { class: "card" }, h("h2", {}, "Create TPUJob"), form));
+  app.replaceChildren(
+    h(
+      "div",
+      { class: "card" },
+      h("h2", {}, prefill ? `Clone TPUJob ${prefill.metadata.namespace}/${prefill.metadata.name}` : "Create TPUJob"),
+      form
+    )
+  );
 }
 
 // ---------- router ----------
@@ -458,6 +556,12 @@ async function route() {
     if (parts[0] === "create") {
       if (pollTimer) clearInterval(pollTimer);
       await createView();
+    } else if (parts[0] === "clone" && parts.length === 3) {
+      // Deep-linkable clone/resubmit: fetch the source job, open the
+      // create form prefilled with its spec.
+      if (pollTimer) clearInterval(pollTimer);
+      const d = await api(`/tpujob/${parts[1]}/${parts[2]}`);
+      await createView(d.tpujob);
     } else if (parts[0] === "job" && parts.length === 3) {
       await jobDetailView(parts[1], parts[2]);
       setPoll(() => jobDetailView(parts[1], parts[2]).catch(() => {}));
