@@ -151,7 +151,7 @@ def probe_input() -> None:
     )
 
 
-def _resnet_setup(stem: str | None = None):
+def _resnet_setup(stem: str | None = None, batch: int | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -162,13 +162,14 @@ def _resnet_setup(stem: str | None = None):
         TrainState, make_classifier_train_step, sgd_momentum,
     )
 
+    batch = batch or bench.BATCH
     mesh = create_mesh({"dp": len(jax.devices())}, jax.devices())
     stem = stem or os.environ.get("BENCH_STEM", "conv7")
     model = resnet50(dtype=jnp.bfloat16, stem=stem)
     x = jnp.zeros(
-        (bench.BATCH, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), jnp.bfloat16
+        (batch, bench.IMAGE_SIZE, bench.IMAGE_SIZE, 3), jnp.bfloat16
     )
-    y = jnp.zeros((bench.BATCH,), jnp.int32)
+    y = jnp.zeros((batch,), jnp.int32)
     variables = model.init(
         __import__("jax").random.PRNGKey(0), x, train=True
     )
@@ -216,10 +217,11 @@ def probe_fwd_split() -> None:
     )
 
 
-def _synthetic_rate(stem: str) -> float:
+def _synthetic_rate(stem: str, batch_size: int | None = None) -> float:
     from tf_operator_tpu.train.steps import fuse_steps
 
-    mesh, model, state, step, batch = _resnet_setup(stem)
+    batch_size = batch_size or bench.BATCH
+    mesh, model, state, step, batch = _resnet_setup(stem, batch_size)
     fused = fuse_steps(step, bench.FUSED_STEPS, donate=False)
     state2, metrics = fused(state, batch)
     float(metrics["loss"])  # compile + complete
@@ -228,13 +230,25 @@ def _synthetic_rate(stem: str) -> float:
         state2, metrics = fused(state2, batch)
     float(metrics["loss"])
     dt = time.perf_counter() - t0
-    return bench.MEASURE_CALLS * bench.FUSED_STEPS * bench.BATCH / dt
+    return bench.MEASURE_CALLS * bench.FUSED_STEPS * batch_size / dt
 
 
 def probe_synthetic() -> None:
-    emit("synthetic", images_per_sec=_synthetic_rate(
-        os.environ.get("BENCH_STEM", "conv7")
-    ))
+    """Device-resident ResNet train rate at the bench batch AND at 2x
+    batch (perf.md candidate: deeper MXU pipelines per conv at the cost
+    of HBM) — run b256 first so a dying tunnel still answers the primary
+    compute-vs-input split question."""
+    stem = os.environ.get("BENCH_STEM", "conv7")
+    base = _synthetic_rate(stem)
+    results = {"images_per_sec": base}
+    if not os.environ.get("BENCH_SMOKE"):
+        try:
+            results["images_per_sec_b2x"] = _synthetic_rate(
+                stem, 2 * bench.BATCH
+            )
+        except Exception as exc:  # noqa: BLE001 — 2x batch may OOM
+            results["b2x_error"] = repr(exc)[:120]
+    emit("synthetic", **results)
 
 
 def probe_stem() -> None:
@@ -316,6 +330,67 @@ def probe_flashsweep() -> None:
             bench.flash_model_flops(batch, seq) / dt / 1e12
         )
     emit("flashsweep", **results)
+
+
+def probe_convsweep() -> None:
+    """Per-shape conv rooflines — the HLO-level attribution for the ResNet
+    collapse (VERDICT r3: 'if convs are slow through this backend, show it
+    with an HLO-level probe'). Times each distinct ResNet-50 conv geometry
+    as its own jitted op (fwd only, bf16, bench batch), reporting achieved
+    TFLOP/s per shape. If the matmul roofline is healthy (111 TFLOP/s
+    chained) but these convs are not, the backend's conv path — not the
+    model, input, or transfer — owns the gap; a single slow outlier
+    instead names the shape to rewrite (as the s2d stem did for conv7)."""
+    import jax
+    import jax.numpy as jnp
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    batch = 8 if smoke else bench.BATCH
+    # (label, H=W input, Cin, Cout, kernel, stride) — ResNet-50's distinct
+    # conv classes at 224 input: the 7x7 stem, then each stage's 1x1
+    # reduce / 3x3 spatial / 1x1 expand at its resolution.
+    shapes = (
+        ("stem7x7", 224, 3, 64, 7, 2),
+        ("s1_3x3", 56, 64, 64, 3, 1),
+        ("s1_1x1e", 56, 64, 256, 1, 1),
+        ("s2_3x3", 28, 128, 128, 3, 1),
+        ("s2_1x1e", 28, 128, 512, 1, 1),
+        ("s3_3x3", 14, 256, 256, 3, 1),
+        ("s3_1x1e", 14, 256, 1024, 1, 1),
+        ("s4_3x3", 7, 512, 512, 3, 1),
+        ("s4_1x1e", 7, 512, 2048, 1, 1),
+    )
+    if smoke:
+        shapes = shapes[:2]
+    results = {}
+    for label, hw, cin, cout, k, stride in shapes:
+        x = jax.random.normal(
+            jax.random.PRNGKey(0), (batch, hw, hw, cin), jnp.bfloat16
+        )
+        w = jax.random.normal(
+            jax.random.PRNGKey(1), (k, k, cin, cout), jnp.bfloat16
+        )
+
+        @jax.jit
+        def conv(x, w, stride=stride):
+            out = jax.lax.conv_general_dilated(
+                x, w, (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32,
+            )
+            return out.astype(jnp.float32).sum()
+
+        try:
+            dt = min(bench.timed_reps(
+                lambda: float(conv(x, w)), reps=3, warmup=2
+            ))
+        except Exception as exc:  # noqa: BLE001 — per-shape isolation
+            results[f"{label}_error"] = repr(exc)[:120]
+            continue
+        out_hw = hw // stride
+        flops = 2 * batch * out_hw * out_hw * k * k * cin * cout
+        results[f"{label}_tflops"] = flops / dt / 1e12
+    emit("convsweep", batch=batch, **results)
 
 
 def probe_lmsweep() -> None:
@@ -450,6 +525,7 @@ def run_window() -> None:
     plan = [  # (probe, budget_s)
         ("roofline", 300.0),
         ("synthetic", 900.0),
+        ("convsweep", 600.0),
         ("flashramp", 600.0),
         ("flashblocks", 600.0),
         ("flashsweep", 900.0),
@@ -549,6 +625,7 @@ PROBES = {
     "fwd_split": probe_fwd_split,
     "synthetic": probe_synthetic,
     "stem": probe_stem,
+    "convsweep": probe_convsweep,
     "lmsweep": probe_lmsweep,
     "decodesweep": probe_decodesweep,
 }

@@ -1,0 +1,327 @@
+"""tpuctl — the kubectl-shaped CLI for the TPU job operator.
+
+The reference assumes kubectl for every user interaction (README.md:16-18:
+`kubectl apply` a TFJob, `kubectl get tfjobs`); this framework's apiserver
+speaks its own REST dialect, so a standalone deployment needs its own
+ctl. Commands mirror the kubectl verbs users already know:
+
+    tpuctl get jobs [-n NS]                 # table of TPUJobs
+    tpuctl get job NS/NAME [-o json]        # one job (table row or JSON)
+    tpuctl describe NS/NAME                 # conditions/replicas/pods/events
+    tpuctl apply -f job.json|yaml           # create (json or yaml, - = stdin)
+    tpuctl delete NS/NAME
+    tpuctl logs NS/POD                      # pod logs via the dashboard API
+    tpuctl wait NS/NAME [--for Succeeded] [--timeout 300]
+
+The server is ``--master`` / $TPU_OPERATOR_MASTER (default
+http://127.0.0.1:8080 — the operator's --serve address). Write auth rides
+$TPU_OPERATOR_API_TOKEN exactly as the client library does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+from tf_operator_tpu.client.tpujob_client import TPUJobClient
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.restclient import RestClusterClient
+
+DEFAULT_MASTER = os.environ.get(
+    "TPU_OPERATOR_MASTER", "http://127.0.0.1:8080"
+)
+
+
+def _age(ts: str | None) -> str:
+    import calendar
+
+    if not ts:
+        return "?"
+    try:
+        then = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return "?"
+    s = max(0, int(time.time() - then))
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if s >= div:
+            return f"{s // div}{unit}"
+    return f"{s}s"
+
+
+def _state(job: dict[str, Any]) -> str:
+    conds = [
+        c["type"] for c in job.get("status", {}).get("conditions", [])
+        if c.get("status") == "True"
+    ]
+    for top in ("Failed", "Succeeded", "Restarting", "Running", "Created"):
+        if top in conds:
+            return top
+    return "Pending"
+
+
+def _replicas(job: dict[str, Any]) -> str:
+    rs = job.get("status", {}).get("replicaStatuses", {})
+    return ",".join(
+        f"{t}:{s.get('active', 0)}/{s.get('succeeded', 0)}/{s.get('failed', 0)}"
+        for t, s in sorted(rs.items())
+    ) or "-"
+
+
+def _table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows)
+        for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header)]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def _split_ref(ref: str, what: str = "job") -> tuple[str, str]:
+    if "/" not in ref:
+        raise SystemExit(
+            f"tpuctl: {what} reference must be NAMESPACE/NAME, got {ref!r}"
+        )
+    ns, name = ref.split("/", 1)
+    return ns, name
+
+
+def cmd_get(args, client: TPUJobClient) -> int:
+    if args.kind in ("jobs", "tpujobs"):
+        jobs = client.list(args.namespace)
+        if args.output == "json":
+            print(json.dumps({"items": jobs}, indent=2))
+            return 0
+        rows = [
+            [
+                j["metadata"].get("namespace", ""),
+                j["metadata"].get("name", ""),
+                _state(j),
+                _replicas(j),
+                _age(j["metadata"].get("creationTimestamp")),
+            ]
+            for j in jobs
+        ]
+        print(_table(rows, ["NAMESPACE", "NAME", "STATE", "REPLICAS", "AGE"]))
+        return 0
+    if args.kind in ("job", "tpujob"):
+        ns, name = _split_ref(args.name or "", "job")
+        job = client.get(ns, name)
+        if args.output == "json":
+            print(json.dumps(job, indent=2))
+        else:
+            print(_table(
+                [[ns, name, _state(job), _replicas(job),
+                  _age(job["metadata"].get("creationTimestamp"))]],
+                ["NAMESPACE", "NAME", "STATE", "REPLICAS", "AGE"],
+            ))
+        return 0
+    if args.kind == "pods":
+        if args.name:  # pods of one job
+            ns, jname = _split_ref(args.name, "job")
+            pods = client.get_pods(ns, jname)
+        else:
+            pods = client._client.list(objects.PODS, args.namespace)  # noqa: SLF001
+        rows = [
+            [
+                p["metadata"].get("namespace", ""),
+                p["metadata"].get("name", ""),
+                p.get("status", {}).get("phase", "?"),
+                _age(p["metadata"].get("creationTimestamp")),
+            ]
+            for p in pods
+        ]
+        if args.output == "json":
+            print(json.dumps({"items": pods}, indent=2))
+        else:
+            print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "AGE"]))
+        return 0
+    raise SystemExit(f"tpuctl: unknown kind {args.kind!r} "
+                     "(expected jobs|job|pods)")
+
+
+def cmd_describe(args, client: TPUJobClient) -> int:
+    ns, name = _split_ref(args.ref)
+    job = client.get(ns, name)
+    print(f"Name:       {name}")
+    print(f"Namespace:  {ns}")
+    print(f"State:      {_state(job)}")
+    st = job.get("status", {})
+    if st.get("restartCount"):
+        print(f"Restarts:   {st['restartCount']}")
+    for label, key in (("Started", "startTime"),
+                       ("Completed", "completionTime")):
+        if st.get(key):
+            print(f"{label}:    {st[key]}")
+    print("\nConditions:")
+    conds = st.get("conditions", [])
+    if conds:
+        print(_table(
+            [[c.get("type", ""), c.get("status", ""), c.get("reason", ""),
+              c.get("message", "")[:60]] for c in conds],
+            ["TYPE", "STATUS", "REASON", "MESSAGE"],
+        ))
+    else:
+        print("  none")
+    print("\nReplica statuses:")
+    rs = st.get("replicaStatuses", {})
+    if rs:
+        print(_table(
+            [[t, s.get("active", 0), s.get("succeeded", 0),
+              s.get("failed", 0)] for t, s in sorted(rs.items())],
+            ["ROLE", "ACTIVE", "SUCCEEDED", "FAILED"],
+        ))
+    else:
+        print("  none")
+    pods = client.get_pods(ns, name)
+    print("\nPods:")
+    if pods:
+        print(_table(
+            [[p["metadata"]["name"], p.get("status", {}).get("phase", "?")]
+             for p in pods],
+            ["NAME", "PHASE"],
+        ))
+    else:
+        print("  none")
+    events = client.get_events(ns, name)
+    print("\nEvents (last 15):")
+    if events:
+        print(_table(
+            [[e.get("type", ""), e.get("reason", ""),
+              e.get("message", "")[:70]] for e in events[-15:]],
+            ["TYPE", "REASON", "MESSAGE"],
+        ))
+    else:
+        print("  none")
+    return 0
+
+
+def _load_manifest(path: str) -> dict[str, Any]:
+    raw = sys.stdin.read() if path == "-" else open(path).read()
+    stripped = raw.lstrip()
+    if stripped.startswith("{"):
+        return json.loads(raw)
+    import yaml
+
+    docs = [d for d in yaml.safe_load_all(raw) if d]
+    if len(docs) != 1:
+        raise SystemExit(
+            f"tpuctl: expected exactly one TPUJob document, got {len(docs)}"
+        )
+    return docs[0]
+
+
+def cmd_apply(args, client: TPUJobClient) -> int:
+    job = _load_manifest(args.filename)
+    if job.get("kind") != "TPUJob":
+        raise SystemExit(
+            f"tpuctl: manifest kind {job.get('kind')!r} is not TPUJob"
+        )
+    created = client.create(job)
+    m = created["metadata"]
+    print(f"tpujob {m['namespace']}/{m['name']} created")
+    return 0
+
+
+def cmd_delete(args, client: TPUJobClient) -> int:
+    ns, name = _split_ref(args.ref)
+    client.delete(ns, name)
+    print(f"tpujob {ns}/{name} deleted")
+    return 0
+
+
+def cmd_logs(args, master: str) -> int:
+    ns, pod = _split_ref(args.ref, "pod")
+    url = f"{master.rstrip('/')}/tpujobs/api/pod/{ns}/{pod}/logs"
+    req = urllib.request.Request(url)
+    token = os.environ.get("TPU_OPERATOR_API_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+    except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+        raise SystemExit(
+            f"tpuctl: logs unavailable ({e.code}) — is the operator running "
+            "with --dashboard?"
+        ) from None
+    sys.stdout.write(body.get("logs") or "(no logs)\n")
+    return 0
+
+
+def cmd_wait(args, client: TPUJobClient) -> int:
+    ns, name = _split_ref(args.ref)
+    if args.condition == "Deleted":
+        client.wait_for_delete(ns, name, timeout=args.timeout)
+        print(f"tpujob {ns}/{name} deleted")
+        return 0
+    got = client.wait_for_condition(
+        ns, name, (args.condition,), timeout=args.timeout
+    )
+    print(f"tpujob {ns}/{name}: {_state(got)}")
+    # Waiting for Succeeded but landing on Failed is an error exit, so
+    # scripts can `tpuctl wait ... --for Succeeded && next-step`.
+    return 0 if _state(got) == args.condition or (
+        args.condition not in ("Succeeded", "Failed")
+    ) else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpuctl", description=__doc__)
+    p.add_argument("--master", default=DEFAULT_MASTER,
+                   help=f"operator API URL (default {DEFAULT_MASTER})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    g = sub.add_parser("get", help="list/get jobs or pods")
+    g.add_argument("kind", help="jobs | job | pods | tpujob(s)")
+    g.add_argument("name", nargs="?", default=None,
+                   help="NS/NAME (for `get job`; job selector for pods)")
+    g.add_argument("-n", "--namespace", default=None)
+    g.add_argument("-o", "--output", choices=("table", "json"),
+                   default="table")
+
+    d = sub.add_parser("describe", help="show a job in detail")
+    d.add_argument("ref", help="NAMESPACE/NAME")
+
+    a = sub.add_parser("apply", help="create a TPUJob from a manifest")
+    a.add_argument("-f", "--filename", required=True,
+                   help="json or yaml manifest (- = stdin)")
+
+    rm = sub.add_parser("delete", help="delete a job")
+    rm.add_argument("ref", help="NAMESPACE/NAME")
+
+    lg = sub.add_parser("logs", help="pod logs (via the dashboard API)")
+    lg.add_argument("ref", help="NAMESPACE/POD")
+
+    w = sub.add_parser("wait", help="block until a job condition")
+    w.add_argument("ref", help="NAMESPACE/NAME")
+    w.add_argument("--for", dest="condition", default="Succeeded",
+                   help="Succeeded | Failed | Running | Created | Deleted")
+    w.add_argument("--timeout", type=float, default=300.0)
+
+    args = p.parse_args(argv)
+    if args.cmd == "logs":
+        return cmd_logs(args, args.master)
+    client = TPUJobClient(RestClusterClient(args.master))
+    try:
+        return {
+            "get": cmd_get,
+            "describe": cmd_describe,
+            "apply": cmd_apply,
+            "delete": cmd_delete,
+            "wait": cmd_wait,
+        }[args.cmd](args, client)
+    except TimeoutError as e:
+        print(f"tpuctl: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,7 @@ PROBE_TIMEOUT_S = 45.0
 STAGES = [
     ("roofline", {"PROBE": "roofline"}, 300.0),
     ("synthetic", {"PROBE": "synthetic"}, 900.0),
+    ("convsweep", {"PROBE": "convsweep"}, 600.0),
     ("flashramp", {"PROBE": "flashramp"}, 600.0),
     ("flashblocks", {"PROBE": "flashblocks"}, 600.0),
     ("bench_full", None, 3600.0),
