@@ -95,6 +95,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel decode over this many devices")
+    p.add_argument("--int8", action="store_true",
+                   help="weight-only int8 decode: quantize projections "
+                        "after load (Pallas dequant-in-VMEM on TPU — "
+                        "halves per-token weight reads; ops/int8_dense.py)")
     p.add_argument("--requests", type=int, default=None,
                    help="exit 0 after serving this many /generate calls "
                         "(job mode); default: run until SIGTERM")
@@ -147,6 +151,17 @@ def main(argv: list[str] | None = None) -> int:
         params = shard_params_by_rules(mesh, params, param_sharding_rules())
         print(f"serve_lm: params tp-sharded over {args.tp} devices",
               flush=True)
+    if args.int8:
+        if args.tp > 1:
+            p.error("--int8 with --tp > 1 is not supported (the int8 "
+                    "kernel has no SPMD partitioning rule)")
+        from dataclasses import replace
+
+        from tf_operator_tpu.models.transformer import quantize_decode_params
+
+        params = quantize_decode_params(params)
+        cfg = replace(cfg, int8_decode=True)
+        print("serve_lm: projections quantized to int8", flush=True)
 
     served = 0
     done = threading.Event()
