@@ -351,46 +351,68 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
         generate,
     )
 
+    from dataclasses import replace
+
+    from tf_operator_tpu.models.transformer import quantize_decode_params
+
     B, prompt_len, steps = DECODE_BATCH, DECODE_PROMPT, DECODE_STEPS
     total_steps = prompt_len + steps
     cfg_kw = dict(LM_SIZE, max_seq_len=total_steps)
     cfg = TransformerConfig(dtype=jnp.bfloat16, **cfg_kw)
     model = Transformer(cfg)
     prompt = jnp.zeros((B, prompt_len), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    params0 = model.init(jax.random.PRNGKey(0), prompt)["params"]
     # Store params in bf16: decode reads every weight per token, and f32
     # storage would double the traffic just to cast it down for the MXU.
-    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-    params_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    params_bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params0)
     # Each step's attention reads the full (static-shape) K and V buffers.
     kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
 
-    def call():
-        out = generate(cfg, params, prompt, num_steps=steps)
-        int(out[0, -1])  # readback = completion
-
-    times = timed_reps(call, reps=2, warmup=2)
-    dt = min(times)
-
-    # Headline counts GENERATED tokens only (prefill wall time stays in dt
-    # — the conservative convention decode benchmarks use). Prefill is one
-    # batched forward (models/transformer.py generate), so the bandwidth
-    # roofline counts one weight read for it plus a full weight + KV-cache
-    # read per generated token.
-    tokens_per_sec = B * steps / dt
-    achieved_gbps = (
-        (params_bytes + kv_bytes) * steps + params_bytes
-    ) / dt / 1e9
-    emit(
-        f"lm_decode_gen_tokens_per_sec_bf16_b{B}_1chip",
-        tokens_per_sec,
-        "tokens/sec",
-        achieved_gbps / peak_hbm_gbps if peak_hbm_gbps else 0.0,
-        hbm_gbps=achieved_gbps,
-        mean_seconds_per_call=sum(times) / len(times),
-        prompt_len=prompt_len,
-        params_millions=params_bytes / 2 / 1e6,
+    # bf16 first (the established headline), then the int8 weight-only
+    # leg (Pallas dequant-in-VMEM — ops/int8_dense.py): projections at 1
+    # byte/weight, so the weight-read-bound step should approach 2x.
+    legs = (
+        ("bf16", cfg, params_bf16),
+        ("int8", replace(cfg, int8_decode=True),
+         quantize_decode_params(params_bf16)),
     )
+    for label, leg_cfg, params in legs:
+        leaves = jax.tree.leaves(params)
+        params_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+        n_params = sum(x.size for x in leaves)
+
+        def call(leg_cfg=leg_cfg, params=params):
+            out = generate(leg_cfg, params, prompt, num_steps=steps)
+            int(out[0, -1])  # readback = completion
+
+        try:
+            times = timed_reps(call, reps=2, warmup=2)
+        except Exception as exc:  # noqa: BLE001 — int8 must not kill bf16 line
+            print(f"bench: decode {label} leg failed: {exc!r}",
+                  file=sys.stderr, flush=True)
+            continue
+        dt = min(times)
+
+        # Headline counts GENERATED tokens only (prefill wall time stays
+        # in dt — the conservative convention decode benchmarks use).
+        # Prefill is one batched forward (models/transformer.py generate),
+        # so the bandwidth roofline counts one weight read for it plus a
+        # full weight + KV-cache read per generated token.
+        tokens_per_sec = B * steps / dt
+        achieved_gbps = (
+            (params_bytes + kv_bytes) * steps + params_bytes
+        ) / dt / 1e9
+        emit(
+            f"lm_decode_gen_tokens_per_sec_{label}_b{B}_1chip",
+            tokens_per_sec,
+            "tokens/sec",
+            achieved_gbps / peak_hbm_gbps if peak_hbm_gbps else 0.0,
+            hbm_gbps=achieved_gbps,
+            mean_seconds_per_call=sum(times) / len(times),
+            prompt_len=prompt_len,
+            params_millions=n_params / 1e6,
+            params_mb=params_bytes / 1e6,
+        )
 
 
 def ensure_bench_records() -> tuple[str, int, int]:
