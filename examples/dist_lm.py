@@ -11,6 +11,11 @@ over a dp x sp x tp mesh spanning every process:
   around the ring, so context length scales with the mesh, not the chip.
 - tp > 1 shards attention heads / MLP hidden / vocab (Megatron pairing,
   models/transformer.py param_sharding_rules).
+- --moe-every-n swaps every Nth block's MLP for a routed expert MLP
+  (Switch / GShard top-2, models/moe.py) with the load-balancing aux
+  loss in the train step; --ep > 1 shards the experts over an
+  expert-parallel mesh axis (the dispatch/combine einsums become
+  GSPMD all-to-alls).
 - The loss is the chunked cross-entropy (train/steps.py): logits never
   materialize at [B,S,V]; under sp/tp it is the vocab-parallel
   sharded_lm_xent.
@@ -53,6 +58,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="ring attention implementation: stream (autodiff, "
                         "supports kv chunking) or flash (custom-VJP "
                         "second-ring backward, Pallas blocks on TPU)")
+    p.add_argument("--moe-every-n", type=int, default=None,
+                   help="swap every Nth block's MLP for a routed expert "
+                        "MLP (models/moe.py); enables the MoE path")
+    p.add_argument("--moe-experts", type=int, default=8)
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="1 = Switch, 2 = GShard top-2")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel mesh axis (experts sharded over "
+                        "it; requires --moe-every-n)")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (gradients "
                         "averaged inside one jitted step; the global "
@@ -96,10 +110,17 @@ def main(argv: list[str] | None = None) -> int:
 
     devices = jax.devices()
     n = len(devices)
-    if n % (args.sp * args.tp):
-        raise SystemExit(f"{n} devices not divisible by sp*tp="
-                         f"{args.sp * args.tp}")
-    axes = {"dp": n // (args.sp * args.tp), "sp": args.sp, "tp": args.tp}
+    if args.ep > 1 and not args.moe_every_n:
+        raise SystemExit("--ep requires --moe-every-n")
+    if args.moe_every_n and args.moe_experts % args.ep:
+        raise SystemExit("--moe-experts must be a multiple of --ep")
+    if n % (args.sp * args.tp * args.ep):
+        raise SystemExit(f"{n} devices not divisible by sp*tp*ep="
+                         f"{args.sp * args.tp * args.ep}")
+    axes = {"dp": n // (args.sp * args.tp * args.ep),
+            "sp": args.sp, "tp": args.tp}
+    if args.ep > 1:
+        axes["ep"] = args.ep
     print(
         f"dist_lm: process {topo.process_id}/{topo.num_processes}, "
         f"mesh {axes}", flush=True,
@@ -126,21 +147,35 @@ def main(argv: list[str] | None = None) -> int:
     else:
         chunk = local_seq // 2 if local_seq % 2 == 0 else local_seq
 
+    moe_kw = {}
+    if args.moe_every_n:
+        moe_kw = dict(
+            moe_every_n=args.moe_every_n, moe_experts=args.moe_experts,
+            moe_top_k=args.moe_top_k,
+        )
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
         n_layers=args.layers, d_ff=args.d_model * 2,
         max_seq_len=args.seq, dtype=jnp.float32, mesh=mesh,
-        remat=args.remat, ring_impl=args.ring_impl,
+        remat=args.remat, ring_impl=args.ring_impl, **moe_kw,
     )
     model = Transformer(cfg)
     tokens0 = jnp.zeros((args.batch, args.seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
-    params = shard_params_by_rules(mesh, params, param_sharding_rules())
+    rules = dict(param_sharding_rules())
+    if args.ep > 1:  # expert weights split on the expert dim over "ep"
+        from tf_operator_tpu.models.moe import moe_param_sharding_rules
+
+        rules.update(moe_param_sharding_rules())
+    params = shard_params_by_rules(mesh, params, rules)
     tx = adamw(args.lr)
     state = TrainState.create(params, tx)
     step = make_lm_train_step(
         model, tx, mesh, donate=False, xent_chunk=chunk,
         grad_accum=args.grad_accum,
+        # Load-balancing aux loss: only meaningful (and only sown) on the
+        # MoE path.
+        aux_loss_weight=0.01 if args.moe_every_n else 0.0,
     )
 
     ckpt = None
