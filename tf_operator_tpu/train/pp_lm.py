@@ -1,0 +1,192 @@
+"""Pipeline-parallel transformer LM training (GPipe over the block stack).
+
+Completes the parallelism matrix at the model level: dp/sp/tp/ep run
+through the Transformer directly (models/transformer.py), and pipeline
+parallelism previously existed only for generic homogeneous stages
+(parallel/pipeline.py). Here the transformer's own block stack becomes
+the pipeline:
+
+- embed + positions run OUTSIDE the pipeline (cheap, O(B*T*d), GSPMD
+  dp-sharded), as does the final norm + chunked-xent head — so the
+  pipelined stages are perfectly homogeneous (pp stages x k blocks each),
+  which is what `stack_stage_params` / `pipeline_apply` require.
+- each stage applies its k blocks with a `lax.scan` over stacked block
+  params; activations hop stages via ppermute inside shard_map
+  (pipeline.py's schedule), composing with dp on the microbatch dim.
+- the backward is autodiff through scan + ppermute — the reverse
+  pipeline schedule for free, grads summed over dp by shard_map.
+
+The reference has no model parallelism at all (SURVEY.md §2.9); this is
+TPU-native capability on top of parity. Exercised multi-process by
+`__graft_entry__.dryrun_multichip` (pp path) and pinned against the
+plain Transformer forward in tests/test_moe_pipeline.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.models.transformer import Block, TransformerConfig
+from tf_operator_tpu.parallel.pipeline import (
+    microbatch,
+    pipeline_apply,
+    stack_stage_params,
+    unmicrobatch,
+)
+from tf_operator_tpu.train.steps import chunked_lm_xent
+
+OUTER_KEYS = ("embed", "pos", "RMSNorm_0", "lm_head")
+
+
+def split_pp_params(params: Any, n_layers: int, pp: int) -> tuple[Any, Any]:
+    """Standard Transformer param tree -> (outer, stages).
+
+    outer: embed/pos/final-norm/head subtrees, unchanged.
+    stages: block params stacked to leaves [pp, k, ...] (stage-major,
+    layer order preserved: stage s holds blocks s*k .. s*k+k-1).
+    """
+    if n_layers % pp:
+        raise ValueError(f"n_layers={n_layers} not divisible by pp={pp}")
+    k = n_layers // pp
+    missing = [f"block_{i}" for i in range(n_layers) if f"block_{i}" not in params]
+    if missing:
+        raise ValueError(f"params missing {missing}")
+    outer = {key: params[key] for key in OUTER_KEYS}
+    stage_trees = []
+    for s in range(pp):
+        blocks = [params[f"block_{s * k + j}"] for j in range(k)]
+        stage_trees.append(jax.tree.map(lambda *xs: jnp.stack(xs), *blocks))
+    return outer, stack_stage_params(stage_trees)
+
+
+def merge_pp_params(outer: Any, stages: Any, n_layers: int) -> Any:
+    """(outer, stages) -> the standard Transformer tree (for checkpoints
+    / serving / decode interop)."""
+    leaves = jax.tree.leaves(stages)
+    pp = leaves[0].shape[0] if leaves else 1
+    k = n_layers // pp
+    params = dict(outer)
+    for s in range(pp):
+        stage = jax.tree.map(lambda a, s=s: a[s], stages)
+        for j in range(k):
+            params[f"block_{s * k + j}"] = jax.tree.map(
+                lambda a, j=j: a[j], stage
+            )
+    return params
+
+
+def _stage_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    # Inside shard_map each stage is single-device code: the Block must
+    # take the plain attention path (no nested mesh logic).
+    return replace(cfg, mesh=None, remat=False)
+
+
+def make_pp_lm_forward(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    *,
+    num_micro: int,
+    pp_axis: str = "pp",
+    batch_axis: str | None = "dp",
+    xent_chunk: int | None = None,
+):
+    """Returns loss_fn((outer, stages), tokens, targets) -> scalar loss.
+
+    The full pipelined forward + chunked-xent loss, differentiable in
+    both param trees.
+    """
+    scfg = _stage_cfg(cfg)
+    block = Block(scfg)
+    data_axis = (
+        batch_axis if batch_axis and mesh.shape.get(batch_axis, 1) > 1
+        else None
+    )
+
+    def stage_fn(p_stage, x):
+        # p_stage leaves: [k, ...] — this stage's blocks, applied in order.
+        def body(x, block_p):
+            return block.apply({"params": block_p}, x), None
+
+        out, _ = jax.lax.scan(body, x, p_stage)
+        return out
+
+    def loss_fn(pp_params, tokens, targets):
+        outer, stages = pp_params["outer"], pp_params["stages"]
+        B, T = tokens.shape
+        x = jnp.take(
+            outer["embed"]["embedding"], tokens, axis=0
+        ).astype(cfg.dtype)
+        pos = outer["pos"]["embedding"][jnp.arange(T)][None, :, :]
+        x = x + pos.astype(cfg.dtype)
+        out = pipeline_apply(
+            stage_fn, stages, microbatch(x, num_micro), mesh,
+            axis=pp_axis, batch_axis=data_axis,
+        )
+        y = unmicrobatch(out)
+        y = nn.RMSNorm(dtype=cfg.dtype).apply(
+            {"params": outer["RMSNorm_0"]}, y
+        )
+        head = outer["lm_head"]
+        return chunked_lm_xent(
+            y, head["kernel"], head["bias"], targets,
+            chunk=xent_chunk or min(512, T),
+        )
+
+    return loss_fn
+
+
+def pp_param_shardings(mesh: Mesh, pp_params: Any,
+                       pp_axis: str = "pp") -> Any:
+    """Placement tree: stage params sharded over ``pp_axis`` on the stage
+    dim, outer params replicated."""
+    return {
+        "outer": jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), pp_params["outer"]
+        ),
+        "stages": jax.tree.map(
+            lambda _: NamedSharding(mesh, P(pp_axis)), pp_params["stages"]
+        ),
+    }
+
+
+def make_pp_lm_train_step(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    tx,
+    *,
+    num_micro: int,
+    pp_axis: str = "pp",
+    batch_axis: str | None = "dp",
+    xent_chunk: int | None = None,
+):
+    """Jitted (state, batch) -> (state, metrics) for the pipelined LM.
+
+    ``state.params`` is {"outer": ..., "stages": ...} (build with
+    ``split_pp_params``; place with ``pp_param_shardings``).
+    """
+    loss_fn = make_pp_lm_forward(
+        cfg, mesh, num_micro=num_micro, pp_axis=pp_axis,
+        batch_axis=batch_axis, xent_chunk=xent_chunk,
+    )
+
+    import optax
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch["tokens"], batch["targets"]
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=params, opt_state=opt_state
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(step)
