@@ -248,3 +248,29 @@ def test_tpuctl_rejects_bad_input(operator_proc, tmp_path):
     bad.write_text("kind: ConfigMap\nmetadata: {name: x}\n")
     with pytest.raises(SystemExit, match="not TPUJob"):
         tpuctl.main(["--master", base, "apply", "-f", str(bad)])
+
+
+def test_tpuctl_watch_streams_updates(operator_proc, capsys):
+    base, _ = operator_proc
+    import threading
+
+    from tf_operator_tpu.cli import tpuctl
+
+    job = synthetic_job(
+        "watch-e2e", "default", workers=1, accelerator=None, scheduler=None,
+        command=[sys.executable, "-c", "import time; time.sleep(0.3)"],
+    )
+
+    def submit():
+        time.sleep(0.5)
+        TPUJobClient(RestClusterClient(base)).create(job)
+
+    t = threading.Thread(target=submit)
+    t.start()
+    rc = tpuctl.main(["--master", base, "get", "jobs", "-n", "default",
+                      "-w", "--watch-events", "2"])
+    t.join()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "watch-e2e" in out
+    TPUJobClient(RestClusterClient(base)).delete("default", "watch-e2e")

@@ -93,23 +93,43 @@ def _split_ref(ref: str, what: str = "job") -> tuple[str, str]:
     return ns, name
 
 
+def _job_row(j: dict[str, Any]) -> list[str]:
+    return [
+        j["metadata"].get("namespace", ""),
+        j["metadata"].get("name", ""),
+        _state(j),
+        _replicas(j),
+        _age(j["metadata"].get("creationTimestamp")),
+    ]
+
+
 def cmd_get(args, client: TPUJobClient) -> int:
     if args.kind in ("jobs", "tpujobs"):
         jobs = client.list(args.namespace)
         if args.output == "json":
             print(json.dumps({"items": jobs}, indent=2))
             return 0
-        rows = [
-            [
-                j["metadata"].get("namespace", ""),
-                j["metadata"].get("name", ""),
-                _state(j),
-                _replicas(j),
-                _age(j["metadata"].get("creationTimestamp")),
-            ]
-            for j in jobs
-        ]
+        rows = [_job_row(j) for j in jobs]
         print(_table(rows, ["NAMESPACE", "NAME", "STATE", "REPLICAS", "AGE"]))
+        if args.watch:
+            # kubectl -w semantics: stream one row per update event until
+            # interrupted (or --watch-events N for scripts/tests).
+            w = client._client.watch(  # noqa: SLF001 — raw watch surface
+                objects.TPUJOBS, args.namespace or "default"
+            )
+            seen = 0
+            try:
+                while args.watch_events is None or seen < args.watch_events:
+                    ev = w.next(timeout=1.0)
+                    if ev is None:
+                        continue
+                    print(_table([_job_row(ev.object)],
+                                 ["", "", "", "", ""]).splitlines()[1])
+                    seen += 1
+            except KeyboardInterrupt:
+                pass
+            finally:
+                client._client.stop_watch(w)  # noqa: SLF001
         return 0
     if args.kind in ("job", "tpujob"):
         ns, name = _split_ref(args.name or "", "job")
@@ -286,6 +306,10 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("-n", "--namespace", default=None)
     g.add_argument("-o", "--output", choices=("table", "json"),
                    default="table")
+    g.add_argument("-w", "--watch", action="store_true",
+                   help="after listing, stream update rows (kubectl -w)")
+    g.add_argument("--watch-events", type=int, default=None,
+                   help="with -w: exit after N events (for scripts)")
 
     d = sub.add_parser("describe", help="show a job in detail")
     d.add_argument("ref", help="NAMESPACE/NAME")
