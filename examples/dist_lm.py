@@ -16,6 +16,9 @@ over a dp x sp x tp mesh spanning every process:
   loss in the train step; --ep > 1 shards the experts over an
   expert-parallel mesh axis (the dispatch/combine einsums become
   GSPMD all-to-alls).
+- --pp > 1 pipelines the block stack as GPipe stages (train/pp_lm.py)
+  over a pp x dp mesh — microbatches hop stages via ppermute; composes
+  with checkpoint/resume (the pipelined param tree checkpoints whole).
 - The loss is the chunked cross-entropy (train/steps.py): logits never
   materialize at [B,S,V]; under sp/tp it is the vocab-parallel
   sharded_lm_xent.
@@ -67,6 +70,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel mesh axis (experts sharded over "
                         "it; requires --moe-every-n)")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel stages (train/pp_lm.py: the "
+                        "block stack as GPipe stages; requires sp=tp=ep=1 "
+                        "and layers divisible by pp)")
+    p.add_argument("--pp-microbatches", type=int, default=2,
+                   help="microbatches per step on the --pp path")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (gradients "
                         "averaged inside one jitted step; the global "
@@ -114,13 +123,25 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("--ep requires --moe-every-n")
     if args.moe_every_n and args.moe_experts % args.ep:
         raise SystemExit("--moe-experts must be a multiple of --ep")
-    if n % (args.sp * args.tp * args.ep):
-        raise SystemExit(f"{n} devices not divisible by sp*tp*ep="
-                         f"{args.sp * args.tp * args.ep}")
-    axes = {"dp": n // (args.sp * args.tp * args.ep),
+    if args.pp > 1:
+        if args.sp > 1 or args.tp > 1 or args.ep > 1 or args.moe_every_n:
+            raise SystemExit("--pp composes with dp only (sp/tp/ep/moe "
+                             "must be off)")
+        if args.layers % args.pp:
+            raise SystemExit("--layers must be divisible by --pp")
+        if args.data or args.grad_accum != 1:
+            raise SystemExit("--pp path: no --data, --grad-accum must be 1")
+        if args.batch % args.pp_microbatches:
+            raise SystemExit("--batch must divide by --pp-microbatches")
+    if n % (args.sp * args.tp * args.ep * args.pp):
+        raise SystemExit(f"{n} devices not divisible by sp*tp*ep*pp="
+                         f"{args.sp * args.tp * args.ep * args.pp}")
+    axes = {"dp": n // (args.sp * args.tp * args.ep * args.pp),
             "sp": args.sp, "tp": args.tp}
     if args.ep > 1:
         axes["ep"] = args.ep
+    if args.pp > 1:
+        axes["pp"] = args.pp
     print(
         f"dist_lm: process {topo.process_id}/{topo.num_processes}, "
         f"mesh {axes}", flush=True,
@@ -156,27 +177,48 @@ def main(argv: list[str] | None = None) -> int:
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
         n_layers=args.layers, d_ff=args.d_model * 2,
-        max_seq_len=args.seq, dtype=jnp.float32, mesh=mesh,
+        max_seq_len=args.seq, dtype=jnp.float32,
+        # The pp path's pipeline shard_maps itself; mesh-aware blocks are
+        # for the dp/sp/tp/ep path.
+        mesh=None if args.pp > 1 else mesh,
         remat=args.remat, ring_impl=args.ring_impl, **moe_kw,
     )
     model = Transformer(cfg)
     tokens0 = jnp.zeros((args.batch, args.seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
-    rules = dict(param_sharding_rules())
-    if args.ep > 1:  # expert weights split on the expert dim over "ep"
-        from tf_operator_tpu.models.moe import moe_param_sharding_rules
-
-        rules.update(moe_param_sharding_rules())
-    params = shard_params_by_rules(mesh, params, rules)
     tx = adamw(args.lr)
-    state = TrainState.create(params, tx)
-    step = make_lm_train_step(
-        model, tx, mesh, donate=False, xent_chunk=chunk,
-        grad_accum=args.grad_accum,
-        # Load-balancing aux loss: only meaningful (and only sown) on the
-        # MoE path.
-        aux_loss_weight=0.01 if args.moe_every_n else 0.0,
-    )
+    if args.pp > 1:
+        from tf_operator_tpu.train.pp_lm import (
+            make_pp_lm_train_step,
+            pp_param_shardings,
+            split_pp_params,
+        )
+
+        from tf_operator_tpu.train.pp_lm import place_pp_state
+
+        outer, stages = split_pp_params(params, args.layers, args.pp)
+        pp_tree = {"outer": outer, "stages": stages}
+        pp_tree = jax.device_put(pp_tree, pp_param_shardings(mesh, pp_tree))
+        state = place_pp_state(mesh, TrainState.create(pp_tree, tx))
+        step = make_pp_lm_train_step(
+            cfg, mesh, tx, num_micro=args.pp_microbatches,
+            xent_chunk=chunk,
+        )
+    else:
+        rules = dict(param_sharding_rules())
+        if args.ep > 1:  # expert weights split on the expert dim over "ep"
+            from tf_operator_tpu.models.moe import moe_param_sharding_rules
+
+            rules.update(moe_param_sharding_rules())
+        params = shard_params_by_rules(mesh, params, rules)
+        state = TrainState.create(params, tx)
+        step = make_lm_train_step(
+            model, tx, mesh, donate=False, xent_chunk=chunk,
+            grad_accum=args.grad_accum,
+            # Load-balancing aux loss: only meaningful (and only sown) on
+            # the MoE path.
+            aux_loss_weight=0.01 if args.moe_every_n else 0.0,
+        )
 
     ckpt = None
     start_step = 0

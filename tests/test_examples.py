@@ -182,6 +182,42 @@ def test_dist_lm_moe_expert_parallel(tmp_path):
     assert "'ep': 2" in r.stdout
 
 
+def test_dist_lm_pipeline_parallel_with_resume(tmp_path):
+    """dist_lm --pp: the transformer block stack trains as GPipe stages
+    over a pp x dp mesh (train/pp_lm.py), checkpoints the pipelined param
+    tree, simulates preemption (exit 138), and resumes from the
+    checkpoint — the full operator-restart contract on the pp path."""
+    import subprocess
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    argv = [
+        sys.executable, os.path.join(EXAMPLES, "dist_lm.py"),
+        "--steps", "60", "--batch", "8", "--seq", "64", "--vocab", "64",
+        "--layers", "2", "--pp", "2", "--target-loss", "1.2",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ]
+    # Leg 1: dies with the user-retryable code mid-run.
+    r = subprocess.run(
+        argv + ["--fail-at-step", "30"],
+        env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 138, r.stdout + r.stderr
+    # Leg 2 (the operator's restart): resumes and finishes.
+    r = subprocess.run(
+        argv, env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "dist_lm: resumed from step" in r.stdout
+    assert "'pp': 2" in r.stdout
+    assert "dist_lm: OK" in r.stdout
+
+
 def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
     """Worker + Evaluator job: the worker trains and checkpoints; the
     evaluator replica (excluded from the rendezvous, role from TF_CONFIG)

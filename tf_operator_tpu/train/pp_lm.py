@@ -156,6 +156,23 @@ def pp_param_shardings(mesh: Mesh, pp_params: Any,
     }
 
 
+def place_pp_state(mesh: Mesh, state: Any) -> Any:
+    """Pin every leaf of a TrainState to the mesh: leaves already carried
+    by a NamedSharding (params placed by ``pp_param_shardings``, optimizer
+    moments inheriting them via ``tx.init``) keep their placement; the
+    rest (step counter, optax count scalars — uncommitted by default) are
+    replicated. Without this, a checkpoint restore commits the scalars to
+    one device while the params live on the mesh, and the next jitted
+    step rejects the mixed device sets."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree.map(
+        lambda x: x
+        if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, repl),
+        state,
+    )
+
+
 def make_pp_lm_train_step(
     cfg: TransformerConfig,
     mesh: Mesh,
