@@ -91,6 +91,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="orbax checkpoint from dist_lm.py — shape flags "
                         "must mirror the trainer's (default: quick-train "
                         "the +1-chain task at startup)")
+    p.add_argument("--from-pp", type=int, default=None, metavar="PP",
+                   help="the checkpoint came from dist_lm --pp PP: restore "
+                        "the pipelined param tree and merge it back to the "
+                        "standard layout (train/pp_lm.py merge_pp_params)")
     p.add_argument("--train-steps", type=int, default=150)
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--tp", type=int, default=1,
@@ -134,12 +138,31 @@ def main(argv: list[str] | None = None) -> int:
         # The trainer saved a full TrainState; restore into a matching
         # template and keep the params.
         toks0 = jnp.zeros((1, 1), jnp.int32)
-        template = TrainState.create(
-            Transformer(cfg).init(jax.random.PRNGKey(0), toks0)["params"],
-            adamw(args.lr),
-        )
-        params = ckpt.restore(step, template).params
-        print(f"serve_lm: restored checkpoint step {step}", flush=True)
+        init_params = Transformer(cfg).init(
+            jax.random.PRNGKey(0), toks0
+        )["params"]
+        if args.from_pp:
+            from tf_operator_tpu.train.pp_lm import (
+                merge_pp_params,
+                split_pp_params,
+            )
+
+            outer, stages = split_pp_params(
+                init_params, cfg.n_layers, args.from_pp
+            )
+            template = TrainState.create(
+                {"outer": outer, "stages": stages}, adamw(args.lr)
+            )
+            restored = ckpt.restore(step, template).params
+            params = merge_pp_params(
+                restored["outer"], restored["stages"], cfg.n_layers
+            )
+        else:
+            template = TrainState.create(init_params, adamw(args.lr))
+            params = ckpt.restore(step, template).params
+        print(f"serve_lm: restored checkpoint step {step}"
+              + (f" (merged from pp={args.from_pp})" if args.from_pp else ""),
+              flush=True)
     else:
         params = quick_train(cfg, args.train_steps, args.lr)
 

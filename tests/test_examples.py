@@ -218,6 +218,72 @@ def test_dist_lm_pipeline_parallel_with_resume(tmp_path):
     assert "dist_lm: OK" in r.stdout
 
 
+def test_serve_lm_from_pipeline_checkpoint(tmp_path):
+    """Train with --pp, serve with --from-pp: the pipelined param tree
+    merges back to the standard layout and the server completes the
+    chain task correctly — train/serve interop across param layouts."""
+    import json as _json
+    import socket
+    import subprocess
+    import time
+    import urllib.request
+
+    def _free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    ck = str(tmp_path / "ck")
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "dist_lm.py"),
+         "--steps", "120", "--batch", "8", "--seq", "64", "--vocab", "256",
+         "--d-model", "128", "--layers", "2", "--pp", "2", "--lr", "5e-3",
+         "--target-loss", "1.0", "--checkpoint-dir", ck],
+        env=env, capture_output=True, text=True, timeout=480,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    port = _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
+         "--port", str(port), "--checkpoint-dir", ck, "--from-pp", "2",
+         "--max-seq-len", "64", "--requests", "1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1
+                )
+                break
+            except OSError:
+                if proc.poll() is not None:
+                    pytest.fail(f"server died: {proc.communicate()[0]}")
+                time.sleep(0.5)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=_json.dumps(
+                {"tokens": [[5, 6, 7, 8]], "num_steps": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = _json.loads(resp.read())
+        assert out["tokens"][0] == [9, 10, 11, 12], out
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
 def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
     """Worker + Evaluator job: the worker trains and checkpoints; the
     evaluator replica (excluded from the rendezvous, role from TF_CONFIG)
