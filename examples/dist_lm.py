@@ -57,10 +57,12 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize blocks (long-context memory)")
     p.add_argument("--ring-impl", default="auto",
-                   choices=("auto", "stream", "flash"),
-                   help="ring attention implementation: stream (autodiff, "
-                        "supports kv chunking) or flash (custom-VJP "
-                        "second-ring backward, Pallas blocks on TPU)")
+                   choices=("auto", "stream", "flash", "ulysses"),
+                   help="sequence-parallel attention: stream (autodiff "
+                        "ring, supports kv chunking), flash (custom-VJP "
+                        "second-ring backward, Pallas blocks on TPU), or "
+                        "ulysses (all-to-all head/sequence exchange — "
+                        "needs heads/tp divisible by sp)")
     p.add_argument("--moe-every-n", type=int, default=None,
                    help="swap every Nth block's MLP for a routed expert "
                         "MLP (models/moe.py); enables the MoE path")

@@ -162,19 +162,21 @@ class Attention(nn.Module):
                 if cfg.mesh.shape.get(cfg.tp_axis, 1) > 1
                 else (None,)
             )
-            if cfg.ring_impl not in ("auto", "stream", "flash"):
+            if cfg.ring_impl not in ("auto", "stream", "flash", "ulysses"):
                 # A typo must not silently run the other implementation.
                 raise ValueError(
                     f"ring_impl={cfg.ring_impl!r}: expected 'auto', "
-                    f"'stream', or 'flash'"
+                    f"'stream', 'flash', or 'ulysses'"
                 )
-            if cfg.ring_impl == "flash" and cfg.ring_kv_chunk is not None:
-                # The flash impl's XLA fallback materializes the full
-                # per-device score tile; silently dropping the memory
-                # bound would OOM exactly the long contexts it exists for.
+            if (cfg.ring_impl in ("flash", "ulysses")
+                    and cfg.ring_kv_chunk is not None):
+                # These impls' score memory is bounded differently (flash:
+                # VMEM blocks; ulysses: full-seq local attention);
+                # silently dropping the requested memory bound would OOM
+                # exactly the long contexts it exists for.
                 raise ValueError(
-                    "ring_impl='flash' ignores ring_kv_chunk; use "
-                    "ring_impl='stream' (or 'auto') with ring_kv_chunk"
+                    f"ring_impl={cfg.ring_impl!r} ignores ring_kv_chunk; "
+                    "use ring_impl='stream' (or 'auto') with ring_kv_chunk"
                 )
             sp = cfg.mesh.shape[cfg.seq_axis]
             use_flash_ring = cfg.ring_impl == "flash" or (
@@ -182,7 +184,20 @@ class Attention(nn.Module):
                 and cfg.ring_kv_chunk is None
                 and _use_flash_blocks(t // sp, t // sp)
             )
-            if use_flash_ring:
+            if cfg.ring_impl == "ulysses":
+                # All-to-all head/sequence exchange instead of a K/V ring
+                # (parallel/ulysses.py): full-sequence attention per head
+                # group; requires (heads / tp) % sp == 0.
+                from tf_operator_tpu.parallel.ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, cfg.mesh,
+                    seq_axis=cfg.seq_axis,
+                    batch_spec=batch_spec,
+                    head_spec=head_spec,
+                    causal=True,
+                )
+            elif use_flash_ring:
                 out = ring_flash_attention(
                     q, k, v, cfg.mesh,
                     seq_axis=cfg.seq_axis,
