@@ -370,6 +370,36 @@ def test_dist_lm_two_process_ring_attention(operator):
             pass
 
 
+def test_dist_lm_two_process_ulysses(operator):
+    """2-process Ulysses sequence parallelism: sp=2 spans the two
+    processes, so the head/sequence all_to_all exchanges run as genuinely
+    cross-process collectives (the strategy's entire communication
+    pattern), with full-sequence attention per head group in between."""
+    cli = TPUJobClient(RestClusterClient(operator))
+    cli.create(
+        example_job(
+            "lmu2", "dist_lm.py", workers=2,
+            extra_args=[
+                "--steps", "60", "--batch", "4", "--seq", "64",
+                "--sp", "2", "--target-loss", "1.0",
+                "--ring-impl", "ulysses",
+            ],
+            extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+        )
+    )
+    try:
+        got = cli.wait_for_job("default", "lmu2", timeout=600)
+        conds = {c["type"] for c in got["status"]["conditions"] if c["status"] == "True"}
+        logs = job_logs(cli, "lmu2")
+        assert "Succeeded" in conds, f"conds={conds}\nlogs:\n{logs}"
+        assert "dist_lm: OK" in logs, logs
+    finally:
+        try:
+            cli.delete("default", "lmu2")
+        except Exception:
+            pass
+
+
 @pytest.mark.e2e_smoke
 def test_dist_mnist_preemption_checkpoint_resume(operator, tmp_path):
     """Kill-and-resume: the replica checkpoints, dies with the user-retryable
