@@ -1,0 +1,56 @@
+"""Smoke-drill every window_autorun stage on CPU (BENCH_SMOKE shapes).
+
+The window daemon's stages must not fail on argument/plumbing bugs when
+the real tunnel window opens — this drill runs the exact argv+env each
+stage would use, with BENCH_SMOKE=1 forcing tiny shapes on the CPU
+backend, and reports useful-line counts per stage. Run after any change
+to bench.py / perf_probe.py / window_autorun.py:
+
+    python tools/window_drill.py
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import window_autorun as wa  # noqa: E402
+
+
+def main() -> int:
+    failures = []
+    for label, env_over, _budget in wa.STAGES:
+        argv, env = wa.stage_argv(label, dict(env_over) if env_over else None)
+        env["BENCH_SMOKE"] = "1"
+        out_path = f"/tmp/drill_{label}.jsonl"
+        t0 = time.monotonic()
+        try:
+            with open(out_path, "w") as out_f:
+                proc = subprocess.run(
+                    argv, env=env, stdout=out_f,
+                    stderr=subprocess.PIPE, timeout=600,
+                )
+            rc: object = proc.returncode
+            err_tail = proc.stderr.decode(errors="replace")[-500:]
+        except subprocess.TimeoutExpired:
+            rc, err_tail = "timeout", ""
+        useful = wa._useful_lines(out_path, label)
+        dt = time.monotonic() - t0
+        status = "OK" if useful else "NO-DATA"
+        if not useful:
+            failures.append(label)
+        print(f"{status:7s} {label:14s} rc={rc} {dt:5.1f}s "
+              f"useful={useful}", flush=True)
+        if not useful and err_tail:
+            print(f"        stderr: {err_tail}", flush=True)
+    print(f"drill: {len(wa.STAGES) - len(failures)}/{len(wa.STAGES)} stages "
+          f"produced data" + (f"; FAILED: {failures}" if failures else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
