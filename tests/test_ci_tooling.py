@@ -527,3 +527,25 @@ def test_bench_smoke_isolated_sections():
         assert any(m.startswith(want) for m in metrics), (want, metrics)
     for line in lines:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(line)
+
+
+def test_pyproject_metadata_consistent():
+    """Packaging metadata: every console-script entry point resolves to a
+    callable, the dynamic version attribute exists, and the package
+    discovery pattern matches the real package name."""
+    import importlib
+    import tomllib
+
+    with open(os.path.join(REPO_ROOT, "pyproject.toml"), "rb") as f:
+        meta = tomllib.load(f)
+    for script, target in meta["project"]["scripts"].items():
+        mod_name, attr = target.split(":")
+        mod = importlib.import_module(mod_name)
+        assert callable(getattr(mod, attr)), script
+    ver_attr = meta["tool"]["setuptools"]["dynamic"]["version"]["attr"]
+    mod_name, attr = ver_attr.rsplit(".", 1)
+    assert getattr(importlib.import_module(mod_name), attr)
+    assert any(
+        pat.rstrip("*") == "tf_operator_tpu"
+        for pat in meta["tool"]["setuptools"]["packages"]["find"]["include"]
+    )

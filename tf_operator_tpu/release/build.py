@@ -30,7 +30,9 @@ from tf_operator_tpu.harness.prow import git_sha
 # by whether a compile has run) — shipping them would break both the
 # reproducible content digest and portability; targets rebuild on demand.
 EXCLUDE_DIRS = {"__pycache__", ".git", ".pytest_cache", "dist", "_build"}
-INCLUDE_TOP = ("tf_operator_tpu", "examples", "bench.py", "README.md")
+INCLUDE_TOP = (
+    "tf_operator_tpu", "examples", "bench.py", "README.md", "pyproject.toml",
+)
 
 
 def _walk_files(repo_root: str) -> list[str]:
