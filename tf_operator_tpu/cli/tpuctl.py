@@ -29,7 +29,7 @@ import urllib.error
 import urllib.request
 from typing import Any
 
-from tf_operator_tpu.client.tpujob_client import TPUJobClient
+from tf_operator_tpu.client.tpujob_client import TimeoutError_, TPUJobClient
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.restclient import RestClusterClient
 
@@ -114,8 +114,9 @@ def cmd_get(args, client: TPUJobClient) -> int:
         if args.watch:
             # kubectl -w semantics: stream one row per update event until
             # interrupted (or --watch-events N for scripts/tests).
+            # namespace None = all-namespace watch, matching the listing.
             w = client._client.watch(  # noqa: SLF001 — raw watch surface
-                objects.TPUJOBS, args.namespace or "default"
+                objects.TPUJOBS, args.namespace
             )
             seen = 0
             try:
@@ -282,14 +283,19 @@ def cmd_wait(args, client: TPUJobClient) -> int:
         client.wait_for_delete(ns, name, timeout=args.timeout)
         print(f"tpujob {ns}/{name} deleted")
         return 0
+    # Waiting for a terminal condition also watches the OTHER terminal
+    # one: a job that Fails while we wait for Succeeded must return
+    # immediately with rc 1, not block until timeout — scripts rely on
+    # `tpuctl wait ... --for Succeeded && next-step`.
+    expected = (args.condition,)
+    if args.condition in ("Succeeded", "Failed"):
+        expected = ("Succeeded", "Failed")
     got = client.wait_for_condition(
-        ns, name, (args.condition,), timeout=args.timeout
+        ns, name, expected, timeout=args.timeout
     )
     print(f"tpujob {ns}/{name}: {_state(got)}")
-    # Waiting for Succeeded but landing on Failed is an error exit, so
-    # scripts can `tpuctl wait ... --for Succeeded && next-step`.
-    return 0 if _state(got) == args.condition or (
-        args.condition not in ("Succeeded", "Failed")
+    return 0 if args.condition not in ("Succeeded", "Failed") or (
+        _state(got) == args.condition
     ) else 1
 
 
@@ -342,7 +348,9 @@ def main(argv: list[str] | None = None) -> int:
             "delete": cmd_delete,
             "wait": cmd_wait,
         }[args.cmd](args, client)
-    except TimeoutError as e:
+    except (TimeoutError, TimeoutError_) as e:
+        # TimeoutError_ is the client's own wait-timeout type (a plain
+        # Exception subclass, NOT builtins.TimeoutError).
         print(f"tpuctl: {e}", file=sys.stderr)
         return 1
 

@@ -36,15 +36,17 @@ from jax.sharding import PartitionSpec as P
 
 
 def _ulysses_local(q, k, v, *, seq_axis: str, causal: bool,
-                   scale: float | None, use_flash: bool):
+                   scale: float | None, use_flash: bool | None):
     from tf_operator_tpu.ops import attention as device_attention
 
-    sp = lax.axis_size(seq_axis)
     # [B, S/sp, H, D] -> [B, S, H/sp, D]: split heads, concat sequence.
     a2a = lambda x: lax.all_to_all(  # noqa: E731
         x, seq_axis, split_axis=2, concat_axis=1, tiled=True
     )
     qf, kf, vf = a2a(q), a2a(k), a2a(v)
+    # use_flash=None defers to attention_kernel() dispatch, so the
+    # TPU_OPERATOR_ATTN A/B override and the off-TPU XLA fallback are
+    # honored here exactly as on the single-device path.
     out = device_attention(
         qf, kf, vf, causal=causal, scale=scale, use_flash=use_flash
     )
@@ -65,7 +67,7 @@ def ulysses_attention(
     head_spec: Any = (None,),
     causal: bool = True,
     scale: float | None = None,
-    use_flash: bool = True,
+    use_flash: bool | None = None,
 ) -> jax.Array:
     """Exact attention with the sequence dim sharded over ``seq_axis``,
     computed via head/sequence all-to-all. Same signature family as

@@ -84,7 +84,9 @@ def merge_pp_params(outer: Any, stages: Any, n_layers: int) -> Any:
 
 def _stage_cfg(cfg: TransformerConfig) -> TransformerConfig:
     # Inside shard_map each stage is single-device code: the Block must
-    # take the plain attention path (no nested mesh logic).
+    # take the plain attention path (no nested mesh logic). remat is
+    # applied by make_pp_lm_forward around each block apply (the
+    # Transformer-level nn.remat wrapper never runs on this path).
     return replace(cfg, mesh=None, remat=False)
 
 
@@ -109,10 +111,20 @@ def make_pp_lm_forward(
         else None
     )
 
+    def apply_block(block_p, x):
+        return block.apply({"params": block_p}, x)
+
+    if cfg.remat:
+        # Honor the model's remat request on the pipelined path too: each
+        # block's activations are recomputed in the backward instead of
+        # stored through the scan (cfg.remat would otherwise be silently
+        # dropped — the stage cfg disables the Transformer-level wrapper).
+        apply_block = jax.checkpoint(apply_block)
+
     def stage_fn(p_stage, x):
         # p_stage leaves: [k, ...] — this stage's blocks, applied in order.
         def body(x, block_p):
-            return block.apply({"params": block_p}, x), None
+            return apply_block(block_p, x), None
 
         out, _ = jax.lax.scan(body, x, p_stage)
         return out

@@ -198,10 +198,11 @@ def main() -> int:
             print(f"| bench | {weights} | — "
                   f"| {m['value']} | {fmt(gbps)} | {pct}% |")
         bf = next((r for r in rows if r.get("weights") == "bf16"
-                   and r.get("batch") == 8), None)
+                   and r.get("batch") == 8 and "error" not in r), None)
         i8 = next((r for r in rows if r.get("weights") == "int8"
-                   and r.get("batch") == 8), None)
-        if bf and i8 and bf.get("gen_tokens_per_sec"):
+                   and r.get("batch") == 8 and "error" not in r), None)
+        if (bf and i8 and bf.get("gen_tokens_per_sec")
+                and i8.get("gen_tokens_per_sec")):
             sp = i8["gen_tokens_per_sec"] / bf["gen_tokens_per_sec"]
             print(f"\nint8 speedup at b8: **{fmt(sp, 2)}x** "
                   + ("(the VMEM-dequant kernel pays off)" if sp > 1.2
