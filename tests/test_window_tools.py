@@ -1,0 +1,116 @@
+"""The hardware-window tooling (tools/window_*.py) — the capture path for
+every hardware number this round, so its plumbing is suite-tested: stage
+command construction, useful-line gating (what marks a stage done), and
+report rendering from artifacts."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools import window_autorun as wa  # noqa: E402
+
+
+def test_stage_argv_construction():
+    labels = [label for label, _, _ in wa.STAGES]
+    assert labels[0] == "roofline"  # chip-state first
+    assert "bench_full" in labels and "synthetic" in labels
+    for label, env_over, budget in wa.STAGES:
+        argv, env = wa.stage_argv(label, dict(env_over) if env_over else None)
+        assert argv[0] == sys.executable
+        assert os.path.exists(argv[1]), argv
+        if env_over and "PROBE" in env_over:
+            assert env["PROBE"] == env_over["PROBE"]
+            assert env["BENCH_WATCHDOG_S"] == "0"
+        elif env_over and "BENCH" in env_over:
+            assert argv[2:] == ["--section", env_over["BENCH"]]
+        else:  # full bench keeps its own watchdog + section isolation
+            assert "BENCH_WATCHDOG_S" not in env
+        assert budget > 0
+    # The A/B legs pin the attention knob on BOTH sides.
+    flash_env = dict(next(e for l, e, _ in wa.STAGES if l == "lm_ab_flash"))
+    xla_env = dict(next(e for l, e, _ in wa.STAGES if l == "lm_ab_xla"))
+    assert flash_env["TPU_OPERATOR_ATTN"] == ""
+    assert xla_env["TPU_OPERATOR_ATTN"] == "xla"
+
+
+def test_useful_lines_gating(tmp_path):
+    """What counts as 'stage produced data': error rows and the CPU-only
+    submit-latency line must NOT mark a hardware stage done (the
+    BENCH_r03 rc=3 shape)."""
+    p = tmp_path / "out.jsonl"
+    p.write_text(
+        json.dumps({"metric": "tpujob_submit_to_all_running_median_ms",
+                    "value": 90}) + "\n"
+        + "bench: some stderr-ish line\n"
+        + json.dumps({"probe": "lmsweep", "size": "840M",
+                      "error": "RESOURCE_EXHAUSTED"}) + "\n"
+    )
+    assert wa._useful_lines(str(p), "bench_full") == 0
+    with open(p, "a") as f:
+        f.write(json.dumps({"metric": "resnet50_train_images_per_sec",
+                            "value": 2500}) + "\n")
+    assert wa._useful_lines(str(p), "bench_full") == 1
+    assert wa._useful_lines(str(tmp_path / "missing.jsonl"), "x") == 0
+
+
+def test_report_renders_from_artifacts(tmp_path):
+    """window_report renders every section from a synthetic window dir —
+    including the degenerate cases (error rows, missing stages)."""
+    d = tmp_path / "win"
+    d.mkdir()
+    (d / "roofline.jsonl").write_text(json.dumps({
+        "probe": "roofline", "dispatch_roundtrip_ms": 0.06,
+        "matmul_chain_tflops": 111.0, "copy_gbps": 111.0,
+        "matmul_8192_tflops": 86.0,
+    }) + "\n")
+    (d / "synthetic.jsonl").write_text(json.dumps({
+        "probe": "synthetic", "images_per_sec": 2500.0,
+        "images_per_sec_b2x": 2800.0,
+    }) + "\n")
+    (d / "bench_full.jsonl").write_text(
+        json.dumps({"metric": "resnet50_train_images_per_sec_bf16_b256_1chip",
+                    "value": 2400.0, "mfu": 0.30,
+                    "flops_source": "analytic"}) + "\n"
+        + json.dumps({"metric": "lm_decode_gen_tokens_per_sec_int8_b8_1chip",
+                      "value": 900.0, "hbm_gbps": 60.0}) + "\n"
+    )
+    (d / "decodesweep.jsonl").write_text(
+        json.dumps({"probe": "decodesweep", "weights": "bf16", "batch": 8,
+                    "gen_tokens_per_sec": 500.0, "hbm_gbps": 47.0}) + "\n"
+        + json.dumps({"probe": "decodesweep", "weights": "int8", "batch": 8,
+                      "error": "boom"}) + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "window_report.py"),
+         str(d)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "111.0 TFLOP/s" in out or "111.0" in out
+    assert "2500" in out and "2400" in out
+    # Measured-ceiling re-denomination: 0.30 spec MFU * 197/111 = 53%.
+    assert "53." in out
+    # Error row doesn't crash the report, and no speedup line is printed.
+    assert "boom" not in out
+    assert "int8 speedup" not in out
+
+
+def test_foreign_bench_detector_ignores_own_children(tmp_path):
+    """The yield-to-driver scan is structural (argv[1] is the script
+    path): text mentions of bench.py in other processes' cmdlines (e.g.
+    the driver wrapper's prompt) must not trigger it."""
+    script = tmp_path / "not_a_bench.py"
+    script.write_text("import time; time.sleep(5)\n")
+    p = subprocess.Popen(
+        [sys.executable, str(script), "this mentions bench.py in an arg"]
+    )
+    try:
+        assert wa._foreign_bench_running() is False
+    finally:
+        p.terminate()
+        p.wait()
