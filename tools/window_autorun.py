@@ -56,6 +56,11 @@ STAGES = [
     ("lm_ab_xla", {"BENCH": "lm", "TPU_OPERATOR_ATTN": "xla"}, 1100.0),
     ("lmsweep", {"PROBE": "lmsweep"}, 1500.0),
     ("decodesweep", {"PROBE": "decodesweep"}, 900.0),
+    # Tail attribution: host input pipeline (CPU-only, cheap) and the
+    # ResNet fwd/bwd split — consulted if the synthetic-vs-bench split
+    # points at input/transfer or the gradient path respectively.
+    ("input", {"PROBE": "input"}, 300.0),
+    ("fwd_split", {"PROBE": "fwd_split"}, 600.0),
 ]
 
 
