@@ -22,7 +22,8 @@ Stages (see VERDICT round 3 "Next round: do this"):
   7. lmsweep probe         — MFU-vs-model-size curve (VERDICT item 4).
   8. decode probe          — steady-state decode vs measured copy roof.
 
-Everything lands under docs/window_r04/<UTC stamp>/<stage>.jsonl plus a
+Everything lands under docs/$WINDOW_DIR_NAME/<UTC stamp>/<stage>.jsonl
+(default window_r05) plus a
 combined log; stderr per stage under the same dir. Usage:
     nohup python tools/window_autorun.py >> /tmp/autorun.log 2>&1 &
 """
@@ -36,7 +37,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT_ROOT = os.path.join(REPO, "docs", "window_r04")
+OUT_ROOT = os.path.join(
+    REPO, "docs", os.environ.get("WINDOW_DIR_NAME", "window_r05")
+)
 POLL_S = 150.0
 PROBE_TIMEOUT_S = 45.0
 
@@ -73,6 +76,10 @@ def tunnel_up() -> bool:
         out = subprocess.run(
             [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
             capture_output=True, timeout=PROBE_TIMEOUT_S, text=True,
+            # The probe's jax import is CPU-heavy for seconds; at nice 19
+            # it cannot contend with a concurrent (driver) bench's
+            # CPU-side latency fleet (the BENCH_r04 submit inflation).
+            preexec_fn=lambda: os.nice(19),
         )
         return out.stdout.strip().endswith("1")
     except subprocess.TimeoutExpired:
@@ -216,6 +223,13 @@ def main() -> None:
     done: set = set()
     log(f"autorun start (poll {POLL_S:.0f}s, stages={len(STAGES)})")
     while True:
+        # A foreign bench (the driver's round-end run) owns both the chip
+        # AND the host CPUs: even the poll probe's jax import measurably
+        # inflates its CPU-side submit-latency fleet. Defer entirely.
+        if _foreign_bench_running():
+            log("foreign bench running — poll deferred")
+            time.sleep(POLL_S)
+            continue
         if tunnel_up():
             log("UP" + (" (all stages done)" if all(
                 label in done for label, _, _ in STAGES) else ""))
