@@ -1,7 +1,7 @@
 """Turn a window_autorun artifact directory into the perf attribution report.
 
-Usage: python tools/window_report.py [docs/window_r04/<stamp>]
-(default: the newest stamp dir under docs/window_r04).
+Usage: python tools/window_report.py [docs/window_r*/<stamp>]
+(default: the newest stamp dir across all docs/window_r* rounds).
 
 Reads each stage's jsonl and derives the quantities VERDICT r3 asked
 for, so the analysis of a hardware window is one command:
@@ -49,18 +49,24 @@ def fmt(x, nd=1):
 
 
 def main() -> int:
-    root = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "docs", "window_r04",
+    docs = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"
     )
     if len(sys.argv) > 1:
         d = sys.argv[1]
     else:
-        stamps = sorted(os.listdir(root)) if os.path.isdir(root) else []
+        # Newest stamp dir across every round's window_r* captures.
+        import glob
+
+        stamps = sorted(
+            glob.glob(os.path.join(docs, "window_r*", "*T*")),
+            key=os.path.basename,
+        )
+        stamps = [s for s in stamps if os.path.isdir(s)]
         if not stamps:
-            print("no window_r04 artifacts yet")
+            print("no window_r* artifacts yet")
             return 1
-        d = os.path.join(root, stamps[-1])
+        d = stamps[-1]
     print(f"# Window report — {os.path.basename(d)}\n")
 
     # Measured ceilings.
