@@ -109,6 +109,11 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     if args.requests is not None and args.requests < 1:
         p.error("--requests must be >= 1 (omit it to serve until SIGTERM)")
+    if args.int8 and args.tp > 1:
+        # Rejected up front: by the old check site the user had already
+        # paid the full checkpoint restore + tp shard before the error.
+        p.error("--int8 with --tp > 1 is not supported (the int8 "
+                "kernel has no SPMD partitioning rule)")
 
     import jax
     import jax.numpy as jnp
@@ -175,9 +180,6 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serve_lm: params tp-sharded over {args.tp} devices",
               flush=True)
     if args.int8:
-        if args.tp > 1:
-            p.error("--int8 with --tp > 1 is not supported (the int8 "
-                    "kernel has no SPMD partitioning rule)")
         from dataclasses import replace
 
         from tf_operator_tpu.models.transformer import quantize_decode_params

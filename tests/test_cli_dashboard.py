@@ -301,6 +301,54 @@ def test_tpuctl_wait_detects_failure_fast(operator_proc, capsys):
         TPUJobClient(RestClusterClient(base)).delete("default", "wait-fail")
 
 
+def test_tpuctl_wait_nonterminal_target_terminal_races(capsys):
+    """Non-terminal wait targets cross-watch the terminal pair (round-4
+    advisor finding): a job that races to Succeeded between polls is rc 0
+    for `--for Running` (success implies it ran; the status engine flips
+    Running to False on terminal so the raw condition check would flake),
+    while Failed-first is rc 1, and a satisfied non-terminal condition
+    outranked by a later one (Created on a Running job) is still rc 0."""
+    import argparse
+
+    from tf_operator_tpu.cli import tpuctl
+    from tf_operator_tpu.client.tpujob_client import TPUJobClient
+
+    def job_with(conds):
+        return {
+            "metadata": {"namespace": "default", "name": "race"},
+            "status": {"conditions": [
+                {"type": t, "status": s} for t, s in conds
+            ]},
+        }
+
+    outcomes = {
+        # (wait target, conditions on the returned object) -> rc
+        ("Running", (("Created", "True"), ("Running", "False"),
+                     ("Succeeded", "True"))): 0,
+        ("Running", (("Created", "True"), ("Running", "False"),
+                     ("Failed", "True"))): 1,
+        ("Created", (("Created", "True"), ("Running", "True"))): 0,
+        ("Running", (("Created", "True"), ("Running", "True"))): 0,
+    }
+    for (target, conds), want_rc in outcomes.items():
+        client = TPUJobClient.__new__(TPUJobClient)
+        seen = {}
+
+        def wait_for_condition(ns, name, expected, timeout=None,
+                               _conds=conds, _seen=seen):
+            _seen["expected"] = tuple(expected)
+            return job_with(_conds)
+
+        client.wait_for_condition = wait_for_condition
+        args = argparse.Namespace(ref="default/race", condition=target,
+                                  timeout=5)
+        rc = tpuctl.cmd_wait(args, client)
+        assert rc == want_rc, (target, conds, rc)
+        # The terminal pair is always in the expected set.
+        assert {"Succeeded", "Failed"} <= set(seen["expected"])
+    capsys.readouterr()
+
+
 def test_tpuctl_wait_timeout_is_clean(capsys):
     """A wait that times out exits 1 with a message, not a traceback
     (the client's TimeoutError_ is not builtins.TimeoutError)."""

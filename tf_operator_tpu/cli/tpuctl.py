@@ -283,20 +283,34 @@ def cmd_wait(args, client: TPUJobClient) -> int:
         client.wait_for_delete(ns, name, timeout=args.timeout)
         print(f"tpujob {ns}/{name} deleted")
         return 0
-    # Waiting for a terminal condition also watches the OTHER terminal
-    # one: a job that Fails while we wait for Succeeded must return
-    # immediately with rc 1, not block until timeout — scripts rely on
-    # `tpuctl wait ... --for Succeeded && next-step`.
-    expected = (args.condition,)
-    if args.condition in ("Succeeded", "Failed"):
-        expected = ("Succeeded", "Failed")
+    # Every wait also watches the terminal conditions: a job that goes
+    # Failed (or Succeeded) while we wait for anything else must return
+    # promptly with rc 1, not block until timeout — scripts rely on
+    # `tpuctl wait ... --for <cond> && next-step`. This covers both the
+    # Succeeded/Failed cross-watch and non-terminal targets (Running,
+    # Created) on a job that races to terminal before reaching them.
+    expected = tuple(dict.fromkeys(
+        (args.condition, "Succeeded", "Failed")
+    ))
     got = client.wait_for_condition(
         ns, name, expected, timeout=args.timeout
     )
     print(f"tpujob {ns}/{name}: {_state(got)}")
-    return 0 if args.condition not in ("Succeeded", "Failed") or (
-        _state(got) == args.condition
-    ) else 1
+    # rc 0 iff the REQUESTED condition is True on the returned object —
+    # not _state(), whose ranking would fail `--for Created` on a job
+    # already Running. Two asymmetric terminal races: a job that raced
+    # past a non-terminal target to Succeeded necessarily passed through
+    # it (the status engine flips Running to False on terminal, so the
+    # condition check alone would flake on fast jobs) — rc 0; one that
+    # went Failed first gives no such guarantee — rc 1.
+    reached = any(
+        c.get("type") == args.condition and c.get("status") == "True"
+        for c in got.get("status", {}).get("conditions", [])
+    )
+    if (not reached and args.condition not in ("Succeeded", "Failed")
+            and _state(got) == "Succeeded"):
+        reached = True
+    return 0 if reached else 1
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -38,7 +38,11 @@ from typing import Any
 # source manifest drifting away from the parameterization).
 _TEMPLATE_REWRITES: tuple[tuple[str, str], ...] = (
     (r"namespace: default\b", "namespace: {{namespace}}"),
-    (r"image: tpu-operator:latest\s*(#[^\n]*)?", "image: {{image}}"),
+    # [^\S\n]* (horizontal whitespace only): with plain \s* the match
+    # could cross the newline when the inline comment is absent and
+    # swallow the next line's indentation, producing invalid YAML while
+    # the must-match-once guard still passes.
+    (r"image: tpu-operator:latest[^\S\n]*(#[^\n]*)?", "image: {{image}}"),
     (r"replicas: 1\b", "replicas: {{replicas}}"),
     (r"requests: \{cpu: 100m, memory: 256Mi\}",
      "requests: {cpu: {{cpu_request}}, memory: {{memory_request}}}"),
@@ -126,6 +130,8 @@ def load_bundle(tar_path: str) -> dict[str, Any]:
     out: dict[str, Any] = {"templates": {}}
     with tarfile.open(tar_path, "r:gz") as tar:
         for member in tar.getmembers():
+            if not member.isfile():  # dir entries from repacked tarballs
+                continue
             rel = member.name.split("/", 1)[1] if "/" in member.name else member.name
             data = tar.extractfile(member).read().decode()
             if rel == "bundle.json":
