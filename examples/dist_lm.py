@@ -78,6 +78,13 @@ def main(argv: list[str] | None = None) -> int:
                         "and layers divisible by pp)")
     p.add_argument("--pp-microbatches", type=int, default=2,
                    help="microbatches per step on the --pp path")
+    p.add_argument("--pp-schedule", choices=("gpipe", "1f1b"),
+                   default="gpipe",
+                   help="gpipe: autodiff through the pipeline (stash "
+                        "grows with microbatches); 1f1b: interleaved "
+                        "fwd/bwd with an O(pp) stash — raise "
+                        "--pp-microbatches to shrink the bubble without "
+                        "raising memory")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="microbatches per optimizer step (gradients "
                         "averaged inside one jitted step; the global "
@@ -138,6 +145,15 @@ def main(argv: list[str] | None = None) -> int:
     if n % (args.sp * args.tp * args.ep * args.pp):
         raise SystemExit(f"{n} devices not divisible by sp*tp*ep*pp="
                          f"{args.sp * args.tp * args.ep * args.pp}")
+    if args.pp > 1:
+        micro = args.batch // args.pp_microbatches
+        pp_dp = n // args.pp
+        if micro % pp_dp:
+            raise SystemExit(
+                f"microbatch size {micro} (batch/pp-microbatches) must "
+                f"divide by the dp axis ({pp_dp}) — raise --batch or "
+                "lower --pp-microbatches"
+            )
     axes = {"dp": n // (args.sp * args.tp * args.ep * args.pp),
             "sp": args.sp, "tp": args.tp}
     if args.ep > 1:
@@ -204,7 +220,7 @@ def main(argv: list[str] | None = None) -> int:
         state = place_pp_state(mesh, TrainState.create(pp_tree, tx))
         step = make_pp_lm_train_step(
             cfg, mesh, tx, num_micro=args.pp_microbatches,
-            xent_chunk=chunk,
+            xent_chunk=chunk, schedule=args.pp_schedule,
         )
     else:
         rules = dict(param_sharding_rules())

@@ -98,6 +98,163 @@ def pipeline_apply(
     )(stage_params, microbatches)
 
 
+def pipeline_value_and_grad(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    last_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    mesh: Mesh,
+    *,
+    axis: str = "pp",
+    batch_axis: str | None = None,
+) -> Callable[[Any, Any, jax.Array, jax.Array], tuple]:
+    """1F1B pipelined training step: loss AND grads in one schedule.
+
+    ``pipeline_apply`` + autodiff is GPipe: all M forwards run, then all M
+    backwards, and the scan stores O(M) microbatch activations per stage.
+    This engine interleaves them (the 1F1B family): each scan tick moves
+    one forward activation down-pipe and one cotangent up-pipe via
+    ``lax.ppermute``, the loss head runs in-pipeline on the last stage so
+    microbatch i's backward starts the tick after its forward finishes,
+    and the pipeline-internal stash is a ring of 2S microbatch inputs —
+    O(S), independent of M. That is the property that matters: the GPipe
+    bubble is (S-1)/M of the step, so you shrink it by raising M, and with
+    this engine raising M no longer raises activation memory. Backward
+    ticks recompute the stage forward (jax.vjp over the stashed input) —
+    the same FLOPs as GPipe-with-remat (2 fwd + 1 bwd per microbatch).
+
+    Schedule arithmetic (stage s of S, microbatch i of M, R = 2S ring):
+      fwd tick  t_f(s, i) = s + i           (GPipe-timed forwards)
+      bwd tick  t_b(s, i) = 2S - 1 - s + i  (cotangent arrives up-pipe)
+      total ticks T = M + 2S - 1; in-flight stash <= 2S - 1 < R.
+    Every tick executes both branches masked (SPMD lockstep): warmup /
+    drain ticks waste the masked branch — the (2S-2)/M bubble — and the
+    masked last_fn costs what GPipe's outside-the-pipeline head (also
+    replicated over pp) pays anyway.
+
+    stage_fn: (stage params, activation [mb, ...]) -> activation.
+    last_fn: (last params, activation, targets [mb, ...]) -> scalar mean
+      loss for that microbatch (e.g. final norm + vocab head + xent).
+    Returns run(stage_params, last_params, microbatches, targets) ->
+      (loss, stage_grads, last_grads, d_microbatches): loss is the global
+      mean; stage_grads matches stage_params ([S, ...] leaves, sharded
+      over ``axis``); d_microbatches feeds the caller's embedding vjp.
+    """
+    n_stages = mesh.shape[axis]
+    n_dp = mesh.shape[batch_axis] if batch_axis else 1
+
+    def run(stage_params, last_params, microbatches, targets):
+        num_micro = microbatches.shape[0]
+        S, M, R = n_stages, num_micro, 2 * n_stages
+        T = M + 2 * S - 1
+        perm_dn = [(i, (i + 1) % S) for i in range(S)]
+        perm_up = [(i, (i - 1) % S) for i in range(S)]
+        seed = 1.0 / (M * n_dp)  # each microbatch-mean's weight in the
+        # global mean loss; seeding the head vjp with it makes every
+        # accumulated grad exact with no post-scaling.
+
+        def local(sp, lp, x, tgt):
+            p = jax.tree.map(lambda a: a[0], sp)
+            stage = lax.axis_index(axis)
+            is_last = stage == S - 1
+            is_first = stage == 0
+            zero_act = jnp.zeros_like(x[0])
+            carry0 = dict(
+                fwd_msg=zero_act,
+                bwd_msg=zero_act,
+                x_stash=jnp.zeros((R,) + x.shape[1:], x.dtype),
+                dy_stash=jnp.zeros((R,) + x.shape[1:], x.dtype),
+                gp=jax.tree.map(jnp.zeros_like, p),
+                gl=jax.tree.map(jnp.zeros_like, lp),
+                loss=jnp.zeros((), jnp.float32),
+                dx_out=jnp.zeros_like(x),
+            )
+
+            def tick(c, t):
+                fwd_in = lax.ppermute(c["fwd_msg"], axis, perm_dn)
+                bwd_in = lax.ppermute(c["bwd_msg"], axis, perm_up)
+                # --- forward branch: microbatch i_f enters this stage ---
+                i_f = t - stage
+                f_valid = (i_f >= 0) & (i_f < M)
+                i_fc = jnp.clip(i_f, 0, M - 1)
+                xf = jnp.where(is_first, x[i_fc], fwd_in)
+                xf = jnp.where(f_valid, xf, 0)  # masked ticks compute on 0s
+                y = stage_fn(p, xf)
+                # Last stage: head + loss + its vjp IN the same tick, so
+                # the backward can start next tick (this is what makes it
+                # 1F1B rather than fwd-all-then-bwd-all).
+                loss_i, head_vjp = jax.vjp(
+                    lambda lp_, y_: last_fn(lp_, y_, tgt[i_fc]), lp, y
+                )
+                dlp_i, dy_i = head_vjp(jnp.asarray(seed, loss_i.dtype))
+                take_loss = f_valid & is_last
+                w_loss = jnp.where(take_loss, 1.0, 0.0)
+                loss = c["loss"] + w_loss * loss_i.astype(jnp.float32)
+                gl = jax.tree.map(
+                    lambda a, g: a + w_loss.astype(a.dtype) * g,
+                    c["gl"], dlp_i,
+                )
+                # Ring stashes (masked writes keep live slots intact; a
+                # fwd write and the bwd read below always hit different
+                # slots: i_f - i_b = 2S-1-2s is odd, R is even).
+                slot_f = jnp.mod(i_fc, R)
+                old_x = lax.dynamic_index_in_dim(
+                    c["x_stash"], slot_f, 0, keepdims=False)
+                x_stash = lax.dynamic_update_index_in_dim(
+                    c["x_stash"], jnp.where(f_valid, xf, old_x), slot_f, 0)
+                old_dy = lax.dynamic_index_in_dim(
+                    c["dy_stash"], slot_f, 0, keepdims=False)
+                dy_stash = lax.dynamic_update_index_in_dim(
+                    c["dy_stash"],
+                    jnp.where(take_loss, dy_i.astype(x.dtype), old_dy),
+                    slot_f, 0)
+                # --- backward branch: microbatch i_b leaves this stage ---
+                i_b = t - (2 * S - 1 - stage)
+                b_valid = (i_b >= 0) & (i_b < M)
+                i_bc = jnp.clip(i_b, 0, M - 1)
+                slot_b = jnp.mod(i_bc, R)
+                xb = lax.dynamic_index_in_dim(
+                    x_stash, slot_b, 0, keepdims=False)
+                dyb = lax.dynamic_index_in_dim(
+                    dy_stash, slot_b, 0, keepdims=False)
+                cot = jnp.where(is_last, dyb, bwd_in)
+                cot = jnp.where(b_valid, cot, 0)
+                # Recompute-and-pull-back (stage-granular remat): only
+                # this tick's intermediates live, never a whole pipeline's.
+                _, stage_vjp = jax.vjp(stage_fn, p, xb)
+                dp_i, dx_i = stage_vjp(cot)
+                w_b = jnp.where(b_valid, 1.0, 0.0)
+                gp = jax.tree.map(
+                    lambda a, g: a + w_b.astype(a.dtype) * g, c["gp"], dp_i)
+                dx_out = c["dx_out"].at[i_bc].add(
+                    jnp.where(b_valid & is_first, dx_i, 0))
+                return dict(fwd_msg=y, bwd_msg=dx_i, x_stash=x_stash,
+                            dy_stash=dy_stash, gp=gp, gl=gl, loss=loss,
+                            dx_out=dx_out), None
+
+            c, _ = lax.scan(tick, carry0, jnp.arange(T))
+            gp, gl, loss = c["gp"], c["gl"], c["loss"]
+            if batch_axis and n_dp > 1:
+                gp = lax.psum(gp, batch_axis)
+                gl = lax.psum(gl, batch_axis)
+                loss = lax.psum(loss, batch_axis)
+            # Only the last stage accumulated loss/head grads; only stage
+            # 0 accumulated input cotangents — masked psums broadcast them.
+            gl = lax.psum(gl, axis)
+            loss = lax.psum(loss, axis) * seed
+            dx_out = lax.psum(c["dx_out"], axis)
+            return (loss, jax.tree.map(lambda a: a[None], gp), gl, dx_out)
+
+        data_spec = P(None, batch_axis) if batch_axis else P()
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(), data_spec, data_spec),
+            out_specs=(P(), P(axis), P(), data_spec),
+            check_vma=False,
+        )(stage_params, last_params, microbatches, targets)
+
+    return run
+
+
 def microbatch(x: jax.Array, num_micro: int) -> jax.Array:
     """[batch, ...] -> [num_micro, batch/num_micro, ...]."""
     if x.shape[0] % num_micro:

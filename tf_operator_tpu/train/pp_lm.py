@@ -1,4 +1,5 @@
-"""Pipeline-parallel transformer LM training (GPipe over the block stack).
+"""Pipeline-parallel transformer LM training over the block stack
+(GPipe and 1F1B schedules).
 
 Completes the parallelism matrix at the model level: dp/sp/tp/ep run
 through the Transformer directly (models/transformer.py), and pipeline
@@ -14,7 +15,12 @@ the pipeline:
   params; activations hop stages via ppermute inside shard_map
   (pipeline.py's schedule), composing with dp on the microbatch dim.
 - the backward is autodiff through scan + ppermute — the reverse
-  pipeline schedule for free, grads summed over dp by shard_map.
+  pipeline schedule for free, grads summed over dp by shard_map
+  (schedule="gpipe") — or the explicit interleaved 1F1B engine
+  (schedule="1f1b", parallel/pipeline.py:pipeline_value_and_grad) whose
+  activation stash is O(pp) instead of O(num_micro), so the bubble
+  (pp-1)/num_micro can be shrunk by raising num_micro without raising
+  memory.
 
 The reference has no model parallelism at all (SURVEY.md §2.9); this is
 TPU-native capability on top of parity. Exercised multi-process by
@@ -37,6 +43,7 @@ from tf_operator_tpu.models.transformer import Block, TransformerConfig
 from tf_operator_tpu.parallel.pipeline import (
     microbatch,
     pipeline_apply,
+    pipeline_value_and_grad,
     stack_stage_params,
     unmicrobatch,
 )
@@ -90,26 +97,10 @@ def _stage_cfg(cfg: TransformerConfig) -> TransformerConfig:
     return replace(cfg, mesh=None, remat=False)
 
 
-def make_pp_lm_forward(
-    cfg: TransformerConfig,
-    mesh: Mesh,
-    *,
-    num_micro: int,
-    pp_axis: str = "pp",
-    batch_axis: str | None = "dp",
-    xent_chunk: int | None = None,
-):
-    """Returns loss_fn((outer, stages), tokens, targets) -> scalar loss.
-
-    The full pipelined forward + chunked-xent loss, differentiable in
-    both param trees.
-    """
-    scfg = _stage_cfg(cfg)
-    block = Block(scfg)
-    data_axis = (
-        batch_axis if batch_axis and mesh.shape.get(batch_axis, 1) > 1
-        else None
-    )
+def _make_stage_fn(cfg: TransformerConfig):
+    """One pipeline stage: this stage's k blocks applied in order (leaves
+    [k, ...]); remat per block when the model asks for it."""
+    block = Block(_stage_cfg(cfg))
 
     def apply_block(block_p, x):
         return block.apply({"params": block_p}, x)
@@ -122,12 +113,34 @@ def make_pp_lm_forward(
         apply_block = jax.checkpoint(apply_block)
 
     def stage_fn(p_stage, x):
-        # p_stage leaves: [k, ...] — this stage's blocks, applied in order.
         def body(x, block_p):
             return apply_block(block_p, x), None
 
         out, _ = jax.lax.scan(body, x, p_stage)
         return out
+
+    return stage_fn
+
+
+def make_pp_lm_forward(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    *,
+    num_micro: int,
+    pp_axis: str = "pp",
+    batch_axis: str | None = "dp",
+    xent_chunk: int | None = None,
+):
+    """Returns loss_fn((outer, stages), tokens, targets) -> scalar loss.
+
+    The full pipelined forward + chunked-xent loss, differentiable in
+    both param trees (GPipe: autodiff through the schedule).
+    """
+    data_axis = (
+        batch_axis if batch_axis and mesh.shape.get(batch_axis, 1) > 1
+        else None
+    )
+    stage_fn = _make_stage_fn(cfg)
 
     def loss_fn(pp_params, tokens, targets):
         outer, stages = pp_params["outer"], pp_params["stages"]
@@ -194,23 +207,97 @@ def make_pp_lm_train_step(
     pp_axis: str = "pp",
     batch_axis: str | None = "dp",
     xent_chunk: int | None = None,
+    schedule: str = "gpipe",
 ):
     """Jitted (state, batch) -> (state, metrics) for the pipelined LM.
 
     ``state.params`` is {"outer": ..., "stages": ...} (build with
     ``split_pp_params``; place with ``pp_param_shardings``).
+
+    schedule:
+      "gpipe" — autodiff through ``pipeline_apply``: all forwards, then
+        all backwards; the scan stores O(num_micro) activations/stage.
+      "1f1b"  — ``pipeline_value_and_grad``: interleaved schedule with an
+        O(pp) activation stash, so num_micro can grow (shrinking the
+        (pp-1)/num_micro bubble) without growing memory. Bit-identical
+        losses and numerically identical grads (pinned in
+        tests/test_moe_pipeline.py).
     """
-    loss_fn = make_pp_lm_forward(
-        cfg, mesh, num_micro=num_micro, pp_axis=pp_axis,
-        batch_axis=batch_axis, xent_chunk=xent_chunk,
-    )
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule {schedule!r}: want 'gpipe' or '1f1b'")
 
     import optax
 
-    def step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, batch["tokens"], batch["targets"]
+    if schedule == "gpipe":
+        loss_fn = make_pp_lm_forward(
+            cfg, mesh, num_micro=num_micro, pp_axis=pp_axis,
+            batch_axis=batch_axis, xent_chunk=xent_chunk,
         )
+
+        def step(state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, batch["tokens"], batch["targets"]
+            )
+            updates, opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                step=state.step + 1, params=params, opt_state=opt_state
+            )
+            return new_state, {"loss": loss}
+
+        return jax.jit(step)
+
+    # --- 1f1b: explicit fwd/bwd interleave; embed vjp'd outside, the
+    # norm+head+xent ("last_fn") inside the schedule on the last stage ---
+    data_axis = (
+        batch_axis if batch_axis and mesh.shape.get(batch_axis, 1) > 1
+        else None
+    )
+    stage_fn = _make_stage_fn(cfg)
+    norm = nn.RMSNorm(dtype=cfg.dtype)
+
+    def last_fn(last_p, y, tgt):
+        y = norm.apply({"params": last_p["norm"]}, y)
+        head = last_p["head"]
+        return chunked_lm_xent(
+            y, head["kernel"], head["bias"], tgt,
+            chunk=xent_chunk or min(512, y.shape[-2]),
+        )
+
+    engine = pipeline_value_and_grad(
+        stage_fn, last_fn, mesh, axis=pp_axis, batch_axis=data_axis,
+    )
+
+    def step(state, batch):
+        outer, stages = state.params["outer"], state.params["stages"]
+        tokens, targets = batch["tokens"], batch["targets"]
+        T = tokens.shape[1]
+
+        def embed_fn(emb_p):
+            x = jnp.take(
+                emb_p["embed"]["embedding"], tokens, axis=0
+            ).astype(cfg.dtype)
+            pos = emb_p["pos"]["embedding"][jnp.arange(T)][None, :, :]
+            return microbatch(x + pos.astype(cfg.dtype), num_micro)
+
+        emb_p = {"embed": outer["embed"], "pos": outer["pos"]}
+        x_mb, embed_vjp = jax.vjp(embed_fn, emb_p)
+        last_p = {"norm": outer["RMSNorm_0"], "head": outer["lm_head"]}
+        loss, d_stages, d_last, dx = engine(
+            stages, last_p, x_mb, microbatch(targets, num_micro)
+        )
+        (d_emb,) = embed_vjp(dx.astype(x_mb.dtype))
+        grads = {
+            "outer": {
+                "embed": d_emb["embed"],
+                "pos": d_emb["pos"],
+                "RMSNorm_0": d_last["norm"],
+                "lm_head": d_last["head"],
+            },
+            "stages": d_stages,
+        }
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
