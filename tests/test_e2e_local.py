@@ -80,6 +80,39 @@ def submit_job(client, name="e2e", workers=2, restart_policy=None, ttl=None,
     )
 
 
+def test_unexecutable_command_fails_pod_with_127(stack):
+    """A command that cannot exec must surface as exitCode 127 (the
+    kubelet convention) through the pdeathsig exec shim — the same
+    terminal signal the old parent-side spawn-failure path produced —
+    and with restartPolicy Never the pod goes Failed, no restart loop."""
+    client, executor = stack
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"namespace": "default", "name": "bad-cmd"},
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": constants.DEFAULT_CONTAINER_NAME,
+                "command": ["/definitely/not/a/real/binary"],
+            }],
+        },
+    }
+    client.create(objects.PODS, pod)
+
+    def failed_with_127():
+        got = client.get(objects.PODS, "default", "bad-cmd")
+        if objects.pod_phase(got) != objects.FAILED:
+            return False
+        statuses = got.get("status", {}).get("containerStatuses", [])
+        return any(
+            s.get("state", {}).get("terminated", {}).get("exitCode") == 127
+            for s in statuses
+        )
+
+    wait_for(failed_with_127, desc="bad-cmd pod Failed exitCode 127")
+
+
 def wait_for(predicate, timeout=15.0, interval=0.1, desc="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:

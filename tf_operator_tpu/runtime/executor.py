@@ -27,6 +27,7 @@ import os
 import signal
 import socket
 import subprocess
+import sys
 import threading
 from dataclasses import dataclass
 from typing import Any
@@ -37,27 +38,41 @@ from tf_operator_tpu.runtime.client import ADDED, DELETED, ClusterClient, NotFou
 from tf_operator_tpu.utils import logger
 
 
-# Resolved at import time: preexec_fn runs in the fork-child of a
-# multithreaded process, where an `import` or dlopen can deadlock on locks
-# some other thread held at fork() — the child may only make the
-# already-bound C call.
-try:
-    import ctypes as _ctypes
+# prctl(PR_SET_PDEATHSIG, SIGTERM) is armed by a tiny exec shim INSIDE
+# the child, not a preexec_fn: preexec_fn forces CPython's subprocess
+# down the raw fork() path, and in a process where JAX is initialized
+# (the executor runs in-process with training in several E2Es) every pod
+# launch then fires JAX's at-fork RuntimeWarning — with a real deadlock
+# risk behind it, since fork-children of a multithreaded parent may only
+# run async-signal-safe code. The shim lets the parent use the
+# posix_spawn fast path; the child arms pdeathsig and execs the real
+# command. The shim window (parent dying between spawn and prctl) is the
+# same race preexec_fn had.
+_PDEATHSIG_SHIM = (
+    "import os, sys\n"
+    "try:\n"
+    "    import ctypes, signal\n"
+    "    ctypes.CDLL(None, use_errno=True).prctl("
+    "1, signal.SIGTERM.value, 0, 0, 0)\n"
+    "except Exception:\n"
+    "    pass  # no prctl (non-Linux): plain exec\n"
+    "try:\n"
+    "    os.execvp(sys.argv[1], sys.argv[1:])\n"
+    "except OSError as e:\n"
+    "    print(f'spawn failed: {e}', file=sys.stderr)\n"
+    "    sys.exit(127)  # the kubelet-convention 'command not found'\n"
+)
 
-    _LIBC_PRCTL = _ctypes.CDLL(None, use_errno=True).prctl
-except Exception:  # noqa: BLE001 — platform without CDLL(None)/prctl
-    _LIBC_PRCTL = None
 
-
-def _arm_pdeathsig() -> None:
-    """Child-side prctl(PR_SET_PDEATHSIG, SIGTERM): pods die with the
-    executor even when it is SIGKILLed (no chance to run cleanup).
-    Best-effort: no-op where prctl is unavailable."""
-    if _LIBC_PRCTL is not None:
-        try:
-            _LIBC_PRCTL(1, signal.SIGTERM, 0, 0, 0)
-        except Exception:  # noqa: BLE001
-            pass
+def _with_pdeathsig(command: list) -> list:
+    """Wrap a pod argv so the child dies with the executor even when the
+    executor is SIGKILLed (a real kubelet's containers die with their
+    node agent too). Best-effort: Linux-only semantics; the shim is a
+    plain exec elsewhere. ``-I`` (isolated) skips site processing — the
+    operator venv's sitecustomize must not boot a TPU runtime inside
+    every pod child — and an unexecutable command exits 127 like the
+    old parent-side spawn-failure path."""
+    return [sys.executable, "-I", "-c", _PDEATHSIG_SHIM, *command]
 
 
 def _free_port() -> int:
@@ -278,14 +293,10 @@ class LocalProcessExecutor:
             pass
         try:
             proc = subprocess.Popen(
-                command,
+                _with_pdeathsig(command),
                 env=env,
                 stdout=log_file or subprocess.DEVNULL,
                 stderr=subprocess.STDOUT if log_file else subprocess.DEVNULL,
-                # A SIGKILLed operator must not leak its pod processes (a
-                # real kubelet's containers die with their node agent too);
-                # best-effort — Linux-only, no-op elsewhere.
-                preexec_fn=_arm_pdeathsig,
             )
         except OSError as e:
             self._fail_pod(pod, 127, f"spawn failed: {e}")
@@ -342,6 +353,16 @@ class LocalProcessExecutor:
         policy = pod.get("spec", {}).get("restartPolicy", "Never")
         should_restart = policy == "Always" or (policy == "OnFailure" and code != 0)
         if should_restart and self._stop is not None and not self._stop.is_set():
+            if code != 0 and running.restart_count:
+                # CrashLoopBackOff analog: a command that fails instantly
+                # (e.g. the exec shim's 127 for a bad argv) must not spin
+                # the relaunch loop hot. Capped exponential, resets with
+                # each new pod incarnation like the kubelet's.
+                self._stop.wait(
+                    min(0.5 * 2 ** min(running.restart_count, 6), 30.0)
+                )
+                if self._stop.is_set():
+                    return
             try:  # pod may be gone or recreated (new UID) by now
                 fresh = self._client.get(
                     objects.PODS, objects.namespace_of(pod), objects.name_of(pod)
