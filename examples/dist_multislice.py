@@ -34,6 +34,10 @@ def main() -> int:
     # ~1.4 away, so 0.5 still cleanly discriminates "DCN moved data" from
     # "slices trained alone".
     p.add_argument("--tol", type=float, default=0.5)
+    p.add_argument("--fsdp", action="store_true",
+                   help="shard params + momentum over the IN-SLICE axis "
+                        "(ZeRO within each slice, dp across DCN) instead "
+                        "of replicating — the dcn x fsdp deployment shape")
     args = p.parse_args()
 
     import jax
@@ -54,6 +58,17 @@ def main() -> int:
     mesh = Mesh(devices, ("dp",))
     sharding = NamedSharding(mesh, P("dp"))
     replicated = NamedSharding(mesh, P())
+    # --fsdp: params + momentum live sharded over the slice's devices
+    # (dim 0 over the in-slice axis); only the per-step cross-slice sync
+    # gathers them. The same axis shards the batch rows, so in-slice
+    # collectives (param all-gather, grad reduce-scatter — XLA inserts
+    # them under the shardings) ride ICI while DCN carries one param-set
+    # per step, exactly the dcn x fsdp shape of dryrun_multichip path 6b.
+    w_sharding = NamedSharding(mesh, P("dp")) if args.fsdp else replicated
+    if args.fsdp and args.dim % len(devices):
+        raise SystemExit(f"--fsdp: --dim {args.dim} must divide by "
+                         f"{len(devices)} in-slice devices")
+    gather = jax.jit(lambda a: a, out_shardings=replicated)
 
     # Ground truth differs per slice: w*_slice = base + slice_id. The
     # cross-slice mean of the optima is base + (num_slices-1)/2; only a
@@ -63,8 +78,11 @@ def main() -> int:
     w_true_local = w_base + np.float32(slice_id)
     w_true_global = w_base + np.float32((num_slices - 1) / 2)
 
+    mu = 0.5 if args.fsdp else 0.0  # momentum: gives --fsdp an optimizer
+    # moment to shard; the fixed point is unchanged.
+
     @jax.jit
-    def step(w, x, y):
+    def step(w, v, x, y):
         def loss_fn(w):
             pred = x @ w
             return jnp.mean((pred - y) ** 2)
@@ -72,9 +90,11 @@ def main() -> int:
         loss, g = jax.value_and_grad(loss_fn)(w)
         # In-slice dp: batch rows sharded over the slice's processes; the
         # gradient mean is a psum XLA inserts under the sharding.
-        return w - args.lr * g, loss
+        v = mu * v + g
+        return w - args.lr * v, v, loss
 
-    w = jax.device_put(jnp.zeros((args.dim,), jnp.float32), replicated)
+    w = jax.device_put(jnp.zeros((args.dim,), jnp.float32), w_sharding)
+    v = jax.device_put(jnp.zeros((args.dim,), jnp.float32), w_sharding)
     data_rng = np.random.default_rng(1000 + slice_id)
     loss0 = None
     for i in range(args.steps):
@@ -86,17 +106,40 @@ def main() -> int:
         yg = jax.make_array_from_callback(
             y.shape, sharding, lambda idx: y[idx]
         )
-        w, loss = step(w, xg, yg)
+        w, v, loss = step(w, v, xg, yg)
         if loss0 is None:
             loss0 = float(loss)
-        # Cross-slice param sync each step (sync data-parallel over DCN).
+        # Cross-slice sync each step (sync data-parallel over DCN): the
+        # sharded state is gathered for the host-side DCN hop and
+        # re-sharded on return — momentum too, so every slice runs the
+        # identical optimizer trajectory.
         w = jax.device_put(
-            jnp.asarray(dcn.cross_slice_mean(channel, np.asarray(w))),
-            replicated,
+            jnp.asarray(dcn.cross_slice_mean(channel, np.asarray(gather(w)))),
+            w_sharding,
         )
+        if args.fsdp:
+            v = jax.device_put(
+                jnp.asarray(
+                    dcn.cross_slice_mean(channel, np.asarray(gather(v)))
+                ),
+                w_sharding,
+            )
 
-    err = float(np.linalg.norm(np.asarray(w) - w_true_global))
-    local_err = float(np.linalg.norm(np.asarray(w) - w_true_local))
+    if args.fsdp:
+        # The shape claim itself: params and the momentum moment are
+        # genuinely sharded over the in-slice axis.
+        for name, arr in (("w", w), ("v", v)):
+            spec = str(getattr(arr.sharding, "spec", ""))
+            if "dp" not in spec:
+                print(f"dist_multislice: {name} not in-slice sharded "
+                      f"({spec!r})")
+                return 1
+        print(f"dist_multislice: fsdp state sharded over "
+              f"{len(devices)} in-slice devices", flush=True)
+
+    w_full = np.asarray(gather(w))
+    err = float(np.linalg.norm(w_full - w_true_global))
+    local_err = float(np.linalg.norm(w_full - w_true_local))
     print(
         f"dist_multislice: slice {slice_id}/{num_slices} proc "
         f"{topo.process_id}/{topo.num_processes} loss0={loss0:.3f} "
@@ -106,8 +149,8 @@ def main() -> int:
 
     # Cross-slice agreement: every slice must hold the identical params.
     if channel is not None:
-        mean_w = dcn.cross_slice_mean(channel, np.asarray(w))
-        agreement = float(np.linalg.norm(mean_w - np.asarray(w)))
+        mean_w = dcn.cross_slice_mean(channel, w_full)
+        agreement = float(np.linalg.norm(mean_w - w_full))
         if agreement > 1e-5:
             print(f"dist_multislice: DIVERGED across slices ({agreement})")
             return 1
