@@ -112,16 +112,18 @@ def main() -> int:
         # Cross-slice sync each step (sync data-parallel over DCN): the
         # sharded state is gathered for the host-side DCN hop and
         # re-sharded on return — momentum too, so every slice runs the
-        # identical optimizer trajectory.
-        w = jax.device_put(
-            jnp.asarray(dcn.cross_slice_mean(channel, np.asarray(gather(w)))),
-            w_sharding,
-        )
+        # identical optimizer trajectory. One pytree exchange: DCN
+        # latency dominates the sync, so {w, v} share a round trip.
         if args.fsdp:
-            v = jax.device_put(
-                jnp.asarray(
-                    dcn.cross_slice_mean(channel, np.asarray(gather(v)))
-                ),
+            synced = dcn.cross_slice_mean(
+                channel,
+                {"w": np.asarray(gather(w)), "v": np.asarray(gather(v))},
+            )
+            w = jax.device_put(jnp.asarray(synced["w"]), w_sharding)
+            v = jax.device_put(jnp.asarray(synced["v"]), w_sharding)
+        else:
+            w = jax.device_put(
+                jnp.asarray(dcn.cross_slice_mean(channel, np.asarray(w))),
                 w_sharding,
             )
 

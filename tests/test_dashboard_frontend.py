@@ -180,6 +180,67 @@ def test_clone_flow_wiring(dashboard):
         assert marker in card, marker
 
 
+def test_create_form_args_resources_roundtrip(dashboard):
+    """Round-5 create-form depth (reference parity: CreateReplicaSpec's
+    args + gpuCount fields, generalized to requests/limits): a job POSTed
+    exactly as the form's buildJob() emits it must pass validation and
+    the launched pods must inherit args and resources verbatim."""
+    import time as _t
+
+    job = {
+        "apiVersion": "tpuflow.org/v1", "kind": "TPUJob",
+        "metadata": {"name": "form-depth", "namespace": "default"},
+        "spec": {"cleanPodPolicy": "Running", "replicaSpecs": {"Worker": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": {"spec": {"containers": [{
+                "name": "tensorflow", "image": "tpu-operator/test-server",
+                "command": ["python", "train.py"],
+                "args": ["--steps", "100"],
+                "resources": {
+                    "requests": {"cpu": "500m", "memory": "1Gi"},
+                    "limits": {"cpu": "1", "memory": "2Gi"},
+                },
+            }]}}}}},
+    }
+    code, body = fetch(dashboard, "/tpujobs/api/tpujob", "POST", job)
+    assert code in (200, 201), body
+    try:
+        deadline = _t.monotonic() + 10
+        pods = []
+        while _t.monotonic() < deadline and not pods:
+            code, body = fetch(
+                dashboard, "/tpujobs/api/tpujob/default/form-depth")
+            assert code == 200
+            pods = json.loads(body).get("pods", [])
+            _t.sleep(0.3)
+        assert pods, "controller never created pods"
+        c = pods[0]["spec"]["containers"][0]
+        assert c["args"] == ["--steps", "100"]
+        assert c["resources"]["requests"] == {"cpu": "500m",
+                                              "memory": "1Gi"}
+        assert c["resources"]["limits"] == {"cpu": "1", "memory": "2Gi"}
+    finally:
+        fetch(dashboard, "/tpujobs/api/tpujob/default/form-depth", "DELETE")
+
+
+def test_create_form_depth_wiring():
+    """The form writes args/resources and both preview and deploy share
+    one builder (what you preview is what gets POSTed); prefill reads
+    back every new field (clone drift fails here)."""
+    src = open(os.path.join(FRONTEND, "app.js")).read()
+    card = src[src.index("function replicaSpecCard"):
+               src.index("async function createView")]
+    for marker in ("c0.args", "container.args", "c0.resources",
+                   "container.resources", "requests", "limits"):
+        assert marker in card, marker
+    create = src[src.index("async function createView"):
+                 src.index("// ---------- router")]
+    assert "buildJob" in create
+    assert "manifest-preview" in create
+    # The submit path and the preview path both call the shared builder.
+    assert create.count("buildJob()") >= 2
+
+
 def test_detail_view_renders_volumes():
     """The volumes card (reference-parity detail field): one row per
     (role, volume) with hostPath source and container mount paths."""

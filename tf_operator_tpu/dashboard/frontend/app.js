@@ -316,6 +316,22 @@ function replicaSpecCard(onRemove, initType, initSpec) {
   const image = h("input", { "data-k": "image", value: c0.image || "tpu-operator/test-server" });
   const command = h("textarea", { "data-k": "command", placeholder: '["python", "train.py"] (JSON array, optional)' });
   if (c0.command) command.value = JSON.stringify(c0.command);
+  const cmdArgs = h("textarea", { "data-k": "args", placeholder: '["--steps", "100"] (JSON array, optional)' });
+  if (c0.args) cmdArgs.value = JSON.stringify(c0.args);
+  // Per-replica compute resources (reference parity: CreateReplicaSpec's
+  // gpuCount — generalized to the requests/limits the scheduler uses).
+  const res = {};
+  for (const key of ["reqCpu", "reqMem", "limCpu", "limMem"]) {
+    res[key] = h("input", { class: "kv", "data-k": key, placeholder: {
+      reqCpu: "cpu request (500m)", reqMem: "memory request (1Gi)",
+      limCpu: "cpu limit", limMem: "memory limit",
+    }[key] });
+  }
+  const initRes = c0.resources || {};
+  res.reqCpu.value = initRes.requests?.cpu || "";
+  res.reqMem.value = initRes.requests?.memory || "";
+  res.limCpu.value = initRes.limits?.cpu || "";
+  res.limMem.value = initRes.limits?.memory || "";
   const restart = h("select", { "data-k": "restart" }, ...RESTART_POLICIES.map((p) => h("option", { value: p }, p)));
   if (init.restartPolicy) restart.value = init.restartPolicy;
 
@@ -388,6 +404,10 @@ function replicaSpecCard(onRemove, initType, initSpec) {
     h("label", {}, "Restart policy"), restart,
     h("label", {}, "Image"), image,
     h("label", {}, "Command"), command,
+    h("label", {}, "Args"), cmdArgs,
+    h("label", {}, "Resources"),
+    h("div", { class: "kv-row" }, res.reqCpu, res.reqMem),
+    h("div", { class: "kv-row" }, res.limCpu, res.limMem),
     envRows.el,
     volRows.el
   );
@@ -396,6 +416,19 @@ function replicaSpecCard(onRemove, initType, initSpec) {
     const container = { name: "tensorflow", image: image.value.trim() };
     const cmd = command.value.trim();
     if (cmd) container.command = JSON.parse(cmd);
+    const argv = cmdArgs.value.trim();
+    if (argv) container.args = JSON.parse(argv);
+    const requests = {};
+    if (res.reqCpu.value.trim()) requests.cpu = res.reqCpu.value.trim();
+    if (res.reqMem.value.trim()) requests.memory = res.reqMem.value.trim();
+    const limits = {};
+    if (res.limCpu.value.trim()) limits.cpu = res.limCpu.value.trim();
+    if (res.limMem.value.trim()) limits.memory = res.limMem.value.trim();
+    if (Object.keys(requests).length || Object.keys(limits).length) {
+      container.resources = {};
+      if (Object.keys(requests).length) container.resources.requests = requests;
+      if (Object.keys(limits).length) container.resources.limits = limits;
+    }
     const env = envRows.read().map((r) => ({ name: r.name, value: r.value }));
     if (env.length) container.env = env;
     const vols = volRows.read();
@@ -482,31 +515,59 @@ async function createView(prefill) {
       h("label", {}, "Scheduler"), scheduler
     ),
     errBox,
-    h("div", { style: "margin-top:1rem" }, h("button", { type: "submit" }, "Deploy"))
+    h("pre", { id: "manifest-preview", class: "hidden" }),
+    h("div", { style: "margin-top:1rem" },
+      h("button", { type: "submit" }, "Deploy"),
+      h("button", {
+        type: "button", class: "ghost", style: "margin-left:.5rem",
+        onclick: () => previewManifest(),
+      }, "Preview manifest")
+    )
   );
+
+  // One builder for both Deploy and Preview: what you preview is
+  // byte-for-byte what gets POSTed (kubectl users can paste it into a
+  // manifest for `tpuctl apply -f`).
+  const buildJob = () => {
+    const replicaSpecs = {};
+    for (const card of specsHost.querySelectorAll(".replica-spec")) {
+      const [type, spec] = card.readSpec();
+      if (replicaSpecs[type]) throw new Error(`duplicate replica role ${type}`);
+      replicaSpecs[type] = spec;
+    }
+    const job = {
+      apiVersion: "tpuflow.org/v1",
+      kind: "TPUJob",
+      metadata: { name: name.value.trim(), namespace: namespace.value.trim() || "default" },
+      spec: { replicaSpecs, cleanPodPolicy: cleanPolicy.value },
+    };
+    if (ttl.value) job.spec.ttlSecondsAfterFinished = parseInt(ttl.value, 10);
+    if (gang.checked || scheduler.value.trim()) {
+      job.spec.scheduling = { gang: gang.checked };
+      if (scheduler.value.trim()) job.spec.scheduling.schedulerName = scheduler.value.trim();
+    }
+    return job;
+  };
+
+  const previewManifest = () => {
+    const pre = document.getElementById("manifest-preview");
+    errBox.classList.add("hidden");
+    try {
+      pre.textContent = JSON.stringify(buildJob(), null, 2);
+      pre.classList.remove("hidden");
+    } catch (e) {
+      pre.classList.add("hidden");
+      errBox.textContent = "Invalid form: " + e.message;
+      errBox.classList.remove("hidden");
+    }
+  };
 
   form.addEventListener("submit", async (ev) => {
     ev.preventDefault();
     errBox.classList.add("hidden");
     let job;
     try {
-      const replicaSpecs = {};
-      for (const card of specsHost.querySelectorAll(".replica-spec")) {
-        const [type, spec] = card.readSpec();
-        if (replicaSpecs[type]) throw new Error(`duplicate replica role ${type}`);
-        replicaSpecs[type] = spec;
-      }
-      job = {
-        apiVersion: "tpuflow.org/v1",
-        kind: "TPUJob",
-        metadata: { name: name.value.trim(), namespace: namespace.value.trim() || "default" },
-        spec: { replicaSpecs, cleanPodPolicy: cleanPolicy.value },
-      };
-      if (ttl.value) job.spec.ttlSecondsAfterFinished = parseInt(ttl.value, 10);
-      if (gang.checked || scheduler.value.trim()) {
-        job.spec.scheduling = { gang: gang.checked };
-        if (scheduler.value.trim()) job.spec.scheduling.schedulerName = scheduler.value.trim();
-      }
+      job = buildJob();
     } catch (e) {
       errBox.textContent = "Invalid form: " + e.message;
       errBox.classList.remove("hidden");
