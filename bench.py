@@ -367,18 +367,27 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
     # Store params in bf16: decode reads every weight per token, and f32
     # storage would double the traffic just to cast it down for the MXU.
     params_bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params0)
-    # Each step's attention reads the full (static-shape) K and V buffers.
-    kv_bytes = 2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
+    # Each step's attention reads the full (static-shape) K and V buffers:
+    # 2 bytes/elem bf16; 1 byte + a 4-byte per-(token, head) scale when
+    # the cache is int8 (kv_int8).
+    kv_elems = 2 * cfg.n_layers * B * cfg.max_seq_len
+    kv_bytes_bf16 = kv_elems * cfg.d_model * 2
+    kv_bytes_int8 = kv_elems * (cfg.d_model + cfg.n_heads * 4)
 
     # bf16 first (the established headline), then the int8 weight-only
     # leg (Pallas dequant-in-VMEM — ops/int8_dense.py): projections at 1
     # byte/weight, so the weight-read-bound step should approach 2x.
+    # Then the int8 KV-cache leg (cache read halved — the term that
+    # dominates as context grows) and both combined.
+    qparams = quantize_decode_params(params_bf16)
     legs = (
-        ("bf16", cfg, params_bf16),
-        ("int8", replace(cfg, int8_decode=True),
-         quantize_decode_params(params_bf16)),
+        ("bf16", cfg, params_bf16, kv_bytes_bf16),
+        ("int8", replace(cfg, int8_decode=True), qparams, kv_bytes_bf16),
+        ("kv8", replace(cfg, kv_int8=True), params_bf16, kv_bytes_int8),
+        ("int8kv8", replace(cfg, int8_decode=True, kv_int8=True),
+         qparams, kv_bytes_int8),
     )
-    for label, leg_cfg, params in legs:
+    for label, leg_cfg, params, kv_bytes in legs:
         leaves = jax.tree.leaves(params)
         params_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
         n_params = sum(x.size for x in leaves)

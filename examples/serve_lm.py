@@ -103,6 +103,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="weight-only int8 decode: quantize projections "
                         "after load (Pallas dequant-in-VMEM on TPU — "
                         "halves per-token weight reads; ops/int8_dense.py)")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV cache with per-(token, head) scales — "
+                        "halves the cache read that dominates decode as "
+                        "context grows; composes with --int8 (pure XLA, "
+                        "works under --tp)")
     p.add_argument("--requests", type=int, default=None,
                    help="exit 0 after serving this many /generate calls "
                         "(job mode); default: run until SIGTERM")
@@ -187,6 +192,11 @@ def main(argv: list[str] | None = None) -> int:
         params = quantize_decode_params(params)
         cfg = replace(cfg, int8_decode=True)
         print("serve_lm: projections quantized to int8", flush=True)
+    if args.kv_int8:
+        from dataclasses import replace
+
+        cfg = replace(cfg, kv_int8=True)
+        print("serve_lm: KV cache int8 (per-token/head scales)", flush=True)
 
     served = 0
     done = threading.Event()

@@ -467,17 +467,22 @@ def probe_decodesweep() -> None:
         # int8 leg: projection weights stored int8, dequantized in VMEM by
         # the Pallas kernel — the real decode-HBM optimization (the naive
         # XLA int8 path was rejected; docs/perf.md).
+        kv_elems = 2 * cfg.n_layers * B * cfg.max_seq_len
+        kv_bf16 = kv_elems * cfg.d_model * 2
+        kv_int8 = kv_elems * (cfg.d_model + cfg.n_heads * 4)
+        qparams = quantize_decode_params(params_bf16)
         variants = (
-            ("bf16", cfg, params_bf16),
-            ("int8", replace(cfg, int8_decode=True),
-             quantize_decode_params(params_bf16)),
+            ("bf16", cfg, params_bf16, kv_bf16),
+            ("int8", replace(cfg, int8_decode=True), qparams, kv_bf16),
+            # int8 KV cache: the cache-read half of the roofline (grows
+            # with context while weights amortize over batch).
+            ("kv8", replace(cfg, kv_int8=True), params_bf16, kv_int8),
+            ("int8kv8", replace(cfg, int8_decode=True, kv_int8=True),
+             qparams, kv_int8),
         )
-        for label, vcfg, params in variants:
+        for label, vcfg, params, kv_bytes in variants:
             params_bytes = sum(
                 x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
-            kv_bytes = (
-                2 * cfg.n_layers * B * cfg.max_seq_len * cfg.d_model * 2
-            )
 
             def call(vcfg=vcfg, params=params):
                 out = generate(vcfg, params, prompt, num_steps=steps)

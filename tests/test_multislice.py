@@ -340,7 +340,6 @@ def test_dcn_fsdp_shards_state_in_slice_only():
     import re
 
     import jax
-    from jax.sharding import NamedSharding
 
     from tf_operator_tpu.models.transformer import (
         Transformer,

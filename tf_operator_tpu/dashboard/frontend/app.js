@@ -415,9 +415,19 @@ function replicaSpecCard(onRemove, initType, initSpec) {
   card.readSpec = () => {
     const container = { name: "tensorflow", image: image.value.trim() };
     const cmd = command.value.trim();
-    if (cmd) container.command = JSON.parse(cmd);
+    // Both must be JSON ARRAYS of strings: a bare JSON string would
+    // pass JSON.parse and then explode into per-character argv elements
+    // in the executor's list() — fail the form instead.
+    const parseArgv = (text, label) => {
+      const v = JSON.parse(text);
+      if (!Array.isArray(v) || v.some((s) => typeof s !== "string")) {
+        throw new Error(`${label} must be a JSON array of strings`);
+      }
+      return v;
+    };
+    if (cmd) container.command = parseArgv(cmd, "command");
     const argv = cmdArgs.value.trim();
-    if (argv) container.args = JSON.parse(argv);
+    if (argv) container.args = parseArgv(argv, "args");
     const requests = {};
     if (res.reqCpu.value.trim()) requests.cpu = res.reqCpu.value.trim();
     if (res.reqMem.value.trim()) requests.memory = res.reqMem.value.trim();

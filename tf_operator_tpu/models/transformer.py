@@ -78,6 +78,16 @@ class TransformerConfig:
     # decode throughput. Params must come from ``quantize_decode_params``.
     # Only meaningful with decode=True; activations/KV cache stay bf16.
     int8_decode: bool = False
+    # int8 KV cache: keys/values live in HBM as int8 with a per-(token,
+    # head) f32 scale, halving the cache read that dominates long-context
+    # decode (per step the attention re-reads the WHOLE cache; weights
+    # amortize over batch, the cache does not). TPU-first factoring: the
+    # scale is constant over the reduced head_dim axis, so it comes OUT
+    # of both dots — scores = (q . k_int8) * k_scale and the value read
+    # folds v_scale into the tiny [b,h,q,k] probabilities — the MXU
+    # consumes the int8 cache via a fused convert, and no dequantized
+    # cache tensor ever materializes. Composes with int8_decode.
+    kv_int8: bool = False
     # Mixture-of-Experts: every Nth block (1-indexed from the first) swaps
     # its dense MLP for a Switch-routed expert MLP (models/moe.py) sharded
     # over ``ep_axis``. Train with make_lm_train_step(aux_loss_weight=...)
@@ -270,14 +280,38 @@ class Attention(nn.Module):
         """
         cfg = self.cfg
         b, t, h, dh = q.shape
+        kv8 = cfg.kv_int8
         cached_k = self.variable(
             "cache", "cached_key",
-            jnp.zeros, (b, cfg.max_seq_len, h, dh), cfg.dtype,
+            jnp.zeros, (b, cfg.max_seq_len, h, dh),
+            jnp.int8 if kv8 else cfg.dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            jnp.zeros, (b, cfg.max_seq_len, h, dh), cfg.dtype,
+            jnp.zeros, (b, cfg.max_seq_len, h, dh),
+            jnp.int8 if kv8 else cfg.dtype,
         )
+        if kv8:
+            # cfg.kv_int8: K/V live as int8 with a per-(token, head) f32
+            # symmetric scale — the cache read that bounds long-context
+            # decode drops to ~half (1 byte/elem + 1/Dh sidecar). Each
+            # scale is constant along the reduced Dh axis, so it factors
+            # OUT of both attention dots below: the score matmul consumes
+            # the raw int8 keys (exact in bf16 — |q_i| <= 127 needs 7
+            # mantissa bits) rescaled on the [B,H,t,S] score tensor, and
+            # the value scale folds into the softmax probabilities. XLA
+            # fuses the int8->bf16 convert into the dot operand stream,
+            # so HBM sees only int8. Numeric contract (greedy-token
+            # agreement with the bf16 cache) pinned by
+            # tests/test_training.py::TestKvInt8Decode.
+            k_scale = self.variable(
+                "cache", "key_scale",
+                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+            )
+            v_scale = self.variable(
+                "cache", "value_scale",
+                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+            )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
@@ -289,17 +323,40 @@ class Attention(nn.Module):
             # matter here.
             return v
         idx = index.value
+        if kv8:
+            def quant(x):  # [b, t, h, dh] -> int8 values, [b, t, h] scales
+                xf = x.astype(jnp.float32)
+                s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+                return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+            k, ks = quant(k)
+            v, vs = quant(v)
+            k_scale.value = jax.lax.dynamic_update_slice(
+                k_scale.value, ks, (0, idx, 0)
+            )
+            v_scale.value = jax.lax.dynamic_update_slice(
+                v_scale.value, vs, (0, idx, 0)
+            )
+        else:
+            k, v = k.astype(cfg.dtype), v.astype(cfg.dtype)
         cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+            cached_k.value, k, (0, idx, 0, 0)
         )
         cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+            cached_v.value, v, (0, idx, 0, 0)
         )
         index.value = idx + t
+        keys = (
+            cached_k.value.astype(jnp.bfloat16) if kv8 else cached_k.value
+        )
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, cached_k.value,
+            "bqhd,bkhd->bhqk", q, keys,
             preferred_element_type=jnp.float32,
-        ) * (dh ** -0.5)
+        )
+        if kv8:
+            # scores[b,h,i,j] = (q . k8)[b,h,i,j] * ks[b,j,h].
+            s = s * k_scale.value.transpose(0, 2, 1)[:, :, None, :]
+        s = s * (dh ** -0.5)
         # Query row i (absolute position idx + i) sees keys <= idx + i.
         valid = (
             jnp.arange(cfg.max_seq_len)[None, :]
@@ -307,6 +364,9 @@ class Attention(nn.Module):
         )
         s = jnp.where(valid[None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        if kv8:
+            # Fold the value scale into the probabilities (same factoring).
+            p = p * v_scale.value.transpose(0, 2, 1)[:, :, None, :]
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", p, cached_v.value.astype(jnp.float32)
         )

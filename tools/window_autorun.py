@@ -58,7 +58,8 @@ STAGES = [
     ("lm_ab_flash", {"BENCH": "lm", "TPU_OPERATOR_ATTN": ""}, 1100.0),
     ("lm_ab_xla", {"BENCH": "lm", "TPU_OPERATOR_ATTN": "xla"}, 1100.0),
     ("lmsweep", {"PROBE": "lmsweep"}, 1500.0),
-    ("decodesweep", {"PROBE": "decodesweep"}, 900.0),
+    # 4 weight/cache variants (bf16, int8, kv8, int8kv8) x 2 batch sizes.
+    ("decodesweep", {"PROBE": "decodesweep"}, 1400.0),
     # Tail attribution: host input pipeline (CPU-only, cheap) and the
     # ResNet fwd/bwd split — consulted if the synthetic-vs-bench split
     # points at input/transfer or the gradient path respectively.
