@@ -335,6 +335,18 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
     )
 
 
+def kv_cache_bytes(cfg, batch: int, kv8: bool) -> int:
+    """Per-step KV-cache read bytes for the decode roofline: 2 (K and V)
+    x layers x batch x max_seq_len x d_model elems, 2 bytes/elem bf16 or
+    1 byte + a 4-byte per-(token, head) scale when cfg.kv_int8-style
+    quantization is on. THE single copy of this accounting — bench legs
+    and both decode probes import it."""
+    elems = 2 * cfg.n_layers * batch * cfg.max_seq_len
+    if kv8:
+        return elems * (cfg.d_model + cfg.n_heads * 4)
+    return elems * cfg.d_model * 2
+
+
 def bench_decode(peak_hbm_gbps: float | None) -> None:
     """Autoregressive KV-cache decoding, bf16 params, greedy.
 
@@ -367,12 +379,9 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
     # Store params in bf16: decode reads every weight per token, and f32
     # storage would double the traffic just to cast it down for the MXU.
     params_bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params0)
-    # Each step's attention reads the full (static-shape) K and V buffers:
-    # 2 bytes/elem bf16; 1 byte + a 4-byte per-(token, head) scale when
-    # the cache is int8 (kv_int8).
-    kv_elems = 2 * cfg.n_layers * B * cfg.max_seq_len
-    kv_bytes_bf16 = kv_elems * cfg.d_model * 2
-    kv_bytes_int8 = kv_elems * (cfg.d_model + cfg.n_heads * 4)
+    # Each step's attention reads the full (static-shape) K and V buffers.
+    kv_bytes_bf16 = kv_cache_bytes(cfg, B, kv8=False)
+    kv_bytes_int8 = kv_cache_bytes(cfg, B, kv8=True)
 
     # bf16 first (the established headline), then the int8 weight-only
     # leg (Pallas dequant-in-VMEM — ops/int8_dense.py): projections at 1

@@ -467,9 +467,8 @@ def probe_decodesweep() -> None:
         # int8 leg: projection weights stored int8, dequantized in VMEM by
         # the Pallas kernel — the real decode-HBM optimization (the naive
         # XLA int8 path was rejected; docs/perf.md).
-        kv_elems = 2 * cfg.n_layers * B * cfg.max_seq_len
-        kv_bf16 = kv_elems * cfg.d_model * 2
-        kv_int8 = kv_elems * (cfg.d_model + cfg.n_heads * 4)
+        kv_bf16 = bench.kv_cache_bytes(cfg, B, kv8=False)
+        kv_int8 = bench.kv_cache_bytes(cfg, B, kv8=True)
         qparams = quantize_decode_params(params_bf16)
         variants = (
             ("bf16", cfg, params_bf16, kv_bf16),
@@ -503,6 +502,74 @@ def probe_decodesweep() -> None:
                 mean_tokens_per_sec=B * steps / (sum(times) / len(times)),
                 params_mb=params_bytes / 1e6,
             )
+
+
+def probe_decodelong() -> None:
+    """LONG-context decode A/B: bf16 cache vs int8 cache (kv_int8) at a
+    context where the cache READ dominates the roofline. At the standard
+    decodesweep shapes (256-token budget) the KV cache is ~17% of the
+    per-step HBM read, so a cache-dtype change cannot move the headline;
+    at 4k context with the same model the cache is ~75% of the read and
+    kv_int8's halving should be directly visible in gen tok/s. Weights
+    stay bf16 on both legs — this probe isolates the cache term the way
+    decodesweep's int8 leg isolates the weight term."""
+    import jax
+    import jax.numpy as jnp
+
+    from dataclasses import replace
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer, TransformerConfig, generate,
+    )
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    B = 2 if smoke else 8
+    prompt_len = 24 if smoke else 3968
+    steps = 8 if smoke else 128
+    total = prompt_len + steps
+    cfg = TransformerConfig(
+        dtype=jnp.bfloat16,
+        **dict(bench.LM_SIZE, max_seq_len=total) if not smoke else dict(
+            vocab_size=256, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=total),
+    )
+    model = Transformer(cfg)
+    prompt = jnp.zeros((B, prompt_len), jnp.int32)
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        model.init(jax.random.PRNGKey(0), prompt)["params"],
+    )
+    params_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+    variants = (
+        ("bf16", cfg, bench.kv_cache_bytes(cfg, B, kv8=False)),
+        ("kv8", replace(cfg, kv_int8=True),
+         bench.kv_cache_bytes(cfg, B, kv8=True)),
+    )
+    for label, vcfg, kv_bytes in variants:
+        def call(vcfg=vcfg):
+            out = generate(vcfg, params, prompt, num_steps=steps)
+            int(out[0, -1])
+
+        try:
+            times = bench.timed_reps(call, reps=3, warmup=3)
+        except Exception as exc:  # noqa: BLE001 — per-variant isolation
+            emit("decodelong", batch=B, context=total, cache=label,
+                 error=repr(exc)[:200])
+            continue
+        dt = min(times)
+        emit(
+            "decodelong", batch=B, context=total, cache=label,
+            gen_tokens_per_sec=B * steps / dt,
+            hbm_gbps=((params_bytes + kv_bytes) * steps + params_bytes)
+            / dt / 1e9,
+            # mean vs best: the tunnel's intra-process ramp diagnostic
+            # (same cross-check decodesweep carries).
+            mean_tokens_per_sec=B * steps / (sum(times) / len(times)),
+            kv_read_fraction=round(
+                kv_bytes / (kv_bytes + params_bytes), 3),
+            params_mb=params_bytes / 1e6,
+        )
 
 
 def run_window() -> None:
@@ -633,6 +700,7 @@ PROBES = {
     "convsweep": probe_convsweep,
     "lmsweep": probe_lmsweep,
     "decodesweep": probe_decodesweep,
+    "decodelong": probe_decodelong,
 }
 
 

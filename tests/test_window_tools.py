@@ -145,6 +145,16 @@ def test_report_attribution_math_round3_shaped(tmp_path):
         {"probe": "decodesweep", "weights": "int8", "batch": 8,
          "gen_tokens_per_sec": 846.7, "hbm_gbps": 42.7},
     ]) + "\n")
+    (d / "decodelong.jsonl").write_text("\n".join(json.dumps(m) for m in [
+        {"probe": "decodelong", "batch": 8, "context": 4096,
+         "cache": "bf16", "gen_tokens_per_sec": 100.0,
+         "mean_tokens_per_sec": 95.0, "hbm_gbps": 80.0,
+         "kv_read_fraction": 0.758},
+        {"probe": "decodelong", "batch": 8, "context": 4096,
+         "cache": "kv8", "gen_tokens_per_sec": 160.0,
+         "mean_tokens_per_sec": 150.0, "hbm_gbps": 70.0,
+         "kv_read_fraction": 0.611},
+    ]) + "\n")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "window_report.py"),
          str(d)],
@@ -164,6 +174,10 @@ def test_report_attribution_math_round3_shaped(tmp_path):
     # (47.4/111 = 42.7%).
     assert "1.80x" in out
     assert "42.7" in out
+    # Long-context cache A/B: 160/100 = 1.60x kv8 speedup + the kv read
+    # fraction column.
+    assert "1.60x" in out and "cache-read halving pays off" in out
+    assert "75.8%" in out
 
 
 def test_prior_round_submit_median_picks_newest(tmp_path):

@@ -213,6 +213,29 @@ def main() -> int:
             print(f"\nint8 speedup at b8: **{fmt(sp, 2)}x** "
                   + ("(the VMEM-dequant kernel pays off)" if sp > 1.2
                      else "(below expectation — check kernel dispatch)"))
+
+    # Long-context cache A/B (decodelong): the shape where kv_int8's
+    # halved cache read can actually move the headline.
+    long_rows = [r for r in load(d, "decodelong") if "error" not in r]
+    if long_rows:
+        print("\n| context | cache | gen tok/s | mean tok/s | GB/s "
+              "| kv fraction of read |")
+        print("|---|---|---|---|---|---|")
+        for row in long_rows:
+            print(f"| {row.get('context')} | {row.get('cache')} "
+                  f"| {fmt(row.get('gen_tokens_per_sec'))} "
+                  f"| {fmt(row.get('mean_tokens_per_sec'))} "
+                  f"| {fmt(row.get('hbm_gbps'))} "
+                  f"| {fmt((row.get('kv_read_fraction') or 0) * 100)}% |")
+        lb = next((r for r in long_rows if r.get("cache") == "bf16"), None)
+        l8 = next((r for r in long_rows if r.get("cache") == "kv8"), None)
+        if (lb and l8 and lb.get("gen_tokens_per_sec")
+                and l8.get("gen_tokens_per_sec")):
+            sp = l8["gen_tokens_per_sec"] / lb["gen_tokens_per_sec"]
+            print(f"\nkv8 long-context speedup: **{fmt(sp, 2)}x** "
+                  + ("(cache-read halving pays off)" if sp > 1.15
+                     else "(cache term not dominant here — check "
+                          "kv_read_fraction)"))
     return 0
 
 
