@@ -238,6 +238,107 @@ def test_tpuctl_verbs_over_http(operator_proc, capsys, tmp_path):
     ) == 0
 
 
+def test_tpuctl_yaml_output_and_follow_logs(operator_proc, capsys, tmp_path):
+    """Round-5 kubectl-parity depth: `-o yaml` round-trips through a YAML
+    parser, and `logs -f` streams lines appended AFTER the first fetch
+    (polled increments against the live spool)."""
+    import yaml
+
+    base, _ = operator_proc
+    from tf_operator_tpu.cli import tpuctl
+
+    job = synthetic_job(
+        "ctl-yf", "default", workers=1, accelerator=None, scheduler=None,
+        command=[sys.executable, "-u", "-c",
+                 "import time\n"
+                 "print('line-early', flush=True)\n"
+                 "time.sleep(2.5)\n"
+                 "print('line-late', flush=True)\n"],
+    )
+    manifest = tmp_path / "job.json"
+    manifest.write_text(json.dumps(job))
+    m = ["--master", base]
+    assert tpuctl.main(m + ["apply", "-f", str(manifest)]) == 0
+    capsys.readouterr()
+    try:
+        assert tpuctl.main(
+            m + ["wait", "default/ctl-yf", "--for", "Running",
+                 "--timeout", "30"]
+        ) == 0
+        capsys.readouterr()
+
+        assert tpuctl.main(
+            m + ["get", "job", "default/ctl-yf", "-o", "yaml"]
+        ) == 0
+        doc = yaml.safe_load(capsys.readouterr().out)
+        assert doc["metadata"]["name"] == "ctl-yf"
+        assert doc["kind"] == "TPUJob"
+        assert tpuctl.main(
+            m + ["get", "jobs", "-n", "default", "-o", "yaml"]
+        ) == 0
+        items = yaml.safe_load(capsys.readouterr().out)["items"]
+        assert any(j["metadata"]["name"] == "ctl-yf" for j in items)
+
+        # Follow: first fetch sees line-early; the increment printed by a
+        # later poll carries line-late (written ~2.5s in).
+        assert tpuctl.main(
+            m + ["logs", "default/ctl-yf-worker-0", "-f",
+                 "--follow-interval", "0.5", "--follow-polls", "12"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "line-early" in out
+        assert "line-late" in out
+    finally:
+        tpuctl.main(m + ["delete", "default/ctl-yf"])
+        capsys.readouterr()
+
+
+def test_podlogs_stream_contract(tmp_path, monkeypatch):
+    """read_log_stream: absolute offsets stay byte-exact past the 1 MiB
+    tail cap (where the old length heuristic stalled forever), a changed
+    spool id (recreated pod) restarts from 0, and an offset past EOF
+    (truncation) resets — the server side of `tpuctl logs -f`."""
+    from tf_operator_tpu.runtime import podlogs
+
+    monkeypatch.setenv("TPU_OPERATOR_LOG_DIR", str(tmp_path))
+    path = podlogs.log_path("default", "p", "uid00001")
+    with open(path, "w") as f:
+        f.write("A" * 10)
+    chunk, off, spool = podlogs.read_log_stream("default", "p", 0)
+    assert chunk == "A" * 10 and off == 10 and spool.endswith(".log")
+    # Append and read the increment only.
+    with open(path, "a") as f:
+        f.write("B" * 5)
+    chunk, off, _ = podlogs.read_log_stream("default", "p", off, spool)
+    assert chunk == "B" * 5 and off == 15
+    # Cross the tail cap: grow the file past 1 MiB; the stream keeps
+    # absolute offsets (chunked by max_bytes), never stalling.
+    with open(path, "a") as f:
+        f.write("C" * (1 << 20))
+    total_read = 0
+    while True:
+        chunk, off, _ = podlogs.read_log_stream("default", "p", off, spool)
+        if not chunk:
+            break
+        total_read += len(chunk)
+    assert total_read == 1 << 20 and off == 15 + (1 << 20)
+    # Recreated pod (new uid, newer spool): unknown spool id -> reset.
+    import time as _t
+
+    _t.sleep(0.02)
+    path2 = podlogs.log_path("default", "p", "uid00002")
+    with open(path2, "w") as f:
+        f.write("fresh")
+    os.utime(path2)
+    chunk, off2, spool2 = podlogs.read_log_stream("default", "p", off, spool)
+    assert chunk == "fresh" and off2 == 5 and spool2 != spool
+    # Offset past EOF (truncation) resets to 0.
+    chunk, off3, _ = podlogs.read_log_stream("default", "p", 99, spool2)
+    assert chunk == "fresh" and off3 == 5
+    # Nothing spooled at all -> None.
+    assert podlogs.read_log_stream("default", "nope", 0) is None
+
+
 def test_tpuctl_rejects_bad_input(operator_proc, tmp_path):
     base, _ = operator_proc
     from tf_operator_tpu.cli import tpuctl

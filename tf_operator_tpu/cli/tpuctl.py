@@ -5,12 +5,12 @@ The reference assumes kubectl for every user interaction (README.md:16-18:
 speaks its own REST dialect, so a standalone deployment needs its own
 ctl. Commands mirror the kubectl verbs users already know:
 
-    tpuctl get jobs [-n NS]                 # table of TPUJobs
-    tpuctl get job NS/NAME [-o json]        # one job (table row or JSON)
+    tpuctl get jobs [-n NS] [-w]            # table of TPUJobs (stream w/ -w)
+    tpuctl get job NS/NAME [-o json|yaml]   # one job (table row or doc)
     tpuctl describe NS/NAME                 # conditions/replicas/pods/events
     tpuctl apply -f job.json|yaml           # create (json or yaml, - = stdin)
     tpuctl delete NS/NAME
-    tpuctl logs NS/POD                      # pod logs via the dashboard API
+    tpuctl logs NS/POD [-f]                 # pod logs (stream with -f)
     tpuctl wait NS/NAME [--for Succeeded] [--timeout 300]
 
 The server is ``--master`` / $TPU_OPERATOR_MASTER (default
@@ -103,11 +103,19 @@ def _job_row(j: dict[str, Any]) -> list[str]:
     ]
 
 
+def _dump(obj, fmt: str) -> str:
+    if fmt == "yaml":
+        import yaml
+
+        return yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
+    return json.dumps(obj, indent=2)
+
+
 def cmd_get(args, client: TPUJobClient) -> int:
     if args.kind in ("jobs", "tpujobs"):
         jobs = client.list(args.namespace)
-        if args.output == "json":
-            print(json.dumps({"items": jobs}, indent=2))
+        if args.output in ("json", "yaml"):
+            print(_dump({"items": jobs}, args.output))
             return 0
         rows = [_job_row(j) for j in jobs]
         print(_table(rows, ["NAMESPACE", "NAME", "STATE", "REPLICAS", "AGE"]))
@@ -135,8 +143,8 @@ def cmd_get(args, client: TPUJobClient) -> int:
     if args.kind in ("job", "tpujob"):
         ns, name = _split_ref(args.name or "", "job")
         job = client.get(ns, name)
-        if args.output == "json":
-            print(json.dumps(job, indent=2))
+        if args.output in ("json", "yaml"):
+            print(_dump(job, args.output))
         else:
             print(_table(
                 [[ns, name, _state(job), _replicas(job),
@@ -159,8 +167,8 @@ def cmd_get(args, client: TPUJobClient) -> int:
             ]
             for p in pods
         ]
-        if args.output == "json":
-            print(json.dumps({"items": pods}, indent=2))
+        if args.output in ("json", "yaml"):
+            print(_dump({"items": pods}, args.output))
         else:
             print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "AGE"]))
         return 0
@@ -258,22 +266,62 @@ def cmd_delete(args, client: TPUJobClient) -> int:
     return 0
 
 
-def cmd_logs(args, master: str) -> int:
-    ns, pod = _split_ref(args.ref, "pod")
-    url = f"{master.rstrip('/')}/tpujobs/api/pod/{ns}/{pod}/logs"
+def _logs_request(master: str, ns: str, pod: str, params: str = ""):
+    url = f"{master.rstrip('/')}/tpujobs/api/pod/{ns}/{pod}/logs{params}"
     req = urllib.request.Request(url)
     token = os.environ.get("TPU_OPERATOR_API_TOKEN")
     if token:
         req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def cmd_logs(args, master: str) -> int:
+    ns, pod = _split_ref(args.ref, "pod")
+    if not args.follow:
+        try:
+            body = _logs_request(master, ns, pod)
+        except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+            raise SystemExit(
+                f"tpuctl: logs unavailable ({e.code}) — is the operator "
+                "running with --dashboard?"
+            ) from None
+        sys.stdout.write(body.get("logs") or "(no logs)\n")
+        return 0
+    # kubectl logs -f: the server's streaming contract (?offset=&spool=)
+    # returns the appended chunk since the absolute offset in the named
+    # spool — byte-exact across the tail cap, and a changed spool id
+    # (controller-recreated pod) restarts the stream from 0. A 404 means
+    # no logs spooled YET: keep polling like kubectl does, rather than
+    # dying before the pod's first line. --follow-polls bounds the loop
+    # for scripts/tests; default follows until interrupted.
+    offset, spool, polls = 0, "", 0
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            body = json.loads(resp.read())
-    except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
-        raise SystemExit(
-            f"tpuctl: logs unavailable ({e.code}) — is the operator running "
-            "with --dashboard?"
-        ) from None
-    sys.stdout.write(body.get("logs") or "(no logs)\n")
+        while args.follow_polls is None or polls < args.follow_polls:
+            if polls:
+                time.sleep(args.follow_interval)
+            polls += 1
+            try:
+                from urllib.parse import quote
+
+                body = _logs_request(
+                    master, ns, pod, f"?offset={offset}&spool={quote(spool)}"
+                )
+            except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+                if e.code == 404:
+                    continue
+                raise SystemExit(
+                    f"tpuctl: logs unavailable ({e.code}) — is the "
+                    "operator running with --dashboard?"
+                ) from None
+            chunk = body.get("logs") or ""
+            if chunk:
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            offset = int(body.get("offset", offset))
+            spool = body.get("spool", spool)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -324,7 +372,7 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("name", nargs="?", default=None,
                    help="NS/NAME (for `get job`; job selector for pods)")
     g.add_argument("-n", "--namespace", default=None)
-    g.add_argument("-o", "--output", choices=("table", "json"),
+    g.add_argument("-o", "--output", choices=("table", "json", "yaml"),
                    default="table")
     g.add_argument("-w", "--watch", action="store_true",
                    help="after listing, stream update rows (kubectl -w)")
@@ -343,6 +391,13 @@ def main(argv: list[str] | None = None) -> int:
 
     lg = sub.add_parser("logs", help="pod logs (via the dashboard API)")
     lg.add_argument("ref", help="NAMESPACE/POD")
+    lg.add_argument("-f", "--follow", action="store_true",
+                    help="stream appended log lines (kubectl logs -f)")
+    lg.add_argument("--follow-interval", type=float, default=1.0,
+                    help="poll interval seconds for --follow")
+    lg.add_argument("--follow-polls", type=int, default=None,
+                    help="stop --follow after N polls (scripts/tests; "
+                         "default: until interrupted)")
 
     w = sub.add_parser("wait", help="block until a job condition")
     w.add_argument("ref", help="NAMESPACE/NAME")

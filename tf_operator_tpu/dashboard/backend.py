@@ -145,6 +145,31 @@ class DashboardBackend:
             return False
 
         if head == "pod" and method == "GET" and len(rest) == 3 and rest[2] == "logs":
+            from urllib.parse import parse_qs
+
+            query = parse_qs(urlparse(req.path).query)
+            if "offset" in query:
+                # Streaming contract (tpuctl logs -f): absolute offset +
+                # spool id -> the appended chunk since then; byte-exact
+                # across the 1 MiB tail cap and across pod incarnations.
+                try:
+                    offset = int(query.get("offset", ["0"])[0])
+                except ValueError:
+                    offset = 0
+                spool = query.get("spool", [""])[0]
+                got = podlogs.read_log_stream(rest[0], rest[1], offset, spool)
+                if got is None:
+                    self._send_json(
+                        req, {"error": "NotFound",
+                              "message": "no logs spooled"}, 404
+                    )
+                else:
+                    chunk, next_offset, spool_id = got
+                    self._send_json(req, {
+                        "logs": chunk, "offset": next_offset,
+                        "spool": spool_id,
+                    })
+                return True
             text = podlogs.read_log(rest[0], rest[1])
             if text is None:
                 self._send_json(

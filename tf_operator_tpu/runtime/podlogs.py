@@ -26,8 +26,7 @@ def log_path(namespace: str, name: str, uid: str) -> str:
     return os.path.join(log_dir(), f"{namespace}_{name}_{safe_uid}.log")
 
 
-def read_log(namespace: str, name: str, max_bytes: int = 1 << 20) -> str | None:
-    """Newest incarnation's log tail, or None if nothing was spooled."""
+def _newest_spool(namespace: str, name: str) -> str | None:
     prefix = f"{namespace}_{name}_"
     d = log_dir()
     candidates = [
@@ -35,11 +34,46 @@ def read_log(namespace: str, name: str, max_bytes: int = 1 << 20) -> str | None:
         for f in os.listdir(d)
         if f.startswith(prefix) and f.endswith(".log")
     ]
-    if not candidates:
+    return max(candidates, key=os.path.getmtime) if candidates else None
+
+
+def read_log(namespace: str, name: str, max_bytes: int = 1 << 20) -> str | None:
+    """Newest incarnation's log tail, or None if nothing was spooled."""
+    newest = _newest_spool(namespace, name)
+    if newest is None:
         return None
-    newest = max(candidates, key=os.path.getmtime)
     with open(newest, "rb") as f:
         f.seek(0, os.SEEK_END)
         size = f.tell()
         f.seek(max(0, size - max_bytes))
         return f.read().decode(errors="replace")
+
+
+def read_log_stream(
+    namespace: str, name: str, offset: int, spool: str = "",
+    max_bytes: int = 1 << 20,
+) -> tuple[str, int, str] | None:
+    """Incremental read for log following: (chunk, next_offset, spool_id).
+
+    ``offset`` is an ABSOLUTE byte position in the spool identified by
+    ``spool`` (the basename a previous call returned — it embeds the pod
+    uid, so a controller-recreated pod is a different id). A changed or
+    unknown spool id, or an offset past EOF (rotation/truncation), resets
+    to 0 so the caller reprints the new incarnation from its start —
+    tail-window length heuristics cannot distinguish any of these cases
+    (the old `tpuctl logs -f` stalled permanently once a spool crossed
+    the 1 MiB read_log cap). None when nothing is spooled yet."""
+    newest = _newest_spool(namespace, name)
+    if newest is None:
+        return None
+    spool_id = os.path.basename(newest)
+    if spool != spool_id:
+        offset = 0
+    with open(newest, "rb") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if offset > size:
+            offset = 0
+        f.seek(offset)
+        chunk = f.read(max_bytes)
+    return chunk.decode(errors="replace"), offset + len(chunk), spool_id
