@@ -19,7 +19,12 @@ generate()'s own validation). Generation runs the jitted KV-cache decode
 loop (batched single-pass prompt prefill + one-token sampling scan — one
 compile per (batch, prompt_len, num_steps, temperature, top_p)
 combination, so clients sweeping many distinct temperatures pay a
-recompile each). ``--requests`` bounds the serve
+recompile each). ``--batch-window MS`` coalesces concurrent greedy
+requests of the same shape into ONE batched decode (single-token decode
+is weight-read-bound, so a batch of b amortizes the dominant HBM read
+~b-fold; rows pad to power-of-two buckets to bound compile count;
+sampled requests keep their per-request rng and run solo).
+``--requests`` bounds the serve
 loop so the process terminates like a job (the operator's Succeeded
 condition); without it the server runs until SIGTERM.
 
@@ -111,6 +116,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--requests", type=int, default=None,
                    help="exit 0 after serving this many /generate calls "
                         "(job mode); default: run until SIGTERM")
+    p.add_argument("--batch-window", type=float, default=0.0, metavar="MS",
+                   help="coalesce concurrent greedy /generate requests of "
+                        "the same shape for this many ms and run them as "
+                        "ONE batched decode (single-token decode is "
+                        "weight-read-bound, so a batch of b amortizes the "
+                        "dominant HBM read ~b-fold). 0 = off")
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="row cap per coalesced batch (--batch-window)")
     args = p.parse_args(argv)
     if args.requests is not None and args.requests < 1:
         p.error("--requests must be >= 1 (omit it to serve until SIGTERM)")
@@ -202,6 +215,117 @@ def main(argv: list[str] | None = None) -> int:
     done = threading.Event()
     lock = threading.Lock()  # generate() calls serialized per chip
 
+    class Coalescer:
+        """Batch concurrent same-shape greedy requests into one decode.
+
+        Rows from requests sharing (prompt_len, num_steps) that arrive
+        within the window run as ONE generate() call, padded up to the
+        next power-of-two row count so the set of compiled batch shapes
+        stays small. Greedy-only: batching is output-invariant for
+        argmax decoding, while sampled requests carry per-request rngs
+        and run solo on the direct path."""
+
+        def __init__(self, window_s: float, max_rows: int):
+            self.window_s = window_s
+            self.max_rows = max_rows
+            self.cond = threading.Condition()
+            self.pending: list[dict] = []
+            self.batches = 0      # stats for /healthz (and tests)
+            self.max_rows_seen = 0
+
+        def submit(self, prompt, num_steps: int):
+            item = {
+                "key": (prompt.shape[1], num_steps),
+                "rows": prompt,
+                "event": threading.Event(),
+                "out": None,
+                "err": None,
+            }
+            with self.cond:
+                self.pending.append(item)
+                self.cond.notify()
+            if not item["event"].wait(timeout=300.0):
+                raise TimeoutError("coalesced decode timed out")
+            if item["err"] is not None:
+                raise item["err"]
+            return item["out"]
+
+        def _key_rows(self, key) -> int:
+            return sum(p["rows"].shape[0] for p in self.pending
+                       if p["key"] == key)
+
+        def _take_batch(self) -> list[dict]:
+            with self.cond:
+                # Wake exactly on submit()'s notify (or shutdown).
+                self.cond.wait_for(
+                    lambda: self.pending or done.is_set(), timeout=1.0
+                )
+                if not self.pending:
+                    return []
+                key = self.pending[0]["key"]
+                # Hold the window open until the batch fills (or closes).
+                self.cond.wait_for(
+                    lambda: self._key_rows(key) >= self.max_rows
+                    or done.is_set(),
+                    timeout=self.window_s,
+                )
+                take: list[dict] = []
+                total = 0
+                for p in [p for p in self.pending if p["key"] == key]:
+                    n = p["rows"].shape[0]
+                    if take and total + n > self.max_rows:
+                        break
+                    take.append(p)
+                    total += n
+                for p in take:
+                    self.pending.remove(p)
+            return take
+
+        def loop(self):
+            # Keep draining after shutdown begins: requests already
+            # queued must be answered (the direct path serves its
+            # in-flight requests too), never left to hang in submit().
+            while not done.is_set() or self.pending:
+                batch = self._take_batch()
+                if not batch:
+                    continue
+                try:
+                    num_steps = batch[0]["key"][1]
+                    rows = jnp.concatenate(
+                        [p["rows"] for p in batch], axis=0)
+                    k = rows.shape[0]
+                    bucket = 1
+                    while bucket < k:
+                        bucket *= 2
+                    if bucket > k:  # pad: bounded set of batch shapes
+                        rows = jnp.concatenate(
+                            [rows, jnp.zeros((bucket - k, rows.shape[1]),
+                                             rows.dtype)], axis=0)
+                    with lock:
+                        out = generate(cfg, params, rows,
+                                       num_steps=num_steps)
+                    self.batches += 1
+                    self.max_rows_seen = max(self.max_rows_seen, k)
+                    at = 0
+                    for p in batch:
+                        n = p["rows"].shape[0]
+                        p["out"] = out[at:at + n]
+                        at += n
+                except Exception as exc:  # noqa: BLE001 — a failed batch
+                    # must answer its clients AND leave the loop alive.
+                    for p in batch:
+                        p["err"] = exc
+                for p in batch:
+                    p["event"].set()
+
+    coalescer = None
+    if args.batch_window > 0:
+        coalescer = Coalescer(args.batch_window / 1e3, args.max_batch)
+        threading.Thread(target=coalescer.loop, daemon=True).start()
+        print(f"serve_lm: coalescing greedy requests "
+              f"(window {args.batch_window:.0f} ms, "
+              f"max batch {args.max_batch})", flush=True)
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -216,7 +340,11 @@ def main(argv: list[str] | None = None) -> int:
 
         def do_GET(self):
             if self.path == "/healthz":
-                self._json(200, {"ok": True, "served": served})
+                payload = {"ok": True, "served": served}
+                if coalescer is not None:
+                    payload["coalesced_batches"] = coalescer.batches
+                    payload["max_batch_rows"] = coalescer.max_rows_seen
+                self._json(200, payload)
             else:
                 self._json(404, {"error": "unknown path"})
 
@@ -246,10 +374,13 @@ def main(argv: list[str] | None = None) -> int:
                     # is rejected by generate() itself (a client-visible
                     # 400), never silently dropped.
                     kw["top_p"] = float(top_p)
-                with lock:
-                    out = generate(
-                        cfg, params, prompt, num_steps=num_steps, **kw
-                    )
+                if coalescer is not None and not kw:
+                    out = coalescer.submit(prompt, num_steps)
+                else:
+                    with lock:
+                        out = generate(
+                            cfg, params, prompt, num_steps=num_steps, **kw
+                        )
                 self._json(200, {"tokens": out.tolist()})
             except Exception as exc:  # noqa: BLE001 — client-visible error
                 self._json(400, {"error": repr(exc)})
