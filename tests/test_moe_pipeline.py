@@ -182,6 +182,39 @@ class Test1F1B:
             atol=1e-5, rtol=1e-4,
         )
 
+    def test_matches_with_remat_stage(self):
+        """jax.checkpoint-wrapped stage functions (cfg.remat's form on the
+        pp path) must not change 1F1B values or grads — the engine already
+        recomputes per stage in its backward tick, and remat nests inside
+        that recompute."""
+        from tf_operator_tpu.parallel.pipeline import pipeline_value_and_grad
+
+        rng = np.random.default_rng(13)
+        n_stages, num_micro, d, mb = 2, 4, 8, 4
+        params_list = _stage_params(rng, n_stages, d, 16)
+        stacked = stack_stage_params(params_list)
+        lp = {"wo": jnp.asarray(rng.normal(size=(d, 4)) * 0.1, jnp.float32)}
+        B = num_micro * mb
+        x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(B, 4)), jnp.float32)
+        mesh = create_mesh({"pp": n_stages}, jax.devices()[:n_stages])
+
+        outs = {}
+        for label, fn in (("plain", _mlp_stage),
+                          ("remat", jax.checkpoint(_mlp_stage))):
+            engine = pipeline_value_and_grad(fn, self._last_fn, mesh)
+            outs[label] = jax.jit(engine)(
+                stacked, lp, microbatch(x, num_micro),
+                microbatch(tgt, num_micro),
+            )
+        np.testing.assert_allclose(
+            float(outs["remat"][0]), float(outs["plain"][0]), rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5),
+            outs["remat"][1:], outs["plain"][1:],
+        )
+
     def test_composes_with_dp(self):
         from tf_operator_tpu.parallel.pipeline import pipeline_value_and_grad
 

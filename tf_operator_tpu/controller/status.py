@@ -96,6 +96,18 @@ def set_condition(status: TPUJobStatus, cond: JobCondition) -> None:
 
     for c in status.conditions:
         if c.type == cond.type:
+            if (
+                c.status == cond.status
+                and c.reason == cond.reason
+                and c.message == cond.message
+            ):
+                # Semantically identical: keep the existing timestamps.
+                # Re-stamping last_update_time here made every settled
+                # reconcile's status differ by one second-granularity
+                # field, defeating the controller's skip-unchanged write
+                # guard at 1 Hz per job (the status write emits the very
+                # watch event that re-enqueues the sync).
+                return
             transitioned = c.status != cond.status
             c.status = cond.status
             c.reason = cond.reason
