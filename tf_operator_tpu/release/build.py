@@ -77,8 +77,15 @@ def build_release(repo_root: str, out_dir: str,
 
     os.makedirs(out_dir, exist_ok=True)
     tar_path = os.path.join(out_dir, f"{name}.tar.gz")
-    # Deterministic tar: fixed mtime/uid/gid, sorted members.
-    with tarfile.open(tar_path, "w:gz") as tar:
+    # Deterministic tar: fixed mtime/uid/gid, sorted members — and the
+    # gzip header's own MTIME pinned to 0 (plain "w:gz" stamps the wall
+    # clock there, breaking byte-identical rebuilds across a second
+    # boundary).
+    import gzip
+
+    with open(tar_path, "wb") as raw, gzip.GzipFile(
+        fileobj=raw, mode="wb", mtime=0
+    ) as gz, tarfile.open(fileobj=gz, mode="w") as tar:
         for rel in files:
             full = os.path.join(repo_root, rel)
             info = tar.gettarinfo(full, arcname=f"{name}/{rel}")
