@@ -14,6 +14,8 @@ CLI:  python -m tf_operator_tpu.release.build --out dist/
 from __future__ import annotations
 
 import argparse
+import contextlib
+import gzip
 import hashlib
 import io
 import json
@@ -22,6 +24,21 @@ import shutil
 import tarfile
 import time
 from typing import Any
+
+
+@contextlib.contextmanager
+def open_deterministic_targz(path: str):
+    """tarfile writer whose output is byte-identical across rebuilds.
+
+    Plain ``tarfile.open(path, "w:gz")`` stamps the wall clock into the
+    gzip HEADER (byte 4), so two otherwise-identical builds crossing a
+    second boundary differ; an explicit GzipFile(mtime=0) pins it. ONE
+    copy of this contract — the source tarball and the deploy bundle
+    both write through it (member mtimes/owners are the caller's job)."""
+    with open(path, "wb") as raw, gzip.GzipFile(
+        fileobj=raw, mode="wb", mtime=0
+    ) as gz, tarfile.open(fileobj=gz, mode="w") as tar:
+        yield tar
 
 from tf_operator_tpu import version as version_mod
 from tf_operator_tpu.harness.prow import git_sha
@@ -77,15 +94,9 @@ def build_release(repo_root: str, out_dir: str,
 
     os.makedirs(out_dir, exist_ok=True)
     tar_path = os.path.join(out_dir, f"{name}.tar.gz")
-    # Deterministic tar: fixed mtime/uid/gid, sorted members — and the
-    # gzip header's own MTIME pinned to 0 (plain "w:gz" stamps the wall
-    # clock there, breaking byte-identical rebuilds across a second
-    # boundary).
-    import gzip
-
-    with open(tar_path, "wb") as raw, gzip.GzipFile(
-        fileobj=raw, mode="wb", mtime=0
-    ) as gz, tarfile.open(fileobj=gz, mode="w") as tar:
+    # Deterministic tar: fixed mtime/uid/gid, sorted members; the gzip
+    # header is pinned by open_deterministic_targz.
+    with open_deterministic_targz(tar_path) as tar:
         for rel in files:
             full = os.path.join(repo_root, rel)
             info = tar.gettarinfo(full, arcname=f"{name}/{rel}")

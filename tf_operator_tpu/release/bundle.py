@@ -109,16 +109,11 @@ def build_bundle(
     }
     os.makedirs(out_dir, exist_ok=True)
     tar_path = os.path.join(out_dir, f"{bundle_name}.tar.gz")
-    # Deterministic: fixed mtime/uid/gid, sorted members (same contract as
-    # build_release's source tarball) — and gzip mtime pinned to 0:
-    # plain "w:gz" stamps wall-clock seconds into the gzip HEADER, so two
-    # otherwise-identical builds crossing a second boundary differed at
-    # byte 4 (caught as a once-in-several-runs rebuild-determinism flake).
-    import gzip
+    # Deterministic: fixed mtime/uid/gid, sorted members, pinned gzip
+    # header — one shared contract with build_release's source tarball.
+    from tf_operator_tpu.release.build import open_deterministic_targz
 
-    with open(tar_path, "wb") as raw, gzip.GzipFile(
-        fileobj=raw, mode="wb", mtime=0
-    ) as gz, tarfile.open(fileobj=gz, mode="w") as tar:
+    with open_deterministic_targz(tar_path) as tar:
         for arcname in sorted(members):
             data = members[arcname].encode()
             info = tarfile.TarInfo(arcname)
