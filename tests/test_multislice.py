@@ -214,6 +214,7 @@ def test_two_process_group_rendezvous_trains_across_slices(stack):
 
 
 @pytest.mark.slow
+@pytest.mark.e2e_smoke
 def test_two_process_fsdp_state_sharded_across_slices(stack):
     """dcn x fsdp as a REAL multi-process job: 2 slices x 2 hosts; each
     slice's params + momentum are sharded over its own process group's
