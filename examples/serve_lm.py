@@ -230,6 +230,7 @@ def main(argv: list[str] | None = None) -> int:
             self.max_rows = max_rows
             self.cond = threading.Condition()
             self.pending: list[dict] = []
+            self.closed = False   # loop exited: no consumer remains
             self.batches = 0      # stats for /healthz (and tests)
             self.max_rows_seen = 0
 
@@ -242,6 +243,10 @@ def main(argv: list[str] | None = None) -> int:
                 "err": None,
             }
             with self.cond:
+                if self.closed:
+                    # The batcher has exited (shutdown): failing fast
+                    # beats queueing where no consumer will ever look.
+                    raise RuntimeError("server shutting down")
                 self.pending.append(item)
                 self.cond.notify()
             if not item["event"].wait(timeout=300.0):
@@ -285,6 +290,19 @@ def main(argv: list[str] | None = None) -> int:
             # Keep draining after shutdown begins: requests already
             # queued must be answered (the direct path serves its
             # in-flight requests too), never left to hang in submit().
+            try:
+                self._loop()
+            finally:
+                # Whatever is left when the consumer stops (including a
+                # crash) is answered with an error, never abandoned.
+                with self.cond:
+                    self.closed = True
+                    leftovers, self.pending = self.pending, []
+                for p in leftovers:
+                    p["err"] = RuntimeError("server shutting down")
+                    p["event"].set()
+
+        def _loop(self):
             while not done.is_set() or self.pending:
                 batch = self._take_batch()
                 if not batch:
@@ -319,9 +337,11 @@ def main(argv: list[str] | None = None) -> int:
                     p["event"].set()
 
     coalescer = None
+    batcher_thread = None
     if args.batch_window > 0:
         coalescer = Coalescer(args.batch_window / 1e3, args.max_batch)
-        threading.Thread(target=coalescer.loop, daemon=True).start()
+        batcher_thread = threading.Thread(target=coalescer.loop, daemon=True)
+        batcher_thread.start()
         print(f"serve_lm: coalescing greedy requests "
               f"(window {args.batch_window:.0f} ms, "
               f"max batch {args.max_batch})", flush=True)
@@ -344,6 +364,7 @@ def main(argv: list[str] | None = None) -> int:
                 if coalescer is not None:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
+                    payload["pending"] = len(coalescer.pending)
                 self._json(200, payload)
             else:
                 self._json(404, {"error": "unknown path"})
@@ -401,6 +422,19 @@ def main(argv: list[str] | None = None) -> int:
     threading.Thread(target=server.serve_forever, daemon=True).start()
     done.wait()
     server.shutdown()
+    if batcher_thread is not None:
+        # The batcher loop drains queued requests after done is set, but
+        # its thread (and the handler threads waiting in submit()) are
+        # daemons — main must hold the process open until the drain
+        # finishes and the answers have gone out, or it is theater.
+        # Joining the THREAD (not polling the queue) covers the final
+        # in-flight batch: _take_batch pops items before generate()
+        # runs, so an empty queue proves nothing while a decode (or its
+        # cold compile) is still executing.
+        import time as _time
+
+        batcher_thread.join(timeout=30.0)
+        _time.sleep(0.2)  # let unblocked handlers write their responses
     print(f"serve_lm: done ({served} request(s) served)", flush=True)
     return 0
 
