@@ -16,6 +16,7 @@ from tf_operator_tpu.parallel.ring_attention import (
 from tf_operator_tpu.parallel.sharding import (
     batch_sharded,
     fsdp_sharding_tree,
+    replicate,
     shard_batch,
     shard_params_by_rules,
     shard_params_fsdp,
@@ -64,6 +65,94 @@ class TestSharding:
         )
         assert out["mlp"]["in_proj"]["kernel"].sharding.spec == P(None, "tp")
         assert out["norm"]["scale"].sharding.spec == P()
+
+
+class TestWeightUpdateSharding:
+    """ZeRO-1 weight-update sharding over plain dp (arXiv:2004.13336):
+    moments sharded, params replicated, forward/backward untouched.
+    Oracle: the identical step with fully-replicated state."""
+
+    def _setup(self, opt_sharded: bool):
+        from tf_operator_tpu.models.transformer import (
+            Transformer,
+            TransformerConfig,
+        )
+        from tf_operator_tpu.parallel.sharding import (
+            weight_update_shardings,
+        )
+        from tf_operator_tpu.train.steps import (
+            TrainState,
+            adamw,
+            make_lm_train_step,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=64, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+            max_seq_len=32, dtype=jnp.float32,
+        )
+        model = Transformer(cfg)
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(rng.integers(0, 64, (16, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)["params"]
+        tx = adamw(1e-3)
+        mesh = create_mesh({"dp": 8})
+        params = replicate(mesh, params)
+        state = TrainState.create(params, tx)
+        opt_sh = None
+        if opt_sharded:
+            opt_sh = weight_update_shardings(
+                mesh, state.opt_state, min_size=64
+            )
+            state = state.replace(opt_state=jax.tree.map(
+                jax.device_put, state.opt_state, opt_sh))
+        # No param_shardings on purpose: the step must default the
+        # replicated param pin when opt_shardings is set — without it
+        # GSPMD propagates the sharded update into new_params (silent
+        # FSDP); the replicated-params assertion below pins the default.
+        step = make_lm_train_step(
+            model, tx, mesh, seq_axis=None, donate=False,
+            opt_shardings=opt_sh,
+        )
+        batch = shard_batch(
+            mesh, {"tokens": toks, "targets": jnp.roll(toks, -1, 1)}
+        )
+        return state, step, batch, opt_sh
+
+    def test_matches_replicated_and_stays_sharded(self):
+        state_r, step_r, batch, _ = self._setup(opt_sharded=False)
+        state_w, step_w, _, opt_sh = self._setup(opt_sharded=True)
+
+        for _ in range(3):
+            state_r, m_r = step_r(state_r, batch)
+            state_w, m_w = step_w(state_w, batch)
+        np.testing.assert_allclose(
+            float(m_w["loss"]), float(m_r["loss"]), rtol=1e-5)
+        # Params after 3 adamw steps: m/(sqrt(v)+eps) amplifies fp32
+        # roundoff from the sharded-update reduction layout on near-zero
+        # grads — absolute-dominated bound (loss rtol above is the tight
+        # semantic check, same convention as the 1f1b-vs-gpipe test).
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3),
+            state_w.params, state_r.params,
+        )
+        # Moments are PHYSICALLY sharded after steps: a big adam mu leaf
+        # holds 1/8 of its rows per device and its spec names dp.
+        big = [
+            leaf for leaf in jax.tree.leaves(state_w.opt_state)
+            if hasattr(leaf, "sharding") and leaf.size >= 64
+            and "dp" in str(getattr(leaf.sharding, "spec", ""))
+        ]
+        assert big, "no sharded optimizer moment survived the step"
+        sample = max(big, key=lambda a: a.size)
+        full = np.prod(sample.shape)
+        assert (
+            np.prod(sample.addressable_shards[0].data.shape) * 8 == full
+        ), (sample.shape, sample.addressable_shards[0].data.shape)
+        # Params stayed replicated (no FSDP gather was introduced).
+        for leaf in jax.tree.leaves(state_w.params):
+            assert "dp" not in str(getattr(leaf.sharding, "spec", "")), (
+                leaf.sharding)
 
 
 class TestFsdp:

@@ -113,6 +113,38 @@ def fsdp_sharding_tree(
     return jax.tree.map(lambda l: NamedSharding(mesh, spec_for(l)), params)
 
 
+def weight_update_shardings(
+    mesh: Mesh, opt_state: Any, axis: str = "dp", min_size: int = 2**11
+) -> Any:
+    """ZeRO-1 / weight-update sharding for PLAIN data parallelism.
+
+    The optimizer STATE (adam mu/nu, etc.) is sharded over the data axis
+    while params stay replicated — forward and backward are untouched
+    (no FSDP all-gather on the compute path), but moment memory and
+    update FLOPs drop by the dp size: GSPMD turns the gradient reduction
+    feeding each moment shard into reduce-scatter form and all-gathers
+    only the updated param. This is the automatic cross-replica
+    weight-update sharding of arXiv:2004.13336, the right point on the
+    curve when the model fits replicated but 2x adam moments do not (or
+    when FSDP's forward gathers cost more than they save — small models,
+    fast steps). Apply via:
+
+        state = TrainState.create(params, tx)           # replicated
+        opt_sh = weight_update_shardings(mesh, state.opt_state)
+        state = state.replace(opt_state=jax.tree.map(
+            jax.device_put, state.opt_state, opt_sh))
+        step = make_lm_train_step(..., opt_shardings=opt_sh)
+
+    The step pins params REPLICATED by default when opt_shardings is set
+    and param_shardings is not: without that pin GSPMD would propagate
+    the sharded update into new_params (silent FSDP) instead of
+    all-gathering it.
+
+    Same per-leaf placement rule as fsdp_sharding_tree (largest divisible
+    dim; small leaves and scalars — counts — stay replicated)."""
+    return fsdp_sharding_tree(mesh, opt_state, axis=axis, min_size=min_size)
+
+
 def shard_params_fsdp(
     mesh: Mesh, params: Any, axis: str = "fsdp", min_size: int = 2**11
 ) -> Any:

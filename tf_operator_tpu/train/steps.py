@@ -346,6 +346,7 @@ def make_lm_train_step(
     mesh: Mesh,
     *,
     param_shardings: Any = None,
+    opt_shardings: Any = None,
     data_axis: Any = "dp",
     seq_axis: str | None = "sp",
     tp_axis: str = "tp",
@@ -372,6 +373,12 @@ def make_lm_train_step(
     ``aux_loss_weight`` > 0 collects sown auxiliary losses (the MoE
     load-balancing loss) via mutable=["losses"] and adds them weighted;
     metrics then carry "aux_loss".
+
+    ``opt_shardings`` (weight-update sharding, ZeRO-1 over plain dp)
+    constrains the updated optimizer state; when it is set and
+    ``param_shardings`` is not, params are pinned REPLICATED — without
+    that pin GSPMD would propagate the sharded update into new_params
+    (silent FSDP), exactly the drift the technique's contract forbids.
 
     ``grad_accum`` > 1 splits the batch's leading dim into that many
     microbatches and averages their gradients inside ONE jitted step (a
@@ -474,6 +481,28 @@ def make_lm_train_step(
         if param_shardings is not None:
             new_params = jax.lax.with_sharding_constraint(
                 new_params, param_shardings
+            )
+        elif opt_shardings is not None:
+            # Default half of the two-constraint contract (docstring):
+            # sharded moments with unpinned params would silently FSDP
+            # the params via GSPMD propagation of the sharded update.
+            new_params = jax.lax.with_sharding_constraint(
+                new_params,
+                jax.tree.map(
+                    lambda _: jax.sharding.NamedSharding(
+                        mesh, jax.sharding.PartitionSpec()
+                    ),
+                    new_params,
+                ),
+            )
+        if opt_shardings is not None:
+            # Weight-update sharding (ZeRO-1 over plain dp): moments live
+            # sharded over the data axis while params stay replicated —
+            # the constraint stops GSPMD from drifting the moments back
+            # to the (dominant) replicated layout of grads/params. See
+            # parallel/sharding.py:weight_update_shardings.
+            new_opt = jax.lax.with_sharding_constraint(
+                new_opt, opt_shardings
             )
         metrics = {"loss": loss}
         if aux_loss_weight:
