@@ -815,6 +815,40 @@ def adafactor(lr: Any = 1e-3):
     return optax.adafactor(lr)
 
 
+def _no_norm_or_bias(params: Any) -> Any:
+    """Mask tree: True for >=2-D kernels, False for biases and norm
+    scales (1-D / scalar leaves) — the canonical LARS/MLPerf exclusion
+    set for weight decay and trust-ratio adaptation."""
+    return jax.tree.map(lambda p: jnp.ndim(p) >= 2, params)
+
+
+def lars(lr: Any = 1.0, weight_decay: float = 1e-4,
+         momentum: float = 0.9, mask_norm_and_bias: bool = True):
+    """LARS — layerwise-adaptive SGD for LARGE-BATCH vision training
+    (the optimizer behind the MLPerf ResNet TPU-pod entries: per-layer
+    trust ratio ||w||/||g|| keeps early layers stable when the global
+    batch reaches tens of thousands, where plain momentum diverges).
+    Use with warmup_cosine and batch-scaled lr; ``lr`` may be a float
+    or schedule. The canonical recipe EXCLUDES BatchNorm scales/biases
+    and bias vectors from both decay and the trust ratio (a known
+    large-batch convergence degrader otherwise) — on by default via the
+    dimensionality mask; pass mask_norm_and_bias=False for raw LARS."""
+    mask = _no_norm_or_bias if mask_norm_and_bias else True
+    return optax.lars(
+        lr, weight_decay=weight_decay, momentum=momentum,
+        weight_decay_mask=mask, trust_ratio_mask=mask,
+    )
+
+
+def lamb(lr: Any = 1e-3, weight_decay: float = 0.01):
+    """LAMB — the adam-based layerwise-adaptive counterpart for
+    large-batch transformer training (BERT-in-76-minutes recipe).
+    Same trust-ratio idea as LARS on top of adam updates; ``lr`` may be
+    a float or schedule. Moments shard under FSDP / weight-update
+    sharding like adamw's."""
+    return optax.lamb(lr, weight_decay=weight_decay)
+
+
 def warmup_cosine(
     peak_lr: float,
     total_steps: int,
