@@ -90,6 +90,10 @@ def main(argv: list[str] | None = None) -> int:
     # built from them).
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query attention: K/V heads (must divide "
+                        "the 4 query heads); the decode KV cache shrinks "
+                        "by the group factor")
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--max-seq-len", type=int, default=128)
     p.add_argument("--checkpoint-dir", default=None,
@@ -144,6 +148,7 @@ def main(argv: list[str] | None = None) -> int:
 
     cfg = TransformerConfig(
         vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_kv_heads=args.kv_heads,
         n_layers=args.layers, d_ff=args.d_model * 2,
         max_seq_len=args.max_seq_len, dtype=jnp.float32,
     )

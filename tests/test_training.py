@@ -707,6 +707,58 @@ class TestDecode:
                 rtol=1e-4, atol=1e-4,
             )
 
+    def test_gqa_decode_matches_full_forward_and_shrinks_cache(self):
+        """Grouped-query attention: the training forward repeats KV heads
+        while the decode path keeps a grouped [B,S,KV,Dh] cache — the two
+        implementations must agree at every position (the strong oracle
+        that validates both), and the cache must physically shrink by the
+        group factor. Composes with kv_int8."""
+        from dataclasses import replace
+
+        cfg = self._cfg(n_kv_heads=2)  # 4 query heads, groups of 2
+        model = Transformer(cfg)
+        rng = np.random.default_rng(5)
+        tokens = jnp.asarray(rng.integers(0, 32, (2, 12)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        # GQA param tree: split q/kv projections, kv with 2 heads.
+        att0 = params["block_0"]["attn"]
+        assert set(att0) >= {"q", "kv", "out"} and "qkv" not in att0
+        assert att0["kv"]["kernel"].shape == (32, 2, 2, 8)
+        full = model.apply({"params": params}, tokens)
+
+        dmodel = Transformer(replace(cfg, decode=True))
+        cache = dmodel.init(jax.random.PRNGKey(0), tokens[:, :1])["cache"]
+        ck = cache["block_0"]["attn"]["cached_key"]
+        assert ck.shape == (2, cfg.max_seq_len, 2, 8)  # KV=2, not H=4
+        step = jax.jit(
+            lambda cache, tok: dmodel.apply(
+                {"params": params, "cache": cache}, tok, mutable=["cache"]
+            )
+        )
+        for t in range(tokens.shape[1]):
+            logits, updates = step(cache, tokens[:, t : t + 1])
+            cache = updates["cache"]
+            np.testing.assert_allclose(
+                np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+                rtol=1e-4, atol=1e-4,
+            )
+
+        # kv_int8 composes with the grouped cache (scales [B,S,KV]).
+        from tf_operator_tpu.models.transformer import generate
+
+        kv8 = replace(cfg, decode=True, kv_int8=True)
+        cache8 = Transformer(kv8).init(
+            jax.random.PRNGKey(0), tokens[:, :1])["cache"]
+        att8 = cache8["block_0"]["attn"]
+        assert att8["cached_key"].dtype == jnp.int8
+        assert att8["key_scale"].shape == (2, cfg.max_seq_len, 2)
+        g16 = generate(replace(cfg, kv_int8=False), params,
+                       tokens[:, :6], num_steps=6)
+        g8 = generate(replace(cfg, kv_int8=True), params,
+                      tokens[:, :6], num_steps=6)
+        agree = float(np.mean(np.asarray(g16) == np.asarray(g8)))
+        assert agree >= 0.75, agree
+
     def test_batched_prefill_matches_full_forward(self):
         """A multi-token prefill call (the whole prompt in ONE decode-mode
         forward, block-causal attention over the cache) produces the same
@@ -1029,6 +1081,32 @@ class TestInt8Decode:
             replace(cfg, int8_decode=True), qparams, prompt, num_steps=5
         )
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(toks2))
+
+    def test_gqa_split_projections_quantize(self):
+        """quantize_decode_params handles the GQA param tree (split q/kv
+        projections) and int8+GQA generation runs end-to-end."""
+        from dataclasses import replace
+
+        from tf_operator_tpu.models.transformer import generate
+
+        cfg = self._cfg(n_kv_heads=2)
+        prompt = jnp.asarray(
+            np.random.default_rng(2).integers(0, 64, (2, 4)), jnp.int32
+        )
+        params = Transformer(cfg).init(
+            jax.random.PRNGKey(1), prompt[:, :1]
+        )["params"]
+        qparams = quantize_decode_params(
+            jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        )
+        att = qparams["block_0"]["attn"]
+        assert "kernel_q" in att["q"] and "kernel_q" in att["kv"]
+        assert att["kv"]["kernel_q"].shape == (32, 2 * 2 * 8)
+        toks = generate(
+            replace(cfg, int8_decode=True, kv_int8=True), qparams,
+            prompt, num_steps=4,
+        )
+        assert toks.shape == (2, 4)
 
     def test_moe_params_pass_through_unquantized(self):
         cfg = self._cfg(moe_every_n=2)

@@ -98,9 +98,36 @@ class TransformerConfig:
     moe_top_k: int = 1  # 1 = Switch, 2 = GShard top-2
     ep_axis: str = "ep"
 
+    # Grouped-query attention: K/V get this many heads (must divide
+    # n_heads); each group of n_heads/n_kv_heads query heads shares one
+    # KV head. None = classic MHA (and the classic fused-qkv param tree,
+    # so existing checkpoints are untouched). The decode KV cache — the
+    # read that bounds long-context serving — shrinks by the group
+    # factor, multiplying with kv_int8's halving; training-side the
+    # saving is KV projection params/optimizer state (K/V are repeated
+    # to full heads before the attention paths, so ring/flash/ulysses
+    # and tp sharding are unchanged).
+    n_kv_heads: int | None = None
+
+    def __post_init__(self):
+        if self.n_kv_heads is not None and (
+            self.n_kv_heads <= 0 or self.n_heads % self.n_kv_heads
+        ):
+            # At construction, not inside a traced flax forward: a bad
+            # value (0 would otherwise surface as ZeroDivisionError deep
+            # in a jit trace) fails where the config was written.
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be a positive "
+                f"divisor of n_heads={self.n_heads}"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     @property
     def use_ring(self) -> bool:
@@ -146,20 +173,56 @@ class Attention(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         b, t, _ = x.shape
-        if cfg.decode and cfg.int8_decode:
-            qkv = Int8Dense(
-                3 * cfg.n_heads * cfg.head_dim,
-                out_shape=(3, cfg.n_heads, cfg.head_dim),
-                out_dtype=cfg.dtype, name="qkv",
-            )(x)
+        if cfg.n_kv_heads is not None:
+            # GQA: separate projections — K/V carry only kv_heads
+            # (validated at TransformerConfig construction).
+            if cfg.decode and cfg.int8_decode:
+                q = Int8Dense(
+                    cfg.n_heads * cfg.head_dim,
+                    out_shape=(cfg.n_heads, cfg.head_dim),
+                    out_dtype=cfg.dtype, name="q",
+                )(x)
+                kv = Int8Dense(
+                    2 * cfg.kv_heads * cfg.head_dim,
+                    out_shape=(2, cfg.kv_heads, cfg.head_dim),
+                    out_dtype=cfg.dtype, name="kv",
+                )(x)
+            else:
+                q = nn.DenseGeneral(
+                    (cfg.n_heads, cfg.head_dim), axis=-1,
+                    dtype=cfg.dtype, name="q",
+                )(x)
+                kv = nn.DenseGeneral(
+                    (2, cfg.kv_heads, cfg.head_dim), axis=-1,
+                    dtype=cfg.dtype, name="kv",
+                )(x)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+            if not cfg.decode and cfg.kv_heads < cfg.n_heads:
+                # Training/prefill paths: repeat KV heads to full heads so
+                # every attention strategy (ring, flash, ulysses, the tp
+                # shard_map) sees the MHA layout it was built for — the
+                # GQA cache saving is a decode property; here the saving
+                # is the smaller KV projection (params + optimizer
+                # state). The decode path keeps the grouped layout: its
+                # cache stores only kv_heads.
+                g = cfg.n_heads // cfg.kv_heads
+                k = jnp.repeat(k, g, axis=2)
+                v = jnp.repeat(v, g, axis=2)
         else:
-            qkv = nn.DenseGeneral(
-                (3, cfg.n_heads, cfg.head_dim),
-                axis=-1,
-                dtype=cfg.dtype,
-                name="qkv",
-            )(x)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if cfg.decode and cfg.int8_decode:
+                qkv = Int8Dense(
+                    3 * cfg.n_heads * cfg.head_dim,
+                    out_shape=(3, cfg.n_heads, cfg.head_dim),
+                    out_dtype=cfg.dtype, name="qkv",
+                )(x)
+            else:
+                qkv = nn.DenseGeneral(
+                    (3, cfg.n_heads, cfg.head_dim),
+                    axis=-1,
+                    dtype=cfg.dtype,
+                    name="qkv",
+                )(x)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cfg.decode:
             out = self._decode_attend(q, k, v)
         elif cfg.use_ring:
@@ -265,8 +328,11 @@ class Attention(nn.Module):
     def _decode_attend(self, q, k, v):
         """Block attention against the layer's KV cache (t >= 1 tokens).
 
-        The cache is a fixed [B, max_seq_len, H, Dh] buffer of past keys
-        and values (static shapes — the decode loop is jittable/scannable).
+        The cache is a fixed [B, max_seq_len, KV, Dh] buffer of past keys
+        and values (static shapes — the decode loop is jittable/scannable;
+        KV = cfg.kv_heads, which is n_heads for classic MHA so existing
+        cache layouts are unchanged, and n_kv_heads under GQA — the cache
+        and its per-step read shrink by the group factor).
         A multi-token call (prompt PREFILL) writes all t keys/values at the
         cache index and attends causally within the block: query row i sees
         cached positions <= idx + i. Single-token calls are the sampling
@@ -277,18 +343,23 @@ class Attention(nn.Module):
         Numerics follow reference_attention (f32 scores/softmax, d^-0.5
         scale) so decode logits match the training forward exactly
         (tests/test_training.py::test_decode_matches_full_forward).
+        The attention math runs in GROUPED form throughout — query heads
+        reshaped [B,t,KV,G,Dh], scores [B,KV,G,t,S] — which at G=1 is
+        exactly the classic layout.
         """
         cfg = self.cfg
         b, t, h, dh = q.shape
+        kv = k.shape[2]  # cfg.kv_heads
+        g = h // kv
         kv8 = cfg.kv_int8
         cached_k = self.variable(
             "cache", "cached_key",
-            jnp.zeros, (b, cfg.max_seq_len, h, dh),
+            jnp.zeros, (b, cfg.max_seq_len, kv, dh),
             jnp.int8 if kv8 else cfg.dtype,
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            jnp.zeros, (b, cfg.max_seq_len, h, dh),
+            jnp.zeros, (b, cfg.max_seq_len, kv, dh),
             jnp.int8 if kv8 else cfg.dtype,
         )
         if kv8:
@@ -306,22 +377,22 @@ class Attention(nn.Module):
             # tests/test_training.py::TestKvInt8Decode.
             k_scale = self.variable(
                 "cache", "key_scale",
-                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+                jnp.zeros, (b, cfg.max_seq_len, kv), jnp.float32,
             )
             v_scale = self.variable(
                 "cache", "value_scale",
-                jnp.zeros, (b, cfg.max_seq_len, h), jnp.float32,
+                jnp.zeros, (b, cfg.max_seq_len, kv), jnp.float32,
             )
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         if self.is_initializing():
             # init() executes this forward once to build the variables; the
-            # cache must come out untouched (index 0, zero buffers), and
-            # block-causal self-attention with an empty cache reduces to a
-            # value passthrough only at t == 1 — init shapes are all that
-            # matter here.
-            return v
+            # cache must come out untouched (index 0, zero buffers) and
+            # only the OUTPUT SHAPE matters (downstream inits depend on
+            # shapes, not values) — q-shaped, since GQA's v carries fewer
+            # heads than the attention output.
+            return jnp.zeros_like(q)
         idx = index.value
         if kv8:
             def quant(x):  # [b, t, h, dh] -> int8 values, [b, t, h] scales
@@ -349,28 +420,31 @@ class Attention(nn.Module):
         keys = (
             cached_k.value.astype(jnp.bfloat16) if kv8 else cached_k.value
         )
+        # Grouped layout: [b, t, kv, g, dh] query heads against the
+        # [b, S, kv, dh] cache. At g=1 (MHA) this is the classic einsum.
+        qg = q.reshape(b, t, kv, g, dh)
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, keys,
+            "bqkgd,bskd->bkgqs", qg, keys,
             preferred_element_type=jnp.float32,
         )
         if kv8:
-            # scores[b,h,i,j] = (q . k8)[b,h,i,j] * ks[b,j,h].
-            s = s * k_scale.value.transpose(0, 2, 1)[:, :, None, :]
+            # scores[b,k,g,i,j] = (q . k8)[...] * ks[b,j,k].
+            s = s * k_scale.value.transpose(0, 2, 1)[:, :, None, None, :]
         s = s * (dh ** -0.5)
         # Query row i (absolute position idx + i) sees keys <= idx + i.
         valid = (
             jnp.arange(cfg.max_seq_len)[None, :]
             <= (idx + jnp.arange(t))[:, None]
         )
-        s = jnp.where(valid[None, None, :, :], s, -1e30)
+        s = jnp.where(valid[None, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         if kv8:
             # Fold the value scale into the probabilities (same factoring).
-            p = p * v_scale.value.transpose(0, 2, 1)[:, :, None, :]
+            p = p * v_scale.value.transpose(0, 2, 1)[:, :, None, None, :]
         out = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, cached_v.value.astype(jnp.float32)
+            "bkgqs,bskd->bqkgd", p, cached_v.value.astype(jnp.float32)
         )
-        return out.astype(cfg.dtype)
+        return out.reshape(b, t, h, dh).astype(cfg.dtype)
 
 
 class MLP(nn.Module):
@@ -612,7 +686,9 @@ def quantize_decode_params(params: Any) -> Any:
 
     def quant(name: str, sub: dict) -> dict:
         kern = sub["kernel"]
-        if name == "qkv":  # [d, 3, heads, head_dim] -> [d, 3*h*hd]
+        if name in ("qkv", "q", "kv"):
+            # [d, ...heads..., head_dim] -> [d, prod]: fused qkv, or the
+            # GQA split q ([d, H, hd]) / kv ([d, 2, KV, hd]) projections.
             k2 = kern.reshape(kern.shape[0], -1)
         elif name == "out":  # [heads, head_dim, d] -> [h*hd, d]
             k2 = kern.reshape(-1, kern.shape[-1])
@@ -624,7 +700,7 @@ def quantize_decode_params(params: Any) -> Any:
             "bias": sub["bias"].reshape(-1).astype(jnp.float32),
         }
 
-    targets = {"qkv", "out", "in_proj", "out_proj", "lm_head"}
+    targets = {"qkv", "q", "kv", "out", "in_proj", "out_proj", "lm_head"}
 
     def walk(tree: Any) -> Any:
         out = {}
@@ -651,6 +727,11 @@ def param_sharding_rules(tp_axis: str = "tp") -> dict[str, tuple]:
     per block per direction."""
     return {
         "qkv/kernel": (None, None, tp_axis, None),  # [d_model,3,heads,head_dim]
+        # GQA split projections: q shards its (full) head dim like qkv;
+        # kv shards the KV-head dim (requires n_kv_heads % tp == 0 — with
+        # fewer KV heads than tp, drop this rule and keep kv replicated).
+        "attn/q/kernel": (None, tp_axis, None),  # [d_model,heads,head_dim]
+        "attn/kv/kernel": (None, None, tp_axis, None),  # [d,2,kv,head_dim]
         "attn/out/kernel": (tp_axis, None, None),  # [heads,head_dim,d_model]
         "mlp/in_proj/kernel": (None, tp_axis),  # [d_model,d_ff]
         "mlp/out_proj/kernel": (tp_axis, None),  # [d_ff,d_model]

@@ -59,18 +59,31 @@ def sharding_tree_by_rules(
 
     ``rules`` maps a substring of the flattened param path (e.g.
     "Dense_0/kernel") to a PartitionSpec tuple; first match wins, unmatched
-    params get ``default`` (replicated).
+    params get ``default`` (replicated). A matched rule whose named axes
+    cannot tile the leaf (dim not divisible by the mesh-axis size — e.g.
+    GQA/MQA kv projections with n_kv_heads < tp, or an odd vocab under
+    tp) falls back to replicated for that leaf instead of crashing
+    device_put: sharding is a placement optimization, never a
+    correctness requirement.
     """
 
-    def spec_for(path) -> P:
+    def spec_for(path, leaf) -> P:
         p = _path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
         for sub, spec in rules.items():
-            if sub in p:
-                return P(*spec)
+            if sub not in p:
+                continue
+            for d, axis in enumerate(spec):
+                if axis is None:
+                    continue
+                size = mesh.shape.get(axis, 1)
+                if d >= len(shape) or (size > 1 and shape[d] % size):
+                    return P(*default)  # rule can't tile this leaf
+            return P(*spec)
         return P(*default)
 
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: NamedSharding(mesh, spec_for(path)), params
+        lambda path, leaf: NamedSharding(mesh, spec_for(path, leaf)), params
     )
 
 

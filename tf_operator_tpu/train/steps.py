@@ -840,13 +840,16 @@ def lars(lr: Any = 1.0, weight_decay: float = 1e-4,
     )
 
 
-def lamb(lr: Any = 1e-3, weight_decay: float = 0.01):
+def lamb(lr: Any = 1e-3, weight_decay: float = 0.01,
+         mask_norm_and_bias: bool = True):
     """LAMB — the adam-based layerwise-adaptive counterpart for
     large-batch transformer training (BERT-in-76-minutes recipe).
     Same trust-ratio idea as LARS on top of adam updates; ``lr`` may be
-    a float or schedule. Moments shard under FSDP / weight-update
-    sharding like adamw's."""
-    return optax.lamb(lr, weight_decay=weight_decay)
+    a float or schedule. Like lars(), norm scales and biases are
+    excluded from weight decay by default (the canonical recipe).
+    Moments shard under FSDP / weight-update sharding like adamw's."""
+    mask = _no_norm_or_bias if mask_norm_and_bias else None
+    return optax.lamb(lr, weight_decay=weight_decay, mask=mask)
 
 
 def warmup_cosine(
