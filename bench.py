@@ -337,14 +337,18 @@ def bench_transformer_lm(peak_tflops: float | None) -> None:
 
 def kv_cache_bytes(cfg, batch: int, kv8: bool) -> int:
     """Per-step KV-cache read bytes for the decode roofline: 2 (K and V)
-    x layers x batch x max_seq_len x d_model elems, 2 bytes/elem bf16 or
-    1 byte + a 4-byte per-(token, head) scale when cfg.kv_int8-style
-    quantization is on. THE single copy of this accounting — bench legs
-    and both decode probes import it."""
+    x layers x batch x max_seq_len x kv_heads x head_dim elems (GQA
+    caches only kv_heads; classic MHA has kv_heads == n_heads so this is
+    d_model per token), 2 bytes/elem bf16 or 1 byte + a 4-byte
+    per-(token, kv-head) scale when cfg.kv_int8-style quantization is
+    on. THE single copy of this accounting — bench legs and both decode
+    probes import it."""
+    kv_heads = getattr(cfg, "kv_heads", cfg.n_heads)
     elems = 2 * cfg.n_layers * batch * cfg.max_seq_len
+    kv_dim = kv_heads * (cfg.d_model // cfg.n_heads)
     if kv8:
-        return elems * (cfg.d_model + cfg.n_heads * 4)
-    return elems * cfg.d_model * 2
+        return elems * (kv_dim + kv_heads * 4)
+    return elems * kv_dim * 2
 
 
 def bench_decode(peak_hbm_gbps: float | None) -> None:

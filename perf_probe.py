@@ -541,14 +541,32 @@ def probe_decodelong() -> None:
     )
     params_bytes = sum(
         x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
-    variants = (
-        ("bf16", cfg, bench.kv_cache_bytes(cfg, B, kv8=False)),
-        ("kv8", replace(cfg, kv_int8=True),
-         bench.kv_cache_bytes(cfg, B, kv8=True)),
+    # The full cache-reduction ladder: bf16 -> int8 cache (2x) -> GQA
+    # (group-factor x) -> both multiplied. GQA legs re-init params (the
+    # param tree differs); throughput comparisons stay valid because
+    # decode is read-bound, not accuracy-bound, at matched shapes.
+    gqa_kv = max(1, cfg.n_heads // 4)
+    gcfg = replace(cfg, n_kv_heads=gqa_kv)
+    gqa_model = Transformer(gcfg)
+    gqa_params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        gqa_model.init(jax.random.PRNGKey(0), prompt)["params"],
     )
-    for label, vcfg, kv_bytes in variants:
-        def call(vcfg=vcfg):
-            out = generate(vcfg, params, prompt, num_steps=steps)
+    gqa_bytes = sum(
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(gqa_params))
+    variants = (
+        ("bf16", cfg, params, params_bytes,
+         bench.kv_cache_bytes(cfg, B, kv8=False)),
+        ("kv8", replace(cfg, kv_int8=True), params, params_bytes,
+         bench.kv_cache_bytes(cfg, B, kv8=True)),
+        (f"gqa{gqa_kv}", gcfg, gqa_params, gqa_bytes,
+         bench.kv_cache_bytes(gcfg, B, kv8=False)),
+        (f"gqa{gqa_kv}kv8", replace(gcfg, kv_int8=True), gqa_params,
+         gqa_bytes, bench.kv_cache_bytes(gcfg, B, kv8=True)),
+    )
+    for label, vcfg, vparams, params_bytes, kv_bytes in variants:
+        def call(vcfg=vcfg, vparams=vparams):
+            out = generate(vcfg, vparams, prompt, num_steps=steps)
             int(out[0, -1])
 
         try:

@@ -60,9 +60,9 @@ STAGES = [
     ("lmsweep", {"PROBE": "lmsweep"}, 1500.0),
     # 4 weight/cache variants (bf16, int8, kv8, int8kv8) x 2 batch sizes.
     ("decodesweep", {"PROBE": "decodesweep"}, 1400.0),
-    # Long-context cache A/B: the shape where kv_int8's halved cache
-    # read actually moves the headline (cache ~75% of the per-step read).
-    ("decodelong", {"PROBE": "decodelong"}, 900.0),
+    # Long-context cache ladder: bf16 -> int8 cache (2x) -> GQA (4x) ->
+    # both (8x) at the shape where the cache dominates the per-step read.
+    ("decodelong", {"PROBE": "decodelong"}, 1500.0),
     # Tail attribution: host input pipeline (CPU-only, cheap) and the
     # ResNet fwd/bwd split — consulted if the synthetic-vs-bench split
     # points at input/transfer or the gradient path respectively.
