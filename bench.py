@@ -181,29 +181,38 @@ def smoke_attn_config() -> tuple[int, int]:
     return (256, 1) if os.environ.get("BENCH_SMOKE") else (8192, 4)
 
 
-def attn_fwd_bwd_times(batch: int, seq: int, *, reps: int = 3,
-                       warmup: int = 2) -> list[float]:
-    """Per-rep wall times of the causal attention fwd+bwd at the bench
-    geometry (via ops.attention dispatch — whatever kernel that picks).
-    THE single measurement block for every attention timing tool
-    (bench_flash_attention, perf_probe flashramp/flashsweep), so
-    timing/readback changes cannot drift between them."""
+def attn_fwd_bwd_call(attn_fn, q, k, v):
+    """One attention fwd+bwd measurement call: jit value_and_grad over
+    the f32-sum loss wrt (q, k, v), scalar readback = completion. THE
+    single construction for every attention timing tool
+    (attn_fwd_bwd_times → bench_flash_attention / perf_probe flashramp /
+    flashsweep, and perf_probe qblock's per-leg calls), so loss/readback
+    changes cannot drift between the tools being compared."""
     import jax
     import jax.numpy as jnp
 
-    from tf_operator_tpu.ops import attention
-
-    q, k, v = attn_inputs(batch, seq)
-
-    def loss(q, k, v):
-        return attention(q, k, v, causal=True).astype(jnp.float32).sum()
-
-    grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda q, k, v: attn_fn(q, k, v).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2),
+    ))
 
     def call():
         out = grad_fn(q, k, v)
         float(out[0])  # readback = completion
 
+    return call
+
+
+def attn_fwd_bwd_times(batch: int, seq: int, *, reps: int = 3,
+                       warmup: int = 2) -> list[float]:
+    """Per-rep wall times of the causal attention fwd+bwd at the bench
+    geometry (via ops.attention dispatch — whatever kernel that picks)."""
+    from tf_operator_tpu.ops import attention
+
+    q, k, v = attn_inputs(batch, seq)
+    call = attn_fwd_bwd_call(
+        lambda q, k, v: attention(q, k, v, causal=True), q, k, v
+    )
     return timed_reps(call, reps=reps, warmup=warmup)
 
 
@@ -686,6 +695,48 @@ def measure_copy_gbps(gib: bool = True, reps: int = 5) -> float:
     return 2 * m.size * 2 / dt / 1e9
 
 
+def measure_chain_copy_gbps(depth: int | None = None, reps: int = 3) -> float:
+    """Scan-chained on-device copy bandwidth (read+write GB/s). The r05
+    window showed single-execution probes under-measure this environment
+    by ~5x — decode (a fused scan) sustained 365 GB/s of derived HBM read
+    while measure_copy_gbps read 77 — because per-execution scheduling
+    (time-sliced tunnel chip) dominates one-shot launches but amortizes
+    over a scan. Chains `depth` dependent copy steps inside ONE
+    executable, exactly how measure_chain_matmul_tflops establishes the
+    compute ceiling, so the two rooflines are methodologically paired."""
+    import jax
+    import jax.numpy as jnp
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if depth is None:
+        depth = 4 if smoke else 20
+    m = jnp.zeros((8, 1024, 1024) if smoke else (512, 1024, 1024),
+                  jnp.bfloat16)
+    # Per-tick factors passed as scan xs (runtime DATA, not captured
+    # constants): a constant-factor body is foldable — bf16(1.0078125)
+    # times bf16(1/1.0078125) rounds to EXACTLY 1.0, so `(c*s)*inv`
+    # would let XLA's reassociation+constant-folding elide the whole
+    # tick. A factor read from the xs stream cannot fold, so every tick
+    # is a real read+write of the full buffer. Alternating s, ~1/s keeps
+    # the carry bounded (the pair's product is 1 - 2^-14 in bf16).
+    s = jnp.asarray(1.0078125, jnp.bfloat16)
+    inv = jnp.asarray(1.0, jnp.bfloat16) / s
+    factors = jnp.stack([s if i % 2 == 0 else inv for i in range(depth)])
+
+    def chain(x, fs):
+        def body(c, f):
+            return c * f, ()
+
+        out, _ = jax.lax.scan(body, x, fs)
+        return out
+
+    ch = jax.jit(chain)
+    dt = min(timed_reps(lambda: jax.block_until_ready(ch(m, factors)),
+                        reps=reps, warmup=2))
+    # one read + one write of the buffer per tick
+    return depth * 2 * m.size * 2 / dt / 1e9
+
+
 def bench_calibration(peak_tflops: float | None) -> None:
     """Measured environment ceilings, stamped into every bench artifact.
 
@@ -699,12 +750,14 @@ def bench_calibration(peak_tflops: float | None) -> None:
     n, depth = (512, 4) if smoke else (4096, 20)
     chain_tflops = measure_chain_matmul_tflops(n, depth)
     copy_gbps = measure_copy_gbps()
+    chain_copy_gbps = measure_chain_copy_gbps()
     emit(
         "chip_calibration_matmul_chain_tflops_bf16",
         chain_tflops,
         "TFLOP/s",
         chain_tflops / peak_tflops if peak_tflops else 0.0,
         copy_gbps=copy_gbps,
+        chain_copy_gbps=chain_copy_gbps,
         device_kind=getattr(jax.devices()[0], "device_kind", "?"),
     )
 
@@ -823,15 +876,22 @@ def bench_resnet(peak_tflops: float | None) -> None:
     # two sources agree in scale and mfu below divides by one chip's peak.
     flops_source = "xla_cost_analysis"
     flops_per_dev_call = xla_flops_per_call
-    if not flops_per_dev_call:
-        # Some plugin backends return an empty cost analysis (round 3
-        # emitted mfu=0.0 on hardware for exactly this reason). Fall back
-        # to the standard hand model: ResNet-50 fwd ~4.09 GFLOP per 224^2
-        # image (MACs x2), training ~3x fwd.
-        flops_source = "analytic"
-        flops_per_dev_call = 3 * 4.09e9 * BATCH * FUSED_STEPS * (
-            (IMAGE_SIZE / 224.0) ** 2
-        ) / n_dev
+    # Standard hand model: ResNet-50 fwd ~4.09 GFLOP per 224^2 image
+    # (MACs x2), training ~3x fwd.
+    analytic_flops = 3 * 4.09e9 * BATCH * FUSED_STEPS * (
+        (IMAGE_SIZE / 224.0) ** 2
+    ) / n_dev
+    if not (0.5 * analytic_flops <= flops_per_dev_call <= 3 * analytic_flops):
+        # Some plugin backends return an empty OR implausible cost
+        # analysis (round 3 emitted mfu=0.0 on hardware for the empty
+        # case; the round-5 window emitted mfu=0.001 — ~10x below the
+        # hand model — for the implausible one). Trust XLA only inside
+        # a sanity band around the analytic count.
+        flops_source = (
+            "analytic" if not flops_per_dev_call
+            else "analytic (xla_implausible)"
+        )
+        flops_per_dev_call = analytic_flops
 
     # Measured loop: host pipeline + transfer + compute, double-buffered.
     dev = put(next_stacked())

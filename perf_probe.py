@@ -281,11 +281,8 @@ def probe_flashramp() -> None:
 
 def probe_flashblocks() -> None:
     """A/B the decoupled flash-attention Q block on hardware: 8k causal
-    fwd+bwd at block_q 256 (round-3 shipped behavior), 512 (the new
-    auto-pick), and 1024. Decides whether MAX_Q_BLOCK should move."""
-    import jax
-    import jax.numpy as jnp
-
+    fwd+bwd at block_q 256 (round-3 shipped behavior), 512 (the old
+    auto-pick), and 1024 (the r05-measured winner, now MAX_Q_BLOCK)."""
     from tf_operator_tpu.ops.flash_attention import flash_attention
 
     seq, batch = bench.smoke_attn_config()
@@ -296,22 +293,70 @@ def probe_flashblocks() -> None:
         if seq % bq:
             continue
 
-        def loss(q, k, v, bq=bq):
-            o = flash_attention(q, k, v, causal=True, block=64 if interpret
-                                else 256, block_q=bq, interpret=interpret)
-            return o.astype(jnp.float32).sum()
-
-        grad_fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
-
-        def call():
-            out = grad_fn(q, k, v)
-            float(out[0])
-
+        call = bench.attn_fwd_bwd_call(
+            lambda q, k, v, bq=bq: flash_attention(
+                q, k, v, causal=True, block=64 if interpret else 256,
+                block_q=bq, interpret=interpret),
+            q, k, v,
+        )
         dt = min(bench.timed_reps(call, reps=3, warmup=2))
         results[f"bq{bq}_tflops"] = (
             bench.flash_model_flops(batch, seq) / dt / 1e12
         )
     emit("flashblocks", seq=seq, batch=batch, **results)
+
+
+def probe_qblock() -> None:
+    """Settle the r05-window discrepancy: the direct flashblocks A/B
+    measured bq1024 at 14.0 TFLOP/s while the ops.attention dispatch path
+    (flashsweep/bench, same shape, same auto-picked blocks after the
+    MAX_Q_BLOCK=1024 retune) read ~11.5. Interleave the two call paths
+    and the explicit block sizes in ONE process, alternating rounds, so
+    chip/tunnel drift between processes can't masquerade as a config
+    effect. Reports best-rep TFLOP/s per leg + the auto-picked pair."""
+    from tf_operator_tpu.ops import attention
+    from tf_operator_tpu.ops.flash_attention import (
+        flash_attention,
+        select_block_pair,
+    )
+
+    seq, batch = bench.smoke_attn_config()
+    interpret = bool(os.environ.get("BENCH_SMOKE"))
+    q, k, v = bench.attn_inputs(batch, seq)
+    flops = bench.flash_model_flops(batch, seq)
+
+    def make_call(fn):
+        # Shared construction with every other attention timing tool —
+        # the whole point of this probe is comparability with them.
+        return bench.attn_fwd_bwd_call(fn, q, k, v)
+
+    legs = {"dispatch_auto": make_call(
+        lambda q, k, v: attention(q, k, v, causal=True))}
+    for bq in (64, 128) if interpret else (256, 512, 1024):
+        if seq % bq == 0:
+            legs[f"direct_bq{bq}"] = make_call(
+                lambda q, k, v, bq=bq: flash_attention(
+                    q, k, v, causal=True, block=64 if interpret else 256,
+                    block_q=bq, interpret=interpret))
+
+    for call in legs.values():  # compile + first-rep ramp, off the clock
+        # slow-call early stop: on a degraded tunnel each call can run
+        # minutes, and 2 unconditional warmups x 4 legs would eat the
+        # whole stage budget before a single timed rep.
+        bench._warm(call, warmup=2)
+    best: dict[str, float] = {}
+    for _ in range(4):  # interleaved rounds: drift hits every leg equally
+        for name, call in legs.items():
+            t0 = time.perf_counter()
+            call()
+            dt = time.perf_counter() - t0
+            best[name] = min(best.get(name, float("inf")), dt)
+    pair = select_block_pair(seq, seq, compiled=not interpret)
+    emit(
+        "qblock", seq=seq, batch=batch,
+        auto_pair=list(pair) if pair else None,
+        **{f"{name}_tflops": flops / dt / 1e12 for name, dt in best.items()},
+    )
 
 
 def probe_flashsweep() -> None:
@@ -693,12 +738,14 @@ def probe_roofline() -> None:
     n = 512 if smoke else 4096
     chain_tflops = bench.measure_chain_matmul_tflops(n, 4 if smoke else 20)
     copy_gbps = bench.measure_copy_gbps()
+    chain_copy_gbps = bench.measure_chain_copy_gbps()
 
     emit(
         "roofline",
         dispatch_roundtrip_ms=dispatch_ms,
         matmul_chain_tflops=chain_tflops,
         copy_gbps=copy_gbps,
+        chain_copy_gbps=chain_copy_gbps,
         chain_n=n,
         device_kind=getattr(jax.devices()[0], "device_kind", "?"),
         **single,
@@ -709,6 +756,7 @@ PROBES = {
     "roofline": probe_roofline,
     "flashramp": probe_flashramp,
     "flashblocks": probe_flashblocks,
+    "qblock": probe_qblock,
     "flashsweep": probe_flashsweep,
     "h2d": probe_h2d,
     "input": probe_input,

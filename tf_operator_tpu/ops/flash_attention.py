@@ -102,13 +102,14 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
     return None
 
 
-# Q-block growth cap: the score tile is [bq, bk] f32 in VMEM (512x256 =
-# 512 KiB, well inside a core's ~16 MiB) and the Q-side accumulators are
+# Q-block growth cap: the score tile is [bq, bk] f32 in VMEM (1024x256 =
+# 1 MiB, well inside a core's ~16 MiB) and the Q-side accumulators are
 # [bq, head_dim] f32. Growing bq amortizes the K/V HBM streaming — per
 # grid cell the kernel moves O(bk*d) K/V bytes for O(bq*bk*d) FLOPs, so
-# arithmetic intensity scales linearly in bq; at bq=bk=256, d=64 the
-# fwd+bwd cells sit near the measured HBM roofline (round-3 perf notes).
-MAX_Q_BLOCK = 512
+# arithmetic intensity scales linearly in bq. Measured on hardware
+# (window_r05 flashblocks probe, 8k causal fwd+bwd, b4): bq256 9.0,
+# bq512 11.0, bq1024 14.0 TFLOP/s — so the cap sits at 1024.
+MAX_Q_BLOCK = 1024
 
 
 def select_block_pair(
