@@ -708,6 +708,100 @@ def run_window() -> None:
                   min(1100.0, left))
 
 
+def probe_specdecode() -> None:
+    """Speculative-decoding component costs on hardware (the two
+    acceptance-curve ENDPOINTS that bound any trained draft/target pair;
+    exactness itself is pinned CPU-side in tests/test_spec_decode.py):
+
+    - ``plain``: target-only greedy generate (the baseline).
+    - ``spec_self``: draft == target — 100% acceptance, k+1 tokens per
+      round at FULL draft cost. Mechanics ceiling: isolates the chunked
+      verify + rollback overhead from draft quality.
+    - ``spec_cold``: a ~4x-smaller random draft — ~0% acceptance, 1
+      token per round at maximal overhead. The floor.
+
+    Speedup for a real pair with acceptance a and relative draft cost c:
+    tokens/round = E[m]+1, round cost = (k+1)*c + chunk(k+1) target
+    read; both components are measurable from these legs (chunk cost =
+    spec_self round time minus k+1 draft steps)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.spec_decode import speculative_generate
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+    )
+
+    B, prompt_len, steps = (
+        bench.DECODE_BATCH, bench.DECODE_PROMPT, bench.DECODE_STEPS
+    )
+    k = 4
+    cfg = TransformerConfig(
+        dtype=jnp.bfloat16,
+        **dict(bench.LM_SIZE, max_seq_len=prompt_len + steps + k + 1),
+    )
+    # ~4x fewer layers: the canonical cheap-draft shape (same width, so
+    # embeddings/head stay compatible in spirit; params are random —
+    # acceptance ~0 by construction, which is the point of the leg).
+    draft_cfg = TransformerConfig(
+        dtype=jnp.bfloat16,
+        **dict(
+            bench.LM_SIZE,
+            n_layers=max(1, dict(bench.LM_SIZE)["n_layers"] // 4),
+            max_seq_len=prompt_len + steps + k + 1,
+        ),
+    )
+    prompt = jnp.zeros((B, prompt_len), jnp.int32)
+    tparams = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        Transformer(cfg).init(jax.random.PRNGKey(0), prompt)["params"],
+    )
+    dparams = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16),
+        Transformer(draft_cfg).init(
+            jax.random.PRNGKey(1), prompt
+        )["params"],
+    )
+
+    def plain():
+        int(generate(cfg, tparams, prompt, num_steps=steps)[0, -1])
+
+    results = {}
+    rounds: dict[str, int] = {}
+
+    def leg(name, call):
+        dt = min(bench.timed_reps(call, reps=2, warmup=2))
+        results[f"tokens_per_sec_{name}"] = B * steps / dt
+
+    leg("plain", plain)
+
+    def spec(name, dcfg, dp):
+        holder = {}
+
+        def call():
+            toks, r = speculative_generate(
+                cfg, tparams, dcfg, dp, prompt, steps, k=k
+            )
+            int(toks[0, -1])
+            holder["rounds"] = int(r)
+
+        leg(name, call)
+        rounds[name] = holder["rounds"]
+
+    spec("spec_self", cfg, tparams)
+    spec("spec_cold", draft_cfg, dparams)
+    emit(
+        "specdecode", batch=B, prompt_len=prompt_len, steps=steps, k=k,
+        **results,
+        rounds_self=rounds.get("spec_self"),
+        rounds_cold=rounds.get("spec_cold"),
+        tokens_per_round_self=steps / max(1, rounds.get("spec_self", 1)),
+        tokens_per_round_cold=steps / max(1, rounds.get("spec_cold", 1)),
+    )
+
+
 def probe_roofline() -> None:
     import jax
     import jax.numpy as jnp
@@ -767,6 +861,7 @@ PROBES = {
     "lmsweep": probe_lmsweep,
     "decodesweep": probe_decodesweep,
     "decodelong": probe_decodelong,
+    "specdecode": probe_specdecode,
 }
 
 

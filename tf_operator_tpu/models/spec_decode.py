@@ -1,0 +1,235 @@
+"""Speculative decoding: exact greedy acceleration with a draft model.
+
+A small DRAFT model proposes k tokens autoregressively; the TARGET model
+scores all k in ONE chunked forward against its KV cache (the same
+block-causal multi-token path prompt prefill uses) and accepts the
+longest prefix that matches its own greedy choices, then contributes one
+more token itself (the correction at the first mismatch, or the bonus
+token when everything matched). Greedy speculative decoding is EXACT:
+every emitted token is the target model's argmax given the emitted
+prefix, so the output is bit-identical to ``generate(target_cfg, ...)``
+with ``temperature=0`` — pinned by tests/test_spec_decode.py.
+
+Why this is the TPU-shaped decode accelerator: single-token decode is
+weight-read-bound (docs/perf.md — the per-step HBM read of the full
+parameter set dominates), so the target's cost per ROUND is one small
+chunk forward (k+1 tokens read the weights ONCE) instead of m+1
+single-token reads. With acceptance rate a and a draft that costs
+fraction c of the target per token, tokens/round = a·k* + 1 (expected)
+while round cost ≈ (k+1)·c + 1 target-chunk reads — the measured
+component costs let the speedup curve be computed for any trained
+draft/target pair (see the spec leg notes in docs/perf.md).
+
+Mechanics that make it jittable (static shapes throughout):
+
+- The while_loop carries (target cache, draft cache, out buffer, count,
+  pending token). Each round feeds a FIXED k+1 tokens to both models.
+- Cache rollback is O(1): rejected positions are undone by rewriting the
+  scalar ``cache_index`` (set_cache_index) — the decode attention masks
+  every position >= index, so stale K/V entries beyond it are invisible
+  and get overwritten by later writes.
+- Batch rows accept different prefix lengths; the round advances by the
+  BATCH MIN m. Rows that accepted more still emit their own target
+  argmax at position m (for them it equals their draft token), so
+  per-row exactness holds with a single shared cache index.
+
+No reference counterpart: the reference operator runs no models
+(SURVEY.md §2.9); this extends the serving stack its users would bring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import TransformerConfig, Transformer
+
+
+def set_cache_index(cache: Any, value) -> Any:
+    """Return ``cache`` with every position counter set to ``value`` (an
+    int32 scalar or tracer): the per-layer ``cache_index`` AND the
+    top-level ``pos_index`` that drives positional embeddings — the two
+    MUST roll back in lockstep, or re-fed tokens keep advancing position
+    embeddings while overwriting earlier cache slots (K/V written with
+    the wrong position — the exactness bug the first cut of this module
+    had). K/V buffers are untouched: decode attention masks positions
+    >= index, so rewriting the counters IS the rollback."""
+    from collections.abc import Mapping
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            # rebuilt as plain dicts — model.apply accepts them, and it
+            # normalizes away FrozenDict vs dict across flax versions.
+            return {
+                k: (jnp.asarray(value, jnp.int32)
+                    if k in ("cache_index", "pos_index")
+                    else walk(v))
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(cache)
+
+
+def speculative_generate(
+    target_cfg: TransformerConfig,
+    target_params: Any,
+    draft_cfg: TransformerConfig,
+    draft_params: Any,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    k: int = 4,
+) -> tuple[jax.Array, jax.Array]:
+    """Greedy speculative decode: ([B, num_steps] tokens, rounds used).
+
+    Exact equivalent of ``generate(target_cfg, target_params, prompt,
+    num_steps)`` at temperature 0, for ANY draft model (a bad draft only
+    costs speed, never correctness). ``k`` = draft proposals per round;
+    each round emits between 1 and k+1 tokens (batch-min acceptance + 1).
+    ``rounds`` is the number of verify forwards the loop ran — the
+    acceptance telemetry: tokens/round = num_steps/rounds.
+    """
+    if prompt.shape[1] + num_steps + k + 1 > target_cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + steps {num_steps} + speculation "
+            f"margin {k + 1} exceeds target max_seq_len "
+            f"{target_cfg.max_seq_len} (the cache must hold up to k "
+            "rejected tokens beyond the emitted sequence)"
+        )
+    if prompt.shape[1] + num_steps + k + 1 > draft_cfg.max_seq_len:
+        raise ValueError("draft max_seq_len too small for prompt + steps + k")
+    if k < 1:
+        raise ValueError(f"k={k} must be >= 1")
+    for name, cfg in (("target", target_cfg), ("draft", draft_cfg)):
+        if cfg.int8_decode:
+            raise ValueError(
+                f"{name}_cfg.int8_decode is not supported by speculative "
+                "decoding (the int8 head tree has no shared greedy-head "
+                "path here); quantize after choosing a decode strategy"
+            )
+    fn = _spec_fn(target_cfg, draft_cfg, num_steps, int(k))
+    return fn(target_params, draft_params, prompt)
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
+             num_steps: int, k: int):
+    from dataclasses import replace
+
+    tmodel = Transformer(replace(
+        target_cfg, decode=True, mesh=None, remat=False))
+    dmodel = Transformer(replace(
+        draft_cfg, decode=True, mesh=None, remat=False))
+
+    def greedy_head(model_params, hidden):
+        head = model_params["lm_head"]
+        return (
+            hidden.astype(jnp.float32) @ head["kernel"] + head["bias"]
+        ).argmax(-1)
+
+    def run(tparams, dparams, prompt):
+        b = prompt.shape[0]
+        tok_dtype = prompt.dtype
+
+        tcache = tmodel.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+        dcache = dmodel.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+
+        # Prompt prefill, both models; only the target's logits matter.
+        thidden, tupd = tmodel.apply(
+            {"params": tparams, "cache": tcache}, prompt,
+            mutable=["cache"], return_hidden=True,
+        )
+        tcache = tupd["cache"]
+        _, dupd = dmodel.apply(
+            {"params": dparams, "cache": dcache}, prompt,
+            mutable=["cache"], return_hidden=True,
+        )
+        dcache = dupd["cache"]
+
+        pend = greedy_head(tparams, thidden[:, -1]).astype(tok_dtype)
+
+        # Output buffer with k+1 slack: each round unconditionally writes
+        # a k+1 window at position n (n < num_steps inside the loop, so
+        # the window never clamps); positions beyond the accepted count
+        # hold junk until the next round's window overwrites them.
+        out0 = jnp.zeros((b, num_steps + k + 1), tok_dtype)
+        out0 = out0.at[:, 0].set(pend)
+
+        def draft_step(carry, _):
+            dcache, tok = carry
+            logits, upd = dmodel.apply(
+                {"params": dparams, "cache": dcache}, tok[:, None],
+                mutable=["cache"],
+            )
+            nxt = logits[:, 0].argmax(-1).astype(tok_dtype)
+            return (upd["cache"], nxt), nxt
+
+        def round_body(state):
+            tcache, dcache, out, n, pend, rounds = state
+            t_idx = _cache_index(tcache)
+            d_idx = _cache_index(dcache)
+
+            # Draft k+1 greedy steps from the pending token. Proposals
+            # are the first k outputs; the last is drafted only so the
+            # draft cache contains d_k when everything gets accepted.
+            (dcache, _), drafted = jax.lax.scan(
+                draft_step, (dcache, pend), None, length=k + 1
+            )
+            drafted = drafted.swapaxes(0, 1)  # [B, k+1]
+            proposals = drafted[:, :k]
+
+            # Target verifies the whole chunk in one forward: feed
+            # [pend, d_1..d_k] (k+1 tokens); logits row i predicts the
+            # token AFTER chunk[i].
+            chunk = jnp.concatenate([pend[:, None], proposals], axis=1)
+            tlogits, tupd = tmodel.apply(
+                {"params": tparams, "cache": tcache}, chunk,
+                mutable=["cache"],
+            )
+            tcache = tupd["cache"]
+            targmax = tlogits.argmax(-1).astype(tok_dtype)  # [B, k+1]
+
+            # Per-row accepted prefix length, then the batch-min cut.
+            match = proposals == targmax[:, :k]  # [B, k]
+            m_row = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+            m = jnp.min(m_row)  # scalar: tokens accepted this round
+
+            # Emit d_1..d_m then each row's own argmax at position m
+            # (correction at a mismatch; equal to the row's d_{m+1} when
+            # the row accepted further — exactness per row).
+            nxt_pend = jnp.take_along_axis(
+                targmax, jnp.full((b, 1), m), axis=1
+            )[:, 0]
+            cand = jnp.where(
+                jnp.arange(k + 1)[None, :] < m, drafted, nxt_pend[:, None]
+            )
+            out = jax.lax.dynamic_update_slice(out, cand, (0, n))
+
+            # Rollback: true fed prefix grew by pend + accepted proposals.
+            tcache = set_cache_index(tcache, t_idx + 1 + m)
+            dcache = set_cache_index(dcache, d_idx + 1 + m)
+            return (tcache, dcache, out, n + 1 + m, nxt_pend, rounds + 1)
+
+        def cond(state):
+            return state[3] < num_steps
+
+        state = (tcache, dcache, out0, jnp.asarray(1, jnp.int32), pend,
+                 jnp.asarray(0, jnp.int32))
+        _, _, out, _, _, rounds = jax.lax.while_loop(cond, round_body, state)
+        return out[:, :num_steps], rounds
+
+    return jax.jit(run)
+
+
+def _cache_index(cache: Any) -> jax.Array:
+    """The shared scalar cache_index (all layers advance in lockstep)."""
+    for leaf_path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if any(
+            getattr(p, "key", None) == "cache_index" for p in leaf_path
+        ):
+            return leaf
+    raise ValueError("no cache_index in cache tree")

@@ -1,0 +1,123 @@
+"""Device-resident input: the TPU-idiomatic answer to an h2d-bound host.
+
+The round-5 hardware window attributed the ResNet end-to-end gap
+(181 img/s vs 2,533 device-resident) to h2d transfer through the
+tunnel — the host pipeline itself sustains 14.4k img/s (docs/perf.md,
+"ResNet attribution"). When the dataset (or a working shard of it) fits
+in HBM, the classic TPU move is to put the RAW uint8 records on device
+ONCE and run sampling + augmentation there too: per step the only
+"input pipeline" is an HBM gather + crop + flip fused into the training
+scan — zero per-step host work, zero per-step transfer.
+
+This is a different contract from the streaming path (`native/pipeline`
++ `native/augment`): sampling is i.i.d. with replacement via the JAX
+PRNG (stateless, replayable from a key) rather than epoch-shuffled, and
+the crop/flip draws come from `jax.random` rather than the native
+augmenter's counter-based RNG — statistically equivalent augmentation,
+not bit-identical. Document the mode on any number measured with it.
+
+No reference counterpart: the reference operator has no input pipeline
+at all (it schedules pods; SURVEY.md §2.9 — zero sharded-execution
+code). This module exists because the framework side of this repo
+carries the full training stack.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+def load_records_numpy(
+    path: str, rec_bytes: int, record_size: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Read a record file (image bytes + 1 trailing label byte per
+    record — the `bench.ensure_bench_records` / `native.pipeline`
+    layout) into ([N, R, R, 3] uint8 images, [N] int32 labels), ready
+    for a one-time `jax.device_put`."""
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % rec_bytes:
+        raise ValueError(
+            f"{path}: size {raw.size} is not a multiple of rec_bytes "
+            f"{rec_bytes}"
+        )
+    n = raw.size // rec_bytes
+    img_bytes = record_size * record_size * 3
+    if img_bytes + 1 != rec_bytes:
+        raise ValueError(
+            f"rec_bytes {rec_bytes} != {record_size}^2*3 + 1 label byte"
+        )
+    recs = raw.reshape(n, rec_bytes)
+    images = recs[:, :img_bytes].reshape(n, record_size, record_size, 3)
+    labels = recs[:, img_bytes].astype(np.int32)
+    return images, labels
+
+
+def make_resident_sampler(
+    images, labels, batch: int, image_size: int, num_classes: int = 1000
+) -> Callable:
+    """sample_batch(key) -> {"image": bf16 normalized [B,S,S,3],
+    "label": int32 [B]} — gather + random-crop + random-hflip +
+    normalize, entirely on device from resident uint8 records.
+
+    `images`: [N, R, R, 3] uint8 (device array or committed numpy),
+    `labels`: [N] int32. R > image_size enables random cropping (margin
+    R - image_size); R == image_size degenerates to flip-only. Traceable
+    under jit/scan: all shapes static, per-sample crops via a vmapped
+    dynamic_slice.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n, r = images.shape[0], images.shape[1]
+    margin = r - image_size
+    if margin < 0:
+        raise ValueError(f"records {r}^2 smaller than crop {image_size}^2")
+
+    def sample_batch(key):
+        k_idx, k_oy, k_ox, k_flip = jax.random.split(key, 4)
+        idx = jax.random.randint(k_idx, (batch,), 0, n)
+        oy = jax.random.randint(k_oy, (batch,), 0, margin + 1)
+        ox = jax.random.randint(k_ox, (batch,), 0, margin + 1)
+        flip = jax.random.bernoulli(k_flip, 0.5, (batch,))
+
+        gathered = jnp.take(images, idx, axis=0)  # [B, R, R, 3] u8 gather
+
+        def crop_one(img, y0, x0):
+            return jax.lax.dynamic_slice(
+                img, (y0, x0, 0), (image_size, image_size, 3)
+            )
+
+        cropped = jax.vmap(crop_one)(gathered, oy, ox)
+        flipped = jnp.where(
+            flip[:, None, None, None], cropped[:, :, ::-1, :], cropped
+        )
+        img = (flipped.astype(jnp.bfloat16) - 127.5) / 127.5
+        return {"image": img, "label": jnp.take(labels, idx) % num_classes}
+
+    return sample_batch
+
+
+def make_resident_train_loop(
+    step: Callable, sample_batch: Callable, n_steps: int
+) -> Callable:
+    """Fuse `n_steps` of (sample on device → train step) into one jitted
+    scan: fused(state, key) -> (state, last_metrics, next_key). The PRNG
+    key rides the scan carry, so consecutive calls continue the stream
+    — the whole training loop runs without touching the host."""
+    import jax
+
+    def fused(state, key):
+        def body(carry, _):
+            state, key = carry
+            key, sub = jax.random.split(key)
+            state, metrics = step(state, sample_batch(sub))
+            return (state, key), metrics
+
+        (state, key), ms = jax.lax.scan(
+            body, (state, key), None, length=n_steps
+        )
+        return state, {k: v[-1] for k, v in ms.items()}, key
+
+    return jax.jit(fused, donate_argnums=(0,))

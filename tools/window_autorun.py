@@ -64,6 +64,11 @@ STAGES = [
     # measured 14.0 TFLOP/s but the dispatch path read 11.5 minutes
     # later — interleaved legs decide config effect vs chip drift).
     ("qblock", {"PROBE": "qblock"}, 600.0),
+    # NEW headline candidate: dataset resident in HBM, augmentation on
+    # device (train/device_input.py) — the designed answer to this
+    # environment's ~27 MB/s h2d. Expected to land near the synthetic
+    # 2,533 img/s WITH augmentation on the clock.
+    ("resnet_resident", {"BENCH": "resnet_resident"}, 900.0),
     ("lm_ab_flash", {"BENCH": "lm", "TPU_OPERATOR_ATTN": ""}, 1100.0),
     ("lm_ab_xla", {"BENCH": "lm", "TPU_OPERATOR_ATTN": "xla"}, 1100.0),
     ("lmsweep", {"PROBE": "lmsweep"}, 1500.0),
@@ -72,6 +77,9 @@ STAGES = [
     # Long-context cache ladder: bf16 -> int8 cache (2x) -> GQA (4x) ->
     # both (8x) at the shape where the cache dominates the per-step read.
     ("decodelong", {"PROBE": "decodelong"}, 1500.0),
+    # Speculative-decoding component costs (plain vs self-draft vs cold
+    # draft): the acceptance-curve endpoints for models/spec_decode.py.
+    ("specdecode", {"PROBE": "specdecode"}, 900.0),
     # Tail attribution: host input pipeline (CPU-only, cheap) and the
     # ResNet fwd/bwd split — consulted if the synthetic-vs-bench split
     # points at input/transfer or the gradient path respectively.
