@@ -120,6 +120,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--requests", type=int, default=None,
                    help="exit 0 after serving this many /generate calls "
                         "(job mode); default: run until SIGTERM")
+    p.add_argument("--spec-k", type=int, default=0, metavar="K",
+                   help="speculative decoding: a smaller DRAFT model "
+                        "proposes K tokens per round, verified in one "
+                        "chunked target forward (models/spec_decode.py). "
+                        "Greedy requests only; output is EXACTLY the "
+                        "plain greedy output (a bad draft costs speed, "
+                        "never correctness). 0 = off")
+    p.add_argument("--spec-draft-layers", type=int, default=None,
+                   help="draft depth (default max(1, --layers // 2)); "
+                        "the draft trains on the same synthetic task "
+                        "(quick_train), so it actually accepts")
     p.add_argument("--batch-window", type=float, default=0.0, metavar="MS",
                    help="coalesce concurrent greedy /generate requests of "
                         "the same shape for this many ms and run them as "
@@ -136,6 +147,20 @@ def main(argv: list[str] | None = None) -> int:
         # paid the full checkpoint restore + tp shard before the error.
         p.error("--int8 with --tp > 1 is not supported (the int8 "
                 "kernel has no SPMD partitioning rule)")
+    if args.spec_k:
+        if args.spec_k < 1:
+            p.error("--spec-k must be >= 1 (0 disables)")
+        if (args.spec_draft_layers is not None
+                and args.spec_draft_layers < 1):
+            p.error("--spec-draft-layers must be >= 1")
+        if args.int8 or args.kv_int8 or args.tp > 1:
+            p.error("--spec-k composes only with the plain decode path "
+                    "(not --int8/--kv-int8/--tp; speculative exactness "
+                    "is pinned for that configuration)")
+        if args.checkpoint_dir:
+            p.error("--spec-k with --checkpoint-dir needs a trained "
+                    "draft checkpoint, which this example does not "
+                    "plumb; use the quick-train path")
 
     import jax
     import jax.numpy as jnp
@@ -215,6 +240,49 @@ def main(argv: list[str] | None = None) -> int:
 
         cfg = replace(cfg, kv_int8=True)
         print("serve_lm: KV cache int8 (per-token/head scales)", flush=True)
+
+    draft_cfg = draft_params = None
+    if args.spec_k:
+        from dataclasses import replace as _replace
+
+        draft_cfg = _replace(
+            cfg,
+            n_layers=(args.spec_draft_layers
+                      if args.spec_draft_layers is not None
+                      else max(1, args.layers // 2)),
+        )
+        # Same synthetic task as the target: the draft genuinely agrees
+        # with the target often enough to accept (quick_train's data is
+        # deterministic per config shape).
+        draft_params = quick_train(draft_cfg, args.train_steps, args.lr)
+        print(f"serve_lm: speculative decoding on (k={args.spec_k}, "
+              f"draft layers={draft_cfg.n_layers})", flush=True)
+
+    spec_stats = {"decodes": 0, "rounds": 0, "tokens": 0}
+
+    def decode_greedy(rows, num_steps: int):
+        """The one greedy decode path (direct AND coalesced): plain
+        generate, or speculative when enabled and the speculation margin
+        fits the cache (falls back to plain otherwise — same output
+        either way, that is the whole point). spec_stats (surfaced via
+        /healthz) proves the speculative path actually ran — callers
+        hold `lock`, which also covers the counter updates."""
+        if (args.spec_k
+                and rows.shape[1] + num_steps + args.spec_k + 1
+                <= cfg.max_seq_len):
+            from tf_operator_tpu.models.spec_decode import (
+                speculative_generate,
+            )
+
+            out, rounds = speculative_generate(
+                cfg, params, draft_cfg, draft_params, rows, num_steps,
+                k=args.spec_k,
+            )
+            spec_stats["decodes"] += 1
+            spec_stats["rounds"] += int(rounds)
+            spec_stats["tokens"] += num_steps
+            return out
+        return generate(cfg, params, rows, num_steps=num_steps)
 
     served = 0
     done = threading.Event()
@@ -325,8 +393,7 @@ def main(argv: list[str] | None = None) -> int:
                             [rows, jnp.zeros((bucket - k, rows.shape[1]),
                                              rows.dtype)], axis=0)
                     with lock:
-                        out = generate(cfg, params, rows,
-                                       num_steps=num_steps)
+                        out = decode_greedy(rows, num_steps)
                     self.batches += 1
                     self.max_rows_seen = max(self.max_rows_seen, k)
                     at = 0
@@ -370,6 +437,10 @@ def main(argv: list[str] | None = None) -> int:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
                     payload["pending"] = len(coalescer.pending)
+                if args.spec_k:
+                    payload["spec_decodes"] = spec_stats["decodes"]
+                    payload["spec_rounds"] = spec_stats["rounds"]
+                    payload["spec_tokens"] = spec_stats["tokens"]
                 self._json(200, payload)
             else:
                 self._json(404, {"error": "unknown path"})
@@ -402,6 +473,9 @@ def main(argv: list[str] | None = None) -> int:
                     kw["top_p"] = float(top_p)
                 if coalescer is not None and not kw:
                     out = coalescer.submit(prompt, num_steps)
+                elif not kw:
+                    with lock:
+                        out = decode_greedy(prompt, num_steps)
                 else:
                     with lock:
                         out = generate(
