@@ -84,6 +84,29 @@ def test_report_renders_from_artifacts(tmp_path):
         + json.dumps({"probe": "decodesweep", "weights": "int8", "batch": 8,
                       "error": "boom"}) + "\n"
     )
+    # r05-added stages: the chained-copy roofline re-run, the dispatch
+    # Q-block arbitration, the resident ResNet mode, spec decoding.
+    (d / "roofline2.jsonl").write_text(json.dumps({
+        "probe": "roofline", "dispatch_roundtrip_ms": 0.05,
+        "matmul_chain_tflops": 111.0, "copy_gbps": 111.0,
+        "chain_copy_gbps": 400.0,
+    }) + "\n")
+    (d / "qblock.jsonl").write_text(json.dumps({
+        "probe": "qblock", "auto_pair": [1024, 256],
+        "dispatch_auto_tflops": 13.8, "direct_bq1024_tflops": 14.0,
+        "direct_bq512_tflops": 11.0,
+    }) + "\n")
+    (d / "resnet_resident.jsonl").write_text(json.dumps({
+        "metric": "resnet50_train_images_per_sec_bf16_b256_resident_1chip",
+        "value": 2450.0, "mfu": 0.28,
+    }) + "\n")
+    (d / "specdecode.jsonl").write_text(json.dumps({
+        "probe": "specdecode", "k": 4,
+        "tokens_per_sec_plain": 1000.0,
+        "tokens_per_sec_spec_self": 800.0,
+        "tokens_per_sec_spec_cold": 400.0,
+        "tokens_per_round_self": 5.0, "tokens_per_round_cold": 1.1,
+    }) + "\n")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "window_report.py"),
          str(d)],
@@ -98,6 +121,14 @@ def test_report_renders_from_artifacts(tmp_path):
     # Error row doesn't crash the report, and no speedup line is printed.
     assert "boom" not in out
     assert "int8 speedup" not in out
+    # roofline2's chained copy becomes the bandwidth yardstick: the
+    # 47 GB/s decode row re-denominates to 47/400 = 11.8%.
+    assert "scan-chained" in out and "400.0" in out
+    assert "11.8%" in out
+    # qblock, resident, and specdecode sections render.
+    assert "dispatch_auto=13.8" in out
+    assert "resident mode" in out and "2450.0" in out
+    assert "spec_self (k=4)" in out and "0.80x" in out
 
 
 def test_report_attribution_math_round3_shaped(tmp_path):

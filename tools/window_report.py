@@ -69,10 +69,17 @@ def main() -> int:
         d = stamps[-1]
     print(f"# Window report — {os.path.basename(d)}\n")
 
-    # Measured ceilings.
+    # Measured ceilings. roofline2 (the re-run with the scan-chained
+    # copy leg) overrides the first capture where present.
     roof = (load(d, "roofline") or [{}])[0]
+    roof.update((load(d, "roofline2") or [{}])[0])
     chain = roof.get("matmul_chain_tflops")
     copy = roof.get("copy_gbps")
+    chain_copy = roof.get("chain_copy_gbps")
+    # Bandwidth yardstick: the scan-chained copy where measured (per-
+    # execution scheduling makes one-shot copies under-read this
+    # environment ~5x — docs/perf.md r05), else the one-shot number.
+    bw_roof = chain_copy or copy
     print("## Measured ceilings (same-window)\n")
     print("| probe | value | vs v5e spec |")
     print("|---|---|---|")
@@ -83,8 +90,11 @@ def main() -> int:
                 print(f"| {key} | {fmt(val)} TFLOP/s | "
                       f"{fmt(val / V5E_SPEC_TFLOPS * 100)}% |")
         if copy:
-            print(f"| copy bandwidth | {fmt(copy)} GB/s | "
+            print(f"| copy bandwidth (one-shot) | {fmt(copy)} GB/s | "
                   f"{fmt(copy / V5E_SPEC_GBPS * 100)}% |")
+        if chain_copy:
+            print(f"| copy bandwidth (scan-chained) | {fmt(chain_copy)} "
+                  f"GB/s | {fmt(chain_copy / V5E_SPEC_GBPS * 100)}% |")
     else:
         print("| (roofline stage produced no data) | | |")
     print()
@@ -94,12 +104,26 @@ def main() -> int:
     bench_lines = load(d, "bench_full")
     resnet = next((m for m in bench_lines
                    if m.get("metric", "").startswith("resnet50_")), {})
+    # The dedicated re-measure stages override the first-window lines
+    # (bench_resnet2 carries the mfu sanity gate; resnet_resident is the
+    # HBM-resident + on-device-augment mode).
+    resnet2 = next((m for m in load(d, "bench_resnet2")
+                    if m.get("metric", "").startswith("resnet50_")
+                    and "error" not in m), {})
+    resident = next((m for m in load(d, "resnet_resident")
+                     if "resident" in m.get("metric", "")
+                     and "error" not in m), {})
+    if resnet2:
+        resnet = resnet2
     print("## ResNet attribution (VERDICT r3 item 1)\n")
     print("| measurement | img/s |")
     print("|---|---|")
     print(f"| device-resident synthetic (b256) | {fmt(syn.get('images_per_sec'))} |")
     print(f"| device-resident synthetic (b512) | {fmt(syn.get('images_per_sec_b2x'))} |")
     print(f"| end-to-end bench (input+transfer on clock) | {fmt(resnet.get('value'))} |")
+    if resident:
+        print(f"| resident mode (HBM dataset + on-device augment, "
+              f"augmentation on clock) | {fmt(resident.get('value'))} |")
     if syn.get("images_per_sec") and resnet.get("value"):
         ratio = resnet["value"] / syn["images_per_sec"]
         print(f"\nEnd-to-end / synthetic = {fmt(ratio, 2)} — "
@@ -142,6 +166,15 @@ def main() -> int:
         print(f"- Q-block A/B: " + ", ".join(
             f"{key}={fmt(val)}" for key, val in sorted(bq.items()))
             + f" TFLOP/s → best {best}")
+    qb = (load(d, "qblock") or [{}])[0]
+    qb_legs = {key.removesuffix("_tflops"): val for key, val in qb.items()
+               if key.endswith("_tflops")}
+    if qb_legs:
+        print(f"- qblock interleaved (auto pair {qb.get('auto_pair')}): "
+              + ", ".join(f"{name}={fmt(val)}"
+                          for name, val in sorted(qb_legs.items()))
+              + " TFLOP/s — dispatch_auto vs its direct_bq leg decides "
+                "config-effect vs drift")
     for m in load(d, "bench_full"):
         if m.get("metric", "").startswith("flash_attention"):
             print(f"- bench {m['metric']}: {m['value']} TFLOP/s "
@@ -185,19 +218,21 @@ def main() -> int:
                     if m.get("metric", "").startswith("lm_decode")]
     all_rows = rows + bench_decode
     if all_rows:
-        print("| source | weights | batch | gen tok/s | GB/s | % of measured copy roofline |")
+        bw_label = ("scan-chained copy roofline" if chain_copy
+                    else "one-shot copy roofline")
+        print(f"| source | weights | batch | gen tok/s | GB/s | % of measured {bw_label} |")
         print("|---|---|---|---|---|---|")
         for row in rows:
             if "error" in row:
                 continue
             gbps = row.get("hbm_gbps")
-            pct = fmt(gbps / copy * 100) if (gbps and copy) else "—"
+            pct = fmt(gbps / bw_roof * 100) if (gbps and bw_roof) else "—"
             print(f"| probe | {row.get('weights')} | {row.get('batch')} "
                   f"| {fmt(row.get('gen_tokens_per_sec'))} | {fmt(gbps)} "
                   f"| {pct}% |")
         for m in bench_decode:
             gbps = m.get("hbm_gbps")
-            pct = fmt(gbps / copy * 100) if (gbps and copy) else "—"
+            pct = fmt(gbps / bw_roof * 100) if (gbps and bw_roof) else "—"
             # lm_decode_gen_tokens_per_sec_{weights}_b{B}_1chip
             parts = m["metric"].split("_")
             weights = parts[6] if len(parts) > 6 else "?"
@@ -236,6 +271,26 @@ def main() -> int:
                   + ("(cache-read halving pays off)" if sp > 1.15
                      else "(cache term not dominant here — check "
                           "kv_read_fraction)"))
+
+    # Speculative decoding component costs (acceptance-curve endpoints).
+    spec = (load(d, "specdecode") or [{}])[0]
+    if spec.get("tokens_per_sec_plain"):
+        print("\n## Speculative decoding (models/spec_decode.py)\n")
+        plain_tps = spec["tokens_per_sec_plain"]
+        print("| leg | gen tok/s | vs plain | tokens/round |")
+        print("|---|---|---|---|")
+        print(f"| plain greedy | {fmt(plain_tps)} | 1.00x | 1 |")
+        for leg, tpr in (("spec_self", "tokens_per_round_self"),
+                         ("spec_cold", "tokens_per_round_cold")):
+            tps = spec.get(f"tokens_per_sec_{leg}")
+            if tps:
+                print(f"| {leg} (k={spec.get('k')}) | {fmt(tps)} "
+                      f"| {fmt(tps / plain_tps, 2)}x "
+                      f"| {fmt(spec.get(tpr), 2)} |")
+        print("\nself = 100% acceptance at full draft cost (mechanics "
+              "ceiling); cold = ~0% acceptance (floor). A trained "
+              "draft/target pair lands between per the cost model in "
+              "the probe docstring.")
     return 0
 
 
