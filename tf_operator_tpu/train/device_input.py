@@ -9,12 +9,14 @@ ONCE and run sampling + augmentation there too: per step the only
 "input pipeline" is an HBM gather + crop + flip fused into the training
 scan — zero per-step host work, zero per-step transfer.
 
-This is a different contract from the streaming path (`native/pipeline`
-+ `native/augment`): sampling is i.i.d. with replacement via the JAX
-PRNG (stateless, replayable from a key) rather than epoch-shuffled, and
-the crop/flip draws come from `jax.random` rather than the native
-augmenter's counter-based RNG — statistically equivalent augmentation,
-not bit-identical. Document the mode on any number measured with it.
+Two sampling contracts, both fully on device: i.i.d.-with-replacement
+(`make_resident_sampler` — stateless, replayable from a key) and exact
+per-epoch permutation coverage (`make_resident_epoch_sampler` — the
+classic input-pipeline semantics; permutation + cursor ride the scan
+carry). Either way the crop/flip draws come from `jax.random` rather
+than the native augmenter's counter-based RNG — statistically
+equivalent augmentation to the streaming path, not bit-identical.
+Document the mode on any number measured with it.
 
 No reference counterpart: the reference operator has no input pipeline
 at all (it schedules pods; SURVEY.md §2.9 — zero sharded-execution
@@ -54,30 +56,22 @@ def load_records_numpy(
     return images, labels
 
 
-def make_resident_sampler(
-    images, labels, batch: int, image_size: int, num_classes: int = 1000
-) -> Callable:
-    """sample_batch(key) -> {"image": bf16 normalized [B,S,S,3],
-    "label": int32 [B]} — gather + random-crop + random-hflip +
-    normalize, entirely on device from resident uint8 records.
-
-    `images`: [N, R, R, 3] uint8 (device array or committed numpy),
-    `labels`: [N] int32. R > image_size enables random cropping (margin
-    R - image_size); R == image_size degenerates to flip-only. Traceable
-    under jit/scan: all shapes static, per-sample crops via a vmapped
-    dynamic_slice.
-    """
+def _make_augment(images, labels, image_size: int, num_classes: int):
+    """augment(idx, k_oy, k_ox, k_flip) -> batch dict: the ONE
+    gather + random-crop + random-hflip + normalize block, shared by
+    both samplers so the two modes can never preprocess differently.
+    Traceable under jit/scan: all shapes static, per-sample crops via a
+    vmapped dynamic_slice."""
     import jax
     import jax.numpy as jnp
 
-    n, r = images.shape[0], images.shape[1]
+    r = images.shape[1]
     margin = r - image_size
     if margin < 0:
         raise ValueError(f"records {r}^2 smaller than crop {image_size}^2")
 
-    def sample_batch(key):
-        k_idx, k_oy, k_ox, k_flip = jax.random.split(key, 4)
-        idx = jax.random.randint(k_idx, (batch,), 0, n)
+    def augment(idx, k_oy, k_ox, k_flip):
+        batch = idx.shape[0]
         oy = jax.random.randint(k_oy, (batch,), 0, margin + 1)
         ox = jax.random.randint(k_ox, (batch,), 0, margin + 1)
         flip = jax.random.bernoulli(k_flip, 0.5, (batch,))
@@ -96,28 +90,126 @@ def make_resident_sampler(
         img = (flipped.astype(jnp.bfloat16) - 127.5) / 127.5
         return {"image": img, "label": jnp.take(labels, idx) % num_classes}
 
+    return augment
+
+
+def make_resident_sampler(
+    images, labels, batch: int, image_size: int, num_classes: int = 1000
+) -> Callable:
+    """sample_batch(key) -> {"image": bf16 normalized [B,S,S,3],
+    "label": int32 [B]} — i.i.d.-with-replacement draws through the
+    shared on-device augment block (make_resident_epoch_sampler is the
+    epoch-shuffled alternative).
+
+    `images`: [N, R, R, 3] uint8 (device array or committed numpy),
+    `labels`: [N] int32. R > image_size enables random cropping (margin
+    R - image_size); R == image_size degenerates to flip-only.
+    """
+    import jax
+
+    n = images.shape[0]
+    augment = _make_augment(images, labels, image_size, num_classes)
+
+    def sample_batch(key):
+        k_idx, k_oy, k_ox, k_flip = jax.random.split(key, 4)
+        idx = jax.random.randint(k_idx, (batch,), 0, n)
+        return augment(idx, k_oy, k_ox, k_flip)
+
     return sample_batch
+
+
+def make_resident_epoch_sampler(
+    images, labels, batch: int, image_size: int, num_classes: int = 1000
+):
+    """Epoch-shuffled variant of make_resident_sampler: every record is
+    visited exactly once per epoch, in a per-epoch device-computed
+    permutation (classic input-pipeline semantics, vs the plain
+    sampler's i.i.d.-with-replacement draws).
+
+    Returns (sample_batch, state0): ``sample_batch(key, state) ->
+    (batch_dict, state)`` where state = (perm [N] int32, cursor scalar)
+    rides the caller's scan carry alongside the key. Requires
+    N % batch == 0 (drop-remainder semantics would silently skip a tail
+    each epoch; an explicit contract beats a hidden one). The crop/flip
+    draws still come from ``key`` per call, so augmentation differs
+    across epochs even though the visit order is the permutation's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = images.shape[0]
+    if n % batch:
+        raise ValueError(
+            f"records ({n}) must be divisible by batch ({batch}) for "
+            "exact epoch coverage"
+        )
+    augment = _make_augment(images, labels, image_size, num_classes)
+
+    def sample_batch(key, state):
+        perm, cursor = state
+        k_perm, k_oy, k_ox, k_flip = jax.random.split(key, 4)
+        # Epoch boundary: reshuffle and restart. cursor is always a
+        # multiple of batch (the only mutation is += batch), so the
+        # boundary test is exact equality with n.
+        at_end = cursor >= n
+        perm = jax.lax.cond(
+            at_end,
+            lambda: jax.random.permutation(k_perm, n).astype(jnp.int32),
+            lambda: perm,
+        )
+        cursor = jnp.where(at_end, 0, cursor)
+        idx = jax.lax.dynamic_slice(perm, (cursor,), (batch,))
+        return augment(idx, k_oy, k_ox, k_flip), (perm, cursor + batch)
+
+    # cursor starts AT n so the first call draws the first permutation
+    # from the caller's key — no host-side shuffle needed.
+    state0 = (jnp.arange(n, dtype=jnp.int32), jnp.asarray(n, jnp.int32))
+    return sample_batch, state0
+
+
+def make_resident_epoch_train_loop(
+    step: Callable, sample_batch: Callable, n_steps: int
+) -> Callable:
+    """THE fused (sample on device → train step) scan, stateful-sampler
+    form: fused(state, key, sampler_state) -> (state, last_metrics,
+    key, sampler_state). The PRNG key and the sampler state (e.g. the
+    epoch sampler's permutation + cursor) ride the scan carry, so
+    consecutive calls continue both streams — the whole training loop
+    runs without touching the host. make_resident_train_loop is the
+    stateless degenerate case built on this scaffold."""
+    import jax
+
+    def fused(state, key, sstate):
+        def body(carry, _):
+            state, key, sstate = carry
+            key, sub = jax.random.split(key)
+            batch, sstate = sample_batch(sub, sstate)
+            state, metrics = step(state, batch)
+            return (state, key, sstate), metrics
+
+        (state, key, sstate), ms = jax.lax.scan(
+            body, (state, key, sstate), None, length=n_steps
+        )
+        return state, {k: v[-1] for k, v in ms.items()}, key, sstate
+
+    return jax.jit(fused, donate_argnums=(0,))
 
 
 def make_resident_train_loop(
     step: Callable, sample_batch: Callable, n_steps: int
 ) -> Callable:
-    """Fuse `n_steps` of (sample on device → train step) into one jitted
-    scan: fused(state, key) -> (state, last_metrics, next_key). The PRNG
-    key rides the scan carry, so consecutive calls continue the stream
-    — the whole training loop runs without touching the host."""
-    import jax
+    """Stateless-sampler form: fused(state, key) -> (state,
+    last_metrics, next_key), for make_resident_sampler's
+    sample_batch(key). A thin wrapper over the stateful scaffold with
+    unit sampler state — one loop implementation, two signatures."""
+
+    def stateful_sample(key, sstate):
+        return sample_batch(key), sstate
+
+    inner = make_resident_epoch_train_loop(step, stateful_sample, n_steps)
 
     def fused(state, key):
-        def body(carry, _):
-            state, key = carry
-            key, sub = jax.random.split(key)
-            state, metrics = step(state, sample_batch(sub))
-            return (state, key), metrics
+        state, metrics, key, _ = inner(state, key, ())
+        return state, metrics, key
 
-        (state, key), ms = jax.lax.scan(
-            body, (state, key), None, length=n_steps
-        )
-        return state, {k: v[-1] for k, v in ms.items()}, key
-
-    return jax.jit(fused, donate_argnums=(0,))
+    return fused
