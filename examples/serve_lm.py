@@ -131,6 +131,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="draft depth (default max(1, --layers // 2)); "
                         "the draft trains on the same synthetic task "
                         "(quick_train), so it actually accepts")
+    p.add_argument("--stream-segment", type=int, default=16, metavar="N",
+                   help="segment size for streamed responses (POST "
+                        '/generate with "stream": true): greedy tokens '
+                        "are decoded in N-token segments through ONE "
+                        "reused executable and written to the client as "
+                        "NDJSON lines as each segment completes")
     p.add_argument("--batch-window", type=float, default=0.0, metavar="MS",
                    help="coalesce concurrent greedy /generate requests of "
                         "the same shape for this many ms and run them as "
@@ -471,6 +477,69 @@ def main(argv: list[str] | None = None) -> int:
                     # is rejected by generate() itself (a client-visible
                     # 400), never silently dropped.
                     kw["top_p"] = float(top_p)
+                if req.get("stream"):
+                    # Streamed greedy decode: NDJSON, one line per
+                    # segment, through the single reused segment
+                    # executable (generate_segments). Runs solo — a
+                    # stream is inherently per-connection, so it
+                    # bypasses the coalescer and the spec path.
+                    if kw:
+                        # An explicit contract, like top_p-without-
+                        # temperature above: silently returning buffered
+                        # JSON to an NDJSON reader would wedge it.
+                        raise ValueError(
+                            "stream supports greedy only (no "
+                            "temperature/top_p)"
+                        )
+                    from tf_operator_tpu.models.transformer import (
+                        generate_segments,
+                    )
+
+                    seg = max(1, args.stream_segment)
+                    n_seg = -(-num_steps // seg)
+                    if num_steps < 1:
+                        raise ValueError("num_steps must be >= 1")
+                    if prompt.shape[1] + n_seg * seg > cfg.max_seq_len:
+                        # Validate BEFORE headers: mid-stream errors can
+                        # only truncate the stream, not signal 400.
+                        raise ValueError(
+                            f"prompt + {n_seg} segments of {seg} "
+                            f"exceeds max_seq_len {cfg.max_seq_len}"
+                        )
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson")
+                    self.end_headers()
+                    try:
+                        gen = generate_segments(
+                            cfg, params, prompt, num_steps, segment=seg
+                        )
+                        while True:
+                            # The chip lock covers ONLY the device work
+                            # inside next(); the socket write happens
+                            # unlocked, so a slow/stalled client cannot
+                            # block other requests.
+                            with lock:
+                                try:
+                                    toks = next(gen)
+                                except StopIteration:
+                                    break
+                            line = json.dumps(
+                                {"tokens": toks.tolist()}) + "\n"
+                            self.wfile.write(line.encode())
+                            self.wfile.flush()
+                        with lock:
+                            served += 1
+                            if (args.requests is not None
+                                    and served >= args.requests):
+                                done.set()
+                    except Exception as exc:  # noqa: BLE001
+                        # Headers are out: a 400 is impossible. Close the
+                        # connection (the client sees a truncated stream)
+                        # and log server-side.
+                        print(f"serve_lm: stream aborted: {exc!r}",
+                              file=sys.stderr, flush=True)
+                    return
                 if coalescer is not None and not kw:
                     out = coalescer.submit(prompt, num_steps)
                 elif not kw:

@@ -452,6 +452,71 @@ def test_serve_lm_speculative_matches_plain():
     assert "speculative decoding on (k=3, draft layers=1)" in out
 
 
+def test_serve_lm_streams_segments():
+    """POST /generate with stream:true returns NDJSON lines — one per
+    decode segment — whose concatenation equals the non-streamed greedy
+    output for the same prompt."""
+    import json as _json
+    import subprocess
+    import urllib.request
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
+         "--port", str(port), "--train-steps", "40",
+         "--stream-segment", "4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        wait_server_ready(proc, port)
+        body = {"tokens": [[7, 8, 9, 10]], "num_steps": 10}
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            plain = _json.loads(resp.read())["tokens"][0]
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=_json.dumps(dict(body, stream=True)).encode(),
+            headers={"Content-Type": "application/json"})
+        chunks = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            for line in resp:
+                chunks.append(_json.loads(line)["tokens"][0])
+        # 10 steps at segment 4 → chunk lengths [4, 4, 2]
+        assert [len(c) for c in chunks] == [4, 4, 2], chunks
+        streamed = [t for c in chunks for t in c]
+        assert streamed == plain, (streamed, plain)
+
+        # pre-header validation errors are still a 400: over-budget
+        # num_steps, and stream combined with sampling (explicitly
+        # rejected rather than silently returning buffered JSON)
+        for bad in (dict(body, stream=True, num_steps=10_000),
+                    dict(body, stream=True, temperature=0.7)):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=_json.dumps(bad).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError(f"expected 400 for {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
 def test_serve_lm_drains_queued_requests_on_shutdown():
     """SIGTERM arriving while a coalesced request is parked in the batch
     window must not drop it: the batcher drains its queue after shutdown

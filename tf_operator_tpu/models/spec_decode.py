@@ -45,7 +45,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from tf_operator_tpu.models.transformer import TransformerConfig, Transformer
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    _prefill,
+)
 
 
 def set_cache_index(cache: Any, value) -> Any:
@@ -125,32 +129,16 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
     dmodel = Transformer(replace(
         draft_cfg, decode=True, mesh=None, remat=False))
 
-    def greedy_head(model_params, hidden):
-        head = model_params["lm_head"]
-        return (
-            hidden.astype(jnp.float32) @ head["kernel"] + head["bias"]
-        ).argmax(-1)
-
     def run(tparams, dparams, prompt):
         b = prompt.shape[0]
         tok_dtype = prompt.dtype
 
-        tcache = tmodel.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
-        dcache = dmodel.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+        # Prompt prefill, both models (the shared _prefill construction);
+        # only the target's logits matter.
+        tcache, tlogits = _prefill(tmodel, tparams, prompt)
+        dcache, _ = _prefill(dmodel, dparams, prompt)
 
-        # Prompt prefill, both models; only the target's logits matter.
-        thidden, tupd = tmodel.apply(
-            {"params": tparams, "cache": tcache}, prompt,
-            mutable=["cache"], return_hidden=True,
-        )
-        tcache = tupd["cache"]
-        _, dupd = dmodel.apply(
-            {"params": dparams, "cache": dcache}, prompt,
-            mutable=["cache"], return_hidden=True,
-        )
-        dcache = dupd["cache"]
-
-        pend = greedy_head(tparams, thidden[:, -1]).astype(tok_dtype)
+        pend = tlogits.argmax(-1).astype(tok_dtype)
 
         # Output buffer with k+1 slack: each round unconditionally writes
         # a k+1 window at position n (n < num_steps inside the loop, so

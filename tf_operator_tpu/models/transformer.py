@@ -624,32 +624,11 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float,
         return updates["cache"], logits[:, 0]
 
     def run(params, prompt, rng):
-        cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
         # Prompt PREFILL in ONE forward pass (block-causal attention over
         # the cache): a token-by-token prefill scan would pay the full
         # per-step weight read prompt_len times — at bench shapes that was
         # half the decode wall time for work a single batched pass does.
-        # return_hidden skips the f32 [B, P, vocab] logits over the whole
-        # prompt; only the LAST position feeds sampling, so the head runs
-        # on that one row.
-        hidden, updates = model.apply(
-            {"params": params, "cache": cache}, prompt, mutable=["cache"],
-            return_hidden=True,
-        )
-        cache = updates["cache"]
-        head = params["lm_head"]
-        if "kernel_q" in head:  # int8_decode tree (quantize_decode_params)
-            from tf_operator_tpu.ops.int8_dense import int8_apply
-
-            last_logits = int8_apply(
-                hidden[:, -1], head["kernel_q"], head["scale"],
-                out_dtype=jnp.float32,
-            ) + head["bias"]
-        else:
-            last_logits = (
-                hidden[:, -1].astype(jnp.float32) @ head["kernel"]
-                + head["bias"]
-            )
+        cache, last_logits = _prefill(model, params, prompt)
 
         def sample(carry, step_rng):
             cache, logits = carry
@@ -669,6 +648,155 @@ def _generate_fn(cfg: TransformerConfig, num_steps: int, temperature: float,
         return toks.swapaxes(0, 1)
 
     return jax.jit(run)
+
+
+def generate_segments(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    segment: int = 16,
+):
+    """Greedy generation in fixed-size SEGMENTS, as a generator yielding
+    each segment's [B, <=segment] tokens: one prefill executable per
+    prompt shape plus ONE segment executable reused for every segment of
+    every request length — where ``generate`` compiles a fresh loop per
+    ``num_steps``, this path serves any length from the same two
+    executables (the serving win), and consumers stream tokens as each
+    segment lands.
+
+    Decode/consume OVERLAP is real: segment i+1 is dispatched (async —
+    jax returns futures) BEFORE segment i is yielded, so the consumer's
+    readback and I/O run while the device decodes ahead. The device
+    work happens inside ``next()``; a server can therefore serialize
+    device access by holding its lock around next() only, never around
+    its socket writes.
+
+    Output is bit-identical to ``generate(..., temperature=0)``: both
+    run the same argmax-feed recurrence over the same decode cache; the
+    segmentation only changes where the scan boundaries fall. The last
+    partial segment still decodes ``segment`` tokens on device (static
+    shapes) and trims host-side, so the cache must budget the overshoot:
+    prompt + ceil(num_steps/segment)*segment <= cfg.max_seq_len.
+    """
+    if segment < 1:
+        raise ValueError(f"segment={segment} must be >= 1")
+    if num_steps < 1:
+        raise ValueError(f"num_steps={num_steps} must be >= 1")
+    n_segments = -(-num_steps // segment)
+    if prompt.shape[1] + n_segments * segment > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt.shape[1]} + {n_segments} segments of "
+            f"{segment} exceeds max_seq_len {cfg.max_seq_len} (the last "
+            "partial segment decodes a full segment on device)"
+        )
+
+    def trim(toks, i):
+        if (i + 1) * segment > num_steps:  # overshoot of the last segment
+            return toks[:, : num_steps - i * segment]
+        return toks
+
+    def gen():
+        prefill_fn, segment_fn = _segment_fns(cfg, int(segment))
+        cache, logits = prefill_fn(params, prompt)
+        cache, logits, pending = segment_fn(params, cache, logits)
+        for i in range(1, n_segments):
+            # dispatch ahead of the yield: the consumer reads segment
+            # i-1 while the device runs segment i
+            cache, logits, nxt = segment_fn(params, cache, logits)
+            yield trim(pending, i - 1)
+            pending = nxt
+        yield trim(pending, n_segments - 1)
+
+    return gen()
+
+
+def generate_segmented(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jax.Array,
+    num_steps: int,
+    *,
+    segment: int = 16,
+    on_segment=None,
+) -> jax.Array:
+    """Collected form of ``generate_segments``: returns the full
+    [B, num_steps] tokens, invoking ``on_segment(tokens)`` per segment
+    as it lands (see the generator for the streaming/locking and
+    exactness contracts)."""
+    chunks = []
+    for toks in generate_segments(
+        cfg, params, prompt, num_steps, segment=segment
+    ):
+        chunks.append(toks)
+        if on_segment is not None:
+            on_segment(toks)
+    return jnp.concatenate(chunks, axis=1)
+
+
+def _prefill(model: "Transformer", params: Any, prompt: jax.Array):
+    """Prompt prefill in ONE block-causal forward -> (cache, logits of
+    the last position). THE shared construction for every decode
+    entry point (_generate_fn, _segment_fns) — including the
+    int8_decode head dispatch — so their outputs cannot drift. Plain
+    traced code: call from inside any jitted context."""
+    cache = model.init(jax.random.PRNGKey(0), prompt[:, :1])["cache"]
+    # return_hidden skips the f32 [B, P, vocab] logits over the whole
+    # prompt; only the LAST position feeds sampling.
+    hidden, updates = model.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"],
+        return_hidden=True,
+    )
+    head = params["lm_head"]
+    if "kernel_q" in head:  # int8_decode tree (quantize_decode_params)
+        from tf_operator_tpu.ops.int8_dense import int8_apply
+
+        logits = int8_apply(
+            hidden[:, -1], head["kernel_q"], head["scale"],
+            out_dtype=jnp.float32,
+        ) + head["bias"]
+    else:
+        logits = (
+            hidden[:, -1].astype(jnp.float32) @ head["kernel"]
+            + head["bias"]
+        )
+    return updates["cache"], logits
+
+
+@functools.lru_cache(maxsize=16)
+def _segment_fns(cfg: TransformerConfig, segment: int):
+    """(prefill, decode_segment) jitted pair for one (config, segment).
+
+    decode_segment's shapes are independent of request length — cache is
+    the static [B, max_seq_len, ...] buffer, logits [B, vocab] — so its
+    executable is compiled once per (batch, config) and reused for every
+    segment of every request. The cache argument is donated: segments
+    update it in place instead of doubling decode memory."""
+    from dataclasses import replace
+
+    dcfg = replace(cfg, decode=True, mesh=None, remat=False)
+    model = Transformer(dcfg)
+
+    def decode_segment(params, cache, logits):
+        def sample(carry, _):
+            cache, logits = carry
+            tok = logits.argmax(-1)
+            nxt, upd = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None].astype(jnp.int32), mutable=["cache"],
+            )
+            return (upd["cache"], nxt[:, 0]), tok
+
+        (cache, logits), toks = jax.lax.scan(
+            sample, (cache, logits), None, length=segment
+        )
+        return cache, logits, toks.swapaxes(0, 1)
+
+    return (
+        jax.jit(functools.partial(_prefill, model)),
+        jax.jit(decode_segment, donate_argnums=(1,)),
+    )
 
 
 def quantize_decode_params(params: Any) -> Any:
