@@ -495,25 +495,20 @@ def main(argv: list[str] | None = None) -> int:
                         generate_segments,
                     )
 
-                    seg = max(1, args.stream_segment)
-                    n_seg = -(-num_steps // seg)
-                    if num_steps < 1:
-                        raise ValueError("num_steps must be >= 1")
-                    if prompt.shape[1] + n_seg * seg > cfg.max_seq_len:
-                        # Validate BEFORE headers: mid-stream errors can
-                        # only truncate the stream, not signal 400.
-                        raise ValueError(
-                            f"prompt + {n_seg} segments of {seg} "
-                            f"exceeds max_seq_len {cfg.max_seq_len}"
-                        )
+                    # generate_segments validates segment/num_steps/cache
+                    # budget EAGERLY (before any device work), so
+                    # constructing it here — before headers — turns every
+                    # validation error into a real 400 with one source of
+                    # truth for the budget formula.
+                    gen = generate_segments(
+                        cfg, params, prompt, num_steps,
+                        segment=max(1, args.stream_segment),
+                    )
                     self.send_response(200)
                     self.send_header(
                         "Content-Type", "application/x-ndjson")
                     self.end_headers()
                     try:
-                        gen = generate_segments(
-                            cfg, params, prompt, num_steps, segment=seg
-                        )
                         while True:
                             # The chip lock covers ONLY the device work
                             # inside next(); the socket write happens

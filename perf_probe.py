@@ -777,6 +777,24 @@ def probe_specdecode() -> None:
 
     leg("plain", plain)
 
+    def segmented():
+        # The streaming path (transformer.generate_segments): n_segments
+        # host round-trips instead of one fused call — through a
+        # dispatch-taxed tunnel this leg prices the streaming tax that
+        # serve_lm's stream:true pays vs the one-shot decode above.
+        # The segment is the largest power-of-two <= 16 DIVIDING steps:
+        # zero last-segment overshoot, so the leg fits the cfg's k+1
+        # margin at every DECODE_STEPS (a non-divisor segment overshoots
+        # by up to segment-1 > k).
+        from tf_operator_tpu.models.transformer import generate_segmented
+
+        seg = next(s for s in (16, 8, 4, 2, 1) if steps % s == 0)
+        int(generate_segmented(
+            cfg, tparams, prompt, steps, segment=seg
+        )[0, -1])
+
+    leg("segmented", segmented)
+
     def spec(name, dcfg, dp):
         holder = {}
 

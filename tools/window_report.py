@@ -280,6 +280,10 @@ def main() -> int:
         print("| leg | gen tok/s | vs plain | tokens/round |")
         print("|---|---|---|---|")
         print(f"| plain greedy | {fmt(plain_tps)} | 1.00x | 1 |")
+        seg_tps = spec.get("tokens_per_sec_segmented")
+        if seg_tps:
+            print(f"| segmented (streaming path) | {fmt(seg_tps)} "
+                  f"| {fmt(seg_tps / plain_tps, 2)}x | — |")
         for leg, tpr in (("spec_self", "tokens_per_round_self"),
                          ("spec_cold", "tokens_per_round_cold")):
             tps = spec.get(f"tokens_per_sec_{leg}")
