@@ -137,6 +137,12 @@ def main(argv: list[str] | None = None) -> int:
                         "are decoded in N-token segments through ONE "
                         "reused executable and written to the client as "
                         "NDJSON lines as each segment completes")
+    p.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                   help="run streamed requests' prompt prefill in "
+                        "fixed N-token chunks through one reused "
+                        "executable (prefill_chunked): any prompt "
+                        "length compiles nothing new. 0 = one-shot "
+                        "prefill (compiles per prompt shape)")
     p.add_argument("--batch-window", type=float, default=0.0, metavar="MS",
                    help="coalesce concurrent greedy /generate requests of "
                         "the same shape for this many ms and run them as "
@@ -503,6 +509,7 @@ def main(argv: list[str] | None = None) -> int:
                     gen = generate_segments(
                         cfg, params, prompt, num_steps,
                         segment=max(1, args.stream_segment),
+                        prefill_chunk=(args.prefill_chunk or None),
                     )
                     self.send_response(200)
                     self.send_header(

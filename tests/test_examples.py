@@ -470,7 +470,7 @@ def test_serve_lm_streams_segments():
     proc = subprocess.Popen(
         [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
          "--port", str(port), "--train-steps", "40",
-         "--stream-segment", "4"],
+         "--stream-segment", "4", "--prefill-chunk", "3"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     try:

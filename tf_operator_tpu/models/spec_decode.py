@@ -49,33 +49,10 @@ from tf_operator_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
     _prefill,
+    set_cache_index,
 )
 
-
-def set_cache_index(cache: Any, value) -> Any:
-    """Return ``cache`` with every position counter set to ``value`` (an
-    int32 scalar or tracer): the per-layer ``cache_index`` AND the
-    top-level ``pos_index`` that drives positional embeddings — the two
-    MUST roll back in lockstep, or re-fed tokens keep advancing position
-    embeddings while overwriting earlier cache slots (K/V written with
-    the wrong position — the exactness bug the first cut of this module
-    had). K/V buffers are untouched: decode attention masks positions
-    >= index, so rewriting the counters IS the rollback."""
-    from collections.abc import Mapping
-
-    def walk(node):
-        if isinstance(node, Mapping):
-            # rebuilt as plain dicts — model.apply accepts them, and it
-            # normalizes away FrozenDict vs dict across flax versions.
-            return {
-                k: (jnp.asarray(value, jnp.int32)
-                    if k in ("cache_index", "pos_index")
-                    else walk(v))
-                for k, v in node.items()
-            }
-        return node
-
-    return walk(cache)
+__all__ = ["set_cache_index", "speculative_generate"]
 
 
 def speculative_generate(

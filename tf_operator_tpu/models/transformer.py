@@ -657,6 +657,7 @@ def generate_segments(
     num_steps: int,
     *,
     segment: int = 16,
+    prefill_chunk: int | None = None,
 ):
     """Greedy generation in fixed-size SEGMENTS, as a generator yielding
     each segment's [B, <=segment] tokens: one prefill executable per
@@ -664,7 +665,10 @@ def generate_segments(
     every request length — where ``generate`` compiles a fresh loop per
     ``num_steps``, this path serves any length from the same two
     executables (the serving win), and consumers stream tokens as each
-    segment lands.
+    segment lands. ``prefill_chunk`` additionally runs the prefill
+    through fixed-size chunks (prefill_chunked), removing the
+    per-prompt-shape compile too — the full serving-compile trifecta:
+    any (prompt_len, num_steps) pair runs on three fixed executables.
 
     Decode/consume OVERLAP is real: segment i+1 is dispatched (async —
     jax returns futures) BEFORE segment i is yielded, so the consumer's
@@ -691,6 +695,12 @@ def generate_segments(
             f"{segment} exceeds max_seq_len {cfg.max_seq_len} (the last "
             "partial segment decodes a full segment on device)"
         )
+    if prefill_chunk is not None:
+        # prefill_chunked re-validates, but ITS checks would fire inside
+        # the lazy gen() body — after a streaming server has committed
+        # its 200/NDJSON headers. Eager here keeps the documented
+        # every-validation-error-is-a-400 contract.
+        _validate_prefill_chunk(cfg, prompt.shape[1], prefill_chunk)
 
     def trim(toks, i):
         if (i + 1) * segment > num_steps:  # overshoot of the last segment
@@ -699,7 +709,12 @@ def generate_segments(
 
     def gen():
         prefill_fn, segment_fn = _segment_fns(cfg, int(segment))
-        cache, logits = prefill_fn(params, prompt)
+        if prefill_chunk is not None:
+            cache, logits = prefill_chunked(
+                cfg, params, prompt, chunk=prefill_chunk
+            )
+        else:
+            cache, logits = prefill_fn(params, prompt)
         cache, logits, pending = segment_fn(params, cache, logits)
         for i in range(1, n_segments):
             # dispatch ahead of the yield: the consumer reads segment
@@ -719,6 +734,7 @@ def generate_segmented(
     num_steps: int,
     *,
     segment: int = 16,
+    prefill_chunk: int | None = None,
     on_segment=None,
 ) -> jax.Array:
     """Collected form of ``generate_segments``: returns the full
@@ -727,12 +743,56 @@ def generate_segmented(
     exactness contracts)."""
     chunks = []
     for toks in generate_segments(
-        cfg, params, prompt, num_steps, segment=segment
+        cfg, params, prompt, num_steps, segment=segment,
+        prefill_chunk=prefill_chunk,
     ):
         chunks.append(toks)
         if on_segment is not None:
             on_segment(toks)
     return jnp.concatenate(chunks, axis=1)
+
+
+def set_cache_index(cache: Any, value) -> Any:
+    """Return ``cache`` with every position counter set to ``value`` (an
+    int32 scalar or tracer): the per-layer ``cache_index`` AND the
+    top-level ``pos_index`` that drives positional embeddings — the two
+    MUST move in lockstep, or re-fed tokens keep advancing position
+    embeddings while overwriting earlier cache slots (K/V written with
+    the wrong position). K/V buffers are untouched: decode attention
+    masks positions >= index, so rewriting the counters IS the
+    rollback. Used by speculative decoding (undo rejected proposals)
+    and chunked prefill (discard right-padding)."""
+    from collections.abc import Mapping
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            # rebuilt as plain dicts — model.apply accepts them, and it
+            # normalizes away FrozenDict vs dict across flax versions.
+            return {
+                k: (jnp.asarray(value, jnp.int32)
+                    if k in ("cache_index", "pos_index")
+                    else walk(v))
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(cache)
+
+
+def _head_logits(params: Any, h: jax.Array) -> jax.Array:
+    """lm_head projection of one hidden row [B, d] -> f32 [B, vocab],
+    dispatching on the param-tree layout (plain dense vs the int8 tree
+    quantize_decode_params writes). THE single head dispatch for every
+    decode entry point so quantization/layout changes cannot diverge
+    them. Plain traced code."""
+    head = params["lm_head"]
+    if "kernel_q" in head:  # int8_decode tree (quantize_decode_params)
+        from tf_operator_tpu.ops.int8_dense import int8_apply
+
+        return int8_apply(
+            h, head["kernel_q"], head["scale"], out_dtype=jnp.float32,
+        ) + head["bias"]
+    return h.astype(jnp.float32) @ head["kernel"] + head["bias"]
 
 
 def _prefill(model: "Transformer", params: Any, prompt: jax.Array):
@@ -748,20 +808,104 @@ def _prefill(model: "Transformer", params: Any, prompt: jax.Array):
         {"params": params, "cache": cache}, prompt, mutable=["cache"],
         return_hidden=True,
     )
-    head = params["lm_head"]
-    if "kernel_q" in head:  # int8_decode tree (quantize_decode_params)
-        from tf_operator_tpu.ops.int8_dense import int8_apply
+    return updates["cache"], _head_logits(params, hidden[:, -1])
 
-        logits = int8_apply(
-            hidden[:, -1], head["kernel_q"], head["scale"],
-            out_dtype=jnp.float32,
-        ) + head["bias"]
-    else:
-        logits = (
-            hidden[:, -1].astype(jnp.float32) @ head["kernel"]
-            + head["bias"]
+
+def prefill_chunked(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jax.Array,
+    chunk: int = 64,
+):
+    """Prompt prefill through ONE fixed-[B, chunk] executable: (cache,
+    last-position logits) for ANY prompt length — where ``_prefill``
+    compiles per prompt shape, a server using this path compiles one
+    prefill chunk once and serves every prompt length with
+    ceil(P/chunk) calls of it.
+
+    The last partial chunk is RIGHT-PADDED to the fixed shape: pad
+    positions sit after every true position, so no true position ever
+    attends one (causal); their K/V land in cache rows beyond the true
+    length, which set_cache_index then masks out (decode writes
+    overwrite them one by one). The cache must budget the padding:
+    ceil(P/chunk)*chunk <= cfg.max_seq_len. Logits come from the true
+    last position's row of the final chunk. Numerics are the same
+    block-causal attention the one-shot prefill runs, so downstream
+    greedy decode is unchanged (pinned vs generate in
+    tests/test_prefill_chunked.py).
+    """
+    p = prompt.shape[1]
+    _validate_prefill_chunk(cfg, p, chunk)
+    n_chunks = -(-p // chunk)
+    padded = n_chunks * chunk
+    init_fn, chunk_fn, head_fn = _prefill_chunk_fns(cfg, int(chunk))
+    if padded > p:
+        prompt = jnp.concatenate(
+            [prompt, jnp.zeros((prompt.shape[0], padded - p),
+                               prompt.dtype)], axis=1,
         )
-    return updates["cache"], logits
+    cache = init_fn(params, prompt[:, :1])
+    hidden = None
+    for i in range(n_chunks):
+        cache, hidden = chunk_fn(
+            params, cache, prompt[:, i * chunk:(i + 1) * chunk]
+        )
+    # True last position sits in the final chunk at row p-1 - (padded-chunk).
+    logits = head_fn(params, hidden, p - 1 - (padded - chunk))
+    if padded > p:
+        cache = set_cache_index(cache, p)
+    return cache, logits
+
+
+def _validate_prefill_chunk(cfg: TransformerConfig, p: int, chunk: int):
+    """Shared eager validation for chunked prefill (generate_segments
+    runs it before returning its generator; prefill_chunked before any
+    device work): no device call may have happened when these raise."""
+    if chunk < 1:
+        raise ValueError(f"chunk={chunk} must be >= 1")
+    if p < 1:
+        raise ValueError("prompt must have at least one token")
+    padded = -(-p // chunk) * chunk
+    if padded > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {p} right-padded to {padded} exceeds max_seq_len "
+            f"{cfg.max_seq_len} (the last partial chunk feeds a full "
+            "chunk of cache rows before rollback)"
+        )
+
+
+@functools.lru_cache(maxsize=16)
+def _prefill_chunk_fns(cfg: TransformerConfig, chunk: int):
+    """(init, chunk_step, head) jitted trio for chunked prefill: init
+    builds the empty cache, chunk_step feeds one fixed-[B, chunk] block
+    (cache donated), head projects one hidden row to logits (row index
+    a jit argument, so one executable serves every remainder)."""
+    from dataclasses import replace
+
+    dcfg = replace(cfg, decode=True, mesh=None, remat=False)
+    model = Transformer(dcfg)
+
+    def init(params, tok0):
+        del params
+        return model.init(jax.random.PRNGKey(0), tok0)["cache"]
+
+    def chunk_step(params, cache, block):
+        hidden, updates = model.apply(
+            {"params": params, "cache": cache}, block, mutable=["cache"],
+            return_hidden=True,
+        )
+        return updates["cache"], hidden
+
+    def head(params, hidden, row):
+        h = jax.lax.dynamic_index_in_dim(hidden, row, axis=1,
+                                         keepdims=False)
+        return _head_logits(params, h)
+
+    return (
+        jax.jit(init),
+        jax.jit(chunk_step, donate_argnums=(1,)),
+        jax.jit(head),
+    )
 
 
 @functools.lru_cache(maxsize=16)
