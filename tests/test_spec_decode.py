@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from tf_operator_tpu.models.spec_decode import (
+    residual_distribution,
     set_cache_index,
     speculative_generate,
 )
@@ -122,6 +123,104 @@ def test_budget_and_config_validation(params):
         speculative_generate(
             TARGET, params["target"], DRAFT, params["draft"],
             prompt_batch(1), 4, k=0,
+        )
+
+
+def test_residual_identity_recovers_target_distribution():
+    """The correctness core of sampled speculative decoding, pinned
+    against the exact module code: for ANY p, q the accept/residual
+    scheme's emitted-token law q(t)·min(1,p(t)/q(t)) + (1-a)·r(t)
+    equals p(t)."""
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        v = 16
+        p = rng.dirichlet(np.full(v, 0.4)).astype(np.float32)
+        q = rng.dirichlet(np.full(v, 0.4)).astype(np.float32)
+        r = np.asarray(residual_distribution(
+            jnp.asarray(p), jnp.asarray(q)))
+        accept_t = q * np.minimum(1.0, p / q)
+        emitted = accept_t + (1.0 - accept_t.sum()) * r
+        np.testing.assert_allclose(emitted, p, atol=2e-6)
+    # degenerate p == q: accept prob 1, residual falls back to p and
+    # stays a valid distribution
+    r = np.asarray(residual_distribution(jnp.asarray(p), jnp.asarray(p)))
+    np.testing.assert_allclose(r, p, atol=1e-6)
+
+
+def test_sampled_conditional_distribution_matches_target():
+    """Empirical pin of the full sampled machinery: num_steps=2, k=1,
+    4096 independent rows → position-2 tokens grouped by the position-1
+    token must follow the TARGET's tempered softmax for that prefix
+    (computed analytically by teacher forcing), not the draft's."""
+    V, T = 16, 1.0
+    tcfg = small_cfg(vocab_size=V)
+    dcfg = small_cfg(vocab_size=V, n_layers=1, d_model=16, n_heads=1,
+                     d_ff=32)
+    tp = init_params(tcfg, 21)
+    dp = init_params(dcfg, 22)
+    b = 4096
+    prompt = jnp.tile(jnp.asarray([[3, 9, 1]], jnp.int32), (b, 1))
+
+    toks, _ = speculative_generate(
+        tcfg, tp, dcfg, dp, prompt, 2, k=1, temperature=T,
+        rng=jax.random.PRNGKey(7),
+    )
+    toks = np.asarray(toks)
+
+    # Analytic conditionals: target logits after prefix+[t0], all t0 at
+    # once (teacher forcing, training forward).
+    model = Transformer(tcfg)
+    seqs = jnp.concatenate(
+        [jnp.tile(prompt[:1], (V, 1)),
+         jnp.arange(V, dtype=jnp.int32)[:, None]], axis=1,
+    )
+    tgt_logits = model.apply({"params": tp}, seqs)[:, -1]  # [V, V]
+    p_cond = np.asarray(jax.nn.softmax(tgt_logits / T))
+    d_model2 = Transformer(dcfg)
+    q_cond = np.asarray(jax.nn.softmax(
+        d_model2.apply({"params": dp}, seqs)[:, -1] / T))
+
+    checked = 0
+    for t0 in range(V):
+        rows = toks[toks[:, 0] == t0]
+        if len(rows) < 250:
+            continue
+        emp = np.bincount(rows[:, 1], minlength=V) / len(rows)
+        l1_target = np.abs(emp - p_cond[t0]).sum()
+        l1_draft = np.abs(emp - q_cond[t0]).sum()
+        gap = np.abs(p_cond[t0] - q_cond[t0]).sum()
+        assert l1_target < 0.3, (t0, l1_target, len(rows))
+        if gap > 0.5:  # diagnostic buckets: p and q clearly differ
+            assert l1_target < l1_draft, (t0, l1_target, l1_draft)
+            checked += 1
+    assert checked >= 2, "too few diagnostic prefix buckets"
+
+
+def test_sampled_deterministic_per_key_and_validates(params):
+    prompt = prompt_batch(2)
+    a, _ = speculative_generate(
+        TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+        k=2, temperature=0.8, rng=jax.random.PRNGKey(3),
+    )
+    b, _ = speculative_generate(
+        TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+        k=2, temperature=0.8, rng=jax.random.PRNGKey(3),
+    )
+    c, _ = speculative_generate(
+        TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+        k=2, temperature=0.8, rng=jax.random.PRNGKey(4),
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    with pytest.raises(ValueError, match="rng"):
+        speculative_generate(
+            TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+            k=2, temperature=0.5,
+        )
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_generate(
+            TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+            k=2, temperature=-1.0, rng=jax.random.PRNGKey(0),
         )
 
 

@@ -1,14 +1,19 @@
-"""Speculative decoding: exact greedy acceleration with a draft model.
+"""Speculative decoding: exact acceleration with a draft model.
 
 A small DRAFT model proposes k tokens autoregressively; the TARGET model
 scores all k in ONE chunked forward against its KV cache (the same
-block-causal multi-token path prompt prefill uses) and accepts the
-longest prefix that matches its own greedy choices, then contributes one
-more token itself (the correction at the first mismatch, or the bonus
-token when everything matched). Greedy speculative decoding is EXACT:
-every emitted token is the target model's argmax given the emitted
-prefix, so the output is bit-identical to ``generate(target_cfg, ...)``
-with ``temperature=0`` — pinned by tests/test_spec_decode.py.
+block-causal multi-token path prompt prefill uses), accepts a prefix,
+and contributes one more token itself. Both decoding modes preserve the
+target's output exactly — pinned by tests/test_spec_decode.py:
+
+- GREEDY (temperature=0): accept while the proposal matches the
+  target's argmax; the output is bit-identical to
+  ``generate(target_cfg, ...)`` at temperature 0.
+- SAMPLED (temperature>0): accept d ~ q with probability
+  min(1, p(d)/q(d)), resample rejections from the residual
+  max(p-q, 0)/Z (``residual_distribution``) — the emitted-token law at
+  every position is exactly the target's tempered softmax, for ANY
+  draft.
 
 Why this is the TPU-shaped decode accelerator: single-token decode is
 weight-read-bound (docs/perf.md — the per-step HBM read of the full
@@ -52,7 +57,11 @@ from tf_operator_tpu.models.transformer import (
     set_cache_index,
 )
 
-__all__ = ["set_cache_index", "speculative_generate"]
+__all__ = [
+    "residual_distribution",
+    "set_cache_index",
+    "speculative_generate",
+]
 
 
 def speculative_generate(
@@ -64,15 +73,31 @@ def speculative_generate(
     num_steps: int,
     *,
     k: int = 4,
+    temperature: float = 0.0,
+    rng: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
-    """Greedy speculative decode: ([B, num_steps] tokens, rounds used).
+    """Speculative decode: ([B, num_steps] tokens, rounds used).
 
-    Exact equivalent of ``generate(target_cfg, target_params, prompt,
-    num_steps)`` at temperature 0, for ANY draft model (a bad draft only
-    costs speed, never correctness). ``k`` = draft proposals per round;
-    each round emits between 1 and k+1 tokens (batch-min acceptance + 1).
-    ``rounds`` is the number of verify forwards the loop ran — the
-    acceptance telemetry: tokens/round = num_steps/rounds.
+    ``temperature=0`` (default) is GREEDY: bit-exact equivalent of
+    ``generate(target_cfg, target_params, prompt, num_steps)``, for ANY
+    draft model (a bad draft only costs speed, never correctness).
+
+    ``temperature > 0`` is SAMPLED speculative decoding with the
+    distribution-preserving accept/residual scheme: each proposal
+    d ~ q is accepted with probability min(1, p(d)/q(d)); on rejection
+    the token is resampled from the residual max(p - q, 0)/Z. The
+    emitted-token distribution at every position is EXACTLY the
+    target's tempered softmax p — the algebraic identity
+    q(t)·min(1, p(t)/q(t)) + (1 - Σ_s q(s)·min(1, p(s)/q(s)))·r(t) =
+    p(t) — regardless of the draft (pinned analytically and empirically
+    in tests/test_spec_decode.py). Rows accept different prefix
+    lengths; the round advances by the batch-min, and at the cut each
+    row emits ITS OWN accept-or-residual outcome, which is a correct
+    per-row sample either way. ``rng`` is required when sampling.
+
+    ``k`` = draft proposals per round; each round emits between 1 and
+    k+1 tokens. ``rounds`` is the number of verify forwards the loop
+    ran — the acceptance telemetry: tokens/round = num_steps/rounds.
     """
     if prompt.shape[1] + num_steps + k + 1 > target_cfg.max_seq_len:
         raise ValueError(
@@ -92,21 +117,33 @@ def speculative_generate(
                 "decoding (the int8 head tree has no shared greedy-head "
                 "path here); quantize after choosing a decode strategy"
             )
-    fn = _spec_fn(target_cfg, draft_cfg, num_steps, int(k))
-    return fn(target_params, draft_params, prompt)
+    if temperature < 0:
+        raise ValueError(f"temperature={temperature} must be >= 0")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    fn = _spec_fn(target_cfg, draft_cfg, num_steps, int(k),
+                  float(temperature))
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # greedy: carried but never consumed
+    return fn(target_params, draft_params, prompt, rng)
 
 
 @functools.lru_cache(maxsize=16)
 def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
-             num_steps: int, k: int):
+             num_steps: int, k: int, temperature: float = 0.0):
     from dataclasses import replace
 
     tmodel = Transformer(replace(
         target_cfg, decode=True, mesh=None, remat=False))
     dmodel = Transformer(replace(
         draft_cfg, decode=True, mesh=None, remat=False))
+    # One round skeleton for both modes; `sampled` picks the sampling/
+    # accept/emission rules at TRACE time, so the greedy executable is
+    # unchanged by the branches (rng rides the carry either way but the
+    # greedy trace never consumes it).
+    sampled = temperature > 0
 
-    def run(tparams, dparams, prompt):
+    def run(tparams, dparams, prompt, rng):
         b = prompt.shape[0]
         tok_dtype = prompt.dtype
 
@@ -115,7 +152,13 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
         tcache, tlogits = _prefill(tmodel, tparams, prompt)
         dcache, _ = _prefill(dmodel, dparams, prompt)
 
-        pend = tlogits.argmax(-1).astype(tok_dtype)
+        if sampled:
+            rng, k0 = jax.random.split(rng)
+            pend = jax.random.categorical(
+                k0, tlogits / temperature
+            ).astype(tok_dtype)
+        else:
+            pend = tlogits.argmax(-1).astype(tok_dtype)
 
         # Output buffer with k+1 slack: each round unconditionally writes
         # a k+1 window at position n (n < num_steps inside the loop, so
@@ -124,25 +167,33 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
         out0 = jnp.zeros((b, num_steps + k + 1), tok_dtype)
         out0 = out0.at[:, 0].set(pend)
 
-        def draft_step(carry, _):
+        def draft_step(carry, step_key):
             dcache, tok = carry
             logits, upd = dmodel.apply(
                 {"params": dparams, "cache": dcache}, tok[:, None],
                 mutable=["cache"],
             )
-            nxt = logits[:, 0].argmax(-1).astype(tok_dtype)
-            return (upd["cache"], nxt), nxt
+            logits = logits[:, 0]
+            if sampled:
+                nxt = jax.random.categorical(
+                    step_key, logits / temperature
+                ).astype(tok_dtype)
+                return (upd["cache"], nxt), (nxt, logits)
+            nxt = logits.argmax(-1).astype(tok_dtype)
+            return (upd["cache"], nxt), (nxt, ())
 
         def round_body(state):
-            tcache, dcache, out, n, pend, rounds = state
+            tcache, dcache, out, n, pend, rounds, rng = state
             t_idx = _cache_index(tcache)
             d_idx = _cache_index(dcache)
+            rng, k_draft, k_acc, k_res, k_bonus = jax.random.split(rng, 5)
 
-            # Draft k+1 greedy steps from the pending token. Proposals
-            # are the first k outputs; the last is drafted only so the
-            # draft cache contains d_k when everything gets accepted.
-            (dcache, _), drafted = jax.lax.scan(
-                draft_step, (dcache, pend), None, length=k + 1
+            # Draft k+1 steps from the pending token. Proposals are the
+            # first k outputs; the last is drafted only so the draft
+            # cache contains d_k when everything gets accepted.
+            (dcache, _), (drafted, qlogits) = jax.lax.scan(
+                draft_step, (dcache, pend),
+                jax.random.split(k_draft, k + 1),
             )
             drafted = drafted.swapaxes(0, 1)  # [B, k+1]
             proposals = drafted[:, :k]
@@ -156,19 +207,55 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
                 mutable=["cache"],
             )
             tcache = tupd["cache"]
-            targmax = tlogits.argmax(-1).astype(tok_dtype)  # [B, k+1]
+
+            if sampled:
+                # Accept tests at positions 1..k: u < p(d)/q(d), in log
+                # space (ratio >= 1 always accepts; log u < 0 surely).
+                qlogits = qlogits.swapaxes(0, 1)  # [B, k+1, V]
+                logp = jax.nn.log_softmax(tlogits[:, :k] / temperature)
+                logq = jax.nn.log_softmax(qlogits[:, :k] / temperature)
+                sel = proposals[..., None]
+                lp = jnp.take_along_axis(logp, sel, axis=-1)[..., 0]
+                lq = jnp.take_along_axis(logq, sel, axis=-1)[..., 0]
+                log_u = jnp.log(jax.random.uniform(
+                    k_acc, (b, k), minval=1e-38, maxval=1.0
+                ))
+                accept = log_u < jnp.minimum(lp - lq, 0.0)  # [B, k]
+            else:
+                targmax = tlogits.argmax(-1).astype(tok_dtype)  # [B, k+1]
+                accept = proposals == targmax[:, :k]
 
             # Per-row accepted prefix length, then the batch-min cut.
-            match = proposals == targmax[:, :k]  # [B, k]
-            m_row = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1), 1)
+            m_row = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), 1), 1)
             m = jnp.min(m_row)  # scalar: tokens accepted this round
 
-            # Emit d_1..d_m then each row's own argmax at position m
-            # (correction at a mismatch; equal to the row's d_{m+1} when
-            # the row accepted further — exactness per row).
-            nxt_pend = jnp.take_along_axis(
-                targmax, jnp.full((b, 1), m), axis=1
-            )[:, 0]
+            # Emit d_1..d_m, then each row's OWN outcome at the cut.
+            if sampled:
+                # Accepted rows their d_{m+1}; rejected rows a residual
+                # resample; every-row-accepted-k gets the bonus token.
+                p_all = jnp.exp(logp)
+                q_all = jnp.exp(logq)
+                resample = jax.random.categorical(
+                    k_res,
+                    jnp.log(residual_distribution(p_all, q_all) + 1e-38),
+                ).astype(tok_dtype)                 # [B, k]
+                bonus = jax.random.categorical(
+                    k_bonus, tlogits[:, k] / temperature
+                ).astype(tok_dtype)                 # [B]
+                col = jnp.minimum(m, k - 1)
+                at_m = jnp.take_along_axis(
+                    jnp.where(accept, proposals, resample),
+                    jnp.full((b, 1), col), axis=1,
+                )[:, 0]
+                nxt_pend = jnp.where(m == k, bonus, at_m)
+            else:
+                # The row's argmax at position m: correction at a
+                # mismatch, equal to the row's d_{m+1} when it accepted
+                # further — exactness per row.
+                nxt_pend = jnp.take_along_axis(
+                    targmax, jnp.full((b, 1), m), axis=1
+                )[:, 0]
+
             cand = jnp.where(
                 jnp.arange(k + 1)[None, :] < m, drafted, nxt_pend[:, None]
             )
@@ -177,17 +264,32 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
             # Rollback: true fed prefix grew by pend + accepted proposals.
             tcache = set_cache_index(tcache, t_idx + 1 + m)
             dcache = set_cache_index(dcache, d_idx + 1 + m)
-            return (tcache, dcache, out, n + 1 + m, nxt_pend, rounds + 1)
+            return (tcache, dcache, out, n + 1 + m, nxt_pend,
+                    rounds + 1, rng)
 
         def cond(state):
             return state[3] < num_steps
 
         state = (tcache, dcache, out0, jnp.asarray(1, jnp.int32), pend,
-                 jnp.asarray(0, jnp.int32))
-        _, _, out, _, _, rounds = jax.lax.while_loop(cond, round_body, state)
+                 jnp.asarray(0, jnp.int32), rng)
+        _, _, out, _, _, rounds, _ = jax.lax.while_loop(
+            cond, round_body, state
+        )
         return out[:, :num_steps], rounds
 
     return jax.jit(run)
+
+
+def residual_distribution(p: jax.Array, q: jax.Array) -> jax.Array:
+    """The rejection-resample distribution r = max(p - q, 0)/Z over the
+    last axis, with a p fallback where Z == 0 (possible only when the
+    accept probability was exactly 1, so the fallback never actually
+    fires — it just keeps the categorical well-defined). Module-level so
+    the test suite can pin the algebraic identity
+    q·min(1,p/q) + (1-a)·r = p against the exact code the decoder runs."""
+    r = jnp.maximum(p - q, 0.0)
+    z = jnp.sum(r, axis=-1, keepdims=True)
+    return jnp.where(z > 0, r / jnp.where(z > 0, z, 1.0), p)
 
 
 def _cache_index(cache: Any) -> jax.Array:
