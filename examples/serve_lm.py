@@ -272,29 +272,38 @@ def main(argv: list[str] | None = None) -> int:
 
     spec_stats = {"decodes": 0, "rounds": 0, "tokens": 0}
 
-    def decode_greedy(rows, num_steps: int):
-        """The one greedy decode path (direct AND coalesced): plain
-        generate, or speculative when enabled and the speculation margin
-        fits the cache (falls back to plain otherwise — same output
-        either way, that is the whole point). spec_stats (surfaced via
-        /healthz) proves the speculative path actually ran — callers
-        hold `lock`, which also covers the counter updates."""
-        if (args.spec_k
+    def decode_spec(rows, num_steps: int, temperature: float = 0.0,
+                    sample_rng=None):
+        """THE speculative decode path for greedy (direct AND coalesced)
+        and sampled requests: speculative_generate when --spec-k is set
+        and the speculation margin fits the cache, else None (caller
+        falls back to plain generate — identical output distribution
+        either way, that is the whole point). The budget formula,
+        speculative call, and spec_stats (/healthz telemetry proving
+        the path actually ran) live HERE only; callers hold `lock`,
+        which also covers the counter updates."""
+        if not (args.spec_k
                 and rows.shape[1] + num_steps + args.spec_k + 1
                 <= cfg.max_seq_len):
-            from tf_operator_tpu.models.spec_decode import (
-                speculative_generate,
-            )
+            return None
+        from tf_operator_tpu.models.spec_decode import (
+            speculative_generate,
+        )
 
-            out, rounds = speculative_generate(
-                cfg, params, draft_cfg, draft_params, rows, num_steps,
-                k=args.spec_k,
-            )
-            spec_stats["decodes"] += 1
-            spec_stats["rounds"] += int(rounds)
-            spec_stats["tokens"] += num_steps
-            return out
-        return generate(cfg, params, rows, num_steps=num_steps)
+        out, rounds = speculative_generate(
+            cfg, params, draft_cfg, draft_params, rows, num_steps,
+            k=args.spec_k, temperature=temperature, rng=sample_rng,
+        )
+        spec_stats["decodes"] += 1
+        spec_stats["rounds"] += int(rounds)
+        spec_stats["tokens"] += num_steps
+        return out
+
+    def decode_greedy(rows, num_steps: int):
+        out = decode_spec(rows, num_steps)
+        if out is None:
+            out = generate(cfg, params, rows, num_steps=num_steps)
+        return out
 
     served = 0
     done = threading.Event()
@@ -548,10 +557,23 @@ def main(argv: list[str] | None = None) -> int:
                     with lock:
                         out = decode_greedy(prompt, num_steps)
                 else:
+                    # Sampled requests also try the distribution-
+                    # preserving speculative path (same emitted-token
+                    # law as plain sampling); top_p has no residual
+                    # analog, so it always takes plain generate.
                     with lock:
-                        out = generate(
-                            cfg, params, prompt, num_steps=num_steps, **kw
-                        )
+                        out = None
+                        if "top_p" not in kw:
+                            out = decode_spec(
+                                prompt, num_steps,
+                                temperature=kw["temperature"],
+                                sample_rng=kw["rng"],
+                            )
+                        if out is None:
+                            out = generate(
+                                cfg, params, prompt,
+                                num_steps=num_steps, **kw
+                            )
                 self._json(200, {"tokens": out.tolist()})
             except Exception as exc:  # noqa: BLE001 — client-visible error
                 self._json(400, {"error": repr(exc)})

@@ -443,6 +443,29 @@ def test_serve_lm_speculative_matches_plain():
             f"http://127.0.0.1:{spec_port}/healthz", timeout=5).read())
         assert health["spec_decodes"] == 2 * len(starts), health
         assert 0 < health["spec_rounds"] <= health["spec_tokens"], health
+
+        # SAMPLED requests also ride the speculative path (distribution-
+        # preserving accept/residual): deterministic per seed, seed-
+        # sensitive, and counted in the telemetry.
+        def ask_sampled(port, seed):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=_json.dumps({
+                    "tokens": [[5, 6, 7, 8]], "num_steps": 6,
+                    "temperature": 0.9, "seed": seed,
+                }).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return _json.loads(resp.read())["tokens"][0]
+
+        s1 = ask_sampled(spec_port, 11)
+        assert ask_sampled(spec_port, 11) == s1
+        assert any(ask_sampled(spec_port, s) != s1 for s in (12, 13, 14))
+        health2 = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{spec_port}/healthz", timeout=5).read())
+        # 2 determinism queries + at least 1 seed-sensitivity query
+        # (any() short-circuits on the first differing seed)
+        assert health2["spec_decodes"] >= health["spec_decodes"] + 3, health2
     finally:
         for proc in (plain, spec):
             proc.terminate()
