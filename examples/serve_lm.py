@@ -131,6 +131,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="draft depth (default max(1, --layers // 2)); "
                         "the draft trains on the same synthetic task "
                         "(quick_train), so it actually accepts")
+    p.add_argument("--draft-checkpoint-dir", default=None,
+                   help="orbax checkpoint for the DRAFT model (trained "
+                        "at --spec-draft-layers depth, same width "
+                        "flags); required when --spec-k is combined "
+                        "with --checkpoint-dir")
     p.add_argument("--stream-segment", type=int, default=16, metavar="N",
                    help="segment size for streamed responses (POST "
                         '/generate with "stream": true): greedy tokens '
@@ -169,10 +174,12 @@ def main(argv: list[str] | None = None) -> int:
             p.error("--spec-k composes only with the plain decode path "
                     "(not --int8/--kv-int8/--tp; speculative exactness "
                     "is pinned for that configuration)")
-        if args.checkpoint_dir:
-            p.error("--spec-k with --checkpoint-dir needs a trained "
-                    "draft checkpoint, which this example does not "
-                    "plumb; use the quick-train path")
+        if args.checkpoint_dir and not args.draft_checkpoint_dir:
+            p.error("--spec-k with --checkpoint-dir also needs "
+                    "--draft-checkpoint-dir (a draft trained at "
+                    "--spec-draft-layers depth)")
+    elif args.draft_checkpoint_dir:
+        p.error("--draft-checkpoint-dir requires --spec-k")
 
     import jax
     import jax.numpy as jnp
@@ -189,45 +196,57 @@ def main(argv: list[str] | None = None) -> int:
         n_layers=args.layers, d_ff=args.d_model * 2,
         max_seq_len=args.max_seq_len, dtype=jnp.float32,
     )
-    if args.checkpoint_dir:
+    def restore_params(ckpt_dir, model_cfg, label, from_pp=None):
+        """Restore trained params from a dist_lm orbax checkpoint into a
+        model_cfg-shaped template — THE restore path for both the target
+        and the draft, so template construction and error handling
+        cannot drift. Returns None (after the standard error print) when
+        the dir holds no checkpoint."""
         from tf_operator_tpu.models.transformer import Transformer
         from tf_operator_tpu.train.checkpoint import CheckpointManager
         from tf_operator_tpu.train.steps import TrainState, adamw
 
-        ckpt = CheckpointManager(args.checkpoint_dir)
+        ckpt = CheckpointManager(ckpt_dir)
         step = ckpt.latest_step()
         if step is None:
-            print(f"serve_lm: no checkpoint in {args.checkpoint_dir}",
+            print(f"serve_lm: no checkpoint in {ckpt_dir}",
                   file=sys.stderr, flush=True)
-            return 1
+            return None
         # The trainer saved a full TrainState; restore into a matching
         # template and keep the params.
-        toks0 = jnp.zeros((1, 1), jnp.int32)
-        init_params = Transformer(cfg).init(
-            jax.random.PRNGKey(0), toks0
+        init_params = Transformer(model_cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)
         )["params"]
-        if args.from_pp:
+        if from_pp:
             from tf_operator_tpu.train.pp_lm import (
                 merge_pp_params,
                 split_pp_params,
             )
 
             outer, stages = split_pp_params(
-                init_params, cfg.n_layers, args.from_pp
+                init_params, model_cfg.n_layers, from_pp
             )
             template = TrainState.create(
                 {"outer": outer, "stages": stages}, adamw(args.lr)
             )
             restored = ckpt.restore(step, template).params
-            params = merge_pp_params(
-                restored["outer"], restored["stages"], cfg.n_layers
+            restored = merge_pp_params(
+                restored["outer"], restored["stages"], model_cfg.n_layers
             )
         else:
             template = TrainState.create(init_params, adamw(args.lr))
-            params = ckpt.restore(step, template).params
-        print(f"serve_lm: restored checkpoint step {step}"
-              + (f" (merged from pp={args.from_pp})" if args.from_pp else ""),
+            restored = ckpt.restore(step, template).params
+        print(f"serve_lm: restored {label} checkpoint step {step}"
+              + (f" (merged from pp={from_pp})" if from_pp else ""),
               flush=True)
+        return restored
+
+    if args.checkpoint_dir:
+        params = restore_params(
+            args.checkpoint_dir, cfg, "target", from_pp=args.from_pp
+        )
+        if params is None:
+            return 1
     else:
         params = quick_train(cfg, args.train_steps, args.lr)
 
@@ -263,10 +282,17 @@ def main(argv: list[str] | None = None) -> int:
                       if args.spec_draft_layers is not None
                       else max(1, args.layers // 2)),
         )
-        # Same synthetic task as the target: the draft genuinely agrees
-        # with the target often enough to accept (quick_train's data is
-        # deterministic per config shape).
-        draft_params = quick_train(draft_cfg, args.train_steps, args.lr)
+        if args.draft_checkpoint_dir:
+            draft_params = restore_params(
+                args.draft_checkpoint_dir, draft_cfg, "draft"
+            )
+            if draft_params is None:
+                return 1
+        else:
+            # Same synthetic task as the target: the draft genuinely
+            # agrees with the target often enough to accept
+            # (quick_train's data is deterministic per config shape).
+            draft_params = quick_train(draft_cfg, args.train_steps, args.lr)
         print(f"serve_lm: speculative decoding on (k={args.spec_k}, "
               f"draft layers={draft_cfg.n_layers})", flush=True)
 

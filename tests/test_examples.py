@@ -295,6 +295,66 @@ def test_serve_lm_from_pipeline_checkpoint(tmp_path):
         proc.wait(timeout=10)
 
 
+def test_serve_lm_speculative_from_checkpoints(tmp_path):
+    """Production-shaped speculative serving: target AND draft restored
+    from orbax checkpoints (separately trained at different depths on
+    the same task), greedy chain completion correct, speculative path
+    engaged per the telemetry."""
+    import json as _json
+    import subprocess
+    import urllib.request
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    tck, dck = str(tmp_path / "target"), str(tmp_path / "draft")
+    for ck, layers in ((tck, "2"), (dck, "1")):
+        r = subprocess.run(
+            [sys.executable, os.path.join(EXAMPLES, "dist_lm.py"),
+             "--steps", "120", "--batch", "8", "--seq", "64",
+             "--vocab", "256", "--d-model", "128", "--layers", layers,
+             "--lr", "5e-3", "--target-loss", "1.0",
+             "--checkpoint-dir", ck],
+            env=env, capture_output=True, text=True, timeout=480,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
+         "--port", str(port), "--checkpoint-dir", tck,
+         "--spec-k", "3", "--spec-draft-layers", "1",
+         "--draft-checkpoint-dir", dck,
+         # budget 2 > the 1 request sent: /healthz after the generate
+         # cannot race the request-budget shutdown
+         "--max-seq-len", "64", "--requests", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        wait_server_ready(proc, port, timeout=120)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=_json.dumps(
+                {"tokens": [[5, 6, 7, 8]], "num_steps": 4}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            out = _json.loads(resp.read())
+        assert out["tokens"][0] == [9, 10, 11, 12], out
+        health = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+        assert health["spec_decodes"] == 1, health
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+        out_log = proc.stdout.read() if proc.stdout else ""
+    assert "restored draft checkpoint step" in out_log
+
+
 def test_serve_lm_coalesces_concurrent_requests():
     """--batch-window: concurrent same-shape greedy requests run as ONE
     batched decode (weight reads amortized across the batch — decode's
