@@ -124,9 +124,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="speculative decoding: a smaller DRAFT model "
                         "proposes K tokens per round, verified in one "
                         "chunked target forward (models/spec_decode.py). "
-                        "Greedy requests only; output is EXACTLY the "
-                        "plain greedy output (a bad draft costs speed, "
-                        "never correctness). 0 = off")
+                        "Covers greedy AND sampled requests (incl. "
+                        "top_p): greedy output is bit-identical to "
+                        "plain greedy, sampled output follows exactly "
+                        "the plain sampling distribution (a bad draft "
+                        "costs speed, never correctness). 0 = off")
     p.add_argument("--spec-draft-layers", type=int, default=None,
                    help="draft depth (default max(1, --layers // 2)); "
                         "the draft trains on the same synthetic task "
@@ -299,7 +301,7 @@ def main(argv: list[str] | None = None) -> int:
     spec_stats = {"decodes": 0, "rounds": 0, "tokens": 0}
 
     def decode_spec(rows, num_steps: int, temperature: float = 0.0,
-                    sample_rng=None):
+                    top_p=None, sample_rng=None):
         """THE speculative decode path for greedy (direct AND coalesced)
         and sampled requests: speculative_generate when --spec-k is set
         and the speculation margin fits the cache, else None (caller
@@ -318,7 +320,8 @@ def main(argv: list[str] | None = None) -> int:
 
         out, rounds = speculative_generate(
             cfg, params, draft_cfg, draft_params, rows, num_steps,
-            k=args.spec_k, temperature=temperature, rng=sample_rng,
+            k=args.spec_k, temperature=temperature, top_p=top_p,
+            rng=sample_rng,
         )
         spec_stats["decodes"] += 1
         spec_stats["rounds"] += int(rounds)
@@ -583,16 +586,19 @@ def main(argv: list[str] | None = None) -> int:
                     with lock:
                         out = decode_greedy(prompt, num_steps)
                 else:
-                    # Sampled requests also try the distribution-
-                    # preserving speculative path (same emitted-token
-                    # law as plain sampling); top_p has no residual
-                    # analog, so it always takes plain generate.
+                    # Sampled requests (with or without top_p) also try
+                    # the distribution-preserving speculative path: the
+                    # accept/residual scheme targets the tempered —
+                    # and, when requested, nucleus-filtered — softmax
+                    # exactly. top_p-without-temperature still reaches
+                    # plain generate, whose 400 defines that contract.
                     with lock:
                         out = None
-                        if "top_p" not in kw:
+                        if "temperature" in kw:
                             out = decode_spec(
                                 prompt, num_steps,
                                 temperature=kw["temperature"],
+                                top_p=kw.get("top_p"),
                                 sample_rng=kw["rng"],
                             )
                         if out is None:

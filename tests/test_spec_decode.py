@@ -196,6 +196,50 @@ def test_sampled_conditional_distribution_matches_target():
     assert checked >= 2, "too few diagnostic prefix buckets"
 
 
+def test_sampled_top_p_conditional_distribution_matches_filtered_target():
+    """top_p speculative sampling: the emitted law is the NUCLEUS
+    distribution of the target (zero mass outside the nucleus, filtered
+    softmax inside) — same empirical scheme as the unfiltered test, with
+    the oracle nucleus-filtered."""
+    from tf_operator_tpu.models.transformer import _nucleus_filter
+
+    V, T, TOP_P = 16, 1.0, 0.6
+    tcfg = small_cfg(vocab_size=V)
+    dcfg = small_cfg(vocab_size=V, n_layers=1, d_model=16, n_heads=1,
+                     d_ff=32)
+    tp = init_params(tcfg, 31)
+    dp = init_params(dcfg, 32)
+    b = 4096
+    prompt = jnp.tile(jnp.asarray([[4, 11, 2]], jnp.int32), (b, 1))
+
+    toks, _ = speculative_generate(
+        tcfg, tp, dcfg, dp, prompt, 2, k=1, temperature=T, top_p=TOP_P,
+        rng=jax.random.PRNGKey(9),
+    )
+    toks = np.asarray(toks)
+
+    model = Transformer(tcfg)
+    seqs = jnp.concatenate(
+        [jnp.tile(prompt[:1], (V, 1)),
+         jnp.arange(V, dtype=jnp.int32)[:, None]], axis=1,
+    )
+    p_cond = np.asarray(jax.nn.softmax(
+        _nucleus_filter(model.apply({"params": tp}, seqs)[:, -1] / T,
+                        TOP_P)))
+
+    checked = 0
+    for t0 in range(V):
+        rows = toks[toks[:, 0] == t0]
+        if len(rows) < 250:
+            continue
+        emp = np.bincount(rows[:, 1], minlength=V) / len(rows)
+        # zero mass outside the target's nucleus — the hard guarantee
+        assert emp[p_cond[t0] < 1e-9].sum() == 0.0, t0
+        assert np.abs(emp - p_cond[t0]).sum() < 0.3, t0
+        checked += 1
+    assert checked >= 2
+
+
 def test_sampled_deterministic_per_key_and_validates(params):
     prompt = prompt_batch(2)
     a, _ = speculative_generate(
@@ -221,6 +265,16 @@ def test_sampled_deterministic_per_key_and_validates(params):
         speculative_generate(
             TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
             k=2, temperature=-1.0, rng=jax.random.PRNGKey(0),
+        )
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_generate(
+            TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+            k=2, temperature=0.5, top_p=1.5, rng=jax.random.PRNGKey(0),
+        )
+    with pytest.raises(ValueError, match="top_p requires"):
+        speculative_generate(
+            TARGET, params["target"], DRAFT, params["draft"], prompt, 8,
+            k=2, top_p=0.9,
         )
 
 

@@ -74,6 +74,7 @@ def speculative_generate(
     *,
     k: int = 4,
     temperature: float = 0.0,
+    top_p: float | None = None,
     rng: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Speculative decode: ([B, num_steps] tokens, rounds used).
@@ -94,6 +95,14 @@ def speculative_generate(
     lengths; the round advances by the batch-min, and at the cut each
     row emits ITS OWN accept-or-residual outcome, which is a correct
     per-row sample either way. ``rng`` is required when sampling.
+
+    ``top_p`` (sampling only) applies the nucleus filter to BOTH
+    distributions — the draft proposes from its filtered q', the accept
+    test and residual target the filtered p' — so the emitted law is
+    exactly ``generate(..., temperature, top_p)``'s nucleus
+    distribution (the identity holds for any pair of distributions,
+    filtered ones included; a proposal outside the target's nucleus has
+    p'(d)=0 and is surely rejected).
 
     ``k`` = draft proposals per round; each round emits between 1 and
     k+1 tokens. ``rounds`` is the number of verify forwards the loop
@@ -121,8 +130,13 @@ def speculative_generate(
         raise ValueError(f"temperature={temperature} must be >= 0")
     if temperature > 0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    if top_p is not None and temperature <= 0:
+        raise ValueError("top_p requires temperature > 0 (greedy ignores it)")
     fn = _spec_fn(target_cfg, draft_cfg, num_steps, int(k),
-                  float(temperature))
+                  float(temperature),
+                  None if top_p is None else float(top_p))
     if rng is None:
         rng = jax.random.PRNGKey(0)  # greedy: carried but never consumed
     return fn(target_params, draft_params, prompt, rng)
@@ -130,8 +144,11 @@ def speculative_generate(
 
 @functools.lru_cache(maxsize=16)
 def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
-             num_steps: int, k: int, temperature: float = 0.0):
+             num_steps: int, k: int, temperature: float = 0.0,
+             top_p: float | None = None):
     from dataclasses import replace
+
+    from tf_operator_tpu.models.transformer import _nucleus_filter
 
     tmodel = Transformer(replace(
         target_cfg, decode=True, mesh=None, remat=False))
@@ -142,6 +159,15 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
     # unchanged by the branches (rng rides the carry either way but the
     # greedy trace never consumes it).
     sampled = temperature > 0
+
+    def scale(logits):
+        """Tempered (and optionally nucleus-filtered) logits: the ONE
+        transformation both models' distributions pass through, so p
+        and q are always the same kind of distribution."""
+        s = logits / temperature
+        if top_p is not None:
+            s = _nucleus_filter(s, top_p)
+        return s
 
     def run(tparams, dparams, prompt, rng):
         b = prompt.shape[0]
@@ -155,7 +181,7 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
         if sampled:
             rng, k0 = jax.random.split(rng)
             pend = jax.random.categorical(
-                k0, tlogits / temperature
+                k0, scale(tlogits)
             ).astype(tok_dtype)
         else:
             pend = tlogits.argmax(-1).astype(tok_dtype)
@@ -176,7 +202,7 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
             logits = logits[:, 0]
             if sampled:
                 nxt = jax.random.categorical(
-                    step_key, logits / temperature
+                    step_key, scale(logits)
                 ).astype(tok_dtype)
                 return (upd["cache"], nxt), (nxt, logits)
             nxt = logits.argmax(-1).astype(tok_dtype)
@@ -212,8 +238,8 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
                 # Accept tests at positions 1..k: u < p(d)/q(d), in log
                 # space (ratio >= 1 always accepts; log u < 0 surely).
                 qlogits = qlogits.swapaxes(0, 1)  # [B, k+1, V]
-                logp = jax.nn.log_softmax(tlogits[:, :k] / temperature)
-                logq = jax.nn.log_softmax(qlogits[:, :k] / temperature)
+                logp = jax.nn.log_softmax(scale(tlogits[:, :k]))
+                logq = jax.nn.log_softmax(scale(qlogits[:, :k]))
                 sel = proposals[..., None]
                 lp = jnp.take_along_axis(logp, sel, axis=-1)[..., 0]
                 lq = jnp.take_along_axis(logq, sel, axis=-1)[..., 0]
@@ -240,7 +266,7 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
                     jnp.log(residual_distribution(p_all, q_all) + 1e-38),
                 ).astype(tok_dtype)                 # [B, k]
                 bonus = jax.random.categorical(
-                    k_bonus, tlogits[:, k] / temperature
+                    k_bonus, scale(tlogits[:, k])
                 ).astype(tok_dtype)                 # [B]
                 col = jnp.minimum(m, k - 1)
                 at_m = jnp.take_along_axis(
