@@ -446,6 +446,7 @@ def test_serve_lm_coalesces_concurrent_requests():
         proc.wait(timeout=15)
 
 
+@pytest.mark.e2e_smoke
 def test_serve_lm_speculative_matches_plain():
     """--spec-k: the draft-accelerated server's greedy outputs agree with
     a plain server's (same quick-train config → same params; greedy
