@@ -87,6 +87,19 @@ def test_budget_validation(params):
         generate_segmented(CFG, params, prompt_of(), 6, segment=0)
 
 
+def test_exact_with_gqa_cache():
+    cfg_gqa = cfg_of(n_heads=4, n_kv_heads=2)
+    params = Transformer(cfg_gqa).init(
+        jax.random.PRNGKey(3), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = prompt_of()
+    want = np.asarray(generate(cfg_gqa, params, prompt, 9))
+    got = np.asarray(generate_segmented(
+        cfg_gqa, params, prompt, 9, segment=4
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_exact_with_kv8_cache():
     cfg8 = cfg_of(kv_int8=True)
     params = Transformer(cfg_of()).init(

@@ -126,6 +126,30 @@ def test_budget_and_config_validation(params):
         )
 
 
+@pytest.mark.parametrize("variant", ["gqa", "kv8", "gqa_kv8"])
+def test_exact_vs_greedy_cache_variants(variant):
+    """Speculative exactness composes with the cache variants: GQA
+    (grouped K/V heads — smaller cache rows to roll back), int8 KV
+    (extra scale buffers whose stale entries must also be masked by the
+    counter rollback), and both. Oracle: plain generate on the same
+    variant config."""
+    kw = {}
+    if "gqa" in variant:
+        kw.update(n_heads=4, n_kv_heads=2)
+    if "kv8" in variant:
+        kw.update(kv_int8=True)
+    tcfg = small_cfg(**kw)
+    tparams = init_params(small_cfg(**{k: v for k, v in kw.items()
+                                       if k != "kv_int8"}), 3)
+    dparams = init_params(DRAFT, 7)  # the module's shared draft
+    prompt = prompt_batch(2)
+    want = generate(tcfg, tparams, prompt, 12)
+    got, _ = speculative_generate(
+        tcfg, tparams, DRAFT, dparams, prompt, 12, k=3
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_residual_identity_recovers_target_distribution():
     """The correctness core of sampled speculative decoding, pinned
     against the exact module code: for ANY p, q the accept/residual
