@@ -3,28 +3,33 @@
 The chip behind the axon tunnel is reachable only in short, unpredictable
 windows (rounds 2-4 each saw 6-12 h outages around a ~35-min window).
 This daemon replaces the passive watcher: it polls `jax.devices()` under
-a timeout, and the moment the backend answers it runs the round-4
-measurement plan — highest-value stage first, each stage its own
-subprocess with a budget, tunnel re-checked between stages — so a window
-is fully exploited even if it opens while nobody is watching.
+a timeout, and the moment the backend answers it runs the staged
+measurement plan (STAGES below) — highest-value first, each stage its
+own subprocess with a budget, tunnel re-checked between stages — so a
+window is fully exploited even if it opens while nobody is watching.
+The r05 first window (2026-08-01, 33 min) captured the core 7 stages
+this way; the remaining stages resume automatically at the next UP.
 
-Stages (see VERDICT round 3 "Next round: do this"):
-  1. roofline probe        — chip state right now (fast/slow?).
-  2. synthetic probe       — device-resident ResNet rate: THE split that
-                             attributes round 3's 59.9 img/s collapse.
-  3. flashramp/flashblocks — 8k attention: ramp artifact or real, and
-                             the Q-block A/B for the decoupled kernel.
-  4. bench.py (full)       — the complete artifact, ResNet first; also
-                             populates the persistent XLA compile cache
-                             so the driver's round-end bench is cheap.
-  5. flashsweep/stem/h2d   — secondary attribution probes.
-  6. LM flash-vs-xla A/B   — bench lm section, both kernel legs.
-  7. lmsweep probe         — MFU-vs-model-size curve (VERDICT item 4).
-  8. decode probe          — steady-state decode vs measured copy roof.
+Done-state is DERIVED FROM DISK (_done_from_disk): a stage whose
+artifact under docs/$WINDOW_DIR_NAME/<stamp>/<stage>.jsonl holds useful
+lines is never re-run, so daemon restarts (code updates, supervisor
+relaunch after a crash) are free. Stage groups, in priority order:
+
+  attribution  roofline/roofline2 (ceilings: chained matmul AND chained
+               copy — one-shot probes under-read this time-sliced
+               tunnel ~5x), synthetic (device-resident ResNet),
+               convsweep, flashramp/flashblocks/qblock (8k ramp,
+               Q-block A/Bs, dispatch-vs-direct arbitration)
+  artifact     bench_full (the complete 8-section bench.py run),
+               bench_resnet2 + resnet_resident (re-measures: mfu gate,
+               HBM-resident input mode)
+  secondary    flashsweep, h2d, lm A/B (flash vs xla), lmsweep,
+               decodesweep, decodelong, specdecode, input, fwd_split,
+               stem
 
 Everything lands under docs/$WINDOW_DIR_NAME/<UTC stamp>/<stage>.jsonl
-(default window_r05) plus a
-combined log; stderr per stage under the same dir. Usage:
+(default window_r05); stderr per stage under the same dir. See the
+"Window-capture runbook" in docs/developer_guide.md. Usage:
     nohup python tools/window_autorun.py >> /tmp/autorun.log 2>&1 &
 """
 
