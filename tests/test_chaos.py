@@ -24,7 +24,17 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
 from tf_operator_tpu.controller.tpujob_controller import TPUJobController
 from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError
+from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
 from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.scheduler import GangScheduler, SchedulerConfig
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_STATE,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    is_gated,
+)
 
 import os
 
@@ -289,3 +299,235 @@ def test_chaos_soak_converges_clean():
     finally:
         stop.set()
         time.sleep(0.5)
+
+
+# ===========================================================================
+# Gang-admission chaos (fast tier): the all-or-nothing proofs of ISSUE 1.
+#
+# Invariant under test — the deadlock gang admission exists to prevent: a
+# job must never have a strict subset of its slice pods Running while the
+# remainder CANNOT run (still gated). Both cluster backends are exercised:
+# the in-memory store directly, and the wire-level Kubernetes stub through
+# KubeClusterClient (gate enforcement surfacing as HTTP 422).
+# ===========================================================================
+
+GANG_CAPACITY = {"v4": (2, 2, 2)}  # exactly one v4-8 gang (8 chips) fits
+
+
+def gang_job(name: str, priority_class: str | None = None) -> dict:
+    spec: dict = {
+        "replicaSpecs": {
+            "Worker": {
+                "tpu": {"acceleratorType": "v4-8"},  # 2 hosts, one slice
+                "template": {
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": constants.DEFAULT_CONTAINER_NAME,
+                                "image": "chaos/none",
+                                "command": ["unused"],
+                            }
+                        ]
+                    }
+                },
+            }
+        }
+    }
+    if priority_class:
+        spec["scheduling"] = {"priorityClass": priority_class}
+    return {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+@pytest.fixture(params=["memcluster", "kubestub"])
+def gang_backend(request):
+    """(client, store, stub|None): the controller-facing client plus the
+    authoritative InMemoryCluster behind it (rejection counters)."""
+    if request.param == "memcluster":
+        store = InMemoryCluster()
+        yield store, store, None
+        return
+    stub = KubeApiStub()
+    stub.start()
+    try:
+        yield KubeClusterClient(KubeConfig(server=stub.url)), stub.cluster, stub
+    finally:
+        stub.stop()
+
+
+def gang_controller(client, scheduler):
+    from tf_operator_tpu.runtime.events import FakeRecorder
+
+    return TPUJobController(
+        client,
+        JobControllerConfig(reconcile_period=0.2),
+        recorder=FakeRecorder(),
+        scheduler=scheduler,
+    )
+
+
+def sync(tc, key: str):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(key)
+
+
+def job_pods(store, name: str) -> list[dict]:
+    return store.list(
+        objects.PODS, "default", {constants.LABEL_JOB_NAME: name}
+    )
+
+
+def running_count(store, name: str) -> int:
+    return sum(
+        1 for p in job_pods(store, name)
+        if objects.pod_phase(p) == objects.RUNNING
+    )
+
+
+class PartialSliceWatch(threading.Thread):
+    """Continuously samples the store asserting the gang invariant: a job
+    with any Running pod has NO gated pod left (its whole slice became
+    runnable as a unit)."""
+
+    def __init__(self, store, job_names):
+        super().__init__(daemon=True)
+        self.store = store
+        self.job_names = job_names
+        self.stop_event = threading.Event()
+        self.violations: list[str] = []
+
+    def run(self):
+        while not self.stop_event.is_set():
+            for name in self.job_names:
+                pods = job_pods(self.store, name)
+                running = [
+                    p for p in pods
+                    if objects.pod_phase(p) == objects.RUNNING
+                ]
+                gated = [p for p in pods if is_gated(p)]
+                if running and gated:
+                    self.violations.append(
+                        f"{name}: {len(running)} Running while "
+                        f"{len(gated)} still gated"
+                    )
+            time.sleep(0.002)
+
+
+def hammer_running(client, store, name: str, seconds: float) -> int:
+    """A rogue kubelet: keeps trying to mark every pod of ``name`` Running.
+    Returns how many attempts the backend REFUSED (gate enforcement)."""
+    rejected = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for pod in job_pods(store, name):
+            fresh = dict(pod)
+            objects.set_pod_phase(fresh, objects.RUNNING)
+            try:
+                client.update_status(objects.PODS, fresh)
+            except ApiError:
+                rejected += 1
+        time.sleep(0.01)
+    return rejected
+
+
+@pytest.mark.scheduler
+def test_gang_crash_between_create_and_release_never_runs_partial(
+    gang_backend,
+):
+    """Controller dies after creating the gang's pods but BEFORE lifting the
+    gates: nothing may run (a fake kubelet hammering Running is refused by
+    the store / by HTTP 422), and a successor controller finishes the
+    release so the whole slice becomes runnable together."""
+    client, store, stub = gang_backend
+
+    # Controller #1 whose release path "crashes": admission is decided and
+    # persisted, pods are created gated, but the gates never lift.
+    sched1 = GangScheduler(config=SchedulerConfig(capacity=GANG_CAPACITY))
+    tc1 = gang_controller(client, sched1)
+    sched1.release_gang = lambda job: False  # the simulated crash point
+    client.create(objects.TPUJOBS, gang_job("half-born"))
+
+    watch = PartialSliceWatch(store, ["half-born"])
+    watch.start()
+    try:
+        sync(tc1, "default/half-born")
+        pods = job_pods(store, "half-born")
+        assert len(pods) == 2 and all(is_gated(p) for p in pods)
+        ann = store.get(objects.TPUJOBS, "default", "half-born")[
+            "metadata"]["annotations"]
+        assert ann[ANNOTATION_STATE] == STATE_ADMITTED  # persisted FIRST
+
+        # The rogue kubelet cannot run any gated pod.
+        rejected = hammer_running(client, store, "half-born", 0.25)
+        assert rejected > 0, "gate was never actually exercised"
+        assert running_count(store, "half-born") == 0
+        assert store.gate_rejections > 0
+        if stub is not None:
+            assert stub.gate_422s_served > 0  # enforced AT THE WIRE
+
+        # Controller #1 is gone; a fresh incarnation recovers the persisted
+        # admission and finishes the release — no re-arbitration, no
+        # re-queue, and the slice flips runnable as one unit.
+        sched2 = GangScheduler(config=SchedulerConfig(capacity=GANG_CAPACITY))
+        tc2 = gang_controller(client, sched2)
+        sync(tc2, "default/half-born")
+        pods = job_pods(store, "half-born")
+        assert pods and all(not is_gated(p) for p in pods)
+
+        # Now the kubelet succeeds — the whole gang runs.
+        hammer_running(client, store, "half-born", 0.1)
+        assert running_count(store, "half-born") == 2
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations
+
+
+@pytest.mark.scheduler
+def test_gang_oversubscription_preempts_within_one_epoch(gang_backend):
+    """Two jobs oversubscribe the fleet (capacity fits exactly one): the
+    low-priority gang runs; a critical gang arrives and — within ONE
+    reconcile pass — evicts the victim whole, takes its place, and runs.
+    At no instant does either job hold a strict subset of runnable pods."""
+    client, store, stub = gang_backend
+    sched = GangScheduler(config=SchedulerConfig(capacity=GANG_CAPACITY))
+    tc = gang_controller(client, sched)
+
+    watch = PartialSliceWatch(store, ["meek", "boss"])
+    watch.start()
+    try:
+        client.create(objects.TPUJOBS, gang_job("meek", "low"))
+        sync(tc, "default/meek")
+        hammer_running(client, store, "meek", 0.1)
+        assert running_count(store, "meek") == 2  # victim fully up
+
+        # The critical job lands. One reconcile epoch later it has evicted
+        # the victim gang WHOLE and owns the slice.
+        client.create(objects.TPUJOBS, gang_job("boss", "critical"))
+        sync(tc, "default/boss")
+        assert job_pods(store, "meek") == [], "victim evicted whole"
+        boss_pods = job_pods(store, "boss")
+        assert len(boss_pods) == 2 and all(not is_gated(p) for p in boss_pods)
+        meek_ann = store.get(objects.TPUJOBS, "default", "meek")[
+            "metadata"]["annotations"]
+        assert meek_ann[ANNOTATION_STATE] == STATE_QUEUED  # requeued as gang
+        snap = sched.snapshot()
+        assert [g["key"] for g in snap["admitted"]] == ["default/boss"]
+        assert [g["key"] for g in snap["queued"]] == ["default/meek"]
+
+        hammer_running(client, store, "boss", 0.1)
+        assert running_count(store, "boss") == 2
+        # The preempted gang cannot creep back while capacity is held: a
+        # later sync of the victim creates nothing.
+        sync(tc, "default/meek")
+        assert job_pods(store, "meek") == []
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations

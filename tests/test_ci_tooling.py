@@ -534,7 +534,11 @@ def test_pyproject_metadata_consistent():
     callable, the dynamic version attribute exists, and the package
     discovery pattern matches the real package name."""
     import importlib
-    import tomllib
+
+    try:
+        import tomllib  # 3.11+ stdlib
+    except ImportError:
+        import tomli as tomllib  # 3.10: the identical backport
 
     with open(os.path.join(REPO_ROOT, "pyproject.toml"), "rb") as f:
         meta = tomllib.load(f)

@@ -673,3 +673,46 @@ class TestInformerResyncOrdering:
         tc.sync_job("default/ghostcount")
         got = client.get(objects.TPUJOBS, "default", "ghostcount")
         assert got["status"].get("restartCount", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# Service spec-drift repair (VERDICT #5)
+# ---------------------------------------------------------------------------
+
+class TestServiceDriftRepair:
+    def test_drifted_service_recreated_with_desired_spec(self):
+        tc, client = make_controller(real_controls=True)
+        job = testutil.new_tpujob(name="drift", worker=1)
+        submit(client, job)
+        sync_once(tc, client, job)
+        [svc] = client.list(objects.SERVICES, "default")
+        desired_port = svc["spec"]["ports"][0]["port"]
+        desired_selector = dict(svc["spec"]["selector"])
+
+        # Out-of-band edit breaks the rendezvous identity: wrong port AND a
+        # selector matching no pod (DNS resolves to nothing).
+        svc["spec"]["ports"][0]["port"] = 1
+        svc["spec"]["selector"] = {"oops": "wrong"}
+        client.update(objects.SERVICES, svc)
+
+        sync_once(tc, client, job)  # observes drift, deletes
+        sync_once(tc, client, job)  # expectations settle, recreates
+        [repaired] = client.list(objects.SERVICES, "default")
+        assert repaired["spec"]["ports"][0]["port"] == desired_port
+        assert repaired["spec"]["selector"] == desired_selector
+
+    def test_cluster_assigned_fields_are_not_drift(self):
+        tc, client = make_controller(real_controls=True)
+        job = testutil.new_tpujob(name="nodrift", worker=1)
+        submit(client, job)
+        sync_once(tc, client, job)
+        [svc] = client.list(objects.SERVICES, "default")
+        uid_before = objects.uid_of(svc)
+
+        # A cluster-manager write the controller does not own must not
+        # trigger a recreate loop.
+        svc["spec"]["clusterIP"] = "10.0.0.7"
+        client.update(objects.SERVICES, svc)
+        sync_once(tc, client, job)
+        [svc2] = client.list(objects.SERVICES, "default")
+        assert objects.uid_of(svc2) == uid_before

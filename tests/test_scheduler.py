@@ -1,0 +1,709 @@
+"""Gang-admission scheduler tests: queue ordering/aging, quota accounting,
+topology fit, preemption victim selection, and the GangScheduler admission
+pipeline (gate → admit → release → recover) against the in-memory cluster.
+
+The chaos-grade all-or-nothing proofs (controller killed mid-release, two
+jobs oversubscribing the fleet on both backends) live in test_chaos.py.
+"""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import Invalid
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.scheduler import (
+    GATE_NAME,
+    AdmissionQueue,
+    Gang,
+    GangScheduler,
+    Quota,
+    QuotaLedger,
+    SchedulerConfig,
+    TopologyPlacer,
+    gang_from_job,
+    is_gated,
+    parse_capacity,
+    resolve_priority,
+    select_victims,
+)
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_PREEMPTED_AT,
+    ANNOTATION_STATE,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    SliceRequest,
+    ungate_patch,
+)
+from tf_operator_tpu.scheduler.placement import CapacityError
+from tf_operator_tpu.utils import testutil
+
+pytestmark = pytest.mark.scheduler
+
+
+def mk_gang(name, priority=0, chips=8, dims=(2, 2, 2), pods=2, ns="default",
+            enqueued_at=1000.0, gen="v4"):
+    return Gang(
+        namespace=ns,
+        name=name,
+        uid=f"uid-{name}",
+        priority_class=str(priority),
+        priority=priority,
+        pod_count=pods,
+        slices=[SliceRequest(gen, dims, chips)],
+        enqueued_at=enqueued_at,
+    )
+
+
+def tpu_job(name, accel="v4-8", priority_class=None, ns="default"):
+    job = testutil.new_tpujob(name=name, namespace=ns, tpu_accelerator=accel)
+    if priority_class:
+        job.spec.scheduling.priority_class = priority_class
+    return job
+
+
+# ---------------------------------------------------------------------------
+# queue.py: ordering, aging, quota
+# ---------------------------------------------------------------------------
+
+def test_queue_orders_by_priority_then_fifo():
+    q = AdmissionQueue(aging_rate=0.0)
+    q.add(mk_gang("low", priority=-100, enqueued_at=1.0))
+    q.add(mk_gang("first-default", priority=0, enqueued_at=2.0))
+    q.add(mk_gang("second-default", priority=0, enqueued_at=3.0))
+    q.add(mk_gang("crit", priority=1000, enqueued_at=99.0))
+    names = [g.name for g in q.ordered(now=100.0)]
+    assert names == ["crit", "first-default", "second-default", "low"]
+
+
+def test_queue_aging_lets_old_low_priority_overtake():
+    q = AdmissionQueue(aging_rate=1.0)
+    q.add(mk_gang("patient-default", priority=0, enqueued_at=0.0))
+    q.add(mk_gang("fresh-high", priority=100, enqueued_at=200.0))
+    # At t=200 the default gang has 200 aging points vs high's 100.
+    assert [g.name for g in q.ordered(now=200.0)] == [
+        "patient-default", "fresh-high"
+    ]
+    # Early on, static priority still wins.
+    assert [g.name for g in q.ordered(now=50.0)] == [
+        "fresh-high", "patient-default"
+    ]
+
+
+def test_quota_ledger_chips_and_slices_axes():
+    ledger = QuotaLedger({"teama": Quota(chips=16, slices=2)})
+    g1 = mk_gang("a1", chips=8, ns="teama")
+    g2 = mk_gang("a2", chips=8, ns="teama")
+    g3 = mk_gang("a3", chips=8, ns="teama")
+    assert ledger.fits(g1)
+    ledger.charge(g1)
+    assert ledger.fits(g2)
+    ledger.charge(g2)
+    # Third gang busts both chip (24 > 16) and slice (3 > 2) budgets.
+    assert not ledger.fits(g3)
+    ledger.refund(g1)
+    assert ledger.fits(g3)
+    # Un-quota'd namespaces are unlimited.
+    assert ledger.fits(mk_gang("other", chips=10 ** 6, ns="elsewhere"))
+
+
+# ---------------------------------------------------------------------------
+# gang.py: priority + gang construction + gate helpers
+# ---------------------------------------------------------------------------
+
+def test_resolve_priority_names_numbers_unknown():
+    assert resolve_priority("critical") == 1000
+    assert resolve_priority("low") == -100
+    assert resolve_priority("750") == 750
+    assert resolve_priority("no-such-class") == 0
+    assert resolve_priority(None) == 0
+
+
+def test_gang_from_job_counts_pods_and_slices():
+    job = testutil.new_tpujob(tpu_accelerator="v4-8", ps=2)
+    gang = gang_from_job(job)
+    # v4-8 = 8 chips over 2 hosts; PS pods ride the gang without chips.
+    assert gang.pod_count == 4  # 2 slice hosts + 2 PS
+    assert gang.num_slices == 1
+    assert gang.total_chips == 8
+    assert gang.slices[0].dims == (2, 2, 2)
+
+
+def test_gate_helpers_roundtrip():
+    pod = {"spec": {"schedulingGates": [{"name": GATE_NAME},
+                                        {"name": "other/gate"}]}}
+    assert is_gated(pod)
+    patch = ungate_patch(pod)
+    # Merge-patch preserves the foreign gate while removing ours.
+    assert patch == {"spec": {"schedulingGates": [{"name": "other/gate"}]}}
+    assert not is_gated({"spec": {}})
+
+
+# ---------------------------------------------------------------------------
+# placement.py: capacity parsing + contiguous fit
+# ---------------------------------------------------------------------------
+
+def test_parse_capacity_spec():
+    cap = parse_capacity("v5e=4x8, v4=2x2x4")
+    assert cap == {"v5e": (4, 8), "v4": (2, 2, 4)}
+    with pytest.raises(CapacityError):
+        parse_capacity("v99=4x4")
+
+
+def test_placement_rotation_fits_transposed_block():
+    placer = TopologyPlacer({"v5e": (2, 4)})
+    # A 4x2 request only fits the 2x4 mesh rotated.
+    got = placer.try_fit([SliceRequest("v5e", (4, 2), 8)])
+    assert got is not None and got[0].dims in ((2, 4), (4, 2))
+    assert got[0].chips == 8
+
+
+def test_placement_all_or_nothing_and_release():
+    placer = TopologyPlacer({"v5e": (2, 4)})
+    two = [SliceRequest("v5e", (2, 2), 4), SliceRequest("v5e", (2, 2), 4)]
+    placements = placer.try_fit(two)
+    assert placements is not None
+    placer.commit(placements)
+    assert placer.chips_in_use() == {"v5e": 8}
+    # Mesh is full: nothing more fits — and the failed fit must not leak
+    # tentative cells.
+    assert placer.try_fit([SliceRequest("v5e", (1, 1), 1)]) is None
+    assert placer.chips_in_use() == {"v5e": 8}
+    placer.release(placements[:1])
+    assert placer.try_fit([SliceRequest("v5e", (2, 2), 4)]) is not None
+
+
+def test_placement_unknown_generation_does_not_fit():
+    placer = TopologyPlacer({"v5e": (4, 4)})
+    assert placer.try_fit([SliceRequest("v4", (2, 2, 2), 8)]) is None
+
+
+def test_placement_unbounded_admits_everything():
+    placer = TopologyPlacer(None)
+    got = placer.try_fit([SliceRequest("v4", (8, 8, 8), 512)])
+    assert got is not None and placer.unbounded
+
+
+# ---------------------------------------------------------------------------
+# preemption.py: victim selection
+# ---------------------------------------------------------------------------
+
+def _committed(placer, gang):
+    placements = placer.try_fit(gang.slices)
+    assert placements is not None
+    gang.placements = placements
+    gang.state = STATE_ADMITTED
+    placer.commit(placements)
+    return gang
+
+
+def test_preemption_only_strictly_lower_priority():
+    placer = TopologyPlacer({"v4": (2, 2, 2)})
+    ledger = QuotaLedger()
+    equal = _committed(placer, mk_gang("equal", priority=100))
+    pending = mk_gang("pending", priority=100)
+    assert select_victims(pending, [equal], placer, ledger) is None
+
+
+def test_preemption_picks_minimal_youngest_lowest():
+    # Mesh fits two 2x2x2 slices; both are held by low-priority gangs.
+    placer = TopologyPlacer({"v4": (2, 2, 4)})
+    ledger = QuotaLedger()
+    old = _committed(placer, mk_gang("old-low", priority=-100))
+    old.admitted_at = 100.0
+    young = _committed(placer, mk_gang("young-low", priority=-100))
+    young.admitted_at = 200.0
+    ledger.charge(old)
+    ledger.charge(young)
+    pending = mk_gang("pending-high", priority=100)
+    victims = select_victims(pending, [old, young], placer, ledger)
+    # One eviction suffices; the youngest (cheapest to redo) is chosen.
+    assert [v.name for v in victims] == ["young-low"]
+
+
+def test_preemption_none_when_even_all_victims_insufficient():
+    placer = TopologyPlacer({"v4": (2, 2, 2)})
+    ledger = QuotaLedger()
+    low = _committed(placer, mk_gang("low", priority=-100))
+    ledger.charge(low)
+    # Pending wants more than the whole mesh: no victim set can help.
+    pending = mk_gang("huge", priority=100, dims=(4, 4, 4), chips=64)
+    assert select_victims(pending, [low], placer, ledger) is None
+
+
+# ---------------------------------------------------------------------------
+# core.py: the admission pipeline on the in-memory cluster
+# ---------------------------------------------------------------------------
+
+def mk_scheduler(client, capacity=None, quotas=None, aging=0.0):
+    wakes = []
+    sched = GangScheduler(
+        client,
+        SchedulerConfig(capacity=capacity, quotas=quotas or {},
+                        aging_rate=aging),
+        recorder=FakeRecorder(),
+    )
+    sched.attach(client, wakeup=wakes.append)
+    return sched, wakes
+
+
+def submit(client, job):
+    created = client.create(objects.TPUJOBS, job.to_dict())
+    job.metadata.resource_version = str(
+        objects.meta(created).get("resourceVersion", "")
+    )
+    job.metadata.uid = objects.uid_of(created) or job.metadata.uid
+    return job
+
+
+def test_unbounded_scheduler_admits_immediately():
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client)
+    job = submit(client, tpu_job("free"))
+    decision = sched.reconcile_gang(job)
+    assert decision.admitted and decision.state == STATE_ADMITTED
+    stored = client.get(objects.TPUJOBS, "default", "free")
+    assert stored["metadata"]["annotations"][ANNOTATION_STATE] == STATE_ADMITTED
+
+
+def test_capacity_queues_then_admits_on_release():
+    client = InMemoryCluster()
+    sched, wakes = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    first = submit(client, tpu_job("first"))
+    second = submit(client, tpu_job("second"))
+    assert sched.reconcile_gang(first).admitted
+    decision = sched.reconcile_gang(second)
+    assert not decision.admitted and decision.state == STATE_QUEUED
+    ann = client.get(objects.TPUJOBS, "default", "second")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+    # First job finishes: its capacity refund pumps the queue and wakes the
+    # controller for the newly admitted key.
+    sched.release_job(first.key)
+    assert "default/second" in wakes
+    assert sched.reconcile_gang(second).admitted
+    snap = sched.snapshot()
+    assert [g["key"] for g in snap["admitted"]] == ["default/second"]
+    assert snap["queued"] == []
+    assert snap["chipsInUse"] == {"v4": 8}
+
+
+def test_quota_blocks_admission_without_capacity_pressure():
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client, quotas={"default": Quota(chips=8)})
+    a = submit(client, tpu_job("qa"))
+    b = submit(client, tpu_job("qb"))
+    assert sched.reconcile_gang(a).admitted
+    # Unbounded fleet, but the namespace budget (8 chips) is spent.
+    assert not sched.reconcile_gang(b).admitted
+    sched.release_job(a.key)
+    assert sched.reconcile_gang(b).admitted
+
+
+def _create_gang_pods(client, job, gated=True):
+    """Pods as the controller's build_pod creates them (gate stamped)."""
+    pods = []
+    topo_pods = 2  # v4-8 = 2 hosts
+    for i in range(topo_pods):
+        pod = testutil.new_pod_for_job(job, "Worker", i, objects.PENDING)
+        if gated:
+            pod["spec"]["schedulingGates"] = [{"name": GATE_NAME}]
+        pod["metadata"]["labels"][constants.LABEL_JOB_NAME] = (
+            job.metadata.name
+        )
+        pods.append(client.create(objects.PODS, pod))
+    return pods
+
+
+def test_gated_pod_cannot_run_until_released():
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client)
+    job = submit(client, tpu_job("atomic"))
+    assert sched.reconcile_gang(job).admitted
+    _create_gang_pods(client, job)
+
+    # The store-level gate: a kubelet write of Running on a gated pod is
+    # refused — this is what makes a crash between create and release safe.
+    pod = client.list(objects.PODS, "default")[0]
+    objects.set_pod_phase(pod, objects.RUNNING)
+    with pytest.raises(Invalid):
+        client.update_status(objects.PODS, pod)
+    assert client.gate_rejections == 1
+
+    assert sched.release_gang(job)
+    pods = client.list(objects.PODS, "default")
+    assert pods and all(not is_gated(p) for p in pods)
+    # Released pods run normally.
+    objects.set_pod_phase(pods[0], objects.RUNNING)
+    client.update_status(objects.PODS, pods[0])
+
+
+def test_release_gang_waits_for_full_pod_set():
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client)
+    job = submit(client, tpu_job("straggler"))
+    assert sched.reconcile_gang(job).admitted
+    pod = testutil.new_pod_for_job(job, "Worker", 0, objects.PENDING)
+    pod["spec"]["schedulingGates"] = [{"name": GATE_NAME}]
+    pod["metadata"]["labels"][constants.LABEL_JOB_NAME] = job.metadata.name
+    client.create(objects.PODS, pod)
+    # 1 of 2 expected pods: release must refuse (all-pods-first rule).
+    assert not sched.release_gang(job)
+    assert all(is_gated(p) for p in client.list(objects.PODS, "default"))
+
+
+def test_admission_recovery_after_scheduler_restart():
+    client = InMemoryCluster()
+    sched1, _ = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    job = submit(client, tpu_job("survivor"))
+    assert sched1.reconcile_gang(job).admitted
+
+    # New scheduler incarnation (controller restart): the persisted
+    # admission is recovered — not re-queued — and the ledger is recharged
+    # so a competing gang still sees a full fleet.
+    sched2, _ = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    refetched = tpu_job("survivor")
+    refetched.metadata.annotations = dict(
+        client.get(objects.TPUJOBS, "default", "survivor")["metadata"][
+            "annotations"]
+    )
+    assert sched2.reconcile_gang(refetched).admitted
+    rival = submit(client, tpu_job("rival"))
+    assert not sched2.reconcile_gang(rival).admitted
+    assert sched2.snapshot()["chipsInUse"] == {"v4": 8}
+
+
+def test_preemption_evicts_whole_gang_and_requeues():
+    client = InMemoryCluster()
+    sched, wakes = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    low = submit(client, tpu_job("low", priority_class="low"))
+    assert sched.reconcile_gang(low).admitted
+    _create_gang_pods(client, low, gated=False)
+    assert len(client.list(objects.PODS, "default")) == 2
+
+    crit = submit(client, tpu_job("crit", priority_class="critical"))
+    decision = sched.reconcile_gang(crit)
+    assert decision.admitted, "preemption must admit within the same pass"
+    # The victim was evicted WHOLE and requeued as a gang.
+    assert client.list(objects.PODS, "default") == []
+    ann = client.get(objects.TPUJOBS, "default", "low")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+    assert ANNOTATION_PREEMPTED_AT in ann  # checkpoint signal landed
+    snap = sched.snapshot()
+    assert [g["key"] for g in snap["queued"]] == ["default/low"]
+    assert [g["key"] for g in snap["admitted"]] == ["default/crit"]
+    assert snap["queued"][0]["requeues"] == 1
+    assert "default/low" in wakes  # victim's controller key re-enqueued
+
+
+def test_preemption_disabled_leaves_victims_alone():
+    client = InMemoryCluster()
+    sched = GangScheduler(
+        client,
+        SchedulerConfig(capacity={"v4": (2, 2, 2)}, preemption=False),
+    )
+    low = submit(client, tpu_job("low2", priority_class="low"))
+    assert sched.reconcile_gang(low).admitted
+    crit = submit(client, tpu_job("crit2", priority_class="critical"))
+    assert not sched.reconcile_gang(crit).admitted
+    assert [g["key"] for g in sched.snapshot()["admitted"]] == [
+        "default/low2"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Controller integration: sync → gated create → same-pass release
+# ---------------------------------------------------------------------------
+
+def sync_once(tc, job):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(job.key)
+
+
+def test_controller_sync_creates_gated_then_releases_same_pass():
+    client = InMemoryCluster()
+    tc = TPUJobController(client, recorder=FakeRecorder())
+    job = submit(client, tpu_job("pipeline"))
+    sync_once(tc, job)
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2
+    # The unbounded default admits in the same pass, so the gates are
+    # already lifted — but they provably WERE stamped (release counted).
+    assert all(not is_gated(p) for p in pods)
+    ann = client.get(objects.TPUJOBS, "default", "pipeline")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_ADMITTED
+
+
+def test_queued_job_creates_no_pods_and_no_pdb():
+    client = InMemoryCluster()
+    sched = GangScheduler(config=SchedulerConfig(capacity={"v4": (2, 2, 2)}))
+    tc = TPUJobController(client, recorder=FakeRecorder(), scheduler=sched)
+    winner = submit(client, tpu_job("winner"))
+    loser = submit(client, tpu_job("loser"))  # same priority: queues
+    sync_once(tc, winner)
+    sync_once(tc, loser)
+    pods = client.list(objects.PODS, "default")
+    assert {p["metadata"]["labels"][constants.LABEL_JOB_NAME]
+            for p in pods} == {"winner"}
+    # Satellite: no orphan PDB for a never-admitted gang.
+    assert client.list(objects.PDBS, "default", {}) == [] or all(
+        p["metadata"]["name"] != "loser-gang"
+        for p in client.list(objects.PDBS, "default")
+    )
+    ann = client.get(objects.TPUJOBS, "default", "loser")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+
+
+def test_terminal_job_refunds_capacity_to_next_in_line():
+    client = InMemoryCluster()
+    sched = GangScheduler(config=SchedulerConfig(capacity={"v4": (2, 2, 2)}))
+    tc = TPUJobController(client, recorder=FakeRecorder(), scheduler=sched)
+    winner = submit(client, tpu_job("done-soon"))
+    waiter = submit(client, tpu_job("waiter"))
+    sync_once(tc, winner)
+    sync_once(tc, waiter)
+    assert not sched.reconcile_gang(waiter).admitted
+    # Drive the winner terminal: both slice pods succeed.
+    for pod in client.list(objects.PODS, "default"):
+        objects.set_pod_phase(pod, objects.SUCCEEDED)
+        objects.set_container_terminated(
+            pod, constants.DEFAULT_CONTAINER_NAME, 0
+        )
+        client.update_status(objects.PODS, pod)
+    sync_once(tc, winner)  # records Succeeded
+    sync_once(tc, winner)  # terminal path: release_job + cleanup
+    assert sched.reconcile_gang(waiter).admitted
+
+
+def test_admission_aborts_when_annotation_persist_fails():
+    """The admitted annotation must land BEFORE any in-memory commit: if
+    the persist fails the gang stays queued (and is retried), because an
+    admission that exists only in memory would read, after a crash, as a
+    queued gang with live pods — which recovery would evict."""
+    from tf_operator_tpu.runtime.client import ApiError
+
+    class FlakyCluster(InMemoryCluster):
+        fail_job_patches = False
+
+        def patch_merge(self, kind, namespace, name, patch):
+            if self.fail_job_patches and kind == objects.TPUJOBS:
+                raise ApiError("injected outage")
+            return super().patch_merge(kind, namespace, name, patch)
+
+    client = FlakyCluster()
+    sched, _ = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    job = submit(client, tpu_job("flaky"))
+    client.fail_job_patches = True
+    decision = sched.reconcile_gang(job)
+    assert not decision.admitted and decision.state == STATE_QUEUED
+    assert sched.snapshot()["chipsInUse"] == {"v4": 0}  # nothing committed
+    assert ANNOTATION_STATE not in client.get(
+        objects.TPUJOBS, "default", "flaky"
+    )["metadata"].get("annotations", {})
+    # Outage over: the next pump admits and persists atomically.
+    client.fail_job_patches = False
+    assert sched.reconcile_gang(job).admitted
+    assert client.get(objects.TPUJOBS, "default", "flaky")["metadata"][
+        "annotations"][ANNOTATION_STATE] == STATE_ADMITTED
+
+
+def test_blocked_aged_head_does_not_wedge_preemption_behind_it():
+    """An aged low-priority head that can neither place (fleet full) nor
+    preempt (no strictly-lower class running) must not block a critical
+    gang behind it from preempting — free capacity stays reserved for the
+    head, but eviction brings its own."""
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client, capacity={"v4": (2, 2, 2)}, aging=1000.0)
+    runner = submit(client, tpu_job("runner", priority_class="high"))
+    assert sched.reconcile_gang(runner).admitted
+
+    aged = submit(client, tpu_job("aged", priority_class="low"))
+    assert not sched.reconcile_gang(aged).admitted
+    # Long wait: with aging 1000 pt/s the low gang's effective priority
+    # dwarfs even "critical" — it is unambiguously the queue head.
+    sched.queue.get("default/aged").enqueued_at -= 10.0
+
+    crit = submit(client, tpu_job("crit", priority_class="critical"))
+    assert sched.reconcile_gang(crit).admitted, (
+        "critical must preempt past the blocked aged head"
+    )
+    snap = sched.snapshot()
+    assert [g["key"] for g in snap["admitted"]] == ["default/crit"]
+    assert {g["key"] for g in snap["queued"]} == {
+        "default/aged", "default/runner"
+    }
+    # And the aged head really was first in service order.
+    assert snap["queued"][0]["key"] == "default/aged"
+
+
+def test_select_victims_never_evicts_when_free_capacity_suffices():
+    placer = TopologyPlacer({"v4": (2, 2, 4)})  # room for two v4-8 blocks
+    ledger = QuotaLedger()
+    victim = mk_gang("occupant", priority=-100)
+    victim.placements = placer.try_fit(victim.slices)
+    placer.commit(victim.placements)
+    pending = mk_gang("newcomer", priority=100)
+    # Half the mesh is still free: no eviction may be proposed.
+    assert select_victims(pending, [victim], placer, ledger) is None
+
+
+def test_gated_pod_rejects_failed_phase_too():
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client)
+    job = submit(client, tpu_job("nofail"))
+    assert sched.reconcile_gang(job).admitted
+    _create_gang_pods(client, job)
+    pod = client.list(objects.PODS, "default")[0]
+    objects.set_pod_phase(pod, objects.FAILED)
+    # A gated pod never ran; accepting Failed would burn restart budget
+    # on a slice that never executed an instruction.
+    with pytest.raises(Invalid):
+        client.update_status(objects.PODS, pod)
+
+
+def test_infeasible_gang_never_wedges_the_queue():
+    """A job that can NEVER fit (generation not in the declared fleet, or
+    request over the namespace's whole quota) must not become a permanent
+    head-of-line blocker for feasible work behind it."""
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(
+        client,
+        capacity={"v4": (2, 2, 2)},
+        quotas={"capped": Quota(chips=4)},
+    )
+    # Highest priority, but targets a generation this fleet doesn't have.
+    ghost = submit(client, tpu_job("ghost", accel="v5e-16",
+                                   priority_class="critical"))
+    assert not sched.reconcile_gang(ghost).admitted
+    # And one whose 8-chip request exceeds its namespace's WHOLE 4-chip
+    # quota — infeasible however much capacity frees up.
+    glutton = submit(client, tpu_job("glutton", ns="capped"))
+    assert not sched.reconcile_gang(glutton).admitted
+    # A feasible gang behind both still admits — the pump passes over the
+    # infeasible heads instead of stopping at them.
+    worker = submit(client, tpu_job("worker"))
+    assert sched.reconcile_gang(worker).admitted
+    queued = {g["key"]: g for g in sched.snapshot()["queued"]}
+    assert set(queued) == {"default/ghost", "capped/glutton"}
+    assert all(g.get("infeasible") for g in queued.values())
+
+
+def test_template_scheduling_gates_survive_gang_gate():
+    """A template's own gates (external admission control) ride along with
+    the gang gate at creation and SURVIVE the gang release."""
+    client = InMemoryCluster()
+    tc = TPUJobController(client, recorder=FakeRecorder())
+    job = tpu_job("guarded")
+    job.spec.replica_specs["Worker"].template["spec"]["schedulingGates"] = [
+        {"name": "example.com/budget-approval"}
+    ]
+    submit(client, job)
+    sync_once(tc, job)
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2
+    # Gang gate lifted (unbounded fleet admits same-pass); user gate kept.
+    assert all(not is_gated(p) for p in pods)
+    assert all(is_gated(p, "example.com/budget-approval") for p in pods)
+
+
+def test_interrupted_eviction_cleanup_on_queued_gang_with_pods():
+    """Crash between the scheduler's state=queued persist and the eviction
+    deletion loop: the successor controller finds a QUEUED gang that still
+    has pods and finishes the eviction (a queued gang must leave zero
+    footprint — its chips are no longer charged in the ledger)."""
+    client = InMemoryCluster()
+    sched = GangScheduler(config=SchedulerConfig(capacity={"v4": (2, 2, 2)}))
+    tc = TPUJobController(client, recorder=FakeRecorder(), scheduler=sched)
+    winner = submit(client, tpu_job("winner"))
+    sync_once(tc, winner)  # fleet now fully held by the winner
+
+    victim = tpu_job("victim")
+    victim.metadata.annotations = {
+        ANNOTATION_STATE: STATE_QUEUED,
+        ANNOTATION_PREEMPTED_AT: "2026-01-01T00:00:00Z",
+    }
+    submit(client, victim)
+    _create_gang_pods(client, victim, gated=False)  # the half-dead leftovers
+
+    sync_once(tc, victim)
+    leftover = [
+        p for p in client.list(objects.PODS, "default")
+        if p["metadata"]["labels"][constants.LABEL_JOB_NAME] == "victim"
+    ]
+    assert leftover == []
+    ann = client.get(objects.TPUJOBS, "default", "victim")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+
+
+def test_release_gang_not_relisted_in_steady_state():
+    """Once every pod exists ungated, further syncs must not re-enter
+    release_gang (each call is a pod LIST under the scheduler lock)."""
+    client = InMemoryCluster()
+    tc = TPUJobController(client, recorder=FakeRecorder())
+    job = submit(client, tpu_job("steady"))
+    sync_once(tc, job)  # creates + releases
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+
+    calls = []
+    tc.scheduler.release_gang = lambda j: calls.append(j.key)
+    sync_once(tc, job)  # steady state: no gated pods, full set present
+    assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# Observability: /debug/scheduler + tpuctl queue + metric families
+# ---------------------------------------------------------------------------
+
+def test_debug_scheduler_endpoint_and_tpuctl_queue(capsys):
+    from tf_operator_tpu.cli import tpuctl
+    from tf_operator_tpu.runtime.apiserver import ApiServer
+    from tf_operator_tpu.runtime.observability import mount_observability
+
+    client = InMemoryCluster()
+    sched, _ = mk_scheduler(client, capacity={"v4": (2, 2, 2)})
+    admitted = submit(client, tpu_job("shown"))
+    queued = submit(client, tpu_job("waiting"))
+    assert sched.reconcile_gang(admitted).admitted
+    assert not sched.reconcile_gang(queued).admitted
+
+    server = ApiServer(client, host="127.0.0.1", port=0)
+    mount_observability(server, scheduler=sched)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        assert tpuctl.main(["--master", base, "queue"]) == 0
+        out = capsys.readouterr().out
+        assert "default/shown" in out and "default/waiting" in out
+        assert "CHIPS-TOTAL" in out
+        assert tpuctl.main(["--master", base, "queue", "-o", "json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["chipsInUse"] == {"v4": 8}
+        assert [g["key"] for g in snap["queued"]] == ["default/waiting"]
+    finally:
+        server.stop()
+
+
+def test_scheduler_metric_families_exported():
+    from tf_operator_tpu.runtime.metrics import REGISTRY
+
+    rendered = REGISTRY.render()
+    for family in (
+        "tpu_scheduler_queue_depth",
+        "tpu_scheduler_admitted_gangs",
+        "tpu_scheduler_admissions_total",
+        "tpu_scheduler_preemptions_total",
+        "tpu_scheduler_gate_releases_total",
+        "tpu_scheduler_admission_latency_seconds",
+    ):
+        assert family in rendered

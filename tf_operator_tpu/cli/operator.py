@@ -48,6 +48,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--enable-gang-scheduling", dest="gang", action="store_true",
                    default=True)
     p.add_argument("--disable-gang-scheduling", dest="gang", action="store_false")
+    # Gang-admission fleet declaration (scheduler/placement.py). Without it
+    # the admission pipeline still runs (gate → admit → release, so no
+    # partial slice can run) but every gang admits immediately; with it the
+    # scheduler arbitrates topology-contiguous placement on the declared
+    # meshes and queues what does not fit.
+    p.add_argument("--tpu-capacity", default=None, metavar="SPEC",
+                   help='installed fleet per generation, e.g. '
+                        '"v5e=16x16,v4=4x4x8" (default: unbounded)')
+    p.add_argument("--quota", action="append", default=[], metavar="NS=CHIPS[:SLICES]",
+                   help="per-namespace admission budget, repeatable, e.g. "
+                        "--quota team-a=64 --quota team-b=32:2")
+    p.add_argument("--scheduler-aging-rate", type=float, default=1.0,
+                   help="priority points gained per second queued "
+                        "(starvation valve; 0 disables aging)")
+    p.add_argument("--disable-preemption", dest="preemption",
+                   action="store_false", default=True,
+                   help="never evict lower-priority gangs to admit a "
+                        "higher-priority one")
     p.add_argument("--json-log", action="store_true", help="structured JSON logs")
     p.add_argument("--version", action="store_true", help="print version and exit")
     # Runtime wiring: the backing store is the in-process store (default),
@@ -175,6 +193,35 @@ def main(argv: list[str] | None = None) -> int:
 
         client = InMemoryCluster()
 
+    # --- gang admission scheduler ------------------------------------------
+    from tf_operator_tpu.scheduler import GangScheduler, Quota, SchedulerConfig
+    from tf_operator_tpu.scheduler.placement import CapacityError, parse_capacity
+
+    try:
+        capacity = parse_capacity(args.tpu_capacity) if args.tpu_capacity else None
+        quotas = {}
+        for spec in args.quota:
+            ns, _, budget = spec.partition("=")
+            if not ns or not budget:
+                raise CapacityError(
+                    f"--quota must be NS=CHIPS[:SLICES], got {spec!r}"
+                )
+            chips_s, _, slices_s = budget.partition(":")
+            quotas[ns.strip()] = Quota(
+                chips=int(chips_s),
+                slices=int(slices_s) if slices_s else None,
+            )
+    except (CapacityError, ValueError) as e:
+        log.error("bad scheduler flag: %s", e)
+        return 2
+    scheduler = GangScheduler(config=SchedulerConfig(
+        capacity=capacity,
+        quotas=quotas,
+        aging_rate=args.scheduler_aging_rate,
+        preemption=args.preemption,
+        gate_pods=args.gang,
+    ))
+
     api_server = None
     if args.serve is not None:
         if args.master:
@@ -208,7 +255,7 @@ def main(argv: list[str] | None = None) -> int:
         # unmatched GET, which would shadow /metrics with index.html.
         from tf_operator_tpu.runtime.observability import mount_observability
 
-        mount_observability(api_server)
+        mount_observability(api_server, scheduler=scheduler)
         if args.dashboard:
             from tf_operator_tpu.dashboard.backend import mount_dashboard
 
@@ -227,7 +274,7 @@ def main(argv: list[str] | None = None) -> int:
     extras: list[object] = []
 
     def run_controller(leading_stop: threading.Event) -> None:
-        controller = TPUJobController(client, cfg)
+        controller = TPUJobController(client, cfg, scheduler=scheduler)
         if args.local_executor:
             from tf_operator_tpu.runtime.executor import LocalProcessExecutor
             from tf_operator_tpu.runtime.gc import OwnerGarbageCollector

@@ -12,6 +12,7 @@ ctl. Commands mirror the kubectl verbs users already know:
     tpuctl delete NS/NAME
     tpuctl logs NS/POD [-f]                 # pod logs (stream with -f)
     tpuctl wait NS/NAME [--for Succeeded] [--timeout 300]
+    tpuctl queue [-o json]                  # gang-admission queue/capacity
 
 The server is ``--master`` / $TPU_OPERATOR_MASTER (default
 http://127.0.0.1:8080 — the operator's --serve address). Write auth rides
@@ -325,6 +326,75 @@ def cmd_logs(args, master: str) -> int:
     return 0
 
 
+def _gang_row(g: dict[str, Any]) -> list[str]:
+    return [
+        g.get("key", ""),
+        g.get("priorityClass", "default"),
+        g.get("chips", 0),
+        g.get("slices", 0),
+        g.get("pods", 0),
+        g.get("requeues", 0),
+        f"{g.get('waitedSeconds', 0):.0f}s",
+    ]
+
+
+def cmd_queue(args, master: str) -> int:
+    """Render /debug/scheduler: the gang-admission queue, admitted set,
+    fleet usage and per-namespace quota — the operator's answer to
+    `kubectl get queue` on a Volcano/Kueue cluster."""
+    url = f"{master.rstrip('/')}/debug/scheduler"
+    req = urllib.request.Request(url)
+    token = os.environ.get("TPU_OPERATOR_API_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            snap = json.loads(resp.read())
+    except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+        raise SystemExit(
+            f"tpuctl: scheduler snapshot unavailable ({e.code}) — is the "
+            "operator serving with gang admission enabled?"
+        ) from None
+    if args.output == "json":
+        print(json.dumps(snap, indent=2))
+        return 0
+    total = snap.get("chipsTotal") or {}
+    in_use = snap.get("chipsInUse") or {}
+    if total:
+        print("Fleet:")
+        print(_table(
+            [[gen, "x".join(str(d) for d in dims), in_use.get(gen, 0),
+              total[gen]]
+             for gen, dims in sorted((snap.get("capacity") or {}).items())],
+            ["GENERATION", "MESH", "CHIPS-USED", "CHIPS-TOTAL"],
+        ))
+    else:
+        print("Fleet: unbounded (no --tpu-capacity declared)")
+    usage = snap.get("quotaUsage") or {}
+    if usage:
+        print("\nQuota usage:")
+        print(_table(
+            [[ns, u.get("chips", 0), u.get("slices", 0)]
+             for ns, u in sorted(usage.items())],
+            ["NAMESPACE", "CHIPS", "SLICES"],
+        ))
+    header = ["GANG", "CLASS", "CHIPS", "SLICES", "PODS", "REQUEUES", "WAITED"]
+    print("\nAdmitted:")
+    admitted = snap.get("admitted") or []
+    print(_table([_gang_row(g) for g in admitted], header)
+          if admitted else "  none")
+    print("\nQueued (service order):")
+    queued = snap.get("queued") or []
+    if queued:
+        print(_table(
+            [_gang_row(g) + [g.get("effectivePriority", "")] for g in queued],
+            header + ["EFF-PRIORITY"],
+        ))
+    else:
+        print("  none")
+    return 0
+
+
 def cmd_wait(args, client: TPUJobClient) -> int:
     ns, name = _split_ref(args.ref)
     if args.condition == "Deleted":
@@ -405,9 +475,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="Succeeded | Failed | Running | Created | Deleted")
     w.add_argument("--timeout", type=float, default=300.0)
 
+    q = sub.add_parser("queue", help="gang-admission queue / fleet usage")
+    q.add_argument("-o", "--output", choices=("table", "json"),
+                   default="table")
+
     args = p.parse_args(argv)
     if args.cmd == "logs":
         return cmd_logs(args, args.master)
+    if args.cmd == "queue":
+        return cmd_queue(args, args.master)
     client = TPUJobClient(RestClusterClient(args.master))
     try:
         return {

@@ -220,6 +220,18 @@ class JobController:
             )
         self.enqueue(key)
 
+    def update_service(self, old: dict[str, Any], new: dict[str, Any]) -> None:
+        """Out-of-band service edits (port/selector drift) must re-enqueue
+        the owner so reconcile_services can repair the spec — the reference
+        leaves this handler a TODO stub (controller_service.go:224-228)."""
+        if objects.meta(old).get("resourceVersion") == objects.meta(new).get(
+            "resourceVersion"
+        ):
+            return
+        key = self._resolve_job_key(new) or self._resolve_job_key(old)
+        if key is not None:
+            self.enqueue(key)
+
     def delete_service(self, service: dict[str, Any]) -> None:
         key = self._resolve_job_key(service)
         if key is None:

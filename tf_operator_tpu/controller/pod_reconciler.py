@@ -96,6 +96,18 @@ class PodReconciler:
             tmpl_spec.setdefault("schedulerName", job.spec.scheduling.scheduler_name)
         if job.spec.scheduling.priority_class:
             tmpl_spec.setdefault("priorityClassName", job.spec.scheduling.priority_class)
+        # Gang admission: pods are born gated and released as one unit when
+        # the whole gang is admitted (scheduler/core.py). Recreated pods
+        # (slice restarts) re-gate and re-release the same way. Appended,
+        # not assigned: a template's own gates (external admission control)
+        # must survive — release_gang lifts only the gang gate.
+        gates = self.scheduling_gates(job)
+        if gates:
+            existing = tmpl_spec.get("schedulingGates") or []
+            present = {g.get("name") for g in existing}
+            tmpl_spec["schedulingGates"] = list(existing) + [
+                dict(g) for g in gates if g["name"] not in present
+            ]
 
         labels = replica_labels(job.metadata.name, rtype, index)
         meta = template.setdefault("metadata", {})

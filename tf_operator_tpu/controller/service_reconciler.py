@@ -96,6 +96,23 @@ class ServiceReconciler:
                 for dup in bucket[1:]:
                     if self._delete_service_expected(job, exp_key, objects.name_of(dup)):
                         summary["deleted"] += 1
+            # Spec-drift repair (VERDICT #5): a service whose selector or
+            # port no longer matches the desired build is a silently-broken
+            # rendezvous DNS name — every TF_CONFIG/TPU_WORKER_HOSTNAMES
+            # entry that resolves through it points at the wrong pod or
+            # port. Recreate rather than patch: ports+selector are the
+            # service's whole identity here, and delete-then-create reuses
+            # the expectation machinery duplicates already exercise.
+            observed = bucket[0]
+            if self._service_drifted(
+                observed, self.build_service(job, rtype, spec, index)
+            ):
+                if self._delete_service_expected(
+                    job, exp_key, objects.name_of(observed)
+                ):
+                    summary["deleted"] += 1
+                summary["repaired"] = summary.get("repaired", 0) + 1
+                to_create.append(index)
 
         if to_create:
             self.expectations.raise_expectations(exp_key, len(to_create), 0)
@@ -116,6 +133,30 @@ class ServiceReconciler:
                         self.expectations.creation_observed(exp_key)
                     raise
         return summary
+
+    @staticmethod
+    def _service_drifted(observed: dict[str, Any], desired: dict[str, Any]) -> bool:
+        """Whether the observed service's selector or ports diverge from the
+        desired build. Compares only the fields this controller owns —
+        cluster-assigned extras (clusterIP, ipFamilies, status) must not
+        read as drift."""
+        obs_spec = observed.get("spec", {}) or {}
+        des_spec = desired.get("spec", {}) or {}
+        if (obs_spec.get("selector") or {}) != (des_spec.get("selector") or {}):
+            return True
+
+        def _ports(spec: dict[str, Any]) -> list[tuple]:
+            return sorted(
+                (
+                    p.get("name", ""),
+                    p.get("port"),
+                    p.get("targetPort", p.get("port")),
+                    p.get("protocol", "TCP"),
+                )
+                for p in spec.get("ports", []) or []
+            )
+
+        return _ports(obs_spec) != _ports(des_spec)
 
     def _delete_service_expected(self, job: TPUJob, exp_key: str, name: str) -> bool:
         from tf_operator_tpu.runtime.client import NotFound

@@ -45,6 +45,8 @@ from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ClusterClient, Conflict, NotFound
 from tf_operator_tpu.runtime.metrics import REGISTRY
 from tf_operator_tpu.runtime.tracing import TRACER
+from tf_operator_tpu.scheduler import GangScheduler
+from tf_operator_tpu.scheduler.gang import is_gated
 from tf_operator_tpu.utils import logger
 from tf_operator_tpu.utils.times import parse_rfc3339
 
@@ -75,6 +77,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         pod_control: PodControlInterface | None = None,
         service_control: ServiceControlInterface | None = None,
         recorder: ev.EventRecorder | None = None,
+        scheduler: GangScheduler | None = None,
     ) -> None:
         recorder = recorder or ev.EventRecorder(client)
         super().__init__(
@@ -84,6 +87,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             recorder,
             config,
         )
+        # Gang admission authority (scheduler/core.py). The operator main
+        # may pass a capacity/quota-configured instance; the default is an
+        # unbounded fleet, which still runs the full gate → admit → release
+        # pipeline so no partial slice can ever run.
+        self.scheduler = scheduler or GangScheduler()
+        self.scheduler.attach(client, recorder, wakeup=self.enqueue)
         self.job_informer = Informer(
             client, objects.TPUJOBS, self.config.namespace, self.config.informer_resync
         )
@@ -98,7 +107,11 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             )
         )
         self.service_informer.add_event_handlers(
-            EventHandlers(on_add=self.add_service, on_delete=self.delete_service)
+            EventHandlers(
+                on_add=self.add_service,
+                on_update=self.update_service,
+                on_delete=self.delete_service,
+            )
         )
         # Test seams (tfcontroller.go:84-90 exposes syncHandler etc. for the
         # tier-2 harness).
@@ -158,6 +171,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         key = f"{objects.namespace_of(obj)}/{objects.name_of(obj)}"
         self._terminal_recorded.pop(key, None)
         self._restart_floor.pop(key, None)
+        self.scheduler.release_job(key)
         for rtype in ReplicaType.ALL:
             self.expectations.delete_expectations(
                 self.expectation_key(key, rtype, "pods")
@@ -256,6 +270,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         )
         return requeue
 
+    def scheduling_gates(self, job: TPUJob) -> list[dict[str, str]]:
+        """Admission gates stamped on every pod at creation (build_pod)."""
+        if not self.config.enable_gang_scheduling:
+            return []
+        return self.scheduler.gates_for(job)
+
     def reconcile_job(self, job: TPUJob) -> bool:
         ref = self._controller_ref(job)
         pods = self.get_pods_for_job(job, ref)
@@ -264,13 +284,54 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         status_before = job.status.to_dict()
 
         if status_engine.is_finished(job.status):
+            self.scheduler.release_job(job.key)
             self.delete_pods_and_services(job, pods, services)
             self.delete_pdb(job)
             return self.cleanup_job(job)
 
-        if self.config.enable_gang_scheduling and job.spec.scheduling.gang:
-            total = sum(r.replicas or 0 for r in job.spec.replica_specs.values())
-            self.sync_pdb(job, total)
+        # Gang admission: every live job is arbitrated as one all-or-nothing
+        # unit BEFORE any pod exists. A queued gang creates nothing — its
+        # pods, services and PDB all wait for admission, so an unadmitted
+        # job leaves zero footprint to deadlock or leak (VERDICT #3/#5).
+        admitted = True
+        total_replicas = sum(
+            r.replicas or 0 for r in job.spec.replica_specs.values()
+        )
+        if self.config.enable_gang_scheduling:
+            decision = self.scheduler.reconcile_gang(job, has_pods=bool(pods))
+            admitted = decision.admitted
+
+        if (
+            self.config.enable_gang_scheduling
+            and job.spec.scheduling.gang
+            and admitted
+        ):
+            self.sync_pdb(job, total_replicas)
+
+        if not admitted:
+            if pods:
+                # A queued gang with pods is an interrupted preemption (the
+                # scheduler persisted state=queued, then the controller died
+                # before the deletion loop finished): finish the eviction —
+                # a queued gang must leave zero footprint, and half a slice
+                # left running would occupy chips the ledger no longer
+                # charges for.
+                for pod in pods:
+                    try:
+                        self.pod_control.delete_pod(
+                            job.metadata.namespace,
+                            objects.name_of(pod),
+                            job.to_dict(),
+                        )
+                    except NotFound:
+                        pass
+                return True
+            # Waiting in the admission queue: record observation time only;
+            # the scheduler wakes this key the moment capacity frees up,
+            # and the periodic resync re-pumps the queue meanwhile (aging).
+            self.update_job_status(job, pods, False, False)
+            self._maybe_write_status(job, status_before)
+            return True
 
         # Monotonic rebase BEFORE reconciling: this controller is the sole
         # writer of restart_count, but the informer cache can be one status
@@ -298,7 +359,20 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         if restarts:
             self._restart_floor[job.key] = job.status.restart_count
             RESTARTS_TOTAL.inc(restarts)
+        if admitted and self.config.enable_gang_scheduling:
+            # Every expected pod now exists (or this pass just created the
+            # stragglers): lift the gates as one unit. Runs on any sync
+            # whose cached view still shows gated or missing pods, so a
+            # crash between create and release is finished by the next pass
+            # (or the next controller incarnation) rather than wedging —
+            # while a fully released steady-state gang skips the relist
+            # release_gang would otherwise pay every sync.
+            if len(pods) < total_replicas or any(is_gated(p) for p in pods):
+                self.scheduler.release_gang(job)
         self.update_job_status(job, pods, restarting, permanent_failure)
+        return self._maybe_write_status(job, status_before)
+
+    def _maybe_write_status(self, job: TPUJob, status_before: dict) -> bool:
         # Skip-unchanged guard (the standard controller idiom): a status
         # write ALWAYS emits a job MODIFIED watch event, which re-enqueues
         # this very sync — without the guard every no-op pass re-stamps

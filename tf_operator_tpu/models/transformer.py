@@ -23,6 +23,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from tf_operator_tpu import parallel as parallel_compat
+
 from tf_operator_tpu.ops import attention as device_attention
 from tf_operator_tpu.parallel.ring_attention import (
     _use_flash_blocks,
@@ -303,7 +305,7 @@ class Attention(nn.Module):
             hspec = cfg.tp_axis if tp > 1 and cfg.n_heads % tp == 0 else None
             if bspec or hspec:
                 spec = jax.sharding.PartitionSpec(bspec, None, hspec, None)
-                out = jax.shard_map(
+                out = parallel_compat.shard_map(
                     lambda q, k, v: device_attention(q, k, v, causal=True),
                     mesh=mesh,
                     in_specs=(spec, spec, spec),

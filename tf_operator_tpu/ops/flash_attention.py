@@ -320,7 +320,11 @@ def _spec_y(blk, d, *, clamp=None):
 
 # Shared grid contract: (batch, head) and the x block dim parallel; the
 # innermost streamed dim sequential so scratch accumulators carry across it.
-_COMPILER_PARAMS = pltpu.CompilerParams(
+# jax renamed TPUCompilerParams -> CompilerParams (~0.4.3x); accept both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+_COMPILER_PARAMS = _CompilerParams(
     dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
 )
 

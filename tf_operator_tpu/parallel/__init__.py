@@ -1,0 +1,20 @@
+"""Parallelism primitives (mesh, sharding, ring/ulysses attention, pipeline).
+
+Also the jax version-compat seam: `shard_map` was promoted from
+`jax.experimental.shard_map` to `jax.shard_map` (and its `check_rep` kwarg
+renamed `check_vma`) around 0.5/0.6; the graft toolchain pins 0.4.x. Import
+it from here so every caller — written against the modern spelling — runs
+on both.
+"""
+
+import jax as _jax
+
+try:
+    shard_map = _jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, /, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _legacy_shard_map(f, *args, **kwargs)

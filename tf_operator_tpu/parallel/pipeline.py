@@ -28,6 +28,8 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from tf_operator_tpu import parallel as parallel_compat
+
 
 def stack_stage_params(param_list: list[Any]) -> Any:
     """Stack per-stage param pytrees into one pytree with leading stage dim."""
@@ -89,7 +91,7 @@ def pipeline_apply(
         return lax.psum(valid, axis)
 
     data_spec = P(None, batch_axis) if batch_axis else P()
-    return jax.shard_map(
+    return parallel_compat.shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), data_spec),
@@ -244,7 +246,7 @@ def pipeline_value_and_grad(
             return (loss, jax.tree.map(lambda a: a[None], gp), gl, dx_out)
 
         data_spec = P(None, batch_axis) if batch_axis else P()
-        return jax.shard_map(
+        return parallel_compat.shard_map(
             local,
             mesh=mesh,
             in_specs=(P(axis), P(), data_spec, data_spec),

@@ -30,6 +30,8 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from tf_operator_tpu import parallel as parallel_compat
+
 _NEG_INF = -1e30  # finite "masked" value: keeps the streaming max NaN-free
 
 
@@ -154,7 +156,7 @@ def ring_attention(
         _ring_attention_local, axis_name=seq_axis, causal=causal, scale=scale,
         kv_chunk=kv_chunk,
     )
-    return jax.shard_map(
+    return parallel_compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -430,7 +432,7 @@ def ring_flash_attention(
     spec = P(*batch_spec, seq_axis, *head_spec, None)
     body = _make_ring_flash_local(seq_axis, causal, float(scale),
                                   bool(use_kernel))
-    return jax.shard_map(
+    return parallel_compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

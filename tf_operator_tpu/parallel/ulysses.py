@@ -34,6 +34,8 @@ from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from tf_operator_tpu import parallel as parallel_compat
+
 
 def _ulysses_local(q, k, v, *, seq_axis: str, causal: bool,
                    scale: float | None, use_flash: bool | None):
@@ -98,7 +100,7 @@ def ulysses_attention(
         _ulysses_local, seq_axis=seq_axis, causal=causal, scale=scale,
         use_flash=use_flash,
     )
-    return jax.shard_map(
+    return parallel_compat.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -34,7 +34,13 @@ from typing import Any
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.runtime import objects, podlogs
-from tf_operator_tpu.runtime.client import ADDED, DELETED, ClusterClient, NotFound
+from tf_operator_tpu.runtime.client import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    ClusterClient,
+    NotFound,
+)
 from tf_operator_tpu.utils import logger
 
 
@@ -135,6 +141,15 @@ class LocalProcessExecutor:
                 continue
             if event.type == ADDED:
                 self._on_added(event.object)
+            elif event.type == MODIFIED:
+                # The one spec mutation that changes runnability: the gang
+                # scheduler lifting the admission gate. A pod that arrived
+                # gated launches on this event instead of ADDED. Pending-only:
+                # every other MODIFIED is a status echo (Running/terminal
+                # writes, possibly processed after the process was reaped),
+                # and launching on one would re-run a finished pod.
+                if objects.pod_phase(event.object) == objects.PENDING:
+                    self._on_added(event.object)
             elif event.type == DELETED:
                 self._on_deleted(event.object)
         watch.stop()
@@ -219,6 +234,11 @@ class LocalProcessExecutor:
         return value
 
     def _on_added(self, pod: dict[str, Any]) -> None:
+        if pod.get("spec", {}).get("schedulingGates"):
+            # Gang-gated: this kubelet must not run the pod (real kubelets
+            # never see gated pods at all — the scheduler won't bind them).
+            # The gate-lifting MODIFIED event re-enters here and launches.
+            return
         key = objects.key_of(pod)
         uid = objects.uid_of(pod)
         with self._lock:

@@ -253,7 +253,18 @@ class _Handler(JsonHandlerMixin, BaseHTTPRequestHandler):
         try:
             obj = self._read_body()
             if route.subresource == "status":
-                self._send_json(self.server.cluster.update_status(route.kind, obj))
+                try:
+                    self._send_json(
+                        self.server.cluster.update_status(route.kind, obj)
+                    )
+                except Invalid:
+                    # Scheduling-gate enforcement (memcluster) surfacing at
+                    # the wire as a 422, the way a real apiserver's
+                    # admission would refuse an impossible kubelet write.
+                    # Counted so gang-chaos tests can assert the gate was
+                    # actually exercised over HTTP.
+                    self.server.gate_422s_served += 1
+                    raise
             elif route.subresource is None:
                 with self.server.mutation_lock(route.kind):
                     self._validate(route.kind, obj)
@@ -450,6 +461,9 @@ class KubeApiStub(ThreadingHTTPServer):
         # 410 ERROR events served to watch resumes (bookmark tests assert
         # this stays 0: a bookmark-advanced RV never needs the relist).
         self.watch_410s_served = 0
+        # Pod status writes refused with 422 because the pod still carried
+        # a scheduling gate (gang admission not released yet).
+        self.gate_422s_served = 0
 
     def kill_watches(self) -> int:
         """Abruptly sever every active watch connection (RST-style), as a

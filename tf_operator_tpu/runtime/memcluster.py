@@ -30,6 +30,7 @@ from tf_operator_tpu.runtime.client import (
     AlreadyExists,
     ClusterClient,
     Conflict,
+    Invalid,
     NotFound,
     Watch,
     WatchEvent,
@@ -44,6 +45,29 @@ def _matches(selector: dict[str, str] | None, obj: dict[str, Any]) -> bool:
     return all(labels.get(k) == v for k, v in selector.items())
 
 
+def _gates_of(pod: dict[str, Any]) -> list[dict[str, Any]]:
+    return pod.get("spec", {}).get("schedulingGates", []) or []
+
+
+def _check_scheduling_gate(current: dict[str, Any], new_status: dict[str, Any]) -> None:
+    """K8s semantics for spec.schedulingGates, enforced at the store: a
+    gated pod is never scheduled, so no kubelet can legally report it
+    Running (or terminal-by-execution). Rejecting the write here is what
+    makes gang admission crash-safe — a controller dying between "pods
+    created" and "gates released" leaves pods that CANNOT run, not a
+    half-started slice (the deadlock the gang scheduler exists to prevent).
+    """
+    if not _gates_of(current):
+        return
+    phase = (new_status or {}).get("phase")
+    if phase in (objects.RUNNING, objects.SUCCEEDED, objects.FAILED):
+        gates = ",".join(g.get("name", "?") for g in _gates_of(current))
+        raise Invalid(
+            f"pod {objects.key_of(current)} has scheduling gates [{gates}] "
+            f"and cannot transition to {phase}"
+        )
+
+
 class InMemoryCluster(ClusterClient):
     def __init__(self) -> None:
         self._lock = threading.RLock()
@@ -52,6 +76,10 @@ class InMemoryCluster(ClusterClient):
         self._store: dict[str, dict[str, dict[str, dict[str, Any]]]] = {}
         # (kind, namespace|None) watchers
         self._watchers: list[tuple[str, str | None, Watch]] = []
+        # Status writes refused because the pod still carried a scheduling
+        # gate — chaos tests assert this is busy (the fake kubelet really
+        # hammered the gate) while no gated pod ever ran.
+        self.gate_rejections = 0
 
     # -- internals -----------------------------------------------------------
 
@@ -136,6 +164,12 @@ class InMemoryCluster(ClusterClient):
                     f"{kind} {ns}/{name}: resourceVersion {sent_rv} is stale (now {cur_rv})"
                 )
             if status_only:
+                if kind == objects.PODS:
+                    try:
+                        _check_scheduling_gate(current, obj.get("status", {}))
+                    except Invalid:
+                        self.gate_rejections += 1
+                        raise
                 updated = copy.deepcopy(current)
                 updated["status"] = copy.deepcopy(obj.get("status", {}))
             else:
@@ -168,6 +202,34 @@ class InMemoryCluster(ClusterClient):
             coll[name] = merged
             self._broadcast(kind, MODIFIED, merged)
             return copy.deepcopy(merged)
+
+    def ungate_pods(
+        self, namespace: str, names: list[str], gate: str
+    ) -> list[dict[str, Any]]:
+        """Remove one scheduling gate from a set of pods in a SINGLE store
+        transaction: every pod flips runnable under the same lock hold, so
+        no observer (kubelet, informer, chaos probe) can see a gang whose
+        members straddle the gate. This is the atomic gang release the
+        scheduler uses on the in-memory backend; wire backends fall back to
+        per-pod patches (see scheduler/core.py release_gang).
+        """
+        updated: list[dict[str, Any]] = []
+        with self._lock:
+            coll = self._coll(objects.PODS, namespace)
+            for name in names:
+                pod = coll.get(name)
+                if pod is None:
+                    continue
+                gates = _gates_of(pod)
+                remaining = [g for g in gates if g.get("name") != gate]
+                if len(remaining) == len(gates):
+                    continue
+                pod.setdefault("spec", {})["schedulingGates"] = remaining
+                objects.meta(pod)["resourceVersion"] = self._next_rv()
+                updated.append(copy.deepcopy(pod))
+            for pod in updated:
+                self._broadcast(objects.PODS, MODIFIED, pod)
+        return updated
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:

@@ -180,15 +180,19 @@ class Histogram(_Family):
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += s["counts"][i]
+                # The le label is built outside the f-string: a backslash in
+                # an f-string expression part is a SyntaxError before 3.12.
+                le = 'le="%s"' % _fmt_value(b)
                 out.append(
                     f"{self.name}_bucket"
-                    f"{_fmt_labels(self.labelnames, key, f'le=\"{_fmt_value(b)}\"')}"
+                    f"{_fmt_labels(self.labelnames, key, le)}"
                     f" {cum}"
                 )
             cum += s["counts"][-1]
+            inf = 'le="+Inf"'
             out.append(
                 f"{self.name}_bucket"
-                f"{_fmt_labels(self.labelnames, key, 'le=\"+Inf\"')} {cum}"
+                f"{_fmt_labels(self.labelnames, key, inf)} {cum}"
             )
             out.append(
                 f"{self.name}_sum{_fmt_labels(self.labelnames, key)} "
@@ -258,3 +262,39 @@ class Registry:
 
 
 REGISTRY = Registry()
+
+
+# ---------------------------------------------------------------------------
+# Gang-scheduler metric families (consumed by tf_operator_tpu/scheduler/).
+# Declared here rather than in the scheduler so every process that imports
+# the registry exposes the full schema on /metrics from the first scrape —
+# a dashboard pointed at a freshly-started, still-idle operator sees the
+# queue series at 0 instead of absent.
+# ---------------------------------------------------------------------------
+
+SCHED_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_scheduler_queue_depth", "Gangs waiting for admission",
+)
+SCHED_ADMITTED_GANGS = REGISTRY.gauge(
+    "tpu_scheduler_admitted_gangs", "Gangs currently holding capacity",
+)
+SCHED_CHIPS_IN_USE = REGISTRY.gauge(
+    "tpu_scheduler_chips_in_use",
+    "TPU chips committed to admitted gangs", ("generation",),
+)
+SCHED_ADMISSION_SECONDS = REGISTRY.histogram(
+    "tpu_scheduler_admission_latency_seconds",
+    "Enqueue-to-admission wall time per gang",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+             1800.0),
+)
+SCHED_ADMISSIONS_TOTAL = REGISTRY.counter(
+    "tpu_scheduler_admissions_total", "Gang admissions",
+)
+SCHED_PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "tpu_scheduler_preemptions_total", "Whole-gang preemption evictions",
+)
+SCHED_RELEASES_TOTAL = REGISTRY.counter(
+    "tpu_scheduler_gate_releases_total",
+    "Pods whose admission gate was lifted",
+)

@@ -1,13 +1,16 @@
-"""/metrics (Prometheus text) and /debug/traces (Chrome trace) endpoints.
+"""/metrics (Prometheus text), /debug/traces (Chrome trace) and
+/debug/scheduler (gang-admission snapshot) endpoints.
 
 Mounts on the operator's ApiServer via its extra-handler hook (the same
 mechanism the dashboard uses). The reference exposes neither metrics nor
 traces (SURVEY.md §5); here every operator process is scrapeable and
-traceable out of the box.
+traceable out of the box, and the admission queue (scheduler/core.py) is
+inspectable live — `tpuctl queue` renders /debug/scheduler.
 """
 
 from __future__ import annotations
 
+import json
 from typing import Any
 
 from tf_operator_tpu.runtime.metrics import REGISTRY, Registry
@@ -18,9 +21,15 @@ LOG = logger.with_fields(component="observability")
 
 
 class ObservabilityHandler:
-    def __init__(self, registry: Registry = REGISTRY, tracer: Tracer = TRACER):
+    def __init__(
+        self,
+        registry: Registry = REGISTRY,
+        tracer: Tracer = TRACER,
+        scheduler: Any | None = None,
+    ):
         self._registry = registry
         self._tracer = tracer
+        self._scheduler = scheduler
 
     def __call__(self, req: Any) -> bool:
         path = req.path.split("?", 1)[0]
@@ -31,6 +40,9 @@ class ObservabilityHandler:
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/debug/traces":
             body = self._tracer.export_chrome_trace().encode()
+            ctype = "application/json"
+        elif path == "/debug/scheduler" and self._scheduler is not None:
+            body = json.dumps(self._scheduler.snapshot(), indent=2).encode()
             ctype = "application/json"
         else:
             return False
@@ -43,8 +55,12 @@ class ObservabilityHandler:
 
 
 def mount_observability(api_server: Any, registry: Registry = REGISTRY,
-                        tracer: Tracer = TRACER) -> ObservabilityHandler:
-    handler = ObservabilityHandler(registry, tracer)
+                        tracer: Tracer = TRACER,
+                        scheduler: Any | None = None) -> ObservabilityHandler:
+    handler = ObservabilityHandler(registry, tracer, scheduler)
     api_server.add_handler(handler)
-    LOG.info("observability mounted at /metrics and /debug/traces")
+    LOG.info(
+        "observability mounted at /metrics and /debug/traces%s",
+        " and /debug/scheduler" if scheduler is not None else "",
+    )
     return handler

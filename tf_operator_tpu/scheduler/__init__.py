@@ -1,0 +1,40 @@
+"""Slice-aware gang scheduling: all-or-nothing admission, quota, priority,
+preemption. See docs/scheduler.md for the pipeline walkthrough."""
+
+from tf_operator_tpu.scheduler.core import (
+    AdmissionDecision,
+    GangScheduler,
+    SchedulerConfig,
+)
+from tf_operator_tpu.scheduler.gang import (
+    GATE_NAME,
+    Gang,
+    gang_from_job,
+    is_gated,
+    resolve_priority,
+)
+from tf_operator_tpu.scheduler.placement import (
+    Placement,
+    TopologyPlacer,
+    parse_capacity,
+)
+from tf_operator_tpu.scheduler.preemption import select_victims
+from tf_operator_tpu.scheduler.queue import AdmissionQueue, Quota, QuotaLedger
+
+__all__ = [
+    "AdmissionDecision",
+    "AdmissionQueue",
+    "GATE_NAME",
+    "Gang",
+    "GangScheduler",
+    "Placement",
+    "Quota",
+    "QuotaLedger",
+    "SchedulerConfig",
+    "TopologyPlacer",
+    "gang_from_job",
+    "is_gated",
+    "parse_capacity",
+    "resolve_priority",
+    "select_victims",
+]

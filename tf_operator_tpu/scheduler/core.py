@@ -1,0 +1,636 @@
+"""GangScheduler: the cluster-level admission authority.
+
+Sits between job reconciliation and pod creation. The controller asks it
+three questions per sync:
+
+- ``reconcile_gang(job)``: is this job's gang admitted? (registers new
+  gangs, recovers persisted decisions after a controller restart, and
+  pumps the queue — admitting / preempting as capacity allows);
+- ``release_gang(job)``: every slice pod now exists — atomically lift
+  the scheduling gates so the whole gang becomes runnable at once;
+- ``release_job(key)``: the job is terminal or deleted — refund its
+  capacity and quota and forget the gang.
+
+Crash consistency: the admission decision is persisted on the job
+(annotations in gang.py) BEFORE any gate is lifted. A controller dying
+anywhere in the pipeline leaves one of two recoverable worlds: gang not
+admitted (all pods gated — the backends refuse to run them) or admitted
+(recovery re-reads the annotation, recharges the ledger from the
+persisted placements, and finishes the release). There is no world in
+which a strict subset of a slice can run while the rest cannot.
+
+The in-memory queue/ledger are authoritative while the scheduler lives;
+annotations exist for recovery, the CLI (`tpuctl queue`), and operators
+reading raw job objects.
+
+Lock scope: release/evict perform store I/O while holding the scheduler
+lock. That serializes concurrent syncs against one slow apiserver call —
+accepted for now because arbitration correctness depends on the ledger
+not changing between fit-check and commit, the controller's sync loop is
+already serialized per key, and the steady-state release relist is
+skipped at the call site (reconcile_job only re-enters release_gang while
+gated or missing pods are visible). Moving the wire calls outside the
+lock (decide under lock, act outside, re-validate on re-entry) is the
+known next step if multi-sync threadiness lands.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient, NotFound
+from tf_operator_tpu.runtime.metrics import (
+    SCHED_ADMISSION_SECONDS,
+    SCHED_ADMISSIONS_TOTAL,
+    SCHED_ADMITTED_GANGS,
+    SCHED_CHIPS_IN_USE,
+    SCHED_PREEMPTIONS_TOTAL,
+    SCHED_QUEUE_DEPTH,
+    SCHED_RELEASES_TOTAL,
+)
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_ADMITTED_AT,
+    ANNOTATION_CHIPS,
+    ANNOTATION_ENQUEUED_AT,
+    ANNOTATION_PLACEMENTS,
+    ANNOTATION_PREEMPTED_AT,
+    ANNOTATION_STATE,
+    DEFAULT_PRIORITY_CLASSES,
+    GATE_NAME,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    Gang,
+    gang_from_job,
+    is_gated,
+    ungate_patch,
+)
+from tf_operator_tpu.scheduler.placement import Placement, TopologyPlacer
+from tf_operator_tpu.scheduler.preemption import select_victims
+from tf_operator_tpu.scheduler.queue import AdmissionQueue, Quota, QuotaLedger
+from tf_operator_tpu.utils import logger
+
+EVENT_GANG_QUEUED = "GangQueued"
+EVENT_GANG_ADMITTED = "GangAdmitted"
+EVENT_GANG_RELEASED = "GangReleased"
+EVENT_PREEMPTED = "GangPreempted"
+EVENT_UNSCHEDULABLE = "GangUnschedulable"
+
+
+@dataclass
+class SchedulerConfig:
+    # Installed fleet per generation, e.g. {"v5e": (16, 16)}. None =
+    # unbounded virtual fleet: every gang admits immediately (the gate →
+    # admit → release pipeline still runs, so partial-slice protection
+    # holds even without declared capacity).
+    capacity: dict[str, tuple[int, ...]] | None = None
+    quotas: dict[str, Quota] = field(default_factory=dict)
+    priority_classes: dict[str, int] = field(
+        default_factory=lambda: dict(DEFAULT_PRIORITY_CLASSES)
+    )
+    aging_rate: float = 1.0
+    preemption: bool = True
+    # Stamp the admission gate on created pods. Off = legacy pass-through
+    # behavior (pods run as soon as a kubelet picks them up).
+    gate_pods: bool = True
+
+
+@dataclass
+class AdmissionDecision:
+    admitted: bool
+    state: str
+    reason: str = ""
+
+
+class GangScheduler:
+    def __init__(
+        self,
+        client: ClusterClient | None = None,
+        config: SchedulerConfig | None = None,
+        recorder: Any | None = None,
+    ) -> None:
+        self.client = client
+        self.config = config or SchedulerConfig()
+        self.recorder = recorder
+        self._lock = threading.RLock()
+        self.queue = AdmissionQueue(self.config.aging_rate)
+        self.placer = TopologyPlacer(self.config.capacity)
+        self.ledger = QuotaLedger(self.config.quotas)
+        self._admitted: dict[str, Gang] = {}
+        self._wakeup: Callable[[str], None] | None = None
+        self.log = logger.with_fields(component="gang-scheduler")
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach(
+        self,
+        client: ClusterClient,
+        recorder: Any | None = None,
+        wakeup: Callable[[str], None] | None = None,
+    ) -> None:
+        """Late binding for pieces the controller owns (operator.py builds
+        the scheduler from flags before any client exists)."""
+        if self.client is None:
+            self.client = client
+        if self.recorder is None:
+            self.recorder = recorder
+        if wakeup is not None:
+            self._wakeup = wakeup
+
+    def gates_for(self, job: TPUJob) -> list[dict[str, str]]:
+        """Scheduling gates to stamp on this job's pods at creation."""
+        if not self.config.gate_pods:
+            return []
+        return [{"name": GATE_NAME}]
+
+    # -- controller-facing surface -------------------------------------------
+
+    def reconcile_gang(self, job: TPUJob, has_pods: bool = False) -> AdmissionDecision:
+        """Register/recover this job's gang, pump the queue, and report
+        whether the gang currently holds an admission."""
+        with self._lock:
+            key = job.key
+            gang = self._admitted.get(key) or self.queue.get(key)
+            if gang is not None and job.metadata.uid and gang.uid and (
+                gang.uid != job.metadata.uid
+            ):
+                # Same name, new job incarnation: retire the stale gang.
+                self._forget(gang)
+                gang = None
+            if gang is None:
+                gang = self._register(job, has_pods)
+            if gang.state != STATE_ADMITTED:
+                self._pump()
+            self._export_gauges()
+            admitted = gang.state == STATE_ADMITTED
+            return AdmissionDecision(
+                admitted=admitted,
+                state=gang.state,
+                reason="" if admitted else "waiting for capacity",
+            )
+
+    def release_gang(self, job: TPUJob) -> bool:
+        """Atomically lift the gates once EVERY expected pod exists.
+
+        Called after pod reconciliation; returns True when the gang is
+        fully released (no gated pods remain). The all-pods-first check is
+        what makes release all-or-nothing: a gang is never part-runnable
+        because creation is still in flight.
+        """
+        with self._lock:
+            gang = self._admitted.get(job.key)
+            if gang is None:
+                return False
+            assert self.client is not None
+            pods = self.client.list(
+                objects.PODS,
+                gang.namespace,
+                {constants.LABEL_JOB_NAME: gang.name},
+            )
+            if len(pods) < gang.pod_count:
+                return False
+            gated = [p for p in pods if is_gated(p)]
+            if not gated:
+                return True
+            names = [objects.name_of(p) for p in gated]
+            ungate_bulk = getattr(self.client, "ungate_pods", None)
+            if callable(ungate_bulk):
+                # One store transaction: the whole gang becomes runnable
+                # in a single resource-version tick (memcluster backend).
+                ungate_bulk(gang.namespace, names, GATE_NAME)
+            else:
+                # Wire backends (real apiserver) have no multi-object
+                # transaction; the admission annotation was persisted
+                # before this point, so a crash mid-loop is finished by
+                # recovery, never re-arbitrated.
+                for p in gated:
+                    try:
+                        self.client.patch_merge(
+                            objects.PODS,
+                            gang.namespace,
+                            objects.name_of(p),
+                            ungate_patch(p),
+                        )
+                    except NotFound:
+                        continue
+            SCHED_RELEASES_TOTAL.inc(len(gated))
+            self._event(
+                gang, EVENT_GANG_RELEASED,
+                f"released {len(gated)} gated pod(s); gang is runnable",
+                warning=False,
+            )
+            return True
+
+    def release_job(self, key: str) -> None:
+        """Terminal or deleted job: refund capacity/quota, forget the gang,
+        and re-pump (freed chips may admit the next gang in line)."""
+        with self._lock:
+            gang = self._admitted.get(key) or self.queue.get(key)
+            if gang is None:
+                return
+            self._forget(gang)
+            self._pump()
+            self._export_gauges()
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-friendly view for /debug/scheduler and tests."""
+        with self._lock:
+            now = time.time()
+            return {
+                "capacity": {
+                    gen: list(dims)
+                    for gen, dims in (self.config.capacity or {}).items()
+                } or None,
+                "chipsInUse": self.placer.chips_in_use(),
+                "chipsTotal": self.placer.chips_total(),
+                "quotaUsage": self.ledger.usage(),
+                "admitted": [
+                    self._gang_view(g, now)
+                    for g in sorted(
+                        self._admitted.values(), key=lambda g: g.key
+                    )
+                ],
+                "queued": [
+                    self._gang_view(g, now) for g in self.queue.ordered(now)
+                ],
+            }
+
+    def _gang_view(self, g: Gang, now: float) -> dict[str, Any]:
+        view = {
+            "key": g.key,
+            "state": g.state,
+            "priorityClass": g.priority_class or "default",
+            "priority": g.priority,
+            "chips": g.total_chips,
+            "slices": g.num_slices,
+            "pods": g.pod_count,
+            "requeues": g.requeues,
+            "waitedSeconds": round(max(0.0, now - g.enqueued_at), 3),
+        }
+        if g.state == STATE_QUEUED:
+            view["effectivePriority"] = round(
+                self.queue.effective_priority(g, now), 3
+            )
+        if g.infeasible:
+            view["infeasible"] = g.infeasible
+        return view
+
+    # -- internals (lock held) -----------------------------------------------
+
+    def _register(self, job: TPUJob, has_pods: bool) -> Gang:
+        gang = gang_from_job(job, self.config.priority_classes)
+        ann = job.metadata.annotations or {}
+        if ann.get(ANNOTATION_STATE) == STATE_ADMITTED:
+            # Recover a persisted admission (controller restart / failover):
+            # recharge the ledger from the recorded placements so the new
+            # incarnation arbitrates against true free capacity.
+            self._recover_admitted(gang, ann)
+            return gang
+        if has_pods and ANNOTATION_STATE not in ann:
+            # Grandfather: pods predate the scheduler (upgrade path). A
+            # running job is never queued retroactively — admit in place,
+            # overcommitting if its blocks no longer fit on paper.
+            placements = self.placer.try_fit(gang.slices) or []
+            self._admit_in_place(gang, placements)
+            return gang
+        # Fresh (or previously queued) gang: enqueue, preserving the
+        # original enqueue time across controller restarts so aging credit
+        # survives.
+        enq = _parse_epoch(ann.get(ANNOTATION_ENQUEUED_AT))
+        if enq is not None:
+            gang.enqueued_at = enq
+        gang.infeasible = self._infeasibility(gang)
+        if gang.infeasible:
+            self._event(
+                gang, EVENT_UNSCHEDULABLE,
+                f"gang can never admit: {gang.infeasible}", warning=True,
+            )
+        self.queue.add(gang)
+        if ann.get(ANNOTATION_STATE) != STATE_QUEUED:
+            self._persist(
+                job.metadata.namespace, job.metadata.name,
+                {
+                    ANNOTATION_STATE: STATE_QUEUED,
+                    ANNOTATION_ENQUEUED_AT: _fmt_epoch(gang.enqueued_at),
+                    ANNOTATION_CHIPS: str(gang.total_chips),
+                },
+                typed=job,
+            )
+            self._event(
+                gang, EVENT_GANG_QUEUED,
+                f"gang queued for admission ({gang.pod_count} pod(s), "
+                f"{gang.total_chips} chip(s), "
+                f"priority {gang.priority_class or 'default'})",
+                warning=False,
+            )
+        return gang
+
+    def _recover_admitted(self, gang: Gang, ann: dict[str, str]) -> None:
+        placements: list[Placement] = []
+        try:
+            placements = [
+                Placement.from_dict(d)
+                for d in json.loads(ann.get(ANNOTATION_PLACEMENTS, "[]"))
+            ]
+        except (ValueError, KeyError, TypeError):
+            placements = []
+        if not placements and not self.placer.unbounded and gang.slices:
+            # Placements were not recorded (or capacity layout changed):
+            # re-fit if possible, else recover overcommitted — an admitted
+            # gang is never demoted by a controller restart.
+            placements = self.placer.try_fit(gang.slices) or []
+        enq = _parse_epoch(ann.get(ANNOTATION_ENQUEUED_AT))
+        if enq is not None:
+            gang.enqueued_at = enq
+        gang.admitted_at = _parse_epoch(ann.get(ANNOTATION_ADMITTED_AT)) or time.time()
+        gang.state = STATE_ADMITTED
+        gang.placements = placements
+        self.placer.commit(placements)
+        self.ledger.charge(gang)
+        self._admitted[gang.key] = gang
+
+    def _admit_in_place(self, gang: Gang, placements: list[Placement]) -> None:
+        gang.state = STATE_ADMITTED
+        gang.admitted_at = time.time()
+        gang.placements = placements
+        self.placer.commit(placements)
+        self.ledger.charge(gang)
+        self._admitted[gang.key] = gang
+        self._persist_admitted(gang)
+
+    def _infeasibility(self, gang: Gang) -> str:
+        """Why this gang can NEVER admit under the configured fleet/quota
+        ("" = feasible). Checked once at registration: capacity and quotas
+        are fixed for the scheduler's lifetime, so "never" is forever."""
+        for req in gang.slices:
+            if not self.placer.fits_empty(req):
+                mesh = (self.config.capacity or {}).get(req.generation)
+                return (
+                    f"slice {req.generation} {'x'.join(map(str, req.dims))} "
+                    + (
+                        f"cannot fit the {'x'.join(map(str, mesh))} mesh"
+                        if mesh is not None
+                        else "targets a generation not in the declared fleet"
+                    )
+                )
+        if not self.ledger.fits_ever(gang):
+            return (
+                f"request ({gang.total_chips} chip(s), {gang.num_slices} "
+                f"slice(s)) exceeds namespace {gang.namespace!r}'s whole quota"
+            )
+        return ""
+
+    def _pump(self) -> None:
+        """Serve the queue in effective-priority order.
+
+        Head-of-line is strict for FREE capacity: once a gang cannot be
+        placed, no later gang may take free chips (backfill would starve
+        the large slices gang admission exists for — the head keeps first
+        claim on whatever frees up). But later gangs may still be served
+        by PREEMPTION: eviction brings its own capacity, taken from
+        strictly-lower-static-priority victims the blocked head, having
+        already failed its own preemption attempt, could not claim. Without
+        this, an aged low-priority head that can neither place nor preempt
+        (aging raises queue position, never eviction rights — cross-class
+        eviction by aging would see-saw with the requeued victim's retained
+        aging credit) would wedge a preemption-capable critical gang behind
+        it indefinitely. Permanently infeasible gangs are passed over
+        entirely — one misconfigured job must not starve the cluster."""
+        now = time.time()
+        blocked = False
+        for gang in self.queue.ordered(now):
+            if gang.infeasible:
+                continue
+            if not blocked and self._try_admit(gang, now):
+                continue
+            if self.config.preemption and self._try_preempt_for(gang, now):
+                continue
+            blocked = True
+
+    def _try_admit(self, gang: Gang, now: float) -> bool:
+        if not self.ledger.fits(gang):
+            return False
+        placements = self.placer.try_fit(gang.slices)
+        if placements is None:
+            return False
+        # Persist BEFORE committing any in-memory state: an admission that
+        # exists only in memory would, after a crash, read as state=queued
+        # with live pods — which recovery treats as an interrupted eviction
+        # and deletes. If the annotation cannot be written the gang simply
+        # stays queued and the next pump retries.
+        gang.admitted_at = now
+        gang.placements = placements
+        if not self._persist_admitted(gang):
+            gang.admitted_at = None
+            gang.placements = []
+            return False
+        self.queue.remove(gang.key)
+        gang.state = STATE_ADMITTED
+        self.placer.commit(placements)
+        self.ledger.charge(gang)
+        self._admitted[gang.key] = gang
+        SCHED_ADMISSIONS_TOTAL.inc()
+        SCHED_ADMISSION_SECONDS.observe(max(0.0, now - gang.enqueued_at))
+        self._event(
+            gang, EVENT_GANG_ADMITTED,
+            f"gang admitted after {max(0.0, now - gang.enqueued_at):.1f}s "
+            f"({gang.total_chips} chip(s) reserved)",
+            warning=False,
+        )
+        if self._wakeup is not None:
+            self._wakeup(gang.key)
+        return True
+
+    def _try_preempt_for(self, gang: Gang, now: float) -> bool:
+        victims = select_victims(
+            gang, list(self._admitted.values()), self.placer, self.ledger
+        )
+        if not victims:
+            return False
+        for victim in victims:
+            if not self._evict(victim, preemptor=gang):
+                # Eviction could not be carried out (apiserver hiccup):
+                # the victim keeps its capacity, so admitting the pending
+                # gang now would double-book chips. Retry next pump.
+                return False
+        return self._try_admit(gang, now)
+
+    def _evict(self, victim: Gang, preemptor: Gang) -> bool:
+        """Checkpoint-signal, then evict the victim WHOLE and requeue it.
+
+        Returns False (victim untouched, still admitted) when its pods
+        cannot even be listed — capacity is only ever refunded after the
+        deletion loop actually ran, so the preemptor can never be admitted
+        onto chips the victim still occupies.
+        """
+        assert self.client is not None
+        # 1. Enumerate the gang BEFORE any state changes: an unreachable
+        #    apiserver aborts the eviction cleanly.
+        try:
+            pods = self.client.list(
+                objects.PODS,
+                victim.namespace,
+                {constants.LABEL_JOB_NAME: victim.name},
+            )
+        except ApiError:
+            self.log.warning(
+                "evict %s aborted: pod list failed; victim keeps capacity",
+                victim.key,
+            )
+            return False
+        # 2. Checkpoint signal: the annotation lands before any pod dies,
+        #    giving checkpoint-aware workloads (train/checkpoint.py watches
+        #    for exactly this) their best-effort flush window. Should the
+        #    controller crash after this persist but before the deletion
+        #    loop finishes, the successor sees state=queued with pods still
+        #    present and finishes the eviction (reconcile_job's
+        #    queued-with-pods cleanup) — never a half-evicted gang running
+        #    unaccounted. If the persist itself fails the eviction aborts:
+        #    deleting pods while the job still reads admitted on the wire
+        #    would make a restart recover the victim as a healthy admitted
+        #    gang and double-book the chips against the preemptor's.
+        if not self._persist(
+            victim.namespace, victim.name,
+            {
+                ANNOTATION_PREEMPTED_AT: objects.now_iso(),
+                ANNOTATION_STATE: STATE_QUEUED,
+            },
+        ):
+            return False
+        self._event(
+            victim, EVENT_PREEMPTED,
+            f"preempted by higher-priority gang {preemptor.key} "
+            f"(priority {preemptor.priority} > {victim.priority}); "
+            "checkpoint now",
+            warning=True,
+        )
+        # 3. Evict the whole gang — a partial eviction would leave exactly
+        #    the stranded half-slice this subsystem exists to prevent.
+        for pod in pods:
+            try:
+                self.client.delete(
+                    objects.PODS, victim.namespace, objects.name_of(pod)
+                )
+            except NotFound:
+                continue
+        # 4. Refund and requeue as a gang, keeping the original enqueue
+        #    time (aging credit) so the victim re-admits ahead of later
+        #    arrivals of its own class.
+        self.placer.release(victim.placements)
+        self.ledger.refund(victim)
+        victim.placements = []
+        victim.state = STATE_QUEUED
+        victim.admitted_at = None
+        victim.requeues += 1
+        self._admitted.pop(victim.key, None)
+        self.queue.add(victim)
+        SCHED_PREEMPTIONS_TOTAL.inc()
+        if self._wakeup is not None:
+            self._wakeup(victim.key)
+        return True
+
+    def _forget(self, gang: Gang) -> None:
+        if gang.state == STATE_ADMITTED:
+            self.placer.release(gang.placements)
+            self.ledger.refund(gang)
+        self._admitted.pop(gang.key, None)
+        self.queue.remove(gang.key)
+
+    # -- persistence / events -------------------------------------------------
+
+    def _persist_admitted(self, gang: Gang) -> bool:
+        return self._persist(
+            gang.namespace, gang.name,
+            {
+                ANNOTATION_STATE: STATE_ADMITTED,
+                ANNOTATION_ADMITTED_AT: _fmt_epoch(gang.admitted_at or time.time()),
+                ANNOTATION_ENQUEUED_AT: _fmt_epoch(gang.enqueued_at),
+                ANNOTATION_CHIPS: str(gang.total_chips),
+                ANNOTATION_PLACEMENTS: json.dumps(
+                    [p.to_dict() for p in gang.placements]
+                ),
+            },
+        )
+
+    def _persist(
+        self,
+        namespace: str,
+        name: str,
+        annotations: dict[str, str],
+        typed: TPUJob | None = None,
+    ) -> bool:
+        """Merge-patch annotations onto the job. Returns False on failure
+        (a vanished job, an apiserver error) so callers for whom the
+        persisted state is a prerequisite — admission, eviction — can
+        abort instead of diverging from what a restart would recover.
+        When the caller holds the typed object, its RV is refreshed so the
+        sync's later status write does not self-conflict."""
+        if self.client is None:
+            return True
+        try:
+            patched = self.client.patch_merge(
+                objects.TPUJOBS, namespace, name,
+                {"metadata": {"annotations": dict(annotations)}},
+            )
+        except ApiError:
+            self.log.warning(
+                "annotation persist failed for %s/%s", namespace, name
+            )
+            return False
+        if typed is not None:
+            typed.metadata.annotations.update(annotations)
+            typed.metadata.resource_version = str(
+                objects.meta(patched).get("resourceVersion", "")
+            )
+        return True
+
+    def _event(self, gang: Gang, reason: str, message: str, warning: bool) -> None:
+        if self.recorder is None:
+            return
+        ref = {
+            "apiVersion": constants.API_VERSION,
+            "kind": constants.KIND,
+            "metadata": {
+                "namespace": gang.namespace,
+                "name": gang.name,
+                "uid": gang.uid,
+            },
+        }
+        try:
+            if warning:
+                self.recorder.warning(ref, reason, message)
+            else:
+                self.recorder.normal(ref, reason, message)
+        except Exception:  # events are best-effort observability
+            self.log.debug("event emit failed", exc_info=True)
+
+    def _export_gauges(self) -> None:
+        SCHED_QUEUE_DEPTH.set(len(self.queue))
+        SCHED_ADMITTED_GANGS.set(len(self._admitted))
+        for gen, used in self.placer.chips_in_use().items():
+            SCHED_CHIPS_IN_USE.set(used, generation=gen)
+
+
+def _fmt_epoch(epoch: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
+def _parse_epoch(stamp: str | None) -> float | None:
+    if not stamp:
+        return None
+    try:
+        import calendar
+
+        return float(
+            calendar.timegm(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+        )
+    except ValueError:
+        return None

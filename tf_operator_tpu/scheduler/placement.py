@@ -1,0 +1,212 @@
+"""Topology-aware placement: contiguous-block fit on the physical mesh.
+
+A TPU slice is not "N chips somewhere" — it is an axis-aligned contiguous
+block of the pod's ICI torus (topology/slices.py resolves "v5e-16" to a
+4x4 block). The placer models each generation's installed capacity as a
+d-dimensional mesh of unit chips and answers the only question gang
+admission needs: *does this gang's full set of slice blocks fit in the
+free cells right now, and where?*
+
+Design notes:
+
+- Fit is all-or-nothing across a gang's slices (a multislice job's DCN
+  halves are placed together or not at all), mirroring the whole-slice
+  placement result of arXiv:2011.03641 / arXiv:1909.09756.
+- Blocks may be rotated (any axis permutation of the requested dims): a
+  4x2 request fits a 2x4 hole — the ICI fabric is symmetric per axis at
+  this granularity.
+- No torus wrap-around: blocks are contiguous in the untorn mesh, the
+  conservative reading of "contiguous" (GKE's TPU placement behaves the
+  same way for sub-pod slices).
+- ``capacity=None`` means an unbounded virtual fleet: every request fits
+  with a zero-footprint placement. This is the default wiring so the
+  scheduler pipeline (gate → admit → release) runs everywhere, while
+  capacity arbitration only engages when the operator declares a fleet
+  (--tpu-capacity).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Iterable
+
+from tf_operator_tpu.scheduler.gang import SliceRequest
+from tf_operator_tpu.topology import slices as topo_slices
+
+
+class CapacityError(ValueError):
+    """A request that can NEVER fit (unknown generation / bigger than the
+    whole mesh) — distinct from "does not fit right now"."""
+
+
+@dataclass(frozen=True)
+class Placement:
+    """One slice's assigned block: generation + offset + (rotated) dims."""
+
+    generation: str
+    offset: tuple[int, ...]
+    dims: tuple[int, ...]
+
+    def cells(self) -> Iterable[tuple[int, ...]]:
+        ranges = [range(o, o + d) for o, d in zip(self.offset, self.dims)]
+        return itertools.product(*ranges)
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "offset": list(self.offset),
+            "dims": list(self.dims),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Placement":
+        return cls(
+            generation=d["generation"],
+            offset=tuple(int(x) for x in d["offset"]),
+            dims=tuple(int(x) for x in d["dims"]),
+        )
+
+
+def parse_capacity(spec: str) -> dict[str, tuple[int, ...]]:
+    """Parse the operator flag form: ``"v5e=16x16,v4=4x4x8"``."""
+    out: dict[str, tuple[int, ...]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        gen, _, dims = part.partition("=")
+        gen = gen.strip().lower()
+        if gen not in topo_slices.GENERATIONS:
+            raise CapacityError(
+                f"unknown TPU generation {gen!r} in capacity spec "
+                f"(known: {sorted(topo_slices.GENERATIONS)})"
+            )
+        out[gen] = topo_slices.parse_topology(dims)
+    return out
+
+
+class TopologyPlacer:
+    """Tracks free/used cells per generation mesh; finds contiguous blocks.
+
+    Not thread-safe on its own — the GangScheduler serializes access.
+    """
+
+    def __init__(self, capacity: dict[str, tuple[int, ...]] | None = None):
+        self.capacity = dict(capacity) if capacity is not None else None
+        self._used: dict[str, set[tuple[int, ...]]] = {
+            gen: set() for gen in (self.capacity or {})
+        }
+
+    @property
+    def unbounded(self) -> bool:
+        return self.capacity is None
+
+    # -- queries -------------------------------------------------------------
+
+    def chips_total(self) -> dict[str, int]:
+        if self.capacity is None:
+            return {}
+        out = {}
+        for gen, mesh in self.capacity.items():
+            n = 1
+            for d in mesh:
+                n *= d
+            out[gen] = n
+        return out
+
+    def chips_in_use(self) -> dict[str, int]:
+        return {gen: len(cells) for gen, cells in self._used.items()}
+
+    def fits_empty(self, req: SliceRequest) -> bool:
+        """Could this block EVER place on an idle fleet? False means the
+        request is permanently infeasible (generation not installed, or
+        bigger than the whole mesh) — the CapacityError class of failure,
+        as opposed to "does not fit right now"."""
+        if self.capacity is None:
+            return True
+        return self._find(req, set()) is not None
+
+    # -- fit -----------------------------------------------------------------
+
+    def try_fit(
+        self, requests: list[SliceRequest]
+    ) -> list[Placement] | None:
+        """All-or-nothing tentative fit; returns placements without
+        committing them, or None when any block has no home right now."""
+        if self.capacity is None:
+            return [
+                Placement(r.generation, (), ()) for r in requests
+            ]
+        # Place the largest blocks first: greedy first-fit with big-first
+        # ordering avoids the easy fragmentation traps (two 2x2s straddling
+        # the only 4x4 hole).
+        order = sorted(
+            range(len(requests)), key=lambda i: -requests[i].chips
+        )
+        tentative: dict[str, set[tuple[int, ...]]] = {
+            gen: set(cells) for gen, cells in self._used.items()
+        }
+        placed: dict[int, Placement] = {}
+        for i in order:
+            req = requests[i]
+            spot = self._find(req, tentative.get(req.generation))
+            if spot is None:
+                return None
+            placed[i] = spot
+            tentative.setdefault(req.generation, set()).update(spot.cells())
+        return [placed[i] for i in range(len(requests))]
+
+    def _find(
+        self, req: SliceRequest, used: set[tuple[int, ...]] | None
+    ) -> Placement | None:
+        mesh = (self.capacity or {}).get(req.generation)
+        if mesh is None:
+            return None  # generation not installed in this fleet
+        dims = tuple(req.dims)
+        if len(dims) > len(mesh):
+            # A 3D request cannot embed in a 2D mesh unless the extra
+            # dims are singleton.
+            if any(d != 1 for d in dims[len(mesh):]):
+                return None
+            dims = dims[: len(mesh)]
+        # Pad to mesh rank so rotation covers every axis assignment.
+        dims = dims + (1,) * (len(mesh) - len(dims))
+        used = used or set()
+        seen: set[tuple[int, ...]] = set()
+        for perm in itertools.permutations(dims):
+            if perm in seen:
+                continue
+            seen.add(perm)
+            if any(p > m for p, m in zip(perm, mesh)):
+                continue
+            for offset in itertools.product(
+                *[range(m - p + 1) for p, m in zip(perm, mesh)]
+            ):
+                candidate = Placement(req.generation, offset, perm)
+                if not any(c in used for c in candidate.cells()):
+                    return candidate
+        return None
+
+    # -- commit/release ------------------------------------------------------
+
+    def commit(self, placements: list[Placement]) -> None:
+        if self.capacity is None:
+            return
+        for p in placements:
+            self._used.setdefault(p.generation, set()).update(p.cells())
+
+    def release(self, placements: list[Placement]) -> None:
+        if self.capacity is None:
+            return
+        for p in placements:
+            cells = self._used.get(p.generation)
+            if cells:
+                cells.difference_update(p.cells())

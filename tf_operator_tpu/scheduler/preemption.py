@@ -1,0 +1,88 @@
+"""Preemption: make room for a higher-priority gang, whole gangs at a time.
+
+Victim selection policy (the ISSUE's contract):
+
+- only gangs with STRICTLY lower priority than the pending gang are
+  candidates — equal-priority work is never preempted (that way churn
+  lies; aging would see-saw two equal gangs forever),
+- candidates are considered lowest-priority first, youngest first within
+  a priority (the cheapest work to redo is the work that has run the
+  shortest time),
+- the chosen set is minimal: after the greedy sweep finds a feasible
+  set, every victim that can be returned without breaking feasibility is
+  returned (greedy-then-prune; victims are whole gangs, so "minimal"
+  means no removable member, not globally optimal bin packing).
+
+Victims are evicted as gangs — checkpoint-signaled, every pod deleted,
+capacity refunded — and requeued as gangs with their original enqueue
+time, so a preempted gang keeps its aging credit and re-admits ahead of
+later arrivals of its class instead of restarting at the back of the
+line.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.scheduler.gang import Gang
+from tf_operator_tpu.scheduler.placement import TopologyPlacer
+from tf_operator_tpu.scheduler.queue import QuotaLedger
+
+
+def _feasible_with(
+    pending: Gang,
+    victims: list[Gang],
+    placer: TopologyPlacer,
+    ledger: QuotaLedger,
+) -> bool:
+    """Would releasing ``victims`` let ``pending`` place AND pass quota?"""
+    # Simulate the placer with the victims' cells freed.
+    sim = TopologyPlacer(placer.capacity)
+    sim._used = {gen: set(cells) for gen, cells in placer._used.items()}
+    for v in victims:
+        sim.release(v.placements)
+    if sim.try_fit(pending.slices) is None:
+        return False
+    # Simulate the ledger with the victims refunded.
+    sim_ledger = QuotaLedger(ledger.quotas)
+    sim_ledger._chips = dict(ledger._chips)
+    sim_ledger._slices = dict(ledger._slices)
+    for v in victims:
+        sim_ledger.refund(v)
+    return sim_ledger.fits(pending)
+
+
+def select_victims(
+    pending: Gang,
+    admitted: list[Gang],
+    placer: TopologyPlacer,
+    ledger: QuotaLedger,
+) -> list[Gang] | None:
+    """Minimal set of strictly-lower-priority gangs whose eviction lets
+    ``pending`` admit; None when no such set exists — or when no eviction
+    is needed at all (pending fits free capacity; that case belongs to the
+    admit path, which the pump's head-of-line discipline governs, and must
+    never be reached by pointlessly evicting someone)."""
+    if _feasible_with(pending, [], placer, ledger):
+        return None
+    candidates = [g for g in admitted if g.priority < pending.priority]
+    if not candidates:
+        return None
+    # Lowest priority first; youngest (latest admission) first within it.
+    candidates.sort(key=lambda g: (g.priority, -(g.admitted_at or 0.0)))
+
+    chosen: list[Gang] = []
+    for g in candidates:
+        chosen.append(g)
+        if _feasible_with(pending, chosen, placer, ledger):
+            break
+    else:
+        return None  # even evicting every candidate is not enough
+
+    # Prune: drop any victim whose eviction turned out unnecessary (the
+    # greedy sweep may have collected small gangs before the one whose
+    # block actually frees the hole). Iterate oldest-priority-last so the
+    # survivors stay the cheapest feasible choice.
+    for g in list(chosen):
+        trial = [v for v in chosen if v is not g]
+        if trial and _feasible_with(pending, trial, placer, ledger):
+            chosen = trial
+    return chosen

@@ -1,0 +1,138 @@
+"""Admission queue: priority FIFO with quota accounting and aging.
+
+Ordering: gangs are served by *effective* priority — the static priority
+resolved from ``SchedulingPolicy.priority_class`` plus an aging bonus that
+grows with time spent queued. Ties break FIFO (enqueue time, then name).
+Aging is the starvation valve: a low-priority gang stuck behind a stream
+of high-priority arrivals eventually out-bids them in QUEUE POSITION, so
+it holds first claim on the next capacity that frees up and no tenant
+waits forever behind a busy stream. Aging deliberately does not grant
+eviction rights — preemption stays keyed on static class (see
+preemption.py; an aged gang evicting a peer would requeue that peer with
+its own retained aging credit and see-saw forever).
+
+Head-of-line discipline is strict for free capacity: the pump never lets
+a later gang take free chips past a blocked head — backfill would starve
+large slices indefinitely on a busy fleet, exactly the workloads gang
+admission exists for. Later gangs may still be served by preemption,
+which takes capacity from their own strictly-lower-class victims rather
+than from the pool the head is waiting on (core.py ``_pump``).
+
+Quota: per-namespace budgets in chips and/or slice count, charged at
+admission and refunded at release/preemption/terminal — the multi-tenant
+arbitration layer the ROADMAP's many-concurrent-jobs target needs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from tf_operator_tpu.scheduler.gang import Gang
+
+
+@dataclass(frozen=True)
+class Quota:
+    """A namespace's admission budget; None = unlimited on that axis."""
+
+    chips: int | None = None
+    slices: int | None = None
+
+
+class QuotaLedger:
+    """Charges admitted gangs against per-namespace budgets."""
+
+    def __init__(self, quotas: dict[str, Quota] | None = None) -> None:
+        self.quotas = dict(quotas or {})
+        self._chips: dict[str, int] = {}
+        self._slices: dict[str, int] = {}
+
+    def fits(self, gang: Gang) -> bool:
+        quota = self.quotas.get(gang.namespace)
+        if quota is None:
+            return True
+        if quota.chips is not None:
+            if self._chips.get(gang.namespace, 0) + gang.total_chips > quota.chips:
+                return False
+        if quota.slices is not None:
+            if (
+                self._slices.get(gang.namespace, 0) + gang.num_slices
+                > quota.slices
+            ):
+                return False
+        return True
+
+    def fits_ever(self, gang: Gang) -> bool:
+        """Could this gang EVER pass quota, even on an idle namespace?
+        False = permanently infeasible, however much capacity frees up."""
+        quota = self.quotas.get(gang.namespace)
+        if quota is None:
+            return True
+        if quota.chips is not None and gang.total_chips > quota.chips:
+            return False
+        if quota.slices is not None and gang.num_slices > quota.slices:
+            return False
+        return True
+
+    def charge(self, gang: Gang) -> None:
+        ns = gang.namespace
+        self._chips[ns] = self._chips.get(ns, 0) + gang.total_chips
+        self._slices[ns] = self._slices.get(ns, 0) + gang.num_slices
+
+    def refund(self, gang: Gang) -> None:
+        ns = gang.namespace
+        self._chips[ns] = max(0, self._chips.get(ns, 0) - gang.total_chips)
+        self._slices[ns] = max(0, self._slices.get(ns, 0) - gang.num_slices)
+
+    def usage(self) -> dict[str, dict[str, int]]:
+        namespaces = set(self._chips) | set(self._slices) | set(self.quotas)
+        return {
+            ns: {
+                "chips": self._chips.get(ns, 0),
+                "slices": self._slices.get(ns, 0),
+            }
+            for ns in sorted(namespaces)
+        }
+
+
+class AdmissionQueue:
+    """The waiting line. Not thread-safe; GangScheduler holds the lock."""
+
+    def __init__(self, aging_rate: float = 1.0) -> None:
+        # Priority points gained per second of queue wait. At the default
+        # (1 pt/s) a "default" (0) gang out-bids a "high" (100) arrival
+        # after 100s of waiting — aggressive enough for tests and small
+        # fleets; production deployments tune it down via SchedulerConfig.
+        self.aging_rate = aging_rate
+        self._gangs: dict[str, Gang] = {}
+
+    def __len__(self) -> int:
+        return len(self._gangs)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._gangs
+
+    def get(self, key: str) -> Gang | None:
+        return self._gangs.get(key)
+
+    def add(self, gang: Gang) -> None:
+        self._gangs[gang.key] = gang
+
+    def remove(self, key: str) -> Gang | None:
+        return self._gangs.pop(key, None)
+
+    def effective_priority(self, gang: Gang, now: float | None = None) -> float:
+        waited = max(0.0, (now if now is not None else time.time()) - gang.enqueued_at)
+        return gang.priority + self.aging_rate * waited
+
+    def ordered(self, now: float | None = None) -> list[Gang]:
+        """Service order: effective priority desc, then FIFO, then name."""
+        now = now if now is not None else time.time()
+        return sorted(
+            self._gangs.values(),
+            key=lambda g: (
+                -self.effective_priority(g, now),
+                g.enqueued_at,
+                g.key,
+            ),
+        )

@@ -20,6 +20,8 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from tf_operator_tpu import parallel as parallel_compat
+
 
 def _data_axis_sharding(mesh: Mesh, data_axis: Any) -> tuple[NamedSharding, int]:
     """(batch NamedSharding, shard count) for a str-or-tuple data axis,
@@ -213,7 +215,7 @@ def sharded_lm_xent(
             (P(dp, sp, None), P(None, tp), P(tp), P(dp, sp)),
         )
         args = (hidden, kernel, bias, labels)
-    total = jax.shard_map(
+    total = parallel_compat.shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
     )(*args)
     return total / (b * s)

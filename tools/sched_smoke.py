@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Fast gang-scheduler smoke: runs the `scheduler`-marked tests in
+isolation (scheduler unit + integration suite plus the gang-admission
+chaos cases on both cluster backends) — the ~5s loop for iterating on
+tf_operator_tpu/scheduler/ without paying for the whole tier-1 run.
+
+    python tools/sched_smoke.py            # the smoke subset
+    python tools/sched_smoke.py -k quota   # extra pytest args pass through
+
+Exit code is pytest's. CI wires this as the pre-merge gate for scheduler
+changes; the same tests also run (unmarked-slow, so by default) inside the
+tier-1 command in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_scheduler.py", "tests/test_chaos.py",
+        "-m", "scheduler",
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
