@@ -172,10 +172,15 @@ def main(argv: list[str] | None = None) -> int:
         if (args.spec_draft_layers is not None
                 and args.spec_draft_layers < 1):
             p.error("--spec-draft-layers must be >= 1")
-        if args.int8 or args.kv_int8 or args.tp > 1:
-            p.error("--spec-k composes only with the plain decode path "
-                    "(not --int8/--kv-int8/--tp; speculative exactness "
-                    "is pinned for that configuration)")
+        # --kv-int8 composes: speculative exactness for the int8 KV cache
+        # (including the scale-buffer rollback) is pinned by
+        # tests/test_spec_decode.py::test_exact_vs_greedy_cache_variants.
+        # --int8 (no SPMD/quantized multi-token scoring path) and --tp
+        # (no partitioning rule for the draft round) remain blocked.
+        if args.int8 or args.tp > 1:
+            p.error("--spec-k composes only with the plain or --kv-int8 "
+                    "decode paths (not --int8/--tp; speculative "
+                    "exactness is not pinned for those configurations)")
         if args.checkpoint_dir and not args.draft_checkpoint_dir:
             p.error("--spec-k with --checkpoint-dir also needs "
                     "--draft-checkpoint-dir (a draft trained at "

@@ -1,0 +1,677 @@
+"""Fleet health & auto-repair tests: cordon-aware placement, the per-cell
+state machine (suspect scoring/decay, NotReady grace, repair probing),
+signal attribution (exit-138 reports, restart churn, heartbeats), the
+drain → checkpoint-signal → evict-whole → re-place migration pipeline,
+SliceDegraded/JobMigrating conditions, persistence/recovery, and the
+/debug/health + tpuctl surface.
+
+The crash-at-every-boundary proofs (both cluster backends) live in
+tests/test_health_chaos.py.
+"""
+
+import json
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.controller import status as status_engine
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.health import (
+    FleetHealthMonitor,
+    HealthConfig,
+    STATE_CORDONED,
+    STATE_REPAIRING,
+    STATE_SUSPECT,
+)
+from tf_operator_tpu.health.monitor import RECORD_NAME, RECORD_NAMESPACE
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.scheduler import (
+    GangScheduler,
+    SchedulerConfig,
+    TopologyPlacer,
+)
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_MIGRATED_AT,
+    ANNOTATION_PLACEMENTS,
+    ANNOTATION_PREEMPTED_AT,
+    ANNOTATION_STATE,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    SliceRequest,
+    is_gated,
+)
+from tf_operator_tpu.scheduler.placement import Placement
+from tf_operator_tpu.utils import testutil
+
+pytestmark = pytest.mark.health
+
+T0 = 1_000_000.0  # deterministic clock origin for state-machine tests
+
+
+def tpu_job(name, accel="v4-8", ns="default"):
+    return testutil.new_tpujob(name=name, namespace=ns, tpu_accelerator=accel)
+
+
+def submit(client, job):
+    created = client.create(objects.TPUJOBS, job.to_dict())
+    job.metadata.resource_version = str(
+        objects.meta(created).get("resourceVersion", "")
+    )
+    job.metadata.uid = objects.uid_of(created) or job.metadata.uid
+    return job
+
+
+def fast_config(**over):
+    base = dict(
+        suspect_threshold=3.0,
+        suspect_decay=1.0,       # fast forgiveness for decay tests
+        notready_cordon_after=10.0,
+        repair_after=30.0,
+        probe_window=30.0,
+    )
+    base.update(over)
+    return HealthConfig(**base)
+
+
+def mk_stack(capacity={"v4": (2, 2, 4)}, config=None, client=None):
+    """(client, scheduler, monitor, controller) wired the way the operator
+    wires them; the monitor is created before the controller so the
+    controller's attach recovers persisted cordons."""
+    client = client or InMemoryCluster()
+    sched = GangScheduler(config=SchedulerConfig(capacity=capacity))
+    monitor = FleetHealthMonitor(sched, config=config or fast_config())
+    tc = TPUJobController(client, recorder=FakeRecorder(), scheduler=sched)
+    return client, sched, monitor, tc
+
+
+def sync_once(tc, key):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(key)
+
+
+def fresh_job(client, ns, name):
+    """Decode the job straight from the store (the informer cache in these
+    synchronous tests lags the sync's own status write)."""
+    from tf_operator_tpu.api.types import TPUJob
+
+    return TPUJob.from_dict(client.get(objects.TPUJOBS, ns, name))
+
+
+def placement_cells(client, ns, name):
+    ann = client.get(objects.TPUJOBS, ns, name)["metadata"]["annotations"]
+    cells = []
+    for d in json.loads(ann.get(ANNOTATION_PLACEMENTS, "[]")):
+        p = Placement.from_dict(d)
+        cells.extend((p.generation, c) for c in p.cells())
+    return cells
+
+
+def run_pods(client, name):
+    for pod in client.list(
+        objects.PODS, "default", {constants.LABEL_JOB_NAME: name}
+    ):
+        objects.set_pod_phase(pod, objects.RUNNING)
+        client.update_status(objects.PODS, pod)
+
+
+# ---------------------------------------------------------------------------
+# placement.py: cordon-aware fit
+# ---------------------------------------------------------------------------
+
+def test_placer_cordon_excludes_cells_from_fit():
+    placer = TopologyPlacer({"v4": (2, 2, 2)})
+    req = [SliceRequest("v4", (2, 2, 2), 8)]
+    assert placer.try_fit(req) is not None
+    placer.cordon("v4", [(0, 0, 0)])
+    # One cordoned cell breaks the only 2x2x2 block.
+    assert placer.try_fit(req) is None
+    # Smaller blocks still fit around the cordon.
+    assert placer.try_fit([SliceRequest("v4", (1, 2, 2), 4)]) is not None
+    placer.uncordon("v4", [(0, 0, 0)])
+    assert placer.try_fit(req) is not None
+
+
+def test_placer_fits_empty_ignores_cordons():
+    """A cordon is temporary; infeasibility is forever — a fully cordoned
+    mesh must not flag gangs GangUnschedulable."""
+    placer = TopologyPlacer({"v4": (2, 2, 2)})
+    placer.cordon("v4", [(x, y, z) for x in range(2) for y in range(2)
+                         for z in range(2)])
+    req = SliceRequest("v4", (2, 2, 2), 8)
+    assert placer.fits_empty(req)
+    assert placer.try_fit([req]) is None
+    assert placer.chips_cordoned() == {"v4": 8}
+
+
+def test_scheduler_queues_not_infeasible_on_cordoned_fleet():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 2)})
+    monitor.cordon("v4", [(0, 0, 0)], now=T0)
+    job = submit(client, tpu_job("blocked"))
+    decision = sched.reconcile_gang(job)
+    assert not decision.admitted
+    snap = sched.snapshot()
+    assert snap["queued"][0]["key"] == "default/blocked"
+    assert not snap["queued"][0].get("infeasible")
+    assert snap["chipsCordoned"] == {"v4": 1}
+    # Healing the cell admits the waiting gang (uncordon re-pumps).
+    monitor.uncordon("v4", [(0, 0, 0)])
+    assert sched.reconcile_gang(job).admitted
+
+
+# ---------------------------------------------------------------------------
+# monitor: state machine
+# ---------------------------------------------------------------------------
+
+def test_suspect_scoring_cordons_at_threshold_and_decays():
+    _, sched, monitor, _ = mk_stack()
+    cells = [("v4", (0, 0, 0))]
+    monitor._signal(cells, "restart-churn", 1.0, T0)
+    monitor._signal(cells, "restart-churn", 1.0, T0 + 1)
+    st = monitor.snapshot()["cells"][0]
+    assert st["state"] == STATE_SUSPECT and st["score"] == 2.0
+    assert not sched.placer.is_cordoned("v4", (0, 0, 0))
+    # Third strike crosses the threshold: cordoned + excluded.
+    monitor._signal(cells, "restart-churn", 1.0, T0 + 2)
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_CORDONED
+    assert sched.placer.is_cordoned("v4", (0, 0, 0))
+
+
+def test_suspect_decay_forgives_a_lone_restart():
+    _, sched, monitor, _ = mk_stack(config=fast_config(suspect_decay=1.0))
+    monitor.tick(T0)  # anchor the decay clock
+    monitor._signal([("v4", (1, 1, 1))], "restart-churn", 1.0, T0)
+    monitor.tick(T0 + 5)  # 5s x 1 pt/s decay swallows the single point
+    assert monitor.snapshot()["cells"] == []
+    assert not sched.placer.is_cordoned("v4", (1, 1, 1))
+
+
+def test_auto_uncordon_after_repair_probe():
+    _, sched, monitor, _ = mk_stack()
+    monitor._signal([("v4", (0, 0, 1))], "restart-churn", 3.0, T0)
+    assert sched.placer.is_cordoned("v4", (0, 0, 1))
+    monitor.tick(T0 + 31)  # repair_after elapsed: probing
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_REPAIRING
+    assert sched.placer.is_cordoned("v4", (0, 0, 1))  # still excluded
+    monitor.tick(T0 + 62)  # quiet probe window: back in service
+    assert monitor.snapshot()["cells"] == []
+    assert not sched.placer.is_cordoned("v4", (0, 0, 1))
+
+
+def test_signal_during_repair_probe_recordons():
+    _, sched, monitor, _ = mk_stack()
+    monitor._signal([("v4", (0, 0, 1))], "restart-churn", 3.0, T0)
+    monitor.tick(T0 + 31)
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_REPAIRING
+    monitor._signal([("v4", (0, 0, 1))], "restart-churn", 1.0, T0 + 40)
+    monitor.tick(T0 + 41)
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_CORDONED
+    # The probe clock restarted: quiet from the RE-cordon, not the first.
+    monitor.tick(T0 + 41 + 30)
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_REPAIRING
+
+
+def test_manual_cordon_never_auto_uncordons():
+    _, sched, monitor, _ = mk_stack()
+    monitor.cordon("v4", [(1, 0, 0)], now=T0)
+    monitor.tick(T0 + 10_000)
+    st = monitor.snapshot()["cells"][0]
+    assert st["state"] == STATE_CORDONED and st["manual"]
+    assert sched.placer.is_cordoned("v4", (1, 0, 0))
+    monitor.uncordon("v4", [(1, 0, 0)])
+    assert not sched.placer.is_cordoned("v4", (1, 0, 0))
+    assert monitor.snapshot()["cells"] == []
+
+
+def test_drain_deadline_holds_cordon_until_maintenance_passes():
+    _, sched, monitor, _ = mk_stack()
+    # Maintenance at T0+100: the repair probe may only start after it.
+    monitor.drain("v4", [(0, 1, 0)], deadline=T0 + 100, now=T0)
+    assert sched.placer.is_cordoned("v4", (0, 1, 0))
+    monitor.tick(T0 + 99)
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_CORDONED
+    monitor.tick(T0 + 100 + 31)  # deadline + repair_after
+    assert monitor.snapshot()["cells"][0]["state"] == STATE_REPAIRING
+    monitor.tick(T0 + 100 + 62)
+    assert monitor.snapshot()["cells"] == []
+
+
+# ---------------------------------------------------------------------------
+# monitor: node heartbeats (memcluster node objects)
+# ---------------------------------------------------------------------------
+
+def test_notready_node_cordons_after_grace_and_probes_on_recovery():
+    client, sched, monitor, tc = mk_stack()
+    cells = [(0, 0, 0), (0, 0, 1), (0, 1, 0), (0, 1, 1)]
+    client.create(objects.NODES, objects.new_node("host-0", "v4", cells))
+    now = time.time()
+    monitor.observe_nodes(now)
+    assert monitor.snapshot()["cells"] == []  # Ready host: nothing tracked
+
+    client.heartbeat_node("host-0", ready=False)
+    monitor.observe_nodes(now)
+    states = {tuple(c["cell"]): c["state"]
+              for c in monitor.snapshot()["cells"]}
+    assert set(states) == set(cells)
+    assert all(s == STATE_SUSPECT for s in states.values())
+    assert not sched.placer.is_cordoned("v4", (0, 0, 0))  # grace window
+
+    monitor.tick(now + 11)  # NotReady past the grace: cordon all 4 cells
+    assert all(
+        c["state"] == STATE_CORDONED for c in monitor.snapshot()["cells"]
+    )
+    assert sched.placer.is_cordoned("v4", (0, 0, 0))
+
+    # Host heartbeats Ready again: straight to the repair probe, then (a
+    # quiet window later) back to service.
+    client.heartbeat_node("host-0", ready=True)
+    monitor.observe_nodes(now + 20)
+    assert all(
+        c["state"] == STATE_REPAIRING for c in monitor.snapshot()["cells"]
+    )
+    monitor.tick(now + 20 + 31)
+    assert monitor.snapshot()["cells"] == []
+    assert not sched.placer.is_cordoned("v4", (0, 0, 0))
+
+
+def test_stale_heartbeat_counts_as_notready():
+    client, sched, monitor, _ = mk_stack(
+        config=fast_config(heartbeat_timeout=60.0)
+    )
+    client.create(objects.NODES, objects.new_node("host-1", "v4", [(1, 1, 0)]))
+    # Ready=True on the wire, but the heartbeat stamp is an hour old.
+    monitor.observe_nodes(time.time() + 3600)
+    cells = monitor.snapshot()["cells"]
+    assert len(cells) == 1 and cells[0]["state"] == STATE_SUSPECT
+
+
+# ---------------------------------------------------------------------------
+# migration: drain → checkpoint-signal → evict whole → re-place → resume
+# ---------------------------------------------------------------------------
+
+def test_drain_migrates_running_gang_to_healthy_cells_end_to_end():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("prod"))
+    sync_once(tc, job.key)
+    sync_once(tc, job.key)  # second pass: informer observes the creations
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+    run_pods(client, "prod")
+    old_cells = placement_cells(client, "default", "prod")
+    assert old_cells, "admitted gang must have recorded placements"
+
+    # Maintenance notice lands on exactly the gang's cells.
+    migrated = monitor.drain(
+        "v4", [c for _, c in old_cells], deadline=time.time() + 3600
+    )
+    assert migrated == ["default/prod"]
+
+    # Checkpoint signal + migration marker persisted; old pods evicted
+    # whole; the gang was immediately re-placed on the OTHER (healthy)
+    # block — disjoint cells — because capacity allowed it.
+    ann = client.get(objects.TPUJOBS, "default", "prod")["metadata"][
+        "annotations"]
+    assert ANNOTATION_PREEMPTED_AT in ann
+    assert ANNOTATION_MIGRATED_AT in ann
+    assert ann[ANNOTATION_STATE] == STATE_ADMITTED
+    new_cells = placement_cells(client, "default", "prod")
+    assert new_cells and not (set(new_cells) & set(old_cells))
+    assert client.list(objects.PODS, "default") == []  # evicted whole
+
+    # The next sync recreates the gang's pods on the new placement and
+    # releases them as one unit; the job resumes.
+    sync_once(tc, job.key)
+    sync_once(tc, job.key)
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+    run_pods(client, "prod")
+
+    # The drained cells stay excluded: a second gang cannot take them.
+    rival = submit(client, tpu_job("rival"))
+    assert not sched.reconcile_gang(rival).admitted
+    monitor.uncordon("v4", [c for _, c in old_cells])
+    assert sched.reconcile_gang(rival).admitted
+
+
+def test_migrating_condition_and_events_when_replacement_must_wait():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 2)})
+    job = submit(client, tpu_job("pinned"))
+    sync_once(tc, job.key)
+    sync_once(tc, job.key)  # informer observes the creations
+    run_pods(client, "pinned")
+    cells = [c for _, c in placement_cells(client, "default", "pinned")]
+
+    monitor.drain("v4", cells, now=time.time())
+    ann = client.get(objects.TPUJOBS, "default", "pinned")["metadata"][
+        "annotations"]
+    # Whole fleet cordoned: the gang cannot re-place and waits queued.
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+    sync_once(tc, job.key)
+    job2 = fresh_job(client, "default", "pinned")
+    assert status_engine.has_condition(
+        job2.status, JobConditionType.JOB_MIGRATING
+    )
+    assert any(
+        r == status_engine.REASON_MIGRATING
+        for _, _, r, _ in tc.recorder.events
+    )
+    # Aging credit: the migrated gang's effective priority outruns its
+    # actual wait (enqueued_at was shifted back by migration_credit).
+    waited = sched.snapshot()["queued"][0]["waitedSeconds"]
+    assert waited >= sched.config.migration_credit
+
+    # Maintenance over: uncordon → re-admit → pods recreated → condition
+    # flips False with a MigrationComplete event.
+    monitor.uncordon("v4", cells)
+    sync_once(tc, job.key)
+    sync_once(tc, job.key)
+    pods = client.list(objects.PODS, "default")
+    assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+    job3 = fresh_job(client, "default", "pinned")
+    assert not status_engine.has_condition(
+        job3.status, JobConditionType.JOB_MIGRATING
+    )
+    assert any(
+        r == status_engine.REASON_MIGRATED
+        for _, _, r, _ in tc.recorder.events
+    )
+
+
+def test_stale_migrated_at_does_not_mislabel_later_preemption():
+    """migrated-at is never garbage-collected off the job; a LATER
+    ordinary preemption must raise no JobMigrating condition from the
+    stale stamp (migration stamps migrated-at == preempted-at; preemption
+    advances only preempted-at)."""
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 2)})
+    job = submit(client, tpu_job("vet"))
+    sync_once(tc, job.key)
+    cells = [c for _, c in placement_cells(client, "default", "vet")]
+    monitor.drain("v4", cells)          # migrated: queued + both stamps
+    monitor.uncordon("v4", cells)       # heals: re-admitted
+    sync_once(tc, job.key)
+    assert sched.reconcile_gang(job).admitted
+
+    time.sleep(1.1)  # second-granularity stamps must actually advance
+    crit = submit(client, tpu_job("crit"))
+    crit.spec.scheduling.priority_class = "critical"
+    assert sched.reconcile_gang(crit).admitted  # preempts vet
+    sync_once(tc, job.key)
+    vet = fresh_job(client, "default", "vet")
+    ann = vet.metadata.annotations
+    assert ANNOTATION_MIGRATED_AT in ann  # the stale stamp is still there
+    assert not status_engine.has_condition(
+        vet.status, JobConditionType.JOB_MIGRATING
+    )
+
+
+# ---------------------------------------------------------------------------
+# attribution: exit-138 reports + restart churn → the cells the gang ran on
+# ---------------------------------------------------------------------------
+
+def test_exit_report_cordons_gang_cells_and_migrates():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("sick"))
+    sync_once(tc, job.key)
+    old_cells = placement_cells(client, "default", "sick")
+
+    # One exit-138 "TPU health check failed" report: strongest signal —
+    # immediate cordon of every cell the gang occupies, and migration.
+    monitor.record_pod_exit("default/sick", "uid-pod-0", 138)
+    assert all(sched.placer.is_cordoned(g, c) for g, c in old_cells)
+    new_cells = placement_cells(client, "default", "sick")
+    assert new_cells and not (set(new_cells) & set(old_cells))
+
+
+def test_restart_churn_cordons_after_repeated_retryable_exits():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("churny"))
+    sync_once(tc, job.key)
+    cells = placement_cells(client, "default", "churny")
+    # Two retryable incidents, separated by more than churn_interval:
+    # suspect but still placed.
+    monitor.record_pod_exit("default/churny", "uid-a", 137, now=T0)
+    monitor.record_pod_exit("default/churny", "uid-b", 143, now=T0 + 10)
+    assert not any(sched.placer.is_cordoned(g, c) for g, c in cells)
+    # Dedupe: replaying a seen pod incarnation must not score again.
+    monitor.record_pod_exit("default/churny", "uid-a", 137, now=T0 + 20)
+    assert not any(sched.placer.is_cordoned(g, c) for g, c in cells)
+    # Third distinct incident crosses the threshold.
+    monitor.record_pod_exit("default/churny", "uid-c", 137, now=T0 + 30)
+    assert all(sched.placer.is_cordoned(g, c) for g, c in cells)
+
+
+def test_restart_churn_burst_is_one_incident():
+    """A multi-host gang failing AS ONE INCIDENT drops several member
+    pods at once — the burst must score one signal, not gang-size
+    signals (which would cross the threshold in a single sweep)."""
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("cascade"))
+    sync_once(tc, job.key)
+    cells = placement_cells(client, "default", "cascade")
+    for i in range(4):  # four members of one incident, same instant
+        monitor.record_pod_exit("default/cascade", f"uid-{i}", 137, now=T0)
+    assert not any(sched.placer.is_cordoned(g, c) for g, c in cells)
+    st = monitor.snapshot()["cells"]
+    assert st and all(c["score"] == 1.0 for c in st)
+
+
+def test_permanent_exits_are_not_cell_evidence():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("appbug"))
+    sync_once(tc, job.key)
+    for uid, code in (("u1", 1), ("u2", 134), ("u3", 139), ("u4", 139)):
+        monitor.record_pod_exit("default/appbug", uid, code)
+    assert monitor.snapshot()["cells"] == []  # app bugs don't brick cells
+
+
+def test_pod_reconciler_attributes_failed_exit_to_cells():
+    """The full attribution path: a pod fails with exit 138 on the store,
+    the controller's sync reports it through report_pod_exit, and the
+    monitor cordons + migrates — no direct monitor calls in the test."""
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("selfcheck"))
+    sync_once(tc, job.key)
+    old_cells = placement_cells(client, "default", "selfcheck")
+    pods = client.list(objects.PODS, "default")
+    pod = pods[0]
+    objects.set_pod_phase(pod, objects.FAILED)
+    objects.set_container_terminated(
+        pod, constants.DEFAULT_CONTAINER_NAME, 138, "TPUHealthCheckFailed"
+    )
+    client.update_status(objects.PODS, pod)
+    sync_once(tc, job.key)
+    assert all(sched.placer.is_cordoned(g, c) for g, c in old_cells)
+    new_cells = placement_cells(client, "default", "selfcheck")
+    assert new_cells and not (set(new_cells) & set(old_cells))
+
+
+def test_slice_degraded_condition_tracks_suspicion():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    job = submit(client, tpu_job("degraded"))
+    sync_once(tc, job.key)
+    monitor.tick(T0)
+    monitor.record_pod_exit("default/degraded", "uid-a", 137, now=T0)
+    sync_once(tc, job.key)
+    job2 = fresh_job(client, "default", "degraded")
+    cond = status_engine.get_condition(
+        job2.status, JobConditionType.SLICE_DEGRADED
+    )
+    assert cond is not None and "v4:" in cond.message
+    # Decay forgives the lone restart; the condition flips False.
+    monitor.tick(T0 + 30)
+    sync_once(tc, job.key)
+    sync_once(tc, job.key)
+    job3 = fresh_job(client, "default", "degraded")
+    assert not status_engine.has_condition(
+        job3.status, JobConditionType.SLICE_DEGRADED
+    )
+
+
+# ---------------------------------------------------------------------------
+# persistence / recovery
+# ---------------------------------------------------------------------------
+
+def test_cordons_survive_monitor_restart():
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 2)})
+    monitor.cordon("v4", [(0, 0, 0), (1, 1, 1)], now=T0)
+    record = client.get(objects.CONFIGMAPS, RECORD_NAMESPACE, RECORD_NAME)
+    assert len(json.loads(record["data"]["cells"])) == 2
+
+    # Successor incarnation: fresh scheduler + monitor over the same store.
+    sched2 = GangScheduler(config=SchedulerConfig(capacity={"v4": (2, 2, 2)}))
+    FleetHealthMonitor(sched2, client=client, config=fast_config())
+    assert sched2.placer.is_cordoned("v4", (0, 0, 0))
+    assert sched2.placer.is_cordoned("v4", (1, 1, 1))
+    job = submit(client, tpu_job("post-crash"))
+    assert not sched2.reconcile_gang(job).admitted  # block is broken
+
+
+def test_deferred_migration_retried_by_poll():
+    """A failed cordon persist defers the eviction (never evict what a
+    successor would re-place on the same cells) — the poll retries both."""
+    from tf_operator_tpu.runtime.client import ApiError
+
+    class FlakyStore(InMemoryCluster):
+        fail_cm = False
+
+        def patch_merge(self, kind, namespace, name, patch):
+            if self.fail_cm and kind == objects.CONFIGMAPS:
+                raise ApiError("injected outage")
+            return super().patch_merge(kind, namespace, name, patch)
+
+        def create(self, kind, obj):
+            if self.fail_cm and kind == objects.CONFIGMAPS:
+                raise ApiError("injected outage")
+            return super().create(kind, obj)
+
+    client = FlakyStore()
+    client_, sched, monitor, tc = mk_stack(
+        capacity={"v4": (2, 2, 4)}, client=client
+    )
+    job = submit(client, tpu_job("deferred"))
+    sync_once(tc, job.key)
+    old_cells = placement_cells(client, "default", "deferred")
+
+    client.fail_cm = True
+    assert monitor.drain("v4", [c for _, c in old_cells]) == []  # deferred
+    # Cells ARE excluded in-memory (no new placement can land on them)...
+    assert all(sched.placer.is_cordoned(g, c) for g, c in old_cells)
+    # ...but the gang was not evicted (its annotations are untouched).
+    assert placement_cells(client, "default", "deferred") == old_cells
+
+    # A poll while the record is STILL unpersistable must keep deferring:
+    # evicting now would hand a crash-successor no cordon to recover.
+    monitor.poll(time.time())
+    assert placement_cells(client, "default", "deferred") == old_cells
+
+    client.fail_cm = False
+    monitor.poll(time.time())  # persist retried, then the migration sweep
+    new_cells = placement_cells(client, "default", "deferred")
+    assert new_cells and not (set(new_cells) & set(old_cells))
+    assert client.get(objects.CONFIGMAPS, RECORD_NAMESPACE, RECORD_NAME)
+
+
+# ---------------------------------------------------------------------------
+# observability: /debug/health, tpuctl, executor reason, metric families
+# ---------------------------------------------------------------------------
+
+def test_debug_health_endpoint_and_tpuctl_cli(capsys):
+    from tf_operator_tpu.cli import tpuctl
+    from tf_operator_tpu.runtime.apiserver import ApiServer
+    from tf_operator_tpu.runtime.observability import mount_observability
+
+    client, sched, monitor, tc = mk_stack(capacity={"v4": (2, 2, 4)})
+    server = ApiServer(client, host="127.0.0.1", port=0)
+    mount_observability(server, scheduler=sched, health=monitor)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        assert tpuctl.main(["--master", base, "health"]) == 0
+        assert "Fleet healthy" in capsys.readouterr().out
+
+        assert tpuctl.main(
+            ["--master", base, "cordon", "v4", "0,0,0", "0,0,1"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "cordon: v4" in out
+        assert sched.placer.is_cordoned("v4", (0, 0, 0))
+
+        assert tpuctl.main(["--master", base, "health"]) == 0
+        out = capsys.readouterr().out
+        assert "Cordoned=2" in out and "0,0,1" in out
+
+        assert tpuctl.main(
+            ["--master", base, "drain", "v4", "1,1,3", "--at", "3600"]
+        ) == 0
+        capsys.readouterr()
+        snap = json.loads(
+            __import__("urllib.request", fromlist=["request"]).urlopen(
+                base + "/debug/health", timeout=5
+            ).read()
+        )
+        drained = [c for c in snap["cells"] if c["cell"] == [1, 1, 3]]
+        assert drained and drained[0]["deadline"] > time.time()
+
+        assert tpuctl.main(
+            ["--master", base, "uncordon", "v4", "0,0,0", "0,0,1", "1,1,3"]
+        ) == 0
+        capsys.readouterr()
+        assert not sched.placer.is_cordoned("v4", (0, 0, 0))
+
+        assert tpuctl.main(["--master", base, "health", "-o", "json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["cells"] == []
+    finally:
+        server.stop()
+
+
+def test_executor_stamps_health_check_reason():
+    from tf_operator_tpu.runtime.executor import LocalProcessExecutor
+
+    client = InMemoryCluster()
+    pod = objects.new_pod(
+        "hc-pod", containers=[{"name": constants.DEFAULT_CONTAINER_NAME}]
+    )
+    client.create(objects.PODS, pod)
+    ex = LocalProcessExecutor(client)
+    stored = client.get(objects.PODS, "default", "hc-pod")
+    ex._set_phase(stored, objects.FAILED, exit_code=138)
+    fresh = client.get(objects.PODS, "default", "hc-pod")
+    assert objects.terminated_exit_code(
+        fresh, constants.DEFAULT_CONTAINER_NAME
+    ) == 138
+    assert objects.terminated_reason(
+        fresh, constants.DEFAULT_CONTAINER_NAME
+    ) == "TPUHealthCheckFailed"
+
+
+def test_health_metric_families_exported():
+    from tf_operator_tpu.runtime.metrics import REGISTRY
+
+    rendered = REGISTRY.render()
+    for family in (
+        "tpu_health_cells",
+        "tpu_health_signals_total",
+        "tpu_health_cordons_total",
+        "tpu_health_uncordons_total",
+        "tpu_health_migrations_total",
+    ):
+        assert family in rendered
+
+
+def test_cells_gauge_zeroed_when_generation_heals():
+    """Gauge series persist their last value: uncordoning the last
+    tracked cell of a generation must write the series back to 0, not
+    leave a stale Cordoned=1 on /metrics forever."""
+    from tf_operator_tpu.runtime.metrics import HEALTH_CELLS
+
+    _, sched, monitor, _ = mk_stack()
+    monitor.cordon("v4", [(0, 0, 0)], now=T0)
+    assert HEALTH_CELLS.value(generation="v4", state=STATE_CORDONED) == 1
+    monitor.uncordon("v4", [(0, 0, 0)])
+    assert HEALTH_CELLS.value(generation="v4", state=STATE_CORDONED) == 0

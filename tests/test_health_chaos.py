@@ -1,0 +1,246 @@
+"""Fleet-health chaos: controller crashes mid-migration, on both cluster
+backends (in-memory store directly, and the wire-level Kubernetes stub via
+KubeClusterClient).
+
+Invariants under test — the ISSUE 2 acceptance contract:
+
+- the gang is recovered EXACTLY ONCE: after recovery there is one complete
+  pod set, on cells disjoint from the cordon, released as one unit;
+- no partial slice ever runs (the PR 1 watch: Running pods and gated pods
+  never coexist for one job);
+- no pod of the gang ends up running on a cordoned cell once recovery
+  finishes — the drained cells stay excluded from placement until
+  uncordoned.
+
+Crash boundaries exercised (the migration pipeline persists in this order:
+cordon record → job eviction annotations → pod deletions → re-admission):
+
+  A. after the cordon record persisted, before any eviction started;
+  B. after the eviction annotations (state=queued + migrated-at) landed,
+     before the pod deletion loop ran — the interrupted-eviction case;
+  C. after eviction completed (pods deleted, gang requeued), before the
+     re-placed gang's pods were recreated/released.
+"""
+
+import json
+
+import pytest
+
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.health import FleetHealthMonitor, HealthConfig
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.scheduler import GangScheduler, SchedulerConfig
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_MIGRATED_AT,
+    ANNOTATION_PLACEMENTS,
+    ANNOTATION_STATE,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    is_gated,
+)
+from tf_operator_tpu.scheduler.placement import Placement
+from tests.test_chaos import (
+    PartialSliceWatch,
+    gang_job,
+    hammer_running,
+    job_pods,
+    running_count,
+)
+
+pytestmark = [pytest.mark.health, pytest.mark.scheduler]
+
+# Two v4-8 blocks: one to run on, one healthy spare to migrate onto.
+CAPACITY = {"v4": (2, 2, 4)}
+
+
+@pytest.fixture(params=["memcluster", "kubestub"])
+def health_backend(request):
+    """(client, store, stub|None): controller-facing client + the
+    authoritative InMemoryCluster behind it."""
+    if request.param == "memcluster":
+        store = InMemoryCluster()
+        yield store, store, None
+        return
+    stub = KubeApiStub()
+    stub.start()
+    try:
+        yield KubeClusterClient(KubeConfig(server=stub.url)), stub.cluster, stub
+    finally:
+        stub.stop()
+
+
+def mk_incarnation(client):
+    """One controller incarnation: scheduler + health monitor + controller,
+    wired the way the operator wires them (monitor first, so the
+    controller's attach recovers any persisted cordons)."""
+    sched = GangScheduler(config=SchedulerConfig(capacity=CAPACITY))
+    monitor = FleetHealthMonitor(
+        sched, config=HealthConfig(repair_after=3600.0)
+    )
+    tc = TPUJobController(
+        client,
+        JobControllerConfig(reconcile_period=0.2),
+        recorder=FakeRecorder(),
+        scheduler=sched,
+    )
+    return sched, monitor, tc
+
+
+def sync(tc, key):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(key)
+
+
+def cells_of(store, name):
+    ann = store.get(objects.TPUJOBS, "default", name)["metadata"][
+        "annotations"]
+    cells = []
+    for d in json.loads(ann.get(ANNOTATION_PLACEMENTS, "[]")):
+        p = Placement.from_dict(d)
+        cells.extend(p.cells())
+    return cells
+
+
+def start_running_gang(client, store, tc, name="prod"):
+    """Admit + create + release + run a v4-8 gang; returns its cells."""
+    client.create(objects.TPUJOBS, gang_job(name))
+    sync(tc, f"default/{name}")
+    sync(tc, f"default/{name}")  # informer observes the creations
+    hammer_running(client, store, name, 0.1)
+    assert running_count(store, name) == 2
+    return cells_of(store, name)
+
+
+def recover_and_settle(client, store, name, old_cells, syncs=4):
+    """Successor incarnation: recover, drive syncs until the gang runs
+    again, then assert the exactly-once/no-cordoned-cell contract."""
+    sched2, monitor2, tc2 = mk_incarnation(client)
+    # The persisted cordon record came back before the first sync.
+    assert all(sched2.placer.is_cordoned("v4", c) for c in old_cells)
+
+    watch = PartialSliceWatch(store, [name])
+    watch.start()
+    try:
+        for _ in range(syncs):
+            sync(tc2, f"default/{name}")
+            hammer_running(client, store, name, 0.05)
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations
+
+    # Exactly once: one complete, fully-released pod set.
+    pods = job_pods(store, name)
+    assert len(pods) == 2, f"expected one whole gang, got {len(pods)} pods"
+    assert all(not is_gated(p) for p in pods)
+    assert running_count(store, name) == 2
+
+    # Re-placed on healthy cells: the store's recorded placement is
+    # disjoint from the cordon, and the store agrees it is admitted.
+    ann = store.get(objects.TPUJOBS, "default", name)["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_ADMITTED
+    new_cells = cells_of(store, name)
+    assert new_cells and not (set(new_cells) & set(old_cells))
+    # And the drained cells are still excluded until uncordoned: a rival
+    # v4-8 gang has nowhere to go.
+    client.create(objects.TPUJOBS, gang_job("rival"))
+    sync(tc2, "default/rival")
+    assert job_pods(store, "rival") == []
+    monitor2.uncordon("v4", old_cells)
+    sync(tc2, "default/rival")
+    assert len(job_pods(store, "rival")) == 2
+    return sched2, monitor2, tc2
+
+
+def test_crash_after_cordon_persist_before_migration(health_backend):
+    """Boundary A: the cordon record landed, the controller died before
+    evicting anything. The successor recovers the cordon and the
+    reconcile-time cordon check migrates the recovered gang."""
+    client, store, stub = health_backend
+    sched1, monitor1, tc1 = mk_incarnation(client)
+    old_cells = start_running_gang(client, store, tc1)
+
+    # Simulated crash point: the monitor persists the cordon, then dies
+    # before driving a single migration.
+    sched1.migrate_gang = lambda key, reason="": False
+    assert monitor1.drain("v4", old_cells) == []
+
+    # The job is untouched on the wire — still admitted, still running on
+    # the now-cordoned cells, no checkpoint signal yet.
+    ann = store.get(objects.TPUJOBS, "default", "prod")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_ADMITTED
+    assert ANNOTATION_MIGRATED_AT not in ann
+    assert running_count(store, "prod") == 2
+
+    recover_and_settle(client, store, "prod", old_cells)
+    # The recovery migration stamped the checkpoint signal exactly once.
+    ann = store.get(objects.TPUJOBS, "default", "prod")["metadata"][
+        "annotations"]
+    assert ANNOTATION_MIGRATED_AT in ann
+
+
+def test_crash_between_eviction_persist_and_pod_deletion(health_backend):
+    """Boundary B: state=queued + migrated-at persisted, the controller
+    died before any pod delete landed. The successor must FINISH the
+    eviction before re-admitting — never resurrect the gang in place on
+    cordoned cells."""
+    client, store, stub = health_backend
+    sched1, monitor1, tc1 = mk_incarnation(client)
+    old_cells = start_running_gang(client, store, tc1)
+
+    class CrashingDeletes:
+        """Client proxy: the annotation persist goes through; the first
+        pod delete is where the controller 'dies'."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def delete(self, kind, namespace, name):
+            if kind == objects.PODS:
+                raise ApiError("simulated crash mid-eviction")
+            return self._inner.delete(kind, namespace, name)
+
+    sched1.client = CrashingDeletes(client)
+    monitor1.drain("v4", old_cells)  # eviction aborts at the delete loop
+
+    # The wire says queued + migrated-at, but the whole gang still exists
+    # (nothing was deleted) — the interrupted-eviction world.
+    ann = store.get(objects.TPUJOBS, "default", "prod")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+    assert ANNOTATION_MIGRATED_AT in ann
+    assert len(job_pods(store, "prod")) == 2
+
+    recover_and_settle(client, store, "prod", old_cells)
+
+
+def test_crash_after_eviction_before_replacement(health_backend):
+    """Boundary C: the eviction fully ran (pods deleted, gang requeued)
+    but the controller died before the re-placed gang's pods existed."""
+    client, store, stub = health_backend
+    sched1, monitor1, tc1 = mk_incarnation(client)
+    old_cells = start_running_gang(client, store, tc1)
+
+    # Freeze the pump so the eviction completes but re-admission never
+    # happens in this incarnation (the crash point).
+    sched1._pump = lambda: None
+    monitor1.drain("v4", old_cells)
+    ann = store.get(objects.TPUJOBS, "default", "prod")["metadata"][
+        "annotations"]
+    assert ann[ANNOTATION_STATE] == STATE_QUEUED
+    assert job_pods(store, "prod") == []  # evicted whole
+
+    recover_and_settle(client, store, "prod", old_cells)

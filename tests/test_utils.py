@@ -13,8 +13,10 @@ from tf_operator_tpu.utils import exit_codes, logger, names
 
 
 class TestExitCodes:
-    @pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 139])
+    @pytest.mark.parametrize("code", [1, 2, 126, 127, 128, 134, 139])
     def test_permanent(self, code):
+        # 134 = SIGABRT (XLA/runtime aborts) and 139 = SIGSEGV are app
+        # bugs despite being >128: enumerated in _PERMANENT_SIGNAL_EXITS.
         assert exit_codes.is_permanent(code)
         assert not exit_codes.is_retryable(code)
 

@@ -57,15 +57,22 @@ class CleanPodPolicy:
 
 
 class JobConditionType:
-    """Parity: v1alpha2/types.go:190-216."""
+    """Parity: v1alpha2/types.go:190-216, extended with the fleet-health
+    conditions (SliceDegraded: the gang's cells carry open suspicion or a
+    cordon; JobMigrating: the gang was evicted off draining/cordoned cells
+    and awaits re-placement). Both are auxiliary — they ride alongside the
+    lifecycle conditions and never gate the terminal state machine."""
 
     CREATED = "Created"
     RUNNING = "Running"
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    SLICE_DEGRADED = "SliceDegraded"
+    JOB_MIGRATING = "JobMigrating"
 
-    ALL = (CREATED, RUNNING, RESTARTING, SUCCEEDED, FAILED)
+    ALL = (CREATED, RUNNING, RESTARTING, SUCCEEDED, FAILED,
+           SLICE_DEGRADED, JOB_MIGRATING)
 
 
 # ---------------------------------------------------------------------------

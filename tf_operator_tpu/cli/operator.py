@@ -66,6 +66,24 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false", default=True,
                    help="never evict lower-priority gangs to admit a "
                         "higher-priority one")
+    # Fleet health & auto-repair (tf_operator_tpu/health/): node heartbeats,
+    # exit-138 attribution and restart churn feed per-cell health states;
+    # cordoned cells are excluded from placement and gangs on them are
+    # checkpoint-signaled and migrated whole.
+    p.add_argument("--disable-fleet-health", dest="fleet_health",
+                   action="store_false", default=True,
+                   help="run without the fleet-health monitor (no cordons, "
+                        "no maintenance-aware migration)")
+    p.add_argument("--health-poll-interval", type=float, default=2.0,
+                   help="seconds between health monitor sweeps "
+                        "(heartbeats, repair clocks, deferred migrations)")
+    p.add_argument("--health-suspect-threshold", type=float, default=3.0,
+                   help="suspect score at which a cell auto-cordons")
+    p.add_argument("--health-repair-after", type=float, default=30.0,
+                   help="seconds a cordon holds before the repair probe")
+    p.add_argument("--health-probe-window", type=float, default=30.0,
+                   help="quiet seconds in the repair probe before a cell "
+                        "auto-uncordons")
     p.add_argument("--json-log", action="store_true", help="structured JSON logs")
     p.add_argument("--version", action="store_true", help="print version and exit")
     # Runtime wiring: the backing store is the in-process store (default),
@@ -222,6 +240,20 @@ def main(argv: list[str] | None = None) -> int:
         gate_pods=args.gang,
     ))
 
+    # --- fleet health monitor ----------------------------------------------
+    health = None
+    if args.fleet_health:
+        from tf_operator_tpu.health import FleetHealthMonitor, HealthConfig
+
+        health = FleetHealthMonitor(
+            scheduler,
+            config=HealthConfig(
+                suspect_threshold=args.health_suspect_threshold,
+                repair_after=args.health_repair_after,
+                probe_window=args.health_probe_window,
+            ),
+        )
+
     api_server = None
     if args.serve is not None:
         if args.master:
@@ -255,7 +287,7 @@ def main(argv: list[str] | None = None) -> int:
         # unmatched GET, which would shadow /metrics with index.html.
         from tf_operator_tpu.runtime.observability import mount_observability
 
-        mount_observability(api_server, scheduler=scheduler)
+        mount_observability(api_server, scheduler=scheduler, health=health)
         if args.dashboard:
             from tf_operator_tpu.dashboard.backend import mount_dashboard
 
@@ -275,6 +307,11 @@ def main(argv: list[str] | None = None) -> int:
 
     def run_controller(leading_stop: threading.Event) -> None:
         controller = TPUJobController(client, cfg, scheduler=scheduler)
+        if health is not None:
+            # Attached by the controller (client + recorder, cordon
+            # recovery); the poll loop runs only while leading — a
+            # standby must not cordon or migrate anything.
+            health.start(leading_stop, interval=args.health_poll_interval)
         if args.local_executor:
             from tf_operator_tpu.runtime.executor import LocalProcessExecutor
             from tf_operator_tpu.runtime.gc import OwnerGarbageCollector

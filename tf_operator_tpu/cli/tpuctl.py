@@ -13,6 +13,10 @@ ctl. Commands mirror the kubectl verbs users already know:
     tpuctl logs NS/POD [-f]                 # pod logs (stream with -f)
     tpuctl wait NS/NAME [--for Succeeded] [--timeout 300]
     tpuctl queue [-o json]                  # gang-admission queue/capacity
+    tpuctl health [-o json]                 # fleet health: cell states
+    tpuctl cordon v4 0,0,0 0,0,1            # pin cells out of placement
+    tpuctl uncordon v4 0,0,0 0,0,1          # return cells to service
+    tpuctl drain v4 0,0,0 --at 3600         # maintenance notice + migrate
 
 The server is ``--master`` / $TPU_OPERATOR_MASTER (default
 http://127.0.0.1:8080 — the operator's --serve address). Write auth rides
@@ -395,6 +399,98 @@ def cmd_queue(args, master: str) -> int:
     return 0
 
 
+def _health_request(master: str, path: str, body: dict | None = None):
+    """GET (body None) or POST against the operator's /debug/health API.
+    Mutations ride the same bearer token as every other write."""
+    url = f"{master.rstrip('/')}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method="POST" if body is not None else "GET"
+    )
+    if body is not None:
+        req.add_header("Content-Type", "application/json")
+    token = os.environ.get("TPU_OPERATOR_API_TOKEN")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+        detail = ""
+        try:
+            detail = json.loads(e.read()).get("message", "")
+        except Exception:
+            pass
+        raise SystemExit(
+            f"tpuctl: health API unavailable ({e.code}"
+            + (f": {detail}" if detail else "")
+            + ") — is the operator serving with fleet health enabled?"
+        ) from None
+
+
+def _parse_cli_cells(raw: list[str]) -> list[list[int]]:
+    """"0,0,1" → [0, 0, 1] per argument."""
+    cells = []
+    for item in raw:
+        try:
+            cells.append([int(x) for x in item.split(",") if x != ""])
+        except ValueError:
+            raise SystemExit(
+                f"tpuctl: cell must be comma-separated ints, got {item!r}"
+            ) from None
+    return cells
+
+
+def cmd_health(args, master: str) -> int:
+    """Render /debug/health: per-cell states, counts, and the tuning the
+    monitor runs with — the fleet's answer to `kubectl get nodes` plus
+    `kubectl describe node` rolled into mesh coordinates."""
+    snap = _health_request(master, "/debug/health")
+    if args.output == "json":
+        print(json.dumps(snap, indent=2))
+        return 0
+    counts = snap.get("counts") or {}
+    if counts:
+        print("Cells with open suspicion/cordons: " + ", ".join(
+            f"{state}={n}" for state, n in sorted(counts.items())
+        ))
+    else:
+        print("Fleet healthy: no cells under suspicion or cordon")
+    cells = snap.get("cells") or []
+    if cells:
+        print()
+        print(_table(
+            [[c.get("generation", ""),
+              ",".join(str(x) for x in c.get("cell", [])),
+              c.get("state", ""),
+              f"{c.get('score', 0):.1f}",
+              c.get("source", ""),
+              "yes" if c.get("manual") else ""]
+             for c in cells],
+            ["GENERATION", "CELL", "STATE", "SCORE", "SOURCE", "PINNED"],
+        ))
+    return 0
+
+
+def cmd_cordon(args, master: str, verb: str) -> int:
+    """cordon/uncordon/drain: POST the verb to the operator. Drain carries
+    a maintenance deadline (--at seconds from now) — the injected stand-in
+    for a GCE maintenance event."""
+    body: dict = {
+        "generation": args.generation,
+        "cells": _parse_cli_cells(args.cells),
+    }
+    if verb == "drain" and args.at is not None:
+        body["deadlineSeconds"] = args.at
+    out = _health_request(master, f"/debug/health/{verb}", body)
+    cells = ";".join(",".join(str(x) for x in c) for c in out.get("cells", []))
+    print(f"{verb}: {out.get('generation')} [{cells}]")
+    migrated = out.get("migrated") or []
+    for key in migrated:
+        print(f"  migrating gang {key} off the cells")
+    return 0
+
+
 def cmd_wait(args, client: TPUJobClient) -> int:
     ns, name = _split_ref(args.ref)
     if args.condition == "Deleted":
@@ -479,11 +575,32 @@ def main(argv: list[str] | None = None) -> int:
     q.add_argument("-o", "--output", choices=("table", "json"),
                    default="table")
 
+    h = sub.add_parser("health", help="fleet health: cell states / cordons")
+    h.add_argument("-o", "--output", choices=("table", "json"),
+                   default="table")
+    for verb, help_text in (
+        ("cordon", "withdraw mesh cells from placement (operator-pinned)"),
+        ("uncordon", "return mesh cells to service"),
+        ("drain", "maintenance notice: cordon cells + migrate gangs now"),
+    ):
+        vp = sub.add_parser(verb, help=help_text)
+        vp.add_argument("generation", help="TPU generation, e.g. v4")
+        vp.add_argument("cells", nargs="+",
+                        help='mesh cells as "x,y[,z]", e.g. 0,0,1')
+        if verb == "drain":
+            vp.add_argument("--at", type=float, default=None, metavar="SECS",
+                            help="maintenance deadline, seconds from now "
+                                 "(repair probing starts after it)")
+
     args = p.parse_args(argv)
     if args.cmd == "logs":
         return cmd_logs(args, args.master)
     if args.cmd == "queue":
         return cmd_queue(args, args.master)
+    if args.cmd == "health":
+        return cmd_health(args, args.master)
+    if args.cmd in ("cordon", "uncordon", "drain"):
+        return cmd_cordon(args, args.master, args.cmd)
     client = TPUJobClient(RestClusterClient(args.master))
     try:
         return {

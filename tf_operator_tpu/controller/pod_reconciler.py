@@ -186,6 +186,19 @@ class PodReconciler:
             pod = bucket[0]
             if objects.pod_phase(pod) != objects.FAILED:
                 continue
+            # Fleet-health cell attribution: every failed exit is reported
+            # back to the cells the gang occupies (the monitor dedupes per
+            # pod incarnation and scores only health-relevant codes —
+            # exit-138 reports strongly, retryable churn weakly).
+            report = getattr(self, "report_pod_exit", None)
+            if report is not None:
+                report(
+                    job,
+                    pod,
+                    objects.terminated_exit_code(
+                        pod, constants.DEFAULT_CONTAINER_NAME
+                    ),
+                )
             policy = spec.restart_policy
             if policy == RestartPolicy.EXIT_CODE:
                 code = objects.terminated_exit_code(

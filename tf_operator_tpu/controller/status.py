@@ -30,16 +30,23 @@ REASON_RUNNING = "TPUJobRunning"
 REASON_RESTARTING = "TPUJobRestarting"
 REASON_SUCCEEDED = "TPUJobSucceeded"
 REASON_FAILED = "TPUJobFailed"
+# Fleet-health reasons (health/monitor.py drives these via the controller).
+REASON_SLICE_DEGRADED = "SliceHealthSuspect"
+REASON_SLICE_HEALTHY = "SliceHealthy"
+REASON_MIGRATING = "SliceDraining"
+REASON_MIGRATED = "MigrationComplete"
 
 TRUE = "True"
 FALSE = "False"
 
 
-def new_condition(ctype: str, reason: str, message: str) -> JobCondition:
+def new_condition(
+    ctype: str, reason: str, message: str, status: str = TRUE
+) -> JobCondition:
     now = objects.now_iso()
     return JobCondition(
         type=ctype,
-        status=TRUE,
+        status=status,
         reason=reason,
         message=message,
         last_update_time=now,
@@ -120,9 +127,9 @@ def set_condition(status: TPUJobStatus, cond: JobCondition) -> None:
 
 
 def update_job_conditions(
-    job: TPUJob, ctype: str, reason: str, message: str
+    job: TPUJob, ctype: str, reason: str, message: str, status: str = TRUE
 ) -> None:
-    set_condition(job.status, new_condition(ctype, reason, message))
+    set_condition(job.status, new_condition(ctype, reason, message, status))
 
 
 def initialize_replica_statuses(job: TPUJob, replica_type: str) -> None:

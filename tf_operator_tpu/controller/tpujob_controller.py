@@ -93,6 +93,14 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         # pipeline so no partial slice can ever run.
         self.scheduler = scheduler or GangScheduler()
         self.scheduler.attach(client, recorder, wakeup=self.enqueue)
+        # Fleet-health monitor (health/monitor.py), when one was wired onto
+        # the scheduler (operator main builds it; tests construct their
+        # own). Attaching recovers persisted cordons before the first sync
+        # so a restarted controller never re-places a gang on withdrawn
+        # cells. Without a monitor the health surfaces stay dormant.
+        self.health = getattr(self.scheduler, "health", None)
+        if self.health is not None:
+            self.health.attach(client, recorder)
         self.job_informer = Informer(
             client, objects.TPUJOBS, self.config.namespace, self.config.informer_resync
         )
@@ -307,6 +315,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
             and admitted
         ):
             self.sync_pdb(job, total_replicas)
+
+        # Fleet-health conditions (SliceDegraded/JobMigrating): surfaced on
+        # every sync so operators see degradation and in-flight migrations
+        # on the job object itself, not only in /debug/health.
+        if self.health is not None and self.config.enable_gang_scheduling:
+            self._sync_health_conditions(job, admitted)
 
         if not admitted:
             if pods:
@@ -564,6 +578,99 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
                 status_engine.REASON_RESTARTING,
                 f"TPUJob {name} is restarting ({job.status.restart_count} restart(s) total).",
             )
+
+    def _sync_health_conditions(self, job: TPUJob, admitted: bool) -> None:
+        """Roll fleet-health state up into job conditions + events.
+
+        - JobMigrating=True while the gang carries the migrated-at marker
+          and is not (yet) re-admitted; flipped False (MigrationComplete)
+          once the gang holds a fresh admission.
+        - SliceDegraded=True while an admitted gang's placement includes
+          cells with open suspicion or a cordon (named in the message);
+          flipped False when the cells heal or the gang moved elsewhere.
+        Both transitions emit one event each (set_condition dedupes
+        semantically-identical updates, so steady state writes nothing).
+        """
+        from tf_operator_tpu.scheduler.gang import (
+            ANNOTATION_MIGRATED_AT,
+            ANNOTATION_PREEMPTED_AT,
+        )
+
+        ann = job.metadata.annotations or {}
+        migrated_at = ann.get(ANNOTATION_MIGRATED_AT, "")
+        # migrated-at outlives the migration on the job (annotations are
+        # never garbage-collected); a LATER ordinary preemption must not
+        # resurrect JobMigrating off the stale stamp. Migration writes
+        # both stamps with one timestamp, so "this eviction was a
+        # migration" ⇔ migrated-at >= preempted-at (ISO strings compare
+        # lexicographically).
+        migrating_now = (
+            bool(migrated_at)
+            and migrated_at >= ann.get(ANNOTATION_PREEMPTED_AT, "")
+            and not admitted
+        )
+        was_migrating = status_engine.has_condition(
+            job.status, JobConditionType.JOB_MIGRATING
+        )
+        if migrating_now and not was_migrating:
+            msg = (
+                "gang evicted off draining/cordoned cells at "
+                f"{ann.get(ANNOTATION_MIGRATED_AT)}; awaiting re-placement "
+                "on healthy cells"
+            )
+            status_engine.update_job_conditions(
+                job, JobConditionType.JOB_MIGRATING,
+                status_engine.REASON_MIGRATING, msg,
+            )
+            self.recorder.warning(
+                job.to_dict(), status_engine.REASON_MIGRATING, msg
+            )
+        elif admitted and was_migrating:
+            msg = "migration complete; gang re-placed on healthy cells"
+            status_engine.update_job_conditions(
+                job, JobConditionType.JOB_MIGRATING,
+                status_engine.REASON_MIGRATED, msg, status=status_engine.FALSE,
+            )
+            self.recorder.normal(
+                job.to_dict(), status_engine.REASON_MIGRATED, msg
+            )
+
+        degraded = (
+            self.health.degraded_cells_for(job.key) if admitted else []
+        )
+        was_degraded = status_engine.has_condition(
+            job.status, JobConditionType.SLICE_DEGRADED
+        )
+        if degraded:
+            msg = (
+                "slice placement includes unhealthy cells: "
+                + ", ".join(degraded[:8])
+                + ("…" if len(degraded) > 8 else "")
+            )
+            status_engine.update_job_conditions(
+                job, JobConditionType.SLICE_DEGRADED,
+                status_engine.REASON_SLICE_DEGRADED, msg,
+            )
+            if not was_degraded:
+                self.recorder.warning(
+                    job.to_dict(), status_engine.REASON_SLICE_DEGRADED, msg
+                )
+        elif was_degraded:
+            status_engine.update_job_conditions(
+                job, JobConditionType.SLICE_DEGRADED,
+                status_engine.REASON_SLICE_HEALTHY,
+                "slice cells healthy", status=status_engine.FALSE,
+            )
+
+    def report_pod_exit(
+        self, job: TPUJob, pod: dict[str, Any], exit_code: int | None
+    ) -> None:
+        """Pod-reconciler hook (cell attribution): forward a failed pod's
+        exit to the health monitor, which scores it against the cells the
+        gang occupies."""
+        if self.health is None or exit_code is None:
+            return
+        self.health.record_pod_exit(job.key, objects.uid_of(pod), exit_code)
 
     def _terminal_already_recorded(self, job: TPUJob, ctype: str) -> bool:
         """Terminal-once guard without a per-sync API round-trip.

@@ -1,0 +1,46 @@
+"""Fleet health & auto-repair: cordon/drain, maintenance-aware migration,
+and degraded-slice detection.
+
+The reference operator only ever reacts to failures after a container dies
+(exit-code → retry); on real TPU fleets most capacity loss is announced or
+observable before the crash — maintenance events, ICI link degradation,
+hosts going NotReady. This subsystem makes host/chip health a first-class
+scheduling input: per-cell health states over the same mesh coordinates
+the placer allocates from, multi-source signal ingestion, cordon-aware
+placement, and checkpoint-signaled whole-gang migration ahead of failures.
+
+See docs/health.md for the state machine, signal sources, and the
+migration flow; tools/health_smoke.py runs the marked test subset.
+"""
+
+from tf_operator_tpu.health.model import (
+    SOURCE_EXIT_REPORT,
+    SOURCE_HEARTBEAT,
+    SOURCE_MAINTENANCE,
+    SOURCE_MANUAL,
+    SOURCE_RESTART_CHURN,
+    STATE_CORDONED,
+    STATE_HEALTHY,
+    STATE_REPAIRING,
+    STATE_SUSPECT,
+    CellHealth,
+    HealthConfig,
+    MaintenanceNotice,
+)
+from tf_operator_tpu.health.monitor import FleetHealthMonitor
+
+__all__ = [
+    "CellHealth",
+    "FleetHealthMonitor",
+    "HealthConfig",
+    "MaintenanceNotice",
+    "SOURCE_EXIT_REPORT",
+    "SOURCE_HEARTBEAT",
+    "SOURCE_MAINTENANCE",
+    "SOURCE_MANUAL",
+    "SOURCE_RESTART_CHURN",
+    "STATE_CORDONED",
+    "STATE_HEALTHY",
+    "STATE_REPAIRING",
+    "STATE_SUSPECT",
+]

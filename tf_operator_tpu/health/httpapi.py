@@ -1,0 +1,95 @@
+"""/debug/health HTTP surface: the fleet-health snapshot plus the
+cordon/uncordon/drain verbs `tpuctl health|cordon|uncordon|drain` drive.
+
+Mounts on the operator's ApiServer via its extra-handler hook (the same
+mechanism the dashboard and /metrics use). Mutating verbs are POSTs, so
+they ride the server's bearer-token write gate automatically.
+
+    GET  /debug/health            → FleetHealthMonitor.snapshot()
+    POST /debug/health/cordon     {"generation": "v4", "cells": [[0,0,0],…]}
+    POST /debug/health/uncordon   same body
+    POST /debug/health/drain      same body + "deadlineSeconds": 3600
+                                  (maintenance deadline relative to now —
+                                  relative so client clock skew is moot)
+
+The drain endpoint is the injection point standing in for GCE maintenance
+events: anything that learns of upcoming maintenance (a cloud-ops webhook,
+a cron, an operator) POSTs the notice and the fleet migrates ahead of it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any
+
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="health-api")
+
+
+def _parse_cells(body: dict[str, Any]) -> list[tuple[int, ...]]:
+    cells = body.get("cells")
+    if not isinstance(cells, list) or not cells:
+        raise ValueError("body must carry a non-empty 'cells' list")
+    return [tuple(int(x) for x in c) for c in cells]
+
+
+class HealthApiHandler:
+    def __init__(self, monitor: Any) -> None:
+        self._monitor = monitor
+
+    def __call__(self, req: Any) -> bool:
+        path = req.path.split("?", 1)[0]
+        if not path.startswith("/debug/health"):
+            return False
+        if req.command == "GET" and path == "/debug/health":
+            body = json.dumps(self._monitor.snapshot(), indent=2).encode()
+            req.send_response(200)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(body)))
+            req.end_headers()
+            req.wfile.write(body)
+            return True
+        if req.command != "POST":
+            return False
+        verb = path[len("/debug/health/"):] if len(path) > len(
+            "/debug/health/"
+        ) else ""
+        if verb not in ("cordon", "uncordon", "drain"):
+            return False
+        try:
+            body = req.read_json_body()
+            generation = str(body.get("generation", "")).strip()
+            if not generation:
+                raise ValueError("body must carry a 'generation'")
+            cells = _parse_cells(body)
+            if verb == "cordon":
+                migrated = self._monitor.cordon(generation, cells)
+            elif verb == "uncordon":
+                self._monitor.uncordon(generation, cells)
+                migrated = []
+            else:
+                deadline = None
+                rel = body.get("deadlineSeconds")
+                if rel is not None:
+                    deadline = time.time() + float(rel)
+                migrated = self._monitor.drain(generation, cells, deadline)
+            req.send_json(
+                {
+                    "ok": True,
+                    "generation": generation,
+                    "cells": [list(c) for c in cells],
+                    "migrated": migrated,
+                }
+            )
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            req.send_json({"error": "BadRequest", "message": str(e)}, 400)
+        return True
+
+
+def mount_health(api_server: Any, monitor: Any) -> HealthApiHandler:
+    handler = HealthApiHandler(monitor)
+    api_server.add_handler(handler)
+    LOG.info("fleet-health API mounted at /debug/health")
+    return handler

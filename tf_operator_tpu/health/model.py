@@ -1,0 +1,158 @@
+"""Fleet-health model: per-cell state machine + tuning knobs.
+
+The unit of health is the same unit the placer allocates — one mesh cell
+(one chip) of a generation's installed torus (scheduler/placement.py).
+Hosts, slices and jobs all project onto cells: a NotReady host marks its
+cells, an exit-138 health report marks the cells of the gang that raised
+it, a maintenance notice names cells directly. Keying health on cells is
+what lets every signal source feed the same scheduling decision: a cell
+that is not Healthy is excluded from placement.
+
+State machine (driven by health/monitor.py):
+
+    Healthy ──signal──► Suspect ──score≥threshold / NotReady-grace──►
+    Cordoned ──repair_after quiet──► Repairing ──probe_window quiet──►
+    Healthy
+       ▲                                │
+       └──────── new signal ────────────┘   (re-cordon)
+
+- *Suspect*: accumulating evidence (suspect scoring decays over time —
+  one flaky restart does not brick a cell). Suspect cells still place,
+  but jobs sitting on them surface a SliceDegraded condition.
+- *Cordoned*: excluded from placement; gangs on the cells are migrated.
+  Manual cordons (`tpuctl cordon`) never auto-uncordon; maintenance
+  cordons hold at least until their deadline.
+- *Repairing*: the repair probe window — still excluded from placement;
+  one more signal re-cordons, a quiet window returns the cell to service.
+
+The ISSUE's parity anchors: MLPerf-scale pod runs (arXiv:1909.09756) and
+the TPU concurrency study (arXiv:2011.03641) both treat whole-slice health
+as the scheduling unit — one bad host strands the slice, so health must
+feed the placer, not just the restart loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+STATE_HEALTHY = "Healthy"
+STATE_SUSPECT = "Suspect"
+STATE_CORDONED = "Cordoned"
+STATE_REPAIRING = "Repairing"
+
+STATES = (STATE_HEALTHY, STATE_SUSPECT, STATE_CORDONED, STATE_REPAIRING)
+
+# Signal sources (metric label + cordon attribution).
+SOURCE_HEARTBEAT = "heartbeat"      # node NotReady / stale heartbeat
+SOURCE_EXIT_REPORT = "exit-report"  # exit-138 "TPU health check failed"
+SOURCE_RESTART_CHURN = "restart-churn"  # repeated retryable exits on a cell
+SOURCE_MAINTENANCE = "maintenance"  # injected drain notice with deadline
+SOURCE_MANUAL = "manual"            # tpuctl cordon
+
+
+@dataclass
+class HealthConfig:
+    """Tuning for the fleet-health state machine. The defaults are test-
+    and-demo scale (seconds); production deployments stretch them via the
+    operator's --health-* flags."""
+
+    # Suspect score at which a cell auto-cordons.
+    suspect_threshold: float = 3.0
+    # Score points decayed per second — the forgiveness valve that keeps
+    # one flaky restart from eventually bricking a cell.
+    suspect_decay: float = 1.0 / 60.0
+    # Signal weights. An explicit exit-138 health-check report is the
+    # workload measuring its own chips (the strongest evidence we have),
+    # so it cordons immediately by default; one retryable restart is weak
+    # evidence and needs repeats.
+    exit_report_weight: float = 3.0
+    restart_weight: float = 1.0
+    notready_weight: float = 1.0
+    # Churn signals for the SAME job within this window collapse into one:
+    # a multi-host gang failing as one incident produces one failed pod
+    # per member, and attributing every member's exit to the shared cells
+    # would cross the threshold in a single sweep — one incident is one
+    # piece of evidence, however many pods it took down. Distinct
+    # incidents are separated by a full restart cycle, which takes longer
+    # than this window. Explicit exit-138 reports are exempt (each is the
+    # workload deliberately measuring its own chips).
+    churn_interval: float = 5.0
+    # Seconds a node may stay NotReady before its cells cordon (suspect in
+    # the meantime — a kubelet blip must not evict a healthy gang).
+    notready_cordon_after: float = 10.0
+    # Seconds with no fresh heartbeat before a node counts as NotReady
+    # even when its last written Ready condition still says True.
+    heartbeat_timeout: float = 60.0
+    # Auto-repair: a (non-manual) cordon older than repair_after enters
+    # the Repairing probe; probe_window quiet seconds return it to
+    # service, any new signal re-cordons.
+    repair_after: float = 30.0
+    probe_window: float = 30.0
+
+
+@dataclass
+class CellHealth:
+    """One mesh cell's health record. Cells with no open suspicion are
+    not tracked at all — absence means Healthy."""
+
+    generation: str
+    cell: tuple[int, ...]
+    state: str = STATE_HEALTHY
+    score: float = 0.0
+    source: str = ""                 # what pushed it out of Healthy
+    last_signal_at: float = 0.0
+    notready_since: float | None = None
+    cordoned_at: float | None = None
+    repairing_since: float | None = None
+    deadline: float | None = None    # maintenance: earliest repair start
+    manual: bool = False             # operator-pinned: no auto-uncordon
+
+    @property
+    def placeable(self) -> bool:
+        """Whether the placer may use this cell (Suspect still places —
+        cordoning on a single weak signal would thrash the fleet)."""
+        return self.state in (STATE_HEALTHY, STATE_SUSPECT)
+
+    def to_dict(self) -> dict:
+        d: dict = {
+            "generation": self.generation,
+            "cell": list(self.cell),
+            "state": self.state,
+            "score": round(self.score, 3),
+            "source": self.source,
+        }
+        for key, val in (
+            ("cordonedAt", self.cordoned_at),
+            ("repairingSince", self.repairing_since),
+            ("deadline", self.deadline),
+        ):
+            if val is not None:
+                d[key] = val
+        if self.manual:
+            d["manual"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "CellHealth":
+        return cls(
+            generation=d["generation"],
+            cell=tuple(int(x) for x in d["cell"]),
+            state=d.get("state", STATE_CORDONED),
+            score=float(d.get("score", 0.0)),
+            source=d.get("source", ""),
+            cordoned_at=d.get("cordonedAt"),
+            repairing_since=d.get("repairingSince"),
+            deadline=d.get("deadline"),
+            manual=bool(d.get("manual", False)),
+        )
+
+
+@dataclass
+class MaintenanceNotice:
+    """An injected drain: these cells will be serviced at ``deadline``
+    (epoch seconds). Stands in for GCE maintenance events; arrives via
+    `tpuctl drain --at` or POST /debug/health/drain."""
+
+    generation: str
+    cells: list[tuple[int, ...]] = field(default_factory=list)
+    deadline: float | None = None

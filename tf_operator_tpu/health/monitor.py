@@ -1,0 +1,625 @@
+"""FleetHealthMonitor: signals in, cordons + migrations out.
+
+The fleet-health authority that makes host/chip health a first-class
+scheduling input. Four signal sources feed one per-cell state machine
+(health/model.py):
+
+1. **Heartbeats** — node objects on either cluster backend (memcluster
+   `heartbeat_node`, or `PUT /api/v1/nodes/{name}/status` on the wire
+   stub / a real apiserver). A node labeled with its generation and cell
+   block that goes NotReady (or whose heartbeat goes stale) marks its
+   cells Suspect, and Cordoned after a grace window.
+2. **Exit reports** — exit-138/SIGUSR1 "TPU health check failed" pod
+   exits, attributed back to the cells the gang occupied (the controller
+   forwards them via ``record_pod_exit``; placements come from the
+   scheduler). The workload measuring its own chips is the strongest
+   evidence, so a report cordons immediately by default.
+3. **Restart churn** — repeated retryable exits on the same cells score
+   suspicion that decays over time; crossing the threshold cordons.
+4. **Maintenance notices** — injected drains with a deadline
+   (`tpuctl drain --at` / POST /debug/health/drain), standing in for GCE
+   maintenance events: cordon now, migrate ahead of the failure, start
+   the repair probe only after the deadline passes.
+
+Acting on a cordon is a three-step discipline whose ORDER is the crash
+contract (mirroring scheduler/core.py's annotation-first admissions):
+
+    a. commit the cordon to the placer (in-memory: placement stops
+       handing out these cells immediately),
+    b. persist the cordon record (a ConfigMap-shaped object in the
+       store) — BEFORE any eviction,
+    c. migrate admitted gangs off the cells (scheduler.migrate_gang:
+       checkpoint-signal annotation persisted, pods deleted whole,
+       gang requeued with an aging credit, re-placed on healthy cells).
+
+A controller dying between (b) and (c) — or mid-(c) — recovers: the
+successor's monitor reads the persisted cordons back into the placer,
+and the scheduler's reconcile-time cordon check (reconcile_gang) migrates
+any recovered gang still sitting on cordoned cells; a half-finished
+eviction is completed by the existing queued-gang-with-pods cleanup. If
+(b) itself fails the migration is deferred (cells stay cordoned in this
+incarnation, so no NEW placement can land on them) and retried by the
+next poll.
+
+Auto-repair: a non-manual cordon older than ``repair_after`` enters the
+Repairing probe window; ``probe_window`` quiet seconds uncordon the cell
+(and re-pump the queue — healed capacity admits waiting gangs), while any
+fresh signal re-cordons.
+
+Lock ordering: monitor lock → scheduler lock, always. The scheduler never
+calls into the monitor.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any
+
+from tf_operator_tpu.health.model import (
+    SOURCE_EXIT_REPORT,
+    SOURCE_HEARTBEAT,
+    SOURCE_MAINTENANCE,
+    SOURCE_MANUAL,
+    SOURCE_RESTART_CHURN,
+    STATE_CORDONED,
+    STATE_HEALTHY,
+    STATE_REPAIRING,
+    STATE_SUSPECT,
+    STATES,
+    CellHealth,
+    HealthConfig,
+)
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient, NotFound
+from tf_operator_tpu.runtime.metrics import (
+    HEALTH_CELLS,
+    HEALTH_CORDONS_TOTAL,
+    HEALTH_SIGNALS_TOTAL,
+    HEALTH_UNCORDONS_TOTAL,
+)
+from tf_operator_tpu.utils import exit_codes, logger
+from tf_operator_tpu.utils.times import parse_rfc3339
+
+# The persisted cordon record: one ConfigMap-shaped object. Suspect scores
+# are soft state (losing them on restart only delays a cordon); cordons and
+# in-probe repairs are durable — a restarted controller must never place a
+# gang on a cell its predecessor withdrew.
+RECORD_NAME = "tpu-fleet-health"
+RECORD_NAMESPACE = "default"
+
+# Bound for the (job, pod-uid) exit dedupe set — a failed pod can be
+# observed by several syncs before its deletion lands, and each observation
+# must score its cells at most once.
+_SEEN_EXITS_CAP = 4096
+
+
+def _time_now() -> float:
+    import time
+
+    return time.time()
+
+
+class FleetHealthMonitor:
+    def __init__(
+        self,
+        scheduler: Any,
+        client: ClusterClient | None = None,
+        config: HealthConfig | None = None,
+        recorder: Any | None = None,
+    ) -> None:
+        self.scheduler = scheduler
+        scheduler.health = self
+        self.client = client if client is not None else scheduler.client
+        self.config = config or HealthConfig()
+        self.recorder = recorder
+        self._lock = threading.RLock()
+        self._cells: dict[tuple[str, tuple[int, ...]], CellHealth] = {}
+        self._seen_exits: set[tuple[str, str]] = set()
+        # job key -> last time a restart-churn signal was scored for it
+        # (the one-incident-one-signal collapse; config.churn_interval).
+        self._last_churn: dict[str, float] = {}
+        # Generations ever exported to the cells gauge, so a generation
+        # whose last tracked cell healed still gets its series zeroed.
+        self._gauge_gens: set[str] = set()
+        self._last_tick: float | None = None
+        self._dirty = False  # a persist failed; retry on the next poll
+        self._recovered = False
+        self.log = logger.with_fields(component="fleet-health")
+        if self.client is not None:
+            self.recover()
+
+    # -- wiring ---------------------------------------------------------------
+
+    def attach(
+        self, client: ClusterClient, recorder: Any | None = None
+    ) -> None:
+        """Late binding, mirroring GangScheduler.attach (the operator main
+        builds the monitor from flags before any client exists)."""
+        if self.client is None:
+            self.client = client
+        if self.recorder is None:
+            self.recorder = recorder
+        if not self._recovered:
+            self.recover()
+
+    def start(self, stop: threading.Event, interval: float = 2.0) -> None:
+        """Background poll loop: node heartbeats + clock transitions +
+        deferred-migration retries."""
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                try:
+                    self.poll()
+                except Exception:
+                    self.log.exception("health poll failed")
+
+        threading.Thread(target=loop, name="fleet-health", daemon=True).start()
+
+    # -- signal ingestion -----------------------------------------------------
+
+    def record_pod_exit(
+        self,
+        job_key: str,
+        pod_uid: str,
+        exit_code: int | None,
+        now: float | None = None,
+    ) -> None:
+        """Attribute a failed pod's exit back to the cells its gang runs
+        on. Exit 138 (SIGUSR1, the reserved "TPU health check failed"
+        self-report) is a strong signal; other retryable exits score
+        restart churn. Permanent exits are app bugs, not cell evidence.
+        Deduped per pod incarnation — syncs can re-observe a failed pod."""
+        if exit_code is None or exit_codes.is_success(exit_code):
+            return
+        is_report = exit_code == exit_codes.SIGUSR1_EXIT
+        if not is_report and not exit_codes.is_retryable(exit_code):
+            return
+        now = now if now is not None else _time_now()
+        with self._lock:
+            if pod_uid:
+                if (job_key, pod_uid) in self._seen_exits:
+                    return
+                if len(self._seen_exits) >= _SEEN_EXITS_CAP:
+                    self._seen_exits.clear()
+                self._seen_exits.add((job_key, pod_uid))
+            if not is_report:
+                # One incident = one signal: a gang failing as a unit
+                # drops several member pods at once, all attributed to the
+                # same cells — collapsing the burst keeps the threshold
+                # meaning "repeated incidents", not "big gang".
+                last = self._last_churn.get(job_key)
+                if last is not None and now - last < self.config.churn_interval:
+                    return
+                self._last_churn[job_key] = now
+            cells = [
+                (p.generation, cell)
+                for p in self.scheduler.placements_of(job_key)
+                for cell in p.cells()
+            ]
+            source = SOURCE_EXIT_REPORT if is_report else SOURCE_RESTART_CHURN
+            weight = (
+                self.config.exit_report_weight
+                if is_report
+                else self.config.restart_weight
+            )
+            HEALTH_SIGNALS_TOTAL.inc(source=source)
+            if cells:
+                self._signal(cells, source, weight, now)
+
+    def observe_nodes(self, now: float | None = None) -> None:
+        """Heartbeat sweep: list node objects, mark cells of NotReady (or
+        heartbeat-stale) TPU hosts, recover cells whose host came back."""
+        if self.client is None:
+            return
+        now = now if now is not None else _time_now()
+        try:
+            nodes = self.client.list(objects.NODES, None)
+        except ApiError:
+            return
+        with self._lock:
+            for node in nodes:
+                gen = objects.node_generation(node)
+                cells = objects.node_cells(node)
+                if not gen or not cells:
+                    continue
+                ready = objects.node_ready(node)
+                if ready:
+                    hb = parse_rfc3339(objects.node_heartbeat_time(node) or "")
+                    if hb is not None and now - hb > self.config.heartbeat_timeout:
+                        ready = False  # stale heartbeat: lost, not healthy
+                if ready:
+                    self._node_recovered(gen, cells, now)
+                else:
+                    self._node_lost(gen, cells, now)
+
+    def drain(
+        self,
+        generation: str,
+        cells: list[tuple[int, ...]],
+        deadline: float | None = None,
+        now: float | None = None,
+    ) -> list[str]:
+        """Maintenance notice: cordon the cells NOW (migrating gangs ahead
+        of the failure) and hold the cordon at least until ``deadline``
+        (epoch seconds; the repair probe starts after it). Returns the
+        keys of gangs migrated off the cells."""
+        now = now if now is not None else _time_now()
+        with self._lock:
+            HEALTH_SIGNALS_TOTAL.inc(source=SOURCE_MAINTENANCE)
+            return self._cordon(
+                generation,
+                cells,
+                SOURCE_MAINTENANCE,
+                now,
+                deadline=deadline,
+            )
+
+    def cordon(
+        self,
+        generation: str,
+        cells: list[tuple[int, ...]],
+        now: float | None = None,
+    ) -> list[str]:
+        """Operator-pinned cordon: never auto-uncordons."""
+        now = now if now is not None else _time_now()
+        with self._lock:
+            HEALTH_SIGNALS_TOTAL.inc(source=SOURCE_MANUAL)
+            return self._cordon(
+                generation, cells, SOURCE_MANUAL, now, manual=True
+            )
+
+    def uncordon(
+        self,
+        generation: str,
+        cells: list[tuple[int, ...]],
+        now: float | None = None,
+    ) -> None:
+        """Return cells to service (manual; also clears suspicion)."""
+        with self._lock:
+            self._uncordon(generation, [tuple(c) for c in cells])
+
+    # -- clock ---------------------------------------------------------------
+
+    def poll(self, now: float | None = None) -> None:
+        """One monitor pass: heartbeat sweep, state-machine clock, persist
+        retry, and the migration sweep (admitted gangs on cordoned cells —
+        normally empty; non-empty after a deferred persist or a recovery)."""
+        now = now if now is not None else _time_now()
+        self.observe_nodes(now)
+        self.tick(now)
+        with self._lock:
+            if self._dirty:
+                self._persist()
+            if self._dirty:
+                # The cordon record STILL is not durable: evicting now
+                # would break the persist-before-evict crash contract (a
+                # successor with no record would re-place gangs straight
+                # onto the bad cells). Keep deferring; the cells stay
+                # excluded in-memory meanwhile.
+                return
+            for key in self.scheduler.gangs_on_cordoned_cells():
+                self._migrate(key)
+
+    def tick(self, now: float | None = None) -> None:
+        """Advance time-driven transitions: score decay, NotReady grace
+        expiry, cordon → repair probe, probe → healthy."""
+        now = now if now is not None else _time_now()
+        with self._lock:
+            dt = max(0.0, now - self._last_tick) if self._last_tick else 0.0
+            self._last_tick = now
+            cordon: dict[str, list[tuple[int, ...]]] = {}
+            uncordon: dict[str, list[tuple[int, ...]]] = {}
+            drop: list[tuple[str, tuple[int, ...]]] = []
+            for (gen, cell), ch in self._cells.items():
+                ch.score = max(0.0, ch.score - self.config.suspect_decay * dt)
+                if ch.state == STATE_SUSPECT:
+                    if ch.score <= 0.0 and ch.notready_since is None:
+                        drop.append((gen, cell))  # forgiven
+                    elif (
+                        ch.notready_since is not None
+                        and now - ch.notready_since
+                        >= self.config.notready_cordon_after
+                    ):
+                        cordon.setdefault(gen, []).append(cell)
+                elif ch.state == STATE_CORDONED and not ch.manual:
+                    if ch.notready_since is not None:
+                        continue  # host still dark: no point probing
+                    base = ch.cordoned_at or now
+                    if ch.deadline is not None:
+                        base = max(base, ch.deadline)
+                    if now - base >= self.config.repair_after:
+                        ch.state = STATE_REPAIRING
+                        ch.repairing_since = now
+                        self._dirty = True
+                elif ch.state == STATE_REPAIRING:
+                    since = ch.repairing_since or now
+                    if ch.last_signal_at > since or ch.notready_since is not None:
+                        ch.state = STATE_CORDONED
+                        ch.cordoned_at = now
+                        ch.repairing_since = None
+                        self._dirty = True
+                    elif now - since >= self.config.probe_window:
+                        uncordon.setdefault(gen, []).append(cell)
+            for gen, cell in drop:
+                del self._cells[(gen, cell)]
+            for gen, cells in cordon.items():
+                self._cordon(gen, cells, SOURCE_HEARTBEAT, now)
+            for gen, cells in uncordon.items():
+                self._uncordon(gen, cells)
+            if self._dirty:
+                self._persist()
+            self._export_gauges()
+
+    # -- controller-facing lookups -------------------------------------------
+
+    def degraded_cells_for(self, job_key: str) -> list[str]:
+        """Human-readable list of non-Healthy cells under this admitted
+        gang's placements — what the SliceDegraded condition names."""
+        with self._lock:
+            out = []
+            for p in self.scheduler.placements_of(job_key):
+                for cell in p.cells():
+                    ch = self._cells.get((p.generation, cell))
+                    if ch is not None and ch.state != STATE_HEALTHY:
+                        out.append(
+                            f"{p.generation}:{','.join(map(str, cell))}"
+                            f"({ch.state})"
+                        )
+            return sorted(out)
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-friendly view for /debug/health and `tpuctl health`."""
+        with self._lock:
+            cells = sorted(
+                (ch.to_dict() for ch in self._cells.values()),
+                key=lambda d: (d["generation"], d["cell"]),
+            )
+            counts: dict[str, int] = {}
+            for ch in self._cells.values():
+                counts[ch.state] = counts.get(ch.state, 0) + 1
+            return {
+                "cells": cells,
+                "counts": counts,
+                "config": {
+                    "suspectThreshold": self.config.suspect_threshold,
+                    "notreadyCordonAfter": self.config.notready_cordon_after,
+                    "repairAfter": self.config.repair_after,
+                    "probeWindow": self.config.probe_window,
+                },
+            }
+
+    # -- internals (lock held) ------------------------------------------------
+
+    def _cell(self, gen: str, cell: tuple[int, ...]) -> CellHealth:
+        key = (gen, tuple(int(x) for x in cell))
+        ch = self._cells.get(key)
+        if ch is None:
+            ch = CellHealth(generation=gen, cell=key[1])
+            self._cells[key] = ch
+        return ch
+
+    def _signal(
+        self,
+        cells: list[tuple[str, tuple[int, ...]]],
+        source: str,
+        weight: float,
+        now: float | None,
+    ) -> None:
+        now = now if now is not None else _time_now()
+        to_cordon: dict[str, list[tuple[int, ...]]] = {}
+        for gen, cell in cells:
+            ch = self._cell(gen, cell)
+            ch.score += weight
+            ch.last_signal_at = now
+            if ch.state == STATE_HEALTHY:
+                ch.state = STATE_SUSPECT
+                ch.source = source
+            if (
+                ch.state == STATE_SUSPECT
+                and ch.score >= self.config.suspect_threshold
+            ):
+                to_cordon.setdefault(gen, []).append(ch.cell)
+            # Repairing cells re-cordon on the next tick (last_signal_at
+            # advanced past repairing_since).
+        for gen, cs in to_cordon.items():
+            self._cordon(gen, cs, source, now)
+        self._export_gauges()
+
+    def _node_lost(
+        self, gen: str, cells: list[tuple[int, ...]], now: float
+    ) -> None:
+        fresh = []
+        for cell in cells:
+            ch = self._cell(gen, cell)
+            if ch.notready_since is None:
+                ch.notready_since = now
+                fresh.append((gen, tuple(cell)))
+        if fresh:
+            HEALTH_SIGNALS_TOTAL.inc(source=SOURCE_HEARTBEAT)
+            self._signal(fresh, SOURCE_HEARTBEAT, self.config.notready_weight, now)
+
+    def _node_recovered(
+        self, gen: str, cells: list[tuple[int, ...]], now: float
+    ) -> None:
+        changed = False
+        for cell in cells:
+            ch = self._cells.get((gen, tuple(cell)))
+            if ch is None or ch.notready_since is None:
+                continue
+            ch.notready_since = None
+            if (
+                ch.state == STATE_CORDONED
+                and ch.source == SOURCE_HEARTBEAT
+                and not ch.manual
+            ):
+                # Host is back: skip straight to the repair probe rather
+                # than waiting out the full repair_after window.
+                ch.state = STATE_REPAIRING
+                ch.repairing_since = now
+                changed = True
+        if changed:
+            self._dirty = True
+            self._persist()
+            self._export_gauges()
+
+    def _cordon(
+        self,
+        gen: str,
+        cells: list[tuple[int, ...]],
+        source: str,
+        now: float,
+        manual: bool = False,
+        deadline: float | None = None,
+    ) -> list[str]:
+        cells = [tuple(int(x) for x in c) for c in cells]
+        newly = []
+        for cell in cells:
+            ch = self._cell(gen, cell)
+            if ch.state not in (STATE_CORDONED, STATE_REPAIRING):
+                newly.append(cell)
+            ch.state = STATE_CORDONED
+            ch.cordoned_at = now
+            ch.repairing_since = None
+            ch.source = source
+            ch.manual = ch.manual or manual
+            if deadline is not None:
+                ch.deadline = deadline
+        if newly:
+            HEALTH_CORDONS_TOTAL.inc(len(newly), source=source)
+        # (a) placement stops handing out these cells immediately.
+        victims = self.scheduler.cordon_cells(gen, cells)
+        # (b) persist BEFORE migrating: a crash after this point recovers
+        # the cordon, and reconcile_gang finishes the migration. A failed
+        # persist defers the eviction (cells stay excluded in-memory; the
+        # next poll retries) rather than evicting a gang whose successor
+        # controller would happily re-place right back on the bad cells.
+        self._dirty = True
+        if not self._persist():
+            self.log.warning(
+                "cordon persisted only in memory; migration deferred "
+                "(gen=%s cells=%s)", gen, cells,
+            )
+            self._export_gauges()
+            return []
+        # (c) migrate admitted gangs off the cells, whole.
+        migrated = [key for key in victims if self._migrate(key)]
+        self._export_gauges()
+        return migrated
+
+    def _uncordon(self, gen: str, cells: list[tuple[int, ...]]) -> None:
+        returned = 0
+        for cell in cells:
+            key = (gen, tuple(cell))
+            ch = self._cells.pop(key, None)
+            if ch is not None and ch.state in (STATE_CORDONED, STATE_REPAIRING):
+                returned += 1
+        if returned:
+            HEALTH_UNCORDONS_TOTAL.inc(returned)
+        self._dirty = True
+        self._persist()
+        # Pumps the queue: healed capacity may admit waiting gangs.
+        self.scheduler.uncordon_cells(gen, list(cells))
+        self._export_gauges()
+
+    def _migrate(self, key: str) -> bool:
+        try:
+            return self.scheduler.migrate_gang(key)
+        except ApiError:
+            # Apiserver hiccup mid-eviction: the job annotations either
+            # landed (queued-with-pods cleanup finishes it) or did not
+            # (the gang stays admitted and the next poll's migration
+            # sweep retries). Either way the cordon already excludes the
+            # cells from any new placement.
+            self.log.warning("migration of %s interrupted; will retry", key)
+            return False
+
+    # -- persistence / recovery ----------------------------------------------
+
+    def _persist(self) -> bool:
+        """Write the durable cordon record (Cordoned + Repairing cells).
+        Returns False on failure, leaving _dirty set for the poll retry."""
+        if self.client is None:
+            self._dirty = False
+            return True
+        durable = [
+            ch.to_dict()
+            for ch in self._cells.values()
+            if ch.state in (STATE_CORDONED, STATE_REPAIRING)
+        ]
+        body = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": RECORD_NAME, "namespace": RECORD_NAMESPACE},
+            "data": {"cells": json.dumps(durable)},
+        }
+        try:
+            try:
+                self.client.patch_merge(
+                    objects.CONFIGMAPS,
+                    RECORD_NAMESPACE,
+                    RECORD_NAME,
+                    {"data": {"cells": body["data"]["cells"]}},
+                )
+            except NotFound:
+                self.client.create(objects.CONFIGMAPS, body)
+        except ApiError:
+            self.log.warning("fleet-health record persist failed")
+            self._dirty = True
+            return False
+        self._dirty = False
+        return True
+
+    def recover(self) -> None:
+        """Rebuild cordons from the persisted record (controller restart):
+        re-commit them to the placer so recovered admissions re-arbitrate
+        against the true healthy fleet, then let reconcile_gang's cordon
+        check migrate any recovered gang still sitting on withdrawn cells."""
+        self._recovered = True
+        if self.client is None:
+            return
+        try:
+            record = self.client.get(
+                objects.CONFIGMAPS, RECORD_NAMESPACE, RECORD_NAME
+            )
+        except NotFound:
+            return
+        except ApiError:
+            self.log.warning("fleet-health record read failed; starting empty")
+            return
+        try:
+            cells = [
+                CellHealth.from_dict(d)
+                for d in json.loads(record.get("data", {}).get("cells", "[]"))
+            ]
+        except (ValueError, KeyError, TypeError):
+            self.log.warning("fleet-health record unparseable; starting empty")
+            return
+        with self._lock:
+            by_gen: dict[str, list[tuple[int, ...]]] = {}
+            for ch in cells:
+                self._cells[(ch.generation, ch.cell)] = ch
+                by_gen.setdefault(ch.generation, []).append(ch.cell)
+            for gen, cs in by_gen.items():
+                self.scheduler.cordon_cells(gen, cs)
+            self._export_gauges()
+
+    # -- metrics --------------------------------------------------------------
+
+    def _export_gauges(self) -> None:
+        counts: dict[tuple[str, str], int] = {}
+        gens = set()
+        for ch in self._cells.values():
+            gens.add(ch.generation)
+            counts[(ch.generation, ch.state)] = (
+                counts.get((ch.generation, ch.state), 0) + 1
+            )
+        # Gauge series persist their last value: a generation whose last
+        # tracked cell was dropped (healed) must be written back to 0, or
+        # /metrics would report the old cordon forever.
+        for gen in gens | self._gauge_gens:
+            for state in STATES:
+                HEALTH_CELLS.set(
+                    counts.get((gen, state), 0), generation=gen, state=state
+                )
+        self._gauge_gens = gens

@@ -109,6 +109,10 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # arithmetic intensity scales linearly in bq. Measured on hardware
 # (window_r05 flashblocks probe, 8k causal fwd+bwd, b4): bq256 9.0,
 # bq512 11.0, bq1024 14.0 TFLOP/s — so the cap sits at 1024.
+# Status: the interleaved probe_qblock run is the pending confirmation of
+# that single-shot measurement; revert trigger is dispatch_auto failing
+# to track direct_bq1024 (i.e. the auto path losing to the direct-dispatch
+# bq1024 leg on the same probe), in which case drop the cap back to 512.
 MAX_Q_BLOCK = 1024
 
 

@@ -41,7 +41,7 @@ from tf_operator_tpu.runtime.client import (
     ClusterClient,
     NotFound,
 )
-from tf_operator_tpu.utils import logger
+from tf_operator_tpu.utils import exit_codes, logger
 
 
 # prctl(PR_SET_PDEATHSIG, SIGTERM) is armed by a tiny exec shim INSIDE
@@ -460,8 +460,17 @@ class LocalProcessExecutor:
             fresh["status"]["podIP"] = "127.0.0.1"
             fresh["status"]["hostPort"] = port
         if exit_code is not None:
+            # Exit 138 = 128+SIGUSR1, the reserved "TPU health check
+            # failed" self-report (utils/exit_codes.py): stamp the kubelet-
+            # style reason so the pod reconciler / health monitor can
+            # attribute the report without re-deriving signal arithmetic.
+            reason = (
+                "TPUHealthCheckFailed"
+                if exit_code == exit_codes.SIGUSR1_EXIT
+                else ""
+            )
             objects.set_container_terminated(
-                fresh, constants.DEFAULT_CONTAINER_NAME, exit_code
+                fresh, constants.DEFAULT_CONTAINER_NAME, exit_code, reason
             )
         statuses = fresh.setdefault("status", {}).setdefault("containerStatuses", [])
         for cs in statuses:

@@ -105,6 +105,11 @@ _RESOURCES: dict[str, _Resource] = {
     objects.SERVICES: _Resource("/api/v1", "services", True, "v1", "Service"),
     objects.EVENTS: _Resource("/api/v1", "events", True, "v1", "Event"),
     objects.NAMESPACES: _Resource("/api/v1", "namespaces", False, "v1", "Namespace"),
+    # Nodes are cluster-scoped; the stub (and the mem store behind it)
+    # files them under the "default" namespace, the convention the fleet-
+    # health monitor's heartbeat sweep relies on.
+    objects.NODES: _Resource("/api/v1", "nodes", False, "v1", "Node"),
+    objects.CONFIGMAPS: _Resource("/api/v1", "configmaps", True, "v1", "ConfigMap"),
     objects.PDBS: _Resource(
         "/apis/policy/v1", "poddisruptionbudgets", True, "policy/v1",
         "PodDisruptionBudget",

@@ -231,6 +231,21 @@ class InMemoryCluster(ClusterClient):
                 self._broadcast(objects.PODS, MODIFIED, pod)
         return updated
 
+    def heartbeat_node(self, name: str, ready: bool = True) -> dict[str, Any]:
+        """Kubelet-style node heartbeat: bump the Ready condition and
+        lastHeartbeatTime in one store tick. The fleet-health monitor reads
+        these node objects (Ready=False, or a heartbeat gone stale) as the
+        NotReady signal source; the same surface exists over the wire stub
+        as PUT /api/v1/nodes/{name}/status."""
+        with self._lock:
+            node = self._coll(objects.NODES, "default").get(name)
+            if node is None:
+                raise NotFound(f"{objects.NODES} default/{name} not found")
+            objects.set_node_ready(node, ready)
+            objects.meta(node)["resourceVersion"] = self._next_rv()
+            self._broadcast(objects.NODES, MODIFIED, node)
+            return copy.deepcopy(node)
+
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:
             coll = self._coll(kind, namespace)

@@ -298,3 +298,35 @@ SCHED_RELEASES_TOTAL = REGISTRY.counter(
     "tpu_scheduler_gate_releases_total",
     "Pods whose admission gate was lifted",
 )
+
+
+# ---------------------------------------------------------------------------
+# Fleet-health metric families (consumed by tf_operator_tpu/health/ and the
+# scheduler's migration path). Same rationale as above: declared at import
+# so /metrics exposes the full schema before the first signal arrives.
+# ---------------------------------------------------------------------------
+
+HEALTH_CELLS = REGISTRY.gauge(
+    "tpu_health_cells",
+    "Fleet cells by health state (Healthy cells with no open suspicion "
+    "are not tracked individually and read 0)",
+    ("generation", "state"),
+)
+HEALTH_SIGNALS_TOTAL = REGISTRY.counter(
+    "tpu_health_signals_total",
+    "Health signals ingested, by source",
+    ("source",),
+)
+HEALTH_CORDONS_TOTAL = REGISTRY.counter(
+    "tpu_health_cordons_total",
+    "Cells withdrawn from placement, by triggering source",
+    ("source",),
+)
+HEALTH_UNCORDONS_TOTAL = REGISTRY.counter(
+    "tpu_health_uncordons_total",
+    "Cells returned to service (manual or repair-probe auto-uncordon)",
+)
+HEALTH_MIGRATIONS_TOTAL = REGISTRY.counter(
+    "tpu_health_migrations_total",
+    "Gangs checkpoint-signaled and evicted off draining/cordoned cells",
+)

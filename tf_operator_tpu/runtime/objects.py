@@ -21,6 +21,17 @@ PDBS = "poddisruptionbudgets"
 EVENTS = "events"
 LEASES = "leases"
 NAMESPACES = "namespaces"
+# Nodes are cluster-scoped in Kubernetes; this runtime stores them under the
+# "default" namespace (the kubestub routes /api/v1/nodes there), which both
+# backends and the health monitor agree on.
+NODES = "nodes"
+CONFIGMAPS = "configmaps"
+
+# TPU host labeling: a node declares which generation mesh it belongs to and
+# which unit cells of that mesh its chips occupy, so fleet health can map a
+# NotReady host back to scheduler coordinates (health/monitor.py).
+LABEL_NODE_GENERATION = "tpu.tpuflow.org/generation"
+ANNOTATION_NODE_CELLS = "tpu.tpuflow.org/cells"  # JSON: [[x,y,...], ...]
 
 # Pod phases (core/v1).
 PENDING = "Pending"
@@ -134,6 +145,80 @@ def new_pdb(
     if owner_references:
         pdb["metadata"]["ownerReferences"] = copy.deepcopy(owner_references)
     return pdb
+
+
+def new_node(
+    name: str,
+    generation: str | None = None,
+    cells: list[tuple[int, ...]] | None = None,
+    ready: bool = True,
+) -> dict[str, Any]:
+    """A core/v1-shaped Node for the runtime store. TPU hosts carry the
+    generation label + cells annotation the health monitor attributes
+    heartbeats through; plain nodes omit both."""
+    import json as _json
+
+    node: dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "namespace": "default", "labels": {}},
+        "status": {},
+    }
+    if generation:
+        node["metadata"]["labels"][LABEL_NODE_GENERATION] = generation
+    if cells is not None:
+        node["metadata"].setdefault("annotations", {})[
+            ANNOTATION_NODE_CELLS
+        ] = _json.dumps([list(c) for c in cells])
+    set_node_ready(node, ready)
+    return node
+
+
+def node_ready(node: dict[str, Any]) -> bool:
+    for cond in node.get("status", {}).get("conditions", []) or []:
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False  # no Ready condition = the kubelet never reported in
+
+
+def set_node_ready(node: dict[str, Any], ready: bool) -> None:
+    """Stamp the Ready condition + lastHeartbeatTime, kubelet-style."""
+    now = now_iso()
+    conds = node.setdefault("status", {}).setdefault("conditions", [])
+    for cond in conds:
+        if cond.get("type") == "Ready":
+            cond["status"] = "True" if ready else "False"
+            cond["lastHeartbeatTime"] = now
+            break
+    else:
+        conds.append(
+            {
+                "type": "Ready",
+                "status": "True" if ready else "False",
+                "lastHeartbeatTime": now,
+            }
+        )
+    node["status"]["lastHeartbeatTime"] = now
+
+
+def node_heartbeat_time(node: dict[str, Any]) -> str | None:
+    return node.get("status", {}).get("lastHeartbeatTime") or None
+
+
+def node_generation(node: dict[str, Any]) -> str | None:
+    return labels_of(node).get(LABEL_NODE_GENERATION) or None
+
+
+def node_cells(node: dict[str, Any]) -> list[tuple[int, ...]]:
+    import json as _json
+
+    raw = (meta(node).get("annotations") or {}).get(ANNOTATION_NODE_CELLS)
+    if not raw:
+        return []
+    try:
+        return [tuple(int(x) for x in c) for c in _json.loads(raw)]
+    except (ValueError, TypeError):
+        return []
 
 
 def pod_phase(pod: dict[str, Any]) -> str:

@@ -56,11 +56,20 @@ class ObservabilityHandler:
 
 def mount_observability(api_server: Any, registry: Registry = REGISTRY,
                         tracer: Tracer = TRACER,
-                        scheduler: Any | None = None) -> ObservabilityHandler:
+                        scheduler: Any | None = None,
+                        health: Any | None = None) -> ObservabilityHandler:
     handler = ObservabilityHandler(registry, tracer, scheduler)
     api_server.add_handler(handler)
+    if health is not None:
+        # /debug/health (+ the cordon/uncordon/drain verbs) rides the same
+        # extra-handler hook; kept in the health package so the endpoint
+        # schema lives next to the monitor it exposes.
+        from tf_operator_tpu.health.httpapi import mount_health
+
+        mount_health(api_server, health)
     LOG.info(
-        "observability mounted at /metrics and /debug/traces%s",
+        "observability mounted at /metrics and /debug/traces%s%s",
         " and /debug/scheduler" if scheduler is not None else "",
+        " and /debug/health" if health is not None else "",
     )
     return handler

@@ -47,6 +47,7 @@ from tf_operator_tpu.api.types import TPUJob
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ApiError, ClusterClient, NotFound
 from tf_operator_tpu.runtime.metrics import (
+    HEALTH_MIGRATIONS_TOTAL,
     SCHED_ADMISSION_SECONDS,
     SCHED_ADMISSIONS_TOTAL,
     SCHED_ADMITTED_GANGS,
@@ -59,6 +60,7 @@ from tf_operator_tpu.scheduler.gang import (
     ANNOTATION_ADMITTED_AT,
     ANNOTATION_CHIPS,
     ANNOTATION_ENQUEUED_AT,
+    ANNOTATION_MIGRATED_AT,
     ANNOTATION_PLACEMENTS,
     ANNOTATION_PREEMPTED_AT,
     ANNOTATION_STATE,
@@ -81,6 +83,7 @@ EVENT_GANG_ADMITTED = "GangAdmitted"
 EVENT_GANG_RELEASED = "GangReleased"
 EVENT_PREEMPTED = "GangPreempted"
 EVENT_UNSCHEDULABLE = "GangUnschedulable"
+EVENT_MIGRATING = "JobMigrating"
 
 
 @dataclass
@@ -99,6 +102,11 @@ class SchedulerConfig:
     # Stamp the admission gate on created pods. Off = legacy pass-through
     # behavior (pods run as soon as a kubelet picks them up).
     gate_pods: bool = True
+    # Aging seconds granted to a gang evicted by the fleet-health layer
+    # (on top of its retained enqueue time): a migration is the cluster's
+    # fault, not the tenant's, so the migrated gang out-bids same-class
+    # arrivals when re-placement has to wait for capacity.
+    migration_credit: float = 60.0
 
 
 @dataclass
@@ -124,6 +132,11 @@ class GangScheduler:
         self.ledger = QuotaLedger(self.config.quotas)
         self._admitted: dict[str, Gang] = {}
         self._wakeup: Callable[[str], None] | None = None
+        # Set by health/monitor.py when a FleetHealthMonitor is wired in;
+        # the controller reaches the monitor through this back-reference.
+        # The scheduler itself never calls into it (lock ordering: the
+        # monitor's lock is always taken before this one, never after).
+        self.health: Any | None = None
         self.log = logger.with_fields(component="gang-scheduler")
 
     # -- wiring --------------------------------------------------------------
@@ -165,7 +178,22 @@ class GangScheduler:
                 gang = None
             if gang is None:
                 gang = self._register(job, has_pods)
+            if gang.state == STATE_ADMITTED and self._on_cordoned_cells(gang):
+                # Fleet health cordoned cells under this gang (possibly in a
+                # previous controller incarnation — the cordon outlives us
+                # via the health monitor's persisted record, while the gang
+                # was just recovered as admitted). Migrate: checkpoint-
+                # signal, evict whole, requeue with aging credit. If the
+                # eviction cannot be persisted the gang simply stays
+                # admitted on its cells until the next sync retries.
+                self._migrate_locked(gang)
             if gang.state != STATE_ADMITTED:
+                # Interrupted-eviction guard: a queued gang that still owns
+                # pods must not re-admit until the controller's cleanup
+                # deleted them (see Gang.pending_cleanup). Recomputed from
+                # the caller's live observation each sync, so it clears the
+                # moment the leftovers are gone.
+                gang.pending_cleanup = has_pods
                 self._pump()
             self._export_gauges()
             admitted = gang.state == STATE_ADMITTED
@@ -238,6 +266,95 @@ class GangScheduler:
             self._pump()
             self._export_gauges()
 
+    # -- fleet-health surface (health/monitor.py) -----------------------------
+
+    def cordon_cells(
+        self, generation: str, cells: list[tuple[int, ...]]
+    ) -> list[str]:
+        """Withdraw cells from placement. Returns the keys of admitted
+        gangs now sitting on cordoned cells — the migration work-list the
+        health monitor drives AFTER persisting the cordon (crash between
+        persist and migration is finished by recovery + the reconcile-time
+        cordon check in reconcile_gang)."""
+        with self._lock:
+            self.placer.cordon(generation, cells)
+            return sorted(
+                g.key
+                for g in self._admitted.values()
+                if self._on_cordoned_cells(g)
+            )
+
+    def uncordon_cells(
+        self, generation: str, cells: list[tuple[int, ...]]
+    ) -> None:
+        """Return cells to service and re-pump: the healed capacity may
+        admit queued gangs immediately."""
+        with self._lock:
+            self.placer.uncordon(generation, cells)
+            self._pump()
+            self._export_gauges()
+
+    def gangs_on_cordoned_cells(self) -> list[str]:
+        with self._lock:
+            return sorted(
+                g.key
+                for g in self._admitted.values()
+                if self._on_cordoned_cells(g)
+            )
+
+    def migrate_gang(self, key: str, reason: str = "cell cordoned") -> bool:
+        """Maintenance-aware migration: checkpoint-signal the gang, evict
+        it WHOLE off its (draining/cordoned) cells, requeue it with an
+        aging credit, and immediately try to re-place it on healthy cells.
+        Same crash discipline as preemption: the queued state (+ migrated-at
+        marker) is persisted on the job before any pod dies."""
+        with self._lock:
+            gang = self._admitted.get(key)
+            if gang is None:
+                return False
+            return self._migrate_locked(gang)
+
+    def placements_of(self, key: str) -> list[Placement]:
+        """The admitted gang's placements ([] when not admitted) — the
+        cell-attribution lookup the health monitor scores exit reports
+        against."""
+        with self._lock:
+            gang = self._admitted.get(key)
+            return list(gang.placements) if gang is not None else []
+
+    def _on_cordoned_cells(self, gang: Gang) -> bool:
+        for p in gang.placements:
+            for cell in p.cells():
+                if self.placer.is_cordoned(p.generation, cell):
+                    return True
+        return False
+
+    def _migrate_locked(self, gang: Gang) -> bool:
+        now = objects.now_iso()
+        ok = self._evict(
+            gang,
+            annotations={
+                # preempted-at IS the checkpoint signal contract of PR 1 —
+                # checkpoint-aware workloads watch for exactly this key;
+                # migrated-at attributes the eviction to fleet health and
+                # keys the JobMigrating condition.
+                ANNOTATION_PREEMPTED_AT: now,
+                ANNOTATION_MIGRATED_AT: now,
+                ANNOTATION_STATE: STATE_QUEUED,
+            },
+            event=EVENT_MIGRATING,
+            message=(
+                "slice cells are draining/cordoned; checkpoint now — the "
+                "gang will be re-placed whole on healthy cells"
+            ),
+            aging_credit=self.config.migration_credit,
+        )
+        if ok:
+            HEALTH_MIGRATIONS_TOTAL.inc()
+            self._pump()
+            self._export_gauges()
+        return ok
+
     # -- introspection -------------------------------------------------------
 
     def snapshot(self) -> dict[str, Any]:
@@ -251,6 +368,11 @@ class GangScheduler:
                 } or None,
                 "chipsInUse": self.placer.chips_in_use(),
                 "chipsTotal": self.placer.chips_total(),
+                "chipsCordoned": self.placer.chips_cordoned(),
+                "cordonedCells": {
+                    gen: sorted(list(c) for c in cells)
+                    for gen, cells in self.placer.cordoned().items()
+                },
                 "quotaUsage": self.ledger.usage(),
                 "admitted": [
                     self._gang_view(g, now)
@@ -407,7 +529,10 @@ class GangScheduler:
         now = time.time()
         blocked = False
         for gang in self.queue.ordered(now):
-            if gang.infeasible:
+            if gang.infeasible or gang.pending_cleanup:
+                # Infeasible gangs can never admit; pending_cleanup gangs
+                # must not admit YET (their interrupted-eviction leftovers
+                # are still being deleted). Neither may wedge the head.
                 continue
             if not blocked and self._try_admit(gang, now):
                 continue
@@ -456,15 +581,41 @@ class GangScheduler:
         if not victims:
             return False
         for victim in victims:
-            if not self._evict(victim, preemptor=gang):
+            evicted = self._evict(
+                victim,
+                annotations={
+                    ANNOTATION_PREEMPTED_AT: objects.now_iso(),
+                    ANNOTATION_STATE: STATE_QUEUED,
+                },
+                event=EVENT_PREEMPTED,
+                message=(
+                    f"preempted by higher-priority gang {gang.key} "
+                    f"(priority {gang.priority} > {victim.priority}); "
+                    "checkpoint now"
+                ),
+            )
+            if not evicted:
                 # Eviction could not be carried out (apiserver hiccup):
                 # the victim keeps its capacity, so admitting the pending
                 # gang now would double-book chips. Retry next pump.
                 return False
+            SCHED_PREEMPTIONS_TOTAL.inc()
         return self._try_admit(gang, now)
 
-    def _evict(self, victim: Gang, preemptor: Gang) -> bool:
+    def _evict(
+        self,
+        victim: Gang,
+        *,
+        annotations: dict[str, str],
+        event: str,
+        message: str,
+        aging_credit: float = 0.0,
+    ) -> bool:
         """Checkpoint-signal, then evict the victim WHOLE and requeue it.
+        Shared by preemption (make room for a higher-priority gang) and
+        fleet-health migration (get off draining/cordoned cells); the
+        callers differ only in the persisted marker annotations, the
+        event, and the aging credit granted on requeue.
 
         Returns False (victim untouched, still admitted) when its pods
         cannot even be listed — capacity is only ever refunded after the
@@ -497,21 +648,9 @@ class GangScheduler:
         #    deleting pods while the job still reads admitted on the wire
         #    would make a restart recover the victim as a healthy admitted
         #    gang and double-book the chips against the preemptor's.
-        if not self._persist(
-            victim.namespace, victim.name,
-            {
-                ANNOTATION_PREEMPTED_AT: objects.now_iso(),
-                ANNOTATION_STATE: STATE_QUEUED,
-            },
-        ):
+        if not self._persist(victim.namespace, victim.name, annotations):
             return False
-        self._event(
-            victim, EVENT_PREEMPTED,
-            f"preempted by higher-priority gang {preemptor.key} "
-            f"(priority {preemptor.priority} > {victim.priority}); "
-            "checkpoint now",
-            warning=True,
-        )
+        self._event(victim, event, message, warning=True)
         # 3. Evict the whole gang — a partial eviction would leave exactly
         #    the stranded half-slice this subsystem exists to prevent.
         for pod in pods:
@@ -523,16 +662,18 @@ class GangScheduler:
                 continue
         # 4. Refund and requeue as a gang, keeping the original enqueue
         #    time (aging credit) so the victim re-admits ahead of later
-        #    arrivals of its own class.
+        #    arrivals of its own class; migrations add an extra credit on
+        #    top (the eviction was the cluster's fault).
         self.placer.release(victim.placements)
         self.ledger.refund(victim)
         victim.placements = []
         victim.state = STATE_QUEUED
         victim.admitted_at = None
         victim.requeues += 1
+        if aging_credit:
+            victim.enqueued_at -= aging_credit
         self._admitted.pop(victim.key, None)
         self.queue.add(victim)
-        SCHED_PREEMPTIONS_TOTAL.inc()
         if self._wakeup is not None:
             self._wakeup(victim.key)
         return True

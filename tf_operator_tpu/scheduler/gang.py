@@ -43,6 +43,10 @@ ANNOTATION_ADMITTED_AT = "scheduler.tpuflow.org/admitted-at"
 ANNOTATION_PLACEMENTS = "scheduler.tpuflow.org/placements"
 ANNOTATION_PREEMPTED_AT = "scheduler.tpuflow.org/preempted-at"
 ANNOTATION_CHIPS = "scheduler.tpuflow.org/chips"
+# Stamped (alongside preempted-at — the same checkpoint-signal contract)
+# when the fleet-health layer evicts a gang off draining/cordoned cells;
+# the controller keys the JobMigrating condition on it (health/monitor.py).
+ANNOTATION_MIGRATED_AT = "health.tpuflow.org/migrated-at"
 
 STATE_QUEUED = "queued"
 STATE_ADMITTED = "admitted"
@@ -102,6 +106,14 @@ class Gang:
     # the namespace's absolute budget). The pump skips it so one
     # misconfigured job cannot wedge the strict head-of-line queue.
     infeasible: str = ""
+    # True while a QUEUED gang still owns pods — an interrupted eviction
+    # (preemption or migration crashed between the state=queued persist and
+    # the deletion loop). The pump must not re-admit it until the leftovers
+    # are gone: a fresh admission with live pods would resurrect the gang
+    # IN PLACE on its old (possibly cordoned) cells while the ledger
+    # charges the new placement. Cleared by the next reconcile that
+    # observes zero pods.
+    pending_cleanup: bool = False
     # Filled at admission: one placement per SliceRequest (see placement.py).
     placements: list[Any] = field(default_factory=list)
 

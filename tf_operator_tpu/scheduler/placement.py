@@ -104,6 +104,13 @@ class TopologyPlacer:
         self._used: dict[str, set[tuple[int, ...]]] = {
             gen: set() for gen in (self.capacity or {})
         }
+        # Cells withdrawn from service by the fleet-health layer
+        # (health/monitor.py): excluded from every fit, but NOT from
+        # feasibility (fits_empty) — a cordon is temporary, and
+        # "infeasible" is forever. Disjoint bookkeeping from _used: a
+        # cordoned cell may simultaneously be occupied by a gang that has
+        # not been migrated off it yet.
+        self._cordoned: dict[str, set[tuple[int, ...]]] = {}
 
     @property
     def unbounded(self) -> bool:
@@ -125,14 +132,52 @@ class TopologyPlacer:
     def chips_in_use(self) -> dict[str, int]:
         return {gen: len(cells) for gen, cells in self._used.items()}
 
+    def chips_cordoned(self) -> dict[str, int]:
+        return {
+            gen: len(cells)
+            for gen, cells in self._cordoned.items()
+            if cells
+        }
+
     def fits_empty(self, req: SliceRequest) -> bool:
         """Could this block EVER place on an idle fleet? False means the
         request is permanently infeasible (generation not installed, or
         bigger than the whole mesh) — the CapacityError class of failure,
-        as opposed to "does not fit right now"."""
+        as opposed to "does not fit right now". Cordons are deliberately
+        ignored: a fully-cordoned mesh heals, an unknown generation never
+        does."""
         if self.capacity is None:
             return True
-        return self._find(req, set()) is not None
+        return self._find(req, set(), avoid_cordoned=False) is not None
+
+    # -- cordons (fleet-health integration) ----------------------------------
+
+    def cordon(
+        self, generation: str, cells: Iterable[tuple[int, ...]]
+    ) -> None:
+        """Withdraw cells from placement. Idempotent; unknown generations
+        are tracked too (harmless — they can never be placed on anyway)."""
+        self._cordoned.setdefault(generation, set()).update(
+            tuple(int(x) for x in c) for c in cells
+        )
+
+    def uncordon(
+        self, generation: str, cells: Iterable[tuple[int, ...]]
+    ) -> None:
+        pool = self._cordoned.get(generation)
+        if pool:
+            pool.difference_update(tuple(int(x) for x in c) for c in cells)
+
+    def cordoned(self) -> dict[str, set[tuple[int, ...]]]:
+        """View of the cordoned cells (copy; per-generation)."""
+        return {
+            gen: set(cells)
+            for gen, cells in self._cordoned.items()
+            if cells
+        }
+
+    def is_cordoned(self, generation: str, cell: tuple[int, ...]) -> bool:
+        return tuple(cell) in self._cordoned.get(generation, ())
 
     # -- fit -----------------------------------------------------------------
 
@@ -165,7 +210,10 @@ class TopologyPlacer:
         return [placed[i] for i in range(len(requests))]
 
     def _find(
-        self, req: SliceRequest, used: set[tuple[int, ...]] | None
+        self,
+        req: SliceRequest,
+        used: set[tuple[int, ...]] | None,
+        avoid_cordoned: bool = True,
     ) -> Placement | None:
         mesh = (self.capacity or {}).get(req.generation)
         if mesh is None:
@@ -180,6 +228,10 @@ class TopologyPlacer:
         # Pad to mesh rank so rotation covers every axis assignment.
         dims = dims + (1,) * (len(mesh) - len(dims))
         used = used or set()
+        if avoid_cordoned:
+            cordoned = self._cordoned.get(req.generation)
+            if cordoned:
+                used = used | cordoned
         seen: set[tuple[int, ...]] = set()
         for perm in itertools.permutations(dims):
             if perm in seen:

@@ -10,8 +10,12 @@ Parity: pkg/util/train/train_util.go:18-53. The contract:
   drain, preemption, OOM-killer at node scope. Retryable.
 - 138 (128+SIGUSR1): reserved as *user-defined retryable* — training code can
   kill itself with SIGUSR1 to request a restart (e.g. on a TPU health-check
-  failure) without the operator second-guessing it.
-- >128 otherwise: died by signal; treated as retryable infrastructure noise.
+  failure) without the operator second-guessing it. The fleet-health layer
+  (tf_operator_tpu/health/) additionally attributes 138 exits back to the
+  cells the slice ran on and cordons them.
+- >128 otherwise: died by signal; treated as retryable infrastructure noise —
+  except the enumerated app-bug signals (_PERMANENT_SIGNAL_EXITS): 139
+  (SIGSEGV) and 134 (SIGABRT — XLA/runtime aborts), which retrying cannot fix.
 
 TPU addendum: on a multi-host slice a retryable exit of ONE host restarts the
 WHOLE slice (ICI state is not recoverable piecemeal) — that logic lives in the
@@ -25,6 +29,11 @@ SIGUSR1_EXIT = 138  # 128 + SIGUSR1: user-requested retry
 
 _RETRYABLE = frozenset({130, 137, 138, 143})
 
+# Death-by-signal exits that are APP bugs, not infrastructure noise, so a
+# restart cannot help: 134 (128+SIGABRT — XLA/runtime aborts, assertion
+# failures, glibc heap corruption land here) and 139 (128+SIGSEGV).
+_PERMANENT_SIGNAL_EXITS = frozenset({134, 139})
+
 
 def is_success(exit_code: int) -> bool:
     return exit_code == SUCCESS
@@ -35,8 +44,9 @@ def is_retryable(exit_code: int) -> bool:
     if exit_code in _RETRYABLE:
         return True
     # Other >128 codes are deaths-by-signal we didn't enumerate; the reference
-    # treats unknown signals as retryable infrastructure failures.
-    return exit_code > 128 and exit_code not in (139,)  # 139 = SIGSEGV: app bug
+    # treats unknown signals as retryable infrastructure failures — except
+    # the enumerated app-bug signals above.
+    return exit_code > 128 and exit_code not in _PERMANENT_SIGNAL_EXITS
 
 
 def is_permanent(exit_code: int) -> bool:

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Fast fleet-health smoke: runs the `health`-marked tests in isolation
+(cell state machine + cordon-aware placement + drain/migration integration
+plus the crash-boundary chaos cases on both cluster backends) — the ~10s
+loop for iterating on tf_operator_tpu/health/ without paying for the whole
+tier-1 run. Mirrors tools/sched_smoke.py.
+
+    python tools/health_smoke.py            # the smoke subset
+    python tools/health_smoke.py -k drain   # extra pytest args pass through
+
+Exit code is pytest's. The same tests also run (unmarked-slow, so by
+default) inside the tier-1 command in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_health.py", "tests/test_health_chaos.py",
+        "-m", "health",
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
