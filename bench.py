@@ -563,6 +563,42 @@ def bench_submit_latency() -> None:
     )
 
 
+def bench_control_plane() -> None:
+    """Control-plane scale line (CPU-only, no jax): subprocess-runs
+    tools/bench_control_plane.py — N synthetic jobs through a real
+    controller with indexed informer caches — and re-emits its BENCH line
+    (jobs sustained, p50/p99 sync, steady-state API list calls, which the
+    scale tier asserts are zero for pods/services/nodes). A subprocess so
+    the process-global metrics registry starts clean and a wedged run
+    cannot take the bench down."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(here, "tools", "bench_control_plane.py"),
+            "--jobs", "60" if smoke else "1000",
+            "--steady-seconds", "1.5" if smoke else "6",
+        ],
+        capture_output=True, text=True,
+        timeout=120 if smoke else 360,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    emitted = False
+    for raw in proc.stdout.splitlines():
+        if raw.startswith("{"):
+            print(raw, flush=True)
+            emitted = True
+    if proc.returncode != 0 or not emitted:
+        print(
+            f"bench: control-plane bench rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}",
+            file=sys.stderr, flush=True,
+        )
+
+
 def _submit_latency_fleet() -> list:
     """One fleet measurement: fresh cluster + controller + instant kubelet,
     20 jobs, returns the sorted per-job submit→Running latencies."""
@@ -1361,6 +1397,14 @@ def main() -> None:
             bench_submit_latency()
         except Exception as exc:  # noqa: BLE001
             print(f"bench: bench_submit_latency failed: {exc!r}",
+                  file=sys.stderr, flush=True)
+    # Control-plane scale line: also CPU-only (subprocess, no jax), run
+    # before the backend preflight for the same tunnel-down resilience.
+    if _section_selected("control_plane"):
+        try:
+            bench_control_plane()
+        except Exception as exc:  # noqa: BLE001
+            print(f"bench: bench_control_plane failed: {exc!r}",
                   file=sys.stderr, flush=True)
     preflight = _backend_preflight_start()
     # Join the preflight BEFORE any branch that would touch the backend

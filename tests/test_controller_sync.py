@@ -661,9 +661,12 @@ class TestInformerResyncOrdering:
         # Replay the SAME failed pod into the informer cache (ghost) after
         # its real deletion; the sync must not count it again.
         with tc.pod_informer._lock:
-            tc.pod_informer._cache[
-                f"default/{objects.name_of(failed)}"
-            ] = failed
+            # _cache_put (not bare dict assignment) so the secondary
+            # indexes see the ghost too — the sync's pod view is an index
+            # lookup now, and the scenario needs the replayed pod IN it.
+            tc.pod_informer._cache_put(
+                f"default/{objects.name_of(failed)}", failed
+            )
         tc.expectations.delete_expectations(
             tc.expectation_key(tc.job_key("default", "ghostcount"),
                                "Worker", "pods")

@@ -675,3 +675,62 @@ def test_cells_gauge_zeroed_when_generation_heals():
     assert HEALTH_CELLS.value(generation="v4", state=STATE_CORDONED) == 1
     monitor.uncordon("v4", [(0, 0, 0)])
     assert HEALTH_CELLS.value(generation="v4", state=STATE_CORDONED) == 0
+
+
+def test_monitor_poll_reads_node_cache_zero_lists():
+    """ISSUE 3: once the controller's node informer has synced, the
+    heartbeat sweep reads the cache — steady-state polls issue zero API
+    node LISTs (asserted on tpu_api_requests_total), and a NotReady flip
+    still arrives through the watch. Runs over the wire stub (kubestub),
+    the backend where a LIST is a real HTTP round-trip."""
+    import threading
+
+    from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+    from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+    from tf_operator_tpu.runtime.kubestub import KubeApiStub
+    from tf_operator_tpu.runtime.metrics import API_REQUESTS_TOTAL
+
+    stub = KubeApiStub()
+    stub.start()
+    stop = threading.Event()
+    try:
+        client = KubeClusterClient(KubeConfig(server=stub.url))
+        sched = GangScheduler(config=SchedulerConfig(capacity={"v4": (2, 2, 2)}))
+        monitor = FleetHealthMonitor(sched, client=client, config=HealthConfig())
+        tc = TPUJobController(
+            client,
+            JobControllerConfig(reconcile_period=0.5, informer_resync=60.0),
+            recorder=FakeRecorder(),
+            scheduler=sched,
+        )
+        threading.Thread(target=tc.run, args=(stop,), daemon=True).start()
+        assert tc.node_informer is not None
+        assert monitor.node_lister is tc.node_informer
+        assert tc.node_informer.wait_synced(15), "node informer never synced"
+        client.create(
+            objects.NODES, objects.new_node("host-0", "v4", [(0, 0, 0)])
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if tc.node_informer.get("default", "host-0") is not None:
+                break
+            time.sleep(0.05)
+        before = API_REQUESTS_TOTAL.value(verb="list", kind=objects.NODES)
+        for _ in range(5):
+            monitor.poll()
+        assert API_REQUESTS_TOTAL.value(verb="list", kind=objects.NODES) == before
+
+        node = client.get(objects.NODES, "default", "host-0")
+        objects.set_node_ready(node, False)
+        client.update_status(objects.NODES, node)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            monitor.poll()
+            if monitor.snapshot()["counts"].get(STATE_SUSPECT):
+                break
+            time.sleep(0.05)
+        assert monitor.snapshot()["counts"].get(STATE_SUSPECT, 0) >= 1
+        assert API_REQUESTS_TOTAL.value(verb="list", kind=objects.NODES) == before
+    finally:
+        stop.set()
+        stub.stop()

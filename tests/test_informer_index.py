@@ -1,0 +1,281 @@
+"""Informer secondary-index correctness under churn (ISSUE 3 tentpole).
+
+The indexes (namespace / owner uid / label term) are maintained
+incrementally on every delta; these tests assert they can NEVER drift from
+the cache, whatever the event sequence:
+
+- randomized churn — adds, relabels, owner flips, deletes, ghost replays
+  (stale events for already-deleted uids), stale-incarnation DELETEDs —
+  with index-backed ``list()`` / ``list_for_owner()`` compared against a
+  brute-force scan of the cache after every step;
+- store-driven churn through ``sync_now`` relists (the resync diff path
+  mutates the cache through the same two mutators);
+- the ghost-suppression sequences from the chaos soak, now also asserting
+  no suppressed replay leaves a stale index entry.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tf_operator_tpu.api.helpers import selector_matches
+from tf_operator_tpu.controller.informer import Informer, _controller_uid
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ADDED, DELETED, MODIFIED
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+
+NAMESPACES = ["alpha", "beta", "gamma"]
+JOBS = ["job-a", "job-b", "job-c", "job-d"]
+TYPES = ["worker", "chief"]
+
+
+def _brute_list(inf, namespace=None, selector=None):
+    out = [
+        o
+        for o in inf._cache.values()
+        if (namespace is None or objects.namespace_of(o) == namespace)
+        and (not selector or selector_matches(selector, objects.labels_of(o)))
+    ]
+    return sorted(out, key=objects.key_of)
+
+
+def _brute_for_owner(inf, uid, namespace=None, selector=None):
+    out = []
+    for o in inf._cache.values():
+        if namespace is not None and objects.namespace_of(o) != namespace:
+            continue
+        owned = bool(uid) and _controller_uid(o) == uid
+        matches = bool(selector) and selector_matches(
+            selector, objects.labels_of(o)
+        )
+        if owned or matches:
+            out.append(o)
+    return sorted(out, key=objects.key_of)
+
+
+def _verify_equivalence(inf, uids):
+    inf.check_indexes()
+    for ns in [None, *NAMESPACES]:
+        assert inf.list(namespace=ns) == _brute_list(inf, ns)
+        for job in JOBS:
+            sel = {"job-name": job}
+            assert inf.list(namespace=ns, label_selector=sel) == _brute_list(
+                inf, ns, sel
+            ), (ns, sel)
+            sel2 = {"job-name": job, "replica-type": "worker"}
+            assert inf.list(namespace=ns, label_selector=sel2) == _brute_list(
+                inf, ns, sel2
+            ), (ns, sel2)
+    for uid in list(uids)[:8]:
+        for job in JOBS:
+            sel = {"job-name": job}
+            assert inf.list_for_owner(
+                uid, namespace=NAMESPACES[0], label_selector=sel
+            ) == _brute_for_owner(inf, uid, NAMESPACES[0], sel), uid
+
+
+def _make_obj(rng, name, ns, uid):
+    labels = {"job-name": rng.choice(JOBS)}
+    if rng.random() < 0.8:
+        labels["replica-type"] = rng.choice(TYPES)
+    if rng.random() < 0.2:
+        labels["extra"] = rng.choice(["x", "y"])
+    obj = {
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": uid,
+            "labels": labels,
+        },
+        "status": {"phase": rng.choice(["Pending", "Running", "Failed"])},
+    }
+    if rng.random() < 0.7:
+        obj["metadata"]["ownerReferences"] = [
+            {"controller": True, "uid": f"owner-{rng.choice(JOBS)}"}
+        ]
+    return obj
+
+
+@pytest.mark.parametrize("seed", [7, 23, 1999])
+def test_index_equals_brute_force_under_randomized_churn(seed):
+    """2000 random deltas — including ghost replays of dead uids and
+    stale-incarnation DELETEDs — never leave index/cache drift."""
+    rng = random.Random(seed)
+    inf = Informer(client=None, kind="pods")  # _apply-driven; no client I/O
+    live_uid: dict[str, str] = {}  # key -> current uid
+    dead: list[tuple[str, dict]] = []  # (uid, last object) for replays
+    owner_uids = {f"owner-{j}" for j in JOBS}
+    uid_seq = 0
+
+    for step in range(2000):
+        op = rng.random()
+        ns = rng.choice(NAMESPACES)
+        name = f"pod-{rng.randrange(40)}"
+        key = f"{ns}/{name}"
+        if op < 0.40:  # add / recreate (new uid) or modify (same uid)
+            if key in live_uid and rng.random() < 0.6:
+                uid = live_uid[key]  # relabel / owner flip in place
+                etype = MODIFIED
+            else:
+                uid_seq += 1
+                uid = f"uid-{uid_seq}"
+                etype = ADDED
+            obj = _make_obj(rng, name, ns, uid)
+            live_uid[key] = uid
+            inf._apply(etype, obj)
+        elif op < 0.60:  # delete the live incarnation
+            if key in live_uid:
+                obj = inf.get(ns, name)
+                if obj is not None:
+                    inf._apply(DELETED, obj)
+                    dead.append((live_uid[key], obj))
+                    del live_uid[key]
+        elif op < 0.75 and dead:  # ghost replay of a dead uid
+            uid, obj = rng.choice(dead)
+            inf._apply(rng.choice([ADDED, MODIFIED, DELETED]), obj)
+        elif op < 0.85 and dead:
+            # Stale-incarnation DELETED: a dead uid under a key that is
+            # live again with a NEW uid must not pop the live object.
+            uid, obj = rng.choice(dead)
+            k = objects.key_of(obj)
+            if k in live_uid and live_uid[k] != uid:
+                inf._apply(DELETED, obj)
+        # else: no-op step (time passes)
+        if step % 100 == 0:
+            _verify_equivalence(inf, owner_uids)
+
+    _verify_equivalence(inf, owner_uids)
+    # The cache itself must agree with the live-object model (ghosts
+    # suppressed, live incarnations intact).
+    assert set(inf._cache) == set(live_uid)
+    for k, uid in live_uid.items():
+        assert objects.uid_of(inf._cache[k]) == uid
+
+
+@pytest.mark.parametrize("seed", [11, 42])
+def test_index_survives_sync_now_relist_churn(seed):
+    """The resync diff path (sync_now) mutates the cache through the same
+    mutators: random store churn + interleaved relists keep indexes exact."""
+    rng = random.Random(seed)
+    client = InMemoryCluster()
+    inf = Informer(client, objects.PODS)
+    owner_uids = {f"owner-{j}" for j in JOBS}
+    uid_seq = 0
+
+    for step in range(300):
+        op = rng.random()
+        ns = rng.choice(NAMESPACES)
+        name = f"pod-{rng.randrange(20)}"
+        if op < 0.5:
+            uid_seq += 1
+            obj = _make_obj(rng, name, ns, "")
+            del obj["metadata"]["uid"]
+            try:
+                client.create(objects.PODS, obj)
+            except Exception:
+                # Exists: mutate labels in place (a relabel on the wire).
+                cur = client.get(objects.PODS, ns, name)
+                cur["metadata"]["labels"] = _make_obj(rng, name, ns, "x")[
+                    "metadata"
+                ]["labels"]
+                client.update(objects.PODS, cur)
+        elif op < 0.75:
+            try:
+                client.delete(objects.PODS, ns, name)
+            except Exception:
+                pass
+        if op >= 0.9 or step % 25 == 0:
+            inf.sync_now()
+            _verify_equivalence(inf, owner_uids)
+
+    inf.sync_now()
+    _verify_equivalence(inf, owner_uids)
+    assert {objects.key_of(o) for o in client.list(objects.PODS)} == set(
+        inf._cache
+    )
+
+
+def test_ghost_replay_leaves_no_stale_index_entry():
+    """The chaos-soak ghost sequence (buffered pre-list events replayed
+    after a relist) must not resurrect the pod into ANY index."""
+    client = InMemoryCluster()
+    pod = {
+        "metadata": {
+            "name": "ghost",
+            "namespace": "alpha",
+            "labels": {"job-name": "job-a"},
+            "ownerReferences": [{"controller": True, "uid": "owner-job-a"}],
+        },
+        "status": {"phase": "Running"},
+    }
+    client.create(objects.PODS, pod)
+    inf = Informer(client, objects.PODS)
+    inf.sync_now()
+    assert inf.list("alpha", {"job-name": "job-a"}) != []
+
+    # Buffer events, then delete; drain-then-relist suppresses the replay.
+    watch = client.watch(objects.PODS)
+    live = client.get(objects.PODS, "alpha", "ghost")
+    objects.set_pod_phase(live, objects.FAILED)
+    client.update_status(objects.PODS, live)
+    client.delete(objects.PODS, "alpha", "ghost")
+    inf._drain(watch)
+    inf.sync_now()
+
+    # Replay the stale MODIFIED (dead uid) straight into _apply: the ghost
+    # must be suppressed in cache AND indexes.
+    inf._apply(MODIFIED, live)
+    inf.check_indexes()
+    assert inf.get("alpha", "ghost") is None
+    assert inf.list("alpha") == []
+    assert inf.list("alpha", {"job-name": "job-a"}) == []
+    assert inf.list_for_owner("owner-job-a", "alpha", {"job-name": "job-a"}) == []
+
+
+def test_relabel_moves_object_between_selector_indexes():
+    inf = Informer(client=None, kind="pods")
+    obj = {
+        "metadata": {
+            "name": "p0", "namespace": "alpha", "uid": "u1",
+            "labels": {"job-name": "job-a"},
+        }
+    }
+    inf._apply(ADDED, obj)
+    assert inf.list("alpha", {"job-name": "job-a"}) == [obj]
+    moved = {
+        "metadata": {
+            "name": "p0", "namespace": "alpha", "uid": "u1",
+            "labels": {"job-name": "job-b"},
+        }
+    }
+    inf._apply(MODIFIED, moved)
+    inf.check_indexes()
+    assert inf.list("alpha", {"job-name": "job-a"}) == []
+    assert inf.list("alpha", {"job-name": "job-b"}) == [moved]
+
+
+def test_owner_flip_moves_object_between_owner_indexes():
+    inf = Informer(client=None, kind="pods")
+    obj = {
+        "metadata": {
+            "name": "p0", "namespace": "alpha", "uid": "u1",
+            "labels": {"job-name": "job-a"},
+            "ownerReferences": [{"controller": True, "uid": "owner-1"}],
+        }
+    }
+    inf._apply(ADDED, obj)
+    assert len(inf.list_for_owner("owner-1", "alpha")) == 1
+    flipped = {
+        "metadata": {
+            "name": "p0", "namespace": "alpha", "uid": "u1",
+            "labels": {},  # relabeled away too: adoption-set must drop it
+            "ownerReferences": [{"controller": True, "uid": "owner-2"}],
+        }
+    }
+    inf._apply(MODIFIED, flipped)
+    inf.check_indexes()
+    assert inf.list_for_owner("owner-1", "alpha") == []
+    assert inf.list_for_owner("owner-1", "alpha", {"job-name": "job-a"}) == []
+    assert inf.list_for_owner("owner-2", "alpha") == [flipped]

@@ -975,7 +975,7 @@ def test_full_job_lifecycle_over_kube_backend():
     REST conventions."""
     import threading
 
-    from test_scale import FakeKubelet
+    from tools.bench_control_plane import WatchKubelet
 
     from tf_operator_tpu.cli.genjob import synthetic_job
     from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
@@ -989,8 +989,9 @@ def test_full_job_lifecycle_over_kube_backend():
     )
     stop = threading.Event()
     threading.Thread(target=tc.run, args=(stop,), daemon=True).start()
-    # the kubelet also talks to the cluster over the wire client
-    kubelet = FakeKubelet(KubeClusterClient(KubeConfig(server=stub.url)), stop)
+    # the kubelet also talks to the cluster over the wire client — watch-
+    # driven (it never lists), the same kubelet the scale bench uses
+    kubelet = WatchKubelet(KubeClusterClient(KubeConfig(server=stub.url)), stop)
     kubelet.start()
     try:
         job = synthetic_job("wire", "default", 2, None, None)

@@ -1,10 +1,27 @@
-"""Informer: list+watch cache with event handlers and periodic resync.
+"""Informer: list+watch cache with secondary indexes, event handlers and
+periodic resync.
 
 Parity: the SharedInformerFactory / unstructured-informer machinery the
 reference builds on (pkg/util/unstructured/informer.go:24-62,
 tfcontroller/informer.go:34-55). The controller reads the world from this
 cache (never directly from the API) and reacts to deltas via handlers; a
 periodic resync re-delivers everything so missed events self-heal.
+
+Reads are index lookups, not scans. Three incremental secondary indexes are
+maintained on every ADDED/MODIFIED/DELETED delta, so the cost of a cache
+read is O(result), not O(world):
+
+- **namespace** — key set per namespace (the old ``list(namespace=...)``
+  prefix scan);
+- **owner uid** — key set per controller ownerReference uid, serving
+  ``get_pods_for_job``-style "everything this job owns" lookups;
+- **label term** — key set per (label, value) pair. A label-selector query
+  hashes each of its terms and intersects the matching key sets
+  (smallest-set first). Indexing per *term* rather than per whole selector
+  keeps delta maintenance O(#labels on the object): a whole-selector index
+  would have to re-evaluate every registered selector (one per live job —
+  O(jobs)) on every pod event, which is exactly the O(jobs x pods) blow-up
+  this index exists to remove.
 
 Tests drive it synchronously via ``sync_now()`` — the analog of seeding
 informer indexers directly in the reference's tier-2 tests
@@ -17,9 +34,12 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable
 
-from tf_operator_tpu.api.helpers import selector_matches
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ADDED, DELETED, MODIFIED, ClusterClient
+from tf_operator_tpu.runtime.metrics import (
+    INFORMER_CACHE_SIZE,
+    INFORMER_INDEX_HITS,
+)
 from tf_operator_tpu.utils import logger
 
 Handler = Callable[[dict[str, Any]], None]
@@ -31,6 +51,14 @@ class EventHandlers:
     on_add: Handler | None = None
     on_update: UpdateHandler | None = None
     on_delete: Handler | None = None
+
+
+def _controller_uid(obj: dict[str, Any]) -> str:
+    """Uid of the controller ownerReference, '' when unowned."""
+    for ref in objects.meta(obj).get("ownerReferences", []) or []:
+        if ref.get("controller"):
+            return str(ref.get("uid", ""))
+    return ""
 
 
 class Informer:
@@ -46,6 +74,13 @@ class Informer:
         self.namespace = namespace
         self.resync_period = resync_period
         self._cache: dict[str, dict[str, Any]] = {}
+        # Secondary indexes: key sets, maintained by _cache_put/_cache_pop
+        # (the ONLY two mutators of _cache) so cache and indexes can never
+        # drift apart, whatever path — watch delta, relist diff, ghost
+        # suppression — mutated the cache.
+        self._by_namespace: dict[str, set[str]] = {}
+        self._by_owner: dict[str, set[str]] = {}
+        self._by_label: dict[tuple[str, str], set[str]] = {}
         self._lock = threading.RLock()
         self._handlers: list[EventHandlers] = []
         self._synced = threading.Event()
@@ -72,6 +107,16 @@ class Informer:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    @property
+    def synced_event(self) -> threading.Event:
+        """Waitable sync barrier: set after the first full list lands in
+        the cache. Callers block on it (``.wait(timeout)``) instead of
+        polling ``has_synced`` in a sleep loop."""
+        return self._synced
+
+    def wait_synced(self, timeout: float | None = None) -> bool:
+        return self._synced.wait(timeout)
+
     def get(self, namespace: str, name: str) -> dict[str, Any] | None:
         with self._lock:
             return self._cache.get(f"{namespace}/{name}")
@@ -82,17 +127,131 @@ class Informer:
         label_selector: dict[str, str] | None = None,
     ) -> list[dict[str, Any]]:
         with self._lock:
-            out = []
-            for key, obj in self._cache.items():
-                if namespace is not None and not key.startswith(namespace + "/"):
-                    continue
-                if label_selector and not selector_matches(
-                    label_selector, objects.labels_of(obj)
-                ):
-                    continue
-                out.append(obj)
+            if label_selector:
+                keys = self._select_keys(label_selector, namespace)
+                INFORMER_INDEX_HITS.inc(kind=self.kind, index="label")
+            elif namespace is not None:
+                keys = self._by_namespace.get(namespace, set())
+                INFORMER_INDEX_HITS.inc(kind=self.kind, index="namespace")
+            else:
+                keys = self._cache.keys()
+            out = [self._cache[k] for k in keys]
             out.sort(key=objects.key_of)
             return out
+
+    def list_for_owner(
+        self,
+        owner_uid: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict[str, Any]]:
+        """Union of the owner-uid and label-selector indexes — the claim
+        candidate set for one controlling object: everything it owns (so a
+        relabeled orphan can be released) plus everything matching its
+        labels (so an unowned match can be adopted). Equivalent to the
+        full-namespace scan RefManager used to filter, because candidates
+        in neither set can produce a claim action."""
+        with self._lock:
+            keys: set[str] = set()
+            if owner_uid:
+                keys |= self._by_owner.get(owner_uid, set())
+                INFORMER_INDEX_HITS.inc(kind=self.kind, index="owner")
+            if label_selector:
+                keys |= self._select_keys(label_selector, namespace)
+                INFORMER_INDEX_HITS.inc(kind=self.kind, index="label")
+            if namespace is not None:
+                ns_keys = self._by_namespace.get(namespace, set())
+                keys &= ns_keys
+            out = [self._cache[k] for k in keys]
+            out.sort(key=objects.key_of)
+            return out
+
+    def _select_keys(
+        self, selector: dict[str, str], namespace: str | None
+    ) -> set[str]:
+        """Keys matching every selector term: intersect the per-term key
+        sets, smallest first (lock held)."""
+        term_sets: list[set[str]] = []
+        for term in selector.items():
+            s = self._by_label.get(term)
+            if not s:
+                return set()
+            term_sets.append(s)
+        term_sets.sort(key=len)
+        keys = set(term_sets[0])
+        for s in term_sets[1:]:
+            keys &= s
+        if namespace is not None:
+            keys &= self._by_namespace.get(namespace, set())
+        return keys
+
+    # -- cache + index mutation (lock held) ----------------------------------
+
+    def _index_add(self, key: str, obj: dict[str, Any]) -> None:
+        self._by_namespace.setdefault(objects.namespace_of(obj), set()).add(key)
+        uid = _controller_uid(obj)
+        if uid:
+            self._by_owner.setdefault(uid, set()).add(key)
+        for term in objects.labels_of(obj).items():
+            self._by_label.setdefault(term, set()).add(key)
+
+    def _index_remove(self, key: str, obj: dict[str, Any]) -> None:
+        def _discard(table: dict, idx_key: Any) -> None:
+            s = table.get(idx_key)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del table[idx_key]
+
+        _discard(self._by_namespace, objects.namespace_of(obj))
+        uid = _controller_uid(obj)
+        if uid:
+            _discard(self._by_owner, uid)
+        for term in objects.labels_of(obj).items():
+            _discard(self._by_label, term)
+
+    def _cache_put(self, key: str, obj: dict[str, Any]) -> None:
+        old = self._cache.get(key)
+        if old is not None:
+            # Labels or ownerReferences may have changed: deindex the old
+            # incarnation first or a relabel would leave a stale entry.
+            self._index_remove(key, old)
+        self._cache[key] = obj
+        self._index_add(key, obj)
+        INFORMER_CACHE_SIZE.set(len(self._cache), kind=self.kind)
+
+    def _cache_pop(self, key: str) -> dict[str, Any] | None:
+        obj = self._cache.pop(key, None)
+        if obj is not None:
+            self._index_remove(key, obj)
+            INFORMER_CACHE_SIZE.set(len(self._cache), kind=self.kind)
+        return obj
+
+    def check_indexes(self) -> None:
+        """Invariant check (tests): every index entry resolves to a cached
+        object that actually has the indexed property, and every cached
+        object is fully indexed. Raises AssertionError on drift."""
+        with self._lock:
+            for ns, keys in self._by_namespace.items():
+                for k in keys:
+                    assert k in self._cache, f"namespace index ghost {k}"
+                    assert objects.namespace_of(self._cache[k]) == ns
+            for uid, keys in self._by_owner.items():
+                for k in keys:
+                    assert k in self._cache, f"owner index ghost {k}"
+                    assert _controller_uid(self._cache[k]) == uid
+            for term, keys in self._by_label.items():
+                for k in keys:
+                    assert k in self._cache, f"label index ghost {k}"
+                    labels = objects.labels_of(self._cache[k])
+                    assert labels.get(term[0]) == term[1]
+            for k, obj in self._cache.items():
+                assert k in self._by_namespace.get(objects.namespace_of(obj), set())
+                uid = _controller_uid(obj)
+                if uid:
+                    assert k in self._by_owner.get(uid, set())
+                for term in objects.labels_of(obj).items():
+                    assert k in self._by_label.get(term, set())
 
     # -- delta processing ----------------------------------------------------
 
@@ -127,7 +286,7 @@ class Informer:
                 )
                 self._mark_dead(obj)
                 if not stale_incarnation:
-                    self._cache.pop(key, None)
+                    self._cache_pop(key)
                 if replayed:
                     # Handlers (expectation decrements) already ran for
                     # this deletion — e.g. the relist diff synthesized it
@@ -138,7 +297,7 @@ class Informer:
                     # Stale replay of an object whose deletion was already
                     # observed — applying it would resurrect a ghost.
                     return
-                self._cache[key] = obj
+                self._cache_put(key, obj)
         for h in self._handlers:
             try:
                 if etype == ADDED and old is None:
@@ -206,8 +365,19 @@ class Informer:
 
     def _run(self, stop: threading.Event) -> None:
         watch = self._client.watch(self.kind, self.namespace)
-        self._drain(watch)  # events buffered between watch-start and list
-        self.sync_now()
+        # Initial sync, retried: a transient apiserver outage at startup
+        # must not kill the informer thread permanently (observed as an
+        # unhandled ConnectionRefused from the chaos suite's stub
+        # teardown) — a dead thread would leave has_synced() false forever
+        # while the controller runs against an empty cache.
+        while not stop.is_set():
+            try:
+                self._drain(watch)  # events buffered between watch-start and list
+                self.sync_now()
+                break
+            except Exception:
+                self._log.exception("initial sync failed; retrying")
+                stop.wait(1.0)
         import time as _time
 
         last_resync = _time.monotonic()

@@ -69,6 +69,16 @@ RESTARTS_TOTAL = REGISTRY.counter(
 )
 
 
+def _semantic_status(status: dict) -> dict:
+    """Status minus the volatile reconcile stamp — the comparison basis for
+    every skip-unchanged guard. Only lastReconcileTime is excluded; it then
+    records the last MEANINGFUL reconcile, which is exactly what its one
+    consumer (cleanup_job's TTL fallback) wants."""
+    out = dict(status)
+    out.pop("lastReconcileTime", None)
+    return out
+
+
 class TPUJobController(JobController, PodReconciler, ServiceReconciler):
     def __init__(
         self,
@@ -92,15 +102,28 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         # unbounded fleet, which still runs the full gate → admit → release
         # pipeline so no partial slice can ever run.
         self.scheduler = scheduler or GangScheduler()
-        self.scheduler.attach(client, recorder, wakeup=self.enqueue)
+        # The scheduler shares this controller's pod informer: gang release
+        # relists and eviction work-lists become cache index lookups
+        # instead of per-call API LISTs (core.py _list_gang_pods).
+        self.scheduler.attach(
+            client, recorder, wakeup=self.enqueue, pod_lister=self.pod_informer
+        )
         # Fleet-health monitor (health/monitor.py), when one was wired onto
         # the scheduler (operator main builds it; tests construct their
         # own). Attaching recovers persisted cordons before the first sync
         # so a restarted controller never re-places a gang on withdrawn
         # cells. Without a monitor the health surfaces stay dormant.
         self.health = getattr(self.scheduler, "health", None)
+        self.node_informer: Informer | None = None
         if self.health is not None:
-            self.health.attach(client, recorder)
+            # Node informer for the heartbeat sweep: the monitor's poll
+            # reads this watch-maintained cache (zero API round-trips in
+            # steady state) once run() has started and synced it; before
+            # that the monitor falls back to a direct LIST.
+            self.node_informer = Informer(
+                client, objects.NODES, None, self.config.informer_resync
+            )
+            self.health.attach(client, recorder, node_lister=self.node_informer)
         self.job_informer = Informer(
             client, objects.TPUJOBS, self.config.namespace, self.config.informer_resync
         )
@@ -194,16 +217,17 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
 
     def run(self, stop: threading.Event) -> None:
         """Start informers + worker threads; blocks until stop is set."""
-        self.job_informer.start(stop)
-        self.pod_informer.start(stop)
-        self.service_informer.start(stop)
+        informers = [self.job_informer, self.pod_informer, self.service_informer]
+        if self.node_informer is not None:
+            informers.append(self.node_informer)
+        for informer in informers:
+            informer.start(stop)
+        # Block on each informer's synced event rather than polling
+        # has_synced in a 10ms sleep loop — the waits overlap (syncs run
+        # in parallel informer threads), bounded by one shared deadline.
         deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not (
-            self.job_informer.has_synced()
-            and self.pod_informer.has_synced()
-            and self.service_informer.has_synced()
-        ):
-            time.sleep(0.01)
+        for informer in informers:
+            informer.synced_event.wait(max(0.0, deadline - time.monotonic()))
         for i in range(self.config.threadiness):
             t = threading.Thread(target=self._worker, name=f"worker-{i}", daemon=True)
             t.start()
@@ -392,15 +416,11 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         # this very sync — without the guard every no-op pass re-stamps
         # last_reconcile_time and the loop feeds itself (profiled round 5:
         # ~144 syncs and ~150 status writes per job over a 3 s fleet
-        # bench). Only the volatile stamp is excluded from the comparison;
-        # it then records the last MEANINGFUL reconcile, which is exactly
-        # what its one consumer (cleanup_job's TTL fallback) wants.
-        def _semantic(status: dict) -> dict:
-            out = dict(status)
-            out.pop("lastReconcileTime", None)
-            return out
-
-        if _semantic(job.status.to_dict()) == _semantic(status_before):
+        # bench). Comparison excludes only the volatile stamp
+        # (_semantic_status).
+        if _semantic_status(job.status.to_dict()) == _semantic_status(
+            status_before
+        ):
             return True
         try:
             self.update_status_handler(job)
@@ -717,7 +737,21 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         store already reached a terminal state this (stale) computation must
         not overwrite it — blindly bumping the RV would turn optimistic
         concurrency into last-writer-wins and lose the terminal condition.
+
+        Uniform no-op skip: when the informer cache already shows exactly
+        this status, the write is dropped before it reaches the wire. The
+        sync path's own diff-against-snapshot guard (_maybe_write_status)
+        catches most no-ops; this second layer covers every OTHER caller —
+        add_job re-observing an already-stamped Created condition on a
+        handler replay, and post-conflict recomputes that converged on the
+        stored value. A write wrongly needed is never skipped: a stale
+        cache differs from the computed status and falls through.
         """
+        cached = self.job_informer.get(job.metadata.namespace, job.metadata.name)
+        if cached is not None and _semantic_status(
+            cached.get("status") or {}
+        ) == _semantic_status(job.status.to_dict()):
+            return
         for attempt in range(3):
             try:
                 self.client.update_status(objects.TPUJOBS, job.to_dict())

@@ -76,6 +76,13 @@ class RateLimitingQueue:
         self._dirty: set[Hashable] = set()
         self._processing: set[Hashable] = set()
         self._delayed: list[tuple[float, int, Hashable]] = []  # heap by ready-time
+        # item -> earliest pending ready-time: the coalescing ledger for the
+        # delayed heap. Heap entries whose time no longer matches it are
+        # superseded duplicates and are dropped at pop (lazy deletion).
+        self._delayed_pending: dict[Hashable, float] = {}
+        # Count of delayed enqueues coalesced into an already-pending entry
+        # (observability; the scale bench reports it).
+        self.coalesced = 0
         self._seq = 0
         self._shutdown = False
 
@@ -91,14 +98,27 @@ class RateLimitingQueue:
                 self._cond.notify()
 
     def add_after(self, item: Hashable, delay: float) -> None:
+        """Schedule item for the ready queue after ``delay``; duplicate
+        delayed enqueues coalesce to the EARLIEST deadline. Every consumer
+        of a delayed pass is a level-triggered reconcile that reschedules
+        its own next pass, so one (earliest) pending entry per key is
+        equivalent to N of them — while N per key is what the periodic
+        requeue + resync traffic produced at scale (heap growth O(waves ×
+        jobs) instead of O(jobs))."""
         if delay <= 0:
             self.add(item)
             return
         with self._cond:
             if self._shutdown:
                 return
+            ready = time.monotonic() + delay
+            pending = self._delayed_pending.get(item)
+            if pending is not None and pending <= ready:
+                self.coalesced += 1
+                return
+            self._delayed_pending[item] = ready
             self._seq += 1
-            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            heapq.heappush(self._delayed, (ready, self._seq, item))
             self._cond.notify()
 
     def add_rate_limited(self, item: Hashable) -> None:
@@ -108,7 +128,10 @@ class RateLimitingQueue:
         """Move due delayed items to ready; return seconds to next due item."""
         now = time.monotonic()
         while self._delayed and self._delayed[0][0] <= now:
-            _, _, item = heapq.heappop(self._delayed)
+            ready, _, item = heapq.heappop(self._delayed)
+            if self._delayed_pending.get(item) != ready:
+                continue  # superseded by an earlier re-add; already served
+            del self._delayed_pending[item]
             if item not in self._dirty:
                 self._dirty.add(item)
                 if item not in self._processing:

@@ -113,6 +113,11 @@ class FleetHealthMonitor:
         self.client = client if client is not None else scheduler.client
         self.config = config or HealthConfig()
         self.recorder = recorder
+        # Shared node informer (controller-owned), when one was attached:
+        # the heartbeat sweep reads this cache once it has synced, so the
+        # steady-state poll costs zero API round-trips. Monitors built
+        # without one (tests, standalone) keep the direct LIST.
+        self.node_lister: Any | None = None
         self._lock = threading.RLock()
         self._cells: dict[tuple[str, tuple[int, ...]], CellHealth] = {}
         self._seen_exits: set[tuple[str, str]] = set()
@@ -132,7 +137,10 @@ class FleetHealthMonitor:
     # -- wiring ---------------------------------------------------------------
 
     def attach(
-        self, client: ClusterClient, recorder: Any | None = None
+        self,
+        client: ClusterClient,
+        recorder: Any | None = None,
+        node_lister: Any | None = None,
     ) -> None:
         """Late binding, mirroring GangScheduler.attach (the operator main
         builds the monitor from flags before any client exists)."""
@@ -140,6 +148,8 @@ class FleetHealthMonitor:
             self.client = client
         if self.recorder is None:
             self.recorder = recorder
+        if node_lister is not None:
+            self.node_lister = node_lister
         if not self._recovered:
             self.recover()
 
@@ -210,13 +220,18 @@ class FleetHealthMonitor:
     def observe_nodes(self, now: float | None = None) -> None:
         """Heartbeat sweep: list node objects, mark cells of NotReady (or
         heartbeat-stale) TPU hosts, recover cells whose host came back."""
-        if self.client is None:
-            return
         now = now if now is not None else _time_now()
-        try:
-            nodes = self.client.list(objects.NODES, None)
-        except ApiError:
-            return
+        lister = self.node_lister
+        if lister is not None and lister.has_synced():
+            # Watch-maintained cache: the poll issues no API round-trip.
+            nodes = lister.list()
+        else:
+            if self.client is None:
+                return
+            try:
+                nodes = self.client.list(objects.NODES, None)
+            except ApiError:
+                return
         with self._lock:
             for node in nodes:
                 gen = objects.node_generation(node)

@@ -55,7 +55,7 @@ from tf_operator_tpu.runtime.client import (
     Watch,
     WatchEvent,
 )
-from tf_operator_tpu.runtime.metrics import REGISTRY
+from tf_operator_tpu.runtime.metrics import API_REQUESTS_TOTAL, REGISTRY
 from tf_operator_tpu.utils import logger
 from tf_operator_tpu.utils.times import parse_rfc3339
 
@@ -614,6 +614,7 @@ class KubeClusterClient(ClusterClient):
     # -- ClusterClient ------------------------------------------------------
 
     def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="create", kind=kind)
         ns = objects.namespace_of(obj)
         objects.meta(obj).setdefault("namespace", ns)
         return self._call(
@@ -621,6 +622,7 @@ class KubeClusterClient(ClusterClient):
         )
 
     def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="get", kind=kind)
         return self._call("GET", self._item(kind, namespace, name))
 
     def list(
@@ -641,6 +643,7 @@ class KubeClusterClient(ClusterClient):
         a 10k-pod collection never lands in one response body. The returned
         metadata is the FINAL page's — its resourceVersion is the collection
         RV as of the first page's snapshot, which is what watch resume needs."""
+        API_REQUESTS_TOTAL.inc(verb="list", kind=kind)
         base_params: dict[str, str] = {}
         if label_selector:
             base_params["labelSelector"] = ",".join(
@@ -682,12 +685,14 @@ class KubeClusterClient(ClusterClient):
                 return out
 
     def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="update", kind=kind)
         ns, name = objects.namespace_of(obj), objects.name_of(obj)
         return self._call(
             "PUT", self._item(kind, ns, name), self._stamp_gvk(kind, obj)
         )
 
     def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="update_status", kind=kind)
         ns, name = objects.namespace_of(obj), objects.name_of(obj)
         return self._call(
             "PUT", self._item(kind, ns, name) + "/status", self._stamp_gvk(kind, obj)
@@ -696,6 +701,7 @@ class KubeClusterClient(ClusterClient):
     def patch_merge(
         self, kind: str, namespace: str, name: str, patch: dict[str, Any]
     ) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="patch", kind=kind)
         return self._call(
             "PATCH",
             self._item(kind, namespace, name),
@@ -704,6 +710,7 @@ class KubeClusterClient(ClusterClient):
         )
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        API_REQUESTS_TOTAL.inc(verb="delete", kind=kind)
         self._call("DELETE", self._item(kind, namespace, name))
 
     # -- watch --------------------------------------------------------------
@@ -717,6 +724,7 @@ class KubeClusterClient(ClusterClient):
         last delivered RV; on 410 Gone relist for a fresh RV (the informer's
         periodic resync repairs anything missed during the gap).
         """
+        API_REQUESTS_TOTAL.inc(verb="watch", kind=kind)
         watch = Watch()
         stopped = threading.Event()
         with self._lock:

@@ -36,6 +36,7 @@ from tf_operator_tpu.runtime.client import (
     WatchEvent,
     merge_patch,
 )
+from tf_operator_tpu.runtime.metrics import API_REQUESTS_TOTAL
 
 
 def _matches(selector: dict[str, str] | None, obj: dict[str, Any]) -> bool:
@@ -106,6 +107,7 @@ class InMemoryCluster(ClusterClient):
     # -- ClusterClient -------------------------------------------------------
 
     def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="create", kind=kind)
         with self._lock:
             obj = copy.deepcopy(obj)
             m = objects.meta(obj)
@@ -127,6 +129,7 @@ class InMemoryCluster(ClusterClient):
             return copy.deepcopy(obj)
 
     def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="get", kind=kind)
         with self._lock:
             try:
                 return copy.deepcopy(self._store[kind][namespace][name])
@@ -139,6 +142,7 @@ class InMemoryCluster(ClusterClient):
         namespace: str | None = None,
         label_selector: dict[str, str] | None = None,
     ) -> list[dict[str, Any]]:
+        API_REQUESTS_TOTAL.inc(verb="list", kind=kind)
         with self._lock:
             out: list[dict[str, Any]] = []
             for ns, coll in self._store.get(kind, {}).items():
@@ -151,6 +155,9 @@ class InMemoryCluster(ClusterClient):
             return out
 
     def _update(self, kind: str, obj: dict[str, Any], status_only: bool) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(
+            verb="update_status" if status_only else "update", kind=kind
+        )
         with self._lock:
             ns, name = objects.namespace_of(obj), objects.name_of(obj)
             coll = self._coll(kind, ns)
@@ -193,6 +200,7 @@ class InMemoryCluster(ClusterClient):
     def patch_merge(
         self, kind: str, namespace: str, name: str, patch: dict[str, Any]
     ) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="patch", kind=kind)
         with self._lock:
             coll = self._coll(kind, namespace)
             if name not in coll:
@@ -213,6 +221,7 @@ class InMemoryCluster(ClusterClient):
         scheduler uses on the in-memory backend; wire backends fall back to
         per-pod patches (see scheduler/core.py release_gang).
         """
+        API_REQUESTS_TOTAL.inc(verb="patch", kind=objects.PODS)
         updated: list[dict[str, Any]] = []
         with self._lock:
             coll = self._coll(objects.PODS, namespace)
@@ -237,6 +246,7 @@ class InMemoryCluster(ClusterClient):
         these node objects (Ready=False, or a heartbeat gone stale) as the
         NotReady signal source; the same surface exists over the wire stub
         as PUT /api/v1/nodes/{name}/status."""
+        API_REQUESTS_TOTAL.inc(verb="update_status", kind=objects.NODES)
         with self._lock:
             node = self._coll(objects.NODES, "default").get(name)
             if node is None:
@@ -247,6 +257,7 @@ class InMemoryCluster(ClusterClient):
             return copy.deepcopy(node)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        API_REQUESTS_TOTAL.inc(verb="delete", kind=kind)
         with self._lock:
             coll = self._coll(kind, namespace)
             obj = coll.pop(name, None)
@@ -255,6 +266,7 @@ class InMemoryCluster(ClusterClient):
             self._broadcast(kind, DELETED, obj)
 
     def watch(self, kind: str, namespace: str | None = None) -> Watch:
+        API_REQUESTS_TOTAL.inc(verb="watch", kind=kind)
         with self._lock:
             w = Watch()
             self._watchers.append((kind, namespace, w))

@@ -265,6 +265,37 @@ REGISTRY = Registry()
 
 
 # ---------------------------------------------------------------------------
+# Control-plane traffic observability (consumed by runtime/memcluster.py,
+# runtime/kubeclient.py and controller/informer.py). Declared here so every
+# process exposes the full schema from the first scrape, and so the scale
+# benchmark (tools/bench_control_plane.py) can assert "steady-state
+# reconcile waves issue zero API list calls" against real counters rather
+# than log scraping.
+# ---------------------------------------------------------------------------
+
+API_REQUESTS_TOTAL = REGISTRY.counter(
+    "tpu_api_requests_total",
+    "Cluster API requests issued through a ClusterClient implementation, "
+    "by verb and resource kind — LOGICAL requests: memcluster counts "
+    "in-process store calls; kubeclient counts one per call (a paginated "
+    "LIST still counts once, not per page); over the wire stub both "
+    "sides count, one hop each",
+    ("verb", "kind"),
+)
+INFORMER_CACHE_SIZE = REGISTRY.gauge(
+    "tpu_informer_cache_size",
+    "Objects resident in the informer cache, by resource kind",
+    ("kind",),
+)
+INFORMER_INDEX_HITS = REGISTRY.counter(
+    "tpu_informer_index_hits_total",
+    "Informer cache reads served by a secondary index (namespace / owner "
+    "uid / label term) instead of a full cache scan",
+    ("kind", "index"),
+)
+
+
+# ---------------------------------------------------------------------------
 # Gang-scheduler metric families (consumed by tf_operator_tpu/scheduler/).
 # Declared here rather than in the scheduler so every process that imports
 # the registry exposes the full schema on /metrics from the first scrape —

@@ -28,6 +28,7 @@ from tf_operator_tpu.runtime.client import (
     Watch,
     WatchEvent,
 )
+from tf_operator_tpu.runtime.metrics import API_REQUESTS_TOTAL
 
 _ERRORS = {
     "NotFound": NotFound,
@@ -85,9 +86,11 @@ class RestClusterClient(ClusterClient):
     # -- ClusterClient ------------------------------------------------------
 
     def create(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="create", kind=kind)
         return self._call("POST", f"/api/{kind}", obj)
 
     def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="get", kind=kind)
         return self._call("GET", f"/api/{kind}/{namespace}/{name}")
 
     def list(
@@ -96,6 +99,7 @@ class RestClusterClient(ClusterClient):
         namespace: str | None = None,
         label_selector: dict[str, str] | None = None,
     ) -> list[dict[str, Any]]:
+        API_REQUESTS_TOTAL.inc(verb="list", kind=kind)
         params: dict[str, str] = {}
         if namespace is not None:
             params["namespace"] = namespace
@@ -107,11 +111,13 @@ class RestClusterClient(ClusterClient):
         return self._call("GET", f"/api/{kind}{qs}")["items"]
 
     def update(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="update", kind=kind)
         meta = obj.get("metadata", {})
         ns, name = meta.get("namespace", "default"), meta.get("name", "")
         return self._call("PUT", f"/api/{kind}/{ns}/{name}", obj)
 
     def update_status(self, kind: str, obj: dict[str, Any]) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="update_status", kind=kind)
         meta = obj.get("metadata", {})
         ns, name = meta.get("namespace", "default"), meta.get("name", "")
         return self._call("PUT", f"/api/{kind}/{ns}/{name}/status", obj)
@@ -119,12 +125,15 @@ class RestClusterClient(ClusterClient):
     def patch_merge(
         self, kind: str, namespace: str, name: str, patch: dict[str, Any]
     ) -> dict[str, Any]:
+        API_REQUESTS_TOTAL.inc(verb="patch", kind=kind)
         return self._call("PATCH", f"/api/{kind}/{namespace}/{name}", patch)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        API_REQUESTS_TOTAL.inc(verb="delete", kind=kind)
         self._call("DELETE", f"/api/{kind}/{namespace}/{name}")
 
     def watch(self, kind: str, namespace: str | None = None) -> Watch:
+        API_REQUESTS_TOTAL.inc(verb="watch", kind=kind)
         params: dict[str, str] = {"watch": "1"}
         if namespace is not None:
             params["namespace"] = namespace

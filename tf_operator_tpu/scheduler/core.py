@@ -132,6 +132,11 @@ class GangScheduler:
         self.ledger = QuotaLedger(self.config.quotas)
         self._admitted: dict[str, Gang] = {}
         self._wakeup: Callable[[str], None] | None = None
+        # Shared pod informer (controller-owned), when one was attached:
+        # gang pod enumeration (release relist, eviction work-list) reads
+        # this cache instead of issuing an API LIST per call — the
+        # steady-state pump then costs zero API round-trips.
+        self._pod_lister: Any | None = None
         # Set by health/monitor.py when a FleetHealthMonitor is wired in;
         # the controller reaches the monitor through this back-reference.
         # The scheduler itself never calls into it (lock ordering: the
@@ -146,6 +151,7 @@ class GangScheduler:
         client: ClusterClient,
         recorder: Any | None = None,
         wakeup: Callable[[str], None] | None = None,
+        pod_lister: Any | None = None,
     ) -> None:
         """Late binding for pieces the controller owns (operator.py builds
         the scheduler from flags before any client exists)."""
@@ -155,6 +161,28 @@ class GangScheduler:
             self.recorder = recorder
         if wakeup is not None:
             self._wakeup = wakeup
+        if pod_lister is not None:
+            self._pod_lister = pod_lister
+
+    def _list_gang_pods(self, gang: Gang) -> list[dict[str, Any]]:
+        """This gang's pods, from the shared informer cache when possible.
+
+        Falls back to an API LIST only when the cache cannot be
+        authoritative yet: not attached / not synced, or showing fewer
+        pods than the gang expects (a creation is still in flight — the
+        same sync that created the pods asks for the release relist
+        before the watch deltas land, and gang release must not wait a
+        round-trip of informer lag). In steady state — every pod exists
+        and is cached — this is a pure index lookup.
+        """
+        selector = {constants.LABEL_JOB_NAME: gang.name}
+        lister = self._pod_lister
+        if lister is not None and lister.has_synced():
+            pods = lister.list(gang.namespace, selector)
+            if len(pods) >= gang.pod_count:
+                return pods
+        assert self.client is not None
+        return self.client.list(objects.PODS, gang.namespace, selector)
 
     def gates_for(self, job: TPUJob) -> list[dict[str, str]]:
         """Scheduling gates to stamp on this job's pods at creation."""
@@ -215,12 +243,7 @@ class GangScheduler:
             gang = self._admitted.get(job.key)
             if gang is None:
                 return False
-            assert self.client is not None
-            pods = self.client.list(
-                objects.PODS,
-                gang.namespace,
-                {constants.LABEL_JOB_NAME: gang.name},
-            )
+            pods = self._list_gang_pods(gang)
             if len(pods) < gang.pod_count:
                 return False
             gated = [p for p in pods if is_gated(p)]
@@ -624,13 +647,12 @@ class GangScheduler:
         """
         assert self.client is not None
         # 1. Enumerate the gang BEFORE any state changes: an unreachable
-        #    apiserver aborts the eviction cleanly.
+        #    apiserver aborts the eviction cleanly. Served by the informer
+        #    cache when it can be authoritative (see _list_gang_pods); a
+        #    cache miss of an in-flight pod is covered by the existing
+        #    queued-gang-with-pods cleanup, which finishes any leftover.
         try:
-            pods = self.client.list(
-                objects.PODS,
-                victim.namespace,
-                {constants.LABEL_JOB_NAME: victim.name},
-            )
+            pods = self._list_gang_pods(victim)
         except ApiError:
             self.log.warning(
                 "evict %s aborted: pod list failed; victim keeps capacity",

@@ -1,0 +1,337 @@
+"""Control-plane scale benchmark: N synthetic TPUJobs through a real
+TPUJobController against the in-memory cluster with a watch-driven fake
+kubelet.
+
+What it proves (ISSUE 3 / docs/performance.md): the controller's read path
+is O(result), not O(world) — indexed informer lookups serve every pod/
+service/node read, so a steady-state reconcile wave issues ZERO API `list`
+calls for those kinds, and p99 sync latency stays flat at 10x the
+reference's O(100)-job design target (tf_job_design_doc.md:32-36).
+
+The kubelet here is deliberately watch-driven (it never lists): pods are
+tracked from watch deltas and advanced Pending → Running → Succeeded via
+update_status, so the `tpu_api_requests_total{verb="list"}` counters
+measure only what the CONTROL PLANE issues.
+
+Phases:
+  1. start controller, wait for informer sync     (initial LISTs land here)
+  2. submit N jobs, drive all of them to Running  (creation wave)
+  3. hold Running for --steady-seconds            (steady-state window:
+     reconcile waves run; list counters for pods/services/nodes must not
+     move)
+  4. release the kubelet hold, drive all jobs to Succeeded
+
+Emits one BENCH-style JSON line (the same shape bench.py emits), plus a
+full result dict on --verbose. Used by tests/test_scale.py (100-job tier-1
+smoke, 1000-job slow+scale tier) and picked up by bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.cli.genjob import synthetic_job
+from tf_operator_tpu.controller import tpujob_controller as tc_mod
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, Conflict, NotFound
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.runtime.metrics import API_REQUESTS_TOTAL
+
+# The kinds whose steady-state reads must be cache-served (the acceptance
+# bar): pod/service reads in every sync, node reads in every health poll.
+CACHED_KINDS = (objects.PODS, objects.SERVICES, objects.NODES)
+VERBS = ("create", "get", "list", "update", "update_status", "patch",
+         "delete", "watch")
+
+
+class WatchKubelet(threading.Thread):
+    """Advances pods Pending → Running → Succeeded from watch deltas only.
+
+    Never calls list: its world model is built purely from the pod watch
+    stream, so every `list` the API counters record during the run is the
+    control plane's. Scheduling-gated pods are left alone (the store would
+    reject the phase write anyway); they advance once the gang release
+    ungates them. Works over ANY ClusterClient (watch + update_status) —
+    the wire E2E (tests/test_kubeclient.py) runs it over KubeClusterClient.
+    """
+
+    def __init__(self, client: Any, stop: threading.Event) -> None:
+        super().__init__(daemon=True, name="watch-kubelet")
+        self.client = client
+        self.stop_event = stop
+        self.hold_running = threading.Event()  # set = do NOT finish pods
+        self._running: dict[str, dict[str, Any]] = {}  # name -> last seen pod
+        self.running_count = 0
+
+    def _advance(self, pod: dict[str, Any]) -> None:
+        name = objects.name_of(pod)
+        phase = objects.pod_phase(pod)
+        gated = bool(pod.get("spec", {}).get("schedulingGates"))
+        try:
+            if phase == objects.PENDING and not gated:
+                objects.set_pod_phase(pod, objects.RUNNING)
+                self.client.update_status(objects.PODS, pod)
+            elif phase == objects.RUNNING:
+                if self.hold_running.is_set():
+                    if name not in self._running:
+                        self._running[name] = pod
+                        self.running_count = len(self._running)
+                else:
+                    objects.set_pod_phase(pod, objects.SUCCEEDED)
+                    objects.set_container_terminated(
+                        pod, constants.DEFAULT_CONTAINER_NAME, 0
+                    )
+                    self.client.update_status(objects.PODS, pod)
+                    self._running.pop(name, None)
+        except (Conflict, NotFound):
+            # Raced a controller write or a deletion: the store broadcasts
+            # another MODIFIED with the fresh RV (or the pod is gone);
+            # the next event retries — exactly a kubelet's model.
+            pass
+        except ApiError:
+            pass
+
+    def release(self) -> None:
+        """Stop holding: finish everything currently Running, and let new
+        Running pods complete immediately."""
+        self.hold_running.clear()
+        for pod in list(self._running.values()):
+            self._advance(pod)
+        self._running.clear()
+
+    def run(self) -> None:
+        watch = self.client.watch(objects.PODS, None)
+        while not self.stop_event.is_set():
+            event = watch.next(timeout=0.1)
+            if event is None:
+                continue
+            if event.type == "DELETED":
+                self._running.pop(objects.name_of(event.object), None)
+                continue
+            self._advance(event.object)
+        watch.stop()
+
+
+def _api_snapshot() -> dict[tuple[str, str], float]:
+    kinds = set(CACHED_KINDS) | {objects.TPUJOBS, objects.PDBS,
+                                 objects.CONFIGMAPS, objects.EVENTS}
+    return {
+        (verb, kind): API_REQUESTS_TOTAL.value(verb=verb, kind=kind)
+        for verb in VERBS
+        for kind in kinds
+    }
+
+
+def _api_delta(
+    t0: dict[tuple[str, str], float]
+) -> dict[str, dict[str, int]]:
+    out: dict[str, dict[str, int]] = {}
+    for (verb, kind), before in t0.items():
+        d = int(API_REQUESTS_TOTAL.value(verb=verb, kind=kind) - before)
+        if d:
+            out.setdefault(verb, {})[kind] = d
+    return out
+
+
+def run_bench(
+    jobs: int = 1000,
+    workers: int = 1,
+    threadiness: int = 4,
+    reconcile_period: float = 2.0,
+    steady_seconds: float = 6.0,
+    timeout: float = 300.0,
+) -> dict[str, Any]:
+    client = InMemoryCluster()
+    controller = TPUJobController(
+        client,
+        JobControllerConfig(
+            reconcile_period=reconcile_period,
+            # Resync re-lists by design; park it outside the run so the
+            # list counters isolate the reconcile path itself.
+            informer_resync=3600.0,
+            threadiness=threadiness,
+        ),
+    )
+    stop = threading.Event()
+    sync_baseline = tc_mod.SYNC_SECONDS.snapshot()
+    threading.Thread(target=controller.run, args=(stop,), daemon=True).start()
+    kubelet = WatchKubelet(client, stop)
+    kubelet.hold_running.set()
+    kubelet.start()
+
+    result: dict[str, Any] = {
+        "jobs": jobs, "workers": workers, "threadiness": threadiness,
+        "reconcile_period_s": reconcile_period,
+    }
+    max_queue_depth = 0
+
+    def _sample_queue() -> None:
+        nonlocal max_queue_depth
+        max_queue_depth = max(max_queue_depth, len(controller.queue))
+
+    try:
+        for informer in (controller.job_informer, controller.pod_informer,
+                         controller.service_informer):
+            if not informer.wait_synced(30):
+                raise RuntimeError("informers never synced")
+        run_t0 = _api_snapshot()
+
+        # -- creation wave ---------------------------------------------------
+        t0 = time.monotonic()
+        for i in range(jobs):
+            client.create(
+                objects.TPUJOBS,
+                synthetic_job(f"bench-{i}", "default", workers, None, None),
+            )
+        result["submit_seconds"] = round(time.monotonic() - t0, 3)
+
+        want_pods = jobs * workers
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            _sample_queue()
+            if kubelet.running_count >= want_pods:
+                break
+            time.sleep(0.2)
+        result["time_to_all_running_s"] = round(time.monotonic() - t0, 3)
+        if kubelet.running_count < want_pods:
+            result["error"] = (
+                f"only {kubelet.running_count}/{want_pods} pods Running "
+                f"after {timeout}s"
+            )
+            return result
+
+        # -- steady-state window ---------------------------------------------
+        steady_t0 = _api_snapshot()
+        steady_sync_t0 = tc_mod.SYNCS_TOTAL.value(result="ok")
+        steady_end = time.monotonic() + steady_seconds
+        while time.monotonic() < steady_end:
+            _sample_queue()
+            time.sleep(0.1)
+        steady = _api_delta(steady_t0)
+        result["steady_seconds"] = steady_seconds
+        result["steady_syncs"] = int(
+            tc_mod.SYNCS_TOTAL.value(result="ok") - steady_sync_t0
+        )
+        result["steady_api_requests"] = steady
+        result["steady_list_calls"] = {
+            kind: steady.get("list", {}).get(kind, 0) for kind in CACHED_KINDS
+        }
+
+        # -- drain to Succeeded ----------------------------------------------
+        kubelet.release()
+
+        def succeeded_count() -> int:
+            n = 0
+            for job in client.list(objects.TPUJOBS, "default"):
+                for cond in job.get("status", {}).get("conditions", []):
+                    if cond["type"] == "Succeeded" and cond["status"] == "True":
+                        n += 1
+                        break
+            return n
+
+        done = 0
+        while time.monotonic() < deadline:
+            _sample_queue()
+            done = succeeded_count()
+            if done == jobs:
+                break
+            time.sleep(0.3)
+        result["succeeded"] = done
+        result["total_seconds"] = round(time.monotonic() - t0, 3)
+        if done < jobs:
+            result["error"] = f"only {done}/{jobs} jobs Succeeded"
+
+        # Workqueue drain: once the fleet is terminal nothing should keep
+        # keys ready — a leak in the delayed-heap coalescing would show up
+        # here as a queue that never empties (the old 100-job scale test's
+        # assertion, carried over).
+        drain_deadline = time.monotonic() + 15
+        drained = False
+        while time.monotonic() < drain_deadline:
+            if len(controller.queue) == 0:
+                drained = True
+                break
+            time.sleep(0.1)
+        result["queue_drained"] = drained
+        result["final_queue_depth"] = len(controller.queue)
+
+        result["p50_sync_ms"] = round(
+            tc_mod.SYNC_SECONDS.quantile(0.5, since=sync_baseline) * 1e3, 3
+        )
+        result["p99_sync_ms"] = round(
+            tc_mod.SYNC_SECONDS.quantile(0.99, since=sync_baseline) * 1e3, 3
+        )
+        result["max_queue_depth"] = max_queue_depth
+        result["enqueues_coalesced"] = controller.queue.coalesced
+        result["api_requests"] = _api_delta(run_t0)
+        wedged = [
+            k for k in list(controller.expectations._store)
+            if not controller.expectations.satisfied(k)
+        ]
+        result["wedged_expectations"] = wedged
+        return result
+    finally:
+        stop.set()
+        time.sleep(0.3)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="bench_control_plane", description=__doc__)
+    p.add_argument("--jobs", type=int, default=1000)
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--threadiness", type=int, default=4)
+    p.add_argument("--reconcile-period", type=float, default=2.0)
+    p.add_argument("--steady-seconds", type=float, default=6.0)
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--verbose", action="store_true",
+                   help="also print the full result dict")
+    args = p.parse_args(argv)
+
+    result = run_bench(
+        jobs=args.jobs,
+        workers=args.workers,
+        threadiness=args.threadiness,
+        reconcile_period=args.reconcile_period,
+        steady_seconds=args.steady_seconds,
+        timeout=args.timeout,
+    )
+    if args.verbose:
+        print(json.dumps(result, indent=2), file=sys.stderr)
+
+    steady_lists = sum(result.get("steady_list_calls", {}).values())
+    # The BENCH-style line (same shape bench.py emits). vs_baseline: the
+    # reference design target is O(100) jobs; value 1.0 at 100 jobs.
+    line = {
+        "metric": "control_plane_jobs_sustained",
+        "value": result.get("succeeded", 0),
+        "unit": "jobs",
+        "vs_baseline": round(result.get("succeeded", 0) / 100.0, 3),
+        "p50_sync_ms": result.get("p50_sync_ms"),
+        "p99_sync_ms": result.get("p99_sync_ms"),
+        "total_seconds": result.get("total_seconds"),
+        "steady_list_calls": steady_lists,
+        "steady_syncs": result.get("steady_syncs"),
+        "max_queue_depth": result.get("max_queue_depth"),
+        "enqueues_coalesced": result.get("enqueues_coalesced"),
+    }
+    if "error" in result:
+        line["error"] = result["error"]
+    print(json.dumps(line), flush=True)
+    return 1 if "error" in result else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
