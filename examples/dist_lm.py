@@ -24,6 +24,13 @@ over a dp x sp x tp mesh spanning every process:
   sharded_lm_xent.
 - Checkpoint/resume + simulated preemption mirror dist_mnist.py so the
   ExitCode restart policy can be exercised on the LM path too.
+- Checkpoint coordination (tf_operator_tpu/ckpt/): with checkpointing on,
+  the operator's eviction signal (relayed by the local executor as a
+  graceful SIGTERM, utils/signals.py) triggers a forced save + durable
+  ack instead of being ignored, the periodic saves report progress via
+  the ack file, and resume honors the injected TPU_RESUME_STEP /
+  TPU_CKPT_DIR contract — so a preempted/migrated replica restarts from
+  its last acked step, not the latest periodic save it happens to see.
 
 Data is a synthetic next-token task (tokens advance by +1 mod vocab) the
 model must actually learn — the acceptance check fails the replica when
@@ -111,6 +118,23 @@ def main(argv: list[str] | None = None) -> int:
         # Ring attention only engages when the sequence is sharded; a
         # forced impl with sp=1 would silently train on plain attention.
         p.error("--ring-impl requires --sp > 1 (ring attention is off)")
+
+    import os
+
+    # Operator-injected checkpoint contract (ckpt/protocol.py): a
+    # replacement pod of a checkpointing job learns its directory even
+    # when the manifest never spelled one out.
+    ckpt_dir = args.checkpoint_dir or os.environ.get("TPU_CKPT_DIR")
+    stop_event = None
+    if ckpt_dir:
+        # Install BEFORE any heavy initialization: the eviction signal can
+        # arrive at any point, and an uninstalled handler would kill the
+        # process instead of requesting a checkpoint. Only checkpointing
+        # runs trap SIGTERM — a non-checkpointing replica keeps the
+        # default die-on-TERM so plain deletions stay prompt.
+        from tf_operator_tpu.utils import signals
+
+        stop_event = signals.setup_signal_handler()
 
     from tf_operator_tpu.train import distributed
 
@@ -246,14 +270,22 @@ def main(argv: list[str] | None = None) -> int:
     ckpt = None
     start_step = 0
     resumed = False
-    if args.checkpoint_dir:
-        from tf_operator_tpu.train.checkpoint import CheckpointManager
+    if ckpt_dir:
+        from tf_operator_tpu.train.checkpoint import (
+            CheckpointManager,
+            resume_min_step,
+        )
 
         ckpt = CheckpointManager(
-            args.checkpoint_dir, max_to_keep=2,
+            ckpt_dir, max_to_keep=2,
             save_interval_steps=args.checkpoint_interval,
         )
-        state, start_step = ckpt.restore_or_init(state)
+        # min_step: the operator's acked-step contract — reload() the
+        # cached step list rather than resume below what is known durable
+        # (the CheckpointManager follower caveat).
+        state, start_step = ckpt.restore_or_init(
+            state, min_step=resume_min_step()
+        )
         # resumed (not the clamped start_step) gates the preemption sim:
         # with --steps 1 the clamp forces start_step back to 0, and a
         # start_step==0 guard would re-fire exit 138 forever.
@@ -340,10 +372,32 @@ def main(argv: list[str] | None = None) -> int:
 
     t0 = time.perf_counter()
     metrics = None
+    evict_acked = False
     for i in range(start_step, args.steps):
         state, metrics = step(state, next_data(i))
         if ckpt is not None:
             ckpt.save(i, state)
+            # Progress report: the latest COMMITTED step, at zero sync
+            # cost — feeds the operator's registry and staleness view.
+            ckpt.maybe_ack()
+            if (
+                stop_event is not None
+                and stop_event.is_set()
+                and not evict_acked
+            ):
+                # Eviction checkpoint signal (the executor's graceful
+                # SIGTERM): force-save the current step, drain the async
+                # writer, and ack durably — the operator's eviction
+                # barrier releases on this. Then KEEP training: exiting
+                # here would read as success, and the pod is killed when
+                # the barrier actually evicts.
+                ckpt.save(i, state, force=True)
+                acked = ckpt.ack()
+                evict_acked = True
+                print(
+                    f"dist_lm: eviction signal — checkpoint durable at "
+                    f"step {acked}", flush=True,
+                )
         if (
             args.fail_at_step is not None
             and i == args.fail_at_step

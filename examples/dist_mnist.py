@@ -158,13 +158,21 @@ def main(argv: list[str] | None = None) -> int:
     start_step = 0
     resumed = False
     if args.checkpoint_dir:
-        from tf_operator_tpu.train.checkpoint import CheckpointManager
+        from tf_operator_tpu.train.checkpoint import (
+            CheckpointManager,
+            resume_min_step,
+        )
 
         ckpt = CheckpointManager(
             args.checkpoint_dir, max_to_keep=2,
             save_interval_steps=args.checkpoint_interval,
         )
-        state, start_step = ckpt.restore_or_init(state)
+        # min_step: never resume below the operator's acked step — the
+        # CheckpointManager follower caveat (reload-before-latest) applied
+        # at the resume call site.
+        state, start_step = ckpt.restore_or_init(
+            state, min_step=resume_min_step()
+        )
         # resumed (not the clamped start_step) gates the preemption sim:
         # with --steps 1 the clamp forces start_step back to 0, and a
         # start_step==0 guard would re-fire exit 138 forever.

@@ -214,6 +214,11 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.train.steps import TrainState, adamw
 
         ckpt = CheckpointManager(ckpt_dir)
+        # Follower caveat: this directory was written by the TRAINER;
+        # re-read the (orbax-cached) step list before trusting it — a
+        # manager constructed while the final save was still committing
+        # would otherwise serve a stale or empty step list.
+        ckpt.reload()
         step = ckpt.latest_step()
         if step is None:
             print(f"serve_lm: no checkpoint in {ckpt_dir}",

@@ -1,0 +1,555 @@
+"""Checkpoint-coordination unit + integration tests (ckpt/protocol.py,
+ckpt/registry.py, ckpt/gc.py, the controller roll-up, resume injection,
+and the local executor's ack relay / signal delivery with real processes).
+
+The eviction-barrier chaos cases (crash boundaries on both cluster
+backends) live in tests/test_ckpt_chaos.py.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.ckpt import protocol
+from tf_operator_tpu.ckpt.gc import CheckpointSweeper, SweepConfig
+from tf_operator_tpu.ckpt.registry import CheckpointRegistry, CkptConfig
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.runtime.metrics import (
+    CKPT_ACKS_TOTAL,
+    CKPT_GC_STEPS_TOTAL,
+    CKPT_RESUME_INJECTIONS_TOTAL,
+)
+from tf_operator_tpu.scheduler import GangScheduler, SchedulerConfig
+
+pytestmark = pytest.mark.ckpt
+
+
+# ---------------------------------------------------------------------------
+# protocol
+# ---------------------------------------------------------------------------
+
+
+def test_ack_file_roundtrip(tmp_path):
+    path = str(tmp_path / "ack.json")
+    assert protocol.read_ack(path) is None
+    protocol.write_ack(path, 42, "/ckpt/demo")
+    ack = protocol.read_ack(path)
+    assert ack is not None
+    assert ack.step == 42 and ack.directory == "/ckpt/demo"
+    assert ack.saved_at.endswith("Z")
+    # Overwrite advances; no partial files linger.
+    protocol.write_ack(path, 43)
+    assert protocol.read_ack(path).step == 43
+    assert [f for f in os.listdir(tmp_path) if "tmp" in f] == []
+
+
+def test_signal_gen_monotone_and_deadline_roundtrip():
+    from tf_operator_tpu.utils.times import parse_rfc3339
+
+    g1 = protocol.new_signal_gen(1000.0)
+    g2 = protocol.new_signal_gen(1000.5)
+    assert g2 > g1
+    # Sub-second deadlines round-trip through the annotation format.
+    epoch = 1_700_000_000.25
+    assert abs(parse_rfc3339(protocol.fmt_deadline(epoch)) - epoch) < 1e-3
+
+
+def test_all_pods_acked():
+    def pod(ack=None):
+        p = {"metadata": {"annotations": {}}}
+        if ack is not None:
+            p["metadata"]["annotations"][protocol.POD_ACK] = str(ack)
+        return p
+
+    assert not protocol.all_pods_acked([], 5)
+    assert not protocol.all_pods_acked([pod(5), pod()], 5)
+    assert not protocol.all_pods_acked([pod(4)], 5)
+    assert protocol.all_pods_acked([pod(5), pod(9)], 5)
+
+
+# ---------------------------------------------------------------------------
+# registry roll-up
+# ---------------------------------------------------------------------------
+
+
+def ckpt_job(name="train", replicas=2):
+    return {
+        "apiVersion": constants.API_VERSION,
+        "kind": constants.KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicaSpecs": {
+                "Worker": {
+                    "replicas": replicas,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": constants.DEFAULT_CONTAINER_NAME,
+                                    "image": "x",
+                                    "command": ["unused"],
+                                }
+                            ]
+                        }
+                    },
+                }
+            }
+        },
+    }
+
+
+def mk_controller(client, grace=0.0, stale_after=600.0):
+    sched = GangScheduler(config=SchedulerConfig(checkpoint_grace=grace))
+    registry = CheckpointRegistry(
+        sched, config=CkptConfig(stale_after=stale_after)
+    )
+    tc = TPUJobController(
+        client,
+        JobControllerConfig(reconcile_period=0.2),
+        recorder=FakeRecorder(),
+        scheduler=sched,
+    )
+    assert tc.ckpt is registry  # the flag-configured registry won
+    return sched, registry, tc
+
+
+def sync(tc, key):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(key)
+
+
+def stamp_pod(client, name, step, ack=None, directory="/ckpt/train"):
+    ann = {
+        protocol.POD_STEP: str(step),
+        protocol.POD_SAVED_AT: objects.now_iso(),
+        protocol.POD_DIR: directory,
+    }
+    if ack is not None:
+        ann[protocol.POD_ACK] = str(ack)
+    client.patch_merge(
+        objects.PODS, "default", name, {"metadata": {"annotations": ann}}
+    )
+
+
+def job_ann(client, name="train"):
+    return client.get(objects.TPUJOBS, "default", name)["metadata"].get(
+        "annotations", {}
+    )
+
+
+def test_rollup_is_min_over_reporters_and_monotone():
+    client = InMemoryCluster()
+    _, registry, tc = mk_controller(client)
+    client.create(objects.TPUJOBS, ckpt_job())
+    sync(tc, "default/train")  # creates the two worker pods
+    acks_before = CKPT_ACKS_TOTAL.value()
+
+    # Only worker 0 reports: the roll-up records its step.
+    stamp_pod(client, "train-worker-0", 10)
+    sync(tc, "default/train")
+    ann = job_ann(client)
+    assert ann[protocol.JOB_STEP] == "10"
+    assert ann[protocol.JOB_DIR] == "/ckpt/train"
+    assert ann[protocol.JOB_ACKED_AT]
+    assert CKPT_ACKS_TOTAL.value() == acks_before + 1
+
+    # Both report: min over reporters.
+    stamp_pod(client, "train-worker-0", 30)
+    stamp_pod(client, "train-worker-1", 20)
+    sync(tc, "default/train")
+    assert job_ann(client)[protocol.JOB_STEP] == "20"
+
+    # A lower report never regresses the record.
+    stamp_pod(client, "train-worker-1", 15)
+    sync(tc, "default/train")
+    assert job_ann(client)[protocol.JOB_STEP] == "20"
+
+    # Status mirrors the annotation record.
+    job = client.get(objects.TPUJOBS, "default", "train")
+    assert job["status"]["lastCheckpointStep"] == 20
+    rec = registry.record_of("default/train")
+    assert rec.latest_step == 20 and rec.directory == "/ckpt/train"
+
+
+def test_rollup_noop_for_non_checkpointing_jobs():
+    """A job whose pods never report must see zero checkpoint artifacts:
+    no annotations, no status field, no conditions."""
+    client = InMemoryCluster()
+    _, _, tc = mk_controller(client)
+    client.create(objects.TPUJOBS, ckpt_job("plain"))
+    for _ in range(3):
+        sync(tc, "default/plain")
+    ann = job_ann(client, "plain")
+    assert not any(k.startswith("ckpt.") for k in ann)
+    job = client.get(objects.TPUJOBS, "default", "plain")
+    assert "lastCheckpointStep" not in job["status"]
+    types = {c["type"] for c in job["status"].get("conditions", [])}
+    assert "CheckpointStale" not in types
+    assert "CheckpointSkipped" not in types
+
+
+def test_registry_recovers_record_from_annotations():
+    """A successor controller (fresh registry) rebuilds the record from
+    the persisted job annotations on its first sync — crash discipline."""
+    client = InMemoryCluster()
+    _, _, tc1 = mk_controller(client)
+    client.create(objects.TPUJOBS, ckpt_job())
+    sync(tc1, "default/train")
+    stamp_pod(client, "train-worker-0", 7)
+    stamp_pod(client, "train-worker-1", 7)
+    sync(tc1, "default/train")
+    assert job_ann(client)[protocol.JOB_STEP] == "7"
+
+    _, registry2, tc2 = mk_controller(client)
+    sync(tc2, "default/train")
+    rec = registry2.record_of("default/train")
+    assert rec is not None and rec.latest_step == 7
+    job = client.get(objects.TPUJOBS, "default", "train")
+    assert job["status"]["lastCheckpointStep"] == 7
+
+
+def test_resume_env_injected_into_replacement_pods():
+    client = InMemoryCluster()
+    _, _, tc = mk_controller(client)
+    client.create(objects.TPUJOBS, ckpt_job())
+    sync(tc, "default/train")
+    stamp_pod(client, "train-worker-0", 12)
+    stamp_pod(client, "train-worker-1", 12)
+    sync(tc, "default/train")
+
+    injections_before = CKPT_RESUME_INJECTIONS_TOTAL.value()
+    # Delete a pod; the recreated one carries the resume contract.
+    client.delete(objects.PODS, "default", "train-worker-0")
+    sync(tc, "default/train")
+    pod = client.get(objects.PODS, "default", "train-worker-0")
+    env = {
+        e["name"]: e.get("value")
+        for c in pod["spec"]["containers"]
+        if c["name"] == constants.DEFAULT_CONTAINER_NAME
+        for e in c.get("env", [])
+    }
+    assert env[protocol.ENV_RESUME_STEP] == "12"
+    assert env[protocol.ENV_CKPT_DIR] == "/ckpt/train"
+    assert CKPT_RESUME_INJECTIONS_TOTAL.value() > injections_before
+
+
+def test_stale_condition_flips_and_recovers():
+    # stale_after must exceed the 1s rounding of the acked-at stamp.
+    client = InMemoryCluster()
+    _, _, tc = mk_controller(client, stale_after=1.5)
+    client.create(objects.TPUJOBS, ckpt_job(replicas=1))
+    sync(tc, "default/train")
+    stamp_pod(client, "train-worker-0", 5)
+    # Run the pod so the job gets the Running condition staleness needs.
+    pod = client.get(objects.PODS, "default", "train-worker-0")
+    objects.set_pod_phase(pod, objects.RUNNING)
+    client.update_status(objects.PODS, pod)
+    sync(tc, "default/train")
+    job = client.get(objects.TPUJOBS, "default", "train")
+    conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+    assert conds.get("CheckpointStale") is None  # fresh ack, not stale
+
+    time.sleep(1.7)
+    sync(tc, "default/train")
+    job = client.get(objects.TPUJOBS, "default", "train")
+    conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+    assert conds["CheckpointStale"] == "True"
+
+    # A new durable save flips it back.
+    stamp_pod(client, "train-worker-0", 6)
+    sync(tc, "default/train")
+    job = client.get(objects.TPUJOBS, "default", "train")
+    conds = {c["type"]: c["status"] for c in job["status"]["conditions"]}
+    assert conds["CheckpointStale"] == "False"
+
+
+# ---------------------------------------------------------------------------
+# retention sweeper
+# ---------------------------------------------------------------------------
+
+
+def _mk_steps(root, steps):
+    for s in steps:
+        d = root / str(s)
+        d.mkdir(parents=True)
+        (d / "data").write_text("x")
+
+
+def _succeed(client, name):
+    job = client.get(objects.TPUJOBS, "default", name)
+    job.setdefault("status", {})["conditions"] = [
+        {"type": "Succeeded", "status": "True"}
+    ]
+    client.update_status(objects.TPUJOBS, job)
+
+
+def test_sweeper_prunes_succeeded_jobs_only(tmp_path):
+    client = InMemoryCluster()
+    done_dir = tmp_path / "done"
+    live_dir = tmp_path / "live"
+    _mk_steps(done_dir, [1, 3, 5, 7])
+    _mk_steps(live_dir, [2, 4])
+
+    for name, d in (("done", done_dir), ("live", live_dir)):
+        job = ckpt_job(name)
+        job["metadata"]["annotations"] = {protocol.JOB_DIR: str(d)}
+        client.create(objects.TPUJOBS, job)
+    _succeed(client, "done")
+
+    gc_before = CKPT_GC_STEPS_TOTAL.value()
+    sweeper = CheckpointSweeper(client, SweepConfig(keep=1))
+    removed = sweeper.sweep()
+    assert removed == 3
+    assert sorted(os.listdir(done_dir)) == ["7"]  # newest kept
+    assert sorted(os.listdir(live_dir)) == ["2", "4"]  # running: untouched
+    assert CKPT_GC_STEPS_TOTAL.value() == gc_before + 3
+    # Idempotent.
+    assert sweeper.sweep() == 0
+
+
+def test_sweeper_ttl_expires_even_the_newest(tmp_path):
+    client = InMemoryCluster()
+    d = tmp_path / "old"
+    _mk_steps(d, [9])
+    os.utime(d / "9", (time.time() - 100, time.time() - 100))
+    job = ckpt_job("old")
+    job["metadata"]["annotations"] = {protocol.JOB_DIR: str(d)}
+    client.create(objects.TPUJOBS, job)
+    _succeed(client, "old")
+
+    keeper = CheckpointSweeper(client, SweepConfig(keep=1, ttl=0.0))
+    assert keeper.sweep() == 0  # no TTL: newest survives
+    expirer = CheckpointSweeper(client, SweepConfig(keep=1, ttl=50.0))
+    assert expirer.sweep() == 1
+    assert os.listdir(d) == []
+    assert d.exists()  # the root itself is never removed
+
+
+def test_sweeper_ignores_non_step_entries(tmp_path):
+    client = InMemoryCluster()
+    d = tmp_path / "mixed"
+    _mk_steps(d, [1, 2])
+    (d / "not-a-step").mkdir()
+    (d / "3").write_text("a FILE named like a step")
+    job = ckpt_job("mixed")
+    job["metadata"]["annotations"] = {protocol.JOB_DIR: str(d)}
+    client.create(objects.TPUJOBS, job)
+    _succeed(client, "mixed")
+    CheckpointSweeper(client, SweepConfig(keep=1)).sweep()
+    assert sorted(os.listdir(d)) == ["2", "3", "not-a-step"]
+
+
+# ---------------------------------------------------------------------------
+# local executor: ack relay + signal delivery (real processes)
+# ---------------------------------------------------------------------------
+
+WORKLOAD = r"""
+import json, os, signal, sys, time
+
+ack_path = os.environ["TPU_CKPT_ACK_FILE"]
+step = 0
+signaled = {"v": False}
+
+def write(s):
+    tmp = ack_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"step": s, "dir": "/ckpt/proc",
+                   "savedAt": "2026-01-01T00:00:00Z"}, f)
+    os.replace(tmp, ack_path)
+
+signal.signal(signal.SIGTERM, lambda *_: signaled.__setitem__("v", True))
+write(step)
+deadline = time.time() + 30
+while time.time() < deadline:
+    time.sleep(0.05)
+    step += 1
+    if step % 4 == 0:
+        write(step)
+    if signaled["v"]:
+        write(step)  # the forced eviction save
+        signaled["v"] = False
+"""
+
+
+def test_executor_relays_acks_and_delivers_signal(tmp_path):
+    from tf_operator_tpu.runtime.executor import LocalProcessExecutor
+
+    script = tmp_path / "workload.py"
+    script.write_text(WORKLOAD)
+    client = InMemoryCluster()
+    executor = LocalProcessExecutor(client, "default")
+    stop = threading.Event()
+    executor.start(stop)
+    try:
+        pod = objects.new_pod(
+            "ckpt-proc-0",
+            containers=[
+                {
+                    "name": constants.DEFAULT_CONTAINER_NAME,
+                    "command": [sys.executable, str(script)],
+                }
+            ],
+        )
+        client.create(objects.PODS, pod)
+
+        def ann_of():
+            return client.get(objects.PODS, "default", "ckpt-proc-0")[
+                "metadata"
+            ].get("annotations", {})
+
+        # 1. Periodic acks surface as pod annotations (step + dir).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if protocol.POD_STEP in ann_of():
+                break
+            time.sleep(0.05)
+        ann = ann_of()
+        assert protocol.POD_STEP in ann, "ack relay never reported a step"
+        assert ann[protocol.POD_DIR] == "/ckpt/proc"
+        assert protocol.POD_ACK not in ann  # no signal yet → no ack
+
+        # 2. The eviction signal annotation is delivered as SIGTERM; the
+        #    workload's post-signal save becomes the barrier ack.
+        gen = protocol.new_signal_gen()
+        client.patch_merge(
+            objects.PODS, "default", "ckpt-proc-0",
+            {"metadata": {"annotations": {protocol.POD_SIGNAL: str(gen)}}},
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if ann_of().get(protocol.POD_ACK) == str(gen):
+                break
+            time.sleep(0.05)
+        assert ann_of().get(protocol.POD_ACK) == str(gen)
+        # The process is still alive: the signal requests a checkpoint,
+        # it does not kill the pod (the barrier decides when to evict).
+        assert objects.pod_phase(
+            client.get(objects.PODS, "default", "ckpt-proc-0")
+        ) == objects.RUNNING
+    finally:
+        stop.set()
+        time.sleep(0.3)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: ack writing + the follower reload fix
+# ---------------------------------------------------------------------------
+
+
+def test_manager_ack_and_follower_min_step(tmp_path):
+    """restore_or_init(min_step=...) must reload() a stale step cache: a
+    manager opened before another process wrote steps resumes from the
+    operator's acked step, not from its cached (empty/old) view. Also
+    pins ack()/maybe_ack() writing the ack file protocol."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.steps import TrainState, sgd_momentum
+
+    mesh = create_mesh({"dp": 8})
+    model = MnistCNN()
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    state = replicate(
+        mesh, TrainState.create(variables["params"], sgd_momentum(0.1))
+    )
+    path = str(tmp_path / "ckpt")
+    ack_path = str(tmp_path / "ack.json")
+
+    # The follower opens the (empty) directory FIRST and caches the view.
+    follower = CheckpointManager(path)
+    assert follower.latest_step() is None
+
+    # The writer (the evicted predecessor) saves step 5 and acks it.
+    with CheckpointManager(path, ack_path=ack_path) as writer:
+        writer.save(5, state)
+        acked = writer.ack()
+    assert acked == 5
+    ack = protocol.read_ack(ack_path)
+    assert ack.step == 5 and ack.directory == os.path.abspath(path)
+
+    # Without min_step the follower's cache can miss the write; with the
+    # operator's contract it reloads and resumes AFTER the acked step.
+    _, start = follower.restore_or_init(state, min_step=5)
+    assert start == 6
+    follower.close()
+
+
+def test_manager_maybe_ack_reports_committed_steps(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.mnist import MnistCNN
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import replicate
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.steps import TrainState, sgd_momentum
+
+    mesh = create_mesh({"dp": 8})
+    model = MnistCNN()
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    state = replicate(
+        mesh, TrainState.create(variables["params"], sgd_momentum(0.1))
+    )
+    ack_path = str(tmp_path / "ack.json")
+    with CheckpointManager(
+        str(tmp_path / "c"), ack_path=ack_path
+    ) as mgr:
+        assert mgr.maybe_ack() is None  # nothing committed yet
+        mgr.save(3, state)
+        mgr.wait()
+        assert mgr.maybe_ack() == 3
+        assert mgr.maybe_ack() is None  # deduped: unchanged step
+    assert protocol.read_ack(ack_path).step == 3
+
+
+def test_workload_env_helpers(monkeypatch):
+    from tf_operator_tpu.train import checkpoint as ckpt_lib
+
+    monkeypatch.delenv(protocol.ENV_RESUME_STEP, raising=False)
+    monkeypatch.delenv(protocol.ENV_CKPT_DIR, raising=False)
+    assert ckpt_lib.resume_min_step() is None
+    assert ckpt_lib.injected_dir() is None
+    monkeypatch.setenv(protocol.ENV_RESUME_STEP, "17")
+    monkeypatch.setenv(protocol.ENV_CKPT_DIR, "/ckpt/x")
+    assert ckpt_lib.resume_min_step() == 17
+    assert ckpt_lib.injected_dir() == "/ckpt/x"
+    monkeypatch.setenv(protocol.ENV_RESUME_STEP, "junk")
+    assert ckpt_lib.resume_min_step() is None
+
+
+# ---------------------------------------------------------------------------
+# /debug/ckpt snapshot shape
+# ---------------------------------------------------------------------------
+
+
+def test_registry_snapshot_shape():
+    client = InMemoryCluster()
+    _, registry, tc = mk_controller(client)
+    client.create(objects.TPUJOBS, ckpt_job())
+    sync(tc, "default/train")
+    stamp_pod(client, "train-worker-0", 4)
+    stamp_pod(client, "train-worker-1", 4)
+    sync(tc, "default/train")
+    snap = json.loads(json.dumps(registry.snapshot()))  # JSON-serializable
+    jobs = {j["key"]: j for j in snap["jobs"]}
+    rec = jobs["default/train"]
+    assert rec["latestStep"] == 4
+    assert rec["reportingPods"] == 2
+    assert snap["config"]["staleAfter"] == 600.0

@@ -1,0 +1,522 @@
+"""Graceful-eviction barrier chaos: the signal → save → ack → evict →
+resume loop under controller crashes, on both cluster backends (in-memory
+store directly, and the wire-level Kubernetes stub via KubeClusterClient).
+
+Invariants under test — the ISSUE 4 acceptance contract:
+
+- pods of a checkpoint-signaled gang are NEVER deleted before every pod
+  acks the signal generation or the grace deadline passes;
+- a preempted (PR-1 path) or migrated (PR-2 path) gang resumes from its
+  last acked checkpoint step — replacement pods carry TPU_RESUME_STEP —
+  not step 0;
+- an eviction past the deadline with no ack proceeds anyway and marks the
+  job CheckpointSkipped;
+- every persistence boundary is crash-safe: signal persisted / ack landed
+  / deletion pending — a successor controller recovers the SAME barrier
+  from the job annotations and finishes it exactly once;
+- the PR-1 partial-slice watch holds throughout.
+
+Workloads here ack via direct pod-annotation patches — the real-cluster
+leg of ckpt/protocol.py (the local-executor ack-file leg is covered with
+real processes in tests/test_ckpt.py).
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.ckpt import protocol
+from tf_operator_tpu.ckpt.registry import CheckpointRegistry, CkptConfig
+from tf_operator_tpu.controller.jobcontroller import JobControllerConfig
+from tf_operator_tpu.controller.tpujob_controller import TPUJobController
+from tf_operator_tpu.health import FleetHealthMonitor, HealthConfig
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.runtime.metrics import (
+    CKPT_SIGNALS_TOTAL,
+    CKPT_SKIPPED_TOTAL,
+)
+from tf_operator_tpu.scheduler import GangScheduler, SchedulerConfig
+from tf_operator_tpu.scheduler.gang import (
+    ANNOTATION_STATE,
+    STATE_ADMITTED,
+    STATE_QUEUED,
+    is_gated,
+)
+from tests.test_chaos import (
+    PartialSliceWatch,
+    gang_job,
+    hammer_running,
+    job_pods,
+    running_count,
+)
+
+pytestmark = [pytest.mark.ckpt, pytest.mark.scheduler]
+
+# One v4-8 block for the preemption tests; two for migration (a healthy
+# spare to re-place onto).
+CAPACITY_ONE = {"v4": (2, 2, 2)}
+CAPACITY_TWO = {"v4": (2, 2, 4)}
+
+
+@pytest.fixture(params=["memcluster", "kubestub"])
+def backend(request):
+    if request.param == "memcluster":
+        store = InMemoryCluster()
+        yield store, store, None
+        return
+    stub = KubeApiStub()
+    stub.start()
+    try:
+        yield KubeClusterClient(KubeConfig(server=stub.url)), stub.cluster, stub
+    finally:
+        stub.stop()
+
+
+def mk_incarnation(client, capacity, grace=30.0, with_health=False):
+    """One controller incarnation wired the way the operator wires it:
+    scheduler (+grace), checkpoint registry, optional health monitor,
+    then the controller (whose attach recovers persisted state)."""
+    sched = GangScheduler(
+        config=SchedulerConfig(capacity=capacity, checkpoint_grace=grace)
+    )
+    registry = CheckpointRegistry(sched, config=CkptConfig())
+    monitor = None
+    if with_health:
+        monitor = FleetHealthMonitor(
+            sched, config=HealthConfig(repair_after=3600.0)
+        )
+    tc = TPUJobController(
+        client,
+        JobControllerConfig(reconcile_period=0.2),
+        recorder=FakeRecorder(),
+        scheduler=sched,
+    )
+    return sched, registry, monitor, tc
+
+
+def sync(tc, key):
+    tc.job_informer.sync_now()
+    tc.pod_informer.sync_now()
+    tc.service_informer.sync_now()
+    return tc.sync_job(key)
+
+
+def stamp_reports(client, store, name, step):
+    """Workload progress reports: each pod announces its durable step."""
+    for pod in job_pods(store, name):
+        client.patch_merge(
+            objects.PODS, "default", objects.name_of(pod),
+            {"metadata": {"annotations": {
+                protocol.POD_STEP: str(step),
+                protocol.POD_SAVED_AT: objects.now_iso(),
+                protocol.POD_DIR: f"/ckpt/{name}",
+            }}},
+        )
+
+
+def ack_signal(client, store, name, step=None):
+    """Workload eviction acks: each pod echoes the signal generation it
+    was stamped with (the real-cluster protocol leg)."""
+    for pod in job_pods(store, name):
+        gen = protocol.pod_signal_gen(pod)
+        assert gen, f"{objects.name_of(pod)} carries no signal"
+        ann = {protocol.POD_ACK: str(gen)}
+        if step is not None:
+            ann[protocol.POD_STEP] = str(step)
+            ann[protocol.POD_SAVED_AT] = objects.now_iso()
+        client.patch_merge(
+            objects.PODS, "default", objects.name_of(pod),
+            {"metadata": {"annotations": ann}},
+        )
+
+
+def job_ann(store, name):
+    return store.get(objects.TPUJOBS, "default", name)["metadata"].get(
+        "annotations", {}
+    )
+
+
+def start_reporting_gang(client, store, tc, name, step):
+    """Admit + run a v4-8 gang and roll a checkpoint report up into the
+    job's durable record."""
+    client.create(objects.TPUJOBS, gang_job(name))
+    sync(tc, f"default/{name}")
+    sync(tc, f"default/{name}")
+    hammer_running(client, store, name, 0.1)
+    assert running_count(store, name) == 2
+    stamp_reports(client, store, name, step)
+    sync(tc, f"default/{name}")
+    assert job_ann(store, name)[protocol.JOB_STEP] == str(step)
+
+
+def resume_env_of(pod):
+    return {
+        e["name"]: e.get("value")
+        for c in pod["spec"]["containers"]
+        if c["name"] == constants.DEFAULT_CONTAINER_NAME
+        for e in c.get("env", [])
+    }
+
+
+def test_preemption_holds_pods_until_ack(backend):
+    """PR-1 path, live barrier: a critical gang's preemption signals the
+    victim and HOLDS its pods; repeated syncs delete nothing; the ack
+    releases the barrier, the victim evicts whole, and the preemptor
+    admits — no instant ever shows a partial slice."""
+    client, store, stub = backend
+    sched, registry, _, tc = mk_incarnation(client, CAPACITY_ONE, grace=30.0)
+    signals_before = CKPT_SIGNALS_TOTAL.value(reason="preemption")
+
+    watch = PartialSliceWatch(store, ["meek", "boss"])
+    watch.start()
+    try:
+        start_reporting_gang(client, store, tc, "meek", step=40)
+
+        client.create(objects.TPUJOBS, gang_job("boss", "critical"))
+        sync(tc, "default/boss")
+        # Signal persisted annotation-first: queued state + generation +
+        # deadline on the job, the generation on every pod — pods ALIVE.
+        ann = job_ann(store, "meek")
+        assert ann[ANNOTATION_STATE] == STATE_QUEUED
+        gen = int(ann[protocol.JOB_SIGNAL_GEN])
+        assert gen and ann[protocol.JOB_EVICT_DEADLINE]
+        pods = job_pods(store, "meek")
+        assert len(pods) == 2
+        assert all(protocol.pod_signal_gen(p) == gen for p in pods)
+        assert running_count(store, "meek") == 2
+        assert job_pods(store, "boss") == []  # preemptor waits
+        assert (
+            CKPT_SIGNALS_TOTAL.value(reason="preemption")
+            == signals_before + 1
+        )
+
+        # No ack yet: syncs of either job must not touch the pods.
+        for _ in range(3):
+            sync(tc, "default/meek")
+            sync(tc, "default/boss")
+        assert len(job_pods(store, "meek")) == 2
+        assert job_pods(store, "boss") == []
+
+        # The workload flushes and acks at step 41 → barrier releases.
+        ack_signal(client, store, "meek", step=41)
+        sync(tc, "default/meek")
+        assert job_pods(store, "meek") == []  # evicted whole
+        assert job_ann(store, "meek")[protocol.JOB_STEP] == "41"
+        assert protocol.JOB_SKIPPED_AT not in job_ann(store, "meek")
+
+        sync(tc, "default/boss")
+        boss_pods = job_pods(store, "boss")
+        assert len(boss_pods) == 2 and all(not is_gated(p) for p in boss_pods)
+        snap = sched.snapshot()
+        assert [g["key"] for g in snap["admitted"]] == ["default/boss"]
+        assert [g["key"] for g in snap["queued"]] == ["default/meek"]
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations
+
+
+def test_grace_expiry_evicts_and_marks_skipped(backend):
+    """A mute workload cannot hold preemption hostage: past the grace
+    deadline the eviction proceeds and the job is marked
+    CheckpointSkipped (annotation + condition)."""
+    client, store, stub = backend
+    sched, registry, _, tc = mk_incarnation(client, CAPACITY_ONE, grace=0.7)
+    skipped_before = CKPT_SKIPPED_TOTAL.value()
+
+    start_reporting_gang(client, store, tc, "mute", step=10)
+    client.create(objects.TPUJOBS, gang_job("boss", "critical"))
+    sync(tc, "default/boss")
+    assert len(job_pods(store, "mute")) == 2  # signaled, held
+
+    # Within the grace window nothing dies.
+    sync(tc, "default/mute")
+    assert len(job_pods(store, "mute")) == 2
+
+    time.sleep(0.9)
+    sync(tc, "default/mute")
+    assert job_pods(store, "mute") == []  # deadline passed: evicted
+    ann = job_ann(store, "mute")
+    assert protocol.JOB_SKIPPED_AT in ann
+    assert CKPT_SKIPPED_TOTAL.value() == skipped_before + 1
+
+    sync(tc, "default/mute")  # surface the condition on the job status
+    job = store.get(objects.TPUJOBS, "default", "mute")
+    conds = {
+        c["type"]: c["status"] for c in job["status"].get("conditions", [])
+    }
+    assert conds.get("CheckpointSkipped") == "True"
+
+    sync(tc, "default/boss")
+    assert len(job_pods(store, "boss")) == 2
+
+
+def test_migration_barrier_and_resume_injection(backend):
+    """PR-2 path end-to-end: drain → signal → ack → evict → re-place on
+    healthy cells, with the replacement pods carrying the acked step as
+    TPU_RESUME_STEP/TPU_CKPT_DIR — resume from step 12, not step 0."""
+    client, store, stub = backend
+    sched, registry, monitor, tc = mk_incarnation(
+        client, CAPACITY_TWO, grace=30.0, with_health=True
+    )
+    import json as json_mod
+
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_PLACEMENTS
+    from tf_operator_tpu.scheduler.placement import Placement
+
+    watch = PartialSliceWatch(store, ["prod"])
+    watch.start()
+    try:
+        start_reporting_gang(client, store, tc, "prod", step=12)
+        old_cells = []
+        for d in json_mod.loads(
+            job_ann(store, "prod")[ANNOTATION_PLACEMENTS]
+        ):
+            old_cells.extend(Placement.from_dict(d).cells())
+
+        migrated = monitor.drain("v4", old_cells)
+        assert migrated == ["default/prod"]
+        # Barrier holds: still admitted in memory, pods alive on the
+        # draining cells, queued + signaled on the wire.
+        assert len(job_pods(store, "prod")) == 2
+        assert job_ann(store, "prod")[ANNOTATION_STATE] == STATE_QUEUED
+        sync(tc, "default/prod")
+        assert len(job_pods(store, "prod")) == 2
+
+        ack_signal(client, store, "prod", step=13)
+        sync(tc, "default/prod")  # barrier releases: evicted + re-queued
+        for _ in range(4):
+            sync(tc, "default/prod")
+            hammer_running(client, store, "prod", 0.05)
+        pods = job_pods(store, "prod")
+        assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+        assert running_count(store, "prod") == 2
+
+        # Re-placed on healthy cells, resuming from the acked step.
+        ann = job_ann(store, "prod")
+        assert ann[ANNOTATION_STATE] == STATE_ADMITTED
+        new_cells = []
+        for d in json_mod.loads(ann[ANNOTATION_PLACEMENTS]):
+            new_cells.extend(Placement.from_dict(d).cells())
+        assert new_cells and not (set(new_cells) & set(old_cells))
+        for pod in pods:
+            env = resume_env_of(pod)
+            assert env[protocol.ENV_RESUME_STEP] == "13"
+            assert env[protocol.ENV_CKPT_DIR] == "/ckpt/prod"
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations
+
+
+def test_crash_between_signal_and_ack_recovers_barrier(backend):
+    """Crash boundary: the signal (queued + gen + deadline) persisted,
+    then the controller died. The successor must recover the SAME barrier
+    from annotations — holding the pods until the ack — and then finish
+    the eviction exactly once, re-placing with resume injection."""
+    client, store, stub = backend
+    sched1, _, monitor1, tc1 = mk_incarnation(
+        client, CAPACITY_TWO, grace=30.0, with_health=True
+    )
+    start_reporting_gang(client, store, tc1, "prod", step=21)
+    import json as json_mod
+
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_PLACEMENTS
+    from tf_operator_tpu.scheduler.placement import Placement
+
+    old_cells = []
+    for d in json_mod.loads(job_ann(store, "prod")[ANNOTATION_PLACEMENTS]):
+        old_cells.extend(Placement.from_dict(d).cells())
+    monitor1.drain("v4", old_cells)  # signals, holds — then "crash"
+    assert len(job_pods(store, "prod")) == 2
+    assert job_ann(store, "prod")[ANNOTATION_STATE] == STATE_QUEUED
+
+    # Successor incarnation: recovers the cordon AND the barrier.
+    sched2, _, monitor2, tc2 = mk_incarnation(
+        client, CAPACITY_TWO, grace=30.0, with_health=True
+    )
+    assert all(sched2.placer.is_cordoned("v4", c) for c in old_cells)
+    watch = PartialSliceWatch(store, ["prod"])
+    watch.start()
+    try:
+        for _ in range(3):
+            sync(tc2, "default/prod")
+        # Pods held: the recovered barrier is still waiting for the ack.
+        assert len(job_pods(store, "prod")) == 2
+        assert running_count(store, "prod") == 2
+
+        ack_signal(client, store, "prod", step=22)
+        sync(tc2, "default/prod")  # ack observed → eviction finishes
+        for _ in range(4):
+            sync(tc2, "default/prod")
+            hammer_running(client, store, "prod", 0.05)
+        pods = job_pods(store, "prod")
+        assert len(pods) == 2 and all(not is_gated(p) for p in pods)
+        new_cells = []
+        for d in json_mod.loads(
+            job_ann(store, "prod")[ANNOTATION_PLACEMENTS]
+        ):
+            new_cells.extend(Placement.from_dict(d).cells())
+        assert new_cells and not (set(new_cells) & set(old_cells))
+        for pod in pods:
+            assert resume_env_of(pod)[protocol.ENV_RESUME_STEP] == "22"
+    finally:
+        watch.stop_event.set()
+        watch.join(timeout=2)
+    assert not watch.violations, watch.violations
+
+
+def test_crash_between_ack_and_eviction(backend):
+    """Crash boundary: the ack landed on every pod, then the controller
+    died before the held deletion loop ran. The successor sees a
+    satisfied barrier and finishes the eviction immediately — no extra
+    grace wait, no double eviction, no CheckpointSkipped."""
+    client, store, stub = backend
+    sched1, _, monitor1, tc1 = mk_incarnation(
+        client, CAPACITY_TWO, grace=30.0, with_health=True
+    )
+    start_reporting_gang(client, store, tc1, "prod", step=33)
+    import json as json_mod
+
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_PLACEMENTS
+    from tf_operator_tpu.scheduler.placement import Placement
+
+    old_cells = []
+    for d in json_mod.loads(job_ann(store, "prod")[ANNOTATION_PLACEMENTS]):
+        old_cells.extend(Placement.from_dict(d).cells())
+    monitor1.drain("v4", old_cells)
+    ack_signal(client, store, "prod", step=34)  # acks land... then crash
+
+    sched2, _, monitor2, tc2 = mk_incarnation(
+        client, CAPACITY_TWO, grace=30.0, with_health=True
+    )
+    t0 = time.monotonic()
+    sync(tc2, "default/prod")  # satisfied barrier → delete immediately
+    assert job_pods(store, "prod") == []
+    assert time.monotonic() - t0 < 5.0  # no grace wait
+    assert protocol.JOB_SKIPPED_AT not in job_ann(store, "prod")
+
+    for _ in range(4):
+        sync(tc2, "default/prod")
+        hammer_running(client, store, "prod", 0.05)
+    pods = job_pods(store, "prod")
+    assert len(pods) == 2
+    assert job_ann(store, "prod")[ANNOTATION_STATE] == STATE_ADMITTED
+    for pod in pods:
+        assert resume_env_of(pod)[protocol.ENV_RESUME_STEP] == "34"
+
+
+def test_live_barrier_with_executor_end_to_end(tmp_path):
+    """The whole loop with REAL processes and the live controller: a
+    running gang of checkpoint-aware workloads is preempted; the executor
+    relays the signal as SIGTERM; the workloads force-ack; the barrier
+    releases on the ack (well inside the 20s grace), the victim evicts
+    whole, and the preemptor runs — with the victim's job record carrying
+    a post-signal step and NO skip marker."""
+    from tests.test_ckpt import WORKLOAD
+    from tf_operator_tpu.runtime.executor import LocalProcessExecutor
+    from tf_operator_tpu.runtime.metrics import CKPT_BARRIER_SECONDS
+
+    script = tmp_path / "workload.py"
+    script.write_text(WORKLOAD)
+
+    def live_job(name, priority_class=None):
+        job = gang_job(name, priority_class)
+        worker = job["spec"]["replicaSpecs"]["Worker"]
+        worker["template"]["spec"]["containers"][0]["command"] = [
+            sys.executable, str(script)
+        ]
+        return job
+
+    client = InMemoryCluster()
+    sched, registry, _, tc = mk_incarnation(client, CAPACITY_ONE, grace=20.0)
+    acked_before = sum(CKPT_BARRIER_SECONDS.snapshot(result="acked"))
+    stop = threading.Event()
+    threading.Thread(target=tc.run, args=(stop,), daemon=True).start()
+    executor = LocalProcessExecutor(client, "default")
+    executor.start(stop)
+    try:
+        client.create(objects.TPUJOBS, live_job("meek"))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if running_count(client, "meek") == 2 and protocol.JOB_STEP in (
+                job_ann(client, "meek")
+            ):
+                break
+            time.sleep(0.1)
+        assert running_count(client, "meek") == 2
+        assert protocol.JOB_STEP in job_ann(client, "meek")
+
+        client.create(objects.TPUJOBS, live_job("boss", "critical"))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (
+                job_pods(client, "meek") == []
+                and running_count(client, "boss") == 2
+            ):
+                break
+            time.sleep(0.1)
+        assert job_pods(client, "meek") == []
+        assert running_count(client, "boss") == 2
+
+        ann = job_ann(client, "meek")
+        assert ann[ANNOTATION_STATE] == STATE_QUEUED
+        assert protocol.JOB_SKIPPED_AT not in ann  # released by ACK
+        assert int(ann[protocol.JOB_STEP]) >= 0
+        # The completed barrier retired its record...
+        assert protocol.JOB_SIGNAL_GEN not in ann
+        # ...and the acked-barrier histogram proves it ran.
+        assert (
+            sum(CKPT_BARRIER_SECONDS.snapshot(result="acked"))
+            == acked_before + 1
+        )
+    finally:
+        stop.set()
+        time.sleep(0.5)
+
+
+def test_crash_after_expiry_recovery_skips_and_evicts(backend):
+    """Crash boundary + deadline expiry: the signal persisted with a
+    short grace, the controller died, and the grace expired while nobody
+    was running. The successor's first sync evicts, stamps the skip
+    marker, and recovery completes without an ack ever arriving."""
+    client, store, stub = backend
+    sched1, _, monitor1, tc1 = mk_incarnation(
+        client, CAPACITY_TWO, grace=0.5, with_health=True
+    )
+    start_reporting_gang(client, store, tc1, "prod", step=8)
+    import json as json_mod
+
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_PLACEMENTS
+    from tf_operator_tpu.scheduler.placement import Placement
+
+    old_cells = []
+    for d in json_mod.loads(job_ann(store, "prod")[ANNOTATION_PLACEMENTS]):
+        old_cells.extend(Placement.from_dict(d).cells())
+    monitor1.drain("v4", old_cells)
+    assert len(job_pods(store, "prod")) == 2  # held at crash time
+
+    time.sleep(0.7)  # the grace expires while the controller is "down"
+    sched2, _, monitor2, tc2 = mk_incarnation(
+        client, CAPACITY_TWO, grace=0.5, with_health=True
+    )
+    sync(tc2, "default/prod")
+    assert job_pods(store, "prod") == []
+    assert protocol.JOB_SKIPPED_AT in job_ann(store, "prod")
+
+    for _ in range(4):
+        sync(tc2, "default/prod")
+        hammer_running(client, store, "prod", 0.05)
+    pods = job_pods(store, "prod")
+    assert len(pods) == 2  # re-placed exactly once, on healthy cells
+    # Resume still injects the last recorded step — skipping the ack
+    # costs at most one checkpoint interval, never the whole run.
+    for pod in pods:
+        assert resume_env_of(pod)[protocol.ENV_RESUME_STEP] == "8"

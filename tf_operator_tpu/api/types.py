@@ -70,9 +70,16 @@ class JobConditionType:
     FAILED = "Failed"
     SLICE_DEGRADED = "SliceDegraded"
     JOB_MIGRATING = "JobMigrating"
+    # Checkpoint coordination (ckpt/registry.py; auxiliary like the two
+    # above): CheckpointStale — a Running job's checkpoint roll-up has gone
+    # quiet past the staleness threshold; CheckpointSkipped — the last
+    # eviction proceeded past the grace deadline without a checkpoint ack.
+    CHECKPOINT_STALE = "CheckpointStale"
+    CHECKPOINT_SKIPPED = "CheckpointSkipped"
 
     ALL = (CREATED, RUNNING, RESTARTING, SUCCEEDED, FAILED,
-           SLICE_DEGRADED, JOB_MIGRATING)
+           SLICE_DEGRADED, JOB_MIGRATING, CHECKPOINT_STALE,
+           CHECKPOINT_SKIPPED)
 
 
 # ---------------------------------------------------------------------------
@@ -287,6 +294,9 @@ class TPUJobStatus:
     completion_time: str | None = None
     last_reconcile_time: str | None = None
     restart_count: int = 0
+    # Latest checkpoint step acked by the job's workers (ckpt/registry.py
+    # roll-up); None until the first durable save is reported.
+    last_checkpoint_step: int | None = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -301,6 +311,8 @@ class TPUJobStatus:
             d["lastReconcileTime"] = self.last_reconcile_time
         if self.restart_count:
             d["restartCount"] = self.restart_count
+        if self.last_checkpoint_step is not None:
+            d["lastCheckpointStep"] = self.last_checkpoint_step
         return d
 
     @classmethod
@@ -315,6 +327,11 @@ class TPUJobStatus:
             completion_time=d.get("completionTime"),
             last_reconcile_time=d.get("lastReconcileTime"),
             restart_count=int(d.get("restartCount", 0)),
+            last_checkpoint_step=(
+                int(d["lastCheckpointStep"])
+                if d.get("lastCheckpointStep") is not None
+                else None
+            ),
         )
 
 

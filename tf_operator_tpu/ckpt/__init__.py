@@ -1,0 +1,39 @@
+"""Checkpoint coordination: operator-owned checkpoint registry, ack'd
+graceful eviction, resume injection, and checkpoint GC.
+
+- ``protocol``: annotations, env vars, ack file — the wire contract.
+- ``registry``: per-job roll-up + the eviction-barrier ack source.
+- ``gc``: retention sweeper for finished jobs' checkpoint directories.
+- ``httpapi``: the /debug/ckpt endpoint.
+
+Re-exports resolve lazily (PEP 562): workload-side importers reach
+``ckpt.protocol`` through this package too, and must not drag the
+operator-side registry/GC modules (runtime client, metrics, api types)
+into every training process just by importing the package.
+
+See docs/checkpoint.md for the state machine, the ack protocol, grace
+semantics, and the GC policy; tools/ckpt_smoke.py runs the marked test
+subset.
+"""
+
+_EXPORTS = {
+    "BarrierStatus": "registry",
+    "CheckpointRecord": "registry",
+    "CheckpointRegistry": "registry",
+    "CkptConfig": "registry",
+    "CheckpointSweeper": "gc",
+    "SweepConfig": "gc",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"{__name__}.{module}"), name
+    )

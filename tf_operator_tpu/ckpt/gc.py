@@ -1,0 +1,126 @@
+"""Checkpoint retention sweeper: GC for checkpoint step directories of
+finished jobs.
+
+Orbax already enforces ``max_to_keep`` *while a job runs*; what nobody
+owns is the tail — a Succeeded job leaves its last N step directories on
+disk forever. The sweeper closes that gap operator-side: it walks
+Succeeded TPUJobs whose checkpoint directory is recorded on the job
+(``ckpt.tpuflow.org/dir``, rolled up by ckpt/registry.py), and prunes
+step directories beyond the retention policy:
+
+- keep the newest ``keep`` steps (a Succeeded job usually wants exactly
+  one restorable checkpoint for eval/serving),
+- additionally drop any step older than ``ttl`` seconds (0 = no TTL) —
+  with a TTL even the newest step expires once the job is old news.
+
+Only directories that LOOK like orbax steps (all-digit basenames directly
+under the recorded directory) are ever touched, and the checkpoint root
+itself is never removed. The sweeper runs where the checkpoint storage is
+reachable — the local-executor runtime by construction; on a real cluster
+it would run wherever the shared filesystem is mounted.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from dataclasses import dataclass
+
+from tf_operator_tpu.ckpt import protocol
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.runtime.metrics import CKPT_GC_STEPS_TOTAL
+from tf_operator_tpu.utils import logger
+
+
+@dataclass
+class SweepConfig:
+    keep: int = 1  # newest steps retained per Succeeded job
+    ttl: float = 0.0  # seconds after which even retained steps expire (0 = never)
+    interval: float = 60.0  # seconds between sweeps
+
+
+class CheckpointSweeper:
+    def __init__(
+        self,
+        client: ClusterClient,
+        config: SweepConfig | None = None,
+        namespace: str | None = None,
+    ) -> None:
+        self._client = client
+        self.config = config or SweepConfig()
+        self._namespace = namespace
+        self._log = logger.with_fields(component="ckpt-gc")
+
+    def start(self, stop: threading.Event) -> None:
+        def loop() -> None:
+            while not stop.wait(self.config.interval):
+                try:
+                    self.sweep()
+                except Exception:
+                    self._log.exception("checkpoint sweep failed")
+
+        threading.Thread(target=loop, name="ckpt-gc", daemon=True).start()
+
+    def sweep(self, now: float | None = None) -> int:
+        """One pass: prune step dirs of every Succeeded job. Returns how
+        many step directories were removed."""
+        now = now if now is not None else time.time()
+        try:
+            jobs = self._client.list(objects.TPUJOBS, self._namespace)
+        except ApiError:
+            return 0
+        removed = 0
+        for job in jobs:
+            if not _succeeded(job):
+                continue
+            directory = (
+                objects.meta(job).get("annotations") or {}
+            ).get(protocol.JOB_DIR, "")
+            if directory:
+                removed += self.sweep_dir(directory, now)
+        return removed
+
+    def sweep_dir(self, directory: str, now: float | None = None) -> int:
+        """Prune one checkpoint directory per the retention policy."""
+        now = now if now is not None else time.time()
+        try:
+            entries = os.listdir(directory)
+        except OSError:
+            return 0
+        steps = sorted(
+            (int(e), os.path.join(directory, e))
+            for e in entries
+            if e.isdigit() and os.path.isdir(os.path.join(directory, e))
+        )
+        doomed = steps[: max(0, len(steps) - max(0, self.config.keep))]
+        if self.config.ttl > 0:
+            for step, path in steps[len(doomed):]:
+                try:
+                    age = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+                if age > self.config.ttl:
+                    doomed.append((step, path))
+        removed = 0
+        for step, path in doomed:
+            try:
+                shutil.rmtree(path)
+                removed += 1
+            except OSError:
+                self._log.warning("could not remove checkpoint step %s", path)
+        if removed:
+            CKPT_GC_STEPS_TOTAL.inc(removed)
+            self._log.info(
+                "pruned %d checkpoint step(s) under %s", removed, directory
+            )
+        return removed
+
+
+def _succeeded(job: dict) -> bool:
+    for cond in (job.get("status") or {}).get("conditions", []):
+        if cond.get("type") == "Succeeded" and cond.get("status") == "True":
+            return True
+    return False

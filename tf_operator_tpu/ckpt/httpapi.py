@@ -1,0 +1,42 @@
+"""/debug/ckpt HTTP surface: the checkpoint registry snapshot `tpuctl
+ckpt` renders.
+
+Mounts on the operator's ApiServer via its extra-handler hook, exactly
+like /debug/scheduler and /debug/health. Read-only: the checkpoint record
+is written by workers (acks) and the controller (roll-up), never by hand.
+
+    GET /debug/ckpt → CheckpointRegistry.snapshot()
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="ckpt-api")
+
+
+class CkptApiHandler:
+    def __init__(self, registry: Any) -> None:
+        self._registry = registry
+
+    def __call__(self, req: Any) -> bool:
+        path = req.path.split("?", 1)[0]
+        if req.command != "GET" or path != "/debug/ckpt":
+            return False
+        body = json.dumps(self._registry.snapshot(), indent=2).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+        return True
+
+
+def mount_ckpt(api_server: Any, registry: Any) -> CkptApiHandler:
+    handler = CkptApiHandler(registry)
+    api_server.add_handler(handler)
+    LOG.info("checkpoint API mounted at /debug/ckpt")
+    return handler

@@ -1,0 +1,175 @@
+"""The checkpoint-coordination wire protocol: annotations, env vars, and
+the ack file.
+
+Three parties speak it:
+
+- **Workloads** save checkpoints and *ack* them. Under the local executor
+  the ack is a small JSON file (``$TPU_CKPT_ACK_FILE``, written by
+  ``train/checkpoint.py`` after a durable save) that the executor lifts
+  into pod annotations; on a real cluster a workload (or sidecar) patches
+  its own pod's annotations directly. Either way the operator sees the
+  same thing: per-pod ``ckpt.tpuflow.org/step`` / ``saved-at`` / ``ack``.
+- **The scheduler** signals: before an eviction (preemption or health
+  migration) it stamps ``ckpt.tpuflow.org/signal`` = <generation> on every
+  gang pod and persists the generation + grace deadline on the job, then
+  holds the deletion loop until every pod acks the generation or the
+  deadline passes (scheduler/core.py).
+- **The controller** rolls per-pod reports up into job-level state
+  (``ckpt/registry.py``): the job annotations below are the durable resume
+  record a restarted controller recovers from, and the source of the
+  ``TPU_RESUME_STEP`` / ``TPU_CKPT_DIR`` env injected into replacement
+  pods.
+
+Signal generations are millisecond-epoch integers: monotone across
+controller incarnations without any persisted counter, so a recovered
+barrier compares acks against the persisted generation and a *stale* ack
+(from an earlier eviction) can never satisfy a newer signal.
+
+This module is dependency-light on purpose — the executor, the scheduler,
+the registry and the training stack all import it, and none of them may
+drag in the others (or jax).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any
+
+# -- pod annotations (worker → operator reports, scheduler → worker signal)
+
+# Latest durably-saved checkpoint step this pod reports.
+POD_STEP = "ckpt.tpuflow.org/step"
+# RFC3339 stamp of that save.
+POD_SAVED_AT = "ckpt.tpuflow.org/saved-at"
+# Checkpoint directory the pod writes to.
+POD_DIR = "ckpt.tpuflow.org/dir"
+# Eviction checkpoint signal: the generation the scheduler stamped.
+POD_SIGNAL = "ckpt.tpuflow.org/signal"
+# The generation this pod has acked (a durable save completed at-or-after
+# the signal); the eviction barrier waits for ack >= signal on every pod.
+POD_ACK = "ckpt.tpuflow.org/ack"
+
+# -- job annotations (the operator's durable checkpoint record)
+
+# Latest job-level acked step: the min over reporting pods, monotone.
+JOB_STEP = "ckpt.tpuflow.org/latest-step"
+# RFC3339 stamp of the last roll-up advance.
+JOB_ACKED_AT = "ckpt.tpuflow.org/acked-at"
+# Checkpoint directory (first reported; also user-presettable).
+JOB_DIR = "ckpt.tpuflow.org/dir"
+# Generation of the most recent eviction checkpoint signal.
+JOB_SIGNAL_GEN = "ckpt.tpuflow.org/signal-gen"
+# RFC3339 grace deadline of the in-flight eviction barrier. Retired
+# (null-deleted, along with signal-gen) when the eviction completes;
+# should the retirement patch fail, the stale pair is harmless — it is
+# only ever consulted together with state=queued AND live pods, a
+# combination the completed deletion loop removed.
+JOB_EVICT_DEADLINE = "ckpt.tpuflow.org/evict-deadline"
+# RFC3339 stamp of the last eviction that proceeded WITHOUT an ack (grace
+# expired); keys the CheckpointSkipped condition until a newer ack lands.
+JOB_SKIPPED_AT = "ckpt.tpuflow.org/skipped-at"
+
+# -- env vars injected into pods
+
+# Where the workload writes its ack file (local executor contract).
+ENV_ACK_FILE = "TPU_CKPT_ACK_FILE"
+# Resume contract injected into replacement pods from the job record.
+ENV_RESUME_STEP = "TPU_RESUME_STEP"
+ENV_CKPT_DIR = "TPU_CKPT_DIR"
+
+
+def new_signal_gen(now: float | None = None) -> int:
+    """Millisecond-epoch signal generation — monotone across restarts."""
+    return int((now if now is not None else time.time()) * 1000)
+
+
+def fmt_deadline(epoch: float) -> str:
+    """RFC3339 with fractional seconds (grace deadlines can be sub-second
+    in tests; utils.times.parse_rfc3339 reads this back exactly)."""
+    import datetime
+
+    dt = datetime.datetime.fromtimestamp(epoch, tz=datetime.timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%f") + "Z"
+
+
+def pod_step(pod: dict[str, Any]) -> int | None:
+    return _int_ann(pod, POD_STEP)
+
+
+def pod_ack_gen(pod: dict[str, Any]) -> int:
+    """The generation this pod has acked (0 = never acked)."""
+    return _int_ann(pod, POD_ACK) or 0
+
+
+def pod_signal_gen(pod: dict[str, Any]) -> int:
+    return _int_ann(pod, POD_SIGNAL) or 0
+
+
+def _int_ann(obj: dict[str, Any], key: str) -> int | None:
+    from tf_operator_tpu.runtime import objects
+
+    raw = objects.annotations_of(obj).get(key)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def all_pods_acked(pods: list[dict[str, Any]], gen: int) -> bool:
+    """The barrier predicate: every pod still standing has acked the
+    signal generation (pods deleted mid-eviction no longer block; pods
+    that never report can only be released by the grace deadline)."""
+    return bool(pods) and all(pod_ack_gen(p) >= gen for p in pods)
+
+
+# -- the ack file (workload ↔ local executor) -------------------------------
+
+
+@dataclass
+class Ack:
+    """One durable-save report, as written to the ack file."""
+
+    step: int
+    directory: str = ""
+    saved_at: str = ""
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"step": self.step, "dir": self.directory,
+                "savedAt": self.saved_at}
+
+
+def write_ack(path: str, step: int, directory: str = "") -> None:
+    """Atomically write the ack file: the executor may read it mid-write,
+    so the JSON lands via rename, never a partial file."""
+    ack = Ack(step=int(step), directory=directory,
+              saved_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(ack.to_dict(), f)
+    os.replace(tmp, path)
+
+
+def read_ack(path: str) -> Ack | None:
+    """Parse an ack file; None when absent or (transiently) malformed."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return Ack(step=int(d["step"]), directory=str(d.get("dir", "")),
+                   saved_at=str(d.get("savedAt", "")))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def ack_path_for(namespace: str, pod_name: str, uid: str) -> str:
+    """Per-pod-incarnation ack file, next to the pod log spool."""
+    from tf_operator_tpu.runtime import podlogs
+
+    safe_uid = (uid or "nouid")[:8]
+    return os.path.join(
+        podlogs.log_dir(), f"{namespace}_{pod_name}_{safe_uid}.ckpt-ack.json"
+    )

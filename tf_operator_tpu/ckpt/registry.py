@@ -1,0 +1,388 @@
+"""CheckpointRegistry: the operator-side source of truth for per-job
+checkpoint state.
+
+The reference delegates checkpointing entirely to user code — the
+operator's only contribution is stable pod identity so resume *can* work
+(tf_job_design_doc.md, SURVEY §5). This registry closes the loop: workers
+report durable saves through pod annotations (lifted from the ack file by
+the local executor, or patched directly on a real cluster —
+ckpt/protocol.py), and every controller sync rolls them up into one
+job-level record:
+
+- ``ckpt.tpuflow.org/latest-step`` / ``acked-at`` / ``dir`` annotations on
+  the TPUJob — persisted annotation-first with the same crash discipline
+  as the gang scheduler's admissions, so a controller restart recovers the
+  exact resume state from the store with no side channel;
+- ``status.lastCheckpointStep`` + the CheckpointStale / CheckpointSkipped
+  conditions (stamped by the controller from the same annotations);
+- the ``TPU_RESUME_STEP`` / ``TPU_CKPT_DIR`` env injected into replacement
+  pods (resume_env), which is how a preempted/migrated gang resumes from
+  its last acked step instead of step 0.
+
+The roll-up is the MIN over reporting pods — conservative: a step is
+recorded only once every pod that reports at all has it durable. Pods that
+never report cannot hold the record back (they also can never ack an
+eviction signal; the grace deadline covers them). The record is monotone:
+checkpoint steps on disk only grow.
+
+The registry also serves the eviction barrier (scheduler/core.py): it
+caches each pod's acked generation from the latest sync observation, and
+``barrier_acked`` answers "has every gang pod acked signal generation G?"
+under the scheduler's lock. Lock ordering: scheduler lock → registry lock,
+always; the registry never calls into the scheduler.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.ckpt import protocol
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import ApiError, ClusterClient
+from tf_operator_tpu.runtime.metrics import (
+    CKPT_ACKS_TOTAL,
+    CKPT_JOBS_REPORTING,
+    CKPT_SKIPPED_TOTAL,
+    CKPT_STALE_JOBS,
+)
+from tf_operator_tpu.utils import logger
+from tf_operator_tpu.utils.times import parse_rfc3339
+
+EVENT_CKPT_SKIPPED = "CheckpointSkipped"
+
+
+@dataclass
+class CkptConfig:
+    # A Running job whose checkpoint roll-up has not advanced for this many
+    # seconds gets the CheckpointStale condition (0 disables).
+    stale_after: float = 600.0
+
+
+@dataclass
+class CheckpointRecord:
+    """One job's checkpoint state, mirrored from its annotations plus the
+    per-pod ack cache from the latest sync observation."""
+
+    key: str
+    directory: str = ""
+    latest_step: int | None = None
+    acked_at: str = ""  # RFC3339 of the last roll-up advance
+    signal_gen: int = 0
+    skipped_at: str = ""
+    stale: bool = False
+    # pod uid -> acked generation (0 = never), refreshed every observe.
+    pod_acks: dict[str, int] = field(default_factory=dict)
+    # pod uid -> latest reported step.
+    pod_steps: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "key": self.key,
+            "directory": self.directory,
+            "latestStep": self.latest_step,
+            "ackedAt": self.acked_at,
+            "reportingPods": len(self.pod_steps),
+            "stale": self.stale,
+        }
+        if self.signal_gen:
+            d["signalGen"] = self.signal_gen
+        if self.skipped_at:
+            d["skippedAt"] = self.skipped_at
+        return d
+
+
+@dataclass
+class BarrierStatus:
+    """The eviction barrier, read from a job's persisted annotations + its
+    live pods: exactly one of acked / expired / waiting holds."""
+
+    gen: int
+    acked: bool = False
+    expired: bool = False
+    waiting: bool = False
+    remaining: float = 0.0
+
+
+class CheckpointRegistry:
+    def __init__(
+        self,
+        scheduler: Any,
+        client: ClusterClient | None = None,
+        config: CkptConfig | None = None,
+        recorder: Any | None = None,
+    ) -> None:
+        self.scheduler = scheduler
+        scheduler.ckpt = self
+        self.client = client if client is not None else scheduler.client
+        self.config = config or CkptConfig()
+        self.recorder = recorder
+        self._lock = threading.RLock()
+        self._records: dict[str, CheckpointRecord] = {}
+        # (job key, signal gen) pairs already marked skipped: the scheduler
+        # barrier and the controller recovery path can both observe one
+        # expired barrier in a single sync; the marker lands once.
+        self._skipped: set[tuple[str, int]] = set()
+        # Incrementally-maintained gauge inputs (see observe/forget).
+        self._reporting = 0
+        self._stale = 0
+        self.log = logger.with_fields(component="ckpt-registry")
+
+    def attach(self, client: ClusterClient, recorder: Any | None = None) -> None:
+        """Late binding, mirroring GangScheduler.attach."""
+        if self.client is None:
+            self.client = client
+        if self.recorder is None:
+            self.recorder = recorder
+
+    # -- sync-time observation (controller-driven) ----------------------------
+
+    def observe(self, job: TPUJob, pods: list[dict[str, Any]]) -> None:
+        """Roll per-pod checkpoint reports up into the job record.
+
+        Persist-first: an advanced roll-up lands on the job's annotations
+        BEFORE the in-memory record or status reflect it — a crash at any
+        point leaves the store carrying exactly what recovery will read
+        back. A failed persist changes nothing; the next sync retries.
+        """
+        ann = job.metadata.annotations or {}
+        acks: dict[str, int] = {}
+        steps: dict[str, int] = {}
+        reported_dir = ""
+        for pod in pods:
+            uid = objects.uid_of(pod)
+            acks[uid] = protocol.pod_ack_gen(pod)
+            step = protocol.pod_step(pod)
+            if step is not None:
+                steps[uid] = step
+                if not reported_dir:
+                    reported_dir = objects.annotations_of(pod).get(
+                        protocol.POD_DIR, ""
+                    )
+
+        cur = _parse_int(ann.get(protocol.JOB_STEP))
+        cur_dir = ann.get(protocol.JOB_DIR, "")
+        rolled = min(steps.values()) if steps else None
+        patch: dict[str, str] = {}
+        if rolled is not None and (cur is None or rolled > cur):
+            patch[protocol.JOB_STEP] = str(rolled)
+            patch[protocol.JOB_ACKED_AT] = objects.now_iso()
+        if reported_dir and reported_dir != cur_dir and not cur_dir:
+            patch[protocol.JOB_DIR] = reported_dir
+        if patch and self._persist(job, patch) and protocol.JOB_STEP in patch:
+            CKPT_ACKS_TOTAL.inc()
+
+        ann = job.metadata.annotations or {}  # refreshed by _persist
+        with self._lock:
+            rec = self._records.setdefault(
+                job.key, CheckpointRecord(key=job.key)
+            )
+            was_reporting, was_stale = rec.latest_step is not None, rec.stale
+            rec.pod_acks = acks
+            rec.pod_steps = steps
+            rec.latest_step = _parse_int(ann.get(protocol.JOB_STEP))
+            rec.directory = ann.get(protocol.JOB_DIR, "")
+            rec.acked_at = ann.get(protocol.JOB_ACKED_AT, "")
+            rec.signal_gen = _parse_int(ann.get(protocol.JOB_SIGNAL_GEN)) or 0
+            rec.skipped_at = ann.get(protocol.JOB_SKIPPED_AT, "")
+            rec.stale = self._is_stale(rec, job)
+            # Incremental gauge maintenance: a sync must stay O(this job),
+            # not O(all records) — the control-plane hot path PR 3 paid
+            # for must not regress to an O(jobs²) resync wave here.
+            self._reporting += (rec.latest_step is not None) - was_reporting
+            self._stale += rec.stale - was_stale
+        job.status.last_checkpoint_step = rec.latest_step
+        self._export_gauges()
+
+    def _is_stale(self, rec: CheckpointRecord, job: TPUJob) -> bool:
+        if self.config.stale_after <= 0 or not rec.acked_at:
+            return False
+        last = parse_rfc3339(rec.acked_at)
+        if last is None:
+            return False
+        running = any(
+            c.type == "Running" and c.status == "True"
+            for c in job.status.conditions
+        )
+        return running and time.time() - last > self.config.stale_after
+
+    # -- eviction barrier (scheduler + controller recovery) -------------------
+
+    def barrier_acked(self, key: str, gen: int, expected_pods: int) -> bool:
+        """True when every expected pod (per the latest sync observation)
+        has acked signal generation ``gen``. Called under the scheduler's
+        lock; reads only registry state."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is None:
+                return False
+            acks = rec.pod_acks
+        return len(acks) >= expected_pods and bool(acks) and all(
+            a >= gen for a in acks.values()
+        )
+
+    def barrier_status(
+        self, job: TPUJob, pods: list[dict[str, Any]],
+        now: float | None = None,
+    ) -> BarrierStatus | None:
+        """The persisted barrier for a queued-with-pods job, or None when
+        no barrier was ever signaled (plain interrupted eviction — the
+        caller deletes immediately, the pre-barrier behavior). Computed
+        purely from annotations + live pods, so a successor controller
+        recovers the exact barrier its predecessor left."""
+        ann = job.metadata.annotations or {}
+        gen = _parse_int(ann.get(protocol.JOB_SIGNAL_GEN)) or 0
+        deadline = parse_rfc3339(ann.get(protocol.JOB_EVICT_DEADLINE) or "")
+        if not gen or deadline is None:
+            return None
+        if protocol.all_pods_acked(pods, gen):
+            return BarrierStatus(gen=gen, acked=True)
+        now = now if now is not None else time.time()
+        if now >= deadline:
+            return BarrierStatus(gen=gen, expired=True)
+        return BarrierStatus(gen=gen, waiting=True, remaining=deadline - now)
+
+    def note_skipped(
+        self,
+        namespace: str,
+        name: str,
+        gen: int,
+        typed: TPUJob | None = None,
+    ) -> None:
+        """Record that an eviction proceeded past the grace deadline with
+        no ack — once per (job, signal generation). Best-effort: the skip
+        marker is observability and must never block the eviction."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            if (key, gen) in self._skipped:
+                return
+            if len(self._skipped) >= 4096:
+                self._skipped.clear()
+            self._skipped.add((key, gen))
+        CKPT_SKIPPED_TOTAL.inc()
+        stamp = {protocol.JOB_SKIPPED_AT: objects.now_iso()}
+        if typed is not None:
+            self._persist(typed, stamp)
+            return
+        if self.client is None:
+            return
+        try:
+            self.client.patch_merge(
+                objects.TPUJOBS, namespace, name,
+                {"metadata": {"annotations": stamp}},
+            )
+        except ApiError:
+            self.log.warning(
+                "checkpoint-skipped marker persist failed for %s/%s",
+                namespace, name,
+            )
+
+    def clear_barrier(self, job: TPUJob) -> None:
+        """Retire a completed barrier's annotations (merge-patch null).
+        Best-effort: stale keys are only ever consulted together with
+        state=queued AND live pods, which the completed eviction removed."""
+        self._persist(job, {
+            protocol.JOB_SIGNAL_GEN: None,
+            protocol.JOB_EVICT_DEADLINE: None,
+        })
+
+    # -- resume injection -----------------------------------------------------
+
+    def resume_env(self, job: TPUJob) -> dict[str, str]:
+        """The env contract injected into (replacement) pods: the last
+        acked step and directory from the job's durable record."""
+        ann = job.metadata.annotations or {}
+        env: dict[str, str] = {}
+        step = _parse_int(ann.get(protocol.JOB_STEP))
+        if step is not None:
+            env[protocol.ENV_RESUME_STEP] = str(step)
+        directory = ann.get(protocol.JOB_DIR, "")
+        if directory:
+            env[protocol.ENV_CKPT_DIR] = directory
+        return env
+
+    # -- lifecycle / introspection -------------------------------------------
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            rec = self._records.pop(key, None)
+            if rec is not None:
+                self._reporting -= rec.latest_step is not None
+                self._stale -= rec.stale
+        self._export_gauges()
+
+    def record_of(self, key: str) -> CheckpointRecord | None:
+        with self._lock:
+            rec = self._records.get(key)
+            return None if rec is None else CheckpointRecord(
+                key=rec.key, directory=rec.directory,
+                latest_step=rec.latest_step, acked_at=rec.acked_at,
+                signal_gen=rec.signal_gen, skipped_at=rec.skipped_at,
+                stale=rec.stale, pod_acks=dict(rec.pod_acks),
+                pod_steps=dict(rec.pod_steps),
+            )
+
+    def snapshot(self) -> dict[str, Any]:
+        """JSON-friendly view for /debug/ckpt and `tpuctl ckpt`."""
+        with self._lock:
+            records = [
+                rec.to_dict()
+                for rec in sorted(
+                    self._records.values(), key=lambda r: r.key
+                )
+            ]
+        return {
+            "jobs": records,
+            "config": {"staleAfter": self.config.stale_after},
+        }
+
+    # -- internals ------------------------------------------------------------
+
+    def _persist(self, job: TPUJob, annotations: dict[str, Any]) -> bool:
+        """Merge-patch annotations onto the job (None deletes the key),
+        refreshing the typed object's RV so the sync's later status write
+        does not self-conflict (same shape as GangScheduler._persist)."""
+
+        def apply_typed() -> None:
+            for k, v in annotations.items():
+                if v is None:
+                    job.metadata.annotations.pop(k, None)
+                else:
+                    job.metadata.annotations[k] = v
+
+        if self.client is None:
+            apply_typed()
+            return True
+        try:
+            patched = self.client.patch_merge(
+                objects.TPUJOBS, job.metadata.namespace, job.metadata.name,
+                {"metadata": {"annotations": dict(annotations)}},
+            )
+        except ApiError:
+            self.log.warning(
+                "checkpoint annotation persist failed for %s", job.key
+            )
+            return False
+        apply_typed()
+        job.metadata.resource_version = str(
+            objects.meta(patched).get("resourceVersion", "")
+        )
+        return True
+
+    def _export_gauges(self) -> None:
+        with self._lock:
+            reporting, stale = self._reporting, self._stale
+        CKPT_JOBS_REPORTING.set(reporting)
+        CKPT_STALE_JOBS.set(stale)
+
+
+def _parse_int(raw: str | None) -> int | None:
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None

@@ -84,6 +84,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-probe-window", type=float, default=30.0,
                    help="quiet seconds in the repair probe before a cell "
                         "auto-uncordons")
+    # Checkpoint coordination (tf_operator_tpu/ckpt/): per-job checkpoint
+    # registry, ack'd graceful eviction, resume injection, checkpoint GC.
+    p.add_argument("--checkpoint-grace", type=float, default=30.0,
+                   metavar="SECS",
+                   help="graceful-eviction barrier: seconds a preemption/"
+                        "migration waits for a checkpoint ack before "
+                        "deleting pods (released early on ack; 0 = evict "
+                        "immediately, the fire-and-forget behavior)")
+    p.add_argument("--checkpoint-stale-after", type=float, default=600.0,
+                   metavar="SECS",
+                   help="flag a Running job CheckpointStale when its "
+                        "checkpoint roll-up is quiet this long (0 = off)")
+    p.add_argument("--ckpt-gc-keep", type=int, default=1,
+                   help="checkpoint steps retained per Succeeded job by "
+                        "the retention sweeper (local-executor mode)")
+    p.add_argument("--ckpt-gc-ttl", type=float, default=0.0, metavar="SECS",
+                   help="additionally expire retained checkpoint steps of "
+                        "Succeeded jobs older than this (0 = never)")
+    p.add_argument("--ckpt-gc-interval", type=float, default=60.0,
+                   metavar="SECS",
+                   help="seconds between checkpoint retention sweeps")
     p.add_argument("--json-log", action="store_true", help="structured JSON logs")
     p.add_argument("--version", action="store_true", help="print version and exit")
     # Runtime wiring: the backing store is the in-process store (default),
@@ -238,7 +259,16 @@ def main(argv: list[str] | None = None) -> int:
         aging_rate=args.scheduler_aging_rate,
         preemption=args.preemption,
         gate_pods=args.gang,
+        checkpoint_grace=args.checkpoint_grace,
     ))
+
+    # --- checkpoint coordination -------------------------------------------
+    from tf_operator_tpu.ckpt import CheckpointRegistry, CkptConfig
+
+    ckpt_registry = CheckpointRegistry(
+        scheduler,
+        config=CkptConfig(stale_after=args.checkpoint_stale_after),
+    )
 
     # --- fleet health monitor ----------------------------------------------
     health = None
@@ -287,7 +317,10 @@ def main(argv: list[str] | None = None) -> int:
         # unmatched GET, which would shadow /metrics with index.html.
         from tf_operator_tpu.runtime.observability import mount_observability
 
-        mount_observability(api_server, scheduler=scheduler, health=health)
+        mount_observability(
+            api_server, scheduler=scheduler, health=health,
+            ckpt=ckpt_registry,
+        )
         if args.dashboard:
             from tf_operator_tpu.dashboard.backend import mount_dashboard
 
@@ -313,13 +346,28 @@ def main(argv: list[str] | None = None) -> int:
             # standby must not cordon or migrate anything.
             health.start(leading_stop, interval=args.health_poll_interval)
         if args.local_executor:
+            from tf_operator_tpu.ckpt import CheckpointSweeper, SweepConfig
             from tf_operator_tpu.runtime.executor import LocalProcessExecutor
             from tf_operator_tpu.runtime.gc import OwnerGarbageCollector
 
             executor = LocalProcessExecutor(client, args.namespace)
             collector = OwnerGarbageCollector(client, args.namespace)
+            # Checkpoint retention GC runs where the checkpoint storage is
+            # reachable — which is exactly the local-executor runtime (on
+            # a real cluster the sweeper belongs wherever the shared
+            # filesystem mounts).
+            sweeper = CheckpointSweeper(
+                client,
+                SweepConfig(
+                    keep=args.ckpt_gc_keep,
+                    ttl=args.ckpt_gc_ttl,
+                    interval=args.ckpt_gc_interval,
+                ),
+                args.namespace,
+            )
             executor.start(leading_stop)
             collector.start(leading_stop)
+            sweeper.start(leading_stop)
             extras.append(executor)
         controller.run(leading_stop)
 

@@ -14,6 +14,7 @@ ctl. Commands mirror the kubectl verbs users already know:
     tpuctl wait NS/NAME [--for Succeeded] [--timeout 300]
     tpuctl queue [-o json]                  # gang-admission queue/capacity
     tpuctl health [-o json]                 # fleet health: cell states
+    tpuctl ckpt [-o json]                   # checkpoint registry: acked steps
     tpuctl cordon v4 0,0,0 0,0,1            # pin cells out of placement
     tpuctl uncordon v4 0,0,0 0,0,1          # return cells to service
     tpuctl drain v4 0,0,0 --at 3600         # maintenance notice + migrate
@@ -422,9 +423,9 @@ def _health_request(master: str, path: str, body: dict | None = None):
         except Exception:
             pass
         raise SystemExit(
-            f"tpuctl: health API unavailable ({e.code}"
+            f"tpuctl: debug API {path} unavailable ({e.code}"
             + (f": {detail}" if detail else "")
-            + ") — is the operator serving with fleet health enabled?"
+            + ") — is the operator serving with this subsystem enabled?"
         ) from None
 
 
@@ -469,6 +470,33 @@ def cmd_health(args, master: str) -> int:
              for c in cells],
             ["GENERATION", "CELL", "STATE", "SCORE", "SOURCE", "PINNED"],
         ))
+    return 0
+
+
+def cmd_ckpt(args, master: str) -> int:
+    """Render /debug/ckpt: per-job checkpoint records (acked step, save
+    recency, staleness, in-flight eviction barriers) — the operator-side
+    view of `where would this job resume from right now?`."""
+    snap = _health_request(master, "/debug/ckpt")
+    if args.output == "json":
+        print(json.dumps(snap, indent=2))
+        return 0
+    jobs = snap.get("jobs") or []
+    reporting = [j for j in jobs if j.get("latestStep") is not None]
+    if not reporting:
+        print("No jobs with checkpoint records")
+        return 0
+    print(_table(
+        [[j.get("key", ""),
+          j.get("latestStep", ""),
+          j.get("ackedAt", "") or "-",
+          j.get("reportingPods", 0),
+          "yes" if j.get("stale") else "",
+          "evicting" if j.get("signalGen") else "",
+          j.get("directory", "")[:48]]
+         for j in reporting],
+        ["JOB", "STEP", "ACKED", "PODS", "STALE", "BARRIER", "DIR"],
+    ))
     return 0
 
 
@@ -578,6 +606,11 @@ def main(argv: list[str] | None = None) -> int:
     h = sub.add_parser("health", help="fleet health: cell states / cordons")
     h.add_argument("-o", "--output", choices=("table", "json"),
                    default="table")
+
+    ck = sub.add_parser("ckpt",
+                        help="checkpoint registry: acked steps / barriers")
+    ck.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
     for verb, help_text in (
         ("cordon", "withdraw mesh cells from placement (operator-pinned)"),
         ("uncordon", "return mesh cells to service"),
@@ -599,6 +632,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_queue(args, args.master)
     if args.cmd == "health":
         return cmd_health(args, args.master)
+    if args.cmd == "ckpt":
+        return cmd_ckpt(args, args.master)
     if args.cmd in ("cordon", "uncordon", "drain"):
         return cmd_cordon(args, args.master, args.cmd)
     client = TPUJobClient(RestClusterClient(args.master))

@@ -19,8 +19,10 @@ from typing import Any
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.helpers import replica_labels
 from tf_operator_tpu.api.types import ReplicaSpec, RestartPolicy, TPUJob
+from tf_operator_tpu.ckpt import protocol as ckpt_protocol
 from tf_operator_tpu.controller import cluster_spec
 from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.metrics import CKPT_RESUME_INJECTIONS_TOTAL
 from tf_operator_tpu.topology import slices as topo_slices
 from tf_operator_tpu.utils import exit_codes, names
 
@@ -67,6 +69,14 @@ class PodReconciler:
     """Mixin over JobController providing reconcile_pods. Host controller
     supplies: pod_control, expectations, recorder, job_key/expectation_key."""
 
+    def _resume_env(self, job: TPUJob) -> dict[str, str]:
+        """TPU_RESUME_STEP/TPU_CKPT_DIR from the checkpoint registry, when
+        the host controller carries one (duck-typed like report_pod_exit)."""
+        registry = getattr(self, "ckpt", None)
+        if registry is None:
+            return {}
+        return registry.resume_env(job)
+
     def build_pod(
         self, job: TPUJob, rtype: str, spec: ReplicaSpec, index: int
     ) -> dict[str, Any]:
@@ -108,6 +118,26 @@ class PodReconciler:
             tmpl_spec["schedulingGates"] = list(existing) + [
                 dict(g) for g in gates if g["name"] not in present
             ]
+
+        # Resume injection (ckpt/registry.py): replacement pods of a job
+        # with a durable checkpoint record learn the last acked step and
+        # directory, so a preempted/migrated gang resumes where it acked
+        # instead of step 0. Injected like the topology contract — into
+        # the default container only, never overriding template-set values.
+        resume = self._resume_env(job)
+        if resume:
+            for c in tmpl_spec.get("containers", []):
+                if c.get("name") != constants.DEFAULT_CONTAINER_NAME:
+                    continue
+                env = c.setdefault("env", [])
+                present = {e.get("name") for e in env}
+                injected = False
+                for k, v in resume.items():
+                    if k not in present:
+                        env.append({"name": k, "value": v})
+                        injected = True
+                if injected and ckpt_protocol.ENV_RESUME_STEP in resume:
+                    CKPT_RESUME_INJECTIONS_TOTAL.inc()
 
         labels = replica_labels(job.metadata.name, rtype, index)
         meta = template.setdefault("metadata", {})

@@ -36,6 +36,11 @@ REASON_SLICE_HEALTHY = "SliceHealthy"
 REASON_MIGRATING = "SliceDraining"
 REASON_MIGRATED = "MigrationComplete"
 
+REASON_CKPT_STALE = "CheckpointQuiet"
+REASON_CKPT_FRESH = "CheckpointFresh"
+REASON_CKPT_SKIPPED = "CheckpointGraceExpired"
+REASON_CKPT_RECOVERED = "CheckpointRecovered"
+
 TRUE = "True"
 FALSE = "False"
 

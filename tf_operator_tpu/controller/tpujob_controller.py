@@ -30,6 +30,8 @@ from tf_operator_tpu.api.types import (
 )
 from tf_operator_tpu.api.validation import validate_spec
 from tf_operator_tpu.api.types import CleanPodPolicy
+from tf_operator_tpu.ckpt import protocol as ckpt_protocol
+from tf_operator_tpu.ckpt.registry import CheckpointRegistry
 from tf_operator_tpu.control.pod_control import PodControlInterface, RealPodControl
 from tf_operator_tpu.control.service_control import (
     RealServiceControl,
@@ -108,6 +110,17 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         self.scheduler.attach(
             client, recorder, wakeup=self.enqueue, pod_lister=self.pod_informer
         )
+        # Checkpoint registry (ckpt/registry.py): per-job checkpoint
+        # roll-up, the eviction barrier's ack source, and resume-env
+        # injection. The operator main may wire a flag-configured one onto
+        # the scheduler first; otherwise a default registry is created —
+        # it is pure observation until workers actually report, and the
+        # eviction barrier additionally needs checkpoint_grace > 0.
+        self.ckpt: CheckpointRegistry = (
+            getattr(self.scheduler, "ckpt", None)
+            or CheckpointRegistry(self.scheduler)
+        )
+        self.ckpt.attach(client, recorder)
         # Fleet-health monitor (health/monitor.py), when one was wired onto
         # the scheduler (operator main builds it; tests construct their
         # own). Attaching recovers persisted cordons before the first sync
@@ -203,6 +216,7 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         self._terminal_recorded.pop(key, None)
         self._restart_floor.pop(key, None)
         self.scheduler.release_job(key)
+        self.ckpt.forget(key)
         for rtype in ReplicaType.ALL:
             self.expectations.delete_expectations(
                 self.expectation_key(key, rtype, "pods")
@@ -315,6 +329,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         # Snapshot for the skip-unchanged status guard below.
         status_before = job.status.to_dict()
 
+        # Checkpoint roll-up BEFORE anything acts on the job: per-pod
+        # durable-save reports become the job's annotation record (persist-
+        # first) + status.lastCheckpointStep, and the registry's ack cache
+        # is what the scheduler's eviction barrier consults this sync.
+        self.ckpt.observe(job, pods)
+
         if status_engine.is_finished(job.status):
             self.scheduler.release_job(job.key)
             self.delete_pods_and_services(job, pods, services)
@@ -332,6 +352,12 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         if self.config.enable_gang_scheduling:
             decision = self.scheduler.reconcile_gang(job, has_pods=bool(pods))
             admitted = decision.admitted
+            if decision.evicting and decision.requeue_after is not None:
+                # A graceful-eviction barrier is holding this gang's pods:
+                # re-sync at the grace deadline so expiry never waits for
+                # the periodic resync (acks arrive sooner via the pod
+                # MODIFIED events their annotation patches emit).
+                self.enqueue_after(job.key, decision.requeue_after)
 
         if (
             self.config.enable_gang_scheduling
@@ -346,8 +372,31 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
         if self.health is not None and self.config.enable_gang_scheduling:
             self._sync_health_conditions(job, admitted)
 
+        # Checkpoint conditions (CheckpointStale/CheckpointSkipped): like
+        # the health conditions, auxiliary roll-ups surfaced every sync.
+        self._sync_ckpt_conditions(job)
+
         if not admitted:
             if pods:
+                # Recovered graceful-eviction barrier: a predecessor
+                # controller persisted state=queued + signal-gen + grace
+                # deadline and died before the held deletion loop ran. The
+                # pods keep their flush window — deletion waits until every
+                # pod acks the persisted generation or the deadline passes,
+                # exactly as the original barrier would have.
+                barrier = self.ckpt.barrier_status(job, pods)
+                if barrier is not None and barrier.waiting:
+                    self.update_job_status(job, pods, False, False)
+                    self._maybe_write_status(job, status_before)
+                    self.enqueue_after(
+                        job.key, max(0.05, barrier.remaining)
+                    )
+                    return True
+                if barrier is not None and barrier.expired:
+                    self.ckpt.note_skipped(
+                        job.metadata.namespace, job.metadata.name,
+                        barrier.gen, typed=job,
+                    )
                 # A queued gang with pods is an interrupted preemption (the
                 # scheduler persisted state=queued, then the controller died
                 # before the deletion loop finished): finish the eviction —
@@ -363,6 +412,10 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
                         )
                     except NotFound:
                         pass
+                if barrier is not None:
+                    # The recovered barrier just completed: retire its
+                    # record like the scheduler's own completion does.
+                    self.ckpt.clear_barrier(job)
                 return True
             # Waiting in the admission queue: record observation time only;
             # the scheduler wakes this key the moment capacity frees up,
@@ -680,6 +733,76 @@ class TPUJobController(JobController, PodReconciler, ServiceReconciler):
                 job, JobConditionType.SLICE_DEGRADED,
                 status_engine.REASON_SLICE_HEALTHY,
                 "slice cells healthy", status=status_engine.FALSE,
+            )
+
+    def _sync_ckpt_conditions(self, job: TPUJob) -> None:
+        """Roll checkpoint-registry state up into job conditions.
+
+        - CheckpointStale=True while a Running job's checkpoint roll-up
+          has gone quiet past the registry's staleness threshold; flipped
+          False on the next advance.
+        - CheckpointSkipped=True while the most recent eviction proceeded
+          past the grace deadline without an ack (skipped-at >= acked-at
+          on the annotations — both stamps are ISO, so the comparison is
+          lexicographic like the migrated-at/preempted-at pair above);
+          flipped False once a newer ack lands.
+        Jobs that never report a checkpoint (and were never skipped) get
+        neither condition — the roll-up must be a strict no-op for
+        non-checkpointing workloads.
+        """
+        ann = job.metadata.annotations or {}
+        acked_at = ann.get(ckpt_protocol.JOB_ACKED_AT, "")
+        skipped_at = ann.get(ckpt_protocol.JOB_SKIPPED_AT, "")
+        if not acked_at and not skipped_at:
+            return
+
+        rec = self.ckpt.record_of(job.key)
+        stale_now = rec is not None and rec.stale
+        was_stale = status_engine.has_condition(
+            job.status, JobConditionType.CHECKPOINT_STALE
+        )
+        if stale_now and not was_stale:
+            msg = (
+                f"no checkpoint advance since {acked_at or 'job start'} "
+                f"(threshold {self.ckpt.config.stale_after:.0f}s)"
+            )
+            status_engine.update_job_conditions(
+                job, JobConditionType.CHECKPOINT_STALE,
+                status_engine.REASON_CKPT_STALE, msg,
+            )
+            self.recorder.warning(
+                job.to_dict(), status_engine.REASON_CKPT_STALE, msg
+            )
+        elif not stale_now and was_stale:
+            status_engine.update_job_conditions(
+                job, JobConditionType.CHECKPOINT_STALE,
+                status_engine.REASON_CKPT_FRESH,
+                "checkpoint roll-up advancing again",
+                status=status_engine.FALSE,
+            )
+
+        skipped_now = bool(skipped_at) and skipped_at >= acked_at
+        was_skipped = status_engine.has_condition(
+            job.status, JobConditionType.CHECKPOINT_SKIPPED
+        )
+        if skipped_now and not was_skipped:
+            msg = (
+                f"evicted at {skipped_at} without a checkpoint ack; "
+                "resume will use the last recorded step"
+            )
+            status_engine.update_job_conditions(
+                job, JobConditionType.CHECKPOINT_SKIPPED,
+                status_engine.REASON_CKPT_SKIPPED, msg,
+            )
+            self.recorder.warning(
+                job.to_dict(), status_engine.REASON_CKPT_SKIPPED, msg
+            )
+        elif not skipped_now and was_skipped:
+            status_engine.update_job_conditions(
+                job, JobConditionType.CHECKPOINT_SKIPPED,
+                status_engine.REASON_CKPT_RECOVERED,
+                "a newer checkpoint ack superseded the skipped eviction",
+                status=status_engine.FALSE,
             )
 
     def report_pod_exit(

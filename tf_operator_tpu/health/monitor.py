@@ -31,6 +31,12 @@ contract (mirroring scheduler/core.py's annotation-first admissions):
     c. migrate admitted gangs off the cells (scheduler.migrate_gang:
        checkpoint-signal annotation persisted, pods deleted whole,
        gang requeued with an aging credit, re-placed on healthy cells).
+       With a checkpoint grace configured the eviction inside (c) is
+       NOT fire-and-forget: the scheduler holds the pod deletions until
+       every pod acks the signal or the grace deadline passes
+       (ckpt/registry.py; the poll's migration sweep keeps re-entering
+       the pending barrier and completes it when the ack/deadline
+       allows), and the re-placed pods resume from the last acked step.
 
 A controller dying between (b) and (c) — or mid-(c) — recovers: the
 successor's monitor reads the persisted cordons back into the placer,
@@ -538,6 +544,10 @@ class FleetHealthMonitor:
         self._export_gauges()
 
     def _migrate(self, key: str) -> bool:
+        """Drive one gang's migration. True covers both a completed
+        eviction and an in-flight checkpoint barrier (signaled, pods held
+        for the ack/deadline) — the sweep re-enters pending barriers each
+        poll, which is what expires them even with no sync traffic."""
         try:
             return self.scheduler.migrate_gang(key)
         except ApiError:

@@ -33,11 +33,13 @@ from dataclasses import dataclass
 from typing import Any
 
 from tf_operator_tpu.api import constants
+from tf_operator_tpu.ckpt import protocol as ckpt_protocol
 from tf_operator_tpu.runtime import objects, podlogs
 from tf_operator_tpu.runtime.client import (
     ADDED,
     DELETED,
     MODIFIED,
+    ApiError,
     ClusterClient,
     NotFound,
 )
@@ -94,6 +96,15 @@ class _Running:
     uid: str = ""
     restart_count: int = 0
     deleted: bool = False
+    # Checkpoint-coordination hook (ckpt/protocol.py): where this pod's
+    # workload writes durable-save acks, the last file mtime the relay
+    # lifted into pod annotations, and the eviction-signal generation
+    # delivered to the process (plus the ack-file mtime at delivery — a
+    # save that lands AFTER delivery is the ack the barrier waits for).
+    ack_path: str = ""
+    ack_mtime: float = 0.0
+    delivered_gen: int = 0
+    delivered_mtime: float = 0.0
 
 
 class LocalProcessExecutor:
@@ -115,6 +126,11 @@ class LocalProcessExecutor:
     def start(self, stop: threading.Event) -> None:
         self._stop = stop
         threading.Thread(target=self._run, name="local-executor", daemon=True).start()
+        # Checkpoint ack relay: lifts workload ack files into pod
+        # annotations (the worker→operator leg of ckpt/protocol.py).
+        threading.Thread(
+            target=self._poll_acks, name="local-executor-acks", daemon=True
+        ).start()
 
     def resolve(self, pod_name: str) -> tuple[str, int] | None:
         """The harness's service-proxy analog: pod name → (host, port)."""
@@ -141,6 +157,9 @@ class LocalProcessExecutor:
                 continue
             if event.type == ADDED:
                 self._on_added(event.object)
+                # A pod can arrive already carrying an eviction signal
+                # (executor restart mid-barrier): deliver it on launch.
+                self._maybe_signal(event.object)
             elif event.type == MODIFIED:
                 # The one spec mutation that changes runnability: the gang
                 # scheduler lifting the admission gate. A pod that arrived
@@ -150,6 +169,12 @@ class LocalProcessExecutor:
                 # and launching on one would re-run a finished pod.
                 if objects.pod_phase(event.object) == objects.PENDING:
                     self._on_added(event.object)
+                # Eviction checkpoint signal (scheduler barrier): relay it
+                # to the workload as a graceful SIGTERM — the analog of
+                # kubelet's preStop grace, except the pod is NOT being
+                # deleted yet; the workload saves, acks, and keeps running
+                # until the barrier completes.
+                self._maybe_signal(event.object)
             elif event.type == DELETED:
                 self._on_deleted(event.object)
         watch.stop()
@@ -295,6 +320,14 @@ class LocalProcessExecutor:
         )
         env["PYTHONPATH"] = repo_root
         env["PORT"] = str(port)
+        # Checkpoint ack contract: the workload writes durable-save acks
+        # here (train/checkpoint.py does it automatically when the var is
+        # set); the relay thread lifts them into pod annotations for the
+        # operator's registry and the eviction barrier.
+        ack_path = ckpt_protocol.ack_path_for(
+            objects.namespace_of(pod), name, objects.uid_of(pod)
+        )
+        env[ckpt_protocol.ENV_ACK_FILE] = ack_path
         for item in container.get("env", []):
             if "value" in item:
                 env[item["name"]] = self._rewrite(str(item["value"]), default_port)
@@ -330,6 +363,7 @@ class LocalProcessExecutor:
             port=port,
             uid=objects.uid_of(pod),
             restart_count=restart_count,
+            ack_path=ack_path,
         )
         with self._lock:
             self._procs[key] = running
@@ -401,6 +435,87 @@ class LocalProcessExecutor:
             restart_count=running.restart_count,
             expect_uid=running.uid,
         )
+
+    # -- checkpoint coordination ---------------------------------------------
+
+    def _maybe_signal(self, pod: dict[str, Any]) -> None:
+        """Deliver an eviction checkpoint signal (pod annotation stamped by
+        the scheduler's barrier) to the workload process, once per
+        generation: a graceful SIGTERM the workload's signal handler turns
+        into a forced save + ack (utils/signals.py + train/checkpoint.py).
+        The pod itself stays up until the barrier completes."""
+        gen = ckpt_protocol.pod_signal_gen(pod)
+        if not gen:
+            return
+        key = objects.key_of(pod)
+        uid = objects.uid_of(pod)
+        with self._lock:
+            running = self._procs.get(key)
+            if (
+                running is None
+                or running.deleted
+                or (uid and running.uid != uid)
+                or running.delivered_gen >= gen
+            ):
+                return
+            running.delivered_gen = gen
+            try:
+                # The mtime at delivery: a later write marks a save that
+                # completed AFTER the signal — the ack the barrier wants.
+                running.delivered_mtime = os.path.getmtime(running.ack_path)
+            except OSError:
+                running.delivered_mtime = 0.0
+            proc = running.process
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            self._log.info(
+                "delivered checkpoint signal gen=%d to %s", gen, key
+            )
+
+    def _poll_acks(self) -> None:
+        """Relay loop: workload ack files → pod annotations. Each change
+        of a pod's ack file is patched once (step + saved-at + dir, plus
+        the acked generation when a save landed after a delivered
+        signal); the patch's MODIFIED event is what wakes the owning
+        job's sync to roll the report up."""
+        while self._stop is not None and not self._stop.is_set():
+            with self._lock:
+                procs = list(self._procs.items())
+            for key, running in procs:
+                if running.deleted or not running.ack_path:
+                    continue
+                try:
+                    mtime = os.path.getmtime(running.ack_path)
+                except OSError:
+                    continue
+                if mtime == running.ack_mtime:
+                    continue
+                ack = ckpt_protocol.read_ack(running.ack_path)
+                if ack is None:
+                    continue  # mid-write; next tick re-reads
+                ann = {
+                    ckpt_protocol.POD_STEP: str(ack.step),
+                    ckpt_protocol.POD_SAVED_AT: ack.saved_at,
+                }
+                if ack.directory:
+                    ann[ckpt_protocol.POD_DIR] = ack.directory
+                if running.delivered_gen and mtime > running.delivered_mtime:
+                    ann[ckpt_protocol.POD_ACK] = str(running.delivered_gen)
+                namespace, _, name = key.partition("/")
+                try:
+                    self._client.patch_merge(
+                        objects.PODS, namespace, name,
+                        {"metadata": {"annotations": ann}},
+                    )
+                except NotFound:
+                    pass  # pod gone: nothing left to report to
+                except ApiError:
+                    continue  # apiserver hiccup: keep mtime, retry
+                running.ack_mtime = mtime
+            self._stop.wait(0.2)
 
     def _on_deleted(self, pod: dict[str, Any]) -> None:
         # NOTE: the name→port mapping is deliberately kept. A controller-

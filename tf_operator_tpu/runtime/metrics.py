@@ -361,3 +361,48 @@ HEALTH_MIGRATIONS_TOTAL = REGISTRY.counter(
     "tpu_health_migrations_total",
     "Gangs checkpoint-signaled and evicted off draining/cordoned cells",
 )
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint-coordination metric families (consumed by tf_operator_tpu/ckpt/,
+# the scheduler's eviction barrier, and the pod reconciler's resume
+# injection). Declared at import for the same full-schema-on-first-scrape
+# reason as the scheduler and health families above.
+# ---------------------------------------------------------------------------
+
+CKPT_SIGNALS_TOTAL = REGISTRY.counter(
+    "tpu_checkpoint_signals_total",
+    "Eviction checkpoint signals sent to gangs, by eviction reason",
+    ("reason",),
+)
+CKPT_ACKS_TOTAL = REGISTRY.counter(
+    "tpu_checkpoint_acks_total",
+    "Job-level checkpoint roll-up advances (a new step became the durable "
+    "resume point)",
+)
+CKPT_BARRIER_SECONDS = REGISTRY.histogram(
+    "tpu_checkpoint_barrier_seconds",
+    "Signal-to-eviction wall time of the graceful-eviction barrier",
+    ("result",),  # acked | expired
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0),
+)
+CKPT_SKIPPED_TOTAL = REGISTRY.counter(
+    "tpu_checkpoint_skipped_total",
+    "Evictions that proceeded past the grace deadline without an ack",
+)
+CKPT_RESUME_INJECTIONS_TOTAL = REGISTRY.counter(
+    "tpu_checkpoint_resume_injections_total",
+    "Pods created with a TPU_RESUME_STEP resume contract injected",
+)
+CKPT_GC_STEPS_TOTAL = REGISTRY.counter(
+    "tpu_checkpoint_gc_steps_total",
+    "Checkpoint step directories removed by the retention sweeper",
+)
+CKPT_JOBS_REPORTING = REGISTRY.gauge(
+    "tpu_checkpoint_jobs_reporting",
+    "Jobs with a durable checkpoint record in the registry",
+)
+CKPT_STALE_JOBS = REGISTRY.gauge(
+    "tpu_checkpoint_stale_jobs",
+    "Running jobs whose checkpoint roll-up exceeds the staleness threshold",
+)

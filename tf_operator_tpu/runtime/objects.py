@@ -65,6 +65,10 @@ def labels_of(obj: dict[str, Any]) -> dict[str, str]:
     return meta(obj).get("labels", {}) or {}
 
 
+def annotations_of(obj: dict[str, Any]) -> dict[str, str]:
+    return meta(obj).get("annotations", {}) or {}
+
+
 def key_of(obj: dict[str, Any]) -> str:
     return f"{namespace_of(obj)}/{name_of(obj)}"
 

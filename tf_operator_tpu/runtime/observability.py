@@ -57,7 +57,8 @@ class ObservabilityHandler:
 def mount_observability(api_server: Any, registry: Registry = REGISTRY,
                         tracer: Tracer = TRACER,
                         scheduler: Any | None = None,
-                        health: Any | None = None) -> ObservabilityHandler:
+                        health: Any | None = None,
+                        ckpt: Any | None = None) -> ObservabilityHandler:
     handler = ObservabilityHandler(registry, tracer, scheduler)
     api_server.add_handler(handler)
     if health is not None:
@@ -67,9 +68,15 @@ def mount_observability(api_server: Any, registry: Registry = REGISTRY,
         from tf_operator_tpu.health.httpapi import mount_health
 
         mount_health(api_server, health)
+    if ckpt is not None:
+        # /debug/ckpt: the checkpoint registry snapshot, same pattern.
+        from tf_operator_tpu.ckpt.httpapi import mount_ckpt
+
+        mount_ckpt(api_server, ckpt)
     LOG.info(
-        "observability mounted at /metrics and /debug/traces%s%s",
+        "observability mounted at /metrics and /debug/traces%s%s%s",
         " and /debug/scheduler" if scheduler is not None else "",
         " and /debug/health" if health is not None else "",
+        " and /debug/ckpt" if ckpt is not None else "",
     )
     return handler

@@ -44,9 +44,12 @@ from typing import Any, Callable
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import TPUJob
+from tf_operator_tpu.ckpt import protocol as ckpt_protocol
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.client import ApiError, ClusterClient, NotFound
 from tf_operator_tpu.runtime.metrics import (
+    CKPT_BARRIER_SECONDS,
+    CKPT_SIGNALS_TOTAL,
     HEALTH_MIGRATIONS_TOTAL,
     SCHED_ADMISSION_SECONDS,
     SCHED_ADMISSIONS_TOTAL,
@@ -84,6 +87,18 @@ EVENT_GANG_RELEASED = "GangReleased"
 EVENT_PREEMPTED = "GangPreempted"
 EVENT_UNSCHEDULABLE = "GangUnschedulable"
 EVENT_MIGRATING = "JobMigrating"
+EVENT_CKPT_ACKED = "CheckpointAcked"
+EVENT_CKPT_SKIPPED = "CheckpointSkipped"
+
+# _evict outcomes. FAILED: nothing changed, victim keeps capacity — retry.
+# SIGNALED: the graceful-eviction barrier just started (queued state +
+# signal persisted, pods signaled but HELD). PENDING: a barrier is already
+# in flight and cannot complete yet. DONE: pods deleted, capacity
+# refunded, gang requeued.
+EVICT_FAILED = "failed"
+EVICT_SIGNALED = "signaled"
+EVICT_PENDING = "pending"
+EVICT_DONE = "done"
 
 
 @dataclass
@@ -107,6 +122,14 @@ class SchedulerConfig:
     # fault, not the tenant's, so the migrated gang out-bids same-class
     # arrivals when re-placement has to wait for capacity.
     migration_credit: float = 60.0
+    # Graceful-eviction barrier (ckpt coordination): seconds an eviction
+    # waits between the checkpoint signal and the pod deletions, released
+    # early the moment every gang pod acks the signal. 0 (the default, and
+    # the pre-barrier behavior every existing test encodes) evicts in the
+    # same pass — signal and delete with no wait; the operator main wires
+    # a production default via --checkpoint-grace. Requires a
+    # CheckpointRegistry attached (self.ckpt) to take effect.
+    checkpoint_grace: float = 0.0
 
 
 @dataclass
@@ -114,6 +137,12 @@ class AdmissionDecision:
     admitted: bool
     state: str
     reason: str = ""
+    # True while the gang is admitted but checkpoint-signaled and awaiting
+    # its ack/deadline (the graceful-eviction barrier).
+    evicting: bool = False
+    # Ask the controller to re-sync this key after this many seconds (the
+    # barrier's deadline expiry must not wait for the periodic resync).
+    requeue_after: float | None = None
 
 
 class GangScheduler:
@@ -142,6 +171,11 @@ class GangScheduler:
         # The scheduler itself never calls into it (lock ordering: the
         # monitor's lock is always taken before this one, never after).
         self.health: Any | None = None
+        # Set by ckpt/registry.py when a CheckpointRegistry is wired in:
+        # the eviction barrier's ack source (barrier_acked) and the skip
+        # marker sink. Lock ordering: this scheduler's lock may be held
+        # when calling into the registry; the registry never calls back.
+        self.ckpt: Any | None = None
         self.log = logger.with_fields(component="gang-scheduler")
 
     # -- wiring --------------------------------------------------------------
@@ -215,6 +249,21 @@ class GangScheduler:
                 # eviction cannot be persisted the gang simply stays
                 # admitted on its cells until the next sync retries.
                 self._migrate_locked(gang)
+            if gang.state == STATE_ADMITTED and gang.evict_deadline is not None:
+                # Graceful-eviction barrier in flight (preemption or
+                # migration): complete it the moment every pod acked the
+                # signal or the grace deadline passed; until then the gang
+                # keeps its pods and the controller re-syncs at expiry.
+                if self._finish_evict_locked(gang) == EVICT_PENDING:
+                    self._export_gauges()
+                    return AdmissionDecision(
+                        admitted=True,
+                        state=gang.state,
+                        evicting=True,
+                        requeue_after=max(
+                            0.05, gang.evict_deadline - time.time()
+                        ),
+                    )
             if gang.state != STATE_ADMITTED:
                 # Interrupted-eviction guard: a queued gang that still owns
                 # pods must not re-admit until the controller's cleanup
@@ -353,8 +402,9 @@ class GangScheduler:
         return False
 
     def _migrate_locked(self, gang: Gang) -> bool:
+        already_evicting = gang.evict_deadline is not None
         now = objects.now_iso()
-        ok = self._evict(
+        result = self._evict(
             gang,
             annotations={
                 # preempted-at IS the checkpoint signal contract of PR 1 —
@@ -371,12 +421,20 @@ class GangScheduler:
                 "gang will be re-placed whole on healthy cells"
             ),
             aging_credit=self.config.migration_credit,
+            reason="migration",
         )
-        if ok:
+        if result == EVICT_FAILED:
+            return False
+        if not already_evicting:
+            # Count the migration once, when it starts — whether it ran to
+            # completion in one pass (no grace) or just signaled the
+            # barrier. Re-entries while the barrier is pending land in the
+            # EVICT_PENDING/EVICT_DONE branch above without re-counting.
             HEALTH_MIGRATIONS_TOTAL.inc()
+        if result == EVICT_DONE:
             self._pump()
             self._export_gauges()
-        return ok
+        return True
 
     # -- introspection -------------------------------------------------------
 
@@ -426,6 +484,11 @@ class GangScheduler:
             )
         if g.infeasible:
             view["infeasible"] = g.infeasible
+        if g.evict_deadline is not None:
+            view["evicting"] = {
+                "signalGen": g.evict_gen,
+                "graceRemaining": round(max(0.0, g.evict_deadline - now), 3),
+            }
         return view
 
     # -- internals (lock held) -----------------------------------------------
@@ -598,13 +661,23 @@ class GangScheduler:
         return True
 
     def _try_preempt_for(self, gang: Gang, now: float) -> bool:
+        if any(
+            g.evict_deadline is not None for g in self._admitted.values()
+        ):
+            # Eviction capacity is already in flight behind a checkpoint
+            # barrier. Selecting MORE victims against the still-charged
+            # ledger would cascade evictions the pending refund may make
+            # unnecessary; wait for the barrier(s) to complete — their
+            # finish pumps the queue and this gang gets served then.
+            return False
         victims = select_victims(
             gang, list(self._admitted.values()), self.placer, self.ledger
         )
         if not victims:
             return False
+        signaled = False
         for victim in victims:
-            evicted = self._evict(
+            result = self._evict(
                 victim,
                 annotations={
                     ANNOTATION_PREEMPTED_AT: objects.now_iso(),
@@ -616,13 +689,21 @@ class GangScheduler:
                     f"(priority {gang.priority} > {victim.priority}); "
                     "checkpoint now"
                 ),
+                reason="preemption",
             )
-            if not evicted:
+            if result == EVICT_FAILED:
                 # Eviction could not be carried out (apiserver hiccup):
                 # the victim keeps its capacity, so admitting the pending
                 # gang now would double-book chips. Retry next pump.
                 return False
+            # Counted at the eviction DECISION (signal or same-pass
+            # delete) — a barrier completion never re-counts.
             SCHED_PREEMPTIONS_TOTAL.inc()
+            signaled = signaled or result == EVICT_SIGNALED
+        if signaled:
+            # Victim(s) hold their pods until ack/deadline; the pending
+            # gang admits on the pump their barrier completion runs.
+            return False
         return self._try_admit(gang, now)
 
     def _evict(
@@ -633,19 +714,35 @@ class GangScheduler:
         event: str,
         message: str,
         aging_credit: float = 0.0,
-    ) -> bool:
+        reason: str = "preemption",
+    ) -> str:
         """Checkpoint-signal, then evict the victim WHOLE and requeue it.
         Shared by preemption (make room for a higher-priority gang) and
         fleet-health migration (get off draining/cordoned cells); the
         callers differ only in the persisted marker annotations, the
         event, and the aging credit granted on requeue.
 
-        Returns False (victim untouched, still admitted) when its pods
-        cannot even be listed — capacity is only ever refunded after the
-        deletion loop actually ran, so the preemptor can never be admitted
-        onto chips the victim still occupies.
+        With a checkpoint grace configured (and a CheckpointRegistry
+        attached), eviction is TWO-phase: this call persists the queued
+        state + signal generation + grace deadline, stamps the signal on
+        every pod, and returns EVICT_SIGNALED with the pods still running —
+        the deletion loop runs later, in _finish_evict_locked, once every
+        pod acked the generation or the deadline passed. Without grace it
+        is the original one-pass pipeline (EVICT_DONE).
+
+        Returns EVICT_FAILED (victim untouched, still admitted) when its
+        pods cannot even be listed or the persist fails — capacity is only
+        ever refunded after the deletion loop actually ran, so the
+        preemptor can never be admitted onto chips the victim still
+        occupies.
         """
         assert self.client is not None
+        if victim.evict_deadline is not None:
+            # Idempotent re-entry while the barrier is pending (repeated
+            # pumps, cordon sweeps, the victim's own syncs): try to
+            # complete, never re-signal — the persisted generation is the
+            # one the pods are flushing against.
+            return self._finish_evict_locked(victim)
         # 1. Enumerate the gang BEFORE any state changes: an unreachable
         #    apiserver aborts the eviction cleanly. Served by the informer
         #    cache when it can be authoritative (see _list_gang_pods); a
@@ -658,23 +755,75 @@ class GangScheduler:
                 "evict %s aborted: pod list failed; victim keeps capacity",
                 victim.key,
             )
-            return False
+            return EVICT_FAILED
+        barrier = (
+            self.config.checkpoint_grace > 0
+            and self.ckpt is not None
+            and bool(pods)
+        )
+        now = time.time()
+        ann: dict[str, Any] = dict(annotations)
+        if barrier:
+            gen = ckpt_protocol.new_signal_gen(now)
+            deadline = now + self.config.checkpoint_grace
+            ann[ckpt_protocol.JOB_SIGNAL_GEN] = str(gen)
+            ann[ckpt_protocol.JOB_EVICT_DEADLINE] = (
+                ckpt_protocol.fmt_deadline(deadline)
+            )
+        else:
+            # Fire-and-forget: clear any stale barrier record an EARLIER
+            # graceful eviction left behind (merge-patch null = delete),
+            # so a crash between this persist and the deletion loop can
+            # never read as a recovered — already expired — barrier and
+            # stamp a spurious CheckpointSkipped on the way out.
+            ann.setdefault(ckpt_protocol.JOB_SIGNAL_GEN, None)
+            ann.setdefault(ckpt_protocol.JOB_EVICT_DEADLINE, None)
         # 2. Checkpoint signal: the annotation lands before any pod dies,
         #    giving checkpoint-aware workloads (train/checkpoint.py watches
-        #    for exactly this) their best-effort flush window. Should the
-        #    controller crash after this persist but before the deletion
-        #    loop finishes, the successor sees state=queued with pods still
-        #    present and finishes the eviction (reconcile_job's
-        #    queued-with-pods cleanup) — never a half-evicted gang running
-        #    unaccounted. If the persist itself fails the eviction aborts:
-        #    deleting pods while the job still reads admitted on the wire
-        #    would make a restart recover the victim as a healthy admitted
-        #    gang and double-book the chips against the preemptor's.
-        if not self._persist(victim.namespace, victim.name, annotations):
-            return False
+        #    for exactly this) their flush window. Should the controller
+        #    crash after this persist but before the deletion loop
+        #    finishes, the successor sees state=queued with pods still
+        #    present and finishes the eviction — honoring the SAME barrier,
+        #    recovered from the persisted generation + deadline
+        #    (reconcile_job's queued-with-pods cleanup) — never a
+        #    half-evicted gang running unaccounted. If the persist itself
+        #    fails the eviction aborts: deleting pods while the job still
+        #    reads admitted on the wire would make a restart recover the
+        #    victim as a healthy admitted gang and double-book the chips
+        #    against the preemptor's.
+        if not self._persist(victim.namespace, victim.name, ann):
+            return EVICT_FAILED
         self._event(victim, event, message, warning=True)
-        # 3. Evict the whole gang — a partial eviction would leave exactly
-        #    the stranded half-slice this subsystem exists to prevent.
+        if barrier:
+            # 3a. Stamp the signal on every pod — the local executor (or a
+            #     sidecar on a real cluster) relays it to the workload —
+            #     and HOLD the deletion loop. The gang stays admitted in
+            #     memory: capacity is only refunded once pods actually
+            #     die, so nothing else can be placed onto chips the victim
+            #     still occupies. A pod the signal patch cannot reach is
+            #     bounded by the grace deadline.
+            for pod in pods:
+                try:
+                    self.client.patch_merge(
+                        objects.PODS,
+                        victim.namespace,
+                        objects.name_of(pod),
+                        {"metadata": {"annotations": {
+                            ckpt_protocol.POD_SIGNAL: str(gen)
+                        }}},
+                    )
+                except ApiError:
+                    continue
+            victim.evict_gen = gen
+            victim.evict_deadline = deadline
+            victim.evict_signaled_at = now
+            victim.evict_credit = aging_credit
+            CKPT_SIGNALS_TOTAL.inc(reason=reason)
+            if self._wakeup is not None:
+                self._wakeup(victim.key)
+            return EVICT_SIGNALED
+        # 3b. Evict the whole gang — a partial eviction would leave exactly
+        #     the stranded half-slice this subsystem exists to prevent.
         for pod in pods:
             try:
                 self.client.delete(
@@ -682,23 +831,88 @@ class GangScheduler:
                 )
             except NotFound:
                 continue
-        # 4. Refund and requeue as a gang, keeping the original enqueue
-        #    time (aging credit) so the victim re-admits ahead of later
-        #    arrivals of its own class; migrations add an extra credit on
-        #    top (the eviction was the cluster's fault).
+        self._requeue_evicted(victim, aging_credit)
+        return EVICT_DONE
+
+    def _finish_evict_locked(self, victim: Gang) -> str:
+        """Complete a pending graceful eviction: once every pod acked the
+        signal generation — or the grace deadline passed — run the held
+        deletion loop, refund capacity, and requeue the gang. Returns
+        EVICT_PENDING while the barrier still holds."""
+        now = time.time()
+        gen = victim.evict_gen or 0
+        acked = self.ckpt is not None and self.ckpt.barrier_acked(
+            victim.key, gen, victim.pod_count
+        )
+        if (
+            not acked
+            and victim.evict_deadline is not None
+            and now < victim.evict_deadline
+        ):
+            return EVICT_PENDING
+        try:
+            pods = self._list_gang_pods(victim)
+        except ApiError:
+            return EVICT_PENDING  # retried by the next sync / health poll
+        waited = now - (victim.evict_signaled_at or now)
+        if acked:
+            CKPT_BARRIER_SECONDS.observe(waited, result="acked")
+            self._event(
+                victim, EVENT_CKPT_ACKED,
+                f"all {victim.pod_count} pod(s) acked the checkpoint "
+                f"signal after {waited:.1f}s; evicting", warning=False,
+            )
+        else:
+            # Grace expired with no (complete) ack: evict anyway and mark
+            # the job CheckpointSkipped — losing bounded work beats
+            # holding preemption/migration hostage to a mute workload.
+            CKPT_BARRIER_SECONDS.observe(waited, result="expired")
+            if self.ckpt is not None:
+                self.ckpt.note_skipped(victim.namespace, victim.name, gen)
+            self._event(
+                victim, EVENT_CKPT_SKIPPED,
+                f"checkpoint grace ({waited:.1f}s) expired with no ack; "
+                "evicting anyway", warning=True,
+            )
+        for pod in pods:
+            try:
+                self.client.delete(
+                    objects.PODS, victim.namespace, objects.name_of(pod)
+                )
+            except NotFound:
+                continue
+        self._requeue_evicted(victim, victim.evict_credit)
+        # Retire the barrier record (merge-patch null deletes). Best-
+        # effort: a failure leaves stale keys, which are only ever
+        # consulted together with state=queued AND live pods — a
+        # combination this completed deletion loop just removed.
+        self._persist(victim.namespace, victim.name, {
+            ckpt_protocol.JOB_SIGNAL_GEN: None,
+            ckpt_protocol.JOB_EVICT_DEADLINE: None,
+        })
+        return EVICT_DONE
+
+    def _requeue_evicted(self, victim: Gang, aging_credit: float) -> None:
+        """Refund and requeue as a gang, keeping the original enqueue
+        time (aging credit) so the victim re-admits ahead of later
+        arrivals of its own class; migrations add an extra credit on
+        top (the eviction was the cluster's fault)."""
         self.placer.release(victim.placements)
         self.ledger.refund(victim)
         victim.placements = []
         victim.state = STATE_QUEUED
         victim.admitted_at = None
         victim.requeues += 1
+        victim.evict_gen = None
+        victim.evict_deadline = None
+        victim.evict_signaled_at = None
+        victim.evict_credit = 0.0
         if aging_credit:
             victim.enqueued_at -= aging_credit
         self._admitted.pop(victim.key, None)
         self.queue.add(victim)
         if self._wakeup is not None:
             self._wakeup(victim.key)
-        return True
 
     def _forget(self, gang: Gang) -> None:
         if gang.state == STATE_ADMITTED:
@@ -727,15 +941,16 @@ class GangScheduler:
         self,
         namespace: str,
         name: str,
-        annotations: dict[str, str],
+        annotations: dict[str, Any],
         typed: TPUJob | None = None,
     ) -> bool:
-        """Merge-patch annotations onto the job. Returns False on failure
-        (a vanished job, an apiserver error) so callers for whom the
-        persisted state is a prerequisite — admission, eviction — can
-        abort instead of diverging from what a restart would recover.
-        When the caller holds the typed object, its RV is refreshed so the
-        sync's later status write does not self-conflict."""
+        """Merge-patch annotations onto the job (a None value deletes the
+        key, RFC 7386). Returns False on failure (a vanished job, an
+        apiserver error) so callers for whom the persisted state is a
+        prerequisite — admission, eviction — can abort instead of
+        diverging from what a restart would recover. When the caller holds
+        the typed object, its RV is refreshed so the sync's later status
+        write does not self-conflict."""
         if self.client is None:
             return True
         try:
@@ -749,7 +964,11 @@ class GangScheduler:
             )
             return False
         if typed is not None:
-            typed.metadata.annotations.update(annotations)
+            for k, v in annotations.items():
+                if v is None:
+                    typed.metadata.annotations.pop(k, None)
+                else:
+                    typed.metadata.annotations[k] = v
             typed.metadata.resource_version = str(
                 objects.meta(patched).get("resourceVersion", "")
             )

@@ -114,6 +114,17 @@ class Gang:
     # charges the new placement. Cleared by the next reconcile that
     # observes zero pods.
     pending_cleanup: bool = False
+    # Graceful-eviction barrier (ckpt coordination, scheduler/core.py):
+    # set while the gang has been checkpoint-signaled (state=queued +
+    # signal-gen + deadline persisted on the job) but its pods are HELD
+    # until every pod acks the generation or the deadline passes. The gang
+    # stays admitted in memory — capacity is only refunded once the
+    # deletion loop actually runs. A successor controller recovers the
+    # same barrier from the job annotations, not from these fields.
+    evict_gen: int | None = None
+    evict_deadline: float | None = None
+    evict_signaled_at: float | None = None
+    evict_credit: float = 0.0
     # Filled at admission: one placement per SliceRequest (see placement.py).
     placements: list[Any] = field(default_factory=list)
 

@@ -19,12 +19,39 @@ from typing import Any
 
 import jax
 
+from tf_operator_tpu.ckpt import protocol as ckpt_protocol
+
+
+def resume_min_step() -> int | None:
+    """The operator-injected resume contract (TPU_RESUME_STEP): the last
+    checkpoint step the operator saw acked before this pod was (re)placed.
+    Pass it to restore_or_init(min_step=...) so a follower-cached step
+    list can never resume below what is known durable."""
+    raw = os.environ.get(ckpt_protocol.ENV_RESUME_STEP)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def injected_dir() -> str | None:
+    """The operator-injected checkpoint directory (TPU_CKPT_DIR), if any."""
+    return os.environ.get(ckpt_protocol.ENV_CKPT_DIR) or None
+
 
 class CheckpointManager:
     """Thin orbax CheckpointManager wrapper bound to one train state shape.
 
     save() is async (orbax background thread); close() drains pending
     writes. Directory layout is orbax-standard: {dir}/{step}/...
+
+    Checkpoint coordination: when ``ack_path`` is set (defaulting to the
+    operator-injected $TPU_CKPT_ACK_FILE), ``ack()``/``maybe_ack()`` write
+    the durable-save report the local executor lifts into pod annotations
+    (ckpt/protocol.py) — the worker's leg of the operator's checkpoint
+    registry and graceful-eviction barrier.
     """
 
     def __init__(
@@ -33,11 +60,18 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        ack_path: str | None = None,
     ) -> None:
         import orbax.checkpoint as ocp
 
         self._dir = os.path.abspath(directory)
         os.makedirs(self._dir, exist_ok=True)
+        self.ack_path = (
+            ack_path
+            if ack_path is not None
+            else os.environ.get(ckpt_protocol.ENV_ACK_FILE)
+        )
+        self._last_acked: int | None = None
         self._mgr = ocp.CheckpointManager(
             self._dir,
             options=ocp.CheckpointManagerOptions(
@@ -64,9 +98,17 @@ class CheckpointManager:
         """Queue an async save of the state pytree at ``step``."""
         import orbax.checkpoint as ocp
 
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(state), force=force
-        )
+        try:
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(state), force=force
+            )
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            # A force=True save of a step that is already saved (or still
+            # committing): the checkpoint the caller wants IS there —
+            # orbax just refuses to overwrite. The eviction-signal path
+            # (periodic save then forced save of the same step) hits this
+            # whenever the signal lands inside a save interval.
+            return False
 
     def restore(self, step: int | None, target: Any) -> Any:
         """Restore ``step`` (or the latest) into the target's structure.
@@ -91,15 +133,28 @@ class CheckpointManager:
             step, args=ocp.args.StandardRestore(abstract)
         )
 
-    def restore_or_init(self, state: Any) -> tuple[Any, int]:
+    def restore_or_init(
+        self, state: Any, min_step: int | None = None
+    ) -> tuple[Any, int]:
         """Resume from the latest checkpoint if one exists.
 
         Returns (state, next_step): the restored state and the step to
         continue from (0 when starting fresh). The kill-and-resume entry
         point used by example workloads under the operator's restart
         policies.
+
+        ``min_step`` is the operator's resume contract (TPU_RESUME_STEP):
+        the step it knows was acked durable. If the manager's cached step
+        list shows less — the FOLLOWER caveat: orbax caches the step list,
+        and a directory another process (the evicted predecessor) wrote
+        into is invisible until reload() — the directory is re-read before
+        giving up, so a replacement pod never resumes below the acked step
+        that is actually on disk.
         """
         step = self.latest_step()
+        if min_step is not None and (step is None or step < min_step):
+            self.reload()
+            step = self.latest_step()
         if step is None:
             return state, 0
         return self.restore(step, state), int(step) + 1
@@ -107,6 +162,45 @@ class CheckpointManager:
     def wait(self) -> None:
         """Block until queued async saves are durable."""
         self._mgr.wait_until_finished()
+
+    def ack(self) -> int | None:
+        """Durably ack the newest checkpoint: drain pending async saves,
+        then write the ack file (no-op without one configured). Returns
+        the acked step. This is what an eviction-signal handler calls
+        after its forced save — the operator's barrier releases on it.
+
+        Always REWRITES the file, even when the step is unchanged: the
+        executor's relay treats "the ack file changed after the signal
+        was delivered" as the ack, and a just-drained writer proving an
+        existing step durable is exactly that."""
+        self._mgr.wait_until_finished()
+        step = self._mgr.latest_step()
+        if step is None or not self.ack_path:
+            return None
+        try:
+            ckpt_protocol.write_ack(self.ack_path, int(step), self._dir)
+        except OSError:
+            return None
+        self._last_acked = int(step)
+        return int(step)
+
+    def maybe_ack(self) -> int | None:
+        """Opportunistic ack of the latest COMMITTED step, without
+        draining in-flight saves (orbax finalizes a step atomically, so
+        latest_step never names a half-written checkpoint). Call after
+        periodic save()s: keeps the operator's progress/staleness view
+        fresh at zero synchronization cost."""
+        return self._write_ack(self._mgr.latest_step())
+
+    def _write_ack(self, step: int | None) -> int | None:
+        if step is None or not self.ack_path or step == self._last_acked:
+            return None
+        try:
+            ckpt_protocol.write_ack(self.ack_path, int(step), self._dir)
+        except OSError:
+            return None  # ack is observability; never fail the save path
+        self._last_acked = int(step)
+        return int(step)
 
     def close(self) -> None:
         self._mgr.wait_until_finished()

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Fast checkpoint-coordination smoke: runs the `ckpt`-marked tests in
+isolation (protocol/registry/GC units, the executor ack relay with real
+processes, and the graceful-eviction barrier chaos cases on both cluster
+backends) — the ~30s loop for iterating on tf_operator_tpu/ckpt/ without
+paying for the whole tier-1 run. Mirrors tools/sched_smoke.py and
+tools/health_smoke.py.
+
+    python tools/ckpt_smoke.py             # the smoke subset
+    python tools/ckpt_smoke.py -k barrier  # extra pytest args pass through
+
+Exit code is pytest's. The same tests also run (unmarked-slow, so by
+default) inside the tier-1 command in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_ckpt.py", "tests/test_ckpt_chaos.py",
+        "-m", "ckpt",
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
