@@ -448,6 +448,49 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
         )
 
 
+def bench_serve_continuous(peak_hbm_gbps: float | None) -> None:
+    """Sustained mixed-traffic serving line: subprocess-runs
+    tools/serve_bench.py — seeded open-loop mixed-length schedule through
+    the continuous-batching engine AND the legacy batch-window coalescer
+    — and re-emits its JSON lines (the continuous line's vs_baseline is
+    the speedup over the coalescer). A subprocess so the serving loop's
+    process-global metrics registry starts clean and a wedged run cannot
+    take the bench down; the child inherits the backend (TPU on hardware
+    rounds, CPU elsewhere). peak_hbm is unused — the line's denominator
+    is the coalescer, not the roofline — but the section signature keeps
+    the peak-table plumbing uniform."""
+    del peak_hbm_gbps
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "serve_bench.py")],
+            capture_output=True, text=True,
+            timeout=180 if smoke else 600,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired as exc:
+        # A wedged serving loop must not take the bench down (nor skip
+        # this diagnostic): report and move on.
+        print(f"bench: serve bench timed out after {exc.timeout:.0f}s",
+              file=sys.stderr, flush=True)
+        return
+    emitted = False
+    for raw in proc.stdout.splitlines():
+        if raw.startswith("{"):
+            print(raw, flush=True)
+            emitted = True
+    if proc.returncode != 0 or not emitted:
+        print(
+            f"bench: serve bench rc={proc.returncode}: "
+            f"{proc.stderr[-500:]}",
+            file=sys.stderr, flush=True,
+        )
+
+
 def ensure_bench_records() -> tuple[str, int, int]:
     """(path, record_size, rec_bytes) of the synthetic uint8 image-record
     file at the current bench shapes, creating it if absent. Shared with
@@ -1108,6 +1151,7 @@ _SECTIONS: dict = {
     "resnet_resident": (bench_resnet_resident, chip_peak_tflops, 900.0),
     "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
+    "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }
 

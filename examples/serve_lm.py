@@ -15,15 +15,28 @@ stdlib HTTP server:
 
 temperature=0/omitted is greedy; temperature>0 samples (nucleus-filtered
 when top_p is set — top_p without temperature is a 400, mirroring
-generate()'s own validation). Generation runs the jitted KV-cache decode
-loop (batched single-pass prompt prefill + one-token sampling scan — one
-compile per (batch, prompt_len, num_steps, temperature, top_p)
-combination, so clients sweeping many distinct temperatures pay a
-recompile each). ``--batch-window MS`` coalesces concurrent greedy
-requests of the same shape into ONE batched decode (single-token decode
-is weight-read-bound, so a batch of b amortizes the dominant HBM read
-~b-fold; rows pad to power-of-two buckets to bound compile count;
-sampled requests keep their per-request rng and run solo).
+generate()'s own validation). Two serving engines (``--engine``):
+
+- ``continuous`` (default): the slot-based continuous-batching engine
+  (tf_operator_tpu/serve/): requests join a preallocated slot tensor
+  whenever a slot is free, ONE compiled decode step advances every
+  active slot per iteration, and slots retire independently on
+  num_steps (or a request's ``eos_id``). Sampled requests batch too
+  (per-slot rng reproduces their solo output exactly), occupancy
+  changes never recompile, and token-budgeted chunked prefill
+  (``--prefill-chunk`` + ``--prefill-budget``) interleaves long prompts
+  with decode so TTFT stays short without stalling running requests.
+  ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
+  ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
+  requests finish, queued ones fail fast with a 503 — no hung sockets.
+- ``coalesce``: the legacy lock-step path. Direct per-request decode
+  (one compile per (batch, prompt_len, num_steps, temperature, top_p)
+  combination), optionally with ``--batch-window MS`` coalescing
+  concurrent same-shape greedy requests into one padded batched decode
+  (serve/coalesce.py). Selected automatically when --spec-k /
+  --batch-window / --tp / --int8 ask for paths the continuous engine
+  does not compose with; kept selectable for the exactness matrix.
+
 ``--requests`` bounds the serve
 loop so the process terminates like a job (the operator's Succeeded
 condition); without it the server runs until SIGTERM.
@@ -151,14 +164,48 @@ def main(argv: list[str] | None = None) -> int:
                         "length compiles nothing new. 0 = one-shot "
                         "prefill (compiles per prompt shape)")
     p.add_argument("--batch-window", type=float, default=0.0, metavar="MS",
-                   help="coalesce concurrent greedy /generate requests of "
-                        "the same shape for this many ms and run them as "
-                        "ONE batched decode (single-token decode is "
-                        "weight-read-bound, so a batch of b amortizes the "
-                        "dominant HBM read ~b-fold). 0 = off")
+                   help="legacy engine: coalesce concurrent greedy "
+                        "/generate requests of the same shape for this "
+                        "many ms and run them as ONE batched decode "
+                        "(single-token decode is weight-read-bound, so a "
+                        "batch of b amortizes the dominant HBM read "
+                        "~b-fold). Implies --engine coalesce. 0 = off")
     p.add_argument("--max-batch", type=int, default=8,
-                   help="row cap per coalesced batch (--batch-window)")
+                   help="decode slots of the continuous engine / row cap "
+                        "per coalesced batch (--batch-window)")
+    p.add_argument("--engine", choices=("continuous", "coalesce"),
+                   default=None,
+                   help="serving engine: 'continuous' = slot-based "
+                        "continuous batching (tf_operator_tpu/serve/ — "
+                        "in-flight join/retire, sampled requests batch "
+                        "too, zero recompiles across occupancy); "
+                        "'coalesce' = the legacy direct/batch-window "
+                        "path. Default: continuous, unless --spec-k/"
+                        "--batch-window/--tp/--int8 select the legacy "
+                        "path (solo-decode compositions the continuous "
+                        "engine does not cover)")
+    p.add_argument("--prefill-budget", type=int, default=256,
+                   metavar="TOKENS",
+                   help="continuous engine: max prompt tokens prefilled "
+                        "per serving-loop iteration while slots are "
+                        "decoding (with --prefill-chunk, long prompts "
+                        "stream in across iterations instead of stalling "
+                        "every active request)")
     args = p.parse_args(argv)
+    legacy_flags = [flag for flag, on in (
+        ("--spec-k", bool(args.spec_k)),
+        ("--batch-window", args.batch_window > 0),
+        ("--tp", args.tp > 1),
+        ("--int8", args.int8),
+    ) if on]
+    if args.engine == "continuous" and legacy_flags:
+        p.error(f"--engine continuous does not compose with "
+                f"{'/'.join(legacy_flags)} (those are solo/lock-step "
+                f"decode paths — use --engine coalesce)")
+    if args.engine is None:
+        args.engine = "coalesce" if legacy_flags else "continuous"
+    if args.prefill_budget < 1:
+        p.error("--prefill-budget must be >= 1")
     if args.requests is not None and args.requests < 1:
         p.error("--requests must be >= 1 (omit it to serve until SIGTERM)")
     if args.int8 and args.tp > 1:
@@ -348,130 +395,37 @@ def main(argv: list[str] | None = None) -> int:
     done = threading.Event()
     lock = threading.Lock()  # generate() calls serialized per chip
 
-    class Coalescer:
-        """Batch concurrent same-shape greedy requests into one decode.
-
-        Rows from requests sharing (prompt_len, num_steps) that arrive
-        within the window run as ONE generate() call, padded up to the
-        next power-of-two row count so the set of compiled batch shapes
-        stays small. Greedy-only: batching is output-invariant for
-        argmax decoding, while sampled requests carry per-request rngs
-        and run solo on the direct path."""
-
-        def __init__(self, window_s: float, max_rows: int):
-            self.window_s = window_s
-            self.max_rows = max_rows
-            self.cond = threading.Condition()
-            self.pending: list[dict] = []
-            self.closed = False   # loop exited: no consumer remains
-            self.batches = 0      # stats for /healthz (and tests)
-            self.max_rows_seen = 0
-
-        def submit(self, prompt, num_steps: int):
-            item = {
-                "key": (prompt.shape[1], num_steps),
-                "rows": prompt,
-                "event": threading.Event(),
-                "out": None,
-                "err": None,
-            }
-            with self.cond:
-                if self.closed:
-                    # The batcher has exited (shutdown): failing fast
-                    # beats queueing where no consumer will ever look.
-                    raise RuntimeError("server shutting down")
-                self.pending.append(item)
-                self.cond.notify()
-            if not item["event"].wait(timeout=300.0):
-                raise TimeoutError("coalesced decode timed out")
-            if item["err"] is not None:
-                raise item["err"]
-            return item["out"]
-
-        def _key_rows(self, key) -> int:
-            return sum(p["rows"].shape[0] for p in self.pending
-                       if p["key"] == key)
-
-        def _take_batch(self) -> list[dict]:
-            with self.cond:
-                # Wake exactly on submit()'s notify (or shutdown).
-                self.cond.wait_for(
-                    lambda: self.pending or done.is_set(), timeout=1.0
-                )
-                if not self.pending:
-                    return []
-                key = self.pending[0]["key"]
-                # Hold the window open until the batch fills (or closes).
-                self.cond.wait_for(
-                    lambda: self._key_rows(key) >= self.max_rows
-                    or done.is_set(),
-                    timeout=self.window_s,
-                )
-                take: list[dict] = []
-                total = 0
-                for p in [p for p in self.pending if p["key"] == key]:
-                    n = p["rows"].shape[0]
-                    if take and total + n > self.max_rows:
-                        break
-                    take.append(p)
-                    total += n
-                for p in take:
-                    self.pending.remove(p)
-            return take
-
-        def loop(self):
-            # Keep draining after shutdown begins: requests already
-            # queued must be answered (the direct path serves its
-            # in-flight requests too), never left to hang in submit().
-            try:
-                self._loop()
-            finally:
-                # Whatever is left when the consumer stops (including a
-                # crash) is answered with an error, never abandoned.
-                with self.cond:
-                    self.closed = True
-                    leftovers, self.pending = self.pending, []
-                for p in leftovers:
-                    p["err"] = RuntimeError("server shutting down")
-                    p["event"].set()
-
-        def _loop(self):
-            while not done.is_set() or self.pending:
-                batch = self._take_batch()
-                if not batch:
-                    continue
-                try:
-                    num_steps = batch[0]["key"][1]
-                    rows = jnp.concatenate(
-                        [p["rows"] for p in batch], axis=0)
-                    k = rows.shape[0]
-                    bucket = 1
-                    while bucket < k:
-                        bucket *= 2
-                    if bucket > k:  # pad: bounded set of batch shapes
-                        rows = jnp.concatenate(
-                            [rows, jnp.zeros((bucket - k, rows.shape[1]),
-                                             rows.dtype)], axis=0)
-                    with lock:
-                        out = decode_greedy(rows, num_steps)
-                    self.batches += 1
-                    self.max_rows_seen = max(self.max_rows_seen, k)
-                    at = 0
-                    for p in batch:
-                        n = p["rows"].shape[0]
-                        p["out"] = out[at:at + n]
-                        at += n
-                except Exception as exc:  # noqa: BLE001 — a failed batch
-                    # must answer its clients AND leave the loop alive.
-                    for p in batch:
-                        p["err"] = exc
-                for p in batch:
-                    p["event"].set()
-
     coalescer = None
     batcher_thread = None
-    if args.batch_window > 0:
-        coalescer = Coalescer(args.batch_window / 1e3, args.max_batch)
+    engine_sched = None
+    if args.engine == "continuous":
+        from tf_operator_tpu.serve.engine import ContinuousEngine
+        from tf_operator_tpu.serve.scheduler import ContinuousScheduler
+
+        engine_sched = ContinuousScheduler(
+            ContinuousEngine(
+                cfg, params, max_slots=args.max_batch,
+                prefill_chunk=(args.prefill_chunk or None),
+            ),
+            prefill_tokens_per_step=args.prefill_budget,
+            # Streaming requests bypass the engine and share the chip:
+            # one lock serializes both decode paths.
+            device_lock=lock,
+        ).start()
+        print(f"serve_lm: continuous batching "
+              f"(slots {args.max_batch}, prefill chunk "
+              f"{args.prefill_chunk or 'one-shot'}, prefill budget "
+              f"{args.prefill_budget} tok/iter)", flush=True)
+    elif args.batch_window > 0:
+        from tf_operator_tpu.serve.coalesce import Coalescer
+
+        def coalesced_decode(rows, num_steps: int):
+            with lock:
+                return decode_greedy(rows, num_steps)
+
+        coalescer = Coalescer(
+            args.batch_window / 1e3, args.max_batch, coalesced_decode, done
+        )
         batcher_thread = threading.Thread(target=coalescer.loop, daemon=True)
         batcher_thread.start()
         print(f"serve_lm: coalescing greedy requests "
@@ -492,7 +446,14 @@ def main(argv: list[str] | None = None) -> int:
 
         def do_GET(self):
             if self.path == "/healthz":
-                payload = {"ok": True, "served": served}
+                payload = {"ok": True, "served": served,
+                           "engine": args.engine}
+                if engine_sched is not None:
+                    payload["active_slots"] = engine_sched.engine.active_slots
+                    payload["queue_depth"] = engine_sched.queue_depth
+                    payload["requests_done"] = engine_sched.requests_done
+                    payload["tokens_generated"] = \
+                        engine_sched.tokens_generated
                 if coalescer is not None:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
@@ -502,6 +463,20 @@ def main(argv: list[str] | None = None) -> int:
                     payload["spec_rounds"] = spec_stats["rounds"]
                     payload["spec_tokens"] = spec_stats["tokens"]
                 self._json(200, payload)
+            elif self.path == "/debug/serve" and engine_sched is not None:
+                # The same payload serve/httpapi.py mounts on an operator
+                # ApiServer — one shape for dashboards either way.
+                self._json(200, engine_sched.debug_snapshot())
+            elif self.path == "/metrics":
+                from tf_operator_tpu.runtime.metrics import REGISTRY
+
+                body = REGISTRY.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "unknown path"})
 
@@ -590,7 +565,50 @@ def main(argv: list[str] | None = None) -> int:
                         print(f"serve_lm: stream aborted: {exc!r}",
                               file=sys.stderr, flush=True)
                     return
-                if coalescer is not None and not kw:
+                if engine_sched is not None:
+                    # Continuous engine: greedy AND sampled requests join
+                    # the slot batch (per-slot rng reproduces each row's
+                    # solo output exactly). Multi-row prompts split into
+                    # per-row requests — rows are independent streams to
+                    # a slot engine — and reassemble in order. An
+                    # optional "eos_id" retires a row early.
+                    import numpy as _np
+
+                    eos_id = req.get("eos_id")
+
+                    def _row(i):
+                        return engine_sched.submit(
+                            _np.asarray(prompt[i:i + 1]), num_steps,
+                            temperature=temperature,
+                            top_p=(None if top_p is None
+                                   else float(top_p)),
+                            # Per-row seed offset: rows are independent
+                            # slot requests, and seed+i keeps multi-row
+                            # sampled rows distinct (the legacy batched
+                            # generate drew independent rows from one
+                            # key) while row 0 still reproduces the
+                            # single-row request for the same seed.
+                            seed=int(req.get("seed", 0)) + i,
+                            eos_id=(None if eos_id is None
+                                    else int(eos_id)),
+                        )[0].tolist()
+
+                    if prompt.shape[0] == 1:
+                        out = [_row(0)]
+                    else:
+                        # Rows decode concurrently (submit blocks per
+                        # request; serializing them would run the batch
+                        # one row at a time). Pool capped at the slot
+                        # count: extra threads could only park in the
+                        # queue anyway, and an uncapped pool would spawn
+                        # one OS thread per row of an arbitrary request.
+                        from concurrent.futures import ThreadPoolExecutor
+
+                        with ThreadPoolExecutor(
+                            min(prompt.shape[0], args.max_batch)
+                        ) as ex:
+                            out = list(ex.map(_row, range(prompt.shape[0])))
+                elif coalescer is not None and not kw:
                     out = coalescer.submit(prompt, num_steps)
                 elif not kw:
                     with lock:
@@ -616,9 +634,18 @@ def main(argv: list[str] | None = None) -> int:
                                 cfg, params, prompt,
                                 num_steps=num_steps, **kw
                             )
-                self._json(200, {"tokens": out.tolist()})
+                self._json(200, {
+                    "tokens": out if isinstance(out, list) else out.tolist()
+                })
             except Exception as exc:  # noqa: BLE001 — client-visible error
-                self._json(400, {"error": repr(exc)})
+                from tf_operator_tpu.serve.scheduler import ShuttingDown
+
+                if isinstance(exc, ShuttingDown):
+                    # The request was fine; the server is draining. 503
+                    # (retryable elsewhere), never a hung socket.
+                    self._json(503, {"error": repr(exc)})
+                else:
+                    self._json(400, {"error": repr(exc)})
                 return
             # Budget accounting under the lock: concurrent handler threads
             # would otherwise lose increments and never trip the budget.
@@ -636,6 +663,18 @@ def main(argv: list[str] | None = None) -> int:
     threading.Thread(target=server.serve_forever, daemon=True).start()
     done.wait()
     server.shutdown()
+    if engine_sched is not None:
+        # The ckpt/eviction SIGTERM drain: admitted requests finish their
+        # decode, queued ones are answered 503 NOW — and main holds the
+        # process open (handler threads are daemons) until the loop
+        # confirms the drain, plus a beat for the response writes.
+        import time as _time
+
+        engine_sched.stop(timeout=60.0)
+        _time.sleep(0.2)
+        print(f"serve_lm: engine drained "
+              f"({engine_sched.requests_done} request(s), "
+              f"{engine_sched.tokens_generated} token(s))", flush=True)
     if batcher_thread is not None:
         # The batcher loop drains queued requests after done is set, but
         # its thread (and the handler threads waiting in submit()) are

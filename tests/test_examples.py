@@ -670,6 +670,105 @@ def test_serve_lm_drains_queued_requests_on_shutdown():
         proc.wait(timeout=15)
 
 
+def test_serve_lm_continuous_drains_on_sigterm():
+    """The continuous engine's SIGTERM drain (the ckpt/eviction signal):
+    the admitted in-flight request finishes with its full answer, the
+    queued one (no free slot — --max-batch 1) gets a fast 503 instead of
+    a hung socket, and the process exits 0."""
+    import json as _json
+    import signal as _signal
+    import subprocess
+    import threading as _th
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+    )
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
+         "--port", str(port), "--train-steps", "60",
+         "--max-seq-len", "512",
+         "--engine", "continuous", "--max-batch", "1"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        wait_server_ready(proc, port)
+
+        def ask(tokens, num_steps, timeout):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=_json.dumps(
+                    {"tokens": [tokens], "num_steps": num_steps}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return _json.loads(resp.read())["tokens"]
+
+        ask([1, 2, 3, 4], 2, 180)  # warm every executable
+
+        inflight: dict = {}
+        queued: dict = {}
+
+        def first():
+            try:
+                # Long enough that the drain (SIGTERM -> server
+                # shutdown -> scheduler stop) lands while this request
+                # still owns the slot — a short request could finish and
+                # let the queued one be served before stop() runs.
+                inflight["tokens"] = ask([5, 6, 7, 8], 400, 180)
+            except Exception as exc:  # noqa: BLE001
+                inflight["err"] = repr(exc)
+
+        def second():
+            try:
+                queued["tokens"] = ask([9, 10, 11, 12], 4, 60)
+            except urllib.error.HTTPError as e:
+                queued["code"] = e.code
+            except Exception as exc:  # noqa: BLE001
+                queued["err"] = repr(exc)
+
+        t1 = _th.Thread(target=first)
+        t1.start()
+        # Deterministic trigger: the long request owns the single slot...
+        deadline = _time.monotonic() + 30
+        health: dict = {}
+        while _time.monotonic() < deadline:
+            health = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+            if health.get("active_slots", 0) >= 1:
+                break
+            _time.sleep(0.02)
+        assert health.get("active_slots", 0) >= 1, health
+        t2 = _th.Thread(target=second)
+        t2.start()
+        # ...and the short one is parked in the queue before the signal.
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            health = _json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+            if health.get("queue_depth", 0) >= 1:
+                break
+            _time.sleep(0.02)
+        assert health.get("queue_depth", 0) >= 1, health
+        proc.send_signal(_signal.SIGTERM)
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        assert inflight.get("tokens") and len(inflight["tokens"][0]) == 400, \
+            inflight
+        assert queued.get("code") == 503, queued
+        assert proc.wait(timeout=30) == 0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+    out_log = proc.stdout.read() if proc.stdout else ""
+    assert "engine drained" in out_log, out_log
+
+
 def test_dist_mnist_evaluator_role_follows_checkpoints(operator, tmp_path):
     """Worker + Evaluator job: the worker trains and checkpoints; the
     evaluator replica (excluded from the rendezvous, role from TF_CONFIG)

@@ -1,0 +1,229 @@
+"""Continuous-batching engine exactness pins (f32 CPU): greedy output
+bit-identical to solo ``generate`` at every occupancy — solo, partial,
+full, join-mid-decode, retire-mid-decode, slot reuse — sampled requests
+reproducing their solo per-request-rng stream exactly, and ZERO decode-
+step recompiles across occupancy changes after warmup."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    generate,
+)
+from tf_operator_tpu.serve.engine import ChunkedPrefill, ContinuousEngine
+from tf_operator_tpu.serve.kvcache import SlotAllocator
+
+pytestmark = pytest.mark.serve
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def prompt_of(p: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, (1, p)
+    ).astype(np.int32)
+
+
+def solo(params, prompt, steps, *, temperature=0.0, top_p=None, seed=0):
+    """The oracle: plain generate, per request, exactly as the direct
+    serving path would run it."""
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt), steps, **kw)
+    )[0]
+
+
+def drive(engine: ContinuousEngine, reqs: dict, script: list) -> dict:
+    """Scripted engine run: reqs[name] = (prompt, steps, temp, top_p,
+    seed); script entries are ("join", name) | ("steps", n). Joins are
+    deterministic (lowest free slot); a slot retires the step its
+    request completes — so the matrix covers join and retire at exact
+    step boundaries. Returns name -> generated token list."""
+    owner: dict[int, str] = {}
+    left: dict[int, int] = {}
+    out = {name: [] for name in reqs}
+    for op, arg in script:
+        if op == "join":
+            prompt, steps, t, tp, seed = reqs[arg]
+            slot = engine.join(
+                jnp.asarray(prompt), num_steps=steps, temperature=t,
+                top_p=tp, seed=seed,
+            )
+            assert slot is not None, f"no free slot for {arg}"
+            owner[slot], left[slot] = arg, steps
+        else:
+            for _ in range(arg):
+                if not owner:
+                    break
+                toks = engine.step()
+                for slot in list(owner):
+                    out[owner[slot]].append(int(toks[slot]))
+                    left[slot] -= 1
+                    if left[slot] == 0:
+                        engine.retire(slot)
+                        del owner[slot], left[slot]
+    assert not owner, f"script left requests unfinished: {owner}"
+    return out
+
+
+MATRIX_REQS = {
+    # name: (prompt_len_seed, steps, temperature, top_p, seed)
+    "solo_a": (prompt_of(4, 1), 8, 0.0, None, 0),
+    "join_b": (prompt_of(7, 2), 6, 0.0, None, 0),
+    "samp_c": (prompt_of(3, 3), 10, 0.9, None, 11),
+    "nucl_d": (prompt_of(5, 4), 5, 0.7, 0.8, 7),
+    "reuse_e": (prompt_of(9, 5), 4, 0.0, None, 0),
+    "tail_f": (prompt_of(6, 6), 12, 0.0, None, 0),
+}
+# Occupancy walk on 4 slots: 1 → 3 (joins mid-decode) → 4 (full) →
+# retires mid-decode → slot reuse → drain.
+MATRIX_SCRIPT = [
+    ("join", "solo_a"), ("steps", 3),
+    ("join", "join_b"), ("join", "samp_c"), ("steps", 2),
+    ("join", "nucl_d"), ("steps", 4),
+    ("join", "reuse_e"), ("join", "tail_f"),
+    ("steps", 30),
+]
+
+
+@pytest.mark.parametrize("prefill_chunk", [None, 4])
+def test_engine_bit_identical_to_solo_generate(params, prefill_chunk):
+    """THE tentpole pin: every request's engine output — greedy AND
+    sampled (incl. nucleus) — equals its solo generate output
+    bit-for-bit, across the full occupancy walk, under one-shot AND
+    chunked prefill; and the decode step compiled exactly once."""
+    engine = ContinuousEngine(
+        CFG, params, max_slots=4, prefill_chunk=prefill_chunk
+    )
+    got = drive(engine, MATRIX_REQS, MATRIX_SCRIPT)
+    for name, (prompt, steps, t, tp, seed) in MATRIX_REQS.items():
+        want = solo(params, prompt, steps, temperature=t, top_p=tp,
+                    seed=seed)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), want, err_msg=name
+        )
+    # Zero recompiles after the constructor's warmup (at this width the
+    # warmup itself is a single executable).
+    assert engine.decode_step_compiles == engine.warmup_compiles == 1
+
+
+def test_zero_recompiles_across_occupancy_and_sampling_mix(params):
+    """After the first step, joins/retires/occupancy changes AND new
+    sampling parameter values (temperature/top_p are data, not compile
+    constants) never retrace the decode step."""
+    engine = ContinuousEngine(CFG, params, max_slots=3)
+    s0 = engine.join(jnp.asarray(prompt_of(4, 1)), num_steps=30)
+    engine.step()
+    assert engine.decode_step_compiles == engine.warmup_compiles == 1
+    for i, (t, tp) in enumerate(
+        [(0.0, None), (0.5, None), (1.3, 0.9), (0.01, 0.1)]
+    ):
+        slot = engine.join(
+            jnp.asarray(prompt_of(3 + i, 10 + i)), num_steps=2,
+            temperature=t, top_p=tp, seed=i,
+        )
+        engine.step()
+        engine.step()
+        engine.retire(slot)
+    engine.retire(s0)
+    # Occupancy zero → join again (slot reuse) → still one executable.
+    slot = engine.join(jnp.asarray(prompt_of(5, 50)), num_steps=1)
+    engine.step()
+    engine.retire(slot)
+    assert engine.decode_step_compiles == 1
+
+
+def test_zero_recompiles_at_serving_width(params):
+    """The serve_lm default width (d_model 128, vocab 256) is where the
+    donated-buffer layout flip was observed (one extra compile at the
+    SECOND step): the constructor's warmup must absorb it — compile
+    count frozen at warmup_compiles across real joins/steps/retires."""
+    cfg = TransformerConfig(
+        vocab_size=256, d_model=128, n_heads=4, n_layers=2, d_ff=256,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    wide_params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ContinuousEngine(cfg, wide_params, max_slots=4)
+    c0 = engine.warmup_compiles
+    for i in range(3):
+        slot = engine.join(
+            jnp.asarray(prompt_of(4 + i, 30 + i)), num_steps=2,
+        )
+        engine.step()
+        engine.step()
+        engine.retire(slot)
+    assert engine.decode_step_compiles == c0
+
+
+def test_join_returns_none_when_full_and_validates_budget(params):
+    engine = ContinuousEngine(CFG, params, max_slots=2)
+    assert engine.join(jnp.asarray(prompt_of(4, 1)), num_steps=2) == 0
+    assert engine.join(jnp.asarray(prompt_of(4, 2)), num_steps=2) == 1
+    assert engine.join(jnp.asarray(prompt_of(4, 3)), num_steps=2) is None
+    with pytest.raises(ValueError, match="max_seq_len"):
+        engine.validate_request(60, 10)
+    with pytest.raises(ValueError, match="num_steps"):
+        engine.validate_request(4, 0)
+    with pytest.raises(ValueError, match="top_p"):
+        engine.retire(0)
+        engine.join(jnp.asarray(prompt_of(4, 4)), num_steps=2, top_p=0.9)
+    # The failed join must not leak its slot.
+    assert engine.alloc.free == 1
+
+
+def test_chunked_prefill_resumable_matches_one_shot(params):
+    """Feeding a prompt in budgeted slices across calls lands the same
+    cache/logits as running all chunks at once: the interleaving knob
+    changes latency shape, never values."""
+    prompt = jnp.asarray(prompt_of(11, 9))
+    a = ChunkedPrefill(CFG, params, prompt, chunk=4)
+    while not a.done:
+        assert a.feed(1) == 4
+    cache_a, logits_a = a.result()
+    b = ChunkedPrefill(CFG, params, prompt, chunk=4)
+    b.feed(b.n_chunks)
+    cache_b, logits_b = b.result()
+    np.testing.assert_array_equal(np.asarray(logits_a),
+                                  np.asarray(logits_b))
+    for la, lb in zip(jax.tree.leaves(cache_a), jax.tree.leaves(cache_b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    with pytest.raises(RuntimeError, match="not finished"):
+        ChunkedPrefill(CFG, params, prompt, chunk=4).result()
+
+
+def test_slot_allocator_contract():
+    alloc = SlotAllocator(3)
+    assert [alloc.acquire() for _ in range(3)] == [0, 1, 2]
+    assert alloc.acquire() is None
+    alloc.release(1)
+    assert alloc.free == 1 and alloc.in_use == 2
+    assert alloc.acquire() == 1  # lowest-free, deterministic
+    with pytest.raises(ValueError, match="double-released"):
+        alloc.release(2)
+        alloc.release(2)
+    with pytest.raises(ValueError, match="out of range"):
+        alloc.release(7)
+    assert alloc.high_water == 3
+    with pytest.raises(ValueError, match="max_slots"):
+        SlotAllocator(0)

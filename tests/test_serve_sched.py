@@ -1,0 +1,250 @@
+"""The continuous-batching serving loop: threaded mixed traffic stays
+bit-exact, EOS retires early, drain answers every socket (in-flight
+finishes, queued 503s), metrics move, and the serve bench emits its
+BENCH line (structural asserts only — no wall-clock in any assert)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    generate,
+)
+from tf_operator_tpu.runtime.metrics import (
+    SERVE_REQUESTS_TOTAL,
+    SERVE_TOKENS_TOTAL,
+    SERVE_TTFT_SECONDS,
+)
+from tf_operator_tpu.serve.engine import ContinuousEngine
+from tf_operator_tpu.serve.scheduler import (
+    ContinuousScheduler,
+    ServeRequest,
+    ShuttingDown,
+)
+
+pytestmark = pytest.mark.serve
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def prompt_of(p: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, (1, p)
+    ).astype(np.int32)
+
+
+def solo(params, prompt, steps, *, temperature=0.0, top_p=None, seed=0):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt), steps, **kw)
+    )
+
+
+def test_threaded_mixed_traffic_bit_exact(params):
+    """Concurrent mixed-shape greedy AND sampled requests through the
+    loop (chunked prefill interleaved) all reproduce their solo
+    outputs; the registry counters advance by the served amounts."""
+    ok_before = SERVE_REQUESTS_TOTAL.value(outcome="ok")
+    tokens_before = SERVE_TOKENS_TOTAL.value()
+    ttft_before = SERVE_TTFT_SECONDS.snapshot()
+    engine = ContinuousEngine(CFG, params, max_slots=4, prefill_chunk=4)
+    sched = ContinuousScheduler(engine, prefill_tokens_per_step=8).start()
+    reqs = [
+        (prompt_of(4, 1), 8, 0.0, None, 0),
+        (prompt_of(7, 2), 6, 0.0, None, 0),
+        (prompt_of(3, 3), 10, 0.9, None, 11),
+        (prompt_of(5, 4), 5, 0.7, 0.8, 7),
+        (prompt_of(9, 5), 4, 0.0, None, 0),
+        (prompt_of(6, 6), 12, 0.0, None, 0),
+    ]
+    results: dict[int, np.ndarray] = {}
+
+    def client(i):
+        prompt, steps, t, tp, seed = reqs[i]
+        results[i] = sched.submit(
+            prompt, steps, temperature=t, top_p=tp, seed=seed
+        )
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        total = 0
+        for i, (prompt, steps, t, tp, seed) in enumerate(reqs):
+            want = solo(params, prompt, steps, temperature=t, top_p=tp,
+                        seed=seed)
+            np.testing.assert_array_equal(results[i], want,
+                                          err_msg=f"request {i}")
+            total += steps
+        assert engine.decode_step_compiles == engine.warmup_compiles
+        assert SERVE_REQUESTS_TOTAL.value(outcome="ok") == (
+            ok_before + len(reqs)
+        )
+        assert SERVE_TOKENS_TOTAL.value() == tokens_before + total
+        ttft_count = sum(
+            c - b for c, b in zip(SERVE_TTFT_SECONDS.snapshot(),
+                                  ttft_before)
+        )
+        assert ttft_count == len(reqs)
+        assert 0.0 < sched.mean_occupancy <= 1.0
+    finally:
+        sched.stop(timeout=30)
+
+
+def test_eos_retires_slot_early(params):
+    """A request carrying eos_id stops at the EOS token (inclusive) and
+    frees its slot for the next request."""
+    engine = ContinuousEngine(CFG, params, max_slots=1)
+    sched = ContinuousScheduler(engine).start()
+    try:
+        prompt = prompt_of(5, 42)
+        want = solo(params, prompt, 10)[0]
+        eos = int(want[3])
+        out = sched.submit(prompt, 10, eos_id=eos)
+        k = list(want).index(eos)
+        np.testing.assert_array_equal(out[0], want[:k + 1])
+        # The slot freed: a follow-up request runs on the single slot.
+        out2 = sched.submit(prompt, 4)
+        np.testing.assert_array_equal(out2[0], want[:4])
+    finally:
+        sched.stop(timeout=30)
+
+
+def test_drain_finishes_inflight_rejects_queued(params):
+    """The SIGTERM/eviction drain contract: the admitted request
+    finishes its full decode, the queued one (no slot — max_slots=1)
+    fails fast with ShuttingDown, and post-stop submits are refused."""
+    rejected_before = SERVE_REQUESTS_TOTAL.value(outcome="rejected")
+    engine = ContinuousEngine(CFG, params, max_slots=1)
+    sched = ContinuousScheduler(engine).start()
+    inflight: dict = {}
+    queued: dict = {}
+
+    def first():
+        try:
+            inflight["out"] = sched.submit(prompt_of(4, 1), 40)
+        except Exception as exc:  # noqa: BLE001
+            inflight["err"] = exc
+
+    def second():
+        try:
+            queued["out"] = sched.submit(prompt_of(4, 2), 4)
+        except Exception as exc:  # noqa: BLE001
+            queued["err"] = exc
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    # Deterministic trigger: wait until the first request owns the slot.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and engine.active_slots < 1:
+        time.sleep(0.01)
+    assert engine.active_slots == 1
+    t2 = threading.Thread(target=second)
+    t2.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and sched.queue_depth < 1:
+        time.sleep(0.01)
+    sched.stop(timeout=60)
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert inflight.get("out") is not None and inflight["out"].shape == (
+        1, 40,
+    ), inflight
+    assert isinstance(queued.get("err"), ShuttingDown), queued
+    with pytest.raises(ShuttingDown):
+        sched.submit(prompt_of(4, 3), 2)
+    assert SERVE_REQUESTS_TOTAL.value(outcome="rejected") >= (
+        rejected_before + 1
+    )
+    # The drained output is still exact.
+    np.testing.assert_array_equal(
+        inflight["out"], solo(params, prompt_of(4, 1), 40)
+    )
+
+
+def test_submit_validates_eagerly(params):
+    engine = ContinuousEngine(CFG, params, max_slots=1)
+    sched = ContinuousScheduler(engine)  # no loop needed: all eager
+    with pytest.raises(ValueError, match="max_seq_len"):
+        sched.submit(prompt_of(60, 1), 10)
+    with pytest.raises(ValueError, match="top_p"):
+        sched.submit(prompt_of(4, 1), 2, top_p=0.9)
+    with pytest.raises(ValueError, match="one request row"):
+        ServeRequest(np.zeros((2, 4), np.int32), 2)
+
+
+def test_debug_snapshot_shape(params):
+    engine = ContinuousEngine(CFG, params, max_slots=2)
+    sched = ContinuousScheduler(engine).start()
+    try:
+        sched.submit(prompt_of(4, 1), 3)
+        snap = sched.debug_snapshot()
+        for key in ("engine", "max_slots", "active_slots", "queue_depth",
+                    "decode_step_compiles", "tokens_generated",
+                    "requests_done", "mean_occupancy", "ttft_p50_s",
+                    "draining"):
+            assert key in snap, key
+        assert snap["engine"] == "continuous"
+        assert snap["requests_done"] >= 1
+    finally:
+        sched.stop(timeout=30)
+
+
+def test_serve_bench_emits_structural_line():
+    """tools/serve_bench.py (BENCH_SMOKE shapes): both legs emit JSON,
+    token counts agree across engines (same seeded schedule, greedy —
+    the legs decode the same work), zero errors, zero post-warmup
+    recompiles. Timing fields are present but never asserted."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "serve_bench.py"),
+         "--requests", "8"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    by_metric = {line["metric"]: line for line in lines}
+    cont = by_metric["serve_continuous_tokens_per_sec_mixed"]
+    coal = by_metric["serve_coalesce_tokens_per_sec_mixed"]
+    assert cont["errors"] == 0 and coal["errors"] == 0
+    assert cont["generated_tokens"] == coal["generated_tokens"] > 0
+    assert cont["requests"] == coal["requests"] == 8
+    assert cont["decode_step_compiles"] == 1
+    assert 0.0 < cont["mean_occupancy"] <= 1.0
+    assert cont["vs_baseline"] > 0  # the ratio line is populated
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "steady_occupancy"):
+        assert key in cont, key

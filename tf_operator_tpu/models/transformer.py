@@ -813,17 +813,13 @@ def _prefill(model: "Transformer", params: Any, prompt: jax.Array):
     return updates["cache"], _head_logits(params, hidden[:, -1])
 
 
-def prefill_chunked(
-    cfg: TransformerConfig,
-    params: Any,
-    prompt: jax.Array,
-    chunk: int = 64,
-):
-    """Prompt prefill through ONE fixed-[B, chunk] executable: (cache,
-    last-position logits) for ANY prompt length — where ``_prefill``
-    compiles per prompt shape, a server using this path compiles one
-    prefill chunk once and serves every prompt length with
-    ceil(P/chunk) calls of it.
+class ChunkedPrefill:
+    """Resumable chunked prefill for one prompt: the ``prefill_chunked``
+    loop held as state so a serving loop can interleave a token-budgeted
+    number of chunks between decode iterations instead of stalling for a
+    long prompt (tf_operator_tpu/serve/). ``prefill_chunked`` is this
+    class run to completion — ONE copy of the right-pad, the
+    last-true-position row formula, and the counter rollback.
 
     The last partial chunk is RIGHT-PADDED to the fixed shape: pad
     positions sit after every true position, so no true position ever
@@ -836,27 +832,80 @@ def prefill_chunked(
     greedy decode is unchanged (pinned vs generate in
     tests/test_prefill_chunked.py).
     """
-    p = prompt.shape[1]
-    _validate_prefill_chunk(cfg, p, chunk)
-    n_chunks = -(-p // chunk)
-    padded = n_chunks * chunk
-    init_fn, chunk_fn, head_fn = _prefill_chunk_fns(cfg, int(chunk))
-    if padded > p:
-        prompt = jnp.concatenate(
-            [prompt, jnp.zeros((prompt.shape[0], padded - p),
-                               prompt.dtype)], axis=1,
+
+    def __init__(self, cfg: TransformerConfig, params: Any,
+                 prompt: jax.Array, chunk: int) -> None:
+        self.prompt_len = int(prompt.shape[1])
+        _validate_prefill_chunk(cfg, self.prompt_len, chunk)
+        self.chunk = int(chunk)
+        self.n_chunks = -(-self.prompt_len // self.chunk)
+        self._padded = self.n_chunks * self.chunk
+        if self._padded > self.prompt_len:
+            prompt = jnp.concatenate(
+                [prompt,
+                 jnp.zeros((prompt.shape[0],
+                            self._padded - self.prompt_len),
+                           prompt.dtype)], axis=1,
+            )
+        self._prompt = prompt
+        self._params = params
+        init_fn, self._chunk_fn, self._head_fn = _prefill_chunk_fns(
+            cfg, self.chunk
         )
-    cache = init_fn(params, prompt[:, :1])
-    hidden = None
-    for i in range(n_chunks):
-        cache, hidden = chunk_fn(
-            params, cache, prompt[:, i * chunk:(i + 1) * chunk]
+        self._cache = init_fn(params, prompt[:, :1])
+        self._hidden = None
+        self._at = 0
+
+    @property
+    def done(self) -> bool:
+        return self._at >= self.n_chunks
+
+    def feed(self, max_chunks: int = 1) -> int:
+        """Run up to ``max_chunks`` chunk forwards; returns the number
+        of PROMPT TOKENS processed (the unit a serving loop budgets)."""
+        n = min(max_chunks, self.n_chunks - self._at)
+        for _ in range(n):
+            i = self._at
+            self._cache, self._hidden = self._chunk_fn(
+                self._params,
+                self._cache,
+                self._prompt[:, i * self.chunk:(i + 1) * self.chunk],
+            )
+            self._at += 1
+        return n * self.chunk
+
+    def result(self) -> tuple[Any, jax.Array]:
+        """(cache, last-true-position logits) — call once, after done."""
+        if not self.done:
+            raise RuntimeError("prefill not finished")
+        # True last position sits in the final chunk at row
+        # p-1 - (padded-chunk).
+        logits = self._head_fn(
+            self._params, self._hidden,
+            self.prompt_len - 1 - (self._padded - self.chunk),
         )
-    # True last position sits in the final chunk at row p-1 - (padded-chunk).
-    logits = head_fn(params, hidden, p - 1 - (padded - chunk))
-    if padded > p:
-        cache = set_cache_index(cache, p)
-    return cache, logits
+        cache = self._cache
+        if self._padded > self.prompt_len:
+            cache = set_cache_index(cache, self.prompt_len)
+        return cache, logits
+
+
+def prefill_chunked(
+    cfg: TransformerConfig,
+    params: Any,
+    prompt: jax.Array,
+    chunk: int = 64,
+):
+    """Prompt prefill through ONE fixed-[B, chunk] executable: (cache,
+    last-position logits) for ANY prompt length — where ``_prefill``
+    compiles per prompt shape, a server using this path compiles one
+    prefill chunk once and serves every prompt length with
+    ceil(P/chunk) calls of it. ``ChunkedPrefill`` (above) carries the
+    padding/rollback contract; this is that machine run to completion.
+    """
+    pf = ChunkedPrefill(cfg, params, prompt, chunk)
+    pf.feed(pf.n_chunks)
+    return pf.result()
 
 
 def _validate_prefill_chunk(cfg: TransformerConfig, p: int, chunk: int):

@@ -113,6 +113,9 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # that single-shot measurement; revert trigger is dispatch_auto failing
 # to track direct_bq1024 (i.e. the auto path losing to the direct-dispatch
 # bq1024 leg on the same probe), in which case drop the cap back to 512.
+# The qblock stage now runs at the FRONT of window_autorun's unmeasured
+# set (its old slot sat behind the 3600s bench_full and was never reached
+# in r05), so the next UP window produces this arbitration data first.
 MAX_Q_BLOCK = 1024
 
 

@@ -406,3 +406,55 @@ CKPT_STALE_JOBS = REGISTRY.gauge(
     "tpu_checkpoint_stale_jobs",
     "Running jobs whose checkpoint roll-up exceeds the staleness threshold",
 )
+
+
+# ---------------------------------------------------------------------------
+# Continuous-batching serving metric families (consumed by
+# tf_operator_tpu/serve/scheduler.py and rendered by serve_lm's /metrics).
+# Declared at import for the same full-schema-on-first-scrape reason as the
+# families above: a dashboard pointed at a just-started, still-idle server
+# sees the queue/occupancy series at 0 instead of absent.
+# ---------------------------------------------------------------------------
+
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_serve_queue_depth",
+    "Requests waiting for a free decode slot",
+)
+SERVE_SLOTS_ACTIVE = REGISTRY.gauge(
+    "tpu_serve_active_slots",
+    "Decode slots currently occupied by in-flight requests",
+)
+SERVE_SLOT_CAPACITY = REGISTRY.gauge(
+    "tpu_serve_slot_capacity",
+    "Preallocated decode slots (the engine's max batch)",
+)
+SERVE_REQUESTS_TOTAL = REGISTRY.counter(
+    "tpu_serve_requests_total",
+    "Requests finished by the continuous engine, by outcome "
+    "(ok | error | rejected — rejected is the drain-time 503)",
+    ("outcome",),
+)
+SERVE_TOKENS_TOTAL = REGISTRY.counter(
+    "tpu_serve_generated_tokens_total",
+    "Tokens generated across all slots (the tokens/sec numerator)",
+)
+SERVE_PREFILL_TOKENS_TOTAL = REGISTRY.counter(
+    "tpu_serve_prefill_tokens_total",
+    "Prompt tokens prefilled into slots",
+)
+SERVE_TTFT_SECONDS = REGISTRY.histogram(
+    "tpu_serve_ttft_seconds",
+    "Submit-to-first-generated-token wall time per request",
+)
+SERVE_STEP_SECONDS = REGISTRY.histogram(
+    "tpu_serve_step_seconds",
+    "Serving-loop device iterations by phase: one decode step over the "
+    "slot tensor, or one token-budgeted prefill slice",
+    ("phase",),  # prefill | decode
+)
+SERVE_OCCUPANCY = REGISTRY.histogram(
+    "tpu_serve_batch_occupancy",
+    "Fraction of decode slots active, observed at every decode step — "
+    "the quantity decode throughput is proportional to",
+    buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)

@@ -1,0 +1,48 @@
+"""Continuous-batching LM serving: slot-based KV cache, an occupancy-
+invariant compiled decode step, and the serving loop that joins/retires
+requests mid-stream.
+
+- ``kvcache``: the solo decode cache stacked over a slot axis + the
+  free-slot allocator.
+- ``engine``: ONE compiled decode step over the full slot tensor with
+  per-slot position/length/rng — sampled requests batch too, and
+  occupancy changes never recompile.
+- ``scheduler``: the serving loop — token-budgeted chunked prefill
+  interleaved with decode, admission into free slots, EOS/max-tokens
+  retirement, and the SIGTERM drain (in-flight finishes, queued 503s).
+- ``coalesce``: the legacy same-shape batch-window coalescer
+  (serve_lm --engine coalesce), kept selectable for the exactness
+  matrix and as the bench's comparison leg.
+- ``httpapi``: the /debug/serve endpoint.
+
+Re-exports resolve lazily (PEP 562): importing the package must not
+drag jax into processes that only mount the debug surface.
+
+See docs/serving.md for the architecture, the slot lifecycle, and the
+bench how-to; tools/serve_smoke.py runs the marked test subset.
+"""
+
+_EXPORTS = {
+    "SlotAllocator": "kvcache",
+    "ChunkedPrefill": "engine",
+    "ContinuousEngine": "engine",
+    "ContinuousScheduler": "scheduler",
+    "ServeRequest": "scheduler",
+    "ShuttingDown": "scheduler",
+    "Coalescer": "coalesce",
+    "ServeDebugHandler": "httpapi",
+    "mount_serve": "httpapi",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(
+        importlib.import_module(f"{__name__}.{module}"), name
+    )

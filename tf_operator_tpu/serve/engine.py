@@ -1,0 +1,297 @@
+"""Continuous-batching decode engine: ONE compiled step over the slot
+tensor, occupancy changes free.
+
+The legacy serving paths run lock-step: a batch (coalesced or solo) is
+admitted together, decodes to the longest request's horizon together, and
+retires together — mixed-length traffic decays toward solo throughput
+because finished rows keep riding (and new requests keep waiting) until
+the batch drains. This engine decouples admission from step execution:
+requests JOIN a preallocated slot tensor (serve/kvcache.py) whenever a
+slot is free, decode advances ALL active slots one token per step, and
+slots RETIRE individually on EOS/max-tokens. Single-token decode is
+weight-read-bound, so throughput is proportional to live occupancy — the
+same keep-the-accelerator-busy argument that drives large-batch training.
+
+Mechanics (validated bit-for-bit by tests/test_serve_engine.py):
+
+- The decode step is the SOLO single-token step (models/transformer.py,
+  the same flax module ``generate`` scans) ``jax.vmap``-ed over the slot
+  axis. Every slot carries its own cache row, position counters, logits,
+  sampling parameters, and rng — per-slot math IS the solo math, so
+  greedy output is bit-identical to solo ``generate`` at every occupancy
+  (f32 CPU), and sampled slots reproduce their solo per-request-rng
+  stream exactly. The greedy-only restriction of the legacy coalescer
+  dies here: temperature/top_p are per-slot VALUES, not compile-time
+  constants.
+- All shapes are static in ``max_slots``: joins, retires, and idle slots
+  never change the step's signature, so after the first step there are
+  ZERO decode recompiles (pinned via the jit cache size). Inactive slots
+  execute dead compute — that is the price of the fixed shape, and it is
+  the cheap side of the trade precisely because decode is
+  weight-read-bound: the weight read is shared by all slots regardless.
+- Sampled reproduction: solo ``generate`` draws step keys as
+  ``jax.random.split(rng, num_steps)`` — the schedule depends on
+  num_steps, so each join precomputes its request's full key ladder into
+  a fixed [max_seq_len, 2] buffer and the step gathers key[step_i] per
+  slot. Greedy slots carry zeros and never touch them.
+- Prefill stays a SOLO concern: each joining request prefills alone
+  (one-shot ``_prefill``, or the resumable ``ChunkedPrefill`` over the
+  fixed-chunk executables of ``--prefill-chunk``) and the finished cache
+  is inserted into its slot row — byte-identical to the solo path's
+  cache, which is what makes the join boundary exact.
+
+Thread model: the engine is a device-state machine with NO internal
+locking — the serving loop (serve/scheduler.py) is its single caller;
+tests drive it directly for the deterministic exactness matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import replace
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    ChunkedPrefill,
+    Transformer,
+    TransformerConfig,
+    _nucleus_filter,
+    _prefill,
+    _validate_prefill_chunk,
+)
+from tf_operator_tpu.serve.kvcache import (
+    SlotAllocator,
+    make_insert_fn,
+    mask_inactive_indices,
+    plain_tree,
+    solo_cache_template,
+    stack_slots,
+)
+
+
+class ContinuousEngine:
+    """The slot-tensor decode engine. See the module docstring; the
+    public surface is ``join``/``start_prefill``+``join_prefilled``,
+    ``step``, ``retire``, and the ``decode_step_compiles`` pin."""
+
+    def __init__(self, cfg: TransformerConfig, params: Any,
+                 max_slots: int, *, prefill_chunk: int | None = None) -> None:
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = int(max_slots)
+        self.prefill_chunk = prefill_chunk
+        dcfg = replace(cfg, decode=True, mesh=None, remat=False)
+        self._model = Transformer(dcfg)
+        self.alloc = SlotAllocator(self.max_slots)
+
+        n, v, s = self.max_slots, cfg.vocab_size, cfg.max_seq_len
+        self._cache = stack_slots(solo_cache_template(self._model), n)
+        self._logits = jnp.zeros((n, v), jnp.float32)
+        self._keys = jnp.zeros((n, s, 2), jnp.uint32)
+        self._stepidx = jnp.zeros((n,), jnp.int32)
+        # Host-side per-slot sampling state, passed into every step (tiny
+        # [N] transfers; keeping them host-side means join/retire never
+        # need a device write for them).
+        self._active = np.zeros(n, bool)
+        self._temperature = np.zeros(n, np.float32)
+        self._top_p = np.ones(n, np.float32)
+        self._has_top_p = np.zeros(n, bool)
+
+        self._insert = make_insert_fn()
+        self._prefill_fn = jax.jit(functools.partial(_prefill, self._model))
+        self._step_fn = jax.jit(self._step, donate_argnums=(1, 2))
+        self.steps_total = 0
+        # Warm the decode executable at CONSTRUCTION, twice: the first
+        # step compiles; the second catches XLA's donated-buffer layout
+        # flip (the step's chosen output layout can differ from the
+        # eagerly-built input layout, costing exactly one more compile at
+        # larger widths) so serving traffic never sees a compile. All
+        # slots are inactive — the garbage rows these steps write are
+        # fully overwritten by each join's insert, and the counters are
+        # reset below.
+        for _ in range(2):
+            self.step()
+        self.steps_total = 0
+        self.warmup_compiles = self.decode_step_compiles
+
+    # -- prefill / join ---------------------------------------------------
+
+    def validate_request(self, prompt_len: int, num_steps: int) -> None:
+        """The solo ``generate`` budget, enforced eagerly (a server turns
+        this into a 400 before any device work), plus the chunked-prefill
+        padding budget when that path is configured."""
+        if num_steps < 1:
+            raise ValueError(f"num_steps={num_steps} must be >= 1")
+        if prompt_len < 1:
+            raise ValueError("prompt must have at least one token")
+        if prompt_len + num_steps > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt_len} + steps {num_steps} exceeds "
+                f"max_seq_len {self.cfg.max_seq_len}"
+            )
+        if self.prefill_chunk is not None:
+            _validate_prefill_chunk(
+                self.cfg, prompt_len, self.prefill_chunk
+            )
+
+    def start_prefill(self, prompt: jax.Array) -> ChunkedPrefill | None:
+        """A resumable prefill when the engine is configured for chunked
+        prefill, else None (the caller joins with the prompt directly and
+        the one-shot executable runs inside ``join``)."""
+        if self.prefill_chunk is None:
+            return None
+        return ChunkedPrefill(
+            self.cfg, self.params, prompt, self.prefill_chunk
+        )
+
+    def join(self, prompt: jax.Array, *, num_steps: int,
+             temperature: float = 0.0, top_p: float | None = None,
+             seed: int = 0) -> int | None:
+        """Prefill ``prompt`` solo and join the batch: returns the slot
+        index, or None when fully occupied. Convenience over
+        ``start_prefill`` + ``join_prefilled`` for callers that do not
+        interleave (tests, the bench's coalesce leg)."""
+        self.validate_request(int(prompt.shape[1]), num_steps)
+        if self.alloc.free == 0:
+            return None
+        pf = self.start_prefill(prompt)
+        if pf is None:
+            cache1, logits1 = self._prefill_fn(self.params, prompt)
+        else:
+            while not pf.done:
+                pf.feed(pf.n_chunks)
+            cache1, logits1 = pf.result()
+        return self.join_prefilled(
+            cache1, logits1, prompt_len=int(prompt.shape[1]),
+            num_steps=num_steps, temperature=temperature, top_p=top_p,
+            seed=seed,
+        )
+
+    def join_prefilled(self, cache: Any, logits: jax.Array, *,
+                       prompt_len: int, num_steps: int,
+                       temperature: float = 0.0,
+                       top_p: float | None = None,
+                       seed: int = 0) -> int | None:
+        """Insert a finished solo prefill into a free slot. The slot's
+        first generated token comes from ``logits`` (the last prompt
+        position) at the next ``step`` — exactly the solo recurrence."""
+        self.validate_request(prompt_len, num_steps)
+        slot = self.alloc.acquire()
+        if slot is None:
+            return None
+        keys = np.zeros((self.cfg.max_seq_len, 2), np.uint32)
+        if temperature > 0:
+            # Solo generate's exact key ladder: split(rng, num_steps) —
+            # num_steps-dependent, hence precomputed per request rather
+            # than derivable inside the fixed-shape step.
+            keys[:num_steps] = np.asarray(
+                jax.random.split(jax.random.PRNGKey(seed), num_steps)
+            )
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            self.alloc.release(slot)
+            raise ValueError(f"top_p={top_p} must be in (0, 1]")
+        if top_p is not None and temperature <= 0:
+            self.alloc.release(slot)
+            raise ValueError(
+                "top_p requires temperature > 0 (greedy ignores it)"
+            )
+        state = (self._cache, self._logits, self._keys, self._stepidx)
+        state = self._insert_slot(state, slot, plain_tree(cache), logits,
+                                  keys)
+        self._cache, self._logits, self._keys, self._stepidx = state
+        self._active[slot] = True
+        self._temperature[slot] = max(0.0, float(temperature))
+        self._top_p[slot] = 1.0 if top_p is None else float(top_p)
+        self._has_top_p[slot] = top_p is not None
+        return slot
+
+    def _insert_slot(self, state, slot, cache1, logits1, keys1):
+        cache, logits, keys, stepidx = state
+        cache = self._insert(cache, jnp.int32(slot), cache1)
+        # Small per-slot rows: eager scatter updates (no extra jit).
+        logits = logits.at[slot].set(logits1[0])
+        keys = keys.at[slot].set(jnp.asarray(keys1))
+        stepidx = stepidx.at[slot].set(0)
+        return cache, logits, keys, stepidx
+
+    # -- decode -----------------------------------------------------------
+
+    def _step(self, params, cache, logits, keys, stepidx, active,
+              temperature, top_p, has_top_p):
+        cache = mask_inactive_indices(cache, active)
+        key = keys[
+            jnp.arange(self.max_slots),
+            jnp.clip(stepidx, 0, self.cfg.max_seq_len - 1),
+        ]
+
+        def one(cache1, logits1, key1, temp, tp, has_tp):
+            # The solo sample body (transformer._generate_fn) with the
+            # compile-time temperature/top_p branches turned into traced
+            # selects — values, not executables, so occupancy and
+            # sampling mix never recompile. where(greedy, 1, temp) guards
+            # the division; the greedy lane takes the argmax anyway.
+            greedy = temp <= 0
+            scaled = logits1 / jnp.where(greedy, 1.0, temp)
+            filt = jnp.where(
+                has_tp, _nucleus_filter(scaled[None], tp)[0], scaled
+            )
+            samp = jax.random.categorical(key1, filt[None, :])[0]
+            tok = jnp.where(greedy, logits1.argmax(-1), samp)
+            tok = tok.astype(jnp.int32)
+            nxt, upd = self._model.apply(
+                {"params": params, "cache": cache1}, tok[None, None],
+                mutable=["cache"],
+            )
+            return upd["cache"], nxt[0, 0], tok
+
+        cache, logits, toks = jax.vmap(one)(
+            cache, logits, key, temperature, top_p, has_top_p
+        )
+        return cache, logits, stepidx + 1, toks
+
+    def step(self) -> np.ndarray:
+        """One decode iteration over the WHOLE slot tensor: every active
+        slot advances one token. Returns the [max_slots] int32 token
+        vector (inactive rows are dead compute — ignore them)."""
+        self._cache, self._logits, self._stepidx, toks = self._step_fn(
+            self.params, self._cache, self._logits, self._keys,
+            self._stepidx, jnp.asarray(self._active),
+            jnp.asarray(self._temperature), jnp.asarray(self._top_p),
+            jnp.asarray(self._has_top_p),
+        )
+        self.steps_total += 1
+        return np.asarray(toks)
+
+    def retire(self, slot: int) -> None:
+        """Release a slot. Purely host-side: the row's stale K/V are
+        masked by the next occupant's own counters (kvcache.py)."""
+        self._active[slot] = False
+        self._temperature[slot] = 0.0
+        self._top_p[slot] = 1.0
+        self._has_top_p[slot] = False
+        self.alloc.release(slot)
+
+    # -- observability ----------------------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        return self.alloc.in_use
+
+    @property
+    def occupancy(self) -> float:
+        return self.alloc.in_use / self.max_slots
+
+    @property
+    def decode_step_compiles(self) -> int:
+        """Compiled-executable count of the decode step — the
+        zero-recompile pin: after the constructor's warmup this must
+        never grow across occupancy changes
+        (tests/test_serve_engine.py asserts == warmup_compiles)."""
+        return self._step_fn._cache_size()

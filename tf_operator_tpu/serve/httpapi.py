@@ -1,0 +1,45 @@
+"""/debug/serve HTTP surface: the continuous-batching scheduler snapshot.
+
+Mountable on the operator's ApiServer via its extra-handler hook (the
+/debug/scheduler, /debug/health, /debug/ckpt pattern); serve_lm — whose
+HTTP server is its own — calls ``ContinuousScheduler.debug_snapshot``
+directly and serves the same payload from the same path, so dashboards
+read one shape either way.
+
+    GET /debug/serve → scheduler.debug_snapshot()
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="serve-api")
+
+
+class ServeDebugHandler:
+    def __init__(self, scheduler: Any) -> None:
+        self._scheduler = scheduler
+
+    def __call__(self, req: Any) -> bool:
+        path = req.path.split("?", 1)[0]
+        if req.command != "GET" or path != "/debug/serve":
+            return False
+        body = json.dumps(
+            self._scheduler.debug_snapshot(), indent=2
+        ).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+        return True
+
+
+def mount_serve(api_server: Any, scheduler: Any) -> ServeDebugHandler:
+    handler = ServeDebugHandler(scheduler)
+    api_server.add_handler(handler)
+    LOG.info("serve API mounted at /debug/serve")
+    return handler

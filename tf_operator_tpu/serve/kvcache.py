@@ -1,0 +1,147 @@
+"""Slot-based KV cache for continuous batching: the solo decode cache,
+stacked over a leading SLOT axis, plus a free-slot allocator.
+
+The solo decode path (models/transformer.py, ``decode=True``) keeps one
+cache pytree per request: per-layer ``cached_key``/``cached_value``
+buffers of ``[1, max_seq_len, KV, Dh]`` (int8 + per-(token, head) scale
+sidecars under ``kv_int8``) and scalar position counters. Continuous
+batching needs ``max_slots`` of those living side by side so requests can
+occupy and release rows INDEPENDENTLY — so this module stacks that exact
+pytree over a new leading axis: every leaf becomes ``[N, *solo_shape]``
+(scalar counters become ``[N]`` int32 vectors). Nothing about the solo
+layout changes, which is what makes the engine's per-slot decode step a
+plain ``jax.vmap`` of the solo single-token step — the per-slot math is
+the solo math, the exactness pins in tests/test_serve_engine.py hold
+bit-for-bit, and the kv-int8 variant comes along for free.
+
+A slot's lifecycle: ``SlotAllocator.acquire`` (host-side bookkeeping) →
+the engine writes a freshly prefilled solo cache into the slot row
+(``make_insert_fn`` — one jitted executable, slot index a traced
+argument, so joins never recompile) → decode steps mutate the row in
+place (the engine donates the stacked tree through its step) →
+``SlotAllocator.release``. Nothing is cleared on release: the next
+occupant's prefill insert overwrites the whole row, and decode attention
+masks cache positions beyond the slot's own counter, so a previous
+occupant's K/V rows are unreachable garbage, never data.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections.abc import Mapping
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Position-counter leaf names in the decode cache (the two MUST move in
+# lockstep — see transformer.set_cache_index, which owns that contract).
+INDEX_KEYS = ("cache_index", "pos_index")
+
+
+def plain_tree(tree: Any) -> Any:
+    """Rebuild a cache pytree's mappings as plain dicts: flax versions
+    disagree about FrozenDict vs dict, and the stacked tree must share
+    one treedef with every solo cache that gets inserted into it."""
+    if isinstance(tree, Mapping):
+        return {k: plain_tree(v) for k, v in tree.items()}
+    return tree
+
+
+def solo_cache_template(model: Any) -> Any:
+    """The (empty) solo decode cache pytree for one request: what
+    ``model.init`` builds for a [1, 1] token batch — leaves
+    [1, max_seq_len, KV, Dh] plus scalar counters."""
+    return plain_tree(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32))[
+            "cache"
+        ]
+    )
+
+
+def stack_slots(template: Any, max_slots: int) -> Any:
+    """Preallocate the slot tensor: every solo leaf grows a leading
+    [max_slots] axis, zero-filled. One allocation up front — occupancy
+    changes never allocate or reshape anything again."""
+    return jax.tree.map(
+        lambda x: jnp.zeros((max_slots,) + x.shape, x.dtype),
+        plain_tree(template),
+    )
+
+
+def mask_inactive_indices(cache: Any, active: jax.Array) -> Any:
+    """Zero the position counters of inactive slots (traced; ``active``
+    is [N] bool). Inactive slots still execute the fixed-shape decode
+    step — that is the whole design — and without this reset their dead
+    counters would keep advancing: past max_seq_len the K/V write clamps
+    onto the last row and the position-embedding gather goes out of
+    range. Active slots' counters pass through untouched, so the reset
+    is invisible to real requests."""
+
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {
+                k: (jnp.where(active, v, 0) if k in INDEX_KEYS else walk(v))
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(cache)
+
+
+def make_insert_fn():
+    """Jitted (stacked, slot, solo) → stacked with that slot row replaced
+    by the solo cache. ``slot`` is a TRACED int32 argument, so one
+    executable serves every slot; the stacked tree is donated — a join
+    updates the slot tensor in place rather than doubling it."""
+
+    def insert(stacked, slot, solo):
+        return jax.tree.map(
+            lambda full, one: full.at[slot].set(one), stacked, solo
+        )
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+class SlotAllocator:
+    """Free-slot bookkeeping for the slot tensor (host-side, thread-safe).
+
+    Lowest-free-index policy — deterministic, which the exactness matrix
+    and the serve bench's seeded schedules rely on. Tracks a high-water
+    mark and cumulative acquire count for the /debug surface."""
+
+    def __init__(self, max_slots: int) -> None:
+        if max_slots < 1:
+            raise ValueError(f"max_slots={max_slots} must be >= 1")
+        self.max_slots = max_slots
+        self._free = list(range(max_slots))
+        self._lock = threading.Lock()
+        self.acquired_total = 0
+        self.high_water = 0
+
+    def acquire(self) -> int | None:
+        """Lowest free slot index, or None when fully occupied."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = min(self._free)
+            self._free.remove(slot)
+            self.acquired_total += 1
+            self.high_water = max(self.high_water, self.in_use)
+            return slot
+
+    def release(self, slot: int) -> None:
+        with self._lock:
+            if not 0 <= slot < self.max_slots:
+                raise ValueError(f"slot {slot} out of range")
+            if slot in self._free:
+                raise ValueError(f"slot {slot} double-released")
+            self._free.append(slot)
+
+    @property
+    def in_use(self) -> int:
+        return self.max_slots - len(self._free)
+
+    @property
+    def free(self) -> int:
+        return len(self._free)

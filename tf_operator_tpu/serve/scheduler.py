@@ -1,0 +1,371 @@
+"""The continuous-batching serving loop: admission, prefill/decode
+interleaving, retirement, drain — the policy layer over the engine.
+
+One thread owns the device (the engine is lock-free by design); HTTP
+handler threads talk to it only through ``submit``'s queue + event
+handshake. Each loop iteration:
+
+1. ADMIT + PREFILL (token-budgeted): queued requests move into free
+   slots. Under chunked prefill the iteration feeds at most
+   ``prefill_tokens_per_step`` prompt tokens before decoding again, so a
+   long prompt streams in across iterations instead of stalling every
+   active slot for its whole prefill — that bound is what keeps decode
+   latency flat while TTFT stays short (when nothing is decoding the
+   budget is waived: there is no one to protect). One-shot prefill
+   (prefill_chunk=None) admits whole prompts, still at most one batch of
+   budget per iteration.
+2. DECODE: one engine step advances every active slot one token; new
+   tokens are appended per request, TTFT is observed on each request's
+   first, and slots retire on num_steps or the request's eos_id.
+3. IDLE: with nothing queued and nothing active the loop parks on a
+   condition variable — zero device work, zero spin.
+
+Shutdown (``stop``) is the serve_lm SIGTERM/eviction drain: queued
+requests that never reached a slot fail FAST with ``ShuttingDown`` (the
+server's 503 — no socket left hanging on work that will never run),
+while admitted requests — slots and the in-flight prefill — finish
+normally. A loop crash answers every parked waiter with the error rather
+than abandoning it (the Coalescer's leftover contract).
+
+All counters/histograms land in the process-global registry
+(runtime/metrics.py ``tpu_serve_*``); long-lived tests must window reads
+via snapshot()/deltas.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any
+
+import numpy as np
+
+from tf_operator_tpu.runtime.metrics import (
+    SERVE_OCCUPANCY,
+    SERVE_PREFILL_TOKENS_TOTAL,
+    SERVE_QUEUE_DEPTH,
+    SERVE_REQUESTS_TOTAL,
+    SERVE_SLOTS_ACTIVE,
+    SERVE_SLOT_CAPACITY,
+    SERVE_STEP_SECONDS,
+    SERVE_TOKENS_TOTAL,
+    SERVE_TTFT_SECONDS,
+)
+
+
+class ShuttingDown(RuntimeError):
+    """The request was refused because the server is draining — servers
+    map this to 503 (retryable), never 400 (the request was fine)."""
+
+
+class ServeRequest:
+    """One /generate call in flight through the continuous engine."""
+
+    def __init__(self, tokens: np.ndarray, num_steps: int, *,
+                 temperature: float = 0.0, top_p: float | None = None,
+                 seed: int = 0, eos_id: int | None = None) -> None:
+        self.tokens = np.asarray(tokens, np.int32)
+        if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
+            raise ValueError("tokens must be [1, len] (one request row)")
+        self.num_steps = int(num_steps)
+        self.temperature = float(temperature)
+        self.top_p = top_p
+        self.seed = int(seed)
+        self.eos_id = eos_id
+        self.out: list[int] = []
+        self.error: Exception | None = None
+        self.event = threading.Event()
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: float | None = None
+        self.slot: int | None = None
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    def _finish(self, outcome: str, error: Exception | None = None) -> None:
+        self.error = error
+        SERVE_REQUESTS_TOTAL.inc(outcome=outcome)
+        self.event.set()
+
+
+class ContinuousScheduler:
+    def __init__(self, engine: Any, *,
+                 prefill_tokens_per_step: int = 256,
+                 device_lock: threading.Lock | None = None) -> None:
+        if prefill_tokens_per_step < 1:
+            raise ValueError("prefill_tokens_per_step must be >= 1")
+        self.engine = engine
+        self.prefill_tokens_per_step = prefill_tokens_per_step
+        # Serializes device access with a server's OTHER decode paths
+        # (serve_lm's streaming requests bypass the engine); a dedicated
+        # server may pass None and let the loop own the chip outright.
+        self._device_lock = device_lock or threading.Lock()
+        self._cond = threading.Condition()
+        self._queue: deque[ServeRequest] = deque()
+        self._slots: dict[int, ServeRequest] = {}
+        # (request, ChunkedPrefill | None): admitted, prefill mid-flight.
+        self._prefilling: tuple[ServeRequest, Any] | None = None
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+        self.decode_steps = 0
+        self.occupancy_sum = 0
+        self.tokens_generated = 0
+        self.requests_done = 0
+        # Active-slot count per decode step, bounded (the serve bench
+        # reads a steady-window occupancy out of the middle of it).
+        self.step_log: deque[int] = deque(maxlen=1 << 16)
+        SERVE_SLOT_CAPACITY.set(engine.max_slots)
+
+    # -- client side ------------------------------------------------------
+
+    def submit(self, tokens, num_steps: int, *, temperature: float = 0.0,
+               top_p: float | None = None, seed: int = 0,
+               eos_id: int | None = None,
+               timeout: float = 600.0) -> np.ndarray:
+        """Enqueue one request and block for its tokens ([1, n] int32;
+        n < num_steps only when eos_id fired). Validation errors raise
+        HERE, eagerly — a server turns them into a 400 before any device
+        work; ``ShuttingDown`` is the drain-time 503."""
+        req = ServeRequest(tokens, num_steps, temperature=temperature,
+                           top_p=top_p, seed=seed, eos_id=eos_id)
+        return np.asarray(
+            self.submit_request(req, timeout=timeout).out, np.int32
+        ).reshape(1, -1)
+
+    def submit_request(self, req: ServeRequest,
+                       timeout: float = 600.0) -> ServeRequest:
+        """``submit`` with the request object exposed: callers that need
+        per-request telemetry (TTFT — tools/serve_bench.py) keep the
+        handle; the finished request carries ``out`` and ``ttft``."""
+        # Eager: solo generate's budget + the sampling-parameter contract
+        # (same messages — one source of truth for the 400 text).
+        self.engine.validate_request(req.tokens.shape[1], req.num_steps)
+        if req.top_p is not None and not 0.0 < float(req.top_p) <= 1.0:
+            raise ValueError(f"top_p={req.top_p} must be in (0, 1]")
+        if req.top_p is not None and req.temperature <= 0:
+            raise ValueError(
+                "top_p requires temperature > 0 (greedy ignores it)"
+            )
+        with self._cond:
+            if self._stopping:
+                raise ShuttingDown("server shutting down")
+            self._queue.append(req)
+            SERVE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+        if not req.event.wait(timeout=timeout):
+            raise TimeoutError("continuous decode timed out")
+        if req.error is not None:
+            raise req.error
+        return req
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ContinuousScheduler":
+        self._thread = threading.Thread(target=self.loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Begin the drain and wait for the loop to finish it: queued
+        requests fail fast with ShuttingDown, admitted ones complete."""
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # -- the loop ---------------------------------------------------------
+
+    def loop(self) -> None:
+        try:
+            self._loop()
+        except Exception as exc:  # noqa: BLE001 — a crashed loop must
+            # answer every waiter, never strand a socket.
+            self._fail_all(exc)
+            raise
+        finally:
+            self._fail_all(ShuttingDown("server shutting down"))
+            SERVE_SLOTS_ACTIVE.set(0)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._queue or self._slots or self._prefilling
+                    or self._stopping,
+                    timeout=1.0,
+                )
+                if self._stopping:
+                    # Queued-but-unadmitted work will never run: answer
+                    # those sockets NOW (503), keep draining the rest.
+                    while self._queue:
+                        self._queue.popleft()._finish(
+                            "rejected", ShuttingDown("server shutting down")
+                        )
+                    SERVE_QUEUE_DEPTH.set(0)
+                    if not (self._slots or self._prefilling):
+                        return
+            self._admit_and_prefill()
+            self._decode()
+            SERVE_QUEUE_DEPTH.set(len(self._queue))
+            SERVE_SLOTS_ACTIVE.set(self.engine.active_slots)
+
+    def _pop_next(self) -> ServeRequest | None:
+        with self._cond:
+            if self._queue:
+                return self._queue.popleft()
+        return None
+
+    def _admit_and_prefill(self) -> None:
+        # Budget waived while nothing decodes: throttling prefill then
+        # would only delay TTFT to protect idle slots. (An int sentinel,
+        # not float inf — the chunk division below needs integers.)
+        budget = (self.prefill_tokens_per_step if self._slots
+                  else 1 << 30)
+        while budget > 0:
+            if self._prefilling is None:
+                if self.engine.alloc.free == 0:
+                    return
+                req = self._pop_next()
+                if req is None:
+                    return
+                pf = None
+                if self.engine.prefill_chunk is not None:
+                    pf = self.engine.start_prefill(
+                        np.asarray(req.tokens)
+                    )
+                self._prefilling = (req, pf)
+            req, pf = self._prefilling
+            t0 = time.perf_counter()
+            try:
+                with self._device_lock:
+                    if pf is None:
+                        slot = self.engine.join(
+                            np.asarray(req.tokens),
+                            num_steps=req.num_steps,
+                            temperature=req.temperature, top_p=req.top_p,
+                            seed=req.seed,
+                        )
+                        budget -= req.tokens.shape[1]
+                    else:
+                        chunks = max(1, int(budget // pf.chunk))
+                        budget -= pf.feed(chunks)
+                        if not pf.done:
+                            SERVE_STEP_SECONDS.observe(
+                                time.perf_counter() - t0, phase="prefill"
+                            )
+                            return  # resume next iteration
+                        cache, logits = pf.result()
+                        slot = self.engine.join_prefilled(
+                            cache, logits, prompt_len=pf.prompt_len,
+                            num_steps=req.num_steps,
+                            temperature=req.temperature, top_p=req.top_p,
+                            seed=req.seed,
+                        )
+            except Exception as exc:  # noqa: BLE001 — one bad request
+                # answers its own client and never kills the loop.
+                self._prefilling = None
+                req._finish("error", exc)
+                continue
+            SERVE_STEP_SECONDS.observe(
+                time.perf_counter() - t0, phase="prefill"
+            )
+            SERVE_PREFILL_TOKENS_TOTAL.inc(req.tokens.shape[1])
+            self._prefilling = None
+            if slot is None:  # raced capacity — put it back, front.
+                with self._cond:
+                    self._queue.appendleft(req)
+                return
+            req.slot = slot
+            self._slots[slot] = req
+
+    def _decode(self) -> None:
+        if not self._slots:
+            return
+        t0 = time.perf_counter()
+        with self._device_lock:
+            toks = self.engine.step()
+        now = time.perf_counter()
+        SERVE_STEP_SECONDS.observe(now - t0, phase="decode")
+        SERVE_OCCUPANCY.observe(self.engine.occupancy)
+        self.decode_steps += 1
+        self.occupancy_sum += len(self._slots)
+        self.step_log.append(len(self._slots))
+        self.tokens_generated += len(self._slots)
+        SERVE_TOKENS_TOTAL.inc(len(self._slots))
+        for slot, req in list(self._slots.items()):
+            tok = int(toks[slot])
+            req.out.append(tok)
+            if req.first_token_at is None:
+                req.first_token_at = now
+                SERVE_TTFT_SECONDS.observe(req.ttft)
+            if (len(req.out) >= req.num_steps
+                    or (req.eos_id is not None and tok == req.eos_id)):
+                del self._slots[slot]
+                self.engine.retire(slot)
+                self.requests_done += 1
+                req._finish("ok")
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            if self._prefilling is not None:
+                leftovers.append(self._prefilling[0])
+                self._prefilling = None
+            leftovers.extend(self._slots.values())
+            self._slots.clear()
+        for req in leftovers:
+            if not req.event.is_set():
+                req._finish(
+                    "rejected" if isinstance(exc, ShuttingDown) else "error",
+                    exc,
+                )
+
+    def reset_stats(self) -> None:
+        """Zero the loop's own aggregates (NOT the process-global
+        registry): the serve bench warms executables with a dry run, then
+        measures a clean window."""
+        self.decode_steps = 0
+        self.occupancy_sum = 0
+        self.tokens_generated = 0
+        self.requests_done = 0
+        self.step_log.clear()
+
+    # -- observability ----------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def mean_occupancy(self) -> float:
+        if not self.decode_steps:
+            return 0.0
+        return self.occupancy_sum / self.decode_steps / self.engine.max_slots
+
+    def debug_snapshot(self) -> dict:
+        """The /debug/serve payload (serve/httpapi.py)."""
+        return {
+            "engine": "continuous",
+            "max_slots": self.engine.max_slots,
+            "active_slots": self.engine.active_slots,
+            "queue_depth": self.queue_depth,
+            "prefill_chunk": self.engine.prefill_chunk,
+            "prefill_tokens_per_step": self.prefill_tokens_per_step,
+            "decode_steps": self.decode_steps,
+            # The zero-recompile invariant in one pair: compiles ==
+            # warmup_compiles means serving traffic never compiled.
+            "decode_step_compiles": self.engine.decode_step_compiles,
+            "warmup_compiles": self.engine.warmup_compiles,
+            "tokens_generated": self.tokens_generated,
+            "requests_done": self.requests_done,
+            "mean_occupancy": round(self.mean_occupancy, 4),
+            "ttft_p50_s": SERVE_TTFT_SECONDS.quantile(0.5),
+            "ttft_p99_s": SERVE_TTFT_SECONDS.quantile(0.99),
+            "draining": self._stopping,
+        }

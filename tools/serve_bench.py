@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Mixed-length open-loop serving bench: continuous batching vs the
+legacy batch-window coalescer, same model, same seeded traffic.
+
+The lm_decode bench line is a static-batch best case (one shape, lock
+step, batch 8); THIS is the serving number: requests with ≥4 distinct
+(prompt_len, num_steps) shapes arrive on a deterministic seeded open-loop
+schedule (arrival times are data, independent of service rate — the
+honest serving-load model), and each engine serves the identical
+schedule. Both legs get one untimed dry run of the whole schedule first
+(every executable warm), then the timed run measures steady-state
+serving — so the comparison is engine mechanics (occupancy vs lock-step
+coalescing), not compile luck.
+
+Emits one BENCH-style JSON line per leg:
+
+    {"metric": "serve_continuous_tokens_per_sec_mixed", "value": ...,
+     "vs_baseline": <continuous / coalesce>, "ttft_p50_ms": ...,
+     "ttft_p99_ms": ..., "mean_occupancy": ..., "steady_occupancy": ...}
+
+vs_baseline on the continuous line is the speedup over the coalesce leg
+(the acceptance ratio); ttft on the coalesce line is full-response
+latency (lock-step clients see nothing earlier). steady_occupancy is the
+mean active-slot fraction over the middle half of decode steps — the
+window where admission has filled and drain has not started.
+
+All randomness is seeded (schedule, prompts); wall-clock only enters the
+timing fields, so tests assert structure and token counts, never timing.
+BENCH_SMOKE shrinks shapes for CI. Run:
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py            # both legs
+    python tools/serve_bench.py --engine continuous          # one leg
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+# (prompt_len, num_steps) mix — ≥4 shapes spanning short/long prompts and
+# short/long horizons, so lock-step coalescing has real stragglers.
+SHAPES = [(8, 24), (16, 48), (32, 16), (4, 64)]
+SMOKE_SHAPES = [(4, 6), (8, 10), (12, 4), (2, 12)]
+
+
+def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
+                   shapes, vocab: int):
+    """Deterministic open-loop traffic: [(t_offset_s, prompt, steps)]."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for _ in range(n_requests):
+        p, steps = shapes[int(rng.integers(0, len(shapes)))]
+        prompt = rng.integers(0, vocab, (1, p)).astype(np.int32)
+        out.append((t, prompt, steps))
+        t += float(rng.exponential(mean_gap_ms)) / 1e3
+    return out
+
+
+def run_schedule(schedule, submit_fn):
+    """Replay the schedule open-loop (one client thread per request,
+    sleeping to its arrival time). Returns (wall_seconds, results):
+    results[i] = dict(tokens, latency_s, ttft_s | None, error | None)."""
+    results = [None] * len(schedule)
+    start = time.perf_counter() + 0.05  # common epoch for all arrivals
+
+    def client(i, offset, prompt, steps):
+        delay = start + offset - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        try:
+            tokens, ttft = submit_fn(prompt, steps)
+            results[i] = {
+                "tokens": tokens,
+                "latency_s": time.perf_counter() - t0,
+                "ttft_s": ttft if ttft is not None
+                else time.perf_counter() - t0,
+                "error": None,
+            }
+        except Exception as exc:  # noqa: BLE001 — one failed request
+            # must not hang the bench join below.
+            results[i] = {"tokens": None, "latency_s": 0.0,
+                          "ttft_s": 0.0, "error": repr(exc)}
+
+    threads = [
+        threading.Thread(target=client, args=(i, off, prompt, steps))
+        for i, (off, prompt, steps) in enumerate(schedule)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    return time.perf_counter() - t0, results
+
+
+def percentile(values, q):
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def leg_summary(name, wall_s, results, extra):
+    errors = [r["error"] for r in results if r and r["error"]]
+    tokens = sum(len(r["tokens"]) for r in results if r and r["tokens"]
+                 is not None)
+    ttfts = [r["ttft_s"] for r in results if r and r["error"] is None]
+    lats = [r["latency_s"] for r in results if r and r["error"] is None]
+    line = {
+        "metric": f"serve_{name}_tokens_per_sec_mixed",
+        "value": round(tokens / wall_s, 1) if wall_s else 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "requests": len(results),
+        "errors": len(errors),
+        "generated_tokens": tokens,
+        "wall_seconds": round(wall_s, 3),
+        "ttft_p50_ms": round(percentile(ttfts, 0.5) * 1e3, 1),
+        "ttft_p99_ms": round(percentile(ttfts, 0.99) * 1e3, 1),
+        "latency_p50_ms": round(percentile(lats, 0.5) * 1e3, 1),
+        "latency_p99_ms": round(percentile(lats, 0.99) * 1e3, 1),
+    }
+    line.update(extra)
+    if errors:
+        line["first_error"] = errors[0]
+    return line
+
+
+def run_continuous(cfg, params, schedule, args) -> dict:
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    # ONE engine for both passes: the dry run warms ITS jit caches (the
+    # whole point — a fresh engine would recompile on the clock and the
+    # line would measure compiles, not serving).
+    engine = ContinuousEngine(
+        cfg, params, max_slots=args.max_batch,
+        prefill_chunk=args.prefill_chunk or None,
+    )
+    sched = ContinuousScheduler(
+        engine, prefill_tokens_per_step=args.prefill_budget
+    ).start()
+
+    def submit(prompt, steps):
+        req = sched.submit_request(ServeRequest(prompt, steps))
+        return list(req.out), req.ttft
+
+    run_schedule(schedule, submit)  # untimed warmup
+    sched.reset_stats()
+    wall_s, results = run_schedule(schedule, submit)
+    steady = list(sched.step_log)
+    mid = steady[len(steady) // 4: max(len(steady) // 4 + 1,
+                                       3 * len(steady) // 4)]
+    stats = {
+        "mean_occupancy": round(sched.mean_occupancy, 3),
+        "steady_occupancy": round(
+            sum(mid) / len(mid) / engine.max_slots, 3
+        ) if mid else 0.0,
+        "decode_steps": sched.decode_steps,
+        "decode_step_compiles": engine.decode_step_compiles,
+        "max_batch": engine.max_slots,
+        "prefill_chunk": args.prefill_chunk or None,
+    }
+    sched.stop(timeout=30.0)
+    return leg_summary("continuous", wall_s, results, stats)
+
+
+def run_coalesce(cfg, params, schedule, args) -> dict:
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import generate
+    from tf_operator_tpu.serve.coalesce import Coalescer
+
+    lock = threading.Lock()
+
+    def decode_fn(rows, num_steps):
+        with lock:
+            return generate(cfg, params, rows, num_steps=num_steps)
+
+    def one_pass(timed: bool):
+        stop = threading.Event()
+        co = Coalescer(args.window_ms / 1e3, args.max_batch, decode_fn,
+                       stop)
+        t = threading.Thread(target=co.loop, daemon=True)
+        t.start()
+
+        def submit(prompt, steps):
+            out = co.submit(jnp.asarray(prompt), steps)
+            # Lock-step: the client sees nothing before the whole batch
+            # finishes — TTFT is response latency (None → measured by
+            # the caller).
+            return np.asarray(out)[0].tolist(), None
+
+        wall_s, results = run_schedule(schedule, submit)
+        stats = {
+            "coalesced_batches": co.batches,
+            "max_batch_rows": co.max_rows_seen,
+            "window_ms": args.window_ms,
+            "max_batch": args.max_batch,
+        }
+        stop.set()
+        t.join(timeout=30.0)
+        return wall_s, results, stats
+
+    one_pass(timed=False)
+    wall_s, results, stats = one_pass(timed=True)
+    return leg_summary("coalesce", wall_s, results, stats)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--engine", choices=("continuous", "coalesce", "both"),
+                   default="both")
+    p.add_argument("--requests", type=int, default=None)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mean-gap-ms", type=float, default=None,
+                   help="mean open-loop interarrival gap (seeded "
+                        "exponential)")
+    p.add_argument("--window-ms", type=float, default=25.0,
+                   help="coalesce leg's batch window")
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--prefill-budget", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=128)
+    args = p.parse_args(argv)
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    shapes = SMOKE_SHAPES if smoke else SHAPES
+    if args.requests is None:
+        args.requests = 12 if smoke else 48
+    if args.mean_gap_ms is None:
+        args.mean_gap_ms = 2.0 if smoke else 5.0
+    if args.d_model is None:
+        args.d_model = 32 if smoke else 64
+    if smoke:
+        args.prefill_chunk = min(args.prefill_chunk, 4)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    max_seq = max(p_ + s for p_, s in shapes)
+    if args.prefill_chunk:
+        max_seq = max(
+            max_seq,
+            max(-(-p_ // args.prefill_chunk) * args.prefill_chunk + s
+                for p_, s in shapes),
+        )
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_layers=args.layers, d_ff=args.d_model * 2,
+        # Static cache rows per slot: the largest shape plus headroom,
+        # rounded up — the cache read scales with this, as in serving.
+        max_seq_len=max(64, 1 << (max_seq - 1).bit_length()),
+        dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    schedule = build_schedule(
+        args.requests, args.mean_gap_ms, args.seed, shapes, args.vocab
+    )
+
+    lines = []
+    if args.engine in ("continuous", "both"):
+        lines.append(run_continuous(cfg, params, schedule, args))
+    if args.engine in ("coalesce", "both"):
+        lines.append(run_coalesce(cfg, params, schedule, args))
+    if len(lines) == 2 and lines[1]["value"]:
+        # The acceptance ratio: continuous over the legacy coalescer.
+        lines[0]["vs_baseline"] = round(
+            lines[0]["value"] / lines[1]["value"], 3
+        )
+    for line in lines:
+        print(json.dumps(line), flush=True)
+    return 0 if all(not line["errors"] for line in lines) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

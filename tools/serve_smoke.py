@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Fast continuous-batching smoke: runs the `serve`-marked tests in
+isolation (slot-engine exactness vs solo generate, zero-recompile pins,
+scheduler drain/EOS/metrics, serve-bench structure) — the quick loop for
+iterating on tf_operator_tpu/serve/ without paying for the whole tier-1
+run.
+
+    python tools/serve_smoke.py            # the smoke subset
+    python tools/serve_smoke.py -k drain   # extra pytest args pass through
+
+Exit code is pytest's. CI wires this as the pre-merge gate for serving
+changes; the same tests also run (unmarked-slow, so by default) inside
+the tier-1 command in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_serve_engine.py", "tests/test_serve_sched.py",
+        "-m", "serve",
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

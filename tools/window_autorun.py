@@ -17,10 +17,13 @@ relaunch after a crash) are free. Stage groups, in priority order:
 
   attribution  roofline/roofline2 (ceilings: chained matmul AND chained
                copy — one-shot probes under-read this time-sliced
-               tunnel ~5x), synthetic (device-resident ResNet),
-               convsweep, flashramp/flashblocks/qblock (8k ramp,
-               Q-block A/Bs, dispatch-vs-direct arbitration)
-  artifact     bench_full (the complete 8-section bench.py run),
+               tunnel ~5x), qblock (dispatch-vs-direct arbitration —
+               promoted to the front of the unmeasured set: the
+               MAX_Q_BLOCK retune still awaits its data), synthetic
+               (device-resident ResNet), convsweep,
+               flashramp/flashblocks (8k ramp, Q-block A/Bs)
+  artifact     bench_full (the complete bench.py run), serve
+               (continuous-batching vs coalescer mixed traffic),
                bench_resnet2 + resnet_resident (re-measures: mfu gate,
                HBM-resident input mode)
   secondary    flashsweep, h2d, lm A/B (flash vs xla), lmsweep,
@@ -52,6 +55,15 @@ PROBE_TIMEOUT_S = 45.0
 # section} = bench --section stage, None = full bench), budget seconds).
 STAGES = [
     ("roofline", {"PROBE": "roofline"}, 300.0),
+    # FIRST unmeasured stage of the next window: the in-process
+    # dispatch-vs-direct Q-block A/B (r05: direct bq1024 measured 14.0
+    # TFLOP/s but the dispatch path read 11.5 minutes later — interleaved
+    # legs decide config effect vs chip drift). The MAX_Q_BLOCK 512→1024
+    # retune shipped ahead of this arbitration data (ADVICE r5), and at
+    # its old slot — behind the 3600s bench_full — a short window never
+    # reached it; the revert trigger it arms is documented at
+    # ops/flash_attention.py MAX_Q_BLOCK.
+    ("qblock", {"PROBE": "qblock"}, 600.0),
     ("synthetic", {"PROBE": "synthetic"}, 900.0),
     ("convsweep", {"PROBE": "convsweep"}, 600.0),
     ("flashramp", {"PROBE": "flashramp"}, 600.0),
@@ -65,10 +77,11 @@ STAGES = [
     # ceiling); also re-anchors ceilings for the same-window lm/decode
     # stages below.
     ("roofline2", {"PROBE": "roofline"}, 300.0),
-    # In-process dispatch-vs-direct Q-block A/B (r05: direct bq1024
-    # measured 14.0 TFLOP/s but the dispatch path read 11.5 minutes
-    # later — interleaved legs decide config effect vs chip drift).
-    ("qblock", {"PROBE": "qblock"}, 600.0),
+    # Continuous-batching serving line (tools/serve_bench.py via bench
+    # --section serve): mixed-length open-loop traffic, continuous engine
+    # vs the legacy coalescer — the sustained-serving companion to the
+    # static-batch decode lines.
+    ("serve", {"BENCH": "serve"}, 700.0),
     # NEW headline candidate: dataset resident in HBM, augmentation on
     # device (train/device_input.py) — the designed answer to this
     # environment's ~27 MB/s h2d. Expected to land near the synthetic
