@@ -1156,6 +1156,20 @@ _SECTIONS: dict = {
 }
 
 
+def _emit_skipped_sections(reason: str, names=None) -> None:
+    """Machine-readable skip markers: each skipped hardware section puts
+    one {"section": ..., "skipped": reason} JSON line on stdout, so a
+    round whose TPU preflight failed (or whose watchdog budget ran out)
+    shows EXPLICIT skips in the BENCH artifact instead of silent gaps —
+    BENCH_r02–r05 looked like missing sections rather than skipped ones
+    (ROADMAP watch item). Consumers keyed on "metric" ignore these
+    lines; trajectory tooling keys on "skipped"."""
+    for name in (_SECTIONS if names is None else names):
+        if _section_selected(name):
+            print(json.dumps({"section": name, "skipped": reason}),
+                  flush=True)
+
+
 def _run_jax_section(name: str) -> None:
     """Run one hardware section in-process (the --section entry point)."""
     import jax
@@ -1380,6 +1394,7 @@ def _run_sections_isolated(deadline: float) -> None:
             print(f"bench: skipping section {name} "
                   f"({remaining:.0f}s left before watchdog)",
                   file=sys.stderr, flush=True)
+            _emit_skipped_sections("watchdog_budget", [name])
             continue
         proc = subprocess.Popen(
             [sys.executable, me, "--section", name],
@@ -1395,6 +1410,7 @@ def _run_sections_isolated(deadline: float) -> None:
             print(f"bench: section {name} timed out after {budget:.0f}s "
                   "(tunnel hang?) — killed, continuing",
                   file=sys.stderr, flush=True)
+            _emit_skipped_sections("section_timeout", [name])
         if proc.returncode != 0 and not timed_out:
             print(f"bench: section {name} exited rc={proc.returncode}",
                   file=sys.stderr, flush=True)
@@ -1455,6 +1471,7 @@ def main() -> None:
     # in-process (profile mode would hang exactly like a section child);
     # smoke runs have preflight=None and pass trivially.
     if not _backend_preflight_join(preflight):
+        _emit_skipped_sections("tpu_preflight")
         _emit_window_fallback()  # newest measured hardware lines, tagged
         sys.exit(3)  # CPU-side metrics already emitted above
     if os.environ.get("BENCH_SMOKE") and not os.environ.get(

@@ -26,6 +26,11 @@ generate()'s own validation). Two serving engines (``--engine``):
   changes never recompile, and token-budgeted chunked prefill
   (``--prefill-chunk`` + ``--prefill-budget``) interleaves long prompts
   with decode so TTFT stays short without stalling running requests.
+  KV storage is BLOCK-PAGED by default (``--kv-block``-token blocks,
+  ``--kv-pool-blocks`` pool): admission charges actual lengths rather
+  than max-seq-len rows, identical block-aligned prompt prefixes share
+  physical blocks copy-on-write and skip their prefill, and
+  ``--kv-dense`` falls back to the PR-5 dense slot tensor.
   ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
   ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
   requests finish, queued ones fail fast with a 503 — no hung sockets.
@@ -191,6 +196,30 @@ def main(argv: list[str] | None = None) -> int:
                         "decoding (with --prefill-chunk, long prompts "
                         "stream in across iterations instead of stalling "
                         "every active request)")
+    p.add_argument("--kv-paged", dest="kv_paged", action="store_true",
+                   default=True,
+                   help="continuous engine: block-paged KV cache with "
+                        "copy-on-write shared-prefix reuse (the "
+                        "default) — admission becomes 'free slot AND "
+                        "enough free blocks for prompt + max_tokens', "
+                        "so memory scales with ACTUAL lengths and "
+                        "identical prompt prefixes prefill once")
+    p.add_argument("--kv-dense", dest="kv_paged", action="store_false",
+                   help="escape hatch: the PR-5 dense slot tensor "
+                        "(every slot pre-pays max-seq-len rows; no "
+                        "prefix sharing). Selected automatically under "
+                        "--kv-int8, whose scale sidecars are not "
+                        "block-pooled yet")
+    p.add_argument("--kv-block", type=int, default=64, metavar="TOKENS",
+                   help="paged KV cache block size in tokens "
+                        "(--max-seq-len must divide evenly)")
+    p.add_argument("--kv-pool-blocks", type=int, default=None,
+                   metavar="N",
+                   help="paged KV pool size in blocks, incl. the pinned "
+                        "garbage block (default: the dense cache's "
+                        "byte budget — max-batch x max-seq-len/kv-block "
+                        "+ 1; raise max-batch past what the dense "
+                        "layout could hold and cap memory here instead)")
     args = p.parse_args(argv)
     legacy_flags = [flag for flag, on in (
         ("--spec-k", bool(args.spec_k)),
@@ -402,18 +431,37 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.serve.engine import ContinuousEngine
         from tf_operator_tpu.serve.scheduler import ContinuousScheduler
 
+        kv_paged = args.kv_paged
+        if kv_paged and args.kv_int8:
+            # The int8 scale sidecars are not block-pooled: serve the
+            # dense slot layout (which inherits them) rather than 400ing
+            # a flag combination with an obvious resolution.
+            print("serve_lm: --kv-int8 selects the dense slot cache "
+                  "(int8 sidecars are not block-pooled)", flush=True)
+            kv_paged = False
+        if kv_paged and args.max_seq_len % args.kv_block:
+            p.error(f"--max-seq-len {args.max_seq_len} must be a "
+                    f"multiple of --kv-block {args.kv_block} "
+                    "(or use --kv-dense)")
         engine_sched = ContinuousScheduler(
             ContinuousEngine(
                 cfg, params, max_slots=args.max_batch,
                 prefill_chunk=(args.prefill_chunk or None),
+                kv_paged=kv_paged, kv_block=args.kv_block,
+                kv_blocks=args.kv_pool_blocks,
             ),
             prefill_tokens_per_step=args.prefill_budget,
             # Streaming requests bypass the engine and share the chip:
             # one lock serializes both decode paths.
             device_lock=lock,
         ).start()
+        kv_desc = (
+            f"paged kv ({args.kv_block}-token blocks, "
+            f"{engine_sched.engine.kv_blocks} block pool)"
+            if kv_paged else "dense kv"
+        )
         print(f"serve_lm: continuous batching "
-              f"(slots {args.max_batch}, prefill chunk "
+              f"(slots {args.max_batch}, {kv_desc}, prefill chunk "
               f"{args.prefill_chunk or 'one-shot'}, prefill budget "
               f"{args.prefill_budget} tok/iter)", flush=True)
     elif args.batch_window > 0:

@@ -1,0 +1,435 @@
+"""Paged KV cache + copy-on-write prefix sharing pins (f32 CPU): the
+block allocator / prefix registry contracts, block-table edge cases
+(block-boundary prompts, single-token prompts, growth into the last
+table entry, release with a shared refcount, CoW on the first decode
+token after a shared prefix), block-exhaustion queueing through the
+serving loop, paged == dense == solo bit-identity, and the heap
+SlotAllocator's equivalence to the old list implementation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    generate,
+)
+from tf_operator_tpu.runtime.metrics import (
+    SERVE_KV_BLOCKS,
+    SERVE_KV_COW_TOTAL,
+    SERVE_PREFILL_SAVED_TOTAL,
+)
+from tf_operator_tpu.serve.engine import ContinuousEngine
+from tf_operator_tpu.serve.kvcache import (
+    BlockAllocator,
+    PrefixCache,
+    SlotAllocator,
+)
+from tf_operator_tpu.serve.scheduler import ContinuousScheduler
+
+pytestmark = pytest.mark.serve
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+BLOCK = 8  # table_len 8 at max_seq_len 64
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def prompt_of(p: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, (1, p)
+    ).astype(np.int32)
+
+
+def solo(params, prompt, steps, *, temperature=0.0, top_p=None, seed=0):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    return np.asarray(
+        generate(CFG, params, jnp.asarray(prompt), steps, **kw)
+    )[0]
+
+
+def paged_engine(params, *, slots=4, blocks=None, chunk=None,
+                 block=BLOCK) -> ContinuousEngine:
+    return ContinuousEngine(
+        CFG, params, max_slots=slots, prefill_chunk=chunk,
+        kv_paged=True, kv_block=block, kv_blocks=blocks,
+    )
+
+
+def run_to_completion(engine, slots_steps: dict) -> dict:
+    """Step until every listed slot has produced its step count; retire
+    each at its boundary. Returns slot -> token list."""
+    out = {s: [] for s in slots_steps}
+    left = dict(slots_steps)
+    while left:
+        toks = engine.step()
+        for slot in list(left):
+            out[slot].append(int(toks[slot]))
+            left[slot] -= 1
+            if left[slot] == 0:
+                engine.retire(slot)
+                del left[slot]
+    return out
+
+
+# -- host-side allocators -------------------------------------------------
+
+
+def test_block_allocator_contract():
+    alloc = BlockAllocator(6)  # block 0 reserved -> 5 allocatable
+    assert alloc.alloc(3) == [1, 2, 3]  # lowest-first, deterministic
+    assert alloc.alloc(3) is None       # all-or-nothing
+    assert alloc.free_blocks == 2 and alloc.used == 3
+    alloc.ref([2])
+    assert alloc.shared == 1
+    assert alloc.free([2]) == []        # refcount 2 -> 1, still live
+    assert alloc.free([2]) == [2]       # last holder -> freed
+    assert alloc.free_blocks == 3
+    with pytest.raises(ValueError, match="double-freed"):
+        alloc.free([2])
+    with pytest.raises(ValueError, match="not live"):
+        alloc.ref([5])
+    assert alloc.alloc(1) == [2]        # lowest free again
+    assert alloc.high_water == 3
+    with pytest.raises(ValueError, match="exceed"):
+        BlockAllocator(1)
+
+
+def test_slot_allocator_heap_matches_reference_property():
+    """The heap rewrite must be indistinguishable from the old O(n)
+    list implementation (min + remove): same acquire order, same
+    errors, same counters, under randomized acquire/release traffic."""
+
+    class Reference:
+        def __init__(self, n):
+            self.n = n
+            self._free = list(range(n))
+            self.acquired_total = 0
+            self.high_water = 0
+
+        def acquire(self):
+            if not self._free:
+                return None
+            slot = min(self._free)
+            self._free.remove(slot)
+            self.acquired_total += 1
+            self.high_water = max(self.high_water, self.in_use)
+            return slot
+
+        def release(self, slot):
+            if slot in self._free:
+                raise ValueError("double")
+            self._free.append(slot)
+
+        @property
+        def in_use(self):
+            return self.n - len(self._free)
+
+    rng = np.random.default_rng(0)
+    alloc, ref = SlotAllocator(7), Reference(7)
+    held = []
+    for _ in range(500):
+        if held and rng.random() < 0.45:
+            slot = held.pop(int(rng.integers(0, len(held))))
+            alloc.release(slot)
+            ref.release(slot)
+        else:
+            a, b = alloc.acquire(), ref.acquire()
+            assert a == b
+            if a is not None:
+                held.append(a)
+        assert alloc.in_use == ref.in_use
+        assert alloc.high_water == ref.high_water
+    assert alloc.acquired_total == ref.acquired_total
+    with pytest.raises(ValueError, match="double-released"):
+        alloc.release(held[0])
+        alloc.release(held[0])
+
+
+def test_prefix_cache_register_lookup_invalidate():
+    cache = PrefixCache(block=4)
+    toks = np.arange(10, dtype=np.int32)  # 2 full blocks + partial
+    logits = np.linspace(0, 1, 8, dtype=np.float32)
+    cache.register(toks, [5, 6, 7], logits)
+    # Longest match wins: the exact prompt, with its sampling row.
+    n, blocks, got = cache.lookup(toks)
+    assert (n, blocks) == (10, (5, 6, 7)) and np.array_equal(got, logits)
+    # A longer prompt extending the prefix matches full blocks only.
+    n, blocks, got = cache.lookup(np.arange(12, dtype=np.int32))
+    assert (n, blocks, got) == (8, (5, 6), None)
+    # A diverging prompt matches the shorter aligned prefix.
+    other = np.concatenate([np.arange(4), [63, 62, 61, 60]]).astype(np.int32)
+    n, blocks, got = cache.lookup(other)
+    assert (n, blocks, got) == (4, (5,), None)
+    assert cache.lookup(np.array([9, 9, 9], np.int32))[0] == 0
+    # A full-length digest registered only as a longer prompt's aligned
+    # prefix has no logits: it must downgrade, never claim exactness.
+    n, blocks, got = cache.lookup(np.arange(8, dtype=np.int32))
+    assert (n, got) == (4, None)
+    # Freeing a block drops every entry referencing it.
+    cache.invalidate_blocks([6])
+    assert cache.lookup(toks)[0] == 4  # only the 1-block entry survives
+    cache.invalidate_blocks([5])
+    assert cache.lookup(toks)[0] == 0
+    assert cache.entries == 0
+
+
+# -- block-table edge cases ----------------------------------------------
+
+
+def test_block_boundary_and_single_token_prompts(params):
+    """Prompt lengths at the block-table seams — exactly one block,
+    exact multiples, one-off-boundary, single token — all bit-identical
+    to solo; and a slot growing into its LAST table entry
+    (prompt + steps == max_seq_len, the full table)."""
+    engine = paged_engine(params, slots=2, blocks=None)
+    cases = [
+        (prompt_of(BLOCK, 1), 6),           # exactly one block
+        (prompt_of(2 * BLOCK, 2), 5),       # exact multiple
+        (prompt_of(BLOCK - 1, 3), 7),       # one short of the boundary
+        (prompt_of(BLOCK + 1, 4), 7),       # one past the boundary
+        (prompt_of(1, 5), 6),               # single-token prompt
+        (prompt_of(BLOCK, 6), CFG.max_seq_len - BLOCK),  # last entry
+    ]
+    for prompt, steps in cases:
+        slot = engine.join(jnp.asarray(prompt), num_steps=steps)
+        assert slot is not None
+        got = run_to_completion(engine, {slot: steps})[slot]
+        np.testing.assert_array_equal(
+            got, solo(params, prompt, steps),
+            err_msg=f"prompt_len={prompt.shape[1]} steps={steps}",
+        )
+    assert engine.decode_step_compiles == engine.warmup_compiles
+    assert engine.blocks.used == 0  # every block returned to the pool
+
+
+def test_cow_on_first_decode_token_after_shared_prefix(params):
+    """An exact whole-prompt match whose last block is PARTIAL: the
+    sharer skips prefill entirely, its first decode token triggers ONE
+    copy-on-write, and its output equals the donor's (and solo's)
+    bit-for-bit — while the donor keeps writing its own stream into the
+    original block."""
+    cow_before = SERVE_KV_COW_TOTAL.value()
+    saved_before = SERVE_PREFILL_SAVED_TOTAL.value()
+    engine = paged_engine(params, slots=3)
+    prompt = prompt_of(2 * BLOCK + 3, 7)  # partial last block
+    steps = 9
+    donor = engine.join(jnp.asarray(prompt), num_steps=steps)
+    engine.step()  # donor already decoding when the sharer arrives
+    sharer = engine.join(jnp.asarray(prompt), num_steps=steps)
+    assert engine.prefill_tokens_saved == prompt.shape[1]
+    assert engine._slot_state[sharer]["cow"] is not None
+    out = {donor: [], sharer: []}
+    for _ in range(steps):
+        toks = engine.step()
+        out[donor].append(int(toks[donor]))
+        out[sharer].append(int(toks[sharer]))
+    want = solo(params, prompt, steps)
+    np.testing.assert_array_equal(out[donor][:steps - 1], want[1:])
+    np.testing.assert_array_equal(out[sharer], want)
+    assert engine.cow_copies == 1
+    assert SERVE_KV_COW_TOTAL.value() == cow_before + 1
+    assert SERVE_PREFILL_SAVED_TOTAL.value() == (
+        saved_before + prompt.shape[1]
+    )
+    assert engine.decode_step_compiles == engine.warmup_compiles
+    engine.retire(donor)
+    engine.retire(sharer)
+    assert engine.blocks.used == 0
+
+
+def test_release_with_shared_refcount(params):
+    """The donor retiring mid-decode must NOT free blocks a sharer still
+    reads: refcounts hold them until the last holder retires, then the
+    pool drains fully and the prefix registry invalidates."""
+    engine = paged_engine(params, slots=2)
+    prompt = prompt_of(2 * BLOCK, 8)  # aligned: shared blocks immutable
+    donor = engine.join(jnp.asarray(prompt), num_steps=12)
+    engine.step()
+    sharer = engine.join(jnp.asarray(prompt), num_steps=12)
+    assert engine.blocks.shared >= 2
+    engine.retire(donor)  # sharer's refs keep the prefix blocks live
+    assert engine.blocks.shared == 0 and engine.blocks.used > 0
+    out = run_to_completion(engine, {sharer: 12})[sharer]
+    np.testing.assert_array_equal(out, solo(params, prompt, 12))
+    assert engine.blocks.used == 0
+    assert engine.prefix.entries == 0  # last holder gone -> invalidated
+    assert engine.prefix.lookup(prompt[0])[0] == 0
+
+
+def test_suffix_prefill_after_shared_prefix(params):
+    """Partial (block-aligned) sharing: the sharer prefills only its
+    unshared suffix — one-shot AND chunked — and reproduces the
+    non-sharing output exactly."""
+    for chunk in (None, 4):
+        engine = paged_engine(params, slots=2, chunk=chunk)
+        prefix = prompt_of(2 * BLOCK, 9)
+        a = np.concatenate([prefix, prompt_of(5, 10)], axis=1)
+        b = np.concatenate([prefix, prompt_of(3, 11)], axis=1)
+        sa = engine.join(jnp.asarray(a), num_steps=6)
+        engine.step()
+        sb = engine.join(jnp.asarray(b), num_steps=6)
+        assert engine.prefill_tokens_saved == 2 * BLOCK
+        out = {sa: [], sb: []}
+        for _ in range(6):
+            toks = engine.step()
+            out[sa].append(int(toks[sa]))
+            out[sb].append(int(toks[sb]))
+        np.testing.assert_array_equal(
+            out[sa][: 6 - 1], solo(params, a, 6)[1:]
+        )
+        np.testing.assert_array_equal(out[sb], solo(params, b, 6))
+        assert engine.decode_step_compiles == engine.warmup_compiles
+        engine.retire(sa)
+        engine.retire(sb)
+
+
+def test_paged_matches_dense_engine_token_for_token(params):
+    """The acceptance pin stated directly: the paged engine's token
+    stream equals the dense slot engine's on the same join/step/retire
+    script (both are separately pinned to solo; this removes the oracle
+    from the comparison)."""
+    script = [
+        (prompt_of(5, 20), 7, 0.0, None, 0),
+        (prompt_of(BLOCK, 21), 9, 0.9, None, 3),
+        (prompt_of(11, 22), 5, 0.7, 0.8, 5),
+    ]
+    streams = {}
+    for paged in (False, True):
+        engine = ContinuousEngine(
+            CFG, params, max_slots=3, kv_paged=paged, kv_block=BLOCK
+        )
+        slots = {}
+        for i, (prompt, steps, t, tp, seed) in enumerate(script):
+            slot = engine.join(
+                jnp.asarray(prompt), num_steps=steps, temperature=t,
+                top_p=tp, seed=seed,
+            )
+            slots[slot] = steps
+            engine.step()  # interleave joins with steps
+        out = run_to_completion(engine, {
+            s: n - (len(slots) - i)  # steps already taken while joining
+            for i, (s, n) in enumerate(sorted(slots.items()))
+        })
+        streams[paged] = out
+    # Identical per-slot streams for the steps both engines ran.
+    for slot in streams[False]:
+        np.testing.assert_array_equal(
+            streams[False][slot], streams[True][slot], err_msg=str(slot)
+        )
+
+
+def test_block_exhaustion_queues_until_retire(params):
+    """Admission is 'free slot AND enough free blocks': with a pool that
+    fits ONE request, concurrent submissions serialize through the
+    queue (never error, never deadlock) and every output stays exact;
+    plan_admission itself returns None while the pool is held."""
+    # 64-token budget, prompt 8 + steps 8 -> 2 blocks; pool of exactly 2.
+    engine = paged_engine(params, slots=4, blocks=3)
+    prompts = [prompt_of(BLOCK, 30 + i) for i in range(3)]
+    plan = engine.plan_admission(prompts[0], 8)
+    assert plan is not None
+    assert engine.plan_admission(prompts[1], 8) is None  # pool held
+    engine.release_plan(plan)
+    assert engine.blocks.used == 0
+
+    sched = ContinuousScheduler(engine).start()
+    results = {}
+
+    def client(i):
+        results[i] = sched.submit(prompts[i], 8)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    try:
+        for i, prompt in enumerate(prompts):
+            np.testing.assert_array_equal(
+                results[i][0], solo(params, prompt, 8), err_msg=str(i)
+            )
+        assert engine.alloc.high_water == 1  # never two admitted at once
+        assert engine.blocks.used == 0
+    finally:
+        sched.stop(timeout=30)
+
+
+def test_oversized_request_rejected_eagerly(params):
+    """A request that could NEVER fit the pool must 400 at validation,
+    not queue forever."""
+    engine = paged_engine(params, slots=2, blocks=3)
+    with pytest.raises(ValueError, match="KV blocks"):
+        engine.validate_request(3 * BLOCK, 8)
+    sched = ContinuousScheduler(engine)
+    with pytest.raises(ValueError, match="KV blocks"):
+        sched.submit(prompt_of(3 * BLOCK, 40), 8)
+
+
+def test_kv_debug_and_block_gauges(params):
+    engine = paged_engine(params, slots=2)
+    sched = ContinuousScheduler(engine).start()
+    try:
+        sched.submit(prompt_of(6, 50), 3)
+        snap = sched.debug_snapshot()
+        kv = snap["kv_cache"]
+        assert kv["mode"] == "paged" and kv["block"] == BLOCK
+        for key in ("blocks_total", "blocks_free", "blocks_used",
+                    "blocks_shared", "cow_copies", "prefix_entries",
+                    "prefill_tokens_saved"):
+            assert key in kv, key
+        assert kv["blocks_used"] == 0  # request done, pool drained
+        assert SERVE_KV_BLOCKS.value(state="free") == kv["blocks_free"]
+        assert SERVE_KV_BLOCKS.value(state="used") == 0
+    finally:
+        sched.stop(timeout=30)
+
+
+def test_paged_scheduler_shared_prefix_e2e(params):
+    """The serving-loop path of prefix sharing: a donor in flight, an
+    identical prompt submitted behind it — the sharer's answer equals
+    solo and the engine's saved-prefill counter proves the skip."""
+    engine = paged_engine(params, slots=2)
+    sched = ContinuousScheduler(engine).start()
+    prompt = prompt_of(2 * BLOCK + 3, 60)
+    steps = 20
+    first: dict = {}
+
+    def donor():
+        first["out"] = sched.submit(prompt, steps)
+
+    t = threading.Thread(target=donor)
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and engine.active_slots < 1:
+        time.sleep(0.005)
+    try:
+        assert engine.active_slots >= 1
+        second = sched.submit(prompt, steps)
+        t.join(timeout=60)
+        want = solo(params, prompt, steps)
+        np.testing.assert_array_equal(first["out"][0], want)
+        np.testing.assert_array_equal(second[0], want)
+        assert engine.prefill_tokens_saved == prompt.shape[1]
+        assert engine.cow_copies == 1
+    finally:
+        sched.stop(timeout=30)

@@ -2,7 +2,10 @@
 bit-identical to solo ``generate`` at every occupancy — solo, partial,
 full, join-mid-decode, retire-mid-decode, slot reuse — sampled requests
 reproducing their solo per-request-rng stream exactly, and ZERO decode-
-step recompiles across occupancy changes after warmup."""
+step recompiles across occupancy changes after warmup. The whole matrix
+runs under BOTH KV layouts: the block-paged pool (default) and the
+dense slot tensor (--kv-dense escape hatch); the paged-specific
+edge-case/sharing pins live in tests/test_kvcache_paged.py."""
 
 import numpy as np
 import pytest
@@ -105,14 +108,19 @@ MATRIX_SCRIPT = [
 ]
 
 
+@pytest.mark.parametrize("kv_paged", [False, True],
+                         ids=["dense", "paged"])
 @pytest.mark.parametrize("prefill_chunk", [None, 4])
-def test_engine_bit_identical_to_solo_generate(params, prefill_chunk):
+def test_engine_bit_identical_to_solo_generate(params, prefill_chunk,
+                                               kv_paged):
     """THE tentpole pin: every request's engine output — greedy AND
     sampled (incl. nucleus) — equals its solo generate output
     bit-for-bit, across the full occupancy walk, under one-shot AND
-    chunked prefill; and the decode step compiled exactly once."""
+    chunked prefill, in BOTH KV layouts; and the decode step compiled
+    exactly once."""
     engine = ContinuousEngine(
-        CFG, params, max_slots=4, prefill_chunk=prefill_chunk
+        CFG, params, max_slots=4, prefill_chunk=prefill_chunk,
+        kv_paged=kv_paged, kv_block=8,
     )
     got = drive(engine, MATRIX_REQS, MATRIX_SCRIPT)
     for name, (prompt, steps, t, tp, seed) in MATRIX_REQS.items():
@@ -126,11 +134,16 @@ def test_engine_bit_identical_to_solo_generate(params, prefill_chunk):
     assert engine.decode_step_compiles == engine.warmup_compiles == 1
 
 
-def test_zero_recompiles_across_occupancy_and_sampling_mix(params):
+@pytest.mark.parametrize("kv_paged", [False, True],
+                         ids=["dense", "paged"])
+def test_zero_recompiles_across_occupancy_and_sampling_mix(params,
+                                                           kv_paged):
     """After the first step, joins/retires/occupancy changes AND new
     sampling parameter values (temperature/top_p are data, not compile
-    constants) never retrace the decode step."""
-    engine = ContinuousEngine(CFG, params, max_slots=3)
+    constants) never retrace the decode step — in either KV layout
+    (paged additionally exercises fresh block tables per join)."""
+    engine = ContinuousEngine(CFG, params, max_slots=3,
+                              kv_paged=kv_paged, kv_block=8)
     s0 = engine.join(jnp.asarray(prompt_of(4, 1)), num_steps=30)
     engine.step()
     assert engine.decode_step_compiles == engine.warmup_compiles == 1

@@ -212,10 +212,13 @@ def test_debug_snapshot_shape(params):
         for key in ("engine", "max_slots", "active_slots", "queue_depth",
                     "decode_step_compiles", "tokens_generated",
                     "requests_done", "mean_occupancy", "ttft_p50_s",
-                    "draining"):
+                    "draining", "kv_cache"):
             assert key in snap, key
         assert snap["engine"] == "continuous"
         assert snap["requests_done"] >= 1
+        # The block-pool stats ride the snapshot (paged is the default).
+        assert snap["kv_cache"]["mode"] == "paged"
+        assert snap["kv_cache"]["blocks_total"] > 0
     finally:
         sched.stop(timeout=30)
 
@@ -224,7 +227,10 @@ def test_serve_bench_emits_structural_line():
     """tools/serve_bench.py (BENCH_SMOKE shapes): both legs emit JSON,
     token counts agree across engines (same seeded schedule, greedy —
     the legs decode the same work), zero errors, zero post-warmup
-    recompiles. Timing fields are present but never asserted."""
+    recompiles; the capacity mix shows the paged cache admitting >= 2x
+    the dense layout's concurrent long-context requests at the SAME
+    byte budget, with nonzero prefill-tokens-saved. Timing fields are
+    present but never asserted."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
                PALLAS_AXON_POOL_IPS="")
     proc = subprocess.run(
@@ -248,3 +254,17 @@ def test_serve_bench_emits_structural_line():
     assert cont["vs_baseline"] > 0  # the ratio line is populated
     for key in ("ttft_p50_ms", "ttft_p99_ms", "steady_occupancy"):
         assert key in cont, key
+    # The capacity mix: paged vs dense at one byte budget.
+    paged = by_metric["serve_paged_longctx_tokens_per_sec_mixed"]
+    dense = by_metric["serve_dense_longctx_tokens_per_sec_mixed"]
+    assert paged["errors"] == 0 and dense["errors"] == 0
+    assert paged["generated_tokens"] == dense["generated_tokens"] > 0
+    assert paged["kv"] == "paged" and dense["kv"] == "dense"
+    # The ROADMAP item-2 claim, asserted: the SAME bytes admit >= 2x the
+    # concurrent long-context requests once rows are block-paged.
+    assert paged["admitted_concurrency"] >= 2 * dense[
+        "admitted_concurrency"
+    ], (paged, dense)
+    assert paged["prefill_tokens_saved"] > 0
+    assert paged["decode_step_compiles"] == paged["warmup_compiles"]
+    assert paged["vs_baseline"] > 0 and paged["admitted_ratio"] >= 2.0

@@ -301,6 +301,35 @@ def test_window_fallback_legacy_when_no_captures(tmp_path, capsys):
     assert capsys.readouterr().out == ""
 
 
+def test_skipped_section_markers(monkeypatch, capsys):
+    """Hardware sections skipped on TPU-preflight failure (or budget/
+    timeout) leave an explicit machine-readable marker per section in
+    the BENCH stream — the r02–r05 trajectory ambiguity (skips looked
+    like gaps) closed. Markers carry no "metric" key, so the window
+    fold-in and metric parsers ignore them."""
+    import bench
+
+    monkeypatch.delenv("BENCH_ONLY", raising=False)
+    bench._emit_skipped_sections("tpu_preflight")
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert {l["section"] for l in lines} == set(bench._SECTIONS)
+    assert all(l["skipped"] == "tpu_preflight" for l in lines)
+    assert all("metric" not in l for l in lines)
+    # BENCH_ONLY narrows the markers to the selected sections.
+    monkeypatch.setenv("BENCH_ONLY", "lm,decode")
+    bench._emit_skipped_sections("tpu_preflight")
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert {l["section"] for l in lines} == {"lm", "decode"}
+    # Single-section form (watchdog-budget / timeout paths).
+    monkeypatch.delenv("BENCH_ONLY", raising=False)
+    bench._emit_skipped_sections("watchdog_budget", ["serve"])
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert lines == [{"section": "serve", "skipped": "watchdog_budget"}]
+
+
 def test_foreign_bench_detector_ignores_own_children(tmp_path):
     """The yield-to-driver scan is structural (argv[1] is the script
     path): text mentions of bench.py in other processes' cmdlines (e.g.

@@ -100,6 +100,24 @@ class TransformerConfig:
     moe_top_k: int = 1  # 1 = Switch, 2 = GShard top-2
     ep_axis: str = "ep"
 
+    # Block-paged decode KV storage (serving): instead of a contiguous
+    # [B, max_seq_len] cache row per sequence, K/V live in ONE per-layer
+    # pooled tensor of fixed kv_block-token blocks and each batch lane
+    # carries an int32 block table (gather indices into the pool) plus a
+    # per-lane position counter. Physical blocks are allocated to actual
+    # lengths (serve/kvcache.py BlockAllocator) and can be SHARED across
+    # lanes (copy-on-write prefix reuse), which is what turns max-batch
+    # from "what fits at max-len" into "what fits at actual lengths".
+    # Table capacity is fixed at max_seq_len // kv_block (max_seq_len
+    # must divide evenly — the gathered sequence axis must equal the
+    # dense path's so the masked softmax reduces over the identical
+    # extent, keeping paged decode bit-identical to the dense row path);
+    # unused table entries point at block 0, the pinned garbage block.
+    # Only the decode path reads these fields.
+    kv_paged: bool = False
+    kv_block: int = 64
+    kv_num_blocks: int = 0
+
     # Grouped-query attention: K/V get this many heads (must divide
     # n_heads); each group of n_heads/n_kv_heads query heads shares one
     # KV head. None = classic MHA (and the classic fused-qkv param tree,
@@ -122,6 +140,29 @@ class TransformerConfig:
                 f"n_kv_heads={self.n_kv_heads} must be a positive "
                 f"divisor of n_heads={self.n_heads}"
             )
+        if self.kv_paged:
+            if self.kv_int8:
+                # The int8 scale sidecars are not pooled (yet): silently
+                # dropping either flag would serve the wrong layout.
+                raise ValueError(
+                    "kv_paged does not compose with kv_int8 (the scale "
+                    "sidecars are not block-pooled; use the dense slot "
+                    "cache for kv-int8 serving)"
+                )
+            if self.kv_block < 1:
+                raise ValueError(f"kv_block={self.kv_block} must be >= 1")
+            if self.max_seq_len % self.kv_block:
+                raise ValueError(
+                    f"max_seq_len={self.max_seq_len} must be a multiple "
+                    f"of kv_block={self.kv_block} (block tables address "
+                    "whole blocks, and the gathered sequence axis must "
+                    "equal the dense path's for bit-identical decode)"
+                )
+            if self.kv_num_blocks < 2:
+                raise ValueError(
+                    f"kv_num_blocks={self.kv_num_blocks} must be >= 2 "
+                    "(block 0 is the pinned garbage block)"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -226,7 +267,11 @@ class Attention(nn.Module):
                 )(x)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cfg.decode:
-            out = self._decode_attend(q, k, v)
+            out = (
+                self._decode_attend_paged(q, k, v)
+                if cfg.kv_paged
+                else self._decode_attend(q, k, v)
+            )
         elif cfg.use_ring:
             batch_spec = (cfg.batch_axis,) if cfg.mesh.shape.get(cfg.batch_axis, 1) > 1 else (None,)
             # Heads are tp-sharded by the qkv kernel rule; declaring that to
@@ -448,6 +493,94 @@ class Attention(nn.Module):
         )
         return out.reshape(b, t, h, dh).astype(cfg.dtype)
 
+    def _decode_attend_paged(self, q, k, v):
+        """Block-paged decode attention: K/V live in ONE shared per-layer
+        pool of [kv_num_blocks, kv_block, KV, Dh] and each batch lane
+        addresses its own sequence through a [table_len] int32 block
+        table. Versus ``_decode_attend``:
+
+        - counters are PER-LANE vectors ([b] int32), so lanes sit at
+          independent positions inside one batched call (the continuous
+          engine's step is a single batched forward, not a vmap — the
+          pool is shared state a vmap lane could not mutate);
+        - the write scatters each lane's token K/V to flat pool row
+          ``table[pos // block] * block + pos % block``. Lanes at
+          index 0 are INACTIVE (a live lane always sits at >= its >= 1
+          prompt tokens), and their writes are DROPPED via an
+          out-of-range sentinel so a retired lane's stale table can
+          never corrupt a block that was reallocated to another lane;
+        - the read gathers ``pool[table]`` back into the exact
+          [b, max_seq_len, KV, Dh] layout the dense path slices, then
+          runs the IDENTICAL grouped einsum/mask/softmax — same sequence
+          extent, same per-row contractions, which is the whole
+          bit-exactness argument (pinned f32-CPU by
+          tests/test_serve_engine.py against the dense slot path).
+
+        Blocks beyond a lane's allocation point at block 0 (pinned
+        garbage); their gathered values are finite and always masked, so
+        they can never influence an active lane. Copy-on-write for
+        shared prefix blocks is the ENGINE's job (serve/engine.py runs
+        pending copies before the step that would write), so by the time
+        this executes every writable block is exclusively owned.
+        """
+        cfg = self.cfg
+        b, t, h, dh = q.shape
+        kv = k.shape[2]
+        g = h // kv
+        nb, blk = cfg.kv_num_blocks, cfg.kv_block
+        table_len = cfg.max_seq_len // blk
+        pool_k = self.variable(
+            "cache", "pool_key", jnp.zeros, (nb, blk, kv, dh), cfg.dtype
+        )
+        pool_v = self.variable(
+            "cache", "pool_value", jnp.zeros, (nb, blk, kv, dh), cfg.dtype
+        )
+        table = self.variable(
+            "cache", "block_table", jnp.zeros, (b, table_len), jnp.int32
+        )
+        index = self.variable(
+            "cache", "cache_index", jnp.zeros, (b,), jnp.int32
+        )
+        if self.is_initializing():
+            return jnp.zeros_like(q)
+        idx = index.value  # [b]
+        k, v = k.astype(cfg.dtype), v.astype(cfg.dtype)
+        pos = idx[:, None] + jnp.arange(t)[None, :]  # [b, t] absolute
+        entry = jnp.clip(pos // blk, 0, table_len - 1)
+        blocks = jnp.take_along_axis(table.value, entry, axis=1)
+        flat = blocks * blk + pos % blk
+        # idx == 0 marks an inactive lane (mask_inactive_indices zeroed
+        # it): route its write out of bounds and drop it.
+        flat = jnp.where((idx > 0)[:, None], flat, nb * blk)
+        shape2 = (nb * blk, kv, dh)
+        pool_k.value = pool_k.value.reshape(shape2).at[flat].set(
+            k, mode="drop"
+        ).reshape(nb, blk, kv, dh)
+        pool_v.value = pool_v.value.reshape(shape2).at[flat].set(
+            v, mode="drop"
+        ).reshape(nb, blk, kv, dh)
+        index.value = idx + t
+        keys = pool_k.value[table.value].reshape(
+            b, cfg.max_seq_len, kv, dh
+        )
+        vals = pool_v.value[table.value].reshape(
+            b, cfg.max_seq_len, kv, dh
+        )
+        qg = q.reshape(b, t, kv, g, dh)
+        s = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, keys,
+            preferred_element_type=jnp.float32,
+        )
+        s = s * (dh ** -0.5)
+        # Lane i's query row j (absolute pos[i, j]) sees keys <= pos[i, j].
+        valid = (
+            jnp.arange(cfg.max_seq_len)[None, None, :] <= pos[:, :, None]
+        )  # [b, t, S]
+        s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bkgqs,bskd->bqkgd", p, vals.astype(jnp.float32))
+        return out.reshape(b, t, h, dh).astype(cfg.dtype)
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -500,11 +633,25 @@ class Transformer(nn.Module):
             # One position counter for the model; every layer's
             # cache_index advances in lockstep with it (each __call__
             # touches all layers exactly once) — the same per-layer-counter
-            # convention as flax's canonical decode cache.
-            pidx = self.variable(
-                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
-            )
-            positions = (pidx.value + jnp.arange(tokens.shape[1]))[None, :]
+            # convention as flax's canonical decode cache. Under kv_paged
+            # the counter is PER-LANE ([b] int32): each lane of the
+            # batched paged step sits at its own position.
+            if cfg.kv_paged:
+                pidx = self.variable(
+                    "cache", "pos_index",
+                    jnp.zeros, (tokens.shape[0],), jnp.int32,
+                )
+                positions = (
+                    pidx.value[:, None]
+                    + jnp.arange(tokens.shape[1])[None, :]
+                )
+            else:
+                pidx = self.variable(
+                    "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+                )
+                positions = (
+                    pidx.value + jnp.arange(tokens.shape[1])
+                )[None, :]
             if not self.is_initializing():
                 pidx.value = pidx.value + tokens.shape[1]
         else:
@@ -813,6 +960,25 @@ def _prefill(model: "Transformer", params: Any, prompt: jax.Array):
     return updates["cache"], _head_logits(params, hidden[:, -1])
 
 
+def _prefill_extend(model: "Transformer", params: Any, cache: Any,
+                    suffix: jax.Array):
+    """Suffix prefill on a SEEDED cache: rows [0:base) already hold a
+    shared prefix's K/V (gathered from the paged pool) and the counters
+    sit at base — one block-causal forward of the remaining prompt
+    tokens -> (cache, logits of the true last position). The
+    shared-prefix admission path's sibling of ``_prefill``: same model,
+    same head dispatch, and — because chunked and one-shot prefill are
+    pinned bitwise identical — a prefill split at the shared boundary
+    lands the same cache/logits a full prefill would, which is what
+    makes skipping the prefix's compute a pure saving, never a numerics
+    change. Plain traced code."""
+    hidden, updates = model.apply(
+        {"params": params, "cache": cache}, suffix, mutable=["cache"],
+        return_hidden=True,
+    )
+    return updates["cache"], _head_logits(params, hidden[:, -1])
+
+
 class ChunkedPrefill:
     """Resumable chunked prefill for one prompt: the ``prefill_chunked``
     loop held as state so a serving loop can interleave a token-budgeted
@@ -834,9 +1000,17 @@ class ChunkedPrefill:
     """
 
     def __init__(self, cfg: TransformerConfig, params: Any,
-                 prompt: jax.Array, chunk: int) -> None:
+                 prompt: jax.Array, chunk: int, *,
+                 initial_cache: Any = None, base_index: int = 0) -> None:
+        """``initial_cache``/``base_index`` seed a SUFFIX prefill: the
+        cache already holds rows [0:base_index) (a shared prefix
+        gathered out of the paged pool, counters at base_index) and
+        ``prompt`` is only the remaining tokens — the padding budget and
+        the final counter rollback both shift by base_index."""
         self.prompt_len = int(prompt.shape[1])
-        _validate_prefill_chunk(cfg, self.prompt_len, chunk)
+        self.base_index = int(base_index)
+        _validate_prefill_chunk(cfg, self.prompt_len, chunk,
+                                base=self.base_index)
         self.chunk = int(chunk)
         self.n_chunks = -(-self.prompt_len // self.chunk)
         self._padded = self.n_chunks * self.chunk
@@ -852,7 +1026,10 @@ class ChunkedPrefill:
         init_fn, self._chunk_fn, self._head_fn = _prefill_chunk_fns(
             cfg, self.chunk
         )
-        self._cache = init_fn(params, prompt[:, :1])
+        if initial_cache is None:
+            self._cache = init_fn(params, prompt[:, :1])
+        else:
+            self._cache = initial_cache
         self._hidden = None
         self._at = 0
 
@@ -886,7 +1063,9 @@ class ChunkedPrefill:
         )
         cache = self._cache
         if self._padded > self.prompt_len:
-            cache = set_cache_index(cache, self.prompt_len)
+            cache = set_cache_index(
+                cache, self.base_index + self.prompt_len
+            )
         return cache, logits
 
 
@@ -908,20 +1087,24 @@ def prefill_chunked(
     return pf.result()
 
 
-def _validate_prefill_chunk(cfg: TransformerConfig, p: int, chunk: int):
+def _validate_prefill_chunk(cfg: TransformerConfig, p: int, chunk: int,
+                            base: int = 0):
     """Shared eager validation for chunked prefill (generate_segments
     runs it before returning its generator; prefill_chunked before any
-    device work): no device call may have happened when these raise."""
+    device work): no device call may have happened when these raise.
+    ``base`` is a seeded suffix prefill's starting row (ChunkedPrefill
+    initial_cache/base_index) — the padding budget shifts by it."""
     if chunk < 1:
         raise ValueError(f"chunk={chunk} must be >= 1")
     if p < 1:
         raise ValueError("prompt must have at least one token")
     padded = -(-p // chunk) * chunk
-    if padded > cfg.max_seq_len:
+    if base + padded > cfg.max_seq_len:
+        at_base = f" at base {base}" if base else ""
         raise ValueError(
-            f"prompt {p} right-padded to {padded} exceeds max_seq_len "
-            f"{cfg.max_seq_len} (the last partial chunk feeds a full "
-            "chunk of cache rows before rollback)"
+            f"prompt {p} right-padded to {padded}{at_base} exceeds "
+            f"max_seq_len {cfg.max_seq_len} (the last partial chunk "
+            "feeds a full chunk of cache rows before rollback)"
         )
 
 

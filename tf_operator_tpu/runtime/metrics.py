@@ -452,6 +452,23 @@ SERVE_STEP_SECONDS = REGISTRY.histogram(
     "slot tensor, or one token-budgeted prefill slice",
     ("phase",),  # prefill | decode
 )
+SERVE_KV_BLOCKS = REGISTRY.gauge(
+    "tpu_serve_kv_blocks",
+    "Paged KV-cache pool blocks by state: free = allocatable now, "
+    "used = held by live slots (the pinned garbage block 0 is excluded), "
+    "shared = refcount >= 2 via prefix sharing",
+    ("state",),
+)
+SERVE_KV_COW_TOTAL = REGISTRY.counter(
+    "tpu_serve_kv_cow_copies_total",
+    "Copy-on-write block copies: a slot's first decode write into a "
+    "shared partial block copied it to a privately-owned block first",
+)
+SERVE_PREFILL_SAVED_TOTAL = REGISTRY.counter(
+    "tpu_serve_prefill_tokens_saved_total",
+    "Prompt tokens whose prefill was skipped because a shared prefix "
+    "already held their K/V blocks",
+)
 SERVE_OCCUPANCY = REGISTRY.histogram(
     "tpu_serve_batch_occupancy",
     "Fraction of decode slots active, observed at every decode step — "

@@ -2,11 +2,14 @@
 invariant compiled decode step, and the serving loop that joins/retires
 requests mid-stream.
 
-- ``kvcache``: the solo decode cache stacked over a slot axis + the
-  free-slot allocator.
-- ``engine``: ONE compiled decode step over the full slot tensor with
-  per-slot position/length/rng — sampled requests batch too, and
-  occupancy changes never recompile.
+- ``kvcache``: KV storage — the block-paged pool (refcounted
+  ``BlockAllocator``, ``PrefixCache`` for copy-on-write shared-prefix
+  reuse) and the dense slot tensor escape hatch, plus the free-slot
+  allocator.
+- ``engine``: ONE compiled decode step over all slots with per-slot
+  position/length/rng — sampled requests batch too, and occupancy
+  changes, block-table growth, and CoW copies never recompile.
+  Admission is planned: "free slot AND enough free blocks".
 - ``scheduler``: the serving loop — token-budgeted chunked prefill
   interleaved with decode, admission into free slots, EOS/max-tokens
   retirement, and the SIGTERM drain (in-flight finishes, queued 503s).
@@ -24,6 +27,9 @@ bench how-to; tools/serve_smoke.py runs the marked test subset.
 
 _EXPORTS = {
     "SlotAllocator": "kvcache",
+    "BlockAllocator": "kvcache",
+    "PrefixCache": "kvcache",
+    "AdmissionPlan": "engine",
     "ChunkedPrefill": "engine",
     "ContinuousEngine": "engine",
     "ContinuousScheduler": "scheduler",

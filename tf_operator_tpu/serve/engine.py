@@ -1,54 +1,78 @@
 """Continuous-batching decode engine: ONE compiled step over the slot
-tensor, occupancy changes free.
+state, occupancy changes free, KV storage block-paged by default.
 
 The legacy serving paths run lock-step: a batch (coalesced or solo) is
 admitted together, decodes to the longest request's horizon together, and
 retires together — mixed-length traffic decays toward solo throughput
 because finished rows keep riding (and new requests keep waiting) until
 the batch drains. This engine decouples admission from step execution:
-requests JOIN a preallocated slot tensor (serve/kvcache.py) whenever a
-slot is free, decode advances ALL active slots one token per step, and
-slots RETIRE individually on EOS/max-tokens. Single-token decode is
-weight-read-bound, so throughput is proportional to live occupancy — the
-same keep-the-accelerator-busy argument that drives large-batch training.
+requests JOIN whenever capacity is free, decode advances ALL active slots
+one token per step, and slots RETIRE individually on EOS/max-tokens.
+Single-token decode is weight-read-bound, so throughput is proportional
+to live occupancy — the same keep-the-accelerator-busy argument that
+drives large-batch training.
 
-Mechanics (validated bit-for-bit by tests/test_serve_engine.py):
+KV storage comes in two layouts (serve/kvcache.py):
 
-- The decode step is the SOLO single-token step (models/transformer.py,
-  the same flax module ``generate`` scans) ``jax.vmap``-ed over the slot
-  axis. Every slot carries its own cache row, position counters, logits,
-  sampling parameters, and rng — per-slot math IS the solo math, so
-  greedy output is bit-identical to solo ``generate`` at every occupancy
-  (f32 CPU), and sampled slots reproduce their solo per-request-rng
-  stream exactly. The greedy-only restriction of the legacy coalescer
-  dies here: temperature/top_p are per-slot VALUES, not compile-time
-  constants.
-- All shapes are static in ``max_slots``: joins, retires, and idle slots
-  never change the step's signature, so after the first step there are
-  ZERO decode recompiles (pinned via the jit cache size). Inactive slots
-  execute dead compute — that is the price of the fixed shape, and it is
-  the cheap side of the trade precisely because decode is
-  weight-read-bound: the weight read is shared by all slots regardless.
+- ``kv_paged=True`` (default): per-layer pooled block tensors + per-slot
+  block tables. Capacity is "free slot AND enough free blocks for
+  prompt + max_tokens" — memory scales with ACTUAL lengths, and
+  block-aligned shared prefixes map to the same physical blocks
+  (refcount bumps, prefill skipped) with copy-on-write when a slot first
+  writes into a shared partial block. The decode step is one BATCHED
+  forward of the kv_paged model: per-lane counters/tables are data, so
+  occupancy, table contents, and CoW copies never recompile.
+- ``kv_paged=False``: the PR-5 dense slot tensor — the solo decode cache
+  stacked over a slot axis, the step a ``jax.vmap`` of the solo
+  single-token step. Kept as the escape hatch (serve_lm ``--kv-dense``)
+  and as the bit-exactness oracle's second witness.
+
+Mechanics (validated bit-for-bit by tests/test_serve_engine.py and
+tests/test_kvcache_paged.py):
+
+- Per-slot math IS the solo math. Dense: the solo step vmapped. Paged:
+  the same sampling body vmapped over lanes + one batched forward whose
+  paged attention gathers ``pool[block_table]`` back into the exact
+  dense [S] layout before the identical masked softmax — so greedy
+  output is bit-identical to solo ``generate`` at every occupancy
+  (f32 CPU), sampled slots reproduce their solo per-request-rng stream
+  exactly, and paged equals dense token-for-token.
+- All shapes are static in ``max_slots``: joins, retires, idle slots,
+  block-table growth, and CoW copies never change any step signature,
+  so after the constructor's warmup there are ZERO decode recompiles
+  (pinned via the jit cache size). Inactive slots execute dead compute —
+  the price of the fixed shape, cheap because decode is
+  weight-read-bound.
 - Sampled reproduction: solo ``generate`` draws step keys as
   ``jax.random.split(rng, num_steps)`` — the schedule depends on
   num_steps, so each join precomputes its request's full key ladder into
   a fixed [max_seq_len, 2] buffer and the step gathers key[step_i] per
   slot. Greedy slots carry zeros and never touch them.
-- Prefill stays a SOLO concern: each joining request prefills alone
-  (one-shot ``_prefill``, or the resumable ``ChunkedPrefill`` over the
-  fixed-chunk executables of ``--prefill-chunk``) and the finished cache
-  is inserted into its slot row — byte-identical to the solo path's
-  cache, which is what makes the join boundary exact.
+- Prefill stays a SOLO DENSE concern: each joining request prefills
+  alone (one-shot ``_prefill``, or the resumable ``ChunkedPrefill``) and
+  the finished cache is inserted — dense: into its slot row; paged:
+  scattered into its table's blocks. A shared-prefix admission gathers
+  the donor's prefix rows into a seeded dense cache and prefills only
+  its suffix (``_prefill_extend``); an exact whole-prompt match skips
+  prefill entirely and samples from the donor's stored logits.
+
+Admission is PLANNED: ``plan_admission`` reserves everything (slot
+availability checked, shared refcounts bumped, private blocks allocated)
+so the subsequent prefill/join can never fail on capacity, and
+``release_plan`` undoes it on error/drain paths. ``join`` wraps
+plan → prefill → ``join_planned`` for callers that do not interleave.
 
 Thread model: the engine is a device-state machine with NO internal
 locking — the serving loop (serve/scheduler.py) is its single caller;
-tests drive it directly for the deterministic exactness matrix.
+tests drive it directly for the deterministic exactness matrix. (The
+host-side allocators lock internally only so /debug and /metrics reads
+are safe.)
 """
 
 from __future__ import annotations
 
 import functools
-from dataclasses import replace
+from dataclasses import dataclass, replace
 from typing import Any
 
 import numpy as np
@@ -62,37 +86,146 @@ from tf_operator_tpu.models.transformer import (
     TransformerConfig,
     _nucleus_filter,
     _prefill,
+    _prefill_extend,
     _validate_prefill_chunk,
+    set_cache_index,
+)
+from tf_operator_tpu.runtime.metrics import (
+    SERVE_KV_BLOCKS,
+    SERVE_KV_COW_TOTAL,
+    SERVE_PREFILL_SAVED_TOTAL,
 )
 from tf_operator_tpu.serve.kvcache import (
+    BlockAllocator,
+    PrefixCache,
     SlotAllocator,
+    make_cow_fn,
+    make_gather_fn,
     make_insert_fn,
+    make_paged_insert_fn,
+    make_table_insert_fn,
     mask_inactive_indices,
+    paged_cache_template,
     plain_tree,
     solo_cache_template,
     stack_slots,
 )
 
 
+def _sample_token(logits1, key1, temp, tp, has_tp):
+    """The solo sample body (transformer._generate_fn) with the
+    compile-time temperature/top_p branches turned into traced selects —
+    values, not executables, so occupancy and sampling mix never
+    recompile. where(greedy, 1, temp) guards the division; the greedy
+    lane takes the argmax anyway. THE single sampling construction for
+    both the dense (vmapped solo step) and paged (vmapped sampler +
+    batched forward) steps, so their token choices cannot drift."""
+    greedy = temp <= 0
+    scaled = logits1 / jnp.where(greedy, 1.0, temp)
+    filt = jnp.where(
+        has_tp, _nucleus_filter(scaled[None], tp)[0], scaled
+    )
+    samp = jax.random.categorical(key1, filt[None, :])[0]
+    return jnp.where(greedy, logits1.argmax(-1), samp).astype(jnp.int32)
+
+
+@dataclass
+class AdmissionPlan:
+    """One reserved admission. Paged mode reserves at PLAN time — shared
+    prefix refcounts bumped (so the donor retiring mid-prefill cannot
+    free them out from under us) and private blocks allocated — so the
+    prefill/join that follows can never fail on capacity; ``release``
+    paths undo it. Dense mode carries only the request shape (a free
+    slot was checked; the slot itself is acquired at join, single-caller
+    serialized)."""
+
+    tokens: np.ndarray            # [1, L] int32 prompt
+    prompt_len: int
+    num_steps: int
+    shared_tokens: int = 0        # prefix tokens reused from the cache
+    shared_blocks: tuple = ()     # donor blocks we hold a ref on
+    private_blocks: tuple = ()    # freshly-allocated blocks (CoW dst incl.)
+    read_table: np.ndarray | None = None   # [table_len] int32
+    write_table: np.ndarray | None = None  # shared/unused entries -> 0
+    cow: tuple | None = None      # (table_entry, dst_block)
+    logits: np.ndarray | None = None  # exact-match stored sampling row
+    settled: bool = False         # consumed by a join OR released
+
+    @property
+    def prefill_tokens(self) -> int:
+        """Prompt tokens this admission still has to prefill."""
+        return self.prompt_len - self.shared_tokens
+
+
 class ContinuousEngine:
-    """The slot-tensor decode engine. See the module docstring; the
-    public surface is ``join``/``start_prefill``+``join_prefilled``,
-    ``step``, ``retire``, and the ``decode_step_compiles`` pin."""
+    """The continuous-batching engine. See the module docstring; the
+    public surface is ``plan_admission``/``prefill_planned``/
+    ``join_planned`` (+ the ``join`` convenience), ``step``, ``retire``,
+    ``release_plan``, and the ``decode_step_compiles`` pin."""
 
     def __init__(self, cfg: TransformerConfig, params: Any,
-                 max_slots: int, *, prefill_chunk: int | None = None) -> None:
+                 max_slots: int, *, prefill_chunk: int | None = None,
+                 kv_paged: bool = True, kv_block: int = 64,
+                 kv_blocks: int | None = None) -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
         self.cfg = cfg
         self.params = params
         self.max_slots = int(max_slots)
         self.prefill_chunk = prefill_chunk
-        dcfg = replace(cfg, decode=True, mesh=None, remat=False)
-        self._model = Transformer(dcfg)
+        self.kv_paged = bool(kv_paged)
+        self.kv_block = int(kv_block)
+        dcfg = replace(cfg, decode=True, mesh=None, remat=False,
+                       kv_paged=False)
+        # Solo DENSE model: prefill (one-shot, chunked, and suffix) and
+        # the dense cache layout every insert consumes.
+        self._solo_model = Transformer(dcfg)
         self.alloc = SlotAllocator(self.max_slots)
 
         n, v, s = self.max_slots, cfg.vocab_size, cfg.max_seq_len
-        self._cache = stack_slots(solo_cache_template(self._model), n)
+        if self.kv_paged:
+            # TransformerConfig.__post_init__ re-validates; eager copies
+            # here fail at the engine call site with engine vocabulary.
+            if s % self.kv_block:
+                raise ValueError(
+                    f"max_seq_len={s} must be a multiple of "
+                    f"kv_block={self.kv_block}"
+                )
+            self.table_len = s // self.kv_block
+            if kv_blocks is None:
+                # Default pool = exactly the dense slot tensor's budget
+                # (every slot at max length) + the pinned garbage block.
+                kv_blocks = self.max_slots * self.table_len + 1
+            self.kv_blocks = int(kv_blocks)
+            pcfg = replace(dcfg, kv_paged=True, kv_block=self.kv_block,
+                           kv_num_blocks=self.kv_blocks)
+            self._model = Transformer(pcfg)
+            self.blocks = BlockAllocator(self.kv_blocks)
+            self.prefix = PrefixCache(self.kv_block)
+            self._cache = paged_cache_template(self._model, n)
+            self._paged_insert = make_paged_insert_fn(
+                self.kv_blocks, self.kv_block
+            )
+            self._table_insert = make_table_insert_fn()
+            self._gather = make_gather_fn(self.kv_block)
+            self._cow_fn = make_cow_fn()
+            self._extend_fn = jax.jit(
+                functools.partial(_prefill_extend, self._solo_model)
+            )
+            # slot -> {"private": [...], "shared": [...],
+            #          "cow": (entry, src, dst) | None}
+            self._slot_state: dict[int, dict] = {}
+            self.cow_copies = 0
+            self.prefill_tokens_saved = 0
+            self._set_block_gauges()
+        else:
+            self.table_len = None
+            self.kv_blocks = None
+            self._model = self._solo_model
+            self.blocks = None
+            self.prefix = None
+            self._cache = stack_slots(solo_cache_template(self._model), n)
+            self._insert = make_insert_fn()
         self._logits = jnp.zeros((n, v), jnp.float32)
         self._keys = jnp.zeros((n, s, 2), jnp.uint32)
         self._stepidx = jnp.zeros((n,), jnp.int32)
@@ -104,29 +237,35 @@ class ContinuousEngine:
         self._top_p = np.ones(n, np.float32)
         self._has_top_p = np.zeros(n, bool)
 
-        self._insert = make_insert_fn()
-        self._prefill_fn = jax.jit(functools.partial(_prefill, self._model))
-        self._step_fn = jax.jit(self._step, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(
+            functools.partial(_prefill, self._solo_model)
+        )
+        self._step_fn = jax.jit(
+            self._step_paged if self.kv_paged else self._step,
+            donate_argnums=(1, 2),
+        )
         self.steps_total = 0
         # Warm the decode executable at CONSTRUCTION, twice: the first
         # step compiles; the second catches XLA's donated-buffer layout
         # flip (the step's chosen output layout can differ from the
         # eagerly-built input layout, costing exactly one more compile at
         # larger widths) so serving traffic never sees a compile. All
-        # slots are inactive — the garbage rows these steps write are
-        # fully overwritten by each join's insert, and the counters are
-        # reset below.
+        # slots are inactive — dense: the garbage rows these steps write
+        # are fully overwritten by each join's insert; paged: index-0
+        # lanes' writes are dropped outright.
         for _ in range(2):
             self.step()
         self.steps_total = 0
         self.warmup_compiles = self.decode_step_compiles
 
-    # -- prefill / join ---------------------------------------------------
+    # -- admission planning ----------------------------------------------
 
     def validate_request(self, prompt_len: int, num_steps: int) -> None:
         """The solo ``generate`` budget, enforced eagerly (a server turns
         this into a 400 before any device work), plus the chunked-prefill
-        padding budget when that path is configured."""
+        padding budget when that path is configured and — paged — the
+        whole-pool block budget (a request that could NEVER fit must not
+        queue forever)."""
         if num_steps < 1:
             raise ValueError(f"num_steps={num_steps} must be >= 1")
         if prompt_len < 1:
@@ -140,76 +279,293 @@ class ContinuousEngine:
             _validate_prefill_chunk(
                 self.cfg, prompt_len, self.prefill_chunk
             )
+        if self.kv_paged:
+            cap = -(-(prompt_len + num_steps) // self.kv_block)
+            if cap > self.kv_blocks - 1:
+                raise ValueError(
+                    f"prompt {prompt_len} + steps {num_steps} needs "
+                    f"{cap} KV blocks of {self.kv_block}; the pool has "
+                    f"only {self.kv_blocks - 1} allocatable"
+                )
+
+    def plan_admission(self, tokens, num_steps: int) -> AdmissionPlan | None:
+        """Reserve capacity for one request, or return None (the caller
+        queues). Dense: a free slot exists. Paged: a free slot AND
+        enough free blocks for prompt + num_steps AFTER shared-prefix
+        credit — the longest registered block-aligned prefix maps to the
+        donor's physical blocks (refcounts bumped HERE), an exact
+        whole-prompt match also carries the donor's last-position logits
+        (prefill skipped entirely), and a shared PARTIAL last block
+        reserves one extra private block for its copy-on-write."""
+        tokens = np.asarray(tokens, np.int32)
+        L, M = int(tokens.shape[1]), int(num_steps)
+        self.validate_request(L, M)
+        if self.alloc.free == 0:
+            return None
+        if not self.kv_paged:
+            return AdmissionPlan(tokens, L, M)
+        B = self.kv_block
+        cap = -(-(L + M) // B)
+        n, shared, logits = self.prefix.lookup(tokens[0])
+        shared_entries = -(-n // B)
+        cow_needed = n == L and n % B != 0
+        need = cap - shared_entries + (1 if cow_needed else 0)
+        priv = self.blocks.alloc(need)
+        if priv is None:
+            return None  # block exhaustion: the caller queues
+        if n:
+            self.blocks.ref(shared)
+        cow = None
+        tail = list(priv)
+        if cow_needed:
+            # Reserve the CoW destination now so the copy at first write
+            # can never fail; keep entry blocks lowest-first.
+            cow = (shared_entries - 1, tail.pop())
+        read = np.zeros(self.table_len, np.int32)
+        write = np.zeros(self.table_len, np.int32)
+        read[:shared_entries] = shared
+        read[shared_entries:cap] = tail
+        write[shared_entries:cap] = tail
+        self._set_block_gauges()
+        return AdmissionPlan(
+            tokens, L, M, shared_tokens=n, shared_blocks=tuple(shared),
+            private_blocks=tuple(priv), read_table=read,
+            write_table=write, cow=cow, logits=logits,
+        )
+
+    def release_plan(self, plan: AdmissionPlan | None) -> None:
+        """Undo a plan's reservations (error/drain paths). Idempotent;
+        a plan consumed by ``join_planned`` is a no-op — its blocks
+        belong to the slot then."""
+        if plan is None or plan.settled or not self.kv_paged:
+            return
+        plan.settled = True
+        freed = self.blocks.free(
+            list(plan.private_blocks) + list(plan.shared_blocks)
+        )
+        if freed:
+            self.prefix.invalidate_blocks(freed)
+        self._set_block_gauges()
+
+    # -- prefill / join ---------------------------------------------------
 
     def start_prefill(self, prompt: jax.Array) -> ChunkedPrefill | None:
-        """A resumable prefill when the engine is configured for chunked
-        prefill, else None (the caller joins with the prompt directly and
-        the one-shot executable runs inside ``join``)."""
+        """A resumable WHOLE-prompt prefill when the engine is configured
+        for chunked prefill, else None. Plan-unaware — planned admissions
+        use ``prefill_planned`` (which credits shared prefixes)."""
         if self.prefill_chunk is None:
             return None
         return ChunkedPrefill(
             self.cfg, self.params, prompt, self.prefill_chunk
         )
 
+    def prefill_planned(self, plan: AdmissionPlan) -> ChunkedPrefill | None:
+        """The resumable prefill a planned admission still needs, or
+        None when there is nothing to feed: an exact prefix match (the
+        plan carries the sampling logits), a one-shot engine
+        (prefill_chunk unset — the prefill runs inside
+        ``join_planned``), or a shared suffix whose chunk padding would
+        not fit the cache (one-shot fallback)."""
+        if plan.prefill_tokens == 0 or self.prefill_chunk is None:
+            return None
+        if not plan.shared_tokens:
+            return self.start_prefill(jnp.asarray(plan.tokens))
+        padded = (
+            -(-plan.prefill_tokens // self.prefill_chunk)
+            * self.prefill_chunk
+        )
+        if plan.shared_tokens + padded > self.cfg.max_seq_len:
+            return None
+        return ChunkedPrefill(
+            self.cfg, self.params,
+            jnp.asarray(plan.tokens[:, plan.shared_tokens:]),
+            self.prefill_chunk,
+            initial_cache=self._seed_cache(plan),
+            base_index=plan.shared_tokens,
+        )
+
+    def _seed_cache(self, plan: AdmissionPlan) -> Any:
+        """A solo dense cache seeded with the plan's shared prefix rows
+        (gathered out of the pool through the read table), counters at
+        the shared length — the suffix prefill's starting state."""
+        cache = self._gather(self._cache, jnp.asarray(plan.read_table))
+        return set_cache_index(cache, plan.shared_tokens)
+
     def join(self, prompt: jax.Array, *, num_steps: int,
              temperature: float = 0.0, top_p: float | None = None,
              seed: int = 0) -> int | None:
-        """Prefill ``prompt`` solo and join the batch: returns the slot
-        index, or None when fully occupied. Convenience over
-        ``start_prefill`` + ``join_prefilled`` for callers that do not
-        interleave (tests, the bench's coalesce leg)."""
+        """Plan, prefill, and join in one call: returns the slot index,
+        or None when capacity (slots or blocks) is unavailable.
+        Convenience over the planned API for callers that do not
+        interleave (tests, the bench's legs)."""
         self.validate_request(int(prompt.shape[1]), num_steps)
-        if self.alloc.free == 0:
+        plan = self.plan_admission(np.asarray(prompt), num_steps)
+        if plan is None:
             return None
-        pf = self.start_prefill(prompt)
-        if pf is None:
-            cache1, logits1 = self._prefill_fn(self.params, prompt)
-        else:
-            while not pf.done:
-                pf.feed(pf.n_chunks)
-            cache1, logits1 = pf.result()
-        return self.join_prefilled(
-            cache1, logits1, prompt_len=int(prompt.shape[1]),
-            num_steps=num_steps, temperature=temperature, top_p=top_p,
+        try:
+            pf = self.prefill_planned(plan)
+            if pf is not None:
+                while not pf.done:
+                    pf.feed(pf.n_chunks)
+        except Exception:
+            # join_planned releases on its own failures, but a feed()
+            # failure never reaches it — don't strand the reservation.
+            self.release_plan(plan)
+            raise
+        return self.join_planned(
+            plan, pf, temperature=temperature, top_p=top_p, seed=seed
+        )
+
+    def join_planned(self, plan: AdmissionPlan,
+                     pf: ChunkedPrefill | None = None, *,
+                     temperature: float = 0.0,
+                     top_p: float | None = None,
+                     seed: int = 0) -> int | None:
+        """Complete a planned admission: collect/run whatever prefill the
+        plan still needs, insert into a free slot, and (paged) register
+        the prompt's blocks for future sharers. ``pf`` is the
+        ChunkedPrefill from ``prefill_planned``, fed to completion by
+        the caller. On any error the plan's reservations are released."""
+        try:
+            if pf is not None:
+                cache, logits = pf.result()
+            elif plan.prefill_tokens == 0:
+                cache, logits = None, jnp.asarray(plan.logits)
+            elif plan.shared_tokens:
+                cache, logits = self._extend_fn(
+                    self.params, self._seed_cache(plan),
+                    jnp.asarray(plan.tokens[:, plan.shared_tokens:]),
+                )
+            else:
+                cache, logits = self._prefill_fn(
+                    self.params, jnp.asarray(plan.tokens)
+                )
+        except Exception:
+            self.release_plan(plan)
+            raise
+        if not self.kv_paged:
+            return self.join_prefilled(
+                cache, logits, prompt_len=plan.prompt_len,
+                num_steps=plan.num_steps, temperature=temperature,
+                top_p=top_p, seed=seed,
+            )
+        return self._join_paged(
+            plan, cache, logits, temperature=temperature, top_p=top_p,
             seed=seed,
         )
+
+    def _sampling_state(self, slot: int, num_steps: int,
+                        temperature: float, top_p: float | None,
+                        seed: int) -> np.ndarray:
+        """Validate sampling params and build the slot's key ladder
+        (solo generate's exact split(rng, num_steps) schedule —
+        num_steps-dependent, hence precomputed per request rather than
+        derivable inside the fixed-shape step). Raises BEFORE any slot
+        state is written."""
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p={top_p} must be in (0, 1]")
+        if top_p is not None and temperature <= 0:
+            raise ValueError(
+                "top_p requires temperature > 0 (greedy ignores it)"
+            )
+        keys = np.zeros((self.cfg.max_seq_len, 2), np.uint32)
+        if temperature > 0:
+            keys[:num_steps] = np.asarray(
+                jax.random.split(jax.random.PRNGKey(seed), num_steps)
+            )
+        self._temperature[slot] = max(0.0, float(temperature))
+        self._top_p[slot] = 1.0 if top_p is None else float(top_p)
+        self._has_top_p[slot] = top_p is not None
+        return keys
 
     def join_prefilled(self, cache: Any, logits: jax.Array, *,
                        prompt_len: int, num_steps: int,
                        temperature: float = 0.0,
                        top_p: float | None = None,
                        seed: int = 0) -> int | None:
-        """Insert a finished solo prefill into a free slot. The slot's
-        first generated token comes from ``logits`` (the last prompt
-        position) at the next ``step`` — exactly the solo recurrence."""
+        """Insert a finished solo prefill into a free slot (DENSE layout
+        — paged admissions go through the planned API, which knows which
+        blocks the rows land in). The slot's first generated token comes
+        from ``logits`` (the last prompt position) at the next ``step``
+        — exactly the solo recurrence."""
+        if self.kv_paged:
+            raise RuntimeError(
+                "paged engines admit via plan_admission/join_planned "
+                "(the insert needs the plan's block tables)"
+            )
         self.validate_request(prompt_len, num_steps)
         slot = self.alloc.acquire()
         if slot is None:
             return None
-        keys = np.zeros((self.cfg.max_seq_len, 2), np.uint32)
-        if temperature > 0:
-            # Solo generate's exact key ladder: split(rng, num_steps) —
-            # num_steps-dependent, hence precomputed per request rather
-            # than derivable inside the fixed-shape step.
-            keys[:num_steps] = np.asarray(
-                jax.random.split(jax.random.PRNGKey(seed), num_steps)
+        try:
+            keys = self._sampling_state(
+                slot, num_steps, temperature, top_p, seed
             )
-        if top_p is not None and not 0.0 < top_p <= 1.0:
+        except Exception:
             self.alloc.release(slot)
-            raise ValueError(f"top_p={top_p} must be in (0, 1]")
-        if top_p is not None and temperature <= 0:
-            self.alloc.release(slot)
-            raise ValueError(
-                "top_p requires temperature > 0 (greedy ignores it)"
-            )
+            raise
         state = (self._cache, self._logits, self._keys, self._stepidx)
         state = self._insert_slot(state, slot, plain_tree(cache), logits,
                                   keys)
         self._cache, self._logits, self._keys, self._stepidx = state
         self._active[slot] = True
-        self._temperature[slot] = max(0.0, float(temperature))
-        self._top_p[slot] = 1.0 if top_p is None else float(top_p)
-        self._has_top_p[slot] = top_p is not None
+        return slot
+
+    def _join_paged(self, plan: AdmissionPlan, cache: Any | None,
+                    logits: jax.Array, *, temperature: float,
+                    top_p: float | None, seed: int) -> int | None:
+        slot = self.alloc.acquire()
+        if slot is None:  # single-caller contract makes this unreachable
+            self.release_plan(plan)
+            return None
+        try:
+            keys = self._sampling_state(
+                slot, plan.num_steps, temperature, top_p, seed
+            )
+        except Exception:
+            self.alloc.release(slot)
+            self.release_plan(plan)
+            raise
+        read = jnp.asarray(plan.read_table)
+        if cache is None:
+            # Exact prefix match: every prompt row already lives in
+            # shared blocks — only the table row and counters change.
+            self._cache = self._table_insert(
+                self._cache, jnp.int32(slot), read,
+                jnp.int32(plan.prompt_len),
+            )
+        else:
+            self._cache = self._paged_insert(
+                self._cache, jnp.int32(slot),
+                jnp.asarray(plan.write_table), read, plain_tree(cache),
+            )
+        row = jnp.asarray(logits).reshape(-1)
+        self._logits = self._logits.at[slot].set(row)
+        self._keys = self._keys.at[slot].set(jnp.asarray(keys))
+        self._stepidx = self._stepidx.at[slot].set(0)
+        self._active[slot] = True
+        plan.settled = True  # blocks now belong to the slot
+        cow = None
+        if plan.cow is not None:
+            entry, dst = plan.cow
+            cow = (entry, int(plan.read_table[entry]), dst)
+        self._slot_state[slot] = {
+            "private": list(plan.private_blocks),
+            "shared": list(plan.shared_blocks),
+            "cow": cow,
+        }
+        # Register this prompt's blocks for future sharers (prompt rows
+        # only — generated tokens never enter the registry); the stored
+        # logits row lets an exact re-admission skip prefill entirely.
+        prompt_blocks = plan.read_table[
+            : -(-plan.prompt_len // self.kv_block)
+        ]
+        self.prefix.register(plan.tokens[0], prompt_blocks,
+                             np.asarray(row))
+        if plan.shared_tokens:
+            self.prefill_tokens_saved += plan.shared_tokens
+            SERVE_PREFILL_SAVED_TOTAL.inc(plan.shared_tokens)
+        self._set_block_gauges()
         return slot
 
     def _insert_slot(self, state, slot, cache1, logits1, keys1):
@@ -232,19 +588,7 @@ class ContinuousEngine:
         ]
 
         def one(cache1, logits1, key1, temp, tp, has_tp):
-            # The solo sample body (transformer._generate_fn) with the
-            # compile-time temperature/top_p branches turned into traced
-            # selects — values, not executables, so occupancy and
-            # sampling mix never recompile. where(greedy, 1, temp) guards
-            # the division; the greedy lane takes the argmax anyway.
-            greedy = temp <= 0
-            scaled = logits1 / jnp.where(greedy, 1.0, temp)
-            filt = jnp.where(
-                has_tp, _nucleus_filter(scaled[None], tp)[0], scaled
-            )
-            samp = jax.random.categorical(key1, filt[None, :])[0]
-            tok = jnp.where(greedy, logits1.argmax(-1), samp)
-            tok = tok.astype(jnp.int32)
+            tok = _sample_token(logits1, key1, temp, tp, has_tp)
             nxt, upd = self._model.apply(
                 {"params": params, "cache": cache1}, tok[None, None],
                 mutable=["cache"],
@@ -256,10 +600,57 @@ class ContinuousEngine:
         )
         return cache, logits, stepidx + 1, toks
 
+    def _step_paged(self, params, cache, logits, keys, stepidx, active,
+                    temperature, top_p, has_top_p):
+        """The paged decode step: the SAME vmapped sampling body as the
+        dense step, then ONE batched forward — the pool is shared state
+        a vmap lane could not mutate, and the kv_paged attention carries
+        per-lane counters/tables itself. Identical per-lane math either
+        way (the bit-exactness pin's whole argument)."""
+        cache = mask_inactive_indices(cache, active)
+        key = keys[
+            jnp.arange(self.max_slots),
+            jnp.clip(stepidx, 0, self.cfg.max_seq_len - 1),
+        ]
+        toks = jax.vmap(_sample_token)(
+            logits, key, temperature, top_p, has_top_p
+        )
+        nxt, upd = self._model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            mutable=["cache"],
+        )
+        return plain_tree(upd["cache"]), nxt[:, 0], stepidx + 1, toks
+
+    def _run_pending_cows(self) -> None:
+        """Execute copy-on-write for every slot about to take its first
+        decode write into a shared partial block: copy the block into
+        the slot's reserved private one and repoint the table entry —
+        BEFORE the step whose write would otherwise land in the donor's
+        block. Deterministic join order; one traced executable; the
+        freed src may invalidate prefix entries (last holder gone)."""
+        for slot, st in self._slot_state.items():
+            if st["cow"] is None or not self._active[slot]:
+                continue
+            entry, src, dst = st["cow"]
+            self._cache = self._cow_fn(
+                self._cache, jnp.int32(slot), jnp.int32(entry),
+                jnp.int32(src), jnp.int32(dst),
+            )
+            st["cow"] = None
+            st["shared"].remove(src)
+            freed = self.blocks.free([src])
+            if freed:
+                self.prefix.invalidate_blocks(freed)
+            self.cow_copies += 1
+            SERVE_KV_COW_TOTAL.inc()
+            self._set_block_gauges()
+
     def step(self) -> np.ndarray:
-        """One decode iteration over the WHOLE slot tensor: every active
-        slot advances one token. Returns the [max_slots] int32 token
-        vector (inactive rows are dead compute — ignore them)."""
+        """One decode iteration over ALL slots: every active slot
+        advances one token. Returns the [max_slots] int32 token vector
+        (inactive rows are dead compute — ignore them)."""
+        if self.kv_paged:
+            self._run_pending_cows()
         self._cache, self._logits, self._stepidx, toks = self._step_fn(
             self.params, self._cache, self._logits, self._keys,
             self._stepidx, jnp.asarray(self._active),
@@ -270,15 +661,54 @@ class ContinuousEngine:
         return np.asarray(toks)
 
     def retire(self, slot: int) -> None:
-        """Release a slot. Purely host-side: the row's stale K/V are
-        masked by the next occupant's own counters (kvcache.py)."""
+        """Release a slot. Dense: purely host-side — the row's stale K/V
+        are masked by the next occupant's own counters. Paged: also
+        host-side (the lane's index-0 writes are dropped and its reads
+        masked), plus block bookkeeping: private blocks return to the
+        pool, shared refcounts drop, and prefix entries whose last
+        holder this was are invalidated."""
         self._active[slot] = False
         self._temperature[slot] = 0.0
         self._top_p[slot] = 1.0
         self._has_top_p[slot] = False
+        if self.kv_paged:
+            st = self._slot_state.pop(slot, None)
+            if st is not None:
+                freed = self.blocks.free(st["private"] + st["shared"])
+                if freed:
+                    self.prefix.invalidate_blocks(freed)
+                self._set_block_gauges()
         self.alloc.release(slot)
 
     # -- observability ----------------------------------------------------
+
+    def _set_block_gauges(self) -> None:
+        SERVE_KV_BLOCKS.set(self.blocks.free_blocks, state="free")
+        SERVE_KV_BLOCKS.set(self.blocks.used, state="used")
+        SERVE_KV_BLOCKS.set(self.blocks.shared, state="shared")
+
+    def kv_debug(self) -> dict:
+        """Block-pool stats for /debug/serve."""
+        if not self.kv_paged:
+            return {
+                "mode": "dense",
+                "cache_rows": self.max_slots,
+                "max_seq_len": self.cfg.max_seq_len,
+            }
+        return {
+            "mode": "paged",
+            "block": self.kv_block,
+            "table_len": self.table_len,
+            "blocks_total": self.kv_blocks,
+            "blocks_free": self.blocks.free_blocks,
+            "blocks_used": self.blocks.used,
+            "blocks_shared": self.blocks.shared,
+            "blocks_high_water": self.blocks.high_water,
+            "cow_copies": self.cow_copies,
+            "prefix_entries": self.prefix.entries,
+            "prefix_hits": self.prefix.hits,
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+        }
 
     @property
     def active_slots(self) -> int:
@@ -292,6 +722,6 @@ class ContinuousEngine:
     def decode_step_compiles(self) -> int:
         """Compiled-executable count of the decode step — the
         zero-recompile pin: after the constructor's warmup this must
-        never grow across occupancy changes
-        (tests/test_serve_engine.py asserts == warmup_compiles)."""
+        never grow across occupancy changes, block-table growth, or CoW
+        copies (tests assert == warmup_compiles)."""
         return self._step_fn._cache_size()

@@ -7,6 +7,11 @@ directly and serves the same payload from the same path, so dashboards
 read one shape either way.
 
     GET /debug/serve → scheduler.debug_snapshot()
+
+The payload carries a ``kv_cache`` section with the block-pool stats
+(paged mode: block size, free/used/shared block counts, CoW copies,
+prefix-cache hits, prefill tokens saved — the same numbers the
+``tpu_serve_kv_*`` metric families export).
 """
 
 from __future__ import annotations

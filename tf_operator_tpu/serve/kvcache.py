@@ -1,35 +1,63 @@
-"""Slot-based KV cache for continuous batching: the solo decode cache,
-stacked over a leading SLOT axis, plus a free-slot allocator.
+"""KV-cache storage for continuous batching: the dense slot tensor, the
+block-paged pool with copy-on-write prefix sharing, and their host-side
+allocators.
 
-The solo decode path (models/transformer.py, ``decode=True``) keeps one
-cache pytree per request: per-layer ``cached_key``/``cached_value``
-buffers of ``[1, max_seq_len, KV, Dh]`` (int8 + per-(token, head) scale
-sidecars under ``kv_int8``) and scalar position counters. Continuous
-batching needs ``max_slots`` of those living side by side so requests can
-occupy and release rows INDEPENDENTLY — so this module stacks that exact
-pytree over a new leading axis: every leaf becomes ``[N, *solo_shape]``
-(scalar counters become ``[N]`` int32 vectors). Nothing about the solo
-layout changes, which is what makes the engine's per-slot decode step a
-plain ``jax.vmap`` of the solo single-token step — the per-slot math is
-the solo math, the exactness pins in tests/test_serve_engine.py hold
-bit-for-bit, and the kv-int8 variant comes along for free.
+Two layouts, one engine (serve/engine.py picks per ``kv_paged``):
 
-A slot's lifecycle: ``SlotAllocator.acquire`` (host-side bookkeeping) →
-the engine writes a freshly prefilled solo cache into the slot row
-(``make_insert_fn`` — one jitted executable, slot index a traced
-argument, so joins never recompile) → decode steps mutate the row in
-place (the engine donates the stacked tree through its step) →
-``SlotAllocator.release``. Nothing is cleared on release: the next
-occupant's prefill insert overwrites the whole row, and decode attention
-masks cache positions beyond the slot's own counter, so a previous
-occupant's K/V rows are unreachable garbage, never data.
+**Dense slot tensor** (the PR-5 layout, now the ``--kv-dense`` escape
+hatch). The solo decode cache pytree (models/transformer.py,
+``decode=True``: per-layer ``cached_key``/``cached_value`` of
+``[1, max_seq_len, KV, Dh]`` plus scalar counters) stacked over a
+leading ``max_slots`` axis. One allocation up front; occupancy changes
+never allocate; the engine's decode step is a plain ``jax.vmap`` of the
+solo single-token step. Simple — but every slot pre-pays ``max_seq_len``
+rows whether its request uses 200 of them or all of them.
+
+**Block-paged pool** (the default). Per layer, ONE pooled tensor of
+``[kv_num_blocks, kv_block, KV, Dh]`` token blocks; each slot carries a
+``[max_seq_len // kv_block]`` int32 block table (gather indices into the
+pool — runtime DATA, so table contents never recompile) and a per-lane
+position counter. ``BlockAllocator`` hands out refcounted blocks to
+ACTUAL lengths (prompt + max new tokens), so the admission limit becomes
+"enough free blocks", not "a free max-len row" — the occupancy/memory
+multiplier for HBM-bound serving. Block 0 is RESERVED: the pinned
+garbage block that unused table entries point at (always masked by the
+position counters, never allocated, never read into results).
+
+**Prefix sharing + copy-on-write.** ``PrefixCache`` keys live prompts by
+block-aligned prefix hash: a new request whose prompt extends a
+registered prefix maps those table entries to the donor's physical
+blocks (refcount bumps) and prefills only its suffix; an EXACT
+whole-prompt match also reuses the donor's stored last-position logits
+and skips prefill entirely. Shared full blocks hold only immutable
+prompt rows and are never written; the one writable case — an exact
+match whose last block is PARTIAL (the sharer's first generated token
+lands in it) — is handled by copy-on-write: the engine copies the block
+to a privately-owned one right before the first step that would write
+it (``make_cow_fn``). Entries reference live blocks only: when the last
+holder of a block releases it, every entry touching that block drops —
+reuse spans concurrently-live requests (where the serving win is); a
+persistent prefix store would need an eviction policy against the same
+pool and is future work.
+
+A slot's lifecycle is unchanged from PR 5 — acquire → insert a finished
+solo prefill → in-place decode steps → release — and nothing is cleared
+on release in either layout: the next occupant's insert overwrites (or
+the reallocated blocks' next owner does), and decode attention masks
+positions beyond each lane's own counter, so stale K/V are unreachable
+garbage, never data.
 """
 
 from __future__ import annotations
 
+import hashlib
+import heapq
 import threading
 from collections.abc import Mapping
+from dataclasses import dataclass
 from typing import Any
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +65,9 @@ import jax.numpy as jnp
 # Position-counter leaf names in the decode cache (the two MUST move in
 # lockstep — see transformer.set_cache_index, which owns that contract).
 INDEX_KEYS = ("cache_index", "pos_index")
+
+# Paged pool leaf -> the dense/solo leaf holding the same rows.
+POOL_KEYS = {"pool_key": "cached_key", "pool_value": "cached_value"}
 
 
 def plain_tree(tree: Any) -> Any:
@@ -60,12 +91,25 @@ def solo_cache_template(model: Any) -> Any:
 
 
 def stack_slots(template: Any, max_slots: int) -> Any:
-    """Preallocate the slot tensor: every solo leaf grows a leading
+    """Preallocate the dense slot tensor: every solo leaf grows a leading
     [max_slots] axis, zero-filled. One allocation up front — occupancy
     changes never allocate or reshape anything again."""
     return jax.tree.map(
         lambda x: jnp.zeros((max_slots,) + x.shape, x.dtype),
         plain_tree(template),
+    )
+
+
+def paged_cache_template(model: Any, max_slots: int) -> Any:
+    """The paged engine's whole cache state in one init: a [max_slots, 1]
+    token batch through the kv_paged model builds the per-layer pools
+    ([kv_num_blocks, kv_block, KV, Dh]), per-lane block tables
+    ([max_slots, table_len] int32, all entries on the pinned block 0),
+    and per-lane counters ([max_slots] int32)."""
+    return plain_tree(
+        model.init(
+            jax.random.PRNGKey(0), jnp.zeros((max_slots, 1), jnp.int32)
+        )["cache"]
     )
 
 
@@ -76,7 +120,9 @@ def mask_inactive_indices(cache: Any, active: jax.Array) -> Any:
     counters would keep advancing: past max_seq_len the K/V write clamps
     onto the last row and the position-embedding gather goes out of
     range. Active slots' counters pass through untouched, so the reset
-    is invisible to real requests."""
+    is invisible to real requests. (The paged attend additionally DROPS
+    the writes of index-0 lanes, so a retired lane's stale block table
+    can never corrupt a reallocated block.)"""
 
     def walk(node):
         if isinstance(node, Mapping):
@@ -91,9 +137,10 @@ def mask_inactive_indices(cache: Any, active: jax.Array) -> Any:
 
 def make_insert_fn():
     """Jitted (stacked, slot, solo) → stacked with that slot row replaced
-    by the solo cache. ``slot`` is a TRACED int32 argument, so one
-    executable serves every slot; the stacked tree is donated — a join
-    updates the slot tensor in place rather than doubling it."""
+    by the solo cache (dense layout). ``slot`` is a TRACED int32
+    argument, so one executable serves every slot; the stacked tree is
+    donated — a join updates the slot tensor in place rather than
+    doubling it."""
 
     def insert(stacked, slot, solo):
         return jax.tree.map(
@@ -103,18 +150,154 @@ def make_insert_fn():
     return jax.jit(insert, donate_argnums=(0,))
 
 
+def make_paged_insert_fn(num_blocks: int, block: int):
+    """Jitted (paged, slot, write_table, read_table, solo) → paged with:
+
+    - the solo dense cache's K/V rows scattered into pool blocks through
+      ``write_table`` — entries pointing at block 0 dump their rows into
+      the pinned garbage block, which is how shared-prefix rows (already
+      resident in the donor's blocks) and rows past the prompt are
+      skipped WITHOUT a dynamic-length scatter;
+    - the slot's block-table row set to ``read_table`` (the real blocks,
+      shared ones included);
+    - the slot's counters set from the solo counters.
+
+    slot and both tables are traced DATA: one executable serves every
+    join, every table content, every sharing pattern. The paged tree is
+    donated (in-place on device)."""
+
+    def insert(paged, slot, write_table, read_table, solo):
+        def walk(p, s):
+            if not isinstance(p, Mapping):
+                return p
+            out = {}
+            for name, leaf in p.items():
+                if name in POOL_KEYS:
+                    rows = s[POOL_KEYS[name]][0]  # [S, KV, Dh]
+                    pos = jnp.arange(rows.shape[0])
+                    flat = write_table[pos // block] * block + pos % block
+                    flat_pool = leaf.reshape(
+                        (num_blocks * block,) + leaf.shape[2:]
+                    )
+                    out[name] = flat_pool.at[flat].set(rows).reshape(
+                        leaf.shape
+                    )
+                elif name == "block_table":
+                    out[name] = leaf.at[slot].set(read_table)
+                elif name in INDEX_KEYS:
+                    out[name] = leaf.at[slot].set(
+                        jnp.asarray(s[name], jnp.int32)
+                    )
+                else:
+                    out[name] = walk(leaf, s[name])
+            return out
+
+        return walk(paged, solo)
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+def make_table_insert_fn():
+    """Jitted (paged, slot, read_table, index) → paged with only the
+    slot's block-table row and counters set — the exact-prefix-match
+    join, where every prompt row already lives in shared blocks and
+    there is nothing to scatter."""
+
+    def insert(paged, slot, read_table, index):
+        def walk(p):
+            if not isinstance(p, Mapping):
+                return p
+            out = {}
+            for name, leaf in p.items():
+                if name == "block_table":
+                    out[name] = leaf.at[slot].set(read_table)
+                elif name in INDEX_KEYS:
+                    out[name] = leaf.at[slot].set(index)
+                else:
+                    out[name] = walk(leaf)
+            return out
+
+        return walk(paged)
+
+    return jax.jit(insert, donate_argnums=(0,))
+
+
+def make_gather_fn(block: int):
+    """Jitted (paged, table) → a SOLO dense cache whose K/V rows are the
+    table's blocks in order (counters zero): the seed for a shared-prefix
+    SUFFIX prefill — gather the donor's prefix rows back into the dense
+    layout, ``set_cache_index(n)``, and run the remaining prompt through
+    the ordinary dense prefill path. Rows beyond the shared prefix
+    gather whatever the table's private/garbage blocks hold; the suffix
+    prefill overwrites [n:L) before reading them and masks the rest, so
+    only the prefix rows matter — and those are bitwise the donor's."""
+
+    def gather(paged, table):
+        def walk(p):
+            if not isinstance(p, Mapping):
+                return p
+            out = {}
+            for name, leaf in p.items():
+                if name in POOL_KEYS:
+                    rows = leaf[table].reshape(
+                        (table.shape[0] * block,) + leaf.shape[2:]
+                    )
+                    out[POOL_KEYS[name]] = rows[None]
+                elif name == "block_table":
+                    continue  # paged-only bookkeeping
+                elif name in INDEX_KEYS:
+                    out[name] = jnp.zeros((), jnp.int32)
+                else:
+                    out[name] = walk(leaf)
+            return out
+
+        return walk(paged)
+
+    return jax.jit(gather)
+
+
+def make_cow_fn():
+    """Jitted (paged, slot, entry, src, dst) → paged with every layer's
+    pool block ``src`` copied into ``dst`` and the slot's table entry
+    switched to ``dst`` — the copy-on-write step, run by the engine right
+    before the first decode write into a shared partial block. All
+    indices traced; one executable serves every copy; the tree is
+    donated."""
+
+    def cow(paged, slot, entry, src, dst):
+        def walk(p):
+            if not isinstance(p, Mapping):
+                return p
+            out = {}
+            for name, leaf in p.items():
+                if name in POOL_KEYS:
+                    out[name] = leaf.at[dst].set(leaf[src])
+                elif name == "block_table":
+                    out[name] = leaf.at[slot, entry].set(dst)
+                else:
+                    out[name] = walk(leaf)
+            return out
+
+        return walk(paged)
+
+    return jax.jit(cow, donate_argnums=(0,))
+
+
 class SlotAllocator:
     """Free-slot bookkeeping for the slot tensor (host-side, thread-safe).
 
     Lowest-free-index policy — deterministic, which the exactness matrix
-    and the serve bench's seeded schedules rely on. Tracks a high-water
-    mark and cumulative acquire count for the /debug surface."""
+    and the serve bench's seeded schedules rely on — served from a heap:
+    acquire is O(log n) where the original list scan (`min` + `remove`)
+    was O(n) per call. Tracks a high-water mark and cumulative acquire
+    count for the /debug surface."""
 
     def __init__(self, max_slots: int) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots={max_slots} must be >= 1")
         self.max_slots = max_slots
-        self._free = list(range(max_slots))
+        self._heap = list(range(max_slots))  # ascending == already a heap
+        self._free_set = set(self._heap)
         self._lock = threading.Lock()
         self.acquired_total = 0
         self.high_water = 0
@@ -122,10 +305,10 @@ class SlotAllocator:
     def acquire(self) -> int | None:
         """Lowest free slot index, or None when fully occupied."""
         with self._lock:
-            if not self._free:
+            if not self._heap:
                 return None
-            slot = min(self._free)
-            self._free.remove(slot)
+            slot = heapq.heappop(self._heap)
+            self._free_set.discard(slot)
             self.acquired_total += 1
             self.high_water = max(self.high_water, self.in_use)
             return slot
@@ -134,14 +317,258 @@ class SlotAllocator:
         with self._lock:
             if not 0 <= slot < self.max_slots:
                 raise ValueError(f"slot {slot} out of range")
-            if slot in self._free:
+            if slot in self._free_set:
                 raise ValueError(f"slot {slot} double-released")
-            self._free.append(slot)
+            heapq.heappush(self._heap, slot)
+            self._free_set.add(slot)
+
+    def reset_high_water(self) -> None:
+        """Start a fresh high-water window at the current occupancy (the
+        serve bench measures admitted concurrency over its timed pass
+        only, after the untimed warmup)."""
+        with self._lock:
+            self.high_water = self.in_use
 
     @property
     def in_use(self) -> int:
-        return self.max_slots - len(self._free)
+        return self.max_slots - len(self._free_set)
 
     @property
     def free(self) -> int:
-        return len(self._free)
+        return len(self._free_set)
+
+
+class BlockAllocator:
+    """Refcounted allocator for the paged KV block pool (host-side,
+    thread-safe — the engine loop allocates, /debug and /metrics threads
+    read). Block indices below ``reserved`` (the pinned garbage block 0)
+    are never handed out. Same lowest-free-index heap policy as
+    ``SlotAllocator``, for the same determinism reasons.
+
+    Refcounts: an exclusively-owned block has refcount 1; prefix sharing
+    bumps it per sharer. ``free`` decrements and returns the blocks that
+    actually hit zero (the caller invalidates PrefixCache entries that
+    referenced them)."""
+
+    def __init__(self, num_blocks: int, reserved: int = 1) -> None:
+        if num_blocks <= reserved:
+            raise ValueError(
+                f"num_blocks={num_blocks} must exceed the {reserved} "
+                "reserved block(s)"
+            )
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        self._heap = list(range(reserved, num_blocks))
+        self._free_set = set(self._heap)
+        self._refs: dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.high_water = 0
+
+    def alloc(self, k: int) -> list[int] | None:
+        """The k lowest free blocks at refcount 1, or None when fewer
+        than k are free (all-or-nothing: a partial grant would deadlock
+        two half-admitted requests against each other)."""
+        with self._lock:
+            if k > len(self._heap):
+                return None
+            out = [heapq.heappop(self._heap) for _ in range(k)]
+            for blk in out:
+                self._free_set.discard(blk)
+                self._refs[blk] = 1
+            self.high_water = max(self.high_water, self.used)
+            return out
+
+    def ref(self, blocks) -> None:
+        """Bump refcounts of LIVE blocks (prefix sharing)."""
+        with self._lock:
+            for blk in blocks:
+                if blk not in self._refs:
+                    raise ValueError(f"block {blk} is not live")
+                self._refs[blk] += 1
+
+    def free(self, blocks) -> list[int]:
+        """Decrement refcounts; blocks hitting zero return to the pool.
+        Returns the fully-freed blocks (their prefix entries are now
+        invalid)."""
+        freed: list[int] = []
+        with self._lock:
+            for blk in blocks:
+                rc = self._refs.get(blk)
+                if rc is None:
+                    raise ValueError(f"block {blk} double-freed")
+                if rc > 1:
+                    self._refs[blk] = rc - 1
+                    continue
+                del self._refs[blk]
+                heapq.heappush(self._heap, blk)
+                self._free_set.add(blk)
+                freed.append(blk)
+        return freed
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_set)
+
+    @property
+    def used(self) -> int:
+        return self.num_blocks - self.reserved - len(self._free_set)
+
+    @property
+    def shared(self) -> int:
+        """Blocks currently referenced by more than one holder."""
+        with self._lock:
+            return sum(1 for rc in self._refs.values() if rc >= 2)
+
+
+@dataclass
+class _PrefixEntry:
+    tokens: np.ndarray           # the prefix itself (collision guard)
+    n: int                       # prefix length in tokens
+    blocks: tuple[int, ...]      # physical blocks holding rows [0:n)
+    logits: np.ndarray | None    # last-position logits (exact entries)
+
+
+class PrefixCache:
+    """Block-aligned prefix registry for copy-on-write prefix sharing.
+
+    Keys are CHAINED per-block SHA-1 digests — ``D_k = sha1(D_{k-1} +
+    block_k_bytes)``, the exact (partial-tail) key chained once more
+    over the tail — so registering or probing ALL of a prompt's aligned
+    prefixes hashes each token exactly once: O(L) per admission, not
+    the O(L²/block) of rehashing every prefix from scratch (the feature
+    targets long contexts, where that difference sits on the admission
+    hot path). Entries for one prompt share views of a single stored
+    token copy; the view is compared on a digest hit, so a collision
+    degrades to a miss, never to wrong K/V. For an admitted prompt of L
+    tokens the engine registers every full-block prefix (k*block tokens
+    → the first k table blocks) plus the exact prompt (all its blocks,
+    partial last block included, with the last-position logits) — so a
+    later request can share as much block-aligned prefix as it matches,
+    and an identical prompt skips prefill entirely.
+
+    Entries reference LIVE blocks only — no pinning: when the last slot
+    holding a block releases it (``BlockAllocator.free`` reports it),
+    ``invalidate_blocks`` drops every entry referencing it. Reuse spans
+    concurrently-live requests, which is where the serving win is
+    (identical system prompts in flight together); persisting prefixes
+    beyond their last holder would need an eviction policy against the
+    same pool and is future work."""
+
+    def __init__(self, block: int) -> None:
+        self.block = block
+        self._entries: dict[bytes, _PrefixEntry] = {}
+        self._by_block: dict[int, set[bytes]] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    _SEED = hashlib.sha1(b"tpu-kv-prefix").digest()
+
+    def _chain_keys(self, tokens: np.ndarray) -> list[tuple[int, bytes]]:
+        """[(n_tokens, digest)] for every full-block-aligned prefix plus
+        the exact length, LONGEST first, hashing each token exactly
+        once. For an aligned prompt the exact key IS the last
+        full-block key — which is how an exact admission upgrades that
+        entry with its sampling logits."""
+        L, B = len(tokens), self.block
+        digest = self._SEED
+        keys: list[tuple[int, bytes]] = []
+        for k in range(L // B):
+            digest = hashlib.sha1(
+                digest + tokens[k * B:(k + 1) * B].tobytes()
+            ).digest()
+            keys.append(((k + 1) * B, digest))
+        if L % B:
+            keys.append((L, hashlib.sha1(
+                digest + tokens[(L // B) * B:].tobytes()
+            ).digest()))
+        keys.reverse()
+        return keys
+
+    def lookup(self, tokens: np.ndarray):
+        """Longest usable prefix of ``tokens`` ([L] int32): the exact
+        whole prompt first (may end mid-block — sharing that partial
+        block is what makes copy-on-write reachable), else the longest
+        registered full-block prefix. Returns (n_tokens, blocks,
+        logits | None); logits only on an exact whole-prompt match (the
+        donor's last-position row — the sharer's first sampling input).
+        An exact-length match WITHOUT stored logits (the digest was
+        registered as a longer prompt's aligned prefix) is skipped in
+        favor of a shorter match: sharing it would leave nothing to
+        prefill yet no logits to sample from."""
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        L = len(tokens)
+        with self._lock:
+            for n, key in self._chain_keys(tokens):
+                e = self._entries.get(key)
+                if (
+                    e is None
+                    or e.n != n
+                    or not np.array_equal(e.tokens, tokens[:n])
+                ):
+                    continue
+                if n == L and e.logits is None:
+                    continue  # full-length but no sampling row: downgrade
+                self.hits += 1
+                return n, tuple(e.blocks), (
+                    e.logits if n == L else None
+                )
+            self.misses += 1
+        return 0, (), None
+
+    def register(self, tokens: np.ndarray, blocks,
+                 logits: np.ndarray | None = None) -> None:
+        """Register an admitted prompt: ``blocks`` are its table entries
+        ([ceil(L/block)] physical blocks, shared ones included — their
+        digests already exist and are kept, first writer wins).
+        ``logits`` (the last prompt position's row) lands on the exact
+        full-length entry so identical prompts skip prefill."""
+        tokens = np.ascontiguousarray(
+            np.array(tokens, np.int32, copy=True).reshape(-1)
+        )
+        blocks = [int(b) for b in blocks]
+        L, B = len(tokens), self.block
+        with self._lock:
+            for n, key in self._chain_keys(tokens):
+                # Every entry stores a VIEW of the one copy made above
+                # — O(L) memory for the whole prefix family.
+                self._add(key, tokens[:n], n, blocks[: -(-n // B)],
+                          logits if n == L else None)
+
+    def _add(self, key, toks, n, blks, logits):
+        e = self._entries.get(key)
+        if e is not None:
+            if (logits is not None and e.logits is None and e.n == n
+                    and np.array_equal(e.tokens, toks)):
+                # The digest was first registered as a longer prompt's
+                # aligned prefix; this exact admission supplies the
+                # sampling row that upgrade needs.
+                e.logits = np.array(logits, copy=True)
+            return
+        self._entries[key] = _PrefixEntry(
+            toks, n, tuple(blks),
+            None if logits is None else np.array(logits, copy=True),
+        )
+        for b in blks:
+            self._by_block.setdefault(b, set()).add(key)
+
+    def invalidate_blocks(self, freed) -> None:
+        """Drop every entry referencing a block whose last holder just
+        released it (``BlockAllocator.free``'s return value)."""
+        with self._lock:
+            for blk in freed:
+                for key in self._by_block.pop(blk, ()):
+                    e = self._entries.pop(key, None)
+                    if e is None:
+                        continue
+                    for other in e.blocks:
+                        if other != blk:
+                            peers = self._by_block.get(other)
+                            if peers is not None:
+                                peers.discard(key)
+
+    @property
+    def entries(self) -> int:
+        return len(self._entries)

@@ -6,7 +6,15 @@ handler threads talk to it only through ``submit``'s queue + event
 handshake. Each loop iteration:
 
 1. ADMIT + PREFILL (token-budgeted): queued requests move into free
-   slots. Under chunked prefill the iteration feeds at most
+   slots through the engine's PLANNED admission — a plan reserves
+   everything up front (a free slot checked; paged mode also allocates
+   the KV blocks for prompt + max_tokens, after shared-prefix credit),
+   so admission is "free slot AND enough free blocks": when either is
+   exhausted the request stays queued until a retire frees capacity
+   (block-exhaustion queueing). A shared prefix shrinks the prefill to
+   the unshared suffix — an exact whole-prompt match skips it entirely
+   — and the budget/metrics charge only what actually ran. Under
+   chunked prefill the iteration feeds at most
    ``prefill_tokens_per_step`` prompt tokens before decoding again, so a
    long prompt streams in across iterations instead of stalling every
    active slot for its whole prefill — that bound is what keeps decode
@@ -107,8 +115,9 @@ class ContinuousScheduler:
         self._cond = threading.Condition()
         self._queue: deque[ServeRequest] = deque()
         self._slots: dict[int, ServeRequest] = {}
-        # (request, ChunkedPrefill | None): admitted, prefill mid-flight.
-        self._prefilling: tuple[ServeRequest, Any] | None = None
+        # (request, ChunkedPrefill | None, AdmissionPlan): planned
+        # admission with its prefill mid-flight.
+        self._prefilling: tuple[ServeRequest, Any, Any] | None = None
         self._stopping = False
         self._thread: threading.Thread | None = None
         self.decode_steps = 0
@@ -228,30 +237,36 @@ class ContinuousScheduler:
                   else 1 << 30)
         while budget > 0:
             if self._prefilling is None:
-                if self.engine.alloc.free == 0:
-                    return
                 req = self._pop_next()
                 if req is None:
                     return
-                pf = None
-                if self.engine.prefill_chunk is not None:
-                    pf = self.engine.start_prefill(
-                        np.asarray(req.tokens)
+                try:
+                    plan = self.engine.plan_admission(
+                        np.asarray(req.tokens), req.num_steps
                     )
-                self._prefilling = (req, pf)
-            req, pf = self._prefilling
+                except Exception as exc:  # noqa: BLE001 — one bad
+                    # request answers its own client, never the loop.
+                    req._finish("error", exc)
+                    continue
+                if plan is None:
+                    # No free slot — or (paged) not enough free KV
+                    # blocks for prompt + max_tokens: queue until a
+                    # retire frees capacity (block-exhaustion queueing).
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    return
+                try:
+                    pf = self.engine.prefill_planned(plan)
+                except Exception as exc:  # noqa: BLE001
+                    self.engine.release_plan(plan)
+                    req._finish("error", exc)
+                    continue
+                self._prefilling = (req, pf, plan)
+            req, pf, plan = self._prefilling
             t0 = time.perf_counter()
             try:
                 with self._device_lock:
-                    if pf is None:
-                        slot = self.engine.join(
-                            np.asarray(req.tokens),
-                            num_steps=req.num_steps,
-                            temperature=req.temperature, top_p=req.top_p,
-                            seed=req.seed,
-                        )
-                        budget -= req.tokens.shape[1]
-                    else:
+                    if pf is not None:
                         chunks = max(1, int(budget // pf.chunk))
                         budget -= pf.feed(chunks)
                         if not pf.done:
@@ -259,22 +274,29 @@ class ContinuousScheduler:
                                 time.perf_counter() - t0, phase="prefill"
                             )
                             return  # resume next iteration
-                        cache, logits = pf.result()
-                        slot = self.engine.join_prefilled(
-                            cache, logits, prompt_len=pf.prompt_len,
-                            num_steps=req.num_steps,
-                            temperature=req.temperature, top_p=req.top_p,
-                            seed=req.seed,
-                        )
+                    else:
+                        # One-shot (or prefill-free exact match) inside
+                        # join_planned; charge what actually runs —
+                        # shared prefixes cost nothing to re-admit.
+                        budget -= plan.prefill_tokens
+                    slot = self.engine.join_planned(
+                        plan, pf, temperature=req.temperature,
+                        top_p=req.top_p, seed=req.seed,
+                    )
             except Exception as exc:  # noqa: BLE001 — one bad request
-                # answers its own client and never kills the loop.
+                # answers its own client and never kills the loop. The
+                # release is idempotent: join_planned releases (or
+                # consumes) the plan itself, but a pf.feed() failure
+                # never reaches it — without this, a failing chunked
+                # prefill would strand its reserved blocks forever.
+                self.engine.release_plan(plan)
                 self._prefilling = None
                 req._finish("error", exc)
                 continue
             SERVE_STEP_SECONDS.observe(
                 time.perf_counter() - t0, phase="prefill"
             )
-            SERVE_PREFILL_TOKENS_TOTAL.inc(req.tokens.shape[1])
+            SERVE_PREFILL_TOKENS_TOTAL.inc(plan.prefill_tokens)
             self._prefilling = None
             if slot is None:  # raced capacity — put it back, front.
                 with self._cond:
@@ -315,10 +337,27 @@ class ContinuousScheduler:
             leftovers = list(self._queue)
             self._queue.clear()
             if self._prefilling is not None:
-                leftovers.append(self._prefilling[0])
+                req, _, plan = self._prefilling
+                leftovers.append(req)
+                # Host-side undo of the plan's block reservations — a
+                # crashed loop must not strand pool capacity it never
+                # served (the engine may outlive this scheduler in
+                # tests/tools).
+                self.engine.release_plan(plan)
                 self._prefilling = None
-            leftovers.extend(self._slots.values())
+            admitted = dict(self._slots)
+            leftovers.extend(admitted.values())
             self._slots.clear()
+        for slot in admitted:
+            # A crashed loop must hand the engine back whole: admitted
+            # slots' rows AND (paged) their block reservations return to
+            # the pools, so an engine that outlives this scheduler keeps
+            # its full capacity. On a normal drain _slots is already
+            # empty and this is a no-op.
+            try:
+                self.engine.retire(slot)
+            except Exception:  # noqa: BLE001 — failing-all must finish
+                pass
         for req in leftovers:
             if not req.event.is_set():
                 req._finish(
@@ -368,4 +407,8 @@ class ContinuousScheduler:
             "ttft_p50_s": SERVE_TTFT_SECONDS.quantile(0.5),
             "ttft_p99_s": SERVE_TTFT_SECONDS.quantile(0.99),
             "draining": self._stopping,
+            # Block-pool stats (paged: block size, free/used/shared
+            # counts, CoW copies, prefix-cache hits, prefill tokens
+            # saved; dense: the slot-row budget).
+            "kv_cache": self.engine.kv_debug(),
         }

@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Mixed-length open-loop serving bench: continuous batching vs the
-legacy batch-window coalescer, same model, same seeded traffic.
+legacy batch-window coalescer, same model, same seeded traffic — plus
+the long-context + shared-prefix CAPACITY mix: the paged KV cache vs
+the dense slot tensor at the SAME byte budget.
 
 The lm_decode bench line is a static-batch best case (one shape, lock
 step, batch 8); THIS is the serving number: requests with ≥4 distinct
@@ -24,11 +26,24 @@ latency (lock-step clients see nothing earlier). steady_occupancy is the
 mean active-slot fraction over the middle half of decode steps — the
 window where admission has filled and drain has not started.
 
+The CAPACITY section (runs with ``--engine both``; ``--skip-prefix-mix``
+disables) replays a seeded long-context + shared-prefix schedule — every
+prompt opens with one common block-aligned system prefix, a fraction are
+exact duplicates, and prompts use a small slice of a large max_seq_len —
+through TWO continuous engines whose KV budgets are byte-identical: the
+dense slot tensor (few max-len rows) and the paged block pool (same
+bytes, 4x the slots). Each leg's line adds ``admitted_concurrency`` (the
+slot high-water over the timed pass — what the byte budget actually
+admitted), ``prefill_tokens_saved`` and ``cow_copies`` (prefix reuse at
+work); the paged line's ``vs_baseline`` is its tokens/sec over the dense
+leg and ``admitted_ratio`` the concurrency multiple — the ROADMAP item-2
+"what fits at actual lengths" number.
+
 All randomness is seeded (schedule, prompts); wall-clock only enters the
 timing fields, so tests assert structure and token counts, never timing.
 BENCH_SMOKE shrinks shapes for CI. Run:
 
-    JAX_PLATFORMS=cpu python tools/serve_bench.py            # both legs
+    JAX_PLATFORMS=cpu python tools/serve_bench.py            # all legs
     python tools/serve_bench.py --engine continuous          # one leg
 """
 
@@ -50,6 +65,20 @@ import numpy as np  # noqa: E402
 # short/long horizons, so lock-step coalescing has real stragglers.
 SHAPES = [(8, 24), (16, 48), (32, 16), (4, 64)]
 SMOKE_SHAPES = [(4, 6), (8, 10), (12, 4), (2, 12)]
+
+# Capacity-mix geometry: a large max_seq_len budget that every request
+# uses only a small slice of (the dense layout's worst case), one common
+# block-aligned prefix, short tails/horizons, a third exact duplicates.
+CAPACITY = dict(seq=256, block=16, prefix=32, tails=(8, 16, 24, 32),
+                steps=(8, 16), dense_slots=4, slot_mult=4, requests=32,
+                gap_ms=3.0, exact_every=3)
+# gap_ms 0: the smoke profile arrives ALL AT ONCE — CI asserts the
+# admitted-concurrency ratio, and a guaranteed backlog makes that a
+# capacity property rather than a wall-clock one (a machine fast enough
+# to drain 2 ms open-loop arrivals would otherwise never queue).
+SMOKE_CAPACITY = dict(seq=64, block=8, prefix=8, tails=(2, 4, 6),
+                      steps=(4, 6), dense_slots=2, slot_mult=4,
+                      requests=10, gap_ms=0.0, exact_every=3)
 
 
 def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
@@ -179,6 +208,122 @@ def run_continuous(cfg, params, schedule, args) -> dict:
     return leg_summary("continuous", wall_s, results, stats)
 
 
+def build_prefix_schedule(cap: dict, seed: int, vocab: int):
+    """Deterministic long-context + shared-prefix traffic: every prompt
+    opens with ONE common block-aligned prefix, tails/horizons vary, and
+    every ``exact_every``-th request replays an earlier prompt verbatim
+    (the exact-match/CoW path)."""
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, vocab, (cap["prefix"],)).astype(np.int32)
+    out, prompts, t = [], [], 0.0
+    for i in range(cap["requests"]):
+        if prompts and i % cap["exact_every"] == 0:
+            prompt = prompts[int(rng.integers(0, len(prompts)))]
+        else:
+            tail = rng.integers(
+                0, vocab, (int(rng.choice(cap["tails"])),)
+            ).astype(np.int32)
+            prompt = np.concatenate([prefix, tail])[None]
+            prompts.append(prompt)
+        out.append((t, prompt, int(rng.choice(cap["steps"]))))
+        t += float(rng.exponential(cap["gap_ms"])) / 1e3
+    return out
+
+
+def run_capacity_leg(name, cfg, params, schedule, args, *, kv_paged,
+                     max_slots, kv_blocks, kv_block) -> dict:
+    """One capacity-mix leg: a continuous engine (paged or dense) under
+    the shared-prefix long-context schedule, admitted concurrency and
+    prefix-reuse counters measured over the timed pass only."""
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    engine = ContinuousEngine(
+        cfg, params, max_slots=max_slots,
+        prefill_chunk=args.prefill_chunk or None,
+        kv_paged=kv_paged, kv_block=kv_block, kv_blocks=kv_blocks,
+    )
+    sched = ContinuousScheduler(
+        engine, prefill_tokens_per_step=args.prefill_budget
+    ).start()
+
+    def submit(prompt, steps):
+        req = sched.submit_request(ServeRequest(prompt, steps))
+        return list(req.out), req.ttft
+
+    run_schedule(schedule, submit)  # untimed warmup (same engine)
+    sched.reset_stats()
+    engine.alloc.reset_high_water()
+    saved0 = getattr(engine, "prefill_tokens_saved", 0)
+    cows0 = getattr(engine, "cow_copies", 0)
+    wall_s, results = run_schedule(schedule, submit)
+    stats = {
+        "kv": "paged" if kv_paged else "dense",
+        "admitted_concurrency": engine.alloc.high_water,
+        "prefill_tokens_saved":
+            getattr(engine, "prefill_tokens_saved", 0) - saved0,
+        "cow_copies": getattr(engine, "cow_copies", 0) - cows0,
+        "max_batch": max_slots,
+        "kv_block": kv_block if kv_paged else None,
+        "kv_blocks": engine.kv_blocks,
+        "max_seq_len": cfg.max_seq_len,
+        "decode_step_compiles": engine.decode_step_compiles,
+        "warmup_compiles": engine.warmup_compiles,
+    }
+    sched.stop(timeout=30.0)
+    return leg_summary(name, wall_s, results, stats)
+
+
+def run_capacity_mix(args, smoke: bool) -> list[dict]:
+    """The paged-vs-dense capacity comparison at ONE byte budget: the
+    dense leg gets ``dense_slots`` max-len rows; the paged leg gets the
+    SAME bytes as a block pool (dense_slots x table_len blocks + the
+    pinned garbage block) but ``slot_mult`` x the slots — whether that
+    budget admits more live long-context requests is exactly the
+    paged-cache claim."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    cap = SMOKE_CAPACITY if smoke else CAPACITY
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_layers=args.layers, d_ff=args.d_model * 2,
+        max_seq_len=cap["seq"], dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    schedule = build_prefix_schedule(cap, args.seed, args.vocab)
+    table_len = cap["seq"] // cap["block"]
+    pool = cap["dense_slots"] * table_len + 1  # the dense byte budget
+    paged = run_capacity_leg(
+        "paged_longctx", cfg, params, schedule, args, kv_paged=True,
+        max_slots=cap["dense_slots"] * cap["slot_mult"],
+        kv_blocks=pool, kv_block=cap["block"],
+    )
+    dense = run_capacity_leg(
+        "dense_longctx", cfg, params, schedule, args, kv_paged=False,
+        max_slots=cap["dense_slots"], kv_blocks=None,
+        kv_block=cap["block"],
+    )
+    if dense["value"]:
+        paged["vs_baseline"] = round(paged["value"] / dense["value"], 3)
+    if dense["admitted_concurrency"]:
+        paged["admitted_ratio"] = round(
+            paged["admitted_concurrency"]
+            / dense["admitted_concurrency"], 3
+        )
+    return [paged, dense]
+
+
 def run_coalesce(cfg, params, schedule, args) -> dict:
     import jax.numpy as jnp
 
@@ -238,6 +383,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--d-model", type=int, default=None)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--skip-prefix-mix", action="store_true",
+                   help="skip the long-context + shared-prefix capacity "
+                        "section (paged vs dense at one byte budget)")
     args = p.parse_args(argv)
 
     smoke = bool(os.environ.get("BENCH_SMOKE"))
@@ -291,6 +439,8 @@ def main(argv: list[str] | None = None) -> int:
         lines[0]["vs_baseline"] = round(
             lines[0]["value"] / lines[1]["value"], 3
         )
+    if args.engine == "both" and not args.skip_prefix_mix:
+        lines.extend(run_capacity_mix(args, smoke))
     for line in lines:
         print(json.dumps(line), flush=True)
     return 0 if all(not line["errors"] for line in lines) else 1

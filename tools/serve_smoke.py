@@ -1,16 +1,19 @@
 #!/usr/bin/env python
 """Fast continuous-batching smoke: runs the `serve`-marked tests in
-isolation (slot-engine exactness vs solo generate, zero-recompile pins,
-scheduler drain/EOS/metrics, serve-bench structure) — the quick loop for
-iterating on tf_operator_tpu/serve/ without paying for the whole tier-1
-run.
+isolation (slot-engine exactness vs solo generate, paged-cache/CoW/
+prefix-sharing pins, zero-recompile pins, scheduler drain/EOS/metrics,
+serve-bench structure), then one INLINE end-to-end pair through a live
+paged engine + scheduler — a plain paged request and a shared-prefix
+request — asserting both reproduce solo generate bit-for-bit and the
+second actually skipped its prefill. The quick loop for iterating on
+tf_operator_tpu/serve/ without paying for the whole tier-1 run.
 
-    python tools/serve_smoke.py            # the smoke subset
+    python tools/serve_smoke.py            # the smoke subset + e2e pair
     python tools/serve_smoke.py -k drain   # extra pytest args pass through
 
-Exit code is pytest's. CI wires this as the pre-merge gate for serving
-changes; the same tests also run (unmarked-slow, so by default) inside
-the tier-1 command in ROADMAP.md.
+Exit code is pytest's (or 1 if the e2e pair fails). CI wires this as
+the pre-merge gate for serving changes; the same tests also run
+(unmarked-slow, so by default) inside the tier-1 command in ROADMAP.md.
 """
 
 from __future__ import annotations
@@ -20,6 +23,79 @@ import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def paged_e2e_pair() -> int:
+    """One paged + one shared-prefix request end-to-end: live engine,
+    live serving loop, outputs pinned against solo generate, prefix
+    reuse proven by the engine's own counters."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import ContinuousScheduler
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ContinuousEngine(
+        cfg, params, max_slots=2, kv_paged=True, kv_block=8
+    )
+    sched = ContinuousScheduler(engine).start()
+    try:
+        import threading
+        import time
+
+        prompt = np.random.default_rng(5).integers(
+            0, cfg.vocab_size, (1, 13)
+        ).astype(np.int32)
+        steps = 30
+        want = np.asarray(
+            generate(cfg, params, jnp.asarray(prompt), steps)
+        )
+        # Prefix reuse spans LIVE requests: submit the donor on a
+        # thread, wait until it owns a slot (its prompt blocks are
+        # registered), then submit the identical prompt — an exact
+        # match that skips prefill and CoWs its partial last block.
+        first: dict = {}
+
+        def donor():
+            first["out"] = sched.submit(prompt, steps)
+
+        t = threading.Thread(target=donor)
+        t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and engine.active_slots < 1:
+            time.sleep(0.005)
+        assert engine.active_slots >= 1, "donor never reached a slot"
+        second = sched.submit(prompt, steps)  # exact shared-prefix reuse
+        t.join(timeout=60)
+        assert np.array_equal(first.get("out"), want), \
+            "paged output != solo"
+        assert np.array_equal(second, want), "shared-prefix output != solo"
+        assert engine.prefill_tokens_saved >= prompt.shape[1], (
+            "shared-prefix admission did not skip its prefill"
+        )
+        assert engine.decode_step_compiles == engine.warmup_compiles
+        print(
+            f"serve_smoke: paged + shared-prefix e2e pair ok "
+            f"(saved {engine.prefill_tokens_saved} prefill tokens, "
+            f"{engine.cow_copies} CoW copies)", flush=True,
+        )
+        return 0
+    finally:
+        sched.stop(timeout=30.0)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -29,11 +105,16 @@ def main(argv: list[str] | None = None) -> int:
     cmd = [
         sys.executable, "-m", "pytest",
         "tests/test_serve_engine.py", "tests/test_serve_sched.py",
+        "tests/test_kvcache_paged.py",
         "-m", "serve",
         "-q", "-p", "no:cacheprovider",
         *args,
     ]
-    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+    rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+    if rc != 0:
+        return rc
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return paged_e2e_pair()
 
 
 if __name__ == "__main__":
