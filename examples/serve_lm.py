@@ -33,7 +33,25 @@ generate()'s own validation). Two serving engines (``--engine``):
   ``--kv-dense`` falls back to the PR-5 dense slot tensor.
   ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
   ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
-  requests finish, queued ones fail fast with a 503 — no hung sockets.
+  requests finish (bounded by ``--drain-timeout`` — stragglers resolve
+  with partial output + a flag), queued ones fail fast with a 503 — no
+  hung sockets.
+
+  The continuous engine always serves SUPERVISED (serve/resilience.py):
+  requests expire in queue after ``--queue-ttl`` (typed 408) or resolve
+  with their PARTIAL generation + ``"deadline_exceeded": true`` when
+  ``--decode-deadline`` (or a per-request ``"deadline_s"`` field)
+  passes; the queue is bounded (``--queue-limit``, typed 503 +
+  Retry-After above it); low free KV blocks cap admitted max_tokens
+  (``--degraded-blocks``/``--degraded-max-tokens``, response flagged
+  ``"degraded"``); and a watchdog rebuilds a crashed or stalled engine
+  (``--watchdog-stall``, ``--max-restarts``, ``--restart-backoff``) and
+  REPLAYS in-flight requests — greedy replays are bit-identical to an
+  uninterrupted run. Every error response carries ``code``/
+  ``retryable``/``detail`` (and Retry-After where meaningful) so a
+  router can tell retryable replica failures from request errors.
+  ``--faults``/``TPU_SERVE_FAULTS`` arm the seeded fault-injection
+  points (serve/faultinject.py) for chaos drills.
 - ``coalesce``: the legacy lock-step path. Direct per-request decode
   (one compile per (batch, prompt_len, num_steps, temperature, top_p)
   combination), optionally with ``--batch-window MS`` coalescing
@@ -220,6 +238,61 @@ def main(argv: list[str] | None = None) -> int:
                         "byte budget — max-batch x max-seq-len/kv-block "
                         "+ 1; raise max-batch past what the dense "
                         "layout could hold and cap memory here instead)")
+    res = p.add_argument_group(
+        "resilience (continuous engine; 0 disables a knob)"
+    )
+    res.add_argument("--queue-ttl", type=float, default=30.0, metavar="S",
+                     help="expire requests still queued after this many "
+                          "seconds with a typed 408 + Retry-After "
+                          "(they never cost device work)")
+    res.add_argument("--decode-deadline", type=float, default=120.0,
+                     metavar="S",
+                     help="default end-to-end deadline: past it a "
+                          "request resolves with its PARTIAL generation "
+                          "and \"deadline_exceeded\": true instead of "
+                          "hanging (per-request \"deadline_s\" "
+                          "overrides)")
+    res.add_argument("--watchdog-stall", type=float, default=10.0,
+                     metavar="S",
+                     help="serving-loop heartbeat silence that triggers "
+                          "an engine teardown + rebuild + in-flight "
+                          "replay; must exceed the worst-case single "
+                          "device op INCLUDING a cold prefill compile")
+    res.add_argument("--max-restarts", type=int, default=3,
+                     help="consecutive watchdog restarts before the "
+                          "replica declares itself dead and drains "
+                          "typed 503s (the budget resets once a rebuilt "
+                          "engine completes a request)")
+    res.add_argument("--restart-backoff", type=float, default=0.25,
+                     metavar="S",
+                     help="base of the exponential backoff between "
+                          "watchdog restarts")
+    res.add_argument("--queue-limit", type=int, default=None, metavar="N",
+                     help="bounded queue watermark: above it new "
+                          "requests shed with a typed 503 + Retry-After "
+                          "(reject-newest; default 8x --max-batch)")
+    res.add_argument("--degraded-blocks", type=float, default=0.1,
+                     metavar="FRAC",
+                     help="degraded mode: when the free KV-block "
+                          "fraction drops below this, admitted "
+                          "max_tokens is capped (paged engines only)")
+    res.add_argument("--degraded-max-tokens", type=int, default=32,
+                     metavar="N",
+                     help="the degraded-mode max_tokens cap (responses "
+                          "carry \"degraded\": true)")
+    res.add_argument("--drain-timeout", type=float, default=30.0,
+                     metavar="S",
+                     help="bound the SIGTERM drain: past it the "
+                          "remaining admitted requests resolve with "
+                          "partial output + the drain flag instead of "
+                          "holding shutdown")
+    res.add_argument("--faults", default=None, metavar="SPEC",
+                     help="arm seeded fault-injection points (chaos "
+                          "drills): e.g. 'step_raise@40,step_stall@90:5'"
+                          " — see serve/faultinject.py; default: the "
+                          "TPU_SERVE_FAULTS env var")
+    res.add_argument("--fault-seed", type=int, default=0,
+                     help="seed for probabilistic fault entries")
     args = p.parse_args(argv)
     legacy_flags = [flag for flag, on in (
         ("--spec-k", bool(args.spec_k)),
@@ -429,7 +502,11 @@ def main(argv: list[str] | None = None) -> int:
     engine_sched = None
     if args.engine == "continuous":
         from tf_operator_tpu.serve.engine import ContinuousEngine
-        from tf_operator_tpu.serve.scheduler import ContinuousScheduler
+        from tf_operator_tpu.serve.faultinject import FaultInjector
+        from tf_operator_tpu.serve.resilience import (
+            EngineSupervisor,
+            ResilienceConfig,
+        )
 
         kv_paged = args.kv_paged
         if kv_paged and args.kv_int8:
@@ -443,18 +520,47 @@ def main(argv: list[str] | None = None) -> int:
             p.error(f"--max-seq-len {args.max_seq_len} must be a "
                     f"multiple of --kv-block {args.kv_block} "
                     "(or use --kv-dense)")
-        engine_sched = ContinuousScheduler(
-            ContinuousEngine(
+        if args.faults is not None:
+            faults = FaultInjector(args.faults, seed=args.fault_seed)
+        else:
+            faults = FaultInjector.from_env()
+        if faults.enabled:
+            print(f"serve_lm: FAULT INJECTION armed: "
+                  f"{faults.snapshot()['armed']}", flush=True)
+        res_cfg = ResilienceConfig(
+            queue_ttl_s=args.queue_ttl or None,
+            decode_deadline_s=args.decode_deadline or None,
+            watchdog_stall_s=args.watchdog_stall or None,
+            max_restarts=args.max_restarts,
+            restart_backoff_s=args.restart_backoff,
+            queue_limit=(args.queue_limit if args.queue_limit is not None
+                         else 8 * args.max_batch) or None,
+            degraded_free_block_frac=args.degraded_blocks or 0.0,
+            degraded_max_tokens=args.degraded_max_tokens,
+            drain_timeout_s=args.drain_timeout or None,
+        )
+
+        def engine_factory():
+            # The watchdog rebuilds through here: SAME cfg/params every
+            # time, so a replayed greedy request is bit-identical to an
+            # uninterrupted run.
+            return ContinuousEngine(
                 cfg, params, max_slots=args.max_batch,
                 prefill_chunk=(args.prefill_chunk or None),
                 kv_paged=kv_paged, kv_block=args.kv_block,
                 kv_blocks=args.kv_pool_blocks,
-            ),
+                faults=faults,
+            )
+
+        engine_sched = EngineSupervisor(
+            engine_factory,
+            resilience=res_cfg,
+            faults=faults,
             prefill_tokens_per_step=args.prefill_budget,
             # Streaming requests bypass the engine and share the chip:
             # one lock serializes both decode paths.
             device_lock=lock,
-        ).start()
+        )
         kv_desc = (
             f"paged kv ({args.kv_block}-token blocks, "
             f"{engine_sched.engine.kv_blocks} block pool)"
@@ -463,7 +569,12 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serve_lm: continuous batching "
               f"(slots {args.max_batch}, {kv_desc}, prefill chunk "
               f"{args.prefill_chunk or 'one-shot'}, prefill budget "
-              f"{args.prefill_budget} tok/iter)", flush=True)
+              f"{args.prefill_budget} tok/iter; deadlines "
+              f"queue={args.queue_ttl or 'off'}s "
+              f"decode={args.decode_deadline or 'off'}s, watchdog "
+              f"{args.watchdog_stall or 'off'}s x{args.max_restarts}, "
+              f"queue limit {res_cfg.queue_limit or 'off'}, drain "
+              f"{args.drain_timeout or 'unbounded'}s)", flush=True)
     elif args.batch_window > 0:
         from tf_operator_tpu.serve.coalesce import Coalescer
 
@@ -484,11 +595,14 @@ def main(argv: list[str] | None = None) -> int:
         def log_message(self, *a):  # quiet
             pass
 
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(self, code: int, payload: dict,
+                  headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -497,11 +611,18 @@ def main(argv: list[str] | None = None) -> int:
                 payload = {"ok": True, "served": served,
                            "engine": args.engine}
                 if engine_sched is not None:
-                    payload["active_slots"] = engine_sched.engine.active_slots
+                    payload["active_slots"] = engine_sched.active_slots
                     payload["queue_depth"] = engine_sched.queue_depth
                     payload["requests_done"] = engine_sched.requests_done
                     payload["tokens_generated"] = \
                         engine_sched.tokens_generated
+                    payload["watchdog_restarts"] = engine_sched.restarts
+                    if engine_sched.dead:
+                        # Still answering /healthz, but not serving:
+                        # the replica wants a router/operator to
+                        # replace it.
+                        payload["ok"] = False
+                        payload["dead"] = True
                 if coalescer is not None:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
@@ -619,13 +740,20 @@ def main(argv: list[str] | None = None) -> int:
                     # solo output exactly). Multi-row prompts split into
                     # per-row requests — rows are independent streams to
                     # a slot engine — and reassemble in order. An
-                    # optional "eos_id" retires a row early.
+                    # optional "eos_id" retires a row early; an optional
+                    # "deadline_s" overrides --decode-deadline per
+                    # request.
                     import numpy as _np
 
+                    from tf_operator_tpu.serve.scheduler import (
+                        ServeRequest,
+                    )
+
                     eos_id = req.get("eos_id")
+                    deadline_s = req.get("deadline_s")
 
                     def _row(i):
-                        return engine_sched.submit(
+                        r = ServeRequest(
                             _np.asarray(prompt[i:i + 1]), num_steps,
                             temperature=temperature,
                             top_p=(None if top_p is None
@@ -639,10 +767,13 @@ def main(argv: list[str] | None = None) -> int:
                             seed=int(req.get("seed", 0)) + i,
                             eos_id=(None if eos_id is None
                                     else int(eos_id)),
-                        )[0].tolist()
+                            deadline_s=(None if deadline_s is None
+                                        else float(deadline_s)),
+                        )
+                        return engine_sched.submit_request(r)
 
                     if prompt.shape[0] == 1:
-                        out = [_row(0)]
+                        rows = [_row(0)]
                     else:
                         # Rows decode concurrently (submit blocks per
                         # request; serializing them would run the batch
@@ -655,7 +786,32 @@ def main(argv: list[str] | None = None) -> int:
                         with ThreadPoolExecutor(
                             min(prompt.shape[0], args.max_batch)
                         ) as ex:
-                            out = list(ex.map(_row, range(prompt.shape[0])))
+                            rows = list(
+                                ex.map(_row, range(prompt.shape[0]))
+                            )
+                    out = [list(r.out) for r in rows]
+                    payload = {"tokens": out}
+                    if any(r.deadline_exceeded for r in rows):
+                        # Partial generations: the deadline (or bounded
+                        # drain) cut these rows short — the tokens are
+                        # real, the flag says they are not all of them.
+                        payload["deadline_exceeded"] = [
+                            r.deadline_exceeded for r in rows
+                        ]
+                        payload["timeout_cause"] = [
+                            r.timeout_cause for r in rows
+                        ]
+                    if any(r.degraded for r in rows):
+                        # Degraded admission capped max_tokens while KV
+                        # blocks were scarce.
+                        payload["degraded"] = [r.degraded for r in rows]
+                    self._json(200, payload)
+                    with lock:
+                        served += 1
+                        if (args.requests is not None
+                                and served >= args.requests):
+                            done.set()
+                    return
                 elif coalescer is not None and not kw:
                     out = coalescer.submit(prompt, num_steps)
                 elif not kw:
@@ -686,14 +842,34 @@ def main(argv: list[str] | None = None) -> int:
                     "tokens": out if isinstance(out, list) else out.tolist()
                 })
             except Exception as exc:  # noqa: BLE001 — client-visible error
-                from tf_operator_tpu.serve.scheduler import ShuttingDown
+                from tf_operator_tpu.serve.resilience import (
+                    ServeError,
+                    error_payload,
+                )
 
-                if isinstance(exc, ShuttingDown):
-                    # The request was fine; the server is draining. 503
-                    # (retryable elsewhere), never a hung socket.
-                    self._json(503, {"error": repr(exc)})
+                if isinstance(exc, ServeError):
+                    # Typed serving failure: 503/408 + {code, retryable,
+                    # detail} (+ Retry-After) — a router can tell a
+                    # draining/dead replica from a bad request, and
+                    # nothing ever hangs a socket.
+                    headers = {}
+                    if exc.retry_after_s is not None:
+                        headers["Retry-After"] = str(
+                            max(1, int(round(exc.retry_after_s)))
+                        )
+                    self._json(exc.http_status, error_payload(exc),
+                               headers)
+                elif isinstance(exc, TimeoutError):
+                    # The server ran out of time, not the request out of
+                    # validity: retryable 503, never a bad_request.
+                    self._json(503, {
+                        "error": repr(exc), "code": "timeout",
+                        "retryable": True, "detail": repr(exc),
+                    })
                 else:
-                    self._json(400, {"error": repr(exc)})
+                    self._json(400, error_payload(exc) | {
+                        "code": "bad_request", "error": repr(exc),
+                    })
                 return
             # Budget accounting under the lock: concurrent handler threads
             # would otherwise lose increments and never trip the budget.
@@ -718,7 +894,10 @@ def main(argv: list[str] | None = None) -> int:
         # confirms the drain, plus a beat for the response writes.
         import time as _time
 
-        engine_sched.stop(timeout=60.0)
+        # The drain itself is bounded by --drain-timeout inside the
+        # loop (stragglers resolve with partial output + the drain
+        # flag); the join budget just needs to outlast it.
+        engine_sched.stop(timeout=max(60.0, (args.drain_timeout or 0) + 30.0))
         _time.sleep(0.2)
         print(f"serve_lm: engine drained "
               f"({engine_sched.requests_done} request(s), "

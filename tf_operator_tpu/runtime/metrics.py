@@ -469,6 +469,32 @@ SERVE_PREFILL_SAVED_TOTAL = REGISTRY.counter(
     "Prompt tokens whose prefill was skipped because a shared prefix "
     "already held their K/V blocks",
 )
+SERVE_WATCHDOG_RESTARTS = REGISTRY.counter(
+    "tpu_serve_watchdog_restarts_total",
+    "Engine teardown + rebuild cycles performed by the serving watchdog, "
+    "by trigger (stall = heartbeat silence past --watchdog-stall, "
+    "crash = uncaught decode-loop exception)",
+    ("reason",),
+)
+SERVE_DEADLINE_TOTAL = REGISTRY.counter(
+    "tpu_serve_deadline_exceeded_total",
+    "Requests resolved by a deadline instead of completion, by kind: "
+    "queue = expired waiting for a slot (typed 408), decode = decode "
+    "deadline hit mid-generation (200 + partial tokens + flag), drain = "
+    "cut by the bounded SIGTERM drain (--drain-timeout, same partial "
+    "path)",
+    ("kind",),
+)
+SERVE_SHED_TOTAL = REGISTRY.counter(
+    "tpu_serve_shed_total",
+    "Requests rejected at submit because the bounded queue was at its "
+    "watermark (reject-newest load shedding; typed 503 + Retry-After)",
+)
+SERVE_DEGRADED = REGISTRY.gauge(
+    "tpu_serve_degraded",
+    "1 while the engine admits in degraded mode (free KV blocks below "
+    "the --degraded-blocks watermark caps admitted max_tokens), else 0",
+)
 SERVE_OCCUPANCY = REGISTRY.histogram(
     "tpu_serve_batch_occupancy",
     "Fraction of decode slots active, observed at every decode step — "

@@ -13,6 +13,13 @@ requests mid-stream.
 - ``scheduler``: the serving loop — token-budgeted chunked prefill
   interleaved with decode, admission into free slots, EOS/max-tokens
   retirement, and the SIGTERM drain (in-flight finishes, queued 503s).
+- ``resilience``: the typed-error taxonomy (``ServeError`` and
+  friends), request deadlines, load shedding/degraded-mode config, and
+  the ``EngineSupervisor`` watchdog that rebuilds a crashed/stalled
+  engine and replays in-flight requests bit-identically.
+- ``faultinject``: deterministic, seeded fault points
+  (``TPU_SERVE_FAULTS``) for the chaos tests and serve_bench's chaos
+  mix.
 - ``coalesce``: the legacy same-shape batch-window coalescer
   (serve_lm --engine coalesce), kept selectable for the exactness
   matrix and as the bench's comparison leg.
@@ -35,6 +42,12 @@ _EXPORTS = {
     "ContinuousScheduler": "scheduler",
     "ServeRequest": "scheduler",
     "ShuttingDown": "scheduler",
+    "EngineSupervisor": "resilience",
+    "ResilienceConfig": "resilience",
+    "ServeError": "resilience",
+    "error_payload": "resilience",
+    "FaultInjector": "faultinject",
+    "InjectedFault": "faultinject",
     "Coalescer": "coalesce",
     "ServeDebugHandler": "httpapi",
     "mount_serve": "httpapi",

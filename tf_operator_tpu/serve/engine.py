@@ -95,6 +95,7 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_KV_COW_TOTAL,
     SERVE_PREFILL_SAVED_TOTAL,
 )
+from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
 from tf_operator_tpu.serve.kvcache import (
     BlockAllocator,
     PrefixCache,
@@ -166,9 +167,14 @@ class ContinuousEngine:
     def __init__(self, cfg: TransformerConfig, params: Any,
                  max_slots: int, *, prefill_chunk: int | None = None,
                  kv_paged: bool = True, kv_block: int = 64,
-                 kv_blocks: int | None = None) -> None:
+                 kv_blocks: int | None = None,
+                 faults: Any = None) -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        # Armed only AFTER warmup (below): the constructor's own steps
+        # must not consume positional fault hits — chaos specs count
+        # SERVING invocations.
+        self.faults = NULL_INJECTOR
         self.cfg = cfg
         self.params = params
         self.max_slots = int(max_slots)
@@ -257,6 +263,7 @@ class ContinuousEngine:
             self.step()
         self.steps_total = 0
         self.warmup_compiles = self.decode_step_compiles
+        self.faults = faults or NULL_INJECTOR
 
     # -- admission planning ----------------------------------------------
 
@@ -300,6 +307,8 @@ class ContinuousEngine:
         tokens = np.asarray(tokens, np.int32)
         L, M = int(tokens.shape[1]), int(num_steps)
         self.validate_request(L, M)
+        if self.faults.fire("alloc_exhaust") is not None:
+            return None  # injected slot/block-pool exhaustion
         if self.alloc.free == 0:
             return None
         if not self.kv_paged:
@@ -649,6 +658,9 @@ class ContinuousEngine:
         """One decode iteration over ALL slots: every active slot
         advances one token. Returns the [max_slots] int32 token vector
         (inactive rows are dead compute — ignore them)."""
+        if self.faults.fire("step_raise") is not None:
+            raise InjectedFault("step_raise")
+        self.faults.maybe_sleep("step_stall", default=1.0)
         if self.kv_paged:
             self._run_pending_cows()
         self._cache, self._logits, self._stepidx, toks = self._step_fn(
@@ -709,6 +721,15 @@ class ContinuousEngine:
             "prefix_hits": self.prefix.hits,
             "prefill_tokens_saved": self.prefill_tokens_saved,
         }
+
+    @property
+    def free_block_fraction(self) -> float:
+        """Fraction of the allocatable KV pool still free — the
+        degraded-mode watermark input. Dense layouts never run out of
+        anything but slots, so they read 1.0."""
+        if not self.kv_paged:
+            return 1.0
+        return self.blocks.free_blocks / max(1, self.kv_blocks - 1)
 
     @property
     def active_slots(self) -> int:

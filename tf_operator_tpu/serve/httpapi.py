@@ -12,6 +12,13 @@ The payload carries a ``kv_cache`` section with the block-pool stats
 (paged mode: block size, free/used/shared block counts, CoW copies,
 prefix-cache hits, prefill tokens saved — the same numbers the
 ``tpu_serve_kv_*`` metric families export).
+
+Supervised serving (serve/resilience.py) mounts the SUPERVISOR here
+instead of a scheduler — same ``debug_snapshot`` surface, but the
+handler survives watchdog engine rebuilds and the payload gains a
+``resilience`` section: restart count/attempts, last fault, queue
+watermark + shed/deadline totals, the degraded flag, the drain-timeout
+budget, and the armed fault-injection points.
 """
 
 from __future__ import annotations

@@ -1,0 +1,548 @@
+"""Serving resilience: typed failures, request deadlines, the engine
+watchdog, and bounded degradation — the layer that turns "a wedged step
+hangs every socket" into "every request resolves, typed, within its
+deadline".
+
+PRs 5–6 made the continuous engine fast and memory-dense; this module
+makes it SAFE to put behind a router. Four pillars:
+
+1. **Typed errors.** Every failure a client can see carries ``code``,
+   ``retryable``, ``detail`` (and optionally ``retry_after_s``) — the
+   :class:`ServeError` taxonomy below. A future router reads ``code``
+   to distinguish "retry here later" (``queue_full``), "retry elsewhere
+   now" (``draining``, ``engine_crashed``), and "eject this replica"
+   (``replica_dead``). ``error_payload`` renders any exception into the
+   wire shape; untyped exceptions map to a non-retryable ``internal``.
+
+2. **Deadlines.** A request expires in QUEUE (typed 408, it never cost
+   device work) after ``queue_ttl_s``, and in DECODE (200 + the partial
+   generation + a ``deadline_exceeded`` flag — tokens already paid for
+   are delivered, the slot retires) after ``decode_deadline_s`` or a
+   per-request override. The decode deadline is absolute from submit,
+   so it also bounds time lost to watchdog restarts; the queue TTL is
+   per queue residence (a replayed request gets a fresh one).
+
+3. **Watchdog + crash recovery** (:class:`EngineSupervisor`). The
+   serving loop heartbeats; on silence past ``watchdog_stall_s`` or an
+   uncaught loop exception the supervisor FENCES the old scheduler
+   (its thread — possibly still stuck inside a wedged device call — can
+   never again touch a request), harvests every live request, rebuilds
+   the engine via the factory (fresh KV pool, warmed step), and replays
+   the harvested requests from scratch. Greedy replays are bit-identical
+   to an uninterrupted run (same prompt, same engine math, fresh state)
+   and sampled replays reproduce their seeded key ladder exactly;
+   replayed prompts re-register in the new prefix cache, so a replayed
+   cohort sharing prefixes re-prefills once (prefix-cache-assisted).
+   Restarts are bounded: ``max_restarts`` consecutive failures (the
+   budget resets once a rebuilt engine completes a request) with
+   exponential backoff, then the replica is DEAD — everything drains
+   with ``replica_dead`` 503s and the router routes around it.
+
+4. **Load shedding + degraded mode.** The queue is bounded
+   (``queue_limit``): above the watermark new submits shed with a typed
+   503 + Retry-After (reject-newest — the queued requests are older and
+   closer to their TTLs; shedding the newcomer preserves more deadlines).
+   When free KV blocks drop under ``degraded_free_block_frac`` the
+   scheduler caps admitted ``max_tokens`` at ``degraded_max_tokens``
+   (response carries a ``degraded`` flag), so pool exhaustion shrinks
+   answers instead of deadlocking admission.
+
+The supervisor exposes the scheduler surface (``submit``/
+``submit_request``/``debug_snapshot``/``stop``) so serve_lm and the
+/debug/serve handler talk to ONE object whose engine may be torn down
+and rebuilt underneath at any time.
+
+Fault points (serve/faultinject.py) are threaded through the engine and
+scheduler so tests/serve_bench can inject each failure mode
+deterministically; see docs/resilience.md for the failure model and the
+watchdog state machine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tf_operator_tpu.runtime.metrics import SERVE_WATCHDOG_RESTARTS
+from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="serve-resilience")
+
+
+# ---------------------------------------------------------------------------
+# Typed errors
+# ---------------------------------------------------------------------------
+
+
+class ServeError(RuntimeError):
+    """Base of every typed serving failure: ``code`` names the failure
+    mode, ``http_status`` the transport mapping, ``retryable`` whether
+    the REQUEST could succeed if retried (here after Retry-After, or on
+    another replica — ``code`` tells a router which)."""
+
+    code = "internal"
+    http_status = 500
+    retryable = False
+
+    def __init__(self, detail: str = "", *,
+                 retry_after_s: float | None = None) -> None:
+        super().__init__(detail or self.code)
+        self.detail = detail or self.code
+        self.retry_after_s = retry_after_s
+
+    def payload(self) -> dict:
+        out = {
+            "error": self.detail,
+            "code": self.code,
+            "retryable": self.retryable,
+            "detail": self.detail,
+        }
+        if self.retry_after_s is not None:
+            out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        return out
+
+
+class Draining(ServeError):
+    """The server is shutting down; the request was fine. Retry on
+    another replica."""
+
+    code = "draining"
+    http_status = 503
+    retryable = True
+
+
+class ShuttingDown(Draining):
+    """Back-compat name for the drain-time refusal (PR 5 exported it
+    from serve.scheduler; isinstance checks keep working)."""
+
+
+class QueueFull(ServeError):
+    """Reject-newest load shedding: the bounded queue is at its
+    watermark. Retry after backoff (``retry_after_s``) or elsewhere."""
+
+    code = "queue_full"
+    http_status = 503
+    retryable = True
+
+
+class QueueTTLExpired(ServeError):
+    """The request aged out waiting for a slot — it never cost any
+    device work. 408: the server timed the request out."""
+
+    code = "queue_ttl_expired"
+    http_status = 408
+    retryable = True
+
+
+class EngineCrashed(ServeError):
+    """The serving loop died (or is restarting) and this request could
+    not be carried across. Retryable — a rebuilt engine (or another
+    replica) can serve it."""
+
+    code = "engine_crashed"
+    http_status = 503
+    retryable = True
+
+
+class ReplicaDead(ServeError):
+    """The watchdog exhausted its restart budget: this replica will not
+    recover. The request is retryable ON ANOTHER REPLICA — a router
+    seeing this code should eject the backend, not just retry."""
+
+    code = "replica_dead"
+    http_status = 503
+    retryable = True
+
+
+def error_payload(exc: Exception) -> dict:
+    """The wire shape for ANY exception: typed errors render themselves;
+    anything else becomes a non-retryable ``internal`` (500) whose
+    detail still carries the repr — no failure leaves as a bare
+    unstructured 500."""
+    if isinstance(exc, ServeError):
+        return exc.payload()
+    return {"error": repr(exc), "code": "internal", "retryable": False,
+            "detail": repr(exc)}
+
+
+def http_status_of(exc: Exception) -> int:
+    if isinstance(exc, ServeError):
+        return exc.http_status
+    return 500
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResilienceConfig:
+    """Every knob defaults OFF (None/0) so a bare ContinuousScheduler
+    keeps its PR-5/6 semantics exactly; serve_lm turns the layer on with
+    production defaults via its flags."""
+
+    queue_ttl_s: float | None = None        # expire queued requests (408)
+    decode_deadline_s: float | None = None  # absolute submit->done bound
+    watchdog_stall_s: float | None = None   # heartbeat silence -> restart
+    max_restarts: int = 3                   # consecutive, before dead
+    restart_backoff_s: float = 0.25         # base of the exponential
+    queue_limit: int | None = None          # bounded queue watermark
+    degraded_free_block_frac: float = 0.0   # 0 disables degraded mode
+    degraded_max_tokens: int = 32           # the degraded-mode cap
+    drain_timeout_s: float | None = None    # bound the SIGTERM drain
+
+    @property
+    def enabled(self) -> bool:
+        return any((
+            self.queue_ttl_s, self.decode_deadline_s,
+            self.watchdog_stall_s, self.queue_limit,
+            self.degraded_free_block_frac, self.drain_timeout_s,
+        ))
+
+
+def await_request(req: Any, timeout: float = 600.0) -> Any:
+    """Block for a submitted request's terminal state: returns the
+    request (carrying ``out`` and flags) or raises its typed error.
+    Lives here so the supervisor and the scheduler share one waiter."""
+    if not req.event.wait(timeout=timeout):
+        raise TimeoutError("continuous decode timed out")
+    if req.error is not None:
+        raise req.error
+    return req
+
+
+# ---------------------------------------------------------------------------
+# The supervisor
+# ---------------------------------------------------------------------------
+
+
+class EngineSupervisor:
+    """Owns the engine + scheduler lifecycle. ``engine_factory`` must
+    build a fresh, warmed engine (same cfg/params every time — replay
+    bit-identity depends on it). The supervisor is the long-lived object
+    servers hold; the scheduler/engine pair underneath is generation-
+    scoped and may be replaced by the watchdog at any time."""
+
+    def __init__(self, engine_factory: Callable[[], Any], *,
+                 resilience: ResilienceConfig | None = None,
+                 faults: Any = None,
+                 prefill_tokens_per_step: int = 256,
+                 device_lock: threading.Lock | None = None) -> None:
+        # Local import: scheduler imports this module for the error
+        # taxonomy, so the supervisor resolves it lazily.
+        from tf_operator_tpu.serve.scheduler import ContinuousScheduler
+
+        self._sched_cls = ContinuousScheduler
+        self._factory = engine_factory
+        self.res = resilience or ResilienceConfig()
+        self.faults = faults or NULL_INJECTOR
+        self._prefill_budget = prefill_tokens_per_step
+        self._device_lock = device_lock
+        self._lock = threading.RLock()     # guards the generation swap
+        self._restart_lock = threading.Lock()
+        self._closed = False
+        self.dead = False
+        self.restarts = 0                  # lifetime restarts
+        self._attempts = 0                 # consecutive, resets on health
+        self.last_fault: str | None = None
+        self.last_restart_at: float | None = None
+        # Aggregates carried across generations (each scheduler's own
+        # counters start at zero).
+        self._done_prev = 0
+        self._tokens_prev = 0
+        self._shed_prev = 0
+        self._deadline_prev = 0
+        self._qhw_max = 0
+        self._sched: Any = None
+        self._build(replay=())
+        self._watchdog: threading.Thread | None = None
+        if self.res.watchdog_stall_s:
+            self._watchdog = threading.Thread(
+                target=self._watch, daemon=True,
+                name="serve-watchdog",
+            )
+            self._watchdog.start()
+
+    # -- generation management -------------------------------------------
+
+    def _build(self, replay) -> None:
+        engine = self._factory()
+        sched = self._sched_cls(
+            engine,
+            prefill_tokens_per_step=self._prefill_budget,
+            device_lock=self._device_lock,
+            resilience=self.res,
+            supervisor=self,
+            faults=self.faults,
+        )
+        if replay:
+            sched.requeue(replay)
+        with self._lock:
+            self._sched = sched
+        sched.start()
+
+    @property
+    def scheduler(self) -> Any:
+        with self._lock:
+            return self._sched
+
+    @property
+    def engine(self) -> Any:
+        return self.scheduler.engine
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, tokens, num_steps: int, **kw):
+        """Scheduler-shaped convenience: returns the [1, n] token array
+        (partial when a deadline fired — check ``submit_request`` for
+        the flags)."""
+        import numpy as np
+
+        from tf_operator_tpu.serve.scheduler import ServeRequest
+
+        timeout = kw.pop("timeout", 600.0)
+        req = ServeRequest(tokens, num_steps, **kw)
+        return np.asarray(
+            self.submit_request(req, timeout=timeout).out, np.int32
+        ).reshape(1, -1)
+
+    def submit_request(self, req: Any, timeout: float = 600.0) -> Any:
+        """Enqueue on the CURRENT generation and wait. A restart between
+        enqueue and completion is invisible here: the harvested request
+        keeps its event, the new generation finishes it. An enqueue that
+        races the fence retries on the next generation."""
+        from tf_operator_tpu.serve.scheduler import SchedulerFenced
+
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._lock:
+                if self.dead:
+                    raise ReplicaDead("serving replica marked dead "
+                                      "(restart budget exhausted)")
+                sched = self._sched
+            try:
+                sched.enqueue(req)
+                break
+            except SchedulerFenced:
+                if time.monotonic() > deadline:
+                    # Typed: this is a replica-side condition (the
+                    # rebuild outlasted the caller's budget), not a bad
+                    # request — a router should retry elsewhere.
+                    raise EngineCrashed(
+                        "engine restarting; enqueue timed out"
+                    )
+                time.sleep(0.01)  # a rebuild is in flight
+        return await_request(
+            req, timeout=max(0.0, deadline - time.monotonic())
+        )
+
+    def stop(self, timeout: float = 60.0) -> None:
+        """Drain the current generation (bounded by the config's
+        ``drain_timeout_s`` inside the loop) and stop the watchdog.
+        Holding the restart lock first lets any in-flight restart finish
+        (its backoff is bounded) and guarantees no NEW generation can be
+        built afterwards — every restart re-checks ``_closed`` under
+        that lock — so the generation we drain is the last one ever."""
+        self._closed = True
+        with self._restart_lock:
+            sched = self.scheduler
+        if sched is not None:
+            sched.stop(timeout=timeout)
+
+    # -- failure handling --------------------------------------------------
+
+    def on_loop_crash(self, sched: Any, exc: Exception) -> bool:
+        """Called by a dying serving loop. Returns True when the
+        supervisor takes ownership (the loop must NOT fail its waiters —
+        they will be replayed, or a concurrent restart already harvested
+        them); False hands back the legacy fail-all path (stale-but-
+        unharvested generation, or supervisor shut down)."""
+        with self._lock:
+            if self._closed or self.dead or sched is not self._sched:
+                # A superseded generation was fenced+harvested — its
+                # requests belong to the supervisor already.
+                return sched._fenced
+        LOG.warning(f"serving loop crashed; restarting engine: {exc!r}")
+        # The dying thread itself performs the restart (it has nothing
+        # else to do, and the backoff sleep belongs to the failure).
+        return self._restart("crash", exc, sched)
+
+    def note_served(self) -> None:
+        """A request completed on the current generation: the
+        consecutive-restart budget resets. Called by the scheduler on
+        every ok-retire (a fenced generation can never finish a request,
+        so no staleness check is needed) — the watchdog thread also
+        resets, but crash-only supervision (watchdog_stall_s unset) has
+        no watchdog thread to do it."""
+        self._attempts = 0
+
+    def _watch(self) -> None:
+        stall = float(self.res.watchdog_stall_s)
+        period = max(0.01, min(stall / 4.0, 0.5))
+        while not self._closed and not self.dead:
+            time.sleep(period)
+            with self._lock:
+                sched = self._sched
+            if sched is None or not sched.running:
+                continue
+            # A completed request on this generation proves the rebuilt
+            # engine serves; the consecutive-failure budget resets.
+            if self._attempts and sched.requests_done > 0:
+                self._attempts = 0
+            age = time.monotonic() - sched.heartbeat
+            if age > stall:
+                self._restart(
+                    "stall", None, sched,
+                    detail=f"heartbeat silent {age:.2f}s > {stall}s",
+                )
+
+    def _restart(self, reason: str, exc: Exception | None, sched: Any,
+                 detail: str = "") -> bool:
+        """Fence, harvest, rebuild, replay. Returns True when this (or a
+        concurrent) restart took ownership of ``sched``'s requests —
+        the crash path uses it to decide whether the dying loop may
+        still fail-all. Acquires the restart lock with a timeout loop:
+        ``stop()`` holds that lock while draining, and a crash-path
+        caller blocking on it uninterruptibly would deadlock the very
+        thread stop() is joining."""
+        from tf_operator_tpu.runtime.metrics import SERVE_DEADLINE_TOTAL
+
+        while not self._restart_lock.acquire(timeout=0.05):
+            if self._closed:
+                return False  # stop() owns shutdown; loop fail-alls
+        try:
+            with self._lock:
+                if self._closed:
+                    return False
+                if self.dead or sched is not self._sched:
+                    # Superseded: whoever fenced it owns its requests.
+                    return sched._fenced
+            harvested = sched.fence_and_harvest()
+            self._done_prev += sched.requests_done
+            self._tokens_prev += sched.tokens_generated
+            self._shed_prev += sched.shed_total
+            self._deadline_prev += sched.deadline_total
+            self._qhw_max = max(self._qhw_max, sched.queue_high_water)
+            self.restarts += 1
+            self._attempts += 1
+            self.last_fault = (detail or repr(exc)) + f" [{reason}]"
+            self.last_restart_at = time.time()
+            SERVE_WATCHDOG_RESTARTS.inc(reason=reason)
+            LOG.warning(
+                f"engine restart ({reason}) attempt {self._attempts}: "
+                f"{len(harvested)} in-flight to replay; {self.last_fault}"
+            )
+            if self._attempts > self.res.max_restarts:
+                self._declare_dead(harvested)
+                return True
+            # A harvested request whose absolute deadline already passed
+            # resolves NOW with whatever it had (the deadline contract
+            # does not pause for restarts); the rest replay.
+            now = time.monotonic()
+            replay = []
+            for req in harvested:
+                if req.deadline is not None and now > req.deadline:
+                    req.deadline_exceeded = True
+                    req.timeout_cause = "decode_deadline"
+                    SERVE_DEADLINE_TOTAL.inc(kind="decode")
+                    req._finish("deadline")
+                else:
+                    replay.append(req)
+            time.sleep(
+                self.res.restart_backoff_s * (2 ** (self._attempts - 1))
+            )
+            try:
+                self._build(replay=replay)
+            except Exception as build_exc:  # noqa: BLE001 — a factory
+                # that cannot build an engine is a dead replica.
+                LOG.error(
+                    f"engine rebuild failed; replica dead: {build_exc!r}"
+                )
+                self._declare_dead(replay)
+            return True
+        finally:
+            self._restart_lock.release()
+
+    def _declare_dead(self, leftovers) -> None:
+        with self._lock:
+            self.dead = True
+            self._sched = None
+        exc = ReplicaDead("serving replica dead after "
+                          f"{self.restarts} restart(s): {self.last_fault}")
+        for req in leftovers:
+            if not req.event.is_set():
+                req._finish("error", exc)
+        LOG.error(
+            f"serving replica declared dead after {self.restarts} "
+            f"restart(s); last fault: {self.last_fault}"
+        )
+
+    # -- proxied observability --------------------------------------------
+
+    @property
+    def active_slots(self) -> int:
+        sched = self.scheduler
+        return sched.engine.active_slots if sched is not None else 0
+
+    @property
+    def queue_depth(self) -> int:
+        sched = self.scheduler
+        return sched.queue_depth if sched is not None else 0
+
+    @property
+    def requests_done(self) -> int:
+        sched = self.scheduler
+        return self._done_prev + (sched.requests_done if sched else 0)
+
+    @property
+    def tokens_generated(self) -> int:
+        sched = self.scheduler
+        return self._tokens_prev + (sched.tokens_generated if sched else 0)
+
+    def debug(self) -> dict:
+        """The /debug/serve ``resilience`` section."""
+        sched = self.scheduler
+        return {
+            "watchdog_stall_s": self.res.watchdog_stall_s,
+            "restarts": self.restarts,
+            "restart_attempts": self._attempts,
+            "max_restarts": self.res.max_restarts,
+            "dead": self.dead,
+            "last_fault": self.last_fault,
+            "last_restart_at": self.last_restart_at,
+            "queue_ttl_s": self.res.queue_ttl_s,
+            "decode_deadline_s": self.res.decode_deadline_s,
+            "queue_limit": self.res.queue_limit,
+            # Lifetime aggregates: restarts must not make dashboard
+            # counters go backwards (requests_done/tokens carry the same
+            # way via their properties).
+            "queue_high_water": max(
+                self._qhw_max, sched.queue_high_water if sched else 0
+            ),
+            "shed_total": self._shed_prev + (
+                sched.shed_total if sched else 0
+            ),
+            "deadline_exceeded_total": self._deadline_prev + (
+                sched.deadline_total if sched else 0
+            ),
+            "degraded": bool(sched.degraded) if sched else False,
+            "degraded_free_block_frac": self.res.degraded_free_block_frac,
+            "drain_timeout_s": self.res.drain_timeout_s,
+            "faults": self.faults.snapshot(),
+        }
+
+    def debug_snapshot(self) -> dict:
+        """Scheduler snapshot + the resilience section — the /debug/serve
+        payload when serving runs supervised (httpapi mounts the
+        SUPERVISOR so the handler survives engine rebuilds)."""
+        sched = self.scheduler
+        if sched is None:
+            snap = {"engine": "continuous", "dead": True}
+        else:
+            snap = sched.debug_snapshot()
+        snap["resilience"] = self.debug()
+        return snap

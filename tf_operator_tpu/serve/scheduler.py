@@ -28,12 +28,39 @@ handshake. Each loop iteration:
 3. IDLE: with nothing queued and nothing active the loop parks on a
    condition variable — zero device work, zero spin.
 
+RESILIENCE (serve/resilience.py — every knob defaults off, preserving
+the bare-scheduler semantics above exactly):
+
+- The loop HEARTBEATS every iteration; a supervisor's watchdog reads the
+  stamp. An ``ack_loss`` fault drops the write (the false-positive
+  drill).
+- Queued requests expire after ``queue_ttl_s`` with a typed 408; decode
+  slots whose absolute deadline passes retire with the PARTIAL
+  generation and a ``deadline_exceeded`` flag — a wedged request always
+  resolves, one way or the other.
+- The queue is bounded: at ``queue_limit`` new submits shed with a typed
+  503 + Retry-After (reject-newest). When the engine's free-block
+  fraction drops under ``degraded_free_block_frac``, admissions cap
+  ``num_steps`` at ``degraded_max_tokens`` (flagged), so pool exhaustion
+  shortens answers instead of deadlocking.
+- ``fence_and_harvest`` is the supervisor's takeover: it marks the
+  scheduler FENCED under the condvar and strips every live request out.
+  All request/slot bookkeeping in the loop re-checks the fence under
+  the same condvar before touching anything, so a loop thread that was
+  stuck inside a wedged device call when the watchdog fired can wake
+  up later and die quietly without double-finishing a replayed request.
+- The drain (``stop``) is bounded by ``drain_timeout_s``: on expiry the
+  remaining slots resolve through the SAME partial-output path as the
+  decode deadline (cause ``drain_timeout``).
+
 Shutdown (``stop``) is the serve_lm SIGTERM/eviction drain: queued
 requests that never reached a slot fail FAST with ``ShuttingDown`` (the
 server's 503 — no socket left hanging on work that will never run),
 while admitted requests — slots and the in-flight prefill — finish
-normally. A loop crash answers every parked waiter with the error rather
-than abandoning it (the Coalescer's leftover contract).
+normally. A loop crash answers every parked waiter with a typed error
+rather than abandoning it (the Coalescer's leftover contract) — unless a
+supervisor claims the crash, in which case the waiters ride through the
+restart and are replayed.
 
 All counters/histograms land in the process-global registry
 (runtime/metrics.py ``tpu_serve_*``); long-lived tests must window reads
@@ -42,6 +69,7 @@ via snapshot()/deltas.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from collections import deque
@@ -50,21 +78,42 @@ from typing import Any
 import numpy as np
 
 from tf_operator_tpu.runtime.metrics import (
+    SERVE_DEADLINE_TOTAL,
+    SERVE_DEGRADED,
     SERVE_OCCUPANCY,
     SERVE_PREFILL_TOKENS_TOTAL,
     SERVE_QUEUE_DEPTH,
     SERVE_REQUESTS_TOTAL,
+    SERVE_SHED_TOTAL,
     SERVE_SLOTS_ACTIVE,
     SERVE_SLOT_CAPACITY,
     SERVE_STEP_SECONDS,
     SERVE_TOKENS_TOTAL,
     SERVE_TTFT_SECONDS,
 )
+from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
+from tf_operator_tpu.serve.resilience import (
+    EngineCrashed,
+    QueueFull,
+    QueueTTLExpired,
+    ResilienceConfig,
+    ServeError,
+    ShuttingDown,
+    await_request,
+)
+
+__all__ = [
+    "ContinuousScheduler",
+    "SchedulerFenced",
+    "ServeRequest",
+    "ShuttingDown",
+]
 
 
-class ShuttingDown(RuntimeError):
-    """The request was refused because the server is draining — servers
-    map this to 503 (retryable), never 400 (the request was fine)."""
+class SchedulerFenced(RuntimeError):
+    """Internal: an enqueue hit a scheduler the supervisor has already
+    fenced for teardown. The supervisor retries on the next generation;
+    this never reaches a client."""
 
 
 class ServeRequest:
@@ -72,7 +121,8 @@ class ServeRequest:
 
     def __init__(self, tokens: np.ndarray, num_steps: int, *,
                  temperature: float = 0.0, top_p: float | None = None,
-                 seed: int = 0, eos_id: int | None = None) -> None:
+                 seed: int = 0, eos_id: int | None = None,
+                 deadline_s: float | None = None) -> None:
         self.tokens = np.asarray(tokens, np.int32)
         if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
             raise ValueError("tokens must be [1, len] (one request row)")
@@ -87,6 +137,28 @@ class ServeRequest:
         self.submitted_at = time.perf_counter()
         self.first_token_at: float | None = None
         self.slot: int | None = None
+        # Resilience state. ``deadline`` is ABSOLUTE (monotonic): it
+        # keeps ticking through watchdog restarts, so a replayed request
+        # still resolves inside its original budget. ``deadline_s`` is
+        # the per-request override; the scheduler stamps the config
+        # default at enqueue when it is None.
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s={deadline_s} must be > 0")
+        self.deadline_s = deadline_s
+        self.deadline: float | None = (
+            time.monotonic() + deadline_s if deadline_s else None
+        )
+        self.enqueued_at: float | None = None
+        self.ttl_deadline: float | None = None
+        self.deadline_exceeded = False
+        self.timeout_cause: str | None = None
+        self.requested_steps = self.num_steps
+        self.degraded = False
+        self.replays = 0
+        # One histogram observation per request: a watchdog replay
+        # resets first_token_at (so .ttft honestly includes the restart
+        # for bench/telemetry readers) but must not observe twice.
+        self.ttft_observed = False
 
     @property
     def ttft(self) -> float | None:
@@ -103,7 +175,10 @@ class ServeRequest:
 class ContinuousScheduler:
     def __init__(self, engine: Any, *,
                  prefill_tokens_per_step: int = 256,
-                 device_lock: threading.Lock | None = None) -> None:
+                 device_lock: threading.Lock | None = None,
+                 resilience: ResilienceConfig | None = None,
+                 supervisor: Any = None,
+                 faults: Any = None) -> None:
         if prefill_tokens_per_step < 1:
             raise ValueError("prefill_tokens_per_step must be >= 1")
         self.engine = engine
@@ -112,18 +187,40 @@ class ContinuousScheduler:
         # (serve_lm's streaming requests bypass the engine); a dedicated
         # server may pass None and let the loop own the chip outright.
         self._device_lock = device_lock or threading.Lock()
+        self.res = resilience or ResilienceConfig()
+        self.supervisor = supervisor
+        self.faults = faults or NULL_INJECTOR
         self._cond = threading.Condition()
         self._queue: deque[ServeRequest] = deque()
         self._slots: dict[int, ServeRequest] = {}
         # (request, ChunkedPrefill | None, AdmissionPlan): planned
         # admission with its prefill mid-flight.
         self._prefilling: tuple[ServeRequest, Any, Any] | None = None
+        # The request popped from the queue but not yet recorded in
+        # _prefilling/_slots — plan_admission/prefill_planned do real
+        # device work, so a fence can land while it is in flight. It
+        # lives HERE (set/cleared under the condvar) so a harvest can
+        # never miss it; without this, a wedged plan would strand its
+        # request in a loop-thread local.
+        self._admitting: ServeRequest | None = None
         self._stopping = False
+        self._fenced = False
+        self._drain_deadline: float | None = None
         self._thread: threading.Thread | None = None
+        self.heartbeat = time.monotonic()
         self.decode_steps = 0
         self.occupancy_sum = 0
         self.tokens_generated = 0
         self.requests_done = 0
+        self.queue_high_water = 0
+        self.shed_total = 0
+        self.deadline_total = 0
+        self.degraded = False
+        if self.res.degraded_free_block_frac:
+            # The gauge is process-global but degraded state is
+            # per-generation: a fresh engine (full pool) must not
+            # inherit a dead generation's 1.
+            SERVE_DEGRADED.set(0)
         # Active-slot count per decode step, bounded (the serve bench
         # reads a steady-window occupancy out of the middle of it).
         self.step_log: deque[int] = deque(maxlen=1 << 16)
@@ -134,13 +231,17 @@ class ContinuousScheduler:
     def submit(self, tokens, num_steps: int, *, temperature: float = 0.0,
                top_p: float | None = None, seed: int = 0,
                eos_id: int | None = None,
+               deadline_s: float | None = None,
                timeout: float = 600.0) -> np.ndarray:
         """Enqueue one request and block for its tokens ([1, n] int32;
-        n < num_steps only when eos_id fired). Validation errors raise
-        HERE, eagerly — a server turns them into a 400 before any device
-        work; ``ShuttingDown`` is the drain-time 503."""
+        n < num_steps when eos_id fired — or when a decode deadline cut
+        it short: check ``submit_request`` for the flag). Validation
+        errors raise HERE, eagerly — a server turns them into a 400
+        before any device work; ``ShuttingDown``/``QueueFull``/
+        ``QueueTTLExpired`` are the typed 503/408s."""
         req = ServeRequest(tokens, num_steps, temperature=temperature,
-                           top_p=top_p, seed=seed, eos_id=eos_id)
+                           top_p=top_p, seed=seed, eos_id=eos_id,
+                           deadline_s=deadline_s)
         return np.asarray(
             self.submit_request(req, timeout=timeout).out, np.int32
         ).reshape(1, -1)
@@ -148,8 +249,19 @@ class ContinuousScheduler:
     def submit_request(self, req: ServeRequest,
                        timeout: float = 600.0) -> ServeRequest:
         """``submit`` with the request object exposed: callers that need
-        per-request telemetry (TTFT — tools/serve_bench.py) keep the
-        handle; the finished request carries ``out`` and ``ttft``."""
+        per-request telemetry (TTFT, the ``deadline_exceeded``/
+        ``degraded`` flags) keep the handle; the finished request
+        carries ``out`` and ``ttft``."""
+        self.enqueue(req)
+        return await_request(req, timeout=timeout)
+
+    def enqueue(self, req: ServeRequest) -> ServeRequest:
+        """Validate and queue one request WITHOUT waiting (the
+        supervisor enqueues here and waits itself, so a watchdog restart
+        can move the queue to a new generation under the waiter).
+        Raises eagerly: validation (400s), ``QueueFull`` (shedding),
+        ``ShuttingDown`` (drain), ``SchedulerFenced`` (supervisor-
+        internal retry)."""
         # Eager: solo generate's budget + the sampling-parameter contract
         # (same messages — one source of truth for the 400 text).
         self.engine.validate_request(req.tokens.shape[1], req.num_steps)
@@ -160,16 +272,62 @@ class ContinuousScheduler:
                 "top_p requires temperature > 0 (greedy ignores it)"
             )
         with self._cond:
+            if self._fenced:
+                raise SchedulerFenced("scheduler fenced for restart")
             if self._stopping:
                 raise ShuttingDown("server shutting down")
+            if (self.res.queue_limit is not None
+                    and len(self._queue) >= self.res.queue_limit):
+                # Reject-NEWEST: the queued requests are older and
+                # closer to their TTLs; shedding the newcomer preserves
+                # the most deadlines. Retry-After ~ one TTL (by then the
+                # backlog has either drained or expired).
+                self.shed_total += 1
+                SERVE_SHED_TOTAL.inc()
+                SERVE_REQUESTS_TOTAL.inc(outcome="shed")
+                raise QueueFull(
+                    f"queue at limit ({self.res.queue_limit})",
+                    retry_after_s=self.res.queue_ttl_s or 1.0,
+                )
+            now = time.monotonic()
+            req.enqueued_at = now
+            if self.res.queue_ttl_s:
+                req.ttl_deadline = now + self.res.queue_ttl_s
+            if req.deadline is None and self.res.decode_deadline_s:
+                req.deadline = now + self.res.decode_deadline_s
             self._queue.append(req)
+            self.queue_high_water = max(self.queue_high_water,
+                                        len(self._queue))
             SERVE_QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify_all()
-        if not req.event.wait(timeout=timeout):
-            raise TimeoutError("continuous decode timed out")
-        if req.error is not None:
-            raise req.error
         return req
+
+    def requeue(self, reqs) -> None:
+        """Supervisor replay: previously-live requests re-enter the
+        queue of a FRESH generation, reset to their pre-admission state.
+        Greedy replays are bit-identical to an uninterrupted run (same
+        prompt, same engine math); sampled ones reproduce their seeded
+        key ladder. Queue TTLs restart (per-residence); the absolute
+        decode deadline does NOT."""
+        now = time.monotonic()
+        with self._cond:
+            for req in reqs:
+                req.out.clear()
+                req.slot = None
+                req.first_token_at = None
+                req.num_steps = req.requested_steps
+                req.degraded = False
+                req.replays += 1
+                req.enqueued_at = now
+                req.ttl_deadline = (
+                    now + self.res.queue_ttl_s
+                    if self.res.queue_ttl_s else None
+                )
+                self._queue.append(req)
+            self.queue_high_water = max(self.queue_high_water,
+                                        len(self._queue))
+            SERVE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -178,14 +336,47 @@ class ContinuousScheduler:
         self._thread.start()
         return self
 
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
     def stop(self, timeout: float = 60.0) -> None:
         """Begin the drain and wait for the loop to finish it: queued
-        requests fail fast with ShuttingDown, admitted ones complete."""
+        requests fail fast with ShuttingDown, admitted ones complete —
+        within ``drain_timeout_s`` when configured (on expiry the
+        stragglers resolve with partial output + the drain flag)."""
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+
+    def fence_and_harvest(self) -> list[ServeRequest]:
+        """Supervisor takeover: mark this scheduler fenced and strip out
+        every live request (admitted slots in join order, then the
+        in-flight prefill, then the queue) — all under the condvar, so
+        the loop thread can never finish or mutate a harvested request
+        afterwards even if it is still executing inside a wedged device
+        call right now. The engine is NOT touched: it is generation
+        garbage the moment its scheduler is fenced."""
+        with self._cond:
+            self._fenced = True
+            harvested = list(self._slots.values())
+            self._slots.clear()
+            if self._prefilling is not None:
+                harvested.append(self._prefilling[0])
+                self._prefilling = None
+            if self._admitting is not None:
+                # Popped from the queue but not yet recorded anywhere —
+                # the loop may be wedged inside plan/prefill device work
+                # for it right now.
+                harvested.append(self._admitting)
+                self._admitting = None
+            harvested.extend(self._queue)
+            self._queue.clear()
+            SERVE_QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        return harvested
 
     # -- the loop ---------------------------------------------------------
 
@@ -193,21 +384,49 @@ class ContinuousScheduler:
         try:
             self._loop()
         except Exception as exc:  # noqa: BLE001 — a crashed loop must
-            # answer every waiter, never strand a socket.
+            # answer every waiter, never strand a socket — unless a
+            # supervisor claims the crash and replays them instead.
+            if (self.supervisor is not None
+                    and self.supervisor.on_loop_crash(self, exc)):
+                return
             self._fail_all(exc)
             raise
         finally:
-            self._fail_all(ShuttingDown("server shutting down"))
-            SERVE_SLOTS_ACTIVE.set(0)
+            if not self._fenced:
+                self._fail_all(ShuttingDown("server shutting down"))
+                SERVE_SLOTS_ACTIVE.set(0)
+
+    def _beat(self) -> None:
+        """Stamp the watchdog heartbeat — unless the ack_loss fault
+        swallows the write (the false-positive restart drill)."""
+        if self.faults.fire("ack_loss") is None:
+            self.heartbeat = time.monotonic()
+
+    @contextlib.contextmanager
+    def _device(self):
+        """The device lock, heartbeating WHILE WAITING: time spent
+        queued behind a server's other decode paths (serve_lm's
+        streaming requests share the chip lock, and their per-shape
+        compiles can exceed the stall threshold) is contention, not a
+        wedged engine — only silence INSIDE a device call may trip the
+        watchdog."""
+        while not self._device_lock.acquire(timeout=0.2):
+            self._beat()
+        try:
+            yield
+        finally:
+            self._device_lock.release()
 
     def _loop(self) -> None:
         while True:
             with self._cond:
                 self._cond.wait_for(
                     lambda: self._queue or self._slots or self._prefilling
-                    or self._stopping,
+                    or self._stopping or self._fenced,
                     timeout=1.0,
                 )
+                if self._fenced:
+                    return
                 if self._stopping:
                     # Queued-but-unadmitted work will never run: answer
                     # those sockets NOW (503), keep draining the rest.
@@ -218,16 +437,132 @@ class ContinuousScheduler:
                     SERVE_QUEUE_DEPTH.set(0)
                     if not (self._slots or self._prefilling):
                         return
+                    if (self._drain_deadline is None
+                            and self.res.drain_timeout_s):
+                        self._drain_deadline = (
+                            time.monotonic() + self.res.drain_timeout_s
+                        )
+            self._beat()
+            if self._drain_deadline is not None and (
+                    time.monotonic() > self._drain_deadline):
+                self._expire_drain()
+                return
+            self._expire_queue_ttls()
             self._admit_and_prefill()
             self._decode()
-            SERVE_QUEUE_DEPTH.set(len(self._queue))
+            with self._cond:
+                if self._fenced:
+                    return
+                SERVE_QUEUE_DEPTH.set(len(self._queue))
             SERVE_SLOTS_ACTIVE.set(self.engine.active_slots)
 
     def _pop_next(self) -> ServeRequest | None:
         with self._cond:
             if self._queue:
-                return self._queue.popleft()
+                # Track the popped request until it lands in
+                # _prefilling/_slots or resolves — a fence mid-admission
+                # harvests it from here.
+                self._admitting = self._queue.popleft()
+                return self._admitting
         return None
+
+    def _settle_admitting(self, requeue_front: bool = False) -> bool:
+        """Clear the mid-admission marker under the condvar. Returns
+        False when a fence already harvested the request — the caller
+        must then drop it untouched (the supervisor owns it)."""
+        with self._cond:
+            if self._fenced:
+                return False
+            if requeue_front and self._admitting is not None:
+                self._queue.appendleft(self._admitting)
+            self._admitting = None
+            return True
+
+    def _expire_queue_ttls(self) -> None:
+        """Resolve queued requests whose TTL passed (typed 408 — no
+        device work was ever spent on them) or whose ABSOLUTE decode
+        deadline passed while still queued (empty partial + flag: the
+        deadline bound must hold even with the TTL disabled and every
+        slot held by long generations)."""
+        now = time.monotonic()
+        ttl_expired, dl_expired = [], []
+        with self._cond:
+            if self._fenced or not self._queue:
+                return
+            keep = deque()
+            for req in self._queue:
+                if req.ttl_deadline is not None and now > req.ttl_deadline:
+                    ttl_expired.append(req)
+                elif req.deadline is not None and now > req.deadline:
+                    dl_expired.append(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for req in ttl_expired:
+            self.deadline_total += 1
+            SERVE_DEADLINE_TOTAL.inc(kind="queue")
+            waited = now - (req.enqueued_at or now)
+            req._finish("deadline", QueueTTLExpired(
+                f"queued {waited:.2f}s > ttl "
+                f"{self.res.queue_ttl_s}s without reaching a slot",
+                retry_after_s=self.res.queue_ttl_s,
+            ))
+        for req in dl_expired:
+            self._expire_decode_deadline(None, req, "decode_deadline",
+                                         "decode")
+
+    def _expire_decode_deadline(self, slot: int | None, req: ServeRequest,
+                                cause: str, kind: str) -> None:
+        """THE partial-resolution retire path: deliver whatever the
+        request generated, flagged — shared by the decode deadline, the
+        bounded drain, and the supervisor's expired-harvest sweep (the
+        latter calls the request-side half itself)."""
+        if slot is not None:
+            self.engine.retire(slot)
+        req.deadline_exceeded = True
+        req.timeout_cause = cause
+        self.deadline_total += 1
+        SERVE_DEADLINE_TOTAL.inc(kind=kind)
+        req._finish("deadline")
+
+    def _expire_drain(self) -> None:
+        """The bounded drain's expiry: every remaining admitted request
+        resolves NOW with partial output + the drain flag (reusing the
+        decode-deadline retire path), the in-flight prefill resolves
+        empty, and the loop exits."""
+        with self._cond:
+            if self._fenced:
+                return
+            slots = dict(self._slots)
+            self._slots.clear()
+            prefilling = self._prefilling
+            self._prefilling = None
+        for slot, req in slots.items():
+            self._expire_decode_deadline(slot, req, "drain_timeout",
+                                         "drain")
+        if prefilling is not None:
+            req, _, plan = prefilling
+            self.engine.release_plan(plan)
+            self._expire_decode_deadline(None, req, "drain_timeout",
+                                         "drain")
+        SERVE_SLOTS_ACTIVE.set(self.engine.active_slots)
+
+    def _degrade_check(self, req: ServeRequest) -> None:
+        """Degraded admission: when free KV blocks fall under the
+        watermark, cap this request's max_tokens — exhaustion shortens
+        answers instead of wedging admission. The flag rides the
+        request so servers can tell clients their answer was cut."""
+        frac = self.res.degraded_free_block_frac
+        if not frac:
+            return
+        free = getattr(self.engine, "free_block_fraction", 1.0)
+        entering = free < frac
+        if entering != self.degraded:
+            self.degraded = entering
+            SERVE_DEGRADED.set(1 if entering else 0)
+        if entering and req.num_steps > self.res.degraded_max_tokens:
+            req.num_steps = self.res.degraded_max_tokens
+            req.degraded = True
 
     def _admit_and_prefill(self) -> None:
         # Budget waived while nothing decodes: throttling prefill then
@@ -240,36 +575,80 @@ class ContinuousScheduler:
                 req = self._pop_next()
                 if req is None:
                     return
+                self._degrade_check(req)
                 try:
                     plan = self.engine.plan_admission(
                         np.asarray(req.tokens), req.num_steps
                     )
                 except Exception as exc:  # noqa: BLE001 — one bad
-                    # request answers its own client, never the loop.
-                    req._finish("error", exc)
+                    # request answers its own client, never the loop —
+                    # unless a fence harvested it mid-plan (the
+                    # supervisor will replay it instead).
+                    if self._settle_admitting():
+                        req._finish("error", exc)
+                    else:
+                        return
                     continue
                 if plan is None:
                     # No free slot — or (paged) not enough free KV
                     # blocks for prompt + max_tokens: queue until a
                     # retire frees capacity (block-exhaustion queueing).
-                    with self._cond:
-                        self._queue.appendleft(req)
+                    # Undo any degraded cap first: the next admission
+                    # re-evaluates against the pool's state THEN — a
+                    # transient dip must not permanently shrink the
+                    # answer.
+                    req.num_steps = req.requested_steps
+                    req.degraded = False
+                    if not self._settle_admitting(requeue_front=True):
+                        return
+                    if not (self._slots or self._prefilling):
+                        # Nothing decoding either (injected or real
+                        # total exhaustion): yield instead of spinning
+                        # hot on an unadmittable head-of-line.
+                        time.sleep(0.001)
                     return
                 try:
                     pf = self.engine.prefill_planned(plan)
                 except Exception as exc:  # noqa: BLE001
                     self.engine.release_plan(plan)
-                    req._finish("error", exc)
+                    if self._settle_admitting():
+                        req._finish("error", exc)
+                    else:
+                        return
                     continue
-                self._prefilling = (req, pf, plan)
-            req, pf, plan = self._prefilling
+                with self._cond:
+                    if self._fenced:
+                        return
+                    self._admitting = None
+                    self._prefilling = (req, pf, plan)
+            with self._cond:
+                # Re-read under the condvar: a concurrent harvest may
+                # have fenced us and taken the request since the write.
+                if self._fenced or self._prefilling is None:
+                    return
+                req, pf, plan = self._prefilling
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                # The decode deadline caught the request still in
+                # prefill (slow_prefill, or a long wait): resolve it
+                # now — empty partial — rather than paying more device
+                # work for an answer nobody is waiting on.
+                with self._cond:
+                    if self._fenced:
+                        return
+                    self._prefilling = None
+                self.engine.release_plan(plan)
+                self._expire_decode_deadline(None, req, "decode_deadline",
+                                             "decode")
+                continue
             t0 = time.perf_counter()
             try:
-                with self._device_lock:
+                with self._device():
+                    self.faults.maybe_sleep("slow_prefill")
                     if pf is not None:
                         chunks = max(1, int(budget // pf.chunk))
                         budget -= pf.feed(chunks)
                         if not pf.done:
+                            self._beat()
                             SERVE_STEP_SECONDS.observe(
                                 time.perf_counter() - t0, phase="prefill"
                             )
@@ -290,52 +669,90 @@ class ContinuousScheduler:
                 # never reaches it — without this, a failing chunked
                 # prefill would strand its reserved blocks forever.
                 self.engine.release_plan(plan)
-                self._prefilling = None
+                with self._cond:
+                    if self._fenced:
+                        return
+                    self._prefilling = None
                 req._finish("error", exc)
                 continue
+            self._beat()  # a long prefill/compile is progress, not a stall
             SERVE_STEP_SECONDS.observe(
                 time.perf_counter() - t0, phase="prefill"
             )
             SERVE_PREFILL_TOKENS_TOTAL.inc(plan.prefill_tokens)
-            self._prefilling = None
-            if slot is None:  # raced capacity — put it back, front.
-                with self._cond:
+            with self._cond:
+                if self._fenced:
+                    # The request was harvested mid-join: the slot (and
+                    # its blocks) belong to a fenced generation's engine
+                    # — garbage either way. Do NOT record anything.
+                    return
+                self._prefilling = None
+                if slot is None:  # raced capacity — put it back, front.
                     self._queue.appendleft(req)
-                return
-            req.slot = slot
-            self._slots[slot] = req
+                    return
+                req.slot = slot
+                self._slots[slot] = req
 
     def _decode(self) -> None:
         if not self._slots:
             return
         t0 = time.perf_counter()
-        with self._device_lock:
+        with self._device():
             toks = self.engine.step()
+        self._beat()  # the step returned — wedged steps never get here
         now = time.perf_counter()
-        SERVE_STEP_SECONDS.observe(now - t0, phase="decode")
-        SERVE_OCCUPANCY.observe(self.engine.occupancy)
-        self.decode_steps += 1
-        self.occupancy_sum += len(self._slots)
-        self.step_log.append(len(self._slots))
-        self.tokens_generated += len(self._slots)
-        SERVE_TOKENS_TOTAL.inc(len(self._slots))
-        for slot, req in list(self._slots.items()):
-            tok = int(toks[slot])
-            req.out.append(tok)
-            if req.first_token_at is None:
-                req.first_token_at = now
-                SERVE_TTFT_SECONDS.observe(req.ttft)
-            if (len(req.out) >= req.num_steps
-                    or (req.eos_id is not None and tok == req.eos_id)):
-                del self._slots[slot]
-                self.engine.retire(slot)
-                self.requests_done += 1
-                req._finish("ok")
+        mono = time.monotonic()
+        with self._cond:
+            if self._fenced:
+                return
+            slots_now = list(self._slots.items())
+            SERVE_STEP_SECONDS.observe(now - t0, phase="decode")
+            SERVE_OCCUPANCY.observe(self.engine.occupancy)
+            self.decode_steps += 1
+            self.occupancy_sum += len(self._slots)
+            self.step_log.append(len(self._slots))
+            self.tokens_generated += len(self._slots)
+            SERVE_TOKENS_TOTAL.inc(len(self._slots))
+            for slot, req in slots_now:
+                tok = int(toks[slot])
+                req.out.append(tok)
+                if req.first_token_at is None:
+                    req.first_token_at = now
+                    if not req.ttft_observed:
+                        req.ttft_observed = True
+                        SERVE_TTFT_SECONDS.observe(req.ttft)
+                if (len(req.out) >= req.num_steps
+                        or (req.eos_id is not None and tok == req.eos_id)):
+                    del self._slots[slot]
+                    self.engine.retire(slot)
+                    self.requests_done += 1
+                    req._finish("ok")
+                    if self.supervisor is not None:
+                        # A completed request proves this engine serves:
+                        # the consecutive-restart budget resets (here,
+                        # not only in the watchdog thread — crash-only
+                        # supervision has no watchdog).
+                        self.supervisor.note_served()
+                elif req.deadline is not None and mono > req.deadline:
+                    # Decode deadline: retire the slot, deliver the
+                    # PARTIAL generation with the flag — the tokens are
+                    # paid for, and a hung client beats a hung socket.
+                    del self._slots[slot]
+                    self._expire_decode_deadline(
+                        slot, req, "decode_deadline", "decode"
+                    )
 
     def _fail_all(self, exc: Exception) -> None:
+        # Typed teardown: waiters (and the router above them) see
+        # {code, retryable, detail}, never a bare 500 repr.
+        if not isinstance(exc, ServeError):
+            exc = EngineCrashed(f"serving loop crashed: {exc!r}")
         with self._cond:
             leftovers = list(self._queue)
             self._queue.clear()
+            if self._admitting is not None:
+                leftovers.append(self._admitting)
+                self._admitting = None
             if self._prefilling is not None:
                 req, _, plan = self._prefilling
                 leftovers.append(req)
@@ -388,12 +805,16 @@ class ContinuousScheduler:
         return self.occupancy_sum / self.decode_steps / self.engine.max_slots
 
     def debug_snapshot(self) -> dict:
-        """The /debug/serve payload (serve/httpapi.py)."""
+        """The /debug/serve payload (serve/httpapi.py). Supervised
+        serving wraps this with a ``resilience`` section
+        (EngineSupervisor.debug_snapshot)."""
         return {
             "engine": "continuous",
             "max_slots": self.engine.max_slots,
             "active_slots": self.engine.active_slots,
             "queue_depth": self.queue_depth,
+            "queue_limit": self.res.queue_limit,
+            "queue_high_water": self.queue_high_water,
             "prefill_chunk": self.engine.prefill_chunk,
             "prefill_tokens_per_step": self.prefill_tokens_per_step,
             "decode_steps": self.decode_steps,
@@ -407,6 +828,9 @@ class ContinuousScheduler:
             "ttft_p50_s": SERVE_TTFT_SECONDS.quantile(0.5),
             "ttft_p99_s": SERVE_TTFT_SECONDS.quantile(0.99),
             "draining": self._stopping,
+            "degraded": self.degraded,
+            "shed_total": self.shed_total,
+            "deadline_exceeded_total": self.deadline_total,
             # Block-pool stats (paged: block size, free/used/shared
             # counts, CoW copies, prefix-cache hits, prefill tokens
             # saved; dense: the slot-row budget).

@@ -39,12 +39,23 @@ work); the paged line's ``vs_baseline`` is its tokens/sec over the dense
 leg and ``admitted_ratio`` the concurrency multiple — the ROADMAP item-2
 "what fits at actual lengths" number.
 
+The CHAOS mix (``--engine chaos``) replays the same seeded schedule
+through a SUPERVISED continuous engine (serve/resilience.py) while the
+seeded fault injector kills the decode step once and wedges it once
+mid-run: the watchdog tears the engine down, rebuilds it, and replays
+in-flight requests. The line pins the resilience claims — ``lost`` (a
+request with no terminal outcome) must be 0, every request resolves as
+ok / partial-with-flag / typed error, and ``ttft_p99_ms`` stays under
+the deadline budget (``deadline_budget_ms``) — capacity-style
+assertions enforced by the deadline machinery, not wall-clock luck.
+
 All randomness is seeded (schedule, prompts); wall-clock only enters the
 timing fields, so tests assert structure and token counts, never timing.
 BENCH_SMOKE shrinks shapes for CI. Run:
 
     JAX_PLATFORMS=cpu python tools/serve_bench.py            # all legs
     python tools/serve_bench.py --engine continuous          # one leg
+    python tools/serve_bench.py --engine chaos               # chaos mix
 """
 
 from __future__ import annotations
@@ -324,6 +335,86 @@ def run_capacity_mix(args, smoke: bool) -> list[dict]:
     return [paged, dense]
 
 
+def run_chaos_leg(cfg, params, schedule, args) -> dict:
+    """The seeded chaos mix: the open-loop schedule against a supervised
+    engine while the injector crashes the step once and stalls it once
+    mid-run. Zero lost requests and deadline-bounded TTFT are the
+    assertions; tokens/sec under failure is the informational value."""
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+        ServeError,
+    )
+    from tf_operator_tpu.serve.scheduler import ServeRequest
+
+    inj = FaultInjector(seed=args.seed)
+
+    def factory():
+        return ContinuousEngine(
+            cfg, params, max_slots=args.max_batch,
+            prefill_chunk=args.prefill_chunk or None, faults=inj,
+        )
+
+    res = ResilienceConfig(
+        queue_ttl_s=30.0, decode_deadline_s=60.0, watchdog_stall_s=5.0,
+        max_restarts=5, restart_backoff_s=0.1,
+        queue_limit=max(64, 4 * len(schedule)),
+    )
+    sup = EngineSupervisor(
+        factory, resilience=res, faults=inj,
+        prefill_tokens_per_step=args.prefill_budget,
+    )
+    reqs: list = []
+
+    def submit(prompt, steps):
+        r = ServeRequest(prompt, steps)
+        reqs.append(r)  # list.append is atomic; order is irrelevant
+        r = sup.submit_request(r, timeout=120.0)
+        return list(r.out), r.ttft
+
+    run_schedule(schedule, submit)  # untimed warmup, no faults armed
+    reqs.clear()
+    sup.scheduler.reset_stats()
+    restarts0 = sup.restarts
+    # Seeded fault positions relative to the warmed counters: one crash
+    # ~early, one wedge ~mid-run (the stall must out-wait the watchdog).
+    total_steps = sum(s for _, _, s in schedule)
+    inj.arm(f"step_raise@{inj.invocations['step_raise'] + max(2, total_steps // (4 * args.max_batch))}")
+    inj.arm(f"step_stall@{inj.invocations['step_stall'] + max(4, total_steps // (2 * args.max_batch))}:8.0")
+    wall_s, results = run_schedule(schedule, submit)
+    inj.disarm()
+    lost = sum(1 for r in reqs if not r.event.is_set())
+    ok = sum(1 for r in reqs
+             if r.error is None and not r.deadline_exceeded)
+    partial = sum(1 for r in reqs if r.deadline_exceeded)
+    typed = sum(1 for r in reqs if isinstance(r.error, ServeError))
+    untyped = sum(1 for r in reqs
+                  if r.error is not None
+                  and not isinstance(r.error, ServeError))
+    stats = {
+        "resolved": len(reqs) - lost,
+        "lost": lost,
+        "ok": ok,
+        "deadline_partials": partial,
+        "typed_errors": typed,
+        "untyped_errors": untyped,
+        "watchdog_restarts": sup.restarts - restarts0,
+        "replica_dead": sup.dead,
+        "deadline_budget_ms": round(res.decode_deadline_s * 1e3, 1),
+        "max_batch": args.max_batch,
+        "faults": {k: v for k, v in inj.fired.items() if v},
+    }
+    sup.stop(timeout=30.0)
+    line = leg_summary("chaos", wall_s, results, stats)
+    # The chaos line's error count reflects TYPED resolutions (they are
+    # the contract, not failures of the bench leg itself) — the exit
+    # code keys off lost/untyped instead.
+    line["errors"] = untyped + lost
+    return line
+
+
 def run_coalesce(cfg, params, schedule, args) -> dict:
     import jax.numpy as jnp
 
@@ -368,8 +459,12 @@ def run_coalesce(cfg, params, schedule, args) -> dict:
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--engine", choices=("continuous", "coalesce", "both"),
-                   default="both")
+    p.add_argument("--engine",
+                   choices=("continuous", "coalesce", "both", "chaos"),
+                   default="both",
+                   help="'chaos' runs ONLY the seeded fault-injection "
+                        "mix (supervised engine, step crash + stall "
+                        "mid-run)")
     p.add_argument("--requests", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
@@ -430,6 +525,8 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     lines = []
+    if args.engine == "chaos":
+        lines.append(run_chaos_leg(cfg, params, schedule, args))
     if args.engine in ("continuous", "both"):
         lines.append(run_continuous(cfg, params, schedule, args))
     if args.engine in ("coalesce", "both"):

@@ -10,6 +10,14 @@ tf_operator_tpu/serve/ without paying for the whole tier-1 run.
 
     python tools/serve_smoke.py            # the smoke subset + e2e pair
     python tools/serve_smoke.py -k drain   # extra pytest args pass through
+    python tools/serve_smoke.py --chaos    # resilience chaos pass
+
+``--chaos`` is the resilience fast-pass: the FULL chaos matrix from
+tests/test_serve_chaos.py (every fault point x {one-shot, chunked} x
+{dense, paged} — including the combos tier-1 carries under the slow
+marker) plus an inline kill-mid-run e2e through a live supervised
+engine, asserting the watchdog replay is bit-identical and nothing is
+lost. The serve_bench chaos-mix structural test rides the same marker.
 
 Exit code is pytest's (or 1 if the e2e pair fails). CI wires this as
 the pre-merge gate for serving changes; the same tests also run
@@ -98,22 +106,92 @@ def paged_e2e_pair() -> int:
         sched.stop(timeout=30.0)
 
 
+def chaos_e2e() -> int:
+    """Kill the decode step mid-run through a LIVE supervised engine:
+    the watchdog rebuilds, the in-flight greedy request replays
+    bit-identical to solo generate, nothing is lost, and the rebuilt
+    engine never recompiles after its warmup."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    inj = FaultInjector(seed=1)
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=2, kv_block=8,
+                                 faults=inj),
+        resilience=ResilienceConfig(watchdog_stall_s=5.0,
+                                    restart_backoff_s=0.05,
+                                    max_restarts=3),
+        faults=inj,
+    )
+    try:
+        prompt = np.random.default_rng(9).integers(
+            0, cfg.vocab_size, (1, 11)
+        ).astype(np.int32)
+        want = np.asarray(generate(cfg, params, jnp.asarray(prompt), 24))
+        assert np.array_equal(sup.submit(prompt, 24), want)  # warm
+        inj.arm(f"step_raise@{inj.invocations['step_raise'] + 6}")
+        out = sup.submit(prompt, 24, timeout=90)
+        assert sup.restarts == 1, sup.restarts
+        assert np.array_equal(out, want), "replayed output != solo"
+        assert sup.engine.decode_step_compiles == \
+            sup.engine.warmup_compiles
+        print("serve_smoke: chaos e2e ok (1 restart, replay "
+              "bit-identical, zero post-warmup recompiles)", flush=True)
+        return 0
+    finally:
+        sup.stop(timeout=30.0)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
-    cmd = [
-        sys.executable, "-m", "pytest",
-        "tests/test_serve_engine.py", "tests/test_serve_sched.py",
-        "tests/test_kvcache_paged.py",
-        "-m", "serve",
-        "-q", "-p", "no:cacheprovider",
-        *args,
-    ]
+    chaos = "--chaos" in args
+    if chaos:
+        args.remove("--chaos")
+    if chaos:
+        cmd = [
+            sys.executable, "-m", "pytest",
+            "tests/test_serve_chaos.py",
+            "-m", "chaos",  # includes the slow-marked matrix combos
+            "-q", "-p", "no:cacheprovider",
+            *args,
+        ]
+    else:
+        cmd = [
+            sys.executable, "-m", "pytest",
+            "tests/test_serve_engine.py", "tests/test_serve_sched.py",
+            "tests/test_kvcache_paged.py", "tests/test_serve_chaos.py",
+            "-m", "serve and not slow",
+            "-q", "-p", "no:cacheprovider",
+            *args,
+        ]
     rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
     if rc != 0:
         return rc
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if chaos:
+        return chaos_e2e()
     return paged_e2e_pair()
 
 
