@@ -460,23 +460,51 @@ def bench_serve_continuous(peak_hbm_gbps: float | None) -> None:
     is the coalescer, not the roofline — but the section signature keeps
     the peak-table plumbing uniform."""
     del peak_hbm_gbps
+    _run_serve_subprocess("serve", [], timeout=180 if os.environ.get(
+        "BENCH_SMOKE") else 600)
+
+
+def bench_serve_fleet(peak_hbm_gbps: float | None) -> None:
+    """Fleet serving line: subprocess-runs tools/serve_bench.py
+    --engine fleet — the seeded open-loop schedule through the fleet
+    ROUTER over 4 supervised continuous engines with one replica killed
+    mid-run — and re-emits its JSON line (lost == 0 and deadline-bounded
+    TTFT p99 are the line's structural pins; tests/test_fleet_chaos.py
+    asserts them). A subprocess for the same reasons as the serve
+    section: clean metrics registry, and a wedged fleet cannot take the
+    bench down. peak_hbm is unused — the line has no roofline
+    denominator — but the signature keeps the peak-table plumbing
+    uniform."""
+    del peak_hbm_gbps
+    # Inner timeout stays UNDER the section's 420s watchdog budget so
+    # this handler (not the section killer) reaps the serve_bench child
+    # — otherwise the grandchild's engines/router threads are orphaned
+    # and the rc/stderr diagnostic is lost.
+    _run_serve_subprocess("fleet", ["--engine", "fleet"],
+                          timeout=150 if os.environ.get("BENCH_SMOKE")
+                          else 360)
+
+
+def _run_serve_subprocess(label: str, extra_args: list,
+                          timeout: float) -> None:
+    """Shared harness for the serve-family sections: subprocess-run
+    tools/serve_bench.py and re-emit its JSON lines. A wedged run must
+    not take the bench down (nor skip the diagnostic): timeouts and
+    non-zero rcs are reported to stderr and the section moves on."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
-    smoke = bool(os.environ.get("BENCH_SMOKE"))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(here, "tools",
-                                          "serve_bench.py")],
-            capture_output=True, text=True,
-            timeout=180 if smoke else 600,
+                                          "serve_bench.py"),
+             *extra_args],
+            capture_output=True, text=True, timeout=timeout,
             env=dict(os.environ),
         )
     except subprocess.TimeoutExpired as exc:
-        # A wedged serving loop must not take the bench down (nor skip
-        # this diagnostic): report and move on.
-        print(f"bench: serve bench timed out after {exc.timeout:.0f}s",
-              file=sys.stderr, flush=True)
+        print(f"bench: {label} bench timed out after "
+              f"{exc.timeout:.0f}s", file=sys.stderr, flush=True)
         return
     emitted = False
     for raw in proc.stdout.splitlines():
@@ -485,7 +513,7 @@ def bench_serve_continuous(peak_hbm_gbps: float | None) -> None:
             emitted = True
     if proc.returncode != 0 or not emitted:
         print(
-            f"bench: serve bench rc={proc.returncode}: "
+            f"bench: {label} bench rc={proc.returncode}: "
             f"{proc.stderr[-500:]}",
             file=sys.stderr, flush=True,
         )
@@ -1152,6 +1180,7 @@ _SECTIONS: dict = {
     "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
+    "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }
 

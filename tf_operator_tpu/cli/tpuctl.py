@@ -500,6 +500,47 @@ def cmd_ckpt(args, master: str) -> int:
     return 0
 
 
+def cmd_serve(args, master: str) -> int:
+    """Render /debug/fleet: per-TPUServe replica membership (state,
+    endpoint, load, version), the autoscaler's current target and last
+    reason — `kubectl get deploy` for serving fleets."""
+    snap = _health_request(master, "/debug/fleet")
+    if args.output == "json":
+        print(json.dumps(snap, indent=2))
+        return 0
+    fleets = snap.get("fleets") or {}
+    if not fleets:
+        print("No TPUServe fleets")
+        return 0
+    for key, fleet in sorted(fleets.items()):
+        counts = (fleet.get("membership") or {}).get("counts") or {}
+        auto = fleet.get("autoscale") or {}
+        line = (f"{key}: target={fleet.get('target', 0)} "
+                + " ".join(f"{s}={n}" for s, n in sorted(counts.items())
+                           if n))
+        if auto.get("enabled"):
+            line += (f"  autoscale=[{auto.get('min')}..{auto.get('max')}]"
+                     + (f" last: {auto['last_reason']}"
+                        if auto.get("last_reason") else ""))
+        print(line)
+        replicas = (fleet.get("membership") or {}).get("replicas") or []
+        if replicas:
+            print(_table(
+                [[r.get("id", ""),
+                  r.get("state", ""),
+                  r.get("endpoint", ""),
+                  f"{r.get('activeSlots', 0)}/{r.get('maxSlots', 0)}",
+                  r.get("queueDepth", 0),
+                  f"{r.get('load', 0):.2f}",
+                  r.get("modelVersion", "") or "-",
+                  r.get("watchdogRestarts", 0)]
+                 for r in replicas],
+                ["REPLICA", "STATE", "ENDPOINT", "SLOTS", "QUEUE",
+                 "LOAD", "VERSION", "RESTARTS"],
+            ))
+    return 0
+
+
 def cmd_cordon(args, master: str, verb: str) -> int:
     """cordon/uncordon/drain: POST the verb to the operator. Drain carries
     a maintenance deadline (--at seconds from now) — the injected stand-in
@@ -611,6 +652,12 @@ def main(argv: list[str] | None = None) -> int:
                         help="checkpoint registry: acked steps / barriers")
     ck.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
+
+    sv = sub.add_parser("serve",
+                        help="TPUServe fleets: replica membership / "
+                             "autoscale targets")
+    sv.add_argument("-o", "--output", choices=("table", "json"),
+                    default="table")
     for verb, help_text in (
         ("cordon", "withdraw mesh cells from placement (operator-pinned)"),
         ("uncordon", "return mesh cells to service"),
@@ -634,6 +681,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_health(args, args.master)
     if args.cmd == "ckpt":
         return cmd_ckpt(args, args.master)
+    if args.cmd == "serve":
+        return cmd_serve(args, args.master)
     if args.cmd in ("cordon", "uncordon", "drain"):
         return cmd_cordon(args, args.master, args.cmd)
     client = TPUJobClient(RestClusterClient(args.master))

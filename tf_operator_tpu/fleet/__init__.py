@@ -1,0 +1,43 @@
+"""TPUServe fleet serving: long-running serve replicas behind an
+occupancy-aware router with queue-depth autoscaling.
+
+The composition layer over everything the operator already has: the
+gang scheduler admits each replica (PR 1), fleet health cordons sick
+cells under them (PR 2), and the supervised continuous engine makes a
+single replica safe to route to (PRs 5–7). This package adds the fleet
+abstractions — membership (which replicas are routable), the router
+(where one request goes, and where it retries), the autoscaler (how
+many replicas there should be), and the TPUServe controller (making it
+so). See docs/fleet-serving.md.
+"""
+
+from tf_operator_tpu.fleet.autoscale import Autoscaler, AutoscaleSnapshot
+from tf_operator_tpu.fleet.controller import FleetConfig, TPUServeController
+from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+from tf_operator_tpu.fleet.replica import (
+    FakeReplicaBackend,
+    ReplicaServer,
+    SupervisorBackend,
+    fleet_of,
+)
+from tf_operator_tpu.fleet.router import (
+    FleetRouter,
+    RouterConfig,
+    RouterServer,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscaleSnapshot",
+    "FakeReplicaBackend",
+    "FleetConfig",
+    "FleetMembership",
+    "FleetRouter",
+    "Replica",
+    "ReplicaServer",
+    "RouterConfig",
+    "RouterServer",
+    "SupervisorBackend",
+    "TPUServeController",
+    "fleet_of",
+]

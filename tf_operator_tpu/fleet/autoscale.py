@@ -1,0 +1,162 @@
+"""Queue-depth / TTFT autoscaling for a TPUServe fleet.
+
+Pure decision logic: ``Autoscaler.decide`` maps one fleet observation
+(ready replicas, aggregate queue depth, TTFT p99) to a target replica
+count. The controller applies the target by creating/draining child
+jobs; the policy never touches the cluster.
+
+Policy (api/serve_types.AutoscalePolicy):
+
+- SCALE UP by one when queued requests per READY replica exceed
+  ``queue_high`` — backlog is the direct "users are waiting" signal the
+  replicas already export (tpu_serve_queue_depth) — or when fleet TTFT
+  p99 exceeds ``ttft_p99_high_s`` (queues can look short while every
+  slot is pinned by long generations; latency catches that).
+- SCALE DOWN by one when backlog per replica drops under ``queue_low``
+  and the latency trigger is quiet. The ``queue_low < queue_high``
+  hysteresis band plus per-direction cooldowns prevent flapping; the
+  asymmetric defaults (up fast, down slow) are deliberate — a missing
+  replica costs user latency, a spare one only costs chips.
+- One step per decision: admission of a new replica takes seconds
+  (checkpoint load + warmup), so reacting to the same backlog twice
+  before the first new replica is READY would overshoot. Draining
+  replicas do not count as capacity (they take no new work) but also do
+  not block scale-up.
+
+Targets clamp to [min_replicas, max_replicas] always — even manual
+``spec.replicas`` edits pass through the same clamp in the controller.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from tf_operator_tpu.api.serve_types import AutoscalePolicy
+from tf_operator_tpu.runtime.metrics import FLEET_AUTOSCALE_TOTAL
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="fleet-autoscale")
+
+__all__ = ["AutoscalePolicy", "AutoscaleSnapshot", "Autoscaler"]
+
+
+@dataclass
+class AutoscaleSnapshot:
+    """One observation of the fleet, as the controller's probe sweep
+    sees it."""
+
+    ready: int
+    queue_depth: int              # aggregate across routable replicas
+    ttft_p99_s: float | None = None
+    # Requests the router answered no_replica since the last sync —
+    # the only demand signal a fleet scaled to zero can emit (nothing
+    # exists to queue on, so queue_depth is structurally 0).
+    unrouted: int = 0
+
+
+class Autoscaler:
+    def __init__(self, policy: AutoscalePolicy) -> None:
+        self.policy = policy
+        self.last_scale_up_at: float | None = None
+        self.last_scale_down_at: float | None = None
+        self.last_reason = ""
+
+    def clamp(self, target: int) -> int:
+        return max(self.policy.min_replicas,
+                   min(self.policy.max_replicas, target))
+
+    def decide(self, snap: AutoscaleSnapshot, current_target: int,
+               now: float | None = None) -> int:
+        """New target replica count given the observation; returns
+        ``current_target`` (clamped) when nothing should change."""
+        pol = self.policy
+        if not pol.enabled:
+            return current_target
+        now = time.monotonic() if now is None else now
+        target = self.clamp(current_target)
+        # No READY capacity at all with work queued is an immediate
+        # scale-up signal regardless of the per-replica ratio.
+        per_replica = (
+            snap.queue_depth / snap.ready if snap.ready
+            else float(snap.queue_depth)
+        )
+        ttft_high = bool(
+            pol.ttft_p99_high_s
+            and snap.ttft_p99_s is not None
+            and snap.ttft_p99_s > pol.ttft_p99_high_s
+        )
+        # A fleet at target 0 has no queues and no TTFT — rejected
+        # (no_replica) requests are its scale-up signal, and ANY demand
+        # against zero capacity warrants the first replica; without this
+        # a minReplicas=0 fleet that drained to zero could never come
+        # back.
+        cold_start = current_target == 0 and snap.unrouted > 0
+        want_up = per_replica > pol.queue_high or ttft_high or cold_start
+        want_down = (
+            not want_up
+            and not ttft_high
+            and per_replica < pol.queue_low
+        )
+        if not want_down:
+            # Load is present: a later lull must wait a full cooldown
+            # again before the first down-step.
+            self.last_scale_down_at = None
+        if want_up and target < pol.max_replicas:
+            if (self.last_scale_up_at is not None
+                    and now - self.last_scale_up_at
+                    < pol.scale_up_cooldown_s):
+                return target
+            self.last_scale_up_at = now
+            if ttft_high and snap.ttft_p99_s is not None:
+                self.last_reason = (
+                    f"ttft_p99 {snap.ttft_p99_s:.3f}s > "
+                    f"{pol.ttft_p99_high_s}s"
+                )
+            elif per_replica > pol.queue_high:
+                self.last_reason = (
+                    f"queue/replica {per_replica:.1f} > {pol.queue_high}"
+                )
+            else:
+                self.last_reason = (
+                    f"{snap.unrouted} unrouted request(s) against "
+                    "zero capacity"
+                )
+            FLEET_AUTOSCALE_TOTAL.inc(direction="up")
+            LOG.info(
+                f"scale up {target} -> {target + 1}: {self.last_reason}"
+            )
+            return target + 1
+        if want_down and target > pol.min_replicas:
+            if (self.last_scale_down_at is not None
+                    and now - self.last_scale_down_at
+                    < pol.scale_down_cooldown_s):
+                return target
+            # The down cooldown also starts at the first eligible
+            # observation rather than firing on it: one idle probe after
+            # a burst must not shrink the fleet.
+            if self.last_scale_down_at is None:
+                self.last_scale_down_at = now
+                return target
+            self.last_scale_down_at = now
+            self.last_reason = (
+                f"queue/replica {per_replica:.1f} < {pol.queue_low}"
+            )
+            FLEET_AUTOSCALE_TOTAL.inc(direction="down")
+            LOG.info(
+                f"scale down {target} -> {target - 1}: {self.last_reason}"
+            )
+            return target - 1
+        return target
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "enabled": self.policy.enabled,
+            "min": self.policy.min_replicas,
+            "max": self.policy.max_replicas,
+            "queue_high": self.policy.queue_high,
+            "queue_low": self.policy.queue_low,
+            "ttft_p99_high_s": self.policy.ttft_p99_high_s,
+            "last_reason": self.last_reason,
+        }

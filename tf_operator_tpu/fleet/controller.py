@@ -1,0 +1,775 @@
+"""The TPUServe controller: N serve replicas, kept alive and routable.
+
+Reconciled alongside TPUJob: this controller turns each TPUServe into
+child TPUJobs (one per replica, named ``{serve}-r{index}``), and the
+existing TPUJobController does everything below that line — gang
+admission, pod creation, gate release, restart policy. What lives HERE
+is the fleet layer neither controller has: membership (which replicas
+exist and whether they are routable), traffic withdrawal (drain /
+cordon → router eviction BEFORE processes die), replacement of dead
+replicas, queue-depth/TTFT autoscaling, and rolling model updates.
+
+Reconcile pipeline, per TPUServe, every sync:
+
+1. **Register + probe.** Every child job's replica is registered in the
+   per-fleet membership table; one probe sweep ingests each replica's
+   /healthz (``ok``/``draining``/``dead`` + occupancy/queue depth —
+   serve_lm's PR 9 readiness surface). Probe transport is injected so
+   tests and the operator share this code.
+2. **Cordon eviction.** Replicas whose child gang sits on cordoned
+   cells (health/monitor.py drives the cordon; the scheduler reports
+   the overlap) are marked CORDONED — withdrawn from routing while the
+   health machinery migrates the gang — and return to routing via
+   JOINING once re-placed on healthy cells.
+3. **Autoscale.** The per-fleet Autoscaler maps (ready replicas,
+   aggregate queue depth, fleet TTFT p99) to a target count, clamped to
+   the policy bounds; disabled policies pin target = spec.replicas.
+4. **Rolling update.** When ``spec.modelVersion`` changes, old-version
+   replicas are replaced one at a time: surge a new-version replica at
+   a fresh index, wait until it probes READY, then drain the old one —
+   traffic cuts over only when the replacement demonstrably serves, so
+   the handoff drops nothing (the drain below guarantees the old
+   replica's admitted requests finish).
+5. **Scale to target.** Missing replicas are created at fresh indices;
+   excess replicas DRAIN rather than die: the membership row flips
+   DRAINING (router deregisters it immediately — no drain-window 503s),
+   ``fleet.tpuflow.org/draining-at`` is stamped on the child job (the
+   scheduler exempts draining gangs from preemption — the drain IS the
+   eviction), and only after ``scaleDownGraceSeconds`` is the child
+   deleted, handing the process the SIGTERM bounded drain (PR 7's
+   ``--drain-timeout``) in which admitted requests complete.
+6. **Replace the dead.** A replica whose /healthz says ``dead`` (restart
+   budget exhausted) or that stopped answering probes entirely is
+   deleted immediately (nothing is draining — it serves nothing) and
+   recreated at the lowest free index. Freed indices (and with them
+   ports, ``portBase + index``) sit out ``index_quarantine_s`` before
+   reuse, so a half-dead predecessor still tearing down can never
+   squat its successor's endpoint — but unlike strictly-fresh max+1
+   allocation, a long-lived fleet's indices stay bounded by its width
+   instead of walking the port out of range one replacement at a time.
+7. **Status roll-up.** Replica/ready/draining/dead counts, the current
+   target, and a FleetReady condition land on the TPUServe status
+   (skip-unchanged, conflict-retried) — the ``tpuctl serve`` surface.
+
+Membership is PER FLEET (replicas of different TPUServes serve
+different models and must never share a router pick-set); a router is
+built over one fleet's table via ``membership_for``.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.serve_types import (
+    ANNOTATION_MODEL_VERSION,
+    ENV_SERVE_MODEL_VERSION,
+    ENV_SERVE_PORT,
+    ENV_SERVE_REPLICA_ID,
+    LABEL_SERVE_INDEX,
+    LABEL_SERVE_NAME,
+    TPUServe,
+    validate_serve_spec,
+)
+from tf_operator_tpu.fleet import membership as mship
+from tf_operator_tpu.fleet.autoscale import Autoscaler, AutoscaleSnapshot
+from tf_operator_tpu.fleet.membership import FleetMembership
+from tf_operator_tpu.runtime import events as ev
+from tf_operator_tpu.runtime import objects
+from tf_operator_tpu.runtime.client import Conflict, NotFound
+from tf_operator_tpu.scheduler.gang import ANNOTATION_DRAINING_AT
+from tf_operator_tpu.utils import logger
+from tf_operator_tpu.utils.times import parse_rfc3339
+
+LOG = logger.with_fields(component="fleet-controller")
+
+# Events (the PR 1/2 naming convention: past-tense reason strings).
+EVENT_REPLICA_CREATED = "ReplicaCreated"
+EVENT_REPLICA_DRAINING = "ReplicaDraining"
+EVENT_REPLICA_DELETED = "ReplicaDeleted"
+EVENT_REPLICA_DEAD = "ReplicaDead"
+EVENT_SCALED = "FleetScaled"
+EVENT_ROLLING_UPDATE = "RollingUpdate"
+EVENT_REJECTED = "FailedValidation"
+
+COND_FLEET_READY = "FleetReady"
+
+
+@dataclass
+class FleetConfig:
+    sync_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+    # Consecutive unanswered probes before a replica is DEAD (the
+    # process is gone; connection refused is not a health opinion).
+    fail_threshold: int = 3
+    # How long a JOINING replica may refuse connections before probe
+    # failures start counting toward fail_threshold: a real replica
+    # spends tens of seconds in gang admission + jax init + warmup
+    # before binding its port, and counting those refusals would churn
+    # it DEAD→replace→DEAD forever.
+    join_grace_s: float = 120.0
+    namespace: str | None = None
+    # Orphan-child GC runs on its own (longer) period: it is the one
+    # sweep that LISTs every TPUJob in the namespace, and doing that at
+    # sync_interval_s would reintroduce the per-second list traffic the
+    # PR 3 informer caches exist to eliminate. First sync always GCs
+    # (a restarted controller may be facing orphans from a TPUServe
+    # deleted while it was down).
+    gc_interval_s: float = 30.0
+    # Seconds a freed replica index (= port portBase+index) is held out
+    # of reuse. Deleting a child job only STARTS its teardown — a wedged
+    # predecessor can hold the port past the SIGTERM drain — so the
+    # successor must not bind the same endpoint immediately; after the
+    # quarantine the index is reused, keeping the fleet's index (and
+    # port) range bounded by its width, not its replacement history.
+    index_quarantine_s: float = 60.0
+
+
+class TPUServeController:
+    """``probe_fn(endpoint) -> /healthz dict`` (raises when unreachable)
+    and ``endpoint_fn(serve, index) -> "host:port"`` are injectable:
+    production uses real HTTP against ``host:portBase+index``; tests
+    point them at in-process FakeReplica servers."""
+
+    def __init__(self, client: Any, *,
+                 scheduler: Any = None,
+                 recorder: ev.EventRecorder | None = None,
+                 config: FleetConfig | None = None,
+                 probe_fn: Callable[[str], dict] | None = None,
+                 endpoint_fn: Callable[[TPUServe, int], str] | None = None,
+                 ) -> None:
+        self.client = client
+        self.scheduler = scheduler
+        self.recorder = recorder or ev.EventRecorder(client)
+        self.config = config or FleetConfig()
+        if probe_fn is None:
+            from tf_operator_tpu.fleet.router import http_probe
+
+            probe_fn = lambda ep: http_probe(  # noqa: E731
+                ep, self.config.probe_timeout_s
+            )
+        self._probe_fn = probe_fn
+        self._endpoint_fn = endpoint_fn
+        self._lock = threading.Lock()
+        # Per-fleet state, keyed by "namespace/name".
+        self._memberships: dict[str, FleetMembership] = {}
+        self._autoscalers: dict[str, Autoscaler] = {}
+        self._targets: dict[str, int] = {}
+        # Cumulative replicas declared dead per fleet (seeded from the
+        # persisted status on first sight): dead rows are deleted and
+        # replaced within the SAME sync, so a point-in-time membership
+        # count would always report 0.
+        self._deaths: dict[str, int] = {}
+        # Per-fleet quarantine of freed indices: index -> monotonic time
+        # it was freed. Consulted (and expired) by _next_index.
+        self._retired: dict[str, dict[int, float]] = {}
+        self._last_gc = float("-inf")
+        self._thread: threading.Thread | None = None
+
+    # -- per-fleet state ---------------------------------------------------
+
+    def membership_for(self, key: str) -> FleetMembership:
+        """The fleet's replica table (created on first use) — what a
+        router for this TPUServe routes from."""
+        with self._lock:
+            ms = self._memberships.get(key)
+            if ms is None:
+                ms = self._memberships[key] = FleetMembership(
+                    fail_threshold=self.config.fail_threshold,
+                    join_grace_s=self.config.join_grace_s,
+                    name=key,
+                )
+            return ms
+
+    def _autoscaler_for(self, serve: TPUServe) -> Autoscaler:
+        with self._lock:
+            auto = self._autoscalers.get(serve.key)
+            if auto is None or auto.policy != serve.spec.autoscale:
+                # New fleet or edited policy: decisions restart from the
+                # spec (cooldown clocks reset — an edited band must not
+                # inherit a stale cooldown from the old one).
+                auto = Autoscaler(serve.spec.autoscale)
+                self._autoscalers[serve.key] = auto
+            return auto
+
+    def endpoint_of(self, serve: TPUServe, index: int) -> str:
+        if self._endpoint_fn is not None:
+            return self._endpoint_fn(serve, index)
+        return f"{serve.spec.host}:{serve.spec.port_base + index}"
+
+    # -- decode ------------------------------------------------------------
+
+    def decode_serve(self, obj: dict[str, Any]) -> TPUServe | None:
+        try:
+            serve = TPUServe.from_dict(obj)
+            validate_serve_spec(serve.spec)
+            return serve
+        except Exception as e:  # noqa: BLE001 — the decode barrier:
+            # a bad spec gets an event, never a wedged sync loop.
+            self.recorder.warning(obj, EVENT_REJECTED, str(e))
+            LOG.warning(f"rejected TPUServe {objects.key_of(obj)}: {e}")
+            return None
+
+    # -- child jobs --------------------------------------------------------
+
+    def _children(self, serve: TPUServe) -> dict[int, dict[str, Any]]:
+        """index -> child TPUJob, from the store (fleet counts are
+        small; a LIST per sync is fine at this scale)."""
+        jobs = self.client.list(
+            objects.TPUJOBS, serve.metadata.namespace,
+            {LABEL_SERVE_NAME: serve.metadata.name},
+        )
+        out: dict[int, dict[str, Any]] = {}
+        for job in jobs:
+            try:
+                idx = int(objects.labels_of(job)[LABEL_SERVE_INDEX])
+            except (KeyError, ValueError):
+                continue
+            out[idx] = job
+        return out
+
+    def _build_child(self, serve: TPUServe, index: int) -> dict[str, Any]:
+        name = f"{serve.metadata.name}-r{index}"
+        template = copy.deepcopy(serve.spec.template)
+        port = self.endpoint_of(serve, index).rsplit(":", 1)[1]
+        for c in template.setdefault("spec", {}).setdefault(
+            "containers", []
+        ):
+            if c.get("name") != constants.DEFAULT_CONTAINER_NAME:
+                continue
+            env = c.setdefault("env", [])
+            env.extend([
+                {"name": ENV_SERVE_PORT, "value": port},
+                {"name": ENV_SERVE_REPLICA_ID, "value": name},
+                {"name": ENV_SERVE_MODEL_VERSION,
+                 "value": serve.spec.model_version},
+            ])
+        worker: dict[str, Any] = {"replicas": 1, "template": template}
+        if serve.spec.tpu is not None:
+            worker["tpu"] = serve.spec.tpu.to_dict()
+        spec: dict[str, Any] = {"replicaSpecs": {"Worker": worker}}
+        sched = serve.spec.scheduling.to_dict()
+        if sched:
+            spec["scheduling"] = sched
+        return {
+            "apiVersion": constants.API_VERSION,
+            "kind": constants.KIND,
+            "metadata": {
+                "name": name,
+                "namespace": serve.metadata.namespace,
+                "labels": {
+                    LABEL_SERVE_NAME: serve.metadata.name,
+                    LABEL_SERVE_INDEX: str(index),
+                },
+                "annotations": {
+                    ANNOTATION_MODEL_VERSION: serve.spec.model_version,
+                },
+                "ownerReferences": [{
+                    "apiVersion": serve.api_version,
+                    "kind": serve.kind,
+                    "name": serve.metadata.name,
+                    "uid": serve.metadata.uid or "",
+                    "controller": True,
+                }],
+            },
+            "spec": spec,
+        }
+
+    def _create_replica(self, serve: TPUServe,
+                        index: int) -> dict[str, Any]:
+        """Create the child job and return the dict it was built from
+        (callers reuse it for their local view instead of building the
+        template a second time)."""
+        job = self._build_child(serve, index)
+        name = objects.name_of(job)
+        try:
+            self.client.create(objects.TPUJOBS, job)
+        except Conflict:
+            return job  # a concurrent sync already created it
+        self.membership_for(serve.key).register(
+            name, self.endpoint_of(serve, index),
+            model_version=serve.spec.model_version,
+        )
+        self.recorder.normal(
+            serve.to_dict(), EVENT_REPLICA_CREATED,
+            f"replica {name} created at "
+            f"{self.endpoint_of(serve, index)}",
+        )
+        return job
+
+    def _begin_drain(self, serve: TPUServe, job: dict[str, Any],
+                     reason: str) -> None:
+        """Phase 1 of removal: withdraw from routing NOW, exempt the
+        gang from preemption, and start the grace clock — the child job
+        (and with it the process + its SIGTERM bounded drain) survives
+        until ``_finish_drains`` sees the grace expire."""
+        name = objects.name_of(job)
+        if ANNOTATION_DRAINING_AT in objects.annotations_of(job):
+            return  # already draining; the clock is running
+        self.membership_for(serve.key).mark_draining(name)
+        try:
+            self.client.patch_merge(
+                objects.TPUJOBS, serve.metadata.namespace, name,
+                {"metadata": {"annotations": {
+                    ANNOTATION_DRAINING_AT: objects.now_iso(),
+                }}},
+            )
+        except NotFound:
+            return
+        self.recorder.normal(
+            serve.to_dict(), EVENT_REPLICA_DRAINING,
+            f"replica {name} draining ({reason}); router deregistered, "
+            f"deletion in {serve.spec.scale_down_grace_s:.0f}s",
+        )
+
+    def _delete_replica(self, serve: TPUServe, name: str,
+                        reason: str, *, index: int | None = None) -> None:
+        try:
+            self.client.delete(
+                objects.TPUJOBS, serve.metadata.namespace, name
+            )
+        except NotFound:
+            pass
+        if index is not None:
+            self._retired.setdefault(serve.key, {})[index] = (
+                time.monotonic()
+            )
+        self.membership_for(serve.key).deregister(name)
+        self.recorder.normal(
+            serve.to_dict(), EVENT_REPLICA_DELETED,
+            f"replica {name} deleted ({reason})",
+        )
+
+    # -- reconcile ---------------------------------------------------------
+
+    def sync_all(self) -> None:
+        """One pass over every TPUServe (+ orphan cleanup)."""
+        serves = self.client.list(
+            objects.TPUSERVES, self.config.namespace, None
+        )
+        # Orphan GC keys on the objects that EXIST, not the ones that
+        # decode: a live fleet whose spec is edited into something
+        # invalid must freeze (event + no reconcile), not have its
+        # replicas collected as orphans.
+        present: set[str] = set()
+        for obj in serves:
+            present.add(objects.key_of(obj))
+            serve = self.decode_serve(obj)
+            if serve is None:
+                continue
+            try:
+                self.reconcile_serve(serve)
+            except Conflict:
+                pass  # stale read; the next sync retries fresh
+        self._collect_orphans(present)
+
+    def reconcile_serve(self, serve: TPUServe) -> None:
+        key = serve.key
+        ms = self.membership_for(key)
+        children = self._children(serve)
+        version = serve.spec.model_version
+
+        # 1. Register every child (idempotent) and sweep probes. A
+        # draining annotation re-marks the row each sync, so a restarted
+        # controller recovers drain state from the store, not memory.
+        for idx, job in sorted(children.items()):
+            name = objects.name_of(job)
+            rep = ms.register(
+                name, self.endpoint_of(serve, idx),
+                model_version=objects.annotations_of(job).get(
+                    ANNOTATION_MODEL_VERSION, ""
+                ),
+            )
+            if (ANNOTATION_DRAINING_AT in objects.annotations_of(job)
+                    and rep.state != mship.DEAD):
+                ms.mark_draining(name)
+        child_names = {objects.name_of(j) for j in children.values()}
+        for rid in [r.id for r in ms.all()]:
+            if rid not in child_names:
+                ms.deregister(rid)  # child gone outside our delete path
+        ms.probe(self._probe_fn)
+
+        # 2. Cordon → router eviction (and back): the health machinery
+        # owns the gang migration; membership only mirrors it so the
+        # router stops sending traffic into a cell being drained.
+        if self.scheduler is not None:
+            cordoned = set(self.scheduler.gangs_on_cordoned_cells())
+            for idx, job in children.items():
+                name = objects.name_of(job)
+                rep = ms.get(name)
+                if rep is None:
+                    continue
+                child_key = f"{serve.metadata.namespace}/{name}"
+                if child_key in cordoned:
+                    if rep.state in (mship.READY, mship.JOINING):
+                        ms.mark_cordoned(name)
+                elif rep.state == mship.CORDONED:
+                    ms.uncordon(name)
+
+        # 3. Autoscale target (or the spec's replica count, clamped).
+        counts = ms.counts()
+        auto = self._autoscaler_for(serve)
+        # Drained unconditionally so a later policy enable starts from
+        # a fresh window, not months of accumulated rejections.
+        unrouted = ms.take_unrouted()
+        if serve.spec.autoscale.enabled:
+            current = self._targets.get(key)
+            if current is None:
+                # First sight of this fleet (or a restarted/failed-over
+                # controller): resume the persisted status.target rather
+                # than snapping back to spec.replicas — snapping would
+                # drain autoscaled-up replicas in one sync, bypassing
+                # the two-observation scale-down hysteresis.
+                # last_reconcile_time distinguishes "status was really
+                # written" from the TPUServeStatus default: a fleet
+                # legitimately scaled to target 0 (minReplicas 0) must
+                # resume at 0, not snap back to spec.replicas and
+                # recreate everything the autoscaler drained.
+                persisted = serve.status.target
+                reconciled = bool(serve.status.last_reconcile_time)
+                current = auto.clamp(
+                    persisted if persisted > 0 or reconciled
+                    else serve.spec.replicas
+                )
+            target = auto.decide(
+                AutoscaleSnapshot(
+                    ready=counts[mship.READY],
+                    queue_depth=ms.aggregate_queue_depth(),
+                    ttft_p99_s=ms.fleet_ttft_p99(),
+                    unrouted=unrouted,
+                ),
+                current,
+            )
+            if target != current:
+                self.recorder.normal(
+                    serve.to_dict(), EVENT_SCALED,
+                    f"autoscale {current} -> {target}: "
+                    f"{auto.last_reason}",
+                )
+        else:
+            target = serve.spec.replicas
+        self._targets[key] = target
+
+        draining_names = self._draining_names(children)
+        # 4. Replace dead replicas first: they serve nothing, so no
+        # drain phase — delete now, recreate at a free index below.
+        # Draining children are NOT deaths even when their process is
+        # already gone (an early drain exit is the drain SUCCEEDING):
+        # _finish_drains deletes those without waiting out the grace.
+        for idx, job in sorted(children.items()):
+            name = objects.name_of(job)
+            if name in draining_names:
+                continue
+            rep = ms.get(name)
+            if rep is not None and rep.state == mship.DEAD:
+                self.recorder.warning(
+                    serve.to_dict(), EVENT_REPLICA_DEAD,
+                    f"replica {name} dead "
+                    f"({rep.consecutive_failures} failed probe(s), "
+                    f"{rep.watchdog_restarts} watchdog restart(s)); "
+                    "replacing",
+                )
+                self._deaths[key] = self._deaths.get(
+                    key, serve.status.dead
+                ) + 1
+                self._delete_replica(serve, name, "dead", index=idx)
+                children.pop(idx)
+
+        # 5. Rolling update, one replica at a time. Invariant: drain a
+        # stale replica ONLY while surge surplus exists (live > target
+        # AND a new-version replica probes READY), and surge ONLY while
+        # there is no surplus — so ready-capable capacity never dips
+        # below target, and each drained stale replica's deletion
+        # re-creates the surge for the next one.
+        live = {
+            i: j for i, j in children.items()
+            if objects.name_of(j) not in draining_names
+        }
+        stale = sorted(
+            i for i, j in live.items()
+            if objects.annotations_of(j).get(ANNOTATION_MODEL_VERSION, "")
+            != version
+        )
+        if stale:
+            fresh_ready = [
+                i for i in live
+                if i not in stale
+                and (r := ms.get(objects.name_of(live[i]))) is not None
+                and r.state == mship.READY
+            ]
+            if len(live) <= target:
+                idx = self._next_index(serve, children)
+                children[idx] = live[idx] = self._create_replica(
+                    serve, idx
+                )
+                self.recorder.normal(
+                    serve.to_dict(), EVENT_ROLLING_UPDATE,
+                    f"surging replica r{idx} at version {version!r} "
+                    f"({len(stale)} stale replica(s) to replace)",
+                )
+            elif fresh_ready:
+                # The surge replica serves: cut one old one loose. The
+                # router deregistered it the moment the drain began, so
+                # the cutover drops nothing.
+                victim = live[stale[0]]
+                self._begin_drain(
+                    serve, victim, f"rolling update to {version!r}"
+                )
+                draining_names.add(objects.name_of(victim))
+            elif len(stale) == len(live):
+                # Target fell below the live count mid-roll (spec edit
+                # or autoscaler down-step) and no new-version replica
+                # exists to wait on: the surplus is excess, not surge.
+                # Drain one stale replica per sync — live stays >=
+                # target throughout, and once live == target the surge
+                # branch above takes over the roll.
+                victim = live[stale[0]]
+                self._begin_drain(
+                    serve, victim,
+                    f"rolling update to {version!r} (shrinking stale "
+                    "surplus above target)",
+                )
+                draining_names.add(objects.name_of(victim))
+
+        # 6. Scale to target (draining replicas are neither capacity
+        # nor candidates — they are already on their way out). Plain
+        # scale-down holds while a roll is in flight: the surge surplus
+        # above is intentional, not excess.
+        active = {
+            i: j for i, j in children.items()
+            if objects.name_of(j) not in draining_names
+        }
+        while len(active) < target:
+            idx = self._next_index(serve, children)
+            children[idx] = active[idx] = self._create_replica(
+                serve, idx
+            )
+        if len(active) > target and not stale:
+            # Highest index first: deterministic, and the longest-lived
+            # replicas (warmest caches) survive.
+            for idx in sorted(active, reverse=True)[
+                : len(active) - target
+            ]:
+                self._begin_drain(serve, active[idx], "scale down")
+                draining_names.add(objects.name_of(active[idx]))
+                active.pop(idx)
+
+        # 7. Finish expired drains: grace over → delete the child; the
+        # executor's SIGTERM delivery starts the process's own bounded
+        # drain (admitted requests finish inside --drain-timeout).
+        self._finish_drains(serve, children)
+
+        # 8. Status roll-up.
+        self._write_status(serve, children, target)
+
+    def _draining_names(self, children: dict[int, dict]) -> set[str]:
+        return {
+            objects.name_of(j) for j in children.values()
+            if ANNOTATION_DRAINING_AT in objects.annotations_of(j)
+        }
+
+    def _next_index(self, serve: TPUServe,
+                    children: dict[int, dict]) -> int:
+        """Lowest index neither held by an existing child (live OR
+        draining — its process still owns the port) nor inside the
+        reuse quarantine. Bounded: a fleet's indices never exceed its
+        peak width plus the handful quarantined at any moment, so
+        ``portBase + index`` stays inside the validated port range no
+        matter how many replacements a long-lived fleet goes through."""
+        now = time.monotonic()
+        retired = self._retired.get(serve.key, {})
+        for i, freed_at in list(retired.items()):
+            if now - freed_at >= self.config.index_quarantine_s:
+                retired.pop(i)
+        idx = 0
+        while idx in children or idx in retired:
+            idx += 1
+        return idx
+
+    def _finish_drains(self, serve: TPUServe,
+                       children: dict[int, dict]) -> None:
+        ms = self.membership_for(serve.key)
+        for idx, job in sorted(children.items()):
+            stamp = objects.annotations_of(job).get(ANNOTATION_DRAINING_AT)
+            if not stamp:
+                continue
+            name = objects.name_of(job)
+            started = parse_rfc3339(stamp)
+            rep = ms.get(name)
+            drained = rep is not None and rep.state == mship.DEAD
+            if drained or started is None or (
+                time.time() - started >= serve.spec.scale_down_grace_s
+            ):
+                self._delete_replica(
+                    serve, name, "drain complete", index=idx
+                )
+                children.pop(idx)
+
+    def _collect_orphans(self, seen: set[str]) -> None:
+        """Children whose TPUServe is gone: delete them and drop the
+        per-fleet state (controller-side GC — ownerReferences also cover
+        backends with a real GC, but the in-memory store has none for
+        TPUServe parents).
+
+        The namespace-wide TPUJob LIST is throttled to gc_interval_s;
+        the in-memory per-fleet state cleanup is free and runs every
+        sync."""
+        now = time.monotonic()
+        if now - self._last_gc >= self.config.gc_interval_s:
+            self._last_gc = now
+            jobs = self.client.list(
+                objects.TPUJOBS, self.config.namespace, None
+            )
+            for job in jobs:
+                labels = objects.labels_of(job)
+                serve_name = labels.get(LABEL_SERVE_NAME)
+                if not serve_name:
+                    continue
+                key = f"{objects.namespace_of(job)}/{serve_name}"
+                if key in seen:
+                    continue
+                try:
+                    self.client.delete(
+                        objects.TPUJOBS, objects.namespace_of(job),
+                        objects.name_of(job),
+                    )
+                except NotFound:
+                    pass
+                LOG.info(
+                    f"deleted orphan replica {objects.key_of(job)} "
+                    f"(TPUServe {key} is gone)"
+                )
+        with self._lock:
+            for key in list(self._memberships):
+                if key not in seen:
+                    self._memberships.pop(key).close()
+                    self._autoscalers.pop(key, None)
+                    self._targets.pop(key, None)
+                    self._deaths.pop(key, None)
+                    self._retired.pop(key, None)
+
+    # -- status ------------------------------------------------------------
+
+    def _write_status(self, serve: TPUServe, children: dict[int, dict],
+                      target: int) -> None:
+        ms = self.membership_for(serve.key)
+        counts = ms.counts()
+        status = serve.status
+        before = status.to_dict()
+        status.replicas = len(children)
+        status.ready = counts[mship.READY]
+        status.draining = counts[mship.DRAINING]
+        # Cumulative: a dead replica is deleted + deregistered in the
+        # same sync that sees it, so counts[DEAD] here is always 0.
+        status.dead = self._deaths.get(serve.key, status.dead)
+        status.target = target
+        versions = {
+            r.model_version for r in ms.all() if r.state == mship.READY
+        }
+        status.model_version = (
+            versions.pop() if len(versions) == 1 else ""
+        )
+        ready_now = target == 0 or status.ready >= target
+        self._set_condition(
+            serve, COND_FLEET_READY,
+            "True" if ready_now else "False",
+            reason="AllReplicasReady" if ready_now else "FleetPending",
+            message=(
+                f"{status.ready}/{target} replicas ready"
+                + (f", {status.draining} draining"
+                   if status.draining else "")
+            ),
+        )
+        after = status.to_dict()
+        if after == before:
+            return
+        status.last_reconcile_time = objects.now_iso()
+        for attempt in range(3):
+            try:
+                self.client.update_status(
+                    objects.TPUSERVES, serve.to_dict()
+                )
+                return
+            except Conflict:
+                if attempt == 2:
+                    raise
+                try:
+                    fresh = self.client.get(
+                        objects.TPUSERVES, serve.metadata.namespace,
+                        serve.metadata.name,
+                    )
+                except NotFound:
+                    return
+                serve.metadata.resource_version = str(
+                    objects.meta(fresh).get("resourceVersion", "")
+                )
+            except NotFound:
+                return
+
+    def _set_condition(self, serve: TPUServe, ctype: str, value: str,
+                       *, reason: str, message: str) -> None:
+        from tf_operator_tpu.api.types import JobCondition
+
+        for cond in serve.status.conditions:
+            if cond.type == ctype:
+                if cond.status != value or cond.message != message:
+                    cond.status = value
+                    cond.reason = reason
+                    cond.message = message
+                    cond.last_transition_time = objects.now_iso()
+                return
+        serve.status.conditions.append(JobCondition(
+            type=ctype, status=value, reason=reason, message=message,
+            last_transition_time=objects.now_iso(),
+        ))
+
+    # -- snapshots / run ---------------------------------------------------
+
+    def debug_snapshot(self) -> dict[str, Any]:
+        """The /debug/fleet controller section: per-fleet membership +
+        target + autoscaler state."""
+        # Membership/autoscaler references are captured under the lock
+        # (a concurrent fleet deletion pops these dicts mid-iteration);
+        # the snapshot() calls run outside it — they take their own
+        # locks and must not nest under ours.
+        with self._lock:
+            fleets = [
+                (key, self._targets.get(key, 0), ms,
+                 self._autoscalers.get(key))
+                for key, ms in sorted(self._memberships.items())
+            ]
+        return {
+            "fleets": {
+                key: {
+                    "target": target,
+                    "membership": ms.snapshot(),
+                    "autoscale": (
+                        auto.snapshot() if auto is not None else None
+                    ),
+                }
+                for key, target, ms, auto in fleets
+            }
+        }
+
+    def start(self, stop: threading.Event,
+              interval: float | None = None) -> None:
+        """Background reconcile loop (the operator runs this only while
+        leading — a standby must not create or drain replicas)."""
+        period = interval or self.config.sync_interval_s
+
+        def loop() -> None:
+            while not stop.wait(period):
+                try:
+                    self.sync_all()
+                except Exception:  # noqa: BLE001 — one bad pass must
+                    # not kill the loop; the next interval retries.
+                    LOG.exception("fleet sync failed")
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="fleet-controller"
+        )
+        self._thread.start()

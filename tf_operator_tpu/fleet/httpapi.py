@@ -1,0 +1,50 @@
+"""/debug/fleet HTTP surface: the TPUServe controller snapshot.
+
+Mounts on the operator's ApiServer via its extra-handler hook (the
+/debug/scheduler, /debug/health, /debug/ckpt pattern — see
+runtime/observability.mount_observability, which mounts this when the
+operator runs with fleet serving on).
+
+    GET /debug/fleet → TPUServeController.debug_snapshot()
+                       {fleets: {"ns/name": {target, membership,
+                        autoscale}}}
+
+`tpuctl serve` renders this payload; the per-fleet RouterServer exposes
+its OWN /debug/fleet (membership + router counters) on the router port —
+same name, the fleet seen from two sides.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="fleet-api")
+
+
+class FleetDebugHandler:
+    def __init__(self, controller: Any) -> None:
+        self._controller = controller
+
+    def __call__(self, req: Any) -> bool:
+        path = req.path.split("?", 1)[0]
+        if req.command != "GET" or path != "/debug/fleet":
+            return False
+        body = json.dumps(
+            self._controller.debug_snapshot(), indent=2
+        ).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+        return True
+
+
+def mount_fleet(api_server: Any, controller: Any) -> FleetDebugHandler:
+    handler = FleetDebugHandler(controller)
+    api_server.add_handler(handler)
+    LOG.info("fleet API mounted at /debug/fleet")
+    return handler

@@ -1,0 +1,397 @@
+"""Fleet membership: the replica table the router routes from.
+
+One row per serve replica (a TPUServe child job's serving process).
+State is derived from the replica's own /healthz — the PR 7/9 readiness
+surface — so the table never guesses:
+
+    JOINING   registered, no successful probe yet (not routable)
+    READY     ok:true, draining:false, dead:false (routable)
+    DRAINING  draining:true (SIGTERM drain in flight) or the controller
+              marked it for scale-down — deregistered from routing
+              BEFORE the drain completes, so the router never eats the
+              drain-window 503s
+    CORDONED  operator/health-driven eviction: alive but withdrawn from
+              routing (the health machinery is migrating its gang)
+    DEAD      dead:true (restart budget exhausted), the controller
+              killed it, or ``fail_threshold`` consecutive probe
+              failures (the process is gone — connection refused)
+
+Occupancy (active_slots/max_slots) and queue depth ride the same probe
+payload (serve_lm /healthz carries them; /debug/serve agrees) and feed
+the router's least-loaded pick plus the autoscaler's aggregate signals.
+The router also tracks its own in-flight count per replica so a stale
+probe cannot stack every request on one replica between sweeps.
+
+Thread-safe; gauges (tpu_fleet_replicas{state}, tpu_fleet_queue_depth)
+are re-exported on every mutation/sweep.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tf_operator_tpu.runtime.metrics import (
+    FLEET_QUEUE_DEPTH,
+    FLEET_REPLICAS,
+)
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="fleet-membership")
+
+JOINING = "joining"
+READY = "ready"
+DRAINING = "draining"
+CORDONED = "cordoned"
+DEAD = "dead"
+STATES = (JOINING, READY, DRAINING, CORDONED, DEAD)
+
+
+@dataclass
+class Replica:
+    """One serve replica as the router sees it."""
+
+    id: str
+    endpoint: str  # "host:port"
+    model_version: str = ""
+    state: str = JOINING
+    # Last probe's load picture (0s until the first successful probe).
+    max_slots: int = 0
+    active_slots: int = 0
+    queue_depth: int = 0
+    watchdog_restarts: int = 0
+    # Per-replica TTFT p99 from the probe payload (None until a probe
+    # carries one) — the autoscaler's latency trigger reads the fleet
+    # max so one slow replica is enough to scale.
+    ttft_p99_s: float | None = None
+    # Router-local outstanding requests (begin/end around each send).
+    inflight: int = 0
+    consecutive_failures: int = 0
+    last_probe_at: float | None = None
+    registered_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def routable(self) -> bool:
+        return self.state == READY
+
+    @property
+    def load(self) -> float:
+        """Least-loaded score: probed backlog plus the router's own
+        in-flight count, normalized by capacity (unknown capacity — no
+        probe yet — scores as 1 slot so empty newcomers still win)."""
+        return (self.active_slots + self.queue_depth + self.inflight) / max(
+            1, self.max_slots
+        )
+
+    def snapshot(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "endpoint": self.endpoint,
+            "state": self.state,
+            "modelVersion": self.model_version,
+            "maxSlots": self.max_slots,
+            "activeSlots": self.active_slots,
+            "queueDepth": self.queue_depth,
+            "inflight": self.inflight,
+            "watchdogRestarts": self.watchdog_restarts,
+            "consecutiveFailures": self.consecutive_failures,
+            "ttftP99Seconds": self.ttft_p99_s,
+            "load": round(self.load, 4),
+        }
+
+
+class FleetMembership:
+    def __init__(self, *, fail_threshold: int = 3,
+                 join_grace_s: float = 120.0,
+                 name: str = "default") -> None:
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        self.fail_threshold = fail_threshold
+        self.join_grace_s = join_grace_s
+        # Label for the process-global tpu_fleet_* gauges: one operator
+        # reconciles many fleets, and unlabeled exports would flip-flop
+        # between per-fleet values on every sweep.
+        self.name = name
+        self._lock = threading.Lock()
+        self._replicas: dict[str, Replica] = {}
+        # Probe sweeps reuse one pool for the table's lifetime: routers
+        # sweep every probe_interval_s (sub-second), and spawning+joining
+        # a fresh executor's threads per sweep is pure churn. Workers
+        # are created lazily by the executor, so an idle table costs no
+        # threads.
+        self._probe_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="fleet-probe"
+        )
+        # Requests the router could not place anywhere (no_replica
+        # answers) since the controller last read. This is the ONLY
+        # demand signal a scaled-to-zero fleet has: with no replicas
+        # there is no queue to measure, so without it minReplicas=0
+        # fleets could never scale back up.
+        self._unrouted = 0
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, rid: str, endpoint: str, *,
+                 model_version: str = "") -> Replica:
+        """Idempotent: re-registering an existing id only refreshes its
+        endpoint/version (the controller calls this every sync)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                rep = Replica(rid, endpoint, model_version=model_version)
+                self._replicas[rid] = rep
+                LOG.info(f"replica {rid} registered at {endpoint}")
+            else:
+                rep.endpoint = endpoint
+                if model_version:
+                    rep.model_version = model_version
+            self._export_locked()
+            return rep
+
+    def deregister(self, rid: str) -> None:
+        with self._lock:
+            if self._replicas.pop(rid, None) is not None:
+                LOG.info(f"replica {rid} deregistered")
+            self._export_locked()
+
+    def close(self) -> None:
+        """Zero this fleet's gauge series before the table is discarded:
+        the registry is process-global and set-only, so a deleted
+        TPUServe would otherwise keep reporting its last live counts
+        (a phantom fleet on dashboards) for the rest of the operator's
+        life."""
+        with self._lock:
+            self._replicas.clear()
+            self._export_locked()
+        self._probe_pool.shutdown(wait=False)
+
+    # -- probe ingestion ---------------------------------------------------
+
+    def observe(self, rid: str, payload: dict[str, Any]) -> None:
+        """Apply one /healthz payload. A cordoned replica stays cordoned
+        (the cordon is an external withdrawal, not a health fact); a DEAD
+        verdict is sticky until deregistration — the supervisor never
+        resurrects a dead replica in place."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            rep.last_probe_at = time.monotonic()
+            rep.consecutive_failures = 0
+            rep.active_slots = int(payload.get("active_slots", 0))
+            rep.queue_depth = int(payload.get("queue_depth", 0))
+            rep.max_slots = int(payload.get("max_slots", rep.max_slots))
+            rep.watchdog_restarts = int(
+                payload.get("watchdog_restarts", rep.watchdog_restarts)
+            )
+            # Absent key = the replica's TTFT window drained (no recent
+            # traffic). CLEAR the stale value: latching the last reading
+            # would keep the autoscaler's `not ttft_high` scale-down
+            # guard tripped forever after any latency episode followed
+            # by idle — an idle fleet pinned at max_replicas.
+            if payload.get("ttft_p99_s") is not None:
+                rep.ttft_p99_s = float(payload["ttft_p99_s"])
+            else:
+                rep.ttft_p99_s = None
+            if payload.get("dead"):
+                self._transition_locked(rep, DEAD)
+            elif rep.state == DEAD:
+                pass  # sticky (see docstring)
+            elif payload.get("draining"):
+                self._transition_locked(rep, DRAINING)
+            elif rep.state in (CORDONED, DRAINING):
+                # External withdrawals are lifted explicitly (uncordon /
+                # controller), never by a healthy-looking probe.
+                pass
+            elif payload.get("ok"):
+                self._transition_locked(rep, READY)
+            self._export_locked()
+
+    def probe_failed(self, rid: str) -> None:
+        """A probe (or a routed send) could not reach the replica at
+        all. ``fail_threshold`` consecutive failures = the process is
+        gone → DEAD.
+
+        A JOINING replica inside ``join_grace_s`` of registration is
+        exempt: the controller registers the endpoint the moment the
+        child job exists, but a real replica spends tens of seconds in
+        gang admission + jax init before binding its port — counting
+        those connection-refusals would declare it DEAD, delete it,
+        recreate it at a fresh index, and churn forever without ever
+        reaching READY. (An uncordoned replica re-enters JOINING with
+        its ORIGINAL registered_at, so a genuinely-gone one still dies
+        on schedule.)"""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            if (rep.state == JOINING and time.monotonic()
+                    - rep.registered_at < self.join_grace_s):
+                return
+            rep.consecutive_failures += 1
+            if (rep.consecutive_failures >= self.fail_threshold
+                    and rep.state != DEAD):
+                self._transition_locked(rep, DEAD)
+            self._export_locked()
+
+    def probe(self, probe_fn: Callable[[str], dict[str, Any]]) -> None:
+        """One sweep: probe_fn(endpoint) -> /healthz dict (raises on an
+        unreachable replica). Snapshot the table first — probes do I/O
+        and must not run under the lock — and probe CONCURRENTLY: the
+        controller runs this on its reconcile path, and a serial sweep
+        would let one wedged replica (accepts the connection, never
+        answers — the PR 7 stall mode) hold every fleet's autoscale /
+        drain / replacement clocks hostage for probe_timeout_s apiece."""
+        with self._lock:
+            targets = [(r.id, r.endpoint) for r in self._replicas.values()]
+        if not targets:
+            return
+
+        def one(rid: str, endpoint: str) -> None:
+            try:
+                payload = probe_fn(endpoint)
+            except Exception:  # noqa: BLE001 — unreachable is a signal
+                self.probe_failed(rid)
+            else:
+                self.observe(rid, payload)
+
+        if len(targets) == 1:
+            one(*targets[0])
+            return
+        try:
+            futures = [
+                self._probe_pool.submit(one, rid, endpoint)
+                for rid, endpoint in targets
+            ]
+        except RuntimeError:  # closed table (fleet deleted mid-sweep)
+            return
+        for f in futures:
+            f.result()
+
+    # -- external transitions ---------------------------------------------
+
+    def mark_draining(self, rid: str) -> None:
+        self._mark(rid, DRAINING)
+
+    def mark_cordoned(self, rid: str) -> None:
+        self._mark(rid, CORDONED)
+
+    def mark_dead(self, rid: str) -> None:
+        self._mark(rid, DEAD)
+
+    def uncordon(self, rid: str) -> None:
+        """Back to JOINING (not READY): the next successful probe
+        re-promotes it, so an uncordon can never route to a replica that
+        died while withdrawn."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None and rep.state == CORDONED:
+                self._transition_locked(rep, JOINING)
+            self._export_locked()
+
+    def _mark(self, rid: str, state: str) -> None:
+        # DEAD is sticky: a dead replica gets replaced, never re-marked.
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None and rep.state != DEAD:
+                self._transition_locked(rep, state)
+            self._export_locked()
+
+    def _transition_locked(self, rep: Replica, state: str) -> None:
+        if rep.state != state:
+            LOG.info(f"replica {rep.id}: {rep.state} -> {state}")
+            rep.state = state
+
+    # -- router bookkeeping ------------------------------------------------
+
+    def begin(self, rid: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None:
+                rep.inflight += 1
+
+    def end(self, rid: str) -> None:
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is not None and rep.inflight > 0:
+                rep.inflight -= 1
+
+    # -- views -------------------------------------------------------------
+
+    def get(self, rid: str) -> Replica | None:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def routable(self) -> list[Replica]:
+        with self._lock:
+            return [r for r in self._replicas.values() if r.routable]
+
+    def all(self) -> list[Replica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            out = {s: 0 for s in STATES}
+            for rep in self._replicas.values():
+                out[rep.state] += 1
+            return out
+
+    def note_unrouted(self) -> None:
+        """The router failed to place a request (no routable replica)."""
+        with self._lock:
+            self._unrouted += 1
+
+    def take_unrouted(self) -> int:
+        """Unplaced-request count since the last read (drain-on-read;
+        the controller feeds it to the autoscaler once per sync)."""
+        with self._lock:
+            n, self._unrouted = self._unrouted, 0
+            return n
+
+    def aggregate_queue_depth(self) -> int:
+        with self._lock:
+            return sum(
+                r.queue_depth for r in self._replicas.values() if r.routable
+            )
+
+    def fleet_ttft_p99(self) -> float | None:
+        """Worst routable replica's TTFT p99 (None when no probe has
+        carried one) — one slow replica is enough for the autoscaler's
+        latency trigger."""
+        with self._lock:
+            vals = [
+                r.ttft_p99_s for r in self._replicas.values()
+                if r.routable and r.ttft_p99_s is not None
+            ]
+            return max(vals) if vals else None
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "replicas": [
+                    r.snapshot()
+                    for r in sorted(self._replicas.values(),
+                                    key=lambda r: r.id)
+                ],
+                "counts": {
+                    s: sum(1 for r in self._replicas.values()
+                           if r.state == s)
+                    for s in STATES
+                },
+            }
+
+    def _export_locked(self) -> None:
+        for s in STATES:
+            FLEET_REPLICAS.set(
+                sum(1 for r in self._replicas.values() if r.state == s),
+                fleet=self.name, state=s,
+            )
+        FLEET_QUEUE_DEPTH.set(
+            sum(r.queue_depth for r in self._replicas.values()
+                if r.routable),
+            fleet=self.name,
+        )

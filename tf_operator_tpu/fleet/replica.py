@@ -1,0 +1,306 @@
+"""In-process serve replica: serve_lm's HTTP surface over an injected
+backend, for fleets that live inside one process.
+
+serve_lm is the production replica — one process, one engine, one port.
+Fleet tests and the fleet bench leg need FOUR of those at once on a CPU
+host, where four serve_lm subprocesses would mean four jax inits and
+four quick-trained models. ``ReplicaServer`` keeps the contract and
+drops the processes: the same three endpoints (``/healthz`` via
+serve/httpapi.readiness_payload — the exact probe shape
+fleet/membership.py routes from — plus ``/generate`` with PR 7's typed
+error payloads and ``/metrics``), backed by either
+
+- ``SupervisorBackend``: a real supervised continuous engine
+  (serve/resilience.EngineSupervisor) — the bench's replica, or
+- ``FakeReplicaBackend``: jax-free and scriptable (canned tokens,
+  service delay, injected typed errors, settable load numbers) — the
+  fast routing/retry/autoscale test tier.
+
+Because several replicas share one process, the server stamps its
+``replica`` id onto every response explicitly rather than through
+serve/resilience's process-global ``set_replica_id`` channel (which is
+serve_lm's one-replica-per-process shortcut).
+
+Lifecycle hooks mirror what the fleet controller does to real replicas:
+``begin_drain()`` flips readiness (healthz ``draining: true``, new
+/generate refused with the typed ``draining`` error) while in-flight
+requests finish; ``kill()`` drops the socket dead — the transport
+failure the router's failover path exists for.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Any
+
+from tf_operator_tpu.serve.httpapi import QuietHandler, readiness_payload
+from tf_operator_tpu.serve.resilience import (
+    Draining,
+    error_payload,
+    http_status_of,
+)
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="fleet-replica")
+
+
+class SupervisorBackend:
+    """A real supervised continuous engine behind the replica surface.
+
+    ``handle`` maps one /generate body through
+    ``EngineSupervisor.submit_request`` with serve_lm's response shape:
+    200 + generated tokens (``deadline_exceeded``/``timeout_cause``
+    flags when the deadline or drain cut rows short), typed
+    ServeError -> its ``http_status`` + payload.
+    """
+
+    def __init__(self, supervisor: Any, *,
+                 request_timeout_s: float = 120.0) -> None:
+        self.supervisor = supervisor
+        self.request_timeout_s = request_timeout_s
+
+    # Load picture proxied for readiness_payload. max_slots included:
+    # without it the probe payload omits capacity and membership
+    # normalizes this replica's load by 1 — raw backlog instead of
+    # occupancy, which skews the least-loaded pick on mixed-capacity
+    # fleets.
+    @property
+    def max_slots(self) -> int:
+        return self.supervisor.max_slots
+
+    @property
+    def active_slots(self) -> int:
+        return self.supervisor.active_slots
+
+    @property
+    def queue_depth(self) -> int:
+        return self.supervisor.queue_depth
+
+    @property
+    def requests_done(self) -> int:
+        return self.supervisor.requests_done
+
+    @property
+    def tokens_generated(self) -> int:
+        return self.supervisor.tokens_generated
+
+    @property
+    def restarts(self) -> int:
+        return self.supervisor.restarts
+
+    @property
+    def dead(self) -> bool:
+        return self.supervisor.dead
+
+    def debug_snapshot(self) -> dict[str, Any]:
+        return self.supervisor.debug_snapshot()
+
+    def handle(self, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
+        import numpy as np
+
+        from tf_operator_tpu.serve.scheduler import ServeRequest
+
+        try:
+            tokens = np.asarray(body["tokens"], np.int32)
+            if tokens.ndim != 2:
+                raise ValueError("tokens must be [batch, len]")
+            req = ServeRequest(
+                tokens[:1], int(body.get("num_steps", 8)),
+                temperature=float(body.get("temperature", 0.0)),
+                top_p=body.get("top_p"),
+                seed=int(body.get("seed", 0)),
+                deadline_s=body.get("deadline_s"),
+            )
+        except (KeyError, ValueError, TypeError) as exc:
+            return 400, {"error": str(exc), "code": "bad_request",
+                         "retryable": False, "detail": str(exc)}
+        try:
+            req = self.supervisor.submit_request(
+                req, timeout=self.request_timeout_s
+            )
+        except Exception as exc:  # noqa: BLE001 — every failure leaves
+            # typed (ServeError renders itself; the rest become 500s).
+            return http_status_of(exc), error_payload(exc)
+        payload: dict[str, Any] = {"tokens": [list(req.out)]}
+        if req.deadline_exceeded:
+            payload["deadline_exceeded"] = [True]
+            payload["timeout_cause"] = [req.timeout_cause]
+        if req.degraded:
+            payload["degraded"] = [True]
+        return 200, payload
+
+
+class FakeReplicaBackend:
+    """A jax-free replica brain for the fast fleet test tier.
+
+    Serves canned generations (``num_steps`` zeros) after
+    ``service_delay_s``; everything the routing/retry/autoscale layers
+    read is directly settable (``queue_depth``, ``ttft_p99_s``,
+    ``dead``), and ``fail_with(exc, n)`` scripts the next n /generate
+    calls to resolve as that typed error — so a test drives the exact
+    taxonomy the router keys on without an engine in sight.
+    """
+
+    def __init__(self, *, max_slots: int = 8,
+                 service_delay_s: float = 0.0) -> None:
+        self.max_slots = max_slots
+        self.service_delay_s = service_delay_s
+        self.queue_depth = 0
+        self.requests_done = 0
+        self.tokens_generated = 0
+        self.restarts = 0
+        self.dead = False
+        self.ttft_p99_s: float | None = None
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._scripted: list[Exception] = []
+
+    @property
+    def active_slots(self) -> int:
+        return min(self._inflight, self.max_slots)
+
+    def fail_with(self, exc: Exception, n: int = 1) -> None:
+        with self._lock:
+            self._scripted.extend(exc for _ in range(n))
+
+    def handle(self, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
+        with self._lock:
+            self._inflight += 1
+            scripted = self._scripted.pop(0) if self._scripted else None
+        try:
+            if scripted is not None:
+                return http_status_of(scripted), error_payload(scripted)
+            if self.service_delay_s:
+                import time
+
+                time.sleep(self.service_delay_s)
+            steps = int(body.get("num_steps", 8))
+            rows = body.get("tokens") or [[0]]
+            out = [[0] * steps for _ in rows[:1]]
+            with self._lock:
+                self.requests_done += 1
+                self.tokens_generated += steps
+            return 200, {"tokens": out}
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+
+class ReplicaServer:
+    """One replica endpoint: /healthz + /generate + /metrics over a
+    backend, with the fleet lifecycle hooks (drain, kill)."""
+
+    def __init__(self, backend: Any, *, replica_id: str,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.backend = backend
+        self.replica_id = replica_id
+        self._draining = False
+        outer = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    payload = readiness_payload(
+                        outer.backend, draining=outer._draining,
+                        replica=outer.replica_id,
+                        max_slots=getattr(outer.backend, "max_slots",
+                                          None),
+                    )
+                    # Scriptable latency for the autoscaler tier: a
+                    # FakeReplicaBackend pins its own p99 instead of the
+                    # process-global histogram shared by every
+                    # in-process replica.
+                    ttft = getattr(outer.backend, "ttft_p99_s", None)
+                    if ttft is not None:
+                        payload["ttft_p99_s"] = float(ttft)
+                    self.send_json(200, payload)
+                elif path == "/debug/serve" and hasattr(
+                    outer.backend, "debug_snapshot"
+                ):
+                    self.send_json(200, outer.backend.debug_snapshot())
+                elif path == "/metrics":
+                    self.send_metrics()
+                else:
+                    self.send_json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/generate":
+                    self.send_json(404, {"error": "unknown path"})
+                    return
+                try:
+                    body = self.read_json_body()
+                except ValueError:
+                    self.send_json(400, {"error": "bad JSON",
+                                         "code": "bad_request",
+                                         "retryable": False,
+                                         "replica": outer.replica_id})
+                    return
+                if outer._draining:
+                    exc = Draining("replica draining (scale-down or "
+                                   "rolling update)")
+                    payload = error_payload(exc)
+                    payload["replica"] = outer.replica_id
+                    self.send_json(exc.http_status, payload)
+                    return
+                status, payload = outer.backend.handle(body)
+                # Attribute every answer, success or typed error —
+                # several replicas share this process, so the
+                # process-global resilience channel cannot.
+                payload = dict(payload)
+                payload["replica"] = outer.replica_id
+                self.send_json(status, payload)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def start(self) -> "ReplicaServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"replica-{self.replica_id}",
+        )
+        self._thread.start()
+        LOG.info(f"replica {self.replica_id} listening on {self.endpoint}")
+        return self
+
+    def begin_drain(self) -> None:
+        """Readiness withdrawal: /healthz reports ``draining: true`` and
+        new /generate calls get the typed ``draining`` refusal while
+        in-flight requests finish — the serve_lm SIGTERM shape."""
+        self._draining = True
+
+    def kill(self) -> None:
+        """Drop dead mid-flight: close the socket without a drain. The
+        router sees transport failures and fails over; the membership
+        fail threshold declares the replica DEAD."""
+        self._server.shutdown()
+        self._server.server_close()
+
+    def stop(self) -> None:
+        self.kill()
+
+
+def fleet_of(n: int, backend_factory, *, id_prefix: str = "rep",
+             register_in: Any = None) -> list[ReplicaServer]:
+    """Spin up n started replicas (backend_factory(i) -> backend); when
+    ``register_in`` (a FleetMembership) is given, each is registered
+    under its replica id — the two-liner every fleet test starts with."""
+    servers = [
+        ReplicaServer(backend_factory(i),
+                      replica_id=f"{id_prefix}{i}").start()
+        for i in range(n)
+    ]
+    if register_in is not None:
+        for s in servers:
+            register_in.register(s.replica_id, s.endpoint)
+    return servers

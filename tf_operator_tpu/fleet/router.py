@@ -1,0 +1,331 @@
+"""The fleet router: one HTTP front over N serve replicas.
+
+Routing policy (deliberately boring — the interesting part is what it
+reads): pick the ROUTABLE replica with the lowest load score
+(probed active slots + probed queue depth + router-local in-flight,
+normalized by slot capacity; membership.py), deterministic
+lowest-id tie-break. Occupancy and queue depth are exactly the
+``tpu_serve_*`` numbers each replica already exports — the router adds
+no new instrumentation to the data plane, it just reads the existing
+one.
+
+Failure handling is built on PR 7's typed error taxonomy — that is what
+``{code, retryable, retry_after_s}`` exists for:
+
+- ``retryable: true`` codes that mean "this replica, not this request"
+  (draining / engine_crashed / replica_dead / queue_full / timeout /
+  queue_ttl_expired) are retried on a DIFFERENT replica, bounded by
+  ``RouterConfig.retries``. ``draining`` marks the replica DRAINING and
+  ``replica_dead`` marks it DEAD in the membership table as a side
+  effect, so one typed answer deregisters the backend for everyone.
+- transport failures (connection refused/reset — the replica vanished
+  mid-request) count toward the membership fail threshold and fail over
+  the same way.
+- non-retryable errors (bad_request, internal) return to the client
+  unchanged: retrying a request the replica REJECTED would just burn
+  another replica's time.
+
+Every response (success or error) carries ``replica`` (the id that
+answered — typed replica-side payloads already self-report it via
+serve/resilience.py) and errors carry ``attempts`` so clients and logs
+can attribute without reverse-mapping ports.
+
+The transport is injected (``send_fn(replica, body, timeout) ->
+(status, payload)``) so the jax-free test tier and the in-process bench
+drive the same routing code the HTTP front uses; ``RouterServer`` at the
+bottom is the stdlib HTTP wrapper with ``http_send``/``http_probe`` as
+the real transport.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+from tf_operator_tpu.runtime.metrics import (
+    FLEET_ROUTER_FAILOVERS,
+    FLEET_ROUTER_REQUESTS,
+    FLEET_ROUTER_RETRIES,
+)
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="fleet-router")
+
+# Typed codes that indict the REPLICA, not the request: retry elsewhere.
+RETRY_ELSEWHERE = frozenset((
+    "draining", "engine_crashed", "replica_dead", "queue_full",
+    "queue_ttl_expired", "timeout",
+))
+
+
+@dataclass
+class RouterConfig:
+    # Additional attempts on OTHER replicas after the first (total sends
+    # per request <= retries + 1).
+    retries: int = 2
+    # Per-send transport timeout handed to send_fn.
+    request_timeout_s: float = 300.0
+    probe_interval_s: float = 1.0
+    probe_timeout_s: float = 2.0
+
+
+class FleetRouter:
+    def __init__(self, membership: FleetMembership,
+                 send_fn: Callable[[Replica, dict, float], tuple[int, dict]],
+                 config: RouterConfig | None = None) -> None:
+        self.membership = membership
+        self._send = send_fn
+        self.cfg = config or RouterConfig()
+        self._lock = threading.Lock()
+        self.requests = 0
+        self.retries = 0
+        self.failovers = 0
+
+    # -- picking -----------------------------------------------------------
+
+    def pick(self, exclude: frozenset[str] = frozenset()) -> Replica | None:
+        candidates = [
+            r for r in self.membership.routable() if r.id not in exclude
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: (r.load, r.id))
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, body: dict,
+              timeout: float | None = None) -> tuple[int, dict]:
+        """Route one /generate body; returns (http_status, payload).
+        Never raises for replica-side conditions — everything comes back
+        typed, including "no routable replicas" (503, retryable: the
+        controller may be replacing a replica right now)."""
+        timeout = timeout or self.cfg.request_timeout_s
+        with self._lock:
+            self.requests += 1
+        exclude: set[str] = set()
+        attempts = 0
+        last: tuple[int, dict] | None = None
+        # (code, replica id) of a retryable answer awaiting a retry —
+        # counted only once another replica is actually picked, so
+        # tpu_fleet_router_retries_total means what it says ("on a
+        # DIFFERENT replica") even in a single-replica fleet.
+        pending_retry: tuple[str, str] | None = None
+        while attempts <= self.cfg.retries:
+            rep = self.pick(frozenset(exclude))
+            if rep is None:
+                break
+            if pending_retry is not None:
+                code, prev_id = pending_retry
+                pending_retry = None
+                with self._lock:
+                    self.retries += 1
+                FLEET_ROUTER_RETRIES.inc(code=code or "unknown")
+                LOG.info(
+                    f"retrying elsewhere after {code} from {prev_id} "
+                    f"(attempt {attempts + 1})"
+                )
+            attempts += 1
+            self.membership.begin(rep.id)
+            try:
+                status, payload = self._send(rep, body, timeout)
+            except Exception as exc:  # noqa: BLE001 — transport failure:
+                # the replica did not answer at all; it may be mid-death.
+                self.membership.probe_failed(rep.id)
+                with self._lock:
+                    self.failovers += 1
+                FLEET_ROUTER_FAILOVERS.inc()
+                LOG.warning(
+                    f"replica {rep.id} unreachable ({exc!r}); failing over"
+                )
+                exclude.add(rep.id)
+                last = (503, {
+                    "error": f"replica unreachable: {exc!r}",
+                    "code": "replica_unreachable", "retryable": True,
+                    "replica": rep.id,
+                })
+                continue
+            finally:
+                self.membership.end(rep.id)
+            payload = dict(payload)
+            payload.setdefault("replica", rep.id)
+            if status < 400:
+                FLEET_ROUTER_REQUESTS.inc(outcome="ok")
+                return status, payload
+            code = payload.get("code", "")
+            # Membership side effects come FIRST: even when the retry
+            # budget is spent, a typed draining/dead answer must still
+            # deregister the backend.
+            if code == "replica_dead":
+                self.membership.mark_dead(rep.id)
+            elif code == "draining":
+                self.membership.mark_draining(rep.id)
+            if not (payload.get("retryable") and code in RETRY_ELSEWHERE):
+                FLEET_ROUTER_REQUESTS.inc(outcome="typed")
+                return status, payload
+            last = (status, payload)
+            exclude.add(rep.id)
+            pending_retry = (code, rep.id)
+        if last is not None:
+            status, payload = last
+            payload["attempts"] = attempts
+            FLEET_ROUTER_REQUESTS.inc(
+                outcome="transport"
+                if payload.get("code") == "replica_unreachable" else "typed"
+            )
+            return status, payload
+        FLEET_ROUTER_REQUESTS.inc(outcome="no_replica")
+        # Demand with nowhere to go — the scale-from-zero signal the
+        # autoscaler reads via membership.take_unrouted().
+        self.membership.note_unrouted()
+        return 503, {
+            "error": "no routable replicas",
+            "code": "no_replica", "retryable": True, "retry_after_s": 1.0,
+            "attempts": attempts,
+        }
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "retries": self.retries,
+                "failovers": self.failovers,
+                "retry_budget": self.cfg.retries,
+            }
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport + front
+# ---------------------------------------------------------------------------
+
+
+def http_send(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
+    """POST the body to the replica's /generate; typed error bodies come
+    back as (status, payload) rather than raising — only transport-level
+    failures raise (and trigger failover)."""
+    req = urllib.request.Request(
+        f"http://{rep.endpoint}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {"error": str(e), "code": "internal",
+                       "retryable": False}
+        return e.code, payload
+
+
+def http_probe(endpoint: str, timeout: float = 2.0) -> dict:
+    with urllib.request.urlopen(
+        f"http://{endpoint}/healthz", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+class RouterServer:
+    """The stdlib HTTP front: /generate forwarded through the router,
+    /healthz the fleet aggregate (ok while anything is routable),
+    /debug/fleet the membership+router snapshot, /metrics the registry.
+    A background prober keeps membership fresh."""
+
+    def __init__(self, membership: FleetMembership, *,
+                 router: FleetRouter | None = None,
+                 config: RouterConfig | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 probe_fn: Callable[[str], dict] | None = None,
+                 extra_debug: Callable[[], dict] | None = None) -> None:
+        from http.server import ThreadingHTTPServer
+
+        from tf_operator_tpu.serve.httpapi import QuietHandler
+
+        self.membership = membership
+        cfg = config or RouterConfig()
+        self.router = router or FleetRouter(membership, http_send, cfg)
+        self.cfg = cfg
+        self._probe_fn = probe_fn or (
+            lambda ep: http_probe(ep, cfg.probe_timeout_s)
+        )
+        self._extra_debug = extra_debug
+        self._stop = threading.Event()
+        outer = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    counts = outer.membership.counts()
+                    self.send_json(200, {
+                        "ok": counts["ready"] > 0,
+                        "router": True,
+                        "replicas": counts,
+                    })
+                elif path == "/debug/fleet":
+                    self.send_json(200, outer.debug_snapshot())
+                elif path == "/metrics":
+                    self.send_metrics()
+                else:
+                    self.send_json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/generate":
+                    self.send_json(404, {"error": "unknown path"})
+                    return
+                try:
+                    body = self.read_json_body()
+                except ValueError:
+                    self.send_json(400, {"error": "bad JSON",
+                                         "code": "bad_request",
+                                         "retryable": False})
+                    return
+                status, payload = outer.router.route(body)
+                self.send_json(status, payload)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._threads: list[threading.Thread] = []
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def debug_snapshot(self) -> dict:
+        snap = {
+            "membership": self.membership.snapshot(),
+            "router": self.router.snapshot(),
+        }
+        if self._extra_debug is not None:
+            snap.update(self._extra_debug())
+        return snap
+
+    def start(self) -> "RouterServer":
+        serve = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="fleet-router",
+        )
+        serve.start()
+        probe = threading.Thread(
+            target=self._probe_loop, daemon=True, name="fleet-prober"
+        )
+        probe.start()
+        self._threads = [serve, probe]
+        LOG.info(f"router listening on {self.endpoint}")
+        return self
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            self.membership.probe(self._probe_fn)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()

@@ -116,6 +116,10 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # The qblock stage now runs at the FRONT of window_autorun's unmeasured
 # set (its old slot sat behind the 3600s bench_full and was never reached
 # in r05), so the next UP window produces this arbitration data first.
+# Re-checked (PR 9, 2026-08-03): window_r05 still carries only the
+# single-shot flashblocks line (bq256 9.0 / bq512 11.0 / bq1024 14.0) —
+# no probe_qblock arbitration output has landed, so the trigger stays
+# OPEN and the cap stays 1024 on the strength of the single-shot data.
 MAX_Q_BLOCK = 1024
 
 

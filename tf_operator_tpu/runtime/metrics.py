@@ -501,3 +501,41 @@ SERVE_OCCUPANCY = REGISTRY.histogram(
     "the quantity decode throughput is proportional to",
     buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
 )
+
+# -- fleet serving (tf_operator_tpu/fleet/): TPUServe membership, the
+# occupancy-aware router, and queue-depth autoscaling -----------------------
+
+FLEET_REPLICAS = REGISTRY.gauge(
+    "tpu_fleet_replicas",
+    "Serve replicas by membership state (joining/ready/draining/"
+    "cordoned/dead), per fleet — the gauges are process-global and one "
+    "operator reconciles many fleets", ("fleet", "state"),
+)
+FLEET_ROUTER_REQUESTS = REGISTRY.counter(
+    "tpu_fleet_router_requests_total",
+    "Routed /generate requests by terminal outcome (ok: a replica "
+    "answered 200; typed: a typed error survived the retry budget; "
+    "no_replica: nothing routable; transport: unreachable past budget)",
+    ("outcome",),
+)
+FLEET_ROUTER_RETRIES = REGISTRY.counter(
+    "tpu_fleet_router_retries_total",
+    "Retries on a DIFFERENT replica after a typed retryable error, by "
+    "the error code that triggered them (PR 7's taxonomy is the router "
+    "contract; the replica label in the payload attributes the failure)",
+    ("code",),
+)
+FLEET_ROUTER_FAILOVERS = REGISTRY.counter(
+    "tpu_fleet_router_failovers_total",
+    "Transport-level failovers: the replica did not answer at all and "
+    "the request moved to another one",
+)
+FLEET_AUTOSCALE_TOTAL = REGISTRY.counter(
+    "tpu_fleet_autoscale_total",
+    "Autoscaler target changes by direction (up/down)", ("direction",),
+)
+FLEET_QUEUE_DEPTH = REGISTRY.gauge(
+    "tpu_fleet_queue_depth",
+    "Aggregate queued requests across routable replicas, per fleet, as "
+    "of the last membership probe sweep", ("fleet",),
+)

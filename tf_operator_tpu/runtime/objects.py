@@ -17,6 +17,10 @@ from typing import Any
 PODS = "pods"
 SERVICES = "services"
 TPUJOBS = "tpujobs"
+# Long-running serving fleets (tf_operator_tpu/fleet/): stored like any
+# other CRD in the group — both backends treat unknown collections
+# generically, so no store/stub changes ride this kind.
+TPUSERVES = "tpuserves"
 PDBS = "poddisruptionbudgets"
 EVENTS = "events"
 LEASES = "leases"

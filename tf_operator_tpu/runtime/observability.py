@@ -58,7 +58,8 @@ def mount_observability(api_server: Any, registry: Registry = REGISTRY,
                         tracer: Tracer = TRACER,
                         scheduler: Any | None = None,
                         health: Any | None = None,
-                        ckpt: Any | None = None) -> ObservabilityHandler:
+                        ckpt: Any | None = None,
+                        fleet: Any | None = None) -> ObservabilityHandler:
     handler = ObservabilityHandler(registry, tracer, scheduler)
     api_server.add_handler(handler)
     if health is not None:
@@ -73,10 +74,17 @@ def mount_observability(api_server: Any, registry: Registry = REGISTRY,
         from tf_operator_tpu.ckpt.httpapi import mount_ckpt
 
         mount_ckpt(api_server, ckpt)
+    if fleet is not None:
+        # /debug/fleet: the TPUServe controller's per-fleet membership/
+        # target/autoscale snapshot, same pattern.
+        from tf_operator_tpu.fleet.httpapi import mount_fleet
+
+        mount_fleet(api_server, fleet)
     LOG.info(
-        "observability mounted at /metrics and /debug/traces%s%s%s",
+        "observability mounted at /metrics and /debug/traces%s%s%s%s",
         " and /debug/scheduler" if scheduler is not None else "",
         " and /debug/health" if health is not None else "",
         " and /debug/ckpt" if ckpt is not None else "",
+        " and /debug/fleet" if fleet is not None else "",
     )
     return handler

@@ -62,6 +62,7 @@ from tf_operator_tpu.runtime.metrics import (
 from tf_operator_tpu.scheduler.gang import (
     ANNOTATION_ADMITTED_AT,
     ANNOTATION_CHIPS,
+    ANNOTATION_DRAINING_AT,
     ANNOTATION_ENQUEUED_AT,
     ANNOTATION_MIGRATED_AT,
     ANNOTATION_PLACEMENTS,
@@ -240,6 +241,14 @@ class GangScheduler:
                 gang = None
             if gang is None:
                 gang = self._register(job, has_pods)
+            # Serve replicas mid-drain (fleet/controller.py stamped the
+            # draining annotation) are preemption-exempt: the drain IS
+            # the eviction, already in flight — re-read every sync so
+            # the exemption appears when the drain begins and never
+            # outlives the job object that carried it.
+            gang.no_preempt = ANNOTATION_DRAINING_AT in (
+                job.metadata.annotations or {}
+            )
             if gang.state == STATE_ADMITTED and self._on_cordoned_cells(gang):
                 # Fleet health cordoned cells under this gang (possibly in a
                 # previous controller incarnation — the cordon outlives us

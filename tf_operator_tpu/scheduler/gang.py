@@ -47,6 +47,14 @@ ANNOTATION_CHIPS = "scheduler.tpuflow.org/chips"
 # when the fleet-health layer evicts a gang off draining/cordoned cells;
 # the controller keys the JobMigrating condition on it (health/monitor.py).
 ANNOTATION_MIGRATED_AT = "health.tpuflow.org/migrated-at"
+# Stamped by the fleet-serving controller (fleet/controller.py) on a
+# serve replica's child job when its bounded SIGTERM drain begins
+# (scale-down / rolling update). A draining gang is mid-handoff — the
+# router has deregistered it and admitted requests are finishing — so
+# preemption must not evict it: the drain IS the eviction, already in
+# flight, and a preemption on top would turn "zero dropped requests"
+# into dropped requests. reconcile_gang re-reads it every sync.
+ANNOTATION_DRAINING_AT = "fleet.tpuflow.org/draining-at"
 
 STATE_QUEUED = "queued"
 STATE_ADMITTED = "admitted"
@@ -125,6 +133,10 @@ class Gang:
     evict_deadline: float | None = None
     evict_signaled_at: float | None = None
     evict_credit: float = 0.0
+    # True while the job carries ANNOTATION_DRAINING_AT (a serve replica
+    # mid-drain): excluded from preemption victim selection — see the
+    # annotation's comment. Refreshed from the job every reconcile_gang.
+    no_preempt: bool = False
     # Filled at admission: one placement per SliceRequest (see placement.py).
     placements: list[Any] = field(default_factory=list)
 
@@ -173,6 +185,7 @@ def gang_from_job(
         priority=resolve_priority(pclass, priority_table),
         pod_count=pod_count,
         slices=slice_reqs,
+        no_preempt=ANNOTATION_DRAINING_AT in (job.metadata.annotations or {}),
     )
 
 

@@ -63,7 +63,14 @@ def select_victims(
     never be reached by pointlessly evicting someone)."""
     if _feasible_with(pending, [], placer, ledger):
         return None
-    candidates = [g for g in admitted if g.priority < pending.priority]
+    # no_preempt gangs (serve replicas mid-drain, gang.py) are not
+    # candidates at any priority: their chips are already being
+    # released via the bounded drain, and evicting them on top would
+    # drop the admitted requests the drain exists to finish.
+    candidates = [
+        g for g in admitted
+        if g.priority < pending.priority and not g.no_preempt
+    ]
     if not candidates:
         return None
     # Lowest priority first; youngest (latest admission) first within it.

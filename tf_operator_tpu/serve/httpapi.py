@@ -24,11 +24,131 @@ budget, and the armed fault-injection points.
 from __future__ import annotations
 
 import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
 from typing import Any
 
 from tf_operator_tpu.utils import logger
 
 LOG = logger.with_fields(component="serve-api")
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Shared stdlib-handler base for the serving HTTP fronts (replica
+    server, fleet router): suppressed request logging plus the one JSON /
+    metrics response shape — the Retry-After rule and the Prometheus
+    content type must not drift between surfaces."""
+
+    def log_message(self, *args: Any) -> None:  # quiet
+        pass
+
+    def send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if payload.get("retry_after_s") is not None:
+            self.send_header("Retry-After", str(
+                max(1, int(round(payload["retry_after_s"])))
+            ))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def send_metrics(self) -> None:
+        from tf_operator_tpu.runtime.metrics import REGISTRY
+
+        body = REGISTRY.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def read_json_body(self) -> dict:
+        """Parse the POST body; raises ValueError on bad JSON."""
+        raw = self.rfile.read(
+            int(self.headers["Content-Length"] or 0)
+        ) or b"{}"
+        return json.loads(raw)
+
+# /healthz TTFT window: the metrics registry is process-global, so a
+# lifetime quantile would latch a cold-start compile burst into the
+# reported p99 ~forever — and the fleet autoscaler's latency trigger
+# (which requires `not ttft_high` before scaling down) would pin the
+# fleet at max. Rotating two snapshots bounds the read to roughly the
+# last 1-2 windows.
+_TTFT_WINDOW_S = 120.0
+_ttft_lock = threading.Lock()
+_ttft_prev: list[int] | None = None  # baseline: start of previous window
+_ttft_cur: tuple[list[int], float] | None = None
+
+
+def windowed_ttft_p99() -> float:
+    """p99 TTFT over the trailing 1-2 windows (not process lifetime).
+
+    Clamped to the histogram's top bucket bound: when the p99 lands in
+    the +Inf overflow bucket the true value is unknown but AT LEAST the
+    top bound — reporting that keeps the autoscaler's latency trigger
+    live during the worst episodes instead of going silent (a dropped
+    reading leaves membership holding a stale pre-overload p99, which
+    can even permit scale-down mid-incident)."""
+    from tf_operator_tpu.runtime.metrics import SERVE_TTFT_SECONDS
+
+    global _ttft_prev, _ttft_cur
+    now = time.monotonic()
+    with _ttft_lock:
+        if _ttft_cur is None or now - _ttft_cur[1] >= _TTFT_WINDOW_S:
+            _ttft_prev = _ttft_cur[0] if _ttft_cur else None
+            _ttft_cur = (SERVE_TTFT_SECONDS.snapshot(), now)
+        since = _ttft_prev
+    p99 = SERVE_TTFT_SECONDS.quantile(0.99, since=since)
+    return min(p99, SERVE_TTFT_SECONDS.buckets[-1])
+
+
+def readiness_payload(sched: Any, *, draining: bool = False,
+                      replica: str = "",
+                      max_slots: int | None = None) -> dict[str, Any]:
+    """The /healthz shape fleet/membership.py routes from — liveness and
+    readiness split explicitly:
+
+    - ``ok`` is LIVENESS: the process answers and its engine is not
+      declared dead. It stays true through a drain.
+    - ``draining: true`` is the readiness withdrawal: the SIGTERM
+      bounded drain is in flight — admitted requests are finishing, new
+      ones must go elsewhere. A router deregisters on this flag BEFORE
+      the drain completes instead of eating drain-window 503s.
+    - ``dead: true`` (ok false): the restart budget is spent; the
+      replica wants replacing, not retrying.
+
+    ``sched`` is an EngineSupervisor / ContinuousScheduler-shaped object
+    (duck-typed: active_slots, queue_depth, requests_done,
+    tokens_generated, restarts, dead) or None; occupancy/queue numbers
+    plus TTFT p99 ride along for the router's least-loaded pick and the
+    autoscaler's triggers. serve_lm and fleet/replica.py both emit this
+    one shape.
+    """
+    payload: dict[str, Any] = {"ok": True}
+    if replica:
+        payload["replica"] = replica
+    if draining:
+        payload["draining"] = True
+    if sched is None:
+        return payload
+    payload["active_slots"] = sched.active_slots
+    payload["queue_depth"] = sched.queue_depth
+    if max_slots is not None:
+        payload["max_slots"] = max_slots
+    payload["requests_done"] = sched.requests_done
+    payload["tokens_generated"] = sched.tokens_generated
+    payload["watchdog_restarts"] = getattr(sched, "restarts", 0)
+    ttft_p99 = windowed_ttft_p99()
+    if ttft_p99:
+        payload["ttft_p99_s"] = round(ttft_p99, 4)
+    if getattr(sched, "dead", False):
+        payload["ok"] = False
+        payload["dead"] = True
+    return payload
 
 
 class ServeDebugHandler:

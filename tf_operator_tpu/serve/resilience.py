@@ -76,6 +76,23 @@ LOG = logger.with_fields(component="serve-resilience")
 # Typed errors
 # ---------------------------------------------------------------------------
 
+# Replica identity for error attribution (fleet routing, PR 9): serve_lm
+# threads --replica-id / $TPU_SERVE_REPLICA_ID here once at startup, and
+# every typed payload then self-reports which replica produced it — the
+# router's retry logs and tpu_fleet_* metrics attribute failures without
+# reverse-mapping ports. Process-wide on purpose: one serve process IS
+# one replica.
+_REPLICA_ID = ""
+
+
+def set_replica_id(rid: str) -> None:
+    global _REPLICA_ID
+    _REPLICA_ID = rid or ""
+
+
+def replica_id() -> str:
+    return _REPLICA_ID
+
 
 class ServeError(RuntimeError):
     """Base of every typed serving failure: ``code`` names the failure
@@ -102,6 +119,8 @@ class ServeError(RuntimeError):
         }
         if self.retry_after_s is not None:
             out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        if _REPLICA_ID:
+            out["replica"] = _REPLICA_ID
         return out
 
 
@@ -164,8 +183,11 @@ def error_payload(exc: Exception) -> dict:
     unstructured 500."""
     if isinstance(exc, ServeError):
         return exc.payload()
-    return {"error": repr(exc), "code": "internal", "retryable": False,
-            "detail": repr(exc)}
+    out = {"error": repr(exc), "code": "internal", "retryable": False,
+           "detail": repr(exc)}
+    if _REPLICA_ID:
+        out["replica"] = _REPLICA_ID
+    return out
 
 
 def http_status_of(exc: Exception) -> int:
@@ -257,6 +279,7 @@ class EngineSupervisor:
         self._shed_prev = 0
         self._deadline_prev = 0
         self._qhw_max = 0
+        self._max_slots = 0                # last live engine's capacity
         self._sched: Any = None
         self._build(replay=())
         self._watchdog: threading.Thread | None = None
@@ -492,6 +515,16 @@ class EngineSupervisor:
     def queue_depth(self) -> int:
         sched = self.scheduler
         return sched.queue_depth if sched is not None else 0
+
+    @property
+    def max_slots(self) -> int:
+        """Slot capacity, held steady through rebuild windows (capacity
+        is a config fact, not a generation fact) — the fleet readiness
+        payload normalizes load by it."""
+        sched = self.scheduler
+        if sched is not None:
+            self._max_slots = sched.engine.max_slots
+        return self._max_slots
 
     @property
     def requests_done(self) -> int:

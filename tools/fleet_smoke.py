@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Fast fleet-serving smoke: runs the `fleet`-marked tests in isolation
+(the jax-free membership/router/autoscale decision tier plus the
+controller kill/cordon/drain/rolling chaos on both cluster backends) —
+the ~20s loop for iterating on tf_operator_tpu/fleet/ without paying
+for the whole tier-1 run.
+
+    python tools/fleet_smoke.py            # the smoke subset
+    python tools/fleet_smoke.py --bench    # + the serve_bench fleet e2e
+                                           # (real engines, ~2 min)
+    python tools/fleet_smoke.py -k router  # extra pytest args pass through
+
+Exit code is pytest's. CI wires this as the pre-merge gate for fleet
+changes; the same tests also run (unmarked-slow, so by default) inside
+the tier-1 command in ROADMAP.md.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    marker = "fleet"
+    if "--bench" in args:
+        args.remove("--bench")
+    else:
+        marker = "fleet and not slow"
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_fleet.py", "tests/test_fleet_chaos.py",
+        "-m", marker,
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    return subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -415,6 +415,138 @@ def run_chaos_leg(cfg, params, schedule, args) -> dict:
     return line
 
 
+def run_fleet_leg(cfg, params, schedule, args) -> dict:
+    """The fleet e2e: the open-loop schedule through the fleet ROUTER
+    over ``--fleet-replicas`` supervised continuous engines (each behind
+    its own in-process HTTP replica, fleet/replica.py), with one replica
+    KILLED mid-run. Zero lost requests (ok + partial + typed == total)
+    and deadline-bounded TTFT are the assertions — the router's
+    transport failover and typed-retry policy are what absorb the kill;
+    tokens/sec through the router is the informational value."""
+    from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+    from tf_operator_tpu.fleet.replica import (
+        ReplicaServer,
+        SupervisorBackend,
+    )
+    from tf_operator_tpu.fleet.router import (
+        RouterConfig,
+        RouterServer,
+        http_probe,
+        http_send,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    n = args.fleet_replicas
+    res = ResilienceConfig(
+        queue_ttl_s=30.0, decode_deadline_s=60.0, watchdog_stall_s=5.0,
+        max_restarts=3, restart_backoff_s=0.1,
+        queue_limit=max(64, 4 * len(schedule)),
+    )
+
+    def mk_replica(i: int) -> tuple[EngineSupervisor, ReplicaServer]:
+        sup = EngineSupervisor(
+            lambda: ContinuousEngine(
+                cfg, params, max_slots=args.max_batch,
+                prefill_chunk=args.prefill_chunk or None,
+            ),
+            resilience=res,
+            prefill_tokens_per_step=args.prefill_budget,
+        )
+        server = ReplicaServer(
+            SupervisorBackend(sup, request_timeout_s=90.0),
+            replica_id=f"bench-r{i}",
+        ).start()
+        return sup, server
+
+    replicas = [mk_replica(i) for i in range(n)]
+    ms = FleetMembership(fail_threshold=2)
+    for _, server in replicas:
+        ms.register(server.replica_id, server.endpoint)
+    router = RouterServer(
+        ms, config=RouterConfig(retries=2, request_timeout_s=90.0,
+                                probe_interval_s=0.1),
+    ).start()
+    ms.probe(http_probe)  # promote everyone before the first arrival
+
+    outcomes: list = []
+    outcomes_lock = threading.Lock()
+
+    # The router's own transport (typed-error bodies come back as
+    # (status, payload), only transport failures raise) pointed AT the
+    # router — one wire-contract implementation, not a bench copy.
+    router_as_backend = Replica(id="router", endpoint=router.endpoint)
+
+    def submit(prompt, steps):
+        try:
+            status, payload = http_send(
+                router_as_backend,
+                {"tokens": prompt.tolist(), "num_steps": steps},
+                90.0,
+            )
+        except Exception:  # noqa: BLE001 — transport to the ROUTER
+            # itself failed: untyped, counted against the leg.
+            with outcomes_lock:
+                outcomes.append((None, {}))
+            raise
+        with outcomes_lock:
+            outcomes.append((status, payload))
+        if status == 200 and payload.get("tokens"):
+            return payload["tokens"][0], None
+        raise RuntimeError(f"{status}:{payload.get('code', 'untyped')}")
+
+    run_schedule(schedule, submit)  # untimed warmup, whole fleet alive
+    outcomes.clear()
+
+    # Kill one replica as the mid-run arrivals land: its in-flight
+    # requests die with the socket and MUST resolve via router failover.
+    kill_at = schedule[len(schedule) // 2][0]
+    victim_sup, victim_server = replicas[0]
+    killer = threading.Timer(max(0.05, kill_at), victim_server.kill)
+    killer.start()
+    wall_s, results = run_schedule(schedule, submit)
+    killer.cancel()  # no-op when it fired; cleanup when it never did
+
+    ok = sum(1 for s, p in outcomes
+             if s == 200 and not p.get("deadline_exceeded"))
+    partial = sum(1 for s, p in outcomes
+                  if s == 200 and p.get("deadline_exceeded"))
+    typed = sum(1 for s, p in outcomes
+                if s is not None and s >= 400 and p.get("code"))
+    untyped = sum(1 for s, p in outcomes
+                  if s is None or (s >= 400 and not p.get("code")))
+    lost = len(schedule) - len(outcomes)
+    rsnap = router.router.snapshot()
+    stats = {
+        "resolved": len(outcomes),
+        "lost": lost,
+        "ok": ok,
+        "deadline_partials": partial,
+        "typed_errors": typed,
+        "untyped_errors": untyped,
+        "replicas": n,
+        "killed_replicas": 1,
+        "router_retries": rsnap["retries"],
+        "router_failovers": rsnap["failovers"],
+        "membership": ms.counts(),
+        "deadline_budget_ms": round(res.decode_deadline_s * 1e3, 1),
+        "max_batch": args.max_batch,
+    }
+    router.stop()
+    for sup, server in replicas:
+        if server is not victim_server:
+            server.stop()
+        sup.stop(timeout=30.0)
+    line = leg_summary("fleet", wall_s, results, stats)
+    # Typed resolutions are the contract, not bench failures — the exit
+    # code keys off lost/untyped, as in the chaos leg.
+    line["errors"] = untyped + lost
+    return line
+
+
 def run_coalesce(cfg, params, schedule, args) -> dict:
     import jax.numpy as jnp
 
@@ -460,11 +592,15 @@ def run_coalesce(cfg, params, schedule, args) -> dict:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--engine",
-                   choices=("continuous", "coalesce", "both", "chaos"),
+                   choices=("continuous", "coalesce", "both", "chaos",
+                            "fleet"),
                    default="both",
                    help="'chaos' runs ONLY the seeded fault-injection "
                         "mix (supervised engine, step crash + stall "
-                        "mid-run)")
+                        "mid-run); 'fleet' the router-fronted replica "
+                        "fleet with one replica killed mid-run")
+    p.add_argument("--fleet-replicas", type=int, default=4,
+                   help="replica count for --engine fleet")
     p.add_argument("--requests", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
@@ -527,6 +663,8 @@ def main(argv: list[str] | None = None) -> int:
     lines = []
     if args.engine == "chaos":
         lines.append(run_chaos_leg(cfg, params, schedule, args))
+    if args.engine == "fleet":
+        lines.append(run_fleet_leg(cfg, params, schedule, args))
     if args.engine in ("continuous", "both"):
         lines.append(run_continuous(cfg, params, schedule, args))
     if args.engine in ("coalesce", "both"):
