@@ -485,6 +485,25 @@ def bench_serve_fleet(peak_hbm_gbps: float | None) -> None:
                           else 360)
 
 
+def bench_serve_tp(peak_hbm_gbps: float | None) -> None:
+    """SPMD tensor-parallel serving pair: subprocess-runs
+    tools/serve_bench.py --tp 2 — the seeded open-loop schedule through
+    the continuous engine on a 2-device tp mesh (one compiled step, KV
+    storage head-sharded) and through the single-device engine as
+    baseline; the tp line's vs_baseline is tp2/tp1. On CPU rounds the
+    devices come from the XLA host-device trick serve_bench applies
+    itself (so this line exists in every round — it measures the SPMD
+    mechanism there, the real slice speedup on hardware, where the two
+    mesh devices are chips). Subprocess for the usual serve-section
+    reasons: clean metrics registry, a wedged mesh can't take the bench
+    down. peak_hbm unused; signature keeps the peak-table plumbing
+    uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("serve_tp", ["--tp", "2"],
+                          timeout=150 if os.environ.get("BENCH_SMOKE")
+                          else 420)
+
+
 def _run_serve_subprocess(label: str, extra_args: list,
                           timeout: float) -> None:
     """Shared harness for the serve-family sections: subprocess-run
@@ -1180,6 +1199,7 @@ _SECTIONS: dict = {
     "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
+    "serve_tp": (bench_serve_tp, chip_peak_hbm_gbps, 480.0),
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }

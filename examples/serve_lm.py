@@ -36,7 +36,11 @@ generate()'s own validation). Two serving engines (``--engine``):
   ``--kv-pool-blocks`` pool): admission charges actual lengths rather
   than max-seq-len rows, identical block-aligned prompt prefixes share
   physical blocks copy-on-write and skip their prefill, and
-  ``--kv-dense`` falls back to the PR-5 dense slot tensor.
+  ``--kv-dense`` falls back to the PR-5 dense slot tensor. ``--tp N``
+  runs the SAME engine SPMD over an N-device mesh: params tp-sharded by
+  the training rules, KV storage head-sharded, one compiled step
+  driving the whole slice (composes with ``--kv-paged``/``--kv-dense``;
+  output stays bit-identical to solo decode).
   ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
   ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
   requests finish (bounded by ``--drain-timeout`` — stragglers resolve
@@ -63,8 +67,9 @@ generate()'s own validation). Two serving engines (``--engine``):
   combination), optionally with ``--batch-window MS`` coalescing
   concurrent same-shape greedy requests into one padded batched decode
   (serve/coalesce.py). Selected automatically when --spec-k /
-  --batch-window / --tp / --int8 ask for paths the continuous engine
-  does not compose with; kept selectable for the exactness matrix.
+  --batch-window / --int8 ask for paths the continuous engine does not
+  compose with (--tp no longer downgrades — tensor-parallel decode is a
+  continuous-engine mode); kept selectable for the exactness matrix.
 
 ``--requests`` bounds the serve
 loop so the process terminates like a job (the operator's Succeeded
@@ -161,7 +166,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--train-steps", type=int, default=150)
     p.add_argument("--lr", type=float, default=5e-3)
     p.add_argument("--tp", type=int, default=1,
-                   help="tensor-parallel decode over this many devices")
+                   help="tensor-parallel decode over this many devices: "
+                        "params tp-sharded by the training rules, and "
+                        "under the continuous engine the slot KV "
+                        "storage (paged pool or dense tensor) is "
+                        "head-sharded over the mesh so ONE compiled "
+                        "step drives the whole slice (composes with "
+                        "--kv-paged/--kv-dense; --spec-k/--int8 remain "
+                        "legacy-only)")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode: quantize projections "
                         "after load (Pallas dequant-in-VMEM on TPU — "
@@ -221,9 +233,10 @@ def main(argv: list[str] | None = None) -> int:
                         "in-flight join/retire, sampled requests batch "
                         "too, zero recompiles across occupancy); "
                         "'coalesce' = the legacy direct/batch-window "
-                        "path. Default: continuous, unless --spec-k/"
-                        "--batch-window/--tp/--int8 select the legacy "
-                        "path (solo-decode compositions the continuous "
+                        "path. Default: continuous (incl. under --tp — "
+                        "SPMD tensor-parallel decode), unless --spec-k/"
+                        "--batch-window/--int8 select the legacy path "
+                        "(solo-decode compositions the continuous "
                         "engine does not cover)")
     p.add_argument("--prefill-budget", type=int, default=256,
                    metavar="TOKENS",
@@ -312,10 +325,13 @@ def main(argv: list[str] | None = None) -> int:
     res.add_argument("--fault-seed", type=int, default=0,
                      help="seed for probabilistic fault entries")
     args = p.parse_args(argv)
+    # --tp is NOT in this list: tensor-parallel decode is a first-class
+    # continuous-engine mode (PR 10 — the SPMD slot tensor; one compiled
+    # step drives the slice). Only --spec-k/--int8/--batch-window still
+    # downgrade to the legacy lock-step path.
     legacy_flags = [flag for flag, on in (
         ("--spec-k", bool(args.spec_k)),
         ("--batch-window", args.batch_window > 0),
-        ("--tp", args.tp > 1),
         ("--int8", args.int8),
     ) if on]
     if args.engine == "continuous" and legacy_flags:
@@ -429,6 +445,7 @@ def main(argv: list[str] | None = None) -> int:
     else:
         params = quick_train(cfg, args.train_steps, args.lr)
 
+    mesh = None
     if args.tp > 1:
         from tf_operator_tpu.parallel.mesh import create_mesh
         from tf_operator_tpu.parallel.sharding import shard_params_by_rules
@@ -566,15 +583,17 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         def engine_factory():
-            # The watchdog rebuilds through here: SAME cfg/params every
-            # time, so a replayed greedy request is bit-identical to an
-            # uninterrupted run.
+            # The watchdog rebuilds through here: SAME cfg/params/mesh
+            # every time, so a replayed greedy request is bit-identical
+            # to an uninterrupted run — the rebuilt engine reconstructs
+            # the tp layout (re-places the KV pools head-sharded) from
+            # the captured mesh, at tp>1 exactly as at tp=1.
             return ContinuousEngine(
                 cfg, params, max_slots=args.max_batch,
                 prefill_chunk=(args.prefill_chunk or None),
                 kv_paged=kv_paged, kv_block=args.kv_block,
                 kv_blocks=args.kv_pool_blocks,
-                faults=faults,
+                faults=faults, mesh=mesh,
             )
 
         engine_sched = EngineSupervisor(
@@ -591,6 +610,8 @@ def main(argv: list[str] | None = None) -> int:
             f"{engine_sched.engine.kv_blocks} block pool)"
             if kv_paged else "dense kv"
         )
+        if mesh is not None:
+            kv_desc += f", tp {args.tp} (SPMD mesh, kv head-sharded)"
         print(f"serve_lm: continuous batching "
               f"(slots {args.max_batch}, {kv_desc}, prefill chunk "
               f"{args.prefill_chunk or 'one-shot'}, prefill budget "

@@ -601,6 +601,61 @@ def test_serve_lm_streams_segments():
         proc.wait(timeout=15)
 
 
+def test_serve_lm_tensor_parallel_continuous_engine():
+    """serve_lm --tp 2 serves through the CONTINUOUS engine (PR 10 —
+    the flag no longer downgrades to the coalescer): /healthz and
+    /debug/serve report the 2-device mesh, and greedy output is
+    deterministic across repeated identical requests (the SPMD step is
+    the same math every call)."""
+    import json as _json
+    import subprocess
+    import urllib.request
+
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(EXAMPLES, "serve_lm.py"),
+         "--port", str(port), "--train-steps", "40", "--tp", "2",
+         "--max-batch", "4"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        wait_server_ready(proc, port)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=30
+        ) as resp:
+            health = _json.loads(resp.read())
+        assert health["engine"] == "continuous", health
+        assert health["mesh_devices"] == 2, health
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/serve", timeout=30
+        ) as resp:
+            snap = _json.loads(resp.read())
+        assert snap["mesh"]["devices"] == 2, snap["mesh"]
+        assert snap["mesh"]["kv_heads_sharded"] is True, snap["mesh"]
+
+        body = _json.dumps(
+            {"tokens": [[7, 8, 9, 10]], "num_steps": 8}
+        ).encode()
+        outs = []
+        for _ in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                outs.append(_json.loads(resp.read())["tokens"][0])
+        assert len(outs[0]) == 8 and outs[0] == outs[1], outs
+    finally:
+        proc.terminate()
+        proc.wait(timeout=15)
+
+
 def test_serve_lm_drains_queued_requests_on_shutdown():
     """SIGTERM arriving while a coalesced request is parked in the batch
     window must not drop it: the batcher drains its queue after shutdown

@@ -1,0 +1,117 @@
+"""Fast unit tests for the engine-state sharding layer
+(tf_operator_tpu/serve/sharding.py): the mesh LAYOUT as data — which
+leaf gets which PartitionSpec, the can't-tile fallback, and the debug
+shape — all computable without touching a device (the multi-device
+bit-identity matrix lives in tests/test_serve_tp.py, slow-marked,
+because it needs a >1-device process)."""
+
+import numpy as np
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.serve.sharding import (
+    cache_specs,
+    leaf_spec,
+    logits_spec,
+    mesh_debug,
+    tp_size_of,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def arr(*shape):
+    return np.zeros(shape, np.float32)
+
+
+class TestLeafSpec:
+    def test_paged_pool_sharded_on_kv_head_axis(self):
+        # [nb, blk, KV, Dh]: the KV axis is dim 2.
+        assert leaf_spec("pool_key", (25, 8, 4, 16), 2) == \
+            P(None, None, "tp", None)
+        assert leaf_spec("pool_value", (25, 8, 4, 16), 2) == \
+            P(None, None, "tp", None)
+
+    def test_dense_rows_sharded_on_kv_head_axis(self):
+        # Stacked [slots, 1, S, KV, Dh] and solo [1, S, KV, Dh]: the
+        # suffix addressing finds KV at -2 in both.
+        assert leaf_spec("cached_key", (3, 1, 64, 4, 16), 2) == \
+            P(None, None, None, "tp", None)
+        assert leaf_spec("cached_value", (1, 64, 4, 16), 2) == \
+            P(None, None, "tp", None)
+
+    def test_kv8_scale_sidecars_ride_the_head_shard(self):
+        # [slots, 1, S, KV]: KV is the LAST axis for the scale leaves.
+        assert leaf_spec("key_scale", (3, 1, 64, 4), 2) == \
+            P(None, None, None, "tp")
+        assert leaf_spec("value_scale", (1, 64, 4), 2) == \
+            P(None, None, "tp")
+
+    def test_per_slot_state_replicates(self):
+        for name in ("block_table", "cache_index", "pos_index"):
+            assert leaf_spec(name, (3, 8), 2) == P()
+
+    def test_untileable_heads_fall_back_replicated(self):
+        # KV=3 heads over tp=2: placement is an optimization, never a
+        # correctness requirement — replicate rather than crash.
+        assert leaf_spec("pool_key", (25, 8, 3, 16), 2) == P()
+
+    def test_tp1_replicates_everything(self):
+        assert leaf_spec("pool_key", (25, 8, 4, 16), 1) == P()
+
+
+class TestCacheSpecs:
+    def test_walks_nested_tree_and_mirrors_shape(self):
+        tree = {
+            "block_0": {
+                "attn": {
+                    "pool_key": arr(25, 8, 4, 16),
+                    "pool_value": arr(25, 8, 4, 16),
+                    "block_table": arr(3, 8),
+                    "cache_index": arr(3),
+                },
+            },
+            "pos_index": arr(3),
+        }
+        specs = cache_specs(tree, 2)
+        attn = specs["block_0"]["attn"]
+        assert attn["pool_key"] == P(None, None, "tp", None)
+        assert attn["pool_value"] == P(None, None, "tp", None)
+        assert attn["block_table"] == P()
+        assert attn["cache_index"] == P()
+        assert specs["pos_index"] == P()
+
+    def test_custom_axis_name(self):
+        tree = {"pool_key": arr(25, 8, 4, 16)}
+        assert cache_specs(tree, 4, tp_axis="model")["pool_key"] == \
+            P(None, None, "model", None)
+
+
+class TestLogitsSpec:
+    def test_vocab_split_matches_lm_head(self):
+        assert logits_spec((8, 64), 2) == P(None, "tp")
+
+    def test_odd_vocab_replicates(self):
+        assert logits_spec((8, 63), 2) == P()
+
+    def test_tp1_replicates(self):
+        assert logits_spec((8, 64), 1) == P()
+
+
+class TestMeshDebug:
+    def test_no_mesh_is_single_device(self):
+        assert mesh_debug(None) == {"devices": 1}
+        assert tp_size_of(None) == 1
+
+    def test_mesh_shape_surfaces(self):
+        class FakeDevices:
+            size = 4
+
+        class FakeMesh:
+            devices = FakeDevices()
+            shape = {"dp": 2, "tp": 2}
+
+        info = mesh_debug(FakeMesh())
+        assert info == {"devices": 4, "axes": {"dp": 2, "tp": 2}}
+        assert tp_size_of(FakeMesh()) == 2

@@ -1,0 +1,44 @@
+"""SPMD tensor-parallel decode exactness (slow tier): the
+{dense, paged} x {one-shot, chunked} bit-identity matrix at tp=2 plus
+the supervisor crash/replay drill, via tools/serve_tp_check.py in a
+SUBPROCESS — a >1-device CPU needs
+``--xla_force_host_platform_device_count`` set before jax imports,
+which this (already-jax-initialized, single-device) test process cannot
+do for itself. Slow-marked: tier-1 has no headroom for another
+jit-heavy sweep (the fast tier-1 coverage of the sharding layer is
+tests/test_serve_sharding.py); tools/serve_smoke.py runs this check in
+its default pass."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.serve, pytest.mark.slow]
+
+
+def test_tp2_matrix_and_supervisor_replay_bit_identical():
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "serve_tp_check.py"),
+         "--tp", "2"],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    # Every matrix cell pinned, plus the replay drill.
+    for cell in ("dense/oneshot", "dense/chunked", "paged/oneshot",
+                 "paged/chunked"):
+        assert f"serve_tp_check: {cell} ok" in out, out
+    assert "supervisor replay ok" in out, out
+    assert "serve_tp_check: OK" in out, out

@@ -62,6 +62,12 @@ class Replica:
     max_slots: int = 0
     active_slots: int = 0
     queue_depth: int = 0
+    # SPMD decode width from the probe payload (PR 10): a tp-wide
+    # replica is one probe target but many chips — informational for
+    # /debug/fleet and capacity math (the least-loaded score already
+    # normalizes by max_slots, which is per-REPLICA capacity regardless
+    # of how many chips serve it).
+    mesh_devices: int = 1
     watchdog_restarts: int = 0
     # Per-replica TTFT p99 from the probe payload (None until a probe
     # carries one) — the autoscaler's latency trigger reads the fleet
@@ -95,6 +101,7 @@ class Replica:
             "maxSlots": self.max_slots,
             "activeSlots": self.active_slots,
             "queueDepth": self.queue_depth,
+            "meshDevices": self.mesh_devices,
             "inflight": self.inflight,
             "watchdogRestarts": self.watchdog_restarts,
             "consecutiveFailures": self.consecutive_failures,
@@ -184,6 +191,9 @@ class FleetMembership:
             rep.active_slots = int(payload.get("active_slots", 0))
             rep.queue_depth = int(payload.get("queue_depth", 0))
             rep.max_slots = int(payload.get("max_slots", rep.max_slots))
+            rep.mesh_devices = int(
+                payload.get("mesh_devices", rep.mesh_devices)
+            )
             rep.watchdog_restarts = int(
                 payload.get("watchdog_restarts", rep.watchdog_restarts)
             )

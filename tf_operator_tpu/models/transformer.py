@@ -70,9 +70,12 @@ class TransformerConfig:
     # max_seq_len in a flax "cache" collection. A call may carry t >= 1
     # tokens (multi-token calls are block-causal prompt PREFILL; sampling
     # feeds one token per step); positions come from the cache index.
-    # The mesh field is unread on this path — tensor-parallel decode
-    # happens via GSPMD propagation from tp-sharded params. See
-    # ``generate`` for the jitted sampling loop.
+    # Tensor-parallel decode happens via GSPMD propagation from
+    # tp-sharded params (param_sharding_rules); the dense decode path
+    # never reads ``mesh``, and the PAGED path reads it only to pin the
+    # head-sharded pool placement (_decode_attend_paged — the continuous
+    # engine's SPMD step sets it, serve/engine.py). See ``generate`` for
+    # the jitted sampling loop.
     decode: bool = False
     # Weight-only int8 decode: projection weights live in HBM as int8 +
     # per-channel scales and are dequantized IN VMEM by the Pallas kernel
@@ -522,6 +525,18 @@ class Attention(nn.Module):
         shared prefix blocks is the ENGINE's job (serve/engine.py runs
         pending copies before the step that would write), so by the time
         this executes every writable block is exclusively owned.
+
+        TENSOR PARALLELISM: when ``cfg.mesh`` carries a ``tp`` axis that
+        tiles the KV heads, the pool lives head-sharded
+        (P(None, None, 'tp', None) — serve/sharding.py placed it at
+        allocation) and this attend pins the gathered K/V and the score
+        tensor to the same head split, so the scatter-write, gather,
+        einsum, mask, and softmax all run shard-local per KV-head group
+        with ZERO collectives inside the attend (the only per-layer
+        collective is the out-projection's all-reduce, exactly as in tp
+        training) and no per-step host sync. Without a mesh the
+        constraints vanish and the math is byte-for-byte the single-chip
+        path.
         """
         cfg = self.cfg
         b, t, h, dh = q.shape
@@ -566,11 +581,35 @@ class Attention(nn.Module):
         vals = pool_v.value[table.value].reshape(
             b, cfg.max_seq_len, kv, dh
         )
+        tp = (
+            cfg.mesh.shape.get(cfg.tp_axis, 1)
+            if cfg.mesh is not None else 1
+        )
+        if tp > 1 and kv % tp == 0:
+            # Head-sharded placement pinned end to end: the gather stays
+            # on each chip's KV/tp heads of the pool and the masked
+            # softmax reduces shard-locally (its axis is the unsharded
+            # sequence), so GSPMD cannot be nudged into all-gathering
+            # the pool per step.
+            def _pin(x, spec):
+                return jax.lax.with_sharding_constraint(
+                    x, jax.sharding.NamedSharding(cfg.mesh, spec)
+                )
+
+            hspec = jax.sharding.PartitionSpec(
+                None, None, cfg.tp_axis, None
+            )
+            keys = _pin(keys, hspec)
+            vals = _pin(vals, hspec)
         qg = q.reshape(b, t, kv, g, dh)
         s = jnp.einsum(
             "bqkgd,bskd->bkgqs", qg, keys,
             preferred_element_type=jnp.float32,
         )
+        if tp > 1 and kv % tp == 0:
+            s = _pin(s, jax.sharding.PartitionSpec(
+                None, cfg.tp_axis, None, None, None
+            ))
         s = s * (dh ** -0.5)
         # Lane i's query row j (absolute pos[i, j]) sees keys <= pos[i, j].
         valid = (

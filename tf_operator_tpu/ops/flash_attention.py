@@ -120,6 +120,11 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # single-shot flashblocks line (bq256 9.0 / bq512 11.0 / bq1024 14.0) —
 # no probe_qblock arbitration output has landed, so the trigger stays
 # OPEN and the cap stays 1024 on the strength of the single-shot data.
+# Re-checked (PR 10, 2026-08-03): unchanged — window_r05 remains the
+# newest window and holds no probe_qblock output; the qblock stage is
+# still queued at the front of window_autorun's unmeasured set for the
+# next hardware window, and the dispatch_auto-vs-direct_bq1024 revert
+# trigger above stays armed.
 MAX_Q_BLOCK = 1024
 
 

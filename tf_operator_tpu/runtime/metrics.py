@@ -495,6 +495,12 @@ SERVE_DEGRADED = REGISTRY.gauge(
     "1 while the engine admits in degraded mode (free KV blocks below "
     "the --degraded-blocks watermark caps admitted max_tokens), else 0",
 )
+SERVE_MESH_DEVICES = REGISTRY.gauge(
+    "tpu_serve_mesh_devices",
+    "Devices in the continuous engine's SPMD decode mesh (1 = "
+    "single-chip; >1 = one compiled step drives the whole slice, KV "
+    "storage head-sharded over the tp axis)",
+)
 SERVE_OCCUPANCY = REGISTRY.histogram(
     "tpu_serve_batch_occupancy",
     "Fraction of decode slots active, observed at every decode step — "

@@ -62,6 +62,21 @@ so the subsequent prefill/join can never fail on capacity, and
 ``release_plan`` undoes it on error/drain paths. ``join`` wraps
 plan → prefill → ``join_planned`` for callers that do not interleave.
 
+SPMD tensor parallelism (``mesh=``): one compiled step drives an entire
+slice. Params are tp-sharded by the training-side
+``param_sharding_rules`` (the same shardings that prove tp solo
+decode), the KV storage — paged pool and dense slot tensor alike — is
+head-sharded at allocation (serve/sharding.py: each chip holds KV/tp
+heads, so the per-chip cache footprint divides by tp), per-slot
+counters/tables/sampling state replicate (host-side joins/retires need
+no cross-chip bookkeeping), and the sampling logits stay vocab-split
+where the lm_head leaves them. Every state executable's outputs are
+constrained to those canonical shardings, so donated buffers round-trip
+identically and the zero-recompile pin holds at tp>1 exactly as at
+tp=1. Greedy output stays bit-identical to solo ``generate`` with the
+same tp-sharded params on an f32 CPU mesh (tests/test_serve_tp.py, via
+the ``--xla_force_host_platform_device_count`` trick).
+
 Thread model: the engine is a device-state machine with NO internal
 locking — the serving loop (serve/scheduler.py) is its single caller;
 tests drive it directly for the deterministic exactness matrix. (The
@@ -93,6 +108,7 @@ from tf_operator_tpu.models.transformer import (
 from tf_operator_tpu.runtime.metrics import (
     SERVE_KV_BLOCKS,
     SERVE_KV_COW_TOTAL,
+    SERVE_MESH_DEVICES,
     SERVE_PREFILL_SAVED_TOTAL,
 )
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
@@ -110,6 +126,13 @@ from tf_operator_tpu.serve.kvcache import (
     plain_tree,
     solo_cache_template,
     stack_slots,
+)
+from tf_operator_tpu.serve.sharding import (
+    cache_specs,
+    constrain_tree,
+    logits_spec,
+    mesh_debug,
+    tp_size_of,
 )
 
 
@@ -168,7 +191,8 @@ class ContinuousEngine:
                  max_slots: int, *, prefill_chunk: int | None = None,
                  kv_paged: bool = True, kv_block: int = 64,
                  kv_blocks: int | None = None,
-                 faults: Any = None) -> None:
+                 faults: Any = None, mesh: Any = None,
+                 tp_axis: str = "tp") -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
         # Armed only AFTER warmup (below): the constructor's own steps
@@ -176,11 +200,38 @@ class ContinuousEngine:
         # SERVING invocations.
         self.faults = NULL_INJECTOR
         self.cfg = cfg
-        self.params = params
         self.max_slots = int(max_slots)
         self.prefill_chunk = prefill_chunk
         self.kv_paged = bool(kv_paged)
         self.kv_block = int(kv_block)
+        # SPMD tensor parallelism: one ``tp`` mesh over the slice. The
+        # engine's compiled step stays ONE program — params are
+        # tp-sharded by the training rules (the same shardings that
+        # prove tp solo decode), the KV storage is head-sharded at
+        # allocation (serve/sharding.py), per-slot state replicated, and
+        # GSPMD drives every device from the single step. mesh None (or
+        # tp size 1 with one device) = the single-chip engine unchanged.
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        self._tp = tp_size_of(mesh, tp_axis)
+        if mesh is not None:
+            from tf_operator_tpu.models.transformer import (
+                param_sharding_rules,
+            )
+            from tf_operator_tpu.parallel.sharding import (
+                shard_params_by_rules,
+            )
+
+            # Idempotent for already-sharded params (device_put to the
+            # same sharding is a no-op) — serve_lm shards once up front;
+            # a supervisor rebuild re-places through here either way.
+            params = shard_params_by_rules(
+                mesh, params, param_sharding_rules(tp_axis)
+            )
+        self.params = params
+        SERVE_MESH_DEVICES.set(
+            int(mesh.devices.size) if mesh is not None else 1
+        )
         dcfg = replace(cfg, decode=True, mesh=None, remat=False,
                        kv_paged=False)
         # Solo DENSE model: prefill (one-shot, chunked, and suffix) and
@@ -203,18 +254,27 @@ class ContinuousEngine:
                 # (every slot at max length) + the pinned garbage block.
                 kv_blocks = self.max_slots * self.table_len + 1
             self.kv_blocks = int(kv_blocks)
+            # The paged model carries the mesh so its decode attend can
+            # pin the gather/einsum/softmax to the head-sharded pool
+            # (models/transformer.py _decode_attend_paged).
             pcfg = replace(dcfg, kv_paged=True, kv_block=self.kv_block,
-                           kv_num_blocks=self.kv_blocks)
+                           kv_num_blocks=self.kv_blocks, mesh=self.mesh,
+                           tp_axis=self.tp_axis)
             self._model = Transformer(pcfg)
             self.blocks = BlockAllocator(self.kv_blocks)
             self.prefix = PrefixCache(self.kv_block)
-            self._cache = paged_cache_template(self._model, n)
+            self._cache = paged_cache_template(self._model, n,
+                                               mesh=self.mesh,
+                                               tp_axis=self.tp_axis)
+            constraint = self._make_constraint()
             self._paged_insert = make_paged_insert_fn(
-                self.kv_blocks, self.kv_block
+                self.kv_blocks, self.kv_block, constraint=constraint
             )
-            self._table_insert = make_table_insert_fn()
+            self._table_insert = make_table_insert_fn(
+                constraint=constraint
+            )
             self._gather = make_gather_fn(self.kv_block)
-            self._cow_fn = make_cow_fn()
+            self._cow_fn = make_cow_fn(constraint=constraint)
             self._extend_fn = jax.jit(
                 functools.partial(_prefill_extend, self._solo_model)
             )
@@ -230,11 +290,15 @@ class ContinuousEngine:
             self._model = self._solo_model
             self.blocks = None
             self.prefix = None
-            self._cache = stack_slots(solo_cache_template(self._model), n)
-            self._insert = make_insert_fn()
-        self._logits = jnp.zeros((n, v), jnp.float32)
-        self._keys = jnp.zeros((n, s, 2), jnp.uint32)
-        self._stepidx = jnp.zeros((n,), jnp.int32)
+            self._cache = stack_slots(solo_cache_template(self._model), n,
+                                      mesh=self.mesh,
+                                      tp_axis=self.tp_axis)
+            self._insert = make_insert_fn(
+                constraint=self._make_constraint()
+            )
+        self._logits = self._place_logits(jnp.zeros((n, v), jnp.float32))
+        self._keys = self._replicate(jnp.zeros((n, s, 2), jnp.uint32))
+        self._stepidx = self._replicate(jnp.zeros((n,), jnp.int32))
         # Host-side per-slot sampling state, passed into every step (tiny
         # [N] transfers; keeping them host-side means join/retire never
         # need a device write for them).
@@ -246,10 +310,10 @@ class ContinuousEngine:
         self._prefill_fn = jax.jit(
             functools.partial(_prefill, self._solo_model)
         )
-        self._step_fn = jax.jit(
-            self._step_paged if self.kv_paged else self._step,
-            donate_argnums=(1, 2),
-        )
+        step_impl = self._step_paged if self.kv_paged else self._step
+        if self.mesh is not None:
+            step_impl = self._constrained_step(step_impl)
+        self._step_fn = jax.jit(step_impl, donate_argnums=(1, 2))
         self.steps_total = 0
         # Warm the decode executable at CONSTRUCTION, twice: the first
         # step compiles; the second catches XLA's donated-buffer layout
@@ -264,6 +328,87 @@ class ContinuousEngine:
         self.steps_total = 0
         self.warmup_compiles = self.decode_step_compiles
         self.faults = faults or NULL_INJECTOR
+
+    # -- mesh placement ---------------------------------------------------
+
+    def _make_constraint(self):
+        """Output-layout pin for the state executables, computed once
+        from the freshly-placed cache tree; None single-chip. Donated
+        buffers round-trip with identical shardings, so the canonical
+        layout holds by construction — not by propagation luck — and
+        the zero-recompile pin survives tp>1."""
+        if self.mesh is None:
+            self._cache_specs = None
+            return None
+        self._cache_specs = cache_specs(self._cache, self._tp,
+                                        self.tp_axis)
+        mesh, specs = self.mesh, self._cache_specs
+        return lambda tree: constrain_tree(mesh, tree, specs)
+
+    def _replicate(self, x):
+        """Pin per-slot host-fed state (key ladders, counters) fully
+        replicated: a join's eager scatter update must hand the next
+        step an identically-placed array."""
+        if self.mesh is None:
+            return x
+        from tf_operator_tpu.serve.sharding import replicate_put
+
+        return replicate_put(self.mesh, x)
+
+    def _place_logits(self, x):
+        """Pin the [slots, vocab] sampling logits to the vocab-split
+        layout of the lm_head (or replicated when vocab doesn't tile):
+        prefill rows land vocab-sharded and are consumed in place."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(
+            x,
+            NamedSharding(
+                self.mesh, logits_spec(x.shape, self._tp, self.tp_axis)
+            ),
+        )
+
+    def _constrained_step(self, inner):
+        """Wrap a decode-step body so every output is constrained to the
+        engine's canonical shardings (cache per ``cache_specs``, logits
+        vocab-split, counters/tokens replicated)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh, specs = self.mesh, self._cache_specs
+        rep = NamedSharding(mesh, P())
+        lsharding = NamedSharding(
+            mesh,
+            logits_spec((self.max_slots, self.cfg.vocab_size),
+                        self._tp, self.tp_axis),
+        )
+
+        def step(params, cache, logits, keys, stepidx, active,
+                 temperature, top_p, has_top_p):
+            cache, logits, stepidx, toks = inner(
+                params, cache, logits, keys, stepidx, active,
+                temperature, top_p, has_top_p,
+            )
+            cache = constrain_tree(mesh, cache, specs)
+            logits = jax.lax.with_sharding_constraint(logits, lsharding)
+            stepidx = jax.lax.with_sharding_constraint(stepidx, rep)
+            toks = jax.lax.with_sharding_constraint(toks, rep)
+            return cache, logits, stepidx, toks
+
+        return step
+
+    def mesh_info(self) -> dict:
+        """Mesh shape for /debug/serve and the /healthz probe payload
+        (the fleet router's least-loaded pick can see replica width)."""
+        info = mesh_debug(self.mesh)
+        if self.mesh is not None:
+            info["tp"] = self._tp
+            info["kv_heads_sharded"] = bool(
+                self._tp > 1 and self.cfg.kv_heads % self._tp == 0
+            )
+        return info
 
     # -- admission planning ----------------------------------------------
 
@@ -549,9 +694,14 @@ class ContinuousEngine:
                 jnp.asarray(plan.write_table), read, plain_tree(cache),
             )
         row = jnp.asarray(logits).reshape(-1)
-        self._logits = self._logits.at[slot].set(row)
-        self._keys = self._keys.at[slot].set(jnp.asarray(keys))
-        self._stepidx = self._stepidx.at[slot].set(0)
+        # The re-place pins the canonical layouts after the eager
+        # scatter updates (no-op single-chip AND when already placed):
+        # the decode step's input shardings must never drift.
+        self._logits = self._place_logits(self._logits.at[slot].set(row))
+        self._keys = self._replicate(
+            self._keys.at[slot].set(jnp.asarray(keys))
+        )
+        self._stepidx = self._replicate(self._stepidx.at[slot].set(0))
         self._active[slot] = True
         plan.settled = True  # blocks now belong to the slot
         cow = None
@@ -580,10 +730,11 @@ class ContinuousEngine:
     def _insert_slot(self, state, slot, cache1, logits1, keys1):
         cache, logits, keys, stepidx = state
         cache = self._insert(cache, jnp.int32(slot), cache1)
-        # Small per-slot rows: eager scatter updates (no extra jit).
-        logits = logits.at[slot].set(logits1[0])
-        keys = keys.at[slot].set(jnp.asarray(keys1))
-        stepidx = stepidx.at[slot].set(0)
+        # Small per-slot rows: eager scatter updates (no extra jit); the
+        # re-place pins the canonical mesh layouts (no-op single-chip).
+        logits = self._place_logits(logits.at[slot].set(logits1[0]))
+        keys = self._replicate(keys.at[slot].set(jnp.asarray(keys1)))
+        stepidx = self._replicate(stepidx.at[slot].set(0))
         return cache, logits, keys, stepidx
 
     # -- decode -----------------------------------------------------------

@@ -139,6 +139,12 @@ def readiness_payload(sched: Any, *, draining: bool = False,
     payload["queue_depth"] = sched.queue_depth
     if max_slots is not None:
         payload["max_slots"] = max_slots
+    mesh_devices = getattr(sched, "mesh_devices", None)
+    if mesh_devices is not None:
+        # SPMD decode width: a tp-wide replica is one probe target but
+        # many chips — the router's least-loaded pick and the
+        # autoscaler's capacity math can see it.
+        payload["mesh_devices"] = int(mesh_devices)
     payload["requests_done"] = sched.requests_done
     payload["tokens_generated"] = sched.tokens_generated
     payload["watchdog_restarts"] = getattr(sched, "restarts", 0)

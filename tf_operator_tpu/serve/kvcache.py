@@ -90,26 +90,50 @@ def solo_cache_template(model: Any) -> Any:
     )
 
 
-def stack_slots(template: Any, max_slots: int) -> Any:
+def _maybe_shard(tree: Any, mesh: Any, tp_axis: str) -> Any:
+    """Place a freshly-built cache tree per the engine's mesh layout
+    (serve/sharding.py): K/V storage head-sharded over ``tp_axis``,
+    per-slot state replicated. mesh None = single-chip, tree
+    untouched."""
+    if mesh is None:
+        return tree
+    from tf_operator_tpu.serve.sharding import shard_engine_state
+
+    return shard_engine_state(mesh, tree, tp_axis=tp_axis)
+
+
+def stack_slots(template: Any, max_slots: int, mesh: Any = None,
+                tp_axis: str = "tp") -> Any:
     """Preallocate the dense slot tensor: every solo leaf grows a leading
     [max_slots] axis, zero-filled. One allocation up front — occupancy
-    changes never allocate or reshape anything again."""
-    return jax.tree.map(
-        lambda x: jnp.zeros((max_slots,) + x.shape, x.dtype),
-        plain_tree(template),
+    changes never allocate or reshape anything again. Under a mesh the
+    K/V rows are head-sharded at allocation (each chip holds KV/tp heads
+    of every row)."""
+    return _maybe_shard(
+        jax.tree.map(
+            lambda x: jnp.zeros((max_slots,) + x.shape, x.dtype),
+            plain_tree(template),
+        ),
+        mesh, tp_axis,
     )
 
 
-def paged_cache_template(model: Any, max_slots: int) -> Any:
+def paged_cache_template(model: Any, max_slots: int,
+                         mesh: Any = None, tp_axis: str = "tp") -> Any:
     """The paged engine's whole cache state in one init: a [max_slots, 1]
     token batch through the kv_paged model builds the per-layer pools
     ([kv_num_blocks, kv_block, KV, Dh]), per-lane block tables
     ([max_slots, table_len] int32, all entries on the pinned block 0),
-    and per-lane counters ([max_slots] int32)."""
-    return plain_tree(
-        model.init(
-            jax.random.PRNGKey(0), jnp.zeros((max_slots, 1), jnp.int32)
-        )["cache"]
+    and per-lane counters ([max_slots] int32). Under a mesh the pools
+    are head-sharded at allocation — the per-chip pool footprint divides
+    by tp, which is what lets ``--kv-pool-blocks`` grow with the slice."""
+    return _maybe_shard(
+        plain_tree(
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((max_slots, 1), jnp.int32)
+            )["cache"]
+        ),
+        mesh, tp_axis,
     )
 
 
@@ -135,22 +159,25 @@ def mask_inactive_indices(cache: Any, active: jax.Array) -> Any:
     return walk(cache)
 
 
-def make_insert_fn():
+def make_insert_fn(constraint=None):
     """Jitted (stacked, slot, solo) → stacked with that slot row replaced
     by the solo cache (dense layout). ``slot`` is a TRACED int32
     argument, so one executable serves every slot; the stacked tree is
     donated — a join updates the slot tensor in place rather than
-    doubling it."""
+    doubling it. ``constraint`` (mesh engines) pins the output tree to
+    the engine's canonical shardings so the donated buffer round-trips
+    with an identical layout."""
 
     def insert(stacked, slot, solo):
-        return jax.tree.map(
+        out = jax.tree.map(
             lambda full, one: full.at[slot].set(one), stacked, solo
         )
+        return constraint(out) if constraint is not None else out
 
     return jax.jit(insert, donate_argnums=(0,))
 
 
-def make_paged_insert_fn(num_blocks: int, block: int):
+def make_paged_insert_fn(num_blocks: int, block: int, constraint=None):
     """Jitted (paged, slot, write_table, read_table, solo) → paged with:
 
     - the solo dense cache's K/V rows scattered into pool blocks through
@@ -164,7 +191,8 @@ def make_paged_insert_fn(num_blocks: int, block: int):
 
     slot and both tables are traced DATA: one executable serves every
     join, every table content, every sharing pattern. The paged tree is
-    donated (in-place on device)."""
+    donated (in-place on device); ``constraint`` pins mesh layouts as in
+    ``make_insert_fn``."""
 
     def insert(paged, slot, write_table, read_table, solo):
         def walk(p, s):
@@ -192,16 +220,17 @@ def make_paged_insert_fn(num_blocks: int, block: int):
                     out[name] = walk(leaf, s[name])
             return out
 
-        return walk(paged, solo)
+        out = walk(paged, solo)
+        return constraint(out) if constraint is not None else out
 
     return jax.jit(insert, donate_argnums=(0,))
 
 
-def make_table_insert_fn():
+def make_table_insert_fn(constraint=None):
     """Jitted (paged, slot, read_table, index) → paged with only the
     slot's block-table row and counters set — the exact-prefix-match
     join, where every prompt row already lives in shared blocks and
-    there is nothing to scatter."""
+    there is nothing to scatter. ``constraint`` pins mesh layouts."""
 
     def insert(paged, slot, read_table, index):
         def walk(p):
@@ -217,7 +246,8 @@ def make_table_insert_fn():
                     out[name] = walk(leaf)
             return out
 
-        return walk(paged)
+        out = walk(paged)
+        return constraint(out) if constraint is not None else out
 
     return jax.jit(insert, donate_argnums=(0,))
 
@@ -256,13 +286,15 @@ def make_gather_fn(block: int):
     return jax.jit(gather)
 
 
-def make_cow_fn():
+def make_cow_fn(constraint=None):
     """Jitted (paged, slot, entry, src, dst) → paged with every layer's
     pool block ``src`` copied into ``dst`` and the slot's table entry
     switched to ``dst`` — the copy-on-write step, run by the engine right
     before the first decode write into a shared partial block. All
     indices traced; one executable serves every copy; the tree is
-    donated."""
+    donated. Under a mesh the copy is shard-local (each chip copies its
+    KV/tp heads of the block — no collective) and ``constraint`` pins
+    the output layout."""
 
     def cow(paged, slot, entry, src, dst):
         def walk(p):
@@ -278,7 +310,8 @@ def make_cow_fn():
                     out[name] = walk(leaf)
             return out
 
-        return walk(paged)
+        out = walk(paged)
+        return constraint(out) if constraint is not None else out
 
     return jax.jit(cow, donate_argnums=(0,))
 

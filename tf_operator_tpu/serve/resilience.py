@@ -527,6 +527,22 @@ class EngineSupervisor:
         return self._max_slots
 
     @property
+    def mesh_devices(self) -> int:
+        """SPMD decode-mesh width, held steady through rebuild windows
+        like ``max_slots`` (the factory reconstructs the same mesh every
+        generation) — /healthz reports it so the fleet router can see
+        replica width."""
+        sched = self.scheduler
+        if sched is not None:
+            info = (
+                sched.engine.mesh_info()
+                if hasattr(sched.engine, "mesh_info")
+                else {"devices": 1}
+            )
+            self._mesh_devices = int(info.get("devices", 1))
+        return getattr(self, "_mesh_devices", 1)
+
+    @property
     def requests_done(self) -> int:
         sched = self.scheduler
         return self._done_prev + (sched.requests_done if sched else 0)

@@ -835,4 +835,12 @@ class ContinuousScheduler:
             # counts, CoW copies, prefix-cache hits, prefill tokens
             # saved; dense: the slot-row budget).
             "kv_cache": self.engine.kv_debug(),
+            # SPMD decode mesh: device count + axis sizes ({"devices": 1}
+            # single-chip). getattr-guarded for the chaos tests' fake
+            # engines.
+            "mesh": (
+                self.engine.mesh_info()
+                if hasattr(self.engine, "mesh_info")
+                else {"devices": 1}
+            ),
         }

@@ -1,0 +1,164 @@
+"""Engine-state sharding for SPMD tensor-parallel decode: the mesh
+layout of the continuous engine's slot tensor, as data.
+
+The continuous engine (serve/engine.py) is a device-state machine whose
+whole state is one cache pytree plus a few per-slot vectors. Tensor
+parallelism over a ``tp`` mesh axis shards exactly the axes the model's
+math is independent along, and replicates the rest:
+
+| engine state                      | spec                      | why |
+| --------------------------------- | ------------------------- | --- |
+| paged pool ``pool_key``/``pool_value`` ``[nb, blk, KV, Dh]`` | ``P(None, None, 'tp', None)`` | attention is per-KV-head independent; each chip holds ``KV/tp`` heads of every block — the per-chip KV footprint divides by tp |
+| dense rows ``cached_key``/``cached_value`` ``[slots, 1, S, KV, Dh]`` | ``P(None, None, None, 'tp', None)`` | same head split, slot-stacked layout |
+| kv-int8 scale sidecars ``key_scale``/``value_scale`` ``[slots, 1, S, KV]`` | tp on the KV (last) axis | ride their head shard |
+| ``block_table`` / counters / sampling state | ``P()`` (replicated)      | per-slot scalars and gather indices: a few int32 per slot — replicating them is what keeps joins/retires host-side writes with no cross-chip bookkeeping |
+| logits ``[slots, vocab]``         | ``P(None, 'tp')``         | the lm_head kernel is vocab-split (``param_sharding_rules``), so sampling consumes the shards where they land — no per-step all-gather of the logits row |
+
+Any leaf whose named dimension cannot tile (``KV % tp != 0``, odd vocab)
+falls back to replicated for that leaf — the
+``parallel/sharding.sharding_tree_by_rules`` convention: placement is an
+optimization, never a correctness requirement. Specs are pure data
+(computable without touching a device), so the layout itself is
+unit-testable jax-free; ``shard_engine_state`` is the one function that
+places arrays.
+
+Params are NOT this module's concern: tensor-parallel decode reuses the
+training-side ``param_sharding_rules`` from models/transformer.py
+(already proven for tp-sharded solo decode) via
+``parallel/sharding.shard_params_by_rules``; the engine applies them
+when given a mesh. GSPMD propagates from the head-sharded pool and the
+tp-sharded params through the unchanged decode math — the engine's
+``with_sharding_constraint`` wrappers only pin the fixed point so the
+zero-recompile contract holds by construction instead of by
+propagation luck.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping
+from typing import Any
+
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+# Leaf name -> index of the KV-head dimension, counted FROM THE END
+# (shape-suffix addressing survives the optional leading slot axis: the
+# solo dense cache is [1, S, KV, Dh], the stacked one [slots, 1, S, KV,
+# Dh] — KV is -2 in both).
+_HEAD_AXIS_FROM_END = {
+    "pool_key": 2,      # [nb, blk, KV, Dh]
+    "pool_value": 2,
+    "cached_key": 2,    # [(slots,) 1, S, KV, Dh]
+    "cached_value": 2,
+    "key_scale": 1,     # [(slots,) 1, S, KV]  (kv-int8 sidecars)
+    "value_scale": 1,
+}
+
+
+def _tiles(shape: tuple, dim: int, size: int) -> bool:
+    """Can mesh-axis ``size`` tile dimension ``dim`` of ``shape``?"""
+    return 0 <= dim < len(shape) and size > 0 and shape[dim] % size == 0
+
+
+def leaf_spec(name: str, shape: tuple, tp_size: int,
+              tp_axis: str = "tp") -> P:
+    """PartitionSpec for ONE cache leaf by name + shape: head-sharded
+    for the K/V storage leaves (when ``KV % tp == 0``), replicated for
+    everything else (tables, counters). Pure data — no mesh, no device."""
+    from_end = _HEAD_AXIS_FROM_END.get(name)
+    if from_end is None or tp_size <= 1:
+        return P()
+    dim = len(shape) - from_end
+    if not _tiles(tuple(shape), dim, tp_size):
+        return P()  # can't tile: replicate this leaf (never crash)
+    spec = [None] * len(shape)
+    spec[dim] = tp_axis
+    return P(*spec)
+
+
+def cache_specs(tree: Any, tp_size: int, tp_axis: str = "tp") -> Any:
+    """PartitionSpec pytree matching a cache tree (dense-stacked, paged,
+    or solo): K/V leaves head-sharded, the rest replicated."""
+    def walk(node):
+        if isinstance(node, Mapping):
+            return {
+                k: (leaf_spec(k, tuple(v.shape), tp_size, tp_axis)
+                    if not isinstance(v, Mapping) else walk(v))
+                for k, v in node.items()
+            }
+        return node
+
+    return walk(tree)
+
+
+def logits_spec(shape: tuple, tp_size: int, tp_axis: str = "tp") -> P:
+    """[slots, vocab] sampling-logits spec: vocab-sharded to match the
+    vocab-split lm_head (the shards are consumed where they land), else
+    replicated when vocab doesn't tile."""
+    if tp_size > 1 and _tiles(tuple(shape), len(shape) - 1, tp_size):
+        spec = [None] * len(shape)
+        spec[-1] = tp_axis
+        return P(*spec)
+    return P()
+
+
+def tp_size_of(mesh: Mesh | None, tp_axis: str = "tp") -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(tp_axis, 1))
+
+
+def shard_engine_state(mesh: Mesh, tree: Any, specs: Any = None,
+                       tp_axis: str = "tp") -> Any:
+    """device_put a cache tree per ``cache_specs`` (or explicit
+    ``specs``): the pool lands head-sharded across the slice, per-slot
+    state replicated — ONE placement at construction, after which every
+    executable's constrained outputs keep the layout."""
+    import jax
+
+    if specs is None:
+        specs = cache_specs(tree, tp_size_of(mesh, tp_axis), tp_axis)
+
+    def walk(node, spec):
+        if isinstance(node, Mapping):
+            return {k: walk(v, spec[k]) for k, v in node.items()}
+        return jax.device_put(node, NamedSharding(mesh, spec))
+
+    return walk(tree, specs)
+
+
+def constrain_tree(mesh: Mesh, tree: Any, specs: Any) -> Any:
+    """with_sharding_constraint per leaf (traced contexts): pins an
+    executable's output layout to the engine's canonical specs, so
+    donated buffers round-trip with identical shardings and the decode
+    step can never be nudged into a recompile by a drifted input."""
+    import jax
+
+    def walk(node, spec):
+        if isinstance(node, Mapping):
+            return {k: walk(v, spec[k]) for k, v in node.items()}
+        return jax.lax.with_sharding_constraint(
+            node, NamedSharding(mesh, spec)
+        )
+
+    return walk(tree, specs)
+
+
+def replicate_put(mesh: Mesh, x: Any) -> Any:
+    """device_put one array fully replicated over the mesh (per-slot
+    host-fed state: keys ladders, counters, sampling params)."""
+    import jax
+
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def mesh_debug(mesh: Mesh | None) -> dict:
+    """The /debug/serve + /healthz mesh shape: device count and named
+    axis sizes (a fleet router's least-loaded pick can see replica
+    width). ``{"devices": 1}`` when serving single-chip."""
+    if mesh is None:
+        return {"devices": 1}
+    return {
+        "devices": int(mesh.devices.size),
+        "axes": {name: int(size) for name, size in mesh.shape.items()},
+    }

@@ -49,6 +49,14 @@ ok / partial-with-flag / typed error, and ``ttft_p99_ms`` stays under
 the deadline budget (``deadline_budget_ms``) — capacity-style
 assertions enforced by the deadline machinery, not wall-clock luck.
 
+The TP pair (``--tp N``) replays the same schedule through the
+continuous engine on an N-device ``tp`` mesh (SPMD decode: params
+tp-sharded, KV storage head-sharded, one compiled step driving the
+slice — the host-device trick supplies CPU devices, real chips on
+hardware) and through the single-device engine as baseline; the tp
+line's ``vs_baseline`` is tpN/tp1 and carries ``mesh_devices`` +
+the zero-recompile pin.
+
 All randomness is seeded (schedule, prompts); wall-clock only enters the
 timing fields, so tests assert structure and token counts, never timing.
 BENCH_SMOKE shrinks shapes for CI. Run:
@@ -56,6 +64,7 @@ BENCH_SMOKE shrinks shapes for CI. Run:
     JAX_PLATFORMS=cpu python tools/serve_bench.py            # all legs
     python tools/serve_bench.py --engine continuous          # one leg
     python tools/serve_bench.py --engine chaos               # chaos mix
+    python tools/serve_bench.py --tp 2                       # SPMD pair
 """
 
 from __future__ import annotations
@@ -177,7 +186,8 @@ def leg_summary(name, wall_s, results, extra):
     return line
 
 
-def run_continuous(cfg, params, schedule, args) -> dict:
+def run_continuous(cfg, params, schedule, args, *, mesh=None,
+                   name="continuous") -> dict:
     from tf_operator_tpu.serve.engine import ContinuousEngine
     from tf_operator_tpu.serve.scheduler import (
         ContinuousScheduler,
@@ -190,6 +200,7 @@ def run_continuous(cfg, params, schedule, args) -> dict:
     engine = ContinuousEngine(
         cfg, params, max_slots=args.max_batch,
         prefill_chunk=args.prefill_chunk or None,
+        mesh=mesh,
     )
     sched = ContinuousScheduler(
         engine, prefill_tokens_per_step=args.prefill_budget
@@ -212,11 +223,43 @@ def run_continuous(cfg, params, schedule, args) -> dict:
         ) if mid else 0.0,
         "decode_steps": sched.decode_steps,
         "decode_step_compiles": engine.decode_step_compiles,
+        "warmup_compiles": engine.warmup_compiles,
         "max_batch": engine.max_slots,
         "prefill_chunk": args.prefill_chunk or None,
+        "mesh_devices": engine.mesh_info()["devices"],
     }
     sched.stop(timeout=30.0)
-    return leg_summary("continuous", wall_s, results, stats)
+    return leg_summary(name, wall_s, results, stats)
+
+
+def run_tp_legs(cfg, params, schedule, args) -> list[dict]:
+    """The SPMD tensor-parallel pair: the continuous engine on a
+    ``--tp``-device mesh (params tp-sharded by the training rules, KV
+    storage head-sharded, ONE compiled step driving every device) and
+    the single-device engine on the IDENTICAL schedule as its baseline.
+    The tp line's vs_baseline is tpN/tp1 tokens/sec. On CPU host
+    devices this measures the mechanism, not a speedup — the per-step
+    collectives cost real time against zero extra memory bandwidth; the
+    line exists so hardware rounds report the true slice number through
+    the same plumbing and so the structural pins (zero recompiles,
+    mesh>1 in the line) hold everywhere."""
+    import jax
+
+    from tf_operator_tpu.parallel.mesh import create_mesh
+
+    if len(jax.devices()) < args.tp:
+        raise SystemExit(
+            f"serve_bench: --tp {args.tp} needs {args.tp} devices, "
+            f"have {len(jax.devices())}"
+        )
+    mesh = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+    tp_line = run_continuous(cfg, params, schedule, args, mesh=mesh,
+                             name=f"tp{args.tp}")
+    base = run_continuous(cfg, params, schedule, args, name="tp1")
+    if base["value"]:
+        tp_line["vs_baseline"] = round(tp_line["value"] / base["value"],
+                                       3)
+    return [tp_line, base]
 
 
 def build_prefix_schedule(cap: dict, seed: int, vocab: int):
@@ -601,6 +644,13 @@ def main(argv: list[str] | None = None) -> int:
                         "fleet with one replica killed mid-run")
     p.add_argument("--fleet-replicas", type=int, default=4,
                    help="replica count for --engine fleet")
+    p.add_argument("--tp", type=int, default=0,
+                   help="run ONLY the SPMD tensor-parallel pair: the "
+                        "continuous engine on an N-device tp mesh vs "
+                        "the single-device engine on the identical "
+                        "schedule (vs_baseline = tpN/tp1). On CPU the "
+                        "devices are forced via the XLA host-device "
+                        "trick before jax imports")
     p.add_argument("--requests", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
@@ -629,6 +679,17 @@ def main(argv: list[str] | None = None) -> int:
         args.d_model = 32 if smoke else 64
     if smoke:
         args.prefill_chunk = min(args.prefill_chunk, 4)
+    if args.tp > 1:
+        # BEFORE the jax import below: on the CPU platform the mesh
+        # devices come from the host-device trick (a no-op flag on real
+        # hardware, where jax.devices() are the chips). ONE
+        # implementation of the raise-a-smaller-pinned-count rule —
+        # serve_tp_check owns it (bench.py's smoke mode pins 1 for its
+        # in-process sections and would otherwise starve the mesh).
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from serve_tp_check import _force_host_devices
+
+        _force_host_devices(args.tp)
 
     import jax
     import jax.numpy as jnp
@@ -661,6 +722,11 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     lines = []
+    if args.tp > 1:
+        lines = run_tp_legs(cfg, params, schedule, args)
+        for line in lines:
+            print(json.dumps(line), flush=True)
+        return 0 if all(not line["errors"] for line in lines) else 1
     if args.engine == "chaos":
         lines.append(run_chaos_leg(cfg, params, schedule, args))
     if args.engine == "fleet":

@@ -5,8 +5,12 @@ prefix-sharing pins, zero-recompile pins, scheduler drain/EOS/metrics,
 serve-bench structure), then one INLINE end-to-end pair through a live
 paged engine + scheduler — a plain paged request and a shared-prefix
 request — asserting both reproduce solo generate bit-for-bit and the
-second actually skipped its prefill. The quick loop for iterating on
-tf_operator_tpu/serve/ without paying for the whole tier-1 run.
+second actually skipped its prefill — and finally the SPMD
+tensor-parallel matrix (tools/serve_tp_check.py at tp=2 host devices:
+{dense, paged} x {one-shot, chunked} bit-identity + the supervisor
+mesh-reconstruction replay, slow-marked in tier-1 so THIS is its
+default home). The quick loop for iterating on tf_operator_tpu/serve/
+without paying for the whole tier-1 run.
 
     python tools/serve_smoke.py            # the smoke subset + e2e pair
     python tools/serve_smoke.py -k drain   # extra pytest args pass through
@@ -192,7 +196,24 @@ def main(argv: list[str] | None = None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if chaos:
         return chaos_e2e()
-    return paged_e2e_pair()
+    rc = paged_e2e_pair()
+    if rc != 0:
+        return rc
+    # The SPMD tensor-parallel matrix (slow-marked in tier-1, so the
+    # smoke is where it runs by default): {dense, paged} x {one-shot,
+    # chunked} at tp=2 host devices, bit-identical to solo generate,
+    # plus the supervisor mesh-reconstruction replay drill. A
+    # subprocess — multi-device CPU needs XLA_FLAGS before jax imports.
+    tp_env = dict(env)
+    tp_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    tp_env["PYTHONPATH"] = (
+        REPO_ROOT + os.pathsep + tp_env.get("PYTHONPATH", "")
+    )
+    return subprocess.call(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "serve_tp_check.py"), "--tp", "2"],
+        cwd=REPO_ROOT, env=tp_env,
+    )
 
 
 if __name__ == "__main__":

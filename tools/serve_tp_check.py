@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""SPMD tensor-parallel decode exactness check: the continuous engine on
+a >1-device mesh, bit-identical to solo generate, with zero decode
+recompiles — the multi-chip half of the PR-5 exactness matrix, runnable
+anywhere via the XLA host-device trick.
+
+Proves, at ``--tp`` devices (default 2, forced as CPU host devices
+BEFORE jax imports so the check needs no hardware):
+
+- engine greedy output == solo ``generate`` with the SAME tp-sharded
+  params, bit-for-bit, for every cell of {dense, paged} x {one-shot,
+  chunked prefill}, across join/retire mid-decode, slot reuse, sampled
+  (temperature + seeded rng) slots, and — paged — shared-prefix
+  admission;
+- the KV storage is REALLY sharded: each device's addressable shard
+  holds KV/tp heads (the per-chip cache footprint divides by tp);
+- ``decode_step_compiles == warmup_compiles`` at the end of every cell
+  (occupancy changes, table growth, and CoW copies never recompile at
+  tp>1, same pin as tp=1);
+- a supervised engine (EngineSupervisor) crashed mid-decode by the
+  seeded fault injector rebuilds, RECONSTRUCTS the mesh through the
+  factory, and replays the in-flight request bit-identically.
+
+Driven by tests/test_serve_tp.py (slow-marked: multi-device needs its
+own process) and tools/serve_smoke.py; run standalone:
+
+    python tools/serve_tp_check.py            # tp=2 host devices
+    python tools/serve_tp_check.py --tp 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _force_host_devices(n: int) -> None:
+    """Set the host-device flag BEFORE any jax import (it only affects
+    the CPU platform — on real hardware the mesh uses the chips). A
+    smaller pre-pinned count is RAISED, not respected: callers like
+    bench.py's smoke mode pin 1 for their own sections."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m and int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}"
+        )
+    elif not m:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
+
+
+def run_matrix(tp: int) -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+
+    if len(jax.devices()) < tp:
+        print(f"serve_tp_check: need {tp} devices, have "
+              f"{len(jax.devices())}", file=sys.stderr)
+        return 1
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(mesh, params, param_sharding_rules())
+
+    def solo(prompt, steps, *, temperature=0.0, seed=0):
+        kw = {}
+        if temperature > 0:
+            kw = dict(temperature=temperature,
+                      rng=jax.random.PRNGKey(seed))
+        return np.asarray(
+            generate(cfg, sharded, jnp.asarray(prompt), steps, **kw)
+        )[0]
+
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+
+    rng = np.random.default_rng(7)
+    failures = 0
+    for kv_paged in (False, True):
+        for chunk in (None, 4):
+            label = (f"{'paged' if kv_paged else 'dense'}/"
+                     f"{'chunked' if chunk else 'oneshot'}")
+            eng = ContinuousEngine(
+                cfg, params, max_slots=3, kv_paged=kv_paged,
+                kv_block=8, prefill_chunk=chunk, mesh=mesh,
+            )
+            # The storage is REALLY sharded: this device's shard holds
+            # KV/tp heads (KV=2 here, so 1 head per device at tp=2).
+            def kv_leaf(t):
+                from collections.abc import Mapping
+
+                for k, v in t.items():
+                    if isinstance(v, Mapping):
+                        found = kv_leaf(v)
+                        if found is not None:
+                            return found
+                    elif k in ("pool_key", "cached_key"):
+                        return v
+                return None
+
+            leaf = kv_leaf(eng._cache)
+            local_kv = leaf.addressable_shards[0].data.shape[-2]
+            assert local_kv == cfg.kv_heads // tp, (
+                f"{label}: per-device shard holds {local_kv} KV heads, "
+                f"expected {cfg.kv_heads // tp}"
+            )
+
+            # Occupancy walk: joins/retires mid-decode, slot reuse, a
+            # sampled slot, and (paged) an exact shared-prefix re-join.
+            p1 = rng.integers(0, 64, (1, 9)).astype(np.int32)
+            p2 = rng.integers(0, 64, (1, 5)).astype(np.int32)
+            plan = {"a": (p1, 10, 0.0, 0), "b": (p2, 6, 0.0, 0),
+                    "c": (p1, 8, 0.9, 3), "d": (p2, 4, 0.0, 0)}
+            joins = {2: "b", 4: "c", 12: "d"}  # step index -> join
+            live, outs = {}, {}
+            live[eng.join(jnp.asarray(p1), num_steps=10)] = ("a", 10, [])
+            i = 0
+            while live:
+                toks = eng.step()
+                i += 1
+                for s in list(live):
+                    name, n, acc = live[s]
+                    acc.append(int(toks[s]))
+                    if len(acc) == n:
+                        eng.retire(s)
+                        outs[name] = acc
+                        del live[s]
+                if i in joins:
+                    name = joins[i]
+                    p, n, t, seed = plan[name]
+                    s = eng.join(jnp.asarray(p), num_steps=n,
+                                 temperature=t, seed=seed)
+                    assert s is not None, f"{label}: no slot for {name}"
+                    live[s] = (name, n, [])
+            for name, (p, n, t, seed) in plan.items():
+                want = solo(p, n, temperature=t, seed=seed)
+                if not np.array_equal(np.asarray(outs[name]), want):
+                    print(f"serve_tp_check: {label} request {name} "
+                          f"DIVERGED from solo generate", file=sys.stderr)
+                    failures += 1
+            if eng.decode_step_compiles != eng.warmup_compiles:
+                print(f"serve_tp_check: {label} recompiled "
+                      f"({eng.decode_step_compiles} != warmup "
+                      f"{eng.warmup_compiles})", file=sys.stderr)
+                failures += 1
+            saved = getattr(eng, "prefill_tokens_saved", 0)
+            if kv_paged and saved < p1.shape[1]:
+                print(f"serve_tp_check: {label} shared-prefix admission "
+                      f"saved only {saved} tokens", file=sys.stderr)
+                failures += 1
+            print(f"serve_tp_check: {label} ok "
+                  f"(kv/device {local_kv}, compiles "
+                  f"{eng.decode_step_compiles}=warmup, saved {saved})",
+                  flush=True)
+    return failures
+
+
+def run_supervisor_replay(tp: int) -> int:
+    """Crash a supervised tp engine mid-decode: the rebuild reconstructs
+    the mesh (same factory, same shardings) and the replay is
+    bit-identical."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(mesh, params, param_sharding_rules())
+    inj = FaultInjector(seed=1)
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=2, kv_block=8,
+                                 mesh=mesh, faults=inj),
+        resilience=ResilienceConfig(watchdog_stall_s=10.0,
+                                    restart_backoff_s=0.05,
+                                    max_restarts=3),
+        faults=inj,
+    )
+    try:
+        prompt = np.random.default_rng(9).integers(
+            0, cfg.vocab_size, (1, 11)
+        ).astype(np.int32)
+        want = np.asarray(
+            generate(cfg, sharded, jnp.asarray(prompt), 24)
+        )
+        if not np.array_equal(sup.submit(prompt, 24), want):
+            print("serve_tp_check: pre-crash output != solo",
+                  file=sys.stderr)
+            return 1
+        inj.arm(f"step_raise@{inj.invocations['step_raise'] + 6}")
+        out = sup.submit(prompt, 24, timeout=180)
+        if sup.restarts != 1:
+            print(f"serve_tp_check: expected 1 restart, got "
+                  f"{sup.restarts}", file=sys.stderr)
+            return 1
+        if not np.array_equal(out, want):
+            print("serve_tp_check: post-crash replay != solo",
+                  file=sys.stderr)
+            return 1
+        if sup.engine.decode_step_compiles != \
+                sup.engine.warmup_compiles:
+            print("serve_tp_check: rebuilt engine recompiled",
+                  file=sys.stderr)
+            return 1
+        if sup.mesh_devices != tp:
+            print(f"serve_tp_check: rebuilt mesh width "
+                  f"{sup.mesh_devices} != {tp}", file=sys.stderr)
+            return 1
+        print(f"serve_tp_check: supervisor replay ok (1 restart, "
+              f"mesh reconstructed at {tp} devices, replay "
+              f"bit-identical)", flush=True)
+        return 0
+    finally:
+        sup.stop(timeout=30.0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tp", type=int, default=2,
+                   help="mesh width (forced as CPU host devices when "
+                        "the platform is CPU)")
+    p.add_argument("--skip-supervisor", action="store_true",
+                   help="matrix only (the replay drill builds 2+ more "
+                        "engines)")
+    args = p.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _force_host_devices(args.tp)
+    failures = run_matrix(args.tp)
+    if not args.skip_supervisor:
+        failures += run_supervisor_replay(args.tp)
+    if failures:
+        print(f"serve_tp_check: FAIL ({failures} failure(s))",
+              file=sys.stderr)
+        return 1
+    print(f"serve_tp_check: OK (tp={args.tp}, matrix + supervisor "
+          f"replay bit-identical, zero post-warmup recompiles)",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
