@@ -324,6 +324,13 @@ def main(argv: list[str] | None = None) -> int:
                           "TPU_SERVE_FAULTS env var")
     res.add_argument("--fault-seed", type=int, default=0,
                      help="seed for probabilistic fault entries")
+    p.add_argument("--trace-capacity", type=int, default=8192,
+                   metavar="SPANS",
+                   help="bounded ring of request-scoped data-plane "
+                        "trace spans exported at /debug/traces "
+                        "(Chrome-trace JSON; evictions counted in "
+                        "tpu_trace_spans_dropped_total). 0 disables "
+                        "tracing entirely")
     args = p.parse_args(argv)
     # --tp is NOT in this list: tensor-parallel decode is a first-class
     # continuous-engine mode (PR 10 — the SPMD slot tensor; one compiled
@@ -528,6 +535,14 @@ def main(argv: list[str] | None = None) -> int:
             out = generate(cfg, params, rows, num_steps=num_steps)
         return out
 
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
+
+    if args.trace_capacity != SERVE_TRACER.capacity:
+        SERVE_TRACER.set_capacity(args.trace_capacity)
+        print(f"serve_lm: trace ring "
+              f"{'disabled' if args.trace_capacity <= 0 else args.trace_capacity}",
+              flush=True)
+
     served = 0
     done = threading.Event()
     lock = threading.Lock()  # generate() calls serialized per chip
@@ -683,6 +698,12 @@ def main(argv: list[str] | None = None) -> int:
                 # The same payload serve/httpapi.py mounts on an operator
                 # ApiServer — one shape for dashboards either way.
                 self._json(200, engine_sched.debug_snapshot())
+            elif self.path == "/debug/traces":
+                # The data-plane trace ring (queue wait / prefill /
+                # decode intervals / watchdog restarts, keyed by
+                # request_id) as Chrome-trace JSON; a fleet router or
+                # tpuctl trace merges several replicas' exports.
+                self._json(200, SERVE_TRACER.export_doc())
             elif self.path == "/metrics":
                 from tf_operator_tpu.runtime.metrics import REGISTRY
 
@@ -798,6 +819,14 @@ def main(argv: list[str] | None = None) -> int:
 
                     eos_id = req.get("eos_id")
                     deadline_s = req.get("deadline_s")
+                    # Request identity for tracing: client-supplied
+                    # (body field or X-Request-Id header) or minted
+                    # here; multi-row fan-outs suffix the row index so
+                    # each slot request stays individually traceable
+                    # while the response keys on the parent id.
+                    rid = (req.get("request_id")
+                           or self.headers.get("X-Request-Id")
+                           or mint_request_id())
 
                     def _row(i):
                         r = ServeRequest(
@@ -816,6 +845,8 @@ def main(argv: list[str] | None = None) -> int:
                                     else int(eos_id)),
                             deadline_s=(None if deadline_s is None
                                         else float(deadline_s)),
+                            request_id=(rid if i == 0
+                                        else f"{rid}.{i}"),
                         )
                         return engine_sched.submit_request(r)
 
@@ -837,7 +868,12 @@ def main(argv: list[str] | None = None) -> int:
                                 ex.map(_row, range(prompt.shape[0]))
                             )
                     out = [list(r.out) for r in rows]
-                    payload = {"tokens": out}
+                    payload = {"tokens": out, "request_id": rid}
+                    if req.get("timing"):
+                        # Opt-in compact latency attribution per row:
+                        # queue/prefill/decode ms + ITL summary (the
+                        # span-level story lives at /debug/traces).
+                        payload["timing"] = [r.timing() for r in rows]
                     if any(r.deadline_exceeded for r in rows):
                         # Partial generations: the deadline (or bounded
                         # drain) cut these rows short — the tokens are

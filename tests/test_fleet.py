@@ -592,6 +592,173 @@ def test_fleet_of_registers_and_probe_sweep_promotes():
 
 
 # ---------------------------------------------------------------------------
+# request-id propagation + fleet trace merge (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def test_request_id_minted_at_router_propagates_and_traces():
+    """The tentpole's fleet hop, end to end without jax: a request with
+    no id enters the RouterServer, the router mints one, the replica's
+    spans carry it, the response echoes it, and the router's
+    /debug/traces merges both hops into one timeline under that id."""
+    from tf_operator_tpu.fleet.router import RouterServer, http_probe
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+    SERVE_TRACER.clear()  # process-global ring: isolate this story
+    ms = FleetMembership()
+    servers = fleet_of(2, lambda i: FakeReplicaBackend(),
+                       register_in=ms)
+    router = RouterServer(
+        ms, config=RouterConfig(probe_interval_s=30.0)
+    ).start()
+    try:
+        ms.probe(http_probe)
+        status, payload, _ = _post(
+            f"http://{router.endpoint}/generate",
+            {"tokens": [[1, 2]], "num_steps": 3},
+        )
+        assert status == 200
+        rid = payload["request_id"]
+        assert rid and len(rid) == 16
+
+        dispatch = [s for s in SERVE_TRACER.spans("router.dispatch")
+                    if s.attrs.get("request_id") == rid]
+        handled = [s for s in SERVE_TRACER.spans("replica.request")
+                   if s.attrs.get("request_id") == rid]
+        assert dispatch and handled, "both hops must span under the id"
+        assert handled[0].attrs["replica"] == payload["replica"]
+
+        # The merged fleet trace at the router front: both hop spans
+        # under one id, sources labeled (router + each live replica).
+        _, merged = _get(f"http://{router.endpoint}/debug/traces")
+        spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"
+                 and e.get("args", {}).get("request_id") == rid]
+        assert {"router.dispatch", "replica.request"} <= {
+            e["name"] for e in spans
+        }
+        sources = {e["args"]["name"] for e in merged["traceEvents"]
+                   if e.get("ph") == "M"}
+        assert "router" in sources
+        assert any(s.startswith("replica:rep") for s in sources)
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_client_supplied_request_id_respected_end_to_end():
+    from tf_operator_tpu.fleet.router import RouterServer, http_probe
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+    SERVE_TRACER.clear()
+    ms = FleetMembership()
+    servers = fleet_of(1, lambda i: FakeReplicaBackend(),
+                       register_in=ms)
+    router = RouterServer(
+        ms, config=RouterConfig(probe_interval_s=30.0)
+    ).start()
+    try:
+        ms.probe(http_probe)
+        # Body spelling.
+        status, payload, _ = _post(
+            f"http://{router.endpoint}/generate",
+            {"tokens": [[1]], "num_steps": 2,
+             "request_id": "client-chose-this"},
+        )
+        assert status == 200
+        assert payload["request_id"] == "client-chose-this"
+        assert [s for s in SERVE_TRACER.spans("replica.request")
+                if s.attrs.get("request_id") == "client-chose-this"]
+        # Header spelling (X-Request-Id) through the router front.
+        req = urllib.request.Request(
+            f"http://{router.endpoint}/generate",
+            data=json.dumps({"tokens": [[1]], "num_steps": 2}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "hdr-id-42"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            out = json.loads(r.read())
+        assert out["request_id"] == "hdr-id-42"
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_tpuctl_trace_merges_fleet(capsys):
+    """``tpuctl trace NS/FLEET``: replica endpoints read from the
+    master's /debug/fleet, each live replica's /debug/traces fetched
+    and merged into one catapult JSON on stdout."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tf_operator_tpu.cli.tpuctl import main as tpuctl_main
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+    SERVE_TRACER.clear()
+    replica = ReplicaServer(FakeReplicaBackend(), replica_id="ct0").start()
+    _post(f"http://{replica.endpoint}/generate",
+          {"tokens": [[1]], "num_steps": 2, "request_id": "ctl-req"})
+
+    fleet_snap = {"fleets": {"default/chat": {"membership": {
+        "replicas": [
+            {"id": "ct0", "state": "ready", "endpoint": replica.endpoint},
+            {"id": "ct1", "state": "dead", "endpoint": "127.0.0.1:1"},
+        ],
+    }}}}
+
+    class Master(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(fleet_snap).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    master = ThreadingHTTPServer(("127.0.0.1", 0), Master)
+    import threading as _threading
+
+    _threading.Thread(target=master.serve_forever, daemon=True).start()
+    try:
+        rc = tpuctl_main([
+            "--master", f"http://127.0.0.1:{master.server_address[1]}",
+            "trace", "chat",  # bare name resolves when unambiguous
+        ])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert any(e["args"].get("request_id") == "ctl-req"
+                   for e in spans)
+        assert any(n.startswith("replica:ct0") for n in doc["sources"])
+        # The dead replica was skipped, not fetched.
+        assert not any(n == "replica:ct1" for n in doc["sources"])
+    finally:
+        master.shutdown()
+        master.server_close()
+        replica.stop()
+
+
+def test_replica_server_serves_trace_doc():
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+    SERVE_TRACER.clear()
+    server = ReplicaServer(FakeReplicaBackend(), replica_id="tr0").start()
+    try:
+        _post(f"http://{server.endpoint}/generate",
+              {"tokens": [[1]], "num_steps": 1})
+        _, doc = _get(f"http://{server.endpoint}/debug/traces")
+        assert doc["process"] == "tpu-serve"
+        assert doc["epochUnixUs"] > 0 and "droppedSpans" in doc
+        assert any(e.get("name") == "replica.request"
+                   for e in doc["traceEvents"])
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
 # scheduler: draining serve gangs are preemption-exempt
 # ---------------------------------------------------------------------------
 

@@ -126,6 +126,104 @@ def test_tracer_ring_bounded_and_disable():
     assert len(tr.spans()) == 2
 
 
+def test_tracer_counts_ring_evictions():
+    from tf_operator_tpu.runtime.metrics import TRACE_SPANS_DROPPED
+
+    tr = Tracer(capacity=3, process_name="drop-probe")
+    before = TRACE_SPANS_DROPPED.value(tracer="drop-probe")
+    for i in range(5):
+        with tr.span(f"s{i}"):
+            pass
+    assert tr.dropped == 2  # 5 appends into a 3-slot ring
+    assert TRACE_SPANS_DROPPED.value(tracer="drop-probe") - before == 2
+    assert tr.export_doc()["droppedSpans"] == 2
+    tr.clear()
+    assert tr.dropped == 0
+
+
+def test_tracer_set_capacity_resizes_and_zero_disables():
+    tr = Tracer(capacity=8)
+    for i in range(4):
+        with tr.span(f"s{i}"):
+            pass
+    tr.set_capacity(2)  # newest survive the shrink
+    assert [s.name for s in tr.spans()] == ["s2", "s3"]
+    assert tr.capacity == 2
+    tr.set_capacity(0)
+    assert not tr.enabled
+    with tr.span("hidden"):
+        pass
+    tr.record("hidden2", 0.0, 1.0)
+    assert tr.spans() == []
+    tr.set_capacity(16)
+    assert tr.enabled and tr.capacity == 16
+
+
+def test_tracer_record_explicit_stamps_and_ordering():
+    import time as _time
+
+    tr = Tracer(capacity=8)
+    t0 = _time.monotonic()
+    tr.record("later", t0 + 0.5, t0 + 0.6, request_id="r1")
+    tr.record("earlier", t0 + 0.1, t0 + 0.2, request_id="r1")
+    doc = tr.export_doc()
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["earlier"]["ts"] < by_name["later"]["ts"]
+    assert abs(by_name["later"]["dur"] - 1e5) < 1e4  # ~100ms in us
+    assert by_name["later"]["args"]["request_id"] == "r1"
+    # End-before-start clamps to zero rather than exporting negative dur.
+    tr.record("clamped", t0 + 1.0, t0 + 0.5)
+    assert tr.spans("clamped")[0].duration_us == 0.0
+
+
+def test_tracer_sanitizes_weird_attr_values():
+    tr = Tracer(capacity=4)
+    evil = "tok\x00en\nnew\ud800line" + "x" * 1000
+    with tr.span("prompt", text=evil, n=7):
+        pass
+    doc_str = tr.export_chrome_trace()
+    doc = json.loads(doc_str)  # never corrupted
+    args = [e for e in doc["traceEvents"] if e["ph"] == "X"][0]["args"]
+    assert "\x00" not in args["text"] and "\n" not in args["text"]
+    assert "\ud800" not in args["text"]
+    assert len(args["text"]) <= 256 + 3
+    assert args["n"] == "7"
+    # The raw export string is strict-JSON safe (no lone surrogates).
+    doc_str.encode("utf-8")
+
+
+def test_merge_chrome_traces_rebases_dedupes_and_labels_pids():
+    from tf_operator_tpu.runtime.tracing import merge_chrome_traces
+
+    a, b = Tracer(process_name="router"), Tracer(process_name="replica")
+    # Pretend b's process started 1s later on the wall clock.
+    b._epoch_unix = a._epoch_unix + 1.0
+    a.record("router.dispatch", a._epoch + 0.010, a._epoch + 0.020,
+             request_id="req1")
+    b.record("replica.request", b._epoch + 0.012, b._epoch + 0.018,
+             request_id="req1")
+    merged = merge_chrome_traces([
+        ("router", a.export_doc()),
+        ("replica:r0", b.export_doc()),
+        ("replica:r1", b.export_doc()),  # same ring fetched twice
+    ])
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 2  # the duplicate fetch deduped
+    by_name = {e["name"]: e for e in spans}
+    # b's span rebased +1s onto a's epoch: it lands AFTER a's, on its
+    # own pid.
+    assert by_name["replica.request"]["ts"] > by_name[
+        "router.dispatch"]["ts"]
+    assert by_name["replica.request"]["pid"] != by_name[
+        "router.dispatch"]["pid"]
+    assert all(e["args"]["request_id"] == "req1" for e in spans)
+    names = {e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M"}
+    assert {"router", "replica:r0", "replica:r1"} <= names
+    assert merge_chrome_traces([])["traceEvents"] == []
+
+
 # ---------------------------------------------------------------------------
 # live endpoints on a real operator process
 # ---------------------------------------------------------------------------

@@ -223,6 +223,56 @@ def test_debug_snapshot_shape(params):
         sched.stop(timeout=30)
 
 
+def test_traced_request_bit_identical_with_spans_and_no_recompile(params):
+    """The tier-1 tracing pin: with the data-plane tracer ON (the
+    default), a request through a real engine (paged + chunked prefix
+    so the span set is maximal) produces bit-identical output, zero
+    post-warmup recompiles, a queue→prefill→decode span chain under its
+    request id, and a per-request timing breakdown that adds up."""
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+    SERVE_TRACER.clear()
+    assert SERVE_TRACER.enabled  # tracing on by default — that IS the pin
+    engine = ContinuousEngine(CFG, params, max_slots=2, kv_paged=True,
+                              kv_block=8, prefill_chunk=4)
+    sched = ContinuousScheduler(engine).start()
+    try:
+        prompt = prompt_of(11, 21)
+        want = solo(params, prompt, 12)
+        req = sched.submit_request(
+            ServeRequest(prompt, 12, request_id="traced-req-1")
+        )
+        assert np.array_equal(
+            np.asarray(req.out, np.int32).reshape(1, -1), want
+        )
+        assert engine.decode_step_compiles == engine.warmup_compiles
+
+        mine = [s for s in SERVE_TRACER.spans()
+                if s.attrs.get("request_id") == "traced-req-1"]
+        names = [s.name for s in mine]
+        assert "queue.wait" in names
+        assert "admit.plan" in names
+        assert "prefill.chunk" in names or "prefill.join" in names
+        assert "decode.interval" in names
+        # Parentage-by-time: the request's phases are ordered and the
+        # decode interval aggregates steps (never one span per token).
+        start_of = {s.name: s.start_us for s in mine}
+        assert start_of["queue.wait"] <= start_of["admit.plan"]
+        assert start_of["admit.plan"] <= min(
+            s.start_us for s in mine if s.name.startswith("prefill")
+        )
+        decode = [s for s in mine if s.name == "decode.interval"]
+        assert sum(int(s.attrs["tokens"]) for s in decode) == 12
+        assert len(decode) < 12
+
+        t = req.timing()
+        assert t["request_id"] == "traced-req-1"
+        assert t["decode_ms"] > 0 and t["prefill_ms"] > 0
+        assert t["itl_mean_ms"] >= 0 and len(req.itl_values()) == 11
+    finally:
+        sched.stop(timeout=30.0)
+
+
 def test_serve_bench_emits_structural_line():
     """tools/serve_bench.py (BENCH_SMOKE shapes): both legs emit JSON,
     token counts agree across engines (same seeded schedule, greedy —
@@ -252,8 +302,14 @@ def test_serve_bench_emits_structural_line():
     assert cont["decode_step_compiles"] == 1
     assert 0.0 < cont["mean_occupancy"] <= 1.0
     assert cont["vs_baseline"] > 0  # the ratio line is populated
-    for key in ("ttft_p50_ms", "ttft_p99_ms", "steady_occupancy"):
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "steady_occupancy",
+                "itl_p50_ms", "itl_p99_ms"):
         assert key in cont, key
+    # Both engines report ITL (the ROADMAP item-2 interference pin's
+    # baseline); the continuous engine's comes from real decode-step
+    # gaps, so under load it must be a positive number.
+    assert cont["itl_p99_ms"] > 0
+    assert "itl_p50_ms" in coal and "itl_p99_ms" in coal
     # The capacity mix: paged vs dense at one byte budget.
     paged = by_metric["serve_paged_longctx_tokens_per_sec_mixed"]
     dense = by_metric["serve_dense_longctx_tokens_per_sec_mixed"]

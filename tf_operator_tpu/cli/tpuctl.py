@@ -15,6 +15,7 @@ ctl. Commands mirror the kubectl verbs users already know:
     tpuctl queue [-o json]                  # gang-admission queue/capacity
     tpuctl health [-o json]                 # fleet health: cell states
     tpuctl ckpt [-o json]                   # checkpoint registry: acked steps
+    tpuctl trace NS/FLEET [--router H:P]    # merged fleet Chrome trace → stdout
     tpuctl cordon v4 0,0,0 0,0,1            # pin cells out of placement
     tpuctl uncordon v4 0,0,0 0,0,1          # return cells to service
     tpuctl drain v4 0,0,0 --at 3600         # maintenance notice + migrate
@@ -541,6 +542,70 @@ def cmd_serve(args, master: str) -> int:
     return 0
 
 
+def cmd_trace(args, master: str) -> int:
+    """Assemble ONE fleet-wide Chrome trace on stdout: /debug/traces
+    fetched from every live replica of a TPUServe fleet (endpoints read
+    from the operator's /debug/fleet membership) plus any ``--router``
+    front, merged by wall-clock epoch and keyed by the ``request_id``
+    span attribute — pipe to a file and load at ui.perfetto.dev."""
+    from tf_operator_tpu.fleet.router import http_fetch_traces
+    from tf_operator_tpu.runtime.tracing import merge_chrome_traces
+
+    snap = _health_request(master, "/debug/fleet")
+    fleets = snap.get("fleets") or {}
+    fleet = fleets.get(args.fleet)
+    if fleet is None and "/" not in args.fleet:
+        # Accept the bare name when it is unambiguous (keys are ns/name).
+        matches = [k for k in fleets if k.split("/", 1)[-1] == args.fleet]
+        if len(matches) == 1:
+            fleet = fleets[matches[0]]
+    if fleet is None:
+        raise SystemExit(
+            f"tpuctl: no TPUServe fleet {args.fleet!r} "
+            f"(known: {', '.join(sorted(fleets)) or 'none'})"
+        )
+
+    docs = []
+    if args.router:
+        try:
+            docs.append(("router", http_fetch_traces(args.router)))
+        except (OSError, ValueError) as exc:
+            print(f"tpuctl: router {args.router} unreachable: {exc}",
+                  file=sys.stderr)
+    skipped = []
+    live = [rep for rep in
+            (fleet.get("membership") or {}).get("replicas") or []
+            if rep.get("state") != "dead" and rep.get("endpoint")]
+    if live:
+        from concurrent.futures import ThreadPoolExecutor
+
+        def fetch_one(rep):
+            try:
+                # The router's own fetch helper — one implementation of
+                # the /debug/traces wire contract.
+                return rep, http_fetch_traces(rep["endpoint"])
+            except (OSError, ValueError):
+                return rep, None
+
+        # Concurrent like the router's merge: a wedged replica costs
+        # one timeout, not one per replica.
+        with ThreadPoolExecutor(min(8, len(live))) as pool:
+            for rep, doc in pool.map(fetch_one, live):
+                if doc is None:
+                    skipped.append(rep.get("id"))
+                else:
+                    docs.append((f"replica:{rep.get('id')}", doc))
+    if skipped:
+        print(f"tpuctl: skipped unreachable replica(s): "
+              f"{', '.join(str(s) for s in skipped)}", file=sys.stderr)
+    merged = merge_chrome_traces(docs)
+    print(f"tpuctl: merged {len(docs)} source(s), "
+          f"{sum(1 for e in merged['traceEvents'] if e.get('ph') == 'X')}"
+          f" span(s)", file=sys.stderr)
+    print(json.dumps(merged))
+    return 0
+
+
 def cmd_cordon(args, master: str, verb: str) -> int:
     """cordon/uncordon/drain: POST the verb to the operator. Drain carries
     a maintenance deadline (--at seconds from now) — the injected stand-in
@@ -658,6 +723,15 @@ def main(argv: list[str] | None = None) -> int:
                              "autoscale targets")
     sv.add_argument("-o", "--output", choices=("table", "json"),
                     default="table")
+
+    tr = sub.add_parser("trace",
+                        help="merge a TPUServe fleet's /debug/traces "
+                             "into one Chrome trace on stdout")
+    tr.add_argument("fleet", help="fleet key (NS/NAME, or bare NAME "
+                                  "when unambiguous)")
+    tr.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="also include this fleet router front's "
+                         "/debug/traces (dispatch/failover spans)")
     for verb, help_text in (
         ("cordon", "withdraw mesh cells from placement (operator-pinned)"),
         ("uncordon", "return mesh cells to service"),
@@ -683,6 +757,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_ckpt(args, args.master)
     if args.cmd == "serve":
         return cmd_serve(args, args.master)
+    if args.cmd == "trace":
+        return cmd_trace(args, args.master)
     if args.cmd in ("cordon", "uncordon", "drain"):
         return cmd_cordon(args, args.master, args.cmd)
     client = TPUJobClient(RestClusterClient(args.master))

@@ -31,9 +31,11 @@ failure the router's failover path exists for.
 from __future__ import annotations
 
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from typing import Any
 
+from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
 from tf_operator_tpu.serve.httpapi import QuietHandler, readiness_payload
 from tf_operator_tpu.serve.resilience import (
     Draining,
@@ -111,6 +113,10 @@ class SupervisorBackend:
                 top_p=body.get("top_p"),
                 seed=int(body.get("seed", 0)),
                 deadline_s=body.get("deadline_s"),
+                # The fleet hop: the router-minted (or client-supplied)
+                # id becomes the scheduler/engine span key, so the
+                # merged trace follows one request across processes.
+                request_id=body.get("request_id"),
             )
         except (KeyError, ValueError, TypeError) as exc:
             return 400, {"error": str(exc), "code": "bad_request",
@@ -128,6 +134,11 @@ class SupervisorBackend:
             payload["timeout_cause"] = [req.timeout_cause]
         if req.degraded:
             payload["degraded"] = [True]
+        if body.get("timing"):
+            # Compact per-request latency attribution (queue/prefill/
+            # decode ms + ITL summary) — opt-in, one list entry per row
+            # to match the tokens shape.
+            payload["timing"] = [req.timing()]
         return 200, payload
 
 
@@ -220,6 +231,8 @@ class ReplicaServer:
                     outer.backend, "debug_snapshot"
                 ):
                     self.send_json(200, outer.backend.debug_snapshot())
+                elif path == "/debug/traces":
+                    self.send_serve_traces()
                 elif path == "/metrics":
                     self.send_metrics()
                 else:
@@ -237,19 +250,37 @@ class ReplicaServer:
                                          "retryable": False,
                                          "replica": outer.replica_id})
                     return
+                # Accept the upstream id (router-minted, or the
+                # client's own via body/header) or mint here: the
+                # replica HTTP hop is traced either way.
+                rid = (body.get("request_id")
+                       or self.headers.get("X-Request-Id")
+                       or mint_request_id())
+                body["request_id"] = rid
                 if outer._draining:
                     exc = Draining("replica draining (scale-down or "
                                    "rolling update)")
                     payload = error_payload(exc)
                     payload["replica"] = outer.replica_id
+                    payload["request_id"] = rid
                     self.send_json(exc.http_status, payload)
                     return
+                t0 = time.monotonic()
                 status, payload = outer.backend.handle(body)
+                # The replica-side hop span: even a jax-free fake
+                # backend appears in the fleet trace (the propagation
+                # tests key on this).
+                SERVE_TRACER.record(
+                    "replica.request", t0, time.monotonic(),
+                    request_id=rid, replica=outer.replica_id,
+                    status=status,
+                )
                 # Attribute every answer, success or typed error —
                 # several replicas share this process, so the
                 # process-global resilience channel cannot.
                 payload = dict(payload)
                 payload["replica"] = outer.replica_id
+                payload["request_id"] = rid
                 self.send_json(status, payload)
 
         self._server = ThreadingHTTPServer((host, port), Handler)

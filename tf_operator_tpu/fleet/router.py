@@ -41,16 +41,22 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from typing import Any, Callable
 
-from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+from tf_operator_tpu.fleet.membership import DEAD, FleetMembership, Replica
 from tf_operator_tpu.runtime.metrics import (
     FLEET_ROUTER_FAILOVERS,
     FLEET_ROUTER_REQUESTS,
     FLEET_ROUTER_RETRIES,
+)
+from tf_operator_tpu.runtime.tracing import (
+    SERVE_TRACER,
+    merge_chrome_traces,
+    mint_request_id,
 )
 from tf_operator_tpu.utils import logger
 
@@ -105,6 +111,11 @@ class FleetRouter:
         typed, including "no routable replicas" (503, retryable: the
         controller may be replacing a replica right now)."""
         timeout = timeout or self.cfg.request_timeout_s
+        # Mint (or accept) the fleet-wide request id HERE — the router
+        # is the first hop; the replica threads it into the scheduler's
+        # spans, and the merged trace follows it end to end.
+        rid = body.get("request_id") or mint_request_id()
+        body = dict(body, request_id=rid)
         with self._lock:
             self.requests += 1
         exclude: set[str] = set()
@@ -131,10 +142,16 @@ class FleetRouter:
                 )
             attempts += 1
             self.membership.begin(rep.id)
+            t_send = time.monotonic()
             try:
                 status, payload = self._send(rep, body, timeout)
             except Exception as exc:  # noqa: BLE001 — transport failure:
                 # the replica did not answer at all; it may be mid-death.
+                SERVE_TRACER.record(
+                    "router.dispatch", t_send, time.monotonic(),
+                    request_id=rid, replica=rep.id, attempt=attempts,
+                    outcome="transport_error",
+                )
                 self.membership.probe_failed(rep.id)
                 with self._lock:
                     self.failovers += 1
@@ -146,13 +163,19 @@ class FleetRouter:
                 last = (503, {
                     "error": f"replica unreachable: {exc!r}",
                     "code": "replica_unreachable", "retryable": True,
-                    "replica": rep.id,
+                    "replica": rep.id, "request_id": rid,
                 })
                 continue
             finally:
                 self.membership.end(rep.id)
             payload = dict(payload)
             payload.setdefault("replica", rep.id)
+            payload.setdefault("request_id", rid)
+            SERVE_TRACER.record(
+                "router.dispatch", t_send, time.monotonic(),
+                request_id=rid, replica=rep.id, attempt=attempts,
+                status=status, code=payload.get("code", ""),
+            )
             if status < 400:
                 FLEET_ROUTER_REQUESTS.inc(outcome="ok")
                 return status, payload
@@ -185,7 +208,7 @@ class FleetRouter:
         return 503, {
             "error": "no routable replicas",
             "code": "no_replica", "retryable": True, "retry_after_s": 1.0,
-            "attempts": attempts,
+            "attempts": attempts, "request_id": rid,
         }
 
     def snapshot(self) -> dict[str, Any]:
@@ -232,6 +255,48 @@ def http_probe(endpoint: str, timeout: float = 2.0) -> dict:
         return json.loads(resp.read() or b"{}")
 
 
+def http_fetch_traces(endpoint: str, timeout: float = 3.0) -> dict:
+    """GET one serve surface's /debug/traces (a catapult document with
+    the ``epochUnixUs`` merge metadata)."""
+    with urllib.request.urlopen(
+        f"http://{endpoint}/debug/traces", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def merged_fleet_traces(membership: FleetMembership,
+                        fetch_fn: Callable[[str], dict] = http_fetch_traces,
+                        *, router_doc: dict | None = None) -> dict:
+    """THE fleet-trace merge: the router's own ring plus every known
+    replica's /debug/traces, rebased onto one timeline and keyed by the
+    ``request_id`` span attribute (dead replicas are skipped silently —
+    their process is gone, their spans live on in the ring they already
+    shipped... nowhere; the router-side dispatch spans still tell the
+    failover story). Shared by RouterServer's /debug/traces and
+    ``tpuctl trace``."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    docs: list[tuple[str, dict]] = [
+        ("router", router_doc if router_doc is not None
+         else SERVE_TRACER.export_doc())
+    ]
+    live = [rep for rep in membership.all() if rep.state != DEAD]
+    if live:
+        # Concurrent fetch, the PR 9 probe-sweep rule: one wedged
+        # (non-DEAD) replica must not stall the handler for its whole
+        # timeout times the fleet size.
+        def fetch(rep):
+            try:
+                return f"replica:{rep.id}", fetch_fn(rep.endpoint)
+            except Exception:  # noqa: BLE001 — a probe-sized best
+                # effort; an unreachable replica must not fail the
+                # whole merge.
+                return None
+        with ThreadPoolExecutor(min(8, len(live))) as pool:
+            docs.extend(d for d in pool.map(fetch, live) if d)
+    return merge_chrome_traces(docs)
+
+
 class RouterServer:
     """The stdlib HTTP front: /generate forwarded through the router,
     /healthz the fleet aggregate (ok while anything is routable),
@@ -243,6 +308,7 @@ class RouterServer:
                  config: RouterConfig | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  probe_fn: Callable[[str], dict] | None = None,
+                 trace_fn: Callable[[str], dict] | None = None,
                  extra_debug: Callable[[], dict] | None = None) -> None:
         from http.server import ThreadingHTTPServer
 
@@ -255,6 +321,7 @@ class RouterServer:
         self._probe_fn = probe_fn or (
             lambda ep: http_probe(ep, cfg.probe_timeout_s)
         )
+        self._trace_fn = trace_fn or http_fetch_traces
         self._extra_debug = extra_debug
         self._stop = threading.Event()
         outer = self
@@ -271,6 +338,13 @@ class RouterServer:
                     })
                 elif path == "/debug/fleet":
                     self.send_json(200, outer.debug_snapshot())
+                elif path == "/debug/traces":
+                    # The FLEET timeline: router dispatch spans merged
+                    # with every live replica's ring, one pid per
+                    # source, rebased to one clock — filter on a
+                    # request_id arg in ui.perfetto.dev to follow one
+                    # request across the hop.
+                    self.send_json(200, outer.merged_traces())
                 elif path == "/metrics":
                     self.send_metrics()
                 else:
@@ -287,6 +361,11 @@ class RouterServer:
                                          "code": "bad_request",
                                          "retryable": False})
                     return
+                # X-Request-Id is the client-facing spelling; the body
+                # field is the wire spelling the fleet uses internally.
+                rid = self.headers.get("X-Request-Id")
+                if rid and not body.get("request_id"):
+                    body["request_id"] = rid
                 status, payload = outer.router.route(body)
                 self.send_json(status, payload)
 
@@ -306,6 +385,9 @@ class RouterServer:
         if self._extra_debug is not None:
             snap.update(self._extra_debug())
         return snap
+
+    def merged_traces(self) -> dict:
+        return merged_fleet_traces(self.membership, self._trace_fn)
 
     def start(self) -> "RouterServer":
         serve = threading.Thread(

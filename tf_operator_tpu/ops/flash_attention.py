@@ -125,6 +125,9 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # still queued at the front of window_autorun's unmeasured set for the
 # next hardware window, and the dispatch_auto-vs-direct_bq1024 revert
 # trigger above stays armed.
+# Re-checked (PR 11, 2026-08-03): unchanged — no new hardware window
+# since r05 (docs/window_r05 is still the newest; only the single-shot
+# flashblocks line exists). Trigger stays OPEN; cap stays 1024.
 MAX_Q_BLOCK = 1024
 
 

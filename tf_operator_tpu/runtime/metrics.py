@@ -446,6 +446,23 @@ SERVE_TTFT_SECONDS = REGISTRY.histogram(
     "tpu_serve_ttft_seconds",
     "Submit-to-first-generated-token wall time per request",
 )
+SERVE_ITL_SECONDS = REGISTRY.histogram(
+    "tpu_serve_itl_seconds",
+    "Inter-token latency: gap between consecutive generated tokens of "
+    "one request, observed per retired request from its decode-step "
+    "timestamps (the tail a streaming client actually feels; prefill "
+    "interference on decode slots shows up HERE first)",
+)
+SERVE_PHASE_SECONDS = REGISTRY.counter(
+    "tpu_serve_phase_seconds_total",
+    "Cumulative host-observed device time by serving phase: prefill = "
+    "prompt prefill slices, decode = batched decode steps, cow = "
+    "copy-on-write block copies, prefill_interference = the subset of "
+    "prefill time that ran WHILE decode slots were active (every such "
+    "second is a second stolen from live decodes — the ROADMAP item-2 "
+    "disaggregation pin reads this)",
+    ("phase",),
+)
 SERVE_STEP_SECONDS = REGISTRY.histogram(
     "tpu_serve_step_seconds",
     "Serving-loop device iterations by phase: one decode step over the "
@@ -544,4 +561,15 @@ FLEET_QUEUE_DEPTH = REGISTRY.gauge(
     "tpu_fleet_queue_depth",
     "Aggregate queued requests across routable replicas, per fleet, as "
     "of the last membership probe sweep", ("fleet",),
+)
+
+# -- tracing (runtime/tracing.py): declared here, not there, so the
+# registry module stays import-leaf and the tracer can import it --------------
+
+TRACE_SPANS_DROPPED = REGISTRY.counter(
+    "tpu_trace_spans_dropped_total",
+    "Spans evicted from a tracer's bounded ring before export, by "
+    "tracer process name — a non-zero rate means /debug/traces starts "
+    "mid-story and --trace-capacity should grow",
+    ("tracer",),
 )

@@ -13,6 +13,33 @@ Usage:
 
 Spans record wall-clock microseconds (Chrome's "ts") from the tracer's
 epoch, thread id as "tid", and keyword attributes as "args".
+
+Two process-wide rings: ``TRACER`` is the control plane's
+(process_name "tpu-operator", /debug/traces on the operator API server);
+``SERVE_TRACER`` is the serving DATA plane's (process_name "tpu-serve",
+/debug/traces on the serve HTTP surfaces — serve_lm, fleet replicas,
+and the fleet router). Keeping them separate means a fleet trace never
+interleaves reconcile-loop spans into a request timeline. Both are
+process-global on purpose: a supervisor engine rebuild swaps the
+scheduler/engine generation underneath but the ring (and every span the
+dead generation recorded) survives, exactly like the /debug/serve
+aggregates.
+
+Cross-process merging (``merge_chrome_traces``): each tracer pairs its
+monotonic epoch with a wall-clock stamp taken at the same instant and
+exports it as ``epochUnixUs``, so traces fetched from N processes can be
+rebased onto one timeline (the fleet router's /debug/traces and
+``tpuctl trace`` both merge this way, keyed by the ``request_id`` span
+attribute).
+
+The ring is bounded and evictions are COUNTED (``dropped`` +
+``tpu_trace_spans_dropped_total``) so "the trace ends here because the
+ring wrapped" is observable, never silent; attribute values are
+sanitized at export (printable, length-capped) so a weird prompt string
+can never corrupt — or bloat — the JSON export. ``set_capacity`` is the
+runtime knob (serve_lm ``--trace-capacity``; 0 disables tracing
+entirely — the ``span``/``record`` fast path is then one attribute
+read).
 """
 
 from __future__ import annotations
@@ -20,10 +47,32 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Iterator
+
+from tf_operator_tpu.runtime.metrics import TRACE_SPANS_DROPPED
+
+# Attr-value cap: long enough for ids/prompts-prefixes, short enough
+# that a pathological attr cannot bloat the export.
+_MAX_ATTR_CHARS = 256
+
+
+def _sanitize_attr(value: Any) -> str:
+    """Render one span attribute export-safe: stringified, control and
+    other non-printable characters (incl. lone surrogates, which break
+    strict JSON consumers) replaced, length-capped."""
+    s = str(value)
+    if not s.isprintable():
+        s = "".join(
+            ch if (ch.isprintable() or ch == " ") else "\\u%04x" % ord(ch)
+            for ch in s
+        )
+    if len(s) > _MAX_ATTR_CHARS:
+        s = s[:_MAX_ATTR_CHARS] + "..."
+    return s
 
 
 @dataclass
@@ -37,11 +86,42 @@ class Span:
 
 class Tracer:
     def __init__(self, capacity: int = 8192, process_name: str = "tpu-operator"):
-        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._spans: deque[Span] = deque(maxlen=max(0, capacity))
         self._lock = threading.Lock()
+        # The monotonic epoch and its wall-clock twin are captured
+        # back-to-back: ts values are monotonic-relative (immune to
+        # clock steps), epochUnixUs lets a merger rebase rings from
+        # different processes onto one timeline.
         self._epoch = time.monotonic()
+        self._epoch_unix = time.time()
         self.process_name = process_name
-        self.enabled = True
+        self.enabled = capacity > 0
+        self.dropped = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._spans.maxlen or 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize the ring (newest spans kept). 0 disables tracing —
+        the span/record fast path becomes one attribute read."""
+        with self._lock:
+            if capacity <= 0:
+                self.enabled = False
+                self._spans = deque(maxlen=0)
+            else:
+                self.enabled = True
+                self._spans = deque(self._spans, maxlen=capacity)
+
+    def _append(self, s: Span) -> None:
+        with self._lock:
+            if (self._spans.maxlen is not None
+                    and len(self._spans) == self._spans.maxlen):
+                # deque(maxlen) evicts silently; the counter makes the
+                # wrap observable ("the trace starts mid-story HERE").
+                self.dropped += 1
+                TRACE_SPANS_DROPPED.inc(tracer=self.process_name)
+            self._spans.append(s)
 
     @contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[None]:
@@ -53,15 +133,35 @@ class Tracer:
             yield
         finally:
             t1 = time.monotonic()
-            s = Span(
+            self._append(Span(
                 name=name,
                 start_us=(t0 - self._epoch) * 1e6,
                 duration_us=(t1 - t0) * 1e6,
                 thread=threading.get_ident() % 2**31,
                 attrs=attrs,
-            )
-            with self._lock:
-                self._spans.append(s)
+            ))
+
+    def record(self, name: str, start_mono: float, end_mono: float,
+               **attrs: Any) -> None:
+        """Record a span from explicit ``time.monotonic()`` stamps — for
+        phases measured across threads or assembled after the fact
+        (queue wait from the enqueue stamp, decode intervals aggregated
+        over many steps)."""
+        if not self.enabled:
+            return
+        self._append(Span(
+            name=name,
+            start_us=(start_mono - self._epoch) * 1e6,
+            duration_us=max(0.0, (end_mono - start_mono)) * 1e6,
+            thread=threading.get_ident() % 2**31,
+            attrs=attrs,
+        ))
+
+    def size(self) -> int:
+        """Current ring depth — O(1), unlike ``len(spans())`` which
+        copies the whole ring (debug snapshots poll this)."""
+        with self._lock:
+            return len(self._spans)
 
     def spans(self, name: str | None = None) -> list[Span]:
         with self._lock:
@@ -71,9 +171,13 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self.dropped = 0
 
-    def export_chrome_trace(self) -> str:
-        """Catapult JSON: load at chrome://tracing or ui.perfetto.dev."""
+    def export_doc(self) -> dict[str, Any]:
+        """The catapult document as a dict: ``traceEvents`` plus the
+        merge metadata (``epochUnixUs``, ``droppedSpans``, ``process``).
+        Extra top-level keys are legal in the Chrome trace JSON object
+        format and ignored by viewers."""
         events: list[dict[str, Any]] = [
             {
                 "name": "process_name",
@@ -91,10 +195,95 @@ class Tracer:
                     "tid": s.thread,
                     "ts": round(s.start_us, 3),
                     "dur": round(s.duration_us, 3),
-                    "args": {k: str(v) for k, v in s.attrs.items()},
+                    "args": {
+                        k: _sanitize_attr(v) for k, v in s.attrs.items()
+                    },
                 }
             )
-        return json.dumps({"traceEvents": events})
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "epochUnixUs": round(self._epoch_unix * 1e6, 1),
+            "droppedSpans": self.dropped,
+            "process": self.process_name,
+        }
+
+    def export_chrome_trace(self) -> str:
+        """Catapult JSON: load at chrome://tracing or ui.perfetto.dev."""
+        return json.dumps(self.export_doc())
+
+
+def mint_request_id() -> str:
+    """A fleet-unique request id (16 hex chars) — minted at the FIRST
+    hop that sees a request (fleet router, replica server, serve_lm
+    handler, or the scheduler itself) unless the client supplied one
+    (``X-Request-Id`` header / ``request_id`` body field). Every span a
+    request generates anywhere in the fleet carries it as the
+    ``request_id`` arg; the merge below keys on it."""
+    return uuid.uuid4().hex[:16]
+
+
+def merge_chrome_traces(docs) -> dict[str, Any]:
+    """Merge per-process catapult documents into ONE fleet timeline.
+
+    ``docs`` is an iterable of ``(source_name, doc)`` pairs where each
+    doc is a parsed ``export_doc`` result (or any catapult object-format
+    dict). Each source becomes one pid (its ``process_name`` metadata
+    row names it), timestamps are rebased onto the EARLIEST source's
+    wall-clock epoch via ``epochUnixUs``, and events identical up to
+    pid are deduplicated — several in-process replicas share one ring,
+    so fetching each replica's /debug/traces returns overlapping copies.
+    Request-scoped spans carry a ``request_id`` arg; filtering on it in
+    ui.perfetto.dev follows one request across the fleet hop."""
+    docs = [(name, doc) for name, doc in docs
+            if doc and doc.get("traceEvents")]
+    if not docs:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    # Only docs that CARRY an epoch participate in the base: a foreign
+    # catapult doc without epochUnixUs must not drag the base to 0 and
+    # shift every real source by ~the full unix epoch. Epoch-less docs
+    # keep their raw timestamps (shift 0).
+    known = [float(doc.get("epochUnixUs") or 0.0) for _, doc in docs]
+    known = [e for e in known if e]
+    base = min(known) if known else 0.0
+    events: list[dict[str, Any]] = []
+    seen: set[tuple] = set()
+    dropped = 0
+    for pid, (name, doc) in enumerate(docs, start=1):
+        epoch = float(doc.get("epochUnixUs") or 0.0)
+        shift_us = (epoch - base) if epoch else 0.0
+        dropped += int(doc.get("droppedSpans") or 0)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": str(name)},
+        })
+        for e in doc.get("traceEvents", ()):
+            if e.get("ph") == "M":
+                continue  # re-emitted per source above
+            ts = round(float(e.get("ts", 0.0)) + shift_us, 3)
+            key = (
+                e.get("name"), ts, e.get("dur"), e.get("tid"),
+                json.dumps(e.get("args", {}), sort_keys=True),
+            )
+            if key in seen:
+                continue
+            seen.add(key)
+            events.append({**e, "ts": ts, "pid": pid})
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "epochUnixUs": base,
+        "droppedSpans": dropped,
+        "sources": [name for name, _ in docs],
+    }
 
 
 TRACER = Tracer()
+
+# The serving data plane's ring: request-scoped spans (queue wait,
+# admission, prefill chunks, CoW copies, decode intervals, watchdog
+# restarts, drain) recorded by serve/scheduler.py + serve/engine.py and
+# exported at /debug/traces on every serve HTTP surface. Process-global
+# so supervisor engine rebuilds carry the ring across generations.
+SERVE_TRACER = Tracer(process_name="tpu-serve")

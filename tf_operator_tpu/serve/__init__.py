@@ -23,7 +23,9 @@ requests mid-stream.
 - ``coalesce``: the legacy same-shape batch-window coalescer
   (serve_lm --engine coalesce), kept selectable for the exactness
   matrix and as the bench's comparison leg.
-- ``httpapi``: the /debug/serve endpoint.
+- ``httpapi``: the /debug/serve endpoint, the shared stdlib-handler
+  base (``QuietHandler``, incl. the /debug/traces export of the
+  data-plane span ring), and the /healthz readiness payload.
 
 Re-exports resolve lazily (PEP 562): importing the package must not
 drag jax into processes that only mount the debug surface.

@@ -87,6 +87,7 @@ are safe.)
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, replace
 from typing import Any
 
@@ -109,8 +110,10 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_KV_BLOCKS,
     SERVE_KV_COW_TOTAL,
     SERVE_MESH_DEVICES,
+    SERVE_PHASE_SECONDS,
     SERVE_PREFILL_SAVED_TOTAL,
 )
+from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
 from tf_operator_tpu.serve.kvcache import (
     BlockAllocator,
@@ -232,6 +235,10 @@ class ContinuousEngine:
         SERVE_MESH_DEVICES.set(
             int(mesh.devices.size) if mesh is not None else 1
         )
+        # Request-id tag per slot (scheduler-set after join): the
+        # engine's own host-side spans (CoW copies fire inside step())
+        # attribute to the request that owns the slot.
+        self._slot_tags: dict[int, str] = {}
         dcfg = replace(cfg, decode=True, mesh=None, remat=False,
                        kv_paged=False)
         # Solo DENSE model: prefill (one-shot, chunked, and suffix) and
@@ -792,10 +799,20 @@ class ContinuousEngine:
             if st["cow"] is None or not self._active[slot]:
                 continue
             entry, src, dst = st["cow"]
+            t0 = time.monotonic()
             self._cache = self._cow_fn(
                 self._cache, jnp.int32(slot), jnp.int32(entry),
                 jnp.int32(src), jnp.int32(dst),
             )
+            t1 = time.monotonic()
+            # Host-side span around the dispatched copy executable
+            # (nothing inside jitted code); the tag names the owner.
+            SERVE_TRACER.record(
+                "kv.cow", t0, t1,
+                request_id=self._slot_tags.get(slot, ""),
+                slot=slot, src_block=src, dst_block=dst,
+            )
+            SERVE_PHASE_SECONDS.inc(t1 - t0, phase="cow")
             st["cow"] = None
             st["shared"].remove(src)
             freed = self.blocks.free([src])
@@ -823,6 +840,11 @@ class ContinuousEngine:
         self.steps_total += 1
         return np.asarray(toks)
 
+    def tag_slot(self, slot: int, request_id: str) -> None:
+        """Name the request occupying ``slot`` so the engine's own
+        spans (CoW) carry its id; cleared on retire."""
+        self._slot_tags[slot] = request_id
+
     def retire(self, slot: int) -> None:
         """Release a slot. Dense: purely host-side — the row's stale K/V
         are masked by the next occupant's own counters. Paged: also
@@ -830,6 +852,7 @@ class ContinuousEngine:
         masked), plus block bookkeeping: private blocks return to the
         pool, shared refcounts drop, and prefix entries whose last
         holder this was are invalidated."""
+        self._slot_tags.pop(slot, None)
         self._active[slot] = False
         self._temperature[slot] = 0.0
         self._top_p[slot] = 1.0

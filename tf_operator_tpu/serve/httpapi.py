@@ -8,6 +8,11 @@ read one shape either way.
 
     GET /debug/serve → scheduler.debug_snapshot()
 
+The serve HTTP surfaces (serve_lm, fleet replica/router servers) also
+expose GET /debug/traces — the data-plane SERVE_TRACER ring as a
+catapult document (``QuietHandler.send_serve_traces``); the snapshot's
+``tracing`` section reports that ring's depth/capacity/dropped count.
+
 The payload carries a ``kv_cache`` section with the block-pool stats
 (paged mode: block size, free/used/shared block counts, CoW copies,
 prefix-cache hits, prefill tokens saved — the same numbers the
@@ -64,6 +69,15 @@ class QuietHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def send_serve_traces(self) -> None:
+        """The serving data plane's /debug/traces: the SERVE_TRACER ring
+        as one catapult document (load at ui.perfetto.dev; the fleet
+        router and ``tpuctl trace`` merge several of these by
+        ``epochUnixUs`` + the request_id span attribute)."""
+        from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+
+        self.send_json(200, SERVE_TRACER.export_doc())
 
     def read_json_body(self) -> dict:
         """Parse the POST body; raises ValueError on bad JSON."""

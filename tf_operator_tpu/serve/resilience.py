@@ -66,6 +66,7 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from tf_operator_tpu.runtime.metrics import SERVE_WATCHDOG_RESTARTS
+from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.utils import logger
 
@@ -444,6 +445,7 @@ class EngineSupervisor:
                 if self.dead or sched is not self._sched:
                     # Superseded: whoever fenced it owns its requests.
                     return sched._fenced
+            t_restart = time.monotonic()
             harvested = sched.fence_and_harvest()
             self._done_prev += sched.requests_done
             self._tokens_prev += sched.tokens_generated
@@ -461,6 +463,16 @@ class EngineSupervisor:
             )
             if self._attempts > self.res.max_restarts:
                 self._declare_dead(harvested)
+                # The terminal fence still gets its bridging span — the
+                # one incident an operator most needs the trace to
+                # explain is "every request just stopped here".
+                SERVE_TRACER.record(
+                    "watchdog.restart", t_restart, time.monotonic(),
+                    reason=reason, attempt=self._attempts,
+                    harvested=len(harvested), replayed=0,
+                    outcome="replica_dead",
+                    detail=self.last_fault or "",
+                )
                 return True
             # A harvested request whose absolute deadline already passed
             # resolves NOW with whatever it had (the deadline contract
@@ -486,6 +498,17 @@ class EngineSupervisor:
                     f"engine rebuild failed; replica dead: {build_exc!r}"
                 )
                 self._declare_dead(replay)
+            # The fence→rebuild window on the fleet timeline: every
+            # harvested request's spans stop at the fence and resume
+            # (same request_id, replays+1) after this span — the trace
+            # answers "why did this request's ITL spike" with "the
+            # watchdog restarted the engine here".
+            SERVE_TRACER.record(
+                "watchdog.restart", t_restart, time.monotonic(),
+                reason=reason, attempt=self._attempts,
+                harvested=len(harvested), replayed=len(replay),
+                detail=self.last_fault or "",
+            )
             return True
         finally:
             self._restart_lock.release()

@@ -80,7 +80,9 @@ import numpy as np
 from tf_operator_tpu.runtime.metrics import (
     SERVE_DEADLINE_TOTAL,
     SERVE_DEGRADED,
+    SERVE_ITL_SECONDS,
     SERVE_OCCUPANCY,
+    SERVE_PHASE_SECONDS,
     SERVE_PREFILL_TOKENS_TOTAL,
     SERVE_QUEUE_DEPTH,
     SERVE_REQUESTS_TOTAL,
@@ -91,6 +93,7 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_TOKENS_TOTAL,
     SERVE_TTFT_SECONDS,
 )
+from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.serve.resilience import (
     EngineCrashed,
@@ -109,6 +112,12 @@ __all__ = [
     "ShuttingDown",
 ]
 
+# Decode steps per ``decode.interval`` span before it is flushed and a
+# new one opened. Spans wrap host-side intervals, never single tokens:
+# a 64k-token decode is ~256 spans, not 64k — the bounded-ring pricing
+# that lets tracing stay on by default.
+DECODE_INTERVAL_STEPS = 256
+
 
 class SchedulerFenced(RuntimeError):
     """Internal: an enqueue hit a scheduler the supervisor has already
@@ -122,7 +131,8 @@ class ServeRequest:
     def __init__(self, tokens: np.ndarray, num_steps: int, *,
                  temperature: float = 0.0, top_p: float | None = None,
                  seed: int = 0, eos_id: int | None = None,
-                 deadline_s: float | None = None) -> None:
+                 deadline_s: float | None = None,
+                 request_id: str | None = None) -> None:
         self.tokens = np.asarray(tokens, np.int32)
         if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
             raise ValueError("tokens must be [1, len] (one request row)")
@@ -159,12 +169,58 @@ class ServeRequest:
         # resets first_token_at (so .ttft honestly includes the restart
         # for bench/telemetry readers) but must not observe twice.
         self.ttft_observed = False
+        # Tracing identity + per-phase attribution. The id is minted
+        # here when no upstream hop (router, replica server, serve_lm
+        # handler, or the client's X-Request-Id) supplied one — every
+        # request is traceable, fleet-routed or not. ``token_times`` are
+        # the decode-step monotonic stamps ITL is computed from at
+        # retirement (cleared on replay so gaps are observed exactly
+        # once, from the run that produced the delivered tokens).
+        self.request_id = (str(request_id) if request_id
+                           else mint_request_id())
+        self.token_times: list[float] = []
+        self.queue_wait_s = 0.0
+        self.prefill_s = 0.0
+        self.decode_s = 0.0
 
     @property
     def ttft(self) -> float | None:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    def itl_values(self) -> list[float]:
+        """Inter-token gaps (seconds) from the decode-step stamps."""
+        return [b - a for a, b in zip(self.token_times,
+                                      self.token_times[1:])]
+
+    def timing(self) -> dict:
+        """Compact per-request latency breakdown for response JSON
+        (opt-in via ``"timing": true``): where this request's wall time
+        went. Phase accumulators span replays — a watchdog restart's
+        re-prefill is real time the client waited."""
+        out = {
+            "request_id": self.request_id,
+            "queue_ms": round(self.queue_wait_s * 1e3, 3),
+            "prefill_ms": round(self.prefill_s * 1e3, 3),
+            "decode_ms": round(self.decode_s * 1e3, 3),
+        }
+        if self.ttft is not None:
+            out["ttft_ms"] = round(self.ttft * 1e3, 3)
+        gaps = self.itl_values()
+        if gaps:
+            out["itl_mean_ms"] = round(
+                sum(gaps) / len(gaps) * 1e3, 3
+            )
+            out["itl_max_ms"] = round(max(gaps) * 1e3, 3)
+            # The raw gaps too (bounded by num_steps): a p99 computed
+            # from means hides single-gap tails, so anything pooling
+            # ITL across requests (serve_bench's fleet leg) needs the
+            # real distribution, not its per-request summary.
+            out["itl_ms"] = [round(g * 1e3, 2) for g in gaps]
+        if self.replays:
+            out["replays"] = self.replays
+        return out
 
     def _finish(self, outcome: str, error: Exception | None = None) -> None:
         self.error = error
@@ -224,6 +280,10 @@ class ContinuousScheduler:
         # Active-slot count per decode step, bounded (the serve bench
         # reads a steady-window occupancy out of the middle of it).
         self.step_log: deque[int] = deque(maxlen=1 << 16)
+        # Open decode-interval spans: slot -> [start_mono, last_mono,
+        # steps]. Mutated only under the condvar (the supervisor's
+        # fence flushes from its own thread).
+        self._intervals: dict[int, list] = {}
         SERVE_SLOT_CAPACITY.set(engine.max_slots)
 
     # -- client side ------------------------------------------------------
@@ -315,6 +375,12 @@ class ContinuousScheduler:
                 req.out.clear()
                 req.slot = None
                 req.first_token_at = None
+                # ITL gaps are observed at retirement from these stamps:
+                # clearing them makes the observation cover exactly the
+                # run whose tokens the client receives (the phase-time
+                # accumulators, by contrast, keep counting — replay work
+                # is real wall time).
+                req.token_times.clear()
                 req.num_steps = req.requested_steps
                 req.degraded = False
                 req.replays += 1
@@ -345,11 +411,17 @@ class ContinuousScheduler:
         requests fail fast with ShuttingDown, admitted ones complete —
         within ``drain_timeout_s`` when configured (on expiry the
         stragglers resolve with partial output + the drain flag)."""
+        t0 = time.monotonic()
         with self._cond:
             self._stopping = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+            SERVE_TRACER.record(
+                "drain", t0, time.monotonic(),
+                requests_done=self.requests_done,
+                bounded=bool(self.res.drain_timeout_s),
+            )
 
     def fence_and_harvest(self) -> list[ServeRequest]:
         """Supervisor takeover: mark this scheduler fenced and strip out
@@ -359,6 +431,11 @@ class ContinuousScheduler:
         afterwards even if it is still executing inside a wedged device
         call right now. The engine is NOT touched: it is generation
         garbage the moment its scheduler is fenced."""
+        # Close the open decode-interval spans BEFORE fencing: the
+        # harvest is exactly where each request's pre-crash timeline
+        # ends, and the supervisor's watchdog.restart span fills the gap
+        # to its replay.
+        self._flush_intervals(reason="harvest")
         with self._cond:
             self._fenced = True
             harvested = list(self._slots.values())
@@ -466,6 +543,23 @@ class ContinuousScheduler:
                 return self._admitting
         return None
 
+    def _note_dequeued(self, req: ServeRequest, now: float) -> None:
+        """Close the request's queue residence: ONE ``queue.wait`` span
+        per stay, recorded when the request leaves the queue for good
+        (a reserved plan, or a plan error that resolves it) — NOT at
+        every pop, because block-exhaustion requeue-front cycles pop
+        the head once per loop iteration and would tile the ring with
+        zero-width spans while double-counting the wait."""
+        if req.enqueued_at is None:
+            return
+        req.queue_wait_s += max(0.0, now - req.enqueued_at)
+        SERVE_TRACER.record(
+            "queue.wait", req.enqueued_at, now,
+            request_id=req.request_id, depth=self.queue_depth,
+            replays=req.replays,
+        )
+        req.enqueued_at = None
+
     def _settle_admitting(self, requeue_front: bool = False) -> bool:
         """Clear the mid-admission marker under the condvar. Returns
         False when a fence already harvested the request — the caller
@@ -502,12 +596,27 @@ class ContinuousScheduler:
             self.deadline_total += 1
             SERVE_DEADLINE_TOTAL.inc(kind="queue")
             waited = now - (req.enqueued_at or now)
+            req.queue_wait_s += waited
+            # The request never reached a slot: its whole trace is the
+            # queue residence, closed with the outcome.
+            SERVE_TRACER.record(
+                "queue.wait", req.enqueued_at or now, now,
+                request_id=req.request_id, outcome="ttl_expired",
+            )
             req._finish("deadline", QueueTTLExpired(
                 f"queued {waited:.2f}s > ttl "
                 f"{self.res.queue_ttl_s}s without reaching a slot",
                 retry_after_s=self.res.queue_ttl_s,
             ))
         for req in dl_expired:
+            # Same residence-closing telemetry as the TTL branch: the
+            # still-queued deadline case is exactly the slow-request
+            # story tracing exists to explain.
+            req.queue_wait_s += now - (req.enqueued_at or now)
+            SERVE_TRACER.record(
+                "queue.wait", req.enqueued_at or now, now,
+                request_id=req.request_id, outcome="decode_deadline",
+            )
             self._expire_decode_deadline(None, req, "decode_deadline",
                                          "decode")
 
@@ -519,6 +628,7 @@ class ContinuousScheduler:
         latter calls the request-side half itself)."""
         if slot is not None:
             self.engine.retire(slot)
+            self._retire_telemetry(slot, req, reason=cause)
         req.deadline_exceeded = True
         req.timeout_cause = cause
         self.deadline_total += 1
@@ -576,6 +686,7 @@ class ContinuousScheduler:
                 if req is None:
                     return
                 self._degrade_check(req)
+                t_plan = time.monotonic()
                 try:
                     plan = self.engine.plan_admission(
                         np.asarray(req.tokens), req.num_steps
@@ -585,6 +696,7 @@ class ContinuousScheduler:
                     # unless a fence harvested it mid-plan (the
                     # supervisor will replay it instead).
                     if self._settle_admitting():
+                        self._note_dequeued(req, t_plan)
                         req._finish("error", exc)
                     else:
                         return
@@ -607,6 +719,19 @@ class ContinuousScheduler:
                         # hot on an unadmittable head-of-line.
                         time.sleep(0.001)
                     return
+                # The plan reserved capacity: the request has left the
+                # queue for good — close its queue.wait span where the
+                # plan span opens.
+                self._note_dequeued(req, t_plan)
+                SERVE_TRACER.record(
+                    "admit.plan", t_plan, time.monotonic(),
+                    request_id=req.request_id,
+                    prompt_tokens=req.tokens.shape[1],
+                    prefill_tokens=plan.prefill_tokens,
+                    # getattr: the chaos tests' fake plans carry only
+                    # prefill_tokens.
+                    shared_tokens=getattr(plan, "shared_tokens", 0),
+                )
                 try:
                     pf = self.engine.prefill_planned(plan)
                 except Exception as exc:  # noqa: BLE001
@@ -640,7 +765,14 @@ class ContinuousScheduler:
                 self._expire_decode_deadline(None, req, "decode_deadline",
                                              "decode")
                 continue
+            # Prefill is about to time-share the device with live
+            # decodes: close the open decode-interval spans so the
+            # interference shows as a GAP in each request's decode
+            # timeline (and the prefill span that fills it is the
+            # culprit, by construction).
+            self._flush_intervals(reason="prefill")
             t0 = time.perf_counter()
+            mono0 = time.monotonic()
             try:
                 with self._device():
                     self.faults.maybe_sleep("slow_prefill")
@@ -652,6 +784,8 @@ class ContinuousScheduler:
                             SERVE_STEP_SECONDS.observe(
                                 time.perf_counter() - t0, phase="prefill"
                             )
+                            self._note_prefill(req, mono0, joined=False,
+                                               plan=plan)
                             return  # resume next iteration
                     else:
                         # One-shot (or prefill-free exact match) inside
@@ -679,6 +813,7 @@ class ContinuousScheduler:
             SERVE_STEP_SECONDS.observe(
                 time.perf_counter() - t0, phase="prefill"
             )
+            self._note_prefill(req, mono0, joined=True, plan=plan)
             SERVE_PREFILL_TOKENS_TOTAL.inc(plan.prefill_tokens)
             with self._cond:
                 if self._fenced:
@@ -688,15 +823,87 @@ class ContinuousScheduler:
                     return
                 self._prefilling = None
                 if slot is None:  # raced capacity — put it back, front.
+                    # Re-stamp: _note_dequeued closed the first queue
+                    # residence at plan time; this is a NEW one (span
+                    # and queue_wait_s would otherwise silently skip
+                    # it, and the TTL message would report 0s waited).
+                    req.enqueued_at = time.monotonic()
                     self._queue.appendleft(req)
                     return
                 req.slot = slot
                 self._slots[slot] = req
+                if hasattr(self.engine, "tag_slot"):
+                    # The engine's own spans (CoW copies fire inside
+                    # step()) attribute to the request through the tag;
+                    # hasattr-guarded for the chaos tests' fake engines.
+                    self.engine.tag_slot(slot, req.request_id)
+
+    def _note_prefill(self, req: ServeRequest, mono0: float, *,
+                      joined: bool, plan: Any = None) -> None:
+        """Close one prefill device interval: span + per-phase device
+        seconds (including the ``prefill_interference`` share charged
+        whenever live decode slots were waiting behind this prefill)."""
+        now = time.monotonic()
+        dt = now - mono0
+        req.prefill_s += dt
+        SERVE_PHASE_SECONDS.inc(dt, phase="prefill")
+        if self._slots:
+            SERVE_PHASE_SECONDS.inc(dt, phase="prefill_interference")
+        attrs: dict[str, Any] = {"request_id": req.request_id}
+        if plan is not None:
+            attrs["prefill_tokens"] = plan.prefill_tokens
+            if getattr(plan, "shared_tokens", 0):
+                attrs["shared_tokens"] = plan.shared_tokens
+            if joined and plan.prefill_tokens == 0:
+                # The exact-prefix table-insert join: no prompt token
+                # was prefilled, the donor's blocks were re-pointed.
+                attrs["exact_prefix_join"] = True
+        SERVE_TRACER.record(
+            "prefill.join" if joined else "prefill.chunk",
+            mono0, now, **attrs,
+        )
+
+    def _flush_intervals(self, slot: int | None = None,
+                         reason: str | None = None,
+                         rid: str | None = None) -> None:
+        """Emit the open ``decode.interval`` span(s): one slot (its
+        retire — ``rid`` names the owner, already gone from _slots) or
+        all of them (a prefill about to interleave, the drain, a
+        crash). Bounded aggregation — never one span per token."""
+        with self._cond:
+            slots = ([slot] if slot is not None
+                     else list(self._intervals))
+            flushed = [(s, self._intervals.pop(s))
+                       for s in slots if s in self._intervals]
+            owners = {
+                s: (rid if rid is not None and s == slot
+                    else self._slots[s].request_id if s in self._slots
+                    else "")
+                for s, _ in flushed
+            }
+        for s, (start, last, steps) in flushed:
+            attrs: dict[str, Any] = {
+                "request_id": owners.get(s, ""), "slot": s,
+                "tokens": steps,
+            }
+            if reason:
+                attrs["closed_by"] = reason
+            SERVE_TRACER.record("decode.interval", start, last, **attrs)
+
+    def _retire_telemetry(self, slot: int, req: ServeRequest,
+                          reason: str | None = None) -> None:
+        """Retirement-side tracing/ITL: flush the slot's open decode
+        interval and observe the request's inter-token gaps (from its
+        decode-step stamps — exactly once, at retirement)."""
+        self._flush_intervals(slot, reason=reason, rid=req.request_id)
+        for gap in req.itl_values():
+            SERVE_ITL_SECONDS.observe(gap)
 
     def _decode(self) -> None:
         if not self._slots:
             return
         t0 = time.perf_counter()
+        mono0 = time.monotonic()
         with self._device():
             toks = self.engine.step()
         self._beat()  # the step returned — wedged steps never get here
@@ -707,15 +914,27 @@ class ContinuousScheduler:
                 return
             slots_now = list(self._slots.items())
             SERVE_STEP_SECONDS.observe(now - t0, phase="decode")
+            SERVE_PHASE_SECONDS.inc(mono - mono0, phase="decode")
             SERVE_OCCUPANCY.observe(self.engine.occupancy)
             self.decode_steps += 1
             self.occupancy_sum += len(self._slots)
             self.step_log.append(len(self._slots))
             self.tokens_generated += len(self._slots)
             SERVE_TOKENS_TOTAL.inc(len(self._slots))
+            retired: list[tuple[int, ServeRequest]] = []
             for slot, req in slots_now:
                 tok = int(toks[slot])
                 req.out.append(tok)
+                req.token_times.append(mono)
+                req.decode_s += mono - mono0
+                # Aggregate this step into the slot's open interval
+                # span (opened on its first step, extended in place).
+                ent = self._intervals.get(slot)
+                if ent is None:
+                    self._intervals[slot] = [mono0, mono, 1]
+                else:
+                    ent[1] = mono
+                    ent[2] += 1
                 if req.first_token_at is None:
                     req.first_token_at = now
                     if not req.ttft_observed:
@@ -726,6 +945,7 @@ class ContinuousScheduler:
                     del self._slots[slot]
                     self.engine.retire(slot)
                     self.requests_done += 1
+                    retired.append((slot, req))
                     req._finish("ok")
                     if self.supervisor is not None:
                         # A completed request proves this engine serves:
@@ -741,10 +961,16 @@ class ContinuousScheduler:
                     self._expire_decode_deadline(
                         slot, req, "decode_deadline", "decode"
                     )
+                elif (ent := self._intervals.get(slot)) is not None \
+                        and ent[2] >= DECODE_INTERVAL_STEPS:
+                    self._flush_intervals(slot, reason="cap")
+        for slot, req in retired:
+            self._retire_telemetry(slot, req)
 
     def _fail_all(self, exc: Exception) -> None:
         # Typed teardown: waiters (and the router above them) see
         # {code, retryable, detail}, never a bare 500 repr.
+        self._flush_intervals(reason="crash")
         if not isinstance(exc, ServeError):
             exc = EngineCrashed(f"serving loop crashed: {exc!r}")
         with self._cond:
@@ -827,6 +1053,17 @@ class ContinuousScheduler:
             "mean_occupancy": round(self.mean_occupancy, 4),
             "ttft_p50_s": SERVE_TTFT_SECONDS.quantile(0.5),
             "ttft_p99_s": SERVE_TTFT_SECONDS.quantile(0.99),
+            "itl_p50_s": SERVE_ITL_SECONDS.quantile(0.5),
+            "itl_p99_s": SERVE_ITL_SECONDS.quantile(0.99),
+            # The data-plane trace ring behind /debug/traces: depth,
+            # knob, and whether it has wrapped (dropped > 0 means the
+            # export starts mid-story).
+            "tracing": {
+                "enabled": SERVE_TRACER.enabled,
+                "capacity": SERVE_TRACER.capacity,
+                "spans": SERVE_TRACER.size(),
+                "dropped": SERVE_TRACER.dropped,
+            },
             "draining": self._stopping,
             "degraded": self.degraded,
             "shed_total": self.shed_total,

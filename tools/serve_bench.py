@@ -18,13 +18,20 @@ Emits one BENCH-style JSON line per leg:
 
     {"metric": "serve_continuous_tokens_per_sec_mixed", "value": ...,
      "vs_baseline": <continuous / coalesce>, "ttft_p50_ms": ...,
-     "ttft_p99_ms": ..., "mean_occupancy": ..., "steady_occupancy": ...}
+     "ttft_p99_ms": ..., "itl_p50_ms": ..., "itl_p99_ms": ...,
+     "mean_occupancy": ..., "steady_occupancy": ...}
 
 vs_baseline on the continuous line is the speedup over the coalesce leg
 (the acceptance ratio); ttft on the coalesce line is full-response
-latency (lock-step clients see nothing earlier). steady_occupancy is the
-mean active-slot fraction over the middle half of decode steps — the
-window where admission has filled and drain has not started.
+latency (lock-step clients see nothing earlier). itl_p50/p99 are
+inter-token gaps pooled across requests — real decode-step gaps on the
+continuous legs (ServeRequest.itl_values), latency/tokens on the
+lock-step coalesce leg (nothing streams), the replica-reported timing
+breakdown on the fleet leg. The pair (ttft_p99, itl_p99) is the
+baseline the ROADMAP item-2 disaggregation pin must beat.
+steady_occupancy is the mean active-slot fraction over the middle half
+of decode steps — the window where admission has filled and drain has
+not started.
 
 The CAPACITY section (runs with ``--engine both``; ``--skip-prefix-mix``
 disables) replays a seeded long-context + shared-prefix schedule — every
@@ -118,7 +125,10 @@ def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
 def run_schedule(schedule, submit_fn):
     """Replay the schedule open-loop (one client thread per request,
     sleeping to its arrival time). Returns (wall_seconds, results):
-    results[i] = dict(tokens, latency_s, ttft_s | None, error | None)."""
+    results[i] = dict(tokens, latency_s, ttft_s | None, itls,
+    error | None) — ``itls`` is the request's inter-token gap list
+    (submit_fn's third return value; empty for legs that cannot
+    measure per-token delivery)."""
     results = [None] * len(schedule)
     start = time.perf_counter() + 0.05  # common epoch for all arrivals
 
@@ -128,18 +138,19 @@ def run_schedule(schedule, submit_fn):
             time.sleep(delay)
         t0 = time.perf_counter()
         try:
-            tokens, ttft = submit_fn(prompt, steps)
+            tokens, ttft, itls = submit_fn(prompt, steps)
             results[i] = {
                 "tokens": tokens,
                 "latency_s": time.perf_counter() - t0,
                 "ttft_s": ttft if ttft is not None
                 else time.perf_counter() - t0,
+                "itls": itls or [],
                 "error": None,
             }
         except Exception as exc:  # noqa: BLE001 — one failed request
             # must not hang the bench join below.
             results[i] = {"tokens": None, "latency_s": 0.0,
-                          "ttft_s": 0.0, "error": repr(exc)}
+                          "ttft_s": 0.0, "itls": [], "error": repr(exc)}
 
     threads = [
         threading.Thread(target=client, args=(i, off, prompt, steps))
@@ -166,6 +177,11 @@ def leg_summary(name, wall_s, results, extra):
                  is not None)
     ttfts = [r["ttft_s"] for r in results if r and r["error"] is None]
     lats = [r["latency_s"] for r in results if r and r["error"] is None]
+    # Inter-token gaps pooled across requests: the ROADMAP item-2
+    # interference pin's baseline (disaggregation must beat BOTH TTFT
+    # p99 and ITL p99 of the time-shared engine).
+    itls = [g for r in results if r and r["error"] is None
+            for g in r.get("itls", ())]
     line = {
         "metric": f"serve_{name}_tokens_per_sec_mixed",
         "value": round(tokens / wall_s, 1) if wall_s else 0.0,
@@ -177,6 +193,8 @@ def leg_summary(name, wall_s, results, extra):
         "wall_seconds": round(wall_s, 3),
         "ttft_p50_ms": round(percentile(ttfts, 0.5) * 1e3, 1),
         "ttft_p99_ms": round(percentile(ttfts, 0.99) * 1e3, 1),
+        "itl_p50_ms": round(percentile(itls, 0.5) * 1e3, 2),
+        "itl_p99_ms": round(percentile(itls, 0.99) * 1e3, 2),
         "latency_p50_ms": round(percentile(lats, 0.5) * 1e3, 1),
         "latency_p99_ms": round(percentile(lats, 0.99) * 1e3, 1),
     }
@@ -208,7 +226,7 @@ def run_continuous(cfg, params, schedule, args, *, mesh=None,
 
     def submit(prompt, steps):
         req = sched.submit_request(ServeRequest(prompt, steps))
-        return list(req.out), req.ttft
+        return list(req.out), req.ttft, req.itl_values()
 
     run_schedule(schedule, submit)  # untimed warmup
     sched.reset_stats()
@@ -306,7 +324,7 @@ def run_capacity_leg(name, cfg, params, schedule, args, *, kv_paged,
 
     def submit(prompt, steps):
         req = sched.submit_request(ServeRequest(prompt, steps))
-        return list(req.out), req.ttft
+        return list(req.out), req.ttft, req.itl_values()
 
     run_schedule(schedule, submit)  # untimed warmup (same engine)
     sched.reset_stats()
@@ -415,7 +433,7 @@ def run_chaos_leg(cfg, params, schedule, args) -> dict:
         r = ServeRequest(prompt, steps)
         reqs.append(r)  # list.append is atomic; order is irrelevant
         r = sup.submit_request(r, timeout=120.0)
-        return list(r.out), r.ttft
+        return list(r.out), r.ttft, r.itl_values()
 
     run_schedule(schedule, submit)  # untimed warmup, no faults armed
     reqs.clear()
@@ -527,7 +545,12 @@ def run_fleet_leg(cfg, params, schedule, args) -> dict:
         try:
             status, payload = http_send(
                 router_as_backend,
-                {"tokens": prompt.tolist(), "num_steps": steps},
+                # timing: the replica-side compact breakdown rides the
+                # response, so the fleet leg's ITL comes from the
+                # replica's own decode-step stamps, not router-side
+                # guesswork.
+                {"tokens": prompt.tolist(), "num_steps": steps,
+                 "timing": True},
                 90.0,
             )
         except Exception:  # noqa: BLE001 — transport to the ROUTER
@@ -538,7 +561,12 @@ def run_fleet_leg(cfg, params, schedule, args) -> dict:
         with outcomes_lock:
             outcomes.append((status, payload))
         if status == 200 and payload.get("tokens"):
-            return payload["tokens"][0], None
+            timing = (payload.get("timing") or [{}])[0]
+            # The raw per-request gap list: pooled across requests this
+            # leg's itl_p99 means the same thing as the in-process
+            # legs' (a p99 of gaps, not a p99 of per-request means).
+            gaps = [g / 1e3 for g in timing.get("itl_ms", ())]
+            return payload["tokens"][0], None, gaps
         raise RuntimeError(f"{status}:{payload.get('code', 'untyped')}")
 
     run_schedule(schedule, submit)  # untimed warmup, whole fleet alive
@@ -610,11 +638,16 @@ def run_coalesce(cfg, params, schedule, args) -> dict:
         t.start()
 
         def submit(prompt, steps):
+            t0 = time.perf_counter()
             out = co.submit(jnp.asarray(prompt), steps)
             # Lock-step: the client sees nothing before the whole batch
             # finishes — TTFT is response latency (None → measured by
-            # the caller).
-            return np.asarray(out)[0].tolist(), None
+            # the caller), and the only honest ITL is the effective
+            # per-token delivery rate (latency / tokens, one pooled
+            # sample per request).
+            dt = time.perf_counter() - t0
+            return (np.asarray(out)[0].tolist(), None,
+                    [dt / max(1, steps)])
 
         wall_s, results = run_schedule(schedule, submit)
         stats = {

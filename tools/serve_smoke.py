@@ -5,7 +5,9 @@ prefix-sharing pins, zero-recompile pins, scheduler drain/EOS/metrics,
 serve-bench structure), then one INLINE end-to-end pair through a live
 paged engine + scheduler — a plain paged request and a shared-prefix
 request — asserting both reproduce solo generate bit-for-bit and the
-second actually skipped its prefill — and finally the SPMD
+second actually skipped its prefill — then a TRACED request through a
+supervised engine (queue/admit/prefill/decode-interval spans under one
+request id, in phase order, valid Chrome-trace export) — and finally the SPMD
 tensor-parallel matrix (tools/serve_tp_check.py at tp=2 host devices:
 {dense, paged} x {one-shot, chunked} bit-identity + the supervisor
 mesh-reconstruction replay, slow-marked in tier-1 so THIS is its
@@ -110,6 +112,77 @@ def paged_e2e_pair() -> int:
         sched.stop(timeout=30.0)
 
 
+def trace_e2e() -> int:
+    """One traced request through a SUPERVISED engine: the default-on
+    data-plane tracer yields the queue → admit → prefill → decode span
+    chain under the request's id, in phase order, with the decode steps
+    aggregated into interval spans — and /debug/traces-shaped export
+    stays valid JSON."""
+    import json
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from tf_operator_tpu.runtime.tracing import SERVE_TRACER
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+    from tf_operator_tpu.serve.scheduler import ServeRequest
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    SERVE_TRACER.clear()
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=2, kv_block=8,
+                                 prefill_chunk=4),
+        resilience=ResilienceConfig(),
+    )
+    try:
+        prompt = np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (1, 9)
+        ).astype(np.int32)
+        req = sup.submit_request(
+            ServeRequest(prompt, 16, request_id="smoke-trace")
+        )
+        assert len(req.out) == 16
+        mine = [s for s in SERVE_TRACER.spans()
+                if s.attrs.get("request_id") == "smoke-trace"]
+        names = [s.name for s in mine]
+        for expected in ("queue.wait", "admit.plan"):
+            assert expected in names, (expected, names)
+        assert any(n.startswith("prefill") for n in names), names
+        decode = [s for s in mine if s.name == "decode.interval"]
+        assert decode, names
+        assert sum(int(s.attrs["tokens"]) for s in decode) == 16
+        # Parentage by time: queue closes before the plan opens, the
+        # plan before prefill, prefill before the first decode interval.
+        start = {n: min(s.start_us for s in mine if s.name == n)
+                 for n in set(names)}
+        pf = min(v for n, v in start.items() if n.startswith("prefill"))
+        assert (start["queue.wait"] <= start["admit.plan"] <= pf
+                <= start["decode.interval"])
+        json.loads(SERVE_TRACER.export_chrome_trace())  # valid export
+        print(
+            f"serve_smoke: trace e2e ok ({len(mine)} spans for one "
+            f"request, {len(decode)} decode interval(s))", flush=True,
+        )
+        return 0
+    finally:
+        sup.stop(timeout=30.0)
+
+
 def chaos_e2e() -> int:
     """Kill the decode step mid-run through a LIVE supervised engine:
     the watchdog rebuilds, the in-flight greedy request replays
@@ -197,6 +270,9 @@ def main(argv: list[str] | None = None) -> int:
     if chaos:
         return chaos_e2e()
     rc = paged_e2e_pair()
+    if rc != 0:
+        return rc
+    rc = trace_e2e()
     if rc != 0:
         return rc
     # The SPMD tensor-parallel matrix (slow-marked in tier-1, so the
