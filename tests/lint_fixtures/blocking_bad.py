@@ -1,0 +1,17 @@
+"""Seeded violation for the ``blocking-under-lock`` pass: a sleep
+inside the condvar body (every waiter stalls behind it)."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self.ticks = 0
+
+    def tick(self) -> None:
+        with self._cond:
+            time.sleep(0.01)
+            self.ticks += 1
+            self._cond.notify_all()

@@ -1,0 +1,17 @@
+"""Clean twin of blocking_bad.py: the sleep happens outside the
+condvar; the lock body is bookkeeping only."""
+
+import threading
+import time
+
+
+class Poller:
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self.ticks = 0
+
+    def tick(self) -> None:
+        time.sleep(0.01)
+        with self._cond:
+            self.ticks += 1
+            self._cond.notify_all()

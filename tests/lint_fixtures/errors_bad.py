@@ -1,0 +1,20 @@
+"""Seeded violations for the ``typed-error`` pass: a subclass minting a
+code the taxonomy doesn't know, a payload literal with an unknown code,
+and a dispatch comparison against one. (The test runs the checker over
+this file TOGETHER with serve/resilience.py so the taxonomy is in the
+analyzed set.)"""
+
+from tf_operator_tpu.serve.resilience import ServeError
+
+
+class MysteryFailure(ServeError):
+    code = "mystery_failure"
+    http_status = 500
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "made_up_code", "retryable": False}
+
+
+def dispatch(payload: dict) -> bool:
+    return payload.get("code") == "another_unknown"

@@ -1,0 +1,14 @@
+"""Clean twin of errors_bad.py: every code comes from the taxonomy /
+WIRE_CODES vocabulary."""
+
+from tf_operator_tpu.serve.resilience import QueueFull
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "queue_full", "retryable": True}
+
+
+def dispatch(payload: dict) -> bool:
+    if payload.get("code") == "no_replica":
+        return True
+    return isinstance(payload.get("exc"), QueueFull)

@@ -1,0 +1,17 @@
+"""Seeded violation for the ``guarded-attr`` pass: ``_count`` is
+written under the lock in ``bump`` but read lock-free in ``peek``."""
+
+import threading
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def peek(self) -> int:
+        return self._count

@@ -1,0 +1,26 @@
+"""Clean twin of guarded_bad.py: every access to ``_count`` outside
+``__init__`` holds the lock — including through the ``_peek_locked``
+helper, which is only ever called under it."""
+
+import threading
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self) -> None:
+        with self._lock:
+            self._count += 1
+            self._log(self._peek_locked())
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._peek_locked()
+
+    def _peek_locked(self) -> int:
+        return self._count
+
+    def _log(self, value: int) -> None:
+        del value

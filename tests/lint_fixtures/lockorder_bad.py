@@ -1,0 +1,22 @@
+"""Seeded violation for the ``lock-order`` pass: two functions acquire
+the same two module locks in opposite orders — the textbook deadlock.
+(This directory is excluded from the repo gate; tests/test_lint.py
+points the checker at each file directly.)"""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+state = {"n": 0}
+
+
+def forward() -> None:
+    with _A:
+        with _B:
+            state["n"] += 1
+
+
+def backward() -> None:
+    with _B:
+        with _A:
+            state["n"] -= 1

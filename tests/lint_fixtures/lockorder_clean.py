@@ -1,0 +1,20 @@
+"""Clean twin of lockorder_bad.py: both paths take _A before _B —
+a consistent global order, no cycle."""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+state = {"n": 0}
+
+
+def forward() -> None:
+    with _A:
+        with _B:
+            state["n"] += 1
+
+
+def backward() -> None:
+    with _A:
+        with _B:
+            state["n"] -= 1

@@ -1,0 +1,16 @@
+"""Seeded violations for the ``metrics-registry`` pass: the family is
+declared twice (second site with a drifted label set) and the call site
+passes a label the declaration doesn't know."""
+
+from tf_operator_tpu.runtime.metrics import REGISTRY
+
+FIXTURE_TOTAL = REGISTRY.counter(
+    "tpu_lintfixture_total", "seeded duplicate family", ("outcome",),
+)
+FIXTURE_TOTAL_AGAIN = REGISTRY.counter(
+    "tpu_lintfixture_total", "drifted re-declaration", ("result",),
+)
+
+
+def observe() -> None:
+    FIXTURE_TOTAL.inc(reason="nope")

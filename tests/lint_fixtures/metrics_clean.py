@@ -1,0 +1,12 @@
+"""Clean twin of metrics_bad.py: one declaration, call-site labels
+match the declared set exactly."""
+
+from tf_operator_tpu.runtime.metrics import REGISTRY
+
+FIXTURE_OK_TOTAL = REGISTRY.counter(
+    "tpu_lintfixture_ok_total", "clean fixture family", ("outcome",),
+)
+
+
+def observe() -> None:
+    FIXTURE_OK_TOTAL.inc(outcome="ok")

@@ -27,6 +27,7 @@ engines behind the router, one killed mid-run) is the serve_bench
 ``--engine fleet`` leg, structurally pinned at the bottom of this file.
 """
 
+import os
 import json
 import threading
 import time
@@ -40,6 +41,7 @@ from tf_operator_tpu.fleet import membership as mship
 from tf_operator_tpu.fleet.controller import FleetConfig, TPUServeController
 from tf_operator_tpu.fleet.replica import FakeReplicaBackend, ReplicaServer
 from tf_operator_tpu.fleet.router import RouterConfig, RouterServer, http_probe
+from tf_operator_tpu.runtime import lockwitness
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.events import FakeRecorder
 from tf_operator_tpu.runtime.kubeclient import KubeClusterClient, KubeConfig
@@ -48,6 +50,26 @@ from tf_operator_tpu.runtime.memcluster import InMemoryCluster
 from tf_operator_tpu.scheduler.gang import ANNOTATION_DRAINING_AT
 
 pytestmark = pytest.mark.fleet
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: runtime lock-order witness. The module-scoped autouse fixture
+# wraps every Lock/RLock/Condition created from tf_operator_tpu code for
+# the DURATION OF THIS WHOLE MODULE, recording per-thread held-sets at
+# every acquisition; the zz-test at the bottom of the file (runs last)
+# asserts the observed acquisition-order edges are a subgraph of the
+# transitive closure of tpulint's static lock graph, with zero cycles —
+# the static model and the running system pinned to each other.
+# ---------------------------------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_witness():
+    wit = lockwitness.install(force=True)
+    yield wit
+    lockwitness.uninstall()
+
 
 
 @pytest.fixture(params=["memcluster", "kubestub"])
@@ -715,3 +737,15 @@ def test_serve_bench_fleet_structural():
     assert fleet["untyped_errors"] == 0
     assert 0 < fleet["ttft_p99_ms"] <= fleet["deadline_budget_ms"]
     assert fleet["generated_tokens"] > 0
+
+
+def test_zz_lock_order_witness_subgraph_of_static():
+    """MUST stay the last test in this file: it reads everything the
+    module-scoped witness observed across the suite above. The actual
+    contract (observed edges mapped, inside the closure of the static
+    graph, acyclic, no unmapped/same-site gaps) lives in
+    lockwitness.Witness.assert_subgraph — shared with the other chaos
+    module so the pin cannot drift between them."""
+    wit = lockwitness.current()
+    assert wit is not None, "witness fixture did not install"
+    wit.assert_subgraph(_REPO_ROOT)

@@ -22,12 +22,14 @@ The metrics registry is process-global: every assertion windows reads
 via before/after deltas.
 """
 
+import os
 import threading
 import time
 
 import numpy as np
 import pytest
 
+from tf_operator_tpu.runtime import lockwitness
 from tf_operator_tpu.runtime.metrics import (
     SERVE_DEADLINE_TOTAL,
     SERVE_DEGRADED,
@@ -58,6 +60,26 @@ from tf_operator_tpu.serve.scheduler import (
 )
 
 pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: runtime lock-order witness. The module-scoped autouse fixture
+# wraps every Lock/RLock/Condition created from tf_operator_tpu code for
+# the DURATION OF THIS WHOLE MODULE, recording per-thread held-sets at
+# every acquisition; the zz-test at the bottom of the file (runs last)
+# asserts the observed acquisition-order edges are a subgraph of the
+# transitive closure of tpulint's static lock graph, with zero cycles —
+# the static model and the running system pinned to each other.
+# ---------------------------------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _lock_witness():
+    wit = lockwitness.install(force=True)
+    yield wit
+    lockwitness.uninstall()
+
 
 
 # ---------------------------------------------------------------------------
@@ -829,3 +851,15 @@ def test_replay_bit_identical_tier1(model):
             sup.engine.warmup_compiles
     finally:
         sup.stop(timeout=30)
+
+
+def test_zz_lock_order_witness_subgraph_of_static():
+    """MUST stay the last test in this file: it reads everything the
+    module-scoped witness observed across the suite above. The actual
+    contract (observed edges mapped, inside the closure of the static
+    graph, acyclic, no unmapped/same-site gaps) lives in
+    lockwitness.Witness.assert_subgraph — shared with the other chaos
+    module so the pin cannot drift between them."""
+    wit = lockwitness.current()
+    assert wit is not None, "witness fixture did not install"
+    wit.assert_subgraph(_REPO_ROOT)

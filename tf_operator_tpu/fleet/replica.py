@@ -169,7 +169,8 @@ class FakeReplicaBackend:
 
     @property
     def active_slots(self) -> int:
-        return min(self._inflight, self.max_slots)
+        with self._lock:
+            return min(self._inflight, self.max_slots)
 
     def fail_with(self, exc: Exception, n: int = 1) -> None:
         with self._lock:

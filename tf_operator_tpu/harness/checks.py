@@ -2,10 +2,18 @@
 
 Parity: py/py_checks.py:18 (pylint over the tree + unittest discovery as a
 CI gate). The environment ships no linter, so the checks are self-contained:
-per-file syntax compilation and an AST unused-import lint. Unit tests are a
-separate workflow step (pytest), matching the reference's split.
+per-file syntax compilation, an AST unused-import lint, and the project
+passes in ``tf_operator_tpu/harness/lint/`` (lock-order, guarded-attr,
+blocking-under-lock, metrics-registry, typed-error — see
+docs/static-analysis.md). Unit tests are a separate workflow step (pytest),
+matching the reference's split.
 
     python -m tf_operator_tpu.harness.checks [paths...]
+    python -m tf_operator_tpu.harness.checks --list-passes
+    python -m tf_operator_tpu.harness.checks --select lock-order,typed-error
+
+Findings can be waived per line with a justified comment
+(``# lint: ok <pass-id> — <reason>``); there is no blanket ignore.
 """
 
 from __future__ import annotations
@@ -16,7 +24,14 @@ import os
 import sys
 from dataclasses import dataclass
 
-DEFAULT_PATHS = ("tf_operator_tpu", "tests", "examples", "bench.py")
+DEFAULT_PATHS = (
+    "tf_operator_tpu", "tests", "examples", "tools",
+    "bench.py", "perf_probe.py", "__graft_entry__.py",
+)
+
+# Directories holding deliberately-broken lint-pass fixtures (test data,
+# not shipped code): excluded from the walk the same way __pycache__ is.
+_FIXTURE_DIRS = {"lint_fixtures"}
 
 
 @dataclass
@@ -24,9 +39,11 @@ class Problem:
     path: str
     line: int
     message: str
+    pass_id: str = ""
 
     def __str__(self) -> str:
-        return f"{self.path}:{self.line}: {self.message}"
+        tag = f" [{self.pass_id}]" if self.pass_id else ""
+        return f"{self.path}:{self.line}:{tag} {self.message}"
 
 
 def _py_files(paths: tuple[str, ...], root: str) -> list[str]:
@@ -37,30 +54,38 @@ def _py_files(paths: tuple[str, ...], root: str) -> list[str]:
             out.append(full)
             continue
         for dirpath, dirnames, filenames in os.walk(full):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            dirnames[:] = [
+                d for d in dirnames
+                if d != "__pycache__" and d not in _FIXTURE_DIRS
+            ]
             out.extend(
                 os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
             )
     return sorted(out)
 
 
-def check_syntax(path: str) -> list[Problem]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
+def check_syntax(path: str, src: str | None = None) -> list[Problem]:
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
     try:
         compile(src, path, "exec")
     except SyntaxError as exc:
-        return [Problem(path, exc.lineno or 0, f"syntax error: {exc.msg}")]
+        return [Problem(path, exc.lineno or 0, f"syntax error: {exc.msg}",
+                        pass_id="syntax")]
     return []
 
 
-def check_unused_imports(path: str) -> list[Problem]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError:
-        return []  # reported by check_syntax
+def check_unused_imports(path: str, src: str | None = None,
+                         tree: ast.Module | None = None) -> list[Problem]:
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            return []  # reported by check_syntax
     imported: dict[str, int] = {}
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
@@ -101,19 +126,65 @@ def check_unused_imports(path: str) -> list[Problem]:
             if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
                 used.add(sub.value)
     return [
-        Problem(path, lineno, f"unused import: {name}")
+        Problem(path, lineno, f"unused import: {name}",
+                pass_id="unused-import")
         for name, lineno in sorted(imported.items(), key=lambda kv: kv[1])
         if name not in used
     ]
 
 
+def list_passes() -> list[tuple[str, str]]:
+    """(pass id, one-line doc) for every pass, generic + project."""
+    from tf_operator_tpu.harness.lint import PASSES
+    out = [
+        ("syntax", "every .py file compiles"),
+        ("unused-import", "imports are referenced (or re-exported "
+                          "via __all__)"),
+    ]
+    out.extend((pid, doc) for pid, doc, _run in PASSES)
+    return out
+
+
 def run_checks(paths: tuple[str, ...] = DEFAULT_PATHS,
-               root: str | None = None) -> list[Problem]:
+               root: str | None = None,
+               select: tuple[str, ...] | None = None) -> list[Problem]:
+    """Run the full pass set (or a ``select`` subset of pass ids) over
+    ``paths``. Files are parsed once and shared by every pass; per-line
+    justified waivers are the only suppression mechanism."""
+    from tf_operator_tpu.harness.lint import (
+        PASS_IDS, load_source_file, run_lint_passes,
+    )
     root = root or os.getcwd()
+    generic = {"syntax", "unused-import"}
+    if select:
+        unknown = set(select) - generic - set(PASS_IDS)
+        if unknown:
+            raise ValueError(
+                f"unknown pass id(s): {sorted(unknown)}; known: "
+                f"{sorted(generic) + list(PASS_IDS)}"
+            )
+    files = [load_source_file(p, root) for p in _py_files(paths, root)]
     problems: list[Problem] = []
-    for path in _py_files(paths, root):
-        problems.extend(check_syntax(path))
-        problems.extend(check_unused_imports(path))
+    for sf in files:
+        file_problems: list[Problem] = []
+        if not select or "syntax" in select:
+            # always compile(): a few SyntaxErrors (late __future__
+            # imports, some scoping rules) pass ast.parse but fail
+            # compile — ast success is NOT sufficient for this pass
+            file_problems.extend(check_syntax(sf.rel, sf.src))
+        if not select or "unused-import" in select:
+            file_problems.extend(
+                check_unused_imports(sf.rel, sf.src, sf.tree))
+        problems.extend(
+            p for p in file_problems
+            if p.pass_id not in sf.waived_lines.get(p.line, ())
+        )
+    project_select = None
+    if select:
+        project_select = tuple(s for s in select if s in PASS_IDS)
+        if not project_select:
+            return problems
+    problems.extend(run_lint_passes(files, select=project_select))
     return problems
 
 
@@ -121,8 +192,17 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
     p.add_argument("--root", default=os.getcwd())
+    p.add_argument("--list-passes", action="store_true",
+                   help="print the pass catalog and exit")
+    p.add_argument("--select", default="",
+                   help="comma-separated pass ids to run (default: all)")
     args = p.parse_args(argv)
-    problems = run_checks(tuple(args.paths), args.root)
+    if args.list_passes:
+        for pid, doc in list_passes():
+            print(f"{pid:20s} {doc}")
+        return 0
+    select = tuple(s for s in args.select.split(",") if s) or None
+    problems = run_checks(tuple(args.paths), args.root, select=select)
     for prob in problems:
         print(prob, file=sys.stderr)
     print(f"checks: {len(problems)} problem(s)")

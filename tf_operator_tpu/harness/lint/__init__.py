@@ -1,0 +1,89 @@
+"""tpulint — project-specific static analysis passes for the checks gate.
+
+``harness/checks.py`` runs the generic syntax/unused-import lints; this
+package adds the passes that encode the repo's own concurrency and
+discipline contracts (see docs/static-analysis.md for the catalog and
+the waiver grammar):
+
+- ``lock-order``        static "acquired while holding" graph, no cycles
+- ``guarded-attr``      lock-guarded attributes never accessed lock-free
+- ``blocking-under-lock`` no sleeps/HTTP/subprocess/joins/device calls
+                          inside a lock body
+- ``metrics-registry``  tpu_* families declared once, labels consistent,
+                          test reads windowed
+- ``typed-error``       ServeError codes come from the taxonomy
+
+Every file is parsed once (``base.SourceFile``) and shared by all
+passes; the whole-tree run stays well under the 15s CI budget.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import (
+    blocking,
+    errorspass,
+    guarded,
+    lockorder,
+    metricspass,
+)
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import (
+    SourceFile,
+    apply_waivers,
+    load_source_file,
+    waiver_problems,
+)
+
+# ordered registry: (pass id, one-line doc, run(files, project) -> problems)
+PASSES: tuple[tuple[str, str, object], ...] = (
+    (lockorder.PASS_ID, lockorder.DOC, lockorder.run),
+    (guarded.PASS_ID, guarded.DOC, guarded.run),
+    (blocking.PASS_ID, blocking.DOC, blocking.run),
+    (metricspass.PASS_ID, metricspass.DOC, metricspass.run),
+    (errorspass.PASS_ID, errorspass.DOC, errorspass.run),
+)
+
+PASS_IDS: tuple[str, ...] = tuple(p[0] for p in PASSES)
+
+
+def run_lint_passes(files: list[SourceFile],
+                    select: tuple[str, ...] | None = None,
+                    ) -> list[Problem]:
+    """Run the project passes over pre-parsed files; waivers applied.
+
+    ``select`` restricts to a subset of pass ids (the ``--select`` CLI);
+    unknown ids raise so a typo'd selection can't silently pass."""
+    if select:
+        unknown = set(select) - set(PASS_IDS)
+        if unknown:
+            raise ValueError(
+                f"unknown pass id(s): {sorted(unknown)}; "
+                f"known: {list(PASS_IDS)}"
+            )
+    proj = cmod.build_project(files)
+    by_rel = {sf.rel: sf for sf in files}
+    problems: list[Problem] = []
+    for pass_id, _doc, run in PASSES:
+        if select and pass_id not in select:
+            continue
+        problems.extend(run(files, proj))  # type: ignore[operator]
+    # per-line justified waivers (the only suppression mechanism)
+    out: list[Problem] = []
+    for p in problems:
+        sf = by_rel.get(p.path)
+        if sf is not None and p.pass_id in sf.waived_lines.get(p.line, ()):
+            continue
+        out.append(p)
+    # malformed/unknown waivers are findings themselves
+    known = set(PASS_IDS) | {"syntax", "unused-import"}
+    for sf in files:
+        out.extend(waiver_problems(sf, known))
+    out.sort(key=lambda p: (p.path, p.line, p.pass_id))
+    return out
+
+
+__all__ = [
+    "PASSES", "PASS_IDS", "run_lint_passes", "SourceFile",
+    "load_source_file", "apply_waivers",
+]

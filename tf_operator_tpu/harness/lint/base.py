@@ -1,0 +1,159 @@
+"""tpulint core: parsed-source model, waiver grammar, pass registry glue.
+
+The project-specific passes (see ``tf_operator_tpu/harness/lint/``) extend
+the ``harness.checks`` gate with concurrency/discipline analyses. Every
+finding carries a pass id and can be waived ONLY per line, with a written
+justification::
+
+    # lint: ok lock-order — probe sweep snapshots under one lock by design
+
+Grammar: ``# lint: ok <pass-id>[,<pass-id>...] <dash> <reason>`` where
+``<dash>`` is ``—``/``–``/``-`` and ``<reason>`` is non-empty. A waiver
+comment covers findings on its own physical line; a standalone waiver
+comment line covers the line directly below it (for statements with no
+trailing room). There is deliberately NO file- or pass-level blanket
+ignore: an unjustified waiver is itself reported (pass id ``waiver``).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.harness.checks import Problem
+
+# ids: one or more pass ids separated by commas, spaces around commas
+# allowed ("ok lock-order, guarded-attr — ..."); a bare dash after a
+# space cannot extend the id list (extending requires a comma), so the
+# reason separator stays unambiguous
+_WAIVER_RE = re.compile(
+    r"#\s*lint:\s*ok\s+"
+    r"(?P<ids>[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*)"
+    r"(?:\s*(?:—|–|--|-)\s*(?P<reason>.*))?"
+)
+
+
+@dataclass
+class Waiver:
+    line: int
+    pass_ids: tuple[str, ...]
+    reason: str
+
+
+@dataclass
+class SourceFile:
+    """One parsed .py file shared by every pass (parse-once driver)."""
+
+    path: str                      # absolute
+    rel: str                       # root-relative, forward slashes
+    src: str
+    tree: ast.Module | None        # None on syntax error (reported elsewhere)
+    waivers: list[Waiver] = field(default_factory=list)
+    # line -> pass ids waived there (includes the line below standalone
+    # waiver comment lines)
+    waived_lines: dict[int, set[str]] = field(default_factory=dict)
+
+    @property
+    def module(self) -> str:
+        """Dotted module name: tf_operator_tpu/serve/scheduler.py ->
+        tf_operator_tpu.serve.scheduler; bench.py -> bench."""
+        mod = self.rel[:-3] if self.rel.endswith(".py") else self.rel
+        mod = mod.replace("/", ".").replace("\\", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+def load_source_file(path: str, root: str) -> SourceFile:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        tree = None
+    sf = SourceFile(path=path, rel=rel, src=src, tree=tree)
+    _parse_waivers(sf)
+    return sf
+
+
+def _parse_waivers(sf: SourceFile) -> None:
+    if "lint:" not in sf.src:
+        return  # fast path: tokenizing every file costs ~half the gate
+    # real COMMENT tokens only — a waiver spelled inside a string
+    # literal (e.g. a lint test embedding fixture source) is data, not
+    # a suppression
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(sf.src).readline)
+        comments = [
+            (tok.start[0], tok.string, tok.start[1])
+            for tok in tokens if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return  # syntax problems are reported by the syntax pass
+    lines = sf.src.splitlines()
+    for lineno, text, col in comments:
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        ids = tuple(p for p in re.split(r"[,\s]+", m.group("ids")) if p)
+        reason = (m.group("reason") or "").strip()
+        sf.waivers.append(Waiver(line=lineno, pass_ids=ids, reason=reason))
+        covered = {lineno}
+        line_text = lines[lineno - 1] if lineno <= len(lines) else ""
+        if line_text[:col].strip() == "":
+            covered.add(lineno + 1)  # standalone comment: next line too
+        for ln in covered:
+            sf.waived_lines.setdefault(ln, set()).update(ids)
+
+
+def problem(sf: SourceFile, line: int, pass_id: str, msg: str) -> Problem:
+    return Problem(sf.rel, line, msg, pass_id=pass_id)
+
+
+def apply_waivers(sf: SourceFile, problems: list[Problem]) -> list[Problem]:
+    """Drop findings covered by a justified per-line waiver; report
+    waivers that are missing their justification."""
+    out = [
+        p for p in problems
+        if p.pass_id not in sf.waived_lines.get(p.line, ())
+    ]
+    return out
+
+
+def waiver_problems(sf: SourceFile, known_ids: set[str]) -> list[Problem]:
+    out: list[Problem] = []
+    for w in sf.waivers:
+        if not w.reason:
+            out.append(problem(
+                sf, w.line, "waiver",
+                "waiver without justification: write "
+                "'# lint: ok <pass-id> — <reason>'",
+            ))
+        for pid in w.pass_ids:
+            if pid not in known_ids:
+                out.append(problem(
+                    sf, w.line, "waiver",
+                    f"waiver names unknown pass {pid!r} "
+                    f"(known: {', '.join(sorted(known_ids))})",
+                ))
+    return out
+
+
+def dotted_name(expr: ast.expr) -> str | None:
+    """Render Name/Attribute chains: ``self._engine.step`` / ``time.sleep``.
+    Calls inside the chain break it (returns None)."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    else:
+        return None
+    return ".".join(reversed(parts))

@@ -1,0 +1,141 @@
+"""Pass ``blocking-under-lock``: no slow calls inside a lock body.
+
+The watchdog-heartbeat (PR 7) and probe-sweep (PR 9) contracts both
+reduce to the same rule: state locks are held for bookkeeping, never
+across anything that can stall — a sleep, a subprocess, an HTTP send,
+joining a thread, waiting on an Event, or a device call. A blocked
+lock-holder stalls every thread behind it and turns a latency blip
+into a watchdog restart.
+
+Matched categories while any lock/condvar is held:
+
+- ``time.sleep``
+- ``subprocess.*`` process launches
+- HTTP/socket sends: ``urllib.request.urlopen``, ``*.urlopen``,
+  ``*.getresponse``, ``socket.create_connection``, ``requests.*``
+- ``Thread.join`` on attributes/locals typed ``threading.Thread``
+- ``Event.wait`` on attributes typed ``threading.Event``
+  (``Condition.wait`` is fine — it releases the lock)
+- device calls: ``jax.device_put/device_get``, ``*.block_until_ready``
+  — EXCEPT under a lock whose name contains ``device``: a coarse
+  device mutex exists precisely to serialize device work (the serve
+  scheduler's ``_device_lock`` contract).
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import SourceFile, problem
+
+PASS_ID = "blocking-under-lock"
+DOC = ("no sleeps, subprocess launches, HTTP sends, thread joins, Event "
+       "waits, or device calls while holding a lock/condvar")
+
+_EXACT = {
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "HTTP send",
+    "urlrequest.urlopen": "HTTP send",
+    "socket.create_connection": "socket connect",
+    "jax.device_put": "device call",
+    "jax.device_get": "device call",
+    "jax.block_until_ready": "device call",
+}
+_PREFIXES = {
+    "subprocess.": "subprocess launch",
+    "requests.": "HTTP send",
+}
+_SUFFIXES = {
+    ".block_until_ready": "device call",
+    ".getresponse": "HTTP response wait",
+}
+_DEVICE_CATEGORIES = {"device call"}
+
+
+def _category(dotted: str) -> str | None:
+    hit = _EXACT.get(dotted)
+    if hit is not None:
+        return hit
+    for pre, cat in _PREFIXES.items():
+        if dotted.startswith(pre):
+            return cat
+    for suf, cat in _SUFFIXES.items():
+        if dotted.endswith(suf):
+            return cat
+    return None
+
+
+def _typed_call_category(cm: cmod.ClassModel, dotted: str) -> str | None:
+    """Thread.join / Event.wait recognized through attribute types."""
+    parts = dotted.split(".")
+    if len(parts) == 3 and parts[0] == "self":
+        attr, meth = parts[1], parts[2]
+        if meth == "join" and attr in cm.thread_attrs:
+            return "Thread.join"
+        if meth == "wait" and attr in cm.event_attrs:
+            return "Event.wait"
+    return None
+
+
+def _held_all_device(cm: cmod.ClassModel,
+                     held: tuple[cmod.LockRef, ...]) -> bool:
+    return bool(held) and all("device" in r.name for r in held)
+
+
+def run(files: list[SourceFile], proj: cmod.Project) -> list[Problem]:
+    problems: list[Problem] = []
+    by_rel = {sf.rel: sf for sf in files}
+    for mm in proj.modules.values():
+        sf = by_rel.get(mm.sf.rel)
+        if sf is None:
+            continue
+        for cm in mm.classes.values():
+            for facts in cm.facts.values():
+                for call in facts.calls:
+                    if not call.held or call.dotted is None:
+                        continue
+                    cat = _category(call.dotted) \
+                        or _typed_call_category(cm, call.dotted)
+                    if cat is None:
+                        continue
+                    if cat in _DEVICE_CATEGORIES \
+                            and _held_all_device(cm, call.held):
+                        continue
+                    locks = ", ".join(r.name for r in call.held)
+                    problems.append(problem(
+                        sf, call.line, PASS_ID,
+                        f"{cat} ({call.dotted}) while holding {locks} — "
+                        "move the blocking call outside the lock body",
+                    ))
+                # one-level cross-class: a held-lock call into a typed
+                # attribute whose method directly blocks
+                for call in facts.calls:
+                    if not call.held or call.dotted is None:
+                        continue
+                    parts = call.dotted.split(".")
+                    if len(parts) != 3 or parts[0] != "self":
+                        continue
+                    tname = cm.attr_types.get(parts[1])
+                    if tname is None:
+                        continue
+                    tcm = proj.resolve_type(mm, tname)
+                    if tcm is None:
+                        continue
+                    tfacts = tcm.facts.get(parts[2])
+                    if tfacts is None:
+                        continue
+                    for sub in tfacts.calls:
+                        cat = sub.dotted and _category(sub.dotted)
+                        if not cat:
+                            continue
+                        if cat in _DEVICE_CATEGORIES \
+                                and _held_all_device(cm, call.held):
+                            continue
+                        locks = ", ".join(r.name for r in call.held)
+                        problems.append(problem(
+                            sf, call.line, PASS_ID,
+                            f"call into {tname}.{parts[2]} (which does a "
+                            f"{cat}: {sub.dotted}) while holding {locks}",
+                        ))
+                        break
+    return problems

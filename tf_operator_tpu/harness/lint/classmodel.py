@@ -1,0 +1,895 @@
+"""Whole-program class/lock model shared by the concurrency passes.
+
+This module turns the parsed tree set into:
+
+- per-class **lock attributes** (``self._lock = threading.Lock()`` and
+  friends, including ``param or threading.Lock()`` and lock-annotated
+  constructor params) plus module-level and function-local locks;
+- per-class **attribute types** (``self._x = ClassName(...)``,
+  annotated params/attrs) resolved across modules through imports, so
+  the lock-order pass can follow ``self._membership.probe()`` into
+  ``FleetMembership``;
+- per-method **facts**: every lock acquisition, every ``self.X``
+  access, and every call — each annotated with the ordered list of
+  locks held at that point (a linear symbolic walk over the statement
+  tree: ``with`` bodies, bare ``acquire()``/``release()`` spans, the
+  ``while not lock.acquire(timeout=..)`` idiom, try/finally).
+
+Lock node ids are instance-agnostic (``<module>.<Class>.<attr>``), the
+classic abstraction for lock-order analysis. ``creation sites`` —
+(file, line) of each ``threading.Lock()``-family call — are exported so
+the runtime witness (runtime/lockwitness.py) can map live lock objects
+back onto static nodes by the site that allocated them.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.harness.lint.base import SourceFile, dotted_name
+
+LOCK_FACTORIES = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+}
+# Names that annotate a lock-typed constructor param.
+_LOCK_ANNOTATIONS = {"Lock", "RLock", "Condition"}
+
+
+@dataclass(frozen=True)
+class LockRef:
+    """A lock as referenced from inside one method."""
+
+    scope: str   # "self" | "module" | "local" | "other"
+    name: str    # attribute / global / local name; for "other" the
+    #              fully-qualified "<module>.<Class>.<attr>" node id
+    kind: str | None = None   # pre-resolved kind for "other" refs
+
+
+@dataclass
+class LockInfo:
+    kind: str                    # lock | rlock | condition
+    site_line: int | None        # line of the threading.X() call, if created
+    alias_params: tuple[str, ...] = ()   # ctor params this attr may alias
+    # qual of the DEFINING class when the attr is inherited — lock nodes
+    # are named after the class that creates the lock (Counter/Gauge/
+    # Histogram all share _Family._lock)
+    owner_qual: str | None = None
+
+
+@dataclass
+class AccessFact:
+    attr: str
+    is_write: bool
+    line: int
+    held: tuple[LockRef, ...]
+
+
+@dataclass
+class CallFact:
+    dotted: str | None           # "self._engine.step", "time.sleep", ...
+    node: ast.Call
+    line: int
+    held: tuple[LockRef, ...]
+    # class name (as written) of the receiver when it is a param/local
+    # with a known type: `sched.fence_and_harvest()` with
+    # `sched: ContinuousScheduler` resolves cross-class
+    recv_type: str | None = None
+
+
+@dataclass
+class AcquireFact:
+    ref: LockRef
+    line: int
+    held: tuple[LockRef, ...]    # held BEFORE this acquisition
+
+
+@dataclass
+class MethodFacts:
+    name: str                    # may be "meth.<locals>.fn" for nested defs
+    entry_public: bool           # analyzed as externally callable
+    acquires: list[AcquireFact] = field(default_factory=list)
+    accesses: list[AccessFact] = field(default_factory=list)
+    calls: list[CallFact] = field(default_factory=list)
+
+
+@dataclass
+class ClassModel:
+    module: str
+    rel: str
+    name: str
+    node: ast.ClassDef
+    bases: tuple[str, ...]
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    lock_attrs: dict[str, LockInfo] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    event_attrs: set[str] = field(default_factory=set)
+    thread_attrs: set[str] = field(default_factory=set)
+    facts: dict[str, MethodFacts] = field(default_factory=dict)
+    # module-level lock names visible from this class's methods
+    module_locks: dict[str, LockInfo] = field(default_factory=dict)
+    is_module_scope: bool = False  # synthetic holder of top-level functions
+    # memo for @contextmanager lock extraction (`with self._device():`)
+    ctx_cache: dict[str, tuple["LockRef", ...]] = field(default_factory=dict)
+    # creation line -> node id for function-local locks (the witness
+    # maps live locks by creation site; locals must be nameable too)
+    local_lock_sites: dict[int, str] = field(default_factory=dict)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.module}.{self.name}"
+
+    def lock_node(self, attr: str) -> str:
+        return f"{self.qual}.{attr}"
+
+
+@dataclass
+class ModuleModel:
+    sf: SourceFile
+    classes: dict[str, ClassModel] = field(default_factory=dict)
+    module_locks: dict[str, LockInfo] = field(default_factory=dict)
+    imports: dict[str, str] = field(default_factory=dict)  # local -> dotted
+    # module-level instances: NAME = ClassName(...) -> class name as written
+    global_types: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Project:
+    modules: dict[str, ModuleModel] = field(default_factory=dict)  # dotted
+    classes: dict[str, ClassModel] = field(default_factory=dict)   # qual
+
+    def resolve_class(self, mod: ModuleModel, name: str) -> ClassModel | None:
+        """Resolve a (possibly dotted) name used in ``mod`` to a class."""
+        if name in mod.classes:
+            return mod.classes[name]
+        if "." in name:
+            head, _, rest = name.partition(".")
+            target = mod.imports.get(head)
+            if target is not None:
+                return self.classes.get(f"{target}.{rest}")
+            return self.classes.get(name)
+        target = mod.imports.get(name)
+        if target is not None:
+            return self.classes.get(target)
+        return None
+
+    def resolve_type(self, mod: ModuleModel, name: str) -> ClassModel | None:
+        """Like resolve_class, but a name that denotes a module-level
+        INSTANCE (``NULL_INJECTOR``, ``SERVE_TRACER``) resolves to the
+        instance's class — local or imported."""
+        got = self.resolve_class(mod, name)
+        if got is not None:
+            return got
+        tname = mod.global_types.get(name)
+        if tname is not None:
+            return self.resolve_class(mod, tname)
+        target = mod.imports.get(name)
+        if target is not None:
+            owner_mod, _, owner_name = target.rpartition(".")
+            owner_mm = self.modules.get(owner_mod)
+            if owner_mm is not None:
+                tname = owner_mm.global_types.get(owner_name)
+                if tname is not None:
+                    return self.resolve_class(owner_mm, tname)
+        return None
+
+
+def _lock_call_kind(node: ast.expr) -> tuple[str, int] | None:
+    """threading.Lock() / Lock() style call -> (kind, line)."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "threading":
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    kind = LOCK_FACTORIES.get(name or "")
+    if kind is None:
+        return None
+    return kind, node.lineno
+
+
+def _find_lock_call(expr: ast.expr) -> tuple[str, int] | None:
+    """Find a lock-factory call anywhere inside expr (covers the
+    ``param or threading.Lock()`` default idiom)."""
+    for sub in ast.walk(expr):
+        got = _lock_call_kind(sub)
+        if got is not None:
+            return got
+    return None
+
+
+def _annotation_lock_kind(ann: ast.expr | None) -> str | None:
+    if ann is None:
+        return None
+    for sub in ast.walk(ann):
+        name = None
+        if isinstance(sub, ast.Attribute) and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "threading":
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name in _LOCK_ANNOTATIONS:
+            return LOCK_FACTORIES[name]
+    return None
+
+
+def _annotation_type_name(ann: ast.expr | None) -> str | None:
+    """'ClassName' out of ``ClassName``/``ClassName | None``/``Optional[..]``
+    annotations (string annotations included)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:
+            ann = ast.parse(ann.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            got = _annotation_type_name(side)
+            if got is not None:
+                return got
+        return None
+    if isinstance(ann, ast.Subscript):
+        base = dotted_name(ann.value)
+        if base in ("Optional", "typing.Optional"):
+            return _annotation_type_name(
+                ann.slice if not isinstance(ann.slice, ast.Tuple) else None
+            )
+        return None
+    if isinstance(ann, ast.Constant) and ann.value is None:
+        return None
+    name = dotted_name(ann)
+    if name in (None, "None", "Any", "typing.Any", "object"):
+        return None
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Model construction
+# ---------------------------------------------------------------------------
+
+
+def build_project(files: list[SourceFile]) -> Project:
+    proj = Project()
+    for sf in files:
+        if sf.tree is None:
+            continue
+        mm = ModuleModel(sf=sf)
+        _collect_imports(sf.tree, mm)
+        _collect_module_locks(sf.tree, mm)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                cm = _build_class(sf, node)
+                mm.classes[cm.name] = cm
+                proj.classes.setdefault(cm.qual, cm)
+        # synthetic scope for module-level functions (they use module
+        # locks: native._LOCK, serve.httpapi._ttft_lock, ...)
+        modscope = ClassModel(
+            module=sf.module, rel=sf.rel, name="<module>",
+            node=ast.ClassDef(
+                name="<module>", bases=[], keywords=[], body=[],
+                decorator_list=[],
+            ),
+            bases=(), is_module_scope=True,
+        )
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                modscope.methods[node.name] = node  # type: ignore[assignment]
+        mm.classes["<module>"] = modscope
+        proj.modules[sf.module] = mm
+    _resolve_inheritance(proj)
+    for mm in proj.modules.values():
+        # The concurrency passes (facts-driven: lock-order, guarded-attr,
+        # blocking-under-lock) cover SHIPPED code; test modules are
+        # covered at runtime by the lock witness instead (the chaos
+        # suites assert observed edges ⊆ this graph), and skipping their
+        # method walks roughly halves the gate's cost. The AST-driven
+        # passes (metrics-registry, typed-error) still scan tests.
+        if mm.sf.rel.startswith("tests/") \
+                and "lint_fixtures" not in mm.sf.rel:
+            continue
+        for cm in mm.classes.values():
+            cm.module_locks = mm.module_locks
+            for name, fn in list(cm.methods.items()):
+                _walk_method(cm, name, fn, proj, mm)
+    return proj
+
+
+def _resolve_inheritance(proj: Project) -> None:
+    """Merge base-class lock/event/thread/type attrs into subclasses so
+    ``with self._lock`` in a subclass method resolves to the lock the
+    base created (named after the defining class)."""
+    done: set[str] = set()
+
+    def resolve(cm: ClassModel, depth: int = 0) -> None:
+        if cm.qual in done or depth > 8:
+            return
+        done.add(cm.qual)
+        mm = proj.modules.get(cm.module)
+        if mm is None:
+            return
+        for bname in cm.bases:
+            bcm = proj.resolve_class(mm, bname)
+            if bcm is None or bcm.qual == cm.qual:
+                continue
+            resolve(bcm, depth + 1)
+            for attr, info in bcm.lock_attrs.items():
+                if attr not in cm.lock_attrs:
+                    cm.lock_attrs[attr] = LockInfo(
+                        info.kind, info.site_line, info.alias_params,
+                        owner_qual=info.owner_qual or bcm.qual,
+                    )
+            for attr, t in bcm.attr_types.items():
+                cm.attr_types.setdefault(attr, t)
+            cm.event_attrs |= bcm.event_attrs
+            cm.thread_attrs |= bcm.thread_attrs
+
+    for cm in list(proj.classes.values()):
+        resolve(cm)
+
+
+def method_owner(proj: Project, cm: ClassModel, meth: str,
+                 depth: int = 0) -> ClassModel | None:
+    """The class (``cm`` or a base) whose ``facts`` define ``meth``."""
+    if meth in cm.facts:
+        return cm
+    if depth > 8:
+        return None
+    mm = proj.modules.get(cm.module)
+    if mm is None:
+        return None
+    for bname in cm.bases:
+        bcm = proj.resolve_class(mm, bname)
+        if bcm is not None and bcm.qual != cm.qual:
+            got = method_owner(proj, bcm, meth, depth + 1)
+            if got is not None:
+                return got
+    return None
+
+
+def _collect_imports(tree: ast.Module, mm: ModuleModel) -> None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mm.imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name != "*":
+                    mm.imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+
+
+# REGISTRY.counter(...) / .gauge / .histogram return these family classes
+# (runtime/metrics.py) — special-cased so "metric mutation under a lock"
+# edges resolve to the family's internal lock.
+_REGISTRY_FACTORY_TYPES = {
+    "counter": "tf_operator_tpu.runtime.metrics.Counter",
+    "gauge": "tf_operator_tpu.runtime.metrics.Gauge",
+    "histogram": "tf_operator_tpu.runtime.metrics.Histogram",
+}
+
+
+def _value_class_name(value: ast.expr | None) -> str | None:
+    """Class name (as written) a value expression instantiates, covering
+    the ``x or ClassName()`` default idiom and registry factories."""
+    if value is None:
+        return None
+    for sub in ast.walk(value):
+        if not isinstance(sub, ast.Call):
+            continue
+        callee = dotted_name(sub.func)
+        if callee is None or callee.startswith("self."):
+            continue
+        parts = callee.split(".")
+        if len(parts) >= 2 and parts[-2] == "REGISTRY" \
+                and parts[-1] in _REGISTRY_FACTORY_TYPES:
+            return _REGISTRY_FACTORY_TYPES[parts[-1]]
+        if parts[-1][:1].isupper():
+            return callee
+    return None
+
+
+def _collect_module_locks(tree: ast.Module, mm: ModuleModel) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            got = _find_lock_call(node.value)
+            if got is not None:
+                kind, line = got
+                mm.module_locks[node.targets[0].id] = LockInfo(kind, line)
+                continue
+            tname = _value_class_name(node.value)
+            if tname is not None:
+                mm.global_types[node.targets[0].id] = tname
+
+
+def _build_class(sf: SourceFile, node: ast.ClassDef) -> ClassModel:
+    cm = ClassModel(
+        module=sf.module, rel=sf.rel, name=node.name, node=node,
+        bases=tuple(
+            d for d in (dotted_name(b) for b in node.bases) if d
+        ),
+    )
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cm.methods[item.name] = item  # type: ignore[assignment]
+        elif isinstance(item, ast.AnnAssign) \
+                and isinstance(item.target, ast.Name):
+            # class-body annotation: `server: KubeApiStub` on a handler
+            # types an attribute the framework injects at runtime
+            tname = _annotation_type_name(item.annotation)
+            if tname is not None:
+                cm.attr_types.setdefault(item.target.id, tname)
+    for meth in cm.methods.values():
+        param_ann = {
+            a.arg: a.annotation
+            for a in list(meth.args.posonlyargs) + list(meth.args.args)
+            + list(meth.args.kwonlyargs)
+        }
+        for st in ast.walk(meth):
+            attr, value, ann = None, None, None
+            if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                tgt = st.targets[0]
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    attr, value = tgt.attr, st.value
+            elif isinstance(st, ast.AnnAssign):
+                tgt = st.target
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    attr, value, ann = tgt.attr, st.value, st.annotation
+            if attr is None:
+                continue
+            _classify_attr(cm, attr, value, ann, param_ann)
+    return cm
+
+
+def _classify_attr(cm: ClassModel, attr: str, value: ast.expr | None,
+                   ann: ast.expr | None,
+                   param_ann: dict[str, ast.expr | None]) -> None:
+    lock = _find_lock_call(value) if value is not None else None
+    aliases: list[str] = []
+    if value is not None:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Name) and sub.id in param_ann:
+                aliases.append(sub.id)
+    if lock is not None:
+        kind, line = lock
+        cm.lock_attrs.setdefault(
+            attr, LockInfo(kind, line, tuple(aliases))
+        )
+        return
+    # lock-annotated attr or lock-annotated ctor param assigned through
+    ann_kind = _annotation_lock_kind(ann)
+    if ann_kind is None and isinstance(value, ast.Name) \
+            and value.id in param_ann:
+        ann_kind = _annotation_lock_kind(param_ann[value.id])
+    if ann_kind is not None:
+        cm.lock_attrs.setdefault(
+            attr, LockInfo(ann_kind, None, tuple(aliases))
+        )
+        return
+    if value is not None:
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                callee = dotted_name(sub.func)
+                if callee == "threading.Event":
+                    cm.event_attrs.add(attr)
+                    return
+                if callee == "threading.Thread":
+                    cm.thread_attrs.add(attr)
+                    return
+        tname = _value_class_name(value)
+        if tname is not None:
+            cm.attr_types.setdefault(attr, tname)
+            return
+        # self._sched_cls = ContinuousScheduler (a class stored to call
+        # later) — record so `self.X = self._sched_cls(...)` resolves
+        if isinstance(value, ast.Name) and value.id[:1].isupper():
+            cm.attr_types.setdefault(attr, value.id)
+            return
+        # `self.faults = faults or NULL_INJECTOR`: the default names a
+        # module-level instance — its type resolves at pass time via
+        # global_types (see Project.resolve_type)
+        if isinstance(value, ast.BoolOp) and isinstance(value.op, ast.Or):
+            last = value.values[-1]
+            if isinstance(last, ast.Name) and last.id[:1].isupper():
+                cm.attr_types.setdefault(attr, last.id)
+                return
+        # self._sched = self._sched_cls(...): type of the called attr
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            if callee is not None and callee.startswith("self.") \
+                    and callee.count(".") == 1:
+                src = callee.split(".")[1]
+                if src in cm.attr_types:
+                    cm.attr_types.setdefault(attr, cm.attr_types[src])
+                    return
+    # typed via annotation, or via an annotated ctor param
+    tname = _annotation_type_name(ann)
+    if tname is None and isinstance(value, ast.Name) \
+            and value.id in param_ann:
+        tname = _annotation_type_name(param_ann[value.id])
+    if tname is not None and tname not in ("threading.Lock",):
+        cm.attr_types.setdefault(attr, tname)
+
+
+# ---------------------------------------------------------------------------
+# The held-region walker
+# ---------------------------------------------------------------------------
+
+
+class _Walk:
+    def __init__(self, cm: ClassModel, facts: MethodFacts,
+                 param_types: dict[str, str] | None = None,
+                 proj: "Project | None" = None,
+                 mm: "ModuleModel | None" = None) -> None:
+        self.cm = cm
+        self.facts = facts
+        self.proj = proj
+        self.mm = mm
+        self.held: list[LockRef] = []
+        self.local_locks: dict[str, LockInfo] = {}
+        self.local_types: dict[str, str] = dict(param_types or {})
+        self.nested: list[tuple[str, ast.FunctionDef]] = []
+
+    # -- lock reference resolution --------------------------------------
+
+    def lock_ref(self, expr: ast.expr) -> LockRef | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" \
+                and expr.attr in self.cm.lock_attrs:
+            return LockRef("self", expr.attr)
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks:
+                return LockRef("local", expr.id)
+            if expr.id in self.cm.module_locks:
+                return LockRef("module", expr.id)
+            return None
+        return None
+
+    def _value_type(self, value: ast.expr) -> str | None:
+        """Type of a local assignment's value: ``ClassName(...)``,
+        ``self._attr`` / ``self._cls_attr(...)`` with known attr types."""
+        got = _value_class_name(value)
+        if got is not None:
+            return got
+        if isinstance(value, ast.Call):
+            callee = dotted_name(value.func)
+            if callee is not None and callee.startswith("self.") \
+                    and callee.count(".") == 1:
+                return self.cm.attr_types.get(callee.split(".")[1])
+        if isinstance(value, ast.Attribute) \
+                and isinstance(value.value, ast.Name) \
+                and value.value.id == "self":
+            return self.cm.attr_types.get(value.attr)
+        return None
+
+    def _ctxmgr_locks(self, expr: ast.expr) -> tuple[LockRef, ...]:
+        if not isinstance(expr, ast.Call):
+            return ()
+        callee = dotted_name(expr.func)
+        if callee is None or not callee.startswith("self."):
+            return ()
+        if callee.count(".") == 2:
+            return self._ctxmgr_other_locks(callee)
+        if callee.count(".") != 1:
+            return ()
+        meth = callee.split(".")[1]
+        fn = self.cm.methods.get(meth)
+        if fn is None:
+            return ()
+        key = meth
+        cached = self.cm.ctx_cache.get(key)
+        if cached is None:
+            refs: list[LockRef] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "acquire":
+                    tgt = node.func.value
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and tgt.attr in self.cm.lock_attrs:
+                        refs.append(LockRef("self", tgt.attr))
+            cached = tuple(dict.fromkeys(refs))
+            self.cm.ctx_cache[key] = cached
+        return cached
+
+    def _ctxmgr_other_locks(self, callee: str) -> tuple[LockRef, ...]:
+        """``with self.server.mutation_lock(kind):`` — a method on a
+        TYPED attribute that hands back one of its class's locks (the
+        kubestub/apiserver per-kind mutation serialization idiom). The
+        target method is scanned for ``return self.<lockattr>``; a
+        conditional nullcontext branch over-approximates to "held",
+        which is sound for ordering (extra static edges, never missing
+        ones)."""
+        if self.proj is None or self.mm is None:
+            return ()
+        _, attr, meth = callee.split(".")
+        tname = self.cm.attr_types.get(attr)
+        if tname is None:
+            return ()
+        tcm = self.proj.resolve_type(self.mm, tname)
+        if tcm is None:
+            return ()
+        key = f"{attr}.{meth}"
+        cached = self.cm.ctx_cache.get(key)
+        if cached is None:
+            refs: list[LockRef] = []
+            fn = tcm.methods.get(meth)
+            if fn is not None:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return) \
+                            and isinstance(node.value, ast.Attribute) \
+                            and isinstance(node.value.value, ast.Name) \
+                            and node.value.value.id == "self" \
+                            and node.value.attr in tcm.lock_attrs:
+                        info = tcm.lock_attrs[node.value.attr]
+                        owner = info.owner_qual or tcm.qual
+                        refs.append(LockRef(
+                            "other", f"{owner}.{node.value.attr}",
+                            kind=info.kind,
+                        ))
+            cached = tuple(dict.fromkeys(refs))
+            self.cm.ctx_cache[key] = cached
+        return cached
+
+    def push(self, ref: LockRef, line: int) -> None:
+        self.facts.acquires.append(
+            AcquireFact(ref, line, tuple(self.held))
+        )
+        self.held.append(ref)
+
+    def pop(self, ref: LockRef) -> None:
+        if ref in self.held:
+            # remove the LAST occurrence (re-entrant with-nesting)
+            for i in range(len(self.held) - 1, -1, -1):
+                if self.held[i] == ref:
+                    del self.held[i]
+                    break
+
+    # -- statements ------------------------------------------------------
+
+    def body(self, stmts: list[ast.stmt]) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            pushed: list[LockRef] = []
+            for item in st.items:
+                ref = self.lock_ref(item.context_expr)
+                if ref is not None:
+                    self.push(ref, item.context_expr.lineno)
+                    pushed.append(ref)
+                    continue
+                # `with self._device():` — a same-class @contextmanager
+                # that acquires a lock for its body (the serve
+                # scheduler's heartbeating device mutex). Scan the call
+                # BEFORE pushing: entering the manager happens unheld.
+                self.expr(item.context_expr)
+                for cref in self._ctxmgr_locks(item.context_expr):
+                    self.push(cref, item.context_expr.lineno)
+                    pushed.append(cref)
+            self.body(st.body)
+            for ref in reversed(pushed):
+                self.pop(ref)
+        elif isinstance(st, ast.While):
+            acq = self.expr(st.test, collect_acquires=True)
+            self.body(st.body)
+            self.body(st.orelse)
+            # `while not lock.acquire(timeout=..): ...` — after the loop
+            # exits, the lock is held for the remainder of the method
+            for ref, line in acq:
+                self.push(ref, line)
+        elif isinstance(st, ast.If):
+            acq = self.expr(st.test, collect_acquires=True)
+            for ref, line in acq:
+                self.push(ref, line)
+            self.body(st.body)
+            for ref, _ in reversed(acq):
+                self.pop(ref)
+            self.body(st.orelse)
+        elif isinstance(st, ast.For):
+            self.expr(st.iter)
+            self.body(st.body)
+            self.body(st.orelse)
+        elif isinstance(st, ast.Try):
+            self.body(st.body)
+            for h in st.handlers:
+                self.body(h.body)
+            self.body(st.orelse)
+            self.body(st.finalbody)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later (thread target / callback) — record
+            # as a separate externally-entered pseudo-method
+            self.nested.append((st.name, st))  # type: ignore[arg-type]
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, ast.Assign):
+            got = _find_lock_call(st.value)
+            if got is not None and len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                kind, line = got
+                name = st.targets[0].id
+                self.local_locks[name] = LockInfo(kind, line)
+                self.cm.local_lock_sites.setdefault(
+                    line, f"{self.cm.qual}.{self.facts.name}.{name}"
+                )
+            elif len(st.targets) == 1 \
+                    and isinstance(st.targets[0], ast.Name):
+                tname = self._value_type(st.value)
+                if tname is not None:
+                    self.local_types[st.targets[0].id] = tname
+            for tgt in st.targets:
+                self.target(tgt)
+            self.expr(st.value)
+        elif isinstance(st, ast.AugAssign):
+            self.target(st.target, also_read=True)
+            self.expr(st.value)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.expr(st.value)
+            self.target(st.target)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+                elif isinstance(child, ast.stmt):
+                    self.stmt(child)
+
+    def target(self, tgt: ast.expr, also_read: bool = False) -> None:
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            self.facts.accesses.append(AccessFact(
+                tgt.attr, True, tgt.lineno, tuple(self.held)
+            ))
+            if also_read:
+                self.facts.accesses.append(AccessFact(
+                    tgt.attr, False, tgt.lineno, tuple(self.held)
+                ))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self.target(el, also_read=also_read)
+        elif isinstance(tgt, (ast.Subscript, ast.Starred, ast.Attribute)):
+            # self._x[k] = v reads self._x
+            self.expr(tgt.value if not isinstance(tgt, ast.Starred)
+                      else tgt.value)
+
+    # -- expressions -----------------------------------------------------
+
+    def expr(self, e: ast.expr | None, collect_acquires: bool = False
+             ) -> list[tuple[LockRef, int]]:
+        acquired: list[tuple[LockRef, int]] = []
+        if e is None:
+            return acquired
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                self._call(node, acquired, collect_acquires)
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" \
+                    and isinstance(node.ctx, ast.Load):
+                self.facts.accesses.append(AccessFact(
+                    node.attr, False, node.lineno, tuple(self.held)
+                ))
+            elif isinstance(node, (ast.Lambda,)):
+                pass  # body nodes reached by ast.walk; treated inline
+        return acquired
+
+    def _call(self, node: ast.Call, acquired: list[tuple[LockRef, int]],
+              collect_acquires: bool) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            ref = self.lock_ref(fn.value)
+            if ref is None and isinstance(fn.value, ast.Name):
+                # module-level lock? leave to the pass (needs module ctx);
+                # record the dotted call below instead
+                pass
+            if ref is not None:
+                if fn.attr == "acquire":
+                    if collect_acquires:
+                        acquired.append((ref, node.lineno))
+                    else:
+                        self.push(ref, node.lineno)
+                    return
+                if fn.attr == "release":
+                    self.pop(ref)
+                    return
+                # cond.wait()/notify()/locked() — not an ordering event
+                return
+        dotted = dotted_name(fn)
+        recv_type = None
+        if dotted is not None and "." in dotted:
+            head = dotted.split(".")[0]
+            recv_type = self.local_types.get(head)
+        self.facts.calls.append(
+            CallFact(dotted, node, node.lineno, tuple(self.held),
+                     recv_type=recv_type)
+        )
+
+
+def _walk_method(cm: ClassModel, name: str, fn: ast.FunctionDef,
+                 proj: "Project | None" = None,
+                 mm: "ModuleModel | None" = None) -> None:
+    facts = MethodFacts(
+        name=name,
+        entry_public=not name.startswith("_") or _is_dunder(name),
+    )
+    param_types: dict[str, str] = {}
+    for a in list(fn.args.posonlyargs) + list(fn.args.args) \
+            + list(fn.args.kwonlyargs):
+        tname = _annotation_type_name(a.annotation)
+        if tname is not None:
+            param_types[a.arg] = tname
+    w = _Walk(cm, facts, param_types, proj, mm)
+    w.body(fn.body)
+    cm.facts[name] = facts
+    for nested_name, nested_fn in w.nested:
+        pseudo = f"{name}.<locals>.{nested_name}"
+        nested_facts = MethodFacts(name=pseudo, entry_public=True)
+        nw = _Walk(cm, nested_facts, param_types, proj, mm)
+        nw.local_locks = dict(w.local_locks)
+        nw.local_types.update(w.local_types)
+        nw.body(nested_fn.body)
+        cm.facts[pseudo] = nested_facts
+
+
+def _is_dunder(name: str) -> bool:
+    return name.startswith("__") and name.endswith("__")
+
+
+# ---------------------------------------------------------------------------
+# Lock node naming + creation-site map
+# ---------------------------------------------------------------------------
+
+
+def lock_node_id(proj: Project, cm: ClassModel, ref: LockRef,
+                 method: str) -> str | None:
+    if ref.scope == "other":
+        return ref.name
+    if ref.scope == "self":
+        info = cm.lock_attrs.get(ref.name)
+        if info is not None and info.owner_qual is not None:
+            return f"{info.owner_qual}.{ref.name}"
+        return cm.lock_node(ref.name)
+    if ref.scope == "module":
+        return f"{cm.module}.{ref.name}"
+    if ref.scope == "local":
+        return f"{cm.qual}.{method}.{ref.name}"
+    return None
+
+
+def creation_sites(proj: Project) -> dict[tuple[str, int], str]:
+    """(rel-path, line of the threading.X() call) -> lock node id, for
+    every statically known lock creation. The runtime witness keys live
+    locks by the frame that allocated them and uses this map to name
+    them."""
+    sites: dict[tuple[str, int], str] = {}
+    for mm in proj.modules.values():
+        rel = mm.sf.rel
+        for name, info in mm.module_locks.items():
+            if info.site_line is not None:
+                sites[(rel, info.site_line)] = f"{mm.sf.module}.{name}"
+        for cm in mm.classes.values():
+            for attr, info in cm.lock_attrs.items():
+                # inherited copies (owner_qual set) would mis-name the
+                # site after the LAST subclass — only the defining class
+                # owns the creation site
+                if info.site_line is not None and info.owner_qual is None:
+                    sites[(cm.rel, info.site_line)] = cm.lock_node(attr)
+            for line, node in cm.local_lock_sites.items():
+                sites.setdefault((cm.rel, line), node)
+    return sites

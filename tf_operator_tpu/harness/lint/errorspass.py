@@ -1,0 +1,201 @@
+"""Pass ``typed-error``: the ServeError wire-code vocabulary.
+
+PR 7's contract is that every client-visible failure carries a ``code``
+from one taxonomy (``serve/resilience.py`` — the ``ServeError``
+subclasses plus the transport codes the router mints), because the
+fleet router *dispatches on those strings* (retry elsewhere / eject /
+give up) and a typo'd or undeclared code silently downgrades to
+"not retryable".
+
+Checked across the tree:
+
+- a class subclassing a taxonomy error outside ``resilience.py`` must
+  not mint a ``code`` the taxonomy doesn't know;
+- every string literal compared against a code-valued expression
+  (``payload["code"] == ...``, ``.get("code") in (...)``, ``err.code``)
+  must be a known code;
+- every ``{"code": "..."}`` payload literal must use a known code;
+- module-level code-set constants used in ``code in NAME`` dispatch
+  (e.g. the router's ``RETRY_ELSEWHERE``) must contain only known codes.
+
+The vocabulary = ``code`` class attrs of ``ServeError`` subclasses in
+``serve/resilience.py`` + its ``WIRE_CODES`` constant + ``internal``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import SourceFile, dotted_name, problem
+
+PASS_ID = "typed-error"
+DOC = ("every ServeError subclass / code literal / code-set constant uses "
+       "a code declared in the serve/resilience.py taxonomy")
+
+_TAXONOMY_MODULE = "tf_operator_tpu.serve.resilience"
+
+
+def _taxonomy(proj: cmod.Project) -> tuple[set[str], set[str]]:
+    """(known codes, taxonomy class names) from resilience.py."""
+    codes = {"internal"}
+    class_names: set[str] = set()
+    mm = proj.modules.get(_TAXONOMY_MODULE)
+    if mm is None or mm.sf.tree is None:
+        return codes, class_names
+    # transitive ServeError descendants within the module
+    bases: dict[str, tuple[str, ...]] = {}
+    code_attr: dict[str, str] = {}
+    for node in mm.sf.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases[node.name] = tuple(
+            d for d in (dotted_name(b) for b in node.bases) if d
+        )
+        for item in node.body:
+            if isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name) \
+                    and item.targets[0].id == "code" \
+                    and isinstance(item.value, ast.Constant) \
+                    and isinstance(item.value.value, str):
+                code_attr[node.name] = item.value.value
+    descendants = {"ServeError"}
+    changed = True
+    while changed:
+        changed = False
+        for name, bs in bases.items():
+            if name not in descendants and any(b in descendants for b in bs):
+                descendants.add(name)
+                changed = True
+    class_names = descendants & set(bases)
+    for name in class_names:
+        if name in code_attr:
+            codes.add(code_attr[name])
+    # WIRE_CODES: the transport codes minted outside ServeError raises
+    for node in mm.sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "WIRE_CODES":
+            got = _str_elements(node.value)
+            if got is not None:
+                codes.update(got)
+    return codes, class_names
+
+
+def _str_elements(node: ast.expr) -> set[str] | None:
+    if isinstance(node, ast.Call) and dotted_name(node.func) in (
+            "frozenset", "set", "tuple") and node.args:
+        return _str_elements(node.args[0])
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+            else:
+                return None
+        return out
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        left = _str_elements(node.left)
+        right = _str_elements(node.right)
+        if left is not None and right is not None:
+            return left | right
+    return None
+
+
+def _is_code_expr(e: ast.expr) -> bool:
+    if isinstance(e, ast.Subscript) \
+            and isinstance(e.slice, ast.Constant) and e.slice.value == "code":
+        return True
+    if isinstance(e, ast.Call) and isinstance(e.func, ast.Attribute) \
+            and e.func.attr == "get" and e.args \
+            and isinstance(e.args[0], ast.Constant) \
+            and e.args[0].value == "code":
+        return True
+    if isinstance(e, ast.Attribute) and e.attr == "code":
+        return True
+    return False
+
+
+def run(files: list[SourceFile], proj: cmod.Project) -> list[Problem]:
+    problems: list[Problem] = []
+    codes, taxonomy_classes = _taxonomy(proj)
+    if not taxonomy_classes:
+        return problems   # no taxonomy in tree (fixture runs)
+    by_rel = {sf.rel: sf for sf in files}
+    for mm in proj.modules.values():
+        sf = by_rel.get(mm.sf.rel)
+        if sf is None or sf.tree is None:
+            continue
+        in_taxonomy = mm.sf.module == _TAXONOMY_MODULE
+        code_set_names: set[str] = set()
+        for node in ast.walk(sf.tree):
+            # subclasses minting unknown codes
+            if isinstance(node, ast.ClassDef) and not in_taxonomy:
+                base_names = {
+                    (dotted_name(b) or "").split(".")[-1]
+                    for b in node.bases
+                }
+                if base_names & taxonomy_classes:
+                    for item in node.body:
+                        if isinstance(item, ast.Assign) \
+                                and len(item.targets) == 1 \
+                                and isinstance(item.targets[0], ast.Name) \
+                                and item.targets[0].id == "code" \
+                                and isinstance(item.value, ast.Constant) \
+                                and isinstance(item.value.value, str) \
+                                and item.value.value not in codes:
+                            problems.append(problem(
+                                sf, item.lineno, PASS_ID,
+                                f"ServeError subclass {node.name} mints "
+                                f"unknown code {item.value.value!r} — "
+                                "declare it in serve/resilience.py "
+                                "(taxonomy / WIRE_CODES)",
+                            ))
+            # comparisons against code-valued expressions
+            if isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                if any(_is_code_expr(s) for s in sides):
+                    for s, op in zip(node.comparators, node.ops):
+                        if isinstance(op, ast.In):
+                            if isinstance(s, ast.Name):
+                                code_set_names.add(s.id)
+                                continue
+                            got = _str_elements(s)
+                            for val in sorted(got or ()):
+                                if val not in codes:
+                                    problems.append(_unknown(
+                                        sf, s.lineno, val))
+                    for s in sides:
+                        if isinstance(s, ast.Constant) \
+                                and isinstance(s.value, str) \
+                                and s.value not in codes:
+                            problems.append(_unknown(sf, node.lineno,
+                                                     s.value))
+            # payload literals minting codes
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "code" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str) \
+                            and v.value not in codes:
+                        problems.append(_unknown(sf, v.lineno, v.value))
+        # code-set constants dispatched on via `code in NAME`
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in code_set_names:
+                got = _str_elements(node.value)
+                for val in sorted(got or ()):
+                    if val not in codes:
+                        problems.append(_unknown(sf, node.lineno, val))
+    return problems
+
+
+def _unknown(sf: SourceFile, line: int, val: str) -> Problem:
+    return problem(
+        sf, line, PASS_ID,
+        f"unknown serve error code {val!r} — the router dispatches on "
+        "these strings; declare it in the serve/resilience.py taxonomy "
+        "or WIRE_CODES",
+    )

@@ -1,0 +1,153 @@
+"""Pass ``guarded-attr``: mixed lock discipline on instance attributes.
+
+Within a class that owns at least one lock, an attribute that is
+*written while holding a lock* in some method (outside ``__init__``)
+is a guarded attribute: every other read or write of it from a
+different thread needs the same lock. The pass flags accesses that can
+execute with **no** lock held.
+
+Precision machinery:
+
+- ``__init__`` (and other dunder construction paths) is exempt —
+  construction is single-threaded by contract.
+- Entry-context propagation: a private helper only ever invoked from
+  inside ``with self._lock`` bodies inherits that lock, so accesses in
+  ``_retire_locked``-style helpers are not false positives. A method
+  reachable with an empty held-set anywhere (public methods, thread
+  targets, unreferenced helpers) keeps the empty context.
+- Lock/Condition/Event/Thread attributes, method names, and
+  ``Final``-style set-once-in-init attributes (never written under a
+  lock outside init) are not findings.
+
+Benign lock-free reads (approximate stats for logs/metrics) are
+expected to carry a per-line waiver naming the reason.
+"""
+
+from __future__ import annotations
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import SourceFile, problem
+
+PASS_ID = "guarded-attr"
+DOC = ("attributes written under a lock in some methods of a class must "
+       "not be read/written lock-free in others")
+
+_EXEMPT_METHODS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+
+def _entry_contexts(cm: cmod.ClassModel) -> dict[str, set[frozenset[str]]]:
+    """Possible held-lock sets (self-lock attr names only) at entry of
+    each method, via fixpoint over internal self-calls."""
+    entries: dict[str, set[frozenset[str]]] = {}
+    called_internally: set[str] = set()
+    for facts in cm.facts.values():
+        for call in facts.calls:
+            if call.dotted and call.dotted.startswith("self.") \
+                    and call.dotted.count(".") == 1:
+                called_internally.add(call.dotted.split(".")[1])
+    for name, facts in cm.facts.items():
+        if facts.entry_public or name not in called_internally:
+            entries[name] = {frozenset()}
+        else:
+            entries[name] = set()
+    for _ in range(6):  # small fixpoint; call chains are shallow
+        changed = False
+        for name, facts in cm.facts.items():
+            # iterate only contexts actually established so far — a
+            # substituted empty context here would propagate a spurious
+            # "callable lock-free" fact down two-hop locked chains and
+            # never retract (contexts only grow)
+            for ctx in set(entries.get(name, set())):
+                for call in facts.calls:
+                    if not (call.dotted and call.dotted.startswith("self.")
+                            and call.dotted.count(".") == 1):
+                        continue
+                    callee = call.dotted.split(".")[1]
+                    if callee not in entries:
+                        continue
+                    held = ctx | {
+                        r.name for r in call.held if r.scope == "self"
+                    }
+                    if frozenset(held) not in entries[callee]:
+                        entries[callee].add(frozenset(held))
+                        changed = True
+        if not changed:
+            break
+    for name in entries:
+        if not entries[name]:
+            entries[name] = {frozenset()}
+    return entries
+
+
+def run(files: list[SourceFile], proj: cmod.Project) -> list[Problem]:
+    problems: list[Problem] = []
+    by_rel = {sf.rel: sf for sf in files}
+    for mm in proj.modules.values():
+        sf = by_rel.get(mm.sf.rel)
+        if sf is None:
+            continue
+        for cm in mm.classes.values():
+            if cm.is_module_scope or not cm.lock_attrs:
+                continue
+            problems.extend(_check_class(sf, cm))
+    return problems
+
+
+def _check_class(sf: SourceFile, cm: cmod.ClassModel) -> list[Problem]:
+    entries = _entry_contexts(cm)
+    skip_attrs = (
+        set(cm.lock_attrs) | cm.event_attrs | cm.thread_attrs
+        | set(cm.methods)
+    )
+
+    def effective(facts: cmod.MethodFacts,
+                  held: tuple[cmod.LockRef, ...]) -> list[frozenset[str]]:
+        local = frozenset(r.name for r in held if r.scope == "self")
+        # module/local locks also count as "some lock held"
+        extra = frozenset(
+            f"{r.scope}:{r.name}" for r in held if r.scope != "self"
+        )
+        return [ctx | local | extra for ctx in entries.get(
+            facts.name, {frozenset()})]
+
+    # 1) find guarded attrs: written under some lock outside init
+    guarded: dict[str, tuple[str, str]] = {}  # attr -> (lock, method)
+    for name, facts in cm.facts.items():
+        base = name.split(".", 1)[0]
+        if base in _EXEMPT_METHODS:
+            continue
+        for acc in facts.accesses:
+            if not acc.is_write or acc.attr in skip_attrs:
+                continue
+            for ctx in effective(facts, acc.held):
+                if ctx:
+                    guarded.setdefault(
+                        acc.attr, (sorted(ctx)[0], name)
+                    )
+    if not guarded:
+        return []
+    # 2) flag possibly-lock-free accesses to guarded attrs
+    out: list[Problem] = []
+    seen: set[tuple[str, int]] = set()
+    for name, facts in cm.facts.items():
+        base = name.split(".", 1)[0]
+        if base in _EXEMPT_METHODS:
+            continue
+        for acc in facts.accesses:
+            if acc.attr not in guarded:
+                continue
+            if all(ctx for ctx in effective(facts, acc.held)):
+                continue  # every entry context holds some lock
+            key = (acc.attr, acc.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            lock, wmeth = guarded[acc.attr]
+            verb = "written" if acc.is_write else "read"
+            out.append(problem(
+                sf, acc.line, PASS_ID,
+                f"{cm.name}.{acc.attr} is guarded (written under "
+                f"{lock} in {wmeth}) but {verb} lock-free in {name}",
+            ))
+    return out

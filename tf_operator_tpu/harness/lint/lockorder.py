@@ -1,0 +1,434 @@
+"""Pass ``lock-order``: the static "acquired while holding" graph.
+
+Extracts, per class and across module boundaries (through attribute
+types), every edge *L1 -> L2* = "lock L2 was acquired while L1 was
+held", then fails on cycles — the static form of the classic deadlock
+condition. Self-edges on plain ``Lock`` attributes (re-acquiring a
+non-reentrant lock you already hold) are reported too; ``RLock`` and
+``Condition`` (whose default inner lock is an RLock) self-edges are
+legal re-entry and ignored.
+
+The same graph is the contract for the runtime witness
+(``tf_operator_tpu/runtime/lockwitness.py``): the chaos suites install
+the witness, record the acquisition-order edges real threads actually
+perform, and assert they form a subgraph of the transitive closure of
+this graph — pinning the static model to the running system.
+
+Public API (used by the witness tests and tools/lint_smoke.py):
+
+- ``static_lock_graph(files) -> LockGraph`` with ``nodes``, ``edges``,
+  ``sites`` ((rel, line) -> node), ``aliases`` (merged node unions).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import SourceFile, problem
+
+PASS_ID = "lock-order"
+DOC = ("extract the per-class/cross-module 'acquired while holding' lock "
+       "graph and fail on cycles (and on re-acquiring a plain Lock)")
+
+_MAX_CALL_DEPTH = 4
+
+
+class _Union:
+    """Union-find over lock node ids (constructor-param aliasing)."""
+
+    def __init__(self) -> None:
+        self.parent: dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        self.parent.setdefault(x, x)
+        while self.parent[x] != x:
+            self.parent[x] = self.parent[self.parent[x]]
+            x = self.parent[x]
+        return x
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            # deterministic representative: lexicographically smallest
+            lo, hi = sorted((ra, rb))
+            self.parent[hi] = lo
+
+
+@dataclass
+class LockGraph:
+    nodes: set[str] = field(default_factory=set)
+    # canonical edge -> one (rel, line) witness site for reporting
+    edges: dict[tuple[str, str], tuple[str, int]] = field(
+        default_factory=dict)
+    sites: dict[tuple[str, int], str] = field(default_factory=dict)
+    kinds: dict[str, str] = field(default_factory=dict)
+    union: _Union = field(default_factory=_Union)
+
+    def canon(self, node: str) -> str:
+        return self.union.find(node)
+
+    def closure(self) -> set[tuple[str, str]]:
+        """Transitive closure of the edge set (the witness observes an
+        edge from EVERY held lock to a new acquisition, so a chain
+        A->B->C legally shows up as A->C at runtime)."""
+        adj: dict[str, set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        out: set[tuple[str, str]] = set()
+        for start in list(adj):
+            seen: set[str] = set()
+            stack = [start]
+            while stack:
+                cur = stack.pop()
+                for nxt in adj.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            out.update((start, n) for n in seen)
+        return out
+
+
+def _node(proj: cmod.Project, cm: cmod.ClassModel, ref: cmod.LockRef,
+          method: str) -> str | None:
+    return cmod.lock_node_id(proj, cm, ref, method)
+
+
+def _lock_kind(cm: cmod.ClassModel, ref: cmod.LockRef) -> str:
+    if ref.kind is not None:
+        return ref.kind
+    if ref.scope == "self":
+        info = cm.lock_attrs.get(ref.name)
+    elif ref.scope == "module":
+        info = cm.module_locks.get(ref.name)
+    else:
+        info = None
+    return info.kind if info is not None else "lock"
+
+
+def _collect_aliases(proj: cmod.Project, graph: LockGraph) -> None:
+    """Merge nodes for the ctor-param hand-off idiom::
+
+        B.__init__: self._y = y_param or threading.Lock()
+        A: self._sub = B(..., y_param=self._x)   # A._x aliases B._y
+    """
+    # param name -> lock attr, per class
+    param_attr: dict[str, dict[str, str]] = {}
+    for cm in proj.classes.values():
+        for attr, info in cm.lock_attrs.items():
+            for p in info.alias_params:
+                param_attr.setdefault(cm.qual, {})[p] = attr
+    for mm in proj.modules.values():
+        for cm in mm.classes.values():
+            for facts in cm.facts.values():
+                for call in facts.calls:
+                    if call.dotted is None:
+                        continue
+                    target = proj.resolve_class(mm, call.dotted)
+                    if target is None or target.qual not in param_attr:
+                        continue
+                    for kw in call.node.keywords:
+                        if kw.arg is None:
+                            continue
+                        attr = param_attr[target.qual].get(kw.arg)
+                        if attr is None:
+                            continue
+                        src = _self_lock_arg(cm, kw.value)
+                        if src is not None:
+                            graph.union.union(
+                                cm.lock_node(src), target.lock_node(attr)
+                            )
+
+
+def _self_lock_arg(cm: cmod.ClassModel, expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self" and expr.attr in cm.lock_attrs:
+        return expr.attr
+    return None
+
+
+def _reachable_acquires(proj: cmod.Project, mm: cmod.ModuleModel,
+                        cm: cmod.ClassModel, method: str,
+                        memo: dict[tuple[str, str], set[tuple[str, str]]],
+                        depth: int = 0,
+                        stack: frozenset[tuple[str, str]] = frozenset(),
+                        ) -> set[tuple[str, str]]:
+    """Lock nodes (with kind) acquired anywhere in the call tree rooted
+    at (class, method) — what a caller holding a lock is exposed to."""
+    key = (cm.qual, method)
+    if key in memo:
+        return memo[key]
+    if key in stack or depth > _MAX_CALL_DEPTH:
+        return set()
+    facts = cm.facts.get(method)
+    if facts is None:
+        return set()
+    out: set[tuple[str, str]] = set()
+    for acq in facts.acquires:
+        node = _node(proj, cm, acq.ref, method)
+        if node is not None:
+            out.add((node, _lock_kind(cm, acq.ref)))
+    nstack = stack | {key}
+    for call in facts.calls:
+        for tgt_cm, tgt_mm, tgt_meth in _resolve_call(proj, mm, cm, call):
+            out |= _reachable_acquires(
+                proj, tgt_mm, tgt_cm, tgt_meth, memo, depth + 1, nstack
+            )
+    memo[key] = out
+    return out
+
+
+def _resolve_call(proj: cmod.Project, mm: cmod.ModuleModel,
+                  cm: cmod.ClassModel, call: cmod.CallFact,
+                  ) -> list[tuple[cmod.ClassModel, cmod.ModuleModel, str]]:
+    """CallFact -> [(class, module, method)] targets we can follow."""
+    d = call.dotted
+    if d is None:
+        return []
+    parts = d.split(".")
+    out: list[tuple[cmod.ClassModel, cmod.ModuleModel, str]] = []
+    # typed param/local receiver: sched.fence_and_harvest() with
+    # sched: ContinuousScheduler
+    if call.recv_type is not None and len(parts) == 2:
+        tcm = proj.resolve_type(mm, call.recv_type)
+        if tcm is not None:
+            owner = cmod.method_owner(proj, tcm, parts[1])
+            if owner is not None:
+                omm = proj.modules.get(owner.module)
+                if omm is not None:
+                    return [(owner, omm, parts[1])]
+    if parts[0] == "self" and not cm.is_module_scope:
+        if len(parts) == 2:
+            owner = cmod.method_owner(proj, cm, parts[1])
+            if owner is not None:
+                omm = proj.modules.get(owner.module)
+                if omm is not None:
+                    out.append((owner, omm, parts[1]))
+        elif len(parts) == 3:
+            attr, meth = parts[1], parts[2]
+            tname = cm.attr_types.get(attr)
+            if tname is not None:
+                tcm = proj.resolve_type(mm, tname)
+                if tcm is not None:
+                    owner = cmod.method_owner(proj, tcm, meth)
+                    if owner is not None:
+                        omm = proj.modules.get(owner.module)
+                        if omm is not None:
+                            out.append((owner, omm, meth))
+        elif len(parts) == 4:
+            # self.server.cluster.replace(...) — two typed hops (the
+            # handler -> stub -> backing store chain)
+            t1 = cm.attr_types.get(parts[1])
+            c1 = proj.resolve_type(mm, t1) if t1 else None
+            if c1 is not None:
+                m1 = proj.modules.get(c1.module)
+                t2 = c1.attr_types.get(parts[2])
+                c2 = proj.resolve_type(m1, t2) if t2 and m1 else None
+                if c2 is not None:
+                    owner = cmod.method_owner(proj, c2, parts[3])
+                    if owner is not None:
+                        omm = proj.modules.get(owner.module)
+                        if omm is not None:
+                            out.append((owner, omm, parts[3]))
+        return out
+    # direct constructor call: ClassName(...) runs __init__
+    tcm = proj.resolve_class(mm, d)
+    if tcm is not None and "__init__" in tcm.facts:
+        tmm = proj.modules.get(tcm.module)
+        if tmm is not None:
+            out.append((tcm, tmm, "__init__"))
+        return out
+    # module-level function call, same module or imported
+    if len(parts) == 1:
+        mscope = mm.classes.get("<module>")
+        if mscope is not None and parts[0] in mscope.facts:
+            out.append((mscope, mm, parts[0]))
+        return out
+    # CONSTANT.meth(...) on a module-level instance (REGISTRY, metric
+    # families, SERVE_TRACER, ...), local or imported
+    if len(parts) == 2:
+        const, meth = parts
+        tname = mm.global_types.get(const)
+        owner_mm = mm
+        if tname is None and const in mm.imports:
+            target = mm.imports[const]
+            owner_mod, _, owner_name = target.rpartition(".")
+            owner_mm = proj.modules.get(owner_mod)  # type: ignore[assignment]
+            if owner_mm is not None:
+                tname = owner_mm.global_types.get(owner_name)
+        if tname is not None and owner_mm is not None:
+            tcm = proj.resolve_class(owner_mm, tname)
+            if tcm is not None:
+                owner = cmod.method_owner(proj, tcm, meth)
+                if owner is not None:
+                    tmm = proj.modules.get(owner.module)
+                    if tmm is not None:
+                        out.append((owner, tmm, meth))
+    return out
+
+
+def build_graph(files: list[SourceFile],
+                proj: cmod.Project | None = None) -> LockGraph:
+    proj = proj or cmod.build_project(files)
+    graph = LockGraph()
+    graph.sites = cmod.creation_sites(proj)
+    _collect_aliases(proj, graph)
+    # register nodes + kinds
+    for mm in proj.modules.values():
+        for name, info in mm.module_locks.items():
+            nid = graph.canon(f"{mm.sf.module}.{name}")
+            graph.nodes.add(nid)
+            graph.kinds[nid] = info.kind
+        for cm in mm.classes.values():
+            for attr, info in cm.lock_attrs.items():
+                nid = graph.canon(cm.lock_node(attr))
+                graph.nodes.add(nid)
+                # an rlock/condition kind wins over plain lock on merge
+                prev = graph.kinds.get(nid)
+                if prev is None or prev == "lock":
+                    graph.kinds[nid] = info.kind
+    # canonicalize creation sites
+    graph.sites = {k: graph.canon(v) for k, v in graph.sites.items()}
+    memo: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    for mm in proj.modules.values():
+        for cm in mm.classes.values():
+            for method, facts in cm.facts.items():
+                for acq in facts.acquires:
+                    tgt = _node(proj, cm, acq.ref, method)
+                    if tgt is None:
+                        continue
+                    tgt = graph.canon(tgt)
+                    graph.nodes.add(tgt)
+                    graph.kinds.setdefault(tgt, _lock_kind(cm, acq.ref))
+                    for held in acq.held:
+                        src = _node(proj, cm, held, method)
+                        if src is None:
+                            continue
+                        src = graph.canon(src)
+                        graph.edges.setdefault(
+                            (src, tgt), (cm.rel, acq.line)
+                        )
+                for call in facts.calls:
+                    if not call.held:
+                        continue
+                    for tgt_cm, tgt_mm, tgt_meth in _resolve_call(
+                            proj, mm, cm, call):
+                        reach = _reachable_acquires(
+                            proj, tgt_mm, tgt_cm, tgt_meth, memo
+                        )
+                        for node, _kind in reach:
+                            tgt = graph.canon(node)
+                            graph.nodes.add(tgt)
+                            for held in call.held:
+                                src = _node(proj, cm, held, method)
+                                if src is None:
+                                    continue
+                                src = graph.canon(src)
+                                graph.edges.setdefault(
+                                    (src, tgt), (cm.rel, call.line)
+                                )
+    return graph
+
+
+def static_lock_graph(files: list[SourceFile]) -> LockGraph:
+    """The witness-facing entry point (also used by tools)."""
+    return build_graph(files)
+
+
+def _cycles(graph: LockGraph) -> list[list[str]]:
+    """Strongly connected components with >1 node, plus illegal
+    self-loops; deterministic order."""
+    adj: dict[str, list[str]] = {}
+    for (a, b) in graph.edges:
+        if a != b:
+            adj.setdefault(a, []).append(b)
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the graph is small but recursion depth is
+        # unbounded in principle)
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    # illegal self-loops: re-acquiring a held plain Lock
+    for (a, b) in sorted(graph.edges):
+        if a == b and graph.kinds.get(a, "lock") == "lock":
+            out.append([a])
+    return out
+
+
+def run(files: list[SourceFile], proj: cmod.Project) -> list[Problem]:
+    graph = build_graph(files, proj)
+    problems: list[Problem] = []
+    by_rel = {sf.rel: sf for sf in files}
+    for comp in _cycles(graph):
+        if len(comp) == 1:
+            node = comp[0]
+            rel, line = graph.edges[(node, node)]
+            sf = by_rel.get(rel)
+            if sf is None:
+                continue
+            problems.append(problem(
+                sf, line, PASS_ID,
+                f"non-reentrant lock {node} acquired while already held "
+                "(self-deadlock; use RLock or restructure)",
+            ))
+            continue
+        # anchor the report at each edge inside the cycle so a per-line
+        # waiver must name the specific acquisition it blesses
+        comp_set = set(comp)
+        for (a, b), (rel, line) in sorted(graph.edges.items()):
+            if a in comp_set and b in comp_set and a != b:
+                sf = by_rel.get(rel)
+                if sf is None:
+                    continue
+                problems.append(problem(
+                    sf, line, PASS_ID,
+                    "lock-order cycle through "
+                    f"{' -> '.join(comp)}: this acquisition takes {b} "
+                    f"while holding {a}",
+                ))
+    return problems

@@ -1,0 +1,236 @@
+"""Pass ``metrics-registry``: the ``tpu_*`` family discipline.
+
+The metrics registry is process-global (``runtime/metrics.py
+REGISTRY``), which makes two rules load-bearing:
+
+1. **Declared once.** Every ``tpu_*`` family is registered against the
+   global ``REGISTRY`` at exactly one site, with one kind and one label
+   set; a second registration site is where label drift starts (the
+   registry itself only catches exact-duplicate mismatches at import
+   time of the *second* module). Label keywords at ``.inc/.set/...``
+   call sites must match the declared label set exactly.
+
+2. **Windowed reads in tests.** Because families survive across tests
+   in one process, a test asserting on an absolute histogram quantile
+   or comparing a counter's absolute ``.value()`` to a literal is
+   order-dependent: histogram reads must window via
+   ``snapshot()``/``quantile(since=...)`` and counter asserts must be
+   before/after deltas (the PR 3/11 rules).
+
+Local ``Registry()`` instances (unit tests of the registry itself) are
+out of scope — only the global ``REGISTRY`` is the shared surface.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tf_operator_tpu.harness.checks import Problem
+from tf_operator_tpu.harness.lint import classmodel as cmod
+from tf_operator_tpu.harness.lint.base import SourceFile, dotted_name, problem
+
+PASS_ID = "metrics-registry"
+DOC = ("each tpu_* family declared once against the global REGISTRY with "
+       "one label set; call-site labels match; test reads are windowed")
+
+_METRICS_MODULE = "tf_operator_tpu.runtime.metrics"
+_DECL_METHODS = {"counter", "gauge", "histogram"}
+_NON_LABEL_KWARGS = {"amount", "value", "since", "q", "buckets"}
+_USE_METHODS = {"inc", "dec", "set", "observe", "value", "quantile",
+                "snapshot"}
+
+
+@dataclass
+class Family:
+    name: str
+    kind: str
+    labels: tuple[str, ...] | None   # None = not statically evaluable
+    rel: str
+    line: int
+
+
+def _static_str_tuple(node: ast.expr | None) -> tuple[str, ...] | None:
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _is_global_registry(expr: ast.expr, mm: cmod.ModuleModel) -> bool:
+    d = dotted_name(expr)
+    if d is None:
+        return False
+    if d == "REGISTRY":
+        return mm.imports.get("REGISTRY", "").endswith("metrics.REGISTRY") \
+            or mm.sf.module == _METRICS_MODULE
+    resolved = mm.imports.get(d.split(".")[0])
+    if resolved is None:
+        return False
+    full = d.replace(d.split(".")[0], resolved, 1)
+    return full.endswith("metrics.REGISTRY")
+
+
+def _collect_declarations(files: list[SourceFile], proj: cmod.Project
+                          ) -> tuple[list[Family], dict[str, Family]]:
+    fams: list[Family] = []
+    by_const: dict[str, Family] = {}   # "<module>.<CONST>" -> family
+    for mm in proj.modules.values():
+        sf = mm.sf
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DECL_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("tpu_")):
+                continue
+            if not _is_global_registry(node.func.value, mm):
+                continue
+            label_arg: ast.expr | None = None
+            if len(node.args) >= 3:
+                label_arg = node.args[2]
+            for kw in node.keywords:
+                if kw.arg == "labelnames":
+                    label_arg = kw.value
+            fam = Family(
+                name=node.args[0].value, kind=node.func.attr,
+                labels=_static_str_tuple(label_arg),
+                rel=sf.rel, line=node.lineno,
+            )
+            fams.append(fam)
+        # map module-level constants to families for call-site checks
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                for fam in fams:
+                    if fam.rel == sf.rel and fam.line == node.lineno:
+                        by_const[f"{sf.module}.{node.targets[0].id}"] = fam
+    return fams, by_const
+
+
+def _resolve_const(mm: cmod.ModuleModel, expr: ast.expr,
+                   by_const: dict[str, Family]) -> Family | None:
+    d = dotted_name(expr)
+    if d is None:
+        return None
+    if d in mm.imports:
+        return by_const.get(mm.imports[d])
+    head = d.split(".")[0]
+    if head in mm.imports and "." in d:
+        return by_const.get(d.replace(head, mm.imports[head], 1))
+    return by_const.get(f"{mm.sf.module}.{d}")
+
+
+def run(files: list[SourceFile], proj: cmod.Project) -> list[Problem]:
+    problems: list[Problem] = []
+    by_rel = {sf.rel: sf for sf in files}
+    fams, by_const = _collect_declarations(files, proj)
+    # -- declared once, consistently ------------------------------------
+    seen: dict[str, Family] = {}
+    for fam in sorted(fams, key=lambda f: (f.rel, f.line)):
+        first = seen.get(fam.name)
+        if first is None:
+            seen[fam.name] = fam
+            continue
+        sf = by_rel.get(fam.rel)
+        if sf is None:
+            continue
+        what = "re-declared"
+        if first.kind != fam.kind:
+            what = f"re-declared as {fam.kind} (was {first.kind})"
+        elif first.labels != fam.labels:
+            what = (f"re-declared with labels {list(fam.labels or ())} "
+                    f"(was {list(first.labels or ())})")
+        problems.append(problem(
+            sf, fam.line, PASS_ID,
+            f"family {fam.name} {what} — first declared at "
+            f"{first.rel}:{first.line}; declare each tpu_* family once",
+        ))
+    # -- call-site label discipline + windowed test reads ----------------
+    for mm in proj.modules.values():
+        sf = mm.sf
+        if sf.tree is None or (sf_rel := by_rel.get(sf.rel)) is None:
+            continue
+        in_tests = sf.rel.startswith("tests/")
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _USE_METHODS):
+                continue
+            fam = _resolve_const(mm, node.func.value, by_const)
+            if fam is None:
+                continue
+            meth = node.func.attr
+            if fam.labels is not None and not any(
+                    kw.arg is None for kw in node.keywords):
+                label_kwargs = {
+                    kw.arg for kw in node.keywords
+                    if kw.arg not in _NON_LABEL_KWARGS
+                }
+                declared = set(fam.labels)
+                if meth in ("inc", "dec", "set", "observe", "value") \
+                        and label_kwargs != declared:
+                    problems.append(problem(
+                        sf_rel, node.lineno, PASS_ID,
+                        f"{fam.name}.{meth}() labels "
+                        f"{sorted(label_kwargs)} != declared "
+                        f"{sorted(declared)} ({fam.rel}:{fam.line})",
+                    ))
+            if in_tests and meth == "quantile" and fam.kind == "histogram":
+                if not any(kw.arg == "since" for kw in node.keywords):
+                    problems.append(problem(
+                        sf_rel, node.lineno, PASS_ID,
+                        f"{fam.name}.quantile() in a test without "
+                        "since= — window histogram reads via "
+                        "snapshot()/quantile(since=...) (the registry "
+                        "is process-global)",
+                    ))
+        if in_tests:
+            problems.extend(_absolute_counter_asserts(
+                sf_rel, mm, by_const))
+    return problems
+
+
+def _absolute_counter_asserts(sf: SourceFile, mm: cmod.ModuleModel,
+                              by_const: dict[str, Family]) -> list[Problem]:
+    """``FAM.value() == 3`` in a test: order-dependent absolute read."""
+    out: list[Problem] = []
+    if mm.sf.tree is None:
+        return out
+    for node in ast.walk(mm.sf.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        has_literal = any(
+            isinstance(s, ast.Constant) and isinstance(s.value, (int, float))
+            and not isinstance(s.value, bool) for s in sides
+        )
+        if not has_literal:
+            continue
+        for s in sides:
+            if not (isinstance(s, ast.Call)
+                    and isinstance(s.func, ast.Attribute)
+                    and s.func.attr == "value"):
+                continue
+            fam = _resolve_const(mm, s.func.value, by_const)
+            if fam is None or fam.kind != "counter":
+                continue
+            if all(isinstance(op, ast.Eq) for op in node.ops):
+                out.append(problem(
+                    sf, node.lineno, PASS_ID,
+                    f"absolute {fam.name}.value() == literal in a test — "
+                    "counters are process-global; assert before/after "
+                    "deltas instead",
+                ))
+    return out

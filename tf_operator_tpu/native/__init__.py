@@ -63,6 +63,7 @@ def load_library(source: str, *, cxxflags: tuple[str, ...] = ()) -> ctypes.CDLL:
             ]
             try:
                 try:
+                    # lint: ok blocking-under-lock — one-shot compile-cache fill; serializing the g++ build is this lock's purpose
                     proc = subprocess.run(
                         cmd, capture_output=True, text=True, timeout=120
                     )

@@ -128,6 +128,13 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # Re-checked (PR 11, 2026-08-03): unchanged — no new hardware window
 # since r05 (docs/window_r05 is still the newest; only the single-shot
 # flashblocks line exists). Trigger stays OPEN; cap stays 1024.
+# Re-checked (PR 12, 2026-08-04): unchanged — window_r05 remains the
+# newest window (both r05 stamps hold only the single-shot flashblocks
+# line: bq256 9.0 / bq512 11.0 / bq1024 14.0 TFLOP/s; no probe_qblock
+# arbitration output anywhere under docs/window_r05/). The qblock stage
+# stays queued at the FRONT of window_autorun's unmeasured set; the
+# dispatch_auto-vs-direct_bq1024 revert trigger above stays armed and
+# the cap stays 1024.
 MAX_Q_BLOCK = 1024
 
 

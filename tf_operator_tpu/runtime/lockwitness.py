@@ -1,0 +1,387 @@
+"""Runtime lock-order witness: pin the static lock graph to reality.
+
+tpulint's ``lock-order`` pass (harness/lint/lockorder.py) extracts the
+"acquired while holding" graph from the source. This module is the
+other half of the pin: an **opt-in** instrumented Lock/Condition layer
+that records, per thread, the set of held locks at every acquisition in
+the running system — so the chaos suites can assert
+
+    observed acquisition-order edges  ⊆  transitive closure of the
+                                         static graph, and acyclic.
+
+If the static model drifts from the code (a new lock, a new nesting),
+the witness fails the chaos suite instead of letting the gap grow.
+
+Mechanics
+---------
+``install()`` replaces ``threading.Lock/RLock/Condition`` with
+factories that wrap locks **created from tf_operator_tpu code only**
+(the creating frame's module name is checked; stdlib and test-local
+locks come back untouched). Each wrapped lock remembers its creation
+site ``(file, line)`` — the same key the static pass exports in
+``LockGraph.sites`` — so observed edges map back onto static nodes.
+
+Gating: inert unless ``TPU_LOCK_WITNESS=1`` is set or ``force=True``
+is passed (what the chaos suites do). When not installed this module
+touches nothing — ``threading.Lock`` stays the builtin, so disabled
+runs are bit-for-bit identical.
+
+Re-entrant acquisitions (RLock / Condition, whose default inner lock
+is an RLock) are not edges. Condition waiters release through the
+wrapper, so held-sets stay truthful across ``wait()``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+
+WITNESS_ENV = "TPU_LOCK_WITNESS"
+
+_PKG_PREFIX = "tf_operator_tpu"
+
+# the real factories, captured at import (before any patching)
+_real_Lock = threading.Lock
+_real_RLock = threading.RLock
+_real_Condition = threading.Condition
+
+
+def _caller_module(depth: int = 2) -> str:
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return ""
+    return frame.f_globals.get("__name__", "") or ""
+
+
+def _caller_site(depth: int = 2) -> tuple[str, int]:
+    frame = sys._getframe(depth)
+    return frame.f_code.co_filename, frame.f_lineno
+
+
+class _WitnessLock:
+    """Wraps a real lock; reports acquisitions to the witness."""
+
+    __slots__ = ("_inner", "site", "_witness", "kind")
+
+    def __init__(self, inner, site: tuple[str, int], witness: "Witness",
+                 kind: str) -> None:
+        self._inner = inner
+        self.site = site
+        self._witness = witness
+        self.kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._witness._on_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._witness._on_release(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition compatibility and anything else (e.g. _at_fork_reinit,
+    # RLock's _is_owned/_release_save/_acquire_restore) delegates to the
+    # inner lock. Bookkeeping during wait() stays truthful because the
+    # default Condition _release_save/_acquire_restore for non-RLock
+    # locks go through our release()/acquire().
+    def __getattr__(self, name: str):
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self.kind} @ {self.site[0]}:{self.site[1]}>"
+
+
+@dataclass
+class Witness:
+    """Recorded acquisition-order facts (process-global singleton while
+    installed)."""
+
+    # ((file, line) of held lock) -> ((file, line) of acquired lock)
+    edges: set[tuple[tuple[str, int], tuple[str, int]]] = \
+        field(default_factory=set)
+    sites: set[tuple[str, int]] = field(default_factory=set)
+    acquisitions: int = 0
+    wrapped: int = 0
+
+    def __post_init__(self) -> None:
+        self._mutex = _real_Lock()
+        self._tls = threading.local()
+        # per-thread acquisition counters (single-element lists mutated
+        # lock-free by their owning thread, summed at report time)
+        self._counters: list[list[int]] = []
+
+    @property
+    def total_acquisitions(self) -> int:
+        with self._mutex:
+            return self.acquisitions + sum(c[0] for c in self._counters)
+
+    # -- hot path --------------------------------------------------------
+    #
+    # No global mutex per acquisition: the per-thread held stack and
+    # acquisition counter live in thread-local state (registered once
+    # per thread), and the edge set is only written under the mutex for
+    # a NEW edge — after the first few hundred acquisitions the steady
+    # state is a held-list append plus a set-membership probe, cheap
+    # enough that chaos-suite watchdog budgets (2.5s stall thresholds)
+    # are unaffected.
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+            counter = [0]
+            self._tls.counter = counter
+            with self._mutex:
+                self._counters.append(counter)
+        return held
+
+    def _on_acquire(self, lock: _WitnessLock) -> None:
+        held = self._held()
+        self._tls.counter[0] += 1
+        if held and not any(h is lock for h in held):
+            for h in held:
+                pair = (h.site, lock.site)
+                if pair not in self.edges:  # racy pre-check: set adds
+                    with self._mutex:       # are idempotent anyway
+                        self.edges.add(pair)
+        held.append(lock)
+
+    def _on_release(self, lock: _WitnessLock) -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- factories -------------------------------------------------------
+
+    def _make_lock(self):
+        if not _caller_module().startswith(_PKG_PREFIX):
+            return _real_Lock()
+        site = _caller_site()
+        with self._mutex:
+            self.wrapped += 1
+            self.sites.add(site)
+        return _WitnessLock(_real_Lock(), site, self, "lock")
+
+    def _make_rlock(self):
+        if not _caller_module().startswith(_PKG_PREFIX):
+            return _real_RLock()
+        site = _caller_site()
+        with self._mutex:
+            self.wrapped += 1
+            self.sites.add(site)
+        return _WitnessLock(_real_RLock(), site, self, "rlock")
+
+    def _make_condition(self, lock=None):
+        if not _caller_module().startswith(_PKG_PREFIX):
+            return _real_Condition(lock)
+        if lock is None:
+            site = _caller_site()
+            with self._mutex:
+                self.wrapped += 1
+                self.sites.add(site)
+            lock = _WitnessLock(_real_RLock(), site, self, "condition")
+        return _real_Condition(lock)
+
+    # -- reporting -------------------------------------------------------
+
+    def named_edges(self, root: str) -> tuple[
+            set[tuple[str, str]], set[tuple[tuple[str, int],
+                                            tuple[str, int]]]]:
+        """Map observed edges onto static lock nodes.
+
+        Returns ``(named, unmapped)``: edges whose BOTH creation sites
+        exist in the static graph's site map, named by node id, plus the
+        raw edges at least one of whose sites the static model does not
+        know (those are themselves a model gap worth looking at)."""
+        graph = _static_graph(root)
+
+        def node_of(site: tuple[str, int]) -> str | None:
+            rel = os.path.relpath(site[0], root).replace(os.sep, "/")
+            return graph.sites.get((rel, site[1]))
+
+        named: set[tuple[str, str]] = set()
+        unmapped: set[tuple[tuple[str, int], tuple[str, int]]] = set()
+        self_site: set[str] = set()
+        with self._mutex:
+            edges = set(self.edges)
+        for a, b in edges:
+            na, nb = node_of(a), node_of(b)
+            if na is None or nb is None:
+                unmapped.add((a, b))
+            elif na != nb:
+                named.add((na, nb))
+            else:
+                # two DIFFERENT locks from one creation site nested in
+                # one thread (intra-instance re-entry is filtered by
+                # identity in _on_acquire): a cross-instance ordering
+                # the instance-agnostic static model cannot rank
+                self_site.add(na)
+        return named, unmapped, self_site
+
+    def check_against_static(self, root: str) -> dict:
+        """The chaos-suite assertion payload: observed named edges must
+        be a subgraph of the closure of the static graph, and the
+        observed graph must be acyclic."""
+        graph = _static_graph(root)
+        closure = graph.closure()
+        named, unmapped, self_site = self.named_edges(root)
+        violations = sorted(e for e in named if e not in closure)
+        cycles = _find_cycles(named)
+        return {
+            "observed": sorted(named),
+            "violations": violations,
+            "cycles": cycles,
+            "unmapped": sorted(unmapped),
+            "self_site": sorted(self_site),
+            "static_edges": len(graph.edges),
+            "acquisitions": self.total_acquisitions,
+            "wrapped": self.wrapped,
+        }
+
+    def assert_subgraph(self, root: str) -> dict:
+        """THE chaos-suite pin, in one place (both chaos modules call
+        this from their final test): the witness saw traffic, every
+        observed ordering edge maps onto the static model and lies
+        inside its transitive closure, the observed graph is acyclic,
+        and there are no edges the model cannot name — an unmapped
+        creation site or a cross-instance same-site nesting is a model
+        gap to teach, not to ignore. Returns the report for logging."""
+        report = self.check_against_static(root)
+        assert report["acquisitions"] > 0, "witness saw no lock traffic"
+        assert report["observed"], "witness recorded no ordering edges"
+        assert report["cycles"] == [], (
+            f"observed lock-order cycle: {report['cycles']}"
+        )
+        assert report["violations"] == [], (
+            "runtime acquisition orders missing from the static lock "
+            f"graph (extend the model or fix the code): "
+            f"{report['violations']}"
+        )
+        assert report["unmapped"] == [], (
+            "witness saw locks created at sites the static model cannot "
+            f"name (teach classmodel the idiom): {report['unmapped']}"
+        )
+        assert report["self_site"] == [], (
+            "two instances from one creation site nested in one thread "
+            "— an ordering the instance-agnostic model cannot rank; "
+            f"restructure or rank the instances: {report['self_site']}"
+        )
+        return report
+
+
+# Static graphs are pure functions of the tree on disk; both chaos
+# suites (and any other witness consumer in one pytest process) share
+# one build instead of re-parsing ~200 files each.
+_GRAPH_CACHE: dict[str, object] = {}
+
+
+def _static_graph(root: str):
+    graph = _GRAPH_CACHE.get(root)
+    if graph is None:
+        from tf_operator_tpu.harness.checks import DEFAULT_PATHS, _py_files
+        from tf_operator_tpu.harness.lint import load_source_file
+        from tf_operator_tpu.harness.lint.lockorder import static_lock_graph
+        files = [load_source_file(p, root)
+                 for p in _py_files(DEFAULT_PATHS, root)]
+        graph = static_lock_graph(files)
+        _GRAPH_CACHE[root] = graph
+    return graph
+
+
+def _find_cycles(edges: set[tuple[str, str]]) -> list[list[str]]:
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    out: list[list[str]] = []
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    path: list[str] = []
+
+    def visit(v: str) -> None:
+        color[v] = GRAY
+        path.append(v)
+        for w in sorted(adj.get(v, ())):
+            c = color.get(w, WHITE)
+            if c == GRAY:
+                out.append(path[path.index(w):] + [w])
+            elif c == WHITE:
+                visit(w)
+        path.pop()
+        color[v] = BLACK
+
+    for v in sorted(adj):
+        if color.get(v, WHITE) == WHITE:
+            visit(v)
+    return out
+
+
+def probe() -> tuple[object, object]:
+    """Test helper: create and nest two locks FROM INSIDE the package
+    (this module's frame), so witness-recording coverage does not
+    depend on which tf_operator_tpu modules were imported before
+    install(). Returns the two lock objects (wrapped when installed)."""
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    return a, b
+
+
+_installed: Witness | None = None
+
+
+def enabled() -> bool:
+    return os.environ.get(WITNESS_ENV, "") == "1"
+
+
+def install(force: bool = False) -> Witness | None:
+    """Patch the threading factories; returns the witness, or None when
+    the gate is off (and nothing was touched). Idempotent."""
+    global _installed
+    if not (force or enabled()):
+        return None
+    if _installed is not None:
+        return _installed
+    wit = Witness()
+    threading.Lock = wit._make_lock                 # type: ignore[misc]
+    threading.RLock = wit._make_rlock               # type: ignore[misc]
+    threading.Condition = wit._make_condition       # type: ignore[misc]
+    _installed = wit
+    return wit
+
+
+def uninstall() -> Witness | None:
+    """Restore the real factories; recorded data stays readable."""
+    global _installed
+    wit = _installed
+    if wit is None:
+        return None
+    threading.Lock = _real_Lock                     # type: ignore[misc]
+    threading.RLock = _real_RLock                   # type: ignore[misc]
+    threading.Condition = _real_Condition           # type: ignore[misc]
+    _installed = None
+    return wit
+
+
+def current() -> Witness | None:
+    return _installed

@@ -100,6 +100,7 @@ class Tracer:
 
     @property
     def capacity(self) -> int:
+        # lint: ok guarded-attr — atomic deque-reference read; maxlen is immutable per deque
         return self._spans.maxlen or 0
 
     def set_capacity(self, capacity: int) -> None:
@@ -125,6 +126,7 @@ class Tracer:
 
     @contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[None]:
+        # lint: ok guarded-attr — hot-path volatile flag; set_capacity flips it under the GIL, a stale read mistraces one span
         if not self.enabled:
             yield
             return
@@ -147,6 +149,7 @@ class Tracer:
         phases measured across threads or assembled after the fact
         (queue wait from the enqueue stamp, decode intervals aggregated
         over many steps)."""
+        # lint: ok guarded-attr — hot-path volatile flag, same contract as span() above
         if not self.enabled:
             return
         self._append(Span(
@@ -186,7 +189,13 @@ class Tracer:
                 "args": {"name": self.process_name},
             }
         ]
-        for s in self.spans():
+        # one locked read: the span snapshot and the dropped counter
+        # describe the same instant (a racy ``dropped`` read could claim
+        # a wrap the exported events don't show)
+        with self._lock:
+            snap = list(self._spans)
+            dropped = self.dropped
+        for s in snap:
             events.append(
                 {
                     "name": s.name,
@@ -204,7 +213,7 @@ class Tracer:
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "epochUnixUs": round(self._epoch_unix * 1e6, 1),
-            "droppedSpans": self.dropped,
+            "droppedSpans": dropped,
             "process": self.process_name,
         }
 

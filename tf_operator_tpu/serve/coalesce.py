@@ -113,6 +113,7 @@ class Coalescer:
                 p["event"].set()
 
     def _loop(self):
+        # lint: ok guarded-attr — racy liveness peek; _take_batch re-reads pending under cond
         while not self.stop.is_set() or self.pending:
             batch = self._take_batch()
             if not batch:

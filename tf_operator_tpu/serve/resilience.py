@@ -63,12 +63,15 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Callable
+from typing import TYPE_CHECKING, Any, Callable
 
 from tf_operator_tpu.runtime.metrics import SERVE_WATCHDOG_RESTARTS
 from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.utils import logger
+
+if TYPE_CHECKING:  # annotation-only: the runtime import stays lazy
+    from tf_operator_tpu.serve.scheduler import ContinuousScheduler
 
 LOG = logger.with_fields(component="serve-resilience")
 
@@ -177,6 +180,23 @@ class ReplicaDead(ServeError):
     retryable = True
 
 
+# The COMPLETE wire-code vocabulary: every ``code`` a client or the
+# fleet router can see. ServeError subclasses above carry the
+# engine-side codes; these are the transport/front-door codes minted as
+# plain payloads (fleet/router.py, fleet/replica.py, serve_lm) where no
+# exception object exists. tpulint's ``typed-error`` pass enforces that
+# every code literal in the tree comes from this vocabulary — a typo'd
+# code silently downgrades to "not retryable" at the router, so new
+# codes MUST be declared here.
+WIRE_CODES = frozenset((
+    "internal",            # untyped exception rendered by error_payload
+    "bad_request",         # malformed /generate body (400, not retryable)
+    "timeout",             # replica-side transport timeout (router retries)
+    "replica_unreachable",  # router could not reach the replica at all
+    "no_replica",          # router found nothing routable (503 + backoff)
+))
+
+
 def error_payload(exc: Exception) -> dict:
     """The wire shape for ANY exception: typed errors render themselves;
     anything else becomes a non-retryable ``internal`` (500) whose
@@ -281,7 +301,7 @@ class EngineSupervisor:
         self._deadline_prev = 0
         self._qhw_max = 0
         self._max_slots = 0                # last live engine's capacity
-        self._sched: Any = None
+        self._sched: ContinuousScheduler | None = None
         self._build(replay=())
         self._watchdog: threading.Thread | None = None
         if self.res.watchdog_stall_s:
@@ -379,7 +399,8 @@ class EngineSupervisor:
 
     # -- failure handling --------------------------------------------------
 
-    def on_loop_crash(self, sched: Any, exc: Exception) -> bool:
+    def on_loop_crash(self, sched: ContinuousScheduler,
+                      exc: Exception) -> bool:
         """Called by a dying serving loop. Returns True when the
         supervisor takes ownership (the loop must NOT fail its waiters —
         they will be replayed, or a concurrent restart already harvested
@@ -402,21 +423,29 @@ class EngineSupervisor:
         so no staleness check is needed) — the watchdog thread also
         resets, but crash-only supervision (watchdog_stall_s unset) has
         no watchdog thread to do it."""
-        self._attempts = 0
+        # Under the generation RLock (NOT the restart lock, which is
+        # held across backoff sleeps): the scheduler calls this from its
+        # condvar body, and _lock is never held while acquiring _cond,
+        # so _cond -> _lock stays acyclic in the lock-order graph.
+        with self._lock:
+            self._attempts = 0
 
     def _watch(self) -> None:
         stall = float(self.res.watchdog_stall_s)
         period = max(0.01, min(stall / 4.0, 0.5))
-        while not self._closed and not self.dead:
+        while True:
             time.sleep(period)
             with self._lock:
+                if self._closed or self.dead:
+                    return
                 sched = self._sched
             if sched is None or not sched.running:
                 continue
             # A completed request on this generation proves the rebuilt
             # engine serves; the consecutive-failure budget resets.
-            if self._attempts and sched.requests_done > 0:
-                self._attempts = 0
+            with self._lock:
+                if self._attempts and sched.requests_done > 0:
+                    self._attempts = 0
             age = time.monotonic() - sched.heartbeat
             if age > stall:
                 self._restart(
@@ -424,7 +453,8 @@ class EngineSupervisor:
                     detail=f"heartbeat silent {age:.2f}s > {stall}s",
                 )
 
-    def _restart(self, reason: str, exc: Exception | None, sched: Any,
+    def _restart(self, reason: str, exc: Exception | None,
+                 sched: ContinuousScheduler,
                  detail: str = "") -> bool:
         """Fence, harvest, rebuild, replay. Returns True when this (or a
         concurrent) restart took ownership of ``sched``'s requests —
@@ -447,15 +477,21 @@ class EngineSupervisor:
                     return sched._fenced
             t_restart = time.monotonic()
             harvested = sched.fence_and_harvest()
-            self._done_prev += sched.requests_done
-            self._tokens_prev += sched.tokens_generated
-            self._shed_prev += sched.shed_total
-            self._deadline_prev += sched.deadline_total
-            self._qhw_max = max(self._qhw_max, sched.queue_high_water)
-            self.restarts += 1
-            self._attempts += 1
-            self.last_fault = (detail or repr(exc)) + f" [{reason}]"
-            self.last_restart_at = time.time()
+            # Aggregate roll-over + budget bump under the generation
+            # RLock: debug()/requests_done/note_served read these from
+            # other threads, and _restart_lock is the wrong guard for
+            # them (it is held across the backoff sleep below — readers
+            # must never block on it).
+            with self._lock:
+                self._done_prev += sched.requests_done
+                self._tokens_prev += sched.tokens_generated
+                self._shed_prev += sched.shed_total
+                self._deadline_prev += sched.deadline_total
+                self._qhw_max = max(self._qhw_max, sched.queue_high_water)
+                self.restarts += 1
+                self._attempts += 1
+                self.last_fault = (detail or repr(exc)) + f" [{reason}]"
+                self.last_restart_at = time.time()
             SERVE_WATCHDOG_RESTARTS.inc(reason=reason)
             LOG.warning(
                 f"engine restart ({reason}) attempt {self._attempts}: "
@@ -487,6 +523,7 @@ class EngineSupervisor:
                     req._finish("deadline")
                 else:
                     replay.append(req)
+            # lint: ok blocking-under-lock — the backoff sleep belongs to the failure; stop()/crash callers acquire this lock with timeout loops for exactly this reason
             time.sleep(
                 self.res.restart_backoff_s * (2 ** (self._attempts - 1))
             )
@@ -567,18 +604,26 @@ class EngineSupervisor:
 
     @property
     def requests_done(self) -> int:
-        sched = self.scheduler
-        return self._done_prev + (sched.requests_done if sched else 0)
+        with self._lock:   # pair with _restart's aggregate roll-over
+            sched = self._sched
+            return self._done_prev + (sched.requests_done if sched else 0)
 
     @property
     def tokens_generated(self) -> int:
-        sched = self.scheduler
-        return self._tokens_prev + (sched.tokens_generated if sched else 0)
+        with self._lock:
+            sched = self._sched
+            return self._tokens_prev + (
+                sched.tokens_generated if sched else 0)
 
     def debug(self) -> dict:
-        """The /debug/serve ``resilience`` section."""
-        sched = self.scheduler
-        return {
+        """The /debug/serve ``resilience`` section. One consistent view
+        under the generation RLock — never the restart lock, which is
+        held across backoff sleeps (debug must stay responsive DURING a
+        restart storm; the aggregates it reads are rolled over under
+        _lock in _restart for exactly this reason)."""
+        with self._lock:
+            sched = self._sched
+            return {
             "watchdog_stall_s": self.res.watchdog_stall_s,
             "restarts": self.restarts,
             "restart_attempts": self._attempts,

@@ -73,9 +73,12 @@ import contextlib
 import threading
 import time
 from collections import deque
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 import numpy as np
+
+if TYPE_CHECKING:  # annotation-only: keeps this module jax-import-free
+    from tf_operator_tpu.serve.engine import ContinuousEngine
 
 from tf_operator_tpu.runtime.metrics import (
     SERVE_DEADLINE_TOTAL,
@@ -97,6 +100,7 @@ from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.serve.resilience import (
     EngineCrashed,
+    EngineSupervisor,
     QueueFull,
     QueueTTLExpired,
     ResilienceConfig,
@@ -229,11 +233,15 @@ class ServeRequest:
 
 
 class ContinuousScheduler:
-    def __init__(self, engine: Any, *,
+    # ``engine`` is annotated with the canonical type (fakes still pass:
+    # annotations are lazy) so static analysis can follow device/KV
+    # calls made under the scheduler's locks — tpulint's lock-order
+    # graph resolves ``self.engine.X`` through it.
+    def __init__(self, engine: ContinuousEngine, *,
                  prefill_tokens_per_step: int = 256,
                  device_lock: threading.Lock | None = None,
                  resilience: ResilienceConfig | None = None,
-                 supervisor: Any = None,
+                 supervisor: EngineSupervisor | None = None,
                  faults: Any = None) -> None:
         if prefill_tokens_per_step < 1:
             raise ValueError("prefill_tokens_per_step must be >= 1")
@@ -419,6 +427,7 @@ class ContinuousScheduler:
             self._thread.join(timeout=timeout)
             SERVE_TRACER.record(
                 "drain", t0, time.monotonic(),
+                # lint: ok guarded-attr — read after join; the loop thread is dead
                 requests_done=self.requests_done,
                 bounded=bool(self.res.drain_timeout_s),
             )
@@ -469,6 +478,7 @@ class ContinuousScheduler:
             self._fail_all(exc)
             raise
         finally:
+            # lint: ok guarded-attr — advisory re-check; _fail_all re-validates the fence under the condvar before touching requests
             if not self._fenced:
                 self._fail_all(ShuttingDown("server shutting down"))
                 SERVE_SLOTS_ACTIVE.set(0)
@@ -477,6 +487,7 @@ class ContinuousScheduler:
         """Stamp the watchdog heartbeat — unless the ack_loss fault
         swallows the write (the false-positive restart drill)."""
         if self.faults.fire("ack_loss") is None:
+            # lint: ok guarded-attr — single-writer monotonic stamp; the watchdog reads it racily by design (see _device)
             self.heartbeat = time.monotonic()
 
     @contextlib.contextmanager
@@ -520,8 +531,9 @@ class ContinuousScheduler:
                             time.monotonic() + self.res.drain_timeout_s
                         )
             self._beat()
-            if self._drain_deadline is not None and (
-                    time.monotonic() > self._drain_deadline):
+            # lint: ok guarded-attr — loop-thread-private field; the condvar block above wrote it for bookkeeping, only this thread reads it
+            dd = self._drain_deadline
+            if dd is not None and time.monotonic() > dd:
                 self._expire_drain()
                 return
             self._expire_queue_ttls()
@@ -593,6 +605,7 @@ class ContinuousScheduler:
                     keep.append(req)
             self._queue = keep
         for req in ttl_expired:
+            # lint: ok guarded-attr — loop-thread-only counter; snapshot readers are approximate by contract
             self.deadline_total += 1
             SERVE_DEADLINE_TOTAL.inc(kind="queue")
             waited = now - (req.enqueued_at or now)
@@ -631,6 +644,7 @@ class ContinuousScheduler:
             self._retire_telemetry(slot, req, reason=cause)
         req.deadline_exceeded = True
         req.timeout_cause = cause
+        # lint: ok guarded-attr — loop-thread-only counter; snapshot readers are approximate by contract
         self.deadline_total += 1
         SERVE_DEADLINE_TOTAL.inc(kind=kind)
         req._finish("deadline")
@@ -681,6 +695,7 @@ class ContinuousScheduler:
         budget = (self.prefill_tokens_per_step if self._slots
                   else 1 << 30)
         while budget > 0:
+            # lint: ok guarded-attr — loop-thread-owned; fence transitions are re-checked under the condvar in _settle_admitting before any request is touched
             if self._prefilling is None:
                 req = self._pop_next()
                 if req is None:
@@ -713,6 +728,7 @@ class ContinuousScheduler:
                     req.degraded = False
                     if not self._settle_admitting(requeue_front=True):
                         return
+                    # lint: ok guarded-attr — same loop-thread-owned re-check as above; _settle_admitting just validated the fence
                     if not (self._slots or self._prefilling):
                         # Nothing decoding either (injected or real
                         # total exhaustion): yield instead of spinning
@@ -775,6 +791,7 @@ class ContinuousScheduler:
             mono0 = time.monotonic()
             try:
                 with self._device():
+                    # lint: ok blocking-under-lock — injected stall drill: simulating a slow device op under the device mutex IS the fault being tested
                     self.faults.maybe_sleep("slow_prefill")
                     if pf is not None:
                         chunks = max(1, int(budget // pf.chunk))
@@ -1012,29 +1029,38 @@ class ContinuousScheduler:
         """Zero the loop's own aggregates (NOT the process-global
         registry): the serve bench warms executables with a dry run, then
         measures a clean window."""
-        self.decode_steps = 0
-        self.occupancy_sum = 0
-        self.tokens_generated = 0
-        self.requests_done = 0
-        self.step_log.clear()
+        with self._cond:
+            self.decode_steps = 0
+            self.occupancy_sum = 0
+            self.tokens_generated = 0
+            self.requests_done = 0
+            self.step_log.clear()
 
     # -- observability ----------------------------------------------------
 
     @property
     def queue_depth(self) -> int:
-        return len(self._queue)
+        with self._cond:
+            return len(self._queue)
 
     @property
     def mean_occupancy(self) -> float:
-        if not self.decode_steps:
-            return 0.0
-        return self.occupancy_sum / self.decode_steps / self.engine.max_slots
+        with self._cond:
+            if not self.decode_steps:
+                return 0.0
+            return (self.occupancy_sum / self.decode_steps
+                    / self.engine.max_slots)
 
     def debug_snapshot(self) -> dict:
         """The /debug/serve payload (serve/httpapi.py). Supervised
         serving wraps this with a ``resilience`` section
-        (EngineSupervisor.debug_snapshot)."""
-        return {
+        (EngineSupervisor.debug_snapshot). Snapshot under the condvar
+        (re-entrant for the nested queue_depth/mean_occupancy reads):
+        one consistent view, and the loop only ever holds _cond for
+        bookkeeping — never across device work — so this cannot stall
+        behind a decode step."""
+        with self._cond:
+            return {
             "engine": "continuous",
             "max_slots": self.engine.max_slots,
             "active_slots": self.engine.active_slots,

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Fast tpulint smoke: the whole static-analysis story in ~30s —
+
+1. the `lint`-marked tests (fixture exactness per pass, waiver grammar,
+   class/lock-model units, checks CLI, witness wrap/inertness);
+2. the repo gate itself: the FULL pass set (syntax, unused-import,
+   lock-order, guarded-attr, blocking-under-lock, metrics-registry,
+   typed-error) over the whole tree must be green and finish inside the
+   15s CI budget.
+
+    python tools/lint_smoke.py             # tests + repo gate
+    python tools/lint_smoke.py -k waiver   # extra pytest args pass through
+    python tools/lint_smoke.py --gate-only # just the repo gate + timing
+
+The runtime lock-order witness's chaos assertions live in
+tests/test_serve_chaos.py / test_fleet_chaos.py (serve/fleet smokes).
+
+Exit code: non-zero if the tests fail, the gate finds problems, or the
+gate blows the time budget.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE_BUDGET_S = 15.0
+
+
+def run_gate() -> int:
+    sys.path.insert(0, REPO_ROOT)
+    from tf_operator_tpu.harness.checks import run_checks
+
+    t0 = time.monotonic()
+    problems = run_checks(root=REPO_ROOT)
+    dt = time.monotonic() - t0
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"lint gate: {len(problems)} problem(s) in {dt:.1f}s "
+          f"(budget {GATE_BUDGET_S:.0f}s)")
+    if problems:
+        return 1
+    if dt > GATE_BUDGET_S:
+        print(f"lint gate: TOO SLOW ({dt:.1f}s > {GATE_BUDGET_S:.0f}s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--gate-only" in args:
+        args.remove("--gate-only")
+        return run_gate()
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_lint.py", "tests/test_ci_tooling.py",
+        "-m", "not slow",
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+    if rc != 0:
+        return rc
+    return run_gate()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
