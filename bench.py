@@ -504,6 +504,25 @@ def bench_serve_tp(peak_hbm_gbps: float | None) -> None:
                           else 420)
 
 
+def bench_serve_disagg(peak_hbm_gbps: float | None) -> None:
+    """Disaggregated prefill/decode interference pair: subprocess-runs
+    tools/serve_bench.py --engine disagg — long prefills + latency-
+    sensitive short decodes through the two-stage router (2 prefill
+    replicas, one KILLED mid-run) vs the time-shared engine on the
+    identical seeded schedule. lost == 0 and shipped_joins == the
+    long-prompt count are the structural pins
+    (tests/test_fleet_chaos.py); the ttft/itl p99 ratios are the
+    ROADMAP item-2 acceptance numbers on hosts where the prefill pool
+    is real extra hardware (the line carries host_cpus — a 1-core CI
+    box shares one execution unit and measures the mechanism only).
+    Subprocess for the usual serve-section reasons. peak_hbm unused;
+    signature keeps the peak-table plumbing uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("serve_disagg", ["--engine", "disagg"],
+                          timeout=240 if os.environ.get("BENCH_SMOKE")
+                          else 540)
+
+
 def _run_serve_subprocess(label: str, extra_args: list,
                           timeout: float) -> None:
     """Shared harness for the serve-family sections: subprocess-run
@@ -1200,6 +1219,7 @@ _SECTIONS: dict = {
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
     "serve_tp": (bench_serve_tp, chip_peak_hbm_gbps, 480.0),
+    "serve_disagg": (bench_serve_disagg, chip_peak_hbm_gbps, 560.0),
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }

@@ -142,6 +142,15 @@ def main(argv: list[str] | None = None) -> int:
                         "and every typed error payload so the router "
                         "attributes failures without reverse-mapping "
                         "ports")
+    p.add_argument("--role", choices=("decode", "prefill"),
+                   default=os.environ.get("TPU_SERVE_ROLE") or "decode",
+                   help="replica role (default $TPU_SERVE_ROLE): "
+                        "'prefill' serves ONLY POST /prefill — prompt "
+                        "prefill exported as shipped-KV block-pool "
+                        "rows for a disaggregated fleet's decode pool "
+                        "(serve/disagg.py; --kv-block must match the "
+                        "decode pool's). 'decode' (or unset) is the "
+                        "ordinary serving process")
     # Model-shape flags default to dist_lm.py's defaults so the
     # train-then-serve flow works without repeating flags; when loading a
     # checkpoint from a non-default trainer run, these MUST mirror the
@@ -347,6 +356,22 @@ def main(argv: list[str] | None = None) -> int:
                 f"decode paths — use --engine coalesce)")
     if args.engine is None:
         args.engine = "coalesce" if legacy_flags else "continuous"
+    if args.role == "prefill":
+        bad = [flag for flag, on in (
+            ("--spec-k", bool(args.spec_k)),
+            ("--int8", args.int8),
+            ("--kv-int8", args.kv_int8),
+            ("--batch-window", args.batch_window > 0),
+            ("--tp", args.tp > 1),
+        ) if on]
+        if bad:
+            p.error(f"--role prefill does not compose with "
+                    f"{'/'.join(bad)} (a prefill replica runs only the "
+                    "solo dense prefill and ships its rows)")
+        if args.max_seq_len % args.kv_block:
+            p.error("--role prefill needs --kv-block to divide "
+                    "--max-seq-len (the shipped rows are block-aligned "
+                    "pool rows for the decode pool)")
     if args.prefill_budget < 1:
         p.error("--prefill-budget must be >= 1")
     if args.requests is not None and args.requests < 1:
@@ -553,6 +578,46 @@ def main(argv: list[str] | None = None) -> int:
         from tf_operator_tpu.serve.resilience import set_replica_id
 
         set_replica_id(args.replica_id)
+
+    if args.role == "prefill":
+        # Dedicated prefill replica (disaggregated serving): no decode
+        # engine, no slots — prompt prefill only, exported as shipped-KV
+        # wire payloads for the fleet's decode pool. The controller
+        # injects TPU_SERVE_ROLE=prefill into "{serve}-p{i}" children;
+        # SIGTERM drains exactly like the decode path (readiness
+        # withdrawn first, in-flight prefills finish).
+        import time
+
+        from tf_operator_tpu.serve.disagg import (
+            PrefillServer,
+            PrefillWorker,
+        )
+
+        worker = PrefillWorker(
+            cfg, params, prefill_chunk=args.prefill_chunk or None,
+            kv_block=args.kv_block,
+        )
+        pserver = PrefillServer(
+            worker, replica_id=args.replica_id or "prefill",
+            host=args.host, port=args.port,
+        ).start()
+        print(f"serve_lm: PREFILL replica "
+              f"{args.replica_id or '(anonymous)'} on "
+              f"{pserver.endpoint} (kv_block={args.kv_block}, "
+              f"chunk={args.prefill_chunk or 'one-shot'})", flush=True)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: done.set())
+        done.wait()
+        pserver.begin_drain()
+        drain_deadline = time.monotonic() + args.drain_timeout
+        while (worker.queue_depth or worker.active_slots) \
+                and time.monotonic() < drain_deadline:
+            time.sleep(0.05)
+        pserver.stop()
+        print(f"serve_lm: prefill replica drained "
+              f"({worker.requests_done} prompts, "
+              f"{worker.tokens_prefilled} tokens shipped)", flush=True)
+        return 0
 
     coalescer = None
     batcher_thread = None
@@ -828,6 +893,32 @@ def main(argv: list[str] | None = None) -> int:
                            or self.headers.get("X-Request-Id")
                            or mint_request_id())
 
+                    shipment = None
+                    if req.get("shipped_kv") is not None:
+                        # Disaggregated prefill: verify the shipped
+                        # payload (chained digests + row checksum + the
+                        # request's own prompt) BEFORE it reaches the
+                        # scheduler — a mismatch RAISES the typed
+                        # ship_failed (rendered by the generic handler
+                        # below; the disagg router re-prefills on it).
+                        # Single-row only: a shipment prefills ONE
+                        # prompt.
+                        from tf_operator_tpu.serve.disagg import (
+                            decode_shipment,
+                        )
+                        from tf_operator_tpu.serve.resilience import (
+                            ShipFailed,
+                        )
+
+                        if prompt.shape[0] != 1:
+                            raise ShipFailed(
+                                "shipped_kv serves single-row "
+                                "requests only"
+                            )
+                        shipment = decode_shipment(
+                            req["shipped_kv"], expect_tokens=prompt[0],
+                        )
+
                     def _row(i):
                         r = ServeRequest(
                             _np.asarray(prompt[i:i + 1]), num_steps,
@@ -847,6 +938,9 @@ def main(argv: list[str] | None = None) -> int:
                                         else float(deadline_s)),
                             request_id=(rid if i == 0
                                         else f"{rid}.{i}"),
+                            # Single-row contract enforced above, so
+                            # the shipment always belongs to row 0.
+                            shipment=shipment,
                         )
                         return engine_sched.submit_request(r)
 

@@ -1,0 +1,26 @@
+"""Seeded violations for the ``typed-error`` pass, disaggregation era
+(ISSUE 14): a typo'd ship code in a payload literal, a dispatch
+comparison against an unknown ship code, and an unknown-code member in
+a code-set constant — the exact mistakes that would silently break the
+two-stage router's retry policy (a typo'd ``ship_failed`` downgrades
+to "not retryable" and the router stops re-prefilling). (The test runs
+the checker over this file TOGETHER with serve/resilience.py so the
+taxonomy — incl. the real ``ship_failed`` / ``prefill_pool_empty`` —
+is in the analyzed set.)"""
+
+
+def mint() -> dict:
+    # Typo: the taxonomy declares "ship_failed".
+    return {"error": "x", "code": "ship_fialed", "retryable": True}
+
+
+def dispatch(payload: dict) -> bool:
+    # Unknown: the WIRE_CODES constant declares "prefill_pool_empty".
+    return payload.get("code") == "prefill_pool_drained"
+
+
+RESHIP_CODES = ("ship_failed", "kv_ship_rejected")
+
+
+def reship(payload: dict) -> bool:
+    return payload.get("code") in RESHIP_CODES

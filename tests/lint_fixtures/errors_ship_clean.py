@@ -1,0 +1,19 @@
+"""Clean twin of errors_ship_bad.py: the disaggregation wire codes
+spelled as the taxonomy declares them (``ship_failed`` from the
+ShipFailed ServeError subclass, ``prefill_pool_empty`` from
+WIRE_CODES)."""
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "ship_failed", "retryable": True}
+
+
+def dispatch(payload: dict) -> bool:
+    return payload.get("code") == "prefill_pool_empty"
+
+
+RESHIP_CODES = ("ship_failed",)
+
+
+def reship(payload: dict) -> bool:
+    return payload.get("code") in RESHIP_CODES

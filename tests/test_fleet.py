@@ -474,10 +474,11 @@ def test_readiness_payload_clamps_overflow_ttft():
 
     # Window out every observation made before this test (the registry
     # is process-global).
-    with serve_httpapi._ttft_lock:
+    win = serve_httpapi._TTFT_WINDOW
+    with win._lock:
         base = SERVE_TTFT_SECONDS.snapshot()
-        serve_httpapi._ttft_prev = base
-        serve_httpapi._ttft_cur = (base, _time.monotonic())
+        win._prev = base
+        win._cur = (base, _time.monotonic())
     top = SERVE_TTFT_SECONDS.buckets[-1]
     try:
         for _ in range(10):
@@ -487,10 +488,10 @@ def test_readiness_payload_clamps_overflow_ttft():
     finally:
         # Re-baseline past this test's overflow observations so later
         # windowed reads don't inherit them.
-        with serve_httpapi._ttft_lock:
+        with win._lock:
             base = SERVE_TTFT_SECONDS.snapshot()
-            serve_httpapi._ttft_prev = base
-            serve_httpapi._ttft_cur = (base, _time.monotonic())
+            win._prev = base
+            win._cur = (base, _time.monotonic())
 
 
 def test_error_payload_carries_replica_id_when_set():

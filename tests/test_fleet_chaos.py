@@ -40,7 +40,13 @@ from tf_operator_tpu.api.serve_types import LABEL_SERVE_NAME
 from tf_operator_tpu.fleet import membership as mship
 from tf_operator_tpu.fleet.controller import FleetConfig, TPUServeController
 from tf_operator_tpu.fleet.replica import FakeReplicaBackend, ReplicaServer
-from tf_operator_tpu.fleet.router import RouterConfig, RouterServer, http_probe
+from tf_operator_tpu.fleet.router import (
+    DisaggRouterServer,
+    RouterConfig,
+    RouterServer,
+    http_probe,
+)
+from tf_operator_tpu.serve.disagg import FakePrefillBackend, PrefillServer
 from tf_operator_tpu.runtime import lockwitness
 from tf_operator_tpu.runtime import objects
 from tf_operator_tpu.runtime.events import FakeRecorder
@@ -119,6 +125,35 @@ class ReplicaHarness:
                 server.stop()
 
 
+class PrefillHarness:
+    """The prefill pool's twin of ReplicaHarness: lazily-created
+    in-process PrefillServers over the jax-free FakePrefillBackend."""
+
+    def __init__(self, backend_factory=None):
+        self.backend_factory = backend_factory or (
+            lambda idx: FakePrefillBackend(service_delay_s=0.02)
+        )
+        self.servers: dict[int, PrefillServer] = {}
+        self.killed: set[int] = set()
+
+    def endpoint(self, serve, idx: int) -> str:
+        if idx not in self.servers:
+            self.servers[idx] = PrefillServer(
+                self.backend_factory(idx),
+                replica_id=f"{serve.metadata.name}-p{idx}",
+            ).start()
+        return self.servers[idx].endpoint
+
+    def kill(self, idx: int) -> None:
+        self.killed.add(idx)
+        self.servers[idx].kill()
+
+    def stop_all(self) -> None:
+        for idx, server in self.servers.items():
+            if idx not in self.killed:
+                server.stop()
+
+
 def mk_serve(name="lm", replicas=4, grace=0.2, **spec):
     return {
         "apiVersion": "tpuflow.org/v1alpha1",
@@ -136,7 +171,8 @@ def mk_serve(name="lm", replicas=4, grace=0.2, **spec):
     }
 
 
-def mk_controller(client, harness, *, scheduler=None, fail_threshold=2):
+def mk_controller(client, harness, *, scheduler=None, fail_threshold=2,
+                  prefill_harness=None):
     return TPUServeController(
         client,
         scheduler=scheduler,
@@ -144,6 +180,8 @@ def mk_controller(client, harness, *, scheduler=None, fail_threshold=2):
         config=FleetConfig(fail_threshold=fail_threshold),
         probe_fn=lambda ep: http_probe(ep, timeout=2.0),
         endpoint_fn=harness.endpoint,
+        prefill_endpoint_fn=(prefill_harness.endpoint
+                             if prefill_harness else None),
     )
 
 
@@ -698,6 +736,132 @@ def test_autoscale_grows_on_backlog_and_shrinks_when_idle():
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 14 ship-path chaos: kill a prefill replica mid-ship, crash a
+# decode replica post-ingest — BOTH backends, zero lost requests
+# ---------------------------------------------------------------------------
+
+
+def mk_disagg_fleet(client, *, replicas=2, prefill=2,
+                    decode_factory=None, prefill_factory=None):
+    """(controller, harnesses, router): a reconciled disaggregated
+    fleet behind a DisaggRouterServer — the shared setup of the two
+    ship-path chaos drills."""
+    harness = ReplicaHarness(decode_factory)
+    pharness = PrefillHarness(prefill_factory)
+    tc = mk_controller(client, harness, prefill_harness=pharness)
+    client.create(objects.TPUSERVES, mk_serve(
+        replicas=replicas, grace=0.2, prefillReplicas=prefill,
+    ))
+    ms = tc.membership_for("default/lm")
+    pms = tc.prefill_membership_for("default/lm")
+    assert sync_until(
+        tc,
+        lambda: ms.counts()[mship.READY] == replicas
+        and pms.counts()[mship.READY] == prefill,
+    ), (ms.counts(), pms.counts())
+    router = DisaggRouterServer(
+        pms, ms,
+        config=RouterConfig(retries=2, request_timeout_s=10.0,
+                            probe_interval_s=0.05),
+    ).start()
+    return tc, harness, pharness, ms, pms, router
+
+
+def test_disagg_kill_prefill_replica_mid_ship_zero_lost(fleet_backend):
+    """A prefill replica dies WHILE shipping: in-flight /prefill sends
+    fail at the transport, the stage-1 router retries the prefill
+    ELSEWHERE (typed contract — the request re-prefills, never drops),
+    and the controller replaces the dead prefill child at a fresh
+    index. Every client request resolves ok."""
+    client, store = fleet_backend
+    router = None
+    tc, harness, pharness, ms, pms, router = mk_disagg_fleet(client)
+    try:
+        driver = TrafficDriver(router.endpoint, n_requests=30,
+                               gap_s=0.01).start()
+        time.sleep(0.1)  # ships in flight
+        pharness.kill(0)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0, driver.results
+        assert ok == 30, [p for s, p in driver.results if s != 200]
+        # The ship pipeline actually ran: requests carried shipments
+        # into the decode pool (pre-kill and post-retry alike).
+        ship = router.router.snapshot()["ship"]
+        assert ship["shipped"] > 0, ship
+        shipped_seen = sum(
+            b.shipped_received
+            for b in (s.backend for s in harness.servers.values())
+        )
+        assert shipped_seen > 0
+        # The dead prefill replica was replaced at a FRESH index.
+        assert sync_until(
+            tc, lambda: pms.counts()[mship.READY] == 2, timeout=15.0,
+        ), pms.counts()
+        names = set(children_of(store))
+        assert "lm-p0" not in names, names
+        assert {n for n in names if "-p" in n} == {"lm-p1", "lm-p2"}
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+        pharness.stop_all()
+
+
+def test_disagg_decode_crash_post_ingest_zero_lost(fleet_backend):
+    """A decode replica dies AFTER ingesting shipped bodies: the
+    decode-stage router fails the transport over to a live decode
+    replica (the shipment rides the retry — same bytes, different
+    replica), membership declares the victim DEAD, and the controller
+    replaces it. Zero lost requests."""
+    client, store = fleet_backend
+    router = None
+    tc, harness, pharness, ms, pms, router = mk_disagg_fleet(
+        client,
+        decode_factory=lambda idx: FakeReplicaBackend(
+            max_slots=4, service_delay_s=0.03,
+        ),
+    )
+    try:
+        driver = TrafficDriver(router.endpoint, n_requests=30,
+                               gap_s=0.01).start()
+        # Let the victim ingest some shipped bodies first.
+        deadline = time.monotonic() + 5.0
+        while (harness.servers[0].backend.shipped_received == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert harness.servers[0].backend.shipped_received > 0
+        harness.kill(0)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0, driver.results
+        assert ok == 30, [p for s, p in driver.results if s != 200]
+        # The failover carried shipments to the survivor too.
+        assert harness.servers[1].backend.shipped_received > 0
+        # Replacement at a fresh index; the fleet is whole again.
+        assert sync_until(
+            tc, lambda: ms.counts()[mship.READY] == 2, timeout=15.0,
+        ), ms.counts()
+        names = set(children_of(store))
+        assert "lm-r0" not in names, names
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+        pharness.stop_all()
+
+
+# ---------------------------------------------------------------------------
 # the real-engine e2e: serve_bench --engine fleet (structural pin)
 # ---------------------------------------------------------------------------
 
@@ -737,6 +901,65 @@ def test_serve_bench_fleet_structural():
     assert fleet["untyped_errors"] == 0
     assert 0 < fleet["ttft_p99_ms"] <= fleet["deadline_budget_ms"]
     assert fleet["generated_tokens"] > 0
+
+
+@pytest.mark.slow
+def test_serve_bench_disagg_structural():
+    """tools/serve_bench.py --engine disagg (BENCH_SMOKE): the
+    interference pair — real engines, real prefill pool, one prefill
+    replica killed mid-run. Capacity-style pins only (the repo
+    convention: structure and token counts, never wall-clock): zero
+    lost requests on BOTH legs, every long prompt actually shipped on
+    the disagg leg (shipped_joins == the seeded long count), the kill
+    happened, the baseline/ratio fields exist for hardware rounds, and
+    the decode replica's zero-recompile pin held through the ingests."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--engine", "disagg"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    dis = next(l for l in lines
+               if l["metric"] == "serve_disagg_interference_"
+                                 "tokens_per_sec_mixed")
+    base = next(l for l in lines
+                if l["metric"] == "serve_timeshared_interference_"
+                                  "tokens_per_sec_mixed")
+    from tools.serve_bench import SMOKE_INTERFERENCE as CAP
+
+    n = CAP["requests"]
+    longs = sum(1 for i in range(n)
+                if i and i % CAP["long_every"] == 0)
+    for leg in (dis, base):
+        assert leg["requests"] == n
+        assert leg["lost"] == 0 and leg["resolved"] == n
+        assert leg["ok"] + leg["deadline_partials"] + \
+            leg["typed_errors"] == n
+        assert leg["untyped_errors"] == 0
+        assert leg["generated_tokens"] > 0
+        assert leg["decode_step_compiles"] == leg["warmup_compiles"]
+    # Every seeded long prompt rode the ship path; shorts stayed local.
+    assert dis["shipped_joins"] == longs, (dis["shipped_joins"], longs)
+    assert dis["shipments_ingested"] >= longs
+    assert base["shipped_joins"] == 0
+    assert dis["killed_prefill_replicas"] == 1
+    assert dis["ship"]["shipped"] >= longs
+    # The acceptance-ratio fields hardware rounds key on.
+    assert dis["vs_baseline"] > 0
+    assert dis["baseline_ttft_p99_ms"] > 0
+    assert dis["baseline_itl_p99_ms"] > 0
+    assert dis["ttft_p99_vs_baseline"] > 0
+    assert dis["itl_p99_vs_baseline"] > 0
+    assert dis["host_cpus"] >= 1
 
 
 def test_zz_lock_order_witness_subgraph_of_static():

@@ -49,6 +49,10 @@ _FIXTURE_MATRIX = {
     "blocking_bad.py": ((), "blocking-under-lock"),
     "metrics_bad.py": ((), "metrics-registry"),
     "errors_bad.py": ((TAXONOMY,), "typed-error"),
+    # Disaggregation wire codes (ISSUE 14): a typo'd ship_failed /
+    # unknown prefill-pool code must trip — the two-stage router
+    # dispatches on these strings.
+    "errors_ship_bad.py": ((TAXONOMY,), "typed-error"),
 }
 
 
@@ -68,7 +72,7 @@ def test_fixture_trips_exactly_its_pass(name):
 
 @pytest.mark.parametrize("name", [
     "lockorder_clean.py", "guarded_clean.py", "blocking_clean.py",
-    "metrics_clean.py", "errors_clean.py",
+    "metrics_clean.py", "errors_clean.py", "errors_ship_clean.py",
 ])
 def test_clean_twin_trips_nothing(name):
     extra = (TAXONOMY,) if name.startswith("errors") else ()

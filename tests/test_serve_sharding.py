@@ -15,6 +15,7 @@ from tf_operator_tpu.serve.sharding import (
     leaf_spec,
     logits_spec,
     mesh_debug,
+    ship_specs,
     tp_size_of,
 )
 
@@ -97,6 +98,97 @@ class TestLogitsSpec:
 
     def test_tp1_replicates(self):
         assert logits_spec((8, 64), 1) == P()
+
+
+class TestDpAxis:
+    """The ``dp`` mesh axis over slots (PR 10 follow-on, ISSUE 14):
+    per-slot leaves shard their leading slot axis, the shared paged
+    pool replicates over dp — specs as pure data; the tp×dp engine
+    bit-identity matrix is the declared stretch behind a slow marker
+    once a >1-device dp engine lands."""
+
+    def test_stacked_dense_rows_shard_slots_over_dp(self):
+        # [slots, 1, S, KV, Dh]: dp on the slot axis, tp on KV.
+        assert leaf_spec("cached_key", (4, 1, 64, 4, 16), 2,
+                         dp_size=2) == P("dp", None, None, "tp", None)
+        assert leaf_spec("cached_value", (4, 1, 64, 4, 16), 1,
+                         dp_size=2) == P("dp", None, None, None, None)
+
+    def test_solo_dense_rows_never_shard_dp(self):
+        # The solo cache [1, S, KV, Dh] has no slot axis.
+        assert leaf_spec("cached_key", (1, 64, 4, 16), 2,
+                         dp_size=2) == P(None, None, "tp", None)
+
+    def test_per_slot_bookkeeping_shards_over_dp(self):
+        assert leaf_spec("block_table", (4, 8), 2, dp_size=2) == \
+            P("dp", None)
+        assert leaf_spec("cache_index", (4,), 2, dp_size=2) == P("dp")
+        assert leaf_spec("pos_index", (4,), 1, dp_size=4) == P("dp")
+
+    def test_paged_pool_replicates_over_dp(self):
+        # The pool is SHARED across slots: any slot's table may point
+        # at any block — dp cannot shard it, tp still shards heads.
+        assert leaf_spec("pool_key", (25, 8, 4, 16), 2, dp_size=2) == \
+            P(None, None, "tp", None)
+        assert leaf_spec("pool_key", (25, 8, 4, 16), 1, dp_size=2) == \
+            P()
+
+    def test_untileable_slots_fall_back(self):
+        # 3 slots over dp=2: the dp component drops, tp survives.
+        assert leaf_spec("cached_key", (3, 1, 64, 4, 16), 2,
+                         dp_size=2) == P(None, None, None, "tp", None)
+
+    def test_logits_shard_slots_and_vocab(self):
+        assert logits_spec((8, 64), 2, dp_size=2) == P("dp", "tp")
+        assert logits_spec((8, 64), 1, dp_size=2) == P("dp", None)
+        assert logits_spec((7, 64), 2, dp_size=2) == P(None, "tp")
+
+    def test_cache_specs_thread_dp_through(self):
+        tree = {
+            "attn": {
+                "pool_key": arr(25, 8, 4, 16),
+                "block_table": arr(4, 8),
+                "cache_index": arr(4),
+            },
+        }
+        specs = cache_specs(tree, 2, dp_size=2)
+        assert specs["attn"]["pool_key"] == P(None, None, "tp", None)
+        assert specs["attn"]["block_table"] == P("dp", None)
+        assert specs["attn"]["cache_index"] == P("dp")
+
+    def test_defaults_keep_tp_only_layout(self):
+        # dp_size default 1: bit-for-bit the PR 10 behavior.
+        assert leaf_spec("cached_key", (4, 1, 64, 4, 16), 2) == \
+            P(None, None, None, "tp", None)
+        assert leaf_spec("block_table", (4, 8), 2) == P()
+
+
+class TestShipSpecs:
+    """Shard layout of shipped-KV wire rows (serve/disagg.py): each
+    [R, KV, Dh] wire leaf head-shards like the pool leaf its rows land
+    in, so the disaggregated path composes with tp>1."""
+
+    def test_wire_rows_head_shard_like_the_pool(self):
+        rows = {"block_0/attn": {"key": arr(16, 4, 8),
+                                 "value": arr(16, 4, 8)}}
+        specs = ship_specs(rows, 2)
+        assert specs["block_0/attn"]["key"] == P(None, "tp", None)
+        assert specs["block_0/attn"]["value"] == P(None, "tp", None)
+
+    def test_untileable_heads_replicate(self):
+        rows = {"a": {"key": arr(16, 3, 8), "value": arr(16, 3, 8)}}
+        specs = ship_specs(rows, 2)
+        assert specs["a"]["key"] == P()
+
+    def test_accepts_bare_shapes(self):
+        specs = ship_specs({"a": {"key": (16, 4, 8)}}, 4)
+        assert specs["a"]["key"] == P(None, "tp", None)
+
+    def test_tp1_replicates(self):
+        specs = ship_specs(
+            {"a": {"key": arr(16, 4, 8), "value": arr(16, 4, 8)}}, 1
+        )
+        assert specs["a"]["key"] == P()
 
 
 class TestMeshDebug:

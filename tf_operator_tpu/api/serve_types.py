@@ -40,14 +40,33 @@ SERVE_API_VERSION = constants.API_VERSION
 ENV_SERVE_PORT = "TPU_SERVE_PORT"
 ENV_SERVE_REPLICA_ID = "TPU_SERVE_REPLICA_ID"
 ENV_SERVE_MODEL_VERSION = "TPU_SERVE_MODEL_VERSION"
+ENV_SERVE_ROLE = "TPU_SERVE_ROLE"
 
 # Child-job wiring (fleet/controller.py): each replica is one child
-# TPUJob named "{serve}-r{index}". The label pair is the child
-# selector; the version rides an ANNOTATION because model versions are
-# arbitrary strings (checkpoint paths), not label-safe values.
+# TPUJob named "{serve}-r{index}" (decode pool) or "{serve}-p{index}"
+# (prefill pool). The label pair is the child selector; the version
+# rides an ANNOTATION because model versions are arbitrary strings
+# (checkpoint paths), not label-safe values; the role label splits a
+# disaggregated fleet's children into its two pools.
 LABEL_SERVE_NAME = "fleet.tpuflow.org/serve"
 LABEL_SERVE_INDEX = "fleet.tpuflow.org/index"
+LABEL_SERVE_ROLE = "fleet.tpuflow.org/role"
 ANNOTATION_MODEL_VERSION = "fleet.tpuflow.org/model-version"
+
+# Replica roles. "" on the SPEC means a unified fleet (every replica
+# both prefills and decodes — the pre-disaggregation shape); "decode"/
+# "prefill" pin a whole TPUServe to one pool (operators running the
+# pools as two objects). On a CHILD job the role label is always
+# explicit.
+ROLE_UNIFIED = ""
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+SERVE_ROLES = (ROLE_UNIFIED, ROLE_PREFILL, ROLE_DECODE)
+
+# Prefill-pool endpoints live at portBase + PREFILL_PORT_OFFSET + index
+# so the two pools' port spans can never collide; validate_serve_spec
+# bounds both spans.
+PREFILL_PORT_OFFSET = 1000
 
 
 @dataclass
@@ -64,6 +83,13 @@ class AutoscalePolicy:
     queue_low: float = 1.0
     # Scale up when fleet TTFT p99 exceeds this (0 disables the trigger).
     ttft_p99_high_s: float = 0.0
+    # Decode-pool signals (disaggregated serving; 0 disables each):
+    # scale up when the fleet's worst inter-token-latency p99 exceeds
+    # this — shipped joins barely queue, so a saturated decode pool
+    # shows in its step time first…
+    itl_p99_high_s: float = 0.0
+    # …or when mean active-slot occupancy across ready replicas does.
+    occupancy_high: float = 0.0
     scale_up_cooldown_s: float = 5.0
     scale_down_cooldown_s: float = 30.0
 
@@ -75,6 +101,8 @@ class AutoscalePolicy:
             "queueHigh": self.queue_high,
             "queueLow": self.queue_low,
             "ttftP99HighSeconds": self.ttft_p99_high_s,
+            "itlP99HighSeconds": self.itl_p99_high_s,
+            "occupancyHigh": self.occupancy_high,
             "scaleUpCooldownSeconds": self.scale_up_cooldown_s,
             "scaleDownCooldownSeconds": self.scale_down_cooldown_s,
         }
@@ -88,6 +116,8 @@ class AutoscalePolicy:
             queue_high=float(d.get("queueHigh", 8.0)),
             queue_low=float(d.get("queueLow", 1.0)),
             ttft_p99_high_s=float(d.get("ttftP99HighSeconds", 0.0)),
+            itl_p99_high_s=float(d.get("itlP99HighSeconds", 0.0)),
+            occupancy_high=float(d.get("occupancyHigh", 0.0)),
             scale_up_cooldown_s=float(d.get("scaleUpCooldownSeconds", 5.0)),
             scale_down_cooldown_s=float(
                 d.get("scaleDownCooldownSeconds", 30.0)
@@ -112,6 +142,18 @@ class TPUServeSpec:
     # Rolling-update key: changing it surges a new-version replica per
     # index, waits for readiness, then drains the old one.
     model_version: str = ""
+    # Disaggregated prefill/decode (serve/disagg.py). ``role`` pins the
+    # WHOLE fleet to one pool ("" = unified); ``prefill_replicas`` > 0
+    # (unified/decode fleets only) makes the controller reconcile a
+    # SECOND child pool — "{serve}-p{index}" prefill replicas at
+    # portBase + PREFILL_PORT_OFFSET + index — scaled by
+    # ``prefill_autoscale`` on prefill queue depth, while the decode
+    # pool keeps ``autoscale`` (occupancy/ITL signals).
+    role: str = ROLE_UNIFIED
+    prefill_replicas: int = 0
+    prefill_autoscale: AutoscalePolicy = field(
+        default_factory=AutoscalePolicy
+    )
     # Seconds a scale-down/rolling-update replica stays DRAINING (router
     # deregistered, scheduler preemption-exempt) before its child job is
     # deleted and the SIGTERM bounded drain runs.
@@ -133,6 +175,12 @@ class TPUServeSpec:
             d["modelVersion"] = self.model_version
         if self.scale_down_grace_s != 5.0:
             d["scaleDownGraceSeconds"] = self.scale_down_grace_s
+        if self.role:
+            d["role"] = self.role
+        if self.prefill_replicas:
+            d["prefillReplicas"] = self.prefill_replicas
+        if self.prefill_autoscale != AutoscalePolicy():
+            d["prefillAutoscale"] = self.prefill_autoscale.to_dict()
         auto = self.autoscale.to_dict()
         if self.autoscale != AutoscalePolicy():
             d["autoscale"] = auto
@@ -151,6 +199,11 @@ class TPUServeSpec:
             port_base=int(d.get("portBase", 9100)),
             model_version=str(d.get("modelVersion", "")),
             scale_down_grace_s=float(d.get("scaleDownGraceSeconds", 5.0)),
+            role=str(d.get("role", ROLE_UNIFIED)),
+            prefill_replicas=int(d.get("prefillReplicas", 0)),
+            prefill_autoscale=AutoscalePolicy.from_dict(
+                d.get("prefillAutoscale", {})
+            ),
             autoscale=AutoscalePolicy.from_dict(d.get("autoscale", {})),
             scheduling=SchedulingPolicy.from_dict(d.get("scheduling", {})),
         )
@@ -169,6 +222,10 @@ class TPUServeStatus:
     dead: int = 0
     target: int = 0         # current desired count (autoscaler-adjusted)
     model_version: str = ""  # version every READY replica serves
+    # Prefill pool roll-up (disaggregated fleets; all 0 otherwise).
+    prefill_replicas: int = 0
+    prefill_ready: int = 0
+    prefill_target: int = 0
     conditions: list[JobCondition] = field(default_factory=list)
     last_reconcile_time: str | None = None
 
@@ -180,6 +237,11 @@ class TPUServeStatus:
             "dead": self.dead,
             "target": self.target,
         }
+        if self.prefill_replicas or self.prefill_target \
+                or self.prefill_ready:
+            d["prefillReplicas"] = self.prefill_replicas
+            d["prefillReady"] = self.prefill_ready
+            d["prefillTarget"] = self.prefill_target
         if self.model_version:
             d["modelVersion"] = self.model_version
         if self.conditions:
@@ -197,6 +259,9 @@ class TPUServeStatus:
             dead=int(d.get("dead", 0)),
             target=int(d.get("target", 0)),
             model_version=str(d.get("modelVersion", "")),
+            prefill_replicas=int(d.get("prefillReplicas", 0)),
+            prefill_ready=int(d.get("prefillReady", 0)),
+            prefill_target=int(d.get("prefillTarget", 0)),
             conditions=[
                 JobCondition.from_dict(c) for c in d.get("conditions", [])
             ],
@@ -258,6 +323,19 @@ def validate_serve_spec(spec: TPUServeSpec) -> None:
             f"no container named {constants.DEFAULT_CONTAINER_NAME!r} "
             "(serve env is injected into that container only)"
         )
+    if spec.role not in SERVE_ROLES:
+        raise ServeValidationError(
+            f"role must be one of {SERVE_ROLES!r}, got {spec.role!r}"
+        )
+    if spec.prefill_replicas < 0:
+        raise ServeValidationError("prefillReplicas must be >= 0")
+    if spec.role == ROLE_PREFILL and (
+            spec.prefill_replicas or spec.prefill_autoscale.enabled):
+        raise ServeValidationError(
+            "a role=prefill fleet IS a prefill pool; prefillReplicas/"
+            "prefillAutoscale only apply to unified/decode fleets "
+            "growing a second pool"
+        )
     auto = spec.autoscale
     if auto.min_replicas < 0 or auto.max_replicas < max(1, auto.min_replicas):
         raise ServeValidationError(
@@ -267,6 +345,12 @@ def validate_serve_spec(spec: TPUServeSpec) -> None:
     if auto.enabled and auto.queue_low > auto.queue_high:
         raise ServeValidationError(
             "autoscale.queueLow must be <= autoscale.queueHigh "
+            "(the hysteresis band must not invert)"
+        )
+    pauto = spec.prefill_autoscale
+    if pauto.enabled and pauto.queue_low > pauto.queue_high:
+        raise ServeValidationError(
+            "prefillAutoscale.queueLow must be <= queueHigh "
             "(the hysteresis band must not invert)"
         )
     # Replica ports are portBase + index; index allocation is bounded
@@ -282,3 +366,24 @@ def validate_serve_spec(spec: TPUServeSpec) -> None:
             f"reach {ceiling} replicas needs 2*(replicas+1) for surge "
             "and quarantined-index headroom"
         )
+    if spec.prefill_replicas or pauto.enabled:
+        # Decode indices live in [0, PREFILL_PORT_OFFSET); prefill
+        # indices at portBase + PREFILL_PORT_OFFSET + i. Both spans must
+        # fit, and the decode span must stay clear of the offset.
+        if 2 * (ceiling + 1) > PREFILL_PORT_OFFSET:
+            raise ServeValidationError(
+                f"a disaggregated fleet's decode pool is bounded at "
+                f"{PREFILL_PORT_OFFSET // 2 - 1} replicas (the prefill "
+                f"pool's ports start at portBase + {PREFILL_PORT_OFFSET})"
+            )
+        p_ceiling = max(
+            spec.prefill_replicas,
+            pauto.max_replicas if pauto.enabled else 0,
+        )
+        if (spec.port_base + PREFILL_PORT_OFFSET
+                + 2 * (p_ceiling + 1) > 65535):
+            raise ServeValidationError(
+                f"portBase {spec.port_base} + prefill offset "
+                f"{PREFILL_PORT_OFFSET} leaves no headroom for a "
+                f"prefill pool of {p_ceiling} replicas"
+            )

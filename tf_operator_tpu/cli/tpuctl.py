@@ -539,6 +539,35 @@ def cmd_serve(args, master: str) -> int:
                 ["REPLICA", "STATE", "ENDPOINT", "SLOTS", "QUEUE",
                  "LOAD", "VERSION", "RESTARTS"],
             ))
+        # Disaggregated fleets: the prefill pool, same shape (its QUEUE
+        # column is the pool's autoscale signal — prefill backlog).
+        prefill = fleet.get("prefill") or {}
+        prows = (prefill.get("membership") or {}).get("replicas") or []
+        if prefill:
+            pcounts = (prefill.get("membership") or {}).get("counts") or {}
+            pline = (f"  prefill pool: target={prefill.get('target', 0)} "
+                     + " ".join(f"{s}={n}"
+                                for s, n in sorted(pcounts.items())
+                                if n))
+            pauto = prefill.get("autoscale") or {}
+            if pauto.get("enabled"):
+                pline += (f"  autoscale=[{pauto.get('min')}.."
+                          f"{pauto.get('max')}]"
+                          + (f" last: {pauto['last_reason']}"
+                             if pauto.get("last_reason") else ""))
+            print(pline)
+        if prows:
+            print(_table(
+                [[r.get("id", ""),
+                  r.get("state", ""),
+                  r.get("endpoint", ""),
+                  r.get("queueDepth", 0),
+                  f"{r.get('load', 0):.2f}",
+                  r.get("modelVersion", "") or "-"]
+                 for r in prows],
+                ["PREFILL", "STATE", "ENDPOINT", "QUEUE", "LOAD",
+                 "VERSION"],
+            ))
     return 0
 
 

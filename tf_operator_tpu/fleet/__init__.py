@@ -21,6 +21,9 @@ from tf_operator_tpu.fleet.replica import (
     fleet_of,
 )
 from tf_operator_tpu.fleet.router import (
+    DisaggConfig,
+    DisaggRouter,
+    DisaggRouterServer,
     FleetRouter,
     RouterConfig,
     RouterServer,
@@ -29,6 +32,9 @@ from tf_operator_tpu.fleet.router import (
 __all__ = [
     "Autoscaler",
     "AutoscaleSnapshot",
+    "DisaggConfig",
+    "DisaggRouter",
+    "DisaggRouterServer",
     "FakeReplicaBackend",
     "FleetConfig",
     "FleetMembership",

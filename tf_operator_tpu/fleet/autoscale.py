@@ -54,6 +54,14 @@ class AutoscaleSnapshot:
     # the only demand signal a fleet scaled to zero can emit (nothing
     # exists to queue on, so queue_depth is structurally 0).
     unrouted: int = 0
+    # Decode-pool signals (disaggregated serving): mean active-slot
+    # fraction and worst inter-token-latency p99 across routable
+    # replicas. A decode pool saturates its SLOTS and its STEP TIME
+    # before its queues move (shipped joins cost almost nothing to
+    # admit), so queue depth alone under-scales it — these two are what
+    # the policy's occupancy_high / itl_p99_high_s thresholds read.
+    occupancy: float | None = None
+    itl_p99_s: float | None = None
 
 
 class Autoscaler:
@@ -87,16 +95,28 @@ class Autoscaler:
             and snap.ttft_p99_s is not None
             and snap.ttft_p99_s > pol.ttft_p99_high_s
         )
+        occ_high = bool(
+            pol.occupancy_high
+            and snap.occupancy is not None
+            and snap.occupancy > pol.occupancy_high
+        )
+        itl_high = bool(
+            pol.itl_p99_high_s
+            and snap.itl_p99_s is not None
+            and snap.itl_p99_s > pol.itl_p99_high_s
+        )
+        latency_high = ttft_high or occ_high or itl_high
         # A fleet at target 0 has no queues and no TTFT — rejected
         # (no_replica) requests are its scale-up signal, and ANY demand
         # against zero capacity warrants the first replica; without this
         # a minReplicas=0 fleet that drained to zero could never come
         # back.
         cold_start = current_target == 0 and snap.unrouted > 0
-        want_up = per_replica > pol.queue_high or ttft_high or cold_start
+        want_up = (per_replica > pol.queue_high or latency_high
+                   or cold_start)
         want_down = (
             not want_up
-            and not ttft_high
+            and not latency_high
             and per_replica < pol.queue_low
         )
         if not want_down:
@@ -113,6 +133,16 @@ class Autoscaler:
                 self.last_reason = (
                     f"ttft_p99 {snap.ttft_p99_s:.3f}s > "
                     f"{pol.ttft_p99_high_s}s"
+                )
+            elif itl_high and snap.itl_p99_s is not None:
+                self.last_reason = (
+                    f"itl_p99 {snap.itl_p99_s:.3f}s > "
+                    f"{pol.itl_p99_high_s}s"
+                )
+            elif occ_high and snap.occupancy is not None:
+                self.last_reason = (
+                    f"occupancy {snap.occupancy:.2f} > "
+                    f"{pol.occupancy_high}"
                 )
             elif per_replica > pol.queue_high:
                 self.last_reason = (
@@ -158,5 +188,7 @@ class Autoscaler:
             "queue_high": self.policy.queue_high,
             "queue_low": self.policy.queue_low,
             "ttft_p99_high_s": self.policy.ttft_p99_high_s,
+            "itl_p99_high_s": self.policy.itl_p99_high_s,
+            "occupancy_high": self.policy.occupancy_high,
             "last_reason": self.last_reason,
         }

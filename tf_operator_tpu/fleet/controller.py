@@ -70,8 +70,12 @@ from tf_operator_tpu.api.serve_types import (
     ENV_SERVE_MODEL_VERSION,
     ENV_SERVE_PORT,
     ENV_SERVE_REPLICA_ID,
+    ENV_SERVE_ROLE,
     LABEL_SERVE_INDEX,
     LABEL_SERVE_NAME,
+    LABEL_SERVE_ROLE,
+    PREFILL_PORT_OFFSET,
+    ROLE_PREFILL,
     TPUServe,
     validate_serve_spec,
 )
@@ -141,6 +145,8 @@ class TPUServeController:
                  config: FleetConfig | None = None,
                  probe_fn: Callable[[str], dict] | None = None,
                  endpoint_fn: Callable[[TPUServe, int], str] | None = None,
+                 prefill_endpoint_fn: Callable[[TPUServe, int], str]
+                 | None = None,
                  ) -> None:
         self.client = client
         self.scheduler = scheduler
@@ -154,6 +160,7 @@ class TPUServeController:
             )
         self._probe_fn = probe_fn
         self._endpoint_fn = endpoint_fn
+        self._prefill_endpoint_fn = prefill_endpoint_fn
         self._lock = threading.Lock()
         # Per-fleet state, keyed by "namespace/name".
         self._memberships: dict[str, FleetMembership] = {}
@@ -173,8 +180,8 @@ class TPUServeController:
     # -- per-fleet state ---------------------------------------------------
 
     def membership_for(self, key: str) -> FleetMembership:
-        """The fleet's replica table (created on first use) — what a
-        router for this TPUServe routes from."""
+        """The fleet's DECODE replica table (created on first use) —
+        what a router for this TPUServe routes /generate from."""
         with self._lock:
             ms = self._memberships.get(key)
             if ms is None:
@@ -185,18 +192,34 @@ class TPUServeController:
                 )
             return ms
 
-    def _autoscaler_for(self, serve: TPUServe) -> Autoscaler:
+    def prefill_membership_for(self, key: str) -> FleetMembership:
+        """The fleet's PREFILL pool table (disaggregated serving) —
+        what a DisaggRouter's first stage routes /prefill from. Keyed
+        "{key}#prefill" internally so the two pools can never share a
+        pick-set (or a gauge series)."""
+        return self.membership_for(f"{key}#prefill")
+
+    def _autoscaler_named(self, key: str, policy: Any) -> Autoscaler:
         with self._lock:
-            auto = self._autoscalers.get(serve.key)
-            if auto is None or auto.policy != serve.spec.autoscale:
+            auto = self._autoscalers.get(key)
+            if auto is None or auto.policy != policy:
                 # New fleet or edited policy: decisions restart from the
                 # spec (cooldown clocks reset — an edited band must not
                 # inherit a stale cooldown from the old one).
-                auto = Autoscaler(serve.spec.autoscale)
-                self._autoscalers[serve.key] = auto
+                auto = Autoscaler(policy)
+                self._autoscalers[key] = auto
             return auto
 
-    def endpoint_of(self, serve: TPUServe, index: int) -> str:
+    def _autoscaler_for(self, serve: TPUServe) -> Autoscaler:
+        return self._autoscaler_named(serve.key, serve.spec.autoscale)
+
+    def endpoint_of(self, serve: TPUServe, index: int,
+                    role: str = "decode") -> str:
+        if role == ROLE_PREFILL:
+            if self._prefill_endpoint_fn is not None:
+                return self._prefill_endpoint_fn(serve, index)
+            return (f"{serve.spec.host}:"
+                    f"{serve.spec.port_base + PREFILL_PORT_OFFSET + index}")
         if self._endpoint_fn is not None:
             return self._endpoint_fn(serve, index)
         return f"{serve.spec.host}:{serve.spec.port_base + index}"
@@ -216,26 +239,41 @@ class TPUServeController:
 
     # -- child jobs --------------------------------------------------------
 
-    def _children(self, serve: TPUServe) -> dict[int, dict[str, Any]]:
-        """index -> child TPUJob, from the store (fleet counts are
-        small; a LIST per sync is fine at this scale)."""
+    def _children(self, serve: TPUServe) -> tuple[
+            dict[int, dict[str, Any]], dict[int, dict[str, Any]]]:
+        """(decode, prefill) pools: index -> child TPUJob, split by the
+        role label (absent = decode, the pre-disaggregation children).
+        From the store — fleet counts are small; a LIST per sync is
+        fine at this scale."""
         jobs = self.client.list(
             objects.TPUJOBS, serve.metadata.namespace,
             {LABEL_SERVE_NAME: serve.metadata.name},
         )
-        out: dict[int, dict[str, Any]] = {}
+        decode: dict[int, dict[str, Any]] = {}
+        prefill: dict[int, dict[str, Any]] = {}
         for job in jobs:
+            labels = objects.labels_of(job)
             try:
-                idx = int(objects.labels_of(job)[LABEL_SERVE_INDEX])
+                idx = int(labels[LABEL_SERVE_INDEX])
             except (KeyError, ValueError):
                 continue
-            out[idx] = job
-        return out
+            if labels.get(LABEL_SERVE_ROLE) == ROLE_PREFILL:
+                prefill[idx] = job
+            else:
+                decode[idx] = job
+        return decode, prefill
 
-    def _build_child(self, serve: TPUServe, index: int) -> dict[str, Any]:
-        name = f"{serve.metadata.name}-r{index}"
+    def _build_child(self, serve: TPUServe, index: int,
+                     role: str = "decode") -> dict[str, Any]:
+        prefix = "p" if role == ROLE_PREFILL else "r"
+        name = f"{serve.metadata.name}-{prefix}{index}"
         template = copy.deepcopy(serve.spec.template)
-        port = self.endpoint_of(serve, index).rsplit(":", 1)[1]
+        port = self.endpoint_of(serve, index, role).rsplit(":", 1)[1]
+        # Single-pool fleets inherit the spec's role pin (a role=prefill
+        # TPUServe IS a prefill pool — its -r children run /prefill).
+        env_role = role if role == ROLE_PREFILL else (
+            serve.spec.role or "decode"
+        )
         for c in template.setdefault("spec", {}).setdefault(
             "containers", []
         ):
@@ -247,6 +285,7 @@ class TPUServeController:
                 {"name": ENV_SERVE_REPLICA_ID, "value": name},
                 {"name": ENV_SERVE_MODEL_VERSION,
                  "value": serve.spec.model_version},
+                {"name": ENV_SERVE_ROLE, "value": env_role},
             ])
         worker: dict[str, Any] = {"replicas": 1, "template": template}
         if serve.spec.tpu is not None:
@@ -255,16 +294,19 @@ class TPUServeController:
         sched = serve.spec.scheduling.to_dict()
         if sched:
             spec["scheduling"] = sched
+        labels = {
+            LABEL_SERVE_NAME: serve.metadata.name,
+            LABEL_SERVE_INDEX: str(index),
+        }
+        if role == ROLE_PREFILL:
+            labels[LABEL_SERVE_ROLE] = ROLE_PREFILL
         return {
             "apiVersion": constants.API_VERSION,
             "kind": constants.KIND,
             "metadata": {
                 "name": name,
                 "namespace": serve.metadata.namespace,
-                "labels": {
-                    LABEL_SERVE_NAME: serve.metadata.name,
-                    LABEL_SERVE_INDEX: str(index),
-                },
+                "labels": labels,
                 "annotations": {
                     ANNOTATION_MODEL_VERSION: serve.spec.model_version,
                 },
@@ -279,27 +321,37 @@ class TPUServeController:
             "spec": spec,
         }
 
-    def _create_replica(self, serve: TPUServe,
-                        index: int) -> dict[str, Any]:
+    def _create_replica(self, serve: TPUServe, index: int,
+                        role: str = "decode") -> dict[str, Any]:
         """Create the child job and return the dict it was built from
         (callers reuse it for their local view instead of building the
         template a second time)."""
-        job = self._build_child(serve, index)
+        job = self._build_child(serve, index, role)
         name = objects.name_of(job)
         try:
             self.client.create(objects.TPUJOBS, job)
         except Conflict:
             return job  # a concurrent sync already created it
-        self.membership_for(serve.key).register(
-            name, self.endpoint_of(serve, index),
+        ms = (self.prefill_membership_for(serve.key)
+              if role == ROLE_PREFILL else self.membership_for(serve.key))
+        ms.register(
+            name, self.endpoint_of(serve, index, role),
             model_version=serve.spec.model_version,
+            role=role,
         )
         self.recorder.normal(
             serve.to_dict(), EVENT_REPLICA_CREATED,
-            f"replica {name} created at "
-            f"{self.endpoint_of(serve, index)}",
+            f"{role} replica {name} created at "
+            f"{self.endpoint_of(serve, index, role)}",
         )
         return job
+
+    def _membership_of(self, serve: TPUServe,
+                       job: dict[str, Any]) -> FleetMembership:
+        """The pool table a child's row lives in, by its role label."""
+        if objects.labels_of(job).get(LABEL_SERVE_ROLE) == ROLE_PREFILL:
+            return self.prefill_membership_for(serve.key)
+        return self.membership_for(serve.key)
 
     def _begin_drain(self, serve: TPUServe, job: dict[str, Any],
                      reason: str) -> None:
@@ -310,7 +362,7 @@ class TPUServeController:
         name = objects.name_of(job)
         if ANNOTATION_DRAINING_AT in objects.annotations_of(job):
             return  # already draining; the clock is running
-        self.membership_for(serve.key).mark_draining(name)
+        self._membership_of(serve, job).mark_draining(name)
         try:
             self.client.patch_merge(
                 objects.TPUJOBS, serve.metadata.namespace, name,
@@ -327,18 +379,25 @@ class TPUServeController:
         )
 
     def _delete_replica(self, serve: TPUServe, name: str,
-                        reason: str, *, index: int | None = None) -> None:
+                        reason: str, *, index: int | None = None,
+                        role: str = "decode") -> None:
         try:
             self.client.delete(
                 objects.TPUJOBS, serve.metadata.namespace, name
             )
         except NotFound:
             pass
+        # Index quarantines are PER POOL: the pools' port spaces are
+        # disjoint, so index 2 freed in one must not block the other's.
+        pool_key = (f"{serve.key}#prefill" if role == ROLE_PREFILL
+                    else serve.key)
         if index is not None:
-            self._retired.setdefault(serve.key, {})[index] = (
+            self._retired.setdefault(pool_key, {})[index] = (
                 time.monotonic()
             )
-        self.membership_for(serve.key).deregister(name)
+        ms = (self.prefill_membership_for(serve.key)
+              if role == ROLE_PREFILL else self.membership_for(serve.key))
+        ms.deregister(name)
         self.recorder.normal(
             serve.to_dict(), EVENT_REPLICA_DELETED,
             f"replica {name} deleted ({reason})",
@@ -370,7 +429,7 @@ class TPUServeController:
     def reconcile_serve(self, serve: TPUServe) -> None:
         key = serve.key
         ms = self.membership_for(key)
-        children = self._children(serve)
+        children, prefill_children = self._children(serve)
         version = serve.spec.model_version
 
         # 1. Register every child (idempotent) and sweep probes. A
@@ -441,6 +500,11 @@ class TPUServeController:
                     queue_depth=ms.aggregate_queue_depth(),
                     ttft_p99_s=ms.fleet_ttft_p99(),
                     unrouted=unrouted,
+                    # Decode-pool signals (disaggregated fleets): the
+                    # policy's occupancy/ITL thresholds read these;
+                    # both default off, so plain fleets are unchanged.
+                    occupancy=ms.mean_occupancy(),
+                    itl_p99_s=ms.fleet_itl_p99(),
                 ),
                 current,
             )
@@ -563,8 +627,14 @@ class TPUServeController:
         # drain (admitted requests finish inside --drain-timeout).
         self._finish_drains(serve, children)
 
-        # 8. Status roll-up.
-        self._write_status(serve, children, target)
+        # 8. The prefill pool (disaggregated fleets; no-op otherwise).
+        prefill_target = self._reconcile_prefill_pool(
+            serve, prefill_children
+        )
+
+        # 9. Status roll-up.
+        self._write_status(serve, children, target, prefill_children,
+                           prefill_target)
 
     def _draining_names(self, children: dict[int, dict]) -> set[str]:
         return {
@@ -572,16 +642,19 @@ class TPUServeController:
             if ANNOTATION_DRAINING_AT in objects.annotations_of(j)
         }
 
-    def _next_index(self, serve: TPUServe,
-                    children: dict[int, dict]) -> int:
+    def _next_index(self, serve: TPUServe, children: dict[int, dict],
+                    role: str = "decode") -> int:
         """Lowest index neither held by an existing child (live OR
         draining — its process still owns the port) nor inside the
-        reuse quarantine. Bounded: a fleet's indices never exceed its
-        peak width plus the handful quarantined at any moment, so
+        reuse quarantine (per POOL: the pools' port spaces are
+        disjoint). Bounded: a fleet's indices never exceed its peak
+        width plus the handful quarantined at any moment, so
         ``portBase + index`` stays inside the validated port range no
         matter how many replacements a long-lived fleet goes through."""
         now = time.monotonic()
-        retired = self._retired.get(serve.key, {})
+        pool_key = (f"{serve.key}#prefill" if role == ROLE_PREFILL
+                    else serve.key)
+        retired = self._retired.get(pool_key, {})
         for i, freed_at in list(retired.items()):
             if now - freed_at >= self.config.index_quarantine_s:
                 retired.pop(i)
@@ -591,23 +664,153 @@ class TPUServeController:
         return idx
 
     def _finish_drains(self, serve: TPUServe,
-                       children: dict[int, dict]) -> None:
-        ms = self.membership_for(serve.key)
+                       children: dict[int, dict],
+                       role: str = "decode") -> None:
         for idx, job in sorted(children.items()):
             stamp = objects.annotations_of(job).get(ANNOTATION_DRAINING_AT)
             if not stamp:
                 continue
             name = objects.name_of(job)
             started = parse_rfc3339(stamp)
-            rep = ms.get(name)
+            rep = self._membership_of(serve, job).get(name)
             drained = rep is not None and rep.state == mship.DEAD
             if drained or started is None or (
                 time.time() - started >= serve.spec.scale_down_grace_s
             ):
                 self._delete_replica(
-                    serve, name, "drain complete", index=idx
+                    serve, name, "drain complete", index=idx, role=role
                 )
                 children.pop(idx)
+
+    def _reconcile_prefill_pool(self, serve: TPUServe,
+                                children: dict[int, dict]) -> int:
+        """The disaggregated fleet's SECOND pool, reconciled with the
+        same verbs as the decode pool but simpler policies: prefill
+        replicas are STATELESS (no admitted decodes to protect), so
+        there is no surge-then-drain roll — a stale-version replica
+        drains (one per sync) and the top-up loop recreates it at the
+        new version; dead ones are replaced at quarantined-reuse
+        indices; the pool scales on ITS OWN signal — prefill queue
+        depth per ready replica (``spec.prefillAutoscale``) — because a
+        prefill pool has no occupancy or ITL to read. Returns the
+        pool's target."""
+        key = serve.key
+        want = serve.spec.prefill_replicas
+        pol = serve.spec.prefill_autoscale
+        if not (want or pol.enabled or children):
+            return 0
+        pms = self.prefill_membership_for(key)
+
+        # Register + probe (drain state recovered from the store).
+        for idx, job in sorted(children.items()):
+            name = objects.name_of(job)
+            rep = pms.register(
+                name, self.endpoint_of(serve, idx, ROLE_PREFILL),
+                model_version=objects.annotations_of(job).get(
+                    ANNOTATION_MODEL_VERSION, ""
+                ),
+                role=ROLE_PREFILL,
+            )
+            if (ANNOTATION_DRAINING_AT in objects.annotations_of(job)
+                    and rep.state != mship.DEAD):
+                pms.mark_draining(name)
+        child_names = {objects.name_of(j) for j in children.values()}
+        for rid in [r.id for r in pms.all()]:
+            if rid not in child_names:
+                pms.deregister(rid)
+        pms.probe(self._probe_fn)
+
+        # Autoscale on prefill queue depth (or pin to the spec count).
+        counts = pms.counts()
+        auto_key = f"{key}#prefill"
+        # Drained unconditionally, exactly like the decode pool's: the
+        # stage-1 router notes no_replica answers onto THIS table, and
+        # they are the only demand signal a prefill pool scaled to
+        # zero can emit (nothing exists to queue on).
+        unrouted = pms.take_unrouted()
+        if pol.enabled:
+            auto = self._autoscaler_named(auto_key, pol)
+            current = self._targets.get(auto_key)
+            if current is None:
+                persisted = serve.status.prefill_target
+                reconciled = bool(serve.status.last_reconcile_time)
+                current = auto.clamp(
+                    persisted if persisted > 0 or reconciled else want
+                )
+            target = auto.decide(
+                AutoscaleSnapshot(
+                    ready=counts[mship.READY],
+                    queue_depth=pms.aggregate_queue_depth(),
+                    unrouted=unrouted,
+                ),
+                current,
+            )
+            if target != current:
+                self.recorder.normal(
+                    serve.to_dict(), EVENT_SCALED,
+                    f"prefill autoscale {current} -> {target}: "
+                    f"{auto.last_reason}",
+                )
+        else:
+            target = want
+        self._targets[auto_key] = target
+
+        # Replace the dead (no drain phase — they serve nothing).
+        draining_names = self._draining_names(children)
+        for idx, job in sorted(children.items()):
+            name = objects.name_of(job)
+            if name in draining_names:
+                continue
+            rep = pms.get(name)
+            if rep is not None and rep.state == mship.DEAD:
+                self.recorder.warning(
+                    serve.to_dict(), EVENT_REPLICA_DEAD,
+                    f"prefill replica {name} dead "
+                    f"({rep.consecutive_failures} failed probe(s)); "
+                    "replacing",
+                )
+                self._deaths[key] = self._deaths.get(
+                    key, serve.status.dead
+                ) + 1
+                self._delete_replica(serve, name, "dead", index=idx,
+                                     role=ROLE_PREFILL)
+                children.pop(idx)
+
+        # Version roll, stateless style: drain ONE stale per sync; the
+        # top-up below recreates at the new version in the same pass.
+        active = {
+            i: j for i, j in children.items()
+            if objects.name_of(j) not in draining_names
+        }
+        stale = sorted(
+            i for i, j in active.items()
+            if objects.annotations_of(j).get(ANNOTATION_MODEL_VERSION, "")
+            != serve.spec.model_version
+        )
+        if stale:
+            victim = active.pop(stale[0])
+            self._begin_drain(
+                serve, victim,
+                f"prefill roll to {serve.spec.model_version!r}",
+            )
+            draining_names.add(objects.name_of(victim))
+
+        # Scale to target.
+        while len(active) < target:
+            idx = self._next_index(serve, children, ROLE_PREFILL)
+            children[idx] = active[idx] = self._create_replica(
+                serve, idx, ROLE_PREFILL
+            )
+        if len(active) > target:
+            for idx in sorted(active, reverse=True)[
+                : len(active) - target
+            ]:
+                self._begin_drain(serve, active[idx], "scale down")
+                draining_names.add(objects.name_of(active[idx]))
+                active.pop(idx)
+
+        self._finish_drains(serve, children, ROLE_PREFILL)
+        return target
 
     def _collect_orphans(self, seen: set[str]) -> None:
         """Children whose TPUServe is gone: delete them and drop the
@@ -645,7 +848,9 @@ class TPUServeController:
                 )
         with self._lock:
             for key in list(self._memberships):
-                if key not in seen:
+                # Pool tables key "{fleet}" / "{fleet}#prefill": both
+                # live exactly as long as their TPUServe.
+                if key.split("#", 1)[0] not in seen:
                     self._memberships.pop(key).close()
                     self._autoscalers.pop(key, None)
                     self._targets.pop(key, None)
@@ -655,7 +860,9 @@ class TPUServeController:
     # -- status ------------------------------------------------------------
 
     def _write_status(self, serve: TPUServe, children: dict[int, dict],
-                      target: int) -> None:
+                      target: int,
+                      prefill_children: dict[int, dict] | None = None,
+                      prefill_target: int = 0) -> None:
         ms = self.membership_for(serve.key)
         counts = ms.counts()
         status = serve.status
@@ -667,22 +874,34 @@ class TPUServeController:
         # same sync that sees it, so counts[DEAD] here is always 0.
         status.dead = self._deaths.get(serve.key, status.dead)
         status.target = target
+        status.prefill_replicas = len(prefill_children or {})
+        status.prefill_target = prefill_target
+        status.prefill_ready = (
+            self.prefill_membership_for(serve.key).counts()[mship.READY]
+            if prefill_children or prefill_target else 0
+        )
         versions = {
             r.model_version for r in ms.all() if r.state == mship.READY
         }
         status.model_version = (
             versions.pop() if len(versions) == 1 else ""
         )
-        ready_now = target == 0 or status.ready >= target
+        ready_now = (
+            (target == 0 or status.ready >= target)
+            and status.prefill_ready >= prefill_target
+        )
+        msg = (
+            f"{status.ready}/{target} replicas ready"
+            + (f", {status.draining} draining" if status.draining else "")
+        )
+        if prefill_target or status.prefill_replicas:
+            msg += (f"; prefill {status.prefill_ready}/"
+                    f"{prefill_target} ready")
         self._set_condition(
             serve, COND_FLEET_READY,
             "True" if ready_now else "False",
             reason="AllReplicasReady" if ready_now else "FleetPending",
-            message=(
-                f"{status.ready}/{target} replicas ready"
-                + (f", {status.draining} draining"
-                   if status.draining else "")
-            ),
+            message=msg,
         )
         after = status.to_dict()
         if after == before:
@@ -731,29 +950,34 @@ class TPUServeController:
 
     def debug_snapshot(self) -> dict[str, Any]:
         """The /debug/fleet controller section: per-fleet membership +
-        target + autoscaler state."""
+        target + autoscaler state; disaggregated fleets carry their
+        prefill pool under a ``prefill`` sub-entry of the SAME fleet
+        key (tpuctl serve renders both pools)."""
         # Membership/autoscaler references are captured under the lock
         # (a concurrent fleet deletion pops these dicts mid-iteration);
         # the snapshot() calls run outside it — they take their own
         # locks and must not nest under ours.
         with self._lock:
-            fleets = [
+            rows = [
                 (key, self._targets.get(key, 0), ms,
                  self._autoscalers.get(key))
                 for key, ms in sorted(self._memberships.items())
             ]
-        return {
-            "fleets": {
-                key: {
-                    "target": target,
-                    "membership": ms.snapshot(),
-                    "autoscale": (
-                        auto.snapshot() if auto is not None else None
-                    ),
-                }
-                for key, target, ms, auto in fleets
+        fleets: dict[str, dict] = {}
+        for key, target, ms, auto in rows:
+            base, _, pool = key.partition("#")
+            entry = {
+                "target": target,
+                "membership": ms.snapshot(),
+                "autoscale": (
+                    auto.snapshot() if auto is not None else None
+                ),
             }
-        }
+            if pool == "prefill":
+                fleets.setdefault(base, {})["prefill"] = entry
+            else:
+                fleets.setdefault(base, {}).update(entry)
+        return {"fleets": fleets}
 
     def start(self, stop: threading.Event,
               interval: float | None = None) -> None:

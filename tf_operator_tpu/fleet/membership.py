@@ -58,6 +58,11 @@ class Replica:
     endpoint: str  # "host:port"
     model_version: str = ""
     state: str = JOINING
+    # Disaggregated serving (serve/disagg.py): "decode" replicas take
+    # /generate, "prefill" replicas take only /prefill. Pools live in
+    # SEPARATE membership tables (a router pick-set must never mix
+    # them); the field attributes rows in debug/tpuctl output.
+    role: str = "decode"
     # Last probe's load picture (0s until the first successful probe).
     max_slots: int = 0
     active_slots: int = 0
@@ -73,6 +78,11 @@ class Replica:
     # carries one) — the autoscaler's latency trigger reads the fleet
     # max so one slow replica is enough to scale.
     ttft_p99_s: float | None = None
+    # Per-replica ITL p99 (decode pools): the disaggregation-era decode
+    # scale signal — prefill interference and overload show up in
+    # inter-token gaps before queues move. Same clear-on-idle contract
+    # as ttft_p99_s.
+    itl_p99_s: float | None = None
     # Router-local outstanding requests (begin/end around each send).
     inflight: int = 0
     consecutive_failures: int = 0
@@ -92,11 +102,16 @@ class Replica:
             1, self.max_slots
         )
 
+    @property
+    def occupancy(self) -> float:
+        return self.active_slots / max(1, self.max_slots)
+
     def snapshot(self) -> dict[str, Any]:
         return {
             "id": self.id,
             "endpoint": self.endpoint,
             "state": self.state,
+            "role": self.role,
             "modelVersion": self.model_version,
             "maxSlots": self.max_slots,
             "activeSlots": self.active_slots,
@@ -106,6 +121,7 @@ class Replica:
             "watchdogRestarts": self.watchdog_restarts,
             "consecutiveFailures": self.consecutive_failures,
             "ttftP99Seconds": self.ttft_p99_s,
+            "itlP99Seconds": self.itl_p99_s,
             "load": round(self.load, 4),
         }
 
@@ -142,15 +158,18 @@ class FleetMembership:
     # -- registration ------------------------------------------------------
 
     def register(self, rid: str, endpoint: str, *,
-                 model_version: str = "") -> Replica:
+                 model_version: str = "",
+                 role: str = "decode") -> Replica:
         """Idempotent: re-registering an existing id only refreshes its
         endpoint/version (the controller calls this every sync)."""
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None:
-                rep = Replica(rid, endpoint, model_version=model_version)
+                rep = Replica(rid, endpoint, model_version=model_version,
+                              role=role)
                 self._replicas[rid] = rep
-                LOG.info(f"replica {rid} registered at {endpoint}")
+                LOG.info(f"replica {rid} ({role}) registered at "
+                         f"{endpoint}")
             else:
                 rep.endpoint = endpoint
                 if model_version:
@@ -206,6 +225,14 @@ class FleetMembership:
                 rep.ttft_p99_s = float(payload["ttft_p99_s"])
             else:
                 rep.ttft_p99_s = None
+            # Same clear-on-absent contract for the ITL window (the
+            # decode pool's latency scale signal).
+            if payload.get("itl_p99_s") is not None:
+                rep.itl_p99_s = float(payload["itl_p99_s"])
+            else:
+                rep.itl_p99_s = None
+            if payload.get("role"):
+                rep.role = str(payload["role"])
             if payload.get("dead"):
                 self._transition_locked(rep, DEAD)
             elif rep.state == DEAD:
@@ -378,6 +405,28 @@ class FleetMembership:
                 if r.routable and r.ttft_p99_s is not None
             ]
             return max(vals) if vals else None
+
+    def fleet_itl_p99(self) -> float | None:
+        """Worst routable replica's inter-token-latency p99 — the
+        decode pool's disaggregation-era latency trigger (one replica
+        with interfering prefills or an overloaded step is enough)."""
+        with self._lock:
+            vals = [
+                r.itl_p99_s for r in self._replicas.values()
+                if r.routable and r.itl_p99_s is not None
+            ]
+            return max(vals) if vals else None
+
+    def mean_occupancy(self) -> float | None:
+        """Mean active-slot fraction across routable replicas (None
+        with nothing routable) — the decode pool's capacity scale
+        signal: occupancy saturating means decode throughput has, too,
+        regardless of what queues look like."""
+        with self._lock:
+            vals = [
+                r.occupancy for r in self._replicas.values() if r.routable
+            ]
+            return sum(vals) / len(vals) if vals else None
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:

@@ -57,6 +57,8 @@ class SupervisorBackend:
     ServeError -> its ``http_status`` + payload.
     """
 
+    role = "decode"
+
     def __init__(self, supervisor: Any, *,
                  request_timeout_s: float = 120.0) -> None:
         self.supervisor = supervisor
@@ -107,6 +109,25 @@ class SupervisorBackend:
             tokens = np.asarray(body["tokens"], np.int32)
             if tokens.ndim != 2:
                 raise ValueError("tokens must be [batch, len]")
+        except (KeyError, ValueError, TypeError) as exc:
+            return 400, {"error": str(exc), "code": "bad_request",
+                         "retryable": False, "detail": str(exc)}
+        shipment = None
+        if body.get("shipped_kv") is not None:
+            # Disaggregated prefill: verify the payload BEFORE it
+            # reaches the scheduler — a digest/token mismatch answers
+            # typed ship_failed (the disagg router re-prefills; it
+            # never retries the same bytes on another decode replica).
+            from tf_operator_tpu.serve.disagg import decode_shipment
+            from tf_operator_tpu.serve.resilience import ShipFailed
+
+            try:
+                shipment = decode_shipment(
+                    body["shipped_kv"], expect_tokens=tokens[0]
+                )
+            except ShipFailed as exc:
+                return http_status_of(exc), error_payload(exc)
+        try:
             req = ServeRequest(
                 tokens[:1], int(body.get("num_steps", 8)),
                 temperature=float(body.get("temperature", 0.0)),
@@ -117,6 +138,7 @@ class SupervisorBackend:
                 # id becomes the scheduler/engine span key, so the
                 # merged trace follows one request across processes.
                 request_id=body.get("request_id"),
+                shipment=shipment,
             )
         except (KeyError, ValueError, TypeError) as exc:
             return 400, {"error": str(exc), "code": "bad_request",
@@ -153,6 +175,8 @@ class FakeReplicaBackend:
     taxonomy the router keys on without an engine in sight.
     """
 
+    role = "decode"
+
     def __init__(self, *, max_slots: int = 8,
                  service_delay_s: float = 0.0) -> None:
         self.max_slots = max_slots
@@ -163,6 +187,10 @@ class FakeReplicaBackend:
         self.restarts = 0
         self.dead = False
         self.ttft_p99_s: float | None = None
+        self.itl_p99_s: float | None = None
+        # Shipped-KV bodies seen (disagg chaos tier asserts the routed
+        # payload actually reached a decode replica).
+        self.shipped_received = 0
         self._lock = threading.Lock()
         self._inflight = 0
         self._scripted: list[Exception] = []
@@ -179,6 +207,8 @@ class FakeReplicaBackend:
     def handle(self, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
         with self._lock:
             self._inflight += 1
+            if body.get("shipped_kv") is not None:
+                self.shipped_received += 1
             scripted = self._scripted.pop(0) if self._scripted else None
         try:
             if scripted is not None:
@@ -219,14 +249,18 @@ class ReplicaServer:
                         replica=outer.replica_id,
                         max_slots=getattr(outer.backend, "max_slots",
                                           None),
+                        role=getattr(outer.backend, "role", ""),
                     )
                     # Scriptable latency for the autoscaler tier: a
-                    # FakeReplicaBackend pins its own p99 instead of the
-                    # process-global histogram shared by every
+                    # FakeReplicaBackend pins its own p99s instead of
+                    # the process-global histograms shared by every
                     # in-process replica.
                     ttft = getattr(outer.backend, "ttft_p99_s", None)
                     if ttft is not None:
                         payload["ttft_p99_s"] = float(ttft)
+                    itl = getattr(outer.backend, "itl_p99_s", None)
+                    if itl is not None:
+                        payload["itl_p99_s"] = float(itl)
                     self.send_json(200, payload)
                 elif path == "/debug/serve" and hasattr(
                     outer.backend, "debug_snapshot"

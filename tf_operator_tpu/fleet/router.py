@@ -52,6 +52,7 @@ from tf_operator_tpu.runtime.metrics import (
     FLEET_ROUTER_FAILOVERS,
     FLEET_ROUTER_REQUESTS,
     FLEET_ROUTER_RETRIES,
+    FLEET_SHIP_TOTAL,
 )
 from tf_operator_tpu.runtime.tracing import (
     SERVE_TRACER,
@@ -226,12 +227,13 @@ class FleetRouter:
 # ---------------------------------------------------------------------------
 
 
-def http_send(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
-    """POST the body to the replica's /generate; typed error bodies come
-    back as (status, payload) rather than raising — only transport-level
-    failures raise (and trigger failover)."""
+def _http_post_json(url: str, body: dict,
+                    timeout: float) -> tuple[int, dict]:
+    """ONE wire implementation for the replica-facing POSTs: typed
+    error bodies come back as (status, payload) rather than raising —
+    only transport-level failures raise (and trigger failover)."""
     req = urllib.request.Request(
-        f"http://{rep.endpoint}/generate",
+        url,
         data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
@@ -246,6 +248,19 @@ def http_send(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
             payload = {"error": str(e), "code": "internal",
                        "retryable": False}
         return e.code, payload
+
+
+def http_send(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
+    """POST the body to the replica's /generate."""
+    return _http_post_json(f"http://{rep.endpoint}/generate", body,
+                           timeout)
+
+
+def http_ship(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
+    """POST a prompt to a PREFILL replica's /prefill (serve/disagg.py
+    PrefillServer) — the two-stage dispatch's stage-1 transport."""
+    return _http_post_json(f"http://{rep.endpoint}/prefill", body,
+                           timeout)
 
 
 def http_probe(endpoint: str, timeout: float = 2.0) -> dict:
@@ -330,12 +345,7 @@ class RouterServer:
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
                 if path == "/healthz":
-                    counts = outer.membership.counts()
-                    self.send_json(200, {
-                        "ok": counts["ready"] > 0,
-                        "router": True,
-                        "replicas": counts,
-                    })
+                    self.send_json(200, outer.healthz_payload())
                 elif path == "/debug/fleet":
                     self.send_json(200, outer.debug_snapshot())
                 elif path == "/debug/traces":
@@ -377,6 +387,14 @@ class RouterServer:
     def endpoint(self) -> str:
         return f"{self.host}:{self.port}"
 
+    def healthz_payload(self) -> dict:
+        counts = self.membership.counts()
+        return {
+            "ok": counts["ready"] > 0,
+            "router": True,
+            "replicas": counts,
+        }
+
     def debug_snapshot(self) -> dict:
         snap = {
             "membership": self.membership.snapshot(),
@@ -411,3 +429,249 @@ class RouterServer:
         self._stop.set()
         self._server.shutdown()
         self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Two-stage dispatch: prefill pool -> decode pool (disaggregated serving)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DisaggConfig:
+    """Knobs of the two-stage dispatch. ``ship_min_tokens`` gates which
+    prompts are worth the hop: tiny prompts prefill in one decode-loop
+    iteration and shipping them only adds wire latency — the
+    interference win is the LONG prefills. 0 ships everything (the
+    deterministic test/bench setting)."""
+
+    ship_min_tokens: int = 0
+    # One fresh prefill->decode cycle after a decode replica answers
+    # ship_failed before giving up on shipping and going local.
+    reship_retries: int = 1
+
+
+class DisaggRouter:
+    """Two-stage dispatch over TWO pools: route the prompt to the
+    least-loaded PREFILL replica (/prefill → the shipped-KV payload),
+    attach the shipment, then route to the least-loaded DECODE replica
+    (/generate). Each stage is a full PR 9 ``FleetRouter`` — the typed
+    retry-elsewhere contract, membership side effects, and transport
+    failover all apply per pool unchanged.
+
+    Failure policy (every path ends in a served request):
+
+    - prefill pool EMPTY (``no_replica``) → typed ``prefill_pool_empty``
+      noted on the response, decode pool prefills locally — a dead
+      prefill pool degrades to exactly the time-shared engine;
+    - prefill stage exhausts its retry budget (typed/transport) →
+      local-prefill fallback the same way;
+    - prefill rejects the REQUEST (``bad_request``) → returned to the
+      client unchanged (the decode pool would reject it identically);
+    - decode replica answers ``ship_failed`` (digest/geometry mismatch)
+      → ONE fresh prefill→decode cycle (``reship_retries``), then
+      local-prefill fallback. Never the same bytes to another decode
+      replica: the payload is what failed.
+    """
+
+    def __init__(self, prefill_membership: FleetMembership,
+                 decode_membership: FleetMembership, *,
+                 prefill_send: Callable[..., tuple[int, dict]] = http_ship,
+                 decode_send: Callable[..., tuple[int, dict]] = http_send,
+                 config: RouterConfig | None = None,
+                 disagg: DisaggConfig | None = None) -> None:
+        cfg = config or RouterConfig()
+        self.cfg = cfg
+        self.disagg = disagg or DisaggConfig()
+        self.prefill = FleetRouter(prefill_membership, prefill_send, cfg)
+        self.decode = FleetRouter(decode_membership, decode_send, cfg)
+        self._lock = threading.Lock()
+        self.shipped = 0
+        self.prefill_pool_empty = 0
+        self.local_fallbacks = 0
+        self.ship_failures = 0
+
+    def _note(self, counter: str, outcome: str) -> None:
+        with self._lock:
+            setattr(self, counter, getattr(self, counter) + 1)
+        FLEET_SHIP_TOTAL.inc(outcome=outcome)
+
+    def _stage_prefill(self, body: dict, rid: str,
+                       timeout: float | None) -> tuple[
+            dict | None, str | None, dict | None]:
+        """Run stage 1. Returns (shipment payload | None, note,
+        reject): ``reject`` is the prefill pool's own typed
+        ``bad_request`` answer — route() returns it to the client
+        verbatim (the prompt itself is malformed; the replica's error
+        detail must not be replaced with a generic string)."""
+        t0 = time.monotonic()
+        status, payload = self.prefill.route(
+            {"tokens": body["tokens"], "request_id": rid},
+            timeout=timeout,
+        )
+        SERVE_TRACER.record(
+            "kv.ship", t0, time.monotonic(),
+            request_id=rid, stage="prefill_dispatch", status=status,
+            code=payload.get("code", ""),
+            replica=payload.get("replica", ""),
+        )
+        if status < 400 and payload.get("shipped_kv"):
+            self._note("shipped", "shipped")
+            return payload["shipped_kv"], "shipped", None
+        code = payload.get("code", "")
+        if code == "no_replica":
+            # The pool is empty/unroutable: typed degradation, decode
+            # prefills locally.
+            self._note("prefill_pool_empty", "prefill_pool_empty")
+            return None, "prefill_pool_empty", None
+        if code == "bad_request":
+            return None, None, payload
+        self._note("local_fallbacks", "local_fallback")
+        return None, code or "prefill_failed", None
+
+    def route(self, body: dict,
+              timeout: float | None = None) -> tuple[int, dict]:
+        rid = body.get("request_id") or mint_request_id()
+        body = dict(body, request_id=rid)
+        # The disagg router reads the prompt itself (the ship-gate and
+        # the stage-1 body), so malformed tokens must 400 typed HERE —
+        # the plain router can leave that to the replica, this one
+        # would crash the handler instead.
+        prompt = body.get("tokens")
+        if (not isinstance(prompt, list) or not prompt
+                or not isinstance(prompt[0], list)):
+            return 400, {
+                "error": "tokens must be [[...]] (one prompt row)",
+                "code": "bad_request", "retryable": False,
+                "request_id": rid,
+            }
+        prompt_len = len(prompt[0])
+        ship_note: str | None = None
+        attempts = self.disagg.reship_retries + 1
+        for attempt in range(attempts):
+            shipped, note = None, None
+            # Ship single-row long prompts only: a shipment prefills
+            # ONE prompt, and multi-row bodies must behave exactly as
+            # they do through the plain router (the decode replica
+            # decides what to do with the extra rows — no annotation).
+            if len(prompt) == 1:
+                note = "below_min_tokens"
+                if prompt_len >= self.disagg.ship_min_tokens:
+                    # The caller's bound covers BOTH stages.
+                    shipped, note, reject = self._stage_prefill(
+                        body, rid, timeout
+                    )
+                    if reject is not None:
+                        # The prefill pool's own typed bad_request: the
+                        # prompt itself is malformed — hand the
+                        # replica's answer (detail included) straight
+                        # back.
+                        reject.setdefault("request_id", rid)
+                        return 400, reject
+            ship_note = note
+            decode_body = dict(body)
+            if shipped is not None:
+                decode_body["shipped_kv"] = shipped
+            status, payload = self.decode.route(decode_body,
+                                                timeout=timeout)
+            if (payload.get("code") == "ship_failed"
+                    and attempt + 1 < attempts):
+                # The payload is what failed — re-run the PREFILL stage
+                # for fresh bytes rather than burning decode replicas.
+                self._note("ship_failures", "ship_failed")
+                continue
+            if payload.get("code") == "ship_failed":
+                # Budget spent: strip the shipment, decode prefills
+                # locally — the request still serves (and the ship
+                # annotation must say what actually happened, not that
+                # the dropped shipment was used).
+                self._note("ship_failures", "ship_failed")
+                self._note("local_fallbacks", "local_fallback")
+                ship_note = "ship_failed"
+                status, payload = self.decode.route(dict(body),
+                                                    timeout=timeout)
+            if ship_note and status < 400:
+                payload = dict(payload, ship=ship_note)
+            return status, payload
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            ship = {
+                "shipped": self.shipped,
+                "prefill_pool_empty": self.prefill_pool_empty,
+                "local_fallbacks": self.local_fallbacks,
+                "ship_failures": self.ship_failures,
+                "ship_min_tokens": self.disagg.ship_min_tokens,
+            }
+        return {
+            "prefill": self.prefill.snapshot(),
+            "decode": self.decode.snapshot(),
+            "ship": ship,
+        }
+
+
+class DisaggRouterServer(RouterServer):
+    """The stdlib HTTP front of a disaggregated fleet — RouterServer's
+    scaffolding (handler, /metrics, /debug routes, lifecycle) with the
+    decode pool as ``membership``, the two-stage ``DisaggRouter``
+    behind /generate, /healthz aggregating BOTH pools (ok while the
+    decode pool is routable — the prefill pool degrades, never gates),
+    /debug/fleet carrying per-pool membership, and the probe sweep
+    covering both pools each interval."""
+
+    def __init__(self, prefill_membership: FleetMembership,
+                 decode_membership: FleetMembership, *,
+                 router: DisaggRouter | None = None,
+                 config: RouterConfig | None = None,
+                 disagg: DisaggConfig | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 probe_fn: Callable[[str], dict] | None = None) -> None:
+        cfg = config or RouterConfig()
+        self.prefill_membership = prefill_membership
+        self.decode_membership = decode_membership
+        super().__init__(
+            decode_membership,
+            router=router or DisaggRouter(
+                prefill_membership, decode_membership, config=cfg,
+                disagg=disagg,
+            ),
+            config=cfg, host=host, port=port, probe_fn=probe_fn,
+        )
+
+    def healthz_payload(self) -> dict:
+        payload = super().healthz_payload()
+        payload["disagg"] = True
+        payload["prefill_replicas"] = self.prefill_membership.counts()
+        return payload
+
+    def debug_snapshot(self) -> dict:
+        snap = super().debug_snapshot()
+        snap["prefill_membership"] = self.prefill_membership.snapshot()
+        return snap
+
+    def merged_traces(self) -> dict:
+        """Both pools' rings + the router's own, one timeline: the
+        ``kv.ship`` spans bridge the prefill replica's ``prefill.ship``
+        to the decode replica's ingest under one request id."""
+        doc = merged_fleet_traces(self.decode_membership,
+                                  self._trace_fn)
+        prefill_docs = []
+        for rep in self.prefill_membership.all():
+            if rep.state == DEAD:
+                continue
+            try:
+                prefill_docs.append(
+                    (f"prefill:{rep.id}", self._trace_fn(rep.endpoint))
+                )
+            except Exception:  # noqa: BLE001 — best-effort, as in
+                # merged_fleet_traces: an unreachable replica must not
+                # fail the merge.
+                continue
+        if prefill_docs:
+            doc = merge_chrome_traces([("merged", doc)] + prefill_docs)
+        return doc
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.cfg.probe_interval_s):
+            self.decode_membership.probe(self._probe_fn)
+            self.prefill_membership.probe(self._probe_fn)

@@ -135,6 +135,12 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # stays queued at the FRONT of window_autorun's unmeasured set; the
 # dispatch_auto-vs-direct_bq1024 revert trigger above stays armed and
 # the cap stays 1024.
+# Re-checked (PR 14, 2026-08-04): unchanged — no window newer than
+# window_r05 exists and neither r05 stamp holds probe_qblock output
+# (still only the single-shot flashblocks line). Trigger stays OPEN;
+# the cap stays 1024 on the single-shot data; the qblock stage remains
+# at the front of window_autorun's unmeasured set for the next
+# hardware window.
 MAX_Q_BLOCK = 1024
 
 

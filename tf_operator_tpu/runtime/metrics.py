@@ -524,6 +524,22 @@ SERVE_OCCUPANCY = REGISTRY.histogram(
     "the quantity decode throughput is proportional to",
     buckets=(0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
 )
+SERVE_SHIP_INGEST_TOTAL = REGISTRY.counter(
+    "tpu_serve_kv_ship_ingest_total",
+    "Shipped-KV ingest attempts on a decode replica, by outcome (ok: "
+    "blocks written + prefix registered; exhausted: no free blocks — "
+    "the request requeued; unsupported: dense engine, shipment dropped "
+    "and prefill ran locally; failed: malformed/mismatched payload, "
+    "local-prefill fallback)",
+    ("outcome",),
+)
+SERVE_SHIP_TOKENS_TOTAL = REGISTRY.counter(
+    "tpu_serve_ship_tokens_total",
+    "Prompt tokens whose K/V arrived as shipped block-pool rows from a "
+    "dedicated prefill replica instead of local prefill (the "
+    "disaggregation win: these tokens never time-shared the decode "
+    "device)",
+)
 
 # -- fleet serving (tf_operator_tpu/fleet/): TPUServe membership, the
 # occupancy-aware router, and queue-depth autoscaling -----------------------
@@ -561,6 +577,17 @@ FLEET_QUEUE_DEPTH = REGISTRY.gauge(
     "tpu_fleet_queue_depth",
     "Aggregate queued requests across routable replicas, per fleet, as "
     "of the last membership probe sweep", ("fleet",),
+)
+FLEET_SHIP_TOTAL = REGISTRY.counter(
+    "tpu_fleet_ship_total",
+    "Two-stage (prefill pool -> decode pool) dispatch outcomes at the "
+    "disaggregation router: shipped = KV prefilled remotely and "
+    "attached to the decode send; prefill_pool_empty = no routable "
+    "prefill replica, decode pool prefilled locally; local_fallback = "
+    "the prefill stage failed typed/transport past its retry budget; "
+    "ship_failed = a decode replica rejected the payload and the "
+    "request re-ran with local prefill",
+    ("outcome",),
 )
 
 # -- tracing (runtime/tracing.py): declared here, not there, so the

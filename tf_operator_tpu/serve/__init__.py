@@ -26,6 +26,11 @@ requests mid-stream.
 - ``httpapi``: the /debug/serve endpoint, the shared stdlib-handler
   base (``QuietHandler``, incl. the /debug/traces export of the
   data-plane span ring), and the /healthz readiness payload.
+- ``disagg``: disaggregated prefill/decode — dedicated prefill
+  replicas (``PrefillWorker``/``PrefillServer``), the shipped-KV wire
+  format (``export_shipment``/``decode_shipment``), and the digest
+  chain; the two-stage router lives in fleet/router.py. See
+  docs/disaggregation.md.
 
 Re-exports resolve lazily (PEP 562): importing the package must not
 drag jax into processes that only mount the debug surface.
@@ -53,6 +58,12 @@ _EXPORTS = {
     "Coalescer": "coalesce",
     "ServeDebugHandler": "httpapi",
     "mount_serve": "httpapi",
+    "Shipment": "disagg",
+    "PrefillWorker": "disagg",
+    "PrefillServer": "disagg",
+    "FakePrefillBackend": "disagg",
+    "export_shipment": "disagg",
+    "decode_shipment": "disagg",
 }
 
 __all__ = sorted(_EXPORTS)

@@ -1,0 +1,583 @@
+"""Disaggregated prefill/decode serving: dedicated prefill replicas,
+the shipped-KV wire format, and the prefill side of the two-stage
+dispatch.
+
+Chunked prefill (PR 5/6) time-shares the decode loop's device: one
+64k-token prefill steals decode steps from every active slot —
+``tpu_serve_phase_seconds_total{prefill_interference}`` measures the
+theft, this module removes it. The roles split:
+
+- **Prefill replicas** run ONLY prompt prefill (``ChunkedPrefill`` /
+  the one-shot ``_prefill``) — no slots, no decode loop, no KV pool.
+  A finished prefill exports as wire-format BLOCK-POOL ROWS: per
+  attention layer, the dense cache rows ``[0 : ceil(L/B)*B)`` (the
+  exact bytes the paged insert would have scattered into the donor's
+  blocks in the local path — pad rows past the prompt included, so a
+  copy-on-write of the partial last block is bitwise the local copy),
+  plus the last-position logits row and the chained per-block SHA-1
+  token digests (the PrefixCache key chain, recomputed and verified on
+  the decode side).
+- **Decode replicas** ingest a shipment through
+  ``ContinuousEngine.ingest_shipment``: allocate blocks, scatter the
+  rows (``kvcache.make_pool_write_fn``), register the prompt in the
+  PrefixCache with the shipped logits — after which the request's own
+  admission finds an EXACT prefix match and joins via the PR 6
+  table-insert path, skipping prefill entirely. A shipped prefix lands
+  exactly like a local exact-prefix-cache hit, so decode output is
+  bit-identical whether the KV was computed locally or shipped
+  (tests/test_serve_disagg.py pins greedy and sampled, one-shot and
+  chunked), and the decode step never recompiles
+  (``compiles == warmup_compiles`` holds through ingest).
+
+The two-stage dispatch (prefill pool → decode pool) lives in
+fleet/router.py (``DisaggRouter``); failure handling rides the existing
+typed-error contract with the new codes — ``ship_failed`` (a decode
+replica rejected the payload; re-prefill, never retry the same bytes
+elsewhere) and ``prefill_pool_empty`` (no routable prefill replica; the
+decode pool prefills locally — graceful degradation, not an error).
+Every fallback path ends in a served request: a dead prefill pool makes
+the system exactly the PR 6 time-shared engine again.
+
+Wire format (``export_shipment`` / ``decode_shipment``): JSON-safe dict
+— arrays as base64 raw bytes + shape + dtype — because everything else
+on the serving wire is stdlib HTTP + JSON. The rows are the paged pool
+layout already (``[rows, KV, Dh]`` per layer), which is what makes the
+transfer payload trivial; ``serve/sharding.ship_specs`` names each wire
+leaf's placement for the tp>1 case (rows enter replicated and the
+ingest scatter writes each chip's KV/tp head shard).
+
+This module imports jax lazily: the fleet test tier and the router load
+it jax-free (FakePrefillBackend, digest helpers, the HTTP server).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import threading
+import time
+from dataclasses import dataclass
+from http.server import ThreadingHTTPServer
+from typing import Any
+
+import numpy as np
+
+from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
+from tf_operator_tpu.serve.httpapi import QuietHandler
+from tf_operator_tpu.serve.resilience import (
+    Draining,
+    ShipFailed,
+    error_payload,
+    http_status_of,
+)
+from tf_operator_tpu.utils import logger
+
+LOG = logger.with_fields(component="serve-disagg")
+
+WIRE_VERSION = 1
+
+# Seed of the chained per-block digest — MUST match PrefixCache._SEED
+# (kvcache.py): the shipment's digests are literally the prefix-cache
+# key chain, so a decode replica could pre-key its registry from them.
+_SEED = hashlib.sha1(b"tpu-kv-prefix").digest()
+
+
+# ---------------------------------------------------------------------------
+# digests + array codec
+# ---------------------------------------------------------------------------
+
+
+def chain_digests(tokens: np.ndarray, block: int) -> list[str]:
+    """Chained per-block SHA-1 digests of a prompt, hex, shortest first:
+    ``D_k = sha1(D_{k-1} + block_k_bytes)`` per full block, chained once
+    more over the partial tail — the PrefixCache key chain
+    (kvcache.py), O(L) total."""
+    tokens = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    L = len(tokens)
+    digest = _SEED
+    out: list[str] = []
+    for k in range(L // block):
+        digest = hashlib.sha1(
+            digest + tokens[k * block:(k + 1) * block].tobytes()
+        ).digest()
+        out.append(digest.hex())
+    if L % block:
+        out.append(hashlib.sha1(
+            digest + tokens[(L // block) * block:].tobytes()
+        ).digest().hex())
+    return out
+
+
+def _enc(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def _dec(d: dict) -> np.ndarray:
+    try:
+        raw = base64.b64decode(d["b64"])
+        return np.frombuffer(raw, dtype=np.dtype(d["dtype"])).reshape(
+            d["shape"]
+        )
+    except (KeyError, TypeError, ValueError) as exc:
+        raise ShipFailed(f"malformed wire array: {exc}") from exc
+
+
+def _rows_sha1(rows: dict) -> str:
+    """One SHA-1 over every row leaf in path order: the payload
+    integrity check (the token digests prove WHICH prompt, this proves
+    the K/V bytes survived the hop)."""
+    h = hashlib.sha1()
+    for path in sorted(rows):
+        for part in ("key", "value"):
+            h.update(path.encode())
+            h.update(np.ascontiguousarray(rows[path][part]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# shipment: export / decode / verify
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Shipment:
+    """One decoded, VERIFIED shipped-KV payload, engine-ready."""
+
+    tokens: np.ndarray                 # [L] int32 prompt
+    kv_block: int
+    rows: dict[str, dict[str, np.ndarray]]  # path -> key/value [R,KV,Dh]
+    logits: np.ndarray                 # [vocab] last-position sampling row
+    digests: tuple[str, ...] = ()
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.tokens.shape[0])
+
+
+def _cache_row_paths(cache: Any, prefix: tuple = ()):
+    """Yield (path, leaf_name, leaf) for the dense K/V row leaves of a
+    solo decode cache — path is the PARENT module path, which is shared
+    with the paged tree's pool leaves (same model, same modules)."""
+    from collections.abc import Mapping
+
+    if not isinstance(cache, Mapping):
+        return
+    for name, leaf in cache.items():
+        if name in ("cached_key", "cached_value"):
+            yield "/".join(prefix), name, leaf
+        elif isinstance(leaf, Mapping):
+            yield from _cache_row_paths(leaf, prefix + (name,))
+
+
+def export_shipment(cache: Any, tokens: np.ndarray, logits: np.ndarray,
+                    kv_block: int) -> dict:
+    """Render a finished SOLO prefill (dense cache + last-position
+    logits) as the JSON-safe wire payload. Ships rows
+    ``[0 : ceil(L/B)*B)`` per layer — block-aligned, pad rows past the
+    prompt included so the decode side's blocks are bitwise what a
+    local prefill would have produced (the CoW copy of a partial last
+    block reads them)."""
+    tokens = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    L = int(tokens.shape[0])
+    cap_rows = -(-L // kv_block) * kv_block
+    rows: dict[str, dict[str, np.ndarray]] = {}
+    for path, name, leaf in _cache_row_paths(cache):
+        arr = np.asarray(leaf)[0, :cap_rows]  # [1, S, KV, Dh] -> rows
+        rows.setdefault(path, {})[
+            "key" if name == "cached_key" else "value"
+        ] = arr
+    payload = {
+        "version": WIRE_VERSION,
+        "tokens": tokens.tolist(),
+        "kv_block": int(kv_block),
+        "rows": {
+            path: {part: _enc(arr) for part, arr in kv.items()}
+            for path, kv in rows.items()
+        },
+        "logits": _enc(np.asarray(logits, np.float32).reshape(-1)),
+        "digests": chain_digests(tokens, kv_block),
+        "rows_sha1": _rows_sha1(rows),
+    }
+    return payload
+
+
+def decode_shipment(payload: dict,
+                    expect_tokens: np.ndarray | None = None) -> Shipment:
+    """Decode + VERIFY one wire payload; raises the typed ``ShipFailed``
+    on any mismatch (version, token digests, row checksum, or — when
+    ``expect_tokens`` is given — a payload that prefilled a different
+    prompt than the request carries). The router treats ``ship_failed``
+    as re-prefill, never retry-the-same-bytes-elsewhere."""
+    if not isinstance(payload, dict):
+        raise ShipFailed("shipment payload must be an object")
+    if payload.get("version") != WIRE_VERSION:
+        raise ShipFailed(
+            f"unknown shipment version {payload.get('version')!r}"
+        )
+    try:
+        tokens = np.asarray(payload["tokens"], np.int32).reshape(-1)
+        kv_block = int(payload["kv_block"])
+        digests = tuple(payload.get("digests") or ())
+    except (KeyError, TypeError, ValueError) as exc:
+        raise ShipFailed(f"malformed shipment: {exc}") from exc
+    if kv_block < 1 or tokens.size < 1:
+        raise ShipFailed("shipment needs kv_block >= 1 and >= 1 token")
+    if expect_tokens is not None:
+        expect = np.asarray(expect_tokens, np.int32).reshape(-1)
+        if not np.array_equal(tokens, expect):
+            raise ShipFailed(
+                "shipment prefilled a different prompt than the request"
+            )
+    if tuple(chain_digests(tokens, kv_block)) != digests:
+        raise ShipFailed("chained per-block token digests do not match")
+    rows = {
+        path: {part: _dec(d) for part, d in kv.items()}
+        for path, kv in (payload.get("rows") or {}).items()
+    }
+    cap_rows = -(-int(tokens.size) // kv_block) * kv_block
+    for path, kv in rows.items():
+        for part in ("key", "value"):
+            arr = kv.get(part)
+            if arr is None or arr.ndim != 3 or arr.shape[0] != cap_rows:
+                raise ShipFailed(
+                    f"row leaf {path}:{part} has wrong geometry "
+                    f"(want [{cap_rows}, KV, Dh])"
+                )
+    if payload.get("rows_sha1") != _rows_sha1(rows):
+        raise ShipFailed("shipped K/V row checksum mismatch")
+    logits = _dec(payload["logits"]) if payload.get("logits") else None
+    if logits is None:
+        raise ShipFailed("shipment is missing the last-position logits")
+    return Shipment(tokens=tokens, kv_block=kv_block, rows=rows,
+                    logits=np.asarray(logits, np.float32).reshape(-1),
+                    digests=digests)
+
+
+# ---------------------------------------------------------------------------
+# the prefill worker (real engine-side prefill, exported as shipments)
+# ---------------------------------------------------------------------------
+
+
+class PrefillWorker:
+    """The prefill replica's brain: same cfg/params as the decode pool's
+    engines, but the ONLY device work is prompt prefill — one-shot
+    ``_prefill`` or ``ChunkedPrefill`` (``prefill_chunk``) — exported as
+    wire shipments. Single device, single worker: requests serialize on
+    an internal lock and ``queue_depth`` counts the waiters — the
+    prefill pool's autoscale signal (queue depth per ready prefill
+    replica), exactly as decode occupancy is the decode pool's.
+
+    Prefill math is THE engine's: the same ``decode=True, kv_paged=False``
+    solo model construction (engine.py's ``dcfg``), so shipped rows are
+    bitwise what the decode replica's local prefill would have written.
+    """
+
+    role = "prefill"
+
+    def __init__(self, cfg: Any, params: Any, *,
+                 prefill_chunk: int | None = None,
+                 kv_block: int = 64) -> None:
+        import functools
+
+        import jax
+
+        from tf_operator_tpu.models.transformer import (
+            Transformer,
+            _prefill,
+            _validate_prefill_chunk,
+        )
+        from dataclasses import replace
+
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        self.cfg = cfg
+        self.kv_block = int(kv_block)
+        if cfg.max_seq_len % self.kv_block:
+            raise ValueError(
+                f"max_seq_len={cfg.max_seq_len} must be a multiple of "
+                f"kv_block={self.kv_block}"
+            )
+        self.prefill_chunk = prefill_chunk
+        self._validate_chunk = _validate_prefill_chunk
+        dcfg = replace(cfg, decode=True, mesh=None, remat=False,
+                       kv_paged=False)
+        self._solo_model = Transformer(dcfg)
+        self.params = params
+        self._prefill_fn = jax.jit(
+            functools.partial(_prefill, self._solo_model)
+        )
+        self._device_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._waiting = 0
+        self._running = 0
+        self.requests_done = 0
+        self.tokens_prefilled = 0
+        self.restarts = 0
+        self.dead = False
+        # Capacity for the membership load score: one prefill at a time.
+        self.max_slots = 1
+
+    @property
+    def queue_depth(self) -> int:
+        with self._stats_lock:
+            return self._waiting
+
+    @property
+    def active_slots(self) -> int:
+        with self._stats_lock:
+            return self._running
+
+    @property
+    def tokens_generated(self) -> int:
+        # readiness_payload duck-type; a prefill replica generates no
+        # decode tokens — it prefills prompt tokens.
+        with self._stats_lock:
+            return self.tokens_prefilled
+
+    def prefill(self, tokens: np.ndarray,
+                request_id: str = "") -> dict:
+        """Run one prompt's prefill and return the wire payload.
+        Serialized on the worker's device lock; waiters count into
+        ``queue_depth`` while they queue."""
+        import jax.numpy as jnp
+
+        from tf_operator_tpu.models.transformer import ChunkedPrefill
+
+        tokens = np.asarray(tokens, np.int32).reshape(1, -1)
+        L = int(tokens.shape[1])
+        if L < 1:
+            raise ValueError("prompt must have at least one token")
+        if L > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {L} exceeds max_seq_len {self.cfg.max_seq_len}"
+            )
+        if self.prefill_chunk is not None:
+            self._validate_chunk(self.cfg, L, self.prefill_chunk)
+        with self._stats_lock:
+            self._waiting += 1
+        t0 = time.monotonic()
+        with self._device_lock:
+            with self._stats_lock:
+                self._waiting -= 1
+                self._running += 1
+            try:
+                if self.prefill_chunk is not None:
+                    pf = ChunkedPrefill(
+                        self.cfg, self.params, jnp.asarray(tokens),
+                        self.prefill_chunk,
+                    )
+                    while not pf.done:
+                        pf.feed(pf.n_chunks)
+                    cache, logits = pf.result()
+                else:
+                    cache, logits = self._prefill_fn(
+                        self.params, jnp.asarray(tokens)
+                    )
+            finally:
+                with self._stats_lock:
+                    self._running -= 1
+        payload = export_shipment(
+            cache, tokens[0], np.asarray(logits).reshape(-1),
+            self.kv_block,
+        )
+        with self._stats_lock:
+            self.requests_done += 1
+            self.tokens_prefilled += L
+        SERVE_TRACER.record(
+            "prefill.ship", t0, time.monotonic(),
+            request_id=request_id, prompt_tokens=L,
+            blocks=len(payload["digests"]),
+        )
+        return payload
+
+
+class FakePrefillBackend:
+    """Jax-free prefill brain for the fleet test tier: canned payloads
+    whose digests are REAL (chained over the request's tokens — so a
+    decode-side fake can verify routing), rows empty. Scriptable typed
+    failures + service delay + settable load, mirroring
+    FakeReplicaBackend."""
+
+    role = "prefill"
+
+    def __init__(self, *, kv_block: int = 8,
+                 service_delay_s: float = 0.0) -> None:
+        self.kv_block = kv_block
+        self.service_delay_s = service_delay_s
+        self.queue_depth = 0
+        self.requests_done = 0
+        self.tokens_prefilled = 0
+        self.restarts = 0
+        self.dead = False
+        self.max_slots = 1
+        self.ttft_p99_s: float | None = None
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._scripted: list[Exception] = []
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return min(self._inflight, self.max_slots)
+
+    @property
+    def tokens_generated(self) -> int:
+        with self._lock:
+            return self.tokens_prefilled
+
+    def fail_with(self, exc: Exception, n: int = 1) -> None:
+        with self._lock:
+            self._scripted.extend(exc for _ in range(n))
+
+    def prefill(self, tokens, request_id: str = "") -> dict:
+        with self._lock:
+            self._inflight += 1
+            scripted = self._scripted.pop(0) if self._scripted else None
+        try:
+            if scripted is not None:
+                raise scripted
+            if self.service_delay_s:
+                time.sleep(self.service_delay_s)
+            toks = np.asarray(tokens, np.int32).reshape(-1)
+            with self._lock:
+                self.requests_done += 1
+                self.tokens_prefilled += int(toks.size)
+            return {
+                "version": WIRE_VERSION,
+                "fake": True,
+                "tokens": toks.tolist(),
+                "kv_block": self.kv_block,
+                "digests": chain_digests(toks, self.kv_block),
+            }
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+
+class PrefillServer:
+    """One prefill replica endpoint: POST /prefill → the wire shipment,
+    plus /healthz (``role: "prefill"``; queue_depth is the pool's
+    autoscale signal) and /metrics, with the fleet lifecycle hooks
+    (begin_drain, kill) — the prefill-pool twin of
+    fleet/replica.ReplicaServer."""
+
+    def __init__(self, backend: Any, *, replica_id: str,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.backend = backend
+        self.replica_id = replica_id
+        self._draining = False
+        outer = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    payload = outer.health_payload()
+                    self.send_json(200, payload)
+                elif path == "/debug/traces":
+                    self.send_serve_traces()
+                elif path == "/metrics":
+                    self.send_metrics()
+                else:
+                    self.send_json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                if self.path.split("?", 1)[0] != "/prefill":
+                    self.send_json(404, {"error": "unknown path"})
+                    return
+                try:
+                    body = self.read_json_body()
+                    tokens = np.asarray(body["tokens"], np.int32)
+                    if tokens.ndim != 2 or tokens.shape[0] != 1:
+                        raise ValueError("tokens must be [1, len]")
+                except (ValueError, KeyError, TypeError) as exc:
+                    self.send_json(400, {
+                        "error": str(exc), "code": "bad_request",
+                        "retryable": False,
+                        "replica": outer.replica_id,
+                    })
+                    return
+                rid = (body.get("request_id")
+                       or self.headers.get("X-Request-Id")
+                       or mint_request_id())
+                if outer._draining:
+                    exc = Draining("prefill replica draining")
+                    payload = error_payload(exc)
+                    payload["replica"] = outer.replica_id
+                    payload["request_id"] = rid
+                    self.send_json(exc.http_status, payload)
+                    return
+                try:
+                    shipped = outer.backend.prefill(tokens[0],
+                                                    request_id=rid)
+                except Exception as exc:  # noqa: BLE001 — typed out,
+                    # like every serving failure (ServeError renders
+                    # itself; the rest become internal 500s).
+                    payload = error_payload(exc)
+                    payload["replica"] = outer.replica_id
+                    payload["request_id"] = rid
+                    self.send_json(http_status_of(exc), payload)
+                    return
+                self.send_json(200, {
+                    "shipped_kv": shipped,
+                    "replica": outer.replica_id,
+                    "request_id": rid,
+                })
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def health_payload(self) -> dict:
+        b = self.backend
+        payload: dict[str, Any] = {
+            "ok": not getattr(b, "dead", False),
+            "role": "prefill",
+            "replica": self.replica_id,
+            "active_slots": getattr(b, "active_slots", 0),
+            "queue_depth": getattr(b, "queue_depth", 0),
+            "max_slots": getattr(b, "max_slots", 1),
+            "requests_done": getattr(b, "requests_done", 0),
+            "tokens_generated": getattr(b, "tokens_generated", 0),
+            "watchdog_restarts": getattr(b, "restarts", 0),
+        }
+        if self._draining:
+            payload["draining"] = True
+        if getattr(b, "dead", False):
+            payload["dead"] = True
+        return payload
+
+    def start(self) -> "PrefillServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"prefill-{self.replica_id}",
+        )
+        self._thread.start()
+        LOG.info(
+            f"prefill replica {self.replica_id} listening on "
+            f"{self.endpoint}"
+        )
+        return self
+
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    def kill(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def stop(self) -> None:
+        self.kill()

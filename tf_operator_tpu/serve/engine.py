@@ -88,6 +88,7 @@ from __future__ import annotations
 
 import functools
 import time
+from collections.abc import Mapping
 from dataclasses import dataclass, replace
 from typing import Any
 
@@ -112,10 +113,12 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_MESH_DEVICES,
     SERVE_PHASE_SECONDS,
     SERVE_PREFILL_SAVED_TOTAL,
+    SERVE_SHIP_TOKENS_TOTAL,
 )
 from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
 from tf_operator_tpu.serve.kvcache import (
+    POOL_KEYS,
     BlockAllocator,
     PrefixCache,
     SlotAllocator,
@@ -123,6 +126,7 @@ from tf_operator_tpu.serve.kvcache import (
     make_gather_fn,
     make_insert_fn,
     make_paged_insert_fn,
+    make_pool_write_fn,
     make_table_insert_fn,
     mask_inactive_indices,
     paged_cache_template,
@@ -137,6 +141,21 @@ from tf_operator_tpu.serve.sharding import (
     mesh_debug,
     tp_size_of,
 )
+
+
+def _ship_row_paths(tree: Any, prefix: tuple = ()):
+    """Yield (parent_path, leaf_name, leaf) for the paged pool leaves —
+    "/"-joined module paths, the same keys serve/disagg.py's wire rows
+    carry (the solo dense cache and the paged cache share module
+    structure, so the prefill side's ``cached_*`` paths line up with
+    the pool's ``pool_*`` paths)."""
+    if not isinstance(tree, Mapping):
+        return
+    for name, leaf in tree.items():
+        if name in POOL_KEYS:
+            yield "/".join(prefix), name, leaf
+        elif isinstance(leaf, Mapping):
+            yield from _ship_row_paths(leaf, prefix + (name,))
 
 
 def _sample_token(logits1, key1, temp, tp, has_tp):
@@ -182,6 +201,23 @@ class AdmissionPlan:
     def prefill_tokens(self) -> int:
         """Prompt tokens this admission still has to prefill."""
         return self.prompt_len - self.shared_tokens
+
+
+@dataclass
+class ShipHold:
+    """The ingest-time hold on a shipment's freshly-written blocks: the
+    ingest allocates them at refcount 1 and registers the prompt in the
+    PrefixCache, and THIS object keeps them (and with them the
+    registration) alive until the shipped request's own admission plan
+    has bumped its shared refs — then ``release_shipment`` drops the
+    hold and the blocks live exactly as long as the request, like any
+    local prefix donor's. Empty ``blocks`` = the prompt was already
+    registered live (a duplicate in flight) and the ingest wrote
+    nothing."""
+
+    blocks: tuple = ()
+    tokens: int = 0
+    settled: bool = False
 
 
 class ContinuousEngine:
@@ -274,6 +310,7 @@ class ContinuousEngine:
                                                mesh=self.mesh,
                                                tp_axis=self.tp_axis)
             constraint = self._make_constraint()
+            self._constraint = constraint
             self._paged_insert = make_paged_insert_fn(
                 self.kv_blocks, self.kv_block, constraint=constraint
             )
@@ -282,6 +319,11 @@ class ContinuousEngine:
             )
             self._gather = make_gather_fn(self.kv_block)
             self._cow_fn = make_cow_fn(constraint=constraint)
+            # Disaggregated-prefill ingest (serve/disagg.py): shipped
+            # block-pool rows scatter into freshly-allocated blocks;
+            # built lazily on first ingest — pure-local engines never
+            # pay the trace.
+            self._pool_write = None
             self._extend_fn = jax.jit(
                 functools.partial(_prefill_extend, self._solo_model)
             )
@@ -290,6 +332,8 @@ class ContinuousEngine:
             self._slot_state: dict[int, dict] = {}
             self.cow_copies = 0
             self.prefill_tokens_saved = 0
+            self.shipments_ingested = 0
+            self.ship_tokens_ingested = 0
             self._set_block_gauges()
         else:
             self.table_len = None
@@ -504,6 +548,139 @@ class ContinuousEngine:
         freed = self.blocks.free(
             list(plan.private_blocks) + list(plan.shared_blocks)
         )
+        if freed:
+            self.prefix.invalidate_blocks(freed)
+        self._set_block_gauges()
+
+    # -- shipped-KV ingest (disaggregated prefill) ------------------------
+
+    def ingest_shipment(self, shp: Any,
+                        reserve_steps: int = 0) -> ShipHold | None:
+        """Land one verified shipment (serve/disagg.Shipment) in the
+        block pool: allocate ``ceil(L/B)`` blocks, scatter the shipped
+        rows through ONE fixed-shape executable, and register the
+        prompt (blocks + shipped last-position logits) in the
+        PrefixCache — after which the request's own ``plan_admission``
+        finds an EXACT prefix match and joins via the table-insert
+        path, bit-identical to a local exact-prefix hit. Returns None
+        on block exhaustion (the caller requeues, like a plan miss) or
+        on a dense engine (shipping is meaningless there — the caller
+        drops the shipment and prefills locally). Raises ValueError on
+        geometry mismatch (wrong kv_block / row shapes): the caller
+        falls back to local prefill.
+
+        ``reserve_steps`` is the request's decode horizon: the ingest
+        refuses (None → the caller requeues) while the pool cannot hold
+        prompt + steps, because a shipment the admission plan can't use
+        yet would be scattered, released, and re-scattered once per
+        loop iteration until capacity frees — the exact device churn
+        disaggregation exists to remove.
+
+        The decode step is untouched: ingest adds ONE new executable
+        (the pool write), compiled outside the decode-step cache, so
+        ``compiles == warmup_compiles`` holds through any number of
+        ingests (pinned in tests/test_serve_disagg.py)."""
+        if not self.kv_paged:
+            return None
+        if int(shp.kv_block) != self.kv_block:
+            raise ValueError(
+                f"shipment kv_block={shp.kv_block} != engine "
+                f"kv_block={self.kv_block}"
+            )
+        tokens = np.asarray(shp.tokens, np.int32).reshape(-1)
+        L = int(tokens.shape[0])
+        B = self.kv_block
+        cap = -(-L // B)
+        if cap > self.kv_blocks - 1:
+            raise ValueError(
+                f"shipment of {L} tokens needs {cap} blocks; the pool "
+                f"has only {self.kv_blocks - 1} allocatable"
+            )
+        n, _, logits = self.prefix.lookup(tokens)
+        if n == L and logits is not None:
+            # Already registered live (a duplicate prompt in flight):
+            # nothing to write — admission will exact-hit the existing
+            # entry. An empty hold keeps release idempotent.
+            return ShipHold((), L, settled=True)
+        # The whole-request budget, not just the shipment's: the plan
+        # that follows also needs the decode-horizon blocks (and the
+        # CoW destination when the prompt ends mid-block).
+        need = -(-(L + int(reserve_steps)) // B)
+        if L % B:
+            need += 1
+        if self.blocks.free_blocks < need:
+            return None  # pool exhaustion: the caller requeues
+        blocks = self.blocks.alloc(cap)
+        if blocks is None:
+            return None  # pool exhaustion: the caller requeues
+        try:
+            rows = self._padded_ship_rows(shp, cap * B)
+            if self._pool_write is None:
+                self._pool_write = make_pool_write_fn(
+                    self.kv_blocks, self.kv_block,
+                    constraint=self._constraint,
+                )
+            table = np.zeros(self.table_len, np.int32)
+            table[:cap] = blocks
+            self._cache = self._pool_write(
+                self._cache, jnp.asarray(table), rows
+            )
+        except Exception:
+            freed = self.blocks.free(blocks)
+            if freed:
+                self.prefix.invalidate_blocks(freed)
+            self._set_block_gauges()
+            raise
+        self.prefix.register(
+            tokens, blocks, np.asarray(shp.logits, np.float32)
+        )
+        self.shipments_ingested += 1
+        self.ship_tokens_ingested += L
+        SERVE_SHIP_TOKENS_TOTAL.inc(L)
+        self._set_block_gauges()
+        return ShipHold(tuple(blocks), L)
+
+    def _padded_ship_rows(self, shp: Any, cap_rows: int) -> dict:
+        """Shipped rows padded to the full [max_seq_len, KV, Dh] shape
+        (one executable serves every shipment; pad rows scatter into
+        the pinned garbage block), shape-checked against the pool."""
+        S = self.cfg.max_seq_len
+        kv, dh = self.cfg.kv_heads, self.cfg.head_dim
+        out: dict[str, dict[str, np.ndarray]] = {}
+        for path, parts in shp.rows.items():
+            out[path] = {}
+            for name in ("key", "value"):
+                arr = np.asarray(parts[name])
+                if arr.shape != (cap_rows, kv, dh):
+                    raise ValueError(
+                        f"shipped rows {path}:{name} shape {arr.shape} "
+                        f"!= ({cap_rows}, {kv}, {dh})"
+                    )
+                padded = np.zeros((S, kv, dh), arr.dtype)
+                padded[:cap_rows] = arr
+                out[path][name] = padded
+        # Every attention layer must be covered: a partial shipment
+        # would decode garbage for the missing layers.
+        want = {
+            path for path, _, _ in _ship_row_paths(self._cache)
+        }
+        if set(out) != want:
+            raise ValueError(
+                f"shipment covers layers {sorted(out)} but the engine "
+                f"has {sorted(want)}"
+            )
+        return out
+
+    def release_shipment(self, hold: ShipHold | None) -> None:
+        """Drop the ingest-time hold (idempotent): after the shipped
+        request's plan has bumped its shared refs, or on any error path
+        before that. Blocks whose refcount hits zero return to the pool
+        and invalidate their prefix entries — exactly the retire
+        bookkeeping."""
+        if hold is None or hold.settled or not self.kv_paged:
+            return
+        hold.settled = True
+        freed = self.blocks.free(list(hold.blocks))
         if freed:
             self.prefix.invalidate_blocks(freed)
         self._set_block_gauges()
@@ -894,6 +1071,10 @@ class ContinuousEngine:
             "prefix_entries": self.prefix.entries,
             "prefix_hits": self.prefix.hits,
             "prefill_tokens_saved": self.prefill_tokens_saved,
+            # Disaggregated prefill: shipments landed + prompt tokens
+            # whose K/V arrived as wire rows instead of local prefill.
+            "shipments_ingested": self.shipments_ingested,
+            "ship_tokens_ingested": self.ship_tokens_ingested,
         }
 
     @property

@@ -86,43 +86,69 @@ class QuietHandler(BaseHTTPRequestHandler):
         ) or b"{}"
         return json.loads(raw)
 
-# /healthz TTFT window: the metrics registry is process-global, so a
+# /healthz latency windows: the metrics registry is process-global, so a
 # lifetime quantile would latch a cold-start compile burst into the
-# reported p99 ~forever — and the fleet autoscaler's latency trigger
-# (which requires `not ttft_high` before scaling down) would pin the
+# reported p99 ~forever — and the fleet autoscaler's latency triggers
+# (which require the trigger quiet before scaling down) would pin the
 # fleet at max. Rotating two snapshots bounds the read to roughly the
-# last 1-2 windows.
+# last 1-2 windows. One instance per histogram the probe payload
+# reports: TTFT (PR 9) and ITL (the decode pool's disaggregation-era
+# scale signal).
 _TTFT_WINDOW_S = 120.0
-_ttft_lock = threading.Lock()
-_ttft_prev: list[int] | None = None  # baseline: start of previous window
-_ttft_cur: tuple[list[int], float] | None = None
+
+
+class _QuantileWindow:
+    """p99 of a registry histogram over the trailing 1-2 windows, not
+    process lifetime. Clamped to the histogram's top bucket bound: when
+    the p99 lands in the +Inf overflow bucket the true value is unknown
+    but AT LEAST the top bound — reporting that keeps the autoscaler's
+    latency trigger live during the worst episodes instead of going
+    silent (a dropped reading leaves membership holding a stale
+    pre-overload p99, which can even permit scale-down mid-incident)."""
+
+    def __init__(self, hist_name: str,
+                 window_s: float = _TTFT_WINDOW_S) -> None:
+        self._hist_name = hist_name
+        self.window_s = window_s
+        self._lock = threading.Lock()
+        self._prev: list[int] | None = None
+        self._cur: tuple[list[int], float] | None = None
+
+    def _hist(self):
+        from tf_operator_tpu.runtime import metrics
+
+        return getattr(metrics, self._hist_name)
+
+    def p99(self) -> float:
+        hist = self._hist()
+        now = time.monotonic()
+        with self._lock:
+            if self._cur is None or now - self._cur[1] >= self.window_s:
+                self._prev = self._cur[0] if self._cur else None
+                self._cur = (hist.snapshot(), now)
+            since = self._prev
+        return min(hist.quantile(0.99, since=since), hist.buckets[-1])
+
+
+_TTFT_WINDOW = _QuantileWindow("SERVE_TTFT_SECONDS")
+_ITL_WINDOW = _QuantileWindow("SERVE_ITL_SECONDS")
 
 
 def windowed_ttft_p99() -> float:
-    """p99 TTFT over the trailing 1-2 windows (not process lifetime).
+    """p99 TTFT over the trailing 1-2 windows (see _QuantileWindow)."""
+    return _TTFT_WINDOW.p99()
 
-    Clamped to the histogram's top bucket bound: when the p99 lands in
-    the +Inf overflow bucket the true value is unknown but AT LEAST the
-    top bound — reporting that keeps the autoscaler's latency trigger
-    live during the worst episodes instead of going silent (a dropped
-    reading leaves membership holding a stale pre-overload p99, which
-    can even permit scale-down mid-incident)."""
-    from tf_operator_tpu.runtime.metrics import SERVE_TTFT_SECONDS
 
-    global _ttft_prev, _ttft_cur
-    now = time.monotonic()
-    with _ttft_lock:
-        if _ttft_cur is None or now - _ttft_cur[1] >= _TTFT_WINDOW_S:
-            _ttft_prev = _ttft_cur[0] if _ttft_cur else None
-            _ttft_cur = (SERVE_TTFT_SECONDS.snapshot(), now)
-        since = _ttft_prev
-    p99 = SERVE_TTFT_SECONDS.quantile(0.99, since=since)
-    return min(p99, SERVE_TTFT_SECONDS.buckets[-1])
+def windowed_itl_p99() -> float:
+    """p99 inter-token latency over the trailing 1-2 windows — the
+    decode pool's autoscale latency signal (prefill interference and
+    overload both show up here first for streaming clients)."""
+    return _ITL_WINDOW.p99()
 
 
 def readiness_payload(sched: Any, *, draining: bool = False,
-                      replica: str = "",
-                      max_slots: int | None = None) -> dict[str, Any]:
+                      replica: str = "", max_slots: int | None = None,
+                      role: str = "") -> dict[str, Any]:
     """The /healthz shape fleet/membership.py routes from — liveness and
     readiness split explicitly:
 
@@ -145,6 +171,10 @@ def readiness_payload(sched: Any, *, draining: bool = False,
     payload: dict[str, Any] = {"ok": True}
     if replica:
         payload["replica"] = replica
+    if role:
+        # Disaggregated fleets route by pool: "prefill" replicas take
+        # only /prefill work, "decode" (or unset) the /generate path.
+        payload["role"] = role
     if draining:
         payload["draining"] = True
     if sched is None:
@@ -165,6 +195,11 @@ def readiness_payload(sched: Any, *, draining: bool = False,
     ttft_p99 = windowed_ttft_p99()
     if ttft_p99:
         payload["ttft_p99_s"] = round(ttft_p99, 4)
+    itl_p99 = windowed_itl_p99()
+    if itl_p99:
+        # The decode pool's autoscale latency signal (absent while the
+        # window is idle, same clear-on-idle contract as TTFT).
+        payload["itl_p99_s"] = round(itl_p99, 4)
     if getattr(sched, "dead", False):
         payload["ok"] = False
         payload["dead"] = True

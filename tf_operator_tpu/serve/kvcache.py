@@ -252,6 +252,53 @@ def make_table_insert_fn(constraint=None):
     return jax.jit(insert, donate_argnums=(0,))
 
 
+def make_pool_write_fn(num_blocks: int, block: int, constraint=None):
+    """Jitted (paged, write_table, rows) → paged with SHIPPED K/V rows
+    scattered into pool blocks through ``write_table`` — the
+    disaggregated-prefill ingest (serve/disagg.py): a prefill replica's
+    finished rows land in freshly-allocated blocks WITHOUT touching any
+    slot's table or counters (the request that owns them joins later
+    through the ordinary exact-prefix table-insert path, which is what
+    makes shipped decode bit-identical to local).
+
+    ``rows`` maps each attention layer's cache path ("/"-joined module
+    names) to ``{"key": [S, KV, Dh], "value": [S, KV, Dh]}`` — padded to
+    the full ``max_seq_len`` row count so ONE executable serves every
+    shipment; entries of ``write_table`` beyond the shipment's blocks
+    are 0 and dump the pad rows into the pinned garbage block, exactly
+    the ``make_paged_insert_fn`` trick. The paged tree is donated;
+    ``constraint`` pins mesh layouts."""
+
+    def write(paged, write_table, rows):
+        def walk(p, path):
+            if not isinstance(p, Mapping):
+                return p
+            out = {}
+            for name, leaf in p.items():
+                if name in POOL_KEYS:
+                    r = rows["/".join(path)][
+                        "key" if name == "pool_key" else "value"
+                    ]  # [S, KV, Dh]
+                    pos = jnp.arange(r.shape[0])
+                    flat = write_table[pos // block] * block + pos % block
+                    flat_pool = leaf.reshape(
+                        (num_blocks * block,) + leaf.shape[2:]
+                    )
+                    out[name] = flat_pool.at[flat].set(r).reshape(
+                        leaf.shape
+                    )
+                elif isinstance(leaf, Mapping):
+                    out[name] = walk(leaf, path + (name,))
+                else:
+                    out[name] = leaf
+            return out
+
+        out = walk(paged, ())
+        return constraint(out) if constraint is not None else out
+
+    return jax.jit(write, donate_argnums=(0,))
+
+
 def make_gather_fn(block: int):
     """Jitted (paged, table) → a SOLO dense cache whose K/V rows are the
     table's blocks in order (counters zero): the seed for a shared-prefix

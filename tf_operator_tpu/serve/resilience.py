@@ -180,6 +180,20 @@ class ReplicaDead(ServeError):
     retryable = True
 
 
+class ShipFailed(ServeError):
+    """A decode replica rejected a shipped-KV payload (chained per-block
+    digest mismatch, token mismatch, wrong geometry). Retryable — but
+    NOT on another decode replica with the same payload: the
+    disaggregation router re-runs the PREFILL stage (or strips the
+    shipment and lets the decode pool prefill locally), which is why
+    this code is deliberately absent from the router's RETRY_ELSEWHERE
+    set."""
+
+    code = "ship_failed"
+    http_status = 503
+    retryable = True
+
+
 # The COMPLETE wire-code vocabulary: every ``code`` a client or the
 # fleet router can see. ServeError subclasses above carry the
 # engine-side codes; these are the transport/front-door codes minted as
@@ -194,6 +208,11 @@ WIRE_CODES = frozenset((
     "timeout",             # replica-side transport timeout (router retries)
     "replica_unreachable",  # router could not reach the replica at all
     "no_replica",          # router found nothing routable (503 + backoff)
+    # Disaggregated prefill/decode (serve/disagg.py, fleet/router.py):
+    "prefill_pool_empty",  # two-stage dispatch found no routable prefill
+                           # replica; the decode pool prefills locally
+                           # (informational on the response, not a
+                           # failure — the request still serves)
 ))
 
 

@@ -90,6 +90,7 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_QUEUE_DEPTH,
     SERVE_REQUESTS_TOTAL,
     SERVE_SHED_TOTAL,
+    SERVE_SHIP_INGEST_TOTAL,
     SERVE_SLOTS_ACTIVE,
     SERVE_SLOT_CAPACITY,
     SERVE_STEP_SECONDS,
@@ -136,7 +137,8 @@ class ServeRequest:
                  temperature: float = 0.0, top_p: float | None = None,
                  seed: int = 0, eos_id: int | None = None,
                  deadline_s: float | None = None,
-                 request_id: str | None = None) -> None:
+                 request_id: str | None = None,
+                 shipment: Any = None) -> None:
         self.tokens = np.asarray(tokens, np.int32)
         if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
             raise ValueError("tokens must be [1, len] (one request row)")
@@ -186,6 +188,14 @@ class ServeRequest:
         self.queue_wait_s = 0.0
         self.prefill_s = 0.0
         self.decode_s = 0.0
+        # Disaggregated prefill (serve/disagg.py): a verified Shipment
+        # whose block-pool rows the loop ingests right before this
+        # request's admission plan — the plan then exact-hits the
+        # registered prefix and joins via table-insert, skipping local
+        # prefill. None = the ordinary local-prefill path. Survives
+        # watchdog replays: a rebuilt engine re-ingests the same bytes.
+        self.shipment = shipment
+        self.shipped_join = False
 
     @property
     def ttft(self) -> float | None:
@@ -224,6 +234,10 @@ class ServeRequest:
             out["itl_ms"] = [round(g * 1e3, 2) for g in gaps]
         if self.replays:
             out["replays"] = self.replays
+        if self.shipped_join:
+            # The prompt's KV arrived as shipped block-pool rows from a
+            # prefill replica — this request never prefilled locally.
+            out["shipped_kv"] = True
         return out
 
     def _finish(self, outcome: str, error: Exception | None = None) -> None:
@@ -391,6 +405,10 @@ class ContinuousScheduler:
                 req.token_times.clear()
                 req.num_steps = req.requested_steps
                 req.degraded = False
+                # A retained shipment re-ingests into the REBUILT
+                # engine (same bytes, fresh pool); the flag re-earns
+                # itself there.
+                req.shipped_join = False
                 req.replays += 1
                 req.enqueued_at = now
                 req.ttl_deadline = (
@@ -701,6 +719,22 @@ class ContinuousScheduler:
                 if req is None:
                     return
                 self._degrade_check(req)
+                # Disaggregated prefill: land the request's shipped KV
+                # rows in the pool FIRST, so the plan below exact-hits
+                # the registered prefix (table-insert join, no local
+                # prefill). Block exhaustion requeues exactly like a
+                # plan miss; a bad payload falls back to local prefill
+                # — every path still serves the request.
+                ship_hold = None
+                if req.shipment is not None:
+                    verdict, ship_hold = self._ingest_shipment(req)
+                    if verdict == "requeue":
+                        if not self._settle_admitting(requeue_front=True):
+                            return
+                        # lint: ok guarded-attr — loop-thread-owned re-check; _settle_admitting just validated the fence
+                        if not (self._slots or self._prefilling):
+                            time.sleep(0.001)
+                        return
                 t_plan = time.monotonic()
                 try:
                     plan = self.engine.plan_admission(
@@ -710,12 +744,20 @@ class ContinuousScheduler:
                     # request answers its own client, never the loop —
                     # unless a fence harvested it mid-plan (the
                     # supervisor will replay it instead).
+                    if ship_hold is not None:
+                        self.engine.release_shipment(ship_hold)
                     if self._settle_admitting():
                         self._note_dequeued(req, t_plan)
                         req._finish("error", exc)
                     else:
                         return
                     continue
+                # The plan (if any) has bumped its own refs on the
+                # shipped blocks; the ingest hold can go either way —
+                # on a plan miss the entry dies with the hold and the
+                # requeued request re-ingests next attempt.
+                if ship_hold is not None:
+                    self.engine.release_shipment(ship_hold)
                 if plan is None:
                     # No free slot — or (paged) not enough free KV
                     # blocks for prompt + max_tokens: queue until a
@@ -854,6 +896,57 @@ class ContinuousScheduler:
                     # step()) attribute to the request through the tag;
                     # hasattr-guarded for the chaos tests' fake engines.
                     self.engine.tag_slot(slot, req.request_id)
+
+    def _ingest_shipment(self, req: ServeRequest):
+        """Land one request's shipped KV ahead of its admission plan.
+        Returns (verdict, hold): ``("ok", hold)`` — rows written +
+        prefix registered (the caller releases the hold once the plan
+        has its refs); ``("requeue", None)`` — block exhaustion, treat
+        like a plan miss; ``("none", None)`` — no ingest happened (fake
+        or dense engine, or a malformed payload: ``req.shipment`` is
+        cleared and local prefill takes over)."""
+        if not hasattr(self.engine, "ingest_shipment"):
+            req.shipment = None
+            return "none", None
+        alloc = getattr(self.engine, "alloc", None)
+        if alloc is not None and alloc.free == 0:
+            # No free slot: the plan below would requeue anyway — do it
+            # WITHOUT paying the device scatter, which would otherwise
+            # repeat (ingest → plan miss → release) once per loop
+            # iteration until a retire frees a slot.
+            return "requeue", None
+        t0 = time.monotonic()
+        try:
+            with self._device():
+                hold = self.engine.ingest_shipment(
+                    req.shipment, reserve_steps=req.num_steps
+                )
+        except Exception:  # noqa: BLE001 — a bad shipment must not
+            # fail the request (the prompt is right here): fall back to
+            # the ordinary local prefill.
+            req.shipment = None
+            SERVE_SHIP_INGEST_TOTAL.inc(outcome="failed")
+            return "none", None
+        if hold is None:
+            if getattr(self.engine, "kv_paged", False):
+                # Not enough free blocks for the shipment: queue until
+                # a retire frees capacity (block-exhaustion queueing),
+                # keeping the payload for the next attempt.
+                SERVE_SHIP_INGEST_TOTAL.inc(outcome="exhausted")
+                return "requeue", None
+            req.shipment = None  # dense engine: shipping is a no-op
+            SERVE_SHIP_INGEST_TOTAL.inc(outcome="unsupported")
+            return "none", None
+        self._beat()  # the ingest returned — progress, not a stall
+        now = time.monotonic()
+        SERVE_TRACER.record(
+            "kv.ship", t0, now, request_id=req.request_id,
+            prompt_tokens=hold.tokens, blocks=len(hold.blocks),
+        )
+        SERVE_PHASE_SECONDS.inc(now - t0, phase="ship")
+        SERVE_SHIP_INGEST_TOTAL.inc(outcome="ok")
+        req.shipped_join = True
+        return "ok", hold
 
     def _note_prefill(self, req: ServeRequest, mono0: float, *,
                       joined: bool, plan: Any = None) -> None:

@@ -22,6 +22,21 @@ optimization, never a correctness requirement. Specs are pure data
 unit-testable jax-free; ``shard_engine_state`` is the one function that
 places arrays.
 
+Two disaggregation-era extensions, both still pure data:
+
+- ``dp`` — a batch-parallel mesh axis over SLOTS (the PR 10 follow-on):
+  per-slot leaves (stacked dense K/V rows, block tables, counters,
+  logits rows) shard their leading slot axis over ``dp`` while the
+  paged pool replicates (it is shared across slots — any table may
+  point at any block). ``leaf_spec``/``cache_specs``/``logits_spec``
+  take optional ``dp_size``/``dp_axis`` with defaults that keep the
+  tp-only layout bit-for-bit. Full tp×dp engine bit-identity is the
+  declared stretch — the spec layer here is what it will pin against.
+- ``ship_specs`` — the shard layout of SHIPPED KV wire rows
+  (serve/disagg.py): each ``[R, KV, Dh]`` wire leaf head-shards like
+  the pool leaf its rows land in, so a tp>1 decode replica places the
+  payload once and the ingest scatter stays shard-local per chip.
+
 Params are NOT this module's concern: tensor-parallel decode reuses the
 training-side ``param_sharding_rules`` from models/transformer.py
 (already proven for tp-sharded solo decode) via
@@ -44,14 +59,33 @@ from jax.sharding import PartitionSpec as P
 # Leaf name -> index of the KV-head dimension, counted FROM THE END
 # (shape-suffix addressing survives the optional leading slot axis: the
 # solo dense cache is [1, S, KV, Dh], the stacked one [slots, 1, S, KV,
-# Dh] — KV is -2 in both).
+# Dh] — KV is -2 in both; it also covers the shipped wire rows
+# [R, KV, Dh] of the disaggregated prefill path, see ``ship_specs``).
 _HEAD_AXIS_FROM_END = {
-    "pool_key": 2,      # [nb, blk, KV, Dh]
+    "pool_key": 2,      # [nb, blk, KV, Dh]  /  wire rows [R, KV, Dh]
     "pool_value": 2,
     "cached_key": 2,    # [(slots,) 1, S, KV, Dh]
     "cached_value": 2,
     "key_scale": 1,     # [(slots,) 1, S, KV]  (kv-int8 sidecars)
     "value_scale": 1,
+}
+
+# Leaf name -> minimum rank at which dimension 0 is the SLOT axis, for
+# the ``dp`` (batch-parallel-decode) mesh axis: the slot-stacked dense
+# leaves grow one leading dim over their solo shapes, and the per-slot
+# bookkeeping vectors are slot-first by construction. Pool leaves are
+# absent on purpose — the paged pool is SHARED across slots (any slot's
+# table may point at any block), so it can never shard over dp; a
+# dp-sharded paged engine replicates the pool and shards only the
+# per-slot state.
+_SLOT_LEADING_MIN_RANK = {
+    "cached_key": 5,    # [slots, 1, S, KV, Dh] (solo = 4)
+    "cached_value": 5,
+    "key_scale": 4,     # [slots, 1, S, KV]     (solo = 3)
+    "value_scale": 4,
+    "block_table": 2,   # [slots, table_len]
+    "cache_index": 1,   # [slots]               (solo = scalar)
+    "pos_index": 1,
 }
 
 
@@ -61,28 +95,43 @@ def _tiles(shape: tuple, dim: int, size: int) -> bool:
 
 
 def leaf_spec(name: str, shape: tuple, tp_size: int,
-              tp_axis: str = "tp") -> P:
-    """PartitionSpec for ONE cache leaf by name + shape: head-sharded
-    for the K/V storage leaves (when ``KV % tp == 0``), replicated for
-    everything else (tables, counters). Pure data — no mesh, no device."""
-    from_end = _HEAD_AXIS_FROM_END.get(name)
-    if from_end is None or tp_size <= 1:
-        return P()
-    dim = len(shape) - from_end
-    if not _tiles(tuple(shape), dim, tp_size):
-        return P()  # can't tile: replicate this leaf (never crash)
+              tp_axis: str = "tp", dp_size: int = 1,
+              dp_axis: str = "dp") -> P:
+    """PartitionSpec for ONE cache leaf by name + shape. ``tp``:
+    head-sharded for the K/V storage leaves (when ``KV % tp == 0``).
+    ``dp`` (batch-parallel decode over slots — the PR 10 follow-on):
+    slot-axis-sharded for every per-slot leaf whose leading dim tiles —
+    slot-stacked dense K/V rows, block tables, counters — while the
+    shared paged pool replicates over dp (any slot's table may point at
+    any block). Defaults keep the PR 10 tp-only behavior exactly. Pure
+    data — no mesh, no device."""
+    shape = tuple(shape)
     spec = [None] * len(shape)
-    spec[dim] = tp_axis
+    from_end = _HEAD_AXIS_FROM_END.get(name)
+    if from_end is not None and tp_size > 1:
+        dim = len(shape) - from_end
+        if _tiles(shape, dim, tp_size):
+            spec[dim] = tp_axis
+    min_rank = _SLOT_LEADING_MIN_RANK.get(name)
+    if (dp_size > 1 and min_rank is not None
+            and len(shape) >= min_rank and _tiles(shape, 0, dp_size)):
+        spec[0] = dp_axis
+    if not any(spec):
+        return P()  # can't tile anything: replicate (never crash)
     return P(*spec)
 
 
-def cache_specs(tree: Any, tp_size: int, tp_axis: str = "tp") -> Any:
+def cache_specs(tree: Any, tp_size: int, tp_axis: str = "tp",
+                dp_size: int = 1, dp_axis: str = "dp") -> Any:
     """PartitionSpec pytree matching a cache tree (dense-stacked, paged,
-    or solo): K/V leaves head-sharded, the rest replicated."""
+    or solo): K/V leaves head-sharded over tp, per-slot leaves
+    slot-sharded over dp (when requested and tileable), the rest
+    replicated."""
     def walk(node):
         if isinstance(node, Mapping):
             return {
-                k: (leaf_spec(k, tuple(v.shape), tp_size, tp_axis)
+                k: (leaf_spec(k, tuple(v.shape), tp_size, tp_axis,
+                              dp_size, dp_axis)
                     if not isinstance(v, Mapping) else walk(v))
                 for k, v in node.items()
             }
@@ -91,15 +140,44 @@ def cache_specs(tree: Any, tp_size: int, tp_axis: str = "tp") -> Any:
     return walk(tree)
 
 
-def logits_spec(shape: tuple, tp_size: int, tp_axis: str = "tp") -> P:
+def logits_spec(shape: tuple, tp_size: int, tp_axis: str = "tp",
+                dp_size: int = 1, dp_axis: str = "dp") -> P:
     """[slots, vocab] sampling-logits spec: vocab-sharded to match the
-    vocab-split lm_head (the shards are consumed where they land), else
-    replicated when vocab doesn't tile."""
-    if tp_size > 1 and _tiles(tuple(shape), len(shape) - 1, tp_size):
-        spec = [None] * len(shape)
+    vocab-split lm_head (the shards are consumed where they land),
+    slot-sharded over dp when slots tile — each dp group samples its
+    own slots; components that can't tile drop to None."""
+    shape = tuple(shape)
+    spec = [None] * len(shape)
+    if tp_size > 1 and _tiles(shape, len(shape) - 1, tp_size):
         spec[-1] = tp_axis
-        return P(*spec)
-    return P()
+    if dp_size > 1 and len(shape) >= 2 and _tiles(shape, 0, dp_size):
+        spec[0] = dp_axis
+    if not any(spec):
+        return P()
+    return P(*spec)
+
+
+def ship_specs(rows: Any, tp_size: int, tp_axis: str = "tp") -> dict:
+    """Per-leaf placement of a SHIPPED-KV payload's wire rows
+    (serve/disagg.Shipment.rows: path -> {"key"/"value": [R, KV, Dh]})
+    — the shard layout the disaggregated path composes with tp>1: each
+    wire leaf is head-sharded exactly like the pool leaf its rows land
+    in (suffix addressing finds KV at -2), so a tp decode replica can
+    place the incoming rows once and the ingest scatter stays
+    shard-local per chip. ``rows`` leaves may be arrays or bare
+    shapes. Pure data."""
+    out: dict = {}
+    for path, parts in rows.items():
+        out[path] = {}
+        for part, leaf in parts.items():
+            shape = tuple(getattr(leaf, "shape", leaf))
+            out[path][part] = leaf_spec(
+                "pool_key" if part == "key" else "pool_value",
+                # Wire rows [R, KV, Dh] vs pool [nb, blk, KV, Dh]: the
+                # from-the-end addressing makes the same entry work.
+                shape, tp_size, tp_axis,
+            )
+    return out
 
 
 def tp_size_of(mesh: Mesh | None, tp_axis: str = "tp") -> int:

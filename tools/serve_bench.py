@@ -56,6 +56,23 @@ ok / partial-with-flag / typed error, and ``ttft_p99_ms`` stays under
 the deadline budget (``deadline_budget_ms``) — capacity-style
 assertions enforced by the deadline machinery, not wall-clock luck.
 
+The DISAGG pair (``--engine disagg``) is the ROADMAP item-2
+interference mix: long prefills landing in a stream of
+latency-sensitive short decodes, served once by the time-shared
+supervised engine (chunked prefill budget-interleaved with decode —
+the PR 5 mitigation at its best) and once DISAGGREGATED — the same
+decode engine with every long prompt prefilled on a 2-replica prefill
+pool and shipped as block-pool rows through the two-stage router, one
+prefill replica KILLED mid-run. Both legs ride identical HTTP
+plumbing and report engine-observed TTFT/ITL, so the delta is the
+prefill PLACEMENT. The disagg line pins lost == 0 and
+shipped_joins == the long-prompt count; its ``ttft_p99_vs_baseline``
+/ ``itl_p99_vs_baseline`` ratios are the acceptance numbers — on
+hosts where the prefill pool is real extra hardware (``host_cpus``
+rides the line; CI's 1-core box shares one execution unit across all
+"replicas", so its ratios invert and the line is a mechanism proof,
+the tp pair's CPU story exactly).
+
 The TP pair (``--tp N``) replays the same schedule through the
 continuous engine on an N-device ``tp`` mesh (SPMD decode: params
 tp-sharded, KV storage head-sharded, one compiled step driving the
@@ -106,6 +123,30 @@ CAPACITY = dict(seq=256, block=16, prefix=32, tails=(8, 16, 24, 32),
 SMOKE_CAPACITY = dict(seq=64, block=8, prefix=8, tails=(2, 4, 6),
                       steps=(4, 6), dense_slots=2, slot_mult=4,
                       requests=10, gap_ms=0.0, exact_every=3)
+
+# Interference mix (ROADMAP item 2 / ISSUE 14): LONG prefills arriving
+# into a stream of latency-sensitive short decodes — the TTFT/ITL
+# tension disaggregation exists to remove. Every ``long_every``-th
+# request is a ``long_prompt``-token prompt with a short horizon; the
+# rest are short prompts with long horizons (their ITL is what the
+# long prefills interfere with). Both legs serve the IDENTICAL seeded
+# schedule: the time-shared leg runs one supervised continuous engine
+# (chunked prefill budgeted at ``budget`` tokens per decode step — the
+# PR 5 mitigation at its best), the disagg leg the same decode engine
+# with prefill OFFLOADED to a 2-replica prefill pool through the
+# two-stage router, one prefill replica KILLED mid-run.
+# ship_min gates the hop to the LONG prompts only: short prompts
+# prefill locally in one cheap slice — shipping them would just queue
+# them behind the long prefills at the prefill pool and pay the wire
+# for nothing (measured: ship-everything triples short-request TTFT).
+INTERFERENCE = dict(seq=256, block=16, chunk=16, budget=32,
+                    long_prompt=192, long_steps=8, long_every=5,
+                    shapes=((8, 40), (16, 32), (4, 48)),
+                    requests=40, gap_ms=10.0, ship_min=64)
+SMOKE_INTERFERENCE = dict(seq=64, block=8, chunk=4, budget=8,
+                          long_prompt=40, long_steps=4, long_every=4,
+                          shapes=((4, 10), (6, 8), (2, 12)),
+                          requests=16, gap_ms=8.0, ship_min=24)
 
 
 def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
@@ -618,6 +659,236 @@ def run_fleet_leg(cfg, params, schedule, args) -> dict:
     return line
 
 
+def build_interference_schedule(cap: dict, seed: int, vocab: int):
+    """Deterministic interference traffic: short decode-heavy requests
+    with a long prefill landing every ``long_every`` arrivals."""
+    rng = np.random.default_rng(seed)
+    out, t = [], 0.0
+    shapes = list(cap["shapes"])
+    for i in range(cap["requests"]):
+        if i and i % cap["long_every"] == 0:
+            p, steps = cap["long_prompt"], cap["long_steps"]
+        else:
+            p, steps = shapes[int(rng.integers(0, len(shapes)))]
+        prompt = rng.integers(0, vocab, (1, p)).astype(np.int32)
+        out.append((t, prompt, steps))
+        t += float(rng.exponential(cap["gap_ms"])) / 1e3
+    return out
+
+
+def _run_interference_leg(name, cfg, params, schedule, cap, *,
+                          disagg: bool) -> dict:
+    """One interference leg over real HTTP: a supervised continuous
+    engine behind a replica server, fronted by the plain router
+    (time-shared leg) or the two-stage disagg router over a 2-replica
+    prefill pool with one prefill replica killed mid-run (disagg leg).
+    Same transport both ways, so the comparison is the PREFILL
+    PLACEMENT, not HTTP overhead. TTFT/ITL come from the replica's own
+    per-request timing breakdown — engine-observed first-token time and
+    decode-step gaps, identical semantics on both legs."""
+    from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+    from tf_operator_tpu.fleet.replica import (
+        ReplicaServer,
+        SupervisorBackend,
+    )
+    from tf_operator_tpu.fleet.router import (
+        DisaggConfig,
+        DisaggRouterServer,
+        RouterConfig,
+        RouterServer,
+        http_probe,
+        http_send,
+    )
+    from tf_operator_tpu.serve.disagg import PrefillServer, PrefillWorker
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    res = ResilienceConfig(
+        queue_ttl_s=60.0, decode_deadline_s=90.0, watchdog_stall_s=10.0,
+        max_restarts=3, restart_backoff_s=0.1,
+        queue_limit=max(64, 4 * len(schedule)),
+    )
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(
+            cfg, params, max_slots=8, prefill_chunk=cap["chunk"],
+            kv_block=cap["block"],
+        ),
+        resilience=res, prefill_tokens_per_step=cap["budget"],
+    )
+    decode_server = ReplicaServer(
+        SupervisorBackend(sup, request_timeout_s=120.0),
+        replica_id=f"{name}-d0",
+    ).start()
+    dms = FleetMembership(fail_threshold=2, name=name)
+    dms.register(f"{name}-d0", decode_server.endpoint)
+    rcfg = RouterConfig(retries=2, request_timeout_s=120.0,
+                        probe_interval_s=0.1)
+    prefill_servers = []
+    if disagg:
+        for i in range(2):
+            prefill_servers.append(PrefillServer(
+                PrefillWorker(cfg, params, prefill_chunk=cap["chunk"],
+                              kv_block=cap["block"]),
+                replica_id=f"{name}-p{i}",
+            ).start())
+        pms = FleetMembership(fail_threshold=2, name=f"{name}#prefill")
+        for s in prefill_servers:
+            pms.register(s.replica_id, s.endpoint, role="prefill")
+        router = DisaggRouterServer(
+            pms, dms, config=rcfg,
+            disagg=DisaggConfig(ship_min_tokens=cap["ship_min"]),
+        ).start()
+        pms.probe(http_probe)
+    else:
+        router = RouterServer(dms, config=rcfg).start()
+    dms.probe(http_probe)
+
+    outcomes: list = []
+    olock = threading.Lock()
+    front = Replica(id="router", endpoint=router.endpoint)
+
+    def submit(prompt, steps):
+        status, payload = http_send(
+            front,
+            {"tokens": prompt.tolist(), "num_steps": steps,
+             "timing": True},
+            120.0,
+        )
+        with olock:
+            outcomes.append((status, payload))
+        if status == 200 and payload.get("tokens"):
+            timing = (payload.get("timing") or [{}])[0]
+            ttft = timing.get("ttft_ms")
+            gaps = [g / 1e3 for g in timing.get("itl_ms", ())]
+            return (payload["tokens"][0],
+                    ttft / 1e3 if ttft is not None else None, gaps)
+        raise RuntimeError(f"{status}:{payload.get('code', 'untyped')}")
+
+    run_schedule(schedule, submit)  # untimed warmup, pool whole
+    outcomes.clear()
+    killer = None
+    if disagg:
+        # Kill one prefill replica as the mid-run arrivals land: the
+        # stage-1 retry re-prefills elsewhere; lost must stay 0.
+        kill_at = schedule[len(schedule) // 2][0]
+        killer = threading.Timer(max(0.05, kill_at),
+                                 prefill_servers[0].kill)
+        killer.start()
+    wall_s, results = run_schedule(schedule, submit)
+    if killer is not None:
+        killer.cancel()
+
+    ok = sum(1 for s, p in outcomes
+             if s == 200 and not p.get("deadline_exceeded"))
+    partial = sum(1 for s, p in outcomes
+                  if s == 200 and p.get("deadline_exceeded"))
+    typed = sum(1 for s, p in outcomes
+                if s is not None and s >= 400 and p.get("code"))
+    untyped = sum(1 for s, p in outcomes
+                  if s is None or (s >= 400 and not p.get("code")))
+    lost = len(schedule) - len(outcomes)
+    shipped_joins = sum(
+        1 for s, p in outcomes
+        if s == 200 and (p.get("timing") or [{}])[0].get("shipped_kv")
+    )
+    kv = sup.engine.kv_debug() if sup.scheduler is not None else {}
+    stats = {
+        "mix": "interference",
+        "resolved": len(outcomes),
+        "lost": lost,
+        "ok": ok,
+        "deadline_partials": partial,
+        "typed_errors": typed,
+        "untyped_errors": untyped,
+        "long_prompt": cap["long_prompt"],
+        "long_every": cap["long_every"],
+        "prefill_chunk": cap["chunk"],
+        "prefill_budget": cap["budget"],
+        "deadline_budget_ms": round(res.decode_deadline_s * 1e3, 1),
+        "shipped_joins": shipped_joins,
+        "shipments_ingested": kv.get("shipments_ingested", 0),
+        "decode_step_compiles": (
+            sup.engine.decode_step_compiles
+            if sup.scheduler is not None else None
+        ),
+        "warmup_compiles": (
+            sup.engine.warmup_compiles
+            if sup.scheduler is not None else None
+        ),
+    }
+    # The resource model matters for reading the tails: disaggregation
+    # buys its win with DEDICATED prefill hardware. On a host whose
+    # prefill "replicas" share the decode device's cores (host_cpus <=
+    # the replica count — CI runs on 1), the pair measures the
+    # MECHANISM (zero lost, longs shipped, typed fallbacks) and the
+    # tail ratios invert, exactly like the tp pair's CPU line; the
+    # hardware rounds report the real ratios through this same
+    # plumbing.
+    stats["host_cpus"] = os.cpu_count()
+    if disagg:
+        stats["prefill_replicas"] = 2
+        stats["killed_prefill_replicas"] = 1
+        stats["ship"] = router.router.snapshot()["ship"]
+    router.stop()
+    for s in prefill_servers[1:]:
+        s.stop()
+    decode_server.stop()
+    sup.stop(timeout=30.0)
+    line = leg_summary(name, wall_s, results, stats)
+    line["errors"] = untyped + lost  # typed resolutions are contract
+    return line
+
+
+def run_disagg_legs(args, smoke: bool) -> list[dict]:
+    """The ROADMAP item-2 interference pair: disaggregated vs
+    time-shared on the identical seeded schedule. The disagg line's
+    ``vs_baseline`` is disagg/timeshared tokens/sec; its
+    ``ttft_p99_vs_baseline`` / ``itl_p99_vs_baseline`` are the ratios
+    the acceptance pin reads (< 1.0 = disaggregation wins that tail)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+
+    cap = SMOKE_INTERFERENCE if smoke else INTERFERENCE
+    cfg = TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=4,
+        n_layers=args.layers, d_ff=args.d_model * 2,
+        max_seq_len=cap["seq"], dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    schedule = build_interference_schedule(cap, args.seed, args.vocab)
+    base = _run_interference_leg(
+        "timeshared_interference", cfg, params, schedule, cap,
+        disagg=False,
+    )
+    dis = _run_interference_leg(
+        "disagg_interference", cfg, params, schedule, cap,
+        disagg=True,
+    )
+    if base["value"]:
+        dis["vs_baseline"] = round(dis["value"] / base["value"], 3)
+    dis["baseline_ttft_p99_ms"] = base["ttft_p99_ms"]
+    dis["baseline_itl_p99_ms"] = base["itl_p99_ms"]
+    if base["ttft_p99_ms"]:
+        dis["ttft_p99_vs_baseline"] = round(
+            dis["ttft_p99_ms"] / base["ttft_p99_ms"], 3
+        )
+    if base["itl_p99_ms"]:
+        dis["itl_p99_vs_baseline"] = round(
+            dis["itl_p99_ms"] / base["itl_p99_ms"], 3
+        )
+    return [dis, base]
+
+
 def run_coalesce(cfg, params, schedule, args) -> dict:
     import jax.numpy as jnp
 
@@ -669,12 +940,16 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--engine",
                    choices=("continuous", "coalesce", "both", "chaos",
-                            "fleet"),
+                            "fleet", "disagg"),
                    default="both",
                    help="'chaos' runs ONLY the seeded fault-injection "
                         "mix (supervised engine, step crash + stall "
                         "mid-run); 'fleet' the router-fronted replica "
-                        "fleet with one replica killed mid-run")
+                        "fleet with one replica killed mid-run; "
+                        "'disagg' the ROADMAP item-2 interference pair "
+                        "(long prefills + latency-sensitive decodes, "
+                        "disaggregated prefill pool vs the time-shared "
+                        "engine, one prefill replica killed mid-run)")
     p.add_argument("--fleet-replicas", type=int, default=4,
                    help="replica count for --engine fleet")
     p.add_argument("--tp", type=int, default=0,
@@ -764,6 +1039,8 @@ def main(argv: list[str] | None = None) -> int:
         lines.append(run_chaos_leg(cfg, params, schedule, args))
     if args.engine == "fleet":
         lines.append(run_fleet_leg(cfg, params, schedule, args))
+    if args.engine == "disagg":
+        lines.extend(run_disagg_legs(args, smoke))
     if args.engine in ("continuous", "both"):
         lines.append(run_continuous(cfg, params, schedule, args))
     if args.engine in ("coalesce", "both"):
