@@ -504,6 +504,24 @@ def bench_serve_tp(peak_hbm_gbps: float | None) -> None:
                           else 420)
 
 
+def bench_serve_spec(peak_hbm_gbps: float | None) -> None:
+    """Batch-wide speculative decode triple: subprocess-runs
+    tools/serve_bench.py --engine spec — one seeded decode-heavy
+    schedule served by the spec continuous engine (per-slot draft + one
+    batched verify per round), the plain continuous engine, and the
+    legacy --spec-k coalesce path, on one quick-trained target/draft
+    pair. The spec line's vs_baseline (spec/continuous) and
+    vs_spec_coalesce ratios are the ISSUE-15 acceptance numbers and
+    must both exceed 1, with accept_rate on the line proving the draft
+    actually rode. Subprocess for the usual serve-section reasons.
+    peak_hbm unused; signature keeps the peak-table plumbing
+    uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("serve_spec", ["--engine", "spec"],
+                          timeout=240 if os.environ.get("BENCH_SMOKE")
+                          else 540)
+
+
 def bench_serve_disagg(peak_hbm_gbps: float | None) -> None:
     """Disaggregated prefill/decode interference pair: subprocess-runs
     tools/serve_bench.py --engine disagg — long prefills + latency-
@@ -1219,6 +1237,7 @@ _SECTIONS: dict = {
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
     "serve_tp": (bench_serve_tp, chip_peak_hbm_gbps, 480.0),
+    "serve_spec": (bench_serve_spec, chip_peak_hbm_gbps, 560.0),
     "serve_disagg": (bench_serve_disagg, chip_peak_hbm_gbps, 560.0),
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),

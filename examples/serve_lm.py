@@ -36,11 +36,20 @@ generate()'s own validation). Two serving engines (``--engine``):
   ``--kv-pool-blocks`` pool): admission charges actual lengths rather
   than max-seq-len rows, identical block-aligned prompt prefixes share
   physical blocks copy-on-write and skip their prefill, and
-  ``--kv-dense`` falls back to the PR-5 dense slot tensor. ``--tp N``
+  ``--kv-dense`` falls back to the PR-5 dense slot tensor. ``--kv-int8``
+  composes with BOTH layouts (paged: int8 blocks + per-block scale
+  sidecar pools riding the same tables). ``--tp N``
   runs the SAME engine SPMD over an N-device mesh: params tp-sharded by
   the training rules, KV storage head-sharded, one compiled step
   driving the whole slice (composes with ``--kv-paged``/``--kv-dense``;
-  output stays bit-identical to solo decode).
+  output stays bit-identical to solo decode). ``--spec-k K`` turns
+  every decode iteration into a BATCH-WIDE speculative round: each
+  slot drafts K tokens and one batched K+1-position verify scores
+  them all, per-slot accept counters advancing slots DIFFERENT
+  numbers of tokens per round — greedy output stays bit-identical to
+  plain greedy, sampled slots keep their exact sampling law, and the
+  two round executables never recompile across occupancy or accept
+  variation (composes with ``--tp`` and ``--kv-int8``).
   ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
   ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
   requests finish (bounded by ``--drain-timeout`` — stragglers resolve
@@ -66,10 +75,10 @@ generate()'s own validation). Two serving engines (``--engine``):
   (one compile per (batch, prompt_len, num_steps, temperature, top_p)
   combination), optionally with ``--batch-window MS`` coalescing
   concurrent same-shape greedy requests into one padded batched decode
-  (serve/coalesce.py). Selected automatically when --spec-k /
-  --batch-window / --int8 ask for paths the continuous engine does not
-  compose with (--tp no longer downgrades — tensor-parallel decode is a
-  continuous-engine mode); kept selectable for the exactness matrix.
+  (serve/coalesce.py). Selected automatically only under
+  ``--batch-window`` (the window IS the coalesce policy — --spec-k,
+  --int8, and --tp are all continuous-engine modes now); kept
+  selectable for the exactness matrix and as the spec bench baseline.
 
 ``--requests`` bounds the serve
 loop so the process terminates like a job (the operator's Succeeded
@@ -181,8 +190,9 @@ def main(argv: list[str] | None = None) -> int:
                         "storage (paged pool or dense tensor) is "
                         "head-sharded over the mesh so ONE compiled "
                         "step drives the whole slice (composes with "
-                        "--kv-paged/--kv-dense; --spec-k/--int8 remain "
-                        "legacy-only)")
+                        "--kv-paged/--kv-dense/--kv-int8/--spec-k; "
+                        "--int8 params replicate — the dequant kernel "
+                        "has no SPMD rule)")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode: quantize projections "
                         "after load (Pallas dequant-in-VMEM on TPU — "
@@ -190,8 +200,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--kv-int8", action="store_true",
                    help="int8 KV cache with per-(token, head) scales — "
                         "halves the cache read that dominates decode as "
-                        "context grows; composes with --int8 (pure XLA, "
-                        "works under --tp)")
+                        "context grows. Composes with --int8, --tp, "
+                        "--spec-k, AND the paged pool (int8 blocks + "
+                        "per-block scale sidecar pools riding the same "
+                        "block tables)")
     p.add_argument("--requests", type=int, default=None,
                    help="exit 0 after serving this many /generate calls "
                         "(job mode); default: run until SIGTERM")
@@ -199,11 +211,17 @@ def main(argv: list[str] | None = None) -> int:
                    help="speculative decoding: a smaller DRAFT model "
                         "proposes K tokens per round, verified in one "
                         "chunked target forward (models/spec_decode.py). "
+                        "Under the continuous engine (default) this is "
+                        "BATCH-WIDE: every slot drafts+verifies per "
+                        "round with per-slot accept counters, so slots "
+                        "advance different amounts (serve/engine.py). "
                         "Covers greedy AND sampled requests (incl. "
                         "top_p): greedy output is bit-identical to "
                         "plain greedy, sampled output follows exactly "
                         "the plain sampling distribution (a bad draft "
-                        "costs speed, never correctness). 0 = off")
+                        "costs speed, never correctness). Composes "
+                        "with --tp and --kv-int8; prompt + num_steps + "
+                        "K + 1 must fit --max-seq-len. 0 = off")
     p.add_argument("--spec-draft-layers", type=int, default=None,
                    help="draft depth (default max(1, --layers // 2)); "
                         "the draft trains on the same synthetic task "
@@ -240,13 +258,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="serving engine: 'continuous' = slot-based "
                         "continuous batching (tf_operator_tpu/serve/ — "
                         "in-flight join/retire, sampled requests batch "
-                        "too, zero recompiles across occupancy); "
+                        "too, zero recompiles across occupancy; "
+                        "--tp/--spec-k/--int8/--kv-int8 all compose); "
                         "'coalesce' = the legacy direct/batch-window "
-                        "path. Default: continuous (incl. under --tp — "
-                        "SPMD tensor-parallel decode), unless --spec-k/"
-                        "--batch-window/--int8 select the legacy path "
-                        "(solo-decode compositions the continuous "
-                        "engine does not cover)")
+                        "path, kept selectable for the exactness "
+                        "matrix and the spec bench baseline. Default: "
+                        "continuous unless --batch-window (the window "
+                        "IS the coalesce policy)")
     p.add_argument("--prefill-budget", type=int, default=256,
                    metavar="TOKENS",
                    help="continuous engine: max prompt tokens prefilled "
@@ -265,9 +283,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--kv-dense", dest="kv_paged", action="store_false",
                    help="escape hatch: the PR-5 dense slot tensor "
                         "(every slot pre-pays max-seq-len rows; no "
-                        "prefix sharing). Selected automatically under "
-                        "--kv-int8, whose scale sidecars are not "
-                        "block-pooled yet")
+                        "prefix sharing)")
     p.add_argument("--kv-block", type=int, default=64, metavar="TOKENS",
                    help="paged KV cache block size in tokens "
                         "(--max-seq-len must divide evenly)")
@@ -341,19 +357,19 @@ def main(argv: list[str] | None = None) -> int:
                         "tpu_trace_spans_dropped_total). 0 disables "
                         "tracing entirely")
     args = p.parse_args(argv)
-    # --tp is NOT in this list: tensor-parallel decode is a first-class
-    # continuous-engine mode (PR 10 — the SPMD slot tensor; one compiled
-    # step drives the slice). Only --spec-k/--int8/--batch-window still
-    # downgrade to the legacy lock-step path.
+    # --batch-window is the ONLY legacy selector left: --tp became a
+    # continuous-engine mode in PR 10, and --spec-k/--int8 joined it in
+    # PR 15 (batch-wide speculative decode rides the slot engine's
+    # per-lane counters; --int8 weights are a params-tree property the
+    # engine never branches on). The window is inherently the coalesce
+    # policy, so it keeps selecting that path.
     legacy_flags = [flag for flag, on in (
-        ("--spec-k", bool(args.spec_k)),
         ("--batch-window", args.batch_window > 0),
-        ("--int8", args.int8),
     ) if on]
     if args.engine == "continuous" and legacy_flags:
         p.error(f"--engine continuous does not compose with "
-                f"{'/'.join(legacy_flags)} (those are solo/lock-step "
-                f"decode paths — use --engine coalesce)")
+                f"{'/'.join(legacy_flags)} (the window IS the coalesce "
+                f"policy — use --engine coalesce)")
     if args.engine is None:
         args.engine = "coalesce" if legacy_flags else "continuous"
     if args.role == "prefill":
@@ -376,26 +392,23 @@ def main(argv: list[str] | None = None) -> int:
         p.error("--prefill-budget must be >= 1")
     if args.requests is not None and args.requests < 1:
         p.error("--requests must be >= 1 (omit it to serve until SIGTERM)")
-    if args.int8 and args.tp > 1:
-        # Rejected up front: by the old check site the user had already
-        # paid the full checkpoint restore + tp shard before the error.
-        p.error("--int8 with --tp > 1 is not supported (the int8 "
-                "kernel has no SPMD partitioning rule)")
     if args.spec_k:
         if args.spec_k < 1:
             p.error("--spec-k must be >= 1 (0 disables)")
         if (args.spec_draft_layers is not None
                 and args.spec_draft_layers < 1):
             p.error("--spec-draft-layers must be >= 1")
-        # --kv-int8 composes: speculative exactness for the int8 KV cache
-        # (including the scale-buffer rollback) is pinned by
-        # tests/test_spec_decode.py::test_exact_vs_greedy_cache_variants.
-        # --int8 (no SPMD/quantized multi-token scoring path) and --tp
-        # (no partitioning rule for the draft round) remain blocked.
-        if args.int8 or args.tp > 1:
-            p.error("--spec-k composes only with the plain or --kv-int8 "
-                    "decode paths (not --int8/--tp; speculative "
-                    "exactness is not pinned for those configurations)")
+        # --kv-int8 composes (dense AND paged: the spec×kv8 exactness is
+        # pinned by tests/test_spec_decode.py and the engine matrix in
+        # tests/test_serve_engine.py), and --tp composes (the engine
+        # shards the draft by the same rules — tools/serve_tp_check.py
+        # pins the spec/tp leg). --int8 stays blocked: speculative
+        # decoding rejects int8_decode trees, same contract as solo
+        # speculative_generate.
+        if args.int8:
+            p.error("--spec-k does not compose with --int8 "
+                    "(speculative decoding rejects int8_decode param "
+                    "trees; quantize after choosing a decode strategy)")
         if args.checkpoint_dir and not args.draft_checkpoint_dir:
             p.error("--spec-k with --checkpoint-dir also needs "
                     "--draft-checkpoint-dir (a draft trained at "
@@ -477,15 +490,6 @@ def main(argv: list[str] | None = None) -> int:
     else:
         params = quick_train(cfg, args.train_steps, args.lr)
 
-    mesh = None
-    if args.tp > 1:
-        from tf_operator_tpu.parallel.mesh import create_mesh
-        from tf_operator_tpu.parallel.sharding import shard_params_by_rules
-
-        mesh = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
-        params = shard_params_by_rules(mesh, params, param_sharding_rules())
-        print(f"serve_lm: params tp-sharded over {args.tp} devices",
-              flush=True)
     if args.int8:
         from dataclasses import replace
 
@@ -494,6 +498,22 @@ def main(argv: list[str] | None = None) -> int:
         params = quantize_decode_params(params)
         cfg = replace(cfg, int8_decode=True)
         print("serve_lm: projections quantized to int8", flush=True)
+    mesh = None
+    if args.tp > 1:
+        from tf_operator_tpu.parallel.mesh import create_mesh
+        from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+
+        mesh = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+        # int8 trees replicate (the dequant kernel has no SPMD
+        # partitioning rule — serve/engine.py applies the same policy);
+        # tp still shards the KV storage and drives one compiled step
+        # across the slice.
+        params = shard_params_by_rules(
+            mesh, params,
+            {} if args.int8 else param_sharding_rules(),
+        )
+        print(f"serve_lm: params {'replicated (int8)' if args.int8 else 'tp-sharded'} "
+              f"over {args.tp} devices", flush=True)
     if args.kv_int8:
         from dataclasses import replace
 
@@ -631,13 +651,6 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         kv_paged = args.kv_paged
-        if kv_paged and args.kv_int8:
-            # The int8 scale sidecars are not block-pooled: serve the
-            # dense slot layout (which inherits them) rather than 400ing
-            # a flag combination with an obvious resolution.
-            print("serve_lm: --kv-int8 selects the dense slot cache "
-                  "(int8 sidecars are not block-pooled)", flush=True)
-            kv_paged = False
         if kv_paged and args.max_seq_len % args.kv_block:
             p.error(f"--max-seq-len {args.max_seq_len} must be a "
                     f"multiple of --kv-block {args.kv_block} "
@@ -667,13 +680,17 @@ def main(argv: list[str] | None = None) -> int:
             # every time, so a replayed greedy request is bit-identical
             # to an uninterrupted run — the rebuilt engine reconstructs
             # the tp layout (re-places the KV pools head-sharded) from
-            # the captured mesh, at tp>1 exactly as at tp=1.
+            # the captured mesh, at tp>1 exactly as at tp=1. --spec-k
+            # rides along: the rebuilt engine re-seeds its draft cache
+            # at each replay's join, so replays stay bit-identical.
             return ContinuousEngine(
                 cfg, params, max_slots=args.max_batch,
                 prefill_chunk=(args.prefill_chunk or None),
                 kv_paged=kv_paged, kv_block=args.kv_block,
                 kv_blocks=args.kv_pool_blocks,
                 faults=faults, mesh=mesh,
+                spec_k=args.spec_k, draft_cfg=draft_cfg,
+                draft_params=draft_params,
             )
 
         engine_sched = EngineSupervisor(
@@ -692,6 +709,9 @@ def main(argv: list[str] | None = None) -> int:
         )
         if mesh is not None:
             kv_desc += f", tp {args.tp} (SPMD mesh, kv head-sharded)"
+        if args.spec_k:
+            kv_desc += (f", spec k={args.spec_k} "
+                        f"(draft {draft_cfg.n_layers} layer(s))")
         print(f"serve_lm: continuous batching "
               f"(slots {args.max_batch}, {kv_desc}, prefill chunk "
               f"{args.prefill_chunk or 'one-shot'}, prefill budget "
@@ -754,7 +774,11 @@ def main(argv: list[str] | None = None) -> int:
                     payload["coalesced_batches"] = coalescer.batches
                     payload["max_batch_rows"] = coalescer.max_rows_seen
                     payload["pending"] = len(coalescer.pending)
-                if args.spec_k:
+                if args.spec_k and engine_sched is not None:
+                    # Continuous engine: batch-wide speculation stats
+                    # from the live engine (accept rate included).
+                    payload["spec"] = engine_sched.engine.spec_debug()
+                elif args.spec_k:
                     payload["spec_decodes"] = spec_stats["decodes"]
                     payload["spec_rounds"] = spec_stats["rounds"]
                     payload["spec_tokens"] = spec_stats["tokens"]

@@ -1,0 +1,21 @@
+"""Seeded violations for the ``metrics-registry`` pass over the
+ISSUE-15 speculative-decode families: the accept-tokens histogram is
+re-declared as a counter with a drifted label set, and the rounds
+counter's call site passes a label the declaration doesn't know."""
+
+from tf_operator_tpu.runtime.metrics import REGISTRY
+
+SPEC_ACCEPT = REGISTRY.histogram(
+    "tpu_serve_spec_accept_tokens",
+    "tokens emitted per slot per speculative round",
+)
+SPEC_ACCEPT_AGAIN = REGISTRY.counter(
+    "tpu_serve_spec_accept_tokens", "drifted re-declaration", ("slot",),
+)
+SPEC_ROUNDS = REGISTRY.counter(
+    "tpu_serve_spec_rounds_total", "speculative rounds executed",
+)
+
+
+def observe() -> None:
+    SPEC_ROUNDS.inc(engine="spec")

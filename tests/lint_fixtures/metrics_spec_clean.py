@@ -1,0 +1,19 @@
+"""Clean twin of metrics_spec_bad.py: the speculative-decode families
+declared once each with the real shapes (label-free histogram +
+label-free counter), call sites matching exactly."""
+
+from tf_operator_tpu.runtime.metrics import REGISTRY
+
+SPEC_ACCEPT = REGISTRY.histogram(
+    "tpu_serve_spec_accept_tokens",
+    "tokens emitted per slot per speculative round",
+    buckets=(1.0, 2.0, 3.0, 4.0),
+)
+SPEC_ROUNDS = REGISTRY.counter(
+    "tpu_serve_spec_rounds_total", "speculative rounds executed",
+)
+
+
+def observe(count: float) -> None:
+    SPEC_ACCEPT.observe(count)
+    SPEC_ROUNDS.inc()

@@ -347,7 +347,12 @@ def test_serve_lm_speculative_from_checkpoints(tmp_path):
         assert out["tokens"][0] == [9, 10, 11, 12], out
         health = _json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/healthz", timeout=5).read())
-        assert health["spec_decodes"] == 1, health
+        # Batch-wide speculation on the continuous engine (ISSUE 15):
+        # /healthz carries the engine's spec section — rounds ran and
+        # tokens were emitted through the draft/verify pair.
+        assert health["spec"]["k"] == 3, health
+        assert health["spec"]["rounds"] >= 1, health
+        assert health["spec"]["tokens"] >= 4, health
     finally:
         proc.terminate()
         proc.wait(timeout=10)
@@ -499,11 +504,14 @@ def test_serve_lm_speculative_matches_plain():
         # determinism of the speculative path itself is exact
         assert [ask(spec_port, s) for s in starts] == got
         # the speculative path must have actually run (a silent fallback
-        # to plain generate would pass every check above)
+        # to plain decode would pass every check above): the continuous
+        # engine's spec section counts rounds and emitted tokens.
         health = _json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{spec_port}/healthz", timeout=5).read())
-        assert health["spec_decodes"] == 2 * len(starts), health
-        assert 0 < health["spec_rounds"] <= health["spec_tokens"], health
+        assert health["spec"]["k"] == 3, health
+        assert 0 < health["spec"]["rounds"] <= health["spec"]["tokens"], \
+            health
+        assert health["spec"]["tokens"] >= 2 * len(starts) * 6, health
 
         # SAMPLED requests also ride the speculative path (distribution-
         # preserving accept/residual): deterministic per seed, seed-
@@ -525,8 +533,10 @@ def test_serve_lm_speculative_matches_plain():
         health2 = _json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{spec_port}/healthz", timeout=5).read())
         # 2 determinism queries + at least 1 seed-sensitivity query
-        # (any() short-circuits on the first differing seed)
-        assert health2["spec_decodes"] >= health["spec_decodes"] + 3, health2
+        # (any() short-circuits on the first differing seed) — each at
+        # least one more speculative round.
+        assert health2["spec"]["rounds"] >= health["spec"]["rounds"] + 3, \
+            health2
     finally:
         for proc in (plain, spec):
             proc.terminate()

@@ -337,6 +337,62 @@ def test_paged_matches_dense_engine_token_for_token(params):
         )
 
 
+def test_paged_kv8_matches_dense_kv8_and_solo_with_cow(params):
+    """The kv-int8 POOL layout (ISSUE 15): int8 blocks + per-block
+    scale sidecar pools riding the same block tables. Paged-kv8 decode
+    must equal dense-kv8 AND solo generate on the kv8 config,
+    token-for-token, including an exact-prefix re-join whose
+    copy-on-write must carry the SCALE sidecars along with the int8
+    rows (a block copy that forgot the scales would decode with zeroed
+    scales — wrong tokens, loudly)."""
+    from dataclasses import replace
+
+    cfg8 = replace(CFG, kv_int8=True)
+    p8 = Transformer(cfg8).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    def solo8(prompt, steps):
+        return np.asarray(
+            generate(cfg8, p8, jnp.asarray(prompt), steps)
+        )[0]
+
+    a = prompt_of(11, 30)  # partial last block: the CoW case
+    b = prompt_of(6, 31)
+    streams = {}
+    for paged in (False, True):
+        engine = ContinuousEngine(
+            cfg8, p8, max_slots=3, kv_paged=paged, kv_block=BLOCK
+        )
+        sa = engine.join(jnp.asarray(a), num_steps=8)
+        out = {sa: []}
+        for _ in range(2):
+            toks = engine.step()
+            out[sa].append(int(toks[sa]))
+        if paged:
+            # Exact re-join of a's registered prompt: table-insert join
+            # (prefill skipped) + CoW of the shared partial block —
+            # int8 rows AND scale sidecars.
+            sc = engine.join(jnp.asarray(a), num_steps=8)
+            out[sc] = []
+        sb = engine.join(jnp.asarray(b), num_steps=6)
+        out[sb] = []
+        left = {s: (8 if s != sb else 6) - len(out[s]) for s in out}
+        out2 = run_to_completion(engine, left)
+        for s, toks in out2.items():
+            out[s].extend(toks)
+        streams[paged] = {"a": out[sa], "b": out[sb]}
+        np.testing.assert_array_equal(out[sa], solo8(a, 8))
+        np.testing.assert_array_equal(out[sb], solo8(b, 6))
+        if paged:
+            np.testing.assert_array_equal(out[sc], solo8(a, 8))
+            assert engine.cow_copies >= 1
+            assert engine.prefill_tokens_saved >= a.shape[1]
+        assert engine.decode_step_compiles == engine.warmup_compiles
+    np.testing.assert_array_equal(streams[False]["a"], streams[True]["a"])
+    np.testing.assert_array_equal(streams[False]["b"], streams[True]["b"])
+
+
 def test_block_exhaustion_queues_until_retire(params):
     """Admission is 'free slot AND enough free blocks': with a pool that
     fits ONE request, concurrent submissions serialize through the

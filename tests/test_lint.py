@@ -48,6 +48,10 @@ _FIXTURE_MATRIX = {
     "guarded_bad.py": ((), "guarded-attr"),
     "blocking_bad.py": ((), "blocking-under-lock"),
     "metrics_bad.py": ((), "metrics-registry"),
+    # ISSUE 15 speculative-decode families: a drifted re-declaration of
+    # tpu_serve_spec_accept_tokens / an unknown label on the rounds
+    # counter must trip — dashboards key on these exact schemas.
+    "metrics_spec_bad.py": ((), "metrics-registry"),
     "errors_bad.py": ((TAXONOMY,), "typed-error"),
     # Disaggregation wire codes (ISSUE 14): a typo'd ship_failed /
     # unknown prefill-pool code must trip — the two-stage router
@@ -72,7 +76,8 @@ def test_fixture_trips_exactly_its_pass(name):
 
 @pytest.mark.parametrize("name", [
     "lockorder_clean.py", "guarded_clean.py", "blocking_clean.py",
-    "metrics_clean.py", "errors_clean.py", "errors_ship_clean.py",
+    "metrics_clean.py", "metrics_spec_clean.py", "errors_clean.py",
+    "errors_ship_clean.py",
 ])
 def test_clean_twin_trips_nothing(name):
     extra = (TAXONOMY,) if name.startswith("errors") else ()

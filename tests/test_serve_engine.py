@@ -5,7 +5,16 @@ reproducing their solo per-request-rng stream exactly, and ZERO decode-
 step recompiles across occupancy changes after warmup. The whole matrix
 runs under BOTH KV layouts: the block-paged pool (default) and the
 dense slot tensor (--kv-dense escape hatch); the paged-specific
-edge-case/sharing pins live in tests/test_kvcache_paged.py."""
+edge-case/sharing pins live in tests/test_kvcache_paged.py.
+
+BATCH-WIDE SPECULATIVE DECODE (ISSUE 15): the spec engine's per-slot
+streams must be bit-identical to solo ``speculative_generate`` (greedy
+== plain ``generate`` too; sampled reproduce the solo spec stream for
+the same seed — which carries the seeded-law pins of
+tests/test_spec_decode.py into the engine), across join/retire/
+slot-reuse boundaries, with kv-int8 composed in, at exactly TWO
+compiled round executables (one draft + one verify) frozen from
+warmup."""
 
 import numpy as np
 import pytest
@@ -13,6 +22,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from tf_operator_tpu.models.spec_decode import speculative_generate
 from tf_operator_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
@@ -27,12 +37,25 @@ CFG = TransformerConfig(
     vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
     max_seq_len=64, dtype=jnp.float32,
 )
+# The spec draft: same shapes at half depth (what serve_lm builds), so
+# draft params restore/init cleanly and GQA/kv8 variants inherit.
+DRAFT_CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
 
 
 @pytest.fixture(scope="module")
 def params():
     return Transformer(CFG).init(
         jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return Transformer(DRAFT_CFG).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
     )["params"]
 
 
@@ -225,7 +248,231 @@ def test_chunked_prefill_resumable_matches_one_shot(params):
         ChunkedPrefill(CFG, params, prompt, chunk=4).result()
 
 
-def test_slot_allocator_contract():
+# ---------------------------------------------------------------------------
+# batch-wide speculative decode (spec engine)
+# ---------------------------------------------------------------------------
+
+SPEC_K = 2
+
+
+def spec_drive(engine: ContinuousEngine, reqs: dict, script: list) -> dict:
+    """The ``drive`` harness for spec rounds: each ``("rounds", n)``
+    entry runs up to n ``spec_step`` rounds, delivering each slot's
+    ``counts[slot]``-token window trimmed to its remaining budget —
+    exactly the scheduler's delivery loop. Retires fire the round a
+    request completes, so joins/retires land at accept-dependent
+    (not step-aligned) boundaries — the per-slot-progress property
+    the spec engine exists for."""
+    owner: dict[int, str] = {}
+    out = {name: [] for name in reqs}
+    for op, arg in script:
+        if op == "join":
+            prompt, steps, t, tp, seed = reqs[arg]
+            slot = engine.join(
+                jnp.asarray(prompt), num_steps=steps, temperature=t,
+                top_p=tp, seed=seed,
+            )
+            assert slot is not None, f"no free slot for {arg}"
+            owner[slot] = arg
+        else:
+            for _ in range(arg):
+                if not owner:
+                    break
+                toks, counts = engine.spec_step()
+                for slot in list(owner):
+                    name = owner[slot]
+                    steps = reqs[name][1]
+                    for j in range(int(counts[slot])):
+                        if len(out[name]) < steps:
+                            out[name].append(int(toks[slot, j]))
+                    if len(out[name]) >= steps:
+                        engine.retire(slot)
+                        del owner[slot]
+    assert not owner, f"script left requests unfinished: {owner}"
+    return out
+
+
+def solo_spec(cfg, dcfg, params, dparams, prompt, steps, *,
+              temperature=0.0, top_p=None, seed=0):
+    """The spec oracle: solo ``speculative_generate`` per request —
+    greedy equals plain ``generate``; sampled is the engine's pinned
+    stream (same per-request PRNGKey(seed) chain)."""
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    toks, _ = speculative_generate(
+        cfg, params, dcfg, dparams, jnp.asarray(prompt), steps,
+        k=SPEC_K, **kw,
+    )
+    return np.asarray(toks)[0]
+
+
+SPEC_REQS = {
+    # joins/retires land at accept-dependent boundaries; c is sampled,
+    # d nucleus-sampled, e reuses a freed slot with the SAME prompt as
+    # the still-live a (paged: exact-prefix table-insert join off a's
+    # registered blocks + CoW ahead of the first speculative write into
+    # the shared partial block). a's long horizon keeps it live past
+    # b/c/d's retirements: an unrelated random draft accepts ~never, so
+    # 12 rounds deliver ~12 of its 24 tokens.
+    "a": (prompt_of(6, 11), 24, 0.0, None, 0),
+    "b": (prompt_of(9, 12), 6, 0.0, None, 0),
+    "c": (prompt_of(4, 13), 8, 0.9, None, 11),
+    "d": (prompt_of(5, 14), 5, 0.7, 0.8, 3),
+    "e": (prompt_of(6, 11), 7, 0.0, None, 0),
+}
+SPEC_SCRIPT = [
+    ("join", "a"), ("rounds", 1),
+    ("join", "b"), ("join", "c"), ("rounds", 2),
+    ("join", "d"), ("rounds", 12),
+    ("join", "e"), ("rounds", 40),
+]
+
+
+@pytest.mark.parametrize("kv_paged", [False, True],
+                         ids=["dense", "paged"])
+def test_spec_engine_bit_identical_to_solo_speculative(params,
+                                                       draft_params,
+                                                       kv_paged):
+    """THE spec tentpole pin: every request's engine stream — greedy AND
+    sampled (incl. nucleus) — equals its solo ``speculative_generate``
+    stream bit-for-bit (greedy additionally equals plain ``generate``),
+    across join/retire/slot-reuse at accept-dependent boundaries, in
+    both KV layouts, with exactly the warmup's two round executables."""
+    engine = ContinuousEngine(
+        CFG, params, max_slots=4, kv_paged=kv_paged, kv_block=8,
+        spec_k=SPEC_K, draft_cfg=DRAFT_CFG, draft_params=draft_params,
+    )
+    got = spec_drive(engine, SPEC_REQS, SPEC_SCRIPT)
+    for name, (prompt, steps, t, tp, seed) in SPEC_REQS.items():
+        want = solo_spec(CFG, DRAFT_CFG, params, draft_params, prompt,
+                         steps, temperature=t, top_p=tp, seed=seed)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), want[:steps], err_msg=name
+        )
+        if t == 0.0:
+            np.testing.assert_array_equal(
+                np.asarray(got[name]),
+                solo(params, prompt, steps), err_msg=f"{name} vs plain"
+            )
+    # One draft + one verify executable, frozen from warmup: occupancy
+    # AND accept-length variation never recompiled.
+    assert engine.decode_step_compiles == engine.warmup_compiles
+    if kv_paged:
+        # Request e exact-prefix-joined a's registered prompt: the
+        # target prefill was skipped and the shared partial block was
+        # copied before e's first speculative write touched it.
+        assert engine.prefill_tokens_saved >= SPEC_REQS["a"][0].shape[1]
+        assert engine.cow_copies >= 1
+    dbg = engine.spec_debug()
+    assert dbg["k"] == SPEC_K and dbg["rounds"] > 0
+    assert 0.0 <= dbg["accept_rate"] <= 1.0
+
+
+def test_spec_engine_kv8_paged_across_boundaries(params, draft_params):
+    """spec x kv8 carried across join/retire/slot-reuse: the paged-kv8
+    pool (int8 blocks + per-block scale sidecars) under speculative
+    rounds stays bit-identical to solo speculative_generate on the SAME
+    kv8 config — including an exact-prefix re-join whose CoW must copy
+    the scale sidecars along with the int8 rows. Runs CHUNKED
+    (prefill_chunk=4): target prefill buckets through the fixed-chunk
+    executables and the DRAFT prefill rides them too (the
+    per-prompt-shape compile the chunked machinery exists to avoid)."""
+    from dataclasses import replace
+
+    cfg8 = replace(CFG, kv_int8=True)
+    dcfg8 = replace(DRAFT_CFG, kv_int8=True)
+    engine = ContinuousEngine(
+        cfg8, params, max_slots=4, kv_paged=True, kv_block=8,
+        prefill_chunk=4,
+        spec_k=SPEC_K, draft_cfg=dcfg8, draft_params=draft_params,
+    )
+    got = spec_drive(engine, SPEC_REQS, SPEC_SCRIPT)
+    for name, (prompt, steps, t, tp, seed) in SPEC_REQS.items():
+        want = solo_spec(cfg8, dcfg8, params, draft_params, prompt,
+                         steps, temperature=t, top_p=tp, seed=seed)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), want[:steps], err_msg=name
+        )
+    assert engine.decode_step_compiles == engine.warmup_compiles
+    assert engine.cow_copies >= 1  # scale sidecars rode the block copy
+
+
+def test_spec_engine_through_scheduler_with_eos(params, draft_params):
+    """The serving loop's multi-token delivery: concurrent requests
+    through ContinuousScheduler on a spec engine — greedy pinned to
+    solo speculative_generate (== plain generate), an eos request
+    truncating MID-ROUND (the window past eos is dead, exactly solo's
+    trim), and the snapshot carrying the spec section + the
+    zero-recompile pair."""
+    import threading
+
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    engine = ContinuousEngine(
+        CFG, params, max_slots=3, kv_block=8,
+        spec_k=SPEC_K, draft_cfg=DRAFT_CFG, draft_params=draft_params,
+    )
+    sched = ContinuousScheduler(engine).start()
+    try:
+        pa, pb = prompt_of(6, 40), prompt_of(9, 41)
+        results = {}
+
+        def client(key, req):
+            results[key] = list(sched.submit_request(req).out)
+
+        threads = [
+            threading.Thread(target=client, args=(
+                "a", ServeRequest(pa, 10))),
+            threading.Thread(target=client, args=(
+                "b", ServeRequest(pb, 8, temperature=0.9, seed=5))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        want_a = solo_spec(CFG, DRAFT_CFG, params, draft_params, pa, 10)
+        np.testing.assert_array_equal(results["a"], want_a[:10])
+        np.testing.assert_array_equal(results["a"],
+                                      solo(params, pa, 10))
+        want_b = solo_spec(CFG, DRAFT_CFG, params, draft_params, pb, 8,
+                           temperature=0.9, seed=5)
+        np.testing.assert_array_equal(results["b"], want_b[:8])
+        # eos mid-stream: resubmit a's prompt with its 5th token as eos
+        # — the delivered stream truncates there even when the round
+        # that produced it emitted more.
+        eos = int(want_a[4])
+        r = sched.submit_request(ServeRequest(pa, 10, eos_id=eos))
+        assert list(r.out) == list(want_a[: list(want_a).index(eos) + 1])
+        snap = sched.debug_snapshot()
+        assert snap["spec"]["k"] == SPEC_K
+        assert snap["spec"]["rounds"] > 0
+        assert snap["decode_step_compiles"] == snap["warmup_compiles"]
+        assert snap["tokens_generated"] == (
+            10 + 8 + len(r.out)
+        )
+    finally:
+        sched.stop(timeout=30.0)
+
+
+def test_spec_engine_budget_and_validation(params, draft_params):
+    engine = ContinuousEngine(
+        CFG, params, max_slots=2, kv_block=8,
+        spec_k=SPEC_K, draft_cfg=DRAFT_CFG, draft_params=draft_params,
+    )
+    # The solo margin contract: prompt + steps + k + 1 must fit.
+    with pytest.raises(ValueError, match="speculation margin"):
+        engine.validate_request(40, 64 - 40 - SPEC_K)
+    engine.validate_request(40, 64 - 40 - SPEC_K - 1)
+    with pytest.raises(RuntimeError, match="spec_step"):
+        engine.step()
+    with pytest.raises(ValueError, match="draft_cfg"):
+        ContinuousEngine(CFG, params, max_slots=2, spec_k=1)
     alloc = SlotAllocator(3)
     assert [alloc.acquire() for _ in range(3)] == [0, 1, 2]
     assert alloc.acquire() is None

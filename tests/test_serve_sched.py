@@ -273,6 +273,54 @@ def test_traced_request_bit_identical_with_spans_and_no_recompile(params):
         sched.stop(timeout=30.0)
 
 
+@pytest.mark.slow
+def test_serve_bench_spec_structural():
+    """tools/serve_bench.py --engine spec (BENCH_SMOKE): the ISSUE-15
+    triple — spec continuous engine vs plain continuous vs legacy
+    --spec-k coalesce on one seeded decode-heavy schedule with a
+    quick-trained target/draft pair. Structural pins: all three legs
+    decode the IDENTICAL token count (same greedy schedule, same
+    trained model), zero errors, the spec engine's two round
+    executables frozen from warmup, a high measured accept_rate (the
+    draft genuinely rode — without it the comparison is meaningless),
+    and the spec line beating the legacy coalesce path outright. The
+    spec/continuous ratio is asserted only as populated-and-sane here:
+    the >1 acceptance number is the full-size bench line's (smoke
+    shapes shrink horizons until round quantization eats the margin);
+    BENCH_r* rounds carry the real ratios."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "serve_bench.py"),
+         "--engine", "spec", "--requests", "10"],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    by_metric = {line["metric"]: line for line in lines}
+    spec = by_metric["serve_spec_tokens_per_sec_mixed"]
+    cont = by_metric["serve_continuous_tokens_per_sec_mixed"]
+    legacy = by_metric["serve_spec_coalesce_tokens_per_sec_mixed"]
+    assert spec["errors"] == cont["errors"] == legacy["errors"] == 0
+    assert (spec["generated_tokens"] == cont["generated_tokens"]
+            == legacy["generated_tokens"] > 0)
+    assert spec["requests"] == 10
+    # One draft + one verify executable, frozen from warmup.
+    assert spec["decode_step_compiles"] == spec["warmup_compiles"]
+    assert spec["spec_k"] >= 1 and spec["spec_rounds"] > 0
+    # The draft rode: the quick-trained pair accepts most proposals.
+    assert spec["accept_rate"] > 0.5, spec
+    assert spec["tokens_per_lane_round"] > 1.5, spec
+    # Ratios populated; the legacy lock-step path is beaten outright
+    # even at smoke shapes (the engine keeps occupancy the coalescer
+    # structurally cannot).
+    assert spec["vs_spec_coalesce"] > 1.0, spec
+    assert spec["vs_baseline"] > 0.5, spec
+
+
 def test_serve_bench_emits_structural_line():
     """tools/serve_bench.py (BENCH_SMOKE shapes): both legs emit JSON,
     token counts agree across engines (same seeded schedule, greedy —

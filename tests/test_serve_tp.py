@@ -36,9 +36,10 @@ def test_tp2_matrix_and_supervisor_replay_bit_identical():
     )
     assert r.returncode == 0, r.stdout + r.stderr
     out = r.stdout
-    # Every matrix cell pinned, plus the replay drill.
+    # Every matrix cell pinned, plus the spec leg and the replay drill.
     for cell in ("dense/oneshot", "dense/chunked", "paged/oneshot",
-                 "paged/chunked"):
+                 "paged/chunked", "spec/dense", "spec/paged",
+                 "spec/paged-kv8"):
         assert f"serve_tp_check: {cell} ok" in out, out
     assert "supervisor replay ok" in out, out
     assert "serve_tp_check: OK" in out, out

@@ -63,10 +63,21 @@ from tf_operator_tpu.models.transformer import (
 )
 
 __all__ = [
+    "lane_accept_emit",
     "residual_distribution",
     "set_cache_index",
+    "spec_margin",
     "speculative_generate",
 ]
+
+
+def spec_margin(k: int) -> int:
+    """Cache rows one speculative lane may touch BEYOND prompt + steps:
+    up to k rejected draft tokens plus the in-flight pend write. THE
+    budget formula — ``speculative_generate``'s eager check, the
+    continuous engine's ``validate_request``, and serve_lm's margin
+    test all read it from here so they cannot drift."""
+    return k + 1
 
 
 def speculative_generate(
@@ -117,14 +128,14 @@ def speculative_generate(
     k+1 tokens. ``rounds`` is the number of verify forwards the loop
     ran — the acceptance telemetry: tokens/round = num_steps/rounds.
     """
-    if prompt.shape[1] + num_steps + k + 1 > target_cfg.max_seq_len:
+    if prompt.shape[1] + num_steps + spec_margin(k) > target_cfg.max_seq_len:
         raise ValueError(
             f"prompt {prompt.shape[1]} + steps {num_steps} + speculation "
-            f"margin {k + 1} exceeds target max_seq_len "
+            f"margin {spec_margin(k)} exceeds target max_seq_len "
             f"{target_cfg.max_seq_len} (the cache must hold up to k "
             "rejected tokens beyond the emitted sequence)"
         )
-    if prompt.shape[1] + num_steps + k + 1 > draft_cfg.max_seq_len:
+    if prompt.shape[1] + num_steps + spec_margin(k) > draft_cfg.max_seq_len:
         raise ValueError("draft max_seq_len too small for prompt + steps + k")
     if k < 1:
         raise ValueError(f"k={k} must be >= 1")
@@ -325,6 +336,83 @@ def residual_distribution(p: jax.Array, q: jax.Array) -> jax.Array:
     r = jnp.maximum(p - q, 0.0)
     z = jnp.sum(r, axis=-1, keepdims=True)
     return jnp.where(z > 0, r / jnp.where(z > 0, z, 1.0), p)
+
+
+def lane_accept_emit(k: int, tlogits: jax.Array, qlogits: jax.Array,
+                     drafted: jax.Array, pend: jax.Array,
+                     k_acc: jax.Array, k_res: jax.Array,
+                     k_bonus: jax.Array, temperature: jax.Array,
+                     top_p: jax.Array, has_top_p: jax.Array):
+    """ONE lane's accept/emit round: ``round_body`` above at batch 1,
+    with the trace-time sampled/greedy branches turned into traced
+    selects so temperature/top_p stay DATA (the continuous engine vmaps
+    this over its slot axis — serve/engine.py — and slots with
+    different sampling modes ride one executable).
+
+    Inputs are the lane's verify logits ``tlogits`` [k+1, V] (the
+    target's chunk forward over [pend, d_1..d_k]), the draft's
+    per-proposal logits ``qlogits`` [k+1, V], the drafted tokens
+    ``drafted`` [k+1], the incoming pend token, and the round keys the
+    draft pass split off the lane's rng (solo's
+    ``rng, k_draft, k_acc, k_res, k_bonus = split(rng, 5)`` schedule).
+    Every random draw reproduces the solo shapes exactly — uniforms
+    ``(1, k)``, categoricals over ``[1, ..., V]`` — so a lane's stream
+    is BITWISE the b=1 ``speculative_generate`` stream for the same
+    seed (greedy lanes consume the keys into discarded selects, exactly
+    as solo's greedy trace never draws them: the selected VALUES agree).
+
+    Returns ``(toks [k+1], count, nxt_pend)``: the round's token window
+    ``[pend, d_1..d_k]`` of which the first ``count = 1 + m`` are
+    emitted (the incoming pend plus the accepted prefix — positions
+    past the accept cut are dead until the caller's next round), and
+    the pend for the next round (the correction/residual/bonus token,
+    emitted at the head of the NEXT window). This is solo's out-buffer
+    windowing relabeled by one position: solo writes
+    ``[d_1..d_m, nxt_pend]`` after seeding out[0] with the prefill
+    pend; emitting ``[pend, d_1..d_m]`` per round delivers the
+    identical stream with no join-time token delivery."""
+    sampled = temperature > 0
+
+    def scale(logits):
+        # Solo's scale() with the greedy guard: greedy lanes divide by 1
+        # (their sampled branch is discarded by the selects below).
+        s = logits / jnp.where(sampled, temperature, 1.0)
+        from tf_operator_tpu.models.transformer import _nucleus_filter
+
+        return jnp.where(has_top_p, _nucleus_filter(s, top_p), s)
+
+    proposals = drafted[:k].astype(jnp.int32)
+    targmax = tlogits.argmax(-1).astype(jnp.int32)  # [k+1]
+    tl, ql = tlogits[None], qlogits[None]           # solo's b=1 shapes
+    logp = jax.nn.log_softmax(scale(tl[:, :k]))
+    logq = jax.nn.log_softmax(scale(ql[:, :k]))
+    sel = proposals[None, :, None]
+    lp = jnp.take_along_axis(logp, sel, axis=-1)[..., 0]   # [1, k]
+    lq = jnp.take_along_axis(logq, sel, axis=-1)[..., 0]
+    log_u = jnp.log(jax.random.uniform(
+        k_acc, (1, k), minval=1e-38, maxval=1.0
+    ))
+    acc_s = log_u < jnp.minimum(lp - lq, 0.0)              # [1, k]
+    accept = jnp.where(sampled, acc_s[0], proposals == targmax[:k])
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))
+
+    p_all, q_all = jnp.exp(logp), jnp.exp(logq)
+    resample = jax.random.categorical(
+        k_res, jnp.log(residual_distribution(p_all, q_all) + 1e-38)
+    ).astype(jnp.int32)                                    # [1, k]
+    bonus = jax.random.categorical(
+        k_bonus, scale(tl[:, k])
+    )[0].astype(jnp.int32)
+    col = jnp.minimum(m, k - 1)
+    at_m = jnp.take_along_axis(
+        jnp.where(acc_s, proposals[None], resample),
+        jnp.full((1, 1), col), axis=1,
+    )[0, 0]
+    nxt_pend = jnp.where(
+        sampled, jnp.where(m == k, bonus, at_m), targmax[m]
+    ).astype(jnp.int32)
+    toks = jnp.concatenate([pend[None].astype(jnp.int32), proposals])
+    return toks, (1 + m).astype(jnp.int32), nxt_pend
 
 
 def _cache_index(cache: Any) -> jax.Array:

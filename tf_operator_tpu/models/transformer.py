@@ -144,14 +144,6 @@ class TransformerConfig:
                 f"divisor of n_heads={self.n_heads}"
             )
         if self.kv_paged:
-            if self.kv_int8:
-                # The int8 scale sidecars are not pooled (yet): silently
-                # dropping either flag would serve the wrong layout.
-                raise ValueError(
-                    "kv_paged does not compose with kv_int8 (the scale "
-                    "sidecars are not block-pooled; use the dense slot "
-                    "cache for kv-int8 serving)"
-                )
             if self.kv_block < 1:
                 raise ValueError(f"kv_block={self.kv_block} must be >= 1")
             if self.max_seq_len % self.kv_block:
@@ -178,6 +170,18 @@ class TransformerConfig:
     @property
     def use_ring(self) -> bool:
         return self.mesh is not None and self.mesh.shape.get(self.seq_axis, 1) > 1
+
+
+def _kv8_quant(x):
+    """kv_int8's symmetric per-(token, head) quantizer: [.., t, h, dh]
+    -> (int8 values, f32 absmax/127 scales over the dh axis). THE one
+    copy for the dense rows and the paged pool — the paged<->dense
+    bit-exactness contract (and the dense-prefill -> paged-scatter
+    join) requires both storage layouts to produce identical int8 +
+    scale values, so the formula lives once."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    return jnp.round(xf / s[..., None]).astype(jnp.int8), s
 
 
 class Int8Dense(nn.Module):
@@ -445,13 +449,8 @@ class Attention(nn.Module):
             return jnp.zeros_like(q)
         idx = index.value
         if kv8:
-            def quant(x):  # [b, t, h, dh] -> int8 values, [b, t, h] scales
-                xf = x.astype(jnp.float32)
-                s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
-                return jnp.round(xf / s[..., None]).astype(jnp.int8), s
-
-            k, ks = quant(k)
-            v, vs = quant(v)
+            k, ks = _kv8_quant(k)
+            v, vs = _kv8_quant(v)
             k_scale.value = jax.lax.dynamic_update_slice(
                 k_scale.value, ks, (0, idx, 0)
             )
@@ -542,14 +541,37 @@ class Attention(nn.Module):
         b, t, h, dh = q.shape
         kv = k.shape[2]
         g = h // kv
+        kv8 = cfg.kv_int8
         nb, blk = cfg.kv_num_blocks, cfg.kv_block
         table_len = cfg.max_seq_len // blk
         pool_k = self.variable(
-            "cache", "pool_key", jnp.zeros, (nb, blk, kv, dh), cfg.dtype
+            "cache", "pool_key", jnp.zeros, (nb, blk, kv, dh),
+            jnp.int8 if kv8 else cfg.dtype,
         )
         pool_v = self.variable(
-            "cache", "pool_value", jnp.zeros, (nb, blk, kv, dh), cfg.dtype
+            "cache", "pool_value", jnp.zeros, (nb, blk, kv, dh),
+            jnp.int8 if kv8 else cfg.dtype,
         )
+        if kv8:
+            # cfg.kv_int8 in the POOLED layout: the per-(token, head) f32
+            # scales live as per-block sidecar pools [nb, blk, KV] riding
+            # the same block tables — scatter, gather, copy-on-write, and
+            # sharding all address them through the identical
+            # table[pos // B] * B + pos % B row math as the int8 K/V
+            # blocks (serve/kvcache.py POOL_KEYS). The attention math
+            # below is EXACTLY the dense kv8 factoring (_decode_attend):
+            # scores consume raw int8 keys rescaled on the score tensor,
+            # the value scale folds into the probabilities — so paged-kv8
+            # decode is bit-identical to dense-kv8 (pinned by
+            # tests/test_kvcache_paged.py).
+            pool_ks = self.variable(
+                "cache", "pool_key_scale",
+                jnp.zeros, (nb, blk, kv), jnp.float32,
+            )
+            pool_vs = self.variable(
+                "cache", "pool_value_scale",
+                jnp.zeros, (nb, blk, kv), jnp.float32,
+            )
         table = self.variable(
             "cache", "block_table", jnp.zeros, (b, table_len), jnp.int32
         )
@@ -559,7 +581,13 @@ class Attention(nn.Module):
         if self.is_initializing():
             return jnp.zeros_like(q)
         idx = index.value  # [b]
-        k, v = k.astype(cfg.dtype), v.astype(cfg.dtype)
+        if kv8:
+            # The shared quantizer: identical int8 values + scales land
+            # in the pool as land in the dense rows.
+            k, ks = _kv8_quant(k)
+            v, vs = _kv8_quant(v)
+        else:
+            k, v = k.astype(cfg.dtype), v.astype(cfg.dtype)
         pos = idx[:, None] + jnp.arange(t)[None, :]  # [b, t] absolute
         entry = jnp.clip(pos // blk, 0, table_len - 1)
         blocks = jnp.take_along_axis(table.value, entry, axis=1)
@@ -574,6 +602,14 @@ class Attention(nn.Module):
         pool_v.value = pool_v.value.reshape(shape2).at[flat].set(
             v, mode="drop"
         ).reshape(nb, blk, kv, dh)
+        if kv8:
+            shape2s = (nb * blk, kv)
+            pool_ks.value = pool_ks.value.reshape(shape2s).at[flat].set(
+                ks, mode="drop"
+            ).reshape(nb, blk, kv)
+            pool_vs.value = pool_vs.value.reshape(shape2s).at[flat].set(
+                vs, mode="drop"
+            ).reshape(nb, blk, kv)
         index.value = idx + t
         keys = pool_k.value[table.value].reshape(
             b, cfg.max_seq_len, kv, dh
@@ -581,6 +617,16 @@ class Attention(nn.Module):
         vals = pool_v.value[table.value].reshape(
             b, cfg.max_seq_len, kv, dh
         )
+        if kv8:
+            # Same cast the dense path applies to its int8 cache before
+            # the score dot (exact in bf16: |k8| <= 127).
+            keys = keys.astype(jnp.bfloat16)
+            k_scales = pool_ks.value[table.value].reshape(
+                b, cfg.max_seq_len, kv
+            )
+            v_scales = pool_vs.value[table.value].reshape(
+                b, cfg.max_seq_len, kv
+            )
         tp = (
             cfg.mesh.shape.get(cfg.tp_axis, 1)
             if cfg.mesh is not None else 1
@@ -601,11 +647,23 @@ class Attention(nn.Module):
             )
             keys = _pin(keys, hspec)
             vals = _pin(vals, hspec)
+            if kv8:
+                # The gathered scale rows ride their head shard.
+                sspec = jax.sharding.PartitionSpec(
+                    None, None, cfg.tp_axis
+                )
+                k_scales = _pin(k_scales, sspec)
+                v_scales = _pin(v_scales, sspec)
         qg = q.reshape(b, t, kv, g, dh)
         s = jnp.einsum(
             "bqkgd,bskd->bkgqs", qg, keys,
             preferred_element_type=jnp.float32,
         )
+        if kv8:
+            # scores[b,k,g,i,j] = (q . k8)[...] * ks[b,j,k] — the dense
+            # kv8 factoring, scale applied in the same order so the
+            # paged scores are bitwise the dense scores.
+            s = s * k_scales.transpose(0, 2, 1)[:, :, None, None, :]
         if tp > 1 and kv % tp == 0:
             s = _pin(s, jax.sharding.PartitionSpec(
                 None, cfg.tp_axis, None, None, None
@@ -617,6 +675,9 @@ class Attention(nn.Module):
         )  # [b, t, S]
         s = jnp.where(valid[:, None, None, :, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        if kv8:
+            # Fold the value scale into the probabilities (same factoring).
+            p = p * v_scales.transpose(0, 2, 1)[:, :, None, None, :]
         out = jnp.einsum("bkgqs,bskd->bqkgd", p, vals.astype(jnp.float32))
         return out.reshape(b, t, h, dh).astype(cfg.dtype)
 

@@ -141,6 +141,11 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # the cap stays 1024 on the single-shot data; the qblock stage remains
 # at the front of window_autorun's unmeasured set for the next
 # hardware window.
+# Re-checked (PR 15, 2026-08-04): unchanged — window_r05 is still the
+# newest window (no carrier newer than its two stamps) and no
+# probe_qblock arbitration output has landed anywhere under
+# docs/window_r05/. Trigger stays OPEN; cap stays 1024; the qblock
+# stage keeps its front slot in window_autorun's unmeasured set.
 MAX_Q_BLOCK = 1024
 
 

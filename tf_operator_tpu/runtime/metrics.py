@@ -533,6 +533,21 @@ SERVE_SHIP_INGEST_TOTAL = REGISTRY.counter(
     "local-prefill fallback)",
     ("outcome",),
 )
+SERVE_SPEC_ACCEPT_TOKENS = REGISTRY.histogram(
+    "tpu_serve_spec_accept_tokens",
+    "Tokens emitted per slot per speculative round (the incoming pend "
+    "token plus the accepted draft prefix, 1..k+1) — the distribution "
+    "behind the engine's accept rate: mean/(k+1) near 1 means the draft "
+    "is riding, near 1/(k+1) means every round falls back to one token",
+    buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 12.0, 16.0),
+)
+SERVE_SPEC_ROUNDS_TOTAL = REGISTRY.counter(
+    "tpu_serve_spec_rounds_total",
+    "Speculative decode rounds executed (one per-slot draft of k tokens "
+    "+ one batched k+1-position verify forward each) — tokens/round = "
+    "tpu_serve_generated_tokens_total over this counter while the spec "
+    "engine serves",
+)
 SERVE_SHIP_TOKENS_TOTAL = REGISTRY.counter(
     "tpu_serve_ship_tokens_total",
     "Prompt tokens whose K/V arrived as shipped block-pool rows from a "

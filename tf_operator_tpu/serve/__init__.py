@@ -10,6 +10,10 @@ requests mid-stream.
   position/length/rng — sampled requests batch too, and occupancy
   changes, block-table growth, and CoW copies never recompile.
   Admission is planned: "free slot AND enough free blocks".
+  ``spec_k >= 1`` turns each iteration into a batch-wide SPECULATIVE
+  round (one draft executable + one batched verify, per-slot accept
+  counters — slots advance different amounts; composes with kv-int8
+  in both layouts and with the tp mesh).
 - ``scheduler``: the serving loop — token-budgeted chunked prefill
   interleaved with decode, admission into free slots, EOS/max-tokens
   retirement, and the SIGTERM drain (in-flight finishes, queued 503s).

@@ -77,6 +77,26 @@ tp=1. Greedy output stays bit-identical to solo ``generate`` with the
 same tp-sharded params on an f32 CPU mesh (tests/test_serve_tp.py, via
 the ``--xla_force_host_platform_device_count`` trick).
 
+Batch-wide SPECULATIVE decode (``spec_k >= 1``): every decode iteration
+becomes one ROUND — a per-slot draft of k tokens (ONE compiled
+executable: the solo draft scan vmapped over slots, sampling params and
+per-lane rng as data) plus ONE batched k+1-position verify of the
+target over each lane's [pend, d_1..d_k] chunk, with the vmapped
+accept/emit body from models/spec_decode.lane_accept_emit. Per-slot
+accept counters are DATA: slots advance different numbers of tokens per
+round (the per-lane counters the paged tables already carry), rejected
+drafts just rewind the lane's position counter (stale K/V masked, then
+overwritten by the next round's chunk), and the admission plan reserves
+the k+1-row speculation margin so speculative writes always land in
+owned blocks — CoW still runs ahead of the round, so they can never
+touch a shared partial block. Greedy output is bit-identical to solo
+``speculative_generate`` (hence to plain ``generate`` and to this
+engine's own plain mode); sampled lanes carry the solo split-per-round
+rng chain and reproduce the b=1 solo spec stream bitwise per seed.
+``decode_step_compiles`` counts BOTH round executables; the
+zero-recompile pin covers occupancy AND accept-length variation, at
+tp=1 and tp>1 (the draft's params/cache shard by the same rules).
+
 Thread model: the engine is a device-state machine with NO internal
 locking — the serving loop (serve/scheduler.py) is its single caller;
 tests drive it directly for the deterministic exactness matrix. (The
@@ -97,6 +117,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# The solo speculative machinery IS the engine's per-lane machinery:
+# _cache_index finds the (per-lane, here) position counters the rewind
+# rewrites via set_cache_index — one copy of each walk, so the rollback
+# contract cannot drift between solo and batch-wide speculation.
+from tf_operator_tpu.models.spec_decode import (
+    _cache_index as _spec_cache_index,
+)
 from tf_operator_tpu.models.transformer import (
     ChunkedPrefill,
     Transformer,
@@ -114,6 +141,8 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_PHASE_SECONDS,
     SERVE_PREFILL_SAVED_TOTAL,
     SERVE_SHIP_TOKENS_TOTAL,
+    SERVE_SPEC_ACCEPT_TOKENS,
+    SERVE_SPEC_ROUNDS_TOTAL,
 )
 from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
@@ -231,9 +260,46 @@ class ContinuousEngine:
                  kv_paged: bool = True, kv_block: int = 64,
                  kv_blocks: int | None = None,
                  faults: Any = None, mesh: Any = None,
-                 tp_axis: str = "tp") -> None:
+                 tp_axis: str = "tp", spec_k: int = 0,
+                 draft_cfg: TransformerConfig | None = None,
+                 draft_params: Any = None) -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        # Batch-wide speculative decode (spec_k >= 1): every decode
+        # iteration runs a per-slot DRAFT of k tokens plus ONE batched
+        # k+1-position verify against the target, and slots advance
+        # DIFFERENT numbers of tokens per round (per-slot accept
+        # counters are data — see spec_step). The draft model rides a
+        # dense stacked cache of its own; the k+1 speculation margin
+        # (spec_decode.spec_margin) joins the admission budget.
+        self.spec_k = int(spec_k or 0)
+        if self.spec_k:
+            from tf_operator_tpu.models.spec_decode import spec_margin
+
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k={self.spec_k} must be >= 1")
+            if draft_cfg is None or draft_params is None:
+                raise ValueError(
+                    "spec_k needs draft_cfg and draft_params (the draft "
+                    "model that proposes k tokens per round)"
+                )
+            for name, c in (("target", cfg), ("draft", draft_cfg)):
+                if c.int8_decode:
+                    raise ValueError(
+                        f"{name} cfg.int8_decode is not supported by "
+                        "speculative decoding (same contract as solo "
+                        "speculative_generate)"
+                    )
+            if draft_cfg.max_seq_len < cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} < target "
+                    f"max_seq_len {cfg.max_seq_len}: the draft cache "
+                    "must hold every position the target budget admits"
+                )
+            self._spec_margin = spec_margin(self.spec_k)
+        else:
+            self._spec_margin = 0
+        self.draft_cfg = draft_cfg
         # Armed only AFTER warmup (below): the constructor's own steps
         # must not consume positional fault hits — chaos specs count
         # SERVING invocations.
@@ -264,10 +330,25 @@ class ContinuousEngine:
             # Idempotent for already-sharded params (device_put to the
             # same sharding is a no-op) — serve_lm shards once up front;
             # a supervisor rebuild re-places through here either way.
+            # int8_decode trees REPLICATE outright: the dequant-in-VMEM
+            # pallas kernel has no SPMD partitioning rule, so sharded
+            # int8 operands could not partition on TPU — tp still
+            # divides the KV storage (the long-context read), and the
+            # weight read stays whole per chip.
             params = shard_params_by_rules(
-                mesh, params, param_sharding_rules(tp_axis)
+                mesh, params,
+                {} if cfg.int8_decode else param_sharding_rules(tp_axis),
             )
+            if self.spec_k:
+                # The draft rides the same rules: head-sharded where its
+                # shapes tile the tp axis, replicated where they don't
+                # (a small draft replicating outright is the documented
+                # fallback — placement is never a correctness gate).
+                draft_params = shard_params_by_rules(
+                    mesh, draft_params, param_sharding_rules(tp_axis)
+                )
         self.params = params
+        self._draft_params = draft_params
         SERVE_MESH_DEVICES.set(
             int(mesh.devices.size) if mesh is not None else 1
         )
@@ -365,20 +446,79 @@ class ContinuousEngine:
         if self.mesh is not None:
             step_impl = self._constrained_step(step_impl)
         self._step_fn = jax.jit(step_impl, donate_argnums=(1, 2))
+        if self.spec_k:
+            self._init_spec(draft_cfg)
         self.steps_total = 0
-        # Warm the decode executable at CONSTRUCTION, twice: the first
+        # Warm the decode executable(s) at CONSTRUCTION, twice: the first
         # step compiles; the second catches XLA's donated-buffer layout
         # flip (the step's chosen output layout can differ from the
         # eagerly-built input layout, costing exactly one more compile at
         # larger widths) so serving traffic never sees a compile. All
         # slots are inactive — dense: the garbage rows these steps write
         # are fully overwritten by each join's insert; paged: index-0
-        # lanes' writes are dropped outright.
+        # lanes' writes are dropped outright. Spec engines warm BOTH the
+        # draft and verify executables through the same two rounds.
         for _ in range(2):
-            self.step()
+            self.spec_step() if self.spec_k else self.step()
         self.steps_total = 0
         self.warmup_compiles = self.decode_step_compiles
         self.faults = faults or NULL_INJECTOR
+
+    # -- batch-wide speculative decode ------------------------------------
+
+    def _init_spec(self, draft_cfg: TransformerConfig) -> None:
+        """Build the speculative-decode state: the draft model over a
+        dense stacked cache of its own, the per-slot pend/rng vectors,
+        and the TWO compiled round executables (one draft, one verify)
+        whose shapes are static in (max_slots, k) — accept counts are
+        data, so occupancy and accept-length variation never recompile
+        (the same contract as the plain decode step, pinned via
+        ``decode_step_compiles``)."""
+        n = self.max_slots
+        ddcfg = replace(draft_cfg, decode=True, mesh=None, remat=False,
+                        kv_paged=False)
+        self._draft_model = Transformer(ddcfg)
+        self._draft_cache = stack_slots(
+            solo_cache_template(self._draft_model), n,
+            mesh=self.mesh, tp_axis=self.tp_axis,
+        )
+        if self.mesh is not None:
+            self._draft_specs = cache_specs(self._draft_cache, self._tp,
+                                            self.tp_axis)
+            mesh, dspecs = self.mesh, self._draft_specs
+            draft_constraint = lambda t: constrain_tree(mesh, t, dspecs)
+        else:
+            self._draft_specs = None
+            draft_constraint = None
+        self._draft_insert = make_insert_fn(constraint=draft_constraint)
+        self._draft_prefill_fn = jax.jit(
+            functools.partial(_prefill, self._draft_model)
+        )
+        # Chunked-prefill engines bucket the DRAFT's prompt prefill
+        # through the same fixed-chunk executables as the target's
+        # (ChunkedPrefill below): a full-length draft jit would compile
+        # per novel prompt length at join — the exact compile storm the
+        # chunked machinery exists to prevent. One-shot engines keep
+        # the per-shape jit, matching the target's own behavior.
+        self._draft_pf_cfg = replace(draft_cfg, mesh=None, remat=False,
+                                     kv_paged=False)
+        # Per-slot round state: the pending token (sampled at join from
+        # the prefill logits, then by each round's accept/emit) and the
+        # lane's rng chain (solo speculative_generate's exact
+        # split-per-round schedule — round count is data, so the chain
+        # lives as state rather than a precomputed ladder).
+        self._pend = self._replicate(jnp.zeros((n,), jnp.int32))
+        self._spec_rng = self._replicate(jnp.zeros((n, 2), jnp.uint32))
+        draft_impl = self._spec_draft_impl
+        verify_impl = self._spec_verify_impl
+        if self.mesh is not None:
+            draft_impl = self._constrained_spec_draft(draft_impl)
+            verify_impl = self._constrained_spec_verify(verify_impl)
+        self._draft_fn = jax.jit(draft_impl, donate_argnums=(1,))
+        self._verify_fn = jax.jit(verify_impl, donate_argnums=(1, 2))
+        self.spec_rounds_total = 0       # batched draft+verify rounds
+        self.spec_lane_rounds_total = 0  # (active slot, round) pairs
+        self.spec_tokens_total = 0       # emitted tokens across lanes
 
     # -- mesh placement ---------------------------------------------------
 
@@ -473,23 +613,36 @@ class ContinuousEngine:
             raise ValueError(f"num_steps={num_steps} must be >= 1")
         if prompt_len < 1:
             raise ValueError("prompt must have at least one token")
-        if prompt_len + num_steps > self.cfg.max_seq_len:
+        margin = self._spec_margin
+        if prompt_len + num_steps + margin > self.cfg.max_seq_len:
+            with_margin = (
+                f" + speculation margin {margin}" if margin else ""
+            )
             raise ValueError(
-                f"prompt {prompt_len} + steps {num_steps} exceeds "
-                f"max_seq_len {self.cfg.max_seq_len}"
+                f"prompt {prompt_len} + steps {num_steps}{with_margin} "
+                f"exceeds max_seq_len {self.cfg.max_seq_len}"
             )
         if self.prefill_chunk is not None:
             _validate_prefill_chunk(
                 self.cfg, prompt_len, self.prefill_chunk
             )
         if self.kv_paged:
-            cap = -(-(prompt_len + num_steps) // self.kv_block)
+            cap = self._block_cap(prompt_len, num_steps)
             if cap > self.kv_blocks - 1:
                 raise ValueError(
                     f"prompt {prompt_len} + steps {num_steps} needs "
                     f"{cap} KV blocks of {self.kv_block}; the pool has "
                     f"only {self.kv_blocks - 1} allocatable"
                 )
+
+    def _block_cap(self, prompt_len: int, num_steps: int) -> int:
+        """Table entries one admission reserves: prompt + decode horizon
+        plus (speculative engines) the k+1 rejected-write margin —
+        reserving the margin keeps every speculative write in blocks
+        the slot owns, so a rejected draft can never scribble a block
+        another lane might be allocated meanwhile."""
+        return -(-(prompt_len + num_steps + self._spec_margin)
+                 // self.kv_block)
 
     def plan_admission(self, tokens, num_steps: int) -> AdmissionPlan | None:
         """Reserve capacity for one request, or return None (the caller
@@ -510,7 +663,7 @@ class ContinuousEngine:
         if not self.kv_paged:
             return AdmissionPlan(tokens, L, M)
         B = self.kv_block
-        cap = -(-(L + M) // B)
+        cap = self._block_cap(L, M)
         n, shared, logits = self.prefix.lookup(tokens[0])
         shared_entries = -(-n // B)
         cow_needed = n == L and n % B != 0
@@ -582,6 +735,11 @@ class ContinuousEngine:
         ingests (pinned in tests/test_serve_disagg.py)."""
         if not self.kv_paged:
             return None
+        if self.cfg.kv_int8:
+            raise ValueError(
+                "shipped-KV ingest does not support kv-int8 pools (the "
+                "wire format carries no scale sidecars); prefill locally"
+            )
         if int(shp.kv_block) != self.kv_block:
             raise ValueError(
                 f"shipment kv_block={shp.kv_block} != engine "
@@ -785,7 +943,7 @@ class ContinuousEngine:
             return self.join_prefilled(
                 cache, logits, prompt_len=plan.prompt_len,
                 num_steps=plan.num_steps, temperature=temperature,
-                top_p=top_p, seed=seed,
+                top_p=top_p, seed=seed, prompt=plan.tokens,
             )
         return self._join_paged(
             plan, cache, logits, temperature=temperature, top_p=top_p,
@@ -807,7 +965,10 @@ class ContinuousEngine:
                 "top_p requires temperature > 0 (greedy ignores it)"
             )
         keys = np.zeros((self.cfg.max_seq_len, 2), np.uint32)
-        if temperature > 0:
+        if temperature > 0 and not self.spec_k:
+            # Plain-mode ladder only: speculative lanes carry the solo
+            # split-per-round rng CHAIN instead (_join_spec_state) —
+            # round count is data, so no fixed ladder exists.
             keys[:num_steps] = np.asarray(
                 jax.random.split(jax.random.PRNGKey(seed), num_steps)
             )
@@ -820,16 +981,24 @@ class ContinuousEngine:
                        prompt_len: int, num_steps: int,
                        temperature: float = 0.0,
                        top_p: float | None = None,
-                       seed: int = 0) -> int | None:
+                       seed: int = 0,
+                       prompt: Any = None) -> int | None:
         """Insert a finished solo prefill into a free slot (DENSE layout
         — paged admissions go through the planned API, which knows which
         blocks the rows land in). The slot's first generated token comes
         from ``logits`` (the last prompt position) at the next ``step``
-        — exactly the solo recurrence."""
+        — exactly the solo recurrence. Speculative engines also need
+        ``prompt`` (the [1, L] tokens): the draft lane prefills the
+        whole prompt itself."""
         if self.kv_paged:
             raise RuntimeError(
                 "paged engines admit via plan_admission/join_planned "
                 "(the insert needs the plan's block tables)"
+            )
+        if self.spec_k and prompt is None:
+            raise ValueError(
+                "speculative engines need prompt= at join_prefilled "
+                "(the draft lane prefills the prompt itself)"
             )
         self.validate_request(prompt_len, num_steps)
         slot = self.alloc.acquire()
@@ -846,6 +1015,11 @@ class ContinuousEngine:
         state = self._insert_slot(state, slot, plain_tree(cache), logits,
                                   keys)
         self._cache, self._logits, self._keys, self._stepidx = state
+        if self.spec_k:
+            self._join_spec_state(
+                slot, prompt, jnp.asarray(logits).reshape(-1),
+                temperature=temperature, top_p=top_p, seed=seed,
+            )
         self._active[slot] = True
         return slot
 
@@ -908,6 +1082,15 @@ class ContinuousEngine:
         if plan.shared_tokens:
             self.prefill_tokens_saved += plan.shared_tokens
             SERVE_PREFILL_SAVED_TOTAL.inc(plan.shared_tokens)
+        if self.spec_k:
+            # The draft lane prefills the WHOLE prompt even when the
+            # target's prefill was shared/shipped/skipped — the draft
+            # cache is per-slot dense state with nothing to share; the
+            # prefix-cache saving remains a pure target-side win.
+            self._join_spec_state(
+                slot, plan.tokens, row,
+                temperature=temperature, top_p=top_p, seed=seed,
+            )
         self._set_block_gauges()
         return slot
 
@@ -999,10 +1182,255 @@ class ContinuousEngine:
             SERVE_KV_COW_TOTAL.inc()
             self._set_block_gauges()
 
+    def _spec_draft_impl(self, dparams, dcache, pend, rng, active,
+                         temperature, top_p, has_top_p):
+        """The DRAFT round executable: per lane, split the rng (solo's
+        ``rng, k_draft, k_acc, k_res, k_bonus = split(rng, 5)``
+        schedule) and scan k+1 draft steps from the pending token — the
+        vmapped solo draft scan, so each lane's proposals are bitwise
+        the b=1 solo stream. Returns the advanced draft cache, the
+        pre-round per-lane draft indices (the verify pass rewinds from
+        them), the drafted tokens/logits, and the round keys."""
+        k = self.spec_k
+        dcache = mask_inactive_indices(dcache, active)
+        d_idx = _spec_cache_index(dcache)  # [n] per-lane, post-mask
+        dmodel = self._draft_model
+
+        def one(dc1, pend1, rng1, temp, tp, has_tp):
+            rng1, k_draft, k_acc, k_res, k_bonus = jax.random.split(
+                rng1, 5
+            )
+
+            def dstep(carry, step_key):
+                dc, tok = carry
+                logits, upd = dmodel.apply(
+                    {"params": dparams, "cache": dc}, tok[None, None],
+                    mutable=["cache"],
+                )
+                logits = logits[0, 0]
+                nxt = _sample_token(logits, step_key, temp, tp, has_tp)
+                return (upd["cache"], nxt), (nxt, logits)
+
+            (dc1, _), (drafted, qlogits) = jax.lax.scan(
+                dstep, (dc1, pend1), jax.random.split(k_draft, k + 1)
+            )
+            return dc1, drafted, qlogits, rng1, k_acc, k_res, k_bonus
+
+        (dcache, drafted, qlogits, rng, k_acc, k_res, k_bonus) = jax.vmap(
+            one
+        )(dcache, pend, rng, temperature, top_p, has_top_p)
+        return (plain_tree(dcache), d_idx, drafted, qlogits, rng,
+                k_acc, k_res, k_bonus)
+
+    def _spec_verify_impl(self, params, cache, dcache, pend, drafted,
+                          qlogits, k_acc, k_res, k_bonus, d_idx, active,
+                          temperature, top_p, has_top_p):
+        """The VERIFY round executable: ONE batched k+1-position chunk
+        forward of the target over [pend, d_1..d_k] per lane (paged:
+        the per-lane-counter multi-token attend; dense: the vmapped
+        solo chunk forward), the vmapped per-lane accept/emit body
+        (spec_decode.lane_accept_emit), and the per-lane REWIND of both
+        caches to idx + 1 + m — accept counts are data, so lanes
+        advancing different amounts never change a shape."""
+        k = self.spec_k
+        cache = mask_inactive_indices(cache, active)
+        t_idx = _spec_cache_index(cache)  # [n] per-lane, post-mask
+        chunk = jnp.concatenate(
+            [pend[:, None], drafted[:, :k].astype(jnp.int32)], axis=1
+        )
+        if self.kv_paged:
+            tlogits, upd = self._model.apply(
+                {"params": params, "cache": cache}, chunk,
+                mutable=["cache"],
+            )
+            cache = plain_tree(upd["cache"])
+        else:
+            def one(c1, chunk1):
+                lg, upd = self._model.apply(
+                    {"params": params, "cache": c1}, chunk1[None],
+                    mutable=["cache"],
+                )
+                return upd["cache"], lg[0]
+
+            cache, tlogits = jax.vmap(one)(cache, chunk)
+            cache = plain_tree(cache)
+        from tf_operator_tpu.models.spec_decode import lane_accept_emit
+
+        toks, counts, nxt_pend = jax.vmap(
+            functools.partial(lane_accept_emit, k)
+        )(tlogits, qlogits, drafted, pend, k_acc, k_res, k_bonus,
+          temperature, top_p, has_top_p)
+        counts = jnp.where(active, counts, 0)
+        # The batch-wide REWIND: set_cache_index per lane (the solo
+        # rollback — its walk broadcasts the [n] vector across every
+        # counter leaf, all of which are [n] in engine layouts), so
+        # rejected positions go invisible to the masked attention and
+        # the next round's chunk overwrites them.
+        cache = set_cache_index(
+            cache, jnp.where(active, t_idx + counts, 0)
+        )
+        dcache = set_cache_index(
+            dcache, jnp.where(active, d_idx + counts, 0)
+        )
+        nxt_pend = jnp.where(active, nxt_pend, pend)
+        return cache, dcache, nxt_pend, toks, counts
+
+    def _constrained_spec_draft(self, inner):
+        """Mesh wrapper: pin the draft executable's outputs (draft cache
+        per its specs, the per-lane vectors replicated) so donated
+        buffers round-trip identically — the spec twin of
+        ``_constrained_step``."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh, specs = self.mesh, self._draft_specs
+        rep = NamedSharding(mesh, P())
+
+        def fn(dparams, dcache, pend, rng, active, temperature, top_p,
+               has_top_p):
+            (dcache, d_idx, drafted, qlogits, rng, k_acc, k_res,
+             k_bonus) = inner(dparams, dcache, pend, rng, active,
+                              temperature, top_p, has_top_p)
+            dcache = constrain_tree(mesh, dcache, specs)
+            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
+            return (dcache, pin(d_idx), pin(drafted), pin(qlogits),
+                    pin(rng), pin(k_acc), pin(k_res), pin(k_bonus))
+
+        return fn
+
+    def _constrained_spec_verify(self, inner):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        tspecs, dspecs = self._cache_specs, self._draft_specs
+        rep = NamedSharding(mesh, P())
+
+        def fn(params, cache, dcache, pend, drafted, qlogits, k_acc,
+               k_res, k_bonus, d_idx, active, temperature, top_p,
+               has_top_p):
+            cache, dcache, nxt_pend, toks, counts = inner(
+                params, cache, dcache, pend, drafted, qlogits, k_acc,
+                k_res, k_bonus, d_idx, active, temperature, top_p,
+                has_top_p,
+            )
+            cache = constrain_tree(mesh, cache, tspecs)
+            dcache = constrain_tree(mesh, dcache, dspecs)
+            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
+            return cache, dcache, pin(nxt_pend), pin(toks), pin(counts)
+
+        return fn
+
+    def spec_step(self) -> tuple[np.ndarray, np.ndarray]:
+        """One speculative ROUND over all slots: draft k+1 tokens per
+        lane, verify the k+1 chunk in one batched target forward,
+        accept per lane, rewind per lane. Returns ``(toks, counts)`` —
+        toks [max_slots, k+1] int32 where row i's first counts[i]
+        entries are slot i's newly-emitted tokens this round (the
+        incoming pend plus its accepted prefix; 1 <= counts <= k+1 for
+        active lanes, 0 inactive). The caller trims to each request's
+        remaining budget, exactly like solo's out-buffer trim."""
+        if self.faults.fire("step_raise") is not None:
+            raise InjectedFault("step_raise")
+        self.faults.maybe_sleep("step_stall", default=1.0)
+        if self.kv_paged:
+            self._run_pending_cows()
+        active = jnp.asarray(self._active)
+        temp = jnp.asarray(self._temperature)
+        top_p = jnp.asarray(self._top_p)
+        has_tp = jnp.asarray(self._has_top_p)
+        (self._draft_cache, d_idx, drafted, qlogits, self._spec_rng,
+         k_acc, k_res, k_bonus) = self._draft_fn(
+            self._draft_params, self._draft_cache, self._pend,
+            self._spec_rng, active, temp, top_p, has_tp,
+        )
+        (self._cache, self._draft_cache, self._pend, toks,
+         counts) = self._verify_fn(
+            self.params, self._cache, self._draft_cache, self._pend,
+            drafted, qlogits, k_acc, k_res, k_bonus, d_idx, active,
+            temp, top_p, has_tp,
+        )
+        self.steps_total += 1
+        counts_np = np.asarray(counts)
+        if self._active.any():
+            self.spec_rounds_total += 1
+            SERVE_SPEC_ROUNDS_TOTAL.inc()
+            emitted = counts_np[self._active]
+            self.spec_lane_rounds_total += len(emitted)
+            self.spec_tokens_total += int(emitted.sum())
+            for c in emitted:
+                SERVE_SPEC_ACCEPT_TOKENS.observe(float(c))
+        return np.asarray(toks), counts_np
+
+    def _join_spec_state(self, slot: int, tokens: np.ndarray,
+                         logits_row: Any, *, temperature: float,
+                         top_p: float | None, seed: int) -> None:
+        """Seed one slot's speculative state at join: draft-prefill the
+        WHOLE prompt into the slot's draft lane (the draft cache shares
+        nothing — an exact-prefix or shipped join skips only the
+        TARGET's prefill), then the first pend token exactly as solo
+        speculative_generate draws it after prefill: sampled lanes
+        split PRNGKey(seed) and draw categorical from the tempered
+        (and nucleus-filtered) logits; greedy lanes take the argmax
+        and never consume their rng."""
+        if self.prefill_chunk is not None:
+            # Fixed-chunk executables (bit-identical to one-shot — the
+            # chunked-prefill pin); any prompt length compiles nothing.
+            pf = ChunkedPrefill(self._draft_pf_cfg, self._draft_params,
+                                jnp.asarray(tokens), self.prefill_chunk)
+            pf.feed(pf.n_chunks)
+            dc, _ = pf.result()
+        else:
+            dc, _ = self._draft_prefill_fn(
+                self._draft_params, jnp.asarray(tokens)
+            )
+        self._draft_cache = self._draft_insert(
+            self._draft_cache, jnp.int32(slot), plain_tree(dc)
+        )
+        row = jnp.asarray(logits_row).reshape(1, -1)  # solo's [1, V]
+        if temperature > 0:
+            rng, k0 = jax.random.split(jax.random.PRNGKey(seed))
+            scaled = row / temperature
+            if top_p is not None:
+                scaled = _nucleus_filter(scaled, top_p)
+            pend = jax.random.categorical(k0, scaled)[0]
+        else:
+            rng = jax.random.PRNGKey(0)  # carried, never consumed
+            pend = row[0].argmax(-1)
+        self._pend = self._replicate(
+            self._pend.at[slot].set(jnp.asarray(pend, jnp.int32))
+        )
+        self._spec_rng = self._replicate(
+            self._spec_rng.at[slot].set(rng)
+        )
+
+    def spec_debug(self) -> dict:
+        """Speculation telemetry for /debug/serve: emission stats and
+        the derived accept rate — accepted draft tokens over drafted,
+        ``(tokens per LANE-round - 1) / k`` (a lane-round is one slot
+        riding one batched round; each emits 1 + accepted tokens)."""
+        lanes = self.spec_lane_rounds_total
+        tpr = (self.spec_tokens_total / lanes) if lanes else 0.0
+        return {
+            "k": self.spec_k,
+            "rounds": self.spec_rounds_total,
+            "lane_rounds": lanes,
+            "tokens": self.spec_tokens_total,
+            "tokens_per_lane_round": round(tpr, 3),
+            "accept_rate": round(
+                max(0.0, tpr - 1.0) / self.spec_k, 4
+            ) if lanes else 0.0,
+        }
+
     def step(self) -> np.ndarray:
         """One decode iteration over ALL slots: every active slot
         advances one token. Returns the [max_slots] int32 token vector
         (inactive rows are dead compute — ignore them)."""
+        if self.spec_k:
+            raise RuntimeError(
+                "speculative engines decode via spec_step() (rounds "
+                "emit between 1 and k+1 tokens per slot)"
+            )
         if self.faults.fire("step_raise") is not None:
             raise InjectedFault("step_raise")
         self.faults.maybe_sleep("step_stall", default=1.0)
@@ -1099,5 +1527,11 @@ class ContinuousEngine:
         """Compiled-executable count of the decode step — the
         zero-recompile pin: after the constructor's warmup this must
         never grow across occupancy changes, block-table growth, or CoW
-        copies (tests assert == warmup_compiles)."""
+        copies (tests assert == warmup_compiles). Speculative engines
+        count BOTH round executables (one draft + one verify): accept
+        counts are data, so occupancy AND accept-length variation must
+        never add a third."""
+        if self.spec_k:
+            return (self._draft_fn._cache_size()
+                    + self._verify_fn._cache_size())
         return self._step_fn._cache_size()

@@ -66,8 +66,18 @@ import jax.numpy as jnp
 # lockstep — see transformer.set_cache_index, which owns that contract).
 INDEX_KEYS = ("cache_index", "pos_index")
 
-# Paged pool leaf -> the dense/solo leaf holding the same rows.
-POOL_KEYS = {"pool_key": "cached_key", "pool_value": "cached_value"}
+# Paged pool leaf -> the dense/solo leaf holding the same rows. The
+# kv-int8 scale sidecars (f32 [nb, blk, KV] per-block pools riding the
+# same block tables — present only when cfg.kv_int8) address their rows
+# through the IDENTICAL table[pos // B] * B + pos % B math as the K/V
+# blocks, so one generic walk serves scatter, gather, and copy-on-write
+# for all four leaves.
+POOL_KEYS = {
+    "pool_key": "cached_key",
+    "pool_value": "cached_value",
+    "pool_key_scale": "key_scale",
+    "pool_value_scale": "value_scale",
+}
 
 
 def plain_tree(tree: Any) -> Any:
@@ -275,7 +285,10 @@ def make_pool_write_fn(num_blocks: int, block: int, constraint=None):
                 return p
             out = {}
             for name, leaf in p.items():
-                if name in POOL_KEYS:
+                # K/V rows only: the wire format carries no kv-int8
+                # scale sidecars (the engine rejects shipped-KV ingest
+                # on kv8 pools before this executable is ever built).
+                if name in ("pool_key", "pool_value"):
                     r = rows["/".join(path)][
                         "key" if name == "pool_key" else "value"
                     ]  # [S, KV, Dh]

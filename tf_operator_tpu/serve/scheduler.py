@@ -991,11 +991,20 @@ class ContinuousScheduler:
                     else "")
                 for s, _ in flushed
             }
-        for s, (start, last, steps) in flushed:
+        spec = getattr(self.engine, "spec_k", 0)
+        for s, (start, last, steps, rounds) in flushed:
             attrs: dict[str, Any] = {
                 "request_id": owners.get(s, ""), "slot": s,
                 "tokens": steps,
             }
+            if spec and rounds:
+                # Speculative rounds: tokens > rounds when the draft is
+                # riding; the per-interval accept rate is the latency
+                # attribution a spec regression shows up in first.
+                attrs["rounds"] = rounds
+                attrs["spec_accept_rate"] = round(
+                    max(0.0, steps / rounds - 1.0) / spec, 4
+                )
             if reason:
                 attrs["closed_by"] = reason
             SERVE_TRACER.record("decode.interval", start, last, **attrs)
@@ -1012,10 +1021,20 @@ class ContinuousScheduler:
     def _decode(self) -> None:
         if not self._slots:
             return
+        # Batch-wide speculative decode (serve/engine.py spec_step):
+        # one ROUND emits between 1 and k+1 tokens per slot — per-slot
+        # accept counters are data, so slots advance DIFFERENT amounts.
+        # The loop trims each slot's window to its remaining budget
+        # (and its eos), exactly like solo speculative_generate's
+        # out-buffer trim; plain engines stay the one-token path.
+        spec = getattr(self.engine, "spec_k", 0)
         t0 = time.perf_counter()
         mono0 = time.monotonic()
         with self._device():
-            toks = self.engine.step()
+            if spec:
+                toks, counts = self.engine.spec_step()
+            else:
+                toks = self.engine.step()
         self._beat()  # the step returned — wedged steps never get here
         now = time.perf_counter()
         mono = time.monotonic()
@@ -1029,29 +1048,42 @@ class ContinuousScheduler:
             self.decode_steps += 1
             self.occupancy_sum += len(self._slots)
             self.step_log.append(len(self._slots))
-            self.tokens_generated += len(self._slots)
-            SERVE_TOKENS_TOTAL.inc(len(self._slots))
+            delivered_total = 0
             retired: list[tuple[int, ServeRequest]] = []
             for slot, req in slots_now:
-                tok = int(toks[slot])
-                req.out.append(tok)
-                req.token_times.append(mono)
+                if spec:
+                    row = [int(toks[slot, j])
+                           for j in range(int(counts[slot]))]
+                else:
+                    row = [int(toks[slot])]
+                finished = False
+                delivered = 0
+                for tok in row:
+                    req.out.append(tok)
+                    req.token_times.append(mono)
+                    delivered += 1
+                    if (len(req.out) >= req.num_steps
+                            or (req.eos_id is not None
+                                and tok == req.eos_id)):
+                        finished = True
+                        break  # window past the budget/eos is dead
+                delivered_total += delivered
                 req.decode_s += mono - mono0
                 # Aggregate this step into the slot's open interval
                 # span (opened on its first step, extended in place).
                 ent = self._intervals.get(slot)
                 if ent is None:
-                    self._intervals[slot] = [mono0, mono, 1]
+                    self._intervals[slot] = [mono0, mono, delivered, 1]
                 else:
                     ent[1] = mono
-                    ent[2] += 1
+                    ent[2] += delivered
+                    ent[3] += 1
                 if req.first_token_at is None:
                     req.first_token_at = now
                     if not req.ttft_observed:
                         req.ttft_observed = True
                         SERVE_TTFT_SECONDS.observe(req.ttft)
-                if (len(req.out) >= req.num_steps
-                        or (req.eos_id is not None and tok == req.eos_id)):
+                if finished:
                     del self._slots[slot]
                     self.engine.retire(slot)
                     self.requests_done += 1
@@ -1074,6 +1106,8 @@ class ContinuousScheduler:
                 elif (ent := self._intervals.get(slot)) is not None \
                         and ent[2] >= DECODE_INTERVAL_STEPS:
                     self._flush_intervals(slot, reason="cap")
+            self.tokens_generated += delivered_total
+            SERVE_TOKENS_TOTAL.inc(delivered_total)
         for slot, req in retired:
             self._retire_telemetry(slot, req)
 
@@ -1153,7 +1187,7 @@ class ContinuousScheduler:
         bookkeeping — never across device work — so this cannot stall
         behind a decode step."""
         with self._cond:
-            return {
+            snap = {
             "engine": "continuous",
             "max_slots": self.engine.max_slots,
             "active_slots": self.engine.active_slots,
@@ -1200,3 +1234,9 @@ class ContinuousScheduler:
                 else {"devices": 1}
             ),
         }
+            if getattr(self.engine, "spec_k", 0):
+                # Batch-wide speculative decode: k, rounds, emitted
+                # tokens, and the derived accept rate — the number the
+                # spec bench leg and dashboards read.
+                snap["spec"] = self.engine.spec_debug()
+            return snap

@@ -11,6 +11,7 @@ math is independent along, and replicates the rest:
 | paged pool ``pool_key``/``pool_value`` ``[nb, blk, KV, Dh]`` | ``P(None, None, 'tp', None)`` | attention is per-KV-head independent; each chip holds ``KV/tp`` heads of every block — the per-chip KV footprint divides by tp |
 | dense rows ``cached_key``/``cached_value`` ``[slots, 1, S, KV, Dh]`` | ``P(None, None, None, 'tp', None)`` | same head split, slot-stacked layout |
 | kv-int8 scale sidecars ``key_scale``/``value_scale`` ``[slots, 1, S, KV]`` | tp on the KV (last) axis | ride their head shard |
+| paged kv-int8 sidecar pools ``pool_key_scale``/``pool_value_scale`` ``[nb, blk, KV]`` | tp on the KV (last) axis | the per-block scale pools ride the pool's head shard — same suffix addressing |
 | ``block_table`` / counters / sampling state | ``P()`` (replicated)      | per-slot scalars and gather indices: a few int32 per slot — replicating them is what keeps joins/retires host-side writes with no cross-chip bookkeeping |
 | logits ``[slots, vocab]``         | ``P(None, 'tp')``         | the lm_head kernel is vocab-split (``param_sharding_rules``), so sampling consumes the shards where they land — no per-step all-gather of the logits row |
 
@@ -68,6 +69,9 @@ _HEAD_AXIS_FROM_END = {
     "cached_value": 2,
     "key_scale": 1,     # [(slots,) 1, S, KV]  (kv-int8 sidecars)
     "value_scale": 1,
+    "pool_key_scale": 1,    # [nb, blk, KV]  (paged kv-int8 sidecar pools
+    "pool_value_scale": 1,  # — ride the K/V head shard like the dense
+                            # sidecars, same suffix addressing)
 }
 
 # Leaf name -> minimum rank at which dimension 0 is the SLOT axis, for

@@ -9,7 +9,8 @@ second actually skipped its prefill — then a TRACED request through a
 supervised engine (queue/admit/prefill/decode-interval spans under one
 request id, in phase order, valid Chrome-trace export) — and finally the SPMD
 tensor-parallel matrix (tools/serve_tp_check.py at tp=2 host devices:
-{dense, paged} x {one-shot, chunked} bit-identity + the supervisor
+{dense, paged} x {one-shot, chunked} bit-identity, the batch-wide
+speculative cells spec/{dense, paged, paged-kv8}, + the supervisor
 mesh-reconstruction replay, slow-marked in tier-1 so THIS is its
 default home). The quick loop for iterating on tf_operator_tpu/serve/
 without paying for the whole tier-1 run.

@@ -178,6 +178,111 @@ def run_matrix(tp: int) -> int:
     return failures
 
 
+def run_spec(tp: int) -> int:
+    """Batch-wide speculative decode at tp>1 (ISSUE 15): the spec
+    engine on the mesh — draft params sharded by the same rules, kv8
+    scale sidecars riding the head shard — bit-identical per slot to
+    solo ``speculative_generate`` with the SAME tp-sharded params
+    (greedy AND sampled), across a join/retire walk, in both KV
+    layouts plus the paged-kv8 cell, with compiles == warmup."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.spec_decode import speculative_generate
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+
+    K = 2
+    base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+                d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    cfg = TransformerConfig(**base)
+    dcfg = TransformerConfig(**{**base, "n_layers": 1})
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    dparams = Transformer(dcfg).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(mesh, params, param_sharding_rules())
+    dsharded = shard_params_by_rules(mesh, dparams,
+                                     param_sharding_rules())
+
+    rng = np.random.default_rng(13)
+    p1 = rng.integers(0, 64, (1, 9)).astype(np.int32)
+    p2 = rng.integers(0, 64, (1, 5)).astype(np.int32)
+    failures = 0
+    from dataclasses import replace
+
+    cells = [("spec/dense", cfg, dcfg, dict(kv_paged=False)),
+             ("spec/paged", cfg, dcfg, dict(kv_paged=True)),
+             ("spec/paged-kv8", replace(cfg, kv_int8=True),
+              replace(dcfg, kv_int8=True), dict(kv_paged=True))]
+    for label, tcfg, tdcfg, kw in cells:
+        eng = ContinuousEngine(
+            tcfg, params, max_slots=3, kv_block=8, mesh=mesh,
+            spec_k=K, draft_cfg=tdcfg, draft_params=dparams, **kw,
+        )
+
+        def solo_spec(prompt, steps, temperature=0.0, seed=0):
+            skw = {}
+            if temperature > 0:
+                skw = dict(temperature=temperature,
+                           rng=jax.random.PRNGKey(seed))
+            out, _ = speculative_generate(
+                tcfg, sharded, tdcfg, dsharded, jnp.asarray(prompt),
+                steps, k=K, **skw,
+            )
+            return np.asarray(out)[0]
+
+        plan = {"a": (p1, 10, 0.0, 0), "b": (p2, 6, 0.9, 11)}
+        sa = eng.join(jnp.asarray(p1), num_steps=10)
+        state = {sa: ("a", 10, [])}
+        toks, counts = eng.spec_step()
+        for j in range(int(counts[sa])):
+            state[sa][2].append(int(toks[sa, j]))
+        sb = eng.join(jnp.asarray(p2), num_steps=6, temperature=0.9,
+                      seed=11)
+        state[sb] = ("b", 6, [])
+        done: dict = {}
+        for _ in range(40):
+            if not state:
+                break
+            toks, counts = eng.spec_step()
+            for s in list(state):
+                name, n, acc = state[s]
+                for j in range(int(counts[s])):
+                    if len(acc) < n:
+                        acc.append(int(toks[s, j]))
+                if len(acc) >= n:
+                    eng.retire(s)
+                    done[name] = acc
+                    del state[s]
+        for name, (p, n, t, seed) in plan.items():
+            want = solo_spec(p, n, t, seed)[:n]
+            if not np.array_equal(np.asarray(done[name]), want):
+                print(f"serve_tp_check: {label} request {name} DIVERGED "
+                      f"from solo speculative_generate", file=sys.stderr)
+                failures += 1
+        if eng.decode_step_compiles != eng.warmup_compiles:
+            print(f"serve_tp_check: {label} recompiled "
+                  f"({eng.decode_step_compiles} != warmup "
+                  f"{eng.warmup_compiles})", file=sys.stderr)
+            failures += 1
+        print(f"serve_tp_check: {label} ok (k={K}, compiles "
+              f"{eng.decode_step_compiles}=warmup, accept_rate "
+              f"{eng.spec_debug()['accept_rate']})", flush=True)
+    return failures
+
+
 def run_supervisor_replay(tp: int) -> int:
     """Crash a supervised tp engine mid-decode: the rebuild reconstructs
     the mesh (same factory, same shardings) and the replay is
@@ -270,15 +375,16 @@ def main(argv: list[str] | None = None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     _force_host_devices(args.tp)
     failures = run_matrix(args.tp)
+    failures += run_spec(args.tp)
     if not args.skip_supervisor:
         failures += run_supervisor_replay(args.tp)
     if failures:
         print(f"serve_tp_check: FAIL ({failures} failure(s))",
               file=sys.stderr)
         return 1
-    print(f"serve_tp_check: OK (tp={args.tp}, matrix + supervisor "
-          f"replay bit-identical, zero post-warmup recompiles)",
-          flush=True)
+    print(f"serve_tp_check: OK (tp={args.tp}, matrix + spec + "
+          f"supervisor replay bit-identical, zero post-warmup "
+          f"recompiles)", flush=True)
     return 0
 
 

@@ -98,6 +98,12 @@ STAGES = [
     # Speculative-decoding component costs (plain vs self-draft vs cold
     # draft): the acceptance-curve endpoints for models/spec_decode.py.
     ("specdecode", {"PROBE": "specdecode"}, 900.0),
+    # Batch-wide speculative SERVING triple (ISSUE 15): spec continuous
+    # engine vs plain continuous vs legacy --spec-k coalesce on one
+    # seeded schedule — the hardware ratios for the acceptance pin (the
+    # CPU line is a floor: compute-bound hosts can't show the
+    # weight-read amortization the verify chunk buys).
+    ("serve_spec", {"BENCH": "serve_spec"}, 700.0),
     # Tail attribution: host input pipeline (CPU-only, cheap) and the
     # ResNet fwd/bwd split — consulted if the synthetic-vs-bench split
     # points at input/transfer or the gradient path respectively.
