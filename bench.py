@@ -541,6 +541,24 @@ def bench_serve_disagg(peak_hbm_gbps: float | None) -> None:
                           else 540)
 
 
+def bench_serve_fleet_prefix(peak_hbm_gbps: float | None) -> None:
+    """Fleet-global prefix reuse pair: subprocess-runs
+    tools/serve_bench.py --engine fleet-prefix — the identical seeded
+    multi-turn chat mix through the prefix-aware router (prefix-hit-
+    weighted scoring + session affinity + cross-replica KV pulls) and
+    through the plain least-loaded router, over engine-identical
+    fleets (paged engines, prefix retention on both legs). The prefix
+    line's prefill_tokens_saved_vs_baseline (must exceed 1) and
+    ttft_p50_vs_baseline are the ISSUE-16 acceptance numbers;
+    tests/test_fleet_chaos.py pins the structure. Subprocess for the
+    usual serve-section reasons. peak_hbm unused; signature keeps the
+    peak-table plumbing uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("fleet_prefix", ["--engine", "fleet-prefix"],
+                          timeout=240 if os.environ.get("BENCH_SMOKE")
+                          else 540)
+
+
 def _run_serve_subprocess(label: str, extra_args: list,
                           timeout: float) -> None:
     """Shared harness for the serve-family sections: subprocess-run
@@ -1240,6 +1258,8 @@ _SECTIONS: dict = {
     "serve_spec": (bench_serve_spec, chip_peak_hbm_gbps, 560.0),
     "serve_disagg": (bench_serve_disagg, chip_peak_hbm_gbps, 560.0),
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
+    "fleet_prefix": (bench_serve_fleet_prefix, chip_peak_hbm_gbps,
+                     560.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }
 

@@ -287,6 +287,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--kv-block", type=int, default=64, metavar="TOKENS",
                    help="paged KV cache block size in tokens "
                         "(--max-seq-len must divide evenly)")
+    p.add_argument("--prefix-advertise", type=int, default=32,
+                   metavar="N",
+                   help="hot prefix-cache entries advertised on "
+                        "/healthz for fleet-global prefix routing "
+                        "(paged continuous engine; MRU first; 0 "
+                        "disables advertisement — the replica still "
+                        "answers /prefix/<digest> pulls)")
     p.add_argument("--kv-pool-blocks", type=int, default=None,
                    metavar="N",
                    help="paged KV pool size in blocks, incl. the pinned "
@@ -683,7 +690,7 @@ def main(argv: list[str] | None = None) -> int:
             # the captured mesh, at tp>1 exactly as at tp=1. --spec-k
             # rides along: the rebuilt engine re-seeds its draft cache
             # at each replay's join, so replays stay bit-identical.
-            return ContinuousEngine(
+            eng = ContinuousEngine(
                 cfg, params, max_slots=args.max_batch,
                 prefill_chunk=(args.prefill_chunk or None),
                 kv_paged=kv_paged, kv_block=args.kv_block,
@@ -692,6 +699,15 @@ def main(argv: list[str] | None = None) -> int:
                 spec_k=args.spec_k, draft_cfg=draft_cfg,
                 draft_params=draft_params,
             )
+            if kv_paged:
+                # Inside the factory so a watchdog rebuild keeps the
+                # flags (the supervisor rebuilds through here).
+                # Retention matches the advertisement width: every
+                # digest the replica advertises stays exportable and
+                # exact-joinable after its request completes.
+                eng.prefix_advertise_max = args.prefix_advertise
+                eng.prefix_retain_max = args.prefix_advertise
+            return eng
 
         engine_sched = EngineSupervisor(
             engine_factory,
@@ -803,6 +819,30 @@ def main(argv: list[str] | None = None) -> int:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif (self.path.startswith("/prefix/")
+                    and engine_sched is not None):
+                # Fleet-global prefix reuse: export one hot prefix
+                # entry (named by its chained per-block digest, the
+                # same chain /healthz advertises) in the shipped-KV
+                # wire format. The fleet router pulls this onto a
+                # replica that misses the prefix; a stale digest
+                # answers the typed prefix_not_found — the puller
+                # degrades to local prefill.
+                from tf_operator_tpu.serve.resilience import (
+                    error_payload,
+                    http_status_of,
+                )
+
+                digest = self.path[len("/prefix/"):]
+                try:
+                    shipment = engine_sched.export_prefix(digest)
+                except Exception as exc:  # noqa: BLE001 — typed out
+                    payload = error_payload(exc)
+                    payload["replica"] = args.replica_id
+                    self._json(http_status_of(exc), payload)
+                    return
+                self._json(200, {"shipment": shipment,
+                                 "replica": args.replica_id})
             else:
                 self._json(404, {"error": "unknown path"})
 

@@ -1,0 +1,27 @@
+"""Seeded violations for the ``typed-error`` pass, fleet-prefix era
+(ISSUE 16): a typo'd prefix code in a payload literal, a pull-handler
+comparison against an unknown code, and an unknown-code member in a
+degrade-code constant — the mistakes that would silently break the
+prefix pull's degrade-to-local-prefill contract (a typo'd
+``prefix_not_found`` makes the router treat a stale-advertisement race
+as an internal error instead of quietly prefilling). (The test runs
+the checker over this file TOGETHER with serve/resilience.py so the
+taxonomy — incl. the real ``prefix_not_found`` — is in the analyzed
+set.)"""
+
+
+def mint() -> dict:
+    # Typo: the taxonomy declares "prefix_not_found".
+    return {"error": "x", "code": "prefix_notfound", "retryable": False}
+
+
+def degrade(payload: dict) -> bool:
+    # Unknown: no such code anywhere in the taxonomy.
+    return payload.get("code") == "prefix_stale"
+
+
+LOCAL_PREFILL_CODES = ("prefix_not_found", "prefix_pull_rejected")
+
+
+def pull_failed(payload: dict) -> bool:
+    return payload.get("code") in LOCAL_PREFILL_CODES

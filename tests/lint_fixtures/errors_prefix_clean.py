@@ -1,0 +1,19 @@
+"""Clean twin of errors_prefix_bad.py: the prefix-pull codes spelled
+as the taxonomy declares them (``prefix_not_found`` from the
+PrefixNotFound ServeError subclass / WIRE_CODES, ``ship_failed`` for
+the pulled-bytes-rejected degrade path)."""
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "prefix_not_found", "retryable": False}
+
+
+def degrade(payload: dict) -> bool:
+    return payload.get("code") == "prefix_not_found"
+
+
+LOCAL_PREFILL_CODES = ("prefix_not_found", "ship_failed")
+
+
+def pull_failed(payload: dict) -> bool:
+    return payload.get("code") in LOCAL_PREFILL_CODES

@@ -481,6 +481,183 @@ def test_rolling_update_zero_lost_and_converges(fleet_backend):
         harness.stop_all()
 
 
+# ---------------------------------------------------------------------------
+# fleet-global prefix reuse under chaos (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _route_session(router_endpoint, session, timeout=10.0):
+    """One session-tagged request through the router."""
+    req = urllib.request.Request(
+        f"http://{router_endpoint}/generate",
+        data=json.dumps({"tokens": [[1, 2]], "num_steps": 2,
+                         "session": session}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read() or b"{}")
+        except ValueError:
+            return e.code, {}
+    except Exception:  # noqa: BLE001 — transport-level loss
+        return None, None
+
+
+def _pull_digest():
+    """The exact whole-prompt digest of route_one's [[1, 2]] body at
+    kv_block=2 — what a holder advertises for the pull tests."""
+    import numpy as np
+
+    from tf_operator_tpu.serve.disagg import chain_digests
+
+    return chain_digests(np.asarray([1, 2], np.int32), 2)[-1]
+
+
+def test_kill_prefix_holder_mid_pull_degrades_to_local(fleet_backend):
+    """The pull path's crash boundary: replica r1 advertises the hot
+    digest and serves pulls; killing it mid-run degrades every
+    subsequent miss to LOCAL PREFILL on the routed replica — requests
+    keep resolving (ok + typed == total, zero lost), the pull wreckage
+    shows up only in the router's pull_misses/outcome counters."""
+    from tf_operator_tpu.fleet import PrefixConfig
+
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=3))
+    router = None
+    digest = _pull_digest()
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 3)
+        # r1 holds the prefix: advertises the digest AND stores a wire
+        # payload for GET /prefix/<digest>. weight=0 keeps the pick
+        # least-loaded (r1 is never preferred for holding), so picks
+        # land elsewhere and must PULL from r1.
+        harness.servers[1].backend.prefixes = [digest]
+        harness.servers[1].backend.prefix_store[digest] = {
+            "version": 1, "tokens": [1, 2], "kv_block": 2,
+        }
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=0.05),
+            prefix=PrefixConfig(kv_block=2, weight=0.0,
+                                pull_timeout_s=2.0),
+        ).start()
+        # The advertisement must reach membership before traffic.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and (
+                digest not in (ms.get("lm-r1").prefixes or ())):
+            time.sleep(0.02)
+        assert digest in (ms.get("lm-r1").prefixes or ())
+        # Healthy phase: picks miss locally, pull from r1, attach the
+        # shipped bytes to the routed body.
+        for _ in range(2):
+            status, _ = route_one(router.endpoint)
+            assert status == 200
+        snap = router.router.snapshot()["prefix"]
+        assert snap["pulls"] >= 1, snap
+        assert (harness.servers[0].backend.shipped_received
+                + harness.servers[2].backend.shipped_received) >= 1
+        assert harness.servers[1].backend.prefix_exports >= 1
+        # Chaos: kill the holder mid-run with traffic flowing.
+        driver = TrafficDriver(router.endpoint, n_requests=30).start()
+        time.sleep(0.05)
+        harness.kill(1)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0, driver.results
+        assert ok + typed == 30
+        # The holder's death is invisible to clients: pull failures
+        # degrade to local prefill on the routed replica, transport
+        # failures fail over.
+        assert ok == 30, [p for s, p in driver.results if s != 200]
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
+def test_session_affinity_survives_rolling_update(fleet_backend):
+    """Session affinity's chaos contract: multi-turn traffic sticks to
+    its home replica while the home is routable, RE-HOMES when a
+    rolling update drains it out from under the session, and never
+    surfaces a 5xx to the client along the way."""
+    from tf_operator_tpu.fleet import PrefixConfig
+
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES,
+                  mk_serve(replicas=2, grace=0.1, modelVersion="v1"))
+    router = None
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 2)
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=0.05),
+            prefix=PrefixConfig(kv_block=2, weight=1.0, pull=False),
+        ).start()
+        # Establish the home: turn 1 picks, turns 2..4 ride affinity.
+        status, payload = _route_session(router.endpoint, "chat-7")
+        assert status == 200
+        home = payload["replica"]
+        for _ in range(3):
+            status, payload = _route_session(router.endpoint, "chat-7")
+            assert status == 200
+            assert payload["replica"] == home
+        assert router.router.snapshot()["prefix"]["affinity_routes"] >= 3
+        # Roll the fleet under the session's feet.
+        serve = store.get(objects.TPUSERVES, "default", "lm")
+        serve["spec"]["modelVersion"] = "v2"
+        client.update(objects.TPUSERVES, serve)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        results = []
+        try:
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                results.append(_route_session(router.endpoint, "chat-7"))
+                kids = children_of(store)
+                if (
+                    len(kids) == 2
+                    and ms.counts()[mship.READY] == 2
+                    and all(
+                        objects.annotations_of(j).get(
+                            "fleet.tpuflow.org/model-version") == "v2"
+                        for j in kids.values()
+                    )
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"rolling update did not converge: "
+                            f"{ms.counts()}")
+        finally:
+            stop.set()
+        # Never a 5xx, never a loss — the session re-homed through the
+        # drain instead of erroring.
+        assert all(s == 200 for s, _ in results), results
+        # Post-roll turns route to a LIVE home (the old children are
+        # gone; the affinity table tracked the move).
+        status, payload = _route_session(router.endpoint, "chat-7")
+        assert status == 200
+        live = {r.id for r in ms.routable()}
+        assert payload["replica"] in live
+        assert router.router.affinity.home("chat-7") in live
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
 def test_invalid_spec_edit_freezes_fleet_instead_of_gc():
     """A live fleet whose spec is edited into something the validator
     rejects must FREEZE (rejection event, no reconcile) — its replicas
@@ -960,6 +1137,66 @@ def test_serve_bench_disagg_structural():
     assert dis["ttft_p99_vs_baseline"] > 0
     assert dis["itl_p99_vs_baseline"] > 0
     assert dis["host_cpus"] >= 1
+
+
+@pytest.mark.slow
+def test_serve_bench_fleet_prefix_structural():
+    """tools/serve_bench.py --engine fleet-prefix (BENCH_SMOKE): the
+    ISSUE-16 multi-turn chat pair — prefix-aware routing vs the plain
+    least-loaded router over engine-identical fleets on the identical
+    seeded session mix. Capacity-style pins only (structure and token
+    counts, never wall-clock): every turn of every session resolves on
+    both legs, session affinity actually routed the follow-up turns,
+    the prefix leg saved at least as much prefill as the baseline
+    (strictly positive), and the ratio fields hardware rounds key on
+    exist."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--engine", "fleet-prefix"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    pfx = next(l for l in lines
+               if l["metric"] == "serve_fleet_prefix_chat_"
+                                 "tokens_per_sec_mixed")
+    base = next(l for l in lines
+                if l["metric"] == "serve_fleet_lru_chat_"
+                                  "tokens_per_sec_mixed")
+    from tools.serve_bench import SMOKE_CHAT_MIX as MIX
+
+    n_turns = MIX["sessions"] * MIX["turns"]
+    for leg in (pfx, base):
+        assert leg["requests"] == n_turns
+        assert leg["errors"] == 0
+        assert leg["generated_tokens"] == n_turns * MIX["steps"]
+        assert leg["sessions"] == MIX["sessions"]
+        assert leg["replicas"] == MIX["replicas"]
+        assert leg["ttft_p50_ms"] > 0
+    assert pfx["prefix_aware"] and not base["prefix_aware"]
+    # The acceptance direction: prefix-aware routing reuses at least
+    # as much prefill as least-loaded, and strictly saves something.
+    assert pfx["prefill_tokens_saved"] > 0
+    assert pfx["prefill_tokens_saved_vs_baseline"] >= 1.0
+    # Affinity routed every follow-up turn of every session home.
+    rp = pfx["router_prefix"]
+    assert rp["affinity_routes"] >= MIX["sessions"] * (MIX["turns"] - 1)
+    assert rp["hits"] + rp["pulls"] > 0
+    # Pull failures, if any, degraded typed — never a lost turn.
+    assert rp["pull_fallbacks"] == 0
+    # The ratio fields hardware rounds key on.
+    assert pfx["ttft_p50_vs_baseline"] > 0
+    assert pfx["baseline_ttft_p50_ms"] > 0
+    assert pfx["baseline_ttft_p99_ms"] > 0
+    assert pfx["vs_baseline"] > 0
 
 
 def test_zz_lock_order_witness_subgraph_of_static():

@@ -1,0 +1,400 @@
+"""Fleet-global prefix reuse, unit tier — jax-free and fast.
+
+Covers the ISSUE 16 routing-side pieces in isolation: the request
+digest chain vs the shipped-KV wire chain, deepest-hit scoring
+(``load - weight * hit_fraction``) with its equal-load tiebreak, the
+session-affinity table's home/re-home semantics against DRAINING/DEAD
+replicas, advertisement staleness (clear-on-absent + the typed
+``prefix_not_found`` pull miss degrading to local prefill), the pull
+attach/ship_failed-strip-retry policy, and the spec ``prefixRouting``
+block's round-trip + validation. The cross-layer runs (live engines,
+bit-identity through a real pull, chaos kills) live in
+test_serve_prefix_pull.py and test_fleet_chaos.py.
+"""
+
+import pytest
+
+from tf_operator_tpu.api.serve_types import (
+    PrefixRoutingPolicy,
+    ServeValidationError,
+    TPUServe,
+    validate_serve_spec,
+)
+from tf_operator_tpu.fleet.membership import (
+    DRAINING,
+    FleetMembership,
+)
+from tf_operator_tpu.fleet.prefixes import (
+    AffinityTable,
+    PrefixConfig,
+    best_replica,
+    hit_blocks,
+    holder_of,
+    prefix_score,
+    request_digests,
+)
+from tf_operator_tpu.fleet.router import FleetRouter, RouterConfig
+from tf_operator_tpu.serve.disagg import chain_digests
+
+pytestmark = pytest.mark.fleet
+
+KVB = 4
+PROMPT = list(range(11))  # 2 whole blocks + a 3-token tail = 3 digests
+
+
+def mk_fleet(n=3, **adv):
+    """n READY replicas; adv maps replica id -> advertised digests."""
+    ms = FleetMembership()
+    for i in range(n):
+        rid = f"r{i}"
+        ms.register(rid, f"h:{i}")
+        payload = {"ok": True, "max_slots": 8}
+        if rid in adv:
+            payload["prefixes"] = list(adv[rid])
+        ms.observe(rid, payload)
+    return ms
+
+
+def observe(ms, rid, *, active=0, prefixes=None):
+    payload = {"ok": True, "max_slots": 8, "active_slots": active}
+    if prefixes is not None:
+        payload["prefixes"] = list(prefixes)
+    ms.observe(rid, payload)
+
+
+# ---------------------------------------------------------------------------
+# digest chain / scoring primitives
+# ---------------------------------------------------------------------------
+
+
+def test_request_digests_are_the_wire_chain():
+    d = request_digests(PROMPT, KVB)
+    assert d == tuple(chain_digests(PROMPT, KVB))
+    assert len(d) == 3  # two whole blocks + the partial tail
+    # Chain property: a longer prompt's chain extends the shorter's.
+    assert request_digests(PROMPT[:8], KVB) == d[:2]
+
+
+def test_hit_blocks_takes_deepest_advertised_position():
+    d = request_digests(PROMPT, KVB)
+    assert hit_blocks(d, []) == 0
+    assert hit_blocks(d, [d[0]]) == 1
+    # The deepest advertised digest measures reuse even when its
+    # ancestors aren't listed (the advertisement is capped).
+    assert hit_blocks(d, [d[1]]) == 2
+    assert hit_blocks(d, [d[2], "junk"]) == 3
+    assert hit_blocks(d, ["junk"]) == 0
+
+
+def test_prefix_score_formula_and_weight_zero():
+    assert prefix_score(0.5, 0, 3, 1.0) == 0.5
+    assert prefix_score(0.5, 3, 3, 1.0) == pytest.approx(-0.5)
+    # weight 0 ignores hits entirely — exactly least-loaded.
+    assert prefix_score(0.5, 3, 3, 0.0) == 0.5
+
+
+def test_equal_load_prefix_hit_wins_tiebreak():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(3, r2={d[-1]})
+    # All loads equal (0): the PR 9 pick would take r0; the deeper
+    # prefix hit makes r2's score strictly lower.
+    rep, hit = best_replica(ms.routable(), d, weight=1.0)
+    assert rep.id == "r2" and hit == 3
+    # weight 0: scores tie everywhere, (load, id) tiebreak -> r0.
+    rep, _ = best_replica(ms.routable(), d, weight=0.0)
+    assert rep.id == "r0"
+
+
+def test_weight_prices_hit_against_load():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(2, r1={d[-1]})
+    observe(ms, "r1", active=8, prefixes=[d[-1]])  # load 1.0, full hit
+    # weight 1.0: r1 scores 1.0 - 1.0 = 0.0 == r0's, tiebreak on load
+    # -> the idle r0 wins; a prefix hit may not outbid a FULL replica.
+    rep, _ = best_replica(ms.routable(), d, weight=1.0)
+    assert rep.id == "r0"
+    # weight 2.0 prices the hit higher than one max_slots of queue.
+    rep, _ = best_replica(ms.routable(), d, weight=2.0)
+    assert rep.id == "r1"
+
+
+def test_holder_of_least_loaded_advertiser_with_exclusions():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(3, r1={d[-1]}, r2={d[-1]})
+    observe(ms, "r1", active=6, prefixes=[d[-1]])
+    assert holder_of(ms.routable(), d[-1]).id == "r2"
+    assert holder_of(ms.routable(), d[-1], {"r2"}).id == "r1"
+    assert holder_of(ms.routable(), d[-1], {"r1", "r2"}) is None
+    assert holder_of(ms.routable(), "nope") is None
+
+
+# ---------------------------------------------------------------------------
+# affinity table
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_lru_capacity_and_forget():
+    t = AffinityTable(capacity=2)
+    t.set_home("a", "r0")
+    t.set_home("b", "r1")
+    assert t.home("a") == "r0"  # refreshes a's recency
+    t.set_home("c", "r2")       # evicts b (LRU), not a
+    assert t.home("b") is None
+    assert t.home("a") == "r0" and t.home("c") == "r2"
+    t.forget_replica("r0")
+    assert t.home("a") is None
+    assert t.snapshot() == {"sessions": 1, "capacity": 2}
+    assert t.home("") is None  # sessionless requests never have homes
+
+
+# ---------------------------------------------------------------------------
+# router integration (injected transport, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def no_pull(rep, digest, timeout):  # pull_fn that must not be called
+    raise AssertionError("unexpected pull")
+
+
+def test_router_prefix_pick_routes_to_advertiser():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(3, r2={d[-1]})
+    sent = []
+
+    def send(rep, body, timeout):
+        sent.append((rep.id, "shipped_kv" in body))
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=no_pull)
+    status, payload = router.route({"tokens": [PROMPT]})
+    assert status == 200 and sent == [("r2", False)]
+    snap = router.snapshot()["prefix"]
+    # Exact-chain hit: the whole prompt's prefill credited as saved.
+    assert snap["hits"] == 1 and snap["tokens_saved"] == len(PROMPT)
+    assert snap["pulls"] == 0
+
+
+def test_partial_hit_credits_whole_blocks_only():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(2, r1={d[0]})
+    router = FleetRouter(ms, lambda rep, b, t: (200, {}),
+                         prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=no_pull)
+    status, _ = router.route({"tokens": [PROMPT]})
+    assert status == 200
+    snap = router.snapshot()["prefix"]
+    assert snap["hits"] == 1 and snap["tokens_saved"] == 1 * KVB
+
+
+def test_session_affinity_routes_home_and_rehomes_off_draining():
+    ms = mk_fleet(3)
+    sent = []
+
+    def send(rep, body, timeout):
+        sent.append(rep.id)
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=no_pull)
+    body = {"tokens": [PROMPT], "session": "s1"}
+    assert router.route(body)[0] == 200  # first turn: scored pick, r0
+    observe(ms, "r0", active=7)          # home is now heavily loaded...
+    assert router.route(body)[0] == 200  # ...but affinity still wins
+    assert sent == ["r0", "r0"]
+    assert router.snapshot()["prefix"]["affinity_routes"] == 1
+    # Home drains: it leaves routable(), the session re-homes through
+    # the scored pick — never a 5xx, never a route to the old home.
+    ms.mark_draining("r0")
+    assert ms.get("r0").state == DRAINING
+    assert router.route(body)[0] == 200
+    assert sent[-1] == "r1"
+    # ...and the NEW home sticks (set_home on success re-homed it).
+    observe(ms, "r1", active=7)
+    assert router.route(body)[0] == 200
+    assert sent[-1] == "r1"
+    # A DEAD home behaves identically (sticky-dead leaves routable()).
+    ms.mark_dead("r1")
+    assert router.route(body)[0] == 200
+    assert sent[-1] == "r2"
+
+
+def test_stale_advertisement_clear_on_absent_stops_scoring():
+    d = request_digests(PROMPT, KVB)
+    ms = mk_fleet(2, r1={d[-1]})
+    assert ms.get("r1").prefixes == (d[-1],)
+    # Next probe payload carries no prefixes: the replica freed its
+    # entries (restart, LRU churn) — the advertisement must clear, and
+    # the router falls back to plain least-loaded (r0 by id tiebreak).
+    observe(ms, "r1")
+    assert ms.get("r1").prefixes == ()
+    router = FleetRouter(ms, lambda rep, b, t: (200, {}),
+                         prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=no_pull)
+    router.route({"tokens": [PROMPT]})
+    snap = router.snapshot()["prefix"]
+    assert snap["hits"] == 0 and snap["tokens_saved"] == 0
+
+
+def loaded_holder_fleet(d):
+    """r1 advertises the exact digest but is FULL, so the scored pick
+    sends the request to an idle non-holder and the router must pull."""
+    ms = mk_fleet(2, r1={d[-1]})
+    observe(ms, "r1", active=8, prefixes=[d[-1]])
+    return ms
+
+
+def test_pull_attaches_holder_shipment_to_dispatch():
+    d = request_digests(PROMPT, KVB)
+    ms = loaded_holder_fleet(d)
+    pulls, sent = [], []
+
+    def pull(rep, digest, timeout):
+        pulls.append((rep.id, digest))
+        return 200, {"shipment": {"version": 1, "fake": True}}
+
+    def send(rep, body, timeout):
+        sent.append((rep.id, body.get("shipped_kv")))
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=pull)
+    status, _ = router.route({"tokens": [PROMPT]})
+    assert status == 200
+    assert pulls == [("r1", d[-1])]
+    assert sent == [("r0", {"version": 1, "fake": True})]
+    snap = router.snapshot()["prefix"]
+    assert snap["pulls"] == 1 and snap["tokens_saved"] == len(PROMPT)
+    assert snap["hits"] == 0  # a pull is not a routing hit
+
+
+def test_typed_pull_miss_degrades_to_local_prefill():
+    d = request_digests(PROMPT, KVB)
+    ms = loaded_holder_fleet(d)
+    sent = []
+
+    def pull(rep, digest, timeout):
+        # The stale-advertisement race: the holder LRU'd the entry
+        # between the probe sweep and this pull.
+        return 404, {"code": "prefix_not_found", "retryable": False,
+                     "error": "gone"}
+
+    def send(rep, body, timeout):
+        sent.append((rep.id, "shipped_kv" in body))
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, prefix=PrefixConfig(kv_block=KVB),
+                         pull_fn=pull)
+    status, _ = router.route({"tokens": [PROMPT]})
+    assert status == 200 and sent == [("r0", False)]
+    snap = router.snapshot()["prefix"]
+    assert snap["pull_misses"] == 1 and snap["pulls"] == 0
+
+
+def test_pull_transport_error_degrades_to_local_prefill():
+    d = request_digests(PROMPT, KVB)
+    ms = loaded_holder_fleet(d)
+
+    def pull(rep, digest, timeout):
+        raise OSError("connection refused")
+
+    router = FleetRouter(ms, lambda rep, b, t: (200, {}),
+                         prefix=PrefixConfig(kv_block=KVB), pull_fn=pull)
+    status, _ = router.route({"tokens": [PROMPT]})
+    assert status == 200
+    assert router.snapshot()["prefix"]["pull_misses"] == 1
+
+
+def test_pulled_ship_failed_strips_and_retries_same_replica():
+    d = request_digests(PROMPT, KVB)
+    ms = loaded_holder_fleet(d)
+    sent = []
+
+    def pull(rep, digest, timeout):
+        return 200, {"shipment": {"version": 1}}
+
+    def send(rep, body, timeout):
+        sent.append((rep.id, "shipped_kv" in body))
+        if "shipped_kv" in body:
+            return 422, {"code": "ship_failed", "retryable": False,
+                         "error": "digest mismatch"}
+        return 200, {"tokens": [[1]]}
+
+    router = FleetRouter(ms, send, RouterConfig(retries=2),
+                         prefix=PrefixConfig(kv_block=KVB), pull_fn=pull)
+    status, payload = router.route({"tokens": [PROMPT]})
+    # SAME replica, shipment stripped — the replica is healthy, the
+    # pulled bytes were what failed; the request still serves.
+    assert status == 200
+    assert sent == [("r0", True), ("r0", False)]
+    snap = router.snapshot()["prefix"]
+    assert snap["pull_fallbacks"] == 1
+    # tokens_saved must NOT credit the stripped pull's prompt.
+    assert snap["tokens_saved"] == 0
+
+
+def test_pull_disabled_config_never_pulls():
+    d = request_digests(PROMPT, KVB)
+    ms = loaded_holder_fleet(d)
+    router = FleetRouter(
+        ms, lambda rep, b, t: (200, {}),
+        prefix=PrefixConfig(kv_block=KVB, pull=False), pull_fn=no_pull,
+    )
+    assert router.route({"tokens": [PROMPT]})[0] == 200
+
+
+def test_router_without_prefix_cfg_has_no_prefix_snapshot():
+    ms = mk_fleet(2)
+    router = FleetRouter(ms, lambda rep, b, t: (200, {}))
+    assert router.route({"tokens": [PROMPT]})[0] == 200
+    assert "prefix" not in router.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# spec block
+# ---------------------------------------------------------------------------
+
+
+def serve_with_prefix(**kw):
+    return TPUServe.from_dict({
+        "metadata": {"name": "lm", "namespace": "default"},
+        "spec": {
+            "replicas": 2,
+            "template": {"spec": {"containers": [
+                {"name": "tensorflow", "command": ["serve"]}
+            ]}},
+            "prefixRouting": {"enabled": True, **kw},
+        },
+    })
+
+
+def test_prefix_routing_spec_roundtrip_and_config_render():
+    serve = serve_with_prefix(weight=2.0, kvBlock=32,
+                              sessionAffinity=False, advertiseMax=8)
+    validate_serve_spec(serve.spec)
+    pr = serve.spec.prefix_routing
+    assert (pr.weight, pr.kv_block, pr.session_affinity,
+            pr.advertise_max) == (2.0, 32, False, 8)
+    assert TPUServe.from_dict(serve.to_dict()).spec.prefix_routing == pr
+    cfg = PrefixConfig.from_policy(pr)
+    assert cfg.kv_block == 32 and cfg.weight == 2.0 and not \
+        cfg.session_affinity
+    # Disabled (the default) renders to None — plain routing.
+    assert PrefixConfig.from_policy(PrefixRoutingPolicy()) is None
+    # The default block round-trips as an ABSENT dict key.
+    assert "prefixRouting" not in TPUServe.from_dict(
+        {"metadata": {"name": "x"},
+         "spec": {"template": serve.spec.template}}
+    ).spec.to_dict()
+
+
+@pytest.mark.parametrize("kw,msg", [
+    (dict(kvBlock=0), "kvBlock"),
+    (dict(weight=-1.0), "weight"),
+    (dict(advertiseMax=0), "advertiseMax"),
+    (dict(pullTimeoutSeconds=0.0), "pullTimeoutSeconds"),
+])
+def test_prefix_routing_validation_rejects(kw, msg):
+    serve = serve_with_prefix(**kw)
+    with pytest.raises(ServeValidationError, match=msg):
+        validate_serve_spec(serve.spec)

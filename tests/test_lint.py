@@ -57,6 +57,10 @@ _FIXTURE_MATRIX = {
     # unknown prefill-pool code must trip — the two-stage router
     # dispatches on these strings.
     "errors_ship_bad.py": ((TAXONOMY,), "typed-error"),
+    # Fleet-prefix pull codes (ISSUE 16): a typo'd prefix_not_found /
+    # unknown degrade code must trip — the router's pull path degrades
+    # to local prefill on these strings.
+    "errors_prefix_bad.py": ((TAXONOMY,), "typed-error"),
 }
 
 
@@ -77,7 +81,7 @@ def test_fixture_trips_exactly_its_pass(name):
 @pytest.mark.parametrize("name", [
     "lockorder_clean.py", "guarded_clean.py", "blocking_clean.py",
     "metrics_clean.py", "metrics_spec_clean.py", "errors_clean.py",
-    "errors_ship_clean.py",
+    "errors_ship_clean.py", "errors_prefix_clean.py",
 ])
 def test_clean_twin_trips_nothing(name):
     extra = (TAXONOMY,) if name.startswith("errors") else ()

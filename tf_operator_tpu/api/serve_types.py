@@ -126,6 +126,53 @@ class AutoscalePolicy:
 
 
 @dataclass
+class PrefixRoutingPolicy:
+    """Fleet-global prefix reuse (fleet/prefixes.py). Disabled by
+    default: the router keeps the plain least-loaded pick. Enabled, the
+    router scores ``load - weight * hit_fraction`` over the replicas'
+    advertised prefix digest chains, routes sessions home while home
+    stays routable, and (``pull``) fetches a missing exact-prefix entry
+    from the replica that advertises it before falling back to a full
+    local prefill."""
+
+    enabled: bool = False
+    # Load units a FULL prefix hit outbids; 0.0 degrades to
+    # least-loaded even when enabled (advertisements still flow).
+    weight: float = 1.0
+    # MUST match the replica engines' paged KV block size — the digest
+    # chain is block-aligned and hashes per block.
+    kv_block: int = 64
+    session_affinity: bool = True
+    pull: bool = True
+    pull_timeout_s: float = 5.0
+    # Hot entries each replica advertises on /healthz (MRU first).
+    advertise_max: int = 32
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "enabled": self.enabled,
+            "weight": self.weight,
+            "kvBlock": self.kv_block,
+            "sessionAffinity": self.session_affinity,
+            "pull": self.pull,
+            "pullTimeoutSeconds": self.pull_timeout_s,
+            "advertiseMax": self.advertise_max,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PrefixRoutingPolicy":
+        return cls(
+            enabled=bool(d.get("enabled", False)),
+            weight=float(d.get("weight", 1.0)),
+            kv_block=int(d.get("kvBlock", 64)),
+            session_affinity=bool(d.get("sessionAffinity", True)),
+            pull=bool(d.get("pull", True)),
+            pull_timeout_s=float(d.get("pullTimeoutSeconds", 5.0)),
+            advertise_max=int(d.get("advertiseMax", 32)),
+        )
+
+
+@dataclass
 class TPUServeSpec:
     """One serving fleet: N replicas of one pod template."""
 
@@ -159,6 +206,11 @@ class TPUServeSpec:
     # deleted and the SIGTERM bounded drain runs.
     scale_down_grace_s: float = 5.0
     autoscale: AutoscalePolicy = field(default_factory=AutoscalePolicy)
+    # Fleet-global prefix reuse: prefix-aware routing + session
+    # affinity + cross-replica KV pulls for this fleet's router.
+    prefix_routing: PrefixRoutingPolicy = field(
+        default_factory=PrefixRoutingPolicy
+    )
     scheduling: SchedulingPolicy = field(default_factory=SchedulingPolicy)
 
     def to_dict(self) -> dict[str, Any]:
@@ -184,6 +236,8 @@ class TPUServeSpec:
         auto = self.autoscale.to_dict()
         if self.autoscale != AutoscalePolicy():
             d["autoscale"] = auto
+        if self.prefix_routing != PrefixRoutingPolicy():
+            d["prefixRouting"] = self.prefix_routing.to_dict()
         sched = self.scheduling.to_dict()
         if sched:
             d["scheduling"] = sched
@@ -205,6 +259,9 @@ class TPUServeSpec:
                 d.get("prefillAutoscale", {})
             ),
             autoscale=AutoscalePolicy.from_dict(d.get("autoscale", {})),
+            prefix_routing=PrefixRoutingPolicy.from_dict(
+                d.get("prefixRouting", {})
+            ),
             scheduling=SchedulingPolicy.from_dict(d.get("scheduling", {})),
         )
 
@@ -353,6 +410,28 @@ def validate_serve_spec(spec: TPUServeSpec) -> None:
             "prefillAutoscale.queueLow must be <= queueHigh "
             "(the hysteresis band must not invert)"
         )
+    pr = spec.prefix_routing
+    if pr.enabled:
+        if pr.kv_block < 1:
+            raise ServeValidationError(
+                "prefixRouting.kvBlock must be >= 1 (and must match "
+                "the replica engines' paged KV block size)"
+            )
+        if pr.weight < 0:
+            raise ServeValidationError(
+                "prefixRouting.weight must be >= 0 (0 routes "
+                "least-loaded; negative would PENALIZE prefix hits)"
+            )
+        if pr.advertise_max < 1:
+            raise ServeValidationError(
+                "prefixRouting.advertiseMax must be >= 1 (nothing "
+                "advertised means nothing to score or pull)"
+            )
+        if pr.pull and pr.pull_timeout_s <= 0:
+            raise ServeValidationError(
+                "prefixRouting.pullTimeoutSeconds must be > 0 when "
+                "pulls are enabled"
+            )
     # Replica ports are portBase + index; index allocation is bounded
     # by the fleet's peak width plus indices quarantined after removal,
     # so the span above portBase must hold twice the widest the fleet

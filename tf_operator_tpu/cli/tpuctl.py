@@ -523,6 +523,12 @@ def cmd_serve(args, master: str) -> int:
             line += (f"  autoscale=[{auto.get('min')}..{auto.get('max')}]"
                      + (f" last: {auto['last_reason']}"
                         if auto.get("last_reason") else ""))
+        # Fleet-global prefix reuse: the decode pool's advertisement
+        # directory (distinct hot-prefix digests / advertising replicas).
+        pfx = fleet.get("prefixes") or {}
+        if pfx.get("digests"):
+            line += (f"  prefixes={pfx['digests']}"
+                     f"@{pfx.get('replicas_advertising', 0)} replicas")
         print(line)
         replicas = (fleet.get("membership") or {}).get("replicas") or []
         if replicas:
@@ -533,11 +539,12 @@ def cmd_serve(args, master: str) -> int:
                   f"{r.get('activeSlots', 0)}/{r.get('maxSlots', 0)}",
                   r.get("queueDepth", 0),
                   f"{r.get('load', 0):.2f}",
+                  r.get("prefixesAdvertised", 0),
                   r.get("modelVersion", "") or "-",
                   r.get("watchdogRestarts", 0)]
                  for r in replicas],
                 ["REPLICA", "STATE", "ENDPOINT", "SLOTS", "QUEUE",
-                 "LOAD", "VERSION", "RESTARTS"],
+                 "LOAD", "PFX", "VERSION", "RESTARTS"],
             ))
         # Disaggregated fleets: the prefill pool, same shape (its QUEUE
         # column is the pool's autoscale signal — prefill backlog).

@@ -14,6 +14,15 @@ so). See docs/fleet-serving.md.
 from tf_operator_tpu.fleet.autoscale import Autoscaler, AutoscaleSnapshot
 from tf_operator_tpu.fleet.controller import FleetConfig, TPUServeController
 from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+from tf_operator_tpu.fleet.prefixes import (
+    AffinityTable,
+    PrefixConfig,
+    best_replica,
+    hit_blocks,
+    holder_of,
+    prefix_score,
+    request_digests,
+)
 from tf_operator_tpu.fleet.replica import (
     FakeReplicaBackend,
     ReplicaServer,
@@ -30,6 +39,7 @@ from tf_operator_tpu.fleet.router import (
 )
 
 __all__ = [
+    "AffinityTable",
     "Autoscaler",
     "AutoscaleSnapshot",
     "DisaggConfig",
@@ -39,11 +49,17 @@ __all__ = [
     "FleetConfig",
     "FleetMembership",
     "FleetRouter",
+    "PrefixConfig",
     "Replica",
     "ReplicaServer",
     "RouterConfig",
     "RouterServer",
     "SupervisorBackend",
     "TPUServeController",
+    "best_replica",
     "fleet_of",
+    "hit_blocks",
+    "holder_of",
+    "prefix_score",
+    "request_digests",
 ]

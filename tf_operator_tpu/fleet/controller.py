@@ -976,6 +976,10 @@ class TPUServeController:
             if pool == "prefill":
                 fleets.setdefault(base, {})["prefill"] = entry
             else:
+                # The fleet-wide prefix directory (distinct advertised
+                # digests / advertising replicas) rides the decode pool
+                # entry: prefix routing reads decode advertisements only.
+                entry["prefixes"] = ms.prefix_directory()
                 fleets.setdefault(base, {}).update(entry)
         return {"fleets": fleets}
 

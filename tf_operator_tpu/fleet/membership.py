@@ -83,6 +83,11 @@ class Replica:
     # inter-token gaps before queues move. Same clear-on-idle contract
     # as ttft_p99_s.
     itl_p99_s: float | None = None
+    # Fleet-global prefix reuse (fleet/prefixes.py): the hot prefix
+    # digest chain this replica advertised on its last probe — hex
+    # chained per-block SHA-1s, MRU first, capped replica-side. The
+    # router's prefix-hit scoring and pull-source selection read it.
+    prefixes: tuple[str, ...] = ()
     # Router-local outstanding requests (begin/end around each send).
     inflight: int = 0
     consecutive_failures: int = 0
@@ -122,6 +127,9 @@ class Replica:
             "consecutiveFailures": self.consecutive_failures,
             "ttftP99Seconds": self.ttft_p99_s,
             "itlP99Seconds": self.itl_p99_s,
+            # Count, not the digest list: /debug/fleet stays readable
+            # and digests are opaque outside the router anyway.
+            "prefixesAdvertised": len(self.prefixes),
             "load": round(self.load, 4),
         }
 
@@ -231,6 +239,12 @@ class FleetMembership:
                 rep.itl_p99_s = float(payload["itl_p99_s"])
             else:
                 rep.itl_p99_s = None
+            # Prefix advertisement (fleet/prefixes.py), clear-on-absent
+            # too: a replica that freed its last entry stops advertising
+            # and must stop attracting prefix-scored traffic.
+            rep.prefixes = tuple(
+                str(d) for d in (payload.get("prefixes") or ())
+            )
             if payload.get("role"):
                 rep.role = str(payload["role"])
             if payload.get("dead"):
@@ -416,6 +430,24 @@ class FleetMembership:
                 if r.routable and r.itl_p99_s is not None
             ]
             return max(vals) if vals else None
+
+    def prefix_directory(self) -> dict[str, int]:
+        """Fleet-wide advertisement roll-up for /debug/fleet and
+        ``tpuctl serve``: distinct advertised digests and per-replica
+        advertisement sizes are summarized as {"digests": distinct,
+        "replicas_advertising": n} — counts, not the digests themselves
+        (opaque hex noise outside the router)."""
+        with self._lock:
+            digests: set[str] = set()
+            advertising = 0
+            for r in self._replicas.values():
+                if r.prefixes:
+                    advertising += 1
+                    digests.update(r.prefixes)
+            return {
+                "digests": len(digests),
+                "replicas_advertising": advertising,
+            }
 
     def mean_occupancy(self) -> float | None:
         """Mean active-slot fraction across routable replicas (None

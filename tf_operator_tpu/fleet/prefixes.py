@@ -1,0 +1,234 @@
+"""Fleet-global prefix reuse: the routing-side state and policy.
+
+PR 6 gave each replica a PrefixCache; PR 9 gave the fleet a router that
+scores load alone. The result at fleet scale is the worst of both: the
+same 8k-token system prompt is re-prefilled once per replica, because
+the replica that already holds it looks exactly as attractive as the
+one that doesn't. This module closes that gap with three pieces of
+fleet state, all riding surfaces that already exist:
+
+- **Advertisement** — every replica's /healthz readiness payload grows
+  a ``prefixes`` list: the hex chained per-block SHA-1 digests of its
+  hottest PrefixCache entries (MRU first, capped engine-side; the
+  digest chain is the SAME one the PR 14 shipped-KV wire format
+  carries, so router and replica hash identically by construction).
+  ``FleetMembership.observe`` ingests it on every probe sweep with the
+  clear-on-absent contract the latency signals use.
+
+- **Scoring** — the router chains the request's own digests
+  (``disagg.chain_digests``, jax-free) and picks by
+  ``load - weight * hit_fraction`` instead of load alone:
+  ``hit_fraction`` is the longest advertised prefix of the request's
+  chain over its total blocks, so a full-prompt hit on an
+  equally-loaded replica always wins the tiebreak, and ``weight``
+  prices how much queued work a prefix hit is allowed to buy
+  (weight=0 degrades to exactly the PR 9 least-loaded pick).
+
+- **Affinity** — multi-turn traffic carries a ``session`` key; the
+  router remembers each session's home replica (LRU-capped table) and
+  routes it home while home stays routable, so every turn after the
+  first lands on the replica that holds the conversation's blocks. A
+  DRAINING/CORDONED/DEAD home falls out of ``routable()`` and the
+  session re-homes through the scored pick — rolling updates re-home,
+  they never 5xx.
+
+On a prefix miss at the chosen replica the router can *pull*: if
+another routable replica advertises the request's exact whole-prompt
+digest, ``GET /prefix/<digest>`` exports that entry in the PR 14 wire
+format and the payload rides the dispatch as ``shipped_kv``, landing
+through the ordinary ``ingest_shipment`` → exact-prefix table-insert
+join — bit-identical to decoding on the holder. Every failure in that
+chain (the typed ``prefix_not_found`` stale-advertisement race, a
+transport error, a ``ship_failed`` rejection at the decode side)
+degrades to local prefill; the pull is an optimization, never a new
+way to fail a request.
+
+Deliberately jax-free, like the rest of fleet/: the router tier tests
+run without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterable, Sequence
+
+from tf_operator_tpu.serve.disagg import chain_digests
+
+__all__ = [
+    "AffinityTable",
+    "PrefixConfig",
+    "best_replica",
+    "hit_blocks",
+    "holder_of",
+    "prefix_score",
+    "request_digests",
+]
+
+
+@dataclass
+class PrefixConfig:
+    """Router-side knobs for prefix-aware routing (the TPUServe spec's
+    ``prefixRouting`` block renders into one of these).
+
+    ``kv_block`` MUST match the replica engines' paged block size: the
+    digest chain is block-aligned, and a router chaining with the wrong
+    block computes digests no replica ever advertises — prefix routing
+    silently degrades to least-loaded (safe, but pointless).
+    """
+
+    kv_block: int = 64
+    # Load units a FULL prefix hit is worth: score = load - weight *
+    # hit_fraction. Replica load is (active + queued + inflight) /
+    # max_slots, so weight=1.0 lets a full hit outbid one max_slots'
+    # worth of queued work; weight=0.0 is exactly least-loaded.
+    weight: float = 1.0
+    session_affinity: bool = True
+    pull: bool = True
+    pull_timeout_s: float = 5.0
+    affinity_capacity: int = 4096
+    # Plumbed to replica engines (prefix_advertise_max), echoed here so
+    # the spec carries one coherent block; the router never reads it.
+    advertise_max: int = 32
+
+    @classmethod
+    def from_policy(cls, policy: Any) -> "PrefixConfig | None":
+        """Render a TPUServe spec ``prefixRouting`` block
+        (api/serve_types.PrefixRoutingPolicy, duck-typed so the api
+        layer stays import-free of fleet/) into the router's config.
+        None when the block is absent or disabled — the router then
+        keeps the plain least-loaded pick."""
+        if policy is None or not getattr(policy, "enabled", False):
+            return None
+        return cls(
+            kv_block=int(policy.kv_block),
+            weight=float(policy.weight),
+            session_affinity=bool(policy.session_affinity),
+            pull=bool(policy.pull),
+            pull_timeout_s=float(policy.pull_timeout_s),
+            advertise_max=int(policy.advertise_max),
+        )
+
+
+def request_digests(tokens: Any, kv_block: int) -> tuple[str, ...]:
+    """The request prompt's chained per-block digest chain (hex,
+    shortest first) — ``disagg.chain_digests`` under a fleet-side name;
+    the last element is the exact whole-prompt digest a pull targets."""
+    return tuple(chain_digests(tokens, kv_block))
+
+
+def hit_blocks(digests: Sequence[str], advertised: Iterable[str]) -> int:
+    """Chain positions of ``digests`` covered by an advertisement: the
+    LONGEST k with digests[k-1] advertised. The chain construction makes
+    position k imply the replica holds blocks [0, k) of this prompt —
+    later positions chain over earlier bytes — so the deepest advertised
+    digest, not the count of matches, is the reuse measure (the
+    advertisement is capped and need not list every ancestor)."""
+    adv = advertised if isinstance(advertised, (set, frozenset)) \
+        else frozenset(advertised)
+    hit = 0
+    for k, d in enumerate(digests):
+        if d in adv:
+            hit = k + 1
+    return hit
+
+
+def prefix_score(load: float, hit: int, total: int,
+                 weight: float) -> float:
+    """``load - weight * hit_fraction`` — lower wins. Documented in
+    docs/fleet-serving.md; keep the two in sync."""
+    frac = (hit / total) if total else 0.0
+    return load - weight * frac
+
+
+def best_replica(replicas: Sequence[Any], digests: Sequence[str],
+                 weight: float):
+    """The prefix-hit-weighted-by-load pick: min score, ties broken by
+    (load, id) so equal-score candidates keep the PR 9 deterministic
+    order and an equal-LOAD candidate with a deeper prefix hit wins
+    (its score is strictly lower). Returns ``(replica, hit_blocks)``;
+    (None, 0) on no candidates."""
+    best = None
+    best_hit = 0
+    best_key = None
+    for r in replicas:
+        hit = hit_blocks(digests, getattr(r, "prefixes", ()) or ())
+        key = (prefix_score(r.load, hit, len(digests), weight),
+               r.load, r.id)
+        if best_key is None or key < best_key:
+            best, best_hit, best_key = r, hit, key
+    return best, best_hit
+
+
+def holder_of(replicas: Sequence[Any], digest: str,
+              exclude: Iterable[str] = ()):
+    """The least-loaded routable replica advertising ``digest`` (the
+    pull source), excluding ids in ``exclude`` (the chosen replica —
+    pulling from yourself is a no-op — and anything the retry loop
+    already struck out). None when nobody advertises it."""
+    skip = set(exclude)
+    holders = [
+        r for r in replicas
+        if r.id not in skip and digest in (getattr(r, "prefixes", ()) or ())
+    ]
+    if not holders:
+        return None
+    return min(holders, key=lambda r: (r.load, r.id))
+
+
+class AffinityTable:
+    """session -> home replica id, LRU-capped and thread-safe (router
+    handler threads write on every successful route; the probe thread
+    never touches it). The table stores ROUTING PREFERENCE, not truth:
+    a home that stopped being routable is simply ignored by the caller
+    and overwritten on the next successful route, so there is no
+    invalidation protocol to get wrong — a rolling update re-homes
+    every session it touches and nothing 5xxs on stale entries."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        self.capacity = max(1, int(capacity))
+        self._homes: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def home(self, session: str) -> str | None:
+        """The session's home replica id (recency-refreshing), or None
+        for a first-turn/unknown session."""
+        if not session:
+            return None
+        with self._lock:
+            rid = self._homes.get(session)
+            if rid is not None:
+                self._homes[session] = self._homes.pop(session)
+            return rid
+
+    def set_home(self, session: str, rid: str) -> None:
+        """Record where the session's turn actually served (called on
+        SUCCESS only — a failed dispatch must not re-home the session
+        onto the replica that just failed it)."""
+        if not session or not rid:
+            return
+        with self._lock:
+            self._homes.pop(session, None)
+            self._homes[session] = rid
+            while len(self._homes) > self.capacity:
+                self._homes.pop(next(iter(self._homes)))
+
+    def forget_replica(self, rid: str) -> None:
+        """Drop every session homed on ``rid`` — optional hygiene when
+        membership marks a replica DEAD (stale homes are harmless, this
+        just keeps the table from pinning them until LRU eviction)."""
+        with self._lock:
+            for s in [s for s, r in self._homes.items() if r == rid]:
+                self._homes.pop(s, None)
+
+    @property
+    def sessions(self) -> int:
+        with self._lock:
+            return len(self._homes)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self._homes),
+                "capacity": self.capacity,
+            }

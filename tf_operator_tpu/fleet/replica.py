@@ -39,6 +39,7 @@ from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
 from tf_operator_tpu.serve.httpapi import QuietHandler, readiness_payload
 from tf_operator_tpu.serve.resilience import (
     Draining,
+    PrefixNotFound,
     error_payload,
     http_status_of,
 )
@@ -99,6 +100,17 @@ class SupervisorBackend:
 
     def debug_snapshot(self) -> dict[str, Any]:
         return self.supervisor.debug_snapshot()
+
+    def advertised_prefixes(self) -> list[str]:
+        """The engine's hot-prefix advertisement — readiness_payload
+        duck-types this off the backend, so real replicas advertise
+        through the same /healthz shape the fakes script."""
+        return self.supervisor.advertised_prefixes()
+
+    def export_prefix(self, digest: str) -> dict[str, Any]:
+        """GET /prefix/<digest>: the supervised engine's wire-format
+        export (raises the typed PrefixNotFound on stale digests)."""
+        return self.supervisor.export_prefix(digest)
 
     def handle(self, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
         import numpy as np
@@ -191,6 +203,14 @@ class FakeReplicaBackend:
         # Shipped-KV bodies seen (disagg chaos tier asserts the routed
         # payload actually reached a decode replica).
         self.shipped_received = 0
+        # Fleet-global prefix reuse, scriptable: ``prefixes`` is what
+        # /healthz advertises; ``prefix_store`` maps digest -> the wire
+        # payload GET /prefix/<digest> serves (absent digest answers
+        # the typed prefix_not_found — the stale-advertisement script:
+        # advertise a digest WITHOUT storing it).
+        self.prefixes: list[str] = []
+        self.prefix_store: dict[str, dict] = {}
+        self.prefix_exports = 0
         self._lock = threading.Lock()
         self._inflight = 0
         self._scripted: list[Exception] = []
@@ -203,6 +223,18 @@ class FakeReplicaBackend:
     def fail_with(self, exc: Exception, n: int = 1) -> None:
         with self._lock:
             self._scripted.extend(exc for _ in range(n))
+
+    def advertised_prefixes(self) -> list[str]:
+        return list(self.prefixes)
+
+    def export_prefix(self, digest: str) -> dict[str, Any]:
+        payload = self.prefix_store.get(digest)
+        if payload is None:
+            raise PrefixNotFound(f"no live exact prefix entry for "
+                                 f"{digest[:12]}")
+        with self._lock:
+            self.prefix_exports += 1
+        return payload
 
     def handle(self, body: dict[str, Any]) -> tuple[int, dict[str, Any]]:
         with self._lock:
@@ -266,6 +298,26 @@ class ReplicaServer:
                     outer.backend, "debug_snapshot"
                 ):
                     self.send_json(200, outer.backend.debug_snapshot())
+                elif path.startswith("/prefix/") and hasattr(
+                    outer.backend, "export_prefix"
+                ):
+                    # Fleet-global prefix reuse: export one live
+                    # PrefixCache entry in the shipped-KV wire format.
+                    # Stale digests answer the typed prefix_not_found
+                    # (404) — the pulling router degrades to local
+                    # prefill, never fails the request.
+                    digest = path[len("/prefix/"):]
+                    try:
+                        shipment = outer.backend.export_prefix(digest)
+                    except Exception as exc:  # noqa: BLE001 — typed out
+                        payload = error_payload(exc)
+                        payload["replica"] = outer.replica_id
+                        self.send_json(http_status_of(exc), payload)
+                        return
+                    self.send_json(200, {
+                        "shipment": shipment,
+                        "replica": outer.replica_id,
+                    })
                 elif path == "/debug/traces":
                     self.send_serve_traces()
                 elif path == "/metrics":

@@ -48,7 +48,18 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from tf_operator_tpu.fleet.membership import DEAD, FleetMembership, Replica
+from tf_operator_tpu.fleet.prefixes import (
+    AffinityTable,
+    PrefixConfig,
+    best_replica,
+    hit_blocks,
+    holder_of,
+    request_digests,
+)
 from tf_operator_tpu.runtime.metrics import (
+    FLEET_PREFIX_HITS,
+    FLEET_PREFIX_PULLS,
+    FLEET_PREFIX_TOKENS_SAVED,
     FLEET_ROUTER_FAILOVERS,
     FLEET_ROUTER_REQUESTS,
     FLEET_ROUTER_RETRIES,
@@ -84,24 +95,59 @@ class RouterConfig:
 class FleetRouter:
     def __init__(self, membership: FleetMembership,
                  send_fn: Callable[[Replica, dict, float], tuple[int, dict]],
-                 config: RouterConfig | None = None) -> None:
+                 config: RouterConfig | None = None, *,
+                 prefix: PrefixConfig | None = None,
+                 pull_fn: Callable[
+                     [Replica, str, float], tuple[int, dict]
+                 ] | None = None) -> None:
         self.membership = membership
         self._send = send_fn
         self.cfg = config or RouterConfig()
+        # Fleet-global prefix reuse (fleet/prefixes.py): None keeps the
+        # PR 9 least-loaded pick byte-for-byte.
+        self.prefix_cfg = prefix
+        self._pull_fn = pull_fn or http_pull
+        self.affinity = AffinityTable(
+            prefix.affinity_capacity if prefix else 1
+        )
         self._lock = threading.Lock()
         self.requests = 0
         self.retries = 0
         self.failovers = 0
+        self.prefix_hits = 0
+        self.prefix_pulls = 0
+        self.prefix_pull_misses = 0
+        self.prefix_pull_fallbacks = 0
+        self.prefix_tokens_saved = 0
+        self.affinity_routes = 0
 
     # -- picking -----------------------------------------------------------
 
-    def pick(self, exclude: frozenset[str] = frozenset()) -> Replica | None:
+    def pick(self, exclude: frozenset[str] = frozenset(),
+             digests: tuple[str, ...] = (),
+             session: str = "") -> Replica | None:
         candidates = [
             r for r in self.membership.routable() if r.id not in exclude
         ]
         if not candidates:
             return None
-        return min(candidates, key=lambda r: (r.load, r.id))
+        pfx = self.prefix_cfg
+        if pfx is None or not digests:
+            return min(candidates, key=lambda r: (r.load, r.id))
+        if pfx.session_affinity and session:
+            home = self.affinity.home(session)
+            if home is not None:
+                for r in candidates:
+                    # Home is honored only while ROUTABLE (and not
+                    # struck out by this request's retry loop): a
+                    # draining/dead home simply isn't a candidate, and
+                    # the session re-homes through the scored pick.
+                    if r.id == home:
+                        with self._lock:
+                            self.affinity_routes += 1
+                        return r
+        rep, _ = best_replica(candidates, digests, pfx.weight)
+        return rep
 
     # -- routing -----------------------------------------------------------
 
@@ -119,6 +165,23 @@ class FleetRouter:
         body = dict(body, request_id=rid)
         with self._lock:
             self.requests += 1
+        # Prefix-aware context, computed ONCE per request: the prompt's
+        # digest chain (same chained per-block SHA-1 the replicas
+        # advertise and the shipped-KV wire format verifies) and the
+        # session key for affinity. Single-row prompts only — shipping
+        # prefills one row, and multi-row bodies route exactly as the
+        # PR 9 pick did.
+        pfx = self.prefix_cfg
+        digests: tuple[str, ...] = ()
+        session = ""
+        prompt_len = 0
+        if pfx is not None:
+            toks = body.get("tokens")
+            if (isinstance(toks, list) and len(toks) == 1
+                    and isinstance(toks[0], list) and toks[0]):
+                digests = request_digests(toks[0], pfx.kv_block)
+                prompt_len = len(toks[0])
+            session = str(body.get("session") or "")
         exclude: set[str] = set()
         attempts = 0
         last: tuple[int, dict] | None = None
@@ -127,8 +190,16 @@ class FleetRouter:
         # tpu_fleet_router_retries_total means what it says ("on a
         # DIFFERENT replica") even in a single-replica fleet.
         pending_retry: tuple[str, str] | None = None
+        # ship_failed on a router-pulled shipment retries the SAME
+        # replica once, shipment stripped (degrade to local prefill —
+        # the replica is healthy, the pulled bytes are what failed).
+        retry_same: Replica | None = None
+        pull_disabled = False
         while attempts <= self.cfg.retries:
-            rep = self.pick(frozenset(exclude))
+            if retry_same is not None:
+                rep, retry_same = retry_same, None
+            else:
+                rep = self.pick(frozenset(exclude), digests, session)
             if rep is None:
                 break
             if pending_retry is not None:
@@ -142,10 +213,30 @@ class FleetRouter:
                     f"(attempt {attempts + 1})"
                 )
             attempts += 1
+            # Prefix pull: the chosen replica misses the request's EXACT
+            # whole-prompt digest but another routable replica advertises
+            # it — fetch that entry's blocks in the shipped-KV wire
+            # format and ride them on the dispatch. Partial-chain hits
+            # affect scoring only (the entry table stores whole-prompt
+            # entries with their logits; those are what export cleanly).
+            attached: dict | None = None
+            if (pfx is not None and pfx.pull and digests
+                    and not pull_disabled
+                    and "shipped_kv" not in body
+                    and digests[-1] not in (rep.prefixes or ())):
+                holder = holder_of(
+                    self.membership.routable(), digests[-1],
+                    exclude | {rep.id},
+                )
+                if holder is not None:
+                    attached = self._pull(holder, digests[-1], rid)
+            send_body = body if attached is None else dict(
+                body, shipped_kv=attached
+            )
             self.membership.begin(rep.id)
             t_send = time.monotonic()
             try:
-                status, payload = self._send(rep, body, timeout)
+                status, payload = self._send(rep, send_body, timeout)
             except Exception as exc:  # noqa: BLE001 — transport failure:
                 # the replica did not answer at all; it may be mid-death.
                 SERVE_TRACER.record(
@@ -179,6 +270,9 @@ class FleetRouter:
             )
             if status < 400:
                 FLEET_ROUTER_REQUESTS.inc(outcome="ok")
+                self._note_prefix_success(
+                    rep, digests, prompt_len, attached, session
+                )
                 return status, payload
             code = payload.get("code", "")
             # Membership side effects come FIRST: even when the retry
@@ -188,6 +282,24 @@ class FleetRouter:
                 self.membership.mark_dead(rep.id)
             elif code == "draining":
                 self.membership.mark_draining(rep.id)
+            if code == "ship_failed" and attached is not None:
+                # The PULLED bytes failed replica-side verification
+                # (stale export, geometry drift) — the replica itself is
+                # healthy, so degrade to local prefill THERE: same
+                # replica, shipment stripped, pulls off for the rest of
+                # this request. Consumes an attempt, so the loop stays
+                # bounded.
+                with self._lock:
+                    self.prefix_pull_fallbacks += 1
+                FLEET_PREFIX_PULLS.inc(outcome="ship_failed")
+                LOG.warning(
+                    f"pulled prefix rejected by {rep.id} (ship_failed); "
+                    "retrying there with local prefill"
+                )
+                pull_disabled = True
+                retry_same = rep
+                last = (status, payload)
+                continue
             if not (payload.get("retryable") and code in RETRY_ELSEWHERE):
                 FLEET_ROUTER_REQUESTS.inc(outcome="typed")
                 return status, payload
@@ -212,14 +324,113 @@ class FleetRouter:
             "attempts": attempts, "request_id": rid,
         }
 
+    # -- prefix reuse ------------------------------------------------------
+
+    def _pull(self, holder: Replica, digest: str,
+              rid: str) -> dict | None:
+        """Fetch ``digest``'s exported shipment from ``holder``
+        (GET /prefix/<digest>). Returns the shipment payload or None —
+        EVERY failure mode (typed prefix_not_found from a stale
+        advertisement, transport error, malformed answer) degrades to
+        local prefill at the chosen replica; a pull never fails the
+        request."""
+        t0 = time.monotonic()
+        try:
+            status, payload = self._pull_fn(
+                holder, digest, self.prefix_cfg.pull_timeout_s
+            )
+        except Exception as exc:  # noqa: BLE001 — holder unreachable:
+            # it may be mid-death; the prober will notice. Degrade.
+            SERVE_TRACER.record(
+                "prefix.pull", t0, time.monotonic(),
+                request_id=rid, holder=holder.id,
+                outcome="transport_error",
+            )
+            with self._lock:
+                self.prefix_pull_misses += 1
+            FLEET_PREFIX_PULLS.inc(outcome="transport_error")
+            LOG.warning(
+                f"prefix pull from {holder.id} failed ({exc!r}); "
+                "degrading to local prefill"
+            )
+            return None
+        shipment = payload.get("shipment") if status < 400 else None
+        SERVE_TRACER.record(
+            "prefix.pull", t0, time.monotonic(),
+            request_id=rid, holder=holder.id, status=status,
+            outcome="ok" if shipment else
+            (payload.get("code") or "error"),
+        )
+        if shipment:
+            with self._lock:
+                self.prefix_pulls += 1
+            FLEET_PREFIX_PULLS.inc(outcome="ok")
+            return shipment
+        # Typed miss — usually prefix_not_found, the advertisement
+        # raced the holder's LRU. The holder is fine; just prefill.
+        with self._lock:
+            self.prefix_pull_misses += 1
+        FLEET_PREFIX_PULLS.inc(
+            outcome=payload.get("code") or "error"
+        )
+        return None
+
+    def _note_prefix_success(self, rep: Replica,
+                             digests: tuple[str, ...], prompt_len: int,
+                             attached: dict | None,
+                             session: str) -> None:
+        """Success-path prefix bookkeeping: hit/saved counters and the
+        session's new home. tokens_saved is the ROUTER'S estimate of
+        prefill work avoided — exact-chain hits and pulls save the whole
+        prompt, partial hits save the covered whole blocks (the replica
+        side's kv_prefill_tokens_saved is the ground truth; this one
+        exists so the fleet number needs no replica scrape)."""
+        pfx = self.prefix_cfg
+        if pfx is None or not digests:
+            return
+        saved = 0
+        if attached is not None:
+            # Pulled the exact whole-prompt entry: lands as a
+            # table-insert join, the whole prefill avoided.
+            saved = prompt_len
+        else:
+            hit = hit_blocks(digests, rep.prefixes or ())
+            if hit:
+                with self._lock:
+                    self.prefix_hits += 1
+                FLEET_PREFIX_HITS.inc()
+                saved = prompt_len if hit == len(digests) \
+                    else hit * pfx.kv_block
+        if saved:
+            with self._lock:
+                self.prefix_tokens_saved += saved
+            FLEET_PREFIX_TOKENS_SAVED.inc(saved)
+        if pfx.session_affinity and session:
+            # SUCCESS only: a failed dispatch must not re-home the
+            # session onto the replica that just failed it.
+            self.affinity.set_home(session, rep.id)
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
-            return {
+            snap = {
                 "requests": self.requests,
                 "retries": self.retries,
                 "failovers": self.failovers,
                 "retry_budget": self.cfg.retries,
             }
+            if self.prefix_cfg is not None:
+                snap["prefix"] = {
+                    "hits": self.prefix_hits,
+                    "pulls": self.prefix_pulls,
+                    "pull_misses": self.prefix_pull_misses,
+                    "pull_fallbacks": self.prefix_pull_fallbacks,
+                    "tokens_saved": self.prefix_tokens_saved,
+                    "affinity_routes": self.affinity_routes,
+                    "weight": self.prefix_cfg.weight,
+                    "kv_block": self.prefix_cfg.kv_block,
+                    "affinity": self.affinity.snapshot(),
+                }
+        return snap
 
 
 # ---------------------------------------------------------------------------
@@ -261,6 +472,26 @@ def http_ship(rep: Replica, body: dict, timeout: float) -> tuple[int, dict]:
     PrefillServer) — the two-stage dispatch's stage-1 transport."""
     return _http_post_json(f"http://{rep.endpoint}/prefill", body,
                            timeout)
+
+
+def http_pull(rep: Replica, digest: str,
+              timeout: float) -> tuple[int, dict]:
+    """GET the holder's /prefix/<digest> (fleet/replica.py): 200 with
+    ``{"shipment": <wire payload>}`` or a typed error body — the stale
+    advertisement race answers ``prefix_not_found`` (404), which the
+    router degrades to local prefill. Only transport failures raise."""
+    try:
+        with urllib.request.urlopen(
+            f"http://{rep.endpoint}/prefix/{digest}", timeout=timeout
+        ) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {"error": str(e), "code": "internal",
+                       "retryable": False}
+        return e.code, payload
 
 
 def http_probe(endpoint: str, timeout: float = 2.0) -> dict:
@@ -324,14 +555,16 @@ class RouterServer:
                  host: str = "127.0.0.1", port: int = 0,
                  probe_fn: Callable[[str], dict] | None = None,
                  trace_fn: Callable[[str], dict] | None = None,
-                 extra_debug: Callable[[], dict] | None = None) -> None:
+                 extra_debug: Callable[[], dict] | None = None,
+                 prefix: PrefixConfig | None = None) -> None:
         from http.server import ThreadingHTTPServer
 
         from tf_operator_tpu.serve.httpapi import QuietHandler
 
         self.membership = membership
         cfg = config or RouterConfig()
-        self.router = router or FleetRouter(membership, http_send, cfg)
+        self.router = router or FleetRouter(membership, http_send, cfg,
+                                            prefix=prefix)
         self.cfg = cfg
         self._probe_fn = probe_fn or (
             lambda ep: http_probe(ep, cfg.probe_timeout_s)
@@ -399,6 +632,10 @@ class RouterServer:
         snap = {
             "membership": self.membership.snapshot(),
             "router": self.router.snapshot(),
+            # The fleet-wide prefix directory roll-up (how many distinct
+            # digests are advertised, by how many replicas) — the
+            # per-replica lists stay in membership.snapshot() as counts.
+            "prefixes": self.membership.prefix_directory(),
         }
         if self._extra_debug is not None:
             snap.update(self._extra_debug())

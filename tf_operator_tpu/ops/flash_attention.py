@@ -146,6 +146,12 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # probe_qblock arbitration output has landed anywhere under
 # docs/window_r05/. Trigger stays OPEN; cap stays 1024; the qblock
 # stage keeps its front slot in window_autorun's unmeasured set.
+# Re-checked (PR 16, 2026-08-07): unchanged — window_r05 (stamps
+# 20260801T082804 + 20260801T091000_hostlocal) remains the newest
+# window and neither stamp carries probe_qblock arbitration output
+# (the 082804 run still lists only the single-shot flashblocks line).
+# Trigger stays OPEN; cap stays 1024; qblock keeps its front slot in
+# window_autorun's unmeasured set for the next hardware window.
 MAX_Q_BLOCK = 1024
 
 

@@ -604,6 +604,28 @@ FLEET_SHIP_TOTAL = REGISTRY.counter(
     "request re-ran with local prefill",
     ("outcome",),
 )
+FLEET_PREFIX_HITS = REGISTRY.counter(
+    "tpu_fleet_prefix_hits_total",
+    "Requests the prefix-aware router landed on a replica already "
+    "advertising a prefix of the prompt's digest chain (scoring hits; "
+    "pulls are counted separately in tpu_fleet_prefix_pulls_total)",
+)
+FLEET_PREFIX_PULLS = REGISTRY.counter(
+    "tpu_fleet_prefix_pulls_total",
+    "Cross-replica prefix pulls (GET /prefix/<digest>) by outcome: "
+    "ok = shipment attached to the dispatch; prefix_not_found = the "
+    "advertisement raced the holder's LRU (degraded to local prefill); "
+    "transport_error = holder unreachable; ship_failed = the decode "
+    "replica rejected the pulled bytes and re-ran with local prefill",
+    ("outcome",),
+)
+FLEET_PREFIX_TOKENS_SAVED = REGISTRY.counter(
+    "tpu_fleet_prefix_tokens_saved_total",
+    "Router-side estimate of prefill tokens avoided by prefix-aware "
+    "routing (exact hits and pulls save the whole prompt, partial "
+    "chain hits the covered blocks); the replicas' "
+    "tpu_serve_kv_prefill_tokens_saved_total is the ground truth",
+)
 
 # -- tracing (runtime/tracing.py): declared here, not there, so the
 # registry module stays import-leaf and the tracer can import it --------------

@@ -128,12 +128,15 @@ def _dec(d: dict) -> np.ndarray:
 
 
 def _rows_sha1(rows: dict) -> str:
-    """One SHA-1 over every row leaf in path order: the payload
+    """One SHA-1 over every row leaf in (path, part) order: the payload
     integrity check (the token digests prove WHICH prompt, this proves
-    the K/V bytes survived the hop)."""
+    the K/V bytes survived the hop). Iterating the parts present in
+    sorted order keeps pre-kv8 payloads (key/value only) hashing exactly
+    as wire v1 always did — sorted(("key", "value")) is ("key",
+    "value") — while kv-int8 shipments fold their scale sidecars in."""
     h = hashlib.sha1()
     for path in sorted(rows):
-        for part in ("key", "value"):
+        for part in sorted(rows[path]):
             h.update(path.encode())
             h.update(np.ascontiguousarray(rows[path][part]).tobytes())
     return h.hexdigest()
@@ -150,7 +153,9 @@ class Shipment:
 
     tokens: np.ndarray                 # [L] int32 prompt
     kv_block: int
-    rows: dict[str, dict[str, np.ndarray]]  # path -> key/value [R,KV,Dh]
+    # path -> key/value [R, KV, Dh] (+ key_scale/value_scale [R, KV]
+    # f32 sidecars when the prefill side ran a kv-int8 cache)
+    rows: dict[str, dict[str, np.ndarray]]
     logits: np.ndarray                 # [vocab] last-position sampling row
     digests: tuple[str, ...] = ()
 
@@ -159,16 +164,30 @@ class Shipment:
         return int(self.tokens.shape[0])
 
 
+# Dense/solo cache row leaf -> its wire part name. K/V rows shipped
+# since wire v1; the kv-int8 scale sidecars ride as two more leaves
+# with [R, KV] rows (present only when the prefill side ran a kv-int8
+# cache — kvcache.POOL_WIRE_PARTS names the pool twins on the ingest
+# side).
+_DENSE_WIRE_PARTS = {
+    "cached_key": "key",
+    "cached_value": "value",
+    "key_scale": "key_scale",
+    "value_scale": "value_scale",
+}
+
+
 def _cache_row_paths(cache: Any, prefix: tuple = ()):
-    """Yield (path, leaf_name, leaf) for the dense K/V row leaves of a
-    solo decode cache — path is the PARENT module path, which is shared
-    with the paged tree's pool leaves (same model, same modules)."""
+    """Yield (path, leaf_name, leaf) for the dense K/V row leaves (and
+    kv-int8 scale sidecars, when present) of a solo decode cache — path
+    is the PARENT module path, which is shared with the paged tree's
+    pool leaves (same model, same modules)."""
     from collections.abc import Mapping
 
     if not isinstance(cache, Mapping):
         return
     for name, leaf in cache.items():
-        if name in ("cached_key", "cached_value"):
+        if name in _DENSE_WIRE_PARTS:
             yield "/".join(prefix), name, leaf
         elif isinstance(leaf, Mapping):
             yield from _cache_row_paths(leaf, prefix + (name,))
@@ -187,10 +206,10 @@ def export_shipment(cache: Any, tokens: np.ndarray, logits: np.ndarray,
     cap_rows = -(-L // kv_block) * kv_block
     rows: dict[str, dict[str, np.ndarray]] = {}
     for path, name, leaf in _cache_row_paths(cache):
-        arr = np.asarray(leaf)[0, :cap_rows]  # [1, S, KV, Dh] -> rows
-        rows.setdefault(path, {})[
-            "key" if name == "cached_key" else "value"
-        ] = arr
+        # [1, S, KV, Dh] -> [cap, KV, Dh] rows (scale sidecars:
+        # [1, S, KV] -> [cap, KV])
+        arr = np.asarray(leaf)[0, :cap_rows]
+        rows.setdefault(path, {})[_DENSE_WIRE_PARTS[name]] = arr
     payload = {
         "version": WIRE_VERSION,
         "tokens": tokens.tolist(),
@@ -248,6 +267,23 @@ def decode_shipment(payload: dict,
                     f"row leaf {path}:{part} has wrong geometry "
                     f"(want [{cap_rows}, KV, Dh])"
                 )
+        # kv-int8 scale sidecars are optional per payload (present only
+        # when the prefill side quantized); the INGESTING engine's
+        # coverage check is what enforces match-the-pool.
+        for part in ("key_scale", "value_scale"):
+            arr = kv.get(part)
+            if arr is not None and (
+                arr.ndim != 2 or arr.shape[0] != cap_rows
+            ):
+                raise ShipFailed(
+                    f"row leaf {path}:{part} has wrong geometry "
+                    f"(want [{cap_rows}, KV])"
+                )
+        unknown = set(kv) - set(_DENSE_WIRE_PARTS.values())
+        if unknown:
+            raise ShipFailed(
+                f"row leaf {path} carries unknown parts {sorted(unknown)}"
+            )
     if payload.get("rows_sha1") != _rows_sha1(rows):
         raise ShipFailed("shipped K/V row checksum mismatch")
     logits = _dec(payload["logits"]) if payload.get("logits") else None

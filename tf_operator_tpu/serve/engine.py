@@ -148,6 +148,7 @@ from tf_operator_tpu.runtime.tracing import SERVE_TRACER
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR, InjectedFault
 from tf_operator_tpu.serve.kvcache import (
     POOL_KEYS,
+    POOL_WIRE_PARTS,
     BlockAllocator,
     PrefixCache,
     SlotAllocator,
@@ -415,6 +416,21 @@ class ContinuousEngine:
             self.prefill_tokens_saved = 0
             self.shipments_ingested = 0
             self.ship_tokens_ingested = 0
+            # Fleet-global prefix reuse: /healthz advertisement width
+            # and the /prefix/<digest> export counter.
+            self.prefix_advertise_max = 32
+            self.prefix_exports = 0
+            # Prefix retention — 0 disables (solo engines keep the
+            # historical free-everything-on-retire accounting). When
+            # > 0, each completed prompt's exact entry is pinned past
+            # its slot by one extra pool reference per block, bounded
+            # LRU; ALL retained holds reclaim before admission or
+            # ingest ever reports pool exhaustion, so retention can
+            # delay live work but never starve it. Fleet serving
+            # (examples/serve_lm.py) turns this on so advertisement,
+            # exact re-joins, and /prefix exports survive completion.
+            self.prefix_retain_max = 0
+            self._retained: dict[bytes, list[int]] = {}
             self._set_block_gauges()
         else:
             self.table_len = None
@@ -669,6 +685,13 @@ class ContinuousEngine:
         cow_needed = n == L and n % B != 0
         need = cap - shared_entries + (1 if cow_needed else 0)
         priv = self.blocks.alloc(need)
+        if priv is None and self._retained:
+            # Pool pressure: retained (completed-request) prefix holds
+            # give way to live admissions before the caller is ever
+            # told to queue — sparing the donor this very plan is
+            # about to share from.
+            self._evict_retained(until_free=need, keep=shared)
+            priv = self.blocks.alloc(need)
         if priv is None:
             return None  # block exhaustion: the caller queues
         if n:
@@ -705,6 +728,51 @@ class ContinuousEngine:
             self.prefix.invalidate_blocks(freed)
         self._set_block_gauges()
 
+    # -- prefix retention (fleet-global prefix reuse) ---------------------
+
+    def _retain_prefix(self, tokens) -> None:
+        """Pin a just-registered prompt's EXACT prefix entry past its
+        slot: one extra pool reference per block, recorded in the
+        bounded ``_retained`` LRU. A duplicate prompt refreshes
+        recency without double-referencing (first-writer-wins keeps
+        the entry's blocks unchanged). No-op unless retention is on."""
+        if self.prefix_retain_max <= 0:
+            return
+        hold = self.prefix.exact_hold(tokens)
+        if hold is None:
+            return
+        key, blks = hold
+        old = self._retained.pop(key, None)
+        if old is not None:
+            self._retained[key] = old
+            return
+        self.blocks.ref(blks)
+        self._retained[key] = list(blks)
+        self._evict_retained()
+
+    def _evict_retained(self, until_free: int | None = None,
+                        keep=()) -> None:
+        """Drop retained prefix holds, oldest first: down to the
+        ``prefix_retain_max`` cap (no argument), or until the pool has
+        ``until_free`` free blocks (admission/ingest pressure). Holds
+        overlapping ``keep`` — the donor an in-flight plan is sharing
+        from — are spared."""
+        keep = set(int(b) for b in keep)
+        for key in list(self._retained):
+            if until_free is None:
+                if len(self._retained) <= max(
+                        0, int(self.prefix_retain_max)):
+                    break
+            elif self.blocks.free_blocks >= until_free:
+                break
+            blks = self._retained[key]
+            if keep and not keep.isdisjoint(blks):
+                continue
+            del self._retained[key]
+            freed = self.blocks.free(blks)
+            if freed:
+                self.prefix.invalidate_blocks(freed)
+
     # -- shipped-KV ingest (disaggregated prefill) ------------------------
 
     def ingest_shipment(self, shp: Any,
@@ -732,14 +800,16 @@ class ContinuousEngine:
         The decode step is untouched: ingest adds ONE new executable
         (the pool write), compiled outside the decode-step cache, so
         ``compiles == warmup_compiles`` holds through any number of
-        ingests (pinned in tests/test_serve_disagg.py)."""
+        ingests (pinned in tests/test_serve_disagg.py).
+
+        kv-int8 pools ingest too (wire v1 grew the f32 scale-row
+        sidecars as two more parts per layer): the coverage check in
+        ``_padded_ship_rows`` derives the required parts from the LIVE
+        pool leaves, so a kv8 engine rejects a scale-less shipment and
+        a bf16 engine rejects a quantized one — both as ValueError →
+        local prefill, never silent garbage."""
         if not self.kv_paged:
             return None
-        if self.cfg.kv_int8:
-            raise ValueError(
-                "shipped-KV ingest does not support kv-int8 pools (the "
-                "wire format carries no scale sidecars); prefill locally"
-            )
         if int(shp.kv_block) != self.kv_block:
             raise ValueError(
                 f"shipment kv_block={shp.kv_block} != engine "
@@ -766,6 +836,8 @@ class ContinuousEngine:
         need = -(-(L + int(reserve_steps)) // B)
         if L % B:
             need += 1
+        if self.blocks.free_blocks < need and self._retained:
+            self._evict_retained(until_free=need)
         if self.blocks.free_blocks < need:
             return None  # pool exhaustion: the caller requeues
         blocks = self.blocks.alloc(cap)
@@ -792,6 +864,7 @@ class ContinuousEngine:
         self.prefix.register(
             tokens, blocks, np.asarray(shp.logits, np.float32)
         )
+        self._retain_prefix(tokens)
         self.shipments_ingested += 1
         self.ship_tokens_ingested += L
         SERVE_SHIP_TOKENS_TOTAL.inc(L)
@@ -799,34 +872,49 @@ class ContinuousEngine:
         return ShipHold(tuple(blocks), L)
 
     def _padded_ship_rows(self, shp: Any, cap_rows: int) -> dict:
-        """Shipped rows padded to the full [max_seq_len, KV, Dh] shape
+        """Shipped rows padded to the full [max_seq_len, ...] shape
         (one executable serves every shipment; pad rows scatter into
-        the pinned garbage block), shape-checked against the pool."""
+        the pinned garbage block), shape-checked against the pool. The
+        required parts per layer come from the LIVE pool leaves
+        (POOL_WIRE_PARTS): K/V rows always, the f32 scale sidecars
+        exactly when the pool is kv-int8 — a shipment that doesn't
+        match the pool's quantization is a geometry error, never a
+        silent partial write."""
         S = self.cfg.max_seq_len
-        kv, dh = self.cfg.kv_heads, self.cfg.head_dim
-        out: dict[str, dict[str, np.ndarray]] = {}
-        for path, parts in shp.rows.items():
-            out[path] = {}
-            for name in ("key", "value"):
-                arr = np.asarray(parts[name])
-                if arr.shape != (cap_rows, kv, dh):
-                    raise ValueError(
-                        f"shipped rows {path}:{name} shape {arr.shape} "
-                        f"!= ({cap_rows}, {kv}, {dh})"
-                    )
-                padded = np.zeros((S, kv, dh), arr.dtype)
-                padded[:cap_rows] = arr
-                out[path][name] = padded
+        # layer path -> wire part -> the pool leaf's per-row trailing
+        # shape ((KV, Dh) for K/V, (KV,) for scale sidecars).
+        want: dict[str, dict[str, tuple]] = {}
+        for path, name, leaf in _ship_row_paths(self._cache):
+            want.setdefault(path, {})[POOL_WIRE_PARTS[name]] = tuple(
+                leaf.shape[2:]
+            )
         # Every attention layer must be covered: a partial shipment
         # would decode garbage for the missing layers.
-        want = {
-            path for path, _, _ in _ship_row_paths(self._cache)
-        }
-        if set(out) != want:
+        if set(shp.rows) != set(want):
             raise ValueError(
-                f"shipment covers layers {sorted(out)} but the engine "
-                f"has {sorted(want)}"
+                f"shipment covers layers {sorted(shp.rows)} but the "
+                f"engine has {sorted(want)}"
             )
+        out: dict[str, dict[str, np.ndarray]] = {}
+        for path, parts in want.items():
+            if set(shp.rows[path]) != set(parts):
+                raise ValueError(
+                    f"shipment rows {path} carry parts "
+                    f"{sorted(shp.rows[path])} but the pool needs "
+                    f"{sorted(parts)} (kv-int8 pools require the scale "
+                    f"sidecars; bf16 pools reject them)"
+                )
+            out[path] = {}
+            for name, trail in parts.items():
+                arr = np.asarray(shp.rows[path][name])
+                if arr.shape != (cap_rows,) + trail:
+                    raise ValueError(
+                        f"shipped rows {path}:{name} shape {arr.shape} "
+                        f"!= {(cap_rows,) + trail}"
+                    )
+                padded = np.zeros((S,) + trail, arr.dtype)
+                padded[:cap_rows] = arr
+                out[path][name] = padded
         return out
 
     def release_shipment(self, hold: ShipHold | None) -> None:
@@ -842,6 +930,64 @@ class ContinuousEngine:
         if freed:
             self.prefix.invalidate_blocks(freed)
         self._set_block_gauges()
+
+    # -- fleet-global prefix reuse (fleet/prefixes.py) --------------------
+
+    def advertised_prefixes(self) -> list[str]:
+        """Hex digests of the hottest PrefixCache entries, MRU first,
+        capped at ``prefix_advertise_max`` — the /healthz advertisement
+        the fleet router scores prefix hits from. Host-side read under
+        the PrefixCache lock; safe from any thread. Empty on dense
+        engines (no block pool, nothing pullable)."""
+        if not self.kv_paged:
+            return []
+        return self.prefix.advertise(self.prefix_advertise_max)
+
+    def export_prefix(self, digest_hex: str) -> dict:
+        """The replica side of a cross-replica prefix pull
+        (``GET /prefix/<digest>``): export the live EXACT PrefixCache
+        entry under ``digest_hex`` as the PR 14 shipped-KV wire payload
+        — gather its blocks back into the dense row layout (the
+        shared-prefix seed executable, one trace for every export) and
+        render with ``disagg.export_shipment``, so the puller lands it
+        through the ordinary ``ingest_shipment`` → exact-prefix
+        table-insert path, bit-identical to decoding on this replica.
+
+        Raises the typed ``PrefixNotFound`` when the digest names no
+        live exact entry — the stale-advertisement race (the blocks
+        were freed, or the digest was only ever a longer prompt's
+        aligned prefix, which has no sampling logits to ship). The
+        entry is re-checked against the cache snapshot AFTER the
+        snapshot is taken, so a retire racing this export degrades to
+        the typed miss instead of shipping reused-block rows.
+
+        MUST run loop-serialized on a live engine (the scheduler's
+        ``call_engine`` posts it between steps): the decode executables
+        donate ``self._cache``, so a concurrent device read from
+        another thread would race the donation."""
+        from tf_operator_tpu.serve.disagg import export_shipment
+        from tf_operator_tpu.serve.resilience import PrefixNotFound
+
+        if not self.kv_paged:
+            raise PrefixNotFound("dense engine holds no prefix blocks")
+        entry = self.prefix.entry_for_hex(digest_hex)
+        if entry is None:
+            raise PrefixNotFound(
+                f"no live exact prefix entry for {digest_hex[:12]}"
+            )
+        tokens, n, blocks, logits = entry
+        cache = self._cache
+        again = self.prefix.entry_for_hex(digest_hex)
+        if again is None or tuple(again[2]) != tuple(blocks):
+            raise PrefixNotFound(
+                f"prefix entry {digest_hex[:12]} retired mid-export"
+            )
+        table = np.zeros(self.table_len, np.int32)
+        table[: len(blocks)] = blocks
+        solo = self._gather(cache, jnp.asarray(table))
+        payload = export_shipment(solo, tokens, logits, self.kv_block)
+        self.prefix_exports += 1
+        return payload
 
     # -- prefill / join ---------------------------------------------------
 
@@ -1079,6 +1225,7 @@ class ContinuousEngine:
         ]
         self.prefix.register(plan.tokens[0], prompt_blocks,
                              np.asarray(row))
+        self._retain_prefix(plan.tokens[0])
         if plan.shared_tokens:
             self.prefill_tokens_saved += plan.shared_tokens
             SERVE_PREFILL_SAVED_TOTAL.inc(plan.shared_tokens)
@@ -1503,6 +1650,11 @@ class ContinuousEngine:
             # whose K/V arrived as wire rows instead of local prefill.
             "shipments_ingested": self.shipments_ingested,
             "ship_tokens_ingested": self.ship_tokens_ingested,
+            # Fleet-global prefix reuse: entries served to pulling
+            # routers via GET /prefix/<digest>, and completed-request
+            # entries currently pinned past their slots.
+            "prefix_exports": self.prefix_exports,
+            "prefix_retained": len(self._retained),
         }
 
     @property

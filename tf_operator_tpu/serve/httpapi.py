@@ -192,6 +192,15 @@ def readiness_payload(sched: Any, *, draining: bool = False,
     payload["requests_done"] = sched.requests_done
     payload["tokens_generated"] = sched.tokens_generated
     payload["watchdog_restarts"] = getattr(sched, "restarts", 0)
+    adv = getattr(sched, "advertised_prefixes", None)
+    if adv is not None:
+        # Fleet-global prefix reuse: the replica's hot prefix digest
+        # chain (hex, MRU first, capped engine-side). Omitted when
+        # empty — membership's clear-on-absent keeps a replica that
+        # freed everything from advertising ghosts.
+        prefixes = adv()
+        if prefixes:
+            payload["prefixes"] = list(prefixes)
     ttft_p99 = windowed_ttft_p99()
     if ttft_p99:
         payload["ttft_p99_s"] = round(ttft_p99, 4)

@@ -79,6 +79,21 @@ POOL_KEYS = {
     "pool_value_scale": "value_scale",
 }
 
+# Paged pool leaf -> the part name its rows travel under in the
+# shipped-KV wire format (serve/disagg.py): K/V rows as "key"/"value"
+# (wire v1 since PR 14), the kv-int8 per-(token, head) f32 scale
+# sidecars as "key_scale"/"value_scale" ([S, KV] rows — 2-D, no Dh
+# axis). One mapping shared by the export (disagg.export_shipment walks
+# the dense twins), the ingest scatter (make_pool_write_fn), and the
+# engine's coverage check, so a new pool leaf cannot silently miss the
+# wire.
+POOL_WIRE_PARTS = {
+    "pool_key": "key",
+    "pool_value": "value",
+    "pool_key_scale": "key_scale",
+    "pool_value_scale": "value_scale",
+}
+
 
 def plain_tree(tree: Any) -> Any:
     """Rebuild a cache pytree's mappings as plain dicts: flax versions
@@ -272,11 +287,15 @@ def make_pool_write_fn(num_blocks: int, block: int, constraint=None):
     makes shipped decode bit-identical to local).
 
     ``rows`` maps each attention layer's cache path ("/"-joined module
-    names) to ``{"key": [S, KV, Dh], "value": [S, KV, Dh]}`` — padded to
-    the full ``max_seq_len`` row count so ONE executable serves every
-    shipment; entries of ``write_table`` beyond the shipment's blocks
-    are 0 and dump the pad rows into the pinned garbage block, exactly
-    the ``make_paged_insert_fn`` trick. The paged tree is donated;
+    names) to ``{"key": [S, KV, Dh], "value": [S, KV, Dh]}`` — plus, on
+    kv-int8 pools, ``{"key_scale"/"value_scale": [S, KV]}`` f32 scale
+    sidecars riding the SAME write table (POOL_WIRE_PARTS names the
+    leaves; the engine's coverage check guarantees the rows dict matches
+    the pool before this traces) — padded to the full ``max_seq_len``
+    row count so ONE executable serves every shipment; entries of
+    ``write_table`` beyond the shipment's blocks are 0 and dump the pad
+    rows into the pinned garbage block, exactly the
+    ``make_paged_insert_fn`` trick. The paged tree is donated;
     ``constraint`` pins mesh layouts."""
 
     def write(paged, write_table, rows):
@@ -285,13 +304,10 @@ def make_pool_write_fn(num_blocks: int, block: int, constraint=None):
                 return p
             out = {}
             for name, leaf in p.items():
-                # K/V rows only: the wire format carries no kv-int8
-                # scale sidecars (the engine rejects shipped-KV ingest
-                # on kv8 pools before this executable is ever built).
-                if name in ("pool_key", "pool_value"):
+                if name in POOL_WIRE_PARTS:
                     r = rows["/".join(path)][
-                        "key" if name == "pool_key" else "value"
-                    ]  # [S, KV, Dh]
+                        POOL_WIRE_PARTS[name]
+                    ]  # [S, KV, Dh] (K/V) or [S, KV] (scales)
                     pos = jnp.arange(r.shape[0])
                     flat = write_table[pos // block] * block + pos % block
                     flat_pool = leaf.reshape(
@@ -539,13 +555,16 @@ class PrefixCache:
     later request can share as much block-aligned prefix as it matches,
     and an identical prompt skips prefill entirely.
 
-    Entries reference LIVE blocks only — no pinning: when the last slot
-    holding a block releases it (``BlockAllocator.free`` reports it),
-    ``invalidate_blocks`` drops every entry referencing it. Reuse spans
-    concurrently-live requests, which is where the serving win is
-    (identical system prompts in flight together); persisting prefixes
-    beyond their last holder would need an eviction policy against the
-    same pool and is future work."""
+    Entries reference LIVE blocks only — the cache itself never pins:
+    when the last holder of a block releases it (``BlockAllocator.free``
+    reports it), ``invalidate_blocks`` drops every entry referencing it.
+    Persistence past a request's own slot is the ENGINE's job: with
+    retention enabled (``ContinuousEngine.prefix_retain_max`` > 0) the
+    engine takes one extra pool reference per exact-entry block at
+    registration (``exact_hold`` is its read), so the entry outlives
+    its slot until the bounded retained set evicts it — that is what
+    fleet-global prefix advertisement and ``/prefix/<digest>`` exports
+    serve from."""
 
     def __init__(self, block: int) -> None:
         self.block = block
@@ -605,6 +624,10 @@ class PrefixCache:
                 if n == L and e.logits is None:
                     continue  # full-length but no sampling row: downgrade
                 self.hits += 1
+                # Recency refresh: dict order IS the LRU order the
+                # fleet advertisement (``advertise``) reads — a hit
+                # moves the entry to the hot end.
+                self._entries[key] = self._entries.pop(key)
                 return n, tuple(e.blocks), (
                     e.logits if n == L else None
                 )
@@ -665,3 +688,66 @@ class PrefixCache:
     @property
     def entries(self) -> int:
         return len(self._entries)
+
+    # -- fleet-global prefix reuse (fleet/prefixes.py) --------------------
+
+    def advertise(self, cap: int = 32) -> list[str]:
+        """The replica's hot-prefix advertisement: hex digests of up to
+        ``cap`` entries, most-recently-used first (dict order is the LRU
+        order — ``lookup`` hits refresh it, registrations append at the
+        hot end). Rides the /healthz readiness payload so the fleet
+        router can score prefix hits; entries reference LIVE blocks
+        only, so a digest can go stale between the advertisement and a
+        pull — that race is why ``/prefix/<digest>`` answers with the
+        typed ``prefix_not_found`` instead of trusting this list."""
+        if cap <= 0:
+            return []  # NOT [-0:], which would be the whole table
+        with self._lock:
+            keys = list(self._entries)[-int(cap):]
+        keys.reverse()
+        return [k.hex() for k in keys]
+
+    def entry_for_hex(self, digest_hex: str):
+        """The live EXACT entry (stored sampling logits) under a hex
+        digest, as ``(tokens, n, blocks, logits)`` copies — the
+        ``GET /prefix/<digest>`` export's read. None when the digest
+        names nothing live, or only a longer prompt's aligned prefix
+        (no logits: the wire format cannot ship it, and the puller
+        could not exact-join it)."""
+        try:
+            key = bytes.fromhex(digest_hex)
+        except ValueError:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.logits is None:
+                return None
+            return (
+                np.array(e.tokens, np.int32, copy=True),
+                e.n,
+                tuple(e.blocks),
+                np.array(e.logits, copy=True),
+            )
+
+    def exact_hold(self, tokens) -> tuple[bytes, tuple[int, ...]] | None:
+        """``(digest, blocks)`` of the live exact-length entry for
+        ``tokens`` (sampling row present) — the engine's retention
+        hook: the blocks it must extra-reference to keep this entry
+        alive past its last slot. None when the exact digest is
+        unregistered, collided, or only a longer prompt's aligned
+        prefix (nothing worth pinning: it could never exact-join or
+        export)."""
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        with self._lock:
+            n, key = self._chain_keys(tokens)[0]
+            e = self._entries.get(key)
+            if (
+                e is None
+                or e.logits is None
+                or e.n != n
+                or not np.array_equal(e.tokens, tokens)
+            ):
+                return None
+            return key, tuple(e.blocks)

@@ -194,6 +194,20 @@ class ShipFailed(ServeError):
     retryable = True
 
 
+class PrefixNotFound(ServeError):
+    """A ``GET /prefix/<digest>`` export found no live PrefixCache entry
+    with stored sampling logits for that digest — the advertisement the
+    router acted on went stale (the holder freed the blocks, or the
+    digest was only ever a longer prompt's aligned prefix). NOT
+    retryable and deliberately absent from RETRY_ELSEWHERE: the
+    prefix-aware router treats this as degrade-to-local-prefill — the
+    request itself has not failed, only the optimization."""
+
+    code = "prefix_not_found"
+    http_status = 404
+    retryable = False
+
+
 # The COMPLETE wire-code vocabulary: every ``code`` a client or the
 # fleet router can see. ServeError subclasses above carry the
 # engine-side codes; these are the transport/front-door codes minted as
@@ -213,6 +227,10 @@ WIRE_CODES = frozenset((
                            # replica; the decode pool prefills locally
                            # (informational on the response, not a
                            # failure — the request still serves)
+    # Fleet-global prefix reuse (fleet/prefixes.py, fleet/router.py):
+    "prefix_not_found",    # /prefix/<digest> export found no live entry
+                           # (stale advertisement) — the router degrades
+                           # to local prefill, the request still serves
 ))
 
 
@@ -682,3 +700,21 @@ class EngineSupervisor:
             snap = sched.debug_snapshot()
         snap["resilience"] = self.debug()
         return snap
+
+    # -- fleet-global prefix reuse (fleet/prefixes.py) --------------------
+
+    def advertised_prefixes(self) -> list[str]:
+        """The live generation's hot-prefix advertisement (empty across
+        a rebuild window — a restarting engine holds no blocks, and a
+        stale advertisement would just degrade to a typed pull miss)."""
+        sched = self.scheduler
+        return sched.advertised_prefixes() if sched is not None else []
+
+    def export_prefix(self, digest: str, timeout: float = 30.0) -> dict:
+        """``GET /prefix/<digest>`` through the supervisor: delegates to
+        the live generation; a rebuild window answers the typed
+        ``prefix_not_found`` (the entry died with the old engine)."""
+        sched = self.scheduler
+        if sched is None:
+            raise PrefixNotFound("engine rebuilding; no live prefixes")
+        return sched.export_prefix(digest, timeout=timeout)

@@ -102,6 +102,7 @@ from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.serve.resilience import (
     EngineCrashed,
     EngineSupervisor,
+    PrefixNotFound,
     QueueFull,
     QueueTTLExpired,
     ResilienceConfig,
@@ -306,6 +307,12 @@ class ContinuousScheduler:
         # steps]. Mutated only under the condvar (the supervisor's
         # fence flushes from its own thread).
         self._intervals: dict[int, list] = {}
+        # Loop-serialized engine calls (``call_engine``): (fn, box)
+        # pairs appended under the condvar from other threads, drained
+        # by the loop between steps — the decode executables donate the
+        # cache, so a device read from an HTTP thread would race the
+        # donation. The /prefix/<digest> export rides here.
+        self._engine_calls: deque = deque()
         SERVE_SLOT_CAPACITY.set(engine.max_slots)
 
     # -- client side ------------------------------------------------------
@@ -523,12 +530,76 @@ class ContinuousScheduler:
         finally:
             self._device_lock.release()
 
+    def _run_engine_calls(self) -> None:
+        """Drain the loop-serialized engine-call queue: pop under the
+        condvar, execute under the device lock OUTSIDE it (device work
+        under the condvar would block every enqueue for the duration),
+        answer the waiter through its box."""
+        while True:
+            with self._cond:
+                if not self._engine_calls:
+                    return
+                fn, box = self._engine_calls.popleft()
+            try:
+                with self._device():
+                    box["result"] = fn(self.engine)
+            except Exception as exc:  # noqa: BLE001 — delivered, not lost
+                box["exc"] = exc
+            box["done"].set()
+
+    def call_engine(self, fn, timeout: float = 30.0):
+        """Run ``fn(engine)`` serialized with the serving loop's device
+        work and return its result. On a live loop the call is posted
+        and executed between steps (the decode executables donate the
+        cache — a concurrent device read from another thread would race
+        the donation); when the loop is not running it executes
+        directly under the device lock. Raises TimeoutError when the
+        loop is too busy to take the call in ``timeout`` seconds, and
+        re-raises whatever ``fn`` raised."""
+        if not self.running:
+            with self._device():
+                return fn(self.engine)
+        box: dict = {"done": threading.Event()}
+        with self._cond:
+            self._engine_calls.append((fn, box))
+            self._cond.notify_all()
+        if not box["done"].wait(timeout):
+            raise TimeoutError("engine call timed out behind the loop")
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]
+
+    # -- fleet-global prefix reuse (fleet/prefixes.py) --------------------
+
+    def advertised_prefixes(self) -> list[str]:
+        """The engine's hot-prefix digest advertisement for /healthz —
+        host-side PrefixCache read, safe from the probe thread; empty
+        for dense engines and engine fakes."""
+        fn = getattr(self.engine, "advertised_prefixes", None)
+        return fn() if fn is not None else []
+
+    def export_prefix(self, digest: str, timeout: float = 30.0) -> dict:
+        """``GET /prefix/<digest>``: export a live PrefixCache entry as
+        the shipped-KV wire payload, loop-serialized (``call_engine``).
+        A loop too busy to serve the export inside ``timeout`` answers
+        the typed ``prefix_not_found`` — the puller degrades to local
+        prefill, which is strictly better than stalling its request
+        behind our decode."""
+        try:
+            return self.call_engine(
+                lambda eng: eng.export_prefix(digest), timeout=timeout
+            )
+        except TimeoutError as exc:
+            raise PrefixNotFound(
+                "prefix export timed out behind the serving loop"
+            ) from exc
+
     def _loop(self) -> None:
         while True:
             with self._cond:
                 self._cond.wait_for(
                     lambda: self._queue or self._slots or self._prefilling
-                    or self._stopping or self._fenced,
+                    or self._engine_calls or self._stopping or self._fenced,
                     timeout=1.0,
                 )
                 if self._fenced:
@@ -554,6 +625,7 @@ class ContinuousScheduler:
             if dd is not None and time.monotonic() > dd:
                 self._expire_drain()
                 return
+            self._run_engine_calls()
             self._expire_queue_ttls()
             self._admit_and_prefill()
             self._decode()

@@ -160,6 +160,20 @@ SMOKE_INTERFERENCE = dict(seq=64, block=8, chunk=4, budget=8,
                           shapes=((4, 10), (6, 8), (2, 12)),
                           requests=16, gap_ms=8.0, ship_min=24)
 
+# Multi-turn chat mix (ISSUE 16): ``sessions`` concurrent conversations
+# of ``turns`` turns each; turn t's prompt is the WHOLE conversation so
+# far (previous prompt + assistant tokens + ``user_tokens`` fresh user
+# tokens), so consecutive turns share a growing block-aligned prefix —
+# IF the router lands them on the replica that still holds the blocks.
+# The prefix-aware leg routes with scoring + session affinity +
+# retention; the baseline leg is the identical fleet behind the plain
+# least-loaded router. Both replay the IDENTICAL seeded session set, so
+# the delta is purely the ROUTING policy's prefix locality.
+CHAT_MIX = dict(sessions=8, turns=4, user_tokens=16, steps=8,
+                replicas=4, block=8, think_ms=20.0)
+SMOKE_CHAT_MIX = dict(sessions=4, turns=3, user_tokens=8, steps=4,
+                      replicas=2, block=8, think_ms=0.0)
+
 
 def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
                    shapes, vocab: int):
@@ -855,6 +869,258 @@ def run_fleet_leg(cfg, params, schedule, args) -> dict:
     return line
 
 
+def build_chat_sessions(mix: dict, seed: int, vocab: int):
+    """Seeded multi-turn conversations: [(session_id, [user_turn, ...],
+    steps)] — each user_turn a fresh [user_tokens] int32 chunk the
+    runner appends to the conversation before resubmitting it whole."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for s in range(mix["sessions"]):
+        turns = [
+            rng.integers(0, vocab, (mix["user_tokens"],)).astype(np.int32)
+            for _ in range(mix["turns"])
+        ]
+        out.append((f"chat-{s}", turns, mix["steps"]))
+    return out
+
+
+def _run_chat_leg(name, cfg, params, sessions, mix, args, *,
+                  prefix_aware: bool) -> dict:
+    """One chat leg: ``mix['replicas']`` supervised paged continuous
+    engines (prefix retention ON — the engine side is identical on both
+    legs) behind the fleet router; ``prefix_aware`` selects the routing
+    policy under test (prefix-hit-weighted scoring + session affinity +
+    cross-replica pulls) vs the plain least-loaded baseline. Sessions
+    run closed-loop (turn t+1 waits for turn t — a conversation), all
+    sessions concurrently."""
+    from tf_operator_tpu.fleet.membership import FleetMembership, Replica
+    from tf_operator_tpu.fleet.prefixes import PrefixConfig
+    from tf_operator_tpu.fleet.replica import (
+        ReplicaServer,
+        SupervisorBackend,
+    )
+    from tf_operator_tpu.fleet.router import (
+        RouterConfig,
+        RouterServer,
+        http_probe,
+        http_send,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    n = mix["replicas"]
+    res = ResilienceConfig(
+        queue_ttl_s=30.0, decode_deadline_s=60.0, watchdog_stall_s=5.0,
+        max_restarts=3, restart_backoff_s=0.1,
+        queue_limit=max(64, 4 * mix["sessions"] * mix["turns"]),
+    )
+
+    def mk_replica(i: int):
+        def factory():
+            eng = ContinuousEngine(
+                cfg, params, max_slots=args.max_batch,
+                kv_block=mix["block"],
+                prefill_chunk=args.prefill_chunk or None,
+            )
+            # Retention on BOTH legs: the engine keeps completed
+            # conversations' prefix blocks either way — the legs
+            # differ only in whether the router exploits them.
+            eng.prefix_retain_max = 64
+            eng.prefix_advertise_max = 64
+            return eng
+
+        sup = EngineSupervisor(
+            factory, resilience=res,
+            prefill_tokens_per_step=args.prefill_budget,
+        )
+        server = ReplicaServer(
+            SupervisorBackend(sup, request_timeout_s=90.0),
+            replica_id=f"chat-r{i}",
+        ).start()
+        return sup, server
+
+    replicas = [mk_replica(i) for i in range(n)]
+    ms = FleetMembership(fail_threshold=2)
+    for _, server in replicas:
+        ms.register(server.replica_id, server.endpoint)
+    prefix_cfg = None
+    if prefix_aware:
+        prefix_cfg = PrefixConfig(kv_block=mix["block"], weight=1.0,
+                                  pull_timeout_s=10.0)
+    router = RouterServer(
+        ms, config=RouterConfig(retries=2, request_timeout_s=90.0,
+                                probe_interval_s=0.05),
+        prefix=prefix_cfg,
+    ).start()
+    ms.probe(http_probe)
+    router_as_backend = Replica(id="router", endpoint=router.endpoint)
+
+    results = []
+    results_lock = threading.Lock()
+
+    def run_session(sid, user_turns, steps):
+        history = None
+        for turn in user_turns:
+            prompt = (turn if history is None
+                      else np.concatenate([history, turn]))
+            t0 = time.perf_counter()
+            try:
+                status, payload = http_send(
+                    router_as_backend,
+                    {"tokens": prompt[None, :].tolist(),
+                     "num_steps": steps, "session": sid,
+                     "timing": True},
+                    90.0,
+                )
+            except Exception as exc:  # noqa: BLE001 — transport loss
+                with results_lock:
+                    results.append({"tokens": None, "latency_s": 0.0,
+                                    "ttft_s": 0.0, "itls": [],
+                                    "error": repr(exc)})
+                return
+            latency = time.perf_counter() - t0
+            if status != 200 or not payload.get("tokens"):
+                with results_lock:
+                    results.append({
+                        "tokens": None, "latency_s": 0.0, "ttft_s": 0.0,
+                        "itls": [], "error": f"{status}:"
+                        f"{payload.get('code', 'untyped')}",
+                    })
+                return
+            timing = (payload.get("timing") or [{}])[0]
+            ttft_ms = timing.get("ttft_ms")
+            out = payload["tokens"][0]
+            with results_lock:
+                results.append({
+                    "tokens": out,
+                    "latency_s": latency,
+                    "ttft_s": (ttft_ms / 1e3 if ttft_ms is not None
+                               else latency),
+                    "itls": [g / 1e3
+                             for g in timing.get("itl_ms", ())],
+                    "error": None,
+                })
+            history = np.concatenate(
+                [prompt, np.asarray(out, np.int32)]
+            )
+            if mix["think_ms"]:
+                time.sleep(mix["think_ms"] / 1e3)
+
+    def fleet_saved():
+        s = i = 0
+        for sup, _ in replicas:
+            kv = sup.debug_snapshot().get("kv_cache") or {}
+            s += kv.get("prefill_tokens_saved", 0)
+            i += kv.get("ship_tokens_ingested", 0)
+        return s, i
+
+    # Untimed warmup: one throwaway conversation covering every turn
+    # shape, so the prefill/join executables compile OFF the clock —
+    # the timed pair then compares routing policy, not which leg ran
+    # first against cold jit caches.
+    warm = build_chat_sessions(dict(mix, sessions=1),
+                               args.seed + 7919, args.vocab)
+    run_session("warmup-0", warm[0][1], warm[0][2])
+    results.clear()
+    saved0, ingested0 = fleet_saved()
+
+    threads = [
+        threading.Thread(target=run_session, args=s, daemon=True)
+        for s in sessions
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600.0)
+    wall_s = time.perf_counter() - t0
+
+    # Engine-observed ground truth, summed over the fleet: prompt
+    # tokens whose K/V was NOT recomputed (local prefix joins) plus
+    # tokens that arrived as shipped rows (cross-replica pulls) —
+    # timed sessions only (the warmup baseline is subtracted).
+    saved1, ingested1 = fleet_saved()
+    saved, ingested = saved1 - saved0, ingested1 - ingested0
+    rsnap = router.router.snapshot()
+    stats = {
+        "sessions": mix["sessions"],
+        "turns": mix["turns"],
+        "replicas": n,
+        "prefix_aware": prefix_aware,
+        "prefill_tokens_saved": saved,
+        "ship_tokens_ingested": ingested,
+        "max_batch": args.max_batch,
+    }
+    if prefix_aware:
+        pfx = rsnap.get("prefix") or {}
+        stats["router_prefix"] = {
+            k: pfx.get(k, 0)
+            for k in ("hits", "pulls", "pull_misses", "pull_fallbacks",
+                      "tokens_saved", "affinity_routes")
+        }
+    router.stop()
+    for sup, server in replicas:
+        server.stop()
+        sup.stop(timeout=30.0)
+    line = leg_summary(name, wall_s, results, stats)
+    return line
+
+
+def run_fleet_prefix_legs(cfg, params, args, smoke: bool) -> list[dict]:
+    """The ISSUE-16 acceptance pair: the IDENTICAL seeded multi-turn
+    chat mix through (1) the prefix-aware router (scoring + session
+    affinity + pulls) and (2) the plain least-loaded router, over
+    engine-identical fleets. The prefix line carries the
+    saved/TTFT-p50 ratios hardware rounds key on."""
+    from dataclasses import replace
+
+    mix = SMOKE_CHAT_MIX if smoke else CHAT_MIX
+    # A conversation's final turn is turns*(user_tokens+steps) tokens;
+    # the bench cfg's max_seq_len must hold it (power of two, ≥64).
+    need = mix["turns"] * (mix["user_tokens"] + mix["steps"])
+    seq = max(64, 1 << (need - 1).bit_length())
+    chat_cfg = replace(cfg, max_seq_len=seq)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import Transformer
+
+    chat_params = Transformer(chat_cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    sessions = build_chat_sessions(mix, args.seed, args.vocab)
+    prefix = _run_chat_leg("fleet_prefix_chat", chat_cfg, chat_params,
+                           sessions, mix, args, prefix_aware=True)
+    base = _run_chat_leg("fleet_lru_chat", chat_cfg, chat_params,
+                         sessions, mix, args, prefix_aware=False)
+    # The acceptance ratios: >1 saved ratio (prefix-aware reuses more
+    # prefill) and <1 TTFT p50 ratio (cheaper prefill, faster first
+    # token) at comparable tails.
+    base_saved = base["prefill_tokens_saved"] + \
+        base["ship_tokens_ingested"]
+    pfx_saved = prefix["prefill_tokens_saved"] + \
+        prefix["ship_tokens_ingested"]
+    prefix["prefill_tokens_saved_vs_baseline"] = round(
+        pfx_saved / max(1, base_saved), 3
+    )
+    if base["value"]:
+        prefix["vs_baseline"] = round(
+            prefix["value"] / base["value"], 3
+        )
+    if base["ttft_p50_ms"]:
+        prefix["ttft_p50_vs_baseline"] = round(
+            prefix["ttft_p50_ms"] / base["ttft_p50_ms"], 3
+        )
+    prefix["baseline_ttft_p50_ms"] = base["ttft_p50_ms"]
+    prefix["baseline_ttft_p99_ms"] = base["ttft_p99_ms"]
+    return [prefix, base]
+
+
 def build_interference_schedule(cap: dict, seed: int, vocab: int):
     """Deterministic interference traffic: short decode-heavy requests
     with a long prefill landing every ``long_every`` arrivals."""
@@ -1144,12 +1410,17 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--engine",
                    choices=("continuous", "coalesce", "both", "chaos",
-                            "fleet", "disagg", "spec"),
+                            "fleet", "fleet-prefix", "disagg", "spec"),
                    default="both",
                    help="'chaos' runs ONLY the seeded fault-injection "
                         "mix (supervised engine, step crash + stall "
                         "mid-run); 'fleet' the router-fronted replica "
                         "fleet with one replica killed mid-run; "
+                        "'fleet-prefix' the ISSUE-16 multi-turn chat "
+                        "pair: prefix-aware routing (scoring + session "
+                        "affinity + cross-replica pulls) vs the plain "
+                        "least-loaded router on the identical seeded "
+                        "session mix; "
                         "'disagg' the ROADMAP item-2 interference pair "
                         "(long prefills + latency-sensitive decodes, "
                         "disaggregated prefill pool vs the time-shared "
@@ -1253,6 +1524,8 @@ def main(argv: list[str] | None = None) -> int:
         lines.append(run_chaos_leg(cfg, params, schedule, args))
     if args.engine == "fleet":
         lines.append(run_fleet_leg(cfg, params, schedule, args))
+    if args.engine == "fleet-prefix":
+        lines.extend(run_fleet_prefix_legs(cfg, params, args, smoke))
     if args.engine == "disagg":
         lines.extend(run_disagg_legs(args, smoke))
     if args.engine == "spec":
