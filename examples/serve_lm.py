@@ -301,6 +301,25 @@ def main(argv: list[str] | None = None) -> int:
                         "byte budget — max-batch x max-seq-len/kv-block "
                         "+ 1; raise max-batch past what the dense "
                         "layout could hold and cap memory here instead)")
+    p.add_argument("--host-tier-bytes", type=int, default=0,
+                   metavar="BYTES",
+                   help="host-RAM KV tier byte budget "
+                        "(docs/kv-tiering.md): evicted prefix-cache "
+                        "entries spill here as wire payloads and "
+                        "admission restores them (session resume "
+                        "without re-prefill); also answers fleet "
+                        "/prefix/<digest> pulls and advertises "
+                        "tier_prefixes on /healthz. 0 (default) "
+                        "disables the tier — accounting is then "
+                        "bit-identical to pre-tier serving. The tier "
+                        "outlives watchdog rebuilds: spilled sessions "
+                        "survive an engine restart")
+    p.add_argument("--tier-prefetch", type=int, default=1,
+                   metavar="0|1",
+                   help="async host-tier prefetch at enqueue for "
+                        "requests carrying a session key (the prefix "
+                        "upload overlaps queue wait); 0 restores only "
+                        "at admission")
     res = p.add_argument_group(
         "resilience (continuous engine; 0 disables a knob)"
     )
@@ -682,6 +701,15 @@ def main(argv: list[str] | None = None) -> int:
             drain_timeout_s=args.drain_timeout or None,
         )
 
+        # ONE process-lifetime host tier, attached to every engine the
+        # factory builds: a watchdog rebuild loses the HBM pool but NOT
+        # the spilled sessions — the new generation restores them on
+        # demand (docs/kv-tiering.md).
+        host_tier = None
+        if kv_paged and args.host_tier_bytes > 0:
+            from tf_operator_tpu.serve.tier import HostTier
+            host_tier = HostTier(args.host_tier_bytes)
+
         def engine_factory():
             # The watchdog rebuilds through here: SAME cfg/params/mesh
             # every time, so a replayed greedy request is bit-identical
@@ -707,6 +735,7 @@ def main(argv: list[str] | None = None) -> int:
                 # exact-joinable after its request completes.
                 eng.prefix_advertise_max = args.prefix_advertise
                 eng.prefix_retain_max = args.prefix_advertise
+                eng.host_tier = host_tier
             return eng
 
         engine_sched = EngineSupervisor(
@@ -717,12 +746,17 @@ def main(argv: list[str] | None = None) -> int:
             # Streaming requests bypass the engine and share the chip:
             # one lock serializes both decode paths.
             device_lock=lock,
+            tier_prefetch=bool(args.tier_prefetch),
         )
         kv_desc = (
             f"paged kv ({args.kv_block}-token blocks, "
             f"{engine_sched.engine.kv_blocks} block pool)"
             if kv_paged else "dense kv"
         )
+        if host_tier is not None:
+            kv_desc += (f", host tier "
+                        f"{args.host_tier_bytes >> 20 or 1} MiB"
+                        f"{' +prefetch' if args.tier_prefetch else ''}")
         if mesh is not None:
             kv_desc += f", tp {args.tp} (SPMD mesh, kv head-sharded)"
         if args.spec_k:
@@ -1002,6 +1036,11 @@ def main(argv: list[str] | None = None) -> int:
                                         else float(deadline_s)),
                             request_id=(rid if i == 0
                                         else f"{rid}.{i}"),
+                            # A session key pre-warms the host KV tier
+                            # at enqueue (--tier-prefetch,
+                            # docs/kv-tiering.md); each row prefetches
+                            # against its own prompt chain.
+                            session=req.get("session"),
                             # Single-row contract enforced above, so
                             # the shipment always belongs to row 0.
                             shipment=shipment,

@@ -1,0 +1,26 @@
+"""Seeded violations for the ``typed-error`` pass, KV-tier era
+(ISSUE 17): a typo'd tier code in a payload literal, a restore-handler
+comparison against an unknown code, and an unknown-code member in a
+degrade-code constant — the mistakes that would silently break the
+warm-pull degrade contract (a typo'd ``tier_miss`` makes the router
+treat an evicted-between-probe-and-pull race as an internal error
+instead of quietly prefilling locally). (The test runs the checker
+over this file TOGETHER with serve/resilience.py so the taxonomy —
+incl. the real ``tier_miss`` — is in the analyzed set.)"""
+
+
+def mint() -> dict:
+    # Typo: the taxonomy declares "tier_miss".
+    return {"error": "x", "code": "tier_missed", "retryable": False}
+
+
+def degrade(payload: dict) -> bool:
+    # Unknown: no such code anywhere in the taxonomy.
+    return payload.get("code") == "tier_evicted"
+
+
+LOCAL_PREFILL_CODES = ("tier_miss", "tier_cold")
+
+
+def restore_failed(payload: dict) -> bool:
+    return payload.get("code") in LOCAL_PREFILL_CODES

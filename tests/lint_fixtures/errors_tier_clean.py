@@ -1,0 +1,19 @@
+"""Clean twin of errors_tier_bad.py: the KV-tier codes spelled as the
+taxonomy declares them (``tier_miss`` from the TierMiss ServeError
+subclass / WIRE_CODES, ``prefix_not_found`` for the never-advertised
+degrade path)."""
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "tier_miss", "retryable": False}
+
+
+def degrade(payload: dict) -> bool:
+    return payload.get("code") == "tier_miss"
+
+
+LOCAL_PREFILL_CODES = ("tier_miss", "prefix_not_found")
+
+
+def restore_failed(payload: dict) -> bool:
+    return payload.get("code") in LOCAL_PREFILL_CODES

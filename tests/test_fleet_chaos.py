@@ -584,6 +584,82 @@ def test_kill_prefix_holder_mid_pull_degrades_to_local(fleet_backend):
         harness.stop_all()
 
 
+@pytest.mark.tier
+def test_kill_warm_tier_holder_mid_restore_zero_lost(fleet_backend):
+    """The KV memory hierarchy's fleet crash boundary (serve/tier.py):
+    replica r1 advertises the digest WARM only (``tier_prefixes`` +
+    ``tier_store`` — its hot list stays empty), so the router's pulls
+    source from r1's host tier through the same GET /prefix/<digest>.
+    Killing r1 mid-run with traffic flowing degrades every subsequent
+    restore-miss to LOCAL PREFILL on the routed replica: ok + typed ==
+    total, zero lost — on both cluster backends."""
+    from tf_operator_tpu.fleet import PrefixConfig
+
+    client, store = fleet_backend
+    harness = ReplicaHarness()
+    tc = mk_controller(client, harness)
+    client.create(objects.TPUSERVES, mk_serve(replicas=3))
+    router = None
+    digest = _pull_digest()
+    try:
+        ms = tc.membership_for("default/lm")
+        assert sync_until(tc, lambda: ms.counts()[mship.READY] == 3)
+        harness.servers[1].backend.tier_prefixes = [digest]
+        harness.servers[1].backend.tier_store[digest] = {
+            "version": 1, "tokens": [1, 2], "kv_block": 2,
+        }
+        router = RouterServer(
+            ms, config=RouterConfig(retries=2, request_timeout_s=10.0,
+                                    probe_interval_s=0.05),
+            prefix=PrefixConfig(kv_block=2, weight=0.0,
+                                pull_timeout_s=2.0),
+        ).start()
+        # The warm advertisement must reach membership before traffic.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and (
+                digest not in (ms.get("lm-r1").tier_prefixes or ())):
+            time.sleep(0.02)
+        assert digest in (ms.get("lm-r1").tier_prefixes or ())
+        assert digest not in (ms.get("lm-r1").prefixes or ())
+        # /debug/fleet's warm rollup sees it apart from the hot one.
+        directory = ms.prefix_directory()
+        assert directory["tier_digests"] == 1
+        assert directory["replicas_tier_advertising"] == 1
+        assert directory["digests"] == 0
+        # Healthy phase: picks miss locally, pull from r1's HOST TIER
+        # (prefix_store is empty — the export fell back), attach the
+        # shipped bytes to the routed body.
+        for _ in range(2):
+            status, _ = route_one(router.endpoint)
+            assert status == 200
+        snap = router.router.snapshot()["prefix"]
+        assert snap["pulls"] >= 1, snap
+        assert (harness.servers[0].backend.shipped_received
+                + harness.servers[2].backend.shipped_received) >= 1
+        assert harness.servers[1].backend.prefix_exports >= 1
+        assert not harness.servers[1].backend.prefix_store
+        # Chaos: kill the warm holder mid-run with traffic flowing.
+        driver = TrafficDriver(router.endpoint, n_requests=30).start()
+        time.sleep(0.05)
+        harness.kill(1)
+        stop = threading.Event()
+        tc.start(stop, interval=0.05)
+        try:
+            driver.join()
+        finally:
+            stop.set()
+        ok, typed, lost = driver.tally()
+        assert lost == 0, driver.results
+        assert ok + typed == 30
+        # The warm holder's death is invisible to clients: restore
+        # pulls degrade to local prefill, transport failures fail over.
+        assert ok == 30, [p for s, p in driver.results if s != 200]
+    finally:
+        if router is not None:
+            router.stop()
+        harness.stop_all()
+
+
 def test_session_affinity_survives_rolling_update(fleet_backend):
     """Session affinity's chaos contract: multi-turn traffic sticks to
     its home replica while the home is routable, RE-HOMES when a

@@ -61,6 +61,10 @@ _FIXTURE_MATRIX = {
     # unknown degrade code must trip — the router's pull path degrades
     # to local prefill on these strings.
     "errors_prefix_bad.py": ((TAXONOMY,), "typed-error"),
+    # KV-tier codes (ISSUE 17): a typo'd tier_miss / unknown warm-pull
+    # degrade code must trip — the router degrades tier-pull failures
+    # to local prefill on these strings.
+    "errors_tier_bad.py": ((TAXONOMY,), "typed-error"),
 }
 
 
@@ -82,6 +86,7 @@ def test_fixture_trips_exactly_its_pass(name):
     "lockorder_clean.py", "guarded_clean.py", "blocking_clean.py",
     "metrics_clean.py", "metrics_spec_clean.py", "errors_clean.py",
     "errors_ship_clean.py", "errors_prefix_clean.py",
+    "errors_tier_clean.py",
 ])
 def test_clean_twin_trips_nothing(name):
     extra = (TAXONOMY,) if name.startswith("errors") else ()

@@ -1,0 +1,541 @@
+"""KV memory hierarchy (serve/tier.py): the host-RAM block tier —
+spill on eviction, restore on resume, tier-aware admission.
+
+The pins mirror test_serve_prefix_pull.py's discipline — every restored
+decode is bit-identical to the solo ``generate`` oracle (greedy AND
+sampled, dense AND kv8) with zero decode recompiles — plus the tier's
+own contracts:
+
+- spill: when pool pressure reclaims a retained prefix hold, its exact
+  entry lands in the host tier as the PR-14 wire payload instead of
+  vanishing (blocks back in the pool, digest advertised as warm);
+- restore: a later identical prompt exact-joins the restored blocks —
+  prefill skipped for the whole prompt, decode bit-identical to a
+  never-spilled run;
+- tier-off (`--host-tier-bytes 0`): the PR 16 accounting exactly — no
+  ``tier`` section in kv_debug, nothing advertised, evictions simply
+  free;
+- can-restore wait: a tier hit the pool cannot hold yet requeues
+  (outcome "exhausted"), distinct from a plain must-wait miss;
+- export: ``GET /prefix/<digest>`` answers from the holder's host tier
+  too (the stored payload IS the wire format — no device work);
+- session prefetch: a ``session``-keyed enqueue pre-warms its prefix;
+- typed ``tier_miss``: an advertised-warm digest whose payload is gone
+  answers 404 ``tier_miss`` (retryable=False — the router degrades to
+  local prefill, it does not retry the same replica).
+
+HostTier itself (byte budget, LRU eviction, refusal) is unit-tested
+jax-free at the bottom. The fleet chaos case (kill the warm holder
+mid-restore on both cluster backends, zero lost) lives with the other
+router chaos in test_fleet_chaos.py; the bench-scale acceptance pair is
+pinned here structurally (slow).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    generate,
+)
+from tf_operator_tpu.serve.disagg import chain_digests, decode_shipment
+from tf_operator_tpu.serve.engine import ContinuousEngine
+from tf_operator_tpu.serve.httpapi import readiness_payload
+from tf_operator_tpu.serve.resilience import PrefixNotFound, TierMiss
+from tf_operator_tpu.serve.scheduler import ContinuousScheduler, ServeRequest
+from tf_operator_tpu.serve.tier import HostTier, payload_nbytes
+
+pytestmark = [pytest.mark.serve, pytest.mark.tier]
+
+CFG = TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+BLOCK = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+def prompt_of(p: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(
+        0, CFG.vocab_size, (1, p)
+    ).astype(np.int32)
+
+
+def solo(cfg, params, prompt, steps, *, temperature=0.0, seed=0):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+    return np.asarray(
+        generate(cfg, params, jnp.asarray(prompt), steps, **kw)
+    )[0].tolist()
+
+
+def mk_sched(params, *, cfg=CFG, retain=32, max_slots=2, kv_blocks=None,
+             tier_bytes=64 << 20):
+    """A paged engine with retention ON and (tier_bytes > 0) the host
+    tier attached — the serve_lm --host-tier-bytes wiring — wrapped in
+    a started scheduler."""
+    kw = {} if kv_blocks is None else {"kv_blocks": kv_blocks}
+    eng = ContinuousEngine(
+        cfg, params, max_slots=max_slots, kv_paged=True, kv_block=BLOCK,
+        **kw,
+    )
+    eng.prefix_retain_max = retain
+    eng.prefix_advertise_max = 32
+    if tier_bytes:
+        eng.host_tier = HostTier(tier_bytes)
+    return ContinuousScheduler(eng).start()
+
+
+def exact_digest(prompt) -> str:
+    return chain_digests(np.asarray(prompt[0], np.int32), BLOCK)[-1]
+
+
+def force_spill(sched):
+    """Reclaim EVERY retained prefix hold under simulated pool
+    pressure (the PR 16 oldest-first path) — with a tier attached the
+    dying exact entries spill; without one they just free."""
+    sched.call_engine(lambda e: e._evict_retained(until_free=10 ** 9))
+
+
+# ---------------------------------------------------------------------------
+# spill → restore, bit-identical (dense)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("temperature,seed", [(0.0, 0), (0.9, 11)],
+                         ids=["greedy", "sampled"])
+def test_spill_restore_bit_identical(params, temperature, seed):
+    """The tentpole pin: serve once (entry retained), evict under
+    pressure (entry SPILLS to host), serve the identical prompt again
+    — admission restores the spilled blocks, the plan exact-joins them
+    (prefill skipped for the whole prompt), and the decode is
+    bit-identical to the never-spilled solo oracle with zero decode
+    recompiles."""
+    prompt = prompt_of(13, 70 if temperature == 0 else 71)
+    steps = 8
+    oracle = solo(CFG, params, prompt, steps,
+                  temperature=temperature, seed=seed)
+    sched = mk_sched(params)
+    eng = sched.engine
+    try:
+        r1 = sched.submit_request(ServeRequest(
+            prompt, steps, temperature=temperature, seed=seed,
+        ), timeout=60.0)
+        assert r1.out == oracle
+        force_spill(sched)
+        # The entry left HBM for the host tier: blocks back in the
+        # pool, digest now advertised as WARM (not hot).
+        assert eng.blocks.used == 0
+        assert exact_digest(prompt) not in sched.advertised_prefixes()
+        assert exact_digest(prompt) in sched.advertised_tier_prefixes()
+        saved0 = sched.debug_snapshot()["kv_cache"]["prefill_tokens_saved"]
+        r2 = sched.submit_request(ServeRequest(
+            prompt, steps, temperature=temperature, seed=seed,
+        ), timeout=60.0)
+        snap = sched.debug_snapshot()
+        assert r2.out == oracle, (r2.out, oracle)
+        assert r2.tier_join, "admission did not restore from the tier"
+        assert eng.tier_restores >= 1
+        saved = snap["kv_cache"]["prefill_tokens_saved"] - saved0
+        assert saved == prompt.shape[1], "restore did not skip prefill"
+        assert snap["decode_step_compiles"] == snap["warmup_compiles"]
+        tier = snap["kv_cache"]["tier"]
+        assert tier["spills"] >= 1 and tier["hits"] >= 1
+        assert tier["restore_tokens"] >= prompt.shape[1]
+    finally:
+        sched.stop(timeout=30.0)
+
+
+def test_session_resume_restores_turn_prefix(params):
+    """The many-session resume shape the bench runs at scale: turn 2's
+    prompt EXTENDS turn 1's (block-aligned), the tier restores the
+    spilled turn-1 prefix, and only the extension prefills."""
+    turn1 = prompt_of(16, 72)  # block-aligned: its digest is in every
+    steps = 6                  # extension's chain
+    ext = np.concatenate(
+        [turn1, np.asarray(solo(CFG, params, turn1, steps),
+                           np.int32)[None, :8],
+         prompt_of(8, 73)], axis=1,
+    )
+    sched = mk_sched(params)
+    eng = sched.engine
+    try:
+        sched.submit_request(ServeRequest(turn1, steps, session="s0"),
+                             timeout=60.0)
+        force_spill(sched)
+        assert eng.blocks.used == 0
+        oracle = solo(CFG, params, ext, steps)
+        r2 = sched.submit_request(ServeRequest(ext, steps, session="s0"),
+                                  timeout=60.0)
+        assert r2.out == oracle, (r2.out, oracle)
+        assert eng.tier_restores >= 1
+        # Only the 16 aligned turn-1 tokens restored; the rest
+        # prefilled locally — partial restore, not all-or-nothing.
+        assert eng.tier_restore_tokens >= 16
+    finally:
+        sched.stop(timeout=30.0)
+
+
+def test_session_prefetch_prewarms(params):
+    """A ``session``-keyed enqueue posts a fire-and-forget restore that
+    runs loop-serialized before admission — either way (prefetch or
+    admission-time restore wins the race) the prompt exact-joins and
+    never re-prefills."""
+    prompt = prompt_of(13, 74)
+    steps = 6
+    oracle = solo(CFG, params, prompt, steps)
+    sched = mk_sched(params)
+    eng = sched.engine
+    try:
+        sched.submit_request(ServeRequest(prompt, steps, session="s1"),
+                             timeout=60.0)
+        force_spill(sched)
+        saved0 = sched.debug_snapshot()["kv_cache"]["prefill_tokens_saved"]
+        r2 = sched.submit_request(ServeRequest(prompt, steps,
+                                               session="s1"), timeout=60.0)
+        snap = sched.debug_snapshot()
+        assert r2.out == oracle
+        assert eng.tier_restores >= 1
+        saved = snap["kv_cache"]["prefill_tokens_saved"] - saved0
+        assert saved == prompt.shape[1]
+        assert snap["decode_step_compiles"] == snap["warmup_compiles"]
+    finally:
+        sched.stop(timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# kv8: int8 pools spill WITH their scale sidecars
+# ---------------------------------------------------------------------------
+
+
+class TestKv8Tier:
+    @pytest.fixture(scope="class")
+    def cfg8(self):
+        from dataclasses import replace
+        return replace(CFG, kv_int8=True)
+
+    @pytest.fixture(scope="class")
+    def p8(self, cfg8):
+        return Transformer(cfg8).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+
+    @pytest.mark.parametrize("temperature,seed", [(0.0, 0), (0.9, 5)],
+                             ids=["greedy", "sampled"])
+    def test_kv8_spill_restore_bit_identical(self, cfg8, p8,
+                                             temperature, seed):
+        prompt = prompt_of(13, 75 if temperature == 0 else 76)
+        steps = 8
+        oracle = solo(cfg8, p8, prompt, steps,
+                      temperature=temperature, seed=seed)
+        sched = mk_sched(p8, cfg=cfg8)
+        try:
+            r1 = sched.submit_request(ServeRequest(
+                prompt, steps, temperature=temperature, seed=seed,
+            ), timeout=60.0)
+            assert r1.out == oracle
+            force_spill(sched)
+            # The spilled payload carries the f32 scale-row sidecars —
+            # read it back through the export fallback (the tier stores
+            # the wire format verbatim).
+            wire = json.loads(json.dumps(
+                sched.export_prefix(exact_digest(prompt))
+            ))
+            parts = set().union(*(set(kv)
+                                  for kv in wire["rows"].values()))
+            assert {"key_scale", "value_scale"} <= parts
+            r2 = sched.submit_request(ServeRequest(
+                prompt, steps, temperature=temperature, seed=seed,
+            ), timeout=60.0)
+            snap = sched.debug_snapshot()
+            assert r2.out == oracle, (r2.out, oracle)
+            assert r2.tier_join
+            assert snap["decode_step_compiles"] == snap["warmup_compiles"]
+        finally:
+            sched.stop(timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# tier-off: the PR 16 accounting, exactly
+# ---------------------------------------------------------------------------
+
+
+def test_tier_off_accounting_unchanged(params):
+    """--host-tier-bytes 0: no tier section in kv_debug, nothing
+    advertised warm, evictions free without spilling, restore reports
+    miss — byte-for-byte the PR 16 snapshot shape."""
+    prompt = prompt_of(13, 77)
+    sched = mk_sched(params, tier_bytes=0)
+    eng = sched.engine
+    try:
+        sched.submit_request(ServeRequest(prompt, 6), timeout=60.0)
+        force_spill(sched)
+        assert eng.blocks.used == 0
+        kv = sched.debug_snapshot()["kv_cache"]
+        assert "tier" not in kv
+        assert sched.advertised_tier_prefixes() == []
+        assert eng.tier_probe(np.asarray(prompt)) is False
+        hold, outcome = sched.call_engine(
+            lambda e: e.restore_from_tier(np.asarray(prompt))
+        )
+        assert hold is None and outcome == "miss"
+        with pytest.raises(PrefixNotFound):
+            sched.export_prefix(exact_digest(prompt))
+    finally:
+        sched.stop(timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# tier-aware admission: must-wait vs can-restore
+# ---------------------------------------------------------------------------
+
+
+def test_exhausted_pool_is_can_restore_not_recompute(params):
+    """A tier hit the pool cannot hold yet reports outcome "exhausted"
+    (the can-restore wait) while ``tier_probe`` stays True — and once
+    capacity frees, the SAME prompt restores and serves bit-identically
+    without ever recomputing its prefix."""
+    prompt = prompt_of(13, 78)
+    steps = 6
+    oracle = solo(CFG, params, prompt, steps)
+    sched = mk_sched(params, kv_blocks=8, max_slots=1)
+    eng = sched.engine
+    try:
+        r1 = sched.submit_request(ServeRequest(prompt, steps),
+                                  timeout=60.0)
+        assert r1.out == oracle
+        force_spill(sched)
+        # Artificially exhaust the pool (live work holds every block).
+        grabbed = sched.call_engine(
+            lambda e: e.blocks.alloc(e.blocks.free_blocks)
+        )
+        assert grabbed, "pool should have had free blocks to grab"
+        assert eng.tier_probe(np.asarray(prompt)) is True
+        hold, outcome = sched.call_engine(
+            lambda e: e.restore_from_tier(np.asarray(prompt),
+                                          reserve_steps=steps)
+        )
+        assert hold is None and outcome == "exhausted"
+        # The entry survived the failed attempt — capacity frees, the
+        # restore lands.
+        sched.call_engine(lambda e: e._free_blocks(grabbed))
+        r2 = sched.submit_request(ServeRequest(prompt, steps),
+                                  timeout=60.0)
+        assert r2.out == oracle
+        assert r2.tier_join
+    finally:
+        sched.stop(timeout=30.0)
+
+
+# ---------------------------------------------------------------------------
+# fleet surfaces: export fallback, /healthz advertisement, typed miss
+# ---------------------------------------------------------------------------
+
+
+def test_export_answers_from_tier(params):
+    """GET /prefix/<digest> on a spilled entry: the holder answers with
+    the STORED wire payload (no device work, prefix_exports counted) —
+    a peer's pull decodes it exactly like a hot export."""
+    prompt = prompt_of(13, 79)
+    sched = mk_sched(params)
+    try:
+        sched.submit_request(ServeRequest(prompt, 6), timeout=60.0)
+        force_spill(sched)
+        exports0 = sched.debug_snapshot()["kv_cache"]["prefix_exports"]
+        wire = json.loads(json.dumps(
+            sched.export_prefix(exact_digest(prompt))
+        ))
+        assert sched.debug_snapshot()["kv_cache"]["prefix_exports"] == (
+            exports0 + 1
+        )
+        shp = decode_shipment(wire, expect_tokens=prompt[0])
+        assert shp.tokens.tolist() == prompt[0].tolist()
+        # Unknown digests still answer the typed prefix_not_found.
+        with pytest.raises(PrefixNotFound):
+            sched.export_prefix("ab" * 20)
+    finally:
+        sched.stop(timeout=30.0)
+
+
+class _ProbeShape:
+    active_slots = 0
+    queue_depth = 0
+    requests_done = 0
+    tokens_generated = 0
+
+    def __init__(self, sched):
+        self._sched = sched
+
+    def advertised_prefixes(self):
+        return self._sched.advertised_prefixes()
+
+    def advertised_tier_prefixes(self):
+        return self._sched.advertised_tier_prefixes()
+
+
+def test_readiness_advertises_tier_and_omits_when_empty(params):
+    """/healthz: ``tier_prefixes`` carries the warm digests, capped by
+    prefix_advertise_max like the hot list — and the key is OMITTED
+    when the tier has nothing (the membership clear-on-absent
+    contract)."""
+    prompt = prompt_of(11, 80)
+    sched = mk_sched(params)
+    duck = _ProbeShape(sched)
+    try:
+        sched.submit_request(ServeRequest(prompt, 4), timeout=60.0)
+        payload = readiness_payload(duck)
+        assert "tier_prefixes" not in payload  # nothing spilled yet
+        force_spill(sched)
+        payload = readiness_payload(duck)
+        assert exact_digest(prompt) in payload["tier_prefixes"]
+        assert exact_digest(prompt) not in payload.get("prefixes", [])
+        sched.engine.prefix_advertise_max = 0
+        assert "tier_prefixes" not in readiness_payload(duck)
+    finally:
+        sched.engine.prefix_advertise_max = 32
+        sched.stop(timeout=30.0)
+
+
+def test_tier_miss_is_typed():
+    """An advertised-warm digest whose payload is gone (evicted between
+    probe and pull) answers the typed ``tier_miss`` 404 — jax-free, on
+    the fleet fake, same shape a real replica serves."""
+    from tf_operator_tpu.fleet.replica import FakeReplicaBackend
+    from tf_operator_tpu.serve.resilience import (
+        WIRE_CODES,
+        http_status_of,
+    )
+
+    backend = FakeReplicaBackend(max_slots=2)
+    backend.tier_prefixes = ["ab" * 20]
+    with pytest.raises(TierMiss) as exc:
+        backend.export_prefix("ab" * 20)
+    assert exc.value.code == "tier_miss"
+    assert exc.value.retryable is False
+    assert http_status_of(exc.value) == 404
+    assert "tier_miss" in WIRE_CODES
+    # A digest never advertised stays the PR 16 typed answer.
+    with pytest.raises(PrefixNotFound):
+        backend.export_prefix("cd" * 20)
+    # A stored tier payload serves the pull.
+    backend.tier_store["ab" * 20] = {"version": 1, "tokens": [1, 2],
+                                     "kv_block": 2}
+    assert backend.export_prefix("ab" * 20)["tokens"] == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# HostTier unit pins (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def _payload(tag: str, nbytes: int = 96) -> dict:
+    import base64
+    data = base64.b64encode(b"\x00" * nbytes).decode()
+    return {
+        "version": 1, "tokens": [1, 2, 3], "kv_block": 2,
+        "digests": [f"{tag}-d0", f"{tag}-d1"],
+        "rows": {"layer0": {"key": {"b64": data}}},
+    }
+
+
+def test_host_tier_lru_byte_budget():
+    one = payload_nbytes(_payload("a"))
+    tier = HostTier(2 * one)
+    assert tier.put(_payload("a")) and tier.put(_payload("b"))
+    assert len(tier) == 2 and tier.bytes_used == 2 * one
+    # Touch a: b becomes the cold end; c evicts b, not a.
+    assert tier.get("a-d1") is not None
+    assert tier.put(_payload("c"))
+    assert "b-d1" not in tier and "a-d1" in tier and "c-d1" in tier
+    snap = tier.snapshot()
+    assert snap["evictions"] == 1 and snap["entries"] == 2
+    assert snap["bytes_used"] <= snap["capacity_bytes"]
+    # Oversize payloads are refused, never raise (spill is
+    # best-effort: the blocks were dying anyway).
+    assert not HostTier(8).put(_payload("x"))
+    # deepest: shortest-first chain resolves to the longest stored.
+    assert tier.deepest(["a-d0", "a-d1"]) == "a-d1"
+    assert tier.deepest(["zz"]) is None
+    # advertise is MRU-first and capped.
+    assert tier.advertise(1) == ["c-d1"]
+    assert tier.advertise(0) == []
+    # discard is idempotent and returns the bytes.
+    used = tier.bytes_used
+    tier.discard("c-d1")
+    tier.discard("c-d1")
+    assert tier.bytes_used == used - one
+
+
+# ---------------------------------------------------------------------------
+# bench acceptance pair (structural, slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bench_tier_structural():
+    """tools/serve_bench.py --engine tier (BENCH_SMOKE): the ISSUE-17
+    session-resume pair — host tier vs recompute at the identical HBM
+    block budget. Capacity-style pins only: every turn of every session
+    resolves on both legs, the tier leg's outputs MATCH the recompute
+    leg's token-for-token (bench-scale bit-identity), restores actually
+    fired, the saved ratio beats 1, and the TTFT ratio fields hardware
+    rounds key on exist."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--engine", "tier"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    tier = next(l for l in lines
+                if l["metric"] == "serve_tier_resume_"
+                                  "tokens_per_sec_mixed")
+    base = next(l for l in lines
+                if l["metric"] == "serve_tier_recompute_"
+                                  "tokens_per_sec_mixed")
+    sys.path.insert(0, repo)
+    from tools.serve_bench import SMOKE_TIER_MIX as MIX
+
+    n_turns = MIX["sessions"] * MIX["turns"]
+    for leg in (tier, base):
+        assert leg["requests"] == n_turns
+        assert leg["errors"] == 0
+        assert leg["generated_tokens"] == n_turns * MIX["steps"]
+        assert leg["kv_pool_blocks"] == base["kv_pool_blocks"]
+        assert leg["decode_step_compiles"] == leg["warmup_compiles"]
+        assert leg["resume_ttft_p50_ms"] > 0
+    assert tier["tiered"] and not base["tiered"]
+    # The acceptance direction: the tier turned evictions back into
+    # prefix joins the recompute leg had to re-prefill.
+    assert tier["tier"]["spills"] > 0
+    assert tier["tier"]["restores"] > 0
+    assert tier["prefill_tokens_saved"] > base["prefill_tokens_saved"]
+    assert tier["prefill_tokens_saved_vs_baseline"] > 1.0
+    # Bench-scale bit-identity: greedy, identical seeded schedule.
+    assert tier["outputs_match_baseline"] is True
+    # The ratio fields hardware rounds key on.
+    assert tier["resume_ttft_p50_vs_baseline"] > 0
+    assert tier["baseline_resume_ttft_p50_ms"] > 0
+    assert tier["vs_baseline"] > 0
+    assert tier["host_cpus"] >= 1

@@ -529,6 +529,11 @@ def cmd_serve(args, master: str) -> int:
         if pfx.get("digests"):
             line += (f"  prefixes={pfx['digests']}"
                      f"@{pfx.get('replicas_advertising', 0)} replicas")
+        if pfx.get("tier_digests"):
+            # KV memory hierarchy: warm host-tier digests restorable
+            # across the fleet (serve/tier.py, docs/kv-tiering.md).
+            line += (f"  tier={pfx['tier_digests']}"
+                     f"@{pfx.get('replicas_tier_advertising', 0)}")
         print(line)
         replicas = (fleet.get("membership") or {}).get("replicas") or []
         if replicas:
@@ -540,11 +545,12 @@ def cmd_serve(args, master: str) -> int:
                   r.get("queueDepth", 0),
                   f"{r.get('load', 0):.2f}",
                   r.get("prefixesAdvertised", 0),
+                  r.get("tierPrefixesAdvertised", 0),
                   r.get("modelVersion", "") or "-",
                   r.get("watchdogRestarts", 0)]
                  for r in replicas],
                 ["REPLICA", "STATE", "ENDPOINT", "SLOTS", "QUEUE",
-                 "LOAD", "PFX", "VERSION", "RESTARTS"],
+                 "LOAD", "PFX", "TIER", "VERSION", "RESTARTS"],
             ))
         # Disaggregated fleets: the prefill pool, same shape (its QUEUE
         # column is the pool's autoscale signal — prefill backlog).

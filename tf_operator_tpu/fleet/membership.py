@@ -88,6 +88,11 @@ class Replica:
     # chained per-block SHA-1s, MRU first, capped replica-side. The
     # router's prefix-hit scoring and pull-source selection read it.
     prefixes: tuple[str, ...] = ()
+    # KV memory hierarchy (serve/tier.py): the WARM host-tier digests
+    # this replica advertised — restorable (upload + join), not hot, so
+    # the router scores them at a discount (PrefixConfig.tier_discount)
+    # and pull-source selection treats them as a second lookup level.
+    tier_prefixes: tuple[str, ...] = ()
     # Router-local outstanding requests (begin/end around each send).
     inflight: int = 0
     consecutive_failures: int = 0
@@ -130,6 +135,7 @@ class Replica:
             # Count, not the digest list: /debug/fleet stays readable
             # and digests are opaque outside the router anyway.
             "prefixesAdvertised": len(self.prefixes),
+            "tierPrefixesAdvertised": len(self.tier_prefixes),
             "load": round(self.load, 4),
         }
 
@@ -244,6 +250,13 @@ class FleetMembership:
             # and must stop attracting prefix-scored traffic.
             rep.prefixes = tuple(
                 str(d) for d in (payload.get("prefixes") or ())
+            )
+            # The warm host-tier advertisement rides the same probe,
+            # same clear-on-absent contract (a tier emptied by eviction
+            # or --host-tier-bytes 0 must stop attracting discounted
+            # prefix traffic).
+            rep.tier_prefixes = tuple(
+                str(d) for d in (payload.get("tier_prefixes") or ())
             )
             if payload.get("role"):
                 rep.role = str(payload["role"])
@@ -439,14 +452,24 @@ class FleetMembership:
         (opaque hex noise outside the router)."""
         with self._lock:
             digests: set[str] = set()
+            tier_digests: set[str] = set()
             advertising = 0
+            tier_advertising = 0
             for r in self._replicas.values():
                 if r.prefixes:
                     advertising += 1
                     digests.update(r.prefixes)
+                if r.tier_prefixes:
+                    tier_advertising += 1
+                    tier_digests.update(r.tier_prefixes)
             return {
                 "digests": len(digests),
                 "replicas_advertising": advertising,
+                # Warm host-tier rollup (serve/tier.py): distinct
+                # restorable digests across the fleet + how many
+                # replicas hold a tier.
+                "tier_digests": len(tier_digests),
+                "replicas_tier_advertising": tier_advertising,
             }
 
     def mean_occupancy(self) -> float | None:

@@ -90,6 +90,12 @@ class PrefixConfig:
     # Plumbed to replica engines (prefix_advertise_max), echoed here so
     # the spec carries one coherent block; the router never reads it.
     advertise_max: int = 32
+    # KV memory hierarchy (serve/tier.py): what fraction of a hot hit a
+    # WARM host-tier hit is worth in the score. A tier hit saves the
+    # prefill compute but still pays the host→HBM upload at admission,
+    # so it outbids a cold replica and loses to an equally-loaded hot
+    # one. 0.0 ignores tier advertisements entirely.
+    tier_discount: float = 0.5
 
     @classmethod
     def from_policy(cls, policy: Any) -> "PrefixConfig | None":
@@ -107,6 +113,9 @@ class PrefixConfig:
             pull=bool(policy.pull),
             pull_timeout_s=float(policy.pull_timeout_s),
             advertise_max=int(policy.advertise_max),
+            # getattr: specs predating the KV tier carry no knob — keep
+            # the default discount rather than failing the render.
+            tier_discount=float(getattr(policy, "tier_discount", 0.5)),
         )
 
 
@@ -134,26 +143,45 @@ def hit_blocks(digests: Sequence[str], advertised: Iterable[str]) -> int:
 
 
 def prefix_score(load: float, hit: int, total: int,
-                 weight: float) -> float:
-    """``load - weight * hit_fraction`` — lower wins. Documented in
-    docs/fleet-serving.md; keep the two in sync."""
-    frac = (hit / total) if total else 0.0
+                 weight: float, tier_hit: int = 0,
+                 tier_discount: float = 0.0) -> float:
+    """``load - weight * effective_hit_fraction`` — lower wins.
+    ``effective`` counts hot blocks at full value and the WARM
+    host-tier blocks BEYOND the hot hit at ``tier_discount`` (a tier
+    hit skips the prefill compute but still pays the restore upload):
+    ``hit/total + discount * max(0, tier_hit - hit)/total``. The
+    defaults (tier_hit=0, discount=0) reproduce the pre-tier score
+    exactly. Documented in docs/fleet-serving.md and
+    docs/kv-tiering.md; keep the three in sync."""
+    if not total:
+        return load
+    frac = hit / total
+    if tier_hit > hit and tier_discount:
+        frac += tier_discount * (tier_hit - hit) / total
     return load - weight * frac
 
 
 def best_replica(replicas: Sequence[Any], digests: Sequence[str],
-                 weight: float):
+                 weight: float, tier_discount: float = 0.0):
     """The prefix-hit-weighted-by-load pick: min score, ties broken by
     (load, id) so equal-score candidates keep the PR 9 deterministic
     order and an equal-LOAD candidate with a deeper prefix hit wins
-    (its score is strictly lower). Returns ``(replica, hit_blocks)``;
-    (None, 0) on no candidates."""
+    (its score is strictly lower). With ``tier_discount`` > 0 a
+    replica's WARM host-tier advertisement counts as a discounted hit
+    (serve/tier.py) — restorable beats recompute, hot beats
+    restorable. Returns ``(replica, hit_blocks)`` with the HOT hit
+    depth (the pull gate keys off what is live); (None, 0) on no
+    candidates."""
     best = None
     best_hit = 0
     best_key = None
     for r in replicas:
         hit = hit_blocks(digests, getattr(r, "prefixes", ()) or ())
-        key = (prefix_score(r.load, hit, len(digests), weight),
+        tier_hit = hit_blocks(
+            digests, getattr(r, "tier_prefixes", ()) or ()
+        ) if tier_discount else 0
+        key = (prefix_score(r.load, hit, len(digests), weight,
+                            tier_hit, tier_discount),
                r.load, r.id)
         if best_key is None or key < best_key:
             best, best_hit, best_key = r, hit, key
@@ -165,15 +193,24 @@ def holder_of(replicas: Sequence[Any], digest: str,
     """The least-loaded routable replica advertising ``digest`` (the
     pull source), excluding ids in ``exclude`` (the chosen replica —
     pulling from yourself is a no-op — and anything the retry loop
-    already struck out). None when nobody advertises it."""
+    already struck out). A WARM host-tier advertisement counts too —
+    the holder's /prefix/<digest> export answers from its tier when
+    the entry is no longer hot (serve/tier.py), same wire format — but
+    hot holders are preferred at equal exclusion (their export needs
+    no tier lookup and proves the entry live). None when nobody
+    advertises it at either level."""
     skip = set(exclude)
     holders = [
         r for r in replicas
-        if r.id not in skip and digest in (getattr(r, "prefixes", ()) or ())
+        if r.id not in skip
+        and (digest in (getattr(r, "prefixes", ()) or ())
+             or digest in (getattr(r, "tier_prefixes", ()) or ()))
     ]
     if not holders:
         return None
-    return min(holders, key=lambda r: (r.load, r.id))
+    return min(holders, key=lambda r: (
+        digest not in (getattr(r, "prefixes", ()) or ()), r.load, r.id
+    ))
 
 
 class AffinityTable:

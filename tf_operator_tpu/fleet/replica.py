@@ -40,6 +40,7 @@ from tf_operator_tpu.serve.httpapi import QuietHandler, readiness_payload
 from tf_operator_tpu.serve.resilience import (
     Draining,
     PrefixNotFound,
+    TierMiss,
     error_payload,
     http_status_of,
 )
@@ -107,6 +108,11 @@ class SupervisorBackend:
         through the same /healthz shape the fakes script."""
         return self.supervisor.advertised_prefixes()
 
+    def advertised_tier_prefixes(self) -> list[str]:
+        """The warm host-tier advertisement (serve/tier.py) — rides the
+        same /healthz probe as the hot list, as ``tier_prefixes``."""
+        return self.supervisor.advertised_tier_prefixes()
+
     def export_prefix(self, digest: str) -> dict[str, Any]:
         """GET /prefix/<digest>: the supervised engine's wire-format
         export (raises the typed PrefixNotFound on stale digests)."""
@@ -151,6 +157,11 @@ class SupervisorBackend:
                 # merged trace follows one request across processes.
                 request_id=body.get("request_id"),
                 shipment=shipment,
+                # The same session key the router uses for affinity also
+                # pre-warms the host KV tier (serve/tier.py): enqueue
+                # kicks an async restore so the blocks are hot by
+                # admission.
+                session=body.get("session"),
             )
         except (KeyError, ValueError, TypeError) as exc:
             return 400, {"error": str(exc), "code": "bad_request",
@@ -211,6 +222,14 @@ class FakeReplicaBackend:
         self.prefixes: list[str] = []
         self.prefix_store: dict[str, dict] = {}
         self.prefix_exports = 0
+        # KV memory hierarchy (serve/tier.py), scriptable the same way:
+        # ``tier_prefixes`` is the /healthz warm advertisement;
+        # ``tier_store`` backs GET /prefix/<digest> as a SECOND lookup
+        # level behind ``prefix_store`` — exactly how a real replica's
+        # export falls back to its host tier. A digest advertised in
+        # neither store scripts the typed tier_miss.
+        self.tier_prefixes: list[str] = []
+        self.tier_store: dict[str, dict] = {}
         self._lock = threading.Lock()
         self._inflight = 0
         self._scripted: list[Exception] = []
@@ -227,9 +246,23 @@ class FakeReplicaBackend:
     def advertised_prefixes(self) -> list[str]:
         return list(self.prefixes)
 
+    def advertised_tier_prefixes(self) -> list[str]:
+        return list(self.tier_prefixes)
+
     def export_prefix(self, digest: str) -> dict[str, Any]:
         payload = self.prefix_store.get(digest)
         if payload is None:
+            # Warm-tier fallback, mirroring the real engine's export:
+            # a spilled entry still answers the pull from host RAM.
+            payload = self.tier_store.get(digest)
+        if payload is None:
+            if digest in self.tier_prefixes:
+                # Advertised warm but gone from the tier (byte-budget
+                # eviction raced the pull): the typed tier_miss — the
+                # puller degrades to local prefill, like any 404 here.
+                raise TierMiss(
+                    f"tier entry {digest[:12]} evicted before pull"
+                )
             raise PrefixNotFound(f"no live exact prefix entry for "
                                  f"{digest[:12]}")
         with self._lock:

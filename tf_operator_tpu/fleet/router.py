@@ -115,6 +115,7 @@ class FleetRouter:
         self.retries = 0
         self.failovers = 0
         self.prefix_hits = 0
+        self.prefix_tier_hits = 0
         self.prefix_pulls = 0
         self.prefix_pull_misses = 0
         self.prefix_pull_fallbacks = 0
@@ -146,7 +147,8 @@ class FleetRouter:
                         with self._lock:
                             self.affinity_routes += 1
                         return r
-        rep, _ = best_replica(candidates, digests, pfx.weight)
+        rep, _ = best_replica(candidates, digests, pfx.weight,
+                              pfx.tier_discount)
         return rep
 
     # -- routing -----------------------------------------------------------
@@ -223,7 +225,12 @@ class FleetRouter:
             if (pfx is not None and pfx.pull and digests
                     and not pull_disabled
                     and "shipped_kv" not in body
-                    and digests[-1] not in (rep.prefixes or ())):
+                    and digests[-1] not in (rep.prefixes or ())
+                    # A digest in the chosen replica's OWN host tier
+                    # needs no pull either: tier-aware admission
+                    # restores it locally (serve/tier.py) — cheaper
+                    # than shipping the same bytes over the wire.
+                    and digests[-1] not in (rep.tier_prefixes or ())):
                 holder = holder_of(
                     self.membership.routable(), digests[-1],
                     exclude | {rep.id},
@@ -401,6 +408,14 @@ class FleetRouter:
                 FLEET_PREFIX_HITS.inc()
                 saved = prompt_len if hit == len(digests) \
                     else hit * pfx.kv_block
+            elif hit_blocks(digests, rep.tier_prefixes or ()):
+                # Warm-tier hit (serve/tier.py): the replica restores
+                # the prefix from its host tier at admission — prefill
+                # compute saved, counted apart from hot hits (saved
+                # tokens stay the replica side's story: the router
+                # cannot know how deep the restore actually landed).
+                with self._lock:
+                    self.prefix_tier_hits += 1
         if saved:
             with self._lock:
                 self.prefix_tokens_saved += saved
@@ -421,12 +436,14 @@ class FleetRouter:
             if self.prefix_cfg is not None:
                 snap["prefix"] = {
                     "hits": self.prefix_hits,
+                    "tier_hits": self.prefix_tier_hits,
                     "pulls": self.prefix_pulls,
                     "pull_misses": self.prefix_pull_misses,
                     "pull_fallbacks": self.prefix_pull_fallbacks,
                     "tokens_saved": self.prefix_tokens_saved,
                     "affinity_routes": self.affinity_routes,
                     "weight": self.prefix_cfg.weight,
+                    "tier_discount": self.prefix_cfg.tier_discount,
                     "kv_block": self.prefix_cfg.kv_block,
                     "affinity": self.affinity.snapshot(),
                 }

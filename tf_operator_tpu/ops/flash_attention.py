@@ -152,6 +152,11 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # (the 082804 run still lists only the single-shot flashblocks line).
 # Trigger stays OPEN; cap stays 1024; qblock keeps its front slot in
 # window_autorun's unmeasured set for the next hardware window.
+# Re-checked (PR 17, 2026-08-07): unchanged — window_r05 is still the
+# newest window (same two stamps) and no probe_qblock output exists
+# under either (082804 carries only the single-shot flashblocks line;
+# 091000_hostlocal only input.jsonl). Trigger stays OPEN; cap stays
+# 1024; qblock keeps its front slot for the next hardware window.
 MAX_Q_BLOCK = 1024
 
 

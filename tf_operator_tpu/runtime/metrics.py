@@ -555,6 +555,29 @@ SERVE_SHIP_TOKENS_TOTAL = REGISTRY.counter(
     "disaggregation win: these tokens never time-shared the decode "
     "device)",
 )
+SERVE_KV_TIER_BYTES = REGISTRY.gauge(
+    "tpu_serve_kv_tier_bytes",
+    "Host-RAM KV tier occupancy by tier label (host = decoded bytes of "
+    "spilled prefix payloads currently stored, host_free = remaining "
+    "byte budget) — the second level of the KV memory hierarchy "
+    "(docs/kv-tiering.md)",
+    ("tier",),
+)
+SERVE_KV_TIER_RESTORES = REGISTRY.counter(
+    "tpu_serve_kv_tier_restores_total",
+    "Host-tier KV restore attempts on admission/prefetch, by outcome "
+    "(ok: payload uploaded into pool blocks + prefix registered; "
+    "exhausted: tier hit but no free HBM blocks — the request waits; "
+    "miss: no stored prefix deeper than the hot HBM hit; failed: "
+    "stored payload no longer decodes — dropped, local prefill runs)",
+    ("outcome",),
+)
+SERVE_KV_TIER_SPILLS = REGISTRY.counter(
+    "tpu_serve_kv_tier_spills_total",
+    "Prefix entries spilled from the HBM block pool into the host-RAM "
+    "KV tier when their last pool holder freed (retention reclaim, "
+    "retire, CoW source release) instead of vanishing",
+)
 
 # -- fleet serving (tf_operator_tpu/fleet/): TPUServe membership, the
 # occupancy-aware router, and queue-depth autoscaling -----------------------

@@ -137,6 +137,7 @@ from tf_operator_tpu.models.transformer import (
 from tf_operator_tpu.runtime.metrics import (
     SERVE_KV_BLOCKS,
     SERVE_KV_COW_TOTAL,
+    SERVE_KV_TIER_RESTORES,
     SERVE_MESH_DEVICES,
     SERVE_PHASE_SECONDS,
     SERVE_PREFILL_SAVED_TOTAL,
@@ -431,6 +432,16 @@ class ContinuousEngine:
             # exact re-joins, and /prefix exports survive completion.
             self.prefix_retain_max = 0
             self._retained: dict[bytes, list[int]] = {}
+            # Host-RAM KV tier (serve/tier.py): attach a HostTier and
+            # dying prefix entries SPILL (serialize to host wire
+            # payloads) instead of vanishing, and admission restores
+            # them. None (the default) keeps the PR 16 free/invalidate
+            # accounting bit-for-bit — kv_debug omits the tier section
+            # and every spill/restore path short-circuits.
+            self.host_tier = None
+            self.tier_spills = 0
+            self.tier_restores = 0
+            self.tier_restore_tokens = 0
             self._set_block_gauges()
         else:
             self.table_len = None
@@ -721,12 +732,163 @@ class ContinuousEngine:
         if plan is None or plan.settled or not self.kv_paged:
             return
         plan.settled = True
-        freed = self.blocks.free(
+        self._free_blocks(
             list(plan.private_blocks) + list(plan.shared_blocks)
         )
-        if freed:
-            self.prefix.invalidate_blocks(freed)
         self._set_block_gauges()
+
+    # -- host-RAM KV tier (serve/tier.py) ---------------------------------
+
+    def _free_blocks(self, blks) -> None:
+        """THE block release path: decrement refcounts, invalidate
+        prefix entries whose last holder just left — and, with a host
+        tier attached, SPILL the dying exact entries into it first.
+        Every free site (retire, retention eviction, plan/shipment
+        release, CoW source) funnels through here so no prefix can
+        vanish without the tier seeing it."""
+        freed = self.blocks.free(list(blks))
+        if freed:
+            dropped = self.prefix.invalidate_blocks(freed)
+            if dropped and self.host_tier is not None:
+                self._spill_entries(dropped)
+
+    def _spill_entries(self, dropped) -> None:
+        """Serialize dying prefix entries into the host tier as
+        shipped-KV wire payloads. Safe exactly HERE: the freed blocks
+        return to the allocator's heap but their pool rows stay intact
+        until reallocated, and the engine is single-caller (the loop
+        thread owns the device), so the gather below still reads valid
+        K/V. Only exact entries (stored sampling logits) spill — an
+        aligned sub-prefix is subsumed by its prompt's exact entry
+        (restore re-registers the whole chain) and the wire format
+        cannot ship it. Best-effort by design: a failed export drops
+        that entry (the blocks were dying anyway) and never breaks the
+        free path. No new decode-step executables — the gather is the
+        shared export jit — so the zero-recompile pin holds."""
+        from tf_operator_tpu.serve.disagg import export_shipment
+
+        t0 = time.monotonic()
+        spilled = 0
+        for e in dropped:
+            if e.logits is None:
+                continue
+            try:
+                table = np.zeros(self.table_len, np.int32)
+                table[: len(e.blocks)] = e.blocks
+                solo = self._gather(self._cache, jnp.asarray(table))
+                payload = export_shipment(
+                    solo, np.asarray(e.tokens, np.int32), e.logits,
+                    self.kv_block,
+                )
+            except Exception:  # noqa: BLE001 — spill is best-effort
+                continue
+            if self.host_tier.put(payload):
+                spilled += 1
+        if spilled:
+            self.tier_spills += spilled
+            t1 = time.monotonic()
+            SERVE_TRACER.record("kv.spill", t0, t1, entries=spilled)
+            SERVE_PHASE_SECONDS.inc(t1 - t0, phase="tier_spill")
+
+    def restore_from_tier(self, tokens, reserve_steps: int = 0):
+        """Deepest-chain host-tier restore for one prompt: probe the
+        tier for the longest stored chain prefix STRICTLY deeper than
+        the live HBM prefix hit, decode its payload, and land it
+        through ``ingest_shipment`` — after which ``plan_admission``
+        finds the restored prefix exactly as if it had never left HBM
+        (table-insert join, bit-identical decode, zero new compiles).
+
+        Returns ``(hold, outcome)``: ``("ok", ShipHold)`` — the caller
+        releases the hold once its plan holds refs; ``(None,
+        "exhausted")`` — a restorable entry exists but the pool cannot
+        hold prompt + ``reserve_steps`` (the can-restore wait: the
+        caller requeues knowing capacity, not recompute, is what it
+        waits for); ``(None, "miss")`` — nothing stored deeper than
+        what HBM already shares; ``(None, "failed")`` — the stored
+        payload no longer decodes (dropped as poison; local prefill
+        serves the request). Never raises. MUST run loop-serialized on
+        a live engine, like every other device read."""
+        from tf_operator_tpu.serve.disagg import (
+            chain_digests, decode_shipment,
+        )
+
+        if self.host_tier is None or not self.kv_paged:
+            return None, "miss"
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        L, B = int(tokens.shape[0]), self.kv_block
+        chain = chain_digests(tokens, B)  # hex, shortest-first
+        lengths = [(k + 1) * B for k in range(L // B)]
+        if L % B:
+            lengths.append(L)
+        n_live, _, live_logits = self.prefix.lookup(tokens)
+        if n_live == L and live_logits is not None:
+            return None, "miss"  # already hot: the plan exact-joins
+        t0 = time.monotonic()
+        outcome = "miss"
+        for length, hx in zip(reversed(lengths), reversed(chain)):
+            if length <= n_live:
+                break  # HBM already shares this deep — nothing to gain
+            payload = self.host_tier.get(hx)
+            if payload is None:
+                continue
+            try:
+                shp = decode_shipment(payload)
+                # Budget the WHOLE request, not just the stored prefix:
+                # the plan that follows still needs blocks for the
+                # un-restored prompt tail plus the decode horizon.
+                hold = self.ingest_shipment(
+                    shp, reserve_steps=int(reserve_steps) + (L - length),
+                    _source="tier",
+                )
+            except Exception:  # noqa: BLE001 — poison payload: drop it,
+                # local prefill serves the request.
+                self.host_tier.discard(hx)
+                outcome = "failed"
+                break
+            if hold is None:
+                outcome = "exhausted"
+                break
+            self.tier_restores += 1
+            self.tier_restore_tokens += length
+            t1 = time.monotonic()
+            SERVE_TRACER.record(
+                "kv.restore", t0, t1, tokens=length,
+                blocks=len(hold.blocks), digest=hx[:12],
+            )
+            SERVE_PHASE_SECONDS.inc(t1 - t0, phase="tier_restore")
+            SERVE_KV_TIER_RESTORES.inc(outcome="ok")
+            return hold, "ok"
+        SERVE_KV_TIER_RESTORES.inc(outcome=outcome)
+        return None, outcome
+
+    def tier_probe(self, tokens) -> bool:
+        """Could a queued prompt restore from the host tier? Pure
+        host-side membership probe (no LRU perturbation, no device
+        work) — the block-exhaustion requeue path's must-wait vs
+        can-restore distinction, safe from any thread."""
+        if self.host_tier is None or not self.kv_paged:
+            return False
+        from tf_operator_tpu.serve.disagg import chain_digests
+
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        return self.host_tier.deepest(
+            chain_digests(tokens, self.kv_block)
+        ) is not None
+
+    def advertised_tier_prefixes(self) -> list[str]:
+        """Hex digests of the warmest host-tier payloads, MRU first,
+        same ``prefix_advertise_max`` cap as the hot advertisement —
+        the /healthz ``tier_prefixes`` list the fleet router scores as
+        DISCOUNTED hits and peers pull via ``GET /prefix/<digest>``.
+        Empty without a tier (the key is omitted from /healthz: the
+        clear-on-absent contract)."""
+        if not self.kv_paged or self.host_tier is None:
+            return []
+        return self.host_tier.advertise(self.prefix_advertise_max)
 
     # -- prefix retention (fleet-global prefix reuse) ---------------------
 
@@ -769,14 +931,12 @@ class ContinuousEngine:
             if keep and not keep.isdisjoint(blks):
                 continue
             del self._retained[key]
-            freed = self.blocks.free(blks)
-            if freed:
-                self.prefix.invalidate_blocks(freed)
+            self._free_blocks(blks)
 
     # -- shipped-KV ingest (disaggregated prefill) ------------------------
 
-    def ingest_shipment(self, shp: Any,
-                        reserve_steps: int = 0) -> ShipHold | None:
+    def ingest_shipment(self, shp: Any, reserve_steps: int = 0,
+                        _source: str = "ship") -> ShipHold | None:
         """Land one verified shipment (serve/disagg.Shipment) in the
         block pool: allocate ``ceil(L/B)`` blocks, scatter the shipped
         rows through ONE fixed-shape executable, and register the
@@ -856,18 +1016,21 @@ class ContinuousEngine:
                 self._cache, jnp.asarray(table), rows
             )
         except Exception:
-            freed = self.blocks.free(blocks)
-            if freed:
-                self.prefix.invalidate_blocks(freed)
+            self._free_blocks(blocks)
             self._set_block_gauges()
             raise
         self.prefix.register(
             tokens, blocks, np.asarray(shp.logits, np.float32)
         )
         self._retain_prefix(tokens)
-        self.shipments_ingested += 1
-        self.ship_tokens_ingested += L
-        SERVE_SHIP_TOKENS_TOTAL.inc(L)
+        if _source == "ship":
+            # Host-tier restores reuse this upload path but are NOT
+            # disaggregated shipments — they keep their own counters
+            # (tier_restores / SERVE_KV_TIER_RESTORES) so /debug tells
+            # the two stories apart.
+            self.shipments_ingested += 1
+            self.ship_tokens_ingested += L
+            SERVE_SHIP_TOKENS_TOTAL.inc(L)
         self._set_block_gauges()
         return ShipHold(tuple(blocks), L)
 
@@ -926,9 +1089,7 @@ class ContinuousEngine:
         if hold is None or hold.settled or not self.kv_paged:
             return
         hold.settled = True
-        freed = self.blocks.free(list(hold.blocks))
-        if freed:
-            self.prefix.invalidate_blocks(freed)
+        self._free_blocks(list(hold.blocks))
         self._set_block_gauges()
 
     # -- fleet-global prefix reuse (fleet/prefixes.py) --------------------
@@ -972,6 +1133,16 @@ class ContinuousEngine:
             raise PrefixNotFound("dense engine holds no prefix blocks")
         entry = self.prefix.entry_for_hex(digest_hex)
         if entry is None:
+            # Warm-tier fallback: a digest no longer (or never) hot in
+            # HBM may still sit in the host tier — it stores the SAME
+            # wire payload an export would render, so answer with it
+            # directly (no gather, no device work). This is how a pull
+            # against a spilled prefix succeeds instead of 404ing.
+            if self.host_tier is not None:
+                payload = self.host_tier.get(digest_hex)
+                if payload is not None:
+                    self.prefix_exports += 1
+                    return payload
             raise PrefixNotFound(
                 f"no live exact prefix entry for {digest_hex[:12]}"
             )
@@ -979,6 +1150,15 @@ class ContinuousEngine:
         cache = self._cache
         again = self.prefix.entry_for_hex(digest_hex)
         if again is None or tuple(again[2]) != tuple(blocks):
+            # A retire racing this export SPILLS the entry (the free
+            # path funnels through the tier) — so the mid-export miss
+            # can still answer from the tier before degrading to the
+            # typed 404.
+            if self.host_tier is not None:
+                payload = self.host_tier.get(digest_hex)
+                if payload is not None:
+                    self.prefix_exports += 1
+                    return payload
             raise PrefixNotFound(
                 f"prefix entry {digest_hex[:12]} retired mid-export"
             )
@@ -1322,9 +1502,7 @@ class ContinuousEngine:
             SERVE_PHASE_SECONDS.inc(t1 - t0, phase="cow")
             st["cow"] = None
             st["shared"].remove(src)
-            freed = self.blocks.free([src])
-            if freed:
-                self.prefix.invalidate_blocks(freed)
+            self._free_blocks([src])
             self.cow_copies += 1
             SERVE_KV_COW_TOTAL.inc()
             self._set_block_gauges()
@@ -1612,9 +1790,7 @@ class ContinuousEngine:
         if self.kv_paged:
             st = self._slot_state.pop(slot, None)
             if st is not None:
-                freed = self.blocks.free(st["private"] + st["shared"])
-                if freed:
-                    self.prefix.invalidate_blocks(freed)
+                self._free_blocks(st["private"] + st["shared"])
                 self._set_block_gauges()
         self.alloc.release(slot)
 
@@ -1633,7 +1809,7 @@ class ContinuousEngine:
                 "cache_rows": self.max_slots,
                 "max_seq_len": self.cfg.max_seq_len,
             }
-        return {
+        out = {
             "mode": "paged",
             "block": self.kv_block,
             "table_len": self.table_len,
@@ -1656,6 +1832,16 @@ class ContinuousEngine:
             "prefix_exports": self.prefix_exports,
             "prefix_retained": len(self._retained),
         }
+        if self.host_tier is not None:
+            # Host-RAM KV tier — the key is PRESENT only with a tier
+            # attached, so tier-off snapshots stay bit-identical to the
+            # pre-tier accounting (pinned in tests/test_serve_tier.py).
+            out["tier"] = dict(
+                self.host_tier.snapshot(),
+                restores=self.tier_restores,
+                restore_tokens=self.tier_restore_tokens,
+            )
+        return out
 
     @property
     def free_block_fraction(self) -> float:

@@ -201,6 +201,16 @@ def readiness_payload(sched: Any, *, draining: bool = False,
         prefixes = adv()
         if prefixes:
             payload["prefixes"] = list(prefixes)
+    tadv = getattr(sched, "advertised_tier_prefixes", None)
+    if tadv is not None:
+        # KV memory hierarchy (serve/tier.py): the warm host-tier
+        # digests alongside the hot HBM ones — the router scores these
+        # as DISCOUNTED hits (restorable, not live) and peers can pull
+        # them through the same /prefix/<digest> endpoint. Same
+        # omit-when-empty / clear-on-absent contract.
+        tier_prefixes = tadv()
+        if tier_prefixes:
+            payload["tier_prefixes"] = list(tier_prefixes)
     ttft_p99 = windowed_ttft_p99()
     if ttft_p99:
         payload["ttft_p99_s"] = round(ttft_p99, 4)

@@ -670,20 +670,28 @@ class PrefixCache:
         for b in blks:
             self._by_block.setdefault(b, set()).add(key)
 
-    def invalidate_blocks(self, freed) -> None:
+    def invalidate_blocks(self, freed) -> list[_PrefixEntry]:
         """Drop every entry referencing a block whose last holder just
-        released it (``BlockAllocator.free``'s return value)."""
+        released it (``BlockAllocator.free``'s return value). Returns
+        the dropped entries — the engine's host-tier spill hook
+        (serve/tier.py): the pool rows they reference stay intact until
+        the freed blocks are REALLOCATED, so a caller that serializes
+        them before its next allocation reads valid K/V. Callers
+        without a tier ignore the return value."""
+        dropped: list[_PrefixEntry] = []
         with self._lock:
             for blk in freed:
                 for key in self._by_block.pop(blk, ()):
                     e = self._entries.pop(key, None)
                     if e is None:
                         continue
+                    dropped.append(e)
                     for other in e.blocks:
                         if other != blk:
                             peers = self._by_block.get(other)
                             if peers is not None:
                                 peers.discard(key)
+        return dropped
 
     @property
     def entries(self) -> int:

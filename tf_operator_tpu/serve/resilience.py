@@ -208,6 +208,19 @@ class PrefixNotFound(ServeError):
     retryable = False
 
 
+class TierMiss(ServeError):
+    """A host-tier KV lookup (serve/tier.py) found nothing under a
+    digest the caller expected stored — a tier advertisement went stale
+    (byte-budget eviction, poison-payload discard, or an engine rebuild
+    emptied the tier's owner). Same degrade-don't-fail contract as
+    ``prefix_not_found``: NOT retryable, absent from RETRY_ELSEWHERE —
+    the request recomputes locally and only the optimization is lost."""
+
+    code = "tier_miss"
+    http_status = 404
+    retryable = False
+
+
 # The COMPLETE wire-code vocabulary: every ``code`` a client or the
 # fleet router can see. ServeError subclasses above carry the
 # engine-side codes; these are the transport/front-door codes minted as
@@ -231,6 +244,11 @@ WIRE_CODES = frozenset((
     "prefix_not_found",    # /prefix/<digest> export found no live entry
                            # (stale advertisement) — the router degrades
                            # to local prefill, the request still serves
+    # KV memory hierarchy (serve/tier.py, docs/kv-tiering.md):
+    "tier_miss",           # host-tier lookup under an advertised digest
+                           # found nothing (evicted / discarded /
+                           # rebuilt) — recompute locally, request
+                           # still serves
 ))
 
 
@@ -311,7 +329,8 @@ class EngineSupervisor:
                  resilience: ResilienceConfig | None = None,
                  faults: Any = None,
                  prefill_tokens_per_step: int = 256,
-                 device_lock: threading.Lock | None = None) -> None:
+                 device_lock: threading.Lock | None = None,
+                 tier_prefetch: bool = True) -> None:
         # Local import: scheduler imports this module for the error
         # taxonomy, so the supervisor resolves it lazily.
         from tf_operator_tpu.serve.scheduler import ContinuousScheduler
@@ -322,6 +341,9 @@ class EngineSupervisor:
         self.faults = faults or NULL_INJECTOR
         self._prefill_budget = prefill_tokens_per_step
         self._device_lock = device_lock
+        # Session prefetch knob (serve/tier.py), generation-invariant:
+        # every rebuilt scheduler inherits it.
+        self._tier_prefetch = bool(tier_prefetch)
         self._lock = threading.RLock()     # guards the generation swap
         self._restart_lock = threading.Lock()
         self._closed = False
@@ -359,6 +381,7 @@ class EngineSupervisor:
             resilience=self.res,
             supervisor=self,
             faults=self.faults,
+            tier_prefetch=self._tier_prefetch,
         )
         if replay:
             sched.requeue(replay)
@@ -709,6 +732,18 @@ class EngineSupervisor:
         stale advertisement would just degrade to a typed pull miss)."""
         sched = self.scheduler
         return sched.advertised_prefixes() if sched is not None else []
+
+    def advertised_tier_prefixes(self) -> list[str]:
+        """The live generation's warm host-tier advertisement. Empty
+        across a rebuild window like the hot list — though serve_lm
+        attaches ONE process-lifetime HostTier to every rebuilt engine,
+        so the tier's contents (unlike HBM blocks) survive the restart
+        and re-advertise as soon as the new generation serves."""
+        sched = self.scheduler
+        if sched is None:
+            return []
+        fn = getattr(sched, "advertised_tier_prefixes", None)
+        return fn() if fn is not None else []
 
     def export_prefix(self, digest: str, timeout: float = 30.0) -> dict:
         """``GET /prefix/<digest>`` through the supervisor: delegates to

@@ -139,7 +139,8 @@ class ServeRequest:
                  seed: int = 0, eos_id: int | None = None,
                  deadline_s: float | None = None,
                  request_id: str | None = None,
-                 shipment: Any = None) -> None:
+                 shipment: Any = None,
+                 session: str | None = None) -> None:
         self.tokens = np.asarray(tokens, np.int32)
         if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
             raise ValueError("tokens must be [1, len] (one request row)")
@@ -197,6 +198,14 @@ class ServeRequest:
         # watchdog replays: a rebuilt engine re-ingests the same bytes.
         self.shipment = shipment
         self.shipped_join = False
+        # KV memory hierarchy (serve/tier.py): ``session`` marks a
+        # resumable conversation — enqueue kicks an async host-tier
+        # prefetch under it so the prefix upload overlaps queue wait.
+        # ``tier_join`` records that admission restored this prompt's
+        # KV from the host tier instead of re-prefilling (the timing()
+        # flag bench/telemetry readers key off).
+        self.session = None if session is None else str(session)
+        self.tier_join = False
 
     @property
     def ttft(self) -> float | None:
@@ -239,6 +248,11 @@ class ServeRequest:
             # The prompt's KV arrived as shipped block-pool rows from a
             # prefill replica — this request never prefilled locally.
             out["shipped_kv"] = True
+        if self.tier_join:
+            # The prompt's KV was restored from the host-RAM tier
+            # (spilled by an earlier eviction) — a session resume that
+            # skipped recomputing its prefix.
+            out["tier_kv"] = True
         return out
 
     def _finish(self, outcome: str, error: Exception | None = None) -> None:
@@ -257,11 +271,17 @@ class ContinuousScheduler:
                  device_lock: threading.Lock | None = None,
                  resilience: ResilienceConfig | None = None,
                  supervisor: EngineSupervisor | None = None,
-                 faults: Any = None) -> None:
+                 faults: Any = None,
+                 tier_prefetch: bool = True) -> None:
         if prefill_tokens_per_step < 1:
             raise ValueError("prefill_tokens_per_step must be >= 1")
         self.engine = engine
         self.prefill_tokens_per_step = prefill_tokens_per_step
+        # Session prefetch (serve/tier.py): enqueue-time async host-tier
+        # restores for requests carrying a ``session`` key. Inert
+        # without a host tier; the flag exists so ops can isolate the
+        # prefetch path (--tier-prefetch 0) from tiering itself.
+        self.tier_prefetch = bool(tier_prefetch)
         # Serializes device access with a server's OTHER decode paths
         # (serve_lm's streaming requests bypass the engine); a dedicated
         # server may pass None and let the loop own the chip outright.
@@ -389,7 +409,42 @@ class ContinuousScheduler:
                                         len(self._queue))
             SERVE_QUEUE_DEPTH.set(len(self._queue))
             self._cond.notify_all()
+        self._maybe_prefetch(req)
         return req
+
+    def _maybe_prefetch(self, req: ServeRequest) -> None:
+        """Session prefetch: post a fire-and-forget host-tier restore
+        for a just-enqueued ``session`` request, so the block upload
+        runs between decode steps WHILE the request queues — by its
+        admission the plan exact-hits the pre-warmed (retained) prefix
+        and the restore costs it nothing. Requires retention
+        (``prefix_retain_max`` > 0): the prefetch releases its ingest
+        hold immediately, and only a retained ref pins the entry until
+        admission. No-op without a tier, without a session key, with
+        the knob off, or with the loop down (admission-time restore
+        still covers those)."""
+        if req.session is None or not self.tier_prefetch:
+            return
+        eng = self.engine
+        if (getattr(eng, "host_tier", None) is None
+                or getattr(eng, "prefix_retain_max", 0) <= 0
+                or not self.running):
+            return
+        tokens = np.asarray(req.tokens)
+
+        def job(engine):
+            hold, outcome = engine.restore_from_tier(tokens)
+            if hold is not None:
+                engine.release_shipment(hold)
+            return outcome
+
+        # Same loop-serialized queue as call_engine, but nobody waits
+        # on the box: a prefetch that loses its loop is just a restore
+        # that happens at admission instead.
+        box: dict = {"done": threading.Event()}
+        with self._cond:
+            self._engine_calls.append((job, box))
+            self._cond.notify_all()
 
     def requeue(self, reqs) -> None:
         """Supervisor replay: previously-live requests re-enter the
@@ -416,6 +471,10 @@ class ContinuousScheduler:
                 # engine (same bytes, fresh pool); the flag re-earns
                 # itself there.
                 req.shipped_join = False
+                # Tier restores likewise re-earn against the rebuilt
+                # engine's pool (the HostTier itself is process-
+                # lifetime, so the payload is still there).
+                req.tier_join = False
                 req.replays += 1
                 req.enqueued_at = now
                 req.ttl_deadline = (
@@ -576,6 +635,13 @@ class ContinuousScheduler:
         host-side PrefixCache read, safe from the probe thread; empty
         for dense engines and engine fakes."""
         fn = getattr(self.engine, "advertised_prefixes", None)
+        return fn() if fn is not None else []
+
+    def advertised_tier_prefixes(self) -> list[str]:
+        """The warm host-tier digest advertisement for /healthz —
+        host-side HostTier read, safe from the probe thread; empty
+        without a tier (and for dense engines and engine fakes)."""
+        fn = getattr(self.engine, "advertised_tier_prefixes", None)
         return fn() if fn is not None else []
 
     def export_prefix(self, digest: str, timeout: float = 30.0) -> dict:
@@ -807,6 +873,26 @@ class ContinuousScheduler:
                         if not (self._slots or self._prefilling):
                             time.sleep(0.001)
                         return
+                # Tier-aware admission (serve/tier.py): land the
+                # deepest restorable host-tier prefix BEFORE the plan,
+                # so the plan shares (or exact-joins) the restored
+                # blocks instead of re-prefilling — this is how
+                # plan_admission "plans against free HBM + restorable
+                # host entries". A tier hit the pool can't hold yet
+                # requeues like a plan miss, but COUNTED apart
+                # (restore outcome "exhausted"): the request waits for
+                # capacity knowing recompute is not its fate —
+                # must-wait vs can-restore.
+                tier_hold = None
+                if ship_hold is None:
+                    verdict, tier_hold = self._restore_tier(req)
+                    if verdict == "requeue":
+                        if not self._settle_admitting(requeue_front=True):
+                            return
+                        # lint: ok guarded-attr — loop-thread-owned re-check; _settle_admitting just validated the fence
+                        if not (self._slots or self._prefilling):
+                            time.sleep(0.001)
+                        return
                 t_plan = time.monotonic()
                 try:
                     plan = self.engine.plan_admission(
@@ -818,6 +904,8 @@ class ContinuousScheduler:
                     # supervisor will replay it instead).
                     if ship_hold is not None:
                         self.engine.release_shipment(ship_hold)
+                    if tier_hold is not None:
+                        self.engine.release_shipment(tier_hold)
                     if self._settle_admitting():
                         self._note_dequeued(req, t_plan)
                         req._finish("error", exc)
@@ -830,6 +918,12 @@ class ContinuousScheduler:
                 # requeued request re-ingests next attempt.
                 if ship_hold is not None:
                     self.engine.release_shipment(ship_hold)
+                if tier_hold is not None:
+                    # Same either-way contract — and on a plan miss a
+                    # restored-but-unplanned entry SPILLS back to the
+                    # tier through the free path, so nothing is lost,
+                    # only deferred.
+                    self.engine.release_shipment(tier_hold)
                 if plan is None:
                     # No free slot — or (paged) not enough free KV
                     # blocks for prompt + max_tokens: queue until a
@@ -1019,6 +1113,42 @@ class ContinuousScheduler:
         SERVE_SHIP_INGEST_TOTAL.inc(outcome="ok")
         req.shipped_join = True
         return "ok", hold
+
+    def _restore_tier(self, req: ServeRequest):
+        """Land one request's deepest host-tier prefix ahead of its
+        admission plan (the tier twin of ``_ingest_shipment``).
+        Returns (verdict, hold): ``("ok", hold)`` — restored + prefix
+        registered (the caller releases the hold once the plan has its
+        refs); ``("requeue", None)`` — a restorable entry exists but
+        the pool can't hold it yet (the CAN-RESTORE wait, counted
+        apart from plain exhaustion); ``("none", None)`` — no tier, no
+        deep-enough entry, or a poison payload (local prefill serves
+        the request either way)."""
+        eng = self.engine
+        if (getattr(eng, "host_tier", None) is None
+                or not hasattr(eng, "restore_from_tier")):
+            return "none", None
+        alloc = getattr(eng, "alloc", None)
+        if alloc is not None and alloc.free == 0:
+            # No free slot: the plan below would requeue anyway — skip
+            # the device upload (which would otherwise repeat restore →
+            # plan miss → release once per loop iteration).
+            return "none", None
+        try:
+            with self._device():
+                hold, outcome = eng.restore_from_tier(
+                    np.asarray(req.tokens), reserve_steps=req.num_steps
+                )
+        except Exception:  # noqa: BLE001 — restore is an optimization;
+            # the prompt is right here and local prefill serves it.
+            return "none", None
+        if outcome == "ok":
+            self._beat()  # the upload returned — progress, not a stall
+            req.tier_join = True
+            return "ok", hold
+        if outcome == "exhausted":
+            return "requeue", None
+        return "none", None
 
     def _note_prefill(self, req: ServeRequest, mono0: float, *,
                       joined: bool, plan: Any = None) -> None:

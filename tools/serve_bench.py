@@ -85,6 +85,21 @@ spec/continuous, ``vs_spec_coalesce`` its ratio over the legacy leg,
 and ``accept_rate`` the timed pass's measured acceptance — the
 acceptance pin is BOTH ratios > 1 while accept_rate stays realistic.
 
+The TIER pair (``--engine tier``) is the ISSUE-17 acceptance run: a
+seeded many-session RESUME mix (round-robin closed-loop turns, so
+every session's retained prefix is reclaimed by the others' traffic
+between its own turns) served twice at the IDENTICAL tight HBM block
+budget — once with the host-RAM KV tier attached (evicted prefixes
+spill to host and restore on resume, serve/tier.py) and once without
+(evicted prefixes recompute). Greedy decoding, so the tier leg's
+outputs must MATCH the recompute leg's token-for-token
+(``outputs_match_baseline`` — the spill→restore bit-identity pin at
+bench scale). The tier line's ``prefill_tokens_saved_vs_baseline``
+(> 1: restores turn evictions back into joins) and
+``resume_ttft_p50_vs_baseline`` (< 1 on hardware: restoring beats
+recomputing; ``host_cpus`` rides the line for the CPU-round caveat)
+are the acceptance numbers.
+
 The TP pair (``--tp N``) replays the same schedule through the
 continuous engine on an N-device ``tp`` mesh (SPMD decode: params
 tp-sharded, KV storage head-sharded, one compiled step driving the
@@ -173,6 +188,13 @@ CHAT_MIX = dict(sessions=8, turns=4, user_tokens=16, steps=8,
                 replicas=4, block=8, think_ms=20.0)
 SMOKE_CHAT_MIX = dict(sessions=4, turns=3, user_tokens=8, steps=4,
                       replicas=2, block=8, think_ms=0.0)
+# The tier resume mix: enough sessions that the tight block pool
+# evicts each idle session's retained prefix before its next turn
+# (pool_extra blocks of headroom over ONE conversation's worst case).
+TIER_MIX = dict(sessions=6, turns=3, user_tokens=24, steps=8,
+                block=8, pool_extra=4, retain=64)
+SMOKE_TIER_MIX = dict(sessions=3, turns=2, user_tokens=16, steps=4,
+                      block=8, pool_extra=4, retain=64)
 
 
 def build_schedule(n_requests: int, mean_gap_ms: float, seed: int,
@@ -1121,6 +1143,180 @@ def run_fleet_prefix_legs(cfg, params, args, smoke: bool) -> list[dict]:
     return [prefix, base]
 
 
+def _run_tier_leg(name, cfg, params, sessions, mix, args, *,
+                  tiered: bool):
+    """One tier leg: a single supervised-free paged engine with a block
+    pool sized to hold ONE conversation plus ``pool_extra`` headroom
+    (prefix retention on — the PR 16 baseline), sessions replayed
+    round-robin closed-loop so every retained prefix is reclaimed by
+    the other sessions' traffic before its own next turn. ``tiered``
+    attaches the host-RAM KV tier (reclaims SPILL, resumes RESTORE —
+    serve/tier.py); the recompute leg drops evictions on the floor.
+    Returns (line, outputs) — greedy decoding, so outputs must match
+    across the pair."""
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+    from tf_operator_tpu.serve.tier import HostTier
+
+    need = mix["turns"] * (mix["user_tokens"] + mix["steps"])
+    blocks = -(-need // mix["block"]) + mix["pool_extra"]
+    engine = ContinuousEngine(
+        cfg, params, max_slots=args.max_batch, kv_block=mix["block"],
+        kv_blocks=blocks, prefill_chunk=args.prefill_chunk or None,
+    )
+    # Equal HBM budget on BOTH legs: same pool, same retention cap —
+    # the legs differ only in what happens to a reclaimed prefix.
+    engine.prefix_retain_max = mix["retain"]
+    if tiered:
+        engine.host_tier = HostTier(64 << 20)
+    sched = ContinuousScheduler(
+        engine, prefill_tokens_per_step=args.prefill_budget
+    ).start()
+
+    def one_turn(sid, prompt, steps):
+        t1 = time.perf_counter()
+        req = sched.submit_request(
+            ServeRequest(prompt[None, :], int(steps), session=sid),
+            timeout=300.0,
+        )
+        latency = time.perf_counter() - t1
+        return {
+            "tokens": [int(t) for t in req.out],
+            "latency_s": latency,
+            "ttft_s": req.ttft if req.ttft is not None else latency,
+            "itls": req.itl_values(),
+            "error": None,
+        }
+
+    def play(tag, convs, sink=None, resume_sink=None):
+        history = {}
+        for turn_idx in range(mix["turns"]):
+            for sid, turns, steps in convs:
+                if turn_idx >= len(turns):
+                    continue
+                prev = history.get(sid)
+                prompt = (turns[turn_idx] if prev is None
+                          else np.concatenate([prev, turns[turn_idx]]))
+                rec = one_turn(f"{tag}{sid}", prompt, steps)
+                if sink is not None:
+                    sink.append(rec)
+                if resume_sink is not None and turn_idx:
+                    resume_sink.append(rec["ttft_s"])
+                history[sid] = np.concatenate(
+                    [prompt, np.asarray(rec["tokens"], np.int32)]
+                )
+
+    # Untimed warmup: TWO throwaway conversations covering every turn
+    # shape compile prefill/decode off the clock — two, so the tight
+    # pool evicts one's prefix under the other's traffic and the tier
+    # leg exercises a full spill->restore round (the host->device
+    # upload path compiles here, not on the timed clock).
+    play("warm-", build_chat_sessions(dict(mix, sessions=2),
+                                      args.seed + 7919, args.vocab))
+    kv0 = engine.kv_debug()
+    saved0 = kv0.get("prefill_tokens_saved", 0)
+    restores0 = (kv0.get("tier") or {}).get("restores", 0)
+
+    results, resume_ttfts = [], []
+    t0 = time.perf_counter()
+    play("", sessions, sink=results, resume_sink=resume_ttfts)
+    wall_s = time.perf_counter() - t0
+
+    kv = engine.kv_debug()
+    stats = {
+        "mix": "tier_resume",
+        "sessions": mix["sessions"],
+        "turns": mix["turns"],
+        "tiered": tiered,
+        "kv_pool_blocks": blocks,
+        "kv_block": mix["block"],
+        # Engine-observed ground truth: prompt tokens whose K/V was not
+        # recomputed (prefix joins) over the TIMED sessions — on the
+        # tier leg, restores feed this; on the recompute leg the tight
+        # pool has already dropped the prefix by resume time.
+        "prefill_tokens_saved": kv.get("prefill_tokens_saved", 0)
+        - saved0,
+        "resume_ttft_p50_ms": round(
+            percentile(resume_ttfts, 0.5) * 1e3, 1
+        ),
+        "decode_step_compiles": engine.decode_step_compiles,
+        "warmup_compiles": engine.warmup_compiles,
+        # The resource caveat, as on the disagg line: restore's win is
+        # host->device block upload vs recompute; a 1-core CPU round
+        # prices both on the same core and the TTFT ratio can invert —
+        # the saved ratio and the outputs-match pin are the mechanism
+        # proof either way.
+        "host_cpus": os.cpu_count(),
+    }
+    if tiered:
+        tier = kv.get("tier") or {}
+        stats["tier"] = {
+            "bytes_used": tier.get("bytes_used", 0),
+            "spills": tier.get("spills", 0),
+            "hits": tier.get("hits", 0),
+            "evictions": tier.get("evictions", 0),
+            "restores": tier.get("restores", 0) - restores0,
+            "restore_tokens": tier.get("restore_tokens", 0),
+        }
+    sched.stop(timeout=30.0)
+    line = leg_summary(name, wall_s, results, stats)
+    return line, [r["tokens"] for r in results]
+
+
+def run_tier_legs(cfg, params, args, smoke: bool) -> list[dict]:
+    """The ISSUE-17 acceptance pair: the IDENTICAL seeded session-resume
+    mix at the IDENTICAL HBM block budget, once with the host-RAM KV
+    tier attached and once recomputing every evicted prefix. The tier
+    line carries the saved/TTFT ratios and the bench-scale bit-identity
+    pin (``outputs_match_baseline``)."""
+    from dataclasses import replace
+
+    mix = SMOKE_TIER_MIX if smoke else TIER_MIX
+    # A conversation's final turn is turns*(user_tokens+steps) tokens;
+    # the bench cfg's max_seq_len must hold it (power of two, >= 64).
+    need = mix["turns"] * (mix["user_tokens"] + mix["steps"])
+    seq = max(64, 1 << (need - 1).bit_length())
+    tier_cfg = replace(cfg, max_seq_len=seq)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import Transformer
+
+    tier_params = Transformer(tier_cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+    sessions = build_chat_sessions(mix, args.seed, args.vocab)
+    tier, tier_out = _run_tier_leg("tier_resume", tier_cfg, tier_params,
+                                   sessions, mix, args, tiered=True)
+    base, base_out = _run_tier_leg("tier_recompute", tier_cfg,
+                                   tier_params, sessions, mix, args,
+                                   tiered=False)
+    # The acceptance ratios: > 1 saved ratio (restores turn evictions
+    # back into prefix joins) and, on hardware, < 1 resume-TTFT ratio
+    # (uploading spilled blocks beats recomputing them). Greedy
+    # decoding makes the output comparison exact — the spill->restore
+    # bit-identity pin at bench scale.
+    tier["outputs_match_baseline"] = tier_out == base_out
+    tier["prefill_tokens_saved_vs_baseline"] = round(
+        tier["prefill_tokens_saved"]
+        / max(1, base["prefill_tokens_saved"]), 3
+    )
+    if base["value"]:
+        tier["vs_baseline"] = round(tier["value"] / base["value"], 3)
+    if base["resume_ttft_p50_ms"]:
+        tier["resume_ttft_p50_vs_baseline"] = round(
+            tier["resume_ttft_p50_ms"] / base["resume_ttft_p50_ms"], 3
+        )
+    tier["baseline_resume_ttft_p50_ms"] = base["resume_ttft_p50_ms"]
+    tier["baseline_ttft_p50_ms"] = base["ttft_p50_ms"]
+    return [tier, base]
+
+
 def build_interference_schedule(cap: dict, seed: int, vocab: int):
     """Deterministic interference traffic: short decode-heavy requests
     with a long prefill landing every ``long_every`` arrivals."""
@@ -1410,7 +1606,8 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--engine",
                    choices=("continuous", "coalesce", "both", "chaos",
-                            "fleet", "fleet-prefix", "disagg", "spec"),
+                            "fleet", "fleet-prefix", "disagg", "spec",
+                            "tier"),
                    default="both",
                    help="'chaos' runs ONLY the seeded fault-injection "
                         "mix (supervised engine, step crash + stall "
@@ -1429,7 +1626,11 @@ def main(argv: list[str] | None = None) -> int:
                         "speculative continuous engine vs the plain "
                         "continuous engine vs legacy --spec-k coalesce "
                         "on one seeded schedule with a quick-trained "
-                        "target/draft pair (accept_rate on the line)")
+                        "target/draft pair (accept_rate on the line); "
+                        "'tier' the ISSUE-17 session-resume pair: the "
+                        "host-RAM KV tier (spill on eviction, restore "
+                        "on resume) vs recompute at the identical "
+                        "tight HBM block budget")
     p.add_argument("--spec-k", type=int, default=8,
                    help="draft proposals per round for --engine spec "
                         "(CPU rounds need a large k: per-round "
@@ -1528,6 +1729,8 @@ def main(argv: list[str] | None = None) -> int:
         lines.extend(run_fleet_prefix_legs(cfg, params, args, smoke))
     if args.engine == "disagg":
         lines.extend(run_disagg_legs(args, smoke))
+    if args.engine == "tier":
+        lines.extend(run_tier_legs(cfg, params, args, smoke))
     if args.engine == "spec":
         mesh = None
         if args.tp > 1:

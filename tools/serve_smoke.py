@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Fast continuous-batching smoke: runs the `serve`-marked tests in
 isolation (slot-engine exactness vs solo generate, paged-cache/CoW/
-prefix-sharing pins, zero-recompile pins, scheduler drain/EOS/metrics,
+prefix-sharing pins, KV-tier spill/restore pins, zero-recompile pins,
+scheduler drain/EOS/metrics,
 serve-bench structure), then one INLINE end-to-end pair through a live
 paged engine + scheduler — a plain paged request and a shared-prefix
 request — asserting both reproduce solo generate bit-for-bit and the
@@ -260,6 +261,7 @@ def main(argv: list[str] | None = None) -> int:
             sys.executable, "-m", "pytest",
             "tests/test_serve_engine.py", "tests/test_serve_sched.py",
             "tests/test_kvcache_paged.py", "tests/test_serve_chaos.py",
+            "tests/test_serve_tier.py",
             "-m", "serve and not slow",
             "-q", "-p", "no:cacheprovider",
             *args,

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Fast KV-memory-hierarchy smoke: runs the `tier`-marked tests in
+isolation (spill→restore bit-identity dense AND kv8, tier-off
+equivalence, can-restore admission, warm advertisement/export/typed
+tier_miss, HostTier byte-budget unit pins, the warm-holder fleet chaos
+case), then one INLINE end-to-end spill→restore through a live paged
+engine: serve a prompt, reclaim its retained prefix under simulated
+pool pressure (the entry spills to the host tier), serve the identical
+prompt again and assert the restored decode is bit-identical to solo
+generate with the whole prefill skipped and zero decode recompiles.
+The quick loop for iterating on tf_operator_tpu/serve/tier.py without
+paying for the whole tier-1 run; the same tests also ride
+tools/serve_smoke.py's default pass.
+
+    python tools/tier_smoke.py             # tier tests + inline e2e
+    python tools/tier_smoke.py -k kv8      # extra pytest args pass through
+    python tools/tier_smoke.py --bench     # + the slow bench pair
+
+Exit code is pytest's (or 1 if the e2e fails).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def spill_restore_e2e() -> int:
+    """One spill→restore round end-to-end: live engine, live serving
+    loop, the restored decode pinned against solo generate and the
+    tier's own counters."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+    from tf_operator_tpu.serve.tier import HostTier
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    engine = ContinuousEngine(
+        cfg, params, max_slots=2, kv_paged=True, kv_block=8
+    )
+    engine.prefix_retain_max = 16
+    engine.host_tier = HostTier(16 << 20)
+    sched = ContinuousScheduler(engine).start()
+    try:
+        prompt = np.random.default_rng(17).integers(
+            0, cfg.vocab_size, (1, 13)
+        ).astype(np.int32)
+        steps = 16
+        want = np.asarray(
+            generate(cfg, params, jnp.asarray(prompt), steps)
+        )[0].tolist()
+        r1 = sched.submit_request(ServeRequest(prompt, steps),
+                                  timeout=60.0)
+        assert r1.out == want, "paged output != solo"
+        # Pool pressure reclaims the retained prefix — it SPILLS.
+        sched.call_engine(lambda e: e._evict_retained(until_free=10 ** 9))
+        assert engine.blocks.used == 0, "spill left device blocks live"
+        assert len(engine.host_tier) >= 1, "eviction did not spill"
+        saved0 = engine.prefill_tokens_saved
+        r2 = sched.submit_request(ServeRequest(prompt, steps,
+                                               session="smoke"),
+                                  timeout=60.0)
+        assert r2.out == want, "restored output != solo"
+        assert engine.tier_restores >= 1, "admission did not restore"
+        assert engine.prefill_tokens_saved - saved0 >= prompt.shape[1], (
+            "restore did not skip the prefill"
+        )
+        assert engine.decode_step_compiles == engine.warmup_compiles
+        snap = engine.host_tier.snapshot()
+        print(
+            f"tier_smoke: spill→restore e2e ok (spills="
+            f"{snap['spills']}, restores={engine.tier_restores}, "
+            f"restored {engine.tier_restore_tokens} tokens, "
+            f"{snap['bytes_used']} host bytes, zero decode recompiles)",
+            flush=True,
+        )
+        return 0
+    finally:
+        sched.stop(timeout=30.0)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    bench = "--bench" in args
+    if bench:
+        args.remove("--bench")
+    marker = "tier" if bench else "tier and not slow"
+    cmd = [
+        sys.executable, "-m", "pytest",
+        "tests/test_serve_tier.py", "tests/test_fleet_chaos.py",
+        "-m", marker,
+        "-q", "-p", "no:cacheprovider",
+        *args,
+    ]
+    rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+    if rc != 0:
+        return rc
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return spill_restore_e2e()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
