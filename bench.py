@@ -448,6 +448,113 @@ def bench_decode(peak_hbm_gbps: float | None) -> None:
         )
 
 
+def bench_decode_paged(peak_hbm_gbps: float | None) -> None:
+    """Paged decode at LONG context: gather vs pallas attend (ISSUE 18)
+    through the continuous engine on one seeded occupancy spread.
+
+    The gather read materializes [b, max_seq_len, KV, Dh] every step
+    regardless of lane lengths; the pallas kernel's HBM traffic is
+    bounded by each lane's actual block count. So the leg pins lanes at
+    GEOMETRICALLY SPREAD lengths (one near max-S, the rest halving) —
+    the regime where the two paths' modeled KV reads differ ~3x — and
+    reports generated tokens/sec for both attends plus that modeled
+    ratio. GQA (kv_heads=4) keeps the kernel's copy-then-finalize
+    scratch inside its VMEM budget at 4k context. On a CPU round the
+    kernel runs in the pallas INTERPRETER — the line is a mechanism
+    proof only (host_cpus rides it); real ratios come from the next
+    hardware window (with perf_probe.py's kvblock stage as the
+    op-level attribution)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dataclasses import replace
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if smoke:
+        B, steps, blk = 2, 4, 8
+        cfg = TransformerConfig(
+            dtype=jnp.float32, vocab_size=256, d_model=64, n_heads=4,
+            n_kv_heads=2, d_ff=128, n_layers=2, max_seq_len=64,
+        )
+        lane_lens = [24, 12]
+    else:
+        B, steps, blk = 4, 128, 128
+        cfg = TransformerConfig(
+            dtype=jnp.bfloat16, n_kv_heads=4,
+            **dict(LM_SIZE, max_seq_len=4096),
+        )
+        lane_lens = [3500, 1750, 875, 437]
+    S = cfg.max_seq_len
+    model = Transformer(cfg)
+    params = jax.tree.map(
+        lambda x: x.astype(cfg.dtype),
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 8), jnp.int32))["params"],
+    )
+    rng = np.random.default_rng(18)
+    prompts = [rng.integers(0, cfg.vocab_size, (1, n)).astype(np.int32)
+               for n in lane_lens]
+    # reps x warmup decode rounds must fit every lane's window.
+    reps, warmup = 2, 2
+    budget = steps * (reps + warmup)
+    assert max(lane_lens) + budget < S
+    results = {}
+    for attend in ("gather", "pallas"):
+        try:
+            engine = ContinuousEngine(
+                cfg, params, max_slots=B, kv_paged=True, kv_block=blk,
+                kv_attend=attend,
+            )
+            for p in prompts:
+                slot = engine.join(jnp.asarray(p), num_steps=budget)
+                assert slot is not None
+
+            def call(engine=engine):
+                for _ in range(steps):
+                    toks = engine.step()
+                int(toks[0])  # host readback = completion
+
+            times = timed_reps(call, reps=reps, warmup=warmup)
+        except Exception as exc:  # noqa: BLE001 — pallas must not kill gather
+            print(f"bench: decode_paged {attend} leg failed: {exc!r}",
+                  file=sys.stderr, flush=True)
+            continue
+        dt = min(times)
+        results[attend] = B * steps / dt
+        if engine.decode_step_compiles != engine.warmup_compiles:
+            print(f"bench: decode_paged {attend} leg RECOMPILED "
+                  f"({engine.decode_step_compiles} != "
+                  f"{engine.warmup_compiles})", file=sys.stderr,
+                  flush=True)
+        # Modeled per-step KV read ratio (pallas/gather): blocks the
+        # lanes actually own vs the full-window gather.
+        owned = sum(-(-(n + budget) // blk) for n in lane_lens)
+        emit(
+            f"lm_decode_gen_tokens_per_sec_paged_{attend}_b{B}_s{S}"
+            "_1chip",
+            results[attend],
+            "tokens/sec",
+            results[attend] / results["gather"]
+            if attend == "pallas" and results.get("gather") else 0.0,
+            mean_seconds_per_call=sum(times) / len(times),
+            kv_read_frac_model=owned * blk / (B * S),
+            host_cpus=os.cpu_count(),
+            interpret=not _on_tpu(),
+        )
+
+
+def _on_tpu() -> bool:
+    from tf_operator_tpu.ops.flash_attention import on_tpu_backend
+
+    return on_tpu_backend()
+
+
 def bench_serve_continuous(peak_hbm_gbps: float | None) -> None:
     """Sustained mixed-traffic serving line: subprocess-runs
     tools/serve_bench.py — seeded open-loop mixed-length schedule through
@@ -1253,6 +1360,7 @@ _SECTIONS: dict = {
     "resnet_resident": (bench_resnet_resident, chip_peak_tflops, 900.0),
     "flash_attention": (bench_flash_attention, chip_peak_tflops, 700.0),
     "decode": (bench_decode, chip_peak_hbm_gbps, 700.0),
+    "decode_paged": (bench_decode_paged, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
     "serve_tp": (bench_serve_tp, chip_peak_hbm_gbps, 480.0),
     "serve_spec": (bench_serve_spec, chip_peak_hbm_gbps, 560.0),

@@ -38,7 +38,10 @@ generate()'s own validation). Two serving engines (``--engine``):
   physical blocks copy-on-write and skip their prefill, and
   ``--kv-dense`` falls back to the PR-5 dense slot tensor. ``--kv-int8``
   composes with BOTH layouts (paged: int8 blocks + per-block scale
-  sidecar pools riding the same tables). ``--tp N``
+  sidecar pools riding the same tables). ``--kv-attend pallas`` swaps
+  the paged decode attend for the block-table-walking pallas kernel
+  (per-lane-bounded HBM traffic, bit-identical to the gather default;
+  docs/serving.md "Paged-attention kernel"). ``--tp N``
   runs the SAME engine SPMD over an N-device mesh: params tp-sharded by
   the training rules, KV storage head-sharded, one compiled step
   driving the whole slice (composes with ``--kv-paged``/``--kv-dense``;
@@ -287,6 +290,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--kv-block", type=int, default=64, metavar="TOKENS",
                    help="paged KV cache block size in tokens "
                         "(--max-seq-len must divide evenly)")
+    p.add_argument("--kv-attend", choices=("gather", "pallas"),
+                   default="gather",
+                   help="paged decode attention path: 'gather' (the "
+                        "default and the bit-identity oracle — pool "
+                        "blocks gathered dense, XLA einsum) or "
+                        "'pallas' (ops/paged_attention.py — walks the "
+                        "block table directly so per-step HBM traffic "
+                        "is bounded by actual lane lengths; pinned "
+                        "bit-identical to gather; requires --kv-paged "
+                        "and a geometry inside the kernel's VMEM "
+                        "budget, and runs INTERPRETED off-TPU)")
     p.add_argument("--prefix-advertise", type=int, default=32,
                    metavar="N",
                    help="hot prefix-cache entries advertised on "
@@ -723,6 +737,7 @@ def main(argv: list[str] | None = None) -> int:
                 prefill_chunk=(args.prefill_chunk or None),
                 kv_paged=kv_paged, kv_block=args.kv_block,
                 kv_blocks=args.kv_pool_blocks,
+                kv_attend=args.kv_attend if kv_paged else "gather",
                 faults=faults, mesh=mesh,
                 spec_k=args.spec_k, draft_cfg=draft_cfg,
                 draft_params=draft_params,

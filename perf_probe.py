@@ -359,6 +359,107 @@ def probe_qblock() -> None:
     )
 
 
+def probe_kvblock() -> None:
+    """Paged-attention decode A/B + block-chunk geometry sweep (ISSUE
+    18): the pallas kernel (ops/paged_attention.py) vs the gather
+    oracle read, interleaved rounds in ONE process exactly like
+    probe_qblock (chip/tunnel drift hits every leg equally), across
+    kv_block sizes at a long context with lanes SPREAD over occupancy
+    — the kernel's claim is per-lane-bounded HBM traffic, so the win
+    must grow with the gap between mean lane length and max-S. Reports
+    best-rep microseconds per decode step per leg plus the modeled
+    KV-read fraction (pallas bytes / gather bytes — the roofline-level
+    expectation the measured ratio should track on hardware; on a CPU
+    smoke run the interpret-mode numbers are mechanism proof only)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.paged_attention import paged_attend
+
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    interpret = smoke
+    if smoke:
+        b, kv, g, dh, S = 2, 2, 2, 16, 64
+        blks = (8, 16)
+    else:
+        # 12 MiB VMEM ceiling: S*kv*dh*(2+4) bytes of finalize scratch
+        # — 4096 x 4 x 128 x 6 sits exactly at the budget.
+        b, kv, g, dh, S = 8, 4, 8, 128, 4096
+        blks = (64, 128, 256)
+    h = kv * g
+    dtype = jnp.float32 if smoke else jnp.bfloat16
+    rng = np.random.default_rng(18)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, dh)), dtype)
+    # Occupancy spread: one lane near max-S, the rest geometrically
+    # shorter — mean length ~S/3, so gather reads ~3x the kernel's
+    # model bytes per step.
+    spread = [max(1, (S - 1) >> i) for i in range(b)]
+    idx = jnp.asarray(spread, jnp.int32)
+
+    legs = {}
+    ratios = {}
+    for blk in blks:
+        table_len = S // blk
+        nblk = [-(-(p + 1) // blk) for p in spread]
+        nb = sum(nblk) + 1
+        pool_k = jnp.asarray(
+            rng.standard_normal((nb, blk, kv, dh)), dtype)
+        pool_v = jnp.asarray(
+            rng.standard_normal((nb, blk, kv, dh)), dtype)
+        table = np.zeros((b, table_len), np.int32)
+        nxt = 1
+        for i in range(b):
+            for e in range(nblk[i]):
+                table[i, e] = nxt
+                nxt += 1
+        table = jnp.asarray(table)
+
+        def gather_read(q, pk, pv, tbl, ix):
+            # The oracle read: dense gather + batched einsums — what
+            # _decode_attend_paged does under kv_attend="gather".
+            keys = pk[tbl].reshape(b, S, kv, dh)
+            vals = pv[tbl].reshape(b, S, kv, dh)
+            qg = q.reshape(b, 1, kv, g, dh)
+            s = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys,
+                           preferred_element_type=jnp.float32)
+            s = s * (dh ** -0.5)
+            valid = jnp.arange(S)[None, :] <= ix[:, None]
+            s = jnp.where(valid[:, None, None, None, :], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bkgqs,bskd->bqkgd", p,
+                             vals.astype(jnp.float32))
+            return out.reshape(b, 1, h, dh)
+
+        g_fn = jax.jit(gather_read)
+        p_fn = jax.jit(lambda q, pk, pv, tbl, ix: paged_attend(
+            q, pk, pv, tbl, ix, interpret=interpret))
+
+        def make_call(fn, pk=pool_k, pv=pool_v, tbl=table):
+            return lambda: jax.block_until_ready(fn(q, pk, pv, tbl, idx))
+
+        legs[f"blk{blk}_gather"] = make_call(g_fn)
+        legs[f"blk{blk}_pallas"] = make_call(p_fn)
+        ratios[f"blk{blk}_kv_read_frac"] = (
+            sum(nblk) * blk / (b * S)  # modeled pallas/gather KV bytes
+        )
+
+    for call in legs.values():  # compile off the clock
+        bench._warm(call, warmup=2)
+    best: dict[str, float] = {}
+    for _ in range(4):  # interleaved rounds, same as probe_qblock
+        for name, call in legs.items():
+            t0 = time.perf_counter()
+            call()
+            dt = time.perf_counter() - t0
+            best[name] = min(best.get(name, float("inf")), dt)
+    emit(
+        "kvblock", seq=S, batch=b, kv_heads=kv, head_dim=dh,
+        interpret=interpret, mean_lane=sum(spread) / len(spread),
+        **{f"{name}_us": dt * 1e6 for name, dt in best.items()},
+        **ratios,
+    )
+
+
 def probe_flashsweep() -> None:
     """Best-rep attention TFLOP/s over a (seq, batch) grid: round 3's
     hardware sample showed 8k/b4 running 10x slower than 64k/b1 with 16x
@@ -869,6 +970,7 @@ PROBES = {
     "flashramp": probe_flashramp,
     "flashblocks": probe_flashblocks,
     "qblock": probe_qblock,
+    "kvblock": probe_kvblock,
     "flashsweep": probe_flashsweep,
     "h2d": probe_h2d,
     "input": probe_input,

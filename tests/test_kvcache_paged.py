@@ -67,10 +67,11 @@ def solo(params, prompt, steps, *, temperature=0.0, top_p=None, seed=0):
 
 
 def paged_engine(params, *, slots=4, blocks=None, chunk=None,
-                 block=BLOCK) -> ContinuousEngine:
+                 block=BLOCK, attend="gather") -> ContinuousEngine:
     return ContinuousEngine(
         CFG, params, max_slots=slots, prefill_chunk=chunk,
         kv_paged=True, kv_block=block, kv_blocks=blocks,
+        kv_attend=attend,
     )
 
 
@@ -221,15 +222,18 @@ def test_block_boundary_and_single_token_prompts(params):
     assert engine.blocks.used == 0  # every block returned to the pool
 
 
-def test_cow_on_first_decode_token_after_shared_prefix(params):
+@pytest.mark.parametrize("attend", ["gather", "pallas"])
+def test_cow_on_first_decode_token_after_shared_prefix(params, attend):
     """An exact whole-prompt match whose last block is PARTIAL: the
     sharer skips prefill entirely, its first decode token triggers ONE
     copy-on-write, and its output equals the donor's (and solo's)
     bit-for-bit — while the donor keeps writing its own stream into the
-    original block."""
+    original block. Parametrized over both paged attends: a CoW'd
+    table entry is just new DATA to the pallas kernel's scalar-prefetch
+    walk, so the pin (and zero recompiles) must hold identically."""
     cow_before = SERVE_KV_COW_TOTAL.value()
     saved_before = SERVE_PREFILL_SAVED_TOTAL.value()
-    engine = paged_engine(params, slots=3)
+    engine = paged_engine(params, slots=3, attend=attend)
     prompt = prompt_of(2 * BLOCK + 3, 7)  # partial last block
     steps = 9
     donor = engine.join(jnp.asarray(prompt), num_steps=steps)
@@ -337,14 +341,18 @@ def test_paged_matches_dense_engine_token_for_token(params):
         )
 
 
-def test_paged_kv8_matches_dense_kv8_and_solo_with_cow(params):
+@pytest.mark.parametrize("attend", ["gather", "pallas"])
+def test_paged_kv8_matches_dense_kv8_and_solo_with_cow(params, attend):
     """The kv-int8 POOL layout (ISSUE 15): int8 blocks + per-block
     scale sidecar pools riding the same block tables. Paged-kv8 decode
     must equal dense-kv8 AND solo generate on the kv8 config,
     token-for-token, including an exact-prefix re-join whose
     copy-on-write must carry the SCALE sidecars along with the int8
     rows (a block copy that forgot the scales would decode with zeroed
-    scales — wrong tokens, loudly)."""
+    scales — wrong tokens, loudly). Under ``attend="pallas"`` the same
+    pin proves the kernel's FUSED dequant (int8 keys rescaled on the
+    score tensor, value scale folded into probabilities) reproduces
+    the gather factoring exactly."""
     from dataclasses import replace
 
     cfg8 = replace(CFG, kv_int8=True)
@@ -362,7 +370,8 @@ def test_paged_kv8_matches_dense_kv8_and_solo_with_cow(params):
     streams = {}
     for paged in (False, True):
         engine = ContinuousEngine(
-            cfg8, p8, max_slots=3, kv_paged=paged, kv_block=BLOCK
+            cfg8, p8, max_slots=3, kv_paged=paged, kv_block=BLOCK,
+            kv_attend=attend if paged else "gather",
         )
         sa = engine.join(jnp.asarray(a), num_steps=8)
         out = {sa: []}

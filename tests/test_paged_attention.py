@@ -1,0 +1,295 @@
+"""Paged-attention kernel pins (f32 CPU interpret): paged_attend must
+be BITWISE-identical to the gather oracle — a verbatim transcription of
+``_decode_attend_paged``'s read side — across block geometry x {dense,
+kv8} x {single-token, K+1 VERIFY chunk} x lane-position spread
+(including inactive lanes at position 0 whose tables are all zeros past
+the first block). Plus the loud-failure contracts: bad kv_attend config
+values, pallas-without-paged, the VMEM-budget gate, kv%tp tiling, and
+the scratch-size arithmetic itself.
+
+The oracle transcription here is the REFERENCE SEMANTICS — if the
+gather path in models/transformer.py changes its factoring, this copy
+must change with it (and the kernel after it), or the engine-level
+bit-identity suites will catch the drift anyway.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.ops.paged_attention import (
+    VMEM_BUDGET_BYTES,
+    paged_attend,
+    paged_attend_supported,
+    paged_attend_vmem_bytes,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def gather_oracle(q, pool_k, pool_v, table, idx, ksp=None, vsp=None):
+    """_decode_attend_paged's read side, transcribed verbatim: gather
+    the pool dense, batched einsums, kv8 scales on scores (pre-1/sqrt d)
+    and probabilities, -1e30 mask, NO preferred_element_type on the
+    value einsum."""
+    b, t, h, dh = q.shape
+    nb, blk, kv, _ = pool_k.shape
+    g = h // kv
+    kv8 = ksp is not None
+    S = table.shape[1] * blk
+    keys = pool_k[table].reshape(b, S, kv, dh)
+    vals = pool_v[table].reshape(b, S, kv, dh)
+    if kv8:
+        keys = keys.astype(jnp.bfloat16)
+        k_scales = ksp[table].reshape(b, S, kv)
+        v_scales = vsp[table].reshape(b, S, kv)
+    qg = q.reshape(b, t, kv, g, dh)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, keys,
+                   preferred_element_type=jnp.float32)
+    if kv8:
+        s = s * k_scales.transpose(0, 2, 1)[:, :, None, None, :]
+    s = s * (dh ** -0.5)
+    pos = idx[:, None] + jnp.arange(t)[None, :]
+    valid = jnp.arange(S)[None, None, :] <= pos[:, :, None]
+    s = jnp.where(valid[:, None, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    if kv8:
+        p = p * v_scales.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, vals.astype(jnp.float32))
+    return out.reshape(b, t, h, dh)
+
+
+def kv8_quant(x):
+    """The engine's _kv8_quant: symmetric per-row int8, scale floor."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    return jnp.round(xf / s[..., None]).astype(jnp.int8), s
+
+
+def make_case(b, t, kv, g, dh, blk, table_len, kv8, seed, spread):
+    """Distinct pool blocks per lane for its covered range, zeros past
+    it — so a kernel that reads past a lane's nblk (or another lane's
+    blocks) sees DIFFERENT data than the oracle and fails loudly."""
+    rng = np.random.default_rng(seed)
+    h = kv * g
+    nb = b * table_len + 1
+    q = jnp.asarray(rng.standard_normal((b, t, h, dh)), jnp.float32)
+    if kv8:
+        pool_k, ksp = kv8_quant(
+            jnp.asarray(rng.standard_normal((nb, blk, kv, dh)),
+                        jnp.float32))
+        pool_v, vsp = kv8_quant(
+            jnp.asarray(rng.standard_normal((nb, blk, kv, dh)),
+                        jnp.float32))
+    else:
+        pool_k = jnp.asarray(
+            rng.standard_normal((nb, blk, kv, dh)), jnp.float32)
+        pool_v = jnp.asarray(
+            rng.standard_normal((nb, blk, kv, dh)), jnp.float32)
+        ksp = vsp = None
+    assert len(spread) == b
+    idx = jnp.asarray(spread, jnp.int32)
+    table = np.zeros((b, table_len), np.int32)
+    nxt = 1
+    for i in range(b):
+        need = -(-(int(idx[i]) + t) // blk)  # ceil — matches the kernel
+        for e in range(need):
+            table[i, e] = nxt
+            nxt += 1
+    return q, pool_k, pool_v, jnp.asarray(table), idx, ksp, vsp
+
+
+# Geometry x precision x chunk-width x occupancy-spread matrix. Every
+# spread includes boundary lanes: position 0 (inactive/just-admitted),
+# block-aligned positions, and last-row-of-table positions.
+CASES = [
+    # t=1 single-token decode, grouped and ungrouped query heads
+    dict(b=3, t=1, kv=2, g=1, dh=16, blk=8, table_len=8, kv8=False,
+         seed=0, spread=[5, 17, 0]),
+    dict(b=3, t=1, kv=2, g=2, dh=16, blk=8, table_len=8, kv8=False,
+         seed=1, spread=[1, 40, 63]),
+    # t=3 VERIFY chunk (K=2 speculative: K+1 query rows)
+    dict(b=3, t=3, kv=2, g=2, dh=16, blk=8, table_len=8, kv8=False,
+         seed=2, spread=[5, 17, 0]),
+    # kv8: fused dequant, single-token and VERIFY chunk
+    dict(b=3, t=1, kv=2, g=2, dh=16, blk=8, table_len=8, kv8=True,
+         seed=3, spread=[5, 17, 0]),
+    dict(b=3, t=3, kv=2, g=2, dh=16, blk=8, table_len=8, kv8=True,
+         seed=4, spread=[8, 33, 0]),
+    # MQA extreme (kv=1) with wide heads, coarse blocks
+    dict(b=2, t=1, kv=1, g=4, dh=32, blk=16, table_len=4, kv8=False,
+         seed=5, spread=[30, 2]),
+    # MHA extreme (g=1) with fine blocks, long table, kv8 VERIFY
+    dict(b=2, t=4, kv=4, g=1, dh=8, blk=4, table_len=16, kv8=True,
+         seed=6, spread=[13, 59]),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: (
+    f"b{c['b']}t{c['t']}kv{c['kv']}g{c['g']}dh{c['dh']}"
+    f"blk{c['blk']}x{c['table_len']}{'-kv8' if c['kv8'] else ''}"
+))
+def test_paged_attend_bitwise_vs_gather_oracle(case):
+    q, pk, pv, table, idx, ksp, vsp = make_case(**case)
+    want = np.asarray(gather_oracle(q, pk, pv, table, idx, ksp, vsp))
+    got = np.asarray(paged_attend(q, pk, pv, table, idx,
+                                  k_scale_pool=ksp, v_scale_pool=vsp))
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(want, got)
+
+
+def test_paged_attend_bitwise_under_jit():
+    """The engine always calls through jit — the pin must survive XLA's
+    whole-graph optimization, not just eager dispatch."""
+    case = dict(b=3, t=3, kv=2, g=2, dh=16, blk=8, table_len=8,
+                kv8=True, seed=7, spread=[5, 17, 0])
+    q, pk, pv, table, idx, ksp, vsp = make_case(**case)
+    want = np.asarray(jax.jit(gather_oracle)(q, pk, pv, table, idx,
+                                             ksp, vsp))
+    got = np.asarray(jax.jit(
+        lambda *a: paged_attend(a[0], a[1], a[2], a[3], a[4],
+                                k_scale_pool=a[5], v_scale_pool=a[6])
+    )(q, pk, pv, table, idx, ksp, vsp))
+    np.testing.assert_array_equal(want, got)
+
+
+def test_paged_attend_ignores_stale_table_tail():
+    """Entries past a lane's nblk must be invisible: pointing the tail
+    at a real, data-bearing block must not change the output (the
+    kernel's clamp + zero-fill, the oracle's mask)."""
+    case = dict(b=2, t=1, kv=2, g=2, dh=16, blk=8, table_len=8,
+                kv8=False, seed=8, spread=[5, 20])
+    q, pk, pv, table, idx, ksp, vsp = make_case(**case)
+    base = np.asarray(paged_attend(q, pk, pv, table, idx))
+    dirty = np.asarray(table).copy()
+    dirty[0, 1:] = 3  # lane 0 owns one block; tail points at lane 1's
+    got = np.asarray(paged_attend(q, pk, pv, jnp.asarray(dirty), idx))
+    np.testing.assert_array_equal(base, got)
+
+
+# ---- loud-failure contracts ----------------------------------------
+
+
+def _tiny():
+    return make_case(b=1, t=1, kv=2, g=1, dh=8, blk=4, table_len=4,
+                     kv8=False, seed=9, spread=[3])
+
+
+def test_paged_attend_rejects_empty_chunk():
+    q, pk, pv, table, idx, _, _ = _tiny()
+    with pytest.raises(ValueError, match="at least one query row"):
+        paged_attend(q[:, :0], pk, pv, table, idx)
+
+
+def test_paged_attend_rejects_untiled_heads():
+    q, pk, pv, table, idx, _, _ = _tiny()
+    q3 = jnp.concatenate([q, q, q], axis=2)  # 6 heads over KV=4 pool
+    pk4 = jnp.concatenate([pk, pk], axis=2)
+    pv4 = jnp.concatenate([pv, pv], axis=2)
+    with pytest.raises(ValueError, match="multiple of KV"):
+        paged_attend(q3, pk4, pv4, table, idx)
+
+
+def test_paged_attend_rejects_lone_scale_pool():
+    q, pk, pv, table, idx, _, _ = _tiny()
+    ks = jnp.ones(pk.shape[:3], jnp.float32)
+    with pytest.raises(ValueError, match="BOTH scale pools"):
+        paged_attend(q, pk.astype(jnp.int8), pv.astype(jnp.int8),
+                     table, idx, k_scale_pool=ks)
+
+
+def test_paged_attend_rejects_untileable_tp():
+    """KV that doesn't divide tp must raise, not silently fall back —
+    the gather path degrades to replication there, a pallas call has
+    nothing to degrade WITH."""
+    class _FakeMesh:  # paged_attend only consults mesh.shape
+        shape = {"tp": 2}
+
+    case = dict(b=1, t=1, kv=1, g=2, dh=8, blk=4, table_len=4,
+                kv8=False, seed=10, spread=[3])
+    q, pk, pv, table, idx, _, _ = make_case(**case)
+    with pytest.raises(ValueError, match="does not tile tp=2"):
+        paged_attend(q, pk, pv, table, idx, mesh=_FakeMesh())
+
+
+def test_paged_attend_rejects_vmem_blowout():
+    """Geometry past the VMEM budget raises at trace time. S=16384 x
+    KV=1 x Dh=128 f32 needs S*kv*dh*(4+4) = 16 MiB of scratch > 12."""
+    blk, table_len, kv, dh = 128, 128, 1, 128
+    assert not paged_attend_supported(table_len * blk, kv, dh,
+                                      dtype_bytes=4)
+    q = jnp.zeros((1, 1, kv, dh), jnp.float32)
+    pk = jnp.zeros((2, blk, kv, dh), jnp.float32)
+    table = jnp.zeros((1, table_len), jnp.int32)
+    idx = jnp.zeros((1,), jnp.int32)
+    with pytest.raises(ValueError, match="VMEM budget"):
+        paged_attend(q, pk, pk, table, idx)
+
+
+def test_vmem_bytes_arithmetic():
+    # dense bf16: S*kv*dh*(2 + 4)
+    assert paged_attend_vmem_bytes(64, 2, 16) == 64 * 2 * 16 * 6
+    # f32 storage: (4 + 4)
+    assert paged_attend_vmem_bytes(64, 2, 16, dtype_bytes=4) == (
+        64 * 2 * 16 * 8
+    )
+    # kv8: int8 keys land bf16 (2) + f32 values (4) + two f32 sidecars
+    assert paged_attend_vmem_bytes(64, 2, 16, kv_int8=True,
+                                   dtype_bytes=1) == (
+        64 * 2 * 16 * 6 + 2 * 64 * 2 * 4
+    )
+    # tp divides the KV extent (and only when it tiles)
+    assert paged_attend_vmem_bytes(64, 4, 16, tp=2) == (
+        paged_attend_vmem_bytes(64, 2, 16)
+    )
+    assert paged_attend_vmem_bytes(64, 3, 16, tp=2) == (
+        paged_attend_vmem_bytes(64, 3, 16)
+    )
+    # the gate is just the comparison against the budget
+    assert paged_attend_supported(64, 2, 16)
+    assert not paged_attend_supported(64, 2, 16, budget=1)
+    assert VMEM_BUDGET_BYTES == 12 * 1024 * 1024
+
+
+# ---- config plumbing: loud rejection of nonsense selections ---------
+
+
+def test_config_rejects_unknown_kv_attend():
+    from tf_operator_tpu.models.transformer import TransformerConfig
+    with pytest.raises(ValueError, match="kv_attend"):
+        TransformerConfig(
+            vocab_size=8, d_model=8, n_layers=1, n_heads=2, d_ff=8,
+            max_seq_len=8, kv_attend="flash",
+        )
+
+
+def test_config_rejects_pallas_without_paged():
+    from tf_operator_tpu.models.transformer import TransformerConfig
+    with pytest.raises(ValueError, match="kv_paged"):
+        TransformerConfig(
+            vocab_size=8, d_model=8, n_layers=1, n_heads=2, d_ff=8,
+            max_seq_len=8, kv_attend="pallas",
+        )
+
+
+def test_engine_rejects_bad_kv_attend():
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    cfg = TransformerConfig(
+        vocab_size=16, d_model=16, n_layers=1, n_heads=2, d_ff=16,
+        max_seq_len=16, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="kv_attend"):
+        ContinuousEngine(cfg, params, max_slots=1, kv_paged=True,
+                         kv_attend="triton")
+    with pytest.raises(ValueError, match="kv_paged"):
+        ContinuousEngine(cfg, params, max_slots=1, kv_paged=False,
+                         kv_attend="pallas")

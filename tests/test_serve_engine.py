@@ -131,19 +131,21 @@ MATRIX_SCRIPT = [
 ]
 
 
-@pytest.mark.parametrize("kv_paged", [False, True],
-                         ids=["dense", "paged"])
+@pytest.mark.parametrize("kv_layout", ["dense", "paged", "paged-pallas"])
 @pytest.mark.parametrize("prefill_chunk", [None, 4])
 def test_engine_bit_identical_to_solo_generate(params, prefill_chunk,
-                                               kv_paged):
+                                               kv_layout):
     """THE tentpole pin: every request's engine output — greedy AND
     sampled (incl. nucleus) — equals its solo generate output
     bit-for-bit, across the full occupancy walk, under one-shot AND
-    chunked prefill, in BOTH KV layouts; and the decode step compiled
-    exactly once."""
+    chunked prefill, in BOTH KV layouts — the paged layout under BOTH
+    attends (the gather oracle and the pallas block-table kernel,
+    ops/paged_attention.py); and the decode step compiled exactly
+    once."""
     engine = ContinuousEngine(
         CFG, params, max_slots=4, prefill_chunk=prefill_chunk,
-        kv_paged=kv_paged, kv_block=8,
+        kv_paged=kv_layout != "dense", kv_block=8,
+        kv_attend="pallas" if kv_layout == "paged-pallas" else "gather",
     )
     got = drive(engine, MATRIX_REQS, MATRIX_SCRIPT)
     for name, (prompt, steps, t, tp, seed) in MATRIX_REQS.items():
@@ -157,16 +159,20 @@ def test_engine_bit_identical_to_solo_generate(params, prefill_chunk,
     assert engine.decode_step_compiles == engine.warmup_compiles == 1
 
 
-@pytest.mark.parametrize("kv_paged", [False, True],
-                         ids=["dense", "paged"])
+@pytest.mark.parametrize("kv_layout", ["dense", "paged", "paged-pallas"])
 def test_zero_recompiles_across_occupancy_and_sampling_mix(params,
-                                                           kv_paged):
+                                                           kv_layout):
     """After the first step, joins/retires/occupancy changes AND new
     sampling parameter values (temperature/top_p are data, not compile
     constants) never retrace the decode step — in either KV layout
-    (paged additionally exercises fresh block tables per join)."""
-    engine = ContinuousEngine(CFG, params, max_slots=3,
-                              kv_paged=kv_paged, kv_block=8)
+    (paged additionally exercises fresh block tables per join, under
+    both the gather and the pallas attend: the kernel's per-lane block
+    counts are scalar-prefetch DATA, so table growth cannot retrace)."""
+    engine = ContinuousEngine(
+        CFG, params, max_slots=3, kv_paged=kv_layout != "dense",
+        kv_block=8,
+        kv_attend="pallas" if kv_layout == "paged-pallas" else "gather",
+    )
     s0 = engine.join(jnp.asarray(prompt_of(4, 1)), num_steps=30)
     engine.step()
     assert engine.decode_step_compiles == engine.warmup_compiles == 1
@@ -331,18 +337,21 @@ SPEC_SCRIPT = [
 ]
 
 
-@pytest.mark.parametrize("kv_paged", [False, True],
-                         ids=["dense", "paged"])
+@pytest.mark.parametrize("kv_layout", ["dense", "paged", "paged-pallas"])
 def test_spec_engine_bit_identical_to_solo_speculative(params,
                                                        draft_params,
-                                                       kv_paged):
+                                                       kv_layout):
     """THE spec tentpole pin: every request's engine stream — greedy AND
     sampled (incl. nucleus) — equals its solo ``speculative_generate``
     stream bit-for-bit (greedy additionally equals plain ``generate``),
     across join/retire/slot-reuse at accept-dependent boundaries, in
-    both KV layouts, with exactly the warmup's two round executables."""
+    both KV layouts — paged under both attends, so the K+1-position
+    VERIFY chunk rides the pallas kernel's multi-query path — with
+    exactly the warmup's two round executables."""
+    kv_paged = kv_layout != "dense"
     engine = ContinuousEngine(
         CFG, params, max_slots=4, kv_paged=kv_paged, kv_block=8,
+        kv_attend="pallas" if kv_layout == "paged-pallas" else "gather",
         spec_k=SPEC_K, draft_cfg=DRAFT_CFG, draft_params=draft_params,
     )
     got = spec_drive(engine, SPEC_REQS, SPEC_SCRIPT)
@@ -371,7 +380,9 @@ def test_spec_engine_bit_identical_to_solo_speculative(params,
     assert 0.0 <= dbg["accept_rate"] <= 1.0
 
 
-def test_spec_engine_kv8_paged_across_boundaries(params, draft_params):
+@pytest.mark.parametrize("kv_attend", ["gather", "pallas"])
+def test_spec_engine_kv8_paged_across_boundaries(params, draft_params,
+                                                 kv_attend):
     """spec x kv8 carried across join/retire/slot-reuse: the paged-kv8
     pool (int8 blocks + per-block scale sidecars) under speculative
     rounds stays bit-identical to solo speculative_generate on the SAME
@@ -379,14 +390,17 @@ def test_spec_engine_kv8_paged_across_boundaries(params, draft_params):
     the scale sidecars along with the int8 rows. Runs CHUNKED
     (prefill_chunk=4): target prefill buckets through the fixed-chunk
     executables and the DRAFT prefill rides them too (the
-    per-prompt-shape compile the chunked machinery exists to avoid)."""
+    per-prompt-shape compile the chunked machinery exists to avoid).
+    Under ``kv_attend="pallas"`` this is the deepest composition the
+    kernel serves: fused int8 dequant x K+1 VERIFY chunk x CoW'd
+    tables."""
     from dataclasses import replace
 
     cfg8 = replace(CFG, kv_int8=True)
     dcfg8 = replace(DRAFT_CFG, kv_int8=True)
     engine = ContinuousEngine(
         cfg8, params, max_slots=4, kv_paged=True, kv_block=8,
-        prefill_chunk=4,
+        prefill_chunk=4, kv_attend=kv_attend,
         spec_k=SPEC_K, draft_cfg=dcfg8, draft_params=draft_params,
     )
     got = spec_drive(engine, SPEC_REQS, SPEC_SCRIPT)

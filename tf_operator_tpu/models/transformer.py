@@ -120,6 +120,18 @@ class TransformerConfig:
     kv_paged: bool = False
     kv_block: int = 64
     kv_num_blocks: int = 0
+    # Paged decode attend implementation. "gather" (default) gathers
+    # pool blocks back to the dense [b, max_seq_len, KV, Dh] layout and
+    # reuses the dense einsum — the REFERENCE ORACLE every other path
+    # pins against. "pallas" consumes the block table directly in a
+    # Pallas kernel (ops/paged_attention.py): per-lane block-list
+    # iteration bounded by the lane's counter, so per-step HBM traffic
+    # scales with actual lane lengths instead of max_seq_len; pinned
+    # bit-identical to the oracle in f32 CPU interpret mode. Requires
+    # kv_paged and a geometry inside the kernel's VMEM budget
+    # (paged_attend_supported — an unsupported geometry raises at trace
+    # time rather than silently falling back).
+    kv_attend: str = "gather"
 
     # Grouped-query attention: K/V get this many heads (must divide
     # n_heads); each group of n_heads/n_kv_heads query heads shares one
@@ -158,6 +170,16 @@ class TransformerConfig:
                     f"kv_num_blocks={self.kv_num_blocks} must be >= 2 "
                     "(block 0 is the pinned garbage block)"
                 )
+        if self.kv_attend not in ("gather", "pallas"):
+            raise ValueError(
+                f"kv_attend={self.kv_attend!r}: expected 'gather' or "
+                "'pallas'"
+            )
+        if self.kv_attend == "pallas" and not self.kv_paged:
+            raise ValueError(
+                "kv_attend='pallas' requires kv_paged=True (the kernel "
+                "consumes the block table; dense rows have no table)"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -611,6 +633,24 @@ class Attention(nn.Module):
                 vs, mode="drop"
             ).reshape(nb, blk, kv)
         index.value = idx + t
+        if cfg.kv_attend == "pallas":
+            # The Pallas kernel walks each lane's block list directly
+            # (ops/paged_attention.py): no [b, max_seq_len] gather ever
+            # materializes, per-step HBM traffic is bounded by actual
+            # lane lengths, and the kernel is pinned bit-identical to
+            # the gather path below (tests/test_paged_attention.py).
+            # The scatter-write above is SHARED — only the read side
+            # dispatches, so the cache leaf set (and its tp sharding,
+            # serve/sharding.py) is identical across both attends.
+            from tf_operator_tpu.ops.paged_attention import paged_attend
+
+            out = paged_attend(
+                q, pool_k.value, pool_v.value, table.value, idx,
+                k_scale_pool=pool_ks.value if kv8 else None,
+                v_scale_pool=pool_vs.value if kv8 else None,
+                mesh=cfg.mesh, tp_axis=cfg.tp_axis,
+            )
+            return out.astype(cfg.dtype)
         keys = pool_k.value[table.value].reshape(
             b, cfg.max_seq_len, kv, dh
         )

@@ -23,6 +23,11 @@ from tf_operator_tpu.ops.flash_attention import (
     pick_block,
     select_block,
 )
+from tf_operator_tpu.ops.paged_attention import (
+    paged_attend,
+    paged_attend_supported,
+    paged_attend_vmem_bytes,
+)
 
 
 def attention_kernel(tq: int, tk: int, head_dim: int, itemsize: int,
@@ -87,6 +92,9 @@ __all__ = [
     "flash_attention",
     "flash_supported",
     "on_tpu_backend",
+    "paged_attend",
+    "paged_attend_supported",
+    "paged_attend_vmem_bytes",
     "pick_block",
     "select_block",
 ]

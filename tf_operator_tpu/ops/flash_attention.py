@@ -157,6 +157,15 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # under either (082804 carries only the single-shot flashblocks line;
 # 091000_hostlocal only input.jsonl). Trigger stays OPEN; cap stays
 # 1024; qblock keeps its front slot for the next hardware window.
+# Re-checked (PR 18, 2026-08-07): unchanged — still no window newer
+# than r05, so the 512->1024 arbitration data does not exist yet and
+# the cap stays 1024 on the single-shot line; the revert trigger above
+# stays armed. The carry-over is now FOLDED into shared machinery: a
+# probe_kvblock stage (pallas paged-attend vs gather across kv_block
+# sizes, ISSUE 18) rides directly behind qblock in window_autorun's
+# attribution group, so the next UP window arbitrates both block-
+# geometry questions — this retune and the paged kernel's chunk size —
+# from one stage sequence.
 MAX_Q_BLOCK = 1024
 
 

@@ -1,0 +1,395 @@
+"""Pallas TPU paged-attention decode kernel: the block table, consumed
+directly.
+
+Every serving mode since the continuous engine landed bottoms out in
+``_decode_attend_paged`` (models/transformer.py), whose GATHER path
+materializes the pool back into the dense ``[b, max_seq_len, KV, Dh]``
+layout each step — correct (it is what makes the bit-identity pins
+cheap) but its HBM traffic scales with max-S, not actual lane lengths.
+This kernel walks each lane's block list instead:
+
+- the grid is ``(batch, table_len)`` with the table walk sequential; the
+  block table, the per-lane counters, and the per-lane block counts
+  ``nblk = ceil((pos + t) / blk)`` ride scalar prefetch, so the K/V pool
+  BlockSpec index maps resolve ``table[b, j]`` on the host side of the
+  pipeline — the kernel streams exactly the pool blocks a lane owns;
+- beyond a lane's ``nblk`` the index map CLAMPS to the lane's last
+  block: an unchanged block index means pallas skips the HBM->VMEM copy
+  (the same trick the flash kernel's causal skip uses), so per-step HBM
+  traffic is bounded by actual lane lengths — the whole point;
+- kv-int8 dequant is fused with the exact dense factoring the engine
+  pins: raw int8 keys enter the score dot (cast bf16, exact — |k8| <=
+  127 needs 7 mantissa bits) and are rescaled on the score tensor; the
+  value scale folds into the post-softmax probabilities;
+- multi-query: ``t >= 1`` query rows per lane share one table walk, so
+  the speculative VERIFY chunk (K+1 positions) rides the same kernel.
+
+EXACTNESS over elegance — why this is copy-then-finalize, not online
+softmax: the engine's reason to exist is the bit-identity pin chain
+(paged == dense == solo, kv8 included), and a rescaling online softmax
+(flash-style ``acc * alpha`` carries) cannot reproduce the gather
+path's full-row softmax bit-for-bit — every chunk boundary perturbs
+rounding. So the sequential grid steps only COPY each fetched block
+into a VMEM-resident ``[S, KV, Dh]`` buffer (zero-filling the columns
+of skipped blocks), and the last step runs per-KV-head score/mask/
+softmax/value contractions with the same operand dtypes, reduction
+extents, and op order as the gather oracle. Masked columns are exactly
+``-1e30 -> softmax 0.0`` on both paths, so the zero-filled (kernel) vs
+garbage-block (gather) column contents cancel bitwise. The HBM savings
+— the decode bottleneck — are untouched by this choice: only VMEM-
+resident VPU/MXU work runs at full S extent. The trade is a VMEM
+ceiling of O(max_seq_len * KV/tp * Dh) per core (``paged_attend_
+supported`` gates it; tensor parallelism divides it by tp).
+
+The gather path stays the default and the reference oracle
+(``TransformerConfig.kv_attend="gather"``); this kernel is selected
+with ``kv_attend="pallas"`` and is pinned bit-identical to the oracle
+in f32 CPU interpret mode by tests/test_paged_attention.py across
+block geometry x {dense, kv8} x {single-token, K+1 VERIFY} x lane
+spread. Interpret-mode selection follows flash_attention's discipline:
+``on_tpu_backend()`` is the single TPU detection.
+
+Tensor parallelism: a pallas call has no SPMD partitioning rule, so at
+tp > 1 the kernel runs under shard_map over the tp axis — pool
+``P(None, None, 'tp', None)``, query/output head-sharded, table and
+counters replicated, ZERO collectives inside the attend (per-KV-head
+math is shard-local, exactly the gather path's layout story).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tf_operator_tpu import parallel as parallel_compat
+from tf_operator_tpu.ops.flash_attention import (
+    _CompilerParams,
+    on_tpu_backend,
+)
+
+_NEG_INF = -1e30
+
+# VMEM ceiling for the copy-then-finalize buffers (K + V + kv8 scale
+# sidecars at full S extent, per core). ~16 MiB is a core's VMEM; leave
+# headroom for the q/out/pool-block tiles and Mosaic padding.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def paged_attend_vmem_bytes(
+    max_seq_len: int,
+    kv_heads: int,
+    head_dim: int,
+    *,
+    kv_int8: bool = False,
+    dtype_bytes: int = 2,
+    tp: int = 1,
+) -> int:
+    """Unpadded bytes of the kernel's persistent VMEM scratch: the
+    ``[S, KV/tp, Dh]`` key buffer (storage dtype; bf16 under kv8), the
+    f32 value buffer, and the two f32 ``[S, KV/tp]`` scale sidecars
+    under kv8. Pure arithmetic — usable without touching a device."""
+    kv_local = kv_heads // tp if tp > 1 and kv_heads % tp == 0 else kv_heads
+    k_bytes = 2 if kv_int8 else dtype_bytes
+    total = max_seq_len * kv_local * head_dim * (k_bytes + 4)
+    if kv_int8:
+        total += 2 * max_seq_len * kv_local * 4
+    return total
+
+
+def paged_attend_supported(
+    max_seq_len: int,
+    kv_heads: int,
+    head_dim: int,
+    *,
+    kv_int8: bool = False,
+    dtype_bytes: int = 2,
+    tp: int = 1,
+    budget: int = VMEM_BUDGET_BYTES,
+) -> bool:
+    """True when paged_attend() will accept this geometry: the
+    copy-then-finalize buffers must fit the VMEM budget. The single
+    source of truth the config selector consults — a config requesting
+    ``kv_attend="pallas"`` for an unsupported geometry fails loudly at
+    trace time (never a silent gather fallback: a bench would measure
+    the wrong kernel)."""
+    return paged_attend_vmem_bytes(
+        max_seq_len, kv_heads, head_dim,
+        kv_int8=kv_int8, dtype_bytes=dtype_bytes, tp=tp,
+    ) <= budget
+
+
+def _paged_kernel(
+    # scalar prefetch
+    table_ref, idx_ref, nblk_ref,
+    # inputs
+    q_ref, kp_ref, vp_ref, *rest,
+    blk: int, t: int, g: int, nj: int, kv8: bool, structural: bool,
+):
+    """Grid cell (b, j). Phase A (every j): land pool block j of lane b
+    in the persistent buffers — the lane's own data below ``nblk[b]``,
+    zeros above it (every column is written each lane, so no stale VMEM
+    can leak across lanes and the compiled path can never read
+    uninitialized scratch as NaN). Phase B (last j): the full-row
+    attention.
+
+    Two finalize bodies, same math: ``structural`` (interpret mode)
+    mirrors the gather oracle's einsum subscripts exactly — same
+    dot_general batch/contract structure minus the leading batch dim —
+    which is what makes the f32 CPU bitwise pin hold (XLA picks its
+    reduction strategy from the dot SHAPE; a merged-rows 2-D dot with a
+    single row lowers as a gemv whose accumulation order differs from
+    the batched einsum's by 1 ulp). The compiled TPU path uses a static
+    per-KV-head loop of plain 2-D dots instead — Mosaic-friendly MXU
+    work (it cannot lower rank-4 batched dot_generals) — bitwise parity
+    across BACKENDS was never on the table (MXU vs host float paths),
+    the oracle pin is an interpret-mode contract."""
+    if kv8:
+        ksp_ref, vsp_ref, o_ref, k_buf, v_buf, ks_buf, vs_buf = rest
+    else:
+        o_ref, k_buf, v_buf = rest
+        ks_buf = vs_buf = None
+    b, j = pl.program_id(0), pl.program_id(1)
+    rows = pl.ds(j * blk, blk)
+    live = j < nblk_ref[b]
+
+    @pl.when(live)
+    def _copy():
+        k_buf[rows, :, :] = kp_ref[0].astype(k_buf.dtype)
+        v_buf[rows, :, :] = vp_ref[0].astype(jnp.float32)
+        if kv8:
+            ks_buf[rows, :] = ksp_ref[0]
+            vs_buf[rows, :] = vsp_ref[0]
+
+    @pl.when(jnp.logical_not(live))
+    def _zero():
+        k_buf[rows, :, :] = jnp.zeros_like(k_buf[rows, :, :])
+        v_buf[rows, :, :] = jnp.zeros_like(v_buf[rows, :, :])
+        if kv8:
+            ks_buf[rows, :] = jnp.zeros_like(ks_buf[rows, :])
+            vs_buf[rows, :] = jnp.zeros_like(vs_buf[rows, :])
+
+    @pl.when(j == nj - 1)
+    def _attend():
+        s_len = nj * blk
+        kv_local = k_buf.shape[1]
+        dh = k_buf.shape[2]
+        if structural:
+            # Interpret mode: the oracle's einsums verbatim (its batch
+            # dim b is this grid cell; kv stays a dot batch dim).
+            qg = q_ref[0].reshape(kv_local, t, g, dh)  # rows (t, g)
+            s = jnp.einsum(
+                "kqgd,skd->kgqs", qg, k_buf[:, :, :],
+                preferred_element_type=jnp.float32,
+            )
+            if kv8:
+                s = s * ks_buf[:, :].T[:, None, None, :]
+            s = s * (dh ** -0.5)
+            # Query row i (absolute position idx[b] + i) sees keys at
+            # positions <= idx[b] + i; columns past the lane's length —
+            # including every zero-filled skipped block — mask to the
+            # oracle's exact -1e30 and softmax to exact 0.0.
+            row_t = lax.broadcasted_iota(
+                jnp.int32, (kv_local, g, t, s_len), 2
+            )
+            col = lax.broadcasted_iota(
+                jnp.int32, (kv_local, g, t, s_len), 3
+            )
+            s = jnp.where(col <= idx_ref[b] + row_t, s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            if kv8:
+                # Value scale folds into the probabilities (exact 0s at
+                # masked columns stay exact 0s).
+                p = p * vs_buf[:, :].T[:, None, None, :]
+            out = jnp.einsum("kgqs,skd->qkgd", p, v_buf[:, :, :])
+            o_ref[0] = out.transpose(1, 0, 2, 3).reshape(
+                kv_local, t * g, dh
+            )
+            return
+        # Compiled path: static python loop over KV heads — each
+        # iteration is plain 2-D MXU work (Mosaic-friendly), and
+        # per-head independence is what keeps the tp shard_map
+        # collective-free.
+        for kk in range(kv_local):
+            qh = q_ref[0, kk, :, :]  # [t*g, Dh], rows (t, g)-ordered
+            s = jnp.dot(
+                qh, k_buf[:, kk, :].T,
+                preferred_element_type=jnp.float32,
+            )
+            s = s.reshape(t, g, s_len)
+            if kv8:
+                # The dense kv8 factoring: scores = (q . k8) * k_scale,
+                # the scale applied on the score tensor BEFORE 1/sqrt(d)
+                # — same order as the oracle, so the rounding matches.
+                s = s * ks_buf[:, kk][None, None, :]
+            s = s * (dh ** -0.5)
+            row_t = lax.broadcasted_iota(jnp.int32, (t, g, s_len), 0)
+            col = lax.broadcasted_iota(jnp.int32, (t, g, s_len), 2)
+            s = jnp.where(col <= idx_ref[b] + row_t, s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            if kv8:
+                p = p * vs_buf[:, kk][None, None, :]
+            o_ref[0, kk, :, :] = jnp.dot(
+                p.reshape(t * g, s_len), v_buf[:, kk, :],
+                preferred_element_type=jnp.float32,
+            )
+
+
+def _pool_index(block_shape):
+    """Index map for pool-side inputs: fetch lane b's j-th block; past
+    the lane's block count, CLAMP to its last block — an unchanged
+    block index lets pallas skip the HBM->VMEM copy, which is what
+    bounds per-step HBM traffic by actual lane lengths."""
+    zeros = (0,) * (len(block_shape) - 1)
+    return pl.BlockSpec(
+        block_shape,
+        lambda b, j, tbl, idx, nblk: (
+            tbl[b, jnp.minimum(j, nblk[b] - 1)],
+        ) + zeros,
+    )
+
+
+def _lane_index(block_shape):
+    """Index map for lane-side q/out: one block per lane, constant
+    across the table walk (fetched/flushed once per lane)."""
+    zeros = (0,) * (len(block_shape) - 1)
+    return pl.BlockSpec(
+        block_shape, lambda b, j, tbl, idx, nblk: (b,) + zeros
+    )
+
+
+def _run_paged(table, idx, nblk, qr, pool_k, pool_v, *scale_pools,
+               blk: int, t: int, g: int, interpret: bool):
+    b, kv, rows, dh = qr.shape
+    nj = table.shape[1]
+    kv8 = bool(scale_pools)
+    kernel = functools.partial(
+        _paged_kernel, blk=blk, t=t, g=g, nj=nj, kv8=kv8,
+        structural=interpret,
+    )
+    in_specs = [
+        _lane_index((1, kv, rows, dh)),      # q
+        _pool_index((1, blk, kv, dh)),       # key pool
+        _pool_index((1, blk, kv, dh)),       # value pool
+    ]
+    scratch = [
+        pltpu.VMEM((nj * blk, kv, dh), pool_k.dtype
+                   if not kv8 else jnp.bfloat16),
+        pltpu.VMEM((nj * blk, kv, dh), jnp.float32),
+    ]
+    if kv8:
+        in_specs += [
+            _pool_index((1, blk, kv)),       # key scale pool
+            _pool_index((1, blk, kv)),       # value scale pool
+        ]
+        scratch += [
+            pltpu.VMEM((nj * blk, kv), jnp.float32),
+            pltpu.VMEM((nj * blk, kv), jnp.float32),
+        ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, nj),
+        in_specs=in_specs,
+        out_specs=_lane_index((1, kv, rows, dh)),
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, rows, dh), jnp.float32),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(table, idx, nblk, qr, pool_k, pool_v, *scale_pools)
+
+
+def paged_attend(
+    q: jax.Array,
+    pool_k: jax.Array,
+    pool_v: jax.Array,
+    block_table: jax.Array,
+    index: jax.Array,
+    *,
+    k_scale_pool: jax.Array | None = None,
+    v_scale_pool: jax.Array | None = None,
+    mesh=None,
+    tp_axis: str = "tp",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged decode attention straight off the block table.
+
+    q: [b, t, H, Dh] (t >= 1 — the speculative VERIFY chunk is just
+    t = K+1); pool_k/pool_v: [nb, blk, KV, Dh] (int8 when the scale
+    pools are given); block_table: [b, table_len] int32; index: [b]
+    int32 PRE-update counters (query row i of lane b sits at absolute
+    position index[b] + i). Returns [b, t, H, Dh] float32 — the caller
+    applies the storage-dtype cast, exactly like the gather oracle.
+
+    Raises ValueError when the geometry exceeds the VMEM budget — the
+    selector must not silently fall back (see paged_attend_supported).
+    """
+    if interpret is None:
+        interpret = not on_tpu_backend()
+    b, t, h, dh = q.shape
+    nb, blk, kv, _ = pool_k.shape
+    if t < 1:
+        raise ValueError(f"t={t}: need at least one query row per lane")
+    if h % kv:
+        raise ValueError(f"n_heads={h} must be a multiple of KV={kv}")
+    g = h // kv
+    table_len = block_table.shape[1]
+    kv8 = k_scale_pool is not None
+    if kv8 != (v_scale_pool is not None):
+        raise ValueError("kv8 needs BOTH scale pools (or neither)")
+    tp = (mesh.shape.get(tp_axis, 1) if mesh is not None else 1)
+    if tp > 1 and kv % tp:
+        # The gather oracle degrades to a replicated einsum here; a
+        # pallas call has no SPMD partitioning rule to degrade WITH, so
+        # fail loudly instead of compiling something untileable.
+        raise ValueError(
+            f"paged_attend: KV={kv} does not tile tp={tp} — use "
+            "kv_attend='gather' for this mesh"
+        )
+    shard = tp > 1 and kv % tp == 0
+    if not paged_attend_supported(
+        table_len * blk, kv, dh,
+        kv_int8=kv8, dtype_bytes=pool_k.dtype.itemsize,
+        tp=tp if shard else 1,
+    ):
+        raise ValueError(
+            f"paged_attend: S={table_len * blk} x KV={kv}"
+            f"{f'/tp={tp}' if shard else ''} x Dh={dh} "
+            f"(kv8={kv8}) exceeds the VMEM budget "
+            f"({VMEM_BUDGET_BYTES} bytes) — use kv_attend='gather'"
+        )
+    idx = index.astype(jnp.int32)
+    nblk = (idx + t + blk - 1) // blk  # ceil: per-lane block count >= 1
+    # [b, t, H, Dh] -> [b, KV, t*g, Dh]: head h = (kk, gg) splits as in
+    # the oracle's q.reshape(b, t, kv, g, dh); rows are (t, g)-ordered.
+    qr = q.reshape(b, t, kv, g, dh).transpose(0, 2, 1, 3, 4)
+    qr = qr.reshape(b, kv, t * g, dh)
+    run = functools.partial(_run_paged, blk=blk, t=t, g=g,
+                            interpret=bool(interpret))
+    scale_pools = (k_scale_pool, v_scale_pool) if kv8 else ()
+    if shard:
+        P = jax.sharding.PartitionSpec
+        pool_spec = P(None, None, tp_axis, None)
+        lane_spec = P(None, tp_axis, None, None)
+        in_specs = [P(), P(), P(), lane_spec, pool_spec, pool_spec]
+        if kv8:
+            in_specs += [P(None, None, tp_axis)] * 2
+        out = parallel_compat.shard_map(
+            run, mesh=mesh,
+            in_specs=tuple(in_specs), out_specs=lane_spec,
+            check_vma=False,
+        )(block_table, idx, nblk, qr, pool_k, pool_v, *scale_pools)
+    else:
+        out = run(block_table, idx, nblk, qr, pool_k, pool_v,
+                  *scale_pools)
+    out = out.reshape(b, kv, t, g, dh).transpose(0, 2, 1, 3, 4)
+    return out.reshape(b, t, h, dh)

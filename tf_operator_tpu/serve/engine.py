@@ -260,13 +260,30 @@ class ContinuousEngine:
     def __init__(self, cfg: TransformerConfig, params: Any,
                  max_slots: int, *, prefill_chunk: int | None = None,
                  kv_paged: bool = True, kv_block: int = 64,
-                 kv_blocks: int | None = None,
+                 kv_blocks: int | None = None, kv_attend: str = "gather",
                  faults: Any = None, mesh: Any = None,
                  tp_axis: str = "tp", spec_k: int = 0,
                  draft_cfg: TransformerConfig | None = None,
                  draft_params: Any = None) -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        # kv_attend selects the paged attend implementation: "gather"
+        # (default, the reference oracle) or "pallas" (the block-table
+        # kernel, ops/paged_attention.py). Decode-path only — prefill
+        # runs the solo dense model either way, and the DRAFT model of
+        # a speculative engine keeps its dense stacked cache. Validated
+        # eagerly so a typo fails at the engine call site, not inside a
+        # jit trace.
+        self.kv_attend = str(kv_attend)
+        if self.kv_attend not in ("gather", "pallas"):
+            raise ValueError(
+                f"kv_attend={kv_attend!r}: expected 'gather' or 'pallas'"
+            )
+        if self.kv_attend == "pallas" and not kv_paged:
+            raise ValueError(
+                "kv_attend='pallas' requires kv_paged=True (the kernel "
+                "consumes the block table)"
+            )
         # Batch-wide speculative decode (spec_k >= 1): every decode
         # iteration runs a per-slot DRAFT of k tokens plus ONE batched
         # k+1-position verify against the target, and slots advance
@@ -359,7 +376,7 @@ class ContinuousEngine:
         # attribute to the request that owns the slot.
         self._slot_tags: dict[int, str] = {}
         dcfg = replace(cfg, decode=True, mesh=None, remat=False,
-                       kv_paged=False)
+                       kv_paged=False, kv_attend="gather")
         # Solo DENSE model: prefill (one-shot, chunked, and suffix) and
         # the dense cache layout every insert consumes.
         self._solo_model = Transformer(dcfg)
@@ -385,7 +402,8 @@ class ContinuousEngine:
             # (models/transformer.py _decode_attend_paged).
             pcfg = replace(dcfg, kv_paged=True, kv_block=self.kv_block,
                            kv_num_blocks=self.kv_blocks, mesh=self.mesh,
-                           tp_axis=self.tp_axis)
+                           tp_axis=self.tp_axis,
+                           kv_attend=self.kv_attend)
             self._model = Transformer(pcfg)
             self.blocks = BlockAllocator(self.kv_blocks)
             self.prefix = PrefixCache(self.kv_block)

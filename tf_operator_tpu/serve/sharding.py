@@ -73,6 +73,11 @@ _HEAD_AXIS_FROM_END = {
     "pool_value_scale": 1,  # — ride the K/V head shard like the dense
                             # sidecars, same suffix addressing)
 }
+# The pallas paged-attention kernel (kv_attend="pallas", ISSUE 18) adds
+# NO entries here: its copy-then-finalize buffers are pallas-internal
+# VMEM scratch, never cache leaves, so supervisor rebuilds reconstruct
+# a pallas engine through exactly these rules (regression-pinned by
+# tools/serve_tp_check.py's leaf-set check).
 
 # Leaf name -> minimum rank at which dimension 0 is the SLOT axis, for
 # the ``dp`` (batch-parallel-decode) mesh axis: the slot-stacked dense

@@ -44,7 +44,12 @@ slot high-water over the timed pass — what the byte budget actually
 admitted), ``prefill_tokens_saved`` and ``cow_copies`` (prefix reuse at
 work); the paged line's ``vs_baseline`` is its tokens/sec over the dense
 leg and ``admitted_ratio`` the concurrency multiple — the ROADMAP item-2
-"what fits at actual lengths" number.
+"what fits at actual lengths" number. A third ``pallas_longctx`` leg
+(ISSUE 18) replays the identical schedule, pool, and slot budget with
+``kv_attend="pallas"`` — its ``vs_baseline`` is the kernel-vs-gather
+ratio, with ``host_cpus`` stamped because a CPU round runs the kernel
+in the pallas interpreter (mechanism proof only; hardware ratios come
+from the next window).
 
 The CHAOS mix (``--engine chaos``) replays the same seeded schedule
 through a SUPERVISED continuous engine (serve/resilience.py) while the
@@ -576,7 +581,8 @@ def build_prefix_schedule(cap: dict, seed: int, vocab: int):
 
 
 def run_capacity_leg(name, cfg, params, schedule, args, *, kv_paged,
-                     max_slots, kv_blocks, kv_block) -> dict:
+                     max_slots, kv_blocks, kv_block,
+                     kv_attend="gather") -> dict:
     """One capacity-mix leg: a continuous engine (paged or dense) under
     the shared-prefix long-context schedule, admitted concurrency and
     prefix-reuse counters measured over the timed pass only."""
@@ -590,6 +596,7 @@ def run_capacity_leg(name, cfg, params, schedule, args, *, kv_paged,
         cfg, params, max_slots=max_slots,
         prefill_chunk=args.prefill_chunk or None,
         kv_paged=kv_paged, kv_block=kv_block, kv_blocks=kv_blocks,
+        kv_attend=kv_attend,
     )
     sched = ContinuousScheduler(
         engine, prefill_tokens_per_step=args.prefill_budget
@@ -607,6 +614,7 @@ def run_capacity_leg(name, cfg, params, schedule, args, *, kv_paged,
     wall_s, results = run_schedule(schedule, submit)
     stats = {
         "kv": "paged" if kv_paged else "dense",
+        "kv_attend": kv_attend if kv_paged else None,
         "admitted_concurrency": engine.alloc.high_water,
         "prefill_tokens_saved":
             getattr(engine, "prefill_tokens_saved", 0) - saved0,
@@ -654,6 +662,21 @@ def run_capacity_mix(args, smoke: bool) -> list[dict]:
         max_slots=cap["dense_slots"] * cap["slot_mult"],
         kv_blocks=pool, kv_block=cap["block"],
     )
+    # The ISSUE 18 kernel A/B: the SAME seeded schedule, pool, and slot
+    # budget with the pallas paged-attend instead of the gather read —
+    # the capacity story is identical (admission is an allocator
+    # property), the per-step attend cost is the variable. host_cpus
+    # rides the line: on a CPU round the kernel runs in the pallas
+    # INTERPRETER, so the ratio is mechanism proof only — real numbers
+    # come from the next hardware window (probe_kvblock + this leg).
+    import os as _os
+
+    pallas = run_capacity_leg(
+        "pallas_longctx", cfg, params, schedule, args, kv_paged=True,
+        max_slots=cap["dense_slots"] * cap["slot_mult"],
+        kv_blocks=pool, kv_block=cap["block"], kv_attend="pallas",
+    )
+    pallas["host_cpus"] = _os.cpu_count()
     dense = run_capacity_leg(
         "dense_longctx", cfg, params, schedule, args, kv_paged=False,
         max_slots=cap["dense_slots"], kv_blocks=None,
@@ -661,12 +684,16 @@ def run_capacity_mix(args, smoke: bool) -> list[dict]:
     )
     if dense["value"]:
         paged["vs_baseline"] = round(paged["value"] / dense["value"], 3)
+    if paged["value"]:
+        # pallas vs gather on the identical schedule: the kernel ratio.
+        pallas["vs_baseline"] = round(
+            pallas["value"] / paged["value"], 3)
     if dense["admitted_concurrency"]:
         paged["admitted_ratio"] = round(
             paged["admitted_concurrency"]
             / dense["admitted_concurrency"], 3
         )
-    return [paged, dense]
+    return [paged, pallas, dense]
 
 
 def run_chaos_leg(cfg, params, schedule, args) -> dict:

@@ -261,7 +261,7 @@ def main(argv: list[str] | None = None) -> int:
             sys.executable, "-m", "pytest",
             "tests/test_serve_engine.py", "tests/test_serve_sched.py",
             "tests/test_kvcache_paged.py", "tests/test_serve_chaos.py",
-            "tests/test_serve_tier.py",
+            "tests/test_serve_tier.py", "tests/test_paged_attention.py",
             "-m", "serve and not slow",
             "-q", "-p", "no:cacheprovider",
             *args,

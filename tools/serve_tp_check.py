@@ -19,7 +19,11 @@ BEFORE jax imports so the check needs no hardware):
   tp>1, same pin as tp=1);
 - a supervised engine (EngineSupervisor) crashed mid-decode by the
   seeded fault injector rebuilds, RECONSTRUCTS the mesh through the
-  factory, and replays the in-flight request bit-identically.
+  factory, and replays the in-flight request bit-identically;
+- the pallas paged-attention kernel (``kv_attend="pallas"``, ISSUE 18)
+  holds all of the above under shard_map — including the cache
+  leaf-set regression proving the kernel adds no scratch leaves for
+  serve/sharding.py's rebuild rules to miss.
 
 Driven by tests/test_serve_tp.py (slow-marked: multi-device needs its
 own process) and tools/serve_smoke.py; run standalone:
@@ -283,6 +287,187 @@ def run_spec(tp: int) -> int:
     return failures
 
 
+def run_pallas(tp: int) -> int:
+    """Paged-attention kernel at tp>1 (ISSUE 18): the pallas attend
+    runs under shard_map over the tp axis (a pallas call has no SPMD
+    partitioning rule) with the pool head-sharded and ZERO collectives
+    inside the attend. Proves, for {f32, kv8} x pallas:
+
+    - engine output bit-identical to solo ``generate`` with the SAME
+      tp-sharded params, across a join/retire occupancy walk with a
+      sampled slot;
+    - the cache leaf SET (paths, shapes, dtypes) is identical to the
+      gather engine's — the kernel's scratch is pallas-internal, so
+      serve/sharding.py's supervisor-rebuild reconstruction needs no
+      new rules (the regression this guards);
+    - the KV pool is really head-sharded (KV/tp per device) and
+      ``decode_step_compiles == warmup_compiles`` at the end;
+    - a supervised pallas engine crashed mid-decode rebuilds through
+      the factory and replays bit-identically without a second
+      compile."""
+    from dataclasses import replace
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(mesh, params, param_sharding_rules())
+
+    def leafset(tree):
+        return {
+            (jax.tree_util.keystr(path), leaf.shape, str(leaf.dtype))
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                tree
+            )[0]
+        }
+
+    rng = np.random.default_rng(21)
+    p1 = rng.integers(0, 64, (1, 9)).astype(np.int32)
+    p2 = rng.integers(0, 64, (1, 5)).astype(np.int32)
+    failures = 0
+    for label, tcfg in (("pallas/f32", cfg),
+                        ("pallas/kv8", replace(cfg, kv_int8=True))):
+        eng = ContinuousEngine(
+            tcfg, params, max_slots=3, kv_paged=True, kv_block=8,
+            mesh=mesh, kv_attend="pallas",
+        )
+        gather = ContinuousEngine(
+            tcfg, params, max_slots=3, kv_paged=True, kv_block=8,
+            mesh=mesh,
+        )
+        if leafset(eng._cache) != leafset(gather._cache):
+            print(f"serve_tp_check: {label} cache leaf set differs "
+                  f"from the gather engine's — sharding.py's rebuild "
+                  f"rules no longer cover it", file=sys.stderr)
+            failures += 1
+        del gather
+        kv_pool = [
+            leaf for path, leaf
+            in jax.tree_util.tree_flatten_with_path(eng._cache)[0]
+            if "pool_key" in jax.tree_util.keystr(path)
+        ][0]
+        local_kv = kv_pool.addressable_shards[0].data.shape[-2]
+        if local_kv != cfg.kv_heads // tp:
+            print(f"serve_tp_check: {label} per-device pool shard "
+                  f"holds {local_kv} KV heads, expected "
+                  f"{cfg.kv_heads // tp}", file=sys.stderr)
+            failures += 1
+
+        def solo(prompt, steps, *, temperature=0.0, seed=0):
+            kw = {}
+            if temperature > 0:
+                kw = dict(temperature=temperature,
+                          rng=jax.random.PRNGKey(seed))
+            return np.asarray(
+                generate(tcfg, sharded, jnp.asarray(prompt), steps,
+                         **kw)
+            )[0]
+
+        plan = {"a": (p1, 10, 0.0, 0), "b": (p2, 6, 0.0, 0),
+                "c": (p1, 8, 0.9, 3)}
+        joins = {2: "b", 5: "c"}
+        live, outs = {}, {}
+        live[eng.join(jnp.asarray(p1), num_steps=10)] = ("a", 10, [])
+        i = 0
+        while live:
+            toks = eng.step()
+            i += 1
+            for s in list(live):
+                name, n, acc = live[s]
+                acc.append(int(toks[s]))
+                if len(acc) == n:
+                    eng.retire(s)
+                    outs[name] = acc
+                    del live[s]
+            if i in joins:
+                name = joins[i]
+                p, n, t, seed = plan[name]
+                s = eng.join(jnp.asarray(p), num_steps=n,
+                             temperature=t, seed=seed)
+                assert s is not None, f"{label}: no slot for {name}"
+                live[s] = (name, n, [])
+        for name, (p, n, t, seed) in plan.items():
+            want = solo(p, n, temperature=t, seed=seed)
+            if not np.array_equal(np.asarray(outs[name]), want):
+                print(f"serve_tp_check: {label} request {name} "
+                      f"DIVERGED from solo generate", file=sys.stderr)
+                failures += 1
+        if eng.decode_step_compiles != eng.warmup_compiles:
+            print(f"serve_tp_check: {label} recompiled "
+                  f"({eng.decode_step_compiles} != warmup "
+                  f"{eng.warmup_compiles})", file=sys.stderr)
+            failures += 1
+        print(f"serve_tp_check: {label} ok (kv/device {local_kv}, "
+              f"leaf set == gather, compiles "
+              f"{eng.decode_step_compiles}=warmup)", flush=True)
+
+    # Supervisor rebuild with the kernel in the loop: the rebuilt
+    # engine's cache reconstructs through the SAME sharding.py rules
+    # (no kernel-side leaves to miss) and replays without recompiling.
+    inj = FaultInjector(seed=3)
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=2, kv_block=8,
+                                 kv_paged=True, mesh=mesh,
+                                 kv_attend="pallas", faults=inj),
+        resilience=ResilienceConfig(watchdog_stall_s=10.0,
+                                    restart_backoff_s=0.05,
+                                    max_restarts=3),
+        faults=inj,
+    )
+    try:
+        prompt = np.random.default_rng(17).integers(
+            0, cfg.vocab_size, (1, 11)
+        ).astype(np.int32)
+        want = np.asarray(
+            generate(cfg, sharded, jnp.asarray(prompt), 20)
+        )
+        inj.arm(f"step_raise@{inj.invocations['step_raise'] + 5}")
+        out = sup.submit(prompt, 20, timeout=180)
+        if sup.restarts != 1:
+            print(f"serve_tp_check: pallas replay expected 1 restart, "
+                  f"got {sup.restarts}", file=sys.stderr)
+            failures += 1
+        if not np.array_equal(out, want):
+            print("serve_tp_check: pallas post-crash replay != solo",
+                  file=sys.stderr)
+            failures += 1
+        if sup.engine.decode_step_compiles != \
+                sup.engine.warmup_compiles:
+            print("serve_tp_check: rebuilt pallas engine recompiled",
+                  file=sys.stderr)
+            failures += 1
+        if not failures:
+            print(f"serve_tp_check: pallas supervisor replay ok "
+                  f"(1 restart, replay bit-identical)", flush=True)
+    finally:
+        sup.stop(timeout=30.0)
+    return failures
+
+
 def run_supervisor_replay(tp: int) -> int:
     """Crash a supervised tp engine mid-decode: the rebuild reconstructs
     the mesh (same factory, same shardings) and the replay is
@@ -376,14 +561,15 @@ def main(argv: list[str] | None = None) -> int:
     _force_host_devices(args.tp)
     failures = run_matrix(args.tp)
     failures += run_spec(args.tp)
+    failures += run_pallas(args.tp)
     if not args.skip_supervisor:
         failures += run_supervisor_replay(args.tp)
     if failures:
         print(f"serve_tp_check: FAIL ({failures} failure(s))",
               file=sys.stderr)
         return 1
-    print(f"serve_tp_check: OK (tp={args.tp}, matrix + spec + "
-          f"supervisor replay bit-identical, zero post-warmup "
+    print(f"serve_tp_check: OK (tp={args.tp}, matrix + spec + pallas "
+          f"+ supervisor replay bit-identical, zero post-warmup "
           f"recompiles)", flush=True)
     return 0
 

@@ -19,8 +19,9 @@ relaunch after a crash) are free. Stage groups, in priority order:
                copy — one-shot probes under-read this time-sliced
                tunnel ~5x), qblock (dispatch-vs-direct arbitration —
                promoted to the front of the unmeasured set: the
-               MAX_Q_BLOCK retune still awaits its data), synthetic
-               (device-resident ResNet), convsweep,
+               MAX_Q_BLOCK retune still awaits its data), kvblock
+               (pallas paged-attend vs gather across kv_block sizes),
+               synthetic (device-resident ResNet), convsweep,
                flashramp/flashblocks (8k ramp, Q-block A/Bs)
   artifact     bench_full (the complete bench.py run), serve
                (continuous-batching vs coalescer mixed traffic),
@@ -64,6 +65,13 @@ STAGES = [
     # reached it; the revert trigger it arms is documented at
     # ops/flash_attention.py MAX_Q_BLOCK.
     ("qblock", {"PROBE": "qblock"}, 600.0),
+    # Paged-attention kernel A/B (ISSUE 18): pallas vs gather decode
+    # attend across kv_block sizes with lanes spread over occupancy —
+    # the hardware ratios for the per-lane HBM-bounding claim (the CPU
+    # interpret line is mechanism proof only). Rides directly behind
+    # qblock so one short window arbitrates BOTH block-geometry
+    # questions.
+    ("kvblock", {"PROBE": "kvblock"}, 600.0),
     ("synthetic", {"PROBE": "synthetic"}, 900.0),
     ("convsweep", {"PROBE": "convsweep"}, 600.0),
     ("flashramp", {"PROBE": "flashramp"}, 600.0),
