@@ -666,6 +666,24 @@ def bench_serve_fleet_prefix(peak_hbm_gbps: float | None) -> None:
                           else 540)
 
 
+def bench_serve_constrain(peak_hbm_gbps: float | None) -> None:
+    """Structured-decoding overhead pair: subprocess-runs
+    tools/serve_bench.py --engine constrain — the identical seeded
+    schedule served free (baseline) and with every other request under
+    a compiled JSON-schema grammar program (batch-wide mask gather +
+    host FSM walk). grammar_valid == constrained_requests and the
+    zero-recompile pin on BOTH legs are the structural pins
+    (tests/test_serve_constrain.py); the mixed line's vs_baseline is
+    the ISSUE-19 acceptance number — the bounded cost of constraints-
+    as-data on a mixed batch. Subprocess for the usual serve-section
+    reasons. peak_hbm unused; signature keeps the peak-table plumbing
+    uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("serve_constrain", ["--engine", "constrain"],
+                          timeout=150 if os.environ.get("BENCH_SMOKE")
+                          else 420)
+
+
 def _run_serve_subprocess(label: str, extra_args: list,
                           timeout: float) -> None:
     """Shared harness for the serve-family sections: subprocess-run
@@ -1368,6 +1386,8 @@ _SECTIONS: dict = {
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),
     "fleet_prefix": (bench_serve_fleet_prefix, chip_peak_hbm_gbps,
                      560.0),
+    "serve_constrain": (bench_serve_constrain, chip_peak_hbm_gbps,
+                        420.0),
     "lm": (bench_transformer_lm, chip_peak_tflops, 1100.0),
 }
 

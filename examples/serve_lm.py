@@ -16,8 +16,14 @@ stdlib HTTP server:
                               the fleet ``replica`` id — the probe
                               payload fleet/membership.py routes from
     POST /generate            {"tokens": [[...]], "num_steps": N,
-                               "temperature": T?, "top_p": P?, "seed": S?}
-                              -> {"tokens": [[...]]} (generated only)
+                               "temperature": T?, "top_p": P?, "seed": S?,
+                               "json_schema"|"regex"|"choices": ...?,
+                               "stop": [...]?, "logprobs": true?, "n": N?}
+                              -> {"tokens": [[...]]} (generated only;
+                              constrained requests add "finish_reason",
+                              "logprobs" rows under --logprobs-k, and
+                              an n-best "choices" list — see
+                              docs/constrained-decoding.md)
 
 temperature=0/omitted is greedy; temperature>0 samples (nucleus-filtered
 when top_p is set — top_p without temperature is a 400, mirroring
@@ -52,7 +58,19 @@ generate()'s own validation). Two serving engines (``--engine``):
   numbers of tokens per round — greedy output stays bit-identical to
   plain greedy, sampled slots keep their exact sampling law, and the
   two round executables never recompile across occupancy or accept
-  variation (composes with ``--tp`` and ``--kv-int8``).
+  variation (composes with ``--tp`` and ``--kv-int8``). STRUCTURED
+  DECODING (serve/constrain.py, docs/constrained-decoding.md): a
+  request's ``json_schema``/``regex``/``choices`` field compiles at
+  enqueue into a token-level DFA bound into a fixed-shape device
+  constraint pool (``--constrain-rows``); the SAME compiled step masks
+  every slot's logits through the pool (row 0 = always-allow for free
+  slots), so any constrained/unconstrained mix — under spec decode,
+  paged/dense/kv8, tp — never recompiles. ``stop`` sequences match
+  host-side (excluded from output), ``logprobs: true`` returns
+  per-token top-K rows (``--logprobs-k``), and ``n > 1`` fans one
+  sampled prompt into n candidate slots sharing ONE prefill via the
+  exact-prefix join. Invalid grammars are a typed 400
+  (``invalid_grammar``), before any device work.
   ``/debug/serve`` exposes the scheduler snapshot and ``/metrics`` the
   ``tpu_serve_*`` families. On SIGTERM the engine DRAINS: admitted
   requests finish (bounded by ``--drain-timeout`` — stragglers resolve
@@ -234,6 +252,22 @@ def main(argv: list[str] | None = None) -> int:
                         "at --spec-draft-layers depth, same width "
                         "flags); required when --spec-k is combined "
                         "with --checkpoint-dir")
+    p.add_argument("--logprobs-k", type=int, default=0, metavar="K",
+                   help="per-token top-K logprobs in /generate responses "
+                        '(opt-in per request via "logprobs": true). '
+                        "Engine-constructor static — the compiled step's "
+                        "output arity — so it is a flag, not a request "
+                        "field; continuous engine only, and mutually "
+                        "exclusive with --spec-k (verify rounds emit "
+                        "whole windows, not per-step rows). 0 = off")
+    p.add_argument("--constrain-rows", type=int, default=128, metavar="N",
+                   help="constraint-pool rows (serve/constrain.py): the "
+                        "fixed-shape device tables compiled grammar "
+                        "programs (json_schema/regex/choices request "
+                        "fields) bind into. Row 0 is the always-allow "
+                        "garbage row; a program needs n_states "
+                        "contiguous rows. HBM cost: rows x vocab bool + "
+                        "rows x vocab int32 (~5 bytes/cell)")
     p.add_argument("--stream-segment", type=int, default=16, metavar="N",
                    help="segment size for streamed responses (POST "
                         '/generate with "stream": true): greedy tokens '
@@ -455,6 +489,17 @@ def main(argv: list[str] | None = None) -> int:
                     "--spec-draft-layers depth)")
     elif args.draft_checkpoint_dir:
         p.error("--draft-checkpoint-dir requires --spec-k")
+    if args.logprobs_k:
+        if args.logprobs_k < 0:
+            p.error("--logprobs-k must be >= 0")
+        if args.spec_k:
+            p.error("--logprobs-k does not compose with --spec-k "
+                    "(verify rounds emit accept-dependent windows, not "
+                    "per-step logit rows)")
+        if args.engine != "continuous":
+            p.error("--logprobs-k requires --engine continuous")
+    if args.constrain_rows < 1:
+        p.error("--constrain-rows must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -741,6 +786,8 @@ def main(argv: list[str] | None = None) -> int:
                 faults=faults, mesh=mesh,
                 spec_k=args.spec_k, draft_cfg=draft_cfg,
                 draft_params=draft_params,
+                constrain_rows=args.constrain_rows,
+                logprobs_k=args.logprobs_k,
             )
             if kv_paged:
                 # Inside the factory so a watchdog rebuild keeps the
@@ -753,6 +800,17 @@ def main(argv: list[str] | None = None) -> int:
                 eng.host_tier = host_tier
             return eng
 
+        # ONE process-lifetime constraint compiler (like the host tier):
+        # the program LRU survives watchdog rebuilds, and every replica
+        # generation compiles against the same vocab closure. The demo
+        # vocab is the identity charset (token id i = chr(i)) — real
+        # deployments pass the tokenizer's decoded token strings.
+        from tf_operator_tpu.serve.constrain import (
+            ConstraintCompiler,
+            default_vocab,
+        )
+        constrainer = ConstraintCompiler(default_vocab(cfg.vocab_size))
+
         engine_sched = EngineSupervisor(
             engine_factory,
             resilience=res_cfg,
@@ -762,6 +820,7 @@ def main(argv: list[str] | None = None) -> int:
             # one lock serializes both decode paths.
             device_lock=lock,
             tier_prefetch=bool(args.tier_prefetch),
+            constrainer=constrainer,
         )
         kv_desc = (
             f"paged kv ({args.kv_block}-token blocks, "
@@ -777,6 +836,9 @@ def main(argv: list[str] | None = None) -> int:
         if args.spec_k:
             kv_desc += (f", spec k={args.spec_k} "
                         f"(draft {draft_cfg.n_layers} layer(s))")
+        kv_desc += f", constrain pool {args.constrain_rows} rows"
+        if args.logprobs_k:
+            kv_desc += f", logprobs top-{args.logprobs_k}"
         print(f"serve_lm: continuous batching "
               f"(slots {args.max_batch}, {kv_desc}, prefill chunk "
               f"{args.prefill_chunk or 'one-shot'}, prefill budget "
@@ -921,7 +983,23 @@ def main(argv: list[str] | None = None) -> int:
                     # is rejected by generate() itself (a client-visible
                     # 400), never silently dropped.
                     kw["top_p"] = float(top_p)
+                # Structured-decoding request fields are continuous-
+                # engine only (the constraint pool and the host stop/
+                # logprob bookkeeping live in the scheduler): anywhere
+                # else they are a 400, never a silent no-op.
+                structured = (
+                    any(req.get(k) is not None for k in
+                        ("json_schema", "regex", "choices", "stop"))
+                    or bool(req.get("logprobs"))
+                    or int(req.get("n", 1)) != 1
+                )
                 if req.get("stream"):
+                    if structured:
+                        raise ValueError(
+                            "stream does not compose with json_schema/"
+                            "regex/choices/stop/logprobs/n (use the "
+                            "continuous engine's buffered path)"
+                        )
                     # Streamed greedy decode: NDJSON, one line per
                     # segment, through the single reused segment
                     # executable (generate_segments). Runs solo — a
@@ -1006,6 +1084,39 @@ def main(argv: list[str] | None = None) -> int:
                            or self.headers.get("X-Request-Id")
                            or mint_request_id())
 
+                    # Structured/constrained decoding: at most one of
+                    # json_schema/regex/choices (the compiler's typed
+                    # 400 owns the message for conflicts/bad grammars),
+                    # plus multi-token "stop" sequences, per-token
+                    # "logprobs" (needs --logprobs-k), and "n" best-of
+                    # candidates (docs/constrained-decoding.md).
+                    constrain = {
+                        k: req[k]
+                        for k in ("json_schema", "regex", "choices")
+                        if req.get(k) is not None
+                    } or None
+                    stop = req.get("stop")
+                    want_logprobs = bool(req.get("logprobs"))
+                    n_best = int(req.get("n", 1))
+                    if n_best < 1:
+                        raise ValueError(f"n={n_best} must be >= 1")
+                    if n_best > 1:
+                        if prompt.shape[0] != 1:
+                            raise ValueError(
+                                "n > 1 requires a single-row prompt "
+                                "(candidates fan out over slots)"
+                            )
+                        if temperature <= 0:
+                            raise ValueError(
+                                "n > 1 requires temperature > 0 "
+                                "(greedy candidates would be identical)"
+                            )
+                        if n_best > args.max_batch:
+                            raise ValueError(
+                                f"n={n_best} exceeds slot capacity "
+                                f"{args.max_batch}"
+                            )
+
                     shipment = None
                     if req.get("shipped_kv") is not None:
                         # Disaggregated prefill: verify the shipped
@@ -1033,8 +1144,16 @@ def main(argv: list[str] | None = None) -> int:
                         )
 
                     def _row(i):
+                        # n-best candidates ride the SAME fan-out as
+                        # multi-row prompts: candidate j is row 0's
+                        # request at seed+j (distinct sampled streams)
+                        # — identical prompts exact-prefix-join in the
+                        # paged pool, so n candidates pay ONE prefill.
                         r = ServeRequest(
-                            _np.asarray(prompt[i:i + 1]), num_steps,
+                            _np.asarray(
+                                prompt[0:1] if n_best > 1
+                                else prompt[i:i + 1]
+                            ), num_steps,
                             temperature=temperature,
                             top_p=(None if top_p is None
                                    else float(top_p)),
@@ -1059,10 +1178,15 @@ def main(argv: list[str] | None = None) -> int:
                             # Single-row contract enforced above, so
                             # the shipment always belongs to row 0.
                             shipment=shipment,
+                            constrain=constrain,
+                            stop=stop,
+                            logprobs=want_logprobs,
                         )
                         return engine_sched.submit_request(r)
 
-                    if prompt.shape[0] == 1:
+                    fanout = (n_best if n_best > 1
+                              else prompt.shape[0])
+                    if fanout == 1:
                         rows = [_row(0)]
                     else:
                         # Rows decode concurrently (submit blocks per
@@ -1074,13 +1198,36 @@ def main(argv: list[str] | None = None) -> int:
                         from concurrent.futures import ThreadPoolExecutor
 
                         with ThreadPoolExecutor(
-                            min(prompt.shape[0], args.max_batch)
+                            min(fanout, args.max_batch)
                         ) as ex:
-                            rows = list(
-                                ex.map(_row, range(prompt.shape[0]))
-                            )
+                            rows = list(ex.map(_row, range(fanout)))
                     out = [list(r.out) for r in rows]
                     payload = {"tokens": out, "request_id": rid}
+                    if any(r.finish_reason for r in rows):
+                        # Why each stream ended: "length" | "eos" |
+                        # "grammar_complete" | "stop_sequence" (None
+                        # for deadline-cut partials — those carry
+                        # deadline_exceeded below instead).
+                        payload["finish_reason"] = [
+                            r.finish_reason for r in rows
+                        ]
+                    if want_logprobs:
+                        payload["logprobs"] = [
+                            r.logprob_rows for r in rows
+                        ]
+                    if n_best > 1:
+                        # Candidate view of the same rows: one entry
+                        # per seed, ordered. "tokens" above stays the
+                        # raw per-slot list so existing readers (and
+                        # the fleet response assembler) are unchanged.
+                        payload["choices"] = [
+                            {
+                                "tokens": list(r.out),
+                                "seed": int(req.get("seed", 0)) + j,
+                                "finish_reason": r.finish_reason,
+                            }
+                            for j, r in enumerate(rows)
+                        ]
                     if req.get("timing"):
                         # Opt-in compact latency attribution per row:
                         # queue/prefill/decode ms + ITL summary (the
@@ -1107,6 +1254,11 @@ def main(argv: list[str] | None = None) -> int:
                                 and served >= args.requests):
                             done.set()
                     return
+                elif structured:
+                    raise ValueError(
+                        "json_schema/regex/choices/stop/logprobs/n "
+                        "require --engine continuous"
+                    )
                 elif coalescer is not None and not kw:
                     out = coalescer.submit(prompt, num_steps)
                 elif not kw:

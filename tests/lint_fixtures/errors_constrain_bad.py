@@ -1,0 +1,27 @@
+"""Seeded violations for the ``typed-error`` pass, constrained-decoding
+era (ISSUE 19): a typo'd grammar-rejection code in a payload literal, a
+client-side comparison against an unknown code, and an unknown
+finish-reason member in a non-retryable-code constant — the mistakes
+that would break the structured-decoding wire contract (a typo'd
+``invalid_grammar`` makes the fleet router RETRY a deterministically
+bad spec across every replica instead of handing the 400 straight back
+to the client). (The test runs the checker over this file TOGETHER
+with serve/resilience.py so the taxonomy — incl. the real
+``invalid_grammar``/``stop_sequence`` — is in the analyzed set.)"""
+
+
+def mint() -> dict:
+    # Typo: the taxonomy declares "invalid_grammar".
+    return {"error": "x", "code": "invalid_gramar", "retryable": False}
+
+
+def client_should_not_retry(payload: dict) -> bool:
+    # Unknown: no such code anywhere in the taxonomy.
+    return payload.get("code") == "grammar_invalid"
+
+
+NO_RETRY_CODES = ("invalid_grammar", "grammar_timeout")
+
+
+def hand_back(payload: dict) -> bool:
+    return payload.get("code") in NO_RETRY_CODES

@@ -1,0 +1,19 @@
+"""Clean twin of errors_constrain_bad.py: the constrained-decoding
+codes spelled as the taxonomy declares them (``invalid_grammar`` from
+the InvalidGrammar ServeError subclass / WIRE_CODES, ``stop_sequence``
+for the trimmed-at-match finish reason)."""
+
+
+def mint() -> dict:
+    return {"error": "x", "code": "invalid_grammar", "retryable": False}
+
+
+def client_should_not_retry(payload: dict) -> bool:
+    return payload.get("code") == "invalid_grammar"
+
+
+NO_RETRY_CODES = ("invalid_grammar", "stop_sequence")
+
+
+def hand_back(payload: dict) -> bool:
+    return payload.get("code") in NO_RETRY_CODES

@@ -65,6 +65,10 @@ _FIXTURE_MATRIX = {
     # degrade code must trip — the router degrades tier-pull failures
     # to local prefill on these strings.
     "errors_tier_bad.py": ((TAXONOMY,), "typed-error"),
+    # Constrained-decoding codes (ISSUE 19): a typo'd invalid_grammar /
+    # unknown finish-reason code must trip — the router hands a 400
+    # back (never retries) on exactly this string.
+    "errors_constrain_bad.py": ((TAXONOMY,), "typed-error"),
 }
 
 
@@ -86,7 +90,7 @@ def test_fixture_trips_exactly_its_pass(name):
     "lockorder_clean.py", "guarded_clean.py", "blocking_clean.py",
     "metrics_clean.py", "metrics_spec_clean.py", "errors_clean.py",
     "errors_ship_clean.py", "errors_prefix_clean.py",
-    "errors_tier_clean.py",
+    "errors_tier_clean.py", "errors_constrain_clean.py",
 ])
 def test_clean_twin_trips_nothing(name):
     extra = (TAXONOMY,) if name.startswith("errors") else ()

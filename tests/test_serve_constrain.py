@@ -1,0 +1,641 @@
+"""Structured & constrained decoding (serve/constrain.py + the engine/
+scheduler integration): the grammar exactness contract.
+
+THE pin: a constrained slot's stream is BIT-IDENTICAL to solo
+``constrained_generate`` on the same program — greedy AND sampled —
+while unconstrained neighbors stay bitwise on plain ``generate`` (the
+row-0 ``+ 0.0`` invariance), across dense/paged/paged-kv8 layouts,
+one-shot/chunked prefill, gather/pallas attends, composed with
+speculative decode (solo oracle: ``speculative_generate(program=)``),
+with ZERO decode-step recompiles across any constrained/unconstrained
+occupancy mix and program churn. Every constrained completion PARSES:
+json.loads for schemas, re.fullmatch for regexes, membership for
+choices. Compiler/pool/stop/logprobs units ride alongside; the
+scheduler tier pins grammar_complete/stop_sequence retirement, typed
+invalid_grammar 400s, and the /debug/serve constrain section.
+
+All vocabularies here are the identity charset at V=128 (token id i =
+``chr(i)``) so ASCII grammars close over the vocab; V=64 misses
+lowercase/braces and is itself a pinned typed-400 case.
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tf_operator_tpu.models.spec_decode import speculative_generate
+from tf_operator_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    generate,
+)
+from tf_operator_tpu.serve.constrain import (
+    ConstraintCompiler,
+    ProgramPool,
+    apply_stop,
+    constrained_generate,
+    default_vocab,
+    detokenize,
+    match_stop,
+    schema_to_regex,
+    walk_tokens,
+)
+from tf_operator_tpu.serve.engine import ContinuousEngine
+from tf_operator_tpu.serve.resilience import InvalidGrammar
+
+pytestmark = pytest.mark.serve
+
+V = 128
+CFG = TransformerConfig(
+    vocab_size=V, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+DRAFT_CFG = TransformerConfig(
+    vocab_size=V, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+    max_seq_len=64, dtype=jnp.float32,
+)
+VOCAB = default_vocab(V)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Transformer(CFG).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def draft_params():
+    return Transformer(DRAFT_CFG).init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+
+
+@pytest.fixture(scope="module")
+def comp():
+    return ConstraintCompiler(VOCAB)
+
+
+def prompt_of(p: int, seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, V, (1, p)).astype(
+        np.int32
+    )
+
+
+# ---------------------------------------------------------------------------
+# compiler units: regex / choices / json-schema -> token DFA
+# ---------------------------------------------------------------------------
+
+def test_regex_program_walks_and_completes(comp):
+    prog = comp.compile({"regex": "[0-9]{2,4}"})
+    assert prog.kind == "regex" and prog.n_states >= 4
+    digits = [ord(c) for c in "2026"]
+    st, done = walk_tokens(prog, digits)
+    assert done == 3  # completes exactly at the 4th digit
+    st2, done2 = walk_tokens(prog, digits[:2])
+    assert done2 is None and bool(prog.accept[st2])
+    # every state's allow row admits only tokens the regex can extend by
+    assert not prog.allow[0, ord("a")]
+    assert prog.allow[0, ord("7")]
+
+
+def test_choices_trie_and_membership(comp):
+    prog = comp.compile({"choices": ["cat", "car", "dog"]})
+    assert prog.kind == "choices"
+    for word in ("cat", "car", "dog"):
+        _, done = walk_tokens(prog, [ord(c) for c in word])
+        assert done == len(word) - 1, word
+    # 'ca' is a prefix, not a member — no completion yet
+    _, done = walk_tokens(prog, [ord(c) for c in "ca"])
+    assert done is None
+
+
+def test_schema_to_regex_and_compile(comp):
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 5},
+            "age": {"type": "integer"},
+        },
+        "required": ["name", "age"],
+    }
+    rx = schema_to_regex(schema)
+    assert re.fullmatch(rx, '{"name":"ab","age":42}')
+    assert not re.fullmatch(rx, '{"age":42,"name":"ab"}')  # canonical order
+    prog = comp.compile({"json_schema": schema})
+    assert prog.kind == "json_schema"
+    text = '{"name":"ok","age":7}'
+    _, done = walk_tokens(prog, [ord(c) for c in text])
+    assert done == len(text) - 1
+    assert json.loads(text)["age"] == 7
+
+
+def test_invalid_grammars_are_typed_400(comp):
+    cases = [
+        {"regex": "[unclosed"},
+        {"regex": "a{5,2}"},
+        {"regex": ""},
+        {"choices": []},
+        {"json_schema": {"type": "object"}},  # no properties
+        {"regex": "a", "choices": ["a"]},     # conflicting keys
+        {"unknown": 1},
+    ]
+    for spec in cases:
+        with pytest.raises(InvalidGrammar) as ei:
+            comp.compile(spec)
+        assert ei.value.http_status == 400 and not ei.value.retryable
+    # vocabulary closure: V=64 has no lowercase tokens, so a lowercase
+    # choice can NEVER be produced — typed 400, not a silent dead DFA.
+    small = ConstraintCompiler(default_vocab(64))
+    with pytest.raises(InvalidGrammar, match="vocabulary"):
+        small.compile({"choices": ["cat"]})
+
+
+def test_compiler_cache_lru_by_digest(comp):
+    c = ConstraintCompiler(VOCAB, cache_programs=2)
+    a = c.compile({"regex": "[0-9]+"})
+    b = c.compile({"regex": "[0-9]+"})
+    assert a is b and c.cache_hits >= 1
+    c.compile({"regex": "[a-z]+"})
+    c.compile({"regex": "[A-Z]+"})  # evicts the LRU entry
+    assert len(c.debug()) and c.debug()["cached_programs"] == 2
+
+
+# ---------------------------------------------------------------------------
+# stop-sequence helpers: incremental == post-hoc
+# ---------------------------------------------------------------------------
+
+def test_match_stop_equals_apply_stop(comp):
+    stops = comp.encode_stop(["ab", [7, 8, 9]])
+    assert stops == ((97, 98), (7, 8, 9))
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        stream = [int(t) for t in rng.integers(90, 100, 30)]
+        out: list = []
+        trimmed = None
+        for tok in stream:
+            out.append(tok)
+            k = match_stop(out, stops)
+            if k:
+                del out[-k:]
+                trimmed = list(out)
+                break
+        want = apply_stop(stream, stops)
+        got = trimmed if trimmed is not None else out
+        assert got == want[: len(got)] and (
+            trimmed is None or got == want
+        )
+    with pytest.raises(InvalidGrammar):
+        comp.encode_stop([""])
+    with pytest.raises(InvalidGrammar):
+        comp.encode_stop([3.5])
+
+
+# ---------------------------------------------------------------------------
+# the program pool: bind / refcount / LRU eviction
+# ---------------------------------------------------------------------------
+
+def test_program_pool_bind_refcount_evict(comp):
+    a = comp.compile({"regex": "[0-9]{2,4}"})
+    b = comp.compile({"choices": ["cat", "car", "dog"]})
+    pool = ProgramPool(a.n_states + b.n_states + 1, V)
+    base_a = pool.bind(a)
+    assert base_a == 1  # row 0 is the garbage row
+    base_a2 = pool.bind(a)
+    assert base_a2 == base_a  # resident: refcount bump, no new rows
+    base_b = pool.bind(b)
+    assert base_b == base_a + a.n_states
+    # full: a third distinct program cannot bind while refs are live
+    c = comp.compile({"regex": "[A-Z]{2,4}"})  # same 5-state footprint
+    assert c.n_states == a.n_states
+    assert pool.bind(c) is None
+    pool.release(a.digest)
+    pool.release(a.digest)
+    # refcount-0 resident evicts LRU to free a's contiguous rows
+    assert pool.bind(c) is not None
+    dbg = pool.debug()
+    assert dbg["evictions"] >= 1 and dbg["programs"] == 2
+    # absolute-next convention: disallowed transitions escape to row 0
+    nxt = np.asarray(pool.next_pool)
+    allow = np.asarray(pool.allow_pool)
+    assert allow[0].all() and (nxt[0] == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# engine bit-identity: constrained slot == constrained_generate,
+# free neighbor == generate, across the layout matrix
+# ---------------------------------------------------------------------------
+
+def drive(engine, reqs, script):
+    """test_serve_engine's scripted harness + per-request programs."""
+    owner, left, out = {}, {}, {n: [] for n in reqs}
+    for op, arg in script:
+        if op == "join":
+            prompt, steps, t, tp, seed, prog = reqs[arg]
+            slot = engine.join(
+                jnp.asarray(prompt), num_steps=steps, temperature=t,
+                top_p=tp, seed=seed, program=prog,
+            )
+            assert slot is not None, f"no free slot for {arg}"
+            owner[slot], left[slot] = arg, steps
+        else:
+            for _ in range(arg):
+                if not owner:
+                    break
+                toks = engine.step()
+                for slot in list(owner):
+                    out[owner[slot]].append(int(toks[slot]))
+                    left[slot] -= 1
+                    if left[slot] == 0:
+                        engine.retire(slot)
+                        del owner[slot], left[slot]
+    assert not owner, f"unfinished: {owner}"
+    return out
+
+
+def solo_con(params, prompt, steps, prog, *, temperature=0.0,
+             top_p=None, seed=0, cfg=CFG):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    return np.asarray(constrained_generate(
+        cfg, params, jnp.asarray(prompt), steps, program=prog, **kw
+    ))[0]
+
+
+def solo_free(params, prompt, steps, *, temperature=0.0, top_p=None,
+              seed=0, cfg=CFG):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    return np.asarray(
+        generate(cfg, params, jnp.asarray(prompt), steps, **kw)
+    )[0]
+
+
+# Each cell covers every axis value at least once across the matrix:
+# {dense, paged, paged-kv8} x {oneshot, chunked} x {gather, pallas}.
+MATRIX = [
+    ("dense", None, "gather"),
+    ("dense", 4, "gather"),
+    ("paged", None, "gather"),
+    ("paged", 4, "pallas"),
+    ("paged-kv8", 4, "gather"),
+    ("paged-kv8", None, "pallas"),
+]
+
+
+@pytest.mark.parametrize("kv_layout,prefill_chunk,kv_attend", MATRIX)
+def test_constrained_slots_bit_identical(params, comp, kv_layout,
+                                         prefill_chunk, kv_attend):
+    """THE tentpole pin: constrained slots (greedy AND sampled, two
+    different programs churning through joins/retires) reproduce solo
+    ``constrained_generate`` bit-for-bit while free neighbors stay on
+    plain ``generate`` — and the decode step never recompiled."""
+    from dataclasses import replace
+
+    cfg = replace(CFG, kv_int8=True) if "kv8" in kv_layout else CFG
+    reqs = {
+        "free_a": (prompt_of(5, 1), 10, 0.0, None, 0, None),
+        "con_b": (prompt_of(6, 2), 10, 0.0, None, 0,
+                  comp.compile({"regex": "[0-9]{2,6}"})),
+        "con_c": (prompt_of(4, 3), 8, 0.8, 0.9, 11,
+                  comp.compile({"choices": ["cat", "car", "dog"]})),
+        "free_d": (prompt_of(7, 4), 6, 0.9, None, 5, None),
+        "reuse_e": (prompt_of(5, 5), 5, 0.0, None, 0,
+                    comp.compile({"regex": "[0-9]{2,6}"})),
+    }
+    script = [
+        ("join", "free_a"), ("steps", 2),
+        ("join", "con_b"), ("join", "con_c"), ("steps", 3),
+        ("join", "free_d"), ("steps", 8),
+        ("join", "reuse_e"), ("steps", 20),
+    ]
+    engine = ContinuousEngine(
+        cfg, params, max_slots=4, prefill_chunk=prefill_chunk,
+        kv_paged=kv_layout != "dense", kv_block=8, kv_attend=kv_attend,
+    )
+    got = drive(engine, reqs, script)
+    for name, (prompt, steps, t, tp, seed, prog) in reqs.items():
+        if prog is None:
+            want = solo_free(params, prompt, steps, temperature=t,
+                             top_p=tp, seed=seed, cfg=cfg)
+        else:
+            want = solo_con(params, prompt, steps, prog, temperature=t,
+                            top_p=tp, seed=seed, cfg=cfg)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), want, err_msg=f"{name}@{kv_layout}"
+        )
+    assert engine.decode_step_compiles == engine.warmup_compiles
+    dbg = engine.constrain_debug()
+    assert dbg["slots_constrained"] == 0  # all retired + released
+
+
+def test_constrained_outputs_parse(params, comp):
+    """Grammar validity, sampled: regex streams fullmatch, choices are
+    members, schema streams json.load — trimmed at the completion index
+    the host walker reports."""
+    # bounded grammar lengths so every sampled stream completes well
+    # inside the step budget (an unbounded integer can extend forever)
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string", "maxLength": 4},
+            "ok": {"type": "boolean"},
+        },
+        "required": ["name", "ok"],
+    }
+    progs = {
+        "regex": (comp.compile({"regex": "[0-9]{2,6}"}),
+                  lambda s: re.fullmatch("[0-9]{2,6}", s)),
+        "choices": (comp.compile({"choices": ["cat", "car", "dog"]}),
+                    lambda s: s in {"cat", "car", "dog"}),
+        "json_schema": (comp.compile({"json_schema": schema}),
+                        lambda s: isinstance(json.loads(s)["ok"], bool)),
+    }
+    for seed, (kind, (prog, check)) in enumerate(progs.items()):
+        toks = solo_con(params, prompt_of(5, seed), 30, prog,
+                        temperature=0.9, seed=seed)
+        _, done = walk_tokens(prog, [int(t) for t in toks])
+        assert done is not None, f"{kind} never completed: {toks}"
+        text = detokenize(VOCAB, toks[: done + 1])
+        assert check(text), (kind, text)
+
+
+def test_zero_recompiles_across_program_churn(params, comp):
+    """Join/retire a DIFFERENT program each round (pool scatters are
+    eager data updates) — compile count frozen at warmup, fsm rows are
+    data, bind/evict never touches the executable."""
+    engine = ContinuousEngine(CFG, params, max_slots=2, kv_block=8,
+                              constrain_rows=32)
+    anchor = engine.join(jnp.asarray(prompt_of(4, 9)), num_steps=40)
+    engine.step()
+    base = engine.decode_step_compiles
+    for i, spec in enumerate([
+        {"regex": "[0-9]{2,4}"},
+        {"choices": ["cat", "car", "dog"]},
+        {"regex": "[A-Z]{1,3}"},
+        {"regex": "[0-9]{2,4}"},  # resident rebind
+    ]):
+        slot = engine.join(
+            jnp.asarray(prompt_of(3 + i, 20 + i)), num_steps=2,
+            program=comp.compile(spec),
+        )
+        engine.step()
+        engine.step()
+        engine.retire(slot)
+    engine.retire(anchor)
+    assert engine.decode_step_compiles == base == engine.warmup_compiles
+
+
+def test_engine_logprobs_rows(params):
+    engine = ContinuousEngine(CFG, params, max_slots=2, kv_block=8,
+                              logprobs_k=3)
+    slot = engine.join(jnp.asarray(prompt_of(5, 3)), num_steps=4)
+    toks = engine.step()
+    chosen, top_vals, top_ids = engine.last_logprobs()
+    assert chosen.shape == (2,) and top_vals.shape == (2, 3)
+    # the chosen (greedy) token is the top-1 entry and logprobs are
+    # normalized (<= 0, top-1 the largest)
+    assert int(top_ids[slot, 0]) == int(toks[slot])
+    assert np.isclose(chosen[slot], top_vals[slot, 0])
+    assert (top_vals[slot] <= 0).all()
+    assert top_vals[slot, 0] >= top_vals[slot, 2]
+    engine.retire(slot)
+    with pytest.raises(ValueError, match="logprobs_k"):
+        ContinuousEngine(CFG, params, max_slots=2, logprobs_k=V + 1)
+
+
+def test_logprobs_spec_engine_rejected(params, draft_params):
+    with pytest.raises(ValueError, match="spec"):
+        ContinuousEngine(
+            CFG, params, max_slots=2, logprobs_k=2,
+            spec_k=2, draft_cfg=DRAFT_CFG, draft_params=draft_params,
+        )
+
+
+# ---------------------------------------------------------------------------
+# speculative composition: draft walks the FSM, verify re-masks
+# ---------------------------------------------------------------------------
+
+SPEC_K = 2
+
+
+def spec_drive(engine, reqs, script):
+    owner, out = {}, {n: [] for n in reqs}
+    for op, arg in script:
+        if op == "join":
+            prompt, steps, t, tp, seed, prog = reqs[arg]
+            slot = engine.join(
+                jnp.asarray(prompt), num_steps=steps, temperature=t,
+                top_p=tp, seed=seed, program=prog,
+            )
+            assert slot is not None, f"no free slot for {arg}"
+            owner[slot] = arg
+        else:
+            for _ in range(arg):
+                if not owner:
+                    break
+                toks, counts = engine.spec_step()
+                for slot in list(owner):
+                    name = owner[slot]
+                    steps = reqs[name][1]
+                    for j in range(int(counts[slot])):
+                        if len(out[name]) < steps:
+                            out[name].append(int(toks[slot, j]))
+                    if len(out[name]) >= steps:
+                        engine.retire(slot)
+                        del owner[slot]
+    assert not owner, f"unfinished: {owner}"
+    return out
+
+
+def solo_spec(params, draft_params, prompt, steps, *, temperature=0.0,
+              top_p=None, seed=0, program=None):
+    kw = {}
+    if temperature > 0:
+        kw = dict(temperature=temperature, rng=jax.random.PRNGKey(seed))
+        if top_p is not None:
+            kw["top_p"] = top_p
+    toks, _ = speculative_generate(
+        CFG, params, DRAFT_CFG, draft_params, jnp.asarray(prompt),
+        steps, k=SPEC_K, program=program, **kw,
+    )
+    return np.asarray(toks)[0]
+
+
+def test_solo_spec_constrained_equals_constrained_generate(params,
+                                                           draft_params,
+                                                           comp):
+    """The composition law at the solo tier: greedy speculative with a
+    program == plain constrained_generate (mask violations are just
+    rejections), and program=None stays exactly plain generate."""
+    prog = comp.compile({"regex": "[0-9]{2,6}"})
+    pa = prompt_of(6, 11)
+    np.testing.assert_array_equal(
+        solo_spec(params, draft_params, pa, 12, program=prog),
+        solo_con(params, pa, 12, prog),
+    )
+    np.testing.assert_array_equal(
+        solo_spec(params, draft_params, pa, 12),
+        solo_free(params, pa, 12),
+    )
+
+
+@pytest.mark.parametrize("kv_attend", ["gather", "pallas"])
+def test_spec_engine_constrained_lanes(params, draft_params, comp,
+                                       kv_attend):
+    """Constrained lanes on the SPEC engine reproduce solo
+    ``speculative_generate(program=)`` bit-for-bit — greedy and sampled
+    — with free lanes untouched and the two round executables frozen,
+    under both paged attends (the pallas kernel sees masked verify
+    chunks as pure data)."""
+    prog_d = comp.compile({"regex": "[0-9]{2,6}"})
+    prog_c = comp.compile({"choices": ["cat", "car", "dog"]})
+    reqs = {
+        "free_a": (prompt_of(6, 11), 12, 0.0, None, 0, None),
+        "con_b": (prompt_of(6, 11), 12, 0.0, None, 0, prog_d),
+        "con_c": (prompt_of(4, 13), 8, 0.8, 0.9, 5, prog_d),
+        "con_d": (prompt_of(5, 14), 6, 0.0, None, 0, prog_c),
+    }
+    script = [
+        ("join", "free_a"), ("rounds", 1),
+        ("join", "con_b"), ("join", "con_c"), ("rounds", 2),
+        ("join", "con_d"), ("rounds", 40),
+    ]
+    engine = ContinuousEngine(
+        CFG, params, max_slots=4, kv_paged=True, kv_block=8,
+        kv_attend=kv_attend, spec_k=SPEC_K, draft_cfg=DRAFT_CFG,
+        draft_params=draft_params,
+    )
+    got = spec_drive(engine, reqs, script)
+    for name, (prompt, steps, t, tp, seed, prog) in reqs.items():
+        want = solo_spec(params, draft_params, prompt, steps,
+                         temperature=t, top_p=tp, seed=seed,
+                         program=prog)
+        np.testing.assert_array_equal(
+            np.asarray(got[name]), want[:steps], err_msg=name
+        )
+    assert engine.decode_step_compiles == engine.warmup_compiles
+
+
+# ---------------------------------------------------------------------------
+# scheduler tier: grammar_complete / stop / logprobs / typed 400s
+# ---------------------------------------------------------------------------
+
+def test_scheduler_constrained_end_to_end(params, comp):
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    engine = ContinuousEngine(CFG, params, max_slots=4, kv_block=8,
+                              logprobs_k=3)
+    sched = ContinuousScheduler(engine, constrainer=comp).start()
+    try:
+        pa = prompt_of(6, 11)
+        spec = {"regex": "[0-9]{2,4}"}
+        r = sched.submit_request(ServeRequest(pa, 20, constrain=spec))
+        assert r.error is None
+        prog = comp.compile(spec)
+        want = solo_con(params, pa, 20, prog)
+        _, done = walk_tokens(prog, [int(t) for t in want])
+        assert list(r.out) == [int(t) for t in want[: done + 1]]
+        assert r.finish_reason == "grammar_complete"
+        assert detokenize(VOCAB, r.out).isdigit()
+
+        # logprobs rows, one per delivered token
+        r2 = sched.submit_request(ServeRequest(pa, 6, logprobs=True))
+        assert r2.finish_reason == "length"
+        assert len(r2.logprob_rows) == 6
+        assert all(len(row["top_ids"]) == 3 and row["logprob"] <= 0
+                   for row in r2.logprob_rows)
+
+        # stop sequence: excluded from output, post-hoc law
+        free = [int(t) for t in r2.out]
+        r3 = sched.submit_request(ServeRequest(pa, 6, stop=[free[2:4]]))
+        assert r3.finish_reason == "stop_sequence"
+        assert list(r3.out) == apply_stop(free, [tuple(free[2:4])])
+
+        # typed 400 at enqueue, before any device work
+        with pytest.raises(InvalidGrammar):
+            sched.submit_request(
+                ServeRequest(pa, 4, constrain={"regex": "[bad"})
+            )
+
+        snap = sched.debug_snapshot()
+        assert snap["constrain"]["slots_constrained"] == 0
+        assert snap["constrain"]["compiler"]["compiles"] >= 1
+        assert snap["decode_step_compiles"] == snap["warmup_compiles"]
+    finally:
+        sched.stop(timeout=30.0)
+
+
+def test_scheduler_rejects_unconfigured_constrain(params):
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    engine = ContinuousEngine(CFG, params, max_slots=2, kv_block=8)
+    sched = ContinuousScheduler(engine)  # no constrainer, not started
+    with pytest.raises(InvalidGrammar, match="compiler"):
+        sched.enqueue(ServeRequest(prompt_of(4, 1), 4,
+                                   constrain={"regex": "[0-9]+"}))
+    with pytest.raises(ValueError, match="logprobs"):
+        sched.enqueue(ServeRequest(prompt_of(4, 1), 4, logprobs=True))
+
+
+# ---------------------------------------------------------------------------
+# serve_bench structural (slow): the constrain leg wiring
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_bench_constrain_structural():
+    """tools/serve_bench.py --engine constrain (BENCH_SMOKE): the
+    free/mixed pair on one seeded schedule — capacity pins only: every
+    constrained request retired grammar_complete with output that
+    PARSES (grammar_valid == constrained_requests), the program pool
+    was actually used, both legs held the zero-recompile pin, no
+    errors, and the mixed line carries the overhead ratio."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "serve_bench.py"),
+         "--engine", "constrain"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(raw) for raw in proc.stdout.splitlines()
+             if raw.startswith("{")]
+    free = next(l for l in lines
+                if l["metric"] == "serve_constrain_free_"
+                                  "tokens_per_sec_mixed")
+    mixed = next(l for l in lines
+                 if l["metric"] == "serve_constrain_mixed_"
+                                   "tokens_per_sec_mixed")
+    for leg in (free, mixed):
+        assert leg["errors"] == 0
+        assert leg["generated_tokens"] > 0
+        assert leg["decode_step_compiles"] == leg["warmup_compiles"]
+    assert free["constrained_requests"] == 0
+    assert mixed["constrained_requests"] > 0
+    assert mixed["grammar_valid"] == mixed["constrained_requests"]
+    assert mixed["grammar_complete"] == mixed["constrained_requests"]
+    assert mixed["constrain_programs"] >= 1
+    assert mixed["constrain_rows_used"] > 1
+    assert mixed["vs_baseline"] > 0

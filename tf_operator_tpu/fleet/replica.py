@@ -162,6 +162,17 @@ class SupervisorBackend:
                 # kicks an async restore so the blocks are hot by
                 # admission.
                 session=body.get("session"),
+                # Structured decoding rides the fleet hop verbatim: the
+                # grammar spec compiles on the REPLICA's scheduler (its
+                # compiler owns the vocab closure); an invalid grammar
+                # answers the typed 400 through submit_request below.
+                constrain=({
+                    k: body[k]
+                    for k in ("json_schema", "regex", "choices")
+                    if body.get(k) is not None
+                } or None),
+                stop=body.get("stop"),
+                logprobs=bool(body.get("logprobs")),
             )
         except (KeyError, ValueError, TypeError) as exc:
             return 400, {"error": str(exc), "code": "bad_request",
@@ -174,6 +185,10 @@ class SupervisorBackend:
             # typed (ServeError renders itself; the rest become 500s).
             return http_status_of(exc), error_payload(exc)
         payload: dict[str, Any] = {"tokens": [list(req.out)]}
+        if req.finish_reason:
+            payload["finish_reason"] = [req.finish_reason]
+        if req.logprobs and req.logprob_rows:
+            payload["logprobs"] = [req.logprob_rows]
         if req.deadline_exceeded:
             payload["deadline_exceeded"] = [True]
             payload["timeout_cause"] = [req.timeout_cause]

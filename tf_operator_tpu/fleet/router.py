@@ -158,7 +158,13 @@ class FleetRouter:
         """Route one /generate body; returns (http_status, payload).
         Never raises for replica-side conditions — everything comes back
         typed, including "no routable replicas" (503, retryable: the
-        controller may be replacing a replica right now)."""
+        controller may be replacing a replica right now). Structured-
+        decoding fields (``json_schema``/``regex``/``choices``/``stop``/
+        ``logprobs``) forward VERBATIM inside the body — grammars
+        compile on the replica that serves the request (its compiler
+        owns the vocab closure), and the replica's typed
+        ``invalid_grammar`` 400 returns unchanged (non-retryable: the
+        grammar is bad on every replica)."""
         timeout = timeout or self.cfg.request_timeout_s
         # Mint (or accept) the fleet-wide request id HERE — the router
         # is the first hop; the replica threads it into the scheduler's

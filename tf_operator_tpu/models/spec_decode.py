@@ -92,8 +92,20 @@ def speculative_generate(
     temperature: float = 0.0,
     top_p: float | None = None,
     rng: jax.Array | None = None,
+    program: Any = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Speculative decode: ([B, num_steps] tokens, rounds used).
+
+    ``program`` (serve/constrain.CompiledProgram, optional) composes a
+    token-level grammar constraint with speculation — the SOLO oracle
+    the continuous engine's constrained spec lanes pin against: the
+    draft walks the FSM and proposes only from mask-added logits, the
+    verify re-masks the target's chunk rows with the same per-position
+    state chain before the unchanged accept test (a proposal the
+    grammar forbids has q = p = 0 there — a mask violation is just a
+    rejection, the rewind machinery untouched), and the residual/bonus
+    draws come from masked rows so every emitted token is legal. With
+    ``program=None`` the constraint code never enters the trace.
 
     ``temperature=0`` (default) is GREEDY: equivalent to
     ``generate(target_cfg, target_params, prompt, num_steps)``, for ANY
@@ -156,7 +168,7 @@ def speculative_generate(
         raise ValueError("top_p requires temperature > 0 (greedy ignores it)")
     fn = _spec_fn(target_cfg, draft_cfg, num_steps, int(k),
                   float(temperature),
-                  None if top_p is None else float(top_p))
+                  None if top_p is None else float(top_p), program)
     if rng is None:
         rng = jax.random.PRNGKey(0)  # greedy: carried but never consumed
     return fn(target_params, draft_params, prompt, rng)
@@ -165,7 +177,7 @@ def speculative_generate(
 @functools.lru_cache(maxsize=16)
 def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
              num_steps: int, k: int, temperature: float = 0.0,
-             top_p: float | None = None):
+             top_p: float | None = None, program: Any = None):
     from dataclasses import replace
 
     from tf_operator_tpu.models.transformer import _nucleus_filter
@@ -179,6 +191,36 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
     # unchanged by the branches (rng rides the carry either way but the
     # greedy trace never consumes it).
     sampled = temperature > 0
+    if program is not None:
+        import numpy as np
+
+        # The program's local tables with the engine-pool convention
+        # appended: a disallowed transition (reachable only after the
+        # grammar COMPLETES) lands on an always-allow free state — the
+        # pool's garbage row 0 — so solo and the continuous engine's
+        # constrained spec lanes agree bitwise for the whole stream.
+        n_states, vsz = program.allow.shape
+        free = n_states
+        allow_x = jnp.asarray(np.concatenate(
+            [program.allow, np.ones((1, vsz), np.bool_)], axis=0
+        ))
+        next_x = jnp.asarray(np.concatenate(
+            [np.where(program.allow, program.next.astype(np.int32),
+                      free),
+             np.full((1, vsz), free, np.int32)], axis=0
+        ))
+
+    def cmask(logits, st):
+        """Additive grammar mask for [B, V] logits at per-row FSM
+        states [B] — identity (not even traced) without a program."""
+        if program is None:
+            return logits
+        return logits + jnp.where(allow_x[st], 0.0, -1e30)
+
+    def advance(st, tok):
+        if program is None:
+            return st
+        return next_x[st, tok.astype(jnp.int32)]
 
     def scale(logits):
         """Tempered (and optionally nucleus-filtered) logits: the ONE
@@ -198,6 +240,12 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
         tcache, tlogits = _prefill(tmodel, tparams, prompt)
         dcache, _ = _prefill(dmodel, dparams, prompt)
 
+        # Per-row FSM state (all-zero init; stays zero and unused
+        # without a program). pend is the first GENERATED token: its
+        # distribution takes the init state's mask, and the carried
+        # state is always the state AFTER pend — the engine invariant.
+        st0 = jnp.zeros((b,), jnp.int32)
+        tlogits = cmask(tlogits, st0)
         if sampled:
             rng, k0 = jax.random.split(rng)
             pend = jax.random.categorical(
@@ -205,6 +253,7 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
             ).astype(tok_dtype)
         else:
             pend = tlogits.argmax(-1).astype(tok_dtype)
+        st0 = advance(st0, pend)
 
         # Output buffer with k+1 slack: each round unconditionally writes
         # a k+1 window at position n (n < num_steps inside the loop, so
@@ -214,22 +263,22 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
         out0 = out0.at[:, 0].set(pend)
 
         def draft_step(carry, step_key):
-            dcache, tok = carry
+            dcache, tok, st = carry
             logits, upd = dmodel.apply(
                 {"params": dparams, "cache": dcache}, tok[:, None],
                 mutable=["cache"],
             )
-            logits = logits[:, 0]
+            logits = cmask(logits[:, 0], st)
             if sampled:
                 nxt = jax.random.categorical(
                     step_key, scale(logits)
                 ).astype(tok_dtype)
-                return (upd["cache"], nxt), (nxt, logits)
+                return (upd["cache"], nxt, advance(st, nxt)), (nxt, logits)
             nxt = logits.argmax(-1).astype(tok_dtype)
-            return (upd["cache"], nxt), (nxt, ())
+            return (upd["cache"], nxt, advance(st, nxt)), (nxt, ())
 
         def round_body(state):
-            tcache, dcache, out, n, pend, rounds, rng = state
+            tcache, dcache, out, n, pend, st, rounds, rng = state
             t_idx = _cache_index(tcache)
             d_idx = _cache_index(dcache)
             rng, k_draft, k_acc, k_res, k_bonus = jax.random.split(rng, 5)
@@ -237,8 +286,8 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
             # Draft k+1 steps from the pending token. Proposals are the
             # first k outputs; the last is drafted only so the draft
             # cache contains d_k when everything gets accepted.
-            (dcache, _), (drafted, qlogits) = jax.lax.scan(
-                draft_step, (dcache, pend),
+            (dcache, _, _), (drafted, qlogits) = jax.lax.scan(
+                draft_step, (dcache, pend, st),
                 jax.random.split(k_draft, k + 1),
             )
             drafted = drafted.swapaxes(0, 1)  # [B, k+1]
@@ -253,6 +302,24 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
                 mutable=["cache"],
             )
             tcache = tupd["cache"]
+            if program is not None:
+                # The same FSM chain the draft walked, re-derived:
+                # s_seq[:, j] is the state chunk position j's target
+                # distribution must be masked by (s_0 = the carried
+                # state after pend, then advancing through proposals).
+                def fsm_walk(s, d):
+                    return next_x[s, d], s
+
+                s_last, s_seq = jax.lax.scan(
+                    fsm_walk, st,
+                    jnp.swapaxes(proposals.astype(jnp.int32), 0, 1),
+                )
+                s_seq = jnp.concatenate(
+                    [jnp.swapaxes(s_seq, 0, 1), s_last[:, None]], axis=1
+                )  # [B, k+1]
+                tlogits = tlogits + jnp.where(
+                    allow_x[s_seq], 0.0, -1e30
+                )
 
             if sampled:
                 # Accept tests at positions 1..k: u < p(d)/q(d), in log
@@ -307,18 +374,25 @@ def _spec_fn(target_cfg: TransformerConfig, draft_cfg: TransformerConfig,
             )
             out = jax.lax.dynamic_update_slice(out, cand, (0, n))
 
+            if program is not None:
+                # New carried state: after the batch-min accepted
+                # prefix (s_seq[:, m]) advanced through each row's own
+                # next pend — always legal: resample/bonus/correction
+                # all drew from mask-added rows.
+                st = next_x[s_seq[:, m], nxt_pend.astype(jnp.int32)]
+
             # Rollback: true fed prefix grew by pend + accepted proposals.
             tcache = set_cache_index(tcache, t_idx + 1 + m)
             dcache = set_cache_index(dcache, d_idx + 1 + m)
-            return (tcache, dcache, out, n + 1 + m, nxt_pend,
+            return (tcache, dcache, out, n + 1 + m, nxt_pend, st,
                     rounds + 1, rng)
 
         def cond(state):
             return state[3] < num_steps
 
         state = (tcache, dcache, out0, jnp.asarray(1, jnp.int32), pend,
-                 jnp.asarray(0, jnp.int32), rng)
-        _, _, out, _, _, rounds, _ = jax.lax.while_loop(
+                 st0, jnp.asarray(0, jnp.int32), rng)
+        _, _, out, _, _, _, rounds, _ = jax.lax.while_loop(
             cond, round_body, state
         )
         return out[:, :num_steps], rounds

@@ -166,6 +166,12 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # attribution group, so the next UP window arbitrates both block-
 # geometry questions — this retune and the paged kernel's chunk size —
 # from one stage sequence.
+# Re-checked (PR 19, 2026-08-07): unchanged — window_r05 remains the
+# newest window (no stamp newer than 082804 / 091000_hostlocal, and
+# neither carries probe_qblock or probe_kvblock arbitration output).
+# Trigger stays OPEN; cap stays 1024; the qblock+kvblock stage pair
+# keeps its front slot in window_autorun's unmeasured set for the
+# next hardware window.
 MAX_Q_BLOCK = 1024
 
 

@@ -578,6 +578,35 @@ SERVE_KV_TIER_SPILLS = REGISTRY.counter(
     "KV tier when their last pool holder freed (retention reclaim, "
     "retire, CoW source release) instead of vanishing",
 )
+SERVE_CONSTRAINED_REQUESTS = REGISTRY.counter(
+    "tpu_serve_constrained_requests_total",
+    "Requests admitted with a compiled constraint program, by spec kind "
+    "(json_schema/regex/choices) — unconstrained traffic never touches "
+    "this counter (docs/constrained-decoding.md)",
+    ("kind",),
+)
+SERVE_CONSTRAINED_STOPS = REGISTRY.counter(
+    "tpu_serve_constrained_stops_total",
+    "Completions finished by the host-side stop machinery, by reason "
+    "(stop_sequence: a multi-token stop matched and the tail was "
+    "trimmed; grammar_complete: the constraint DFA reached a state "
+    "with nothing left to emit and the slot retired)",
+    ("reason",),
+)
+SERVE_CONSTRAIN_PROGRAMS = REGISTRY.gauge(
+    "tpu_serve_constrain_programs",
+    "Compiled constraint programs resident in the device-side paged "
+    "constraint pool (row ranges of the batch-wide allow/next tables); "
+    "refcount-0 residents are reuse candidates, not leaks",
+)
+SERVE_CONSTRAIN_EVICTIONS = REGISTRY.counter(
+    "tpu_serve_constrain_evictions_total",
+    "Constraint-program evictions by tier (cache: host LRU of compiled "
+    "DFAs outgrew its bound; pool: a refcount-0 resident gave up its "
+    "device rows to an incoming bind) — steady growth under a stable "
+    "program set means the cache/pool knobs are undersized",
+    ("tier",),
+)
 
 # -- fleet serving (tf_operator_tpu/fleet/): TPUServe membership, the
 # occupancy-aware router, and queue-depth autoscaling -----------------------

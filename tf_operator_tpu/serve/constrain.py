@@ -1,0 +1,1098 @@
+"""Structured & constrained decoding: the host-side constraint
+compiler and the paged constraint pool (ISSUE 19, ROADMAP item 6).
+
+A per-request spec — ``{"json_schema": …}`` / ``{"regex": …}`` /
+``{"choices": […]}`` / ``{"stop": […]}`` — compiles into a token-level
+DFA over the model vocabulary:
+
+- ``regex`` goes regex → Thompson NFA → subset-construction DFA over
+  the VOCAB CHARSET (only characters that appear in some token string
+  can ever be generated, so the alphabet is exactly that set), then a
+  tokenizer-closure pass walks every vocab token's string through the
+  char DFA to produce token-level ``allow``/``next`` tables.
+- ``json_schema`` compiles a schema-driven grammar to a regex over
+  CANONICAL JSON (no whitespace, fixed property order) and rides the
+  same pipeline — everything stays regular, so the whole constraint is
+  one finite automaton, never a pushdown interpreter on the hot path.
+- ``choices`` build a character trie directly (states are prefixes of
+  the allowed literals) and close over the tokenizer the same way.
+- ``stop`` sequences are NOT a DFA concern: they compile to token-id
+  sequences matched host-side at delivery with a bounded tail buffer
+  (:func:`match_stop` / :func:`apply_stop`), trimmed exactly like the
+  post-hoc solo semantics.
+
+Two liveness prunes keep generation from ever dead-ending: char-level
+states that cannot reach an accept state are dropped during subset
+construction, and after the tokenizer closure a token-level prune
+removes transitions into states from which no TOKEN path reaches an
+accept state (a char path may exist that no whole token realizes).
+After both, every reachable state either extends toward an accept
+state or is ``complete`` — accepting with nothing left to emit — and
+the scheduler retires the slot there. When the request carries an
+``eos_id`` the compiler additionally allows eos at every accepting
+state, so open-ended grammars (``[0-9]+``) terminate naturally.
+
+The result is a :class:`CompiledProgram`: fixed-shape numpy tables
+``allow [n_states, vocab] bool`` and ``next [n_states, vocab] int32``
+plus ``accept``/``complete`` flags, keyed by a digest of (spec, eos,
+vocab). :class:`ConstraintCompiler` caches programs LRU by that digest
+and raises the typed :class:`~tf_operator_tpu.serve.resilience.InvalidGrammar`
+(a 400) on malformed/unsupported/unsatisfiable specs — it runs OFF the
+device lock (scheduler enqueue, HTTP threads), so compile latency never
+stalls decode.
+
+On the device side :class:`ProgramPool` materializes programs into a
+paged constraint pool: ONE ``allow_pool [rows, vocab] bool`` and one
+``next_pool [rows, vocab] int32`` (absolute row indices), row 0 the
+always-allow garbage program (mask all-pass, next always 0) so
+unconstrained lanes pay one gather and zero branches. Per-slot FSM
+state is then just an int32 row index riding the compiled decode step
+as DATA — the same constraints-as-data discipline as temperature/top_p
+(PR 5) and the spec-accept counters (PR 15) — so constrained and
+unconstrained slots mix freely with zero decode recompiles. Programs
+occupy contiguous row ranges with refcounts; refcount-0 programs evict
+LRU when the pool is full (``tpu_serve_constrain_evictions_total``),
+and the resident count is the ``tpu_serve_constrain_programs`` gauge.
+
+The additive mask is materialized IN-STEP as
+``logits + where(allow_row, 0.0, -1e30)`` (the ``_nucleus_filter``
+fill convention): storing the pool as bool instead of f32 costs one
+``where`` per step and divides pool HBM by 4, and ``x + 0.0`` keeps
+unconstrained lanes bitwise on their solo law (argmax and categorical
+are invariant to the +0.0).
+
+:func:`constrained_generate` is the solo oracle: ``generate``'s exact
+prefill + lax.scan loop with the mask add and FSM advance inserted at
+the same op positions as the engine's ``_sample_token``, so a
+constrained slot pins bit-identical against it the same way free slots
+pin against ``generate`` (tests/test_serve_constrain.py). The
+speculative composition oracle lives in models/spec_decode.py
+(``speculative_generate(..., program=)``): the draft walks the FSM to
+mask its proposals, verify re-masks the target chunk rows with the
+same state chain, and a mask violation is just a rejection — the PR 15
+rewind machinery is unchanged.
+
+See docs/constrained-decoding.md for the memory math, the spec-decode
+composition table, and the stop/logprobs/n-best response semantics.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+from collections.abc import Sequence
+from typing import Any
+
+import numpy as np
+
+from tf_operator_tpu.runtime.metrics import (
+    SERVE_CONSTRAIN_EVICTIONS,
+    SERVE_CONSTRAIN_PROGRAMS,
+)
+from tf_operator_tpu.serve.resilience import InvalidGrammar
+
+# The additive-mask fill, matching _nucleus_filter's: large enough that
+# softmax/argmax can never resurrect a masked token, finite so f32
+# arithmetic (logsumexp shifts, temperature division) stays NaN-free.
+NEG_MASK = -1e30
+
+# Compile-budget caps: a DFA past these is a client error (typed 400),
+# not an OOM — the pool rows are the real resource.
+MAX_DFA_STATES = 512
+MAX_REPEAT = 64
+
+
+# ---------------------------------------------------------------------------
+# regex → NFA (Thompson construction over the vocab charset)
+# ---------------------------------------------------------------------------
+
+_ESCAPE_CLASSES = {
+    "d": "0123456789",
+    "w": ("ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+          "abcdefghijklmnopqrstuvwxyz0123456789_"),
+    "s": " \t\n\r",
+}
+
+
+class _Nfa:
+    """Mutable Thompson NFA: per-state char→{states} plus ε-edges."""
+
+    def __init__(self) -> None:
+        self.chars: list[dict[str, set[int]]] = []
+        self.eps: list[set[int]] = []
+
+    def state(self) -> int:
+        self.chars.append({})
+        self.eps.append(set())
+        return len(self.chars) - 1
+
+    def edge(self, a: int, ch: str, b: int) -> None:
+        self.chars[a].setdefault(ch, set()).add(b)
+
+    def eedge(self, a: int, b: int) -> None:
+        self.eps[a].add(b)
+
+
+class _RegexParser:
+    """Recursive-descent parser for the supported regex subset:
+    literals, ``.``, escapes (incl. ``\\d \\w \\s``), ``[...]`` classes
+    with ranges and negation, grouping ``( )``, alternation ``|``, and
+    the quantifiers ``* + ? {m} {m,} {m,n}`` (bounded expansion). The
+    AST is tuples; compilation resolves classes against the vocab
+    alphabet (chars outside it can never be generated, so they simply
+    have no edges)."""
+
+    def __init__(self, pattern: str) -> None:
+        self.p = pattern
+        self.i = 0
+
+    def fail(self, why: str) -> "InvalidGrammar":
+        return InvalidGrammar(
+            f"regex error at offset {self.i}: {why} (pattern {self.p!r})"
+        )
+
+    def peek(self) -> str | None:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def take(self) -> str:
+        if self.i >= len(self.p):
+            raise self.fail("unexpected end of pattern")
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def parse(self):
+        node = self.alt()
+        if self.i != len(self.p):
+            raise self.fail(f"unexpected {self.p[self.i]!r}")
+        return node
+
+    def alt(self):
+        branches = [self.concat()]
+        while self.peek() == "|":
+            self.take()
+            branches.append(self.concat())
+        return ("alt", branches) if len(branches) > 1 else branches[0]
+
+    def concat(self):
+        parts = []
+        while self.peek() not in (None, "|", ")"):
+            parts.append(self.repeat())
+        if not parts:
+            return ("empty",)
+        return ("cat", parts) if len(parts) > 1 else parts[0]
+
+    def repeat(self):
+        node = self.atom()
+        while self.peek() in ("*", "+", "?", "{"):
+            op = self.take()
+            if op == "*":
+                node = ("rep", node, 0, None)
+            elif op == "+":
+                node = ("rep", node, 1, None)
+            elif op == "?":
+                node = ("rep", node, 0, 1)
+            else:
+                node = ("rep", node, *self._bounds())
+        return node
+
+    def _bounds(self) -> tuple[int, int | None]:
+        digits = ""
+        while (c := self.peek()) is not None and c.isdigit():
+            digits += self.take()
+        if not digits:
+            raise self.fail("expected digits in {m,n}")
+        lo = int(digits)
+        hi: int | None = lo
+        if self.peek() == ",":
+            self.take()
+            digits = ""
+            while (c := self.peek()) is not None and c.isdigit():
+                digits += self.take()
+            hi = int(digits) if digits else None
+        if self.take() != "}":
+            raise self.fail("unterminated {m,n}")
+        if hi is not None and hi < lo:
+            raise self.fail(f"bad repeat bounds {{{lo},{hi}}}")
+        if lo > MAX_REPEAT or (hi or 0) > MAX_REPEAT:
+            raise self.fail(f"repeat bound exceeds {MAX_REPEAT}")
+        return lo, hi
+
+    def atom(self):
+        ch = self.take()
+        if ch == "(":
+            node = self.alt()
+            if self.peek() != ")":
+                raise self.fail("unterminated group")
+            self.take()
+            return node
+        if ch == "[":
+            return self._char_class()
+        if ch == ".":
+            return ("any",)
+        if ch == "\\":
+            return self._escape(in_class=False)
+        if ch in "*+?{":
+            raise self.fail(f"quantifier {ch!r} with nothing to repeat")
+        return ("lit", ch)
+
+    def _escape(self, *, in_class: bool):
+        ch = self.take()
+        if ch in _ESCAPE_CLASSES:
+            return ("class", frozenset(_ESCAPE_CLASSES[ch]), False)
+        if ch == "n":
+            return ("lit", "\n")
+        if ch == "t":
+            return ("lit", "\t")
+        if ch == "r":
+            return ("lit", "\r")
+        # Everything else escapes to its literal self (\. \\ \[ \" …).
+        return ("lit", ch)
+
+    def _char_class(self):
+        negated = False
+        if self.peek() == "^":
+            self.take()
+            negated = True
+        chars: set[str] = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise self.fail("unterminated character class")
+            if c == "]" and not first:
+                self.take()
+                break
+            first = False
+            c = self.take()
+            if c == "\\":
+                sub = self._escape(in_class=True)
+                if sub[0] == "class":
+                    chars |= set(sub[1])
+                    continue
+                c = sub[1]
+            if self.peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self.take()
+                hi = self.take()
+                if hi == "\\":
+                    hi = self._escape(in_class=True)[1]
+                if ord(hi) < ord(c):
+                    raise self.fail(f"bad class range {c}-{hi}")
+                chars |= {chr(o) for o in range(ord(c), ord(hi) + 1)}
+            else:
+                chars.add(c)
+        return ("class", frozenset(chars), negated)
+
+
+def _nfa_compile(node, nfa: _Nfa, alphabet: Sequence[str]) -> tuple[int, int]:
+    """Thompson-construct ``node`` into ``nfa``; returns (start, end).
+    Classes/``.``/negations resolve against ``alphabet`` — the vocab
+    charset — here, so the DFA never carries unreachable characters."""
+    kind = node[0]
+    if kind == "empty":
+        s = nfa.state()
+        return s, s
+    if kind == "lit":
+        a, b = nfa.state(), nfa.state()
+        nfa.edge(a, node[1], b)
+        return a, b
+    if kind == "any":
+        a, b = nfa.state(), nfa.state()
+        for ch in alphabet:
+            if ch != "\n":
+                nfa.edge(a, ch, b)
+        return a, b
+    if kind == "class":
+        _, chars, negated = node
+        a, b = nfa.state(), nfa.state()
+        for ch in alphabet:
+            if (ch in chars) != negated:
+                nfa.edge(a, ch, b)
+        return a, b
+    if kind == "alt":
+        a, b = nfa.state(), nfa.state()
+        for br in node[1]:
+            s, e = _nfa_compile(br, nfa, alphabet)
+            nfa.eedge(a, s)
+            nfa.eedge(e, b)
+        return a, b
+    if kind == "cat":
+        start = prev = None
+        for part in node[1]:
+            s, e = _nfa_compile(part, nfa, alphabet)
+            if start is None:
+                start = s
+            else:
+                nfa.eedge(prev, s)
+            prev = e
+        return start, prev
+    if kind == "rep":
+        _, inner, lo, hi = node
+        start = prev = nfa.state()
+        for _ in range(lo):
+            s, e = _nfa_compile(inner, nfa, alphabet)
+            nfa.eedge(prev, s)
+            prev = e
+        if hi is None:
+            # Kleene tail: loop the inner once-or-more, skippable.
+            s, e = _nfa_compile(inner, nfa, alphabet)
+            nfa.eedge(prev, s)
+            nfa.eedge(e, s)
+            end = nfa.state()
+            nfa.eedge(prev, end)
+            nfa.eedge(e, end)
+            return start, end
+        end = nfa.state()
+        nfa.eedge(prev, end)
+        for _ in range(hi - lo):
+            s, e = _nfa_compile(inner, nfa, alphabet)
+            nfa.eedge(prev, s)
+            prev = e
+            nfa.eedge(prev, end)
+        return start, end
+    raise InvalidGrammar(f"unsupported regex node {kind!r}")
+
+
+def _eps_closure(nfa: _Nfa, states: frozenset[int]) -> frozenset[int]:
+    out = set(states)
+    stack = list(states)
+    while stack:
+        for nxt in nfa.eps[stack.pop()]:
+            if nxt not in out:
+                out.add(nxt)
+                stack.append(nxt)
+    return frozenset(out)
+
+
+def _char_dfa(pattern: str, alphabet: Sequence[str],
+              max_states: int) -> tuple[list[dict[str, int]], list[bool]]:
+    """regex → char-level DFA over ``alphabet`` (subset construction),
+    with dead (accept-unreachable) states pruned. Returns
+    (transitions, accept); state 0 is the start."""
+    ast = _RegexParser(pattern).parse()
+    nfa = _Nfa()
+    start, end = _nfa_compile(ast, nfa, alphabet)
+    start_set = _eps_closure(nfa, frozenset((start,)))
+    index = {start_set: 0}
+    order = [start_set]
+    trans: list[dict[str, int]] = [{}]
+    todo = [start_set]
+    while todo:
+        cur = todo.pop()
+        ci = index[cur]
+        for ch in alphabet:
+            nxt = set()
+            for st in cur:
+                nxt |= nfa.chars[st].get(ch, set())
+            if not nxt:
+                continue
+            closed = _eps_closure(nfa, frozenset(nxt))
+            if closed not in index:
+                if len(index) >= max_states:
+                    raise InvalidGrammar(
+                        f"constraint DFA exceeds {max_states} states — "
+                        "simplify the pattern or bound its repeats"
+                    )
+                index[closed] = len(order)
+                order.append(closed)
+                trans.append({})
+                todo.append(closed)
+            trans[ci][ch] = index[closed]
+    accept = [end in st for st in order]
+    return _prune_char_dead(trans, accept)
+
+
+def _prune_char_dead(
+    trans: list[dict[str, int]], accept: list[bool],
+) -> tuple[list[dict[str, int]], list[bool]]:
+    """Drop states that cannot reach an accept state (reverse BFS), so
+    the token closure never offers a char path that strands generation."""
+    n = len(trans)
+    rev: list[set[int]] = [set() for _ in range(n)]
+    for s, edges in enumerate(trans):
+        for d in edges.values():
+            rev[d].add(s)
+    live = {s for s in range(n) if accept[s]}
+    stack = list(live)
+    while stack:
+        for p in rev[stack.pop()]:
+            if p not in live:
+                live.add(p)
+                stack.append(p)
+    if 0 not in live:
+        raise InvalidGrammar(
+            "constraint matches nothing expressible with this vocabulary"
+        )
+    remap = {old: new for new, old in enumerate(sorted(live))}
+    out_trans = [
+        {ch: remap[d] for ch, d in trans[old].items() if d in live}
+        for old in sorted(live)
+    ]
+    out_accept = [accept[old] for old in sorted(live)]
+    return out_trans, out_accept
+
+
+def _choices_dfa(
+    choices: Sequence[str],
+) -> tuple[list[dict[str, int]], list[bool]]:
+    """Character trie of the literal choices — states are prefixes.
+    Equivalent to the DFA of an escaped alternation, built directly."""
+    if not choices:
+        raise InvalidGrammar("choices must be a non-empty list of strings")
+    trans: list[dict[str, int]] = [{}]
+    accept = [False]
+    for c in choices:
+        if not isinstance(c, str) or not c:
+            raise InvalidGrammar(
+                f"choices entries must be non-empty strings, got {c!r}"
+            )
+        cur = 0
+        for ch in c:
+            nxt = trans[cur].get(ch)
+            if nxt is None:
+                trans.append({})
+                accept.append(False)
+                nxt = len(trans) - 1
+                trans[cur][ch] = nxt
+            cur = nxt
+        accept[cur] = True
+    return trans, accept
+
+
+# ---------------------------------------------------------------------------
+# JSON schema → regex (canonical JSON, everything regular)
+# ---------------------------------------------------------------------------
+
+_REGEX_META = set("\\^$.|?*+()[]{}")
+
+
+def regex_escape(text: str) -> str:
+    return "".join(("\\" + c) if c in _REGEX_META else c for c in text)
+
+
+# Canonical string body charset: the vocab minus the quote, backslash,
+# and ALL control characters below 0x20 (RFC 8259 says those MUST be
+# escaped inside a JSON string — excluding them outright means no
+# escape sequences, which keeps the automaton small and every emitted
+# string loads with strict json.loads unchanged). The controls are
+# spelled as literal characters: the grammar parser has no \xNN escape.
+_JSON_STRING_CLASS = '[^"\\\\' + "".join(map(chr, range(0x20))) + "]"
+_JSON_INT = r"-?(0|[1-9][0-9]*)"
+_JSON_NUMBER = _JSON_INT + r"(\.[0-9]+)?"
+
+
+def schema_to_regex(schema: Any, *, depth: int = 0) -> str:
+    """Compile the supported json_schema subset to a regex over
+    CANONICAL JSON (``json.dumps(..., separators=(',', ':'))`` — no
+    whitespace, properties in declared order). Supported: ``object``
+    (properties emitted in declared order; ``required`` defaults to all),
+    ``string`` (``minLength``/``maxLength``/``pattern``), ``integer``,
+    ``number``, ``boolean``, ``null``, ``enum``/``const``, ``array``
+    (``items`` + ``minItems``/``maxItems``). Anything else is a typed
+    ``invalid_grammar``."""
+    if depth > 8:
+        raise InvalidGrammar("json_schema nests deeper than 8 levels")
+    if not isinstance(schema, dict):
+        raise InvalidGrammar(f"json_schema must be an object, got {schema!r}")
+    if "const" in schema:
+        return regex_escape(
+            json.dumps(schema["const"], separators=(",", ":"))
+        )
+    if "enum" in schema:
+        vals = schema["enum"]
+        if not isinstance(vals, list) or not vals:
+            raise InvalidGrammar("enum must be a non-empty list")
+        return "(" + "|".join(
+            regex_escape(json.dumps(v, separators=(",", ":")))
+            for v in vals
+        ) + ")"
+    t = schema.get("type")
+    if t == "object":
+        props = schema.get("properties") or {}
+        if not isinstance(props, dict) or not props:
+            raise InvalidGrammar(
+                "object schemas need non-empty 'properties'"
+            )
+        required = schema.get("required")
+        keep = (props if required is None
+                else {k: v for k, v in props.items() if k in required})
+        if not keep:
+            raise InvalidGrammar("object schema with no required property")
+        body = ",".join(
+            regex_escape(json.dumps(k) + ":") + schema_to_regex(
+                v, depth=depth + 1
+            )
+            for k, v in keep.items()
+        )
+        return r"\{" + body + r"\}"
+    if t == "string":
+        lo = int(schema.get("minLength", 0))
+        hi = schema.get("maxLength")
+        if schema.get("pattern") is not None:
+            return '"' + str(schema["pattern"]) + '"'
+        if hi is None:
+            body = _JSON_STRING_CLASS + (f"{{{lo},}}" if lo else "*")
+        else:
+            body = _JSON_STRING_CLASS + f"{{{lo},{int(hi)}}}"
+        return '"' + body + '"'
+    if t == "integer":
+        return _JSON_INT
+    if t == "number":
+        return _JSON_NUMBER
+    if t == "boolean":
+        return "(true|false)"
+    if t == "null":
+        return "null"
+    if t == "array":
+        item = schema_to_regex(schema.get("items") or {"type": "integer"},
+                               depth=depth + 1)
+        lo = int(schema.get("minItems", 0))
+        hi = schema.get("maxItems")
+        item = "(" + item + ")"
+        if lo == 0:
+            inner = (f"({item}(,{item})*)?" if hi is None
+                     else f"({item}(,{item}){{0,{max(0, int(hi) - 1)}}})?")
+        else:
+            tail = (f"(,{item})*" if hi is None
+                    else f"(,{item}){{{lo - 1},{max(0, int(hi) - 1)}}}")
+            inner = item + tail
+        return r"\[" + inner + r"\]"
+    raise InvalidGrammar(f"unsupported json_schema type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# tokenizer closure → CompiledProgram
+# ---------------------------------------------------------------------------
+
+class CompiledProgram:
+    """One constraint compiled to token-level tables (host numpy; the
+    :class:`ProgramPool` materializes them on device):
+
+    - ``allow [n_states, vocab] bool`` — token legal from this state
+    - ``next  [n_states, vocab] int32`` — LOCAL successor state (0 where
+      disallowed — never followed, the mask forbids it first)
+    - ``accept [n_states] bool`` — the emitted-so-far text matches
+    - ``complete [n_states] bool`` — accepting with no way to extend:
+      the scheduler retires the slot here (finish_reason
+      ``grammar_complete``)
+
+    State 0 is the start. ``digest`` keys the LRU caches (spec + eos +
+    vocab fingerprint)."""
+
+    def __init__(self, *, allow: np.ndarray, nxt: np.ndarray,
+                 accept: np.ndarray, complete: np.ndarray, digest: str,
+                 kind: str, spec: Any) -> None:
+        self.allow = allow
+        self.next = nxt
+        self.accept = accept
+        self.complete = complete
+        self.digest = digest
+        self.kind = kind
+        self.spec = spec
+        self.n_states = int(allow.shape[0])
+
+    def walk(self, state: int, token: int) -> int:
+        """Host-side FSM advance for ONE delivered token (the scheduler
+        re-derives per-request state from emitted tokens — replay after
+        a crash reconstructs it for free)."""
+        return int(self.next[state, token])
+
+    def describe(self) -> dict:
+        return {"kind": self.kind, "digest": self.digest[:12],
+                "n_states": self.n_states}
+
+
+def _token_closure(
+    trans: list[dict[str, int]], accept: list[bool],
+    vocab: Sequence[str], eos_id: int | None,
+) -> CompiledProgram:
+    """Walk every vocab token's string through the char DFA from every
+    state → token-level ``allow``/``next``; then prune token-level-dead
+    transitions (a char path no whole token realizes) so generation can
+    always either extend or finish."""
+    n, v = len(trans), len(vocab)
+    allow = np.zeros((n, v), np.bool_)
+    nxt = np.zeros((n, v), np.int32)
+    for tid, text in enumerate(vocab):
+        if not text:
+            continue  # empty tokens would advance nothing, forever
+        for s in range(n):
+            cur = s
+            for ch in text:
+                cur = trans[cur].get(ch, -1)
+                if cur < 0:
+                    break
+            if cur >= 0:
+                allow[s, tid] = True
+                nxt[s, tid] = cur
+    acc = np.asarray(accept, np.bool_)
+    # Token-level liveness: a state must reach an accept state via
+    # TOKEN edges (or be accepting itself); edges into token-dead
+    # states are removed. One pass suffices: surviving states keep the
+    # very edge that made them live.
+    live = set(np.flatnonzero(acc).tolist())
+    changed = True
+    while changed:
+        changed = False
+        for s in range(n):
+            if s in live:
+                continue
+            dests = nxt[s][allow[s]]
+            if any(int(d) in live for d in dests):
+                live.add(s)
+                changed = True
+    if 0 not in live:
+        raise InvalidGrammar(
+            "constraint cannot be completed with this vocabulary"
+        )
+    for s in range(n):
+        for tid in np.flatnonzero(allow[s]):
+            if int(nxt[s, tid]) not in live:
+                allow[s, tid] = False
+                nxt[s, tid] = 0
+    if eos_id is not None and 0 <= eos_id < v:
+        # eos is legal exactly at accepting states (and self-loops —
+        # the scheduler retires on it before another step runs).
+        allow[:, eos_id] = acc
+        nxt[:, eos_id] = np.where(acc, np.arange(n), 0)
+    # complete = accepting with no non-eos continuation: retire here.
+    cont = allow.copy()
+    if eos_id is not None and 0 <= eos_id < v:
+        cont[:, eos_id] = False
+    complete = acc & ~cont.any(axis=1)
+    return CompiledProgram(
+        allow=allow, nxt=nxt, accept=acc, complete=complete,
+        digest="", kind="", spec=None,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the compiler (LRU, off the device lock)
+# ---------------------------------------------------------------------------
+
+_SPEC_KINDS = ("json_schema", "regex", "choices")
+
+
+def default_vocab(vocab_size: int) -> list[str]:
+    """Token id → string for toy/byte models: identity ``chr(i)`` — the
+    mapping serve_lm and the tests use when no tokenizer exists. Real
+    deployments pass their tokenizer's id→piece table instead."""
+    return [chr(i) for i in range(vocab_size)]
+
+
+def detokenize(vocab: Sequence[str], ids: Sequence[int]) -> str:
+    return "".join(vocab[int(i)] for i in ids)
+
+
+class ConstraintCompiler:
+    """spec dict → :class:`CompiledProgram`, LRU-cached by digest.
+
+    Thread-safe and device-free: the scheduler calls :meth:`compile`
+    at ENQUEUE time on HTTP threads, off the device lock, so a cold
+    compile costs queue latency only. All failures raise the typed
+    :class:`InvalidGrammar` (400, not retryable)."""
+
+    def __init__(self, vocab: Sequence[str], *,
+                 max_states: int = MAX_DFA_STATES,
+                 cache_programs: int = 64) -> None:
+        self.vocab = [str(t) for t in vocab]
+        self.max_states = int(max_states)
+        self.cache_programs = max(1, int(cache_programs))
+        self.alphabet = sorted({ch for t in self.vocab for ch in t})
+        self._fingerprint = hashlib.sha1(
+            "\x00".join(self.vocab).encode()
+        ).hexdigest()[:16]
+        # Single-char reverse map for stop-string encoding (first id
+        # wins, matching detokenize round-trips for identity vocabs).
+        self._char_token: dict[str, int] = {}
+        for tid, t in enumerate(self.vocab):
+            if len(t) == 1 and t not in self._char_token:
+                self._char_token[t] = tid
+        self._lock = threading.Lock()
+        self._cache: OrderedDict[str, CompiledProgram] = OrderedDict()
+        self.compiles = 0
+        self.cache_hits = 0
+
+    def digest_of(self, spec: Any, eos_id: int | None) -> str:
+        blob = json.dumps({"spec": spec, "eos": eos_id,
+                           "vocab": self._fingerprint},
+                          sort_keys=True, default=str)
+        return hashlib.sha1(blob.encode()).hexdigest()
+
+    def compile(self, spec: dict, *,
+                eos_id: int | None = None) -> CompiledProgram:
+        if not isinstance(spec, dict):
+            raise InvalidGrammar(
+                f"constraint spec must be an object, got {type(spec).__name__}"
+            )
+        kinds = [k for k in _SPEC_KINDS if spec.get(k) is not None]
+        if len(kinds) != 1:
+            raise InvalidGrammar(
+                "constraint spec needs exactly one of "
+                f"{'/'.join(_SPEC_KINDS)}, got {kinds or 'none'}"
+            )
+        kind = kinds[0]
+        digest = self.digest_of({kind: spec[kind]}, eos_id)
+        with self._lock:
+            prog = self._cache.get(digest)
+            if prog is not None:
+                self._cache.move_to_end(digest)
+                self.cache_hits += 1
+                return prog
+        prog = self._compile_cold(kind, spec[kind], eos_id, digest)
+        with self._lock:
+            self.compiles += 1
+            self._cache[digest] = prog
+            self._cache.move_to_end(digest)
+            while len(self._cache) > self.cache_programs:
+                self._cache.popitem(last=False)
+                SERVE_CONSTRAIN_EVICTIONS.inc(tier="cache")
+        return prog
+
+    def _compile_cold(self, kind: str, body: Any, eos_id: int | None,
+                      digest: str) -> CompiledProgram:
+        if kind == "choices":
+            trans, accept = _choices_dfa(body)
+            if len(trans) > self.max_states:
+                raise InvalidGrammar(
+                    f"choices trie exceeds {self.max_states} states"
+                )
+        else:
+            pattern = (body if kind == "regex"
+                       else schema_to_regex(body))
+            if not isinstance(pattern, str) or not pattern:
+                raise InvalidGrammar("regex must be a non-empty string")
+            trans, accept = _char_dfa(pattern, self.alphabet,
+                                      self.max_states)
+        prog = _token_closure(trans, accept, self.vocab, eos_id)
+        prog.digest = digest
+        prog.kind = kind
+        prog.spec = {kind: body}
+        return prog
+
+    def encode_stop(self, stop: Any) -> tuple[tuple[int, ...], ...]:
+        """Stop entries → token-id sequences: int lists pass through;
+        strings encode char-by-char via the single-char reverse map (the
+        identity-vocab case — real tokenizers pass id lists)."""
+        if stop is None:
+            return ()
+        if not isinstance(stop, (list, tuple)) or not stop:
+            raise InvalidGrammar("stop must be a non-empty list")
+        out = []
+        for entry in stop:
+            if isinstance(entry, str):
+                if not entry:
+                    raise InvalidGrammar("empty stop string")
+                try:
+                    out.append(tuple(self._char_token[c] for c in entry))
+                except KeyError as exc:
+                    raise InvalidGrammar(
+                        f"stop string {entry!r} has no token for "
+                        f"character {exc.args[0]!r}"
+                    ) from None
+            elif isinstance(entry, (list, tuple)) and entry and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    for t in entry):
+                out.append(tuple(int(t) for t in entry))
+            else:
+                raise InvalidGrammar(
+                    f"stop entries must be strings or token-id lists, "
+                    f"got {entry!r}"
+                )
+        return tuple(out)
+
+    def debug(self) -> dict:
+        with self._lock:
+            return {
+                "cached_programs": len(self._cache),
+                "cache_limit": self.cache_programs,
+                "compiles": self.compiles,
+                "cache_hits": self.cache_hits,
+                "alphabet": len(self.alphabet),
+            }
+
+
+# ---------------------------------------------------------------------------
+# stop sequences (host-side, bounded tail buffer)
+# ---------------------------------------------------------------------------
+
+def max_stop_len(stops: Sequence[Sequence[int]]) -> int:
+    return max((len(s) for s in stops), default=0)
+
+
+def match_stop(out: Sequence[int],
+               stops: Sequence[Sequence[int]]) -> int:
+    """Incremental check after each delivered token: does any stop
+    sequence end EXACTLY at the current tail? Returns the matched
+    length (trim that many) or 0. Only the last ``max_stop_len``
+    tokens are examined — the bounded tail buffer."""
+    for s in stops:
+        k = len(s)
+        if k and len(out) >= k and tuple(out[-k:]) == tuple(s):
+            return k
+    return 0
+
+
+def apply_stop(tokens: Sequence[int],
+               stops: Sequence[Sequence[int]]) -> list[int]:
+    """Post-hoc solo semantics: cut at the FIRST position where any
+    stop sequence completes, excluding the stop tokens themselves. The
+    incremental :func:`match_stop` loop produces exactly this — pinned
+    by tests so the two can never drift."""
+    toks = list(tokens)
+    for j in range(len(toks)):
+        for s in stops:
+            k = len(s)
+            if k and j + 1 >= k and tuple(toks[j + 1 - k:j + 1]) == tuple(s):
+                return toks[:j + 1 - k]
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# the paged constraint pool (device tables, programs as row ranges)
+# ---------------------------------------------------------------------------
+
+class ProgramPool:
+    """Fixed-shape device tables every compiled step reads as DATA:
+
+    - ``allow_pool [rows, vocab] bool`` — True = token legal
+    - ``next_pool  [rows, vocab] int32`` — ABSOLUTE successor row
+
+    Row 0 is the always-allow garbage program (all-True mask, next
+    always 0): unconstrained lanes gather row 0, add +0.0, and stay
+    bitwise on their solo law. A program binds into a contiguous row
+    range (its local states offset by the base row) with a refcount;
+    refcount-0 programs stay resident for reuse and evict LRU when a
+    bind needs their rows. All updates are EAGER host-side scatters —
+    the decode step's jit cache never sees them, so the zero-recompile
+    contract holds across arbitrary program churn.
+
+    Single-threaded by design: bind/release run on the scheduler's
+    serving loop (join/retire), exactly like the block allocator."""
+
+    def __init__(self, rows: int, vocab_size: int, *, put=None) -> None:
+        import jax.numpy as jnp
+
+        if rows < 2:
+            raise ValueError(f"constrain_rows={rows} must be >= 2")
+        self.rows = int(rows)
+        self.vocab_size = int(vocab_size)
+        self._put = put if put is not None else (lambda x: x)
+        self.allow_pool = self._put(
+            jnp.ones((self.rows, self.vocab_size), jnp.bool_)
+        )
+        self.next_pool = self._put(
+            jnp.zeros((self.rows, self.vocab_size), jnp.int32)
+        )
+        # digest -> [base, n_states, refs, last_used_tick]
+        self._resident: dict[str, list[int]] = {}
+        self._free: list[tuple[int, int]] = [(1, self.rows - 1)]
+        self._tick = 0
+        self.evictions = 0
+        self.binds = 0
+
+    # -- allocation -----------------------------------------------------
+
+    def _alloc_range(self, n: int) -> int | None:
+        for i, (start, length) in enumerate(self._free):
+            if length >= n:
+                if length == n:
+                    self._free.pop(i)
+                else:
+                    self._free[i] = (start + n, length - n)
+                return start
+        return None
+
+    def _free_range(self, start: int, n: int) -> None:
+        self._free.append((start, n))
+        self._free.sort()
+        merged: list[tuple[int, int]] = []
+        for s, ln in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == s:
+                merged[-1] = (merged[-1][0], merged[-1][1] + ln)
+            else:
+                merged.append((s, ln))
+        self._free = merged
+
+    def _evict_one(self) -> bool:
+        victims = [(ent[3], dig) for dig, ent in self._resident.items()
+                   if ent[2] == 0]
+        if not victims:
+            return False
+        _, dig = min(victims)
+        base, n, _, _ = self._resident.pop(dig)
+        self._free_range(base, n)
+        self.evictions += 1
+        SERVE_CONSTRAIN_EVICTIONS.inc(tier="pool")
+        SERVE_CONSTRAIN_PROGRAMS.set(len(self._resident))
+        return True
+
+    # -- the public surface --------------------------------------------
+
+    def bind(self, prog: CompiledProgram) -> int | None:
+        """Make ``prog`` resident and take a reference; returns its base
+        row (slot fsm row = base + local state), or None when every
+        resident program is still referenced and nothing can evict —
+        the caller requeues, exactly like KV-block exhaustion."""
+        import jax.numpy as jnp
+
+        self._tick += 1
+        ent = self._resident.get(prog.digest)
+        if ent is not None:
+            ent[2] += 1
+            ent[3] = self._tick
+            self.binds += 1
+            return ent[0]
+        n = prog.n_states
+        if n > self.rows - 1:
+            raise InvalidGrammar(
+                f"program needs {n} rows; the constraint pool has "
+                f"{self.rows - 1} (raise constrain_rows)"
+            )
+        base = self._alloc_range(n)
+        while base is None:
+            if not self._evict_one():
+                return None
+            base = self._alloc_range(n)
+        # Absolute successor rows; disallowed entries point at the
+        # garbage row (never followed — the mask forbids the token).
+        nxt_abs = np.where(prog.allow, prog.next.astype(np.int64) + base,
+                           0).astype(np.int32)
+        self.allow_pool = self._put(
+            self.allow_pool.at[base:base + n].set(jnp.asarray(prog.allow))
+        )
+        self.next_pool = self._put(
+            self.next_pool.at[base:base + n].set(jnp.asarray(nxt_abs))
+        )
+        self._resident[prog.digest] = [base, n, 1, self._tick]
+        self.binds += 1
+        SERVE_CONSTRAIN_PROGRAMS.set(len(self._resident))
+        return base
+
+    def release(self, digest: str) -> None:
+        ent = self._resident.get(digest)
+        if ent is not None and ent[2] > 0:
+            ent[2] -= 1
+
+    def debug(self) -> dict:
+        used = sum(ent[1] for ent in self._resident.values())
+        return {
+            "rows": self.rows,
+            "rows_used": used + 1,  # + the garbage row
+            "programs": len(self._resident),
+            "live_refs": sum(ent[2] for ent in self._resident.values()),
+            "evictions": self.evictions,
+            "binds": self.binds,
+        }
+
+
+# ---------------------------------------------------------------------------
+# the solo oracle
+# ---------------------------------------------------------------------------
+
+def constrained_generate(
+    cfg: Any,
+    params: Any,
+    prompt: Any,
+    num_steps: int,
+    *,
+    program: CompiledProgram,
+    temperature: float = 0.0,
+    top_p: float | None = None,
+    rng: Any = None,
+) -> Any:
+    """``generate`` with the constraint walked inline: the bit-identity
+    oracle every constrained engine slot pins against. Per step the
+    logits take the additive mask of the CURRENT state's allow row
+    before temperature/top_p/argmax — the exact op order of the
+    engine's ``_sample_token`` — and the state advances through the
+    sampled token. [1, L] prompts (the per-slot shape); returns
+    [1, num_steps]."""
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        _nucleus_filter,
+        _prefill,
+    )
+
+    if prompt.shape[0] != 1:
+        raise ValueError("constrained_generate serves [1, L] prompts")
+    if prompt.shape[1] + num_steps > cfg.max_seq_len:
+        raise ValueError("prompt + steps exceeds max_seq_len")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    if top_p is not None and temperature <= 0:
+        raise ValueError("top_p requires temperature > 0")
+    from dataclasses import replace
+
+    dcfg = replace(cfg, decode=True, mesh=None, remat=False)
+    model = Transformer(dcfg)
+    # Mirror the engine pool's convention exactly: a disallowed
+    # transition (only reachable once the grammar has COMPLETED and the
+    # masked argmax picks garbage) lands on an always-allow free state —
+    # the pool's row 0 — so engine and oracle agree bitwise for the
+    # whole stream, not just up to completion. The scheduler retires at
+    # completion either way; this keeps the pin unconditional.
+    n_states, vocab = program.allow.shape
+    free = n_states
+    allow_t = jnp.asarray(np.concatenate(
+        [program.allow, np.ones((1, vocab), np.bool_)], axis=0
+    ))
+    next_local = np.where(
+        program.allow, program.next.astype(np.int32), free
+    ).astype(np.int32)
+    next_t = jnp.asarray(np.concatenate(
+        [next_local, np.full((1, vocab), free, np.int32)], axis=0
+    ))
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    temperature = float(temperature)
+    top_p_f = None if top_p is None else float(top_p)
+
+    def run(params, prompt, rng):
+        cache, last_logits = _prefill(model, params, prompt)
+
+        def sample(carry, step_rng):
+            cache, logits, state = carry
+            masked = logits + jnp.where(
+                allow_t[state], 0.0, NEG_MASK
+            )[None, :]
+            if temperature > 0:
+                scaled = masked / temperature
+                if top_p_f is not None:
+                    scaled = _nucleus_filter(scaled, top_p_f)
+                tok = jax.random.categorical(step_rng, scaled)
+            else:
+                tok = masked.argmax(-1)
+            state = next_t[state, tok[0]]
+            logits2, updates = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            return (updates["cache"], logits2[:, 0], state), tok
+
+        (_, _, _), toks = jax.lax.scan(
+            sample, (cache, last_logits, jnp.int32(0)),
+            jax.random.split(rng, num_steps),
+        )
+        return toks.swapaxes(0, 1)
+
+    return jax.jit(run)(params, prompt, rng)
+
+
+def walk_tokens(program: CompiledProgram, tokens: Sequence[int],
+                state: int = 0) -> tuple[int, int | None]:
+    """Walk delivered tokens through the program from ``state``;
+    returns (final state, index AFTER which the grammar completed —
+    None if it never did). The scheduler's trim rule and the tests'
+    expected-output rule share this one walker."""
+    done_at = None
+    for i, tok in enumerate(tokens):
+        state = program.walk(state, int(tok))
+        if done_at is None and bool(program.complete[state]):
+            done_at = i
+    return state, done_at

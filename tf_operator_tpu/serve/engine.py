@@ -196,7 +196,14 @@ def _sample_token(logits1, key1, temp, tp, has_tp):
     recompile. where(greedy, 1, temp) guards the division; the greedy
     lane takes the argmax anyway. THE single sampling construction for
     both the dense (vmapped solo step) and paged (vmapped sampler +
-    batched forward) steps, so their token choices cannot drift."""
+    batched forward) steps, so their token choices cannot drift.
+
+    Constrained decoding feeds MASKED logits here: every step body
+    gathers the slot's constraint row (``allow_pool[fsm]``, row 0 the
+    always-allow garbage program) and adds ``where(allow, 0.0, -1e30)``
+    BEFORE this construction — the exact op position of the solo
+    ``constrained_generate`` oracle, and a bitwise no-op (+0.0) for
+    unconstrained lanes."""
     greedy = temp <= 0
     scaled = logits1 / jnp.where(greedy, 1.0, temp)
     filt = jnp.where(
@@ -264,9 +271,29 @@ class ContinuousEngine:
                  faults: Any = None, mesh: Any = None,
                  tp_axis: str = "tp", spec_k: int = 0,
                  draft_cfg: TransformerConfig | None = None,
-                 draft_params: Any = None) -> None:
+                 draft_params: Any = None,
+                 constrain_rows: int = 128,
+                 logprobs_k: int = 0) -> None:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(f"prefill_chunk={prefill_chunk} must be >= 1")
+        # Per-token logprobs (top-k + the chosen token's, computed from
+        # the masked step logits already in hand). Static at
+        # construction — K shapes the step's extra outputs, so it is a
+        # trace-time branch, NOT per-request data; per-request opt-out
+        # is just the scheduler ignoring the rows. Plain engines only:
+        # a speculative round's accepted tokens reuse draft positions
+        # whose target logits the rewind discards, so there is no
+        # per-emitted-token distribution to report.
+        self.logprobs_k = int(logprobs_k or 0)
+        if self.logprobs_k < 0 or self.logprobs_k > cfg.vocab_size:
+            raise ValueError(
+                f"logprobs_k={logprobs_k} must be in [0, vocab_size]"
+            )
+        if self.logprobs_k and spec_k:
+            raise ValueError(
+                "logprobs_k is not supported with speculative decoding "
+                "(serve it from a plain engine)"
+            )
         # kv_attend selects the paged attend implementation: "gather"
         # (default, the reference oracle) or "pallas" (the block-table
         # kernel, ops/paged_attention.py). Decode-path only — prefill
@@ -476,6 +503,20 @@ class ContinuousEngine:
         self._logits = self._place_logits(jnp.zeros((n, v), jnp.float32))
         self._keys = self._replicate(jnp.zeros((n, s, 2), jnp.uint32))
         self._stepidx = self._replicate(jnp.zeros((n,), jnp.int32))
+        # Structured decoding (serve/constrain.py): the paged constraint
+        # pool — batch-wide allow/next tables the step reads as DATA,
+        # row 0 the always-allow garbage program — plus the per-slot
+        # FSM row vector. Replicated on a mesh (the tables are small:
+        # rows × vocab bytes + rows × vocab × 4); program churn is
+        # eager host-side scatters, so the zero-recompile pin holds.
+        from tf_operator_tpu.serve.constrain import ProgramPool
+
+        self.constrain_pool = ProgramPool(
+            int(constrain_rows), v, put=self._replicate
+        )
+        self._fsm = self._replicate(jnp.zeros((n,), jnp.int32))
+        self._slot_program: dict[int, str] = {}  # slot -> bound digest
+        self._last_logprobs = None  # (chosen, top_vals, top_ids) numpy
         # Host-side per-slot sampling state, passed into every step (tiny
         # [N] transfers; keeping them host-side means join/retire never
         # need a device write for them).
@@ -622,16 +663,21 @@ class ContinuousEngine:
         )
 
         def step(params, cache, logits, keys, stepidx, active,
-                 temperature, top_p, has_top_p):
-            cache, logits, stepidx, toks = inner(
+                 temperature, top_p, has_top_p, allow_pool, next_pool,
+                 fsm):
+            out = inner(
                 params, cache, logits, keys, stepidx, active,
-                temperature, top_p, has_top_p,
+                temperature, top_p, has_top_p, allow_pool, next_pool,
+                fsm,
             )
+            cache, logits, stepidx, toks, fsm2 = out[:5]
             cache = constrain_tree(mesh, cache, specs)
             logits = jax.lax.with_sharding_constraint(logits, lsharding)
-            stepidx = jax.lax.with_sharding_constraint(stepidx, rep)
-            toks = jax.lax.with_sharding_constraint(toks, rep)
-            return cache, logits, stepidx, toks
+            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
+            # fsm + any logprob rows replicate like the other per-slot
+            # counters — host-side joins/retires scatter them eagerly.
+            return (cache, logits, pin(stepidx), pin(toks),
+                    pin(fsm2)) + tuple(pin(x) for x in out[5:])
 
         return step
 
@@ -1233,7 +1279,7 @@ class ContinuousEngine:
 
     def join(self, prompt: jax.Array, *, num_steps: int,
              temperature: float = 0.0, top_p: float | None = None,
-             seed: int = 0) -> int | None:
+             seed: int = 0, program: Any = None) -> int | None:
         """Plan, prefill, and join in one call: returns the slot index,
         or None when capacity (slots or blocks) is unavailable.
         Convenience over the planned API for callers that do not
@@ -1253,19 +1299,26 @@ class ContinuousEngine:
             self.release_plan(plan)
             raise
         return self.join_planned(
-            plan, pf, temperature=temperature, top_p=top_p, seed=seed
+            plan, pf, temperature=temperature, top_p=top_p, seed=seed,
+            program=program,
         )
 
     def join_planned(self, plan: AdmissionPlan,
                      pf: ChunkedPrefill | None = None, *,
                      temperature: float = 0.0,
                      top_p: float | None = None,
-                     seed: int = 0) -> int | None:
+                     seed: int = 0, program: Any = None) -> int | None:
         """Complete a planned admission: collect/run whatever prefill the
         plan still needs, insert into a free slot, and (paged) register
         the prompt's blocks for future sharers. ``pf`` is the
         ChunkedPrefill from ``prefill_planned``, fed to completion by
-        the caller. On any error the plan's reservations are released."""
+        the caller. On any error the plan's reservations are released.
+
+        ``program`` is an optional compiled constraint
+        (serve/constrain.CompiledProgram): its rows bind into the
+        constraint pool here — a bind that cannot fit (every resident
+        program still referenced) releases the plan and returns None,
+        the same requeue contract as block exhaustion."""
         try:
             if pf is not None:
                 cache, logits = pf.result()
@@ -1288,10 +1341,11 @@ class ContinuousEngine:
                 cache, logits, prompt_len=plan.prompt_len,
                 num_steps=plan.num_steps, temperature=temperature,
                 top_p=top_p, seed=seed, prompt=plan.tokens,
+                program=program,
             )
         return self._join_paged(
             plan, cache, logits, temperature=temperature, top_p=top_p,
-            seed=seed,
+            seed=seed, program=program,
         )
 
     def _sampling_state(self, slot: int, num_steps: int,
@@ -1326,7 +1380,8 @@ class ContinuousEngine:
                        temperature: float = 0.0,
                        top_p: float | None = None,
                        seed: int = 0,
-                       prompt: Any = None) -> int | None:
+                       prompt: Any = None,
+                       program: Any = None) -> int | None:
         """Insert a finished solo prefill into a free slot (DENSE layout
         — paged admissions go through the planned API, which knows which
         blocks the rows land in). The slot's first generated token comes
@@ -1345,8 +1400,15 @@ class ContinuousEngine:
                 "(the draft lane prefills the prompt itself)"
             )
         self.validate_request(prompt_len, num_steps)
+        base = None
+        if program is not None:
+            base = self.constrain_pool.bind(program)
+            if base is None:
+                return None  # pool saturated with live programs: requeue
         slot = self.alloc.acquire()
         if slot is None:
+            if program is not None:
+                self.constrain_pool.release(program.digest)
             return None
         try:
             keys = self._sampling_state(
@@ -1354,6 +1416,8 @@ class ContinuousEngine:
             )
         except Exception:
             self.alloc.release(slot)
+            if program is not None:
+                self.constrain_pool.release(program.digest)
             raise
         state = (self._cache, self._logits, self._keys, self._stepidx)
         state = self._insert_slot(state, slot, plain_tree(cache), logits,
@@ -1363,15 +1427,40 @@ class ContinuousEngine:
             self._join_spec_state(
                 slot, prompt, jnp.asarray(logits).reshape(-1),
                 temperature=temperature, top_p=top_p, seed=seed,
+                program=program, base=base,
             )
+        elif program is not None:
+            self._set_fsm(slot, base)
+        if program is not None:
+            self._slot_program[slot] = program.digest
         self._active[slot] = True
         return slot
 
+    def _set_fsm(self, slot: int, row: int) -> None:
+        """Eager per-slot FSM row scatter (join/retire): the same tiny
+        host-dispatched update discipline as the key ladders — the
+        compiled step only ever sees [n] int32 data."""
+        self._fsm = self._replicate(
+            self._fsm.at[slot].set(jnp.int32(row))
+        )
+
     def _join_paged(self, plan: AdmissionPlan, cache: Any | None,
                     logits: jax.Array, *, temperature: float,
-                    top_p: float | None, seed: int) -> int | None:
+                    top_p: float | None, seed: int,
+                    program: Any = None) -> int | None:
+        base = None
+        if program is not None:
+            base = self.constrain_pool.bind(program)
+            if base is None:
+                # Constraint-pool saturation: the same requeue contract
+                # as block exhaustion — release the plan's reservations
+                # and let the scheduler retry once rows free.
+                self.release_plan(plan)
+                return None
         slot = self.alloc.acquire()
         if slot is None:  # single-caller contract makes this unreachable
+            if program is not None:
+                self.constrain_pool.release(program.digest)
             self.release_plan(plan)
             return None
         try:
@@ -1380,6 +1469,8 @@ class ContinuousEngine:
             )
         except Exception:
             self.alloc.release(slot)
+            if program is not None:
+                self.constrain_pool.release(program.digest)
             self.release_plan(plan)
             raise
         read = jnp.asarray(plan.read_table)
@@ -1435,7 +1526,15 @@ class ContinuousEngine:
             self._join_spec_state(
                 slot, plan.tokens, row,
                 temperature=temperature, top_p=top_p, seed=seed,
+                program=program, base=base,
             )
+        elif program is not None:
+            # Prompt tokens are unconstrained: the slot enters at the
+            # program's init state and the mask applies from the first
+            # GENERATED token — the solo oracle's exact convention.
+            self._set_fsm(slot, base)
+        if program is not None:
+            self._slot_program[slot] = program.digest
         self._set_block_gauges()
         return slot
 
@@ -1451,13 +1550,34 @@ class ContinuousEngine:
 
     # -- decode -----------------------------------------------------------
 
+    def _logprob_outputs(self, masked, toks):
+        """Per-token logprob rows when the engine was built with
+        ``logprobs_k`` > 0: the chosen token's logprob plus the top-K
+        (values, ids), all from log_softmax of the MASKED logits — the
+        model's actual distribution (temperature-independent; greedy
+        and sampled slots report the same quantity), with disallowed
+        tokens already at -inf so constrained rows renormalize over
+        the legal set. Empty tuple when K == 0 — the step's output
+        arity is a trace-time property of the engine, not data."""
+        if not self.logprobs_k:
+            return ()
+        lp = jax.nn.log_softmax(masked, axis=-1)
+        chosen = jnp.take_along_axis(lp, toks[:, None], axis=1)[:, 0]
+        top_vals, top_ids = jax.lax.top_k(lp, self.logprobs_k)
+        return (chosen, top_vals, top_ids.astype(jnp.int32))
+
     def _step(self, params, cache, logits, keys, stepidx, active,
-              temperature, top_p, has_top_p):
+              temperature, top_p, has_top_p, allow_pool, next_pool,
+              fsm):
         cache = mask_inactive_indices(cache, active)
         key = keys[
             jnp.arange(self.max_slots),
             jnp.clip(stepidx, 0, self.cfg.max_seq_len - 1),
         ]
+        # The batch-wide constraint gather: one allow row per slot
+        # (row 0 = always-allow), added BEFORE temperature — the solo
+        # constrained_generate op order; +0.0 for unconstrained lanes.
+        masked = logits + jnp.where(allow_pool[fsm], 0.0, -1e30)
 
         def one(cache1, logits1, key1, temp, tp, has_tp):
             tok = _sample_token(logits1, key1, temp, tp, has_tp)
@@ -1468,30 +1588,38 @@ class ContinuousEngine:
             return upd["cache"], nxt[0, 0], tok
 
         cache, logits, toks = jax.vmap(one)(
-            cache, logits, key, temperature, top_p, has_top_p
+            cache, masked, key, temperature, top_p, has_top_p
         )
-        return cache, logits, stepidx + 1, toks
+        fsm2 = next_pool[fsm, toks]
+        return (cache, logits, stepidx + 1, toks, fsm2) \
+            + self._logprob_outputs(masked, toks)
 
     def _step_paged(self, params, cache, logits, keys, stepidx, active,
-                    temperature, top_p, has_top_p):
+                    temperature, top_p, has_top_p, allow_pool,
+                    next_pool, fsm):
         """The paged decode step: the SAME vmapped sampling body as the
         dense step, then ONE batched forward — the pool is shared state
         a vmap lane could not mutate, and the kv_paged attention carries
         per-lane counters/tables itself. Identical per-lane math either
-        way (the bit-exactness pin's whole argument)."""
+        way (the bit-exactness pin's whole argument). The constraint
+        mask/advance ride identically: gather allow rows, add the mask,
+        sample, then ``fsm2 = next_pool[fsm, toks]`` — all data."""
         cache = mask_inactive_indices(cache, active)
         key = keys[
             jnp.arange(self.max_slots),
             jnp.clip(stepidx, 0, self.cfg.max_seq_len - 1),
         ]
+        masked = logits + jnp.where(allow_pool[fsm], 0.0, -1e30)
         toks = jax.vmap(_sample_token)(
-            logits, key, temperature, top_p, has_top_p
+            masked, key, temperature, top_p, has_top_p
         )
+        fsm2 = next_pool[fsm, toks]
         nxt, upd = self._model.apply(
             {"params": params, "cache": cache}, toks[:, None],
             mutable=["cache"],
         )
-        return plain_tree(upd["cache"]), nxt[:, 0], stepidx + 1, toks
+        return (plain_tree(upd["cache"]), nxt[:, 0], stepidx + 1, toks,
+                fsm2) + self._logprob_outputs(masked, toks)
 
     def _run_pending_cows(self) -> None:
         """Execute copy-on-write for every slot about to take its first
@@ -1526,61 +1654,99 @@ class ContinuousEngine:
             self._set_block_gauges()
 
     def _spec_draft_impl(self, dparams, dcache, pend, rng, active,
-                         temperature, top_p, has_top_p):
+                         temperature, top_p, has_top_p, allow_pool,
+                         next_pool, fsm):
         """The DRAFT round executable: per lane, split the rng (solo's
         ``rng, k_draft, k_acc, k_res, k_bonus = split(rng, 5)``
         schedule) and scan k+1 draft steps from the pending token — the
         vmapped solo draft scan, so each lane's proposals are bitwise
         the b=1 solo stream. Returns the advanced draft cache, the
         pre-round per-lane draft indices (the verify pass rewinds from
-        them), the drafted tokens/logits, and the round keys."""
+        them), the drafted tokens/logits, and the round keys.
+
+        Constrained lanes walk the FSM INSIDE the scan: ``fsm`` enters
+        as the state after every emitted token including pend, each
+        proposal samples from mask-added logits at the current state,
+        and the state advances through the proposal — so the emitted
+        qlogits are the MASKED draft distributions, exactly what the
+        verify's accept test must compare against. Unconstrained lanes
+        sit on row 0 (always-allow, next 0): +0.0 and a self-loop,
+        bitwise the solo stream."""
         k = self.spec_k
         dcache = mask_inactive_indices(dcache, active)
         d_idx = _spec_cache_index(dcache)  # [n] per-lane, post-mask
         dmodel = self._draft_model
 
-        def one(dc1, pend1, rng1, temp, tp, has_tp):
+        def one(dc1, pend1, rng1, temp, tp, has_tp, st1):
             rng1, k_draft, k_acc, k_res, k_bonus = jax.random.split(
                 rng1, 5
             )
 
             def dstep(carry, step_key):
-                dc, tok = carry
+                dc, tok, st = carry
                 logits, upd = dmodel.apply(
                     {"params": dparams, "cache": dc}, tok[None, None],
                     mutable=["cache"],
                 )
                 logits = logits[0, 0]
-                nxt = _sample_token(logits, step_key, temp, tp, has_tp)
-                return (upd["cache"], nxt), (nxt, logits)
+                masked = logits + jnp.where(allow_pool[st], 0.0, -1e30)
+                nxt = _sample_token(masked, step_key, temp, tp, has_tp)
+                return (upd["cache"], nxt, next_pool[st, nxt]), \
+                    (nxt, masked)
 
-            (dc1, _), (drafted, qlogits) = jax.lax.scan(
-                dstep, (dc1, pend1), jax.random.split(k_draft, k + 1)
+            (dc1, _, _), (drafted, qlogits) = jax.lax.scan(
+                dstep, (dc1, pend1, st1),
+                jax.random.split(k_draft, k + 1),
             )
             return dc1, drafted, qlogits, rng1, k_acc, k_res, k_bonus
 
         (dcache, drafted, qlogits, rng, k_acc, k_res, k_bonus) = jax.vmap(
             one
-        )(dcache, pend, rng, temperature, top_p, has_top_p)
+        )(dcache, pend, rng, temperature, top_p, has_top_p, fsm)
         return (plain_tree(dcache), d_idx, drafted, qlogits, rng,
                 k_acc, k_res, k_bonus)
 
     def _spec_verify_impl(self, params, cache, dcache, pend, drafted,
                           qlogits, k_acc, k_res, k_bonus, d_idx, active,
-                          temperature, top_p, has_top_p):
+                          temperature, top_p, has_top_p, allow_pool,
+                          next_pool, fsm):
         """The VERIFY round executable: ONE batched k+1-position chunk
         forward of the target over [pend, d_1..d_k] per lane (paged:
         the per-lane-counter multi-token attend; dense: the vmapped
         solo chunk forward), the vmapped per-lane accept/emit body
         (spec_decode.lane_accept_emit), and the per-lane REWIND of both
         caches to idx + 1 + m — accept counts are data, so lanes
-        advancing different amounts never change a shape."""
+        advancing different amounts never change a shape.
+
+        Constraint composition: the draft already walked the FSM, so
+        this pass RE-DERIVES the same per-position state chain
+        (s_0 = fsm, s_j = next[s_{j-1}, d_j]) and adds the mask to the
+        target's chunk logits row-by-row before the UNCHANGED
+        accept/emit body — a proposal the grammar forbids has q = 0
+        AND p = 0 there, so a mask violation is just a rejection and
+        the PR 15 rewind machinery never knows constraints exist. The
+        residual resample and the bonus token draw from masked rows,
+        so the next pend is always legal; the new fsm is the state
+        after the accepted prefix advanced through that pend."""
         k = self.spec_k
         cache = mask_inactive_indices(cache, active)
         t_idx = _spec_cache_index(cache)  # [n] per-lane, post-mask
         chunk = jnp.concatenate(
             [pend[:, None], drafted[:, :k].astype(jnp.int32)], axis=1
         )
+        # Per-position FSM states: s_j is the state the j-th chunk
+        # position's distribution must be masked by (s_0 after pend —
+        # the incoming fsm — then advancing through each proposal).
+        def fsm_walk(s, d):
+            return next_pool[s, d], s
+
+        s_last, s_seq = jax.lax.scan(
+            fsm_walk, fsm,
+            jnp.swapaxes(drafted[:, :k].astype(jnp.int32), 0, 1),
+        )
+        st_seq = jnp.concatenate(
+            [jnp.swapaxes(s_seq, 0, 1), s_last[:, None]], axis=1
+        )  # [n, k+1]
         if self.kv_paged:
             tlogits, upd = self._model.apply(
                 {"params": params, "cache": cache}, chunk,
@@ -1597,6 +1763,7 @@ class ContinuousEngine:
 
             cache, tlogits = jax.vmap(one)(cache, chunk)
             cache = plain_tree(cache)
+        tlogits = tlogits + jnp.where(allow_pool[st_seq], 0.0, -1e30)
         from tf_operator_tpu.models.spec_decode import lane_accept_emit
 
         toks, counts, nxt_pend = jax.vmap(
@@ -1604,6 +1771,13 @@ class ContinuousEngine:
         )(tlogits, qlogits, drafted, pend, k_acc, k_res, k_bonus,
           temperature, top_p, has_top_p)
         counts = jnp.where(active, counts, 0)
+        # New per-lane FSM: the state after the accepted prefix
+        # (st_seq[counts-1] — counts >= 1 on active lanes) advanced
+        # through the next pend; inactive lanes keep their state.
+        s_m = jnp.take_along_axis(
+            st_seq, jnp.clip(counts - 1, 0, k)[:, None], axis=1
+        )[:, 0]
+        fsm2 = jnp.where(active, next_pool[s_m, nxt_pend], fsm)
         # The batch-wide REWIND: set_cache_index per lane (the solo
         # rollback — its walk broadcasts the [n] vector across every
         # counter leaf, all of which are [n] in engine layouts), so
@@ -1616,7 +1790,7 @@ class ContinuousEngine:
             dcache, jnp.where(active, d_idx + counts, 0)
         )
         nxt_pend = jnp.where(active, nxt_pend, pend)
-        return cache, dcache, nxt_pend, toks, counts
+        return cache, dcache, nxt_pend, toks, counts, fsm2
 
     def _constrained_spec_draft(self, inner):
         """Mesh wrapper: pin the draft executable's outputs (draft cache
@@ -1630,10 +1804,11 @@ class ContinuousEngine:
         rep = NamedSharding(mesh, P())
 
         def fn(dparams, dcache, pend, rng, active, temperature, top_p,
-               has_top_p):
+               has_top_p, allow_pool, next_pool, fsm):
             (dcache, d_idx, drafted, qlogits, rng, k_acc, k_res,
              k_bonus) = inner(dparams, dcache, pend, rng, active,
-                              temperature, top_p, has_top_p)
+                              temperature, top_p, has_top_p,
+                              allow_pool, next_pool, fsm)
             dcache = constrain_tree(mesh, dcache, specs)
             pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
             return (dcache, pin(d_idx), pin(drafted), pin(qlogits),
@@ -1651,16 +1826,17 @@ class ContinuousEngine:
 
         def fn(params, cache, dcache, pend, drafted, qlogits, k_acc,
                k_res, k_bonus, d_idx, active, temperature, top_p,
-               has_top_p):
-            cache, dcache, nxt_pend, toks, counts = inner(
+               has_top_p, allow_pool, next_pool, fsm):
+            cache, dcache, nxt_pend, toks, counts, fsm2 = inner(
                 params, cache, dcache, pend, drafted, qlogits, k_acc,
                 k_res, k_bonus, d_idx, active, temperature, top_p,
-                has_top_p,
+                has_top_p, allow_pool, next_pool, fsm,
             )
             cache = constrain_tree(mesh, cache, tspecs)
             dcache = constrain_tree(mesh, dcache, dspecs)
             pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
-            return cache, dcache, pin(nxt_pend), pin(toks), pin(counts)
+            return (cache, dcache, pin(nxt_pend), pin(toks),
+                    pin(counts), pin(fsm2))
 
         return fn
 
@@ -1682,16 +1858,19 @@ class ContinuousEngine:
         temp = jnp.asarray(self._temperature)
         top_p = jnp.asarray(self._top_p)
         has_tp = jnp.asarray(self._has_top_p)
+        allow_pool = self.constrain_pool.allow_pool
+        next_pool = self.constrain_pool.next_pool
         (self._draft_cache, d_idx, drafted, qlogits, self._spec_rng,
          k_acc, k_res, k_bonus) = self._draft_fn(
             self._draft_params, self._draft_cache, self._pend,
             self._spec_rng, active, temp, top_p, has_tp,
+            allow_pool, next_pool, self._fsm,
         )
         (self._cache, self._draft_cache, self._pend, toks,
-         counts) = self._verify_fn(
+         counts, self._fsm) = self._verify_fn(
             self.params, self._cache, self._draft_cache, self._pend,
             drafted, qlogits, k_acc, k_res, k_bonus, d_idx, active,
-            temp, top_p, has_tp,
+            temp, top_p, has_tp, allow_pool, next_pool, self._fsm,
         )
         self.steps_total += 1
         counts_np = np.asarray(counts)
@@ -1707,7 +1886,9 @@ class ContinuousEngine:
 
     def _join_spec_state(self, slot: int, tokens: np.ndarray,
                          logits_row: Any, *, temperature: float,
-                         top_p: float | None, seed: int) -> None:
+                         top_p: float | None, seed: int,
+                         program: Any = None,
+                         base: int | None = None) -> None:
         """Seed one slot's speculative state at join: draft-prefill the
         WHOLE prompt into the slot's draft lane (the draft cache shares
         nothing — an exact-prefix or shipped join skips only the
@@ -1715,7 +1896,11 @@ class ContinuousEngine:
         speculative_generate draws it after prefill: sampled lanes
         split PRNGKey(seed) and draw categorical from the tempered
         (and nucleus-filtered) logits; greedy lanes take the argmax
-        and never consume their rng."""
+        and never consume their rng. With a constraint ``program``
+        (bound at ``base``) the prefill row takes the init state's
+        mask before the draw — pend is the FIRST generated token — and
+        the slot's fsm enters as the state AFTER pend, the invariant
+        every round maintains."""
         if self.prefill_chunk is not None:
             # Fixed-chunk executables (bit-identical to one-shot — the
             # chunked-prefill pin); any prompt length compiles nothing.
@@ -1731,6 +1916,10 @@ class ContinuousEngine:
             self._draft_cache, jnp.int32(slot), plain_tree(dc)
         )
         row = jnp.asarray(logits_row).reshape(1, -1)  # solo's [1, V]
+        if program is not None:
+            row = row + jnp.where(
+                jnp.asarray(program.allow[0]), 0.0, -1e30
+            )
         if temperature > 0:
             rng, k0 = jax.random.split(jax.random.PRNGKey(seed))
             scaled = row / temperature
@@ -1746,6 +1935,12 @@ class ContinuousEngine:
         self._spec_rng = self._replicate(
             self._spec_rng.at[slot].set(rng)
         )
+        if program is not None:
+            # fsm = state AFTER pend (program-local walk from init,
+            # then absolute by the bind base) — row 0 stays the
+            # unconstrained lanes' home.
+            local = int(program.next[0, int(pend)])
+            self._set_fsm(slot, int(base) + local)
 
     def spec_debug(self) -> dict:
         """Speculation telemetry for /debug/serve: emission stats and
@@ -1765,6 +1960,23 @@ class ContinuousEngine:
             ) if lanes else 0.0,
         }
 
+    def constrain_debug(self) -> dict:
+        """Constraint-pool telemetry for /debug/serve: resident
+        programs/rows, live refs, bind/eviction counters, and how many
+        slots currently decode under a program."""
+        out = dict(self.constrain_pool.debug())
+        out["slots_constrained"] = len(self._slot_program)
+        out["logprobs_k"] = self.logprobs_k
+        return out
+
+    def last_logprobs(self):
+        """The most recent step's ``(chosen [n], top_vals [n, K],
+        top_ids [n, K])`` numpy rows — None until a step ran, and only
+        on engines built with ``logprobs_k > 0``. The scheduler reads
+        its slot's row right after the step that produced it (same
+        loop iteration, so the next step cannot have overwritten it)."""
+        return self._last_logprobs
+
     def step(self) -> np.ndarray:
         """One decode iteration over ALL slots: every active slot
         advances one token. Returns the [max_slots] int32 token vector
@@ -1779,12 +1991,18 @@ class ContinuousEngine:
         self.faults.maybe_sleep("step_stall", default=1.0)
         if self.kv_paged:
             self._run_pending_cows()
-        self._cache, self._logits, self._stepidx, toks = self._step_fn(
+        out = self._step_fn(
             self.params, self._cache, self._logits, self._keys,
             self._stepidx, jnp.asarray(self._active),
             jnp.asarray(self._temperature), jnp.asarray(self._top_p),
             jnp.asarray(self._has_top_p),
+            self.constrain_pool.allow_pool, self.constrain_pool.next_pool,
+            self._fsm,
         )
+        (self._cache, self._logits, self._stepidx, toks,
+         self._fsm) = out[:5]
+        if self.logprobs_k:
+            self._last_logprobs = tuple(np.asarray(x) for x in out[5:])
         self.steps_total += 1
         return np.asarray(toks)
 
@@ -1805,6 +2023,13 @@ class ContinuousEngine:
         self._temperature[slot] = 0.0
         self._top_p[slot] = 1.0
         self._has_top_p[slot] = False
+        digest = self._slot_program.pop(slot, None)
+        if digest is not None:
+            # Drop the program reference (rows stay resident for reuse
+            # until an incoming bind needs them) and park the lane back
+            # on the always-allow garbage row.
+            self.constrain_pool.release(digest)
+            self._set_fsm(slot, 0)
         if self.kv_paged:
             st = self._slot_state.pop(slot, None)
             if st is not None:

@@ -16,7 +16,12 @@ catapult document (``QuietHandler.send_serve_traces``); the snapshot's
 The payload carries a ``kv_cache`` section with the block-pool stats
 (paged mode: block size, free/used/shared block counts, CoW copies,
 prefix-cache hits, prefill tokens saved — the same numbers the
-``tpu_serve_kv_*`` metric families export).
+``tpu_serve_kv_*`` metric families export), and a ``constrain``
+section (serve/constrain.py): constraint-pool rows/residency, bind and
+eviction counters, slots currently decoding under a grammar program,
+the engine's ``logprobs_k``, and — when the scheduler owns a
+ConstraintCompiler — its program-LRU stats (compiles/cache_hits),
+mirroring the ``tpu_serve_constrain_*`` families.
 
 Supervised serving (serve/resilience.py) mounts the SUPERVISOR here
 instead of a scheduler — same ``debug_snapshot`` surface, but the

@@ -221,6 +221,21 @@ class TierMiss(ServeError):
     retryable = False
 
 
+class InvalidGrammar(ServeError):
+    """A constrained-decoding spec (``json_schema``/``regex``/
+    ``choices``/``stop``, serve/constrain.py) failed to compile into a
+    token-level DFA: malformed regex, unsupported schema construct, a
+    grammar unsatisfiable with this vocabulary, or a program too large
+    for the state budget. A 400, NOT retryable — the request itself is
+    wrong, so the router must hand the code back to the client rather
+    than burn retries on other replicas (compile is deterministic:
+    every replica would reject it identically)."""
+
+    code = "invalid_grammar"
+    http_status = 400
+    retryable = False
+
+
 # The COMPLETE wire-code vocabulary: every ``code`` a client or the
 # fleet router can see. ServeError subclasses above carry the
 # engine-side codes; these are the transport/front-door codes minted as
@@ -249,6 +264,15 @@ WIRE_CODES = frozenset((
                            # found nothing (evicted / discarded /
                            # rebuilt) — recompute locally, request
                            # still serves
+    # Structured & constrained decoding (serve/constrain.py):
+    "invalid_grammar",     # constraint spec failed to compile (400 at
+                           # enqueue, deterministic — never retried on
+                           # another replica)
+    "stop_sequence",       # finish_reason wire value: a multi-token
+                           # stop sequence matched and the output was
+                           # trimmed at the match (a finish reason, not
+                           # a failure — carried in the same vocabulary
+                           # so a typo'd literal trips tpulint)
 ))
 
 
@@ -330,7 +354,8 @@ class EngineSupervisor:
                  faults: Any = None,
                  prefill_tokens_per_step: int = 256,
                  device_lock: threading.Lock | None = None,
-                 tier_prefetch: bool = True) -> None:
+                 tier_prefetch: bool = True,
+                 constrainer: Any = None) -> None:
         # Local import: scheduler imports this module for the error
         # taxonomy, so the supervisor resolves it lazily.
         from tf_operator_tpu.serve.scheduler import ContinuousScheduler
@@ -344,6 +369,11 @@ class EngineSupervisor:
         # Session prefetch knob (serve/tier.py), generation-invariant:
         # every rebuilt scheduler inherits it.
         self._tier_prefetch = bool(tier_prefetch)
+        # Constraint compiler (serve/constrain.py), process-lifetime
+        # like the host tier: a watchdog rebuild keeps the compiled-
+        # program LRU, and replayed constrained requests re-bind their
+        # (already stamped) programs into the fresh engine's pool.
+        self._constrainer = constrainer
         self._lock = threading.RLock()     # guards the generation swap
         self._restart_lock = threading.Lock()
         self._closed = False
@@ -382,6 +412,7 @@ class EngineSupervisor:
             supervisor=self,
             faults=self.faults,
             tier_prefetch=self._tier_prefetch,
+            constrainer=self._constrainer,
         )
         if replay:
             sched.requeue(replay)

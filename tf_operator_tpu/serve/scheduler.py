@@ -81,6 +81,8 @@ if TYPE_CHECKING:  # annotation-only: keeps this module jax-import-free
     from tf_operator_tpu.serve.engine import ContinuousEngine
 
 from tf_operator_tpu.runtime.metrics import (
+    SERVE_CONSTRAINED_REQUESTS,
+    SERVE_CONSTRAINED_STOPS,
     SERVE_DEADLINE_TOTAL,
     SERVE_DEGRADED,
     SERVE_ITL_SECONDS,
@@ -98,10 +100,14 @@ from tf_operator_tpu.runtime.metrics import (
     SERVE_TTFT_SECONDS,
 )
 from tf_operator_tpu.runtime.tracing import SERVE_TRACER, mint_request_id
+# jax-import-free: constrain.py defers its jnp imports into ProgramPool
+# methods, so the host-side helpers (match_stop) are safe here.
+from tf_operator_tpu.serve.constrain import match_stop
 from tf_operator_tpu.serve.faultinject import NULL_INJECTOR
 from tf_operator_tpu.serve.resilience import (
     EngineCrashed,
     EngineSupervisor,
+    InvalidGrammar,
     PrefixNotFound,
     QueueFull,
     QueueTTLExpired,
@@ -140,7 +146,10 @@ class ServeRequest:
                  deadline_s: float | None = None,
                  request_id: str | None = None,
                  shipment: Any = None,
-                 session: str | None = None) -> None:
+                 session: str | None = None,
+                 constrain: Any = None,
+                 stop: Any = None,
+                 logprobs: bool = False) -> None:
         self.tokens = np.asarray(tokens, np.int32)
         if self.tokens.ndim != 2 or self.tokens.shape[0] != 1:
             raise ValueError("tokens must be [1, len] (one request row)")
@@ -206,6 +215,26 @@ class ServeRequest:
         # flag bench/telemetry readers key off).
         self.session = None if session is None else str(session)
         self.tier_join = False
+        # Structured/constrained decoding (serve/constrain.py).
+        # ``constrain`` is the raw client spec ({"json_schema"|"regex"|
+        # "choices": ...}); enqueue compiles it OFF the device lock and
+        # stamps ``program`` (a CompiledProgram) — a watchdog replay
+        # reuses the stamped program (same digest → the rebuilt
+        # engine's pool re-binds the identical tables). ``_walk_state``
+        # is the host-side FSM position over DELIVERED tokens (program-
+        # local states): the scheduler re-derives it from req.out, so
+        # replay reconstructs it for free. ``stop_ids`` are the encoded
+        # multi-token stop sequences, matched host-side against the
+        # out tail; ``finish_reason`` records why the stream ended
+        # ("length" | "eos" | "grammar_complete" | "stop_sequence").
+        self.constrain = constrain
+        self.stop = stop
+        self.logprobs = bool(logprobs)
+        self.program: Any = None
+        self.stop_ids: tuple = ()
+        self.finish_reason: str | None = None
+        self.logprob_rows: list[dict] = []
+        self._walk_state = 0
 
     @property
     def ttft(self) -> float | None:
@@ -272,7 +301,8 @@ class ContinuousScheduler:
                  resilience: ResilienceConfig | None = None,
                  supervisor: EngineSupervisor | None = None,
                  faults: Any = None,
-                 tier_prefetch: bool = True) -> None:
+                 tier_prefetch: bool = True,
+                 constrainer: Any = None) -> None:
         if prefill_tokens_per_step < 1:
             raise ValueError("prefill_tokens_per_step must be >= 1")
         self.engine = engine
@@ -282,6 +312,12 @@ class ContinuousScheduler:
         # without a host tier; the flag exists so ops can isolate the
         # prefetch path (--tier-prefetch 0) from tiering itself.
         self.tier_prefetch = bool(tier_prefetch)
+        # Constrained decoding (serve/constrain.py): the shared
+        # ConstraintCompiler requests' grammar specs compile through at
+        # ENQUEUE time — on the client's thread, off the device lock,
+        # LRU-cached by spec digest, so program churn never stalls the
+        # decode loop. None = constrained requests are a typed 400.
+        self.constrainer = constrainer
         # Serializes device access with a server's OTHER decode paths
         # (serve_lm's streaming requests bypass the engine); a dedicated
         # server may pass None and let the loop own the chip outright.
@@ -380,6 +416,11 @@ class ContinuousScheduler:
             raise ValueError(
                 "top_p requires temperature > 0 (greedy ignores it)"
             )
+        if req.logprobs and not getattr(self.engine, "logprobs_k", 0):
+            raise ValueError(
+                "logprobs requires an engine built with logprobs_k > 0"
+            )
+        self._compile_constraint(req)
         with self._cond:
             if self._fenced:
                 raise SchedulerFenced("scheduler fenced for restart")
@@ -411,6 +452,37 @@ class ContinuousScheduler:
             self._cond.notify_all()
         self._maybe_prefetch(req)
         return req
+
+    def _compile_constraint(self, req: ServeRequest) -> None:
+        """Enqueue-time constraint compile + stop-sequence encoding:
+        on the CLIENT's thread, off the device lock — the decode loop
+        only ever sees a finished CompiledProgram. All grammar failures
+        raise :class:`InvalidGrammar` here, eagerly (the server's typed
+        400, same contract as the validation above). Idempotent: a
+        supervisor replay re-enqueues with ``program``/``stop_ids``
+        already stamped and recompiles nothing."""
+        if req.constrain is not None and req.program is None:
+            if self.constrainer is None:
+                raise InvalidGrammar(
+                    "this server has no constraint compiler "
+                    "(constrained decoding is not enabled)"
+                )
+            t0 = time.monotonic()
+            req.program = self.constrainer.compile(
+                req.constrain, eos_id=req.eos_id
+            )
+            SERVE_TRACER.record(
+                "constrain.compile", t0, time.monotonic(),
+                request_id=req.request_id, **req.program.describe(),
+            )
+            SERVE_CONSTRAINED_REQUESTS.inc(kind=req.program.kind)
+        if req.stop is not None and not req.stop_ids:
+            if self.constrainer is None:
+                raise InvalidGrammar(
+                    "this server has no constraint compiler "
+                    "(stop sequences are not enabled)"
+                )
+            req.stop_ids = self.constrainer.encode_stop(req.stop)
 
     def _maybe_prefetch(self, req: ServeRequest) -> None:
         """Session prefetch: post a fire-and-forget host-tier restore
@@ -475,6 +547,13 @@ class ContinuousScheduler:
                 # engine's pool (the HostTier itself is process-
                 # lifetime, so the payload is still there).
                 req.tier_join = False
+                # Constrained state: the compiled program survives (a
+                # replay re-binds the same tables into the rebuilt
+                # engine's pool), but the host FSM walk and delivered
+                # logprob rows restart with the cleared output.
+                req._walk_state = 0
+                req.finish_reason = None
+                req.logprob_rows.clear()
                 req.replays += 1
                 req.enqueued_at = now
                 req.ttl_deadline = (
@@ -1017,9 +1096,14 @@ class ContinuousScheduler:
                         # join_planned; charge what actually runs —
                         # shared prefixes cost nothing to re-admit.
                         budget -= plan.prefill_tokens
+                    # ``program`` is keyword-passed only when set so the
+                    # chaos tests' fake engines (pre-constrain
+                    # join_planned signatures) keep working unmodified.
+                    join_kw = ({"program": req.program}
+                               if req.program is not None else {})
                     slot = self.engine.join_planned(
                         plan, pf, temperature=req.temperature,
-                        top_p=req.top_p, seed=req.seed,
+                        top_p=req.top_p, seed=req.seed, **join_kw,
                     )
             except Exception as exc:  # noqa: BLE001 — one bad request
                 # answers its own client and never kills the loop. The
@@ -1177,7 +1261,8 @@ class ContinuousScheduler:
 
     def _flush_intervals(self, slot: int | None = None,
                          reason: str | None = None,
-                         rid: str | None = None) -> None:
+                         rid: str | None = None,
+                         constrained: bool | None = None) -> None:
         """Emit the open ``decode.interval`` span(s): one slot (its
         retire — ``rid`` names the owner, already gone from _slots) or
         all of them (a prefill about to interleave, the drain, a
@@ -1193,12 +1278,23 @@ class ContinuousScheduler:
                     else "")
                 for s, _ in flushed
             }
+            # Constrained-slot attribution: live slots read their
+            # request's program; the retire path (owner already gone
+            # from _slots) passes the flag alongside rid.
+            con = {
+                s: (constrained if constrained is not None and s == slot
+                    else (s in self._slots
+                          and self._slots[s].program is not None))
+                for s, _ in flushed
+            }
         spec = getattr(self.engine, "spec_k", 0)
         for s, (start, last, steps, rounds) in flushed:
             attrs: dict[str, Any] = {
                 "request_id": owners.get(s, ""), "slot": s,
                 "tokens": steps,
             }
+            if con.get(s):
+                attrs["constrained"] = True
             if spec and rounds:
                 # Speculative rounds: tokens > rounds when the draft is
                 # riding; the per-interval accept rate is the latency
@@ -1216,7 +1312,8 @@ class ContinuousScheduler:
         """Retirement-side tracing/ITL: flush the slot's open decode
         interval and observe the request's inter-token gaps (from its
         decode-step stamps — exactly once, at retirement)."""
-        self._flush_intervals(slot, reason=reason, rid=req.request_id)
+        self._flush_intervals(slot, reason=reason, rid=req.request_id,
+                              constrained=req.program is not None)
         for gap in req.itl_values():
             SERVE_ITL_SECONDS.observe(gap)
 
@@ -1237,6 +1334,12 @@ class ContinuousScheduler:
                 toks, counts = self.engine.spec_step()
             else:
                 toks = self.engine.step()
+        # Per-step top-k logprobs (plain engines only — the ctor
+        # forbids logprobs_k on spec engines): numpy rows already
+        # materialized by step(); slots read theirs below.
+        lp = (self.engine.last_logprobs()
+              if not spec and getattr(self.engine, "logprobs_k", 0)
+              else None)
         self._beat()  # the step returned — wedged steps never get here
         now = time.perf_counter()
         mono = time.monotonic()
@@ -1264,10 +1367,54 @@ class ContinuousScheduler:
                     req.out.append(tok)
                     req.token_times.append(mono)
                     delivered += 1
+                    if req.logprobs and lp is not None:
+                        req.logprob_rows.append({
+                            "token": tok,
+                            "logprob": float(lp[0][slot]),
+                            "top_ids": [int(x) for x in lp[2][slot]],
+                            "top_logprobs": [float(x)
+                                             for x in lp[1][slot]],
+                        })
+                    if req.program is not None:
+                        # Host FSM walk (program-local states) — the
+                        # device fsm row advanced in the same step;
+                        # this mirror exists to read the COMPLETE flag
+                        # and survives replay (re-derived from out).
+                        req._walk_state = req.program.walk(
+                            req._walk_state, tok
+                        )
+                        if bool(req.program.complete[req._walk_state]):
+                            finished = True
+                            req.finish_reason = "grammar_complete"
+                            SERVE_CONSTRAINED_STOPS.inc(
+                                reason="grammar_complete"
+                            )
+                            break  # window past completion is dead
+                    if req.stop_ids:
+                        k = match_stop(req.out, req.stop_ids)
+                        if k:
+                            # The stop tokens are excluded from the
+                            # response (apply_stop's post-hoc law);
+                            # their times/logprob rows go with them.
+                            del req.out[-k:]
+                            del req.token_times[-k:]
+                            if req.logprob_rows:
+                                del req.logprob_rows[-k:]
+                            finished = True
+                            req.finish_reason = "stop_sequence"
+                            SERVE_CONSTRAINED_STOPS.inc(
+                                reason="stop_sequence"
+                            )
+                            break
                     if (len(req.out) >= req.num_steps
                             or (req.eos_id is not None
                                 and tok == req.eos_id)):
                         finished = True
+                        req.finish_reason = (
+                            "eos" if (req.eos_id is not None
+                                      and tok == req.eos_id)
+                            else "length"
+                        )
                         break  # window past the budget/eos is dead
                 delivered_total += delivered
                 req.decode_s += mono - mono0
@@ -1441,4 +1588,14 @@ class ContinuousScheduler:
                 # tokens, and the derived accept rate — the number the
                 # spec bench leg and dashboards read.
                 snap["spec"] = self.engine.spec_debug()
+            if hasattr(self.engine, "constrain_debug"):
+                # Constrained decoding: pool rows/residency, bind and
+                # eviction counters, slots currently under a program —
+                # plus the shared compiler's cache stats when this
+                # scheduler owns one.
+                snap["constrain"] = self.engine.constrain_debug()
+                if self.constrainer is not None:
+                    snap["constrain"]["compiler"] = (
+                        self.constrainer.debug()
+                    )
             return snap

@@ -233,7 +233,13 @@ def constrain_tree(mesh: Mesh, tree: Any, specs: Any) -> Any:
 
 def replicate_put(mesh: Mesh, x: Any) -> Any:
     """device_put one array fully replicated over the mesh (per-slot
-    host-fed state: keys ladders, counters, sampling params)."""
+    host-fed state: keys ladders, counters, sampling params — and the
+    constraint pool's ``allow_pool``/``next_pool`` tables plus the
+    per-slot FSM row vector, serve/constrain.py: the mask gather reads
+    full vocab rows on every shard, and vocab is unsharded in this
+    stack, so replication is the correct layout, not a compromise;
+    eager ``.at[].set`` program binds re-enter through here and keep
+    the placement, which is what lets a bind never retrace the step)."""
     import jax
 
     return jax.device_put(x, NamedSharding(mesh, P()))

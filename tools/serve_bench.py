@@ -390,6 +390,121 @@ def run_tp_legs(cfg, params, schedule, args) -> list[dict]:
     return [tp_line, base]
 
 
+# Constrained-decoding mix (ISSUE 19): every ``every``-th request
+# carries a bounded JSON-schema grammar (string maxLength + boolean —
+# every DFA path is finite, so completion is GUARANTEED inside the
+# step budget, and grammar_valid == constrained_requests is a hard pin,
+# not a coin flip). Both legs serve the IDENTICAL seeded schedule; the
+# free leg drops the grammar, so the mixed line's vs_baseline is purely
+# the mask-gather + host-walk overhead (the acceptance bound: bounded,
+# near-1 — the mask is data, not a recompile).
+CONSTRAIN_MIX = dict(requests=24, gap_ms=4.0,
+                     shapes=((6, 40), (10, 40), (4, 48)), every=2)
+SMOKE_CONSTRAIN_MIX = dict(requests=10, gap_ms=2.0,
+                           shapes=((4, 32), (6, 32)), every=2)
+
+
+def run_constrain_legs(cfg, params, args, smoke: bool) -> list[dict]:
+    """The ISSUE-19 acceptance pair: the continuous engine serving the
+    identical seeded schedule FREE (baseline) and MIXED (every other
+    request under a compiled JSON-schema grammar program). Capacity
+    pins, no wall-clock: every constrained request retires
+    grammar_complete with output that actually parses
+    (grammar_valid == constrained_requests), the free leg's streams are
+    untouched by the mask plumbing, and BOTH legs hold the
+    zero-recompile pin across the constrained/free occupancy churn."""
+    import json as _json
+
+    from tf_operator_tpu.serve.constrain import (
+        ConstraintCompiler,
+        default_vocab,
+        detokenize,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.scheduler import (
+        ContinuousScheduler,
+        ServeRequest,
+    )
+
+    mix = SMOKE_CONSTRAIN_MIX if smoke else CONSTRAIN_MIX
+    schedule = build_schedule(mix["requests"], mix["gap_ms"], args.seed,
+                              mix["shapes"], cfg.vocab_size)
+    if cfg.vocab_size >= 128:
+        # chr-identity vocab covers ASCII: the real JSON-schema path.
+        spec = {"json_schema": {
+            "type": "object",
+            "properties": {"name": {"type": "string", "maxLength": 4},
+                           "ok": {"type": "boolean"}},
+            "required": ["name", "ok"],
+        }}
+        valid = lambda s: isinstance(_json.loads(s), dict)  # noqa: E731
+    else:
+        # tiny --vocab: digits still tokenize; same bounded-DFA pin.
+        spec = {"regex": "[0-9]{2,8}"}
+        valid = lambda s: s.isdigit() and 2 <= len(s) <= 8  # noqa: E731
+    constrainer = ConstraintCompiler(default_vocab(cfg.vocab_size))
+    vocab = default_vocab(cfg.vocab_size)
+    lines = []
+    for name, constrained in (("constrain_free", False),
+                              ("constrain_mixed", True)):
+        engine = ContinuousEngine(
+            cfg, params, max_slots=args.max_batch,
+            prefill_chunk=args.prefill_chunk or None,
+            constrain_rows=64,
+        )
+        sched = ContinuousScheduler(
+            engine, constrainer=constrainer,
+            prefill_tokens_per_step=args.prefill_budget,
+        ).start()
+        spec_by_key = {
+            prompt.tobytes(): (spec if constrained and i % mix["every"]
+                               else None)
+            for i, (_, prompt, _s) in enumerate(schedule)
+        }
+        done = []
+        done_lock = threading.Lock()
+
+        def submit(prompt, steps):
+            req = sched.submit_request(ServeRequest(
+                prompt, steps, constrain=spec_by_key[prompt.tobytes()]
+            ))
+            with done_lock:
+                done.append(req)
+            return list(req.out), req.ttft, req.itl_values()
+
+        run_schedule(schedule, submit)  # untimed warmup
+        done.clear()
+        sched.reset_stats()
+        wall_s, results = run_schedule(schedule, submit)
+        con = [r for r in done if r.constrain is not None]
+        grammar_valid = sum(
+            1 for r in con
+            if r.finish_reason == "grammar_complete"
+            and valid(detokenize(vocab, r.out))
+        )
+        dbg = engine.constrain_debug()
+        stats = {
+            "constrained_requests": len(con),
+            "grammar_valid": grammar_valid,
+            "grammar_complete": sum(
+                1 for r in con
+                if r.finish_reason == "grammar_complete"
+            ),
+            "constrain_programs": dbg["programs"],
+            "constrain_rows_used": dbg["rows_used"],
+            "decode_steps": sched.decode_steps,
+            "decode_step_compiles": engine.decode_step_compiles,
+            "warmup_compiles": engine.warmup_compiles,
+            "max_batch": engine.max_slots,
+        }
+        sched.stop(timeout=30.0)
+        lines.append(leg_summary(name, wall_s, results, stats))
+    # Treatment first (the pair convention main's ratio block keys on):
+    # the mixed line's vs_baseline becomes mixed/free — the bounded
+    # mask-gather + host-walk overhead on the identical schedule.
+    return [lines[1], lines[0]]
+
+
 def train_lm_params(cfg, steps: int, lr: float, seq: int, seed: int = 0):
     """Train the +1-mod-vocab chain task (serve_lm's quick_train,
     batch 16 over full-length chains) — the SPEC legs need a draft
@@ -1634,7 +1749,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--engine",
                    choices=("continuous", "coalesce", "both", "chaos",
                             "fleet", "fleet-prefix", "disagg", "spec",
-                            "tier"),
+                            "tier", "constrain"),
                    default="both",
                    help="'chaos' runs ONLY the seeded fault-injection "
                         "mix (supervised engine, step crash + stall "
@@ -1657,7 +1772,13 @@ def main(argv: list[str] | None = None) -> int:
                         "'tier' the ISSUE-17 session-resume pair: the "
                         "host-RAM KV tier (spill on eviction, restore "
                         "on resume) vs recompute at the identical "
-                        "tight HBM block budget")
+                        "tight HBM block budget; "
+                        "'constrain' the ISSUE-19 structured-decoding "
+                        "pair: the identical seeded schedule free vs "
+                        "with every other request under a compiled "
+                        "JSON-schema grammar program (grammar_valid "
+                        "and zero-recompile pins, vs_baseline = the "
+                        "mask overhead)")
     p.add_argument("--spec-k", type=int, default=8,
                    help="draft proposals per round for --engine spec "
                         "(CPU rounds need a large k: per-round "
@@ -1758,6 +1879,8 @@ def main(argv: list[str] | None = None) -> int:
         lines.extend(run_disagg_legs(args, smoke))
     if args.engine == "tier":
         lines.extend(run_tier_legs(cfg, params, args, smoke))
+    if args.engine == "constrain":
+        lines.extend(run_constrain_legs(cfg, params, args, smoke))
     if args.engine == "spec":
         mesh = None
         if args.tp > 1:

@@ -1,17 +1,22 @@
 #!/usr/bin/env python
 """Fast continuous-batching smoke: runs the `serve`-marked tests in
 isolation (slot-engine exactness vs solo generate, paged-cache/CoW/
-prefix-sharing pins, KV-tier spill/restore pins, zero-recompile pins,
+prefix-sharing pins, KV-tier spill/restore pins, constrained-decoding
+grammar/bit-identity pins, zero-recompile pins,
 scheduler drain/EOS/metrics,
 serve-bench structure), then one INLINE end-to-end pair through a live
 paged engine + scheduler — a plain paged request and a shared-prefix
 request — asserting both reproduce solo generate bit-for-bit and the
 second actually skipped its prefill — then a TRACED request through a
 supervised engine (queue/admit/prefill/decode-interval spans under one
-request id, in phase order, valid Chrome-trace export) — and finally the SPMD
+request id, in phase order, valid Chrome-trace export) — then a
+CONSTRAINED end-to-end through a supervised engine (grammar_complete
+JSON that parses, typed invalid_grammar 400 on a malformed spec, crash
+replay bit-identical to solo constrained_generate) — and finally the SPMD
 tensor-parallel matrix (tools/serve_tp_check.py at tp=2 host devices:
 {dense, paged} x {one-shot, chunked} bit-identity, the batch-wide
-speculative cells spec/{dense, paged, paged-kv8}, + the supervisor
+speculative cells spec/{dense, paged, paged-kv8}, a constrained cell,
++ the supervisor
 mesh-reconstruction replay, slow-marked in tier-1 so THIS is its
 default home). The quick loop for iterating on tf_operator_tpu/serve/
 without paying for the whole tier-1 run.
@@ -241,6 +246,111 @@ def chaos_e2e() -> int:
         sup.stop(timeout=30.0)
 
 
+def constrain_e2e() -> int:
+    """Structured decoding end-to-end through a LIVE supervised engine
+    (ISSUE 19): a JSON-schema-constrained request retires
+    grammar_complete with output that json.loads, a malformed spec is a
+    typed invalid_grammar 400 AT ENQUEUE (no device work), and a step
+    crash mid-constrained-run replays bit-identical to solo
+    constrained_generate through the watchdog rebuild — the stamped
+    program survives the supervisor's requeue and re-binds into the
+    rebuilt engine's fresh pool."""
+    import json
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+    )
+    from tf_operator_tpu.serve.constrain import (
+        ConstraintCompiler,
+        constrained_generate,
+        default_vocab,
+        detokenize,
+        walk_tokens,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        InvalidGrammar,
+        ResilienceConfig,
+    )
+    from tf_operator_tpu.serve.scheduler import ServeRequest
+
+    # V=128: the chr-identity vocab must cover ASCII for JSON grammars.
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    vocab = default_vocab(cfg.vocab_size)
+    comp = ConstraintCompiler(vocab)
+    inj = FaultInjector(seed=2)
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=2, kv_block=8,
+                                 constrain_rows=32, faults=inj),
+        resilience=ResilienceConfig(watchdog_stall_s=5.0,
+                                    restart_backoff_s=0.05,
+                                    max_restarts=3),
+        faults=inj,
+        constrainer=comp,
+    )
+    try:
+        spec = {"json_schema": {
+            "type": "object",
+            "properties": {"name": {"type": "string", "maxLength": 4},
+                           "ok": {"type": "boolean"}},
+            "required": ["name", "ok"],
+        }}
+        prompt = np.random.default_rng(4).integers(
+            0, cfg.vocab_size, (1, 8)
+        ).astype(np.int32)
+        prog = comp.compile(spec)
+        want = np.asarray(constrained_generate(
+            cfg, params, jnp.asarray(prompt), 32, program=prog
+        ))[0]
+        _, done = walk_tokens(prog, [int(t) for t in want])
+        assert done is not None, "bounded grammar must complete"
+        want = [int(t) for t in want[: done + 1]]
+
+        req = sup.submit_request(ServeRequest(prompt, 32,
+                                              constrain=spec))
+        assert req.finish_reason == "grammar_complete", req.finish_reason
+        assert list(req.out) == want, "constrained output != solo"
+        doc = json.loads(detokenize(vocab, req.out))
+        assert isinstance(doc["ok"], bool), doc
+
+        try:
+            sup.submit_request(ServeRequest(prompt, 4,
+                                            constrain={"regex": "[bad"}))
+            raise AssertionError("malformed spec was accepted")
+        except InvalidGrammar as exc:
+            assert exc.http_status == 400 and not exc.retryable
+
+        inj.arm(f"step_raise@{inj.invocations['step_raise'] + 3}")
+        req2 = sup.submit_request(ServeRequest(prompt, 32,
+                                               constrain=spec))
+        assert sup.restarts == 1, sup.restarts
+        assert list(req2.out) == want, "replayed constrained != solo"
+        assert req2.finish_reason == "grammar_complete"
+        assert sup.engine.decode_step_compiles == \
+            sup.engine.warmup_compiles
+        print(
+            "serve_smoke: constrain e2e ok (grammar_complete JSON "
+            "parses, typed 400 on the bad spec, crash replay "
+            "bit-identical, zero post-warmup recompiles)", flush=True,
+        )
+        return 0
+    finally:
+        sup.stop(timeout=30.0)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
     env = dict(os.environ)
@@ -262,6 +372,7 @@ def main(argv: list[str] | None = None) -> int:
             "tests/test_serve_engine.py", "tests/test_serve_sched.py",
             "tests/test_kvcache_paged.py", "tests/test_serve_chaos.py",
             "tests/test_serve_tier.py", "tests/test_paged_attention.py",
+            "tests/test_serve_constrain.py",
             "-m", "serve and not slow",
             "-q", "-p", "no:cacheprovider",
             *args,
@@ -276,6 +387,9 @@ def main(argv: list[str] | None = None) -> int:
     if rc != 0:
         return rc
     rc = trace_e2e()
+    if rc != 0:
+        return rc
+    rc = constrain_e2e()
     if rc != 0:
         return rc
     # The SPMD tensor-parallel matrix (slow-marked in tier-1, so the

@@ -287,6 +287,93 @@ def run_spec(tp: int) -> int:
     return failures
 
 
+def run_constrain(tp: int) -> int:
+    """Constrained decoding at tp>1 (ISSUE 19): the paged engine on the
+    mesh with a grammar-constrained lane co-resident with a free
+    sampled lane — the constraint pool's allow/next tables and the
+    per-slot FSM vector are REPLICATED (sharding.replicate_put: the
+    mask gather reads full vocab rows on every shard, and vocab is
+    unsharded), so the constrained lane must be bit-identical to solo
+    ``constrained_generate`` with the SAME tp-sharded params, the free
+    lane to plain ``generate``, with compiles == warmup."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.serve.constrain import (
+        ConstraintCompiler,
+        constrained_generate,
+        default_vocab,
+    )
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+
+    # V=128: the chr-identity vocab must cover ASCII for the grammar.
+    cfg = TransformerConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(mesh, params, param_sharding_rules())
+    comp = ConstraintCompiler(default_vocab(cfg.vocab_size))
+    prog = comp.compile({"regex": "[0-9]{2,6}"})
+
+    rng = np.random.default_rng(17)
+    p_con = rng.integers(0, 128, (1, 6)).astype(np.int32)
+    p_free = rng.integers(0, 128, (1, 9)).astype(np.int32)
+    failures = 0
+    eng = ContinuousEngine(
+        cfg, params, max_slots=2, kv_paged=True, kv_block=8, mesh=mesh,
+        constrain_rows=16,
+    )
+    s_con = eng.join(jnp.asarray(p_con), num_steps=10, program=prog)
+    s_free = eng.join(jnp.asarray(p_free), num_steps=10,
+                      temperature=0.9, seed=3)
+    got = {s_con: [], s_free: []}
+    for _ in range(10):
+        toks = eng.step()
+        for s in got:
+            got[s].append(int(toks[s]))
+    eng.retire(s_con)
+    eng.retire(s_free)
+    want_con = np.asarray(constrained_generate(
+        cfg, sharded, jnp.asarray(p_con), 10, program=prog
+    ))[0]
+    want_free = np.asarray(generate(
+        cfg, sharded, jnp.asarray(p_free), 10, temperature=0.9,
+        rng=jax.random.PRNGKey(3),
+    ))[0]
+    if not np.array_equal(np.asarray(got[s_con]), want_con):
+        print("serve_tp_check: constrain lane DIVERGED from solo "
+              "constrained_generate", file=sys.stderr)
+        failures += 1
+    if not np.array_equal(np.asarray(got[s_free]), want_free):
+        print("serve_tp_check: free lane beside the constrained one "
+              "DIVERGED from solo generate", file=sys.stderr)
+        failures += 1
+    if eng.decode_step_compiles != eng.warmup_compiles:
+        print(f"serve_tp_check: constrain cell recompiled "
+              f"({eng.decode_step_compiles} != warmup "
+              f"{eng.warmup_compiles})", file=sys.stderr)
+        failures += 1
+    print(f"serve_tp_check: constrain/paged ok (compiles "
+          f"{eng.decode_step_compiles}=warmup, "
+          f"{eng.constrain_debug()['rows_used']} pool rows)",
+          flush=True)
+    return failures
+
+
 def run_pallas(tp: int) -> int:
     """Paged-attention kernel at tp>1 (ISSUE 18): the pallas attend
     runs under shard_map over the tp axis (a pallas call has no SPMD
@@ -561,6 +648,7 @@ def main(argv: list[str] | None = None) -> int:
     _force_host_devices(args.tp)
     failures = run_matrix(args.tp)
     failures += run_spec(args.tp)
+    failures += run_constrain(args.tp)
     failures += run_pallas(args.tp)
     if not args.skip_supervisor:
         failures += run_supervisor_replay(args.tp)
@@ -568,9 +656,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"serve_tp_check: FAIL ({failures} failure(s))",
               file=sys.stderr)
         return 1
-    print(f"serve_tp_check: OK (tp={args.tp}, matrix + spec + pallas "
-          f"+ supervisor replay bit-identical, zero post-warmup "
-          f"recompiles)", flush=True)
+    print(f"serve_tp_check: OK (tp={args.tp}, matrix + spec "
+          f"+ constrain + pallas + supervisor replay bit-identical, "
+          f"zero post-warmup recompiles)", flush=True)
     return 0
 
 
