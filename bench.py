@@ -611,6 +611,27 @@ def bench_serve_tp(peak_hbm_gbps: float | None) -> None:
                           else 420)
 
 
+def bench_serve_tpdp(peak_hbm_gbps: float | None) -> None:
+    """Pod-scale serving pair (ISSUE 20): subprocess-runs
+    tools/serve_bench.py --tp 2 --dp 2 — the SAME seeded open-loop
+    schedule as the tp pair through the continuous engine on a 2-D
+    tp x dp mesh (4 devices: per-slot state and the paged pool's block
+    axis sharded over dp on top of the tp head shard, ONE compiled step
+    driving the pod slice) and through the tp=2/dp=1 engine as
+    baseline; the tpdp line's vs_baseline is tp2dp2/tp2dp1 and carries
+    mesh_devices=4 + the zero-recompile pin. On CPU rounds the four
+    devices come from the XLA host-device trick serve_bench applies
+    itself, so the line exists in every round — there it is a MECHANISM
+    proof (dp buys aggregate slots/HBM only on real chips, where it is
+    the true pod number). Subprocess for the usual serve-section
+    reasons. peak_hbm unused; signature keeps the peak-table plumbing
+    uniform."""
+    del peak_hbm_gbps
+    _run_serve_subprocess("serve_tpdp", ["--tp", "2", "--dp", "2"],
+                          timeout=150 if os.environ.get("BENCH_SMOKE")
+                          else 480)
+
+
 def bench_serve_spec(peak_hbm_gbps: float | None) -> None:
     """Batch-wide speculative decode triple: subprocess-runs
     tools/serve_bench.py --engine spec — one seeded decode-heavy
@@ -1381,6 +1402,7 @@ _SECTIONS: dict = {
     "decode_paged": (bench_decode_paged, chip_peak_hbm_gbps, 700.0),
     "serve": (bench_serve_continuous, chip_peak_hbm_gbps, 700.0),
     "serve_tp": (bench_serve_tp, chip_peak_hbm_gbps, 480.0),
+    "serve_tpdp": (bench_serve_tpdp, chip_peak_hbm_gbps, 540.0),
     "serve_spec": (bench_serve_spec, chip_peak_hbm_gbps, 560.0),
     "serve_disagg": (bench_serve_disagg, chip_peak_hbm_gbps, 560.0),
     "fleet": (bench_serve_fleet, chip_peak_hbm_gbps, 420.0),

@@ -51,7 +51,12 @@ generate()'s own validation). Two serving engines (``--engine``):
   runs the SAME engine SPMD over an N-device mesh: params tp-sharded by
   the training rules, KV storage head-sharded, one compiled step
   driving the whole slice (composes with ``--kv-paged``/``--kv-dense``;
-  output stays bit-identical to solo decode). ``--spec-k K`` turns
+  output stays bit-identical to solo decode). ``--dp M`` makes the mesh
+  2-D (tp x dp, pod-scale): per-slot state and the paged pool's block
+  axis ALSO shard over dp — each dp shard owns max-batch/M slots and
+  its own block extent, admission routes each request to one shard, and
+  the same single compiled step drives the whole 2-D slice, still
+  bit-identical (docs/serving.md "Pod-scale decode"). ``--spec-k K`` turns
   every decode iteration into a BATCH-WIDE speculative round: each
   slot drafts K tokens and one batched K+1-position verify scores
   them all, per-slot accept counters advancing slots DIFFERENT
@@ -214,6 +219,15 @@ def main(argv: list[str] | None = None) -> int:
                         "--kv-paged/--kv-dense/--kv-int8/--spec-k; "
                         "--int8 params replicate — the dequant kernel "
                         "has no SPMD rule)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="pod-scale decode (composes with --tp; "
+                        "tp*dp devices): ALSO shard the slot axis — "
+                        "per-slot state and the paged pool's block "
+                        "axis split over a second mesh axis, each dp "
+                        "shard owning max-batch/dp slots and its own "
+                        "block extent, ONE compiled step driving the "
+                        "whole 2-D slice (requires --dp to divide "
+                        "--max-batch; continuous engine only)")
     p.add_argument("--int8", action="store_true",
                    help="weight-only int8 decode: quantize projections "
                         "after load (Pallas dequant-in-VMEM on TPU — "
@@ -446,6 +460,18 @@ def main(argv: list[str] | None = None) -> int:
                 f"policy — use --engine coalesce)")
     if args.engine is None:
         args.engine = "coalesce" if legacy_flags else "continuous"
+    if args.dp > 1:
+        if args.engine != "continuous":
+            p.error("--dp > 1 needs --engine continuous (the dp slot "
+                    "slices exist only in the continuous engine)")
+        if args.max_batch % args.dp:
+            p.error("--dp must divide --max-batch (each dp shard owns "
+                    "an equal slot slice)")
+        if args.spec_k:
+            p.error("--dp does not compose with --spec-k yet (the "
+                    "pod-scale bit-identity pins cover the plain "
+                    "engine; the spec engine's dp placement is "
+                    "unvalidated)")
     if args.role == "prefill":
         bad = [flag for flag, on in (
             ("--spec-k", bool(args.spec_k)),
@@ -453,6 +479,7 @@ def main(argv: list[str] | None = None) -> int:
             ("--kv-int8", args.kv_int8),
             ("--batch-window", args.batch_window > 0),
             ("--tp", args.tp > 1),
+            ("--dp", args.dp > 1),
         ) if on]
         if bad:
             p.error(f"--role prefill does not compose with "
@@ -584,11 +611,19 @@ def main(argv: list[str] | None = None) -> int:
         cfg = replace(cfg, int8_decode=True)
         print("serve_lm: projections quantized to int8", flush=True)
     mesh = None
-    if args.tp > 1:
+    if args.tp > 1 or args.dp > 1:
         from tf_operator_tpu.parallel.mesh import create_mesh
         from tf_operator_tpu.parallel.sharding import shard_params_by_rules
 
-        mesh = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+        # --dp adds the second mesh axis: params REPLICATE over it
+        # (every dp shard decodes its own slot slice with the full
+        # model) while the engine shards slot state and the pool's
+        # block axis over it — serve/sharding.py slot_spec/leaf_spec.
+        need = args.tp * args.dp
+        axes = {"tp": args.tp}
+        if args.dp > 1:
+            axes["dp"] = args.dp
+        mesh = create_mesh(axes, jax.devices()[:need])
         # int8 trees replicate (the dequant kernel has no SPMD
         # partitioning rule — serve/engine.py applies the same policy);
         # tp still shards the KV storage and drives one compiled step
@@ -598,7 +633,9 @@ def main(argv: list[str] | None = None) -> int:
             {} if args.int8 else param_sharding_rules(),
         )
         print(f"serve_lm: params {'replicated (int8)' if args.int8 else 'tp-sharded'} "
-              f"over {args.tp} devices", flush=True)
+              f"over {need} devices"
+              + (f" (tp {args.tp} x dp {args.dp})" if args.dp > 1
+                 else ""), flush=True)
     if args.kv_int8:
         from dataclasses import replace
 
@@ -833,6 +870,9 @@ def main(argv: list[str] | None = None) -> int:
                         f"{' +prefetch' if args.tier_prefetch else ''}")
         if mesh is not None:
             kv_desc += f", tp {args.tp} (SPMD mesh, kv head-sharded)"
+            if args.dp > 1:
+                kv_desc += (f" x dp {args.dp} (slots + pool blocks "
+                            f"dp-sharded)")
         if args.spec_k:
             kv_desc += (f", spec k={args.spec_k} "
                         f"(draft {draft_cfg.n_layers} layer(s))")

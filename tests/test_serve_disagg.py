@@ -462,3 +462,79 @@ def test_prefill_pinned_fleet_rejects_second_pool():
     ):
         with pytest.raises(ServeValidationError):
             validate_serve_spec(bad)
+
+
+class TestDpShardRouting:
+    """Pod-scale ingest routing (ISSUE 20), host-side: at dp > 1 every
+    KV arrival path — shipped blocks, fleet prefix pulls, host-tier
+    restores — funnels through ``ingest_shipment``, which picks the dp
+    shard that will SEAT the request with the same ``choose_dp_shard``
+    the admission planner uses, and the PrefixCache's ``within=``
+    extent filter is what keeps a shard from crediting a donor living
+    on another shard's pool slice. The pure pieces are pinned here;
+    the device-level proof (shipped rows and tier restores landing on
+    the seating shard's extent of a REALLY dp-sharded pool, then
+    decoding bit-identically) is the tpdp ingest cell in
+    tools/serve_tp_check.py via tests/test_serve_tp.py."""
+
+    def test_prefix_match_respects_shard_extent(self):
+        from tf_operator_tpu.serve.kvcache import PrefixCache
+
+        cache = PrefixCache(block=BLOCK)
+        toks = prompt_of(16, 3).reshape(-1)
+        logits = np.zeros(CFG.vocab_size, np.float32)
+        cache.register(toks, [5, 9], logits)       # shard-0 blocks
+        # Unrestricted and shard-0-extent lookups both credit it...
+        assert cache.lookup(toks)[0] == 16
+        assert cache.lookup(toks, within=(1, 17))[0] == 16
+        # ...but probed WITHIN shard 1's extent the donor is a miss:
+        # its blocks are table-unreferenceable from shard 1.
+        assert cache.lookup(toks, within=(17, 34))[0] == 0
+
+    def test_peek_is_side_effect_free(self):
+        from tf_operator_tpu.serve.kvcache import PrefixCache
+
+        cache = PrefixCache(block=BLOCK)
+        toks = prompt_of(8, 4).reshape(-1)
+        cache.register(toks, [20], np.zeros(CFG.vocab_size, np.float32))
+        hits0, misses0 = cache.hits, cache.misses
+        n, blocks, logits = cache.peek(toks, within=(17, 34))
+        assert n == 8 and blocks == (20,) and logits is not None
+        assert cache.peek(prompt_of(8, 5).reshape(-1))[0] == 0
+        # The planner probes EVERY shard per admission: counters and
+        # LRU order must reflect only the chosen shard's real lookup.
+        assert (cache.hits, cache.misses) == (hits0, misses0)
+
+    def test_mixed_extent_entry_is_no_shards_match(self):
+        from tf_operator_tpu.serve.kvcache import PrefixCache
+
+        cache = PrefixCache(block=BLOCK)
+        toks = prompt_of(16, 6).reshape(-1)
+        # An entry straddling both extents (impossible under extent-
+        # bounded allocation, possible after a bug) never yields a
+        # cross-shard table: shard 0 downgrades to the aligned
+        # sub-prefix whose blocks it CAN reference (n=8, block 5),
+        # shard 1 — which can reference neither block — sees a miss.
+        cache.register(toks, [5, 20],
+                       np.zeros(CFG.vocab_size, np.float32))
+        n, blocks, _ = cache.lookup(toks, within=(1, 17))
+        assert n == 8 and blocks == (5,)
+        assert cache.lookup(toks, within=(17, 34))[0] == 0
+        assert cache.lookup(toks)[0] == 16
+
+    def test_ingest_at_dp1_keeps_global_pool(self, params):
+        # The dp=1 funnel is untouched: no shard targeting, blocks from
+        # the global heap, exact-hit join skips prefill — the existing
+        # TestIngest pins ride this same path.
+        eng = ContinuousEngine(CFG, params, max_slots=2,
+                               kv_block=BLOCK)
+        pw = PrefillWorker(CFG, params, kv_block=BLOCK)
+        prompt = prompt_of(9, 7)
+        hold = eng.ingest_shipment(decode_shipment(pw.prefill(prompt)),
+                                   reserve_steps=4)
+        assert hold is not None
+        plan = eng.plan_admission(prompt, 4)
+        assert plan is not None and plan.dp_shard == 0
+        assert plan.prefill_tokens == 0
+        eng.release_plan(plan)
+        eng.release_shipment(hold)

@@ -501,3 +501,117 @@ def test_spec_engine_budget_and_validation(params, draft_params):
     assert alloc.high_water == 3
     with pytest.raises(ValueError, match="max_slots"):
         SlotAllocator(0)
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale decode (ISSUE 20): the dp-sharded capacity layer, host-side.
+# The device-level tp x dp bit-identity/ingest/replay pins live in
+# tests/test_serve_tp.py (slow, subprocess — a dp>1 engine needs a
+# multi-device mesh this tier-1 process cannot host); everything the
+# engine DECIDES about dp, it decides with the pure pieces below.
+# ---------------------------------------------------------------------------
+
+
+def test_slot_allocator_dp_slices():
+    from tf_operator_tpu.serve.kvcache import SlotAllocator
+
+    alloc = SlotAllocator(4, dp=2)
+    # Shard-targeted acquire stays inside the shard's slot slice and
+    # is lowest-free deterministic within it.
+    assert alloc.acquire(shard=1) == 2
+    assert alloc.acquire(shard=0) == 0
+    assert alloc.free_in(0) == 1 and alloc.free_in(1) == 1
+    assert alloc.acquire(shard=1) == 3
+    assert alloc.acquire(shard=1) is None  # shard 1 full, shard 0 not
+    assert alloc.free == 1
+    alloc.release(2)
+    assert alloc.free_in(1) == 1
+    with pytest.raises(ValueError, match="dp"):
+        SlotAllocator(3, dp=2)  # slices must be equal
+
+
+def test_block_allocator_dp_extents():
+    from tf_operator_tpu.serve.kvcache import BlockAllocator
+
+    blocks = BlockAllocator(34, dp=2)
+    lo0, hi0 = blocks.shard_extent(0)
+    lo1, hi1 = blocks.shard_extent(1)
+    assert (lo0, hi0) == (1, 17) and (lo1, hi1) == (17, 34)
+    got = blocks.alloc(4, shard=1)
+    assert got is not None and all(lo1 <= b < hi1 for b in got)
+    assert blocks.free_in(1) == (hi1 - lo1) - 4
+    # Shard-0 capacity is untouched by shard-1 allocations.
+    assert blocks.free_in(0) == hi0 - lo0
+    # A shard never overdraws its own extent even when the OTHER shard
+    # has room — that is what keeps every table entry inside its
+    # shard's pool tile.
+    assert blocks.alloc(hi0 - lo0 + 1, shard=0) is None
+    blocks.free(got)
+    assert blocks.free_in(1) == hi1 - lo1
+
+
+def test_choose_dp_shard_ranking():
+    from tf_operator_tpu.serve.engine import choose_dp_shard
+
+    # Deepest shard-local prefix wins, regardless of free blocks.
+    assert choose_dp_shard([1, 1], [16, 2], [0, 8]) == 1
+    # Depth tie -> most free blocks.
+    assert choose_dp_shard([1, 1], [3, 9], [4, 4]) == 1
+    # Full tie -> lowest index (deterministic).
+    assert choose_dp_shard([2, 2], [8, 8], [0, 0]) == 0
+    # A shard with no free slot is never seated, whatever its prefix.
+    assert choose_dp_shard([0, 1], [16, 2], [99, 0]) == 1
+    assert choose_dp_shard([0, 0], [16, 16], [0, 0]) is None
+
+
+def test_dp_occupancy_walk_host_side():
+    """The dp-occupancy walk at the capacity layer: a join/retire churn
+    driven through choose_dp_shard + the dp allocators, asserting the
+    invariants the device-level tpdp walk relies on — every seated
+    request's slot shard matches the shard that allocated its blocks,
+    blocks stay inside that shard's extent for the request's whole
+    life, and retiring returns capacity to the SAME shard."""
+    from tf_operator_tpu.serve.engine import choose_dp_shard
+    from tf_operator_tpu.serve.kvcache import (
+        BlockAllocator,
+        SlotAllocator,
+    )
+    from tf_operator_tpu.serve.sharding import shard_of_slot
+
+    dp, max_slots = 2, 4
+    slots = SlotAllocator(max_slots, dp=dp)
+    blocks = BlockAllocator(34, dp=dp)
+    rng = np.random.default_rng(5)
+    live = {}
+    for step in range(200):
+        if live and (step % 3 == 2 or slots.free == 0):
+            slot, (shard, held) = live.popitem()
+            blocks.free(held)
+            slots.release(slot)
+            assert blocks.free_in(shard) >= len(held)
+            continue
+        need = int(rng.integers(1, 5))
+        shard = choose_dp_shard(
+            [slots.free_in(i) for i in range(dp)],
+            [blocks.free_in(i) for i in range(dp)],
+            [0] * dp,
+        )
+        if shard is None:
+            continue
+        got = blocks.alloc(need, shard=shard)
+        if got is None:
+            continue
+        slot = slots.acquire(shard=shard)
+        assert slot is not None  # choose_dp_shard saw a free slot
+        assert shard_of_slot(slot, max_slots, dp) == shard
+        lo, hi = blocks.shard_extent(shard)
+        assert all(lo <= b < hi for b in got)
+        live[slot] = (shard, got)
+    for slot, (shard, held) in live.items():
+        blocks.free(held)
+        slots.release(slot)
+    assert slots.free == max_slots
+    assert all(
+        blocks.free_in(i) == blocks.shard_extent(i)[1]
+        - blocks.shard_extent(i)[0] for i in range(dp)
+    )

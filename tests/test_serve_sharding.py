@@ -12,10 +12,14 @@ from jax.sharding import PartitionSpec as P
 
 from tf_operator_tpu.serve.sharding import (
     cache_specs,
+    dp_size_of,
     leaf_spec,
     logits_spec,
     mesh_debug,
+    shard_block_extent,
+    shard_of_slot,
     ship_specs,
+    slot_spec,
     tp_size_of,
 )
 
@@ -103,9 +107,10 @@ class TestLogitsSpec:
 class TestDpAxis:
     """The ``dp`` mesh axis over slots (PR 10 follow-on, ISSUE 14):
     per-slot leaves shard their leading slot axis, the shared paged
-    pool replicates over dp — specs as pure data; the tp×dp engine
-    bit-identity matrix is the declared stretch behind a slow marker
-    once a >1-device dp engine lands."""
+    pool replicates over dp — specs as pure data. The tp×dp engine
+    bit-identity matrix LANDED with ISSUE 20 (tests/test_serve_tp.py's
+    tpdp cells, slow-marked); the pool-sharding opt-in it uses is
+    TestDpPool below."""
 
     def test_stacked_dense_rows_shard_slots_over_dp(self):
         # [slots, 1, S, KV, Dh]: dp on the slot axis, tp on KV.
@@ -161,6 +166,84 @@ class TestDpAxis:
         assert leaf_spec("cached_key", (4, 1, 64, 4, 16), 2) == \
             P(None, None, None, "tp", None)
         assert leaf_spec("block_table", (4, 8), 2) == P()
+
+
+class TestDpPool:
+    """Pod-scale decode (ISSUE 20): with ``dp_pool=True`` the paged
+    pool's BLOCK axis shards over dp — legal only because the engine
+    allocates each dp shard's slots exclusively from that shard's
+    ``shard_block_extent`` slice, so no slot's table ever references a
+    block outside its own shard's tile. Pure spec/extent math here; the
+    device-level pins (per-device pool shape, extent containment
+    across an occupancy walk, ingest landing on the seating shard) are
+    the tpdp cells in tools/serve_tp_check.py."""
+
+    def test_dp_pool_shards_block_axis(self):
+        # [nb, blk, KV, Dh]: dp on blocks, tp on KV — the 2-D layout.
+        assert leaf_spec("pool_key", (34, 8, 4, 16), 2, dp_size=2,
+                         dp_pool=True) == P("dp", None, "tp", None)
+        assert leaf_spec("pool_value_scale", (34, 8, 4), 2, dp_size=2,
+                         dp_pool=True) == P("dp", None, "tp")
+
+    def test_dp_pool_untileable_blocks_fall_back(self):
+        # 33 blocks over dp=2: the dp component drops (the engine
+        # prevents this case by rounding kv_blocks up to a dp multiple
+        # — extents must coincide with XLA tile boundaries).
+        assert leaf_spec("pool_key", (33, 8, 4, 16), 2, dp_size=2,
+                         dp_pool=True) == P(None, None, "tp", None)
+
+    def test_dp_pool_off_keeps_replicated_pool(self):
+        assert leaf_spec("pool_key", (34, 8, 4, 16), 2, dp_size=2,
+                         dp_pool=False) == P(None, None, "tp", None)
+
+    def test_cache_specs_thread_dp_pool(self):
+        tree = {"attn": {"pool_key": arr(34, 8, 4, 16),
+                         "block_table": arr(4, 8)}}
+        specs = cache_specs(tree, 2, dp_size=2, dp_pool=True)
+        assert specs["attn"]["pool_key"] == P("dp", None, "tp", None)
+        assert specs["attn"]["block_table"] == P("dp", None)
+
+    def test_slot_spec_tiles_or_replicates(self):
+        assert slot_spec((4, 64), 2) == P("dp", None)
+        assert slot_spec((4,), 2) == P("dp")
+        assert slot_spec((3, 64), 2) == P()   # untileable
+        assert slot_spec((4, 64), 1) == P()   # dp=1: the old layout
+
+    def test_shard_of_slot_slices_the_slot_axis(self):
+        # 4 slots over dp=2: slots 0-1 -> shard 0, slots 2-3 -> shard 1.
+        assert [shard_of_slot(s, 4, 2) for s in range(4)] == \
+            [0, 0, 1, 1]
+        assert shard_of_slot(3, 4, 1) == 0
+
+    def test_shard_block_extent_partitions_the_pool(self):
+        # 34 blocks over dp=2, block 0 reserved (garbage): shard 0 owns
+        # [1, 17), shard 1 owns [17, 34) — disjoint, covering, and each
+        # lo/hi a multiple of the 17-block XLA tile (except the
+        # reserved clamp).
+        assert shard_block_extent(0, 34, 2) == (1, 17)
+        assert shard_block_extent(1, 34, 2) == (17, 34)
+        # dp=1 (and the None-shard path): the whole pool minus reserve.
+        assert shard_block_extent(0, 34, 1) == (1, 34)
+
+    def test_extents_cover_disjointly(self):
+        for dp in (2, 3, 4):
+            nb = 12 * dp
+            spans = [shard_block_extent(i, nb, dp) for i in range(dp)]
+            assert spans[0][0] == 1          # reserve clamped out
+            assert spans[-1][1] == nb
+            for (_, hi), (lo2, _) in zip(spans, spans[1:]):
+                assert hi == lo2             # no gap, no overlap
+
+    def test_dp_size_of_reads_the_axis(self):
+        class FakeDevices:
+            size = 4
+
+        class FakeMesh:
+            devices = FakeDevices()
+            shape = {"tp": 2, "dp": 2}
+
+        assert dp_size_of(FakeMesh()) == 2
+        assert dp_size_of(None) == 1
 
 
 class TestShipSpecs:

@@ -1,7 +1,7 @@
 """SPMD tensor-parallel decode exactness (slow tier): the
 {dense, paged} x {one-shot, chunked} bit-identity matrix at tp=2 plus
-the supervisor crash/replay drill, via tools/serve_tp_check.py in a
-SUBPROCESS — a >1-device CPU needs
+the supervisor crash/replay drill, and the pod-scale {tp=2, dp=2}
+cells (ISSUE 20), via tools/serve_tp_check.py in a SUBPROCESS — a >1-device CPU needs
 ``--xla_force_host_platform_device_count`` set before jax imports,
 which this (already-jax-initialized, single-device) test process cannot
 do for itself. Slow-marked: tier-1 has no headroom for another
@@ -43,3 +43,31 @@ def test_tp2_matrix_and_supervisor_replay_bit_identical():
         assert f"serve_tp_check: {cell} ok" in out, out
     assert "supervisor replay ok" in out, out
     assert "serve_tp_check: OK" in out, out
+
+
+def test_tp2_dp2_pod_scale_bit_identical():
+    """Pod-scale decode (ISSUE 20): ONE engine over the 2-D {tp=2,
+    dp=2} mesh — every layout cell bit-identical to the canonical tp
+    oracle with zero post-warmup recompiles, shipped-KV and host-tier
+    restores landing on the seating dp shard's block extent, and the
+    supervisor rebuilding the 2-D mesh through the factory."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "serve_tp_check.py"),
+         "--tp", "2", "--dp", "2"],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout
+    for cell in ("tpdp dense", "tpdp paged", "tpdp kv8", "tpdp pallas",
+                 "tpdp ship ingest", "tpdp tier ingest",
+                 "tpdp supervisor replay"):
+        assert f"serve_tp_check: {cell} ok" in out, out
+    assert "serve_tp_check: OK (tp=2, dp=2" in out, out

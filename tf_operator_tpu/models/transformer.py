@@ -555,9 +555,13 @@ class Attention(nn.Module):
         einsum, mask, and softmax all run shard-local per KV-head group
         with ZERO collectives inside the attend (the only per-layer
         collective is the out-projection's all-reduce, exactly as in tp
-        training) and no per-step host sync. Without a mesh the
-        constraints vanish and the math is byte-for-byte the single-chip
-        path.
+        training) and no per-step host sync. A ``dp`` (batch_axis) mesh
+        axis composes on top (the pod-scale tp×dp engine): the lane
+        axis of the gathered tensors joins the dp shard when lanes
+        tile, matching the slot-sharded tables and the extent-bounded
+        pool slices, so the whole attend stays shard-local on BOTH
+        axes. Without a mesh the constraints vanish and the math is
+        byte-for-byte the single-chip path.
         """
         cfg = self.cfg
         b, t, h, dh = q.shape
@@ -649,6 +653,7 @@ class Attention(nn.Module):
                 k_scale_pool=pool_ks.value if kv8 else None,
                 v_scale_pool=pool_vs.value if kv8 else None,
                 mesh=cfg.mesh, tp_axis=cfg.tp_axis,
+                dp_axis=cfg.batch_axis,
             )
             return out.astype(cfg.dtype)
         keys = pool_k.value[table.value].reshape(
@@ -671,6 +676,18 @@ class Attention(nn.Module):
             cfg.mesh.shape.get(cfg.tp_axis, 1)
             if cfg.mesh is not None else 1
         )
+        dp = (
+            cfg.mesh.shape.get(cfg.batch_axis, 1)
+            if cfg.mesh is not None else 1
+        )
+        # Pod-scale tp×dp engines (serve/engine.py) shard the lane
+        # (slot) axis over dp, and the extent-bounded allocator keeps
+        # each lane's table inside its own shard's pool slice — so the
+        # gathered [b, S, ...] tensors carry a dp component on dim 0
+        # when lanes tile, keeping the gather AND the softmax
+        # shard-local on both mesh axes. dp=1 (or non-tiling b) leaves
+        # the tp-only specs byte-for-byte.
+        bdim = cfg.batch_axis if (dp > 1 and b % dp == 0) else None
         if tp > 1 and kv % tp == 0:
             # Head-sharded placement pinned end to end: the gather stays
             # on each chip's KV/tp heads of the pool and the masked
@@ -683,14 +700,14 @@ class Attention(nn.Module):
                 )
 
             hspec = jax.sharding.PartitionSpec(
-                None, None, cfg.tp_axis, None
+                bdim, None, cfg.tp_axis, None
             )
             keys = _pin(keys, hspec)
             vals = _pin(vals, hspec)
             if kv8:
                 # The gathered scale rows ride their head shard.
                 sspec = jax.sharding.PartitionSpec(
-                    None, None, cfg.tp_axis
+                    bdim, None, cfg.tp_axis
                 )
                 k_scales = _pin(k_scales, sspec)
                 v_scales = _pin(v_scales, sspec)
@@ -706,7 +723,7 @@ class Attention(nn.Module):
             s = s * k_scales.transpose(0, 2, 1)[:, :, None, None, :]
         if tp > 1 and kv % tp == 0:
             s = _pin(s, jax.sharding.PartitionSpec(
-                None, cfg.tp_axis, None, None, None
+                bdim, cfg.tp_axis, None, None, None
             ))
         s = s * (dh ** -0.5)
         # Lane i's query row j (absolute pos[i, j]) sees keys <= pos[i, j].

@@ -172,6 +172,11 @@ def select_block(tq: int, tk: int, *, compiled: bool = False,
 # Trigger stays OPEN; cap stays 1024; the qblock+kvblock stage pair
 # keeps its front slot in window_autorun's unmeasured set for the
 # next hardware window.
+# Re-checked (PR 20, 2026-08-07): unchanged — window_r05 is still the
+# newest window (only the 082804 / 091000_hostlocal stamps exist) and
+# neither carries probe_qblock or probe_kvblock arbitration output.
+# Trigger stays OPEN; cap stays 1024; the qblock+kvblock pair keeps
+# its front slot for the next hardware window.
 MAX_Q_BLOCK = 1024
 
 

@@ -319,6 +319,7 @@ def paged_attend(
     v_scale_pool: jax.Array | None = None,
     mesh=None,
     tp_axis: str = "tp",
+    dp_axis: str = "dp",
     interpret: bool | None = None,
 ) -> jax.Array:
     """Paged decode attention straight off the block table.
@@ -355,11 +356,21 @@ def paged_attend(
             f"paged_attend: KV={kv} does not tile tp={tp} — use "
             "kv_attend='gather' for this mesh"
         )
-    shard = tp > 1 and kv % tp == 0
+    dp = (mesh.shape.get(dp_axis, 1) if mesh is not None else 1)
+    # A dp (batch-parallel) mesh axis splits the LANE axis of the
+    # shard_map grid (the pod-scale tp×dp engine slot-shards its
+    # lanes): each (dp, tp) cell runs the kernel over its own slot
+    # slice. The POOL stays dp-UNMENTIONED — every cell sees the whole
+    # pool, so the table's GLOBAL block indices stay valid inside the
+    # kernel unchanged (the per-step dp all-gather of the pool is the
+    # documented cost of the pallas path at dp>1; the gather attend
+    # keeps the pool shard-local instead).
+    bshard = dp > 1 and b % dp == 0
+    shard = (tp > 1 and kv % tp == 0) or bshard
     if not paged_attend_supported(
         table_len * blk, kv, dh,
         kv_int8=kv8, dtype_bytes=pool_k.dtype.itemsize,
-        tp=tp if shard else 1,
+        tp=tp if tp > 1 and kv % tp == 0 else 1,
     ):
         raise ValueError(
             f"paged_attend: S={table_len * blk} x KV={kv}"
@@ -378,11 +389,14 @@ def paged_attend(
     scale_pools = (k_scale_pool, v_scale_pool) if kv8 else ()
     if shard:
         P = jax.sharding.PartitionSpec
-        pool_spec = P(None, None, tp_axis, None)
-        lane_spec = P(None, tp_axis, None, None)
-        in_specs = [P(), P(), P(), lane_spec, pool_spec, pool_spec]
+        hdim = tp_axis if tp > 1 else None
+        bdim = dp_axis if bshard else None
+        pool_spec = P(None, None, hdim, None)
+        lane_spec = P(bdim, hdim, None, None)
+        in_specs = [P(bdim, None), P(bdim), P(bdim), lane_spec,
+                    pool_spec, pool_spec]
         if kv8:
-            in_specs += [P(None, None, tp_axis)] * 2
+            in_specs += [P(None, None, hdim)] * 2
         out = parallel_compat.shard_map(
             run, mesh=mesh,
             in_specs=tuple(in_specs), out_specs=lane_spec,

@@ -72,6 +72,14 @@ mask its proposals, verify re-masks the target chunk rows with the
 same state chain, and a mask violation is just a rejection — the PR 15
 rewind machinery is unchanged.
 
+At dp > 1 (pod scale, ISSUE 20) the pools stay REPLICATED over the dp
+axis while per-slot FSM rows shard with the slot axis: every dp shard
+gathers its own slots' ``allow``/``next`` rows from a full local copy
+(the rows are vocab-wide and shared across slots — slicing them per
+shard would tear the gather), so constrained decode at tp x dp is the
+same data path with zero extra collectives; the tpdp cells in
+tools/serve_tp_check.py ride the same pinned step.
+
 See docs/constrained-decoding.md for the memory math, the spec-decode
 composition table, and the stop/logprobs/n-best response semantics.
 """

@@ -44,7 +44,13 @@ on the serving wire is stdlib HTTP + JSON. The rows are the paged pool
 layout already (``[rows, KV, Dh]`` per layer), which is what makes the
 transfer payload trivial; ``serve/sharding.ship_specs`` names each wire
 leaf's placement for the tp>1 case (rows enter replicated and the
-ingest scatter writes each chip's KV/tp head shard).
+ingest scatter writes each chip's KV/tp head shard). At dp > 1 (pod
+scale, ISSUE 20) the wire rows STILL carry no dp component — the decode
+side's ``ingest_shipment`` picks the dp shard that will seat the
+request (the same ``choose_dp_shard`` its admission planner uses),
+allocates only from that shard's block extent, and the scatter lands
+the rows on that shard's pool slice; tools/serve_tp_check.py's tpdp
+ingest cell pins it.
 
 This module imports jax lazily: the fleet test tier and the router load
 it jax-free (FakePrefillBackend, digest helpers, the HTTP server).

@@ -77,6 +77,25 @@ tp=1. Greedy output stays bit-identical to solo ``generate`` with the
 same tp-sharded params on an f32 CPU mesh (tests/test_serve_tp.py, via
 the ``--xla_force_host_platform_device_count`` trick).
 
+POD-SCALE decode (a 2-D ``tp×dp`` mesh): the ``dp`` axis
+batch-parallelizes the SLOT dimension on top of the tp split — one
+compiled step still drives the whole slice. Slot-leading leaves
+(per-slot counters, key ladders, fsm rows, block tables, the dense
+slot tensor, the sampling logits' slot axis) shard dim 0 over dp; the
+paged pool's BLOCK axis joins the dp split too
+(serve/sharding.leaf_spec ``dp_pool``), made legal by allocator
+discipline: each dp shard owns the contiguous slot slice
+``[i*per, (i+1)*per)`` and the matching block extent
+(``shard_block_extent``), and ``plan_admission`` picks the owning
+shard GLOBALLY (``choose_dp_shard``: deepest shard-local prefix, then
+freest blocks) so every slot's table references only its own shard's
+pool slice. dp never shards a reduction dimension, so per-slot math is
+untouched — greedy output stays bit-identical to solo ``generate`` at
+{tp=2, dp=2} across occupancy on both axes (tools/serve_tp_check.py
+``run_tpdp``), and shipped/pulled/tier-restored KV lands on the shard
+that will seat the request (``ingest_shipment`` routes through the
+same shard choice).
+
 Batch-wide SPECULATIVE decode (``spec_k >= 1``): every decode iteration
 becomes one ROUND — a per-slot draft of k tokens (ONE compiled
 executable: the solo draft scan vmapped over slots, sampling params and
@@ -168,10 +187,34 @@ from tf_operator_tpu.serve.kvcache import (
 from tf_operator_tpu.serve.sharding import (
     cache_specs,
     constrain_tree,
+    dp_size_of,
     logits_spec,
     mesh_debug,
+    slot_spec,
     tp_size_of,
 )
+
+
+def choose_dp_shard(free_slots, free_blocks, prefix_depths):
+    """Pick the dp shard for one paged admission from per-shard stats
+    (index-aligned lists over the dp axis): among shards with a free
+    slot, the DEEPEST shard-local prefix hit wins (reuse saves the most
+    prefill and the most blocks); ties break to the most free blocks
+    (load-spread), then the lowest index (determinism). Returns None
+    when no shard has a free slot — the caller queues, exactly like
+    global slot exhaustion. Pure host data: the global-admission policy
+    is unit-testable without a device, and every ingest path (shipped
+    KV, fleet prefix pulls, host-tier restores) routes through the SAME
+    choice so a landed prefix and the request that uses it agree on the
+    owning shard."""
+    best = None
+    for i, slots in enumerate(free_slots):
+        if slots <= 0:
+            continue
+        key = (prefix_depths[i], free_blocks[i], -i)
+        if best is None or key > best[0]:
+            best = (key, i)
+    return None if best is None else best[1]
 
 
 def _ship_row_paths(tree: Any, prefix: tuple = ()):
@@ -233,6 +276,10 @@ class AdmissionPlan:
     write_table: np.ndarray | None = None  # shared/unused entries -> 0
     cow: tuple | None = None      # (table_entry, dst_block)
     logits: np.ndarray | None = None  # exact-match stored sampling row
+    dp_shard: int = 0             # owning dp shard (0 at dp=1): the
+    # slot slice the join acquires from AND the block extent every
+    # reserved block sits in — chosen once by choose_dp_shard so the
+    # plan's tables can only ever reference the shard's own pool slice.
     settled: bool = False         # consumed by a join OR released
 
     @property
@@ -269,7 +316,8 @@ class ContinuousEngine:
                  kv_paged: bool = True, kv_block: int = 64,
                  kv_blocks: int | None = None, kv_attend: str = "gather",
                  faults: Any = None, mesh: Any = None,
-                 tp_axis: str = "tp", spec_k: int = 0,
+                 tp_axis: str = "tp", dp_axis: str = "dp",
+                 spec_k: int = 0,
                  draft_cfg: TransformerConfig | None = None,
                  draft_params: Any = None,
                  constrain_rows: int = 128,
@@ -365,6 +413,24 @@ class ContinuousEngine:
         self.mesh = mesh
         self.tp_axis = tp_axis
         self._tp = tp_size_of(mesh, tp_axis)
+        # Pod-scale decode: a ``dp`` mesh axis batch-parallelizes the
+        # SLOT dimension — slot-leading state (counters, tables, keys,
+        # fsm, dense K/V rows, the paged pool's block axis) shards over
+        # dp while params and K/V heads shard over tp, and ONE compiled
+        # step still drives the whole 2-D slice. Admission plans
+        # globally: each dp shard owns a contiguous slot slice and its
+        # own block extent (serve/sharding.shard_of_slot /
+        # shard_block_extent), so every slot's table points only inside
+        # its shard's pool slice. dp=1 (or no dp axis) is the tp-only
+        # engine bit-for-bit.
+        self.dp_axis = dp_axis
+        self._dp = dp_size_of(mesh, dp_axis)
+        if self._dp > 1 and self.max_slots % self._dp:
+            raise ValueError(
+                f"max_slots={self.max_slots} must be a multiple of the "
+                f"dp mesh axis ({self._dp}): each dp shard owns an "
+                "equal contiguous slot slice"
+            )
         if mesh is not None:
             from tf_operator_tpu.models.transformer import (
                 param_sharding_rules,
@@ -407,7 +473,7 @@ class ContinuousEngine:
         # Solo DENSE model: prefill (one-shot, chunked, and suffix) and
         # the dense cache layout every insert consumes.
         self._solo_model = Transformer(dcfg)
-        self.alloc = SlotAllocator(self.max_slots)
+        self.alloc = SlotAllocator(self.max_slots, dp=self._dp)
 
         n, v, s = self.max_slots, cfg.vocab_size, cfg.max_seq_len
         if self.kv_paged:
@@ -423,6 +489,15 @@ class ContinuousEngine:
                 # Default pool = exactly the dense slot tensor's budget
                 # (every slot at max length) + the pinned garbage block.
                 kv_blocks = self.max_slots * self.table_len + 1
+            if self._dp > 1 and int(kv_blocks) % self._dp:
+                # Round UP to a dp multiple: the pool's block axis only
+                # joins the dp shard when it tiles, and an even split
+                # makes the XLA tile boundaries coincide exactly with
+                # the allocator's shard_block_extent slices. Rounding
+                # up only ADDS capacity, so a user-given budget is
+                # never silently shrunk.
+                kv_blocks = (int(kv_blocks) + self._dp
+                             - int(kv_blocks) % self._dp)
             self.kv_blocks = int(kv_blocks)
             # The paged model carries the mesh so its decode attend can
             # pin the gather/einsum/softmax to the head-sharded pool
@@ -432,11 +507,16 @@ class ContinuousEngine:
                            tp_axis=self.tp_axis,
                            kv_attend=self.kv_attend)
             self._model = Transformer(pcfg)
-            self.blocks = BlockAllocator(self.kv_blocks)
+            self.blocks = BlockAllocator(self.kv_blocks, dp=self._dp)
             self.prefix = PrefixCache(self.kv_block)
+            # dp>1 opts the pool's block axis into the dp split
+            # (sharding._POOL_LEADING_MIN_RANK): legal exactly because
+            # the allocator above partitions the block-index space into
+            # the matching extents.
             self._cache = paged_cache_template(self._model, n,
                                                mesh=self.mesh,
-                                               tp_axis=self.tp_axis)
+                                               tp_axis=self.tp_axis,
+                                               dp_pool=self._dp > 1)
             constraint = self._make_constraint()
             self._constraint = constraint
             self._paged_insert = make_paged_insert_fn(
@@ -501,8 +581,8 @@ class ContinuousEngine:
                 constraint=self._make_constraint()
             )
         self._logits = self._place_logits(jnp.zeros((n, v), jnp.float32))
-        self._keys = self._replicate(jnp.zeros((n, s, 2), jnp.uint32))
-        self._stepidx = self._replicate(jnp.zeros((n,), jnp.int32))
+        self._keys = self._place_slots(jnp.zeros((n, s, 2), jnp.uint32))
+        self._stepidx = self._place_slots(jnp.zeros((n,), jnp.int32))
         # Structured decoding (serve/constrain.py): the paged constraint
         # pool — batch-wide allow/next tables the step reads as DATA,
         # row 0 the always-allow garbage program — plus the per-slot
@@ -511,10 +591,15 @@ class ContinuousEngine:
         # eager host-side scatters, so the zero-recompile pin holds.
         from tf_operator_tpu.serve.constrain import ProgramPool
 
+        # The allow/next tables stay REPLICATED even at dp>1: the mask
+        # gather reads full vocab rows per slot and vocab is unsharded
+        # on the dp axis, so replication is the correct layout (see
+        # sharding.replicate_put); only the per-slot fsm vector joins
+        # the slot shard.
         self.constrain_pool = ProgramPool(
             int(constrain_rows), v, put=self._replicate
         )
-        self._fsm = self._replicate(jnp.zeros((n,), jnp.int32))
+        self._fsm = self._place_slots(jnp.zeros((n,), jnp.int32))
         self._slot_program: dict[int, str] = {}  # slot -> bound digest
         self._last_logprobs = None  # (chosen, top_vals, top_ids) numpy
         # Host-side per-slot sampling state, passed into every step (tiny
@@ -570,7 +655,8 @@ class ContinuousEngine:
         )
         if self.mesh is not None:
             self._draft_specs = cache_specs(self._draft_cache, self._tp,
-                                            self.tp_axis)
+                                            self.tp_axis, self._dp,
+                                            self.dp_axis)
             mesh, dspecs = self.mesh, self._draft_specs
             draft_constraint = lambda t: constrain_tree(mesh, t, dspecs)
         else:
@@ -593,8 +679,8 @@ class ContinuousEngine:
         # lane's rng chain (solo speculative_generate's exact
         # split-per-round schedule — round count is data, so the chain
         # lives as state rather than a precomputed ladder).
-        self._pend = self._replicate(jnp.zeros((n,), jnp.int32))
-        self._spec_rng = self._replicate(jnp.zeros((n, 2), jnp.uint32))
+        self._pend = self._place_slots(jnp.zeros((n,), jnp.int32))
+        self._spec_rng = self._place_slots(jnp.zeros((n, 2), jnp.uint32))
         draft_impl = self._spec_draft_impl
         verify_impl = self._spec_verify_impl
         if self.mesh is not None:
@@ -618,23 +704,45 @@ class ContinuousEngine:
             self._cache_specs = None
             return None
         self._cache_specs = cache_specs(self._cache, self._tp,
-                                        self.tp_axis)
+                                        self.tp_axis, self._dp,
+                                        self.dp_axis,
+                                        dp_pool=self._dp > 1)
         mesh, specs = self.mesh, self._cache_specs
         return lambda tree: constrain_tree(mesh, tree, specs)
 
     def _replicate(self, x):
-        """Pin per-slot host-fed state (key ladders, counters) fully
-        replicated: a join's eager scatter update must hand the next
-        step an identically-placed array."""
+        """Pin batch-global host-fed state (the constraint pool's
+        allow/next tables) fully replicated: an eager scatter update
+        must hand the next step an identically-placed array."""
         if self.mesh is None:
             return x
         from tf_operator_tpu.serve.sharding import replicate_put
 
         return replicate_put(self.mesh, x)
 
+    def _place_slots(self, x):
+        """Pin SLOT-LEADING host-fed state (key ladders, step counters,
+        fsm rows, spec pend/rng) to the engine's slot layout: replicated
+        at dp=1 (slot_spec collapses to P(), bit-identical to the tp
+        engine's placement), dim-0-sharded over dp on a tp×dp mesh —
+        each dp group holds only its own slot slice. Joins/retires stay
+        eager host-dispatched scatters either way; the re-place keeps
+        every step input's sharding at the canonical fixed point."""
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(
+            x,
+            NamedSharding(
+                self.mesh, slot_spec(x.shape, self._dp, self.dp_axis)
+            ),
+        )
+
     def _place_logits(self, x):
         """Pin the [slots, vocab] sampling logits to the vocab-split
-        layout of the lm_head (or replicated when vocab doesn't tile):
+        layout of the lm_head (or replicated when vocab doesn't tile),
+        with the slot axis joining the dp shard on a tp×dp mesh:
         prefill rows land vocab-sharded and are consumed in place."""
         if self.mesh is None:
             return x
@@ -643,7 +751,9 @@ class ContinuousEngine:
         return jax.device_put(
             x,
             NamedSharding(
-                self.mesh, logits_spec(x.shape, self._tp, self.tp_axis)
+                self.mesh,
+                logits_spec(x.shape, self._tp, self.tp_axis,
+                            self._dp, self.dp_axis),
             ),
         )
 
@@ -652,14 +762,13 @@ class ContinuousEngine:
         engine's canonical shardings (cache per ``cache_specs``, logits
         vocab-split, counters/tokens replicated)."""
         from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
 
         mesh, specs = self.mesh, self._cache_specs
-        rep = NamedSharding(mesh, P())
+        dp, dp_axis = self._dp, self.dp_axis
         lsharding = NamedSharding(
             mesh,
             logits_spec((self.max_slots, self.cfg.vocab_size),
-                        self._tp, self.tp_axis),
+                        self._tp, self.tp_axis, dp, dp_axis),
         )
 
         def step(params, cache, logits, keys, stepidx, active,
@@ -673,9 +782,14 @@ class ContinuousEngine:
             cache, logits, stepidx, toks, fsm2 = out[:5]
             cache = constrain_tree(mesh, cache, specs)
             logits = jax.lax.with_sharding_constraint(logits, lsharding)
-            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
-            # fsm + any logprob rows replicate like the other per-slot
-            # counters — host-side joins/retires scatter them eagerly.
+            # fsm + any logprob rows take the slot layout like the
+            # other per-slot counters (replicated at dp=1, dim-0 over
+            # dp on a tp×dp mesh) — host-side joins/retires scatter
+            # them eagerly through _place_slots, so the donated
+            # round-trip stays at the same fixed point.
+            pin = lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, slot_spec(x.shape, dp, dp_axis))
+            )
             return (cache, logits, pin(stepidx), pin(toks),
                     pin(fsm2)) + tuple(pin(x) for x in out[5:])
 
@@ -687,6 +801,7 @@ class ContinuousEngine:
         info = mesh_debug(self.mesh)
         if self.mesh is not None:
             info["tp"] = self._tp
+            info["dp"] = self._dp
             info["kv_heads_sharded"] = bool(
                 self._tp > 1 and self.cfg.kv_heads % self._tp == 0
             )
@@ -719,12 +834,54 @@ class ContinuousEngine:
             )
         if self.kv_paged:
             cap = self._block_cap(prompt_len, num_steps)
-            if cap > self.kv_blocks - 1:
+            limit = self._max_alloc_blocks()
+            if cap > limit:
+                where = ("the pool" if self._dp <= 1
+                         else "each dp shard's extent")
                 raise ValueError(
                     f"prompt {prompt_len} + steps {num_steps} needs "
-                    f"{cap} KV blocks of {self.kv_block}; the pool has "
-                    f"only {self.kv_blocks - 1} allocatable"
+                    f"{cap} KV blocks of {self.kv_block}; {where} has "
+                    f"only {limit} allocatable"
                 )
+
+    def _max_alloc_blocks(self) -> int:
+        """Largest block count ONE request can ever hold: the whole
+        allocatable pool at dp=1, the widest shard extent at dp>1 — a
+        request lives entirely inside one dp shard's slice, so the
+        could-it-EVER-fit test must use the per-shard budget."""
+        if self._dp <= 1:
+            return self.kv_blocks - 1
+        return max(
+            hi - lo
+            for lo, hi in (self.blocks.shard_extent(i)
+                           for i in range(self._dp))
+        )
+
+    def _shard_free_blocks(self, shard: int | None) -> int:
+        """Free blocks in the admission's scope: the whole pool
+        (shard None, the dp=1 path) or one dp shard's extent."""
+        if shard is None:
+            return self.blocks.free_blocks
+        return self.blocks.free_in(shard)
+
+    def _pick_dp_shard(self, tokens) -> int | None:
+        """Global admission's shard choice at dp>1 (paged): probe every
+        shard's extent-local prefix depth side-effect-free
+        (``PrefixCache.peek`` — the losing shards' LRU must not move)
+        and rank through ``choose_dp_shard``. None = no shard has a
+        free slot (the caller queues)."""
+        dp = self._dp
+        depths = [
+            self.prefix.peek(
+                tokens, within=self.blocks.shard_extent(i)
+            )[0]
+            for i in range(dp)
+        ]
+        return choose_dp_shard(
+            [self.alloc.free_in(i) for i in range(dp)],
+            [self.blocks.free_in(i) for i in range(dp)],
+            depths,
+        )
 
     def _block_cap(self, prompt_len: int, num_steps: int) -> int:
         """Table entries one admission reserves: prompt + decode horizon
@@ -755,18 +912,33 @@ class ContinuousEngine:
             return AdmissionPlan(tokens, L, M)
         B = self.kv_block
         cap = self._block_cap(L, M)
-        n, shared, logits = self.prefix.lookup(tokens[0])
+        shard = None
+        if self._dp > 1:
+            # Global admission at dp>1: pick the owning shard FIRST
+            # (deepest shard-local prefix, then freest blocks), then
+            # look up the prefix WITHIN that shard's extent — a donor
+            # on another shard is a miss here, because this slot's
+            # table may only reference its own shard's pool slice.
+            shard = self._pick_dp_shard(tokens[0])
+            if shard is None:
+                return None  # no dp shard has a free slot
+            n, shared, logits = self.prefix.lookup(
+                tokens[0], within=self.blocks.shard_extent(shard)
+            )
+        else:
+            n, shared, logits = self.prefix.lookup(tokens[0])
         shared_entries = -(-n // B)
         cow_needed = n == L and n % B != 0
         need = cap - shared_entries + (1 if cow_needed else 0)
-        priv = self.blocks.alloc(need)
+        priv = self.blocks.alloc(need, shard=shard)
         if priv is None and self._retained:
             # Pool pressure: retained (completed-request) prefix holds
             # give way to live admissions before the caller is ever
             # told to queue — sparing the donor this very plan is
             # about to share from.
-            self._evict_retained(until_free=need, keep=shared)
-            priv = self.blocks.alloc(need)
+            self._evict_retained(until_free=need, keep=shared,
+                                 shard=shard)
+            priv = self.blocks.alloc(need, shard=shard)
         if priv is None:
             return None  # block exhaustion: the caller queues
         if n:
@@ -787,6 +959,7 @@ class ContinuousEngine:
             tokens, L, M, shared_tokens=n, shared_blocks=tuple(shared),
             private_blocks=tuple(priv), read_table=read,
             write_table=write, cow=cow, logits=logits,
+            dp_shard=0 if shard is None else shard,
         )
 
     def release_plan(self, plan: AdmissionPlan | None) -> None:
@@ -977,19 +1150,22 @@ class ContinuousEngine:
         self._evict_retained()
 
     def _evict_retained(self, until_free: int | None = None,
-                        keep=()) -> None:
+                        keep=(), shard: int | None = None) -> None:
         """Drop retained prefix holds, oldest first: down to the
         ``prefix_retain_max`` cap (no argument), or until the pool has
-        ``until_free`` free blocks (admission/ingest pressure). Holds
-        overlapping ``keep`` — the donor an in-flight plan is sharing
-        from — are spared."""
+        ``until_free`` free blocks (admission/ingest pressure) — in ONE
+        dp shard's extent when ``shard`` is given (dp>1 admissions
+        only care about their own shard's headroom; holds elsewhere
+        still evict on the way, oldest-first, which only widens other
+        shards' headroom). Holds overlapping ``keep`` — the donor an
+        in-flight plan is sharing from — are spared."""
         keep = set(int(b) for b in keep)
         for key in list(self._retained):
             if until_free is None:
                 if len(self._retained) <= max(
                         0, int(self.prefix_retain_max)):
                     break
-            elif self.blocks.free_blocks >= until_free:
+            elif self._shard_free_blocks(shard) >= until_free:
                 break
             blks = self._retained[key]
             if keep and not keep.isdisjoint(blks):
@@ -1043,10 +1219,12 @@ class ContinuousEngine:
         L = int(tokens.shape[0])
         B = self.kv_block
         cap = -(-L // B)
-        if cap > self.kv_blocks - 1:
+        if cap > self._max_alloc_blocks():
+            where = ("the pool" if self._dp <= 1
+                     else "each dp shard's extent")
             raise ValueError(
-                f"shipment of {L} tokens needs {cap} blocks; the pool "
-                f"has only {self.kv_blocks - 1} allocatable"
+                f"shipment of {L} tokens needs {cap} blocks; {where} "
+                f"has only {self._max_alloc_blocks()} allocatable"
             )
         n, _, logits = self.prefix.lookup(tokens)
         if n == L and logits is not None:
@@ -1054,17 +1232,30 @@ class ContinuousEngine:
             # nothing to write — admission will exact-hit the existing
             # entry. An empty hold keeps release idempotent.
             return ShipHold((), L, settled=True)
+        shard = None
+        if self._dp > 1:
+            # Land the rows on the dp shard that will SEAT the request:
+            # the same choose_dp_shard policy plan_admission runs, so
+            # the plan that follows finds the freshly-registered prefix
+            # inside its own shard's extent (this is what "shipped /
+            # pulled / tier-restored KV ingests onto the correct dp
+            # shard" means — the extent-bounded allocation below puts
+            # the scatter on that shard's pool slice, ship_specs keeps
+            # the wire rows dp-replicated on entry).
+            shard = self._pick_dp_shard(tokens)
+            if shard is None:
+                return None  # no dp shard has a free slot: requeue
         # The whole-request budget, not just the shipment's: the plan
         # that follows also needs the decode-horizon blocks (and the
         # CoW destination when the prompt ends mid-block).
         need = -(-(L + int(reserve_steps)) // B)
         if L % B:
             need += 1
-        if self.blocks.free_blocks < need and self._retained:
-            self._evict_retained(until_free=need)
-        if self.blocks.free_blocks < need:
+        if self._shard_free_blocks(shard) < need and self._retained:
+            self._evict_retained(until_free=need, shard=shard)
+        if self._shard_free_blocks(shard) < need:
             return None  # pool exhaustion: the caller requeues
-        blocks = self.blocks.alloc(cap)
+        blocks = self.blocks.alloc(cap, shard=shard)
         if blocks is None:
             return None  # pool exhaustion: the caller requeues
         try:
@@ -1440,7 +1631,7 @@ class ContinuousEngine:
         """Eager per-slot FSM row scatter (join/retire): the same tiny
         host-dispatched update discipline as the key ladders — the
         compiled step only ever sees [n] int32 data."""
-        self._fsm = self._replicate(
+        self._fsm = self._place_slots(
             self._fsm.at[slot].set(jnp.int32(row))
         )
 
@@ -1457,7 +1648,13 @@ class ContinuousEngine:
                 # and let the scheduler retry once rows free.
                 self.release_plan(plan)
                 return None
-        slot = self.alloc.acquire()
+        # dp>1: the slot comes from the plan's owning shard — its slice
+        # of the slot axis is the only one whose tables may reference
+        # the blocks the plan reserved. dp=1 keeps the global
+        # lowest-free acquire bit-for-bit.
+        slot = self.alloc.acquire(
+            shard=plan.dp_shard if self._dp > 1 else None
+        )
         if slot is None:  # single-caller contract makes this unreachable
             if program is not None:
                 self.constrain_pool.release(program.digest)
@@ -1491,10 +1688,10 @@ class ContinuousEngine:
         # scatter updates (no-op single-chip AND when already placed):
         # the decode step's input shardings must never drift.
         self._logits = self._place_logits(self._logits.at[slot].set(row))
-        self._keys = self._replicate(
+        self._keys = self._place_slots(
             self._keys.at[slot].set(jnp.asarray(keys))
         )
-        self._stepidx = self._replicate(self._stepidx.at[slot].set(0))
+        self._stepidx = self._place_slots(self._stepidx.at[slot].set(0))
         self._active[slot] = True
         plan.settled = True  # blocks now belong to the slot
         cow = None
@@ -1544,8 +1741,8 @@ class ContinuousEngine:
         # Small per-slot rows: eager scatter updates (no extra jit); the
         # re-place pins the canonical mesh layouts (no-op single-chip).
         logits = self._place_logits(logits.at[slot].set(logits1[0]))
-        keys = self._replicate(keys.at[slot].set(jnp.asarray(keys1)))
-        stepidx = self._replicate(stepidx.at[slot].set(0))
+        keys = self._place_slots(keys.at[slot].set(jnp.asarray(keys1)))
+        stepidx = self._place_slots(stepidx.at[slot].set(0))
         return cache, logits, keys, stepidx
 
     # -- decode -----------------------------------------------------------
@@ -1798,10 +1995,9 @@ class ContinuousEngine:
         buffers round-trip identically — the spec twin of
         ``_constrained_step``."""
         from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
 
         mesh, specs = self.mesh, self._draft_specs
-        rep = NamedSharding(mesh, P())
+        dp, dp_axis = self._dp, self.dp_axis
 
         def fn(dparams, dcache, pend, rng, active, temperature, top_p,
                has_top_p, allow_pool, next_pool, fsm):
@@ -1810,7 +2006,9 @@ class ContinuousEngine:
                               temperature, top_p, has_top_p,
                               allow_pool, next_pool, fsm)
             dcache = constrain_tree(mesh, dcache, specs)
-            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
+            pin = lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, slot_spec(x.shape, dp, dp_axis))
+            )
             return (dcache, pin(d_idx), pin(drafted), pin(qlogits),
                     pin(rng), pin(k_acc), pin(k_res), pin(k_bonus))
 
@@ -1818,11 +2016,10 @@ class ContinuousEngine:
 
     def _constrained_spec_verify(self, inner):
         from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
 
         mesh = self.mesh
         tspecs, dspecs = self._cache_specs, self._draft_specs
-        rep = NamedSharding(mesh, P())
+        dp, dp_axis = self._dp, self.dp_axis
 
         def fn(params, cache, dcache, pend, drafted, qlogits, k_acc,
                k_res, k_bonus, d_idx, active, temperature, top_p,
@@ -1834,7 +2031,9 @@ class ContinuousEngine:
             )
             cache = constrain_tree(mesh, cache, tspecs)
             dcache = constrain_tree(mesh, dcache, dspecs)
-            pin = lambda x: jax.lax.with_sharding_constraint(x, rep)
+            pin = lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, slot_spec(x.shape, dp, dp_axis))
+            )
             return (cache, dcache, pin(nxt_pend), pin(toks),
                     pin(counts), pin(fsm2))
 
@@ -1929,10 +2128,10 @@ class ContinuousEngine:
         else:
             rng = jax.random.PRNGKey(0)  # carried, never consumed
             pend = row[0].argmax(-1)
-        self._pend = self._replicate(
+        self._pend = self._place_slots(
             self._pend.at[slot].set(jnp.asarray(pend, jnp.int32))
         )
-        self._spec_rng = self._replicate(
+        self._spec_rng = self._place_slots(
             self._spec_rng.at[slot].set(rng)
         )
         if program is not None:
@@ -2075,6 +2274,19 @@ class ContinuousEngine:
             "prefix_exports": self.prefix_exports,
             "prefix_retained": len(self._retained),
         }
+        if self._dp > 1:
+            # Pod-scale decode: per-dp-shard capacity — the key is
+            # PRESENT only at dp>1, so tp-only snapshots stay
+            # bit-identical to the pre-dp accounting.
+            out["dp_shards"] = [
+                {
+                    "shard": i,
+                    "extent": list(self.blocks.shard_extent(i)),
+                    "blocks_free": self.blocks.free_in(i),
+                    "slots_free": self.alloc.free_in(i),
+                }
+                for i in range(self._dp)
+            ]
         if self.host_tier is not None:
             # Host-RAM KV tier — the key is PRESENT only with a tier
             # attached, so tier-off snapshots stay bit-identical to the

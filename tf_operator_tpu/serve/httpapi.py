@@ -194,6 +194,12 @@ def readiness_payload(sched: Any, *, draining: bool = False,
         # many chips — the router's least-loaded pick and the
         # autoscaler's capacity math can see it.
         payload["mesh_devices"] = int(mesh_devices)
+    mesh_axes = getattr(sched, "mesh_axes", None)
+    if mesh_axes is not None:
+        # Pod SHAPE, not just width: tp=2,dp=2 and tp=4 are both 4
+        # chips but a dp shard multiplies slot capacity, not per-slot
+        # speed — capacity math needs the split.
+        payload["mesh_axes"] = dict(mesh_axes)
     payload["requests_done"] = sched.requests_done
     payload["tokens_generated"] = sched.tokens_generated
     payload["watchdog_restarts"] = getattr(sched, "restarts", 0)

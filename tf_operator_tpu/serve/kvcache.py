@@ -115,16 +115,20 @@ def solo_cache_template(model: Any) -> Any:
     )
 
 
-def _maybe_shard(tree: Any, mesh: Any, tp_axis: str) -> Any:
+def _maybe_shard(tree: Any, mesh: Any, tp_axis: str,
+                 dp_pool: bool = False) -> Any:
     """Place a freshly-built cache tree per the engine's mesh layout
     (serve/sharding.py): K/V storage head-sharded over ``tp_axis``,
-    per-slot state replicated. mesh None = single-chip, tree
+    per-slot state dp-sharded when the mesh carries a ``dp`` axis (and
+    the pool's block axis too under ``dp_pool`` — the tp×dp engine's
+    extent-allocated layout). mesh None = single-chip, tree
     untouched."""
     if mesh is None:
         return tree
     from tf_operator_tpu.serve.sharding import shard_engine_state
 
-    return shard_engine_state(mesh, tree, tp_axis=tp_axis)
+    return shard_engine_state(mesh, tree, tp_axis=tp_axis,
+                              dp_pool=dp_pool)
 
 
 def stack_slots(template: Any, max_slots: int, mesh: Any = None,
@@ -133,7 +137,8 @@ def stack_slots(template: Any, max_slots: int, mesh: Any = None,
     [max_slots] axis, zero-filled. One allocation up front — occupancy
     changes never allocate or reshape anything again. Under a mesh the
     K/V rows are head-sharded at allocation (each chip holds KV/tp heads
-    of every row)."""
+    of every row); a dp axis additionally splits the slot axis so each
+    dp group holds only its own slots' rows."""
     return _maybe_shard(
         jax.tree.map(
             lambda x: jnp.zeros((max_slots,) + x.shape, x.dtype),
@@ -144,21 +149,25 @@ def stack_slots(template: Any, max_slots: int, mesh: Any = None,
 
 
 def paged_cache_template(model: Any, max_slots: int,
-                         mesh: Any = None, tp_axis: str = "tp") -> Any:
+                         mesh: Any = None, tp_axis: str = "tp",
+                         dp_pool: bool = False) -> Any:
     """The paged engine's whole cache state in one init: a [max_slots, 1]
     token batch through the kv_paged model builds the per-layer pools
     ([kv_num_blocks, kv_block, KV, Dh]), per-lane block tables
     ([max_slots, table_len] int32, all entries on the pinned block 0),
     and per-lane counters ([max_slots] int32). Under a mesh the pools
     are head-sharded at allocation — the per-chip pool footprint divides
-    by tp, which is what lets ``--kv-pool-blocks`` grow with the slice."""
+    by tp, which is what lets ``--kv-pool-blocks`` grow with the slice;
+    ``dp_pool=True`` (the tp×dp engine) splits the block axis over dp
+    too, on the promise that each dp shard's slots allocate only from
+    their own block extent."""
     return _maybe_shard(
         plain_tree(
             model.init(
                 jax.random.PRNGKey(0), jnp.zeros((max_slots, 1), jnp.int32)
             )["cache"]
         ),
-        mesh, tp_axis,
+        mesh, tp_axis, dp_pool,
     )
 
 
@@ -399,24 +408,49 @@ class SlotAllocator:
     and the serve bench's seeded schedules rely on — served from a heap:
     acquire is O(log n) where the original list scan (`min` + `remove`)
     was O(n) per call. Tracks a high-water mark and cumulative acquire
-    count for the /debug surface."""
+    count for the /debug surface.
 
-    def __init__(self, max_slots: int) -> None:
+    ``dp`` > 1 (the pod-scale tp×dp engine) partitions the slot space
+    into ``dp`` contiguous slices per ``sharding.shard_of_slot`` — one
+    heap per slice, so ``acquire(shard=i)`` hands out the lowest free
+    slot OWNED by dp shard i. ``acquire()`` with no shard stays the
+    global lowest-free policy (the head of the first non-empty slice
+    heap), which makes dp=1 behavior bit-identical to the original
+    single heap."""
+
+    def __init__(self, max_slots: int, dp: int = 1) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots={max_slots} must be >= 1")
+        if dp < 1 or max_slots % dp:
+            raise ValueError(
+                f"dp={dp} must be >= 1 and divide max_slots={max_slots}"
+            )
         self.max_slots = max_slots
-        self._heap = list(range(max_slots))  # ascending == already a heap
-        self._free_set = set(self._heap)
+        self.dp = dp
+        self._per = max_slots // dp
+        # Ascending ranges == already heaps; slice i owns
+        # [i*per, (i+1)*per), matching P(dp) on a slot-leading axis.
+        self._heaps = [
+            list(range(i * self._per, (i + 1) * self._per))
+            for i in range(dp)
+        ]
+        self._free_set = set(range(max_slots))
         self._lock = threading.Lock()
         self.acquired_total = 0
         self.high_water = 0
 
-    def acquire(self) -> int | None:
-        """Lowest free slot index, or None when fully occupied."""
+    def acquire(self, shard: int | None = None) -> int | None:
+        """Lowest free slot index — globally (``shard=None``), or within
+        dp shard ``shard``'s slot slice. None when the chosen scope is
+        fully occupied."""
         with self._lock:
-            if not self._heap:
+            if shard is None:
+                heap = next((h for h in self._heaps if h), None)
+            else:
+                heap = self._heaps[shard]
+            if not heap:
                 return None
-            slot = heapq.heappop(self._heap)
+            slot = heapq.heappop(heap)
             self._free_set.discard(slot)
             self.acquired_total += 1
             self.high_water = max(self.high_water, self.in_use)
@@ -428,8 +462,14 @@ class SlotAllocator:
                 raise ValueError(f"slot {slot} out of range")
             if slot in self._free_set:
                 raise ValueError(f"slot {slot} double-released")
-            heapq.heappush(self._heap, slot)
+            heapq.heappush(self._heaps[slot // self._per], slot)
             self._free_set.add(slot)
+
+    def free_in(self, shard: int) -> int:
+        """Free slots in dp shard ``shard``'s slice (admission's
+        per-shard capacity check)."""
+        with self._lock:
+            return len(self._heaps[shard])
 
     def reset_high_water(self) -> None:
         """Start a fresh high-water window at the current occupancy (the
@@ -457,30 +497,67 @@ class BlockAllocator:
     Refcounts: an exclusively-owned block has refcount 1; prefix sharing
     bumps it per sharer. ``free`` decrements and returns the blocks that
     actually hit zero (the caller invalidates PrefixCache entries that
-    referenced them)."""
+    referenced them).
 
-    def __init__(self, num_blocks: int, reserved: int = 1) -> None:
+    ``dp`` > 1 (the pod-scale tp×dp engine) partitions the block-index
+    space into per-shard extents per ``sharding.shard_block_extent`` —
+    one heap per extent, so ``alloc(k, shard=i)`` grants only blocks
+    INSIDE dp shard i's pool slice (what makes the dp-sharded pool
+    layout legal: every table entry of a shard's slots points at its
+    own slice). ``alloc(k)`` with no shard stays the global lowest-free
+    policy, bit-identical to the original single heap at dp=1."""
+
+    def __init__(self, num_blocks: int, reserved: int = 1,
+                 dp: int = 1) -> None:
         if num_blocks <= reserved:
             raise ValueError(
                 f"num_blocks={num_blocks} must exceed the {reserved} "
                 "reserved block(s)"
             )
+        if dp < 1:
+            raise ValueError(f"dp={dp} must be >= 1")
+        if dp > 1 and num_blocks // dp <= reserved:
+            raise ValueError(
+                f"num_blocks={num_blocks} leaves dp shard 0 no "
+                f"allocatable blocks past the {reserved} reserved "
+                f"(need num_blocks // dp > reserved at dp={dp})"
+            )
+        from tf_operator_tpu.serve.sharding import shard_block_extent
+
         self.num_blocks = num_blocks
         self.reserved = reserved
-        self._heap = list(range(reserved, num_blocks))
-        self._free_set = set(self._heap)
+        self.dp = dp
+        self._per = num_blocks // dp
+        self._extents = [
+            shard_block_extent(i, num_blocks, dp, reserved)
+            for i in range(dp)
+        ]
+        self._heaps = [list(range(lo, hi)) for lo, hi in self._extents]
+        self._free_set = set().union(*map(set, self._heaps))
         self._refs: dict[int, int] = {}
         self._lock = threading.Lock()
         self.high_water = 0
 
-    def alloc(self, k: int) -> list[int] | None:
-        """The k lowest free blocks at refcount 1, or None when fewer
-        than k are free (all-or-nothing: a partial grant would deadlock
-        two half-admitted requests against each other)."""
+    def _shard_of(self, blk: int) -> int:
+        return min(blk // self._per, self.dp - 1)
+
+    def alloc(self, k: int, shard: int | None = None) -> list[int] | None:
+        """The k lowest free blocks at refcount 1 — globally
+        (``shard=None``) or from dp shard ``shard``'s extent — or None
+        when fewer than k are free in the chosen scope (all-or-nothing:
+        a partial grant would deadlock two half-admitted requests
+        against each other)."""
         with self._lock:
-            if k > len(self._heap):
+            if shard is not None:
+                heaps = [self._heaps[shard]]
+            else:
+                heaps = self._heaps
+            if k > sum(len(h) for h in heaps):
                 return None
-            out = [heapq.heappop(self._heap) for _ in range(k)]
+            out: list[int] = []
+            for _ in range(k):
+                heap = min((h for h in heaps if h), key=lambda h: h[0])
+                out.append(heapq.heappop(heap))
             for blk in out:
                 self._free_set.discard(blk)
                 self._refs[blk] = 1
@@ -509,10 +586,21 @@ class BlockAllocator:
                     self._refs[blk] = rc - 1
                     continue
                 del self._refs[blk]
-                heapq.heappush(self._heap, blk)
+                heapq.heappush(self._heaps[self._shard_of(blk)], blk)
                 self._free_set.add(blk)
                 freed.append(blk)
         return freed
+
+    def free_in(self, shard: int) -> int:
+        """Free blocks in dp shard ``shard``'s extent (admission's
+        per-shard capacity check / shard-choice tiebreak)."""
+        with self._lock:
+            return len(self._heaps[shard])
+
+    def shard_extent(self, shard: int) -> tuple[int, int]:
+        """[lo, hi) of the global block indices shard ``shard`` owns —
+        the ``within`` bound extent-aware prefix probes use."""
+        return self._extents[shard]
 
     @property
     def free_blocks(self) -> int:
@@ -597,7 +685,34 @@ class PrefixCache:
         keys.reverse()
         return keys
 
-    def lookup(self, tokens: np.ndarray):
+    def _match(self, tokens: np.ndarray,
+               within: tuple[int, int] | None = None):
+        """Longest usable entry for ``tokens`` (caller holds the lock):
+        ``(n, key, entry)`` or None. ``within=(lo, hi)`` (the tp×dp
+        engine's dp-shard block extent) skips entries holding any block
+        outside that range — a shard can only table-reference blocks in
+        its own pool slice, so a donor living on another shard is a
+        miss FOR THAT SHARD even though the digest is registered."""
+        L = len(tokens)
+        for n, key in self._chain_keys(tokens):
+            e = self._entries.get(key)
+            if (
+                e is None
+                or e.n != n
+                or not np.array_equal(e.tokens, tokens[:n])
+            ):
+                continue
+            if n == L and e.logits is None:
+                continue  # full-length but no sampling row: downgrade
+            if within is not None and any(
+                not (within[0] <= b < within[1]) for b in e.blocks
+            ):
+                continue
+            return n, key, e
+        return None
+
+    def lookup(self, tokens: np.ndarray,
+               within: tuple[int, int] | None = None):
         """Longest usable prefix of ``tokens`` ([L] int32): the exact
         whole prompt first (may end mid-block — sharing that partial
         block is what makes copy-on-write reachable), else the longest
@@ -607,22 +722,17 @@ class PrefixCache:
         An exact-length match WITHOUT stored logits (the digest was
         registered as a longer prompt's aligned prefix) is skipped in
         favor of a shorter match: sharing it would leave nothing to
-        prefill yet no logits to sample from."""
+        prefill yet no logits to sample from. ``within`` restricts the
+        match to entries whose blocks all sit inside one dp shard's
+        extent (see ``_match``)."""
         tokens = np.ascontiguousarray(
             np.asarray(tokens, np.int32).reshape(-1)
         )
         L = len(tokens)
         with self._lock:
-            for n, key in self._chain_keys(tokens):
-                e = self._entries.get(key)
-                if (
-                    e is None
-                    or e.n != n
-                    or not np.array_equal(e.tokens, tokens[:n])
-                ):
-                    continue
-                if n == L and e.logits is None:
-                    continue  # full-length but no sampling row: downgrade
+            m = self._match(tokens, within)
+            if m is not None:
+                n, key, e = m
                 self.hits += 1
                 # Recency refresh: dict order IS the LRU order the
                 # fleet advertisement (``advertise``) reads — a hit
@@ -633,6 +743,24 @@ class PrefixCache:
                 )
             self.misses += 1
         return 0, (), None
+
+    def peek(self, tokens: np.ndarray,
+             within: tuple[int, int] | None = None):
+        """``lookup`` without side effects: no hit/miss counters, no LRU
+        refresh. The tp×dp admission planner probes EVERY dp shard's
+        extent with this to pick the shard owning the deepest usable
+        prefix — only the chosen shard's subsequent real ``lookup``
+        should count and refresh."""
+        tokens = np.ascontiguousarray(
+            np.asarray(tokens, np.int32).reshape(-1)
+        )
+        L = len(tokens)
+        with self._lock:
+            m = self._match(tokens, within)
+            if m is None:
+                return 0, (), None
+            n, _, e = m
+            return n, tuple(e.blocks), (e.logits if n == L else None)
 
     def register(self, tokens: np.ndarray, blocks,
                  logits: np.ndarray | None = None) -> None:

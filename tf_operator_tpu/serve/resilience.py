@@ -694,6 +694,24 @@ class EngineSupervisor:
         return getattr(self, "_mesh_devices", 1)
 
     @property
+    def mesh_axes(self) -> dict:
+        """Both SPMD decode-mesh axes ({"tp": N, "dp": M}), held steady
+        through rebuild windows like ``mesh_devices`` — /healthz and
+        /debug/serve report the pod SHAPE, not just its width (a
+        tp=2,dp=2 replica and a tp=4 replica are both 4 chips but serve
+        very different slot capacity)."""
+        sched = self.scheduler
+        if sched is not None:
+            info = (
+                sched.engine.mesh_info()
+                if hasattr(sched.engine, "mesh_info")
+                else {}
+            )
+            self._mesh_axes = {"tp": int(info.get("tp", 1)),
+                               "dp": int(info.get("dp", 1))}
+        return getattr(self, "_mesh_axes", {"tp": 1, "dp": 1})
+
+    @property
     def requests_done(self) -> int:
         with self._lock:   # pair with _restart's aggregate roll-over
             sched = self._sched

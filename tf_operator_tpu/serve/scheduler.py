@@ -62,6 +62,15 @@ rather than abandoning it (the Coalescer's leftover contract) — unless a
 supervisor claims the crash, in which case the waiters ride through the
 restart and are replayed.
 
+POD-SCALE (dp > 1, ISSUE 20): nothing here changes — and that is the
+design. The engine's PLANNED admission hides the whole dp layout:
+``plan_admission`` picks the owning dp shard (free slot there, blocks
+from that shard's pool extent, prefix credit only against prefixes the
+shard can actually reference), so this loop's admit/CoW/retire logic,
+the prefill budget, and the block-exhaustion queueing all run unchanged
+over a tp x dp engine. ``debug_snapshot``'s ``mesh`` carries both axis
+sizes, and ``kv_debug`` grows per-shard extent/free rows at dp > 1.
+
 All counters/histograms land in the process-global registry
 (runtime/metrics.py ``tpu_serve_*``); long-lived tests must window reads
 via snapshot()/deltas.

@@ -28,11 +28,22 @@ Two disaggregation-era extensions, both still pure data:
 - ``dp`` — a batch-parallel mesh axis over SLOTS (the PR 10 follow-on):
   per-slot leaves (stacked dense K/V rows, block tables, counters,
   logits rows) shard their leading slot axis over ``dp`` while the
-  paged pool replicates (it is shared across slots — any table may
-  point at any block). ``leaf_spec``/``cache_specs``/``logits_spec``
-  take optional ``dp_size``/``dp_axis`` with defaults that keep the
-  tp-only layout bit-for-bit. Full tp×dp engine bit-identity is the
-  declared stretch — the spec layer here is what it will pin against.
+  paged pool replicates BY DEFAULT (it is shared across slots — any
+  table may point at any block). ``leaf_spec``/``cache_specs``/
+  ``logits_spec`` take optional ``dp_size``/``dp_axis`` with defaults
+  that keep the tp-only layout bit-for-bit.
+- ``dp_pool=True`` — the pod-scale engine's opt-in (ISSUE 20): the
+  paged pool's BLOCK axis shards over ``dp`` too, which is only
+  correct when the allocator partitions the block-index space the same
+  way — each dp shard owning the slot slice ``[i*per, (i+1)*per)``
+  allocates only from its block extent ``shard_block_extent(i, nb,
+  dp)``, so every table entry of a slot points inside its own shard's
+  pool slice and the gather/scatter traffic stays shard-local under
+  GSPMD.
+  The per-shard arithmetic lives here (``shard_of_slot``,
+  ``shard_block_extent``) because it is pure data the allocators
+  (serve/kvcache.py) and the admission planner (serve/engine.py) must
+  agree on exactly.
 - ``ship_specs`` — the shard layout of SHIPPED KV wire rows
   (serve/disagg.py): each ``[R, KV, Dh]`` wire leaf head-shards like
   the pool leaf its rows land in, so a tp>1 decode replica places the
@@ -97,6 +108,22 @@ _SLOT_LEADING_MIN_RANK = {
     "pos_index": 1,
 }
 
+# Leaf name -> minimum rank at which dimension 0 is the BLOCK axis, for
+# the opt-in ``dp_pool`` layout (the pod-scale tp×dp engine): the pool
+# shards its block axis over dp ONLY when the caller promises the
+# allocator discipline above — each dp shard's slots allocate strictly
+# from that shard's block extent. The min-rank guard keeps the shipped
+# wire rows ([R, KV, Dh], rank 3 for key/value) out of the dp split:
+# they enter replicated and land on the owning shard through the
+# extent-bounded scatter. Default (dp_pool=False) keeps the
+# replicated-pool layout the PR 14 spec tests pin.
+_POOL_LEADING_MIN_RANK = {
+    "pool_key": 4,        # [nb, blk, KV, Dh]
+    "pool_value": 4,
+    "pool_key_scale": 3,  # [nb, blk, KV]
+    "pool_value_scale": 3,
+}
+
 
 def _tiles(shape: tuple, dim: int, size: int) -> bool:
     """Can mesh-axis ``size`` tile dimension ``dim`` of ``shape``?"""
@@ -105,15 +132,18 @@ def _tiles(shape: tuple, dim: int, size: int) -> bool:
 
 def leaf_spec(name: str, shape: tuple, tp_size: int,
               tp_axis: str = "tp", dp_size: int = 1,
-              dp_axis: str = "dp") -> P:
+              dp_axis: str = "dp", dp_pool: bool = False) -> P:
     """PartitionSpec for ONE cache leaf by name + shape. ``tp``:
     head-sharded for the K/V storage leaves (when ``KV % tp == 0``).
     ``dp`` (batch-parallel decode over slots — the PR 10 follow-on):
     slot-axis-sharded for every per-slot leaf whose leading dim tiles —
     slot-stacked dense K/V rows, block tables, counters — while the
     shared paged pool replicates over dp (any slot's table may point at
-    any block). Defaults keep the PR 10 tp-only behavior exactly. Pure
-    data — no mesh, no device."""
+    any block). ``dp_pool=True`` (the pod-scale tp×dp engine) adds the
+    pool's block axis to the dp split — valid only under the per-shard
+    block-extent allocation discipline (``shard_block_extent``).
+    Defaults keep the PR 10 tp-only behavior exactly. Pure data — no
+    mesh, no device."""
     shape = tuple(shape)
     spec = [None] * len(shape)
     from_end = _HEAD_AXIS_FROM_END.get(name)
@@ -125,22 +155,29 @@ def leaf_spec(name: str, shape: tuple, tp_size: int,
     if (dp_size > 1 and min_rank is not None
             and len(shape) >= min_rank and _tiles(shape, 0, dp_size)):
         spec[0] = dp_axis
+    if dp_pool and dp_size > 1:
+        pool_rank = _POOL_LEADING_MIN_RANK.get(name)
+        if (pool_rank is not None and len(shape) >= pool_rank
+                and _tiles(shape, 0, dp_size)):
+            spec[0] = dp_axis
     if not any(spec):
         return P()  # can't tile anything: replicate (never crash)
     return P(*spec)
 
 
 def cache_specs(tree: Any, tp_size: int, tp_axis: str = "tp",
-                dp_size: int = 1, dp_axis: str = "dp") -> Any:
+                dp_size: int = 1, dp_axis: str = "dp",
+                dp_pool: bool = False) -> Any:
     """PartitionSpec pytree matching a cache tree (dense-stacked, paged,
     or solo): K/V leaves head-sharded over tp, per-slot leaves
-    slot-sharded over dp (when requested and tileable), the rest
+    slot-sharded over dp (when requested and tileable), the paged pool
+    block-sharded over dp only under ``dp_pool=True``, the rest
     replicated."""
     def walk(node):
         if isinstance(node, Mapping):
             return {
                 k: (leaf_spec(k, tuple(v.shape), tp_size, tp_axis,
-                              dp_size, dp_axis)
+                              dp_size, dp_axis, dp_pool)
                     if not isinstance(v, Mapping) else walk(v))
                 for k, v in node.items()
             }
@@ -173,8 +210,12 @@ def ship_specs(rows: Any, tp_size: int, tp_axis: str = "tp") -> dict:
     wire leaf is head-sharded exactly like the pool leaf its rows land
     in (suffix addressing finds KV at -2), so a tp decode replica can
     place the incoming rows once and the ingest scatter stays
-    shard-local per chip. ``rows`` leaves may be arrays or bare
-    shapes. Pure data."""
+    shard-local per chip. Wire rows carry NO dp component even on a
+    tp×dp engine: rank-3 ``[R, KV, Dh]`` rows sit below the pool's
+    ``_POOL_LEADING_MIN_RANK``, so they enter dp-replicated and the
+    extent-bounded block allocation (``shard_block_extent``) is what
+    lands them on the owning dp shard's pool slice. ``rows`` leaves may
+    be arrays or bare shapes. Pure data."""
     out: dict = {}
     for path, parts in rows.items():
         out[path] = {}
@@ -195,16 +236,67 @@ def tp_size_of(mesh: Mesh | None, tp_axis: str = "tp") -> int:
     return int(mesh.shape.get(tp_axis, 1))
 
 
+def dp_size_of(mesh: Mesh | None, dp_axis: str = "dp") -> int:
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(dp_axis, 1))
+
+
+def slot_spec(shape: tuple, dp_size: int, dp_axis: str = "dp") -> P:
+    """Spec of a SLOT-LEADING engine vector ([slots] counters, [slots,
+    ...] sampling keys / fsm rows / step indices): dim 0 over dp when it
+    tiles, replicated otherwise — the layout every per-slot leaf outside
+    the cache tree shares at dp>1, and ``P()`` exactly at dp=1."""
+    shape = tuple(shape)
+    if dp_size > 1 and _tiles(shape, 0, dp_size):
+        return P(dp_axis, *([None] * (len(shape) - 1)))
+    return P()
+
+
+def shard_of_slot(slot: int, max_slots: int, dp_size: int) -> int:
+    """Which dp shard owns ``slot``: contiguous slot slices, shard i
+    holding ``[i*per, (i+1)*per)`` with ``per = max_slots // dp`` —
+    matching ``P(dp)`` on a slot-leading axis, where XLA tiles dim 0
+    contiguously across the dp groups. The allocators and the admission
+    planner must agree with THIS function, never re-derive it."""
+    if dp_size <= 1:
+        return 0
+    per = max_slots // dp_size
+    return min(int(slot) // per, dp_size - 1)
+
+
+def shard_block_extent(shard: int, num_blocks: int, dp_size: int,
+                       reserved: int = 1) -> tuple[int, int]:
+    """[lo, hi) of the GLOBAL block indices dp shard ``shard`` may
+    allocate — the contiguous ``P(dp)`` tile of the pool's block axis,
+    with the ``reserved`` garbage blocks (block 0) excluded from shard
+    0's allocatable range (they stay pinned, in shard 0's tile, exactly
+    as in the single-shard pool). A slot's table then points only
+    inside its own shard's pool slice, which is what makes the
+    ``dp_pool`` layout legal."""
+    if dp_size <= 1:
+        return reserved, num_blocks
+    per = num_blocks // dp_size
+    lo, hi = shard * per, (shard + 1) * per
+    if shard == dp_size - 1:
+        hi = num_blocks  # remainder blocks ride the last shard
+    return (max(lo, reserved), hi)
+
+
 def shard_engine_state(mesh: Mesh, tree: Any, specs: Any = None,
-                       tp_axis: str = "tp") -> Any:
+                       tp_axis: str = "tp", dp_axis: str = "dp",
+                       dp_pool: bool = False) -> Any:
     """device_put a cache tree per ``cache_specs`` (or explicit
     ``specs``): the pool lands head-sharded across the slice, per-slot
-    state replicated — ONE placement at construction, after which every
-    executable's constrained outputs keep the layout."""
+    state dp-sharded when the mesh carries a dp axis, the pool's block
+    axis joining the dp split only under ``dp_pool=True`` — ONE
+    placement at construction, after which every executable's
+    constrained outputs keep the layout."""
     import jax
 
     if specs is None:
-        specs = cache_specs(tree, tp_size_of(mesh, tp_axis), tp_axis)
+        specs = cache_specs(tree, tp_size_of(mesh, tp_axis), tp_axis,
+                            dp_size_of(mesh, dp_axis), dp_axis, dp_pool)
 
     def walk(node, spec):
         if isinstance(node, Mapping):

@@ -111,7 +111,12 @@ tp-sharded, KV storage head-sharded, one compiled step driving the
 slice — the host-device trick supplies CPU devices, real chips on
 hardware) and through the single-device engine as baseline; the tp
 line's ``vs_baseline`` is tpN/tp1 and carries ``mesh_devices`` +
-the zero-recompile pin.
+the zero-recompile pin. ``--tp N --dp M`` runs the POD-SCALE pair
+instead (ISSUE 20): the engine on the 2-D tp x dp mesh (slot state and
+the paged pool's block axis sharded over dp on top of the tp head
+shard) vs the same tp at dp=1 on the identical schedule —
+``vs_baseline`` = tpNdpM/tpNdp1, ``mesh_devices`` = N*M, same
+zero-recompile pin; on CPU a mechanism proof, not a speedup.
 
 All randomness is seeded (schedule, prompts); wall-clock only enters the
 timing fields, so tests assert structure and token counts, never timing.
@@ -388,6 +393,45 @@ def run_tp_legs(cfg, params, schedule, args) -> list[dict]:
         tp_line["vs_baseline"] = round(tp_line["value"] / base["value"],
                                        3)
     return [tp_line, base]
+
+
+def run_tpdp_legs(cfg, params, schedule, args) -> list[dict]:
+    """The pod-scale pair (ISSUE 20): the continuous engine on the 2-D
+    ``tp x dp`` mesh — per-slot state and the paged pool's block axis
+    sharded over dp on top of the tp head shard, ONE compiled step
+    driving every device — vs the SAME tp width at dp=1 on the
+    IDENTICAL seeded schedule. The tpdp line's vs_baseline is
+    tp{N}dp{M}/tp{N}dp1 tokens/sec and carries ``mesh_devices`` (=N*M)
+    plus the zero-recompile pin (``decode_step_compiles`` ==
+    ``warmup_compiles``). On CPU host devices this is a MECHANISM
+    proof, not a speedup — dp buys aggregate slots/HBM only on real
+    chips; the line exists so hardware rounds report the true pod
+    number through the same plumbing."""
+    import jax
+
+    from tf_operator_tpu.parallel.mesh import create_mesh
+
+    need = args.tp * args.dp
+    if len(jax.devices()) < need:
+        raise SystemExit(
+            f"serve_bench: --tp {args.tp} --dp {args.dp} needs {need} "
+            f"devices, have {len(jax.devices())}"
+        )
+    if args.max_batch % args.dp:
+        raise SystemExit(
+            f"serve_bench: --dp {args.dp} must divide --max-batch "
+            f"{args.max_batch} (each dp shard owns an equal slot slice)"
+        )
+    mesh2 = create_mesh({"tp": args.tp, "dp": args.dp},
+                        jax.devices()[:need])
+    line = run_continuous(cfg, params, schedule, args, mesh=mesh2,
+                          name=f"tp{args.tp}dp{args.dp}")
+    mesh1 = create_mesh({"tp": args.tp}, jax.devices()[: args.tp])
+    base = run_continuous(cfg, params, schedule, args, mesh=mesh1,
+                          name=f"tp{args.tp}dp1")
+    if base["value"]:
+        line["vs_baseline"] = round(line["value"] / base["value"], 3)
+    return [line, base]
 
 
 # Constrained-decoding mix (ISSUE 19): every ``every``-th request
@@ -1793,6 +1837,13 @@ def main(argv: list[str] | None = None) -> int:
                         "schedule (vs_baseline = tpN/tp1). On CPU the "
                         "devices are forced via the XLA host-device "
                         "trick before jax imports")
+    p.add_argument("--dp", type=int, default=1,
+                   help="with --tp: run ONLY the pod-scale pair — the "
+                        "continuous engine on the 2-D tp x dp mesh "
+                        "(tp*dp devices; slot state + paged pool "
+                        "blocks dp-sharded) vs the same tp at dp=1 on "
+                        "the identical schedule (vs_baseline = "
+                        "tpNdpM/tpNdp1); must divide --max-batch")
     p.add_argument("--requests", type=int, default=None)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
@@ -1831,7 +1882,7 @@ def main(argv: list[str] | None = None) -> int:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from serve_tp_check import _force_host_devices
 
-        _force_host_devices(args.tp)
+        _force_host_devices(args.tp * max(1, args.dp))
 
     import jax
     import jax.numpy as jnp
@@ -1864,6 +1915,11 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     lines = []
+    if args.tp > 1 and args.dp > 1 and args.engine != "spec":
+        lines = run_tpdp_legs(cfg, params, schedule, args)
+        for line in lines:
+            print(json.dumps(line), flush=True)
+        return 0 if all(not line["errors"] for line in lines) else 1
     if args.tp > 1 and args.engine != "spec":
         lines = run_tp_legs(cfg, params, schedule, args)
         for line in lines:

@@ -18,7 +18,10 @@ tensor-parallel matrix (tools/serve_tp_check.py at tp=2 host devices:
 speculative cells spec/{dense, paged, paged-kv8}, a constrained cell,
 + the supervisor
 mesh-reconstruction replay, slow-marked in tier-1 so THIS is its
-default home). The quick loop for iterating on tf_operator_tpu/serve/
+default home) and the POD-SCALE {tp=2, dp=2} pass (serve_tp_check.py
+--dp 2 at 4 host devices: one engine over the 2-D mesh, dense/paged/
+kv8/pallas bit-identity, dp-shard KV ingest, 2-D supervisor replay).
+The quick loop for iterating on tf_operator_tpu/serve/
 without paying for the whole tier-1 run.
 
     python tools/serve_smoke.py            # the smoke subset + e2e pair
@@ -402,10 +405,26 @@ def main(argv: list[str] | None = None) -> int:
     tp_env["PYTHONPATH"] = (
         REPO_ROOT + os.pathsep + tp_env.get("PYTHONPATH", "")
     )
-    return subprocess.call(
+    rc = subprocess.call(
         [sys.executable, os.path.join(REPO_ROOT, "tools",
                                       "serve_tp_check.py"), "--tp", "2"],
         cwd=REPO_ROOT, env=tp_env,
+    )
+    if rc != 0:
+        return rc
+    # Pod-scale decode (ISSUE 20): the {tp=2, dp=2} cells — one engine
+    # over a 2-D mesh, slot state + pool block axis sharded over dp,
+    # bit-identical to the canonical tp oracle for {dense, paged, kv8,
+    # pallas}, shipped/tier-restored KV landing on the seating dp
+    # shard, and the supervisor rebuilding the 2-D mesh. Also a
+    # subprocess: 4 host devices need their own XLA_FLAGS.
+    tpdp_env = dict(tp_env)
+    tpdp_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    return subprocess.call(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "serve_tp_check.py"),
+         "--tp", "2", "--dp", "2"],
+        cwd=REPO_ROOT, env=tpdp_env,
     )
 
 

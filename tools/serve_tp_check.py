@@ -635,17 +635,322 @@ def run_supervisor_replay(tp: int) -> int:
         sup.stop(timeout=30.0)
 
 
+def run_tpdp(tp: int, dp: int) -> int:
+    """Pod-scale decode (ISSUE 20): ONE ContinuousEngine over a 2-D
+    {tp}x{dp} mesh — slot-leading state and the pool's block axis shard
+    over dp, K/V heads and params over tp, ONE compiled step drives the
+    whole slice. Proves, per cell:
+
+    - greedy AND sampled output bit-identical to solo ``generate`` with
+      the same tp-sharded params across an occupancy walk that crosses
+      BOTH axes (joins/retires/slot reuse on every dp shard), for
+      {dense, paged, kv8, pallas};
+    - the storage is REALLY 2-D sharded: each device holds
+      blocks/dp x KV/tp of the pool (dense: slots/dp rows);
+    - every paged slot's table references only its OWN dp shard's block
+      extent (the dp_pool legality invariant);
+    - ``decode_step_compiles == warmup_compiles`` at the end of every
+      cell (the zero-recompile pin holds on the 2-D mesh);
+    - shipped-KV ingest and host-tier restore land on the dp shard that
+      SEATS the request (the shard with free slots), and the admission
+      plan exact-hits the landed prefix there — decode bit-identical;
+    - a supervised tp×dp engine crashed mid-decode rebuilds,
+      reconstructs the 2-D mesh through the factory, and replays
+      bit-identically."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.models.transformer import (
+        Transformer,
+        TransformerConfig,
+        generate,
+        param_sharding_rules,
+    )
+    from tf_operator_tpu.parallel.mesh import create_mesh
+    from tf_operator_tpu.parallel.sharding import shard_params_by_rules
+    from tf_operator_tpu.serve.engine import ContinuousEngine
+    from tf_operator_tpu.serve.sharding import shard_of_slot
+
+    need = tp * dp
+    if len(jax.devices()) < need:
+        print(f"serve_tp_check: need {need} devices, have "
+              f"{len(jax.devices())}", file=sys.stderr)
+        return 1
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    cfg8 = TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, kv_int8=True,
+    )
+    params = Transformer(cfg).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    mesh = create_mesh({"tp": tp, "dp": dp}, jax.devices()[:need])
+    # The oracle runs on the CANONICAL tp-only mesh (the exact solo
+    # baseline run_matrix pins): the claim under test is that adding
+    # the dp axis changes NOTHING bitwise vs that baseline. (Running
+    # solo generate itself on the wider mesh lets GSPMD pick different
+    # layouts for the unconstrained b=1 activations — ULP drift that
+    # can flip a sampled categorical draw; the engine does not drift.)
+    omesh = create_mesh({"tp": tp}, jax.devices()[:tp])
+    sharded = shard_params_by_rules(omesh, params,
+                                    param_sharding_rules())
+
+    def solo(prompt, steps, *, c=cfg, temperature=0.0, seed=0):
+        kw = {}
+        if temperature > 0:
+            kw = dict(temperature=temperature,
+                      rng=jax.random.PRNGKey(seed))
+        return np.asarray(
+            generate(c, sharded, jnp.asarray(prompt), steps, **kw)
+        )[0]
+
+    def first_leaf(tree, names):
+        from collections.abc import Mapping
+
+        for k, v in tree.items():
+            if isinstance(v, Mapping):
+                found = first_leaf(v, names)
+                if found is not None:
+                    return found
+            elif k in names:
+                return v
+        return None
+
+    def extent_violations(eng, label):
+        """Every live paged slot's blocks inside its OWN shard's
+        extent — the invariant that makes the dp-sharded pool legal."""
+        for s, st in eng._slot_state.items():
+            lo, hi = eng.blocks.shard_extent(
+                shard_of_slot(s, eng.max_slots, dp)
+            )
+            bad = [b for b in st["private"] + st["shared"]
+                   if b and not lo <= b < hi]
+            if bad:
+                print(f"serve_tp_check: tpdp {label} slot {s} holds "
+                      f"blocks {bad} outside its dp shard extent "
+                      f"[{lo}, {hi})", file=sys.stderr)
+                return 1
+        return 0
+
+    failures = 0
+    rng = np.random.default_rng(11)
+    slots = 2 * dp  # two slots per dp shard
+    cells = [
+        ("dense", cfg, dict(kv_paged=False)),
+        ("paged", cfg, dict(kv_paged=True)),
+        ("kv8", cfg8, dict(kv_paged=True)),
+        ("pallas", cfg, dict(kv_paged=True, kv_attend="pallas")),
+    ]
+    for label, c, kw in cells:
+        eng = ContinuousEngine(c, params, max_slots=slots, kv_block=8,
+                               mesh=mesh, **kw)
+        # The storage is REALLY 2-D sharded: block axis (dense: slot
+        # axis) divided by dp, KV heads by tp, on every device.
+        leaf = first_leaf(eng._cache, ("pool_key", "cached_key"))
+        local = leaf.addressable_shards[0].data.shape
+        want0 = (eng.kv_blocks // dp) if eng.kv_paged else slots // dp
+        if local[0] != want0 or local[-2] != c.kv_heads // tp:
+            print(f"serve_tp_check: tpdp {label} per-device shard "
+                  f"{local} is not blocks/dp x KV/tp", file=sys.stderr)
+            failures += 1
+        # Occupancy walk crossing BOTH axes: joins/retires mid-decode,
+        # slot reuse past one shard's slice, a sampled lane, and an
+        # exact shared-prefix re-join ("d" repeats p1's prompt).
+        p1 = rng.integers(0, 64, (1, 9)).astype(np.int32)
+        p2 = rng.integers(0, 64, (1, 5)).astype(np.int32)
+        p3 = rng.integers(0, 64, (1, 12)).astype(np.int32)
+        plan = {"a": (p1, 10, 0.0, 0), "b": (p2, 6, 0.0, 0),
+                "c": (p3, 8, 0.9, 3), "d": (p1, 8, 0.0, 0),
+                "e": (p2, 4, 0.0, 0)}
+        joins = {1: "b", 2: "c", 4: "d", 12: "e"}
+        live, outs, shards_used = {}, {}, set()
+        s0 = eng.join(jnp.asarray(p1), num_steps=10)
+        live[s0] = ("a", 10, [])
+        shards_used.add(shard_of_slot(s0, slots, dp))
+        i = 0
+        while live:
+            toks = eng.step()
+            i += 1
+            for s in list(live):
+                name, n, acc = live[s]
+                acc.append(int(toks[s]))
+                if len(acc) == n:
+                    eng.retire(s)
+                    outs[name] = acc
+                    del live[s]
+            if i in joins:
+                name = joins[i]
+                p, n, t, seed = plan[name]
+                s = eng.join(jnp.asarray(p), num_steps=n,
+                             temperature=t, seed=seed)
+                assert s is not None, f"tpdp {label}: no slot for {name}"
+                live[s] = (name, n, [])
+                shards_used.add(shard_of_slot(s, slots, dp))
+                if eng.kv_paged:
+                    failures += extent_violations(eng, label)
+        for name, (p, n, t, seed) in plan.items():
+            want = solo(p, n, c=c, temperature=t, seed=seed)
+            if not np.array_equal(np.asarray(outs[name]), want):
+                print(f"serve_tp_check: tpdp {label} request {name} "
+                      f"DIVERGED from solo generate", file=sys.stderr)
+                failures += 1
+        if len(shards_used) < dp:
+            print(f"serve_tp_check: tpdp {label} walk never left dp "
+                  f"shard(s) {shards_used}", file=sys.stderr)
+            failures += 1
+        if eng.decode_step_compiles != eng.warmup_compiles:
+            print(f"serve_tp_check: tpdp {label} recompiled "
+                  f"({eng.decode_step_compiles} != warmup "
+                  f"{eng.warmup_compiles})", file=sys.stderr)
+            failures += 1
+        print(f"serve_tp_check: tpdp {label} ok (blocks-or-slots/dev "
+              f"{local[0]}, kv/dev {local[-2]}, shards {sorted(shards_used)}, "
+              f"compiles {eng.decode_step_compiles}=warmup)", flush=True)
+
+    # dp-shard KV ingest: fill slots until ONE shard has the only free
+    # seats, then ship a prefilled prompt in — the ingest must land the
+    # blocks on THAT shard's extent, and the admission plan must
+    # exact-hit them there (prefill skipped, decode bit-identical).
+    from tf_operator_tpu.serve.disagg import PrefillWorker, decode_shipment
+
+    for source in ("ship", "tier"):
+        eng = ContinuousEngine(cfg, params, max_slots=slots, kv_block=8,
+                               mesh=mesh)
+        prompt = rng.integers(0, 64, (1, 9)).astype(np.int32)
+        if source == "tier":
+            from tf_operator_tpu.serve.tier import HostTier
+
+            eng.host_tier = HostTier(1 << 22)
+            # Decode the prompt once and retire: the freed exact prefix
+            # entry SPILLS into the host tier on the way out.
+            s = eng.join(jnp.asarray(prompt), num_steps=3)
+            for _ in range(3):
+                eng.step()
+            eng.retire(s)
+        while sum(1 for i in range(dp) if eng.alloc.free_in(i)) > 1:
+            s = eng.join(jnp.asarray(
+                rng.integers(0, 64, (1, 5)).astype(np.int32)
+            ), num_steps=20)
+            assert s is not None
+        target = next(i for i in range(dp) if eng.alloc.free_in(i))
+        lo, hi = eng.blocks.shard_extent(target)
+        if source == "ship":
+            pw = PrefillWorker(cfg, params, kv_block=8)
+            shp = decode_shipment(pw.prefill(prompt))
+            hold = eng.ingest_shipment(shp, reserve_steps=4)
+            ok = hold is not None and hold.blocks
+        else:
+            hold, outcome = eng.restore_from_tier(prompt,
+                                                  reserve_steps=4)
+            ok = outcome == "ok" and hold.blocks
+        if not ok:
+            print(f"serve_tp_check: tpdp {source} ingest did not land",
+                  file=sys.stderr)
+            failures += 1
+            continue
+        bad = [b for b in hold.blocks if not lo <= b < hi]
+        if bad:
+            print(f"serve_tp_check: tpdp {source} ingest blocks {bad} "
+                  f"outside seating shard {target}'s extent [{lo}, {hi})",
+                  file=sys.stderr)
+            failures += 1
+        adm = eng.plan_admission(prompt, 4)
+        if adm is None or adm.dp_shard != target or adm.prefill_tokens:
+            print(f"serve_tp_check: tpdp {source} plan did not "
+                  f"exact-hit the landed prefix on shard {target} "
+                  f"(plan={adm and (adm.dp_shard, adm.prefill_tokens)})",
+                  file=sys.stderr)
+            failures += 1
+            eng.release_plan(adm)
+            eng.release_shipment(hold)
+            continue
+        s = eng.join_planned(adm)
+        eng.release_shipment(hold)
+        out = [int(eng.step()[s]) for _ in range(4)]
+        if not np.array_equal(out, solo(prompt, 4)):
+            print(f"serve_tp_check: tpdp {source}-landed decode "
+                  f"DIVERGED from solo generate", file=sys.stderr)
+            failures += 1
+        if eng.decode_step_compiles != eng.warmup_compiles:
+            print(f"serve_tp_check: tpdp {source} ingest recompiled",
+                  file=sys.stderr)
+            failures += 1
+        print(f"serve_tp_check: tpdp {source} ingest ok (landed on "
+              f"shard {target} extent [{lo}, {hi}), exact-hit, "
+              f"bit-identical)", flush=True)
+
+    # Crash -> rebuild -> replay on the 2-D mesh: the factory
+    # reconstructs tp x dp and the replay is bit-identical.
+    from tf_operator_tpu.serve.faultinject import FaultInjector
+    from tf_operator_tpu.serve.resilience import (
+        EngineSupervisor,
+        ResilienceConfig,
+    )
+
+    inj = FaultInjector(seed=1)
+    sup = EngineSupervisor(
+        lambda: ContinuousEngine(cfg, params, max_slots=slots,
+                                 kv_block=8, mesh=mesh, faults=inj),
+        resilience=ResilienceConfig(watchdog_stall_s=10.0,
+                                    restart_backoff_s=0.05,
+                                    max_restarts=3),
+        faults=inj,
+    )
+    try:
+        prompt = rng.integers(0, 64, (1, 11)).astype(np.int32)
+        want = solo(prompt, 24)
+        if not np.array_equal(sup.submit(prompt, 24)[0], want):
+            print("serve_tp_check: tpdp pre-crash output != solo",
+                  file=sys.stderr)
+            failures += 1
+        inj.arm(f"step_raise@{inj.invocations['step_raise'] + 6}")
+        out = sup.submit(prompt, 24, timeout=180)
+        if sup.restarts != 1 or not np.array_equal(out[0], want):
+            print("serve_tp_check: tpdp post-crash replay diverged or "
+                  f"restarts={sup.restarts}", file=sys.stderr)
+            failures += 1
+        if sup.mesh_devices != need:
+            print(f"serve_tp_check: tpdp rebuilt mesh width "
+                  f"{sup.mesh_devices} != {need}", file=sys.stderr)
+            failures += 1
+        print(f"serve_tp_check: tpdp supervisor replay ok (1 restart, "
+              f"2-D mesh reconstructed at {need} devices, replay "
+              f"bit-identical)", flush=True)
+    finally:
+        sup.stop(timeout=30.0)
+    return failures
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--tp", type=int, default=2,
                    help="mesh width (forced as CPU host devices when "
                         "the platform is CPU)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="batch-parallel mesh axis over slots; > 1 runs "
+                        "the pod-scale tp x dp cells INSTEAD of the "
+                        "tp-only pass (tp*dp host devices)")
     p.add_argument("--skip-supervisor", action="store_true",
                    help="matrix only (the replay drill builds 2+ more "
                         "engines)")
     args = p.parse_args(argv)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    _force_host_devices(args.tp)
+    _force_host_devices(args.tp * max(1, args.dp))
+    if args.dp > 1:
+        failures = run_tpdp(args.tp, args.dp)
+        if failures:
+            print(f"serve_tp_check: FAIL ({failures} failure(s))",
+                  file=sys.stderr)
+            return 1
+        print(f"serve_tp_check: OK (tp={args.tp}, dp={args.dp}, tpdp "
+              f"matrix + ingest + supervisor replay bit-identical, "
+              f"zero post-warmup recompiles)", flush=True)
+        return 0
     failures = run_matrix(args.tp)
     failures += run_spec(args.tp)
     failures += run_constrain(args.tp)
